@@ -1,0 +1,56 @@
+"""incubator_mxnet_tpu: a TPU-native deep learning framework.
+
+A ground-up re-design of the capabilities of Apache MXNet (incubating) for
+TPU hardware: JAX/XLA is the compute substrate (MXU matmuls/convs, ICI
+collectives, XLA fusion in place of the dependency engine + cuDNN/MKL-DNN
+backends), Pallas for custom kernels, pjit/shard_map over device meshes for
+data/model/sequence parallelism.
+
+Usage mirrors the reference's `import mxnet as mx`:
+
+    import incubator_mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu())
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .base import MXNetError  # noqa: F401
+from .context import Context, cpu, cpu_pinned, gpu, tpu, current_context, num_gpus, num_tpus  # noqa: F401
+from . import base  # noqa: F401
+from . import ops  # noqa: F401
+from . import ndarray  # noqa: F401
+from . import ndarray as nd  # noqa: F401
+from . import autograd  # noqa: F401
+from . import random  # noqa: F401
+
+# populated as subsystems land (symbol, module, gluon, optimizer, kvstore, io,
+# metric, initializer, parallel, profiler, ...)
+from . import symbol  # noqa: F401  # isort: skip
+from . import symbol as sym  # noqa: F401
+from .symbol import Symbol  # noqa: F401
+from . import initializer  # noqa: F401
+from .initializer import init  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import optimizer as opt  # noqa: F401
+from . import lr_scheduler  # noqa: F401
+from . import metric  # noqa: F401
+from . import kvstore as kv  # noqa: F401
+from . import kvstore  # noqa: F401
+from . import io  # noqa: F401
+from . import recordio  # noqa: F401
+from . import image  # noqa: F401
+from . import callback  # noqa: F401
+from . import monitor  # noqa: F401
+from . import model  # noqa: F401
+from . import module  # noqa: F401
+from . import gluon  # noqa: F401
+from . import executor  # noqa: F401
+from . import engine  # noqa: F401
+from . import profiler  # noqa: F401
+from . import runtime  # noqa: F401
+from . import parallel  # noqa: F401
+from . import test_utils  # noqa: F401
+from . import visualization  # noqa: F401
+from . import visualization as viz  # noqa: F401
+from .util import is_np_array  # noqa: F401

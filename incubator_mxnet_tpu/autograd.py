@@ -1,0 +1,302 @@
+"""Imperative autograd: tape + record/pause scopes + backward.
+
+TPU-native equivalent of the reference's imperative runtime & autograd tape
+(ref: src/imperative/imperative.cc — RecordOp:191, Backward:278;
+python/mxnet/autograd.py). Where the reference re-runs an nnvm gradient pass
+over recorded nodes, here every recorded op carries a `jax.vjp` closure; the
+backward pass walks the tape in reverse topological order and accumulates
+cotangents. XLA executes each vjp asynchronously, which preserves the
+reference engine's compute/transfer overlap without an explicit dependency
+scheduler.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import random as _random
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "set_recording",
+    "set_training",
+    "mark_variables",
+    "backward",
+    "grad",
+    "get_symbol",
+]
+
+_STATE = threading.local()
+
+
+def _state():
+    if not hasattr(_STATE, "recording"):
+        _STATE.recording = False
+        _STATE.training = False
+    return _STATE
+
+
+def is_recording():
+    return _state().recording
+
+
+def is_training():
+    return _state().training
+
+
+def set_recording(is_record):
+    prev = _state().recording
+    _STATE.recording = bool(is_record)
+    return prev
+
+
+def set_training(train_mode_):
+    prev = _state().training
+    _STATE.training = bool(train_mode_)
+    return prev
+
+
+class _AutogradScope:
+    def __init__(self, recording=None, training=None):
+        self._recording = recording
+        self._training = training
+
+    def __enter__(self):
+        if self._recording is not None:
+            self._prev_rec = set_recording(self._recording)
+        if self._training is not None:
+            self._prev_train = set_training(self._training)
+        return self
+
+    def __exit__(self, *exc):
+        if self._recording is not None:
+            set_recording(self._prev_rec)
+        if self._training is not None:
+            set_training(self._prev_train)
+
+
+def record(train_mode=True):  # noqa: A002 - reference API name
+    """Scope: record ops for autograd (ref: python/mxnet/autograd.py:93)."""
+    return _AutogradScope(recording=True, training=train_mode)
+
+
+def pause(train_mode=False):
+    return _AutogradScope(recording=False, training=train_mode)
+
+
+def train_mode():
+    return _AutogradScope(training=True)
+
+
+def predict_mode():
+    return _AutogradScope(training=False)
+
+
+# ---------------------------------------------------------------------------
+# Tape
+# ---------------------------------------------------------------------------
+
+
+class TapeNode:
+    """One recorded op: vjp closure + graph links (ref: Imperative::RecordOp)."""
+
+    __slots__ = ("vjp", "inputs", "n_outputs", "out_avals", "name", "saved")
+
+    def __init__(self, vjp, inputs, n_outputs, out_avals, name=""):
+        self.vjp = vjp
+        self.inputs = inputs  # list[NDArray]
+        self.n_outputs = n_outputs
+        self.out_avals = out_avals  # [(shape, dtype)] per output
+        self.name = name
+
+
+def _attach_outputs(node, outputs):
+    for i, o in enumerate(outputs):
+        o._node = node
+        o._node_index = i
+
+
+def invoke_recorded(fn, input_arrays, name=""):
+    """Run `fn(*jax_arrays) -> array | tuple` with optional tape recording.
+
+    Central eager dispatcher used by every generated nd.* function.
+    Always returns a list of NDArrays.
+    """
+    from .ndarray.ndarray import NDArray
+
+    datas = [a._data if isinstance(a, NDArray) else a for a in input_arrays]
+    nd_inputs = [a for a in input_arrays if isinstance(a, NDArray)]
+    recording = is_recording() and len(nd_inputs) > 0
+
+    if not recording:
+        out = fn(*datas)
+        outs = out if isinstance(out, tuple) else (out,)
+        return [NDArray._from_data(o) for o in outs]
+
+    def tuple_fn(*xs):
+        out = fn(*xs)
+        return out if isinstance(out, tuple) else (out,)
+
+    outs, vjp_fn = jax.vjp(tuple_fn, *datas)
+    res = [NDArray._from_data(o) for o in outs]
+    node = TapeNode(
+        vjp=vjp_fn,
+        inputs=list(input_arrays),
+        n_outputs=len(res),
+        out_avals=[(o.shape, o.dtype) for o in outs],
+        name=name,
+    )
+    _attach_outputs(node, res)
+    return res
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers (ref: MXAutogradMarkVariables)."""
+    if not isinstance(variables, (list, tuple)):
+        variables, gradients = [variables], [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = req
+
+
+def _topo_order(head_nodes):
+    """Post-order (children-first) node order via iterative DFS."""
+    order, visited, stack = [], set(), []
+    for root in head_nodes:
+        if id(root) in visited:
+            continue
+        stack.append((root, False))
+        while stack:
+            node, processed = stack.pop()
+            if processed:
+                order.append(node)
+                continue
+            if id(node) in visited:
+                continue
+            visited.add(id(node))
+            stack.append((node, True))
+            for inp in node.inputs:
+                n = getattr(inp, "_node", None)
+                if n is not None and id(n) not in visited:
+                    stack.append((n, False))
+    return order
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # noqa: A002
+    """Compute gradients of heads w.r.t. marked variables.
+
+    (ref: Imperative::Backward imperative.cc:278)
+    """
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    # cotangent accumulator: id(node) -> [cotangent per output]
+    cotangents: dict[int, list] = {}
+    # within-call gradient accumulator for marked variables: id(arr) -> ct
+    var_cts: dict[int, object] = {}
+    var_by_id: dict[int, object] = {}
+
+    def _accum_var(arr, ct):
+        if getattr(arr, "_grad", None) is None or getattr(arr, "_grad_req", "write") == "null":
+            return
+        k = id(arr)
+        var_by_id[k] = arr
+        var_cts[k] = ct if k not in var_cts else var_cts[k] + ct
+
+    head_nodes = []
+    for h, hg in zip(heads, head_grads):
+        g = hg._data if isinstance(hg, NDArray) else (
+            jnp.ones(h.shape, h.dtype) if hg is None else jnp.asarray(hg)
+        )
+        node = getattr(h, "_node", None)
+        if node is None:
+            _accum_var(h, g)
+            continue
+        head_nodes.append(node)
+        slot = cotangents.setdefault(id(node), [None] * node.n_outputs)
+        idx = h._node_index
+        slot[idx] = g if slot[idx] is None else slot[idx] + g
+        if getattr(h, "_grad", None) is not None:
+            _accum_var(h, g)
+
+    order = _topo_order(head_nodes)
+    for node in reversed(order):
+        cts = cotangents.pop(id(node), None)
+        if cts is None:
+            continue
+        full = tuple(
+            ct if ct is not None else jnp.zeros(shape, dtype)
+            for ct, (shape, dtype) in zip(cts, node.out_avals)
+        )
+        in_cts = node.vjp(full)
+        for inp, ct in zip(node.inputs, in_cts):
+            if ct is None or not isinstance(inp, NDArray):
+                continue
+            if hasattr(ct, "dtype") and ct.dtype == jax.dtypes.float0:
+                continue
+            sub = getattr(inp, "_node", None)
+            if sub is not None:
+                slot = cotangents.setdefault(id(sub), [None] * sub.n_outputs)
+                i = inp._node_index
+                slot[i] = ct if slot[i] is None else slot[i] + ct
+            else:
+                _accum_var(inp, ct)
+        if not retain_graph:
+            node.vjp = None  # free residuals
+
+    # write accumulated cotangents into grad buffers per grad_req
+    for k, ct in var_cts.items():
+        arr = var_by_id[k]
+        grad = arr._grad
+        if getattr(arr, "_grad_req", "write") == "add":
+            grad._data = grad._data + ct.astype(grad.dtype)
+        else:
+            grad._data = jnp.asarray(ct, dtype=grad.dtype).reshape(grad.shape)
+
+    if not retain_graph:
+        for h in heads:
+            if getattr(h, "_node", None) is not None:
+                h._node = None
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False, train_mode=True):  # noqa: A002
+    """Return grads of heads w.r.t. variables (ref: autograd.grad)."""
+    from .ndarray.ndarray import NDArray
+
+    if create_graph:
+        raise NotImplementedError("higher-order autograd: use hybridized jax.grad path")
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    saved = [(getattr(v, "_grad", None), getattr(v, "_grad_req", "write")) for v in variables]
+    zeros = []
+    for v in variables:
+        z = NDArray._from_data(jnp.zeros(v.shape, v.dtype))
+        zeros.append(z)
+        v._grad = z
+        v._grad_req = "add"
+    backward(heads, head_grads, retain_graph=bool(retain_graph), train_mode=train_mode)
+    outs = [v._grad for v in variables]
+    for v, (g, r) in zip(variables, saved):
+        v._grad, v._grad_req = g, r
+    return outs[0] if single else outs
+
+
+def get_symbol(x):
+    raise NotImplementedError("tracing an eager tape to a Symbol is not supported; use hybridize")

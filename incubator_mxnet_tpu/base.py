@@ -1,0 +1,64 @@
+"""Base definitions shared across the framework.
+
+TPU-native re-imagination of the reference framework's base layer
+(ref: include/mxnet/base.h, python/mxnet/base.py). Instead of a C ABI +
+ctypes handle zoo, the substrate is JAX/XLA: arrays are `jax.Array`s, ops are
+traced/jitted functions, and the "engine" is XLA's async dispatch.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["MXNetError", "DType", "dtype_np", "canonical_dtype", "string_types"]
+
+string_types = (str,)
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (ref: python/mxnet/base.py MXNetError)."""
+
+
+# Canonical dtype names (ref: mshadow type enum used by TBlob / NDArray).
+_DTYPE_ALIASES = {
+    "float32": np.float32,
+    "float64": np.float64,
+    "float16": np.float16,
+    "bfloat16": None,  # resolved lazily via ml_dtypes/jnp
+    "uint8": np.uint8,
+    "int8": np.int8,
+    "int32": np.int32,
+    "int64": np.int64,
+    "bool": np.bool_,
+}
+
+
+def dtype_np(dtype):
+    """Resolve a user-supplied dtype (str/np.dtype/jnp dtype) to a numpy dtype."""
+    if dtype is None:
+        return np.dtype(np.float32)
+    if isinstance(dtype, str):
+        if dtype == "bfloat16":
+            import ml_dtypes
+
+            return np.dtype(ml_dtypes.bfloat16)
+        if dtype in _DTYPE_ALIASES:
+            return np.dtype(_DTYPE_ALIASES[dtype])
+    return np.dtype(dtype)
+
+
+def canonical_dtype(dtype) -> str:
+    """Canonical string name for a dtype."""
+    return dtype_np(dtype).name
+
+
+class DType:
+    """Namespace of supported dtypes."""
+
+    float16 = "float16"
+    float32 = "float32"
+    float64 = "float64"
+    bfloat16 = "bfloat16"
+    uint8 = "uint8"
+    int8 = "int8"
+    int32 = "int32"
+    int64 = "int64"

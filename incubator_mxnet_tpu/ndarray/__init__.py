@@ -1,0 +1,132 @@
+"""Eager NDArray API (ref: python/mxnet/ndarray/).
+
+Creation ops, generated operator functions, serialization, and the
+random/linalg/sparse/contrib sub-namespaces.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import dtype_np
+from ..context import Context, current_context
+from .ndarray import NDArray, array, concatenate, from_jax, waitall
+from . import register as _register
+
+# generated op functions (nd.relu, nd.FullyConnected, nd.dot, ...)
+_register.install_ops(globals())
+
+from . import random  # noqa: E402,F401
+from . import linalg  # noqa: E402,F401
+from . import sparse  # noqa: E402,F401
+from . import contrib  # noqa: E402,F401
+
+
+def _place(data, ctx):
+    if ctx is None:
+        return NDArray._from_data(data)
+    return NDArray(data, ctx=ctx)
+
+
+def zeros(shape, ctx=None, dtype="float32", **kwargs):
+    return _place(jnp.zeros(shape, dtype_np(dtype)), ctx)
+
+
+def ones(shape, ctx=None, dtype="float32", **kwargs):
+    return _place(jnp.ones(shape, dtype_np(dtype)), ctx)
+
+
+def full(shape, val, ctx=None, dtype="float32", **kwargs):
+    return _place(jnp.full(shape, val, dtype_np(dtype)), ctx)
+
+
+def empty(shape, ctx=None, dtype="float32"):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+    out = jnp.arange(start, stop, step, dtype=dtype_np(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return _place(out, ctx)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype="float32"):
+    return _place(jnp.eye(N, M if M else N, k=k, dtype=dtype_np(dtype)), ctx)
+
+
+def moveaxis(data, source, destination):
+    return data._apply(lambda d: jnp.moveaxis(d, source, destination))
+
+
+def stack_arrays(*arrays, axis=0):
+    from .. import autograd
+
+    return autograd.invoke_recorded(lambda *xs: jnp.stack(xs, axis=axis), list(arrays))[0]
+
+
+# ---------------------------------------------------------------------------
+# serialization (ref: src/ndarray/ndarray.cc Save/Load,
+# python/mxnet/ndarray/utils.py:149 save / :222 load). Our container format:
+# magic + count + per-entry (name, dtype, shape, raw little-endian bytes).
+# ---------------------------------------------------------------------------
+
+_MAGIC = b"MXTPU001"
+
+
+def save(fname, data):
+    """Save NDArray / list / dict of NDArrays to a binary container file."""
+    if isinstance(data, NDArray):
+        entries = [("", data)]
+    elif isinstance(data, (list, tuple)):
+        entries = [("", d) for d in data]
+    elif isinstance(data, dict):
+        entries = sorted(data.items())
+    else:
+        raise TypeError("save expects NDArray, list, or dict")
+    with open(fname, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<q", len(entries)))
+        for name, arr in entries:
+            a = arr.asnumpy() if isinstance(arr, NDArray) else np.asarray(arr)
+            nb = name.encode("utf-8")
+            dt = a.dtype.name.encode("utf-8")
+            f.write(struct.pack("<i", len(nb))); f.write(nb)
+            f.write(struct.pack("<i", len(dt))); f.write(dt)
+            f.write(struct.pack("<i", a.ndim))
+            f.write(struct.pack(f"<{a.ndim}q", *a.shape))
+            raw = np.ascontiguousarray(a).tobytes()
+            f.write(struct.pack("<q", len(raw))); f.write(raw)
+
+
+def load(fname):
+    """Load a container saved by `save` -> list or dict of NDArrays."""
+    with open(fname, "rb") as f:
+        magic = f.read(8)
+        if magic != _MAGIC:
+            raise ValueError(f"{fname}: not a valid NDArray container")
+        (count,) = struct.unpack("<q", f.read(8))
+        named, anon = {}, []
+        for _ in range(count):
+            (ln,) = struct.unpack("<i", f.read(4)); name = f.read(ln).decode()
+            (ld,) = struct.unpack("<i", f.read(4)); dt = f.read(ld).decode()
+            (nd_,) = struct.unpack("<i", f.read(4))
+            shape = struct.unpack(f"<{nd_}q", f.read(8 * nd_)) if nd_ else ()
+            (nb,) = struct.unpack("<q", f.read(8))
+            a = np.frombuffer(f.read(nb), dtype=dtype_np(dt)).reshape(shape)
+            arr = NDArray(jnp.asarray(a))
+            if name:
+                named[name] = arr
+            else:
+                anon.append(arr)
+    return named if named else anon
+
+
+def imdecode(buf, flag=1, to_rgb=True, **kwargs):  # pragma: no cover - thin wrapper
+    from ..image import imdecode as _imdecode
+
+    return _imdecode(buf, flag=flag, to_rgb=to_rgb)

@@ -1,0 +1,37 @@
+"""nd.contrib namespace (ref: python/mxnet/ndarray/contrib.py).
+
+Round-1 subset; detection/vision contrib ops land with the vision models.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import autograd
+from .ndarray import NDArray
+from ..ops.registry import OP_REGISTRY
+from . import register as _register
+
+
+def boolean_mask(data, index, axis=0):
+    return _register.invoke(OP_REGISTRY["boolean_mask"], (data, index), dict(axis=axis))
+
+
+def index_copy(old_tensor, index_vector, new_tensor):
+    idx = index_vector._data.astype(jnp.int32)
+    return autograd.invoke_recorded(
+        lambda old, new: old.at[idx].set(new), [old_tensor, new_tensor]
+    )[0]
+
+
+def index_array(data, axes=None):
+    shape = data.shape
+    axes_ = tuple(axes) if axes is not None else tuple(range(len(shape)))
+    grids = jnp.meshgrid(*[jnp.arange(shape[a]) for a in axes_], indexing="ij")
+    out = jnp.stack([g.astype(jnp.int64) for g in grids], axis=-1)
+    return NDArray._from_data(out)
+
+
+def arange_like(data, start=0.0, step=1.0, axis=None):
+    return _register.invoke(
+        OP_REGISTRY["_arange_like"], (data,), dict(start=start, step=step, axis=axis)
+    )

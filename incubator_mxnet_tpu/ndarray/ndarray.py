@@ -1,0 +1,537 @@
+"""NDArray: the user-facing tensor.
+
+TPU-native equivalent of the reference NDArray (ref: include/mxnet/ndarray.h:82,
+src/ndarray/ndarray.cc, python/mxnet/ndarray/ndarray.py). Design mapping:
+- the reference's Chunk (storage handle + engine var) -> a `jax.Array`, whose
+  buffer and async token ARE the storage handle and dependency var: XLA's
+  runtime orders reads/writes, so WaitToRead == block_until_ready.
+- engine-scheduled CopyFromTo -> `jax.device_put` (async D2D/H2D).
+- autograd entry_ -> (_node, _node_index) pointing into the vjp tape.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import dtype_np
+from ..context import Context, current_context
+from .. import autograd
+
+__all__ = ["NDArray", "array", "waitall", "from_jax", "concatenate"]
+
+
+def _ctx_of_jax(data) -> Context:
+    try:
+        dev = next(iter(data.devices()))
+    except Exception:
+        return current_context()
+    if dev.platform == "cpu":
+        return Context("cpu", dev.id)
+    return Context("tpu", dev.id)
+
+
+class NDArray:
+    """Dense tensor handle over a jax.Array."""
+
+    __slots__ = ("_data", "_grad", "_grad_req", "_node", "_node_index", "__weakref__")
+
+    # make NDArray win against numpy in mixed dunder dispatch
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, ctx=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        if not isinstance(data, jax.Array):
+            data = jnp.asarray(data)
+        if ctx is not None:
+            data = jax.device_put(data, Context(ctx).jax_device())
+        self._data = data
+        self._grad = None
+        self._grad_req = "write"
+        self._node = None
+        self._node_index = 0
+
+    @classmethod
+    def _from_data(cls, data):
+        out = cls.__new__(cls)
+        out._data = data
+        out._grad = None
+        out._grad_req = "write"
+        out._node = None
+        out._node_index = 0
+        return out
+
+    # -- basic properties --------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self) -> Context:
+        return _ctx_of_jax(self._data)
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    @property
+    def grad(self):
+        return self._grad
+
+    # -- materialization / sync -------------------------------------------
+    def asnumpy(self):
+        """Blocking copy to host (ref: NDArray::SyncCopyToCPU)."""
+        return np.asarray(jax.device_get(self._data))
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(()).item()
+
+    def item(self):
+        return self.asscalar()
+
+    def wait_to_read(self):
+        """ref: NDArray::WaitToRead — resolves when pending writes complete."""
+        self._data.block_until_ready()
+
+    def wait_to_write(self):
+        self._data.block_until_ready()
+
+    # -- autograd ----------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        """Allocate a gradient buffer (ref: autograd.mark_variables). Detaches."""
+        self._node = None
+        self._grad = NDArray._from_data(jnp.zeros(self.shape, self._data.dtype))
+        self._grad_req = grad_req
+
+    def detach(self):
+        out = NDArray._from_data(self._data)
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        autograd.backward([self], [out_grad], retain_graph=retain_graph, train_mode=train_mode)
+
+    # -- conversion / movement --------------------------------------------
+    def astype(self, dtype, copy=True):
+        return self._apply(lambda d: d.astype(dtype_np(dtype)))
+
+    def as_in_context(self, ctx):
+        if ctx == self.context:
+            return self
+        return NDArray._from_data(jax.device_put(self._data, Context(ctx).jax_device()))
+
+    as_in_ctx = as_in_context
+
+    def copyto(self, other):
+        """ref: NDArray::CopyFromTo — async copy to a context or array."""
+        if isinstance(other, Context):
+            return NDArray._from_data(jax.device_put(self._data, other.jax_device()))
+        if isinstance(other, NDArray):
+            other._data = jax.device_put(self._data, next(iter(other._data.devices())))
+            return other
+        raise TypeError(f"copyto does not support type {type(other)}")
+
+    def copy(self):
+        return NDArray._from_data(jnp.array(self._data))
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from . import sparse
+
+        return sparse.cast_storage(self, stype)
+
+    def as_nd_ndarray(self):
+        return self
+
+    def asnumpy_or_none(self):
+        return self.asnumpy()
+
+    # -- helpers -----------------------------------------------------------
+    def _apply(self, fn, *others):
+        """Route a jnp-level fn through the autograd dispatcher."""
+        return autograd.invoke_recorded(fn, [self, *others])[0]
+
+    # -- shape ops (methods mirror reference NDArray methods) -------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        from . import register as _r
+
+        return _r.invoke_by_name("Reshape", [self], {"shape": shape, **kwargs})
+
+    def reshape_like(self, other):
+        return self._apply(lambda a, b: jnp.reshape(a, b.shape), other)
+
+    def transpose(self, axes=None):
+        return self._apply(lambda d: jnp.transpose(d, axes=axes))
+
+    def swapaxes(self, dim1, dim2):
+        return self._apply(lambda d: jnp.swapaxes(d, dim1, dim2))
+
+    def flatten(self):
+        return self._apply(lambda d: jnp.reshape(d, (d.shape[0], -1)))
+
+    def expand_dims(self, axis):
+        return self._apply(lambda d: jnp.expand_dims(d, axis))
+
+    def squeeze(self, axis=None):
+        return self._apply(lambda d: jnp.squeeze(d, axis=axis))
+
+    def broadcast_to(self, shape):
+        return self._apply(lambda d: jnp.broadcast_to(d, shape))
+
+    def broadcast_like(self, other):
+        return self._apply(lambda a, b: jnp.broadcast_to(a, b.shape), other)
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        from . import register as _r
+
+        return _r.invoke_by_name(
+            "split", [self],
+            {"num_outputs": num_outputs, "axis": axis, "squeeze_axis": squeeze_axis},
+        )
+
+    def slice(self, begin, end, step=None):
+        from . import register as _r
+
+        return _r.invoke_by_name("slice", [self], {"begin": begin, "end": end, "step": step or ()})
+
+    def slice_axis(self, axis, begin, end):
+        from . import register as _r
+
+        return _r.invoke_by_name("slice_axis", [self], {"axis": axis, "begin": begin, "end": end})
+
+    def take(self, indices, axis=0, mode="clip"):
+        from . import register as _r
+
+        return _r.invoke_by_name("take", [self, indices], {"axis": axis, "mode": mode})
+
+    def one_hot(self, depth, **kwargs):
+        from . import register as _r
+
+        return _r.invoke_by_name("one_hot", [self], {"depth": depth, **kwargs})
+
+    def pad(self, mode, pad_width, constant_value=0.0):
+        from . import register as _r
+
+        return _r.invoke_by_name(
+            "pad", [self],
+            {"mode": mode, "pad_width": pad_width, "constant_value": constant_value},
+        )
+
+    def tile(self, reps):
+        return self._apply(lambda d: jnp.tile(d, reps))
+
+    def repeat(self, repeats, axis=None):
+        return self._apply(lambda d: jnp.repeat(d, repeats, axis=axis))
+
+    def flip(self, axis):
+        return self._apply(lambda d: jnp.flip(d, axis=axis))
+
+    def diag(self, k=0):
+        from . import register as _r
+
+        return _r.invoke_by_name("diag", [self], {"k": k})
+
+    # -- reductions --------------------------------------------------------
+    def _reduce(self, name, axis=None, keepdims=False, **kw):
+        from . import register as _r
+
+        return _r.invoke_by_name(name, [self], {"axis": axis, "keepdims": keepdims, **kw})
+
+    def sum(self, axis=None, keepdims=False, **kw):
+        return self._reduce("sum", axis, keepdims)
+
+    def mean(self, axis=None, keepdims=False, **kw):
+        return self._reduce("mean", axis, keepdims)
+
+    def prod(self, axis=None, keepdims=False, **kw):
+        return self._reduce("prod", axis, keepdims)
+
+    def max(self, axis=None, keepdims=False, **kw):
+        return self._reduce("max", axis, keepdims)
+
+    def min(self, axis=None, keepdims=False, **kw):
+        return self._reduce("min", axis, keepdims)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        from . import register as _r
+
+        return _r.invoke_by_name("norm", [self], {"ord": ord, "axis": axis, "keepdims": keepdims})
+
+    def argmax(self, axis=None, keepdims=False):
+        from . import register as _r
+
+        return _r.invoke_by_name("argmax", [self], {"axis": axis, "keepdims": keepdims})
+
+    def argmin(self, axis=None, keepdims=False):
+        from . import register as _r
+
+        return _r.invoke_by_name("argmin", [self], {"axis": axis, "keepdims": keepdims})
+
+    def argsort(self, axis=-1, is_ascend=True):
+        from . import register as _r
+
+        return _r.invoke_by_name("argsort", [self], {"axis": axis, "is_ascend": is_ascend})
+
+    def sort(self, axis=-1, is_ascend=True):
+        from . import register as _r
+
+        return _r.invoke_by_name("sort", [self], {"axis": axis, "is_ascend": is_ascend})
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        from . import register as _r
+
+        return _r.invoke_by_name(
+            "topk", [self], {"axis": axis, "k": k, "ret_typ": ret_typ, "is_ascend": is_ascend}
+        )
+
+    def clip(self, a_min, a_max):
+        return self._apply(lambda d: jnp.clip(d, a_min, a_max))
+
+    def abs(self):
+        return self._apply(jnp.abs)
+
+    def sign(self):
+        return self._apply(jnp.sign)
+
+    def sqrt(self):
+        return self._apply(jnp.sqrt)
+
+    def square(self):
+        return self._apply(jnp.square)
+
+    def exp(self):
+        return self._apply(jnp.exp)
+
+    def log(self):
+        return self._apply(jnp.log)
+
+    def tanh(self):
+        return self._apply(jnp.tanh)
+
+    def sigmoid(self):
+        return self._apply(jax.nn.sigmoid)
+
+    def relu(self):
+        return self._apply(jax.nn.relu)
+
+    def softmax(self, axis=-1):
+        return self._apply(lambda d: jax.nn.softmax(d, axis=axis))
+
+    def log_softmax(self, axis=-1):
+        return self._apply(lambda d: jax.nn.log_softmax(d, axis=axis))
+
+    def round(self):
+        return self._apply(jnp.round)
+
+    def floor(self):
+        return self._apply(jnp.floor)
+
+    def ceil(self):
+        return self._apply(jnp.ceil)
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        from . import register as _r
+
+        return _r.invoke_by_name(
+            "dot", [self, other], {"transpose_a": transpose_a, "transpose_b": transpose_b}
+        )
+
+    # -- dunder arithmetic -------------------------------------------------
+    def _binop(self, other, fn, scalar_fn=None):
+        if isinstance(other, NDArray):
+            return autograd.invoke_recorded(fn, [self, other])[0]
+        return autograd.invoke_recorded(lambda a: scalar_fn(a, other) if scalar_fn else fn(a, other), [self])[0]
+
+    def __add__(self, other):
+        return self._binop(other, jnp.add, lambda a, s: a + s)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binop(other, jnp.subtract, lambda a, s: a - s)
+
+    def __rsub__(self, other):
+        return autograd.invoke_recorded(lambda a: other - a, [self])[0]
+
+    def __mul__(self, other):
+        return self._binop(other, jnp.multiply, lambda a, s: a * s)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binop(other, jnp.divide, lambda a, s: a / s)
+
+    def __rtruediv__(self, other):
+        return autograd.invoke_recorded(lambda a: other / a, [self])[0]
+
+    def __mod__(self, other):
+        return self._binop(other, jnp.mod, lambda a, s: jnp.mod(a, s))
+
+    def __rmod__(self, other):
+        return autograd.invoke_recorded(lambda a: jnp.mod(other, a), [self])[0]
+
+    def __pow__(self, other):
+        return self._binop(other, jnp.power, lambda a, s: jnp.power(a, s))
+
+    def __rpow__(self, other):
+        return autograd.invoke_recorded(lambda a: jnp.power(other, a), [self])[0]
+
+    def __matmul__(self, other):
+        return self._binop(other, jnp.matmul)
+
+    def __neg__(self):
+        return self._apply(jnp.negative)
+
+    def __abs__(self):
+        return self._apply(jnp.abs)
+
+    def __iadd__(self, other):
+        o = other._data if isinstance(other, NDArray) else other
+        self._data = self._data + o
+        return self
+
+    def __isub__(self, other):
+        o = other._data if isinstance(other, NDArray) else other
+        self._data = self._data - o
+        return self
+
+    def __imul__(self, other):
+        o = other._data if isinstance(other, NDArray) else other
+        self._data = self._data * o
+        return self
+
+    def __itruediv__(self, other):
+        o = other._data if isinstance(other, NDArray) else other
+        self._data = self._data / o
+        return self
+
+    def _cmp(self, other, fn):
+        o = other._data if isinstance(other, NDArray) else other
+        return NDArray._from_data(fn(self._data, o).astype(self._data.dtype))
+
+    def __eq__(self, other):
+        if other is None:
+            return False
+        return self._cmp(other, jnp.equal)
+
+    def __ne__(self, other):
+        if other is None:
+            return True
+        return self._cmp(other, jnp.not_equal)
+
+    def __lt__(self, other):
+        return self._cmp(other, jnp.less)
+
+    def __le__(self, other):
+        return self._cmp(other, jnp.less_equal)
+
+    def __gt__(self, other):
+        return self._cmp(other, jnp.greater)
+
+    def __ge__(self, other):
+        return self._cmp(other, jnp.greater_equal)
+
+    def __hash__(self):
+        return id(self)
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asnumpy())
+        raise ValueError("The truth value of an NDArray with multiple elements is ambiguous.")
+
+    def __len__(self):
+        return self.shape[0] if self.ndim else 0
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -- indexing ----------------------------------------------------------
+    def _jax_key(self, key):
+        if isinstance(key, NDArray):
+            return key._data.astype(jnp.int32) if jnp.issubdtype(key._data.dtype, jnp.floating) else key._data
+        if isinstance(key, tuple):
+            return tuple(self._jax_key(k) if isinstance(k, NDArray) else k for k in key)
+        return key
+
+    def __getitem__(self, key):
+        k = self._jax_key(key)
+        nd_keys = []
+        if isinstance(key, NDArray):
+            nd_keys.append(key)
+        return autograd.invoke_recorded(lambda d: d[k], [self])[0]
+
+    def __setitem__(self, key, value):
+        k = self._jax_key(key)
+        v = value._data if isinstance(value, NDArray) else value
+        self._data = self._data.at[k].set(v)
+
+    def __repr__(self):
+        return f"\n{self.asnumpy()}\n<NDArray {'x'.join(map(str, self.shape))} @{self.context}>"
+
+    # numpy interop
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Create an NDArray from any array-like (ref: mx.nd.array)."""
+    if isinstance(source_array, NDArray):
+        data = source_array._data
+    else:
+        data = np.asarray(source_array, dtype=dtype_np(dtype) if dtype else None)
+        if data.dtype == np.float64 and dtype is None:
+            data = data.astype(np.float32)
+        if data.dtype == np.int64 and dtype is None and not isinstance(source_array, np.ndarray):
+            pass
+    out = NDArray(jnp.asarray(data), ctx=ctx)
+    if dtype is not None:
+        out = out.astype(dtype)
+    return out
+
+
+def from_jax(data):
+    return NDArray._from_data(data)
+
+
+def concatenate(arrays, axis=0):
+    return autograd.invoke_recorded(lambda *xs: jnp.concatenate(xs, axis=axis), list(arrays))[0]
+
+
+def waitall():
+    """Block until all async computation completes (ref: Engine::WaitForAll)."""
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass

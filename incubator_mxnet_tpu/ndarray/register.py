@@ -1,0 +1,123 @@
+"""Generated eager op functions.
+
+TPU-native analog of the reference's import-time frontend codegen
+(ref: python/mxnet/ndarray/register.py:157 — builds nd.* functions from the
+op registry via MXSymbolGetAtomicSymbolInfo). Here the registry is the
+in-process `ops.OP_REGISTRY`; each generated function routes through the
+autograd dispatcher (`autograd.invoke_recorded`), mirroring
+`_imperative_invoke` -> `MXImperativeInvokeEx`
+(ref: python/mxnet/_ctypes/ndarray.py:65).
+"""
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import autograd
+from .. import random as _global_random
+from ..ops.registry import OP_REGISTRY, OpDef
+from .ndarray import NDArray
+
+__all__ = ["invoke_by_name", "install_ops"]
+
+
+def _as_data_or_none(x):
+    if x is None:
+        return None
+    if isinstance(x, NDArray):
+        return x
+    return NDArray(jnp.asarray(x))
+
+
+def invoke(opdef: OpDef, args, kwargs):
+    """Generic eager invocation of a registered op."""
+    kwargs = dict(kwargs)
+    out = kwargs.pop("out", None)
+    kwargs.pop("name", None)
+    kwargs.pop("ctx", None) if "ctx" not in opdef.attrs else None
+
+    if opdef.variadic:
+        slots = [_as_data_or_none(a) for a in args]
+        attrs = {k: v for k, v in kwargs.items() if v is not None or k in opdef.attrs}
+    else:
+        slots = [None] * len(opdef.inputs)
+        for i, a in enumerate(args):
+            slots[i] = _as_data_or_none(a)
+        attrs = {}
+        for k, v in kwargs.items():
+            if k in opdef.inputs:
+                slots[opdef.inputs.index(k)] = _as_data_or_none(v)
+            else:
+                attrs[k] = v
+
+    # resolve static attrs with defaults
+    call_attrs = dict(opdef.attrs)
+    call_attrs.update({k: v for k, v in attrs.items() if k in opdef.attrs})
+    # tolerate unknown attrs silently only if the fn takes them; else error
+    unknown = {k for k in attrs if k not in opdef.attrs}
+    if unknown:
+        raise TypeError(f"op {opdef.name}: unknown arguments {sorted(unknown)}")
+
+    training = autograd.is_training()
+    if opdef.needs_rng:
+        call_attrs["_rng"] = _global_random.next_key()
+    if opdef.needs_training:
+        call_attrs["_training"] = training
+
+    has_aux = bool(opdef.aux) and training
+    n_primary = opdef.num_outputs(call_attrs) if callable(opdef.num_outputs) else opdef.num_outputs
+
+    live_idx = [i for i, v in enumerate(slots) if v is not None]
+    live_arrays = [slots[i] for i in live_idx]
+    aux_pos = [opdef.inputs.index(a) for a in opdef.aux] if (opdef.aux and not opdef.variadic) else []
+
+    def fn(*live_datas):
+        full = [None] * len(slots)
+        for i, d in zip(live_idx, live_datas):
+            full[i] = d
+        for ap in aux_pos:
+            if full[ap] is not None:
+                full[ap] = lax.stop_gradient(full[ap])
+        return opdef.fn(*full, **call_attrs)
+
+    results = autograd.invoke_recorded(fn, live_arrays, name=opdef.name)
+
+    if has_aux:
+        primary = results[:n_primary]
+        aux_new = results[n_primary:]
+        for ap, new in zip(aux_pos, aux_new):
+            holder = slots[ap]
+            if holder is not None:
+                holder._data = new._data
+        results = primary
+
+    if out is not None:
+        if len(results) != 1:
+            raise ValueError("out= supported only for single-output ops")
+        out._data = results[0]._data
+        return out
+    return results if len(results) > 1 else results[0]
+
+
+def invoke_by_name(name, args, kwargs):
+    return invoke(OP_REGISTRY[name], args, kwargs)
+
+
+def _make_fn(opdef: OpDef, public_name: str):
+    def generated(*args, **kwargs):
+        return invoke(opdef, args, kwargs)
+
+    generated.__name__ = public_name
+    generated.__qualname__ = public_name
+    generated.__doc__ = opdef.fn.__doc__ or f"Eager op `{opdef.name}`."
+    return generated
+
+
+def install_ops(module_dict):
+    """Install one function per registry entry into a module namespace."""
+    for name, opdef in OP_REGISTRY.items():
+        if name not in module_dict:
+            module_dict[name] = _make_fn(opdef, name)

@@ -1,0 +1,165 @@
+"""Sparse NDArray storage types (ref: python/mxnet/ndarray/sparse.py,
+src/ndarray/ndarray.cc kRowSparseStorage/kCSRStorage).
+
+TPU-native stance: XLA has no first-class sparse tensors, so sparse storage
+is a *host-side format* (index + value arrays) used for communication and
+embedding-style workloads; compute materializes via gather/scatter, which XLA
+lowers efficiently. Round 1 covers construction, conversion, elementwise and
+dot paths used by the kvstore row_sparse protocol.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .ndarray import NDArray
+
+__all__ = [
+    "RowSparseNDArray",
+    "CSRNDArray",
+    "row_sparse_array",
+    "csr_matrix",
+    "cast_storage",
+    "zeros",
+]
+
+
+class BaseSparseNDArray:
+    @property
+    def context(self):
+        return self.data.context
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def wait_to_read(self):
+        self.data.wait_to_read()
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Rows at `indices` hold `data`; other rows are zero
+    (ref: ndarray.h kRowSparseStorage)."""
+
+    stype = "row_sparse"
+
+    def __init__(self, data, indices, shape):
+        self.data = data if isinstance(data, NDArray) else NDArray(data)
+        self.indices = indices if isinstance(indices, NDArray) else NDArray(indices, dtype="int64")
+        self.shape = tuple(shape)
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return self.todense()
+        raise ValueError(stype)
+
+    def todense(self) -> NDArray:
+        out = jnp.zeros(self.shape, dtype=self.data._data.dtype)
+        idx = self.indices._data.astype(jnp.int32)
+        return NDArray._from_data(out.at[idx].set(self.data._data))
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def copyto(self, other):
+        return self.todense().copyto(other)
+
+    def __repr__(self):
+        return f"<RowSparseNDArray {'x'.join(map(str, self.shape))} nnz_rows={self.indices.shape[0]}>"
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (ref: ndarray.h kCSRStorage)."""
+
+    stype = "csr"
+
+    def __init__(self, data, indptr, indices, shape):
+        self.data = data if isinstance(data, NDArray) else NDArray(data)
+        self.indptr = indptr if isinstance(indptr, NDArray) else NDArray(indptr, dtype="int64")
+        self.indices = indices if isinstance(indices, NDArray) else NDArray(indices, dtype="int64")
+        self.shape = tuple(shape)
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return self.todense()
+        raise ValueError(stype)
+
+    def todense(self) -> NDArray:
+        import scipy.sparse as sp  # host-side conversion
+
+        m = sp.csr_matrix(
+            (self.data.asnumpy(), self.indices.asnumpy(), self.indptr.asnumpy()), shape=self.shape
+        )
+        return NDArray(jnp.asarray(m.toarray()))
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def __repr__(self):
+        return f"<CSRNDArray {'x'.join(map(str, self.shape))} nnz={self.data.shape[0]}>"
+
+
+def row_sparse_array(arg, shape=None, ctx=None, dtype=None):
+    if isinstance(arg, tuple) and len(arg) == 2:
+        data, indices = arg
+        return RowSparseNDArray(NDArray(np.asarray(data, dtype=np.float32 if dtype is None else dtype)),
+                                NDArray(np.asarray(indices, dtype=np.int64)), shape)
+    dense = np.asarray(arg.asnumpy() if isinstance(arg, NDArray) else arg)
+    nz_rows = np.where(np.any(dense != 0, axis=tuple(range(1, dense.ndim))))[0]
+    return RowSparseNDArray(NDArray(dense[nz_rows]), NDArray(nz_rows.astype(np.int64)), dense.shape)
+
+
+def csr_matrix(arg, shape=None, ctx=None, dtype=None):
+    import scipy.sparse as sp
+
+    if isinstance(arg, tuple) and len(arg) == 3:
+        data, indices, indptr = arg
+        return CSRNDArray(NDArray(np.asarray(data)), NDArray(np.asarray(indptr, dtype=np.int64)),
+                          NDArray(np.asarray(indices, dtype=np.int64)), shape)
+    dense = np.asarray(arg.asnumpy() if isinstance(arg, NDArray) else arg)
+    m = sp.csr_matrix(dense)
+    return CSRNDArray(NDArray(m.data), NDArray(m.indptr.astype(np.int64)),
+                      NDArray(m.indices.astype(np.int64)), dense.shape)
+
+
+def cast_storage(arr, stype):
+    if stype == "default":
+        return arr.todense() if isinstance(arr, BaseSparseNDArray) else arr
+    if stype == "row_sparse":
+        return row_sparse_array(arr)
+    if stype == "csr":
+        return csr_matrix(arr)
+    raise ValueError(stype)
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    if stype == "row_sparse":
+        return RowSparseNDArray(
+            NDArray(np.zeros((0,) + tuple(shape[1:]), dtype=np.float32)),
+            NDArray(np.zeros((0,), dtype=np.int64)),
+            shape,
+        )
+    if stype == "csr":
+        return CSRNDArray(
+            NDArray(np.zeros((0,), dtype=np.float32)),
+            NDArray(np.zeros((shape[0] + 1,), dtype=np.int64)),
+            NDArray(np.zeros((0,), dtype=np.int64)),
+            shape,
+        )
+    raise ValueError(stype)
+
+
+def retain(rsp: RowSparseNDArray, indices) -> RowSparseNDArray:
+    """Keep only the given rows of a row_sparse array
+    (ref: sparse_retain op)."""
+    want = np.asarray(indices.asnumpy() if isinstance(indices, NDArray) else indices).astype(np.int64)
+    have = rsp.indices.asnumpy()
+    mask = np.isin(have, want)
+    return RowSparseNDArray(
+        NDArray(rsp.data.asnumpy()[mask]), NDArray(have[mask]), rsp.shape
+    )

@@ -1,0 +1,11 @@
+"""Operator library: registry + tensor/nn/random/optimizer op families.
+
+This package is the analog of the reference's `src/operator/` tree (SURVEY §2
+N5-N8, N31): pure jax functions registered once and surfaced through both the
+eager (`nd`) and symbolic (`sym`) frontends.
+"""
+from .registry import OP_REGISTRY, OpDef, get_op, list_ops, register, alias  # noqa: F401
+from . import tensor  # noqa: F401
+from . import nn  # noqa: F401
+from . import random  # noqa: F401
+from . import optimizer  # noqa: F401

@@ -1,0 +1,764 @@
+"""Neural-network operators.
+
+TPU-native coverage of the reference's `src/operator/nn/` + root NN ops
+(ref: SURVEY §2 N5/N8). Where the reference dispatches to cuDNN kernels
+(nn/cudnn/*-inl.h), these lower to XLA HLO (conv_general_dilated,
+reduce_window) or composed jnp — XLA autotuning replaces cuDNN algo
+selection. The fused multi-layer RNN op (ref: rnn-inl.h:49) is a `lax.scan`
+over time so the compiled program does not grow with sequence length.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# FullyConnected
+# ---------------------------------------------------------------------------
+
+
+@register("FullyConnected", optional=("bias",))
+def fully_connected(data, weight, bias=None, *, num_hidden=None, no_bias=False, flatten=True):
+    """y = x W^T + b (ref: src/operator/nn/fully_connected.cc)."""
+    x = data.reshape((data.shape[0], -1)) if flatten and data.ndim > 2 else data
+    y = jnp.matmul(x, weight.T)
+    if bias is not None and not no_bias:
+        y = y + bias
+    return y
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution
+# ---------------------------------------------------------------------------
+
+
+def _conv_dn(ndim):
+    sp = "DHW"[3 - ndim:]
+    return (f"NC{sp}", f"OI{sp}", f"NC{sp}")
+
+
+def _tup(v, n):
+    if v is None:
+        return (1,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    t = tuple(int(x) for x in v)
+    return t if len(t) == n else t + (t[-1],) * (n - len(t))
+
+
+@register("Convolution", optional=("bias",))
+def convolution(
+    data,
+    weight,
+    bias=None,
+    *,
+    kernel=None,
+    stride=None,
+    dilate=None,
+    pad=None,
+    num_filter=None,
+    num_group=1,
+    no_bias=False,
+    workspace=1024,
+    cudnn_tune=None,
+    cudnn_off=False,
+    layout=None,
+):
+    """N-d convolution, NC(D)HW layout (ref: src/operator/nn/convolution.cc:388).
+
+    Lowers to a single XLA convolution HLO — the direct MXU path; the
+    reference's im2col/cuDNN algo machinery has no analog here.
+    """
+    nd = data.ndim - 2
+    strides = _tup(stride, nd)
+    dil = _tup(dilate, nd)
+    p = _tup(pad, nd) if pad is not None else (0,) * nd
+    padding = [(pi, pi) for pi in p]
+    out = lax.conv_general_dilated(
+        data,
+        weight,
+        window_strides=strides,
+        padding=padding,
+        rhs_dilation=dil,
+        dimension_numbers=_conv_dn(nd),
+        feature_group_count=num_group,
+    )
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register("Deconvolution", optional=("bias",))
+def deconvolution(
+    data,
+    weight,
+    bias=None,
+    *,
+    kernel=None,
+    stride=None,
+    dilate=None,
+    pad=None,
+    adj=None,
+    target_shape=None,
+    num_filter=None,
+    num_group=1,
+    no_bias=True,
+    workspace=512,
+    cudnn_tune=None,
+    cudnn_off=False,
+    layout=None,
+):
+    """Transposed convolution (ref: src/operator/nn/deconvolution.cc).
+
+    Weight layout (in_c, out_c/group, *k) as in the reference; implemented as
+    the gradient of convolution via input dilation.
+    """
+    nd = data.ndim - 2
+    strides = _tup(stride, nd)
+    p = _tup(pad, nd) if pad is not None else (0,) * nd
+    a = _tup(adj, nd) if adj is not None else (0,) * nd
+    k = weight.shape[2:]
+    if num_group != 1:
+        xs = jnp.split(data, num_group, axis=1)
+        ws = jnp.split(weight, num_group, axis=0)
+        outs = [
+            _deconv1(x, w, strides, p, a, k, nd) for x, w in zip(xs, ws)
+        ]
+        out = jnp.concatenate(outs, axis=1)
+    else:
+        out = _deconv1(data, weight, strides, p, a, k, nd)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+def _deconv1(x, w, strides, p, a, k, nd):
+    # gradient-of-conv: dilate input by stride, correlate with flipped kernel
+    w_t = jnp.flip(w, axis=tuple(range(2, 2 + nd)))  # (I, O, *k) spatial-flipped
+    padding = [(k[i] - 1 - p[i], k[i] - 1 - p[i] + a[i]) for i in range(nd)]
+    sp = "DHW"[3 - nd:]
+    return lax.conv_general_dilated(
+        x,
+        w_t,
+        window_strides=(1,) * nd,
+        padding=padding,
+        lhs_dilation=strides,
+        dimension_numbers=(f"NC{sp}", f"IO{sp}", f"NC{sp}"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+
+
+@register("Pooling")
+def pooling(
+    data,
+    *,
+    kernel=None,
+    pool_type="max",
+    global_pool=False,
+    stride=None,
+    pad=None,
+    pooling_convention="valid",
+    count_include_pad=True,
+    cudnn_off=False,
+    layout=None,
+):
+    """Max/avg/sum/lp pooling via XLA reduce_window (ref: nn/pooling.cc, nn/pool.h)."""
+    nd = data.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, data.ndim))
+        if pool_type == "max":
+            out = jnp.max(data, axis=axes, keepdims=True)
+        elif pool_type == "sum":
+            out = jnp.sum(data, axis=axes, keepdims=True)
+        else:
+            out = jnp.mean(data, axis=axes, keepdims=True)
+        return out
+    k = _tup(kernel, nd)
+    s = _tup(stride, nd) if stride is not None else k if pooling_convention == "valid" else _tup(1, nd)
+    if stride is None:
+        s = k
+    p = _tup(pad, nd) if pad is not None else (0,) * nd
+    window = (1, 1) + k
+    strides = (1, 1) + s
+    padding = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+    if pooling_convention == "full":
+        # ceil-mode: pad high side enough that ceil-division windows fit
+        extra = []
+        for i in range(nd):
+            in_sz = data.shape[2 + i] + 2 * p[i]
+            rem = (in_sz - k[i]) % s[i]
+            extra.append((s[i] - rem) % s[i] if rem != 0 else 0)
+        padding = ((0, 0), (0, 0)) + tuple((p[i], p[i] + extra[i]) for i in range(nd))
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, padding)
+    summed = lax.reduce_window(data, 0.0, lax.add, window, strides, padding)
+    if pool_type == "sum":
+        return summed
+    if pool_type == "avg":
+        if count_include_pad:
+            denom = float(np.prod(k))
+            return summed / denom
+        ones = jnp.ones_like(data)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+        return summed / counts
+    if pool_type == "lp":
+        return jnp.power(
+            lax.reduce_window(jnp.power(jnp.abs(data), 2.0), 0.0, lax.add, window, strides, padding),
+            0.5,
+        )
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+# ---------------------------------------------------------------------------
+# Normalization
+# ---------------------------------------------------------------------------
+
+
+@register(
+    "BatchNorm",
+    aux=("moving_mean", "moving_var"),
+    needs_training=True,
+)
+def batch_norm(
+    data,
+    gamma,
+    beta,
+    moving_mean,
+    moving_var,
+    *,
+    eps=1e-3,
+    momentum=0.9,
+    fix_gamma=True,
+    use_global_stats=False,
+    output_mean_var=False,
+    axis=1,
+    cudnn_off=False,
+    _training=False,
+):
+    """Batch normalization (ref: src/operator/nn/batch_norm.cc).
+
+    Functional aux-state protocol: in training mode returns
+    (out, new_moving_mean, new_moving_var); the evaluator writes the new
+    values back into the aux arrays (the reference mutates aux in place).
+    """
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    reduce_axes = tuple(i for i in range(data.ndim) if i != axis % data.ndim)
+    bshape = [1] * data.ndim
+    bshape[axis % data.ndim] = data.shape[axis % data.ndim]
+
+    if _training and not use_global_stats:
+        mean = jnp.mean(data, axis=reduce_axes)
+        var = jnp.var(data, axis=reduce_axes)
+        new_mean = moving_mean * momentum + mean * (1 - momentum)
+        new_var = moving_var * momentum + var * (1 - momentum)
+    else:
+        mean, var = moving_mean, moving_var
+        new_mean, new_var = moving_mean, moving_var
+    x_hat = (data - mean.reshape(bshape)) * lax.rsqrt(var.reshape(bshape) + eps)
+    out = x_hat * g.reshape(bshape) + beta.reshape(bshape)
+    if _training:
+        return out, lax.stop_gradient(new_mean), lax.stop_gradient(new_var)
+    return out
+
+
+@register("LayerNorm")
+def layer_norm(data, gamma, beta, *, axis=-1, eps=1e-5, output_mean_var=False):
+    """Layer normalization (ref: src/operator/nn/layer_norm.cc)."""
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    x_hat = (data - mean) * lax.rsqrt(var + eps)
+    bshape = [1] * data.ndim
+    bshape[axis % data.ndim] = data.shape[axis % data.ndim]
+    return x_hat * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("InstanceNorm")
+def instance_norm(data, gamma, beta, *, eps=1e-3):
+    """Instance norm over spatial dims (ref: src/operator/instance_norm.cc)."""
+    axes = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=axes, keepdims=True)
+    var = jnp.var(data, axis=axes, keepdims=True)
+    x_hat = (data - mean) * lax.rsqrt(var + eps)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return x_hat * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("L2Normalization")
+def l2_normalization(data, *, eps=1e-10, mode="instance"):
+    """(ref: src/operator/l2_normalization.cc)"""
+    if mode == "instance":
+        axes = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    elif mode == "spatial":
+        axes = tuple(range(2, data.ndim))
+    else:
+        raise ValueError(mode)
+    nrm = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=True) + eps)
+    return data / nrm
+
+
+@register("LRN")
+def lrn(data, *, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Local response norm across channels (ref: src/operator/nn/lrn.cc)."""
+    sq = jnp.square(data)
+    half = nsize // 2
+    # sum over channel window via padded cumulative trick
+    padded = jnp.pad(sq, ((0, 0), (half, half)) + ((0, 0),) * (data.ndim - 2))
+    window = sum(
+        lax.slice_in_dim(padded, i, i + data.shape[1], axis=1) for i in range(nsize)
+    )
+    return data / jnp.power(knorm + (alpha / nsize) * window, beta)
+
+
+# ---------------------------------------------------------------------------
+# Activations
+# ---------------------------------------------------------------------------
+
+
+@register("Activation")
+def activation(data, *, act_type="relu"):
+    """(ref: src/operator/nn/activation.cc)"""
+    if act_type == "relu":
+        return jax.nn.relu(data)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jax.nn.softplus(data)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(data)
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+@register("LeakyReLU", optional=("gamma",), needs_rng=True, needs_training=True)
+def leaky_relu(
+    data,
+    gamma=None,
+    *,
+    act_type="leaky",
+    slope=0.25,
+    lower_bound=0.125,
+    upper_bound=0.334,
+    _rng=None,
+    _training=False,
+):
+    """(ref: src/operator/leaky_relu.cc). prelu takes a learned `gamma` input."""
+    if act_type == "leaky":
+        return jnp.where(data > 0, data, slope * data)
+    if act_type == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2)) if gamma.ndim == 1 else gamma
+        return jnp.where(data > 0, data, g * data)
+    if act_type == "elu":
+        return jnp.where(data > 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        a, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(data > 0, data, a * jnp.expm1(data))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "rrelu":
+        if _training and _rng is not None:
+            s = jax.random.uniform(
+                _rng, data.shape, minval=lower_bound, maxval=upper_bound, dtype=data.dtype
+            )
+        else:
+            s = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data > 0, data, s * data)
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+@register("softmax")
+def softmax(data, *, axis=-1, temperature=None, length=None):
+    x = data / temperature if temperature else data
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register("log_softmax")
+def log_softmax(data, *, axis=-1, temperature=None):
+    x = data / temperature if temperature else data
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register("softmin")
+def softmin(data, *, axis=-1):
+    return jax.nn.softmax(-data, axis=axis)
+
+
+@register("SoftmaxActivation")
+def softmax_activation(data, *, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+@register("softmax_cross_entropy", no_grad_inputs=("label",))
+def softmax_cross_entropy(data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lbl = label.astype(jnp.int32)
+    return -jnp.sum(jnp.take_along_axis(logp, lbl[:, None], axis=-1))
+
+
+# ---------------------------------------------------------------------------
+# Output ops with MXNet training-loss semantics.
+#
+# The reference's SoftmaxOutput/`*RegressionOutput` define their OWN backward
+# (gradient of implied loss, ignoring head gradients — ref:
+# src/operator/softmax_output-inl.h). Reproduced here with jax.custom_vjp so
+# `Module.fit`-style training matches numerically.
+# ---------------------------------------------------------------------------
+
+
+def _softmax_output_impl(
+    data, label, grad_scale, ignore_label, use_ignore, multi_output, normalization, smooth_alpha
+):
+    if multi_output:
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data, axis=-1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+def _softmax_output(
+    data, label, grad_scale, ignore_label, use_ignore, multi_output, normalization, smooth_alpha
+):
+    return _softmax_output_impl(
+        data, label, grad_scale, ignore_label, use_ignore, multi_output, normalization, smooth_alpha
+    )
+
+
+def _softmax_output_fwd(
+    data, label, grad_scale, ignore_label, use_ignore, multi_output, normalization, smooth_alpha
+):
+    out = _softmax_output_impl(
+        data, label, grad_scale, ignore_label, use_ignore, multi_output, normalization, smooth_alpha
+    )
+    return out, (out, label)
+
+
+def _softmax_output_bwd(
+    grad_scale, ignore_label, use_ignore, multi_output, normalization, smooth_alpha, res, g
+):
+    out, label = res
+    axis = 1 if multi_output else -1
+    n_class = out.shape[axis]
+    lbl = label.astype(jnp.int32)
+    onehot = jax.nn.one_hot(lbl, n_class, dtype=out.dtype)
+    if multi_output:
+        # label (N, d1, ...) -> onehot (N, d1, ..., C) -> move C to axis 1
+        onehot = jnp.moveaxis(onehot, -1, 1)
+    if smooth_alpha:
+        onehot = onehot * (1 - smooth_alpha) + smooth_alpha / n_class
+    grad = out - onehot
+    if use_ignore:
+        mask = (lbl != int(ignore_label)).astype(out.dtype)
+        mask = jnp.expand_dims(mask, axis=axis)
+        grad = grad * mask
+    scale = grad_scale
+    if normalization == "batch":
+        scale = scale / out.shape[0]
+    elif normalization == "valid" and use_ignore:
+        valid = jnp.maximum(jnp.sum(lbl != int(ignore_label)).astype(out.dtype), 1.0)
+        grad = grad / valid
+    return (grad * scale, jnp.zeros_like(label))
+
+
+_softmax_output.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
+@register("SoftmaxOutput", aliases=("Softmax",), no_grad_inputs=("label",))
+def softmax_output(
+    data,
+    label,
+    *,
+    grad_scale=1.0,
+    ignore_label=-1.0,
+    use_ignore=False,
+    multi_output=False,
+    normalization="null",
+    preserve_shape=False,
+    out_grad=False,
+    smooth_alpha=0.0,
+):
+    return _softmax_output(
+        data, label, float(grad_scale), float(ignore_label), bool(use_ignore),
+        bool(multi_output), normalization, float(smooth_alpha),
+    )
+
+
+def _make_regression_output(name, fwd_fn, grad_fn):
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def _impl(data, label, grad_scale):
+        return fwd_fn(data)
+
+    def _fwd(data, label, grad_scale):
+        out = fwd_fn(data)
+        return out, (out, label)
+
+    def _bwd(grad_scale, res, g):
+        out, label = res
+        return (grad_fn(out, label) * grad_scale, jnp.zeros_like(label))
+
+    _impl.defvjp(_fwd, _bwd)
+
+    @register(name, no_grad_inputs=("label",))
+    def op(data, label, *, grad_scale=1.0):
+        return _impl(data, label, float(grad_scale))
+
+    op.__name__ = name
+    return op
+
+
+_make_regression_output(
+    "LinearRegressionOutput", lambda x: x, lambda o, l: (o - l.reshape(o.shape))
+)
+_make_regression_output(
+    "LogisticRegressionOutput", jax.nn.sigmoid, lambda o, l: (o - l.reshape(o.shape))
+)
+_make_regression_output(
+    "MAERegressionOutput", lambda x: x, lambda o, l: jnp.sign(o - l.reshape(o.shape))
+)
+
+
+# ---------------------------------------------------------------------------
+# Dropout
+# ---------------------------------------------------------------------------
+
+
+@register("Dropout", needs_rng=True, needs_training=True)
+def dropout(data, *, p=0.5, mode="training", axes=(), cudnn_off=False, _rng=None, _training=False):
+    """(ref: src/operator/nn/dropout.cc) — inverted dropout."""
+    if not _training and mode != "always":
+        return data
+    if p <= 0 or _rng is None:
+        return data
+    shape = list(data.shape)
+    for a in axes:
+        shape[a] = 1
+    keep = jax.random.bernoulli(_rng, 1.0 - p, tuple(shape))
+    return jnp.where(keep, data / (1.0 - p), jnp.zeros_like(data))
+
+
+# ---------------------------------------------------------------------------
+# UpSampling
+# ---------------------------------------------------------------------------
+
+
+@register("UpSampling")
+def upsampling(*args, scale=2, sample_type="nearest", num_args=1, num_filter=0, multi_input_mode="concat", workspace=512):
+    """(ref: src/operator/upsampling.cc) nearest/bilinear upsampling."""
+    data = args[0]
+    n, c, h, w = data.shape
+    if sample_type == "nearest":
+        out = jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+    else:
+        out = jax.image.resize(data, (n, c, h * scale, w * scale), method="bilinear")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Fused RNN (ref: src/operator/rnn-inl.h:49 — LSTM/GRU/vanilla, multi-layer,
+# bidirectional, packed parameter vector). Implemented as lax.scan over time:
+# compile once, run any T of the same padded length.
+#
+# Packed layout (documented, self-consistent): for each layer, for each
+# direction: W_i2h (G*H, in), W_h2h (G*H, H); then for each layer/direction:
+# b_i2h (G*H), b_h2h (G*H). Gate order: LSTM [i, f, g, o], GRU [r, z, n].
+# ---------------------------------------------------------------------------
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(num_layers, input_size, state_size, bidirectional=False, mode="lstm"):
+    """Total packed parameter count for the fused RNN op."""
+    G, H, D = _GATES[mode], state_size, 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        inp = input_size if layer == 0 else H * D
+        size += D * (G * H * inp + G * H * H)  # weights
+    size += num_layers * D * 2 * G * H  # biases
+    return size
+
+
+def _rnn_slice_params(params, num_layers, input_size, H, D, G):
+    """Slice the packed vector into per-(layer, direction) weight/bias sets."""
+    offset = 0
+    Wx, Wh = [], []
+    for layer in range(num_layers):
+        inp = input_size if layer == 0 else H * D
+        wx_l, wh_l = [], []
+        for _ in range(D):
+            wx_l.append(params[offset : offset + G * H * inp].reshape(G * H, inp))
+            offset += G * H * inp
+            wh_l.append(params[offset : offset + G * H * H].reshape(G * H, H))
+            offset += G * H * H
+        Wx.append(wx_l)
+        Wh.append(wh_l)
+    bx, bh = [], []
+    for layer in range(num_layers):
+        bx_l, bh_l = [], []
+        for _ in range(D):
+            bx_l.append(params[offset : offset + G * H]); offset += G * H
+            bh_l.append(params[offset : offset + G * H]); offset += G * H
+        bx.append(bx_l)
+        bh.append(bh_l)
+    return Wx, Wh, bx, bh
+
+
+def _lstm_step(carry, x_t, wx, wh, bx, bh, H):
+    h, c = carry
+    gates = x_t @ wx.T + bx + h @ wh.T + bh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+    return (h_new, c_new), h_new
+
+
+def _gru_step(carry, x_t, wx, wh, bx, bh, H):
+    (h,) = carry
+    gx = x_t @ wx.T + bx
+    gh = h @ wh.T + bh
+    rx, zx, nx = jnp.split(gx, 3, axis=-1)
+    rh, zh, nh = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(rx + rh)
+    z = jax.nn.sigmoid(zx + zh)
+    n = jnp.tanh(nx + r * nh)
+    h_new = (1 - z) * n + z * h
+    return (h_new,), h_new
+
+
+def _rnn_tanh_step(carry, x_t, wx, wh, bx, bh, H):
+    (h,) = carry
+    h_new = jnp.tanh(x_t @ wx.T + bx + h @ wh.T + bh)
+    return (h_new,), h_new
+
+
+def _rnn_relu_step(carry, x_t, wx, wh, bx, bh, H):
+    (h,) = carry
+    h_new = jax.nn.relu(x_t @ wx.T + bx + h @ wh.T + bh)
+    return (h_new,), h_new
+
+
+_STEPS = {"lstm": _lstm_step, "gru": _gru_step, "rnn_tanh": _rnn_tanh_step, "rnn_relu": _rnn_relu_step}
+
+
+def _rnn_outputs(attrs):
+    if not attrs.get("state_outputs", False):
+        return 1
+    return 3 if attrs.get("mode", "lstm") == "lstm" else 2
+
+
+@register("RNN", optional=("state_cell",), needs_rng=True, needs_training=True, num_outputs=_rnn_outputs)
+def rnn(
+    data,
+    parameters,
+    state,
+    state_cell=None,
+    *,
+    state_size=None,
+    num_layers=1,
+    mode="lstm",
+    bidirectional=False,
+    p=0.0,
+    state_outputs=False,
+    projection_size=None,
+    lstm_state_clip_min=None,
+    lstm_state_clip_max=None,
+    _rng=None,
+    _training=False,
+):
+    """Fused multi-layer (bi)RNN over packed params (ref: rnn-inl.h:49).
+
+    data: (T, B, I); state: (L*D, B, H); state_cell (lstm): (L*D, B, H).
+    Returns output (T, B, H*D) [+ final h [+ final c for lstm] when
+    state_outputs].
+    """
+    T, B, I = data.shape
+    H, D, G = state_size, 2 if bidirectional else 1, _GATES[mode]
+    step = _STEPS[mode]
+    Wx, Wh, bx, bh = _rnn_slice_params(parameters, num_layers, I, H, D, G)
+
+    x = data
+    hs_out, cs_out = [], []
+    for layer in range(num_layers):
+        if p > 0 and _training and layer > 0 and _rng is not None:
+            _rng, sub = jax.random.split(_rng)
+            keep = jax.random.bernoulli(sub, 1.0 - p, x.shape)
+            x = jnp.where(keep, x / (1.0 - p), 0.0)
+        dir_outs = []
+        for d in range(D):
+            idx = layer * D + d
+            h0 = state[idx]
+            carry = (h0, state_cell[idx]) if mode == "lstm" else (h0,)
+            wx, wh, bxx, bhh = Wx[layer][d], Wh[layer][d], bx[layer][d], bh[layer][d]
+            xs = jnp.flip(x, axis=0) if d == 1 else x
+
+            def scan_fn(c, xt, wx=wx, wh=wh, bxx=bxx, bhh=bhh):
+                return step(c, xt, wx, wh, bxx, bhh, H)
+
+            final, ys = lax.scan(scan_fn, carry, xs)
+            if d == 1:
+                ys = jnp.flip(ys, axis=0)
+            dir_outs.append(ys)
+            hs_out.append(final[0])
+            if mode == "lstm":
+                cs_out.append(final[1])
+        x = jnp.concatenate(dir_outs, axis=-1) if D > 1 else dir_outs[0]
+
+    if not state_outputs:
+        return x
+    hN = jnp.stack(hs_out, axis=0)
+    if mode == "lstm":
+        return x, hN, jnp.stack(cs_out, axis=0)
+    return x, hN
+
+
+# ---------------------------------------------------------------------------
+# CTC loss (ref: src/operator/nn/ctc_loss.cc over 3rdparty warpctc headers) —
+# here via optax's native XLA implementation.
+# ---------------------------------------------------------------------------
+
+
+@register("CTCLoss", aliases=("ctc_loss",), optional=("data_lengths", "label_lengths"),
+          no_grad_inputs=("label", "data_lengths", "label_lengths"))
+def ctc_loss(
+    data,
+    label,
+    data_lengths=None,
+    label_lengths=None,
+    *,
+    use_data_lengths=False,
+    use_label_lengths=False,
+    blank_label="first",
+):
+    """CTC loss. data: (T, B, C); label: (B, L) with -1/0 padding."""
+    import optax
+
+    T, B, C = data.shape
+    logits = jnp.moveaxis(data, 0, 1)  # (B, T, C)
+    if use_data_lengths and data_lengths is not None:
+        t = jnp.arange(T)[None, :]
+        logit_paddings = (t >= data_lengths[:, None].astype(jnp.int32)).astype(jnp.float32)
+    else:
+        logit_paddings = jnp.zeros((B, T), dtype=jnp.float32)
+    lbl = label.astype(jnp.int32)
+    if use_label_lengths and label_lengths is not None:
+        L = label.shape[1]
+        pos = jnp.arange(L)[None, :]
+        label_paddings = (pos >= label_lengths[:, None].astype(jnp.int32)).astype(jnp.float32)
+    else:
+        label_paddings = (lbl <= 0).astype(jnp.float32) if blank_label == "first" else (lbl < 0).astype(jnp.float32)
+    if blank_label == "first":
+        # optax uses blank_id; MXNet 'first' means class 0 is blank and labels are 1-based
+        return optax.ctc_loss(logits, logit_paddings, lbl, label_paddings, blank_id=0)
+    return optax.ctc_loss(logits, logit_paddings, lbl, label_paddings, blank_id=C - 1)

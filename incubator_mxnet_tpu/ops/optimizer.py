@@ -1,0 +1,147 @@
+"""Fused optimizer update operators.
+
+TPU-native equivalent of the reference's fused update ops
+(ref: src/operator/optimizer_op-inl.h — sgd_update, sgd_mom_update,
+adam_update, etc., SURVEY §2 N5). Each returns the updated weight (and
+updated states); the Optimizer/Updater layer writes results back into the
+parameter arrays. All are jit-compiled once per shape/dtype and fuse into a
+handful of elementwise XLA kernels.
+
+Functional protocol: update ops return tuples (new_weight, new_state...);
+MXNet mutates in place. Multi-output counts are static per op.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _apply_wd(grad, weight, wd):
+    return grad + wd * weight
+
+
+def _rescale_clip(grad, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+@register("sgd_update", no_grad_inputs=("weight", "grad"))
+def sgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    return weight - lr * (g + wd * weight)
+
+
+@register("sgd_mom_update", num_outputs=2, no_grad_inputs=("weight", "grad", "mom"))
+def sgd_mom_update(
+    weight, grad, mom, *, lr, momentum=0.0, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True
+):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight)
+    return weight + new_mom, new_mom
+
+
+@register("nag_mom_update", num_outputs=2, no_grad_inputs=("weight", "grad", "mom"))
+def nag_mom_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient) + wd * weight
+    new_mom = momentum * mom + g
+    return weight - lr * (g + momentum * new_mom), new_mom
+
+
+@register("adam_update", num_outputs=3, no_grad_inputs=("weight", "grad", "mean", "var"))
+def adam_update(
+    weight, grad, mean, var, *, lr, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True,
+):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient) + wd * weight
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    return weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon), new_mean, new_var
+
+
+@register("rmsprop_update", num_outputs=2, no_grad_inputs=("weight", "grad", "n"))
+def rmsprop_update(
+    weight, grad, n, *, lr, gamma1=0.95, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+    clip_gradient=-1.0, clip_weights=-1.0,
+):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient) + wd * weight
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    new_w = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n
+
+
+@register("rmspropalex_update", num_outputs=4, no_grad_inputs=("weight", "grad", "n", "g", "delta"))
+def rmspropalex_update(
+    weight, grad, n, g, delta, *, lr, gamma1=0.95, gamma2=0.9, epsilon=1e-8, wd=0.0,
+    rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0,
+):
+    gr = _rescale_clip(grad, rescale_grad, clip_gradient) + wd * weight
+    new_n = (1 - gamma1) * jnp.square(gr) + gamma1 * n
+    new_g = (1 - gamma1) * gr + gamma1 * g
+    new_delta = gamma2 * delta - lr * gr / jnp.sqrt(new_n - jnp.square(new_g) + epsilon)
+    new_w = weight + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n, new_g, new_delta
+
+
+@register("ftrl_update", num_outputs=3, no_grad_inputs=("weight", "grad", "z", "n"))
+def ftrl_update(
+    weight, grad, z, n, *, lr, lamda1=0.01, beta=1.0, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0
+):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    new_w = jnp.where(
+        jnp.abs(new_z) > lamda1,
+        -(new_z - jnp.sign(new_z) * lamda1) / ((beta + jnp.sqrt(new_n)) / lr + wd),
+        jnp.zeros_like(weight),
+    )
+    return new_w, new_z, new_n
+
+
+@register("signsgd_update", no_grad_inputs=("weight", "grad"))
+def signsgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register("signum_update", num_outputs=2, no_grad_inputs=("weight", "grad", "mom"))
+def signum_update(
+    weight, grad, mom, *, lr, momentum=0.0, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0
+):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - (1 - momentum) * (g + wd * weight)
+    new_w = weight * (1 - lr * wd_lh) + lr * jnp.sign(new_mom)
+    return new_w, new_mom
+
+
+@register("ftml_update", num_outputs=4, no_grad_inputs=("weight", "grad", "d", "v", "z"))
+def ftml_update(
+    weight, grad, d, v, z, *, lr, beta1=0.6, beta2=0.999, epsilon=1e-8, wd=0.0,
+    rescale_grad=1.0, clip_grad=-1.0, t=1,
+):
+    g = _rescale_clip(grad, rescale_grad, clip_grad) + wd * weight
+    new_v = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_t = (1 - beta1 ** t) / lr * (jnp.sqrt(new_v / (1 - beta2 ** t)) + epsilon)
+    sigma = d_t - beta1 * d
+    new_z = beta1 * z + (1 - beta1) * g - sigma * weight
+    new_w = -new_z / d_t
+    return new_w, d_t, new_v, new_z
+
+
+@register("adamw_update", num_outputs=3, no_grad_inputs=("weight", "grad", "mean", "var"))
+def adamw_update(
+    weight, grad, mean, var, *, lr, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+    rescale_grad=1.0, clip_gradient=-1.0,
+):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    new_w = weight - eta * (lr * new_mean / (jnp.sqrt(new_var) + epsilon) + wd * weight)
+    return new_w, new_mean, new_var

@@ -1,0 +1,133 @@
+"""Random sampling operators.
+
+TPU-native equivalent of the reference's `src/operator/random/` samplers
+(ref: SURVEY §2 N31). The reference keeps per-device PRNG resource states
+(resource.h kRandom); here every sampler is a pure function of an explicit
+jax PRNG key threaded in by the evaluator (`_rng`), with the global seed
+state living in `random.py` — deterministic per replica by construction.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import dtype_np
+from .registry import register
+
+
+def _dt(dtype):
+    return dtype_np(dtype if dtype not in (None, "None") else "float32")
+
+
+@register("_random_uniform", aliases=("uniform",), needs_rng=True)
+def random_uniform(*, low=0.0, high=1.0, shape=(1,), dtype="float32", _rng=None):
+    return jax.random.uniform(_rng, shape, minval=low, maxval=high, dtype=_dt(dtype))
+
+
+@register("_random_normal", aliases=("normal",), needs_rng=True)
+def random_normal(*, loc=0.0, scale=1.0, shape=(1,), dtype="float32", _rng=None):
+    return loc + scale * jax.random.normal(_rng, shape, dtype=_dt(dtype))
+
+
+@register("_random_gamma", aliases=("gamma_sample",), needs_rng=True)
+def random_gamma(*, alpha=1.0, beta=1.0, shape=(1,), dtype="float32", _rng=None):
+    return beta * jax.random.gamma(_rng, alpha, shape, dtype=_dt(dtype))
+
+
+@register("_random_exponential", needs_rng=True)
+def random_exponential(*, lam=1.0, shape=(1,), dtype="float32", _rng=None):
+    return jax.random.exponential(_rng, shape, dtype=_dt(dtype)) / lam
+
+
+@register("_random_poisson", needs_rng=True)
+def random_poisson(*, lam=1.0, shape=(1,), dtype="float32", _rng=None):
+    return jax.random.poisson(_rng, lam, shape).astype(_dt(dtype))
+
+
+@register("_random_negative_binomial", needs_rng=True)
+def random_negative_binomial(*, k=1, p=0.5, shape=(1,), dtype="float32", _rng=None):
+    k1, k2 = jax.random.split(_rng)
+    lam = jax.random.gamma(k1, k, shape) * (1 - p) / p
+    return jax.random.poisson(k2, lam, shape).astype(_dt(dtype))
+
+
+@register("_random_generalized_negative_binomial", needs_rng=True)
+def random_gen_neg_binomial(*, mu=1.0, alpha=1.0, shape=(1,), dtype="float32", _rng=None):
+    k1, k2 = jax.random.split(_rng)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, shape) * (1 - p) / p
+    return jax.random.poisson(k2, lam, shape).astype(_dt(dtype))
+
+
+@register("_random_randint", aliases=("randint",), needs_rng=True)
+def random_randint(*, low=0, high=1, shape=(1,), dtype="int32", _rng=None):
+    return jax.random.randint(_rng, shape, low, high, dtype=_dt(dtype))
+
+
+@register("_sample_unique_zipfian", needs_rng=True)
+def sample_unique_zipfian(*, range_max=1, shape=(1,), _rng=None):
+    u = jax.random.uniform(_rng, shape)
+    cls = (jnp.exp(u * jnp.log(range_max + 1.0)) - 1.0).astype(jnp.int64)
+    return jnp.clip(cls, 0, range_max - 1)
+
+
+@register("_sample_multinomial", aliases=("sample_multinomial",), needs_rng=True,
+          no_grad_inputs=("data",))
+def sample_multinomial(data, *, shape=(), get_prob=False, dtype="int32", _rng=None):
+    n = int(jnp.prod(jnp.array(shape))) if shape else 1
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    if data.ndim == 1:
+        out = jax.random.categorical(_rng, logits, shape=(n,))
+        out = out.reshape(shape) if shape else out.reshape(())
+    else:
+        out = jax.random.categorical(_rng, logits[:, None, :].repeat(max(n, 1), axis=1), axis=-1)
+        out = out.reshape((data.shape[0],) + tuple(shape)) if shape else out.reshape((data.shape[0],))
+    return out.astype(_dt(dtype))
+
+
+def _sample_elemwise(name, sampler):
+    @register(name, needs_rng=True, optional=("p2",), no_grad_inputs=("p1", "p2"))
+    def op(p1, p2=None, *, shape=(), dtype="float32", _rng=None):
+        s = tuple(shape) if shape else ()
+        out_shape = p1.shape + s
+        return sampler(_rng, p1, p2, out_shape).astype(_dt(dtype))
+
+    op.__name__ = name
+    return op
+
+
+def _bcast(p, out_shape):
+    return p.reshape(p.shape + (1,) * (len(out_shape) - p.ndim))
+
+
+_sample_elemwise(
+    "_sample_uniform",
+    lambda k, lo, hi, s: _bcast(lo, s) + (_bcast(hi, s) - _bcast(lo, s)) * jax.random.uniform(k, s),
+)
+_sample_elemwise(
+    "_sample_normal",
+    lambda k, mu, sig, s: _bcast(mu, s) + _bcast(sig, s) * jax.random.normal(k, s),
+)
+_sample_elemwise(
+    "_sample_gamma",
+    lambda k, a, b, s: _bcast(b, s) * jax.random.gamma(k, _bcast(a, s) * jnp.ones(s), s),
+)
+_sample_elemwise(
+    "_sample_exponential",
+    lambda k, lam, _unused, s: jax.random.exponential(k, s) / _bcast(lam, s),
+)
+_sample_elemwise(
+    "_sample_poisson",
+    lambda k, lam, _unused, s: jax.random.poisson(k, _bcast(lam, s) * jnp.ones(s), s).astype(jnp.float32),
+)
+
+
+@register("_shuffle", aliases=("shuffle",), needs_rng=True)
+def shuffle(data, *, _rng=None):
+    return jax.random.permutation(_rng, data, axis=0)
+
+
+@register("_random_bernoulli", aliases=("bernoulli",), needs_rng=True)
+def bernoulli(*, p=0.5, shape=(1,), dtype="float32", _rng=None):
+    return jax.random.bernoulli(_rng, p, shape).astype(_dt(dtype))

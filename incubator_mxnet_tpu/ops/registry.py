@@ -1,0 +1,122 @@
+"""Operator registry.
+
+TPU-native analog of the reference's NNVM op registry (ref:
+src/operator/*/*.cc `NNVM_REGISTER_OP`, include/mxnet/op_attr_types.h). Each
+op is a *pure function* over jax arrays plus static attributes. From this one
+registry we generate both the eager `nd.*` functions and the symbolic `sym.*`
+builders, the same way the reference generates Python frontends from
+`MXSymbolGetAtomicSymbolInfo` (ref: python/mxnet/ndarray/register.py:157).
+
+Key differences from the reference, by design:
+- No FCompute/FInferShape/FInferType triples: shape/type inference is
+  `jax.eval_shape` over the same pure function; gradients come from `jax.vjp`
+  (ref's FGradient pass: src/nnvm/gradient.cc) — one definition, no drift.
+- Mutable aux state (e.g. BatchNorm running stats) is modeled functionally:
+  the op returns updated aux values as extra outputs and the caller writes
+  them back (ref models this as in-place aux_states on the executor).
+"""
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+__all__ = ["OpDef", "register", "get_op", "list_ops", "OP_REGISTRY", "alias"]
+
+OP_REGISTRY: dict[str, "OpDef"] = {}
+
+
+@dataclass
+class OpDef:
+    """One registered operator.
+
+    fn signature convention: positional params are tensor inputs; keyword-only
+    params are static attrs. A ``*args`` param means variadic tensor inputs
+    (Concat/add_n style). If ``needs_rng``/``needs_training`` the evaluator
+    passes ``_rng`` (a jax PRNG key) / ``_training`` (bool) keyword args.
+    """
+
+    name: str
+    fn: Callable
+    inputs: Sequence[str] = ()
+    variadic: bool = False
+    num_outputs: int = 1
+    # names of inputs that are mutable aux state; fn returns
+    # (out_0..out_{n-1}, new_aux_0, ...) when training
+    aux: Sequence[str] = ()
+    needs_rng: bool = False
+    needs_training: bool = False
+    # inputs that are optional (may be None), e.g. bias under no_bias
+    optional: Sequence[str] = ()
+    attrs: dict = field(default_factory=dict)  # attr name -> default
+    aliases: Sequence[str] = ()
+    no_grad_inputs: Sequence[str] = ()  # integer-like inputs w/o gradients
+
+    @property
+    def attr_names(self):
+        return tuple(self.attrs.keys())
+
+
+def register(
+    name,
+    *,
+    num_outputs=1,
+    aux=(),
+    needs_rng=False,
+    needs_training=False,
+    optional=(),
+    aliases=(),
+    no_grad_inputs=(),
+):
+    """Decorator registering a pure function as an operator."""
+
+    def deco(fn):
+        sig = inspect.signature(fn)
+        inputs, attrs, variadic = [], {}, False
+        for pname, p in sig.parameters.items():
+            if pname in ("_rng", "_training"):
+                continue
+            if p.kind == inspect.Parameter.VAR_POSITIONAL:
+                variadic = True
+            elif p.kind == inspect.Parameter.KEYWORD_ONLY:
+                attrs[pname] = None if p.default is inspect.Parameter.empty else p.default
+            elif p.kind in (
+                inspect.Parameter.POSITIONAL_ONLY,
+                inspect.Parameter.POSITIONAL_OR_KEYWORD,
+            ):
+                inputs.append(pname)
+        opdef = OpDef(
+            name=name,
+            fn=fn,
+            inputs=tuple(inputs),
+            variadic=variadic,
+            num_outputs=num_outputs,
+            aux=tuple(aux),
+            needs_rng=needs_rng,
+            needs_training=needs_training,
+            optional=tuple(optional),
+            attrs=attrs,
+            aliases=tuple(aliases),
+            no_grad_inputs=tuple(no_grad_inputs),
+        )
+        OP_REGISTRY[name] = opdef
+        for a in aliases:
+            OP_REGISTRY[a] = opdef
+        fn.__opdef__ = opdef
+        return fn
+
+    return deco
+
+
+def alias(existing, *names):
+    op = OP_REGISTRY[existing]
+    for n in names:
+        OP_REGISTRY[n] = op
+
+
+def get_op(name) -> Optional[OpDef]:
+    return OP_REGISTRY.get(name)
+
+
+def list_ops():
+    return sorted(set(o.name for o in OP_REGISTRY.values()))

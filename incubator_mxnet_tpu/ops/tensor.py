@@ -1,0 +1,750 @@
+"""Tensor math operators.
+
+TPU-native coverage of the reference's `src/operator/tensor/` family
+(elemwise_unary/binary, broadcast, reductions, dot, indexing, matrix ops,
+ordering, init ops — ref: SURVEY §2 N5). Every op is a pure jnp/lax function;
+XLA fuses elementwise chains into surrounding matmuls so there is no need for
+the reference's mshadow expression templates or Kernel<OP,xpu>::Launch
+machinery (ref: src/operator/mxnet_op.h:538).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+# ---------------------------------------------------------------------------
+# elementwise binary (broadcasting, like the reference's broadcast_* family;
+# the strict elemwise_* variants share the same impl since XLA handles both)
+# ---------------------------------------------------------------------------
+
+
+def _binary(name, fn, aliases=()):
+    @register(name, aliases=aliases)
+    def op(lhs, rhs):
+        return fn(lhs, rhs)
+
+    op.__name__ = name
+    return op
+
+
+_binary("broadcast_add", jnp.add, aliases=("elemwise_add", "_plus", "_add"))
+_binary("broadcast_sub", jnp.subtract, aliases=("elemwise_sub", "_minus", "_sub"))
+_binary("broadcast_mul", jnp.multiply, aliases=("elemwise_mul", "_mul"))
+_binary("broadcast_div", jnp.divide, aliases=("elemwise_div", "_div"))
+_binary("broadcast_mod", jnp.mod, aliases=("_mod",))
+_binary("broadcast_power", jnp.power, aliases=("_power", "pow"))
+_binary("broadcast_maximum", jnp.maximum, aliases=("_maximum", "maximum"))
+_binary("broadcast_minimum", jnp.minimum, aliases=("_minimum", "minimum"))
+_binary("broadcast_hypot", jnp.hypot, aliases=("_hypot",))
+_binary("broadcast_equal", lambda a, b: jnp.equal(a, b).astype(a.dtype), aliases=("_equal",))
+_binary(
+    "broadcast_not_equal", lambda a, b: jnp.not_equal(a, b).astype(a.dtype), aliases=("_not_equal",)
+)
+_binary("broadcast_greater", lambda a, b: jnp.greater(a, b).astype(a.dtype), aliases=("_greater",))
+_binary(
+    "broadcast_greater_equal",
+    lambda a, b: jnp.greater_equal(a, b).astype(a.dtype),
+    aliases=("_greater_equal",),
+)
+_binary("broadcast_lesser", lambda a, b: jnp.less(a, b).astype(a.dtype), aliases=("_lesser",))
+_binary(
+    "broadcast_lesser_equal",
+    lambda a, b: jnp.less_equal(a, b).astype(a.dtype),
+    aliases=("_lesser_equal",),
+)
+_binary(
+    "broadcast_logical_and",
+    lambda a, b: jnp.logical_and(a, b).astype(a.dtype),
+    aliases=("_logical_and",),
+)
+_binary(
+    "broadcast_logical_or",
+    lambda a, b: jnp.logical_or(a, b).astype(a.dtype),
+    aliases=("_logical_or",),
+)
+_binary(
+    "broadcast_logical_xor",
+    lambda a, b: jnp.logical_xor(a, b).astype(a.dtype),
+    aliases=("_logical_xor",),
+)
+
+
+# scalar ops (ref: elemwise_binary_scalar_op*.cc) — scalar is a static attr
+def _scalar_op(name, fn, aliases=()):
+    @register(name, aliases=aliases)
+    def op(data, *, scalar=1.0):
+        return fn(data, scalar)
+
+    op.__name__ = name
+    return op
+
+
+_scalar_op("_plus_scalar", lambda x, s: x + s)
+_scalar_op("_minus_scalar", lambda x, s: x - s)
+_scalar_op("_rminus_scalar", lambda x, s: s - x)
+_scalar_op("_mul_scalar", lambda x, s: x * s)
+_scalar_op("_div_scalar", lambda x, s: x / s)
+_scalar_op("_rdiv_scalar", lambda x, s: s / x)
+_scalar_op("_mod_scalar", lambda x, s: jnp.mod(x, s))
+_scalar_op("_rmod_scalar", lambda x, s: jnp.mod(s, x))
+_scalar_op("_power_scalar", lambda x, s: jnp.power(x, s))
+_scalar_op("_rpower_scalar", lambda x, s: jnp.power(s, x))
+_scalar_op("_maximum_scalar", lambda x, s: jnp.maximum(x, s))
+_scalar_op("_minimum_scalar", lambda x, s: jnp.minimum(x, s))
+_scalar_op("_equal_scalar", lambda x, s: (x == s).astype(x.dtype))
+_scalar_op("_not_equal_scalar", lambda x, s: (x != s).astype(x.dtype))
+_scalar_op("_greater_scalar", lambda x, s: (x > s).astype(x.dtype))
+_scalar_op("_greater_equal_scalar", lambda x, s: (x >= s).astype(x.dtype))
+_scalar_op("_lesser_scalar", lambda x, s: (x < s).astype(x.dtype))
+_scalar_op("_lesser_equal_scalar", lambda x, s: (x <= s).astype(x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# elementwise unary (ref: elemwise_unary_op_basic.cc + mshadow_op.h functors)
+# ---------------------------------------------------------------------------
+
+
+def _unary(name, fn, aliases=()):
+    @register(name, aliases=aliases)
+    def op(data):
+        return fn(data)
+
+    op.__name__ = name
+    return op
+
+
+_unary("abs", jnp.abs)
+_unary("sign", jnp.sign)
+_unary("rint", jnp.rint)
+_unary("round", jnp.round)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("trunc", jnp.trunc)
+_unary("fix", jnp.fix)
+_unary("square", jnp.square)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", lambda x: lax.rsqrt(x))
+_unary("cbrt", jnp.cbrt)
+_unary("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("log10", jnp.log10)
+_unary("log2", jnp.log2)
+_unary("log1p", jnp.log1p)
+_unary("expm1", jnp.expm1)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("arcsin", jnp.arcsin)
+_unary("arccos", jnp.arccos)
+_unary("arctan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("tanh", jnp.tanh)
+_unary("arcsinh", jnp.arcsinh)
+_unary("arccosh", jnp.arccosh)
+_unary("arctanh", jnp.arctanh)
+_unary("degrees", jnp.degrees)
+_unary("radians", jnp.radians)
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("softsign", jax.nn.soft_sign)
+_unary("relu", jax.nn.relu)
+_unary("erf", jax.scipy.special.erf)
+_unary("erfinv", jax.scipy.special.erfinv)
+_unary("gamma", lambda x: jnp.exp(jax.scipy.special.gammaln(x)))
+_unary("gammaln", jax.scipy.special.gammaln)
+_unary("reciprocal", jnp.reciprocal)
+_unary("negative", jnp.negative, aliases=("_neg",))
+_unary("logical_not", lambda x: jnp.logical_not(x).astype(x.dtype))
+_unary("identity", lambda x: x, aliases=("_copy",))
+_unary("BlockGrad", lax.stop_gradient, aliases=("stop_gradient",))
+_unary("make_loss", lambda x: x, aliases=("MakeLoss",))
+
+
+@register("smooth_l1")
+def smooth_l1(data, *, scalar=1.0):
+    s2 = scalar * scalar
+    absd = jnp.abs(data)
+    return jnp.where(absd < 1.0 / s2, 0.5 * s2 * data * data, absd - 0.5 / s2)
+
+
+@register("clip")
+def clip(data, *, a_min=0.0, a_max=1.0):
+    return jnp.clip(data, a_min, a_max)
+
+
+@register("Cast", aliases=("cast",))
+def cast(data, *, dtype="float32"):
+    from ..base import dtype_np
+
+    return data.astype(dtype_np(dtype))
+
+
+@register("amp_cast")
+def amp_cast(data, *, dtype="float32"):
+    from ..base import dtype_np
+
+    return data.astype(dtype_np(dtype))
+
+
+# ---------------------------------------------------------------------------
+# reductions (ref: broadcast_reduce_op_value.cc) — MXNet axis semantics:
+# axis may be int/tuple/None; `exclude` inverts the axis set.
+# ---------------------------------------------------------------------------
+
+
+def _norm_axis(axis, ndim, exclude=False):
+    if axis is None:
+        ax = tuple(range(ndim))
+    elif isinstance(axis, int):
+        ax = (axis % ndim,)
+    else:
+        ax = tuple(a % ndim for a in axis)
+    if exclude:
+        ax = tuple(i for i in range(ndim) if i not in ax)
+    return ax
+
+
+def _reduce(name, fn, aliases=()):
+    @register(name, aliases=aliases)
+    def op(data, *, axis=None, keepdims=False, exclude=False):
+        ax = _norm_axis(axis, data.ndim, exclude)
+        return fn(data, axis=ax, keepdims=keepdims)
+
+    op.__name__ = name
+    return op
+
+
+_reduce("sum", jnp.sum, aliases=("sum_axis",))
+_reduce("mean", jnp.mean)
+_reduce("prod", jnp.prod)
+_reduce("nansum", jnp.nansum)
+_reduce("nanprod", jnp.nanprod)
+_reduce("max", jnp.max, aliases=("max_axis",))
+_reduce("min", jnp.min, aliases=("min_axis",))
+
+
+@register("norm")
+def norm(data, *, ord=2, axis=None, keepdims=False):
+    ax = None if axis is None else (axis if isinstance(axis, tuple) else (axis,))
+    if ord == 1:
+        r = jnp.sum(jnp.abs(data), axis=ax, keepdims=keepdims)
+    else:
+        r = jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=keepdims))
+    return r
+
+
+@register("argmax", no_grad_inputs=("data",))
+def argmax(data, *, axis=None, keepdims=False):
+    out = jnp.argmax(data, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)
+
+
+@register("argmin", no_grad_inputs=("data",))
+def argmin(data, *, axis=None, keepdims=False):
+    out = jnp.argmin(data, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)
+
+
+@register("argmax_channel", no_grad_inputs=("data",))
+def argmax_channel(data):
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# dot / linalg (ref: tensor/dot-inl.h, tensor/la_op.h) — straight onto the MXU
+# ---------------------------------------------------------------------------
+
+
+@register("dot")
+def dot(lhs, rhs, *, transpose_a=False, transpose_b=False):
+    a = lhs.T if transpose_a else lhs
+    b = rhs.T if transpose_b else rhs
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    # MXNet dot contracts last axis of a with first axis of b
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def batch_dot(lhs, rhs, *, transpose_a=False, transpose_b=False):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+@register("_linalg_gemm2", aliases=("linalg_gemm2",))
+def linalg_gemm2(A, B, *, transpose_a=False, transpose_b=False, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("_linalg_gemm", aliases=("linalg_gemm",))
+def linalg_gemm(A, B, C, *, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("_linalg_potrf", aliases=("linalg_potrf",))
+def linalg_potrf(A):
+    return jnp.linalg.cholesky(A)
+
+
+@register("_linalg_potri", aliases=("linalg_potri",))
+def linalg_potri(A):
+    L = A
+    ident = jnp.broadcast_to(jnp.eye(L.shape[-1], dtype=L.dtype), L.shape)
+    Linv = jax.scipy.linalg.solve_triangular(L, ident, lower=True)
+    return jnp.matmul(jnp.swapaxes(Linv, -1, -2), Linv)
+
+
+@register("_linalg_trsm", aliases=("linalg_trsm",))
+def linalg_trsm(A, B, *, transpose=False, rightside=False, lower=True, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    low = bool(lower) != bool(transpose)
+    if rightside:
+        x = jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(a, -1, -2), jnp.swapaxes(B, -1, -2), lower=not low
+        )
+        return alpha * jnp.swapaxes(x, -1, -2)
+    return alpha * jax.scipy.linalg.solve_triangular(a, B, lower=low)
+
+
+@register("_linalg_trmm", aliases=("linalg_trmm",))
+def linalg_trmm(A, B, *, transpose=False, rightside=False, lower=True, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    a = jnp.tril(a) if (bool(lower) != bool(transpose)) else jnp.triu(a)
+    return alpha * (jnp.matmul(B, a) if rightside else jnp.matmul(a, B))
+
+
+@register("_linalg_syrk", aliases=("linalg_syrk",))
+def linalg_syrk(A, *, transpose=False, alpha=1.0):
+    at = jnp.swapaxes(A, -1, -2)
+    return alpha * (jnp.matmul(at, A) if transpose else jnp.matmul(A, at))
+
+
+@register("_linalg_sumlogdiag", aliases=("linalg_sumlogdiag",))
+def linalg_sumlogdiag(A):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("_linalg_extractdiag", aliases=("linalg_extractdiag",))
+def linalg_extractdiag(A, *, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("_linalg_makediag", aliases=("linalg_makediag",))
+def linalg_makediag(A, *, offset=0):
+    return jax.vmap(lambda v: jnp.diag(v, k=offset))(A.reshape((-1, A.shape[-1]))).reshape(
+        A.shape[:-1] + (A.shape[-1] + abs(offset),) * 2
+    ) if A.ndim > 1 else jnp.diag(A, k=offset)
+
+
+# ---------------------------------------------------------------------------
+# matrix / shape manipulation (ref: tensor/matrix_op-inl.h)
+# ---------------------------------------------------------------------------
+
+
+@register("Reshape", aliases=("reshape",))
+def reshape(data, *, shape=None, reverse=False):
+    # supports MXNet magic numbers 0 (copy dim) and -1 (infer); -2/-3/-4 subset
+    if shape is None:
+        return data
+    src = list(data.shape)
+    out = []
+    i = 0  # cursor into src dims
+    shape = list(shape)
+    if reverse:
+        src = src[::-1]
+        shape = shape[::-1]
+    k = 0
+    while k < len(shape):
+        s = shape[k]
+        if s == 0:
+            out.append(src[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(src[i:]); i = len(src)
+        elif s == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif s == -4:
+            a, b = shape[k + 1], shape[k + 2]
+            k += 2
+            a = src[i] // b if a == -1 else a
+            b = src[i] // a if b == -1 else b
+            out.extend([a, b]); i += 1
+        else:
+            out.append(s)
+            if i < len(src):
+                i += 1
+        k += 1
+    if reverse:
+        out = out[::-1]
+    return jnp.reshape(data, tuple(out))
+
+
+@register("reshape_like")
+def reshape_like(lhs, rhs):
+    return jnp.reshape(lhs, rhs.shape)
+
+
+@register("Flatten", aliases=("flatten",))
+def flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("transpose")
+def transpose(data, *, axes=None):
+    return jnp.transpose(data, axes=axes if axes else None)
+
+
+@register("SwapAxis", aliases=("swapaxes",))
+def swapaxes(data, *, dim1=0, dim2=0):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register("expand_dims")
+def expand_dims(data, *, axis=0):
+    return jnp.expand_dims(data, axis)
+
+
+@register("squeeze")
+def squeeze(data, *, axis=None):
+    return jnp.squeeze(data, axis=axis)
+
+
+@register("broadcast_to")
+def broadcast_to(data, *, shape=None):
+    tgt = tuple(d if s == 0 else s for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@register("broadcast_like")
+def broadcast_like(lhs, rhs):
+    return jnp.broadcast_to(lhs, rhs.shape)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(data, *, axis=(), size=()):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(data.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a] = s
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+@register("zeros_like")
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like")
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register("shape_array", no_grad_inputs=("data",))
+def shape_array(data):
+    return jnp.array(data.shape, dtype=jnp.int64)
+
+
+@register("size_array", no_grad_inputs=("data",))
+def size_array(data):
+    return jnp.array([data.size], dtype=jnp.int64)
+
+
+@register("slice")
+def slice_op(data, *, begin=(), end=(), step=()):
+    idx = []
+    for i in range(data.ndim):
+        b = begin[i] if i < len(begin) else None
+        e = end[i] if i < len(end) else None
+        s = step[i] if i < len(step) and step[i] is not None and step[i] != 0 else None
+        idx.append(slice(b, e, s))
+    return data[tuple(idx)]
+
+
+@register("slice_axis")
+def slice_axis(data, *, axis=0, begin=0, end=None):
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register("slice_like")
+def slice_like(data, shape_like, *, axes=()):
+    axs = tuple(axes) if axes else tuple(range(min(data.ndim, shape_like.ndim)))
+    idx = [slice(None)] * data.ndim
+    for a in axs:
+        idx[a] = slice(0, shape_like.shape[a])
+    return data[tuple(idx)]
+
+
+@register("Concat", aliases=("concat",))
+def concat(*args, dim=1):
+    return jnp.concatenate(args, axis=dim)
+
+
+@register("stack")
+def stack(*args, axis=0):
+    return jnp.stack(args, axis=axis)
+
+
+@register("add_n", aliases=("ElementWiseSum", "_sum"))
+def add_n(*args):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+def _split_outputs(attrs):
+    return int(attrs.get("num_outputs", 1))
+
+
+@register("SliceChannel", aliases=("split",), num_outputs=_split_outputs)
+def split(data, *, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if num_outputs > 1 else parts[0]
+
+
+@register("tile")
+def tile(data, *, reps=()):
+    return jnp.tile(data, reps)
+
+
+@register("repeat")
+def repeat(data, *, repeats=1, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register("reverse", aliases=("flip",))
+def reverse(data, *, axis=()):
+    axs = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(data, axis=axs)
+
+
+@register("Pad", aliases=("pad",))
+def pad(data, *, mode="constant", pad_width=(), constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    if mode == "constant":
+        return jnp.pad(data, pw, mode="constant", constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(data, pw, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(data, pw, mode="reflect")
+    raise ValueError(f"unknown pad mode {mode}")
+
+
+@register("diag")
+def diag(data, *, k=0):
+    if data.ndim == 1:
+        return jnp.diag(data, k=k)
+    return jnp.diagonal(data, offset=k, axis1=-2, axis2=-1)
+
+
+@register("depth_to_space")
+def depth_to_space(data, *, block_size=2):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth")
+def space_to_depth(data, *, block_size=2):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+# ---------------------------------------------------------------------------
+# indexing (ref: tensor/indexing_op.h)
+# ---------------------------------------------------------------------------
+
+
+@register("take", no_grad_inputs=("indices",))
+def take(a, indices, *, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    return jnp.take(a, idx, axis=axis, mode=mode if mode != "raise" else "clip")
+
+
+@register("batch_take", no_grad_inputs=("indices",))
+def batch_take(a, indices):
+    idx = indices.astype(jnp.int32)
+    return a[jnp.arange(a.shape[0]), idx]
+
+
+@register("pick", no_grad_inputs=("index",))
+def pick(data, index, *, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    out = jnp.take_along_axis(data, jnp.expand_dims(idx, axis=axis), axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("Embedding", no_grad_inputs=("data",))
+def embedding(data, weight, *, input_dim=None, output_dim=None, dtype="float32", sparse_grad=False):
+    idx = data.astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0, mode="clip")
+
+
+@register("gather_nd", no_grad_inputs=("indices",))
+def gather_nd(data, indices):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return data[tuple(idx[i] for i in range(m))]
+
+
+@register("scatter_nd", no_grad_inputs=("indices",))
+def scatter_nd(data, indices, *, shape=None):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(shape, dtype=data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].add(data)
+
+
+@register("one_hot", no_grad_inputs=("indices",))
+def one_hot(indices, *, depth=None, on_value=1.0, off_value=0.0, dtype="float32"):
+    from ..base import dtype_np
+
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth)
+    return (oh * (on_value - off_value) + off_value).astype(dtype_np(dtype))
+
+
+@register("where", no_grad_inputs=("condition",))
+def where(condition, x, y):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@register("boolean_mask", no_grad_inputs=("index",))
+def boolean_mask(data, index, *, axis=0):
+    # dynamic-shape op: evaluated eagerly (not jit-safe); reference is
+    # contrib.boolean_mask
+    mask = index.astype(bool)
+    return jnp.compress(mask, data, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# ordering (ref: tensor/ordering_op-inl.h)
+# ---------------------------------------------------------------------------
+
+
+@register("sort")
+def sort(data, *, axis=-1, is_ascend=True):
+    out = jnp.sort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register("argsort", no_grad_inputs=("data",))
+def argsort(data, *, axis=-1, is_ascend=True, dtype="float32"):
+    from ..base import dtype_np
+
+    out = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(dtype_np(dtype))
+
+
+def _topk_outputs(attrs):
+    rt = attrs.get("ret_typ", "indices")
+    return 2 if rt == "both" else 1
+
+
+@register("topk", no_grad_inputs=("data",), num_outputs=_topk_outputs)
+def topk(data, *, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    from ..base import dtype_np
+
+    ax = axis % data.ndim
+    src = -data if is_ascend else data
+    moved = jnp.moveaxis(src, ax, -1)
+    vals, idxs = lax.top_k(moved, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, ax)
+    idxs = jnp.moveaxis(idxs, -1, ax).astype(dtype_np(dtype))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idxs
+    return idxs
+
+
+# ---------------------------------------------------------------------------
+# sequence ops (ref: src/operator/sequence_*.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("SequenceMask", optional=("sequence_length",), no_grad_inputs=("sequence_length",))
+def sequence_mask(data, sequence_length=None, *, use_sequence_length=False, value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    mask = steps[:, None] < sequence_length[None, :].astype(jnp.int32)  # (T, B)
+    if axis == 1:
+        mask = mask.T
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    shape[1 - axis] = data.shape[1 - axis]
+    mask = mask.reshape(shape)
+    return jnp.where(mask, data, jnp.asarray(value, dtype=data.dtype))
+
+
+@register("SequenceLast", optional=("sequence_length",), no_grad_inputs=("sequence_length",))
+def sequence_last(data, sequence_length=None, *, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        idx = [slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    last = (sequence_length.astype(jnp.int32) - 1)
+    moved = jnp.moveaxis(data, axis, 0)  # (T, B, ...)
+    return moved[last, jnp.arange(moved.shape[1])]
+
+
+@register("SequenceReverse", optional=("sequence_length",), no_grad_inputs=("sequence_length",))
+def sequence_reverse(data, sequence_length=None, *, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    moved = jnp.moveaxis(data, axis, 0)  # (T, B, ...)
+    T = moved.shape[0]
+    lens = sequence_length.astype(jnp.int32)
+    t = jnp.arange(T)[:, None]
+    src = jnp.where(t < lens[None, :], lens[None, :] - 1 - t, t)  # (T, B)
+    out = jnp.take_along_axis(
+        moved, src.reshape(src.shape + (1,) * (moved.ndim - 2)).astype(jnp.int32), axis=0
+    )
+    return jnp.moveaxis(out, 0, axis)
+
+
+# ---------------------------------------------------------------------------
+# init-like ops used inside graphs
+# ---------------------------------------------------------------------------
+
+
+@register("_arange_like", no_grad_inputs=("data",))
+def arange_like(data, *, start=0.0, step=1.0, axis=None):
+    n = data.size if axis is None else data.shape[axis]
+    return start + step * jnp.arange(n, dtype=jnp.float32)
+
+
+@register("histogram", no_grad_inputs=("data",))
+def histogram(data, *, bin_cnt=10, range=None):
+    lo, hi = range if range is not None else (float(data.min()), float(data.max()))
+    hist, edges = jnp.histogram(data, bins=bin_cnt, range=(lo, hi))
+    return hist.astype(jnp.float32)

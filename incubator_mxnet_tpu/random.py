@@ -1,0 +1,41 @@
+"""Global random state.
+
+The reference keeps per-device PRNG resources seeded by mx.random.seed
+(ref: src/resource.cc kRandom pools, python/mxnet/random.py). TPU-native
+design: a single counter-based root key; every consumer takes a fresh split,
+so results are reproducible per seed and independent per call — and, under
+pjit, per replica when folded with axis index.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "next_key", "current_seed"]
+
+_LOCK = threading.Lock()
+_SEED = 0
+_KEY = None
+
+
+def seed(seed_state, ctx="all"):
+    """Seed the global generator (ref: mx.random.seed)."""
+    global _SEED, _KEY
+    with _LOCK:
+        _SEED = int(seed_state)
+        _KEY = jax.random.PRNGKey(_SEED)
+
+
+def current_seed():
+    return _SEED
+
+
+def next_key():
+    """Return a fresh PRNG key (thread-safe split of the root key)."""
+    global _KEY
+    with _LOCK:
+        if _KEY is None:
+            _KEY = jax.random.PRNGKey(_SEED)
+        _KEY, sub = jax.random.split(_KEY)
+        return sub

@@ -1,0 +1,2 @@
+"""Placeholder."""
+Symbol = None
