@@ -1,2 +1,9 @@
-"""Placeholder."""
-Symbol = None
+"""Symbolic API (ref: python/mxnet/symbol/)."""
+from __future__ import annotations
+
+from .symbol import Symbol, Variable, var, Group, load, load_json  # noqa: F401
+from . import register as _register
+
+_register.install_ops(globals())
+
+from . import infer  # noqa: E402,F401

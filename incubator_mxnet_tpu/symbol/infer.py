@@ -1,0 +1,191 @@
+"""Graph shape inference.
+
+TPU-native analog of the reference's fused shape/type inference pass
+(ref: src/executor/infer_graph_attr_pass.cc + per-op FInferShape). Parameter
+shapes (conv weights, BN stats, RNN packed params, ...) come from explicit
+rules; everything else falls out of `jax.eval_shape` over the op function —
+no hand-written output-shape formulas to drift from the kernels.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import dtype_np
+from ..ops import nn as _nn
+
+# name -> fn(attrs, in_shapes list[tuple|None], in_dtypes) -> {input_name: shape}
+PARAM_SHAPE_RULES = {}
+
+
+def rule(name):
+    def deco(fn):
+        PARAM_SHAPE_RULES[name] = fn
+        return fn
+
+    return deco
+
+
+@rule("FullyConnected")
+def _fc(attrs, shapes, names):
+    data = shapes[0]
+    nh = int(attrs["num_hidden"])
+    in_dim = int(np.prod(data[1:])) if attrs.get("flatten", True) else data[-1]
+    return {"weight": (nh, in_dim), "bias": (nh,)}
+
+
+@rule("Convolution")
+def _conv(attrs, shapes, names):
+    data = shapes[0]
+    nf = int(attrs["num_filter"])
+    g = int(attrs.get("num_group", 1) or 1)
+    k = tuple(attrs["kernel"])
+    return {"weight": (nf, data[1] // g) + k, "bias": (nf,)}
+
+
+@rule("Deconvolution")
+def _deconv(attrs, shapes, names):
+    data = shapes[0]
+    nf = int(attrs["num_filter"])
+    g = int(attrs.get("num_group", 1) or 1)
+    k = tuple(attrs["kernel"])
+    return {"weight": (data[1], nf // g) + k, "bias": (nf,)}
+
+
+@rule("BatchNorm")
+def _bn(attrs, shapes, names):
+    data = shapes[0]
+    axis = int(attrs.get("axis", 1) or 1)
+    c = data[axis % len(data)]
+    return {"gamma": (c,), "beta": (c,), "moving_mean": (c,), "moving_var": (c,)}
+
+
+@rule("LayerNorm")
+def _ln(attrs, shapes, names):
+    data = shapes[0]
+    axis = int(attrs.get("axis", -1) if attrs.get("axis") is not None else -1)
+    c = data[axis % len(data)]
+    return {"gamma": (c,), "beta": (c,)}
+
+
+@rule("InstanceNorm")
+def _in(attrs, shapes, names):
+    return {"gamma": (shapes[0][1],), "beta": (shapes[0][1],)}
+
+
+@rule("Embedding")
+def _emb(attrs, shapes, names):
+    return {"weight": (int(attrs["input_dim"]), int(attrs["output_dim"]))}
+
+
+@rule("LeakyReLU")
+def _lrelu(attrs, shapes, names):
+    if attrs.get("act_type") == "prelu":
+        return {"gamma": (shapes[0][1],)}
+    return {}
+
+
+@rule("RNN")
+def _rnn(attrs, shapes, names):
+    data = shapes[0]  # (T, B, I)
+    H = int(attrs["state_size"])
+    L = int(attrs.get("num_layers", 1) or 1)
+    D = 2 if attrs.get("bidirectional") else 1
+    mode = attrs.get("mode", "lstm")
+    psize = _nn.rnn_param_size(L, data[2], H, bool(attrs.get("bidirectional")), mode)
+    out = {"parameters": (psize,), "state": (L * D, data[1], H)}
+    if mode == "lstm":
+        out["state_cell"] = (L * D, data[1], H)
+    return out
+
+
+def infer_shapes(symbol, given: dict, partial=False, dtypes_given=None):
+    """Walk the graph, assigning shapes to every entry.
+
+    Returns {var_name: shape, ..., "__outputs__": [out shapes]}.
+    """
+    nodes = symbol._topo_nodes()
+    entry_shape = {}  # (id(node), idx) -> shape
+    entry_dtype = {}
+    var_shapes = {}
+    key = jax.random.PRNGKey(0)
+
+    for node in nodes:
+        if node.is_var:
+            shp = given.get(node.name) or node.misc_attrs.get("__shape__")
+            if shp is not None:
+                shp = tuple(int(s) for s in shp)
+                entry_shape[(id(node), 0)] = shp
+                var_shapes[node.name] = shp
+            dt = node.misc_attrs.get("__dtype__")
+            entry_dtype[(id(node), 0)] = dtype_np(dt) if dt else np.float32
+            continue
+
+        op = node.op
+        in_shapes = []
+        in_dtypes = []
+        for src, i in node.inputs:
+            in_shapes.append(entry_shape.get((id(src), i)))
+            in_dtypes.append(entry_dtype.get((id(src), i), np.float32))
+
+        # fill unknown parameter inputs from rules
+        if any(s is None for s in in_shapes) and op.name in PARAM_SHAPE_RULES and in_shapes and in_shapes[0] is not None:
+            rules = PARAM_SHAPE_RULES[op.name](
+                {**op.attrs, **node.attrs}, in_shapes, op.inputs
+            )
+            for j, (src, i) in enumerate(node.inputs):
+                if in_shapes[j] is None and j < len(op.inputs):
+                    pname = op.inputs[j] if not op.variadic else None
+                    if pname in rules:
+                        in_shapes[j] = tuple(rules[pname])
+                        entry_shape[(id(src), i)] = in_shapes[j]
+                        if src.is_var:
+                            var_shapes[src.name] = in_shapes[j]
+
+        if any(s is None for s in in_shapes):
+            if partial:
+                continue
+            missing = [
+                (src.name, op.inputs[j] if j < len(op.inputs) else j)
+                for j, (src, i) in enumerate(node.inputs)
+                if in_shapes[j] is None
+            ]
+            raise ValueError(f"cannot infer shape for inputs {missing} of op {node.name} ({op.name})")
+
+        call_attrs = dict(op.attrs)
+        call_attrs.update(node.attrs)
+        call_attrs.pop("name", None)
+        if op.needs_rng:
+            call_attrs["_rng"] = key
+        if op.needs_training:
+            call_attrs["_training"] = False
+
+        structs = [jax.ShapeDtypeStruct(s, d) for s, d in zip(in_shapes, in_dtypes)]
+        if not op.variadic and len(structs) < len(op.inputs):
+            pad = [None] * (len(op.inputs) - len(structs))
+        else:
+            pad = []
+
+        def _fn(*xs):
+            return op.fn(*(list(xs) + pad), **call_attrs)
+
+        try:
+            out = jax.eval_shape(_fn, *structs)
+        except Exception:
+            if partial:
+                continue
+            raise
+        outs = out if isinstance(out, tuple) else (out,)
+        for i, o in enumerate(outs):
+            entry_shape[(id(node), i)] = tuple(o.shape)
+            entry_dtype[(id(node), i)] = np.dtype(o.dtype)
+
+    result = dict(var_shapes)
+    outs = []
+    for node, i in symbol._outputs:
+        outs.append(entry_shape.get((id(node), i)))
+    result["__outputs__"] = outs
+    return result

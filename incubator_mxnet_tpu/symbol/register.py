@@ -1,0 +1,98 @@
+"""Generated symbolic op builders.
+
+TPU-native analog of the reference's symbol frontend codegen
+(ref: python/mxnet/symbol/register.py). Each registered op gets a builder
+`sym.OpName(*inputs, **attrs, name=...)`; missing parameter inputs
+auto-become Variables named `{name}_{input}` exactly like the reference's
+auto-created weight/bias variables.
+"""
+from __future__ import annotations
+
+from ..ops.registry import OP_REGISTRY, OpDef
+from .symbol import Symbol, Variable, _Node, name_uid
+
+__all__ = ["invoke_symbol", "install_ops"]
+
+
+def _should_create_input(op: OpDef, input_name: str, attrs: dict) -> bool:
+    """Whether a missing input slot should auto-create a Variable."""
+    if input_name not in op.optional:
+        return True
+    # gates mirroring reference op semantics
+    if input_name == "bias":
+        return not attrs.get("no_bias", False)
+    if input_name == "gamma" and op.name == "LeakyReLU":
+        return attrs.get("act_type") == "prelu"
+    if input_name == "state_cell":
+        return attrs.get("mode", "lstm") == "lstm"
+    if input_name == "sequence_length":
+        return bool(attrs.get("use_sequence_length", False))
+    if input_name in ("data_lengths", "label_lengths"):
+        return bool(attrs.get(f"use_{input_name}", False))
+    return False
+
+
+def invoke_symbol(op_name, args, kwargs):
+    op = OP_REGISTRY[op_name]
+    kwargs = dict(kwargs)
+    name = kwargs.pop("name", None)
+    kwargs.pop("attr", None)
+    base = op.name.lower().lstrip("_")
+    name = name or name_uid(base)
+
+    if op.variadic:
+        inputs = [a for a in args if isinstance(a, Symbol)]
+        # variadic ops may also receive a list as first arg
+        if len(args) == 1 and isinstance(args[0], (list, tuple)):
+            inputs = list(args[0])
+        attrs = dict(kwargs)
+        entries = [s._outputs[0] for s in inputs]
+        node = _Node(op, name, attrs, entries)
+        return Symbol([(node, i) for i in range(node.num_outputs)])
+
+    slots: list = [None] * len(op.inputs)
+    for i, a in enumerate(args):
+        slots[i] = a
+    attrs = {}
+    for k, v in kwargs.items():
+        if k in op.inputs:
+            slots[op.inputs.index(k)] = v
+        elif k in op.attrs:
+            attrs[k] = v
+        else:
+            raise TypeError(f"op {op.name}: unknown argument {k!r}")
+
+    merged_attrs = dict(op.attrs)
+    merged_attrs.update(attrs)
+
+    entries = []
+    for i, s in enumerate(slots):
+        in_name = op.inputs[i]
+        if s is None:
+            if not _should_create_input(op, in_name, merged_attrs):
+                # truncate trailing missing optionals
+                continue
+            aux = in_name in op.aux
+            v = Variable(f"{name}_{in_name}")
+            s = v
+        if not isinstance(s, Symbol):
+            raise TypeError(f"op {op.name}: input {in_name} must be a Symbol, got {type(s)}")
+        entries.append(s._outputs[0])
+
+    node = _Node(op, name, attrs, entries)
+    return Symbol([(node, i) for i in range(node.num_outputs)])
+
+
+def _make_builder(opdef: OpDef, public_name: str):
+    def builder(*args, **kwargs):
+        return invoke_symbol(public_name, args, kwargs)
+
+    builder.__name__ = public_name
+    builder.__doc__ = (opdef.fn.__doc__ or "") + "\n(symbolic builder)"
+    return builder
+
+
+def install_ops(module_dict):
+    for name, opdef in OP_REGISTRY.items():
+        if name not in module_dict:
+            module_dict[name] = _make_builder(opdef, name)

@@ -1,0 +1,514 @@
+"""Symbol: declarative graph API.
+
+TPU-native equivalent of the reference's nnvm::Symbol + graph passes
+(ref: python/mxnet/symbol/symbol.py, src/nnvm/). A Symbol is a small
+immutable DAG over registered ops; binding it turns the DAG into ONE pure
+jax function that XLA compiles whole — the analog of GraphExecutor's
+InitCachedOps+bulking (ref: src/executor/graph_executor.cc:1073,1187), with
+XLA fusion playing the role of the memory planner (src/nnvm/plan_memory.cc).
+
+Shape inference = per-op parameter-shape rules (for weight auto-shaping,
+ref: FInferShape) + `jax.eval_shape` over the composed function.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+from collections import defaultdict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import dtype_np
+from ..ops.registry import OP_REGISTRY, OpDef
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json", "name_uid"]
+
+_UID = defaultdict(itertools.count)
+
+
+def name_uid(prefix):
+    return f"{prefix}{next(_UID[prefix])}"
+
+
+class _Node:
+    """One graph node: a registered op application or a variable."""
+
+    __slots__ = ("op", "name", "attrs", "inputs", "aux_inputs", "num_outputs", "misc_attrs")
+
+    def __init__(self, op, name, attrs, inputs):
+        self.op: OpDef | None = op  # None => variable
+        self.name = name
+        self.attrs = attrs  # static op attrs
+        self.inputs = inputs  # list[(Node, int)]
+        self.misc_attrs = {}  # user __attr__ like ctx_group / lr_mult
+        if op is None:
+            self.num_outputs = 1
+        else:
+            n = op.num_outputs
+            full = dict(op.attrs)
+            full.update(attrs)
+            self.num_outputs = n(full) if callable(n) else n
+
+    @property
+    def is_var(self):
+        return self.op is None
+
+
+class Symbol:
+    """A list of output entries over the node DAG."""
+
+    def __init__(self, outputs):
+        self._outputs = list(outputs)  # list[(Node, int)]
+
+    # -- composition helpers ----------------------------------------------
+    @property
+    def name(self):
+        node, idx = self._outputs[0]
+        return node.name
+
+    def __repr__(self):
+        return f"<Symbol {self.name}>"
+
+    def __iter__(self):
+        for i in range(len(self._outputs)):
+            yield Symbol([self._outputs[i]])
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, str):
+            names = self.list_outputs()
+            idx = names.index(idx)
+        return Symbol([self._outputs[idx]])
+
+    def get_internals(self):
+        """Symbol grouping every node's outputs (ref: Symbol::GetInternals)."""
+        outs = []
+        for node in self._topo_nodes():
+            for i in range(node.num_outputs):
+                outs.append((node, i))
+        return Symbol(outs)
+
+    def get_children(self):
+        node, _ = self._outputs[0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    # -- traversal ---------------------------------------------------------
+    def _topo_nodes(self):
+        """Topological order (inputs before consumers), deterministic."""
+        order, visited, stack = [], set(), []
+        for node, _ in self._outputs:
+            if id(node) in visited:
+                continue
+            stack.append((node, False))
+            while stack:
+                n, processed = stack.pop()
+                if processed:
+                    order.append(n)
+                    continue
+                if id(n) in visited:
+                    continue
+                visited.add(id(n))
+                stack.append((n, True))
+                for inp, _i in reversed(n.inputs):
+                    if id(inp) not in visited:
+                        stack.append((inp, False))
+        return order
+
+    def list_arguments(self):
+        """Variable names in traversal order (ref: Symbol::ListArguments)."""
+        args = []
+        aux = set(self._aux_var_ids())
+        for n in self._topo_nodes():
+            if n.is_var and id(n) not in aux:
+                args.append(n.name)
+        return args
+
+    def list_outputs(self):
+        names = []
+        for node, idx in self._outputs:
+            if node.is_var:
+                names.append(node.name)
+            elif node.num_outputs == 1:
+                names.append(f"{node.name}_output")
+            else:
+                names.append(f"{node.name}_output{idx}")
+        return names
+
+    def _aux_var_ids(self):
+        ids = []
+        for n in self._topo_nodes():
+            if n.is_var or not n.op.aux:
+                continue
+            for aux_name in n.op.aux:
+                pos = n.op.inputs.index(aux_name)
+                if pos < len(n.inputs):
+                    src = n.inputs[pos][0]
+                    if src.is_var:
+                        ids.append(id(src))
+        return ids
+
+    def list_auxiliary_states(self):
+        """Aux-state variable names, e.g. BN moving stats (ref:
+        Symbol::ListAuxiliaryStates)."""
+        aux_ids = set(self._aux_var_ids())
+        return [n.name for n in self._topo_nodes() if n.is_var and id(n) in aux_ids]
+
+    def list_inputs(self):
+        return [n.name for n in self._topo_nodes() if n.is_var]
+
+    # -- attrs -------------------------------------------------------------
+    def attr(self, key):
+        node, _ = self._outputs[0]
+        return node.misc_attrs.get(key)
+
+    def _set_attr(self, **kwargs):
+        node, _ = self._outputs[0]
+        node.misc_attrs.update(kwargs)
+
+    def attr_dict(self):
+        out = {}
+        for n in self._topo_nodes():
+            if n.misc_attrs:
+                out[n.name] = dict(n.misc_attrs)
+        return out
+
+    # -- arithmetic --------------------------------------------------------
+    def _binop(self, other, op_name, scalar_op, reverse=False):
+        from . import register as _r
+
+        if isinstance(other, Symbol):
+            a, b = (other, self) if reverse else (self, other)
+            return _r.invoke_symbol(op_name, (a, b), {})
+        return _r.invoke_symbol(scalar_op, (self,), {"scalar": float(other)})
+
+    def __add__(self, other):
+        return self._binop(other, "broadcast_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binop(other, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, other):
+        return self._binop(other, "broadcast_sub", "_rminus_scalar", reverse=True)
+
+    def __mul__(self, other):
+        return self._binop(other, "broadcast_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binop(other, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, other):
+        return self._binop(other, "broadcast_div", "_rdiv_scalar", reverse=True)
+
+    def __pow__(self, other):
+        return self._binop(other, "broadcast_power", "_power_scalar")
+
+    def __neg__(self):
+        return self._binop(-1.0, "broadcast_mul", "_mul_scalar")
+
+    def __eq__(self, other):
+        if isinstance(other, (Symbol, int, float)):
+            return self._binop(other, "broadcast_equal", "_equal_scalar")
+        return NotImplemented
+
+    def __ne__(self, other):
+        if isinstance(other, (Symbol, int, float)):
+            return self._binop(other, "broadcast_not_equal", "_not_equal_scalar")
+        return NotImplemented
+
+    def __gt__(self, other):
+        return self._binop(other, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, other):
+        return self._binop(other, "broadcast_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, other):
+        return self._binop(other, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, other):
+        return self._binop(other, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    # -- evaluation --------------------------------------------------------
+    def make_eval_fn(self):
+        """Compose the DAG into one pure function.
+
+        Returns fn(arg_dict, aux_dict, rng_key, training) ->
+        (outputs_tuple, new_aux_dict). This single function is what gets
+        jit-compiled — the whole-graph analog of the reference's per-node
+        cached engine ops.
+        """
+        nodes = self._topo_nodes()
+        aux_names = set(self.list_auxiliary_states())
+        out_entries = list(self._outputs)
+
+        def eval_fn(arg_dict, aux_dict, rng_key, training):
+            env = {}  # id(node) -> tuple of outputs
+            new_aux = dict(aux_dict)
+            key = rng_key
+            for node in nodes:
+                if node.is_var:
+                    if node.name in arg_dict:
+                        val = arg_dict[node.name]
+                    elif node.name in new_aux:
+                        val = new_aux[node.name]
+                    else:
+                        raise KeyError(f"unbound variable {node.name}")
+                    env[id(node)] = (val,)
+                    continue
+                op = node.op
+                in_vals = [env[id(src)][i] for src, i in node.inputs]
+                call_attrs = dict(op.attrs)
+                call_attrs.update(node.attrs)
+                call_attrs.pop("name", None)
+                if op.needs_rng:
+                    if key is not None:
+                        key, sub = jax.random.split(key)
+                    else:
+                        sub = None
+                    call_attrs["_rng"] = sub
+                if op.needs_training:
+                    call_attrs["_training"] = training
+                # pad optional missing inputs with None
+                if not op.variadic and len(in_vals) < len(op.inputs):
+                    in_vals = in_vals + [None] * (len(op.inputs) - len(in_vals))
+                if op.aux:
+                    n_primary = op.num_outputs(call_attrs) if callable(op.num_outputs) else op.num_outputs
+                    from jax import lax as _lax
+
+                    aux_pos = [op.inputs.index(a) for a in op.aux]
+                    in_vals = [
+                        _lax.stop_gradient(v) if j in aux_pos and v is not None else v
+                        for j, v in enumerate(in_vals)
+                    ]
+                    res = op.fn(*in_vals, **call_attrs)
+                    res = res if isinstance(res, tuple) else (res,)
+                    if training and len(res) > n_primary:
+                        # write back new aux values
+                        for aux_name, new_val in zip(op.aux, res[n_primary:]):
+                            pos = op.inputs.index(aux_name)
+                            src = node.inputs[pos][0]
+                            if src.is_var:
+                                new_aux[src.name] = new_val
+                        res = res[:n_primary]
+                    env[id(node)] = res
+                else:
+                    res = op.fn(*in_vals, **call_attrs)
+                    env[id(node)] = res if isinstance(res, tuple) else (res,)
+            outs = tuple(env[id(node)][i] for node, i in out_entries)
+            return outs, new_aux
+
+        return eval_fn
+
+    # -- shape/type inference ---------------------------------------------
+    def infer_shape(self, **kwargs):
+        """Infer (arg_shapes, out_shapes, aux_shapes) from given input shapes
+        (ref: Symbol::InferShape). Uses parameter-shape rules + eval_shape."""
+        from .infer import infer_shapes
+
+        try:
+            shapes = infer_shapes(self, kwargs)
+        except Exception:
+            return None, None, None
+        args = [shapes.get(n) for n in self.list_arguments()]
+        auxs = [shapes.get(n) for n in self.list_auxiliary_states()]
+        outs = shapes["__outputs__"]
+        return args, outs, auxs
+
+    def infer_shape_partial(self, **kwargs):
+        from .infer import infer_shapes
+
+        shapes = infer_shapes(self, kwargs, partial=True)
+        args = [shapes.get(n) for n in self.list_arguments()]
+        auxs = [shapes.get(n) for n in self.list_auxiliary_states()]
+        outs = shapes.get("__outputs__")
+        return args, outs, auxs
+
+    def infer_type(self, **kwargs):
+        dt = np.float32
+        for v in kwargs.values():
+            if v is not None:
+                dt = dtype_np(v)
+                break
+        args = [dt for _ in self.list_arguments()]
+        auxs = [dt for _ in self.list_auxiliary_states()]
+        outs = [dt for _ in self.list_outputs()]
+        return args, outs, auxs
+
+    # -- binding -----------------------------------------------------------
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None, stype_dict=None,
+                    group2ctx=None, shared_arg_names=None, shared_exec=None,
+                    shared_buffer=None, **kwargs):
+        """Allocate arrays by shape inference and bind
+        (ref: symbol.py:1368 simple_bind -> GraphExecutor::Init)."""
+        from ..executor import Executor
+        from ..context import current_context
+        from ..ndarray import zeros
+
+        ctx = ctx or current_context()
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        if arg_shapes is None:
+            raise ValueError(f"cannot infer shapes from {kwargs}")
+        type_dict = type_dict or {}
+        args = {}
+        for name, shp in zip(self.list_arguments(), arg_shapes):
+            args[name] = zeros(shp, ctx=ctx, dtype=type_dict.get(name, "float32"))
+        auxs = {}
+        for name, shp in zip(self.list_auxiliary_states(), aux_shapes):
+            auxs[name] = zeros(shp, ctx=ctx, dtype=type_dict.get(name, "float32"))
+        if isinstance(grad_req, str):
+            reqs = {n: grad_req for n in args}
+        elif isinstance(grad_req, dict):
+            reqs = {n: grad_req.get(n, "write") for n in args}
+        else:
+            reqs = {n: r for n, r in zip(args, grad_req)}
+        grads = {n: zeros(a.shape, ctx=ctx, dtype=str(a.dtype)) for n, a in args.items() if reqs[n] != "null"}
+        return Executor(self, ctx, args, grads, reqs, auxs)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write", aux_states=None,
+             group2ctx=None, shared_exec=None):
+        """Bind with caller-provided arrays (ref: symbol.py:1632 bind)."""
+        from ..executor import Executor
+        from ..context import current_context
+
+        ctx = ctx or current_context()
+        names = self.list_arguments()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(names, args))
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(names, args_grad))
+        aux_names = self.list_auxiliary_states()
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(aux_names, aux_states))
+        if isinstance(grad_req, str):
+            reqs = {n: grad_req for n in names}
+        elif isinstance(grad_req, dict):
+            reqs = {n: grad_req.get(n, "write") for n in names}
+        else:
+            reqs = dict(zip(names, grad_req))
+        if args_grad is None:
+            from ..ndarray import zeros
+
+            args_grad = {
+                n: zeros(args[n].shape, ctx=ctx, dtype=str(args[n].dtype))
+                for n in names if reqs.get(n, "write") != "null"
+            }
+        return Executor(self, ctx, args, args_grad, reqs, aux_states or {})
+
+    def eval(self, ctx=None, **kwargs):
+        ex = self.bind(ctx=ctx, args=kwargs, grad_req="null")
+        return ex.forward()
+
+    # -- gradient ----------------------------------------------------------
+    def gradient(self, wrt):  # pragma: no cover - parity stub
+        raise NotImplementedError("use Executor.backward / autograd")
+
+    # -- serialization -----------------------------------------------------
+    def tojson(self):
+        """JSON graph (schema mirrors the reference's nnvm json for
+        tooling/visualization parity)."""
+        nodes = self._topo_nodes()
+        nid = {id(n): i for i, n in enumerate(nodes)}
+        out_nodes = []
+        for n in nodes:
+            out_nodes.append(
+                {
+                    "op": "null" if n.is_var else n.op.name,
+                    "name": n.name,
+                    "attrs": {k: _attr_str(v) for k, v in n.attrs.items()},
+                    "inputs": [[nid[id(src)], i, 0] for src, i in n.inputs],
+                }
+            )
+        heads = [[nid[id(node)], i, 0] for node, i in self._outputs]
+        arg_nodes = [i for i, n in enumerate(nodes) if n.is_var]
+        return json.dumps(
+            {"nodes": out_nodes, "arg_nodes": arg_nodes, "heads": heads,
+             "attrs": {"framework": "incubator_mxnet_tpu", "version": "0.1"}},
+            indent=2,
+        )
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    def debug_str(self):
+        lines = []
+        for n in self._topo_nodes():
+            kind = "Variable" if n.is_var else n.op.name
+            ins = ", ".join(f"{src.name}[{i}]" for src, i in n.inputs)
+            lines.append(f"{kind} {n.name}({ins})")
+        return "\n".join(lines)
+
+
+def _attr_str(v):
+    if isinstance(v, (tuple, list)):
+        return "(" + ", ".join(str(x) for x in v) + ")"
+    return str(v)
+
+
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+             init=None, stype=None, **kwargs):
+    """Create a symbolic variable (ref: sym.Variable)."""
+    node = _Node(None, name, {}, [])
+    if shape is not None:
+        node.misc_attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        node.misc_attrs["__dtype__"] = str(dtype)
+    if lr_mult is not None:
+        node.misc_attrs["lr_mult"] = lr_mult
+    if wd_mult is not None:
+        node.misc_attrs["wd_mult"] = wd_mult
+    if init is not None:
+        node.misc_attrs["__init__"] = init
+    if attr:
+        node.misc_attrs.update(attr)
+    return Symbol([(node, 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs)
+    return Symbol(outs)
+
+
+def load_json(json_str):
+    """Rebuild a Symbol from `tojson` output."""
+    import ast
+
+    d = json.loads(json_str)
+    nodes = []
+    for nd_ in d["nodes"]:
+        if nd_["op"] == "null":
+            node = _Node(None, nd_["name"], {}, [])
+        else:
+            attrs = {}
+            for k, v in nd_.get("attrs", {}).items():
+                try:
+                    attrs[k] = ast.literal_eval(v)
+                except (ValueError, SyntaxError):
+                    attrs[k] = v
+            inputs = [(nodes[i], oi) for i, oi, _ in nd_["inputs"]]
+            node = _Node(OP_REGISTRY[nd_["op"]], nd_["name"], attrs, inputs)
+        nodes.append(node)
+    return Symbol([(nodes[i], oi) for i, oi, _ in d["heads"]])
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
