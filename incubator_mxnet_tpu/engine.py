@@ -1,1 +1,44 @@
-"""Placeholder - implemented later this round."""
+"""Engine facade.
+
+The reference's dependency engine (ref: src/engine/ — ThreadedEnginePerDevice,
+var-version dependency tracking) is replaced by XLA's async runtime: every
+dispatched computation is ordered by its argument buffers, exactly the
+read/write-var ordering the reference implements by hand. This module keeps
+the reference's control API (bulking, waitall) as thin shims.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+import jax
+
+__all__ = ["waitall", "bulk", "set_bulk_size"]
+
+_BULK_SIZE = int(os.environ.get("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", "15"))
+
+
+def waitall():
+    """(ref: Engine::WaitForAll / MXNDArrayWaitAll)"""
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+def set_bulk_size(size):
+    """(ref: Engine::set_bulk_size) — XLA fuses whole jitted programs, so
+    bulking is inherent; retained for API parity."""
+    global _BULK_SIZE
+    prev = _BULK_SIZE
+    _BULK_SIZE = size
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size):
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

@@ -1,1 +1,500 @@
-"""Placeholder - implemented later this round."""
+"""Image IO + augmentation (ref: python/mxnet/image/image.py and the C++
+augmenter chain src/io/image_aug_default.cc:46-283).
+
+Host-side decode/augment with OpenCV (like the reference's opencv path);
+tensors convert to NDArray at batch boundaries for async H2D transfer.
+"""
+from __future__ import annotations
+
+import os
+import random as pyrandom
+
+import numpy as np
+
+from .ndarray.ndarray import NDArray
+from .ndarray import array as nd_array
+from .io import DataIter, DataBatch, DataDesc
+from . import recordio
+
+__all__ = [
+    "imdecode", "imread", "imresize", "scale_down", "resize_short", "fixed_crop",
+    "random_crop", "center_crop", "color_normalize", "random_size_crop",
+    "Augmenter", "SequentialAug", "RandomOrderAug", "ResizeAug", "ForceResizeAug",
+    "RandomCropAug", "RandomSizedCropAug", "CenterCropAug", "BrightnessJitterAug",
+    "ContrastJitterAug", "SaturationJitterAug", "HueJitterAug", "ColorJitterAug",
+    "LightingAug", "ColorNormalizeAug", "RandomGrayAug", "HorizontalFlipAug",
+    "CastAug", "CreateAugmenter", "ImageIter",
+]
+
+
+def imdecode(buf, flag=1, to_rgb=True, **kwargs):
+    import cv2
+
+    img = cv2.imdecode(np.frombuffer(buf, dtype=np.uint8), flag)
+    if img is None:
+        raise ValueError("cannot decode image")
+    if to_rgb and img.ndim == 3:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    return nd_array(img)
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    import cv2
+
+    a = src.asnumpy() if isinstance(src, NDArray) else src
+    return nd_array(cv2.resize(a, (w, h), interpolation=interp))
+
+
+def scale_down(src_size, size):
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    import cv2
+
+    a = src.asnumpy() if isinstance(src, NDArray) else src
+    h, w = a.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return nd_array(cv2.resize(a, (new_w, new_h), interpolation=interp))
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    a = src.asnumpy() if isinstance(src, NDArray) else src
+    out = a[y0 : y0 + h, x0 : x0 + w]
+    if size is not None and (w, h) != size:
+        import cv2
+
+        out = cv2.resize(out, size, interpolation=interp)
+    return nd_array(out)
+
+
+def random_crop(src, size, interp=2):
+    a = src.asnumpy() if isinstance(src, NDArray) else src
+    h, w = a.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    out = fixed_crop(a, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    a = src.asnumpy() if isinstance(src, NDArray) else src
+    h, w = a.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(a, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, area, ratio, interp=2, **kwargs):
+    a = src.asnumpy() if isinstance(src, NDArray) else src
+    h, w = a.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = pyrandom.uniform(area[0], area[1]) * src_area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        new_ratio = np.exp(pyrandom.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * new_ratio)))
+        new_h = int(round(np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = pyrandom.randint(0, w - new_w)
+            y0 = pyrandom.randint(0, h - new_h)
+            out = fixed_crop(a, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    a = src.asnumpy().astype(np.float32) if isinstance(src, NDArray) else np.asarray(src, np.float32)
+    mean = mean.asnumpy() if isinstance(mean, NDArray) else np.asarray(mean)
+    a = a - mean
+    if std is not None:
+        std = std.asnumpy() if isinstance(std, NDArray) else np.asarray(std)
+        a = a / std
+    return nd_array(a)
+
+
+class Augmenter:
+    """(ref: image.py Augmenter base)"""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for aug in self.ts:
+            src = aug(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        pyrandom.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2, **kwargs):
+        super().__init__(size=size)
+        self.size, self.area, self.ratio, self.interp = size, area, ratio, interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        return nd_array(src.asnumpy() * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+        self.coef = np.array([[[0.299, 0.587, 0.114]]])
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        a = src.asnumpy().astype(np.float32)
+        gray = (a * self.coef).sum() * (3.0 / a.size)
+        return nd_array(a * alpha + gray * (1 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+        self.coef = np.array([[[0.299, 0.587, 0.114]]])
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        a = src.asnumpy().astype(np.float32)
+        gray = (a * self.coef).sum(axis=2, keepdims=True)
+        return nd_array(a * alpha + gray * (1 - alpha))
+
+
+class HueJitterAug(Augmenter):
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = np.array([[0.299, 0.587, 0.114], [0.596, -0.274, -0.321],
+                              [0.211, -0.523, 0.311]])
+        self.ityiq = np.array([[1.0, 0.956, 0.621], [1.0, -0.272, -0.647],
+                               [1.0, -1.107, 1.705]])
+
+    def __call__(self, src):
+        alpha = pyrandom.uniform(-self.hue, self.hue)
+        u, w = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]])
+        t = np.dot(np.dot(self.ityiq, bt), self.tyiq).T
+        return nd_array(np.dot(src.asnumpy().astype(np.float32), t))
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA lighting noise (ref: image_aug_default.cc pca noise)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval)
+        self.eigvec = np.asarray(eigvec)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,))
+        rgb = np.dot(self.eigvec * alpha, self.eigval)
+        return nd_array(src.asnumpy().astype(np.float32) + rgb)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = np.asarray(mean) if mean is not None else None
+        self.std = np.asarray(std) if std is not None else None
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class RandomGrayAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+        self.mat = np.array([[0.21, 0.21, 0.21], [0.72, 0.72, 0.72], [0.07, 0.07, 0.07]])
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            src = nd_array(np.dot(src.asnumpy().astype(np.float32), self.mat))
+        return src
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            src = nd_array(src.asnumpy()[:, ::-1].copy())
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return nd_array(src.asnumpy().astype(self.typ))
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0, rand_gray=0,
+                    inter_method=2):
+    """(ref: image.py CreateAugmenter mirroring image_aug_default.cc defaults)"""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0), (3.0 / 4.0, 4.0 / 3.0), inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.814],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Python image iterator over .rec shards or image lists
+    (ref: python/mxnet/image/image.py ImageIter; C++ twin:
+    src/io/iter_image_recordio_2.cc). Supports dist sharding via
+    part_index/num_parts like the reference."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="", path_imgidx=None,
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imglist=None, data_name="data", label_name="softmax_label",
+                 dtype="float32", last_batch_handle="pad", **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.data_name = data_name
+        self.label_name = label_name
+        self.dtype = dtype
+
+        self.imgrec = None
+        self.imglist = None
+        self.seq = None
+        if path_imgrec:
+            idx_path = path_imgidx or (os.path.splitext(path_imgrec)[0] + ".idx")
+            if os.path.isfile(idx_path):
+                self.imgrec = recordio.MXIndexedRecordIO(idx_path, path_imgrec, "r")
+                self.seq = list(self.imgrec.keys)
+            else:
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+        elif path_imglist or imglist is not None:
+            if path_imglist:
+                imglist = []
+                with open(path_imglist) as fin:
+                    for line in fin:
+                        parts = line.strip().split("\t")
+                        imglist.append((np.array([float(x) for x in parts[1:-1]]), parts[-1]))
+            self.imglist = [
+                (np.asarray(lbl, dtype=np.float32), os.path.join(path_root, fname))
+                for lbl, fname in imglist
+            ]
+            self.seq = list(range(len(self.imglist)))
+        else:
+            raise ValueError("need path_imgrec, path_imglist, or imglist")
+
+        if self.seq is not None and num_parts > 1:
+            # distributed sharding (ref: iter_image_recordio_2.cc part_index)
+            n = len(self.seq) // num_parts
+            self.seq = self.seq[part_index * n : (part_index + 1) * n]
+
+        self.auglist = aug_list if aug_list is not None else CreateAugmenter(data_shape, **{
+            k: v for k, v in kwargs.items()
+            if k in ("resize", "rand_crop", "rand_resize", "rand_mirror", "mean",
+                     "std", "brightness", "contrast", "saturation", "hue",
+                     "pca_noise", "rand_gray", "inter_method")
+        })
+        self.cur = 0
+        self._cache = None
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name, (self.batch_size,) + self.data_shape, np.float32)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 else (self.batch_size, self.label_width)
+        return [DataDesc(self.label_name, shape, np.float32)]
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            pyrandom.shuffle(self.seq)
+        if self.imgrec is not None and self.seq is None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = recordio.unpack(s)
+                return header.label, img
+            label, fname = self.imglist[idx]
+            with open(fname, "rb") as f:
+                return label, f.read()
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = recordio.unpack(s)
+        return header.label, img
+
+    def next(self):
+        batch_data = np.zeros((self.batch_size,) + self.data_shape, dtype=np.float32)
+        shape = (self.batch_size,) if self.label_width == 1 else (self.batch_size, self.label_width)
+        batch_label = np.zeros(shape, dtype=np.float32)
+        i = 0
+        pad = 0
+        try:
+            while i < self.batch_size:
+                label, s = self.next_sample()
+                img = imdecode(s).asnumpy()
+                for aug in self.auglist:
+                    img = aug(nd_array(img) if not isinstance(img, NDArray) else img)
+                    img = img.asnumpy() if isinstance(img, NDArray) else img
+                batch_data[i] = np.transpose(img, (2, 0, 1))
+                batch_label[i] = label if np.isscalar(label) or self.label_width == 1 else label[: self.label_width]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            pad = self.batch_size - i
+        return DataBatch(
+            data=[nd_array(batch_data)], label=[nd_array(batch_label)], pad=pad,
+        )

@@ -1,3 +1,294 @@
-"""Placeholder."""
+"""Weight initializers (ref: python/mxnet/initializer.py).
+
+Same registry + `InitDesc`-style name-pattern dispatch as the reference;
+values are produced with jax PRNG through the global seed state.
+"""
+from __future__ import annotations
+
+import json
+import math
+import re
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import random as _global_random
+from .ndarray.ndarray import NDArray
+
+__all__ = [
+    "Initializer", "init", "register", "create", "Zero", "One", "Constant",
+    "Uniform", "Normal", "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear",
+    "LSTMBias", "Mixed", "Load", "InitDesc",
+]
+
+_REGISTRY = {}
+
+
+def register(klass):
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    return _REGISTRY[name.lower()](**kwargs)
+
+
+class InitDesc(str):
+    """Name + attrs describing the array being initialized."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    """Base initializer with the reference's name-based dispatch
+    (ref: Initializer.__call__ in python/mxnet/initializer.py)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, str):
+            raise TypeError("desc must be a string or InitDesc")
+        if isinstance(desc, InitDesc) and desc.attrs.get("__init__"):
+            create(desc.attrs["__init__"])._init_weight(desc, arr)
+            return
+        name = str(desc)
+        if name.endswith("weight"):
+            self._init_weight(name, arr)
+        elif name.endswith("bias"):
+            self._init_bias(name, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(name, arr)
+        elif name.endswith("beta"):
+            self._init_beta(name, arr)
+        elif name.endswith("moving_mean") or name.endswith("running_mean"):
+            self._init_zero(name, arr)
+        elif name.endswith("moving_var") or name.endswith("running_var"):
+            self._init_one(name, arr)
+        elif name.endswith("min") or name.endswith("max"):
+            self._init_zero(name, arr)
+        elif name.endswith("parameters") and "rnn" in name:
+            self._init_weight(name, arr)
+        else:
+            self._init_default(name, arr)
+
+    # helpers -------------------------------------------------------------
+    def _set(self, arr, value):
+        arr._data = jnp.asarray(value, dtype=arr._data.dtype).reshape(arr.shape)
+
+    def _init_zero(self, name, arr):
+        self._set(arr, jnp.zeros(arr.shape))
+
+    def _init_one(self, name, arr):
+        self._set(arr, jnp.ones(arr.shape))
+
+    def _init_bias(self, name, arr):
+        self._init_zero(name, arr)
+
+    def _init_gamma(self, name, arr):
+        self._init_one(name, arr)
+
+    def _init_beta(self, name, arr):
+        self._init_zero(name, arr)
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def _init_default(self, name, arr):
+        self._init_weight(name, arr)
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_zero(name, arr)
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_one(name, arr)
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        self._set(arr, jnp.full(arr.shape, self.value))
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        k = _global_random.next_key()
+        self._set(arr, jax.random.uniform(k, arr.shape, minval=-self.scale, maxval=self.scale))
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        k = _global_random.next_key()
+        self._set(arr, self.sigma * jax.random.normal(k, arr.shape))
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        k = _global_random.next_key()
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(k, (nout, nin), minval=-1.0, maxval=1.0)
+        else:
+            tmp = jax.random.normal(k, (nout, nin))
+        u, _, v = jnp.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        self._set(arr, self.scale * q.reshape(arr.shape))
+
+
+@register
+class Xavier(Initializer):
+    """(ref: initializer.py Xavier — default for most reference examples)"""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type, magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            self._init_zero(name, arr)
+            return
+        if len(shape) > 2:
+            hw_scale = float(np.prod(shape[2:]))
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in, "out": fan_out}[self.factor_type]
+        scale = math.sqrt(self.magnitude / factor)
+        k = _global_random.next_key()
+        if self.rnd_type == "uniform":
+            self._set(arr, jax.random.uniform(k, shape, minval=-scale, maxval=scale))
+        else:
+            self._set(arr, scale * jax.random.normal(k, shape))
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        weight = np.zeros(int(np.prod(shape)), dtype="float32")
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        self._set(arr, weight.reshape(shape))
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = 1 (ref: initializer.py LSTMBias); our gate order is
+    [i, f, g, o] so the second quarter is the forget gate."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = np.zeros(arr.shape, dtype="float32")
+        n = arr.shape[0] // 4
+        b[n : 2 * n] = self.forget_bias
+        self._set(arr, b)
+
+    _init_bias = _init_weight
+
+
+@register
+class Mixed(Initializer):
+    def __init__(self, patterns, initializers):
+        super().__init__()
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, i in self.map:
+            if prog.match(str(name)):
+                i(name, arr)
+                return
+        raise ValueError(f"parameter {name} did not match any pattern")
+
+
+@register
+class Load:
+    """Init from a dict of arrays, falling back to default_init."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        self.param = {k.replace("arg:", "").replace("aux:", ""): v for k, v in param.items()}
+        self.default_init = default_init
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            arr._data = jnp.asarray(
+                self.param[name]._data if isinstance(self.param[name], NDArray) else self.param[name],
+                dtype=arr._data.dtype,
+            )
+        elif self.default_init is not None:
+            self.default_init(name, arr)
+        else:
+            raise ValueError(f"no init for {name}")
+
+
 class init:
-    pass
+    """Namespace alias so `mx.init.Xavier()` works like the reference."""
+
+    Zero = Zero
+    One = One
+    Constant = Constant
+    Uniform = Uniform
+    Normal = Normal
+    Orthogonal = Orthogonal
+    Xavier = Xavier
+    MSRAPrelu = MSRAPrelu
+    Bilinear = Bilinear
+    LSTMBias = LSTMBias
+    Mixed = Mixed
+    Load = Load
+    Initializer = Initializer
+    InitDesc = InitDesc

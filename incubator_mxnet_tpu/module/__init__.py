@@ -1,1 +1,4 @@
-"""Placeholder - implemented later this round."""
+"""Module API (ref: python/mxnet/module/)."""
+from .base_module import BaseModule  # noqa: F401
+from .module import Module  # noqa: F401
+from .bucketing_module import BucketingModule  # noqa: F401

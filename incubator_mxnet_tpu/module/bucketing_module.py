@@ -1,0 +1,191 @@
+"""BucketingModule: variable-length training via per-bucket executors
+sharing parameters (ref: python/mxnet/module/bucketing_module.py:36).
+
+TPU-native note: each bucket is a shape-specialized XLA compilation of the
+same functions; parameters are shared NDArray objects so all bucket
+executors see updates — the same arrays, not copies, exactly like the
+reference's shared executor memory.
+"""
+from __future__ import annotations
+
+import logging
+
+from .base_module import BaseModule
+from .module import Module
+
+__all__ = ["BucketingModule"]
+
+
+class BucketingModule(BaseModule):
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None, compression_params=None):
+        super().__init__(logger=logger)
+        assert default_bucket_key is not None
+        self._default_bucket_key = default_bucket_key
+        self._sym_gen = sym_gen
+        self._context = context
+        self._fixed_param_names = fixed_param_names
+        self._state_names = state_names
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+        self._monitor = None
+        self._grad_req = None
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol
+
+    @property
+    def data_names(self):
+        if self.binded:
+            return self._curr_module.data_names
+        sym, data_names, _ = self._call_sym_gen(self._default_bucket_key)
+        return data_names
+
+    @property
+    def output_names(self):
+        if self.binded:
+            return self._curr_module.output_names
+        sym, _, _ = self._call_sym_gen(self._default_bucket_key)
+        return sym.list_outputs()
+
+    @property
+    def data_shapes(self):
+        return self._curr_module.data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._curr_module.label_shapes
+
+    @property
+    def output_shapes(self):
+        return self._curr_module.output_shapes
+
+    def _call_sym_gen(self, bucket_key):
+        return self._sym_gen(bucket_key)
+
+    def _gen_module(self, bucket_key):
+        sym, data_names, label_names = self._call_sym_gen(bucket_key)
+        return Module(
+            sym, data_names, label_names, logger=self.logger, context=self._context,
+            fixed_param_names=self._fixed_param_names, state_names=self._state_names,
+        )
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.binded = True
+        self._grad_req = grad_req
+        module = self._gen_module(self._default_bucket_key)
+        module.bind(data_shapes, label_shapes, for_training, inputs_need_grad,
+                    force_rebind=False, grad_req=grad_req)
+        self._curr_module = module
+        self._curr_bucket_key = self._default_bucket_key
+        self._buckets[self._default_bucket_key] = module
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """(ref: bucketing_module.py switch_bucket) — shape-specialized
+        recompile, shared parameter arrays."""
+        assert self.binded
+        if bucket_key not in self._buckets:
+            module = self._gen_module(bucket_key)
+            default_mod = self._buckets[self._default_bucket_key]
+            module.bind(data_shapes, label_shapes, self.for_training,
+                        self.inputs_need_grad, force_rebind=False, grad_req=self._grad_req)
+            if default_mod.params_initialized:
+                arg, aux = default_mod._arg_params, default_mod._aux_params
+                module.init_params(arg_params=arg, aux_params=aux, allow_missing=False)
+                # share the SAME NDArray objects (updates propagate)
+                for n in module._param_names:
+                    if n in arg:
+                        module._exec.arg_dict[n]._data = arg[n]._data
+                        module._arg_params[n] = arg[n]
+                        module._exec.arg_dict[n] = arg[n]
+                for n, a in aux.items():
+                    if n in module._exec.aux_dict:
+                        module._exec.aux_dict[n] = a
+                        module._aux_params[n] = a
+            if default_mod.optimizer_initialized:
+                module.borrow_optimizer(default_mod)
+            self._buckets[bucket_key] = module
+        self._curr_module = self._buckets[bucket_key]
+        self._curr_bucket_key = bucket_key
+
+    def init_params(self, **kwargs):
+        if self.params_initialized and not kwargs.get("force_init", False):
+            return
+        self._curr_module.init_params(**kwargs)
+        self.params_initialized = True
+
+    def get_params(self):
+        return self._curr_module.get_params()
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self._curr_module.set_params(arg_params, aux_params, allow_missing,
+                                     force_init, allow_extra)
+        self.params_initialized = True
+
+    def init_optimizer(self, **kwargs):
+        self._curr_module.init_optimizer(**kwargs)
+        self.optimizer_initialized = True
+        for mod in self._buckets.values():
+            if mod is not self._curr_module and mod.optimizer_initialized is False:
+                pass
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        bucket_key = data_batch.bucket_key
+        if bucket_key is None:
+            bucket_key = self._curr_bucket_key
+        self.switch_bucket(bucket_key, data_batch.provide_data, data_batch.provide_label)
+        if not self._curr_module.params_initialized:
+            default_mod = self._buckets[self._default_bucket_key]
+            arg, aux = default_mod._arg_params, default_mod._aux_params
+            self._curr_module.init_params(arg_params=arg, aux_params=aux)
+        if not self._curr_module.optimizer_initialized and self.optimizer_initialized:
+            self._curr_module.borrow_optimizer(self._buckets[self._default_bucket_key])
+        self._curr_module.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        self._curr_module.update()
+        # propagate updated params to other bucket executors (same arrays)
+        cur = self._curr_module
+        for key, mod in self._buckets.items():
+            if mod is cur or not mod.params_initialized:
+                continue
+            for n in mod._param_names:
+                if n in cur._exec.arg_dict:
+                    mod._exec.arg_dict[n]._data = cur._exec.arg_dict[n]._data
+            for n in mod._exec.aux_dict:
+                if n in cur._exec.aux_dict:
+                    mod._exec.aux_dict[n]._data = cur._exec.aux_dict[n]._data
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._curr_module.get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._curr_module.update_metric(eval_metric, labels, pre_sliced)
+
+    def install_monitor(self, mon):
+        self._monitor = mon
+        for mod in self._buckets.values():
+            mod.install_monitor(mon)
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        self._curr_module.save_checkpoint(prefix, epoch, save_optimizer_states)
+
+    def prepare(self, data_batch, sparse_row_id_fn=None):
+        pass

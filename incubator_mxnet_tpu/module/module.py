@@ -1,0 +1,380 @@
+"""Module: symbolic training on one or many TPU chips.
+
+TPU-native re-design of the reference Module + DataParallelExecutorGroup
+(ref: python/mxnet/module/module.py:40-644, executor_group.py:143). The
+reference splits the batch into per-GPU executors and all-reduces grads via
+kvstore; here there is ONE executor whose arrays are sharded over a
+`jax.sharding.Mesh` of the given contexts — batch dim sharded for data,
+params replicated — and XLA GSPMD inserts the ICI all-reduce during the
+backward pass (the kvstore='device' analog).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import optimizer as opt
+from ..context import Context, cpu
+from ..initializer import InitDesc, Uniform
+from ..io import DataDesc
+from ..model import save_checkpoint, load_checkpoint
+from ..ndarray.ndarray import NDArray
+from .base_module import BaseModule
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        if context is None:
+            context = cpu()
+        self._context = context if isinstance(context, (list, tuple)) else [context]
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+        self._state_names = list(state_names or [])
+        arg_names = symbol.list_arguments()
+        input_names = self._data_names + self._label_names + self._state_names
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+        self._arg_params = None
+        self._aux_params = None
+        self._params_dirty = False
+        self._optimizer = None
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._updater = None
+        self._exec = None
+        self._data_shapes = None
+        self._label_shapes = None
+        self._grad_req = None
+        self._mesh = None
+        self._preload_opt_states = None
+        if len(self._context) > 1:
+            from ..parallel import make_mesh
+
+            self._mesh = make_mesh(self._context, axis_names=("data",))
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        _, out_shapes, _ = self._symbol.infer_shape(
+            **{d.name: d.shape for d in self._data_shapes + (self._label_shapes or [])}
+        )
+        return list(zip(self._output_names, out_shapes))
+
+    # -- bind --------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        """(ref: module.py:364 bind -> simple_bind per ctx; here one sharded
+        executor)"""
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.binded = True
+
+        self._data_shapes = [
+            d if isinstance(d, DataDesc) else DataDesc(*d) for d in data_shapes
+        ]
+        self._label_shapes = (
+            [d if isinstance(d, DataDesc) else DataDesc(*d) for d in label_shapes]
+            if label_shapes else []
+        )
+        shapes = {d.name: tuple(d.shape) for d in self._data_shapes + self._label_shapes}
+
+        reqs = {}
+        for n in self._symbol.list_arguments():
+            if not for_training:
+                reqs[n] = "null"
+            elif n in self._data_names:
+                reqs[n] = grad_req if inputs_need_grad else "null"
+            elif n in self._label_names or n in self._fixed_param_names:
+                reqs[n] = "null"
+            else:
+                reqs[n] = grad_req
+        self._grad_req = reqs
+        self._exec = self._symbol.simple_bind(
+            ctx=self._context[0], grad_req=reqs, **shapes
+        )
+        if self._mesh is not None:
+            self._apply_shardings()
+        if shared_module is not None and shared_module._arg_params is not None:
+            self._arg_params = shared_module._arg_params
+            self._aux_params = shared_module._aux_params
+            self._exec.copy_params_from(self._arg_params, self._aux_params)
+            self.params_initialized = True
+
+    def _apply_shardings(self):
+        """Replicate params, shard data on the batch axis over the mesh."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self._mesh
+        rep = NamedSharding(mesh, P())
+        for name, arr in self._exec.arg_dict.items():
+            if name in self._param_names:
+                arr._data = jax.device_put(arr._data, rep)
+        for arr in self._exec.aux_dict.values():
+            arr._data = jax.device_put(arr._data, rep)
+        for arr in self._exec.grad_dict.values():
+            arr._data = jax.device_put(arr._data, rep)
+
+    def _shard_input(self, data):
+        if self._mesh is None:
+            return data
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = P("data") if data.ndim >= 1 else P()
+        return jax.device_put(data, NamedSharding(self._mesh, spec))
+
+    # -- params ------------------------------------------------------------
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        """(ref: module.py init_params)"""
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before init_params"
+        if initializer is None and not self.params_initialized:
+            initializer = Uniform(0.01)
+
+        attrs = self._symbol.attr_dict()
+
+        def _impl(name, arr, cache):
+            if cache is not None and name in cache:
+                src = cache[name]
+                arr._data = jnp.asarray(
+                    src._data if isinstance(src, NDArray) else src, dtype=arr._data.dtype
+                ).reshape(arr.shape)
+            elif cache is not None and not allow_missing:
+                raise RuntimeError(f"{name} is not presented")
+            elif initializer is not None:
+                initializer(InitDesc(name, attrs.get(name)), arr)
+
+        for name in self._param_names:
+            _impl(name, self._exec.arg_dict[name], arg_params)
+        for name, arr in self._exec.aux_dict.items():
+            _impl(name, arr, aux_params)
+
+        self._arg_params = {n: self._exec.arg_dict[n] for n in self._param_names}
+        self._aux_params = dict(self._exec.aux_dict)
+        self.params_initialized = True
+        self._params_dirty = False
+        if self._mesh is not None:
+            self._apply_shardings()
+
+    def get_params(self):
+        """(ref: module.py get_params) — returns host-synced copies."""
+        assert self.binded and self.params_initialized
+        arg = {n: NDArray(self._exec.arg_dict[n]._data) for n in self._param_names}
+        aux = {n: NDArray(a._data) for n, a in self._exec.aux_dict.items()}
+        return arg, aux
+
+    # -- optimizer ---------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),), force_init=False):
+        """(ref: module.py init_optimizer + model._create_kvstore)"""
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        from .. import kvstore as kvs
+
+        batch_size = self._data_shapes[0].shape[0]
+        optimizer_params = dict(optimizer_params)
+        if isinstance(optimizer, str):
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            if "rescale_grad" not in optimizer_params:
+                optimizer_params["rescale_grad"] = 1.0 / batch_size
+            optimizer = opt.create(
+                optimizer, sym=self._symbol, param_idx2name=idx2name, **optimizer_params
+            )
+        self._optimizer = optimizer
+        self._optimizer.set_lr_mult({})
+        self._optimizer.set_wd_mult({})
+
+        kvstore_obj, update_on_kvstore = kvs.create_kvstore_for_module(
+            kvstore, len(self._context), self._arg_params
+        )
+        self._kvstore = kvstore_obj
+        self._update_on_kvstore = update_on_kvstore
+        if kvstore_obj is not None:
+            if update_on_kvstore:
+                kvstore_obj.set_optimizer(self._optimizer)
+            for i, name in enumerate(self._param_names):
+                kvstore_obj.init(name, self._arg_params[name])
+        if not update_on_kvstore or kvstore_obj is None:
+            self._updater = opt.get_updater(self._optimizer)
+        self.optimizer_initialized = True
+        if self._preload_opt_states is not None:
+            self.load_optimizer_states(self._preload_opt_states)
+            self._preload_opt_states = None
+
+    def borrow_optimizer(self, shared_module):
+        assert shared_module.optimizer_initialized
+        self._optimizer = shared_module._optimizer
+        self._kvstore = shared_module._kvstore
+        self._update_on_kvstore = shared_module._update_on_kvstore
+        self._updater = shared_module._updater
+        self.optimizer_initialized = True
+
+    # -- compute -----------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feed = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            a = arr._data if isinstance(arr, NDArray) else jnp.asarray(arr)
+            feed[name] = self._shard_input(a)
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                if name in self._exec.arg_dict:
+                    a = arr._data if isinstance(arr, NDArray) else jnp.asarray(arr)
+                    feed[name] = self._shard_input(a)
+        self._exec.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """(ref: module.py:644 update -> updater / kvstore push+pull)"""
+        assert self.binded and self.params_initialized and self.optimizer_initialized
+        self._params_dirty = True
+        if self._kvstore is not None and self._update_on_kvstore:
+            for i, name in enumerate(self._param_names):
+                w = self._exec.arg_dict[name]
+                g = self._exec.grad_dict.get(name)
+                if g is None:
+                    continue
+                self._kvstore.push(name, g)
+                self._kvstore.pull(name, out=w)
+        else:
+            if self._kvstore is not None:
+                for i, name in enumerate(self._param_names):
+                    g = self._exec.grad_dict.get(name)
+                    if g is None:
+                        continue
+                    self._kvstore.push(name, g)
+                    self._kvstore.pull(name, out=g)
+            for i, name in enumerate(self._param_names):
+                w = self._exec.arg_dict[name]
+                g = self._exec.grad_dict.get(name)
+                if g is None:
+                    continue
+                self._updater(i, g, w)
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.inputs_need_grad
+        return [self._exec.grad_dict[n] for n in self._data_names if n in self._exec.grad_dict]
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        if self._label_names:
+            eval_metric.update_dict(
+                dict(zip(self._label_names, labels or [])),
+                dict(zip(self._output_names, self._exec.outputs)),
+            )
+        else:
+            eval_metric.update_dict({}, dict(zip(self._output_names, self._exec.outputs)))
+
+    # -- states / checkpoints ----------------------------------------------
+    def get_states(self, merge_multi_context=True):
+        return [self._exec.arg_dict[n] for n in self._state_names]
+
+    def set_states(self, states=None, value=None):
+        for name in self._state_names:
+            if value is not None:
+                self._exec.arg_dict[name]._data = jnp.full(
+                    self._exec.arg_dict[name].shape, value,
+                    dtype=self._exec.arg_dict[name]._data.dtype,
+                )
+        if states is not None:
+            for name, s in zip(self._state_names, states):
+                self._exec.arg_dict[name]._data = s._data
+
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as f:
+                f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                self._updater.set_states(f.read())
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False, remove_amp_cast=True):
+        arg_params, aux_params = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg_params, aux_params)
+        if save_optimizer_states:
+            self.save_optimizer_states(f"{prefix}-{epoch:04d}.states")
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = f"{prefix}-{epoch:04d}.states"
+        # defer copying into executors until bind
+        orig_bind = mod.bind
+
+        def bind_and_set(*a, **kw):
+            orig_bind(*a, **kw)
+            mod._exec.copy_params_from(args, auxs, allow_extra_params=True)
+            mod._arg_params = {n: mod._exec.arg_dict[n] for n in mod._param_names}
+            mod._aux_params = dict(mod._exec.aux_dict)
+
+        mod.bind = bind_and_set
+        return mod
+
+    def reshape(self, data_shapes, label_shapes=None):
+        self.bind(data_shapes, label_shapes, for_training=self.for_training,
+                  inputs_need_grad=self.inputs_need_grad, force_rebind=True)
+
+    def install_monitor(self, mon):
+        mon.install(self._exec)
+
+    def prepare(self, data_batch, sparse_row_id_fn=None):
+        pass

@@ -1,1 +1,75 @@
-"""Placeholder - implemented later this round."""
+"""Monitor: tap intermediate outputs during training
+(ref: python/mxnet/monitor.py:33, executor monitor_callback hooks
+graph_executor.cc:1239)."""
+from __future__ import annotations
+
+import logging
+import re
+
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False, monitor_all=False):
+        if stat_func is None:
+            def asum_stat(x):
+                return float(abs(x.asnumpy()).mean()) if isinstance(x, NDArray) else float(abs(x).mean())
+
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+        self.monitor_all = monitor_all
+
+    def install(self, exe):
+        exe.set_monitor_callback(self.stat_helper, self.monitor_all)
+        self.exes.append(exe)
+
+    def stat_helper(self, name, arr):
+        if not self.activated or not self.re_prog.match(name):
+            return
+        self.queue.append((self.step, name, self.stat_func(arr)))
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            for exe in self.exes:
+                for o in exe.outputs:
+                    o.wait_to_read()
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            for o in exe.outputs:
+                o.wait_to_read()
+            # record all outputs (whole-graph jit means internals are fused
+            # away; outputs + args are observable)
+            for name, arr in list(exe.arg_dict.items()):
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name, self.stat_func(arr)))
+            for name, o in zip(exe._symbol.list_outputs(), exe.outputs):
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name, self.stat_func(o)))
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            res.append((n, k, str(v_list)))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        res = self.toc()
+        for n, k, v in res:
+            logging.info("Batch: %7d %30s %s", n, k, v)

@@ -1,1 +1,572 @@
-"""Placeholder - implemented later this round."""
+"""Optimizers (ref: python/mxnet/optimizer/optimizer.py — 17 registered
+optimizers + the Updater state machine used by KVStore).
+
+Each update dispatches to the fused update ops in `ops/optimizer.py`
+(ref: src/operator/optimizer_op-inl.h) or inline jnp math; the arrays are
+updated by rebinding `_data`, which is the functional analog of the
+reference's in-place kWriteInplace updates.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+
+import numpy as np
+import jax.numpy as jnp
+
+from . import random as _global_random
+from .ndarray import register as _ndreg
+from .ndarray.ndarray import NDArray
+from .ndarray import zeros
+
+__all__ = [
+    "Optimizer", "SGD", "NAG", "SGLD", "Signum", "FTML", "DCASGD", "LBSGD",
+    "Adam", "AdaGrad", "RMSProp", "AdaDelta", "Ftrl", "Adamax", "Nadam",
+    "AdamW", "Test", "Updater", "get_updater", "create", "register",
+]
+
+_REGISTRY = {}
+
+
+def register(klass):
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    return _REGISTRY[name.lower()](**kwargs)
+
+
+class Optimizer:
+    """Base optimizer (ref: optimizer.py class Optimizer)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None, **kwargs):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.idx2name = dict(param_idx2name or {})
+        self.sym_info = (sym.attr_dict(), sym.list_arguments()) if sym is not None else ()
+        self.param_dict = param_dict or {}
+        self.aggregate_num = 0
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        return create(name, **kwargs)
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler is not None else self.lr
+        name = self.idx2name.get(index, index)
+        if name in self.param_dict:
+            lr *= self.param_dict[name].lr_mult
+        elif name in self.lr_mult:
+            lr *= self.lr_mult[name]
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        name = self.idx2name.get(index, index)
+        if name in self.param_dict:
+            wd *= self.param_dict[name].wd_mult
+        elif name in self.wd_mult:
+            wd *= self.wd_mult[name]
+        return wd
+
+    def _common_attrs(self, index):
+        return dict(
+            lr=self._get_lr(index),
+            wd=self._get_wd(index),
+            rescale_grad=self.rescale_grad,
+            clip_gradient=self.clip_gradient if self.clip_gradient else -1.0,
+        )
+
+
+def _call(name, arrays, attrs):
+    return _ndreg.invoke_by_name(name, arrays, attrs)
+
+
+def _writeback(targets, results):
+    if isinstance(results, NDArray):
+        results = [results]
+    for t, r in zip(targets, results):
+        t._data = r._data
+
+
+@register
+class SGD(Optimizer):
+    """(ref: optimizer.py:511 SGD, with momentum + multi-precision)"""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return zeros(weight.shape, dtype=str(weight.dtype))
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        attrs = self._common_attrs(index)
+        if state is not None:
+            _writeback([weight, state], _call("sgd_mom_update", [weight, grad, state],
+                                              {**attrs, "momentum": self.momentum}))
+        else:
+            _writeback([weight], _call("sgd_update", [weight, grad], attrs))
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype=str(weight.dtype)) if self.momentum else None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        attrs = self._common_attrs(index)
+        if state is not None:
+            _writeback([weight, state], _call("nag_mom_update", [weight, grad, state],
+                                              {**attrs, "momentum": self.momentum}))
+        else:
+            _writeback([weight], _call("sgd_update", [weight, grad], attrs))
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (ref: optimizer.py SGLD)."""
+
+    def update(self, index, weight, grad, state):
+        import jax
+
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        key = _global_random.next_key()
+        noise = jax.random.normal(key, weight.shape, weight._data.dtype) * math.sqrt(lr)
+        weight._data = weight._data - lr / 2 * (g + wd * weight._data) + noise
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype=str(weight.dtype)) if self.momentum else None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        attrs = self._common_attrs(index)
+        if state is not None:
+            _writeback([weight, state], _call("signum_update", [weight, grad, state],
+                                              {**attrs, "momentum": self.momentum, "wd_lh": self.wd_lh}))
+        else:
+            _writeback([weight], _call("signsgd_update", [weight, grad], attrs))
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        dt = str(weight.dtype)
+        return (zeros(weight.shape, dtype=dt), zeros(weight.shape, dtype=dt), zeros(weight.shape, dtype=dt))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        attrs = self._common_attrs(index)
+        attrs.pop("clip_gradient")
+        d, v, z = state
+        _writeback([weight, d, v, z], _call(
+            "ftml_update", [weight, grad, d, v, z],
+            {**attrs, "beta1": self.beta1, "beta2": self.beta2, "epsilon": self.epsilon,
+             "t": t, "clip_grad": self.clip_gradient if self.clip_gradient else -1.0},
+        ))
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (ref: optimizer.py DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        mom = zeros(weight.shape, dtype=str(weight.dtype)) if self.momentum else None
+        prev = NDArray(weight._data)
+        return (mom, prev)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        mom, prev = state
+        comp = g + wd * weight._data + self.lamda * g * g * (weight._data - prev._data)
+        if mom is not None:
+            mom._data = self.momentum * mom._data - lr * comp
+            upd = mom._data
+        else:
+            upd = -lr * comp
+        prev._data = weight._data
+        weight._data = weight._data + upd
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with LARS-style layer-wise scaling
+    (ref: optimizer.py:782 LBSGD)."""
+
+    def __init__(self, momentum=0.0, warmup_strategy="linear", warmup_epochs=5,
+                 batch_scale=1, updates_per_epoch=32, begin_epoch=0, num_epochs=60, **kwargs):
+        super().__init__(momentum=momentum, **kwargs)
+        self.warmup_strategy = warmup_strategy
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        # LARS trust ratio
+        wnorm = jnp.linalg.norm(weight._data)
+        gnorm = jnp.linalg.norm(g)
+        ratio = jnp.where(
+            (wnorm > 0) & (gnorm > 0), wnorm / (gnorm + wd * wnorm + 1e-9), 1.0
+        )
+        eff_lr = lr * ratio
+        if state is not None:
+            state._data = self.momentum * state._data - eff_lr * (g + wd * weight._data)
+            weight._data = weight._data + state._data
+        else:
+            weight._data = weight._data - eff_lr * (g + wd * weight._data)
+
+
+@register
+class Adam(Optimizer):
+    """(ref: optimizer.py:1120 Adam) with bias-corrected lr."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        dt = str(weight.dtype)
+        return (zeros(weight.shape, dtype=dt), zeros(weight.shape, dtype=dt))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        attrs = self._common_attrs(index)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        attrs["lr"] = attrs["lr"] * math.sqrt(coef2) / coef1
+        mean, var = state
+        _writeback([weight, mean, var], _call(
+            "adam_update", [weight, grad, mean, var],
+            {**attrs, "beta1": self.beta1, "beta2": self.beta2, "epsilon": self.epsilon},
+        ))
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype=str(weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight._data
+        state._data = state._data + jnp.square(g)
+        weight._data = weight._data - lr * g / (jnp.sqrt(state._data) + self.float_stable_eps)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9, epsilon=1e-8,
+                 centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2, self.epsilon = gamma1, gamma2, epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        dt = str(weight.dtype)
+        if self.centered:
+            return (zeros(weight.shape, dtype=dt), zeros(weight.shape, dtype=dt), zeros(weight.shape, dtype=dt))
+        return zeros(weight.shape, dtype=dt)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        attrs = self._common_attrs(index)
+        attrs["clip_weights"] = self.clip_weights if self.clip_weights else -1.0
+        if self.centered:
+            n, g, delta = state
+            _writeback([weight, n, g, delta], _call(
+                "rmspropalex_update", [weight, grad, n, g, delta],
+                {**attrs, "gamma1": self.gamma1, "gamma2": self.gamma2, "epsilon": self.epsilon},
+            ))
+        else:
+            _writeback([weight, state], _call(
+                "rmsprop_update", [weight, grad, state],
+                {**attrs, "gamma1": self.gamma1, "epsilon": self.epsilon},
+            ))
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.9, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        dt = str(weight.dtype)
+        return (zeros(weight.shape, dtype=dt), zeros(weight.shape, dtype=dt))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g._data = self.rho * acc_g._data + (1 - self.rho) * jnp.square(g)
+        delta = jnp.sqrt(acc_delta._data + self.epsilon) / jnp.sqrt(acc_g._data + self.epsilon) * g
+        acc_delta._data = self.rho * acc_delta._data + (1 - self.rho) * jnp.square(delta)
+        weight._data = weight._data - delta - wd * weight._data
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        dt = str(weight.dtype)
+        return (zeros(weight.shape, dtype=dt), zeros(weight.shape, dtype=dt))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        attrs = self._common_attrs(index)
+        z, n = state
+        _writeback([weight, z, n], _call(
+            "ftrl_update", [weight, grad, z, n],
+            {**attrs, "lamda1": self.lamda1, "beta": self.beta},
+        ))
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        dt = str(weight.dtype)
+        return (zeros(weight.shape, dtype=dt), zeros(weight.shape, dtype=dt))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index) / (1.0 - self.beta1 ** t)
+        wd = self._get_wd(index)
+        g = grad._data * self.rescale_grad + wd * weight._data
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        m, u = state
+        m._data = self.beta1 * m._data + (1 - self.beta1) * g
+        u._data = jnp.maximum(self.beta2 * u._data, jnp.abs(g))
+        weight._data = weight._data - lr * m._data / (u._data + 1e-8)
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        dt = str(weight.dtype)
+        return (zeros(weight.shape, dtype=dt), zeros(weight.shape, dtype=dt))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad._data * self.rescale_grad + wd * weight._data
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m, v = state
+        g_prime = g / (1.0 - self.m_schedule)
+        m._data = self.beta1 * m._data + (1.0 - self.beta1) * g
+        v._data = self.beta2 * v._data + (1.0 - self.beta2) * jnp.square(g)
+        m_prime = m._data / (1.0 - m_schedule_next)
+        v_prime = v._data / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - momentum_t) * g_prime + momentum_t_1 * m_prime
+        weight._data = weight._data - lr * m_bar / (jnp.sqrt(v_prime) + self.epsilon)
+
+
+@register
+class AdamW(Optimizer):
+    """Decoupled weight decay Adam (ref: src/operator/contrib/adamw.cc)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, eta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon, self.eta = beta1, beta2, epsilon, eta
+
+    def create_state(self, index, weight):
+        dt = str(weight.dtype)
+        return (zeros(weight.shape, dtype=dt), zeros(weight.shape, dtype=dt))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        attrs = self._common_attrs(index)
+        mean, var = state
+        _writeback([weight, mean, var], _call(
+            "adamw_update", [weight, grad, mean, var],
+            {**attrs, "beta1": self.beta1, "beta2": self.beta2,
+             "epsilon": self.epsilon, "eta": self.eta},
+        ))
+
+
+@register
+class Test(Optimizer):
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype=str(weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        weight._data = weight._data - self.rescale_grad * grad._data
+
+
+class Updater:
+    """State machine applying an optimizer per key
+    (ref: optimizer.py:1621 Updater — used by KVStore as the updater fn)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+        self.aggregate_updates = optimizer.aggregate_num > 0
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        def _np(state):
+            if state is None:
+                return None
+            if isinstance(state, (list, tuple)):
+                return tuple(_np(s) for s in state)
+            return state.asnumpy()
+
+        states = {k: _np(v) for k, v in self.states.items()}
+        return pickle.dumps((states, self.optimizer) if dump_optimizer else states)
+
+    def set_states(self, states_blob):
+        states = pickle.loads(states_blob)
+        if isinstance(states, tuple) and len(states) == 2:
+            states, self.optimizer = states
+
+        def _nd(state):
+            if state is None:
+                return None
+            if isinstance(state, (list, tuple)):
+                return tuple(_nd(s) for s in state)
+            return NDArray(state)
+
+        self.states = {k: _nd(v) for k, v in states.items()}
+        self.states_synced = {k: True for k in self.states}
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -1,1 +1,13 @@
-"""Placeholder - implemented later this round."""
+"""Parallelism & distribution (TPU-native).
+
+Covers SURVEY §2.2: data parallel (mesh batch sharding + GSPMD all-reduce),
+model/tensor parallel (weight sharding specs), sequence/context parallel
+(ring attention over ICI), and multi-host data parallel (DCN collectives).
+The reference implements these with kvstore reduce kernels, NCCL and
+ps-lite; here they are sharding declarations + XLA collectives.
+"""
+from .mesh import (  # noqa: F401
+    make_mesh, make_nd_mesh, data_sharding, replicated, local_mesh,
+)
+from . import collectives  # noqa: F401
+from .collectives import allreduce, allgather, broadcast  # noqa: F401

@@ -1,0 +1,53 @@
+"""Collective helpers over a mesh.
+
+The reference's reduce/broadcast kernels (src/kvstore/comm.h CommCPU:103,
+CommDevice:451) + NCCL ring (kvstore_nccl.h) become XLA collectives: psum /
+all_gather / ppermute inside shard_map, riding ICI.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["allreduce", "allgather", "broadcast", "reduce_scatter", "psum_in_shardmap"]
+
+
+def allreduce(values, mesh=None, axis_name="data"):
+    """Sum list of per-device arrays OR a sharded array across the mesh axis."""
+    if isinstance(values, (list, tuple)):
+        acc = values[0]
+        for v in values[1:]:
+            acc = acc + v
+        return acc
+    return values
+
+
+def psum_in_shardmap(x, mesh, axis_name="data"):
+    fn = jax.shard_map(
+        lambda v: jax.lax.psum(v, axis_name),
+        mesh=mesh, in_specs=P(axis_name), out_specs=P(),
+    )
+    return fn(x)
+
+
+def allgather(x, mesh, axis_name="data"):
+    fn = jax.shard_map(
+        lambda v: jax.lax.all_gather(v, axis_name, tiled=True),
+        mesh=mesh, in_specs=P(axis_name), out_specs=P(),
+    )
+    return fn(x)
+
+
+def reduce_scatter(x, mesh, axis_name="data"):
+    fn = jax.shard_map(
+        lambda v: jax.lax.psum_scatter(v, axis_name, tiled=True),
+        mesh=mesh, in_specs=P(None), out_specs=P(axis_name),
+    )
+    return fn(x)
+
+
+def broadcast(x, mesh):
+    return jax.device_put(x, NamedSharding(mesh, P()))

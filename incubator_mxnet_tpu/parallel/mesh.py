@@ -1,0 +1,61 @@
+"""Device mesh helpers.
+
+TPU-native replacement for the reference's device topology machinery
+(ref: src/kvstore/gpu_topology.h link-weight spanning trees): on TPU the
+interconnect is the ICI torus and XLA already routes collectives optimally,
+so "topology" reduces to declaring a `jax.sharding.Mesh` with named axes.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["make_mesh", "make_nd_mesh", "data_sharding", "replicated", "local_mesh"]
+
+
+def _devices_of(contexts):
+    from ..context import Context
+
+    devs = []
+    for c in contexts:
+        if isinstance(c, Context):
+            devs.append(c.jax_device())
+        else:
+            devs.append(c)
+    return devs
+
+
+def make_mesh(contexts=None, axis_names=("data",)):
+    """1-D mesh over the given contexts (or all local devices)."""
+    devs = _devices_of(contexts) if contexts else jax.devices()
+    return Mesh(np.array(devs), axis_names=axis_names[:1])
+
+
+def make_nd_mesh(axis_sizes: dict, devices=None):
+    """N-D mesh, e.g. {'dp': 2, 'tp': 4}. Sizes must multiply to #devices."""
+    devices = devices if devices is not None else jax.devices()
+    names = tuple(axis_sizes.keys())
+    sizes = tuple(axis_sizes.values())
+    n = int(np.prod(sizes))
+    if n != len(devices):
+        raise ValueError(f"mesh {axis_sizes} needs {n} devices, have {len(devices)}")
+    arr = np.array(devices).reshape(sizes)
+    return Mesh(arr, axis_names=names)
+
+
+def data_sharding(mesh, ndim, axis=0, mesh_axis="data"):
+    spec = [None] * ndim
+    spec[axis] = mesh_axis
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def local_mesh(n=None, axis_names=("data",)):
+    devs = jax.devices()
+    if n is not None:
+        devs = devs[:n]
+    return Mesh(np.array(devs), axis_names=axis_names[:1])

@@ -1,1 +1,118 @@
-"""Placeholder - implemented later this round."""
+"""Profiler (ref: src/profiler/profiler.h, python/mxnet/profiler.py).
+
+Keeps the reference's UX — set_config / set_state('run'|'stop') / dump — on
+top of jax.profiler, which emits XPlane/Perfetto traces viewable in
+TensorBoard or chrome://tracing (matching the reference's chrome-trace dump
+ref: profiler.h:87-90). Op-level annotations use TraceAnnotation, the analog
+of the engine's named-opr profiling spans.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.profiler
+
+__all__ = [
+    "set_config", "set_state", "dump", "pause", "resume", "Task", "Frame",
+    "Event", "Counter", "Marker", "scope",
+]
+
+_CONFIG = {"filename": "profile.json", "profile_all": False}
+_STATE = {"running": False, "dir": None}
+
+
+def set_config(**kwargs):
+    """(ref: profiler.py set_config) — accepts the reference's kwargs;
+    `filename` determines the trace directory."""
+    _CONFIG.update(kwargs)
+
+
+def set_state(state="stop", profile_process="worker"):
+    if state == "run" and not _STATE["running"]:
+        trace_dir = os.path.splitext(_CONFIG.get("filename", "profile.json"))[0] + "_trace"
+        os.makedirs(trace_dir, exist_ok=True)
+        jax.profiler.start_trace(trace_dir)
+        _STATE.update(running=True, dir=trace_dir)
+    elif state == "stop" and _STATE["running"]:
+        jax.profiler.stop_trace()
+        _STATE["running"] = False
+
+
+def dump(finished=True, profile_process="worker"):
+    if _STATE["running"]:
+        set_state("stop")
+    return _STATE["dir"]
+
+
+def pause(profile_process="worker"):
+    pass
+
+
+def resume(profile_process="worker"):
+    pass
+
+
+def scope(name):
+    """Annotation context (ref: ProfileTask) — shows up in the trace."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class _Annotated:
+    def __init__(self, name, *a, **kw):
+        self.name = name
+        self._ctx = None
+
+    def start(self):
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+
+    def stop(self):
+        if self._ctx is not None:
+            self._ctx.__exit__(None, None, None)
+            self._ctx = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Task(_Annotated):
+    """(ref: profiler.h ProfileTask:761)"""
+
+
+class Frame(_Annotated):
+    """(ref: profiler.h ProfileFrame:911)"""
+
+
+class Event(_Annotated):
+    """(ref: profiler.h ProfileEvent:837)"""
+
+
+class Counter:
+    """(ref: profiler.h ProfileCounter:556) — host-side counter recorded into
+    logs (XPlane has no free counters)."""
+
+    def __init__(self, domain, name, value=0):
+        self.name = name
+        self.value = value
+
+    def set_value(self, value):
+        self.value = value
+
+    def increment(self, delta=1):
+        self.value += delta
+
+    def decrement(self, delta=1):
+        self.value -= delta
+
+
+class Marker:
+    def __init__(self, domain, name):
+        self.name = name
+
+    def mark(self, scope="process"):
+        pass

@@ -1,1 +1,221 @@
-"""Placeholder - implemented later this round."""
+"""RecordIO (ref: python/mxnet/recordio.py:37 MXRecordIO, :212 indexed;
+binary format ref: dmlc-core recordio — magic 0xced7230a framing).
+
+Pure-Python implementation of the same on-disk format (kMagic + cflag/length
+word, 4-byte aligned records) so shards written by the reference tooling
+layout are readable; a C++ reader lands with the native io engine.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+
+import numpy as np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_MAGIC = 0xCED7230A
+_CFLAG_BITS = 29
+_CFLAG_MASK = (1 << _CFLAG_BITS) - 1
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << _CFLAG_BITS) | length
+
+
+def _decode_lrec(lrec):
+    return lrec >> _CFLAG_BITS, lrec & _CFLAG_MASK
+
+
+class MXRecordIO:
+    """Sequential record file reader/writer (ref: recordio.py:37)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("invalid flag")
+        self.is_open = True
+        self.pid = os.getpid()
+
+    def close(self):
+        if self.is_open:
+            self.handle.close()
+            self.is_open = False
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["handle"] = None
+        if d["is_open"]:
+            d["is_open"] = False
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        if self.flag in ("w", "r"):
+            self.open()
+
+    def _check_pid(self):
+        if self.pid != os.getpid():
+            # reopen after fork (ref: recordio.py fork handling)
+            self.open()
+
+    def write(self, buf):
+        assert self.writable
+        self._check_pid()
+        self.handle.write(struct.pack("<II", _MAGIC, _encode_lrec(0, len(buf))))
+        self.handle.write(buf)
+        pad = (4 - len(buf) % 4) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        self._check_pid()
+        head = self.handle.read(8)
+        if len(head) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", head)
+        if magic != _MAGIC:
+            raise IOError(f"invalid record magic {magic:#x} in {self.uri}")
+        _, length = _decode_lrec(lrec)
+        buf = self.handle.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.handle.read(pad)
+        return buf
+
+    def tell(self):
+        return self.handle.tell()
+
+    def seek(self, pos):
+        assert not self.writable
+        self.handle.seek(pos)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Keyed random access via a .idx file (ref: recordio.py:212)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if self.flag == "r" and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+        elif self.flag == "w":
+            self.fidx = open(self.idx_path, "w")
+
+    def close(self):
+        if self.is_open and self.fidx is not None:
+            self.fidx.close()
+            self.fidx = None
+        super().close()
+
+    def read_idx(self, idx):
+        self.seek(self.idx[idx])
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write(f"{key}\t{pos}\n")
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+class IRHeader:
+    """Image record header (ref: recordio.py IRHeader: flag, label, id, id2)."""
+
+    __slots__ = ("flag", "label", "id", "id2")
+
+    def __init__(self, flag, label, id, id2):  # noqa: A002
+        self.flag = flag
+        self.label = label
+        self.id = id
+        self.id2 = id2
+
+
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """(ref: recordio.py pack)"""
+    label = header.label
+    if np.isscalar(label):
+        hdr = struct.pack(_IR_FORMAT, 0, float(label), header.id, header.id2)
+        return hdr + s
+    label = np.asarray(label, dtype=np.float32)
+    hdr = struct.pack(_IR_FORMAT, label.size, 0.0, header.id, header.id2)
+    return hdr + label.tobytes() + s
+
+
+def unpack(s):
+    flag, label, id_, id2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    s = s[_IR_SIZE:]
+    if flag > 0:
+        arr = np.frombuffer(s[: flag * 4], dtype=np.float32)
+        s = s[flag * 4:]
+        header = IRHeader(flag, arr, id_, id2)
+    else:
+        header = IRHeader(flag, label, id_, id2)
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """(ref: recordio.py pack_img) — encode with OpenCV."""
+    import cv2
+
+    if img_fmt.lower() in (".jpg", ".jpeg"):
+        encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality]
+    elif img_fmt.lower() == ".png":
+        encode_params = [cv2.IMWRITE_PNG_COMPRESSION, quality]
+    else:
+        encode_params = None
+    ret, buf = cv2.imencode(img_fmt, img, encode_params)
+    assert ret, "failed to encode image"
+    return pack(header, buf.tobytes())
+
+
+def unpack_img(s, iscolor=-1):
+    import cv2
+
+    header, s = unpack(s)
+    img = cv2.imdecode(np.frombuffer(s, dtype=np.uint8), iscolor)
+    return header, img

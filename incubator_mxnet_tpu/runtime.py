@@ -1,1 +1,42 @@
-"""Placeholder - implemented later this round."""
+"""Runtime feature detection (ref: src/libinfo.cc, python/mxnet/runtime.py)."""
+from __future__ import annotations
+
+import collections
+
+import jax
+
+__all__ = ["Feature", "feature_list", "Features"]
+
+Feature = collections.namedtuple("Feature", ["name", "enabled"])
+
+
+def _detect():
+    feats = {
+        "TPU": False, "CPU": True, "XLA": True, "PALLAS": True,
+        "BF16": True, "F16C": True, "INT64_TENSOR_SIZE": True,
+        "DIST_KVSTORE": True, "OPENCV": False, "BLAS_OPEN": True,
+        "SIGNAL_HANDLER": False, "PROFILER": True,
+    }
+    try:
+        feats["TPU"] = any(d.platform != "cpu" for d in jax.devices())
+    except RuntimeError:
+        pass
+    try:
+        import cv2  # noqa: F401
+
+        feats["OPENCV"] = True
+    except ImportError:
+        pass
+    return feats
+
+
+def feature_list():
+    return [Feature(k, v) for k, v in _detect().items()]
+
+
+class Features(dict):
+    def __init__(self):
+        super().__init__({f.name: f for f in feature_list()})
+
+    def is_enabled(self, name):
+        return self[name].enabled

@@ -1,1 +1,237 @@
-"""Placeholder - implemented later this round."""
+"""Numeric testing toolbox (ref: python/mxnet/test_utils.py — shipped in the
+package). The key oracle is `check_consistency`: run the same symbol under
+several contexts/dtypes and cross-check — TPU correctness = consistency of
+tpu vs cpu, exactly the cpu-vs-gpu pattern of the reference (:1224).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .context import Context, cpu, current_context, tpu, num_tpus
+from .ndarray.ndarray import NDArray
+from .ndarray import array as nd_array
+from . import random as _rnd
+
+__all__ = [
+    "default_context", "set_default_context", "assert_almost_equal",
+    "almost_equal", "same", "rand_ndarray", "rand_shape_nd", "random_arrays",
+    "check_numeric_gradient", "check_symbolic_forward", "check_symbolic_backward",
+    "check_consistency", "simple_forward", "create_2d_tensor", "rand_coord_2d",
+]
+
+_DEFAULT_CTX = [None]
+
+
+def default_context():
+    """(ref: test_utils.py:52) — retarget the whole suite at a device."""
+    if _DEFAULT_CTX[0] is not None:
+        return _DEFAULT_CTX[0]
+    return current_context()
+
+
+def set_default_context(ctx):
+    _DEFAULT_CTX[0] = ctx
+
+
+def same(a, b):
+    return np.array_equal(a, b)
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20, equal_nan=False):
+    return np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b"), equal_nan=False):
+    """(ref: test_utils.py:474)"""
+    a = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, NDArray) else np.asarray(b)
+    if not almost_equal(a, b, rtol, atol, equal_nan):
+        index = np.unravel_index(np.argmax(np.abs(a - b)), a.shape) if a.shape else ()
+        rel = np.max(np.abs(a - b) / (np.abs(b) + atol + 1e-30))
+        raise AssertionError(
+            f"Items are not equal (rtol={rtol}, atol={atol}): max rel err {rel} "
+            f"at {index}: {names[0]}={a[index] if a.shape else a}, "
+            f"{names[1]}={b[index] if b.shape else b}"
+        )
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=ndim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None, ctx=None):
+    arr = np.random.uniform(-1, 1, shape).astype(dtype or np.float32)
+    if stype == "default":
+        return nd_array(arr, ctx=ctx)
+    from .ndarray import sparse
+
+    return sparse.cast_storage(nd_array(arr), stype)
+
+
+def random_arrays(*shapes):
+    arrays = [np.random.randn(*s).astype(np.float32) for s in shapes]
+    if len(arrays) == 1:
+        return arrays[0]
+    return arrays
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    ctx = ctx or default_context()
+    inputs = {k: nd_array(v, ctx=ctx) for k, v in inputs.items()}
+    exe = sym.bind(ctx, args=inputs, grad_req="null")
+    outputs = [o.asnumpy() for o in exe.forward(is_train=is_train)]
+    if len(outputs) == 1:
+        outputs = outputs[0]
+    return outputs
+
+
+def _parse_location(sym, location, ctx):
+    if isinstance(location, dict):
+        return {k: nd_array(v, ctx=ctx) if not isinstance(v, NDArray) else v
+                for k, v in location.items()}
+    return {k: nd_array(v, ctx=ctx) if not isinstance(v, NDArray) else v
+            for k, v in zip(sym.list_arguments(), location)}
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None, use_forward_train=True,
+                           ctx=None, grad_stype_dict=None, dtype=np.float32):
+    """Finite-difference gradient check (ref: test_utils.py:801)."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx)
+    args = {k: v for k, v in location.items()}
+    grad_nodes = grad_nodes or list(args.keys())
+    exe = sym.bind(
+        ctx, args=args, grad_req={k: ("write" if k in grad_nodes else "null") for k in args},
+        aux_states=aux_states,
+    )
+    exe.forward(is_train=True)
+    out = exe.outputs[0].asnumpy()
+    head_grad = np.ones_like(out)
+    exe.backward([nd_array(head_grad, ctx=ctx)])
+    sym_grads = {k: exe.grad_dict[k].asnumpy() for k in grad_nodes if k in exe.grad_dict}
+
+    for name in grad_nodes:
+        base = location[name].asnumpy().astype(np.float64)
+        num_grad = np.zeros_like(base)
+        flat = base.reshape(-1)
+        ng_flat = num_grad.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + numeric_eps / 2
+            exe.arg_dict[name]._data = nd_array(base.astype(dtype))._data.reshape(base.shape)
+            exe.forward(is_train=use_forward_train)
+            f_pos = float((exe.outputs[0].asnumpy() * head_grad).sum())
+            flat[i] = orig - numeric_eps / 2
+            exe.arg_dict[name]._data = nd_array(base.astype(dtype))._data.reshape(base.shape)
+            exe.forward(is_train=use_forward_train)
+            f_neg = float((exe.outputs[0].asnumpy() * head_grad).sum())
+            ng_flat[i] = (f_pos - f_neg) / numeric_eps
+            flat[i] = orig
+        exe.arg_dict[name]._data = nd_array(base.astype(dtype))._data.reshape(base.shape)
+        assert_almost_equal(
+            sym_grads[name], num_grad, rtol=rtol, atol=atol or rtol * 0.1,
+            names=(f"analytic {name}", f"numeric {name}"),
+        )
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-5, atol=None,
+                           aux_states=None, ctx=None, equal_nan=False, dtype=np.float32):
+    """(ref: test_utils.py:939)"""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx)
+    exe = sym.bind(ctx, args=location, grad_req="null", aux_states=aux_states)
+    outputs = exe.forward(is_train=False)
+    for out, exp in zip(outputs, expected):
+        assert_almost_equal(out, exp, rtol=rtol, atol=atol or 1e-20, equal_nan=equal_nan)
+    return [o.asnumpy() for o in outputs]
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5, atol=None,
+                            aux_states=None, grad_req="write", ctx=None, equal_nan=False,
+                            dtype=np.float32):
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx)
+    exe = sym.bind(ctx, args=location, grad_req=grad_req, aux_states=aux_states)
+    exe.forward(is_train=True)
+    exe.backward([nd_array(g, ctx=ctx) if not isinstance(g, NDArray) else g for g in out_grads])
+    if isinstance(expected, dict):
+        for name, exp in expected.items():
+            assert_almost_equal(exe.grad_dict[name], exp, rtol, atol or 1e-20,
+                                names=(f"grad {name}", "expected"), equal_nan=equal_nan)
+    else:
+        for name, exp in zip(sym.list_arguments(), expected):
+            if exp is None:
+                continue
+            assert_almost_equal(exe.grad_dict[name], exp, rtol, atol or 1e-20,
+                                names=(f"grad {name}", "expected"), equal_nan=equal_nan)
+    return {k: v.asnumpy() for k, v in exe.grad_dict.items()}
+
+
+def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
+                      arg_params=None, aux_params=None, rtol=1e-4, atol=1e-5,
+                      raise_on_err=True, use_uniform=False):
+    """Cross-context oracle (ref: test_utils.py:1224): run the same symbol on
+    each context (e.g. cpu vs tpu) and cross-check outputs + gradients."""
+    assert len(ctx_list) > 1
+    if isinstance(sym, (list, tuple)):
+        syms = list(sym)
+    else:
+        syms = [sym] * len(ctx_list)
+
+    exe_list = []
+    shapes0 = {k: v for k, v in ctx_list[0].items() if k != "ctx"}
+    arg_values = None
+    for s, spec in zip(syms, ctx_list):
+        ctx = spec["ctx"]
+        shapes = {k: v for k, v in spec.items() if k != "ctx" and not k.endswith("dtype")}
+        type_dict = {k[: -len("_dtype")]: v for k, v in spec.items() if k.endswith("_dtype")}
+        exe = s.simple_bind(ctx=ctx, grad_req=grad_req, type_dict=type_dict, **shapes)
+        if arg_values is None:
+            arg_values = {}
+            for name, arr in exe.arg_dict.items():
+                if use_uniform:
+                    arg_values[name] = np.random.uniform(-0.1, 0.1, arr.shape).astype(np.float32)
+                else:
+                    arg_values[name] = (np.random.randn(*arr.shape) * scale).astype(np.float32)
+            if arg_params:
+                arg_values.update({k: v.asnumpy() if isinstance(v, NDArray) else v for k, v in arg_params.items()})
+        for name, arr in exe.arg_dict.items():
+            arr._data = nd_array(arg_values[name].astype(arr.dtype))._data
+        if aux_params:
+            for name, v in aux_params.items():
+                if name in exe.aux_dict:
+                    exe.aux_dict[name]._data = nd_array(v)._data
+        exe_list.append(exe)
+
+    outputs = []
+    for exe in exe_list:
+        exe.forward(is_train=(grad_req != "null"))
+        if grad_req != "null":
+            exe.backward([nd_array(np.ones(o.shape, dtype=np.float32)) for o in exe.outputs])
+        outputs.append([o.asnumpy() for o in exe.outputs])
+
+    ref = outputs[0]
+    for i, outs in enumerate(outputs[1:], 1):
+        for o_ref, o in zip(ref, outs):
+            assert_almost_equal(o, o_ref, rtol=rtol, atol=atol,
+                                names=(f"ctx[{i}] out", "ctx[0] out"))
+    if grad_req != "null":
+        ref_grads = {k: v.asnumpy() for k, v in exe_list[0].grad_dict.items()}
+        for i, exe in enumerate(exe_list[1:], 1):
+            for k, v in exe.grad_dict.items():
+                assert_almost_equal(v, ref_grads[k], rtol=rtol, atol=atol,
+                                    names=(f"ctx[{i}] grad {k}", "ctx[0] grad"))
+    return outputs
+
+
+def create_2d_tensor(rows, columns, dtype=np.int64):
+    a = np.arange(0, rows).reshape(rows, 1)
+    b = np.broadcast_to(a, shape=(a.shape[0], columns))
+    return nd_array(b.astype(dtype))
+
+
+def rand_coord_2d(x_low, x_high, y_low, y_high):
+    x = np.random.randint(x_low, x_high)
+    y = np.random.randint(y_low, y_high)
+    return x, y

@@ -1,1 +1,71 @@
-"""Placeholder - implemented later this round."""
+"""Network visualization (ref: python/mxnet/visualization.py)."""
+from __future__ import annotations
+
+import json
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
+    """(ref: visualization.py print_summary)"""
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    if shape is not None:
+        _, out_shapes, _ = symbol.get_internals().infer_shape(**shape)
+        shape_dict = dict(zip(symbol.get_internals().list_outputs(), out_shapes))
+    else:
+        shape_dict = {}
+
+    positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(fields, positions):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[: positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+    total_params = 0
+    for node in nodes:
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            continue
+        out_name = f"{name}_output"
+        out_shape = shape_dict.get(out_name, "")
+        pre = [nodes[item[0]]["name"] for item in node["inputs"]]
+        print_row([f"{name} ({op})", out_shape, 0, ",".join(pre[:2])], positions)
+        total_params += 0
+    print("=" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None, dtype=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz plot; returns a Digraph when graphviz is installed."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise ImportError("plot_network requires graphviz") from e
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    dot = Digraph(name=title)
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            if hide_weights and (name.endswith("weight") or name.endswith("bias") or
+                                 name.endswith("gamma") or name.endswith("beta") or
+                                 "moving" in name):
+                continue
+            dot.node(name=name, label=name, shape="oval")
+        else:
+            dot.node(name=name, label=f"{op}\n{name}", shape="box")
+        for item in node["inputs"]:
+            src = nodes[item[0]]["name"]
+            dot.edge(tail_name=src, head_name=name)
+    return dot
