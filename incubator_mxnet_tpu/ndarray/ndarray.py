@@ -509,12 +509,11 @@ def array(source_array, ctx=None, dtype=None):
     """Create an NDArray from any array-like (ref: mx.nd.array)."""
     if isinstance(source_array, NDArray):
         data = source_array._data
+    elif isinstance(source_array, np.ndarray):
+        data = source_array
     else:
-        data = np.asarray(source_array, dtype=dtype_np(dtype) if dtype else None)
-        if data.dtype == np.float64 and dtype is None:
-            data = data.astype(np.float32)
-        if data.dtype == np.int64 and dtype is None and not isinstance(source_array, np.ndarray):
-            pass
+        # python lists/scalars default to float32, as the reference does
+        data = np.asarray(source_array, dtype=dtype_np(dtype) if dtype else np.float32)
     out = NDArray(jnp.asarray(data), ctx=ctx)
     if dtype is not None:
         out = out.astype(dtype)

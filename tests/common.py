@@ -1,0 +1,30 @@
+"""Shared test helpers (ref: tests/python/unittest/common.py)."""
+import functools
+import logging
+import os
+import random
+
+import numpy as np
+
+
+def with_seed(seed=None):
+    """Seed decorator that logs the seed on failure (ref: common.py with_seed)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            import incubator_mxnet_tpu as mx
+
+            this_seed = seed if seed is not None else np.random.randint(0, 2**31)
+            np.random.seed(this_seed)
+            random.seed(this_seed)
+            mx.random.seed(this_seed)
+            try:
+                return fn(*args, **kwargs)
+            except Exception:
+                logging.error("test failed with seed %d", this_seed)
+                raise
+
+        return wrapper
+
+    return deco

@@ -1,0 +1,103 @@
+"""Autograd tests (ref: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_simple_grad():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_chain_grad():
+    x = nd.array(np.random.randn(3, 4).astype("float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x)
+        z = (y * 2).sum()
+    z.backward()
+    assert_almost_equal(x.grad.asnumpy(), 2 * np.exp(x.asnumpy()), rtol=1e-5)
+
+
+def test_multi_path_grad():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + x * 3
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), np.array([7.0]))
+
+
+def test_head_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+    y.backward(nd.array([10.0, 100.0]))
+    assert_almost_equal(x.grad.asnumpy(), np.array([20.0, 200.0]))
+
+
+def test_grad_add_req():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = x * 2
+        y.backward()
+    assert_almost_equal(x.grad.asnumpy(), np.array([6.0]))
+
+
+def test_detach_stop_gradient():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = nd.BlockGrad(y) + x
+    z.backward()
+    assert_almost_equal(x.grad.asnumpy(), np.array([1.0]))
+
+
+def test_is_training_recording():
+    assert not autograd.is_recording()
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+    with autograd.predict_mode():
+        assert not autograd.is_training()
+
+
+def test_grad_function():
+    x = nd.array([1.0, 2.0, 3.0])
+    with autograd.record():
+        y = (x * x).sum()
+    g = autograd.grad(y, x)
+    assert_almost_equal(g.asnumpy(), 2 * x.asnumpy())
+
+
+def test_dropout_training_mode():
+    x = nd.ones((100, 100))
+    with autograd.record(train_mode=True):
+        y = nd.Dropout(x, p=0.5)
+    frac = float((y.asnumpy() == 0).mean())
+    assert 0.3 < frac < 0.7
+    y2 = nd.Dropout(x, p=0.5)  # not recording -> predict mode -> identity
+    assert (y2.asnumpy() == 1).all()
+
+
+def test_mark_variables():
+    x = nd.array([5.0])
+    g = nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = x * 4
+    y.backward()
+    assert_almost_equal(g.asnumpy(), np.array([4.0]))

@@ -1,0 +1,62 @@
+"""Initializer tests (ref: tests/python/unittest/test_init.py)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def test_basic_inits():
+    arr = nd.zeros((100, 100))
+    mx.init.Uniform(0.5)("fc_weight", arr)
+    a = arr.asnumpy()
+    assert -0.5 <= a.min() and a.max() <= 0.5 and abs(a.mean()) < 0.05
+    mx.init.Normal(2.0)("fc_weight", arr)
+    assert 1.5 < arr.asnumpy().std() < 2.5
+    mx.init.Constant(3.0)("fc_weight", arr)
+    assert (arr.asnumpy() == 3.0).all()
+    mx.init.One()("fc_weight", arr)
+    assert (arr.asnumpy() == 1.0).all()
+
+
+def test_name_dispatch():
+    init = mx.init.Xavier()
+    bias = nd.ones((10,))
+    init("fc_bias", bias)
+    assert (bias.asnumpy() == 0).all()
+    gamma = nd.zeros((10,))
+    init("bn_gamma", gamma)
+    assert (gamma.asnumpy() == 1).all()
+    mv = nd.zeros((10,))
+    init("bn_moving_var", mv)
+    assert (mv.asnumpy() == 1).all()
+
+
+def test_xavier_scale():
+    arr = nd.zeros((50, 50))
+    mx.init.Xavier(factor_type="avg", magnitude=3)("w_weight", arr)
+    bound = np.sqrt(3.0 / 50)
+    a = arr.asnumpy()
+    assert a.min() >= -bound - 1e-6 and a.max() <= bound + 1e-6
+
+
+def test_orthogonal():
+    arr = nd.zeros((16, 16))
+    mx.init.Orthogonal(scale=1.0)("q_weight", arr)
+    a = arr.asnumpy()
+    eye = a @ a.T
+    assert np.allclose(eye, np.eye(16), atol=1e-4)
+
+
+def test_lstm_bias():
+    arr = nd.zeros((16,))
+    mx.init.LSTMBias(forget_bias=1.0)("lstm_bias", arr)
+    a = arr.asnumpy()
+    assert (a[4:8] == 1.0).all() and a.sum() == 4.0
+
+
+def test_mixed():
+    init = mx.init.Mixed([".*bias", ".*"], [mx.init.Zero(), mx.init.One()])
+    b = nd.ones((4,)); init("fc_bias", b)
+    assert (b.asnumpy() == 0).all()
+    w = nd.zeros((4,)); init("fc_weight", w)
+    assert (w.asnumpy() == 1).all()

@@ -1,0 +1,61 @@
+"""IO tests (ref: tests/python/unittest/test_io.py)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.io import NDArrayIter, ResizeIter, PrefetchingIter, DataBatch
+
+
+def test_ndarrayiter_basic():
+    X = np.arange(40).reshape(10, 4).astype("float32")
+    y = np.arange(10).astype("float32")
+    it = NDArrayIter(X, y, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (5, 4)
+    assert (batches[0].label[0].asnumpy() == y[:5]).all()
+    it.reset()
+    assert len(list(it)) == 2
+
+
+def test_ndarrayiter_pad():
+    X = np.arange(28).reshape(7, 4).astype("float32")
+    it = NDArrayIter(X, None, batch_size=5, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[1].pad == 3
+    it = NDArrayIter(X, None, batch_size=5, last_batch_handle="discard")
+    assert len(list(it)) == 1
+
+
+def test_ndarrayiter_shuffle():
+    X = np.arange(100).reshape(100, 1).astype("float32")
+    it = NDArrayIter(X, X[:, 0], batch_size=10, shuffle=True)
+    seen = np.concatenate([b.label[0].asnumpy() for b in it])
+    assert sorted(seen.tolist()) == list(range(100))
+
+
+def test_multi_input():
+    it = NDArrayIter(
+        {"a": np.zeros((10, 2), "float32"), "b": np.ones((10, 3), "float32")},
+        {"label": np.zeros(10, "float32")}, batch_size=5,
+    )
+    names = [d.name for d in it.provide_data]
+    assert set(names) == {"a", "b"}
+    b = next(it)
+    assert len(b.data) == 2
+
+
+def test_resize_iter():
+    X = np.zeros((10, 2), "float32")
+    it = ResizeIter(NDArrayIter(X, None, batch_size=5), size=5)
+    assert len(list(it)) == 5
+
+
+def test_prefetching_iter():
+    X = np.arange(20).reshape(10, 2).astype("float32")
+    base = NDArrayIter(X, np.zeros(10, "float32"), batch_size=5)
+    it = PrefetchingIter(base)
+    batches = list(it)
+    assert len(batches) == 2
+    it.reset()
+    assert len(list(it)) == 2

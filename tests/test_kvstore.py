@@ -1,0 +1,75 @@
+"""KVStore tests (ref: tests/python/unittest/test_kvstore.py)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import kvstore, nd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_init_pull():
+    kv = kvstore.create("local")
+    kv.init(3, nd.ones((2, 3)))
+    out = nd.zeros((2, 3))
+    kv.pull(3, out=out)
+    assert (out.asnumpy() == 1).all()
+
+
+def test_push_aggregation():
+    kv = kvstore.create("local")
+    kv.init("w", nd.zeros((2, 2)))
+    kv.set_updater(lambda key, grad, weight: weight.__iadd__(grad))
+    # push list of device grads -> summed
+    kv.push("w", [nd.ones((2, 2)), nd.ones((2, 2)) * 2])
+    out = nd.zeros((2, 2))
+    kv.pull("w", out=out)
+    assert (out.asnumpy() == 3).all()
+
+
+def test_updater_sgd_semantics():
+    from incubator_mxnet_tpu import optimizer as opt
+
+    kv = kvstore.create("device")
+    kv.set_optimizer(opt.SGD(learning_rate=0.1, rescale_grad=1.0))
+    kv.init("w", nd.ones((3,)))
+    kv.push("w", nd.ones((3,)))
+    out = nd.zeros((3,))
+    kv.pull("w", out=out)
+    assert_almost_equal(out.asnumpy(), np.full(3, 0.9), rtol=1e-6)
+
+
+def test_list_keys():
+    kv = kvstore.create("local")
+    keys = ["a", "b"]
+    kv.init(keys, [nd.ones((2,)), nd.ones((3,))])
+    outs = [nd.zeros((2,)), nd.zeros((3,))]
+    kv.pull(keys, out=outs)
+    assert outs[0].shape == (2,) and (outs[1].asnumpy() == 1).all()
+
+
+def test_row_sparse_pull():
+    kv = kvstore.create("local")
+    kv.init("emb", nd.array(np.arange(12).reshape(4, 3).astype("float32")))
+    from incubator_mxnet_tpu.ndarray import sparse
+
+    out = sparse.zeros("row_sparse", (4, 3))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array([1, 3]))
+    dense = out.todense().asnumpy()
+    assert (dense[1] == [3, 4, 5]).all() and (dense[3] == [9, 10, 11]).all()
+    assert (dense[0] == 0).all()
+
+
+def test_gradient_compression_threshold():
+    kv = kvstore.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("w", nd.zeros((4,)))
+    kv.push("w", nd.array([1.0, -1.0, 0.1, -0.1]))
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    assert_almost_equal(out.asnumpy(), np.array([0.5, -0.5, 0.0, 0.0]), rtol=1e-6)
+
+
+def test_type_and_rank():
+    kv = kvstore.create("dist_sync")
+    assert kv.rank == 0 and kv.num_workers == 1
+    assert "dist" in kv.type
+    kv.barrier()

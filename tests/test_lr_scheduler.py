@@ -1,0 +1,35 @@
+"""LR scheduler tests."""
+import math
+
+from incubator_mxnet_tpu.lr_scheduler import (
+    FactorScheduler, MultiFactorScheduler, PolyScheduler, CosineScheduler,
+)
+
+
+def test_factor():
+    s = FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == 1.0
+    assert s(11) == 0.5
+    assert s(21) == 0.25
+
+
+def test_multifactor():
+    s = MultiFactorScheduler(step=[5, 10], factor=0.1, base_lr=1.0)
+    assert s(1) == 1.0
+    assert abs(s(6) - 0.1) < 1e-9
+    assert abs(s(11) - 0.01) < 1e-9
+
+
+def test_poly():
+    s = PolyScheduler(max_update=100, base_lr=1.0, pwr=2)
+    assert s(0) == 1.0
+    assert s(100) == 0.0
+    assert 0 < s(50) < 1
+
+
+def test_cosine_warmup():
+    s = CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.0, warmup_steps=10)
+    assert s(5) < 1.0  # warming up
+    assert abs(s(10) - 1.0) < 0.1
+    assert s(100) == 0.0
+    assert abs(s(55) - (1 + math.cos(math.pi * 0.5)) / 2) < 0.1

@@ -1,0 +1,72 @@
+"""Metric tests (ref: tests/python/unittest/test_metric.py)."""
+import math
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import metric, nd
+
+
+def test_accuracy():
+    m = metric.Accuracy()
+    pred = nd.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    label = nd.array([1.0, 0.0, 0.0])
+    m.update([label], [pred])
+    assert m.get()[1] == 2.0 / 3
+
+
+def test_topk():
+    m = metric.TopKAccuracy(top_k=2)
+    pred = nd.array([[0.1, 0.2, 0.7], [0.8, 0.05, 0.15]])
+    label = nd.array([1.0, 1.0])
+    m.update([label], [pred])
+    assert m.get()[1] == 0.5  # row0 top2={2,1} hit; row1 top2={0,2} miss
+
+
+def test_mse_mae_rmse():
+    pred = nd.array([1.0, 2.0])
+    label = nd.array([2.0, 2.0])
+    m = metric.MSE(); m.update([label], [pred])
+    assert abs(m.get()[1] - 0.5) < 1e-6
+    m = metric.MAE(); m.update([label], [pred])
+    assert abs(m.get()[1] - 0.5) < 1e-6
+    m = metric.RMSE(); m.update([label], [pred])
+    assert abs(m.get()[1] - math.sqrt(0.5)) < 1e-6
+
+
+def test_perplexity_uniform():
+    C = 4
+    pred = nd.array(np.full((10, C), 1.0 / C, dtype="float32"))
+    label = nd.array(np.zeros(10, dtype="float32"))
+    m = metric.Perplexity()
+    m.update([label], [pred])
+    assert abs(m.get()[1] - C) < 1e-3
+
+
+def test_f1():
+    m = metric.F1()
+    pred = nd.array([[0.2, 0.8], [0.8, 0.2], [0.3, 0.7]])
+    label = nd.array([1.0, 0.0, 0.0])
+    m.update([label], [pred])
+    # tp=1 fp=1 fn=0 -> p=0.5 r=1 f1=2/3
+    assert abs(m.get()[1] - 2.0 / 3) < 1e-6
+
+
+def test_composite_and_create():
+    m = metric.create(["acc", "ce"])
+    pred = nd.array([[0.3, 0.7]])
+    label = nd.array([1.0])
+    m.update([label], [pred])
+    names, values = m.get()
+    assert "accuracy" in names and "cross-entropy" in names
+    m2 = metric.create("top_k_accuracy", top_k=3)
+    assert m2.top_k == 3
+
+
+def test_custom_metric():
+    def feval(label, pred):
+        return float(np.sum(label == 1))
+
+    m = metric.np(feval, name="ones")
+    m.update([nd.array([1.0, 1.0, 0.0])], [nd.array([0.0, 0.0, 0.0])])
+    assert m.get()[1] == 2.0

@@ -1,0 +1,131 @@
+"""Module tests (ref: tests/python/unittest/test_module.py, train tests)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, sym
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _make_data(n=600, d=10, c=3, seed=0):
+    rng = np.random.RandomState(seed)
+    W = rng.randn(d, c)
+    X = rng.randn(n, d).astype("float32")
+    y = np.argmax(X @ W, axis=1).astype("float32")
+    return X, y
+
+
+def _mlp_sym(c=3):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=c, name="fc2")
+    return sym.SoftmaxOutput(net, sym.Variable("softmax_label"), name="softmax")
+
+
+def test_module_fit_converges():
+    X, y = _make_data()
+    train = mx.io.NDArrayIter(X[:500], y[:500], batch_size=50, shuffle=True)
+    val = mx.io.NDArrayIter(X[500:], y[500:], batch_size=50)
+    mod = mx.module.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Xavier(), num_epoch=8, eval_metric="acc")
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.9, f"val acc {score}"
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    X, y = _make_data(n=200)
+    train = mx.io.NDArrayIter(X, y, batch_size=20)
+    mod = mx.module.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd", num_epoch=2, initializer=mx.init.Xavier())
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 2)
+    mod2 = mx.module.Module.load(prefix, 2)
+    mod2.bind(train.provide_data, train.provide_label, for_training=False)
+    s1 = mod.score(train, "acc")[0][1]
+    s2 = mod2.score(train, "acc")[0][1]
+    assert abs(s1 - s2) < 1e-6
+
+
+def test_module_predict():
+    X, y = _make_data(n=100)
+    it = mx.io.NDArrayIter(X, y, batch_size=25)
+    mod = mx.module.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label, for_training=False)
+    mod.init_params(mx.init.Xavier())
+    out = mod.predict(it)
+    assert out.shape == (100, 3)
+    assert_almost_equal(out.asnumpy().sum(-1), np.ones(100), rtol=1e-5)
+
+
+def test_module_input_grads():
+    X, y = _make_data(n=20)
+    it = mx.io.NDArrayIter(X, y, batch_size=20)
+    mod = mx.module.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label, for_training=True, inputs_need_grad=True)
+    mod.init_params(mx.init.Xavier())
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    grads = mod.get_input_grads()
+    assert grads[0].shape == (20, 10)
+    assert float(np.abs(grads[0].asnumpy()).sum()) > 0
+
+
+def test_module_kvstore_device():
+    X, y = _make_data(n=200)
+    train = mx.io.NDArrayIter(X, y, batch_size=20)
+    mod = mx.module.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd", kvstore="device", num_epoch=2,
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.1})
+    assert mod.score(train, "acc")[0][1] > 0.5
+
+
+def test_module_optimizer_state_checkpoint(tmp_path):
+    X, y = _make_data(n=100)
+    train = mx.io.NDArrayIter(X, y, batch_size=20)
+    mod = mx.module.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, optimizer="adam", num_epoch=1, initializer=mx.init.Xavier())
+    f = str(tmp_path / "opt.states")
+    mod.save_optimizer_states(f)
+    mod.load_optimizer_states(f)
+
+
+def test_bucketing_module():
+    # variable-length sequences, shared params (ref: test_bucketing.py)
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        label = sym.Variable("softmax_label")
+        net = sym.FullyConnected(data, num_hidden=8, name="fc_shared", flatten=False)
+        net = sym.sum(net, axis=1)
+        net = sym.FullyConnected(net, num_hidden=2, name="out_shared")
+        return sym.SoftmaxOutput(net, label, name="softmax"), ("data",), ("softmax_label",)
+
+    mod = mx.module.BucketingModule(sym_gen, default_bucket_key=8, context=mx.cpu())
+    from incubator_mxnet_tpu.io import DataBatch, DataDesc
+
+    def make_batch(seq_len, bs=8):
+        X = np.random.randn(bs, seq_len, 4).astype("float32")
+        y = (X.sum(axis=(1, 2)) > 0).astype("float32")
+        return DataBatch(
+            data=[nd.array(X)], label=[nd.array(y)], bucket_key=seq_len,
+            provide_data=[DataDesc("data", (bs, seq_len, 4))],
+            provide_label=[DataDesc("softmax_label", (bs,))],
+        )
+
+    mod.bind([DataDesc("data", (8, 8, 4))], [DataDesc("softmax_label", (8,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.1})
+    for seq_len in (8, 4, 6, 8, 4):
+        b = make_batch(seq_len)
+        mod.forward(b, is_train=True)
+        mod.backward()
+        mod.update()
+    assert set(mod._buckets.keys()) == {8, 4, 6}
+    # params shared across buckets
+    w8 = mod._buckets[8]._exec.arg_dict["fc_shared_weight"].asnumpy()
+    w4 = mod._buckets[4]._exec.arg_dict["fc_shared_weight"].asnumpy()
+    assert_almost_equal(w8, w4)

@@ -1,0 +1,159 @@
+"""NDArray tests (ref: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = nd.zeros((3, 4))
+    assert a.shape == (3, 4) and a.asnumpy().sum() == 0
+    b = nd.ones((2, 2), dtype="float32")
+    assert b.asnumpy().sum() == 4
+    c = nd.full((2, 2), 7)
+    assert (c.asnumpy() == 7).all()
+    d = nd.array([[1, 2], [3, 4]])
+    assert d.dtype == np.float32
+    e = nd.arange(0, 10, 2)
+    assert (e.asnumpy() == np.arange(0, 10, 2)).all()
+    f = nd.eye(3)
+    assert (f.asnumpy() == np.eye(3)).all()
+
+
+def test_arith():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    y = nd.array([[5.0, 6.0], [7.0, 8.0]])
+    assert_almost_equal((x + y).asnumpy(), np.array([[6, 8], [10, 12]]))
+    assert_almost_equal((x - y).asnumpy(), -np.array([[4, 4], [4, 4]]))
+    assert_almost_equal((x * 2 + 1).asnumpy(), np.array([[3, 5], [7, 9]]))
+    assert_almost_equal((y / x).asnumpy(), np.array([[5, 3], [7 / 3, 2]]))
+    assert_almost_equal((x ** 2).asnumpy(), np.array([[1, 4], [9, 16]]))
+    assert_almost_equal((-x).asnumpy(), -x.asnumpy())
+    assert_almost_equal((2 - x).asnumpy(), 2 - x.asnumpy())
+    assert_almost_equal((2 / x).asnumpy(), 2 / x.asnumpy())
+
+
+def test_inplace():
+    x = nd.ones((2, 2))
+    x += 1
+    assert (x.asnumpy() == 2).all()
+    x *= 3
+    assert (x.asnumpy() == 6).all()
+    x /= 2
+    assert (x.asnumpy() == 3).all()
+
+
+def test_indexing():
+    x = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert x[0].shape == (3, 4)
+    assert x[0, 1].shape == (4,)
+    assert float(x[1, 2, 3].asscalar()) == 23
+    assert x[:, 1:3].shape == (2, 2, 4)
+    x[0] = 0
+    assert x.asnumpy()[0].sum() == 0
+    idx = nd.array([0, 1])
+    assert x[idx].shape == (2, 3, 4)
+
+
+def test_reshape_transpose():
+    x = nd.array(np.arange(24))
+    y = x.reshape(2, 3, 4)
+    assert y.shape == (2, 3, 4)
+    z = y.transpose()
+    assert z.shape == (4, 3, 2)
+    assert y.transpose((1, 0, 2)).shape == (3, 2, 4)
+    assert y.flatten().shape == (2, 12)
+    assert nd.Reshape(y, shape=(0, -1)).shape == (2, 12)
+    assert nd.Reshape(y, shape=(-3, 4)).shape == (6, 4)
+    assert y.swapaxes(0, 2).shape == (4, 3, 2)
+    assert y.expand_dims(0).shape == (1, 2, 3, 4)
+
+
+def test_reduce():
+    x = nd.array(np.arange(12).reshape(3, 4))
+    assert float(x.sum().asscalar()) == 66
+    assert x.sum(axis=0).shape == (4,)
+    assert x.sum(axis=1, keepdims=True).shape == (3, 1)
+    assert float(x.max().asscalar()) == 11
+    assert float(x.min().asscalar()) == 0
+    assert abs(float(x.mean().asscalar()) - 5.5) < 1e-6
+    assert float(nd.sum(x, axis=0, exclude=True).asnumpy()[0]) == 6
+
+
+def test_dot():
+    a = np.random.randn(4, 5).astype("float32")
+    b = np.random.randn(5, 6).astype("float32")
+    assert_almost_equal(nd.dot(nd.array(a), nd.array(b)).asnumpy(), a @ b, rtol=1e-5)
+    bt = np.random.randn(6, 5).astype("float32")
+    assert_almost_equal(
+        nd.dot(nd.array(a), nd.array(bt), transpose_b=True).asnumpy(), a @ bt.T, rtol=1e-5
+    )
+    x = np.random.randn(3, 4, 5).astype("float32")
+    y = np.random.randn(3, 5, 2).astype("float32")
+    assert_almost_equal(nd.batch_dot(nd.array(x), nd.array(y)).asnumpy(), x @ y, rtol=1e-5)
+
+
+def test_concat_split_stack():
+    x = nd.ones((2, 3))
+    y = nd.zeros((2, 3))
+    c = nd.concat(x, y, dim=1)
+    assert c.shape == (2, 6)
+    parts = nd.split(c, num_outputs=2, axis=1)
+    assert parts[0].shape == (2, 3)
+    assert (parts[0].asnumpy() == 1).all()
+    s = nd.stack(x, y, axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrs.params")
+    d = {"w": nd.array(np.random.randn(3, 4)), "b": nd.array(np.random.randn(4))}
+    nd.save(fname, d)
+    loaded = nd.load(fname)
+    assert set(loaded.keys()) == {"w", "b"}
+    assert_almost_equal(loaded["w"].asnumpy(), d["w"].asnumpy())
+    lst = [nd.ones((2,)), nd.zeros((3,))]
+    nd.save(fname, lst)
+    loaded = nd.load(fname)
+    assert len(loaded) == 2 and loaded[0].shape == (2,)
+
+
+def test_astype_copy():
+    x = nd.array([1.5, 2.5])
+    y = x.astype("int32")
+    assert y.dtype == np.int32
+    z = x.copy()
+    z += 1
+    assert float(x.asnumpy()[0]) == 1.5
+
+
+def test_topk_sort():
+    x = nd.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    ids = nd.topk(x, k=2)
+    assert ids.shape == (2, 2)
+    assert ids.asnumpy()[0, 0] == 0
+    vals = nd.topk(x, k=1, ret_typ="value")
+    assert_almost_equal(vals.asnumpy(), np.array([[3.0], [5.0]]))
+    s = nd.sort(x, axis=-1)
+    assert_almost_equal(s.asnumpy(), np.sort(x.asnumpy(), axis=-1))
+
+
+def test_take_onehot_where():
+    w = nd.array(np.arange(12).reshape(4, 3))
+    idx = nd.array([0, 2])
+    assert_almost_equal(nd.take(w, idx).asnumpy(), w.asnumpy()[[0, 2]])
+    oh = nd.one_hot(nd.array([0, 2]), depth=3)
+    assert_almost_equal(oh.asnumpy(), np.eye(3)[[0, 2]])
+    cond = nd.array([1.0, 0.0])
+    a, b = nd.ones((2,)), nd.zeros((2,))
+    assert_almost_equal(nd.where(cond, a, b).asnumpy(), np.array([1.0, 0.0]))
+
+
+def test_wait_sync():
+    x = nd.ones((10, 10))
+    y = nd.dot(x, x)
+    y.wait_to_read()
+    nd.waitall()
+    assert y.asnumpy()[0, 0] == 10

@@ -1,0 +1,332 @@
+"""Operator numeric tests (ref: tests/python/unittest/test_operator.py).
+
+Covers the op families numerically against numpy references, plus
+finite-difference gradient checks for key layers.
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, sym, autograd
+from incubator_mxnet_tpu.test_utils import (
+    assert_almost_equal, check_numeric_gradient, check_symbolic_forward,
+)
+
+
+def test_elemwise_unary():
+    x = np.random.rand(3, 4).astype("float32") + 0.5
+    a = nd.array(x)
+    assert_almost_equal(nd.sqrt(a).asnumpy(), np.sqrt(x), rtol=1e-5)
+    assert_almost_equal(nd.exp(a).asnumpy(), np.exp(x), rtol=1e-5)
+    assert_almost_equal(nd.log(a).asnumpy(), np.log(x), rtol=1e-5)
+    assert_almost_equal(nd.square(a).asnumpy(), x * x, rtol=1e-5)
+    assert_almost_equal(nd.rsqrt(a).asnumpy(), 1 / np.sqrt(x), rtol=1e-5)
+    assert_almost_equal(nd.sigmoid(a).asnumpy(), 1 / (1 + np.exp(-x)), rtol=1e-5)
+    assert_almost_equal(nd.tanh(a).asnumpy(), np.tanh(x), rtol=1e-5)
+    assert_almost_equal(nd.relu(nd.array(x - 1)).asnumpy(), np.maximum(x - 1, 0), rtol=1e-5)
+
+
+def test_broadcast_binary():
+    a = np.random.randn(3, 1, 4).astype("float32")
+    b = np.random.randn(1, 5, 4).astype("float32")
+    assert_almost_equal(nd.broadcast_add(nd.array(a), nd.array(b)).asnumpy(), a + b, rtol=1e-6)
+    assert_almost_equal(nd.broadcast_mul(nd.array(a), nd.array(b)).asnumpy(), a * b, rtol=1e-6)
+    assert_almost_equal(nd.broadcast_maximum(nd.array(a), nd.array(b)).asnumpy(), np.maximum(a, b))
+    assert_almost_equal(
+        nd.broadcast_greater(nd.array(a), nd.array(b)).asnumpy(), (a > b).astype("float32")
+    )
+
+
+def test_fully_connected():
+    x = np.random.randn(4, 10).astype("float32")
+    w = np.random.randn(6, 10).astype("float32")
+    b = np.random.randn(6).astype("float32")
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b), num_hidden=6)
+    assert_almost_equal(out.asnumpy(), x @ w.T + b, rtol=1e-4)
+    out = nd.FullyConnected(nd.array(x), nd.array(w), no_bias=True, num_hidden=6)
+    assert_almost_equal(out.asnumpy(), x @ w.T, rtol=1e-4)
+
+
+def test_convolution_vs_naive():
+    x = np.random.randn(2, 3, 5, 5).astype("float32")
+    w = np.random.randn(4, 3, 3, 3).astype("float32")
+    out = nd.Convolution(nd.array(x), nd.array(w), no_bias=True,
+                         kernel=(3, 3), num_filter=4).asnumpy()
+    # naive correlation
+    ref = np.zeros((2, 4, 3, 3), dtype="float32")
+    for n in range(2):
+        for f in range(4):
+            for i in range(3):
+                for j in range(3):
+                    ref[n, f, i, j] = (x[n, :, i:i+3, j:j+3] * w[f]).sum()
+    assert_almost_equal(out, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_convolution_grouped_dilated():
+    x = nd.array(np.random.randn(1, 4, 8, 8).astype("float32"))
+    w = nd.array(np.random.randn(8, 2, 3, 3).astype("float32"))
+    out = nd.Convolution(x, w, no_bias=True, kernel=(3, 3), num_filter=8, num_group=2,
+                         pad=(1, 1), stride=(2, 2))
+    assert out.shape == (1, 8, 4, 4)
+    out2 = nd.Convolution(x, nd.array(np.random.randn(8, 4, 3, 3).astype("float32")),
+                          no_bias=True, kernel=(3, 3), num_filter=8, dilate=(2, 2))
+    assert out2.shape == (1, 8, 4, 4)
+
+
+def test_deconvolution_shape():
+    x = nd.array(np.random.randn(1, 4, 5, 5).astype("float32"))
+    w = nd.array(np.random.randn(4, 6, 3, 3).astype("float32"))
+    out = nd.Deconvolution(x, w, kernel=(3, 3), num_filter=6, stride=(2, 2), pad=(1, 1), adj=(1, 1))
+    assert out.shape == (1, 6, 10, 10)
+    # deconv is adjoint of conv: <conv(x), y> == <x, deconv(y)>
+    xc = np.random.randn(1, 4, 8, 8).astype("float32")
+    wc = np.random.randn(6, 4, 3, 3).astype("float32")  # conv weight (O,I,kh,kw)
+    y = np.random.randn(1, 6, 6, 6).astype("float32")
+    conv_x = nd.Convolution(nd.array(xc), nd.array(wc), no_bias=True, kernel=(3, 3), num_filter=6).asnumpy()
+    # deconv weight layout (I=6->out 4): transpose conv weight to (O=6? ...)
+    deconv_y = nd.Deconvolution(nd.array(y), nd.array(wc.transpose(0, 1, 2, 3)), no_bias=True,
+                                kernel=(3, 3), num_filter=4).asnumpy()
+    assert_almost_equal(np.sum(conv_x * y), np.sum(xc * deconv_y), rtol=1e-3)
+
+
+def test_pooling():
+    x = np.random.randn(1, 2, 4, 4).astype("float32")
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="max").asnumpy()
+    ref = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+    assert_almost_equal(out, ref)
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="avg").asnumpy()
+    ref = x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+    assert_almost_equal(out, ref, rtol=1e-6)
+    out = nd.Pooling(nd.array(x), global_pool=True, pool_type="max", kernel=(1, 1)).asnumpy()
+    assert_almost_equal(out, x.max(axis=(2, 3), keepdims=True))
+
+
+def test_batchnorm():
+    x = np.random.randn(8, 4, 3, 3).astype("float32")
+    gamma = np.random.rand(4).astype("float32") + 0.5
+    beta = np.random.randn(4).astype("float32")
+    mm = np.zeros(4, "float32")
+    mv = np.ones(4, "float32")
+    # inference: use global stats
+    out = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta), nd.array(mm),
+                       nd.array(mv), fix_gamma=False, eps=1e-5).asnumpy()
+    ref = x / np.sqrt(1 + 1e-5) * gamma.reshape(1, -1, 1, 1) + beta.reshape(1, -1, 1, 1)
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+    # training: batch stats + aux update
+    mmv = nd.array(mm)
+    mvv = nd.array(mv)
+    with autograd.record():
+        out = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta), mmv, mvv,
+                           fix_gamma=False, momentum=0.9, eps=1e-5)
+    o = out.asnumpy()
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    ref = (x - mean.reshape(1, -1, 1, 1)) / np.sqrt(var.reshape(1, -1, 1, 1) + 1e-5)
+    ref = ref * gamma.reshape(1, -1, 1, 1) + beta.reshape(1, -1, 1, 1)
+    assert_almost_equal(o, ref, rtol=1e-3, atol=1e-4)
+    assert_almost_equal(mmv.asnumpy(), 0.9 * mm + 0.1 * mean, rtol=1e-4)
+    assert_almost_equal(mvv.asnumpy(), 0.9 * mv + 0.1 * var, rtol=1e-4)
+
+
+def test_layernorm():
+    x = np.random.randn(4, 10).astype("float32")
+    g = np.random.rand(10).astype("float32")
+    b = np.random.randn(10).astype("float32")
+    out = nd.LayerNorm(nd.array(x), nd.array(g), nd.array(b), eps=1e-5).asnumpy()
+    mu = x.mean(-1, keepdims=True)
+    sd = np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+    assert_almost_equal(out, (x - mu) / sd * g + b, rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_family():
+    x = np.random.randn(3, 5).astype("float32")
+    p = nd.softmax(nd.array(x)).asnumpy()
+    e = np.exp(x - x.max(-1, keepdims=True))
+    assert_almost_equal(p, e / e.sum(-1, keepdims=True), rtol=1e-5)
+    lp = nd.log_softmax(nd.array(x)).asnumpy()
+    assert_almost_equal(lp, np.log(e / e.sum(-1, keepdims=True)), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(p.sum(-1), np.ones(3), rtol=1e-5)
+
+
+def test_softmax_output_grad():
+    # SoftmaxOutput backward = p - onehot (ref: softmax_output-inl.h)
+    x = nd.array(np.random.randn(4, 3).astype("float32"))
+    label = nd.array(np.array([0, 1, 2, 1], dtype="float32"))
+    x.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(x, label)
+    out.backward()
+    p = out.asnumpy()
+    onehot = np.eye(3, dtype="float32")[[0, 1, 2, 1]]
+    assert_almost_equal(x.grad.asnumpy(), p - onehot, rtol=1e-5)
+
+
+def test_activation_leakyrelu():
+    x = np.random.randn(3, 4).astype("float32")
+    assert_almost_equal(nd.Activation(nd.array(x), act_type="softrelu").asnumpy(),
+                        np.log1p(np.exp(x)), rtol=1e-4)
+    out = nd.LeakyReLU(nd.array(x), act_type="leaky", slope=0.1).asnumpy()
+    assert_almost_equal(out, np.where(x > 0, x, 0.1 * x), rtol=1e-5)
+    out = nd.LeakyReLU(nd.array(x), act_type="elu", slope=1.0).asnumpy()
+    assert_almost_equal(out, np.where(x > 0, x, np.expm1(x)), rtol=1e-5)
+    g = np.full((4,), 0.2, "float32")
+    out = nd.LeakyReLU(nd.array(x), nd.array(g), act_type="prelu").asnumpy()
+    assert_almost_equal(out, np.where(x > 0, x, 0.2 * x), rtol=1e-5)
+
+
+def test_embedding():
+    w = np.random.randn(10, 4).astype("float32")
+    idx = np.array([[1, 2], [3, 4]], dtype="float32")
+    out = nd.Embedding(nd.array(idx), nd.array(w), input_dim=10, output_dim=4)
+    assert out.shape == (2, 2, 4)
+    assert_almost_equal(out.asnumpy(), w[idx.astype("int32")])
+
+
+def test_sequence_ops():
+    x = np.random.randn(4, 2, 3).astype("float32")  # (T, B, D)
+    lens = np.array([2, 4], dtype="float32")
+    out = nd.SequenceMask(nd.array(x), nd.array(lens), use_sequence_length=True, value=-1.0)
+    o = out.asnumpy()
+    assert (o[2:, 0] == -1).all() and (o[:, 1] == x[:, 1]).all()
+    last = nd.SequenceLast(nd.array(x), nd.array(lens), use_sequence_length=True)
+    assert_almost_equal(last.asnumpy(), np.stack([x[1, 0], x[3, 1]]))
+    rev = nd.SequenceReverse(nd.array(x), nd.array(lens), use_sequence_length=True)
+    r = rev.asnumpy()
+    assert_almost_equal(r[0, 0], x[1, 0])
+    assert_almost_equal(r[1, 0], x[0, 0])
+    assert_almost_equal(r[2, 0], x[2, 0])
+    assert_almost_equal(r[0, 1], x[3, 1])
+
+
+def test_rnn_lstm_shapes_and_grad():
+    from incubator_mxnet_tpu.ops.nn import rnn_param_size
+
+    T, B, I, H, L = 5, 3, 4, 6, 2
+    psize = rnn_param_size(L, I, H, False, "lstm")
+    x = nd.array(np.random.randn(T, B, I).astype("float32") * 0.1)
+    params = nd.array(np.random.randn(psize).astype("float32") * 0.1)
+    h0 = nd.zeros((L, B, H))
+    c0 = nd.zeros((L, B, H))
+    params.attach_grad()
+    with autograd.record():
+        out, hN, cN = nd.RNN(x, params, h0, c0, state_size=H, num_layers=L,
+                             mode="lstm", state_outputs=True)
+        loss = out.sum()
+    loss.backward()
+    assert out.shape == (T, B, H)
+    assert hN.shape == (L, B, H) and cN.shape == (L, B, H)
+    assert float(np.abs(params.grad.asnumpy()).sum()) > 0
+
+
+def test_rnn_bidirectional_gru():
+    from incubator_mxnet_tpu.ops.nn import rnn_param_size
+
+    T, B, I, H = 4, 2, 3, 5
+    psize = rnn_param_size(1, I, H, True, "gru")
+    x = nd.array(np.random.randn(T, B, I).astype("float32"))
+    params = nd.array(np.random.randn(psize).astype("float32") * 0.1)
+    h0 = nd.zeros((2, B, H))
+    out = nd.RNN(x, params, h0, state_size=H, num_layers=1, mode="gru", bidirectional=True)
+    assert out.shape == (T, B, 2 * H)
+
+
+def test_fc_numeric_gradient():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=3, name="fc")
+    check_numeric_gradient(
+        fc,
+        {"data": np.random.randn(2, 4).astype("float32"),
+         "fc_weight": np.random.randn(3, 4).astype("float32"),
+         "fc_bias": np.random.randn(3).astype("float32")},
+        numeric_eps=1e-2, rtol=0.05,
+    )
+
+
+def test_conv_numeric_gradient():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data, kernel=(2, 2), num_filter=2, name="c")
+    check_numeric_gradient(
+        conv,
+        {"data": np.random.randn(1, 2, 4, 4).astype("float32"),
+         "c_weight": np.random.randn(2, 2, 2, 2).astype("float32"),
+         "c_bias": np.random.randn(2).astype("float32")},
+        numeric_eps=1e-2, rtol=0.05,
+    )
+
+
+def test_check_symbolic_forward():
+    x = sym.Variable("x")
+    y = sym.sqrt(x)
+    inp = np.abs(np.random.randn(3, 3)).astype("float32") + 1
+    check_symbolic_forward(y, {"x": inp}, [np.sqrt(inp)], rtol=1e-4)
+
+
+def test_linalg_ops():
+    a = np.random.randn(4, 4).astype("float32")
+    spd = a @ a.T + 4 * np.eye(4, dtype="float32")
+    L = nd.linalg.potrf(nd.array(spd)).asnumpy()
+    assert_almost_equal(L @ L.T, spd, rtol=1e-3, atol=1e-3)
+    g = nd.linalg.gemm2(nd.array(a), nd.array(a), transpose_b=True).asnumpy()
+    assert_almost_equal(g, a @ a.T, rtol=1e-4, atol=1e-4)
+    s = nd.linalg.sumlogdiag(nd.array(spd)).asnumpy()
+    assert_almost_equal(s, np.log(np.diag(spd)).sum(), rtol=1e-5)
+
+
+def test_ctc_loss():
+    T, B, C = 10, 2, 5
+    x = np.random.randn(T, B, C).astype("float32")
+    labels = np.array([[1, 2, 0, 0], [2, 3, 4, 0]], dtype="float32")
+    loss = nd.CTCLoss(nd.array(x), nd.array(labels))
+    assert loss.shape == (B,)
+    assert (loss.asnumpy() > 0).all()
+
+
+def test_pick_gather_scatter():
+    x = np.random.randn(3, 4).astype("float32")
+    idx = np.array([0, 2, 1], dtype="float32")
+    out = nd.pick(nd.array(x), nd.array(idx))
+    assert_almost_equal(out.asnumpy(), x[np.arange(3), idx.astype(int)])
+    # gather_nd: indices[j, :] is the j-th coordinate axis (ref: indexing_op.h)
+    data = nd.array(np.arange(12).reshape(3, 4).astype("float32"))
+    indices = nd.array(np.array([[0, 2], [1, 3]], dtype="float32"))
+    g = nd.gather_nd(data, indices)
+    assert_almost_equal(g.asnumpy(), np.array([1.0, 11.0]))
+    s = nd.scatter_nd(g, indices, shape=(3, 4))
+    assert s.asnumpy()[0, 1] == 1.0 and s.asnumpy()[2, 3] == 11.0
+
+
+def test_random_ops():
+    u = nd.random.uniform(0, 1, shape=(1000,))
+    assert 0.4 < float(u.asnumpy().mean()) < 0.6
+    n = nd.random.normal(2.0, 0.5, shape=(2000,))
+    assert 1.8 < float(n.asnumpy().mean()) < 2.2
+    r = nd.random.randint(0, 10, shape=(100,))
+    assert r.asnumpy().min() >= 0 and r.asnumpy().max() < 10
+    p = nd.random.poisson(4.0, shape=(2000,))
+    assert 3.5 < float(p.asnumpy().mean()) < 4.5
+    # reproducibility
+    mx.random.seed(7)
+    a = nd.random.uniform(shape=(5,)).asnumpy()
+    mx.random.seed(7)
+    b = nd.random.uniform(shape=(5,)).asnumpy()
+    assert_almost_equal(a, b)
+
+
+def test_dropout_axes_lrn_l2norm():
+    x = np.abs(np.random.randn(2, 4, 5, 5)).astype("float32")
+    out = nd.LRN(nd.array(x), nsize=3).asnumpy()
+    assert out.shape == x.shape
+    l2 = nd.L2Normalization(nd.array(x), mode="instance").asnumpy()
+    flat = x.reshape(2, -1)
+    ref = (flat / np.sqrt((flat ** 2).sum(-1, keepdims=True) + 1e-10)).reshape(x.shape)
+    assert_almost_equal(l2, ref, rtol=1e-4)
+
+
+def test_upsampling_pad():
+    x = np.random.randn(1, 2, 3, 3).astype("float32")
+    up = nd.UpSampling(nd.array(x), scale=2, sample_type="nearest")
+    assert up.shape == (1, 2, 6, 6)
+    assert_almost_equal(up.asnumpy()[0, 0, :2, :2], np.full((2, 2), x[0, 0, 0, 0]), rtol=1e-6)
+    p = nd.pad(nd.array(x), mode="constant", pad_width=(0, 0, 0, 0, 1, 1, 1, 1), constant_value=5)
+    assert p.shape == (1, 2, 5, 5)
+    assert p.asnumpy()[0, 0, 0, 0] == 5

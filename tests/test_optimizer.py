@@ -1,0 +1,104 @@
+"""Optimizer tests (ref: tests/python/unittest/test_optimizer.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu import optimizer as opt
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+ALL_OPTS = ["sgd", "nag", "sgld", "signum", "ftml", "dcasgd", "lbsgd", "adam",
+            "adagrad", "rmsprop", "adadelta", "ftrl", "adamax", "nadam", "adamw"]
+
+
+def test_sgd_matches_manual():
+    w = nd.array([1.0, 2.0, 3.0])
+    g = nd.array([0.1, 0.2, 0.3])
+    o = opt.SGD(learning_rate=0.1, rescale_grad=1.0, wd=0.0)
+    state = o.create_state(0, w)
+    o.update(0, w, g, state)
+    assert_almost_equal(w.asnumpy(), np.array([1.0, 2.0, 3.0]) - 0.1 * np.array([0.1, 0.2, 0.3]),
+                        rtol=1e-6)
+
+
+def test_sgd_momentum():
+    w = nd.array([1.0])
+    g = nd.array([1.0])
+    o = opt.SGD(learning_rate=0.1, momentum=0.9, rescale_grad=1.0)
+    state = o.create_state(0, w)
+    o.update(0, w, g, state)   # mom = -0.1, w = 0.9
+    o.update(0, w, g, state)   # mom = -0.09-0.1=-0.19, w = 0.71
+    assert_almost_equal(w.asnumpy(), np.array([0.71]), rtol=1e-5)
+
+
+def test_adam_direction():
+    w = nd.array(np.ones(5, dtype="float32"))
+    g = nd.array(np.full(5, 0.5, dtype="float32"))
+    o = opt.Adam(learning_rate=0.01, rescale_grad=1.0)
+    state = o.create_state(0, w)
+    for _ in range(3):
+        o.update(0, w, g, state)
+    assert (w.asnumpy() < 1.0).all()
+
+
+def test_wd_shrinks_weights():
+    w = nd.array([10.0])
+    g = nd.array([0.0])
+    o = opt.SGD(learning_rate=0.1, wd=0.1, rescale_grad=1.0)
+    o.update(0, w, g, o.create_state(0, w))
+    assert float(w.asnumpy()[0]) < 10.0
+
+
+def test_clip_gradient():
+    w = nd.array([0.0])
+    g = nd.array([100.0])
+    o = opt.SGD(learning_rate=1.0, clip_gradient=1.0, rescale_grad=1.0)
+    o.update(0, w, g, None)
+    assert_almost_equal(w.asnumpy(), np.array([-1.0]), rtol=1e-6)
+
+
+@pytest.mark.parametrize("name", ALL_OPTS)
+def test_all_optimizers_decrease_quadratic(name):
+    # minimize ||w||^2 from a fixed start; every optimizer should decrease it
+    o = opt.create(name, learning_rate=0.05, rescale_grad=1.0)
+    w = nd.array(np.array([1.0, -2.0, 3.0], dtype="float32"))
+    state = o.create_state(0, w)
+    start = float((w.asnumpy() ** 2).sum())
+    for _ in range(20):
+        g = nd.array(2 * w.asnumpy())
+        o.update(0, w, g, state)
+    end = float((w.asnumpy() ** 2).sum())
+    assert end < start, f"{name}: {start} -> {end}"
+
+
+def test_lr_scheduler_integration():
+    from incubator_mxnet_tpu.lr_scheduler import FactorScheduler
+
+    sched = FactorScheduler(step=2, factor=0.5)
+    o = opt.SGD(learning_rate=1.0, lr_scheduler=sched, rescale_grad=1.0)
+    w = nd.array([0.0])
+    g = nd.array([1.0])
+    for _ in range(5):
+        o.update(0, w, g, None)
+    assert o._get_lr(0) < 1.0
+
+
+def test_updater_states_roundtrip():
+    o = opt.Adam(learning_rate=0.01)
+    upd = opt.get_updater(o)
+    w, g = nd.array([1.0, 2.0]), nd.array([0.1, 0.1])
+    upd(0, g, w)
+    blob = upd.get_states()
+    upd2 = opt.get_updater(opt.Adam(learning_rate=0.01))
+    upd2.set_states(blob)
+    assert 0 in upd2.states
+    m1 = upd.states[0][0].asnumpy()
+    m2 = upd2.states[0][0].asnumpy()
+    assert_almost_equal(m1, m2)
+
+
+def test_idx2name_lr_mult():
+    o = opt.SGD(learning_rate=1.0, param_idx2name={0: "w1", 1: "w2"}, rescale_grad=1.0)
+    o.set_lr_mult({"w1": 0.1})
+    assert o._get_lr(0) == pytest.approx(0.1)
+    assert o._get_lr(1) == pytest.approx(1.0)

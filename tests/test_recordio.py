@@ -1,0 +1,52 @@
+"""RecordIO tests (ref: tests/python/unittest/test_recordio.py)."""
+import numpy as np
+
+from incubator_mxnet_tpu import recordio
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        w.write(f"record_{i}".encode())
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for i in range(5):
+        assert r.read() == f"record_{i}".encode()
+    assert r.read() is None
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    rec = str(tmp_path / "test.rec")
+    idx = str(tmp_path / "test.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(10):
+        w.write_idx(i, f"data{i}".encode())
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    assert r.read_idx(7) == b"data7"
+    assert r.read_idx(2) == b"data2"
+    assert sorted(r.keys) == list(range(10))
+    r.close()
+
+
+def test_pack_unpack():
+    h = recordio.IRHeader(0, 3.0, 7, 0)
+    s = recordio.pack(h, b"payload")
+    h2, data = recordio.unpack(s)
+    assert h2.label == 3.0 and h2.id == 7 and data == b"payload"
+    # vector label
+    h = recordio.IRHeader(0, np.array([1.0, 2.0], dtype=np.float32), 1, 0)
+    s = recordio.pack(h, b"x")
+    h2, data = recordio.unpack(s)
+    assert (h2.label == np.array([1.0, 2.0])).all() and data == b"x"
+
+
+def test_pack_img_roundtrip():
+    img = (np.random.rand(8, 8, 3) * 255).astype(np.uint8)
+    h = recordio.IRHeader(0, 1.0, 0, 0)
+    s = recordio.pack_img(h, img, quality=100, img_fmt=".png")
+    h2, img2 = recordio.unpack_img(s)
+    assert img2.shape == (8, 8, 3)
+    assert np.array_equal(img, img2)  # png is lossless

@@ -1,0 +1,98 @@
+"""Symbol tests (ref: tests/python/unittest/test_symbol.py, test_infer_shape.py)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import sym, nd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="act1")
+    net = sym.FullyConnected(net, num_hidden=3, name="fc2")
+    return net
+
+
+def test_compose_and_listings():
+    net = _mlp()
+    assert net.list_arguments() == ["data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"]
+    assert net.list_outputs() == ["fc2_output"]
+
+
+def test_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(4, 10))
+    assert arg_shapes == [(4, 10), (8, 10), (8,), (3, 8), (3,)]
+    assert out_shapes == [(4, 3)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_conv_bn():
+    data = sym.Variable("data")
+    c = sym.Convolution(data, kernel=(3, 3), num_filter=16, pad=(1, 1), name="conv")
+    b = sym.BatchNorm(c, name="bn")
+    p = sym.Pooling(b, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    arg_shapes, out_shapes, aux_shapes = p.infer_shape(data=(2, 3, 8, 8))
+    assert out_shapes == [(2, 16, 4, 4)]
+    assert aux_shapes == [(16,), (16,)]
+    assert b.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+
+
+def test_arith_and_scalar():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = (a + b * 2.0) / 2.0
+    ex = c.bind(mx.cpu(), args={"a": nd.ones((2, 2)), "b": nd.ones((2, 2)) * 3})
+    out = ex.forward()[0]
+    assert_almost_equal(out.asnumpy(), np.full((2, 2), 3.5))
+
+
+def test_group_and_slicing():
+    a = sym.Variable("a")
+    x = sym.relu(a, name="r")
+    y = sym.tanh(a, name="t")
+    g = sym.Group([x, y])
+    assert g.list_outputs() == ["r_output", "t_output"]
+    assert g[0].list_outputs() == ["r_output"]
+    internals = x.get_internals()
+    assert "a" in internals.list_outputs()
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    arg_shapes, out_shapes, _ = net2.infer_shape(data=(2, 10))
+    assert out_shapes == [(2, 3)]
+
+
+def test_simple_bind_forward_backward():
+    net = _mlp()
+    ex = net.simple_bind(mx.cpu(), data=(4, 10))
+    for name in ("fc1_weight", "fc2_weight"):
+        ex.arg_dict[name]._data = nd.array(
+            np.random.randn(*ex.arg_dict[name].shape).astype("float32") * 0.1
+        )._data
+    x = np.random.randn(4, 10).astype("float32")
+    out = ex.forward(is_train=True, data=x)[0]
+    assert out.shape == (4, 3)
+    ex.backward(out_grads=[nd.ones((4, 3))])
+    assert float(np.abs(ex.grad_dict["fc1_weight"].asnumpy()).sum()) > 0
+
+
+def test_multi_output_split():
+    data = sym.Variable("data")
+    s = sym.split(data, num_outputs=2, axis=1, name="sp")
+    assert len(s.list_outputs()) == 2
+    ex = s.bind(mx.cpu(), args={"data": nd.array(np.arange(8).reshape(2, 4))})
+    o1, o2 = ex.forward()
+    assert o1.shape == (2, 2) and o2.shape == (2, 2)
+
+
+def test_variable_shape_attr():
+    v = sym.Variable("x", shape=(3, 4))
+    y = sym.relu(v)
+    args, outs, _ = y.infer_shape()
+    assert outs == [(3, 4)]
