@@ -1,0 +1,402 @@
+"""Gluon Blocks (ref: python/mxnet/gluon/block.py — Block:127,
+HybridBlock:671, SymbolBlock:952).
+
+TPU-native hybridization: instead of tracing into an nnvm CachedOp
+(ref: block.py:748 _build_cache), `hybridize()` wraps the block's forward in
+`jax.jit`. Parameters enter as function arguments (via a thread-local
+substitution map, so `param.data()` yields tracers during tracing); RNG keys
+and the training flag are threaded explicitly. Under autograd.record the
+whole jitted call becomes ONE tape node via jax.vjp — the exact analog of
+CachedOp recording one node for the whole subgraph (ref: cached_op.cc:889).
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd
+from .. import random as _global_random
+from ..ndarray.ndarray import NDArray
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+_SUBST = threading.local()
+
+
+def _current_subst():
+    return getattr(_SUBST, "map", None)
+
+
+class _ParamSubst:
+    """Substitute param.data() results during jit tracing."""
+
+    def __init__(self, mapping):
+        self.mapping = mapping
+
+    def __enter__(self):
+        self._prev = getattr(_SUBST, "map", None)
+        _SUBST.map = self.mapping
+        return self
+
+    def __exit__(self, *exc):
+        _SUBST.map = self._prev
+
+
+class _BlockScope:
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                prefix = hint + str(_NameManager.next(hint)) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = f"{hint}{count}_"
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        _BlockScope._current.value = self._old_scope
+
+
+class _NameManager:
+    _counters = {}
+
+    @classmethod
+    def next(cls, hint):
+        c = cls._counters.get(hint, 0)
+        cls._counters[hint] = c + 1
+        return c
+
+
+class Block:
+    """(ref: gluon/block.py:127)"""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = OrderedDict()
+        self._reg_params = {}
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None):
+        """(ref: block.py collect_params)"""
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({n: p for n, p in self.params.items() if pattern.match(n)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select))
+        return ret
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            self.__dict__.setdefault("_children", OrderedDict())[name] = value
+        elif isinstance(value, Parameter):
+            self.__dict__.setdefault("_reg_params", {})[name] = value
+            self._params._params[value.name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        self._children[name or str(len(self._children))] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for p in self.params.values():
+            p.cast(dtype)
+
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        out = self(*inputs)
+        return out
+
+    # -- serialization -----------------------------------------------------
+    def save_parameters(self, filename, deduplicate=False):
+        """(ref: block.py:315)"""
+        params = self.collect_params()
+        from ..ndarray import save as nd_save
+
+        arg = {n: p._data for n, p in params.items() if p._data is not None}
+        nd_save(filename, arg)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False, dtype_source="current"):
+        """(ref: block.py:356)"""
+        from ..ndarray import load as nd_load
+
+        loaded = nd_load(filename)
+        params = self.collect_params()
+        for name, p in params.items():
+            if name in loaded:
+                p.set_data(loaded[name])
+            elif not allow_missing:
+                raise ValueError(f"parameter {name} missing in {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(params.keys())
+            if extra:
+                raise ValueError(f"extra parameters: {sorted(extra)}")
+
+    save_params = save_parameters
+    load_params = load_parameters
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+
+class HybridBlock(Block):
+    """(ref: gluon/block.py:671)"""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_fn = None
+        self._cached_param_names = None
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._cached_fn = None
+        super().hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        self._cached_fn = None
+        super().cast(dtype)
+
+    def forward(self, x, *args):
+        """(ref: HybridBlock.forward:901) — dispatch eager or cached-jit."""
+        self._pre_forward(x, *args)
+        if not self._active:
+            return self.hybrid_forward(_F, x, *args, **self._param_kwargs())
+        return self._call_cached(x, *args)
+
+    def _pre_forward(self, *args):
+        """Hook: layers resolve deferred param shapes from the first input
+        (the reference does this by catching DeferredInitializationError in
+        forward, ref: block.py HybridBlock.forward)."""
+        return
+
+    def _param_kwargs(self):
+        return {name: p.data() for name, p in self._reg_params.items()}
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    # -- cached (jitted) path ---------------------------------------------
+    def _build_cache(self):
+        params = self.collect_params()
+        # only initialized params participate
+        names = [n for n, p in params.items() if p._data is not None]
+        param_objs = [params[n] for n in names]
+
+        def fn(param_datas, input_datas, key, training):
+            mapping = {
+                n: NDArray._from_data(d) for n, d in zip(names, param_datas)
+            }
+            wrapped = [
+                NDArray._from_data(d) if d is not None else None for d in input_datas
+            ]
+            prev_t = autograd.set_training(training)
+            prev_r = autograd.set_recording(False)
+            try:
+                with _ParamSubst(mapping), _global_random.key_override(key):
+                    out = self._eager_forward(wrapped)
+            finally:
+                autograd.set_training(prev_t)
+                autograd.set_recording(prev_r)
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            out_datas = tuple(o._data for o in outs)
+            # aux writes (BN running stats): substituted arrays whose _data
+            # changed during the call
+            aux_updates = {
+                n: arr._data for n, arr in mapping.items()
+                if arr._data is not param_datas[names.index(n)]
+            }
+            return out_datas, aux_updates
+
+        jitted = jax.jit(fn, static_argnums=(3,))
+        self._cached_fn = jitted
+        self._cached_param_names = names
+        self._cached_param_objs = param_objs
+
+    def _eager_forward(self, wrapped):
+        return self.hybrid_forward(_F, *wrapped, **self._param_kwargs())
+
+    def _call_cached(self, *inputs):
+        if self._cached_fn is None:
+            self._build_cache()
+        names = self._cached_param_names
+        param_objs = self._cached_param_objs
+        param_arrays = [p.data() for p in param_objs]
+        key = _global_random.next_key()
+        training = autograd.is_training()
+
+        fn = self._cached_fn
+        n_params = len(param_arrays)
+        input_arrays = list(inputs)
+
+        def call_fn(*datas):
+            out_datas, aux_updates = fn(
+                tuple(datas[:n_params]), tuple(datas[n_params:]), key, training
+            )
+            return tuple(out_datas) + tuple(aux_updates[k] for k in sorted(aux_updates))
+
+        results = autograd.invoke_recorded(
+            call_fn, param_arrays + input_arrays, name=self.name
+        )
+        # aux output names are deterministic per (shapes, training); derive by
+        # abstract evaluation once and cache
+        cache_key = (training, tuple(a.shape for a in input_arrays))
+        aux_names = getattr(self, "_aux_names_cache", {}).get(cache_key)
+        if aux_names is None:
+            sd = lambda a: jax.ShapeDtypeStruct(tuple(a.shape), a._data.dtype)
+            _, aux_updates = jax.eval_shape(
+                lambda p, i: fn(p, i, key, training),
+                tuple(sd(a) for a in param_arrays), tuple(sd(a) for a in input_arrays),
+            )
+            aux_names = sorted(aux_updates)
+            if not hasattr(self, "_aux_names_cache"):
+                self._aux_names_cache = {}
+            self._aux_names_cache[cache_key] = aux_names
+        n_out = len(results) - len(aux_names)
+        primary = results[:n_out]
+        for aux_name, new_val in zip(aux_names, results[n_out:]):
+            param_objs[names.index(aux_name)]._data._data = new_val._data
+        return primary if len(primary) > 1 else primary[0]
+
+    def export(self, path, epoch=0, remove_amp_cast=True):
+        """Export symbol+params for deployment (ref: block.py:868)."""
+        raise NotImplementedError("export lands with the SymbolBlock bridge")
+
+
+class _FModule:
+    """The `F` namespace handed to hybrid_forward: eager nd ops (tracers flow
+    through them transparently under jit)."""
+
+    def __getattr__(self, name):
+        from .. import ndarray as nd
+
+        return getattr(nd, name)
+
+
+_F = _FModule()
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol as a Block (ref: block.py:952)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=params)
+        from ..symbol import Symbol, Group
+
+        if isinstance(outputs, (list, tuple)):
+            outputs = Group(list(outputs))
+        self._symbol = outputs
+        self._inputs = [i.name if isinstance(i, Symbol) else i for i in (
+            inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        )]
+        arg_names = outputs.list_arguments()
+        aux_names = outputs.list_auxiliary_states()
+        for name in arg_names + aux_names:
+            if name not in self._inputs:
+                self._params.get(
+                    name.replace(self._params.prefix, "", 1) if self._params.prefix else name,
+                    grad_req="write" if name in arg_names else "null",
+                    allow_deferred_init=True,
+                )
+        self._eval_fn = outputs.make_eval_fn()
+
+    def forward(self, *args):
+        arg_dict = {}
+        params = self.collect_params()
+        datas = [a._data if isinstance(a, NDArray) else jnp.asarray(a) for a in args]
+        for name, d in zip(self._inputs, datas):
+            arg_dict[name] = d
+        aux_names = set(self._symbol.list_auxiliary_states())
+        aux_dict = {}
+        for n, p in params.items():
+            if p._data is None:
+                continue
+            if n in aux_names:
+                aux_dict[n] = p.data()._data
+            else:
+                arg_dict[n] = p.data()._data
+        outs, _ = self._eval_fn(arg_dict, aux_dict, _global_random.next_key(),
+                                autograd.is_training())
+        res = [NDArray._from_data(o) for o in outs]
+        return res if len(res) > 1 else res[0]

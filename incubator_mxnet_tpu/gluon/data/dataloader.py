@@ -1,0 +1,87 @@
+"""Gluon DataLoader (ref: python/mxnet/gluon/data/dataloader.py:98-190).
+
+The reference forks worker processes sharing NDArrays through POSIX-shm
+(CPUSharedStorageManager). TPU-native twist: batches are assembled in numpy
+by a thread pool (JAX arrays are process-local; threads avoid the fork+IPC
+machinery while XLA dispatch releases the GIL), then transferred async to
+device. num_workers>0 selects the threaded prefetch path.
+"""
+from __future__ import annotations
+
+import concurrent.futures as _futures
+
+import numpy as np
+
+from ...ndarray.ndarray import NDArray
+from ...ndarray import array as nd_array
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """(ref: dataloader.py default_batchify_fn)"""
+    if isinstance(data[0], NDArray):
+        import jax.numpy as jnp
+
+        return nd_array(np.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(list(i)) for i in data]
+    data = np.asarray(data)
+    return nd_array(data)
+
+
+class DataLoader:
+    """(ref: dataloader.py DataLoader)"""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None, thread_pool=True):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size required when batch_sampler is None")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must be False with custom sampler")
+            batch_sampler = BatchSampler(sampler, batch_size, last_batch or "keep")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = num_workers
+        self._prefetch = max(0, prefetch or 2 * max(num_workers, 1))
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[i] for i in batch])
+            return
+        # threaded prefetch pipeline (PrefetcherIter analog)
+        with _futures.ThreadPoolExecutor(self._num_workers) as pool:
+            pending = []
+            it = iter(self._batch_sampler)
+
+            def submit():
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return None
+                return pool.submit(
+                    lambda b: self._batchify_fn([self._dataset[i] for i in b]), batch
+                )
+
+            for _ in range(self._prefetch):
+                f = submit()
+                if f is None:
+                    break
+                pending.append(f)
+            while pending:
+                f = pending.pop(0)
+                nxt = submit()
+                if nxt is not None:
+                    pending.append(nxt)
+                yield f.result()
+
+    def __len__(self):
+        return len(self._batch_sampler)

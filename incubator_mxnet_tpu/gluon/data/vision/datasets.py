@@ -1,0 +1,207 @@
+"""Vision datasets (ref: python/mxnet/gluon/data/vision/datasets.py).
+
+Zero-egress environment: datasets read standard on-disk formats from `root`
+(MNIST idx files, CIFAR binary batches) and raise a clear error if absent —
+no downloads. SyntheticImageDataset provides a generated stand-in for tests
+and benchmarks.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..dataset import Dataset, ArrayDataset
+from ....ndarray import array as nd_array
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100", "ImageFolderDataset",
+           "ImageRecordDataset", "SyntheticImageDataset"]
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, transform):
+        self._transform = transform
+        self._root = os.path.expanduser(root)
+        self._data = None
+        self._label = None
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(nd_array(self._data[idx]), self._label[idx])
+        return nd_array(self._data[idx]), self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST from idx files in `root` (ref: datasets.py MNIST)."""
+
+    _train_files = ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz")
+    _test_files = ("t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz")
+
+    def __init__(self, root="~/.mxnet/datasets/mnist", train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _read_idx(self, path):
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            data = f.read()
+        magic = struct.unpack(">i", data[:4])[0]
+        ndim = magic % 256
+        dims = struct.unpack(">" + "i" * ndim, data[4 : 4 + 4 * ndim])
+        return np.frombuffer(data[4 + 4 * ndim:], dtype=np.uint8).reshape(dims)
+
+    def _get_data(self):
+        imgs, lbls = self._train_files if self._train else self._test_files
+        img_path = os.path.join(self._root, imgs)
+        lbl_path = os.path.join(self._root, lbls)
+        for p in (img_path, lbl_path):
+            if not os.path.exists(p) and not os.path.exists(p[:-3]):
+                raise FileNotFoundError(
+                    f"{p} not found. This environment has no network access: place the "
+                    "standard MNIST idx files under the dataset root, or use "
+                    "SyntheticImageDataset for smoke tests."
+                )
+        img_path = img_path if os.path.exists(img_path) else img_path[:-3]
+        lbl_path = lbl_path if os.path.exists(lbl_path) else lbl_path[:-3]
+        data = self._read_idx(img_path)
+        label = self._read_idx(lbl_path)
+        self._data = data.reshape(-1, 28, 28, 1)
+        self._label = label.astype(np.int32)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root="~/.mxnet/datasets/fashion-mnist", train=True, transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR-10 from the python/binary batches in `root`."""
+
+    def __init__(self, root="~/.mxnet/datasets/cifar10", train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        files = ([f"data_batch_{i}.bin" for i in range(1, 6)] if self._train
+                 else ["test_batch.bin"])
+        data_list, label_list = [], []
+        for fname in files:
+            path = os.path.join(self._root, fname)
+            if not os.path.exists(path):
+                raise FileNotFoundError(
+                    f"{path} not found (no network access; provide CIFAR binary batches "
+                    "or use SyntheticImageDataset)"
+                )
+            raw = np.fromfile(path, dtype=np.uint8).reshape(-1, 3073)
+            label_list.append(raw[:, 0])
+            data_list.append(raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+        self._data = np.concatenate(data_list)
+        self._label = np.concatenate(label_list).astype(np.int32)
+
+
+class CIFAR100(CIFAR10):
+    def __init__(self, root="~/.mxnet/datasets/cifar100", train=True,
+                 fine_label=False, transform=None):
+        self._fine = fine_label
+        super().__init__(root, train, transform)
+
+    def _get_data(self):
+        fname = "train.bin" if self._train else "test.bin"
+        path = os.path.join(self._root, fname)
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"{path} not found")
+        raw = np.fromfile(path, dtype=np.uint8).reshape(-1, 3074)
+        self._label = raw[:, 1 if self._fine else 0].astype(np.int32)
+        self._data = raw[:, 2:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+
+
+class ImageFolderDataset(Dataset):
+    """(ref: datasets.py ImageFolderDataset) — label per subdirectory."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = {".jpg", ".jpeg", ".png"}
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(self._root)):
+            path = os.path.join(self._root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                if os.path.splitext(filename)[1].lower() in self._exts:
+                    self.items.append((os.path.join(path, filename), label))
+
+    def __getitem__(self, idx):
+        from .... import image
+
+        img = image.imread(self.items[idx][0], self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)
+
+
+class ImageRecordDataset(Dataset):
+    """(ref: datasets.py ImageRecordDataset over RecordIO shards)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        from ..dataset import RecordFileDataset
+
+        self._record = RecordFileDataset(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from .... import image, recordio
+
+        record = self._record[idx]
+        header, img = recordio.unpack(record)
+        img = image.imdecode(img, self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self._record)
+
+
+class SyntheticImageDataset(Dataset):
+    """Deterministic generated image-classification data (for tests/bench in
+    a zero-egress environment)."""
+
+    def __init__(self, num_samples=1000, shape=(3, 224, 224), num_classes=1000,
+                 transform=None, seed=0, channels_last=False):
+        rng = np.random.RandomState(seed)
+        self._labels = rng.randint(0, num_classes, size=num_samples).astype(np.int32)
+        self._shape = tuple(shape)
+        self._seed = seed
+        self._transform = transform
+        self._channels_last = channels_last
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self._seed + idx)
+        img = rng.rand(*self._shape).astype(np.float32)
+        label = self._labels[idx]
+        if self._transform is not None:
+            return self._transform(nd_array(img), label)
+        return nd_array(img), label
+
+    def __len__(self):
+        return len(self._labels)

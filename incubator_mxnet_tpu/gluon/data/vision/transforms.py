@@ -1,0 +1,158 @@
+"""Vision transforms (ref: python/mxnet/gluon/data/vision/transforms.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ....ndarray.ndarray import NDArray
+from ....ndarray import array as nd_array
+from ...block import Block, HybridBlock
+from ...nn import Sequential as Compose_base
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "RandomSaturation", "RandomLighting"]
+
+
+class Compose(Compose_base):
+    """(ref: transforms.py Compose)"""
+
+    def __init__(self, transforms):
+        super().__init__()
+        self.add(*transforms)
+
+
+class Cast(Block):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(Block):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (ref: transforms.py ToTensor)."""
+
+    def forward(self, x):
+        a = x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+        a = a.astype(np.float32) / 255.0
+        if a.ndim == 3:
+            a = a.transpose(2, 0, 1)
+        elif a.ndim == 4:
+            a = a.transpose(0, 3, 1, 2)
+        return nd_array(a)
+
+
+class Normalize(Block):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = np.asarray(mean, dtype=np.float32)
+        self._std = np.asarray(std, dtype=np.float32)
+
+    def forward(self, x):
+        a = x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+        mean = self._mean.reshape(-1, 1, 1) if self._mean.ndim else self._mean
+        std = self._std.reshape(-1, 1, 1) if self._std.ndim else self._std
+        return nd_array((a - mean) / std)
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        from .... import image
+
+        return image.imresize(x, self._size[0], self._size[1], self._interpolation)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        from .... import image
+
+        return image.center_crop(x, self._size, self._interpolation)[0]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super().__init__()
+        self._args = (size if isinstance(size, (tuple, list)) else (size, size),
+                      scale, ratio, interpolation)
+
+    def forward(self, x):
+        from .... import image
+
+        size, scale, ratio, interp = self._args
+        return image.random_size_crop(x, size, scale, ratio, interp)[0]
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            a = x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+            return nd_array(np.ascontiguousarray(a[:, ::-1]))
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            a = x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+            return nd_array(np.ascontiguousarray(a[::-1]))
+        return x
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        from .... import image
+
+        return image.BrightnessJitterAug(self._b)(x)
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        from .... import image
+
+        return image.ContrastJitterAug(self._c)(x)
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        from .... import image
+
+        return image.SaturationJitterAug(self._s)(x)
+
+
+class RandomLighting(Block):
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        from .... import image
+
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.814],
+                           [-0.5836, -0.6948, 0.4203]])
+        return image.LightingAug(self._alpha, eigval, eigvec)(x)

@@ -1,0 +1,299 @@
+"""Gluon parameters (ref: python/mxnet/gluon/parameter.py — Parameter:43,
+ParameterDict:632). Deferred shape init (0-dims resolved at first forward) is
+kept; storage is a single (possibly mesh-sharded) NDArray instead of
+per-device copies — replication across chips is a sharding annotation, not N
+arrays.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..base import dtype_np
+from ..context import Context, cpu, current_context
+from ..ndarray.ndarray import NDArray
+from ..ndarray import zeros as nd_zeros
+from .. import initializer as init_mod
+
+__all__ = ["DeferredInitializationError", "Parameter", "Constant", "ParameterDict"]
+
+
+class DeferredInitializationError(Exception):
+    """(ref: parameter.py DeferredInitializationError)"""
+
+
+class Parameter:
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self.name = name
+        self._grad_req = grad_req if differentiable else "null"
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self._allow_deferred_init = allow_deferred_init
+        self._data = None          # NDArray
+        self._grad = None
+        self._deferred_init = None  # (initializer, ctx) captured at initialize()
+        self._var = None
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self._shape}, dtype={self.dtype})"
+
+    # -- shape handling ----------------------------------------------------
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is not None and not _shape_compatible(self._shape, new_shape):
+            raise AssertionError(
+                f"{self.name}: incompatible shape {new_shape} vs {self._shape}"
+            )
+        self._shape = tuple(new_shape)
+        if self._deferred_init is not None and self._shape_known():
+            self._finish_deferred_init()
+
+    def _shape_known(self):
+        return self._shape is not None and all(s > 0 for s in self._shape)
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._grad = None
+                self._data._grad = None
+            else:
+                self._attach_grad()
+
+    # -- init --------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None, force_reinit=False):
+        """(ref: parameter.py Parameter.initialize)"""
+        if self._data is not None and not force_reinit:
+            return
+        if default_init is None:
+            default_init = init_mod.Uniform(0.07)
+        initializer = init or self.init or default_init
+        self._deferred_init = (initializer, ctx)
+        if self._shape_known():
+            self._finish_deferred_init()
+        elif not self._allow_deferred_init:
+            raise ValueError(
+                f"cannot initialize {self.name}: shape {self._shape} unknown; "
+                "set allow_deferred_init=True or give a full shape"
+            )
+
+    def _finish_deferred_init(self):
+        initializer, ctx = self._deferred_init
+        arr = nd_zeros(self._shape, ctx=ctx, dtype=self.dtype)
+        initializer(init_mod.InitDesc(self.name, {"__init__": None}), arr)
+        self._data = arr
+        self._deferred_init = None
+        if self._grad_req != "null":
+            self._attach_grad()
+
+    def _attach_grad(self):
+        self._grad = NDArray._from_data(jnp.zeros(self._shape, dtype_np(self.dtype)))
+        self._data._grad = self._grad
+        self._data._grad_req = self._grad_req
+
+    # -- access ------------------------------------------------------------
+    def data(self, ctx=None):
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    f"parameter {self.name} deferred (shape {self._shape})"
+                )
+            raise RuntimeError(f"parameter {self.name} not initialized")
+        from .block import _current_subst
+
+        subst = _current_subst()
+        if subst is not None and self.name in subst:
+            return subst[self.name]
+        return self._data
+
+    def list_data(self):
+        return [self.data()]
+
+    def grad(self, ctx=None):
+        if self._grad is None:
+            raise RuntimeError(f"parameter {self.name} has no gradient (grad_req=null?)")
+        return self._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        return [self._data.context] if self._data is not None else []
+
+    def set_data(self, data):
+        arr = data if isinstance(data, NDArray) else NDArray(data)
+        if self._data is None:
+            self._shape = arr.shape
+            self._data = NDArray(jnp.asarray(arr._data, dtype_np(self.dtype)))
+            self._deferred_init = None
+            if self._grad_req != "null":
+                self._attach_grad()
+        else:
+            self._data._data = jnp.asarray(arr._data, dtype=self._data._data.dtype).reshape(self._shape)
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad._data = jnp.zeros_like(self._grad._data)
+
+    def reset_ctx(self, ctx):
+        pass
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is not None:
+            self._data._data = self._data._data.astype(dtype_np(dtype))
+            if self._grad is not None:
+                self._grad._data = self._grad._data.astype(dtype_np(dtype))
+
+    def var(self):
+        if self._var is None:
+            from .. import symbol as sym
+
+            self._var = sym.Variable(self.name)
+        return self._var
+
+
+class Constant(Parameter):
+    """Non-differentiable constant parameter (ref: parameter.py Constant)."""
+
+    def __init__(self, name, value):
+        value = np.asarray(value, dtype=np.float32)
+        self.value = value
+
+        class _CInit(init_mod.Initializer):
+            def _init_weight(self_inner, _name, arr):
+                arr._data = jnp.asarray(value)
+
+        super().__init__(
+            name, grad_req="null", shape=value.shape, init=_CInit(),
+            differentiable=False,
+        )
+
+
+def _shape_compatible(old, new):
+    if len(old) != len(new):
+        return False
+    return all(o == n or o in (0, -1) for o, n in zip(old, new))
+
+
+class ParameterDict:
+    """(ref: parameter.py:632 ParameterDict)"""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def __repr__(self):
+        return f"ParameterDict({list(self._params)})"
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def get(self, name, **kwargs):
+        """Create-or-retrieve (ref: ParameterDict.get)."""
+        name = self._prefix + name
+        if name in self._params:
+            param = self._params[name]
+            for k, v in kwargs.items():
+                if k == "shape" and v is not None and param.shape is not None:
+                    param.shape = tuple(v)
+            return param
+        if self._shared is not None and name in self._shared:
+            self._params[name] = self._shared[name]
+            return self._shared[name]
+        param = Parameter(name, **kwargs)
+        self._params[name] = param
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        if name in self._params:
+            return self._params[name]
+        c = Constant(name, value)
+        self._params[name] = c
+        return c
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise ValueError(f"duplicate parameter {k}")
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        for p in self.values():
+            p.initialize(init=None, ctx=ctx, default_init=init or init_mod.Uniform(0.07),
+                         force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        pass
+
+    def setattr(self, name, value):
+        for p in self.values():
+            setattr(p, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        from ..ndarray import save as nd_save
+
+        arg = {}
+        for p in self.values():
+            name = p.name
+            if strip_prefix and name.startswith(strip_prefix):
+                name = name[len(strip_prefix):]
+            arg[name] = p._data
+        nd_save(filename, arg)
+
+    def load(self, filename, ctx=None, allow_missing=False, ignore_extra=False,
+             restore_prefix=""):
+        from ..ndarray import load as nd_load
+
+        loaded = nd_load(filename)
+        loaded = {restore_prefix + k: v for k, v in loaded.items()}
+        for name, p in self.items():
+            if name in loaded:
+                p.set_data(loaded[name])
+            elif not allow_missing:
+                raise ValueError(f"parameter {name} missing in {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(self.keys())
+            if extra:
+                raise ValueError(f"extra parameters in file: {sorted(extra)}")

@@ -1,0 +1,335 @@
+"""Gluon RNN cells (ref: python/mxnet/gluon/rnn/rnn_cell.py)."""
+from __future__ import annotations
+
+from ... import initializer as init_mod
+from ...ndarray import zeros as nd_zeros
+from ..block import HybridBlock
+
+__all__ = [
+    "RecurrentCell", "RNNCell", "LSTMCell", "GRUCell", "SequentialRNNCell",
+    "DropoutCell", "ZoneoutCell", "ResidualCell", "BidirectionalCell",
+]
+
+
+class RecurrentCell(HybridBlock):
+    """(ref: rnn_cell.py RecurrentCell)"""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            states.append(nd_zeros(info["shape"]))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """(ref: rnn_cell.py unroll) — python loop; under hybridize the whole
+        unrolled graph compiles into one XLA program."""
+        self.reset()
+        axis = layout.find("T")
+        from ... import ndarray as nd
+
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [
+                x.squeeze(axis=axis)
+                for x in nd.split(inputs, num_outputs=length, axis=axis, squeeze_axis=False)
+            ]
+        states = begin_state if begin_state is not None else self.begin_state(inputs[0].shape[0])
+        outputs = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+        if merge_outputs:
+            outputs = nd.stack(*outputs, axis=axis)
+        return outputs, states
+
+    def forward(self, inputs, states):
+        self._counter += 1
+        self._pre_forward(inputs, states)
+        return self.hybrid_forward(None, inputs, states)
+
+
+class RNNCell(RecurrentCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0, prefix=None, params=None,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get("i2h_weight", shape=(hidden_size, input_size),
+                                              allow_deferred_init=True, init=i2h_weight_initializer)
+            self.h2h_weight = self.params.get("h2h_weight", shape=(hidden_size, hidden_size),
+                                              init=h2h_weight_initializer)
+            self.i2h_bias = self.params.get("i2h_bias", shape=(hidden_size,), init=init_mod.Zero())
+            self.h2h_bias = self.params.get("h2h_bias", shape=(hidden_size,), init=init_mod.Zero())
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def _pre_forward(self, x, states):
+        if not self.i2h_weight._shape_known():
+            self.i2h_weight.shape = (self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, **kwargs):
+        from ... import ndarray as nd
+
+        h = states[0]
+        i2h = nd.FullyConnected(inputs, self.i2h_weight.data(), self.i2h_bias.data(),
+                                num_hidden=self._hidden_size)
+        h2h = nd.FullyConnected(h, self.h2h_weight.data(), self.h2h_bias.data(),
+                                num_hidden=self._hidden_size)
+        out = nd.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0, prefix=None, params=None,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get("i2h_weight", shape=(4 * hidden_size, input_size),
+                                              allow_deferred_init=True, init=i2h_weight_initializer)
+            self.h2h_weight = self.params.get("h2h_weight", shape=(4 * hidden_size, hidden_size),
+                                              init=h2h_weight_initializer)
+            self.i2h_bias = self.params.get("i2h_bias", shape=(4 * hidden_size,), init=init_mod.Zero())
+            self.h2h_bias = self.params.get("h2h_bias", shape=(4 * hidden_size,), init=init_mod.Zero())
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)},
+                {"shape": (batch_size, self._hidden_size)}]
+
+    def _pre_forward(self, x, states):
+        if not self.i2h_weight._shape_known():
+            self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, **kwargs):
+        from ... import ndarray as nd
+
+        h, c = states
+        gates = (
+            nd.FullyConnected(inputs, self.i2h_weight.data(), self.i2h_bias.data(),
+                              num_hidden=4 * self._hidden_size)
+            + nd.FullyConnected(h, self.h2h_weight.data(), self.h2h_bias.data(),
+                                num_hidden=4 * self._hidden_size)
+        )
+        i, f, g, o = nd.split(gates, num_outputs=4, axis=-1)
+        c_new = nd.sigmoid(f) * c + nd.sigmoid(i) * nd.tanh(g)
+        h_new = nd.sigmoid(o) * nd.tanh(c_new)
+        return h_new, [h_new, c_new]
+
+
+class GRUCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0, prefix=None, params=None,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get("i2h_weight", shape=(3 * hidden_size, input_size),
+                                              allow_deferred_init=True, init=i2h_weight_initializer)
+            self.h2h_weight = self.params.get("h2h_weight", shape=(3 * hidden_size, hidden_size),
+                                              init=h2h_weight_initializer)
+            self.i2h_bias = self.params.get("i2h_bias", shape=(3 * hidden_size,), init=init_mod.Zero())
+            self.h2h_bias = self.params.get("h2h_bias", shape=(3 * hidden_size,), init=init_mod.Zero())
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def _pre_forward(self, x, states):
+        if not self.i2h_weight._shape_known():
+            self.i2h_weight.shape = (3 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, **kwargs):
+        from ... import ndarray as nd
+
+        h = states[0]
+        gx = nd.FullyConnected(inputs, self.i2h_weight.data(), self.i2h_bias.data(),
+                               num_hidden=3 * self._hidden_size)
+        gh = nd.FullyConnected(h, self.h2h_weight.data(), self.h2h_bias.data(),
+                               num_hidden=3 * self._hidden_size)
+        rx, zx, nx = nd.split(gx, num_outputs=3, axis=-1)
+        rh, zh, nh = nd.split(gh, num_outputs=3, axis=-1)
+        r = nd.sigmoid(rx + rh)
+        z = nd.sigmoid(zx + zh)
+        n = nd.tanh(nx + r * nh)
+        h_new = (1 - z) * n + z * h
+        return h_new, [h_new]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """(ref: rnn_cell.py SequentialRNNCell)"""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        infos = []
+        for cell in self._children.values():
+            infos.extend(cell.state_info(batch_size))
+        return infos
+
+    def begin_state(self, batch_size=0, **kwargs):
+        states = []
+        for cell in self._children.values():
+            states.extend(cell.begin_state(batch_size, **kwargs))
+        return states
+
+    def _pre_forward(self, *args):
+        return
+
+    def hybrid_forward(self, F, inputs, states, **kwargs):
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            inputs, new = cell(inputs, states[p : p + n])
+            next_states.extend(new)
+            p += n
+        return inputs, next_states
+
+    def __len__(self):
+        return len(self._children)
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def _pre_forward(self, *args):
+        return
+
+    def hybrid_forward(self, F, inputs, states, **kwargs):
+        from ... import ndarray as nd
+
+        if self._rate > 0:
+            inputs = nd.Dropout(inputs, p=self._rate, axes=self._axes)
+        return inputs, states
+
+
+class ModifierCell(RecurrentCell):
+    def __init__(self, base_cell):
+        super().__init__(prefix=base_cell.prefix + "mod_", params=None)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.base_cell.begin_state(batch_size, **kwargs)
+
+    def _pre_forward(self, *args):
+        return
+
+
+class ZoneoutCell(ModifierCell):
+    """(ref: rnn_cell.py ZoneoutCell)"""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def hybrid_forward(self, F, inputs, states, **kwargs):
+        from ... import autograd, ndarray as nd
+
+        next_output, next_states = self.base_cell(inputs, states)
+        if autograd.is_training():
+            def mask(p, new, old):
+                m = nd.Dropout(nd.ones_like(new), p=p, mode="always")
+                keep = (m > 0)
+                return nd.where(keep, new, old)
+
+            prev = self._prev_output if self._prev_output is not None else nd.zeros_like(next_output)
+            if self.zoneout_outputs > 0:
+                output = mask(self.zoneout_outputs, next_output, prev)
+            else:
+                output = next_output
+            if self.zoneout_states > 0:
+                next_states = [mask(self.zoneout_states, ns, s)
+                               for ns, s in zip(next_states, states)]
+        else:
+            output = next_output
+        self._prev_output = output
+        return output, next_states
+
+
+class ResidualCell(ModifierCell):
+    def hybrid_forward(self, F, inputs, states, **kwargs):
+        output, states = self.base_cell(inputs, states)
+        return output + inputs, states
+
+
+class BidirectionalCell(RecurrentCell):
+    """(ref: rnn_cell.py BidirectionalCell)"""
+
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__(prefix="", params=None)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+
+    def state_info(self, batch_size=0):
+        return (self._children["l_cell"].state_info(batch_size)
+                + self._children["r_cell"].state_info(batch_size))
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return (self._children["l_cell"].begin_state(batch_size, **kwargs)
+                + self._children["r_cell"].begin_state(batch_size, **kwargs))
+
+    def _pre_forward(self, *args):
+        return
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError("BidirectionalCell supports unroll() only")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import ndarray as nd
+
+        axis = layout.find("T")
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [
+                x.squeeze(axis=axis)
+                for x in nd.split(inputs, num_outputs=length, axis=axis, squeeze_axis=False)
+            ]
+        l_cell = self._children["l_cell"]
+        r_cell = self._children["r_cell"]
+        begin = begin_state or self.begin_state(inputs[0].shape[0])
+        nl = len(l_cell.state_info())
+        l_out, l_states = l_cell.unroll(length, inputs, begin[:nl], layout="NTC")
+        r_out, r_states = r_cell.unroll(length, list(reversed(inputs)), begin[nl:], layout="NTC")
+        r_out = list(reversed(r_out))
+        outputs = [nd.concat(lo, ro, dim=-1) for lo, ro in zip(l_out, r_out)]
+        if merge_outputs:
+            outputs = nd.stack(*outputs, axis=axis)
+        return outputs, l_states + r_states

@@ -1,0 +1,163 @@
+"""Gluon recurrent layers (ref: python/mxnet/gluon/rnn/rnn_layer.py).
+
+Parameters are stored unfused ({l,r}{layer}_{i2h,h2h}_{weight,bias}) for
+reference-compatible naming, and packed into the fused scan-based RNN op at
+forward; XLA folds the packing away under jit.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import initializer as init_mod
+from ...ndarray.ndarray import NDArray
+from ...ndarray import zeros as nd_zeros
+from ...ops.nn import _GATES
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout, bidirectional,
+                 input_size, mode, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC")
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._mode = mode
+        self._gates = _GATES[mode]
+        self._unfused_params = []
+        ng, ni, nh = self._gates, input_size, hidden_size
+        with self.name_scope():
+            for layer in range(num_layers):
+                for d in (["l", "r"] if bidirectional else ["l"]):
+                    self._register_layer_params(layer, d, ni)
+                ni = hidden_size * self._dir
+
+    def _register_layer_params(self, layer, d, input_size):
+        ng, nh = self._gates, self._hidden_size
+        for kind, shape in (
+            ("i2h_weight", (ng * nh, input_size)),
+            ("h2h_weight", (ng * nh, nh)),
+            ("i2h_bias", (ng * nh,)),
+            ("h2h_bias", (ng * nh,)),
+        ):
+            name = f"{d}{layer}_{kind}"
+            p = self.params.get(
+                name, shape=shape,
+                init=init_mod.Zero() if kind.endswith("bias") else None,
+                allow_deferred_init=True,
+            )
+            self._unfused_params.append((name, p))
+
+    def _pre_forward(self, inputs, *args):
+        if self._input_size == 0:
+            axis = 2 if self._layout == "TNC" else 2
+            in_size = inputs.shape[axis]
+            self._input_size = in_size
+            ng, nh = self._gates, self._hidden_size
+            for name, p in self._unfused_params:
+                if not p._shape_known():
+                    if name.endswith("i2h_weight"):
+                        layer = int(name[1:].split("_")[0])
+                        isz = in_size if layer == 0 else nh * self._dir
+                        p.shape = (ng * nh, isz)
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """(ref: rnn_layer.py begin_state)"""
+        states = []
+        for info in self.state_info(batch_size):
+            states.append(nd_zeros(info["shape"]))
+        return states
+
+    def _pack_params(self):
+        """Pack unfused params through the autograd dispatcher so gradients
+        flow back to the individual weights in eager mode too."""
+        from ... import autograd
+
+        names = [n for n, _ in self._unfused_params]
+        arrays = [p.data() for _, p in self._unfused_params]
+
+        def pack(*datas):
+            ws = [d.reshape(-1) for d, n in zip(datas, names) if n.endswith("weight")]
+            bs = [d.reshape(-1) for d, n in zip(datas, names) if n.endswith("bias")]
+            return jnp.concatenate(ws + bs)
+
+        return autograd.invoke_recorded(pack, arrays)[0]
+
+    def hybrid_forward(self, F, inputs, states=None, **kwargs):
+        if self._layout == "NTC":
+            inputs = F.swapaxes(inputs, dim1=0, dim2=1)
+        batch_size = inputs.shape[1]
+        skip_states = states is None
+        if states is None:
+            states = self.begin_state(batch_size)
+        if isinstance(states, NDArray):
+            states = [states]
+        packed = self._pack_params()
+        rnn_args = [inputs, packed] + list(states)
+        out = F.RNN(
+            *rnn_args, state_size=self._hidden_size, num_layers=self._num_layers,
+            mode=self._mode, bidirectional=self._dir == 2, p=self._dropout,
+            state_outputs=not skip_states,
+        )
+        if skip_states:
+            output, new_states = out, []
+        else:
+            output, new_states = out[0], list(out[1:])
+        if self._layout == "NTC":
+            output = F.swapaxes(output, dim1=0, dim2=1)
+        if skip_states:
+            return output
+        return output, new_states
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._hidden_size}, layers={self._num_layers}, "
+                f"bidirectional={self._dir == 2})")
+
+
+class RNN(_RNNLayer):
+    """(ref: rnn_layer.py RNN)"""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu", layout="TNC",
+                 dropout=0, bidirectional=False, input_size=0, **kwargs):
+        mode = "rnn_relu" if activation == "relu" else "rnn_tanh"
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, mode, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size, self._hidden_size)}]
+
+
+class LSTM(_RNNLayer):
+    """(ref: rnn_layer.py LSTM)"""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape}, {"shape": shape}]
+
+
+class GRU(_RNNLayer):
+    """(ref: rnn_layer.py GRU)"""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size, self._hidden_size)}]

@@ -1,0 +1,94 @@
+"""Gluon Trainer (ref: python/mxnet/gluon/trainer.py:27 — kvstore wiring:169,
+step:298, allreduce_grads:327, update:359).
+
+TPU-native: gradients live in single (mesh-replicated) arrays, so the
+per-device reduce of the reference collapses to the GSPMD all-reduce already
+performed during backward; kvstore remains for dist (multi-host) setups.
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt
+from .. import kvstore as kvs
+from .parameter import ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        self._params = [p for p in params if p.grad_req != "null"]
+        self._all_params = list(params)
+        self._scale = 1.0
+        optimizer_params = dict(optimizer_params or {})
+        idx2name = {i: p.name for i, p in enumerate(self._params)}
+        if isinstance(optimizer, str):
+            self._optimizer = opt.create(optimizer, param_idx2name=idx2name,
+                                         **optimizer_params)
+        else:
+            self._optimizer = optimizer
+            self._optimizer.idx2name.update(idx2name)
+        self._updater = opt.get_updater(self._optimizer)
+        self._kvstore_str = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._update_on_kvstore = update_on_kvstore
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.lr
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def _init_kvstore(self):
+        """(ref: trainer.py:169 _init_kvstore)"""
+        if self._kv_initialized:
+            return
+        if isinstance(self._kvstore_str, str) and "dist" in self._kvstore_str:
+            self._kvstore = kvs.create(self._kvstore_str)
+            for i, p in enumerate(self._params):
+                self._kvstore.init(i, p.data())
+        self._kv_initialized = True
+
+    def allreduce_grads(self):
+        """(ref: trainer.py:327) — multi-host sum via kvstore; intra-host is
+        already reduced by GSPMD."""
+        self._init_kvstore()
+        if self._kvstore is not None:
+            for i, p in enumerate(self._params):
+                g = p.grad()
+                self._kvstore.push(i, g)
+                self._kvstore.pull(i, out=g)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """(ref: trainer.py:298)"""
+        self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        if self._kvstore is not None:
+            self.allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, p in enumerate(self._params):
+            if p._data is None:
+                continue
+            self._updater(i, p.grad(), p.data())
+
+    def save_states(self, fname):
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer=False))
+
+    def load_states(self, fname):
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())

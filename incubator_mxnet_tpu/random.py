@@ -32,10 +32,34 @@ def current_seed():
 
 
 def next_key():
-    """Return a fresh PRNG key (thread-safe split of the root key)."""
+    """Return a fresh PRNG key (thread-safe split of the root key). Under
+    `key_override` (hybrid tracing) splits the overridden key instead."""
     global _KEY
+    override = getattr(_OVERRIDE, "key", None)
+    if override is not None:
+        new, sub = jax.random.split(override)
+        _OVERRIDE.key = new
+        return sub
     with _LOCK:
         if _KEY is None:
             _KEY = jax.random.PRNGKey(_SEED)
         _KEY, sub = jax.random.split(_KEY)
         return sub
+
+
+import contextlib as _contextlib
+
+_OVERRIDE = threading.local()
+
+
+@_contextlib.contextmanager
+def key_override(key):
+    """Thread an explicit key through next_key() — used while jit-tracing
+    hybridized blocks so randomness is a function argument, not trace-time
+    state."""
+    prev = getattr(_OVERRIDE, "key", None)
+    _OVERRIDE.key = key
+    try:
+        yield
+    finally:
+        _OVERRIDE.key = prev
