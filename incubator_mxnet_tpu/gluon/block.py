@@ -187,9 +187,20 @@ class Block:
         return out
 
     # -- serialization -----------------------------------------------------
+    def _collect_params_with_prefix(self, prefix=""):
+        """Structure-relative parameter names (ref: block.py
+        _collect_params_with_prefix — keys like '0.weight' survive re-creating
+        the model with fresh name counters)."""
+        if prefix:
+            prefix += "."
+        ret = {prefix + name: p for name, p in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
     def save_parameters(self, filename, deduplicate=False):
         """(ref: block.py:315)"""
-        params = self.collect_params()
+        params = self._collect_params_with_prefix()
         from ..ndarray import save as nd_save
 
         arg = {n: p._data for n, p in params.items() if p._data is not None}
@@ -201,7 +212,10 @@ class Block:
         from ..ndarray import load as nd_load
 
         loaded = nd_load(filename)
-        params = self.collect_params()
+        params = self._collect_params_with_prefix()
+        if loaded and params and not any(k in params for k in loaded):
+            # fall back to full-prefix names (ParameterDict.save format)
+            params = dict(self.collect_params().items())
         for name, p in params.items():
             if name in loaded:
                 p.set_data(loaded[name])
@@ -239,9 +253,14 @@ class HybridBlock(Block):
         super().cast(dtype)
 
     def forward(self, x, *args):
-        """(ref: HybridBlock.forward:901) — dispatch eager or cached-jit."""
+        """(ref: HybridBlock.forward:901) — dispatch eager or cached-jit.
+
+        When already inside a parent block's trace (param substitution
+        active), inline into it instead of nesting another cached call —
+        the analog of CachedOp flattening nested hybridized subgraphs.
+        """
         self._pre_forward(x, *args)
-        if not self._active:
+        if not self._active or _current_subst() is not None:
             return self.hybrid_forward(_F, x, *args, **self._param_kwargs())
         return self._call_cached(x, *args)
 
@@ -299,6 +318,9 @@ class HybridBlock(Block):
 
     def _call_cached(self, *inputs):
         if self._cached_fn is None:
+            # one eager warmup resolves deferred param shapes before tracing
+            with autograd.pause():
+                self._eager_forward(list(inputs))
             self._build_cache()
         names = self._cached_param_names
         param_objs = self._cached_param_objs

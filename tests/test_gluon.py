@@ -1,0 +1,236 @@
+"""Gluon tests (ref: tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, nd, autograd
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(4, 8))
+    p.initialize(init=mx.init.One())
+    assert p.data().shape == (4, 8)
+    assert (p.data().asnumpy() == 1).all()
+    assert p.grad().shape == (4, 8)
+    p.set_data(nd.zeros((4, 8)))
+    assert (p.data().asnumpy() == 0).all()
+
+
+def test_parameter_deferred():
+    p = gluon.Parameter("w", shape=(4, 0), allow_deferred_init=True)
+    p.initialize()
+    with pytest.raises(gluon.DeferredInitializationError):
+        p.data()
+    p.shape = (4, 7)
+    assert p.data().shape == (4, 7)
+
+
+def test_dense_deferred_shape():
+    net = nn.Dense(5)
+    net.initialize()
+    out = net(nd.ones((3, 11)))
+    assert out.shape == (3, 5)
+    assert net.weight.shape == (5, 11)
+
+
+def test_sequential_and_children():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8), nn.Activation("relu"), nn.Dense(2))
+    net.initialize()
+    assert len(net) == 3
+    y = net(nd.ones((4, 3)))
+    assert y.shape == (4, 2)
+    params = net.collect_params()
+    assert len(list(params.keys())) == 4
+
+
+def test_block_save_load(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net.initialize(mx.init.Xavier())
+    x = nd.ones((2, 4))
+    y1 = net(x).asnumpy()
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net2.load_parameters(f)
+    y2 = net2(x).asnumpy()
+    assert_almost_equal(y1, y2)
+
+
+def test_hybridize_consistency():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.randn(5, 8).astype("float32"))
+    y_eager = net(x).asnumpy()
+    net.hybridize()
+    y_hybrid = net(x).asnumpy()
+    assert_almost_equal(y_eager, y_hybrid, rtol=1e-5, atol=1e-6)
+
+
+def test_hybrid_batchnorm_aux_update():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1), nn.BatchNorm())
+    net.initialize()
+    net.hybridize()
+    bn = net[1]
+    x = nd.array(np.random.randn(2, 3, 8, 8).astype("float32"))
+    net(x)  # first forward resolves deferred shapes (predict: no stat update)
+    before = bn.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        net(x)
+    after = bn.running_mean.data().asnumpy()
+    assert not np.allclose(before, after)
+
+
+def test_gluon_trainer_convergence():
+    np.random.seed(0)
+    X = np.random.randn(400, 8).astype("float32")
+    W = np.random.randn(8, 1).astype("float32")
+    Y = X @ W + 0.01 * np.random.randn(400, 1).astype("float32")
+    net = nn.Dense(1)
+    net.initialize(mx.init.Normal(0.1))
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    L = gluon.loss.L2Loss()
+    for _ in range(50):
+        with autograd.record():
+            loss = L(net(nd.array(X)), nd.array(Y))
+        loss.backward()
+        trainer.step(400)
+    final = float(loss.mean().asscalar())
+    assert final < 0.01, final
+
+
+def test_losses_values():
+    L = gluon.loss.L2Loss()
+    a, b = nd.ones((2, 3)), nd.zeros((2, 3))
+    assert_almost_equal(L(a, b).asnumpy(), np.full(2, 0.5), rtol=1e-6)
+    L1 = gluon.loss.L1Loss()
+    assert_almost_equal(L1(a, b).asnumpy(), np.ones(2), rtol=1e-6)
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    pred = nd.array([[10.0, 0.0], [0.0, 10.0]])
+    label = nd.array([0.0, 1.0])
+    assert float(sce(pred, label).mean().asscalar()) < 0.01
+    hinge = gluon.loss.HingeLoss()
+    assert float(hinge(nd.array([[2.0]]), nd.array([[1.0]])).asscalar()) == 0.0
+
+
+def test_loss_grad_flows():
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        loss = L(net(nd.ones((4, 3))), nd.zeros((4,)))
+    loss.backward()
+    g = net.weight.grad().asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_lstm_layer_forward_backward():
+    lstm = gluon.rnn.LSTM(16, num_layers=2)
+    lstm.initialize(mx.init.Xavier())
+    x = nd.array(np.random.randn(5, 3, 8).astype("float32"))
+    with autograd.record():
+        out = lstm(x)
+        loss = out.sum()
+    loss.backward()
+    assert out.shape == (5, 3, 16)
+    p = lstm.collect_params()
+    some_w = [v for k, v in p.items() if k.endswith("l0_i2h_weight")][0]
+    assert np.abs(some_w.grad().asnumpy()).sum() > 0
+
+
+def test_gru_bidirectional_states():
+    gru = gluon.rnn.GRU(8, num_layers=1, bidirectional=True)
+    gru.initialize()
+    x = nd.array(np.random.randn(4, 2, 5).astype("float32"))
+    states = gru.begin_state(batch_size=2)
+    out, new_states = gru(x, states)
+    assert out.shape == (4, 2, 16)
+    assert new_states[0].shape == (2, 2, 8)
+
+
+def test_lstm_cell_unroll():
+    cell = gluon.rnn.LSTMCell(8)
+    cell.initialize()
+    x = nd.array(np.random.randn(2, 5, 4).astype("float32"))  # NTC
+    outputs, states = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 5, 8)
+    assert len(states) == 2
+
+
+def test_sequential_rnn_cells():
+    stack = gluon.rnn.SequentialRNNCell()
+    stack.add(gluon.rnn.LSTMCell(8))
+    stack.add(gluon.rnn.LSTMCell(8))
+    stack.initialize()
+    x = nd.ones((3, 4))
+    states = stack.begin_state(batch_size=3)
+    out, new_states = stack(x, states)
+    assert out.shape == (3, 8)
+    assert len(new_states) == 4
+
+
+def test_dataset_dataloader():
+    X = np.random.randn(20, 3).astype("float32")
+    Y = np.arange(20).astype("float32")
+    ds = gluon.data.ArrayDataset(X, Y)
+    assert len(ds) == 20
+    loader = gluon.data.DataLoader(ds, batch_size=5)
+    batches = list(loader)
+    assert len(batches) == 4
+    xb, yb = batches[0]
+    assert xb.shape == (5, 3)
+    loader2 = gluon.data.DataLoader(ds, batch_size=6, last_batch="discard", shuffle=True)
+    assert len(list(loader2)) == 3
+    loader3 = gluon.data.DataLoader(ds, batch_size=5, num_workers=2)
+    assert len(list(loader3)) == 4
+
+
+def test_dataset_transform():
+    ds = gluon.data.SimpleDataset(list(range(10)))
+    t = ds.transform(lambda x: x * 2)
+    assert t[3] == 6
+    tf = gluon.data.ArrayDataset(np.ones((4, 2), "float32"), np.zeros(4, "float32")).transform_first(
+        lambda x: x + 1
+    )
+    x, y = tf[0]
+    assert (x == 2).all() and y == 0
+
+
+def test_vision_transforms():
+    from incubator_mxnet_tpu.gluon.data.vision import transforms
+
+    img = nd.array((np.random.rand(8, 8, 3) * 255).astype("uint8"))
+    t = transforms.ToTensor()
+    out = t(img)
+    assert out.shape == (3, 8, 8)
+    assert out.asnumpy().max() <= 1.0
+    norm = transforms.Normalize(mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5))
+    out2 = norm(out)
+    assert out2.asnumpy().min() >= -1.01
+    comp = transforms.Compose([transforms.ToTensor(), norm])
+    assert comp(img).shape == (3, 8, 8)
+
+
+def test_synthetic_dataset():
+    from incubator_mxnet_tpu.gluon.data.vision import SyntheticImageDataset
+
+    ds = SyntheticImageDataset(num_samples=10, shape=(3, 8, 8), num_classes=4)
+    x, y = ds[0]
+    assert x.shape == (3, 8, 8) and 0 <= y < 4
+    # deterministic
+    x2, _ = ds[0]
+    assert_almost_equal(x.asnumpy(), x2.asnumpy())
+
+
+def test_split_and_load():
+    data = nd.array(np.arange(24).reshape(8, 3))
+    parts = gluon.utils.split_data(data, 4)
+    assert len(parts) == 4 and parts[0].shape == (2, 3)
+    norm = gluon.utils.clip_global_norm([nd.ones((2,)) * 3, nd.ones((2,)) * 4], 1.0)
+    assert abs(norm - np.sqrt(9 * 2 + 16 * 2)) < 1e-4
