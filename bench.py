@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 v1 training throughput (images/sec) on one
+TPU chip, matching the reference's measurement protocol
+(ref: example/image-classification/train_imagenet.py + docs/faq/perf.md:225 —
+synthetic data, SGD momentum, batch 128, fp32 baseline 363.69 img/s on V100).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, fused, gluon
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    batch_size = int(os.environ.get("BENCH_BATCH", "128"))
+    image_size = int(os.environ.get("BENCH_IMAGE", "224"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+    iters = int(os.environ.get("BENCH_ITERS", "30"))
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    baseline = 363.69  # MXNet-CUDA ResNet-50 v1 fp32 bs128 on V100 (perf.md:225)
+
+    mx.random.seed(0)
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+    if dtype == "bfloat16":
+        net.cast("bfloat16")
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    opt = mx.optimizer.SGD(learning_rate=0.05, momentum=0.9, wd=1e-4,
+                           rescale_grad=1.0 / batch_size)
+
+    def loss_fn(n, x, y):
+        return L(n(x), y)
+
+    step = fused.GluonTrainStep(net, loss_fn, opt)
+
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(batch_size, 3, image_size, image_size).astype(np.float32))
+    if dtype == "bfloat16":
+        x = x.astype("bfloat16")
+    y = nd.array(rng.randint(0, 1000, size=batch_size).astype(np.float32))
+
+    for _ in range(warmup):
+        loss = step(x, y)
+    loss.wait_to_read()
+
+    start = time.perf_counter()
+    for _ in range(iters):
+        loss = step(x, y)
+    loss.wait_to_read()
+    elapsed = time.perf_counter() - start
+
+    ips = batch_size * iters / elapsed
+    print(json.dumps({
+        "metric": "resnet50_v1_train_images_per_sec",
+        "value": round(ips, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(ips / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,158 @@
+"""Fused training steps.
+
+TPU-native analog of the reference's bulked execution: where the graph
+executor pre-creates engine ops and bulks whole fwd/bwd segments
+(ref: graph_executor.cc InitCachedOps:1073, InitOpSegs:1187,
+MXNET_EXEC_BULK_EXEC_*), here the ENTIRE training step — forward, backward,
+and optimizer update — is one jit-compiled XLA program with parameter
+buffers donated, so updates are in-place in HBM and the only per-step host
+work is the dispatch call.
+
+Under a mesh, inputs sharded on the batch axis + replicated params make the
+same program data-parallel: GSPMD inserts the gradient all-reduce over ICI
+(the kvstore='device'/'nccl' path of the reference).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from . import random as _global_random
+from .gluon.block import _ParamSubst
+from .ndarray.ndarray import NDArray
+
+__all__ = ["GluonTrainStep"]
+
+
+class GluonTrainStep:
+    """Compile net+loss+optimizer into one donated-buffer step.
+
+    step(x, y) -> loss (device scalar, async). Parameters and optimizer
+    states live as jax arrays owned by this object and are written back into
+    the net's Parameters after every step (same objects, rebound data).
+    """
+
+    def __init__(self, net, loss_fn, optimizer, mesh=None, batch_axis=0):
+        self.net = net
+        self.loss_fn = loss_fn
+        self.opt = optimizer
+        self.mesh = mesh
+        self._built = False
+        self._n = 0
+        if not hasattr(self.opt, "fused_update"):
+            raise TypeError(
+                f"{type(self.opt).__name__} has no fused_update; use the eager path"
+            )
+
+    def _build(self, x, y):
+        # warmup eager forward resolves deferred parameter shapes
+        with autograd.pause():
+            self.loss_fn(self.net, x, y)
+        net = self.net
+        params = list(net.collect_params().items())
+        self.names = [n for n, _ in params]
+        self.param_objs = [p for _, p in params]
+        self.grad_mask = [p.grad_req != "null" for p in self.param_objs]
+        self._states = [
+            self._state_data(self.opt.create_state(i, p.data())) if m else None
+            for i, (p, m) in enumerate(zip(self.param_objs, self.grad_mask))
+        ]
+        self._params = [p.data()._data for p in self.param_objs]
+        mesh = self.mesh
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(mesh, P())
+            self._params = [jax.device_put(d, rep) for d in self._params]
+            self._states = jax.tree_util.tree_map(
+                lambda d: jax.device_put(d, rep), self._states
+            )
+            self._data_sharding = NamedSharding(mesh, P("data"))
+        else:
+            self._data_sharding = None
+        self._step = jax.jit(self._make_step(), donate_argnums=(0, 1))
+        self._built = True
+
+    @staticmethod
+    def _state_data(state):
+        if state is None:
+            return None
+        if isinstance(state, (tuple, list)):
+            return tuple(s._data if isinstance(s, NDArray) else s for s in state)
+        return state._data if isinstance(state, NDArray) else state
+
+    def _make_step(self):
+        names = self.names
+        grad_names = [n for n, m in zip(names, self.grad_mask) if m]
+
+        def forward(grad_params, other_params, x, y, key):
+            mapping = {}
+            for n, d in zip(grad_names, grad_params):
+                mapping[n] = NDArray._from_data(d)
+            for n, d in other_params.items():
+                mapping[n] = NDArray._from_data(d)
+            prev_t = autograd.set_training(True)
+            prev_r = autograd.set_recording(False)
+            try:
+                with _ParamSubst(mapping), _global_random.key_override(key):
+                    loss = self.loss_fn(self.net, NDArray._from_data(x), NDArray._from_data(y))
+            finally:
+                autograd.set_training(prev_t)
+                autograd.set_recording(prev_r)
+            loss_data = jnp.mean(loss._data)
+            # aux state updates (BN running stats) show up as rebound arrays
+            aux_new = {
+                n: mapping[n]._data
+                for n in other_params
+                if mapping[n]._data is not other_params[n]
+            }
+            return loss_data, aux_new
+
+        def step(params, states, x, y, key, lr):
+            grad_params = [d for d, m in zip(params, self.grad_mask) if m]
+            other_params = {
+                n: d for n, d, m in zip(names, params, self.grad_mask) if not m
+            }
+            (loss, aux_new), grads = jax.value_and_grad(forward, has_aux=True)(
+                grad_params, other_params, x, y, key
+            )
+            new_params, new_states = [], []
+            gi = 0
+            for i, (n, d, m) in enumerate(zip(names, params, self.grad_mask)):
+                if m:
+                    w, st = self.opt.fused_update(n, d, grads[gi], states[i], lr)
+                    gi += 1
+                    new_params.append(w)
+                    new_states.append(st)
+                else:
+                    new_params.append(aux_new.get(n, d))
+                    new_states.append(None)
+            return loss, new_params, new_states
+
+        return step
+
+    def __call__(self, x, y):
+        if not self._built:
+            self._build(
+                x if isinstance(x, NDArray) else NDArray(jnp.asarray(x)),
+                y if isinstance(y, NDArray) else NDArray(jnp.asarray(y)),
+            )
+        xd = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        yd = y._data if isinstance(y, NDArray) else jnp.asarray(y)
+        if self._data_sharding is not None:
+            xd = jax.device_put(xd, self._data_sharding)
+            yd = jax.device_put(yd, self._data_sharding)
+        key = _global_random.next_key()
+        self._n += 1
+        self.opt.num_update = self._n
+        lr = self.opt.lr_scheduler(self._n) if self.opt.lr_scheduler else self.opt.lr
+        loss, self._params, self._states = self._step(
+            self._params, self._states, xd, yd, key, jnp.asarray(lr, jnp.float32),
+        )
+        return NDArray._from_data(loss)
+
+    def sync_params(self):
+        """Write current param values back into the net's Parameters."""
+        for p, d in zip(self.param_objs, self._params):
+            p._data._data = d

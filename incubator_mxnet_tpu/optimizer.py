@@ -570,3 +570,61 @@ class Updater:
 
 def get_updater(optimizer):
     return Updater(optimizer)
+
+
+# ---------------------------------------------------------------------------
+# Pure fused-update hooks used by fused.GluonTrainStep (traced inside jit;
+# everything here is jnp math on raw arrays).
+# ---------------------------------------------------------------------------
+
+
+def _sgd_fused(self, name, weight, grad, state, lr):
+    g = grad * self.rescale_grad
+    if self.clip_gradient:
+        g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+    wd = self.wd * self.wd_mult.get(name, 1.0)
+    lr = lr * self.lr_mult.get(name, 1.0)
+    g = g + wd * weight
+    if self.momentum != 0.0 and state is not None:
+        new_mom = self.momentum * state - lr * g
+        return weight + new_mom, new_mom
+    return weight - lr * g, None
+
+
+SGD.fused_update = _sgd_fused
+LBSGD.fused_update = _sgd_fused
+
+
+def _nag_fused(self, name, weight, grad, state, lr):
+    g = grad * self.rescale_grad
+    if self.clip_gradient:
+        g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+    g = g + self.wd * weight
+    if self.momentum != 0.0 and state is not None:
+        new_mom = self.momentum * state + g
+        return weight - lr * (g + self.momentum * new_mom), new_mom
+    return weight - lr * g, None
+
+
+NAG.fused_update = _nag_fused
+
+
+def _adam_fused(self, name, weight, grad, state, lr):
+    g = grad * self.rescale_grad
+    if self.clip_gradient:
+        g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+    g = g + self.wd * weight
+    mean, var = state
+    t = jnp.maximum(jnp.asarray(float(self.num_update)), 1.0)
+    new_mean = self.beta1 * mean + (1 - self.beta1) * g
+    new_var = self.beta2 * var + (1 - self.beta2) * jnp.square(g)
+    coef1 = 1.0 - self.beta1 ** t
+    coef2 = 1.0 - self.beta2 ** t
+    lr_t = lr * jnp.sqrt(coef2) / coef1
+    return (
+        weight - lr_t * new_mean / (jnp.sqrt(new_var) + self.epsilon),
+        (new_mean, new_var),
+    )
+
+
+Adam.fused_update = _adam_fused
