@@ -1,0 +1,9 @@
+"""Symbol-API model builders (ref: example/image-classification/symbols/).
+
+These build `Symbol` graphs for the Module training path; the Gluon twins
+live in gluon.model_zoo.
+"""
+from . import lenet, mlp, resnet, alexnet  # noqa: F401
+from .lenet import get_symbol as get_lenet  # noqa: F401
+from .mlp import get_symbol as get_mlp  # noqa: F401
+from .resnet import get_symbol as get_resnet  # noqa: F401

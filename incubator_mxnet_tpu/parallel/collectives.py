@@ -28,7 +28,7 @@ def allreduce(values, mesh=None, axis_name="data"):
 def psum_in_shardmap(x, mesh, axis_name="data"):
     fn = jax.shard_map(
         lambda v: jax.lax.psum(v, axis_name),
-        mesh=mesh, in_specs=P(axis_name), out_specs=P(),
+        mesh=mesh, in_specs=P(axis_name), out_specs=P(), check_vma=False,
     )
     return fn(x)
 
@@ -36,7 +36,7 @@ def psum_in_shardmap(x, mesh, axis_name="data"):
 def allgather(x, mesh, axis_name="data"):
     fn = jax.shard_map(
         lambda v: jax.lax.all_gather(v, axis_name, tiled=True),
-        mesh=mesh, in_specs=P(axis_name), out_specs=P(),
+        mesh=mesh, in_specs=P(axis_name), out_specs=P(), check_vma=False,
     )
     return fn(x)
 
@@ -44,7 +44,7 @@ def allgather(x, mesh, axis_name="data"):
 def reduce_scatter(x, mesh, axis_name="data"):
     fn = jax.shard_map(
         lambda v: jax.lax.psum_scatter(v, axis_name, tiled=True),
-        mesh=mesh, in_specs=P(None), out_specs=P(axis_name),
+        mesh=mesh, in_specs=P(None), out_specs=P(axis_name), check_vma=False,
     )
     return fn(x)
 

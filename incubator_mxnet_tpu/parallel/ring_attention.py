@@ -1,0 +1,120 @@
+"""Ring attention: sequence/context parallelism over ICI.
+
+Capability beyond the reference (SURVEY §5.7: the 2019 framework had only
+bucketing + fused RNN for long sequences). TPU-native design: the sequence
+axis is sharded over a mesh axis; K/V blocks rotate around the ring via
+`lax.ppermute` while each device accumulates flash-style online-softmax
+partial results for its local Q block — memory per device is O(T/N), and the
+K/V transfers overlap compute around the ICI ring (cf. Liu et al., Ring
+Attention with Blockwise Transformers, 2023).
+
+Also provides the all-to-all ("Ulysses"-style) variant that reshards
+sequence -> heads for regular attention.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ring_attention", "ring_self_attention_sharded", "ulysses_attention"]
+
+_NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, bias=None):
+    """One q-block x k-block partial attention with running-softmax stats.
+
+    q: (B, Tq, H, D); k,v: (B, Tk, H, D). Returns (o_partial, lse_partial)
+    where o_partial is unnormalized (sum of softmax-numerator * v) given the
+    local max; summary stats merge across blocks.
+    """
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    if bias is not None:
+        logits = logits + bias
+    m = jnp.max(logits, axis=-1)  # (B, H, Tq)
+    p = jnp.exp(logits - m[..., None])
+    l = jnp.sum(p, axis=-1)  # (B, H, Tq)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return o, m, l
+
+
+def _merge(o1, m1, l1, o2, m2, l2):
+    """Merge two online-softmax partials (flash-attention accumulate)."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    l = l1 * a1 + l2 * a2
+    o = o1 * a1.transpose(0, 2, 1)[..., None] + o2 * a2.transpose(0, 2, 1)[..., None]
+    return o, m, l
+
+
+def ring_attention(q, k, v, axis_name, causal=False):
+    """Attention over a ring-sharded sequence; call inside shard_map.
+
+    Per-device shapes: q,k,v (B, T_local, H, D); the global sequence is the
+    concatenation over the `axis_name` mesh axis. Returns (B, T_local, H, D).
+    """
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def make_bias(block_idx):
+        if not causal:
+            return None
+        # global positions: q rows at my*Tq..., k cols at block_idx*Tk...
+        q_pos = my * Tq + jnp.arange(Tq)
+        k_pos = block_idx * k.shape[1] + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        return jnp.where(mask, 0.0, _NEG_INF)[None, None]
+
+    def body(carry, _):
+        o, m, l, k_cur, v_cur, idx = carry
+        o2, m2, l2 = _block_attn(q, k_cur, v_cur, make_bias(idx))
+        o, m, l = _merge(o, m, l, o2, m2, l2)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        idx_nxt = lax.ppermute(idx, axis_name, perm)
+        return (o, m, l, k_nxt, v_nxt, idx_nxt), None
+
+    o0 = lax.pvary(jnp.zeros((B, Tq, H, D), q.dtype), (axis_name,))
+    m0 = lax.pvary(jnp.full((B, H, Tq), _NEG_INF, q.dtype), (axis_name,))
+    l0 = lax.pvary(jnp.zeros((B, H, Tq), q.dtype), (axis_name,))
+    (o, m, l, _, _, _), _ = lax.scan(body, (o0, m0, l0, k, v, my), None, length=n)
+    return o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+
+
+def ring_self_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False):
+    """Convenience wrapper: global (B, T, H, D) arrays, sequence sharded on
+    `axis_name`; runs ring_attention under shard_map."""
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    sharding = NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(t, sharding) for t in (q, k, v))
+    return fn(q, k, v)
+
+
+def ulysses_attention(q, k, v, axis_name):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style): reshard
+    sequence-sharded (B, T/N, H, D) to head-sharded (B, T, H/N, D) with
+    all_to_all, run full attention locally, reshard back. Call inside
+    shard_map over `axis_name`."""
+    def seq_to_heads(t):
+        # (B, T/N, H, D) -> (B, T, H/N, D)
+        return lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def heads_to_seq(t):
+        return lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) / jnp.sqrt(qh.shape[-1]).astype(q.dtype)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vh)
+    return heads_to_seq(out)

@@ -1,0 +1,145 @@
+"""Parallelism tests on the virtual 8-device CPU mesh
+(the reference's analog: tests/nightly dist kvstore suites run multi-process
+on one host; here sharding runs multi-device in one process).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, parallel
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _mesh(n=8, name="data"):
+    return Mesh(np.array(jax.devices()[:n]), axis_names=(name,))
+
+
+def test_make_mesh():
+    mesh = parallel.make_mesh()
+    assert mesh.devices.size == 8
+    mesh2 = parallel.make_nd_mesh({"dp": 2, "tp": 4})
+    assert mesh2.axis_names == ("dp", "tp")
+
+
+def test_psum_allgather():
+    mesh = _mesh()
+    x = jnp.arange(16.0)
+    s = parallel.collectives.psum_in_shardmap(x, mesh)
+    # psum of shards = sum over devices of local shards -> replicated total sum per element? 
+    # each shard is 2 elems; psum sums the 8 shards elementwise -> shape (2,)
+    expect = x.reshape(8, 2).sum(0)
+    assert np.allclose(np.asarray(s), np.asarray(expect))
+    g = parallel.collectives.allgather(x, mesh)
+    assert np.allclose(np.asarray(g), np.asarray(x))
+
+
+def test_data_parallel_grads_match_single():
+    """DP over 8 devices == single-device grads (the kvstore='device' oracle)."""
+    from incubator_mxnet_tpu import gluon, fused
+    from incubator_mxnet_tpu.gluon import nn
+
+    def build(seed):
+        mx.random.seed(seed)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu", in_units=8), nn.Dense(3, in_units=16))
+        net.initialize(mx.init.Xavier())
+        return net
+
+    np.random.seed(0)
+    X = np.random.randn(16, 8).astype("float32")
+    Y = np.random.randint(0, 3, 16).astype("float32")
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    net1 = build(7)
+    opt1 = mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0)
+    step1 = fused.GluonTrainStep(net1, lambda n, x, y: L(n(x), y), opt1)
+    l1 = float(step1(nd.array(X), nd.array(Y)).asscalar())
+    step1.sync_params()
+
+    net2 = build(7)
+    opt2 = mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0)
+    mesh = _mesh()
+    step2 = fused.GluonTrainStep(net2, lambda n, x, y: L(n(x), y), opt2, mesh=mesh)
+    l2 = float(step2(nd.array(X), nd.array(Y)).asscalar())
+    step2.sync_params()
+
+    assert abs(l1 - l2) < 1e-5
+    for (n1, p1), (n2, p2) in zip(net1.collect_params().items(),
+                                  net2.collect_params().items()):
+        assert_almost_equal(p1.data().asnumpy(), p2.data().asnumpy(),
+                            rtol=1e-4, atol=1e-5, names=(n1, n2))
+
+
+def test_ring_attention_matches_full():
+    mesh = _mesh(8, name="sp")
+    B, T, H, D = 2, 32, 4, 8
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, T, H, D).astype("float32"))
+    k = jnp.asarray(rng.randn(B, T, H, D).astype("float32"))
+    v = jnp.asarray(rng.randn(B, T, H, D).astype("float32"))
+
+    out_ring = parallel.ring_self_attention_sharded(q, k, v, mesh, axis_name="sp")
+    # dense reference
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    p = jax.nn.softmax(jnp.asarray(logits), axis=-1)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    assert np.allclose(np.asarray(out_ring), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_ring_attention_causal():
+    mesh = _mesh(4, name="sp")
+    mesh = Mesh(np.array(jax.devices()[:4]), axis_names=("sp",))
+    B, T, H, D = 1, 16, 2, 4
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(B, T, H, D).astype("float32"))
+    k = jnp.asarray(rng.randn(B, T, H, D).astype("float32"))
+    v = jnp.asarray(rng.randn(B, T, H, D).astype("float32"))
+    out = parallel.ring_self_attention_sharded(q, k, v, mesh, axis_name="sp", causal=True)
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    mask = np.tril(np.ones((T, T), bool))
+    logits = np.where(mask[None, None], logits, -1e30)
+    p = jax.nn.softmax(jnp.asarray(logits), axis=-1)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    assert np.allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_ulysses_attention_matches_full():
+    mesh = _mesh(4, name="sp")
+    B, T, H, D = 2, 16, 8, 4
+    rng = np.random.RandomState(2)
+    q = jnp.asarray(rng.randn(B, T, H, D).astype("float32"))
+    k = jnp.asarray(rng.randn(B, T, H, D).astype("float32"))
+    v = jnp.asarray(rng.randn(B, T, H, D).astype("float32"))
+    spec = P(None, "sp", None, None)
+    fn = jax.shard_map(
+        lambda a, b, c: parallel.ulysses_attention(a, b, c, "sp"),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    out = fn(q, k, v)
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    p = jax.nn.softmax(jnp.asarray(logits), axis=-1)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    assert np.allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_module_multi_context():
+    """Module with 8 cpu contexts = DataParallelExecutorGroup analog."""
+    from incubator_mxnet_tpu import sym
+
+    X = np.random.randn(64, 10).astype("float32")
+    W = np.random.randn(10, 3)
+    Y = np.argmax(X @ W, axis=1).astype("float32")
+    train = mx.io.NDArrayIter(X, Y, batch_size=16)
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=3, name="fc")
+    net = sym.SoftmaxOutput(net, sym.Variable("softmax_label"), name="softmax")
+    ctxs = [mx.cpu(i) for i in range(8)]
+    mod = mx.module.Module(net, context=ctxs)
+    mod.fit(train, optimizer="sgd", num_epoch=3, initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.5})
+    acc = mod.score(train, "acc")[0][1]
+    assert acc > 0.8, acc
