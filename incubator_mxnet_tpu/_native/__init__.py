@@ -1,0 +1,80 @@
+"""Native (C++) runtime components, built lazily with g++.
+
+Where the reference's runtime is C++ (engine, io, storage — SURVEY §2), the
+TPU build keeps native code for the pieces XLA does not subsume: the host
+data path (recordio) and host-side scheduling. Libraries are compiled on
+first use into the package directory and loaded via ctypes; every consumer
+has a pure-Python fallback so the framework works without a toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "src")
+_LOCK = threading.Lock()
+_LIBS: dict = {}
+
+
+def _build(name: str, sources: list[str]) -> str | None:
+    out = os.path.join(_HERE, f"lib{name}.so")
+    srcs = [os.path.join(_SRC, s) for s in sources]
+    if os.path.exists(out) and all(
+        os.path.getmtime(out) >= os.path.getmtime(s) for s in srcs
+    ):
+        return out
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread", "-o", out] + srcs
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return out
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired, FileNotFoundError):
+        return None
+
+
+def load(name: str, sources: list[str]):
+    """Build+load libname.so; returns ctypes CDLL or None."""
+    with _LOCK:
+        if name in _LIBS:
+            return _LIBS[name]
+        path = _build(name, sources)
+        lib = None
+        if path is not None:
+            try:
+                lib = ctypes.CDLL(path)
+            except OSError:
+                lib = None
+        _LIBS[name] = lib
+        return lib
+
+
+def recordio_lib():
+    lib = load("mxtpu_recordio", ["recordio.cc"])
+    if lib is not None and not getattr(lib, "_rio_configured", False):
+        lib.rio_open_reader.restype = ctypes.c_void_p
+        lib.rio_open_reader.argtypes = [ctypes.c_char_p]
+        lib.rio_close_reader.argtypes = [ctypes.c_void_p]
+        lib.rio_num_records.restype = ctypes.c_int64
+        lib.rio_num_records.argtypes = [ctypes.c_void_p]
+        lib.rio_record.restype = ctypes.c_int
+        lib.rio_record.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
+        lib.rio_record_len.restype = ctypes.c_int64
+        lib.rio_record_len.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.rio_read_batch.restype = ctypes.c_int
+        lib.rio_read_batch.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.rio_open_writer.restype = ctypes.c_void_p
+        lib.rio_open_writer.argtypes = [ctypes.c_char_p]
+        lib.rio_write.restype = ctypes.c_int64
+        lib.rio_write.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32]
+        lib.rio_close_writer.argtypes = [ctypes.c_void_p]
+        lib._rio_configured = True
+    return lib

@@ -219,3 +219,95 @@ def unpack_img(s, iscolor=-1):
     header, s = unpack(s)
     img = cv2.imdecode(np.frombuffer(s, dtype=np.uint8), iscolor)
     return header, img
+
+
+# ---------------------------------------------------------------------------
+# Native (C++) fast path — mmap + thread-pool batch reads (src/recordio.cc).
+# ---------------------------------------------------------------------------
+
+
+class NativeRecordReader:
+    """Zero-copy random-access reader over the same on-disk format, backed by
+    the C++ engine (the reference's C++ recordio/threaded-reader analog)."""
+
+    def __init__(self, uri):
+        from . import _native
+
+        self._lib = _native.recordio_lib()
+        if self._lib is None:
+            raise RuntimeError("native recordio library unavailable (g++ build failed)")
+        self._handle = self._lib.rio_open_reader(uri.encode())
+        if not self._handle:
+            raise IOError(f"cannot open {uri}")
+        self.uri = uri
+
+    def __len__(self):
+        return int(self._lib.rio_num_records(self._handle))
+
+    def read(self, i):
+        data = ctypes.POINTER(ctypes.c_uint8)()
+        length = ctypes.c_uint32()
+        rc = self._lib.rio_record(self._handle, i, ctypes.byref(data), ctypes.byref(length))
+        if rc != 0:
+            raise IndexError(i)
+        return ctypes.string_at(data, length.value)
+
+    def read_batch(self, indices):
+        """Parallel fetch of many records -> list[bytes]."""
+        n = len(indices)
+        idx = (ctypes.c_int64 * n)(*indices)
+        lens = [int(self._lib.rio_record_len(self._handle, i)) for i in indices]
+        offsets, acc = [], 0
+        for ln in lens:
+            offsets.append(acc)
+            acc += ln
+        buf = (ctypes.c_uint8 * max(acc, 1))()
+        offs = (ctypes.c_int64 * n)(*offsets)
+        rc = self._lib.rio_read_batch(self._handle, idx, n, buf, offs)
+        if rc != 0:
+            raise IOError("batch read failed")
+        raw = bytes(buf)
+        return [raw[o : o + ln] for o, ln in zip(offsets, lens)]
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.rio_close_reader(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeRecordWriter:
+    """C++ writer producing the same shard format."""
+
+    def __init__(self, uri):
+        from . import _native
+
+        self._lib = _native.recordio_lib()
+        if self._lib is None:
+            raise RuntimeError("native recordio library unavailable")
+        self._handle = self._lib.rio_open_writer(uri.encode())
+        if not self._handle:
+            raise IOError(f"cannot open {uri}")
+
+    def write(self, buf):
+        arr = (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf)
+        pos = self._lib.rio_write(self._handle, arr, len(buf))
+        if pos < 0:
+            raise IOError("write failed")
+        return int(pos)
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.rio_close_writer(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

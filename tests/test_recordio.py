@@ -50,3 +50,38 @@ def test_pack_img_roundtrip():
     h2, img2 = recordio.unpack_img(s)
     assert img2.shape == (8, 8, 3)
     assert np.array_equal(img, img2)  # png is lossless
+
+
+def test_native_reader_interop(tmp_path):
+    """C++ mmap reader reads shards written by the Python writer, and vice
+    versa (same on-disk framing)."""
+    pytest_skip = None
+    from incubator_mxnet_tpu import recordio as rio
+
+    path = str(tmp_path / "native.rec")
+    w = rio.MXRecordIO(path, "w")
+    payloads = [bytes([i]) * (i * 7 + 1) for i in range(20)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    try:
+        r = rio.NativeRecordReader(path)
+    except RuntimeError:
+        import pytest
+
+        pytest.skip("native lib unavailable")
+    assert len(r) == 20
+    assert r.read(3) == payloads[3]
+    batch = r.read_batch([0, 5, 19])
+    assert batch == [payloads[0], payloads[5], payloads[19]]
+    r.close()
+    # native writer -> python reader
+    path2 = str(tmp_path / "native2.rec")
+    w2 = rio.NativeRecordWriter(path2)
+    for p in payloads[:5]:
+        w2.write(p)
+    w2.close()
+    pr = rio.MXRecordIO(path2, "r")
+    for p in payloads[:5]:
+        assert pr.read() == p
+    pr.close()
