@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Gluon imperative training example (ref: example/gluon/mnist.py)."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.gluon import nn
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--hybridize", action="store_true")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    proto = rng.rand(10, 1, 28, 28).astype("float32")
+    y = rng.randint(0, 10, 4000)
+    X = proto[y] + 0.1 * rng.randn(4000, 1, 28, 28).astype("float32")
+    dataset = gluon.data.ArrayDataset(X, y.astype("float32"))
+    loader = gluon.data.DataLoader(dataset, batch_size=args.batch_size, shuffle=True)
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(20, 5, activation="relu"), nn.MaxPool2D(2, 2),
+            nn.Conv2D(50, 5, activation="relu"), nn.MaxPool2D(2, 2),
+            nn.Flatten(), nn.Dense(500, activation="relu"), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    if args.hybridize:
+        net.hybridize()
+
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        total, correct, cum_loss = 0, 0, 0.0
+        for data, label in loader:
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            cum_loss += float(loss.mean().asscalar()) * data.shape[0]
+            correct += int((out.asnumpy().argmax(1) == label.asnumpy()).sum())
+            total += data.shape[0]
+        logging.info("epoch %d loss %.4f acc %.4f", epoch, cum_loss / total, correct / total)
+
+
+if __name__ == "__main__":
+    main()

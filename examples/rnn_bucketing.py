@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""LSTM language model with BucketingModule (ref: example/rnn/bucketing/
+lstm_bucketing.py + python/mxnet/rnn BucketSentenceIter pattern).
+
+Trains on synthetic text when no corpus is given.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import sym
+from incubator_mxnet_tpu.io import DataBatch, DataDesc, DataIter
+
+
+class BucketSentenceIter(DataIter):
+    """Bucketed sentence iterator (ref: rnn/io.py:84 BucketSentenceIter)."""
+
+    def __init__(self, sentences, batch_size, buckets, invalid_label=0,
+                 data_name="data", label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data_name, self.label_name = data_name, label_name
+        self.buckets = sorted(buckets)
+        self.data = {b: [] for b in self.buckets}
+        for s in sentences:
+            for b in self.buckets:
+                if len(s) < b:
+                    padded = np.full(b, invalid_label, "float32")
+                    padded[: len(s)] = s
+                    self.data[b].append(padded)
+                    break
+        self.batches = []
+        for b, rows in self.data.items():
+            rows = np.array(rows, dtype="float32")
+            for i in range(0, len(rows) - batch_size + 1, batch_size):
+                self.batches.append((b, rows[i : i + batch_size]))
+        self.default_bucket_key = max(self.buckets)
+        self.cur = 0
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name, (self.batch_size, self.default_bucket_key))]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name, (self.batch_size, self.default_bucket_key))]
+
+    def reset(self):
+        self.cur = 0
+        np.random.shuffle(self.batches)
+
+    def next(self):
+        if self.cur >= len(self.batches):
+            raise StopIteration
+        b, rows = self.batches[self.cur]
+        self.cur += 1
+        data = rows[:, :-1] if rows.shape[1] > 1 else rows
+        label = rows[:, 1:] if rows.shape[1] > 1 else rows
+        return DataBatch(
+            data=[mx.nd.array(data)], label=[mx.nd.array(label)], bucket_key=b - 1,
+            provide_data=[DataDesc(self.data_name, data.shape)],
+            provide_label=[DataDesc(self.label_name, label.shape)],
+        )
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-hidden", type=int, default=128)
+    p.add_argument("--num-embed", type=int, default=64)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-epochs", type=int, default=2)
+    p.add_argument("--vocab", type=int, default=100)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    # synthetic "language": markov chain over vocab
+    rng = np.random.RandomState(0)
+    sentences = []
+    for _ in range(2000):
+        L = rng.randint(5, 33)
+        s = [rng.randint(1, args.vocab)]
+        for _ in range(L - 1):
+            s.append((s[-1] * 7 + rng.randint(0, 3)) % (args.vocab - 1) + 1)
+        sentences.append(np.array(s))
+    buckets = [8, 16, 24, 33]
+    train = BucketSentenceIter(sentences, args.batch_size, buckets)
+
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        label = sym.Variable("softmax_label")
+        embed = sym.Embedding(data, input_dim=args.vocab, output_dim=args.num_embed,
+                              name="embed")
+        x = sym.transpose(embed, axes=(1, 0, 2))  # (T, B, E)
+        out = sym.RNN(x, state_size=args.num_hidden, num_layers=args.num_layers,
+                      mode="lstm", name="lstm")
+        out = sym.transpose(out, axes=(1, 0, 2))  # (B, T, H)
+        pred = sym.Reshape(out, shape=(-3, -2))
+        pred = sym.FullyConnected(pred, num_hidden=args.vocab, name="pred")
+        lab = sym.Reshape(label, shape=(-1,))
+        pred = sym.SoftmaxOutput(pred, lab, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    mod = mx.module.BucketingModule(sym_gen, default_bucket_key=train.default_bucket_key - 1,
+                                    context=mx.cpu())
+    mod.fit(
+        train, eval_metric=mx.metric.Perplexity(ignore_label=None),
+        optimizer="adam", optimizer_params={"learning_rate": 0.01},
+        initializer=mx.init.Xavier(), num_epoch=args.num_epochs,
+        batch_end_callback=mx.callback.Speedometer(args.batch_size, 20),
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""ResNet ImageNet training harness (ref:
+example/image-classification/train_imagenet.py + common/fit.py:148).
+
+Reads ImageRecordIter shards when --data-train is given; otherwise runs on
+synthetic data (the reference's benchmark mode: train_imagenet.py
+--benchmark 1).
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.models import resnet
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-layers", type=int, default=50)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--num-epochs", type=int, default=1)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--image-shape", default="3,224,224")
+    p.add_argument("--data-train", default=None, help=".rec shard path")
+    p.add_argument("--benchmark", type=int, default=1)
+    p.add_argument("--num-batches", type=int, default=50)
+    p.add_argument("--kv-store", default="device")
+    args = p.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    net = resnet.get_symbol(args.num_classes, args.num_layers, args.image_shape)
+
+    if args.data_train:
+        from incubator_mxnet_tpu.image import ImageIter
+
+        train = ImageIter(args.batch_size, shape, path_imgrec=args.data_train,
+                          shuffle=True, rand_crop=True, rand_mirror=True)
+    else:
+        rng = np.random.RandomState(0)
+        n = args.batch_size * args.num_batches
+        X = rng.rand(n, *shape).astype("float32")
+        y = rng.randint(0, args.num_classes, n).astype("float32")
+        train = mx.io.NDArrayIter(X, y, args.batch_size)
+
+    mod = mx.module.Module(net, context=mx.tpu() if mx.num_tpus() else mx.cpu())
+    mod.fit(
+        train, optimizer="sgd",
+        optimizer_params={"learning_rate": args.lr, "momentum": 0.9, "wd": 1e-4},
+        initializer=mx.init.Xavier(rnd_type="gaussian", factor_type="in", magnitude=2),
+        num_epoch=args.num_epochs, kvstore=args.kv_store,
+        batch_end_callback=mx.callback.Speedometer(args.batch_size, 10),
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Train LeNet/MLP on MNIST with the Module API
+(ref: example/image-classification/train_mnist.py:97).
+
+Uses local MNIST idx files if present (--data-dir), else a synthetic
+stand-in (zero-egress environment).
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import models
+
+
+def get_mnist_iters(batch_size, data_dir):
+    try:
+        from incubator_mxnet_tpu.gluon.data.vision import MNIST
+
+        train = MNIST(root=data_dir, train=True)
+        val = MNIST(root=data_dir, train=False)
+        Xtr = np.stack([train._data[i] for i in range(len(train))]).astype("float32") / 255.0
+        Xtr = Xtr.transpose(0, 3, 1, 2)
+        ytr = train._label.astype("float32")
+        Xv = np.stack([val._data[i] for i in range(len(val))]).astype("float32") / 255.0
+        Xv = Xv.transpose(0, 3, 1, 2)
+        yv = val._label.astype("float32")
+    except FileNotFoundError:
+        logging.warning("MNIST files not found under %s; using synthetic digits", data_dir)
+        rng = np.random.RandomState(0)
+        n = 6000
+        proto = rng.rand(10, 1, 28, 28).astype("float32")
+        y = rng.randint(0, 10, n)
+        X = proto[y] + 0.1 * rng.randn(n, 1, 28, 28).astype("float32")
+        Xtr, ytr = X[:5000], y[:5000].astype("float32")
+        Xv, yv = X[5000:], y[5000:].astype("float32")
+    return (
+        mx.io.NDArrayIter(Xtr, ytr, batch_size, shuffle=True),
+        mx.io.NDArrayIter(Xv, yv, batch_size),
+    )
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--network", default="lenet", choices=["lenet", "mlp"])
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--num-epochs", type=int, default=3)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--data-dir", default=os.path.expanduser("~/.mxnet/datasets/mnist"))
+    p.add_argument("--kv-store", default="local")
+    p.add_argument("--ctx", default="tpu", choices=["cpu", "tpu", "gpu"])
+    args = p.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    train, val = get_mnist_iters(args.batch_size, args.data_dir)
+    net = models.get_lenet(10) if args.network == "lenet" else models.get_mlp(10)
+    ctx = {"cpu": mx.cpu(), "tpu": mx.tpu(), "gpu": mx.gpu()}[args.ctx]
+    mod = mx.module.Module(net, context=ctx)
+    mod.fit(
+        train, eval_data=val, optimizer="sgd",
+        optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+        initializer=mx.init.Xavier(), num_epoch=args.num_epochs,
+        kvstore=args.kv_store,
+        batch_end_callback=mx.callback.Speedometer(args.batch_size, 50),
+    )
+    acc = mod.score(val, "acc")
+    logging.info("final validation %s", acc)
+
+
+if __name__ == "__main__":
+    main()
