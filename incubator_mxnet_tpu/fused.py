@@ -46,10 +46,32 @@ class GluonTrainStep:
             )
 
     def _build(self, x, y):
-        # warmup eager forward resolves deferred parameter shapes
-        with autograd.pause():
-            self.loss_fn(self.net, x, y)
+        # resolve deferred parameter shapes abstractly: eval_shape traces the
+        # forward without touching the device (no per-op dispatch/compile)
+        def warm(xd, yd):
+            # predict mode: BN must not write (traced) aux values into the
+            # real parameter arrays during this abstract pass
+            prev = autograd.set_training(False)
+            try:
+                return self.loss_fn(
+                    self.net, NDArray._from_data(xd), NDArray._from_data(yd)
+                )._data
+            finally:
+                autograd.set_training(prev)
+
+        from .gluon.parameter import abstract_init_mode
+
+        with abstract_init_mode():
+            jax.eval_shape(
+                warm,
+                jax.ShapeDtypeStruct(x.shape, x._data.dtype),
+                jax.ShapeDtypeStruct(y.shape, y._data.dtype),
+            )
         net = self.net
+        # materialize any still-deferred params concretely (outside trace)
+        for _n, _p in net.collect_params().items():
+            if _p._data is None and _p._deferred_init is not None and _p._shape_known():
+                _p._finish_deferred_init()
         params = list(net.collect_params().items())
         self.names = [n for n, _ in params]
         self.param_objs = [p for _, p in params]

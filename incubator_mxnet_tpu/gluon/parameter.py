@@ -6,6 +6,8 @@ arrays.
 """
 from __future__ import annotations
 
+import contextlib
+import threading
 from collections import OrderedDict
 
 import numpy as np
@@ -22,6 +24,26 @@ __all__ = ["DeferredInitializationError", "Parameter", "Constant", "ParameterDic
 
 class DeferredInitializationError(Exception):
     """(ref: parameter.py DeferredInitializationError)"""
+
+
+_ABSTRACT = threading.local()
+
+
+@contextlib.contextmanager
+def abstract_init_mode():
+    """While active, deferred params resolve SHAPES but do not materialize
+    arrays (used when shape inference runs inside a jax trace — creating
+    values there would leak tracers into global state)."""
+    prev = getattr(_ABSTRACT, "on", False)
+    _ABSTRACT.on = True
+    try:
+        yield
+    finally:
+        _ABSTRACT.on = prev
+
+
+def _abstract_mode():
+    return getattr(_ABSTRACT, "on", False)
 
 
 class Parameter:
@@ -94,6 +116,8 @@ class Parameter:
             )
 
     def _finish_deferred_init(self):
+        if _abstract_mode():
+            return  # shape now known; materialize later, outside the trace
         initializer, ctx = self._deferred_init
         arr = nd_zeros(self._shape, ctx=ctx, dtype=self.dtype)
         initializer(init_mod.InitDesc(self.name, {"__init__": None}), arr)
@@ -110,6 +134,9 @@ class Parameter:
     # -- access ------------------------------------------------------------
     def data(self, ctx=None):
         if self._data is None:
+            if self._deferred_init is not None and _abstract_mode() and self._shape_known():
+                # inside an abstract (eval_shape) pass: a trace-local dummy
+                return NDArray._from_data(jnp.zeros(self._shape, dtype_np(self.dtype)))
             if self._deferred_init is not None:
                 raise DeferredInitializationError(
                     f"parameter {self.name} deferred (shape {self._shape})"

@@ -16,15 +16,15 @@ __all__ = ["seed", "next_key", "current_seed"]
 
 _LOCK = threading.Lock()
 _SEED = 0
-_KEY = None
+_COUNTER = 0
 
 
 def seed(seed_state, ctx="all"):
     """Seed the global generator (ref: mx.random.seed)."""
-    global _SEED, _KEY
+    global _SEED, _COUNTER
     with _LOCK:
         _SEED = int(seed_state)
-        _KEY = jax.random.PRNGKey(_SEED)
+        _COUNTER = 0
 
 
 def current_seed():
@@ -32,19 +32,20 @@ def current_seed():
 
 
 def next_key():
-    """Return a fresh PRNG key (thread-safe split of the root key). Under
-    `key_override` (hybrid tracing) splits the overridden key instead."""
-    global _KEY
+    """Return a fresh PRNG key. The global state is (seed, counter) on the
+    HOST — keys derive via fold_in, so calling inside a jax trace never leaks
+    a traced key into global state. Under `key_override` (hybrid tracing) the
+    overridden key is split instead."""
+    global _COUNTER
     override = getattr(_OVERRIDE, "key", None)
     if override is not None:
         new, sub = jax.random.split(override)
         _OVERRIDE.key = new
         return sub
     with _LOCK:
-        if _KEY is None:
-            _KEY = jax.random.PRNGKey(_SEED)
-        _KEY, sub = jax.random.split(_KEY)
-        return sub
+        _COUNTER += 1
+        c = _COUNTER
+    return jax.random.fold_in(jax.random.PRNGKey(_SEED), c)
 
 
 import contextlib as _contextlib
