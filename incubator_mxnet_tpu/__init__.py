@@ -54,3 +54,6 @@ from . import test_utils  # noqa: F401
 from . import visualization  # noqa: F401
 from . import visualization as viz  # noqa: F401
 from .util import is_np_array  # noqa: F401
+from . import operator  # noqa: F401
+from . import contrib  # noqa: F401
+from . import fused  # noqa: F401

@@ -1,0 +1,5 @@
+"""Contrib: control flow, AMP, quantization (ref: python/mxnet/contrib/)."""
+from . import control_flow  # noqa: F401
+from .control_flow import foreach, while_loop, cond  # noqa: F401
+from . import amp  # noqa: F401
+from . import quantization  # noqa: F401

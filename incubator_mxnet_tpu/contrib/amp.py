@@ -1,0 +1,55 @@
+"""Automatic mixed precision (ref: python/mxnet/contrib/amp precursor).
+
+TPU-native stance: bfloat16 is the native MXU dtype — no loss scaling is
+required (unlike fp16 on the reference's GPUs). `convert_model` /
+`convert_block` cast parameters and compute to bf16 while keeping
+normalization statistics and optimizer state in fp32.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["init", "convert_block", "convert_model", "scale_loss"]
+
+_F32_KEEP_SUFFIXES = ("gamma", "beta", "running_mean", "running_var",
+                      "moving_mean", "moving_var")
+
+
+def init(target_dtype="bfloat16"):
+    """Enable AMP defaults (kept for API parity; casting is explicit)."""
+    return target_dtype
+
+
+def convert_block(block, target_dtype="bfloat16"):
+    """Cast a Gluon block to bf16 compute, fp32 norm statistics."""
+    for name, p in block.collect_params().items():
+        if name.endswith(_F32_KEEP_SUFFIXES):
+            continue
+        p.cast(target_dtype)
+    return block
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16"):
+    """Cast a symbolic checkpoint (ref: amp convert_model)."""
+    new_args = {}
+    for k, v in arg_params.items():
+        if k.endswith(_F32_KEEP_SUFFIXES):
+            new_args[k] = v
+        else:
+            new_args[k] = v.astype(target_dtype)
+    return sym, new_args, dict(aux_params)
+
+
+class scale_loss:
+    """Loss-scaling context (ref: amp.scale_loss). On TPU bf16 has fp32-range
+    exponent so scale defaults to 1; kept for fp16 compat."""
+
+    def __init__(self, loss, optimizer_or_trainer, scale=1.0):
+        self._loss = loss
+        self._scale = scale
+
+    def __enter__(self):
+        return self._loss * self._scale if self._scale != 1.0 else self._loss
+
+    def __exit__(self, *exc):
+        return False

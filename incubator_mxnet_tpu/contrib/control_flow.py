@@ -1,0 +1,128 @@
+"""Differentiable control flow (ref: src/operator/control_flow.cc —
+_foreach:1255, _while_loop:1316, _cond:1378).
+
+TPU-native: these lower directly to lax.scan / lax.while_loop / lax.cond —
+compiled loops with O(1) program size in the trip count, which the reference
+needed a subgraph-op mechanism to achieve.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import autograd
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _wrap(x):
+    return NDArray._from_data(x)
+
+
+def _data(x):
+    return x._data if isinstance(x, NDArray) else jnp.asarray(x)
+
+
+def foreach(body, data, init_states):
+    """Scan `body(x_t, states) -> (out_t, new_states)` over axis 0 of data
+    (ref: contrib.foreach / _foreach op). Differentiable end-to-end."""
+    single_data = isinstance(data, NDArray)
+    datas = [data] if single_data else list(data)
+    single_state = isinstance(init_states, NDArray)
+    states = [init_states] if single_state else list(init_states)
+
+    def fn(*leaf_datas):
+        n = len(datas)
+        xs = leaf_datas[:n]
+        st = [d for d in leaf_datas[n:]]
+
+        def scan_body(carry, x_slices):
+            c_nd = [_wrap(c) for c in carry]
+            x_nd = [_wrap(x) for x in x_slices]
+            out, new_states = body(
+                x_nd[0] if single_data else x_nd,
+                c_nd[0] if single_state else c_nd,
+            )
+            outs = [out] if isinstance(out, NDArray) else list(out)
+            ns = [new_states] if isinstance(new_states, NDArray) else list(new_states)
+            return tuple(_data(s) for s in ns), tuple(_data(o) for o in outs)
+
+        final, ys = lax.scan(scan_body, tuple(st), tuple(xs))
+        return tuple(ys) + tuple(final)
+
+    all_inputs = datas + states
+    results = autograd.invoke_recorded(fn, all_inputs, name="foreach")
+    x0 = [_wrap(_data(d)[0]) for d in datas]
+    out_probe, st_probe = body(
+        x0[0] if single_data else x0,
+        states[0] if single_state else states,
+    )
+    n_out = 1 if isinstance(out_probe, NDArray) else len(out_probe)
+    outs = results[:n_out]
+    finals = results[n_out:]
+    out_val = outs[0] if (n_out == 1 and isinstance(out_probe, NDArray)) else outs
+    st_val = finals[0] if single_state else list(finals)
+    return out_val, st_val
+
+
+def while_loop(cond_fn, func, loop_vars, max_iterations=None):
+    """(ref: _while_loop op). Runs func while cond holds; bounded by
+    max_iterations with a scan so shapes stay static (XLA requirement —
+    the reference pads outputs the same way)."""
+    single = isinstance(loop_vars, NDArray)
+    lvars = [loop_vars] if single else list(loop_vars)
+    assert max_iterations is not None, "max_iterations required for static shapes"
+
+    def fn(*leaf):
+        def scan_body(carry, _):
+            active, vals = carry
+            v_nd = [_wrap(v) for v in vals]
+            c = cond_fn(v_nd[0] if single else v_nd)
+            c = _data(c).reshape(()).astype(bool) & active
+            out, new_vals = func(v_nd[0] if single else v_nd)
+            nv = [new_vals] if isinstance(new_vals, NDArray) else list(new_vals)
+            stepped = tuple(
+                jnp.where(c, _data(n), v) for n, v in zip(nv, vals)
+            )
+            outs = [out] if isinstance(out, NDArray) else list(out)
+            o_vals = tuple(jnp.where(c, _data(o), jnp.zeros_like(_data(o))) for o in outs)
+            return (c, stepped), o_vals
+
+        (_, final_vals), ys = lax.scan(
+            scan_body, (jnp.asarray(True), tuple(leaf)), None, length=max_iterations
+        )
+        return tuple(ys) + tuple(final_vals)
+
+    probe_out, _ = func(lvars[0] if single else lvars)
+    n_out = 1 if isinstance(probe_out, NDArray) else len(probe_out)
+    results = autograd.invoke_recorded(fn, lvars, name="while_loop")
+    outs = results[:n_out]
+    finals = results[n_out:]
+    return (outs[0] if n_out == 1 else outs), (finals[0] if single else list(finals))
+
+
+def cond(pred, then_func, else_func, inputs=None):
+    """(ref: _cond op) -> lax.cond."""
+    inputs = inputs or []
+    single = isinstance(inputs, NDArray)
+    ins = [inputs] if single else list(inputs)
+
+    def fn(p, *leaf):
+        def then_branch(vals):
+            v = [_wrap(x) for x in vals]
+            out = then_func(*v) if v else then_func()
+            outs = [out] if isinstance(out, NDArray) else list(out)
+            return tuple(_data(o) for o in outs)
+
+        def else_branch(vals):
+            v = [_wrap(x) for x in vals]
+            out = else_func(*v) if v else else_func()
+            outs = [out] if isinstance(out, NDArray) else list(out)
+            return tuple(_data(o) for o in outs)
+
+        return lax.cond(p.reshape(()).astype(bool), then_branch, else_branch, leaf)
+
+    results = autograd.invoke_recorded(fn, [pred] + ins, name="cond")
+    return results if len(results) > 1 else results[0]

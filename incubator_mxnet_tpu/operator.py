@@ -1,0 +1,142 @@
+"""Custom operators defined in Python.
+
+TPU-native equivalent of the reference's custom-op bridge (ref:
+src/operator/custom/custom-inl.h:95, python/mxnet/operator.py CustomOp/
+CustomOpProp). The reference runs Python ops on a dedicated thread pool so
+the engine never blocks on the GIL; here the host callback mechanism is
+`jax.pure_callback` — XLA suspends the device computation, runs the Python
+body on the host, and resumes, which composes with jit/grad via
+jax.custom_vjp.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .ndarray.ndarray import NDArray
+from .ops.registry import register as _register_op
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_custom_op"]
+
+_CUSTOM_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for custom operator bodies (ref: operator.py CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        if req in ("write", "inplace", None):
+            dst._data = src._data if isinstance(src, NDArray) else jnp.asarray(src)
+        elif req == "add":
+            dst._data = dst._data + (src._data if isinstance(src, NDArray) else jnp.asarray(src))
+
+
+class CustomOpProp:
+    """Operator metadata (ref: operator.py CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """Register a CustomOpProp subclass under a name
+    (ref: mx.operator.register -> MXCustomOpRegister)."""
+
+    def deco(prop_cls):
+        _CUSTOM_REGISTRY[reg_name] = prop_cls
+        _register_custom_as_op(reg_name, prop_cls)
+        return prop_cls
+
+    return deco
+
+
+def get_custom_op(name):
+    return _CUSTOM_REGISTRY[name]
+
+
+def _register_custom_as_op(reg_name, prop_cls):
+    """Surface the custom op as nd.Custom-style callable: runs the Python
+    forward/backward through pure_callback with a custom_vjp."""
+
+    def call(*inputs, **kwargs):
+        prop = prop_cls(**kwargs)
+        arg_names = prop.list_arguments()
+        n_out = len(prop.list_outputs())
+        in_arrays = [a if isinstance(a, NDArray) else NDArray(jnp.asarray(a)) for a in inputs]
+        in_shapes = [a.shape for a in in_arrays]
+        _, out_shapes, _ = prop.infer_shape(list(in_shapes))
+        in_dtypes = [a.dtype for a in in_arrays]
+        op = prop.create_operator(None, in_shapes, in_dtypes)
+        out_avals = [
+            jax.ShapeDtypeStruct(tuple(s), np.float32) for s in out_shapes
+        ]
+
+        def host_forward(*datas):
+            ins = [NDArray(np.asarray(d)) for d in datas]
+            outs = [NDArray(np.zeros(s, np.float32)) for s in out_shapes]
+            op.forward(True, ["write"] * n_out, ins, outs, [])
+            return tuple(np.asarray(o.asnumpy()) for o in outs)
+
+        def host_backward(*datas):
+            k = len(in_arrays)
+            ins = [NDArray(np.asarray(d)) for d in datas[:k]]
+            outs = [NDArray(np.asarray(d)) for d in datas[k : k + n_out]]
+            ograds = [NDArray(np.asarray(d)) for d in datas[k + n_out :]]
+            igrads = [NDArray(np.zeros(s.shape, np.float32)) for s in ins]
+            op.backward(["write"] * k, ograds, ins, outs, igrads, [])
+            return tuple(np.asarray(g.asnumpy()) for g in igrads)
+
+        @jax.custom_vjp
+        def fwd(*datas):
+            return jax.pure_callback(host_forward, tuple(out_avals), *datas)
+
+        def fwd_fwd(*datas):
+            outs = jax.pure_callback(host_forward, tuple(out_avals), *datas)
+            return outs, (datas, outs)
+
+        def fwd_bwd(res, gs):
+            datas, outs = res
+            in_avals = tuple(jax.ShapeDtypeStruct(d.shape, d.dtype) for d in datas)
+            grads = jax.pure_callback(host_backward, in_avals, *(datas + outs + tuple(gs)))
+            return grads
+
+        fwd.defvjp(fwd_fwd, fwd_bwd)
+
+        results = autograd.invoke_recorded(
+            lambda *ds: fwd(*ds), in_arrays, name=f"custom:{reg_name}"
+        )
+        return results if len(results) > 1 else results[0]
+
+    from . import ndarray as nd_mod
+
+    setattr(nd_mod, f"Custom_{reg_name}", call)
+    return call

@@ -1,0 +1,107 @@
+"""Contrib tests: control flow (ref: test_contrib_control_flow.py), custom op
+(ref: test_operator.py custom-op sections), quantization, amp."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd
+from incubator_mxnet_tpu.contrib import foreach, while_loop, cond
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_foreach_cumsum():
+    data = nd.array(np.arange(5, dtype="float32"))
+    init = nd.zeros(())
+
+    def body(x, s):
+        new = s + x
+        return new, new
+
+    outs, final = foreach(body, data, init)
+    assert_almost_equal(outs.asnumpy(), np.array([0, 1, 3, 6, 10], "float32"))
+    assert float(final.asscalar()) == 10
+
+
+def test_foreach_grad():
+    data = nd.array(np.array([1.0, 2.0, 3.0], "float32"))
+    data.attach_grad()
+    init = nd.ones(())
+    with autograd.record():
+        outs, final = foreach(lambda x, s: (x * s, s), data, init)
+        loss = outs.sum()
+    loss.backward()
+    assert_almost_equal(data.grad.asnumpy(), np.ones(3))
+
+
+def test_while_loop():
+    def cond_fn(v):
+        return v[0] < 20
+
+    def body_fn(v):
+        return v[0], [v[0] * 2]
+
+    outs, final = while_loop(cond_fn, body_fn, [nd.array([2.0])], max_iterations=10)
+    assert float(final[0].asnumpy()[0]) >= 20
+
+
+def test_cond():
+    x = nd.array([3.0])
+    out = cond(nd.array([1.0]), lambda v: v * 2, lambda v: v * 10, [x])
+    assert float(out.asnumpy()[0]) == 6.0
+    out = cond(nd.array([0.0]), lambda v: v * 2, lambda v: v * 10, [x])
+    assert float(out.asnumpy()[0]) == 30.0
+
+
+def test_custom_op():
+    from incubator_mxnet_tpu import operator as op
+
+    class Square(op.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0], nd.array(in_data[0].asnumpy() ** 2))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self.assign(in_grad[0], req[0],
+                        nd.array(2 * in_data[0].asnumpy() * out_grad[0].asnumpy()))
+
+    @op.register("square_test")
+    class SquareProp(op.CustomOpProp):
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            return Square()
+
+    x = nd.array(np.array([1.0, 2.0, 3.0], "float32"))
+    x.attach_grad()
+    fn = op.get_custom_op("square_test")
+    from incubator_mxnet_tpu import ndarray as ndm
+
+    call = getattr(ndm, "Custom_square_test")
+    with autograd.record():
+        y = call(x)
+    assert_almost_equal(y.asnumpy(), np.array([1.0, 4.0, 9.0]))
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), np.array([2.0, 4.0, 6.0]))
+
+
+def test_quantization_roundtrip():
+    from incubator_mxnet_tpu.contrib import quantization as q
+
+    w = nd.array(np.random.randn(16, 16).astype("float32"))
+    qw, mn, mx_ = q.quantize(w)
+    assert qw.dtype == np.int8
+    back = q.dequantize(qw, mn, mx_)
+    err = np.abs(back.asnumpy() - w.asnumpy()).max()
+    assert err < float(mx_.asscalar()) / 127.0 + 1e-6
+
+
+def test_amp_convert_block():
+    from incubator_mxnet_tpu.contrib import amp
+    from incubator_mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.BatchNorm(in_channels=4))
+    net.initialize()
+    amp.convert_block(net)
+    assert net[0].weight.data().dtype.name == "bfloat16"
+    assert net[1].gamma.data().dtype == np.float32
