@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""Collective bandwidth measurement (ref: tools/bandwidth/measure.py — the
+kvstore bandwidth harness). Measures all-reduce throughput over the local
+mesh (ICI on real pods, host RAM on the CPU mesh).
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--size-mb", type=float, default=64)
+    p.add_argument("--iters", type=int, default=10)
+    args = p.parse_args()
+
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices), axis_names=("d",))
+    n = int(args.size_mb * 1e6 / 4)
+    n = (n // len(devices)) * len(devices)
+    x = jnp.arange(n, dtype=jnp.float32)
+    x = jax.device_put(x, NamedSharding(mesh, P("d")))
+
+    allreduce = jax.jit(jax.shard_map(
+        lambda v: jax.lax.psum(v, "d"), mesh=mesh,
+        in_specs=P("d"), out_specs=P(), check_vma=False,
+    ))
+    allreduce(x).block_until_ready()  # compile
+    start = time.perf_counter()
+    for _ in range(args.iters):
+        out = allreduce(x)
+    out.block_until_ready()
+    dt = (time.perf_counter() - start) / args.iters
+    gb = n * 4 / 1e9
+    print(f"devices={len(devices)} size={gb:.3f}GB allreduce={dt*1e3:.2f}ms "
+          f"bus_bw={2*(len(devices)-1)/len(devices)*gb/dt:.2f}GB/s")
+
+
+if __name__ == "__main__":
+    main()

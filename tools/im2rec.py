@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Pack an image directory/list into RecordIO shards
+(ref: tools/im2rec.py / tools/im2rec.cc). Uses the native C++ writer when
+available.
+"""
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from incubator_mxnet_tpu import recordio
+
+
+def list_images(root, recursive=True):
+    exts = {".jpg", ".jpeg", ".png"}
+    cat = {}
+    items = []
+    i = 0
+    for path, dirs, files in sorted(os.walk(root)):
+        dirs.sort()
+        for fname in sorted(files):
+            if os.path.splitext(fname)[1].lower() not in exts:
+                continue
+            label_name = os.path.relpath(path, root)
+            if label_name not in cat:
+                cat[label_name] = len(cat)
+            items.append((i, os.path.join(path, fname), cat[label_name]))
+            i += 1
+        if not recursive:
+            break
+    return items
+
+
+def write_list(items, path):
+    with open(path, "w") as f:
+        for idx, fname, label in items:
+            f.write(f"{idx}\t{label}\t{fname}\n")
+
+
+def read_list(path):
+    items = []
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            items.append((int(parts[0]), parts[-1], float(parts[1])))
+    return items
+
+
+def main():
+    import cv2
+
+    p = argparse.ArgumentParser()
+    p.add_argument("prefix")
+    p.add_argument("root")
+    p.add_argument("--list", action="store_true", help="only create the .lst file")
+    p.add_argument("--resize", type=int, default=0)
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--shuffle", type=int, default=1)
+    p.add_argument("--num-parts", type=int, default=1)
+    args = p.parse_args()
+
+    lst = args.prefix + ".lst"
+    if args.list or not os.path.exists(lst):
+        items = list_images(args.root)
+        if args.shuffle:
+            random.seed(100)
+            random.shuffle(items)
+        write_list(items, lst)
+        if args.list:
+            return
+    items = read_list(lst)
+
+    n = len(items)
+    per = (n + args.num_parts - 1) // args.num_parts
+    for part in range(args.num_parts):
+        suffix = f".part{part}" if args.num_parts > 1 else ""
+        rec = recordio.MXIndexedRecordIO(args.prefix + suffix + ".idx",
+                                         args.prefix + suffix + ".rec", "w")
+        for idx, fname, label in items[part * per : (part + 1) * per]:
+            img = cv2.imread(fname)
+            if img is None:
+                continue
+            if args.resize:
+                h, w = img.shape[:2]
+                if h > w:
+                    img = cv2.resize(img, (args.resize, args.resize * h // w))
+                else:
+                    img = cv2.resize(img, (args.resize * w // h, args.resize))
+            header = recordio.IRHeader(0, label, idx, 0)
+            rec.write_idx(idx, recordio.pack_img(header, img, args.quality, ".jpg"))
+        rec.close()
+        print(f"wrote {args.prefix + suffix}.rec")
+
+
+if __name__ == "__main__":
+    main()

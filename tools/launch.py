@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Distributed job launcher (ref: tools/launch.py -> dmlc tracker).
+
+TPU-native: instead of scheduler/server/worker roles over ZMQ, every process
+is a JAX distributed client (jax.distributed.initialize) and gradients ride
+DCN/ICI collectives. Supports local multi-process launch (the reference's
+`--launcher local` used by the nightly dist tests) and ssh host lists.
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("-n", "--num-workers", type=int, required=True)
+    p.add_argument("--launcher", default="local", choices=["local", "ssh"])
+    p.add_argument("-H", "--hostfile", default=None)
+    p.add_argument("--coordinator", default="127.0.0.1:12345")
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    args = p.parse_args()
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    assert cmd, "no command given"
+
+    if args.launcher == "local":
+        procs = []
+        for rank in range(args.num_workers):
+            env = dict(os.environ)
+            env.update({
+                "MXTPU_COORDINATOR": args.coordinator,
+                "MXTPU_NUM_PROCESSES": str(args.num_workers),
+                "MXTPU_PROCESS_ID": str(rank),
+                # reference-compatible names (ref: DMLC_ROLE env protocol)
+                "DMLC_ROLE": "worker",
+                "DMLC_NUM_WORKER": str(args.num_workers),
+                "DMLC_WORKER_ID": str(rank),
+            })
+            procs.append(subprocess.Popen(cmd, env=env))
+        rc = 0
+        for proc in procs:
+            rc |= proc.wait()
+        sys.exit(rc)
+    else:
+        hosts = [h.strip() for h in open(args.hostfile) if h.strip()]
+        procs = []
+        for rank in range(args.num_workers):
+            host = hosts[rank % len(hosts)]
+            remote_env = (
+                f"MXTPU_COORDINATOR={args.coordinator} "
+                f"MXTPU_NUM_PROCESSES={args.num_workers} MXTPU_PROCESS_ID={rank}"
+            )
+            procs.append(subprocess.Popen(
+                ["ssh", host, remote_env + " " + " ".join(cmd)]
+            ))
+        rc = 0
+        for proc in procs:
+            rc |= proc.wait()
+        sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()
