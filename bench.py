@@ -30,10 +30,16 @@ def main():
     baseline = 363.69  # MXNet-CUDA ResNet-50 v1 fp32 bs128 on V100 (perf.md:225)
 
     mx.random.seed(0)
-    net = vision.resnet50_v1(classes=1000)
-    net.initialize(mx.init.Xavier())
-    if dtype == "bfloat16":
-        net.cast("bfloat16")
+    # build + initialize on host CPU: avoids hundreds of tiny per-param
+    # device programs; one bulk transfer moves weights to the chip
+    cpu0 = jax.devices("cpu")[0]
+    accel = [d for d in jax.devices() if d.platform != "cpu"]
+    target = accel[0] if accel else cpu0
+    with jax.default_device(cpu0):
+        net = vision.resnet50_v1(classes=1000)
+        net.initialize(mx.init.Xavier())
+        if dtype == "bfloat16":
+            net.cast("bfloat16")
     L = gluon.loss.SoftmaxCrossEntropyLoss()
     opt = mx.optimizer.SGD(learning_rate=0.05, momentum=0.9, wd=1e-4,
                            rescale_grad=1.0 / batch_size)
@@ -41,13 +47,18 @@ def main():
     def loss_fn(n, x, y):
         return L(n(x), y)
 
-    step = fused.GluonTrainStep(net, loss_fn, opt)
+    step = fused.GluonTrainStep(net, loss_fn, opt, device=target)
 
     rng = np.random.RandomState(0)
-    x = nd.array(rng.rand(batch_size, 3, image_size, image_size).astype(np.float32))
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    xd = rng.rand(batch_size, 3, image_size, image_size).astype(np.float32)
     if dtype == "bfloat16":
-        x = x.astype("bfloat16")
-    y = nd.array(rng.randint(0, 1000, size=batch_size).astype(np.float32))
+        xd = xd.astype(ml_dtypes.bfloat16)
+    x = nd.array(jax.device_put(jnp.asarray(xd), target))
+    y = nd.array(jax.device_put(
+        jnp.asarray(rng.randint(0, 1000, size=batch_size).astype(np.float32)), target))
 
     for _ in range(warmup):
         loss = step(x, y)

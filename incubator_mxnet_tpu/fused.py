@@ -33,11 +33,12 @@ class GluonTrainStep:
     the net's Parameters after every step (same objects, rebound data).
     """
 
-    def __init__(self, net, loss_fn, optimizer, mesh=None, batch_axis=0):
+    def __init__(self, net, loss_fn, optimizer, mesh=None, batch_axis=0, device=None):
         self.net = net
         self.loss_fn = loss_fn
         self.opt = optimizer
         self.mesh = mesh
+        self.device = device  # single target device (e.g. the TPU chip)
         self._built = False
         self._n = 0
         if not hasattr(self.opt, "fused_update"):
@@ -81,6 +82,12 @@ class GluonTrainStep:
             for i, (p, m) in enumerate(zip(self.param_objs, self.grad_mask))
         ]
         self._params = [p.data()._data for p in self.param_objs]
+        if self.device is not None and self.mesh is None:
+            # bulk host->device transfer of params/states (init ran on host)
+            self._params = [jax.device_put(d, self.device) for d in self._params]
+            self._states = jax.tree_util.tree_map(
+                lambda d: jax.device_put(d, self.device), self._states
+            )
         mesh = self.mesh
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -165,6 +172,9 @@ class GluonTrainStep:
         if self._data_sharding is not None:
             xd = jax.device_put(xd, self._data_sharding)
             yd = jax.device_put(yd, self._data_sharding)
+        elif self.device is not None:
+            xd = jax.device_put(xd, self.device)
+            yd = jax.device_put(yd, self.device)
         key = _global_random.next_key()
         self._n += 1
         self.opt.num_update = self._n
