@@ -57,3 +57,4 @@ from .util import is_np_array  # noqa: F401
 from . import operator  # noqa: F401
 from . import contrib  # noqa: F401
 from . import fused  # noqa: F401
+from . import rtc  # noqa: F401

@@ -1,0 +1,88 @@
+"""Pallas TPU kernels for hot ops.
+
+Where the reference reaches for hand-written CUDA (ref: SURVEY §2 N6/N8),
+the TPU build authors Pallas kernels. First kernel: fused flash attention —
+blocked over VMEM with online softmax, never materializing the (T, T) score
+matrix in HBM. Falls back to `interpret=True` off-TPU so the same code runs
+in CPU tests.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_len, causal, scale):
+    # one grid step handles one (batch*head, q_block); loops over k blocks
+    q = q_ref[...]  # (block_q, d)
+    block_q, d = q.shape
+    q_idx = pl.program_id(1)
+
+    def body(start, carry):
+        o, m, l = carry
+        k = k_ref[pl.ds(start * block_k, block_k), :]
+        v = v_ref[pl.ds(start * block_k, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = start * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        o_new = o * alpha[:, None] + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        )
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    num_k = seq_len // block_k
+    o, m, l = jax.lax.fori_loop(0, num_k, body, (o0, m0, l0))
+    o_ref[...] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, causal=False, block_q=128, block_k=128, interpret=None):
+    """Fused attention: q,k,v (B, H, T, D) -> (B, H, T, D).
+
+    Blocked flash-attention Pallas kernel; O(T) HBM, scores live in VMEM.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    B, H, T, D = q.shape
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    assert T % block_q == 0 and T % block_k == 0, "seq len must divide blocks"
+    scale = 1.0 / np.sqrt(D)
+
+    qr = q.reshape(B * H, T, D)
+    kr = k.reshape(B * H, T, D)
+    vr = v.reshape(B * H, T, D)
+
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, seq_len=T, causal=causal, scale=scale
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, T // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(B, H, T, D)

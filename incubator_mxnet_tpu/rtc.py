@@ -1,0 +1,59 @@
+"""Runtime-compiled custom kernels.
+
+TPU-native equivalent of the reference's NVRTC bridge (ref: src/common/rtc.cc
+:31-69, python/mxnet/rtc.py CudaModule): where the reference compiles CUDA C
+source at runtime, here user kernels are Pallas functions compiled by Mosaic
+for the TPU's VMEM/MXU/VPU. `PallasModule` keeps the CudaModule UX: wrap a
+kernel, get a callable with declared signature.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .ndarray.ndarray import NDArray
+
+__all__ = ["PallasModule", "CudaModule"]
+
+
+class PallasModule:
+    """Hold a user Pallas kernel and expose launchable functions
+    (API shape ref: python/mxnet/rtc.py CudaModule.get_kernel)."""
+
+    def __init__(self, kernel_fn, interpret=None):
+        self._kernel = kernel_fn
+        if interpret is None:
+            interpret = jax.default_backend() == "cpu"
+        self._interpret = interpret
+
+    def get_kernel(self, out_shape, out_dtype="float32", grid=None,
+                   in_specs=None, out_specs=None, **pallas_kwargs):
+        from jax.experimental import pallas as pl
+
+        def launch(*arrays):
+            datas = [a._data if isinstance(a, NDArray) else jnp.asarray(a) for a in arrays]
+            kw = dict(pallas_kwargs)
+            if grid is not None:
+                kw["grid"] = grid
+            if in_specs is not None:
+                kw["in_specs"] = in_specs
+            if out_specs is not None:
+                kw["out_specs"] = out_specs
+            call = pl.pallas_call(
+                self._kernel,
+                out_shape=jax.ShapeDtypeStruct(tuple(out_shape), np.dtype(out_dtype)),
+                interpret=self._interpret,
+                **kw,
+            )
+            return NDArray._from_data(call(*datas))
+
+        return launch
+
+
+def CudaModule(*args, **kwargs):  # pragma: no cover - parity shim
+    raise NotImplementedError(
+        "CUDA runtime compilation does not exist on TPU; use rtc.PallasModule "
+        "to author runtime-compiled Pallas kernels"
+    )
