@@ -44,3 +44,72 @@ def load_checkpoint(prefix, epoch):
     symbol = sym.load(f"{prefix}-symbol.json")
     arg_params, aux_params = load_params(prefix, epoch)
     return symbol, arg_params, aux_params
+
+
+class FeedForward:
+    """Legacy training API (ref: python/mxnet/model.py FeedForward) — thin
+    wrapper over Module kept for reference-script compatibility."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, learning_rate=0.01, **kwargs):
+        from .module import Module
+
+        self._symbol = symbol
+        self._ctx = ctx
+        self._num_epoch = num_epoch
+        self._optimizer = optimizer
+        self._initializer = initializer
+        self._arg_params = arg_params
+        self._aux_params = aux_params
+        self._begin_epoch = begin_epoch
+        self._lr = learning_rate
+        self._opt_kwargs = kwargs
+        self._module = None
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        from .module import Module
+        from . import io as io_mod
+        import numpy as _np
+
+        if not isinstance(X, io_mod.DataIter):
+            X = io_mod.NDArrayIter(X, y, batch_size=128, shuffle=True)
+        self._module = Module(self._symbol, context=self._ctx)
+        opt_params = {"learning_rate": self._lr}
+        opt_params.update({k: v for k, v in self._opt_kwargs.items()
+                           if k in ("momentum", "wd", "clip_gradient", "rescale_grad")})
+        self._module.fit(
+            X, eval_data=eval_data, eval_metric=eval_metric,
+            epoch_end_callback=epoch_end_callback,
+            batch_end_callback=batch_end_callback, kvstore=kvstore,
+            optimizer=self._optimizer, optimizer_params=opt_params,
+            initializer=self._initializer, arg_params=self._arg_params,
+            aux_params=self._aux_params, begin_epoch=self._begin_epoch,
+            num_epoch=self._num_epoch, monitor=monitor,
+        )
+        return self
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        from . import io as io_mod
+
+        if not isinstance(X, io_mod.DataIter):
+            X = io_mod.NDArrayIter(X, batch_size=128)
+        return self._module.predict(X, num_batch=num_batch, reset=reset).asnumpy()
+
+    def score(self, X, eval_metric="acc", num_batch=None, **kwargs):
+        return self._module.score(X, eval_metric, num_batch=num_batch)[0][1]
+
+    def save(self, prefix, epoch=None):
+        arg, aux = self._module.get_params()
+        save_checkpoint(prefix, epoch if epoch is not None else self._num_epoch,
+                        self._symbol, arg, aux)
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch, **kwargs)

@@ -1,0 +1,110 @@
+"""Misc subsystem tests: profiler, engine, runtime, visualization, monitor,
+check_consistency oracle, model FeedForward, SymbolBlock."""
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, sym
+from incubator_mxnet_tpu.test_utils import check_consistency, assert_almost_equal
+
+
+def test_engine_waitall_and_bulk():
+    from incubator_mxnet_tpu import engine
+
+    engine.waitall()
+    with engine.bulk(30):
+        x = nd.ones((4, 4)) * 2
+    assert (x.asnumpy() == 2).all()
+
+
+def test_runtime_features():
+    feats = mx.runtime.feature_list()
+    names = {f.name for f in feats}
+    assert "XLA" in names and "PALLAS" in names
+    f = mx.runtime.Features()
+    assert f.is_enabled("CPU")
+
+
+def test_profiler_smoke(tmp_path):
+    mx.profiler.set_config(filename=str(tmp_path / "profile.json"))
+    mx.profiler.set_state("run")
+    with mx.profiler.scope("matmul_test"):
+        nd.dot(nd.ones((32, 32)), nd.ones((32, 32))).wait_to_read()
+    with mx.profiler.Task(None, "task1") if False else mx.profiler.Task("dom", "task1"):
+        pass
+    out_dir = mx.profiler.dump()
+    assert out_dir and os.path.isdir(out_dir)
+
+
+def test_visualization_print_summary(capsys):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc")
+    mx.visualization.print_summary(net, shape={"data": (2, 8)})
+    out = capsys.readouterr().out
+    assert "fc" in out
+
+
+def test_monitor():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc")
+    ex = net.simple_bind(mx.cpu(), data=(2, 8))
+    mon = mx.monitor.Monitor(1, pattern=".*")
+    mon.install(ex)
+    mon.tic()
+    ex.forward(data=np.ones((2, 8), "float32"))
+    res = mon.toc()
+    assert len(res) > 0
+
+
+def test_check_consistency_cpu_devices():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=3, name="fc")
+    net = sym.Activation(net, act_type="tanh")
+    check_consistency(
+        net,
+        [{"ctx": mx.cpu(0), "data": (4, 6)}, {"ctx": mx.cpu(1), "data": (4, 6)}],
+    )
+
+
+def test_feedforward_legacy():
+    X = np.random.randn(200, 10).astype("float32")
+    W = np.random.randn(10, 2)
+    y = np.argmax(X @ W, axis=1).astype("float32")
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=2, name="fc")
+    net = sym.SoftmaxOutput(net, sym.Variable("softmax_label"), name="softmax")
+    model = mx.model.FeedForward(net, ctx=mx.cpu(), num_epoch=4,
+                                 learning_rate=0.5, initializer=mx.init.Xavier())
+    it = mx.io.NDArrayIter(X, y, batch_size=20)
+    model.fit(it)
+    assert model.score(it) > 0.8
+
+
+def test_symbol_block():
+    from incubator_mxnet_tpu import gluon
+
+    data = sym.Variable("data")
+    net_sym = sym.FullyConnected(data, num_hidden=4, name="sbfc")
+    blk = gluon.SymbolBlock(net_sym, [data])
+    blk.initialize(mx.init.One())
+    # set weight to known value
+    params = blk.collect_params()
+    for name, p in params.items():
+        if p.shape is None or not p._shape_known():
+            p.shape = (4, 6) if "weight" in name else (4,)
+    blk.initialize(mx.init.One(), force_reinit=True)
+    out = blk(nd.ones((2, 6)))
+    assert out.shape == (2, 4)
+    assert_almost_equal(out.asnumpy(), np.full((2, 4), 6.0))  # 6*1, bias->0 by name dispatch
+
+
+def test_custom_grad_function_parity():
+    # verify MakeLoss / BlockGrad combo (ref: make_loss usage)
+    x = nd.array([2.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.make_loss(x * x)
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), np.array([4.0]))
