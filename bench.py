@@ -60,8 +60,15 @@ def main():
     y = nd.array(jax.device_put(
         jnp.asarray(rng.randint(0, 1000, size=batch_size).astype(np.float32)), target))
 
-    for _ in range(warmup):
+    import sys as _sys
+    t0 = time.perf_counter()
+    print(f"[bench] init done, compiling...", file=_sys.stderr, flush=True)
+    for i in range(warmup):
         loss = step(x, y)
+        if i == 0:
+            loss.wait_to_read()
+            print(f"[bench] first step (compile) {time.perf_counter()-t0:.1f}s",
+                  file=_sys.stderr, flush=True)
     loss.wait_to_read()
 
     start = time.perf_counter()
