@@ -1,0 +1,310 @@
+"""Transformer LM flagship: every parallelism axis on one model.
+
+Capability beyond the reference (SURVEY §2.2/§5.7: MXNet's long-sequence
+story was bucketing + the fused RNN op; TP/PP/SP/EP were absent). This is the
+TPU-native composition point for the `parallel` package:
+
+- data parallel       : batch sharded on the `dp` mesh axis (GSPMD or shard_map)
+- tensor parallel     : attention heads + FFN hidden sharded on `tp` (GSPMD
+                        sharding rules, parallel.tensor)
+- expert parallel     : MoE expert axis sharded on `ep` (parallel.moe)
+- sequence parallel   : ring attention over `sp` (parallel.ring_attention)
+- pipeline parallel   : layer stack sharded on `pp` (parallel.pipeline)
+
+Two jitted training steps are provided:
+- `make_gspmd_train_step`   — mesh ('dp','ep','tp'): annotation-driven
+  sharding; XLA inserts the grad all-reduce and MoE all-to-all.
+- `make_pipeline_train_step`— mesh ('dp','sp','pp'): explicit shard_map SPMD
+  pipeline with ring attention inside each stage.
+
+Both return scalar loss and apply an SGD update in the same jit (donated
+params — the fused-step pattern of incubator_mxnet_tpu.fused).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.pipeline import spmd_pipeline
+from ..parallel.moe import moe_ffn
+from ..parallel.ring_attention import ring_attention
+from ..parallel.tensor import make_shardings
+
+__all__ = [
+    "TransformerConfig",
+    "init_params",
+    "apply",
+    "make_gspmd_train_step",
+    "make_pipeline_train_step",
+]
+
+
+@dataclasses.dataclass
+class TransformerConfig:
+    vocab: int = 256
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 128
+    max_len: int = 128
+    n_experts: int = 0  # 0 = dense FFN
+    dtype: str = "float32"
+
+
+def init_params(cfg: TransformerConfig, seed: int = 0):
+    """Stacked-layer parameter dict: every per-layer tensor has a leading
+    (n_layers,) axis so the stack can be scanned (single-chip) or sharded on
+    `pp` (pipeline)."""
+    rng = np.random.RandomState(seed)
+    d, f, L, V = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab
+    dt = cfg.dtype
+
+    def W(*shape, scale=None):
+        scale = scale if scale is not None else 1.0 / np.sqrt(shape[-2])
+        return jnp.asarray(rng.randn(*shape).astype(dt) * scale)
+
+    p = {
+        "embed": W(V, d, scale=0.02),
+        "pos": W(cfg.max_len, d, scale=0.02),
+        "ln_f_g": jnp.ones((d,), dt),
+        "ln_f_b": jnp.zeros((d,), dt),
+        "wq": W(L, d, d),
+        "wk": W(L, d, d),
+        "wv": W(L, d, d),
+        "wo": W(L, d, d),
+        "ln1_g": jnp.ones((L, d), dt),
+        "ln1_b": jnp.zeros((L, d), dt),
+        "ln2_g": jnp.ones((L, d), dt),
+        "ln2_b": jnp.zeros((L, d), dt),
+    }
+    if cfg.n_experts:
+        p["router"] = W(L, d, cfg.n_experts, scale=0.02)
+        p["w1"] = W(L, cfg.n_experts, d, f)
+        p["w2"] = W(L, cfg.n_experts, f, d, scale=1.0 / np.sqrt(f))
+    else:
+        p["w1"] = W(L, d, f)
+        p["w2"] = W(L, f, d, scale=1.0 / np.sqrt(f))
+    return p
+
+
+_NON_STACKED = ("embed", "pos", "ln_f_g", "ln_f_b")
+
+
+def _stack_keys(params):
+    """Keys of per-layer (stacked, leading n_layers axis) params — the single
+    predicate used by both the scanned forward and the pipeline sharding."""
+    return [k for k in params if k not in _NON_STACKED]
+
+
+def _ln(x, g, b, eps=1e-5):
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + eps) * g + b
+
+
+def _split_heads(x, n_heads):
+    B, T, d = x.shape
+    return x.reshape(B, T, n_heads, d // n_heads)
+
+
+def _dense_attention(q, k, v, causal=True):
+    # q,k,v: (B, T, H, Dh)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _layer(lp, x, cfg, attn_fn):
+    """One transformer block. lp = per-layer param dict (no leading L axis).
+    x: (B, T, d). Returns (y, aux_loss)."""
+    h = _ln(x, lp["ln1_g"], lp["ln1_b"])
+    q = _split_heads(h @ lp["wq"], cfg.n_heads)
+    k = _split_heads(h @ lp["wk"], cfg.n_heads)
+    v = _split_heads(h @ lp["wv"], cfg.n_heads)
+    a = attn_fn(q, k, v)
+    B, T, _ = x.shape
+    x = x + a.reshape(B, T, cfg.d_model) @ lp["wo"]
+    h = _ln(x, lp["ln2_g"], lp["ln2_b"])
+    if cfg.n_experts:
+        flat = h.reshape(B * T, cfg.d_model)
+        out, aux = moe_ffn(flat, lp["router"], lp["w1"], lp["w2"])
+        return x + out.reshape(B, T, cfg.d_model), aux
+    return x + jax.nn.gelu(h @ lp["w1"]) @ lp["w2"], jnp.zeros((), x.dtype)
+
+
+def apply(params, tokens, cfg: TransformerConfig, attn_fn=None):
+    """Forward pass: tokens (B, T) int32 -> logits (B, T, V). Scans the layer
+    stack (compiler-friendly: one compiled block body)."""
+    attn_fn = attn_fn or _dense_attention
+    x = params["embed"][tokens] + params["pos"][: tokens.shape[1]][None]
+
+    stacked = {k: params[k] for k in _stack_keys(params)}
+
+    def body(carry, lp):
+        x, aux = carry
+        y, a = _layer(lp, x, cfg, attn_fn)
+        return (y, aux + a), None
+
+    (x, aux), _ = lax.scan(body, (x, jnp.zeros((), x.dtype)), stacked)
+    x = _ln(x, params["ln_f_g"], params["ln_f_b"])
+    logits = x @ params["embed"].T
+    return logits, aux / max(cfg.n_layers, 1)
+
+
+def _xent(logits, targets):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# GSPMD step: dp x ep x tp
+# ---------------------------------------------------------------------------
+
+TP_RULES = [
+    # attention: split heads (= output features of wq/wk/wv, input of wo)
+    (r"^wq$|^wk$|^wv$", P(None, None, "tp")),
+    (r"^wo$", P(None, "tp", None)),
+    # dense FFN: Megatron column-then-row
+    (r"^w1$", P(None, None, "tp") ),
+    (r"^w2$", P(None, "tp", None)),
+    (r"^embed$", P(None, None)),
+]
+
+MOE_TP_RULES = [
+    (r"^wq$|^wk$|^wv$", P(None, None, "tp")),
+    (r"^wo$", P(None, "tp", None)),
+    # MoE FFN: experts on ep, hidden on tp
+    (r"^w1$", P(None, "ep", None, "tp")),
+    (r"^w2$", P(None, "ep", "tp", None)),
+    (r"^router$", P()),
+]
+
+
+def make_gspmd_train_step(mesh: Mesh, cfg: TransformerConfig, lr=0.1, aux_weight=0.01):
+    """Fused train step over a ('dp','ep','tp') mesh: batch on dp, MoE experts
+    on ep, heads/FFN-hidden on tp. Returns (step, sharded_params).
+
+    step(params, tokens, targets) -> (loss, new_params); jitted with donated
+    params, shardings annotation-driven (GSPMD inserts collectives)."""
+    params = init_params(cfg)
+    rules = MOE_TP_RULES if cfg.n_experts else TP_RULES
+    shardings = make_shardings(params, rules, mesh)
+    params = {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+    data_sharding = NamedSharding(mesh, P("dp", None))
+
+    def loss_fn(p, tokens, targets):
+        logits, aux = apply(p, tokens, cfg)
+        return jnp.mean(_xent(logits, targets)) + aux_weight * aux
+
+    def step(p, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(p, tokens, targets)
+        new_p = jax.tree.map(lambda w, g: w - lr * g, p, grads)
+        return loss, new_p
+
+    jstep = jax.jit(
+        step,
+        in_shardings=(shardings, data_sharding, data_sharding),
+        out_shardings=(NamedSharding(mesh, P()), shardings),
+        donate_argnums=(0,),
+    )
+    return jstep, params
+
+
+# ---------------------------------------------------------------------------
+# shard_map step: dp x sp x pp (ring attention + SPMD pipeline)
+# ---------------------------------------------------------------------------
+
+def make_pipeline_train_step(mesh: Mesh, cfg: TransformerConfig, lr=0.1, n_micro=2):
+    """Fused train step over a ('dp','sp','pp') mesh: batch sharded on dp and
+    microbatched through an SPMD pipeline whose stages are the layer stack
+    sharded on pp; inside each stage, attention is ring attention with the
+    sequence sharded on sp. Returns (step, params).
+
+    Per-call global shapes: tokens/targets (batch, seq). Requires
+    batch % (dp * n_micro) == 0, seq % sp == 0, n_layers % pp == 0."""
+    assert cfg.n_experts == 0, "pipeline step uses the dense FFN"
+    params = init_params(cfg)
+    pp = mesh.shape["pp"]
+    assert cfg.n_layers % pp == 0
+
+    stack_keys = _stack_keys(params)
+    pspecs = {k: (P("pp") if k in stack_keys else P()) for k in params}
+    params = {
+        k: jax.device_put(v, NamedSharding(mesh, pspecs[k])) for k, v in params.items()
+    }
+
+    def stage_fn(stage_params, x):
+        """Apply this stage's layer slice to one microbatch activation.
+        x: (mb_local, T_local, d); stage_params leaves: (L/pp, ...)."""
+        attn = functools.partial(ring_attention, axis_name="sp", causal=True)
+
+        def body(h, lp):
+            y, _ = _layer(lp, h, cfg, attn)
+            return y, None
+
+        y, _ = lax.scan(body, x, stage_params)
+        return y
+
+    def local_step(p, tokens, targets):
+        """Runs per-device under shard_map over ('dp','sp','pp').
+        tokens/targets: (b_local, T_local) int32."""
+        def loss_fn(p):
+            b, t = tokens.shape
+            sp_idx = lax.axis_index("sp")
+            pos0 = sp_idx * t  # global position offset of this sequence shard
+            x = p["embed"][tokens] + lax.dynamic_slice_in_dim(p["pos"], pos0, t, axis=0)[None]
+            stage_params = {k: p[k] for k in stack_keys}
+            mb = b // n_micro
+            micro = x.reshape(n_micro, mb, t, cfg.d_model)
+            out = spmd_pipeline(stage_fn, stage_params, micro, axis_name="pp")
+            h = out.reshape(b, t, cfg.d_model)
+            h = _ln(h, p["ln_f_g"], p["ln_f_b"])
+            logits = h @ p["embed"].T
+            losses = _xent(logits, targets)
+            # replicated-scalar loss: only the device's own shard contributes,
+            # psum over every mesh axis; pp ranks all hold identical outputs so
+            # gate the contribution to pp rank 0.
+            is_pp0 = (lax.axis_index("pp") == 0).astype(losses.dtype)
+            total = lax.psum(jnp.sum(losses) * is_pp0, ("dp", "sp", "pp"))
+            count = losses.size * mesh.shape["dp"] * mesh.shape["sp"]  # static
+            return total / count
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        # grads of replicated params are device-varying partials (each device
+        # saw its batch/sequence shard): all-reduce to the replicated mean.
+        # pp-sharded stack grads are already correct per-stage; average over
+        # the axes they are replicated on (dp, sp).
+        def reduce_grad(k, g):
+            axes = ("dp", "sp") if k in stack_keys else ("dp", "sp", "pp")
+            return lax.pmean(g, axes)
+
+        grads = {k: reduce_grad(k, g) for k, g in grads.items()}
+        new_p = {k: p[k] - lr * grads[k] for k in p}
+        return loss, new_p
+
+    in_specs = (pspecs, P("dp", "sp"), P("dp", "sp"))
+    out_specs = (P(), pspecs)
+    smapped = jax.shard_map(local_step, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    jstep = jax.jit(smapped, donate_argnums=(0,))
+    dp, sp = mesh.shape["dp"], mesh.shape["sp"]
+
+    def checked_step(p, tokens, targets):
+        b, t = tokens.shape
+        if b % (dp * n_micro):
+            raise ValueError(f"batch {b} not divisible by dp*n_micro = {dp * n_micro}")
+        if t % sp:
+            raise ValueError(f"seq len {t} not divisible by sp = {sp}")
+        return jstep(p, tokens, targets)
+
+    return checked_step, params

@@ -1,0 +1,93 @@
+"""Expert parallelism: mixture-of-experts FFN with capacity-based dispatch.
+
+Capability beyond the reference (SURVEY §2.2: MXNet has no MoE / expert
+parallelism). TPU-native design: routing is expressed as dense einsums against
+a (tokens, experts, capacity) dispatch tensor — compiler-friendly static
+shapes, no gather/scatter of ragged groups — and the expert dimension is
+sharded over an `ep` mesh axis. Under `jit` with GSPMD shardings, XLA lowers
+the dispatch/combine einsums into all-to-all exchanges over ICI automatically;
+`moe_ffn_shardmap` is the explicit `lax.all_to_all` variant for use inside
+`shard_map`.
+
+Top-1 routing with an auxiliary load-balance loss (Shazeer et al. 2017 /
+Switch Transformer), fixed per-expert capacity, dropped-token semantics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["moe_dispatch", "moe_ffn", "moe_ffn_shardmap"]
+
+
+def moe_dispatch(tokens, router_w, n_experts, capacity):
+    """Compute top-1 dispatch/combine tensors and the load-balance aux loss.
+
+    tokens: (T, d); router_w: (d, E). Returns (dispatch (T,E,C) 0/1,
+    combine (T,E,C) gate-weighted, aux_loss scalar).
+    """
+    logits = tokens @ router_w
+    probs = jax.nn.softmax(logits, axis=-1)  # (T, E)
+    expert = jnp.argmax(probs, axis=-1)  # (T,)
+    gate = jnp.max(probs, axis=-1)  # (T,)
+    onehot = jax.nn.one_hot(expert, n_experts, dtype=tokens.dtype)  # (T, E)
+    # position of each token within its expert's queue; tokens beyond
+    # capacity are dropped (residual connection carries them unchanged).
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # (T, E), -1 where unrouted
+    pos_tok = jnp.max(pos, axis=-1)  # (T,)
+    keep = (pos_tok >= 0) & (pos_tok < capacity)
+    disp = (
+        onehot[:, :, None]
+        * jax.nn.one_hot(jnp.clip(pos_tok, 0, capacity - 1), capacity, dtype=tokens.dtype)[:, None, :]
+        * keep[:, None, None]
+    )  # (T, E, C)
+    combine = disp * gate[:, None, None]
+    # load-balance loss: E * sum_e fraction_routed_e * mean_prob_e
+    frac = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = n_experts * jnp.sum(frac * mean_prob)
+    return disp, combine, aux
+
+
+def moe_ffn(tokens, router_w, w1, w2, *, capacity_factor=2.0):
+    """GSPMD MoE FFN. tokens: (T, d); w1: (E, d, f); w2: (E, f, d).
+
+    Shard w1/w2 on their expert axis with PartitionSpec("ep", ...) and XLA
+    inserts the token all-to-all. Returns (out (T, d), aux_loss).
+    """
+    E = w1.shape[0]
+    T = tokens.shape[0]
+    capacity = max(1, int(capacity_factor * T / E))
+    disp, combine, aux = moe_dispatch(tokens, router_w, E, capacity)
+    xs = jnp.einsum("td,tec->ecd", tokens, disp)  # (E, C, d)
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xs, w1))
+    ys = jnp.einsum("ecf,efd->ecd", h, w2)  # (E, C, d)
+    out = jnp.einsum("ecd,tec->td", ys, combine)
+    return out, aux
+
+
+def moe_ffn_shardmap(tokens, router_w, w1, w2, *, axis_name="ep", capacity_factor=2.0):
+    """Explicit expert-parallel MoE for use inside shard_map over `axis_name`.
+
+    Per-device shapes: tokens (T_local, d) — token batch sharded over ep;
+    w1 (E_local, d, f), w2 (E_local, f, d) — experts sharded over ep. Tokens
+    route to the global expert set; dispatch travels via `lax.all_to_all`.
+    """
+    n = lax.psum(1, axis_name)
+    E_local = w1.shape[0]
+    E = E_local * n
+    T = tokens.shape[0]
+    capacity = max(1, int(capacity_factor * T / E))
+    disp, combine, aux = moe_dispatch(tokens, router_w, E, capacity)
+    xs = jnp.einsum("td,tec->ecd", tokens, disp)  # (E, C, d): rows grouped by owner device
+    # scatter expert-rows to their owner; gather one chunk per source device.
+    # (E, C, d) -> (E_local, n*C, d): expert k's queue is the concat of every
+    # source device's C-slot block for it.
+    xs = lax.all_to_all(xs, axis_name, split_axis=0, concat_axis=1, tiled=True)
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xs, w1))
+    ys = jnp.einsum("ecf,efd->ecd", h, w2)
+    ys = lax.all_to_all(ys, axis_name, split_axis=1, concat_axis=0, tiled=True)
+    out = jnp.einsum("ecd,tec->td", ys, combine)
+    # aux is computed from local tokens; average over the ep group
+    return out, lax.pmean(aux, axis_name)

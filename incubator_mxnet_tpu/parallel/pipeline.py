@@ -1,0 +1,71 @@
+"""Pipeline parallelism (SPMD circular-shift schedule over a `pp` mesh axis).
+
+Capability beyond the reference: MXNet had no pipeline parallelism — only
+step-wise `PartialForward` (ref: src/executor/graph_executor.cc:68) and manual
+inter-layer placement via `group2ctx` (ref: python/mxnet/symbol/symbol.py:1415).
+The TPU-native design is the standard GPipe-style SPMD pipeline: each device
+along the `pp` mesh axis holds a contiguous slice of the layer stack (the
+stage), microbatches enter at stage 0, and activations rotate to the next
+stage over ICI via `lax.ppermute` each tick. The whole schedule is a single
+`lax.scan`, so XLA overlaps the ppermute transfer of tick t with the stage
+compute of tick t+1. Backward is plain `jax.grad` through the scan/ppermute.
+
+Run `spmd_pipeline` inside `jax.shard_map` over the `pp` axis; stage
+parameters are the full stacked-layer pytree sharded on their leading
+(layer-stack) axis with `PartitionSpec("pp", ...)`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["spmd_pipeline"]
+
+
+def spmd_pipeline(stage_fn, stage_params, inputs, *, axis_name="pp"):
+    """Run a microbatched pipeline; call inside shard_map over `axis_name`.
+
+    stage_fn(stage_params, x) -> y : applies THIS stage's layer slice to one
+        microbatch activation (shapes of x and y must match so activations can
+        rotate between stages).
+    stage_params : pytree whose leaves are this device's stage slice (shard_map
+        already consumed the leading pp axis).
+    inputs : (n_microbatches, *mb_shape) microbatched input activations,
+        available on every device (only stage 0 reads them).
+
+    Returns (n_microbatches, *mb_shape) outputs, replicated across the pp axis
+    (the last stage's results are psum-broadcast).
+    """
+    n_stages = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    n_micro = inputs.shape[0]
+    total_ticks = n_micro + n_stages - 1
+    perm_fwd = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 ingests a fresh microbatch; later stages consume the
+        # activation that rotated in from the previous stage last tick.
+        idx = jnp.clip(t, 0, n_micro - 1)
+        fresh = lax.dynamic_index_in_dim(inputs, idx, axis=0, keepdims=False)
+        x = jnp.where(stage == 0, fresh, state)
+        y = stage_fn(stage_params, x)
+        # the last stage retires microbatch t-(n_stages-1) at tick t
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        is_out = jnp.logical_and(stage == n_stages - 1, t >= n_stages - 1)
+        updated = lax.dynamic_update_index_in_dim(outputs, y, out_idx, axis=0)
+        outputs = jnp.where(is_out, updated, outputs)
+        state = lax.ppermute(y, axis_name, perm_fwd)
+        return (state, outputs), None
+
+    # carry inits derive from `inputs` (inheriting its varying mesh axes) and
+    # are additionally marked varying over the pipeline axis, since the
+    # rotating state/output differ per stage.
+    state0 = lax.pvary(inputs[0] * 0, (axis_name,))
+    out0 = lax.pvary(inputs * 0, (axis_name,))
+    (_, outputs), _ = lax.scan(tick, (state0, out0), jnp.arange(total_ticks))
+    # broadcast the last stage's outputs to every pp rank so downstream code
+    # (final LN / unembed / loss) is replicated over pp.
+    mask = (stage == n_stages - 1).astype(inputs.dtype)
+    return lax.psum(outputs * mask, axis_name)

@@ -81,9 +81,12 @@ def ring_attention(q, k, v, axis_name, causal=False):
         idx_nxt = lax.ppermute(idx, axis_name, perm)
         return (o, m, l, k_nxt, v_nxt, idx_nxt), None
 
-    o0 = lax.pvary(jnp.zeros((B, Tq, H, D), q.dtype), (axis_name,))
-    m0 = lax.pvary(jnp.full((B, H, Tq), _NEG_INF, q.dtype), (axis_name,))
-    l0 = lax.pvary(jnp.zeros((B, H, Tq), q.dtype), (axis_name,))
+    # derive carry inits from q so they inherit q's varying mesh axes (works
+    # whether the enclosing shard_map spans just `axis_name` or more axes)
+    zq = q * 0.0
+    o0 = zq
+    m0 = zq.sum(-1).transpose(0, 2, 1) + _NEG_INF  # (B, H, Tq)
+    l0 = zq.sum(-1).transpose(0, 2, 1)
     (o, m, l, _, _, _), _ = lax.scan(body, (o0, m0, l0, k, v, my), None, length=n)
     return o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
 

@@ -1,0 +1,65 @@
+"""Tensor (intra-op) parallelism helpers: named sharding rules for parameters.
+
+Capability beyond the reference (SURVEY §2.2: tensor parallel absent in
+MXNet). TPU-native design: TP is *not* hand-written collectives — it is
+sharding annotations on weight matrices under `jit` over a mesh with a `tp`
+axis. XLA/GSPMD propagates the shardings through the einsums and inserts the
+minimal all-reduce (the Megatron column-then-row pattern falls out of
+sharding W1 on its output axis and W2 on its input axis).
+
+`shard_params` applies regex -> PartitionSpec rules to a flat param dict;
+`constrain` is `with_sharding_constraint` for activations.
+"""
+from __future__ import annotations
+
+import re
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["shard_params", "make_shardings", "constrain", "column_parallel", "row_parallel"]
+
+
+def column_parallel(mesh_axis="tp"):
+    """Spec for a (in, out) weight split on its output features (Megatron W1)."""
+    return P(None, mesh_axis)
+
+
+def row_parallel(mesh_axis="tp"):
+    """Spec for a (in, out) weight split on its input features (Megatron W2);
+    GSPMD inserts the trailing all-reduce of the partial products."""
+    return P(mesh_axis, None)
+
+
+def make_shardings(params, rules, mesh):
+    """Map a flat {name: array} dict to {name: NamedSharding} via the first
+    matching (regex, PartitionSpec) rule; unmatched params are replicated.
+
+    A rule spec may have fewer axes than the array rank; it is right-padded
+    with None (replicated trailing dims stay replicated)."""
+    out = {}
+    for name, arr in params.items():
+        spec = P()
+        for pat, s in rules:
+            if re.search(pat, name):
+                spec = s
+                break
+        nd = getattr(arr, "ndim", 0)
+        if len(tuple(spec)) > nd:
+            raise ValueError(
+                f"sharding rule for {name!r} has {len(tuple(spec))} axes but "
+                f"the param is rank {nd}: {tuple(spec)}")
+        parts = tuple(spec) + (None,) * (nd - len(tuple(spec)))
+        out[name] = NamedSharding(mesh, P(*parts))
+    return out
+
+
+def shard_params(params, rules, mesh):
+    """device_put each param onto its rule-derived NamedSharding."""
+    shardings = make_shardings(params, rules, mesh)
+    return {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+
+
+def constrain(x, mesh, *spec):
+    """Anchor an activation's sharding inside jit (GSPMD hint)."""
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
