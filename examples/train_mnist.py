@@ -56,6 +56,12 @@ def main():
     p.add_argument("--ctx", default="tpu", choices=["cpu", "tpu", "gpu"])
     args = p.parse_args()
 
+    if args.ctx == "cpu":
+        # don't initialize the (possibly slow/absent) TPU platform at all
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
     logging.basicConfig(level=logging.INFO)
     train, val = get_mnist_iters(args.batch_size, args.data_dir)
     net = models.get_lenet(10) if args.network == "lenet" else models.get_mlp(10)

@@ -35,3 +35,28 @@ def arange_like(data, start=0.0, step=1.0, axis=None):
     return _register.invoke(
         OP_REGISTRY["_arange_like"], (data,), dict(start=start, step=step, axis=axis)
     )
+
+
+def _install_contrib_ops():
+    """Surface every `_contrib_*` registry op here under its short name
+    (mirrors the reference's `nd.contrib` codegen,
+    ref: python/mxnet/ndarray/register.py:157)."""
+    for _name, _op in list(OP_REGISTRY.items()):
+        if not _name.startswith("_contrib_"):
+            continue
+        short = _name[len("_contrib_"):]
+        if short in globals():
+            continue
+
+        def _make(opdef):
+            def f(*args, **kwargs):
+                return _register.invoke(opdef, args, kwargs)
+            return f
+
+        fn = _make(_op)
+        fn.__name__ = short
+        fn.__doc__ = _op.fn.__doc__
+        globals()[short] = fn
+
+
+_install_contrib_ops()

@@ -9,3 +9,4 @@ from . import tensor  # noqa: F401
 from . import nn  # noqa: F401
 from . import random  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import vision  # noqa: F401

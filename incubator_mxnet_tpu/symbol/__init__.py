@@ -7,3 +7,4 @@ from . import register as _register
 _register.install_ops(globals())
 
 from . import infer  # noqa: E402,F401
+from . import contrib  # noqa: E402,F401

@@ -1,0 +1,31 @@
+"""sym.contrib namespace (ref: python/mxnet/symbol/contrib.py).
+
+Every `_contrib_*` registry op surfaces here under its short name, as
+symbolic builders (same codegen idea as the reference's frontend generation).
+"""
+from __future__ import annotations
+
+from ..ops.registry import OP_REGISTRY
+from . import register as _register
+
+
+def _install():
+    for _name, _op in list(OP_REGISTRY.items()):
+        if not _name.startswith("_contrib_"):
+            continue
+        short = _name[len("_contrib_"):]
+        if short in globals():
+            continue
+
+        def _make(op_name):
+            def f(*args, **kwargs):
+                return _register.invoke_symbol(op_name, args, kwargs)
+            return f
+
+        fn = _make(_name)
+        fn.__name__ = short
+        fn.__doc__ = _op.fn.__doc__
+        globals()[short] = fn
+
+
+_install()

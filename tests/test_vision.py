@@ -1,0 +1,293 @@
+"""Vision/detection op tests (ref: tests/python/unittest/test_operator.py
+test_roipooling/test_bilinear_sampler/test_spatial_transformer +
+tests/python/unittest/test_contrib_operator.py box_nms/multibox tests)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, sym
+
+
+def _iou_np(a, b):
+    iw = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+    ih = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+    inter = iw * ih
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+def test_box_iou():
+    a = np.random.uniform(0, 1, (5, 4)).astype(np.float32)
+    b = np.random.uniform(0, 1, (3, 4)).astype(np.float32)
+    a[:, 2:] += a[:, :2]
+    b[:, 2:] += b[:, :2]
+    out = nd.contrib.box_iou(nd.array(a), nd.array(b)).asnumpy()
+    for i in range(5):
+        for j in range(3):
+            assert abs(out[i, j] - _iou_np(a[i], b[j])) < 1e-5
+
+
+def test_box_iou_center_format():
+    a = np.array([[0.5, 0.5, 1.0, 1.0]], np.float32)  # == corner [0,0,1,1]
+    b = np.array([[0.0, 0.0, 1.0, 1.0]], np.float32)
+    out = nd.contrib.box_iou(nd.array(a), nd.array(b), format="center").asnumpy()
+    # corner boxes: [0,0,1,1] vs [-0.5,-0.5,0.5,0.5] -> inter 0.25, union 1.75
+    assert abs(out[0, 0] - 0.25 / 1.75) < 1e-6
+
+
+def _nms_np(rows, thresh, id_index=-1, force=False, valid_thresh=0.0):
+    order = np.argsort(-rows[:, 1])
+    rows = rows[order]
+    keep = list(rows[:, 1] > valid_thresh)
+    n = len(rows)
+    for i in range(n):
+        if not keep[i]:
+            continue
+        for j in range(i + 1, n):
+            if not keep[j]:
+                continue
+            if id_index >= 0 and not force and rows[i, id_index] != rows[j, id_index]:
+                continue
+            if _iou_np(rows[i, 2:6], rows[j, 2:6]) > thresh:
+                keep[j] = False
+    out = rows.copy()
+    out[~np.array(keep)] = -1
+    return out
+
+
+def test_box_nms_matches_reference_algorithm():
+    np.random.seed(3)
+    for _ in range(4):
+        rows = np.random.uniform(0, 1, (12, 6)).astype(np.float32)
+        rows[:, 0] = np.random.randint(0, 3, 12)
+        rows[:, 4:6] = rows[:, 2:4] + np.random.uniform(0.1, 0.5, (12, 2))
+        got = nd.contrib.box_nms(nd.array(rows[None]), overlap_thresh=0.5,
+                                 id_index=0).asnumpy()[0]
+        want = _nms_np(rows, 0.5, id_index=0)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_box_nms_force_and_topk():
+    rows = np.array([
+        [0, 0.9, 0, 0, 1, 1],
+        [1, 0.8, 0.05, 0.05, 1.05, 1.05],  # overlaps class 0 box
+        [0, 0.7, 3, 3, 4, 4],
+    ], np.float32)
+    # force_suppress kills the class-1 box despite different id
+    got = nd.contrib.box_nms(nd.array(rows[None]), overlap_thresh=0.5,
+                             id_index=0, force_suppress=True).asnumpy()[0]
+    assert (got[1] == -1).all() and got[2, 1] == pytest.approx(0.7)
+    # topk=1 drops everything after the best box
+    got = nd.contrib.box_nms(nd.array(rows[None]), overlap_thresh=0.5,
+                             id_index=0, topk=1).asnumpy()[0]
+    assert got[0, 1] == pytest.approx(0.9) and (got[1:] == -1).all()
+
+
+def test_multibox_prior():
+    anch = nd.contrib.MultiBoxPrior(nd.zeros((1, 3, 2, 2)), sizes=(0.5,),
+                                    ratios=(1.0,)).asnumpy()
+    assert anch.shape == (1, 4, 4)
+    # first pixel center (0.25, 0.25), half-size 0.25
+    np.testing.assert_allclose(anch[0, 0], [0.0, 0.0, 0.5, 0.5], atol=1e-6)
+    # clip
+    anch = nd.contrib.MultiBoxPrior(nd.zeros((1, 3, 2, 2)), sizes=(1.5,),
+                                    ratios=(1.0,), clip=True).asnumpy()
+    assert anch.min() >= 0.0 and anch.max() <= 1.0
+
+
+def test_multibox_prior_count():
+    anch = nd.contrib.MultiBoxPrior(nd.zeros((1, 3, 4, 5)), sizes=(0.5, 0.3),
+                                    ratios=(1.0, 2.0, 0.5)).asnumpy()
+    assert anch.shape == (1, 4 * 5 * (2 + 3 - 1), 4)
+
+
+def test_multibox_target_matching():
+    # one gt box exactly equal to one anchor -> that anchor is positive
+    anchors = np.array([[[0.1, 0.1, 0.5, 0.5], [0.6, 0.6, 0.9, 0.9],
+                         [0.0, 0.0, 0.05, 0.05]]], np.float32)
+    label = np.array([[[1.0, 0.1, 0.1, 0.5, 0.5]]], np.float32)
+    cls_pred = np.zeros((1, 3, 3), np.float32)
+    lt, lm, ct = nd.contrib.MultiBoxTarget(nd.array(anchors), nd.array(label),
+                                           nd.array(cls_pred))
+    ct = ct.asnumpy()[0]
+    assert ct[0] == 2.0  # class 1 -> target 2 (background is 0)
+    assert ct[1] == 0.0 and ct[2] == 0.0
+    lm = lm.asnumpy().reshape(3, 4)
+    assert lm[0].all() and not lm[1].any()
+    # exact match -> zero offsets
+    lt = lt.asnumpy().reshape(3, 4)
+    np.testing.assert_allclose(lt[0], 0.0, atol=1e-5)
+
+
+def test_multibox_target_negative_mining():
+    anchors = np.random.uniform(0, 0.4, (1, 8, 4)).astype(np.float32)
+    anchors[..., 2:] = anchors[..., :2] + 0.1
+    label = np.array([[[0.0, 0.0, 0.0, 0.11, 0.11]]], np.float32)
+    cls_pred = np.random.uniform(0, 1, (1, 3, 8)).astype(np.float32)
+    _, _, ct = nd.contrib.MultiBoxTarget(
+        nd.array(anchors), nd.array(label), nd.array(cls_pred),
+        negative_mining_ratio=2.0, minimum_negative_samples=1)
+    ct = ct.asnumpy()[0]
+    assert set(np.unique(ct)).issubset({-1.0, 0.0, 1.0})
+
+
+def test_multibox_detection():
+    anch = nd.contrib.MultiBoxPrior(nd.zeros((1, 3, 4, 4)), sizes=(0.3,),
+                                    ratios=(1.0,))
+    n = anch.shape[1]
+    cls_prob = np.random.uniform(0, 1, (2, 3, n)).astype(np.float32)
+    loc_pred = np.zeros((2, 4 * n), np.float32)
+    out = nd.contrib.MultiBoxDetection(nd.array(cls_prob), nd.array(loc_pred),
+                                       anch, nms_threshold=0.5).asnumpy()
+    assert out.shape == (2, n, 6)
+    valid = out[out[..., 0] >= 0]
+    assert (valid[:, 1] > 0).all()          # scores positive
+    assert (valid[:, 2:] >= 0).all() and (valid[:, 2:] <= 1).all()  # clipped
+
+
+def test_roi_pooling_forward():
+    data = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8)
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = nd.ROIPooling(nd.array(data), nd.array(rois), pooled_size=(2, 2),
+                        spatial_scale=1.0).asnumpy()
+    np.testing.assert_allclose(out[0, 0], [[27., 31.], [59., 63.]])
+
+
+def test_roi_pooling_scale_and_batch_index():
+    data = np.random.uniform(size=(2, 3, 8, 8)).astype(np.float32)
+    rois = np.array([[1, 0, 0, 15, 15]], np.float32)  # second image, scale .5
+    out = nd.ROIPooling(nd.array(data), nd.array(rois), pooled_size=(1, 1),
+                        spatial_scale=0.5).asnumpy()
+    np.testing.assert_allclose(out[0, :, 0, 0], data[1].max(axis=(1, 2)),
+                               rtol=1e-6)
+
+
+def test_roi_align_constant():
+    # constant image -> every aligned bin equals the constant
+    data = np.full((1, 2, 10, 10), 7.0, np.float32)
+    rois = np.array([[0, 1.3, 2.1, 8.2, 7.7]], np.float32)
+    out = nd.contrib.ROIAlign(nd.array(data), nd.array(rois),
+                              pooled_size=(3, 3), spatial_scale=1.0,
+                              sample_ratio=2).asnumpy()
+    np.testing.assert_allclose(out, 7.0, rtol=1e-6)
+
+
+def test_bilinear_sampler_identity_and_shift():
+    img = np.random.uniform(size=(1, 2, 6, 6)).astype(np.float32)
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 6), np.linspace(-1, 1, 6),
+                         indexing="ij")
+    grid = np.stack([xs, ys])[None].astype(np.float32)
+    out = nd.BilinearSampler(nd.array(img), nd.array(grid)).asnumpy()
+    np.testing.assert_allclose(out, img, atol=1e-6)
+    # shift one pixel right: out[..., j] = img[..., j+1], zeros at edge
+    step = 2.0 / 5
+    grid2 = grid.copy()
+    grid2[:, 0] += step
+    out = nd.BilinearSampler(nd.array(img), nd.array(grid2)).asnumpy()
+    np.testing.assert_allclose(out[..., :-1], img[..., 1:], atol=1e-5)
+    np.testing.assert_allclose(out[..., -1], 0.0, atol=1e-5)
+
+
+def test_bilinear_sampler_grad_flows():
+    from incubator_mxnet_tpu import autograd
+    img = nd.random.uniform(shape=(1, 1, 4, 4))
+    img.attach_grad()
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4),
+                         indexing="ij")
+    grid = nd.array(np.stack([xs, ys])[None].astype(np.float32))
+    with autograd.record():
+        out = nd.BilinearSampler(img, grid)
+        loss = out.sum()
+    loss.backward()
+    np.testing.assert_allclose(img.grad.asnumpy(), np.ones((1, 1, 4, 4)),
+                               atol=1e-5)
+
+
+def test_spatial_transformer_affine():
+    img = np.random.uniform(size=(2, 3, 5, 5)).astype(np.float32)
+    theta = np.tile(np.array([[1, 0, 0, 0, 1, 0]], np.float32), (2, 1))
+    out = nd.SpatialTransformer(nd.array(img), nd.array(theta),
+                                target_shape=(5, 5), transform_type="affine",
+                                sampler_type="bilinear").asnumpy()
+    np.testing.assert_allclose(out, img, atol=1e-6)
+    # horizontal flip: x' = -x
+    theta_f = np.tile(np.array([[-1, 0, 0, 0, 1, 0]], np.float32), (2, 1))
+    out = nd.SpatialTransformer(nd.array(img), nd.array(theta_f),
+                                target_shape=(5, 5), transform_type="affine",
+                                sampler_type="bilinear").asnumpy()
+    np.testing.assert_allclose(out, img[..., ::-1], atol=1e-5)
+
+
+def test_grid_generator_warp():
+    flow = np.zeros((1, 2, 4, 4), np.float32)
+    grid = nd.GridGenerator(nd.array(flow), transform_type="warp").asnumpy()
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4),
+                         indexing="ij")
+    np.testing.assert_allclose(grid[0, 0], xs, atol=1e-6)
+    np.testing.assert_allclose(grid[0, 1], ys, atol=1e-6)
+
+
+def test_correlation_zero_displacement():
+    img = np.random.uniform(size=(1, 4, 6, 6)).astype(np.float32)
+    out = nd.Correlation(nd.array(img), nd.array(img), kernel_size=1,
+                         max_displacement=0, stride1=1, stride2=1,
+                         pad_size=0).asnumpy()
+    np.testing.assert_allclose(out[0, 0], (img ** 2).mean(axis=1)[0], rtol=1e-5)
+
+
+def test_adaptive_avg_pooling():
+    img = np.random.uniform(size=(2, 3, 7, 9)).astype(np.float32)
+    out = nd.contrib.AdaptiveAvgPooling2D(nd.array(img),
+                                          output_size=(1, 1)).asnumpy()
+    np.testing.assert_allclose(out[..., 0, 0], img.mean(axis=(2, 3)), rtol=1e-5)
+    out = nd.contrib.AdaptiveAvgPooling2D(nd.array(img),
+                                          output_size=(7, 9)).asnumpy()
+    np.testing.assert_allclose(out, img, rtol=1e-6)
+
+
+def test_bilinear_resize_2d():
+    img = np.random.uniform(size=(1, 2, 4, 4)).astype(np.float32)
+    out = nd.contrib.BilinearResize2D(nd.array(img), height=8, width=8)
+    assert out.shape == (1, 2, 8, 8)
+    # align_corners=True semantics: corners map exactly, and a 1D ramp
+    # resizes to the exact linspace between its endpoints
+    ramp = np.arange(4, dtype=np.float32).reshape(1, 1, 1, 4).repeat(2, axis=2)
+    out = nd.contrib.BilinearResize2D(nd.array(ramp), height=2, width=7).asnumpy()
+    np.testing.assert_allclose(out[0, 0, 0], np.linspace(0, 3, 7), atol=1e-6)
+
+
+def test_roi_align_position_sensitive():
+    ph = pw = 2
+    c_out = 3
+    # each channel holds its own constant -> PS output bin (i,j) must read
+    # the constant of channel group c*ph*pw + i*pw + j
+    c = c_out * ph * pw
+    data = np.arange(c, dtype=np.float32).reshape(1, c, 1, 1)
+    data = np.tile(data, (1, 1, 8, 8))
+    rois = np.array([[0, 1, 1, 6, 6]], np.float32)
+    out = nd.contrib.ROIAlign(nd.array(data), nd.array(rois),
+                              pooled_size=(ph, pw), spatial_scale=1.0,
+                              sample_ratio=2, position_sensitive=True).asnumpy()
+    assert out.shape == (1, c_out, ph, pw)
+    for co in range(c_out):
+        for i in range(ph):
+            for j in range(pw):
+                assert out[0, co, i, j] == co * ph * pw + i * pw + j
+
+
+def test_vision_ops_symbolic():
+    data = sym.Variable("data")
+    rois = sym.Variable("rois")
+    net = sym.ROIPooling(data, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    ex = net.bind(mx.cpu(), {
+        "data": nd.array(np.random.uniform(size=(1, 2, 8, 8)).astype(np.float32)),
+        "rois": nd.array(np.array([[0, 0, 0, 4, 4]], np.float32)),
+    })
+    out = ex.forward()[0]
+    assert out.shape == (1, 2, 2, 2)
+
+    d = sym.Variable("d")
+    n = sym.contrib.box_nms(d, overlap_thresh=0.5)
+    ex = n.bind(mx.cpu(), {"d": nd.array(
+        np.random.uniform(0, 1, (1, 5, 6)).astype(np.float32))})
+    assert ex.forward()[0].shape == (1, 5, 6)
