@@ -498,3 +498,298 @@ class ImageIter(DataIter):
         return DataBatch(
             data=[nd_array(batch_data)], label=[nd_array(batch_label)], pad=pad,
         )
+
+
+# ---------------------------------------------------------------------------
+# Detection augmenters + ImageDetIter (ref: python/mxnet/image/detection.py;
+# C++ twin src/io/iter_image_det_recordio.cc). Labels are (N, 5+) arrays of
+# [cls, x1, y1, x2, y2] with normalized corner coords; invalid rows cls=-1.
+# ---------------------------------------------------------------------------
+
+
+class DetAugmenter:
+    """Augmenter that transforms (image, label) jointly."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap an image-only Augmenter for detection pipelines."""
+
+    def __init__(self, augmenter):
+        super().__init__(augmenter=augmenter.__class__.__name__)
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Apply one randomly selected augmenter (or none, with skip_prob)
+    (ref: detection.py DetRandomSelectAug)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        super().__init__(skip_prob=skip_prob)
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if not self.aug_list or pyrandom.random() < self.skip_prob:
+            return src, label
+        return pyrandom.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.p:
+            a = src.asnumpy() if isinstance(src, NDArray) else src
+            src = nd_array(np.ascontiguousarray(a[:, ::-1]))
+            label = label.copy()
+            valid = label[:, 0] >= 0
+            x1 = label[valid, 1].copy()
+            label[valid, 1] = 1.0 - label[valid, 3]
+            label[valid, 3] = 1.0 - x1
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop keeping enough object coverage
+    (ref: detection.py DetRandomCropAug)."""
+
+    def __init__(self, min_object_covered=0.1, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(0.05, 1.0), min_eject_coverage=0.3,
+                 max_attempts=50):
+        super().__init__(min_object_covered=min_object_covered,
+                         aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range,
+                         min_eject_coverage=min_eject_coverage,
+                         max_attempts=max_attempts)
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+
+    def _coverage(self, box, crop):
+        iw = max(0.0, min(box[2], crop[2]) - max(box[0], crop[0]))
+        ih = max(0.0, min(box[3], crop[3]) - max(box[1], crop[1]))
+        area = (box[2] - box[0]) * (box[3] - box[1])
+        return iw * ih / area if area > 0 else 0.0
+
+    def __call__(self, src, label):
+        a = src.asnumpy() if isinstance(src, NDArray) else src
+        h, w = a.shape[:2]
+        valid = label[:, 0] >= 0
+        boxes = label[valid, 1:5]
+        for _ in range(self.max_attempts):
+            ar = pyrandom.uniform(*self.aspect_ratio_range)
+            area = pyrandom.uniform(*self.area_range)
+            cw = min(1.0, np.sqrt(area * ar))
+            ch = min(1.0, np.sqrt(area / ar))
+            cx = pyrandom.uniform(0, 1 - cw)
+            cy = pyrandom.uniform(0, 1 - ch)
+            crop = (cx, cy, cx + cw, cy + ch)
+            if len(boxes) and max(
+                    (self._coverage(b, crop) for b in boxes), default=0.0
+            ) < self.min_object_covered:
+                continue
+            x0, y0 = int(cx * w), int(cy * h)
+            x1, y1 = max(x0 + 1, int((cx + cw) * w)), max(y0 + 1, int((cy + ch) * h))
+            out = np.ascontiguousarray(a[y0:y1, x0:x1])
+            new_label = label.copy()
+            for i in np.where(valid)[0]:
+                cov = self._coverage(label[i, 1:5], crop)
+                if cov < self.min_eject_coverage:
+                    new_label[i, 0] = -1.0  # ejected
+                    continue
+                bx = label[i, 1:5]
+                nb = [
+                    (max(bx[0], crop[0]) - crop[0]) / cw,
+                    (max(bx[1], crop[1]) - crop[1]) / ch,
+                    (min(bx[2], crop[2]) - crop[0]) / cw,
+                    (min(bx[3], crop[3]) - crop[1]) / ch,
+                ]
+                new_label[i, 1:5] = nb
+            return nd_array(out), new_label
+        return src, label
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expand/pad (ref: detection.py DetRandomPadAug)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33), area_range=(1.0, 3.0),
+                 max_attempts=50, pad_val=(127, 127, 127)):
+        super().__init__(aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range, max_attempts=max_attempts)
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        a = src.asnumpy() if isinstance(src, NDArray) else src
+        h, w = a.shape[:2]
+        scale = pyrandom.uniform(*self.area_range)
+        if scale <= 1.0:
+            return src, label
+        ar = pyrandom.uniform(*self.aspect_ratio_range)
+        nw = int(w * np.sqrt(scale * ar))
+        nh = int(h * np.sqrt(scale / ar))
+        nw, nh = max(nw, w), max(nh, h)
+        ox = pyrandom.randint(0, nw - w)
+        oy = pyrandom.randint(0, nh - h)
+        canvas = np.empty((nh, nw, a.shape[2]), a.dtype)
+        canvas[:] = np.asarray(self.pad_val, a.dtype)
+        canvas[oy:oy + h, ox:ox + w] = a
+        new_label = label.copy()
+        valid = new_label[:, 0] >= 0
+        new_label[valid, 1] = (label[valid, 1] * w + ox) / nw
+        new_label[valid, 2] = (label[valid, 2] * h + oy) / nh
+        new_label[valid, 3] = (label[valid, 3] * w + ox) / nw
+        new_label[valid, 4] = (label[valid, 4] * h + oy) / nh
+        return nd_array(canvas), new_label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_mirror=False, mean=None, std=None, brightness=0,
+                       contrast=0, saturation=0, hue=0, pca_noise=0,
+                       rand_gray=0, inter_method=2,
+                       min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), min_eject_coverage=0.3,
+                       max_attempts=50, pad_val=(127, 127, 127)):
+    """Detection augmenter factory (ref: detection.py CreateDetAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        # rand_crop is the per-image application probability (ref semantics)
+        crop = DetRandomCropAug(min_object_covered, aspect_ratio_range,
+                                (area_range[0], min(1.0, area_range[1])),
+                                min_eject_coverage, max_attempts)
+        auglist.append(DetRandomSelectAug([crop], skip_prob=1.0 - rand_crop))
+    if rand_pad > 0:
+        pad = DetRandomPadAug(aspect_ratio_range,
+                              (1.0, max(1.0, area_range[1])),
+                              max_attempts, pad_val)
+        auglist.append(DetRandomSelectAug([pad], skip_prob=1.0 - rand_pad))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    auglist.append(DetBorrowAug(ForceResizeAug((data_shape[2], data_shape[1]),
+                                               inter_method)))
+    for jitter, cls in ((brightness, BrightnessJitterAug),
+                       (contrast, ContrastJitterAug),
+                       (saturation, SaturationJitterAug),
+                       (hue, HueJitterAug)):
+        if jitter > 0:
+            auglist.append(DetBorrowAug(cls(jitter)))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.814],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(DetBorrowAug(LightingAug(pca_noise, eigval, eigvec)))
+    if rand_gray > 0:
+        auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    if mean is not None or std is not None:
+        auglist.append(DetBorrowAug(ColorNormalizeAug(
+            mean if mean is not None else np.zeros(3, np.float32),
+            std if std is not None else np.ones(3, np.float32))))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator: images + (max_objects, 5) padded box labels
+    (ref: python/mxnet/image/detection.py ImageDetIter; C++ twin
+    src/io/iter_image_det_recordio.cc)."""
+
+    def __init__(self, batch_size, data_shape, label_width=-1, aug_list=None,
+                 **kwargs):
+        det_kwargs = {k: kwargs.pop(k) for k in (
+            "rand_crop", "rand_pad", "min_object_covered", "aspect_ratio_range",
+            "area_range", "min_eject_coverage", "max_attempts", "pad_val",
+        ) if k in kwargs}
+        img_aug_kwargs = {k: kwargs.pop(k) for k in (
+            "resize", "rand_mirror", "mean", "std", "brightness", "contrast",
+            "saturation", "pca_noise", "rand_gray", "inter_method",
+        ) if k in kwargs}
+        super().__init__(batch_size, data_shape, label_width=1,
+                         aug_list=[], **kwargs)
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape, **det_kwargs,
+                                          **img_aug_kwargs)
+        self.det_auglist = aug_list
+        self.max_objects = self._scan_max_objects()
+
+    def _scan_max_objects(self):
+        mx_obj = 1
+        if self.imglist is not None:
+            for lbl, _ in self.imglist:
+                mx_obj = max(mx_obj, len(np.asarray(lbl).reshape(-1, 5)))
+        elif self.seq is not None:
+            for idx in self.seq:
+                s = self.imgrec.read_idx(idx)
+                header, _ = recordio.unpack(s)
+                lbl = np.asarray(header.label).reshape(-1)
+                if lbl.size >= 5:
+                    mx_obj = max(mx_obj, lbl.size // 5)
+        else:  # sequential .rec without .idx: full pass, then rewind
+            while True:
+                s = self.imgrec.read()
+                if s is None:
+                    break
+                header, _ = recordio.unpack(s)
+                lbl = np.asarray(header.label).reshape(-1)
+                if lbl.size >= 5:
+                    mx_obj = max(mx_obj, lbl.size // 5)
+            self.imgrec.reset()
+        return mx_obj
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size, self.max_objects, 5), np.float32)]
+
+    def next(self):
+        c, h, w = self.data_shape
+        batch_data = np.zeros((self.batch_size, c, h, w), np.float32)
+        batch_label = -np.ones((self.batch_size, self.max_objects, 5), np.float32)
+        i = 0
+        pad = 0
+        try:
+            while i < self.batch_size:
+                label, s = self.next_sample()
+                img = imdecode(s)
+                lbl = np.asarray(label, np.float32).reshape(-1, 5)
+                for aug in self.det_auglist:
+                    img, lbl = aug(img, lbl)
+                a = img.asnumpy() if isinstance(img, NDArray) else img
+                batch_data[i] = np.transpose(a.astype(np.float32), (2, 0, 1))
+                n = min(len(lbl), self.max_objects)
+                batch_label[i, :n] = lbl[:n]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            pad = self.batch_size - i
+        return DataBatch(data=[nd_array(batch_data)],
+                         label=[nd_array(batch_label)], pad=pad)
+
+
+__all__ += [
+    "DetAugmenter", "DetBorrowAug", "DetRandomSelectAug", "DetHorizontalFlipAug",
+    "DetRandomCropAug", "DetRandomPadAug", "CreateDetAugmenter", "ImageDetIter",
+]

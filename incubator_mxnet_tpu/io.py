@@ -370,3 +370,11 @@ def MXDataIter(*args, **kwargs):  # pragma: no cover - parity shim
     raise NotImplementedError(
         "C++-registered iterators surface as ImageRecordIter in the io package"
     )
+
+
+# C++-backed record iterators live in io_record.py to keep this module the
+# pure-Python DataIter layer (mirrors the reference's python/mxnet/io/ vs
+# src/io/ split); surface them here like the reference's registry does.
+from .io_record import ImageRecordIter, MNISTIter, LibSVMIter  # noqa: E402,F401
+
+__all__ += ["ImageRecordIter", "MNISTIter", "LibSVMIter"]

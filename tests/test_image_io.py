@@ -1,0 +1,197 @@
+"""Image/record data-pipeline tests (ref: tests/python/unittest/test_io.py
+ImageRecordIter/MNISTIter coverage + test_image.py ImageDetIter)."""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import io, image, recordio
+
+cv2 = pytest.importorskip("cv2")
+
+
+@pytest.fixture(scope="module")
+def rec_file(tmp_path_factory):
+    d = tmp_path_factory.mktemp("rec")
+    path = str(d / "data.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(24):
+        img = np.full((40, 40, 3), i * 10 % 255, np.uint8)
+        ok, buf = cv2.imencode(".jpg", img)
+        assert ok
+        w.write(recordio.pack(recordio.IRHeader(0, float(i % 4), i, 0),
+                              buf.tobytes()))
+    w.close()
+    return path
+
+
+def test_image_record_iter_batches(rec_file):
+    it = io.ImageRecordIter(path_imgrec=rec_file, data_shape=(3, 32, 32),
+                            batch_size=8, preprocess_threads=2)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (8, 3, 32, 32)
+    assert batches[0].label[0].shape == (8,)
+    # labels preserved (first batch unshuffled = 0,1,2,3,0,...)
+    np.testing.assert_allclose(batches[0].label[0].asnumpy(),
+                               np.arange(8) % 4)
+
+
+def test_image_record_iter_round_batch_pad(rec_file):
+    it = io.ImageRecordIter(path_imgrec=rec_file, data_shape=(3, 32, 32),
+                            batch_size=10, preprocess_threads=2)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 6  # 24 = 10+10+4 -> last padded by wraparound
+
+
+def test_image_record_iter_sharding(rec_file):
+    parts = []
+    for p in range(2):
+        it = io.ImageRecordIter(path_imgrec=rec_file, data_shape=(3, 32, 32),
+                                batch_size=4, part_index=p, num_parts=2,
+                                preprocess_threads=1)
+        parts.append(sum(b.data[0].shape[0] - b.pad for b in it))
+    assert parts == [12, 12]
+
+
+def test_image_record_iter_reset_reproduces(rec_file):
+    it = io.ImageRecordIter(path_imgrec=rec_file, data_shape=(3, 32, 32),
+                            batch_size=8, preprocess_threads=2)
+    a = [b.label[0].asnumpy() for b in it]
+    it.reset()
+    b = [b.label[0].asnumpy() for b in it]
+    np.testing.assert_array_equal(np.concatenate(a), np.concatenate(b))
+
+
+def test_mnist_iter(tmp_path):
+    imgs = np.random.randint(0, 255, (50, 28, 28), np.uint8)
+    labs = (np.arange(50) % 10).astype(np.uint8)
+    ip, lp = str(tmp_path / "img"), str(tmp_path / "lab")
+    with open(ip, "wb") as f:
+        f.write(struct.pack(">iiii", 2051, 50, 28, 28))
+        f.write(imgs.tobytes())
+    with open(lp, "wb") as f:
+        f.write(struct.pack(">ii", 2049, 50))
+        f.write(labs.tobytes())
+    it = io.MNISTIter(image=ip, label=lp, batch_size=10)
+    batches = list(it)
+    assert len(batches) == 5
+    assert batches[0].data[0].shape == (10, 1, 28, 28)
+    np.testing.assert_allclose(batches[0].label[0].asnumpy(), labs[:10])
+    # flat mode
+    it = io.MNISTIter(image=ip, label=lp, batch_size=10, flat=True)
+    assert next(iter(it)).data[0].shape == (10, 784)
+
+
+def test_libsvm_iter(tmp_path):
+    p = str(tmp_path / "d.svm")
+    with open(p, "w") as f:
+        for i in range(8):
+            f.write(f"{i % 2} 0:{i + 1}.0 3:2.5\n")
+    it = io.LibSVMIter(data_libsvm=p, data_shape=(6,), batch_size=4)
+    b = next(iter(it))
+    dense = b.data[0].asnumpy()
+    assert dense.shape == (4, 6)
+    np.testing.assert_allclose(dense[:, 0], [1, 2, 3, 4])
+    np.testing.assert_allclose(dense[:, 3], 2.5)
+    np.testing.assert_allclose(b.label[0].asnumpy(), [0, 1, 0, 1])
+
+
+def _det_sample():
+    img = image.imdecode(cv2.imencode(
+        ".jpg", np.random.randint(0, 255, (40, 40, 3), np.uint8))[1].tobytes())
+    label = np.array([[0, 0.2, 0.2, 0.6, 0.6], [1, 0.5, 0.5, 0.9, 0.9]],
+                     np.float32)
+    return img, label
+
+
+def test_det_horizontal_flip():
+    img, label = _det_sample()
+    aug = image.DetHorizontalFlipAug(p=1.0)
+    out, lbl = aug(img, label)
+    np.testing.assert_allclose(out.asnumpy(), img.asnumpy()[:, ::-1])
+    np.testing.assert_allclose(lbl[0, 1:5], [0.4, 0.2, 0.8, 0.6], atol=1e-6)
+
+
+def test_det_random_pad_keeps_boxes_normalized():
+    img, label = _det_sample()
+    aug = image.DetRandomPadAug(area_range=(2.0, 2.0))
+    out, lbl = aug(img, label)
+    assert out.shape[0] >= img.shape[0] and out.shape[1] >= img.shape[1]
+    valid = lbl[lbl[:, 0] >= 0]
+    assert (valid[:, 1:] >= 0).all() and (valid[:, 1:] <= 1).all()
+    # pad shrinks normalized box size
+    assert (valid[0, 3] - valid[0, 1]) < (label[0, 3] - label[0, 1])
+
+
+def test_det_random_crop_updates_labels():
+    np.random.seed(0)
+    img, label = _det_sample()
+    aug = image.DetRandomCropAug(min_object_covered=0.5,
+                                 area_range=(0.5, 1.0), max_attempts=50)
+    out, lbl = aug(img, label)
+    valid = lbl[lbl[:, 0] >= 0]
+    assert len(valid) >= 1
+    assert (valid[:, 1:] >= -1e-6).all() and (valid[:, 1:] <= 1 + 1e-6).all()
+
+
+def test_image_det_iter(tmp_path):
+    imglist = []
+    for i in range(6):
+        fname = str(tmp_path / f"im{i}.jpg")
+        cv2.imwrite(fname, np.random.randint(0, 255, (40, 40, 3), np.uint8))
+        nobj = 1 + i % 3
+        lbl = np.tile(np.array([i % 2, 0.1, 0.1, 0.7, 0.7], np.float32),
+                      (nobj, 1)).reshape(-1)
+        imglist.append((lbl, fname))
+    it = image.ImageDetIter(batch_size=3, data_shape=(3, 32, 32),
+                            imglist=imglist, path_root="", rand_mirror=True)
+    assert it.max_objects == 3
+    b = next(iter(it))
+    assert b.data[0].shape == (3, 3, 32, 32)
+    assert b.label[0].shape == (3, 3, 5)
+    lbl = b.label[0].asnumpy()
+    assert (lbl[0, 1:] == -1).all()  # first image has 1 object, rest padded
+
+
+def test_prefetch_iter_raises_after_exhaustion(rec_file):
+    it = io.ImageRecordIter(path_imgrec=rec_file, data_shape=(3, 32, 32),
+                            batch_size=8, preprocess_threads=1)
+    list(it)
+    with pytest.raises(StopIteration):
+        it.next()
+    with pytest.raises(StopIteration):  # stays exhausted, no deadlock
+        it.next()
+    it.close()
+
+
+def test_det_rand_crop_probability_zero_is_noop():
+    img, label = _det_sample()
+    augs = image.CreateDetAugmenter((3, 32, 32), rand_crop=0.0)
+    # no DetRandomSelectAug when probability is 0
+    assert not any(isinstance(a, image.DetRandomSelectAug) for a in augs)
+    augs = image.CreateDetAugmenter((3, 32, 32), rand_crop=0.7)
+    sel = [a for a in augs if isinstance(a, image.DetRandomSelectAug)]
+    assert len(sel) == 1 and sel[0].skip_prob == pytest.approx(0.3)
+
+
+def test_image_det_iter_seqless_rec(tmp_path):
+    # .rec with no .idx: max_objects must still come from a full scan
+    path = str(tmp_path / "det.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(4):
+        img = np.random.randint(0, 255, (40, 40, 3), np.uint8)
+        nobj = 1 + i % 3
+        lbl = np.tile(np.array([0, 0.1, 0.1, 0.6, 0.6], np.float32),
+                      (nobj, 1)).reshape(-1)
+        hdr = recordio.IRHeader(0, lbl, i, 0)
+        w.write(recordio.pack(hdr, cv2.imencode(".jpg", img)[1].tobytes()))
+    w.close()
+    it = image.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                            path_imgrec=path)
+    assert it.max_objects == 3
+    b = next(iter(it))
+    assert b.label[0].shape == (2, 3, 5)
