@@ -58,3 +58,4 @@ from . import operator  # noqa: F401
 from . import contrib  # noqa: F401
 from . import fused  # noqa: F401
 from . import rtc  # noqa: F401
+from . import deploy  # noqa: F401

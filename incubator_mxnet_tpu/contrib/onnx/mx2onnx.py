@@ -1,0 +1,184 @@
+"""Symbol -> ONNX export
+(ref: python/mxnet/contrib/onnx/mx2onnx/export_model.py + _op_translations.py).
+
+The graph walk + per-op translation tables are serializer-independent; only
+the final protobuf assembly needs the `onnx` package.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["export_model", "ONNX_OP_MAP"]
+
+# op-name -> (onnx_op_type, attr translator). Attr translators take the
+# node's registry attrs and return ONNX attribute dicts (ref:
+# mx2onnx/_op_translations.py one function per op).
+
+
+def _conv_attrs(a):
+    k = a.get("kernel")
+    return {
+        "kernel_shape": list(k),
+        "strides": list(a.get("stride") or (1,) * len(k)),
+        "pads": list(a.get("pad") or (0,) * len(k)) * 2,
+        "dilations": list(a.get("dilate") or (1,) * len(k)),
+        "group": int(a.get("num_group", 1)),
+    }
+
+
+def _pool_attrs(a):
+    k = a.get("kernel", (2, 2))
+    return {
+        "kernel_shape": list(k),
+        "strides": list(a.get("stride") or (1,) * len(k)),
+        "pads": list(a.get("pad") or (0,) * len(k)) * 2,
+    }
+
+
+ONNX_OP_MAP = {
+    "Convolution": ("Conv", _conv_attrs),
+    "FullyConnected": ("Gemm", lambda a: {"transB": 1}),
+    "Activation": (None, None),  # dispatched by act_type below
+    "BatchNorm": ("BatchNormalization",
+                  lambda a: {"epsilon": float(a.get("eps", 1e-3)),
+                             "momentum": float(a.get("momentum", 0.9))}),
+    "Pooling": (None, None),  # max/avg dispatch below
+    "Flatten": ("Flatten", lambda a: {"axis": 1}),
+    "softmax": ("Softmax", lambda a: {"axis": int(a.get("axis", -1))}),
+    "SoftmaxOutput": ("Softmax", lambda a: {"axis": -1}),
+    "Concat": ("Concat", lambda a: {"axis": int(a.get("dim", 1))}),
+    "Reshape": ("Reshape", lambda a: {}),  # shape initializer added in walk
+    "transpose": ("Transpose", lambda a: {"perm": list(a["axes"])}
+                  if a.get("axes") else {}),
+    "Dropout": ("Dropout", lambda a: {"ratio": float(a.get("p", 0.5))}),
+    "LeakyReLU": ("LeakyRelu", lambda a: {"alpha": float(a.get("slope", 0.25))}),
+    "elemwise_add": ("Add", lambda a: {}),
+    "broadcast_add": ("Add", lambda a: {}),
+    "elemwise_mul": ("Mul", lambda a: {}),
+    "broadcast_mul": ("Mul", lambda a: {}),
+    "elemwise_sub": ("Sub", lambda a: {}),
+    "dot": ("MatMul", lambda a: {}),
+    "LayerNorm": ("LayerNormalization",
+                  lambda a: {"epsilon": float(a.get("eps", 1e-5)),
+                             "axis": int(a.get("axis", -1))}),
+    "relu": ("Relu", lambda a: {}),
+    "sigmoid": ("Sigmoid", lambda a: {}),
+    "tanh": ("Tanh", lambda a: {}),
+    "exp": ("Exp", lambda a: {}),
+    "log": ("Log", lambda a: {}),
+    "sqrt": ("Sqrt", lambda a: {}),
+    "negative": ("Neg", lambda a: {}),
+    "Pad": ("Pad", lambda a: {"mode": a.get("mode", "constant")}),
+    # Gather's ONNX input order is (table, indices); Embedding's is
+    # (indices, weight) — reordered in graph_to_onnx_nodes
+    "Embedding": ("Gather", lambda a: {}),
+    # attribute forms valid at the emitted opset (8): Clip(min,max),
+    # Slice(axes,starts,ends), Upsample(scales)
+    "clip": ("Clip", lambda a: {"min": float(a["a_min"]),
+                                "max": float(a["a_max"])}),
+    "slice_axis": ("Slice", lambda a: {"axes": [int(a["axis"])],
+                                       "starts": [int(a["begin"])],
+                                       "ends": [int(a["end"]) if a.get("end")
+                                                is not None else 2**31 - 1]}),
+    "UpSampling": ("Upsample", lambda a: {
+        "mode": "nearest" if a.get("sample_type", "nearest") == "nearest"
+        else "linear",
+        "scales": [1.0, 1.0, float(a["scale"]), float(a["scale"])]}),
+    "mean": ("ReduceMean", lambda a: {}),
+    "sum": ("ReduceSum", lambda a: {}),
+    "max": ("ReduceMax", lambda a: {}),
+}
+
+_OPSET = 8  # highest opset where the attribute forms above are all legal
+
+_ACT_MAP = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+            "softrelu": "Softplus", "softsign": "Softsign"}
+_POOL_MAP = {"max": "MaxPool", "avg": "AveragePool"}
+
+
+def graph_to_onnx_nodes(symbol):
+    """Walk the symbol graph into (op_type, inputs, outputs, attrs, name,
+    const_inputs) tuples — the serializer-independent core of the exporter.
+    const_inputs maps extra input names to numpy arrays that the serializer
+    must materialize as initializers (e.g. Reshape's target shape)."""
+    nodes = []
+    for node in symbol._topo_nodes():
+        if node.is_var:
+            continue
+        op = node.op.name
+        attrs = dict(node.attrs)
+        consts = {}
+        if op == "Activation":
+            ot, oattrs = _ACT_MAP[attrs.get("act_type", "relu")], {}
+        elif op == "Pooling":
+            if attrs.get("global_pool"):
+                ot = ("GlobalMaxPool" if attrs.get("pool_type", "max") == "max"
+                      else "GlobalAveragePool")
+                oattrs = {}
+            else:
+                ot = _POOL_MAP[attrs.get("pool_type", "max")]
+                oattrs = _pool_attrs(attrs)
+        elif op in ONNX_OP_MAP and ONNX_OP_MAP[op][0] is not None:
+            ot, tr = ONNX_OP_MAP[op]
+            oattrs = tr(attrs)
+        else:
+            raise NotImplementedError(
+                f"ONNX export: no translation for op '{op}' "
+                f"(ref mapping table: mx2onnx/_op_translations.py)")
+        in_names = [src.name if src.is_var else f"{src.name}_out{idx}"
+                    for src, idx in node.inputs]
+        if op == "Embedding":  # ONNX Gather is (table, indices)
+            in_names = [in_names[1], in_names[0]]
+        elif op == "SoftmaxOutput":  # label input has no ONNX counterpart
+            in_names = in_names[:1]
+        elif op == "Reshape":  # target shape is a tensor input at opset>=5
+            shape_name = f"{node.name}_shape"
+            consts[shape_name] = np.asarray(attrs["shape"], np.int64)
+            in_names = in_names[:1] + [shape_name]
+        out_names = [f"{node.name}_out{i}" for i in range(node.num_outputs)]
+        nodes.append((ot, in_names, out_names, oattrs, node.name, consts))
+    return nodes
+
+
+def export_model(sym, params, input_shape, input_type=np.float32,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Export symbol+params to an ONNX file (ref: export_model.py:83).
+
+    Requires the `onnx` package at call time.
+    """
+    try:
+        import onnx
+        from onnx import TensorProto, helper, numpy_helper
+    except ImportError as e:  # environment gate, mirrors reference behavior
+        raise ImportError(
+            "onnx package is required for export_model; install onnx or use "
+            "incubator_mxnet_tpu.deploy.export_predictor for the TPU-native "
+            "StableHLO deployment path") from e
+
+    nodes = graph_to_onnx_nodes(sym)
+    args = sym.list_arguments()
+    shapes = input_shape if isinstance(input_shape, list) else [input_shape]
+    data_names = [n for n in args if n not in params][: len(shapes)]
+
+    inits, inputs = [], []
+    for n, shp in zip(data_names, shapes):
+        inputs.append(helper.make_tensor_value_info(
+            n, TensorProto.FLOAT, list(shp)))
+    for name, arr in params.items():
+        a = arr.asnumpy() if hasattr(arr, "asnumpy") else np.asarray(arr)
+        inits.append(numpy_helper.from_array(a, name=name))
+
+    onnx_nodes = []
+    for ot, ins, outs, attrs, name, consts in nodes:
+        for cname, carr in consts.items():
+            inits.append(numpy_helper.from_array(carr, name=cname))
+        onnx_nodes.append(helper.make_node(ot, ins, outs, name=name, **attrs))
+    last_outs = nodes[-1][2]
+    outputs = [helper.make_tensor_value_info(o, TensorProto.FLOAT, None)
+               for o in last_outs]
+    graph = helper.make_graph(onnx_nodes, "incubator_mxnet_tpu", inputs,
+                              outputs, initializer=inits)
+    model = helper.make_model(
+        graph, opset_imports=[helper.make_opsetid("", _OPSET)])
+    onnx.save(model, onnx_file_path)
+    return onnx_file_path

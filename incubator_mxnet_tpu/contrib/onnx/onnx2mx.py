@@ -1,0 +1,145 @@
+"""ONNX -> Symbol import
+(ref: python/mxnet/contrib/onnx/onnx2mx/import_model.py + import_onnx.py).
+
+Returns (sym, arg_params, aux_params) like the reference's import_model.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["import_model"]
+
+
+def _attrs(node):
+    out = {}
+    for a in node.attribute:
+        if a.type == a.INT:
+            out[a.name] = int(a.i)
+        elif a.type == a.FLOAT:
+            out[a.name] = float(a.f)
+        elif a.type == a.INTS:
+            out[a.name] = tuple(a.ints)
+        elif a.type == a.FLOATS:
+            out[a.name] = tuple(a.floats)
+        elif a.type == a.STRING:
+            out[a.name] = a.s.decode()
+    return out
+
+
+def import_model(model_file):
+    """Load an ONNX model into (sym, arg_params, aux_params)
+    (ref: import_model.py:31). Requires the `onnx` package."""
+    try:
+        import onnx
+        from onnx import numpy_helper
+    except ImportError as e:
+        raise ImportError(
+            "onnx package is required for import_model") from e
+
+    from ... import symbol as sym
+    from ...ndarray import array as nd_array
+
+    model = onnx.load(model_file)
+    graph = model.graph
+    params = {init.name: nd_array(numpy_helper.to_array(init))
+              for init in graph.initializer}
+
+    env = {}  # onnx value name -> Symbol
+    for name in list(params):
+        env[name] = sym.Variable(name)
+    for inp in graph.input:
+        if inp.name not in env:
+            env[inp.name] = sym.Variable(inp.name)
+
+    def conv(node):
+        a = _attrs(node)
+        ins = [env[i] for i in node.input if i]
+        t = node.op_type
+        if t == "Conv":
+            k = a.get("kernel_shape")
+            pads = a.get("pads", (0,) * (2 * len(k)))
+            return sym.Convolution(
+                *ins, kernel=tuple(k), stride=tuple(a.get("strides", (1,) * len(k))),
+                pad=tuple(pads[: len(k)]), dilate=tuple(a.get("dilations", (1,) * len(k))),
+                num_group=int(a.get("group", 1)),
+                num_filter=int(params[node.input[1]].shape[0]),
+                no_bias=len(ins) < 3)
+        if t == "Gemm":
+            if a.get("alpha", 1.0) != 1.0 or a.get("beta", 1.0) != 1.0 \
+                    or a.get("transA", 0):
+                raise NotImplementedError(
+                    "Gemm with alpha/beta != 1 or transA is not supported")
+            w = params[node.input[1]]
+            if not a.get("transB", 0):
+                # FullyConnected computes x·W^T; un-transposed ONNX weight
+                # (K, N) must be stored transposed
+                w = nd_array(w.asnumpy().T)
+                params[node.input[1]] = w
+            return sym.FullyConnected(*ins, num_hidden=int(w.shape[0]),
+                                      no_bias=len(ins) < 3)
+        if t == "MatMul":
+            return sym.dot(*ins)
+        if t == "BatchNormalization":
+            return sym.BatchNorm(*ins, eps=a.get("epsilon", 1e-5),
+                                 momentum=a.get("momentum", 0.9),
+                                 fix_gamma=False)
+        if t in ("Relu", "Sigmoid", "Tanh"):
+            return sym.Activation(ins[0], act_type=t.lower())
+        if t == "LeakyRelu":
+            return sym.LeakyReLU(ins[0], slope=a.get("alpha", 0.01))
+        if t == "Softmax":
+            return sym.softmax(ins[0], axis=a.get("axis", -1))
+        if t == "MaxPool":
+            return sym.Pooling(ins[0], kernel=tuple(a["kernel_shape"]),
+                               pool_type="max",
+                               stride=tuple(a.get("strides", (1, 1))),
+                               pad=tuple(a.get("pads", (0, 0, 0, 0))[:2]))
+        if t == "AveragePool":
+            return sym.Pooling(ins[0], kernel=tuple(a["kernel_shape"]),
+                               pool_type="avg",
+                               stride=tuple(a.get("strides", (1, 1))),
+                               pad=tuple(a.get("pads", (0, 0, 0, 0))[:2]))
+        if t == "GlobalAveragePool":
+            return sym.Pooling(ins[0], kernel=(1, 1), pool_type="avg",
+                               global_pool=True)
+        if t == "GlobalMaxPool":
+            return sym.Pooling(ins[0], kernel=(1, 1), pool_type="max",
+                               global_pool=True)
+        if t == "Flatten":
+            return sym.Flatten(ins[0])
+        if t == "Add":
+            return ins[0] + ins[1]
+        if t == "Sub":
+            return ins[0] - ins[1]
+        if t == "Mul":
+            return ins[0] * ins[1]
+        if t == "Concat":
+            return sym.Concat(*ins, dim=a.get("axis", 1))
+        if t == "Reshape":
+            shape = tuple(int(x) for x in
+                          np.asarray(params[node.input[1]].asnumpy(), np.int64))
+            return sym.Reshape(ins[0], shape=shape)
+        if t == "Transpose":
+            return sym.transpose(ins[0], axes=a.get("perm"))
+        if t == "Dropout":
+            return sym.Dropout(ins[0], p=a.get("ratio", 0.5))
+        if t == "Gather":
+            w = params[node.input[0]]
+            return sym.Embedding(ins[1], ins[0], input_dim=int(w.shape[0]),
+                                 output_dim=int(w.shape[1]))
+        raise NotImplementedError(
+            f"ONNX import: unsupported op {t} "
+            f"(ref: onnx2mx/_op_translations.py)")
+
+    for node in graph.node:
+        out_sym = conv(node)
+        outs = list(out_sym) if len(node.output) > 1 else [out_sym]
+        for name, s in zip(node.output, outs):
+            env[name] = s
+
+    final = env[graph.output[0].name]
+    arg_names = set(final.list_arguments())
+    aux_names = set(final.list_auxiliary_states())
+    arg_params = {k: v for k, v in params.items() if k in arg_names}
+    aux_params = {k: v for k, v in params.items() if k in aux_names}
+    return final, arg_params, aux_params

@@ -1,0 +1,5 @@
+"""Contrib layers (ref: python/mxnet/gluon/contrib/nn/basic_layers.py)."""
+from .basic_layers import (  # noqa: F401
+    Concurrent, HybridConcurrent, Identity, SparseEmbedding, SyncBatchNorm,
+    PixelShuffle1D, PixelShuffle2D, PixelShuffle3D,
+)

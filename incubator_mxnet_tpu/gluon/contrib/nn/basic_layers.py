@@ -1,0 +1,133 @@
+"""Contrib basic layers
+(ref: python/mxnet/gluon/contrib/nn/basic_layers.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...block import HybridBlock
+from ...nn.basic_layers import BatchNorm, Embedding, HybridSequential, Sequential
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D", "PixelShuffle3D"]
+
+
+class Concurrent(Sequential):
+    """Run children on the same input, concat outputs along `axis`
+    (ref: contrib/nn/basic_layers.py Concurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import ndarray as nd
+
+        return nd.concat(*[block(x) for block in self._children.values()],
+                         dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (ref: contrib HybridConcurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        return F.Concat(*[block(x) for block in self._children.values()],
+                        dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """(ref: contrib Identity)"""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Embedding):
+    """Embedding with row-sparse gradient intent. On TPU the dense gather's
+    VJP is already a scatter-add XLA fuses well, so this is Embedding with
+    the reference's API (ref: contrib SparseEmbedding, gluon/nn Embedding
+    sparse_grad=True)."""
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device batch normalization
+    (ref: python/mxnet/gluon/contrib/nn/basic_layers.py SyncBatchNorm over
+    src/operator/contrib/sync_batch_norm.cc).
+
+    TPU-native semantics: under pjit with the batch axis sharded over the
+    mesh, statistics are computed over the GLOBAL batch by construction (XLA
+    inserts the cross-chip reductions), so this layer equals BatchNorm there.
+    For shard_map per-replica programs pass `axis_name` to pmean the
+    statistics across that mesh axis (the reference's num_devices group).
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True, use_global_stats=False,
+                 axis_name=None, **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         in_channels=in_channels, **kwargs)
+        self._axis_name = axis_name
+        self._num_devices = num_devices
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        return F._contrib_SyncBatchNorm(
+            x, gamma, beta, running_mean, running_var,
+            eps=self._epsilon, momentum=self._momentum,
+            fix_gamma=not self._scale,
+            use_global_stats=self._use_global_stats,
+            ndev=self._num_devices or 1, axis_name=self._axis_name,
+        )
+
+
+class _PixelShuffle(HybridBlock):
+    def __init__(self, factor, ndim):
+        super().__init__()
+        self._factor = ((factor,) * ndim if np.isscalar(factor)
+                        else tuple(factor))
+        self._ndim = ndim
+
+    def hybrid_forward(self, F, x):
+        # NDArray-level implementation via reshape/transpose ops
+        f = self._factor
+        shape = x.shape
+        n, c = shape[0], shape[1]
+        spatial = shape[2:]
+        import math
+
+        cf = math.prod(f)
+        c_out = c // cf
+        # (N, C_out, f1..fk, d1..dk) -> interleave -> (N, C_out, d1*f1, ...)
+        x = F.reshape(x, shape=(n, c_out) + f + spatial)
+        ndim = self._ndim
+        perm = [0, 1]
+        for i in range(ndim):
+            perm += [2 + ndim + i, 2 + i]
+        x = F.transpose(x, axes=tuple(perm))
+        out_spatial = tuple(d * fi for d, fi in zip(spatial, f))
+        return F.reshape(x, shape=(n, c_out) + out_spatial)
+
+
+class PixelShuffle1D(_PixelShuffle):
+    """(ref: contrib PixelShuffle1D)"""
+
+    def __init__(self, factor):
+        super().__init__(factor, 1)
+
+
+class PixelShuffle2D(_PixelShuffle):
+    """(ref: contrib PixelShuffle2D)"""
+
+    def __init__(self, factor):
+        super().__init__(factor, 2)
+
+
+class PixelShuffle3D(_PixelShuffle):
+    """(ref: contrib PixelShuffle3D)"""
+
+    def __init__(self, factor):
+        super().__init__(factor, 3)

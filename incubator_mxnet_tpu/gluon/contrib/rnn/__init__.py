@@ -1,0 +1,2 @@
+"""Contrib RNN cells (ref: python/mxnet/gluon/contrib/rnn/rnn_cell.py)."""
+from .rnn_cell import VariationalDropoutCell, LSTMPCell  # noqa: F401

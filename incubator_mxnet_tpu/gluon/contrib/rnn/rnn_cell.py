@@ -1,0 +1,122 @@
+"""Contrib recurrent cells
+(ref: python/mxnet/gluon/contrib/rnn/rnn_cell.py)."""
+from __future__ import annotations
+
+from ...rnn.rnn_cell import LSTMCell, ModifierCell, RecurrentCell
+from ... import parameter as _param  # noqa: F401  (init path parity)
+
+__all__ = ["VariationalDropoutCell", "LSTMPCell"]
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Variational (per-sequence, shared-across-time) dropout wrapper
+    (ref: gluon/contrib/rnn/rnn_cell.py VariationalDropoutCell). The same
+    dropout masks are sampled once per unroll and reused at every step —
+    exactly the property that makes it XLA-friendly (masks are loop
+    invariants the compiler keeps in registers/VMEM).
+    """
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._input_mask = None
+        self._state_masks = None
+        self._output_mask = None
+
+    def reset(self):
+        super().reset()
+        self._input_mask = None
+        self._state_masks = None
+        self._output_mask = None
+
+    def _mask_like(self, p, arr):
+        from .... import ndarray as nd
+
+        keep = 1.0 - p
+        mask = nd.random.uniform(shape=arr.shape) < keep
+        return mask.astype("float32") / keep
+
+    def hybrid_forward(self, F, inputs, states, **kwargs):
+        from .... import autograd
+
+        if not autograd.is_training():  # dropout is identity at inference,
+            return self.base_cell(inputs, states)  # like the Dropout op
+        if self.drop_inputs:
+            if self._input_mask is None:
+                self._input_mask = self._mask_like(self.drop_inputs, inputs)
+            inputs = inputs * self._input_mask
+        if self.drop_states:
+            if self._state_masks is None:
+                self._state_masks = [self._mask_like(self.drop_states, s)
+                                     for s in states]
+            states = [s * m for s, m in zip(states, self._state_masks)]
+        out, nstates = self.base_cell(inputs, states)
+        if self.drop_outputs:
+            if self._output_mask is None:
+                self._output_mask = self._mask_like(self.drop_outputs, out)
+            out = out * self._output_mask
+        return out, nstates
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with a projection layer on the hidden state
+    (ref: gluon/contrib/rnn/rnn_cell.py LSTMPCell — LSTMP from
+    Sak et al. 2014). The projection matmul fuses into the recurrent
+    matmuls on the MXU.
+    """
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        from .... import initializer as init_mod
+
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_size, input_size),
+                allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_size, projection_size))
+            self.h2r_weight = self.params.get(
+                "h2r_weight", shape=(projection_size, hidden_size))
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_size,), init=init_mod.Zero())
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_size,), init=init_mod.Zero())
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size)},
+                {"shape": (batch_size, self._hidden_size)}]
+
+    def _pre_forward(self, x, states):
+        if not self.i2h_weight._shape_known():
+            self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, **kwargs):
+        from .... import ndarray as nd
+
+        r, c = states  # projected hidden, cell
+        gates = (
+            nd.FullyConnected(inputs, self.i2h_weight.data(),
+                              self.i2h_bias.data(),
+                              num_hidden=4 * self._hidden_size)
+            + nd.FullyConnected(r, self.h2h_weight.data(),
+                                self.h2h_bias.data(),
+                                num_hidden=4 * self._hidden_size)
+        )
+        i, f, g, o = nd.SliceChannel(gates, num_outputs=4, axis=1)
+        i = nd.Activation(i, act_type="sigmoid")
+        f = nd.Activation(f, act_type="sigmoid")
+        g = nd.Activation(g, act_type="tanh")
+        o = nd.Activation(o, act_type="sigmoid")
+        c_next = f * c + i * g
+        h = o * nd.Activation(c_next, act_type="tanh")
+        r_next = nd.FullyConnected(h, self.h2r_weight.data(), None,
+                                   num_hidden=self._projection_size,
+                                   no_bias=True)
+        return r_next, [r_next, c_next]

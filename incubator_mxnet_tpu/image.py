@@ -732,7 +732,10 @@ class ImageDetIter(ImageIter):
             aug_list = CreateDetAugmenter(data_shape, **det_kwargs,
                                           **img_aug_kwargs)
         self.det_auglist = aug_list
-        self.max_objects = self._scan_max_objects()
+        # label_width > 0 (flat label slots, the reference's escape hatch)
+        # skips the full-dataset label scan — essential for large shards
+        self.max_objects = (label_width // 5 if label_width > 0
+                            else self._scan_max_objects())
 
     def _scan_max_objects(self):
         mx_obj = 1

@@ -72,15 +72,21 @@ class _PrefetchMixin:
         self._thread.start()
 
     def _stop_prefetch(self):
-        if getattr(self, "_thread", None) is not None:
-            self._stop.set()
-            try:
-                while True:
-                    self._q.get_nowait()
-            except queue.Empty:
-                pass
-            self._thread.join(timeout=5)
-            self._thread = None
+        """Returns True when the producer thread has fully exited."""
+        t = getattr(self, "_thread", None)
+        if t is None:
+            return True
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        t.join(timeout=5)
+        if t.is_alive():  # producer wedged (e.g. slow decode) — keep ref
+            return False
+        self._thread = None
+        return True
 
     def close(self):
         self._stop_prefetch()
@@ -101,6 +107,69 @@ class _PrefetchMixin:
                 raise self._producer_exc
             raise StopIteration
         return b
+
+
+class _PyRandomAccessRec:
+    """Thread-safe random-access fallback over a .rec file (no .idx needed).
+
+    One header-only scan builds the offset table, then every read is a
+    single `os.pread` — positionless, so the decode thread pool can read
+    concurrently without locks (the C++ engine does the same via mmap).
+    """
+
+    def __init__(self, uri, idx_path=None):
+        from .recordio import _MAGIC, _decode_lrec
+        import struct
+
+        self._fd = os.open(uri, os.O_RDONLY)
+        self._offsets = []  # (payload_offset, length)
+        if idx_path and os.path.isfile(idx_path):
+            # honor a user-supplied .idx (subset / custom order): each line
+            # is "key\tbyte_offset" of a record start
+            starts = []
+            with open(idx_path) as f:
+                for line in f:
+                    parts = line.split()
+                    if len(parts) >= 2:
+                        starts.append(int(parts[1]))
+            for pos in starts:
+                head = os.pread(self._fd, 8, pos)
+                magic, lrec = struct.unpack("<II", head)
+                if magic != _MAGIC:
+                    raise IOError(f"bad idx offset {pos} for {uri}")
+                _, length = _decode_lrec(lrec)
+                self._offsets.append((pos + 8, length))
+        else:
+            pos = 0
+            size = os.fstat(self._fd).st_size
+            while pos + 8 <= size:
+                head = os.pread(self._fd, 8, pos)
+                magic, lrec = struct.unpack("<II", head)
+                if magic != _MAGIC:
+                    raise IOError(f"invalid record magic {magic:#x} in {uri}")
+                _, length = _decode_lrec(lrec)
+                self._offsets.append((pos + 8, length))
+                pos += 8 + length + (4 - length % 4) % 4
+        if not self._offsets:
+            raise IOError(f"no records found in {uri}")
+
+    def __len__(self):
+        return len(self._offsets)
+
+    def read(self, i):
+        off, length = self._offsets[i]
+        return os.pread(self._fd, length, off)
+
+    def close(self):
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class ImageRecordIter(_PrefetchMixin, DataIter):
@@ -133,18 +202,19 @@ class ImageRecordIter(_PrefetchMixin, DataIter):
         self.shuffle = shuffle
         self._rng = pyrandom.Random(seed)
 
-        # --- shard reader: native engine first, Python fallback ---
-        try:
-            self._reader = recordio.NativeRecordReader(path_imgrec)
-            n = len(self._reader)
-            self._read = lambda i: self._reader.read(i)
-        except (RuntimeError, IOError):
-            idx_path = path_imgidx or (os.path.splitext(path_imgrec)[0] + ".idx")
-            rec = recordio.MXIndexedRecordIO(idx_path, path_imgrec, "r")
-            keys = list(rec.keys)
-            n = len(keys)
-            self._reader = rec
-            self._read = lambda i: rec.read_idx(keys[i])
+        # --- shard reader: native engine first, pread-based Python fallback
+        #     (both are positionless -> safe under the decode thread pool) ---
+        if path_imgidx:
+            # explicit .idx subsets/reorders the shard; pread fallback
+            # handles it natively
+            self._reader = _PyRandomAccessRec(path_imgrec, path_imgidx)
+        else:
+            try:
+                self._reader = recordio.NativeRecordReader(path_imgrec)
+            except (RuntimeError, IOError):
+                self._reader = _PyRandomAccessRec(path_imgrec)
+        n = len(self._reader)
+        self._read = self._reader.read
 
         self._seq = list(range(n))
         if num_parts > 1:  # distributed sharding (ref: part_index/num_parts)
@@ -163,6 +233,7 @@ class ImageRecordIter(_PrefetchMixin, DataIter):
             rand_mirror=rand_mirror, mean=mean, std=std,
             brightness=random_l / 255.0 if random_l else 0,
             saturation=random_s / 255.0 if random_s else 0,
+            hue=random_h / 180.0 if random_h else 0,
             inter_method=inter_method)
         self._scale = float(scale)
 
@@ -174,7 +245,7 @@ class ImageRecordIter(_PrefetchMixin, DataIter):
     @property
     def provide_data(self):
         return [DataDesc(self.data_name, (self.batch_size,) + self.data_shape,
-                         np.float32)]
+                         np.dtype(self.dtype))]
 
     @property
     def provide_label(self):
@@ -192,7 +263,7 @@ class ImageRecordIter(_PrefetchMixin, DataIter):
         a = img.asnumpy() if hasattr(img, "asnumpy") else np.asarray(img)
         a = np.transpose(a.astype(np.float32), (2, 0, 1)) * self._scale
         label = np.asarray(header.label, np.float32)
-        return a, label
+        return a.astype(self.dtype, copy=False), label
 
     def _produce(self):
         if self._cursor >= len(self._seq):
@@ -219,6 +290,14 @@ class ImageRecordIter(_PrefetchMixin, DataIter):
             self._rng.shuffle(self._seq)
         self._cursor = 0
         self._start_prefetch(self._prefetch_depth)
+
+    def close(self):
+        stopped = self._stop_prefetch()
+        self._pool.shutdown(wait=stopped)
+        if stopped:
+            # only close the fd once no producer/decoder can still read it;
+            # otherwise leave cleanup to GC rather than risk EBADF races
+            self._reader.close()
 
 
 def _read_idx_images(path):

@@ -10,3 +10,4 @@ from . import nn  # noqa: F401
 from . import random  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import vision  # noqa: F401
+from . import contrib_ops  # noqa: F401

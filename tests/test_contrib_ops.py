@@ -1,0 +1,168 @@
+"""Contrib op tests (ref: tests/python/unittest/test_contrib_operator.py +
+gpu/test_gluon_contrib.py SyncBatchNorm consistency tests)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+
+
+def test_fft_ifft_roundtrip():
+    x = nd.random.uniform(shape=(3, 8))
+    y = nd.contrib.fft(x)
+    assert y.shape == (3, 16)
+    # interleaved real/imag matches numpy fft
+    ref = np.fft.fft(x.asnumpy(), axis=-1)
+    got = y.asnumpy().reshape(3, 8, 2)
+    np.testing.assert_allclose(got[..., 0], ref.real, atol=1e-4)
+    np.testing.assert_allclose(got[..., 1], ref.imag, atol=1e-4)
+    # ifft is unnormalized like the reference (scales by d)
+    back = nd.contrib.ifft(y)
+    np.testing.assert_allclose(back.asnumpy(), x.asnumpy() * 8, rtol=1e-4)
+
+
+def test_count_sketch():
+    d, od = 10, 5
+    h = np.random.randint(0, od, d).astype(np.float32)
+    s = np.random.choice([-1.0, 1.0], d).astype(np.float32)
+    data = np.random.uniform(size=(4, d)).astype(np.float32)
+    out = nd.contrib.count_sketch(nd.array(data), nd.array(h), nd.array(s),
+                                  out_dim=od).asnumpy()
+    ref = np.zeros((4, od), np.float32)
+    for i in range(d):
+        ref[:, int(h[i])] += s[i] * data[:, i]
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_quadratic_and_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.contrib.quadratic(x, a=1.0, b=2.0, c=3.0)
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(y.asnumpy(), [6.0, 11.0, 18.0])
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy() + 2)
+
+
+def test_group_norm():
+    x = np.random.uniform(size=(2, 6, 4, 4)).astype(np.float32)
+    gamma = np.random.uniform(size=(6,)).astype(np.float32)
+    beta = np.random.uniform(size=(6,)).astype(np.float32)
+    out = nd.GroupNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                       num_groups=3).asnumpy()
+    xa = x.reshape(2, 3, 2, 4, 4)
+    mean = xa.mean(axis=(2, 3, 4), keepdims=True)
+    var = xa.var(axis=(2, 3, 4), keepdims=True)
+    ref = ((xa - mean) / np.sqrt(var + 1e-5)).reshape(2, 6, 4, 4)
+    ref = ref * gamma.reshape(1, 6, 1, 1) + beta.reshape(1, 6, 1, 1)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_sync_batch_norm_matches_batch_norm_single_device():
+    x = nd.random.uniform(shape=(8, 3, 5, 5))
+    sbn = gluon.contrib.nn.SyncBatchNorm(in_channels=3)
+    bn = gluon.nn.BatchNorm(in_channels=3)
+    sbn.initialize()
+    bn.initialize()
+    with autograd.record():
+        a = sbn(x)
+    with autograd.record():
+        b = bn(x)
+    np.testing.assert_allclose(a.asnumpy(), b.asnumpy(), atol=2e-3)
+
+
+def test_sync_batch_norm_cross_replica_shard_map():
+    """The TPU design point: per-replica shards + axis_name pmean must equal
+    global-batch statistics (ref: sync_batch_norm.cc cross-device reduce)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    from incubator_mxnet_tpu.ops.registry import OP_REGISTRY
+
+    fn = OP_REGISTRY["_contrib_SyncBatchNorm"].fn
+    devs = np.array(jax.devices()[:8])
+    mesh = Mesh(devs, ("dp",))
+    x = np.random.uniform(size=(16, 3, 4, 4)).astype(np.float32)
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    mm = np.zeros(3, np.float32)
+    mv = np.ones(3, np.float32)
+
+    def local(xs, g, b, m, v):
+        out, nm, nv = fn(xs, g, b, m, v, fix_gamma=False, axis_name="dp",
+                         _training=True)
+        return out, nm, nv
+
+    sharded = shard_map(
+        local, mesh=mesh,
+        in_specs=(P("dp"), P(), P(), P(), P()),
+        out_specs=(P("dp"), P(), P()))
+    out, nm, nv = sharded(x, gamma, beta, mm, mv)
+
+    # oracle: plain global batch norm on the full batch
+    ref_out, ref_m, ref_v = fn(jnp.asarray(x), gamma, beta, mm, mv,
+                               fix_gamma=False, axis_name=None, _training=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(nm), np.asarray(ref_m), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(nv), np.asarray(ref_v), atol=1e-6)
+
+
+def test_concurrent_and_identity():
+    net = gluon.contrib.nn.HybridConcurrent(axis=1)
+    net.add(gluon.contrib.nn.Identity())
+    net.add(gluon.nn.Dense(4))
+    net.initialize()
+    out = net(nd.ones((2, 3)))
+    assert out.shape == (2, 7)
+
+
+def test_pixel_shuffle():
+    ps = gluon.contrib.nn.PixelShuffle2D(2)
+    x = np.arange(1 * 4 * 2 * 2, dtype=np.float32).reshape(1, 4, 2, 2)
+    out = ps(nd.array(x)).asnumpy()
+    assert out.shape == (1, 1, 4, 4)
+    # channel (f1,f2) blocks interleave into space
+    assert out[0, 0, 0, 0] == x[0, 0, 0, 0]
+    assert out[0, 0, 0, 1] == x[0, 1, 0, 0]
+    assert out[0, 0, 1, 0] == x[0, 2, 0, 0]
+    ps1 = gluon.contrib.nn.PixelShuffle1D(3)
+    assert ps1(nd.ones((1, 6, 5))).shape == (1, 2, 15)
+
+
+def test_variational_dropout_cell_mask_constant_over_time():
+    cell = gluon.contrib.rnn.VariationalDropoutCell(
+        gluon.rnn.RNNCell(8), drop_inputs=0.5)
+    cell.base_cell.initialize()
+    x = nd.ones((2, 4))
+    states = cell.begin_state(2)
+    with autograd.record():
+        _, states = cell(x, states)
+        mask_t0 = cell._input_mask
+        assert mask_t0 is not None and mask_t0.shape == (2, 4)
+        _, states = cell(x, states)
+        assert cell._input_mask is mask_t0  # same mask across time steps
+    cell.reset()
+    assert cell._input_mask is None  # fresh mask per sequence
+    # inference: dropout is identity -> no mask is ever sampled
+    outs, _ = cell.unroll(6, nd.ones((2, 6, 4)), merge_outputs=True)
+    assert outs.shape == (2, 6, 8)
+    assert cell._input_mask is None
+
+
+def test_lstmp_cell_projection():
+    cell = gluon.contrib.rnn.LSTMPCell(16, 8)
+    cell.initialize()
+    x = nd.random.uniform(shape=(3, 5, 10))
+    outs, states = cell.unroll(5, x, merge_outputs=True)
+    assert outs.shape == (3, 5, 8)
+    assert states[0].shape == (3, 8) and states[1].shape == (3, 16)
+
+
+def test_sparse_embedding():
+    emb = gluon.contrib.nn.SparseEmbedding(10, 4)
+    emb.initialize()
+    out = emb(nd.array([1, 3, 5]))
+    assert out.shape == (3, 4)

@@ -1,0 +1,136 @@
+"""Deployment/predict API tests (ref: the c_predict_api usage pattern in
+tests/python/predict/ + amalgamation's predict-only contract) and ONNX
+graph-walk tests (ref: tests/python-pytest/onnx/)."""
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import deploy, nd, sym
+
+
+def _small_net():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=3, name="fc2")
+    return sym.softmax(net)
+
+
+def _bound(net, batch=2, dim=5):
+    ex = net.simple_bind(mx.cpu(), data=(batch, dim))
+    for k, v in ex.arg_dict.items():
+        if k != "data":
+            v[:] = nd.random.uniform(shape=v.shape)
+    return ex
+
+
+def test_predictor_roundtrip(tmp_path):
+    net = _small_net()
+    ex = _bound(net)
+    x = np.random.rand(2, 5).astype(np.float32)
+    ref = ex.forward(data=x)[0].asnumpy()
+
+    prefix = str(tmp_path / "m")
+    args = {k: v for k, v in ex.arg_dict.items() if k != "data"}
+    path = deploy.export_predictor(prefix, net, args, ex.aux_dict,
+                                   {"data": (2, 5)})
+    assert os.path.exists(path)
+    assert os.path.exists(prefix + "-symbol.json")
+
+    p = deploy.Predictor(prefix)
+    p.forward(data=x)
+    np.testing.assert_allclose(p.get_output(0), ref, rtol=1e-5)
+    assert p.output_names == net.list_outputs()
+
+
+def test_predictor_with_batchnorm_aux(tmp_path):
+    data = sym.Variable("data")
+    net = sym.Convolution(data, kernel=(3, 3), num_filter=4, name="c1")
+    net = sym.BatchNorm(net, name="bn1")
+    net = sym.Pooling(net, kernel=(2, 2), pool_type="max", stride=(2, 2))
+    net = sym.Flatten(net)
+    net = sym.FullyConnected(net, num_hidden=2, name="fc")
+    ex = net.simple_bind(mx.cpu(), data=(2, 3, 8, 8))
+    for k, v in ex.arg_dict.items():
+        if k != "data":
+            v[:] = nd.random.uniform(shape=v.shape)
+    x = np.random.rand(2, 3, 8, 8).astype(np.float32)
+    ref = ex.forward(data=x)[0].asnumpy()
+
+    prefix = str(tmp_path / "bn")
+    args = {k: v for k, v in ex.arg_dict.items() if k != "data"}
+    deploy.export_predictor(prefix, net, args, ex.aux_dict,
+                            {"data": (2, 3, 8, 8)})
+    p = deploy.Predictor(prefix)
+    p.forward(data=x)
+    np.testing.assert_allclose(p.get_output(0), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_predictor_missing_param_errors(tmp_path):
+    net = _small_net()
+    with pytest.raises(ValueError, match="missing params"):
+        deploy.export_predictor(str(tmp_path / "x"), net, {}, {},
+                                {"data": (2, 5)})
+
+
+def test_onnx_graph_walk():
+    from incubator_mxnet_tpu.contrib.onnx.mx2onnx import graph_to_onnx_nodes
+
+    nodes = graph_to_onnx_nodes(_small_net())
+    assert [n[0] for n in nodes] == ["Gemm", "Relu", "Gemm", "Softmax"]
+    # Conv/pool/bn path
+    data = sym.Variable("data")
+    net = sym.Convolution(data, kernel=(3, 3), num_filter=4, pad=(1, 1))
+    net = sym.BatchNorm(net)
+    net = sym.Pooling(net, kernel=(2, 2), pool_type="avg")
+    nodes = graph_to_onnx_nodes(net)
+    ops = [n[0] for n in nodes]
+    assert ops == ["Conv", "BatchNormalization", "AveragePool"]
+    conv_attrs = nodes[0][3]
+    assert conv_attrs["kernel_shape"] == [3, 3]
+    assert conv_attrs["pads"] == [1, 1, 1, 1]
+
+
+def test_onnx_export_gated_without_onnx():
+    try:
+        import onnx  # noqa: F401
+        pytest.skip("onnx installed; gate not applicable")
+    except ImportError:
+        pass
+    with pytest.raises(ImportError, match="onnx package is required"):
+        mx.contrib.onnx.export_model(_small_net(), {}, (2, 5))
+    with pytest.raises(ImportError, match="onnx package is required"):
+        mx.contrib.onnx.import_model("nope.onnx")
+
+
+def test_onnx_unsupported_op_message():
+    from incubator_mxnet_tpu.contrib.onnx.mx2onnx import graph_to_onnx_nodes
+
+    data = sym.Variable("data")
+    net = sym.SwapAxis(data, dim1=0, dim2=1)
+    with pytest.raises(NotImplementedError, match="no translation"):
+        graph_to_onnx_nodes(net)
+
+
+def test_onnx_walk_reshape_embedding_softmaxoutput():
+    from incubator_mxnet_tpu.contrib.onnx.mx2onnx import graph_to_onnx_nodes
+
+    data = sym.Variable("data")
+    net = sym.Reshape(data, shape=(0, -1))
+    nodes = graph_to_onnx_nodes(net)
+    ot, ins, outs, attrs, name, consts = nodes[0]
+    assert ot == "Reshape" and len(ins) == 2
+    np.testing.assert_array_equal(consts[ins[1]], [0, -1])
+
+    emb = sym.Embedding(sym.Variable("idx"), input_dim=10, output_dim=4,
+                        name="emb")
+    nodes = graph_to_onnx_nodes(emb)
+    ot, ins, _, _, _, _ = nodes[0]
+    assert ot == "Gather"
+    assert "weight" in ins[0] and ins[1] == "idx"  # (table, indices) order
+
+    so = sym.SoftmaxOutput(sym.Variable("x"), sym.Variable("label"))
+    nodes = graph_to_onnx_nodes(so)
+    assert nodes[0][0] == "Softmax" and nodes[0][1] == ["x"]

@@ -195,3 +195,59 @@ def test_image_det_iter_seqless_rec(tmp_path):
     assert it.max_objects == 3
     b = next(iter(it))
     assert b.label[0].shape == (2, 3, 5)
+
+
+def test_py_random_access_fallback_reader(rec_file):
+    from incubator_mxnet_tpu.io_record import _PyRandomAccessRec
+
+    r = _PyRandomAccessRec(rec_file)
+    assert len(r) == 24
+    hdr, _ = recordio.unpack(r.read(5))
+    assert float(np.atleast_1d(hdr.label)[0]) == 5 % 4
+    # concurrent reads (thread-pool path) stay consistent
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(4) as pool:
+        out = list(pool.map(lambda i: recordio.unpack(r.read(i))[0], list(range(24))))
+    for i, h in enumerate(out):
+        assert float(np.atleast_1d(h.label)[0]) == i % 4
+    r.close()
+
+
+def test_image_record_iter_dtype(rec_file):
+    it = io.ImageRecordIter(path_imgrec=rec_file, data_shape=(3, 32, 32),
+                            batch_size=8, dtype="float16",
+                            preprocess_threads=1)
+    b = next(iter(it))
+    assert b.data[0].asnumpy().dtype == np.float16
+    assert it.provide_data[0].dtype == np.dtype("float16")
+    it.close()
+
+
+def test_image_det_iter_label_width_skips_scan(tmp_path):
+    lbl = np.array([0, .1, .1, .5, .5], np.float32)
+    fname = str(tmp_path / "im.jpg")
+    cv2.imwrite(fname, np.random.randint(0, 255, (40, 40, 3), np.uint8))
+    # label_width=15 -> 3 object slots without scanning the dataset
+    it = image.ImageDetIter(batch_size=1, data_shape=(3, 32, 32),
+                            imglist=[(lbl, fname)], path_root="",
+                            label_width=15)
+    assert it.max_objects == 3
+
+
+def test_image_record_iter_honors_imgidx_subset(rec_file, tmp_path):
+    # build an .idx listing only every other record
+    from incubator_mxnet_tpu.io_record import _PyRandomAccessRec
+
+    full = _PyRandomAccessRec(rec_file)
+    subset_idx = str(tmp_path / "subset.idx")
+    with open(subset_idx, "w") as f:
+        for k, (payload_off, _) in enumerate(full._offsets):
+            if k % 2 == 0:
+                f.write(f"{k}\t{payload_off - 8}\n")
+    full.close()
+    it = io.ImageRecordIter(path_imgrec=rec_file, path_imgidx=subset_idx,
+                            data_shape=(3, 32, 32), batch_size=4,
+                            preprocess_threads=1)
+    labels = np.concatenate([b.label[0].asnumpy() for b in it])
+    np.testing.assert_allclose(labels, (np.arange(12) * 2) % 4)
+    it.close()
