@@ -19,19 +19,34 @@ _LOCK = threading.Lock()
 _LIBS: dict = {}
 
 
-def _build(name: str, sources: list[str]) -> str | None:
-    out = os.path.join(_HERE, f"lib{name}.so")
+def _compile(out: str, sources: list[str], extra: list[str],
+             shared: bool) -> str | None:
+    """Shared compile-if-stale helper for .so libs and tool binaries."""
     srcs = [os.path.join(_SRC, s) for s in sources]
     if os.path.exists(out) and all(
         os.path.getmtime(out) >= os.path.getmtime(s) for s in srcs
     ):
         return out
-    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread", "-o", out] + srcs
+    cmd = ["g++", "-O2", "-std=c++17", "-pthread"]
+    if shared:
+        cmd += ["-shared", "-fPIC"]
+    cmd += ["-o", out] + srcs + extra
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        subprocess.run(cmd, check=True, capture_output=True, timeout=180)
         return out
     except (subprocess.CalledProcessError, subprocess.TimeoutExpired, FileNotFoundError):
         return None
+
+
+def _build(name: str, sources: list[str]) -> str | None:
+    return _compile(os.path.join(_HERE, f"lib{name}.so"), sources, [], True)
+
+
+def build_binary(name: str, sources: list[str], extra_flags=()) -> str | None:
+    """Build a tool binary (e.g. the im2rec packer) into the package dir;
+    returns its path or None when the toolchain is unavailable."""
+    return _compile(os.path.join(_HERE, name), sources, list(extra_flags),
+                    False)
 
 
 def load(name: str, sources: list[str]):

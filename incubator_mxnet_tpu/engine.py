@@ -42,3 +42,212 @@ def bulk(size):
         yield
     finally:
         set_bulk_size(prev)
+
+
+# ---------------------------------------------------------------------------
+# Host-side dependency engine (ref: src/engine/threaded_engine*.cc). Device
+# ordering belongs to XLA; this schedules HOST work — pipeline stages,
+# checkpoint IO, comm — with read/write-var semantics. Native C++ scheduler
+# (src/engine.cc) with a serial NaiveEngine fallback/debug mode, selected by
+# MXNET_ENGINE_TYPE exactly like the reference (ref: src/engine/engine.cc:32).
+# ---------------------------------------------------------------------------
+import ctypes as _ctypes
+import threading as _threading
+
+_TRAMPOLINE_T = _ctypes.CFUNCTYPE(None, _ctypes.c_int64)
+
+
+class Var:
+    """Engine variable token (ref: engine::Var)."""
+
+    __slots__ = ("_id", "_engine")
+
+    def __init__(self, vid, engine):
+        self._id = vid
+        self._engine = engine
+
+    @property
+    def version(self):
+        return self._engine._var_version(self._id)
+
+
+class ThreadedEngine:
+    """Async host scheduler over the native C++ engine
+    (ref: ThreadedEnginePerDevice). Ops are Python callables; read vars may
+    run concurrently, writes are exclusive, order is FIFO per var."""
+
+    def __init__(self, num_workers=None):
+        from . import _native
+
+        self._lib = _native.load("mxtpu_engine", ["engine.cc"])
+        if self._lib is None:
+            raise RuntimeError("native engine unavailable (g++ build failed)")
+        self._configure(self._lib)
+        self._ops = {}
+        self._op_lock = _threading.Lock()
+        self._next_op = [0]
+        self._exceptions = []
+
+        @_TRAMPOLINE_T
+        def tramp(op_id):
+            with self._op_lock:
+                fn, var_ids = self._ops.pop(op_id)
+            try:
+                fn()
+            except BaseException as e:  # surfaced at wait_* (ref:
+                with self._op_lock:  # threaded_engine.cc:474 rethrow)
+                    self._exceptions.append((e, var_ids))
+
+        self._tramp = tramp  # keep alive
+        if num_workers is None:
+            num_workers = int(os.environ.get("MXNET_CPU_WORKER_NTHREADS", "4"))
+        self._h = self._lib.eng_create(num_workers, tramp)
+
+    @staticmethod
+    def _configure(lib):
+        if getattr(lib, "_eng_configured", False):
+            return
+        lib.eng_create.restype = _ctypes.c_void_p
+        lib.eng_create.argtypes = [_ctypes.c_int, _TRAMPOLINE_T]
+        lib.eng_destroy.argtypes = [_ctypes.c_void_p]
+        lib.eng_new_var.restype = _ctypes.c_int64
+        lib.eng_new_var.argtypes = [_ctypes.c_void_p]
+        lib.eng_push.argtypes = [
+            _ctypes.c_void_p, _ctypes.c_int64,
+            _ctypes.POINTER(_ctypes.c_int64), _ctypes.c_int,
+            _ctypes.POINTER(_ctypes.c_int64), _ctypes.c_int,
+        ]
+        lib.eng_wait_for_var.argtypes = [_ctypes.c_void_p, _ctypes.c_int64]
+        lib.eng_wait_all.argtypes = [_ctypes.c_void_p]
+        lib.eng_var_version.restype = _ctypes.c_uint64
+        lib.eng_var_version.argtypes = [_ctypes.c_void_p, _ctypes.c_int64]
+        lib._eng_configured = True
+
+    def new_variable(self):
+        """(ref: Engine::NewVariable)"""
+        return Var(self._lib.eng_new_var(self._h), self)
+
+    def push(self, fn, read_vars=(), write_vars=()):
+        """Async-execute fn once all dependencies clear
+        (ref: Engine::PushAsync). Like the reference, read and write sets
+        must be disjoint — a var in both is treated as write-only (the
+        stronger dependency), and duplicates are dropped."""
+        wids, rids = [], []
+        for v in write_vars:
+            if v._id not in wids:
+                wids.append(v._id)
+        for v in read_vars:
+            if v._id not in wids and v._id not in rids:
+                rids.append(v._id)
+        with self._op_lock:
+            op_id = self._next_op[0]
+            self._next_op[0] += 1
+            self._ops[op_id] = (fn, frozenset(rids + wids))
+        r = (_ctypes.c_int64 * max(1, len(rids)))(*rids)
+        w = (_ctypes.c_int64 * max(1, len(wids)))(*wids)
+        self._lib.eng_push(self._h, op_id, r, len(rids), w, len(wids))
+
+    def wait_for_var(self, var):
+        """(ref: Engine::WaitForVar) — rethrows exceptions from ops that
+        touched this var (ref: threaded_engine.cc exception capture)."""
+        self._lib.eng_wait_for_var(self._h, var._id)
+        self._raise_pending(var._id)
+
+    def wait_all(self):
+        """(ref: Engine::WaitForAll) — rethrows any pending op exception."""
+        self._lib.eng_wait_all(self._h)
+        self._raise_pending(None)
+
+    def _raise_pending(self, var_id):
+        with self._op_lock:
+            for i, (exc, vids) in enumerate(self._exceptions):
+                if var_id is None or var_id in vids:
+                    del self._exceptions[i]
+                    raise exc
+
+    def _var_version(self, vid):
+        return int(self._lib.eng_var_version(self._h, vid))
+
+    def stop(self):
+        if getattr(self, "_h", None):
+            self._lib.eng_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class NaiveEngine:
+    """Serial debug engine (ref: src/engine/naive_engine.cc) — executes each
+    op synchronously at push; the bisect tool for ordering bugs."""
+
+    def __init__(self, num_workers=None):
+        self._versions = {}
+        self._next = [0]
+
+    def new_variable(self):
+        v = Var(self._next[0], self)
+        self._next[0] += 1
+        self._versions[v._id] = 0
+        return v
+
+    def push(self, fn, read_vars=(), write_vars=()):
+        fn()
+        for v in write_vars:
+            self._versions[v._id] += 1
+
+    def wait_for_var(self, var):
+        pass
+
+    def wait_all(self):
+        pass
+
+    def _var_version(self, vid):
+        return self._versions[vid]
+
+    def stop(self):
+        pass
+
+
+_DEFAULT_ENGINE = None
+_ENGINE_LOCK = _threading.Lock()
+
+
+def _drain_default_engine():
+    # drain + stop before interpreter finalization: worker threads must not
+    # be joined while a ctypes trampoline could still need the GIL
+    global _DEFAULT_ENGINE
+    eng = _DEFAULT_ENGINE
+    if isinstance(eng, ThreadedEngine):
+        try:
+            eng.wait_all()
+        except BaseException:
+            pass
+        eng.stop()
+    _DEFAULT_ENGINE = None
+
+
+def get_engine():
+    """Process-wide engine, type from MXNET_ENGINE_TYPE
+    (ref: engine.cc:32-46 CreateEngine)."""
+    global _DEFAULT_ENGINE
+    with _ENGINE_LOCK:
+        if _DEFAULT_ENGINE is None:
+            import atexit
+
+            kind = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+            if kind == "NaiveEngine":
+                _DEFAULT_ENGINE = NaiveEngine()
+            else:
+                try:
+                    _DEFAULT_ENGINE = ThreadedEngine()
+                    atexit.register(_drain_default_engine)
+                except RuntimeError:
+                    _DEFAULT_ENGINE = NaiveEngine()
+        return _DEFAULT_ENGINE
+
+
+__all__ += ["Var", "ThreadedEngine", "NaiveEngine", "get_engine"]

@@ -11,20 +11,72 @@ import collections
 from . import ndarray as nd
 from . import symbol as sym
 
-__all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint", "load_params"]
+__all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
+           "load_params", "wait_checkpoints"]
 
 BatchEndParam = collections.namedtuple(
     "BatchEndParams", ["epoch", "nbatch", "eval_metric", "locals"]
 )
 
 
-def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params, remove_amp_cast=True):
-    """(ref: model.py:394)"""
+_ckpt_vars = {}  # prefix -> engine var ordering async writes per prefix
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True, run_async=False):
+    """(ref: model.py:394). With run_async=True the file write is pushed
+    onto the host dependency engine (write-var per prefix keeps epochs in
+    order) so checkpointing overlaps the next training steps — the engine
+    doing for host IO what it does for comm in the reference."""
     if symbol is not None:
         symbol.save(f"{prefix}-symbol.json")
     save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
     save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
-    nd.save(f"{prefix}-{epoch:04d}.params", save_dict)
+
+    if not run_async:
+        nd.save(f"{prefix}-{epoch:04d}.params", save_dict)
+        return
+    import atexit
+
+    from . import engine as _engine
+
+    eng = _engine.get_engine()
+    if not _ckpt_vars:
+        # never lose an in-flight checkpoint at interpreter exit
+        atexit.register(wait_checkpoints)
+    if prefix not in _ckpt_vars:
+        _ckpt_vars[prefix] = eng.new_variable()
+    # snapshot to host now (device buffers may be donated/overwritten by the
+    # next step); the file write itself happens on an engine worker
+    host_dict = {k: v.asnumpy() if hasattr(v, "asnumpy") else v
+                 for k, v in save_dict.items()}
+    path = f"{prefix}-{epoch:04d}.params"
+
+    eng.push(lambda: nd.save(path, host_dict),
+             write_vars=[_ckpt_vars[prefix]])
+
+
+def wait_checkpoints(prefix=None):
+    """Block until async checkpoints finished (ref: Engine::WaitForVar).
+
+    With a prefix, waits only for that prefix's writes (no-op if it never
+    checkpointed asynchronously); otherwise waits for all of them.
+    """
+    from . import engine as _engine
+
+    eng = _engine.get_engine()
+    if prefix is not None:
+        if prefix in _ckpt_vars:
+            eng.wait_for_var(_ckpt_vars[prefix])
+        return
+    first_exc = None
+    for v in _ckpt_vars.values():
+        try:  # one failed prefix must not strand the others' writes
+            eng.wait_for_var(v)
+        except BaseException as e:
+            first_exc = first_exc or e
+    if first_exc is not None:
+        raise first_exc
 
 
 def load_params(prefix, epoch):

@@ -1,0 +1,181 @@
+// Native dataset packer: image list -> RecordIO shard.
+//
+// TPU-native analog of the reference's offline packer
+// (ref: tools/im2rec.cc — OpenCV decode/resize + recordio write). Same
+// record layout as the Python recordio module (kMagic framing + IRHeader),
+// so shards interop with both the Python and native readers. Multithreaded
+// decode with ordered write-back, like the reference's worker pool.
+//
+// Build (done by tools/im2rec.py --native, or by hand):
+//   g++ -O2 -std=c++17 -pthread src/im2rec.cc src/recordio.cc \
+//       -I/usr/include/opencv4 -lopencv_core -lopencv_imgcodecs \
+//       -lopencv_imgproc -o im2rec
+//
+// Usage: im2rec <list-file> <image-root> <out.rec> [resize] [quality]
+//   list-file lines: "<index>\t<label>\t<relative-path>"
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <opencv2/imgcodecs.hpp>
+#include <opencv2/imgproc.hpp>
+
+// recordio.cc writer C API
+extern "C" {
+void* rio_open_writer(const char* path);
+int64_t rio_write(void* handle, const uint8_t* data, uint32_t len);
+void rio_close_writer(void* handle);
+}
+
+namespace {
+
+#pragma pack(push, 1)
+struct IRHeader {  // matches recordio.py pack(): <IfQQ little-endian
+  uint32_t flag;
+  float label;
+  uint64_t id;
+  uint64_t id2;
+};
+#pragma pack(pop)
+
+struct Item {
+  size_t seq;
+  float label;
+  std::string path;
+};
+
+struct Packed {
+  size_t seq;
+  std::vector<uint8_t> bytes;  // IRHeader + jpeg
+  bool ok;
+};
+
+std::vector<uint8_t> PackOne(const Item& it, int resize, int quality) {
+  cv::Mat img = cv::imread(it.path, cv::IMREAD_COLOR);
+  if (img.empty()) return {};
+  if (resize > 0) {
+    // reference semantics: resize the SHORT edge to `resize`
+    double scale = resize / static_cast<double>(std::min(img.rows, img.cols));
+    cv::resize(img, img, cv::Size(), scale, scale,
+               scale < 1 ? cv::INTER_AREA : cv::INTER_LINEAR);
+  }
+  std::vector<uint8_t> jpg;
+  cv::imencode(".jpg", img, jpg, {cv::IMWRITE_JPEG_QUALITY, quality});
+  IRHeader hdr{0, it.label, it.seq, 0};
+  std::vector<uint8_t> out(sizeof(hdr) + jpg.size());
+  std::memcpy(out.data(), &hdr, sizeof(hdr));
+  std::memcpy(out.data() + sizeof(hdr), jpg.data(), jpg.size());
+  return out;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::fprintf(stderr,
+                 "usage: im2rec <list> <root> <out.rec> [resize] [quality]\n");
+    return 1;
+  }
+  const std::string list_path = argv[1], root = argv[2], out_path = argv[3];
+  const int resize = argc > 4 ? std::atoi(argv[4]) : 0;
+  const int quality = argc > 5 ? std::atoi(argv[5]) : 95;
+
+  std::vector<Item> items;
+  std::ifstream list(list_path);
+  std::string line;
+  while (std::getline(list, line)) {
+    if (line.empty()) continue;
+    // tab-separated "<index>\t<label>\t<path>" — the path may contain
+    // spaces, so split on tabs only (matches the Python packer)
+    size_t t1 = line.find('\t');
+    size_t t2 = t1 == std::string::npos ? t1 : line.find('\t', t1 + 1);
+    if (t2 == std::string::npos) continue;
+    size_t idx = std::strtoull(line.substr(0, t1).c_str(), nullptr, 10);
+    float label = std::strtof(line.substr(t1 + 1, t2 - t1 - 1).c_str(),
+                              nullptr);
+    std::string rel = line.substr(t2 + 1);
+    while (!rel.empty() && (rel.back() == '\r' || rel.back() == '\n'))
+      rel.pop_back();
+    if (rel.empty()) continue;
+    std::string path = rel[0] == '/' ? rel : root + "/" + rel;
+    items.push_back({idx, label, path});
+  }
+
+  void* writer = rio_open_writer(out_path.c_str());
+  if (!writer) {
+    std::fprintf(stderr, "cannot open %s\n", out_path.c_str());
+    return 1;
+  }
+
+  const int nthreads = std::max(1u, std::thread::hardware_concurrency());
+  std::mutex mu;
+  std::condition_variable cv_done;
+  std::vector<Packed> done(items.size());
+  std::vector<bool> ready(items.size(), false);
+  size_t next_in = 0, next_out = 0, failed = 0;
+
+  const size_t window = 4 * static_cast<size_t>(nthreads);
+  std::condition_variable cv_space;
+
+  auto worker = [&] {
+    for (;;) {
+      size_t i;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        // backpressure: bound decoded-but-unwritten buffers so packing a
+        // huge dataset to slow storage cannot grow memory unboundedly
+        cv_space.wait(lk, [&] {
+          return next_in >= items.size() || next_in - next_out < window;
+        });
+        if (next_in >= items.size()) return;
+        i = next_in++;
+      }
+      auto bytes = PackOne(items[i], resize, quality);
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        bool ok = !bytes.empty();
+        done[i] = {items[i].seq, std::move(bytes), ok};
+        ready[i] = true;
+        cv_done.notify_all();
+      }
+    }
+  };
+  std::vector<std::thread> pool;
+  for (int t = 0; t < nthreads; ++t) pool.emplace_back(worker);
+
+  {  // ordered write-back preserves list order in the shard
+    std::unique_lock<std::mutex> lk(mu);
+    while (next_out < items.size()) {
+      cv_done.wait(lk, [&] { return ready[next_out]; });
+      while (next_out < items.size() && ready[next_out]) {
+        Packed& p = done[next_out];
+        if (p.ok) {
+          lk.unlock();
+          rio_write(writer, p.bytes.data(),
+                    static_cast<uint32_t>(p.bytes.size()));
+          lk.lock();
+        } else {
+          ++failed;
+        }
+        p.bytes.clear();
+        p.bytes.shrink_to_fit();
+        ++next_out;
+        cv_space.notify_all();
+      }
+    }
+  }
+  for (auto& t : pool) t.join();
+  rio_close_writer(writer);
+  std::fprintf(stderr, "packed %zu records (%zu failed) -> %s\n",
+               items.size() - failed, failed, out_path.c_str());
+  return 0;
+}

@@ -1,0 +1,72 @@
+"""Tool tests: native im2rec packer (ref: tools/im2rec.cc + test pattern of
+tools/im2rec.py usage in example/image-classification)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+cv2 = pytest.importorskip("cv2")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_native_im2rec_packs_readable_shard(tmp_path):
+    from incubator_mxnet_tpu import io, recordio
+
+    for i in range(8):
+        cv2.imwrite(str(tmp_path / f"img{i}.jpg"),
+                    np.random.randint(0, 255, (50, 70, 3), np.uint8))
+    lst = tmp_path / "data.lst"
+    with open(lst, "w") as f:
+        for i in range(8):
+            f.write(f"{i}\t{i % 2}\timg{i}.jpg\n")
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "im2rec.py"),
+         str(tmp_path / "data"), str(tmp_path), "--native", "--resize", "32"],
+        capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, out.stderr
+    rec_path = str(tmp_path / "data.rec")
+    assert os.path.exists(rec_path)
+
+    r = recordio.MXRecordIO(rec_path, "r")
+    labels, n = [], 0
+    while True:
+        s = r.read()
+        if s is None:
+            break
+        hdr, _ = recordio.unpack(s)
+        img = recordio.unpack_img(s)[1]
+        assert min(img.shape[:2]) == 32  # short-edge resize
+        labels.append(float(hdr.label))
+        n += 1
+    assert n == 8 and labels == [i % 2 for i in range(8)]
+
+    it = io.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 28, 28),
+                            batch_size=4, preprocess_threads=2)
+    b = next(iter(it))
+    assert b.data[0].shape == (4, 3, 28, 28)
+    it.close()
+
+
+def test_native_im2rec_writes_idx(tmp_path):
+    from incubator_mxnet_tpu import recordio
+
+    for i in range(4):
+        cv2.imwrite(str(tmp_path / f"p{i}.jpg"),
+                    np.random.randint(0, 255, (40, 40, 3), np.uint8))
+    with open(tmp_path / "d.lst", "w") as f:
+        for i in range(4):
+            f.write(f"{i}\t{float(i)}\tp{i}.jpg\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "im2rec.py"),
+         str(tmp_path / "d"), str(tmp_path), "--native"],
+        capture_output=True, text=True, timeout=180, cwd=str(tmp_path))
+    assert out.returncode == 0, out.stderr
+    assert os.path.exists(tmp_path / "d.idx")
+    rec = recordio.MXIndexedRecordIO(str(tmp_path / "d.idx"),
+                                     str(tmp_path / "d.rec"), "r")
+    hdr, _ = recordio.unpack(rec.read_idx(2))
+    assert float(hdr.label) == 2.0

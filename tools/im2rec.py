@@ -28,7 +28,9 @@ def list_images(root, recursive=True):
             label_name = os.path.relpath(path, root)
             if label_name not in cat:
                 cat[label_name] = len(cat)
-            items.append((i, os.path.join(path, fname), cat[label_name]))
+            # store root-RELATIVE paths (reference .lst convention)
+            items.append((i, os.path.relpath(os.path.join(path, fname), root),
+                          cat[label_name]))
             i += 1
         if not recursive:
             break
@@ -50,6 +52,60 @@ def read_list(path):
     return items
 
 
+def _native_pack(args, items):
+    """Pack via the C++ im2rec binary (multithreaded decode + ordered
+    write-back, ref: tools/im2rec.cc)."""
+    import subprocess
+    import tempfile
+
+    from incubator_mxnet_tpu import _native
+
+    binary = _native.build_binary(
+        "im2rec", ["im2rec.cc", "recordio.cc"],
+        ["-I/usr/include/opencv4", "-lopencv_core", "-lopencv_imgcodecs",
+         "-lopencv_imgproc"])
+    if binary is None:
+        raise RuntimeError(
+            "--native requires the g++/OpenCV toolchain; rerun without "
+            "--native to use the Python packer")
+    n = len(items)
+    per = (n + args.num_parts - 1) // args.num_parts
+    for part in range(args.num_parts):
+        suffix = f".part{part}" if args.num_parts > 1 else ""
+        chunk = items[part * per:(part + 1) * per]
+        with tempfile.NamedTemporaryFile("w", suffix=".lst", delete=False) as f:
+            for idx, fname, label in chunk:
+                # .lst paths are root-relative (reference convention)
+                full = fname if os.path.isabs(fname) \
+                    else os.path.join(args.root, fname)
+                f.write(f"{idx}\t{label}\t{os.path.abspath(full)}\n")
+            tmp = f.name
+        rec_path = args.prefix + suffix + ".rec"
+        subprocess.run([binary, tmp, "/", rec_path,
+                        str(args.resize), str(args.quality)], check=True)
+        os.unlink(tmp)
+        _write_idx(rec_path, args.prefix + suffix + ".idx")
+        print(f"wrote {args.prefix + suffix}.rec (native)")
+
+
+def _write_idx(rec_path, idx_path):
+    """Companion .idx (key\\toffset) so indexed readers work on native
+    shards too (ref: tools/rec2idx.py). Header-only preads — never touches
+    the image payloads."""
+    import struct
+
+    from incubator_mxnet_tpu.io_record import _PyRandomAccessRec
+
+    r = _PyRandomAccessRec(rec_path)
+    with open(idx_path, "w") as f:
+        for payload_off, _ in r._offsets:
+            # IRHeader <IfQQ: flag, label, id, id2 — 24 bytes at payload
+            head = os.pread(r._fd, 24, payload_off)
+            _flag, _label, rec_id, _id2 = struct.unpack("<IfQQ", head)
+            f.write(f"{rec_id}\t{payload_off - 8}\n")
+    r.close()
+
+
 def main():
     import cv2
 
@@ -61,6 +117,9 @@ def main():
     p.add_argument("--quality", type=int, default=95)
     p.add_argument("--shuffle", type=int, default=1)
     p.add_argument("--num-parts", type=int, default=1)
+    p.add_argument("--native", action="store_true",
+                   help="pack with the multithreaded C++ engine "
+                        "(src/im2rec.cc; builds on first use)")
     args = p.parse_args()
 
     lst = args.prefix + ".lst"
@@ -74,6 +133,10 @@ def main():
             return
     items = read_list(lst)
 
+    if args.native:
+        _native_pack(args, items)
+        return
+
     n = len(items)
     per = (n + args.num_parts - 1) // args.num_parts
     for part in range(args.num_parts):
@@ -81,6 +144,8 @@ def main():
         rec = recordio.MXIndexedRecordIO(args.prefix + suffix + ".idx",
                                          args.prefix + suffix + ".rec", "w")
         for idx, fname, label in items[part * per : (part + 1) * per]:
+            if not os.path.isabs(fname):
+                fname = os.path.join(args.root, fname)
             img = cv2.imread(fname)
             if img is None:
                 continue
