@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Train SSD on synthetic colored-square detection data
+(ref: example/ssd/train.py — same Module-based flow, synthetic stand-in for
+VOC in this zero-egress environment).
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def synth_batch(rng, batch_size, size=64, num_classes=3, max_obj=2):
+    """Images with colored squares; label rows [cls, x1, y1, x2, y2]."""
+    x = rng.rand(batch_size, 3, size, size).astype(np.float32) * 0.1
+    labels = -np.ones((batch_size, max_obj, 5), np.float32)
+    for b in range(batch_size):
+        for o in range(rng.randint(1, max_obj + 1)):
+            cls = rng.randint(num_classes)
+            w = rng.uniform(0.25, 0.5)
+            cx, cy = rng.uniform(w / 2, 1 - w / 2, 2)
+            x1, y1, x2, y2 = cx - w / 2, cy - w / 2, cx + w / 2, cy + w / 2
+            xi = slice(int(x1 * size), max(int(x2 * size), int(x1 * size) + 1))
+            yi = slice(int(y1 * size), max(int(y2 * size), int(y1 * size) + 1))
+            x[b, cls, yi, xi] = 1.0
+            labels[b, o] = [cls, x1, y1, x2, y2]
+    return x, labels
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--num-steps", type=int, default=60)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--ctx", default="tpu", choices=["cpu", "tpu"])
+    args = p.parse_args()
+    if args.ctx == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import models, nd
+
+    logging.basicConfig(level=logging.INFO)
+    num_classes = 3
+    net = models.ssd.get_symbol_train(num_classes=num_classes, base_filters=16)
+    ex = net.simple_bind(
+        mx.cpu() if args.ctx == "cpu" else mx.tpu(),
+        data=(args.batch_size, 3, 64, 64), label=(args.batch_size, 2, 5))
+    rng = np.random.RandomState(0)
+    mx.random.seed(0)
+    init = mx.init.Xavier()
+    for k, v in ex.arg_dict.items():
+        if k not in ("data", "label"):
+            init(mx.init.InitDesc(k), v)
+
+    opt = mx.optimizer.SGD(learning_rate=args.lr, momentum=0.9, wd=5e-4)
+    updater = mx.optimizer.get_updater(opt)
+
+    for step in range(args.num_steps):
+        x, lab = synth_batch(rng, args.batch_size, num_classes=num_classes)
+        outs = ex.forward(is_train=True, data=x, label=lab)
+        ex.backward()
+        for i, (k, g) in enumerate(ex.grad_dict.items()):
+            if k in ("data", "label") or g is None:
+                continue
+            updater(i, g, ex.arg_dict[k])
+        if step % 10 == 0:
+            cls_prob, _, cls_target = outs[0].asnumpy(), outs[1], outs[2].asnumpy()
+            valid = cls_target >= 0
+            pred = cls_prob.argmax(axis=1)
+            acc = float((pred[valid] == cls_target[valid]).mean())
+            logging.info("step %d cls-acc %.3f", step, acc)
+
+    # quick detection sanity on a fresh batch
+    x, lab = synth_batch(rng, args.batch_size, num_classes=num_classes)
+    outs = ex.forward(is_train=True, data=x, label=lab)
+    det = outs[3].asnumpy()
+    kept = det[det[..., 0] >= 0]
+    logging.info("detections kept: %d (score max %.3f)",
+                 len(kept), float(kept[:, 1].max()) if len(kept) else -1)
+
+
+if __name__ == "__main__":
+    main()

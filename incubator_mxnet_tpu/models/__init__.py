@@ -7,3 +7,4 @@ from . import lenet, mlp, resnet, alexnet  # noqa: F401
 from .lenet import get_symbol as get_lenet  # noqa: F401
 from .mlp import get_symbol as get_mlp  # noqa: F401
 from .resnet import get_symbol as get_resnet  # noqa: F401
+from . import ssd  # noqa: F401

@@ -1,0 +1,128 @@
+"""SSD single-shot detector symbols
+(ref: example/ssd/symbol/symbol_builder.py get_symbol_train/get_symbol +
+example/ssd/symbol/common.py multi_layer_feature/multibox_layer).
+
+TPU-first notes: every stage is fixed-shape (anchors, targets, NMS all
+mask-based — see ops/vision.py), so train and detect symbols jit into
+single XLA programs; the whole multi-scale head concat is one fused graph.
+"""
+from __future__ import annotations
+
+from .. import symbol as sym
+
+__all__ = ["get_symbol_train", "get_symbol", "default_spec"]
+
+
+def default_spec():
+    """Per-scale anchor spec: (sizes, ratios) per feature stride."""
+    return {
+        "sizes": [(0.2, 0.27), (0.37, 0.45), (0.54, 0.62)],
+        "ratios": [(1, 2, 0.5)] * 3,
+    }
+
+
+def _conv_act(data, name, num_filter, kernel=(3, 3), pad=(1, 1), stride=(1, 1)):
+    c = sym.Convolution(data, kernel=kernel, pad=pad, stride=stride,
+                        num_filter=num_filter, name=name)
+    bn = sym.BatchNorm(c, name=name + "_bn")
+    return sym.Activation(bn, act_type="relu")
+
+
+def _body(data, base_filters=32):
+    """Small VGG-ish backbone emitting 3 feature scales (strides 8/16/32)
+    (ref: example/ssd/symbol/vgg16_reduced.py role)."""
+    x = _conv_act(data, "c1", base_filters)
+    x = sym.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    x = _conv_act(x, "c2", base_filters * 2)
+    x = sym.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    x = _conv_act(x, "c3", base_filters * 4)
+    f1 = _conv_act(x, "c3b", base_filters * 4)            # stride 4... pool next
+    x = sym.Pooling(f1, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    f2 = _conv_act(x, "c4", base_filters * 8)             # stride 8
+    x = sym.Pooling(f2, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    f3 = _conv_act(x, "c5", base_filters * 8)             # stride 16
+    return [f2, f3, sym.Pooling(f3, kernel=(2, 2), stride=(2, 2),
+                                pool_type="max")]
+
+
+def _multibox_layer(features, num_classes, sizes, ratios, clip=False):
+    """Per-scale loc/cls heads + anchors, concatenated over scales
+    (ref: example/ssd/symbol/common.py multibox_layer)."""
+    loc_layers, cls_layers, anchor_layers = [], [], []
+    num_classes_b = num_classes + 1  # + background
+    for k, feat in enumerate(features):
+        n_anchor = len(sizes[k]) + len(ratios[k]) - 1
+        loc = sym.Convolution(feat, kernel=(3, 3), pad=(1, 1),
+                              num_filter=n_anchor * 4,
+                              name=f"loc_pred_{k}")
+        # (B, A*4, H, W) -> (B, H, W, A*4) -> (B, -1)
+        loc = sym.transpose(loc, axes=(0, 2, 3, 1))
+        loc_layers.append(sym.Flatten(loc))
+
+        cls = sym.Convolution(feat, kernel=(3, 3), pad=(1, 1),
+                              num_filter=n_anchor * num_classes_b,
+                              name=f"cls_pred_{k}")
+        cls = sym.transpose(cls, axes=(0, 2, 3, 1))
+        cls_layers.append(sym.Flatten(cls))
+
+        anchor_layers.append(sym.contrib.MultiBoxPrior(
+            feat, sizes=tuple(sizes[k]), ratios=tuple(ratios[k]), clip=clip,
+            name=f"anchors_{k}"))
+
+    loc_preds = sym.Concat(*loc_layers, dim=1, name="multibox_loc_pred")
+    cls_concat = sym.Concat(*cls_layers, dim=1)
+    # (B, sum_k H_k*W_k*A_k*C) -> (B, N, C) -> (B, C, N)
+    cls_preds = sym.Reshape(cls_concat, shape=(0, -1, num_classes_b))
+    cls_preds = sym.transpose(cls_preds, axes=(0, 2, 1))
+    anchors = sym.Concat(*anchor_layers, dim=1, name="multibox_anchors")
+    return loc_preds, cls_preds, anchors
+
+
+def get_symbol_train(num_classes=20, nms_thresh=0.5, force_suppress=False,
+                     nms_topk=400, base_filters=32, spec=None, **kwargs):
+    """Training symbol: outputs [cls_prob, loc_loss, cls_label, det]
+    (ref: symbol_builder.py get_symbol_train)."""
+    spec = spec or default_spec()
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    features = _body(data, base_filters)
+    loc_preds, cls_preds, anchors = _multibox_layer(
+        features, num_classes, spec["sizes"], spec["ratios"], clip=False)
+
+    loc_target, loc_target_mask, cls_target = sym.contrib.MultiBoxTarget(
+        anchors, label, cls_preds, overlap_threshold=0.5, ignore_label=-1,
+        negative_mining_ratio=3, minimum_negative_samples=0,
+        variances=(0.1, 0.1, 0.2, 0.2), name="multibox_target")
+
+    cls_prob = sym.SoftmaxOutput(cls_preds, cls_target, ignore_label=-1,
+                                 use_ignore=True, multi_output=True,
+                                 normalization="valid", name="cls_prob")
+    loc_diff = loc_preds - loc_target
+    masked_loc_diff = loc_target_mask * loc_diff
+    loc_loss_ = sym.smooth_l1(masked_loc_diff, scalar=1.0,
+                              name="loc_loss_")
+    loc_loss = sym.MakeLoss(loc_loss_, name="loc_loss")
+
+    cls_label = sym.BlockGrad(cls_target, name="cls_label")
+    det = sym.contrib.MultiBoxDetection(
+        cls_prob, loc_preds, anchors, nms_threshold=nms_thresh,
+        force_suppress=force_suppress, variances=(0.1, 0.1, 0.2, 0.2),
+        nms_topk=nms_topk)
+    det = sym.BlockGrad(det, name="det_out")
+    return sym.Group([cls_prob, loc_loss, cls_label, det])
+
+
+def get_symbol(num_classes=20, nms_thresh=0.5, force_suppress=False,
+               nms_topk=400, base_filters=32, spec=None, **kwargs):
+    """Inference symbol -> (B, N, 6) detections
+    (ref: symbol_builder.py get_symbol)."""
+    spec = spec or default_spec()
+    data = sym.Variable("data")
+    features = _body(data, base_filters)
+    loc_preds, cls_preds, anchors = _multibox_layer(
+        features, num_classes, spec["sizes"], spec["ratios"], clip=False)
+    cls_prob = sym.SoftmaxActivation(cls_preds, mode="channel")
+    return sym.contrib.MultiBoxDetection(
+        cls_prob, loc_preds, anchors, nms_threshold=nms_thresh,
+        force_suppress=force_suppress, variances=(0.1, 0.1, 0.2, 0.2),
+        nms_topk=nms_topk, name="detection")

@@ -1,0 +1,93 @@
+"""SSD detection-stack tests (ref: example/ssd/ + the train-to-threshold
+pattern of tests/python/train/)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import models, nd
+
+
+def _init(ex, seed=0):
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    init = mx.init.Xavier()
+    for k, v in ex.arg_dict.items():
+        if k not in ("data", "label"):
+            init(mx.init.InitDesc(k), v)
+
+
+def test_ssd_train_symbol_shapes():
+    net = models.ssd.get_symbol_train(num_classes=3, base_filters=8)
+    ex = net.simple_bind(mx.cpu(), data=(2, 3, 64, 64), label=(2, 4, 5))
+    _init(ex)
+    x = np.random.rand(2, 3, 64, 64).astype(np.float32)
+    lab = -np.ones((2, 4, 5), np.float32)
+    lab[:, 0] = [1, 0.2, 0.2, 0.7, 0.7]
+    cls_prob, loc_loss, cls_target, det = ex.forward(
+        is_train=True, data=x, label=lab)
+    n_anchors = cls_prob.shape[2]
+    assert cls_prob.shape == (2, 4, n_anchors)      # classes + background
+    assert loc_loss.shape == (2, 4 * n_anchors)
+    assert cls_target.shape == (2, n_anchors)
+    assert det.shape == (2, n_anchors, 6)
+    # the forced bipartite match yields at least one positive per image
+    assert (cls_target.asnumpy() == 2.0).sum() >= 2
+
+
+def test_ssd_gradients_flow_to_matched_scale():
+    net = models.ssd.get_symbol_train(num_classes=3, base_filters=8)
+    ex = net.simple_bind(mx.cpu(), data=(2, 3, 64, 64), label=(2, 4, 5))
+    _init(ex)
+    x = np.random.rand(2, 3, 64, 64).astype(np.float32)
+    lab = -np.ones((2, 4, 5), np.float32)
+    lab[:, 0] = [1, 0.2, 0.2, 0.7, 0.7]  # large box -> coarse scale anchors
+    ex.forward(is_train=True, data=x, label=lab)
+    ex.backward()
+    loc_gmax = max(float(np.abs(ex.grad_dict[f"loc_pred_{k}_weight"]
+                                .asnumpy()).max()) for k in range(3))
+    cls_gmax = max(float(np.abs(ex.grad_dict[f"cls_pred_{k}_weight"]
+                                .asnumpy()).max()) for k in range(3))
+    assert loc_gmax > 0 and cls_gmax > 0
+    assert float(np.abs(ex.grad_dict["c1_weight"].asnumpy()).max()) > 0
+
+
+def test_ssd_training_improves_cls_accuracy():
+    from examples.train_ssd import synth_batch
+
+    net = models.ssd.get_symbol_train(num_classes=3, base_filters=8)
+    ex = net.simple_bind(mx.cpu(), data=(8, 3, 64, 64), label=(8, 2, 5))
+    _init(ex)
+    rng = np.random.RandomState(0)
+    opt = mx.optimizer.SGD(learning_rate=0.01, momentum=0.9)
+    updater = mx.optimizer.get_updater(opt)
+
+    def acc_of(outs):
+        cls_prob, cls_target = outs[0].asnumpy(), outs[2].asnumpy()
+        valid = cls_target >= 0
+        return float((cls_prob.argmax(1)[valid] == cls_target[valid]).mean())
+
+    first = None
+    for step in range(25):
+        x, lab = synth_batch(rng, 8)
+        outs = ex.forward(is_train=True, data=x, label=lab)
+        if first is None:
+            first = acc_of(outs)
+        ex.backward()
+        for i, (k, g) in enumerate(ex.grad_dict.items()):
+            if k in ("data", "label") or g is None:
+                continue
+            updater(i, g, ex.arg_dict[k])
+    last = acc_of(outs)
+    assert last > first + 0.2, (first, last)
+
+
+def test_ssd_inference_symbol():
+    net = models.ssd.get_symbol(num_classes=3, base_filters=8)
+    ex = net.simple_bind(mx.cpu(), data=(1, 3, 64, 64))
+    _init(ex)
+    out = ex.forward(data=np.random.rand(1, 3, 64, 64).astype(np.float32))[0]
+    d = out.asnumpy()
+    assert d.shape[-1] == 6
+    kept = d[d[..., 0] >= 0]
+    if len(kept):
+        assert (kept[:, 1] >= 0).all() and (kept[:, 1] <= 1).all()
