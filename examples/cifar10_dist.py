@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Distributed data-parallel CIFAR-style training
+(ref: example/distributed_training/cifar10_dist.py).
+
+Launch:  python tools/launch.py -n 2 --launcher local -- \\
+             python examples/cifar10_dist.py --ctx cpu
+Each process takes its shard (part_index/num_parts), gradients allreduce
+over kvstore='dist_sync' (DCN/ICI collectives instead of ps-lite).
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def synth_cifar(rng, n=2048, num_classes=10):
+    proto = rng.rand(num_classes, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, num_classes, n)
+    x = proto[y] + 0.15 * rng.randn(n, 3, 32, 32).astype(np.float32)
+    return x, y.astype(np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--num-epochs", type=int, default=3)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--ctx", default="tpu", choices=["cpu", "tpu"])
+    args = p.parse_args()
+    if args.ctx == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import kvstore, models
+
+    logging.basicConfig(level=logging.INFO)
+    kv = kvstore.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    logging.info("worker %d/%d", rank, nw)
+
+    rng = np.random.RandomState(0)  # same dataset everywhere, sharded below
+    X, y = synth_cifar(rng)
+    per = len(X) // nw
+    Xs, ys = X[rank * per:(rank + 1) * per], y[rank * per:(rank + 1) * per]
+
+    net = models.get_resnet(num_classes=10, num_layers=20,
+                            image_shape="3,32,32")
+    mod = mx.module.Module(net, context=mx.cpu() if args.ctx == "cpu" else mx.tpu())
+    train = mx.io.NDArrayIter(Xs, ys, args.batch_size, shuffle=True)
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            initializer=mx.init.Xavier(), num_epoch=args.num_epochs,
+            kvstore=kv,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 10))
+    val = mx.io.NDArrayIter(Xs, ys, args.batch_size)
+    logging.info("rank %d final %s", rank, mod.score(val, "acc"))
+
+
+if __name__ == "__main__":
+    main()

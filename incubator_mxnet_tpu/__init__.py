@@ -59,3 +59,4 @@ from . import contrib  # noqa: F401
 from . import fused  # noqa: F401
 from . import rtc  # noqa: F401
 from . import deploy  # noqa: F401
+from . import distributed  # noqa: F401

@@ -57,14 +57,28 @@ class Context:
 
     # -- JAX resolution ---------------------------------------------------
     def jax_device(self):
-        """Resolve to the backing jax.Device."""
+        """Resolve to the backing jax.Device.
+
+        Always a process-LOCAL device: under jax.distributed, jax.devices()
+        includes other processes' (non-addressable) devices, and a Context
+        must never place data there (the reference's Context is likewise
+        process-local; cross-process movement is the kvstore's job).
+        """
         dt = self.device_type
         if dt in ("cpu", "cpu_pinned"):
-            devs = jax.devices("cpu") if _accel_platform() != "cpu" else jax.devices()
+            try:  # CPU backend devices even when an accelerator is default
+                devs = jax.local_devices(backend="cpu")
+            except RuntimeError:
+                devs = jax.local_devices()
             return devs[min(self.device_id, len(devs) - 1)]
         # gpu and tpu both map onto the available accelerator
         plat = _accel_platform()
-        devs = jax.devices(plat) if plat != "cpu" else jax.devices()
+        try:
+            devs = jax.local_devices(backend=plat) if plat != "cpu" \
+                else jax.local_devices()
+        except RuntimeError:
+            devs = [d for d in jax.local_devices() if d.platform == plat] \
+                or jax.local_devices()
         if self.device_id >= len(devs):
             raise ValueError(
                 f"device_id {self.device_id} out of range: {len(devs)} {plat} device(s)"

@@ -4,3 +4,4 @@ from .control_flow import foreach, while_loop, cond  # noqa: F401
 from . import amp  # noqa: F401
 from . import quantization  # noqa: F401
 from . import onnx  # noqa: F401
+from . import torch_bridge  # noqa: F401

@@ -1,0 +1,110 @@
+"""Torch op bridge (ref: plugin/torch/ — TorchModule/TorchCriterion ops that
+run Torch layers inside the graph).
+
+TPU-native stance: torch (CPU) runs host-side behind `jax.pure_callback`,
+exactly like Python CustomOps (ref: src/operator/custom/ runs user Python on
+a dedicated thread pool so the engine never blocks). Gradients come from
+torch.autograd inside the callback, spliced into the JAX VJP — so a bridged
+layer is differentiable end-to-end inside `autograd.record()` and usable
+under jit (the callback is a host excursion XLA schedules around).
+"""
+from __future__ import annotations
+
+
+import numpy as np
+
+__all__ = ["TorchModule", "torch_function"]
+
+
+def _require_torch():
+    try:
+        import torch  # noqa: F401
+
+        return torch
+    except ImportError as e:  # pragma: no cover - torch is baked in here
+        raise ImportError("contrib.torch_bridge requires torch") from e
+
+
+class TorchModule:
+    """Wrap a `torch.nn.Module` as a differentiable eager op
+    (ref: plugin/torch/torch_module-inl.h TorchModuleOp).
+
+    Torch parameters stay owned by torch; their gradients accumulate into
+    `.grad` as usual so a torch optimizer can drive them, while gradients
+    w.r.t. the (JAX) inputs flow back onto the tape.
+    """
+
+    def __init__(self, module):
+        self._torch = _require_torch()
+        self.module = module
+        self._bridged_cache = {}  # input signature -> custom_vjp fn
+
+    def _build_bridged(self, sig):
+        import jax
+        import jax.numpy as jnp
+
+        torch = self._torch
+        # probe the output spec ONCE per input signature (shapes, dtypes)
+        with torch.no_grad():
+            probe = self.module(*[torch.from_numpy(np.zeros(s, np.float32))
+                                  for s, _ in sig])
+        out_spec = jax.ShapeDtypeStruct(tuple(probe.shape), jnp.float32)
+
+        def host_forward(*arrs):
+            tins = [torch.from_numpy(np.array(a, np.float32))
+                    for a in arrs]
+            with torch.no_grad():
+                return np.asarray(self.module(*tins).detach().numpy())
+
+        def host_backward(g, *arrs):
+            tins = [torch.from_numpy(np.array(a, np.float32))
+                    .requires_grad_(True) for a in arrs]
+            out = self.module(*tins)
+            out.backward(torch.from_numpy(np.array(g, np.float32)))
+            return tuple(
+                np.asarray(t.grad.numpy()) if t.grad is not None
+                else np.zeros(t.shape, np.float32)  # input unused by module
+                for t in tins)
+
+        @jax.custom_vjp
+        def bridged(*arrs):
+            return jax.pure_callback(host_forward, out_spec, *arrs)
+
+        def fwd(*arrs):
+            return bridged(*arrs), arrs
+
+        def bwd(res, g):
+            specs = tuple(jax.ShapeDtypeStruct(a.shape, jnp.float32)
+                          for a in res)
+            return jax.pure_callback(host_backward, specs, g, *res)
+
+        bridged.defvjp(fwd, bwd)
+        return bridged
+
+    def __call__(self, *inputs):
+        import jax.numpy as jnp
+
+        from .. import autograd
+        from ..ndarray.ndarray import NDArray
+
+        datas = [x._data if isinstance(x, NDArray) else jnp.asarray(x)
+                 for x in inputs]
+        sig = tuple((tuple(d.shape), str(d.dtype)) for d in datas)
+        bridged = self._bridged_cache.get(sig)
+        if bridged is None:
+            bridged = self._bridged_cache[sig] = self._build_bridged(sig)
+        outs = autograd.invoke_recorded(lambda *a: bridged(*a), list(inputs))
+        return outs[0]
+
+
+def torch_function(fn):
+    """Decorator form for stateless torch functions:
+    `f = torch_function(torch.special.erf); y = f(x)`."""
+    class _Fn:
+        def __call__(self, *tins):
+            return fn(*tins)
+
+        def parameters(self):
+            return []
+
+    return TorchModule(_Fn())

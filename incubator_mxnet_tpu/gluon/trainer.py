@@ -52,9 +52,9 @@ class Trainer:
         if self._kv_initialized:
             return
         if isinstance(self._kvstore_str, str) and "dist" in self._kvstore_str:
+            # allreduce mode: the store is a transient merge buffer, never
+            # seeded with weights (optimizer runs locally on every worker)
             self._kvstore = kvs.create(self._kvstore_str)
-            for i, p in enumerate(self._params):
-                self._kvstore.init(i, p.data())
         self._kv_initialized = True
 
     def allreduce_grads(self):
@@ -64,8 +64,8 @@ class Trainer:
         if self._kvstore is not None:
             for i, p in enumerate(self._params):
                 g = p.grad()
-                self._kvstore.push(i, g)
-                self._kvstore.pull(i, out=g)
+                # merge-and-reset one-shot allreduce (no cross-step carry)
+                self._kvstore.pushpull(i, g, out=g)
 
     def step(self, batch_size, ignore_stale_grad=False):
         """(ref: trainer.py:298)"""

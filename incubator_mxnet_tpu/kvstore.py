@@ -194,11 +194,19 @@ class KVStoreDist(KVStore):
                 self.push(k, v, priority)
             return
         grad = self._reduce(value)
+        if self._compression is not None and self._compression.get("type") == "2bit":
+            # compress-on-the-wire semantics: quantize the local contribution
+            # before it crosses DCN (ref: DataHandleCompressed)
+            grad = _two_bit_roundtrip(
+                grad, float(self._compression.get("threshold", 0.5)))
         if self.num_workers > 1:
+            import numpy as _np
             from jax.experimental import multihost_utils
 
-            grad = multihost_utils.process_allgather(grad)
-            grad = jnp.sum(grad, axis=0)
+            # host-side hop: the local grad may be committed to one local
+            # device; allgather wants process-replicated input
+            grad = multihost_utils.process_allgather(_np.asarray(grad))
+            grad = jnp.sum(jnp.asarray(grad), axis=0)
         if self._updater is not None:
             self._updater(_key_int(key), NDArray._from_data(grad), self._store[key])
         else:
@@ -230,6 +238,9 @@ def create(name="local"):
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     if "dist" in name:
+        from . import distributed
+
+        distributed.init_from_env()  # launcher env -> jax.distributed
         return KVStoreDist(name)
     return KVStore(name)
 

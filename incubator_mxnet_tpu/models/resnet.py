@@ -82,7 +82,7 @@ def get_symbol(num_classes, num_layers, image_shape, conv_workspace=256,
     if isinstance(image_shape, str):
         image_shape = [int(x) for x in image_shape.split(",")]
     nchannel, height, width = image_shape
-    if height <= 28:
+    if height <= 32:  # cifar-style small images (ref: symbols/resnet.py)
         num_stages = 3
         if (num_layers - 2) % 9 == 0 and num_layers >= 164:
             per_unit = [(num_layers - 2) // 9]

@@ -228,9 +228,11 @@ class Module(BaseModule):
         )
         self._kvstore = kvstore_obj
         self._update_on_kvstore = update_on_kvstore
-        if kvstore_obj is not None:
-            if update_on_kvstore:
-                kvstore_obj.set_optimizer(self._optimizer)
+        if kvstore_obj is not None and update_on_kvstore:
+            # the store holds the authoritative weights only when the
+            # optimizer runs inside it (ref: kvstore_dist_server's updater);
+            # in allreduce mode the store is a transient merge buffer
+            kvstore_obj.set_optimizer(self._optimizer)
             for i, name in enumerate(self._param_names):
                 kvstore_obj.init(name, self._arg_params[name])
         if not update_on_kvstore or kvstore_obj is None:
@@ -286,8 +288,9 @@ class Module(BaseModule):
                     g = self._exec.grad_dict.get(name)
                     if g is None:
                         continue
-                    self._kvstore.push(name, g)
-                    self._kvstore.pull(name, out=g)
+                    # one-shot allreduce: merge-and-reset, NOT accumulate
+                    # (the store must not carry grads across steps)
+                    self._kvstore.pushpull(name, g, out=g)
             for i, name in enumerate(self._param_names):
                 w = self._exec.arg_dict[name]
                 g = self._exec.grad_dict.get(name)

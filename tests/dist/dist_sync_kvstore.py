@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Multi-process dist-kvstore invariants, run under tools/launch.py
+(ref: tests/nightly/dist_sync_kvstore.py:30-60 — the reference's
+multi-process-single-host harness driving the real comm stack).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import kvstore, nd
+
+
+def main():
+    kv = kvstore.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    assert nw == int(os.environ["MXTPU_NUM_PROCESSES"]), (nw, os.environ)
+
+    shape = (3, 4)
+    keys = ["w0", "w1"]
+
+    # --- plain allreduce-sum semantics (ref: test sync push/pull) ---
+    kv.init(keys, [nd.zeros(shape) for _ in keys])
+    for step in range(3):
+        vals = [nd.ones(shape) * (rank + 1) * (k + 1) for k in range(len(keys))]
+        kv.push(keys, vals)
+        outs = [nd.zeros(shape) for _ in keys]
+        kv.pull(keys, out=outs)
+        expect_rank_sum = nw * (nw + 1) / 2  # sum over ranks of (rank+1)
+        for k, o in enumerate(outs):
+            expect = (step + 1) * (k + 1) * expect_rank_sum
+            np.testing.assert_allclose(o.asnumpy(), expect, rtol=1e-6), (
+                rank, step, k)
+    kv.barrier()
+
+    # --- updater path: optimizer applied identically on all workers ---
+    kv2 = kvstore.create("dist_sync")
+    kv2.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    kv2.init("x", nd.ones(shape))
+    kv2.push("x", nd.ones(shape) * (rank + 1))
+    out = nd.zeros(shape)
+    kv2.pull("x", out=out)
+    expect = 1.0 - 0.1 * (nw * (nw + 1) / 2)
+    np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-5)
+    kv2.barrier()
+
+    # --- every worker converged to the same weights ---
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(out._data)
+    for r in range(nw):
+        np.testing.assert_allclose(np.asarray(gathered[r]), expect, rtol=1e-5)
+
+    print(f"rank {rank}/{nw}: dist_sync_kvstore OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Multi-process distributed tests driven through the local launcher
+(ref: ci/docker/runtime_functions.sh:1052-1057 —
+`tools/launch.py -n W --launcher local python dist_sync_kvstore.py`)."""
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _launch(script, n):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # scripts force cpu themselves
+    env.pop("XLA_FLAGS", None)  # no virtual-device override across processes
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", str(n), "--launcher", "local",
+         "--coordinator", f"127.0.0.1:{_free_port()}",
+         "--", sys.executable, os.path.join(REPO, "tests", "dist", script)],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert out.returncode == 0, f"stdout:\n{out.stdout}\nstderr:\n{out.stderr}"
+    return out.stdout + out.stderr
+
+
+def test_dist_sync_kvstore_two_workers():
+    log = _launch("dist_sync_kvstore.py", 2)
+    assert log.count("dist_sync_kvstore OK") == 2
+
+
+def test_dist_lenet_two_workers():
+    log = _launch("dist_lenet.py", 2)
+    assert log.count("dist_lenet OK") == 2
+
+
+def test_dist_gluon_trainer_two_workers():
+    log = _launch("dist_gluon_trainer.py", 2)
+    assert log.count("dist_gluon_trainer OK") == 2

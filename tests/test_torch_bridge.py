@@ -1,0 +1,65 @@
+"""Torch plugin-bridge tests (ref: plugin/torch op bridge; test pattern of
+tests/python/unittest/test_operator.py custom-op coverage)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd
+from incubator_mxnet_tpu.contrib.torch_bridge import TorchModule, torch_function
+
+
+def test_torch_module_forward_matches_torch():
+    lin = torch.nn.Linear(4, 3)
+    op = TorchModule(lin)
+    x = nd.random.uniform(shape=(2, 4))
+    y = op(x)
+    ref = lin(torch.from_numpy(x.asnumpy())).detach().numpy()
+    np.testing.assert_allclose(y.asnumpy(), ref, rtol=1e-5)
+
+
+def test_torch_module_input_gradient():
+    lin = torch.nn.Linear(4, 3)
+    op = TorchModule(lin)
+    x = nd.random.uniform(shape=(2, 4))
+    x.attach_grad()
+    with autograd.record():
+        loss = (op(x) ** 2).sum()
+    loss.backward()
+    tx = torch.from_numpy(x.asnumpy()).requires_grad_(True)
+    (lin(tx) ** 2).sum().backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), tx.grad.numpy(), rtol=1e-4)
+
+
+def test_torch_module_param_grads_accumulate():
+    lin = torch.nn.Linear(4, 2)
+    op = TorchModule(lin)
+    x = nd.random.uniform(shape=(3, 4))
+    x.attach_grad()
+    with autograd.record():
+        loss = op(x).sum()
+    loss.backward()
+    assert lin.weight.grad is not None
+    # dL/dW for sum(xW^T+b) = sum of x rows broadcast
+    expect = np.tile(x.asnumpy().sum(axis=0), (2, 1))
+    np.testing.assert_allclose(lin.weight.grad.numpy(), expect, rtol=1e-4)
+
+
+def test_torch_function_stateless():
+    f = torch_function(torch.special.erf)
+    z = f(nd.array([0.0, 1.0]))
+    np.testing.assert_allclose(z.asnumpy(), [0.0, 0.84270078], atol=1e-5)
+
+
+def test_torch_module_multilayer():
+    net = torch.nn.Sequential(torch.nn.Linear(8, 16), torch.nn.ReLU(),
+                              torch.nn.Linear(16, 1))
+    op = TorchModule(net)
+    x = nd.random.uniform(shape=(4, 8))
+    x.attach_grad()
+    with autograd.record():
+        loss = op(x).sum()
+    loss.backward()
+    assert x.grad.asnumpy().shape == (4, 8)
+    assert float(np.abs(x.grad.asnumpy()).sum()) > 0
