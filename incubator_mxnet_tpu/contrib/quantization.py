@@ -13,7 +13,8 @@ import jax.numpy as jnp
 from .. import autograd
 from ..ndarray.ndarray import NDArray
 
-__all__ = ["quantize", "dequantize", "requantize", "calib_minmax", "quantize_model"]
+__all__ = ["quantize", "dequantize", "requantize", "calib_minmax",
+           "calib_entropy", "quantize_model"]
 
 
 def quantize(data, min_range=None, max_range=None, out_type="int8"):
@@ -78,3 +79,76 @@ def quantize_model(sym=None, arg_params=None, aux_params=None, net=None,
         else:
             qparams[name] = w
     return qparams, scales
+
+
+def _kl_divergence(p, q):
+    p = p / max(p.sum(), 1e-12)
+    q = q / max(q.sum(), 1e-12)
+    mask = p > 0
+    return float(np.sum(p[mask] * np.log(p[mask] / np.maximum(q[mask], 1e-12))))
+
+
+def calib_entropy(net_or_fn, calib_iter, num_batches=10, num_bins=2048,
+                  num_quantized_bins=255):
+    """KL-divergence (entropy) calibration: pick the clipping threshold whose
+    quantized distribution best matches the fp32 one
+    (ref: python/mxnet/contrib/quantization.py _get_optimal_threshold /
+    _LayerHistogramCollector — TensorRT-style entropy calibration).
+    Returns (-threshold, threshold)."""
+    if num_bins <= num_quantized_bins // 2:
+        raise ValueError(
+            f"num_bins ({num_bins}) must exceed num_quantized_bins//2 "
+            f"({num_quantized_bins // 2}) for the threshold sweep")
+    num_bins += num_bins % 2  # range-doubling rebin needs an even bin count
+    # streaming histogram of |activations|: O(num_bins) memory, range doubles
+    # (with 2:1 re-binning) when a batch exceeds it — single pass over the
+    # iterator (ref: _LayerHistogramCollector keeps running histograms)
+    hist = np.zeros(num_bins, np.float64)
+    hi_range = None
+    n_seen = 0
+    for i, batch in enumerate(calib_iter):
+        if i >= num_batches:
+            break
+        data = batch.data[0] if hasattr(batch, "data") else batch[0]
+        out = net_or_fn(data)
+        o = np.abs(out.asnumpy() if isinstance(out, NDArray)
+                   else np.asarray(out)).reshape(-1)
+        n_seen += o.size
+        bmax = float(o.max()) if o.size else 0.0
+        if hi_range is None:
+            hi_range = max(bmax, 1e-12)
+        while bmax > hi_range:
+            # double the range: merge adjacent bin pairs into the lower half
+            hist = hist.reshape(num_bins // 2, 2).sum(axis=1)
+            hist = np.concatenate([hist, np.zeros(num_bins - num_bins // 2)])
+            hi_range *= 2
+        hist += np.histogram(o, bins=num_bins, range=(0, hi_range))[0]
+    if n_seen == 0:
+        raise ValueError("calib_entropy: no calibration data "
+                         "(empty iterator or num_batches <= 0)")
+    amax = hi_range
+    edges = np.linspace(0, hi_range, num_bins + 1)
+
+    best_kl, best_t = None, amax
+    # sweep candidate thresholds (same loop structure as the reference)
+    for i in range(num_quantized_bins // 2, num_bins + 1,
+                   max(1, num_bins // 128)):
+        t = edges[i] if i < len(edges) else amax
+        p = hist[:i].astype(np.float64).copy()
+        outliers = hist[i:].sum()
+        if len(p) == 0 or p.sum() + outliers == 0:
+            continue
+        p[-1] += outliers  # clip outliers into the last bin
+        # quantize p into num_quantized_bins then expand back
+        factor = len(p) / num_quantized_bins
+        q = np.zeros_like(p)
+        for j in range(num_quantized_bins):
+            lo, hi = int(j * factor), max(int((j + 1) * factor), int(j * factor) + 1)
+            chunk = p[lo:hi]
+            nz = (chunk > 0).sum()
+            if nz:
+                q[lo:hi] = np.where(chunk > 0, chunk.sum() / nz, 0)
+        kl = _kl_divergence(p, q)
+        if best_kl is None or kl < best_kl:
+            best_kl, best_t = kl, float(t)
+    return -best_t, best_t

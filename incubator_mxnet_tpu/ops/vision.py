@@ -580,3 +580,73 @@ def adaptive_avg_pooling_2d(data, *, output_size=(1, 1)):
     cols = [jnp.mean(col_pooled[:, :, :, x0:x1], axis=3, keepdims=True)
             for (x0, x1) in xs]
     return jnp.concatenate(cols, axis=3)
+
+
+# ---------------------------------------------------------------------------
+# Deformable convolution (ref: src/operator/contrib/deformable_convolution.cc,
+# Dai et al. 2017). TPU formulation: the deformable im2col becomes a batched
+# bilinear gather building (B, C*kh*kw, H', W'), and the convolution itself
+# collapses to one big matmul on the MXU — no scatter/atomics.
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_DeformableConvolution", aliases=("DeformableConvolution",),
+          optional=("bias",))
+def deformable_convolution(data, offset, weight, bias=None, *, kernel,
+                           num_filter, stride=(1, 1), pad=(0, 0),
+                           dilate=(1, 1), num_deformable_group=1,
+                           num_group=1, no_bias=False, workspace=1024,
+                           layout="NCHW"):
+    """data (B, C, H, W); offset (B, 2*kh*kw*num_deformable_group, H', W');
+    weight (num_filter, C/num_group, kh, kw). Output (B, num_filter, H', W').
+    Offsets are (dy, dx) per kernel tap, per deformable group.
+    """
+    if layout != "NCHW":
+        raise ValueError("DeformableConvolution supports layout='NCHW' only "
+                         "(matches the reference op)")
+    kh, kw = int(kernel[0]), int(kernel[1])
+    sh, sw = int(stride[0]), int(stride[1])
+    ph, pw = int(pad[0]), int(pad[1])
+    dh, dw = int(dilate[0]), int(dilate[1])
+    b, c, h, w = data.shape
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    ndg = int(num_deformable_group)
+    cg = c // ndg
+
+    # base sampling locations per output position and tap (in padded coords,
+    # shifted back by pad to input coords)
+    oy = jnp.arange(oh, dtype=jnp.float32) * sh - ph
+    ox = jnp.arange(ow, dtype=jnp.float32) * sw - pw
+    ky = jnp.arange(kh, dtype=jnp.float32) * dh
+    kx = jnp.arange(kw, dtype=jnp.float32) * dw
+    base_y = oy[:, None, None, None] + ky[None, None, :, None]  # (oh,1,kh,1)
+    base_x = ox[None, :, None, None] + kx[None, None, None, :]  # (1,ow,1,kw)
+    base_y = jnp.broadcast_to(base_y, (oh, ow, kh, kw))
+    base_x = jnp.broadcast_to(base_x, (oh, ow, kh, kw))
+
+    off = offset.reshape(b, ndg, kh, kw, 2, oh, ow)
+
+    def one_image(img, off_i):
+        cols = []
+        for g in range(ndg):  # static loop over deformable groups
+            dy = jnp.transpose(off_i[g, :, :, 0], (2, 3, 0, 1))  # (oh,ow,kh,kw)
+            dx = jnp.transpose(off_i[g, :, :, 1], (2, 3, 0, 1))
+            ys = (base_y + dy).reshape(-1)
+            xs = (base_x + dx).reshape(-1)
+            v = _bilinear_gather(img[g * cg:(g + 1) * cg], ys, xs)
+            cols.append(v.reshape(cg, oh, ow, kh, kw))
+        col = jnp.concatenate(cols, axis=0)  # (C, oh, ow, kh, kw)
+        return jnp.transpose(col, (0, 3, 4, 1, 2))  # (C, kh, kw, oh, ow)
+
+    col = jax.vmap(one_image)(data, off)  # (B, C, kh, kw, oh, ow)
+
+    cpg = c // num_group
+    fpg = num_filter // num_group
+    col = col.reshape(b, num_group, cpg * kh * kw, oh * ow)
+    wmat = weight.reshape(num_group, fpg, cpg * kh * kw)
+    out = jnp.einsum("bgkp,gfk->bgfp", col, wmat)
+    out = out.reshape(b, num_filter, oh, ow)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out

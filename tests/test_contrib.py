@@ -105,3 +105,22 @@ def test_amp_convert_block():
     amp.convert_block(net)
     assert net[0].weight.data().dtype.name == "bfloat16"
     assert net[1].gamma.data().dtype == np.float32
+
+
+def test_entropy_calibration():
+    import numpy as np
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.contrib import quantization as q
+
+    class FakeIter:
+        def __iter__(self):
+            rng = np.random.RandomState(0)
+            for _ in range(3):
+                yield [nd.array(rng.randn(512).astype(np.float32))]
+
+    lo, hi = q.calib_entropy(lambda d: d, iter(FakeIter()), num_batches=3,
+                             num_bins=256)
+    assert lo == -hi and hi > 0
+    # threshold clips the tail: must be below the absolute max but cover
+    # most of the mass of a standard normal
+    assert 1.0 < hi < 5.0

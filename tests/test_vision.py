@@ -291,3 +291,49 @@ def test_vision_ops_symbolic():
     ex = n.bind(mx.cpu(), {"d": nd.array(
         np.random.uniform(0, 1, (1, 5, 6)).astype(np.float32))})
     assert ex.forward()[0].shape == (1, 5, 6)
+
+
+def test_deformable_convolution_zero_offsets_equals_conv():
+    B, C, nf, k = 2, 4, 6, 3
+    x = nd.random.uniform(shape=(B, C, 8, 8))
+    w = nd.random.uniform(shape=(nf, C, k, k))
+    b = nd.random.uniform(shape=(nf,))
+    off = nd.zeros((B, 2 * k * k, 6, 6))
+    out = nd.contrib.DeformableConvolution(x, off, w, b, kernel=(3, 3),
+                                           num_filter=nf)
+    ref = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=nf)
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), atol=1e-4)
+
+
+def test_deformable_convolution_integer_offset_shifts():
+    # constant (dy=0, dx=1) offset == convolving the x-shifted image interior
+    B, C, nf, k = 1, 2, 3, 3
+    x = nd.random.uniform(shape=(B, C, 10, 10))
+    w = nd.random.uniform(shape=(nf, C, k, k))
+    b = nd.zeros((nf,))
+    off_np = np.zeros((B, 2 * k * k, 8, 8), np.float32)
+    off_np[:, 1::2] = 1.0  # dx taps
+    out = nd.contrib.DeformableConvolution(x, nd.array(off_np), w, b,
+                                           kernel=(3, 3), num_filter=nf)
+    shifted = np.roll(x.asnumpy(), -1, axis=3)
+    ref = nd.Convolution(nd.array(shifted), w, b, kernel=(3, 3),
+                         num_filter=nf)
+    np.testing.assert_allclose(out.asnumpy()[..., :-1],
+                               ref.asnumpy()[..., :-1], atol=1e-4)
+
+
+def test_deformable_convolution_grad_flows_to_offsets():
+    from incubator_mxnet_tpu import autograd
+    B, C, nf, k = 1, 2, 2, 3
+    x = nd.random.uniform(shape=(B, C, 6, 6))
+    w = nd.random.uniform(shape=(nf, C, k, k))
+    off = nd.random.uniform(-0.3, 0.3, shape=(B, 2 * k * k, 4, 4))
+    off.attach_grad()
+    x.attach_grad()
+    with autograd.record():
+        out = nd.contrib.DeformableConvolution(x, off, w, None, kernel=(3, 3),
+                                               num_filter=nf, no_bias=True)
+        loss = (out * out).sum()
+    loss.backward()
+    assert float(np.abs(off.grad.asnumpy()).sum()) > 0
+    assert float(np.abs(x.grad.asnumpy()).sum()) > 0
