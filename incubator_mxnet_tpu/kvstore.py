@@ -216,6 +216,70 @@ class KVStoreDist(KVStore):
                 self._store[key] = NDArray._from_data(grad)
 
 
+
+
+class KVStoreDistAsync(KVStoreDist):
+    """Asynchronous distributed store (ref: `dist_async` —
+    kvstore_dist_server.h:348 applies updates instantly, workers never
+    barrier per step).
+
+    TPU-native design: there is no server, so "async" = **bounded-staleness
+    elastic averaging**. Every push applies the optimizer LOCALLY with zero
+    cross-worker blocking; every `period`-th push of a key mixes that key's
+    weights toward the cross-worker mean (collectives match by call order,
+    so stragglers only rendezvous at mix points — staleness is bounded by
+    `period`, the role MXNET_KVSTORE's async staleness played). Tune with
+    MXTPU_ASYNC_PERIOD / MXTPU_ASYNC_ALPHA.
+    """
+
+    def __init__(self, kv_type="dist_async"):
+        super().__init__(kv_type)
+        import os as _os
+
+        self._period = max(1, int(_os.environ.get("MXTPU_ASYNC_PERIOD", "16")))
+        self._alpha = float(_os.environ.get("MXTPU_ASYNC_ALPHA", "0.5"))
+        self._push_counts = {}
+
+    def push(self, key, value, priority=0):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.push(k, v, priority)
+            return
+        grad = self._reduce(value)
+        if self._compression is not None and self._compression.get("type") == "2bit":
+            grad = _two_bit_roundtrip(
+                grad, float(self._compression.get("threshold", 0.5)))
+        # local apply — no cross-worker communication on the hot path
+        if self._updater is not None:
+            self._updater(_key_int(key), NDArray._from_data(grad),
+                          self._store[key])
+        else:
+            if key in self._store:
+                self._store[key]._data = self._store[key]._data + grad
+            else:
+                self._store[key] = NDArray._from_data(grad)
+        c = self._push_counts.get(key, 0) + 1
+        self._push_counts[key] = c
+        if self.num_workers > 1 and c % self._period == 0:
+            self._mix(key)
+
+    def _mix(self, key, alpha=None):
+        """Elastic-average this key toward the cross-worker mean."""
+        import numpy as _np
+        from jax.experimental import multihost_utils
+
+        alpha = self._alpha if alpha is None else alpha
+        w = self._store[key]
+        gathered = multihost_utils.process_allgather(_np.asarray(w._data))
+        mean = jnp.mean(jnp.asarray(gathered), axis=0)
+        w._data = (1.0 - alpha) * w._data + alpha * mean
+
+    def sync_all(self, alpha=1.0):
+        """Force full weight consensus (e.g. before eval/checkpoint)."""
+        for key in list(self._store):
+            self._mix(key, alpha=alpha)
+
+
 def _key_int(key):
     if isinstance(key, int):
         return key
@@ -241,6 +305,8 @@ def create(name="local"):
         from . import distributed
 
         distributed.init_from_env()  # launcher env -> jax.distributed
+        if "async" in name:
+            return KVStoreDistAsync(name)
         return KVStoreDist(name)
     return KVStore(name)
 

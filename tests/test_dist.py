@@ -46,3 +46,8 @@ def test_dist_lenet_two_workers():
 def test_dist_gluon_trainer_two_workers():
     log = _launch("dist_gluon_trainer.py", 2)
     assert log.count("dist_gluon_trainer OK") == 2
+
+
+def test_dist_async_kvstore_two_workers():
+    log = _launch("dist_async_kvstore.py", 2)
+    assert log.count("dist_async_kvstore OK") == 2
