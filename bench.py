@@ -4,19 +4,29 @@ TPU chip, matching the reference's measurement protocol
 (ref: example/image-classification/train_imagenet.py + docs/faq/perf.md:225 —
 synthetic data, SGD momentum, batch 128, fp32 baseline 363.69 img/s on V100).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+
+Structure: the measurement itself runs in a child subprocess (BENCH_CHILD=1)
+so that a flaky TPU backend / remote-compile tunnel only kills one attempt.
+The parent retries each dtype a few times, falls back to a small CPU run if
+the accelerator never comes up, and ALWAYS emits a parseable JSON line.
 """
 import json
 import os
+import subprocess
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import numpy as np
+BASELINE_FP32 = 363.69  # MXNet-CUDA ResNet-50 v1 fp32 bs128 on V100 (perf.md:225)
+# ResNet-50 fwd FLOPs at 224x224 ~ 4.09 GFLOP/img; training ~ 3x fwd.
+FLOPS_PER_IMAGE_TRAIN = 3 * 4.09e9
+PEAK_FLOPS = {"bfloat16": 197e12, "float32": 197e12 / 4}  # v5e MXU peak
 
 
-def main():
+def child_main():
+    import numpy as np
     import jax
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import nd, fused, gluon
@@ -26,15 +36,18 @@ def main():
     image_size = int(os.environ.get("BENCH_IMAGE", "224"))
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
     iters = int(os.environ.get("BENCH_ITERS", "30"))
-    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
-    baseline = 363.69  # MXNet-CUDA ResNet-50 v1 fp32 bs128 on V100 (perf.md:225)
+    dtype = os.environ.get("BENCH_DTYPE", "float32")
 
     mx.random.seed(0)
+    devices = jax.devices()
+    accel = [d for d in devices if d.platform != "cpu"]
+    target = accel[0] if accel else devices[0]
+    try:
+        cpu0 = jax.devices("cpu")[0]
+    except RuntimeError:
+        cpu0 = target
     # build + initialize on host CPU: avoids hundreds of tiny per-param
     # device programs; one bulk transfer moves weights to the chip
-    cpu0 = jax.devices("cpu")[0]
-    accel = [d for d in jax.devices() if d.platform != "cpu"]
-    target = accel[0] if accel else cpu0
     with jax.default_device(cpu0):
         net = vision.resnet50_v1(classes=1000)
         net.initialize(mx.init.Xavier())
@@ -44,10 +57,8 @@ def main():
     opt = mx.optimizer.SGD(learning_rate=0.05, momentum=0.9, wd=1e-4,
                            rescale_grad=1.0 / batch_size)
 
-    def loss_fn(n, x, y):
-        return L(n(x), y)
-
-    step = fused.GluonTrainStep(net, loss_fn, opt, device=target)
+    step = fused.GluonTrainStep(net, lambda n, x, y: L(n(x), y), opt,
+                                device=target)
 
     rng = np.random.RandomState(0)
     import jax.numpy as jnp
@@ -58,17 +69,19 @@ def main():
         xd = xd.astype(ml_dtypes.bfloat16)
     x = nd.array(jax.device_put(jnp.asarray(xd), target))
     y = nd.array(jax.device_put(
-        jnp.asarray(rng.randint(0, 1000, size=batch_size).astype(np.float32)), target))
+        jnp.asarray(rng.randint(0, 1000, size=batch_size).astype(np.float32)),
+        target))
 
-    import sys as _sys
     t0 = time.perf_counter()
-    print(f"[bench] init done, compiling...", file=_sys.stderr, flush=True)
+    compile_s = 0.0
+    print(f"[bench] init done ({dtype}), compiling...", file=sys.stderr, flush=True)
     for i in range(warmup):
         loss = step(x, y)
         if i == 0:
             loss.wait_to_read()
-            print(f"[bench] first step (compile) {time.perf_counter()-t0:.1f}s",
-                  file=_sys.stderr, flush=True)
+            compile_s = time.perf_counter() - t0
+            print(f"[bench] first step (compile) {compile_s:.1f}s",
+                  file=sys.stderr, flush=True)
     loss.wait_to_read()
 
     start = time.perf_counter()
@@ -79,11 +92,101 @@ def main():
 
     ips = batch_size * iters / elapsed
     print(json.dumps({
+        "ips": round(ips, 2),
+        "dtype": dtype,
+        "platform": target.platform,
+        "compile_s": round(compile_s, 1),
+        "loss": float(loss.asscalar()),
+    }), flush=True)
+
+
+def _run_child(dtype, attempts=3, timeout=1500, extra_env=None):
+    """Run one measurement in a subprocess; returns (result_dict, last_err)."""
+    last_err = None
+    for i in range(attempts):
+        env = dict(os.environ)
+        env["BENCH_CHILD"] = "1"
+        env["BENCH_DTYPE"] = dtype
+        env.update(extra_env or {})
+        try:
+            p = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                               env=env, capture_output=True, text=True,
+                               timeout=timeout,
+                               cwd=os.path.dirname(os.path.abspath(__file__)))
+        except subprocess.TimeoutExpired:
+            last_err = f"attempt {i}: timeout after {timeout}s"
+            print(f"[bench] {dtype} {last_err}", file=sys.stderr, flush=True)
+            continue
+        for line in reversed(p.stdout.strip().splitlines()):
+            try:
+                d = json.loads(line)
+            except (json.JSONDecodeError, ValueError):
+                continue
+            if "ips" in d:
+                return d, None
+        tail = "\n".join((p.stderr or "").strip().splitlines()[-6:])
+        last_err = f"attempt {i}: rc={p.returncode}: {tail[-500:]}"
+        print(f"[bench] {dtype} failed: {last_err}", file=sys.stderr, flush=True)
+        time.sleep(5 * (i + 1))
+    return None, last_err
+
+
+def main():
+    if os.environ.get("BENCH_CHILD"):
+        child_main()
+        return
+
+    results, errors = {}, {}
+    for dtype in ("float32", "bfloat16"):
+        r, err = _run_child(dtype, attempts=3)
+        if r is not None:
+            results[dtype] = r
+        else:
+            errors[dtype] = err
+
+    note = ""
+    if not results:
+        # accelerator never came up: tiny CPU run so a real number still
+        # exists, clearly labelled.
+        r, err = _run_child(
+            "float32", attempts=1, timeout=2400,
+            extra_env={"JAX_PLATFORMS": "cpu", "BENCH_BATCH": "16",
+                       "BENCH_ITERS": "3", "BENCH_WARMUP": "1",
+                       "PALLAS_AXON_POOL_IPS": ""})
+        if r is not None:
+            results["float32"] = r
+            note = "cpu-fallback (TPU backend unavailable); "
+        else:
+            errors["cpu-fallback"] = err
+
+    out = {
         "metric": "resnet50_v1_train_images_per_sec",
-        "value": round(ips, 2),
+        "value": 0.0,
         "unit": "images/sec/chip",
-        "vs_baseline": round(ips / baseline, 3),
-    }))
+        "vs_baseline": 0.0,
+    }
+    fp32 = results.get("float32")
+    bf16 = results.get("bfloat16")
+    primary = fp32 or bf16
+    if primary is not None:
+        out["value"] = primary["ips"]
+        out["vs_baseline"] = round(primary["ips"] / BASELINE_FP32, 3)
+        out["dtype"] = primary["dtype"]
+        out["platform"] = primary["platform"]
+        if bf16:
+            out["bf16_ips"] = bf16["ips"]
+            out["bf16_vs_fp32_baseline"] = round(bf16["ips"] / BASELINE_FP32, 3)
+            out["bf16_mfu"] = round(
+                bf16["ips"] * FLOPS_PER_IMAGE_TRAIN / PEAK_FLOPS["bfloat16"], 3)
+        if fp32:
+            out["fp32_ips"] = fp32["ips"]
+            out["fp32_mfu"] = round(
+                fp32["ips"] * FLOPS_PER_IMAGE_TRAIN / PEAK_FLOPS["float32"], 3)
+    if errors:
+        note += "; ".join(f"{k}: {v}" for k, v in errors.items())[:400]
+    if note:
+        out["note"] = note
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

@@ -116,7 +116,12 @@ def calib_entropy(net_or_fn, calib_iter, num_batches=10, num_bins=2048,
         n_seen += o.size
         bmax = float(o.max()) if o.size else 0.0
         if hi_range is None:
-            hi_range = max(bmax, 1e-12)
+            if bmax == 0.0:
+                # don't seed the range from an all-zero batch: a later normal
+                # batch would trigger ~40 range doublings and collapse all
+                # histogram mass into bin 0 (zeros land in bin 0 regardless)
+                continue
+            hi_range = bmax
         while bmax > hi_range:
             # double the range: merge adjacent bin pairs into the lower half
             hist = hist.reshape(num_bins // 2, 2).sum(axis=1)
@@ -126,6 +131,10 @@ def calib_entropy(net_or_fn, calib_iter, num_batches=10, num_bins=2048,
     if n_seen == 0:
         raise ValueError("calib_entropy: no calibration data "
                          "(empty iterator or num_batches <= 0)")
+    if hi_range is None:
+        raise ValueError("calib_entropy: every calibration activation was "
+                         "exactly zero — no threshold can be calibrated for "
+                         "this layer (check the calibration data)")
     amax = hi_range
     edges = np.linspace(0, hi_range, num_bins + 1)
 

@@ -29,9 +29,15 @@ class TorchModule:
     """Wrap a `torch.nn.Module` as a differentiable eager op
     (ref: plugin/torch/torch_module-inl.h TorchModuleOp).
 
-    Torch parameters stay owned by torch; their gradients accumulate into
-    `.grad` as usual so a torch optimizer can drive them, while gradients
-    w.r.t. the (JAX) inputs flow back onto the tape.
+    Torch parameters stay owned by torch; gradients w.r.t. the (JAX) inputs
+    flow back onto the tape.
+
+    Torch-side `.grad` accumulation is a side effect inside
+    `jax.pure_callback`, which JAX may elide, cache, or re-execute under
+    `jit`/`vmap`/higher-order `grad`. The "torch optimizer can drive the
+    module's parameters via `.grad`" contract therefore holds ONLY in eager
+    execution (the default dispatch of this bridge). Under `jit`, treat the
+    torch module as frozen — or step it outside the jitted region.
     """
 
     def __init__(self, module):

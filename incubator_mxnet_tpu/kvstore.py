@@ -17,7 +17,11 @@ Module/Trainer code ports unchanged; the transport is different by design:
 """
 from __future__ import annotations
 
+import os
 import pickle
+import tempfile
+import threading
+import time
 
 import numpy as np
 import jax
@@ -26,11 +30,72 @@ import jax.numpy as jnp
 from .ndarray.ndarray import NDArray
 from .ndarray.sparse import RowSparseNDArray
 
-__all__ = ["KVStore", "create", "create_kvstore_for_module"]
+__all__ = ["KVStore", "TwoBitCompressor", "create", "create_kvstore_for_module"]
 
 
 def _to_data(v):
     return v._data if isinstance(v, NDArray) else jnp.asarray(v)
+
+
+class TwoBitCompressor:
+    """2-bit gradient compression with error-feedback residual
+    (ref: src/kvstore/gradient_compression.h:37, gradient_compression-inl.h:68).
+
+    encode() maps each gradient element to one of three levels
+    {-threshold, 0, +threshold}, packs 4 elements per byte (a genuinely
+    2-bit wire representation), and keeps the quantization error in a
+    per-key residual that is added to the next step's gradient — so
+    sub-threshold gradients accumulate and are eventually transmitted,
+    exactly the reference's semantics.
+    """
+
+    def __init__(self, threshold=0.5):
+        self.threshold = float(threshold)
+        self._residual = {}
+
+    def encode(self, key, grad):
+        """grad -> (packed uint8 wire payload, element count). Updates the
+        key's residual with the quantization error."""
+        acc = grad + self._residual.get(key, 0.0)
+        codes = jnp.where(acc >= self.threshold, 1,
+                          jnp.where(acc <= -self.threshold, 2, 0)).astype(jnp.uint8)
+        decoded = self._decode_codes(codes)
+        self._residual[key] = acc - decoded
+        flat = codes.ravel()
+        pad = (-flat.size) % 4
+        flat = jnp.pad(flat, (0, pad))
+        quads = flat.reshape(-1, 4)
+        packed = (quads[:, 0] | (quads[:, 1] << 2) | (quads[:, 2] << 4)
+                  | (quads[:, 3] << 6))
+        return packed, grad.size
+
+    def decode(self, packed, shape):
+        """Inverse of encode's packing: wire payload -> dense gradient."""
+        n = int(np.prod(shape)) if shape else 1
+        quads = jnp.stack([(packed >> s) & 0x3 for s in (0, 2, 4, 6)], axis=1)
+        codes = quads.ravel()[:n].reshape(shape)
+        return self._decode_codes(codes)
+
+    def _decode_codes(self, codes):
+        return jnp.where(codes == 1, self.threshold,
+                         jnp.where(codes == 2, -self.threshold, 0.0))
+
+    def roundtrip(self, key, grad):
+        """Local-store path: same signal degradation + error feedback as a
+        compressed push, with no wire to cross."""
+        acc = grad + self._residual.get(key, 0.0)
+        q = jnp.where(acc >= self.threshold, self.threshold,
+                      jnp.where(acc <= -self.threshold, -self.threshold, 0.0))
+        self._residual[key] = acc - q
+        return q
+
+
+def _make_compressor(params):
+    if params is None:
+        return None
+    if params.get("type") == "2bit":
+        return TwoBitCompressor(float(params.get("threshold", 0.5)))
+    raise ValueError(f"unsupported gradient compression {params!r}")
 
 
 class KVStore:
@@ -64,7 +129,7 @@ class KVStore:
         return 0
 
     def set_gradient_compression(self, compression_params):
-        self._compression = dict(compression_params)
+        self._compression = _make_compressor(dict(compression_params))
 
     # -- data --------------------------------------------------------------
     def init(self, key, value):
@@ -92,8 +157,8 @@ class KVStore:
                 self.push(k, v, priority)
             return
         grad = self._reduce(value)
-        if self._compression is not None and self._compression.get("type") == "2bit":
-            grad = _two_bit_roundtrip(grad, float(self._compression.get("threshold", 0.5)))
+        if self._compression is not None:
+            grad = self._compression.roundtrip(key, grad)
         if self._updater is not None:
             weight = self._store[key]
             self._updater(_key_int(key), NDArray._from_data(grad), weight)
@@ -117,15 +182,20 @@ class KVStore:
         return src
 
     def pushpull(self, key, value, out=None, priority=0):
+        if isinstance(key, (list, tuple)):
+            # recurse per element so the per-key accumulator reset below
+            # always runs (a single push(list)+pull(list) would leave
+            # allreduce-mode gradients in the store, corrupting step N+1)
+            outs = out if isinstance(out, (list, tuple)) else [out] * len(key)
+            for k, v, o in zip(key, value, outs):
+                self.pushpull(k, v, o, priority)
+            return
         self.push(key, value, priority)
         if out is not None:
+            self.pull(key, out, priority)
             if self._updater is None:
-                # pure allreduce semantics: pull then reset accumulator
-                self.pull(key, out, priority)
-                if not isinstance(key, (list, tuple)):
-                    del self._store[key]
-            else:
-                self.pull(key, out, priority)
+                # pure allreduce semantics: reset the accumulator after pull
+                del self._store[key]
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """(ref: KVStore::PullRowSparse) — gather only requested rows."""
@@ -173,6 +243,7 @@ class KVStoreDist(KVStore):
 
     def __init__(self, kv_type="dist_sync"):
         super().__init__(kv_type)
+        self._heartbeat = _Heartbeat.maybe_start(self.rank, self.num_workers)
 
     @property
     def rank(self):
@@ -188,25 +259,43 @@ class KVStoreDist(KVStore):
 
             multihost_utils.sync_global_devices("kvstore_barrier")
 
+    @property
+    def num_dead_node(self):
+        """Heartbeat-based dead-peer count (ref: ps-lite Postoffice
+        GetDeadNodes via kvstore_dist.h:121). Workers touch a per-rank
+        heartbeat file; a rank is dead once its heartbeat goes stale."""
+        if self._heartbeat is None:
+            return 0
+        return self._heartbeat.num_dead()
+
     def push(self, key, value, priority=0):
         if isinstance(key, (list, tuple)):
             for k, v in zip(key, value):
                 self.push(k, v, priority)
             return
         grad = self._reduce(value)
-        if self._compression is not None and self._compression.get("type") == "2bit":
-            # compress-on-the-wire semantics: quantize the local contribution
-            # before it crosses DCN (ref: DataHandleCompressed)
-            grad = _two_bit_roundtrip(
-                grad, float(self._compression.get("threshold", 0.5)))
         if self.num_workers > 1:
             import numpy as _np
             from jax.experimental import multihost_utils
 
-            # host-side hop: the local grad may be committed to one local
-            # device; allgather wants process-replicated input
-            grad = multihost_utils.process_allgather(_np.asarray(grad))
-            grad = jnp.sum(jnp.asarray(grad), axis=0)
+            if self._compression is not None:
+                # compress-on-the-wire (ref: DataHandleCompressed): only the
+                # packed 2-bit payload crosses DCN; each worker keeps its own
+                # error-feedback residual and decodes the peers' payloads.
+                payload, _n = self._compression.encode(key, grad)
+                gathered = multihost_utils.process_allgather(
+                    _np.asarray(payload))
+                grad = sum(
+                    self._compression.decode(jnp.asarray(gathered[i]),
+                                             grad.shape)
+                    for i in range(gathered.shape[0]))
+            else:
+                # host-side hop: the local grad may be committed to one local
+                # device; allgather wants process-replicated input
+                gathered = multihost_utils.process_allgather(_np.asarray(grad))
+                grad = jnp.sum(jnp.asarray(gathered), axis=0)
+        elif self._compression is not None:
+            grad = self._compression.roundtrip(key, grad)
         if self._updater is not None:
             self._updater(_key_int(key), NDArray._from_data(grad), self._store[key])
         else:
@@ -246,9 +335,8 @@ class KVStoreDistAsync(KVStoreDist):
                 self.push(k, v, priority)
             return
         grad = self._reduce(value)
-        if self._compression is not None and self._compression.get("type") == "2bit":
-            grad = _two_bit_roundtrip(
-                grad, float(self._compression.get("threshold", 0.5)))
+        if self._compression is not None:
+            grad = self._compression.roundtrip(key, grad)
         # local apply — no cross-worker communication on the hot path
         if self._updater is not None:
             self._updater(_key_int(key), NDArray._from_data(grad),
@@ -286,15 +374,79 @@ def _key_int(key):
     return key
 
 
-def _two_bit_roundtrip(grad, threshold):
-    """2-bit gradient quantization semantics (ref: gradient_compression.h:37).
+class _Heartbeat:
+    """File-based worker heartbeats for dead-node detection (ref: ps-lite
+    heartbeat/GetDeadNodes, surfaced as KVStore::get_num_dead_node
+    include/mxnet/kvstore.h:353).
 
-    Single-process stores apply the quantize->dequantize roundtrip so
-    training sees the same signal degradation + error-feedback as the
-    reference's compressed push.
+    Each worker touches `<dir>/rank_<i>` every MXTPU_HEARTBEAT_INTERVAL
+    seconds from a daemon thread; a peer is dead when its file has not been
+    touched for MXTPU_HEARTBEAT_TIMEOUT seconds (or never appeared within
+    the timeout of store creation). Works wherever the workers share a
+    filesystem — same-host multi-process (the test/launcher topology) and
+    NFS-backed pods; otherwise detection degrades to 0, matching the
+    reference when ps-lite heartbeats are off.
     """
-    q = jnp.where(grad >= threshold, threshold, jnp.where(grad <= -threshold, -threshold, 0.0))
-    return q
+
+    def __init__(self, rank, num_workers, hb_dir, interval, timeout):
+        self.rank = rank
+        self.num_workers = num_workers
+        self.dir = hb_dir
+        self.interval = interval
+        self.timeout = timeout
+        self.start_time = time.time()
+        os.makedirs(hb_dir, exist_ok=True)
+        self._stop = threading.Event()
+        self._beat()
+        t = threading.Thread(target=self._loop, daemon=True)
+        t.start()
+
+    @classmethod
+    def maybe_start(cls, rank, num_workers):
+        if num_workers <= 1:
+            return None
+        hb_dir = os.environ.get("MXTPU_HEARTBEAT_DIR")
+        if not hb_dir:
+            coord = os.environ.get("MXTPU_COORDINATOR", "local")
+            tag = coord.replace(":", "_").replace("/", "_")
+            hb_dir = os.path.join(tempfile.gettempdir(), f"mxtpu_hb_{tag}")
+        interval = float(os.environ.get("MXTPU_HEARTBEAT_INTERVAL", "2"))
+        timeout = float(os.environ.get("MXTPU_HEARTBEAT_TIMEOUT", "20"))
+        return cls(rank, num_workers, hb_dir, interval, timeout)
+
+    def _path(self, rank):
+        return os.path.join(self.dir, f"rank_{rank}")
+
+    def _beat(self):
+        try:
+            with open(self._path(self.rank), "w") as f:
+                f.write(str(time.time()))
+        except OSError:
+            pass
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            self._beat()
+
+    def stop(self):
+        self._stop.set()
+
+    def num_dead(self):
+        now = time.time()
+        dead = 0
+        for r in range(self.num_workers):
+            if r == self.rank:
+                continue
+            try:
+                mtime = os.path.getmtime(self._path(r))
+            except OSError:
+                # never seen: dead only once the startup grace has passed
+                if now - self.start_time > self.timeout:
+                    dead += 1
+                continue
+            if now - mtime > self.timeout:
+                dead += 1
+        return dead
 
 
 def create(name="local"):

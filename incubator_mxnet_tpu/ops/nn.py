@@ -27,6 +27,8 @@ from .registry import register
 def fully_connected(data, weight, bias=None, *, num_hidden=None, no_bias=False, flatten=True):
     """y = x W^T + b (ref: src/operator/nn/fully_connected.cc)."""
     x = data.reshape((data.shape[0], -1)) if flatten and data.ndim > 2 else data
+    if x.dtype != weight.dtype:  # mixed precision: MXU wants matching operand dtypes
+        x = x.astype(weight.dtype)
     y = jnp.matmul(x, weight.T)
     if bias is not None and not no_bias:
         y = y + bias
@@ -80,6 +82,8 @@ def convolution(
     dil = _tup(dilate, nd)
     p = _tup(pad, nd) if pad is not None else (0,) * nd
     padding = [(pi, pi) for pi in p]
+    if data.dtype != weight.dtype:  # mixed precision: MXU wants matching operand dtypes
+        data = data.astype(weight.dtype)
     out = lax.conv_general_dilated(
         data,
         weight,
@@ -257,16 +261,21 @@ def batch_norm(
     bshape = [1] * data.ndim
     bshape[axis % data.ndim] = data.shape[axis % data.ndim]
 
+    # Statistics in fp32 regardless of activation dtype (bf16 mean/var loses
+    # too much precision); output cast back so bf16 stays bf16 end-to-end.
+    out_dtype = data.dtype
+    xf = data.astype(jnp.float32)
     if _training and not use_global_stats:
-        mean = jnp.mean(data, axis=reduce_axes)
-        var = jnp.var(data, axis=reduce_axes)
-        new_mean = moving_mean * momentum + mean * (1 - momentum)
-        new_var = moving_var * momentum + var * (1 - momentum)
+        mean = jnp.mean(xf, axis=reduce_axes)
+        var = jnp.var(xf, axis=reduce_axes)
+        new_mean = moving_mean * momentum + mean.astype(moving_mean.dtype) * (1 - momentum)
+        new_var = moving_var * momentum + var.astype(moving_var.dtype) * (1 - momentum)
     else:
-        mean, var = moving_mean, moving_var
+        mean, var = moving_mean.astype(jnp.float32), moving_var.astype(jnp.float32)
         new_mean, new_var = moving_mean, moving_var
-    x_hat = (data - mean.reshape(bshape)) * lax.rsqrt(var.reshape(bshape) + eps)
-    out = x_hat * g.reshape(bshape) + beta.reshape(bshape)
+    x_hat = (xf - mean.reshape(bshape)) * lax.rsqrt(var.reshape(bshape) + eps)
+    out = (x_hat * g.reshape(bshape).astype(jnp.float32)
+           + beta.reshape(bshape).astype(jnp.float32)).astype(out_dtype)
     if _training:
         return out, lax.stop_gradient(new_mean), lax.stop_gradient(new_var)
     return out

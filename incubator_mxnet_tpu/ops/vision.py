@@ -280,16 +280,20 @@ def multibox_target(anchor, label, cls_pred, *, overlap_threshold=0.5,
         cls_t = jnp.where(pos, gt[:, 0] + 1.0, 0.0)
         if negative_mining_ratio > 0:
             # hard-negative mining: keep top (ratio * num_pos) negatives by
-            # background-class "difficulty" (max non-bg prob), ignore the rest
+            # background-class "difficulty" (max non-bg prob), ignore the rest.
+            # Anchors overlapping any ground truth above negative_mining_thresh
+            # are never negative candidates (ref: multibox_target.cc) — they
+            # are ignored instead of trained as background.
             num_pos = jnp.sum(pos)
             max_neg = jnp.maximum(num_pos * negative_mining_ratio,
                                   float(minimum_negative_samples))
             conf = jnp.max(pred[1:, :], axis=0)  # (N,) hardest-negative score
-            neg = ~pos
+            max_iou = jnp.max(jnp.where(valid[None, :], iou, 0.0), axis=1)
+            neg = ~pos & (max_iou < negative_mining_thresh)
             neg_score = jnp.where(neg, conf, -jnp.inf)
             rank = jnp.argsort(jnp.argsort(-neg_score))  # rank 0 = hardest
             keep_neg = neg & (rank < max_neg)
-            cls_t = jnp.where(neg & ~keep_neg, float(ignore_label), cls_t)
+            cls_t = jnp.where(~pos & ~keep_neg, float(ignore_label), cls_t)
         return loc_t, loc_m, cls_t
 
     loc_t, loc_m, cls_t = jax.vmap(per_image)(label, cls_pred)

@@ -1,4 +1,5 @@
 """KVStore tests (ref: tests/python/unittest/test_kvstore.py)."""
+import os
 import numpy as np
 
 import incubator_mxnet_tpu as mx
@@ -73,3 +74,76 @@ def test_type_and_rank():
     assert kv.rank == 0 and kv.num_workers == 1
     assert "dist" in kv.type
     kv.barrier()
+
+
+def test_compression_error_feedback():
+    """Sub-threshold gradients accumulate in the residual and are eventually
+    transmitted (ref: gradient_compression-inl.h:68 error feedback)."""
+    kv = kvstore.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("w", nd.zeros((1,)))
+    total = 0.0
+    for _ in range(10):
+        kv.push("w", nd.array([0.2]))
+        out = nd.zeros((1,))
+        kv.pull("w", out=out)
+        total = float(out.asnumpy()[0])
+    # 10 * 0.2 = 2.0 pushed; with error feedback the store should have
+    # received ~2.0 (within one threshold quantum), not 0
+    assert abs(total - 2.0) <= 0.5 + 1e-6, total
+
+
+def test_compressor_wire_size_and_roundtrip():
+    """The transferred representation is genuinely 2-bit-sized."""
+    from incubator_mxnet_tpu.kvstore import TwoBitCompressor
+    import jax.numpy as jnp
+
+    c = TwoBitCompressor(threshold=0.5)
+    g = jnp.asarray(np.random.RandomState(0).randn(131).astype("float32"))
+    payload, n = c.encode("k", g)
+    assert payload.dtype == jnp.uint8
+    assert payload.size == (131 + 3) // 4  # 4 elements per byte
+    dec = c.decode(payload, g.shape)
+    # decoded levels only
+    u = np.unique(np.asarray(dec))
+    assert set(np.round(u, 6)).issubset({-0.5, 0.0, 0.5})
+    # residual + decoded == original accumulated signal
+    assert_almost_equal(np.asarray(dec) + np.asarray(c._residual["k"]),
+                        np.asarray(g), rtol=1e-5, atol=1e-6)
+
+
+def test_pushpull_list_keys_reset():
+    """List-key pushpull in allreduce (updater-less) mode must reset the
+    per-key accumulator so step N+1 doesn't accumulate onto step N."""
+    kv = kvstore.create("local")
+    keys = ["a", "b"]
+    outs = [nd.zeros((2,)), nd.zeros((2,))]
+    kv.pushpull(keys, [nd.ones((2,)), nd.ones((2,)) * 2], out=outs)
+    assert (outs[0].asnumpy() == 1).all() and (outs[1].asnumpy() == 2).all()
+    # second step: same values again — must NOT double
+    kv.pushpull(keys, [nd.ones((2,)), nd.ones((2,)) * 2], out=outs)
+    assert (outs[0].asnumpy() == 1).all() and (outs[1].asnumpy() == 2).all()
+
+
+def test_heartbeat_dead_node_detection(tmp_path):
+    """num_dead_node counts stale peers (ref: kvstore.h:353 get_num_dead_node)."""
+    import time
+    from incubator_mxnet_tpu.kvstore import _Heartbeat
+
+    hb = _Heartbeat(rank=0, num_workers=3, hb_dir=str(tmp_path),
+                    interval=0.05, timeout=0.4)
+    try:
+        # peer 1 beats recently, peer 2 stale
+        with open(tmp_path / "rank_1", "w") as f:
+            f.write("x")
+        with open(tmp_path / "rank_2", "w") as f:
+            f.write("x")
+        old = time.time() - 10
+        os.utime(tmp_path / "rank_2", (old, old))
+        assert hb.num_dead() == 1
+        # a never-appearing peer counts once the startup grace passes
+        os.remove(tmp_path / "rank_1")
+        hb.start_time = time.time() - 100
+        assert hb.num_dead() == 2
+    finally:
+        hb.stop()
