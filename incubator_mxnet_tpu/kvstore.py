@@ -142,21 +142,47 @@ class KVStore:
         self._store[key] = v if isinstance(v, NDArray) else NDArray(v)
 
     def _reduce(self, value):
-        """Sum a list of per-device values (CommCPU/CommDevice analog)."""
+        """Sum a list of per-device values (CommCPU/CommDevice analog).
+        Row-sparse values reduce sparsely (ref: comm.h row-sparse reduce
+        paths at comm.h:226) and stay sparse for the updater. Returns either
+        a BaseSparseNDArray or a raw jnp array — never an NDArray."""
+        from .ndarray.sparse import BaseSparseNDArray, add_n
+
         if not isinstance(value, (list, tuple)):
-            return _to_data(value)
+            return value if isinstance(value, BaseSparseNDArray) else _to_data(value)
+        if any(isinstance(v, BaseSparseNDArray) for v in value):
+            r = add_n(*value)  # dense NDArray when any operand densifies
+            return r if isinstance(r, BaseSparseNDArray) else r._data
         acc = _to_data(value[0])
         for v in value[1:]:
             acc = acc + _to_data(v)
         return acc
 
+    def _apply_sparse_push(self, key, grad):
+        """Shared sparse-gradient apply: updater sees the sparse grad so the
+        lazy/sparse optimizer paths engage; compression doesn't apply
+        (reference falls back to uncompressed for sparse too)."""
+        if self._updater is not None:
+            self._updater(_key_int(key), grad, self._store[key])
+        else:
+            dense = grad.todense()
+            if key in self._store:
+                self._store[key]._data = self._store[key]._data + dense._data
+            else:
+                self._store[key] = dense
+
     def push(self, key, value, priority=0):
         """(ref: KVStore::Push) — aggregate + optionally run updater."""
+        from .ndarray.sparse import BaseSparseNDArray
+
         if isinstance(key, (list, tuple)):
             for k, v in zip(key, value):
                 self.push(k, v, priority)
             return
         grad = self._reduce(value)
+        if isinstance(grad, BaseSparseNDArray):
+            self._apply_sparse_push(key, grad)
+            return
         if self._compression is not None:
             grad = self._compression.roundtrip(key, grad)
         if self._updater is not None:
@@ -268,12 +294,50 @@ class KVStoreDist(KVStore):
             return 0
         return self._heartbeat.num_dead()
 
+    def _allreduce_row_sparse(self, grad):
+        """Cross-worker row_sparse sum: only (row_id, row) pairs cross DCN
+        (ref: DataHandleRowSparse kvstore_dist_server.h:499). Ragged nnz is
+        padded to the cross-worker max so the allgather has a fixed shape;
+        pad rows carry index -1 and are dropped on receive."""
+        import numpy as _np
+        from jax.experimental import multihost_utils
+        from .ndarray.sparse import RowSparseNDArray, add_n
+        from .ndarray.ndarray import NDArray as _ND
+
+        idx = _np.asarray(grad.indices.asnumpy(), _np.int64)
+        dat = _np.asarray(grad.data.asnumpy())
+        nnz = _np.asarray([idx.shape[0]], _np.int64)
+        max_nnz = int(multihost_utils.process_allgather(nnz).max())
+        width = dat.shape[1:]
+        pidx = _np.full((max_nnz,), -1, _np.int64)
+        pdat = _np.zeros((max_nnz,) + width, dat.dtype)
+        pidx[: idx.shape[0]] = idx
+        pdat[: idx.shape[0]] = dat
+        all_idx = multihost_utils.process_allgather(pidx)   # (W, max_nnz)
+        all_dat = multihost_utils.process_allgather(pdat)   # (W, max_nnz, ...)
+        parts = []
+        for w in range(all_idx.shape[0]):
+            keep = _np.asarray(all_idx[w]) >= 0
+            parts.append(RowSparseNDArray(
+                _ND(_np.asarray(all_dat[w])[keep]),
+                _ND(_np.asarray(all_idx[w])[keep]), grad.shape))
+        return add_n(*parts)
+
     def push(self, key, value, priority=0):
+        from .ndarray.sparse import BaseSparseNDArray, RowSparseNDArray
+
         if isinstance(key, (list, tuple)):
             for k, v in zip(key, value):
                 self.push(k, v, priority)
             return
         grad = self._reduce(value)
+        if isinstance(grad, BaseSparseNDArray):
+            if self.num_workers > 1:
+                if not isinstance(grad, RowSparseNDArray):
+                    grad = grad.tostype("row_sparse")
+                grad = self._allreduce_row_sparse(grad)
+            self._apply_sparse_push(key, grad)
+            return
         if self.num_workers > 1:
             import numpy as _np
             from jax.experimental import multihost_utils
@@ -330,11 +394,21 @@ class KVStoreDistAsync(KVStoreDist):
         self._push_counts = {}
 
     def push(self, key, value, priority=0):
+        from .ndarray.sparse import BaseSparseNDArray
+
         if isinstance(key, (list, tuple)):
             for k, v in zip(key, value):
                 self.push(k, v, priority)
             return
         grad = self._reduce(value)
+        if isinstance(grad, BaseSparseNDArray):
+            # async sparse: apply locally; consensus happens at mix points
+            self._apply_sparse_push(key, grad)
+            c = self._push_counts.get(key, 0) + 1
+            self._push_counts[key] = c
+            if self.num_workers > 1 and c % self._period == 0:
+                self._mix(key)
+            return
         if self._compression is not None:
             grad = self._compression.roundtrip(key, grad)
         # local apply — no cross-worker communication on the hot path

@@ -1,27 +1,46 @@
-"""Sparse NDArray storage types (ref: python/mxnet/ndarray/sparse.py,
-src/ndarray/ndarray.cc kRowSparseStorage/kCSRStorage).
+"""Sparse NDArray storage types + sparse operators
+(ref: python/mxnet/ndarray/sparse.py CSRNDArray:287/RowSparseNDArray:561;
+src/ndarray/ndarray.cc kRowSparseStorage/kCSRStorage;
+src/operator/tensor/dot-inl.h sparse dot kernels;
+src/operator/tensor/sparse_retain-inl.h).
 
 TPU-native stance: XLA has no first-class sparse tensors, so sparse storage
-is a *host-side format* (index + value arrays) used for communication and
-embedding-style workloads; compute materializes via gather/scatter, which XLA
-lowers efficiently. Round 1 covers construction, conversion, elementwise and
-dot paths used by the kvstore row_sparse protocol.
+is an (index, value) host-visible format whose COMPUTE lowers to the three
+primitives XLA/TPU handles well — gather, dense matmul on the gathered
+block, and `segment_sum` scatter-reduction. A CSR x dense matmul is:
+
+    rows  = searchsorted(indptr, arange(nnz))          # nnz -> row ids
+    prod  = data[:, None] * dense[col_indices]         # gather + multiply
+    out   = segment_sum(prod, rows, num_segments=m)    # fused scatter-add
+
+All kernels have static shapes (nnz is a compile-time constant per batch
+signature), so they jit cleanly. Index-set algebra (unions, uniqueness) is
+data-dependent and stays on host — sparse arrays are an eager/communication
+format here; the hot training path remains dense XLA programs.
 """
 from __future__ import annotations
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from .ndarray import NDArray
 
 __all__ = [
+    "BaseSparseNDArray",
     "RowSparseNDArray",
     "CSRNDArray",
     "row_sparse_array",
     "csr_matrix",
     "cast_storage",
     "zeros",
+    "retain",
+    "dot",
+    "add",
+    "subtract",
+    "multiply",
+    "add_n",
 ]
 
 
@@ -37,10 +56,24 @@ class BaseSparseNDArray:
     def wait_to_read(self):
         self.data.wait_to_read()
 
+    # sparse arrays share the dense save/load container via densification
+    # markers; see ndarray/utils.py for the container format.
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    __radd__ = __add__
+    __rmul__ = __mul__
+
 
 class RowSparseNDArray(BaseSparseNDArray):
     """Rows at `indices` hold `data`; other rows are zero
-    (ref: ndarray.h kRowSparseStorage)."""
+    (ref: ndarray.h kRowSparseStorage, python RowSparseNDArray:561)."""
 
     stype = "row_sparse"
 
@@ -48,6 +81,10 @@ class RowSparseNDArray(BaseSparseNDArray):
         self.data = data if isinstance(data, NDArray) else NDArray(data)
         self.indices = indices if isinstance(indices, NDArray) else NDArray(indices, dtype="int64")
         self.shape = tuple(shape)
+
+    @property
+    def nnz(self):
+        return int(self.indices.shape[0])
 
     def tostype(self, stype):
         if stype == "row_sparse":
@@ -67,12 +104,31 @@ class RowSparseNDArray(BaseSparseNDArray):
     def copyto(self, other):
         return self.todense().copyto(other)
 
+    def copy(self):
+        return RowSparseNDArray(
+            NDArray(self.data._data), NDArray(self.indices._data), self.shape)
+
+    def check_format(self, full_check=True):
+        """(ref: CheckFormat for kRowSparseStorage) — indices strictly
+        ascending, in range, matching data rows."""
+        idx = self.indices.asnumpy()
+        if idx.shape[0] != self.data.shape[0]:
+            raise ValueError("indices/data length mismatch")
+        if idx.size and (np.any(np.diff(idx) <= 0)):
+            raise ValueError("row_sparse indices must be strictly ascending")
+        if idx.size and (idx[0] < 0 or idx[-1] >= self.shape[0]):
+            raise ValueError("row index out of range")
+
+    def retain(self, indices):
+        return retain(self, indices)
+
     def __repr__(self):
         return f"<RowSparseNDArray {'x'.join(map(str, self.shape))} nnz_rows={self.indices.shape[0]}>"
 
 
 class CSRNDArray(BaseSparseNDArray):
-    """Compressed sparse row matrix (ref: ndarray.h kCSRStorage)."""
+    """Compressed sparse row matrix (ref: ndarray.h kCSRStorage,
+    python CSRNDArray:287)."""
 
     stype = "csr"
 
@@ -82,26 +138,212 @@ class CSRNDArray(BaseSparseNDArray):
         self.indices = indices if isinstance(indices, NDArray) else NDArray(indices, dtype="int64")
         self.shape = tuple(shape)
 
+    @property
+    def nnz(self):
+        return int(self.data.shape[0])
+
     def tostype(self, stype):
         if stype == "csr":
             return self
         if stype == "default":
             return self.todense()
+        if stype == "row_sparse":
+            return row_sparse_array(self.todense())
         raise ValueError(stype)
 
     def todense(self) -> NDArray:
-        import scipy.sparse as sp  # host-side conversion
-
-        m = sp.csr_matrix(
-            (self.data.asnumpy(), self.indices.asnumpy(), self.indptr.asnumpy()), shape=self.shape
-        )
-        return NDArray(jnp.asarray(m.toarray()))
+        dense = _csr_to_dense(self.data._data, self.indices._data,
+                              self.indptr._data, self.shape)
+        return NDArray._from_data(dense)
 
     def asnumpy(self):
         return self.todense().asnumpy()
 
+    def copy(self):
+        return CSRNDArray(NDArray(self.data._data), NDArray(self.indptr._data),
+                          NDArray(self.indices._data), self.shape)
+
+    def check_format(self, full_check=True):
+        """(ref: CheckFormat for kCSRStorage)."""
+        indptr = self.indptr.asnumpy()
+        idx = self.indices.asnumpy()
+        if indptr.shape[0] != self.shape[0] + 1:
+            raise ValueError("indptr length must be rows+1")
+        if indptr[0] != 0 or indptr[-1] != idx.shape[0]:
+            raise ValueError("indptr endpoints invalid")
+        if np.any(np.diff(indptr) < 0):
+            raise ValueError("indptr must be non-decreasing")
+        if idx.size and (idx.min() < 0 or idx.max() >= self.shape[1]):
+            raise ValueError("column index out of range")
+
+    def __getitem__(self, key):
+        """Row slicing (ref: CSRNDArray.__getitem__) — returns CSR."""
+        if isinstance(key, int):
+            if key < 0:
+                key += self.shape[0]
+            if not 0 <= key < self.shape[0]:
+                raise IndexError(f"row {key} out of range")
+            key = slice(key, key + 1)
+        if not isinstance(key, slice):
+            raise TypeError("CSR supports int/slice row indexing")
+        start, stop, stride = key.indices(self.shape[0])
+        if stride != 1:
+            raise ValueError("CSR slicing requires step 1")
+        stop = max(stop, start)  # empty (not negative-shaped) for stop<start
+        indptr = self.indptr.asnumpy()
+        lo, hi = int(indptr[start]), int(indptr[stop])
+        return CSRNDArray(
+            NDArray(self.data.asnumpy()[lo:hi]),
+            NDArray((indptr[start:stop + 1] - lo).astype(np.int64)),
+            NDArray(self.indices.asnumpy()[lo:hi]),
+            (stop - start, self.shape[1]),
+        )
+
     def __repr__(self):
         return f"<CSRNDArray {'x'.join(map(str, self.shape))} nnz={self.data.shape[0]}>"
+
+
+# ---------------------------------------------------------------------------
+# jittable sparse kernels (gather + segment_sum formulation)
+# ---------------------------------------------------------------------------
+
+
+def _row_ids_from_indptr(indptr, nnz):
+    """Per-nonzero row ids from a CSR indptr: rows[j] = the row containing
+    nonzero j. searchsorted keeps this jittable with static nnz."""
+    return (jnp.searchsorted(indptr, jnp.arange(nnz, dtype=indptr.dtype),
+                             side="right") - 1).astype(jnp.int32)
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnums=3)
+def _csr_to_dense(data, indices, indptr, shape):
+    rows = _row_ids_from_indptr(indptr, data.shape[0])
+    out = jnp.zeros(shape, dtype=data.dtype)
+    return out.at[rows, indices.astype(jnp.int32)].add(data)
+
+
+def _csr_dot_dense(data, indices, indptr, rhs, m):
+    """CSR(m,k) x dense(k,n) -> dense(m,n). MXU-adjacent formulation:
+    gather rhs rows by column index, scale, segment-sum by row."""
+    rows = _row_ids_from_indptr(indptr, data.shape[0])
+    gathered = rhs[indices.astype(jnp.int32)]          # (nnz, n)
+    prod = data.reshape(-1, *([1] * (rhs.ndim - 1))) * gathered
+    return jax.ops.segment_sum(prod, rows, num_segments=m)
+
+
+def _csr_T_dot_dense(data, indices, indptr, rhs, k):
+    """CSR(m,k)^T x dense(m,n) -> dense(k,n): scatter-add into columns."""
+    rows = _row_ids_from_indptr(indptr, data.shape[0])
+    gathered = rhs[rows]                                # (nnz, n)
+    prod = data.reshape(-1, *([1] * (rhs.ndim - 1))) * gathered
+    return jax.ops.segment_sum(prod, indices.astype(jnp.int32), num_segments=k)
+
+
+def dot(lhs, rhs, transpose_a=False):
+    """Sparse dot (ref: src/operator/tensor/dot-inl.h; python sparse.dot).
+
+    Supported, mirroring the reference's storage-inference table:
+      dot(csr, dense)            -> dense     (SpMM)
+      dot(csr, dense, T_a=True)  -> row_sparse (rows = touched columns)
+      dot(dense, row_sparse)     -> dense     (gathered column block)
+      dot(rsp/csr-as-dense, ...) -> dense fallbacks via todense()
+    """
+    if isinstance(lhs, CSRNDArray):
+        r = rhs._data if isinstance(rhs, NDArray) else rhs.todense()._data
+        if not transpose_a:
+            out = _csr_dot_dense(lhs.data._data, lhs.indices._data,
+                                 lhs.indptr._data, r, lhs.shape[0])
+            return NDArray._from_data(out)
+        dense_out = _csr_T_dot_dense(lhs.data._data, lhs.indices._data,
+                                     lhs.indptr._data, r, lhs.shape[1])
+        # output rows = columns touched by any nonzero — data-dependent,
+        # resolved on host (eager), as the reference's FInferStorageType
+        # does when it emits kRowSparseStorage for csr^T . dense
+        cols = np.unique(np.asarray(lhs.indices.asnumpy(), dtype=np.int64))
+        return RowSparseNDArray(
+            NDArray(jnp.take(dense_out, jnp.asarray(cols), axis=0)),
+            NDArray(cols), (lhs.shape[1],) + tuple(dense_out.shape[1:]))
+    if isinstance(lhs, NDArray) and isinstance(rhs, RowSparseNDArray):
+        if transpose_a:
+            raise NotImplementedError("dot(dense^T, rsp) unsupported")
+        # (m,k) x rsp(k,n): only stored rows of rhs contribute
+        idx = rhs.indices._data.astype(jnp.int32)
+        cols = jnp.take(lhs._data, idx, axis=1)          # (m, nnz_rows)
+        return NDArray._from_data(cols @ rhs.data._data)
+    if isinstance(lhs, RowSparseNDArray):
+        return NDArray._from_data(
+            (lhs.todense()._data.T if transpose_a else lhs.todense()._data)
+            @ (rhs._data if isinstance(rhs, NDArray) else rhs.todense()._data))
+    raise TypeError(f"unsupported sparse dot: {type(lhs)} x {type(rhs)}")
+
+
+# ---------------------------------------------------------------------------
+# elementwise (index-set algebra on host, value compute in jnp)
+# ---------------------------------------------------------------------------
+
+
+def _rsp_binary(lhs: RowSparseNDArray, rhs: RowSparseNDArray, fn):
+    assert lhs.shape == rhs.shape, (lhs.shape, rhs.shape)
+    li, ri = lhs.indices.asnumpy(), rhs.indices.asnumpy()
+    union = np.union1d(li, ri).astype(np.int64)
+    # union1d output is sorted, so positions are a vectorized searchsorted
+    width = lhs.data._data.shape[1:]
+    lfull = jnp.zeros((len(union),) + width, lhs.data._data.dtype)
+    rfull = jnp.zeros((len(union),) + width, rhs.data._data.dtype)
+    if li.size:
+        lfull = lfull.at[jnp.asarray(np.searchsorted(union, li))].set(lhs.data._data)
+    if ri.size:
+        rfull = rfull.at[jnp.asarray(np.searchsorted(union, ri))].set(rhs.data._data)
+    return RowSparseNDArray(NDArray(fn(lfull, rfull)), NDArray(union), lhs.shape)
+
+
+def add(lhs, rhs):
+    """elemwise_add with sparse storage (ref: elemwise_binary_op_basic.cc)."""
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        return _rsp_binary(lhs, rhs, jnp.add)
+    return _dense_fallback(lhs, rhs, jnp.add)
+
+
+def subtract(lhs, rhs):
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        return _rsp_binary(lhs, rhs, jnp.subtract)
+    return _dense_fallback(lhs, rhs, jnp.subtract)
+
+
+def multiply(lhs, rhs):
+    if isinstance(lhs, BaseSparseNDArray) and np.isscalar(rhs):
+        out = lhs.copy()
+        out.data._data = out.data._data * rhs
+        return out
+    if isinstance(rhs, BaseSparseNDArray) and np.isscalar(lhs):
+        return multiply(rhs, lhs)
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        return _rsp_binary(lhs, rhs, jnp.multiply)
+    return _dense_fallback(lhs, rhs, jnp.multiply)
+
+
+def _dense_fallback(lhs, rhs, fn):
+    l = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    r = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    ld = l._data if isinstance(l, NDArray) else jnp.asarray(l)
+    rd = r._data if isinstance(r, NDArray) else jnp.asarray(r)
+    return NDArray._from_data(fn(ld, rd))
+
+
+def add_n(*arrs):
+    """Sum of sparse/dense arrays (ref: ElemwiseSum sparse path)."""
+    acc = arrs[0]
+    for a in arrs[1:]:
+        acc = add(acc, a)
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# constructors / conversion
+# ---------------------------------------------------------------------------
 
 
 def row_sparse_array(arg, shape=None, ctx=None, dtype=None):
@@ -115,38 +357,44 @@ def row_sparse_array(arg, shape=None, ctx=None, dtype=None):
 
 
 def csr_matrix(arg, shape=None, ctx=None, dtype=None):
-    import scipy.sparse as sp
-
     if isinstance(arg, tuple) and len(arg) == 3:
         data, indices, indptr = arg
         return CSRNDArray(NDArray(np.asarray(data)), NDArray(np.asarray(indptr, dtype=np.int64)),
                           NDArray(np.asarray(indices, dtype=np.int64)), shape)
     dense = np.asarray(arg.asnumpy() if isinstance(arg, NDArray) else arg)
-    m = sp.csr_matrix(dense)
-    return CSRNDArray(NDArray(m.data), NDArray(m.indptr.astype(np.int64)),
-                      NDArray(m.indices.astype(np.int64)), dense.shape)
+    if dense.ndim != 2:
+        raise ValueError("csr_matrix needs a 2-D input")
+    # dense -> CSR without scipy: row-major scan of the nonzero pattern
+    rows, cols = np.nonzero(dense)
+    data = dense[rows, cols]
+    indptr = np.zeros(dense.shape[0] + 1, np.int64)
+    np.add.at(indptr, rows + 1, 1)
+    indptr = np.cumsum(indptr)
+    return CSRNDArray(NDArray(data), NDArray(indptr),
+                      NDArray(cols.astype(np.int64)), dense.shape)
 
 
 def cast_storage(arr, stype):
+    """(ref: src/operator/tensor/cast_storage-inl.h)."""
     if stype == "default":
         return arr.todense() if isinstance(arr, BaseSparseNDArray) else arr
     if stype == "row_sparse":
-        return row_sparse_array(arr)
+        return arr if isinstance(arr, RowSparseNDArray) else row_sparse_array(arr)
     if stype == "csr":
-        return csr_matrix(arr)
+        return arr if isinstance(arr, CSRNDArray) else csr_matrix(arr)
     raise ValueError(stype)
 
 
 def zeros(stype, shape, ctx=None, dtype="float32"):
     if stype == "row_sparse":
         return RowSparseNDArray(
-            NDArray(np.zeros((0,) + tuple(shape[1:]), dtype=np.float32)),
+            NDArray(np.zeros((0,) + tuple(shape[1:]), dtype=dtype)),
             NDArray(np.zeros((0,), dtype=np.int64)),
             shape,
         )
     if stype == "csr":
         return CSRNDArray(
-            NDArray(np.zeros((0,), dtype=np.float32)),
+            NDArray(np.zeros((0,), dtype=dtype)),
             NDArray(np.zeros((shape[0] + 1,), dtype=np.int64)),
             NDArray(np.zeros((0,), dtype=np.int64)),
             shape,
@@ -156,7 +404,7 @@ def zeros(stype, shape, ctx=None, dtype="float32"):
 
 def retain(rsp: RowSparseNDArray, indices) -> RowSparseNDArray:
     """Keep only the given rows of a row_sparse array
-    (ref: sparse_retain op)."""
+    (ref: src/operator/tensor/sparse_retain-inl.h)."""
     want = np.asarray(indices.asnumpy() if isinstance(indices, NDArray) else indices).astype(np.int64)
     have = rsp.indices.asnumpy()
     mask = np.isin(have, want)

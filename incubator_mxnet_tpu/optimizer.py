@@ -148,6 +148,23 @@ def _writeback(targets, results):
         t._data = r._data
 
 
+def _is_row_sparse(grad):
+    from .ndarray.sparse import RowSparseNDArray
+
+    return isinstance(grad, RowSparseNDArray)
+
+
+def _sparse_grad_prep(opt, grad):
+    """Rows + rescaled/clipped per-row gradient block for a lazy update
+    (ref: optimizer_op-inl.h SGDUpdateRspImpl lazy_update path: only rows
+    present in the row_sparse gradient are touched)."""
+    rows = grad.indices._data.astype(jnp.int32)
+    g = grad.data._data * opt.rescale_grad
+    if opt.clip_gradient:
+        g = jnp.clip(g, -opt.clip_gradient, opt.clip_gradient)
+    return rows, g
+
+
 @register
 class SGD(Optimizer):
     """(ref: optimizer.py:511 SGD, with momentum + multi-precision)"""
@@ -165,6 +182,22 @@ class SGD(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         attrs = self._common_attrs(index)
+        if _is_row_sparse(grad):
+            if not self.lazy_update:
+                grad = grad.todense()
+            else:
+                lr, wd = attrs["lr"], attrs["wd"]
+                rows, g = _sparse_grad_prep(self, grad)
+                w = weight._data
+                g = g + wd * w[rows]
+                if state is not None:
+                    m = state._data
+                    m_rows = self.momentum * m[rows] - lr * g
+                    state._data = m.at[rows].set(m_rows)
+                    weight._data = w.at[rows].add(m_rows)
+                else:
+                    weight._data = w.at[rows].add(-lr * g)
+                return
         if state is not None:
             _writeback([weight, state], _call("sgd_mom_update", [weight, grad, state],
                                               {**attrs, "momentum": self.momentum}))
@@ -321,6 +354,7 @@ class Adam(Optimizer):
                  lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         dt = str(weight.dtype)
@@ -334,6 +368,24 @@ class Adam(Optimizer):
         coef2 = 1.0 - self.beta2 ** t
         attrs["lr"] = attrs["lr"] * math.sqrt(coef2) / coef1
         mean, var = state
+        if _is_row_sparse(grad):
+            if not self.lazy_update:
+                grad = grad.todense()
+            else:
+                # lazy Adam (ref: AdamUpdateRspImpl): moments + weight touched
+                # only at the gradient's rows
+                lr, wd = attrs["lr"], attrs["wd"]
+                rows, g = _sparse_grad_prep(self, grad)
+                w = weight._data
+                g = g + wd * w[rows]
+                m_rows = self.beta1 * mean._data[rows] + (1 - self.beta1) * g
+                v_rows = (self.beta2 * var._data[rows]
+                          + (1 - self.beta2) * jnp.square(g))
+                mean._data = mean._data.at[rows].set(m_rows)
+                var._data = var._data.at[rows].set(v_rows)
+                weight._data = w.at[rows].add(
+                    -lr * m_rows / (jnp.sqrt(v_rows) + self.epsilon))
+                return
         _writeback([weight, mean, var], _call(
             "adam_update", [weight, grad, mean, var],
             {**attrs, "beta1": self.beta1, "beta2": self.beta2, "epsilon": self.epsilon},
@@ -352,6 +404,16 @@ class AdaGrad(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
+        if _is_row_sparse(grad):
+            # sparse AdaGrad (ref: AdagradUpdateRspImpl): history + weight
+            # touched only at the gradient's rows
+            rows, g = _sparse_grad_prep(self, grad)
+            g = g + wd * weight._data[rows]
+            h_rows = state._data[rows] + jnp.square(g)
+            state._data = state._data.at[rows].set(h_rows)
+            weight._data = weight._data.at[rows].add(
+                -lr * g / (jnp.sqrt(h_rows) + self.float_stable_eps))
+            return
         g = grad._data * self.rescale_grad
         if self.clip_gradient:
             g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)

@@ -59,6 +59,39 @@ def main():
     for r in range(nw):
         np.testing.assert_allclose(np.asarray(gathered[r]), expect, rtol=1e-5)
 
+    # --- row_sparse push: only (row, data) pairs cross the wire ---
+    from incubator_mxnet_tpu.ndarray import sparse
+
+    kv3 = kvstore.create("dist_sync")
+    kv3.init("emb", nd.zeros((6, 2)))
+    # each rank touches a different overlapping row set
+    rows = np.array([rank, rank + 2], np.int64)
+    g = sparse.RowSparseNDArray(
+        nd.array(np.ones((2, 2), np.float32) * (rank + 1)),
+        nd.array(rows), (6, 2))
+    kv3.push("emb", g)
+    out3 = nd.zeros((6, 2))
+    kv3.pull("emb", out=out3)
+    expect3 = np.zeros((6, 2), np.float32)
+    for r in range(nw):
+        expect3[[r, r + 2]] += (r + 1)
+    np.testing.assert_allclose(out3.asnumpy(), expect3, rtol=1e-6)
+    kv3.barrier()
+
+    # --- 2-bit wire compression: error feedback converges the sum ---
+    kv4 = kvstore.create("dist_sync")
+    kv4.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv4.init("c", nd.zeros((4,)))
+    total = np.zeros(4, np.float32)
+    for _ in range(10):
+        kv4.push("c", nd.ones((4,)) * 0.2)
+        oc = nd.zeros((4,))
+        kv4.pull("c", out=oc)
+        total = oc.asnumpy()
+    # 10 pushes of 0.2 from each of nw workers = 2.0 * nw, within one quantum
+    np.testing.assert_allclose(total, 2.0 * nw, atol=0.5 * nw + 1e-6)
+    kv4.barrier()
+
     print(f"rank {rank}/{nw}: dist_sync_kvstore OK")
 
 

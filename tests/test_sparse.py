@@ -1,0 +1,264 @@
+"""Sparse NDArray + operator + optimizer tests
+(ref: tests/python/unittest/test_sparse_ndarray.py, test_sparse_operator.py,
+tests/python/unittest/test_optimizer.py sparse paths)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.ndarray import sparse
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _rand_sparse_dense(m, k, density=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    dense = rng.rand(m, k) * (rng.rand(m, k) < density)
+    return dense.astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# storage formats
+# ---------------------------------------------------------------------------
+
+
+def test_csr_roundtrip_and_format():
+    dense = _rand_sparse_dense(7, 5)
+    csr = sparse.csr_matrix(dense)
+    csr.check_format()
+    assert csr.stype == "csr"
+    assert csr.nnz == int((dense != 0).sum())
+    assert_almost_equal(csr.asnumpy(), dense)
+
+
+def test_rsp_roundtrip_and_format():
+    dense = np.zeros((6, 4), np.float32)
+    dense[1] = 1.0
+    dense[4] = 2.0
+    rsp = sparse.row_sparse_array(dense)
+    rsp.check_format()
+    assert rsp.stype == "row_sparse"
+    assert rsp.nnz == 2
+    assert_almost_equal(rsp.asnumpy(), dense)
+
+
+def test_cast_storage():
+    dense = _rand_sparse_dense(5, 5)
+    d = nd.array(dense)
+    csr = sparse.cast_storage(d, "csr")
+    rsp = sparse.cast_storage(d, "row_sparse")
+    back = sparse.cast_storage(csr, "default")
+    assert_almost_equal(back.asnumpy(), dense)
+    assert_almost_equal(rsp.asnumpy(), dense)
+    assert sparse.cast_storage(csr, "csr") is csr
+
+
+def test_csr_row_slice():
+    dense = _rand_sparse_dense(8, 6)
+    csr = sparse.csr_matrix(dense)
+    assert_almost_equal(csr[2:6].asnumpy(), dense[2:6])
+    assert_almost_equal(csr[3].asnumpy(), dense[3:4])
+
+
+def test_zeros_and_retain():
+    z = sparse.zeros("row_sparse", (4, 3))
+    assert z.nnz == 0 and (z.asnumpy() == 0).all()
+    zc = sparse.zeros("csr", (4, 3))
+    assert (zc.asnumpy() == 0).all()
+    rsp = sparse.RowSparseNDArray(nd.array(np.ones((3, 2), np.float32)),
+                                  nd.array(np.array([0, 2, 4])), (6, 2))
+    kept = sparse.retain(rsp, nd.array([2, 4]))
+    assert list(kept.indices.asnumpy()) == [2, 4]
+    assert kept.asnumpy()[0].sum() == 0
+
+
+def test_check_format_rejects_bad():
+    with pytest.raises(ValueError):
+        sparse.RowSparseNDArray(nd.array(np.ones((2, 2), np.float32)),
+                                nd.array(np.array([3, 1])), (5, 2)).check_format()
+    with pytest.raises(ValueError):
+        sparse.CSRNDArray(nd.array(np.ones(2, dtype=np.float32)),
+                          nd.array(np.array([0, 1, 1])),  # wrong endpoint
+                          nd.array(np.array([0, 1])), (2, 3)).check_format()
+
+
+# ---------------------------------------------------------------------------
+# sparse dot (ref: dot-inl.h)
+# ---------------------------------------------------------------------------
+
+
+def test_dot_csr_dense():
+    dense = _rand_sparse_dense(9, 7)
+    rhs = np.random.RandomState(1).rand(7, 4).astype(np.float32)
+    out = sparse.dot(sparse.csr_matrix(dense), nd.array(rhs))
+    assert_almost_equal(out.asnumpy(), dense @ rhs, rtol=1e-5)
+
+
+def test_dot_csr_T_dense_returns_row_sparse():
+    dense = _rand_sparse_dense(9, 7, density=0.2)
+    rhs = np.random.RandomState(1).rand(9, 4).astype(np.float32)
+    out = sparse.dot(sparse.csr_matrix(dense), nd.array(rhs), transpose_a=True)
+    assert isinstance(out, sparse.RowSparseNDArray)
+    assert_almost_equal(out.asnumpy(), dense.T @ rhs, rtol=1e-5)
+    # only touched columns are stored
+    touched = np.unique(np.nonzero(dense)[1])
+    assert list(out.indices.asnumpy()) == list(touched)
+
+
+def test_dot_dense_rsp():
+    dense = _rand_sparse_dense(6, 5)
+    rsp = sparse.row_sparse_array(dense)
+    lhs = np.random.RandomState(2).rand(3, 6).astype(np.float32)
+    out = sparse.dot(nd.array(lhs), rsp)
+    assert_almost_equal(out.asnumpy(), lhs @ dense, rtol=1e-5)
+
+
+def test_sparse_elemwise():
+    a = _rand_sparse_dense(5, 3, seed=3)
+    b = _rand_sparse_dense(5, 3, seed=4)
+    ra, rb = sparse.row_sparse_array(a), sparse.row_sparse_array(b)
+    assert_almost_equal(sparse.add(ra, rb).asnumpy(), a + b, rtol=1e-6)
+    assert_almost_equal(sparse.subtract(ra, rb).asnumpy(), a - b, rtol=1e-6)
+    assert_almost_equal(sparse.multiply(ra, rb).asnumpy(), a * b, rtol=1e-6)
+    assert_almost_equal((ra * 2.0).asnumpy(), a * 2, rtol=1e-6)
+    assert_almost_equal((ra + rb).asnumpy(), a + b, rtol=1e-6)
+    assert_almost_equal(sparse.add_n(ra, rb, ra).asnumpy(), a + b + a, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# sparse optimizer updates (ref: optimizer_op-inl.h *RspImpl lazy paths)
+# ---------------------------------------------------------------------------
+
+
+def _row_sparse_grad(rows, width, total, seed=0):
+    rng = np.random.RandomState(seed)
+    return sparse.RowSparseNDArray(
+        nd.array(rng.rand(len(rows), width).astype(np.float32)),
+        nd.array(np.array(rows)), (total, width))
+
+
+@pytest.mark.parametrize("make_opt", [
+    lambda: mx.optimizer.SGD(learning_rate=0.1),
+    lambda: mx.optimizer.SGD(learning_rate=0.1, momentum=0.9),
+    lambda: mx.optimizer.Adam(learning_rate=0.01),
+    lambda: mx.optimizer.AdaGrad(learning_rate=0.1),
+])
+def test_sparse_update_matches_dense(make_opt):
+    """Lazy sparse update on rows R == dense update restricted to rows R
+    (with zero gradient elsewhere having no effect for these optimizers on
+    the touched rows)."""
+    opt_s, opt_d = make_opt(), make_opt()
+    w_s = nd.array(np.ones((8, 3), np.float32))
+    w_d = nd.array(np.ones((8, 3), np.float32))
+    st_s = opt_s.create_state(0, w_s)
+    st_d = opt_d.create_state(0, w_d)
+    rows = [1, 4, 6]
+    g = _row_sparse_grad(rows, 3, 8, seed=7)
+    for _ in range(3):
+        opt_s.update(0, w_s, g, st_s)
+        opt_d.update(0, w_d, g.todense(), st_d)
+    ws, wd = w_s.asnumpy(), w_d.asnumpy()
+    # touched rows agree with the dense oracle
+    assert_almost_equal(ws[rows], wd[rows], rtol=1e-5, atol=1e-6)
+    # untouched rows never move under the lazy path
+    untouched = [r for r in range(8) if r not in rows]
+    assert (ws[untouched] == 1.0).all()
+
+
+def test_sparse_sgd_non_lazy_densifies():
+    opt = mx.optimizer.SGD(learning_rate=0.1, lazy_update=False, wd=0.1)
+    w = nd.array(np.ones((4, 2), np.float32))
+    g = _row_sparse_grad([1], 2, 4)
+    opt.update(0, w, g, None)
+    # non-lazy: weight decay applies to ALL rows
+    assert (w.asnumpy()[0] != 1.0).all()
+
+
+# ---------------------------------------------------------------------------
+# kvstore sparse paths (ref: kvstore row_sparse protocol)
+# ---------------------------------------------------------------------------
+
+
+def test_kvstore_sparse_push_updater():
+    from incubator_mxnet_tpu import kvstore, optimizer as opt
+
+    kv = kvstore.create("local")
+    kv.set_optimizer(opt.SGD(learning_rate=1.0, rescale_grad=1.0))
+    kv.init("emb", nd.array(np.zeros((6, 2), np.float32)))
+    g = sparse.RowSparseNDArray(nd.array(np.ones((2, 2), np.float32)),
+                                nd.array(np.array([1, 3])), (6, 2))
+    kv.push("emb", g)
+    out = nd.zeros((6, 2))
+    kv.pull("emb", out=out)
+    o = out.asnumpy()
+    assert (o[[1, 3]] == -1.0).all() and (o[[0, 2, 4, 5]] == 0).all()
+
+
+def test_kvstore_sparse_reduce_list():
+    from incubator_mxnet_tpu import kvstore
+
+    kv = kvstore.create("local")
+    kv.init("e", nd.array(np.zeros((4, 2), np.float32)))
+    g1 = sparse.RowSparseNDArray(nd.array(np.ones((1, 2), np.float32)),
+                                 nd.array(np.array([0])), (4, 2))
+    g2 = sparse.RowSparseNDArray(nd.array(np.ones((1, 2), np.float32) * 2),
+                                 nd.array(np.array([2])), (4, 2))
+    kv.push("e", [g1, g2])
+    out = nd.zeros((4, 2))
+    kv.pull("e", out=out)
+    o = out.asnumpy()
+    assert (o[0] == 1).all() and (o[2] == 2).all() and (o[1] == 0).all()
+
+
+def test_kvstore_row_sparse_pull_roundtrip():
+    from incubator_mxnet_tpu import kvstore
+
+    kv = kvstore.create("local")
+    table = np.arange(12, dtype=np.float32).reshape(6, 2)
+    kv.init("emb", nd.array(table))
+    out = sparse.zeros("row_sparse", (6, 2))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array([1, 4]))
+    assert_almost_equal(out.todense().asnumpy()[[1, 4]], table[[1, 4]])
+
+
+def test_sparse_linear_end_to_end(tmp_path):
+    """Miniature of examples/sparse_linear.py: LibSVM -> CSR batches ->
+    SpMM forward -> row_sparse grads -> sparse AdaGrad -> learns."""
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
+    import sparse_linear as ex
+    from incubator_mxnet_tpu import kvstore
+    from incubator_mxnet_tpu.io import LibSVMIter
+
+    path = str(tmp_path / "tiny.libsvm")
+    ex.make_synthetic_libsvm(path, n=600, nfeat=120, nnz=8, seed=1)
+    it = LibSVMIter(data_libsvm=path, data_shape=(120,), batch_size=32)
+    kv = kvstore.create("local")
+    acc = ex.train_linear(it, 120, epochs=6, lr=0.5, optimizer="adagrad", kv=kv)
+    assert acc > 0.85, acc
+
+
+def test_kvstore_dist_degraded_sparse_push():
+    """dist_sync with one process (degrade-to-local) must handle sparse
+    pushes through the same updater path as local."""
+    from incubator_mxnet_tpu import kvstore, optimizer as opt
+
+    kv = kvstore.create("dist_sync")
+    assert kv.num_workers == 1
+    kv.set_optimizer(opt.SGD(learning_rate=1.0, rescale_grad=1.0))
+    kv.init("emb", nd.array(np.zeros((5, 2), np.float32)))
+    g = sparse.RowSparseNDArray(nd.array(np.ones((1, 2), np.float32)),
+                                nd.array(np.array([2])), (5, 2))
+    kv.push("emb", g)
+    out = nd.zeros((5, 2))
+    kv.pull("emb", out=out)
+    assert (out.asnumpy()[2] == -1.0).all()
+
+
+def test_csr_negative_and_reversed_slice():
+    dense = _rand_sparse_dense(4, 3)
+    csr = sparse.csr_matrix(dense)
+    assert_almost_equal(csr[-1].asnumpy(), dense[3:4])
+    empty = csr[3:1]
+    assert empty.shape == (0, 3)
+    with pytest.raises(IndexError):
+        csr[-9]
