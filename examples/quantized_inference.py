@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""INT8 quantized inference (ref: example/quantization/imagenet_gen_qsym.py
+flow: train/load fp32 model -> calibrate -> quantize -> compare accuracy).
+
+Trains LeNet on synthetic digits, quantizes with `contrib.quantization.
+quantize_net` (int8 conv/FC with int32 MXU accumulation), and reports
+fp32-vs-int8 accuracy and speed.
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import fused, gluon, nd
+from incubator_mxnet_tpu.contrib import quantization as q
+from incubator_mxnet_tpu.gluon import nn
+
+
+def make_data(n, rng):
+    """Class k = bright blob at grid position k on noisy background."""
+    y = rng.randint(0, 10, n)
+    x = rng.rand(n, 1, 28, 28).astype(np.float32) * 0.3
+    for i, k in enumerate(y):
+        r, c = divmod(k, 5)
+        x[i, 0, 4 + r * 12:12 + r * 12, 2 + c * 5:6 + c * 5] += 0.7
+    return x, y.astype(np.float32)
+
+
+def lenet():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(16, 5, activation="relu"))
+    net.add(nn.MaxPool2D(2))
+    net.add(nn.Conv2D(32, 5, activation="relu"))
+    net.add(nn.MaxPool2D(2))
+    net.add(nn.Flatten())
+    net.add(nn.Dense(64, activation="relu"))
+    net.add(nn.Dense(10))
+    return net
+
+
+class Batches:
+    def __init__(self, arrays):
+        self._arrays = arrays
+
+    def __iter__(self):
+        for a in self._arrays:
+            yield [nd.array(a)]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=6)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--calib-batches", type=int, default=4)
+    args = ap.parse_args()
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    xtr, ytr = make_data(1024, rng)
+    xte, yte = make_data(512, rng)
+
+    net = lenet()
+    net.initialize(mx.init.Xavier())
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    opt = mx.optimizer.Adam(learning_rate=3e-3,
+                            rescale_grad=1.0 / args.batch_size)
+    step = fused.GluonTrainStep(net, lambda n, a, b: L(n(a), b), opt)
+    bs = args.batch_size
+    for ep in range(args.num_epochs):
+        for i in range(0, len(xtr), bs):
+            loss = step(nd.array(xtr[i:i + bs]), nd.array(ytr[i:i + bs]))
+        print(f"epoch {ep} loss={float(loss.asscalar()):.4f}")
+    step.sync_params()
+
+    t0 = time.perf_counter()
+    f_logits = net(nd.array(xte)).asnumpy()
+    t_f = time.perf_counter() - t0
+    acc_f = (f_logits.argmax(1) == yte).mean()
+
+    calib = Batches([xtr[i:i + bs] for i in range(0, args.calib_batches * bs, bs)])
+    qnet = q.quantize_net(net, calib, num_calib_batches=args.calib_batches)
+    qnet(nd.array(xte[:8]))  # compile
+    t0 = time.perf_counter()
+    q_logits = qnet(nd.array(xte)).asnumpy()
+    t_q = time.perf_counter() - t0
+    acc_q = (q_logits.argmax(1) == yte).mean()
+
+    print(f"fp32 acc={acc_f:.4f} ({t_f*1e3:.1f} ms)  "
+          f"int8 acc={acc_q:.4f} ({t_q*1e3:.1f} ms)")
+    assert acc_f - acc_q <= 0.01, "int8 accuracy must be within 1% of fp32"
+    print("quantized inference OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -14,7 +14,7 @@ from .. import autograd
 from ..ndarray.ndarray import NDArray
 
 __all__ = ["quantize", "dequantize", "requantize", "calib_minmax",
-           "calib_entropy", "quantize_model"]
+           "calib_entropy", "quantize_model", "quantize_net", "QuantizedNet"]
 
 
 def quantize(data, min_range=None, max_range=None, out_type="int8"):
@@ -135,29 +135,361 @@ def calib_entropy(net_or_fn, calib_iter, num_batches=10, num_bins=2048,
         raise ValueError("calib_entropy: every calibration activation was "
                          "exactly zero — no threshold can be calibrated for "
                          "this layer (check the calibration data)")
-    amax = hi_range
-    edges = np.linspace(0, hi_range, num_bins + 1)
+    best_t = _kl_sweep(hist, hi_range, num_quantized_bins)
+    return -best_t, best_t
 
+
+def _smooth_distribution(d, eps=1e-4):
+    """Move a little mass onto zero bins so KL is finite
+    (ref: quantization.py _smooth_distribution)."""
+    is_zero = d == 0
+    n_zero = int(is_zero.sum())
+    n_nonzero = d.size - n_zero
+    if n_nonzero == 0:
+        return None
+    eps1 = eps * n_zero / n_nonzero
+    out = d.astype(np.float64).copy()
+    out[~is_zero] -= eps1
+    out[is_zero] = eps
+    if (out < 0).any():
+        return None
+    return out
+
+
+def _kl_sweep(hist, amax, num_quantized_bins=255):
+    """Pick the clipping threshold minimizing KL(p || quantized p) over a
+    |activation| histogram covering [0, amax] (the sweep half of the
+    reference's _get_optimal_threshold). The sweep starts at
+    num_quantized_bins — any narrower slice quantizes losslessly (KL=0)
+    and would always win with a degenerate tiny threshold."""
+    num_bins = len(hist)
+    edges = np.linspace(0, amax, num_bins + 1)
     best_kl, best_t = None, amax
-    # sweep candidate thresholds (same loop structure as the reference)
-    for i in range(num_quantized_bins // 2, num_bins + 1,
+    for i in range(num_quantized_bins, num_bins + 1,
                    max(1, num_bins // 128)):
         t = edges[i] if i < len(edges) else amax
-        p = hist[:i].astype(np.float64).copy()
+        sliced = hist[:i].astype(np.float64)
         outliers = hist[i:].sum()
-        if len(p) == 0 or p.sum() + outliers == 0:
+        if len(sliced) == 0 or sliced.sum() + outliers == 0:
             continue
-        p[-1] += outliers  # clip outliers into the last bin
-        # quantize p into num_quantized_bins then expand back
-        factor = len(p) / num_quantized_bins
-        q = np.zeros_like(p)
+        p = sliced.copy()
+        p[-1] += outliers  # clipped mass lands in p's edge bin ...
+        # ... but q quantizes the histogram WITHOUT the outlier mass — the
+        # resulting p/q mismatch is exactly the cost of clipping at t
+        # (ref: _get_optimal_threshold builds q from sliced_nd_hist)
+        factor = len(sliced) / num_quantized_bins
+        q = np.zeros_like(sliced)
         for j in range(num_quantized_bins):
             lo, hi = int(j * factor), max(int((j + 1) * factor), int(j * factor) + 1)
-            chunk = p[lo:hi]
+            chunk = sliced[lo:hi]
             nz = (chunk > 0).sum()
             if nz:
                 q[lo:hi] = np.where(chunk > 0, chunk.sum() / nz, 0)
-        kl = _kl_divergence(p, q)
+        q[p == 0] = 0
+        ps = _smooth_distribution(p)
+        qs = _smooth_distribution(q)
+        if ps is None or qs is None:
+            continue
+        kl = _kl_divergence(ps, qs)
         if best_kl is None or kl < best_kl:
             best_kl, best_t = kl, float(t)
-    return -best_t, best_t
+    return best_t
+
+
+# ---------------------------------------------------------------------------
+# Model-level INT8 quantization: fp32 Gluon net -> jittable int8 predictor
+# (ref: quantize_graph_pass.cc + python quantize_model:422 — there the graph
+# pass splices quantize/quantized_op/requantize nodes; here the same chain is
+# built as a pure jnp program whose conv/FC run int8 x int8 -> int32 on the
+# MXU via _contrib_quantized_conv / _contrib_quantized_fully_connected)
+# ---------------------------------------------------------------------------
+
+
+def _iter_chain(net):
+    """Flatten (Hybrid)Sequential containers into a layer list. ONLY
+    Sequential containers are flattened: a composite block with its own
+    hybrid_forward (residual blocks, branches) is kept whole and will run
+    as an fp32 island — flattening it would silently drop its skip/branch
+    logic."""
+    if type(net).__name__ in ("Sequential", "HybridSequential"):
+        out = []
+        for k in net._children.values():
+            out.extend(_iter_chain(k))
+        return out
+    return [net]
+
+
+def _fold_batchnorm(layers):
+    """Fold BatchNorm into the preceding conv/dense weights
+    (ref: the quantize pass fuses conv+bn before quantizing).
+    Returns list of (kind, layer, w, b) records in float32."""
+    from ..gluon import nn as gnn
+
+    records = []
+    for layer in layers:
+        if isinstance(layer, gnn.BatchNorm):
+            # fold only into a PLAIN conv/dense: a fused activation between
+            # the linear op and the BN makes the fold invalid
+            # (BN(act(conv)) != act(f*conv + shift))
+            if (not records or records[-1][0] not in ("conv", "dense")
+                    or records[-1][1]._act_type is not None):
+                records.append(("bn_alone", layer, None, None))
+                continue
+            kind, lyr, w, b = records[-1]
+            gamma = layer.gamma.data().asnumpy()
+            beta = layer.beta.data().asnumpy()
+            mean = layer.running_mean.data().asnumpy()
+            var = layer.running_var.data().asnumpy()
+            if not layer._scale:
+                gamma = np.ones_like(gamma)
+            f = gamma / np.sqrt(var + layer._epsilon)
+            w = w * f.reshape((-1,) + (1,) * (w.ndim - 1))
+            b = (b if b is not None else 0.0) * f + beta - mean * f
+            records[-1] = (kind, lyr, w, b.astype(np.float32))
+        elif hasattr(layer, "weight") and getattr(layer, "_transpose", False) is False \
+                and type(layer).__name__.startswith("Conv") \
+                and layer._act_type in (None, "relu"):
+            w = layer.weight.data().asnumpy()
+            b = layer.bias.data().asnumpy() if layer.bias is not None else None
+            records.append(("conv", layer, w, b))
+        elif type(layer).__name__ == "Dense" and layer._act_type in (None, "relu"):
+            w = layer.weight.data().asnumpy()
+            b = layer.bias.data().asnumpy() if layer.bias is not None else None
+            records.append(("dense", layer, w, b))
+        else:
+            # composite blocks, transposed convs, and conv/dense with fused
+            # non-relu activations run whole as fp32 islands
+            records.append((type(layer).__name__, layer, None, None))
+    return records
+
+
+class QuantizedNet:
+    """Jittable int8 inference program produced by `quantize_net`.
+
+    Dataflow per quantized layer (symmetric per-tensor scales s = 127/amax):
+      q_in int8  --int8 conv/fc, int32 accum-->  acc
+      acc + round(bias * s_in * s_w)  --*(s_out/(s_in*s_w)), round, clip-->
+      q_out int8  (ReLU = max(q_out, 0) since zero-point is 0)
+    The final layer dequantizes to float32 logits.
+    """
+
+    def __init__(self, steps, s_in):
+        import jax
+
+        self._steps = steps
+        self._s_in = float(s_in)
+        self._jit = jax.jit(self._run)
+
+    def _run(self, x):
+        from ..ops import quantized as qops
+        from ..ops import nn as nnops
+
+        s = self._s_in
+        q = jnp.clip(jnp.round(x * s), -127, 127).astype(jnp.int8)
+        for step in self._steps:
+            kind = step["kind"]
+            if kind in ("conv", "dense"):
+                if kind == "conv":
+                    acc = qops.quantized_conv(
+                        q, step["qw"], step["qb"], no_bias=step["qb"] is None,
+                        **step["attrs"])
+                else:
+                    acc = qops.quantized_fully_connected(
+                        q, step["qw"], step["qb"], no_bias=step["qb"] is None,
+                        **step["attrs"])
+                if step["last"]:
+                    if step["relu"]:
+                        acc = jnp.maximum(acc, 0)  # zero-point 0: relu on acc
+                    return acc.astype(jnp.float32) * step["deq_scale"]
+                out = acc.astype(jnp.float32) * step["requant_scale"]
+                if step["relu"]:
+                    out = jnp.maximum(out, 0)
+                q = jnp.clip(jnp.round(out), -127, 127).astype(jnp.int8)
+                s = step["s_out"]
+            elif kind == "maxpool":
+                q = qops.quantized_pooling(q, pool_type="max", **step["attrs"])
+            elif kind == "avgpool":
+                q = qops.quantized_pooling(q, pool_type="avg", **step["attrs"])
+            elif kind == "relu":
+                q = jnp.maximum(q, 0)
+            elif kind == "flatten":
+                q = q.reshape(q.shape[0], -1)
+            elif kind == "fp32":
+                # fallback: dequantize, run the fp32 layer, requantize
+                x32 = q.astype(jnp.float32) / s
+                x32 = step["fn"](x32)
+                s = step["s_out"]
+                q = jnp.clip(jnp.round(x32 * s), -127, 127).astype(jnp.int8)
+            else:  # identity (Dropout at inference)
+                pass
+        return q.astype(jnp.float32) / s
+
+    def __call__(self, x):
+        xd = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        return NDArray._from_data(self._jit(xd))
+
+
+def quantize_net(net, calib_data, num_calib_batches=10, calib_mode="minmax",
+                 quantized_dtype="int8"):
+    """fp32 Gluon chain -> QuantizedNet with calibrated activation scales
+    (ref: python quantize_model flow: collect stats -> set ranges -> emit
+    quantized graph). Supports Conv2D/Dense (+folded BatchNorm, fused relu),
+    Max/Avg pooling, Flatten, Activation('relu'), Dropout; anything else
+    runs as an fp32 island between dequantize/quantize pairs."""
+    from ..gluon import nn as gnn
+
+    if quantized_dtype != "int8":
+        raise ValueError("only int8 is supported")
+    layers = _iter_chain(net)
+    records = _fold_batchnorm(layers)
+
+    def _pool_quantizable(lyr):
+        """int8 pooling supports only valid-convention, full-window-divisor
+        pools; anything else runs as an fp32 island."""
+        kw = lyr._kwargs
+        if kw.get("pooling_convention", "valid") != "valid":
+            return False
+        if (kw["pool_type"] == "avg" and not kw.get("count_include_pad", True)
+                and any(_p for _p in np.atleast_1d(kw.get("pad", 0)))):
+            return False
+        return True
+
+    # ---- pass 1: fp32 simulation to collect per-step activation ranges ----
+    def sim_steps(x):
+        """Run the folded-fp32 chain, yielding (record_index, output)."""
+        for i, (kind, lyr, w, b) in enumerate(records):
+            if kind == "conv":
+                from ..ops import nn as nnops
+
+                x = nnops.convolution(
+                    x, jnp.asarray(w), None if b is None else jnp.asarray(b),
+                    kernel=lyr._kernel, stride=lyr._strides,
+                    dilate=lyr._dilation, pad=lyr._padding,
+                    num_filter=lyr._channels, num_group=lyr._groups,
+                    no_bias=b is None)
+                if lyr._act_type == "relu":
+                    x = jnp.maximum(x, 0)
+            elif kind == "dense":
+                from ..ops import nn as nnops
+
+                x = nnops.fully_connected(
+                    x, jnp.asarray(w), None if b is None else jnp.asarray(b),
+                    num_hidden=lyr._units, no_bias=b is None,
+                    flatten=lyr._flatten)
+                if lyr._act_type == "relu":
+                    x = jnp.maximum(x, 0)
+            elif isinstance(lyr, (gnn.MaxPool2D, gnn.AvgPool2D)):
+                from ..ops import nn as nnops
+
+                x = nnops.pooling(x, **lyr._kwargs)
+            elif isinstance(lyr, gnn.Flatten):
+                x = x.reshape(x.shape[0], -1)
+            elif isinstance(lyr, gnn.Dropout):
+                pass
+            elif kind == "bn_alone":
+                from ..ops import nn as nnops
+
+                x = nnops.batch_norm(
+                    x, jnp.asarray(lyr.gamma.data()._data),
+                    jnp.asarray(lyr.beta.data()._data),
+                    jnp.asarray(lyr.running_mean.data()._data),
+                    jnp.asarray(lyr.running_var.data()._data),
+                    eps=lyr._epsilon, fix_gamma=not lyr._scale,
+                    use_global_stats=True)
+            else:
+                x = lyr(NDArray._from_data(x))._data
+            yield i, x
+
+    amax_in = 1e-8
+    amax_out = [1e-8] * len(records)
+    n_done = 0
+    for batch in calib_data:
+        if n_done >= num_calib_batches:
+            break
+        data = batch.data[0] if hasattr(batch, "data") else batch[0]
+        x = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        amax_in = max(amax_in, float(jnp.max(jnp.abs(x))))
+        for i, out in sim_steps(x):
+            amax_out[i] = max(amax_out[i], float(jnp.max(jnp.abs(out))))
+        n_done += 1
+    if n_done == 0:
+        raise ValueError("quantize_net: empty calibration iterator")
+
+    if calib_mode == "entropy":
+        # second pass (requires a re-iterable calib_data): per-step
+        # |activation| histograms inside the minmax range, then a KL sweep
+        # picks each quantized step's clipping threshold
+        # (ref: _get_optimal_threshold entropy mode)
+        nbins = 1024
+        hists = [np.zeros(nbins) for _ in records]
+        n2 = 0
+        for batch in calib_data:
+            if n2 >= num_calib_batches:
+                break
+            data = batch.data[0] if hasattr(batch, "data") else batch[0]
+            x = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+            for i, out in sim_steps(x):
+                o = np.abs(np.asarray(out)).ravel()
+                hists[i] += np.histogram(o, bins=nbins,
+                                         range=(0, amax_out[i]))[0]
+            n2 += 1
+        if n2 == 0:
+            raise ValueError("calib_mode='entropy' needs a re-iterable "
+                             "calib_data (the first pass consumed it)")
+        for i, rec in enumerate(records):
+            if rec[0] in ("conv", "dense") and hists[i].sum() > 0:
+                amax_out[i] = _kl_sweep(hists[i], amax_out[i])
+    elif calib_mode != "minmax":
+        raise ValueError(f"unsupported calib_mode {calib_mode!r} "
+                         "(use 'minmax' or 'entropy')")
+
+    # ---- pass 2: emit the int8 program ----
+    s_in0 = 127.0 / amax_in
+    steps = []
+    s_prev = s_in0
+    last_q = max((i for i, r in enumerate(records) if r[0] in ("conv", "dense")),
+                 default=-1)
+    if last_q != len(records) - 1:
+        last_q = -1  # trailing non-compute layers: dequantize at the very end
+    for i, (kind, lyr, w, b) in enumerate(records):
+        s_out = 127.0 / amax_out[i]
+        if kind in ("conv", "dense"):
+            s_w = 127.0 / max(float(np.abs(w).max()), 1e-8)
+            qw = jnp.asarray(np.clip(np.round(w * s_w), -127, 127)
+                             .astype(np.int8))
+            qb = (None if b is None else
+                  jnp.asarray(np.round(b * s_prev * s_w).astype(np.int32)))
+            attrs = (dict(kernel=lyr._kernel, stride=lyr._strides,
+                          dilate=lyr._dilation, pad=lyr._padding,
+                          num_filter=lyr._channels, num_group=lyr._groups)
+                     if kind == "conv" else
+                     dict(num_hidden=lyr._units, flatten=lyr._flatten))
+            steps.append(dict(
+                kind=kind, qw=qw, qb=qb, attrs=attrs,
+                relu=lyr._act_type == "relu",
+                last=i == last_q,
+                requant_scale=s_out / (s_prev * s_w),
+                deq_scale=1.0 / (s_prev * s_w),
+                s_out=s_out))
+            s_prev = s_out
+        elif (isinstance(lyr, (gnn.MaxPool2D, gnn.AvgPool2D))
+              and _pool_quantizable(lyr)):
+            steps.append(dict(
+                kind="maxpool" if lyr._kwargs["pool_type"] == "max" else "avgpool",
+                attrs=dict(kernel=lyr._kwargs["kernel"],
+                           stride=lyr._kwargs["stride"],
+                           pad=lyr._kwargs["pad"])))
+            # pooling keeps the input scale (max exactly; avg to rounding)
+        elif isinstance(lyr, gnn.Activation) and lyr._act_type == "relu":
+            steps.append(dict(kind="relu"))
+        elif isinstance(lyr, gnn.Flatten):
+            steps.append(dict(kind="flatten"))
+        elif isinstance(lyr, gnn.Dropout):
+            steps.append(dict(kind="identity"))
+        else:
+            def fp32_fn(x32, _l=lyr):
+                return _l(NDArray._from_data(x32))._data
+
+            steps.append(dict(kind="fp32", fn=fp32_fn, s_out=s_out))
+            s_prev = s_out
+    return QuantizedNet(steps, s_in0)

@@ -11,3 +11,4 @@ from . import random  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import vision  # noqa: F401
 from . import contrib_ops  # noqa: F401
+from . import quantized  # noqa: F401

@@ -1,0 +1,515 @@
+"""Registry-wide operator numeric sweep
+(ref: tests/python/unittest/test_operator.py — the reference devotes 7.7k
+lines to per-op numerics; this sweep guarantees EVERY registered op is
+either numerically exercised or explicitly exempted with a reason).
+
+Per op:
+  forward   — runs on domain-valid inputs, output is finite
+  gradient  — autodiff directional derivative vs central finite differences
+  bf16      — fp32 vs bfloat16 forward consistency (loose tolerance), the
+              check_consistency(cpu, tpu-dtype) analog of test_utils:1224
+  oracle    — forward vs a numpy reference for ops with a clean oracle
+
+The partition test fails when a newly registered op is in none of
+GENERIC / SPECS / EXEMPT — coverage is enforced, not aspirational.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import incubator_mxnet_tpu  # noqa: F401 — populates the registry
+from incubator_mxnet_tpu.ops.registry import OP_REGISTRY
+
+RNG = np.random.RandomState(42)
+
+
+def _unique_ops():
+    seen = {}
+    for v in OP_REGISTRY.values():
+        seen.setdefault(v.name, v)
+    return seen
+
+
+UNIQUE = _unique_ops()
+
+# ---------------------------------------------------------------------------
+# input domains for generic (unary/binary, default-attr) ops
+# ---------------------------------------------------------------------------
+
+# (low, high) sampling ranges keeping inputs inside the op's domain
+DOMAINS = {
+    "log": (0.2, 2.0), "log10": (0.2, 2.0), "log2": (0.2, 2.0),
+    "sqrt": (0.1, 2.0), "rsqrt": (0.2, 2.0), "cbrt": (0.2, 2.0),
+    "rcbrt": (0.2, 2.0), "reciprocal": (0.3, 2.0),
+    "log1p": (-0.5, 2.0), "expm1": (-1.0, 1.0),
+    "arcsin": (-0.9, 0.9), "arccos": (-0.9, 0.9),
+    "arccosh": (1.1, 3.0), "arctanh": (-0.9, 0.9),
+    "gamma": (0.5, 3.0), "gammaln": (0.5, 3.0),
+    "digamma": (0.5, 3.0),
+    "_power": (0.2, 2.0), "_rpower_scalar": (0.2, 2.0),
+    "_power_scalar": (0.2, 2.0),
+    "_hypot": (0.2, 2.0),
+    "erfinv": (-0.7, 0.7),
+    "_mod": (0.5, 3.0), "_rmod_scalar": (0.5, 3.0), "_mod_scalar": (0.5, 3.0),
+    "_div": (0.5, 3.0), "_rdiv_scalar": (0.5, 3.0),
+    "_div_scalar": (0.5, 3.0),
+    "broadcast_div": (0.5, 3.0), "broadcast_mod": (0.5, 3.0),
+    "broadcast_power": (0.2, 2.0),
+}
+
+# piecewise-constant / integer-output ops: gradient is legitimately zero, so
+# the directional-derivative check is skipped (both sides would be ~0 anyway
+# only at continuity points; ties make finite differences meaningless)
+GRAD_SKIP = {
+    "argmax", "argmin", "argsort", "round", "rint", "fix", "floor", "ceil",
+    "trunc", "sign", "one_hot", "_equal", "_not_equal", "_greater",
+    "_greater_equal", "_lesser", "_lesser_equal", "_logical_and",
+    "_logical_or", "_logical_xor", "logical_not", "_equal_scalar",
+    "_not_equal_scalar", "_greater_scalar", "_greater_equal_scalar",
+    "_lesser_scalar", "_lesser_equal_scalar", "_logical_and_scalar",
+    "_logical_or_scalar", "_logical_xor_scalar", "argmax_channel",
+    "_maximum", "_minimum", "broadcast_maximum", "broadcast_minimum",
+    "_mod", "_mod_scalar", "_rmod_scalar", "broadcast_mod",
+    "abs",  # kink at 0 is fine but |x| of near-zero entries flakes the FD
+    "clip", "hard_sigmoid", "_sample_unique_zipfian", "_shuffle", "topk",
+    "argsort", "sort", "shape_array", "size_array", "_arange_like",
+    "histogram", "quantize", "quantize_v2", "dequantize", "requantize",
+    "_contrib_index_copy", "batch_take", "take", "pick", "gather_nd",
+    "scatter_nd", "Embedding", "_contrib_count_sketch",
+    "_contrib_boolean_mask", "diag", "eye", "_identity_with_attr_like_rhs",
+    "zeros_like", "ones_like", "_full", "_arange", "_linspace",
+    "BlockGrad", "make_loss", "_contrib_box_iou", "_contrib_box_nms",
+    "_contrib_MultiBoxPrior", "_contrib_bipartite_matching",
+    "_contrib_MultiProposal", "_contrib_Proposal",
+    "space_to_depth", "depth_to_space", "_sample_multinomial",
+    # broadcast comparisons: piecewise-constant
+    "broadcast_equal", "broadcast_not_equal", "broadcast_greater",
+    "broadcast_greater_equal", "broadcast_lesser", "broadcast_lesser_equal",
+    "broadcast_logical_and", "broadcast_logical_or", "broadcast_logical_xor",
+    # loss-output ops: the reference defines backward as the ANALYTIC loss
+    # gradient (e.g. softmax - label), not the vjp of the forward output
+    # (ref: softmax_output-inl.h) — FD of the forward is intentionally
+    # different; the custom backward is pinned in tests/test_operator.py
+    "SoftmaxOutput", "LinearRegressionOutput", "LogisticRegressionOutput",
+    "MAERegressionOutput",
+    # discrete bin/cell assignment: gradient exists a.e. but FD straddles
+    # bin boundaries at any eps
+    "ROIPooling", "BilinearSampler", "SpatialTransformer",
+    "_contrib_DeformableConvolution", "Correlation", "_contrib_box_encode",
+    # int8 inference-only kernels (ref: quantized_conv.cu has no backward)
+    "_contrib_quantized_conv", "_contrib_quantized_fully_connected",
+    "_contrib_quantized_pooling",
+}
+
+# bf16 consistency skipped where bf16 either over/underflows trivially or
+# the op is integer/indexing-valued so "consistency" is exact-match anyway
+BF16_SKIP = GRAD_SKIP | {
+    "gamma", "gammaln", "digamma", "erfinv", "_hypot",
+    "_contrib_hawkesll", "CTCLoss", "_linalg_potrf", "_linalg_potri",
+    "_linalg_trsm", "_linalg_trmm", "_linalg_gelqf", "_linalg_syrk",
+    "_linalg_gemm", "_linalg_gemm2", "_linalg_sumlogdiag",
+    "_linalg_extractdiag", "_linalg_makediag", "_linalg_extracttrian",
+    "_linalg_maketrian", "_linalg_inverse", "_linalg_det",
+    "_linalg_slogdet", "_Linalg_svd", "_linalg_svd", "_npi_eigvals",
+    "softmax_cross_entropy", "_contrib_DeformablePSROIPooling",
+}
+
+
+def _rand(shape, lo=-1.0, hi=1.0, dtype=np.float32, seed=None):
+    rng = RNG if seed is None else np.random.RandomState(seed)
+    return jnp.asarray((rng.rand(*shape) * (hi - lo) + lo).astype(dtype))
+
+
+def _pd_matrix(n=3):
+    a = RNG.rand(n, n).astype(np.float32)
+    return jnp.asarray(a @ a.T + n * np.eye(n, dtype=np.float32))
+
+
+# ---------------------------------------------------------------------------
+# manual specs: name -> callable returning (args tuple, attrs dict)
+# ---------------------------------------------------------------------------
+
+def _conv_spec():
+    return (_rand((2, 3, 8, 8)), _rand((4, 3, 3, 3)), _rand((4,))), dict(
+        kernel=(3, 3), num_filter=4, stride=(1, 1), pad=(1, 1))
+
+
+def _deconv_spec():
+    return (_rand((2, 4, 5, 5)), _rand((4, 3, 3, 3)), _rand((3,))), dict(
+        kernel=(3, 3), num_filter=3, stride=(2, 2), pad=(1, 1), adj=(1, 1))
+
+
+SPECS = {
+    # reductions / shape ops
+    "sum": lambda: ((_rand((3, 4)),), dict(axis=1)),
+    "mean": lambda: ((_rand((3, 4)),), dict(axis=1)),
+    "prod": lambda: ((_rand((3, 4), 0.5, 1.5),), dict(axis=1)),
+    "nansum": lambda: ((_rand((3, 4)),), dict(axis=1)),
+    "nanprod": lambda: ((_rand((3, 4), 0.5, 1.5),), dict(axis=1)),
+    "max": lambda: ((_rand((3, 4)),), dict(axis=1)),
+    "min": lambda: ((_rand((3, 4)),), dict(axis=1)),
+    "norm": lambda: ((_rand((3, 4), 0.5, 1.5),), dict(axis=1)),
+    "argmax": lambda: ((_rand((3, 4)),), dict(axis=1)),
+    "argmin": lambda: ((_rand((3, 4)),), dict(axis=1)),
+    "Reshape": lambda: ((_rand((3, 4)),), dict(shape=(4, 3))),
+    "transpose": lambda: ((_rand((3, 4)),), dict(axes=(1, 0))),
+    "squeeze": lambda: ((_rand((3, 1, 4)),), dict(axis=1)),
+    "broadcast_to": lambda: ((_rand((3, 1)),), dict(shape=(3, 4))),
+    "slice_axis": lambda: ((_rand((3, 6)),), dict(axis=1, begin=1, end=4)),
+    "repeat": lambda: ((_rand((3, 2)),), dict(repeats=2, axis=1)),
+    "one_hot": lambda: ((jnp.asarray([0, 2, 1]),), dict(depth=4)),
+    "_arange_like": lambda: ((_rand((3, 4)),), dict(axis=1)),
+    "histogram": lambda: ((_rand((20,)),), dict(bin_cnt=5, range=(-1.0, 1.0))),
+    "Embedding": lambda: ((jnp.asarray([[0, 2], [1, 3]]), _rand((5, 4))),
+                          dict(input_dim=5, output_dim=4)),
+    "scatter_nd": lambda: ((_rand((2,)), jnp.asarray([[0, 1], [1, 0]])),
+                           dict(shape=(2, 2))),
+    # NN layers
+    "FullyConnected": lambda: ((_rand((2, 5)), _rand((3, 5)), _rand((3,))),
+                               dict(num_hidden=3)),
+    "Convolution": _conv_spec,
+    "Deconvolution": _deconv_spec,
+    "Pooling": lambda: ((_rand((2, 3, 6, 6)),),
+                        dict(kernel=(2, 2), stride=(2, 2), pool_type="max")),
+    "softmax": lambda: ((_rand((3, 5)),), dict(axis=-1)),
+    "log_softmax": lambda: ((_rand((3, 5)),), dict(axis=-1)),
+    "ROIPooling": lambda: ((_rand((1, 2, 8, 8), 0, 1),
+                            jnp.asarray([[0.0, 1, 1, 6, 6]])),
+                           dict(pooled_size=(2, 2), spatial_scale=1.0)),
+    "_contrib_ROIAlign": lambda: ((_rand((1, 2, 8, 8), 0, 1),
+                                   jnp.asarray([[0.0, 1, 1, 6, 6]])),
+                                  dict(pooled_size=(2, 2), spatial_scale=1.0)),
+    "_contrib_BilinearResize2D": lambda: ((_rand((1, 2, 4, 4)),),
+                                          dict(height=8, width=8)),
+    "_contrib_DeformableConvolution": lambda: (
+        (_rand((1, 3, 6, 6)), _rand((1, 18, 6, 6), -0.1, 0.1),
+         _rand((4, 3, 3, 3)), _rand((4,))),
+        dict(kernel=(3, 3), num_filter=4, pad=(1, 1))),
+    "_contrib_count_sketch": lambda: (
+        (_rand((2, 6)), jnp.asarray(RNG.randint(0, 4, 6)),
+         jnp.asarray(RNG.choice([-1.0, 1.0], 6).astype(np.float32))),
+        dict(out_dim=4)),
+    # optimizer update ops
+    "sgd_update": lambda: ((_rand((3, 2)), _rand((3, 2))), dict(lr=0.1)),
+    "signsgd_update": lambda: ((_rand((3, 2)), _rand((3, 2))), dict(lr=0.1)),
+    "sgd_mom_update": lambda: ((_rand((3, 2)), _rand((3, 2)), _rand((3, 2))),
+                               dict(lr=0.1, momentum=0.9)),
+    "nag_mom_update": lambda: ((_rand((3, 2)), _rand((3, 2)), _rand((3, 2))),
+                               dict(lr=0.1, momentum=0.9)),
+    "signum_update": lambda: ((_rand((3, 2)), _rand((3, 2)), _rand((3, 2))),
+                              dict(lr=0.1, momentum=0.9)),
+    "adam_update": lambda: ((_rand((3, 2)), _rand((3, 2)), _rand((3, 2)),
+                             _rand((3, 2), 0.01, 1.0)), dict(lr=0.1)),
+    "adamw_update": lambda: ((_rand((3, 2)), _rand((3, 2)), _rand((3, 2)),
+                              _rand((3, 2), 0.01, 1.0)),
+                             dict(lr=0.1, eta=1.0)),
+    "ftml_update": lambda: ((_rand((3, 2)), _rand((3, 2)), _rand((3, 2)),
+                             _rand((3, 2), 0.01, 1.0), _rand((3, 2))),
+                            dict(lr=0.1, t=1)),
+    "ftrl_update": lambda: ((_rand((3, 2)), _rand((3, 2)), _rand((3, 2)),
+                             _rand((3, 2), 0.01, 1.0)), dict(lr=0.1)),
+    "rmsprop_update": lambda: ((_rand((3, 2)), _rand((3, 2)),
+                                _rand((3, 2), 0.01, 1.0)), dict(lr=0.1)),
+    "rmspropalex_update": lambda: ((_rand((3, 2)), _rand((3, 2)),
+                                    _rand((3, 2), 0.01, 1.0), _rand((3, 2)),
+                                    _rand((3, 2))), dict(lr=0.1)),
+    # multi-output / structured
+    "Concat": lambda: ((_rand((2, 3)), _rand((2, 3))), dict(dim=1)),
+    "add_n": lambda: ((_rand((2, 3)), _rand((2, 3)), _rand((2, 3))), {}),
+    "stack": lambda: ((_rand((2, 3)), _rand((2, 3))), dict(axis=0)),
+    "where": lambda: ((jnp.asarray([[True, False], [False, True]]),
+                       _rand((2, 2)), _rand((2, 2))), {}),
+    "topk": lambda: ((_rand((3, 5)),), dict(k=2)),
+    "LayerNorm": lambda: ((_rand((3, 4)), _rand((4,), 0.5, 1.5),
+                           _rand((4,))), {}),
+    "GroupNorm": lambda: ((_rand((2, 4, 3, 3)), _rand((4,), 0.5, 1.5),
+                           _rand((4,))), dict(num_groups=2)),
+    "InstanceNorm": lambda: ((_rand((2, 3, 4, 4)), _rand((3,), 0.5, 1.5),
+                              _rand((3,))), {}),
+    "SliceChannel": lambda: ((_rand((2, 6)),), dict(num_outputs=2, axis=1)),
+    "UpSampling": lambda: ((_rand((1, 2, 3, 3)),),
+                           dict(scale=2, sample_type="nearest")),
+    "_linalg_gemm": lambda: ((_rand((2, 3)), _rand((3, 4)), _rand((2, 4))),
+                             {}),
+    "_contrib_box_encode": lambda: (
+        (jnp.asarray([[1.0]]),                       # samples (B, N) >0 = pos
+         jnp.asarray([[0.0]]),                       # matches (B, N)
+         jnp.asarray([[[0.1, 0.1, 0.4, 0.4]]]),      # anchors (B, N, 4)
+         jnp.asarray([[[0.12, 0.1, 0.41, 0.42]]])),  # refs (B, M, 4)
+        {}),
+    "_contrib_hawkesll": lambda: (
+        (_rand((1, 2), 0.5, 1.0), _rand((2,), 0.1, 0.5),
+         _rand((2,), 0.5, 1.0), jnp.zeros((1, 2)),
+         _rand((1, 3), 0.1, 1.0), jnp.asarray([[0, 1, 0]]),
+         jnp.asarray([3.0])), {}),
+    # int8 quantized ops: integer in/out, inference-only
+    "_contrib_quantized_conv": lambda: (
+        (jnp.asarray(RNG.randint(-127, 128, (2, 3, 6, 6)), jnp.int8),
+         jnp.asarray(RNG.randint(-127, 128, (4, 3, 3, 3)), jnp.int8)),
+        dict(kernel=(3, 3), num_filter=4)),
+    "_contrib_quantized_fully_connected": lambda: (
+        (jnp.asarray(RNG.randint(-127, 128, (3, 10)), jnp.int8),
+         jnp.asarray(RNG.randint(-127, 128, (4, 10)), jnp.int8)),
+        dict(num_hidden=4)),
+    "_contrib_quantized_pooling": lambda: (
+        (jnp.asarray(RNG.randint(-127, 128, (1, 2, 4, 4)), jnp.int8),),
+        dict(kernel=(2, 2), stride=(2, 2))),
+    # kink at 0: sample both slopes but away from the FD band around 0
+    "LeakyReLU": lambda: (
+        (jnp.asarray(np.where(RNG.rand(3, 4) > 0.5, 1.0, -1.0)
+                     * (0.2 + RNG.rand(3, 4)).astype(np.float32)),),
+        dict(act_type="leaky")),
+    # geometry / sampling ops
+    "dot": lambda: ((_rand((3, 4)), _rand((4, 2))), {}),
+    "batch_dot": lambda: ((_rand((2, 3, 4)), _rand((2, 4, 2))), {}),
+    "Pad": lambda: ((_rand((2, 3, 4, 4)),),
+                    dict(mode="constant", pad_width=(0, 0, 0, 0, 1, 1, 1, 1))),
+    "boolean_mask": lambda: ((_rand((4, 3)), jnp.asarray([1, 0, 1, 1])), {}),
+    "softmax_cross_entropy": lambda: ((_rand((3, 5)),
+                                       jnp.asarray([0.0, 2.0, 4.0])), {}),
+    "depth_to_space": lambda: ((_rand((1, 8, 3, 3)),), dict(block_size=2)),
+    "space_to_depth": lambda: ((_rand((1, 2, 4, 4)),), dict(block_size=2)),
+    "_contrib_AdaptiveAvgPooling2D": lambda: ((_rand((2, 3, 6, 6)),),
+                                              dict(output_size=(2, 2))),
+    "_contrib_MultiBoxPrior": lambda: ((_rand((1, 3, 4, 4)),),
+                                       dict(sizes=(0.5,), ratios=(1.0, 2.0))),
+    "_contrib_box_nms": lambda: (
+        (jnp.asarray([[[0.0, 0.9, 0.1, 0.1, 0.5, 0.5],
+                       [0.0, 0.8, 0.12, 0.1, 0.5, 0.52],
+                       [1.0, 0.7, 0.6, 0.6, 0.9, 0.9]]]),), {}),
+    "GridGenerator": lambda: ((_rand((2, 6)),),
+                              dict(transform_type="affine",
+                                   target_shape=(4, 4))),
+    "BilinearSampler": lambda: ((_rand((1, 2, 4, 4)),
+                                 _rand((1, 2, 3, 3), -0.8, 0.8)), {}),
+    "SpatialTransformer": lambda: ((_rand((1, 2, 4, 4)), _rand((1, 6))),
+                                   dict(target_shape=(3, 3))),
+    "Correlation": lambda: ((_rand((1, 2, 5, 5)), _rand((1, 2, 5, 5))),
+                            dict(kernel_size=1, max_displacement=1,
+                                 pad_size=1)),
+    "LinearRegressionOutput": lambda: ((_rand((3, 2)), _rand((3, 2))), {}),
+    "LogisticRegressionOutput": lambda: ((_rand((3, 2)), _rand((3, 2), 0, 1)),
+                                         {}),
+    "MAERegressionOutput": lambda: ((_rand((3, 2)), _rand((3, 2))), {}),
+    "SoftmaxOutput": lambda: ((_rand((3, 4)), jnp.asarray([0.0, 2.0, 1.0])),
+                              {}),
+    "rmspropalex_update": lambda: (lambda g_avg: (
+        (_rand((3, 2)), _rand((3, 2)),
+         jnp.square(g_avg) + _rand((3, 2), 0.1, 1.0),  # n >= g^2 invariant
+         g_avg, _rand((3, 2), -0.1, 0.1)), dict(lr=0.1)))(_rand((3, 2))),
+}
+
+# ops that cannot be exercised by the generic harness — each with the reason
+EXEMPT = {
+    # covered by dedicated test files (behavioral suites)
+    "BatchNorm": "aux-state protocol; covered in tests/test_operator.py + test_gluon.py",
+    "_contrib_SyncBatchNorm": "aux-state protocol; covered in tests/test_parallel.py",
+    "Dropout": "rng + training-mode; covered in tests/test_operator.py",
+    "RNN": "stateful fused op; covered in tests/test_operator.py rnn tests",
+    "CTCLoss": "variable-length semantics; covered in tests/test_operator.py",
+    "_contrib_MultiBoxTarget": "detection pipeline; covered in tests/test_ssd.py",
+    "_contrib_MultiBoxDetection": "detection pipeline; covered in tests/test_ssd.py",
+    # random samplers: distributional, not pointwise-numeric; moment tests
+    # live in tests/test_operator.py::test_random_moments
+    "_random_uniform": "sampler", "_random_normal": "sampler",
+    "_random_bernoulli": "sampler", "_random_exponential": "sampler",
+    "_random_gamma": "sampler", "_random_poisson": "sampler",
+    "_random_negative_binomial": "sampler",
+    "_random_generalized_negative_binomial": "sampler",
+    "_random_randint": "sampler",
+    "_sample_uniform": "sampler", "_sample_normal": "sampler",
+    "_sample_exponential": "sampler", "_sample_gamma": "sampler",
+    "_sample_poisson": "sampler", "_sample_multinomial": "sampler",
+    "_sample_unique_zipfian": "sampler", "_shuffle": "sampler",
+}
+
+
+def _generic_spec(op):
+    lo, hi = DOMAINS.get(op.name, (-1.0, 1.0))
+    shapes = {1: [(3, 4)], 2: [(3, 4), (3, 4)]}[len(op.inputs)]
+    # special-case binary ops whose second input is integer-like
+    args = tuple(_rand(s, lo, hi) for s in shapes)
+    return args, {}
+
+
+INT_SECOND_INPUT = {
+    "take": lambda: ((_rand((5, 3)), jnp.asarray([0, 2, 4])), {}),
+    "batch_take": lambda: ((_rand((3, 4)), jnp.asarray([0, 2, 1])), {}),
+    "pick": lambda: ((_rand((3, 4)), jnp.asarray([0.0, 2.0, 1.0])), {}),
+    "gather_nd": lambda: ((_rand((3, 4)), jnp.asarray([[0, 1], [2, 0]]).T), {}),
+    "_contrib_boolean_mask": lambda: ((_rand((4, 3)),
+                                       jnp.asarray([1, 0, 1, 1])), {}),
+    "_contrib_index_copy": lambda: ((_rand((5, 3)), jnp.asarray([1, 3]),
+                                     _rand((2, 3))), {}),
+    "diag": lambda: ((_rand((4, 4)),), {}),
+    "eye": lambda: ((), dict(N=3)),
+    "_linalg_potrf": lambda: ((_pd_matrix(),), {}),
+    "_linalg_potri": lambda: ((jnp.linalg.cholesky(_pd_matrix()),), {}),
+    "_linalg_trsm": lambda: ((jnp.linalg.cholesky(_pd_matrix()),
+                              _rand((3, 3))), {}),
+    "_linalg_trmm": lambda: ((jnp.linalg.cholesky(_pd_matrix()),
+                              _rand((3, 3))), {}),
+    "_linalg_syrk": lambda: ((_rand((3, 4)),), {}),
+    "_linalg_gelqf": lambda: ((_rand((2, 4)),), {}),
+    "_linalg_sumlogdiag": lambda: ((_pd_matrix(),), {}),
+    "_linalg_extractdiag": lambda: ((_rand((3, 3)),), {}),
+    "_linalg_makediag": lambda: ((_rand((3,)),), {}),
+    "_linalg_extracttrian": lambda: ((_rand((3, 3)),), {}),
+    "_linalg_maketrian": lambda: ((_rand((6,)),), {}),
+    "_linalg_inverse": lambda: ((_pd_matrix(),), {}),
+    "_linalg_det": lambda: ((_pd_matrix(),), {}),
+    "_linalg_slogdet": lambda: ((_pd_matrix(),), {}),
+    "_linalg_gemm2": lambda: ((_rand((2, 3)), _rand((3, 4))), {}),
+    "_linalg_svd": lambda: ((_rand((2, 4)),), {}),
+}
+SPECS.update(INT_SECOND_INPUT)
+
+
+def _spec_for(op):
+    if op.name in SPECS:
+        return SPECS[op.name]()
+    return _generic_spec(op)
+
+
+def _call_op(op, args, attrs):
+    kw = dict(attrs)
+    if op.needs_rng:
+        kw["_rng"] = jax.random.PRNGKey(0)
+    if op.needs_training:
+        kw["_training"] = False
+    return op.fn(*args, **kw)
+
+
+def _flat_outputs(out):
+    if isinstance(out, (tuple, list)):
+        return [o for o in out if hasattr(o, "dtype")]
+    return [out]
+
+
+def _covered_ops():
+    names = []
+    for name, op in sorted(UNIQUE.items()):
+        if name in EXEMPT:
+            continue
+        names.append(name)
+    return names
+
+
+def test_registry_partition_is_total():
+    """Every registered op is generic-coverable, spec'd, or exempted."""
+    unaccounted = []
+    for name, op in sorted(UNIQUE.items()):
+        if name in EXEMPT or name in SPECS:
+            continue
+        required = [a for a, d in op.attrs.items() if d is None]
+        generic_ok = (not op.variadic and not op.aux and not required
+                      and len(op.inputs) <= 2 and not op.needs_rng)
+        if not generic_ok:
+            unaccounted.append(name)
+    assert not unaccounted, (
+        f"ops with no spec/exemption: {unaccounted} — add a SPECS entry or "
+        f"an EXEMPT reason")
+
+
+@pytest.mark.parametrize("name", _covered_ops())
+def test_op_forward_finite(name):
+    op = UNIQUE[name]
+    args, attrs = _spec_for(op)
+    out = _call_op(op, args, attrs)
+    for o in _flat_outputs(out):
+        a = np.asarray(o)
+        assert np.isfinite(a.astype(np.float64)).all(), f"{name}: non-finite"
+
+
+@pytest.mark.parametrize(
+    "name", [n for n in _covered_ops() if n not in GRAD_SKIP])
+def test_op_gradient_matches_fd(name):
+    """<grad f, v> == (f(x+hv)-f(x-hv))/2h for a random direction v, for
+    every differentiable float input (check_numeric_gradient:801 analog)."""
+    op = UNIQUE[name]
+    args, attrs = _spec_for(op)
+    float_idx = [i for i, a in enumerate(args)
+                 if hasattr(a, "dtype") and a.dtype in (jnp.float32,)
+                 and (not op.inputs or i >= len(op.inputs)
+                      or op.inputs[i] not in op.no_grad_inputs)]
+    if not float_idx:
+        pytest.skip("no differentiable inputs")
+
+    def loss(*fargs):
+        full = list(args)
+        for i, fa in zip(float_idx, fargs):
+            full[i] = fa
+        out = _call_op(op, tuple(full), attrs)
+        return sum(jnp.sum(o.astype(jnp.float32)) for o in _flat_outputs(out))
+
+    fargs = [args[i] for i in float_idx]
+    grads = jax.grad(loss, argnums=tuple(range(len(fargs))))(*fargs)
+    h = 1e-2
+    rng = np.random.RandomState(7)
+    for k, g in enumerate(grads):
+        v = jnp.asarray(rng.choice([-1.0, 1.0],
+                                   size=fargs[k].shape).astype(np.float32))
+        plus = [f if j != k else f + h * v for j, f in enumerate(fargs)]
+        minus = [f if j != k else f - h * v for j, f in enumerate(fargs)]
+        fd = (float(loss(*plus)) - float(loss(*minus))) / (2 * h)
+        ad = float(jnp.sum(g * v))
+        tol = max(0.08 * max(abs(fd), abs(ad)), 5e-2)
+        assert abs(fd - ad) <= tol, (
+            f"{name} input#{float_idx[k]}: autodiff {ad:.5f} vs FD {fd:.5f}")
+
+
+@pytest.mark.parametrize(
+    "name", [n for n in _covered_ops() if n not in BF16_SKIP])
+def test_op_bf16_consistency(name):
+    """fp32 vs bf16 forward agreement (check_consistency:1224 analog)."""
+    op = UNIQUE[name]
+    args, attrs = _spec_for(op)
+    out32 = _flat_outputs(_call_op(op, args, attrs))
+    argsb = tuple(a.astype(jnp.bfloat16)
+                  if hasattr(a, "dtype") and a.dtype == jnp.float32 else a
+                  for a in args)
+    try:
+        outb = _flat_outputs(_call_op(op, argsb, attrs))
+    except TypeError:
+        pytest.skip("op requires homogeneous non-bf16 inputs")
+    for o32, ob in zip(out32, outb):
+        a32 = np.asarray(o32, dtype=np.float64)
+        ab = np.asarray(ob.astype(jnp.float32), dtype=np.float64)
+        denom = np.maximum(np.abs(a32), 1.0)
+        assert (np.abs(a32 - ab) / denom).max() < 0.15, f"{name}: bf16 drift"
+
+
+# ---------------------------------------------------------------------------
+# numpy forward oracles for the core op set
+# ---------------------------------------------------------------------------
+
+ORACLES = {
+    "relu": lambda x: np.maximum(x, 0),
+    "sigmoid": lambda x: 1 / (1 + np.exp(-x)),
+    "exp": np.exp, "log": np.log, "sqrt": np.sqrt, "square": np.square,
+    "tanh": np.tanh, "sin": np.sin, "cos": np.cos, "tan": np.tan,
+    "arcsin": np.arcsin, "arccos": np.arccos, "arctan": np.arctan,
+    "sinh": np.sinh, "cosh": np.cosh, "arcsinh": np.arcsinh,
+    "arccosh": np.arccosh, "arctanh": np.arctanh,
+    "abs": np.abs, "sign": np.sign, "floor": np.floor, "ceil": np.ceil,
+    "log1p": np.log1p, "expm1": np.expm1, "rsqrt": lambda x: 1 / np.sqrt(x),
+    "reciprocal": lambda x: 1 / x, "negative": lambda x: -x,
+    "_add": np.add, "_sub": np.subtract, "_mul": np.multiply,
+    "_div": np.divide, "_maximum": np.maximum, "_minimum": np.minimum,
+    "broadcast_add": np.add, "broadcast_sub": np.subtract,
+    "broadcast_mul": np.multiply, "broadcast_div": np.divide,
+    "dot": np.dot,
+}
+
+
+@pytest.mark.parametrize("name", sorted(ORACLES))
+def test_op_forward_oracle(name):
+    op = UNIQUE.get(name)
+    if op is None:
+        pytest.skip(f"{name} not registered")
+    args, attrs = _spec_for(op)
+    out = np.asarray(_call_op(op, args, attrs))
+    ref = ORACLES[name](*[np.asarray(a) for a in args])
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-6)

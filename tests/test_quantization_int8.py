@@ -1,0 +1,260 @@
+"""INT8 quantized execution tests
+(ref: tests/python/quantization/test_quantization.py — quantized op
+numerics + quantize_model accuracy flow)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.contrib import quantization as q
+from incubator_mxnet_tpu.ops import quantized as qops
+from incubator_mxnet_tpu.ops import nn as nnops
+
+
+def test_quantized_conv_matches_int_oracle():
+    """int8 conv accumulates exactly in int32 (no float rounding)."""
+    rng = np.random.RandomState(0)
+    x = rng.randint(-127, 128, (2, 3, 6, 6)).astype(np.int8)
+    w = rng.randint(-127, 128, (4, 3, 3, 3)).astype(np.int8)
+    out = np.asarray(qops.quantized_conv(
+        jnp.asarray(x), jnp.asarray(w), kernel=(3, 3), num_filter=4))
+    assert out.dtype == np.int32
+    # oracle via float64 conv on the int values (exact for this range)
+    ref = np.asarray(nnops.convolution(
+        jnp.asarray(x.astype(np.float64).astype(np.float32)),
+        jnp.asarray(w.astype(np.float64).astype(np.float32)),
+        kernel=(3, 3), num_filter=4))
+    np.testing.assert_array_equal(out, ref.astype(np.int32))
+
+
+def test_quantized_fc_matches_int_oracle():
+    rng = np.random.RandomState(1)
+    x = rng.randint(-127, 128, (3, 10)).astype(np.int8)
+    w = rng.randint(-127, 128, (4, 10)).astype(np.int8)
+    b = rng.randint(-1000, 1000, (4,)).astype(np.int32)
+    out = np.asarray(qops.quantized_fully_connected(
+        jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+        num_hidden=4, no_bias=False))
+    ref = x.astype(np.int64) @ w.astype(np.int64).T + b
+    np.testing.assert_array_equal(out, ref.astype(np.int32))
+
+
+def test_quantized_pooling_int8():
+    rng = np.random.RandomState(2)
+    x = rng.randint(-127, 128, (1, 2, 4, 4)).astype(np.int8)
+    mx_out = np.asarray(qops.quantized_pooling(
+        jnp.asarray(x), kernel=(2, 2), stride=(2, 2), pool_type="max"))
+    assert mx_out.dtype == np.int8
+    ref = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+    np.testing.assert_array_equal(mx_out, ref)
+    avg_out = np.asarray(qops.quantized_pooling(
+        jnp.asarray(x), kernel=(2, 2), stride=(2, 2), pool_type="avg"))
+    assert avg_out.dtype == np.int8
+
+
+def _lenet():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 5, activation="relu"))
+    net.add(nn.MaxPool2D(2))
+    net.add(nn.Conv2D(16, 5, activation="relu"))
+    net.add(nn.MaxPool2D(2))
+    net.add(nn.Flatten())
+    net.add(nn.Dense(32, activation="relu"))
+    net.add(nn.Dense(10))
+    return net
+
+
+class _Batches:
+    def __init__(self, data):
+        self._data = data
+
+    def __iter__(self):
+        for d in self._data:
+            yield [nd.array(d)]
+
+
+def test_quantize_net_logits_close():
+    mx.random.seed(0)
+    net = _lenet()
+    net.initialize(mx.init.Xavier())
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(4, 1, 28, 28).astype(np.float32))
+    _ = net(x)
+    calib = _Batches([rng.rand(8, 1, 28, 28).astype(np.float32)
+                      for _ in range(4)])
+    qnet = q.quantize_net(net, calib, num_calib_batches=4)
+    f = net(x).asnumpy()
+    g = qnet(x).asnumpy()
+    rel = np.abs(f - g).max() / (np.abs(f).max() + 1e-9)
+    assert rel < 0.1, rel
+    assert (f.argmax(1) == g.argmax(1)).all()
+
+
+def test_quantize_net_with_batchnorm_folding():
+    mx.random.seed(1)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(6, 3))
+    net.add(nn.BatchNorm())
+    net.add(nn.Activation("relu"))
+    net.add(nn.Flatten())
+    net.add(nn.Dense(5))
+    net.initialize(mx.init.Xavier())
+    rng = np.random.RandomState(3)
+    x = nd.array(rng.rand(2, 1, 8, 8).astype(np.float32))
+    _ = net(x)
+    # make BN stats non-trivial
+    net._children[list(net._children)[1]].running_mean.set_data(
+        nd.array(rng.rand(6).astype(np.float32) * 0.5))
+    net._children[list(net._children)[1]].running_var.set_data(
+        nd.array((rng.rand(6) * 0.5 + 0.5).astype(np.float32)))
+    calib = _Batches([rng.rand(4, 1, 8, 8).astype(np.float32)
+                      for _ in range(3)])
+    qnet = q.quantize_net(net, calib, num_calib_batches=3)
+    f = net(x).asnumpy()
+    g = qnet(x).asnumpy()
+    rel = np.abs(f - g).max() / (np.abs(f).max() + 1e-9)
+    assert rel < 0.12, rel
+
+
+def test_quantized_trained_accuracy_within_1pct():
+    """Train LeNet on a separable synthetic task, then int8 accuracy must be
+    within 1% of fp32 (the reference's quantize_model acceptance bar)."""
+    from incubator_mxnet_tpu import gluon, fused
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    # 10-class synthetic images: class k = bright blob at position k
+    def make(n):
+        y = rng.randint(0, 10, n)
+        x = rng.rand(n, 1, 28, 28).astype(np.float32) * 0.3
+        for i, k in enumerate(y):
+            r, c = divmod(k, 5)
+            x[i, 0, 4 + r * 12:12 + r * 12, 2 + c * 5:6 + c * 5] += 0.7
+        return x, y.astype(np.float32)
+
+    xtr, ytr = make(512)
+    xte, yte = make(256)
+    net = _lenet()
+    net.initialize(mx.init.Xavier())
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    opt = mx.optimizer.Adam(learning_rate=3e-3, rescale_grad=1.0 / 64)
+    step = fused.GluonTrainStep(net, lambda n, a, b: L(n(a), b), opt)
+    for ep in range(6):
+        for i in range(0, 512, 64):
+            step(nd.array(xtr[i:i + 64]), nd.array(ytr[i:i + 64]))
+    step.sync_params()  # donated training buffers -> net Parameters
+
+    f_pred = net(nd.array(xte)).asnumpy().argmax(1)
+    acc_f = (f_pred == yte).mean()
+    assert acc_f > 0.9, f"fp32 failed to train ({acc_f})"
+
+    calib = _Batches([xtr[i:i + 64] for i in range(0, 256, 64)])
+    qnet = q.quantize_net(net, calib, num_calib_batches=4)
+    q_pred = qnet(nd.array(xte)).asnumpy().argmax(1)
+    acc_q = (q_pred == yte).mean()
+    assert acc_f - acc_q <= 0.01, f"int8 {acc_q} vs fp32 {acc_f}"
+
+
+def test_kl_sweep_thresholds():
+    """The KL sweep must keep most of a half-normal's mass and clip an
+    empty tail (ref: _get_optimal_threshold behavior)."""
+    rng = np.random.RandomState(5)
+    samples = np.abs(rng.randn(200000))
+    h_tight = np.histogram(samples, bins=1024, range=(0, 5))[0]
+    t = q._kl_sweep(h_tight, 5.0)
+    assert 3.0 < t <= 5.0, t  # near-full range when no outliers
+    h_wide = np.histogram(samples, bins=1024, range=(0, 12))[0]
+    t2 = q._kl_sweep(h_wide, 12.0)
+    assert 3.0 < t2 < 6.0, t2  # clips the empty [5, 12] tail
+
+
+def test_quantize_net_entropy_mode():
+    """Entropy calibration trades range for resolution; on an untrained net
+    with near-tied logits the argmax must still broadly agree, and invalid
+    modes must raise."""
+    mx.random.seed(2)
+    net = _lenet()
+    net.initialize(mx.init.Xavier())
+    rng = np.random.RandomState(5)
+    x = nd.array(rng.rand(64, 1, 28, 28).astype(np.float32))
+    _ = net(x)
+    calib = _Batches([rng.rand(8, 1, 28, 28).astype(np.float32)
+                      for _ in range(3)])
+    qnet = q.quantize_net(net, calib, num_calib_batches=3,
+                          calib_mode="entropy")
+    f = net(x).asnumpy()
+    g = qnet(x).asnumpy()
+    assert (f.argmax(1) == g.argmax(1)).mean() >= 0.75
+    with pytest.raises(ValueError):
+        q.quantize_net(net, calib, calib_mode="bogus")
+
+
+def test_quantize_net_non_relu_activation_is_fp32_island():
+    """Conv/Dense with fused non-relu activations must NOT be silently
+    linearized — they run as fp32 islands and stay numerically faithful."""
+    mx.random.seed(3)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="tanh"))
+    net.add(nn.Dense(5))
+    net.initialize(mx.init.Xavier())
+    rng = np.random.RandomState(6)
+    x = nd.array(rng.rand(4, 8).astype(np.float32) * 4)  # drive tanh nonlinear
+    _ = net(x)
+    calib = _Batches([rng.rand(8, 8).astype(np.float32) * 4 for _ in range(3)])
+    qnet = q.quantize_net(net, calib, num_calib_batches=3)
+    f = net(x).asnumpy()
+    g = qnet(x).asnumpy()
+    rel = np.abs(f - g).max() / (np.abs(f).max() + 1e-9)
+    assert rel < 0.1, rel
+
+
+def test_quantize_net_composite_block_kept_whole():
+    """Non-Sequential composite blocks (residual-style) are fp32 islands,
+    not flattened — their skip connections must survive."""
+    from incubator_mxnet_tpu.gluon.block import HybridBlock
+
+    class Residual(HybridBlock):
+        def __init__(self, units, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.fc = nn.Dense(units, flatten=False)
+
+        def hybrid_forward(self, F, x):
+            return x + self.fc(x)
+
+    mx.random.seed(4)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"))
+    net.add(Residual(8))
+    net.add(nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    rng = np.random.RandomState(7)
+    x = nd.array(rng.rand(4, 6).astype(np.float32))
+    _ = net(x)
+    calib = _Batches([rng.rand(8, 6).astype(np.float32) for _ in range(3)])
+    qnet = q.quantize_net(net, calib, num_calib_batches=3)
+    f = net(x).asnumpy()
+    g = qnet(x).asnumpy()
+    rel = np.abs(f - g).max() / (np.abs(f).max() + 1e-9)
+    assert rel < 0.1, rel
+
+
+def test_quantize_net_last_layer_fused_relu():
+    mx.random.seed(5)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"))
+    net.add(nn.Dense(4, activation="relu"))  # fused relu on the LAST layer
+    net.initialize(mx.init.Xavier())
+    rng = np.random.RandomState(8)
+    x = nd.array((rng.rand(6, 5).astype(np.float32) - 0.5) * 4)
+    _ = net(x)
+    calib = _Batches([(rng.rand(8, 5).astype(np.float32) - 0.5) * 4
+                      for _ in range(3)])
+    qnet = q.quantize_net(net, calib, num_calib_batches=3)
+    g = qnet(x).asnumpy()
+    assert (g >= 0).all(), "last-layer fused relu was dropped"
+    f = net(x).asnumpy()
+    assert np.abs(f - g).max() / (np.abs(f).max() + 1e-9) < 0.1
