@@ -1,4 +1,13 @@
-"""Training callbacks (ref: python/mxnet/callback.py)."""
+"""Training callbacks.
+
+Capability parity with the reference's callback set (ref:
+python/mxnet/callback.py), re-expressed in this framework's idiom: periodic
+behavior is one `_every` combinator applied to plain functions, and the
+Speedometer is a small timer state machine (`_Window`) separated from its
+logging. The callback signatures are unchanged — epoch-end callbacks get
+(iter_no, sym, arg, aux); batch-end callbacks get a BatchEndParam-style
+object with .epoch/.nbatch/.eval_metric.
+"""
 from __future__ import annotations
 
 import logging
@@ -6,92 +15,109 @@ import time
 
 from .model import save_checkpoint
 
-__all__ = ["Speedometer", "do_checkpoint", "module_checkpoint", "log_train_metric", "ProgressBar"]
+__all__ = ["Speedometer", "do_checkpoint", "module_checkpoint",
+           "log_train_metric", "ProgressBar"]
+
+
+def _every(period, fn):
+    """Run `fn` on every `period`-th 1-based tick."""
+    period = int(max(1, period))
+
+    def _callback(tick, *args, **kwargs):
+        if (tick + 1) % period == 0:
+            fn(tick + 1, *args, **kwargs)
+
+    return _callback
 
 
 def do_checkpoint(prefix, period=1):
-    """(ref: callback.py do_checkpoint)"""
-    period = int(max(1, period))
-
-    def _callback(iter_no, sym=None, arg=None, aux=None):
-        if (iter_no + 1) % period == 0:
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
-
-    return _callback
+    """Epoch-end callback saving `prefix-symbol.json` + `prefix-NNNN.params`
+    (ref: callback.py do_checkpoint)."""
+    return _every(period, lambda epoch, sym=None, arg=None, aux=None:
+                  save_checkpoint(prefix, epoch, sym, arg, aux))
 
 
 def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
-    period = int(max(1, period))
-
-    def _callback(iter_no, sym=None, arg=None, aux=None):
-        if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
-
-    return _callback
+    """Epoch-end callback delegating to the module's own checkpointing
+    (ref: callback.py module_checkpoint)."""
+    return _every(period, lambda epoch, *a:
+                  mod.save_checkpoint(prefix, epoch, save_optimizer_states))
 
 
 def log_train_metric(period, auto_reset=False):
+    """Batch-end callback logging the running training metric
+    (ref: callback.py log_train_metric)."""
+
     def _callback(param):
         if param.nbatch % period == 0 and param.eval_metric is not None:
-            name_value = param.eval_metric.get_name_value()
-            for name, value in name_value:
-                logging.info(
-                    "Iter[%d] Batch[%d] Train-%s=%f", param.epoch, param.nbatch, name, value
-                )
+            for name, value in param.eval_metric.get_name_value():
+                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                             param.epoch, param.nbatch, name, value)
             if auto_reset:
                 param.eval_metric.reset()
 
     return _callback
 
 
+class _Window:
+    """Samples/sec over the batches since the last report or reset."""
+
+    def __init__(self):
+        self.t0 = None
+        self.nbatch0 = 0
+
+    def restart(self, nbatch):
+        self.t0 = time.time()
+        self.nbatch0 = nbatch
+
+    def rate(self, nbatch, batch_size):
+        dt = time.time() - self.t0
+        return (nbatch - self.nbatch0) * batch_size / dt if dt > 0 else 0.0
+
+
 class Speedometer:
-    """Throughput logger (ref: callback.py Speedometer). Reading the metric
-    forces a device sync, same as the reference's WaitToRead."""
+    """Batch-end throughput logger (ref: callback.py Speedometer). Reading
+    the metric forces a device sync, same as the reference's WaitToRead."""
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
         self.auto_reset = auto_reset
+        self._win = _Window()
+        self._last_nbatch = 0
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    if self.auto_reset:
-                        param.eval_metric.reset()
-                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t%s"
-                    logging.info(
-                        msg, param.epoch, count, speed,
-                        "\t".join(f"{n}={v:f}" for n, v in name_value),
-                    )
-                else:
-                    logging.info(
-                        "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                        param.epoch, count, speed,
-                    )
-                self.tic = time.time()
+        wrapped = param.nbatch < self._last_nbatch  # new epoch restarted at 0
+        self._last_nbatch = param.nbatch
+        if self._win.t0 is None or wrapped:
+            self._win.restart(param.nbatch)
+            return
+        if param.nbatch % self.frequent != 0 or param.nbatch == self._win.nbatch0:
+            return
+        speed = self._win.rate(param.nbatch, self.batch_size)
+        if param.eval_metric is not None:
+            pairs = param.eval_metric.get_name_value()
+            if self.auto_reset:
+                param.eval_metric.reset()
+            logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t%s",
+                         param.epoch, param.nbatch, speed,
+                         "\t".join(f"{n}={v:f}" for n, v in pairs))
         else:
-            self.init = True
-            self.tic = time.time()
+            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                         param.epoch, param.nbatch, speed)
+        self._win.restart(param.nbatch)
 
 
 class ProgressBar:
+    """Batch-end textual progress bar (ref: callback.py ProgressBar)."""
+
     def __init__(self, total, length=80):
-        self.bar_len = length
         self.total = total
+        self.length = length
 
     def __call__(self, param):
-        count = param.nbatch
-        filled_len = int(round(self.bar_len * count / float(self.total)))
-        percents = int(round(100.0 * count / float(self.total)))
-        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
-        logging.info("[%s] %s%s", prog_bar, percents, "%")
+        frac = param.nbatch / float(self.total)
+        filled = int(round(self.length * frac))
+        logging.info("[%s] %s%%",
+                     "=" * filled + "-" * (self.length - filled),
+                     int(round(100 * frac)))

@@ -1,4 +1,16 @@
-"""Gluon losses (ref: python/mxnet/gluon/loss.py)."""
+"""Training losses, table-driven.
+
+Capability parity with the reference's loss zoo (ref:
+python/mxnet/gluon/loss.py), re-expressed in this framework's idiom: each
+elementwise loss is a single declarative formula in `_LOSS_TABLE`; one
+generic `Loss` engine owns the shared protocol (label/pred alignment,
+sample weighting, per-sample batch mean). Structured losses whose reduction
+isn't elementwise (softmax CE, CTC, triplet, cosine) are explicit classes
+over the same engine.
+
+All formulas run through `F`, so every loss works identically in eager and
+hybridized/symbolic tracing.
+"""
 from __future__ import annotations
 
 from .block import HybridBlock
@@ -11,23 +23,23 @@ __all__ = [
 ]
 
 
-def _apply_weighting(F, loss, weight=None, sample_weight=None):
-    if sample_weight is not None:
-        loss = F.broadcast_mul(loss, sample_weight)
-    if weight is not None:
-        loss = loss * weight
-    return loss
-
-
-def _reshape_like(F, x, y):
-    return F.reshape_like(x, y)
-
-
 class Loss(HybridBlock):
-    def __init__(self, weight, batch_axis, **kwargs):
+    """Shared loss protocol: optional sample_weight scaling, constant
+    weight scaling, and mean over all non-batch axes."""
+
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
         super().__init__(**kwargs)
         self._weight = weight
         self._batch_axis = batch_axis
+
+    def _finish(self, F, loss, sample_weight, mean=True):
+        if sample_weight is not None:
+            loss = F.broadcast_mul(loss, sample_weight)
+        if self._weight is not None and self._weight != 1.0:
+            loss = loss * self._weight
+        if mean:
+            loss = F.mean(loss, axis=self._batch_axis, exclude=True)
+        return loss
 
     def __repr__(self):
         return f"{self.__class__.__name__}(batch_axis={self._batch_axis}, w={self._weight})"
@@ -36,95 +48,153 @@ class Loss(HybridBlock):
         raise NotImplementedError
 
 
-class L2Loss(Loss):
-    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
-        super().__init__(weight, batch_axis, **kwargs)
+def _logit_bce(F, z, y):
+    """Numerically stable BCE on logits: max(z,0) - z*y + log(1+e^-|z|)."""
+    return F.relu(z) - z * y + F.Activation(-F.abs(z), act_type="softrelu")
+
+
+# name -> (formula(F, pred, aligned_label) -> elementwise loss, extra ctor
+# params with defaults, docstring)
+_LOSS_TABLE = {
+    "L2Loss": (
+        lambda F, p, y, s: 0.5 * F.square(y - p),
+        {},
+        "mean of 0.5 (pred - label)^2",
+    ),
+    "L1Loss": (
+        lambda F, p, y, s: F.abs(y - p),
+        {},
+        "mean of |pred - label|",
+    ),
+    "HingeLoss": (
+        lambda F, p, y, s: F.relu(s["margin"] - p * y),
+        {"margin": 1},
+        "mean of max(0, margin - pred*label), labels in {-1, +1}",
+    ),
+    "SquaredHingeLoss": (
+        lambda F, p, y, s: F.square(F.relu(s["margin"] - p * y)),
+        {"margin": 1},
+        "mean of max(0, margin - pred*label)^2, labels in {-1, +1}",
+    ),
+    "HuberLoss": (
+        lambda F, p, y, s: F.where(
+            F.abs(y - p) > s["rho"],
+            F.abs(y - p) - 0.5 * s["rho"],
+            (0.5 / s["rho"]) * F.square(y - p)),
+        {"rho": 1},
+        "smoothed L1: quadratic inside rho, linear outside",
+    ),
+    "LogisticLoss": (
+        lambda F, p, y, s: _logit_bce(
+            F, p, (y + 1.0) / 2.0 if s["label_format"] == "signed" else y),
+        {"label_format": "signed"},
+        "binary logistic loss on logits; labels signed {-1,1} or binary {0,1}",
+    ),
+}
+
+
+def _make_elementwise_loss(name, formula, params, doc):
+    # positional order matches the reference signatures: the loss's own
+    # params first (e.g. HuberLoss(rho, ...)), then weight, batch_axis
+    arg_names = list(params) + ["weight", "batch_axis"]
+    defaults = {**params, "weight": 1.0, "batch_axis": 0}
+
+    def __init__(self, *args, **kwargs):
+        if len(args) > len(arg_names):
+            raise TypeError(f"{name} takes at most {len(arg_names)} "
+                            f"positional arguments")
+        for n, v in zip(arg_names, args):
+            if n in kwargs:
+                raise TypeError(f"{name} got multiple values for {n!r}")
+            kwargs[n] = v
+        own = {k: kwargs.pop(k, defaults[k]) for k in params}
+        Loss.__init__(self, kwargs.pop("weight", 1.0),
+                      kwargs.pop("batch_axis", 0), **kwargs)
+        self._p = own
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.square(label - pred)
-        loss = _apply_weighting(F, loss, self._weight / 2, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        label = F.reshape_like(label, pred)
+        return self._finish(F, formula(F, pred, label, self._p), sample_weight)
+
+    cls = type(name, (Loss,), {
+        "__init__": __init__,
+        "hybrid_forward": hybrid_forward,
+        "__doc__": f"{doc} (ref: loss.py {name})",
+    })
+    return cls
 
 
-class L1Loss(Loss):
-    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
-        super().__init__(weight, batch_axis, **kwargs)
-
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.abs(label - pred)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+for _name, (_formula, _params, _doc) in _LOSS_TABLE.items():
+    globals()[_name] = _make_elementwise_loss(_name, _formula, _params, _doc)
 
 
 class SigmoidBinaryCrossEntropyLoss(Loss):
+    """BCE over sigmoid outputs or (default) raw logits via the stable
+    log-sum-exp form (ref: loss.py SigmoidBinaryCrossEntropyLoss)."""
+
     def __init__(self, from_sigmoid=False, weight=1.0, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._from_sigmoid = from_sigmoid
 
     def hybrid_forward(self, F, pred, label, sample_weight=None, pos_weight=None):
-        label = _reshape_like(F, label, pred)
-        if not self._from_sigmoid:
-            # log-sum-exp stable bce on logits
-            loss = F.relu(pred) - pred * label + F.Activation(-F.abs(pred), act_type="softrelu")
-        else:
+        label = F.reshape_like(label, pred)
+        if self._from_sigmoid:
             eps = 1e-12
-            loss = -(F.log(pred + eps) * label + F.log(1.0 - pred + eps) * (1.0 - label))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
-
-
-SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+            loss = -(F.log(pred + eps) * label
+                     + F.log(1.0 - pred + eps) * (1.0 - label))
+        else:
+            loss = _logit_bce(F, pred, label)
+        return self._finish(F, loss, sample_weight)
 
 
 class SoftmaxCrossEntropyLoss(Loss):
-    """(ref: loss.py SoftmaxCrossEntropyLoss)"""
+    """CE over an axis: sparse integer labels gather their log-prob; dense
+    labels contract against log-probs (ref: loss.py SoftmaxCrossEntropyLoss)."""
 
-    def __init__(self, axis=-1, sparse_label=True, from_logits=False, weight=1.0,
-                 batch_axis=0, **kwargs):
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False,
+                 weight=1.0, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._axis = axis
         self._sparse_label = sparse_label
         self._from_logits = from_logits
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = F.log_softmax(pred, axis=self._axis)
+        logp = pred if self._from_logits else F.log_softmax(pred, axis=self._axis)
         if self._sparse_label:
-            loss = -F.pick(pred, label, axis=self._axis, keepdims=True)
+            nll = -F.pick(logp, label, axis=self._axis, keepdims=True)
         else:
-            label = _reshape_like(F, label, pred)
-            loss = -F.sum(pred * label, axis=self._axis, keepdims=True)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
-
-
-SoftmaxCELoss = SoftmaxCrossEntropyLoss
+            nll = -F.sum(logp * F.reshape_like(label, logp),
+                         axis=self._axis, keepdims=True)
+        return self._finish(F, nll, sample_weight)
 
 
 class KLDivLoss(Loss):
-    def __init__(self, from_logits=True, axis=-1, weight=1.0, batch_axis=0, **kwargs):
+    """KL(label || softmax(pred)); pred is log-probability when from_logits
+    (ref: loss.py KLDivLoss)."""
+
+    def __init__(self, from_logits=True, axis=-1, weight=1.0, batch_axis=0,
+                 **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._from_logits = from_logits
         self._axis = axis
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = F.log_softmax(pred, axis=self._axis)
-        loss = label * (F.log(label + 1e-12) - pred)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        logp = pred if self._from_logits else F.log_softmax(pred, axis=self._axis)
+        return self._finish(F, label * (F.log(label + 1e-12) - logp),
+                            sample_weight)
 
 
 class CTCLoss(Loss):
+    """Connectionist temporal classification over the CTCLoss op
+    (ref: loss.py CTCLoss); layouts select the time-major permutation."""
+
     def __init__(self, layout="NTC", label_layout="NT", weight=None, **kwargs):
         super().__init__(weight, 0, **kwargs)
         self._layout = layout
         self._label_layout = label_layout
 
-    def hybrid_forward(self, F, pred, label, pred_lengths=None, label_lengths=None,
-                       sample_weight=None):
+    def hybrid_forward(self, F, pred, label, pred_lengths=None,
+                       label_lengths=None, sample_weight=None):
         if self._layout == "NTC":
             pred = F.swapaxes(pred, dim1=0, dim2=1)
         if self._label_layout == "TN":
@@ -132,91 +202,42 @@ class CTCLoss(Loss):
         loss = F.CTCLoss(pred, label, pred_lengths, label_lengths,
                          use_data_lengths=pred_lengths is not None,
                          use_label_lengths=label_lengths is not None)
-        return _apply_weighting(F, loss, self._weight, sample_weight)
-
-
-class HuberLoss(Loss):
-    def __init__(self, rho=1, weight=1.0, batch_axis=0, **kwargs):
-        super().__init__(weight, batch_axis, **kwargs)
-        self._rho = rho
-
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.abs(label - pred)
-        loss = F.where(
-            loss > self._rho,
-            loss - 0.5 * self._rho,
-            (0.5 / self._rho) * F.square(loss),
-        )
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
-
-
-class HingeLoss(Loss):
-    def __init__(self, margin=1, weight=1.0, batch_axis=0, **kwargs):
-        super().__init__(weight, batch_axis, **kwargs)
-        self._margin = margin
-
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.relu(self._margin - pred * label)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
-
-
-class SquaredHingeLoss(Loss):
-    def __init__(self, margin=1, weight=1.0, batch_axis=0, **kwargs):
-        super().__init__(weight, batch_axis, **kwargs)
-        self._margin = margin
-
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.square(F.relu(self._margin - pred * label))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
-
-
-class LogisticLoss(Loss):
-    def __init__(self, weight=1.0, batch_axis=0, label_format="signed", **kwargs):
-        super().__init__(weight, batch_axis, **kwargs)
-        self._label_format = label_format
-
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        if self._label_format == "signed":
-            label = (label + 1.0) / 2.0
-        loss = F.relu(pred) - pred * label + F.Activation(-F.abs(pred), act_type="softrelu")
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        return self._finish(F, loss, sample_weight, mean=False)
 
 
 class TripletLoss(Loss):
+    """max(0, margin + d(pred, pos) - d(pred, neg)) with squared-L2
+    distances summed per sample (ref: loss.py TripletLoss)."""
+
     def __init__(self, margin=1, weight=1.0, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._margin = margin
 
     def hybrid_forward(self, F, pred, positive, negative, sample_weight=None):
-        positive = _reshape_like(F, positive, pred)
-        negative = _reshape_like(F, negative, pred)
-        loss = F.sum(F.square(positive - pred) - F.square(negative - pred),
-                     axis=self._batch_axis, exclude=True)
-        loss = F.relu(loss + self._margin)
-        return _apply_weighting(F, loss, self._weight, sample_weight)
+        d_pos = F.square(F.reshape_like(positive, pred) - pred)
+        d_neg = F.square(F.reshape_like(negative, pred) - pred)
+        gap = F.sum(d_pos - d_neg, axis=self._batch_axis, exclude=True)
+        return self._finish(F, F.relu(gap + self._margin), sample_weight,
+                            mean=False)
 
 
 class CosineEmbeddingLoss(Loss):
+    """1 - cos(a, b) for matching pairs, max(0, cos - margin) for
+    non-matching (ref: loss.py CosineEmbeddingLoss)."""
+
     def __init__(self, weight=1.0, batch_axis=0, margin=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._margin = margin
 
     def hybrid_forward(self, F, input1, input2, label, sample_weight=None):
-        input1 = input1.reshape((input1.shape[0], -1))
-        input2 = input2.reshape((input2.shape[0], -1))
-        num = F.sum(input1 * input2, axis=1)
-        denom = F.sqrt(F.sum(F.square(input1), axis=1) * F.sum(F.square(input2), axis=1) + 1e-12)
-        cos = num / denom
-        label = label.reshape((-1,))
-        pos = 1.0 - cos
-        neg = F.relu(cos - self._margin)
-        loss = F.where(label == 1, pos, neg)
-        return _apply_weighting(F, loss, self._weight, sample_weight)
+        a = input1.reshape((input1.shape[0], -1))
+        b = input2.reshape((input2.shape[0], -1))
+        cos = F.sum(a * b, axis=1) / F.sqrt(
+            F.sum(F.square(a), axis=1) * F.sum(F.square(b), axis=1) + 1e-12)
+        loss = F.where(label.reshape((-1,)) == 1,
+                       1.0 - cos, F.relu(cos - self._margin))
+        return self._finish(F, loss, sample_weight, mean=False)
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+SoftmaxCELoss = SoftmaxCrossEntropyLoss

@@ -1,103 +1,98 @@
-"""DenseNet 121/161/169/201 (ref: python/mxnet/gluon/model_zoo/vision/densenet.py)."""
+"""DenseNet 121/161/169/201, motif-driven.
+
+Architectures per Huang et al. 1608.06993. Capability parity with the
+reference zoo (ref: python/mxnet/gluon/model_zoo/vision/densenet.py),
+re-expressed in this framework's idiom: DenseNet is three repetitions of a
+single BN->relu->conv motif — the bottleneck pair inside a dense layer, the
+1x1 in a transition, and the final head — so `_bn_relu_conv` is the one
+building block and everything else is wiring plus the channel arithmetic.
+"""
+from functools import partial
+
 from ...block import HybridBlock
 from ... import nn
 
-__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169", "densenet201"]
+__all__ = ["DenseNet", "densenet_spec", "densenet121", "densenet161",
+           "densenet169", "densenet201"]
+
+# depth -> (stem channels, growth rate, layers per dense block)
+densenet_spec = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+}
+
+
+def _bn_relu_conv(seq, channels, kernel, padding=0):
+    """The DenseNet motif: pre-activation conv appended to `seq`."""
+    seq.add(nn.BatchNorm())
+    seq.add(nn.Activation("relu"))
+    seq.add(nn.Conv2D(channels, kernel_size=kernel, padding=padding,
+                      use_bias=False))
 
 
 class _DenseLayer(HybridBlock):
+    """Bottleneck (1x1 to bn_size*k, then 3x3 to k) whose output is
+    concatenated onto its input along channels."""
+
     def __init__(self, growth_rate, bn_size, dropout, **kwargs):
         super().__init__(**kwargs)
         self.body = nn.HybridSequential(prefix="")
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(bn_size * growth_rate, kernel_size=1, use_bias=False))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(growth_rate, kernel_size=3, padding=1, use_bias=False))
-        self.dropout = nn.Dropout(dropout) if dropout else None
+        _bn_relu_conv(self.body, bn_size * growth_rate, kernel=1)
+        _bn_relu_conv(self.body, growth_rate, kernel=3, padding=1)
+        if dropout:
+            self.body.add(nn.Dropout(dropout))
 
     def hybrid_forward(self, F, x):
-        out = self.body(x)
-        if self.dropout:
-            out = self.dropout(out)
-        return F.Concat(x, out, dim=1)
-
-
-def _make_dense_block(num_layers, bn_size, growth_rate, dropout, stage_index):
-    out = nn.HybridSequential(prefix=f"stage{stage_index}_")
-    with out.name_scope():
-        for _ in range(num_layers):
-            out.add(_DenseLayer(growth_rate, bn_size, dropout))
-    return out
-
-
-def _make_transition(num_output_features):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.BatchNorm())
-    out.add(nn.Activation("relu"))
-    out.add(nn.Conv2D(num_output_features, kernel_size=1, use_bias=False))
-    out.add(nn.AvgPool2D(pool_size=2, strides=2))
-    return out
+        return F.Concat(x, self.body(x), dim=1)
 
 
 class DenseNet(HybridBlock):
+    """Stem -> [dense block -> halving transition]* -> head."""
+
     def __init__(self, num_init_features, growth_rate, block_config,
                  bn_size=4, dropout=0, classes=1000, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            self.features.add(nn.Conv2D(num_init_features, kernel_size=7,
-                                        strides=2, padding=3, use_bias=False))
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2, padding=1))
-            num_features = num_init_features
-            for i, num_layers in enumerate(block_config):
-                self.features.add(_make_dense_block(num_layers, bn_size, growth_rate,
-                                                    dropout, i + 1))
-                num_features = num_features + num_layers * growth_rate
-                if i != len(block_config) - 1:
-                    self.features.add(_make_transition(num_features // 2))
-                    num_features = num_features // 2
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.AvgPool2D(pool_size=7))
-            self.features.add(nn.Flatten())
+            feats = nn.HybridSequential(prefix="")
+            feats.add(nn.Conv2D(num_init_features, kernel_size=7, strides=2,
+                                padding=3, use_bias=False))
+            feats.add(nn.BatchNorm())
+            feats.add(nn.Activation("relu"))
+            feats.add(nn.MaxPool2D(pool_size=3, strides=2, padding=1))
+            channels = num_init_features
+            for i, n_layers in enumerate(block_config):
+                block = nn.HybridSequential(prefix=f"stage{i + 1}_")
+                with block.name_scope():
+                    for _ in range(n_layers):
+                        block.add(_DenseLayer(growth_rate, bn_size, dropout))
+                feats.add(block)
+                channels += n_layers * growth_rate
+                if i + 1 < len(block_config):
+                    channels //= 2  # transition halves the channel count
+                    _bn_relu_conv(feats, channels, kernel=1)
+                    feats.add(nn.AvgPool2D(pool_size=2, strides=2))
+            feats.add(nn.BatchNorm())
+            feats.add(nn.Activation("relu"))
+            feats.add(nn.AvgPool2D(pool_size=7))
+            feats.add(nn.Flatten())
+            self.features = feats
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
-densenet_spec = {
-    121: (64, 32, [6, 12, 24, 16]),
-    161: (96, 48, [6, 12, 36, 24]),
-    169: (64, 32, [6, 12, 32, 32]),
-    201: (64, 32, [6, 12, 48, 32]),
-}
-
-
-def _get(num_layers, pretrained=False, **kwargs):
+def _get_densenet(depth, pretrained=False, **kwargs):
     if pretrained:
         raise RuntimeError("no network egress: load weights via load_parameters")
-    num_init_features, growth_rate, block_config = densenet_spec[num_layers]
-    return DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+    stem, growth, blocks = densenet_spec[depth]
+    return DenseNet(stem, growth, blocks, **kwargs)
 
 
-def densenet121(**kwargs):
-    return _get(121, **kwargs)
-
-
-def densenet161(**kwargs):
-    return _get(161, **kwargs)
-
-
-def densenet169(**kwargs):
-    return _get(169, **kwargs)
-
-
-def densenet201(**kwargs):
-    return _get(201, **kwargs)
+for _d in densenet_spec:
+    _fn = partial(_get_densenet, _d)
+    _fn.__name__ = f"densenet{_d}"
+    _fn.__doc__ = f"DenseNet-{_d} (see densenet_spec)."
+    globals()[f"densenet{_d}"] = _fn

@@ -1,4 +1,17 @@
-"""MobileNet v1/v2 (ref: python/mxnet/gluon/model_zoo/vision/mobilenet.py)."""
+"""MobileNet v1/v2, spec-table driven.
+
+Architectures per Howard et al. 1704.04861 (v1, depthwise-separable stacks)
+and Sandler et al. 1801.04381 (v2, inverted residuals). Capability parity
+with the reference zoo (ref: python/mxnet/gluon/model_zoo/vision/
+mobilenet.py), re-expressed in this framework's idiom: each network is a
+flat spec table — v1 rows are (out_channels, stride) separable units, v2
+rows are (expansion, out_channels, stride, repeats) bottleneck groups — and
+a single `_cba` (conv-BN-activation) helper is the only conv constructor in
+the file. Width multipliers are applied when reading the table, not baked
+into per-variant classes.
+"""
+from functools import partial
+
 from ...block import HybridBlock
 from ... import nn
 
@@ -6,142 +19,121 @@ __all__ = ["MobileNet", "MobileNetV2", "mobilenet1_0", "mobilenet0_75",
            "mobilenet0_5", "mobilenet0_25", "mobilenet_v2_1_0",
            "mobilenet_v2_0_75", "mobilenet_v2_0_5", "mobilenet_v2_0_25"]
 
+# v1: (out_channels, stride) per depthwise-separable unit
+V1_SPEC = ((64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+           (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+           (1024, 1))
 
-def _add_conv(out, channels=1, kernel=1, stride=1, pad=0, num_group=1,
-              active=True, relu6=False):
-    out.add(nn.Conv2D(channels, kernel, stride, pad, groups=num_group, use_bias=False))
-    out.add(nn.BatchNorm(scale=True))
-    if active:
-        out.add(_RELU6() if relu6 else nn.Activation("relu"))
+# v2: (expansion t, out_channels, stride, repeats) per bottleneck group
+V2_SPEC = ((1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 2, 3), (6, 64, 2, 4),
+           (6, 96, 1, 3), (6, 160, 2, 3), (6, 320, 1, 1))
 
 
-class _RELU6(HybridBlock):
+class _ReLU6(HybridBlock):
     def hybrid_forward(self, F, x):
         return F.clip(x, a_min=0.0, a_max=6.0)
 
 
-def _add_conv_dw(out, dw_channels, channels, stride, relu6=False):
-    _add_conv(out, dw_channels, kernel=3, stride=stride, pad=1,
-              num_group=dw_channels, relu6=relu6)
-    _add_conv(out, channels, relu6=relu6)
+def _cba(seq, channels, kernel=1, stride=1, pad=0, groups=1, act="relu"):
+    """conv -> BN -> activation; act in {'relu', 'relu6', None}."""
+    seq.add(nn.Conv2D(channels, kernel, stride, pad, groups=groups,
+                      use_bias=False))
+    seq.add(nn.BatchNorm(scale=True))
+    if act == "relu":
+        seq.add(nn.Activation("relu"))
+    elif act == "relu6":
+        seq.add(_ReLU6())
 
 
-class LinearBottleneck(HybridBlock):
-    """(ref: mobilenet.py LinearBottleneck — the v2 inverted residual)"""
+class InvertedResidual(HybridBlock):
+    """v2 unit: 1x1 expand -> 3x3 depthwise -> linear 1x1 project, with an
+    identity shortcut when the unit preserves shape."""
 
     def __init__(self, in_channels, channels, t, stride, **kwargs):
         super().__init__(**kwargs)
-        self.use_shortcut = stride == 1 and in_channels == channels
+        self._shortcut = stride == 1 and in_channels == channels
+        mid = in_channels * t
         with self.name_scope():
-            self.out = nn.HybridSequential()
-            _add_conv(self.out, in_channels * t, relu6=True)
-            _add_conv(self.out, in_channels * t, kernel=3, stride=stride, pad=1,
-                      num_group=in_channels * t, relu6=True)
-            _add_conv(self.out, channels, active=False, relu6=True)
+            self.body = nn.HybridSequential()
+            _cba(self.body, mid, act="relu6")
+            _cba(self.body, mid, kernel=3, stride=stride, pad=1, groups=mid,
+                 act="relu6")
+            _cba(self.body, channels, act=None)
 
     def hybrid_forward(self, F, x):
-        out = self.out(x)
-        if self.use_shortcut:
-            out = out + x
-        return out
+        out = self.body(x)
+        return out + x if self._shortcut else out
+
+
+# keep the reference zoo's class name for the v2 unit
+LinearBottleneck = InvertedResidual
 
 
 class MobileNet(HybridBlock):
+    """v1: stem + a stack of depthwise-separable units from V1_SPEC."""
+
     def __init__(self, multiplier=1.0, classes=1000, **kwargs):
         super().__init__(**kwargs)
+        scale = lambda c: int(c * multiplier)  # noqa: E731
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            _add_conv(self.features, channels=int(32 * multiplier), kernel=3,
-                      pad=1, stride=2)
-            dw_channels = [int(x * multiplier) for x in
-                           [32, 64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024]]
-            channels = [int(x * multiplier) for x in
-                        [64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024] * 2]
-            strides = [1, 2] * 3 + [1] * 5 + [2, 1]
-            for dwc, c, s in zip(dw_channels, channels, strides):
-                _add_conv_dw(self.features, dw_channels=dwc, channels=c, stride=s)
-            self.features.add(nn.GlobalAvgPool2D())
-            self.features.add(nn.Flatten())
+            feats = nn.HybridSequential(prefix="")
+            _cba(feats, scale(32), kernel=3, stride=2, pad=1)
+            in_c = scale(32)
+            for out_c, stride in V1_SPEC:
+                # depthwise 3x3 on in_c channels, then pointwise to out_c
+                _cba(feats, in_c, kernel=3, stride=stride, pad=1, groups=in_c)
+                _cba(feats, scale(out_c))
+                in_c = scale(out_c)
+            feats.add(nn.GlobalAvgPool2D())
+            feats.add(nn.Flatten())
+            self.features = feats
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 class MobileNetV2(HybridBlock):
+    """v2: stem + inverted-residual groups from V2_SPEC + 1280-wide head
+    with a 1x1-conv classifier."""
+
     def __init__(self, multiplier=1.0, classes=1000, **kwargs):
         super().__init__(**kwargs)
+        scale = lambda c: int(c * multiplier)  # noqa: E731
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="features_")
-            with self.features.name_scope():
-                _add_conv(self.features, int(32 * multiplier), kernel=3, stride=2,
-                          pad=1, relu6=True)
-                in_channels_group = [int(x * multiplier) for x in
-                                     [32] + [16] + [24] * 2 + [32] * 3 + [64] * 4
-                                     + [96] * 3 + [160] * 3]
-                channels_group = [int(x * multiplier) for x in
-                                  [16] + [24] * 2 + [32] * 3 + [64] * 4 + [96] * 3
-                                  + [160] * 3 + [320]]
-                ts = [1] + [6] * 16
-                strides = [1, 2] * 2 + [1, 1, 2] + [1] * 6 + [2] + [1] * 3
-                for in_c, c, t, s in zip(in_channels_group, channels_group, ts, strides):
-                    self.features.add(LinearBottleneck(in_channels=in_c, channels=c,
-                                                       t=t, stride=s))
-                last_channels = int(1280 * multiplier) if multiplier > 1.0 else 1280
-                _add_conv(self.features, last_channels, relu6=True)
-                self.features.add(nn.GlobalAvgPool2D())
+            feats = nn.HybridSequential(prefix="features_")
+            with feats.name_scope():
+                _cba(feats, scale(32), kernel=3, stride=2, pad=1, act="relu6")
+                in_c = scale(32)
+                for t, out_c, stride, repeats in V2_SPEC:
+                    for j in range(repeats):
+                        feats.add(InvertedResidual(
+                            in_c, scale(out_c), t, stride if j == 0 else 1))
+                        in_c = scale(out_c)
+                head = int(1280 * multiplier) if multiplier > 1.0 else 1280
+                _cba(feats, head, act="relu6")
+                feats.add(nn.GlobalAvgPool2D())
+            self.features = feats
             self.output = nn.HybridSequential(prefix="output_")
             with self.output.name_scope():
-                self.output.add(nn.Conv2D(classes, 1, use_bias=False, prefix="pred_"),
+                self.output.add(nn.Conv2D(classes, 1, use_bias=False,
+                                          prefix="pred_"),
                                 nn.Flatten())
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
-def _get_v1(multiplier, pretrained=False, **kwargs):
+def _get(cls, multiplier, pretrained=False, **kwargs):
     if pretrained:
         raise RuntimeError("no network egress: load weights via load_parameters")
-    return MobileNet(multiplier, **kwargs)
+    return cls(multiplier, **kwargs)
 
 
-def _get_v2(multiplier, pretrained=False, **kwargs):
-    if pretrained:
-        raise RuntimeError("no network egress: load weights via load_parameters")
-    return MobileNetV2(multiplier, **kwargs)
-
-
-def mobilenet1_0(**kwargs):
-    return _get_v1(1.0, **kwargs)
-
-
-def mobilenet0_75(**kwargs):
-    return _get_v1(0.75, **kwargs)
-
-
-def mobilenet0_5(**kwargs):
-    return _get_v1(0.5, **kwargs)
-
-
-def mobilenet0_25(**kwargs):
-    return _get_v1(0.25, **kwargs)
-
-
-def mobilenet_v2_1_0(**kwargs):
-    return _get_v2(1.0, **kwargs)
-
-
-def mobilenet_v2_0_75(**kwargs):
-    return _get_v2(0.75, **kwargs)
-
-
-def mobilenet_v2_0_5(**kwargs):
-    return _get_v2(0.5, **kwargs)
-
-
-def mobilenet_v2_0_25(**kwargs):
-    return _get_v2(0.25, **kwargs)
+for _m, _tag in ((1.0, "1_0"), (0.75, "0_75"), (0.5, "0_5"), (0.25, "0_25")):
+    for _cls, _name in ((MobileNet, f"mobilenet{_tag}"),
+                        (MobileNetV2, f"mobilenet_v2_{_tag}")):
+        _fn = partial(_get, _cls, _m)
+        _fn.__name__ = _name
+        _fn.__doc__ = f"{_cls.__name__} with width multiplier {_m}."
+        globals()[_name] = _fn

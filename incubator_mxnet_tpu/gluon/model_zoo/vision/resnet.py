@@ -1,261 +1,175 @@
-"""ResNet v1/v2 (ref: python/mxnet/gluon/model_zoo/vision/resnet.py —
-architectures per He et al. 1512.03385 / 1603.05027)."""
+"""ResNet v1/v2 model zoo, config-driven.
+
+Architectures per He et al. (1512.03385 residual networks, 1603.05027
+pre-activation variant). Capability parity with the reference's model zoo
+(ref: python/mxnet/gluon/model_zoo/vision/resnet.py), re-expressed in this
+framework's idiom: one parameterized residual unit driven by a declarative
+conv plan instead of four hand-written block classes, and one ResNet class
+covering both the post-activation (v1) and pre-activation (v2) orderings.
+"""
 from __future__ import annotations
+
+from functools import partial
 
 from ...block import HybridBlock
 from ... import nn
 
-__all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
-           "BottleneckV1", "BottleneckV2", "resnet18_v1", "resnet34_v1",
-           "resnet50_v1", "resnet101_v1", "resnet152_v1", "resnet18_v2",
-           "resnet34_v2", "resnet50_v2", "resnet101_v2", "resnet152_v2",
-           "get_resnet"]
+__all__ = ["ResNet", "ResidualUnit", "get_resnet", "resnet_spec",
+           "resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
+           "resnet152_v1", "resnet18_v2", "resnet34_v2", "resnet50_v2",
+           "resnet101_v2", "resnet152_v2"]
 
 
-def _conv3x3(channels, stride, in_channels):
-    return nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1,
-                     use_bias=False, in_channels=in_channels)
+# depth -> (bottleneck?, units per stage, channels per stage)
+resnet_spec = {
+    18: (False, (2, 2, 2, 2), (64, 64, 128, 256, 512)),
+    34: (False, (3, 4, 6, 3), (64, 64, 128, 256, 512)),
+    50: (True, (3, 4, 6, 3), (64, 256, 512, 1024, 2048)),
+    101: (True, (3, 4, 23, 3), (64, 256, 512, 1024, 2048)),
+    152: (True, (3, 8, 36, 3), (64, 256, 512, 1024, 2048)),
+}
 
 
-class BasicBlockV1(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
+def _conv_plan(channels, stride, bottleneck, version):
+    """Declarative conv stack for one residual unit:
+    (out_channels, kernel, stride, padding, use_bias) per conv.
+
+    The stride placement matches the reference zoo: v1 bottlenecks stride on
+    the first 1x1 (torch-style), v2 bottlenecks stride on the 3x3."""
+    if not bottleneck:
+        return ((channels, 3, stride, 1, False),
+                (channels, 3, 1, 1, False))
+    mid = channels // 4
+    if version == 1:
+        return ((mid, 1, stride, 0, True),
+                (mid, 3, 1, 1, False),
+                (channels, 1, 1, 0, True))
+    return ((mid, 1, 1, 0, False),
+            (mid, 3, stride, 1, False),
+            (channels, 1, 1, 0, False))
+
+
+class ResidualUnit(HybridBlock):
+    """One residual unit, v1 or v2 ordering.
+
+    v1 (post-activation):  out = relu(x + bn(conv(...relu(bn(conv(x))))))
+                           identity branch: 1x1-conv + BN when downsampling
+    v2 (pre-activation):   h = relu(bn(x)); out = x' + conv(...relu(bn(conv(h))))
+                           identity branch: 1x1-conv of h, no BN
+    """
+
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 version=1, bottleneck=False, **kwargs):
         super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(_conv3x3(channels, stride, in_channels))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels, 1, channels))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1, strides=stride,
-                                          use_bias=False, in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
+        self._version = version
+        plan = _conv_plan(channels, stride, bottleneck, version)
+        # v1: norms[i] FOLLOWS convs[i]; v2: norms[i] PRECEDES convs[i]
+        self.convs = nn.HybridSequential(prefix="")
+        self.norms = nn.HybridSequential(prefix="")
+        for c, k, s, p, bias in plan:
+            self.convs.add(nn.Conv2D(c, kernel_size=k, strides=s, padding=p,
+                                     use_bias=bias))
+            self.norms.add(nn.BatchNorm())
+        if not downsample:
+            self.proj = None
+            self.proj_norm = None
         else:
-            self.downsample = None
+            self.proj = nn.Conv2D(channels, kernel_size=1, strides=stride,
+                                  use_bias=False, in_channels=in_channels)
+            self.proj_norm = nn.BatchNorm() if version == 1 else None
 
     def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        return F.Activation(residual + x, act_type="relu")
+        convs = [self.convs[i] for i in range(len(self.convs))]
+        norms = [self.norms[i] for i in range(len(self.norms))]
+        if self._version == 1:
+            h = x
+            for i, conv in enumerate(convs):
+                h = norms[i](conv(h))
+                if i < len(convs) - 1:
+                    h = F.Activation(h, act_type="relu")
+            skip = x if self.proj is None else self.proj_norm(self.proj(x))
+            return F.Activation(skip + h, act_type="relu")
+        # v2: BN+relu precede each conv; the first pre-activation also
+        # feeds the projection shortcut
+        h = x
+        skip = x
+        for i, conv in enumerate(convs):
+            h = F.Activation(norms[i](h), act_type="relu")
+            if i == 0 and self.proj is not None:
+                skip = self.proj(h)
+            h = conv(h)
+        return skip + h
 
 
-class BottleneckV1(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
+class ResNet(HybridBlock):
+    """Stage-configured ResNet for both orderings.
+
+    `thumbnail=True` swaps the 7x7/maxpool ImageNet stem for a single 3x3
+    (the CIFAR stem), as in the reference zoo.
+    """
+
+    def __init__(self, version, layers, channels, bottleneck, classes=1000,
+                 thumbnail=False, **kwargs):
         super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(nn.Conv2D(channels // 4, kernel_size=1, strides=stride))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels // 4, 1, channels // 4))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1, strides=stride,
-                                          use_bias=False, in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        return F.Activation(x + residual, act_type="relu")
-
-
-class BasicBlockV2(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
-        super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = _conv3x3(channels, stride, in_channels)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels, 1, channels)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        return x + residual
-
-
-class BottleneckV2(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
-        super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = nn.Conv2D(channels // 4, kernel_size=1, strides=1, use_bias=False)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels // 4, stride, channels // 4)
-        self.bn3 = nn.BatchNorm()
-        self.conv3 = nn.Conv2D(channels, kernel_size=1, strides=1, use_bias=False)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        x = self.bn3(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv3(x)
-        return x + residual
-
-
-class ResNetV1(HybridBlock):
-    def __init__(self, block, layers, channels, classes=1000, thumbnail=False, **kwargs):
-        super().__init__(**kwargs)
-        assert len(layers) == len(channels) - 1
+        assert version in (1, 2)
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
+            feats = nn.HybridSequential(prefix="")
+            if version == 2:
+                feats.add(nn.BatchNorm(scale=False, center=False))
             if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
+                feats.add(nn.Conv2D(channels[0], kernel_size=3, strides=1,
+                                    padding=1, use_bias=False))
             else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(block, num_layer, channels[i + 1],
-                                                   stride, i + 1, in_channels=channels[i]))
-            self.features.add(nn.GlobalAvgPool2D())
+                feats.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
+                feats.add(nn.BatchNorm())
+                feats.add(nn.Activation("relu"))
+                feats.add(nn.MaxPool2D(3, 2, 1))
+            in_c = channels[0]
+            for i, n_units in enumerate(layers):
+                stage = nn.HybridSequential(prefix=f"stage{i + 1}_")
+                with stage.name_scope():
+                    for j in range(n_units):
+                        stride = 2 if (j == 0 and i > 0) else 1
+                        stage.add(ResidualUnit(
+                            channels[i + 1], stride,
+                            downsample=(j == 0 and channels[i + 1] != in_c),
+                            in_channels=in_c, version=version,
+                            bottleneck=bottleneck, prefix=""))
+                        in_c = channels[i + 1]
+                feats.add(stage)
+            if version == 2:
+                feats.add(nn.BatchNorm())
+                feats.add(nn.Activation("relu"))
+            feats.add(nn.GlobalAvgPool2D())
+            feats.add(nn.Flatten())
+            self.features = feats
             self.output = nn.Dense(classes, in_units=channels[-1])
 
-    def _make_layer(self, block, layers, channels, stride, stage_index, in_channels=0):
-        layer = nn.HybridSequential(prefix=f"stage{stage_index}_")
-        with layer.name_scope():
-            layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=""))
-            for _ in range(layers - 1):
-                layer.add(block(channels, 1, False, in_channels=channels, prefix=""))
-        return layer
-
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
-class ResNetV2(HybridBlock):
-    def __init__(self, block, layers, channels, classes=1000, thumbnail=False, **kwargs):
-        super().__init__(**kwargs)
-        assert len(layers) == len(channels) - 1
-        with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            self.features.add(nn.BatchNorm(scale=False, center=False))
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
-            else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-            in_channels = channels[0]
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(block, num_layer, channels[i + 1],
-                                                   stride, i + 1, in_channels=in_channels))
-                in_channels = channels[i + 1]
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.GlobalAvgPool2D())
-            self.features.add(nn.Flatten())
-            self.output = nn.Dense(classes, in_units=in_channels)
-
-    _make_layer = ResNetV1._make_layer
-
-    def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
-
-
-resnet_spec = {
-    18: ("basic_block", [2, 2, 2, 2], [64, 64, 128, 256, 512]),
-    34: ("basic_block", [3, 4, 6, 3], [64, 64, 128, 256, 512]),
-    50: ("bottle_neck", [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
-    101: ("bottle_neck", [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
-    152: ("bottle_neck", [3, 8, 36, 3], [64, 256, 512, 1024, 2048]),
-}
-resnet_net_versions = [ResNetV1, ResNetV2]
-resnet_block_versions = [
-    {"basic_block": BasicBlockV1, "bottle_neck": BottleneckV1},
-    {"basic_block": BasicBlockV2, "bottle_neck": BottleneckV2},
-]
-
-
-def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None, **kwargs):
-    """(ref: resnet.py get_resnet)"""
-    block_type, layers, channels = resnet_spec[num_layers]
-    resnet_class = resnet_net_versions[version - 1]
-    block_class = resnet_block_versions[version - 1][block_type]
-    net = resnet_class(block_class, layers, channels, **kwargs)
+def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
+               **kwargs):
+    """(ref: resnet.py get_resnet — same (version, depth) addressing)"""
+    if num_layers not in resnet_spec:
+        raise ValueError(
+            f"unsupported depth {num_layers}; pick from {sorted(resnet_spec)}")
+    bottleneck, layers, channels = resnet_spec[num_layers]
+    net = ResNet(version, layers, channels, bottleneck, **kwargs)
     if pretrained:
         raise RuntimeError("no network egress: load weights via load_parameters")
     return net
 
 
-def resnet18_v1(**kwargs):
-    return get_resnet(1, 18, **kwargs)
+def _register_factories():
+    for depth in resnet_spec:
+        for version in (1, 2):
+            name = f"resnet{depth}_v{version}"
+            fn = partial(get_resnet, version, depth)
+            fn.__name__ = name
+            fn.__doc__ = f"ResNet-{depth} v{version} (see get_resnet)."
+            globals()[name] = fn
 
 
-def resnet34_v1(**kwargs):
-    return get_resnet(1, 34, **kwargs)
-
-
-def resnet50_v1(**kwargs):
-    return get_resnet(1, 50, **kwargs)
-
-
-def resnet101_v1(**kwargs):
-    return get_resnet(1, 101, **kwargs)
-
-
-def resnet152_v1(**kwargs):
-    return get_resnet(1, 152, **kwargs)
-
-
-def resnet18_v2(**kwargs):
-    return get_resnet(2, 18, **kwargs)
-
-
-def resnet34_v2(**kwargs):
-    return get_resnet(2, 34, **kwargs)
-
-
-def resnet50_v2(**kwargs):
-    return get_resnet(2, 50, **kwargs)
-
-
-def resnet101_v2(**kwargs):
-    return get_resnet(2, 101, **kwargs)
-
-
-def resnet152_v2(**kwargs):
-    return get_resnet(2, 152, **kwargs)
+_register_factories()

@@ -1,13 +1,29 @@
-"""Learning-rate schedulers (ref: python/mxnet/lr_scheduler.py)."""
+"""Learning-rate schedules.
+
+Capability parity with the reference's scheduler set (ref:
+python/mxnet/lr_scheduler.py), re-expressed in this framework's idiom:
+every schedule is a STATELESS closed-form function of `num_update` — the
+warmup ramp and the decay law compose in one place (`__call__`), and each
+subclass contributes only its decay formula. The reference instead mutates
+`base_lr`/`count` as updates stream by; closed forms make schedules safe to
+evaluate from any step (checkpoint resume, jitted lr as scalar input) and
+trivially testable.
+"""
 from __future__ import annotations
 
+import bisect
 import math
 
-__all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler", "PolyScheduler", "CosineScheduler"]
+__all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler",
+           "PolyScheduler", "CosineScheduler"]
 
 
 class LRScheduler:
-    def __init__(self, base_lr=0.01, warmup_steps=0, warmup_begin_lr=0, warmup_mode="linear"):
+    """Base: linear/quadratic warmup from warmup_begin_lr to base_lr over
+    warmup_steps, then the subclass decay law."""
+
+    def __init__(self, base_lr=0.01, warmup_steps=0, warmup_begin_lr=0,
+                 warmup_mode="linear"):
         self.base_lr = base_lr
         self.warmup_steps = warmup_steps
         self.warmup_begin_lr = warmup_begin_lr
@@ -15,83 +31,88 @@ class LRScheduler:
         self.warmup_mode = warmup_mode
 
     def get_warmup_lr(self, num_update):
+        t = num_update / self.warmup_steps
         if self.warmup_mode == "linear":
-            inc = (self.warmup_final_lr - self.warmup_begin_lr) * num_update / self.warmup_steps
-            return self.warmup_begin_lr + inc
-        return self.warmup_final_lr * (num_update / self.warmup_steps) ** 2
+            return self.warmup_begin_lr + (self.warmup_final_lr
+                                           - self.warmup_begin_lr) * t
+        return self.warmup_final_lr * t * t
+
+    def _decay(self, num_update):
+        raise NotImplementedError
 
     def __call__(self, num_update):
-        raise NotImplementedError
+        if num_update < self.warmup_steps:
+            return self.get_warmup_lr(num_update)
+        return self._decay(num_update)
 
 
 class FactorScheduler(LRScheduler):
-    def __init__(self, step, factor=1.0, stop_factor_lr=1e-8, base_lr=0.01, **kw):
+    """lr = base_lr * factor^(number of `step`-sized intervals completed),
+    floored at stop_factor_lr (ref: FactorScheduler)."""
+
+    def __init__(self, step, factor=1.0, stop_factor_lr=1e-8, base_lr=0.01,
+                 **kw):
         super().__init__(base_lr, **kw)
+        if step < 1:
+            raise ValueError("step must be >= 1")
         self.step = step
         self.factor = factor
         self.stop_factor_lr = stop_factor_lr
-        self.count = 0
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        while num_update > self.count + self.step:
-            self.count += self.step
-            self.base_lr = max(self.base_lr * self.factor, self.stop_factor_lr)
-        return self.base_lr
+    def _decay(self, num_update):
+        n = max(0, (num_update - 1) // self.step)
+        return max(self.base_lr * self.factor ** n, self.stop_factor_lr)
 
 
 class MultiFactorScheduler(LRScheduler):
+    """lr = base_lr * factor^(number of milestones passed)
+    (ref: MultiFactorScheduler)."""
+
     def __init__(self, step, factor=1.0, base_lr=0.01, **kw):
         super().__init__(base_lr, **kw)
-        self.step = list(step)
-        self.cur_step_ind = 0
+        self.step = sorted(step)
         self.factor = factor
-        self.count = 0
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        while self.cur_step_ind <= len(self.step) - 1:
-            if num_update > self.step[self.cur_step_ind]:
-                self.count = self.step[self.cur_step_ind]
-                self.cur_step_ind += 1
-                self.base_lr *= self.factor
-            else:
-                return self.base_lr
-        return self.base_lr
+    def _decay(self, num_update):
+        # milestone m is passed once num_update > m
+        n = bisect.bisect_left(self.step, num_update)
+        return self.base_lr * self.factor ** n
 
 
-class PolyScheduler(LRScheduler):
-    def __init__(self, max_update, base_lr=0.01, pwr=2, final_lr=0, **kw):
-        super().__init__(base_lr, **kw)
-        self.power = pwr
-        self.base_lr_orig = self.base_lr
-        self.max_update = max_update
-        self.final_lr = final_lr
-        self.max_steps = self.max_update - self.warmup_steps
+class _AnnealToFinal(LRScheduler):
+    """Shared shape for poly/cosine: interpolate base_lr -> final_lr over
+    [warmup_steps, max_update] by a profile of the progress fraction."""
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        if num_update <= self.max_update:
-            frac = 1 - (num_update - self.warmup_steps) / self.max_steps
-            return self.final_lr + (self.base_lr_orig - self.final_lr) * frac ** self.power
-        return self.final_lr
-
-
-class CosineScheduler(LRScheduler):
     def __init__(self, max_update, base_lr=0.01, final_lr=0, **kw):
         super().__init__(base_lr, **kw)
-        self.base_lr_orig = base_lr
         self.max_update = max_update
         self.final_lr = final_lr
-        self.max_steps = self.max_update - self.warmup_steps
+        self.max_steps = max_update - self.warmup_steps
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        if num_update <= self.max_update:
-            frac = (num_update - self.warmup_steps) / self.max_steps
-            return self.final_lr + (self.base_lr_orig - self.final_lr) * (1 + math.cos(math.pi * frac)) / 2
-        return self.final_lr
+    def _profile(self, frac):
+        raise NotImplementedError
+
+    def _decay(self, num_update):
+        if num_update > self.max_update:
+            return self.final_lr
+        frac = (num_update - self.warmup_steps) / self.max_steps
+        return self.final_lr + (self.base_lr - self.final_lr) * self._profile(frac)
+
+
+class PolyScheduler(_AnnealToFinal):
+    """Polynomial decay profile (1 - frac)^pwr (ref: PolyScheduler)."""
+
+    def __init__(self, max_update, base_lr=0.01, pwr=2, final_lr=0, **kw):
+        super().__init__(max_update, base_lr, final_lr, **kw)
+        self.power = pwr
+
+    def _profile(self, frac):
+        return (1 - frac) ** self.power
+
+
+class CosineScheduler(_AnnealToFinal):
+    """Half-cosine decay profile (1 + cos(pi frac)) / 2
+    (ref: CosineScheduler)."""
+
+    def _profile(self, frac):
+        return (1 + math.cos(math.pi * frac)) / 2

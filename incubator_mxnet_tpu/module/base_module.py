@@ -65,72 +65,61 @@ class BaseModule:
         self.forward(data_batch, is_train=True)
         self.backward()
 
-    def score(self, eval_data, eval_metric, num_batch=None, batch_end_callback=None,
-              score_end_callback=None, reset=True, epoch=0, sparse_row_id_fn=None):
-        """(ref: base_module.py:213)"""
+    def _eval_batches(self, eval_data, num_batch, reset):
+        """Inference-mode batch stream shared by score/predict/iter_predict:
+        reset, cap at num_batch, forward each batch with is_train=False."""
         assert self.binded and self.params_initialized
         if reset:
             eval_data.reset()
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                return
+            self.forward(batch, is_train=False)
+            yield nbatch, batch
+
+    def score(self, eval_data, eval_metric, num_batch=None, batch_end_callback=None,
+              score_end_callback=None, reset=True, epoch=0, sparse_row_id_fn=None):
+        """Run eval_data through the net and return metric name/value pairs
+        (ref: base_module.py:213)."""
         if not isinstance(eval_metric, _metric.EvalMetric):
             eval_metric = _metric.create(eval_metric)
         eval_metric.reset()
-        actual_num_batch = 0
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            self.update_metric(eval_metric, eval_batch.label)
-            if batch_end_callback is not None:
-                params = BatchEndParam(epoch=epoch, nbatch=nbatch, eval_metric=eval_metric, locals=locals())
-                for cb in _as_list(batch_end_callback):
-                    cb(params)
-            actual_num_batch += 1
-        if score_end_callback is not None:
-            params = BatchEndParam(epoch=epoch, nbatch=actual_num_batch, eval_metric=eval_metric, locals=locals())
-            for cb in _as_list(score_end_callback):
-                cb(params)
+        seen = 0
+        for nbatch, batch in self._eval_batches(eval_data, num_batch, reset):
+            self.update_metric(eval_metric, batch.label)
+            for cb in _as_list(batch_end_callback or []):
+                cb(BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                 eval_metric=eval_metric, locals=locals()))
+            seen = nbatch + 1
+        for cb in _as_list(score_end_callback or []):
+            cb(BatchEndParam(epoch=epoch, nbatch=seen,
+                             eval_metric=eval_metric, locals=locals()))
         return eval_metric.get_name_value()
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            outputs = self.get_outputs()
-            yield outputs, nbatch, eval_batch
+        """Yield (outputs, nbatch, batch) per eval batch (ref: iter_predict)."""
+        for nbatch, batch in self._eval_batches(eval_data, num_batch, reset):
+            yield self.get_outputs(), nbatch, batch
 
     def predict(self, eval_data, num_batch=None, merge_batches=True, reset=True,
                 always_output_list=False, sparse_row_id_fn=None):
-        """(ref: base_module.py:321)"""
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        output_list = []
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad or 0
-            outputs = [
-                out[0 : out.shape[0] - pad] if pad else out for out in self.get_outputs()
-            ]
-            output_list.append(outputs)
-        if len(output_list) == 0:
-            return output_list
-        if merge_batches:
-            num_outputs = len(output_list[0])
-            from ..ndarray import concatenate
+        """Collect per-batch outputs, pad-stripped; merged along the batch
+        axis unless merge_batches=False (ref: base_module.py:321)."""
+        per_batch = []
+        for _, batch in self._eval_batches(eval_data, num_batch, reset):
+            pad = batch.pad or 0
+            per_batch.append([o[:o.shape[0] - pad] if pad else o
+                              for o in self.get_outputs()])
+        if not per_batch:
+            return per_batch
+        if not merge_batches:
+            return per_batch
+        from ..ndarray import concatenate
 
-            merged = [
-                concatenate([out[i] for out in output_list], axis=0) for i in range(num_outputs)
-            ]
-            if num_outputs == 1 and not always_output_list:
-                return merged[0]
-            return merged
-        return output_list
+        merged = [concatenate([outs[i] for outs in per_batch], axis=0)
+                  for i in range(len(per_batch[0]))]
+        return (merged[0] if len(merged) == 1 and not always_output_list
+                else merged)
 
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
@@ -140,76 +129,33 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None, monitor=None,
             sparse_row_id_fn=None):
-        """The canonical training loop (ref: base_module.py:409)."""
+        """The canonical training loop (ref: base_module.py:409 — same
+        contract: bind/init/optimize once, then per epoch run train batches
+        with one-batch lookahead for sparse prepare, log train metrics,
+        fire callbacks, score eval_data).
+
+        Structure here is setup (`_fit_setup`) + per-epoch body
+        (`_fit_one_epoch`) rather than one long loop.
+        """
         assert num_epoch is not None, "please specify number of epochs"
-        from ..initializer import Uniform
-
-        if initializer is None:
-            initializer = Uniform(0.01)
-
-        self.bind(
-            data_shapes=train_data.provide_data,
-            label_shapes=train_data.provide_label,
-            for_training=True, force_rebind=force_rebind,
-        )
-        if monitor is not None:
-            self.install_monitor(monitor)
-        self.init_params(
-            initializer=initializer, arg_params=arg_params, aux_params=aux_params,
-            allow_missing=allow_missing, force_init=force_init,
-        )
-        self.init_optimizer(kvstore=kvstore, optimizer=optimizer, optimizer_params=optimizer_params)
-
-        if validation_metric is None:
-            validation_metric = eval_metric
-        if not isinstance(eval_metric, _metric.EvalMetric):
-            eval_metric = _metric.create(eval_metric)
+        eval_metric, validation_metric = self._fit_setup(
+            train_data, eval_metric, validation_metric, initializer,
+            arg_params, aux_params, allow_missing, force_rebind, force_init,
+            kvstore, optimizer, optimizer_params, monitor)
 
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
-            eval_metric.reset()
-            nbatch = 0
-            data_iter = iter(train_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                if isinstance(data_batch, list):
-                    self.update_metric(eval_metric, [db.label for db in data_batch], pre_sliced=True)
-                else:
-                    self.update_metric(eval_metric, data_batch.label)
-                try:
-                    next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch, sparse_row_id_fn=sparse_row_id_fn)
-                except StopIteration:
-                    end_of_batch = True
-                if monitor is not None:
-                    monitor.toc_print()
-                if end_of_batch:
-                    eval_name_vals = eval_metric.get_name_value()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(
-                        epoch=epoch, nbatch=nbatch, eval_metric=eval_metric, locals=locals()
-                    )
-                    for cb in _as_list(batch_end_callback):
-                        cb(batch_end_params)
-                nbatch += 1
+            self._fit_one_epoch(epoch, train_data, eval_metric, monitor,
+                                batch_end_callback, sparse_row_id_fn)
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                             time.time() - tic)
 
-            for name, val in eval_name_vals:
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, toc - tic)
-
+            # sync params out of the executors, then epoch-end hooks
             arg_p, aux_p = self.get_params()
-            self.set_params(arg_p, aux_p, allow_missing=False, force_init=True,
-                            allow_extra=False)
-            if epoch_end_callback is not None:
-                for cb in _as_list(epoch_end_callback):
-                    cb(epoch, self.symbol, arg_p, aux_p)
+            self.set_params(arg_p, aux_p, allow_missing=False,
+                            force_init=True, allow_extra=False)
+            for cb in _as_list(epoch_end_callback or []):
+                cb(epoch, self.symbol, arg_p, aux_p)
 
             if eval_data is not None:
                 res = self.score(
@@ -218,9 +164,64 @@ class BaseModule:
                     batch_end_callback=eval_batch_end_callback, epoch=epoch,
                 )
                 for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
-
+                    self.logger.info("Epoch[%d] Validation-%s=%f",
+                                     epoch, name, val)
             train_data.reset()
+
+    def _fit_setup(self, train_data, eval_metric, validation_metric,
+                   initializer, arg_params, aux_params, allow_missing,
+                   force_rebind, force_init, kvstore, optimizer,
+                   optimizer_params, monitor):
+        """bind -> monitor -> params -> optimizer -> metrics, once."""
+        from ..initializer import Uniform
+
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        if monitor is not None:
+            self.install_monitor(monitor)
+        self.init_params(initializer=initializer or Uniform(0.01),
+                         arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        if not isinstance(eval_metric, _metric.EvalMetric):
+            eval_metric = _metric.create(eval_metric)
+        return eval_metric, validation_metric or eval_metric
+
+    def _fit_one_epoch(self, epoch, train_data, eval_metric, monitor,
+                       batch_end_callback, sparse_row_id_fn):
+        """One pass over train_data with one-batch lookahead: the NEXT
+        batch is fetched (and sparse rows prepared) while the current
+        batch's async compute is in flight."""
+        eval_metric.reset()
+        data_iter = iter(train_data)
+        batch = next(data_iter)
+        nbatch = 0
+        while batch is not None:
+            if monitor is not None:
+                monitor.tic()
+            self.forward_backward(batch)
+            self.update()
+            if isinstance(batch, list):  # pre-sliced multi-device batch
+                self.update_metric(eval_metric, [b.label for b in batch],
+                                   pre_sliced=True)
+            else:
+                self.update_metric(eval_metric, batch.label)
+            nxt = next(data_iter, None)
+            if nxt is not None:
+                self.prepare(nxt, sparse_row_id_fn=sparse_row_id_fn)
+            if monitor is not None:
+                monitor.toc_print()
+            if nxt is None:
+                # read the epoch metrics before callbacks may reset them
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            for cb in _as_list(batch_end_callback or []):
+                cb(BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                 eval_metric=eval_metric, locals=locals()))
+            nbatch += 1
+            batch = nxt
 
     # -- misc helpers ------------------------------------------------------
     def get_params(self):
