@@ -18,6 +18,7 @@ __version__ = "0.1.0"
 from .base import MXNetError  # noqa: F401
 from .context import Context, cpu, cpu_pinned, gpu, tpu, current_context, num_gpus, num_tpus  # noqa: F401
 from . import base  # noqa: F401
+from . import config  # noqa: F401
 from . import ops  # noqa: F401
 from . import ndarray  # noqa: F401
 from . import ndarray as nd  # noqa: F401

@@ -1,0 +1,146 @@
+"""Central runtime-configuration registry.
+
+The reference documents ~72 `MXNET_*` env knobs in docs/faq/env_var.md and
+reads them via dmlc::GetEnv at use sites; this module is the equivalent
+tier for the TPU framework: every environment variable the framework reads
+is REGISTERED here with its type, default, and documentation, and read
+through `config.get(...)`. `config.describe()` regenerates the env-var
+reference (the doc-generating reflection the reference gets from
+dmlc::Parameter).
+
+Many reference knobs have no TPU analog because XLA subsumes the subsystem
+they tuned (thread pools per GPU, memory-pool shapes, bulking windows);
+those are listed in `SUBSUMED` with the subsuming mechanism so users
+migrating from the reference can find where each knob went.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+
+__all__ = ["Knob", "KNOBS", "SUBSUMED", "get", "describe", "register_knob"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    name: str
+    default: object
+    type: type
+    doc: str
+
+
+KNOBS: dict[str, Knob] = {}
+
+
+def register_knob(name, default, type_, doc):
+    KNOBS[name] = Knob(name, default, type_, doc)
+    return KNOBS[name]
+
+
+def get(name, default=None):
+    """Read a registered knob from the environment with its typed default."""
+    knob = KNOBS.get(name)
+    if knob is None:
+        raise KeyError(f"unregistered config knob {name!r}; add it to "
+                       "incubator_mxnet_tpu/config.py")
+    raw = os.environ.get(name)
+    if raw is None:
+        return default if default is not None else knob.default
+    if knob.type is bool:
+        return raw.lower() not in ("0", "false", "off", "")
+    return knob.type(raw)
+
+
+def describe():
+    """Render the env-var reference (docs/faq/env_var.md analog)."""
+    lines = ["# Environment variables", ""]
+    for knob in sorted(KNOBS.values(), key=lambda k: k.name):
+        lines.append(f"- `{knob.name}` (default `{knob.default}`, "
+                     f"{knob.type.__name__}): {knob.doc}")
+    lines += ["", "## Reference knobs subsumed by XLA/JAX", ""]
+    for name, how in sorted(SUBSUMED.items()):
+        lines.append(f"- `{name}`: {how}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# registry — engine / execution
+# ---------------------------------------------------------------------------
+
+register_knob("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice", str,
+              "Dependency-engine implementation: ThreadedEnginePerDevice "
+              "(async worker pool) or NaiveEngine (serial, for debugging "
+              "races — ref: env_var.md:103).")
+register_knob("MXNET_CPU_WORKER_NTHREADS", 4, int,
+              "Engine worker threads for host-side ops (ref: env_var.md:42).")
+register_knob("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 64, int,
+              "Max ops bulked into one engine segment (ref: env_var.md:113); "
+              "on TPU the fused train step plays this role.")
+register_knob("MXTPU_EAGER_JIT", True, bool,
+              "Jit-compile eager op dispatches (per-op cache). Off = "
+              "op-by-op dispatch for debugging.")
+
+# data / IO
+register_knob("MXTPU_PREFETCH_BUFFER", 2, int,
+              "DataIter prefetch depth (ref: prefetcher buffer_size).")
+register_knob("MXTPU_DECODE_THREADS", 4, int,
+              "JPEG decode/augment worker threads in ImageRecordIter "
+              "(ref: preprocess_threads of iter_image_recordio_2.cc).")
+
+# distributed / kvstore
+register_knob("MXTPU_COORDINATOR", "", str,
+              "host:port of the jax.distributed coordinator (set by "
+              "tools/launch.py; ref role: DMLC_PS_ROOT_URI).")
+register_knob("MXTPU_NUM_PROCESSES", 1, int,
+              "World size for multi-process training (ref: DMLC_NUM_WORKER).")
+register_knob("MXTPU_PROCESS_ID", 0, int,
+              "This process's rank (ref: ps-lite rank assignment).")
+register_knob("MXTPU_ASYNC_PERIOD", 16, int,
+              "dist_async: pushes of a key between elastic-averaging mix "
+              "points (staleness bound).")
+register_knob("MXTPU_ASYNC_ALPHA", 0.5, float,
+              "dist_async: mixing rate toward the cross-worker mean at a "
+              "mix point.")
+register_knob("MXTPU_HEARTBEAT_DIR", "", str,
+              "Directory for worker heartbeat files (dead-node detection; "
+              "default derives from MXTPU_COORDINATOR).")
+register_knob("MXTPU_HEARTBEAT_INTERVAL", 2.0, float,
+              "Seconds between heartbeat touches.")
+register_knob("MXTPU_HEARTBEAT_TIMEOUT", 20.0, float,
+              "Heartbeat staleness after which a peer counts as dead "
+              "(ref: ps-lite PS_HEARTBEAT_TIMEOUT).")
+
+# profiler
+register_knob("MXNET_PROFILER_AUTOSTART", False, bool,
+              "Start profiling at import (ref: env_var.md:192).")
+
+# numerics / reproducibility
+register_knob("MXTPU_DEFAULT_DTYPE", "float32", str,
+              "Default dtype for new NDArrays.")
+register_knob("MXTPU_DETERMINISTIC", False, bool,
+              "Force deterministic XLA reductions where available "
+              "(ref: MXNET_ENFORCE_DETERMINISM env_var.md:245).")
+
+
+# Reference knobs whose role is subsumed by the XLA/JAX substrate: the
+# migration map (docs/faq/env_var.md names -> what replaces them here).
+SUBSUMED = {
+    "MXNET_GPU_WORKER_NTHREADS": "XLA async launch + stream assignment",
+    "MXNET_GPU_COPY_NTHREADS": "PJRT transfer manager",
+    "MXNET_OMP_MAX_THREADS": "XLA CPU thread pool (--xla_cpu_* flags)",
+    "MXNET_GPU_MEM_POOL_SIZE": "PJRT BFC allocator "
+                               "(XLA_PYTHON_CLIENT_MEM_FRACTION)",
+    "MXNET_GPU_MEM_POOL_TYPE": "PJRT BFC allocator",
+    "MXNET_GPU_MEM_POOL_RESERVE": "XLA_PYTHON_CLIENT_PREALLOCATE",
+    "MXNET_EXEC_ENABLE_INPLACE": "XLA buffer reuse + donation",
+    "MXNET_BACKWARD_DO_MIRROR": "jax.checkpoint / remat policies",
+    "MXNET_EXEC_INPLACE_GRAD_SUM_CAP": "XLA fusion of gradient sums",
+    "MXNET_KVSTORE_REDUCTION_NTHREADS": "ICI collective all-reduce",
+    "MXNET_KVSTORE_BIGARRAY_BOUND": "GSPMD sharding decides partitioning",
+    "MXNET_KVSTORE_USETREE": "XLA collective scheduling over ICI topology",
+    "MXNET_CUDNN_AUTOTUNE_DEFAULT": "XLA autotuning at compile time",
+    "MXNET_SUBGRAPH_BACKEND": "XLA fusion passes",
+    "MXNET_MKLDNN_ENABLED": "XLA:CPU oneDNN integration",
+    "MXNET_SAFE_ACCUMULATION": "fp32 accumulation in bf16 matmuls "
+                               "(preferred_element_type)",
+}

@@ -15,7 +15,9 @@ import jax
 
 __all__ = ["waitall", "bulk", "set_bulk_size"]
 
-_BULK_SIZE = int(os.environ.get("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", "15"))
+from . import config as _config
+
+_BULK_SIZE = _config.get("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 15)
 
 
 def waitall():
@@ -100,7 +102,7 @@ class ThreadedEngine:
 
         self._tramp = tramp  # keep alive
         if num_workers is None:
-            num_workers = int(os.environ.get("MXNET_CPU_WORKER_NTHREADS", "4"))
+            num_workers = _config.get("MXNET_CPU_WORKER_NTHREADS")
         self._h = self._lib.eng_create(num_workers, tramp)
 
     @staticmethod
@@ -238,7 +240,7 @@ def get_engine():
         if _DEFAULT_ENGINE is None:
             import atexit
 
-            kind = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+            kind = _config.get("MXNET_ENGINE_TYPE")
             if kind == "NaiveEngine":
                 _DEFAULT_ENGINE = NaiveEngine()
             else:

@@ -387,10 +387,10 @@ class KVStoreDistAsync(KVStoreDist):
 
     def __init__(self, kv_type="dist_async"):
         super().__init__(kv_type)
-        import os as _os
+        from . import config as _config
 
-        self._period = max(1, int(_os.environ.get("MXTPU_ASYNC_PERIOD", "16")))
-        self._alpha = float(_os.environ.get("MXTPU_ASYNC_ALPHA", "0.5"))
+        self._period = max(1, _config.get("MXTPU_ASYNC_PERIOD"))
+        self._alpha = _config.get("MXTPU_ASYNC_ALPHA")
         self._push_counts = {}
 
     def push(self, key, value, priority=0):
@@ -479,13 +479,15 @@ class _Heartbeat:
     def maybe_start(cls, rank, num_workers):
         if num_workers <= 1:
             return None
-        hb_dir = os.environ.get("MXTPU_HEARTBEAT_DIR")
+        from . import config as _config
+
+        hb_dir = _config.get("MXTPU_HEARTBEAT_DIR")
         if not hb_dir:
-            coord = os.environ.get("MXTPU_COORDINATOR", "local")
+            coord = _config.get("MXTPU_COORDINATOR") or "local"
             tag = coord.replace(":", "_").replace("/", "_")
             hb_dir = os.path.join(tempfile.gettempdir(), f"mxtpu_hb_{tag}")
-        interval = float(os.environ.get("MXTPU_HEARTBEAT_INTERVAL", "2"))
-        timeout = float(os.environ.get("MXTPU_HEARTBEAT_TIMEOUT", "20"))
+        interval = _config.get("MXTPU_HEARTBEAT_INTERVAL")
+        timeout = _config.get("MXTPU_HEARTBEAT_TIMEOUT")
         return cls(rank, num_workers, hb_dir, interval, timeout)
 
     def _path(self, rank):

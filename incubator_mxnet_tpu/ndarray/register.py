@@ -83,7 +83,14 @@ def invoke(opdef: OpDef, args, kwargs):
                 full[ap] = lax.stop_gradient(full[ap])
         return opdef.fn(*full, **call_attrs)
 
-    results = autograd.invoke_recorded(fn, live_arrays, name=opdef.name)
+    from .. import profiler as _profiler
+
+    if _profiler.aggregate_enabled():
+        results = _profiler.timed_invoke(
+            opdef.name, autograd.invoke_recorded, fn, live_arrays,
+            name=opdef.name)
+    else:
+        results = autograd.invoke_recorded(fn, live_arrays, name=opdef.name)
 
     if has_aux:
         primary = results[:n_primary]

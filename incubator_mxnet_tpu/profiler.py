@@ -14,18 +14,108 @@ import jax
 import jax.profiler
 
 __all__ = [
-    "set_config", "set_state", "dump", "pause", "resume", "Task", "Frame",
-    "Event", "Counter", "Marker", "scope",
+    "set_config", "set_state", "dump", "dumps", "pause", "resume", "Task",
+    "Frame", "Event", "Counter", "Marker", "scope", "aggregate_enabled",
+    "timed_invoke", "reset_stats",
 ]
 
-_CONFIG = {"filename": "profile.json", "profile_all": False}
+_CONFIG = {"filename": "profile.json", "profile_all": False,
+           "aggregate_stats": False}
 _STATE = {"running": False, "dir": None}
 
 
 def set_config(**kwargs):
     """(ref: profiler.py set_config) — accepts the reference's kwargs;
-    `filename` determines the trace directory."""
+    `filename` determines the trace directory. `aggregate_stats=True`
+    additionally records a per-op aggregate table (`dumps()`); it
+    synchronizes after every eager op to attribute real device time, the
+    same observability/throughput trade the reference's profiler makes when
+    instrumenting each engine opr."""
     _CONFIG.update(kwargs)
+
+
+# ---------------------------------------------------------------------------
+# per-op aggregate statistics (ref: src/profiler/aggregate_stats.cc —
+# the MXAggregateProfileStatsPrint table, the part users actually read)
+# ---------------------------------------------------------------------------
+
+
+class _OpStat:
+    __slots__ = ("count", "total", "min", "max")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+
+    def add(self, dur):
+        self.count += 1
+        self.total += dur
+        self.min = min(self.min, dur)
+        self.max = max(self.max, dur)
+
+
+_AGG_STATS: dict[str, _OpStat] = {}
+
+
+def aggregate_enabled():
+    return _STATE["running"] and _CONFIG.get("aggregate_stats", False)
+
+
+def timed_invoke(op_name, call, *args, **kwargs):
+    """Run `call`, blocking on its outputs, and charge the wall time to
+    `op_name` in the aggregate table."""
+    import time as _time
+
+    t0 = _time.perf_counter()
+    results = call(*args, **kwargs)
+    try:
+        sync = results if isinstance(results, (list, tuple)) else [results]
+        for r in sync:
+            data = getattr(r, "_data", r)
+            if hasattr(data, "block_until_ready"):
+                data.block_until_ready()
+    except Exception:
+        pass  # timing must never break the op itself
+    _AGG_STATS.setdefault(op_name, _OpStat()).add(_time.perf_counter() - t0)
+    return results
+
+
+def reset_stats():
+    _AGG_STATS.clear()
+
+
+def dumps(reset=False, sort_by="total", ascending=False):
+    """Formatted per-op aggregate table
+    (ref: profiler.py dumps -> MXAggregateProfileStatsPrint).
+
+    Columns: Name, Total Count, Time total/min/max/avg in ms.
+    """
+    key = {
+        "total": lambda kv: kv[1].total,
+        "count": lambda kv: kv[1].count,
+        "min": lambda kv: kv[1].min,
+        "max": lambda kv: kv[1].max,
+        "avg": lambda kv: kv[1].total / max(kv[1].count, 1),
+    }.get(sort_by)
+    if key is None:
+        raise ValueError(f"sort_by must be total/count/min/max/avg, got {sort_by}")
+    rows = sorted(_AGG_STATS.items(), key=key, reverse=not ascending)
+    lines = [
+        "Profile Statistics:",
+        f"{'Name':<40s} {'Count':>8s} {'Total(ms)':>12s} {'Min(ms)':>10s} "
+        f"{'Max(ms)':>10s} {'Avg(ms)':>10s}",
+        "-" * 94,
+    ]
+    for name, s in rows:
+        avg = s.total / max(s.count, 1)
+        lines.append(
+            f"{name[:40]:<40s} {s.count:>8d} {s.total * 1e3:>12.3f} "
+            f"{s.min * 1e3:>10.3f} {s.max * 1e3:>10.3f} {avg * 1e3:>10.3f}")
+    if reset:
+        reset_stats()
+    return "\n".join(lines)
 
 
 def set_state(state="stop", profile_process="worker"):

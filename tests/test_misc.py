@@ -108,3 +108,45 @@ def test_custom_grad_function_parity():
         y = nd.make_loss(x * x)
     y.backward()
     assert_almost_equal(x.grad.asnumpy(), np.array([4.0]))
+
+
+def test_profiler_aggregate_table():
+    """Per-op aggregate stats (ref: aggregate_stats.cc MXAggregateProfileStatsPrint)."""
+    import numpy as np
+    from incubator_mxnet_tpu import nd, profiler
+
+    profiler.reset_stats()
+    profiler.set_config(aggregate_stats=True, filename="/tmp/mxtpu_prof.json")
+    profiler.set_state("run")
+    a = nd.array(np.random.rand(8, 8).astype("float32"))
+    for _ in range(3):
+        nd.relu(nd.dot(a, a))
+    profiler.set_state("stop")
+    table = profiler.dumps(sort_by="count")
+    assert "dot" in table and "relu" in table
+    lines = [l for l in table.splitlines() if l.startswith(("dot", "relu"))]
+    for line in lines:
+        assert int(line.split()[1]) == 3  # count column
+    # after stop, dispatch is no longer instrumented
+    nd.relu(a)
+    assert "Profile Statistics" in profiler.dumps(reset=True)
+    import pytest
+    with pytest.raises(ValueError):
+        profiler.dumps(sort_by="bogus")
+
+
+def test_config_registry():
+    import os
+    import pytest
+    import incubator_mxnet_tpu as mx
+
+    assert mx.config.get("MXTPU_ASYNC_PERIOD") == 16
+    os.environ["MXTPU_ASYNC_PERIOD"] = "8"
+    try:
+        assert mx.config.get("MXTPU_ASYNC_PERIOD") == 8
+    finally:
+        del os.environ["MXTPU_ASYNC_PERIOD"]
+    with pytest.raises(KeyError):
+        mx.config.get("MXTPU_NOT_A_KNOB")
+    doc = mx.config.describe()
+    assert "MXTPU_HEARTBEAT_TIMEOUT" in doc and "Subsumed" in doc.title()
