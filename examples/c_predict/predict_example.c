@@ -1,0 +1,82 @@
+/* C embedding example (ref: the reference's image-classification/predict-cpp
+ * example over c_predict_api.h).
+ *
+ * Build (from repo root; artifact exported by examples/export_mlp.py or any
+ * deploy.export_predictor call):
+ *   g++ -O2 -shared -fPIC -I$SITE/tensorflow/include \
+ *       -o libmxtpu_predict.so src/predict.cc -ldl
+ *   gcc -O2 -I include examples/c_predict/predict_example.c \
+ *       -L incubator_mxnet_tpu/_native -lmxtpu_predict -o predict_example
+ *
+ * Run: ./predict_example model-predict.mxp /path/to/pjrt_plugin.so
+ * (libtpu.so on TPU hosts; any PJRT C-API plugin works)
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxtpu_predict.h"
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s artifact.mxp [pjrt_plugin.so]\n", argv[0]);
+    return 2;
+  }
+  const char* plugin = argc > 2 ? argv[2] : NULL;
+
+  MXTpuPredictorHandle h;
+  if (MXTpuPredCreate(argv[1], plugin, &h) != 0) {
+    fprintf(stderr, "create failed: %s\n", MXTpuPredLastError());
+    return 1;
+  }
+
+  int n_in, n_out;
+  MXTpuPredNumInputs(h, &n_in);
+  MXTpuPredNumOutputs(h, &n_out);
+  printf("artifact: %d input(s), %d output(s)\n", n_in, n_out);
+
+  for (int i = 0; i < n_in; ++i) {
+    const char* name;
+    const int64_t* dims;
+    int ndim;
+    MXTpuPredInputName(h, i, &name);
+    MXTpuPredInputShape(h, i, &dims, &ndim);
+    printf("  input %s: [", name);
+    for (int d = 0; d < ndim; ++d)
+      printf("%s%lld", d ? ", " : "", (long long)dims[d]);
+    printf("]\n");
+  }
+
+  if (plugin != NULL && n_in == 1) {
+    const int64_t* dims;
+    int ndim;
+    const char* name;
+    MXTpuPredInputName(h, 0, &name);
+    MXTpuPredInputShape(h, 0, &dims, &ndim);
+    size_t n = 1;
+    for (int d = 0; d < ndim; ++d) n *= (size_t)dims[d];
+    float* x = (float*)calloc(n, sizeof(float));
+    for (size_t i = 0; i < n; ++i) x[i] = (float)i / (float)n;
+    if (MXTpuPredSetInput(h, name, x, n * sizeof(float)) != 0 ||
+        MXTpuPredForward(h) != 0) {
+      fprintf(stderr, "forward failed: %s\n", MXTpuPredLastError());
+      free(x);
+      MXTpuPredFree(h);
+      return 1;
+    }
+    MXTpuPredOutputShape(h, 0, &dims, &ndim);
+    size_t m = 1;
+    for (int d = 0; d < ndim; ++d) m *= (size_t)dims[d];
+    float* y = (float*)calloc(m, sizeof(float));
+    MXTpuPredGetOutput(h, 0, y, m * sizeof(float));
+    printf("output[0][:4] =");
+    for (size_t i = 0; i < m && i < 4; ++i) printf(" %f", y[i]);
+    printf("\n");
+    free(x);
+    free(y);
+  }
+
+  MXTpuPredFree(h);
+  printf("ok\n");
+  return 0;
+}

@@ -1,0 +1,65 @@
+/* mxtpu_predict.h — C embedding API for exported predict artifacts.
+ *
+ * TPU-native replacement for the reference's c_predict_api
+ * (ref: include/mxnet/c_predict_api.h + src/c_api/c_predict_api.cc:1):
+ * where the reference ships a JSON graph re-executed by the bundled
+ * runtime, this loads a single `.mxp` artifact — the AOT-compiled
+ * StableHLO program plus trained parameters — and runs it through any
+ * PJRT C-API plugin (libtpu.so on TPU hosts, a CPU plugin elsewhere).
+ *
+ * Typical use:
+ *   MXTpuPredictorHandle h;
+ *   MXTpuPredCreate("model-predict.mxp", "/path/libtpu.so", &h);
+ *   MXTpuPredSetInput(h, "data", img, sizeof img);
+ *   MXTpuPredForward(h);
+ *   MXTpuPredGetOutput(h, 0, probs, sizeof probs);
+ *   MXTpuPredFree(h);
+ *
+ * All functions return 0 on success, nonzero on failure;
+ * MXTpuPredLastError() describes the most recent failure.
+ */
+#ifndef MXTPU_PREDICT_H_
+#define MXTPU_PREDICT_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* MXTpuPredictorHandle;
+
+/* Load artifact + PJRT plugin, compile the program, upload parameters.
+ * plugin_path NULL = artifact-only mode: introspection works, Forward
+ * fails (used for tooling and tests without an accelerator). */
+int MXTpuPredCreate(const char* artifact_path, const char* pjrt_plugin_path,
+                    MXTpuPredictorHandle* out);
+
+int MXTpuPredNumInputs(MXTpuPredictorHandle h, int* out);
+int MXTpuPredInputName(MXTpuPredictorHandle h, int idx, const char** out);
+int MXTpuPredInputShape(MXTpuPredictorHandle h, int idx,
+                        const int64_t** dims, int* ndim);
+int MXTpuPredNumOutputs(MXTpuPredictorHandle h, int* out);
+int MXTpuPredOutputShape(MXTpuPredictorHandle h, int idx,
+                         const int64_t** dims, int* ndim);
+
+/* Stage one named input (host, C-order, artifact dtype). */
+int MXTpuPredSetInput(MXTpuPredictorHandle h, const char* name,
+                      const void* data, size_t nbytes);
+
+/* Execute; all inputs must be staged. */
+int MXTpuPredForward(MXTpuPredictorHandle h);
+
+/* Copy output `idx` to `dst` (nbytes must match the output's size). */
+int MXTpuPredGetOutput(MXTpuPredictorHandle h, int idx, void* dst,
+                       size_t nbytes);
+
+const char* MXTpuPredLastError(void);
+void MXTpuPredFree(MXTpuPredictorHandle h);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXTPU_PREDICT_H_ */

@@ -38,8 +38,9 @@ def _compile(out: str, sources: list[str], extra: list[str],
         return None
 
 
-def _build(name: str, sources: list[str]) -> str | None:
-    return _compile(os.path.join(_HERE, f"lib{name}.so"), sources, [], True)
+def _build(name: str, sources: list[str], extra=()) -> str | None:
+    return _compile(os.path.join(_HERE, f"lib{name}.so"), sources,
+                    list(extra), True)
 
 
 def build_binary(name: str, sources: list[str], extra_flags=()) -> str | None:
@@ -49,12 +50,12 @@ def build_binary(name: str, sources: list[str], extra_flags=()) -> str | None:
                     False)
 
 
-def load(name: str, sources: list[str]):
+def load(name: str, sources: list[str], extra=()):
     """Build+load libname.so; returns ctypes CDLL or None."""
     with _LOCK:
         if name in _LIBS:
             return _LIBS[name]
-        path = _build(name, sources)
+        path = _build(name, sources, extra)
         lib = None
         if path is not None:
             try:
@@ -92,4 +93,46 @@ def recordio_lib():
         lib.rio_write.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32]
         lib.rio_close_writer.argtypes = [ctypes.c_void_p]
         lib._rio_configured = True
+    return lib
+
+
+import sysconfig
+
+_TF_INCLUDE = os.path.join(sysconfig.get_paths()["purelib"], "tensorflow",
+                           "include")
+
+
+def predict_lib():
+    """C embedding runtime over the PJRT C API (src/predict.cc; header:
+    include/mxtpu_predict.h — the c_predict_api.cc replacement)."""
+    lib = load("mxtpu_predict", ["predict.cc"],
+               extra=[f"-I{_TF_INCLUDE}", "-ldl"])
+    if lib is not None and not getattr(lib, "_pred_configured", False):
+        lib.MXTpuPredCreate.restype = ctypes.c_int
+        lib.MXTpuPredCreate.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_void_p)]
+        lib.MXTpuPredLastError.restype = ctypes.c_char_p
+        lib.MXTpuPredNumInputs.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int)]
+        lib.MXTpuPredInputName.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_char_p)]
+        lib.MXTpuPredInputShape.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.POINTER(ctypes.c_int)]
+        lib.MXTpuPredNumOutputs.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int)]
+        lib.MXTpuPredOutputShape.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.POINTER(ctypes.c_int)]
+        lib.MXTpuPredSetInput.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.c_size_t]
+        lib.MXTpuPredForward.argtypes = [ctypes.c_void_p]
+        lib.MXTpuPredGetOutput.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p, ctypes.c_size_t]
+        lib.MXTpuPredFree.argtypes = [ctypes.c_void_p]
+        lib._pred_configured = True
     return lib

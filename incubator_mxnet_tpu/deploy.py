@@ -13,6 +13,8 @@ with zero framework overhead — XLA AOT is the TPU's TensorRT.
 Artifact layout for prefix `model`:
   model-predict.stablehlo   serialized StableHLO program (params are inputs)
   model-predict.npz         trained arg/aux params in call order
+  model-predict.mxp         single-file C-embedding artifact (StableHLO +
+                            params) consumed by src/predict.cc over PJRT
   model-symbol.json         the symbol graph (for inspection/retraining)
 """
 from __future__ import annotations
@@ -79,6 +81,15 @@ def export_predictor(prefix, symbol, arg_params, aux_params, input_shapes,
                  "outputs": symbol.list_outputs(),
              }).encode(), dtype=np.uint8))
     symbol.save(prefix + "-symbol.json")
+    try:
+        _write_mxp(prefix + "-predict.mxp", exported, input_shapes, dtype,
+                   params_np, aux_np, symbol.list_outputs())
+    except KeyError as e:  # dtype outside the C ABI's table
+        import warnings
+
+        warnings.warn(f"skipping C-embedding .mxp artifact: unsupported "
+                      f"dtype {e}; the Python Predictor artifacts were "
+                      f"written normally")
     return prefix + "-predict.stablehlo"
 
 
@@ -126,3 +137,77 @@ class Predictor:
     @property
     def output_names(self):
         return list(self._outputs_names)
+
+
+# ---------------------------------------------------------------------------
+# C embedding artifact (.mxp): single-file StableHLO + params consumed by
+# src/predict.cc over the PJRT C API (ref role: c_predict_api.cc — the
+# C/mobile/JVM load-and-run path; include/mxtpu_predict.h is the header)
+# ---------------------------------------------------------------------------
+
+_DTYPE_CODES = {"float32": 0, "float64": 1, "int32": 2, "int64": 3,
+                "uint8": 4, "int8": 5, "bfloat16": 6, "float16": 7,
+                "bool": 8, "uint32": 9, "uint64": 10, "int16": 11,
+                "uint16": 12}
+
+
+def _write_mxp(path, exported, input_shapes, in_dtype, params_np, aux_np,
+               output_names):
+    """Binary artifact: header + per-arg specs (in the program's flat
+    calling order: sorted inputs, sorted params, sorted aux — jax flattens
+    dicts in key order) + CompileOptionsProto + StableHLO + param data."""
+    import struct
+
+    from jax._src import compiler as _jc
+
+    copts = _jc.get_compile_options(num_replicas=1,
+                                    num_partitions=1).SerializeAsString()
+    shlo = exported.mlir_module_serialized
+
+    args = []  # (kind, name, np_dtype_name, shape, payload-or-None)
+    for name in sorted(input_shapes):
+        args.append((0, name, in_dtype, tuple(input_shapes[name]), None))
+    for name in sorted(params_np):
+        v = params_np[name]
+        args.append((1, name, v.dtype.name, v.shape, v))
+    for name in sorted(aux_np):
+        v = aux_np[name]
+        args.append((1, name, v.dtype.name, v.shape, v))
+
+    # jax.export DCEs arguments the program never reads
+    # (module_kept_var_idx); the artifact must list exactly the args the
+    # compiled main accepts, or the C runtime passes too many buffers
+    kept = getattr(exported, "module_kept_var_idx", None)
+    if kept is not None:
+        args = [args[i] for i in kept]
+
+    outs = [(o.dtype.name if hasattr(o, "dtype") else "float32",
+             tuple(getattr(o, "shape", ())), n)
+            for o, n in zip(exported.out_avals, output_names)]
+
+    with open(path, "wb") as f:
+        f.write(b"MXTPU001")
+        f.write(struct.pack("<IIQQ", len(args), len(outs),
+                            len(copts), len(shlo)))
+        for kind, name, dt, shape, payload in args:
+            nb = np.dtype(dt).itemsize * int(np.prod(shape)) if shape else \
+                np.dtype(dt).itemsize
+            nm = name.encode()
+            f.write(struct.pack("<BBBB", kind, _DTYPE_CODES[dt],
+                                len(shape), 0))
+            f.write(struct.pack("<I", len(nm)))
+            f.write(nm)
+            f.write(struct.pack(f"<{len(shape)}q", *shape))
+            f.write(struct.pack("<Q", nb))
+        for dt, shape, name in outs:
+            nm = name.encode()
+            f.write(struct.pack("<BBH", _DTYPE_CODES[dt], len(shape), 0))
+            f.write(struct.pack("<I", len(nm)))
+            f.write(nm)
+            f.write(struct.pack(f"<{len(shape)}q", *shape))
+        f.write(copts)
+        f.write(shlo)
+        for kind, _name, _dt, _shape, payload in args:
+            if kind == 1:
+                f.write(np.ascontiguousarray(payload).tobytes())
+    return path

@@ -1,0 +1,528 @@
+// predict.cc — C embedding runtime for .mxp predict artifacts over the
+// PJRT C API (ref role: src/c_api/c_predict_api.cc — load, bind, forward;
+// here "bind" is PJRT_Client_Compile of the artifact's StableHLO and
+// "forward" is PJRT_LoadedExecutable_Execute).
+//
+// Artifact format (written by incubator_mxnet_tpu.deploy.export_predictor):
+//   8B   magic "MXTPU001"
+//   u32  n_args, u32 n_outputs
+//   u64  copts_size, u64 stablehlo_size
+//   per arg:    u8 kind(0=input,1=param) u8 dtype u8 ndim u8 pad
+//               u32 name_len, name, i64 dims[ndim], u64 nbytes
+//   per output: u8 dtype u8 ndim u16 pad u32 name_len, name, i64 dims[ndim]
+//   copts bytes (serialized CompileOptionsProto)
+//   stablehlo bytes (MLIR bytecode)
+//   param payloads, in arg order, C-contiguous little-endian
+//
+// Args are listed in the program's flat calling order; the embedder only
+// feeds the kind==input ones, params ride along from the artifact.
+
+#include <dlfcn.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <string>
+#include <vector>
+
+#include "tensorflow/compiler/xla/pjrt/c/pjrt_c_api.h"
+#include "../include/mxtpu_predict.h"
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+PJRT_Buffer_Type dtype_to_pjrt(uint8_t code) {
+  switch (code) {
+    case 0: return PJRT_Buffer_Type_F32;
+    case 1: return PJRT_Buffer_Type_F64;
+    case 2: return PJRT_Buffer_Type_S32;
+    case 3: return PJRT_Buffer_Type_S64;
+    case 4: return PJRT_Buffer_Type_U8;
+    case 5: return PJRT_Buffer_Type_S8;
+    case 6: return PJRT_Buffer_Type_BF16;
+    case 7: return PJRT_Buffer_Type_F16;
+    case 8: return PJRT_Buffer_Type_PRED;
+    case 9: return PJRT_Buffer_Type_U32;
+    case 10: return PJRT_Buffer_Type_U64;
+    case 11: return PJRT_Buffer_Type_S16;
+    case 12: return PJRT_Buffer_Type_U16;
+    default: return PJRT_Buffer_Type_INVALID;
+  }
+}
+
+struct ArgSpec {
+  uint8_t kind;  // 0=input 1=param
+  uint8_t dtype;
+  std::string name;
+  std::vector<int64_t> dims;
+  uint64_t nbytes;
+  std::vector<char> payload;     // params: raw data
+  std::vector<char> staged;      // inputs: SetInput data
+  bool staged_set = false;
+};
+
+struct OutSpec {
+  uint8_t dtype;
+  std::string name;
+  std::vector<int64_t> dims;
+};
+
+struct Predictor {
+  std::vector<ArgSpec> args;
+  std::vector<OutSpec> outputs;
+  std::vector<char> copts;
+  std::vector<char> stablehlo;
+  std::vector<int> input_idx;  // arg indices with kind==input
+
+  void* plugin = nullptr;
+  const PJRT_Api* api = nullptr;
+  PJRT_Client* client = nullptr;
+  PJRT_Device* device = nullptr;
+  PJRT_LoadedExecutable* exec = nullptr;
+  size_t num_outputs = 0;
+  std::vector<PJRT_Buffer*> param_bufs;      // device-resident params
+  std::vector<std::vector<char>> results;    // host copies of last outputs
+};
+
+void destroy_predictor(Predictor* p) {
+  if (p == nullptr) return;
+  if (p->api != nullptr) {
+    for (PJRT_Buffer* b : p->param_bufs) {
+      if (b == nullptr) continue;
+      PJRT_Buffer_Destroy_Args dargs;
+      memset(&dargs, 0, sizeof dargs);
+      dargs.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+      dargs.buffer = b;
+      p->api->PJRT_Buffer_Destroy(&dargs);
+    }
+    if (p->exec != nullptr) {
+      PJRT_LoadedExecutable_Destroy_Args dargs;
+      memset(&dargs, 0, sizeof dargs);
+      dargs.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+      dargs.executable = p->exec;
+      p->api->PJRT_LoadedExecutable_Destroy(&dargs);
+    }
+    if (p->client != nullptr) {
+      PJRT_Client_Destroy_Args dargs;
+      memset(&dargs, 0, sizeof dargs);
+      dargs.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+      dargs.client = p->client;
+      p->api->PJRT_Client_Destroy(&dargs);
+    }
+  }
+  if (p->plugin != nullptr) dlclose(p->plugin);
+  delete p;
+}
+
+bool read_exact(FILE* f, void* dst, size_t n) {
+  return fread(dst, 1, n, f) == n;
+}
+
+bool check_pjrt_error(const PJRT_Api* api, PJRT_Error* err,
+                      const char* what) {
+  if (err == nullptr) return true;
+  PJRT_Error_Message_Args margs;
+  memset(&margs, 0, sizeof margs);
+  margs.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+  margs.error = err;
+  api->PJRT_Error_Message(&margs);
+  set_error(std::string(what) + ": " +
+            std::string(margs.message, margs.message_size));
+  PJRT_Error_Destroy_Args dargs;
+  memset(&dargs, 0, sizeof dargs);
+  dargs.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  dargs.error = err;
+  api->PJRT_Error_Destroy(&dargs);
+  return false;
+}
+
+bool await_event(const PJRT_Api* api, PJRT_Event* ev, const char* what) {
+  PJRT_Event_Await_Args aargs;
+  memset(&aargs, 0, sizeof aargs);
+  aargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+  aargs.event = ev;
+  PJRT_Error* err = api->PJRT_Event_Await(&aargs);
+  PJRT_Event_Destroy_Args dargs;
+  memset(&dargs, 0, sizeof dargs);
+  dargs.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+  dargs.event = ev;
+  api->PJRT_Event_Destroy(&dargs);
+  return check_pjrt_error(api, err, what);
+}
+
+bool load_artifact(Predictor* p, const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) {
+    set_error(std::string("cannot open artifact ") + path);
+    return false;
+  }
+  char magic[8];
+  uint32_t n_args = 0, n_outputs = 0;
+  uint64_t copts_size = 0, shlo_size = 0;
+  bool ok = read_exact(f, magic, 8) && memcmp(magic, "MXTPU001", 8) == 0 &&
+            read_exact(f, &n_args, 4) && read_exact(f, &n_outputs, 4) &&
+            read_exact(f, &copts_size, 8) && read_exact(f, &shlo_size, 8);
+  if (!ok) {
+    fclose(f);
+    set_error("bad artifact header (magic/version mismatch?)");
+    return false;
+  }
+  for (uint32_t i = 0; ok && i < n_args; ++i) {
+    ArgSpec a;
+    uint8_t ndim = 0, pad = 0;
+    uint32_t name_len = 0;
+    ok = read_exact(f, &a.kind, 1) && read_exact(f, &a.dtype, 1) &&
+         read_exact(f, &ndim, 1) && read_exact(f, &pad, 1) &&
+         read_exact(f, &name_len, 4);
+    if (ok) {
+      a.name.resize(name_len);
+      a.dims.resize(ndim);
+      ok = read_exact(f, a.name.data(), name_len) &&
+           read_exact(f, a.dims.data(), sizeof(int64_t) * ndim) &&
+           read_exact(f, &a.nbytes, 8);
+    }
+    if (ok) p->args.push_back(std::move(a));
+  }
+  for (uint32_t i = 0; ok && i < n_outputs; ++i) {
+    OutSpec o;
+    uint8_t ndim = 0;
+    uint16_t pad = 0;
+    uint32_t name_len = 0;
+    ok = read_exact(f, &o.dtype, 1) && read_exact(f, &ndim, 1) &&
+         read_exact(f, &pad, 2) && read_exact(f, &name_len, 4);
+    if (ok) {
+      o.name.resize(name_len);
+      o.dims.resize(ndim);
+      ok = read_exact(f, o.name.data(), name_len) &&
+           read_exact(f, o.dims.data(), sizeof(int64_t) * ndim);
+    }
+    if (ok) p->outputs.push_back(std::move(o));
+  }
+  if (ok) {
+    p->copts.resize(copts_size);
+    p->stablehlo.resize(shlo_size);
+    ok = read_exact(f, p->copts.data(), copts_size) &&
+         read_exact(f, p->stablehlo.data(), shlo_size);
+  }
+  for (size_t i = 0; ok && i < p->args.size(); ++i) {
+    ArgSpec& a = p->args[i];
+    if (a.kind == 1) {
+      a.payload.resize(a.nbytes);
+      ok = read_exact(f, a.payload.data(), a.nbytes);
+    } else {
+      p->input_idx.push_back(static_cast<int>(i));
+    }
+  }
+  fclose(f);
+  if (!ok) set_error("truncated artifact");
+  return ok;
+}
+
+PJRT_Buffer* upload(Predictor* p, const ArgSpec& a, const void* data) {
+  PJRT_Client_BufferFromHostBuffer_Args bargs;
+  memset(&bargs, 0, sizeof bargs);
+  bargs.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  bargs.client = p->client;
+  bargs.data = data;
+  bargs.type = dtype_to_pjrt(a.dtype);
+  bargs.dims = a.dims.data();
+  bargs.num_dims = a.dims.size();
+  bargs.host_buffer_semantics =
+      PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+  bargs.device = p->device;
+  PJRT_Error* err = p->api->PJRT_Client_BufferFromHostBuffer(&bargs);
+  if (!check_pjrt_error(p->api, err, "BufferFromHostBuffer")) return nullptr;
+  if (!await_event(p->api, bargs.done_with_host_buffer, "h2d transfer"))
+    return nullptr;
+  return bargs.buffer;
+}
+
+bool init_pjrt(Predictor* p, const char* plugin_path) {
+  p->plugin = dlopen(plugin_path, RTLD_NOW | RTLD_LOCAL);
+  if (!p->plugin) {
+    set_error(std::string("dlopen failed: ") + dlerror());
+    return false;
+  }
+  using GetApiFn = const PJRT_Api* (*)();
+  auto get_api =
+      reinterpret_cast<GetApiFn>(dlsym(p->plugin, "GetPjrtApi"));
+  if (!get_api) {
+    set_error("plugin has no GetPjrtApi symbol");
+    return false;
+  }
+  p->api = get_api();
+
+  PJRT_Plugin_Initialize_Args iargs;
+  memset(&iargs, 0, sizeof iargs);
+  iargs.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+  if (!check_pjrt_error(p->api, p->api->PJRT_Plugin_Initialize(&iargs),
+                        "Plugin_Initialize"))
+    return false;
+
+  PJRT_Client_Create_Args cargs;
+  memset(&cargs, 0, sizeof cargs);
+  cargs.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  if (!check_pjrt_error(p->api, p->api->PJRT_Client_Create(&cargs),
+                        "Client_Create"))
+    return false;
+  p->client = cargs.client;
+
+  PJRT_Client_AddressableDevices_Args dargs;
+  memset(&dargs, 0, sizeof dargs);
+  dargs.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  dargs.client = p->client;
+  if (!check_pjrt_error(p->api,
+                        p->api->PJRT_Client_AddressableDevices(&dargs),
+                        "AddressableDevices"))
+    return false;
+  if (dargs.num_addressable_devices == 0) {
+    set_error("no addressable devices");
+    return false;
+  }
+  p->device = dargs.addressable_devices[0];
+
+  PJRT_Program program;
+  memset(&program, 0, sizeof program);
+  program.struct_size = PJRT_Program_STRUCT_SIZE;
+  program.code = p->stablehlo.data();
+  program.code_size = p->stablehlo.size();
+  static const char kFormat[] = "mlir";
+  program.format = kFormat;
+  program.format_size = sizeof(kFormat) - 1;
+
+  PJRT_Client_Compile_Args pargs;
+  memset(&pargs, 0, sizeof pargs);
+  pargs.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  pargs.client = p->client;
+  pargs.program = &program;
+  pargs.compile_options = p->copts.data();
+  pargs.compile_options_size = p->copts.size();
+  if (!check_pjrt_error(p->api, p->api->PJRT_Client_Compile(&pargs),
+                        "Compile"))
+    return false;
+  p->exec = pargs.executable;
+
+  PJRT_LoadedExecutable_GetExecutable_Args gargs;
+  memset(&gargs, 0, sizeof gargs);
+  gargs.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+  gargs.loaded_executable = p->exec;
+  if (!check_pjrt_error(p->api,
+                        p->api->PJRT_LoadedExecutable_GetExecutable(&gargs),
+                        "GetExecutable"))
+    return false;
+  PJRT_Executable_NumOutputs_Args nargs;
+  memset(&nargs, 0, sizeof nargs);
+  nargs.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+  nargs.executable = gargs.executable;
+  bool ok = check_pjrt_error(p->api,
+                             p->api->PJRT_Executable_NumOutputs(&nargs),
+                             "NumOutputs");
+  PJRT_Executable_Destroy_Args edargs;
+  memset(&edargs, 0, sizeof edargs);
+  edargs.struct_size = PJRT_Executable_Destroy_Args_STRUCT_SIZE;
+  edargs.executable = gargs.executable;
+  p->api->PJRT_Executable_Destroy(&edargs);
+  if (!ok) return false;
+  p->num_outputs = nargs.num_outputs;
+
+  for (const ArgSpec& a : p->args) {
+    if (a.kind != 1) {
+      p->param_bufs.push_back(nullptr);
+      continue;
+    }
+    PJRT_Buffer* buf = upload(p, a, a.payload.data());
+    if (!buf) return false;
+    p->param_bufs.push_back(buf);
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* MXTpuPredLastError(void) { return g_last_error.c_str(); }
+
+int MXTpuPredCreate(const char* artifact_path, const char* pjrt_plugin_path,
+                    MXTpuPredictorHandle* out) {
+  auto* p = new Predictor();
+  if (!load_artifact(p, artifact_path)) {
+    delete p;
+    return 1;
+  }
+  if (pjrt_plugin_path != nullptr && !init_pjrt(p, pjrt_plugin_path)) {
+    destroy_predictor(p);
+    return 2;
+  }
+  *out = p;
+  return 0;
+}
+
+int MXTpuPredNumInputs(MXTpuPredictorHandle h, int* out) {
+  *out = static_cast<int>(static_cast<Predictor*>(h)->input_idx.size());
+  return 0;
+}
+
+int MXTpuPredInputName(MXTpuPredictorHandle h, int idx, const char** out) {
+  auto* p = static_cast<Predictor*>(h);
+  if (idx < 0 || idx >= static_cast<int>(p->input_idx.size())) return 1;
+  *out = p->args[p->input_idx[idx]].name.c_str();
+  return 0;
+}
+
+int MXTpuPredInputShape(MXTpuPredictorHandle h, int idx,
+                        const int64_t** dims, int* ndim) {
+  auto* p = static_cast<Predictor*>(h);
+  if (idx < 0 || idx >= static_cast<int>(p->input_idx.size())) return 1;
+  const ArgSpec& a = p->args[p->input_idx[idx]];
+  *dims = a.dims.data();
+  *ndim = static_cast<int>(a.dims.size());
+  return 0;
+}
+
+int MXTpuPredNumOutputs(MXTpuPredictorHandle h, int* out) {
+  *out = static_cast<int>(static_cast<Predictor*>(h)->outputs.size());
+  return 0;
+}
+
+int MXTpuPredOutputShape(MXTpuPredictorHandle h, int idx,
+                         const int64_t** dims, int* ndim) {
+  auto* p = static_cast<Predictor*>(h);
+  if (idx < 0 || idx >= static_cast<int>(p->outputs.size())) return 1;
+  *dims = p->outputs[idx].dims.data();
+  *ndim = static_cast<int>(p->outputs[idx].dims.size());
+  return 0;
+}
+
+int MXTpuPredSetInput(MXTpuPredictorHandle h, const char* name,
+                      const void* data, size_t nbytes) {
+  auto* p = static_cast<Predictor*>(h);
+  for (int i : p->input_idx) {
+    ArgSpec& a = p->args[i];
+    if (a.name == name) {
+      if (nbytes != a.nbytes) {
+        set_error("SetInput " + a.name + ": expected " +
+                  std::to_string(a.nbytes) + " bytes, got " +
+                  std::to_string(nbytes));
+        return 1;
+      }
+      a.staged.assign(static_cast<const char*>(data),
+                      static_cast<const char*>(data) + nbytes);
+      a.staged_set = true;
+      return 0;
+    }
+  }
+  set_error(std::string("unknown input ") + name);
+  return 1;
+}
+
+int MXTpuPredForward(MXTpuPredictorHandle h) {
+  auto* p = static_cast<Predictor*>(h);
+  if (p->api == nullptr) {
+    set_error("predictor created without a PJRT plugin (artifact-only mode)");
+    return 1;
+  }
+  std::vector<PJRT_Buffer*> arg_bufs(p->args.size(), nullptr);
+  std::vector<PJRT_Buffer*> owned;
+  for (size_t i = 0; i < p->args.size(); ++i) {
+    ArgSpec& a = p->args[i];
+    if (a.kind == 1) {
+      arg_bufs[i] = p->param_bufs[i];
+    } else {
+      if (!a.staged_set) {
+        set_error("input " + a.name + " not set");
+        return 1;
+      }
+      PJRT_Buffer* buf = upload(p, a, a.staged.data());
+      if (!buf) return 1;
+      arg_bufs[i] = buf;
+      owned.push_back(buf);
+    }
+  }
+
+  size_t n_out = p->num_outputs;
+
+  std::vector<PJRT_Buffer*> out_row(n_out, nullptr);
+  PJRT_Buffer** out_lists[1] = {out_row.data()};
+  PJRT_Buffer* const* arg_lists[1] = {arg_bufs.data()};
+  PJRT_Event* done[1] = {nullptr};
+
+  PJRT_ExecuteOptions opts;
+  memset(&opts, 0, sizeof opts);
+  opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+
+  PJRT_LoadedExecutable_Execute_Args eargs;
+  memset(&eargs, 0, sizeof eargs);
+  eargs.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  eargs.executable = p->exec;
+  eargs.options = &opts;
+  eargs.argument_lists = arg_lists;
+  eargs.num_devices = 1;
+  eargs.num_args = arg_bufs.size();
+  eargs.output_lists = out_lists;
+  eargs.device_complete_events = done;
+  bool ok = check_pjrt_error(
+      p->api, p->api->PJRT_LoadedExecutable_Execute(&eargs), "Execute");
+  if (ok && done[0] != nullptr) ok = await_event(p->api, done[0], "execute");
+
+  if (ok) {
+    p->results.assign(n_out, {});
+    for (size_t i = 0; ok && i < n_out; ++i) {
+      PJRT_Buffer_ToHostBuffer_Args targs;
+      memset(&targs, 0, sizeof targs);
+      targs.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+      targs.src = out_row[i];
+      ok = check_pjrt_error(p->api,
+                            p->api->PJRT_Buffer_ToHostBuffer(&targs),
+                            "ToHostBuffer(size)");
+      if (!ok) break;
+      p->results[i].resize(targs.dst_size);
+      targs.dst = p->results[i].data();
+      ok = check_pjrt_error(p->api,
+                            p->api->PJRT_Buffer_ToHostBuffer(&targs),
+                            "ToHostBuffer") &&
+           await_event(p->api, targs.event, "d2h transfer");
+    }
+  }
+
+  for (PJRT_Buffer* b : out_row) {
+    if (b == nullptr) continue;
+    PJRT_Buffer_Destroy_Args dargs;
+    memset(&dargs, 0, sizeof dargs);
+    dargs.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    dargs.buffer = b;
+    p->api->PJRT_Buffer_Destroy(&dargs);
+  }
+  for (PJRT_Buffer* b : owned) {
+    PJRT_Buffer_Destroy_Args dargs;
+    memset(&dargs, 0, sizeof dargs);
+    dargs.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    dargs.buffer = b;
+    p->api->PJRT_Buffer_Destroy(&dargs);
+  }
+  return ok ? 0 : 1;
+}
+
+int MXTpuPredGetOutput(MXTpuPredictorHandle h, int idx, void* dst,
+                       size_t nbytes) {
+  auto* p = static_cast<Predictor*>(h);
+  if (idx < 0 || idx >= static_cast<int>(p->results.size())) {
+    set_error("no such output (did Forward run?)");
+    return 1;
+  }
+  if (nbytes < p->results[idx].size()) {
+    set_error("output buffer too small");
+    return 1;
+  }
+  memcpy(dst, p->results[idx].data(), p->results[idx].size());
+  return 0;
+}
+
+void MXTpuPredFree(MXTpuPredictorHandle h) {
+  destroy_predictor(static_cast<Predictor*>(h));
+}
+
+}  // extern "C"

@@ -1,0 +1,164 @@
+"""C embedding loader tests (ref: c_predict_api.cc usage pattern —
+MXPredCreate/SetInput/Forward/GetOutput from C).
+
+The artifact-introspection half runs everywhere; the PJRT execution half
+needs a PJRT plugin exposing GetPjrtApi (libtpu.so on TPU hosts) and is
+skipped when none is usable.
+"""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, sym
+from incubator_mxnet_tpu import deploy
+from incubator_mxnet_tpu._native import predict_lib
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    """Small MLP exported as a predict artifact."""
+    rng = np.random.RandomState(0)
+    data = sym.Variable("data")
+    w1 = sym.Variable("fc1_weight")
+    b1 = sym.Variable("fc1_bias")
+    h = sym.Activation(sym.FullyConnected(data, w1, b1, num_hidden=8),
+                       act_type="relu")
+    w2 = sym.Variable("fc2_weight")
+    b2 = sym.Variable("fc2_bias")
+    out = sym.FullyConnected(h, w2, b2, num_hidden=3)
+    params = {
+        "fc1_weight": nd.array(rng.rand(8, 5).astype(np.float32) - 0.5),
+        "fc1_bias": nd.array(rng.rand(8).astype(np.float32)),
+        "fc2_weight": nd.array(rng.rand(3, 8).astype(np.float32) - 0.5),
+        "fc2_bias": nd.array(rng.rand(3).astype(np.float32)),
+    }
+    prefix = str(tmp_path_factory.mktemp("artifact") / "mlp")
+    deploy.export_predictor(prefix, out, params, {}, {"data": (2, 5)})
+    x = rng.rand(2, 5).astype(np.float32)
+    ref = deploy.Predictor(prefix)
+    ref.forward(data=x)
+    return prefix, x, ref.get_output(0)
+
+
+def test_mxp_artifact_written(artifact):
+    prefix, _, _ = artifact
+    path = prefix + "-predict.mxp"
+    assert os.path.exists(path)
+    with open(path, "rb") as f:
+        assert f.read(8) == b"MXTPU001"
+
+
+def test_c_loader_introspection(artifact):
+    """Artifact-only mode: metadata readable from C without any PJRT."""
+    prefix, _, _ = artifact
+    lib = predict_lib()
+    assert lib is not None, "toolchain should be available in this image"
+    h = ctypes.c_void_p()
+    rc = lib.MXTpuPredCreate((prefix + "-predict.mxp").encode(), None,
+                             ctypes.byref(h))
+    assert rc == 0, lib.MXTpuPredLastError()
+    try:
+        n = ctypes.c_int()
+        lib.MXTpuPredNumInputs(h, ctypes.byref(n))
+        assert n.value == 1
+        name = ctypes.c_char_p()
+        lib.MXTpuPredInputName(h, 0, ctypes.byref(name))
+        assert name.value == b"data"
+        dims = ctypes.POINTER(ctypes.c_int64)()
+        ndim = ctypes.c_int()
+        lib.MXTpuPredInputShape(h, 0, ctypes.byref(dims), ctypes.byref(ndim))
+        assert [dims[i] for i in range(ndim.value)] == [2, 5]
+        lib.MXTpuPredNumOutputs(h, ctypes.byref(n))
+        assert n.value == 1
+        lib.MXTpuPredOutputShape(h, 0, ctypes.byref(dims), ctypes.byref(ndim))
+        assert [dims[i] for i in range(ndim.value)] == [2, 3]
+        # Forward without a plugin must fail cleanly, not crash
+        assert lib.MXTpuPredForward(h) != 0
+        assert b"artifact-only" in lib.MXTpuPredLastError()
+    finally:
+        lib.MXTpuPredFree(h)
+
+
+def test_c_loader_set_input_validation(artifact):
+    prefix, x, _ = artifact
+    lib = predict_lib()
+    h = ctypes.c_void_p()
+    assert lib.MXTpuPredCreate((prefix + "-predict.mxp").encode(), None,
+                               ctypes.byref(h)) == 0
+    try:
+        buf = np.ascontiguousarray(x)
+        assert lib.MXTpuPredSetInput(h, b"data",
+                                     buf.ctypes.data_as(ctypes.c_void_p),
+                                     buf.nbytes) == 0
+        assert lib.MXTpuPredSetInput(h, b"bogus",
+                                     buf.ctypes.data_as(ctypes.c_void_p),
+                                     buf.nbytes) != 0
+        assert lib.MXTpuPredSetInput(h, b"data",
+                                     buf.ctypes.data_as(ctypes.c_void_p),
+                                     3) != 0
+    finally:
+        lib.MXTpuPredFree(h)
+
+
+def _usable_pjrt_plugin():
+    """A PJRT plugin we can actually create a client on right now."""
+    cand = os.environ.get("MXTPU_PJRT_PLUGIN")
+    if cand and os.path.exists(cand):
+        return cand
+    return None
+
+
+@pytest.mark.skipif(_usable_pjrt_plugin() is None,
+                    reason="no usable PJRT plugin (set MXTPU_PJRT_PLUGIN)")
+def test_c_loader_executes(artifact):
+    """Full load-compile-execute through the PJRT C API; output must match
+    the Python Predictor."""
+    prefix, x, ref_out = artifact
+    lib = predict_lib()
+    h = ctypes.c_void_p()
+    rc = lib.MXTpuPredCreate((prefix + "-predict.mxp").encode(),
+                             _usable_pjrt_plugin().encode(), ctypes.byref(h))
+    assert rc == 0, lib.MXTpuPredLastError()
+    try:
+        buf = np.ascontiguousarray(x)
+        assert lib.MXTpuPredSetInput(h, b"data",
+                                     buf.ctypes.data_as(ctypes.c_void_p),
+                                     buf.nbytes) == 0
+        assert lib.MXTpuPredForward(h) == 0, lib.MXTpuPredLastError()
+        out = np.zeros((2, 3), np.float32)
+        assert lib.MXTpuPredGetOutput(h, 0,
+                                      out.ctypes.data_as(ctypes.c_void_p),
+                                      out.nbytes) == 0
+        np.testing.assert_allclose(out, ref_out, rtol=1e-5, atol=1e-6)
+    finally:
+        lib.MXTpuPredFree(h)
+
+
+def test_mxp_respects_argument_dce(tmp_path):
+    """jax.export prunes unused args (module_kept_var_idx); the .mxp must
+    list exactly the args the compiled main accepts."""
+    rng = np.random.RandomState(1)
+    data = sym.Variable("data")
+    w = sym.Variable("w")
+    unused = sym.Variable("unused_w")  # param never reaching the output
+    out = sym.FullyConnected(data, w, sym.Variable("b"), num_hidden=2)
+    prefix = str(tmp_path / "dce")
+    deploy.export_predictor(
+        prefix, out,
+        {"w": nd.array(rng.rand(2, 4).astype(np.float32)),
+         "b": nd.array(rng.rand(2).astype(np.float32)),
+         "unused_w": nd.array(rng.rand(7, 7).astype(np.float32))},
+        {}, {"data": (1, 4)})
+    lib = predict_lib()
+    h = ctypes.c_void_p()
+    assert lib.MXTpuPredCreate((prefix + "-predict.mxp").encode(), None,
+                               ctypes.byref(h)) == 0
+    try:
+        n = ctypes.c_int()
+        lib.MXTpuPredNumInputs(h, ctypes.byref(n))
+        assert n.value == 1  # 'unused_w' must not survive as an arg
+    finally:
+        lib.MXTpuPredFree(h)
