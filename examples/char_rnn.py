@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Character-level RNN language model + sampling
+(ref: example/rnn/old/char-rnn.ipynb and example/gluon/word_language_model —
+the classic char-rnn demo: learn a corpus character by character, then
+generate text).
+
+Gluon LSTM over a char vocabulary, trained with the fused train step
+(single XLA program per step — the TPU-native "bulked executor"), then
+autoregressive sampling with temperature.
+
+A built-in corpus is used when no --corpus file is given.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import fused, gluon, nd
+from incubator_mxnet_tpu.gluon import nn, rnn
+
+DEFAULT_CORPUS = (
+    "the quick brown fox jumps over the lazy dog. "
+    "she sells sea shells by the sea shore. "
+    "peter piper picked a peck of pickled peppers. "
+    "how much wood would a woodchuck chuck if a woodchuck could chuck wood. "
+) * 40
+
+
+class CharRNN(gluon.block.HybridBlock):
+    def __init__(self, vocab, hidden, layers, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.embed = nn.Embedding(vocab, hidden)
+            self.lstm = rnn.LSTM(hidden, num_layers=layers, layout="NTC")
+            self.out = nn.Dense(vocab, flatten=False)
+
+    def hybrid_forward(self, F, x):
+        return self.out(self.lstm(self.embed(x)))
+
+
+def batches(ids, seq_len, batch_size, rng):
+    """Random contiguous windows: x = chars[t:t+T], y = chars[t+1:t+T+1]."""
+    n = len(ids) - seq_len - 1
+    while True:
+        starts = rng.randint(0, n, batch_size)
+        x = np.stack([ids[s:s + seq_len] for s in starts])
+        y = np.stack([ids[s + 1:s + seq_len + 1] for s in starts])
+        yield x.astype(np.int32), y.astype(np.float32)
+
+
+def sample(net, seed_text, stoi, itos, length=120, temperature=0.8):
+    ids = [stoi[c] for c in seed_text]
+    rng = np.random.RandomState(0)
+    for _ in range(length):
+        ctx = np.asarray(ids[-64:], np.int32)[None, :]
+        logits = net(nd.array(ctx)).asnumpy()[0, -1]
+        p = np.exp((logits - logits.max()) / temperature)
+        p /= p.sum()
+        ids.append(int(rng.choice(len(p), p=p)))
+    return "".join(itos[i] for i in ids)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--corpus", default=None)
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--seq-len", type=int, default=64)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--lr", type=float, default=3e-3)
+    args = ap.parse_args()
+
+    text = (open(args.corpus).read() if args.corpus else DEFAULT_CORPUS)
+    chars = sorted(set(text))
+    stoi = {c: i for i, c in enumerate(chars)}
+    itos = {i: c for i, c in enumerate(chars)}
+    ids = np.asarray([stoi[c] for c in text], np.int32)
+    vocab = len(chars)
+    print(f"corpus: {len(text)} chars, vocab {vocab}")
+
+    mx.random.seed(0)
+    net = CharRNN(vocab, args.hidden, args.layers)
+    net.initialize(mx.init.Xavier())
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    opt = mx.optimizer.Adam(learning_rate=args.lr,
+                            rescale_grad=1.0 / args.batch_size)
+    step = fused.GluonTrainStep(net, lambda n, x, y: L(n(x), y), opt)
+
+    rng = np.random.RandomState(0)
+    gen = batches(ids, args.seq_len, args.batch_size, rng)
+    first_loss = last_loss = None
+    for i in range(args.steps):
+        x, y = next(gen)
+        loss = step(nd.array(x), nd.array(y))
+        if i == 0:
+            first_loss = float(loss.asscalar())
+        if (i + 1) % 50 == 0:
+            last_loss = float(loss.asscalar())
+            print(f"step {i + 1}: loss {last_loss:.3f}")
+    step.sync_params()
+
+    assert last_loss < first_loss * 0.6, (first_loss, last_loss)
+    print("--- sample ---")
+    print(sample(net, "the ", stoi, itos))
+    print("char_rnn OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -136,3 +136,28 @@ def predict_lib():
         lib.MXTpuPredFree.argtypes = [ctypes.c_void_p]
         lib._pred_configured = True
     return lib
+
+
+def imgpipe_lib():
+    """Native JPEG decode+augment batch pipeline (src/imgpipe.cc; ref:
+    iter_image_recordio_2.cc's preprocess-thread parser)."""
+    lib = load("mxtpu_imgpipe", ["imgpipe.cc"], extra=["-ljpeg"])
+    if lib is not None and not getattr(lib, "_imgpipe_configured", False):
+        lib.imgpipe_decode_batch.restype = ctypes.c_int
+        lib.imgpipe_decode_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p),      # datas
+            ctypes.POINTER(ctypes.c_uint32),      # lens
+            ctypes.POINTER(ctypes.c_int64),       # indices
+            ctypes.c_int,                         # n
+            ctypes.POINTER(ctypes.c_float),       # out
+            ctypes.c_int, ctypes.c_int,           # target_h, target_w
+            ctypes.c_int,                         # resize
+            ctypes.c_int, ctypes.c_int,           # rand_crop, rand_mirror
+            ctypes.POINTER(ctypes.c_float),       # mean3
+            ctypes.POINTER(ctypes.c_float),       # std3
+            ctypes.c_float,                       # scale
+            ctypes.c_uint64,                      # seed
+            ctypes.c_int,                         # nthreads
+        ]
+        lib._imgpipe_configured = True
+    return lib

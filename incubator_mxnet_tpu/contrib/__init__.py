@@ -5,3 +5,4 @@ from . import amp  # noqa: F401
 from . import quantization  # noqa: F401
 from . import onnx  # noqa: F401
 from . import torch_bridge  # noqa: F401
+from . import svrg  # noqa: F401

@@ -1,0 +1,114 @@
+"""SVRG optimization (ref: python/mxnet/contrib/svrg_optimization/ —
+SVRGModule + _SVRGOptimizer).
+
+Stochastic Variance-Reduced Gradient (Johnson & Zhang 2013): every
+`update_freq` epochs, snapshot the weights w~ and compute the full-dataset
+gradient mu = (1/N) sum_i grad_i(w~); each step then applies the
+variance-reduced gradient
+
+    g_i(w) - g_i(w~) + mu
+
+TPU-native surface: a Gluon-style `SVRGTrainer` instead of the reference's
+Module subclass — the snapshot pass and the paired two-gradient step are
+plain eager autograd over the net's parameters, so it composes with any
+Block. The reference's split (module keeps snapshots, a wrapped optimizer
+consumes the stitched gradient) collapses into this one class.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import autograd
+from .. import optimizer as opt_mod
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["SVRGTrainer"]
+
+
+class SVRGTrainer:
+    """Variance-reduced trainer (ref: svrg_module.py SVRGModule).
+
+    Usage per epoch::
+
+        if epoch % trainer.update_freq == 0:
+            trainer.update_full_grads(batches)   # snapshot w~, compute mu
+        for x, y in batches:
+            loss = trainer.step(x, y)
+    """
+
+    def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
+                 update_freq=2):
+        self.net = net
+        self.loss_fn = loss_fn
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
+        self.optimizer = optimizer
+        self.update_freq = int(update_freq)
+        self._params = list(net.collect_params().items())
+        self._states = {}
+        self._snapshot = None  # name -> raw param values at w~
+        self._mu = None        # name -> full-dataset gradient at w~
+
+    def _batch_grads(self, x, y):
+        """(loss, gradients of the batch loss) at the CURRENT params."""
+        for _, p in self._params:
+            if p.grad_req != "null":
+                p.zero_grad()
+        with autograd.record():
+            loss = self.loss_fn(self.net, x, y)
+        loss.backward()
+        grads = {n: p.grad()._data for n, p in self._params
+                 if p.grad_req != "null"}
+        return loss, grads
+
+    def _with_params(self, values):
+        """Temporarily swap net params to `values` (name -> raw array)."""
+        class _Swap:
+            def __init__(s):
+                s.saved = None
+
+            def __enter__(s):
+                s.saved = {n: p.data()._data for n, p in self._params}
+                for n, p in self._params:
+                    if n in values:
+                        p.data()._data = values[n]
+
+            def __exit__(s, *exc):
+                for n, p in self._params:
+                    p.data()._data = s.saved[n]
+
+        return _Swap()
+
+    def update_full_grads(self, batches):
+        """Snapshot w~ := w and mu := mean over `batches` of grad(w~)
+        (ref: SVRGModule.update_full_grads)."""
+        self._snapshot = {n: p.data()._data for n, p in self._params}
+        acc, count = {}, 0
+        for batch in batches:
+            x, y = batch if isinstance(batch, (tuple, list)) else \
+                (batch.data[0], batch.label[0])
+            _, g = self._batch_grads(x, y)
+            for n, v in g.items():
+                acc[n] = v if n not in acc else acc[n] + v
+            count += 1
+        if count == 0:
+            raise ValueError("update_full_grads: empty batch iterable")
+        self._mu = {n: v / count for n, v in acc.items()}
+
+    def step(self, x, y):
+        """One variance-reduced update; returns the batch loss."""
+        if self._snapshot is None:
+            raise RuntimeError("call update_full_grads() before step() "
+                               "(the SVRG schedule needs a snapshot)")
+        loss, g_cur = self._batch_grads(x, y)
+        with self._with_params(self._snapshot):
+            _, g_snap = self._batch_grads(x, y)
+        for i, (n, p) in enumerate(self._params):
+            if p.grad_req == "null" or n not in g_cur:
+                continue
+            vr = g_cur[n] - g_snap[n] + self._mu[n]
+            if n not in self._states:
+                self._states[n] = self.optimizer.create_state(i, p.data())
+            self.optimizer.update(i, p.data(), NDArray._from_data(vr),
+                                  self._states[n])
+        return loss

@@ -240,6 +240,34 @@ class ImageRecordIter(_PrefetchMixin, DataIter):
         self._pool = ThreadPoolExecutor(max_workers=max(1, preprocess_threads))
         self._prefetch_depth = int(prefetch_buffer)
         self._cursor = 0
+
+        # --- native decode+augment fast path (src/imgpipe.cc; ref:
+        #     iter_image_recordio_2.cc) when the augmentation config is in
+        #     the subset it implements: resize / random|center crop /
+        #     mirror / mean/std / scale. Anything richer (HSL jitter,
+        #     rotation, aspect) keeps the Python augmenter chain. ---
+        self._native = None
+        simple_augs = (not (random_h or random_s or random_l)
+                       and max_rotate_angle == 0 and max_aspect_ratio == 0.0
+                       and max_shear_ratio == 0.0 and max_random_scale == 1.0
+                       and min_random_scale == 1.0 and mean_img is None
+                       and self.data_shape[0] == 3 and dtype == "float32"
+                       and inter_method == 1)  # native resize is bilinear
+        if simple_augs:
+            from . import _native as _nat
+
+            lib = _nat.imgpipe_lib()
+            if lib is not None:
+                import ctypes as _ct
+
+                mean = np.asarray([mean_r, mean_g, mean_b], np.float32)
+                std = np.asarray([std_r, std_g, std_b], np.float32)
+                self._native = dict(
+                    lib=lib, ct=_ct,
+                    mean=mean, std=std,
+                    resize=int(resize), rand_crop=int(bool(rand_crop)),
+                    rand_mirror=int(bool(rand_mirror)),
+                    threads=max(1, preprocess_threads), seed=int(seed))
         self.reset()
 
     @property
@@ -265,6 +293,56 @@ class ImageRecordIter(_PrefetchMixin, DataIter):
         label = np.asarray(header.label, np.float32)
         return a.astype(self.dtype, copy=False), label
 
+    def _produce_native(self, take, pad):
+        """Batch decode+augment entirely in C++ (GIL-free thread pool)."""
+        nat = self._native
+        ct = nat["ct"]
+        n = len(take)
+        if hasattr(self._reader, "read_batch"):
+            blobs = self._reader.read_batch(take)
+        else:
+            blobs = [self._read(i) for i in take]
+        raws, labels = [], []
+        for blob in blobs:
+            header, img_bytes = recordio.unpack(blob)
+            if not img_bytes.startswith(b"\xff\xd8"):
+                # non-JPEG payload (e.g. PNG-packed shard): the native
+                # decoder only handles JPEG — permanently fall back to the
+                # cv2-based Python chain, which decodes any format
+                self._native = None
+                return None
+            raws.append(img_bytes)
+            labels.append(np.asarray(header.label, np.float32))
+        keep = [ct.c_char_p(r) for r in raws]  # keep buffers alive
+        datas = (ct.c_void_p * n)(*[ct.cast(k, ct.c_void_p) for k in keep])
+        lens = (ct.c_uint32 * n)(*[len(r) for r in raws])
+        idxs = (ct.c_int64 * n)(*take)
+        out = np.empty((n, 3) + self.data_shape[1:], np.float32)
+        # per-epoch seed shift: fresh augmentation stream each epoch, same
+        # stream for a given (seed, epoch) — matching the Python chain's
+        # fresh-per-epoch randomness while keeping runs reproducible
+        seed = (nat["seed"] + 0x9E3779B1 * self._epoch) & 0xFFFFFFFFFFFFFFFF
+        rc = nat["lib"].imgpipe_decode_batch(
+            datas, lens, idxs, n,
+            out.ctypes.data_as(ct.POINTER(ct.c_float)),
+            self.data_shape[1], self.data_shape[2], nat["resize"],
+            nat["rand_crop"], nat["rand_mirror"],
+            nat["mean"].ctypes.data_as(ct.POINTER(ct.c_float)),
+            nat["std"].ctypes.data_as(ct.POINTER(ct.c_float)),
+            self._scale, seed, nat["threads"])
+        if rc != 0:
+            raise IOError(f"corrupt record at batch position {rc - 1} "
+                          f"(record {take[rc - 1]})")
+        return DataBatch(data=[nd_array(out)],
+                         label=[nd_array(self._assemble_labels(labels))],
+                         pad=pad)
+
+    def _assemble_labels(self, labels):
+        if self.label_width == 1:
+            return np.array([float(np.atleast_1d(l)[0]) for l in labels],
+                            np.float32)
+        return np.stack([np.resize(l, self.label_width) for l in labels])
+
     def _produce(self):
         if self._cursor >= len(self._seq):
             raise StopIteration
@@ -275,13 +353,14 @@ class ImageRecordIter(_PrefetchMixin, DataIter):
             raise StopIteration
         if pad:  # wrap-around padding like the reference's round_batch
             take = take + self._seq[:pad]
+        if self._native is not None:
+            batch = self._produce_native(take, pad)
+            if batch is not None:
+                return batch
+            # fell back (non-JPEG shard): continue on the Python chain
         samples = list(self._pool.map(self._decode_one, take))
         data = np.stack([s[0] for s in samples])
-        if self.label_width == 1:
-            label = np.array([float(np.atleast_1d(s[1])[0]) for s in samples],
-                             np.float32)
-        else:
-            label = np.stack([np.resize(s[1], self.label_width) for s in samples])
+        label = self._assemble_labels([s[1] for s in samples])
         return DataBatch(data=[nd_array(data)], label=[nd_array(label)], pad=pad)
 
     def reset(self):
@@ -289,6 +368,7 @@ class ImageRecordIter(_PrefetchMixin, DataIter):
         if self.shuffle:
             self._rng.shuffle(self._seq)
         self._cursor = 0
+        self._epoch = getattr(self, "_epoch", -1) + 1
         self._start_prefetch(self._prefetch_depth)
 
     def close(self):

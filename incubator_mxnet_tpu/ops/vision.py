@@ -654,3 +654,125 @@ def deformable_convolution(data, offset, weight, bias=None, *, kernel,
     if bias is not None and not no_bias:
         out = out + bias.reshape(1, -1, 1, 1)
     return out
+
+
+# ---------------------------------------------------------------------------
+# RPN proposals (Faster-RCNN)
+# ---------------------------------------------------------------------------
+
+
+def _make_anchors(h, w, stride, scales, ratios):
+    """Anchor grid (A*h*w, 4) corners, matching the reference's generation
+    (ref: src/operator/contrib/proposal.cc GenerateAnchors): base box of
+    `stride` size at each cell, per ratio then per scale."""
+    base = stride - 1.0
+    ctr = base / 2.0
+    size = stride * stride
+    anchors = []
+    for r in ratios:
+        size_r = size / r
+        ws = np.round(np.sqrt(size_r))
+        hs = np.round(ws * r)
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            anchors.append([ctr - 0.5 * (wss - 1), ctr - 0.5 * (hss - 1),
+                            ctr + 0.5 * (wss - 1), ctr + 0.5 * (hss - 1)])
+    A = len(anchors)
+    anchors = jnp.asarray(anchors, jnp.float32)  # (A, 4)
+    sx = (jnp.arange(w, dtype=jnp.float32) * stride)
+    sy = (jnp.arange(h, dtype=jnp.float32) * stride)
+    shift = jnp.stack(jnp.meshgrid(sx, sy), axis=-1)        # (h, w, 2)
+    shift = jnp.concatenate([shift, shift], axis=-1)        # (h, w, 4)
+    all_a = anchors[None, None] + shift[:, :, None]         # (h, w, A, 4)
+    return all_a.reshape(-1, 4), A
+
+
+def _proposal_one(score, bbox, im_info, anchors, *, pre_top, post_top,
+                  nms_thresh, min_size, stride):
+    """One image's RPN proposals (ref: proposal.cc ProposalOp::Forward).
+    score (A*h*w,), bbox deltas (A*h*w, 4), anchors (A*h*w, 4)."""
+    height, width, im_scale = im_info[0], im_info[1], im_info[2]
+    # decode: deltas are (dx, dy, dw, dh) on center format
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    ax = anchors[:, 0] + 0.5 * (aw - 1.0)
+    ay = anchors[:, 1] + 0.5 * (ah - 1.0)
+    cx = bbox[:, 0] * aw + ax
+    cy = bbox[:, 1] * ah + ay
+    pw = jnp.exp(jnp.clip(bbox[:, 2], -10, 10)) * aw
+    ph = jnp.exp(jnp.clip(bbox[:, 3], -10, 10)) * ah
+    x1 = jnp.clip(cx - 0.5 * (pw - 1.0), 0, width - 1.0)
+    y1 = jnp.clip(cy - 0.5 * (ph - 1.0), 0, height - 1.0)
+    x2 = jnp.clip(cx + 0.5 * (pw - 1.0), 0, width - 1.0)
+    y2 = jnp.clip(cy + 0.5 * (ph - 1.0), 0, height - 1.0)
+    # min-size filter in input-image scale
+    ms = min_size * im_scale
+    keep = ((x2 - x1 + 1.0) >= ms) & ((y2 - y1 + 1.0) >= ms)
+    score = jnp.where(keep, score, -1.0)
+    # pre-NMS topk
+    k = min(pre_top, score.shape[0]) if pre_top > 0 else score.shape[0]
+    top_scores, top_idx = lax.top_k(score, k)
+    rows = jnp.stack([jnp.zeros_like(top_scores), top_scores,
+                      x1[top_idx], y1[top_idx], x2[top_idx], y2[top_idx]],
+                     axis=1)
+    rows = jnp.where(top_scores[:, None] > -1.0, rows, -1.0)
+    kept = _nms_one(rows, nms_thresh, -1.0, -1, 2, 1, -1, True,
+                    "corner", "corner")
+    # compact survivors (suppressed rows are -1 holes), then take the
+    # post-NMS top; short batches pad with duplicates of the best proposal
+    # (the reference pads the same way)
+    order = jnp.argsort(-kept[:, 1])
+    kept = kept[order]
+    if kept.shape[0] < post_top:  # fewer candidates than the quota
+        pad_n = post_top - kept.shape[0]
+        kept = jnp.concatenate(
+            [kept, jnp.tile(kept[0][None], (pad_n, 1))], axis=0)
+    post = kept[:post_top]
+    invalid = post[:, 1] < 0
+    post = jnp.where(invalid[:, None], kept[0][None, :], post)
+    return post[:, 2:6], post[:, 1]
+
+
+@register("_contrib_Proposal", aliases=("_contrib_MultiProposal", "Proposal"),
+          num_outputs=lambda attrs: 2 if attrs.get("output_score") else 1,
+          no_grad_inputs=("cls_prob", "bbox_pred", "im_info"))
+def proposal(cls_prob, bbox_pred, im_info, *, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+             feature_stride=16, output_score=False, iou_loss=False):
+    """RPN proposal generation (ref: src/operator/contrib/proposal.cc:1 and
+    multi_proposal.cc — both served here since the computation vmaps over
+    the batch).
+
+    cls_prob (B, 2A, H, W) — second half holds foreground scores;
+    bbox_pred (B, 4A, H, W); im_info (B, 3) rows (height, width, scale).
+    Returns rois (B*rpn_post_nms_top_n, 5) [batch_idx, x1, y1, x2, y2]
+    (+ scores (B*rpn_post_nms_top_n, 1) when output_score).
+    """
+    if iou_loss:
+        raise NotImplementedError(
+            "iou_loss=True decoding (x1,y1,x2,y2 deltas) is not implemented; "
+            "retrain the RPN with the standard transform or decode manually")
+    b, a2, h, w = cls_prob.shape
+    A = a2 // 2
+    anchors, A2 = _make_anchors(h, w, feature_stride, scales, ratios)
+    assert A2 == A, f"anchor count {A2} != cls_prob channels//2 {A}"
+    # (B, A, h, w) fg scores -> (B, h*w*A) matching anchor enumeration
+    fg = cls_prob[:, A:].transpose(0, 2, 3, 1).reshape(b, -1)
+    deltas = (bbox_pred.reshape(b, A, 4, h, w)
+              .transpose(0, 3, 4, 1, 2).reshape(b, -1, 4))
+
+    def one(score_i, delta_i, info_i):
+        return _proposal_one(
+            score_i, delta_i, info_i, anchors,
+            pre_top=int(rpn_pre_nms_top_n), post_top=int(rpn_post_nms_top_n),
+            nms_thresh=float(threshold), min_size=float(rpn_min_size),
+            stride=feature_stride)
+
+    boxes, scores = jax.vmap(one)(fg, deltas, im_info)
+    batch_idx = jnp.repeat(jnp.arange(b, dtype=jnp.float32),
+                           int(rpn_post_nms_top_n))
+    rois = jnp.concatenate([batch_idx[:, None], boxes.reshape(-1, 4)], axis=1)
+    if output_score:
+        return rois, scores.reshape(-1, 1)
+    return rois

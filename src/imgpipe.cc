@@ -1,0 +1,224 @@
+// imgpipe.cc — native JPEG decode + augment + batch assembly
+// (ref: src/io/iter_image_recordio_2.cc:50 ImageRecordIOParser2 — the
+// reference keeps this path in C++ with a preprocess_threads pool because
+// Python-side decode cannot feed an accelerator; same reason here: the
+// Python augmenters are GIL-bound, this path is not).
+//
+// One call decodes a whole batch on an internal thread pool and writes
+// normalized NCHW float32 directly into the caller's buffer:
+//   JPEG -> RGB (libjpeg) -> resize shorter side (bilinear) ->
+//   random/center crop -> optional mirror -> (x*scale - mean)/std -> NCHW
+//
+// Deterministic per-record RNG: seed ^ record index -> std::mt19937, so a
+// fixed seed reproduces the exact augmentation stream regardless of thread
+// scheduling (ref: the default augmenter's per-record PRNG).
+
+#include <stddef.h>
+#include <stdio.h>
+
+#include <jpeglib.h>
+#include <setjmp.h>
+#include <stdint.h>
+#include <string.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <random>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jb;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  longjmp(reinterpret_cast<JpegErr*>(cinfo->err)->jb, 1);
+}
+
+// Decode JPEG bytes to RGB HWC uint8. Returns false on corrupt input.
+bool decode_jpeg(const uint8_t* data, uint32_t len, std::vector<uint8_t>* out,
+                 int* h, int* w) {
+  jpeg_decompress_struct cinfo;
+  JpegErr err;
+  cinfo.err = jpeg_std_error(&err.mgr);
+  err.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(err.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(data), len);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *h = cinfo.output_height;
+  *w = cinfo.output_width;
+  out->resize(static_cast<size_t>(*h) * *w * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out->data() +
+                   static_cast<size_t>(cinfo.output_scanline) * *w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// Bilinear resize RGB HWC uint8.
+void resize_bilinear(const uint8_t* src, int sh, int sw, uint8_t* dst,
+                     int dh, int dw) {
+  const float ry = dh > 1 ? static_cast<float>(sh - 1) / (dh - 1) : 0.f;
+  const float rx = dw > 1 ? static_cast<float>(sw - 1) / (dw - 1) : 0.f;
+  for (int y = 0; y < dh; ++y) {
+    float fy = y * ry;
+    int y0 = static_cast<int>(fy);
+    int y1 = std::min(y0 + 1, sh - 1);
+    float wy = fy - y0;
+    for (int x = 0; x < dw; ++x) {
+      float fx = x * rx;
+      int x0 = static_cast<int>(fx);
+      int x1 = std::min(x0 + 1, sw - 1);
+      float wx = fx - x0;
+      for (int c = 0; c < 3; ++c) {
+        float v00 = src[(static_cast<size_t>(y0) * sw + x0) * 3 + c];
+        float v01 = src[(static_cast<size_t>(y0) * sw + x1) * 3 + c];
+        float v10 = src[(static_cast<size_t>(y1) * sw + x0) * 3 + c];
+        float v11 = src[(static_cast<size_t>(y1) * sw + x1) * 3 + c];
+        float v = v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                  v10 * wy * (1 - wx) + v11 * wy * wx;
+        dst[(static_cast<size_t>(y) * dw + x) * 3 + c] =
+            static_cast<uint8_t>(v + 0.5f);
+      }
+    }
+  }
+}
+
+struct AugParams {
+  int target_h, target_w;
+  int resize;       // shorter-side resize (0 = only if needed for crop)
+  int rand_crop;    // random crop position vs center
+  int rand_mirror;  // random horizontal flip
+  float mean[3], std[3], scale;
+  uint64_t seed;
+};
+
+bool process_one(const uint8_t* data, uint32_t len, int64_t index,
+                 const AugParams& p, float* out /* CHW */) {
+  std::vector<uint8_t> rgb;
+  int h = 0, w = 0;
+  if (!decode_jpeg(data, len, &rgb, &h, &w)) return false;
+
+  // Matching the default augmenter chain (ref: image_aug_default.cc /
+  // python CreateAugmenter): an explicit `resize` scales the shorter side;
+  // otherwise the crop happens at the ORIGINAL scale — scaling up only
+  // when the image is smaller than the crop window.
+  int nh = h, nw = w;
+  if (p.resize > 0) {
+    if (h <= w) {
+      nh = p.resize;
+      nw = static_cast<int>(
+          std::lround(static_cast<double>(w) * p.resize / h));
+    } else {
+      nw = p.resize;
+      nh = static_cast<int>(
+          std::lround(static_cast<double>(h) * p.resize / w));
+    }
+  }
+  if (nh < p.target_h || nw < p.target_w) {
+    double f = std::max(static_cast<double>(p.target_h) / nh,
+                        static_cast<double>(p.target_w) / nw);
+    nh = std::max(p.target_h, static_cast<int>(std::lround(nh * f)));
+    nw = std::max(p.target_w, static_cast<int>(std::lround(nw * f)));
+  }
+  std::vector<uint8_t> resized;
+  const uint8_t* img = rgb.data();
+  if (nh != h || nw != w) {
+    resized.resize(static_cast<size_t>(nh) * nw * 3);
+    resize_bilinear(rgb.data(), h, w, resized.data(), nh, nw);
+    img = resized.data();
+    h = nh;
+    w = nw;
+  }
+
+  std::mt19937 rng(static_cast<uint32_t>(p.seed ^ (0x9e3779b9u * index)));
+  int max_y = h - p.target_h, max_x = w - p.target_w;
+  int y0, x0;
+  if (p.rand_crop) {
+    y0 = max_y > 0 ? static_cast<int>(rng() % (max_y + 1)) : 0;
+    x0 = max_x > 0 ? static_cast<int>(rng() % (max_x + 1)) : 0;
+  } else {
+    y0 = max_y / 2;
+    x0 = max_x / 2;
+  }
+  bool mirror = p.rand_mirror && (rng() & 1);
+
+  const size_t plane = static_cast<size_t>(p.target_h) * p.target_w;
+  for (int y = 0; y < p.target_h; ++y) {
+    for (int x = 0; x < p.target_w; ++x) {
+      int sx = mirror ? (p.target_w - 1 - x) : x;
+      const uint8_t* px =
+          img + ((static_cast<size_t>(y0 + y) * w) + (x0 + sx)) * 3;
+      for (int c = 0; c < 3; ++c) {
+        // same order as the Python chain: normalize first, then scale
+        // (ColorNormalizeAug then `* scale` in ImageRecordIter)
+        float v = (static_cast<float>(px[c]) - p.mean[c]) / p.std[c];
+        out[plane * c + static_cast<size_t>(y) * p.target_w + x] =
+            v * p.scale;
+      }
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode+augment a batch into `out` (n x 3 x H x W float32, C-order).
+// Returns 0 on success, or 1-based index of the first corrupt record.
+int imgpipe_decode_batch(const uint8_t** datas, const uint32_t* lens,
+                         const int64_t* indices, int n, float* out,
+                         int target_h, int target_w, int resize,
+                         int rand_crop, int rand_mirror, const float* mean3,
+                         const float* std3, float scale, uint64_t seed,
+                         int nthreads) {
+  AugParams p;
+  p.target_h = target_h;
+  p.target_w = target_w;
+  p.resize = resize;
+  p.rand_crop = rand_crop;
+  p.rand_mirror = rand_mirror;
+  for (int c = 0; c < 3; ++c) {
+    p.mean[c] = mean3 ? mean3[c] : 0.f;
+    p.std[c] = (std3 && std3[c] != 0.f) ? std3[c] : 1.f;
+  }
+  p.scale = scale;
+  p.seed = seed;
+
+  const size_t stride = 3ull * target_h * target_w;
+  std::atomic<int> next(0), failed(0);
+  auto worker = [&]() {
+    for (;;) {
+      int i = next.fetch_add(1);
+      if (i >= n || failed.load() != 0) return;
+      if (!process_one(datas[i], lens[i], indices[i], p, out + stride * i)) {
+        int expect = 0;
+        failed.compare_exchange_strong(expect, i + 1);
+        return;
+      }
+    }
+  };
+  int nt = std::max(1, std::min(nthreads, n));
+  std::vector<std::thread> pool;
+  pool.reserve(nt - 1);
+  for (int t = 1; t < nt; ++t) pool.emplace_back(worker);
+  worker();
+  for (auto& th : pool) th.join();
+  return failed.load();
+}
+
+}  // extern "C"

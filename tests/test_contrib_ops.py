@@ -166,3 +166,92 @@ def test_sparse_embedding():
     emb.initialize()
     out = emb(nd.array([1, 3, 5]))
     assert out.shape == (3, 4)
+
+
+def test_proposal_shapes_and_validity():
+    """RPN proposal generation (ref: src/operator/contrib/proposal.cc)."""
+    import numpy as np
+    from incubator_mxnet_tpu import nd
+
+    rng = np.random.RandomState(0)
+    b, h, w, A = 2, 8, 8, 12  # default scales x ratios = 4*3
+    cls = nd.array(rng.rand(b, 2 * A, h, w).astype("float32"))
+    bbox = nd.array((rng.rand(b, 4 * A, h, w).astype("float32") - 0.5) * 0.2)
+    im_info = nd.array(np.array([[120, 120, 1.0], [100, 110, 1.0]],
+                                "float32"))
+    rois = nd._contrib_Proposal(cls, bbox, im_info, rpn_pre_nms_top_n=300,
+                                rpn_post_nms_top_n=40)
+    r = rois.asnumpy()
+    assert r.shape == (b * 40, 5)
+    # batch indices blocked [0]*40 + [1]*40
+    assert (r[:40, 0] == 0).all() and (r[40:, 0] == 1).all()
+    # boxes inside their image and min-size respected
+    for bi, (hh, ww) in enumerate([(120, 120), (100, 110)]):
+        rows = r[bi * 40:(bi + 1) * 40]
+        assert (rows[:, 1] >= 0).all() and (rows[:, 3] <= ww - 1 + 1e-3).all()
+        assert (rows[:, 2] >= 0).all() and (rows[:, 4] <= hh - 1 + 1e-3).all()
+        assert ((rows[:, 3] - rows[:, 1] + 1) >= 16).all()
+        assert ((rows[:, 4] - rows[:, 2] + 1) >= 16).all()
+
+
+def test_proposal_output_score_sorted():
+    import numpy as np
+    from incubator_mxnet_tpu import nd
+
+    rng = np.random.RandomState(1)
+    A = 12
+    cls = nd.array(rng.rand(1, 2 * A, 6, 6).astype("float32"))
+    bbox = nd.array(np.zeros((1, 4 * A, 6, 6), "float32"))
+    im_info = nd.array(np.array([[96, 96, 1.0]], "float32"))
+    rois, scores = nd._contrib_Proposal(
+        cls, bbox, im_info, rpn_pre_nms_top_n=100, rpn_post_nms_top_n=20,
+        output_score=True)
+    s = scores.asnumpy().ravel()
+    assert s.shape == (20,)
+    assert (np.diff(s) <= 1e-6).all(), "scores must be descending"
+    assert (s > 0).all()
+
+
+def test_multiproposal_alias():
+    import numpy as np
+    from incubator_mxnet_tpu import nd
+
+    rng = np.random.RandomState(2)
+    A = 12
+    cls = rng.rand(2, 2 * A, 5, 5).astype("float32")
+    bbox = (rng.rand(2, 4 * A, 5, 5).astype("float32") - 0.5) * 0.1
+    info = np.array([[80, 80, 1.0], [80, 80, 1.0]], "float32")
+    a = nd._contrib_Proposal(nd.array(cls), nd.array(bbox), nd.array(info),
+                             rpn_post_nms_top_n=10).asnumpy()
+    m = nd._contrib_MultiProposal(nd.array(cls), nd.array(bbox),
+                                  nd.array(info),
+                                  rpn_post_nms_top_n=10).asnumpy()
+    np.testing.assert_allclose(a, m)
+
+
+def test_proposal_small_feature_map_pads():
+    """Fewer anchors than rpn_post_nms_top_n: output is padded with
+    duplicates of the best proposal instead of crashing."""
+    import numpy as np
+    from incubator_mxnet_tpu import nd
+
+    rng = np.random.RandomState(3)
+    rois = nd._contrib_Proposal(
+        nd.array(rng.rand(1, 24, 4, 4).astype("float32")),
+        nd.array(np.zeros((1, 48, 4, 4), "float32")),
+        nd.array(np.array([[64, 64, 1.0]], "float32")))  # default top_n=300
+    assert rois.shape == (300, 5)
+    r = rois.asnumpy()
+    assert (r[:, 1:] >= 0).all()
+
+
+def test_proposal_iou_loss_is_loud():
+    import numpy as np
+    import pytest
+    from incubator_mxnet_tpu import nd
+
+    with pytest.raises(NotImplementedError):
+        nd._contrib_Proposal(
+            nd.array(np.zeros((1, 24, 4, 4), "float32")),
+            nd.array(np.zeros((1, 48, 4, 4), "float32")),
+            nd.array(np.array([[64, 64, 1.0]], "float32")), iou_loss=True)

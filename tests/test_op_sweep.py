@@ -244,6 +244,10 @@ SPECS = {
          _rand((2,), 0.5, 1.0), jnp.zeros((1, 2)),
          _rand((1, 3), 0.1, 1.0), jnp.asarray([[0, 1, 0]]),
          jnp.asarray([3.0])), {}),
+    "_contrib_Proposal": lambda: (
+        (_rand((1, 24, 6, 6), 0, 1), _rand((1, 48, 6, 6), -0.1, 0.1),
+         jnp.asarray([[96.0, 96.0, 1.0]])),
+        dict(rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10)),
     # int8 quantized ops: integer in/out, inference-only
     "_contrib_quantized_conv": lambda: (
         (jnp.asarray(RNG.randint(-127, 128, (2, 3, 6, 6)), jnp.int8),

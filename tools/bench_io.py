@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Input-pipeline throughput benchmark (ref: the reason
+src/io/iter_image_recordio_2.cc exists — proving the data path can feed the
+chip; perf.md's guidance is to watch for IO-bound training).
+
+Packs a synthetic JPEG RecordIO shard, then measures:
+  decode+augment+batch throughput of ImageRecordIter (images/sec)
+  for several preprocess_threads settings,
+and compares against a model-consumption target (img/s the training step
+needs, default ResNet-50-class ~400 img/s/chip fp32).
+
+Usage: python tools/bench_io.py [--num-images 4096] [--size 224]
+Prints one JSON line: {"metric": "input_pipeline_images_per_sec", ...}.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def make_dataset(path, n, size, quality=85):
+    import cv2
+
+    from incubator_mxnet_tpu import recordio
+
+    rec = recordio.MXIndexedRecordIO(path + ".idx", path + ".rec", "w")
+    rng = np.random.RandomState(0)
+    img = (rng.rand(size, size, 3) * 255).astype(np.uint8)
+    for i in range(n):
+        # vary content a little so JPEG sizes differ realistically
+        im = np.roll(img, i % size, axis=0)
+        ok, buf = cv2.imencode(".jpg", im,
+                               [cv2.IMWRITE_JPEG_QUALITY, quality])
+        assert ok
+        header = recordio.IRHeader(0, float(i % 10), i, 0)
+        rec.write_idx(i, recordio.pack(header, buf.tobytes()))
+    rec.close()
+
+
+def measure(path, n, size, batch_size, threads, augment):
+    from incubator_mxnet_tpu.io import ImageRecordIter
+
+    kwargs = dict(rand_crop=True, rand_mirror=True) if augment else {}
+    it = ImageRecordIter(
+        path_imgrec=path + ".rec", data_shape=(3, size, size),
+        batch_size=batch_size, preprocess_threads=threads,
+        prefetch_buffer=4, **kwargs)
+    measure.native = it._native is not None
+    # warm one epoch pass of a few batches
+    it.reset()
+    for _, b in zip(range(3), it):
+        b.data[0].wait_to_read()
+    it.reset()
+    count = 0
+    t0 = time.perf_counter()
+    for batch in it:
+        batch.data[0].wait_to_read()
+        count += batch_size
+    dt = time.perf_counter() - t0
+    return count / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-images", type=int, default=2048)
+    ap.add_argument("--size", type=int, default=224)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--target", type=float, default=400.0,
+                    help="img/s the training step consumes (ResNet-50-class)")
+    args = ap.parse_args()
+
+    tmp = tempfile.mkdtemp(prefix="mxtpu_io_bench_")
+    path = os.path.join(tmp, "synth")
+    make_dataset(path, args.num_images, args.size)
+
+    results = {}
+    for threads in (1, 4, 8):
+        results[threads] = round(
+            measure(path, args.num_images, args.size, args.batch_size,
+                    threads, augment=True), 1)
+        print(f"[bench_io] threads={threads}: {results[threads]} img/s",
+              file=sys.stderr)
+    best = max(results.values())
+    print(json.dumps({
+        "metric": "input_pipeline_images_per_sec",
+        "value": best,
+        "unit": "images/sec",
+        "vs_baseline": round(best / args.target, 3),
+        "per_threads": results,
+        "ncores": os.cpu_count(),
+        "native_path": bool(getattr(measure, "native", False)),
+        "note": f"decode+augment+batch, {args.size}px JPEG; target = "
+                f"{args.target} img/s model consumption; threads scale "
+                f"with cores (this host: {os.cpu_count()})",
+    }))
+
+
+if __name__ == "__main__":
+    main()
