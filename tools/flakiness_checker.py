@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Flakiness checker: rerun a test many times with varying seeds
+(ref: tools/flakiness_checker.py — same purpose and interface spirit).
+
+Usage:
+  python tools/flakiness_checker.py tests/test_operator.py::test_rnn -n 50
+  python tools/flakiness_checker.py tests/test_gluon.py -n 10 --seed-env MXTPU_SEED
+
+Runs the target under pytest `n` times, each with a different seed exported
+in the chosen env var (tests using tests/common.py `with_seed` honor it),
+and reports pass/fail counts plus the failing seeds for reproduction.
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("test", help="pytest node id (file[::test])")
+    ap.add_argument("-n", "--trials", type=int, default=20)
+    ap.add_argument("--seed-env", default="MXTPU_TEST_SEED",
+                    help="env var carrying the per-trial seed")
+    ap.add_argument("--stop-on-fail", action="store_true")
+    args = ap.parse_args()
+
+    failures = []
+    for trial in range(args.trials):
+        env = dict(os.environ)
+        env[args.seed_env] = str(trial)
+        p = subprocess.run(
+            [sys.executable, "-m", "pytest", args.test, "-x", "-q",
+             "--no-header", "-p", "no:cacheprovider"],
+            env=env, capture_output=True, text=True)
+        ok = p.returncode == 0
+        print(f"trial {trial:3d} seed={trial}: {'PASS' if ok else 'FAIL'}",
+              flush=True)
+        if not ok:
+            failures.append(trial)
+            if args.stop_on_fail:
+                print(p.stdout[-2000:])
+                break
+    n_run = trial + 1
+    print(f"\n{n_run - len(failures)}/{n_run} passed", flush=True)
+    if failures:
+        print(f"failing seeds: {failures}")
+        print(f"reproduce: {args.seed_env}={failures[0]} "
+              f"python -m pytest {args.test}")
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
