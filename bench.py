@@ -131,14 +131,35 @@ def _run_child(dtype, attempts=3, timeout=1500, extra_env=None):
     return None, last_err
 
 
+def _probe_accelerator(timeout=150):
+    """Fast check that the TPU backend can initialize at all — a down
+    tunnel makes jax.devices() hang, and burning full bench timeouts on
+    every retry would blow the driver's budget."""
+    code = ("import jax; ds = jax.devices(); "
+            "print('ACCEL' if any(d.platform != 'cpu' for d in ds) else 'CPU')")
+    try:
+        p = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=timeout)
+        return "ACCEL" in (p.stdout or "")
+    except Exception:  # timeout, fork failure, ... — never break the bench
+        return False
+
+
 def main():
     if os.environ.get("BENCH_CHILD"):
         child_main()
         return
 
+    accel_up = _probe_accelerator()
+    print(f"[bench] accelerator probe: {'up' if accel_up else 'down'}",
+          file=sys.stderr, flush=True)
+
     results, errors = {}, {}
     for dtype in ("float32", "bfloat16"):
-        r, err = _run_child(dtype, attempts=3)
+        # healthy backend: full retries; down tunnel: one short attempt in
+        # case the probe raced a recovery, then fall through to CPU
+        attempts, timeout = (3, 1500) if accel_up else (1, 600)
+        r, err = _run_child(dtype, attempts=attempts, timeout=timeout)
         if r is not None:
             results[dtype] = r
         else:

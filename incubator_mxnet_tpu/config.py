@@ -76,9 +76,11 @@ register_knob("MXNET_CPU_WORKER_NTHREADS", 4, int,
 register_knob("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 64, int,
               "Max ops bulked into one engine segment (ref: env_var.md:113); "
               "on TPU the fused train step plays this role.")
-register_knob("MXTPU_EAGER_JIT", True, bool,
-              "Jit-compile eager op dispatches (per-op cache). Off = "
-              "op-by-op dispatch for debugging.")
+register_knob("MXTPU_EAGER_JIT", False, bool,
+              "Jit-compile eager op dispatches (per-(op, attrs) cache; "
+              "XLA then re-specializes per input shape). Recommended for "
+              "steady-shape eager loops on TPU; off by default because "
+              "shape-diverse workloads pay a compile per new shape.")
 
 # data / IO
 register_knob("MXTPU_PREFETCH_BUFFER", 2, int,

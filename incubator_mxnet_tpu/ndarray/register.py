@@ -32,6 +32,44 @@ def _as_data_or_none(x):
     return NDArray(jnp.asarray(x))
 
 
+_EAGER_JIT_CACHE: dict = {}
+
+
+def _freeze(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    # tag leaves with their type: hash(2) == hash(2.0) == hash(True), and a
+    # closure traced with int 2 must not serve a call made with float 2.0
+    return (type(v).__name__, v)
+
+
+def _maybe_jit(opdef, fn, call_attrs, live_idx, n_slots):
+    """Per-(op, attrs) jit cache for eager dispatch (MXTPU_EAGER_JIT).
+
+    Off by default: XLA compiles per input-shape signature, which hurts
+    shape-diverse eager workloads; on TPU, steady-shape eager loops gain
+    the fused-kernel dispatch the reference gets from its engine bulking
+    (ref: MXNET_EXEC_BULK_EXEC_* — same latency-for-compilation trade)."""
+    from .. import config as _config
+
+    if opdef.needs_rng or not _config.get("MXTPU_EAGER_JIT"):
+        return fn
+    key = (opdef.name, _freeze(call_attrs), tuple(live_idx), n_slots)
+    try:
+        hash(key)
+    except TypeError:
+        return fn
+    cached = _EAGER_JIT_CACHE.get(key)
+    if cached is None:
+        # the jitted callable closes over THIS call's attrs; the cache key
+        # guarantees any hit was built from equal attrs
+        cached = jax.jit(fn)
+        _EAGER_JIT_CACHE[key] = cached
+    return cached
+
+
 def invoke(opdef: OpDef, args, kwargs):
     """Generic eager invocation of a registered op."""
     kwargs = dict(kwargs)
@@ -73,9 +111,12 @@ def invoke(opdef: OpDef, args, kwargs):
     live_idx = [i for i, v in enumerate(slots) if v is not None]
     live_arrays = [slots[i] for i in live_idx]
     aux_pos = [opdef.inputs.index(a) for a in opdef.aux] if (opdef.aux and not opdef.variadic) else []
+    n_slots = len(slots)
 
+    # fn must not close over `slots` (its NDArrays would be pinned for the
+    # process lifetime by the eager-jit cache) — only plain ints/attrs
     def fn(*live_datas):
-        full = [None] * len(slots)
+        full = [None] * n_slots
         for i, d in zip(live_idx, live_datas):
             full[i] = d
         for ap in aux_pos:
@@ -85,6 +126,7 @@ def invoke(opdef: OpDef, args, kwargs):
 
     from .. import profiler as _profiler
 
+    fn = _maybe_jit(opdef, fn, call_attrs, live_idx, n_slots)
     if _profiler.aggregate_enabled():
         results = _profiler.timed_invoke(
             opdef.name, autograd.invoke_recorded, fn, live_arrays,

@@ -150,3 +150,30 @@ def test_config_registry():
         mx.config.get("MXTPU_NOT_A_KNOB")
     doc = mx.config.describe()
     assert "MXTPU_HEARTBEAT_TIMEOUT" in doc and "Subsumed" in doc.title()
+
+
+def test_eager_jit_knob():
+    """MXTPU_EAGER_JIT routes eager dispatch through a per-(op, attrs) jit
+    cache with identical numerics."""
+    import os
+    import numpy as np
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.ndarray import register as reg
+
+    x = nd.array(np.random.RandomState(0).rand(4, 4).astype("float32"))
+    base = nd.relu(nd.dot(x, x)).asnumpy()
+    os.environ["MXTPU_EAGER_JIT"] = "1"
+    try:
+        reg._EAGER_JIT_CACHE.clear()
+        jitted = nd.relu(nd.dot(x, x)).asnumpy()
+        assert len(reg._EAGER_JIT_CACHE) == 2  # dot + relu entries
+        nd.relu(nd.dot(x, x))
+        assert len(reg._EAGER_JIT_CACHE) == 2  # cache hit, no growth
+        # different attrs -> new entry
+        nd.sum(x, axis=0)
+        nd.sum(x, axis=1)
+        assert len(reg._EAGER_JIT_CACHE) == 4
+    finally:
+        del os.environ["MXTPU_EAGER_JIT"]
+        reg._EAGER_JIT_CACHE.clear()
+    np.testing.assert_allclose(base, jitted, rtol=1e-6)
