@@ -189,3 +189,34 @@ def test_svrg_trainer_converges_and_reduces_variance():
                                np.asarray(full_sum), rtol=1e-4, atol=1e-5)
     # and the stitching is NOT trivial: g_snap differs from g_cur
     assert float(np.abs(np.asarray(vr - g_cur[name0])).max()) > 1e-6
+
+
+def test_text_vocabulary_and_embedding(tmp_path):
+    """contrib.text (ref: python/mxnet/contrib/text/ vocab + embedding)."""
+    import numpy as np
+    from incubator_mxnet_tpu.contrib import text
+
+    counter = text.count_tokens_from_str("a b b c c c\nc a", to_lower=True)
+    assert counter["c"] == 4 and counter["b"] == 2
+    vocab = text.Vocabulary(counter, min_freq=2, reserved_tokens=["<pad>"])
+    # order: <unk>, <pad>, then by freq desc: c(4), a(2), b(2) ties lexicographic
+    assert vocab.idx_to_token == ["<unk>", "<pad>", "c", "a", "b"]
+    assert vocab.to_indices(["c", "zzz"]) == [2, 0]
+    assert vocab.to_tokens([2, 0]) == ["c", "<unk>"]
+    import pytest
+    with pytest.raises(ValueError):
+        vocab.to_tokens([99])
+
+    emb_file = tmp_path / "vectors.txt"
+    emb_file.write_text("a 1.0 2.0 3.0\nc 4.0 5.0 6.0\n")
+    emb = text.TokenEmbedding(str(emb_file), vocabulary=vocab)
+    assert emb.vec_len == 3
+    table = emb.idx_to_vec.asnumpy()
+    assert table.shape == (5, 3)
+    np.testing.assert_allclose(table[2], [4, 5, 6])   # c
+    np.testing.assert_allclose(table[0], 0)           # unknown -> zeros
+    vecs = emb.get_vecs_by_tokens(["a", "missing"]).asnumpy()
+    np.testing.assert_allclose(vecs[0], [1, 2, 3])
+    np.testing.assert_allclose(vecs[1], 0)
+    emb.update_token_vectors("b", np.array([[9.0, 9.0, 9.0]], np.float32))
+    np.testing.assert_allclose(emb.idx_to_vec.asnumpy()[4], 9.0)
