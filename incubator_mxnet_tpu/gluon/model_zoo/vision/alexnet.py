@@ -1,35 +1,38 @@
-"""AlexNet (ref: python/mxnet/gluon/model_zoo/vision/alexnet.py)."""
+"""AlexNet, spec-driven (Krizhevsky et al. 2012; capability parity with
+python/mxnet/gluon/model_zoo/vision/alexnet.py, expressed as a flat layer
+table like the rest of this zoo)."""
 from ...block import HybridBlock
 from ... import nn
 
 __all__ = ["AlexNet", "alexnet"]
+
+# (channels, kernel, stride, padding, pool-after?)
+_CONV_PLAN = ((64, 11, 4, 2, True),
+              (192, 5, 1, 2, True),
+              (384, 3, 1, 1, False),
+              (256, 3, 1, 1, False),
+              (256, 3, 1, 1, True))
 
 
 class AlexNet(HybridBlock):
     def __init__(self, classes=1000, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            self.features.add(nn.Conv2D(64, kernel_size=11, strides=4, padding=2,
-                                        activation="relu"))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(nn.Conv2D(192, kernel_size=5, padding=2, activation="relu"))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(nn.Conv2D(384, kernel_size=3, padding=1, activation="relu"))
-            self.features.add(nn.Conv2D(256, kernel_size=3, padding=1, activation="relu"))
-            self.features.add(nn.Conv2D(256, kernel_size=3, padding=1, activation="relu"))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(nn.Flatten())
-            self.features.add(nn.Dense(4096, activation="relu"))
-            self.features.add(nn.Dropout(0.5))
-            self.features.add(nn.Dense(4096, activation="relu"))
-            self.features.add(nn.Dropout(0.5))
+            feats = nn.HybridSequential(prefix="")
+            for ch, k, s, p, pool in _CONV_PLAN:
+                feats.add(nn.Conv2D(ch, kernel_size=k, strides=s, padding=p,
+                                    activation="relu"))
+                if pool:
+                    feats.add(nn.MaxPool2D(pool_size=3, strides=2))
+            feats.add(nn.Flatten())
+            for _ in range(2):  # the two 4096-wide dropout-regularized FCs
+                feats.add(nn.Dense(4096, activation="relu"))
+                feats.add(nn.Dropout(0.5))
+            self.features = feats
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 def alexnet(pretrained=False, ctx=None, root=None, **kwargs):

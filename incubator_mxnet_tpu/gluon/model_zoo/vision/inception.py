@@ -1,156 +1,149 @@
-"""Inception V3 (ref: python/mxnet/gluon/model_zoo/vision/inception.py)."""
+"""Inception V3 (Szegedy et al. 1512.00567; capability parity with
+python/mxnet/gluon/model_zoo/vision/inception.py).
+
+Fully declarative: every inception block is a list of branch specs in a
+tiny DSL — ("conv", ch, kernel, stride, pad), ("avgpool",), ("maxpool",),
+and ("split", stem, b1, b2) for the fanned-out 3x3 factorizations — and a
+single builder turns specs into blocks. The whole architecture is the
+`_STEM` + `_TOWERS` tables below.
+"""
 from ...block import HybridBlock
 from ... import nn
 
 __all__ = ["Inception3", "inception_v3"]
 
 
-def _make_basic_conv(**kwargs):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.Conv2D(use_bias=False, **kwargs))
-    out.add(nn.BatchNorm(epsilon=0.001))
-    out.add(nn.Activation("relu"))
-    return out
+def _cbr(channels, kernel, stride=1, pad=0):
+    """conv(no bias) -> BN(eps 1e-3) -> relu, the basic inception unit."""
+    seq = nn.HybridSequential(prefix="")
+    seq.add(nn.Conv2D(channels, kernel_size=kernel, strides=stride,
+                      padding=pad, use_bias=False))
+    seq.add(nn.BatchNorm(epsilon=0.001))
+    seq.add(nn.Activation("relu"))
+    return seq
 
 
-def _make_branch(use_pool, *conv_settings):
-    out = nn.HybridSequential(prefix="")
-    if use_pool == "avg":
-        out.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
-    elif use_pool == "max":
-        out.add(nn.MaxPool2D(pool_size=3, strides=2))
-    for setting in conv_settings:
-        kwargs = {}
-        channels, kernel_size, strides, padding = setting
-        kwargs["channels"] = channels
-        kwargs["kernel_size"] = kernel_size
-        if strides is not None:
-            kwargs["strides"] = strides
-        if padding is not None:
-            kwargs["padding"] = padding
-        out.add(_make_basic_conv(**kwargs))
-    return out
+def _build_branch(spec):
+    seq = nn.HybridSequential(prefix="")
+    for step in spec:
+        kind = step[0]
+        if kind == "conv":
+            _, ch, k, s, p = step
+            seq.add(_cbr(ch, k, s, p))
+        elif kind == "avgpool":
+            seq.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
+        elif kind == "maxpool":
+            seq.add(nn.MaxPool2D(pool_size=3, strides=2))
+        else:
+            raise ValueError(step)
+    return seq
 
 
-class _Concurrent(HybridBlock):
-    """Parallel branches concatenated on channels (gluon.contrib.Concurrent)."""
-
-    def __init__(self, axis=1, **kwargs):
-        super().__init__(**kwargs)
-        self._axis = axis
-
-    def add(self, *blocks):
-        for b in blocks:
-            self.register_child(b)
-
-    def hybrid_forward(self, F, x):
-        outs = [block(x) for block in self._children.values()]
-        return F.Concat(*outs, dim=self._axis)
-
-
-def _make_A(pool_features, prefix):
-    out = _Concurrent(prefix=prefix)
-    out.add(_make_branch(None, (64, 1, None, None)))
-    out.add(_make_branch(None, (48, 1, None, None), (64, 5, None, 2)))
-    out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1), (96, 3, None, 1)))
-    out.add(_make_branch("avg", (pool_features, 1, None, None)))
-    return out
-
-
-def _make_B(prefix):
-    out = _Concurrent(prefix=prefix)
-    out.add(_make_branch(None, (384, 3, 2, None)))
-    out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1), (96, 3, 2, None)))
-    out.add(_make_branch("max"))
-    return out
-
-
-def _make_C(channels_7x7, prefix):
-    out = _Concurrent(prefix=prefix)
-    out.add(_make_branch(None, (192, 1, None, None)))
-    out.add(_make_branch(None, (channels_7x7, 1, None, None),
-                         (channels_7x7, (1, 7), None, (0, 3)),
-                         (192, (7, 1), None, (3, 0))))
-    out.add(_make_branch(None, (channels_7x7, 1, None, None),
-                         (channels_7x7, (7, 1), None, (3, 0)),
-                         (channels_7x7, (1, 7), None, (0, 3)),
-                         (channels_7x7, (7, 1), None, (3, 0)),
-                         (192, (1, 7), None, (0, 3))))
-    out.add(_make_branch("avg", (192, 1, None, None)))
-    return out
-
-
-def _make_D(prefix):
-    out = _Concurrent(prefix=prefix)
-    out.add(_make_branch(None, (192, 1, None, None), (320, 3, 2, None)))
-    out.add(_make_branch(None, (192, 1, None, None), (192, (1, 7), None, (0, 3)),
-                         (192, (7, 1), None, (3, 0)), (192, 3, 2, None)))
-    out.add(_make_branch("max"))
-    return out
-
-
-class _SplitConcat(HybridBlock):
-    """Branch that itself fans out into two convs concatenated."""
+class _Fanout(HybridBlock):
+    """('split', stem, b1, b2): stem -> concat(b1(stem), b2(stem))."""
 
     def __init__(self, stem, b1, b2, **kwargs):
         super().__init__(**kwargs)
-        self.stem = stem
-        self.b1 = b1
-        self.b2 = b2
+        self.stem = _build_branch(stem)
+        self.b1 = _build_branch(b1)
+        self.b2 = _build_branch(b2)
 
     def hybrid_forward(self, F, x):
-        x = self.stem(x) if self.stem is not None else x
-        return F.Concat(self.b1(x), self.b2(x), dim=1)
+        h = self.stem(x)
+        return F.Concat(self.b1(h), self.b2(h), dim=1)
 
 
-def _make_E(prefix):
-    out = _Concurrent(prefix=prefix)
-    out.add(_make_branch(None, (320, 1, None, None)))
-    out.add(_SplitConcat(
-        _make_branch(None, (384, 1, None, None)),
-        _make_branch(None, (384, (1, 3), None, (0, 1))),
-        _make_branch(None, (384, (3, 1), None, (1, 0))),
-    ))
-    out.add(_SplitConcat(
-        _make_branch(None, (448, 1, None, None), (384, 3, None, 1)),
-        _make_branch(None, (384, (1, 3), None, (0, 1))),
-        _make_branch(None, (384, (3, 1), None, (1, 0))),
-    ))
-    out.add(_make_branch("avg", (192, 1, None, None)))
-    return out
+class _Tower(HybridBlock):
+    """Parallel branches concatenated on channels."""
+
+    def __init__(self, branch_specs, **kwargs):
+        super().__init__(**kwargs)
+        for spec in branch_specs:
+            if spec and spec[0][0] == "split":
+                self.register_child(_Fanout(*spec[0][1:]))
+            else:
+                self.register_child(_build_branch(spec))
+
+    def hybrid_forward(self, F, x):
+        return F.Concat(*[b(x) for b in self._children.values()], dim=1)
+
+
+def _conv(ch, k, s=1, p=0):
+    return ("conv", ch, k, s, p)
+
+
+def _block_a(pool_ch):
+    return [
+        [_conv(64, 1)],
+        [_conv(48, 1), _conv(64, 5, 1, 2)],
+        [_conv(64, 1), _conv(96, 3, 1, 1), _conv(96, 3, 1, 1)],
+        [("avgpool",), _conv(pool_ch, 1)],
+    ]
+
+
+def _block_c(c7):
+    return [
+        [_conv(192, 1)],
+        [_conv(c7, 1), _conv(c7, (1, 7), 1, (0, 3)),
+         _conv(192, (7, 1), 1, (3, 0))],
+        [_conv(c7, 1), _conv(c7, (7, 1), 1, (3, 0)),
+         _conv(c7, (1, 7), 1, (0, 3)), _conv(c7, (7, 1), 1, (3, 0)),
+         _conv(192, (1, 7), 1, (0, 3))],
+        [("avgpool",), _conv(192, 1)],
+    ]
+
+
+def _block_e():
+    split1 = ("split", [_conv(384, 1)],
+              [_conv(384, (1, 3), 1, (0, 1))], [_conv(384, (3, 1), 1, (1, 0))])
+    split2 = ("split", [_conv(448, 1), _conv(384, 3, 1, 1)],
+              [_conv(384, (1, 3), 1, (0, 1))], [_conv(384, (3, 1), 1, (1, 0))])
+    return [
+        [_conv(320, 1)],
+        [split1],
+        [split2],
+        [("avgpool",), _conv(192, 1)],
+    ]
+
+
+_REDUCE_B = [
+    [_conv(384, 3, 2)],
+    [_conv(64, 1), _conv(96, 3, 1, 1), _conv(96, 3, 2)],
+    [("maxpool",)],
+]
+
+_REDUCE_D = [
+    [_conv(192, 1), _conv(320, 3, 2)],
+    [_conv(192, 1), _conv(192, (1, 7), 1, (0, 3)),
+     _conv(192, (7, 1), 1, (3, 0)), _conv(192, 3, 2)],
+    [("maxpool",)],
+]
 
 
 class Inception3(HybridBlock):
     def __init__(self, classes=1000, **kwargs):
         super().__init__(**kwargs)
+        towers = ([_block_a(32), _block_a(64), _block_a(64), _REDUCE_B]
+                  + [_block_c(c) for c in (128, 160, 160, 192)]
+                  + [_REDUCE_D, _block_e(), _block_e()])
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            self.features.add(_make_basic_conv(channels=32, kernel_size=3, strides=2))
-            self.features.add(_make_basic_conv(channels=32, kernel_size=3))
-            self.features.add(_make_basic_conv(channels=64, kernel_size=3, padding=1))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_basic_conv(channels=80, kernel_size=1))
-            self.features.add(_make_basic_conv(channels=192, kernel_size=3))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_A(32, "A1_"))
-            self.features.add(_make_A(64, "A2_"))
-            self.features.add(_make_A(64, "A3_"))
-            self.features.add(_make_B("B_"))
-            self.features.add(_make_C(128, "C1_"))
-            self.features.add(_make_C(160, "C2_"))
-            self.features.add(_make_C(160, "C3_"))
-            self.features.add(_make_C(192, "C4_"))
-            self.features.add(_make_D("D_"))
-            self.features.add(_make_E("E1_"))
-            self.features.add(_make_E("E2_"))
-            self.features.add(nn.AvgPool2D(pool_size=8))
-            self.features.add(nn.Dropout(0.5))
+            feats = nn.HybridSequential(prefix="")
+            feats.add(_cbr(32, 3, 2))
+            feats.add(_cbr(32, 3))
+            feats.add(_cbr(64, 3, 1, 1))
+            feats.add(nn.MaxPool2D(pool_size=3, strides=2))
+            feats.add(_cbr(80, 1))
+            feats.add(_cbr(192, 3))
+            feats.add(nn.MaxPool2D(pool_size=3, strides=2))
+            for i, specs in enumerate(towers):
+                feats.add(_Tower(specs, prefix=f"tower{i}_"))
+            feats.add(nn.AvgPool2D(pool_size=8))
+            feats.add(nn.Dropout(0.5))
+            self.features = feats
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):

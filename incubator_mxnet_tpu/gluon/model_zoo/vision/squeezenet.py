@@ -1,89 +1,78 @@
-"""SqueezeNet 1.0/1.1 (ref: python/mxnet/gluon/model_zoo/vision/squeezenet.py)."""
+"""SqueezeNet 1.0/1.1 (Iandola et al. 1602.07360; capability parity with
+python/mxnet/gluon/model_zoo/vision/squeezenet.py).
+
+Spec-driven: each version is a flat plan mixing fire-module squeeze widths
+and pool markers; the fire module itself is one block (squeeze 1x1 ->
+parallel 1x1/3x3 expands, concatenated).
+"""
 from ...block import HybridBlock
 from ... import nn
-from ....ndarray import register as _r
 
 __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
 
-
-def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels):
-    out = nn.HybridSequential(prefix="")
-    out.add(_make_fire_conv(squeeze_channels, 1))
-    out.add(_FireExpand(expand1x1_channels, expand3x3_channels))
-    return out
-
-
-def _make_fire_conv(channels, kernel_size, padding=0):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.Conv2D(channels, kernel_size, padding=padding))
-    out.add(nn.Activation("relu"))
-    return out
+# plans: "P" = 3x3/2 ceil maxpool; int s = fire module with squeeze width s
+# (expands are always 4s + 4s, per the paper's table)
+_PLANS = {
+    "1.0": (96, 7, 2, ["P", 16, 16, 32, "P", 32, 48, 48, 64, "P", 64]),
+    "1.1": (64, 3, 2, ["P", 16, 16, "P", 32, 32, "P", 48, 48, 64, 64]),
+}
 
 
-class _FireExpand(HybridBlock):
-    def __init__(self, e1, e3, **kwargs):
+class Fire(HybridBlock):
+    """squeeze 1x1 -> concat(expand 1x1, expand 3x3), all relu."""
+
+    def __init__(self, squeeze, **kwargs):
         super().__init__(**kwargs)
-        self.conv1 = nn.Conv2D(e1, 1)
-        self.conv3 = nn.Conv2D(e3, 3, padding=1)
+        expand = 4 * squeeze
+        with self.name_scope():
+            self.squeeze = nn.Conv2D(squeeze, 1, activation="relu")
+            self.left = nn.Conv2D(expand, 1)
+            self.right = nn.Conv2D(expand, 3, padding=1)
 
     def hybrid_forward(self, F, x):
-        a = F.Activation(self.conv1(x), act_type="relu")
-        b = F.Activation(self.conv3(x), act_type="relu")
-        return F.Concat(a, b, dim=1)
+        s = self.squeeze(x)
+        return F.Concat(F.Activation(self.left(s), act_type="relu"),
+                        F.Activation(self.right(s), act_type="relu"), dim=1)
 
 
 class SqueezeNet(HybridBlock):
     def __init__(self, version, classes=1000, **kwargs):
         super().__init__(**kwargs)
-        assert version in ("1.0", "1.1")
+        if version not in _PLANS:
+            raise ValueError(f"version must be one of {sorted(_PLANS)}")
+        stem_ch, stem_k, stem_s, plan = _PLANS[version]
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            if version == "1.0":
-                self.features.add(nn.Conv2D(96, kernel_size=7, strides=2, activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2, ceil_mode=True))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2, ceil_mode=True))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(64, 256, 256))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2, ceil_mode=True))
-                self.features.add(_make_fire(64, 256, 256))
-            else:
-                self.features.add(nn.Conv2D(64, kernel_size=3, strides=2, activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2, ceil_mode=True))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2, ceil_mode=True))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2, ceil_mode=True))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(64, 256, 256))
-                self.features.add(_make_fire(64, 256, 256))
-            self.features.add(nn.Dropout(0.5))
-            self.output = nn.HybridSequential(prefix="")
-            self.output.add(nn.Conv2D(classes, kernel_size=1))
-            self.output.add(nn.Activation("relu"))
-            self.output.add(nn.GlobalAvgPool2D())
-            self.output.add(nn.Flatten())
+            feats = nn.HybridSequential(prefix="")
+            feats.add(nn.Conv2D(stem_ch, kernel_size=stem_k, strides=stem_s,
+                                activation="relu"))
+            for item in plan:
+                if item == "P":
+                    feats.add(nn.MaxPool2D(pool_size=3, strides=2,
+                                           ceil_mode=True))
+                else:
+                    feats.add(Fire(item))
+            feats.add(nn.Dropout(0.5))
+            self.features = feats
+            head = nn.HybridSequential(prefix="")
+            head.add(nn.Conv2D(classes, kernel_size=1))
+            head.add(nn.Activation("relu"))
+            head.add(nn.GlobalAvgPool2D())
+            head.add(nn.Flatten())
+            self.output = head
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
-def squeezenet1_0(pretrained=False, **kwargs):
+def _get(version, pretrained=False, **kwargs):
     if pretrained:
         raise RuntimeError("no network egress: load weights via load_parameters")
-    return SqueezeNet("1.0", **kwargs)
+    return SqueezeNet(version, **kwargs)
 
 
-def squeezenet1_1(pretrained=False, **kwargs):
-    if pretrained:
-        raise RuntimeError("no network egress: load weights via load_parameters")
-    return SqueezeNet("1.1", **kwargs)
+def squeezenet1_0(**kwargs):
+    return _get("1.0", **kwargs)
+
+
+def squeezenet1_1(**kwargs):
+    return _get("1.1", **kwargs)

@@ -1,81 +1,62 @@
-"""VGG 11/13/16/19 ±BN (ref: python/mxnet/gluon/model_zoo/vision/vgg.py)."""
+"""VGG 11/13/16/19 with optional BatchNorm (Simonyan & Zisserman 1409.1556;
+capability parity with python/mxnet/gluon/model_zoo/vision/vgg.py).
+
+Spec-driven like the rest of this zoo: each depth is a tuple of per-stage
+conv repeat counts over the fixed 64->512 channel ladder; the `_bn`
+variants are generated from the same table.
+"""
+from functools import partial
+
 from ...block import HybridBlock
 from ... import nn
 
-__all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19", "vgg11_bn", "vgg13_bn",
-           "vgg16_bn", "vgg19_bn", "get_vgg"]
+__all__ = ["VGG", "vgg_spec", "get_vgg", "vgg11", "vgg13", "vgg16", "vgg19",
+           "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn"]
 
-vgg_spec = {
-    11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
-    13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
-    16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
-    19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512]),
-}
+_CHANNELS = (64, 128, 256, 512, 512)
+# depth -> conv repeats per stage (stages always end in a stride-2 maxpool)
+vgg_spec = {11: (1, 1, 2, 2, 2),
+            13: (2, 2, 2, 2, 2),
+            16: (2, 2, 3, 3, 3),
+            19: (2, 2, 4, 4, 4)}
 
 
 class VGG(HybridBlock):
-    def __init__(self, layers, filters, classes=1000, batch_norm=False, **kwargs):
+    def __init__(self, layers, filters=_CHANNELS, classes=1000,
+                 batch_norm=False, **kwargs):
         super().__init__(**kwargs)
-        assert len(layers) == len(filters)
+        if len(layers) != len(filters):
+            raise ValueError("per-stage repeats and channels must align")
         with self.name_scope():
-            self.features = self._make_features(layers, filters, batch_norm)
-            self.features.add(nn.Dense(4096, activation="relu"))
-            self.features.add(nn.Dropout(rate=0.5))
-            self.features.add(nn.Dense(4096, activation="relu"))
-            self.features.add(nn.Dropout(rate=0.5))
+            feats = nn.HybridSequential(prefix="")
+            for repeats, ch in zip(layers, filters):
+                for _ in range(repeats):
+                    feats.add(nn.Conv2D(ch, kernel_size=3, padding=1))
+                    if batch_norm:
+                        feats.add(nn.BatchNorm())
+                    feats.add(nn.Activation("relu"))
+                feats.add(nn.MaxPool2D(strides=2))
+            for _ in range(2):
+                feats.add(nn.Dense(4096, activation="relu"))
+                feats.add(nn.Dropout(rate=0.5))
+            self.features = feats
             self.output = nn.Dense(classes)
 
-    def _make_features(self, layers, filters, batch_norm):
-        featurizer = nn.HybridSequential(prefix="")
-        for i, num in enumerate(layers):
-            for _ in range(num):
-                featurizer.add(nn.Conv2D(filters[i], kernel_size=3, padding=1))
-                if batch_norm:
-                    featurizer.add(nn.BatchNorm())
-                featurizer.add(nn.Activation("relu"))
-            featurizer.add(nn.MaxPool2D(strides=2))
-        return featurizer
-
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     if pretrained:
         raise RuntimeError("no network egress: load weights via load_parameters")
-    layers, filters = vgg_spec[num_layers]
-    return VGG(layers, filters, **kwargs)
+    return VGG(vgg_spec[num_layers], **kwargs)
 
 
-def vgg11(**kwargs):
-    return get_vgg(11, **kwargs)
-
-
-def vgg13(**kwargs):
-    return get_vgg(13, **kwargs)
-
-
-def vgg16(**kwargs):
-    return get_vgg(16, **kwargs)
-
-
-def vgg19(**kwargs):
-    return get_vgg(19, **kwargs)
-
-
-def vgg11_bn(**kwargs):
-    return get_vgg(11, batch_norm=True, **kwargs)
-
-
-def vgg13_bn(**kwargs):
-    return get_vgg(13, batch_norm=True, **kwargs)
-
-
-def vgg16_bn(**kwargs):
-    return get_vgg(16, batch_norm=True, **kwargs)
-
-
-def vgg19_bn(**kwargs):
-    return get_vgg(19, batch_norm=True, **kwargs)
+for _d in vgg_spec:
+    for _bn in (False, True):
+        _name = f"vgg{_d}_bn" if _bn else f"vgg{_d}"
+        _fn = (partial(get_vgg, _d, batch_norm=True) if _bn
+               else partial(get_vgg, _d))
+        _fn.__name__ = _name
+        _fn.__doc__ = f"VGG-{_d}{' with BatchNorm' if _bn else ''}."
+        globals()[_name] = _fn
