@@ -7,3 +7,4 @@ from . import onnx  # noqa: F401
 from . import torch_bridge  # noqa: F401
 from . import svrg  # noqa: F401
 from . import text  # noqa: F401
+from . import sharded_checkpoint  # noqa: F401

@@ -1,0 +1,152 @@
+"""Sharded distributed checkpointing over orbax
+(beyond the reference: SURVEY §5.4 — the reference replicates params and
+rank 0 writes the whole file; sharded/distributed checkpointing does NOT
+exist there. On TPU pods, per-host sharded saves are the difference between
+checkpointing in seconds and serializing the full model through one host).
+
+Saves/restores a pytree of (possibly GSPMD-sharded) jax.Arrays or
+NDArrays: every host writes only the shards it owns; restore reassembles
+onto any mesh whose shardings are supplied. Works transparently for
+single-host too.
+
+Typical use with a Gluon net::
+
+    from incubator_mxnet_tpu.contrib import sharded_checkpoint as sc
+    tree = {n: p.data() for n, p in net.collect_params().items()}
+    sc.save(path, tree)
+    restored = sc.restore(path, like=tree)   # NDArrays back, shardings kept
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["save", "restore", "latest_step", "CheckpointManager"]
+
+
+def _is_nd(v):
+    return isinstance(v, NDArray)
+
+
+def _to_jax_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda v: v._data if _is_nd(v) else v, tree, is_leaf=_is_nd)
+
+
+def _restore_args(like_jax_tree):
+    import orbax.checkpoint as ocp
+
+    return jax.tree_util.tree_map(
+        lambda a: ocp.ArrayRestoreArgs(sharding=getattr(a, "sharding", None)),
+        like_jax_tree)
+
+
+def _rewrap_like(restored, like):
+    """Mirror `like`'s NDArray-ness onto the restored jax leaves."""
+    return jax.tree_util.tree_map(
+        lambda template, value: NDArray._from_data(value)
+        if _is_nd(template) else value,
+        like, restored, is_leaf=_is_nd)
+
+
+def save(path, tree, force=False):
+    """Write a (sharded) pytree checkpoint; every host writes its shards.
+    Refuses to overwrite an existing checkpoint unless force=True (orbax's
+    safe default — a failed re-save must not destroy the previous good
+    checkpoint silently)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
+        ckptr.save(path, _to_jax_tree(tree), force=force)
+    return path
+
+
+def restore(path, like=None, shardings=None):
+    """Restore a pytree checkpoint.
+
+    `like`: a pytree of arrays/NDArrays giving the target structure, the
+    destination shardings, and which leaves come back as NDArrays; shards
+    land directly on their devices without materializing the global array
+    on one host. `shardings`: alternatively, a matching pytree of
+    jax.sharding.Sharding (returns raw jax arrays).
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
+        if like is not None:
+            out = ckptr.restore(path, args=ocp.args.PyTreeRestore(
+                restore_args=_restore_args(_to_jax_tree(like))))
+            return _rewrap_like(out, like)
+        if shardings is not None:
+            restore_args = jax.tree_util.tree_map(
+                lambda s: ocp.ArrayRestoreArgs(sharding=s), shardings)
+            return ckptr.restore(
+                path, args=ocp.args.PyTreeRestore(restore_args=restore_args))
+        return ckptr.restore(path)
+
+
+def latest_step(directory):
+    """Newest step saved by a CheckpointManager under `directory`; raises
+    FileNotFoundError for a missing directory (a typo'd resume path must
+    not silently restart training from scratch)."""
+    import orbax.checkpoint as ocp
+
+    directory = os.path.abspath(directory)
+    if not os.path.isdir(directory):
+        raise FileNotFoundError(f"no checkpoint directory {directory}")
+    mgr = ocp.CheckpointManager(
+        directory, options=ocp.CheckpointManagerOptions(create=False))
+    try:
+        return mgr.latest_step()
+    finally:
+        mgr.close()
+
+
+class CheckpointManager:
+    """Step-indexed manager with retention (keeps the reference's
+    do_checkpoint(period) UX, adds max_to_keep garbage collection and
+    sharded writes)."""
+
+    def __init__(self, directory, max_to_keep=3):
+        import orbax.checkpoint as ocp
+
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(directory),
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
+
+    def save(self, step, tree):
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(step, args=ocp.args.PyTreeSave(_to_jax_tree(tree)))
+        return step
+
+    def restore(self, step=None, like=None):
+        import orbax.checkpoint as ocp
+
+        if step is None:
+            step = self._mgr.latest_step()
+        if like is None:
+            return self._mgr.restore(step)
+        out = self._mgr.restore(step, args=ocp.args.PyTreeRestore(
+            restore_args=_restore_args(_to_jax_tree(like))))
+        return _rewrap_like(out, like)
+
+    def latest_step(self):
+        return self._mgr.latest_step()
+
+    def wait_until_finished(self):
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
