@@ -37,6 +37,7 @@ def child_main():
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
     iters = int(os.environ.get("BENCH_ITERS", "30"))
     dtype = os.environ.get("BENCH_DTYPE", "float32")
+    layout = os.environ.get("BENCH_LAYOUT", "NCHW")  # NHWC = TPU-native
 
     mx.random.seed(0)
     devices = jax.devices()
@@ -49,7 +50,7 @@ def child_main():
     # build + initialize on host CPU: avoids hundreds of tiny per-param
     # device programs; one bulk transfer moves weights to the chip
     with jax.default_device(cpu0):
-        net = vision.resnet50_v1(classes=1000)
+        net = vision.resnet50_v1(classes=1000, layout=layout)
         net.initialize(mx.init.Xavier())
         if dtype == "bfloat16":
             net.cast("bfloat16")
@@ -65,6 +66,8 @@ def child_main():
     import ml_dtypes
 
     xd = rng.rand(batch_size, 3, image_size, image_size).astype(np.float32)
+    if layout == "NHWC":
+        xd = np.ascontiguousarray(xd.transpose(0, 2, 3, 1))
     if dtype == "bfloat16":
         xd = xd.astype(ml_dtypes.bfloat16)
     x = nd.array(jax.device_put(jnp.asarray(xd), target))
@@ -93,6 +96,7 @@ def child_main():
     ips = batch_size * iters / elapsed
     print(json.dumps({
         "ips": round(ips, 2),
+        "layout": layout,
         "dtype": dtype,
         "platform": target.platform,
         "compile_s": round(compile_s, 1),

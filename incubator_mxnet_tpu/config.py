@@ -119,9 +119,6 @@ register_knob("MXNET_PROFILER_AUTOSTART", False, bool,
 # numerics / reproducibility
 register_knob("MXTPU_DEFAULT_DTYPE", "float32", str,
               "Default dtype for new NDArrays.")
-register_knob("MXTPU_DETERMINISTIC", False, bool,
-              "Force deterministic XLA reductions where available "
-              "(ref: MXNET_ENFORCE_DETERMINISM env_var.md:245).")
 
 
 # Reference knobs whose role is subsumed by the XLA/JAX substrate: the

@@ -59,24 +59,26 @@ class ResidualUnit(HybridBlock):
     """
 
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 version=1, bottleneck=False, **kwargs):
+                 version=1, bottleneck=False, layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         self._version = version
+        bn_axis = -1 if layout == "NHWC" else 1
         plan = _conv_plan(channels, stride, bottleneck, version)
         # v1: norms[i] FOLLOWS convs[i]; v2: norms[i] PRECEDES convs[i]
         self.convs = nn.HybridSequential(prefix="")
         self.norms = nn.HybridSequential(prefix="")
         for c, k, s, p, bias in plan:
             self.convs.add(nn.Conv2D(c, kernel_size=k, strides=s, padding=p,
-                                     use_bias=bias))
-            self.norms.add(nn.BatchNorm())
+                                     use_bias=bias, layout=layout))
+            self.norms.add(nn.BatchNorm(axis=bn_axis))
         if not downsample:
             self.proj = None
             self.proj_norm = None
         else:
             self.proj = nn.Conv2D(channels, kernel_size=1, strides=stride,
-                                  use_bias=False, in_channels=in_channels)
-            self.proj_norm = nn.BatchNorm() if version == 1 else None
+                                  use_bias=False, in_channels=in_channels,
+                                  layout=layout)
+            self.proj_norm = nn.BatchNorm(axis=bn_axis) if version == 1 else None
 
     def hybrid_forward(self, F, x):
         convs = [self.convs[i] for i in range(len(self.convs))]
@@ -109,21 +111,25 @@ class ResNet(HybridBlock):
     """
 
     def __init__(self, version, layers, channels, bottleneck, classes=1000,
-                 thumbnail=False, **kwargs):
+                 thumbnail=False, layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         assert version in (1, 2)
+        assert layout in ("NCHW", "NHWC")
+        bn_axis = -1 if layout == "NHWC" else 1
         with self.name_scope():
             feats = nn.HybridSequential(prefix="")
             if version == 2:
-                feats.add(nn.BatchNorm(scale=False, center=False))
+                feats.add(nn.BatchNorm(scale=False, center=False,
+                                       axis=bn_axis))
             if thumbnail:
                 feats.add(nn.Conv2D(channels[0], kernel_size=3, strides=1,
-                                    padding=1, use_bias=False))
+                                    padding=1, use_bias=False, layout=layout))
             else:
-                feats.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
-                feats.add(nn.BatchNorm())
+                feats.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False,
+                                    layout=layout))
+                feats.add(nn.BatchNorm(axis=bn_axis))
                 feats.add(nn.Activation("relu"))
-                feats.add(nn.MaxPool2D(3, 2, 1))
+                feats.add(nn.MaxPool2D(3, 2, 1, layout=layout))
             in_c = channels[0]
             for i, n_units in enumerate(layers):
                 stage = nn.HybridSequential(prefix=f"stage{i + 1}_")
@@ -134,13 +140,13 @@ class ResNet(HybridBlock):
                             channels[i + 1], stride,
                             downsample=(j == 0 and channels[i + 1] != in_c),
                             in_channels=in_c, version=version,
-                            bottleneck=bottleneck, prefix=""))
+                            bottleneck=bottleneck, layout=layout, prefix=""))
                         in_c = channels[i + 1]
                 feats.add(stage)
             if version == 2:
-                feats.add(nn.BatchNorm())
+                feats.add(nn.BatchNorm(axis=bn_axis))
                 feats.add(nn.Activation("relu"))
-            feats.add(nn.GlobalAvgPool2D())
+            feats.add(nn.GlobalAvgPool2D(layout=layout))
             feats.add(nn.Flatten())
             self.features = feats
             self.output = nn.Dense(classes, in_units=channels[-1])

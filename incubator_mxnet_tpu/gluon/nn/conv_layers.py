@@ -34,6 +34,12 @@ class _Conv(HybridBlock):
         self._ndim = ndim
         self._transpose = transpose
         self._output_padding = _tup(output_padding, ndim)
+        self._layout = layout
+        self._channels_last = bool(layout) and layout.endswith("C")
+        if self._channels_last and transpose:
+            raise ValueError(
+                "channels-last layouts are not supported for transposed "
+                "convolutions yet; use the default NC* layout")
         with self.name_scope():
             if transpose:
                 wshape = (in_channels, channels // groups) + self._kernel
@@ -49,7 +55,7 @@ class _Conv(HybridBlock):
 
     def _pre_forward(self, x, *args):
         if not self.weight._shape_known():
-            in_c = x.shape[1]
+            in_c = x.shape[-1] if self._channels_last else x.shape[1]
             if self._transpose:
                 self.weight.shape = (in_c, self._channels // self._groups) + self._kernel
             else:
@@ -67,6 +73,7 @@ class _Conv(HybridBlock):
                 x, weight, bias, kernel=self._kernel, stride=self._strides,
                 dilate=self._dilation, pad=self._padding, num_filter=self._channels,
                 num_group=self._groups, no_bias=bias is None,
+                layout=self._layout if self._channels_last else None,
             )
         if self._act_type:
             out = F.Activation(out, act_type=self._act_type)
@@ -149,6 +156,8 @@ class _Pooling(HybridBlock):
             "pooling_convention": "full" if ceil_mode else "valid",
             "count_include_pad": count_include_pad,
         }
+        if layout and layout.endswith("C"):
+            self._kwargs["layout"] = layout
 
     def hybrid_forward(self, F, x):
         return F.Pooling(x, **self._kwargs)

@@ -182,7 +182,7 @@ class ImageRecordIter(_PrefetchMixin, DataIter):
 
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
                  path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
-                 preprocess_threads=4, prefetch_buffer=2,
+                 preprocess_threads=None, prefetch_buffer=None,
                  rand_crop=False, rand_mirror=False, resize=0,
                  mean_img=None, mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0,
@@ -193,7 +193,12 @@ class ImageRecordIter(_PrefetchMixin, DataIter):
                  round_batch=True, seed=0, dtype="float32", **kwargs):
         super().__init__(batch_size)
         from . import image as _image
+        from . import config as _config
 
+        if preprocess_threads is None:
+            preprocess_threads = _config.get("MXTPU_DECODE_THREADS")
+        if prefetch_buffer is None:
+            prefetch_buffer = _config.get("MXTPU_PREFETCH_BUFFER")
         self.data_shape = tuple(int(x) for x in data_shape)
         self.label_width = int(label_width)
         self.data_name, self.label_name = data_name, label_name

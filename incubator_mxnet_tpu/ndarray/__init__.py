@@ -32,23 +32,34 @@ def _place(data, ctx):
     return NDArray(data, ctx=ctx)
 
 
-def zeros(shape, ctx=None, dtype="float32", **kwargs):
+def _default_dtype():
+    from .. import config as _config
+
+    return _config.get("MXTPU_DEFAULT_DTYPE")
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs):
+    dtype = dtype or _default_dtype()
     return _place(jnp.zeros(shape, dtype_np(dtype)), ctx)
 
 
-def ones(shape, ctx=None, dtype="float32", **kwargs):
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    dtype = dtype or _default_dtype()
     return _place(jnp.ones(shape, dtype_np(dtype)), ctx)
 
 
-def full(shape, val, ctx=None, dtype="float32", **kwargs):
+def full(shape, val, ctx=None, dtype=None, **kwargs):
+    dtype = dtype or _default_dtype()
     return _place(jnp.full(shape, val, dtype_np(dtype)), ctx)
 
 
-def empty(shape, ctx=None, dtype="float32"):
+def empty(shape, ctx=None, dtype=None):
+    dtype = dtype or _default_dtype()
     return zeros(shape, ctx=ctx, dtype=dtype)
 
 
-def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    dtype = dtype or _default_dtype()
     out = jnp.arange(start, stop, step, dtype=dtype_np(dtype))
     if repeat > 1:
         out = jnp.repeat(out, repeat)

@@ -84,17 +84,29 @@ def convolution(
     padding = [(pi, pi) for pi in p]
     if data.dtype != weight.dtype:  # mixed precision: MXU wants matching operand dtypes
         data = data.astype(weight.dtype)
+    sp = "DHW"[3 - nd:]
+    channels_last = layout is not None and layout == f"N{sp}C"
+    if channels_last:
+        # TPU-native layout: convolution consumes/produces channels-last and
+        # HWIO weights directly — no transposes reach XLA. The weight is
+        # still stored OI{sp} (the reference's layout) and re-laid out here;
+        # XLA folds the transpose into the weight's layout assignment.
+        dn = (f"N{sp}C", f"OI{sp}", f"N{sp}C")
+    else:
+        dn = _conv_dn(nd)
     out = lax.conv_general_dilated(
         data,
         weight,
         window_strides=strides,
         padding=padding,
         rhs_dilation=dil,
-        dimension_numbers=_conv_dn(nd),
+        dimension_numbers=dn,
         feature_group_count=num_group,
     )
     if bias is not None and not no_bias:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        bshape = (1,) + (1,) * nd + (-1,) if channels_last \
+            else (1, -1) + (1,) * nd
+        out = out + bias.reshape(bshape)
     return out
 
 
@@ -119,6 +131,9 @@ def deconvolution(
     layout=None,
 ):
     """Transposed convolution (ref: src/operator/nn/deconvolution.cc).
+
+    Only NC{sp} layouts are supported (channels-last deconvolution raises —
+    better loud than silently convolving the wrong axes).
 
     Weight layout (in_c, out_c/group, *k) as in the reference; implemented as
     the gradient of convolution via input dilation.
@@ -176,33 +191,44 @@ def pooling(
     cudnn_off=False,
     layout=None,
 ):
-    """Max/avg/sum/lp pooling via XLA reduce_window (ref: nn/pooling.cc, nn/pool.h)."""
+    """Max/avg/sum/lp pooling via XLA reduce_window (ref: nn/pooling.cc, nn/pool.h).
+
+    `layout='N{sp}C'` pools channels-last without transposes (TPU-native)."""
     nd = data.ndim - 2
+    sp = "DHW"[3 - nd:]
+    channels_last = layout is not None and layout == f"N{sp}C"
+    spatial = tuple(range(1, 1 + nd)) if channels_last \
+        else tuple(range(2, 2 + nd))
     if global_pool:
-        axes = tuple(range(2, data.ndim))
         if pool_type == "max":
-            out = jnp.max(data, axis=axes, keepdims=True)
+            out = jnp.max(data, axis=spatial, keepdims=True)
         elif pool_type == "sum":
-            out = jnp.sum(data, axis=axes, keepdims=True)
+            out = jnp.sum(data, axis=spatial, keepdims=True)
         else:
-            out = jnp.mean(data, axis=axes, keepdims=True)
+            out = jnp.mean(data, axis=spatial, keepdims=True)
         return out
     k = _tup(kernel, nd)
     s = _tup(stride, nd) if stride is not None else k if pooling_convention == "valid" else _tup(1, nd)
     if stride is None:
         s = k
+
+    def _dims(vals, one=1):
+        t = tuple(vals)
+        return (one,) + t + (one,) if channels_last else (one, one) + t
+
     p = _tup(pad, nd) if pad is not None else (0,) * nd
-    window = (1, 1) + k
-    strides = (1, 1) + s
-    padding = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+    window = _dims(k)
+    strides = _dims(s)
+    pads = [(pi, pi) for pi in p]
     if pooling_convention == "full":
         # ceil-mode: pad high side enough that ceil-division windows fit
-        extra = []
         for i in range(nd):
-            in_sz = data.shape[2 + i] + 2 * p[i]
+            in_sz = data.shape[spatial[i]] + 2 * p[i]
             rem = (in_sz - k[i]) % s[i]
-            extra.append((s[i] - rem) % s[i] if rem != 0 else 0)
-        padding = ((0, 0), (0, 0)) + tuple((p[i], p[i] + extra[i]) for i in range(nd))
+            extra = (s[i] - rem) % s[i] if rem != 0 else 0
+            pads[i] = (p[i], p[i] + extra)
+    padding = ((0, 0),) + tuple(pads) + ((0, 0),) if channels_last \
+        else ((0, 0), (0, 0)) + tuple(pads)
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
         return lax.reduce_window(data, init, lax.max, window, strides, padding)

@@ -234,3 +234,54 @@ def test_split_and_load():
     assert len(parts) == 4 and parts[0].shape == (2, 3)
     norm = gluon.utils.clip_global_norm([nd.ones((2,)) * 3, nd.ones((2,)) * 4], 1.0)
     assert abs(norm - np.sqrt(9 * 2 + 16 * 2)) < 1e-4
+
+
+def test_nhwc_layout_matches_nchw():
+    """Channels-last conv/pool/BN path (TPU-native layout) computes the same
+    function as the default NCHW path."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.gluon import nn
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 16, 16).astype("float32")
+
+    def build(layout):
+        mx.random.seed(7)
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(8, 3, padding=1, activation="relu", layout=layout))
+        net.add(nn.MaxPool2D(2, layout=layout))
+        net.add(nn.Conv2D(4, 3, padding=1, layout=layout))
+        net.add(nn.BatchNorm(axis=-1 if layout == "NHWC" else 1))
+        net.add(nn.GlobalAvgPool2D(layout=layout))
+        net.add(nn.Flatten())
+        net.initialize(mx.init.Xavier())
+        return net
+
+    out_c = build("NCHW")(nd.array(x)).asnumpy()
+    out_l = build("NHWC")(nd.array(x.transpose(0, 2, 3, 1))).asnumpy()
+    np.testing.assert_allclose(out_c, out_l, rtol=1e-5, atol=1e-6)
+
+
+def test_nhwc_resnet_trains():
+    """A training step through the NHWC ResNet (grads + BN aux updates flow
+    through the channels-last path)."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import fused, gluon, nd
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(0)
+    net = vision.get_resnet(1, 18, classes=10, thumbnail=True, layout="NHWC")
+    net.initialize(mx.init.Xavier())
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    opt = mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0 / 4)
+    step = fused.GluonTrainStep(net, lambda n, x, y: L(n(x), y), opt)
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(4, 32, 32, 3).astype("float32"))
+    y = nd.array(rng.randint(0, 10, 4).astype("float32"))
+    l0 = float(step(x, y).asscalar())
+    for _ in range(3):
+        loss = step(x, y)
+    assert float(loss.asscalar()) < l0
