@@ -1,43 +1,57 @@
-"""Gluon datasets (ref: python/mxnet/gluon/data/dataset.py)."""
+"""Datasets (capability parity with python/mxnet/gluon/data/dataset.py:
+indexable sources plus filter/take/transform combinators and the RecordIO
+file dataset).
+
+One mapping wrapper (`_Mapped`) backs both transform flavors; eager
+materialization is `list(...)` over the lazy form.
+"""
 from __future__ import annotations
 
 import os
 
 from ...ndarray.ndarray import NDArray
-from ...ndarray import array as nd_array
 
 __all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset"]
 
 
 class Dataset:
+    """Random-access sample source: __getitem__ + __len__."""
+
     def __getitem__(self, idx):
         raise NotImplementedError
 
     def __len__(self):
         raise NotImplementedError
 
+    def __iter__(self):
+        return (self[i] for i in range(len(self)))
+
+    # -- combinators -------------------------------------------------------
     def filter(self, fn):
+        """Materialized subset of samples where fn(sample) is true."""
         return SimpleDataset([s for s in self if fn(s)])
 
     def take(self, count):
-        return SimpleDataset([self[i] for i in range(min(count, len(self)))])
+        """Materialized first min(count, len) samples."""
+        return SimpleDataset(list(self)[:count])
 
     def transform(self, fn, lazy=True):
-        trans = _LazyTransformDataset(self, fn)
-        if lazy:
-            return trans
-        return SimpleDataset([trans[i] for i in range(len(trans))])
+        """Apply fn to every sample (tuple samples are splatted)."""
+        mapped = _Mapped(self, fn)
+        return mapped if lazy else SimpleDataset(list(mapped))
 
     def transform_first(self, fn, lazy=True):
-        def base_fn(x, *args):
-            if args:
-                return (fn(x),) + args
-            return fn(x)
+        """Apply fn to only the FIRST element of each tuple sample (the
+        standard augment-data-not-label pattern)."""
+        def first_only(x, *rest):
+            return (fn(x),) + rest if rest else fn(x)
 
-        return self.transform(base_fn, lazy)
+        return self.transform(first_only, lazy)
 
 
 class SimpleDataset(Dataset):
+    """Wrap any indexable container."""
+
     def __init__(self, data):
         self._data = data
 
@@ -48,55 +62,57 @@ class SimpleDataset(Dataset):
         return self._data[idx]
 
 
-class _LazyTransformDataset(Dataset):
-    def __init__(self, data, fn):
-        self._data = data
+class _Mapped(Dataset):
+    """Lazy per-sample function application."""
+
+    def __init__(self, source, fn):
+        self._source = source
         self._fn = fn
 
     def __len__(self):
-        return len(self._data)
+        return len(self._source)
 
     def __getitem__(self, idx):
-        item = self._data[idx]
-        if isinstance(item, tuple):
-            return self._fn(*item)
-        return self._fn(item)
+        sample = self._source[idx]
+        return self._fn(*sample) if isinstance(sample, tuple) \
+            else self._fn(sample)
 
 
 class ArrayDataset(Dataset):
-    """(ref: dataset.py ArrayDataset)"""
+    """Zip N equal-length arrays into tuple samples (single array: bare
+    samples). NDArrays are snapshotted to numpy once up front so indexing
+    is host-cheap."""
 
-    def __init__(self, *args):
-        assert len(args) > 0
-        self._length = len(args[0])
-        self._data = []
-        for d in args:
-            assert len(d) == self._length, "all arrays must have the same length"
-            if isinstance(d, NDArray):
-                d = d.asnumpy()
-            self._data.append(d)
-
-    def __getitem__(self, idx):
-        if len(self._data) == 1:
-            return self._data[0][idx]
-        return tuple(d[idx] for d in self._data)
+    def __init__(self, *arrays):
+        if not arrays:
+            raise ValueError("ArrayDataset needs at least one array")
+        lengths = {len(a) for a in arrays}
+        if len(lengths) != 1:
+            raise ValueError(f"all arrays must share a length, got {lengths}")
+        self._columns = [a.asnumpy() if isinstance(a, NDArray) else a
+                         for a in arrays]
 
     def __len__(self):
-        return self._length
+        return len(self._columns[0])
+
+    def __getitem__(self, idx):
+        row = tuple(col[idx] for col in self._columns)
+        return row[0] if len(row) == 1 else row
 
 
 class RecordFileDataset(Dataset):
-    """(ref: dataset.py RecordFileDataset over MXIndexedRecordIO)"""
+    """Raw records from a RecordIO shard addressed through its .idx
+    (ref role: dataset.py RecordFileDataset over MXIndexedRecordIO)."""
 
     def __init__(self, filename):
         from ... import recordio
 
-        self.idx_file = os.path.splitext(filename)[0] + ".idx"
         self.filename = filename
-        self._record = recordio.MXIndexedRecordIO(self.idx_file, self.filename, "r")
-
-    def __getitem__(self, idx):
-        return self._record.read_idx(self._record.keys[idx])
+        self.idx_file = os.path.splitext(filename)[0] + ".idx"
+        self._record = recordio.MXIndexedRecordIO(self.idx_file, filename, "r")
 
     def __len__(self):
         return len(self._record.keys)
+
+    def __getitem__(self, idx):
+        return self._record.read_idx(self._record.keys[idx])

@@ -1,12 +1,23 @@
-"""Gluon samplers (ref: python/mxnet/gluon/data/sampler.py)."""
+"""Index samplers for DataLoader (capability parity with
+python/mxnet/gluon/data/sampler.py: sequential/random index streams and the
+batching wrapper with keep/discard/rollover tail policies).
+
+Expressed generator-first: a sampler is just an index iterable with a
+length; the batch wrapper chunks any such iterable, with the tail policy
+isolated in `_flush_tail`.
+"""
 from __future__ import annotations
 
 import numpy as np
 
 __all__ = ["Sampler", "SequentialSampler", "RandomSampler", "BatchSampler"]
 
+_TAIL_POLICIES = ("keep", "discard", "rollover")
+
 
 class Sampler:
+    """An iterable of dataset indices with a known length."""
+
     def __iter__(self):
         raise NotImplementedError
 
@@ -15,59 +26,70 @@ class Sampler:
 
 
 class SequentialSampler(Sampler):
+    """Indices start, start+1, ..., start+length-1."""
+
     def __init__(self, length, start=0):
-        self._length = length
-        self._start = start
+        self._range = range(start, start + length)
 
     def __iter__(self):
-        return iter(range(self._start, self._start + self._length))
+        return iter(self._range)
 
     def __len__(self):
-        return self._length
+        return len(self._range)
 
 
 class RandomSampler(Sampler):
+    """A fresh uniform permutation of [0, length) per epoch."""
+
     def __init__(self, length):
         self._length = length
 
     def __iter__(self):
-        indices = np.arange(self._length)
-        np.random.shuffle(indices)
-        return iter(indices.tolist())
+        yield from np.random.permutation(self._length).tolist()
 
     def __len__(self):
         return self._length
 
 
 class BatchSampler(Sampler):
-    """(ref: sampler.py BatchSampler)"""
+    """Chunk an index sampler into batch-size lists.
+
+    Tail policy for a short final chunk: 'keep' yields it, 'discard' drops
+    it, 'rollover' prepends it to the NEXT epoch's first batch.
+    """
 
     def __init__(self, sampler, batch_size, last_batch="keep"):
+        if last_batch not in _TAIL_POLICIES:
+            raise ValueError(
+                f"last_batch must be one of {_TAIL_POLICIES}, got {last_batch!r}")
         self._sampler = sampler
         self._batch_size = batch_size
         self._last_batch = last_batch
-        self._prev = []
+        self._rolled = []
 
     def __iter__(self):
-        batch, self._prev = self._prev, []
-        for i in self._sampler:
-            batch.append(i)
+        batch = self._rolled
+        self._rolled = []
+        for idx in self._sampler:
+            batch.append(idx)
             if len(batch) == self._batch_size:
                 yield batch
                 batch = []
-        if batch:
-            if self._last_batch == "keep":
-                yield batch
-            elif self._last_batch == "discard":
-                return
-            elif self._last_batch == "rollover":
-                self._prev = batch
-            else:
-                raise ValueError(f"invalid last_batch {self._last_batch}")
+        yield from self._flush_tail(batch)
+
+    def _flush_tail(self, batch):
+        if not batch:
+            return
+        if self._last_batch == "keep":
+            yield batch
+        elif self._last_batch == "rollover":
+            self._rolled = batch
+        # 'discard': drop it
 
     def __len__(self):
+        n, b = len(self._sampler), self._batch_size
         if self._last_batch == "keep":
-            return (len(self._sampler) + self._batch_size - 1) // self._batch_size
+            return -(-n // b)  # ceil
         if self._last_batch == "discard":
-            return len(self._sampler) // self._batch_size
-        return (len(self._sampler) + len(self._prev)) // self._batch_size
+            return n // b
+        return (n + len(self._rolled)) // b

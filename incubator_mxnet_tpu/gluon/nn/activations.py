@@ -1,4 +1,9 @@
-"""Gluon activation blocks (ref: python/mxnet/gluon/nn/activations.py)."""
+"""Activation blocks (capability parity with
+python/mxnet/gluon/nn/activations.py).
+
+The parameter-free activations are one generated class per LeakyReLU-op
+act_type; PReLU (learned slope) and Swish (own formula) stay explicit.
+"""
 from __future__ import annotations
 
 from ... import initializer as init_mod
@@ -7,16 +12,49 @@ from ..block import HybridBlock
 __all__ = ["LeakyReLU", "PReLU", "ELU", "SELU", "GELU", "Swish"]
 
 
-class LeakyReLU(HybridBlock):
-    def __init__(self, alpha, **kwargs):
-        super().__init__(**kwargs)
-        self._alpha = alpha
+def _slope_activation(name, act_type, default_slope, doc):
+    """Generate a HybridBlock wrapping F.LeakyReLU(act_type=...)."""
 
-    def hybrid_forward(self, F, x):
-        return F.LeakyReLU(x, act_type="leaky", slope=self._alpha)
+    if default_slope is None:
+        def __init__(self, **kwargs):
+            HybridBlock.__init__(self, **kwargs)
+
+        def hybrid_forward(self, F, x):
+            return F.LeakyReLU(x, act_type=act_type)
+    else:
+        def __init__(self, alpha=default_slope, **kwargs):
+            HybridBlock.__init__(self, **kwargs)
+            self._alpha = alpha
+
+        def hybrid_forward(self, F, x):
+            return F.LeakyReLU(x, act_type=act_type, slope=self._alpha)
+
+    return type(name, (HybridBlock,), {
+        "__init__": __init__,
+        "hybrid_forward": hybrid_forward,
+        "__doc__": doc,
+    })
+
+
+# LeakyReLU's reference signature has alpha REQUIRED; ELU defaults to 1.0
+LeakyReLU = _slope_activation(
+    "LeakyReLU", "leaky", default_slope=0.01,
+    doc="max(x, alpha*x) (ref: activations.py LeakyReLU)")
+ELU = _slope_activation(
+    "ELU", "elu", default_slope=1.0,
+    doc="x if x>0 else alpha*(exp(x)-1) (ref: activations.py ELU)")
+SELU = _slope_activation(
+    "SELU", "selu", default_slope=None,
+    doc="scaled ELU, self-normalizing (ref: activations.py SELU)")
+GELU = _slope_activation(
+    "GELU", "gelu", default_slope=None,
+    doc="Gaussian error linear unit (ref: activations.py GELU)")
 
 
 class PReLU(HybridBlock):
+    """Leaky relu whose per-channel slope is LEARNED
+    (ref: activations.py PReLU)."""
+
     def __init__(self, alpha_initializer=None, in_channels=1, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
@@ -29,26 +67,9 @@ class PReLU(HybridBlock):
         return F.LeakyReLU(x, alpha, act_type="prelu")
 
 
-class ELU(HybridBlock):
-    def __init__(self, alpha=1.0, **kwargs):
-        super().__init__(**kwargs)
-        self._alpha = alpha
-
-    def hybrid_forward(self, F, x):
-        return F.LeakyReLU(x, act_type="elu", slope=self._alpha)
-
-
-class SELU(HybridBlock):
-    def hybrid_forward(self, F, x):
-        return F.LeakyReLU(x, act_type="selu")
-
-
-class GELU(HybridBlock):
-    def hybrid_forward(self, F, x):
-        return F.LeakyReLU(x, act_type="gelu")
-
-
 class Swish(HybridBlock):
+    """x * sigmoid(beta x) (ref: activations.py Swish)."""
+
     def __init__(self, beta=1.0, **kwargs):
         super().__init__(**kwargs)
         self._beta = beta

@@ -1,6 +1,12 @@
-"""Monitor: tap intermediate outputs during training
-(ref: python/mxnet/monitor.py:33, executor monitor_callback hooks
-graph_executor.cc:1239)."""
+"""Monitor: periodic statistics over executor-visible arrays
+(capability parity with python/mxnet/monitor.py Monitor + the executor
+monitor_callback hooks at graph_executor.cc:1239).
+
+Design note for the TPU build: the executor is one fused XLA program, so
+per-internal-op taps don't exist — the observable surface is the bound
+arguments and outputs, which `toc()` sweeps through the name filter. The
+`install`/`stat_helper` callback protocol is kept for API parity (custom
+evaluators can still push taps in)."""
 from __future__ import annotations
 
 import logging
@@ -11,65 +17,69 @@ from .ndarray.ndarray import NDArray
 __all__ = ["Monitor"]
 
 
-class Monitor:
-    def __init__(self, interval, stat_func=None, pattern=".*", sort=False, monitor_all=False):
-        if stat_func is None:
-            def asum_stat(x):
-                return float(abs(x.asnumpy()).mean()) if isinstance(x, NDArray) else float(abs(x).mean())
+def _mean_abs(arr):
+    a = arr.asnumpy() if isinstance(arr, NDArray) else arr
+    return float(abs(a).mean())
 
-            stat_func = asum_stat
-        self.stat_func = stat_func
+
+class Monitor:
+    """Every `interval` tic/toc cycles, collect stat_func over all arrays
+    whose name matches `pattern`."""
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
+                 monitor_all=False):
         self.interval = interval
-        self.activated = False
-        self.queue = []
-        self.step = 0
-        self.exes = []
-        self.re_prog = re.compile(pattern)
+        self.stat_func = stat_func or _mean_abs
         self.sort = sort
         self.monitor_all = monitor_all
+        self._name_filter = re.compile(pattern)
+        self._exes = []
+        self._taps = []
+        self._step = 0
+        self._armed = False
 
+    # -- executor wiring ---------------------------------------------------
     def install(self, exe):
         exe.set_monitor_callback(self.stat_helper, self.monitor_all)
-        self.exes.append(exe)
+        self._exes.append(exe)
 
     def stat_helper(self, name, arr):
-        if not self.activated or not self.re_prog.match(name):
-            return
-        self.queue.append((self.step, name, self.stat_func(arr)))
+        """Callback protocol entry: record one named array if armed."""
+        if self._armed and self._name_filter.match(name):
+            self._taps.append((self._step, name, self.stat_func(arr)))
 
+    # -- per-batch protocol ------------------------------------------------
     def tic(self):
-        if self.step % self.interval == 0:
-            for exe in self.exes:
-                for o in exe.outputs:
-                    o.wait_to_read()
-            self.queue = []
-            self.activated = True
-        self.step += 1
+        """Arm collection on the interval boundary (ref: Monitor.tic)."""
+        if self._step % self.interval == 0:
+            self._sync()
+            self._taps = []
+            self._armed = True
+        self._step += 1
 
     def toc(self):
-        if not self.activated:
+        """Disarm and return [(step, name, stat-string)] collected since
+        tic, sweeping args + outputs of every installed executor."""
+        if not self._armed:
             return []
-        for exe in self.exes:
-            for o in exe.outputs:
-                o.wait_to_read()
-            # record all outputs (whole-graph jit means internals are fused
-            # away; outputs + args are observable)
-            for name, arr in list(exe.arg_dict.items()):
-                if self.re_prog.match(name):
-                    self.queue.append((self.step, name, self.stat_func(arr)))
-            for name, o in zip(exe._symbol.list_outputs(), exe.outputs):
-                if self.re_prog.match(name):
-                    self.queue.append((self.step, name, self.stat_func(o)))
-        self.activated = False
-        res = []
+        self._sync()
+        for exe in self._exes:
+            named = list(exe.arg_dict.items())
+            named += list(zip(exe._symbol.list_outputs(), exe.outputs))
+            for name, arr in named:
+                if self._name_filter.match(name):
+                    self._taps.append((self._step, name, self.stat_func(arr)))
+        self._armed = False
+        taps, self._taps = self._taps, []
         if self.sort:
-            self.queue.sort(key=lambda x: x[1])
-        for n, k, v_list in self.queue:
-            res.append((n, k, str(v_list)))
-        self.queue = []
-        return res
+            taps.sort(key=lambda t: t[1])
+        return [(step, name, str(value)) for step, name, value in taps]
 
     def toc_print(self):
-        res = self.toc()
-        for n, k, v in res:
-            logging.info("Batch: %7d %30s %s", n, k, v)
+        for step, name, value in self.toc():
+            logging.info("Batch: %7d %30s %s", step, name, value)
+
+    def _sync(self):
+        for exe in self._exes:
+            for out in exe.outputs:
+                out.wait_to_read()
