@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Transformer training throughput benchmark (the flagship model's
+tokens/sec on one chip; complements bench.py's ResNet-50 number with the
+workload class the parallel/ stack is designed for).
+
+Measures the GSPMD train step of models/transformer.py on a 1-device mesh
+(single chip) — same step that dryrun_multichip shards over dp/ep/tp.
+Prints one JSON line {"metric", "value", "unit", ...}.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--d-model", type=int, default=512)
+    ap.add_argument("--n-layers", type=int, default=6)
+    ap.add_argument("--n-heads", type=int, default=8)
+    ap.add_argument("--d-ff", type=int, default=2048)
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+    from jax.sharding import Mesh
+    from incubator_mxnet_tpu.models import transformer as tfm
+
+    devices = jax.devices()[:1]
+    mesh = Mesh(np.array(devices).reshape(1, 1, 1),
+                axis_names=("dp", "ep", "tp"))
+    cfg = tfm.TransformerConfig(
+        vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
+        n_layers=args.n_layers, d_ff=args.d_ff, max_len=args.seq)
+    step, params = tfm.make_gspmd_train_step(mesh, cfg)
+
+    rng = np.random.RandomState(0)
+    tok = rng.randint(0, args.vocab, (args.batch, args.seq)).astype(np.int32)
+    tgt = rng.randint(0, args.vocab, (args.batch, args.seq)).astype(np.int32)
+
+    t0 = time.perf_counter()
+    loss, params = step(params, tok, tgt)
+    float(loss)
+    compile_s = time.perf_counter() - t0
+    for _ in range(args.warmup - 1):
+        loss, params = step(params, tok, tgt)
+    float(loss)
+
+    start = time.perf_counter()
+    for _ in range(args.iters):
+        loss, params = step(params, tok, tgt)
+    float(loss)
+    elapsed = time.perf_counter() - start
+
+    tokens = args.batch * args.seq * args.iters
+    tps = tokens / elapsed
+    # 6 * params * tokens is the standard fwd+bwd FLOP estimate
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+    flops = 6.0 * n_params * tokens / elapsed
+    print(json.dumps({
+        "metric": "transformer_train_tokens_per_sec",
+        "value": round(tps, 1),
+        "unit": "tokens/sec/chip",
+        "params": n_params,
+        "model_tflops": round(flops / 1e12, 2),
+        "compile_s": round(compile_s, 1),
+        "loss": float(loss),
+        "platform": devices[0].platform,
+        "config": vars(args),
+    }))
+
+
+if __name__ == "__main__":
+    main()
