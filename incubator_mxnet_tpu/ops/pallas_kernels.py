@@ -1,10 +1,12 @@
 """Pallas TPU kernels for hot ops.
 
 Where the reference reaches for hand-written CUDA (ref: SURVEY §2 N6/N8),
-the TPU build authors Pallas kernels. First kernel: fused flash attention —
-blocked over VMEM with online softmax, never materializing the (T, T) score
-matrix in HBM. Falls back to `interpret=True` off-TPU so the same code runs
-in CPU tests.
+the TPU build authors Pallas kernels. Flash attention here is TRAINABLE:
+the forward is the blocked online-softmax kernel (never materializing the
+(T, T) score matrix in HBM), and the backward is the standard
+FlashAttention-2 recomputation pair — a dQ kernel gridded over query blocks
+and a dK/dV kernel gridded over key blocks — wired up with jax.custom_vjp.
+Falls back to `interpret=True` off-TPU so the same kernels run in CPU tests.
 """
 from __future__ import annotations
 
@@ -21,7 +23,19 @@ __all__ = ["flash_attention"]
 _NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_len, causal, scale):
+def _causal_mask(s, q_start, k_start):
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+
+def _blocks_until(q_end, block):
+    """Number of `block`-sized chunks covering positions [0, q_end)."""
+    return (q_end + block - 1) // block
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_len,
+                causal, scale):
     # one grid step handles one (batch*head, q_block); loops over k blocks
     q = q_ref[...]  # (block_q, d)
     block_q, d = q.shape
@@ -33,9 +47,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_len, causal, scale
         v = v_ref[pl.ds(start * block_k, block_k), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = start * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            s = _causal_mask(s, q_idx * block_q, start * block_k)
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
@@ -49,31 +61,88 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_len, causal, scale
     m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
     num_k = seq_len // block_k
+    if causal:  # skip fully-masked key blocks above the diagonal
+        num_k = _blocks_until((q_idx + 1) * block_q, block_k)
     o, m, l = jax.lax.fori_loop(0, num_k, body, (o0, m0, l0))
-    o_ref[...] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    l = jnp.maximum(l, 1e-30)
+    o_ref[...] = (o / l[:, None]).astype(o_ref.dtype)
+    lse_ref[...] = m + jnp.log(l)
 
 
-def flash_attention(q, k, v, causal=False, block_q=128, block_k=128, interpret=None):
-    """Fused attention: q,k,v (B, H, T, D) -> (B, H, T, D).
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               block_k, seq_len, causal, scale):
+    """dQ for one query block: dq = sum_k (P*(dP - D)) * scale @ K."""
+    q = q_ref[...]
+    do = do_ref[...]
+    lse = lse_ref[...]
+    delta = delta_ref[...]  # rowsum(dO * O)
+    block_q, d = q.shape
+    q_idx = pl.program_id(1)
 
-    Blocked flash-attention Pallas kernel; O(T) HBM, scores live in VMEM.
-    """
-    if interpret is None:
-        interpret = jax.default_backend() == "cpu"
+    def body(start, dq):
+        k = k_ref[pl.ds(start * block_k, block_k), :]
+        v = v_ref[pl.ds(start * block_k, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, q_idx * block_q, start * block_k)
+        p = jnp.exp(s - lse[:, None])
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jnp.dot(ds.astype(k.dtype), k,
+                            preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros((block_q, d), jnp.float32)
+    num_k = seq_len // block_k
+    if causal:
+        num_k = _blocks_until((q_idx + 1) * block_q, block_k)
+    dq = jax.lax.fori_loop(0, num_k, body, dq0)
+    dq_ref[...] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                dv_ref, *, block_q, seq_len, causal, scale):
+    """dK, dV for one key block: loops over query blocks recomputing P."""
+    k = k_ref[...]
+    v = v_ref[...]
+    block_k, d = k.shape
+    k_idx = pl.program_id(1)
+
+    def body(start, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(start * block_q, block_q), :]
+        do = do_ref[pl.ds(start * block_q, block_q), :]
+        lse = lse_ref[pl.ds(start * block_q, block_q)]
+        delta = delta_ref[pl.ds(start * block_q, block_q)]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, start * block_q, k_idx * block_k)
+        p = jnp.exp(s - lse[:, None])                       # (bq, bk)
+        dv_new = dv + jnp.dot(p.T.astype(do.dtype), do,
+                              preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale              # (bq, bk)
+        dk_new = dk + jnp.dot(ds.T.astype(q.dtype), q,
+                              preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    zeros = jnp.zeros((block_k, d), jnp.float32)
+    num_q = seq_len // block_q
+    # skip query blocks strictly above the diagonal (they see no key here)
+    start_q = (k_idx * block_k) // block_q if causal else 0
+    dk, dv = jax.lax.fori_loop(start_q, num_q, body, (zeros, zeros))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     B, H, T, D = q.shape
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
-    assert T % block_q == 0 and T % block_k == 0, "seq len must divide blocks"
     scale = 1.0 / np.sqrt(D)
-
     qr = q.reshape(B * H, T, D)
     kr = k.reshape(B * H, T, D)
     vr = v.reshape(B * H, T, D)
-
     kernel = functools.partial(
-        _flash_kernel, block_k=block_k, seq_len=T, causal=causal, scale=scale
-    )
-    out = pl.pallas_call(
+        _fwd_kernel, block_k=block_k, seq_len=T, causal=causal, scale=scale)
+    o, lse = pl.pallas_call(
         kernel,
         grid=(B * H, T // block_q),
         in_specs=[
@@ -81,8 +150,107 @@ def flash_attention(q, k, v, causal=False, block_q=128, block_k=128, interpret=N
             pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, T), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return o.reshape(B, H, T, D), lse.reshape(B, H, T)
+
+
+def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
+    B, H, T, D = q.shape
+    scale = 1.0 / np.sqrt(D)
+    qr = q.reshape(B * H, T, D)
+    kr = k.reshape(B * H, T, D)
+    vr = v.reshape(B * H, T, D)
+    dor = do.reshape(B * H, T, D)
+    lser = lse.reshape(B * H, T)
+    # D_i = rowsum(dO * O): cheap dense elementwise, no kernel needed
+    delta = jnp.sum(dor.astype(jnp.float32)
+                    * o.reshape(B * H, T, D).astype(jnp.float32), axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_k=block_k, seq_len=T,
+                          causal=causal, scale=scale),
+        grid=(B * H, T // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+        ],
         out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
         interpret=interpret,
-    )(qr, kr, vr)
-    return out.reshape(B, H, T, D)
+    )(qr, kr, vr, dor, lser, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=block_q, seq_len=T,
+                          causal=causal, scale=scale),
+        grid=(B * H, T // block_k),
+        in_specs=[
+            pl.BlockSpec((None, T, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, T, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((None, T), lambda b, j: (b, 0)),
+            pl.BlockSpec((None, T), lambda b, j: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, T, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta)
+
+    return (dq.reshape(B, H, T, D), dk.reshape(B, H, T, D),
+            dv.reshape(B, H, T, D))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    o, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    o, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    return _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k,
+                      interpret)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
+                    interpret=None):
+    """Fused attention: q,k,v (B, H, T, D) -> (B, H, T, D).
+
+    Blocked flash-attention Pallas kernels, forward AND backward
+    (FlashAttention-2 recomputation scheme): O(T) HBM, scores live in VMEM,
+    trainable under jax.grad.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    B, H, T, D = q.shape
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    assert T % block_q == 0 and T % block_k == 0, "seq len must divide blocks"
+    return _flash(q, k, v, causal, block_q, block_k, interpret)
