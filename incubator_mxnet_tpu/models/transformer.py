@@ -55,6 +55,7 @@ class TransformerConfig:
     max_len: int = 128
     n_experts: int = 0  # 0 = dense FFN
     dtype: str = "float32"
+    use_flash: bool = False  # Pallas flash-attention kernels for attention
 
 
 def init_params(cfg: TransformerConfig, seed: int = 0):
@@ -113,6 +114,22 @@ def _split_heads(x, n_heads):
     return x.reshape(B, T, n_heads, d // n_heads)
 
 
+def _flash_attention_fn(q, k, v, causal=True, block=128):
+    """Adapter onto the Pallas flash kernels (ops/pallas_kernels.py):
+    model layout (B, T, H, Dh) <-> kernel layout (B, H, T, Dh). Falls back
+    to dense attention when the sequence doesn't tile into blocks."""
+    from ..ops.pallas_kernels import flash_attention
+
+    T = q.shape[1]
+    blk = min(block, T)
+    if T % blk != 0:
+        return _dense_attention(q, k, v, causal)
+    out = flash_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                          v.transpose(0, 2, 1, 3), causal=causal,
+                          block_q=blk, block_k=blk)
+    return out.transpose(0, 2, 1, 3)
+
+
 def _dense_attention(q, k, v, causal=True):
     # q,k,v: (B, T, H, Dh)
     scale = 1.0 / np.sqrt(q.shape[-1])
@@ -146,7 +163,8 @@ def _layer(lp, x, cfg, attn_fn):
 def apply(params, tokens, cfg: TransformerConfig, attn_fn=None):
     """Forward pass: tokens (B, T) int32 -> logits (B, T, V). Scans the layer
     stack (compiler-friendly: one compiled block body)."""
-    attn_fn = attn_fn or _dense_attention
+    if attn_fn is None:
+        attn_fn = _flash_attention_fn if cfg.use_flash else _dense_attention
     x = params["embed"][tokens] + params["pos"][: tokens.shape[1]][None]
 
     stacked = {k: params[k] for k in _stack_keys(params)}

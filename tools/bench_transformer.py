@@ -29,6 +29,8 @@ def main():
     ap.add_argument("--seq", type=int, default=512)
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--flash", action="store_true",
+                    help="Pallas flash-attention kernels")
     args = ap.parse_args()
 
     import jax
@@ -40,7 +42,8 @@ def main():
                 axis_names=("dp", "ep", "tp"))
     cfg = tfm.TransformerConfig(
         vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
-        n_layers=args.n_layers, d_ff=args.d_ff, max_len=args.seq)
+        n_layers=args.n_layers, d_ff=args.d_ff, max_len=args.seq,
+        use_flash=args.flash)
     step, params = tfm.make_gspmd_train_step(mesh, cfg)
 
     rng = np.random.RandomState(0)
