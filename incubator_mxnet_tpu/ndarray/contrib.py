@@ -37,6 +37,43 @@ def arange_like(data, start=0.0, step=1.0, axis=None):
     )
 
 
+# --- DGL graph-sampling ops (host-side CSR kernels; see contrib/graph.py,
+#     ref: src/operator/contrib/dgl_graph.cc) ------------------------------
+
+def dgl_csr_neighbor_uniform_sample(csr, *seeds, **kwargs):
+    from ..contrib import graph as _graph
+    kwargs.pop("num_args", None)
+    return _graph.csr_neighbor_uniform_sample(csr, *seeds, **kwargs)
+
+
+def dgl_csr_neighbor_non_uniform_sample(csr, probability, *seeds, **kwargs):
+    from ..contrib import graph as _graph
+    kwargs.pop("num_args", None)
+    return _graph.csr_neighbor_non_uniform_sample(csr, probability, *seeds, **kwargs)
+
+
+def dgl_subgraph(graph, *vertex_arrays, **kwargs):
+    from ..contrib import graph as _graph
+    kwargs.pop("num_args", None)
+    return _graph.dgl_subgraph(graph, *vertex_arrays, **kwargs)
+
+
+def edge_id(csr, u, v):
+    from ..contrib import graph as _graph
+    return _graph.edge_id(csr, u, v)
+
+
+def dgl_adjacency(csr):
+    from ..contrib import graph as _graph
+    return _graph.dgl_adjacency(csr)
+
+
+def dgl_graph_compact(*args, **kwargs):
+    from ..contrib import graph as _graph
+    kwargs.pop("num_args", None)
+    return _graph.dgl_graph_compact(*args, **kwargs)
+
+
 def _install_contrib_ops():
     """Surface every `_contrib_*` registry op here under its short name
     (mirrors the reference's `nd.contrib` codegen,

@@ -1,0 +1,230 @@
+"""DGL graph-sampling op tests
+(mirrors ref: tests/python/unittest/test_dgl_graph.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.ndarray import sparse
+
+
+def _k5():
+    """Fully-connected 5-vertex graph, edge ids 1..20 (the reference's
+    docstring example)."""
+    data = np.arange(1, 21, dtype=np.int64)
+    indices = np.array([1, 2, 3, 4, 0, 2, 3, 4, 0, 1, 3, 4,
+                        0, 1, 2, 4, 0, 1, 2, 3], dtype=np.int64)
+    indptr = np.array([0, 4, 8, 12, 16, 20], dtype=np.int64)
+    return sparse.csr_matrix((data, indices, indptr), shape=(5, 5))
+
+
+def check_uniform(out, num_hops, max_num_vertices, graph):
+    sample_id, sub_csr, layer = out
+    assert sample_id.shape == (max_num_vertices + 1,)
+    nv = int(sample_id.asnumpy()[-1])
+    assert 0 < nv <= max_num_vertices
+    sub_csr.check_format(full_check=True)
+    indptr = sub_csr.indptr.asnumpy()
+    # rows past the real vertices are empty padding
+    assert np.all(indptr[nv:] == indptr[nv])
+    assert np.all(layer.asnumpy()[:nv] <= num_hops)
+    # each sampled edge must exist in the parent graph with the same id
+    g = graph.asnumpy()
+    ids = sample_id.asnumpy()[:nv]
+    cols = sub_csr.indices.asnumpy()
+    eids = sub_csr.data.asnumpy()
+    for r in range(nv):
+        for j in range(indptr[r], indptr[r + 1]):
+            assert g[ids[r], cols[j]] == eids[j]
+
+
+def test_uniform_sample():
+    a = _k5()
+    seed = nd.array(np.array([0, 1, 2, 3, 4], dtype=np.int64))
+    out = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        a, seed, num_args=2, num_hops=1, num_neighbor=2, max_num_vertices=5)
+    assert len(out) == 3
+    check_uniform(out, 1, 5, a)
+    # all 5 seeds must appear
+    assert int(out[0].asnumpy()[-1]) == 5
+    # seeds are layer 0
+    assert np.all(out[2].asnumpy() == 0)
+    # each vertex kept at most 2 neighbors
+    assert np.all(np.diff(out[1].indptr.asnumpy()) <= 2)
+
+
+def test_uniform_sample_multi_seed_arrays():
+    a = _k5()
+    s1 = nd.array(np.array([0, 1], dtype=np.int64))
+    s2 = nd.array(np.array([3], dtype=np.int64))
+    out = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        a, s1, s2, num_hops=2, num_neighbor=2, max_num_vertices=5)
+    assert len(out) == 6  # 2 x (ids, csr, layer)
+    check_uniform((out[0], out[2], out[4]), 2, 5, a)
+    check_uniform((out[1], out[3], out[5]), 2, 5, a)
+
+
+def test_uniform_sample_small_graph():
+    # a chain 0->1->2: sampling can't invent edges
+    data = np.array([10, 20], dtype=np.int64)
+    indices = np.array([1, 2], dtype=np.int64)
+    indptr = np.array([0, 1, 2, 2], dtype=np.int64)
+    a = sparse.csr_matrix((data, indices, indptr), shape=(3, 3))
+    out = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        a, nd.array(np.array([0], dtype=np.int64)),
+        num_hops=2, num_neighbor=3, max_num_vertices=3)
+    ids, sub, layer = out
+    nv = int(ids.asnumpy()[-1])
+    assert nv == 3
+    assert list(ids.asnumpy()[:3]) == [0, 1, 2]
+    assert list(layer.asnumpy()[:3]) == [0, 1, 2]
+    sub_np = sub.asnumpy()
+    assert sub_np[0, 1] == 10 and sub_np[1, 2] == 20
+
+
+def test_non_uniform_sample():
+    a = _k5()
+    prob = nd.array(np.array([0.9, 0.8, 0.2, 0.4, 0.1], dtype=np.float32))
+    seed = nd.array(np.array([0, 1, 2, 3, 4], dtype=np.int64))
+    out = nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+        a, prob, seed, num_args=3, num_hops=1, num_neighbor=2,
+        max_num_vertices=5)
+    assert len(out) == 4
+    sample_id, sub_csr, sprob, layer = out
+    check_uniform((sample_id, sub_csr, layer), 1, 5, a)
+    nv = int(sample_id.asnumpy()[-1])
+    # per-vertex probability is gathered for the sampled vertices
+    np.testing.assert_allclose(
+        sprob.asnumpy()[:nv], prob.asnumpy()[sample_id.asnumpy()[:nv]])
+
+
+def test_non_uniform_sample_zero_prob_excluded():
+    # vertex 2 has probability 0 -> never sampled as a neighbor from a
+    # full row (4 candidates, keep 2)
+    a = _k5()
+    prob = nd.array(np.array([1.0, 1.0, 0.0, 1.0, 1.0], dtype=np.float32))
+    rng = np.random.default_rng(0)
+    for _ in range(5):
+        out = nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+            a, prob, nd.array(np.array([0], dtype=np.int64)),
+            num_hops=1, num_neighbor=2, max_num_vertices=5, rng=rng)
+        sub = out[1]
+        assert 2 not in set(sub.indices.asnumpy().tolist())
+
+
+def test_subgraph():
+    # the reference docstring example (dgl_graph.cc:1138)
+    x = np.array([[1, 0, 0, 2],
+                  [3, 0, 4, 0],
+                  [0, 5, 0, 0],
+                  [0, 6, 7, 0]], dtype=np.int64)
+    csr = sparse.csr_matrix(x)
+    v = nd.array(np.array([0, 1, 2], dtype=np.int64))
+    sub, mapping = nd.contrib.dgl_subgraph(csr, v, return_mapping=True)
+    assert sub.shape == (3, 3) and mapping.shape == (3, 3)
+    # original edge ids of the induced edges: (0,0)=1 (1,0)=3 (1,2)=4 (2,1)=5
+    np.testing.assert_array_equal(mapping.data.asnumpy(), [1, 3, 4, 5])
+    np.testing.assert_array_equal(mapping.indices.asnumpy(), [0, 0, 2, 1])
+    np.testing.assert_array_equal(mapping.indptr.asnumpy(), [0, 1, 3, 4])
+    # new edge ids are 0..nnz-1 in CSR order (ref: GetSubgraph sub_eids[i]=i)
+    np.testing.assert_array_equal(sub.data.asnumpy(), [0, 1, 2, 3])
+    np.testing.assert_array_equal(sub.indices.asnumpy(),
+                                  mapping.indices.asnumpy())
+
+
+def test_subgraph_requires_sorted():
+    csr = _k5()
+    with pytest.raises(ValueError):
+        nd.contrib.dgl_subgraph(
+            csr, nd.array(np.array([2, 0], dtype=np.int64)))
+
+
+def test_edge_id():
+    # the reference docstring example (dgl_graph.cc:1318)
+    x = np.array([[1, 0, 0], [0, 2, 0], [0, 0, 3]], dtype=np.int64)
+    csr = sparse.csr_matrix(x)
+    u = nd.array(np.array([0, 0, 1, 1, 2, 2], dtype=np.int64))
+    v = nd.array(np.array([0, 1, 1, 2, 0, 2], dtype=np.int64))
+    out = nd.contrib.edge_id(csr, u, v)
+    np.testing.assert_array_equal(out.asnumpy(), [1, -1, 2, -1, -1, 3])
+
+
+def test_dgl_adjacency():
+    csr = _k5()
+    adj = nd.contrib.dgl_adjacency(csr)
+    assert adj.data.dtype == np.float32
+    np.testing.assert_array_equal(adj.data.asnumpy(), np.ones(20))
+    np.testing.assert_array_equal(adj.indices.asnumpy(),
+                                  csr.indices.asnumpy())
+    np.testing.assert_array_equal(adj.indptr.asnumpy(), csr.indptr.asnumpy())
+
+
+def test_graph_compact():
+    a = _k5()
+    seed = nd.array(np.array([0, 1, 2, 3, 4], dtype=np.int64))
+    out = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        a, seed, num_hops=1, num_neighbor=2, max_num_vertices=6)
+    vids, sub = out[0], out[1]
+    nv = int(vids.asnumpy()[-1])
+    compact = nd.contrib.dgl_graph_compact(
+        sub, vids, graph_sizes=nv, return_mapping=False)
+    assert compact.shape == (nv, nv)
+    np.testing.assert_array_equal(compact.indptr.asnumpy(),
+                                  sub.indptr.asnumpy()[:nv + 1])
+    # renumbered columns map back to the original vertex ids
+    id_arr = vids.asnumpy()
+    sub_idx = compact.indices.asnumpy()
+    np.testing.assert_array_equal(id_arr[sub_idx], sub.indices.asnumpy())
+
+
+def test_graph_compact_mapping_keeps_orig_eids():
+    a = _k5()
+    seed = nd.array(np.array([1, 3], dtype=np.int64))
+    out = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        a, seed, num_hops=1, num_neighbor=2, max_num_vertices=6)
+    vids, sub = out[0], out[1]
+    nv = int(vids.asnumpy()[-1])
+    compact, mapping = nd.contrib.dgl_graph_compact(
+        sub, vids, graph_sizes=nv, return_mapping=True)
+    nnz = int(sub.indptr.asnumpy()[nv])
+    np.testing.assert_array_equal(mapping.data.asnumpy(),
+                                  sub.data.asnumpy()[:nnz])
+    np.testing.assert_array_equal(compact.data.asnumpy(), np.arange(nnz))
+
+
+def test_truncated_sample_is_self_contained():
+    # star: vertex 0 -> 1,2,3; truncation at max_num_vertices=2 must not
+    # leave edges pointing outside the sampled vertex set
+    data = np.array([1, 2, 3], dtype=np.int64)
+    indices = np.array([1, 2, 3], dtype=np.int64)
+    indptr = np.array([0, 3, 3, 3, 3], dtype=np.int64)
+    a = sparse.csr_matrix((data, indices, indptr), shape=(4, 4))
+    out = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        a, nd.array(np.array([0], dtype=np.int64)),
+        num_hops=1, num_neighbor=3, max_num_vertices=2)
+    vids, sub = out[0], out[1]
+    nv = int(vids.asnumpy()[-1])
+    assert nv == 2
+    sampled = set(vids.asnumpy()[:nv].tolist())
+    assert set(sub.indices.asnumpy().tolist()) <= sampled
+    # and graph_compact consumes the sampler's own output
+    compact = nd.contrib.dgl_graph_compact(sub, vids, graph_sizes=nv)
+    assert compact.shape == (nv, nv)
+
+
+def test_non_uniform_fewer_positive_than_k():
+    # only one positive-probability neighbor: keep exactly it, don't crash
+    a = _k5()
+    prob = nd.array(np.array([0.0, 1.0, 0.0, 0.0, 0.0], dtype=np.float32))
+    out = nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+        a, prob, nd.array(np.array([0], dtype=np.int64)),
+        num_hops=1, num_neighbor=2, max_num_vertices=5)
+    sub = out[1]
+    assert set(sub.indices.asnumpy().tolist()) == {1}
+
+
+def test_subgraph_rejects_duplicates():
+    csr = _k5()
+    with pytest.raises(ValueError):
+        nd.contrib.dgl_subgraph(
+            csr, nd.array(np.array([0, 0, 1], dtype=np.int64)))
