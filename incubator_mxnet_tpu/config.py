@@ -103,6 +103,9 @@ register_knob("MXTPU_ASYNC_PERIOD", 16, int,
 register_knob("MXTPU_ASYNC_ALPHA", 0.5, float,
               "dist_async: mixing rate toward the cross-worker mean at a "
               "mix point.")
+register_knob("MXTPU_PS_ADDR", "", str,
+              "host:port of the parameter server (default: coordinator "
+              "host, coordinator port + 23).")
 register_knob("MXTPU_HEARTBEAT_DIR", "", str,
               "Directory for worker heartbeat files (dead-node detection; "
               "default derives from MXTPU_COORDINATOR).")

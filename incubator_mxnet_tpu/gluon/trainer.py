@@ -35,6 +35,7 @@ class Trainer:
         self._kvstore = None
         self._kv_initialized = False
         self._update_on_kvstore = update_on_kvstore
+        self._kv_shipped_rescale = None
 
     @property
     def learning_rate(self):
@@ -46,21 +47,50 @@ class Trainer:
 
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
+        self._ship_optimizer_attrs(lr=lr)
+
+    def _ship_optimizer_attrs(self, **attrs):
+        """Propagate live optimizer mutations to the server copy (the
+        pickled optimizer shipped at init is otherwise a snapshot)."""
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.set_optimizer_attrs(attrs)
 
     def _init_kvstore(self):
-        """(ref: trainer.py:169 _init_kvstore)"""
+        """(ref: trainer.py:169 _init_kvstore — dist_async forces
+        update_on_kvstore: the server owns weights + optimizer)"""
         if self._kv_initialized:
             return
         if isinstance(self._kvstore_str, str) and "dist" in self._kvstore_str:
-            # allreduce mode: the store is a transient merge buffer, never
-            # seeded with weights (optimizer runs locally on every worker)
             self._kvstore = kvs.create(self._kvstore_str)
+            server_mode = isinstance(self._kvstore, kvs.KVStoreDistAsyncServer)
+            if self._update_on_kvstore is None:
+                self._update_on_kvstore = server_mode
+            if server_mode and not self._update_on_kvstore:
+                raise ValueError(
+                    "dist_async_server requires update_on_kvstore=True "
+                    "(the server applies the optimizer)")
+            if self._update_on_kvstore:
+                # server-applied updates: seed the authoritative weights and
+                # ship the optimizer (ref: trainer.py:221-227)
+                self._kvstore.set_optimizer(self._optimizer)
+                self._kv_shipped_rescale = self._optimizer.rescale_grad
+                for i, p in enumerate(self._params):
+                    self._kvstore.init(i, p.data())
+            # else: allreduce mode — the store is a transient merge buffer,
+            # never seeded with weights (optimizer runs locally everywhere)
+        else:
+            self._update_on_kvstore = False
         self._kv_initialized = True
 
     def allreduce_grads(self):
         """(ref: trainer.py:327) — multi-host sum via kvstore; intra-host is
         already reduced by GSPMD."""
         self._init_kvstore()
+        if self._update_on_kvstore:
+            raise ValueError(
+                "allreduce_grads() is not supported when the optimizer "
+                "runs on the kvstore server; call step() "
+                "(ref: trainer.py:333)")
         if self._kvstore is not None:
             for i, p in enumerate(self._params):
                 g = p.grad()
@@ -69,13 +99,30 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """(ref: trainer.py:298)"""
+        # rescale BEFORE _init_kvstore: server mode pickles the optimizer at
+        # init, so the scale must already be baked in on the first step
+        rescale = self._scale / batch_size
+        self._optimizer.rescale_grad = rescale
         self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
+        if self._kvstore is not None and self._update_on_kvstore:
+            if rescale != self._kv_shipped_rescale:
+                self._ship_optimizer_attrs(rescale_grad=rescale)
+                self._kv_shipped_rescale = rescale
+            # push grads, pull server-updated weights — no local update
+            for i, p in enumerate(self._params):
+                self._kvstore.push(i, p.grad())
+                self._kvstore.pull(i, out=p.data())
+            return
         if self._kvstore is not None:
             self.allreduce_grads()
         self._update(ignore_stale_grad)
 
     def update(self, batch_size, ignore_stale_grad=False):
+        self._init_kvstore()
+        if self._update_on_kvstore:
+            raise ValueError(
+                "update() is not supported when the optimizer runs on the "
+                "kvstore server; call step() (ref: trainer.py:360)")
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
 
@@ -86,9 +133,17 @@ class Trainer:
             self._updater(i, p.grad(), p.data())
 
     def save_states(self, fname):
+        self._init_kvstore()
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.save_optimizer_states(fname)
+            return
         with open(fname, "wb") as f:
             f.write(self._updater.get_states(dump_optimizer=False))
 
     def load_states(self, fname):
+        self._init_kvstore()
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.load_optimizer_states(fname)
+            return
         with open(fname, "rb") as f:
             self._updater.set_states(f.read())

@@ -442,6 +442,175 @@ class KVStoreDistAsync(KVStoreDist):
             self._mix(key, alpha=alpha)
 
 
+class KVStoreDistAsyncServer(KVStoreDist):
+    """`dist_async` with the reference's TRUE parameter-server semantics:
+    a server (rank 0 host thread) owns the authoritative weights and applies
+    each worker's update the instant its push arrives — no cross-worker
+    averaging, no per-step blocking (ref: kvstore_dist_server.h:348-358).
+
+    Select with kvstore type 'dist_async_server'. The default
+    'dist_async' remains collective-based elastic averaging (see
+    KVStoreDistAsync) because collectives are the TPU-native transport; this
+    class exists for workloads that depend on server-applied async-SGD
+    semantics (staleness realized per-push, shared optimizer state).
+    """
+
+    def __init__(self, kv_type="dist_async_server"):
+        super().__init__(kv_type)
+        from . import ps as _ps
+
+        host, port = _ps.default_server_addr()
+        self._server = None
+        if self.rank == 0:
+            self._server = _ps.ParameterServer(self.num_workers, port=port)
+            port = self._server.port
+        self._client = _ps.PSClient("127.0.0.1" if self.rank == 0 else host,
+                                    port)
+        self._shapes = {}
+
+    def barrier(self):
+        # the server's counting barrier: matches PS semantics and works
+        # even before jax.distributed collectives are usable
+        self._client.barrier()
+
+    def init(self, key, value):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.init(k, v)
+            return
+        v = value[0] if isinstance(value, (list, tuple)) else value
+        v = v if isinstance(v, NDArray) else NDArray(v)
+        self._shapes[key] = v.shape
+        if self.rank == 0:
+            self._client.init(key, v.asnumpy())
+        self._client.barrier()
+
+    def set_optimizer(self, optimizer):
+        """Ship the optimizer to the server (ref: CommandType::kController)."""
+        self._optimizer = optimizer
+        if self.rank == 0:
+            self._client.set_optimizer(optimizer)
+        self._client.barrier()
+
+    def set_updater(self, updater):
+        raise NotImplementedError(
+            "dist_async_server applies updates server-side; use "
+            "set_optimizer (the reference's dist kvstore has the same "
+            "constraint for custom python updaters)")
+
+    def set_gradient_compression(self, compression_params):
+        """Compression crosses the REAL wire here: the worker ships the
+        packed 2-bit payload and the server decodes (ref:
+        gradient_compression.h:37 + DataHandleCompressed)."""
+        super().set_gradient_compression(compression_params)
+        if self.rank == 0:
+            self._client.set_compression(dict(compression_params))
+        self._client.barrier()
+
+    def set_optimizer_attrs(self, attrs):
+        """Propagate live attribute changes (lr, rescale_grad, ...) to the
+        server's optimizer without rebuilding it (state survives)."""
+        if self.rank == 0:
+            self._client.set_optimizer_attrs(dict(attrs))
+        self._client.barrier()
+
+    def push(self, key, value, priority=0):
+        from .ndarray.sparse import BaseSparseNDArray, RowSparseNDArray
+
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.push(k, v, priority)
+            return
+        import numpy as _np
+
+        grad = self._reduce(value)
+        if isinstance(grad, BaseSparseNDArray):
+            if not isinstance(grad, RowSparseNDArray):
+                grad = grad.tostype("row_sparse")
+            # only the occupied rows cross the wire, applied sparsely
+            # server-side (ref: DataHandleRowSparse kvstore_dist_server.h:499)
+            self._client.push_rows(key,
+                                   _np.asarray(grad.indices.asnumpy()),
+                                   _np.asarray(grad.data.asnumpy()))
+            return
+
+        if self._compression is not None:
+            # worker keeps the error-feedback residual; only the packed
+            # payload (4 grads/byte) crosses TCP
+            payload, _n = self._compression.encode(key, grad)
+            self._client.push_compressed(key, _np.asarray(payload),
+                                         tuple(grad.shape))
+            return
+        self._client.push(key, _np.asarray(grad), sync=False)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if isinstance(key, (list, tuple)):
+            for k, o in zip(key, out):
+                self.pull(k, o, priority)
+            return
+        val = jnp.asarray(self._client.pull(key))
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o in outs:
+            if o is not None:
+                o._data = val
+        return NDArray._from_data(val)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        if isinstance(key, (list, tuple)):
+            outs = out if isinstance(out, (list, tuple)) else [out] * len(key)
+            for k, v, o in zip(key, value, outs):
+                self.pushpull(k, v, o, priority)
+            return
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Only the requested rows cross the wire
+        (ref: DataHandleRowSparse kvstore_dist_server.h:499)."""
+        import numpy as _np
+
+        rid = row_ids[0] if isinstance(row_ids, (list, tuple)) else row_ids
+        idx = _np.asarray(_to_data(rid)).astype(_np.int64)
+        rows = jnp.asarray(self._client.pull_rows(key, idx))
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o in outs:
+            if isinstance(o, RowSparseNDArray):
+                o.data._data = rows
+                o.indices._data = jnp.asarray(idx)
+            else:
+                full = jnp.zeros(self._shapes[key], rows.dtype)
+                o._data = full.at[jnp.asarray(idx)].set(rows)
+        return out
+
+    def sync_all(self, alpha=1.0):
+        """Server weights are already authoritative — nothing to reconcile."""
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        """Optimizer state lives ON the server — fetch it over the wire
+        (ref: the reference cannot do this; server state was unrecoverable
+        there)."""
+        blob = self._client.get_optimizer_states(dump_optimizer)
+        with open(fname, "wb") as f:
+            f.write(blob)
+
+    def load_optimizer_states(self, fname):
+        with open(fname, "rb") as f:
+            blob = f.read()
+        if self.rank == 0:
+            self._client.set_optimizer_states(blob)
+        self._client.barrier()
+
+    def close(self):
+        self._client.barrier()
+        if self._server is not None:
+            self._server.shutdown()
+        self._client.close()
+        # collective rendezvous AFTER the listener is closed: a successor
+        # store on the same port must never find the old server accepting
+        super().barrier()
+
+
 def _key_int(key):
     if isinstance(key, int):
         return key
@@ -534,6 +703,8 @@ def create(name="local"):
 
         distributed.init_from_env()  # launcher env -> jax.distributed
         if "async" in name:
+            if name == "dist_async_server":
+                return KVStoreDistAsyncServer(name)
             return KVStoreDistAsync(name)
         return KVStoreDist(name)
     return KVStore(name)
@@ -555,6 +726,9 @@ def create_kvstore_for_module(kvstore, num_device, arg_params):
         raise TypeError(f"bad kvstore type {type(kvstore)}")
     if kv is None:
         update_on_kvstore = False
+    elif isinstance(kv, KVStoreDistAsyncServer):
+        # true parameter server: optimizer runs ON the server
+        update_on_kvstore = True
     elif "dist" in kv.type:
         # dist on TPU = serverless allreduce; optimizer runs locally
         update_on_kvstore = False

@@ -51,3 +51,8 @@ def test_dist_gluon_trainer_two_workers():
 def test_dist_async_kvstore_two_workers():
     log = _launch("dist_async_kvstore.py", 2)
     assert log.count("dist_async_kvstore OK") == 2
+
+
+def test_dist_async_parameter_server_two_workers():
+    log = _launch("dist_async_ps.py", 2)
+    assert log.count("dist_async_ps OK") == 2

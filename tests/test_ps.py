@@ -1,0 +1,136 @@
+"""Parameter-server protocol unit tests (single process, real sockets)
+(ref: src/kvstore/kvstore_dist_server.h — async apply :348, sync merge
+:346, row-sparse serving :499)."""
+import threading
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.ps import ParameterServer, PSClient
+
+
+@pytest.fixture
+def server2():
+    srv = ParameterServer(num_workers=2, host="127.0.0.1", port=0)
+    clients = [PSClient("127.0.0.1", srv.port) for _ in range(2)]
+    yield srv, clients
+    for c in clients:
+        c.close()
+    srv.shutdown()
+
+
+def test_init_first_writer_wins(server2):
+    srv, (c0, c1) = server2
+    c0.init("w", np.ones((2, 2), np.float32))
+    c1.init("w", np.zeros((2, 2), np.float32))
+    np.testing.assert_array_equal(c1.pull("w"), np.ones((2, 2)))
+
+
+def test_async_push_applies_instantly(server2):
+    srv, (c0, c1) = server2
+    c0.set_optimizer(mx.optimizer.SGD(learning_rate=0.5, rescale_grad=1.0))
+    c0.init("w", np.ones(3, np.float32))
+    c0.push("w", np.ones(3, np.float32))          # w = 1 - 0.5
+    np.testing.assert_allclose(c1.pull("w"), 0.5)  # visible immediately
+    c1.push("w", np.ones(3, np.float32))          # w = 0.5 - 0.5
+    np.testing.assert_allclose(c0.pull("w"), 0.0)
+
+
+def test_accumulate_without_optimizer(server2):
+    srv, (c0, c1) = server2
+    c0.init("acc", np.zeros(2, np.float32))
+    c0.push("acc", np.ones(2, np.float32))
+    c1.push("acc", 2 * np.ones(2, np.float32))
+    np.testing.assert_allclose(c0.pull("acc"), 3.0)
+
+
+def test_sync_push_aggregates_all_workers(server2):
+    srv, (c0, c1) = server2
+    c0.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0))
+    c0.init("w", np.ones(2, np.float32))
+
+    # sync push blocks until both workers contribute; run one in a thread
+    def late_push():
+        c1.push("w", np.ones(2, np.float32), sync=True)
+
+    t = threading.Thread(target=late_push)
+    t.start()
+    c0.push("w", np.ones(2, np.float32), sync=True)
+    t.join(timeout=30)
+    assert not t.is_alive()
+    # ONE update with the summed gradient: 1 - 0.1*(1+1)
+    np.testing.assert_allclose(c0.pull("w"), 0.8, rtol=1e-6)
+
+
+def test_pull_rows(server2):
+    srv, (c0, c1) = server2
+    w = np.arange(12, dtype=np.float32).reshape(4, 3)
+    c0.init("emb", w)
+    got = c1.pull_rows("emb", np.array([1, 3]))
+    np.testing.assert_array_equal(got, w[[1, 3]])
+
+
+def test_barrier_releases_both(server2):
+    srv, (c0, c1) = server2
+    order = []
+
+    def worker():
+        c1.barrier()
+        order.append("released")
+
+    t = threading.Thread(target=worker)
+    t.start()
+    assert not order  # c1 parked until c0 arrives
+    c0.barrier()
+    t.join(timeout=30)
+    assert order == ["released"]
+
+
+def test_error_ships_to_worker(server2):
+    srv, (c0, _) = server2
+    with pytest.raises(RuntimeError, match="KeyError"):
+        c0.pull("never-inited")
+
+
+def test_optimizer_state_lives_on_server(server2):
+    # momentum accumulates server-side across pushes from different workers
+    srv, (c0, c1) = server2
+    c0.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                                      rescale_grad=1.0))
+    c0.init("w", np.zeros(1, np.float32))
+    c0.push("w", np.ones(1, np.float32))   # mom = -0.1;  w = -0.1
+    c1.push("w", np.ones(1, np.float32))   # mom = -0.19; w = -0.29
+    np.testing.assert_allclose(c0.pull("w"), -0.29, rtol=1e-5)
+
+
+def test_set_optimizer_attrs_preserves_state(server2):
+    # live rescale_grad change must not reset server-side momentum
+    srv, (c0, _) = server2
+    c0.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                                      rescale_grad=1.0))
+    c0.init("w", np.zeros(1, np.float32))
+    c0.push("w", np.ones(1, np.float32))       # mom=-0.1, w=-0.1
+    c0.set_optimizer_attrs({"rescale_grad": 0.5})
+    c0.push("w", np.ones(1, np.float32))       # mom=0.9*-0.1-0.1*0.5=-0.14
+    np.testing.assert_allclose(c0.pull("w"), -0.24, rtol=1e-5)
+
+
+def test_set_optimizer_attrs_rejects_unknown(server2):
+    srv, (c0, _) = server2
+    c0.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    with pytest.raises(RuntimeError, match="AttributeError"):
+        c0.set_optimizer_attrs({"not_an_attr": 1})
+
+
+def test_push_rows_sparse_apply(server2):
+    # only occupied rows cross the wire and only they change
+    srv, (c0, _) = server2
+    w = np.zeros((6, 2), np.float32)
+    c0.init("emb", w)
+    c0.set_optimizer(mx.optimizer.SGD(learning_rate=1.0, rescale_grad=1.0))
+    rows = np.array([[1.0, 1.0], [2.0, 2.0]], np.float32)
+    c0.push_rows("emb", np.array([1, 4]), rows)
+    got = np.asarray(c0.pull("emb"))
+    np.testing.assert_allclose(got[[1, 4]], -rows, rtol=1e-6)
+    np.testing.assert_allclose(got[[0, 2, 3, 5]], 0.0)
