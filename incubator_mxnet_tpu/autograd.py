@@ -158,6 +158,52 @@ def invoke_recorded(fn, input_arrays, name=""):
     return res
 
 
+def sparse_embedding(x, weight, input_dim, output_dim):
+    """Embedding lookup whose recorded weight-cotangent is ROW-SPARSE
+    (ref: src/operator/tensor/indexing_op.cc Embedding with
+    grad_stype=row_sparse — only rows a batch touches appear in the grad).
+
+    Eager-tape only: the row set is data-dependent, so under jit tracing
+    embeddings fall back to the dense gather/scatter path (XLA fuses that
+    fine on-chip; sparsity pays off on the host/optimizer/wire side).
+    Duplicate ids within the batch are pre-aggregated with a segment-sum.
+    """
+    import numpy as np
+
+    from .ndarray.ndarray import NDArray
+    from .ndarray.sparse import RowSparseNDArray
+
+    xd = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+    wd = weight._data
+    idx = jnp.asarray(xd).astype(jnp.int32)
+    out = jnp.take(wd, idx.ravel(), axis=0).reshape(
+        tuple(idx.shape) + (int(output_dim),))
+    res = NDArray._from_data(out)
+    # record whenever the tape is on: whether a grad buffer exists is
+    # backward()'s concern (autograd.grad attaches buffers post-forward)
+    if not is_recording():
+        return res
+
+    host_idx = np.asarray(idx).ravel()
+    uniq, inv = np.unique(host_idx, return_inverse=True)
+    inv = jnp.asarray(inv)
+
+    def vjp(cts):
+        ct = jnp.asarray(cts[0]).reshape(-1, int(output_dim))
+        rows = jnp.zeros((uniq.shape[0], int(output_dim)),
+                         ct.dtype).at[inv].add(ct)
+        gw = RowSparseNDArray(NDArray._from_data(rows),
+                              NDArray(uniq.astype(np.int64)),
+                              (int(input_dim), int(output_dim)))
+        return (gw,)
+
+    node = TapeNode(vjp=vjp, inputs=[weight], n_outputs=1,
+                    out_avals=[(res.shape, res.dtype)],
+                    name="sparse_embedding")
+    _attach_outputs(node, [res])
+    return res
+
+
 def mark_variables(variables, gradients, grad_reqs="write"):
     """Attach gradient buffers (ref: MXAutogradMarkVariables)."""
     if not isinstance(variables, (list, tuple)):
@@ -217,7 +263,17 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # no
             return
         k = id(arr)
         var_by_id[k] = arr
-        var_cts[k] = ct if k not in var_cts else var_cts[k] + ct
+        if k not in var_cts:
+            var_cts[k] = ct
+            return
+        prev = var_cts[k]
+        from .ndarray.sparse import BaseSparseNDArray, add as sparse_add
+
+        if isinstance(prev, BaseSparseNDArray) or isinstance(ct, BaseSparseNDArray):
+            # rsp+rsp stays sparse; mixed falls back to dense NDArray
+            var_cts[k] = sparse_add(prev, ct)
+        else:
+            var_cts[k] = prev + ct
 
     head_nodes = []
     for h, hg in zip(heads, head_grads):
@@ -261,10 +317,36 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # no
             node.vjp = None  # free residuals
 
     # write accumulated cotangents into grad buffers per grad_req
+    from .ndarray.sparse import BaseSparseNDArray
+
     for k, ct in var_cts.items():
         arr = var_by_id[k]
         grad = arr._grad
-        if getattr(arr, "_grad_req", "write") == "add":
+        req = getattr(arr, "_grad_req", "write")
+        if isinstance(ct, BaseSparseNDArray):
+            # sparse cotangent (e.g. sparse_embedding): the grad buffer
+            # BECOMES the sparse array so optimizers hit their lazy paths
+            if req == "add" and isinstance(grad, BaseSparseNDArray):
+                arr._grad = grad + ct
+            elif req == "add" and grad is not None:
+                grad._data = grad._data + ct.todense()._data.astype(grad.dtype)
+            else:
+                arr._grad = ct
+            continue
+        if isinstance(ct, NDArray):  # mixed sparse+dense accumulation
+            ct = ct._data
+        if isinstance(grad, BaseSparseNDArray):
+            # a dense cotangent displaces last step's sparse buffer; reuse
+            # the parameter's original dense buffer so Parameter._grad
+            # identity survives (see Parameter._attach_grad)
+            prev = grad.todense()._data if req == "add" else None
+            grad = getattr(arr, "_dense_grad_buf", None)
+            if grad is None:
+                grad = NDArray._from_data(jnp.zeros(arr.shape, arr.dtype))
+            grad._data = (prev if prev is not None
+                          else jnp.zeros(arr.shape, arr.dtype))
+            arr._grad = grad
+        if req == "add":
             grad._data = grad._data + ct.astype(grad.dtype)
         else:
             grad._data = jnp.asarray(ct, dtype=grad.dtype).reshape(grad.shape)

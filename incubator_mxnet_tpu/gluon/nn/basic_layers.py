@@ -221,6 +221,11 @@ class LayerNorm(HybridBlock):
 
 
 class Embedding(HybridBlock):
+    """Lookup table; `sparse_grad=True` records a row_sparse weight
+    gradient covering only the rows a batch touches, engaging the lazy
+    sparse optimizer paths (ref: gluon/nn/basic_layers.py Embedding +
+    indexing_op.cc grad_stype=row_sparse)."""
+
     def __init__(self, input_dim, output_dim, dtype="float32",
                  weight_initializer=None, sparse_grad=False, **kwargs):
         super().__init__(**kwargs)
@@ -230,9 +235,24 @@ class Embedding(HybridBlock):
             self.weight = self.params.get(
                 "weight", shape=(input_dim, output_dim), dtype=dtype,
                 init=weight_initializer,
+                grad_stype="row_sparse" if sparse_grad else "default",
             )
 
     def hybrid_forward(self, F, x, weight):
+        # the Parameter's grad_stype drives the dispatch, as in the
+        # reference (per-op grad stype support; Embedding honors it here)
+        if self.weight.grad_stype == "row_sparse":
+            import jax as _jax
+
+            from ... import autograd as _ag
+            from ...ndarray.ndarray import NDArray as _ND
+
+            # eager tape only: under jit tracing the row set is dynamic, so
+            # hybridized nets use the dense gather path instead
+            if (isinstance(weight, _ND)
+                    and not isinstance(weight._data, _jax.core.Tracer)):
+                return _ag.sparse_embedding(x, weight, self._input_dim,
+                                            self._output_dim)
         return F.Embedding(x, weight, input_dim=self._input_dim,
                            output_dim=self._output_dim)
 

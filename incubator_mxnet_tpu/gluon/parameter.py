@@ -52,6 +52,8 @@ class Parameter:
                  differentiable=True, stype="default", grad_stype="default"):
         self.name = name
         self._grad_req = grad_req if differentiable else "null"
+        self.stype = stype
+        self.grad_stype = grad_stype
         self._shape = tuple(shape) if shape is not None else None
         self.dtype = dtype
         self.lr_mult = lr_mult
@@ -130,6 +132,10 @@ class Parameter:
         self._grad = NDArray._from_data(jnp.zeros(self._shape, dtype_np(self.dtype)))
         self._data._grad = self._grad
         self._data._grad_req = self._grad_req
+        # backward() may swap _grad for a RowSparseNDArray; this backref
+        # lets it restore THIS buffer when a dense cotangent returns, so
+        # Parameter._grad identity survives the round trip
+        self._data._dense_grad_buf = self._grad
 
     # -- access ------------------------------------------------------------
     def data(self, ctx=None):
@@ -153,6 +159,11 @@ class Parameter:
         return [self.data()]
 
     def grad(self, ctx=None):
+        # the data array's buffer is authoritative: backward() may have
+        # replaced it with a RowSparseNDArray (sparse_grad embeddings)
+        g = getattr(self._data, "_grad", None) if self._data is not None else None
+        if g is not None:
+            return g
         if self._grad is None:
             raise RuntimeError(f"parameter {self.name} has no gradient (grad_req=null?)")
         return self._grad
@@ -175,6 +186,16 @@ class Parameter:
             self._data._data = jnp.asarray(arr._data, dtype=self._data._data.dtype).reshape(self._shape)
 
     def zero_grad(self):
+        from ..ndarray.sparse import BaseSparseNDArray
+
+        live = getattr(self._data, "_grad", None) if self._data is not None else None
+        if isinstance(live, BaseSparseNDArray) or (
+                live is not None and live is not self._grad):
+            # backward() replaced the buffer (sparse grad, or a fresh dense
+            # one displacing a sparse grad) — re-attach so self._grad and
+            # _data._grad agree again
+            self._attach_grad()
+            return
         if self._grad is not None:
             self._grad._data = jnp.zeros_like(self._grad._data)
 

@@ -35,7 +35,8 @@ def _ctx_of_jax(data) -> Context:
 class NDArray:
     """Dense tensor handle over a jax.Array."""
 
-    __slots__ = ("_data", "_grad", "_grad_req", "_node", "_node_index", "__weakref__")
+    __slots__ = ("_data", "_grad", "_grad_req", "_node", "_node_index",
+                 "_dense_grad_buf", "__weakref__")
 
     # make NDArray win against numpy in mixed dunder dispatch
     __array_priority__ = 1000.0

@@ -262,3 +262,126 @@ def test_csr_negative_and_reversed_slice():
     assert empty.shape == (0, 3)
     with pytest.raises(IndexError):
         csr[-9]
+
+
+def test_sparse_embedding_rowsparse_grad():
+    """gluon Embedding(sparse_grad=True) records a row_sparse weight grad
+    covering exactly the batch's unique ids, with duplicates aggregated
+    (ref: indexing_op.cc Embedding grad_stype=row_sparse)."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, nd
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+    mx.random.seed(0)
+    emb = nn.Embedding(50, 4, sparse_grad=True)
+    emb.initialize(mx.init.Normal(0.1))
+    x = nd.array(np.array([3.0, 7.0, 3.0]))
+    with autograd.record():
+        out = emb(x)
+        loss = (out * out).sum()
+    loss.backward()
+    g = emb.weight.grad()
+    assert isinstance(g, RowSparseNDArray)
+    np.testing.assert_array_equal(g.indices.asnumpy(), [3, 7])
+    w = emb.weight.data().asnumpy()
+    # duplicate id 3 contributes twice
+    np.testing.assert_allclose(g.data.asnumpy()[0], 4 * w[3], rtol=1e-5)
+    np.testing.assert_allclose(g.data.asnumpy()[1], 2 * w[7], rtol=1e-5)
+    # dense-path equivalence
+    emb2 = nn.Embedding(50, 4, sparse_grad=False)
+    emb2.initialize(mx.init.Normal(0.1))
+    emb2.weight.set_data(emb.weight.data())
+    with autograd.record():
+        out2 = emb2(x)
+        loss2 = (out2 * out2).sum()
+    loss2.backward()
+    gd = emb2.weight.grad().asnumpy()
+    np.testing.assert_allclose(g.todense().asnumpy(), gd, rtol=1e-5)
+
+
+def test_sparse_embedding_trainer_lazy_update():
+    """Untouched rows keep their weights bit-exact under lazy sparse Adam."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, nd
+    from incubator_mxnet_tpu.gluon import nn
+
+    mx.random.seed(1)
+    emb = nn.Embedding(20, 3, sparse_grad=True)
+    emb.initialize(mx.init.Normal(0.1))
+    before = emb.weight.data().asnumpy().copy()
+    trainer = gluon.Trainer(emb.collect_params(), "adam",
+                            {"learning_rate": 0.1, "lazy_update": True})
+    x = nd.array(np.array([2.0, 5.0]))
+    with autograd.record():
+        loss = (emb(x) ** 2).sum()
+    loss.backward()
+    trainer.step(1)
+    after = emb.weight.data().asnumpy()
+    touched = np.array([2, 5])
+    untouched = np.setdiff1d(np.arange(20), touched)
+    assert not np.allclose(after[touched], before[touched])
+    np.testing.assert_array_equal(after[untouched], before[untouched])
+
+
+def test_sparse_embedding_hybridized_falls_back_dense():
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, nd
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+    mx.random.seed(2)
+    emb = nn.Embedding(10, 2, sparse_grad=True)
+    emb.initialize(mx.init.Normal(0.1))
+    emb.hybridize()
+    x = nd.array(np.array([1.0, 4.0]))
+    with autograd.record():
+        loss = (emb(x) ** 2).sum()
+    loss.backward()
+    assert isinstance(emb.weight.grad(), NDArray)
+
+
+def test_sparse_embedding_autograd_grad_api():
+    """autograd.grad() (buffers attached post-forward) must see the sparse
+    embedding gradient — recording cannot depend on pre-attached buffers."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, nd
+    from incubator_mxnet_tpu.gluon import nn
+
+    mx.random.seed(3)
+    emb = nn.Embedding(10, 3, sparse_grad=True)
+    emb.initialize(mx.init.Normal(0.1))
+    w = emb.weight.data()
+    x = nd.array(np.array([1.0, 4.0]))
+    with autograd.record():
+        loss = (emb(x) ** 2).sum()
+    (g,) = autograd.grad([loss], [w])
+    dense = g.todense().asnumpy() if hasattr(g, "todense") else g.asnumpy()
+    assert float(np.abs(dense).sum()) > 0
+
+
+def test_sparse_then_dense_grad_keeps_parameter_buffer():
+    """A dense cotangent displacing a sparse grad must land in the buffer
+    Parameter.zero_grad()/grad() actually see."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, nd
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+    mx.random.seed(4)
+    emb = nn.Embedding(6, 2, sparse_grad=True)
+    emb.initialize(mx.init.Normal(0.1))
+    x = nd.array(np.array([1.0, 3.0]))
+    with autograd.record():
+        loss = (emb(x) ** 2).sum()
+    loss.backward()
+    assert isinstance(emb.weight.grad(), RowSparseNDArray)
+    # now a dense use of the same weight (sum over the whole table)
+    with autograd.record():
+        loss2 = (emb.weight.data() * emb.weight.data()).sum()
+    loss2.backward()
+    g = emb.weight.grad()
+    assert not isinstance(g, RowSparseNDArray)
+    assert float(np.abs(g.asnumpy()).sum()) > 0
+    emb.weight.zero_grad()
+    assert float(np.abs(emb.weight.grad().asnumpy()).sum()) == 0.0
