@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Long-context training via ring-attention sequence parallelism
+(beyond the reference: its longest-context path was BucketingModule +
+truncated BPTT; here one sequence spans the whole device mesh).
+
+What this shows, on an 8-device mesh (virtual CPU here, ICI on a pod):
+  - the sequence axis is SHARDED: each device holds seq/sp tokens,
+  - ring attention streams K/V blocks around the ring with `ppermute`,
+    merging partial softmax accumulators online, so no device ever
+    materializes the full (seq x seq) score matrix,
+  - the result is numerically identical to dense attention (checked).
+
+Run: python examples/long_context_ring.py --seq-len 2048 --sp 8
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--sp", type=int, default=8, help="sequence-parallel width")
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--d-head", type=int, default=32)
+    p.add_argument("--causal", action="store_true")
+    p.add_argument("--cpu-devices", type=int, default=8)
+    args = p.parse_args()
+
+    # request a virtual device mesh BEFORE jax initializes (no-op on a pod
+    # that already has real chips)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={args.cpu_devices}")
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from incubator_mxnet_tpu.parallel.ring_attention import (
+        ring_self_attention_sharded)
+
+    devices = jax.devices()
+    if len(devices) < args.sp:
+        print(f"need {args.sp} devices, have {len(devices)}; "
+              "set --sp or --cpu-devices")
+        return
+    mesh = Mesh(np.array(devices[:args.sp]), axis_names=("sp",))
+
+    B, H, S, D = 2, args.heads, args.seq_len, args.d_head
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32) * 0.1)
+    k = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32) * 0.1)
+    v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32) * 0.1)
+
+    # shard the SEQUENCE axis: each device owns S/sp tokens
+    shard = NamedSharding(mesh, P(None, "sp", None, None))
+    qs, ks, vs = (jax.device_put(t, shard) for t in (q, k, v))
+
+    t0 = time.perf_counter()
+    out = ring_self_attention_sharded(qs, ks, vs, mesh, axis_name="sp",
+                                      causal=args.causal)
+    out.block_until_ready()
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = ring_self_attention_sharded(qs, ks, vs, mesh, axis_name="sp",
+                                      causal=args.causal)
+    out.block_until_ready()
+    ring_s = time.perf_counter() - t0
+
+    # oracle: dense attention on one device
+    def dense(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+        if args.causal:
+            mask = jnp.tril(jnp.ones((S, S), bool))
+            s = jnp.where(mask[None, None], s, -1e30)
+        return jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
+
+    ref = jax.jit(dense)(q, k, v)
+    err = float(jnp.max(jnp.abs(out - ref)))
+    assert err < 2e-5, f"ring != dense, max err {err}"
+
+    blk = S // args.sp
+    per_dev_scores = blk * blk * 4 / 1e6   # one (q-block x k-block) tile
+    full_scores = S * S * 4 / 1e6
+    print(f"long_context_ring OK seq={S} sp={args.sp} "
+          f"max_err={err:.2e} step={ring_s*1000:.1f}ms "
+          f"(compile {compile_s:.1f}s); peak score buffer "
+          f"{per_dev_scores:.2f}MB/device vs {full_scores:.1f}MB dense")
+
+
+if __name__ == "__main__":
+    main()

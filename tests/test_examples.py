@@ -1,0 +1,54 @@
+"""Example smoke tests: every worked example must run end-to-end at toy
+scale (the reference CI runs example scripts the same way,
+ref: ci/docker/runtime_functions.sh example sections)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_DRIVER = """
+import sys, runpy
+import jax
+jax.config.update("jax_platforms", "cpu")
+script = sys.argv[1]
+sys.argv = sys.argv[1:]
+runpy.run_path(script, run_name="__main__")
+"""
+
+
+def _run(example, *args, timeout=420):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    out = subprocess.run(
+        [sys.executable, "-c", _DRIVER, os.path.join(REPO, "examples", example),
+         *args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+    assert out.returncode == 0, f"stdout:\n{out.stdout[-3000:]}\nstderr:\n{out.stderr[-3000:]}"
+    return out.stdout
+
+
+def test_dcgan():
+    log = _run("dcgan.py", "--iters", "8", "--batch-size", "8")
+    assert "dcgan OK" in log
+
+
+def test_matrix_factorization():
+    log = _run("matrix_factorization.py", "--epochs", "2",
+               "--samples", "1024", "--num-users", "128",
+               "--num-items", "64")
+    assert "matrix_factorization OK" in log
+    assert "sparse rows/step" in log
+
+
+def test_long_context_ring():
+    log = _run("long_context_ring.py", "--seq-len", "256", "--sp", "8")
+    assert "long_context_ring OK" in log
+
+
+def test_long_context_ring_causal():
+    log = _run("long_context_ring.py", "--seq-len", "256", "--sp", "4",
+               "--causal")
+    assert "long_context_ring OK" in log
