@@ -1,11 +1,13 @@
 """ONNX exchange (ref: python/mxnet/contrib/onnx/ — mx2onnx export driver
 `export_model` and onnx2mx `import_model`).
 
-Gated on the `onnx` package (not bundled in this environment); the mapping
-layer itself is real and covered by the serializer-independent graph walk.
-For TPU-native deployment the first-class path is `incubator_mxnet_tpu.deploy`
-(AOT StableHLO artifacts — XLA is the inference engine); ONNX here serves
-interop with third-party runtimes, same as the reference.
+Self-contained: `proto.py` implements the ONNX protobuf wire format
+directly, so import AND export work without the `onnx` package and the
+emitted files are standard ONNX. For TPU-native deployment the first-class
+path is `incubator_mxnet_tpu.deploy` (AOT StableHLO artifacts — XLA is the
+inference engine); ONNX here serves interop with third-party runtimes,
+same as the reference.
 """
+from . import proto  # noqa: F401
 from .mx2onnx import export_model  # noqa: F401
 from .onnx2mx import import_model  # noqa: F401

@@ -35,6 +35,14 @@ def _pool_attrs(a):
     }
 
 
+def _reduce_attrs(a):
+    ax = a.get("axis")
+    out = {"keepdims": int(bool(a.get("keepdims", False)))}
+    if ax is not None:
+        out["axes"] = [int(ax)] if isinstance(ax, int) else [int(v) for v in ax]
+    return out
+
+
 ONNX_OP_MAP = {
     "Convolution": ("Conv", _conv_attrs),
     "FullyConnected": ("Gemm", lambda a: {"transB": 1}),
@@ -68,7 +76,13 @@ ONNX_OP_MAP = {
     "log": ("Log", lambda a: {}),
     "sqrt": ("Sqrt", lambda a: {}),
     "negative": ("Neg", lambda a: {}),
-    "Pad": ("Pad", lambda a: {"mode": a.get("mode", "constant")}),
+    # MXNet pad_width interleaves (b0,e0,b1,e1,...); ONNX pads groups all
+    # begins then all ends
+    "Pad": ("Pad", lambda a: {
+        "mode": a.get("mode", "constant"),
+        "value": float(a.get("constant_value") or 0.0),
+        "pads": (list(a["pad_width"][0::2]) + list(a["pad_width"][1::2]))
+        if a.get("pad_width") else []}),
     # Gather's ONNX input order is (table, indices); Embedding's is
     # (indices, weight) — reordered in graph_to_onnx_nodes
     "Embedding": ("Gather", lambda a: {}),
@@ -84,9 +98,9 @@ ONNX_OP_MAP = {
         "mode": "nearest" if a.get("sample_type", "nearest") == "nearest"
         else "linear",
         "scales": [1.0, 1.0, float(a["scale"]), float(a["scale"])]}),
-    "mean": ("ReduceMean", lambda a: {}),
-    "sum": ("ReduceSum", lambda a: {}),
-    "max": ("ReduceMax", lambda a: {}),
+    "mean": ("ReduceMean", _reduce_attrs),
+    "sum": ("ReduceSum", _reduce_attrs),
+    "max": ("ReduceMax", _reduce_attrs),
 }
 
 _OPSET = 8  # highest opset where the attribute forms above are all legal
@@ -140,45 +154,100 @@ def graph_to_onnx_nodes(symbol):
     return nodes
 
 
+def _make_attr(name, value):
+    """python value -> AttributeProto (the helper.make_attribute role)."""
+    from . import proto
+
+    A = proto.AttributeProto
+    if isinstance(value, bool):
+        return A(name=name, i=int(value), type=proto.AttrType.INT)
+    if isinstance(value, (int, np.integer)):
+        return A(name=name, i=int(value), type=proto.AttrType.INT)
+    if isinstance(value, (float, np.floating)):
+        return A(name=name, f=float(value), type=proto.AttrType.FLOAT)
+    if isinstance(value, str):
+        return A(name=name, s=value.encode(), type=proto.AttrType.STRING)
+    if isinstance(value, np.ndarray):
+        return A(name=name, t=proto.from_array(value),
+                 type=proto.AttrType.TENSOR)
+    if isinstance(value, (list, tuple)):
+        if all(isinstance(v, (int, np.integer)) for v in value):
+            return A(name=name, ints=[int(v) for v in value],
+                     type=proto.AttrType.INTS)
+        if all(isinstance(v, (int, float, np.floating, np.integer))
+               for v in value):
+            return A(name=name, floats=[float(v) for v in value],
+                     type=proto.AttrType.FLOATS)
+        if all(isinstance(v, str) for v in value):
+            return A(name=name, strings=[v.encode() for v in value],
+                     type=proto.AttrType.STRINGS)
+    raise TypeError(f"cannot encode ONNX attribute {name}={value!r}")
+
+
+def _value_info(name, shape=None, elem_type=None):
+    from . import proto
+
+    t = proto.TypeProtoTensor(
+        elem_type=elem_type or proto.DataType.FLOAT)
+    if shape is not None:
+        t.shape = proto.TensorShapeProto(
+            dim=[proto.TensorShapeDim(dim_value=int(s)) for s in shape])
+    return proto.ValueInfoProto(name=name, type=proto.TypeProto(tensor_type=t))
+
+
 def export_model(sym, params, input_shape, input_type=np.float32,
                  onnx_file_path="model.onnx", verbose=False):
     """Export symbol+params to an ONNX file (ref: export_model.py:83).
 
-    Requires the `onnx` package at call time.
+    Self-contained: the protobuf assembly uses the bundled wire-format
+    codec (contrib/onnx/proto.py), so no `onnx` package is needed and the
+    emitted bytes are standard ONNX readable by any runtime.
     """
-    try:
-        import onnx
-        from onnx import TensorProto, helper, numpy_helper
-    except ImportError as e:  # environment gate, mirrors reference behavior
-        raise ImportError(
-            "onnx package is required for export_model; install onnx or use "
-            "incubator_mxnet_tpu.deploy.export_predictor for the TPU-native "
-            "StableHLO deployment path") from e
+    from . import proto
 
     nodes = graph_to_onnx_nodes(sym)
     args = sym.list_arguments()
     shapes = input_shape if isinstance(input_shape, list) else [input_shape]
     data_names = [n for n in args if n not in params][: len(shapes)]
 
+    # BatchNorm with fix_gamma ignores its gamma; ONNX BatchNormalization
+    # always applies scale, so export those gammas as ones
+    ones_params = set()
+    for node in sym._topo_nodes():
+        if not node.is_var and node.op.name == "BatchNorm":
+            fg = node.attrs.get("fix_gamma", True)
+            if fg is True or str(fg).lower() in ("true", "1"):
+                src, _idx = node.inputs[1]
+                ones_params.add(src.name)
+
     inits, inputs = [], []
     for n, shp in zip(data_names, shapes):
-        inputs.append(helper.make_tensor_value_info(
-            n, TensorProto.FLOAT, list(shp)))
+        inputs.append(_value_info(n, shp))
     for name, arr in params.items():
         a = arr.asnumpy() if hasattr(arr, "asnumpy") else np.asarray(arr)
-        inits.append(numpy_helper.from_array(a, name=name))
+        if name in ones_params:
+            a = np.ones_like(a)
+        inits.append(proto.from_array(a, name=name))
+        # graph.input also lists initializers at older opsets/IR; harmless
+        # at newer ones and maximizes loader compatibility
+        inputs.append(_value_info(name, a.shape))
 
     onnx_nodes = []
     for ot, ins, outs, attrs, name, consts in nodes:
         for cname, carr in consts.items():
-            inits.append(numpy_helper.from_array(carr, name=cname))
-        onnx_nodes.append(helper.make_node(ot, ins, outs, name=name, **attrs))
+            inits.append(proto.from_array(carr, name=cname))
+            inputs.append(_value_info(cname, carr.shape,
+                                      proto.DataType.INT64))
+        onnx_nodes.append(proto.NodeProto(
+            op_type=ot, input=list(ins), output=list(outs), name=name,
+            attribute=[_make_attr(k, v) for k, v in sorted(attrs.items())]))
     last_outs = nodes[-1][2]
-    outputs = [helper.make_tensor_value_info(o, TensorProto.FLOAT, None)
-               for o in last_outs]
-    graph = helper.make_graph(onnx_nodes, "incubator_mxnet_tpu", inputs,
-                              outputs, initializer=inits)
-    model = helper.make_model(
-        graph, opset_imports=[helper.make_opsetid("", _OPSET)])
-    onnx.save(model, onnx_file_path)
+    outputs = [_value_info(o) for o in last_outs]
+    graph = proto.GraphProto(node=onnx_nodes, name="incubator_mxnet_tpu",
+                             initializer=inits, input=inputs, output=outputs)
+    model = proto.ModelProto(
+        ir_version=3, producer_name="incubator_mxnet_tpu",
+        producer_version="2.0", graph=graph,
+        opset_import=[proto.OperatorSetId(domain="", version=_OPSET)])
+    proto.save_model(model, onnx_file_path)
     return onnx_file_path

@@ -28,20 +28,15 @@ def _attrs(node):
 
 def import_model(model_file):
     """Load an ONNX model into (sym, arg_params, aux_params)
-    (ref: import_model.py:31). Requires the `onnx` package."""
-    try:
-        import onnx
-        from onnx import numpy_helper
-    except ImportError as e:
-        raise ImportError(
-            "onnx package is required for import_model") from e
-
+    (ref: import_model.py:31). Self-contained: parses the protobuf wire
+    format directly (contrib/onnx/proto.py), no `onnx` package needed."""
     from ... import symbol as sym
     from ...ndarray import array as nd_array
+    from . import proto
 
-    model = onnx.load(model_file)
+    model = proto.load_model(model_file)
     graph = model.graph
-    params = {init.name: nd_array(numpy_helper.to_array(init))
+    params = {init.name: nd_array(proto.to_array(init))
               for init in graph.initializer}
 
     env = {}  # onnx value name -> Symbol
@@ -127,6 +122,60 @@ def import_model(model_file):
             w = params[node.input[0]]
             return sym.Embedding(ins[1], ins[0], input_dim=int(w.shape[0]),
                                  output_dim=int(w.shape[1]))
+        if t == "Div":
+            return ins[0] / ins[1]
+        if t == "Identity":
+            return ins[0]
+        if t == "Exp":
+            return sym.exp(ins[0])
+        if t == "Log":
+            return sym.log(ins[0])
+        if t == "Sqrt":
+            return sym.sqrt(ins[0])
+        if t == "Neg":
+            return sym.negative(ins[0])
+        if t == "Softplus":
+            return sym.Activation(ins[0], act_type="softrelu")
+        if t == "Softsign":
+            return sym.Activation(ins[0], act_type="softsign")
+        if t == "Clip":
+            return sym.clip(ins[0], a_min=a.get("min", -3.4e38),
+                            a_max=a.get("max", 3.4e38))
+        if t == "Slice":
+            axes = a.get("axes")
+            starts, ends = a["starts"], a["ends"]
+            out = ins[0]
+            for ax, b, e in zip(axes or range(len(starts)), starts, ends):
+                out = sym.slice_axis(out, axis=int(ax), begin=int(b),
+                                     end=None if e >= 2**31 - 1 else int(e))
+            return out
+        if t == "ReduceMean":
+            return sym.mean(ins[0], axis=a.get("axes"),
+                            keepdims=bool(a.get("keepdims", 1)))
+        if t == "ReduceSum":
+            return sym.sum(ins[0], axis=a.get("axes"),
+                           keepdims=bool(a.get("keepdims", 1)))
+        if t == "ReduceMax":
+            return sym.max(ins[0], axis=a.get("axes"),
+                           keepdims=bool(a.get("keepdims", 1)))
+        if t == "LayerNormalization":
+            return sym.LayerNorm(*ins, eps=a.get("epsilon", 1e-5),
+                                 axis=a.get("axis", -1))
+        if t == "Upsample":
+            scales = a.get("scales")
+            return sym.UpSampling(ins[0], scale=int(scales[2]),
+                                  sample_type="nearest")
+        if t == "Pad":
+            mode = a.get("mode", "constant")
+            mode = mode.decode() if isinstance(mode, bytes) else mode
+            pads = list(a.get("pads") or ())
+            n = len(pads) // 2
+            # ONNX groups all begins then all ends; pad_width interleaves
+            pw = []
+            for b, e in zip(pads[:n], pads[n:]):
+                pw += [int(b), int(e)]
+            return sym.Pad(ins[0], mode=mode, pad_width=tuple(pw),
+                           constant_value=float(a.get("value", 0.0)))
         raise NotImplementedError(
             f"ONNX import: unsupported op {t} "
             f"(ref: onnx2mx/_op_translations.py)")

@@ -1,0 +1,438 @@
+"""Self-contained ONNX protobuf wire-format codec
+(ref: python/mxnet/contrib/onnx relies on the `onnx` package; this
+environment has none, so the exchange format is read/written directly —
+the ONNX schema below mirrors onnx/onnx.proto, which is stable public
+wire format).
+
+Only the message subset ONNX models actually use is modeled:
+ModelProto / GraphProto / NodeProto / AttributeProto / TensorProto /
+ValueInfoProto / TypeProto(.Tensor) / TensorShapeProto / OperatorSetId.
+The decoder skips unknown fields (forward-compatible); repeated scalars
+accept both packed and unpacked encodings, and the encoder emits packed
+(proto3 default), so files interoperate with the official `onnx` package
+byte-for-byte.
+"""
+from __future__ import annotations
+
+import struct
+
+# wire types
+_VARINT, _I64, _LEN, _I32 = 0, 1, 2, 5
+
+# field kinds
+INT, FLOAT, DOUBLE, BYTES, STRING, MSG = range(6)
+
+_F32 = struct.Struct("<f")
+_F64 = struct.Struct("<d")
+
+
+def _enc_varint(v):
+    v &= (1 << 64) - 1  # two's-complement for negatives, per protobuf
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _dec_varint(buf, pos):
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    if result >= 1 << 63:  # negative int64
+        result -= 1 << 64
+    return result, pos
+
+
+def _tag(num, wt):
+    return _enc_varint((num << 3) | wt)
+
+
+def _skip(buf, pos, wt):
+    if wt == _VARINT:
+        return _dec_varint(buf, pos)[1]
+    if wt == _I64:
+        return pos + 8
+    if wt == _LEN:
+        n, pos = _dec_varint(buf, pos)
+        return pos + n
+    if wt == _I32:
+        return pos + 4
+    raise ValueError(f"unsupported wire type {wt}")
+
+
+class Message:
+    """Base: subclasses define FIELDS = {num: (name, kind, repeated[, cls])}."""
+
+    FIELDS: dict = {}
+
+    def __init__(self, **kwargs):
+        for num, spec in self.FIELDS.items():
+            name, kind, repeated = spec[0], spec[1], spec[2]
+            default = [] if repeated else (
+                0 if kind == INT else
+                0.0 if kind in (FLOAT, DOUBLE) else
+                b"" if kind == BYTES else
+                "" if kind == STRING else None)
+            setattr(self, name, kwargs.pop(name, default))
+        if kwargs:
+            raise TypeError(f"unknown fields {sorted(kwargs)}")
+
+    # --- encode -----------------------------------------------------------
+    def to_bytes(self):
+        out = bytearray()
+        for num, spec in sorted(self.FIELDS.items()):
+            name, kind, repeated = spec[0], spec[1], spec[2]
+            val = getattr(self, name)
+            if repeated:
+                if not val:
+                    continue
+                if kind == INT:  # packed
+                    payload = b"".join(_enc_varint(int(v)) for v in val)
+                    out += _tag(num, _LEN) + _enc_varint(len(payload)) + payload
+                elif kind == FLOAT:
+                    payload = b"".join(_F32.pack(float(v)) for v in val)
+                    out += _tag(num, _LEN) + _enc_varint(len(payload)) + payload
+                elif kind == DOUBLE:
+                    payload = b"".join(_F64.pack(float(v)) for v in val)
+                    out += _tag(num, _LEN) + _enc_varint(len(payload)) + payload
+                elif kind in (BYTES, STRING):
+                    for v in val:
+                        b = v.encode() if isinstance(v, str) else bytes(v)
+                        out += _tag(num, _LEN) + _enc_varint(len(b)) + b
+                elif kind == MSG:
+                    for v in val:
+                        b = v.to_bytes()
+                        out += _tag(num, _LEN) + _enc_varint(len(b)) + b
+                continue
+            if kind == INT:
+                if val:
+                    out += _tag(num, _VARINT) + _enc_varint(int(val))
+            elif kind == FLOAT:
+                if val:
+                    out += _tag(num, _I32) + _F32.pack(float(val))
+            elif kind == DOUBLE:
+                if val:
+                    out += _tag(num, _I64) + _F64.pack(float(val))
+            elif kind in (BYTES, STRING):
+                b = val.encode() if isinstance(val, str) else bytes(val)
+                if b:
+                    out += _tag(num, _LEN) + _enc_varint(len(b)) + b
+            elif kind == MSG:
+                if val is not None:
+                    b = val.to_bytes()
+                    out += _tag(num, _LEN) + _enc_varint(len(b)) + b
+        return bytes(out)
+
+    # --- decode -----------------------------------------------------------
+    @classmethod
+    def from_bytes(cls, buf):
+        self = cls()
+        pos, end = 0, len(buf)
+        while pos < end:
+            key, pos = _dec_varint(buf, pos)
+            num, wt = key >> 3, key & 0x7
+            spec = cls.FIELDS.get(num)
+            if spec is None:
+                pos = _skip(buf, pos, wt)
+                continue
+            name, kind, repeated = spec[0], spec[1], spec[2]
+            if kind == MSG:
+                n, pos = _dec_varint(buf, pos)
+                sub = spec[3].from_bytes(bytes(buf[pos:pos + n]))
+                pos += n
+                if repeated:
+                    getattr(self, name).append(sub)
+                else:
+                    setattr(self, name, sub)
+            elif kind in (BYTES, STRING):
+                n, pos = _dec_varint(buf, pos)
+                raw = bytes(buf[pos:pos + n])
+                pos += n
+                v = raw.decode("utf-8", "surrogateescape") if kind == STRING else raw
+                if repeated:
+                    getattr(self, name).append(v)
+                else:
+                    setattr(self, name, v)
+            elif kind == INT:
+                if wt == _LEN:  # packed
+                    n, pos = _dec_varint(buf, pos)
+                    stop = pos + n
+                    lst = getattr(self, name)
+                    while pos < stop:
+                        v, pos = _dec_varint(buf, pos)
+                        lst.append(v)
+                else:
+                    v, pos = _dec_varint(buf, pos)
+                    if repeated:
+                        getattr(self, name).append(v)
+                    else:
+                        setattr(self, name, v)
+            elif kind == FLOAT:
+                if wt == _LEN:
+                    n, pos = _dec_varint(buf, pos)
+                    stop = pos + n
+                    lst = getattr(self, name)
+                    while pos < stop:
+                        lst.append(_F32.unpack_from(buf, pos)[0])
+                        pos += 4
+                else:
+                    v = _F32.unpack_from(buf, pos)[0]
+                    pos += 4
+                    if repeated:
+                        getattr(self, name).append(v)
+                    else:
+                        setattr(self, name, v)
+            elif kind == DOUBLE:
+                if wt == _LEN:
+                    n, pos = _dec_varint(buf, pos)
+                    stop = pos + n
+                    lst = getattr(self, name)
+                    while pos < stop:
+                        lst.append(_F64.unpack_from(buf, pos)[0])
+                        pos += 8
+                else:
+                    v = _F64.unpack_from(buf, pos)[0]
+                    pos += 8
+                    if repeated:
+                        getattr(self, name).append(v)
+                    else:
+                        setattr(self, name, v)
+        return self
+
+    def __repr__(self):
+        parts = []
+        for spec in self.FIELDS.values():
+            v = getattr(self, spec[0])
+            if v not in (None, [], "", b"", 0, 0.0):
+                parts.append(f"{spec[0]}={v!r}")
+        return f"{type(self).__name__}({', '.join(parts)})"
+
+
+# --- ONNX data-type enum (TensorProto.DataType) ---------------------------
+class DataType:
+    FLOAT = 1
+    UINT8 = 2
+    INT8 = 3
+    UINT16 = 4
+    INT16 = 5
+    INT32 = 6
+    INT64 = 7
+    STRING = 8
+    BOOL = 9
+    FLOAT16 = 10
+    DOUBLE = 11
+    UINT32 = 12
+    UINT64 = 13
+    BFLOAT16 = 16
+
+
+class AttrType:
+    FLOAT = 1
+    INT = 2
+    STRING = 3
+    TENSOR = 4
+    GRAPH = 5
+    FLOATS = 6
+    INTS = 7
+    STRINGS = 8
+    TENSORS = 9
+    GRAPHS = 10
+
+
+class TensorProto(Message):
+    FIELDS = {
+        1: ("dims", INT, True),
+        2: ("data_type", INT, False),
+        4: ("float_data", FLOAT, True),
+        5: ("int32_data", INT, True),
+        6: ("string_data", BYTES, True),
+        7: ("int64_data", INT, True),
+        8: ("name", STRING, False),
+        9: ("raw_data", BYTES, False),
+        10: ("double_data", DOUBLE, True),
+        11: ("uint64_data", INT, True),
+    }
+
+
+class TensorShapeDim(Message):
+    FIELDS = {
+        1: ("dim_value", INT, False),
+        2: ("dim_param", STRING, False),
+    }
+
+
+class TensorShapeProto(Message):
+    FIELDS = {1: ("dim", MSG, True, TensorShapeDim)}
+
+
+class TypeProtoTensor(Message):
+    FIELDS = {
+        1: ("elem_type", INT, False),
+        2: ("shape", MSG, False, TensorShapeProto),
+    }
+
+
+class TypeProto(Message):
+    FIELDS = {1: ("tensor_type", MSG, False, TypeProtoTensor)}
+
+
+class ValueInfoProto(Message):
+    FIELDS = {
+        1: ("name", STRING, False),
+        2: ("type", MSG, False, TypeProto),
+        3: ("doc_string", STRING, False),
+    }
+
+
+class AttributeProto(Message):
+    FIELDS = {
+        1: ("name", STRING, False),
+        2: ("f", FLOAT, False),
+        3: ("i", INT, False),
+        4: ("s", BYTES, False),
+        5: ("t", MSG, False, TensorProto),
+        7: ("floats", FLOAT, True),
+        8: ("ints", INT, True),
+        9: ("strings", BYTES, True),
+        10: ("tensors", MSG, True, TensorProto),
+        20: ("type", INT, False),
+    }
+
+    # mirror the tiny surface onnx2mx reads (a.INT etc.)
+    INT = AttrType.INT
+    FLOAT = AttrType.FLOAT
+    STRING = AttrType.STRING
+    INTS = AttrType.INTS
+    FLOATS = AttrType.FLOATS
+    TENSOR = AttrType.TENSOR
+
+
+class NodeProto(Message):
+    FIELDS = {
+        1: ("input", STRING, True),
+        2: ("output", STRING, True),
+        3: ("name", STRING, False),
+        4: ("op_type", STRING, False),
+        5: ("attribute", MSG, True, AttributeProto),
+        6: ("doc_string", STRING, False),
+        7: ("domain", STRING, False),
+    }
+
+
+class GraphProto(Message):
+    FIELDS = {
+        1: ("node", MSG, True, NodeProto),
+        2: ("name", STRING, False),
+        5: ("initializer", MSG, True, TensorProto),
+        10: ("doc_string", STRING, False),
+        11: ("input", MSG, True, ValueInfoProto),
+        12: ("output", MSG, True, ValueInfoProto),
+        13: ("value_info", MSG, True, ValueInfoProto),
+    }
+
+
+class OperatorSetId(Message):
+    FIELDS = {
+        1: ("domain", STRING, False),
+        2: ("version", INT, False),
+    }
+
+
+class ModelProto(Message):
+    FIELDS = {
+        1: ("ir_version", INT, False),
+        2: ("producer_name", STRING, False),
+        3: ("producer_version", STRING, False),
+        4: ("domain", STRING, False),
+        5: ("model_version", INT, False),
+        6: ("doc_string", STRING, False),
+        7: ("graph", MSG, False, GraphProto),
+        8: ("opset_import", MSG, True, OperatorSetId),
+    }
+
+
+# --- numpy bridge (the numpy_helper role) ---------------------------------
+_NP2ONNX = {
+    "float32": DataType.FLOAT,
+    "float64": DataType.DOUBLE,
+    "float16": DataType.FLOAT16,
+    "bfloat16": DataType.BFLOAT16,
+    "int64": DataType.INT64,
+    "int32": DataType.INT32,
+    "int8": DataType.INT8,
+    "uint8": DataType.UINT8,
+    "bool": DataType.BOOL,
+}
+_ONNX2NP = {
+    DataType.FLOAT: "float32",
+    DataType.DOUBLE: "float64",
+    DataType.FLOAT16: "float16",
+    DataType.INT64: "int64",
+    DataType.INT32: "int32",
+    DataType.INT8: "int8",
+    DataType.UINT8: "uint8",
+    DataType.BOOL: "bool",
+}
+
+
+def from_array(arr, name=""):
+    """np.ndarray -> TensorProto with raw_data (numpy_helper.from_array)."""
+    import numpy as np
+
+    arr = np.ascontiguousarray(arr)
+    key = str(arr.dtype)
+    if key not in _NP2ONNX:
+        raise TypeError(f"unsupported dtype for ONNX export: {arr.dtype}")
+    return TensorProto(dims=list(arr.shape), data_type=_NP2ONNX[key],
+                       raw_data=arr.tobytes(), name=name)
+
+
+def to_array(tensor):
+    """TensorProto -> np.ndarray (numpy_helper.to_array)."""
+    import numpy as np
+
+    if tensor.data_type == DataType.BFLOAT16:
+        import ml_dtypes
+
+        dt = np.dtype(ml_dtypes.bfloat16)
+    else:
+        dt = np.dtype(_ONNX2NP[tensor.data_type])
+    shape = tuple(tensor.dims)
+    if tensor.raw_data:
+        return np.frombuffer(tensor.raw_data, dtype=dt).reshape(shape).copy()
+    if tensor.data_type == DataType.FLOAT and tensor.float_data:
+        return np.asarray(tensor.float_data, np.float32).reshape(shape)
+    if tensor.data_type == DataType.DOUBLE and tensor.double_data:
+        return np.asarray(tensor.double_data, np.float64).reshape(shape)
+    if tensor.data_type == DataType.INT64 and tensor.int64_data:
+        return np.asarray(tensor.int64_data, np.int64).reshape(shape)
+    if tensor.int32_data:
+        if tensor.data_type in (DataType.FLOAT16, DataType.BFLOAT16):
+            # spec: half-precision values travel as uint16 BIT PATTERNS
+            bits = np.asarray(tensor.int32_data, np.int32).astype(np.uint16)
+            return bits.view(dt).reshape(shape).copy()
+        return np.asarray(tensor.int32_data, np.int32).astype(dt).reshape(shape)
+    return np.zeros(shape, dt)
+
+
+def load_model(path_or_bytes):
+    """onnx.load analog."""
+    if isinstance(path_or_bytes, (bytes, bytearray)):
+        return ModelProto.from_bytes(bytes(path_or_bytes))
+    with open(path_or_bytes, "rb") as f:
+        return ModelProto.from_bytes(f.read())
+
+
+def save_model(model, path):
+    with open(path, "wb") as f:
+        f.write(model.to_bytes())

@@ -93,16 +93,20 @@ def test_onnx_graph_walk():
     assert conv_attrs["pads"] == [1, 1, 1, 1]
 
 
-def test_onnx_export_gated_without_onnx():
-    try:
-        import onnx  # noqa: F401
-        pytest.skip("onnx installed; gate not applicable")
-    except ImportError:
-        pass
-    with pytest.raises(ImportError, match="onnx package is required"):
-        mx.contrib.onnx.export_model(_small_net(), {}, (2, 5))
-    with pytest.raises(ImportError, match="onnx package is required"):
-        mx.contrib.onnx.import_model("nope.onnx")
+def test_onnx_works_without_onnx_package(tmp_path):
+    """Export/import are self-contained (bundled protobuf codec) — no
+    `onnx` package needed in either direction."""
+    import os
+
+    net = _small_net()
+    shapes, _, _ = net.infer_shape(data=(2, 5))
+    rng = np.random.RandomState(0)
+    params = {n: nd.array(rng.randn(*s).astype(np.float32))
+              for n, s in zip(net.list_arguments(), shapes) if n != "data"}
+    path = os.path.join(str(tmp_path), "m.onnx")
+    mx.contrib.onnx.export_model(net, params, [(2, 5)], onnx_file_path=path)
+    sym2, args2, aux2 = mx.contrib.onnx.import_model(path)
+    assert set(args2) == set(params)
 
 
 def test_onnx_unsupported_op_message():

@@ -1,0 +1,206 @@
+"""ONNX exchange tests: wire-format codec + export/import round trips
+(ref: tests/python-pytest/onnx/ — the reference validates against onnxruntime;
+here round-trip equality through our own executor plays that role, and the
+codec is additionally checked against hand-assembled protobuf bytes)."""
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, sym
+from incubator_mxnet_tpu.contrib.onnx import export_model, import_model, proto
+
+
+# --- wire format ----------------------------------------------------------
+
+def test_varint_roundtrip():
+    from incubator_mxnet_tpu.contrib.onnx.proto import _dec_varint, _enc_varint
+
+    for v in (0, 1, 127, 128, 300, 2 ** 31, 2 ** 63 - 1, -1, -300):
+        enc = _enc_varint(v)
+        dec, pos = _dec_varint(enc, 0)
+        assert dec == v and pos == len(enc)
+
+
+def test_model_proto_roundtrip():
+    t = proto.from_array(np.arange(6, dtype=np.float32).reshape(2, 3), "w")
+    attr = proto.AttributeProto(name="kernel_shape", ints=[3, 3],
+                                type=proto.AttrType.INTS)
+    node = proto.NodeProto(op_type="Conv", input=["x", "w"], output=["y"],
+                           name="conv0", attribute=[attr])
+    graph = proto.GraphProto(node=[node], name="g", initializer=[t],
+                             input=[proto.ValueInfoProto(name="x")],
+                             output=[proto.ValueInfoProto(name="y")])
+    model = proto.ModelProto(ir_version=3, producer_name="test", graph=graph,
+                             opset_import=[proto.OperatorSetId(version=8)])
+    back = proto.ModelProto.from_bytes(model.to_bytes())
+    assert back.ir_version == 3 and back.producer_name == "test"
+    assert back.opset_import[0].version == 8
+    g = back.graph
+    assert g.node[0].op_type == "Conv"
+    assert g.node[0].input == ["x", "w"]
+    assert list(g.node[0].attribute[0].ints) == [3, 3]
+    np.testing.assert_array_equal(proto.to_array(g.initializer[0]),
+                                  np.arange(6, dtype=np.float32).reshape(2, 3))
+
+
+def test_decoder_skips_unknown_fields():
+    # append an unknown varint field (num 60) and an unknown length-delimited
+    # field (num 61) — decoder must skip both
+    node = proto.NodeProto(op_type="Relu", input=["x"], output=["y"])
+    raw = node.to_bytes()
+    extra = (proto._tag(60, 0) + proto._enc_varint(12345)
+             + proto._tag(61, 2) + proto._enc_varint(3) + b"abc")
+    back = proto.NodeProto.from_bytes(raw + extra)
+    assert back.op_type == "Relu" and back.input == ["x"]
+
+
+def test_unpacked_repeated_ints_accepted():
+    # some writers emit repeated int64 unpacked (one tag per element)
+    raw = b"".join(proto._tag(1, 0) + proto._enc_varint(v) for v in (2, 3, 4))
+    raw += proto._tag(2, 0) + proto._enc_varint(proto.DataType.FLOAT)
+    t = proto.TensorProto.from_bytes(raw)
+    assert list(t.dims) == [2, 3, 4]
+
+
+def test_tensor_float_data_fallback():
+    t = proto.TensorProto(dims=[3], data_type=proto.DataType.FLOAT,
+                          float_data=[1.0, 2.5, -3.0])
+    back = proto.TensorProto.from_bytes(t.to_bytes())
+    np.testing.assert_allclose(proto.to_array(back), [1.0, 2.5, -3.0])
+
+
+# --- export -> import round trips ----------------------------------------
+
+def _random_params(net, data_shape, seed=0):
+    rng = np.random.RandomState(seed)
+    arg_shapes, _, aux_shapes = net.infer_shape(data=data_shape)
+    params = {}
+    for name, shp in zip(net.list_arguments(), arg_shapes):
+        if name == "data":
+            continue
+        params[name] = nd.array(rng.randn(*shp).astype(np.float32) * 0.1)
+    auxs = {}
+    for name, shp in zip(net.list_auxiliary_states(), aux_shapes):
+        auxs[name] = nd.array(
+            np.ones(shp, np.float32) if "var" in name
+            else np.zeros(shp, np.float32))
+    return params, auxs
+
+
+def _forward(net, params, auxs, x):
+    ex = net.bind(mx.cpu(), args={**params, "data": x}, aux_states=auxs)
+    return ex.forward(is_train=False)[0].asnumpy()
+
+
+def _roundtrip(net, data_shape, tmp_path, seed=0):
+    params, auxs = _random_params(net, data_shape, seed)
+    rng = np.random.RandomState(99)
+    x = nd.array(rng.randn(*data_shape).astype(np.float32))
+    ref = _forward(net, params, auxs, x)
+
+    path = os.path.join(str(tmp_path), "model.onnx")
+    export_model(net, {**params, **auxs}, [data_shape],
+                 onnx_file_path=path)
+    sym2, arg2, aux2 = import_model(path)
+    got = _forward(sym2, arg2, aux2, x)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+    return path
+
+
+def test_roundtrip_mlp(tmp_path):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = sym.softmax(net, axis=-1, name="prob")
+    _roundtrip(net, (2, 8), tmp_path)
+
+
+def test_roundtrip_convnet(tmp_path):
+    data = sym.Variable("data")
+    net = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                          name="conv1")
+    net = sym.Activation(net, act_type="relu", name="r1")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                      name="pool1")
+    net = sym.BatchNorm(net, name="bn1")
+    net = sym.Flatten(net, name="flat")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc")
+    _roundtrip(net, (2, 3, 8, 8), tmp_path)
+
+
+def test_roundtrip_structural_ops(tmp_path):
+    data = sym.Variable("data")
+    a = sym.Reshape(data, shape=(2, 12), name="rs")
+    b = sym.transpose(a, axes=(1, 0), name="tr")
+    c = sym.Reshape(b, shape=(2, 12), name="rs2")
+    net = sym.Concat(a, c, dim=1, name="cat")
+    _roundtrip(net, (2, 3, 4), tmp_path)
+
+
+def test_roundtrip_elemwise_and_global_pool(tmp_path):
+    data = sym.Variable("data")
+    c1 = sym.Convolution(data, kernel=(1, 1), num_filter=4, name="c1")
+    c2 = sym.Convolution(data, kernel=(1, 1), num_filter=4, name="c2")
+    s = sym.elemwise_add(c1, c2, name="add")
+    g = sym.Pooling(s, kernel=(1, 1), pool_type="avg", global_pool=True,
+                    name="gap")
+    net = sym.Flatten(g, name="fl")
+    _roundtrip(net, (2, 3, 6, 6), tmp_path)
+
+
+def test_exported_file_parses_with_onnx_if_available(tmp_path):
+    onnx = pytest.importorskip("onnx")
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc")
+    params, auxs = _random_params(net, (2, 8))
+    path = os.path.join(str(tmp_path), "m.onnx")
+    export_model(net, params, [(2, 8)], onnx_file_path=path)
+    m = onnx.load(path)
+    onnx.checker.check_model(m)
+
+
+def test_import_rejects_unsupported_op(tmp_path):
+    node = proto.NodeProto(op_type="Bizarre", input=["x"], output=["y"])
+    graph = proto.GraphProto(
+        node=[node], name="g",
+        input=[proto.ValueInfoProto(name="x")],
+        output=[proto.ValueInfoProto(name="y")])
+    model = proto.ModelProto(ir_version=3, graph=graph)
+    path = os.path.join(str(tmp_path), "bad.onnx")
+    proto.save_model(model, path)
+    with pytest.raises(NotImplementedError, match="Bizarre"):
+        import_model(path)
+
+
+def test_roundtrip_math_and_reduce(tmp_path):
+    data = sym.Variable("data")
+    e = sym.exp(data, name="e")
+    m = sym.mean(e, axis=2, keepdims=True, name="m")
+    c = sym.clip(m, a_min=0.5, a_max=2.0, name="cl")
+    net = sym.log(c, name="lg")
+    _roundtrip(net, (2, 3, 4), tmp_path)
+
+
+def test_roundtrip_slice_layernorm(tmp_path):
+    data = sym.Variable("data")
+    s = sym.slice_axis(data, axis=1, begin=1, end=3, name="sl")
+    net = sym.LayerNorm(s, name="ln")
+    _roundtrip(net, (2, 4, 6), tmp_path)
+
+
+def test_roundtrip_asymmetric_pad(tmp_path):
+    data = sym.Variable("data")
+    net = sym.Pad(data, mode="constant", pad_width=(0, 0, 0, 0, 1, 2, 3, 4),
+                  constant_value=1.5, name="pad")
+    _roundtrip(net, (2, 3, 4, 5), tmp_path)
+
+
+def test_fp16_int32_data_is_bitcast():
+    # ONNX stores raw-less FLOAT16 as uint16 bit patterns in int32_data
+    t = proto.TensorProto(dims=[2], data_type=proto.DataType.FLOAT16,
+                          int32_data=[15360, 49152])  # 1.0, -2.0
+    np.testing.assert_allclose(proto.to_array(t).astype(np.float32),
+                               [1.0, -2.0])
