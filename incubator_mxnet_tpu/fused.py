@@ -100,7 +100,23 @@ class GluonTrainStep:
             self._data_sharding = NamedSharding(mesh, P("data"))
         else:
             self._data_sharding = None
-        self._step = jax.jit(self._make_step(), donate_argnums=(0, 1))
+        self._step_fn = self._make_step()
+        self._step = jax.jit(self._step_fn, donate_argnums=(0, 1))
+
+        def scan_fn(params, states, xs, ys, keys, lrs, ts):
+            def body(carry, inp):
+                p, s = carry
+                x, y, key, lr, t = inp
+                loss, p2, s2 = self._step_fn(p, s, x, y, key, lr, t)
+                return (p2, s2), loss
+
+            (params, states), losses = jax.lax.scan(
+                body, (params, states), (xs, ys, keys, lrs, ts))
+            return losses, params, states
+
+        # one jit wrapper; its cache keys on shapes, so varying K reuses
+        # previously compiled executables
+        self._scan = jax.jit(scan_fn, donate_argnums=(0, 1))
         self._built = True
 
     @staticmethod
@@ -138,7 +154,7 @@ class GluonTrainStep:
             }
             return loss_data, aux_new
 
-        def step(params, states, x, y, key, lr):
+        def step(params, states, x, y, key, lr, t):
             grad_params = [d for d, m in zip(params, self.grad_mask) if m]
             other_params = {
                 n: d for n, d, m in zip(names, params, self.grad_mask) if not m
@@ -150,7 +166,8 @@ class GluonTrainStep:
             gi = 0
             for i, (n, d, m) in enumerate(zip(names, params, self.grad_mask)):
                 if m:
-                    w, st = self.opt.fused_update(n, d, grads[gi], states[i], lr)
+                    w, st = self.opt.fused_update(n, d, grads[gi], states[i],
+                                                  lr, t=t)
                     gi += 1
                     new_params.append(w)
                     new_states.append(st)
@@ -180,9 +197,50 @@ class GluonTrainStep:
         self.opt.num_update = self._n
         lr = self.opt.lr_scheduler(self._n) if self.opt.lr_scheduler else self.opt.lr
         loss, self._params, self._states = self._step(
-            self._params, self._states, xd, yd, key, jnp.asarray(lr, jnp.float32),
+            self._params, self._states, xd, yd, key,
+            jnp.asarray(lr, jnp.float32),
+            jnp.asarray(float(self._n), jnp.float32),
         )
         return NDArray._from_data(loss)
+
+    def scan_steps(self, xs, ys):
+        """Run K training steps as ONE device program: `lax.scan` over the
+        leading axis of pre-staged batches, params/states threaded through
+        the carry with buffers donated.
+
+        This is the deepest form of the reference's bulked execution
+        (MXNET_EXEC_BULK_EXEC_*): zero host work between steps, so device
+        throughput is independent of dispatch latency (which dominates on
+        remote-attached chips and matters on busy hosts). Feed distinct
+        batches stacked on axis 0: xs (K, B, ...), ys (K, B, ...).
+        Returns the K per-step losses as one NDArray.
+        """
+        xd = xs._data if isinstance(xs, NDArray) else jnp.asarray(xs)
+        yd = ys._data if isinstance(ys, NDArray) else jnp.asarray(ys)
+        if not self._built:
+            self._build(NDArray._from_data(xd[0]), NDArray._from_data(yd[0]))
+        k = int(xd.shape[0])
+        if self._data_sharding is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            stacked = NamedSharding(self.mesh, P(None, "data"))
+            xd = jax.device_put(xd, stacked)
+            yd = jax.device_put(yd, stacked)
+        elif self.device is not None:
+            xd = jax.device_put(xd, self.device)
+            yd = jax.device_put(yd, self.device)
+        keys = jnp.stack([_global_random.next_key() for _ in range(k)])
+        lrs, ts = [], []
+        for _ in range(k):
+            self._n += 1
+            lrs.append(self.opt.lr_scheduler(self._n)
+                       if self.opt.lr_scheduler else self.opt.lr)
+            ts.append(float(self._n))
+        self.opt.num_update = self._n
+        losses, self._params, self._states = self._scan(
+            self._params, self._states, xd, yd, keys,
+            jnp.asarray(lrs, jnp.float32), jnp.asarray(ts, jnp.float32))
+        return NDArray._from_data(losses)
 
     def sync_params(self):
         """Write current param values back into the net's Parameters."""

@@ -640,7 +640,7 @@ def get_updater(optimizer):
 # ---------------------------------------------------------------------------
 
 
-def _sgd_fused(self, name, weight, grad, state, lr):
+def _sgd_fused(self, name, weight, grad, state, lr, t=None):
     g = grad * self.rescale_grad
     if self.clip_gradient:
         g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
@@ -657,7 +657,7 @@ SGD.fused_update = _sgd_fused
 LBSGD.fused_update = _sgd_fused
 
 
-def _nag_fused(self, name, weight, grad, state, lr):
+def _nag_fused(self, name, weight, grad, state, lr, t=None):
     g = grad * self.rescale_grad
     if self.clip_gradient:
         g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
@@ -671,13 +671,18 @@ def _nag_fused(self, name, weight, grad, state, lr):
 NAG.fused_update = _nag_fused
 
 
-def _adam_fused(self, name, weight, grad, state, lr):
+def _adam_fused(self, name, weight, grad, state, lr, t=None):
     g = grad * self.rescale_grad
     if self.clip_gradient:
         g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
     g = g + self.wd * weight
     mean, var = state
-    t = jnp.maximum(jnp.asarray(float(self.num_update)), 1.0)
+    # t is a traced per-step input when driven by GluonTrainStep (so K
+    # scanned steps each see their own update count); fall back to the
+    # eager counter otherwise
+    if t is None:
+        t = float(self.num_update)
+    t = jnp.maximum(jnp.asarray(t, jnp.float32), 1.0)
     new_mean = self.beta1 * mean + (1 - self.beta1) * g
     new_var = self.beta2 * var + (1 - self.beta2) * jnp.square(g)
     coef1 = 1.0 - self.beta1 ** t
