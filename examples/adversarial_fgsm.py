@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Fast Gradient Sign Method adversarial examples
+(ref: example/adversary/adversary_generation.ipynb — role: gradients with
+respect to the INPUT via the autograd tape, not just parameters).
+
+Trains a small classifier on synthetic digits, then perturbs test inputs
+along sign(dL/dx) and shows accuracy collapsing with epsilon.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.gluon import nn
+
+
+def make_data(rng, proto, n, noise=0.15):
+    """Noisy samples around SHARED class prototypes (train/test must draw
+    from the same class-conditional distribution)."""
+    y = rng.randint(0, 10, n)
+    X = proto[y] + noise * rng.randn(n, 1, 16, 16).astype(np.float32)
+    return X.astype(np.float32), y.astype(np.float32)
+
+
+def accuracy(net, X, y):
+    out = net(nd.array(X)).asnumpy()
+    return float((out.argmax(1) == y).mean())
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--epsilon", type=float, default=0.4)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    log = logging.getLogger("fgsm")
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    proto = rng.rand(10, 1, 16, 16).astype(np.float32)
+    Xtr, ytr = make_data(rng, proto, 2048)
+    Xte, yte = make_data(rng, proto, 512)
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2), nn.Flatten(), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 5e-3})
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    nb = len(Xtr) // args.batch_size
+    for epoch in range(args.epochs):
+        perm = rng.permutation(len(Xtr))
+        for b in range(nb):
+            sel = perm[b * args.batch_size:(b + 1) * args.batch_size]
+            with autograd.record():
+                loss = L(net(nd.array(Xtr[sel])), nd.array(ytr[sel]))
+            loss.backward()
+            trainer.step(args.batch_size)
+        log.info("epoch %d clean acc %.3f", epoch, accuracy(net, Xte, yte))
+
+    clean_acc = accuracy(net, Xte, yte)
+
+    # FGSM: x_adv = x + eps * sign(dL/dx) — gradient w.r.t. the INPUT
+    x = nd.array(Xte)
+    x.attach_grad()
+    with autograd.record():
+        loss = L(net(x), nd.array(yte))
+    loss.backward()
+    x_adv = np.clip(Xte + args.epsilon * np.sign(x.grad.asnumpy()), 0, 1.5)
+    adv_acc = accuracy(net, x_adv, yte)
+
+    log.info("clean acc %.3f -> adversarial acc %.3f (eps=%.2f)",
+             clean_acc, adv_acc, args.epsilon)
+    assert clean_acc > 0.9, clean_acc
+    assert adv_acc < clean_acc - 0.2, (clean_acc, adv_acc)
+    print(f"adversarial_fgsm OK clean={clean_acc:.3f} adv={adv_acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Denoising autoencoder with tied evaluation (ref: example/autoencoder/ —
+role: unsupervised reconstruction training, encoder/decoder composition,
+using the same Trainer/loss machinery as supervised nets)."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.gluon import nn
+
+
+class AutoEncoder(gluon.HybridBlock):
+    def __init__(self, dims=(64, 16), in_dim=256, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.encoder = nn.HybridSequential()
+            for d in dims:
+                self.encoder.add(nn.Dense(d, activation="relu"))
+            self.decoder = nn.HybridSequential()
+            for d in list(reversed(dims[:-1])) + [in_dim]:
+                self.decoder.add(nn.Dense(d))
+
+    def encode(self, x):
+        return self.encoder(x)
+
+    def hybrid_forward(self, F, x):
+        return self.decoder(self.encoder(x))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--noise", type=float, default=0.2)
+    args = p.parse_args()
+    if args.epochs < 2:
+        p.error("--epochs must be >= 2 (the final loss is compared "
+                "against epoch 0's)")
+    logging.basicConfig(level=logging.INFO)
+    log = logging.getLogger("ae")
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    # data on a low-dim manifold: random 8-D codes through a fixed basis
+    basis = rng.randn(8, 256).astype(np.float32)
+    codes = rng.randn(4096, 8).astype(np.float32)
+    X = np.tanh(codes @ basis)
+
+    net = AutoEncoder()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    L = gluon.loss.L2Loss()
+
+    nb = len(X) // args.batch_size
+    first = None
+    for epoch in range(args.epochs):
+        perm = rng.permutation(len(X))
+        tot = 0.0
+        for b in range(nb):
+            sel = perm[b * args.batch_size:(b + 1) * args.batch_size]
+            clean = X[sel]
+            noisy = clean + args.noise * rng.randn(*clean.shape).astype(np.float32)
+            with autograd.record():
+                recon = net(nd.array(noisy))
+                loss = L(recon, nd.array(clean))
+            loss.backward()
+            trainer.step(args.batch_size)
+            tot += float(loss.mean().asscalar())
+        mse = tot / nb
+        if first is None:
+            first = mse
+        log.info("epoch %d reconstruction L2 %.4f", epoch, mse)
+
+    assert mse < first * 0.5, (first, mse)
+    z = net.encode(nd.array(X[:4]))
+    assert z.shape == (4, 16)
+    print(f"autoencoder OK l2={mse:.4f} (from {first:.4f}) code_dim={z.shape[1]}")
+
+
+if __name__ == "__main__":
+    main()

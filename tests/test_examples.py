@@ -52,3 +52,18 @@ def test_long_context_ring_causal():
     log = _run("long_context_ring.py", "--seq-len", "256", "--sp", "4",
                "--causal")
     assert "long_context_ring OK" in log
+
+
+def test_adversarial_fgsm():
+    log = _run("adversarial_fgsm.py", "--epochs", "4")
+    assert "adversarial_fgsm OK" in log
+
+
+def test_autoencoder():
+    log = _run("autoencoder.py", "--epochs", "3")
+    assert "autoencoder OK" in log
+
+
+def test_super_resolution():
+    log = _run("super_resolution.py", "--epochs", "4")
+    assert "super_resolution OK" in log
