@@ -1,15 +1,21 @@
-"""Automatic mixed precision (ref: python/mxnet/contrib/amp precursor).
+"""Automatic mixed precision
+(ref: python/mxnet/contrib/amp/amp.py — init:87, init_trainer:338,
+scale_loss:311, loss_scaler.py DynamicLossScaler).
 
-TPU-native stance: bfloat16 is the native MXU dtype — no loss scaling is
-required (unlike fp16 on the reference's GPUs). `convert_model` /
-`convert_block` cast parameters and compute to bf16 while keeping
-normalization statistics and optimizer state in fp32.
+TPU-native stance: bfloat16 is the native MXU dtype and shares fp32's
+exponent range, so TPU training normally needs NO loss scaling — cast with
+`convert_block` and train. The dynamic loss scaler exists for float16
+workflows (parity with the reference, and fp16 artifacts imported from
+GPU-land): scale grows 2x every `scale_window` clean steps and halves on
+any non-finite gradient, with the overflowed step skipped — the
+reference's exact policy.
 """
 from __future__ import annotations
 
-import numpy as np
+import jax.numpy as jnp
 
-__all__ = ["init", "convert_block", "convert_model", "scale_loss"]
+__all__ = ["init", "init_trainer", "convert_block", "convert_model",
+           "scale_loss", "DynamicLossScaler"]
 
 _F32_KEEP_SUFFIXES = ("gamma", "beta", "running_mean", "running_var",
                       "moving_mean", "moving_var")
@@ -21,7 +27,7 @@ def init(target_dtype="bfloat16"):
 
 
 def convert_block(block, target_dtype="bfloat16"):
-    """Cast a Gluon block to bf16 compute, fp32 norm statistics."""
+    """Cast a Gluon block to reduced-precision compute, fp32 norm stats."""
     for name, p in block.collect_params().items():
         if name.endswith(_F32_KEEP_SUFFIXES):
             continue
@@ -40,16 +46,86 @@ def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16"):
     return sym, new_args, dict(aux_params)
 
 
-class scale_loss:
-    """Loss-scaling context (ref: amp.scale_loss). On TPU bf16 has fp32-range
-    exponent so scale defaults to 1; kept for fp16 compat."""
+class DynamicLossScaler:
+    """Grow-on-success / halve-on-overflow loss scale
+    (ref: contrib/amp/loss_scaler.py — init_scale 2**16, scale_factor 2,
+    scale_window 2000)."""
 
-    def __init__(self, loss, optimizer_or_trainer, scale=1.0):
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000, min_scale=1.0):
+        self.loss_scale = float(init_scale)
+        self.scale_factor = float(scale_factor)
+        self.scale_window = int(scale_window)
+        self.min_scale = float(min_scale)
+        self._unskipped = 0
+
+    def has_overflow(self, grads):
+        """True if any gradient contains a non-finite value. All per-grad
+        flags are OR-ed on device so only ONE host sync happens per step
+        (the reference's multi_all_finite plays the same role)."""
+        flag = None
+        for g in grads:
+            if hasattr(g, "data") and hasattr(g, "indices"):  # row_sparse
+                data = g.data._data
+            elif hasattr(g, "_data"):
+                data = g._data
+            else:
+                data = jnp.asarray(g)
+            bad = ~jnp.isfinite(data).all()
+            flag = bad if flag is None else flag | bad
+        return bool(flag) if flag is not None else False
+
+    def update_scale(self, overflow):
+        if overflow:
+            self.loss_scale = max(self.min_scale,
+                                  self.loss_scale / self.scale_factor)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self.scale_window:
+                self.loss_scale *= self.scale_factor
+                self._unskipped = 0
+
+
+def init_trainer(trainer, scaler=None):
+    """Attach dynamic loss scaling to a Gluon Trainer
+    (ref: amp.init_trainer:338): scale_loss multiplies the loss by the
+    live scale; the trainer unscales through rescale_grad and SKIPS any
+    step whose gradients overflowed, halving the scale."""
+    trainer._amp_scaler = scaler or DynamicLossScaler()
+    return trainer._amp_scaler
+
+
+class scale_loss:
+    """Context manager yielding the scaled loss (ref: amp.scale_loss:311).
+
+    with amp.scale_loss(loss, trainer) as scaled:
+        scaled.backward()
+    trainer.step(batch)   # unscales via rescale_grad; skips on overflow
+    """
+
+    def __init__(self, loss, optimizer_or_trainer, scale=None):
+        self._trainer = optimizer_or_trainer
+        scaler = getattr(optimizer_or_trainer, "_amp_scaler", None)
+        self._scale = (scale if scale is not None
+                       else (scaler.loss_scale if scaler else 1.0))
         self._loss = loss
-        self._scale = scale
 
     def __enter__(self):
-        return self._loss * self._scale if self._scale != 1.0 else self._loss
+        # record the scale actually applied so the trainer unscales by the
+        # same factor even when the caller overrode it
+        if hasattr(self._trainer, "_amp_scaler"):
+            self._trainer._amp_applied_scale = self._scale
+        if self._scale == 1.0:
+            return self._loss
+        from .. import autograd as _ag
+
+        # the multiply must land on the tape even when the user scales
+        # outside the record() block (the reference permits both placements)
+        with _ag._AutogradScope(recording=True):
+            if isinstance(self._loss, (list, tuple)):
+                return type(self._loss)(l * self._scale for l in self._loss)
+            return self._loss * self._scale
 
     def __exit__(self, *exc):
         return False

@@ -97,6 +97,26 @@ class Trainer:
                 # merge-and-reset one-shot allreduce (no cross-step carry)
                 self._kvstore.pushpull(i, g, out=g)
 
+    def _amp_pre_update(self, rescale):
+        """(skip_step, effective_rescale): overflow-skip + unscale factor
+        for loss-scaled gradients (ref: contrib/amp loss-scaled step).
+        Always runs when a scaler is attached — even at loss_scale 1.0 the
+        overflow check must keep non-finite gradients out of the weights."""
+        scaler = getattr(self, "_amp_scaler", None)
+        if scaler is None:
+            return False, rescale
+        # scale_loss records the scale it actually applied (a user may
+        # override it); fall back to the live scaler value
+        applied = getattr(self, "_amp_applied_scale", None)
+        if applied is None:
+            applied = scaler.loss_scale
+        if scaler.has_overflow([p.grad() for p in self._params
+                                if p._data is not None]):
+            scaler.update_scale(True)
+            return True, rescale
+        scaler.update_scale(False)
+        return False, rescale / applied
+
     def step(self, batch_size, ignore_stale_grad=False):
         """(ref: trainer.py:298)"""
         # rescale BEFORE _init_kvstore: server mode pickles the optimizer at
@@ -105,9 +125,12 @@ class Trainer:
         self._optimizer.rescale_grad = rescale
         self._init_kvstore()
         if self._kvstore is not None and self._update_on_kvstore:
-            if rescale != self._kv_shipped_rescale:
-                self._ship_optimizer_attrs(rescale_grad=rescale)
-                self._kv_shipped_rescale = rescale
+            skip, eff = self._amp_pre_update(rescale)
+            if skip:
+                return
+            if eff != self._kv_shipped_rescale:
+                self._ship_optimizer_attrs(rescale_grad=eff)
+                self._kv_shipped_rescale = eff
             # push grads, pull server-updated weights — no local update
             for i, p in enumerate(self._params):
                 self._kvstore.push(i, p.grad())
@@ -115,6 +138,10 @@ class Trainer:
             return
         if self._kvstore is not None:
             self.allreduce_grads()
+        skip, eff = self._amp_pre_update(rescale)
+        if skip:
+            return
+        self._optimizer.rescale_grad = eff
         self._update(ignore_stale_grad)
 
     def update(self, batch_size, ignore_stale_grad=False):
@@ -123,7 +150,11 @@ class Trainer:
             raise ValueError(
                 "update() is not supported when the optimizer runs on the "
                 "kvstore server; call step() (ref: trainer.py:360)")
-        self._optimizer.rescale_grad = self._scale / batch_size
+        rescale = self._scale / batch_size
+        skip, eff = self._amp_pre_update(rescale)
+        if skip:
+            return
+        self._optimizer.rescale_grad = eff
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):

@@ -220,3 +220,154 @@ def test_text_vocabulary_and_embedding(tmp_path):
     np.testing.assert_allclose(vecs[1], 0)
     emb.update_token_vectors("b", np.array([[9.0, 9.0, 9.0]], np.float32))
     np.testing.assert_allclose(emb.idx_to_vec.asnumpy()[4], 9.0)
+
+
+def test_amp_dynamic_loss_scaling_trainer():
+    """Scaled training matches unscaled training exactly (SGD is linear in
+    the gradient), and overflow steps are skipped with the scale halved
+    (ref: contrib/amp loss_scaler.py policy)."""
+    from incubator_mxnet_tpu import autograd, gluon, nd
+    from incubator_mxnet_tpu.contrib import amp
+    from incubator_mxnet_tpu.gluon import nn
+
+    def build():
+        mx.random.seed(9)
+        net = nn.Dense(2, in_units=3)
+        net.initialize(mx.init.Xavier())
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1})
+        return net, tr
+
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(4, 3).astype(np.float32))
+    y = nd.array(rng.rand(4, 2).astype(np.float32))
+    L = gluon.loss.L2Loss()
+
+    net_a, tr_a = build()
+    for _ in range(3):
+        with autograd.record():
+            loss = L(net_a(x), y)
+        loss.backward()
+        tr_a.step(4)
+
+    net_b, tr_b = build()
+    scaler = amp.init_trainer(tr_b, amp.DynamicLossScaler(init_scale=2 ** 10))
+    for _ in range(3):
+        with autograd.record():
+            loss = L(net_b(x), y)
+        with amp.scale_loss(loss, tr_b) as scaled:
+            scaled.backward()
+        tr_b.step(4)
+    for va, vb in zip(net_a.collect_params().values(),
+                      net_b.collect_params().values()):
+        np.testing.assert_allclose(va.data().asnumpy(), vb.data().asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+    assert scaler.loss_scale == 2 ** 10  # no overflow, window not reached
+
+    # overflow: poison the loss -> step skipped, scale halved
+    before = net_b.weight.data().asnumpy().copy()
+    with autograd.record():
+        loss = L(net_b(x * nd.array(np.float32(1e38))), y) * 1e38
+    with amp.scale_loss(loss, tr_b) as scaled:
+        scaled.backward()
+    tr_b.step(4)
+    np.testing.assert_array_equal(net_b.weight.data().asnumpy(), before)
+    assert scaler.loss_scale == 2 ** 9
+
+
+def test_amp_scaler_grows_after_window():
+    from incubator_mxnet_tpu.contrib import amp
+
+    s = amp.DynamicLossScaler(init_scale=4.0, scale_window=3)
+    for _ in range(3):
+        s.update_scale(False)
+    assert s.loss_scale == 8.0
+    s.update_scale(True)
+    assert s.loss_scale == 4.0 and s._unskipped == 0
+
+
+def test_amp_overflow_guard_at_scale_one():
+    """Even at loss_scale==1.0 (fully decayed) a non-finite gradient must
+    be skipped and never written into the weights."""
+    from incubator_mxnet_tpu import autograd, gluon, nd
+    from incubator_mxnet_tpu.contrib import amp
+    from incubator_mxnet_tpu.gluon import nn
+
+    mx.random.seed(3)
+    net = nn.Dense(2, in_units=3)
+    net.initialize(mx.init.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    amp.init_trainer(tr, amp.DynamicLossScaler(init_scale=1.0))
+    L = gluon.loss.L2Loss()
+    before = net.weight.data().asnumpy().copy()
+    x = nd.array(np.full((2, 3), 1e38, np.float32))
+    with autograd.record():
+        loss = L(net(x) * nd.array(np.float32(1e38)), nd.zeros((2, 2)))
+    with amp.scale_loss(loss, tr) as scaled:
+        scaled.backward()
+    tr.step(2)
+    np.testing.assert_array_equal(net.weight.data().asnumpy(), before)
+
+
+def test_amp_explicit_scale_override_unscales_correctly():
+    from incubator_mxnet_tpu import autograd, gluon, nd
+    from incubator_mxnet_tpu.contrib import amp
+    from incubator_mxnet_tpu.gluon import nn
+
+    def build():
+        mx.random.seed(13)
+        net = nn.Dense(2, in_units=3)
+        net.initialize(mx.init.Xavier())
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1})
+        return net, tr
+
+    rng = np.random.RandomState(1)
+    x = nd.array(rng.rand(4, 3).astype(np.float32))
+    y = nd.array(rng.rand(4, 2).astype(np.float32))
+    L = gluon.loss.L2Loss()
+
+    net_a, tr_a = build()
+    with autograd.record():
+        loss = L(net_a(x), y)
+    loss.backward()
+    tr_a.step(4)
+
+    net_b, tr_b = build()
+    amp.init_trainer(tr_b, amp.DynamicLossScaler(init_scale=2 ** 16))
+    with autograd.record():
+        loss = L(net_b(x), y)
+    with amp.scale_loss(loss, tr_b, scale=128.0) as scaled:  # user override
+        scaled.backward()
+    tr_b.step(4)
+    np.testing.assert_allclose(net_a.weight.data().asnumpy(),
+                               net_b.weight.data().asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_amp_manual_update_flow_unscales():
+    """allreduce_grads()+update() must honor the scaler like step()."""
+    from incubator_mxnet_tpu import autograd, gluon, nd
+    from incubator_mxnet_tpu.contrib import amp
+    from incubator_mxnet_tpu.gluon import nn
+
+    mx.random.seed(7)
+    net = nn.Dense(2, in_units=3)
+    net.initialize(mx.init.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    amp.init_trainer(tr, amp.DynamicLossScaler(init_scale=2 ** 8))
+    L = gluon.loss.L2Loss()
+    rng = np.random.RandomState(2)
+    x = nd.array(rng.rand(4, 3).astype(np.float32))
+    y = nd.array(rng.rand(4, 2).astype(np.float32))
+    with autograd.record():
+        loss = L(net(x), y)
+    with amp.scale_loss(loss, tr) as scaled:
+        scaled.backward()
+    w0 = net.weight.data().asnumpy().copy()
+    g = net.weight.grad().asnumpy().copy()
+    tr.allreduce_grads()
+    tr.update(4)
+    expected = w0 - 0.1 * (g / 2 ** 8) / 4
+    np.testing.assert_allclose(net.weight.data().asnumpy(), expected,
+                               rtol=1e-5, atol=1e-6)
