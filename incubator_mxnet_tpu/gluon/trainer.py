@@ -125,12 +125,17 @@ class Trainer:
         self._optimizer.rescale_grad = rescale
         self._init_kvstore()
         if self._kvstore is not None and self._update_on_kvstore:
-            skip, eff = self._amp_pre_update(rescale)
-            if skip:
-                return
-            if eff != self._kv_shipped_rescale:
-                self._ship_optimizer_attrs(rescale_grad=eff)
-                self._kv_shipped_rescale = eff
+            if getattr(self, "_amp_scaler", None) is not None:
+                # per-worker overflow skips + per-worker scales would feed
+                # the SHARED server optimizer inconsistently (partial sums,
+                # racing rescale ships) — refuse rather than corrupt
+                raise NotImplementedError(
+                    "amp loss scaling is not supported with server-side "
+                    "updates (update_on_kvstore); train in allreduce mode "
+                    "or without a loss scaler")
+            if rescale != self._kv_shipped_rescale:
+                self._ship_optimizer_attrs(rescale_grad=rescale)
+                self._kv_shipped_rescale = rescale
             # push grads, pull server-updated weights — no local update
             for i, p in enumerate(self._params):
                 self._kvstore.push(i, p.grad())

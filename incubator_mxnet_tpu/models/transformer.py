@@ -56,6 +56,7 @@ class TransformerConfig:
     n_experts: int = 0  # 0 = dense FFN
     dtype: str = "float32"
     use_flash: bool = False  # Pallas flash-attention kernels for attention
+    use_fused_xent: bool = False  # Pallas fused softmax-xent loss kernel
 
 
 def init_params(cfg: TransformerConfig, seed: int = 0):
@@ -180,9 +181,20 @@ def apply(params, tokens, cfg: TransformerConfig, attn_fn=None):
     return logits, aux / max(cfg.n_layers, 1)
 
 
-def _xent(logits, targets):
+def _xent(logits, targets, fused=False):
+    if fused:
+        return _xent_fused_local(logits, targets)
     logp = jax.nn.log_softmax(logits, axis=-1)
     return -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+
+
+def _xent_fused_local(logits, targets):
+    """Per-device fused loss: Pallas kernel computing max/logsumexp/pick in
+    one VMEM pass — no (B, V) softmax tensor in HBM
+    (ops/pallas_kernels.softmax_xent)."""
+    from ..ops.pallas_kernels import softmax_xent
+
+    return softmax_xent(logits, targets)
 
 
 # ---------------------------------------------------------------------------
@@ -223,7 +235,17 @@ def make_gspmd_train_step(mesh: Mesh, cfg: TransformerConfig, lr=0.1, aux_weight
 
     def loss_fn(p, tokens, targets):
         logits, aux = apply(p, tokens, cfg)
-        return jnp.mean(_xent(logits, targets)) + aux_weight * aux
+        if cfg.use_fused_xent:
+            # pallas_call has no GSPMD partitioning rule — without this
+            # shard_map XLA would replicate the (B, T, V) logits on every
+            # chip to run the kernel; mapping over dp keeps it local
+            losses = jax.shard_map(
+                _xent_fused_local, mesh=mesh,
+                in_specs=(P("dp", None, None), P("dp", None)),
+                out_specs=P("dp", None))(logits, targets)
+        else:
+            losses = _xent(logits, targets)
+        return jnp.mean(losses) + aux_weight * aux
 
     def step(p, tokens, targets):
         loss, grads = jax.value_and_grad(loss_fn)(p, tokens, targets)
@@ -289,7 +311,7 @@ def make_pipeline_train_step(mesh: Mesh, cfg: TransformerConfig, lr=0.1, n_micro
             h = out.reshape(b, t, cfg.d_model)
             h = _ln(h, p["ln_f_g"], p["ln_f_b"])
             logits = h @ p["embed"].T
-            losses = _xent(logits, targets)
+            losses = _xent(logits, targets, cfg.use_fused_xent)
             # replicated-scalar loss: only the device's own shard contributes,
             # psum over every mesh axis; pp ranks all hold identical outputs so
             # gate the contribution to pp rank 0.

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "softmax_xent"]
 
 _NEG_INF = -1e30
 
@@ -254,3 +254,138 @@ def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
     block_k = min(block_k, T)
     assert T % block_q == 0 and T % block_k == 0, "seq len must divide blocks"
     return _flash(q, k, v, causal, block_q, block_k, interpret)
+
+
+# ---------------------------------------------------------------------------
+# Fused softmax cross-entropy (the transformer loss hot path)
+# ---------------------------------------------------------------------------
+#
+# For large vocabularies the naive loss materializes softmax(logits) in HBM
+# (B*V floats) twice — once forward, once backward. These kernels keep each
+# (block_b, V) tile in VMEM: the forward computes max/logsumexp/label-logit
+# in one pass and emits only per-row scalars; the backward regenerates
+# softmax from the saved logsumexp and fuses the one-hot subtraction
+# (ref role: softmax_output-inl.h fused SoftmaxOutput grad kernel).
+
+
+def _xent_fwd_kernel(logits_ref, labels_ref, loss_ref, lse_ref):
+    logits = logits_ref[...].astype(jnp.float32)      # (block_b, V)
+    labels = labels_ref[...]                          # (block_b,)
+    m = jnp.max(logits, axis=-1)
+    lse = m + jnp.log(jnp.sum(jnp.exp(logits - m[:, None]), axis=-1))
+    onehot = (jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+              == labels[:, None])
+    picked = jnp.sum(jnp.where(onehot, logits, 0.0), axis=-1)
+    loss_ref[...] = lse - picked
+    lse_ref[...] = lse
+
+
+def _xent_bwd_kernel(logits_ref, labels_ref, lse_ref, dloss_ref, dlogits_ref):
+    logits = logits_ref[...].astype(jnp.float32)
+    labels = labels_ref[...]
+    lse = lse_ref[...]
+    dloss = dloss_ref[...]
+    p = jnp.exp(logits - lse[:, None])                # softmax, recomputed
+    onehot = (jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+              == labels[:, None])
+    dlogits_ref[...] = ((p - onehot.astype(jnp.float32))
+                        * dloss[:, None]).astype(dlogits_ref.dtype)
+
+
+def _sds(shape, dtype, vma):
+    """ShapeDtypeStruct carrying varying-mesh-axes metadata when the kernel
+    runs inside a shard_map body (jax requires it with check_vma)."""
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _xent_fwd(logits, labels, block_b, interpret, vma):
+    b, v = logits.shape
+    grid = (pl.cdiv(b, block_b),)
+    return pl.pallas_call(
+        _xent_fwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_b, v), lambda i: (i, 0)),
+            pl.BlockSpec((block_b,), lambda i: (i,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_b,), lambda i: (i,)),
+            pl.BlockSpec((block_b,), lambda i: (i,)),
+        ],
+        out_shape=[
+            _sds((b,), jnp.float32, vma),
+            _sds((b,), jnp.float32, vma),
+        ],
+        interpret=interpret,
+    )(logits, labels)
+
+
+def _xent_bwd_call(logits, labels, lse, dloss, block_b, interpret, vma):
+    b, v = logits.shape
+    grid = (pl.cdiv(b, block_b),)
+    return pl.pallas_call(
+        _xent_bwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_b, v), lambda i: (i, 0)),
+            pl.BlockSpec((block_b,), lambda i: (i,)),
+            pl.BlockSpec((block_b,), lambda i: (i,)),
+            pl.BlockSpec((block_b,), lambda i: (i,)),
+        ],
+        out_specs=pl.BlockSpec((block_b, v), lambda i: (i, 0)),
+        out_shape=_sds((b, v), logits.dtype, vma),
+        interpret=interpret,
+    )(logits, labels, lse, dloss)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _xent(logits, labels, block_b, interpret, vma):
+    loss, _ = _xent_fwd(logits, labels, block_b, interpret, vma)
+    return loss
+
+
+def _xent_vjp_fwd(logits, labels, block_b, interpret, vma):
+    loss, lse = _xent_fwd(logits, labels, block_b, interpret, vma)
+    return loss, (logits, labels, lse)
+
+
+def _xent_vjp_bwd(block_b, interpret, vma, res, dloss):
+    logits, labels, lse = res
+    dlogits = _xent_bwd_call(logits, labels, lse, dloss, block_b, interpret,
+                             vma)
+    return dlogits, None
+
+
+_xent.defvjp(_xent_vjp_fwd, _xent_vjp_bwd)
+
+
+def softmax_xent(logits, labels, block_b=8, interpret=None, vma=None):
+    """Fused per-row softmax cross-entropy: logits (..., V) x int labels
+    (...,) -> loss (...,). Differentiable (custom VJP regenerates softmax
+    from the saved logsumexp — no (B, V) softmax tensor ever hits HBM).
+    Inside a shard_map body pass `vma` = the mesh axes the data varies
+    over (jax requires the metadata on pallas outputs there)."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    shape = logits.shape[:-1]
+    v = logits.shape[-1]
+    flat = logits.reshape(-1, v)
+    lab = labels.reshape(-1).astype(jnp.int32)
+    block_b = min(block_b, flat.shape[0])
+    if vma is None:
+        # inside a shard_map body the outputs must carry the same
+        # varying-mesh-axes metadata as the traced inputs
+        vma = tuple(getattr(jax.typeof(flat), "vma", ()) or ())
+    if interpret and vma:
+        # interpret-mode Pallas inside shard_map trips jax's vma accounting
+        # in the emulation machinery itself (a CPU-test-only configuration);
+        # use the numerically-identical dense form there. Compiled kernels
+        # (real TPU) take the pallas_call path with vma-tagged outputs.
+        logp = jax.nn.log_softmax(flat.astype(jnp.float32), axis=-1)
+        loss = -jnp.take_along_axis(logp, lab[:, None], axis=-1)[:, 0]
+        return loss.reshape(shape)
+    loss = _xent(flat, lab, block_b, interpret,
+                 tuple(vma) if vma else None)
+    return loss.reshape(shape)

@@ -112,3 +112,154 @@ def test_transformer_flash_option_matches_dense():
     step, p2 = tfm.make_gspmd_train_step(mesh, cfg_f)
     loss, _ = step(p2, tok, tok)
     assert np.isfinite(float(loss))
+
+
+def test_softmax_xent_forward_matches_dense():
+    from incubator_mxnet_tpu.ops.pallas_kernels import softmax_xent
+
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(16, 50).astype(np.float32) * 3)
+    labels = jnp.asarray(rng.randint(0, 50, 16).astype(np.int32))
+    got = softmax_xent(logits, labels, block_b=4, interpret=True)
+    ref = -jax.nn.log_softmax(logits)[jnp.arange(16), labels]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_softmax_xent_grad_matches_dense():
+    from incubator_mxnet_tpu.ops.pallas_kernels import softmax_xent
+
+    rng = np.random.RandomState(1)
+    logits = jnp.asarray(rng.randn(8, 33).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 33, 8).astype(np.int32))
+
+    def f(l):
+        return softmax_xent(l, labels, block_b=8, interpret=True).sum()
+
+    def ref_f(l):
+        return (-jax.nn.log_softmax(l)[jnp.arange(8), labels]).sum()
+
+    g = jax.grad(f)(logits)
+    gr = jax.grad(ref_f)(logits)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_xent_batched_shape_and_bf16():
+    import ml_dtypes
+
+    from incubator_mxnet_tpu.ops.pallas_kernels import softmax_xent
+
+    rng = np.random.RandomState(2)
+    logits = jnp.asarray(rng.randn(2, 5, 17).astype(np.float32)
+                         .astype(ml_dtypes.bfloat16))
+    labels = jnp.asarray(rng.randint(0, 17, (2, 5)).astype(np.int32))
+    loss = softmax_xent(logits, labels, interpret=True)
+    assert loss.shape == (2, 5)
+    assert np.isfinite(np.asarray(loss, np.float32)).all()
+
+
+def test_transformer_fused_xent_matches_dense():
+    """Flagship train step with cfg.use_fused_xent: loss and one-step
+    parameter movement match the dense-loss path."""
+    import numpy as np
+
+    from incubator_mxnet_tpu.models import transformer as tfm
+
+    tok = np.random.RandomState(0).randint(0, 31, (4, 8)).astype(np.int32)
+    tgt = np.random.RandomState(1).randint(0, 31, (4, 8)).astype(np.int32)
+
+    import jax
+    from jax.sharding import Mesh
+
+    results = []
+    for fused in (False, True):
+        cfg = tfm.TransformerConfig(vocab=31, d_model=16, n_heads=2,
+                                    n_layers=2, d_ff=32, max_len=8,
+                                    use_fused_xent=fused)
+        mesh = Mesh(np.array(jax.devices("cpu")[:1]).reshape(1, 1, 1),
+                    axis_names=("dp", "ep", "tp"))
+        step, params = tfm.make_gspmd_train_step(mesh, cfg)
+        loss, params = step(params, tok, tgt)
+        results.append((float(loss), params))
+    (l0, p0), (l1, p1) = results
+    np.testing.assert_allclose(l0, l1, rtol=1e-5)
+    import jax
+
+    for a, b in zip(jax.tree_util.tree_leaves(p0),
+                    jax.tree_util.tree_leaves(p1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-5)
+
+
+def test_pipeline_fused_xent_matches_dense():
+    """shard_map pipeline path with cfg.use_fused_xent: wiring/grad-flow
+    check. NOTE: on CPU this exercises softmax_xent's interpret-in-shard_map
+    dense fallback (the compiled Pallas path needs a real TPU), so it
+    validates composition, not kernel numerics — those are covered by the
+    direct kernel tests above."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from incubator_mxnet_tpu.models import transformer as tfm
+
+    tok = np.random.RandomState(3).randint(0, 29, (4, 8)).astype(np.int32)
+    tgt = np.random.RandomState(4).randint(0, 29, (4, 8)).astype(np.int32)
+    losses = []
+    for fused in (False, True):
+        cfg = tfm.TransformerConfig(vocab=29, d_model=16, n_heads=2,
+                                    n_layers=2, d_ff=32, max_len=8,
+                                    use_fused_xent=fused)
+        mesh = Mesh(np.array(jax.devices("cpu")[:1]).reshape(1, 1, 1),
+                    axis_names=("dp", "sp", "pp"))
+        step, params = tfm.make_pipeline_train_step(mesh, cfg, n_micro=2)
+        loss, _ = step(params, tok, tgt)
+        losses.append(float(loss))
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
+
+
+def test_amp_refused_with_server_kvstore():
+    import pytest
+
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.contrib import amp
+    from incubator_mxnet_tpu.gluon import nn
+    import incubator_mxnet_tpu as mx
+
+    mx.random.seed(0)
+    net = nn.Dense(2, in_units=3)
+    net.initialize(mx.init.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    tr._update_on_kvstore = True
+    tr._kvstore = object.__new__(mx.kvstore.KVStore)  # stand-in store
+    tr._kv_initialized = True
+    amp.init_trainer(tr)
+    with pytest.raises(NotImplementedError, match="server-side"):
+        tr.step(4)
+
+
+def test_gspmd_fused_xent_multidevice_mesh():
+    """use_fused_xent on a REAL 8-device dp mesh: the loss is computed
+    under shard_map (per-device shards; no logits replication), gradients
+    flow, and the loss matches the dense path."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from incubator_mxnet_tpu.models import transformer as tfm
+
+    devs = [d for d in jax.devices() if d.platform == "cpu"][:8]
+    tok = np.random.RandomState(5).randint(0, 23, (8, 8)).astype(np.int32)
+    tgt = np.random.RandomState(6).randint(0, 23, (8, 8)).astype(np.int32)
+    losses = []
+    for fused in (False, True):
+        cfg = tfm.TransformerConfig(vocab=23, d_model=16, n_heads=2,
+                                    n_layers=2, d_ff=32, max_len=8,
+                                    use_fused_xent=fused)
+        mesh = Mesh(np.array(devs).reshape(8, 1, 1),
+                    axis_names=("dp", "ep", "tp"))
+        step, params = tfm.make_gspmd_train_step(mesh, cfg)
+        loss, _ = step(params, tok, tgt)
+        losses.append(float(loss))
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
