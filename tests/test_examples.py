@@ -67,3 +67,9 @@ def test_autoencoder():
 def test_super_resolution():
     log = _run("super_resolution.py", "--epochs", "4")
     assert "super_resolution OK" in log
+
+
+def test_rl_reinforce():
+    log = _run("rl_reinforce.py", "--episodes", "150", "--target", "60",
+               timeout=600)
+    assert "rl_reinforce OK" in log
