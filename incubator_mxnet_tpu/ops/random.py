@@ -86,43 +86,6 @@ def sample_multinomial(data, *, shape=(), get_prob=False, dtype="int32", _rng=No
     return out.astype(_dt(dtype))
 
 
-def _sample_elemwise(name, sampler):
-    @register(name, needs_rng=True, optional=("p2",), no_grad_inputs=("p1", "p2"))
-    def op(p1, p2=None, *, shape=(), dtype="float32", _rng=None):
-        s = tuple(shape) if shape else ()
-        out_shape = p1.shape + s
-        return sampler(_rng, p1, p2, out_shape).astype(_dt(dtype))
-
-    op.__name__ = name
-    return op
-
-
-def _bcast(p, out_shape):
-    return p.reshape(p.shape + (1,) * (len(out_shape) - p.ndim))
-
-
-_sample_elemwise(
-    "_sample_uniform",
-    lambda k, lo, hi, s: _bcast(lo, s) + (_bcast(hi, s) - _bcast(lo, s)) * jax.random.uniform(k, s),
-)
-_sample_elemwise(
-    "_sample_normal",
-    lambda k, mu, sig, s: _bcast(mu, s) + _bcast(sig, s) * jax.random.normal(k, s),
-)
-_sample_elemwise(
-    "_sample_gamma",
-    lambda k, a, b, s: _bcast(b, s) * jax.random.gamma(k, _bcast(a, s) * jnp.ones(s), s),
-)
-_sample_elemwise(
-    "_sample_exponential",
-    lambda k, lam, _unused, s: jax.random.exponential(k, s) / _bcast(lam, s),
-)
-_sample_elemwise(
-    "_sample_poisson",
-    lambda k, lam, _unused, s: jax.random.poisson(k, _bcast(lam, s) * jnp.ones(s), s).astype(jnp.float32),
-)
-
-
 @register("_shuffle", aliases=("shuffle",), needs_rng=True)
 def shuffle(data, *, _rng=None):
     return jax.random.permutation(_rng, data, axis=0)
@@ -131,3 +94,84 @@ def shuffle(data, *, _rng=None):
 @register("_random_bernoulli", aliases=("bernoulli",), needs_rng=True)
 def bernoulli(*, p=0.5, shape=(1,), dtype="float32", _rng=None):
     return jax.random.bernoulli(_rng, p, shape).astype(_dt(dtype))
+
+
+# --- sample_* family: TENSOR distribution parameters, one draw-set per
+#     parameter row (ref: src/operator/random/multisample_op.cc) -----------
+
+
+def _shape_tuple(shape):
+    if shape in (None, "None", ()):
+        return ()
+    return (int(shape),) if isinstance(shape, (int, float)) else tuple(
+        int(s) for s in shape)
+
+
+def _expand(p, shape):
+    """Append singleton dims so per-row params broadcast over the draws."""
+    return p.reshape(tuple(p.shape) + (1,) * len(shape))
+
+
+@register("_sample_uniform", aliases=("sample_uniform",), needs_rng=True,
+          no_grad_inputs=("low", "high"))
+def sample_uniform(low, high, *, shape=(), dtype="float32", _rng=None):
+    shape = _shape_tuple(shape)
+    u = jax.random.uniform(_rng, tuple(low.shape) + shape, dtype=_dt(dtype))
+    return _expand(low, shape) + u * (_expand(high, shape) - _expand(low, shape))
+
+
+@register("_sample_normal", aliases=("sample_normal",), needs_rng=True,
+          no_grad_inputs=("mu", "sigma"))
+def sample_normal(mu, sigma, *, shape=(), dtype="float32", _rng=None):
+    shape = _shape_tuple(shape)
+    z = jax.random.normal(_rng, tuple(mu.shape) + shape, dtype=_dt(dtype))
+    return _expand(mu, shape) + _expand(sigma, shape) * z
+
+
+@register("_sample_gamma", aliases=("sample_gamma",), needs_rng=True,
+          no_grad_inputs=("alpha", "beta"))
+def sample_gamma(alpha, beta, *, shape=(), dtype="float32", _rng=None):
+    shape = _shape_tuple(shape)
+    g = jax.random.gamma(_rng, _expand(alpha, shape),
+                         tuple(alpha.shape) + shape, dtype=_dt(dtype))
+    return _expand(beta, shape) * g
+
+
+@register("_sample_exponential", aliases=("sample_exponential",),
+          needs_rng=True, no_grad_inputs=("lam",))
+def sample_exponential(lam, *, shape=(), dtype="float32", _rng=None):
+    shape = _shape_tuple(shape)
+    e = jax.random.exponential(_rng, tuple(lam.shape) + shape, dtype=_dt(dtype))
+    return e / _expand(lam, shape)
+
+
+@register("_sample_poisson", aliases=("sample_poisson",), needs_rng=True,
+          no_grad_inputs=("lam",))
+def sample_poisson(lam, *, shape=(), dtype="float32", _rng=None):
+    shape = _shape_tuple(shape)
+    return jax.random.poisson(_rng, _expand(lam, shape),
+                              tuple(lam.shape) + shape).astype(_dt(dtype))
+
+
+@register("_sample_negative_binomial", aliases=("sample_negative_binomial",),
+          needs_rng=True, no_grad_inputs=("k", "p"))
+def sample_negative_binomial(k, p, *, shape=(), dtype="float32", _rng=None):
+    shape = _shape_tuple(shape)
+    k1, k2 = jax.random.split(_rng)
+    full = tuple(k.shape) + shape
+    lam = (jax.random.gamma(k1, _expand(k, shape), full)
+           * (1 - _expand(p, shape)) / _expand(p, shape))
+    return jax.random.poisson(k2, lam, full).astype(_dt(dtype))
+
+
+@register("_sample_generalized_negative_binomial",
+          aliases=("sample_generalized_negative_binomial",), needs_rng=True,
+          no_grad_inputs=("mu", "alpha"))
+def sample_gen_neg_binomial(mu, alpha, *, shape=(), dtype="float32", _rng=None):
+    shape = _shape_tuple(shape)
+    k1, k2 = jax.random.split(_rng)
+    full = tuple(mu.shape) + shape
+    r = 1.0 / _expand(alpha, shape)
+    p = r / (r + _expand(mu, shape))
+    lam = jax.random.gamma(k1, r, full) * (1 - p) / p
+    return jax.random.poisson(k2, lam, full).astype(_dt(dtype))

@@ -748,3 +748,101 @@ def histogram(data, *, bin_cnt=10, range=None):
     lo, hi = range if range is not None else (float(data.min()), float(data.max()))
     hist, edges = jnp.histogram(data, bins=bin_cnt, range=(lo, hi))
     return hist.astype(jnp.float32)
+
+
+# --- round-2 op-gap batch (reference ops previously uncovered) ------------
+
+
+@register("hard_sigmoid")
+def hard_sigmoid(data, *, alpha=0.2, beta=0.5):
+    """(ref: src/operator/tensor/elemwise_unary_op_basic.cc hard_sigmoid)."""
+    return jnp.clip(alpha * data + beta, 0.0, 1.0)
+
+
+@register("khatri_rao")
+def khatri_rao(*args):
+    """Column-wise Kronecker product: (n_i, k) inputs -> (prod n_i, k)
+    (ref: src/operator/contrib/krprod.cc khatri_rao)."""
+    out = args[0]
+    for m in args[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, out.shape[-1])
+    return out
+
+
+@register("_ravel_multi_index", aliases=("ravel_multi_index",),
+          no_grad_inputs=("data",))
+def ravel_multi_index(data, *, shape):
+    """(ndim, N) coordinates -> (N,) flat indices for `shape`
+    (ref: src/operator/tensor/ravel.cc). Index math is int32 (jax default):
+    index spaces beyond 2^31-1 elements are rejected rather than silently
+    wrapped."""
+    strides = []
+    acc = 1
+    for dim in tuple(shape)[::-1]:
+        strides.append(acc)
+        acc *= int(dim)
+    if acc >= 2 ** 31:
+        raise ValueError(
+            f"shape {tuple(shape)} has {acc} elements; int32 flat indexing "
+            "overflows beyond 2**31-1")
+    strides = jnp.asarray(strides[::-1], jnp.int32)
+    return jnp.sum(data.astype(jnp.int32) * strides[:, None], axis=0)
+
+
+@register("_unravel_index", aliases=("unravel_index",),
+          no_grad_inputs=("data",))
+def unravel_index(data, *, shape):
+    """(N,) flat indices -> (ndim, N) coordinates (ref: ravel.cc)."""
+    import math
+
+    if math.prod(int(d) for d in shape) >= 2 ** 31:
+        raise ValueError(
+            f"shape {tuple(shape)} exceeds int32 flat-index range")
+    coords = jnp.unravel_index(data.astype(jnp.int32), tuple(shape))
+    return jnp.stack(coords, axis=0).astype(jnp.int32)
+
+
+@register("_square_sum", aliases=("square_sum",))
+def square_sum(data, *, axis=None, keepdims=False):
+    """sum(x^2) in one pass (ref: src/operator/tensor/square_sum-inl.h —
+    the sparse-aware fused square+sum; sparse inputs densify here and the
+    row_sparse fast path lives with the sparse kernels)."""
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.sum(jnp.square(data), axis=ax, keepdims=keepdims)
+
+
+def _split_v2_outputs(attrs):
+    ios = attrs.get("indices_or_sections", 1)
+    if isinstance(ios, (list, tuple)):
+        return len(ios) + 1
+    return int(ios)
+
+
+@register("_split_v2", aliases=("split_v2",), num_outputs=_split_v2_outputs)
+def split_v2(data, *, indices_or_sections=1, axis=0, squeeze_axis=False):
+    """Split by section count OR explicit indices
+    (ref: src/operator/tensor/matrix_op.cc _split_v2)."""
+    ios = indices_or_sections
+    parts = jnp.split(data, list(ios) if isinstance(ios, (list, tuple))
+                      else int(ios), axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if len(parts) > 1 else parts[0]
+
+
+@register("_linalg_gelqf", aliases=("linalg_gelqf",), num_outputs=2)
+def linalg_gelqf(A):
+    """LQ factorization A = L @ Q with Q orthonormal rows
+    (ref: src/operator/tensor/la_op.cc _linalg_gelqf). Computed as the
+    transpose of the QR factorization of A^T — one MXU-friendly qr call."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("_linalg_syevd", aliases=("linalg_syevd",), num_outputs=2)
+def linalg_syevd(A):
+    """Symmetric eigendecomposition: returns (U, L) with A = U^T diag(L) U
+    (ref: la_op.cc _linalg_syevd — note the reference's U holds eigenvectors
+    as ROWS)."""
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w

@@ -330,3 +330,61 @@ def test_upsampling_pad():
     p = nd.pad(nd.array(x), mode="constant", pad_width=(0, 0, 0, 0, 1, 1, 1, 1), constant_value=5)
     assert p.shape == (1, 2, 5, 5)
     assert p.asnumpy()[0, 0, 0, 0] == 5
+
+
+def test_ravel_unravel_roundtrip():
+    """(ref: tests/python/unittest/test_operator.py test_ravel)."""
+    shape = (3, 4, 5)
+    rng = np.random.RandomState(0)
+    coords = np.stack([rng.randint(0, s, 10) for s in shape]).astype(np.float32)
+    flat = nd.ravel_multi_index(nd.array(coords), shape=shape)
+    expect = np.ravel_multi_index(coords.astype(np.int64), shape)
+    np.testing.assert_array_equal(flat.asnumpy(), expect)
+    back = nd.unravel_index(flat, shape=shape)
+    np.testing.assert_array_equal(back.asnumpy(), coords)
+
+
+def test_linalg_gelqf_syevd():
+    rng = np.random.RandomState(1)
+    M = rng.randn(3, 5).astype(np.float32)
+    L, Q = nd.linalg_gelqf(nd.array(M))
+    np.testing.assert_allclose(L.asnumpy() @ Q.asnumpy(), M, atol=1e-5)
+    np.testing.assert_allclose(Q.asnumpy() @ Q.asnumpy().T, np.eye(3),
+                               atol=1e-5)
+    # L is lower-triangular
+    np.testing.assert_allclose(L.asnumpy(), np.tril(L.asnumpy()), atol=1e-6)
+    S = M @ M.T
+    U, lam = nd.linalg_syevd(nd.array(S))
+    # reference layout: rows of U are eigenvectors; A = U^T diag(lam) U
+    np.testing.assert_allclose(
+        U.asnumpy().T @ np.diag(lam.asnumpy()) @ U.asnumpy(), S, atol=1e-4)
+    assert (np.diff(lam.asnumpy()) >= -1e-5).all()  # ascending
+
+
+def test_sample_family_per_row_params():
+    """(ref: multisample_op.cc — one draw-set per parameter row)."""
+    mx.random.seed(0)
+    low = nd.array(np.array([0.0, 10.0], np.float32))
+    high = nd.array(np.array([1.0, 20.0], np.float32))
+    s = nd.sample_uniform(low, high, shape=400).asnumpy()
+    assert s.shape == (2, 400)
+    assert (s[0] >= 0).all() and (s[0] <= 1).all()
+    assert (s[1] >= 10).all() and (s[1] <= 20).all()
+    g = nd.sample_gamma(nd.array(np.array([2.0, 9.0], np.float32)),
+                        nd.array(np.array([1.0, 0.5], np.float32)),
+                        shape=3000).asnumpy()
+    np.testing.assert_allclose(g.mean(axis=1), [2.0, 4.5], rtol=0.15)
+    nb = nd.sample_negative_binomial(
+        nd.array(np.array([5.0], np.float32)),
+        nd.array(np.array([0.5], np.float32)), shape=2000).asnumpy()
+    np.testing.assert_allclose(nb.mean(), 5.0, rtol=0.2)
+
+
+def test_split_v2_indices_and_sections():
+    x = nd.arange(12).reshape((6, 2))
+    parts = nd.split_v2(x, indices_or_sections=(2, 5), axis=0)
+    assert [p.shape for p in parts] == [(2, 2), (3, 2), (1, 2)]
+    halves = nd.split_v2(x, indices_or_sections=2, axis=0)
+    assert [p.shape for p in halves] == [(3, 2), (3, 2)]
+    np.testing.assert_array_equal(
+        np.concatenate([p.asnumpy() for p in parts]), x.asnumpy())
