@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# CI tiers (ref: ci/docker/runtime_functions.sh — unittest / nightly /
+# distributed stages). Usage: ci/run_tests.sh [unit|nightly|dist|examples|all]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+tier="${1:-unit}"
+
+run_unit() {
+    echo "=== unit tier (virtual 8-device CPU mesh) ==="
+    # nightly-class files run (with the big cases enabled) in the
+    # nightly tier — keep each test out of exactly one tier
+    python -m pytest tests/ -q -x --ignore=tests/test_dist.py \
+        --ignore=tests/test_examples.py \
+        --ignore=tests/test_large_array.py \
+        --ignore=tests/test_checkpoint_compat.py
+}
+
+run_dist() {
+    echo "=== distributed tier (multi-process launcher) ==="
+    python -m pytest tests/test_dist.py -q
+}
+
+run_examples() {
+    echo "=== examples tier (toy-scale end-to-end) ==="
+    python -m pytest tests/test_examples.py -q
+}
+
+run_nightly() {
+    echo "=== nightly tier (large tensors, checkpoint compat) ==="
+    MXTPU_NIGHTLY=1 python -m pytest tests/test_large_array.py \
+        tests/test_checkpoint_compat.py -q
+}
+
+case "$tier" in
+    unit)     run_unit ;;
+    dist)     run_dist ;;
+    examples) run_examples ;;
+    nightly)  run_nightly ;;
+    all)      run_unit; run_dist; run_examples; run_nightly ;;
+    *) echo "unknown tier: $tier (unit|nightly|dist|examples|all)"; exit 2 ;;
+esac
+echo "tier '$tier' green"

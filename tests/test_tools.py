@@ -70,3 +70,42 @@ def test_native_im2rec_writes_idx(tmp_path):
                                      str(tmp_path / "d.rec"), "r")
     hdr, _ = recordio.unpack(rec.read_idx(2))
     assert float(hdr.label) == 2.0
+
+
+def test_parse_log_metrics_and_speed():
+    """(ref: tools/parse_log.py — epoch metric extraction)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import parse_log
+
+    lines = [
+        "Epoch[0] Batch [20] Speed: 1500.0 samples/sec accuracy=0.5",
+        "Epoch[0] Batch [40] Speed: 1700.0 samples/sec accuracy=0.6",
+        "Epoch[0] Train-accuracy=0.62",
+        "Epoch[0] Time cost=10.5",
+        "Epoch[0] Validation-accuracy=0.60",
+        "Epoch[1] Train-accuracy=0.81",
+    ]
+    rows = parse_log.parse(lines)
+    assert rows[0]["speed"] == 1600.0
+    assert rows[0]["train-accuracy"] == 0.62
+    assert rows[0]["validation-accuracy"] == 0.60
+    assert rows[0]["time-cost"] == 10.5
+    assert rows[1]["train-accuracy"] == 0.81
+    md = parse_log.render(rows, "markdown")
+    assert md.splitlines()[0].startswith("| epoch |")
+    csv = parse_log.render(rows, "csv")
+    assert csv.splitlines()[0].startswith("epoch,")
+
+
+def test_diagnose_runs_clean():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "diagnose.py")],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert out.returncode == 0, out.stderr[-1500:]
+    assert "Python Info" in out.stdout
+    assert "incubator_mxnet_tpu Info" in out.stdout
+    assert "features" in out.stdout

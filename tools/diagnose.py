@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Environment diagnosis (ref: tools/diagnose.py — platform/version/env
+dump users attach to bug reports; network checks dropped by design in a
+zero-egress environment)."""
+import os
+import platform
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def check_python():
+    print("----------Python Info----------")
+    print("Version      :", platform.python_version())
+    print("Compiler     :", platform.python_compiler())
+    print("Build        :", platform.python_build())
+    print("Arch         :", platform.architecture())
+
+
+def check_os():
+    print("----------System Info----------")
+    print("Platform     :", platform.platform())
+    print("system       :", platform.system())
+    print("node         :", platform.node())
+    print("release      :", platform.release())
+    print("version      :", platform.version())
+
+
+def check_hardware():
+    print("----------Hardware Info----------")
+    print("machine      :", platform.machine())
+    print("processor    :", platform.processor())
+    try:
+        with open("/proc/cpuinfo") as f:
+            n = sum(1 for line in f if line.startswith("processor"))
+        print("cpu cores    :", n)
+    except OSError:
+        pass
+
+
+def check_jax():
+    print("----------JAX / Device Info----------")
+    import jax
+
+    print("jax version  :", jax.__version__)
+    print("backend      :", jax.default_backend())
+    for d in jax.devices():
+        print("device       :", d, f"(platform={d.platform})")
+
+
+def check_framework():
+    print("----------incubator_mxnet_tpu Info----------")
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import config, runtime
+
+    print("version      :", getattr(mx, "__version__", "dev"))
+    print("location     :", os.path.dirname(mx.__file__))
+    feats = runtime.feature_list()
+    on = sorted(f.name for f in feats if f.enabled)
+    print("features     :", ", ".join(on))
+    print("----------Config Knobs (non-default)----------")
+    for name in sorted(config.KNOBS):
+        if os.environ.get(name) is not None:
+            print(f"{name} = {os.environ[name]}")
+
+
+def main():
+    check_python()
+    check_os()
+    check_hardware()
+    try:
+        check_jax()
+    except Exception as e:  # diagnosis must never crash on a broken backend
+        print("jax check failed:", e)
+    try:
+        check_framework()
+    except Exception as e:
+        print("framework check failed:", e)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Parse training logs into per-epoch metric tables
+(ref: tools/parse_log.py — turns `Epoch[3] Validation-accuracy=0.91` /
+Speedometer lines into markdown/csv for quick comparison).
+
+Usage: python tools/parse_log.py train.log [--format csv|markdown]
+"""
+import argparse
+import re
+import sys
+
+# Epoch[12] Train-accuracy=0.93  /  Epoch[12] Validation-accuracy=0.91
+_METRIC = re.compile(
+    r"Epoch\[(\d+)\].*?(Train|Validation)-([A-Za-z0-9_\-]+)=([0-9.eE+\-nan]+)")
+# Epoch[12] Batch [40] Speed: 1234.5 samples/sec
+_SPEED = re.compile(r"Epoch\[(\d+)\].*?Speed:\s*([0-9.]+)\s*samples/sec")
+# Epoch[12] Time cost=12.34
+_TIME = re.compile(r"Epoch\[(\d+)\].*?Time cost=([0-9.]+)")
+
+
+def parse(lines):
+    """-> {epoch: {column: value}} with speed averaged per epoch."""
+    rows = {}
+    speeds = {}
+    for line in lines:
+        m = _METRIC.search(line)
+        if m:
+            ep, phase, name, val = m.groups()
+            rows.setdefault(int(ep), {})[f"{phase.lower()}-{name}"] = float(val)
+            continue
+        m = _SPEED.search(line)
+        if m:
+            speeds.setdefault(int(m.group(1)), []).append(float(m.group(2)))
+            continue
+        m = _TIME.search(line)
+        if m:
+            rows.setdefault(int(m.group(1)), {})["time-cost"] = float(m.group(2))
+    for ep, vals in speeds.items():
+        rows.setdefault(ep, {})["speed"] = sum(vals) / len(vals)
+    return rows
+
+
+def render(rows, fmt):
+    cols = sorted({c for vals in rows.values() for c in vals})
+    header = ["epoch"] + cols
+    lines = []
+    if fmt == "markdown":
+        lines.append("| " + " | ".join(header) + " |")
+        lines.append("|" + "|".join("---" for _ in header) + "|")
+        for ep in sorted(rows):
+            cells = [str(ep)] + [f"{rows[ep].get(c, float('nan')):.6g}"
+                                 for c in cols]
+            lines.append("| " + " | ".join(cells) + " |")
+    else:
+        lines.append(",".join(header))
+        for ep in sorted(rows):
+            cells = [str(ep)] + [f"{rows[ep].get(c, float('nan')):.6g}"
+                                 for c in cols]
+            lines.append(",".join(cells))
+    return "\n".join(lines)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("logfile")
+    p.add_argument("--format", choices=("markdown", "csv"), default="markdown")
+    args = p.parse_args()
+    with open(args.logfile) as f:
+        rows = parse(f)
+    if not rows:
+        print("no epoch metrics found", file=sys.stderr)
+        sys.exit(1)
+    print(render(rows, args.format))
+
+
+if __name__ == "__main__":
+    main()
