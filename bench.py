@@ -196,10 +196,35 @@ def main():
         else:
             errors[dtype] = err
 
+    cache_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "BENCH_CACHE.json")
     note = ""
+    if any(r.get("platform") == "tpu" for r in results.values()):
+        # remember the real-chip measurement: the axon tunnel flaps for
+        # hours at a time, and a later bench run should report the last
+        # true TPU number (labelled) instead of only a CPU fallback
+        try:
+            with open(cache_path, "w") as f:
+                json.dump({"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                               time.gmtime()),
+                           "results": results}, f)
+        except OSError:
+            pass
+    if not any(r.get("platform") == "tpu" for r in results.values()):
+        # nothing measured on the real chip this run (down tunnel, or a
+        # plugin that silently fell back to CPU): prefer the cached on-chip
+        # number, clearly labelled
+        try:
+            with open(cache_path) as f:
+                cached = json.load(f)
+            results = cached["results"]
+            note = (f"TPU backend unavailable at bench time; reporting the "
+                    f"last successful on-chip measurement ({cached['ts']}); ")
+        except (OSError, ValueError, KeyError):
+            pass
     if not results:
-        # accelerator never came up: tiny CPU run so a real number still
-        # exists, clearly labelled.
+        # accelerator never came up and no cached number exists: tiny CPU
+        # run so a real number still exists, clearly labelled.
         r, err = _run_child(
             "float32", attempts=1, timeout=2400,
             extra_env={"JAX_PLATFORMS": "cpu", "BENCH_BATCH": "16",

@@ -26,7 +26,11 @@ def parse(lines):
         m = _METRIC.search(line)
         if m:
             ep, phase, name, val = m.groups()
-            rows.setdefault(int(ep), {})[f"{phase.lower()}-{name}"] = float(val)
+            try:
+                value = float(val)
+            except ValueError:  # malformed value: skip the line, not the file
+                continue
+            rows.setdefault(int(ep), {})[f"{phase.lower()}-{name}"] = value
             continue
         m = _SPEED.search(line)
         if m:
