@@ -1,0 +1,3 @@
+"""gluon.contrib.data (ref: python/mxnet/gluon/contrib/data/)."""
+from .sampler import IntervalSampler  # noqa: F401
+from .text import WikiText2, WikiText103  # noqa: F401

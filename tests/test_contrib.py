@@ -1,5 +1,7 @@
 """Contrib tests: control flow (ref: test_contrib_control_flow.py), custom op
 (ref: test_operator.py custom-op sections), quantization, amp."""
+import os
+
 import numpy as np
 import pytest
 
@@ -7,6 +9,8 @@ import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import nd, autograd
 from incubator_mxnet_tpu.contrib import foreach, while_loop, cond
 from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_foreach_cumsum():
@@ -371,3 +375,76 @@ def test_amp_manual_update_flow_unscales():
     expected = w0 - 0.1 * (g / 2 ** 8) / 4
     np.testing.assert_allclose(net.weight.data().asnumpy(), expected,
                                rtol=1e-5, atol=1e-6)
+
+
+def test_interval_sampler():
+    """(ref: contrib/data/sampler.py docstring example)."""
+    from incubator_mxnet_tpu.gluon.contrib.data import IntervalSampler
+
+    assert list(IntervalSampler(13, 3)) == [0, 3, 6, 9, 12, 1, 4, 7,
+                                            10, 2, 5, 8, 11]
+    assert list(IntervalSampler(13, 3, rollover=False)) == [0, 3, 6, 9, 12]
+    assert len(IntervalSampler(13, 3)) == 13
+    assert len(IntervalSampler(13, 3, rollover=False)) == 5
+
+
+def test_wikitext_language_model_dataset():
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.gluon.contrib.data import WikiText2
+
+    train = WikiText2(segment="train", seq_len=20)
+    x, y = train[0]
+    assert x.shape == (20,) and y.shape == (20,)
+    # label is the next-token shift of data
+    np.testing.assert_array_equal(train._data[0][1:], train._label[0][:-1])
+    # a shared vocab maps the validation split consistently
+    val = WikiText2(segment="val", vocab=train.vocab, seq_len=20)
+    assert len(val) > 0
+    assert int(max(train._data.max(), val._data.max())) < len(train.vocab)
+    # integrates with the DataLoader
+    loader = gluon.data.DataLoader(train, batch_size=4)
+    xb, yb = next(iter(loader))
+    assert xb.shape == (4, 20) and yb.shape == (4, 20)
+
+
+def test_wikitext_local_file_loading(tmp_path):
+    from incubator_mxnet_tpu.gluon.contrib.data import WikiText2
+
+    corpus = "the quick brown fox jumps over the lazy dog " * 50
+    (tmp_path / "wiki.train.tokens").write_text(corpus)
+    ds = WikiText2(root=str(tmp_path), segment="train", seq_len=10)
+    assert len(ds) > 0
+    # the real vocabulary, not the synthetic one
+    assert "fox" in ds.vocab.token_to_idx
+
+
+def test_wikitext_explicit_root_missing_raises(tmp_path):
+    from incubator_mxnet_tpu.gluon.contrib.data import WikiText2
+
+    with pytest.raises(FileNotFoundError):
+        WikiText2(root=str(tmp_path / "nope"), segment="train")
+
+
+def test_wikitext_synthetic_is_cross_process_deterministic():
+    import subprocess
+    import sys
+
+    code = ("import jax; jax.config.update('jax_platforms','cpu');"
+            "import sys; sys.path.insert(0, '%s');"
+            "from incubator_mxnet_tpu.gluon.contrib.data import WikiText2;"
+            "d = WikiText2(segment='val', seq_len=11);"
+            "print(int(d._data.sum()), len(d.vocab))" % REPO)
+    outs = {subprocess.run([sys.executable, "-c", code], text=True,
+                           capture_output=True, timeout=240,
+                           env={**os.environ, "PYTHONHASHSEED": "random"}
+                           ).stdout.strip() for _ in range(2)}
+    assert len(outs) == 1 and "" not in outs, outs
+
+
+def test_interval_sampler_rejects_nonpositive():
+    from incubator_mxnet_tpu.gluon.contrib.data import IntervalSampler
+
+    with pytest.raises(ValueError):
+        IntervalSampler(13, 0)
+    with pytest.raises(ValueError):
+        IntervalSampler(13, -1)
