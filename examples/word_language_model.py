@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Word-level language model on WikiText
+(ref: example/gluon/word_language_model/train.py — LSTM LM with tied
+data/label shift, perplexity eval).
+
+Uses gluon.contrib.data.WikiText2 (local corpus if --data-root is given,
+deterministic synthetic stand-in otherwise) and the scanned LSTM (one
+compiled step regardless of sequence length).
+"""
+import argparse
+import logging
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.gluon.contrib.data import WikiText2
+
+
+class RNNModel(gluon.Block):
+    def __init__(self, vocab_size, embed=64, hidden=128, layers=1, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.embedding = nn.Embedding(vocab_size, embed)
+            self.rnn = gluon.rnn.LSTM(hidden, num_layers=layers)
+            self.decoder = nn.Dense(vocab_size, flatten=False)
+
+    def forward(self, x):
+        # x: (B, T) -> logits (B, T, V); LSTM wants (T, B, C)
+        emb = self.embedding(x).transpose(axes=(1, 0, 2))
+        out = self.rnn(emb)
+        return self.decoder(out.transpose(axes=(1, 0, 2)))
+
+
+def evaluate(net, loader, L):
+    total, count = 0.0, 0
+    for x, y in loader:
+        loss = L(net(x), y)
+        total += float(loss.sum().asscalar())
+        count += loss.size
+    return total / max(count, 1)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--data-root", default=None,
+                   help="dir with wiki.{train,valid}.tokens (synthetic if unset)")
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--seq-len", type=int, default=35)
+    p.add_argument("--lr", type=float, default=3e-3)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    log = logging.getLogger("wordlm")
+
+    mx.random.seed(0)
+    np.random.seed(0)  # DataLoader shuffle order draws from numpy's RNG
+    train_ds = WikiText2(root=args.data_root, segment="train",
+                         seq_len=args.seq_len)
+    val_ds = WikiText2(root=args.data_root, segment="val",
+                       vocab=train_ds.vocab, seq_len=args.seq_len)
+    V = len(train_ds.vocab)
+    log.info("vocab %d, %d train seqs, %d val seqs", V, len(train_ds),
+             len(val_ds))
+
+    train_loader = gluon.data.DataLoader(train_ds, batch_size=args.batch_size,
+                                         shuffle=True, last_batch="discard")
+    val_loader = gluon.data.DataLoader(val_ds, batch_size=args.batch_size,
+                                       last_batch="discard")
+
+    net = RNNModel(V)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    # pre-training baseline so the improvement check works for any epochs
+    first_ppl = math.exp(min(evaluate(net, val_loader, L), 20))
+    log.info("untrained perplexity %.1f", first_ppl)
+    ppl = first_ppl
+    for epoch in range(args.epochs):
+        for x, y in train_loader:
+            with autograd.record():
+                loss = L(net(x), y)
+            loss.backward()
+            trainer.step(x.shape[0])
+        val_loss = evaluate(net, val_loader, L)
+        ppl = math.exp(min(val_loss, 20))
+        log.info("epoch %d  val loss %.3f  perplexity %.1f", epoch,
+                 val_loss, ppl)
+
+    assert ppl < first_ppl, (first_ppl, ppl)
+    assert ppl < V, "model no better than uniform"
+    print(f"word_language_model OK ppl={ppl:.1f} (from {first_ppl:.1f}, "
+          f"uniform={V})")
+
+
+if __name__ == "__main__":
+    main()

@@ -73,3 +73,9 @@ def test_rl_reinforce():
     log = _run("rl_reinforce.py", "--episodes", "150", "--target", "60",
                timeout=600)
     assert "rl_reinforce OK" in log
+
+
+def test_word_language_model():
+    log = _run("word_language_model.py", "--epochs", "2",
+               "--batch-size", "64", timeout=600)
+    assert "word_language_model OK" in log
