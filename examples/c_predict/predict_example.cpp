@@ -1,0 +1,48 @@
+// C++ inference via the RAII wrapper (the cpp-package role)
+// Build:  g++ -std=c++17 predict_example.cpp -I../../include \
+//             -L../../incubator_mxnet_tpu/_native -lmxtpu_predict -o predict_cpp
+// Run:    ./predict_cpp model-predict.mxp [/path/to/pjrt_plugin.so]
+#include <cstdio>
+#include <vector>
+
+#include "mxtpu_predict.hpp"
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s model.mxp [pjrt_plugin.so]\n", argv[0]);
+    return 2;
+  }
+  try {
+    mxtpu::Predictor pred(argv[1], argc > 2 ? argv[2] : nullptr);
+
+    std::printf("inputs: %d outputs: %d\n", pred.NumInputs(),
+                pred.NumOutputs());
+    size_t in_elems = 1;
+    for (int i = 0; i < pred.NumInputs(); ++i) {
+      std::printf("  input %s shape [", pred.InputName(i).c_str());
+      for (int64_t d : pred.InputShape(i)) {
+        std::printf(" %lld", static_cast<long long>(d));
+        if (i == 0) in_elems *= static_cast<size_t>(d);
+      }
+      std::printf(" ]\n");
+    }
+    if (argc <= 2) {
+      std::printf("introspection-only mode (no PJRT plugin given)\n");
+      return 0;
+    }
+
+    std::vector<float> input(in_elems, 0.5f);
+    pred.SetInput(pred.InputName(0), input.data(),
+                  input.size() * sizeof(float));
+    pred.Forward();
+    std::vector<float> out = pred.GetOutputFloat(0);
+    std::printf("output[0..%zu):", out.size());
+    for (size_t i = 0; i < out.size() && i < 8; ++i)
+      std::printf(" %.4f", out[i]);
+    std::printf("\n");
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+  return 0;
+}

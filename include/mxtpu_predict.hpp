@@ -1,0 +1,112 @@
+// mxtpu_predict.hpp — idiomatic C++ wrapper over the C embedding API
+// (the cpp-package role, ref: cpp-package/include/mxnet-cpp/ — instead of
+// wrapping 174 C functions, one RAII class over the 10-function predict
+// ABI; JVM/R/Julia bind the same C surface).
+//
+//   mxtpu::Predictor pred("model-predict.mxp", "/path/libtpu.so");
+//   pred.SetInput("data", img.data(), img.size() * sizeof(float));
+//   pred.Forward();
+//   std::vector<float> probs = pred.GetOutputFloat(0);
+//
+// Errors surface as std::runtime_error carrying MXTpuPredLastError().
+#ifndef MXTPU_PREDICT_HPP_
+#define MXTPU_PREDICT_HPP_
+
+#include <cstdint>
+#include <numeric>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "mxtpu_predict.h"
+
+namespace mxtpu {
+
+class Predictor {
+ public:
+  Predictor(const std::string& artifact_path,
+            const char* pjrt_plugin_path = nullptr) {
+    Check(MXTpuPredCreate(artifact_path.c_str(), pjrt_plugin_path, &h_));
+  }
+  ~Predictor() {
+    if (h_) MXTpuPredFree(h_);
+  }
+  Predictor(const Predictor&) = delete;
+  Predictor& operator=(const Predictor&) = delete;
+  Predictor(Predictor&& other) noexcept : h_(other.h_) { other.h_ = nullptr; }
+  Predictor& operator=(Predictor&& other) noexcept {
+    if (this != &other) {
+      if (h_) MXTpuPredFree(h_);
+      h_ = other.h_;
+      other.h_ = nullptr;
+    }
+    return *this;
+  }
+
+  int NumInputs() const {
+    int n = 0;
+    Check(MXTpuPredNumInputs(Handle(), &n));
+    return n;
+  }
+  int NumOutputs() const {
+    int n = 0;
+    Check(MXTpuPredNumOutputs(Handle(), &n));
+    return n;
+  }
+  std::string InputName(int idx) const {
+    const char* name = nullptr;
+    Check(MXTpuPredInputName(Handle(), idx, &name));
+    return name;
+  }
+  std::vector<int64_t> InputShape(int idx) const {
+    const int64_t* dims = nullptr;
+    int ndim = 0;
+    Check(MXTpuPredInputShape(Handle(), idx, &dims, &ndim));
+    return std::vector<int64_t>(dims, dims + ndim);
+  }
+  std::vector<int64_t> OutputShape(int idx) const {
+    const int64_t* dims = nullptr;
+    int ndim = 0;
+    Check(MXTpuPredOutputShape(Handle(), idx, &dims, &ndim));
+    return std::vector<int64_t>(dims, dims + ndim);
+  }
+
+  void SetInput(const std::string& name, const void* data, size_t nbytes) {
+    Check(MXTpuPredSetInput(Handle(), name.c_str(), data, nbytes));
+  }
+  void Forward() { Check(MXTpuPredForward(Handle())); }
+  void GetOutput(int idx, void* dst, size_t nbytes) {
+    Check(MXTpuPredGetOutput(Handle(), idx, dst, nbytes));
+  }
+
+  // convenience for the common float32 output case
+  std::vector<float> GetOutputFloat(int idx) {
+    auto dims = OutputShape(idx);
+    size_t n = std::accumulate(dims.begin(), dims.end(), size_t{1},
+                               [](size_t a, int64_t b) {
+                                 return a * static_cast<size_t>(b);
+                               });
+    std::vector<float> out(n);
+    GetOutput(idx, out.data(), n * sizeof(float));
+    return out;
+  }
+
+ private:
+  MXTpuPredictorHandle Handle() const {
+    if (!h_)
+      throw std::runtime_error("mxtpu::Predictor used after move");
+    return h_;
+  }
+  static void Check(int rc) {
+    if (rc != 0) {
+      const char* msg = MXTpuPredLastError();
+      throw std::runtime_error(msg ? msg : "mxtpu predict error");
+    }
+  }
+  MXTpuPredictorHandle h_ = nullptr;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_PREDICT_HPP_

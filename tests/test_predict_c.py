@@ -162,3 +162,28 @@ def test_mxp_respects_argument_dce(tmp_path):
         assert n.value == 1  # 'unused_w' must not survive as an arg
     finally:
         lib.MXTpuPredFree(h)
+
+
+def test_cpp_wrapper_builds_and_introspects(artifact, tmp_path):
+    """The C++ RAII wrapper (include/mxtpu_predict.hpp, the cpp-package
+    role) compiles against the C ABI and introspects an artifact."""
+    import subprocess
+
+    prefix, _, _ = artifact
+    assert predict_lib() is not None  # triggers the lazy native build
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(repo, "examples", "c_predict", "predict_example.cpp")
+    exe = str(tmp_path / "predict_cpp")
+    libdir = os.path.join(repo, "incubator_mxnet_tpu", "_native")
+    build = subprocess.run(
+        ["g++", "-std=c++17", src, "-I" + os.path.join(repo, "include"),
+         "-L" + libdir, "-lmxtpu_predict", "-Wl,-rpath," + libdir,
+         "-o", exe],
+        capture_output=True, text=True, timeout=180)
+    assert build.returncode == 0, build.stderr[-2000:]
+    run = subprocess.run([exe, prefix + "-predict.mxp"],
+                         capture_output=True, text=True, timeout=120)
+    assert run.returncode == 0, run.stderr[-1000:]
+    assert "inputs: 1 outputs: 1" in run.stdout
+    assert "input data shape [ 2 5 ]" in run.stdout
+    assert "introspection-only" in run.stdout
