@@ -1,0 +1,224 @@
+"""Caffe converter tests (ref: tools/caffe_converter/ test usage —
+prototxt parse, caffemodel blob read, end-to-end conversion).
+
+Fixtures are self-generated: the prototxt is hand-written text and the
+.caffemodel bytes are assembled with the converter's own protobuf message
+classes (standard wire format, so a real caffemodel parses identically).
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import caffe_converter as cc  # noqa: E402
+
+PROTOTXT = """
+name: "MiniNet"  # a comment
+input: "data"
+input_dim: 1
+input_dim: 3
+input_dim: 8
+input_dim: 8
+layer {
+  name: "conv1"
+  type: "Convolution"
+  bottom: "data"
+  top: "conv1"
+  convolution_param {
+    num_output: 4
+    kernel_size: 3
+    pad: 1
+    stride: 1
+  }
+}
+layer {
+  name: "relu1"
+  type: "ReLU"
+  bottom: "conv1"
+  top: "conv1"
+}
+layer {
+  name: "pool1"
+  type: "Pooling"
+  bottom: "conv1"
+  top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "fc1"
+  type: "InnerProduct"
+  bottom: "pool1"
+  top: "fc1"
+  inner_product_param { num_output: 5 }
+}
+layer {
+  name: "prob"
+  type: "Softmax"
+  bottom: "fc1"
+  top: "prob"
+}
+"""
+
+
+def test_parse_prototxt_structure():
+    net = cc.parse_prototxt(PROTOTXT)
+    assert net["name"] == "MiniNet"
+    assert net["input"] == "data"
+    assert net["input_dim"] == [1, 3, 8, 8]
+    layers = net["layer"]
+    assert [l["name"] for l in layers] == ["conv1", "relu1", "pool1",
+                                           "fc1", "prob"]
+    assert layers[0]["convolution_param"]["num_output"] == 4
+    assert layers[2]["pooling_param"]["pool"] == "MAX"
+
+
+def _make_caffemodel(path, rng):
+    w_conv = rng.randn(4, 3, 3, 3).astype(np.float32)
+    b_conv = rng.randn(4).astype(np.float32)
+    w_fc = rng.randn(5, 64).astype(np.float32)
+    b_fc = rng.randn(5).astype(np.float32)
+
+    def blob(a):
+        return cc.BlobProto(data=[float(v) for v in a.ravel()],
+                            shape=cc.BlobShape(dim=list(a.shape)))
+
+    net = cc.CaffeNet(name="MiniNet", layer=[
+        cc.CaffeLayer(name="conv1", type="Convolution",
+                      blobs=[blob(w_conv), blob(b_conv)]),
+        cc.CaffeLayer(name="fc1", type="InnerProduct",
+                      blobs=[blob(w_fc), blob(b_fc)]),
+    ])
+    with open(path, "wb") as f:
+        f.write(net.to_bytes())
+    return w_conv, b_conv, w_fc, b_fc
+
+
+def test_read_caffemodel_blobs(tmp_path):
+    rng = np.random.RandomState(0)
+    path = str(tmp_path / "net.caffemodel")
+    w_conv, b_conv, w_fc, b_fc = _make_caffemodel(path, rng)
+    blobs = cc.read_caffemodel(path)
+    assert set(blobs) == {"conv1", "fc1"}
+    np.testing.assert_allclose(blobs["conv1"][0], w_conv, rtol=1e-6)
+    np.testing.assert_allclose(blobs["fc1"][1], b_fc, rtol=1e-6)
+
+
+def test_convert_end_to_end(tmp_path):
+    rng = np.random.RandomState(1)
+    prototxt = str(tmp_path / "deploy.prototxt")
+    with open(prototxt, "w") as f:
+        f.write(PROTOTXT)
+    caffemodel = str(tmp_path / "net.caffemodel")
+    w_conv, b_conv, w_fc, b_fc = _make_caffemodel(caffemodel, rng)
+
+    s, args, auxs = cc.convert(prototxt, caffemodel)
+    assert set(args) == {"conv1_weight", "conv1_bias",
+                         "fc1_weight", "fc1_bias"}
+    x = rng.rand(1, 3, 8, 8).astype(np.float32)
+    ex = s.bind(mx.cpu(), args={**{k: nd.array(v.asnumpy())
+                                   for k, v in args.items()},
+                                "data": nd.array(x)})
+    out = ex.forward(is_train=False)[0].asnumpy()
+    assert out.shape == (1, 5)
+    np.testing.assert_allclose(out.sum(), 1.0, rtol=1e-5)  # softmax
+
+    # oracle: numpy re-implementation of the tiny net
+    # manual conv with pad=1
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    conv = np.zeros((1, 4, 8, 8), np.float32)
+    for o in range(4):
+        for c in range(3):
+            for i in range(8):
+                for j in range(8):
+                    conv[0, o, i, j] += np.sum(
+                        xp[0, c, i:i + 3, j:j + 3] * w_conv[o, c])
+        conv[0, o] += b_conv[o]
+    relu = np.maximum(conv, 0)
+    pool = relu.reshape(1, 4, 4, 2, 4, 2).max(axis=(3, 5))
+    fc = pool.reshape(1, -1) @ w_fc.T + b_fc
+    e = np.exp(fc - fc.max())
+    ref = e / e.sum()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_unsupported_layer_raises(tmp_path):
+    bad = 'input: "data"\nlayer { name: "x" type: "Bizarre" bottom: "data" }'
+    p = str(tmp_path / "bad.prototxt")
+    with open(p, "w") as f:
+        f.write(bad)
+    with pytest.raises(NotImplementedError, match="Bizarre"):
+        cc.convert(p)
+
+
+def test_v1_legacy_layer_names_and_blobs(tmp_path):
+    """V1LayerParameter stores name in field 4 — legacy caffemodels must
+    keep their layer names."""
+    w = np.arange(6, dtype=np.float32).reshape(2, 3)
+    layer = cc.CaffeV1Layer(name="ip_legacy", type=14,  # INNER_PRODUCT enum
+                            blobs=[cc.BlobProto(
+                                data=[float(v) for v in w.ravel()],
+                                shape=cc.BlobShape(dim=[2, 3]))])
+    net = cc.CaffeNet(name="old", v1_layers=[layer])
+    path = str(tmp_path / "old.caffemodel")
+    with open(path, "wb") as f:
+        f.write(net.to_bytes())
+    blobs = cc.read_caffemodel(path)
+    assert set(blobs) == {"ip_legacy"}
+    np.testing.assert_allclose(blobs["ip_legacy"][0], w)
+
+
+def test_prototxt_comment_between_key_and_value():
+    net = cc.parse_prototxt("num_output: # filters\n 64")
+    assert net == {"num_output": 64}
+
+
+def test_conv_rect_kernel_and_softmax_axis():
+    net = cc.parse_prototxt("""
+input: "data"
+input_dim: 1
+input_dim: 2
+input_dim: 6
+input_dim: 6
+layer {
+  name: "c" type: "Convolution" bottom: "data" top: "c"
+  convolution_param { num_output: 3 kernel_h: 3 kernel_w: 1 pad_h: 1 }
+}
+layer { name: "p" type: "Softmax" bottom: "c" top: "p" }
+""")
+    assert net["layer"][0]["convolution_param"]["kernel_h"] == 3
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        pt = os.path.join(d, "r.prototxt")
+        with open(pt, "w") as f:
+            f.write("""
+input: "data"
+input_dim: 1
+input_dim: 2
+input_dim: 6
+input_dim: 6
+layer {
+  name: "c" type: "Convolution" bottom: "data" top: "c"
+  convolution_param { num_output: 3 kernel_h: 3 kernel_w: 1 pad_h: 1 }
+}
+layer { name: "sm" type: "Softmax" bottom: "c" top: "sm" }
+""")
+        s, args, auxs = cc.convert(pt)
+        arg_shapes, out_shapes, _ = s.infer_shape(data=(1, 2, 6, 6))
+        # rect kernel: H preserved (pad_h=1, k=3), W shrinks by 0 (k=1)
+        assert out_shapes[0] == (1, 3, 6, 6)
+        # softmax over the CHANNEL axis: channel sums are 1 everywhere
+        rng = np.random.RandomState(0)
+        shapes = dict(zip(s.list_arguments(), arg_shapes))
+        binding = {n: nd.array(rng.rand(*shp).astype(np.float32))
+                   for n, shp in shapes.items()}
+        out = s.bind(mx.cpu(), args=binding).forward(is_train=False)[0]
+        np.testing.assert_allclose(out.asnumpy().sum(axis=1),
+                                   np.ones((1, 6, 6)), rtol=1e-5)

@@ -1,0 +1,391 @@
+#!/usr/bin/env python
+"""Caffe model converter: deploy.prototxt (+ .caffemodel) -> Symbol+params
+(ref: tools/caffe_converter/ — convert_symbol.py's prototxt walk +
+convert_model.py's blob transfer; no caffe/protobuf installation needed:
+the prototxt TEXT format is parsed directly and the binary .caffemodel is
+read with the bundled protobuf wire codec).
+
+Usage:
+    python tools/caffe_converter.py deploy.prototxt [net.caffemodel] out_prefix
+or from Python:
+    sym, arg_params, aux_params = convert(prototxt_path, caffemodel_path)
+"""
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+__all__ = ["parse_prototxt", "read_caffemodel", "convert"]
+
+
+# ---------------------------------------------------------------------------
+# prototxt text-format parser (generic protobuf text -> nested dicts)
+# ---------------------------------------------------------------------------
+
+_TOKEN = re.compile(r"""
+    (?P<brace_open>\{) | (?P<brace_close>\}) |
+    (?P<key>[A-Za-z_][A-Za-z0-9_]*)\s*(?P<colon>:)? |
+    (?P<string>"(?:[^"\\]|\\.)*") |
+    (?P<number>-?\d+\.?\d*(?:[eE][+-]?\d+)?) |
+    (?P<comment>\#[^\n]*)
+""", re.VERBOSE)
+
+
+def _scalar(tok):
+    if tok.startswith('"'):
+        return tok[1:-1]
+    if re.fullmatch(r"-?\d+", tok):
+        return int(tok)
+    try:
+        return float(tok)
+    except ValueError:
+        return tok  # enum identifier, e.g. MAX / AVE / SUM
+
+
+def parse_prototxt(text):
+    """Protobuf text format -> dict; repeated keys collect into lists."""
+    pos = 0
+    root = {}
+    stack = [root]
+    pending_key = None
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if not m:
+            if text[pos].isspace():
+                pos += 1
+                continue
+            raise ValueError(f"prototxt parse error at {text[pos:pos+40]!r}")
+        pos = m.end()
+        if m.group("comment"):
+            continue
+        if m.group("brace_open"):
+            child = {}
+            _insert(stack[-1], pending_key, child)
+            stack.append(child)
+            pending_key = None
+        elif m.group("brace_close"):
+            stack.pop()
+        elif m.group("key"):
+            if pending_key is not None and not m.group("colon"):
+                # enum value written without quotes after `key:`... handled
+                # below via _scalar; here `key` with no colon begins a block
+                pass
+            pending_key = m.group("key")
+            # `key: value` — consume the value token (skipping comments)
+            # unless a `{` follows (block form, with or without colon)
+            if m.group("colon"):
+                look = _skip_ws(text, pos)
+                m2 = _TOKEN.match(text, look)
+                while m2 and m2.group("comment"):
+                    look = _skip_ws(text, m2.end())
+                    m2 = _TOKEN.match(text, look)
+                if m2 and (m2.group("string") or m2.group("number")
+                           or m2.group("key")):
+                    pos = m2.end()
+                    val = (m2.group("string") or m2.group("number")
+                           or m2.group("key"))
+                    _insert(stack[-1], pending_key, _scalar(val))
+                    pending_key = None
+        # strings/numbers outside key context are consumed above
+    return root
+
+
+def _skip_ws(text, pos):
+    while pos < len(text) and text[pos].isspace():
+        pos += 1
+    return pos
+
+
+def _insert(container, key, value):
+    if key is None:
+        raise ValueError("prototxt value without a key")
+    if key in container:
+        if not isinstance(container[key], list):
+            container[key] = [container[key]]
+        container[key].append(value)
+    else:
+        container[key] = value
+
+
+def _aslist(v):
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+# ---------------------------------------------------------------------------
+# .caffemodel binary reader (bundled protobuf wire codec)
+# ---------------------------------------------------------------------------
+
+from incubator_mxnet_tpu.contrib.onnx.proto import (  # noqa: E402
+    FLOAT, INT, MSG, STRING, Message)
+
+
+class BlobShape(Message):
+    FIELDS = {1: ("dim", INT, True)}
+
+
+class BlobProto(Message):
+    FIELDS = {
+        1: ("num", INT, False), 2: ("channels", INT, False),
+        3: ("height", INT, False), 4: ("width", INT, False),
+        5: ("data", FLOAT, True), 7: ("shape", MSG, False, BlobShape),
+    }
+
+
+class CaffeLayer(Message):
+    """LayerParameter (modern): name=1, type=2 (string), blobs=7."""
+
+    FIELDS = {
+        1: ("name", STRING, False),
+        2: ("type", STRING, False),
+        7: ("blobs", MSG, True, BlobProto),
+    }
+
+
+class CaffeV1Layer(Message):
+    """V1LayerParameter (legacy): name=4, type=5 (enum), blobs=6."""
+
+    FIELDS = {
+        4: ("name", STRING, False),
+        5: ("type", INT, False),
+        6: ("blobs", MSG, True, BlobProto),
+    }
+
+
+class CaffeNet(Message):
+    FIELDS = {
+        1: ("name", STRING, False),
+        2: ("v1_layers", MSG, True, CaffeV1Layer),  # V1LayerParameter
+        100: ("layer", MSG, True, CaffeLayer),      # LayerParameter
+    }
+
+
+def read_caffemodel(path):
+    """-> {layer_name: [np.ndarray blobs]} (ref: convert_model.py blob walk)."""
+    with open(path, "rb") as f:
+        net = CaffeNet.from_bytes(f.read())
+    out = {}
+    for layer in list(net.layer) + list(net.v1_layers):
+        blobs = list(layer.blobs)
+        if not blobs:
+            continue
+        arrays = []
+        for b in blobs:
+            data = np.asarray(b.data, np.float32)
+            if b.shape is not None and b.shape.dim:
+                data = data.reshape([int(d) for d in b.shape.dim])
+            elif b.num or b.channels or b.height or b.width:
+                legacy = [max(int(x), 1) for x in
+                          (b.num, b.channels, b.height, b.width)]
+                data = data.reshape(legacy)
+            arrays.append(data)
+        out[layer.name] = arrays
+    return out
+
+
+# ---------------------------------------------------------------------------
+# layer translation (ref: convert_symbol.py _parse_proto)
+# ---------------------------------------------------------------------------
+
+def _hw(p, base, default):
+    """Resolve caffe's three spatial-param spellings: scalar `base`,
+    repeated `base` (h, w), or `base_h`/`base_w`."""
+    if f"{base}_h" in p or f"{base}_w" in p:
+        return (int(p.get(f"{base}_h", default)),
+                int(p.get(f"{base}_w", default)))
+    v = p.get("kernel_size" if base == "kernel" else base, default)
+    if isinstance(v, list):
+        return (int(v[0]), int(v[1] if len(v) > 1 else v[0]))
+    return (int(v), int(v))
+
+
+def _conv_sym(sym, ins, name, p):
+    return sym.Convolution(
+        ins[0], name=name, num_filter=int(p["num_output"]),
+        kernel=_hw(p, "kernel", 1), stride=_hw(p, "stride", 1),
+        pad=_hw(p, "pad", 0),
+        num_group=int(p.get("group", 1)),
+        no_bias=not _truthy(p.get("bias_term", True)))
+
+
+def _pool_sym(sym, ins, name, p):
+    ptype = {"MAX": "max", 0: "max", "AVE": "avg", 1: "avg"}[
+        p.get("pool", "MAX")]
+    if _truthy(p.get("global_pooling", False)):
+        return sym.Pooling(ins[0], name=name, kernel=(1, 1),
+                           pool_type=ptype, global_pool=True)
+    return sym.Pooling(ins[0], name=name, kernel=_hw(p, "kernel", 2),
+                       stride=_hw(p, "stride", 1), pad=_hw(p, "pad", 0),
+                       pool_type=ptype, pooling_convention="full")
+
+
+def _truthy(v):
+    return v in (True, 1, "true", "True")
+
+
+def convert(prototxt_path, caffemodel_path=None):
+    """-> (sym, arg_params, aux_params), import_model-style."""
+    from incubator_mxnet_tpu import nd, sym
+
+    with open(prototxt_path) as f:
+        net = parse_prototxt(f.read())
+    blobs = read_caffemodel(caffemodel_path) if caffemodel_path else {}
+
+    env = {}
+    ndims = {}  # blob name -> rank, for broadcast-shape decisions
+
+    def top_of(layer, result, rank=None):
+        for t in _aslist(layer.get("top")) or [layer["name"]]:
+            env[t] = result
+            if rank is not None:
+                ndims[t] = rank
+
+    # network input
+    if "input" in net:
+        in_name = _aslist(net["input"])[0]
+        env[in_name] = sym.Variable(in_name)
+        ndims[in_name] = len(_aslist(net.get("input_dim"))) or 4
+    layers = _aslist(net.get("layer")) or _aslist(net.get("layers"))
+    arg_params, aux_params = {}, {}
+
+    for layer in layers:
+        ltype = str(layer.get("type"))
+        name = layer["name"]
+        bottoms = _aslist(layer.get("bottom"))
+        ins = [env[b] for b in bottoms]
+        if ltype in ("Input", "Data"):
+            shape = None
+            top_of(layer, sym.Variable(_aslist(layer.get("top"))[0]
+                                       if layer.get("top") else name))
+            continue
+        if ltype == "Convolution":
+            out = _conv_sym(sym, ins, name, layer.get("convolution_param", {}))
+            if name in blobs:
+                arg_params[f"{name}_weight"] = nd.array(blobs[name][0])
+                if len(blobs[name]) > 1:
+                    arg_params[f"{name}_bias"] = nd.array(blobs[name][1])
+        elif ltype == "InnerProduct":
+            p = layer.get("inner_product_param", {})
+            out = sym.FullyConnected(
+                ins[0], name=name, num_hidden=int(p["num_output"]),
+                no_bias=not _truthy(p.get("bias_term", True)))
+            if name in blobs:
+                arg_params[f"{name}_weight"] = nd.array(
+                    blobs[name][0].reshape(blobs[name][0].shape[-2:])
+                    if blobs[name][0].ndim > 2 else blobs[name][0])
+                if len(blobs[name]) > 1:
+                    arg_params[f"{name}_bias"] = nd.array(
+                        blobs[name][1].reshape(-1))
+        elif ltype == "Pooling":
+            out = _pool_sym(sym, ins, name, layer.get("pooling_param", {}))
+        elif ltype == "ReLU":
+            out = sym.Activation(ins[0], name=name, act_type="relu")
+        elif ltype == "Sigmoid":
+            out = sym.Activation(ins[0], name=name, act_type="sigmoid")
+        elif ltype == "TanH":
+            out = sym.Activation(ins[0], name=name, act_type="tanh")
+        elif ltype in ("Softmax", "SoftmaxWithLoss"):
+            # caffe's default softmax axis is the CHANNEL axis (1)
+            ax = int(layer.get("softmax_param", {}).get("axis", 1))
+            out = sym.softmax(ins[0], name=name, axis=ax)
+        elif ltype == "Dropout":
+            p = layer.get("dropout_param", {})
+            out = sym.Dropout(ins[0], name=name,
+                              p=float(p.get("dropout_ratio", 0.5)))
+        elif ltype == "Concat":
+            p = layer.get("concat_param", {})
+            out = sym.Concat(*ins, name=name, dim=int(p.get("axis", 1)))
+        elif ltype == "Eltwise":
+            p = layer.get("eltwise_param", {})
+            op = p.get("operation", "SUM")
+            if op in ("SUM", 1):
+                out = ins[0]
+                for extra in ins[1:]:
+                    out = out + extra
+            elif op in ("PROD", 0):
+                out = ins[0]
+                for extra in ins[1:]:
+                    out = out * extra
+            else:
+                out = ins[0]
+                for extra in ins[1:]:
+                    out = sym.maximum(out, extra)
+        elif ltype == "Flatten":
+            out = sym.Flatten(ins[0], name=name)
+        elif ltype == "LRN":
+            p = layer.get("lrn_param", {})
+            out = sym.LRN(ins[0], name=name,
+                          nsize=int(p.get("local_size", 5)),
+                          alpha=float(p.get("alpha", 1e-4)),
+                          beta=float(p.get("beta", 0.75)))
+        elif ltype in ("BatchNorm",):
+            out = sym.BatchNorm(ins[0], name=name, fix_gamma=True,
+                                use_global_stats=True, eps=float(
+                                    layer.get("batch_norm_param", {})
+                                    .get("eps", 1e-5)))
+            if name in blobs and len(blobs[name]) >= 3:
+                scale = float(blobs[name][2].ravel()[0]) or 1.0
+                mean = blobs[name][0].ravel() / scale
+                aux_params[f"{name}_moving_mean"] = nd.array(mean)
+                aux_params[f"{name}_moving_var"] = nd.array(
+                    blobs[name][1].ravel() / scale)
+                # the symbol still takes gamma/beta inputs (fix_gamma
+                # neutralizes gamma; beta must exist and be zero)
+                arg_params[f"{name}_gamma"] = nd.array(
+                    np.ones_like(mean))
+                arg_params[f"{name}_beta"] = nd.array(
+                    np.zeros_like(mean))
+        elif ltype == "Scale":
+            # caffe pairs this with BatchNorm; standalone it is a per-channel
+            # affine. Broadcast shape follows the tracked blob rank.
+            out = ins[0]
+            if name in blobs:
+                gamma = blobs[name][0].ravel()
+                beta = (blobs[name][1].ravel() if len(blobs[name]) > 1
+                        else np.zeros_like(gamma))
+                nd_in = ndims.get(bottoms[0], 4)
+                bshape = (1, -1) + (1,) * max(nd_in - 2, 0)
+                g = sym.Variable(f"{name}_gamma")
+                b = sym.Variable(f"{name}_beta")
+                out = sym.broadcast_add(
+                    sym.broadcast_mul(ins[0], sym.Reshape(g, shape=bshape)),
+                    sym.Reshape(b, shape=bshape))
+                arg_params[f"{name}_gamma"] = nd.array(gamma)
+                arg_params[f"{name}_beta"] = nd.array(beta)
+        else:
+            raise NotImplementedError(
+                f"caffe layer type {ltype!r} has no translation "
+                "(ref: convert_symbol.py supported set)")
+        in_rank = ndims.get(bottoms[0], 4) if bottoms else 4
+        rank = {"InnerProduct": 2, "Flatten": 2}.get(ltype, in_rank)
+        top_of(layer, out, rank)
+
+    final = env[_aslist(layers[-1].get("top"))[0]
+                if layers[-1].get("top") else layers[-1]["name"]]
+    return final, arg_params, aux_params
+
+
+def main():
+    if len(sys.argv) < 3:
+        print("usage: caffe_converter.py deploy.prototxt "
+              "[net.caffemodel] out_prefix", file=sys.stderr)
+        sys.exit(2)
+    prototxt = sys.argv[1]
+    caffemodel = sys.argv[2] if len(sys.argv) > 3 else None
+    prefix = sys.argv[-1]
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from incubator_mxnet_tpu import model
+
+    s, args, auxs = convert(prototxt, caffemodel)
+    model.save_checkpoint(prefix, 0, s, args, auxs)
+    print(f"saved {prefix}-symbol.json + {prefix}-0000.params")
+
+
+if __name__ == "__main__":
+    main()
