@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Neural style transfer (ref: example/neural-style/ — Gatys et al.:
+optimize the INPUT image so deep features match a content image and
+feature Gram matrices match a style image).
+
+Demonstrates optimization-over-input through a model-zoo network:
+`x.attach_grad()` + repeated backward on a content+style loss. With
+`--vgg-params` pointing at trained VGG11 weights the output is real style
+transfer; without it the (random-init) network still defines a valid
+objective, so the optimization machinery is exercised end-to-end and the
+loss must fall either way.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax.numpy as jnp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+
+def feature_layers(net, x, picks):
+    """Run VGG's feature stack, collecting the outputs at `picks`."""
+    feats = []
+    for i, blk in enumerate(net.features):
+        x = blk(x)
+        if i in picks:
+            feats.append(x)
+    return feats
+
+
+def gram(f):
+    b, c = f.shape[0], f.shape[1]
+    flat = f.reshape((b, c, -1))
+    n = flat.shape[2]
+    return nd.batch_dot(flat, flat.transpose(axes=(0, 2, 1))) / n
+
+
+def synthetic_image(rng, kind, size):
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32) / size
+    if kind == "content":  # smooth blobs
+        img = np.stack([np.exp(-((xx - cx) ** 2 + (yy - cy) ** 2) / 0.08)
+                        for cx, cy in ((0.3, 0.3), (0.7, 0.6), (0.5, 0.8))])
+    else:  # stripes: strong oriented texture statistics
+        img = np.stack([0.5 + 0.5 * np.sin(20 * (xx + d * yy))
+                        for d in (-1.0, 0.0, 1.0)])
+    return img[None].astype(np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--size", type=int, default=64)
+    p.add_argument("--iters", type=int, default=40)
+    p.add_argument("--lr", type=float, default=0.02)
+    p.add_argument("--style-weight", type=float, default=50.0)
+    p.add_argument("--vgg-params", default=None,
+                   help="optional trained vgg11 .params for real transfer")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    log = logging.getLogger("style")
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    net = vision.vgg11()
+    if args.vgg_params:
+        net.load_parameters(args.vgg_params)
+    else:
+        net.initialize(mx.init.Xavier())
+    content_picks = (6,)          # mid-level features
+    style_picks = (1, 4, 6)
+
+    content_img = nd.array(synthetic_image(rng, "content", args.size))
+    style_img = nd.array(synthetic_image(rng, "style", args.size))
+    with autograd.pause():
+        content_targets = [f.copy() for f in
+                           feature_layers(net, content_img, content_picks)]
+        style_targets = [gram(f).copy() for f in
+                         feature_layers(net, style_img, style_picks)]
+
+    x = nd.array(content_img.asnumpy()
+                 + 0.1 * rng.randn(*content_img.shape).astype(np.float32))
+    x.attach_grad()
+    trainer_state = nd.zeros(x.shape)  # momentum buffer for the image
+    first = None
+    for it in range(args.iters):
+        with autograd.record():
+            cf = feature_layers(net, x, content_picks)
+            sf = feature_layers(net, x, style_picks)
+            loss = sum(((a - b) ** 2).mean()
+                       for a, b in zip(cf, content_targets))
+            loss = loss + args.style_weight * sum(
+                ((gram(a) - b) ** 2).mean()
+                for a, b in zip(sf, style_targets))
+        loss.backward()
+        # normalized-gradient momentum step on the pixels (the classic
+        # style-transfer trick: loss scale varies wildly across nets, so
+        # normalize by the mean |grad| before applying the rate)
+        g = x.grad._data
+        g = g / (jnp.abs(g).mean() + 1e-12)
+        trainer_state._data = 0.9 * trainer_state._data - args.lr * g
+        x._data = x._data + trainer_state._data
+        cur = float(loss.asscalar())
+        if first is None:
+            first = cur
+        if it % 10 == 0:
+            log.info("iter %d loss %.5f", it, cur)
+
+    assert np.isfinite(cur)
+    assert cur < first * 0.9, (first, cur)
+    print(f"neural_style OK loss={cur:.5f} (from {first:.5f})")
+
+
+if __name__ == "__main__":
+    main()

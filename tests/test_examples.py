@@ -79,3 +79,8 @@ def test_word_language_model():
     log = _run("word_language_model.py", "--epochs", "2",
                "--batch-size", "64", timeout=600)
     assert "word_language_model OK" in log
+
+
+def test_neural_style():
+    log = _run("neural_style.py", "--iters", "25", "--size", "48")
+    assert "neural_style OK" in log

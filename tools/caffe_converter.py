@@ -207,14 +207,17 @@ def _conv_sym(sym, ins, name, p):
     return sym.Convolution(
         ins[0], name=name, num_filter=int(p["num_output"]),
         kernel=_hw(p, "kernel", 1), stride=_hw(p, "stride", 1),
-        pad=_hw(p, "pad", 0),
+        pad=_hw(p, "pad", 0), dilate=_hw(p, "dilation", 1),
         num_group=int(p.get("group", 1)),
         no_bias=not _truthy(p.get("bias_term", True)))
 
 
 def _pool_sym(sym, ins, name, p):
-    ptype = {"MAX": "max", 0: "max", "AVE": "avg", 1: "avg"}[
-        p.get("pool", "MAX")]
+    mode = p.get("pool", "MAX")
+    ptype = {"MAX": "max", 0: "max", "AVE": "avg", 1: "avg"}.get(mode)
+    if ptype is None:
+        raise NotImplementedError(
+            f"caffe pooling mode {mode!r} has no translation")
     if _truthy(p.get("global_pooling", False)):
         return sym.Pooling(ins[0], name=name, kernel=(1, 1),
                            pool_type=ptype, global_pool=True)
@@ -258,16 +261,18 @@ def convert(prototxt_path, caffemodel_path=None):
         bottoms = _aslist(layer.get("bottom"))
         ins = [env[b] for b in bottoms]
         if ltype in ("Input", "Data"):
-            shape = None
-            top_of(layer, sym.Variable(_aslist(layer.get("top"))[0]
-                                       if layer.get("top") else name))
+            rank = len(_aslist(net.get("input_dim"))) or 4
+            for t in _aslist(layer.get("top")) or [name]:
+                env[t] = sym.Variable(t)
+                ndims[t] = rank
             continue
         if ltype == "Convolution":
             out = _conv_sym(sym, ins, name, layer.get("convolution_param", {}))
             if name in blobs:
                 arg_params[f"{name}_weight"] = nd.array(blobs[name][0])
                 if len(blobs[name]) > 1:
-                    arg_params[f"{name}_bias"] = nd.array(blobs[name][1])
+                    arg_params[f"{name}_bias"] = nd.array(
+                        blobs[name][1].reshape(-1))
         elif ltype == "InnerProduct":
             p = layer.get("inner_product_param", {})
             out = sym.FullyConnected(
@@ -303,8 +308,13 @@ def convert(prototxt_path, caffemodel_path=None):
             p = layer.get("eltwise_param", {})
             op = p.get("operation", "SUM")
             if op in ("SUM", 1):
-                out = ins[0]
-                for extra in ins[1:]:
+                coeffs = [float(c) for c in _aslist(p.get("coeff"))]
+                if coeffs and len(coeffs) != len(ins):
+                    raise ValueError("eltwise coeff count != inputs")
+                terms = [c * t if coeffs else t
+                         for c, t in zip(coeffs or [1.0] * len(ins), ins)]
+                out = terms[0]
+                for extra in terms[1:]:
                     out = out + extra
             elif op in ("PROD", 0):
                 out = ins[0]
@@ -341,19 +351,19 @@ def convert(prototxt_path, caffemodel_path=None):
                     np.zeros_like(mean))
         elif ltype == "Scale":
             # caffe pairs this with BatchNorm; standalone it is a per-channel
-            # affine. Broadcast shape follows the tracked blob rank.
-            out = ins[0]
+            # affine. Same graph with or without weights so params from a
+            # weighted conversion always bind to a symbol-only one.
+            nd_in = ndims.get(bottoms[0], 4)
+            bshape = (1, -1) + (1,) * max(nd_in - 2, 0)
+            g = sym.Variable(f"{name}_gamma")
+            b = sym.Variable(f"{name}_beta")
+            out = sym.broadcast_add(
+                sym.broadcast_mul(ins[0], sym.Reshape(g, shape=bshape)),
+                sym.Reshape(b, shape=bshape))
             if name in blobs:
                 gamma = blobs[name][0].ravel()
                 beta = (blobs[name][1].ravel() if len(blobs[name]) > 1
                         else np.zeros_like(gamma))
-                nd_in = ndims.get(bottoms[0], 4)
-                bshape = (1, -1) + (1,) * max(nd_in - 2, 0)
-                g = sym.Variable(f"{name}_gamma")
-                b = sym.Variable(f"{name}_beta")
-                out = sym.broadcast_add(
-                    sym.broadcast_mul(ins[0], sym.Reshape(g, shape=bshape)),
-                    sym.Reshape(b, shape=bshape))
                 arg_params[f"{name}_gamma"] = nd.array(gamma)
                 arg_params[f"{name}_beta"] = nd.array(beta)
         else:
