@@ -486,15 +486,30 @@ class NDArray:
         return key
 
     def __getitem__(self, key):
+        if self._data.size >= 2 ** 31:
+            # int64-element-count tensors: jnp indexing routes offsets
+            # through int32 gather args and overflows; static lax.slice
+            # carries its bounds as attributes instead
+            params = _static_slice_params(self._data.shape, key)
+            if params is not None:
+                return autograd.invoke_recorded(
+                    lambda d: _apply_static_slice(d, params), [self])[0]
         k = self._jax_key(key)
-        nd_keys = []
-        if isinstance(key, NDArray):
-            nd_keys.append(key)
         return autograd.invoke_recorded(lambda d: d[k], [self])[0]
 
     def __setitem__(self, key, value):
-        k = self._jax_key(key)
         v = value._data if isinstance(value, NDArray) else value
+        if self._data.size >= 2 ** 31:
+            # writes share the int32 scatter-offset overflow: rebuild along
+            # axis 0 from static slices instead
+            updated = _static_set(self._data, key, v)
+            if updated is None:
+                raise IndexError(
+                    "unsupported index pattern for a tensor with >= 2**31 "
+                    "elements; use int/slice indexing on axis 0")
+            self._data = updated
+            return
+        k = self._jax_key(key)
         self._data = self._data.at[k].set(v)
 
     def __repr__(self):
@@ -504,6 +519,85 @@ class NDArray:
     def __array__(self, dtype=None):
         a = self.asnumpy()
         return a.astype(dtype) if dtype is not None else a
+
+
+def _static_slice_params(shape, key):
+    """(starts, stops, steps, squeeze_axes) for a static int/slice key, or
+    None when the key is not statically sliceable. Validation only — no
+    device work (the caller executes once on the tape)."""
+    idx = key if isinstance(key, tuple) else (key,)
+    if len(idx) > len(shape):
+        return None
+    starts, stops, steps, squeeze = [], [], [], []
+    for ax, k in enumerate(idx):
+        size = shape[ax]
+        if isinstance(k, bool):  # bool is an int subtype but means masking
+            return None
+        if isinstance(k, (int, np.integer)):
+            kk = int(k) + size if k < 0 else int(k)
+            if not 0 <= kk < size:
+                raise IndexError(f"index {k} out of bounds for axis {ax}")
+            starts.append(kk)
+            stops.append(kk + 1)
+            steps.append(1)
+            squeeze.append(ax)
+        elif isinstance(k, slice):
+            st, sp, stp = k.indices(size)
+            if stp <= 0:
+                return None
+            starts.append(st)
+            stops.append(max(sp, st))
+            steps.append(stp)
+        else:
+            return None
+    for ax in range(len(idx), len(shape)):
+        starts.append(0)
+        stops.append(shape[ax])
+        steps.append(1)
+    return starts, stops, steps, tuple(squeeze)
+
+
+def _apply_static_slice(d, params):
+    """Execute lax.slice with STATIC (attribute) bounds — no int32 index
+    arguments, so offsets beyond 2^31 work on int64-sized tensors."""
+    starts, stops, steps, squeeze = params
+    out = jax.lax.slice(d, starts, stops, steps)
+    if squeeze:
+        out = jnp.squeeze(out, axis=squeeze)
+    return out
+
+
+def _static_set(d, key, v):
+    """Functional write for int64-sized tensors: rebuild along axis 0 from
+    static slices (concat), avoiding int32 scatter offsets. Supports an
+    int or contiguous slice on axis 0 (rest of the axes full). Returns
+    None for unsupported patterns."""
+    k = key[0] if isinstance(key, tuple) and len(key) == 1 else key
+    n = d.shape[0]
+    if isinstance(k, bool):
+        return None
+    if isinstance(k, (int, np.integer)):
+        kk = int(k) + n if k < 0 else int(k)
+        if not 0 <= kk < n:
+            raise IndexError(f"index {k} out of bounds")
+        start, stop = kk, kk + 1
+        vshape = (1,) + tuple(d.shape[1:])
+    elif isinstance(k, slice):
+        start, stop, step = k.indices(n)
+        if step != 1:
+            return None
+        stop = max(stop, start)
+        vshape = (stop - start,) + tuple(d.shape[1:])
+    else:
+        return None
+    val = jnp.broadcast_to(jnp.asarray(v, d.dtype), vshape)
+    ones = [1] * d.ndim
+    # explicit strides: jax's strided slice impl keeps the bounds static,
+    # while the unstrided form re-dispatches through dynamic_slice whose
+    # int32 start args overflow at 2^31
+    head = jax.lax.slice(d, [0] * d.ndim, [start] + list(d.shape[1:]), ones)
+    tail = jax.lax.slice(d, [stop] + [0] * (d.ndim - 1), list(d.shape), ones)
+    return jnp.concatenate([head, val, tail], axis=0)
 
 
 def array(source_array, ctx=None, dtype=None):

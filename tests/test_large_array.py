@@ -59,3 +59,14 @@ def test_large_matmul_shape():
     """A single dim beyond int32 is rejected cleanly, not wrapped."""
     big = nd.zeros((2**20, 1024), dtype="uint8")  # 1G elements
     assert big.size == 2**30
+
+
+def test_large_setitem_static_path():
+    """Writes at offsets beyond int32 go through static rebuilds."""
+    n = 2**31 + 8
+    a = nd.zeros((n,), dtype="uint8")
+    a[n - 2] = 7
+    a[0:4] = 3
+    tail = a[n - 4:n]
+    np.testing.assert_array_equal(tail.asnumpy(), [0, 0, 7, 0])
+    np.testing.assert_array_equal(a[0:6].asnumpy(), [3, 3, 3, 3, 0, 0])
