@@ -31,6 +31,8 @@ def main():
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--flash", action="store_true",
                     help="Pallas flash-attention kernels")
+    ap.add_argument("--fused-xent", action="store_true",
+                    help="Pallas fused softmax-xent loss kernel")
     args = ap.parse_args()
 
     import jax
@@ -43,7 +45,7 @@ def main():
     cfg = tfm.TransformerConfig(
         vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
         n_layers=args.n_layers, d_ff=args.d_ff, max_len=args.seq,
-        use_flash=args.flash)
+        use_flash=args.flash, use_fused_xent=args.fused_xent)
     step, params = tfm.make_gspmd_train_step(mesh, cfg)
 
     rng = np.random.RandomState(0)
