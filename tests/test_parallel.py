@@ -248,3 +248,39 @@ def test_spmd_pipeline_stage_composition():
     fn = jax.shard_map(run, mesh=mesh, in_specs=(P("pp"), P()), out_specs=P())
     out = fn(stage_w, inputs)
     assert np.allclose(np.asarray(out), np.asarray(inputs) + 4.0)
+
+
+def test_scan_steps_on_mesh_matches_single_device():
+    """K scanned steps under dp batch-sharding == the same K steps on one
+    device (GSPMD all-reduce inside the scan body)."""
+    from incubator_mxnet_tpu import fused, gluon
+    from incubator_mxnet_tpu.gluon import nn
+
+    def build(mesh):
+        mx.random.seed(21)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+        net.initialize(mx.init.Xavier())
+        L = gluon.loss.SoftmaxCrossEntropyLoss()
+        opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+        return net, fused.GluonTrainStep(net, lambda n, x, y: L(n(x), y),
+                                         opt, mesh=mesh)
+
+    rng = np.random.RandomState(3)
+    K, B = 3, 8
+    xs = rng.rand(K, B, 5).astype(np.float32)
+    ys = rng.randint(0, 3, size=(K, B)).astype(np.float32)
+
+    net_a, step_a = build(_mesh())
+    la = step_a.scan_steps(nd.array(xs), nd.array(ys)).asnumpy()
+    step_a.sync_params()
+
+    net_b, step_b = build(None)
+    lb = step_b.scan_steps(nd.array(xs), nd.array(ys)).asnumpy()
+    step_b.sync_params()
+
+    np.testing.assert_allclose(la, lb, rtol=1e-5, atol=1e-6)
+    for pa, pb in zip(net_a.collect_params().values(),
+                      net_b.collect_params().values()):
+        np.testing.assert_allclose(pa.data().asnumpy(), pb.data().asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
