@@ -1,0 +1,141 @@
+/* Perl XS binding over the C embedding ABI (ref: perl-package/ — the
+ * reference ships a full AI::MXNet; here one compact XS module binds the
+ * 10-function predict API, the same surface the C++/JVM wrappers use). */
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include "mxtpu_predict.h"
+
+MODULE = AI::MXTpu  PACKAGE = AI::MXTpu  PREFIX = mxtpu_
+
+PROTOTYPES: DISABLE
+
+IV
+mxtpu_xs_create(artifact, plugin)
+    const char* artifact
+    SV* plugin
+  CODE:
+    {
+      MXTpuPredictorHandle h = NULL;
+      const char* p = SvOK(plugin) ? SvPV_nolen(plugin) : NULL;
+      if (MXTpuPredCreate(artifact, p, &h) != 0)
+        croak("%s", MXTpuPredLastError());
+      RETVAL = PTR2IV(h);
+    }
+  OUTPUT: RETVAL
+
+int
+mxtpu_xs_num_inputs(h)
+    IV h
+  CODE:
+    {
+      int n = 0;
+      if (MXTpuPredNumInputs(INT2PTR(MXTpuPredictorHandle, h), &n) != 0)
+        croak("%s", MXTpuPredLastError());
+      RETVAL = n;
+    }
+  OUTPUT: RETVAL
+
+int
+mxtpu_xs_num_outputs(h)
+    IV h
+  CODE:
+    {
+      int n = 0;
+      if (MXTpuPredNumOutputs(INT2PTR(MXTpuPredictorHandle, h), &n) != 0)
+        croak("%s", MXTpuPredLastError());
+      RETVAL = n;
+    }
+  OUTPUT: RETVAL
+
+const char*
+mxtpu_xs_input_name(h, idx)
+    IV h
+    int idx
+  CODE:
+    {
+      const char* name = NULL;
+      if (MXTpuPredInputName(INT2PTR(MXTpuPredictorHandle, h), idx, &name) != 0)
+        croak("%s", MXTpuPredLastError());
+      RETVAL = name;
+    }
+  OUTPUT: RETVAL
+
+void
+mxtpu_xs_input_shape(h, idx)
+    IV h
+    int idx
+  PPCODE:
+    {
+      const int64_t* dims = NULL;
+      int ndim = 0, i;
+      if (MXTpuPredInputShape(INT2PTR(MXTpuPredictorHandle, h), idx,
+                              &dims, &ndim) != 0)
+        croak("%s", MXTpuPredLastError());
+      EXTEND(SP, ndim);
+      for (i = 0; i < ndim; ++i)
+        PUSHs(sv_2mortal(newSViv((IV)dims[i])));
+    }
+
+void
+mxtpu_xs_output_shape(h, idx)
+    IV h
+    int idx
+  PPCODE:
+    {
+      const int64_t* dims = NULL;
+      int ndim = 0, i;
+      if (MXTpuPredOutputShape(INT2PTR(MXTpuPredictorHandle, h), idx,
+                               &dims, &ndim) != 0)
+        croak("%s", MXTpuPredLastError());
+      EXTEND(SP, ndim);
+      for (i = 0; i < ndim; ++i)
+        PUSHs(sv_2mortal(newSViv((IV)dims[i])));
+    }
+
+void
+mxtpu_xs_set_input(h, name, bytes)
+    IV h
+    const char* name
+    SV* bytes
+  CODE:
+    {
+      STRLEN len;
+      const char* buf = SvPV(bytes, len);
+      if (MXTpuPredSetInput(INT2PTR(MXTpuPredictorHandle, h), name,
+                            buf, (size_t)len) != 0)
+        croak("%s", MXTpuPredLastError());
+    }
+
+void
+mxtpu_xs_forward(h)
+    IV h
+  CODE:
+    if (MXTpuPredForward(INT2PTR(MXTpuPredictorHandle, h)) != 0)
+      croak("%s", MXTpuPredLastError());
+
+SV*
+mxtpu_xs_get_output(h, idx, nbytes)
+    IV h
+    int idx
+    size_t nbytes
+  CODE:
+    {
+      SV* out = newSV(nbytes);
+      SvPOK_on(out);
+      if (MXTpuPredGetOutput(INT2PTR(MXTpuPredictorHandle, h), idx,
+                             SvPVX(out), nbytes) != 0) {
+        SvREFCNT_dec(out);
+        croak("%s", MXTpuPredLastError());
+      }
+      SvCUR_set(out, nbytes);
+      RETVAL = out;
+    }
+  OUTPUT: RETVAL
+
+void
+mxtpu_xs_free(h)
+    IV h
+  CODE:
+    MXTpuPredFree(INT2PTR(MXTpuPredictorHandle, h));

@@ -1,0 +1,39 @@
+package AI::MXTpu;
+# Perl frontend over the C embedding ABI (ref: perl-package/AI-MXNet —
+# the reference's idiomatic wrapper; here the deployment surface binds).
+use strict;
+use warnings;
+use XSLoader;
+
+our $VERSION = '0.01';
+XSLoader::load('AI::MXTpu', $VERSION);
+
+sub new {
+    my ($class, $artifact, $plugin) = @_;
+    my $h = xs_create($artifact, $plugin);
+    return bless { h => $h }, $class;
+}
+
+sub num_inputs   { xs_num_inputs($_[0]{h}) }
+sub num_outputs  { xs_num_outputs($_[0]{h}) }
+sub input_name   { xs_input_name($_[0]{h}, $_[1]) }
+sub input_shape  { [xs_input_shape($_[0]{h}, $_[1])] }
+sub output_shape { [xs_output_shape($_[0]{h}, $_[1])] }
+
+# floats in/out travel as packed 'f*' strings (no PDL dependency)
+sub set_input {
+    my ($self, $name, @floats) = @_;
+    xs_set_input($self->{h}, $name, pack('f*', @floats));
+}
+sub forward { xs_forward($_[0]{h}) }
+
+sub get_output {
+    my ($self, $idx) = @_;
+    my $n = 1;
+    $n *= $_ for @{ $self->output_shape($idx) };
+    return [unpack('f*', xs_get_output($self->{h}, $idx, 4 * $n))];
+}
+
+sub DESTROY { xs_free($_[0]{h}) if $_[0]{h} }
+
+1;

@@ -187,3 +187,38 @@ def test_cpp_wrapper_builds_and_introspects(artifact, tmp_path):
     assert "inputs: 1 outputs: 1" in run.stdout
     assert "input data shape [ 2 5 ]" in run.stdout
     assert "introspection-only" in run.stdout
+
+
+def test_perl_binding_builds_and_introspects(artifact, tmp_path):
+    """The Perl XS package (perl-package/AI-MXTpu, the perl-package role)
+    compiles against the same C ABI and introspects an artifact."""
+    import shutil
+    import subprocess
+
+    if shutil.which("perl") is None or shutil.which("make") is None:
+        pytest.skip("perl/make unavailable")
+    prefix, _, _ = artifact
+    assert predict_lib() is not None  # lazy native build
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg = os.path.join(repo, "perl-package", "AI-MXTpu")
+    build = str(tmp_path / "perlbuild")
+    shutil.copytree(pkg, build)
+    env = dict(os.environ, MXTPU_REPO=repo)
+    for cmd in (["perl", "Makefile.PL"], ["make"]):
+        out = subprocess.run(cmd, cwd=build, env=env, capture_output=True,
+                             text=True, timeout=300)
+        assert out.returncode == 0, (cmd, out.stdout[-1500:],
+                                     out.stderr[-1500:])
+    script = f'''
+use blib;
+use AI::MXTpu;
+my $p = AI::MXTpu->new("{prefix}-predict.mxp", undef);
+printf "inputs=%d outputs=%d\\n", $p->num_inputs, $p->num_outputs;
+printf "name=%s shape=%s\\n", $p->input_name(0),
+       join(",", @{{$p->input_shape(0)}});
+'''
+    out = subprocess.run(["perl", "-e", script], cwd=build, env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-1500:]
+    assert "inputs=1 outputs=1" in out.stdout
+    assert "name=data shape=2,5" in out.stdout
