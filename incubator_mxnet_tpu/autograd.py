@@ -108,12 +108,14 @@ def predict_mode():
 class TapeNode:
     """One recorded op: vjp closure + graph links (ref: Imperative::RecordOp)."""
 
-    __slots__ = ("vjp", "inputs", "n_outputs", "out_avals", "name", "saved")
+    __slots__ = ("vjp", "fn", "inputs", "n_outputs", "out_avals", "name",
+                 "saved")
 
-    def __init__(self, vjp, inputs, n_outputs, out_avals, name=""):
+    def __init__(self, vjp, inputs, n_outputs, out_avals, name="", fn=None):
         self.vjp = vjp
-        self.inputs = inputs  # list[NDArray]
-        self.n_outputs = n_outputs
+        self.fn = fn          # primal fn (tuple-returning); enables
+        self.inputs = inputs  # grad-of-grad by re-deriving the vjp with
+        self.n_outputs = n_outputs  # primals as explicit inputs
         self.out_avals = out_avals  # [(shape, dtype)] per output
         self.name = name
 
@@ -153,6 +155,7 @@ def invoke_recorded(fn, input_arrays, name=""):
         n_outputs=len(res),
         out_avals=[(o.shape, o.dtype) for o in outs],
         name=name,
+        fn=tuple_fn,
     )
     _attach_outputs(node, res)
     return res
@@ -311,6 +314,9 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # no
                 slot = cotangents.setdefault(id(sub), [None] * sub.n_outputs)
                 i = inp._node_index
                 slot[i] = ct if slot[i] is None else slot[i] + ct
+                # an INTERMEDIATE with an attached grad buffer collects its
+                # per-consumer partials here (summing to the full cotangent)
+                _accum_var(inp, ct)
             else:
                 _accum_var(inp, ct)
         if not retain_graph:
@@ -357,12 +363,123 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # no
                 h._node = None
 
 
+def _grad_taped(heads, variables, head_grads):
+    """Cotangent propagation with every vjp call and accumulation RECORDED
+    on the tape (create_graph=True): the returned gradients carry tape
+    nodes, so a second backward() differentiates through them
+    (ref: autograd.grad create_graph — grad-of-grad).
+
+    Deliberately mirrors backward()'s propagation loop rather than sharing
+    it: this path re-derives vjps from primal fns and works in NDArray
+    (taped) arithmetic, while backward() consumes stored vjp closures over
+    raw buffers with sparse-cotangent write-back. Behavioral rules (head
+    accumulation, intermediate-variable accumulation, non-diff masking)
+    must be kept in sync — see the matching comments in backward().
+    """
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    cot: dict[int, list] = {}       # id(node) -> [NDArray|None per output]
+    var_ct: dict[int, object] = {}  # id(arr) -> NDArray cotangent
+    var_ids = {id(v) for v in variables}
+
+    def accum_var(arr, ct):
+        k = id(arr)
+        if k not in var_ids:
+            return
+        var_ct[k] = ct if k not in var_ct else var_ct[k] + ct
+
+    head_nodes = []
+    for h, hg in zip(heads, head_grads):
+        g = hg if isinstance(hg, NDArray) else NDArray._from_data(
+            jnp.ones(h.shape, h.dtype) if hg is None else jnp.asarray(hg))
+        node = getattr(h, "_node", None)
+        accum_var(h, g)  # a head may itself be a requested variable
+        if node is None:
+            continue
+        head_nodes.append(node)
+        slot = cot.setdefault(id(node), [None] * node.n_outputs)
+        idx = h._node_index
+        slot[idx] = g if slot[idx] is None else slot[idx] + g
+
+    order = _topo_order(head_nodes)
+    with _AutogradScope(recording=True):
+        for node in reversed(order):
+            cts = cot.pop(id(node), None)
+            if cts is None:
+                continue
+            if node.vjp is None:
+                raise RuntimeError(
+                    f"tape for {node.name!r} was already consumed; call the "
+                    "earlier backward() with retain_graph=True before "
+                    "grad(create_graph=True)")
+            if node.fn is None:
+                raise NotImplementedError(
+                    f"grad(create_graph=True) through custom-vjp node "
+                    f"{node.name!r} is not supported")
+            full = [ct if ct is not None else NDArray._from_data(
+                        jnp.zeros(shape, dtype))
+                    for ct, (shape, dtype) in zip(cts, node.out_avals)]
+            # re-derive the vjp with the PRIMALS as explicit inputs: the
+            # original vjp closure treats them as constants, which would
+            # sever d(grad)/d(primal) in the second-order graph
+            primal_fn = node.fn
+            n_in = len(node.inputs)
+            # non-differentiable inputs (int/bool primals) get float0
+            # cotangents from jax; mask them STATICALLY by dtype so no
+            # shape heuristic ever confuses a real scalar cotangent
+            def _dt(a):
+                return a.dtype if hasattr(a, "dtype") else jnp.asarray(a).dtype
+
+            diff_mask = [jnp.issubdtype(_dt(a), jnp.floating)
+                         for a in node.inputs]
+
+            def vjp_call(*args, _fn=primal_fn, _n=n_in, _mask=tuple(diff_mask)):
+                primals, cs = args[:_n], args[_n:]
+                _, vjp_fn = jax.vjp(_fn, *primals)
+                raw = vjp_fn(tuple(cs))[:_n]
+                return tuple(
+                    x if m else jnp.zeros(())
+                    for x, m in zip(raw, _mask))
+
+            in_cts = invoke_recorded(
+                vjp_call, list(node.inputs) + full, name=f"vjp:{node.name}")
+            for inp, ct, m in zip(node.inputs, in_cts, diff_mask):
+                if not m or not isinstance(inp, NDArray):
+                    continue
+                sub = getattr(inp, "_node", None)
+                if sub is not None:
+                    slot = cot.setdefault(id(sub), [None] * sub.n_outputs)
+                    i = inp._node_index
+                    slot[i] = ct if slot[i] is None else slot[i] + ct
+                if id(inp) in var_ids:
+                    accum_var(inp, ct)
+    out = []
+    for v in variables:
+        ct = var_ct.get(id(v))
+        if ct is None:
+            ct = NDArray._from_data(jnp.zeros(v.shape, v.dtype))
+        out.append(ct)
+    return out
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False, train_mode=True):  # noqa: A002
-    """Return grads of heads w.r.t. variables (ref: autograd.grad)."""
+    """Return grads of heads w.r.t. variables (ref: autograd.grad;
+    create_graph=True keeps the gradient computation on the tape so it can
+    be differentiated again)."""
     from .ndarray.ndarray import NDArray
 
     if create_graph:
-        raise NotImplementedError("higher-order autograd: use hybridized jax.grad path")
+        single = isinstance(variables, NDArray)
+        outs = _grad_taped(heads, [variables] if single else list(variables),
+                           head_grads)
+        return outs[0] if single else outs
     single = isinstance(variables, NDArray)
     if single:
         variables = [variables]

@@ -8,3 +8,4 @@ from . import torch_bridge  # noqa: F401
 from . import svrg  # noqa: F401
 from . import text  # noqa: F401
 from . import sharded_checkpoint  # noqa: F401
+from . import graph  # noqa: F401

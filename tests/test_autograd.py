@@ -101,3 +101,75 @@ def test_mark_variables():
         y = x * 4
     y.backward()
     assert_almost_equal(g.asnumpy(), np.array([4.0]))
+
+
+def test_grad_create_graph_second_derivative():
+    """d2/dx2 of x^3 is 6x via grad(create_graph=True) then backward
+    (ref: autograd.grad create_graph — grad-of-grad)."""
+    x = nd.array(np.array([1.0, 2.0, -3.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x * x).sum()
+        gx = autograd.grad(y, x, create_graph=True)
+        z = (gx * gx).sum()      # z = sum (3x^2)^2 = 9 sum x^4
+    z.backward()
+    # dz/dx = 36 x^3
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               36 * x.asnumpy() ** 3, rtol=1e-5)
+
+
+def test_grad_create_graph_through_layers():
+    """Second-order through a Dense layer: gradient-penalty style loss."""
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.gluon import nn
+
+    mx.random.seed(0)
+    net = nn.Dense(1, in_units=3)
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(0).rand(4, 3).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = net(x).sum()
+        gx = autograd.grad(y, x, create_graph=True)
+        penalty = (gx ** 2).sum()
+    penalty.backward()
+    # y = sum(xW^T + b) -> dy/dx = 1^T W (constant in x), so the penalty's
+    # gradient wrt x is ZERO — and wrt W it is 2*N*W-ish (nonzero)
+    np.testing.assert_allclose(x.grad.asnumpy(), 0.0, atol=1e-6)
+    w = net.weight
+    # differentiate the penalty wrt the weight too
+    with autograd.record():
+        y = net(x).sum()
+        gx = autograd.grad(y, x, create_graph=True)
+        penalty = (gx ** 2).sum()
+    penalty.backward()
+    gw = w.grad().asnumpy()
+    np.testing.assert_allclose(gw, 2 * 4 * w.data().asnumpy(), rtol=1e-5)
+
+
+def test_grad_create_graph_mixed_first_order_still_works():
+    x = nd.array(np.array([2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    g = autograd.grad(y, x, create_graph=False)
+    np.testing.assert_allclose(g.asnumpy(), [4.0], rtol=1e-6)
+
+
+def test_grad_wrt_head_and_intermediate_both_paths():
+    """grad(y, y) == 1 and grad(y, t) == dy/dt for BOTH create_graph
+    settings (the two propagation paths must agree)."""
+    for cg in (False, True):
+        x = nd.array(np.array([3.0], np.float32))
+        x.attach_grad()
+        with autograd.record():
+            t = x * 2.0
+            t.attach_grad()  # mark the intermediate
+            # rebuild downstream of the mark so y consumes the marked t
+            y = t * t
+            gy = autograd.grad(y, y, create_graph=cg, retain_graph=True)
+            gt = autograd.grad(y, t, create_graph=cg, retain_graph=True)
+        np.testing.assert_allclose(gy.asnumpy(), [1.0], rtol=1e-6,
+                                   err_msg=f"create_graph={cg}")
+        np.testing.assert_allclose(gt.asnumpy(), [12.0], rtol=1e-6,
+                                   err_msg=f"create_graph={cg}")
