@@ -84,3 +84,8 @@ def test_word_language_model():
 def test_neural_style():
     log = _run("neural_style.py", "--iters", "25", "--size", "48")
     assert "neural_style OK" in log
+
+
+def test_wgan_gp():
+    log = _run("wgan_gp.py", "--iters", "150", timeout=600)
+    assert "wgan_gp OK" in log
