@@ -188,8 +188,8 @@ def main():
     results, errors = {}, {}
     for dtype in ("float32", "bfloat16"):
         # healthy backend: full retries; down tunnel: one short attempt in
-        # case the probe raced a recovery, then fall through to CPU
-        attempts, timeout = (3, 1500) if accel_up else (1, 600)
+        # case the probe raced a recovery, then fall through to the cache
+        attempts, timeout = (3, 1500) if accel_up else (1, 300)
         r, err = _run_child(dtype, attempts=attempts, timeout=timeout)
         if r is not None:
             results[dtype] = r
