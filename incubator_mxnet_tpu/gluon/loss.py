@@ -13,13 +13,15 @@ hybridized/symbolic tracing.
 """
 from __future__ import annotations
 
+import numpy as np
+
 from .block import HybridBlock
 
 __all__ = [
     "Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss", "SigmoidBCELoss",
     "SoftmaxCrossEntropyLoss", "SoftmaxCELoss", "KLDivLoss", "CTCLoss",
     "HuberLoss", "HingeLoss", "SquaredHingeLoss", "LogisticLoss",
-    "TripletLoss", "CosineEmbeddingLoss",
+    "TripletLoss", "CosineEmbeddingLoss", "PoissonNLLLoss",
 ]
 
 
@@ -241,3 +243,34 @@ class CosineEmbeddingLoss(Loss):
 
 SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
 SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+
+class PoissonNLLLoss(Loss):
+    """Poisson negative log likelihood (ref: loss.py PoissonNLLLoss):
+    exp(pred) - label*pred on logits, or pred - label*log(pred+eps) on
+    rates; compute_full adds the Stirling approximation of log(label!).
+    Reduces to the SCALAR mean over all axes, matching the reference's
+    unique reduction for this loss."""
+
+    def __init__(self, weight=None, from_logits=True, batch_axis=0,
+                 compute_full=False, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None,
+                       epsilon=1e-08):
+        label = F.reshape_like(label, pred)
+        if self._from_logits:
+            loss = F.exp(pred) - label * pred
+        else:
+            loss = pred - label * F.log(pred + epsilon)
+        if self._compute_full:
+            # Stirling term for label > 1: y log y - y + 0.5 log(2 pi y)
+            stirling = (label * F.log(label + epsilon) - label
+                        + 0.5 * F.log(2.0 * np.pi * (label + epsilon)))
+            loss = loss + F.where(label > 1.0, stirling,
+                                  F.zeros_like(stirling))
+        loss = self._finish(F, loss, sample_weight, mean=False)
+        return F.mean(loss)

@@ -9,8 +9,9 @@ from ..block import Block, HybridBlock, _F
 
 __all__ = [
     "Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
-    "InstanceNorm", "LayerNorm", "Embedding", "Flatten", "Lambda",
-    "HybridLambda", "Activation",
+    "InstanceNorm", "LayerNorm", "GroupNorm", "Embedding", "Flatten",
+    "Lambda", "HybridLambda", "Activation", "ReflectionPad2D",
+    "HybridBlock",
 ]
 
 
@@ -304,3 +305,51 @@ class HybridLambda(HybridBlock):
     def hybrid_forward(self, F, *args):
         fn = self._func or getattr(F, self._func_name)
         return fn(*args)
+
+
+class GroupNorm(HybridBlock):
+    """Group normalization over channel groups
+    (ref: gluon/nn/basic_layers.py GroupNorm, v1.6 / group_norm.cc)."""
+
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._num_groups = int(num_groups)
+        self._epsilon = epsilon
+        with self.name_scope():
+            self.gamma = self.params.get(
+                "gamma", shape=(in_channels,), init=init_mod.One(),
+                allow_deferred_init=True,
+                grad_req="write" if scale else "null")
+            self.beta = self.params.get(
+                "beta", shape=(in_channels,), init=init_mod.Zero(),
+                allow_deferred_init=True,
+                grad_req="write" if center else "null")
+
+    def _pre_forward(self, x, *args):
+        if not self.gamma._shape_known():
+            self.gamma.shape = (x.shape[1],)
+            self.beta.shape = (x.shape[1],)
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.GroupNorm(x, gamma, beta, num_groups=self._num_groups,
+                           eps=self._epsilon)
+
+
+class ReflectionPad2D(HybridBlock):
+    """Reflection padding on H/W of NCHW input
+    (ref: gluon/nn/basic_layers.py ReflectionPad2D)."""
+
+    def __init__(self, padding=0, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(padding, int):
+            padding = (0, 0, 0, 0, padding, padding, padding, padding)
+        if len(padding) != 8:
+            raise ValueError(
+                "padding must be an int or an 8-tuple (before/after for "
+                "each NCHW axis); got %r" % (padding,))
+        self._padding = tuple(padding)
+
+    def hybrid_forward(self, F, x):
+        return F.Pad(x, mode="reflect", pad_width=self._padding)

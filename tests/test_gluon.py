@@ -357,3 +357,58 @@ def test_scan_steps_adam_bias_correction():
                       net_b.collect_params().values()):
         np.testing.assert_allclose(va.data().asnumpy(), vb.data().asnumpy(),
                                    rtol=1e-5, atol=1e-7)
+
+
+def test_groupnorm_reflectionpad_poisson_nll():
+    """Round-2 API tail: GroupNorm, ReflectionPad2D, PoissonNLLLoss
+    (ref: gluon/nn/basic_layers.py + gluon/loss.py v1.6 surface)."""
+    mx.random.seed(0)
+    gn = nn.GroupNorm(num_groups=2)
+    gn.initialize()
+    x = nd.array(np.random.RandomState(0).randn(2, 4, 3, 3).astype("float32"))
+    out = gn(x).asnumpy()
+    xr = x.asnumpy().reshape(2, 2, 2, 3, 3)
+    mean = xr.mean(axis=(2, 3, 4), keepdims=True)
+    var = xr.var(axis=(2, 3, 4), keepdims=True)
+    ref = ((xr - mean) / np.sqrt(var + 1e-5)).reshape(2, 4, 3, 3)
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+    # gradient flows through gamma
+    with autograd.record():
+        loss = (gn(x) ** 2).sum()
+    loss.backward()
+    assert np.abs(gn.gamma.grad().asnumpy()).sum() > 0
+
+    rp = nn.ReflectionPad2D(1)
+    y = rp(nd.array(np.arange(16, dtype="float32").reshape(1, 1, 4, 4)))
+    assert_almost_equal(y.asnumpy()[0, 0],
+                        np.pad(np.arange(16.0).reshape(4, 4), 1,
+                               mode="reflect"))
+
+    L = gluon.loss.PoissonNLLLoss()
+    pred = nd.array(np.array([[0.5, -0.2]], "float32"))
+    lab = nd.array(np.array([[1.0, 2.0]], "float32"))
+    ref_l = np.mean(np.exp([0.5, -0.2])
+                    - np.array([1.0, 2.0]) * np.array([0.5, -0.2]))
+    assert_almost_equal(float(L(pred, lab).asscalar()), ref_l, rtol=1e-5)
+    assert nn.HybridBlock is gluon.HybridBlock
+
+
+def test_poisson_nll_scalar_reduction_and_frozen_groupnorm():
+    # reference-unique reduction: scalar mean over ALL axes
+    L = gluon.loss.PoissonNLLLoss()
+    pred = nd.array(np.zeros((4, 2), "float32"))
+    lab = nd.array(np.ones((4, 2), "float32"))
+    out = L(pred, lab)
+    assert out.shape == ()
+    assert_almost_equal(float(out.asscalar()), 1.0, rtol=1e-6)  # e^0 - 1*0
+    # weight positional arg matches reference order: weight first
+    L2 = gluon.loss.PoissonNLLLoss(2.0)
+    assert_almost_equal(float(L2(pred, lab).asscalar()), 2.0, rtol=1e-6)
+
+    gn = nn.GroupNorm(num_groups=1, scale=False, center=False)
+    gn.initialize()
+    x = nd.array(np.random.RandomState(1).randn(2, 4, 3).astype("float32"))
+    with autograd.record():
+        loss = (gn(x) ** 2).sum()
+    loss.backward()
+    assert gn.gamma.grad_req == "null" and gn.beta.grad_req == "null"
