@@ -109,3 +109,60 @@ def test_diagnose_runs_clean():
     assert "Python Info" in out.stdout
     assert "incubator_mxnet_tpu Info" in out.stdout
     assert "features" in out.stdout
+
+
+def test_caffe_converter_cli_saves_checkpoint(tmp_path):
+    """tools/caffe_converter.py CLI: prototxt+caffemodel -> checkpoint."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import caffe_converter as cc
+
+    prototxt = tmp_path / "deploy.prototxt"
+    prototxt.write_text("""
+input: "data"
+input_dim: 1
+input_dim: 2
+input_dim: 4
+input_dim: 4
+layer {
+  name: "fc" type: "InnerProduct" bottom: "data" top: "fc"
+  inner_product_param { num_output: 3 }
+}
+""")
+    w = np.random.RandomState(0).randn(3, 32).astype(np.float32)
+    blob = cc.BlobProto(data=[float(v) for v in w.ravel()],
+                        shape=cc.BlobShape(dim=[3, 32]))
+    net = cc.CaffeNet(layer=[cc.CaffeLayer(name="fc", type="InnerProduct",
+                                           blobs=[blob])])
+    cm = tmp_path / "net.caffemodel"
+    cm.write_bytes(net.to_bytes())
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""  # keep the TPU plugin gate closed
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "caffe_converter.py"),
+         str(prototxt), str(cm), str(tmp_path / "conv")],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-1500:]
+    assert (tmp_path / "conv-symbol.json").exists()
+    assert (tmp_path / "conv-0000.params").exists()
+
+
+def test_bench_transformer_cli_emits_json(tmp_path):
+    """tools/bench_transformer.py prints one parseable JSON line."""
+    import json
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_transformer.py"),
+         "--d-model", "32", "--n-layers", "1", "--d-ff", "64",
+         "--vocab", "128", "--batch", "2", "--seq", "16",
+         "--iters", "2", "--warmup", "1"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert out.returncode == 0, out.stderr[-1500:]
+    d = json.loads(out.stdout.strip().splitlines()[-1])
+    assert d["metric"] == "transformer_train_tokens_per_sec"
+    assert d["value"] > 0
