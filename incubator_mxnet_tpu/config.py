@@ -106,6 +106,11 @@ register_knob("MXTPU_ASYNC_ALPHA", 0.5, float,
 register_knob("MXTPU_PS_ADDR", "", str,
               "host:port of the parameter server (default: coordinator "
               "host, coordinator port + 23).")
+register_knob("MXTPU_PS_SECRET", "", str,
+              "Shared job secret HMAC-authenticating the parameter "
+              "server's optimizer blobs (the only pickled payload on the "
+              "PS wire). tools/launch.py generates and exports one; set "
+              "it identically on every worker for manual launches.")
 register_knob("MXTPU_HEARTBEAT_DIR", "", str,
               "Directory for worker heartbeat files (dead-node detection; "
               "default derives from MXTPU_COORDINATOR).")

@@ -38,6 +38,14 @@ def import_model(model_file):
     graph = model.graph
     params = {init.name: nd_array(proto.to_array(init))
               for init in graph.initializer}
+    # default-domain opset governs where Clip/Pad/Reduce* parameters live
+    # (attributes through opset 10, inputs from 11/13 on)
+    opset = 0
+    for imp in getattr(model, "opset_import", ()) or ():
+        if not getattr(imp, "domain", ""):
+            opset = max(opset, int(getattr(imp, "version", 0) or 0))
+    if opset == 0:
+        opset = 9  # unspecified: ONNX defines this as opset 1; legacy forms
 
     env = {}  # onnx value name -> Symbol
     for name in list(params):
@@ -45,6 +53,18 @@ def import_model(model_file):
     for inp in graph.input:
         if inp.name not in env:
             env[inp.name] = sym.Variable(inp.name)
+
+    def const_input(node, idx):
+        """Constant-foldable input (opset>=11 moved several parameters from
+        attributes to inputs; they must be initializers here)."""
+        if len(node.input) <= idx or not node.input[idx]:
+            return None
+        name = node.input[idx]
+        if name not in params:
+            raise NotImplementedError(
+                f"{node.op_type} input {name!r} must be an initializer "
+                "(dynamic parameter tensors are not supported)")
+        return params[name].asnumpy()
 
     def conv(node):
         a = _attrs(node)
@@ -139,24 +159,53 @@ def import_model(model_file):
         if t == "Softsign":
             return sym.Activation(ins[0], act_type="softsign")
         if t == "Clip":
+            if opset >= 11 or len(node.input) > 1:
+                lo, hi = const_input(node, 1), const_input(node, 2)
+                return sym.clip(
+                    ins[0],
+                    a_min=float(lo) if lo is not None else -3.4e38,
+                    a_max=float(hi) if hi is not None else 3.4e38)
             return sym.clip(ins[0], a_min=a.get("min", -3.4e38),
                             a_max=a.get("max", 3.4e38))
         if t == "Slice":
-            axes = a.get("axes")
-            starts, ends = a["starts"], a["ends"]
+            if opset >= 10 or len(node.input) > 1:
+                # starts/ends/axes/steps moved to inputs at opset 10
+                starts = [int(v) for v in const_input(node, 1)]
+                ends = [int(v) for v in const_input(node, 2)]
+                ax = const_input(node, 3)
+                axes = tuple(int(v) for v in ax) if ax is not None else None
+                steps = const_input(node, 4)
+                if steps is not None and any(int(s) != 1 for s in steps):
+                    raise NotImplementedError(
+                        "Slice with steps != 1 is not supported")
+            else:
+                axes = a.get("axes")
+                starts, ends = a["starts"], a["ends"]
             out = ins[0]
             for ax, b, e in zip(axes or range(len(starts)), starts, ends):
                 out = sym.slice_axis(out, axis=int(ax), begin=int(b),
                                      end=None if e >= 2**31 - 1 else int(e))
             return out
         if t == "ReduceMean":
-            return sym.mean(ins[0], axis=a.get("axes"),
+            axes = a.get("axes")
+            if opset >= 18 or len(node.input) > 1:  # axes moved to input 1
+                ax = const_input(node, 1)
+                axes = tuple(int(x) for x in ax) if ax is not None else axes
+            return sym.mean(ins[0], axis=axes,
                             keepdims=bool(a.get("keepdims", 1)))
         if t == "ReduceSum":
-            return sym.sum(ins[0], axis=a.get("axes"),
+            axes = a.get("axes")
+            if opset >= 13 or len(node.input) > 1:  # axes moved to input 1
+                ax = const_input(node, 1)
+                axes = tuple(int(x) for x in ax) if ax is not None else None
+            return sym.sum(ins[0], axis=axes,
                            keepdims=bool(a.get("keepdims", 1)))
         if t == "ReduceMax":
-            return sym.max(ins[0], axis=a.get("axes"),
+            axes = a.get("axes")
+            if opset >= 18 or len(node.input) > 1:  # axes moved to input 1
+                ax = const_input(node, 1)
+                axes = tuple(int(x) for x in ax) if ax is not None else axes
+            return sym.max(ins[0], axis=axes,
                            keepdims=bool(a.get("keepdims", 1)))
         if t == "LayerNormalization":
             return sym.LayerNorm(*ins, eps=a.get("epsilon", 1e-5),
@@ -168,7 +217,14 @@ def import_model(model_file):
         if t == "Pad":
             mode = a.get("mode", "constant")
             mode = mode.decode() if isinstance(mode, bytes) else mode
-            pads = list(a.get("pads") or ())
+            if opset >= 11 or (len(node.input) > 1 and node.input[1]):
+                # pads/value moved from attributes to inputs at opset 11
+                pads_arr = const_input(node, 1)
+                pads = [] if pads_arr is None else [int(v) for v in pads_arr]
+                val = const_input(node, 2)
+                a = dict(a, value=float(val) if val is not None else 0.0)
+            else:
+                pads = list(a.get("pads") or ())
             n = len(pads) // 2
             # ONNX groups all begins then all ends; pad_width interleaves
             pw = []

@@ -69,6 +69,16 @@ class Trainer:
                 raise ValueError(
                     "dist_async_server requires update_on_kvstore=True "
                     "(the server applies the optimizer)")
+            if self._update_on_kvstore and not server_mode:
+                # collective stores have no server-side optimizer; honoring
+                # the flag would silently take the server push/pull path
+                # (and crash on set_optimizer_attrs) — reject it loudly
+                raise ValueError(
+                    f"update_on_kvstore=True is only valid with kvstore="
+                    f"'dist_async_server' (a true parameter server); "
+                    f"{self._kvstore_str!r} is collective-based — the "
+                    "optimizer runs on every worker. Drop the flag or "
+                    "switch kvstore types.")
             if self._update_on_kvstore:
                 # server-applied updates: seed the authoritative weights and
                 # ship the optimizer (ref: trainer.py:221-227)

@@ -462,10 +462,11 @@ class KVStoreDistAsyncServer(KVStoreDist):
         host, port = _ps.default_server_addr()
         self._server = None
         if self.rank == 0:
-            self._server = _ps.ParameterServer(self.num_workers, port=port)
+            self._server = _ps.ParameterServer(self.num_workers, host=host,
+                                               port=port)
             port = self._server.port
-        self._client = _ps.PSClient("127.0.0.1" if self.rank == 0 else host,
-                                    port)
+            host = self._server.host
+        self._client = _ps.PSClient(host, port)
         self._shapes = {}
 
     def barrier(self):

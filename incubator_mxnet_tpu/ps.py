@@ -19,7 +19,10 @@ zmq. Enable with kvstore type 'dist_async_server'.
 """
 from __future__ import annotations
 
+import hashlib
+import hmac
 import pickle
+import secrets
 import socket
 import struct
 import threading
@@ -29,10 +32,150 @@ import numpy as np
 __all__ = ["ParameterServer", "PSClient", "default_server_addr"]
 
 _LEN = struct.Struct(">Q")
+_U32 = struct.Struct(">I")
+_F64 = struct.Struct(">d")
+
+# ---------------------------------------------------------------------------
+# Wire codec. The data plane (keys, tensors, shapes, attr dicts) crosses the
+# socket in a closed tag-length-value format — NEVER pickle, so a host that
+# can reach the port cannot execute code by connecting (the reference's
+# ps-lite likewise shipped raw tensor bytes). The ONE pickle on the wire is
+# the optimizer blob (ref: CommandType::kController ships a serialized
+# optimizer); it travels as opaque bytes and is HMAC-authenticated with the
+# job secret before either side unpickles it.
+# ---------------------------------------------------------------------------
+
+
+def _enc(obj, out):
+    if obj is None:
+        out.append(b"N")
+    elif obj is True:
+        out.append(b"T")
+    elif obj is False:
+        out.append(b"F")
+    elif isinstance(obj, (int, np.integer)):
+        b = str(int(obj)).encode("ascii")
+        out.append(b"I" + _U32.pack(len(b)) + b)
+    elif isinstance(obj, (float, np.floating)):
+        out.append(b"f" + _F64.pack(float(obj)))
+    elif isinstance(obj, str):
+        b = obj.encode("utf-8")
+        out.append(b"S" + _U32.pack(len(b)) + b)
+    elif isinstance(obj, (bytes, bytearray, memoryview)):
+        b = bytes(obj)
+        out.append(b"B" + _U32.pack(len(b)) + b)
+    elif isinstance(obj, np.ndarray):
+        if obj.dtype.hasobject:
+            raise TypeError("object arrays cannot cross the PS wire")
+        a = np.ascontiguousarray(obj)
+        # dtype travels by NAME ('float32', 'bfloat16', ...) — .str would
+        # collapse extension dtypes like ml_dtypes.bfloat16 to raw-void
+        # '<V2' and silently corrupt them on decode
+        if a.dtype.kind == "V" and a.dtype.name.startswith("void"):
+            raise TypeError(f"dtype {a.dtype} cannot cross the PS wire")
+        dt = a.dtype.name.encode("ascii")
+        out.append(b"A" + _U32.pack(len(dt)) + dt + _U32.pack(a.ndim))
+        for d in a.shape:
+            out.append(_LEN.pack(d))
+        raw = a.tobytes()
+        out.append(_LEN.pack(len(raw)) + raw)
+    elif isinstance(obj, (list, tuple)):
+        out.append(b"L" + _U32.pack(len(obj)))
+        for item in obj:
+            _enc(item, out)
+    elif isinstance(obj, dict):
+        out.append(b"D" + _U32.pack(len(obj)))
+        for k, v in obj.items():
+            _enc(k, out)
+            _enc(v, out)
+    else:
+        raise TypeError(
+            f"{type(obj).__name__} cannot cross the PS wire; allowed: "
+            "None/bool/int/float/str/bytes/ndarray/list/dict")
+
+
+def _dec(buf, pos):
+    tag = buf[pos:pos + 1]
+    pos += 1
+    if tag == b"N":
+        return None, pos
+    if tag == b"T":
+        return True, pos
+    if tag == b"F":
+        return False, pos
+    if tag == b"I":
+        (n,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        return int(buf[pos:pos + n]), pos + n
+    if tag == b"f":
+        (v,) = _F64.unpack_from(buf, pos)
+        return v, pos + 8
+    if tag == b"S":
+        (n,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        return buf[pos:pos + n].decode("utf-8"), pos + n
+    if tag == b"B":
+        (n,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        return bytes(buf[pos:pos + n]), pos + n
+    if tag == b"A":
+        (n,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        dtype = _dtype_by_name(buf[pos:pos + n].decode("ascii"))
+        pos += n
+        if dtype.hasobject:
+            raise ValueError("object arrays cannot cross the PS wire")
+        (ndim,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        shape = []
+        for _ in range(ndim):
+            (d,) = _LEN.unpack_from(buf, pos)
+            shape.append(d)
+            pos += 8
+        (nbytes,) = _LEN.unpack_from(buf, pos)
+        pos += 8
+        arr = np.frombuffer(buf[pos:pos + nbytes], dtype=dtype)
+        return arr.reshape(shape).copy(), pos + nbytes
+    if tag == b"L":
+        (n,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        items = []
+        for _ in range(n):
+            item, pos = _dec(buf, pos)
+            items.append(item)
+        return tuple(items), pos
+    if tag == b"D":
+        (n,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        d = {}
+        for _ in range(n):
+            k, pos = _dec(buf, pos)
+            v, pos = _dec(buf, pos)
+            d[k] = v
+        return d, pos
+    raise ValueError(f"bad PS wire tag {tag!r}")
+
+
+# low-precision accelerator dtypes numpy can't resolve by name
+_EXT_DTYPES = ("bfloat16", "float8_e4m3fn", "float8_e5m2", "float8_e4m3",
+               "float8_e4m3fnuz", "float8_e5m2fnuz", "int4", "uint4")
+
+
+def _dtype_by_name(name):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        if name in _EXT_DTYPES:
+            import ml_dtypes
+
+            return np.dtype(getattr(ml_dtypes, name))
+        raise ValueError(f"unknown dtype {name!r} on the PS wire")
 
 
 def _send_msg(sock, obj):
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    out = []
+    _enc(obj, out)
+    payload = b"".join(out)
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
@@ -48,7 +191,36 @@ def _recv_exact(sock, n):
 
 def _recv_msg(sock):
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    return pickle.loads(_recv_exact(sock, n))
+    obj, _ = _dec(_recv_exact(sock, n), 0)
+    return obj
+
+
+# Fallback secret when MXTPU_PS_SECRET is unset: random per process, so a
+# same-process server+client pair (unit tests, single-host trainer) works
+# out of the box while cross-process use without the launcher fails loudly.
+_PROCESS_SECRET = secrets.token_bytes(32)
+
+
+def _ps_secret():
+    from . import config as _config
+
+    s = _config.get("MXTPU_PS_SECRET")
+    return s.encode("utf-8") if s else _PROCESS_SECRET
+
+
+def _sign_blob(blob):
+    return hmac.new(_ps_secret(), blob, hashlib.sha256).digest() + blob
+
+
+def _verify_blob(signed):
+    mac, blob = signed[:32], signed[32:]
+    if not hmac.compare_digest(
+            mac, hmac.new(_ps_secret(), blob, hashlib.sha256).digest()):
+        raise PermissionError(
+            "optimizer blob failed HMAC authentication; set "
+            "MXTPU_PS_SECRET to the same value on every worker "
+            "(tools/launch.py exports one automatically)")
+    return blob
 
 
 def default_server_addr():
@@ -77,7 +249,14 @@ class ParameterServer:
     serialization).
     """
 
-    def __init__(self, num_workers, host="0.0.0.0", port=9923):
+    def __init__(self, num_workers, host=None, port=9923):
+        if host is None:
+            # default to the coordinator interface, NOT 0.0.0.0 — the
+            # server should only be reachable over the interface the job
+            # actually uses (an unauthenticated data plane on all
+            # interfaces is a needless exposure)
+            host = default_server_addr()[0]
+        self.host = host
         self.num_workers = num_workers
         self._store = {}           # key -> np.ndarray (authoritative)
         self._locks = {}           # key -> threading.Lock
@@ -133,8 +312,9 @@ class ParameterServer:
                     self.shutdown()
                     return
                 _send_msg(conn, self._dispatch(cmd, msg[1:]))
-        except (ConnectionError, OSError, EOFError):
-            pass
+        except (ConnectionError, OSError, EOFError, ValueError,
+                struct.error):
+            pass  # malformed frame or peer gone: drop the connection
         finally:
             conn.close()
 
@@ -155,21 +335,22 @@ class ParameterServer:
 
     def _cmd_set_optimizer(self, blob):
         """(ref: CommandType::kController — the worker ships the pickled
-        optimizer, the server builds its updater from it)."""
+        optimizer, the server builds its updater from it). The blob is
+        unpickled ONLY after HMAC authentication against the job secret."""
         from . import optimizer as _opt
 
-        self._updater = _opt.get_updater(pickle.loads(blob))
+        self._updater = _opt.get_updater(pickle.loads(_verify_blob(blob)))
         return ("ok",)
 
     def _cmd_get_optimizer_states(self, dump_optimizer):
         if self._updater is None:
             raise RuntimeError("no optimizer set on the server")
-        return ("val", self._updater.get_states(dump_optimizer))
+        return ("val", _sign_blob(self._updater.get_states(dump_optimizer)))
 
     def _cmd_set_optimizer_states(self, blob):
         if self._updater is None:
             raise RuntimeError("no optimizer set on the server")
-        self._updater.set_states(blob)
+        self._updater.set_states(_verify_blob(blob))
         return ("ok",)
 
     def _cmd_set_optimizer_attrs(self, attrs):
@@ -373,10 +554,11 @@ class PSClient:
         return self._rpc("set_compression", dict(params))
 
     def get_optimizer_states(self, dump_optimizer=False):
-        return self._rpc("get_optimizer_states", bool(dump_optimizer))
+        return _verify_blob(
+            self._rpc("get_optimizer_states", bool(dump_optimizer)))
 
     def set_optimizer_states(self, blob):
-        return self._rpc("set_optimizer_states", blob)
+        return self._rpc("set_optimizer_states", _sign_blob(blob))
 
     def pull(self, key):
         return self._rpc("pull", key)
@@ -386,8 +568,8 @@ class PSClient:
 
     def set_optimizer(self, optimizer):
         return self._rpc("set_optimizer",
-                         pickle.dumps(optimizer,
-                                      protocol=pickle.HIGHEST_PROTOCOL))
+                         _sign_blob(pickle.dumps(
+                             optimizer, protocol=pickle.HIGHEST_PROTOCOL)))
 
     def barrier(self):
         return self._rpc("barrier")

@@ -204,3 +204,80 @@ def test_fp16_int32_data_is_bitcast():
                           int32_data=[15360, 49152])  # 1.0, -2.0
     np.testing.assert_allclose(proto.to_array(t).astype(np.float32),
                                [1.0, -2.0])
+
+
+# --- opset >= 11 input-form parameters (Clip/Pad/ReduceSum) ----------------
+
+def _make_model(nodes, inputs, outputs, initializers, opset):
+    graph = proto.GraphProto(
+        node=nodes, name="g",
+        initializer=[proto.from_array(a, name=n) for n, a in initializers],
+        input=[proto.ValueInfoProto(name=n) for n in inputs],
+        output=[proto.ValueInfoProto(name=n) for n in outputs])
+    return proto.ModelProto(ir_version=7, graph=graph,
+                            opset_import=[proto.OperatorSetId(version=opset)])
+
+
+def test_import_clip_opset11_bounds_as_inputs(tmp_path):
+    node = proto.NodeProto(op_type="Clip", input=["data", "lo", "hi"],
+                           output=["out"], name="clip0")
+    model = _make_model(
+        [node], ["data"], ["out"],
+        [("lo", np.array(-0.5, np.float32)), ("hi", np.array(0.5, np.float32))],
+        opset=11)
+    path = os.path.join(str(tmp_path), "clip11.onnx")
+    proto.save_model(model, path)
+    s, args, auxs = import_model(path)
+    x = nd.array(np.linspace(-2, 2, 8, dtype=np.float32))
+    got = _forward(s, args, auxs, x)
+    np.testing.assert_allclose(got, np.clip(np.linspace(-2, 2, 8), -0.5, 0.5),
+                               rtol=1e-6)
+
+
+def test_import_pad_opset11_pads_as_inputs(tmp_path):
+    node = proto.NodeProto(op_type="Pad", input=["data", "pads", "val"],
+                           output=["out"], name="pad0")
+    model = _make_model(
+        [node], ["data"], ["out"],
+        [("pads", np.array([0, 0, 1, 2, 0, 0, 3, 4], np.int64)),
+         ("val", np.array(7.0, np.float32))],
+        opset=11)
+    path = os.path.join(str(tmp_path), "pad11.onnx")
+    proto.save_model(model, path)
+    s, args, auxs = import_model(path)
+    x = nd.array(np.ones((1, 1, 2, 2), np.float32))
+    got = _forward(s, args, auxs, x)
+    ref = np.pad(np.ones((1, 1, 2, 2), np.float32),
+                 [(0, 0), (0, 0), (1, 3), (2, 4)], constant_values=7.0)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_import_reducesum_opset13_axes_as_input(tmp_path):
+    node = proto.NodeProto(op_type="ReduceSum", input=["data", "axes"],
+                           output=["out"], name="rs0")
+    model = _make_model(
+        [node], ["data"], ["out"],
+        [("axes", np.array([1], np.int64))], opset=13)
+    path = os.path.join(str(tmp_path), "rs13.onnx")
+    proto.save_model(model, path)
+    s, args, auxs = import_model(path)
+    xv = np.arange(12, dtype=np.float32).reshape(3, 4)
+    got = _forward(s, args, auxs, nd.array(xv))
+    np.testing.assert_allclose(got, xv.sum(axis=1, keepdims=True), rtol=1e-6)
+
+
+def test_import_slice_opset10_params_as_inputs(tmp_path):
+    node = proto.NodeProto(op_type="Slice",
+                           input=["data", "starts", "ends", "axes"],
+                           output=["out"], name="sl0")
+    model = _make_model(
+        [node], ["data"], ["out"],
+        [("starts", np.array([1], np.int64)),
+         ("ends", np.array([3], np.int64)),
+         ("axes", np.array([1], np.int64))], opset=10)
+    path = os.path.join(str(tmp_path), "slice10.onnx")
+    proto.save_model(model, path)
+    s, args, auxs = import_model(path)
+    xv = np.arange(12, dtype=np.float32).reshape(3, 4)
+    got = _forward(s, args, auxs, nd.array(xv))
+    np.testing.assert_allclose(got, xv[:, 1:3], rtol=1e-6)

@@ -134,3 +134,83 @@ def test_push_rows_sparse_apply(server2):
     got = np.asarray(c0.pull("emb"))
     np.testing.assert_allclose(got[[1, 4]], -rows, rtol=1e-6)
     np.testing.assert_allclose(got[[0, 2, 3, 5]], 0.0)
+
+
+# ---------------------------------------------------------------------------
+# wire safety (round-3: the data plane must never unpickle network bytes)
+# ---------------------------------------------------------------------------
+
+def test_wire_codec_roundtrip():
+    from incubator_mxnet_tpu import ps as _ps
+
+    msg = ("push", "w:3", np.arange(12, dtype=np.float32).reshape(3, 4),
+           True, None, 3.5, -7, {"lr": 0.1, "name": "sgd"}, (1, "a", b"\x00"))
+    out = []
+    _ps._enc(msg, out)
+    got, pos = _ps._dec(b"".join(out), 0)
+    assert pos == len(b"".join(out))
+    assert got[0] == "push" and got[1] == "w:3"
+    np.testing.assert_array_equal(got[2], msg[2])
+    assert got[2].dtype == np.float32
+    assert got[3] is True and got[4] is None and got[5] == 3.5 and got[6] == -7
+    assert got[7] == {"lr": 0.1, "name": "sgd"}
+    assert got[8] == (1, "a", b"\x00")
+
+
+def test_wire_codec_rejects_arbitrary_objects():
+    from incubator_mxnet_tpu import ps as _ps
+
+    class Evil:
+        pass
+
+    with pytest.raises(TypeError):
+        _ps._enc(("push", Evil()), [])
+    with pytest.raises(TypeError):
+        _ps._enc(np.array([Evil()], dtype=object), [])
+
+
+def test_optimizer_blob_hmac_rejected_on_mismatch(server2, monkeypatch):
+    # a blob signed under a different job secret must NOT be unpickled
+    from incubator_mxnet_tpu import ps as _ps
+
+    srv, (c0, _) = server2
+    monkeypatch.delenv("MXTPU_PS_SECRET", raising=False)
+    blob = _ps._sign_blob(b"payload")
+    monkeypatch.setattr(_ps, "_PROCESS_SECRET", b"x" * 32)
+    with pytest.raises(PermissionError, match="MXTPU_PS_SECRET"):
+        _ps._verify_blob(blob)
+
+
+def test_server_binds_loopback_by_default(server2):
+    # default bind derives from the coordinator interface, not 0.0.0.0
+    srv, _ = server2
+    from incubator_mxnet_tpu import ps as _ps
+    s = _ps.ParameterServer(num_workers=1, port=0)
+    try:
+        assert s._sock.getsockname()[0] != "0.0.0.0"
+    finally:
+        s.shutdown()
+
+
+def test_trainer_rejects_update_on_kvstore_for_collective_store():
+    from incubator_mxnet_tpu import gluon
+
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       kvstore="dist_sync", update_on_kvstore=True)
+    with pytest.raises(ValueError, match="dist_async_server"):
+        tr._init_kvstore()
+
+
+def test_wire_codec_bfloat16_roundtrip():
+    import ml_dtypes
+    from incubator_mxnet_tpu import ps as _ps
+
+    a = np.arange(6, dtype=ml_dtypes.bfloat16).reshape(2, 3)
+    out = []
+    _ps._enc(a, out)
+    got, _ = _ps._dec(b"".join(out), 0)
+    assert got.dtype == np.dtype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(got.astype(np.float32),
+                                  a.astype(np.float32))

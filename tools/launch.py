@@ -8,6 +8,7 @@ DCN/ICI collectives. Supports local multi-process launch (the reference's
 """
 import argparse
 import os
+import secrets
 import subprocess
 import sys
 
@@ -24,6 +25,9 @@ def main():
     if cmd and cmd[0] == "--":
         cmd = cmd[1:]
     assert cmd, "no command given"
+    # one job secret for the whole gang: authenticates the PS optimizer
+    # blob (the only pickle on the PS wire)
+    ps_secret = os.environ.get("MXTPU_PS_SECRET") or secrets.token_hex(16)
 
     if args.launcher == "local":
         procs = []
@@ -33,6 +37,7 @@ def main():
                 "MXTPU_COORDINATOR": args.coordinator,
                 "MXTPU_NUM_PROCESSES": str(args.num_workers),
                 "MXTPU_PROCESS_ID": str(rank),
+                "MXTPU_PS_SECRET": ps_secret,
                 # reference-compatible names (ref: DMLC_ROLE env protocol)
                 "DMLC_ROLE": "worker",
                 "DMLC_NUM_WORKER": str(args.num_workers),
@@ -50,11 +55,19 @@ def main():
             host = hosts[rank % len(hosts)]
             remote_env = (
                 f"MXTPU_COORDINATOR={args.coordinator} "
-                f"MXTPU_NUM_PROCESSES={args.num_workers} MXTPU_PROCESS_ID={rank}"
+                f"MXTPU_NUM_PROCESSES={args.num_workers} "
+                f"MXTPU_PROCESS_ID={rank}"
             )
-            procs.append(subprocess.Popen(
-                ["ssh", host, remote_env + " " + " ".join(cmd)]
-            ))
+            # the job secret rides stdin, NOT the command line — remote
+            # /proc/<pid>/cmdline is world-readable
+            p = subprocess.Popen(
+                ["ssh", host,
+                 "IFS= read -r MXTPU_PS_SECRET && export MXTPU_PS_SECRET && "
+                 + remote_env + " " + " ".join(cmd)],
+                stdin=subprocess.PIPE, text=True)
+            p.stdin.write(ps_secret + "\n")
+            p.stdin.close()
+            procs.append(p)
         rc = 0
         for proc in procs:
             rc |= proc.wait()
