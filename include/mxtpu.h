@@ -1,0 +1,110 @@
+/* mxtpu.h — C embedding API for TRAINING (and the host-side NDArray).
+ *
+ * TPU-native replacement for the reference's create/train C ABI
+ * (ref: include/mxnet/c_api.h + src/c_api/c_api.cc — NDArray create/copy,
+ * executor bind/forward/backward, optimizer updates driven per-op from the
+ * embedding language; cpp-package/example/mlp.cpp is the canonical
+ * consumer).  Here the whole train step — forward, backward, optimizer
+ * update — is ONE AOT-compiled XLA program inside a `.mxt` artifact
+ * (written by incubator_mxnet_tpu.deploy.export_trainer); the embedder
+ * loops that executable while parameters and optimizer state stay resident
+ * in device HBM.  A C caller therefore trains with five calls:
+ *
+ *   MXTpuTrainerCreate("model-train.mxt", "/path/pjrt_plugin.so", &h);
+ *   for (int e = 0; e < steps; ++e) {
+ *     MXTpuTrainerSetInput(h, "x", xbuf, sizeof xbuf);
+ *     MXTpuTrainerSetInput(h, "y", ybuf, sizeof ybuf);
+ *     MXTpuTrainerStep(h, &loss);
+ *   }
+ *   MXTpuTrainerGetState(h, "param:dense0_weight", wbuf, sizeof wbuf);
+ *   MXTpuTrainerFree(h);
+ *
+ * All functions return 0 on success, nonzero on failure;
+ * MXTpuLastError() describes the most recent failure.
+ */
+#ifndef MXTPU_H_
+#define MXTPU_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ----------------------------------------------------------------------
+ * NDArray: host-side tensors for staging inputs / reading back state
+ * (ref: MXNDArrayCreate / MXNDArraySyncCopyFromCPU / MXNDArrayFree).
+ * dtype codes match the artifact table: 0=f32 1=f64 2=s32 3=s64 4=u8
+ * 5=s8 6=bf16 7=f16 8=bool 9=u32 10=u64 11=s16 12=u16.
+ * -------------------------------------------------------------------- */
+typedef void* MXTpuNDHandle;
+
+/* Create with `data` copied in (NULL = zero-filled). */
+int MXTpuNDCreate(int dtype, int ndim, const int64_t* dims,
+                  const void* data, MXTpuNDHandle* out);
+int MXTpuNDShape(MXTpuNDHandle h, const int64_t** dims, int* ndim);
+int MXTpuNDDType(MXTpuNDHandle h, int* dtype);
+int MXTpuNDSize(MXTpuNDHandle h, size_t* nbytes);
+/* Direct pointer to the host payload (valid until MXTpuNDFree). */
+int MXTpuNDData(MXTpuNDHandle h, void** data);
+int MXTpuNDCopyTo(MXTpuNDHandle h, void* dst, size_t nbytes);
+int MXTpuNDCopyFrom(MXTpuNDHandle h, const void* src, size_t nbytes);
+void MXTpuNDFree(MXTpuNDHandle h);
+
+/* ----------------------------------------------------------------------
+ * Trainer: load a .mxt artifact, loop the compiled train step.
+ * -------------------------------------------------------------------- */
+typedef void* MXTpuTrainerHandle;
+
+/* Load artifact + PJRT plugin, compile the step, upload initial state.
+ * plugin_path NULL = artifact-only mode: introspection and GetState (the
+ * initial values) work; Step fails cleanly. */
+int MXTpuTrainerCreate(const char* artifact_path,
+                       const char* pjrt_plugin_path,
+                       MXTpuTrainerHandle* out);
+
+/* Per-step data inputs (e.g. "x", "y"; excludes auto-managed scalars). */
+int MXTpuTrainerNumInputs(MXTpuTrainerHandle h, int* out);
+int MXTpuTrainerInputName(MXTpuTrainerHandle h, int idx, const char** out);
+int MXTpuTrainerInputShape(MXTpuTrainerHandle h, int idx,
+                           const int64_t** dims, int* ndim);
+
+/* Persistent state (params + optimizer slots), device-resident while
+ * training.  Names: "param:<name>" / "opt:<name>[:<slot>]". */
+int MXTpuTrainerNumStates(MXTpuTrainerHandle h, int* out);
+int MXTpuTrainerStateName(MXTpuTrainerHandle h, int idx, const char** out);
+int MXTpuTrainerStateShape(MXTpuTrainerHandle h, int idx,
+                           const int64_t** dims, int* ndim);
+
+/* Stage one named input (host, C-order, artifact dtype). */
+int MXTpuTrainerSetInput(MXTpuTrainerHandle h, const char* name,
+                         const void* data, size_t nbytes);
+/* NDArray variant of SetInput (shape/dtype checked against the spec). */
+int MXTpuTrainerSetInputND(MXTpuTrainerHandle h, const char* name,
+                           MXTpuNDHandle nd);
+
+/* Run ONE fused train step (fwd+bwd+optimizer); returns the batch loss.
+ * The step counter and PRNG seed advance automatically. */
+int MXTpuTrainerStep(MXTpuTrainerHandle h, float* loss_out);
+
+/* Live learning-rate control (the lr schedule lives with the embedder;
+ * ref: optimizer set_learning_rate). */
+int MXTpuTrainerSetLearningRate(MXTpuTrainerHandle h, float lr);
+int MXTpuTrainerGetLearningRate(MXTpuTrainerHandle h, float* lr);
+
+/* Copy a state tensor device->host (checkpointing / reading weights). */
+int MXTpuTrainerGetState(MXTpuTrainerHandle h, const char* name, void* dst,
+                         size_t nbytes);
+/* Overwrite a state tensor from host bytes (checkpoint restore). */
+int MXTpuTrainerSetState(MXTpuTrainerHandle h, const char* name,
+                         const void* data, size_t nbytes);
+
+const char* MXTpuLastError(void);
+void MXTpuTrainerFree(MXTpuTrainerHandle h);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXTPU_H_ */

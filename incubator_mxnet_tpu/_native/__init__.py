@@ -138,6 +138,66 @@ def predict_lib():
     return lib
 
 
+def train_lib():
+    """C embedding TRAINING runtime over the PJRT C API (src/train.cc;
+    header: include/mxtpu.h — the create/train half of the reference's
+    c_api.cc, collapsed to one compiled step looped from C)."""
+    lib = load("mxtpu_train", ["train.cc"],
+               extra=[f"-I{_TF_INCLUDE}", "-ldl"])
+    if lib is not None and not getattr(lib, "_train_configured", False):
+        lib.MXTpuTrainerCreate.restype = ctypes.c_int
+        lib.MXTpuTrainerCreate.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_void_p)]
+        lib.MXTpuLastError.restype = ctypes.c_char_p
+        for fn in (lib.MXTpuTrainerNumInputs, lib.MXTpuTrainerNumStates):
+            fn.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int)]
+        for fn in (lib.MXTpuTrainerInputName, lib.MXTpuTrainerStateName):
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                           ctypes.POINTER(ctypes.c_char_p)]
+        for fn in (lib.MXTpuTrainerInputShape, lib.MXTpuTrainerStateShape):
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                           ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+                           ctypes.POINTER(ctypes.c_int)]
+        lib.MXTpuTrainerSetInput.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.c_size_t]
+        lib.MXTpuTrainerSetInputND.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p]
+        lib.MXTpuTrainerStep.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float)]
+        lib.MXTpuTrainerSetLearningRate.argtypes = [
+            ctypes.c_void_p, ctypes.c_float]
+        lib.MXTpuTrainerGetLearningRate.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float)]
+        lib.MXTpuTrainerGetState.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.c_size_t]
+        lib.MXTpuTrainerSetState.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.c_size_t]
+        lib.MXTpuTrainerFree.argtypes = [ctypes.c_void_p]
+        lib.MXTpuNDCreate.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p)]
+        lib.MXTpuNDShape.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.POINTER(ctypes.c_int)]
+        lib.MXTpuNDDType.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_int)]
+        lib.MXTpuNDSize.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_size_t)]
+        lib.MXTpuNDData.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_void_p)]
+        lib.MXTpuNDCopyTo.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                      ctypes.c_size_t]
+        lib.MXTpuNDCopyFrom.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                        ctypes.c_size_t]
+        lib.MXTpuNDFree.argtypes = [ctypes.c_void_p]
+        lib._train_configured = True
+    return lib
+
+
 def imgpipe_lib():
     """Native JPEG decode+augment batch pipeline (src/imgpipe.cc; ref:
     iter_image_recordio_2.cc's preprocess-thread parser)."""

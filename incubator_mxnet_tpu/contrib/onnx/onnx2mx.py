@@ -190,6 +190,8 @@ def import_model(model_file):
             axes = a.get("axes")
             if opset >= 18 or len(node.input) > 1:  # axes moved to input 1
                 ax = const_input(node, 1)
+                if ax is None and a.get("noop_with_empty_axes", 0):
+                    return ins[0]
                 axes = tuple(int(x) for x in ax) if ax is not None else axes
             return sym.mean(ins[0], axis=axes,
                             keepdims=bool(a.get("keepdims", 1)))
@@ -197,6 +199,8 @@ def import_model(model_file):
             axes = a.get("axes")
             if opset >= 13 or len(node.input) > 1:  # axes moved to input 1
                 ax = const_input(node, 1)
+                if ax is None and a.get("noop_with_empty_axes", 0):
+                    return ins[0]  # empty axes + noop flag = identity
                 axes = tuple(int(x) for x in ax) if ax is not None else None
             return sym.sum(ins[0], axis=axes,
                            keepdims=bool(a.get("keepdims", 1)))
@@ -204,6 +208,8 @@ def import_model(model_file):
             axes = a.get("axes")
             if opset >= 18 or len(node.input) > 1:  # axes moved to input 1
                 ax = const_input(node, 1)
+                if ax is None and a.get("noop_with_empty_axes", 0):
+                    return ins[0]
                 axes = tuple(int(x) for x in ax) if ax is not None else axes
             return sym.max(ins[0], axis=axes,
                            keepdims=bool(a.get("keepdims", 1)))

@@ -24,7 +24,8 @@ import os
 
 import numpy as np
 
-__all__ = ["export_predictor", "Predictor"]
+__all__ = ["export_predictor", "Predictor", "export_trainer",
+           "TrainerArtifact"]
 
 
 def export_predictor(prefix, symbol, arg_params, aux_params, input_shapes,
@@ -149,6 +150,208 @@ _DTYPE_CODES = {"float32": 0, "float64": 1, "int32": 2, "int64": 3,
                 "uint8": 4, "int8": 5, "bfloat16": 6, "float16": 7,
                 "bool": 8, "uint32": 9, "uint64": 10, "int16": 11,
                 "uint16": 12}
+
+
+# ---------------------------------------------------------------------------
+# Training artifact (.mxt): the ENTIRE train step — forward, backward,
+# optimizer update — AOT-compiled as one StableHLO program, so a C caller
+# trains by looping one executable with device-resident state buffers.
+# This is the TPU-native answer to the reference's create/train C ABI
+# (ref: src/c_api/c_api.cc NDArray/executor/KVStore entry points +
+# cpp-package/example/mlp.cpp): instead of re-exposing a graph builder to
+# C, the graph is built and differentiated in Python once, and C embeds
+# the compiled result.  Consumed by src/train.cc (header: include/mxtpu.h).
+# ---------------------------------------------------------------------------
+
+
+def export_trainer(prefix, net, loss_fn, optimizer, x_shape, y_shape,
+                   dtype="float32", label_dtype="float32"):
+    """AOT-export net+loss+optimizer as a standalone TRAINING artifact.
+
+    Writes `prefix + "-train.mxt"` (single-file C-embedding artifact:
+    StableHLO train step + initial param/optimizer-state payloads) and
+    `prefix + "-train.stablehlo"` (jax.export serialization for the Python
+    `TrainerArtifact` replay).  The program's signature is
+        (states..., x, y, __seed, __lr, __t) -> (states'..., loss)
+    where the first len(states) outputs carry the SAME names as the state
+    args — the embedding runtime feeds each step's state outputs back as
+    the next step's state inputs (the kvstore/optimizer round trip of the
+    reference, collapsed into buffer rotation).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jexport
+
+    from . import fused
+    from .ndarray.ndarray import NDArray
+
+    step = fused.GluonTrainStep(net, loss_fn, optimizer)
+    x0 = NDArray(jnp.zeros(tuple(x_shape), jnp.dtype(dtype)))
+    y0 = NDArray(jnp.zeros(tuple(y_shape), jnp.dtype(label_dtype)))
+    step._build(x0, y0)
+
+    # named flat state: every param, then every optimizer-state leaf
+    state_names, state_vals = [], []
+    for n, d in zip(step.names, step._params):
+        state_names.append("param:" + n)
+        state_vals.append(d)
+    state_struct = []  # per-param recipe: None | -1 (single) | k (tuple)
+    for n, s in zip(step.names, step._states):
+        if s is None:
+            state_struct.append(None)
+        elif isinstance(s, tuple):
+            state_struct.append(len(s))
+            for j, e in enumerate(s):
+                state_names.append(f"opt:{n}:{j}")
+                state_vals.append(e)
+        else:
+            state_struct.append(-1)
+            state_names.append("opt:" + n)
+            state_vals.append(s)
+    n_params = len(step.names)
+
+    def flat_step(state, x, y, seed, lr, t):
+        params = list(state[:n_params])
+        it = iter(state[n_params:])
+        states = []
+        for spec in state_struct:
+            if spec is None:
+                states.append(None)
+            elif spec == -1:
+                states.append(next(it))
+            else:
+                states.append(tuple(next(it) for _ in range(spec)))
+        key = jax.random.PRNGKey(seed)
+        loss, new_params, new_states = step._step_fn(
+            params, states, x, y, key, lr, t)
+        out = list(new_params)
+        for spec, st in zip(state_struct, new_states):
+            if spec is None:
+                continue
+            if spec == -1:
+                out.append(st)
+            else:
+                out.extend(st)
+        return tuple(out) + (loss,)
+
+    state_spec = tuple(jax.ShapeDtypeStruct(np.shape(v),
+                                            np.asarray(v).dtype)
+                       for v in state_vals)
+    scalar = jax.ShapeDtypeStruct((), jnp.float32)
+    exported = jexport.export(jax.jit(flat_step))(
+        state_spec,
+        jax.ShapeDtypeStruct(tuple(x_shape), jnp.dtype(dtype)),
+        jax.ShapeDtypeStruct(tuple(y_shape), jnp.dtype(label_dtype)),
+        jax.ShapeDtypeStruct((), jnp.uint32), scalar, scalar)
+
+    with open(prefix + "-train.stablehlo", "wb") as f:
+        f.write(exported.serialize())
+    np.savez(prefix + "-train.npz",
+             **{f"state:{n}": np.asarray(v)
+                for n, v in zip(state_names, state_vals)},
+             __meta__=np.frombuffer(json.dumps({
+                 "state_names": state_names,
+                 "x_shape": list(x_shape), "y_shape": list(y_shape),
+                 "dtype": dtype, "label_dtype": label_dtype,
+                 "lr": float(getattr(optimizer, "lr", 0.01)),
+             }).encode(), dtype=np.uint8))
+    _write_mxt(prefix + "-train.mxt", exported, state_names, state_vals,
+               {"x": (tuple(x_shape), dtype),
+                "y": (tuple(y_shape), label_dtype)},
+               float(getattr(optimizer, "lr", 0.01)))
+    return prefix + "-train.mxt"
+
+
+def _write_mxt(path, exported, state_names, state_vals, input_specs,
+               default_lr):
+    """MXTPU002 single-file training artifact: like .mxp, plus a default
+    learning rate and named outputs wiring state feedback (output name ==
+    state arg name)."""
+    import struct
+
+    from jax._src import compiler as _jc
+
+    copts = _jc.get_compile_options(num_replicas=1,
+                                    num_partitions=1).SerializeAsString()
+    shlo = exported.mlir_module_serialized
+
+    args = []  # (kind, name, dtype_name, shape, payload-or-None)
+    for name, v in zip(state_names, state_vals):
+        v = np.asarray(v)
+        args.append((1, name, v.dtype.name, v.shape, v))
+    for name, (shape, dt) in input_specs.items():
+        args.append((0, name, dt, shape, None))
+    for name, dt in (("__seed", "uint32"), ("__lr", "float32"),
+                     ("__t", "float32")):
+        args.append((0, name, dt, (), None))
+
+    kept = getattr(exported, "module_kept_var_idx", None)
+    if kept is not None:
+        args = [args[i] for i in kept]
+
+    out_names = list(state_names) + ["__loss"]
+    outs = [(o.dtype.name if hasattr(o, "dtype") else "float32",
+             tuple(getattr(o, "shape", ())), n)
+            for o, n in zip(exported.out_avals, out_names)]
+
+    with open(path, "wb") as f:
+        f.write(b"MXTPU002")
+        f.write(struct.pack("<IIQQ", len(args), len(outs),
+                            len(copts), len(shlo)))
+        f.write(struct.pack("<fI", default_lr, 0))
+        for kind, name, dt, shape, payload in args:
+            nb = np.dtype(dt).itemsize * int(np.prod(shape)) if shape else \
+                np.dtype(dt).itemsize
+            nm = name.encode()
+            f.write(struct.pack("<BBBB", kind, _DTYPE_CODES[dt],
+                                len(shape), 0))
+            f.write(struct.pack("<I", len(nm)))
+            f.write(nm)
+            f.write(struct.pack(f"<{len(shape)}q", *shape))
+            f.write(struct.pack("<Q", nb))
+        for dt, shape, name in outs:
+            nm = name.encode()
+            f.write(struct.pack("<BBH", _DTYPE_CODES[dt], len(shape), 0))
+            f.write(struct.pack("<I", len(nm)))
+            f.write(nm)
+            f.write(struct.pack(f"<{len(shape)}q", *shape))
+        f.write(copts)
+        f.write(shlo)
+        for kind, _name, _dt, _shape, payload in args:
+            if kind == 1:
+                f.write(np.ascontiguousarray(payload).tobytes())
+    return path
+
+
+class TrainerArtifact:
+    """Python replay of an exported training artifact — the same program a
+    C embedder runs (src/train.cc), driven through jax.export.  Used to
+    validate artifacts without a PJRT plugin and as the reference
+    implementation for the C runtime's step loop."""
+
+    def __init__(self, prefix):
+        from jax import export as jexport
+
+        with open(prefix + "-train.stablehlo", "rb") as f:
+            self._exported = jexport.deserialize(bytearray(f.read()))
+        z = np.load(prefix + "-train.npz")
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        self.state_names = meta["state_names"]
+        self._state = [np.asarray(z["state:" + n]) for n in self.state_names]
+        self.lr = float(meta["lr"])
+        self._t = 0
+
+    def step(self, x, y, seed=None):
+        self._t += 1
+        out = self._exported.call(
+            tuple(self._state), np.asarray(x), np.asarray(y),
+            np.uint32(self._t if seed is None else seed),
+            np.float32(self.lr), np.float32(self._t))
+        self._state = [np.asarray(o) for o in out[:len(self._state)]]
+        return float(out[-1])
+
+    def get_state(self, name):
+        return self._state[self.state_names.index(name)]
 
 
 def _write_mxp(path, exported, input_shapes, in_dtype, params_np, aux_np,

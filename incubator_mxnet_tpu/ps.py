@@ -273,7 +273,15 @@ class ParameterServer:
         self._barrier_gen = 0
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((host, port))
+        try:
+            self._sock.bind((host, port))
+        except OSError:
+            # the advertised address is not a local interface (NAT'd
+            # external IP, docker-mapped name): fall back to all
+            # interfaces so the job still comes up — the data plane is
+            # pickle-free either way
+            self.host = host = "0.0.0.0"
+            self._sock.bind((host, port))
         self._sock.listen(num_workers + 2)
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()

@@ -1,0 +1,806 @@
+// train.cc — C embedding runtime for .mxt TRAINING artifacts over the
+// PJRT C API (ref role: src/c_api/c_api.cc — the create/train half of the
+// reference's C ABI; cpp-package/example/mlp.cpp is the canonical caller).
+//
+// Where the reference re-exposes a graph builder + per-op executor to C,
+// the TPU design embeds the COMPILED train step: forward, backward and the
+// optimizer update are one XLA program (exported by
+// incubator_mxnet_tpu.deploy.export_trainer), and this runtime loops it
+// with parameters/optimizer state resident in device HBM.  Each step's
+// state outputs become the next step's state inputs (buffer rotation —
+// the kvstore push/pull round trip collapsed to zero copies).
+//
+// Artifact format "MXTPU002" (deploy._write_mxt):
+//   8B   magic
+//   u32  n_args, u32 n_outputs
+//   u64  copts_size, u64 stablehlo_size
+//   f32  default_lr, u32 pad
+//   per arg:    u8 kind(0=input,1=state) u8 dtype u8 ndim u8 pad
+//               u32 name_len, name, i64 dims[ndim], u64 nbytes
+//   per output: u8 dtype u8 ndim u16 pad u32 name_len, name, i64 dims
+//   copts bytes, stablehlo bytes, state payloads in arg order
+//
+// Auto-managed scalar args (by name): "__seed" (u32, +1 per step),
+// "__lr" (f32, settable), "__t" (f32 step counter).  Any of them may be
+// absent — jax.export DCEs args the program never reads.
+
+#include <dlfcn.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "tensorflow/compiler/xla/pjrt/c/pjrt_c_api.h"
+#include "../include/mxtpu.h"
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+size_t dtype_size(int code) {
+  switch (code) {
+    case 0: return 4;   // f32
+    case 1: return 8;   // f64
+    case 2: return 4;   // s32
+    case 3: return 8;   // s64
+    case 4: return 1;   // u8
+    case 5: return 1;   // s8
+    case 6: return 2;   // bf16
+    case 7: return 2;   // f16
+    case 8: return 1;   // bool
+    case 9: return 4;   // u32
+    case 10: return 8;  // u64
+    case 11: return 2;  // s16
+    case 12: return 2;  // u16
+    default: return 0;
+  }
+}
+
+PJRT_Buffer_Type dtype_to_pjrt(uint8_t code) {
+  switch (code) {
+    case 0: return PJRT_Buffer_Type_F32;
+    case 1: return PJRT_Buffer_Type_F64;
+    case 2: return PJRT_Buffer_Type_S32;
+    case 3: return PJRT_Buffer_Type_S64;
+    case 4: return PJRT_Buffer_Type_U8;
+    case 5: return PJRT_Buffer_Type_S8;
+    case 6: return PJRT_Buffer_Type_BF16;
+    case 7: return PJRT_Buffer_Type_F16;
+    case 8: return PJRT_Buffer_Type_PRED;
+    case 9: return PJRT_Buffer_Type_U32;
+    case 10: return PJRT_Buffer_Type_U64;
+    case 11: return PJRT_Buffer_Type_S16;
+    case 12: return PJRT_Buffer_Type_U16;
+    default: return PJRT_Buffer_Type_INVALID;
+  }
+}
+
+struct NDArray {
+  int dtype = 0;
+  std::vector<int64_t> dims;
+  std::vector<char> data;
+};
+
+struct ArgSpec {
+  uint8_t kind;  // 0=input 1=state
+  uint8_t dtype;
+  std::string name;
+  std::vector<int64_t> dims;
+  uint64_t nbytes;
+  std::vector<char> payload;  // state: current host copy (authoritative
+                              // in artifact-only mode; stale once a PJRT
+                              // step has run — GetState then reads d2h)
+  std::vector<char> staged;   // inputs: SetInput data
+  bool staged_set = false;
+};
+
+struct OutSpec {
+  uint8_t dtype;
+  std::string name;
+  std::vector<int64_t> dims;
+};
+
+struct Trainer {
+  std::vector<ArgSpec> args;
+  std::vector<OutSpec> outputs;
+  std::vector<char> copts;
+  std::vector<char> stablehlo;
+  float default_lr = 0.01f;
+
+  std::vector<int> input_idx;  // kind==0, not auto-managed
+  std::vector<int> state_idx;  // kind==1
+  int seed_idx = -1, lr_idx = -1, t_idx = -1;
+  std::unordered_map<std::string, int> arg_by_name;
+  std::vector<int> out_feedback;  // per output: arg idx to rotate into
+  int loss_out = -1;
+
+  float lr = 0.01f;
+  uint32_t t = 0;
+
+  void* plugin = nullptr;
+  const PJRT_Api* api = nullptr;
+  PJRT_Client* client = nullptr;
+  PJRT_Device* device = nullptr;
+  PJRT_LoadedExecutable* exec = nullptr;
+  size_t num_outputs = 0;
+  std::vector<PJRT_Buffer*> state_bufs;  // per arg index (null for inputs)
+};
+
+bool read_exact(FILE* f, void* dst, size_t n) {
+  return fread(dst, 1, n, f) == n;
+}
+
+bool check_pjrt_error(const PJRT_Api* api, PJRT_Error* err,
+                      const char* what) {
+  if (err == nullptr) return true;
+  PJRT_Error_Message_Args margs;
+  memset(&margs, 0, sizeof margs);
+  margs.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+  margs.error = err;
+  api->PJRT_Error_Message(&margs);
+  set_error(std::string(what) + ": " +
+            std::string(margs.message, margs.message_size));
+  PJRT_Error_Destroy_Args dargs;
+  memset(&dargs, 0, sizeof dargs);
+  dargs.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  dargs.error = err;
+  api->PJRT_Error_Destroy(&dargs);
+  return false;
+}
+
+bool await_event(const PJRT_Api* api, PJRT_Event* ev, const char* what) {
+  PJRT_Event_Await_Args aargs;
+  memset(&aargs, 0, sizeof aargs);
+  aargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+  aargs.event = ev;
+  PJRT_Error* err = api->PJRT_Event_Await(&aargs);
+  PJRT_Event_Destroy_Args dargs;
+  memset(&dargs, 0, sizeof dargs);
+  dargs.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+  dargs.event = ev;
+  api->PJRT_Event_Destroy(&dargs);
+  return check_pjrt_error(api, err, what);
+}
+
+void destroy_buffer(const PJRT_Api* api, PJRT_Buffer* b) {
+  if (b == nullptr) return;
+  PJRT_Buffer_Destroy_Args dargs;
+  memset(&dargs, 0, sizeof dargs);
+  dargs.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+  dargs.buffer = b;
+  api->PJRT_Buffer_Destroy(&dargs);
+}
+
+void destroy_trainer(Trainer* p) {
+  if (p == nullptr) return;
+  if (p->api != nullptr) {
+    for (PJRT_Buffer* b : p->state_bufs) destroy_buffer(p->api, b);
+    if (p->exec != nullptr) {
+      PJRT_LoadedExecutable_Destroy_Args dargs;
+      memset(&dargs, 0, sizeof dargs);
+      dargs.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+      dargs.executable = p->exec;
+      p->api->PJRT_LoadedExecutable_Destroy(&dargs);
+    }
+    if (p->client != nullptr) {
+      PJRT_Client_Destroy_Args dargs;
+      memset(&dargs, 0, sizeof dargs);
+      dargs.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+      dargs.client = p->client;
+      p->api->PJRT_Client_Destroy(&dargs);
+    }
+  }
+  if (p->plugin != nullptr) dlclose(p->plugin);
+  delete p;
+}
+
+bool load_artifact(Trainer* p, const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) {
+    set_error(std::string("cannot open artifact ") + path);
+    return false;
+  }
+  char magic[8];
+  uint32_t n_args = 0, n_outputs = 0, pad = 0;
+  uint64_t copts_size = 0, shlo_size = 0;
+  bool ok = read_exact(f, magic, 8) && memcmp(magic, "MXTPU002", 8) == 0 &&
+            read_exact(f, &n_args, 4) && read_exact(f, &n_outputs, 4) &&
+            read_exact(f, &copts_size, 8) && read_exact(f, &shlo_size, 8) &&
+            read_exact(f, &p->default_lr, 4) && read_exact(f, &pad, 4);
+  if (!ok) {
+    fclose(f);
+    set_error("bad training artifact header (magic/version mismatch?)");
+    return false;
+  }
+  for (uint32_t i = 0; ok && i < n_args; ++i) {
+    ArgSpec a;
+    uint8_t ndim = 0, apad = 0;
+    uint32_t name_len = 0;
+    ok = read_exact(f, &a.kind, 1) && read_exact(f, &a.dtype, 1) &&
+         read_exact(f, &ndim, 1) && read_exact(f, &apad, 1) &&
+         read_exact(f, &name_len, 4);
+    if (ok) {
+      a.name.resize(name_len);
+      a.dims.resize(ndim);
+      ok = read_exact(f, a.name.data(), name_len) &&
+           read_exact(f, a.dims.data(), sizeof(int64_t) * ndim) &&
+           read_exact(f, &a.nbytes, 8);
+    }
+    if (ok) p->args.push_back(std::move(a));
+  }
+  for (uint32_t i = 0; ok && i < n_outputs; ++i) {
+    OutSpec o;
+    uint8_t ndim = 0;
+    uint16_t opad = 0;
+    uint32_t name_len = 0;
+    ok = read_exact(f, &o.dtype, 1) && read_exact(f, &ndim, 1) &&
+         read_exact(f, &opad, 2) && read_exact(f, &name_len, 4);
+    if (ok) {
+      o.name.resize(name_len);
+      o.dims.resize(ndim);
+      ok = read_exact(f, o.name.data(), name_len) &&
+           read_exact(f, o.dims.data(), sizeof(int64_t) * ndim);
+    }
+    if (ok) p->outputs.push_back(std::move(o));
+  }
+  if (ok) {
+    p->copts.resize(copts_size);
+    p->stablehlo.resize(shlo_size);
+    ok = read_exact(f, p->copts.data(), copts_size) &&
+         read_exact(f, p->stablehlo.data(), shlo_size);
+  }
+  for (size_t i = 0; ok && i < p->args.size(); ++i) {
+    ArgSpec& a = p->args[i];
+    if (a.kind == 1) {
+      a.payload.resize(a.nbytes);
+      ok = read_exact(f, a.payload.data(), a.nbytes);
+    }
+  }
+  fclose(f);
+  if (!ok) {
+    set_error("truncated training artifact");
+    return false;
+  }
+
+  p->lr = p->default_lr;
+  for (size_t i = 0; i < p->args.size(); ++i) {
+    ArgSpec& a = p->args[i];
+    p->arg_by_name[a.name] = static_cast<int>(i);
+    if (a.kind == 1) {
+      p->state_idx.push_back(static_cast<int>(i));
+    } else if (a.name == "__seed") {
+      p->seed_idx = static_cast<int>(i);
+    } else if (a.name == "__lr") {
+      p->lr_idx = static_cast<int>(i);
+    } else if (a.name == "__t") {
+      p->t_idx = static_cast<int>(i);
+    } else {
+      p->input_idx.push_back(static_cast<int>(i));
+    }
+  }
+  p->out_feedback.assign(p->outputs.size(), -1);
+  for (size_t i = 0; i < p->outputs.size(); ++i) {
+    const std::string& n = p->outputs[i].name;
+    if (n == "__loss") {
+      p->loss_out = static_cast<int>(i);
+      continue;
+    }
+    auto it = p->arg_by_name.find(n);
+    if (it != p->arg_by_name.end() && p->args[it->second].kind == 1)
+      p->out_feedback[i] = it->second;
+  }
+  return true;
+}
+
+PJRT_Buffer* upload(Trainer* p, uint8_t dtype,
+                    const std::vector<int64_t>& dims, const void* data) {
+  PJRT_Client_BufferFromHostBuffer_Args bargs;
+  memset(&bargs, 0, sizeof bargs);
+  bargs.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  bargs.client = p->client;
+  bargs.data = data;
+  bargs.type = dtype_to_pjrt(dtype);
+  bargs.dims = dims.data();
+  bargs.num_dims = dims.size();
+  bargs.host_buffer_semantics =
+      PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+  bargs.device = p->device;
+  PJRT_Error* err = p->api->PJRT_Client_BufferFromHostBuffer(&bargs);
+  if (!check_pjrt_error(p->api, err, "BufferFromHostBuffer")) return nullptr;
+  if (!await_event(p->api, bargs.done_with_host_buffer, "h2d transfer"))
+    return nullptr;
+  return bargs.buffer;
+}
+
+bool buffer_to_host(Trainer* p, PJRT_Buffer* src, std::vector<char>* dst) {
+  PJRT_Buffer_ToHostBuffer_Args targs;
+  memset(&targs, 0, sizeof targs);
+  targs.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+  targs.src = src;
+  if (!check_pjrt_error(p->api, p->api->PJRT_Buffer_ToHostBuffer(&targs),
+                        "ToHostBuffer(size)"))
+    return false;
+  dst->resize(targs.dst_size);
+  targs.dst = dst->data();
+  return check_pjrt_error(p->api, p->api->PJRT_Buffer_ToHostBuffer(&targs),
+                          "ToHostBuffer") &&
+         await_event(p->api, targs.event, "d2h transfer");
+}
+
+bool init_pjrt(Trainer* p, const char* plugin_path) {
+  p->plugin = dlopen(plugin_path, RTLD_NOW | RTLD_LOCAL);
+  if (!p->plugin) {
+    set_error(std::string("dlopen failed: ") + dlerror());
+    return false;
+  }
+  using GetApiFn = const PJRT_Api* (*)();
+  auto get_api = reinterpret_cast<GetApiFn>(dlsym(p->plugin, "GetPjrtApi"));
+  if (!get_api) {
+    set_error("plugin has no GetPjrtApi symbol");
+    return false;
+  }
+  p->api = get_api();
+
+  PJRT_Plugin_Initialize_Args iargs;
+  memset(&iargs, 0, sizeof iargs);
+  iargs.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+  if (!check_pjrt_error(p->api, p->api->PJRT_Plugin_Initialize(&iargs),
+                        "Plugin_Initialize"))
+    return false;
+
+  PJRT_Client_Create_Args cargs;
+  memset(&cargs, 0, sizeof cargs);
+  cargs.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  if (!check_pjrt_error(p->api, p->api->PJRT_Client_Create(&cargs),
+                        "Client_Create"))
+    return false;
+  p->client = cargs.client;
+
+  PJRT_Client_AddressableDevices_Args dargs;
+  memset(&dargs, 0, sizeof dargs);
+  dargs.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  dargs.client = p->client;
+  if (!check_pjrt_error(p->api,
+                        p->api->PJRT_Client_AddressableDevices(&dargs),
+                        "AddressableDevices"))
+    return false;
+  if (dargs.num_addressable_devices == 0) {
+    set_error("no addressable devices");
+    return false;
+  }
+  p->device = dargs.addressable_devices[0];
+
+  PJRT_Program program;
+  memset(&program, 0, sizeof program);
+  program.struct_size = PJRT_Program_STRUCT_SIZE;
+  program.code = p->stablehlo.data();
+  program.code_size = p->stablehlo.size();
+  static const char kFormat[] = "mlir";
+  program.format = kFormat;
+  program.format_size = sizeof(kFormat) - 1;
+
+  PJRT_Client_Compile_Args pargs;
+  memset(&pargs, 0, sizeof pargs);
+  pargs.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  pargs.client = p->client;
+  pargs.program = &program;
+  pargs.compile_options = p->copts.data();
+  pargs.compile_options_size = p->copts.size();
+  if (!check_pjrt_error(p->api, p->api->PJRT_Client_Compile(&pargs),
+                        "Compile"))
+    return false;
+  p->exec = pargs.executable;
+
+  PJRT_LoadedExecutable_GetExecutable_Args gargs;
+  memset(&gargs, 0, sizeof gargs);
+  gargs.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+  gargs.loaded_executable = p->exec;
+  if (!check_pjrt_error(p->api,
+                        p->api->PJRT_LoadedExecutable_GetExecutable(&gargs),
+                        "GetExecutable"))
+    return false;
+  PJRT_Executable_NumOutputs_Args nargs;
+  memset(&nargs, 0, sizeof nargs);
+  nargs.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+  nargs.executable = gargs.executable;
+  bool ok = check_pjrt_error(p->api,
+                             p->api->PJRT_Executable_NumOutputs(&nargs),
+                             "NumOutputs");
+  PJRT_Executable_Destroy_Args edargs;
+  memset(&edargs, 0, sizeof edargs);
+  edargs.struct_size = PJRT_Executable_Destroy_Args_STRUCT_SIZE;
+  edargs.executable = gargs.executable;
+  p->api->PJRT_Executable_Destroy(&edargs);
+  if (!ok) return false;
+  p->num_outputs = nargs.num_outputs;
+
+  // initial state -> device
+  p->state_bufs.assign(p->args.size(), nullptr);
+  for (int i : p->state_idx) {
+    const ArgSpec& a = p->args[i];
+    PJRT_Buffer* buf = upload(p, a.dtype, a.dims, a.payload.data());
+    if (!buf) return false;
+    p->state_bufs[i] = buf;
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* MXTpuLastError(void) { return g_last_error.c_str(); }
+
+/* ------------------------------- NDArray ------------------------------- */
+
+int MXTpuNDCreate(int dtype, int ndim, const int64_t* dims,
+                  const void* data, MXTpuNDHandle* out) {
+  size_t elt = dtype_size(dtype);
+  if (elt == 0) {
+    set_error("bad dtype code " + std::to_string(dtype));
+    return 1;
+  }
+  if (ndim < 0 || (ndim > 0 && dims == nullptr)) {
+    set_error("bad shape");
+    return 1;
+  }
+  auto* nd = new NDArray();
+  nd->dtype = dtype;
+  size_t n = 1;
+  for (int i = 0; i < ndim; ++i) {
+    if (dims[i] < 0) {
+      delete nd;
+      set_error("negative dimension");
+      return 1;
+    }
+    nd->dims.push_back(dims[i]);
+    n *= static_cast<size_t>(dims[i]);
+  }
+  nd->data.assign(n * elt, 0);
+  if (data != nullptr) memcpy(nd->data.data(), data, n * elt);
+  *out = nd;
+  return 0;
+}
+
+int MXTpuNDShape(MXTpuNDHandle h, const int64_t** dims, int* ndim) {
+  auto* nd = static_cast<NDArray*>(h);
+  *dims = nd->dims.data();
+  *ndim = static_cast<int>(nd->dims.size());
+  return 0;
+}
+
+int MXTpuNDDType(MXTpuNDHandle h, int* dtype) {
+  *dtype = static_cast<NDArray*>(h)->dtype;
+  return 0;
+}
+
+int MXTpuNDSize(MXTpuNDHandle h, size_t* nbytes) {
+  *nbytes = static_cast<NDArray*>(h)->data.size();
+  return 0;
+}
+
+int MXTpuNDData(MXTpuNDHandle h, void** data) {
+  *data = static_cast<NDArray*>(h)->data.data();
+  return 0;
+}
+
+int MXTpuNDCopyTo(MXTpuNDHandle h, void* dst, size_t nbytes) {
+  auto* nd = static_cast<NDArray*>(h);
+  if (nbytes < nd->data.size()) {
+    set_error("destination too small");
+    return 1;
+  }
+  memcpy(dst, nd->data.data(), nd->data.size());
+  return 0;
+}
+
+int MXTpuNDCopyFrom(MXTpuNDHandle h, const void* src, size_t nbytes) {
+  auto* nd = static_cast<NDArray*>(h);
+  if (nbytes != nd->data.size()) {
+    set_error("size mismatch: expected " + std::to_string(nd->data.size()) +
+              " bytes, got " + std::to_string(nbytes));
+    return 1;
+  }
+  memcpy(nd->data.data(), src, nbytes);
+  return 0;
+}
+
+void MXTpuNDFree(MXTpuNDHandle h) { delete static_cast<NDArray*>(h); }
+
+/* ------------------------------- Trainer ------------------------------- */
+
+int MXTpuTrainerCreate(const char* artifact_path,
+                       const char* pjrt_plugin_path,
+                       MXTpuTrainerHandle* out) {
+  auto* p = new Trainer();
+  if (!load_artifact(p, artifact_path)) {
+    delete p;
+    return 1;
+  }
+  if (pjrt_plugin_path != nullptr && !init_pjrt(p, pjrt_plugin_path)) {
+    destroy_trainer(p);
+    return 2;
+  }
+  *out = p;
+  return 0;
+}
+
+int MXTpuTrainerNumInputs(MXTpuTrainerHandle h, int* out) {
+  *out = static_cast<int>(static_cast<Trainer*>(h)->input_idx.size());
+  return 0;
+}
+
+int MXTpuTrainerInputName(MXTpuTrainerHandle h, int idx, const char** out) {
+  auto* p = static_cast<Trainer*>(h);
+  if (idx < 0 || idx >= static_cast<int>(p->input_idx.size())) return 1;
+  *out = p->args[p->input_idx[idx]].name.c_str();
+  return 0;
+}
+
+int MXTpuTrainerInputShape(MXTpuTrainerHandle h, int idx,
+                           const int64_t** dims, int* ndim) {
+  auto* p = static_cast<Trainer*>(h);
+  if (idx < 0 || idx >= static_cast<int>(p->input_idx.size())) return 1;
+  const ArgSpec& a = p->args[p->input_idx[idx]];
+  *dims = a.dims.data();
+  *ndim = static_cast<int>(a.dims.size());
+  return 0;
+}
+
+int MXTpuTrainerNumStates(MXTpuTrainerHandle h, int* out) {
+  *out = static_cast<int>(static_cast<Trainer*>(h)->state_idx.size());
+  return 0;
+}
+
+int MXTpuTrainerStateName(MXTpuTrainerHandle h, int idx, const char** out) {
+  auto* p = static_cast<Trainer*>(h);
+  if (idx < 0 || idx >= static_cast<int>(p->state_idx.size())) return 1;
+  *out = p->args[p->state_idx[idx]].name.c_str();
+  return 0;
+}
+
+int MXTpuTrainerStateShape(MXTpuTrainerHandle h, int idx,
+                           const int64_t** dims, int* ndim) {
+  auto* p = static_cast<Trainer*>(h);
+  if (idx < 0 || idx >= static_cast<int>(p->state_idx.size())) return 1;
+  const ArgSpec& a = p->args[p->state_idx[idx]];
+  *dims = a.dims.data();
+  *ndim = static_cast<int>(a.dims.size());
+  return 0;
+}
+
+int MXTpuTrainerSetInput(MXTpuTrainerHandle h, const char* name,
+                         const void* data, size_t nbytes) {
+  auto* p = static_cast<Trainer*>(h);
+  for (int i : p->input_idx) {
+    ArgSpec& a = p->args[i];
+    if (a.name == name) {
+      if (nbytes != a.nbytes) {
+        set_error("SetInput " + a.name + ": expected " +
+                  std::to_string(a.nbytes) + " bytes, got " +
+                  std::to_string(nbytes));
+        return 1;
+      }
+      a.staged.assign(static_cast<const char*>(data),
+                      static_cast<const char*>(data) + nbytes);
+      a.staged_set = true;
+      return 0;
+    }
+  }
+  set_error(std::string("unknown input ") + name);
+  return 1;
+}
+
+int MXTpuTrainerSetInputND(MXTpuTrainerHandle h, const char* name,
+                           MXTpuNDHandle ndh) {
+  auto* p = static_cast<Trainer*>(h);
+  auto* nd = static_cast<NDArray*>(ndh);
+  auto it = p->arg_by_name.find(name);
+  if (it == p->arg_by_name.end() || p->args[it->second].kind != 0) {
+    set_error(std::string("unknown input ") + name);
+    return 1;
+  }
+  const ArgSpec& a = p->args[it->second];
+  if (nd->dtype != a.dtype) {
+    set_error("SetInputND " + a.name + ": dtype code " +
+              std::to_string(nd->dtype) + " != spec " +
+              std::to_string(a.dtype));
+    return 1;
+  }
+  if (nd->dims != a.dims) {
+    set_error("SetInputND " + a.name + ": shape mismatch");
+    return 1;
+  }
+  return MXTpuTrainerSetInput(h, name, nd->data.data(), nd->data.size());
+}
+
+int MXTpuTrainerSetLearningRate(MXTpuTrainerHandle h, float lr) {
+  static_cast<Trainer*>(h)->lr = lr;
+  return 0;
+}
+
+int MXTpuTrainerGetLearningRate(MXTpuTrainerHandle h, float* lr) {
+  *lr = static_cast<Trainer*>(h)->lr;
+  return 0;
+}
+
+int MXTpuTrainerStep(MXTpuTrainerHandle h, float* loss_out) {
+  auto* p = static_cast<Trainer*>(h);
+  if (p->api == nullptr) {
+    set_error("trainer created without a PJRT plugin (artifact-only mode)");
+    return 1;
+  }
+  p->t += 1;
+  float t_f = static_cast<float>(p->t);
+  uint32_t seed = p->t;
+
+  std::vector<PJRT_Buffer*> arg_bufs(p->args.size(), nullptr);
+  std::vector<PJRT_Buffer*> owned;
+  bool ok = true;
+  for (size_t i = 0; ok && i < p->args.size(); ++i) {
+    ArgSpec& a = p->args[i];
+    if (a.kind == 1) {
+      arg_bufs[i] = p->state_bufs[i];
+      continue;
+    }
+    const void* src = nullptr;
+    if (static_cast<int>(i) == p->seed_idx) {
+      src = &seed;
+    } else if (static_cast<int>(i) == p->lr_idx) {
+      src = &p->lr;
+    } else if (static_cast<int>(i) == p->t_idx) {
+      src = &t_f;
+    } else {
+      if (!a.staged_set) {
+        set_error("input " + a.name + " not set");
+        ok = false;
+        break;
+      }
+      src = a.staged.data();
+    }
+    PJRT_Buffer* buf = upload(p, a.dtype, a.dims, src);
+    if (buf == nullptr) {
+      ok = false;
+      break;
+    }
+    arg_bufs[i] = buf;
+    owned.push_back(buf);
+  }
+  if (!ok) {
+    p->t -= 1;
+    for (PJRT_Buffer* b : owned) destroy_buffer(p->api, b);
+    return 1;
+  }
+
+  size_t n_out = p->num_outputs;
+  std::vector<PJRT_Buffer*> out_row(n_out, nullptr);
+  PJRT_Buffer** out_lists[1] = {out_row.data()};
+  PJRT_Buffer* const* arg_lists[1] = {arg_bufs.data()};
+  PJRT_Event* done[1] = {nullptr};
+
+  PJRT_ExecuteOptions opts;
+  memset(&opts, 0, sizeof opts);
+  opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+
+  PJRT_LoadedExecutable_Execute_Args eargs;
+  memset(&eargs, 0, sizeof eargs);
+  eargs.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  eargs.executable = p->exec;
+  eargs.options = &opts;
+  eargs.argument_lists = arg_lists;
+  eargs.num_devices = 1;
+  eargs.num_args = arg_bufs.size();
+  eargs.output_lists = out_lists;
+  eargs.device_complete_events = done;
+  ok = check_pjrt_error(p->api,
+                        p->api->PJRT_LoadedExecutable_Execute(&eargs),
+                        "Execute");
+  if (ok && done[0] != nullptr) ok = await_event(p->api, done[0], "execute");
+
+  float loss = 0.0f;
+  if (ok) {
+    // rotate state: this step's outputs become the next step's inputs
+    for (size_t i = 0; i < n_out && i < p->out_feedback.size(); ++i) {
+      int arg = p->out_feedback[i];
+      if (arg >= 0) {
+        destroy_buffer(p->api, p->state_bufs[arg]);
+        p->state_bufs[arg] = out_row[i];
+        out_row[i] = nullptr;
+      }
+    }
+    if (p->loss_out >= 0 && p->loss_out < static_cast<int>(n_out)) {
+      std::vector<char> host;
+      uint8_t ldt = p->outputs[p->loss_out].dtype;
+      if (!buffer_to_host(p, out_row[p->loss_out], &host)) {
+        ok = false;
+      } else if (ldt == 0 && host.size() >= 4) {  // f32
+        memcpy(&loss, host.data(), 4);
+      } else if (ldt == 1 && host.size() >= 8) {  // f64
+        double d;
+        memcpy(&d, host.data(), 8);
+        loss = static_cast<float>(d);
+      } else if (ldt == 6 && host.size() >= 2) {  // bf16: widen to f32
+        uint32_t bits = static_cast<uint32_t>(
+                            *reinterpret_cast<uint16_t*>(host.data()))
+                        << 16;
+        memcpy(&loss, &bits, 4);
+      } else {
+        set_error("unsupported loss dtype code " + std::to_string(ldt));
+        ok = false;
+      }
+    }
+  }
+
+  for (PJRT_Buffer* b : out_row) destroy_buffer(p->api, b);
+  for (PJRT_Buffer* b : owned) destroy_buffer(p->api, b);
+  if (!ok) {
+    p->t -= 1;
+    return 1;
+  }
+  if (loss_out != nullptr) *loss_out = loss;
+  return 0;
+}
+
+int MXTpuTrainerGetState(MXTpuTrainerHandle h, const char* name, void* dst,
+                         size_t nbytes) {
+  auto* p = static_cast<Trainer*>(h);
+  auto it = p->arg_by_name.find(name);
+  if (it == p->arg_by_name.end() || p->args[it->second].kind != 1) {
+    set_error(std::string("unknown state ") + name);
+    return 1;
+  }
+  ArgSpec& a = p->args[it->second];
+  if (nbytes < a.nbytes) {
+    set_error("GetState " + a.name + ": buffer too small");
+    return 1;
+  }
+  if (p->api == nullptr || p->state_bufs.empty() ||
+      p->state_bufs[it->second] == nullptr) {
+    memcpy(dst, a.payload.data(), a.nbytes);  // artifact-only: initial value
+    return 0;
+  }
+  std::vector<char> host;
+  if (!buffer_to_host(p, p->state_bufs[it->second], &host)) return 1;
+  if (host.size() < a.nbytes) {
+    set_error("GetState " + a.name + ": device buffer smaller than spec");
+    return 1;
+  }
+  memcpy(dst, host.data(), a.nbytes);
+  return 0;
+}
+
+int MXTpuTrainerSetState(MXTpuTrainerHandle h, const char* name,
+                         const void* data, size_t nbytes) {
+  auto* p = static_cast<Trainer*>(h);
+  auto it = p->arg_by_name.find(name);
+  if (it == p->arg_by_name.end() || p->args[it->second].kind != 1) {
+    set_error(std::string("unknown state ") + name);
+    return 1;
+  }
+  ArgSpec& a = p->args[it->second];
+  if (nbytes != a.nbytes) {
+    set_error("SetState " + a.name + ": expected " +
+              std::to_string(a.nbytes) + " bytes, got " +
+              std::to_string(nbytes));
+    return 1;
+  }
+  memcpy(a.payload.data(), data, nbytes);
+  if (p->api != nullptr && !p->state_bufs.empty()) {
+    PJRT_Buffer* buf = upload(p, a.dtype, a.dims, a.payload.data());
+    if (buf == nullptr) return 1;
+    destroy_buffer(p->api, p->state_bufs[it->second]);
+    p->state_bufs[it->second] = buf;
+  }
+  return 0;
+}
+
+void MXTpuTrainerFree(MXTpuTrainerHandle h) {
+  destroy_trainer(static_cast<Trainer*>(h));
+}
+
+}  // extern "C"

@@ -58,13 +58,16 @@ def main():
                 f"MXTPU_NUM_PROCESSES={args.num_workers} "
                 f"MXTPU_PROCESS_ID={rank}"
             )
-            # the job secret rides stdin, NOT the command line — remote
-            # /proc/<pid>/cmdline is world-readable
-            p = subprocess.Popen(
-                ["ssh", host,
-                 "IFS= read -r MXTPU_PS_SECRET && export MXTPU_PS_SECRET && "
-                 + remote_env + " " + " ".join(cmd)],
-                stdin=subprocess.PIPE, text=True)
+            # the job secret rides the first stdin line, NOT the command
+            # line (remote /proc/<pid>/cmdline is world-readable); the
+            # explicit `sh -c` keeps this independent of the remote login
+            # shell.  Launched commands do not receive the parent's stdin
+            # (training jobs are non-interactive).
+            remote_cmd = ("exec /bin/sh -c 'IFS= read -r MXTPU_PS_SECRET; "
+                          "export MXTPU_PS_SECRET; exec env " + remote_env +
+                          " " + " ".join(cmd) + "'")
+            p = subprocess.Popen(["ssh", host, remote_cmd],
+                                 stdin=subprocess.PIPE, text=True)
             p.stdin.write(ps_secret + "\n")
             p.stdin.close()
             procs.append(p)
