@@ -27,9 +27,10 @@ run_examples() {
 }
 
 run_nightly() {
-    echo "=== nightly tier (large tensors, checkpoint compat) ==="
+    echo "=== nightly tier (large tensors, checkpoint compat, 7-worker dist) ==="
     MXTPU_NIGHTLY=1 python -m pytest tests/test_large_array.py \
         tests/test_checkpoint_compat.py -q
+    MXTPU_NIGHTLY=1 python -m pytest tests/test_dist.py -q -k seven
 }
 
 case "$tier" in

@@ -116,6 +116,11 @@ register_knob("MXTPU_HEARTBEAT_DIR", "", str,
               "default derives from MXTPU_COORDINATOR).")
 register_knob("MXTPU_HEARTBEAT_INTERVAL", 2.0, float,
               "Seconds between heartbeat touches.")
+register_knob("MXTPU_HEARTBEAT_TRANSPORT", "auto", str,
+              "Dead-node heartbeat transport: 'tcp' (rides the PS control "
+              "plane on coordinator port + 29; works cross-host), 'file' "
+              "(shared-filesystem mtimes), or 'auto' (tcp when a "
+              "coordinator is configured, else file).")
 register_knob("MXTPU_HEARTBEAT_TIMEOUT", 20.0, float,
               "Heartbeat staleness after which a peer counts as dead "
               "(ref: ps-lite PS_HEARTBEAT_TIMEOUT).")

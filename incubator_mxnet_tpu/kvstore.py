@@ -618,6 +618,68 @@ def _key_int(key):
     return key
 
 
+class _TcpHeartbeat:
+    """TCP worker heartbeats for dead-node detection (ref: ps-lite
+    Heartbeat/GetDeadNodes over zmq), riding the PS control plane: rank 0
+    hosts a heartbeat service (a ParameterServer instance on coordinator
+    port + 29), every worker beats its rank over a socket from a daemon
+    thread, and `num_dead` is answered server-side from beat staleness.
+    Works cross-host with no shared-filesystem assumption."""
+
+    _singleton = None
+    _singleton_lock = threading.Lock()
+
+    def __init__(self, rank, num_workers, host, port, interval, timeout):
+        from . import ps as _ps
+
+        self.rank = rank
+        self.timeout = timeout
+        self._created = time.time()
+        self._server = None
+        if rank == 0:
+            self._server = _ps.ParameterServer(num_workers, host=host,
+                                               port=port)
+            port = self._server.port
+            host = self._server.host
+        self._client = _ps.PSClient(host, port)
+        self._client.heartbeat(rank)
+        self._stop = threading.Event()
+        self._interval = interval
+        t = threading.Thread(target=self._loop, daemon=True,
+                             name="mxtpu-heartbeat")
+        t.start()
+
+    @classmethod
+    def get(cls, rank, num_workers, host, port, interval, timeout):
+        """One heartbeat service per process, shared by every kvstore
+        instance (a second bind on the port would otherwise fail)."""
+        with cls._singleton_lock:
+            if cls._singleton is None:
+                cls._singleton = cls(rank, num_workers, host, port,
+                                     interval, timeout)
+            return cls._singleton
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self._client.heartbeat(self.rank)
+            except (ConnectionError, OSError, RuntimeError):
+                pass  # server gone; num_dead will surface it
+
+    def num_dead(self):
+        # never-seen peers count as dead only once THIS observer's own
+        # startup grace has passed (parity with the file transport)
+        grace = time.time() - self._created > self.timeout
+        try:
+            return int(self._client.num_dead(self.rank, self.timeout,
+                                             grace))
+        except (ConnectionError, OSError, RuntimeError):
+            return 1  # the coordinator itself is unreachable
+
+    def stop(self):
+        self._stop.set()
+
+
 class _Heartbeat:
     """File-based worker heartbeats for dead-node detection (ref: ps-lite
     heartbeat/GetDeadNodes, surfaced as KVStore::get_num_dead_node
@@ -626,10 +688,10 @@ class _Heartbeat:
     Each worker touches `<dir>/rank_<i>` every MXTPU_HEARTBEAT_INTERVAL
     seconds from a daemon thread; a peer is dead when its file has not been
     touched for MXTPU_HEARTBEAT_TIMEOUT seconds (or never appeared within
-    the timeout of store creation). Works wherever the workers share a
-    filesystem — same-host multi-process (the test/launcher topology) and
-    NFS-backed pods; otherwise detection degrades to 0, matching the
-    reference when ps-lite heartbeats are off.
+    the timeout of store creation). The default transport is the TCP
+    control plane (_TcpHeartbeat) whenever a coordinator is configured;
+    this file transport remains for coordinator-less local jobs and as an
+    explicit opt-in (MXTPU_HEARTBEAT_TRANSPORT=file).
     """
 
     def __init__(self, rank, num_workers, hb_dir, interval, timeout):
@@ -651,13 +713,32 @@ class _Heartbeat:
             return None
         from . import config as _config
 
-        hb_dir = _config.get("MXTPU_HEARTBEAT_DIR")
-        if not hb_dir:
-            coord = _config.get("MXTPU_COORDINATOR") or "local"
-            tag = coord.replace(":", "_").replace("/", "_")
-            hb_dir = os.path.join(tempfile.gettempdir(), f"mxtpu_hb_{tag}")
         interval = _config.get("MXTPU_HEARTBEAT_INTERVAL")
         timeout = _config.get("MXTPU_HEARTBEAT_TIMEOUT")
+        transport = _config.get("MXTPU_HEARTBEAT_TRANSPORT")
+        coord = _config.get("MXTPU_COORDINATOR")
+        if coord and ":" in coord and transport in ("tcp", "auto"):
+            host, port = coord.rsplit(":", 1)
+            try:
+                return _TcpHeartbeat.get(rank, num_workers, host,
+                                         int(port) + 29, interval, timeout)
+            except (OSError, ConnectionError) as e:
+                if transport == "tcp":
+                    # explicit request: never silently downgrade (a split
+                    # transport makes survivors report false dead nodes)
+                    raise RuntimeError(
+                        f"MXTPU_HEARTBEAT_TRANSPORT=tcp but the heartbeat "
+                        f"service at {host}:{int(port) + 29} is "
+                        f"unreachable: {e}") from e
+                import warnings
+
+                warnings.warn(f"TCP heartbeat service unreachable ({e}); "
+                              "falling back to file heartbeats — dead-node "
+                              "detection requires a shared filesystem")
+        hb_dir = _config.get("MXTPU_HEARTBEAT_DIR")
+        if not hb_dir:
+            tag = (coord or "local").replace(":", "_").replace("/", "_")
+            hb_dir = os.path.join(tempfile.gettempdir(), f"mxtpu_hb_{tag}")
         return cls(rank, num_workers, hb_dir, interval, timeout)
 
     def _path(self, rank):

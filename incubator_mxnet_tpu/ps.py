@@ -26,6 +26,7 @@ import secrets
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
 
@@ -271,17 +272,31 @@ class ParameterServer:
         self._barrier_cv = threading.Condition()
         self._barrier_count = 0
         self._barrier_gen = 0
+        # worker heartbeats (ref: ps-lite Heartbeat/GetDeadNodes) — rides
+        # the same TCP control plane, so dead-node detection works
+        # cross-host with no shared filesystem
+        self._beats = {}
+        self._beats_lock = threading.Lock()
+        self._start_time = time.time()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         try:
             self._sock.bind((host, port))
-        except OSError:
+        except OSError as e:
+            import errno
+            import warnings
+
+            if e.errno != errno.EADDRNOTAVAIL:
+                raise
             # the advertised address is not a local interface (NAT'd
             # external IP, docker-mapped name): fall back to all
-            # interfaces so the job still comes up — the data plane is
-            # pickle-free either way
-            self.host = host = "0.0.0.0"
-            self._sock.bind((host, port))
+            # interfaces so the job still comes up — loudly, since this
+            # widens the listener beyond the coordinator interface
+            warnings.warn(
+                f"parameter server cannot bind {host!r} (not a local "
+                "interface); listening on all interfaces instead")
+            self._sock.bind(("0.0.0.0", port))
+            self.host = "127.0.0.1"  # local clients reach it via loopback
         self._sock.listen(num_workers + 2)
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
@@ -487,6 +502,32 @@ class ParameterServer:
                         "workers present")
         return ("ok",)
 
+    def _cmd_heartbeat(self, rank):
+        with self._beats_lock:
+            self._beats[int(rank)] = time.time()
+        return ("ok",)
+
+    def _cmd_num_dead(self, requester, timeout, grace_elapsed):
+        """Ranks whose heartbeat is stale (or never arrived), excluding the
+        requester — the KVStore::get_num_dead_node analog served over TCP.
+        `grace_elapsed` tells whether the REQUESTER's own startup grace has
+        passed (mirrors the file transport, where never-seen peers count
+        as dead only relative to the observer's start, so late-joining
+        workers are not reported dead by early starters)."""
+        now = time.time()
+        dead = 0
+        with self._beats_lock:
+            for r in range(self.num_workers):
+                if r == int(requester):
+                    continue
+                last = self._beats.get(r)
+                if last is None:
+                    if grace_elapsed and now - self._start_time > timeout:
+                        dead += 1
+                elif now - last > timeout:
+                    dead += 1
+        return ("val", dead)
+
     def _cmd_keys(self):
         return ("val", sorted(self._store, key=str))
 
@@ -581,6 +622,13 @@ class PSClient:
 
     def barrier(self):
         return self._rpc("barrier")
+
+    def heartbeat(self, rank):
+        return self._rpc("heartbeat", int(rank))
+
+    def num_dead(self, rank, timeout, grace_elapsed=True):
+        return self._rpc("num_dead", int(rank), float(timeout),
+                         bool(grace_elapsed))
 
     def keys(self):
         return self._rpc("keys")

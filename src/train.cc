@@ -29,6 +29,7 @@
 #include <stdlib.h>
 #include <string.h>
 
+#include <exception>
 #include <string>
 #include <unordered_map>
 #include <vector>
@@ -205,13 +206,21 @@ bool load_artifact(Trainer* p, const char* path) {
     set_error(std::string("cannot open artifact ") + path);
     return false;
   }
+  // every size field read from the file is validated against the file
+  // size BEFORE any resize — a corrupt artifact must fail with an error
+  // return, never a bad_alloc escaping the C ABI
+  fseek(f, 0, SEEK_END);
+  uint64_t fsize = static_cast<uint64_t>(ftell(f));
+  fseek(f, 0, SEEK_SET);
   char magic[8];
   uint32_t n_args = 0, n_outputs = 0, pad = 0;
   uint64_t copts_size = 0, shlo_size = 0;
   bool ok = read_exact(f, magic, 8) && memcmp(magic, "MXTPU002", 8) == 0 &&
             read_exact(f, &n_args, 4) && read_exact(f, &n_outputs, 4) &&
             read_exact(f, &copts_size, 8) && read_exact(f, &shlo_size, 8) &&
-            read_exact(f, &p->default_lr, 4) && read_exact(f, &pad, 4);
+            read_exact(f, &p->default_lr, 4) && read_exact(f, &pad, 4) &&
+            copts_size <= fsize && shlo_size <= fsize &&
+            n_args <= 1000000 && n_outputs <= 1000000;
   if (!ok) {
     fclose(f);
     set_error("bad training artifact header (magic/version mismatch?)");
@@ -223,13 +232,13 @@ bool load_artifact(Trainer* p, const char* path) {
     uint32_t name_len = 0;
     ok = read_exact(f, &a.kind, 1) && read_exact(f, &a.dtype, 1) &&
          read_exact(f, &ndim, 1) && read_exact(f, &apad, 1) &&
-         read_exact(f, &name_len, 4);
+         read_exact(f, &name_len, 4) && name_len <= fsize;
     if (ok) {
       a.name.resize(name_len);
       a.dims.resize(ndim);
       ok = read_exact(f, a.name.data(), name_len) &&
            read_exact(f, a.dims.data(), sizeof(int64_t) * ndim) &&
-           read_exact(f, &a.nbytes, 8);
+           read_exact(f, &a.nbytes, 8) && a.nbytes <= fsize;
     }
     if (ok) p->args.push_back(std::move(a));
   }
@@ -239,7 +248,8 @@ bool load_artifact(Trainer* p, const char* path) {
     uint16_t opad = 0;
     uint32_t name_len = 0;
     ok = read_exact(f, &o.dtype, 1) && read_exact(f, &ndim, 1) &&
-         read_exact(f, &opad, 2) && read_exact(f, &name_len, 4);
+         read_exact(f, &opad, 2) && read_exact(f, &name_len, 4) &&
+         name_len <= fsize;
     if (ok) {
       o.name.resize(name_len);
       o.dims.resize(ndim);
@@ -517,17 +527,27 @@ void MXTpuNDFree(MXTpuNDHandle h) { delete static_cast<NDArray*>(h); }
 int MXTpuTrainerCreate(const char* artifact_path,
                        const char* pjrt_plugin_path,
                        MXTpuTrainerHandle* out) {
-  auto* p = new Trainer();
-  if (!load_artifact(p, artifact_path)) {
-    delete p;
+  // no exception may cross the C ABI (the header promises nonzero-return
+  // failure semantics)
+  try {
+    auto* p = new Trainer();
+    if (!load_artifact(p, artifact_path)) {
+      delete p;
+      return 1;
+    }
+    if (pjrt_plugin_path != nullptr && !init_pjrt(p, pjrt_plugin_path)) {
+      destroy_trainer(p);
+      return 2;
+    }
+    *out = p;
+    return 0;
+  } catch (const std::exception& e) {
+    set_error(std::string("TrainerCreate: ") + e.what());
+    return 1;
+  } catch (...) {
+    set_error("TrainerCreate: unknown exception");
     return 1;
   }
-  if (pjrt_plugin_path != nullptr && !init_pjrt(p, pjrt_plugin_path)) {
-    destroy_trainer(p);
-    return 2;
-  }
-  *out = p;
-  return 0;
 }
 
 int MXTpuTrainerNumInputs(MXTpuTrainerHandle h, int* out) {
@@ -703,8 +723,10 @@ int MXTpuTrainerStep(MXTpuTrainerHandle h, float* loss_out) {
   if (ok && done[0] != nullptr) ok = await_event(p->api, done[0], "execute");
 
   float loss = 0.0f;
+  bool rotated = false;
   if (ok) {
     // rotate state: this step's outputs become the next step's inputs
+    rotated = true;
     for (size_t i = 0; i < n_out && i < p->out_feedback.size(); ++i) {
       int arg = p->out_feedback[i];
       if (arg >= 0) {
@@ -739,7 +761,13 @@ int MXTpuTrainerStep(MXTpuTrainerHandle h, float* loss_out) {
   for (PJRT_Buffer* b : out_row) destroy_buffer(p->api, b);
   for (PJRT_Buffer* b : owned) destroy_buffer(p->api, b);
   if (!ok) {
-    p->t -= 1;
+    if (!rotated) {
+      p->t -= 1;  // nothing was applied: the step may be retried
+    } else {
+      // the optimizer update WAS applied; only the loss readback failed —
+      // retrying this batch would apply the gradient twice
+      g_last_error += " (state update was applied; do not retry the batch)";
+    }
     return 1;
   }
   if (loss_out != nullptr) *loss_out = loss;

@@ -63,16 +63,17 @@ def main():
     from incubator_mxnet_tpu.ndarray import sparse
 
     kv3 = kvstore.create("dist_sync")
-    kv3.init("emb", nd.zeros((6, 2)))
+    nrows = nw + 4  # table scales with the worker count (runs at W=2..7)
+    kv3.init("emb", nd.zeros((nrows, 2)))
     # each rank touches a different overlapping row set
     rows = np.array([rank, rank + 2], np.int64)
     g = sparse.RowSparseNDArray(
         nd.array(np.ones((2, 2), np.float32) * (rank + 1)),
-        nd.array(rows), (6, 2))
+        nd.array(rows), (nrows, 2))
     kv3.push("emb", g)
-    out3 = nd.zeros((6, 2))
+    out3 = nd.zeros((nrows, 2))
     kv3.pull("emb", out=out3)
-    expect3 = np.zeros((6, 2), np.float32)
+    expect3 = np.zeros((nrows, 2), np.float32)
     for r in range(nw):
         expect3[[r, r + 2]] += (r + 1)
     np.testing.assert_allclose(out3.asnumpy(), expect3, rtol=1e-6)

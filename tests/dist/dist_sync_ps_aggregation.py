@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Sync parameter-server aggregation counting at W>2 (ref:
+kvstore_dist_server.h:346 — the merge buffer waits for exactly
+num_workers contributions, applies ONE update with the sum, and releases
+everyone at the new version).
+
+W=2 is degenerate for this invariant (one late push immediately
+completes); at W=4/7 a counting bug — double-counted retries, a barrier
+releasing at W-1, per-push application — produces a different weight.
+Each round every worker sync-pushes a rank-dependent gradient; the test
+asserts the weight after R rounds equals exactly R single updates of the
+rank-sum, on every worker."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import kvstore, nd
+
+
+def main():
+    kv = kvstore.create("dist_async_server")
+    rank, nw = kv.rank, kv.num_workers
+
+    lr = 0.1
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=lr, rescale_grad=1.0))
+    kv.init("w", nd.zeros((4,)))
+
+    rounds = 3
+    for _ in range(rounds):
+        # sync push: the server must aggregate exactly nw contributions
+        # of (rank+1) into ONE update of sum_r (r+1) = nw(nw+1)/2
+        kv._client.push("w", np.full(4, float(rank + 1), np.float32),
+                        sync=True)
+    kv.barrier()
+
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    expect = -lr * rounds * (nw * (nw + 1) / 2)
+    np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-6)
+
+    # barrier generations under churn: staggered arrivals for many
+    # consecutive barriers must all release cleanly (a generation-counting
+    # bug deadlocks or releases early here)
+    import time
+
+    for gen in range(5):
+        time.sleep(0.02 * ((rank + gen) % nw))
+        kv.barrier()
+
+    print(f"rank {rank}/{nw}: dist_sync_ps_aggregation OK")
+    kv.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -214,3 +214,27 @@ def test_wire_codec_bfloat16_roundtrip():
     assert got.dtype == np.dtype(ml_dtypes.bfloat16)
     np.testing.assert_array_equal(got.astype(np.float32),
                                   a.astype(np.float32))
+
+
+def test_heartbeat_protocol():
+    # TCP heartbeat commands: stale/never-seen ranks count as dead after
+    # the grace, excluding the requester
+    from incubator_mxnet_tpu import ps as _ps
+
+    srv = _ps.ParameterServer(num_workers=3, host="127.0.0.1", port=0)
+    c0 = _ps.PSClient("127.0.0.1", srv.port)
+    try:
+        c0.heartbeat(0)
+        c0.heartbeat(1)
+        # rank 2 never beats; within the grace nothing is dead
+        assert c0.num_dead(0, timeout=5.0) == 0
+        # tiny timeout: rank 2 (never seen, grace elapsed relative to the
+        # server's start) is dead; rank 1's fresh beat is not
+        import time
+        time.sleep(0.05)
+        assert c0.num_dead(0, timeout=0.01) >= 1
+        # requester is never counted dead
+        assert c0.num_dead(2, timeout=5.0) == 0
+    finally:
+        c0.stop_server()
+        c0.close()

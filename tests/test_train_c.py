@@ -285,3 +285,24 @@ def test_set_input_nd_checks_shape_dtype(artifact):
         lib.MXTpuNDFree(nd_h)
     finally:
         lib.MXTpuTrainerFree(h)
+
+
+def test_corrupt_artifact_fails_cleanly(tmp_path):
+    """A truncated/corrupt .mxt must return nonzero, never crash."""
+    lib = train_lib()
+    bad = str(tmp_path / "bad.mxt")
+    # huge bogus size fields after a valid magic
+    with open(bad, "wb") as f:
+        f.write(b"MXTPU002")
+        f.write(b"\xff" * 40)
+    h = ctypes.c_void_p()
+    assert lib.MXTpuTrainerCreate(bad.encode(), None, ctypes.byref(h)) != 0
+    assert lib.MXTpuLastError()
+    # truncated mid-args
+    with open(bad, "wb") as f:
+        f.write(b"MXTPU002")
+        import struct as _s
+        f.write(_s.pack("<IIQQ", 3, 1, 10, 10))
+        f.write(_s.pack("<fI", 0.1, 0))
+        f.write(b"\x01\x00\x02\x00")  # one arg header, then EOF
+    assert lib.MXTpuTrainerCreate(bad.encode(), None, ctypes.byref(h)) != 0

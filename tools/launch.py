@@ -63,9 +63,9 @@ def main():
             # explicit `sh -c` keeps this independent of the remote login
             # shell.  Launched commands do not receive the parent's stdin
             # (training jobs are non-interactive).
-            remote_cmd = ("exec /bin/sh -c 'IFS= read -r MXTPU_PS_SECRET; "
-                          "export MXTPU_PS_SECRET; exec env " + remote_env +
-                          " " + " ".join(cmd) + "'")
+            remote_cmd = ("exec /bin/sh -c 'IFS= read -r MXTPU_PS_SECRET "
+                          "&& export MXTPU_PS_SECRET && exec env " +
+                          remote_env + " " + " ".join(cmd) + "'")
             p = subprocess.Popen(["ssh", host, remote_cmd],
                                  stdin=subprocess.PIPE, text=True)
             p.stdin.write(ps_secret + "\n")
