@@ -15,24 +15,67 @@ __all__ = ["export_model", "ONNX_OP_MAP"]
 # mx2onnx/_op_translations.py one function per op).
 
 
+def _tup(v, n, default):
+    """Normalize a scalar-or-sequence attr to an n-list (MXNet accepts
+    kernel=3 and kernel=(3,3) interchangeably)."""
+    if v is None:
+        return [default] * n
+    if isinstance(v, (int, np.integer)):
+        return [int(v)] * n
+    return [int(x) for x in v]
+
+
 def _conv_attrs(a):
     k = a.get("kernel")
+    # scalar kernel means square 2-D (the layers always pass tuples; the
+    # scalar spelling only appears in hand-built symbols) — same policy
+    # as _pool_attrs so the two never disagree
+    k = [int(k)] * 2 if isinstance(k, (int, np.integer)) else list(k)
+    n = len(k)
     return {
-        "kernel_shape": list(k),
-        "strides": list(a.get("stride") or (1,) * len(k)),
-        "pads": list(a.get("pad") or (0,) * len(k)) * 2,
-        "dilations": list(a.get("dilate") or (1,) * len(k)),
+        "kernel_shape": k,
+        "strides": _tup(a.get("stride"), n, 1),
+        "pads": _tup(a.get("pad"), n, 0) * 2,
+        "dilations": _tup(a.get("dilate"), n, 1),
         "group": int(a.get("num_group", 1)),
     }
 
 
 def _pool_attrs(a):
     k = a.get("kernel", (2, 2))
+    k = [int(k)] * 2 if isinstance(k, (int, np.integer)) else list(k)
+    n = len(k)
     return {
-        "kernel_shape": list(k),
-        "strides": list(a.get("stride") or (1,) * len(k)),
-        "pads": list(a.get("pad") or (0,) * len(k)) * 2,
+        "kernel_shape": k,
+        "strides": _tup(a.get("stride"), n, 1),
+        "pads": _tup(a.get("pad"), n, 0) * 2,
     }
+
+
+def _slice_attrs(a):
+    if any(int(s) != 1 for s in (a.get("step") or ()) if s is not None):
+        raise NotImplementedError(
+            "ONNX export: slice with step != 1 (Slice-9 has no steps)")
+    return {
+        "starts": [0 if v is None else int(v) for v in a["begin"]],
+        "ends": [2**31 - 1 if v is None else int(v) for v in a["end"]],
+    }
+
+
+def _squeeze_attrs(a):
+    ax = a.get("axis")
+    if ax is None:
+        return {}
+    return {"axes": [int(ax)] if isinstance(ax, (int, np.integer))
+            else [int(v) for v in ax]}
+
+
+def _deconv_attrs_fwd(a):
+    out = _conv_attrs(a)
+    adj = a.get("adj")
+    if adj:
+        out["output_padding"] = _tup(adj, len(out["kernel_shape"]), 0)
+    return out
 
 
 def _reduce_attrs(a):
@@ -94,16 +137,113 @@ ONNX_OP_MAP = {
                                        "starts": [int(a["begin"])],
                                        "ends": [int(a["end"]) if a.get("end")
                                                 is not None else 2**31 - 1]}),
-    "UpSampling": ("Upsample", lambda a: {
-        "mode": "nearest" if a.get("sample_type", "nearest") == "nearest"
-        else "linear",
-        "scales": [1.0, 1.0, float(a["scale"]), float(a["scale"])]}),
     "mean": ("ReduceMean", _reduce_attrs),
     "sum": ("ReduceSum", _reduce_attrs),
     "max": ("ReduceMax", _reduce_attrs),
+    "min": ("ReduceMin", _reduce_attrs),
+    "prod": ("ReduceProd", _reduce_attrs),
+    # ---- round-3 tail: toward the reference's ~97 translations ----
+    "abs": ("Abs", lambda a: {}),
+    "ceil": ("Ceil", lambda a: {}),
+    "floor": ("Floor", lambda a: {}),
+    "sign": ("Sign", lambda a: {}),
+    "erf": ("Erf", lambda a: {}),
+    "reciprocal": ("Reciprocal", lambda a: {}),
+    "identity": ("Identity", lambda a: {}),
+    "_copy": ("Identity", lambda a: {}),
+    "BlockGrad": ("Identity", lambda a: {}),
+    "stop_gradient": ("Identity", lambda a: {}),
+    "sin": ("Sin", lambda a: {}),
+    "cos": ("Cos", lambda a: {}),
+    "tan": ("Tan", lambda a: {}),
+    "arcsin": ("Asin", lambda a: {}),
+    "arccos": ("Acos", lambda a: {}),
+    "arctan": ("Atan", lambda a: {}),
+    "sinh": ("Sinh", lambda a: {}),
+    "cosh": ("Cosh", lambda a: {}),
+    "arcsinh": ("Asinh", lambda a: {}),
+    "arccosh": ("Acosh", lambda a: {}),
+    "arctanh": ("Atanh", lambda a: {}),
+    "softsign": ("Softsign", lambda a: {}),
+    "elemwise_div": ("Div", lambda a: {}),
+    "broadcast_div": ("Div", lambda a: {}),
+    "_div": ("Div", lambda a: {}),
+    "_mul": ("Mul", lambda a: {}),
+    "_plus": ("Add", lambda a: {}),
+    "_add": ("Add", lambda a: {}),
+    "_sub": ("Sub", lambda a: {}),
+    "_minus": ("Sub", lambda a: {}),
+    "broadcast_sub": ("Sub", lambda a: {}),
+    "pow": ("Pow", lambda a: {}),
+    "_power": ("Pow", lambda a: {}),
+    "broadcast_power": ("Pow", lambda a: {}),
+    "maximum": ("Max", lambda a: {}),
+    "_maximum": ("Max", lambda a: {}),
+    "broadcast_maximum": ("Max", lambda a: {}),
+    "minimum": ("Min", lambda a: {}),
+    "_minimum": ("Min", lambda a: {}),
+    "broadcast_minimum": ("Min", lambda a: {}),
+    "add_n": ("Sum", lambda a: {}),
+    "ElementWiseSum": ("Sum", lambda a: {}),
+    "batch_dot": ("MatMul", lambda a: {}),
+    "expand_dims": ("Unsqueeze", lambda a: {"axes": [int(a["axis"])]}),
+    "squeeze": ("Squeeze", _squeeze_attrs),
+    "log_softmax": ("LogSoftmax", lambda a: {"axis": int(a.get("axis", -1))}),
+    "argmax": ("ArgMax", lambda a: {"axis": int(a.get("axis", 0) or 0),
+                                    "keepdims": int(bool(a.get("keepdims",
+                                                               False)))}),
+    "argmin": ("ArgMin", lambda a: {"axis": int(a.get("axis", 0) or 0),
+                                    "keepdims": int(bool(a.get("keepdims",
+                                                               False)))}),
+    "hard_sigmoid": ("HardSigmoid",
+                     lambda a: {"alpha": float(a.get("alpha", 0.2)),
+                                "beta": float(a.get("beta", 0.5))}),
+    "where": ("Where", lambda a: {}),
+    "LRN": ("LRN", lambda a: {"alpha": float(a.get("alpha", 1e-4)),
+                              "beta": float(a.get("beta", 0.75)),
+                              "bias": float(a.get("knorm", 2.0)),
+                              "size": int(a.get("nsize", 5))}),
+    "InstanceNorm": ("InstanceNormalization",
+                     lambda a: {"epsilon": float(a.get("eps", 1e-3))}),
+    "Deconvolution": ("ConvTranspose", _deconv_attrs_fwd),
+    "depth_to_space": ("DepthToSpace",
+                       lambda a: {"blocksize": int(a["block_size"])}),
+    "space_to_depth": ("SpaceToDepth",
+                       lambda a: {"blocksize": int(a["block_size"])}),
+    "SliceChannel": ("Split", None),     # special-cased (num_outputs)
+    "split": ("Split", None),            # special-cased
+    "tile": ("Tile", None),              # repeats is a tensor input
+    "square": ("Mul", None),             # x*x: special-cased
+    "zeros_like": (None, None),          # Shape+ConstantOfShape: special
+    "Cast": ("Cast", None),              # dtype -> ONNX enum: special-cased
+    "cast": ("Cast", None),
+    "slice": ("Slice", _slice_attrs),
+    "take": ("Gather", lambda a: {"axis": int(a.get("axis", 0))}),
+    "flatten": ("Flatten", lambda a: {"axis": 1}),
+    "reshape": ("Reshape", lambda a: {}),
+    "concat": ("Concat", lambda a: {"axis": int(a.get("dim", 1))}),
 }
 
-_OPSET = 8  # highest opset where the attribute forms above are all legal
+# tensor-scalar ops: ONNX binary op + a scalar initializer input
+# (True = scalar comes first, the _r* reversed variants)
+_SCALAR_BINOPS = {
+    "_plus_scalar": ("Add", False), "_minus_scalar": ("Sub", False),
+    "_rminus_scalar": ("Sub", True), "_mul_scalar": ("Mul", False),
+    "_div_scalar": ("Div", False), "_rdiv_scalar": ("Div", True),
+    "_power_scalar": ("Pow", False), "_rpower_scalar": ("Pow", True),
+    "_maximum_scalar": ("Max", False), "_minimum_scalar": ("Min", False),
+}
+
+# mx dtype string -> ONNX TensorProto.DataType enum (for Cast's `to`)
+_ONNX_DTYPE_ENUM = {"float32": 1, "uint8": 2, "int8": 3, "uint16": 4,
+                    "int16": 5, "int32": 6, "int64": 7, "bool": 9,
+                    "float16": 10, "float64": 11, "uint32": 12,
+                    "uint64": 13, "bfloat16": 16}
+
+_OPSET = 9  # attribute forms above are all legal at 9 (Slice moves its
+            # params to inputs at 10, Clip/Pad at 11, ReduceSum at 13);
+            # 9 admits Sign/Erf/Where/Sinh/Asinh, and Upsample-9's
+            # scales-as-input form is emitted accordingly
 
 _ACT_MAP = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
             "softrelu": "Softplus", "softsign": "Softsign"}
@@ -124,6 +264,52 @@ def graph_to_onnx_nodes(symbol):
         consts = {}
         if op == "Activation":
             ot, oattrs = _ACT_MAP[attrs.get("act_type", "relu")], {}
+        elif op in ("SliceChannel", "split"):
+            if attrs.get("squeeze_axis"):
+                raise NotImplementedError(
+                    "ONNX export: split with squeeze_axis=True")
+            ot, oattrs = "Split", {"axis": int(attrs.get("axis", 1))}
+        elif op in _SCALAR_BINOPS:
+            ot, oattrs = _SCALAR_BINOPS[op][0], {}
+            # the exporter declares float32 graphs (export_model
+            # input_type default); a non-f32 graph would need the scalar
+            # to inherit its tensor's dtype, which the symbol layer does
+            # not carry statically
+            consts[f"{node.name}_scalar"] = np.asarray(
+                float(attrs.get("scalar", 0.0)), np.float32)
+        elif op == "tile":
+            ot, oattrs = "Tile", {}
+            reps_name = f"{node.name}_repeats"
+            consts[reps_name] = np.asarray(attrs["reps"], np.int64)
+        elif op == "square":
+            ot, oattrs = "Mul", {}  # x*x (input doubled below)
+        elif op == "zeros_like":
+            # Shape -> ConstantOfShape(0): exact even for inf/NaN inputs
+            # (x-x or x*0 would yield NaN there); emitted as TWO nodes
+            in0 = [src.name if src.is_var else f"{src.name}_out{idx}"
+                   for src, idx in node.inputs][0]
+            shp = f"{node.name}_shape_out0"
+            nodes.append(("Shape", [in0], [shp], {},
+                          f"{node.name}_shape", {}))
+            nodes.append(("ConstantOfShape", [shp],
+                          [f"{node.name}_out0"],
+                          {"value": np.zeros(1, np.float32)},
+                          node.name, {}))
+            continue
+        elif op in ("Cast", "cast", "amp_cast"):
+            dt = str(attrs.get("dtype", "float32"))
+            if dt not in _ONNX_DTYPE_ENUM:
+                raise NotImplementedError(f"ONNX export: Cast to {dt}")
+            ot, oattrs = "Cast", {"to": _ONNX_DTYPE_ENUM[dt]}
+        elif op == "UpSampling":
+            # Upsample-9: scales is a tensor input, not an attribute
+            ot = "Upsample"
+            oattrs = {"mode": "nearest"
+                      if attrs.get("sample_type", "nearest") == "nearest"
+                      else "linear"}
+            sc = float(attrs["scale"])
+            consts[f"{node.name}_scales"] = np.asarray(
+                [1.0, 1.0, sc, sc], np.float32)
         elif op == "Pooling":
             if attrs.get("global_pool"):
                 ot = ("GlobalMaxPool" if attrs.get("pool_type", "max") == "max"
@@ -141,14 +327,37 @@ def graph_to_onnx_nodes(symbol):
                 f"(ref mapping table: mx2onnx/_op_translations.py)")
         in_names = [src.name if src.is_var else f"{src.name}_out{idx}"
                     for src, idx in node.inputs]
+        if op in ("Convolution", "Deconvolution", "FullyConnected"):
+            # a bias input the op ignores (no_bias) must not be exported —
+            # ONNX Conv/ConvTranspose/Gemm would apply it
+            nb = attrs.get("no_bias", op == "Deconvolution")
+            if nb is True or str(nb).lower() in ("true", "1"):
+                in_names = in_names[:2]
+                if op == "FullyConnected":
+                    # Gemm's C input is mandatory until opset 11: stand in
+                    # a zero bias initializer
+                    zb = f"{node.name}_zero_bias"
+                    consts[zb] = np.zeros(int(attrs["num_hidden"]),
+                                          np.float32)
+                    in_names.append(zb)
         if op == "Embedding":  # ONNX Gather is (table, indices)
             in_names = [in_names[1], in_names[0]]
         elif op == "SoftmaxOutput":  # label input has no ONNX counterpart
             in_names = in_names[:1]
-        elif op == "Reshape":  # target shape is a tensor input at opset>=5
+        elif op in ("Reshape", "reshape"):  # shape is an input at opset>=5
             shape_name = f"{node.name}_shape"
             consts[shape_name] = np.asarray(attrs["shape"], np.int64)
             in_names = in_names[:1] + [shape_name]
+        elif op == "square":  # unary -> binary on itself
+            in_names = [in_names[0], in_names[0]]
+        elif op in _SCALAR_BINOPS:
+            sc = f"{node.name}_scalar"
+            in_names = ([sc] + in_names[:1] if _SCALAR_BINOPS[op][1]
+                        else in_names[:1] + [sc])
+        elif op == "tile":
+            in_names = in_names[:1] + [f"{node.name}_repeats"]
+        elif op == "UpSampling":
+            in_names = in_names[:1] + [f"{node.name}_scales"]
         out_names = [f"{node.name}_out{i}" for i in range(node.num_outputs)]
         nodes.append((ot, in_names, out_names, oattrs, node.name, consts))
     return nodes
@@ -236,8 +445,9 @@ def export_model(sym, params, input_shape, input_type=np.float32,
     for ot, ins, outs, attrs, name, consts in nodes:
         for cname, carr in consts.items():
             inits.append(proto.from_array(carr, name=cname))
-            inputs.append(_value_info(cname, carr.shape,
-                                      proto.DataType.INT64))
+            elem = (proto.DataType.INT64 if carr.dtype == np.int64
+                    else proto.DataType.FLOAT)
+            inputs.append(_value_info(cname, carr.shape, elem))
         onnx_nodes.append(proto.NodeProto(
             op_type=ot, input=list(ins), output=list(outs), name=name,
             attribute=[_make_attr(k, v) for k, v in sorted(attrs.items())]))

@@ -9,6 +9,12 @@ import numpy as np
 
 __all__ = ["import_model"]
 
+# ONNX TensorProto.DataType enum -> mx dtype string (Cast)
+_CAST_DTYPES = {"float32": 1, "uint8": 2, "int8": 3, "uint16": 4,
+                "int16": 5, "int32": 6, "int64": 7, "bool": 9,
+                "float16": 10, "float64": 11, "uint32": 12,
+                "uint64": 13, "bfloat16": 16}
+
 
 def _attrs(node):
     out = {}
@@ -23,6 +29,10 @@ def _attrs(node):
             out[a.name] = tuple(a.floats)
         elif a.type == a.STRING:
             out[a.name] = a.s.decode()
+        elif a.type == a.TENSOR:
+            from . import proto
+
+            out[a.name] = proto.to_array(a.t)
     return out
 
 
@@ -48,6 +58,7 @@ def import_model(model_file):
         opset = 9  # unspecified: ONNX defines this as opset 1; legacy forms
 
     env = {}  # onnx value name -> Symbol
+    shape_sources = {}  # Shape-node output name -> its input Symbol
     for name in list(params):
         env[name] = sym.Variable(name)
     for inp in graph.input:
@@ -218,6 +229,11 @@ def import_model(model_file):
                                  axis=a.get("axis", -1))
         if t == "Upsample":
             scales = a.get("scales")
+            if scales is None:  # opset >= 9: scales is input 1
+                sc = const_input(node, 1)
+                scales = [float(v) for v in sc] if sc is not None else None
+            if scales is None:
+                raise NotImplementedError("Upsample without static scales")
             return sym.UpSampling(ins[0], scale=int(scales[2]),
                                   sample_type="nearest")
         if t == "Pad":
@@ -238,6 +254,155 @@ def import_model(model_file):
                 pw += [int(b), int(e)]
             return sym.Pad(ins[0], mode=mode, pad_width=tuple(pw),
                            constant_value=float(a.get("value", 0.0)))
+        # ---- round-3 tail (mirrors the expanded export map) ----
+        if t == "Abs":
+            return sym.abs(ins[0])
+        if t == "Ceil":
+            return sym.ceil(ins[0])
+        if t == "Floor":
+            return sym.floor(ins[0])
+        if t == "Round":
+            return sym.round(ins[0])
+        if t == "Sign":
+            return sym.sign(ins[0])
+        if t == "Erf":
+            return sym.erf(ins[0])
+        if t == "Reciprocal":
+            return sym.reciprocal(ins[0])
+        if t in ("Sin", "Cos", "Tan", "Sinh", "Cosh"):
+            return getattr(sym, t.lower())(ins[0])
+        if t == "Asin":
+            return sym.arcsin(ins[0])
+        if t == "Acos":
+            return sym.arccos(ins[0])
+        if t == "Atan":
+            return sym.arctan(ins[0])
+        if t == "Asinh":
+            return sym.arcsinh(ins[0])
+        if t == "Acosh":
+            return sym.arccosh(ins[0])
+        if t == "Atanh":
+            return sym.arctanh(ins[0])
+        if t == "Pow":
+            return sym.broadcast_power(ins[0], ins[1])
+        if t == "Max":
+            out = ins[0]
+            for s in ins[1:]:
+                out = sym.broadcast_maximum(out, s)
+            return out
+        if t == "Min":
+            out = ins[0]
+            for s in ins[1:]:
+                out = sym.broadcast_minimum(out, s)
+            return out
+        if t == "Sum":
+            out = ins[0]
+            for s in ins[1:]:
+                out = out + s
+            return out
+        if t == "Unsqueeze":
+            axes = a.get("axes")
+            if axes is None:  # opset >= 13: axes moved to input 1
+                ax = const_input(node, 1)
+                axes = [int(v) for v in ax] if ax is not None else []
+            out = ins[0]
+            for ax in sorted(int(v) for v in axes):
+                out = sym.expand_dims(out, axis=ax)
+            return out
+        if t == "Squeeze":
+            axes = a.get("axes")
+            if axes is None and len(node.input) > 1:
+                ax = const_input(node, 1)
+                axes = [int(v) for v in ax] if ax is not None else None
+            return sym.squeeze(ins[0], axis=tuple(int(v) for v in axes)
+                               if axes is not None else None)
+        if t == "Split":
+            n_out = len(node.output)
+            sizes = a.get("split")
+            if sizes is not None and len(set(int(v) for v in sizes)) > 1:
+                raise NotImplementedError(
+                    "Split with uneven part sizes is not supported")
+            return sym.SliceChannel(ins[0], num_outputs=n_out,
+                                    axis=int(a.get("axis", 0)))
+        if t == "Shape":
+            shape_sources[node.output[0]] = ins[0]
+            return sym.shape_array(ins[0])
+        if t == "ConstantOfShape":
+            src = shape_sources.get(node.input[0])
+            if src is None:
+                raise NotImplementedError(
+                    "ConstantOfShape with a dynamic shape input (only the "
+                    "Shape(x) -> ConstantOfShape zeros_like pattern is "
+                    "supported)")
+            v = a.get("value")
+            val = float(np.asarray(v).ravel()[0]) if v is not None else 0.0
+            out = sym.zeros_like(src)
+            return out if val == 0.0 else out + val
+        if t == "Tile":
+            reps = const_input(node, 1)
+            if reps is None:
+                raise NotImplementedError("Tile without static repeats")
+            return sym.tile(ins[0], reps=tuple(int(v) for v in reps))
+        if t == "ArgMax":
+            return sym.argmax(ins[0], axis=int(a.get("axis", 0)),
+                              keepdims=bool(a.get("keepdims", 1)))
+        if t == "ArgMin":
+            return sym.argmin(ins[0], axis=int(a.get("axis", 0)),
+                              keepdims=bool(a.get("keepdims", 1)))
+        if t == "ReduceMin":
+            return sym.min(ins[0], axis=a.get("axes"),
+                           keepdims=bool(a.get("keepdims", 1)))
+        if t == "ReduceProd":
+            return sym.prod(ins[0], axis=a.get("axes"),
+                            keepdims=bool(a.get("keepdims", 1)))
+        if t == "ReduceL2":
+            return sym.norm(ins[0], ord=2, axis=a.get("axes"),
+                            keepdims=bool(a.get("keepdims", 1)))
+        if t == "LogSoftmax":
+            return sym.log_softmax(ins[0], axis=a.get("axis", -1))
+        if t == "HardSigmoid":
+            return sym.hard_sigmoid(ins[0],
+                                    alpha=float(a.get("alpha", 0.2)),
+                                    beta=float(a.get("beta", 0.5)))
+        if t == "Where":
+            return sym.where(ins[0], ins[1], ins[2])
+        if t == "LRN":
+            return sym.LRN(ins[0], alpha=float(a.get("alpha", 1e-4)),
+                           beta=float(a.get("beta", 0.75)),
+                           knorm=float(a.get("bias", 2.0)),
+                           nsize=int(a.get("size", 5)))
+        if t == "InstanceNormalization":
+            return sym.InstanceNorm(*ins,
+                                    eps=float(a.get("epsilon", 1e-5)))
+        if t == "ConvTranspose":
+            k = a.get("kernel_shape")
+            pads = a.get("pads", (0,) * (2 * len(k)))
+            w = params[node.input[1]]
+            return sym.Deconvolution(
+                *ins, kernel=tuple(k),
+                stride=tuple(a.get("strides", (1,) * len(k))),
+                pad=tuple(pads[: len(k)]),
+                adj=tuple(a.get("output_padding", (0,) * len(k))),
+                num_filter=int(w.shape[1]) * int(a.get("group", 1)),
+                num_group=int(a.get("group", 1)),
+                no_bias=len(ins) < 3)
+        if t == "DepthToSpace":
+            return sym.depth_to_space(ins[0],
+                                      block_size=int(a["blocksize"]))
+        if t == "SpaceToDepth":
+            return sym.space_to_depth(ins[0],
+                                      block_size=int(a["blocksize"]))
+        if t == "Cast":
+            inv = {v: k for k, v in _CAST_DTYPES.items()}
+            to = int(a["to"])
+            if to not in inv:
+                raise NotImplementedError(f"Cast to ONNX enum {to}")
+            return sym.Cast(ins[0], dtype=inv[to])
+        if t == "PRelu":
+            return sym.LeakyReLU(ins[0], gamma=ins[1], act_type="prelu")
+        if t == "Elu":
+            return sym.LeakyReLU(ins[0], act_type="elu",
+                                 slope=float(a.get("alpha", 1.0)))
         raise NotImplementedError(
             f"ONNX import: unsupported op {t} "
             f"(ref: onnx2mx/_op_translations.py)")

@@ -32,6 +32,29 @@ def _current_subst():
     return getattr(_SUBST, "map", None)
 
 
+_SYMBOLIC = threading.local()
+
+
+def _symbolic_active():
+    return getattr(_SYMBOLIC, "on", False)
+
+
+class _SymbolicTrace:
+    """While active, hybrid_forward's `F` namespace resolves to `sym.*`
+    and parameters appear as named Variables — tracing a HybridBlock
+    produces the declarative Symbol graph (the reference's
+    CachedOp-to-Symbol bridge that powers HybridBlock.export,
+    ref: gluon/block.py:1256 _build_cache/export)."""
+
+    def __enter__(self):
+        self._prev = getattr(_SYMBOLIC, "on", False)
+        _SYMBOLIC.on = True
+        return self
+
+    def __exit__(self, *exc):
+        _SYMBOLIC.on = self._prev
+
+
 class _ParamSubst:
     """Substitute param.data() results during jit tracing."""
 
@@ -259,6 +282,10 @@ class HybridBlock(Block):
         active), inline into it instead of nesting another cached call —
         the analog of CachedOp flattening nested hybridized subgraphs.
         """
+        if _symbolic_active():
+            # symbolic trace: inputs are Symbols (no .shape for
+            # _pre_forward; params must already be initialized)
+            return self.hybrid_forward(_F, x, *args, **self._param_kwargs())
         self._pre_forward(x, *args)
         if not self._active or _current_subst() is not None:
             return self.hybrid_forward(_F, x, *args, **self._param_kwargs())
@@ -271,6 +298,11 @@ class HybridBlock(Block):
         return
 
     def _param_kwargs(self):
+        if _symbolic_active():
+            from .. import symbol as sym_mod
+
+            return {name: sym_mod.Variable(p.name)
+                    for name, p in self._reg_params.items()}
         return {name: p.data() for name, p in self._reg_params.items()}
 
     def hybrid_forward(self, F, x, *args, **kwargs):
@@ -362,15 +394,55 @@ class HybridBlock(Block):
         return primary if len(primary) > 1 else primary[0]
 
     def export(self, path, epoch=0, remove_amp_cast=True):
-        """Export symbol+params for deployment (ref: block.py:868)."""
-        raise NotImplementedError("export lands with the SymbolBlock bridge")
+        """Export symbol+params for deployment (ref: block.py:868) — writes
+        `path-symbol.json` + `path-%04d.params` in the reference's
+        arg:/aux: container format. Works on any HybridBlock whose
+        parameters are initialized (shapes must be known; run one forward
+        or initialize with explicit in_units/in_channels first)."""
+        sym_out = self._to_symbol()
+        sym_out.save(f"{path}-symbol.json")
+        arg_names = set(sym_out.list_arguments())
+        aux_names = set(sym_out.list_auxiliary_states())
+        from ..ndarray.legacy_io import save_mxnet_params
+
+        payload = {}
+        for name, p in self.collect_params().items():
+            if p._data is None:
+                continue
+            if name in aux_names:
+                payload["aux:" + name] = p._data
+            elif name in arg_names:
+                payload["arg:" + name] = p._data
+        # reference byte format: the exported pair is loadable by the
+        # reference runtime itself, not just by this framework
+        save_mxnet_params(f"{path}-{epoch:04d}.params", payload)
+        return f"{path}-symbol.json", f"{path}-{epoch:04d}.params"
+
+    def _to_symbol(self, *input_names):
+        """Trace this block into a declarative Symbol (the SymbolBlock
+        bridge): `F` becomes `sym.*`, parameters become named Variables.
+        Default input name: "data"."""
+        from .. import symbol as sym_mod
+
+        inputs = [sym_mod.Variable(n)
+                  for n in (input_names or ("data",))]
+        with _SymbolicTrace():
+            out = self(*inputs)
+        if isinstance(out, (list, tuple)):
+            out = sym_mod.Group(list(out))
+        return out
 
 
 class _FModule:
     """The `F` namespace handed to hybrid_forward: eager nd ops (tracers flow
-    through them transparently under jit)."""
+    through them transparently under jit), or `sym.*` during a symbolic
+    trace (the reference's F=ndarray / F=symbol duality)."""
 
     def __getattr__(self, name):
+        if _symbolic_active():
+            from .. import symbol as sym_mod
+
+            return getattr(sym_mod, name)
         from .. import ndarray as nd
 
         return getattr(nd, name)

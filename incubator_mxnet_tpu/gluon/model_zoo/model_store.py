@@ -1,0 +1,48 @@
+"""Pretrained-weights store — offline variant of the reference's
+model_store (ref: python/mxnet/gluon/model_zoo/model_store.py:1
+get_model_file downloads `<name>-<sha1[:8]>.params` into
+~/.mxnet/models).
+
+This environment has no network egress, so the store resolves STRICTLY
+locally: weights the user (or an offline mirror sync) placed under the
+models root load exactly like downloaded ones — including
+reference-format `.params` files, which `nd.load` reads natively
+(ndarray/legacy_io.py). `pretrained=True` therefore works the moment the
+file exists; otherwise it fails with the precise path to provision.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_model_file", "model_root"]
+
+
+def model_root(root=None):
+    """Default weights directory (ref: model_store.py root=~/.mxnet/models);
+    override with MXTPU_MODELS_ROOT."""
+    if root:
+        return os.path.expanduser(root)
+    env = os.environ.get("MXTPU_MODELS_ROOT")
+    if env:
+        return os.path.expanduser(env)
+    return os.path.expanduser(os.path.join("~", ".mxnet", "models"))
+
+
+def get_model_file(name, root=None):
+    """Path to `<root>/<name>.params` (also accepts the reference's
+    sha1-tagged `<name>-XXXXXXXX.params` spelling). Raises with the
+    expected location when absent — there is no download fallback here."""
+    root = model_root(root)
+    exact = os.path.join(root, f"{name}.params")
+    if os.path.exists(exact):
+        return exact
+    if os.path.isdir(root):
+        tagged = sorted(f for f in os.listdir(root)
+                        if f.startswith(f"{name}-") and
+                        f.endswith(".params"))
+        if tagged:
+            return os.path.join(root, tagged[-1])
+    raise FileNotFoundError(
+        f"pretrained weights for {name!r} not found; this build has no "
+        f"network egress — place the file at {exact} (reference-format "
+        f".params files load directly)")

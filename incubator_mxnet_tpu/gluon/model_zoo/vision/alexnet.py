@@ -36,6 +36,9 @@ class AlexNet(HybridBlock):
 
 
 def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
+    net = AlexNet(**kwargs)
     if pretrained:
-        raise RuntimeError("no network egress: load weights via load_parameters")
-    return AlexNet(**kwargs)
+        from ..model_store import get_model_file
+
+        net.load_parameters(get_model_file("alexnet", root), ctx=ctx)
+    return net

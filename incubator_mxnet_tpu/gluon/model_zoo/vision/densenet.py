@@ -84,11 +84,14 @@ class DenseNet(HybridBlock):
         return self.output(self.features(x))
 
 
-def _get_densenet(depth, pretrained=False, **kwargs):
-    if pretrained:
-        raise RuntimeError("no network egress: load weights via load_parameters")
+def _get_densenet(depth, pretrained=False, ctx=None, root=None, **kwargs):
     stem, growth, blocks = densenet_spec[depth]
-    return DenseNet(stem, growth, blocks, **kwargs)
+    net = DenseNet(stem, growth, blocks, **kwargs)
+    if pretrained:
+        from ..model_store import get_model_file
+
+        net.load_parameters(get_model_file(f"densenet{depth}", root), ctx=ctx)
+    return net
 
 
 for _d in densenet_spec:

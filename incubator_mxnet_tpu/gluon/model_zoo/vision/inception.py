@@ -147,6 +147,9 @@ class Inception3(HybridBlock):
 
 
 def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
+    net = Inception3(**kwargs)
     if pretrained:
-        raise RuntimeError("no network egress: load weights via load_parameters")
-    return Inception3(**kwargs)
+        from ..model_store import get_model_file
+
+        net.load_parameters(get_model_file("inceptionv3", root), ctx=ctx)
+    return net

@@ -124,10 +124,15 @@ class MobileNetV2(HybridBlock):
         return self.output(self.features(x))
 
 
-def _get(cls, multiplier, pretrained=False, **kwargs):
+def _get(cls, multiplier, pretrained=False, ctx=None, root=None, **kwargs):
+    net = cls(multiplier, **kwargs)
     if pretrained:
-        raise RuntimeError("no network egress: load weights via load_parameters")
-    return cls(multiplier, **kwargs)
+        from ..model_store import get_model_file
+
+        base = "mobilenetv2_" if cls is MobileNetV2 else "mobilenet"
+        net.load_parameters(
+            get_model_file(f"{base}{multiplier}", root), ctx=ctx)
+    return net
 
 
 for _m, _tag in ((1.0, "1_0"), (0.75, "0_75"), (0.5, "0_5"), (0.25, "0_25")):

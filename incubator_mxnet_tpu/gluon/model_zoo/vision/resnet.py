@@ -164,7 +164,10 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
     bottleneck, layers, channels = resnet_spec[num_layers]
     net = ResNet(version, layers, channels, bottleneck, **kwargs)
     if pretrained:
-        raise RuntimeError("no network egress: load weights via load_parameters")
+        from ..model_store import get_model_file
+
+        net.load_parameters(
+            get_model_file(f"resnet{num_layers}_v{version}", root), ctx=ctx)
     return net
 
 

@@ -64,10 +64,14 @@ class SqueezeNet(HybridBlock):
         return self.output(self.features(x))
 
 
-def _get(version, pretrained=False, **kwargs):
+def _get(version, pretrained=False, ctx=None, root=None, **kwargs):
+    net = SqueezeNet(version, **kwargs)
     if pretrained:
-        raise RuntimeError("no network egress: load weights via load_parameters")
-    return SqueezeNet(version, **kwargs)
+        from ..model_store import get_model_file
+
+        net.load_parameters(get_model_file(f"squeezenet{version}", root),
+                            ctx=ctx)
+    return net
 
 
 def squeezenet1_0(**kwargs):

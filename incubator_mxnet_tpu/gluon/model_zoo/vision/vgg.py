@@ -47,9 +47,14 @@ class VGG(HybridBlock):
 
 
 def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
+    net = VGG(vgg_spec[num_layers], **kwargs)
     if pretrained:
-        raise RuntimeError("no network egress: load weights via load_parameters")
-    return VGG(vgg_spec[num_layers], **kwargs)
+        from ..model_store import get_model_file
+
+        bn = "_bn" if kwargs.get("batch_norm") else ""
+        net.load_parameters(get_model_file(f"vgg{num_layers}{bn}", root),
+                            ctx=ctx)
+    return net
 
 
 for _d in vgg_spec:

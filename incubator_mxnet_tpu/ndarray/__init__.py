@@ -115,7 +115,14 @@ def save(fname, data):
 
 
 def load(fname):
-    """Load a container saved by `save` -> list or dict of NDArrays."""
+    """Load a container saved by `save` -> list or dict of NDArrays.
+    Reference-format .params files (kMXAPINDArrayListMagic) are detected
+    and read transparently, so checkpoints trained with the reference load
+    with the same call (ref: python/mxnet/ndarray/utils.py:222)."""
+    from .legacy_io import is_mxnet_params, load_mxnet_params
+
+    if is_mxnet_params(fname):
+        return load_mxnet_params(fname)
     with open(fname, "rb") as f:
         magic = f.read(8)
         if magic != _MAGIC:

@@ -1,0 +1,241 @@
+"""Reference-format .params container IO (ref: src/ndarray/ndarray.cc:1776
+NDArray::Save/Load — the binary every MXNet release wrote; loading those
+files offline is the no-egress pretrained-weights story)."""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.ndarray.legacy_io import (
+    is_mxnet_params, load_mxnet_params, save_mxnet_params)
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden")
+
+
+def test_roundtrip_dtypes(tmp_path):
+    rng = np.random.RandomState(0)
+    data = {
+        "w": rng.randn(3, 4).astype(np.float32),
+        "b64": rng.randn(5).astype(np.float64),
+        "idx": np.arange(6, dtype=np.int32),
+        "big": np.arange(4, dtype=np.int64),
+        "bytes": np.arange(8, dtype=np.uint8),
+        "half": rng.randn(2, 2).astype(np.float16),
+        "scalar1d": np.array([7.5], np.float32),
+    }
+    path = str(tmp_path / "p.params")
+    save_mxnet_params(path, data)
+    assert is_mxnet_params(path)
+    back = load_mxnet_params(path)
+    assert set(back) == set(data)
+    # NDArray rides jax, which runs 32-bit by default: 64-bit payloads
+    # load with full VALUES but as their 32-bit dtypes (the framework-wide
+    # dtype policy, same as nd.array(np.float64(...)))
+    narrowed = {"float64": "float32", "int64": "int32"}
+    for k, v in data.items():
+        got = back[k].asnumpy()
+        assert got.dtype.name == narrowed.get(v.dtype.name, v.dtype.name), k
+        np.testing.assert_allclose(got, v.astype(got.dtype), rtol=0)
+
+
+def test_nd_load_autodetects(tmp_path):
+    path = str(tmp_path / "auto.params")
+    save_mxnet_params(path, {"x": np.ones((2, 2), np.float32)})
+    loaded = nd.load(path)  # no format argument: magic-sniffed
+    np.testing.assert_array_equal(loaded["x"].asnumpy(), 1.0)
+
+
+def test_unnamed_list_container(tmp_path):
+    path = str(tmp_path / "anon.params")
+    save_mxnet_params(path, [np.zeros(3, np.float32),
+                             np.ones((2, 1), np.float32)])
+    loaded = load_mxnet_params(path)
+    assert isinstance(loaded, list) and len(loaded) == 2
+
+
+def _independent_v2_bytes(arrays):
+    """Second, test-local writer following ndarray.cc literally — catches
+    bugs that a same-module save/load roundtrip would mask."""
+    out = [struct.pack("<Q", 0x112), struct.pack("<Q", 0)]
+    out.append(struct.pack("<Q", len(arrays)))
+    for name, a in arrays:
+        out.append(struct.pack("<I", 0xF993FAC9))        # v2 magic
+        out.append(struct.pack("<i", 0))                 # kDefaultStorage
+        out.append(struct.pack("<I", a.ndim))            # TShape ndim
+        for d in a.shape:
+            out.append(struct.pack("<q", d))             # int64 dims
+        out.append(struct.pack("<ii", 1, 0))             # Context cpu(0)
+        flag = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+                "int32": 4, "int8": 5, "int64": 6}[a.dtype.name]
+        out.append(struct.pack("<i", flag))
+        out.append(a.tobytes())
+    out.append(struct.pack("<Q", len(arrays)))
+    for name, _ in arrays:
+        nb = name.encode()
+        out.append(struct.pack("<Q", len(nb)) + nb)
+    return b"".join(out)
+
+
+def test_loads_independently_written_v2():
+    rng = np.random.RandomState(1)
+    arrays = [("conv_weight", rng.randn(2, 3, 3, 3).astype(np.float32)),
+              ("labels", np.arange(5, dtype=np.int64))]
+    blob = _independent_v2_bytes(arrays)
+    back = load_mxnet_params(blob)
+    for name, a in arrays:
+        np.testing.assert_array_equal(back[name].asnumpy(), a)
+
+
+def test_loads_legacy_v1_and_ndim_magic():
+    """Pre-v2 files: V1 magic (int64 dims) and the oldest form where the
+    magic word IS the ndim (uint32 dims) — ndarray.cc:1646-1690."""
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    v1 = b"".join([struct.pack("<Q", 0x112), struct.pack("<Q", 0),
+                   struct.pack("<Q", 1),
+                   struct.pack("<I", 0xF993FAC8),     # v1 magic
+                   struct.pack("<I", 2),
+                   struct.pack("<qq", 2, 3),
+                   struct.pack("<ii", 1, 0),
+                   struct.pack("<i", 0), a.tobytes(),
+                   struct.pack("<Q", 0)])
+    got = load_mxnet_params(v1)
+    np.testing.assert_array_equal(got[0].asnumpy(), a)
+
+    oldest = b"".join([struct.pack("<Q", 0x112), struct.pack("<Q", 0),
+                       struct.pack("<Q", 1),
+                       struct.pack("<I", 2),          # magic == ndim
+                       struct.pack("<II", 2, 3),      # uint32 dims
+                       struct.pack("<ii", 1, 0),
+                       struct.pack("<i", 0), a.tobytes(),
+                       struct.pack("<Q", 0)])
+    got = load_mxnet_params(oldest)
+    np.testing.assert_array_equal(got[0].asnumpy(), a)
+
+
+def test_loads_row_sparse():
+    """Row-sparse v2 entry (storage shape + one aux) -> RowSparseNDArray."""
+    data = np.ones((2, 3), np.float32) * 4
+    idx = np.array([1, 3], np.int64)
+    blob = b"".join([
+        struct.pack("<Q", 0x112), struct.pack("<Q", 0), struct.pack("<Q", 1),
+        struct.pack("<I", 0xF993FAC9),
+        struct.pack("<i", 1),                          # kRowSparseStorage
+        struct.pack("<I", 2), struct.pack("<qq", 2, 3),  # storage shape
+        struct.pack("<I", 2), struct.pack("<qq", 5, 3),  # logical shape
+        struct.pack("<ii", 1, 0),
+        struct.pack("<i", 0),                          # data f32
+        struct.pack("<i", 6), struct.pack("<I", 1), struct.pack("<q", 2),
+        data.tobytes(), idx.tobytes(),
+        struct.pack("<Q", 1), struct.pack("<Q", 3) + b"emb"])
+    got = load_mxnet_params(blob)
+    rsp = got["emb"]
+    assert rsp.shape == (5, 3)
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), idx)
+    dense = rsp.tostype("default").asnumpy()
+    np.testing.assert_array_equal(dense[[1, 3]], 4.0)
+    np.testing.assert_array_equal(dense[[0, 2, 4]], 0.0)
+
+
+def test_golden_reference_lenet_predicts():
+    """A committed reference-format LeNet checkpoint (arg:/aux: names, the
+    Module save_checkpoint container) loads through load_checkpoint and
+    reproduces the committed logits bit-for-bit."""
+    from incubator_mxnet_tpu import model
+
+    prefix = os.path.join(GOLDEN, "ref_lenet")
+    symbol, arg_params, aux_params = model.load_checkpoint(prefix, 1)
+    x = nd.array(np.load(prefix + "-input.npy"))
+    expect = np.load(prefix + "-logits.npy")
+    ex = symbol.bind(mx.cpu(), args={**arg_params, "data": x},
+                     aux_states=aux_params)
+    got = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(got, expect, rtol=1e-6, atol=1e-7)
+
+
+def test_pretrained_loads_from_local_root(tmp_path):
+    """pretrained=True resolves weights from the offline model root."""
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(11)
+    src = vision.resnet18_v1(classes=10)
+    src.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(0).rand(1, 3, 32, 32)
+                 .astype(np.float32))
+    ref_out = src(x).asnumpy()
+    # structured (prefix-independent) names — what gluon save_parameters
+    # writes and what a fresh net instance can always match
+    save_mxnet_params(
+        str(tmp_path / "resnet18_v1.params"),
+        {n: p.data().asnumpy()
+         for n, p in src._collect_params_with_prefix().items()})
+
+    net = vision.resnet18_v1(classes=10, pretrained=True,
+                             root=str(tmp_path))
+    np.testing.assert_allclose(net(x).asnumpy(), ref_out, rtol=1e-6)
+
+
+def test_pretrained_missing_raises_with_path(tmp_path):
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    with pytest.raises(FileNotFoundError, match="resnet18_v1"):
+        vision.resnet18_v1(classes=10, pretrained=True,
+                           root=str(tmp_path / "empty"))
+
+
+def test_model_store_accepts_sha1_tagged_names(tmp_path):
+    from incubator_mxnet_tpu.gluon.model_zoo.model_store import \
+        get_model_file
+
+    tagged = tmp_path / "alexnet-44335d1f.params"
+    tagged.write_bytes(b"x")
+    assert get_model_file("alexnet", str(tmp_path)) == str(tagged)
+
+
+def test_csr_load_aux_order():
+    """CSR aux order on disk is (indptr, indices) — kIndPtr=0, kIdx=1."""
+    # 3x4 matrix, rows 0 and 2 occupied
+    data = np.array([1.0, 2.0, 3.0], np.float32)
+    indices = np.array([0, 2, 1], np.int64)    # kIdx (aux 1)
+    indptr = np.array([0, 2, 2, 3], np.int64)  # kIndPtr (aux 0)
+    blob = b"".join([
+        struct.pack("<Q", 0x112), struct.pack("<Q", 0), struct.pack("<Q", 1),
+        struct.pack("<I", 0xF993FAC9),
+        struct.pack("<i", 2),                              # kCSRStorage
+        struct.pack("<I", 1), struct.pack("<q", 3),        # storage shape
+        struct.pack("<I", 2), struct.pack("<qq", 3, 4),    # logical shape
+        struct.pack("<ii", 1, 0),
+        struct.pack("<i", 0),                              # data f32
+        struct.pack("<i", 6), struct.pack("<I", 1), struct.pack("<q", 4),
+        struct.pack("<i", 6), struct.pack("<I", 1), struct.pack("<q", 3),
+        data.tobytes(), indptr.tobytes(), indices.tobytes(),
+        struct.pack("<Q", 1), struct.pack("<Q", 1) + b"m"])
+    got = load_mxnet_params(blob)["m"]
+    np.testing.assert_array_equal(got.indptr.asnumpy(), indptr)
+    np.testing.assert_array_equal(got.indices.asnumpy(), indices)
+    dense = got.tostype("default").asnumpy()
+    expect = np.zeros((3, 4), np.float32)
+    expect[0, 0], expect[0, 2], expect[2, 1] = 1, 2, 3
+    np.testing.assert_array_equal(dense, expect)
+
+
+def test_hybrid_block_export_reference_format(tmp_path):
+    """HybridBlock.export writes symbol json + REFERENCE-format params
+    that load_checkpoint round-trips (ref: block.py:868 export)."""
+    from incubator_mxnet_tpu import gluon, model
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(0).rand(2, 5).astype(np.float32))
+    ref = net(x).asnumpy()
+    prefix = str(tmp_path / "exported")
+    net.export(prefix, epoch=3)
+    assert is_mxnet_params(prefix + "-0003.params")
+    symbol, arg_params, aux_params = model.load_checkpoint(prefix, 3)
+    ex = symbol.bind(mx.cpu(), args={**arg_params, "data": x},
+                     aux_states=aux_params)
+    np.testing.assert_allclose(ex.forward(is_train=False)[0].asnumpy(),
+                               ref, rtol=1e-6)

@@ -281,3 +281,179 @@ def test_import_slice_opset10_params_as_inputs(tmp_path):
     xv = np.arange(12, dtype=np.float32).reshape(3, 4)
     got = _forward(s, args, auxs, nd.array(xv))
     np.testing.assert_allclose(got, xv[:, 1:3], rtol=1e-6)
+
+
+# --- model-zoo round trips (ref: the reference's ONNX story covers its
+# model zoo; mx2onnx/_op_translations.py has ~97 translations) -------------
+
+NIGHTLY = os.environ.get("MXTPU_NIGHTLY", "") not in ("", "0")
+
+
+def _zoo_roundtrip(ctor, shape, tmp_path, tol=1e-3):
+    import incubator_mxnet_tpu as mx
+
+    net = ctor(classes=10)
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(0).rand(*shape).astype(np.float32))
+    ref = net(x).asnumpy()
+    s = net._to_symbol()
+    params = {n: p.data() for n, p in net.collect_params().items()}
+    path = os.path.join(str(tmp_path), "zoo.onnx")
+    export_model(s, params, [shape], onnx_file_path=path)
+    s2, arg2, aux2 = import_model(path)
+    got = _forward(s2, arg2, aux2, x)
+    np.testing.assert_allclose(got, ref, rtol=tol, atol=tol)
+
+
+@pytest.mark.parametrize("name,shape", [
+    ("resnet18_v1", (1, 3, 32, 32)),
+    ("resnet18_v2", (1, 3, 32, 32)),
+    ("vgg11_bn", (1, 3, 32, 32)),
+    ("squeezenet1_1", (1, 3, 64, 64)),
+    ("mobilenet0_25", (1, 3, 32, 32)),
+    ("mobilenet_v2_0_25", (1, 3, 32, 32)),
+])
+def test_zoo_roundtrip(name, shape, tmp_path):
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    _zoo_roundtrip(getattr(vision, name), shape, tmp_path)
+
+
+@pytest.mark.skipif(not NIGHTLY, reason="224/299 CPU forward; MXTPU_NIGHTLY=1")
+@pytest.mark.parametrize("name,shape", [
+    ("densenet121", (1, 3, 224, 224)),
+    ("inception_v3", (1, 3, 299, 299)),
+    ("alexnet", (1, 3, 224, 224)),
+    ("vgg11", (1, 3, 32, 32)),
+    ("squeezenet1_0", (1, 3, 64, 64)),
+])
+def test_zoo_roundtrip_nightly(name, shape, tmp_path):
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    _zoo_roundtrip(getattr(vision, name), shape, tmp_path)
+
+
+# --- round-3 op-translation tail -------------------------------------------
+
+def test_roundtrip_unary_tail(tmp_path):
+    data = sym.Variable("data")
+    net = sym.erf(sym.abs(data)) + sym.floor(data) + sym.ceil(data) \
+        + sym.sign(data) + sym.reciprocal(data + 3.0) + sym.square(data)
+    _roundtrip(net, (2, 5), tmp_path)
+
+
+def test_roundtrip_trig_tail(tmp_path):
+    data = sym.Variable("data")
+    net = sym.sin(data) + sym.cos(data) + sym.tan(data) + \
+        sym.arctan(data) + sym.sinh(data) + sym.cosh(data)
+    _roundtrip(net, (3, 4), tmp_path)
+
+
+def test_roundtrip_shape_ops(tmp_path):
+    data = sym.Variable("data")
+    e = sym.expand_dims(data, axis=1)           # (2,1,6)
+    t = sym.tile(e, reps=(1, 3, 1))             # (2,3,6)
+    sq = sym.squeeze(sym.expand_dims(t, axis=0), axis=0)
+    _roundtrip(sq, (2, 6), tmp_path)
+
+
+def test_roundtrip_split_concat(tmp_path):
+    data = sym.Variable("data")
+    parts = sym.SliceChannel(data, num_outputs=3, axis=1)
+    net = sym.Concat(parts[0], parts[2], parts[1], dim=1)
+    _roundtrip(net, (2, 6), tmp_path)
+
+
+def test_roundtrip_reduce_tail(tmp_path):
+    data = sym.Variable("data")
+    net = sym.min(data, axis=1, keepdims=True) + \
+        sym.prod(data + 1.5, axis=1, keepdims=True) + \
+        sym.log_softmax(data, axis=-1)
+    _roundtrip(net, (3, 4), tmp_path)
+
+
+def test_roundtrip_binary_tail(tmp_path):
+    a = sym.Variable("data")
+    net = sym.broadcast_maximum(a, sym.zeros_like(a)) + \
+        sym.broadcast_minimum(a, sym.broadcast_power(a + 2.0, a * 0.0 + 2.0))
+    _roundtrip(net, (2, 3), tmp_path)
+
+
+def test_roundtrip_lrn_instancenorm(tmp_path):
+    data = sym.Variable("data")
+    net = sym.LRN(data, nsize=3, alpha=1e-4, beta=0.75, knorm=2.0)
+    _roundtrip(net, (1, 6, 5, 5), tmp_path)
+
+
+def test_roundtrip_deconv(tmp_path):
+    data = sym.Variable("data")
+    net = sym.Deconvolution(data, sym.Variable("w"), sym.Variable("b"),
+                            kernel=(3, 3), stride=(2, 2), num_filter=4,
+                            no_bias=False, name="deconv0")
+    _roundtrip(net, (1, 2, 5, 5), tmp_path)
+    # default no_bias=True: the ignored bias input must not be exported
+    net2 = sym.Deconvolution(data, sym.Variable("w2"), sym.Variable("b2"),
+                             kernel=(3, 3), stride=(2, 2), num_filter=4,
+                             name="deconv1")
+    _roundtrip(net2, (1, 2, 5, 5), tmp_path)
+
+
+def test_roundtrip_cast_hard_sigmoid(tmp_path):
+    data = sym.Variable("data")
+    net = sym.Cast(sym.hard_sigmoid(data), dtype="float32")
+    _roundtrip(net, (2, 4), tmp_path)
+
+
+def test_export_op_count():
+    """The translation table must keep growing toward the reference's ~97
+    (mx2onnx/_op_translations.py); special-cased ops count too."""
+    from incubator_mxnet_tpu.contrib.onnx.mx2onnx import ONNX_OP_MAP
+
+    specials = {"Activation", "Pooling", "SliceChannel", "split", "tile",
+                "square", "zeros_like", "Cast", "cast", "amp_cast",
+                "UpSampling"}
+    assert len(set(ONNX_OP_MAP) | specials) >= 90
+
+
+def test_roundtrip_zeros_like_constant_of_shape(tmp_path):
+    data = sym.Variable("data")
+    net = sym.zeros_like(data) + data * 2.0
+    _roundtrip(net, (2, 3), tmp_path)
+
+
+def test_roundtrip_square_and_scalar_ops(tmp_path):
+    data = sym.Variable("data")
+    net = sym.square(data) + (data + 1.5) * 2.0 - (3.0 - data) / 2.0
+    _roundtrip(net, (2, 3), tmp_path)
+
+
+def test_roundtrip_fc_no_bias(tmp_path):
+    # Gemm needs 3 inputs until opset 11: no_bias FC exports a zero C
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, sym.Variable("w"), num_hidden=4,
+                             no_bias=True, name="fc_nb")
+    _roundtrip(net, (2, 5), tmp_path)
+
+
+def test_export_slice_step_rejected():
+    data = sym.Variable("data")
+    net = sym.slice(data, begin=(0,), end=(4,), step=(2,))
+    from incubator_mxnet_tpu.contrib.onnx.mx2onnx import graph_to_onnx_nodes
+    with pytest.raises(NotImplementedError, match="step"):
+        graph_to_onnx_nodes(net)
+
+
+def test_import_split_uneven_rejected(tmp_path):
+    node = proto.NodeProto(op_type="Split", input=["data"],
+                           output=["a", "b"], name="sp",
+                           attribute=[proto.AttributeProto(
+                               name="split", ints=[2, 3],
+                               type=proto.AttrType.INTS),
+                               proto.AttributeProto(
+                               name="axis", i=1,
+                               type=proto.AttrType.INT)])
+    model = _make_model([node], ["data"], ["a", "b"], [], opset=9)
+    path = os.path.join(str(tmp_path), "sp.onnx")
+    proto.save_model(model, path)
+    with pytest.raises(NotImplementedError, match="uneven"):
+        import_model(path)
