@@ -242,6 +242,25 @@ class GluonTrainStep:
             jnp.asarray(lrs, jnp.float32), jnp.asarray(ts, jnp.float32))
         return NDArray._from_data(losses)
 
+    def memory_stats(self, x, y, name="train_step"):
+        """Compile-time device memory breakdown of the fused step (the
+        storage-profiler answer: per-program HBM from XLA's own analysis,
+        recorded into profiler.dumps_memory())."""
+        from . import profiler
+        from . import random as _rng_mod
+
+        if not self._built:
+            self._build(
+                x if isinstance(x, NDArray) else NDArray(jnp.asarray(x)),
+                y if isinstance(y, NDArray) else NDArray(jnp.asarray(y)),
+            )
+        xd = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        yd = y._data if isinstance(y, NDArray) else jnp.asarray(y)
+        return profiler.memory_analysis(
+            self._step, self._params, self._states, xd, yd,
+            _rng_mod.next_key(), jnp.asarray(self.opt.lr, jnp.float32),
+            jnp.asarray(1.0, jnp.float32), name=name)
+
     def sync_params(self):
         """Write current param values back into the net's Parameters."""
         for p, d in zip(self.param_objs, self._params):

@@ -225,21 +225,67 @@ def _csr_to_dense(data, indices, indptr, shape):
     return out.at[rows, indices.astype(jnp.int32)].add(data)
 
 
+@partial(jax.jit, static_argnums=4)
 def _csr_dot_dense(data, indices, indptr, rhs, m):
     """CSR(m,k) x dense(k,n) -> dense(m,n). MXU-adjacent formulation:
-    gather rhs rows by column index, scale, segment-sum by row."""
+    gather rhs rows by column index, scale, segment-sum by row.
+    Tolerates nnz PADDING: padded entries carry data 0 (their row id
+    resolves to m, which segment_sum drops; their clamped gathers multiply
+    against zero)."""
     rows = _row_ids_from_indptr(indptr, data.shape[0])
     gathered = rhs[indices.astype(jnp.int32)]          # (nnz, n)
     prod = data.reshape(-1, *([1] * (rhs.ndim - 1))) * gathered
     return jax.ops.segment_sum(prod, rows, num_segments=m)
 
 
+@partial(jax.jit, static_argnums=4)
 def _csr_T_dot_dense(data, indices, indptr, rhs, k):
-    """CSR(m,k)^T x dense(m,n) -> dense(k,n): scatter-add into columns."""
+    """CSR(m,k)^T x dense(m,n) -> dense(k,n): scatter-add into columns.
+    nnz-padding-tolerant like _csr_dot_dense."""
     rows = _row_ids_from_indptr(indptr, data.shape[0])
     gathered = rhs[rows]                                # (nnz, n)
     prod = data.reshape(-1, *([1] * (rhs.ndim - 1))) * gathered
     return jax.ops.segment_sum(prod, indices.astype(jnp.int32), num_segments=k)
+
+
+# ---------------------------------------------------------------------------
+# nnz bucketing: "nnz is a compile-time constant" means every distinct nnz
+# is a distinct XLA program; real sparse streams (minibatches of LibSVM
+# rows, sampled subgraphs) vary nnz per batch and would recompile forever.
+# Padding nnz up to a power-of-2 bucket bounds the number of programs at
+# log2(max_nnz) while adding only zero-contribution entries (ref role:
+# src/operator/tensor/dot-inl.h handles dynamic nnz at runtime; XLA's
+# static shapes make bucketing the equivalent policy).
+# ---------------------------------------------------------------------------
+
+
+def _bucket_nnz(n):
+    """Smallest power-of-2 >= n (floor 16 keeps tiny batches in one
+    bucket)."""
+    n = int(n)
+    if n <= 16:
+        return 16
+    return 1 << (n - 1).bit_length()
+
+
+def _pad_nnz(data, indices):
+    """Pad (data, indices) along nnz to the bucket size with zeros; returns
+    them unchanged when bucketing is disabled (MXTPU_SPARSE_NNZ_BUCKETING)
+    or already at a bucket boundary."""
+    from .. import config as _config
+
+    if not _config.get("MXTPU_SPARSE_NNZ_BUCKETING"):
+        return data, indices
+    n = int(data.shape[0])
+    b = _bucket_nnz(n)
+    if b == n:
+        return data, indices
+    pad = b - n
+    data = jnp.concatenate(
+        [data, jnp.zeros((pad,) + tuple(data.shape[1:]), data.dtype)])
+    indices = jnp.concatenate(
+        [indices, jnp.zeros((pad,), indices.dtype)])
+    return data, indices
 
 
 def dot(lhs, rhs, transpose_a=False):

@@ -16,7 +16,8 @@ import jax.profiler
 __all__ = [
     "set_config", "set_state", "dump", "dumps", "pause", "resume", "Task",
     "Frame", "Event", "Counter", "Marker", "scope", "aggregate_enabled",
-    "timed_invoke", "reset_stats",
+    "timed_invoke", "reset_stats", "memory_analysis", "record_memory",
+    "dumps_memory",
 ]
 
 _CONFIG = {"filename": "profile.json", "profile_all": False,
@@ -84,6 +85,7 @@ def timed_invoke(op_name, call, *args, **kwargs):
 
 def reset_stats():
     _AGG_STATS.clear()
+    _MEM_STATS.clear()
 
 
 def dumps(reset=False, sort_by="total", ascending=False):
@@ -115,6 +117,72 @@ def dumps(reset=False, sort_by="total", ascending=False):
             f"{s.min * 1e3:>10.3f} {s.max * 1e3:>10.3f} {avg * 1e3:>10.3f}")
     if reset:
         reset_stats()
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# compiled-program memory statistics (ref: src/profiler/storage_profiler.h —
+# the reference tracked per-device allocations through its pooled allocator;
+# under XLA the ground truth is the compiler's own memory analysis of each
+# executable: argument/output/temp/alias bytes, known exactly at compile
+# time rather than sampled at runtime)
+# ---------------------------------------------------------------------------
+
+_MEM_STATS: dict[str, dict] = {}
+
+
+def record_memory(name, compiled):
+    """Record a compiled executable's memory breakdown under `name`.
+    `compiled` is a jax.stages.Compiled (jit(f).lower(...).compile())."""
+    try:
+        m = compiled.memory_analysis()
+    except Exception:
+        return None  # backend without memory analysis: not recordable
+    if m is None:
+        return None
+    stats = {
+        "argument_bytes": int(getattr(m, "argument_size_in_bytes", 0)),
+        "output_bytes": int(getattr(m, "output_size_in_bytes", 0)),
+        "temp_bytes": int(getattr(m, "temp_size_in_bytes", 0)),
+        "alias_bytes": int(getattr(m, "alias_size_in_bytes", 0)),
+        "code_bytes": int(getattr(m, "generated_code_size_in_bytes", 0)),
+    }
+    # peak device footprint while the program runs: live args + outputs +
+    # XLA temp arena (aliased/donated bytes are counted once, in args)
+    stats["peak_bytes"] = (stats["argument_bytes"] + stats["output_bytes"]
+                           + stats["temp_bytes"] - stats["alias_bytes"])
+    _MEM_STATS[name] = stats
+    return stats
+
+
+def memory_analysis(fn, *args, name=None, static_argnums=None):
+    """Compile `fn` for `args` (cached by jax) and record/return its device
+    memory breakdown — the per-program HBM answer to the reference's
+    storage profiler."""
+    jitted = fn if hasattr(fn, "lower") else jax.jit(
+        fn, static_argnums=static_argnums or ())
+    compiled = jitted.lower(*args).compile()
+    return record_memory(name or getattr(fn, "__name__", "program"),
+                         compiled)
+
+
+def dumps_memory():
+    """Formatted per-program memory table (storage_profiler.h analog)."""
+    lines = [
+        "Memory Statistics (per compiled program):",
+        f"{'Name':<32s} {'Peak(MiB)':>10s} {'Args(MiB)':>10s} "
+        f"{'Out(MiB)':>9s} {'Temp(MiB)':>10s} {'Alias(MiB)':>10s}",
+        "-" * 85,
+    ]
+    mib = 1024.0 * 1024.0
+    for name, s in sorted(_MEM_STATS.items(),
+                          key=lambda kv: -kv[1]["peak_bytes"]):
+        lines.append(
+            f"{name[:32]:<32s} {s['peak_bytes'] / mib:>10.2f} "
+            f"{s['argument_bytes'] / mib:>10.2f} "
+            f"{s['output_bytes'] / mib:>9.2f} "
+            f"{s['temp_bytes'] / mib:>10.2f} "
+            f"{s['alias_bytes'] / mib:>10.2f}")
     return "\n".join(lines)
 
 
