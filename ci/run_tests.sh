@@ -26,6 +26,11 @@ run_examples() {
     python -m pytest tests/test_examples.py -q
 }
 
+run_suite() {
+    echo "=== full suite, ONE process, no -x (the honest green bar) ==="
+    python -m pytest tests/ -q
+}
+
 run_nightly() {
     echo "=== nightly tier (large tensors, checkpoint compat, 7-worker dist) ==="
     MXTPU_NIGHTLY=1 python -m pytest tests/test_large_array.py \
@@ -37,8 +42,9 @@ case "$tier" in
     unit)     run_unit ;;
     dist)     run_dist ;;
     examples) run_examples ;;
+    suite)    run_suite ;;
     nightly)  run_nightly ;;
     all)      run_unit; run_dist; run_examples; run_nightly ;;
-    *) echo "unknown tier: $tier (unit|nightly|dist|examples|all)"; exit 2 ;;
+    *) echo "unknown tier: $tier (unit|nightly|dist|examples|suite|all)"; exit 2 ;;
 esac
 echo "tier '$tier' green"

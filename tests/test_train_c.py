@@ -117,17 +117,23 @@ def test_c_loader_introspection(artifact):
 
 
 def test_c_get_state_initial_values(artifact):
-    """Artifact-only GetState returns the exported initial parameters."""
+    """Artifact-only GetState returns the exported initial parameters.
+
+    The first param state is the first Dense weight, but its NAME depends on
+    the process-global gluon auto-naming counters (denseN_weight under full
+    suite order) — read it from the artifact instead of hardcoding."""
     lib = train_lib()
     tr = deploy.TrainerArtifact(artifact)
+    wname = tr.state_names[0]
+    assert wname.startswith("param:") and wname.endswith("_weight")
     h = ctypes.c_void_p()
     assert lib.MXTpuTrainerCreate((artifact + "-train.mxt").encode(), None,
                                   ctypes.byref(h)) == 0
     try:
-        ref = tr.get_state("param:dense0_weight")
+        ref = tr.get_state(wname)
         got = np.zeros_like(ref)
         rc = lib.MXTpuTrainerGetState(
-            h, b"param:dense0_weight",
+            h, wname.encode(),
             got.ctypes.data_as(ctypes.c_void_p), got.nbytes)
         assert rc == 0, lib.MXTpuLastError()
         np.testing.assert_array_equal(got, ref)
@@ -135,7 +141,7 @@ def test_c_get_state_initial_values(artifact):
         assert lib.MXTpuTrainerGetState(h, b"param:nope",
                                         got.ctypes.data_as(ctypes.c_void_p),
                                         got.nbytes) != 0
-        assert lib.MXTpuTrainerGetState(h, b"param:dense0_weight",
+        assert lib.MXTpuTrainerGetState(h, wname.encode(),
                                         got.ctypes.data_as(ctypes.c_void_p),
                                         3) != 0
     finally:
@@ -144,17 +150,19 @@ def test_c_get_state_initial_values(artifact):
 
 def test_c_set_state_roundtrip(artifact):
     lib = train_lib()
+    tr = deploy.TrainerArtifact(artifact)
+    wname = tr.state_names[0]  # first Dense weight, whatever its auto-name
     h = ctypes.c_void_p()
     assert lib.MXTpuTrainerCreate((artifact + "-train.mxt").encode(), None,
                                   ctypes.byref(h)) == 0
     try:
-        new_w = np.full((16, 5), 0.25, np.float32)
+        new_w = np.full(tr.get_state(wname).shape, 0.25, np.float32)
         assert lib.MXTpuTrainerSetState(
-            h, b"param:dense0_weight",
+            h, wname.encode(),
             new_w.ctypes.data_as(ctypes.c_void_p), new_w.nbytes) == 0
         got = np.zeros_like(new_w)
         assert lib.MXTpuTrainerGetState(
-            h, b"param:dense0_weight",
+            h, wname.encode(),
             got.ctypes.data_as(ctypes.c_void_p), got.nbytes) == 0
         np.testing.assert_array_equal(got, new_w)
     finally:

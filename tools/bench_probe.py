@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Background TPU-availability probe for the headline benchmark.
+
+The axon tunnel to the real chip flaps for hours at a time; a bench run at
+an unlucky moment reports only the cached number. This probe loops for the
+whole build round: every PROBE_INTERVAL seconds it checks (in a subprocess,
+with a hard timeout — a down tunnel makes jax.devices() hang) whether an
+accelerator is reachable, and the moment one is, it runs the full bench.py,
+which persists the on-chip measurement into BENCH_CACHE.json. Exits 0 after
+the first successful TPU measurement, or after MAX_HOURS.
+
+Usage: python tools/bench_probe.py [--once]
+Log:   tools/bench_probe.log (stdout/stderr of each attempt)
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "tools", "bench_probe.log")
+PROBE_INTERVAL = int(os.environ.get("BENCH_PROBE_INTERVAL", "300"))
+MAX_HOURS = float(os.environ.get("BENCH_PROBE_MAX_HOURS", "11"))
+PROBE_TIMEOUT = 180
+
+
+def log(msg):
+    line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+    print(line, flush=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def accel_up():
+    sys.path.insert(0, REPO)
+    from bench import _probe_accelerator
+    return _probe_accelerator(timeout=PROBE_TIMEOUT)
+
+
+def run_bench():
+    """Full bench (fp32 + bf16, scan mode). Returns True if a TPU number
+    landed in BENCH_CACHE.json during this run."""
+    cache = os.path.join(REPO, "BENCH_CACHE.json")
+    before = None
+    try:
+        with open(cache) as f:
+            before = json.load(f).get("ts")
+    except (OSError, ValueError):
+        pass
+    try:
+        p = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                           capture_output=True, text=True, timeout=5400)
+        log(f"bench rc={p.returncode} out={p.stdout.strip()[-400:]}")
+        if p.stderr:
+            log("bench stderr tail: " + "\n".join(
+                p.stderr.strip().splitlines()[-10:]))
+    except subprocess.TimeoutExpired:
+        log("bench timed out after 5400s")
+        return False
+    try:
+        with open(cache) as f:
+            after = json.load(f).get("ts")
+        return after is not None and after != before
+    except (OSError, ValueError):
+        return False
+
+
+def main():
+    once = "--once" in sys.argv
+    deadline = time.time() + MAX_HOURS * 3600
+    log(f"probe loop start (interval={PROBE_INTERVAL}s, max={MAX_HOURS}h)")
+    while time.time() < deadline:
+        if accel_up():
+            log("accelerator UP — running full bench")
+            if run_bench():
+                log("fresh on-chip measurement cached — done")
+                return 0
+            log("bench ran but no fresh TPU number; will retry")
+        else:
+            log("accelerator down")
+        if once:
+            return 1
+        time.sleep(PROBE_INTERVAL)
+    log("deadline reached without a fresh TPU measurement")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
