@@ -19,6 +19,9 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+from bench import _probe_accelerator  # noqa: E402
+
 LOG = os.path.join(REPO, "tools", "bench_probe.log")
 PROBE_INTERVAL = int(os.environ.get("BENCH_PROBE_INTERVAL", "300"))
 MAX_HOURS = float(os.environ.get("BENCH_PROBE_MAX_HOURS", "11"))
@@ -33,8 +36,6 @@ def log(msg):
 
 
 def accel_up():
-    sys.path.insert(0, REPO)
-    from bench import _probe_accelerator
     return _probe_accelerator(timeout=PROBE_TIMEOUT)
 
 
