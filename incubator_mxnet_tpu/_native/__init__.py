@@ -34,7 +34,21 @@ def _compile(out: str, sources: list[str], extra: list[str],
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=180)
         return out
-    except (subprocess.CalledProcessError, subprocess.TimeoutExpired, FileNotFoundError):
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired, FileNotFoundError) as e:
+        # consumers fall back to pure-Python paths — make the degradation
+        # visible instead of silent (a missing g++ should not just mean
+        # "mysteriously slower")
+        import warnings
+
+        detail = ""
+        if isinstance(e, subprocess.CalledProcessError) and e.stderr:
+            detail = ": " + e.stderr.decode(errors="replace").strip()[-300:]
+        warnings.warn(
+            f"native build of {os.path.basename(out)} failed "
+            f"({type(e).__name__}{detail}); falling back to the pure-Python "
+            f"implementation (slower). Install g++ or check src/ sources.",
+            RuntimeWarning,
+        )
         return None
 
 

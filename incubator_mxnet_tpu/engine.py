@@ -247,7 +247,14 @@ def get_engine():
                 try:
                     _DEFAULT_ENGINE = ThreadedEngine()
                     atexit.register(_drain_default_engine)
-                except RuntimeError:
+                except RuntimeError as e:
+                    import warnings
+
+                    warnings.warn(
+                        f"ThreadedEngine unavailable ({e}); degrading to "
+                        f"NaiveEngine (serial dependency execution, slower "
+                        f"async semantics). Set MXNET_ENGINE_TYPE=NaiveEngine "
+                        f"to silence this.", RuntimeWarning)
                     _DEFAULT_ENGINE = NaiveEngine()
         return _DEFAULT_ENGINE
 

@@ -11,6 +11,16 @@ work is the dispatch call.
 Under a mesh, inputs sharded on the batch axis + replicated params make the
 same program data-parallel: GSPMD inserts the gradient all-reduce over ICI
 (the kvstore='device'/'nccl' path of the reference).
+
+Optimizer coverage: EVERY built-in optimizer (SGD, NAG, SGLD, Signum, FTML,
+DCASGD, LBSGD, Adam, AdaGrad, RMSProp, AdaDelta, Ftrl, Adamax, Nadam,
+AdamW, Test) ships an exact fused_update whose 3-step trajectory is tested
+against its eager update() (tests/test_optimizer.py). Custom optimizers
+without one fall back to tracing their eager update() inside the step
+(with a RuntimeWarning): correct for pure-jnp-math updates, but Python-side
+state (per-index update counts, host RNG draws) freezes at trace time —
+implement fused_update(name, weight, grad, state, lr, t=None) for
+time-dependent or stochastic custom updates.
 """
 from __future__ import annotations
 
@@ -41,10 +51,21 @@ class GluonTrainStep:
         self.device = device  # single target device (e.g. the TPU chip)
         self._built = False
         self._n = 0
-        if not hasattr(self.opt, "fused_update"):
-            raise TypeError(
-                f"{type(self.opt).__name__} has no fused_update; use the eager path"
-            )
+        from .optimizer import Optimizer as _OptBase
+
+        if (type(self.opt).fused_update is _OptBase.fused_update
+                and type(self.opt) is not _OptBase):
+            # every built-in optimizer ships an exact fused_update; a custom
+            # one falls back to tracing its eager update(), which freezes
+            # any Python-side state (update counts, host RNG) at trace time
+            import warnings
+
+            warnings.warn(
+                f"{type(self.opt).__name__} has no dedicated fused_update; "
+                f"tracing its eager update() instead. Time-dependent or "
+                f"stochastic optimizers should implement "
+                f"fused_update(name, weight, grad, state, lr, t=None).",
+                RuntimeWarning)
 
     def _build(self, x, y):
         # resolve deferred parameter shapes abstractly: eval_shape traces the
@@ -77,8 +98,12 @@ class GluonTrainStep:
         self.names = [n for n, _ in params]
         self.param_objs = [p for _, p in params]
         self.grad_mask = [p.grad_req != "null" for p in self.param_objs]
+        # create_fused_state lets an optimizer carry extra traced state that
+        # its eager path keeps in Python (e.g. Nadam's m_schedule)
+        make_state = getattr(self.opt, "create_fused_state",
+                             self.opt.create_state)
         self._states = [
-            self._state_data(self.opt.create_state(i, p.data())) if m else None
+            self._state_data(make_state(i, p.data())) if m else None
             for i, (p, m) in enumerate(zip(self.param_objs, self.grad_mask))
         ]
         self._params = [p.data()._data for p in self.param_objs]

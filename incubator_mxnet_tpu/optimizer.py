@@ -17,7 +17,7 @@ import jax.numpy as jnp
 from . import random as _global_random
 from .ndarray import register as _ndreg
 from .ndarray.ndarray import NDArray
-from .ndarray import zeros
+from .ndarray import ones, zeros
 
 __all__ = [
     "Optimizer", "SGD", "NAG", "SGLD", "Signum", "FTML", "DCASGD", "LBSGD",
@@ -296,7 +296,9 @@ class DCASGD(Optimizer):
 
     def create_state(self, index, weight):
         mom = zeros(weight.shape, dtype=str(weight.dtype)) if self.momentum else None
-        prev = NDArray(weight._data)
+        # must COPY: aliasing weight's buffer would make the fused step
+        # donate the same buffer twice (params and states are both donated)
+        prev = NDArray(jnp.array(weight._data, copy=True))
         return (mom, prev)
 
     def update(self, index, weight, grad, state):
@@ -644,8 +646,7 @@ def _sgd_fused(self, name, weight, grad, state, lr, t=None):
     g = grad * self.rescale_grad
     if self.clip_gradient:
         g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
-    wd = self.wd * self.wd_mult.get(name, 1.0)
-    lr = lr * self.lr_mult.get(name, 1.0)
+    lr, wd = _mults(self, name, lr)
     g = g + wd * weight
     if self.momentum != 0.0 and state is not None:
         new_mom = self.momentum * state - lr * g
@@ -654,14 +655,15 @@ def _sgd_fused(self, name, weight, grad, state, lr, t=None):
 
 
 SGD.fused_update = _sgd_fused
-LBSGD.fused_update = _sgd_fused
+# (LBSGD gets its own LARS-aware fused hook below)
 
 
 def _nag_fused(self, name, weight, grad, state, lr, t=None):
     g = grad * self.rescale_grad
     if self.clip_gradient:
         g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
-    g = g + self.wd * weight
+    lr, wd = _mults(self, name, lr)
+    g = g + wd * weight
     if self.momentum != 0.0 and state is not None:
         new_mom = self.momentum * state + g
         return weight - lr * (g + self.momentum * new_mom), new_mom
@@ -675,7 +677,8 @@ def _adam_fused(self, name, weight, grad, state, lr, t=None):
     g = grad * self.rescale_grad
     if self.clip_gradient:
         g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
-    g = g + self.wd * weight
+    lr, wd = _mults(self, name, lr)
+    g = g + wd * weight
     mean, var = state
     # t is a traced per-step input when driven by GluonTrainStep (so K
     # scanned steps each see their own update count); fall back to the
@@ -685,8 +688,10 @@ def _adam_fused(self, name, weight, grad, state, lr, t=None):
     t = jnp.maximum(jnp.asarray(t, jnp.float32), 1.0)
     new_mean = self.beta1 * mean + (1 - self.beta1) * g
     new_var = self.beta2 * var + (1 - self.beta2) * jnp.square(g)
-    coef1 = 1.0 - self.beta1 ** t
-    coef2 = 1.0 - self.beta2 ** t
+    # -expm1(t*log(beta)) == 1 - beta**t without the fp32 catastrophic
+    # cancellation at small t (beta2=0.999, t=1: naive form loses ~4 digits)
+    coef1 = _one_minus_pow(self.beta1, t)
+    coef2 = _one_minus_pow(self.beta2, t)
     lr_t = lr * jnp.sqrt(coef2) / coef1
     return (
         weight - lr_t * new_mean / (jnp.sqrt(new_var) + self.epsilon),
@@ -695,3 +700,308 @@ def _adam_fused(self, name, weight, grad, state, lr, t=None):
 
 
 Adam.fused_update = _adam_fused
+
+
+def _one_minus_pow(beta, t):
+    """1 - beta**t for traced t, cancellation-free (beta is a Python float;
+    its log is taken in double precision before entering the trace)."""
+    if beta <= 0.0:
+        return jnp.ones_like(t)
+    return -jnp.expm1(t * math.log(beta))
+
+
+def _mults(self, name, lr):
+    """Per-parameter lr/wd with name-keyed multipliers (the fused-path
+    analog of _get_lr/_get_wd, which are index-keyed on the eager path;
+    like them, a param_dict entry takes EXCLUSIVE priority over the
+    set_lr_mult/set_wd_mult dicts)."""
+    if name in self.param_dict:
+        lr = lr * self.param_dict[name].lr_mult
+        wd = self.wd * self.param_dict[name].wd_mult
+    else:
+        lr = lr * self.lr_mult.get(name, 1.0)
+        wd = self.wd * self.wd_mult.get(name, 1.0)
+    return lr, wd
+
+
+def _t_or_eager(self, t):
+    """Per-step update count: traced input under GluonTrainStep (each of K
+    scanned steps sees its own t), eager counter otherwise."""
+    if t is None:
+        t = float(max(self.num_update, 1))
+    return jnp.maximum(jnp.asarray(t, jnp.float32), 1.0)
+
+
+def _signum_fused(self, name, weight, grad, state, lr, t=None):
+    from .ops import optimizer as _oo
+
+    lr, wd = _mults(self, name, lr)
+    clip = self.clip_gradient if self.clip_gradient else -1.0
+    if state is not None:
+        w, m = _oo.signum_update(weight, grad, state, lr=lr, momentum=self.momentum,
+                                 wd=wd, rescale_grad=self.rescale_grad,
+                                 clip_gradient=clip, wd_lh=self.wd_lh)
+        return w, m
+    return _oo.signsgd_update(weight, grad, lr=lr, wd=wd,
+                              rescale_grad=self.rescale_grad,
+                              clip_gradient=clip), None
+
+
+Signum.fused_update = _signum_fused
+
+
+def _ftml_fused(self, name, weight, grad, state, lr, t=None):
+    from .ops import optimizer as _oo
+
+    lr, wd = _mults(self, name, lr)
+    d, v, z = state
+    w, d2, v2, z2 = _oo.ftml_update(
+        weight, grad, d, v, z, lr=lr, beta1=self.beta1, beta2=self.beta2,
+        epsilon=self.epsilon, wd=wd, rescale_grad=self.rescale_grad,
+        clip_grad=self.clip_gradient if self.clip_gradient else -1.0,
+        t=_t_or_eager(self, t))
+    return w, (d2, v2, z2)
+
+
+FTML.fused_update = _ftml_fused
+
+
+def _dcasgd_fused(self, name, weight, grad, state, lr, t=None):
+    lr, wd = _mults(self, name, lr)
+    g = grad * self.rescale_grad
+    if self.clip_gradient:
+        g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+    mom, prev = state
+    comp = g + wd * weight + self.lamda * g * g * (weight - prev)
+    if mom is not None:
+        new_mom = self.momentum * mom - lr * comp
+        return weight + new_mom, (new_mom, weight)
+    return weight - lr * comp, (None, weight)
+
+
+DCASGD.fused_update = _dcasgd_fused
+
+
+def _lbsgd_fused(self, name, weight, grad, state, lr, t=None):
+    """LARS trust-ratio SGD — matches LBSGD.update (NOT plain SGD)."""
+    lr, wd = _mults(self, name, lr)
+    g = grad * self.rescale_grad
+    if self.clip_gradient:
+        g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+    wnorm = jnp.linalg.norm(weight)
+    gnorm = jnp.linalg.norm(g)
+    ratio = jnp.where((wnorm > 0) & (gnorm > 0),
+                      wnorm / (gnorm + wd * wnorm + 1e-9), 1.0)
+    eff_lr = lr * ratio
+    if state is not None:
+        new_mom = self.momentum * state - eff_lr * (g + wd * weight)
+        return weight + new_mom, new_mom
+    return weight - eff_lr * (g + wd * weight), None
+
+
+LBSGD.fused_update = _lbsgd_fused
+
+
+def _adagrad_fused(self, name, weight, grad, state, lr, t=None):
+    lr, wd = _mults(self, name, lr)
+    g = grad * self.rescale_grad
+    if self.clip_gradient:
+        g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+    g = g + wd * weight
+    h = state + jnp.square(g)
+    return weight - lr * g / (jnp.sqrt(h) + self.float_stable_eps), h
+
+
+AdaGrad.fused_update = _adagrad_fused
+
+
+def _rmsprop_fused(self, name, weight, grad, state, lr, t=None):
+    from .ops import optimizer as _oo
+
+    lr, wd = _mults(self, name, lr)
+    clip = self.clip_gradient if self.clip_gradient else -1.0
+    cw = self.clip_weights if self.clip_weights else -1.0
+    if self.centered:
+        n, g, delta = state
+        w, n2, g2, d2 = _oo.rmspropalex_update(
+            weight, grad, n, g, delta, lr=lr, gamma1=self.gamma1,
+            gamma2=self.gamma2, epsilon=self.epsilon, wd=wd,
+            rescale_grad=self.rescale_grad, clip_gradient=clip,
+            clip_weights=cw)
+        return w, (n2, g2, d2)
+    w, n2 = _oo.rmsprop_update(
+        weight, grad, state, lr=lr, gamma1=self.gamma1, epsilon=self.epsilon,
+        wd=wd, rescale_grad=self.rescale_grad, clip_gradient=clip,
+        clip_weights=cw)
+    return w, n2
+
+
+RMSProp.fused_update = _rmsprop_fused
+
+
+def _adadelta_fused(self, name, weight, grad, state, lr, t=None):
+    _, wd = _mults(self, name, lr)  # AdaDelta ignores lr (as in update())
+    g = grad * self.rescale_grad
+    if self.clip_gradient:
+        g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+    acc_g, acc_delta = state
+    acc_g2 = self.rho * acc_g + (1 - self.rho) * jnp.square(g)
+    delta = jnp.sqrt(acc_delta + self.epsilon) / jnp.sqrt(acc_g2 + self.epsilon) * g
+    acc_delta2 = self.rho * acc_delta + (1 - self.rho) * jnp.square(delta)
+    return weight - delta - wd * weight, (acc_g2, acc_delta2)
+
+
+AdaDelta.fused_update = _adadelta_fused
+
+
+def _ftrl_fused(self, name, weight, grad, state, lr, t=None):
+    from .ops import optimizer as _oo
+
+    lr, wd = _mults(self, name, lr)
+    z, n = state
+    w, z2, n2 = _oo.ftrl_update(
+        weight, grad, z, n, lr=lr, lamda1=self.lamda1, beta=self.beta, wd=wd,
+        rescale_grad=self.rescale_grad,
+        clip_gradient=self.clip_gradient if self.clip_gradient else -1.0)
+    return w, (z2, n2)
+
+
+Ftrl.fused_update = _ftrl_fused
+
+
+def _adamax_fused(self, name, weight, grad, state, lr, t=None):
+    lr, wd = _mults(self, name, lr)
+    t = _t_or_eager(self, t)
+    lr = lr / _one_minus_pow(self.beta1, t)
+    g = grad * self.rescale_grad + wd * weight
+    if self.clip_gradient:
+        g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+    m, u = state
+    m2 = self.beta1 * m + (1 - self.beta1) * g
+    u2 = jnp.maximum(self.beta2 * u, jnp.abs(g))
+    return weight - lr * m2 / (u2 + 1e-8), (m2, u2)
+
+
+Adamax.fused_update = _adamax_fused
+
+
+def _nadam_create_fused_state(self, index, weight):
+    """(m, v, m_schedule): the eager path keeps m_schedule as a shared
+    Python float mutated once per update() CALL (an MXNet quirk: N params
+    advance it N times per step); the traced path cannot mutate Python
+    state, so it carries a PER-PARAMETER m_schedule — the textbook Nadam
+    schedule — as a scalar in the state tuple."""
+    dt = str(weight.dtype)
+    return (zeros(weight.shape, dtype=dt), zeros(weight.shape, dtype=dt),
+            ones((), dtype=dt))
+
+
+def _nadam_fused(self, name, weight, grad, state, lr, t=None):
+    lr, wd = _mults(self, name, lr)
+    t = _t_or_eager(self, t)
+    g = grad * self.rescale_grad + wd * weight
+    if self.clip_gradient:
+        g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+    m, v, m_sched = state
+    momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+    momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+    m_sched2 = m_sched * momentum_t
+    m_sched_next = m_sched2 * momentum_t_1
+    g_prime = g / (1.0 - m_sched2)
+    m2 = self.beta1 * m + (1.0 - self.beta1) * g
+    v2 = self.beta2 * v + (1.0 - self.beta2) * jnp.square(g)
+    m_prime = m2 / (1.0 - m_sched_next)
+    v_prime = v2 / _one_minus_pow(self.beta2, t)
+    m_bar = (1.0 - momentum_t) * g_prime + momentum_t_1 * m_prime
+    w2 = weight - lr * m_bar / (jnp.sqrt(v_prime) + self.epsilon)
+    return w2, (m2, v2, m_sched2)
+
+
+Nadam.create_fused_state = _nadam_create_fused_state
+Nadam.fused_update = _nadam_fused
+
+
+def _adamw_fused(self, name, weight, grad, state, lr, t=None):
+    from .ops import optimizer as _oo
+
+    lr, wd = _mults(self, name, lr)
+    mean, var = state
+    w, m2, v2 = _oo.adamw_update(
+        weight, grad, mean, var, lr=lr, beta1=self.beta1, beta2=self.beta2,
+        epsilon=self.epsilon, wd=wd, eta=self.eta,
+        rescale_grad=self.rescale_grad,
+        clip_gradient=self.clip_gradient if self.clip_gradient else -1.0)
+    return w, (m2, v2)
+
+
+AdamW.fused_update = _adamw_fused
+
+
+def _sgld_fused(self, name, weight, grad, state, lr, t=None):
+    """SGLD inside the trace: the Langevin noise key is derived
+    deterministically from (seed attr, step t, param name) via fold_in —
+    the eager path draws from the global RNG stream instead."""
+    import binascii
+
+    import jax
+
+    lr, wd = _mults(self, name, lr)
+    g = grad * self.rescale_grad
+    if self.clip_gradient:
+        g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+    t = _t_or_eager(self, t)
+    key = jax.random.PRNGKey(getattr(self, "fused_seed", 0))
+    key = jax.random.fold_in(key, jnp.asarray(t, jnp.int32))
+    key = jax.random.fold_in(key, binascii.crc32(name.encode()) & 0x7FFFFFFF)
+    noise = jax.random.normal(key, weight.shape, weight.dtype) * jnp.sqrt(lr)
+    return weight - lr / 2 * (g + wd * weight) + noise, None
+
+
+SGLD.fused_update = _sgld_fused
+
+
+def _test_fused(self, name, weight, grad, state, lr, t=None):
+    return weight - self.rescale_grad * grad, state
+
+
+Test.fused_update = _test_fused
+
+
+def _generic_fused(self, name, weight, grad, state, lr, t=None):
+    """Base-class fallback for CUSTOM optimizers without a dedicated
+    fused_update: runs the eager update() on NDArray views inside the jit
+    trace, routing the traced per-step lr through self.lr for the duration
+    of the trace.
+
+    Caveat (documented in fused.GluonTrainStep): anything update() reads
+    from Python state — self._index_update_count (time-dependent bias
+    correction), host RNG draws — is baked in at TRACE time and frozen
+    thereafter. Time-dependent or stochastic custom optimizers should
+    implement fused_update; every built-in optimizer already has an exact
+    one."""
+
+    def _wrap(s):
+        if s is None:
+            return None
+        if isinstance(s, (tuple, list)):
+            return tuple(_wrap(e) for e in s)
+        return NDArray(s)
+
+    def _unwrap(s):
+        if s is None:
+            return None
+        if isinstance(s, (tuple, list)):
+            return tuple(_unwrap(e) for e in s)
+        return s._data
+
+    w, g, st = NDArray(weight), NDArray(grad), _wrap(state)
+    old_lr, old_sched = self.lr, self.lr_scheduler
+    self.lr, self.lr_scheduler = lr, None
+    try:
+        self.update(name, w, g, st)
+    finally:
+        self.lr, self.lr_scheduler = old_lr, old_sched
+    return w._data, _unwrap(st)
+
+
+Optimizer.fused_update = _generic_fused
