@@ -1,0 +1,40 @@
+/* Shared dtype-code table for the C runtimes.
+ *
+ * Codes are the single source of truth from the Python side
+ * (incubator_mxnet_tpu/deploy.py _DTYPE_CODES) and are baked into .mxp/.mxt
+ * artifacts; every native runtime (train.cc, predict.cc, imperative.cc)
+ * must agree on the byte widths below.
+ */
+#ifndef MXTPU_DTYPES_H_
+#define MXTPU_DTYPES_H_
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+static inline size_t mxtpu_dtype_size(int code) {
+  switch (code) {
+    case 0: return 4;   /* f32 */
+    case 1: return 8;   /* f64 */
+    case 2: return 4;   /* s32 */
+    case 3: return 8;   /* s64 */
+    case 4: return 1;   /* u8 */
+    case 5: return 1;   /* s8 */
+    case 6: return 2;   /* bf16 */
+    case 7: return 2;   /* f16 */
+    case 8: return 1;   /* bool */
+    case 9: return 4;   /* u32 */
+    case 10: return 8;  /* u64 */
+    case 11: return 2;  /* s16 */
+    case 12: return 2;  /* u16 */
+    default: return 0;
+  }
+}
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXTPU_DTYPES_H_ */

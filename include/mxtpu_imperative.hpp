@@ -1,0 +1,307 @@
+// C++ imperative runtime for incubator_mxnet_tpu — the cpp-package analog.
+//
+// Reference role: cpp-package/include/mxnet-cpp/ndarray.h + op.h base
+// machinery over MXImperativeInvokeEx (ref: src/c_api/c_api_ndarray.cc).
+// Here every call routes through libmxtpu_imperative.so, which hosts the
+// framework in an embedded CPython and executes ops on real XLA devices.
+//
+// Usage:
+//   #include "mxtpu_ops.hpp"       // generated op wrappers (pulls this in)
+//   mxtpu::init();
+//   auto x = mxtpu::NDArray::fromVector({2,2}, {1,2,3,4});
+//   auto y = mxtpu::ops::relu(x);
+//
+// Link: -lmxtpu_imperative -lpython3.12 (see tests/test_cpp_api.py for the
+// exact line used in CI).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+extern "C" {
+int MXTpuImpInit(void);
+const char* MXTpuImpError(void);
+size_t MXTpuImpDTypeSize(int dtype);
+int MXTpuImpNDCreate(int dtype, int ndim, const int64_t* dims,
+                     const void* data, void** out);
+int MXTpuImpNDShape(void* h, int64_t* dims, int max_ndim, int* ndim);
+int MXTpuImpNDDType(void* h, int* dtype);
+int MXTpuImpNDCopyTo(void* h, void* out, size_t nbytes);
+int MXTpuImpNDFree(void* h);
+int MXTpuImpNDRef(void* h);
+int MXTpuImpInvoke(const char* op_name, void** inputs, int n_in,
+                   const char* attrs_json, void** outputs, int max_out,
+                   int* n_out);
+int MXTpuImpAttachGrad(void* h);
+int MXTpuImpGrad(void* h, void** grad_out);
+int MXTpuImpRecordBegin(int train_mode);
+int MXTpuImpRecordEnd(void);
+int MXTpuImpBackward(void* loss);
+}
+
+namespace mxtpu {
+
+enum class DType : int {
+  kFloat32 = 0, kFloat64 = 1, kInt32 = 2, kInt64 = 3, kUint8 = 4,
+  kInt8 = 5, kBfloat16 = 6, kFloat16 = 7, kBool = 8,
+};
+
+inline void check(int rc, const char* what) {
+  if (rc != 0) {
+    throw std::runtime_error(std::string(what) + ": " + MXTpuImpError());
+  }
+}
+
+inline void init() { check(MXTpuImpInit(), "mxtpu::init"); }
+
+// ---------------------------------------------------------------------------
+// Attr: JSON-able variant for op attributes. Default-constructed = "unset"
+// (serialized as null; the Python side then applies the op's default).
+// ---------------------------------------------------------------------------
+class Attr {
+ public:
+  Attr() : kind_(Kind::kNull) {}
+  Attr(bool v) : kind_(Kind::kBool), b_(v) {}                     // NOLINT
+  Attr(int v) : kind_(Kind::kInt), i_(v) {}                      // NOLINT
+  Attr(int64_t v) : kind_(Kind::kInt), i_(v) {}                  // NOLINT
+  Attr(double v) : kind_(Kind::kDouble), d_(v) {}                // NOLINT
+  Attr(const char* v) : kind_(Kind::kStr), s_(v) {}              // NOLINT
+  Attr(const std::string& v) : kind_(Kind::kStr), s_(v) {}       // NOLINT
+  Attr(std::initializer_list<int64_t> v)                         // NOLINT
+      : kind_(Kind::kIntVec), iv_(v) {}
+  Attr(const std::vector<int64_t>& v) : kind_(Kind::kIntVec), iv_(v) {}  // NOLINT
+  Attr(const std::vector<double>& v) : kind_(Kind::kDblVec), dv_(v) {}   // NOLINT
+
+  bool is_set() const { return kind_ != Kind::kNull; }
+
+  void to_json(std::ostringstream& o) const {
+    switch (kind_) {
+      case Kind::kNull: o << "null"; break;
+      case Kind::kBool: o << (b_ ? "true" : "false"); break;
+      case Kind::kInt: o << i_; break;
+      case Kind::kDouble: emit_double(o, d_); break;
+      case Kind::kStr: {
+        o << '"';
+        for (char c : s_) {
+          emit_char(o, c);
+        }
+        o << '"';
+        break;
+      }
+      case Kind::kIntVec: {
+        o << '[';
+        for (size_t i = 0; i < iv_.size(); ++i)
+          o << (i ? "," : "") << iv_[i];
+        o << ']';
+        break;
+      }
+      case Kind::kDblVec: {
+        o << '[';
+        for (size_t i = 0; i < dv_.size(); ++i) {
+          if (i) o << ',';
+          emit_double(o, dv_[i]);
+        }
+        o << ']';
+        break;
+      }
+    }
+  }
+
+ private:
+  // Python's json.loads accepts the Infinity/NaN literals; finite values
+  // round-trip at full double precision (default ostream precision is 6
+  // significant digits — silent attr corruption otherwise).
+  static void emit_double(std::ostringstream& o, double v) {
+    if (v != v) { o << "NaN"; return; }
+    if (v > 1.7976931348623157e308) { o << "Infinity"; return; }
+    if (v < -1.7976931348623157e308) { o << "-Infinity"; return; }
+    auto p = o.precision(17);
+    o << v;
+    o.precision(p);
+  }
+  static void emit_char(std::ostringstream& o, char c) {
+    unsigned char u = static_cast<unsigned char>(c);
+    if (c == '"' || c == '\\') { o << '\\' << c; }
+    else if (u < 0x20) {
+      const char* hex = "0123456789abcdef";
+      o << "\\u00" << hex[(u >> 4) & 0xF] << hex[u & 0xF];
+    } else {
+      o << c;
+    }
+  }
+
+  enum class Kind { kNull, kBool, kInt, kDouble, kStr, kIntVec, kDblVec };
+  Kind kind_;
+  bool b_ = false;
+  int64_t i_ = 0;
+  double d_ = 0.0;
+  std::string s_;
+  std::vector<int64_t> iv_;
+  std::vector<double> dv_;
+};
+
+namespace detail {
+
+class AttrWriter {
+ public:
+  void add(const char* name, const Attr& a) {
+    if (!a.is_set()) return;
+    o_ << (any_ ? "," : "{") << '"' << name << "\":";
+    a.to_json(o_);
+    any_ = true;
+  }
+  std::string str() const { return any_ ? o_.str() + "}" : std::string(); }
+
+ private:
+  std::ostringstream o_;
+  bool any_ = false;
+};
+
+}  // namespace detail
+
+// ---------------------------------------------------------------------------
+// NDArray: RAII handle to a framework NDArray living on an XLA device.
+// Copies share the underlying object (refcounted); this mirrors Python
+// semantics where assignment aliases.
+// ---------------------------------------------------------------------------
+class NDArray {
+ public:
+  NDArray() = default;
+  explicit NDArray(void* h) : h_(h) {}
+  NDArray(const NDArray& o) : h_(o.h_) { MXTpuImpNDRef(h_); }
+  NDArray& operator=(const NDArray& o) {
+    if (this != &o) {
+      MXTpuImpNDFree(h_);
+      h_ = o.h_;
+      MXTpuImpNDRef(h_);
+    }
+    return *this;
+  }
+  NDArray(NDArray&& o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  NDArray& operator=(NDArray&& o) noexcept {
+    if (this != &o) {
+      MXTpuImpNDFree(h_);
+      h_ = o.h_;
+      o.h_ = nullptr;
+    }
+    return *this;
+  }
+  ~NDArray() { MXTpuImpNDFree(h_); }
+
+  bool is_null() const { return h_ == nullptr; }
+  void* handle() const { return h_; }
+
+  static NDArray zeros(const std::vector<int64_t>& shape,
+                       DType dtype = DType::kFloat32) {
+    void* h = nullptr;
+    check(MXTpuImpNDCreate(static_cast<int>(dtype),
+                           static_cast<int>(shape.size()), shape.data(),
+                           nullptr, &h),
+          "NDArray::zeros");
+    return NDArray(h);
+  }
+
+  template <typename T>
+  static NDArray fromVector(const std::vector<int64_t>& shape,
+                            const std::vector<T>& data,
+                            DType dtype = DType::kFloat32) {
+    size_t n = 1;
+    for (auto s : shape) n *= static_cast<size_t>(s);
+    if (n != data.size())
+      throw std::runtime_error("fromVector: shape/data size mismatch");
+    if (sizeof(T) != MXTpuImpDTypeSize(static_cast<int>(dtype)))
+      throw std::runtime_error("fromVector: element size mismatch");
+    void* h = nullptr;
+    check(MXTpuImpNDCreate(static_cast<int>(dtype),
+                           static_cast<int>(shape.size()), shape.data(),
+                           data.data(), &h),
+          "NDArray::fromVector");
+    return NDArray(h);
+  }
+
+  std::vector<int64_t> shape() const {
+    int64_t dims[8];
+    int nd = 0;
+    check(MXTpuImpNDShape(h_, dims, 8, &nd), "NDArray::shape");
+    return std::vector<int64_t>(dims, dims + nd);
+  }
+
+  int64_t size() const {
+    int64_t n = 1;
+    for (auto s : shape()) n *= s;
+    return n;
+  }
+
+  DType dtype() const {
+    int dt = 0;
+    check(MXTpuImpNDDType(h_, &dt), "NDArray::dtype");
+    return static_cast<DType>(dt);
+  }
+
+  template <typename T>
+  std::vector<T> toVector() const {
+    std::vector<T> out(static_cast<size_t>(size()));
+    check(MXTpuImpNDCopyTo(h_, out.data(), out.size() * sizeof(T)),
+          "NDArray::toVector");
+    return out;
+  }
+
+  float scalar() const {
+    auto v = toVector<float>();
+    if (v.empty()) throw std::runtime_error("scalar(): empty array");
+    return v[0];
+  }
+
+  // autograd
+  void attachGrad() { check(MXTpuImpAttachGrad(h_), "attachGrad"); }
+  void backward() { check(MXTpuImpBackward(h_), "backward"); }
+  NDArray grad() const {
+    void* g = nullptr;
+    check(MXTpuImpGrad(h_, &g), "grad");
+    return NDArray(g);
+  }
+
+ private:
+  void* h_ = nullptr;
+};
+
+// RAII autograd recording scope (the `with autograd.record():` analog).
+struct AutogradRecord {
+  explicit AutogradRecord(bool train_mode = true) {
+    check(MXTpuImpRecordBegin(train_mode ? 1 : 0), "record");
+  }
+  ~AutogradRecord() { MXTpuImpRecordEnd(); }
+  AutogradRecord(const AutogradRecord&) = delete;
+  AutogradRecord& operator=(const AutogradRecord&) = delete;
+};
+
+namespace detail {
+
+inline std::vector<NDArray> invoke(const char* name, void** ins, int n_in,
+                                   const std::string& attrs) {
+  void* outs[8] = {nullptr};
+  int n_out = 0;
+  check(MXTpuImpInvoke(name, ins, n_in, attrs.empty() ? nullptr : attrs.c_str(),
+                       outs, 8, &n_out),
+        name);
+  std::vector<NDArray> r;
+  r.reserve(static_cast<size_t>(n_out));
+  for (int i = 0; i < n_out; ++i) r.emplace_back(outs[i]);
+  return r;
+}
+
+inline NDArray invoke1(const char* name, void** ins, int n_in,
+                       const std::string& attrs) {
+  auto r = invoke(name, ins, n_in, attrs);
+  if (r.size() != 1)
+    throw std::runtime_error(std::string(name) + ": expected 1 output, got " +
+                             std::to_string(r.size()));
+  return std::move(r[0]);
+}
+
+}  // namespace detail
+}  // namespace mxtpu

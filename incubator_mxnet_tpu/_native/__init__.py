@@ -212,6 +212,44 @@ def train_lib():
     return lib
 
 
+def imperative_lib():
+    """Embedded-interpreter imperative op runtime (src/imperative.cc; the
+    MXImperativeInvokeEx role — see include/mxtpu_imperative.hpp and the
+    generated include/mxtpu_ops.hpp for the C++ user surface)."""
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("LDVERSION") or "3.12"
+    lib = load("mxtpu_imperative", ["imperative.cc"],
+               extra=[f"-I{inc}", f"-L{libdir}", f"-lpython{ver}",
+                      f"-Wl,-rpath,{libdir}"])
+    if lib is not None and not getattr(lib, "_imp_configured", False):
+        lib.MXTpuImpInit.restype = ctypes.c_int
+        lib.MXTpuImpError.restype = ctypes.c_char_p
+        lib.MXTpuImpNDCreate.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p)]
+        lib.MXTpuImpNDShape.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int)]
+        lib.MXTpuImpNDDType.argtypes = [ctypes.c_void_p,
+                                        ctypes.POINTER(ctypes.c_int)]
+        lib.MXTpuImpNDCopyTo.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                         ctypes.c_size_t]
+        lib.MXTpuImpNDFree.argtypes = [ctypes.c_void_p]
+        lib.MXTpuImpNDRef.argtypes = [ctypes.c_void_p]
+        lib.MXTpuImpInvoke.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int)]
+        lib.MXTpuImpAttachGrad.argtypes = [ctypes.c_void_p]
+        lib.MXTpuImpGrad.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_void_p)]
+        lib.MXTpuImpRecordBegin.argtypes = [ctypes.c_int]
+        lib.MXTpuImpBackward.argtypes = [ctypes.c_void_p]
+        lib._imp_configured = True
+    return lib
+
+
 def imgpipe_lib():
     """Native JPEG decode+augment batch pipeline (src/imgpipe.cc; ref:
     iter_image_recordio_2.cc's preprocess-thread parser)."""

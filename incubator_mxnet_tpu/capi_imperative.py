@@ -1,0 +1,109 @@
+"""Python half of the imperative C/C++ embedding API.
+
+The reference exposes op-level imperative execution to non-Python frontends
+through `MXImperativeInvokeEx` (ref: src/c_api/c_api_ndarray.cc:54 — the
+entry point cpp-package's generated `op.h` wrappers call). The TPU-native
+analog keeps the op registry, autograd tape, and XLA dispatch in-process by
+EMBEDDING the interpreter: `src/imperative.cc` (libmxtpu_imperative.so)
+hosts CPython, imports this module once, and funnels every C call through
+the small, C-friendly functions below (plain handles in, plain handles
+out). C++ users get the real framework — all registered ops, the real
+autograd tape, real XLA CPU/TPU execution — not a host-side re-implementation.
+
+Everything here works on NDArray objects; the C side holds them as opaque
+PyObject* handles with ownership managed by Py_INCREF/DECREF.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from . import autograd
+from .deploy import _DTYPE_CODES
+from .ndarray.ndarray import NDArray
+from .ndarray.register import invoke_by_name
+from .ops.registry import OP_REGISTRY
+
+_CODE_TO_DTYPE = {v: k for k, v in _DTYPE_CODES.items()}
+
+
+def nd_from_buffer(dtype_code, shape, data):
+    """data: bytes (C-order) or None for zeros."""
+    dt = np.dtype(_CODE_TO_DTYPE[int(dtype_code)])
+    shape = tuple(int(s) for s in shape)
+    if data is None:
+        arr = np.zeros(shape, dt)
+    else:
+        arr = np.frombuffer(data, dtype=dt).reshape(shape).copy()
+    return NDArray(arr)
+
+
+def nd_to_bytes(nd):
+    return np.ascontiguousarray(nd.asnumpy()).tobytes()
+
+
+def nd_shape(nd):
+    return tuple(int(s) for s in nd.shape)
+
+
+def nd_dtype_code(nd):
+    return _DTYPE_CODES[str(np.dtype(nd._data.dtype))]
+
+
+def invoke(name, inputs, attrs_json):
+    """Run one registered op; returns a LIST of NDArray outputs.
+
+    attrs_json: JSON object string; null values are dropped (= use the
+    op's default), arrays become tuples (shape-like attrs)."""
+    if name not in OP_REGISTRY:
+        raise KeyError(f"unknown op '{name}' (see ops.list_ops())")
+    kwargs = {}
+    if attrs_json:
+        for k, v in json.loads(attrs_json).items():
+            if v is None:
+                continue
+            if isinstance(v, list):
+                v = tuple(v)
+            kwargs[k] = v
+    out = invoke_by_name(name, list(inputs), kwargs)
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+def attach_grad(nd):
+    nd.attach_grad()
+
+
+def grad_of(nd):
+    g = nd.grad
+    if g is None:
+        raise ValueError("no gradient recorded (attach_grad + record first)")
+    return g
+
+
+# LIFO of (prev_recording, prev_training) so C++ AutogradRecord scopes nest
+# and restore enclosing state exactly like autograd.record()'s context
+# manager (clobbering to False would silently un-tape an outer scope).
+# NOTE: autograd state is thread-local — begin/invoke/backward must run on
+# the same OS thread (documented on the C ABI).
+_REC_STACK = []
+
+
+def record_begin(train_mode):
+    prev_rec = autograd.set_recording(True)
+    prev_train = autograd.set_training(bool(train_mode))
+    _REC_STACK.append((prev_rec, prev_train))
+
+
+def record_end():
+    prev_rec, prev_train = _REC_STACK.pop() if _REC_STACK else (False, False)
+    autograd.set_recording(prev_rec)
+    autograd.set_training(prev_train)
+
+
+def backward(loss):
+    loss.backward()
+
+
+def op_list():
+    return "\n".join(sorted(OP_REGISTRY))

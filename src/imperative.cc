@@ -1,0 +1,275 @@
+// Imperative op-level C ABI via an embedded CPython interpreter.
+//
+// Reference role: src/c_api/c_api_ndarray.cc MXImperativeInvokeEx — the op
+// dispatch entry every non-Python frontend (cpp-package, JVM, Perl) builds
+// on.  The TPU-native framework's op registry, autograd tape, and XLA
+// dispatch live in Python, so instead of re-implementing them, this runtime
+// hosts CPython in-process and routes each C call through
+// incubator_mxnet_tpu.capi_imperative.  The C++ caller gets REAL framework
+// semantics: all registered ops, the real tape, real XLA CPU/TPU execution.
+//
+// Threading: every entry takes the GIL via PyGILState_Ensure, so calls are
+// memory-safe from any thread once MXTpuImpInit returned — but the autograd
+// recording state is PYTHON-THREAD-LOCAL: a RecordBegin/Invoke/Backward
+// sequence must run on ONE OS thread (a different thread gets its own
+// Python thread state and records nothing). Op invocation without autograd
+// is thread-agnostic.
+//
+// Handles are PyObject* (NDArray instances) owned by the caller; free with
+// MXTpuImpNDFree.  All functions return 0 on success; on failure call
+// MXTpuImpError() for the message (thread-local).
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+#include "../include/mxtpu_dtypes.h"
+
+namespace {
+
+thread_local std::string g_err;
+PyObject* g_mod = nullptr;  // capi_imperative module (owned)
+
+int fail(const char* where) {
+  std::string msg = where;
+  if (PyErr_Occurred()) {
+    PyObject *type = nullptr, *val = nullptr, *tb = nullptr;
+    PyErr_Fetch(&type, &val, &tb);
+    PyErr_NormalizeException(&type, &val, &tb);
+    if (val) {
+      PyObject* s = PyObject_Str(val);
+      if (s) {
+        const char* u = PyUnicode_AsUTF8(s);  // NULL on non-UTF-8 messages
+        if (u) {
+          msg += ": ";
+          msg += u;
+        } else {
+          PyErr_Clear();
+        }
+        Py_DECREF(s);
+      }
+    }
+    Py_XDECREF(type);
+    Py_XDECREF(val);
+    Py_XDECREF(tb);
+  }
+  g_err = msg;
+  return 1;
+}
+
+// Call a module-level function with a pre-built args tuple (steals nothing).
+PyObject* call(const char* fn, PyObject* args) {
+  PyObject* f = PyObject_GetAttrString(g_mod, fn);
+  if (!f) return nullptr;
+  PyObject* r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  return r;
+}
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() : st(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st); }
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* MXTpuImpError(void) { return g_err.c_str(); }
+
+// Initialize the embedded interpreter (no-op if the process already runs
+// Python, e.g. when loaded from a Python test) and import the shim module.
+int MXTpuImpInit(void) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);  // no signal handlers: we are a guest runtime
+    // hand the GIL back so Gil{} below can take it from any thread
+    PyEval_SaveThread();
+  }
+  Gil gil;
+  if (g_mod) return 0;
+  PyObject* m = PyImport_ImportModule("incubator_mxnet_tpu.capi_imperative");
+  if (!m) return fail("import incubator_mxnet_tpu.capi_imperative failed");
+  g_mod = m;
+  return 0;
+}
+
+size_t MXTpuImpDTypeSize(int dtype) { return mxtpu_dtype_size(dtype); }
+
+int MXTpuImpNDCreate(int dtype, int ndim, const int64_t* dims,
+                     const void* data, void** out) {
+  Gil gil;
+  PyObject* shape = PyTuple_New(ndim);
+  size_t n = 1;
+  for (int i = 0; i < ndim; ++i) {
+    n *= static_cast<size_t>(dims[i]);
+    PyTuple_SET_ITEM(shape, i, PyLong_FromLongLong(dims[i]));
+  }
+  PyObject* buf;
+  if (data) {
+    buf = PyBytes_FromStringAndSize(
+        static_cast<const char*>(data),
+        static_cast<Py_ssize_t>(n * MXTpuImpDTypeSize(dtype)));
+  } else {
+    buf = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject* args = Py_BuildValue("(iNN)", dtype, shape, buf);
+  PyObject* r = call("nd_from_buffer", args);
+  Py_DECREF(args);
+  if (!r) return fail("nd_from_buffer");
+  *out = r;  // ownership to caller
+  return 0;
+}
+
+int MXTpuImpNDShape(void* h, int64_t* dims, int max_ndim, int* ndim) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(h));
+  PyObject* r = call("nd_shape", args);
+  Py_DECREF(args);
+  if (!r) return fail("nd_shape");
+  Py_ssize_t nd = PyTuple_Size(r);
+  *ndim = static_cast<int>(nd);
+  if (nd > max_ndim) {
+    Py_DECREF(r);
+    g_err = "shape buffer too small";
+    return 1;
+  }
+  for (Py_ssize_t i = 0; i < nd; ++i)
+    dims[i] = PyLong_AsLongLong(PyTuple_GET_ITEM(r, i));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuImpNDDType(void* h, int* dtype) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(h));
+  PyObject* r = call("nd_dtype_code", args);
+  Py_DECREF(args);
+  if (!r) return fail("nd_dtype_code");
+  *dtype = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuImpNDCopyTo(void* h, void* out, size_t nbytes) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(h));
+  PyObject* r = call("nd_to_bytes", args);
+  Py_DECREF(args);
+  if (!r) return fail("nd_to_bytes");
+  char* p = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &p, &len) != 0 ||
+      static_cast<size_t>(len) != nbytes) {
+    Py_DECREF(r);
+    g_err = "size mismatch in NDCopyTo (" + std::to_string(len) +
+            " vs " + std::to_string(nbytes) + ")";
+    return 1;
+  }
+  std::memcpy(out, p, nbytes);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuImpNDFree(void* h) {
+  if (!h) return 0;
+  Gil gil;
+  Py_DECREF(static_cast<PyObject*>(h));
+  return 0;
+}
+
+// Share a handle (refcount bump) so C++ NDArray copies are cheap and safe.
+int MXTpuImpNDRef(void* h) {
+  if (!h) return 0;
+  Gil gil;
+  Py_INCREF(static_cast<PyObject*>(h));
+  return 0;
+}
+
+// Invoke a registered op.  inputs: n_in handles.  attrs_json: JSON object
+// (or NULL).  On success fills outputs[0..*n_out) with new handles.
+int MXTpuImpInvoke(const char* op_name, void** inputs, int n_in,
+                   const char* attrs_json, void** outputs, int max_out,
+                   int* n_out) {
+  Gil gil;
+  PyObject* ins = PyList_New(n_in);
+  for (int i = 0; i < n_in; ++i) {
+    // null handle = optional input not supplied (e.g. bias w/ no_bias)
+    PyObject* o = inputs[i] ? static_cast<PyObject*>(inputs[i]) : Py_None;
+    Py_INCREF(o);
+    PyList_SET_ITEM(ins, i, o);
+  }
+  PyObject* args = Py_BuildValue("(sNs)", op_name, ins,
+                                 attrs_json ? attrs_json : "");
+  PyObject* r = call("invoke", args);
+  Py_DECREF(args);
+  if (!r) return fail(op_name);
+  Py_ssize_t n = PyList_Size(r);
+  if (n > max_out) {
+    Py_DECREF(r);
+    g_err = "output buffer too small";
+    return 1;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GET_ITEM(r, i);
+    Py_INCREF(o);
+    outputs[i] = o;
+  }
+  *n_out = static_cast<int>(n);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuImpAttachGrad(void* h) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(h));
+  PyObject* r = call("attach_grad", args);
+  Py_DECREF(args);
+  if (!r) return fail("attach_grad");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuImpGrad(void* h, void** grad_out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(h));
+  PyObject* r = call("grad_of", args);
+  Py_DECREF(args);
+  if (!r) return fail("grad_of");
+  *grad_out = r;
+  return 0;
+}
+
+int MXTpuImpRecordBegin(int train_mode) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", train_mode);
+  PyObject* r = call("record_begin", args);
+  Py_DECREF(args);
+  if (!r) return fail("record_begin");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuImpRecordEnd(void) {
+  Gil gil;
+  PyObject* args = PyTuple_New(0);
+  PyObject* r = call("record_end", args);
+  Py_DECREF(args);
+  if (!r) return fail("record_end");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuImpBackward(void* loss) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(loss));
+  PyObject* r = call("backward", args);
+  Py_DECREF(args);
+  if (!r) return fail("backward");
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // extern "C"

@@ -36,6 +36,7 @@
 
 #include "tensorflow/compiler/xla/pjrt/c/pjrt_c_api.h"
 #include "../include/mxtpu.h"
+#include "../include/mxtpu_dtypes.h"
 
 namespace {
 
@@ -43,24 +44,7 @@ thread_local std::string g_last_error;
 
 void set_error(const std::string& msg) { g_last_error = msg; }
 
-size_t dtype_size(int code) {
-  switch (code) {
-    case 0: return 4;   // f32
-    case 1: return 8;   // f64
-    case 2: return 4;   // s32
-    case 3: return 8;   // s64
-    case 4: return 1;   // u8
-    case 5: return 1;   // s8
-    case 6: return 2;   // bf16
-    case 7: return 2;   // f16
-    case 8: return 1;   // bool
-    case 9: return 4;   // u32
-    case 10: return 8;  // u64
-    case 11: return 2;  // s16
-    case 12: return 2;  // u16
-    default: return 0;
-  }
-}
+size_t dtype_size(int code) { return mxtpu_dtype_size(code); }
 
 PJRT_Buffer_Type dtype_to_pjrt(uint8_t code) {
   switch (code) {
