@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Build the JVM binding: javac sources -> target/classes, JNI native lib,
+# and target/mxtpu.jar. Needs JAVA_HOME (a JDK with jni.h) and the repo's
+# native libs (built lazily by the Python test suite or:
+#   python -c "from incubator_mxnet_tpu._native import imperative_lib, train_lib; imperative_lib(); train_lib()").
+set -euo pipefail
+cd "$(dirname "$0")"
+REPO="$(cd .. && pwd)"
+
+: "${JAVA_HOME:?set JAVA_HOME to a JDK root (needs include/jni.h)}"
+
+mkdir -p target/classes
+find src/main/java -name '*.java' > target/sources.txt
+"$JAVA_HOME/bin/javac" -d target/classes @target/sources.txt
+
+NATIVE="$REPO/incubator_mxnet_tpu/_native"
+PYLIB="$(python3 -c 'import sysconfig; print(sysconfig.get_config_var("LIBDIR"))')"
+PYVER="$(python3 -c 'import sysconfig; print(sysconfig.get_config_var("LDVERSION") or "3.12")')"
+
+g++ -O2 -std=c++17 -shared -fPIC \
+    -I"$JAVA_HOME/include" -I"$JAVA_HOME/include/linux" \
+    src/main/native/mxtpu_jni.cc \
+    -L"$NATIVE" -lmxtpu_imperative -lmxtpu_train \
+    -L"$PYLIB" "-lpython$PYVER" \
+    -Wl,-rpath,"$NATIVE" -Wl,-rpath,"$PYLIB" \
+    -o target/libmxtpu_jni.so
+
+"$JAVA_HOME/bin/jar" cf target/mxtpu.jar -C target/classes .
+echo "built target/mxtpu.jar + target/libmxtpu_jni.so"
+echo "run: java -cp target/mxtpu.jar -Djava.library.path=target \\"
+echo "     org.apache.mxtpu.examples.TrainMlp   (with PYTHONPATH=$REPO)"

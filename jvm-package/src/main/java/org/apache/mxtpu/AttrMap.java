@@ -1,0 +1,96 @@
+package org.apache.mxtpu;
+
+import java.util.LinkedHashMap;
+import java.util.Map;
+
+/**
+ * Op attribute builder serialized to the JSON the runtime expects
+ * (reference role: the string attr maps of scala-package's generated ops).
+ */
+public final class AttrMap {
+  private final Map<String, Object> attrs = new LinkedHashMap<>();
+
+  public static AttrMap of() {
+    return new AttrMap();
+  }
+
+  public AttrMap set(String key, long v) {
+    attrs.put(key, v);
+    return this;
+  }
+
+  public AttrMap set(String key, double v) {
+    attrs.put(key, v);
+    return this;
+  }
+
+  public AttrMap set(String key, boolean v) {
+    attrs.put(key, v);
+    return this;
+  }
+
+  public AttrMap set(String key, String v) {
+    attrs.put(key, v);
+    return this;
+  }
+
+  public AttrMap set(String key, long[] v) {
+    attrs.put(key, v);
+    return this;
+  }
+
+  public boolean isEmpty() {
+    return attrs.isEmpty();
+  }
+
+  String toJson() {
+    if (attrs.isEmpty()) {
+      return null;
+    }
+    StringBuilder b = new StringBuilder("{");
+    boolean first = true;
+    for (Map.Entry<String, Object> e : attrs.entrySet()) {
+      if (!first) {
+        b.append(',');
+      }
+      first = false;
+      b.append('"').append(e.getKey()).append("\":");
+      Object v = e.getValue();
+      if (v instanceof String) {
+        b.append('"');
+        for (char c : ((String) v).toCharArray()) {
+          if (c == '"' || c == '\\') {
+            b.append('\\').append(c);
+          } else if (c < 0x20) {
+            b.append(String.format("\\u%04x", (int) c));
+          } else {
+            b.append(c);
+          }
+        }
+        b.append('"');
+      } else if (v instanceof long[]) {
+        b.append('[');
+        long[] a = (long[]) v;
+        for (int i = 0; i < a.length; i++) {
+          if (i > 0) {
+            b.append(',');
+          }
+          b.append(a[i]);
+        }
+        b.append(']');
+      } else if (v instanceof Double) {
+        double d = (Double) v;
+        if (Double.isNaN(d)) {
+          b.append("NaN");
+        } else if (Double.isInfinite(d)) {
+          b.append(d > 0 ? "Infinity" : "-Infinity");
+        } else {
+          b.append(d);
+        }
+      } else {
+        b.append(v);
+      }
+    }
+    return b.append('}').toString();
+  }
+}

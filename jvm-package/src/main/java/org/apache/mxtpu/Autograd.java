@@ -1,0 +1,28 @@
+package org.apache.mxtpu;
+
+/**
+ * Autograd recording scope (reference role: org.apache.mxnet.autograd).
+ *
+ * Scopes nest and restore the enclosing recording state on close. The
+ * begin/op/backward sequence must stay on one thread (the tape is
+ * thread-local in the runtime).
+ */
+public final class Autograd implements AutoCloseable {
+  private Autograd() {}
+
+  public static Autograd record() {
+    return record(true);
+  }
+
+  public static Autograd record(boolean trainMode) {
+    if (LibMXTpu.recordBegin(trainMode ? 1 : 0) != 0) {
+      throw new MXTpuException(LibMXTpu.lastError());
+    }
+    return new Autograd();
+  }
+
+  @Override
+  public void close() {
+    LibMXTpu.recordEnd();
+  }
+}

@@ -1,0 +1,63 @@
+package org.apache.mxtpu;
+
+/**
+ * JNI surface, 1:1 with the native C ABIs (reference role:
+ * scala-package's org.apache.mxnet.LibInfo over c_api.h).
+ *
+ * Handles are opaque pointers (jlong). Imperative entries route through
+ * libmxtpu_imperative.so (embedded-interpreter op runtime,
+ * include/mxtpu_imperative.hpp); trainer entries through libmxtpu_train.so
+ * (.mxt AOT artifacts, include/mxtpu.h).
+ */
+final class LibMXTpu {
+  static {
+    System.loadLibrary("mxtpu_jni");
+  }
+
+  private LibMXTpu() {}
+
+  // --- runtime ---------------------------------------------------------
+  static native int init();
+
+  static native String lastError();
+
+  // --- NDArray ---------------------------------------------------------
+  static native long ndCreate(int dtype, long[] dims, byte[] dataOrNull);
+
+  static native long[] ndShape(long handle);
+
+  static native int ndDType(long handle);
+
+  static native int ndCopyTo(long handle, byte[] out);
+
+  static native int ndFree(long handle);
+
+  static native int ndRef(long handle);
+
+  // --- op invocation ---------------------------------------------------
+  static native long[] invoke(String opName, long[] inputs, String attrsJson);
+
+  // --- autograd --------------------------------------------------------
+  static native int attachGrad(long handle);
+
+  static native long grad(long handle);
+
+  static native int recordBegin(int trainMode);
+
+  static native int recordEnd();
+
+  static native int backward(long lossHandle);
+
+  // --- .mxt trainer ----------------------------------------------------
+  static native long trainerCreate(String mxtPath, String pluginPathOrNull);
+
+  static native int trainerSetInput(long handle, String name, byte[] data);
+
+  static native float trainerStep(long handle);
+
+  static native int trainerGetState(long handle, String name, byte[] out);
+
+  static native int trainerSetState(long handle, String name, byte[] data);
+
+  static native int trainerFree(long handle);
+}

@@ -1,0 +1,44 @@
+package org.apache.mxtpu;
+
+/** Runtime entry (reference role: org.apache.mxnet.Base init). */
+public final class MXTpu {
+  private static boolean initialized = false;
+
+  private MXTpu() {}
+
+  /** Initialize the embedded runtime; idempotent. */
+  public static synchronized void init() {
+    if (!initialized) {
+      if (LibMXTpu.init() != 0) {
+        throw new MXTpuException("init failed: " + LibMXTpu.lastError());
+      }
+      initialized = true;
+    }
+  }
+
+  /** Generic op invocation; prefer the typed wrappers in {@link Ops}. */
+  public static NDArray[] invoke(String op, NDArray[] inputs, AttrMap attrs) {
+    long[] ins = new long[inputs.length];
+    for (int i = 0; i < inputs.length; i++) {
+      ins[i] = inputs[i] == null ? 0 : inputs[i].handle();
+    }
+    long[] outs = LibMXTpu.invoke(op, ins,
+        attrs == null ? null : attrs.toJson());
+    if (outs == null) {
+      throw new MXTpuException(op + ": " + LibMXTpu.lastError());
+    }
+    NDArray[] r = new NDArray[outs.length];
+    for (int i = 0; i < outs.length; i++) {
+      r[i] = new NDArray(outs[i]);
+    }
+    return r;
+  }
+
+  static NDArray invoke1(String op, NDArray[] inputs, AttrMap attrs) {
+    NDArray[] r = invoke(op, inputs, attrs);
+    if (r.length != 1) {
+      throw new MXTpuException(op + ": expected 1 output, got " + r.length);
+    }
+    return r[0];
+  }
+}

@@ -1,0 +1,8 @@
+package org.apache.mxtpu;
+
+/** Runtime error surfaced from the native ABI. */
+public class MXTpuException extends RuntimeException {
+  public MXTpuException(String message) {
+    super(message);
+  }
+}

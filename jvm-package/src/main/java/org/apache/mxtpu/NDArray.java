@@ -1,0 +1,157 @@
+package org.apache.mxtpu;
+
+import java.lang.ref.Cleaner;
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+
+/**
+ * Device array handle (reference role: org.apache.mxnet.NDArray).
+ *
+ * Data lives in the runtime (XLA CPU/TPU buffers); this class holds a
+ * refcounted handle and moves host data in/out as float[] for simplicity.
+ * Handles are reclaimed by a {@link Cleaner} when the NDArray is GC'd, but
+ * deterministic {@link #close()} (try-with-resources) is preferred in
+ * training loops — the GC does not feel device-memory pressure.
+ */
+public final class NDArray implements AutoCloseable {
+  public static final int FLOAT32 = 0;
+  public static final int INT32 = 2;
+
+  private static final Cleaner CLEANER = Cleaner.create();
+
+  private long handle;
+  private final Cleaner.Cleanable cleanable;
+
+  private static final class FreeAction implements Runnable {
+    private long h;
+
+    FreeAction(long h) {
+      this.h = h;
+    }
+
+    @Override
+    public void run() {
+      if (h != 0) {
+        LibMXTpu.ndFree(h);
+        h = 0;
+      }
+    }
+  }
+
+  private final FreeAction freeAction;
+
+  NDArray(long handle) {
+    if (handle == 0) {
+      throw new MXTpuException("null NDArray handle: " + LibMXTpu.lastError());
+    }
+    this.handle = handle;
+    this.freeAction = new FreeAction(handle);
+    this.cleanable = CLEANER.register(this, freeAction);
+  }
+
+  long handle() {
+    if (handle == 0) {
+      throw new MXTpuException("NDArray used after close()");
+    }
+    return handle;
+  }
+
+  public static NDArray zeros(long... shape) {
+    return new NDArray(LibMXTpu.ndCreate(FLOAT32, shape, null));
+  }
+
+  public static NDArray fromFloats(long[] shape, float[] data) {
+    long n = 1;
+    for (long s : shape) {
+      n *= s;
+    }
+    if (n != data.length) {
+      throw new MXTpuException("fromFloats: prod(shape)=" + n
+          + " != data.length=" + data.length);
+    }
+    ByteBuffer buf = ByteBuffer.allocate(data.length * 4)
+        .order(ByteOrder.LITTLE_ENDIAN);
+    buf.asFloatBuffer().put(data);
+    return new NDArray(LibMXTpu.ndCreate(FLOAT32, shape, buf.array()));
+  }
+
+  public long[] shape() {
+    long[] s = LibMXTpu.ndShape(handle());
+    if (s == null) {
+      throw new MXTpuException(LibMXTpu.lastError());
+    }
+    return s;
+  }
+
+  public long size() {
+    long n = 1;
+    for (long s : shape()) {
+      n *= s;
+    }
+    return n;
+  }
+
+  public int dtype() {
+    return LibMXTpu.ndDType(handle());
+  }
+
+  public float[] toFloats() {
+    int dt = dtype();
+    if (dt != FLOAT32) {
+      throw new MXTpuException("toFloats on dtype code " + dt
+          + " (float32 is 0); Cast first or use toInts");
+    }
+    byte[] out = new byte[(int) size() * 4];
+    if (LibMXTpu.ndCopyTo(handle(), out) != 0) {
+      throw new MXTpuException(LibMXTpu.lastError());
+    }
+    float[] f = new float[out.length / 4];
+    ByteBuffer.wrap(out).order(ByteOrder.LITTLE_ENDIAN).asFloatBuffer().get(f);
+    return f;
+  }
+
+  public int[] toInts() {
+    int dt = dtype();
+    if (dt != INT32) {
+      throw new MXTpuException("toInts on dtype code " + dt
+          + " (int32 is 2)");
+    }
+    byte[] out = new byte[(int) size() * 4];
+    if (LibMXTpu.ndCopyTo(handle(), out) != 0) {
+      throw new MXTpuException(LibMXTpu.lastError());
+    }
+    int[] v = new int[out.length / 4];
+    ByteBuffer.wrap(out).order(ByteOrder.LITTLE_ENDIAN).asIntBuffer().get(v);
+    return v;
+  }
+
+  public float scalar() {
+    return toFloats()[0];
+  }
+
+  // --- autograd --------------------------------------------------------
+  public void attachGrad() {
+    if (LibMXTpu.attachGrad(handle()) != 0) {
+      throw new MXTpuException(LibMXTpu.lastError());
+    }
+  }
+
+  public void backward() {
+    if (LibMXTpu.backward(handle()) != 0) {
+      throw new MXTpuException(LibMXTpu.lastError());
+    }
+  }
+
+  public NDArray grad() {
+    long g = LibMXTpu.grad(handle());
+    return new NDArray(g);
+  }
+
+  @Override
+  public void close() {
+    if (handle != 0) {
+      handle = 0;
+      cleanable.clean();  // runs FreeAction exactly once
+    }
+  }
+}

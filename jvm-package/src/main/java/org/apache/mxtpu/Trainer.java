@@ -1,0 +1,67 @@
+package org.apache.mxtpu;
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+
+/**
+ * Train an exported .mxt artifact from the JVM with no Python at runtime
+ * (reference role: scala-package's Module training loop; runtime:
+ * src/train.cc over the PJRT C API).
+ */
+public final class Trainer implements AutoCloseable {
+  private long handle;
+
+  public Trainer(String mxtPath, String pluginPathOrNull) {
+    handle = LibMXTpu.trainerCreate(mxtPath, pluginPathOrNull);
+    if (handle == 0) {
+      throw new MXTpuException("trainerCreate: " + LibMXTpu.lastError());
+    }
+  }
+
+  public void setInput(String name, float[] data) {
+    ByteBuffer buf = ByteBuffer.allocate(data.length * 4)
+        .order(ByteOrder.LITTLE_ENDIAN);
+    buf.asFloatBuffer().put(data);
+    if (LibMXTpu.trainerSetInput(handle, name, buf.array()) != 0) {
+      throw new MXTpuException("setInput " + name + ": "
+          + LibMXTpu.lastError());
+    }
+  }
+
+  /** One compiled fwd+bwd+update step; returns the loss. */
+  public float step() {
+    float loss = LibMXTpu.trainerStep(handle);
+    if (Float.isInfinite(loss) && loss < 0) {
+      throw new MXTpuException("step: " + LibMXTpu.lastError());
+    }
+    return loss;
+  }
+
+  public void getState(String name, float[] out) {
+    byte[] raw = new byte[out.length * 4];
+    if (LibMXTpu.trainerGetState(handle, name, raw) != 0) {
+      throw new MXTpuException("getState " + name + ": "
+          + LibMXTpu.lastError());
+    }
+    ByteBuffer.wrap(raw).order(ByteOrder.LITTLE_ENDIAN).asFloatBuffer()
+        .get(out);
+  }
+
+  public void setState(String name, float[] data) {
+    ByteBuffer buf = ByteBuffer.allocate(data.length * 4)
+        .order(ByteOrder.LITTLE_ENDIAN);
+    buf.asFloatBuffer().put(data);
+    if (LibMXTpu.trainerSetState(handle, name, buf.array()) != 0) {
+      throw new MXTpuException("setState " + name + ": "
+          + LibMXTpu.lastError());
+    }
+  }
+
+  @Override
+  public void close() {
+    if (handle != 0) {
+      LibMXTpu.trainerFree(handle);
+      handle = 0;
+    }
+  }
+}

@@ -1,0 +1,107 @@
+package org.apache.mxtpu.examples;
+
+import java.util.Random;
+import org.apache.mxtpu.AttrMap;
+import org.apache.mxtpu.Autograd;
+import org.apache.mxtpu.MXTpu;
+import org.apache.mxtpu.NDArray;
+import org.apache.mxtpu.Ops;
+
+/**
+ * Train a small MLP from the JVM via the generated op API (reference role:
+ * scala-package examples). Requires PYTHONPATH to point at the repo and
+ * java.library.path at the native libs; see jvm-package/README.md.
+ */
+public final class TrainMlp {
+  private TrainMlp() {}
+
+  public static void main(String[] args) {
+    MXTpu.init();
+    int batch = 64;
+    int inDim = 20;
+    int hidden = 64;
+    int classes = 10;
+    Random rng = new Random(7);
+
+    float[] xs = new float[batch * inDim];
+    float[] ys = new float[batch];
+    for (int i = 0; i < batch; i++) {
+      int c = rng.nextInt(classes);
+      ys[i] = c;
+      for (int j = 0; j < inDim; j++) {
+        xs[i * inDim + j] = 0.1f * ((c + j) % 10)
+            + 0.3f * (float) rng.nextGaussian();
+      }
+    }
+    NDArray x = NDArray.fromFloats(new long[] {batch, inDim}, xs);
+    NDArray y = NDArray.fromFloats(new long[] {batch}, ys);
+
+    float[] w1d = new float[hidden * inDim];
+    float[] w2d = new float[classes * hidden];
+    for (int i = 0; i < w1d.length; i++) {
+      w1d[i] = 0.05f * (float) rng.nextGaussian();
+    }
+    for (int i = 0; i < w2d.length; i++) {
+      w2d[i] = 0.05f * (float) rng.nextGaussian();
+    }
+    NDArray w1 = NDArray.fromFloats(new long[] {hidden, inDim}, w1d);
+    NDArray b1 = NDArray.zeros(hidden);
+    NDArray w2 = NDArray.fromFloats(new long[] {classes, hidden}, w2d);
+    NDArray b2 = NDArray.zeros(classes);
+
+    double lr = 0.2;
+    double rescale = 1.0 / batch;
+    float first = 0;
+    float last = 0;
+    for (int e = 0; e < 40; e++) {
+      w1.attachGrad();
+      b1.attachGrad();
+      w2.attachGrad();
+      b2.attachGrad();
+      NDArray loss;
+      // close intermediates deterministically: the autograd tape keeps the
+      // graph alive on the runtime side, so JVM handles can drop early
+      // (a Cleaner backstop exists, but GC does not feel device memory)
+      try (Autograd rec = Autograd.record()) {
+        try (NDArray h1 = Ops.FullyConnected(x, w1, b1,
+                 AttrMap.of().set("num_hidden", hidden));
+             NDArray h2 = Ops.Activation(h1,
+                 AttrMap.of().set("act_type", "relu"));
+             NDArray out = Ops.FullyConnected(h2, w2, b2,
+                 AttrMap.of().set("num_hidden", classes))) {
+          loss = Ops.softmax_cross_entropy(out, y);
+        }
+      }
+      loss.backward();
+      float l = loss.scalar() / batch;
+      loss.close();
+      if (e == 0) {
+        first = l;
+      }
+      last = l;
+      AttrMap upd = AttrMap.of().set("lr", lr).set("rescale_grad", rescale);
+      NDArray[] params = {w1, b1, w2, b2};
+      NDArray[] updated = new NDArray[params.length];
+      for (int i = 0; i < params.length; i++) {
+        try (NDArray g = params[i].grad()) {
+          updated[i] = Ops.sgd_update(params[i], g, upd);
+        }
+        params[i].close();
+      }
+      w1 = updated[0];
+      b1 = updated[1];
+      w2 = updated[2];
+      b2 = updated[3];
+      if (e % 10 == 0) {
+        System.out.printf("epoch %d loss %.4f%n", e, l);
+      }
+    }
+    System.out.printf("first %.4f last %.4f%n", first, last);
+    if (last < 0.5f * first) {
+      System.out.println("TRAINED");
+    } else {
+      System.out.println("FAILED");
+      System.exit(1);
+    }
+  }
+}

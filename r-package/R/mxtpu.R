@@ -1,0 +1,62 @@
+# R frontend over the imperative C ABI (reference role: R-package/R/ —
+# mx.nd.* array ops and autograd for R users).
+#
+# Ops run through the embedded-interpreter runtime on real XLA devices.
+# Example:
+#   mx.init()
+#   x <- mx.nd.array(matrix(rnorm(12), 3, 4))
+#   y <- mx.op.invoke("relu", list(x))[[1]]
+#   mx.nd.to.array(y)
+
+mx.init <- function() {
+  invisible(.Call(mxr_init))
+}
+
+#' Create a float32 NDArray from an R array/matrix/vector (column-major R
+#' data is transposed to the row-major layout the runtime uses).
+mx.nd.array <- function(data) {
+  d <- dim(data)
+  if (is.null(d)) d <- length(data)
+  # R is column-major; aperm to serve C-order
+  if (length(d) > 1) data <- aperm(data, rev(seq_along(d)))
+  .Call(mxr_nd_create, as.double(data), as.integer(d))
+}
+
+mx.nd.shape <- function(nd) {
+  .Call(mxr_nd_shape, nd)
+}
+
+#' Copy an NDArray back into an R array (restoring column-major layout).
+mx.nd.to.array <- function(nd) {
+  shape <- .Call(mxr_nd_shape, nd)
+  v <- .Call(mxr_nd_to_vec, nd)
+  if (length(shape) <= 1) return(v)
+  a <- array(v, dim = rev(shape))
+  aperm(a, rev(seq_along(shape)))
+}
+
+#' Invoke any registered op: mx.op.invoke("FullyConnected", list(x, w, b),
+#' attrs = '{"num_hidden": 128}'). Returns a list of NDArrays.
+mx.op.invoke <- function(name, inputs, attrs = NULL) {
+  .Call(mxr_invoke, name, inputs, attrs)
+}
+
+mx.autograd.record <- function(train_mode = TRUE) {
+  invisible(.Call(mxr_record_begin, as.integer(train_mode)))
+}
+
+mx.autograd.end <- function() {
+  invisible(.Call(mxr_record_end))
+}
+
+mx.attach.grad <- function(nd) {
+  invisible(.Call(mxr_attach_grad, nd))
+}
+
+mx.backward <- function(loss) {
+  invisible(.Call(mxr_backward, loss))
+}
+
+mx.grad <- function(nd) {
+  .Call(mxr_grad, nd)
+}

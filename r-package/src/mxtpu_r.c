@@ -1,0 +1,170 @@
+/* R .Call glue over the imperative C ABI (reference role:
+ * R-package/src/ndarray.cc over c_api.h).
+ *
+ * NDArray handles are R external pointers with a finalizer; ops execute
+ * through libmxtpu_imperative.so (embedded-interpreter runtime, real XLA
+ * dispatch). Registered via R_init_mxtpu for useDynLib(.registration).
+ */
+#include <R.h>
+#include <Rinternals.h>
+#include <R_ext/Rdynload.h>
+
+#include <stdint.h>
+#include <string.h>
+
+/* imperative ABI (include/mxtpu_imperative.hpp) */
+extern int MXTpuImpInit(void);
+extern const char* MXTpuImpError(void);
+extern int MXTpuImpNDCreate(int dtype, int ndim, const int64_t* dims,
+                            const void* data, void** out);
+extern int MXTpuImpNDShape(void* h, int64_t* dims, int max_ndim, int* ndim);
+extern int MXTpuImpNDDType(void* h, int* dtype);
+extern int MXTpuImpNDCopyTo(void* h, void* out, size_t nbytes);
+extern int MXTpuImpNDFree(void* h);
+extern int MXTpuImpInvoke(const char* op_name, void** inputs, int n_in,
+                          const char* attrs_json, void** outputs, int max_out,
+                          int* n_out);
+extern int MXTpuImpAttachGrad(void* h);
+extern int MXTpuImpGrad(void* h, void** grad_out);
+extern int MXTpuImpRecordBegin(int train_mode);
+extern int MXTpuImpRecordEnd(void);
+extern int MXTpuImpBackward(void* loss);
+
+static void nd_finalizer(SEXP ptr) {
+  void* h = R_ExternalPtrAddr(ptr);
+  if (h != NULL) {
+    MXTpuImpNDFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+static SEXP wrap_handle(void* h) {
+  SEXP ptr = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(ptr, nd_finalizer, TRUE);
+  UNPROTECT(1);
+  return ptr;
+}
+
+SEXP mxr_init(void) {
+  if (MXTpuImpInit() != 0) error("mxtpu init: %s", MXTpuImpError());
+  return R_NilValue;
+}
+
+/* numeric vector + integer dim vector -> f32 NDArray */
+SEXP mxr_nd_create(SEXP data, SEXP dims) {
+  int nd = LENGTH(dims);
+  int64_t d64[8];
+  R_xlen_t n = 1;
+  if (nd > 8) error("max 8 dims");
+  for (int i = 0; i < nd; ++i) {
+    d64[i] = (int64_t) INTEGER(dims)[i];
+    n *= d64[i];
+  }
+  if (n != XLENGTH(data)) error("length(data) != prod(dims)");
+  float* buf = (float*) R_alloc((size_t) n, sizeof(float));
+  double* src = REAL(data);
+  for (R_xlen_t i = 0; i < n; ++i) buf[i] = (float) src[i];
+  void* h = NULL;
+  if (MXTpuImpNDCreate(0 /* f32 */, nd, d64, buf, &h) != 0)
+    error("nd_create: %s", MXTpuImpError());
+  return wrap_handle(h);
+}
+
+SEXP mxr_nd_shape(SEXP ptr) {
+  int64_t dims[8];
+  int nd = 0;
+  if (MXTpuImpNDShape(R_ExternalPtrAddr(ptr), dims, 8, &nd) != 0)
+    error("nd_shape: %s", MXTpuImpError());
+  SEXP out = PROTECT(allocVector(INTSXP, nd));
+  for (int i = 0; i < nd; ++i) INTEGER(out)[i] = (int) dims[i];
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP mxr_nd_to_vec(SEXP ptr) {
+  int64_t dims[8];
+  int nd = 0;
+  void* h = R_ExternalPtrAddr(ptr);
+  if (MXTpuImpNDShape(h, dims, 8, &nd) != 0)
+    error("nd_shape: %s", MXTpuImpError());
+  int dt = -1;
+  if (MXTpuImpNDDType(h, &dt) != 0 || dt != 0)
+    error("nd_to_vec: dtype code %d is not float32 (0); Cast first", dt);
+  R_xlen_t n = 1;
+  for (int i = 0; i < nd; ++i) n *= dims[i];
+  float* buf = (float*) R_alloc((size_t) n, sizeof(float));
+  if (MXTpuImpNDCopyTo(h, buf, (size_t) n * 4) != 0)
+    error("nd_to_vec: %s", MXTpuImpError());
+  SEXP out = PROTECT(allocVector(REALSXP, n));
+  for (R_xlen_t i = 0; i < n; ++i) REAL(out)[i] = buf[i];
+  UNPROTECT(1);
+  return out;
+}
+
+/* invoke(op_name, list_of_handles, attrs_json_or_NULL) -> list of handles */
+SEXP mxr_invoke(SEXP op, SEXP inputs, SEXP attrs) {
+  int n_in = LENGTH(inputs);
+  void* ins[16];
+  if (n_in > 16) error("max 16 inputs");
+  for (int i = 0; i < n_in; ++i)
+    ins[i] = R_ExternalPtrAddr(VECTOR_ELT(inputs, i));
+  const char* attrs_c =
+      attrs == R_NilValue ? NULL : CHAR(STRING_ELT(attrs, 0));
+  void* outs[8];
+  int n_out = 0;
+  if (MXTpuImpInvoke(CHAR(STRING_ELT(op, 0)), ins, n_in, attrs_c, outs, 8,
+                     &n_out) != 0)
+    error("%s: %s", CHAR(STRING_ELT(op, 0)), MXTpuImpError());
+  SEXP out = PROTECT(allocVector(VECSXP, n_out));
+  for (int i = 0; i < n_out; ++i) SET_VECTOR_ELT(out, i, wrap_handle(outs[i]));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP mxr_attach_grad(SEXP ptr) {
+  if (MXTpuImpAttachGrad(R_ExternalPtrAddr(ptr)) != 0)
+    error("attach_grad: %s", MXTpuImpError());
+  return R_NilValue;
+}
+
+SEXP mxr_record_begin(SEXP train) {
+  if (MXTpuImpRecordBegin(asInteger(train)) != 0)
+    error("record: %s", MXTpuImpError());
+  return R_NilValue;
+}
+
+SEXP mxr_record_end(void) {
+  MXTpuImpRecordEnd();
+  return R_NilValue;
+}
+
+SEXP mxr_backward(SEXP ptr) {
+  if (MXTpuImpBackward(R_ExternalPtrAddr(ptr)) != 0)
+    error("backward: %s", MXTpuImpError());
+  return R_NilValue;
+}
+
+SEXP mxr_grad(SEXP ptr) {
+  void* g = NULL;
+  if (MXTpuImpGrad(R_ExternalPtrAddr(ptr), &g) != 0)
+    error("grad: %s", MXTpuImpError());
+  return wrap_handle(g);
+}
+
+static const R_CallMethodDef call_methods[] = {
+    {"mxr_init", (DL_FUNC) &mxr_init, 0},
+    {"mxr_nd_create", (DL_FUNC) &mxr_nd_create, 2},
+    {"mxr_nd_shape", (DL_FUNC) &mxr_nd_shape, 1},
+    {"mxr_nd_to_vec", (DL_FUNC) &mxr_nd_to_vec, 1},
+    {"mxr_invoke", (DL_FUNC) &mxr_invoke, 3},
+    {"mxr_attach_grad", (DL_FUNC) &mxr_attach_grad, 1},
+    {"mxr_record_begin", (DL_FUNC) &mxr_record_begin, 1},
+    {"mxr_record_end", (DL_FUNC) &mxr_record_end, 0},
+    {"mxr_backward", (DL_FUNC) &mxr_backward, 1},
+    {"mxr_grad", (DL_FUNC) &mxr_grad, 1},
+    {NULL, NULL, 0}};
+
+void R_init_mxtpu(DllInfo* dll) {
+  R_registerRoutines(dll, NULL, call_methods, NULL, NULL);
+  R_useDynamicSymbols(dll, FALSE);
+}

@@ -1,0 +1,20 @@
+# Smoke test: ops + autograd through the R binding.
+# Run (with the package installed and PYTHONPATH at the repo root):
+#   Rscript tests/smoke.R
+library(mxtpu)
+mx.init()
+
+x <- mx.nd.array(matrix(c(-1, 2, 3, -4), 2, 2))
+r <- mx.op.invoke("relu", list(x))[[1]]
+stopifnot(all(mx.nd.to.array(r) == matrix(c(0, 2, 3, 0), 2, 2)))
+
+w <- mx.nd.array(c(2, 3))
+mx.attach.grad(w)
+mx.autograd.record()
+sq <- mx.op.invoke("square", list(w))[[1]]
+loss <- mx.op.invoke("sum", list(sq))[[1]]
+mx.autograd.end()
+mx.backward(loss)
+g <- mx.nd.to.array(mx.grad(w))
+stopifnot(all(abs(g - c(4, 6)) < 1e-6))
+cat("R binding smoke OK\n")

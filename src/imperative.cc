@@ -69,7 +69,11 @@ PyObject* call(const char* fn, PyObject* args) {
 
 struct Gil {
   PyGILState_STATE st;
-  Gil() : st(PyGILState_Ensure()) {}
+  // errno-style semantics: each API entry clears the thread's last error,
+  // so MXTpuImpError() reports the error of the most recent call — a stale
+  // message from an earlier failure must not mask a later subsystem's
+  // error (read the error immediately after a failing call).
+  Gil() : st(PyGILState_Ensure()) { g_err.clear(); }
   ~Gil() { PyGILState_Release(st); }
 };
 

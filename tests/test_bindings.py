@@ -1,0 +1,224 @@
+"""Frontend binding sources: JVM (jvm-package/) and R (r-package/).
+
+Reference roles: scala-package/ (~37k LoC JVM frontend) and R-package/.
+The CI image has neither a JDK nor R, so the build/run tests skip with a
+clear reason there — but the source-level consistency checks ALWAYS run:
+every Java `native` method must have a matching JNI export (and vice
+versa), every R .Call symbol must be registered in mxtpu_r.c, and the C
+sources must only reference symbols the native ABIs actually export.
+"""
+import os
+import re
+import shutil
+import subprocess
+import sys
+import sysconfig
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+JVM = os.path.join(REPO, "jvm-package")
+RPKG = os.path.join(REPO, "r-package")
+
+
+def _read(*parts):
+    with open(os.path.join(*parts)) as f:
+        return f.read()
+
+
+def test_jni_exports_match_java_natives():
+    java = _read(JVM, "src", "main", "java", "org", "apache", "mxtpu",
+                 "LibMXTpu.java")
+    natives = set(re.findall(r"static native \S+(?:\[\])? (\w+)\(", java))
+    assert natives, "no native methods parsed from LibMXTpu.java"
+    cc = _read(JVM, "src", "main", "native", "mxtpu_jni.cc")
+    exports = set(re.findall(r"Java_org_apache_mxtpu_LibMXTpu_(\w+)\(", cc))
+    assert natives == exports, (
+        f"JNI mismatch: java-only={sorted(natives - exports)}, "
+        f"cc-only={sorted(exports - natives)}")
+
+
+def test_jni_uses_only_real_abi_symbols():
+    """Every MXTpu* symbol the JNI layer calls must exist in the native
+    runtimes' sources (catches ABI drift without a JDK)."""
+    cc = _read(JVM, "src", "main", "native", "mxtpu_jni.cc")
+    used = set(re.findall(r"\b(MXTpu\w+)\(", cc))
+    impl = _read(REPO, "src", "imperative.cc") + _read(REPO, "src", "train.cc")
+    defined = set(re.findall(r"\b(MXTpu\w+)\(", impl))
+    missing = used - defined
+    assert not missing, f"JNI references unknown ABI symbols: {sorted(missing)}"
+
+
+def test_r_call_registration_consistent():
+    c = _read(RPKG, "src", "mxtpu_r.c")
+    registered = set(re.findall(r'\{"(mxr_\w+)"', c))
+    defined = set(re.findall(r"^SEXP (mxr_\w+)\(", c, re.M))
+    assert registered == defined, (registered ^ defined)
+    r = _read(RPKG, "R", "mxtpu.R")
+    called = set(re.findall(r"\.Call\((mxr_\w+)", r))
+    assert called <= registered, f"unregistered .Call: {called - registered}"
+
+
+def test_r_uses_only_real_abi_symbols():
+    c = _read(RPKG, "src", "mxtpu_r.c")
+    used = set(re.findall(r"\b(MXTpuImp\w+)\(", c))
+    impl = _read(REPO, "src", "imperative.cc")
+    defined = set(re.findall(r"\b(MXTpuImp\w+)\(", impl))
+    assert used <= defined, f"R glue references unknown symbols: {used - defined}"
+
+
+def test_generated_jvm_ops_current():
+    gen = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "gen_jvm_api.py")],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert gen.returncode == 0, gen.stderr[-800:]
+    diff = subprocess.run(
+        ["git", "diff", "--stat", "--",
+         "jvm-package/src/main/java/org/apache/mxtpu/Ops.java"],
+        capture_output=True, text=True, cwd=REPO)
+    assert diff.stdout.strip() == "", (
+        "stale Ops.java — run tools/gen_jvm_api.py:\n" + diff.stdout)
+
+
+def _jdk():
+    home = os.environ.get("JAVA_HOME")
+    if home and os.path.exists(os.path.join(home, "include", "jni.h")):
+        return home
+    javac = shutil.which("javac")
+    if javac:
+        home = os.path.dirname(os.path.dirname(os.path.realpath(javac)))
+        if os.path.exists(os.path.join(home, "include", "jni.h")):
+            return home
+    return None
+
+
+@pytest.mark.skipif(_jdk() is None,
+                    reason="no JDK with jni.h in this image (set JAVA_HOME)")
+def test_jvm_binding_builds_and_trains():
+    from incubator_mxnet_tpu._native import imperative_lib, train_lib
+
+    assert imperative_lib() is not None and train_lib() is not None
+    env = dict(os.environ)
+    env["JAVA_HOME"] = _jdk()
+    build = subprocess.run(["bash", os.path.join(JVM, "build.sh")],
+                           capture_output=True, text=True, timeout=600,
+                           env=env)
+    assert build.returncode == 0, build.stderr[-2000:]
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    run = subprocess.run(
+        [os.path.join(_jdk(), "bin", "java"),
+         "-cp", os.path.join(JVM, "target", "mxtpu.jar"),
+         "-Djava.library.path=" + os.path.join(JVM, "target"),
+         "org.apache.mxtpu.examples.TrainMlp"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert run.returncode == 0, (run.stdout[-800:], run.stderr[-1500:])
+    assert "TRAINED" in run.stdout
+
+
+@pytest.mark.skipif(shutil.which("R") is None,
+                    reason="R is not installed in this image")
+def test_r_binding_builds_and_smokes(tmp_path):
+    from incubator_mxnet_tpu._native import imperative_lib
+
+    assert imperative_lib() is not None
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    lib = str(tmp_path / "rlib")
+    os.makedirs(lib)
+    inst = subprocess.run(["R", "CMD", "INSTALL", "-l", lib, RPKG],
+                          capture_output=True, text=True, timeout=600,
+                          env=env)
+    assert inst.returncode == 0, inst.stderr[-2000:]
+    env["R_LIBS"] = lib
+    run = subprocess.run(
+        ["Rscript", os.path.join(RPKG, "tests", "smoke.R")],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert run.returncode == 0, (run.stdout[-800:], run.stderr[-1500:])
+    assert "R binding smoke OK" in run.stdout
+
+
+def test_r_c_glue_compiles_headerless(tmp_path):
+    """Even without R, the C glue must be syntactically sound: compile it
+    against a minimal Rinternals stub (catches C errors early)."""
+    stub = tmp_path / "include"
+    os.makedirs(stub / "R_ext")
+    (stub / "R.h").write_text("#pragma once\n")
+    (stub / "Rinternals.h").write_text(
+        "#pragma once\n"
+        "#include <stddef.h>\n"
+        "typedef void* SEXP;\n"
+        "typedef ptrdiff_t R_xlen_t;\n"
+        "extern SEXP R_NilValue;\n"
+        "SEXP R_MakeExternalPtr(void*, SEXP, SEXP);\n"
+        "void* R_ExternalPtrAddr(SEXP);\n"
+        "void R_ClearExternalPtr(SEXP);\n"
+        "typedef void (*R_CFinalizer_t)(SEXP);\n"
+        "void R_RegisterCFinalizerEx(SEXP, R_CFinalizer_t, int);\n"
+        "SEXP PROTECT(SEXP);\nvoid UNPROTECT(int);\n"
+        "void error(const char*, ...);\n"
+        "char* R_alloc(size_t, int);\n"
+        "int LENGTH(SEXP);\nR_xlen_t XLENGTH(SEXP);\n"
+        "int* INTEGER(SEXP);\ndouble* REAL(SEXP);\n"
+        "SEXP VECTOR_ELT(SEXP, int);\nvoid SET_VECTOR_ELT(SEXP, int, SEXP);\n"
+        "SEXP STRING_ELT(SEXP, int);\nconst char* CHAR(SEXP);\n"
+        "int asInteger(SEXP);\n"
+        "typedef unsigned int SEXPTYPE;\n"
+        "#define INTSXP 13\n#define REALSXP 14\n#define VECSXP 19\n"
+        "SEXP allocVector(SEXPTYPE, R_xlen_t);\n"
+        "#define TRUE 1\n#define FALSE 0\n")
+    (stub / "R_ext" / "Rdynload.h").write_text(
+        "#pragma once\n"
+        "typedef void* DL_FUNC;\ntypedef struct DllInfo DllInfo;\n"
+        "typedef struct { const char* name; DL_FUNC fun; int numArgs; }"
+        " R_CallMethodDef;\n"
+        "typedef struct { const char* name; DL_FUNC fun; int numArgs;"
+        " void* types; } R_CMethodDef;\n"
+        "void R_registerRoutines(DllInfo*, const R_CMethodDef*,"
+        " const R_CallMethodDef*, const void*, const void*);\n"
+        "void R_useDynamicSymbols(DllInfo*, int);\n")
+    r = subprocess.run(
+        ["gcc", "-fsyntax-only", "-I" + str(stub),
+         os.path.join(RPKG, "src", "mxtpu_r.c")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+
+def test_jni_glue_compiles_against_stub(tmp_path):
+    """No JDK in CI: syntax-check mxtpu_jni.cc against a minimal jni.h stub
+    so C++ errors in the glue surface before anyone builds with a real JDK."""
+    stub = tmp_path / "include"
+    os.makedirs(stub)
+    (stub / "jni.h").write_text(r"""
+#pragma once
+#include <cstdint>
+#include <cstddef>
+#define JNIEXPORT
+#define JNICALL
+typedef int jint; typedef long long jlong; typedef signed char jbyte;
+typedef float jfloat; typedef int jsize;
+class _jobject {}; typedef _jobject* jobject;
+typedef jobject jclass; typedef jobject jstring;
+typedef jobject jlongArray; typedef jobject jbyteArray;
+struct JNIEnv {
+  const char* GetStringUTFChars(jstring, void*) { return nullptr; }
+  void ReleaseStringUTFChars(jstring, const char*) {}
+  jsize GetArrayLength(jobject) { return 0; }
+  void GetLongArrayRegion(jlongArray, jsize, jsize, jlong*) {}
+  void SetLongArrayRegion(jlongArray, jsize, jsize, const jlong*) {}
+  jlongArray NewLongArray(jsize) { return nullptr; }
+  jbyte* GetByteArrayElements(jbyteArray, void*) { return nullptr; }
+  void ReleaseByteArrayElements(jbyteArray, jbyte*, jint) {}
+  jstring NewStringUTF(const char*) { return nullptr; }
+};
+#define JNI_ABORT 2
+""")
+    r = subprocess.run(
+        ["g++", "-std=c++17", "-fsyntax-only", "-I" + str(stub),
+         os.path.join(JVM, "src", "main", "native", "mxtpu_jni.cc")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
