@@ -797,3 +797,113 @@ def ctc_loss(
         # optax uses blank_id; MXNet 'first' means class 0 is blank and labels are 1-based
         return optax.ctc_loss(logits, logit_paddings, lbl, label_paddings, blank_id=0)
     return optax.ctc_loss(logits, logit_paddings, lbl, label_paddings, blank_id=C - 1)
+
+
+# ---------------------------------------------------------------------------
+# im2col / col2im (ref: src/operator/nn/im2col.h + the im2col/col2im ops) —
+# sliding-block extraction and its scatter-add inverse. On TPU these lower
+# to XLA's patch-extraction (reduce_window family); col2im is expressed as
+# the exact linear transpose of im2col via jax.vjp, so the pair is
+# adjoint by construction.
+# ---------------------------------------------------------------------------
+
+
+def _conv_tuple(v, n):
+    if v is None or v == ():
+        return (1,) * n if n else ()
+    return tuple(int(x) for x in v)
+
+
+def _im2col_patches(data, kernel, stride, dilate, pad):
+    n_sp = len(kernel)
+    stride = _conv_tuple(stride, n_sp)
+    dilate = _conv_tuple(dilate, n_sp)
+    padv = _conv_tuple(pad, n_sp) if pad else (0,) * n_sp
+    padding = [(p, p) for p in padv]
+    # feature dim comes back channel-major (c, k0, k1): exactly the
+    # reference's (c * K_h + kh) * K_w + kw layout
+    patches = lax.conv_general_dilated_patches(
+        data, tuple(kernel), stride, padding, rhs_dilation=dilate,
+        dimension_numbers=("NCHW", "OIHW", "NCHW") if n_sp == 2 else None,
+    )
+    return patches
+
+
+@register("im2col")
+def im2col(data, *, kernel, stride=(), dilate=(), pad=()):
+    """(ref: src/operator/nn/im2col.h im2col CPU/GPU kernels; op
+    registration src/operator/nn/im2col.cc). data (N, C, spatial...) ->
+    (N, C*prod(kernel), prod(out_spatial))."""
+    patches = _im2col_patches(data, tuple(kernel), stride, dilate, pad)
+    n, f = patches.shape[0], patches.shape[1]
+    return patches.reshape(n, f, -1)
+
+
+@register("col2im")
+def col2im(data, *, output_size, kernel, stride=(), dilate=(), pad=()):
+    """(ref: src/operator/nn/im2col.h col2im — scatter-add of column blocks
+    back into an image). data (N, C*prod(kernel), L) -> (N, C,
+    *output_size). Exact adjoint of im2col (same kernel/stride/dilate/pad),
+    expressed as its vjp."""
+    kernel = tuple(int(k) for k in kernel)
+    out_sp = tuple(int(s) for s in output_size)
+    n = data.shape[0]
+    c = data.shape[1] // int(np.prod(kernel))
+    x_shape = (n, c) + out_sp
+
+    def fwd(img):
+        return im2col.__opdef__.fn(img, kernel=kernel, stride=stride,
+                                   dilate=dilate, pad=pad)
+
+    _, vjp = jax.vjp(fwd, jnp.zeros(x_shape, data.dtype))
+    (img,) = vjp(data)
+    return img
+
+
+# ---------------------------------------------------------------------------
+# SVMOutput (ref: src/operator/svm_output.cc) — identity forward whose
+# backward is the multiclass hinge-loss gradient wrt the scores, ignoring
+# head gradients (the SoftmaxOutput-style "output op" contract).
+# Both branches match the reference sign-for-sign: L1_SVM stores dL/ds
+# directly; L2_SVM stores the bracketed magnitude then multiplies by
+# -reg_coef (svm_output.cc:60-63), landing on the same descent gradient
+# with the coefficient applied.
+# ---------------------------------------------------------------------------
+
+
+def _svm_grad(out, label, margin, reg, use_linear):
+    lbl = label.astype(jnp.int32)
+    onehot = jax.nn.one_hot(lbl, out.shape[-1], dtype=out.dtype)
+    if use_linear:
+        g_true = -(margin - out > 0).astype(out.dtype)
+        g_other = (margin + out > 0).astype(out.dtype)
+    else:
+        g_true = -2.0 * jnp.maximum(margin - out, 0.0)
+        g_other = 2.0 * jnp.maximum(margin + out, 0.0)
+    return reg * (onehot * g_true + (1 - onehot) * g_other)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _svm_output(data, label, margin, reg, use_linear):
+    return data
+
+
+def _svm_output_fwd(data, label, margin, reg, use_linear):
+    return data, (data, label)
+
+
+def _svm_output_bwd(margin, reg, use_linear, res, g):
+    out, label = res
+    return (_svm_grad(out, label, margin, reg, use_linear),
+            jnp.zeros_like(label))
+
+
+_svm_output.defvjp(_svm_output_fwd, _svm_output_bwd)
+
+
+@register("SVMOutput")
+def svm_output(data, label, *, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False):
+    """(ref: src/operator/svm_output.cc:89 SVMOutput registration)."""
+    return _svm_output(data, label, float(margin),
+                       float(regularization_coefficient), bool(use_linear))

@@ -846,3 +846,14 @@ def linalg_syevd(A):
     as ROWS)."""
     w, v = jnp.linalg.eigh(A)
     return jnp.swapaxes(v, -1, -2), w
+
+
+# special-function tail (ref: src/operator/mshadow_op.h digamma family)
+_unary("digamma", jax.scipy.special.digamma)
+
+
+@register("polygamma")
+def polygamma(data, *, n=0):
+    """n-th derivative of digamma (ref role: mshadow_op.h special-function
+    tail; n=0 reduces to digamma)."""
+    return jax.scipy.special.polygamma(int(n), data)

@@ -93,7 +93,7 @@ GRAD_SKIP = {
     # (ref: softmax_output-inl.h) — FD of the forward is intentionally
     # different; the custom backward is pinned in tests/test_operator.py
     "SoftmaxOutput", "LinearRegressionOutput", "LogisticRegressionOutput",
-    "MAERegressionOutput",
+    "MAERegressionOutput", "SVMOutput",
     # discrete bin/cell assignment: gradient exists a.e. but FD straddles
     # bin boundaries at any eps
     "ROIPooling", "BilinearSampler", "SpatialTransformer",
@@ -192,6 +192,29 @@ SPECS = {
          jnp.asarray(RNG.choice([-1.0, 1.0], 6).astype(np.float32))),
         dict(out_dim=4)),
     # optimizer update ops
+    "SVMOutput": lambda: ((_rand((3, 4)), jnp.asarray([0.0, 2.0, 1.0])),
+                          {}),
+    "im2col": lambda: ((_rand((2, 3, 6, 6)),),
+                       dict(kernel=(3, 3), stride=(1, 1), pad=(1, 1))),
+    "col2im": lambda: ((_rand((2, 27, 36)),),
+                       dict(output_size=(6, 6), kernel=(3, 3), stride=(1, 1),
+                            pad=(1, 1))),
+    "polygamma": lambda: ((_rand((3, 4), 1.0, 3.0),), dict(n=1)),
+    "multi_sgd_update": lambda: (
+        (_rand((3, 2)), _rand((3, 2)), _rand((4,)), _rand((4,))),
+        dict(lrs=(0.1, 0.2), wds=(0.0, 0.01), num_weights=2)),
+    "multi_sgd_mom_update": lambda: (
+        (_rand((3, 2)), _rand((3, 2)), _rand((3, 2)),
+         _rand((4,)), _rand((4,)), _rand((4,))),
+        dict(lrs=(0.1, 0.2), wds=(0.0, 0.01), num_weights=2, momentum=0.9)),
+    "multi_mp_sgd_update": lambda: (
+        (_rand((3, 2)), _rand((3, 2)), _rand((3, 2)),
+         _rand((4,)), _rand((4,)), _rand((4,))),
+        dict(lrs=(0.1, 0.2), wds=(0.0, 0.01), num_weights=2)),
+    "multi_mp_sgd_mom_update": lambda: (
+        (_rand((3, 2)), _rand((3, 2)), _rand((3, 2)), _rand((3, 2)),
+         _rand((4,)), _rand((4,)), _rand((4,)), _rand((4,))),
+        dict(lrs=(0.1, 0.2), wds=(0.0, 0.01), num_weights=2, momentum=0.9)),
     "sgd_update": lambda: ((_rand((3, 2)), _rand((3, 2))), dict(lr=0.1)),
     "signsgd_update": lambda: ((_rand((3, 2)), _rand((3, 2))), dict(lr=0.1)),
     "sgd_mom_update": lambda: ((_rand((3, 2)), _rand((3, 2)), _rand((3, 2))),

@@ -388,3 +388,135 @@ def test_split_v2_indices_and_sections():
     assert [p.shape for p in halves] == [(3, 2), (3, 2)]
     np.testing.assert_array_equal(
         np.concatenate([p.asnumpy() for p in parts]), x.asnumpy())
+
+
+# ---------------------------------------------------------------------------
+# op tail: im2col/col2im, SVMOutput, digamma/polygamma, multi_sgd family
+# ---------------------------------------------------------------------------
+
+
+def test_im2col_matches_manual():
+    """(ref: src/operator/nn/im2col.h layout — column index (c*Kh+kh)*Kw+kw)"""
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 5, 5).astype(np.float32)
+    out = nd.im2col(nd.array(x), kernel=(3, 3), stride=(2, 2),
+                    pad=(1, 1)).asnumpy()
+    assert out.shape == (2, 27, 9)
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    manual = np.zeros((2, 27, 9), np.float32)
+    for n in range(2):
+        for c in range(3):
+            for kh in range(3):
+                for kw in range(3):
+                    for oh in range(3):
+                        for ow in range(3):
+                            manual[n, (c * 3 + kh) * 3 + kw, oh * 3 + ow] = \
+                                xp[n, c, oh * 2 + kh, ow * 2 + kw]
+    np.testing.assert_allclose(out, manual, rtol=1e-6)
+
+
+def test_col2im_is_adjoint_of_im2col():
+    """<im2col(x), y> == <x, col2im(y)> — the pair must be exact linear
+    adjoints (col2im is the reference's scatter-add inverse)."""
+    rng = np.random.RandomState(1)
+    x = rng.rand(2, 3, 6, 6).astype(np.float32)
+    y = rng.rand(2, 27, 16).astype(np.float32)
+    kw = dict(kernel=(3, 3), stride=(1, 1), pad=(0, 0))
+    ax = nd.im2col(nd.array(x), **kw).asnumpy()
+    ay = nd.col2im(nd.array(y), output_size=(6, 6), **kw).asnumpy()
+    np.testing.assert_allclose(float((ax * y).sum()), float((x * ay).sum()),
+                               rtol=1e-4)
+
+
+def test_svm_output_forward_identity_and_trains():
+    """Forward is identity; backward is the hinge gradient — a linear
+    classifier must separate blobs with BOTH l2 (default) and l1 branches
+    (ref: svm_output.cc L1_SVM/L2_SVM, matched sign-for-sign)."""
+    rng = np.random.RandomState(0)
+    n, d, c = 96, 5, 3
+    labels = rng.randint(0, c, n)
+    x = rng.randn(n, d).astype(np.float32) * 0.3
+    x[np.arange(n), labels % d] += 2.0  # separable
+    xa = nd.array(x)
+    ya = nd.array(labels.astype(np.float32))
+    out = nd.SVMOutput(xa, ya)
+    np.testing.assert_allclose(out.asnumpy(), x, rtol=1e-6)  # identity fwd
+
+    for use_linear in (False, True):
+        w = nd.array(np.zeros((d, c), np.float32))
+        w.attach_grad()
+        for _ in range(60):
+            with autograd.record():
+                scores = nd.dot(xa, w)
+                loss_proxy = nd.SVMOutput(scores, ya,
+                                          use_linear=use_linear)
+            loss_proxy.backward()
+            w -= 0.01 * w.grad
+            w.grad[:] = 0
+        pred = np.argmax(np.asarray(nd.dot(xa, w).asnumpy()), axis=1)
+        acc = (pred == labels).mean()
+        assert acc > 0.9, f"use_linear={use_linear}: acc {acc}"
+
+
+def test_digamma_polygamma_values():
+    x = nd.array(np.array([1.0, 2.0, 5.0], np.float32))
+    # digamma(1) = -euler_gamma; digamma(2) = 1 - euler_gamma
+    eg = 0.5772156649
+    np.testing.assert_allclose(nd.digamma(x).asnumpy()[:2],
+                               [-eg, 1 - eg], rtol=1e-5)
+    # polygamma(1, 1) = pi^2/6
+    np.testing.assert_allclose(nd.polygamma(x, n=1).asnumpy()[0],
+                               np.pi ** 2 / 6, rtol=1e-5)
+    np.testing.assert_allclose(nd.polygamma(x, n=0).asnumpy(),
+                               nd.digamma(x).asnumpy(), rtol=1e-6)
+
+
+def test_multi_sgd_update_matches_sequential():
+    """(ref: optimizer_op.cc:318) aggregated update == per-weight
+    sgd_update/sgd_mom_update with per-tensor lrs/wds."""
+    rng = np.random.RandomState(2)
+    ws = [rng.rand(3, 2).astype(np.float32), rng.rand(4).astype(np.float32)]
+    gs = [rng.rand(3, 2).astype(np.float32), rng.rand(4).astype(np.float32)]
+    ms = [np.zeros_like(w) for w in ws]
+    lrs, wds = (0.1, 0.2), (0.0, 0.01)
+
+    outs = nd.multi_sgd_update(nd.array(ws[0]), nd.array(gs[0]),
+                               nd.array(ws[1]), nd.array(gs[1]),
+                               lrs=lrs, wds=wds, num_weights=2)
+    for i in range(2):
+        ref = nd.sgd_update(nd.array(ws[i]), nd.array(gs[i]), lr=lrs[i],
+                            wd=wds[i])
+        np.testing.assert_allclose(outs[i].asnumpy(), ref.asnumpy(),
+                                   rtol=1e-6)
+
+    outs = nd.multi_sgd_mom_update(
+        nd.array(ws[0]), nd.array(gs[0]), nd.array(ms[0]),
+        nd.array(ws[1]), nd.array(gs[1]), nd.array(ms[1]),
+        lrs=lrs, wds=wds, num_weights=2, momentum=0.9)
+    assert len(outs) == 4  # weights then momenta (functional protocol)
+    for i in range(2):
+        ref_w, ref_m = nd.sgd_mom_update(
+            nd.array(ws[i]), nd.array(gs[i]), nd.array(ms[i]), lr=lrs[i],
+            wd=wds[i], momentum=0.9)
+        np.testing.assert_allclose(outs[i].asnumpy(), ref_w.asnumpy(),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(outs[2 + i].asnumpy(), ref_m.asnumpy(),
+                                   rtol=1e-6)
+
+
+def test_multi_mp_sgd_update_masters_in_fp32():
+    import ml_dtypes
+
+    rng = np.random.RandomState(3)
+    w32 = rng.rand(3, 2).astype(np.float32)
+    w16 = w32.astype(ml_dtypes.bfloat16)
+    g = rng.rand(3, 2).astype(ml_dtypes.bfloat16)
+    outs = nd.multi_mp_sgd_update(
+        nd.array(w16), nd.array(g), nd.array(w32),
+        lrs=(0.1,), wds=(0.0,), num_weights=1)
+    assert len(outs) == 2
+    ref = w32 - 0.1 * g.astype(np.float32)
+    np.testing.assert_allclose(outs[1].asnumpy(), ref, rtol=1e-6)  # master
+    assert str(outs[0].asnumpy().dtype) == "bfloat16"
+    np.testing.assert_allclose(outs[0].asnumpy().astype(np.float32), ref,
+                               rtol=1e-2)  # low-precision refresh
