@@ -50,75 +50,78 @@ def imresize(src, w, h, interp=1):
     return nd_array(cv2.resize(a, (w, h), interpolation=interp))
 
 
+def _as_np(src):
+    return src.asnumpy() if isinstance(src, NDArray) else src
+
+
 def scale_down(src_size, size):
-    w, h = size
+    """Shrink a requested crop (w, h) until it fits inside src_size,
+    preserving its aspect ratio: both edges scale by the one factor
+    min(1, sw/w, sh/h) (behavioral ref: image.py scale_down)."""
     sw, sh = src_size
-    if sh < h:
-        w, h = float(w * sh) / h, sh
-    if sw < w:
-        w, h = sw, float(h * sw) / w
-    return int(w), int(h)
+    w, h = size
+    shrink = min(1.0, sw / float(w), sh / float(h))
+    return int(w * shrink), int(h * shrink)
 
 
 def resize_short(src, size, interp=2):
+    """Resize so the SHORTER edge becomes `size`; the longer edge keeps
+    the aspect ratio (floor division, as users of the reference expect)."""
     import cv2
 
-    a = src.asnumpy() if isinstance(src, NDArray) else src
+    a = _as_np(src)
     h, w = a.shape[:2]
-    if h > w:
-        new_h, new_w = size * h // w, size
-    else:
-        new_h, new_w = size, size * w // h
+    long_edge = size * max(h, w) // min(h, w)
+    new_w, new_h = (size, long_edge) if w <= h else (long_edge, size)
     return nd_array(cv2.resize(a, (new_w, new_h), interpolation=interp))
 
 
 def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
-    a = src.asnumpy() if isinstance(src, NDArray) else src
-    out = a[y0 : y0 + h, x0 : x0 + w]
-    if size is not None and (w, h) != size:
-        import cv2
+    """Take the w x h window at (x0, y0); resize to `size` if asked."""
+    window = _as_np(src)[y0:y0 + h, x0:x0 + w]
+    if size is None or tuple(size) == (w, h):
+        return nd_array(window)
+    import cv2
 
-        out = cv2.resize(out, size, interpolation=interp)
-    return nd_array(out)
+    return nd_array(cv2.resize(window, size, interpolation=interp))
+
+
+def _place_crop(a, size, interp, x0, y0, cw, ch):
+    return fixed_crop(a, x0, y0, cw, ch, size, interp), (x0, y0, cw, ch)
 
 
 def random_crop(src, size, interp=2):
-    a = src.asnumpy() if isinstance(src, NDArray) else src
+    a = _as_np(src)
     h, w = a.shape[:2]
-    new_w, new_h = scale_down((w, h), size)
-    x0 = pyrandom.randint(0, w - new_w)
-    y0 = pyrandom.randint(0, h - new_h)
-    out = fixed_crop(a, x0, y0, new_w, new_h, size, interp)
-    return out, (x0, y0, new_w, new_h)
+    cw, ch = scale_down((w, h), size)
+    return _place_crop(a, size, interp,
+                       pyrandom.randint(0, w - cw),
+                       pyrandom.randint(0, h - ch), cw, ch)
 
 
 def center_crop(src, size, interp=2):
-    a = src.asnumpy() if isinstance(src, NDArray) else src
+    a = _as_np(src)
     h, w = a.shape[:2]
-    new_w, new_h = scale_down((w, h), size)
-    x0 = (w - new_w) // 2
-    y0 = (h - new_h) // 2
-    out = fixed_crop(a, x0, y0, new_w, new_h, size, interp)
-    return out, (x0, y0, new_w, new_h)
+    cw, ch = scale_down((w, h), size)
+    return _place_crop(a, size, interp, (w - cw) // 2, (h - ch) // 2, cw, ch)
 
 
 def random_size_crop(src, size, area, ratio, interp=2, **kwargs):
-    a = src.asnumpy() if isinstance(src, NDArray) else src
+    """Inception-style crop: draw an area fraction and a log-uniform aspect
+    ratio, retry up to 10 times for a window that fits, else center-crop."""
+    a = _as_np(src)
     h, w = a.shape[:2]
-    src_area = h * w
-    if isinstance(area, (int, float)):
-        area = (area, 1.0)
+    lo, hi = (area, 1.0) if isinstance(area, (int, float)) else area
     for _ in range(10):
-        target_area = pyrandom.uniform(area[0], area[1]) * src_area
-        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
-        new_ratio = np.exp(pyrandom.uniform(*log_ratio))
-        new_w = int(round(np.sqrt(target_area * new_ratio)))
-        new_h = int(round(np.sqrt(target_area / new_ratio)))
-        if new_w <= w and new_h <= h:
-            x0 = pyrandom.randint(0, w - new_w)
-            y0 = pyrandom.randint(0, h - new_h)
-            out = fixed_crop(a, x0, y0, new_w, new_h, size, interp)
-            return out, (x0, y0, new_w, new_h)
+        pixels = pyrandom.uniform(lo, hi) * (h * w)
+        aspect = np.exp(pyrandom.uniform(np.log(ratio[0]), np.log(ratio[1])))
+        cw = int(round(np.sqrt(pixels * aspect)))
+        ch = int(round(np.sqrt(pixels / aspect)))
+        if cw > w or ch > h:
+            continue
+        return _place_crop(a, size, interp,
+                           pyrandom.randint(0, w - cw),
+                           pyrandom.randint(0, h - ch), cw, ch)
     return center_crop(src, size, interp)
 
 
@@ -342,43 +345,49 @@ class CastAug(Augmenter):
         return nd_array(src.asnumpy().astype(self.typ))
 
 
+# ImageNet channel statistics and PCA lighting basis (data constants shared
+# with the reference's defaults)
+_IMAGENET_MEAN = np.array([123.68, 116.28, 103.53])
+_IMAGENET_STD = np.array([58.395, 57.12, 57.375])
+_IMAGENET_PCA_EIGVAL = np.array([55.46, 4.794, 1.148])
+_IMAGENET_PCA_EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
+                                 [-0.5808, -0.0045, -0.814],
+                                 [-0.5836, -0.6948, 0.4203]])
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, hue=0, pca_noise=0, rand_gray=0,
                     inter_method=2):
-    """(ref: image.py CreateAugmenter mirroring image_aug_default.cc defaults)"""
-    auglist = []
-    if resize > 0:
-        auglist.append(ResizeAug(resize, inter_method))
+    """(ref: image.py CreateAugmenter mirroring image_aug_default.cc
+    defaults). Pipeline order: resize -> crop -> flip -> cast -> color
+    jitter -> hue -> PCA lighting -> grayscale -> normalize."""
     crop_size = (data_shape[2], data_shape[1])
     if rand_resize:
-        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0), (3.0 / 4.0, 4.0 / 3.0), inter_method))
-    elif rand_crop:
-        auglist.append(RandomCropAug(crop_size, inter_method))
+        crop = RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                  (3.0 / 4.0, 4.0 / 3.0), inter_method)
     else:
-        auglist.append(CenterCropAug(crop_size, inter_method))
-    if rand_mirror:
-        auglist.append(HorizontalFlipAug(0.5))
-    auglist.append(CastAug())
-    if brightness or contrast or saturation:
-        auglist.append(ColorJitterAug(brightness, contrast, saturation))
-    if hue:
-        auglist.append(HueJitterAug(hue))
-    if pca_noise > 0:
-        eigval = np.array([55.46, 4.794, 1.148])
-        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
-                           [-0.5808, -0.0045, -0.814],
-                           [-0.5836, -0.6948, 0.4203]])
-        auglist.append(LightingAug(pca_noise, eigval, eigvec))
-    if rand_gray > 0:
-        auglist.append(RandomGrayAug(rand_gray))
-    if mean is True:
-        mean = np.array([123.68, 116.28, 103.53])
-    if std is True:
-        std = np.array([58.395, 57.12, 57.375])
-    if mean is not None or std is not None:
-        auglist.append(ColorNormalizeAug(mean, std))
-    return auglist
+        crop_cls = RandomCropAug if rand_crop else CenterCropAug
+        crop = crop_cls(crop_size, inter_method)
+    mean = _IMAGENET_MEAN.copy() if mean is True else mean
+    std = _IMAGENET_STD.copy() if std is True else std
+    # (enabled?, augmenter) stages in pipeline order
+    stages = [
+        (resize > 0, lambda: ResizeAug(resize, inter_method)),
+        (True, lambda: crop),
+        (rand_mirror, lambda: HorizontalFlipAug(0.5)),
+        (True, CastAug),
+        (brightness or contrast or saturation,
+         lambda: ColorJitterAug(brightness, contrast, saturation)),
+        (hue, lambda: HueJitterAug(hue)),
+        (pca_noise > 0,
+         lambda: LightingAug(pca_noise, _IMAGENET_PCA_EIGVAL.copy(),
+                             _IMAGENET_PCA_EIGVEC.copy())),
+        (rand_gray > 0, lambda: RandomGrayAug(rand_gray)),
+        (mean is not None or std is not None,
+         lambda: ColorNormalizeAug(mean, std)),
+    ]
+    return [make() for on, make in stages if on]
 
 
 class ImageIter(DataIter):
@@ -456,24 +465,27 @@ class ImageIter(DataIter):
             self.imgrec.reset()
         self.cur = 0
 
+    def _record_at(self, idx):
+        """(label, encoded bytes) for one source position."""
+        if self.imgrec is not None:
+            rec = recordio.unpack(self.imgrec.read_idx(idx))
+            return rec[0].label, rec[1]
+        label, fname = self.imglist[idx]
+        with open(fname, "rb") as f:
+            return label, f.read()
+
     def next_sample(self):
-        if self.seq is not None:
-            if self.cur >= len(self.seq):
+        if self.seq is None:
+            # non-indexed .rec: pure sequential read
+            s = self.imgrec.read()
+            if s is None:
                 raise StopIteration
-            idx = self.seq[self.cur]
-            self.cur += 1
-            if self.imgrec is not None:
-                s = self.imgrec.read_idx(idx)
-                header, img = recordio.unpack(s)
-                return header.label, img
-            label, fname = self.imglist[idx]
-            with open(fname, "rb") as f:
-                return label, f.read()
-        s = self.imgrec.read()
-        if s is None:
+            header, img = recordio.unpack(s)
+            return header.label, img
+        if self.cur >= len(self.seq):
             raise StopIteration
-        header, img = recordio.unpack(s)
-        return header.label, img
+        self.cur += 1
+        return self._record_at(self.seq[self.cur - 1])
 
     def next(self):
         batch_data = np.zeros((self.batch_size,) + self.data_shape, dtype=np.float32)
@@ -697,11 +709,9 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
         if jitter > 0:
             auglist.append(DetBorrowAug(cls(jitter)))
     if pca_noise > 0:
-        eigval = np.array([55.46, 4.794, 1.148])
-        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
-                           [-0.5808, -0.0045, -0.814],
-                           [-0.5836, -0.6948, 0.4203]])
-        auglist.append(DetBorrowAug(LightingAug(pca_noise, eigval, eigvec)))
+        auglist.append(DetBorrowAug(LightingAug(
+            pca_noise, _IMAGENET_PCA_EIGVAL.copy(),
+            _IMAGENET_PCA_EIGVEC.copy())))
     if rand_gray > 0:
         auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
     if mean is not None or std is not None:

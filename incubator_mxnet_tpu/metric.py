@@ -52,16 +52,16 @@ class EvalMetric:
         self._kwargs = kwargs
         self.reset()
 
+    @staticmethod
+    def _select(mapping, names):
+        """Ordered values, filtered to `names` when given."""
+        if names is None:
+            return list(mapping.values())
+        return [mapping[n] for n in names]
+
     def update_dict(self, label, pred):
-        if self.output_names is not None:
-            pred = [pred[name] for name in self.output_names]
-        else:
-            pred = list(pred.values())
-        if self.label_names is not None:
-            label = [label[name] for name in self.label_names]
-        else:
-            label = list(label.values())
-        self.update(label, pred)
+        self.update(self._select(label, self.label_names),
+                    self._select(pred, self.output_names))
 
     def update(self, labels, preds):
         raise NotImplementedError
@@ -71,17 +71,14 @@ class EvalMetric:
         self.sum_metric = 0.0
 
     def get(self):
-        if self.num_inst == 0:
-            return (self.name, float("nan"))
-        return (self.name, self.sum_metric / self.num_inst)
+        mean = (self.sum_metric / self.num_inst if self.num_inst
+                else float("nan"))
+        return (self.name, mean)
 
     def get_name_value(self):
         name, value = self.get()
-        if not isinstance(name, list):
-            name = [name]
-        if not isinstance(value, list):
-            value = [value]
-        return list(zip(name, value))
+        as_list = lambda v: v if isinstance(v, list) else [v]  # noqa: E731
+        return list(zip(as_list(name), as_list(value)))
 
     def __str__(self):
         return f"EvalMetric: {dict(self.get_name_value())}"
@@ -154,62 +151,63 @@ class TopKAccuracy(EvalMetric):
             self.num_inst += len(label)
 
 
+class _ConfusionMetric(EvalMetric):
+    """Shared streaming 2x2 confusion table for the binary metrics: one
+    vectorized count per batch (predicted class x true class), from which
+    F1 and MCC derive their closed forms."""
+
+    def reset(self):
+        self.counts = _numpy.zeros((2, 2))  # [pred][true]
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            y = _asnp(label).flatten()
+            p = _asnp(pred)
+            if p.ndim > 1:
+                p = _numpy.argmax(p, axis=-1)
+            p = p.flatten()
+            # membership test on the RAW values (0.7 is neither class, not
+            # class 0), then bincount the joint index 2*pred + true to fill
+            # all four cells in one pass
+            ok = ((y == 0) | (y == 1)) & ((p == 0) | (p == 1))
+            joint = _numpy.bincount(
+                2 * p[ok].astype(int) + y[ok].astype(int), minlength=4)
+            self.counts += joint.reshape(2, 2)
+            self.num_inst += 1
+
+    @property
+    def _cells(self):
+        """(tp, fp, fn, tn) from the table."""
+        return (self.counts[1, 1], self.counts[1, 0],
+                self.counts[0, 1], self.counts[0, 0])
+
+
 @register
-class F1(EvalMetric):
+class F1(_ConfusionMetric):
     def __init__(self, name="f1", output_names=None, label_names=None, average="macro"):
         super().__init__(name, output_names, label_names)
         self.average = average
 
-    def reset(self):
-        self.tp = self.fp = self.fn = 0.0
-        self.num_inst = 0
-        self.sum_metric = 0.0
-
-    def update(self, labels, preds):
-        for label, pred in zip(labels, preds):
-            label, pred = _asnp(label).flatten(), _asnp(pred)
-            if pred.ndim > 1:
-                pred = _numpy.argmax(pred, axis=-1)
-            pred = pred.flatten()
-            self.tp += float(((pred == 1) & (label == 1)).sum())
-            self.fp += float(((pred == 1) & (label == 0)).sum())
-            self.fn += float(((pred == 0) & (label == 1)).sum())
-            self.num_inst += 1
-
     def get(self):
-        prec = self.tp / max(self.tp + self.fp, 1e-12)
-        rec = self.tp / max(self.tp + self.fn, 1e-12)
-        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
+        tp, fp, fn, _ = self._cells
+        # harmonic mean of precision and recall == 2tp / (2tp + fp + fn)
+        f1 = 2 * tp / max(2 * tp + fp + fn, 1e-12)
         return (self.name, f1 if self.num_inst else float("nan"))
 
 
 @register
-class MCC(EvalMetric):
+class MCC(_ConfusionMetric):
     def __init__(self, name="mcc", output_names=None, label_names=None, average="macro"):
         super().__init__(name, output_names, label_names)
 
-    def reset(self):
-        self.tp = self.fp = self.fn = self.tn = 0.0
-        self.num_inst = 0
-        self.sum_metric = 0.0
-
-    def update(self, labels, preds):
-        for label, pred in zip(labels, preds):
-            label, pred = _asnp(label).flatten(), _asnp(pred)
-            if pred.ndim > 1:
-                pred = _numpy.argmax(pred, axis=-1)
-            pred = pred.flatten()
-            self.tp += float(((pred == 1) & (label == 1)).sum())
-            self.fp += float(((pred == 1) & (label == 0)).sum())
-            self.fn += float(((pred == 0) & (label == 1)).sum())
-            self.tn += float(((pred == 0) & (label == 0)).sum())
-            self.num_inst += 1
-
     def get(self):
-        denom = math.sqrt(
-            (self.tp + self.fp) * (self.tp + self.fn) * (self.tn + self.fp) * (self.tn + self.fn)
-        )
-        mcc = (self.tp * self.tn - self.fp * self.fn) / denom if denom else 0.0
+        tp, fp, fn, tn = self._cells
+        # correlation of the 2x2 table: cov / sqrt(prod of marginals)
+        marginals = [tp + fp, tp + fn, tn + fp, tn + fn]
+        denom = math.sqrt(math.prod(marginals))
+        mcc = (tp * tn - fp * fn) / denom if denom else 0.0
         return (self.name, mcc if self.num_inst else float("nan"))
 
 

@@ -46,7 +46,7 @@ def _conv_dn(ndim):
 
 
 def _tup(v, n):
-    if v is None:
+    if v is None or v == ():
         return (1,) * n
     if isinstance(v, int):
         return (v,) * n
@@ -808,17 +808,11 @@ def ctc_loss(
 # ---------------------------------------------------------------------------
 
 
-def _conv_tuple(v, n):
-    if v is None or v == ():
-        return (1,) * n if n else ()
-    return tuple(int(x) for x in v)
-
-
 def _im2col_patches(data, kernel, stride, dilate, pad):
     n_sp = len(kernel)
-    stride = _conv_tuple(stride, n_sp)
-    dilate = _conv_tuple(dilate, n_sp)
-    padv = _conv_tuple(pad, n_sp) if pad else (0,) * n_sp
+    stride = _tup(stride, n_sp)
+    dilate = _tup(dilate, n_sp)
+    padv = _tup(pad, n_sp) if pad else (0,) * n_sp
     padding = [(p, p) for p in padv]
     # feature dim comes back channel-major (c, k0, k1): exactly the
     # reference's (c * K_h + kh) * K_w + kw layout
@@ -852,8 +846,8 @@ def col2im(data, *, output_size, kernel, stride=(), dilate=(), pad=()):
     x_shape = (n, c) + out_sp
 
     def fwd(img):
-        return im2col.__opdef__.fn(img, kernel=kernel, stride=stride,
-                                   dilate=dilate, pad=pad)
+        return im2col(img, kernel=kernel, stride=stride, dilate=dilate,
+                      pad=pad)
 
     _, vjp = jax.vjp(fwd, jnp.zeros(x_shape, data.dtype))
     (img,) = vjp(data)

@@ -176,6 +176,16 @@ def adamw_update(
 # ---------------------------------------------------------------------------
 
 
+def _nw(attrs):
+    """num_weights from an attr dict, with a real error when omitted (the
+    eager frontend fills required attrs with None, and the num_outputs
+    lambdas run before the op body's own guard could)."""
+    nw = attrs.get("num_weights")
+    if nw is None:
+        raise TypeError("multi update requires num_weights")
+    return int(nw)
+
+
 def _multi_groups(args, group, num_weights):
     if num_weights is None:
         raise TypeError("multi update requires num_weights")
@@ -199,7 +209,7 @@ def _per_weight(attr, i, what):
     return float(attr)
 
 
-@register("multi_sgd_update", num_outputs=lambda attrs: int(attrs["num_weights"]))
+@register("multi_sgd_update", num_outputs=lambda attrs: _nw(attrs))
 def multi_sgd_update(*args, lrs, wds, num_weights, rescale_grad=1.0,
                      clip_gradient=-1.0):
     outs = []
@@ -211,7 +221,7 @@ def multi_sgd_update(*args, lrs, wds, num_weights, rescale_grad=1.0,
 
 
 @register("multi_sgd_mom_update",
-          num_outputs=lambda attrs: 2 * int(attrs["num_weights"]))
+          num_outputs=lambda attrs: 2 * _nw(attrs))
 def multi_sgd_mom_update(*args, lrs, wds, num_weights, momentum=0.0,
                          rescale_grad=1.0, clip_gradient=-1.0):
     ws, ms = [], []
@@ -226,7 +236,7 @@ def multi_sgd_mom_update(*args, lrs, wds, num_weights, momentum=0.0,
 
 
 @register("multi_mp_sgd_update",
-          num_outputs=lambda attrs: 2 * int(attrs["num_weights"]))
+          num_outputs=lambda attrs: 2 * _nw(attrs))
 def multi_mp_sgd_update(*args, lrs, wds, num_weights, rescale_grad=1.0,
                         clip_gradient=-1.0):
     """Mixed precision: per weight (weight, grad, weight32); math in fp32
@@ -243,7 +253,7 @@ def multi_mp_sgd_update(*args, lrs, wds, num_weights, rescale_grad=1.0,
 
 
 @register("multi_mp_sgd_mom_update",
-          num_outputs=lambda attrs: 3 * int(attrs["num_weights"]))
+          num_outputs=lambda attrs: 3 * _nw(attrs))
 def multi_mp_sgd_mom_update(*args, lrs, wds, num_weights, momentum=0.0,
                             rescale_grad=1.0, clip_gradient=-1.0):
     ws, ms, w32s = [], [], []

@@ -70,3 +70,28 @@ def test_custom_metric():
     m = metric.np(feval, name="ones")
     m.update([nd.array([1.0, 1.0, 0.0])], [nd.array([0.0, 0.0, 0.0])])
     assert m.get()[1] == 2.0
+
+
+def test_all_public_metrics_reachable_via_create():
+    """Regression: every public EvalMetric subclass must be in the create()
+    registry (a refactor once silently unregistered F1)."""
+    import inspect
+
+    import incubator_mxnet_tpu.metric as metric
+
+    for name, obj in vars(metric).items():
+        if (inspect.isclass(obj) and issubclass(obj, metric.EvalMetric)
+                and obj is not metric.EvalMetric
+                and not name.startswith("_")
+                and name not in ("CustomMetric",)):  # needs feval arg
+            assert metric._REGISTRY.get(name.lower()) is obj, (
+                f"{name} not reachable via metric.create")
+    m = metric.create("f1")
+    m2 = metric.create("mcc")
+    lbl = np.array([1, 0, 1, 1, 0], np.float32)
+    prd = np.array([1, 0, 0, 1, 1], np.float32)
+    m.update([lbl], [prd])
+    m2.update([lbl], [prd])
+    # tp=2 fp=1 fn=1 tn=1: f1 = 2/3, mcc = (2-1)/sqrt(3*3*2*2) = 1/6
+    assert abs(m.get()[1] - 2 / 3) < 1e-9
+    assert abs(m2.get()[1] - 1 / 6) < 1e-9
