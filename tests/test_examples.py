@@ -89,3 +89,43 @@ def test_neural_style():
 def test_wgan_gp():
     log = _run("wgan_gp.py", "--iters", "150", timeout=600)
     assert "wgan_gp OK" in log
+
+
+def test_speech_ctc():
+    log = _run("speech_ctc.py", "--steps", "200")
+    assert "speech_ctc OK" in log
+
+
+def test_nce_lm():
+    log = _run("nce_lm.py", "--vocab", "200", "--steps", "400", timeout=500)
+    assert "nce_lm OK" in log
+
+
+def test_multi_task():
+    log = _run("multi_task.py", "--steps", "150")
+    assert "multi_task OK" in log
+
+
+def test_recommender_bpr():
+    log = _run("recommender_bpr.py", "--steps", "300")
+    assert "recommender_bpr OK" in log
+
+
+def test_bi_lstm_sort():
+    log = _run("bi_lstm_sort.py", "--steps", "350", timeout=500)
+    assert "bi_lstm_sort OK" in log
+
+
+def test_ner_bilstm():
+    log = _run("ner_bilstm.py", "--steps", "200")
+    assert "ner_bilstm OK" in log
+
+
+def test_capsnet():
+    log = _run("capsnet.py", "--steps", "150")
+    assert "capsnet OK" in log
+
+
+def test_bayes_by_backprop():
+    log = _run("bayes_by_backprop.py", "--steps", "600", timeout=500)
+    assert "bayes_by_backprop OK" in log
