@@ -21,8 +21,7 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
 import incubator_mxnet_tpu as mx
-from incubator_mxnet_tpu import autograd, gluon, nd
-from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu import autograd, nd
 
 
 class BayesDense:

@@ -109,8 +109,7 @@ def main():
     log_kq = nd.array(np.log(args.noise * unigram))
     opt = mx.optimizer.Adam(learning_rate=args.lr)
 
-    step = None
-    first_ppl = None
+    step = fused.GluonTrainStep(net, nce_loss_fn(args.noise, log_kq), opt)
     n_win = len(ids) - args.seq_len - 1
     for i in range(args.steps):
         starts = rng.randint(0, n_win, args.batch_size)
@@ -120,9 +119,6 @@ def main():
                                         args.noise), p=unigram)
         packed = np.concatenate([x[..., None], tgt[..., None], noise],
                                 axis=-1).astype(np.int32)
-        if step is None:
-            step = fused.GluonTrainStep(net, nce_loss_fn(args.noise, log_kq),
-                                        opt)
         loss = step(nd.array(packed), nd.array(tgt.astype(np.float32)))
         if (i + 1) % 100 == 0:
             print(f"step {i + 1}: nce loss {float(loss.asscalar()):.3f}")

@@ -101,8 +101,8 @@ def main():
         if i == 0:
             first = float(loss.asscalar())
         if (i + 1) % 50 == 0:
-            last = float(loss.asscalar())
-            print(f"step {i + 1}: ctc loss {last:.3f}")
+            print(f"step {i + 1}: ctc loss {float(loss.asscalar()):.3f}")
+    last = float(loss.asscalar())
     step.sync_params()
     assert last < first * 0.5, (first, last)
 

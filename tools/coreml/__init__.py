@@ -1,0 +1,13 @@
+"""CoreML converter (ref: tools/coreml/ — mxnet_coreml_converter.py and
+its _mxnet_converter/_layers modules, which map a trained model onto the
+CoreML NeuralNetwork layer schema and assemble a .mlmodel through
+coremltools).
+
+Same architecture here: `converter.convert` walks a trained gluon network
+and produces the CoreML layer specs (structure + weights, validated
+without any Apple tooling); `CoreMLModelSpec.save` assembles the .mlmodel
+protobuf through coremltools and — exactly like the reference, whose
+converter imports coremltools at module load — is gated on that package
+being installed.
+"""
+from .converter import CoreMLModelSpec, convert  # noqa: F401

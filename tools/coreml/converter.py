@@ -1,0 +1,221 @@
+"""Gluon -> CoreML NeuralNetwork layer specs
+(ref: tools/coreml/converter/_mxnet_converter.py `_layers.py` — one
+translator function per op, registered by layer type).
+
+The spec side (layer dicts with CoreML's field names and weight layouts)
+is built and checked dependency-free; protobuf assembly needs coremltools
+(same dependency the reference's converter has).
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".."))
+
+_REGISTRY = {}
+
+
+def _register(cls_name):
+    def deco(fn):
+        _REGISTRY[cls_name] = fn
+        return fn
+
+    return deco
+
+
+@_register("Dense")
+def _dense(block, name):
+    w = block.weight.data().asnumpy()           # (out, in)
+    b = (block.bias.data().asnumpy() if block.bias is not None
+         else np.zeros(w.shape[0], np.float32))
+    out = [{
+        "type": "innerProduct", "name": name,
+        "inputChannels": int(w.shape[1]), "outputChannels": int(w.shape[0]),
+        "weights": w, "bias": b, "hasBias": True,
+    }]
+    if getattr(block, "_act_type", None):
+        out.append({"type": "activation", "name": name + "_act",
+                    "activation": _ACT_MAP[block._act_type]})
+    return out
+
+
+_ACT_MAP = {"relu": "ReLU", "sigmoid": "sigmoid", "tanh": "tanh",
+            "softrelu": "softplus", "softsign": "softsign"}
+
+
+@_register("Conv2D")
+def _conv(block, name):
+    w = block.weight.data().asnumpy()           # (out, in, kh, kw)
+    b = (block.bias.data().asnumpy() if block.bias is not None
+         else np.zeros(w.shape[0], np.float32))
+    out = [{
+        "type": "convolution", "name": name,
+        "outputChannels": int(w.shape[0]), "kernelChannels": int(w.shape[1]),
+        "kernelSize": [int(w.shape[2]), int(w.shape[3])],
+        "stride": [int(s) for s in block._strides],
+        "padding": [int(p) for p in block._padding],
+        # CoreML convolution weights layout: (kh, kw, in, out)
+        "weights": np.transpose(w, (2, 3, 1, 0)).copy(), "bias": b,
+        "hasBias": True,
+    }]
+    if getattr(block, "_act_type", None):
+        out.append({"type": "activation", "name": name + "_act",
+                    "activation": _ACT_MAP[block._act_type]})
+    return out
+
+
+@_register("Activation")
+def _activation(block, name):
+    return [{"type": "activation", "name": name,
+             "activation": _ACT_MAP[block._act_type]}]
+
+
+@_register("MaxPool2D")
+def _maxpool(block, name):
+    return [_pool(block, name, "MAX")]
+
+
+@_register("AvgPool2D")
+def _avgpool(block, name):
+    return [_pool(block, name, "AVERAGE")]
+
+
+def _pool(block, name, kind):
+    def _pair(v):
+        return [int(v), int(v)] if isinstance(v, int) else [int(x) for x in v]
+
+    kw = block._kwargs
+    return {
+        "type": "pooling", "name": name, "poolingType": kind,
+        "kernelSize": _pair(kw["kernel"]),
+        "stride": _pair(kw["stride"]),
+        "padding": _pair(kw["pad"]),
+    }
+
+
+@_register("BatchNorm")
+def _batchnorm(block, name):
+    return [{
+        "type": "batchnorm", "name": name,
+        "channels": int(block.gamma.shape[0]),
+        "gamma": block.gamma.data().asnumpy(),
+        "beta": block.beta.data().asnumpy(),
+        "mean": block.running_mean.data().asnumpy(),
+        "variance": block.running_var.data().asnumpy(),
+        "epsilon": float(block._epsilon),
+    }]
+
+
+@_register("Flatten")
+def _flatten(block, name):
+    return [{"type": "flatten", "name": name, "mode": 0}]
+
+
+@_register("Dropout")
+def _dropout(block, name):
+    return []  # inference graph: dropout is identity
+
+
+class CoreMLModelSpec:
+    """Layer-spec container with the reference CLI's save entry point."""
+
+    def __init__(self, layers, input_shape, class_labels=None):
+        self.layers = layers
+        self.input_shape = tuple(input_shape)
+        self.class_labels = class_labels
+        # wire inputs/outputs as a chain, CoreML-style named blobs
+        names = ["data"] + [l["name"] + "_out" for l in layers]
+        for i, l in enumerate(layers):
+            l["input"], l["output"] = names[i], names[i + 1]
+        if layers:
+            layers[-1]["output"] = "output"
+
+    def validate(self):
+        """Structural checks the reference's unit tests do via coremltools:
+        chained blobs, weight shape consistency."""
+        prev = "data"
+        for l in self.layers:
+            assert l["input"] == prev, (l["name"], l["input"], prev)
+            prev = l["output"]
+            if l["type"] == "innerProduct":
+                assert l["weights"].shape == (l["outputChannels"],
+                                              l["inputChannels"])
+            if l["type"] == "convolution":
+                kh, kw = l["kernelSize"]
+                assert l["weights"].shape == (kh, kw, l["kernelChannels"],
+                                              l["outputChannels"])
+        assert prev == "output" or not self.layers
+        return True
+
+    def save(self, path):
+        """Assemble and write the .mlmodel (needs coremltools, exactly as
+        the reference converter does)."""
+        try:
+            import coremltools  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "coremltools is required to serialize a .mlmodel (the "
+                "reference's tools/coreml has the same dependency); the "
+                "layer specs in .layers are complete — install coremltools "
+                "and re-run save()") from e
+        from coremltools.models import datatypes
+        from coremltools.models.neural_network import NeuralNetworkBuilder
+
+        builder = NeuralNetworkBuilder(
+            [("data", datatypes.Array(*self.input_shape))],
+            [("output", datatypes.Array(1))])
+        for l in self.layers:
+            if l["type"] == "innerProduct":
+                builder.add_inner_product(
+                    l["name"], l["weights"], l["bias"], l["inputChannels"],
+                    l["outputChannels"], l["hasBias"], l["input"], l["output"])
+            elif l["type"] == "convolution":
+                builder.add_convolution(
+                    l["name"], l["kernelChannels"], l["outputChannels"],
+                    l["kernelSize"][0], l["kernelSize"][1],
+                    l["stride"][0], l["stride"][1], "valid", 1,
+                    l["weights"], l["bias"], l["hasBias"],
+                    input_name=l["input"], output_name=l["output"])
+            elif l["type"] == "activation":
+                builder.add_activation(l["name"], l["activation"],
+                                       l["input"], l["output"])
+            elif l["type"] == "pooling":
+                builder.add_pooling(
+                    l["name"], l["kernelSize"][0], l["kernelSize"][1],
+                    l["stride"][0], l["stride"][1], "valid",
+                    l["poolingType"], l["input"], l["output"])
+            elif l["type"] == "batchnorm":
+                builder.add_batchnorm(
+                    l["name"], l["channels"], l["gamma"], l["beta"],
+                    l["mean"], l["variance"], l["input"], l["output"],
+                    epsilon=l["epsilon"])
+            elif l["type"] == "flatten":
+                builder.add_flatten(l["name"], l["mode"], l["input"],
+                                    l["output"])
+        coremltools.models.MLModel(builder.spec).save(path)
+
+
+def convert(net, input_shape, class_labels=None):
+    """Walk a gluon net (HybridSequential or nested blocks) into CoreML
+    layer specs (ref: _mxnet_converter.convert's op walk)."""
+    layers = []
+
+    def walk(block, prefix):
+        cls = type(block).__name__
+        if cls in _REGISTRY:
+            layers.extend(_REGISTRY[cls](block, prefix or cls.lower()))
+            return
+        children = list(getattr(block, "_children", {}).values())
+        if not children:
+            raise ValueError(
+                f"no CoreML translator for block type {cls} "
+                f"(supported: {sorted(_REGISTRY)})")
+        for i, child in enumerate(children):
+            walk(child, f"{prefix}_{i}" if prefix else str(i))
+
+    walk(net, "")
+    return CoreMLModelSpec(layers, input_shape, class_labels)

@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""CLI (ref: tools/coreml/mxnet_coreml_converter.py): convert a saved
+model checkpoint to CoreML.
+
+    python tools/coreml/mxtpu_coreml_converter.py --model-prefix lenet \
+        --epoch 1 --input-shape 1,28,28 --output-file lenet.mlmodel
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from coreml import convert  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-prefix", required=True,
+                    help="gluon .params prefix saved via net.save_parameters")
+    ap.add_argument("--builder", required=True,
+                    help="python module:function returning the uninitialized net")
+    ap.add_argument("--input-shape", required=True,
+                    help="C,H,W (no batch dim)")
+    ap.add_argument("--output-file", required=True)
+    args = ap.parse_args()
+
+    mod_name, fn_name = args.builder.split(":")
+    import importlib
+
+    net = getattr(importlib.import_module(mod_name), fn_name)()
+    net.load_parameters(args.model_prefix)
+    shape = tuple(int(s) for s in args.input_shape.split(","))
+    spec = convert(net, shape)
+    spec.validate()
+    spec.save(args.output_file)
+    print(f"wrote {args.output_file} ({len(spec.layers)} layers)")
+
+
+if __name__ == "__main__":
+    main()
