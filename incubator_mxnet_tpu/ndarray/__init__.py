@@ -148,3 +148,8 @@ def imdecode(buf, flag=1, to_rgb=True, **kwargs):  # pragma: no cover - thin wra
     from ..image import imdecode as _imdecode
 
     return _imdecode(buf, flag=flag, to_rgb=to_rgb)
+
+
+# name-parity re-exports from the sparse module (ref: nd.cast_storage /
+# nd.sparse.retain — sparse-typed ops live outside the dense-array registry)
+from .sparse import cast_storage  # noqa: E402,F401

@@ -7,6 +7,8 @@ formulations that XLA lowers for the MXU/VPU.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -156,3 +158,91 @@ def sync_batch_norm(data, gamma, beta, moving_mean, moving_var, *,
     out = (data - moving_mean.reshape(bshape)) * jax.lax.rsqrt(
         moving_var.reshape(bshape) + eps)
     return out * g.reshape(bshape) + beta.reshape(bshape)
+
+
+# --- round-4 op-gap batch (name-parity tail) -------------------------------
+
+
+@register("_contrib_quadratic", aliases=("_contrib_backward_quadratic",))
+def _contrib_quadratic(data, *, a=0.0, b=0.0, c=0.0):
+    """a*x^2 + b*x + c (ref: src/operator/contrib/quadratic_op.cc — the
+    tutorial op; kept for script parity)."""
+    return a * jnp.square(data) + b * data + c
+
+
+@register("_contrib_div_sqrt_dim")
+def _contrib_div_sqrt_dim(data):
+    """data / sqrt(last_dim) (ref: contrib/transformer.cc
+    _contrib_div_sqrt_dim — the attention-score scaling helper)."""
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], data.dtype))
+
+
+def _grad_mult_fwd(data, scalar):
+    return data, None
+
+
+def _grad_mult_bwd(scalar, _res, g):
+    return (g * scalar,)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _grad_mult(data, scalar):
+    return data
+
+
+_grad_mult.defvjp(_grad_mult_fwd, _grad_mult_bwd)
+
+
+@register("_contrib_gradientmultiplier",
+          aliases=("_contrib_backward_gradientmultiplier",))
+def _contrib_gradientmultiplier(data, *, scalar=1.0):
+    """Identity forward, gradient scaled by `scalar` (ref:
+    contrib/gradient_multiplier_op.cc — e.g. gradient reversal with
+    scalar=-1 for domain adaptation)."""
+    return _grad_mult(data, float(scalar))
+
+
+@register("_contrib_index_copy", aliases=("_contrib_backward_index_copy",),
+          no_grad_inputs=("index",))
+def _contrib_index_copy(old, index, new):
+    """old with new's rows written at `index` along axis 0
+    (ref: contrib/index_copy.cc)."""
+    return old.at[index.astype(jnp.int32)].set(new)
+
+
+@register("_contrib_getnnz")
+def _contrib_getnnz(data, *, axis=None):
+    """Count of non-zero entries (ref: contrib/nnz.cc — CSR nnz; the
+    functional dense form counts exactly)."""
+    return jnp.count_nonzero(data, axis=axis).astype(jnp.int32)
+
+
+def _kl_sparse_fwd(data, sparseness_target, penalty):
+    return data, jnp.mean(jax.nn.sigmoid(data), axis=0)
+
+
+def _kl_sparse_bwd(sparseness_target, penalty, rho_hat, g):
+    # d/da KL(rho || rho_hat(a)) added to the incoming gradient
+    # (ref: identity_attach_KL_sparse_reg-inl.h Backward). The chain
+    # (-rho/rho_hat + (1-rho)/(1-rho_hat)) * rho_hat*(1-rho_hat) simplifies
+    # to rho_hat - rho, which is finite even when the mean activation
+    # saturates to exactly 0 or 1 (the quotient form emits NaN there).
+    return (g + penalty * (rho_hat - sparseness_target),)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _kl_sparse(data, sparseness_target, penalty):
+    return data
+
+
+_kl_sparse.defvjp(_kl_sparse_fwd, _kl_sparse_bwd)
+
+
+@register("IdentityAttachKLSparseReg")
+def identity_attach_kl_sparse_reg(data, *, sparseness_target=0.1,
+                                  penalty=0.001, momentum=0.9):
+    """Identity that attaches a KL sparsity penalty gradient on the mean
+    sigmoid activation (ref: src/operator/identity_attach_KL_sparse_reg.cc;
+    the running-average momentum is subsumed by the per-batch mean in this
+    functional form)."""
+    return _kl_sparse(data, float(sparseness_target), float(penalty))

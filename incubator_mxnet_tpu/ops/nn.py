@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from .registry import register
+from .registry import alias, register
 
 # ---------------------------------------------------------------------------
 # FullyConnected
@@ -901,3 +901,8 @@ def svm_output(data, label, *, margin=1.0, regularization_coefficient=1.0,
     """(ref: src/operator/svm_output.cc:89 SVMOutput registration)."""
     return _svm_output(data, label, float(margin),
                        float(regularization_coefficient), bool(use_linear))
+
+
+# round-4 name-parity aliases
+alias("BatchNorm", "BatchNorm_v1")
+alias("Embedding", "_contrib_SparseEmbedding")

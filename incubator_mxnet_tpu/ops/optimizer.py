@@ -266,3 +266,76 @@ def multi_mp_sgd_mom_update(*args, lrs, wds, num_weights, momentum=0.0,
         ms.append(new_m)
         w32s.append(new_w32)
     return tuple(ws) + tuple(ms) + tuple(w32s)
+
+
+@register("mp_sgd_update", num_outputs=2,
+          no_grad_inputs=("weight", "grad", "weight32"))
+def mp_sgd_update(weight, grad, weight32, *, lr, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=True):
+    """Mixed-precision SGD: math on the fp32 master copy, low-precision
+    weight refreshed by cast (ref: optimizer_op.cc mp_sgd_update)."""
+    new_w32 = sgd_update(weight32, grad.astype(weight32.dtype), lr=lr, wd=wd,
+                         rescale_grad=rescale_grad,
+                         clip_gradient=clip_gradient)
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register("mp_sgd_mom_update", num_outputs=3,
+          no_grad_inputs=("weight", "grad", "mom", "weight32"))
+def mp_sgd_mom_update(weight, grad, mom, weight32, *, lr, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                      lazy_update=True):
+    """(ref: optimizer_op.cc mp_sgd_mom_update)"""
+    new_w32, new_mom = sgd_mom_update(
+        weight32, grad.astype(weight32.dtype), mom, lr=lr, momentum=momentum,
+        wd=wd, rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register("_adamw_update", num_outputs=3,
+          no_grad_inputs=("weight", "grad", "mean", "var", "rescale_grad"))
+def _adamw_update_dyn(weight, grad, mean, var, rescale_grad, *, lr,
+                      beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                      clip_gradient=-1.0):
+    """AdamW whose rescale factor is a TENSOR input: with dynamic loss
+    scaling the scale (1/loss_scale) arrives per step, and a non-finite
+    or zero scale SKIPS the update entirely
+    (ref: src/operator/contrib/adamw.cc _adamw_update)."""
+    scale = jnp.reshape(rescale_grad.astype(jnp.float32), ())
+    ok = jnp.isfinite(scale) & (scale != 0)
+    safe = jnp.where(ok, scale, 1.0)
+    new_w, new_mean, new_var = adamw_update(
+        weight, grad, mean, var, lr=lr, beta1=beta1, beta2=beta2,
+        epsilon=epsilon, wd=wd, eta=eta, rescale_grad=safe,
+        clip_gradient=clip_gradient)
+    keep = lambda new, old: jnp.where(ok, new, old)  # noqa: E731
+    return keep(new_w, weight), keep(new_mean, mean), keep(new_var, var)
+
+
+@register("_mp_adamw_update", num_outputs=4,
+          no_grad_inputs=("weight", "grad", "mean", "var", "weight32",
+                          "rescale_grad"))
+def _mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad, *, lr,
+                     beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                     clip_gradient=-1.0):
+    """(ref: contrib/adamw.cc _mp_adamw_update)"""
+    new_w32, new_mean, new_var = _adamw_update_dyn(
+        weight32, grad.astype(weight32.dtype), mean, var, rescale_grad,
+        lr=lr, beta1=beta1, beta2=beta2, epsilon=epsilon, wd=wd, eta=eta,
+        clip_gradient=clip_gradient)
+    return new_w32.astype(weight.dtype), new_mean, new_var, new_w32
+
+
+@register("_contrib_group_adagrad_update", num_outputs=2,
+          no_grad_inputs=("weight", "grad", "history"))
+def _contrib_group_adagrad_update(weight, grad, history, *, lr,
+                                  rescale_grad=1.0, clip_gradient=-1.0,
+                                  epsilon=1e-5):
+    """Row-wise (grouped) AdaGrad: one accumulator per row
+    (ref: src/operator/contrib/optimizer_op.cc group_adagrad)."""
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    axes = tuple(range(1, g.ndim))
+    new_h = history + jnp.mean(jnp.square(g), axis=axes, keepdims=True) \
+        if g.ndim > 1 else history + jnp.square(g)
+    denom = jnp.sqrt(new_h) + epsilon
+    return weight - lr * g / denom, new_h

@@ -87,3 +87,94 @@ def quantized_pooling(data, *, kernel=None, stride=None, pad=None,
     for ki in k:
         count *= ki
     return jnp.clip(jnp.round(acc / count), -128, 127).astype(jnp.int8)
+
+
+# --- quantize/dequantize wire ops (ref: quantization/quantize.cc,
+# quantize_v2.cc, dequantize.cc, requantize.cc, quantized_concat.cc,
+# quantized_flatten.cc) -----------------------------------------------------
+
+
+def _q_range(min_r, max_r):
+    """Symmetric scale for int8 from a calibration range."""
+    amax = jnp.maximum(jnp.abs(min_r), jnp.abs(max_r))
+    return jnp.where(amax > 0, 127.0 / amax, 1.0)
+
+
+@register("_contrib_quantize", num_outputs=3,
+          no_grad_inputs=("data", "min_range", "max_range"))
+def _contrib_quantize(data, min_range, max_range, *, out_type="int8"):
+    """fp32 -> int8 with explicit calibration range tensors; returns
+    (q, min, max) like the reference."""
+    scale = _q_range(min_range, max_range)
+    q = jnp.clip(jnp.rint(data * scale), -127, 127).astype(jnp.int8)
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    return q, -amax, amax
+
+
+@register("_contrib_quantize_v2", num_outputs=3, no_grad_inputs=("data",))
+def _contrib_quantize_v2(data, *, out_type="int8", min_calib_range=None,
+                         max_calib_range=None):
+    """Range from attrs when calibrated, else from the data
+    (ref: quantize_v2.cc)."""
+    if min_calib_range is not None and max_calib_range is not None:
+        lo = jnp.asarray(min_calib_range, jnp.float32)
+        hi = jnp.asarray(max_calib_range, jnp.float32)
+    else:
+        lo, hi = data.min(), data.max()
+    scale = _q_range(lo, hi)
+    q = jnp.clip(jnp.rint(data * scale), -127, 127).astype(jnp.int8)
+    amax = jnp.maximum(jnp.abs(lo), jnp.abs(hi))
+    return q, -amax, amax
+
+
+@register("_contrib_dequantize",
+          no_grad_inputs=("data", "min_range", "max_range"))
+def _contrib_dequantize(data, min_range, max_range, *, out_type="float32"):
+    scale = _q_range(min_range, max_range)
+    return data.astype(jnp.float32) / scale
+
+
+@register("_contrib_requantize", num_outputs=3,
+          no_grad_inputs=("data", "min_range", "max_range"))
+def _contrib_requantize(data, min_range, max_range, *, min_calib_range=None,
+                        max_calib_range=None, out_type="int8"):
+    """int32 accumulator -> int8 (ref: requantize.cc). The int32 range
+    tensors describe the REAL values of the accumulator's int32 extremes,
+    so the reconstruction scale is amax/(2^31-1), not the int8 127."""
+    amax32 = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    real = data.astype(jnp.float32) * (amax32 / 2147483647.0)
+    if min_calib_range is not None and max_calib_range is not None:
+        lo = jnp.asarray(min_calib_range, jnp.float32)
+        hi = jnp.asarray(max_calib_range, jnp.float32)
+    else:
+        lo, hi = real.min(), real.max()
+    scale = _q_range(lo, hi)
+    q = jnp.clip(jnp.rint(real * scale), -127, 127).astype(jnp.int8)
+    amax = jnp.maximum(jnp.abs(lo), jnp.abs(hi))
+    return q, -amax, amax
+
+
+@register("_contrib_quantized_flatten", num_outputs=3,
+          no_grad_inputs=("data", "min_range", "max_range"))
+def _contrib_quantized_flatten(data, min_range, max_range):
+    return data.reshape(data.shape[0], -1), min_range, max_range
+
+
+@register("_contrib_quantized_concat", num_outputs=3)
+def _contrib_quantized_concat(*args, num_args=None, dim=1):
+    """Concat n int8 tensors whose ranges may differ: requantize each onto
+    the merged range, then concat (ref: quantized_concat.cc). Inputs are
+    (data_0..n-1, min_0..n-1, max_0..n-1)."""
+    n = int(num_args) if num_args else len(args) // 3
+    datas, mins, maxs = args[:n], args[n:2 * n], args[2 * n:3 * n]
+    amaxs = [jnp.maximum(jnp.abs(lo), jnp.abs(hi))
+             for lo, hi in zip(mins, maxs)]
+    merged = amaxs[0]
+    for a in amaxs[1:]:
+        merged = jnp.maximum(merged, a)
+    scaled = [
+        jnp.clip(jnp.rint(d.astype(jnp.float32) * (a / merged)), -127, 127
+                 ).astype(jnp.int8)
+        for d, a in zip(datas, amaxs)
+    ]
+    return jnp.concatenate(scaled, axis=int(dim)), -merged, merged

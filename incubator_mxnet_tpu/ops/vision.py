@@ -776,3 +776,48 @@ def proposal(cls_prob, bbox_pred, im_info, *, rpn_pre_nms_top_n=6000,
     if output_score:
         return rois, scores.reshape(-1, 1)
     return rois
+
+
+# --- nd.image.* op names (ref: src/operator/image/image_random.cc +
+# image_resize.cc — the _image_* registry spellings) ------------------------
+
+
+@register("_image_to_tensor")
+def _image_to_tensor(data):
+    """HWC (or NHWC) [0,255] -> CHW (NCHW) float32 [0,1]
+    (ref: image_random.cc ToTensor)."""
+    x = data.astype(jnp.float32) / 255.0
+    if x.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@register("_image_normalize")
+def _image_normalize(data, *, mean=(0.0,), std=(1.0,)):
+    """Channel-wise (x - mean) / std on CHW/NCHW floats
+    (ref: image_random.cc Normalize)."""
+    c_axis = 0 if data.ndim == 3 else 1
+    shape = [1] * data.ndim
+    shape[c_axis] = -1
+    m = jnp.asarray(mean, data.dtype).reshape(shape)
+    s = jnp.asarray(std, data.dtype).reshape(shape)
+    return (data - m) / s
+
+
+@register("_image_resize")
+def _image_resize(data, *, size, keep_ratio=False, interp=1):
+    """Bilinear/nearest resize of HWC or NHWC images
+    (ref: image_resize.cc Resize)."""
+    method = "nearest" if interp == 0 else "bilinear"
+    if isinstance(size, int):
+        size = (size, size)
+    w, h = int(size[0]), int(size[1])
+    ih, iw = (data.shape[0], data.shape[1]) if data.ndim == 3 \
+        else (data.shape[1], data.shape[2])
+    if keep_ratio:
+        scale = min(h / ih, w / iw)
+        h, w = int(ih * scale), int(iw * scale)
+    if data.ndim == 3:
+        return jax.image.resize(data, (h, w, data.shape[2]), method)
+    return jax.image.resize(data, (data.shape[0], h, w, data.shape[3]),
+                            method)

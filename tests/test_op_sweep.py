@@ -94,6 +94,12 @@ GRAD_SKIP = {
     # different; the custom backward is pinned in tests/test_operator.py
     "SoftmaxOutput", "LinearRegressionOutput", "LogisticRegressionOutput",
     "MAERegressionOutput", "SVMOutput",
+    # custom backward != vjp of the identity forward
+    "IdentityAttachKLSparseReg",
+    # discrete/integer-valued outputs
+    "_contrib_bipartite_matching", "_contrib_getnnz",
+    # range tensors shift int8 rounding discretely
+    "_contrib_quantized_concat",
     # discrete bin/cell assignment: gradient exists a.e. but FD straddles
     # bin boundaries at any eps
     "ROIPooling", "BilinearSampler", "SpatialTransformer",
@@ -106,6 +112,10 @@ GRAD_SKIP = {
 # bf16 consistency skipped where bf16 either over/underflows trivially or
 # the op is integer/indexing-valued so "consistency" is exact-match anyway
 BF16_SKIP = GRAD_SKIP | {
+    # int8 rounding boundaries flip under bf16 inputs
+    "_contrib_quantize", "_contrib_quantize_v2", "_contrib_requantize",
+    "_contrib_dequantize", "_contrib_quantized_concat",
+    "_contrib_quantized_flatten",
     "gamma", "gammaln", "digamma", "erfinv", "_hypot",
     "_contrib_hawkesll", "CTCLoss", "_linalg_potrf", "_linalg_potri",
     "_linalg_trsm", "_linalg_trmm", "_linalg_gelqf", "_linalg_syrk",
@@ -171,7 +181,9 @@ SPECS = {
                                dict(num_hidden=3)),
     "Convolution": _conv_spec,
     "Deconvolution": _deconv_spec,
-    "Pooling": lambda: ((_rand((2, 3, 6, 6)),),
+    # wide value range: max-pool FD straddles window ties when entries are
+    # within 2h of each other
+    "Pooling": lambda: ((_rand((2, 3, 6, 6), -8.0, 8.0),),
                         dict(kernel=(2, 2), stride=(2, 2), pool_type="max")),
     "softmax": lambda: ((_rand((3, 5)),), dict(axis=-1)),
     "log_softmax": lambda: ((_rand((3, 5)),), dict(axis=-1)),
@@ -194,6 +206,62 @@ SPECS = {
     # optimizer update ops
     "SVMOutput": lambda: ((_rand((3, 4)), jnp.asarray([0.0, 2.0, 1.0])),
                           {}),
+    # round-4 name-parity tail
+    "_arange": lambda: ((), dict(start=0.0, stop=6.0)),
+    "_eye": lambda: ((), dict(N=3)),
+    "_full": lambda: ((), dict(shape=(2, 3), value=1.5)),
+    "_ones": lambda: ((), dict(shape=(2, 3))),
+    "_zeros": lambda: ((), dict(shape=(2, 3))),
+    "_slice_assign": lambda: ((_rand((4, 4)), _rand((2, 4))),
+                              dict(begin=(1, 0), end=(3, 4))),
+    "_slice_assign_scalar": lambda: ((_rand((4, 4)),),
+                                     dict(scalar=0.5, begin=(1, 0),
+                                          end=(3, 4))),
+    "_scatter_set_nd": lambda: ((_rand((4, 3)), _rand((2, 3)),
+                                 jnp.asarray([[0, 2]], jnp.int32)), {}),
+    "_contrib_bipartite_matching": lambda: ((_rand((3, 4)),),
+                                            dict(threshold=0.05)),
+    "_contrib_getnnz": lambda: ((_rand((3, 4)),), {}),
+    "_contrib_group_adagrad_update": lambda: (
+        (_rand((4, 3)), _rand((4, 3)), _rand((4, 1), 0.1, 1.0)),
+        dict(lr=0.1)),
+    "mp_sgd_update": lambda: ((_rand((3, 2)), _rand((3, 2)), _rand((3, 2))),
+                              dict(lr=0.1)),
+    "mp_sgd_mom_update": lambda: (
+        (_rand((3, 2)), _rand((3, 2)), _rand((3, 2)), _rand((3, 2))),
+        dict(lr=0.1, momentum=0.9)),
+    "_adamw_update": lambda: (
+        (_rand((3, 2)), _rand((3, 2)), _rand((3, 2)), _rand((3, 2), 0.1, 1.0),
+         jnp.asarray(1.0)), dict(lr=0.01)),
+    "_mp_adamw_update": lambda: (
+        (_rand((3, 2)), _rand((3, 2)), _rand((3, 2)), _rand((3, 2), 0.1, 1.0),
+         _rand((3, 2)), jnp.asarray(1.0)), dict(lr=0.01)),
+    "_contrib_quantize": lambda: (
+        (_rand((3, 4), -1.0, 1.0), jnp.asarray(-1.0), jnp.asarray(1.0)), {}),
+    "_contrib_quantize_v2": lambda: ((_rand((3, 4), -1.0, 1.0),), {}),
+    "_contrib_dequantize": lambda: (
+        (jnp.asarray(np.random.RandomState(3).randint(-127, 127, (3, 4)),
+                     jnp.int8), jnp.asarray(-1.0), jnp.asarray(1.0)), {}),
+    "_contrib_requantize": lambda: (
+        (jnp.asarray(np.random.RandomState(4).randint(-1000, 1000, (3, 4)),
+                     jnp.int32), jnp.asarray(-2000.0), jnp.asarray(2000.0)),
+        {}),
+    "_contrib_quantized_flatten": lambda: (
+        (jnp.asarray(np.random.RandomState(5).randint(-127, 127, (2, 3, 4)),
+                     jnp.int8), jnp.asarray(-1.0), jnp.asarray(1.0)), {}),
+    "_contrib_quantized_concat": lambda: (
+        (jnp.asarray(np.random.RandomState(6).randint(-127, 127, (2, 3)),
+                     jnp.int8),
+         jnp.asarray(np.random.RandomState(7).randint(-127, 127, (2, 3)),
+                     jnp.int8),
+         jnp.asarray(-1.0), jnp.asarray(-0.5),
+         jnp.asarray(1.0), jnp.asarray(0.5)),
+        dict(num_args=2, dim=0)),
+    "_image_resize": lambda: ((_rand((5, 6, 3)),), dict(size=(4, 4))),
+    "_image_to_tensor": lambda: ((_rand((5, 6, 3), 0.0, 255.0),), {}),
+    "_image_normalize": lambda: ((_rand((3, 4, 4)),),
+                                 dict(mean=(0.5, 0.5, 0.5),
+                                      std=(0.2, 0.2, 0.2))),
     "im2col": lambda: ((_rand((2, 3, 6, 6)),),
                        dict(kernel=(3, 3), stride=(1, 1), pad=(1, 1))),
     "col2im": lambda: ((_rand((2, 27, 36)),),
@@ -405,6 +473,12 @@ SPECS.update(INT_SECOND_INPUT)
 
 
 def _spec_for(op):
+    # reseed the shared stream per op: inputs must not depend on how many
+    # OTHER specs ran first (adding a spec once flipped Pooling's max-pool
+    # FD check by moving it onto a tie)
+    import binascii
+
+    RNG.seed(binascii.crc32(op.name.encode()) & 0xFFFF)
     if op.name in SPECS:
         return SPECS[op.name]()
     return _generic_spec(op)
