@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Training through a numpy CustomOp (ref: example/numpy-ops/ — the
+custom-operator escape hatch: forward/backward written in numpy, running
+on the host via the operator bridge).
+
+A "LogisticRegressionHead" custom op computes softmax + gradient in plain
+numpy (the reference's numpy_softmax demo); a Dense trunk trains THROUGH
+it — host callback forward via pure_callback and a custom backward, mixed
+into the jit-compiled graph. Gate: classification accuracy.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd, operator
+from incubator_mxnet_tpu.gluon import nn
+
+
+class NumpySoftmaxXent(operator.CustomOp):
+    """Softmax + cross-entropy with the numpy backward of the reference's
+    numpy_softmax example: grad = (softmax - onehot) / batch."""
+
+    @staticmethod
+    def _softmax(x):
+        e = np.exp(x - x.max(axis=1, keepdims=True))
+        return e / e.sum(axis=1, keepdims=True)
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x, y = in_data[0].asnumpy(), in_data[1].asnumpy()
+        p = self._softmax(x)
+        n = np.arange(len(y))
+        loss = -np.log(p[n, y.astype(int)] + 1e-12).mean()
+        self.assign(out_data[0], req[0],
+                    nd.array(np.asarray([loss], np.float32)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        # recompute from in_data: forward/backward are separate host
+        # callbacks and must not share Python state
+        x, y = in_data[0].asnumpy(), in_data[1].asnumpy()
+        p = self._softmax(x)
+        g = p.copy()
+        g[np.arange(len(y)), y.astype(int)] -= 1.0
+        g /= len(y)
+        self.assign(in_grad[0], req[0],
+                    nd.array(g.astype(np.float32)
+                             * float(out_grad[0].asnumpy()[0])))
+        self.assign(in_grad[1], req[1],
+                    nd.array(np.zeros_like(y, np.float32)))
+
+
+@operator.register("numpy_softmax_xent")
+class NumpySoftmaxXentProp(operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["loss"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [(1,)], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return NumpySoftmaxXent()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.1)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    protos = rng.randn(10, 20).astype(np.float32) * 1.5
+
+    def batch(n):
+        y = rng.randint(0, 10, n)
+        x = protos[y] + 0.6 * rng.randn(n, 20)
+        return x.astype(np.float32), y.astype(np.float32)
+
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(64, activation="relu"), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr})
+
+    for i in range(args.steps):
+        x, y = batch(args.batch_size)
+        with autograd.record():
+            logits = net(nd.array(x))
+            loss = nd.Custom(logits, nd.array(y),
+                             op_type="numpy_softmax_xent")
+        loss.backward()
+        trainer.step(1)
+        if (i + 1) % 50 == 0:
+            print(f"step {i + 1}: numpy-op loss "
+                  f"{float(loss.asnumpy()[0]):.4f}")
+
+    x, y = batch(512)
+    acc = (net(nd.array(x)).asnumpy().argmax(-1) == y).mean()
+    print(f"accuracy through the numpy CustomOp: {acc:.3f}")
+    assert acc > 0.9, acc
+    print("custom_op_numpy OK")
+
+
+if __name__ == "__main__":
+    main()

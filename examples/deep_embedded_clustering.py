@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Deep Embedded Clustering (ref: example/deep-embedded-clustering/ —
+Xie et al.: autoencoder pretraining, then KL-refinement of soft cluster
+assignments in latent space).
+
+Phase 1 pretrains a small autoencoder on synthetic clustered data; phase 2
+initializes centroids from latent k-means and minimizes
+KL(P || Q) where Q is the Student-t soft assignment and P the sharpened
+target distribution. Gate: cluster purity vs the generating labels.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, fused, gluon, nd
+from incubator_mxnet_tpu.gluon import nn
+
+K, DIM, LATENT = 4, 32, 5
+
+
+class AutoEncoder(gluon.block.HybridBlock):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.enc = nn.HybridSequential()
+            self.enc.add(nn.Dense(64, activation="relu"), nn.Dense(LATENT))
+            self.dec = nn.HybridSequential()
+            self.dec.add(nn.Dense(64, activation="relu"), nn.Dense(DIM))
+
+    def hybrid_forward(self, F, x):
+        return self.dec(self.enc(x))
+
+
+def kmeans(z, k, rng, iters=20):
+    cent = z[rng.choice(len(z), k, replace=False)]
+    for _ in range(iters):
+        d = ((z[:, None, :] - cent[None]) ** 2).sum(-1)
+        assign = d.argmin(1)
+        for j in range(k):
+            if (assign == j).any():
+                cent[j] = z[assign == j].mean(0)
+    return cent
+
+
+def soft_assign(z, cent):
+    """Student-t kernel Q (DEC eq. 1)."""
+    d2 = ((z[:, None, :] - cent[None]) ** 2).sum(-1)
+    q = 1.0 / (1.0 + d2)
+    return q / q.sum(1, keepdims=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pretrain-steps", type=int, default=300)
+    ap.add_argument("--refine-steps", type=int, default=100)
+    ap.add_argument("--batch-size", type=int, default=128)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    protos = rng.randn(K, DIM).astype(np.float32) * 2.0
+    labels = rng.randint(0, K, 1024)
+    data = (protos[labels] + 0.7 * rng.randn(1024, DIM)).astype(np.float32)
+
+    mx.random.seed(0)
+    ae = AutoEncoder()
+    ae.initialize(mx.init.Xavier())
+    L2 = gluon.loss.L2Loss()
+    step = fused.GluonTrainStep(
+        ae, lambda n, x, y: L2(n(x), y), mx.optimizer.Adam(learning_rate=2e-3))
+    for i in range(args.pretrain_steps):
+        idx = rng.choice(len(data), args.batch_size)
+        x = nd.array(data[idx])
+        loss = step(x, x)
+    step.sync_params()
+    print(f"pretrain recon loss {float(loss.asscalar()):.4f}")
+
+    # phase 2: centroids from latent k-means, then KL refinement of the
+    # ENCODER (decoder frozen out of the objective)
+    z = ae.enc(nd.array(data)).asnumpy()
+    centroids = nd.array(kmeans(z.copy(), K, rng))
+    centroids.attach_grad()
+    params = [p for _, p in ae.enc.collect_params().items()]
+    for p in params:
+        p.data().attach_grad()
+    opt = mx.optimizer.Adam(learning_rate=1e-3)
+    states = {}
+    for i in range(args.refine_steps):
+        idx = rng.choice(len(data), args.batch_size)
+        x = nd.array(data[idx])
+        with autograd.record():
+            zb = ae.enc(x)
+            d2 = ((zb.expand_dims(1) - centroids.expand_dims(0)) ** 2).sum(-1)
+            q = 1.0 / (1.0 + d2)
+            q = q / q.sum(axis=1, keepdims=True)
+            qn = q.asnumpy()
+            p_t = (qn ** 2) / qn.sum(0, keepdims=True)
+            p_t = nd.array(p_t / p_t.sum(1, keepdims=True))
+            kl = (p_t * (nd.log(p_t + 1e-9) - nd.log(q + 1e-9))).sum(axis=1)
+            loss = kl.mean()
+        loss.backward()
+        for j, arr in enumerate([centroids] + [p.data() for p in params]):
+            if j not in states:
+                states[j] = opt.create_state(j, arr)
+            opt.update(j, arr, arr.grad, states[j])
+            arr.grad[:] = 0
+
+    z = ae.enc(nd.array(data)).asnumpy()
+    assign = soft_assign(z, centroids.asnumpy()).argmax(1)
+    purity = sum(np.bincount(labels[assign == j]).max()
+                 for j in range(K) if (assign == j).any()) / len(labels)
+    print(f"cluster purity {purity:.3f} (chance ~{1 / K:.2f})")
+    assert purity > 0.85, purity
+    print("deep_embedded_clustering OK")
+
+
+if __name__ == "__main__":
+    main()

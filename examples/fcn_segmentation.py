@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Fully-convolutional semantic segmentation (ref: example/fcn-xs/ — FCN
+with a learned upsampling head and per-pixel softmax).
+
+Synthetic scenes: colored rectangles on textured background, 4 classes.
+Conv encoder downsamples 4x, a Deconvolution (transposed conv) head
+upsamples back to full resolution — the FCN-32s pattern at toy scale.
+Gate: mean IoU over classes on held-out scenes.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import fused, gluon, nd
+from incubator_mxnet_tpu.gluon import nn
+
+N_CLASS = 4
+
+
+class FCN(gluon.block.HybridBlock):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.enc = nn.HybridSequential()
+            self.enc.add(nn.Conv2D(16, 3, padding=1, activation="relu"),
+                         nn.MaxPool2D(2),
+                         nn.Conv2D(32, 3, padding=1, activation="relu"),
+                         nn.MaxPool2D(2),
+                         nn.Conv2D(32, 3, padding=1, activation="relu"))
+            self.score = nn.Conv2D(N_CLASS, 1)
+            # learned 4x upsampling (the FCN deconv head)
+            self.up = nn.Conv2DTranspose(N_CLASS, 8, strides=4, padding=2)
+
+    def hybrid_forward(self, F, x):
+        return self.up(self.score(self.enc(x)))
+
+
+def make_scene(rng, size=32):
+    img = 0.1 * rng.rand(3, size, size).astype(np.float32)
+    seg = np.zeros((size, size), np.float32)  # class 0 = background
+    for cls in (1, 2, 3):
+        w, h = rng.randint(6, 14, 2)
+        x0, y0 = rng.randint(0, size - w), rng.randint(0, size - h)
+        color = np.array([cls == 1, cls == 2, cls == 3],
+                         np.float32).reshape(3, 1, 1)
+        img[:, y0:y0 + h, x0:x0 + w] = color + 0.15 * rng.rand(3, h, w)
+        seg[y0:y0 + h, x0:x0 + w] = cls
+    return img, seg
+
+
+def batch(rng, n):
+    xs, ys = zip(*(make_scene(rng) for _ in range(n)))
+    return np.stack(xs), np.stack(ys)
+
+
+def miou(pred, gold):
+    ious = []
+    for c in range(N_CLASS):
+        inter = ((pred == c) & (gold == c)).sum()
+        union = ((pred == c) | (gold == c)).sum()
+        if union:
+            ious.append(inter / union)
+    return float(np.mean(ious))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=250)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=2e-3)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    mx.random.seed(0)
+    net = FCN()
+    net.initialize(mx.init.Xavier())
+    L = gluon.loss.SoftmaxCrossEntropyLoss(axis=1)
+
+    opt = mx.optimizer.Adam(learning_rate=args.lr)
+    step = fused.GluonTrainStep(net, lambda n, x, y: L(n(x), y), opt)
+
+    for i in range(args.steps):
+        x, y = batch(rng, args.batch_size)
+        loss = step(nd.array(x), nd.array(y))
+        if (i + 1) % 50 == 0:
+            print(f"step {i + 1}: pixel xent {float(loss.asscalar()):.4f}")
+    step.sync_params()
+
+    x, y = batch(rng, 64)
+    pred = net(nd.array(x)).asnumpy().argmax(axis=1)
+    score = miou(pred, y)
+    print(f"mean IoU {score:.3f} over {N_CLASS} classes")
+    assert score > 0.6, score
+    print("fcn_segmentation OK")
+
+
+if __name__ == "__main__":
+    main()

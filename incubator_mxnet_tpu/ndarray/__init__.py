@@ -153,3 +153,12 @@ def imdecode(buf, flag=1, to_rgb=True, **kwargs):  # pragma: no cover - thin wra
 # name-parity re-exports from the sparse module (ref: nd.cast_storage /
 # nd.sparse.retain — sparse-typed ops live outside the dense-array registry)
 from .sparse import cast_storage  # noqa: E402,F401
+
+
+def Custom(*inputs, op_type=None, **kwargs):
+    """nd.Custom(data, ..., op_type='my_op') — always present, like the
+    reference's Custom op; dispatches to registered CustomOpProps
+    (ref: src/operator/custom/custom.cc)."""
+    from ..operator import Custom as _dispatch
+
+    return _dispatch(*inputs, op_type=op_type, **kwargs)

@@ -139,4 +139,21 @@ def _register_custom_as_op(reg_name, prop_cls):
     from . import ndarray as nd_mod
 
     setattr(nd_mod, f"Custom_{reg_name}", call)
+    _CUSTOM_CALLS[reg_name] = call
     return call
+
+
+_CUSTOM_CALLS: dict = {}
+
+
+def Custom(*inputs, op_type=None, **kwargs):
+    """MXNet-parity dispatcher: nd.Custom(data, ..., op_type='my_op')
+    (ref: the Custom op in src/operator/custom/custom.cc — scripts select
+    the registered prop by the op_type attr)."""
+    if op_type is None:
+        raise TypeError("nd.Custom requires op_type=<registered name>")
+    if op_type not in _CUSTOM_CALLS:
+        raise KeyError(
+            f"no custom op '{op_type}' registered "
+            f"(have: {sorted(_CUSTOM_CALLS)})")
+    return _CUSTOM_CALLS[op_type](*inputs, **kwargs)

@@ -809,14 +809,21 @@ def _image_resize(data, *, size, keep_ratio=False, interp=1):
     """Bilinear/nearest resize of HWC or NHWC images
     (ref: image_resize.cc Resize)."""
     method = "nearest" if interp == 0 else "bilinear"
-    if isinstance(size, int):
-        size = (size, size)
-    w, h = int(size[0]), int(size[1])
     ih, iw = (data.shape[0], data.shape[1]) if data.ndim == 3 \
         else (data.shape[1], data.shape[2])
-    if keep_ratio:
-        scale = min(h / ih, w / iw)
-        h, w = int(ih * scale), int(iw * scale)
+    if isinstance(size, int):
+        if keep_ratio:
+            # MXNet semantics (resize-inl.h): int size + keep_ratio fits
+            # the SHORT edge to `size`, long edge keeps the aspect ratio
+            if ih > iw:
+                w, h = size, int(ih * size / iw)
+            else:
+                w, h = int(iw * size / ih), size
+        else:
+            w = h = size
+    else:
+        # tuple size is exact; MXNet ignores keep_ratio here
+        w, h = int(size[0]), int(size[1])
     if data.ndim == 3:
         return jax.image.resize(data, (h, w, data.shape[2]), method)
     return jax.image.resize(data, (data.shape[0], h, w, data.shape[3]),

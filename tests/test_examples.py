@@ -129,3 +129,33 @@ def test_capsnet():
 def test_bayes_by_backprop():
     log = _run("bayes_by_backprop.py", "--steps", "600", timeout=500)
     assert "bayes_by_backprop OK" in log
+
+
+def test_fcn_segmentation():
+    log = _run("fcn_segmentation.py", "--steps", "200")
+    assert "fcn_segmentation OK" in log
+
+
+def test_captcha_multidigit():
+    log = _run("captcha_multidigit.py", "--steps", "250")
+    assert "captcha_multidigit OK" in log
+
+
+def test_deep_embedded_clustering():
+    log = _run("deep_embedded_clustering.py")
+    assert "deep_embedded_clustering OK" in log
+
+
+def test_rbm():
+    log = _run("rbm_mnist.py", "--steps", "300")
+    assert "rbm OK" in log
+
+
+def test_time_series_forecast():
+    log = _run("time_series_forecast.py", "--steps", "300", timeout=500)
+    assert "time_series_forecast OK" in log
+
+
+def test_custom_op_numpy():
+    log = _run("custom_op_numpy.py", "--steps", "200")
+    assert "custom_op_numpy OK" in log
