@@ -1,0 +1,142 @@
+"""Convolutional recurrent cells (ref:
+python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py — ConvRNN/ConvLSTM/ConvGRU
+in 1/2/3 spatial dims, Shi et al. 2015).
+
+One base implements all nine public cells: the gate pre-activations are
+input and state convolutions (`nd.Convolution`, which lowers to a single
+XLA conv HLO — the MXU path), and the mode picks the recurrence math.
+Spatial dims are preserved: the i2h padding is caller-chosen and the h2h
+kernel must be odd (implied same-padding), as in the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .... import initializer as init_mod
+from .... import ndarray as nd
+from ...rnn.rnn_cell import RecurrentCell
+
+__all__ = [
+    "Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+    "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+    "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell",
+]
+
+_GATES = {"rnn": 1, "lstm": 4, "gru": 3}
+
+
+def _tup(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _ConvRNNCellBase(RecurrentCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 mode, dims, i2h_pad=0, activation="tanh", prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._input_shape = tuple(input_shape)  # (C, *spatial)
+        self._hc = hidden_channels
+        self._dims = dims
+        self._mode = mode
+        self._act = activation
+        self._i2h_kernel = _tup(i2h_kernel, dims)
+        self._h2h_kernel = _tup(h2h_kernel, dims)
+        if any(k % 2 == 0 for k in self._h2h_kernel):
+            raise ValueError(
+                f"h2h_kernel {self._h2h_kernel} must be odd so the hidden "
+                f"state keeps its spatial shape (same as the reference)")
+        self._i2h_pad = _tup(i2h_pad, dims)
+        self._h2h_pad = tuple(k // 2 for k in self._h2h_kernel)
+        # spatial shape after the input conv (stride 1, dilation 1)
+        self._spatial = tuple(
+            s + 2 * p - k + 1
+            for s, k, p in zip(self._input_shape[1:], self._i2h_kernel,
+                               self._i2h_pad))
+        g = _GATES[mode]
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight",
+                shape=(g * hidden_channels, self._input_shape[0])
+                + self._i2h_kernel)
+            self.h2h_weight = self.params.get(
+                "h2h_weight",
+                shape=(g * hidden_channels, hidden_channels)
+                + self._h2h_kernel)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(g * hidden_channels,),
+                init=init_mod.Zero())
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(g * hidden_channels,),
+                init=init_mod.Zero())
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size, self._hc) + self._spatial
+        n_states = 2 if self._mode == "lstm" else 1
+        return [{"shape": shape} for _ in range(n_states)]
+
+    def _convs(self, inputs, h):
+        g = _GATES[self._mode] * self._hc
+        pre_i = nd.Convolution(
+            inputs, self.i2h_weight.data(), self.i2h_bias.data(),
+            kernel=self._i2h_kernel, pad=self._i2h_pad, num_filter=g)
+        pre_h = nd.Convolution(
+            h, self.h2h_weight.data(), self.h2h_bias.data(),
+            kernel=self._h2h_kernel, pad=self._h2h_pad, num_filter=g)
+        return pre_i, pre_h
+
+    def _activate(self, x):
+        return getattr(nd, self._act)(x)
+
+    def hybrid_forward(self, F, inputs, states, **kwargs):
+        if self._mode == "rnn":
+            pre_i, pre_h = self._convs(inputs, states[0])
+            h_new = self._activate(pre_i + pre_h)
+            return h_new, [h_new]
+        if self._mode == "lstm":
+            h, c = states
+            pre_i, pre_h = self._convs(inputs, h)
+            gates = pre_i + pre_h
+            i, f, g, o = nd.split(gates, num_outputs=4, axis=1)
+            c_new = nd.sigmoid(f) * c + nd.sigmoid(i) * self._activate(g)
+            h_new = nd.sigmoid(o) * self._activate(c_new)
+            return h_new, [h_new, c_new]
+        # gru
+        h = states[0]
+        pre_i, pre_h = self._convs(inputs, h)
+        ir, iz, inew = nd.split(pre_i, num_outputs=3, axis=1)
+        hr, hz, hnew = nd.split(pre_h, num_outputs=3, axis=1)
+        r = nd.sigmoid(ir + hr)
+        z = nd.sigmoid(iz + hz)
+        n = self._activate(inew + r * hnew)
+        h_new = (1 - z) * n + z * h
+        return h_new, [h_new]
+
+
+def _make(mode, dims):
+    gate_doc = {"rnn": "ConvRNN", "lstm": "ConvLSTM", "gru": "ConvGRU"}
+
+    class Cell(_ConvRNNCellBase):
+        def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                     h2h_kernel, i2h_pad=0, activation="tanh", prefix=None,
+                     params=None):
+            super().__init__(input_shape, hidden_channels, i2h_kernel,
+                             h2h_kernel, mode, dims, i2h_pad=i2h_pad,
+                             activation=activation, prefix=prefix,
+                             params=params)
+
+    Cell.__name__ = f"Conv{dims}D{gate_doc[mode][4:]}Cell"
+    Cell.__qualname__ = Cell.__name__
+    Cell.__doc__ = (f"{dims}-D {gate_doc[mode]} cell "
+                    f"(ref: conv_rnn_cell.py {Cell.__name__}).")
+    return Cell
+
+
+Conv1DRNNCell = _make("rnn", 1)
+Conv2DRNNCell = _make("rnn", 2)
+Conv3DRNNCell = _make("rnn", 3)
+Conv1DLSTMCell = _make("lstm", 1)
+Conv2DLSTMCell = _make("lstm", 2)
+Conv3DLSTMCell = _make("lstm", 3)
+Conv1DGRUCell = _make("gru", 1)
+Conv2DGRUCell = _make("gru", 2)
+Conv3DGRUCell = _make("gru", 3)

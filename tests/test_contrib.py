@@ -448,3 +448,85 @@ def test_interval_sampler_rejects_nonpositive():
         IntervalSampler(13, 0)
     with pytest.raises(ValueError):
         IntervalSampler(13, -1)
+
+
+# ---------------------------------------------------------------------------
+# conv RNN cells (ref: gluon/contrib/rnn/conv_rnn_cell.py)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("cls,n_states", [
+    ("Conv2DRNNCell", 1), ("Conv2DLSTMCell", 2), ("Conv2DGRUCell", 1)])
+def test_conv_rnn_cells_unroll_shapes(cls, n_states):
+    from incubator_mxnet_tpu.gluon.contrib import rnn as crnn
+
+    cell = getattr(crnn, cls)(input_shape=(3, 8, 8), hidden_channels=6,
+                              i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(0).rand(2, 5, 3, 8, 8)
+                 .astype(np.float32))
+    outputs, states = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 5, 6, 8, 8)
+    assert len(states) == n_states
+    assert states[0].shape == (2, 6, 8, 8)
+
+
+def test_conv_rnn_1d_3d_and_even_kernel_rejected():
+    from incubator_mxnet_tpu.gluon.contrib import rnn as crnn
+
+    c1 = crnn.Conv1DLSTMCell(input_shape=(2, 10), hidden_channels=4,
+                             i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    c1.initialize(mx.init.Xavier())
+    out, st = c1(nd.array(np.zeros((2, 2, 10), np.float32)),
+                 c1.begin_state(2))
+    assert out.shape == (2, 4, 10)
+    c3 = crnn.Conv3DGRUCell(input_shape=(1, 4, 4, 4), hidden_channels=2,
+                            i2h_kernel=1, h2h_kernel=1)
+    c3.initialize(mx.init.Xavier())
+    out, _ = c3(nd.array(np.zeros((1, 1, 4, 4, 4), np.float32)),
+                c3.begin_state(1))
+    assert out.shape == (1, 2, 4, 4, 4)
+    with pytest.raises(ValueError, match="odd"):
+        crnn.Conv2DRNNCell(input_shape=(1, 4, 4), hidden_channels=2,
+                           i2h_kernel=3, h2h_kernel=2)
+
+
+def test_conv_lstm_learns_motion():
+    """A ConvLSTM must beat a static baseline on next-frame prediction of
+    a moving pixel (the Shi et al. motivating task at toy scale)."""
+    from incubator_mxnet_tpu import autograd, gluon
+    from incubator_mxnet_tpu.gluon.contrib import rnn as crnn
+
+    rng = np.random.RandomState(0)
+
+    def seq(n, t=4, size=8):
+        xs = np.zeros((n, t + 1, 1, size, size), np.float32)
+        for b in range(n):
+            r, c0 = rng.randint(0, size), rng.randint(0, size - t - 1)
+            for i in range(t + 1):
+                xs[b, i, 0, r, c0 + i] = 1.0  # pixel moves right
+        return xs[:, :-1], xs[:, -1]
+
+    mx.random.seed(0)
+    cell = crnn.Conv2DLSTMCell(input_shape=(1, 8, 8), hidden_channels=8,
+                               i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    head = gluon.nn.Conv2D(1, 3, padding=1)
+    cell.initialize(mx.init.Xavier())
+    head.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(
+        dict(list(cell.collect_params().items())
+             + list(head.collect_params().items())),
+        "adam", {"learning_rate": 5e-3})
+    L2 = gluon.loss.L2Loss()
+    losses = []
+    for i in range(60):
+        x, y = seq(16)
+        with autograd.record():
+            outs, _ = cell.unroll(4, nd.array(x), layout="NTC",
+                                  merge_outputs=False)
+            pred = head(outs[-1])
+            loss = L2(pred, nd.array(y)).mean()
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asscalar()))
+    assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
