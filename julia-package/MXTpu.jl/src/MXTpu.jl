@@ -1,0 +1,131 @@
+# Julia frontend over the imperative C ABI (reference role: julia/src/
+# MXNet.jl — NDArray + op invocation for Julia users).
+#
+# No build step: Julia's ccall binds libmxtpu_imperative.so at runtime.
+# The op registry, autograd tape, and XLA dispatch run in the embedded
+# interpreter, exactly as for the C++/JVM/R frontends.
+#
+# Usage:
+#     ENV["MXTPU_LIB"] = "/path/to/incubator_mxnet_tpu/_native/libmxtpu_imperative.so"
+#     using MXTpu
+#     MXTpu.init()
+#     x = MXTpu.NDArray(Float32[1 -2; 3 -4])
+#     y = MXTpu.invoke("relu", [x])[1]
+#     MXTpu.to_array(y)
+module MXTpu
+
+export init, NDArray, to_array, invoke, attach_grad, backward, grad,
+       record_begin, record_end
+
+const _lib = Ref{String}("")
+
+function _libpath()
+    if _lib[] == ""
+        _lib[] = get(ENV, "MXTPU_LIB",
+                     joinpath(@__DIR__, "..", "..", "..",
+                              "incubator_mxnet_tpu", "_native",
+                              "libmxtpu_imperative.so"))
+    end
+    return _lib[]
+end
+
+function _check(rc::Cint, what::String)
+    if rc != 0
+        err = unsafe_string(ccall((:MXTpuImpError, _libpath()), Cstring, ()))
+        error("$what: $err")
+    end
+end
+
+function init()
+    _check(ccall((:MXTpuImpInit, _libpath()), Cint, ()), "init")
+end
+
+mutable struct NDArray
+    handle::Ptr{Cvoid}
+
+    function NDArray(h::Ptr{Cvoid})
+        nd = new(h)
+        finalizer(nd) do x
+            if x.handle != C_NULL
+                ccall((:MXTpuImpNDFree, _libpath()), Cint, (Ptr{Cvoid},),
+                      x.handle)
+                x.handle = C_NULL
+            end
+        end
+        return nd
+    end
+end
+
+"""Create a float32 NDArray from a Julia array (column-major Julia data is
+permuted to the row-major layout the runtime uses)."""
+function NDArray(a::AbstractArray{Float32})
+    c_order = permutedims(a, ndims(a):-1:1)          # row-major bytes
+    dims = Int64[size(a)...]
+    h = Ref{Ptr{Cvoid}}(C_NULL)
+    _check(ccall((:MXTpuImpNDCreate, _libpath()), Cint,
+                 (Cint, Cint, Ptr{Int64}, Ptr{Cvoid}, Ptr{Ptr{Cvoid}}),
+                 0, length(dims), dims, c_order, h), "NDCreate")
+    return NDArray(h[])
+end
+
+NDArray(a::AbstractArray{<:Real}) = NDArray(Float32.(a))
+
+function Base.size(nd::NDArray)
+    dims = Vector{Int64}(undef, 8)
+    n = Ref{Cint}(0)
+    _check(ccall((:MXTpuImpNDShape, _libpath()), Cint,
+                 (Ptr{Cvoid}, Ptr{Int64}, Cint, Ptr{Cint}),
+                 nd.handle, dims, 8, n), "NDShape")
+    return Tuple(dims[1:n[]])
+end
+
+"""Copy back into a Julia array (restoring column-major layout)."""
+function to_array(nd::NDArray)
+    s = size(nd)
+    buf = Vector{Float32}(undef, prod(s))
+    _check(ccall((:MXTpuImpNDCopyTo, _libpath()), Cint,
+                 (Ptr{Cvoid}, Ptr{Cvoid}, Csize_t),
+                 nd.handle, buf, sizeof(buf)), "NDCopyTo")
+    if length(s) <= 1
+        return buf
+    end
+    return permutedims(reshape(buf, reverse(s)), length(s):-1:1)
+end
+
+"""Run any registered op: invoke("FullyConnected", [x, w, b];
+attrs="{\\"num_hidden\\": 128}"). Returns a Vector{NDArray}."""
+function invoke(op::String, inputs::Vector{NDArray}; attrs::String = "")
+    ins = Ptr{Cvoid}[nd.handle for nd in inputs]
+    outs = Vector{Ptr{Cvoid}}(undef, 8)
+    n_out = Ref{Cint}(0)
+    _check(ccall((:MXTpuImpInvoke, _libpath()), Cint,
+                 (Cstring, Ptr{Ptr{Cvoid}}, Cint, Cstring,
+                  Ptr{Ptr{Cvoid}}, Cint, Ptr{Cint}),
+                 op, ins, length(ins), isempty(attrs) ? C_NULL : attrs,
+                 outs, 8, n_out), op)
+    return [NDArray(outs[i]) for i in 1:n_out[]]
+end
+
+attach_grad(nd::NDArray) =
+    _check(ccall((:MXTpuImpAttachGrad, _libpath()), Cint, (Ptr{Cvoid},),
+                 nd.handle), "attach_grad")
+
+backward(loss::NDArray) =
+    _check(ccall((:MXTpuImpBackward, _libpath()), Cint, (Ptr{Cvoid},),
+                 loss.handle), "backward")
+
+function grad(nd::NDArray)
+    g = Ref{Ptr{Cvoid}}(C_NULL)
+    _check(ccall((:MXTpuImpGrad, _libpath()), Cint,
+                 (Ptr{Cvoid}, Ptr{Ptr{Cvoid}}), nd.handle, g), "grad")
+    return NDArray(g[])
+end
+
+record_begin(train::Bool = true) =
+    _check(ccall((:MXTpuImpRecordBegin, _libpath()), Cint, (Cint,),
+                 train ? 1 : 0), "record_begin")
+
+record_end() =
+    ccall((:MXTpuImpRecordEnd, _libpath()), Cint, ())
+
+end # module

@@ -1,0 +1,23 @@
+# Smoke: ops + autograd through the Julia binding.
+# Run (needs PYTHONPATH at the repo root for the embedded interpreter):
+#   julia --project=.. runtests.jl
+using MXTpu
+using Test
+
+MXTpu.init()
+
+x = MXTpu.NDArray(Float32[-1 2; 3 -4])
+r = MXTpu.invoke("relu", [x])[1]
+@test MXTpu.to_array(r) == Float32[0 2; 3 0]
+
+w = MXTpu.NDArray(Float32[2, 3])
+MXTpu.attach_grad(w)
+MXTpu.record_begin()
+sq = MXTpu.invoke("square", [w])[1]
+loss = MXTpu.invoke("sum", [sq])[1]
+MXTpu.record_end()
+MXTpu.backward(loss)
+g = MXTpu.to_array(MXTpu.grad(w))
+@test isapprox(g, Float32[4, 6]; atol = 1e-6)
+
+println("Julia binding smoke OK")

@@ -230,3 +230,36 @@ struct JNIEnv {
          os.path.join(JVM, "src", "main", "native", "mxtpu_jni.cc")],
         capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stderr[-2000:]
+
+
+# --- Julia binding (julia-package/MXTpu.jl — the julia/ role) -------------
+
+
+def test_julia_uses_only_real_abi_symbols():
+    jl = _read(REPO, "julia-package", "MXTpu.jl", "src", "MXTpu.jl")
+    used = set(re.findall(r":(MXTpuImp\w+)", jl))
+    impl = _read(REPO, "src", "imperative.cc")
+    defined = set(re.findall(r"\b(MXTpuImp\w+)\(", impl))
+    assert used, "no ccall symbols parsed from MXTpu.jl"
+    assert used <= defined, f"Julia binding references unknown: {used - defined}"
+
+
+@pytest.mark.skipif(shutil.which("julia") is None,
+                    reason="julia is not installed in this image")
+def test_julia_binding_smokes():
+    from incubator_mxnet_tpu._native import imperative_lib
+
+    assert imperative_lib() is not None
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["MXTPU_LIB"] = os.path.join(
+        REPO, "incubator_mxnet_tpu", "_native", "libmxtpu_imperative.so")
+    pkg = os.path.join(REPO, "julia-package", "MXTpu.jl")
+    run = subprocess.run(
+        ["julia", "--project=" + pkg,
+         os.path.join(pkg, "test", "runtests.jl")],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert run.returncode == 0, (run.stdout[-800:], run.stderr[-1500:])
+    assert "Julia binding smoke OK" in run.stdout
