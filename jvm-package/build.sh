@@ -20,7 +20,7 @@ PYVER="$(python3 -c 'import sysconfig; print(sysconfig.get_config_var("LDVERSION
 g++ -O2 -std=c++17 -shared -fPIC \
     -I"$JAVA_HOME/include" -I"$JAVA_HOME/include/linux" \
     src/main/native/mxtpu_jni.cc \
-    -L"$NATIVE" -lmxtpu_imperative -lmxtpu_train \
+    -L"$NATIVE" -lmxtpu_imperative -lmxtpu_train -lmxtpu_predict \
     -L"$PYLIB" "-lpython$PYVER" \
     -Wl,-rpath,"$NATIVE" -Wl,-rpath,"$PYLIB" \
     -o target/libmxtpu_jni.so

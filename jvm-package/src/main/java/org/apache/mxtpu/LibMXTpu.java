@@ -60,4 +60,21 @@ final class LibMXTpu {
   static native int trainerSetState(long handle, String name, byte[] data);
 
   static native int trainerFree(long handle);
+
+  // --- .mxp predictor (the scala infer/ role) --------------------------
+  static native long predCreate(String mxpPath, String pluginPathOrNull);
+
+  static native int predNumOutputs(long handle);
+
+  static native long[] predOutputShape(long handle, int idx);
+
+  static native int predSetInput(long handle, String name, byte[] data);
+
+  static native int predForward(long handle);
+
+  static native int predGetOutput(long handle, int idx, byte[] out);
+
+  static native String predLastError();
+
+  static native int predFree(long handle);
 }

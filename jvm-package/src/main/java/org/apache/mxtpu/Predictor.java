@@ -1,0 +1,90 @@
+package org.apache.mxtpu;
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+
+/**
+ * Inference over an exported .mxp artifact (reference role: the
+ * scala-package infer/ Predictor — load once, feed named inputs, read
+ * outputs; runtime: src/predict.cc over the PJRT C API, no Python).
+ */
+public final class Predictor implements AutoCloseable {
+  private long handle;
+
+  public Predictor(String mxpPath, String pluginPathOrNull) {
+    handle = LibMXTpu.predCreate(mxpPath, pluginPathOrNull);
+    if (handle == 0) {
+      throw new MXTpuException("predCreate: " + LibMXTpu.predLastError());
+    }
+  }
+
+  public int numOutputs() {
+    return LibMXTpu.predNumOutputs(handle);
+  }
+
+  public long[] outputShape(int idx) {
+    long[] s = LibMXTpu.predOutputShape(handle, idx);
+    if (s == null) {
+      throw new MXTpuException("outputShape: " + LibMXTpu.predLastError());
+    }
+    return s;
+  }
+
+  public void setInput(String name, float[] data) {
+    ByteBuffer buf = ByteBuffer.allocate(data.length * 4)
+        .order(ByteOrder.LITTLE_ENDIAN);
+    buf.asFloatBuffer().put(data);
+    if (LibMXTpu.predSetInput(handle, name, buf.array()) != 0) {
+      throw new MXTpuException("setInput " + name + ": "
+          + LibMXTpu.predLastError());
+    }
+  }
+
+  public void forward() {
+    if (LibMXTpu.predForward(handle) != 0) {
+      throw new MXTpuException("forward: " + LibMXTpu.predLastError());
+    }
+  }
+
+  public float[] getOutput(int idx) {
+    long n = 1;
+    for (long s : outputShape(idx)) {
+      n *= s;
+    }
+    byte[] raw = new byte[(int) n * 4];
+    if (LibMXTpu.predGetOutput(handle, idx, raw) != 0) {
+      throw new MXTpuException("getOutput: " + LibMXTpu.predLastError());
+    }
+    float[] out = new float[(int) n];
+    ByteBuffer.wrap(raw).order(ByteOrder.LITTLE_ENDIAN).asFloatBuffer()
+        .get(out);
+    return out;
+  }
+
+  /** Top-k (index, score) pairs over output 0 — the infer-package
+   * ImageClassifier convenience. */
+  public int[] topK(int k) {
+    float[] probs = getOutput(0);
+    int[] idx = new int[k];
+    boolean[] used = new boolean[probs.length];
+    for (int j = 0; j < k; j++) {
+      int best = -1;
+      for (int i = 0; i < probs.length; i++) {
+        if (!used[i] && (best < 0 || probs[i] > probs[best])) {
+          best = i;
+        }
+      }
+      idx[j] = best;
+      used[best] = true;
+    }
+    return idx;
+  }
+
+  @Override
+  public void close() {
+    if (handle != 0) {
+      LibMXTpu.predFree(handle);
+      handle = 0;
+    }
+  }
+}

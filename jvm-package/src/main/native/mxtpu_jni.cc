@@ -239,3 +239,89 @@ Java_org_apache_mxtpu_LibMXTpu_trainerFree(JNIEnv*, jclass, jlong h) {
 }
 
 }  // extern "C"
+
+// --- predict ABI (include/mxtpu_predict.h; the scala infer/ role) --------
+extern "C" {
+typedef void* MXTpuPredictorHandle;
+int MXTpuPredCreate(const char* path, const char* plugin,
+                    MXTpuPredictorHandle* out);
+int MXTpuPredNumInputs(MXTpuPredictorHandle h, int* out);
+int MXTpuPredInputName(MXTpuPredictorHandle h, int idx, const char** out);
+int MXTpuPredNumOutputs(MXTpuPredictorHandle h, int* out);
+int MXTpuPredOutputShape(MXTpuPredictorHandle h, int idx,
+                         const int64_t** dims, int* ndim);
+int MXTpuPredSetInput(MXTpuPredictorHandle h, const char* name,
+                      const void* data, size_t nbytes);
+int MXTpuPredForward(MXTpuPredictorHandle h);
+int MXTpuPredGetOutput(MXTpuPredictorHandle h, int idx, void* dst,
+                       size_t nbytes);
+const char* MXTpuPredLastError(void);
+void MXTpuPredFree(MXTpuPredictorHandle h);
+
+JNIEXPORT jlong JNICALL Java_org_apache_mxtpu_LibMXTpu_predCreate(
+    JNIEnv* env, jclass, jstring path, jstring plugin) {
+  std::string p = jstr(env, path), pl = jstr(env, plugin);
+  MXTpuPredictorHandle h = nullptr;
+  if (MXTpuPredCreate(p.c_str(), pl.empty() ? nullptr : pl.c_str(), &h) != 0)
+    return 0;
+  return reinterpret_cast<jlong>(h);
+}
+
+JNIEXPORT jint JNICALL
+Java_org_apache_mxtpu_LibMXTpu_predNumOutputs(JNIEnv*, jclass, jlong h) {
+  int n = -1;
+  MXTpuPredNumOutputs(reinterpret_cast<void*>(h), &n);
+  return n;
+}
+
+JNIEXPORT jlongArray JNICALL Java_org_apache_mxtpu_LibMXTpu_predOutputShape(
+    JNIEnv* env, jclass, jlong h, jint idx) {
+  const int64_t* dims = nullptr;
+  int nd = 0;
+  if (MXTpuPredOutputShape(reinterpret_cast<void*>(h), idx, &dims, &nd) != 0)
+    return nullptr;
+  jlongArray out = env->NewLongArray(nd);
+  env->SetLongArrayRegion(out, 0, nd,
+                          reinterpret_cast<const jlong*>(dims));
+  return out;
+}
+
+JNIEXPORT jint JNICALL Java_org_apache_mxtpu_LibMXTpu_predSetInput(
+    JNIEnv* env, jclass, jlong h, jstring name, jbyteArray data) {
+  std::string n = jstr(env, name);
+  jsize len = env->GetArrayLength(data);
+  jbyte* p = env->GetByteArrayElements(data, nullptr);
+  int rc = MXTpuPredSetInput(reinterpret_cast<void*>(h), n.c_str(), p,
+                             static_cast<size_t>(len));
+  env->ReleaseByteArrayElements(data, p, JNI_ABORT);
+  return rc;
+}
+
+JNIEXPORT jint JNICALL
+Java_org_apache_mxtpu_LibMXTpu_predForward(JNIEnv*, jclass, jlong h) {
+  return MXTpuPredForward(reinterpret_cast<void*>(h));
+}
+
+JNIEXPORT jint JNICALL Java_org_apache_mxtpu_LibMXTpu_predGetOutput(
+    JNIEnv* env, jclass, jlong h, jint idx, jbyteArray out) {
+  jsize len = env->GetArrayLength(out);
+  jbyte* p = env->GetByteArrayElements(out, nullptr);
+  int rc = MXTpuPredGetOutput(reinterpret_cast<void*>(h), idx, p,
+                              static_cast<size_t>(len));
+  env->ReleaseByteArrayElements(out, p, rc == 0 ? 0 : JNI_ABORT);
+  return rc;
+}
+
+JNIEXPORT jstring JNICALL
+Java_org_apache_mxtpu_LibMXTpu_predLastError(JNIEnv* env, jclass) {
+  const char* e = MXTpuPredLastError();
+  return env->NewStringUTF(e ? e : "");
+}
+
+JNIEXPORT jint JNICALL
+Java_org_apache_mxtpu_LibMXTpu_predFree(JNIEnv*, jclass, jlong h) {
+  MXTpuPredFree(reinterpret_cast<void*>(h));
+  return 0;
+}
+
+}  // extern "C"

@@ -43,7 +43,9 @@ def test_jni_uses_only_real_abi_symbols():
     runtimes' sources (catches ABI drift without a JDK)."""
     cc = _read(JVM, "src", "main", "native", "mxtpu_jni.cc")
     used = set(re.findall(r"\b(MXTpu\w+)\(", cc))
-    impl = _read(REPO, "src", "imperative.cc") + _read(REPO, "src", "train.cc")
+    impl = (_read(REPO, "src", "imperative.cc")
+            + _read(REPO, "src", "train.cc")
+            + _read(REPO, "src", "predict.cc"))
     defined = set(re.findall(r"\b(MXTpu\w+)\(", impl))
     missing = used - defined
     assert not missing, f"JNI references unknown ABI symbols: {sorted(missing)}"
