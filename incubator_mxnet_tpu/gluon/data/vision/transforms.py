@@ -10,7 +10,8 @@ from ...nn import Sequential as Compose_base
 
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
-           "RandomBrightness", "RandomContrast", "RandomSaturation", "RandomLighting"]
+           "RandomBrightness", "RandomContrast", "RandomSaturation",
+           "RandomHue", "RandomColorJitter", "RandomLighting"]
 
 
 class Compose(Compose_base):
@@ -156,3 +157,32 @@ class RandomLighting(Block):
                            [-0.5808, -0.0045, -0.814],
                            [-0.5836, -0.6948, 0.4203]])
         return image.LightingAug(self._alpha, eigval, eigvec)(x)
+
+
+class RandomHue(Block):
+    def __init__(self, hue):
+        super().__init__()
+        self._h = hue
+
+    def forward(self, x):
+        from .... import image
+
+        return image.HueJitterAug(self._h)(x)
+
+
+class RandomColorJitter(Block):
+    """Brightness/contrast/saturation/hue jitter applied in random order
+    (ref: transforms.py RandomColorJitter over image.ColorJitterAug)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._args = (brightness, contrast, saturation)
+        self._hue = hue
+
+    def forward(self, x):
+        from .... import image
+
+        x = image.ColorJitterAug(*self._args)(x)
+        if self._hue:
+            x = image.HueJitterAug(self._hue)(x)
+        return x
