@@ -159,3 +159,13 @@ def test_time_series_forecast():
 def test_custom_op_numpy():
     log = _run("custom_op_numpy.py", "--steps", "200")
     assert "custom_op_numpy OK" in log
+
+
+def test_seq2seq_attention():
+    log = _run("seq2seq_attention.py", "--steps", "400", timeout=520)
+    assert "seq2seq_attention OK" in log
+
+
+def test_multi_axis_parallel():
+    log = _run("multi_axis_parallel.py", timeout=520)
+    assert "multi_axis_parallel OK" in log
