@@ -10,8 +10,6 @@ kernel must be odd (implied same-padding), as in the reference.
 """
 from __future__ import annotations
 
-import numpy as np
-
 from .... import initializer as init_mod
 from .... import ndarray as nd
 from ...rnn.rnn_cell import RecurrentCell

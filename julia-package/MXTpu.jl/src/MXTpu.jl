@@ -126,6 +126,6 @@ record_begin(train::Bool = true) =
                  train ? 1 : 0), "record_begin")
 
 record_end() =
-    ccall((:MXTpuImpRecordEnd, _libpath()), Cint, ())
+    _check(ccall((:MXTpuImpRecordEnd, _libpath()), Cint, ()), "record_end")
 
 end # module

@@ -19,7 +19,11 @@ public final class Predictor implements AutoCloseable {
   }
 
   public int numOutputs() {
-    return LibMXTpu.predNumOutputs(handle);
+    int n = LibMXTpu.predNumOutputs(handle);
+    if (n < 0) {
+      throw new MXTpuException("numOutputs: " + LibMXTpu.predLastError());
+    }
+    return n;
   }
 
   public long[] outputShape(int idx) {
@@ -65,6 +69,7 @@ public final class Predictor implements AutoCloseable {
    * ImageClassifier convenience. */
   public int[] topK(int k) {
     float[] probs = getOutput(0);
+    k = Math.min(k, probs.length);
     int[] idx = new int[k];
     boolean[] used = new boolean[probs.length];
     for (int j = 0; j < k; j++) {
