@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""CNN text classification (ref: example/cnn_text_classification/ — Kim
+2014: parallel conv filters of several widths over word embeddings,
+max-over-time pooling, softmax).
+
+Synthetic sentiment: sentences are filler words plus sentiment PHRASES
+(ordered word pairs) whose order matters — "not good" vs "good not" —
+so bag-of-words can't solve it but width-2 convolutions can. Gate:
+accuracy well above the bag-of-words ceiling.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import fused, gluon, nd
+from incubator_mxnet_tpu.gluon import nn
+
+VOCAB = 60
+NEG_WORD, POS_WORD, GOOD, BAD = 2, 3, 4, 5  # special words; rest filler
+
+
+class TextCNN(gluon.block.HybridBlock):
+    def __init__(self, embed=32, n_filter=32, widths=(2, 3, 4), **kw):
+        super().__init__(**kw)
+        self._widths = widths
+        with self.name_scope():
+            self.embed = nn.Embedding(VOCAB, embed)
+            self.convs = nn.HybridSequential()
+            for w in widths:
+                self.convs.add(nn.Conv1D(n_filter, w, activation="relu"))
+            self.out = nn.Dense(2)
+
+    def hybrid_forward(self, F, x):
+        e = self.embed(x).transpose((0, 2, 1))  # (N, E, T)
+        pooled = [F.max(conv(e), axis=2) for conv in self.convs]
+        return self.out(F.concat(*pooled, dim=1))
+
+
+def make_batch(rng, n, length):
+    xs = rng.randint(6, VOCAB, (n, length))
+    ys = rng.randint(0, 2, n)
+    for i in range(n):
+        pos = rng.randint(0, length - 2)
+        sentiment = GOOD if ys[i] else BAD
+        if rng.rand() < 0.5:
+            # negation flips the phrase: "NEG GOOD" is negative
+            xs[i, pos], xs[i, pos + 1] = NEG_WORD, GOOD if not ys[i] else BAD
+        else:
+            xs[i, pos], xs[i, pos + 1] = POS_WORD, sentiment
+    return xs.astype(np.int32), ys.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--seq-len", type=int, default=20)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=2e-3)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    mx.random.seed(0)
+    net = TextCNN()
+    net.initialize(mx.init.Xavier())
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = fused.GluonTrainStep(net, lambda n, x, y: L(n(x), y),
+                                mx.optimizer.Adam(learning_rate=args.lr))
+    for i in range(args.steps):
+        x, y = make_batch(rng, args.batch_size, args.seq_len)
+        loss = step(nd.array(x), nd.array(y))
+        if (i + 1) % 100 == 0:
+            print(f"step {i + 1}: loss {float(loss.asscalar()):.4f}")
+    step.sync_params()
+
+    x, y = make_batch(rng, 512, args.seq_len)
+    acc = (net(nd.array(x)).asnumpy().argmax(-1) == y).mean()
+    print(f"accuracy {acc:.3f} (order-sensitive phrases; BoW ceiling ~0.75)")
+    assert acc > 0.9, acc
+    print("cnn_text_classification OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -169,3 +169,13 @@ def test_seq2seq_attention():
 def test_multi_axis_parallel():
     log = _run("multi_axis_parallel.py", timeout=520)
     assert "multi_axis_parallel OK" in log
+
+
+def test_cnn_text_classification():
+    log = _run("cnn_text_classification.py", "--steps", "300")
+    assert "cnn_text_classification OK" in log
+
+
+def test_dsd_pruning():
+    log = _run("dsd_pruning.py", "--steps", "150", timeout=520)
+    assert "dsd_pruning OK" in log
