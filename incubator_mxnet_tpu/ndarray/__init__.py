@@ -21,6 +21,7 @@ from . import register as _register
 _register.install_ops(globals())
 
 from . import random  # noqa: E402,F401
+from . import image  # noqa: E402,F401
 from . import linalg  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
 from . import contrib  # noqa: E402,F401

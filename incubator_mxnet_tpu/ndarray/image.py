@@ -1,0 +1,24 @@
+"""nd.image namespace (ref: python/mxnet/ndarray/image.py — the generated
+`_image_*` op wrappers exposed under friendly names)."""
+from __future__ import annotations
+
+from ..ops.registry import OP_REGISTRY
+from . import register as _register
+
+__all__ = ["to_tensor", "normalize", "resize"]
+
+
+def to_tensor(data):
+    """HWC/NHWC [0,255] -> CHW/NCHW float32 [0,1]."""
+    return _register.invoke(OP_REGISTRY["_image_to_tensor"], (data,), {})
+
+
+def normalize(data, mean=(0.0,), std=(1.0,)):
+    return _register.invoke(OP_REGISTRY["_image_normalize"], (data,),
+                            dict(mean=tuple(mean), std=tuple(std)))
+
+
+def resize(data, size, keep_ratio=False, interp=1):
+    return _register.invoke(
+        OP_REGISTRY["_image_resize"], (data,),
+        dict(size=size, keep_ratio=keep_ratio, interp=interp))
