@@ -14,8 +14,11 @@ def to_tensor(data):
 
 
 def normalize(data, mean=(0.0,), std=(1.0,)):
+    # scalar or per-channel sequence, like the reference API
+    mean = tuple(mean) if hasattr(mean, "__len__") else (float(mean),)
+    std = tuple(std) if hasattr(std, "__len__") else (float(std),)
     return _register.invoke(OP_REGISTRY["_image_normalize"], (data,),
-                            dict(mean=tuple(mean), std=tuple(std)))
+                            dict(mean=mean, std=std))
 
 
 def resize(data, size, keep_ratio=False, interp=1):

@@ -381,3 +381,20 @@ def test_native_imgpipe_scale_matches_python(tmp_path):
     b_n = next(iter(it_n)).data[0].asnumpy()
     b_p = next(iter(it_p)).data[0].asnumpy()
     assert np.abs(b_n - b_p).max() < 0.05, np.abs(b_n - b_p).max()
+
+
+def test_nd_image_namespace():
+    """nd.image.to_tensor/normalize/resize (ref: python/mxnet/ndarray/image.py)."""
+    from incubator_mxnet_tpu import nd
+
+    img = nd.array(np.arange(8 * 6 * 3, dtype=np.uint8).reshape(8, 6, 3))
+    t = nd.image.to_tensor(img)
+    assert t.shape == (3, 8, 6)
+    np.testing.assert_allclose(t.asnumpy().max(), (8 * 6 * 3 - 1) / 255.0,
+                               rtol=1e-6)
+    n = nd.image.normalize(t, mean=(0.5, 0.5, 0.5), std=(0.25, 0.25, 0.25))
+    np.testing.assert_allclose(n.asnumpy(),
+                               (t.asnumpy() - 0.5) / 0.25, rtol=1e-6)
+    r = nd.image.resize(nd.array(np.zeros((20, 40, 3), np.float32)), 10,
+                        keep_ratio=True)
+    assert r.shape == (10, 20, 3)  # short edge -> 10
