@@ -164,6 +164,25 @@ _DTYPE_CODES = {"float32": 0, "float64": 1, "int32": 2, "int64": 3,
 # ---------------------------------------------------------------------------
 
 
+def export_gluon_predictor(prefix, net, input_shapes, dtype="float32"):
+    """One-call deployment export for a trained HybridBlock: traces the
+    block to a Symbol (the SymbolBlock bridge), splits its parameters into
+    arg/aux, and AOT-compiles the predict artifact.
+
+    input_shapes: dict name -> shape, e.g. {"data": (1, 3, 224, 224)}.
+    For multi-input blocks the dict's ITERATION ORDER is the positional
+    order of the block's forward() arguments (names label the Predictor
+    inputs; they do not reorder the trace).
+
+    Returns what export_predictor returns: the `-predict.stablehlo` path;
+    a single-file `-predict.mxp` is written alongside when every tensor
+    dtype has a wire code (a warning is emitted otherwise)."""
+    sym_out, arg_params, aux_params = net._symbol_and_params(
+        *input_shapes.keys())
+    return export_predictor(prefix, sym_out, arg_params, aux_params,
+                            dict(input_shapes), dtype=dtype)
+
+
 def export_trainer(prefix, net, loss_fn, optimizer, x_shape, y_shape,
                    dtype="float32", label_dtype="float32"):
     """AOT-export net+loss+optimizer as a standalone TRAINING artifact.

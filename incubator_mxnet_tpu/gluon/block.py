@@ -399,24 +399,37 @@ class HybridBlock(Block):
         arg:/aux: container format. Works on any HybridBlock whose
         parameters are initialized (shapes must be known; run one forward
         or initialize with explicit in_units/in_channels first)."""
-        sym_out = self._to_symbol()
+        sym_out, arg_params, aux_params = self._symbol_and_params()
         sym_out.save(f"{path}-symbol.json")
-        arg_names = set(sym_out.list_arguments())
-        aux_names = set(sym_out.list_auxiliary_states())
         from ..ndarray.legacy_io import save_mxnet_params
 
         payload = {}
-        for name, p in self.collect_params().items():
-            if p._data is None:
-                continue
-            if name in aux_names:
-                payload["aux:" + name] = p._data
-            elif name in arg_names:
-                payload["arg:" + name] = p._data
+        for name, arr in arg_params.items():
+            payload["arg:" + name] = arr._data
+        for name, arr in aux_params.items():
+            payload["aux:" + name] = arr._data
         # reference byte format: the exported pair is loadable by the
         # reference runtime itself, not just by this framework
         save_mxnet_params(f"{path}-{epoch:04d}.params", payload)
         return f"{path}-symbol.json", f"{path}-{epoch:04d}.params"
+
+    def _symbol_and_params(self, *input_names):
+        """Trace to a Symbol and split initialized parameters into
+        (symbol, arg_params, aux_params) — shared by export() and
+        deploy.export_gluon_predictor. Uninitialized (deferred) params are
+        skipped; downstream consumers report them as missing by name."""
+        sym_out = self._to_symbol(*input_names)
+        arg_names = set(sym_out.list_arguments())
+        aux_names = set(sym_out.list_auxiliary_states())
+        arg_params, aux_params = {}, {}
+        for name, p in self.collect_params().items():
+            if p._data is None:
+                continue
+            if name in aux_names:
+                aux_params[name] = p.data()
+            elif name in arg_names:
+                arg_params[name] = p.data()
+        return sym_out, arg_params, aux_params
 
     def _to_symbol(self, *input_names):
         """Trace this block into a declarative Symbol (the SymbolBlock
