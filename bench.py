@@ -94,6 +94,15 @@ def child_main():
     elapsed = time.perf_counter() - start
     ips = batch_size * iters / elapsed
 
+    # emit the per-step result IMMEDIATELY: the tunnel to the chip flaps,
+    # and if the scan-mode compile below hangs past the parent's timeout,
+    # the parent salvages this line from the killed child's stdout
+    print(json.dumps({
+        "ips": round(ips, 2), "scan_ips": 0.0, "scan_k": 0,
+        "layout": layout, "dtype": dtype, "platform": target.platform,
+        "compile_s": round(compile_s, 1), "loss": float(loss.asscalar()),
+    }), flush=True)
+
     # scan mode: K steps per device program (fused.scan_steps) — measures
     # device throughput free of per-step dispatch latency (the bulked-exec
     # analog; dominant effect on remote-attached chips)
@@ -128,12 +137,35 @@ def child_main():
         "platform": target.platform,
         "compile_s": round(compile_s, 1),
         "loss": float(loss.asscalar()),
+        "final": True,  # distinguishes this from the mid-run partial line
     }), flush=True)
 
 
+def _score(r):
+    """Best throughput a measurement demonstrates (per-step or scan)."""
+    return max(r.get("ips", 0.0), r.get("scan_ips", 0.0))
+
+
+def _last_json_line(text):
+    """Most recent JSON measurement line in a child's stdout, or None."""
+    for line in reversed((text or "").strip().splitlines()):
+        try:
+            d = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(d, dict) and "ips" in d:
+            return d
+    return None
+
+
 def _run_child(dtype, attempts=3, timeout=1500, extra_env=None):
-    """Run one measurement in a subprocess; returns (result_dict, last_err)."""
+    """Run one measurement in a subprocess; returns (result_dict, last_err).
+
+    A child that times out or crashes mid-run may still have printed a
+    stage measurement (the per-step JSON line); that partial is kept as a
+    fallback while the remaining attempts try for a full run."""
     last_err = None
+    best_partial = None
     for i in range(attempts):
         env = dict(os.environ)
         env["BENCH_CHILD"] = "1"
@@ -144,22 +176,84 @@ def _run_child(dtype, attempts=3, timeout=1500, extra_env=None):
                                env=env, capture_output=True, text=True,
                                timeout=timeout,
                                cwd=os.path.dirname(os.path.abspath(__file__)))
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as e:
+            # the child prints a JSON line after EACH measurement stage, so
+            # a timeout mid-scan-compile still salvages the per-step number
+            partial = e.stdout or b""
+            if isinstance(partial, bytes):
+                partial = partial.decode("utf-8", "replace")
+            d = _last_json_line(partial)
+            if d is not None:
+                d["partial"] = True
+                if best_partial is None or _score(d) > _score(best_partial):
+                    best_partial = d
+                print(f"[bench] {dtype} timed out but salvaged a partial "
+                      f"measurement; retrying for a full run",
+                      file=sys.stderr, flush=True)
             last_err = f"attempt {i}: timeout after {timeout}s"
             print(f"[bench] {dtype} {last_err}", file=sys.stderr, flush=True)
             continue
-        for line in reversed(p.stdout.strip().splitlines()):
-            try:
-                d = json.loads(line)
-            except (json.JSONDecodeError, ValueError):
-                continue
-            if "ips" in d:
-                return d, None
+        d = _last_json_line(p.stdout)
+        # a complete final line counts even on rc!=0 (e.g. a TPU runtime
+        # that crashes at teardown AFTER the measurement was printed)
+        if d is not None and (p.returncode == 0 or d.get("final")):
+            return d, None
+        if d is not None:  # crashed after a stage measurement (e.g. in scan)
+            d["partial"] = True
+            if best_partial is None or _score(d) > _score(best_partial):
+                best_partial = d
         tail = "\n".join((p.stderr or "").strip().splitlines()[-6:])
         last_err = f"attempt {i}: rc={p.returncode}: {tail[-500:]}"
         print(f"[bench] {dtype} failed: {last_err}", file=sys.stderr, flush=True)
         time.sleep(5 * (i + 1))
-    return None, last_err
+    return best_partial, last_err
+
+
+def _cache_from_artifacts(repo_dir):
+    """Reconstruct the on-chip result cache from the committed BENCH_r{N}.json
+    round artifacts. BENCH_CACHE.json is machine-local (gitignored) and the
+    build VM is reimaged between rounds, so without this a down tunnel at
+    bench time would discard every previously measured on-chip number and
+    report a CPU fallback instead."""
+    import glob
+    import re
+
+    best_round, best = -1, None
+    for path in glob.glob(os.path.join(repo_dir, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                parsed = json.load(f).get("parsed") or {}
+        except (OSError, ValueError):
+            continue
+        if parsed.get("platform") != "tpu":
+            continue
+        if int(m.group(1)) > best_round:
+            best_round, best = int(m.group(1)), parsed
+    if best is None:
+        return None
+    results = {}
+    for dtype, short in (("float32", "fp32"), ("bfloat16", "bf16")):
+        if f"{short}_ips" not in best:
+            continue
+        # only reconstruct entries PROVEN on-chip: either a per-dtype
+        # platform tag (newer artifacts) or the headline dtype itself —
+        # a silently-CPU sibling dtype must not be laundered into "tpu"
+        platform = best.get(f"{short}_platform") or (
+            best["platform"] if best.get("dtype") == dtype else None)
+        if platform != "tpu":
+            continue
+        results[dtype] = {
+            "ips": best[f"{short}_ips"], "scan_ips": 0.0, "scan_k": 0,
+            "layout": best.get("layout"), "dtype": dtype,
+            "platform": "tpu", "compile_s": best.get("compile_s", 0.0),
+        }
+    if not results:
+        return None
+    ts = best.get("cached_ts") or f"round-{best_round} artifact"
+    return {"ts": ts, "results": results}
 
 
 def _probe_accelerator(timeout=150):
@@ -186,10 +280,16 @@ def main():
           file=sys.stderr, flush=True)
 
     results, errors = {}, {}
-    for dtype in ("float32", "bfloat16"):
+    try:
+        child_timeout = int(os.environ.get("BENCH_CHILD_TIMEOUT", "2400"))
+    except ValueError:
+        child_timeout = 2400
+    # bf16 first: it is the headline TPU path, so a short tunnel-uptime
+    # window lands the most important number before the tunnel can flap
+    for dtype in ("bfloat16", "float32"):
         # healthy backend: full retries; down tunnel: one short attempt in
         # case the probe raced a recovery, then fall through to the cache
-        attempts, timeout = (3, 1500) if accel_up else (1, 300)
+        attempts, timeout = (3, child_timeout) if accel_up else (1, 300)
         r, err = _run_child(dtype, attempts=attempts, timeout=timeout)
         if r is not None:
             results[dtype] = r
@@ -204,10 +304,35 @@ def main():
         # hours at a time, and a later bench run should report the last
         # true TPU number (labelled) instead of only a CPU fallback
         try:
+            merged = {}
+            try:
+                with open(cache_path) as f:
+                    merged = {k: r
+                              for k, r in json.load(f).get("results", {}).items()
+                              if r.get("platform") == "tpu"}
+            except (OSError, ValueError, AttributeError):
+                pass
+            # per-dtype merge: a short uptime window that lands only bf16
+            # must not clobber a previously cached fp32 measurement (both
+            # sides filtered to real on-chip entries — the cache must never
+            # launder a CPU number into an "on-chip" report). A salvaged
+            # PARTIAL never overwrites a cached entry with a better number
+            # (e.g. an earlier full scan-mode measurement).
+            def _score(r):
+                return max(r.get("ips", 0.0), r.get("scan_ips", 0.0))
+
+            for k, r in results.items():
+                if r.get("platform") != "tpu":
+                    continue
+                old = merged.get(k)
+                if (old is not None and r.get("partial")
+                        and _score(old) > _score(r)):
+                    continue
+                merged[k] = r
             with open(cache_path, "w") as f:
                 json.dump({"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                                time.gmtime()),
-                           "results": results}, f)
+                           "results": merged}, f)
         except OSError:
             pass
     cached_ts = None
@@ -215,15 +340,29 @@ def main():
         # nothing measured on the real chip this run (down tunnel, or a
         # plugin that silently fell back to CPU): prefer the cached on-chip
         # number, clearly labelled
+        cached = None
         try:
             with open(cache_path) as f:
                 cached = json.load(f)
-            results = cached["results"]
-            cached_ts = cached["ts"]
+        except (OSError, ValueError):
+            cached = _cache_from_artifacts(
+                os.path.dirname(os.path.abspath(__file__)))
+        # pre-merge-era cache files were written unfiltered and may hold a
+        # silently-CPU entry; never report one as on-chip
+        def _on_chip_entries(c):
+            return {k: r for k, r in (c or {}).get("results", {}).items()
+                    if r.get("platform") == "tpu"}
+
+        on_chip = _on_chip_entries(cached)
+        if not on_chip:  # cache file useless — fall back to round artifacts
+            cached = _cache_from_artifacts(
+                os.path.dirname(os.path.abspath(__file__)))
+            on_chip = _on_chip_entries(cached)
+        if on_chip:
+            results = on_chip
+            cached_ts = cached.get("ts")
             note = (f"TPU backend unavailable at bench time; reporting the "
-                    f"last successful on-chip measurement ({cached['ts']}); ")
-        except (OSError, ValueError, KeyError):
-            pass
+                    f"last successful on-chip measurement ({cached_ts}); ")
     if not results:
         # accelerator never came up and no cached number exists: tiny CPU
         # run so a real number still exists, clearly labelled.
@@ -247,6 +386,11 @@ def main():
     }
     fp32 = results.get("float32")
     bf16 = results.get("bfloat16")
+    for dtype, r in sorted(results.items()):
+        if r.get("partial"):
+            # a salvaged mid-run line: per-step measured, scan stage not
+            note += (f"{dtype}: partial measurement (child timed out before "
+                     f"the scan stage); ")
     # headline = the framework's best number (the reference's headline was
     # likewise its best path — cuDNN + bulked exec); dtype is labelled
     candidates = [r for r in (fp32, bf16) if r is not None]
@@ -272,9 +416,13 @@ def main():
             out["bf16_vs_fp32_baseline"] = round(b / BASELINE_FP32, 3)
             out["bf16_mfu"] = round(
                 b * FLOPS_PER_IMAGE_TRAIN / PEAK_FLOPS["bfloat16"], 3)
+            # per-dtype platform so artifact reconstruction can tell a
+            # silently-CPU dtype from an on-chip one
+            out["bf16_platform"] = bf16.get("platform")
         if fp32:
             f = max(fp32["ips"], fp32.get("scan_ips", 0.0))
             out["fp32_ips"] = f
+            out["fp32_platform"] = fp32.get("platform")
             out["fp32_mfu"] = round(
                 f * FLOPS_PER_IMAGE_TRAIN / PEAK_FLOPS["float32"], 3)
     if cached_ts is not None:

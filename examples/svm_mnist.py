@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Multiclass SVM on MNIST-style digits (ref: example/svm_mnist/svm_mnist.py —
+an MLP feature stack topped by SVMOutput instead of softmax).
+
+SVMOutput's forward is the identity on the class scores; its backward is the
+multiclass hinge gradient (L2-SVM by default, L1 with --l1-svm), so the whole
+net trains as a deep SVM. Runs on synthetic 10-class digit blobs; compares
+the two hinge variants against a softmax head on the same data.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.gluon import nn
+
+
+def build_net():
+    # the reference's 512-512 MLP at toy width
+    net = nn.HybridSequential()
+    net.add(nn.Dense(128, activation="relu"),
+            nn.Dense(64, activation="relu"),
+            nn.Dense(10))
+    return net
+
+
+def make_data(rng, n, image=16):
+    """10 noisy digit prototypes — linearly separable only in feature space."""
+    protos = rng.rand(10, image * image).astype(np.float32)
+    y = rng.randint(0, 10, n)
+    x = protos[y] + 0.35 * rng.randn(n, image * image).astype(np.float32)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def train(net, x, y, steps, lr, head):
+    """head: callable scores, labels -> tensor to backward from."""
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": lr, "momentum": 0.9})
+    xa, ya = nd.array(x), nd.array(y)
+    for _ in range(steps):
+        with autograd.record():
+            out = head(net(xa), ya)
+        out.backward()
+        trainer.step(len(x))
+    pred = net(xa).asnumpy().argmax(-1)
+    return (pred == y).mean()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=120)
+    ap.add_argument("--samples", type=int, default=512)
+    ap.add_argument("--lr", type=float, default=0.02)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    x, y = make_data(rng, args.samples)
+
+    results = {}
+    for name, head in [
+        ("l2-svm", lambda s, t: nd.SVMOutput(s, t)),
+        ("l1-svm", lambda s, t: nd.SVMOutput(s, t, use_linear=True)),
+        ("softmax", lambda s, t:
+         gluon.loss.SoftmaxCrossEntropyLoss()(s, t)),
+    ]:
+        mx.random.seed(7)
+        net = build_net()
+        net.initialize(mx.init.Xavier())
+        results[name] = train(net, x, y, args.steps, args.lr, head)
+        print(f"{name:8s} train accuracy {results[name]:.3f}")
+
+    assert results["l2-svm"] > 0.95, results
+    assert results["l1-svm"] > 0.95, results
+    print("svm_mnist OK")
+
+
+if __name__ == "__main__":
+    main()

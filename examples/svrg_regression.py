@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""SVRG linear regression (ref: example/svrg_module/linear_regression/train.py
+— SVRGModule on the YearPredictionMSD task, here at synthetic toy scale).
+
+Demonstrates the variance-reduced schedule: every `update_freq` epochs the
+trainer snapshots the weights and computes the full-data gradient mu; each
+step then descends along  g(w) - g(w~) + mu,  whose variance vanishes as
+w -> w*. The example verifies the SVRG loss trajectory beats plain SGD at
+the same learning rate on an ill-conditioned least-squares problem.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.contrib.svrg import SVRGTrainer
+from incubator_mxnet_tpu.gluon import nn
+
+
+def make_problem(rng, n, d, cond=30.0):
+    """Least squares with a stretched spectrum (high gradient variance)."""
+    scales = np.logspace(0, np.log10(cond), d).astype(np.float32)
+    x = rng.randn(n, d).astype(np.float32) * scales
+    w_true = rng.randn(d, 1).astype(np.float32)
+    y = x @ w_true + 0.01 * rng.randn(n, 1).astype(np.float32)
+    return x, y
+
+
+def mse(net, xa, ya):
+    err = net(xa) - ya
+    return (err * err).mean()
+
+
+def run_sgd(net, batches, epochs, lr):
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": lr})
+    for _ in range(epochs):
+        for xa, ya in batches:
+            with autograd.record():
+                loss = mse(net, xa, ya)
+            loss.backward()
+            trainer.step(1)
+    return net
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--samples", type=int, default=512)
+    ap.add_argument("--dim", type=int, default=16)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=2e-4)
+    ap.add_argument("--update-freq", type=int, default=2)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    x, y = make_problem(rng, args.samples, args.dim)
+    batches = [(nd.array(x[i:i + args.batch_size]),
+                nd.array(y[i:i + args.batch_size]))
+               for i in range(0, args.samples, args.batch_size)]
+
+    def fresh_net(seed):
+        mx.random.seed(seed)
+        net = nn.Dense(1, in_units=args.dim)
+        net.initialize(mx.init.Zero())
+        return net
+
+    # --- SVRG ---
+    net = fresh_net(3)
+    svrg = SVRGTrainer(net, mse, optimizer="sgd",
+                       optimizer_params={"learning_rate": args.lr},
+                       update_freq=args.update_freq)
+    for epoch in range(args.epochs):
+        if epoch % svrg.update_freq == 0:
+            svrg.update_full_grads(batches)
+        for xa, ya in batches:
+            loss = svrg.step(xa, ya)
+        print(f"epoch {epoch}: svrg loss {float(loss.asscalar()):.5f}")
+    svrg_loss = float(mse(net, nd.array(x), nd.array(y)).asscalar())
+
+    # --- plain SGD at the same lr ---
+    sgd_net = run_sgd(fresh_net(3), batches, args.epochs, args.lr)
+    sgd_loss = float(mse(sgd_net, nd.array(x), nd.array(y)).asscalar())
+
+    print(f"final full-data MSE: svrg {svrg_loss:.5f} vs sgd {sgd_loss:.5f}")
+    assert svrg_loss < sgd_loss * 1.05, (svrg_loss, sgd_loss)
+    assert np.isfinite(svrg_loss)
+    print("svrg_regression OK")
+
+
+if __name__ == "__main__":
+    main()

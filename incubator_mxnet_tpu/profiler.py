@@ -15,7 +15,8 @@ import jax.profiler
 
 __all__ = [
     "set_config", "set_state", "dump", "dumps", "pause", "resume", "Task",
-    "Frame", "Event", "Counter", "Marker", "scope", "aggregate_enabled",
+    "Frame", "Event", "Counter", "Marker", "Domain", "scope",
+    "aggregate_enabled",
     "timed_invoke", "reset_stats", "memory_analysis", "record_memory",
     "dumps_memory",
 ]
@@ -218,6 +219,18 @@ def scope(name):
 
 class _Annotated:
     def __init__(self, name, *a, **kw):
+        # reference signature is Task/Frame(domain, name) but Event(name);
+        # accept both orders (ref: python/mxnet/profiler.py Task.__init__)
+        self.domain = None
+        if isinstance(name, Domain):
+            self.domain = name
+            if a:
+                name = a[0]
+            elif "name" in kw:
+                name = kw["name"]
+            else:
+                raise TypeError(
+                    f"{type(self).__name__}(domain, name): name is required")
         self.name = name
         self._ctx = None
 
@@ -250,11 +263,32 @@ class Event(_Annotated):
     """(ref: profiler.h ProfileEvent:837)"""
 
 
+class Domain:
+    """Instrumentation namespace grouping Tasks/Counters/Markers
+    (ref: python/mxnet/profiler.py Domain -> MXProfileCreateDomain)."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value or 0)
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+    def __repr__(self):
+        return f"Domain(name={self.name})"
+
+
 class Counter:
     """(ref: profiler.h ProfileCounter:556) — host-side counter recorded into
     logs (XPlane has no free counters)."""
 
     def __init__(self, domain, name, value=0):
+        self.domain = domain
         self.name = name
         self.value = value
 
@@ -267,9 +301,18 @@ class Counter:
     def decrement(self, delta=1):
         self.value -= delta
 
+    def __iadd__(self, delta):
+        self.increment(delta)
+        return self
+
+    def __isub__(self, delta):
+        self.decrement(delta)
+        return self
+
 
 class Marker:
     def __init__(self, domain, name):
+        self.domain = domain
         self.name = name
 
     def mark(self, scope="process"):

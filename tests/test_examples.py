@@ -179,3 +179,28 @@ def test_cnn_text_classification():
 def test_dsd_pruning():
     log = _run("dsd_pruning.py", "--steps", "150", timeout=520)
     assert "dsd_pruning OK" in log
+
+
+def test_svm_mnist():
+    log = _run("svm_mnist.py", "--steps", "80", "--samples", "384")
+    assert "svm_mnist OK" in log
+
+
+def test_svrg_regression():
+    log = _run("svrg_regression.py", "--epochs", "6", "--samples", "256")
+    assert "svrg_regression OK" in log
+
+
+def test_vae_gan():
+    log = _run("vae_gan.py", "--iters", "40", timeout=520)
+    assert "vae_gan OK" in log
+
+
+def test_stochastic_depth():
+    log = _run("stochastic_depth.py", "--steps", "300", timeout=520)
+    assert "stochastic_depth OK" in log
+
+
+def test_profiler_demo():
+    log = _run("profiler_demo.py", "--steps", "12")
+    assert "profiler_demo OK" in log

@@ -230,3 +230,23 @@ def test_resnet50_train_step_footprint():
     assert s["alias_bytes"] > 1.8 * param_bytes
     # peak within a sane envelope: above the live state, below 20x it
     assert 2 * param_bytes < s["peak_bytes"] < 20 * param_bytes
+
+
+def test_profiler_domain_counter():
+    """Domain/Counter/Marker surface matches the reference's instrumentation
+    API (ref: python/mxnet/profiler.py Domain/Counter)."""
+    from incubator_mxnet_tpu import profiler
+
+    dom = profiler.Domain("example")
+    c = dom.new_counter("steps", 5)
+    c += 3
+    c -= 1
+    c.increment(2)
+    assert c.value == 9 and c.name == "steps" and c.domain is dom
+    t = dom.new_task("phase")
+    assert t.name == "phase" and t.domain is dom
+    with t:
+        pass
+    import pytest as _pytest
+    with _pytest.raises(TypeError):
+        profiler.Task(dom)  # name is required with a Domain
