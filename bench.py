@@ -183,6 +183,9 @@ def _run_child(dtype, attempts=3, timeout=1500, extra_env=None):
             if isinstance(partial, bytes):
                 partial = partial.decode("utf-8", "replace")
             d = _last_json_line(partial)
+            if d is not None and d.get("final"):
+                # complete measurement, child only hung in teardown
+                return d, None
             if d is not None:
                 d["partial"] = True
                 if best_partial is None or _score(d) > _score(best_partial):
@@ -318,9 +321,6 @@ def main():
             # launder a CPU number into an "on-chip" report). A salvaged
             # PARTIAL never overwrites a cached entry with a better number
             # (e.g. an earlier full scan-mode measurement).
-            def _score(r):
-                return max(r.get("ips", 0.0), r.get("scan_ips", 0.0))
-
             for k, r in results.items():
                 if r.get("platform") != "tpu":
                     continue
@@ -345,8 +345,7 @@ def main():
             with open(cache_path) as f:
                 cached = json.load(f)
         except (OSError, ValueError):
-            cached = _cache_from_artifacts(
-                os.path.dirname(os.path.abspath(__file__)))
+            cached = None
         # pre-merge-era cache files were written unfiltered and may hold a
         # silently-CPU entry; never report one as on-chip
         def _on_chip_entries(c):

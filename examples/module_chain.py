@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Module containers: SequentialModule chaining + a host-side Python loss
+(ref: example/module/sequential_module.py + example/module/python_loss.py).
+
+Two pipelines over the same synthetic 3-class problem:
+  1. SequentialModule[ feature Module -> softmax-head Module ] trained with
+     fit() — each stage is its own jitted XLA program, activations hand off
+     on-device.
+  2. SequentialModule[ scores Module -> PythonLossModule ] — the loss
+     gradient is supplied by a plain numpy function on the host, the
+     module-level analog of a CustomOp.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import sym
+
+
+def make_data(n=600, d=10, c=3, seed=0):
+    rng = np.random.RandomState(seed)
+    W = rng.randn(d, c)
+    X = rng.randn(n, d).astype("float32")
+    y = np.argmax(X @ W, axis=1).astype("float32")
+    return X, y
+
+
+def feat_sym():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=32, name="fc1")
+    return sym.Activation(net, act_type="relu", name="relu1")
+
+
+def head_sym(c):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=c, name="fc2")
+    return sym.SoftmaxOutput(net, sym.Variable("softmax_label"), name="softmax")
+
+
+def scores_sym(c):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    return sym.FullyConnected(net, num_hidden=c, name="fc2")
+
+
+def run_sequential(args):
+    X, y = make_data(seed=0)
+    train = mx.io.NDArrayIter(X[:500], y[:500], args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(X[500:], y[500:], args.batch_size)
+    seq = mx.module.SequentialModule()
+    seq.add(mx.module.Module(feat_sym(), label_names=None, context=mx.cpu()))
+    seq.add(mx.module.Module(head_sym(3), context=mx.cpu()),
+            take_labels=True, auto_wiring=True)
+    seq.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Xavier(), num_epoch=args.epochs,
+            eval_metric="acc")
+    acc = seq.score(val, "acc")[0][1]
+    print(f"sequential val-acc {acc:.3f}")
+    return acc
+
+
+def run_python_loss(args):
+    def softmax_xent_grad(scores, labels):
+        s = scores.asnumpy()
+        s = np.exp(s - s.max(axis=1, keepdims=True))
+        s /= s.sum(axis=1, keepdims=True)
+        onehot = np.eye(s.shape[1], dtype=s.dtype)[labels.asnumpy().astype(int)]
+        return (s - onehot) / s.shape[0]
+
+    X, y = make_data(seed=1)
+    it = mx.io.NDArrayIter(X, y, args.batch_size, shuffle=True)
+    seq = mx.module.SequentialModule()
+    seq.add(mx.module.Module(scores_sym(3), label_names=None, context=mx.cpu()))
+    seq.add(mx.module.PythonLossModule(grad_func=softmax_xent_grad),
+            take_labels=True, auto_wiring=True)
+    seq.bind(it.provide_data, it.provide_label, for_training=True)
+    seq.init_params(mx.init.Xavier())
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5, "momentum": 0.9})
+    for _ in range(args.epochs * 2):
+        it.reset()
+        for b in it:
+            seq.forward(b, is_train=True)
+            seq.backward()
+            seq.update()
+    it.reset()
+    good = total = 0
+    for b in it:
+        seq.forward(b, is_train=False)
+        pred = seq.get_outputs()[0].asnumpy().argmax(axis=1)
+        lab = b.label[0].asnumpy().astype(int)
+        good += (pred == lab).sum()
+        total += len(lab)
+    print(f"python-loss train-acc {good / total:.3f}")
+    return good / total
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=8)
+    p.add_argument("--batch-size", type=int, default=50)
+    args = p.parse_args()
+    acc1 = run_sequential(args)
+    acc2 = run_python_loss(args)
+    assert acc1 > 0.85 and acc2 > 0.85, (acc1, acc2)
+    print("module_chain OK")
+
+
+if __name__ == "__main__":
+    main()

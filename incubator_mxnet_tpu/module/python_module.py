@@ -1,0 +1,158 @@
+"""PythonModule / PythonLossModule: module-granularity host-side stages
+(ref: python/mxnet/module/python_module.py:28,243 — a BaseModule whose
+computation is arbitrary Python; the loss variant caches scores and turns a
+user `grad_func(scores, labels)` into the chain's input gradients).
+
+TPU-native shape: this is the module-level analog of `operator.CustomOp`'s
+`pure_callback` bridge — the stage runs on the host between the
+neighbouring stages' XLA programs. Use it for glue (custom losses, metrics
+probes, debugging) inside a `SequentialModule`; anything hot belongs in a
+jitted stage instead.
+"""
+from __future__ import annotations
+
+import logging
+
+from .base_module import BaseModule
+from ..ndarray.ndarray import NDArray
+from .. import ndarray as nd
+
+__all__ = ["PythonModule", "PythonLossModule"]
+
+
+class PythonModule(BaseModule):
+    """Base for modules implemented as plain Python: parameter-free by
+    default, with bind() reduced to shape bookkeeping. Subclasses override
+    forward/backward (and _compute_output_shapes for a non-identity
+    output signature)."""
+
+    def __init__(self, data_names, label_names, output_names, logger=logging):
+        super().__init__(logger=logger)
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._output_names = list(output_names)
+        self._data_shapes = None
+        self._label_shapes = None
+        self._output_shapes = None
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        return self._output_shapes
+
+    # -- parameters: none by default ---------------------------------------
+    def get_params(self):
+        return {}, {}
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        self.params_initialized = True
+
+    def update(self):
+        pass
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        pass
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.binded = True
+        self._data_shapes = [(n, tuple(s)) for n, s, *_ in
+                             (tuple(d) for d in data_shapes)]
+        self._label_shapes = ([(n, tuple(s)) for n, s, *_ in
+                               (tuple(d) for d in label_shapes)]
+                              if label_shapes else None)
+        self._output_shapes = self._compute_output_shapes()
+
+    def _compute_output_shapes(self):
+        raise NotImplementedError()
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        self.optimizer_initialized = True
+
+    def install_monitor(self, mon):
+        raise NotImplementedError()
+
+
+class PythonLossModule(PythonModule):
+    """Terminal loss stage: forward caches the incoming scores (and labels
+    when training); backward calls `grad_func(scores, labels) -> d(scores)`
+    and exposes it via get_input_grads for the upstream stage."""
+
+    def __init__(self, name="pyloss", data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 grad_func=None):
+        super().__init__(data_names, label_names, [name + "_output"],
+                         logger=logger)
+        self._name = name
+        assert len(data_names) == 1
+        assert len(label_names) == 1
+        self._scores = None
+        self._labels = None
+        self._scores_grad = None
+        if grad_func is not None:
+            assert callable(grad_func)
+        self._grad_func = grad_func
+
+    def _compute_output_shapes(self):
+        # a loss stage passes its scores through unchanged
+        return [(self._name + "_output", self._data_shapes[0][1])]
+
+    def forward(self, data_batch, is_train=None):
+        self._scores = data_batch.data[0]
+        if is_train is None:
+            is_train = self.for_training
+        if is_train:
+            self._labels = data_batch.label[0]
+
+    def get_outputs(self, merge_multi_context=True):
+        assert merge_multi_context is True
+        return [self._scores]
+
+    def backward(self, out_grads=None):
+        assert out_grads is None, "For a loss module, out_grads should be None"
+        assert self.for_training
+        self._backward_impl()
+
+    def _backward_impl(self):
+        """Compute d(loss)/d(scores) into self._scores_grad. Override, or
+        pass grad_func= at construction."""
+        if self._grad_func is None:
+            raise NotImplementedError(
+                "PythonLossModule needs a grad_func or a _backward_impl "
+                "override")
+        grad = self._grad_func(self._scores, self._labels)
+        if not isinstance(grad, NDArray):
+            grad = nd.array(grad)
+        self._scores_grad = grad
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert merge_multi_context is True
+        return [self._scores_grad]
+
+    def install_monitor(self, mon):
+        raise NotImplementedError()

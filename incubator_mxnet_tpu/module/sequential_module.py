@@ -1,0 +1,216 @@
+"""SequentialModule: chain independently-bound modules into one pipeline
+(ref: python/mxnet/module/sequential_module.py:28 — add/bind wire each
+sub-module's outputs to the next one's data; backward threads
+get_input_grads() in reverse).
+
+TPU-native shape: each sub-module owns its own jitted executor (its own XLA
+program); the chain is a host-side container. Activations between stages
+stay on-device (`jax.Array` hand-off, no host sync), so the cost of the
+split vs one fused program is only the lost cross-stage fusion — which is
+the documented trade of this "handy utility" container in the reference
+too. The same container is what module-granular pipeline composition looks
+like before graduating to `parallel/pipeline.py`'s shard_map version.
+"""
+from __future__ import annotations
+
+import copy
+import logging
+
+from .base_module import BaseModule
+
+__all__ = ["SequentialModule"]
+
+
+class SequentialModule(BaseModule):
+    """Container chaining multiple modules; data flows first->last, input
+    gradients flow last->first."""
+
+    META_TAKE_LABELS = "take_labels"
+    META_AUTO_WIRING = "auto_wiring"
+
+    def __init__(self, logger=logging):
+        super().__init__(logger=logger)
+        self._modules = []
+        self._metas = []
+        self._data_shapes = None
+        self._label_shapes = None
+        self._meta_keys = {self.META_TAKE_LABELS, self.META_AUTO_WIRING}
+
+    def add(self, module, **kwargs):
+        """Append `module`; meta kwargs: take_labels (this stage also sees
+        the batch labels), auto_wiring (rename incoming data to the stage's
+        own data_names). Returns self for chaining."""
+        for key in kwargs:
+            if key not in self._meta_keys:
+                raise ValueError(f'Unknown meta "{key}", a typo?')
+        self._modules.append(module)
+        self._metas.append(dict(kwargs))
+        # adding a stage invalidates any previous bind/init
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        return self
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def data_names(self):
+        return self._modules[0].data_names if self._modules else []
+
+    @property
+    def output_names(self):
+        return self._modules[-1].output_names if self._modules else []
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._modules[0].data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return self._modules[-1].output_shapes
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        arg_params, aux_params = {}, {}
+        for module in self._modules:
+            arg, aux = module.get_params()
+            arg_params.update(arg)
+            aux_params.update(aux)
+        return arg_params, aux_params
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before initializing the parameters"
+        for module in self._modules:
+            module.init_params(initializer=initializer, arg_params=arg_params,
+                               aux_params=aux_params,
+                               allow_missing=allow_missing,
+                               force_init=force_init, allow_extra=allow_extra)
+        self._check_duplicate_names()
+        self.params_initialized = True
+
+    def _check_duplicate_names(self):
+        """A parameter name may appear in at most one stage — a duplicate
+        would make get_params/set_params silently pick one of the two."""
+        owner = {}
+        for i, module in enumerate(self._modules):
+            arg, aux = module.get_params()
+            for name in list(arg) + list(aux):
+                if name in owner:
+                    raise ValueError(
+                        f'Duplicated parameter name "{name}": layer {i} '
+                        f"({type(module).__name__}) reuses a name already in "
+                        f"layer {owner[name]}")
+                owner[name] = i
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        """Bind every stage; stage i>0's data shapes are stage i-1's output
+        shapes, and every stage after the first is bound with
+        inputs_need_grad so backward can chain."""
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        if inputs_need_grad:
+            assert for_training
+        assert shared_module is None, "Shared module is not supported"
+        assert self._modules, "Attempting to bind an empty SequentialModule"
+        self.binded = True
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._label_shapes = label_shapes
+
+        my_data_shapes = data_shapes
+        anybody_needs_label = False
+        for i, (module, meta) in enumerate(zip(self._modules, self._metas)):
+            take_labels = bool(meta.get(self.META_TAKE_LABELS))
+            anybody_needs_label |= take_labels
+            if meta.get(self.META_AUTO_WIRING):
+                names = module.data_names
+                assert len(names) == len(my_data_shapes)
+                my_data_shapes = [
+                    (new_name, tuple(d[1] if isinstance(d, tuple) else d.shape))
+                    for new_name, d in zip(names, my_data_shapes)]
+            module.bind(
+                data_shapes=my_data_shapes,
+                label_shapes=label_shapes if take_labels else None,
+                for_training=for_training,
+                inputs_need_grad=bool(inputs_need_grad or
+                                      (for_training and i > 0)),
+                force_rebind=force_rebind, shared_module=None,
+                grad_req=grad_req)
+            my_data_shapes = module.output_shapes
+        if not anybody_needs_label:
+            self._label_shapes = None
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning("optimizer already initialized, ignoring.")
+            return
+        for module in self._modules:
+            module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                                  optimizer_params=optimizer_params,
+                                  force_init=force_init)
+        self.optimizer_initialized = True
+
+    # -- compute -----------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        batch = copy.copy(data_batch)  # keep pad/bucket_key, rewire data
+        for i, module in enumerate(self._modules):
+            module.forward(batch, is_train=is_train)
+            if i + 1 == len(self._modules):
+                break
+            batch.data = module.get_outputs()
+            if hasattr(batch, "provide_data"):
+                names = [n for n, _ in module.output_shapes]
+                batch.provide_data = [(n, x.shape)
+                                      for n, x in zip(names, batch.data)]
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        for i in reversed(range(len(self._modules))):
+            self._modules[i].backward(out_grads=out_grads)
+            if i == 0:
+                break
+            out_grads = self._modules[i].get_input_grads()
+
+    def update(self):
+        assert (self.binded and self.params_initialized
+                and self.optimizer_initialized)
+        for module in self._modules:
+            module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._modules[-1].get_outputs(
+            merge_multi_context=merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert (self.binded and self.params_initialized
+                and self.inputs_need_grad)
+        return self._modules[0].get_input_grads(
+            merge_multi_context=merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        assert self.binded and self.params_initialized
+        for meta, module in zip(self._metas, self._modules):
+            if meta.get(self.META_TAKE_LABELS):
+                module.update_metric(eval_metric, labels, pre_sliced)
+
+    def install_monitor(self, mon):
+        assert self.binded
+        for module in self._modules:
+            module.install_monitor(mon)

@@ -204,3 +204,8 @@ def test_stochastic_depth():
 def test_profiler_demo():
     log = _run("profiler_demo.py", "--steps", "12")
     assert "profiler_demo OK" in log
+
+
+def test_module_chain():
+    log = _run("module_chain.py", "--epochs", "6")
+    assert "module_chain OK" in log

@@ -129,3 +129,114 @@ def test_bucketing_module():
     w8 = mod._buckets[8]._exec.arg_dict["fc_shared_weight"].asnumpy()
     w4 = mod._buckets[4]._exec.arg_dict["fc_shared_weight"].asnumpy()
     assert_almost_equal(w8, w4)
+
+
+# -- SequentialModule / PythonModule (ref: module/sequential_module.py:28,
+#    module/python_module.py:243, example/module/python_loss.py) ------------
+
+def _feat_sym():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=32, name="fc1")
+    return sym.Activation(net, act_type="relu", name="relu1")
+
+
+def _head_sym(c=3):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=c, name="fc2")
+    return sym.SoftmaxOutput(net, sym.Variable("softmax_label"), name="softmax")
+
+
+def test_sequential_module_fit():
+    X, y = _make_data()
+    train = mx.io.NDArrayIter(X[:500], y[:500], batch_size=50, shuffle=True)
+    val = mx.io.NDArrayIter(X[500:], y[500:], batch_size=50)
+    seq = mx.module.SequentialModule()
+    seq.add(mx.module.Module(_feat_sym(), label_names=None, context=mx.cpu()))
+    seq.add(mx.module.Module(_head_sym(), context=mx.cpu()),
+            take_labels=True, auto_wiring=True)
+    seq.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Xavier(), num_epoch=8, eval_metric="acc")
+    arg_params, _ = seq.get_params()
+    assert {"fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"} <= set(arg_params)
+    score = seq.score(val, "acc")
+    assert score[0][1] > 0.9, f"val acc {score}"
+
+
+def test_sequential_module_matches_monolithic():
+    # one fwd/bwd through the chain produces the same first-layer gradients
+    # as the identical monolithic symbol
+    X, y = _make_data(n=40)
+    it = mx.io.NDArrayIter(X, y, batch_size=40)
+    batch = next(iter(it))
+
+    mono = mx.module.Module(_mlp_sym(), context=mx.cpu())
+    mono.bind(it.provide_data, it.provide_label, for_training=True)
+    mono.init_params(mx.init.Xavier(rnd_type="gaussian", magnitude=2))
+    arg_p, aux_p = mono.get_params()
+
+    seq = mx.module.SequentialModule()
+    seq.add(mx.module.Module(_feat_sym(), label_names=None, context=mx.cpu()))
+    seq.add(mx.module.Module(_head_sym(), context=mx.cpu()),
+            take_labels=True, auto_wiring=True)
+    seq.bind(it.provide_data, it.provide_label, for_training=True)
+    seq.set_params(arg_p, aux_p)
+
+    mono.forward(batch, is_train=True)
+    mono.backward()
+    seq.forward(batch, is_train=True)
+    seq.backward()
+
+    out_mono = mono.get_outputs()[0].asnumpy()
+    out_seq = seq.get_outputs()[0].asnumpy()
+    assert_almost_equal(out_mono, out_seq, rtol=1e-5, atol=1e-6)
+    g_mono = mono._exec.grad_dict["fc1_weight"].asnumpy()
+    g_seq = seq._modules[0]._exec.grad_dict["fc1_weight"].asnumpy()
+    assert_almost_equal(g_mono, g_seq, rtol=1e-4, atol=1e-6)
+
+
+def test_python_loss_module():
+    # Module scores -> host-side PythonLossModule with an explicit
+    # softmax-xent gradient (ref: example/module/python_loss.py)
+    def _scores_sym(c=3):
+        data = sym.Variable("data")
+        net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+        net = sym.Activation(net, act_type="relu")
+        return sym.FullyConnected(net, num_hidden=c, name="fc2")
+
+    def softmax_xent_grad(scores, labels):
+        s = scores.asnumpy()
+        s = np.exp(s - s.max(axis=1, keepdims=True))
+        s /= s.sum(axis=1, keepdims=True)
+        onehot = np.eye(s.shape[1], dtype=s.dtype)[labels.asnumpy().astype(int)]
+        return (s - onehot) / s.shape[0]
+
+    X, y = _make_data(n=200)
+    it = mx.io.NDArrayIter(X, y, batch_size=50, shuffle=True)
+    seq = mx.module.SequentialModule()
+    seq.add(mx.module.Module(_scores_sym(), label_names=None, context=mx.cpu()))
+    seq.add(mx.module.PythonLossModule(grad_func=softmax_xent_grad),
+            take_labels=True, auto_wiring=True)
+    seq.bind(it.provide_data, it.provide_label, for_training=True)
+    seq.init_params(mx.init.Xavier())
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5, "momentum": 0.9})
+
+    def accuracy():
+        it.reset()
+        good = total = 0
+        for b in it:
+            seq.forward(b, is_train=False)
+            pred = seq.get_outputs()[0].asnumpy().argmax(axis=1)
+            lab = b.label[0].asnumpy().astype(int)
+            good += (pred == lab).sum()
+            total += len(lab)
+        return good / total
+
+    for _ in range(20):
+        it.reset()
+        for b in it:
+            seq.forward(b, is_train=True)
+            seq.backward()
+            seq.update()
+    assert accuracy() > 0.9
