@@ -45,6 +45,7 @@ from . import callback  # noqa: F401
 from . import monitor  # noqa: F401
 from . import model  # noqa: F401
 from . import module  # noqa: F401
+from . import rnn  # noqa: F401
 from . import gluon  # noqa: F401
 from . import executor  # noqa: F401
 from . import engine  # noqa: F401

@@ -20,7 +20,7 @@ from .ndarray.ndarray import NDArray
 __all__ = [
     "Initializer", "init", "register", "create", "Zero", "One", "Constant",
     "Uniform", "Normal", "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear",
-    "LSTMBias", "Mixed", "Load", "InitDesc",
+    "LSTMBias", "FusedRNN", "Mixed", "Load", "InitDesc",
 ]
 
 _REGISTRY = {}
@@ -242,6 +242,67 @@ class LSTMBias(Initializer):
 
 
 @register
+class FusedRNN(Initializer):
+    """Initialize the fused RNN op's packed parameter vector (ref:
+    initializer.py FusedRNN): the weight section gets `init` (default
+    Xavier), the bias section zeros — except LSTM forget-gate i2h biases,
+    which get `forget_bias`. Layout must match ops/nn.py
+    _rnn_slice_params (weights per (layer, direction), then biases)."""
+
+    def __init__(self, init=None, num_hidden=0, num_layers=1, mode="lstm",
+                 bidirectional=False, forget_bias=1.0):
+        super().__init__(num_hidden=num_hidden, num_layers=num_layers,
+                         mode=mode, bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        if isinstance(init, str):
+            init = create(init)
+        self._init = init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        from .ops.nn import _GATES
+
+        H = self._num_hidden
+        D = 2 if self._bidirectional else 1
+        G = _GATES[self._mode]
+        L = self._num_layers
+        n_bias = L * D * 2 * G * H
+        n_weight = arr.shape[0] - n_bias
+
+        # the packed weight section is (G*H, inp)+(G*H, H) blocks per
+        # (layer, direction); the inner init must see each 2-D matrix (a
+        # flat vector would hit Xavier's 1-D zero-fill branch)
+        inner = self._init or Xavier()
+        inp0 = n_weight // (D * G * H) - (L - 1) * (H * D + H) - H
+        blocks = []
+        for layer in range(L):
+            inp = inp0 if layer == 0 else H * D
+            for _ in range(D):
+                for shape in ((G * H, inp), (G * H, H)):
+                    block = NDArray(jnp.zeros(shape, dtype=arr.dtype))
+                    inner._init_weight(name, block)
+                    blocks.append(block._data.reshape(-1))
+        weights = NDArray(jnp.concatenate(blocks))
+        assert weights.shape[0] == n_weight, \
+            "FusedRNN init walked a different layout than the op"
+
+        biases = np.zeros((n_bias,), dtype="float32")
+        if self._mode == "lstm":
+            # per (layer, direction): i2h biases [i f g o], then h2h
+            for blk in range(L * D):
+                start = blk * 2 * G * H + H  # forget gate of the i2h part
+                biases[start:start + H] = self._forget_bias
+        self._set(arr, jnp.concatenate(
+            [weights._data, jnp.asarray(biases, dtype=arr.dtype)]))
+
+    _init_bias = _init_weight
+
+
+@register
 class Mixed(Initializer):
     def __init__(self, patterns, initializers):
         super().__init__()
@@ -288,6 +349,7 @@ class init:
     MSRAPrelu = MSRAPrelu
     Bilinear = Bilinear
     LSTMBias = LSTMBias
+    FusedRNN = FusedRNN
     Mixed = Mixed
     Load = Load
     Initializer = Initializer

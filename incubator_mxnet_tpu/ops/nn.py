@@ -733,8 +733,12 @@ def rnn(
         dir_outs = []
         for d in range(D):
             idx = layer * D + d
-            h0 = state[idx]
-            carry = (h0, state_cell[idx]) if mode == "lstm" else (h0,)
+            # a (L*D, 1, H) initial state broadcasts over the batch (the
+            # symbolic rnn cells' begin_state default); scan carries need
+            # the full (B, H) shape up front
+            h0 = jnp.broadcast_to(state[idx], (B, H))
+            carry = ((h0, jnp.broadcast_to(state_cell[idx], (B, H)))
+                     if mode == "lstm" else (h0,))
             wx, wh, bxx, bhh = Wx[layer][d], Wh[layer][d], bx[layer][d], bh[layer][d]
             xs = jnp.flip(x, axis=0) if d == 1 else x
 

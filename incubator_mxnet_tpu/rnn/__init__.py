@@ -1,0 +1,10 @@
+"""Symbolic RNN API (ref: python/mxnet/rnn/)."""
+from .rnn_cell import (  # noqa: F401
+    BaseRNNCell, BidirectionalCell, DropoutCell, FusedRNNCell, GRUCell,
+    LSTMCell, ModifierCell, RNNCell, RNNParams, ResidualCell,
+    SequentialRNNCell, ZoneoutCell,
+)
+from .rnn import (  # noqa: F401
+    do_rnn_checkpoint, load_rnn_checkpoint, save_rnn_checkpoint,
+)
+from .io import BucketSentenceIter, encode_sentences  # noqa: F401

@@ -1,0 +1,138 @@
+"""Bucketed sentence iteration for language models
+(ref: python/mxnet/rnn/io.py — encode_sentences:31, BucketSentenceIter:84).
+
+TPU-native note: bucketing is the static-shape answer to variable-length
+sequences — one jitted program per bucket length (BucketingModule caches
+executors per bucket key), no dynamic shapes inside XLA.
+"""
+from __future__ import annotations
+
+import bisect
+import random
+
+import numpy as np
+
+from ..io import DataBatch, DataDesc, DataIter
+
+__all__ = ["encode_sentences", "BucketSentenceIter"]
+
+
+def encode_sentences(sentences, vocab=None, invalid_label=-1,
+                     invalid_key="\n", start_label=0, unknown_token=None):
+    """Map token-string sentences to int ids, growing `vocab` for unseen
+    tokens (or mapping them to `unknown_token` when a fixed vocab is
+    given). Returns (encoded_sentences, vocab)."""
+    if vocab is None:
+        vocab = {invalid_key: invalid_label}
+        growable = True
+    else:
+        growable = False
+    next_id = start_label
+    encoded = []
+    for sent in sentences:
+        ids = []
+        for word in sent:
+            if word not in vocab:
+                if not growable and not unknown_token:
+                    raise ValueError(f"unknown token {word!r} with a fixed "
+                                     "vocabulary and no unknown_token")
+                if next_id == invalid_label:
+                    next_id += 1
+                if unknown_token:
+                    word = unknown_token
+                if word not in vocab:
+                    vocab[word] = next_id
+                    next_id += 1
+            ids.append(vocab[word])
+        encoded.append(ids)
+    return encoded, vocab
+
+
+class BucketSentenceIter(DataIter):
+    """Pad each sentence up to its bucket length; label is the sequence
+    shifted left by one (next-token prediction). Batches come from one
+    bucket at a time so every batch has a static shape."""
+
+    def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
+                 data_name="data", label_name="softmax_label",
+                 dtype="float32", layout="NT"):
+        super().__init__(batch_size)
+        if not buckets:
+            counts = np.bincount([len(s) for s in sentences])
+            buckets = [length for length, n in enumerate(counts)
+                       if n >= batch_size]
+        buckets = sorted(buckets)
+        self.buckets = buckets
+        self.data_name = data_name
+        self.label_name = label_name
+        self.dtype = dtype
+        self.invalid_label = invalid_label
+        self.layout = layout
+        self.major_axis = layout.find("N")
+
+        padded = [[] for _ in buckets]
+        ndiscard = 0
+        for sent in sentences:
+            bucket = bisect.bisect_left(buckets, len(sent))
+            if bucket == len(buckets):
+                ndiscard += 1
+                continue
+            row = np.full((buckets[bucket],), invalid_label, dtype=dtype)
+            row[:len(sent)] = sent
+            padded[bucket].append(row)
+        if ndiscard:
+            import logging
+
+            logging.warning("discarded %d sentences longer than the largest "
+                            "bucket %d", ndiscard, buckets[-1])
+        self.data = [
+            np.asarray(rows, dtype=dtype) if rows
+            else np.empty((0, buckets[b]), dtype=dtype)
+            for b, rows in enumerate(padded)]
+
+        self.default_bucket_key = max(buckets)
+        shape = ((batch_size, self.default_bucket_key)
+                 if self.major_axis == 0
+                 else (self.default_bucket_key, batch_size))
+        self.provide_data = [DataDesc(data_name, shape, layout=layout)]
+        self.provide_label = [DataDesc(label_name, shape, layout=layout)]
+        self.idx = []
+        for b, rows in enumerate(self.data):
+            self.idx.extend((b, start) for start
+                            in range(0, len(rows) - batch_size + 1,
+                                     batch_size))
+        self.curr_idx = 0
+        self.reset()
+
+    def reset(self):
+        self.curr_idx = 0
+        random.shuffle(self.idx)
+        for rows in self.data:
+            np.random.shuffle(rows)
+        self.nddata, self.ndlabel = [], []
+        for rows in self.data:
+            label = np.empty_like(rows)
+            label[:, :-1] = rows[:, 1:]
+            label[:, -1] = self.invalid_label
+            self.nddata.append(rows)
+            self.ndlabel.append(label)
+
+    def next(self):
+        from .. import nd
+
+        if self.curr_idx == len(self.idx):
+            raise StopIteration
+        b, start = self.idx[self.curr_idx]
+        self.curr_idx += 1
+        data = self.nddata[b][start:start + self.batch_size]
+        label = self.ndlabel[b][start:start + self.batch_size]
+        if self.major_axis == 1:
+            data, label = data.T, label.T
+        shape = data.shape
+        return DataBatch(
+            data=[nd.array(data)], label=[nd.array(label)], pad=0,
+            bucket_key=self.buckets[b],
+            provide_data=[DataDesc(self.data_name, shape,
+                                   layout=self.layout)],
+            provide_label=[DataDesc(self.label_name, shape,
+                                    layout=self.layout)])

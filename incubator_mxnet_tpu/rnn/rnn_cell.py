@@ -1,0 +1,708 @@
+"""Symbolic RNN cell API (ref: python/mxnet/rnn/rnn_cell.py — BaseRNNCell
+:108, RNNCell:362, LSTMCell:408, GRUCell:469, FusedRNNCell:536,
+SequentialRNNCell:748, DropoutCell:827, ModifierCell:867, ZoneoutCell:909,
+ResidualCell:957, BidirectionalCell:998).
+
+TPU-native shape: a cell is a Symbol-graph builder; `unroll` emits a static
+length-T graph that XLA fuses into one program (static shapes — bucketing
+handles variable length, `symbol/` jit caches per bucket). `FusedRNNCell`
+targets the fused `sym.RNN` op, whose implementation is a `lax.scan` over
+the packed cuDNN-layout parameter vector (ops/nn.py:696) — the same
+one-program-per-sequence property the reference only gets on GPU via cuDNN.
+
+One documented deviation: initial states default to shape (1, H) zeros and
+broadcast against the (N, ...) batch inside the graph, instead of the
+reference's 0-as-unknown batch placeholder (our shape inference is
+jax.eval_shape, which has no unknown dims). Feed `begin_state(
+func=sym.Variable)` states explicitly to override.
+"""
+from __future__ import annotations
+
+from .. import initializer as init
+from .. import ndarray as nd
+from .. import symbol
+from ..ops.nn import _GATES, rnn_param_size
+
+__all__ = [
+    "RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+    "FusedRNNCell", "SequentialRNNCell", "DropoutCell", "ModifierCell",
+    "ZoneoutCell", "ResidualCell", "BidirectionalCell",
+]
+
+
+class RNNParams:
+    """Variable container enabling weight sharing between cells
+    (ref: rnn_cell.py:77)."""
+
+    def __init__(self, prefix=""):
+        self._prefix = prefix
+        self._params = {}
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        if name not in self._params:
+            self._params[name] = symbol.Variable(name, **kwargs)
+        return self._params[name]
+
+
+def _as_steps(inputs, length, layout):
+    """Normalize `inputs` into a per-step Symbol list.
+
+    Accepts one (N,T,...)/(T,N,...) Symbol (split along the T axis of
+    `layout`) or an existing list; returns (steps, t_axis)."""
+    t_axis = layout.find("T")
+    assert t_axis >= 0, f"invalid layout {layout}"
+    if isinstance(inputs, symbol.Symbol):
+        if len(inputs.list_outputs()) != 1:
+            raise ValueError("unroll does not accept grouped symbols; pass a "
+                             "list of per-step symbols instead")
+        steps = list(symbol.split(inputs, axis=t_axis, num_outputs=length,
+                                  squeeze_axis=1))
+    else:
+        steps = list(inputs)
+        assert length is None or len(steps) == length
+    return steps, t_axis
+
+
+def _merge_steps(outputs, layout, merge):
+    """Per-step Symbol list -> one stacked Symbol (merge=True) or the list
+    (merge=False/None)."""
+    if not merge:
+        return outputs
+    t_axis = layout.find("T")
+    return symbol.stack(*outputs, axis=t_axis)
+
+
+class BaseRNNCell:
+    """Graph-building recurrent cell: __call__ emits one step, unroll
+    emits T steps (ref: rnn_cell.py:108)."""
+
+    def __init__(self, prefix="", params=None):
+        if params is None:
+            params = RNNParams(prefix)
+            self._own_params = True
+        else:
+            self._own_params = False
+        self._prefix = prefix
+        self._params = params
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        """Reset step counters before building another graph."""
+        self._init_counter = -1
+        self._counter = -1
+        for cell in getattr(self, "_cells", []):
+            cell.reset()
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError()
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self._params
+
+    @property
+    def state_info(self):
+        raise NotImplementedError()
+
+    @property
+    def state_shape(self):
+        return [info["shape"] for info in self.state_info]
+
+    @property
+    def _gate_names(self):
+        return ()
+
+    def begin_state(self, func=symbol.zeros, **kwargs):
+        """Initial-state symbols, one per state_info entry. Default: (1, H)
+        zeros that broadcast over the batch (see module docstring)."""
+        assert not self._modified, (
+            "After applying modifier cells the base cell cannot be called "
+            "directly. Call the modifier cell instead.")
+        states = []
+        for info in self.state_info:
+            self._init_counter += 1
+            call_kwargs = dict(kwargs)
+            if info is not None:
+                call_kwargs.update(info)
+            call_kwargs.pop("__layout__", None)
+            states.append(func(
+                name=f"{self._prefix}begin_state_{self._init_counter}",
+                **call_kwargs))
+        return states
+
+    def unpack_weights(self, args):
+        """Split this cell's packed i2h/h2h arrays into per-gate entries
+        (ref: rnn_cell.py unpack_weights)."""
+        args = dict(args)
+        gates = self._gate_names
+        if not gates:
+            return args
+        h = self._num_hidden
+        for group in ("i2h", "h2h"):
+            w = args.pop(f"{self._prefix}{group}_weight")
+            b = args.pop(f"{self._prefix}{group}_bias")
+            for j, gate in enumerate(gates):
+                args[f"{self._prefix}{group}{gate}_weight"] = w[j*h:(j+1)*h].copy()
+                args[f"{self._prefix}{group}{gate}_bias"] = b[j*h:(j+1)*h].copy()
+        return args
+
+    def pack_weights(self, args):
+        """Inverse of unpack_weights."""
+        args = dict(args)
+        gates = self._gate_names
+        if not gates:
+            return args
+        for group in ("i2h", "h2h"):
+            ws = [args.pop(f"{self._prefix}{group}{g}_weight") for g in gates]
+            bs = [args.pop(f"{self._prefix}{group}{g}_bias") for g in gates]
+            args[f"{self._prefix}{group}_weight"] = nd.concatenate(ws)
+            args[f"{self._prefix}{group}_bias"] = nd.concatenate(bs)
+        return args
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        """Emit a length-T static graph; returns (outputs, final_states).
+        outputs is a stacked Symbol when merge_outputs=True, else a list."""
+        self.reset()
+        steps, _ = _as_steps(inputs, length, layout)
+        states = begin_state if begin_state is not None else self.begin_state()
+        outputs = []
+        for x in steps:
+            out, states = self(x, states)
+            outputs.append(out)
+        return _merge_steps(outputs, layout, merge_outputs), states
+
+    def _activate(self, x, activation, **kwargs):
+        if isinstance(activation, str):
+            return symbol.Activation(x, act_type=activation, **kwargs)
+        return activation(x, **kwargs)
+
+    def _gate_fc(self, inputs, state_h, n_units, name):
+        """The shared i2h/h2h affine pair every gate cell starts from."""
+        i2h = symbol.FullyConnected(
+            data=inputs, weight=self._iW, bias=self._iB,
+            num_hidden=n_units, name=f"{name}i2h")
+        h2h = symbol.FullyConnected(
+            data=state_h, weight=self._hW, bias=self._hB,
+            num_hidden=n_units, name=f"{name}h2h")
+        return i2h, h2h
+
+    def _fetch_params(self, bias_init=None):
+        self._iW = self.params.get("i2h_weight")
+        self._hW = self.params.get("h2h_weight")
+        self._iB = self.params.get(
+            "i2h_bias", **({"init": bias_init} if bias_init else {}))
+        self._hB = self.params.get("h2h_bias")
+
+
+class RNNCell(BaseRNNCell):
+    """Elman cell: h' = act(W_x x + W_h h + b) (ref: rnn_cell.py:362)."""
+
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_",
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._activation = activation
+        self._fetch_params()
+
+    @property
+    def state_info(self):
+        return [{"shape": (1, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("",)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f"{self._prefix}t{self._counter}_"
+        i2h, h2h = self._gate_fc(inputs, states[0], self._num_hidden, name)
+        out = self._activate(i2h + h2h, self._activation, name=f"{name}out")
+        return out, [out]
+
+
+class LSTMCell(BaseRNNCell):
+    """LSTM cell, gate order [i, f, g, o] matching the fused op
+    (ref: rnn_cell.py:408; ops/nn.py _lstm_step)."""
+
+    def __init__(self, num_hidden, prefix="lstm_", params=None,
+                 forget_bias=1.0):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._fetch_params(bias_init=init.LSTMBias(forget_bias=forget_bias))
+
+    @property
+    def state_info(self):
+        return [{"shape": (1, self._num_hidden), "__layout__": "NC"},
+                {"shape": (1, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_i", "_f", "_c", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f"{self._prefix}t{self._counter}_"
+        i2h, h2h = self._gate_fc(inputs, states[0], 4 * self._num_hidden, name)
+        i, f, g, o = symbol.SliceChannel(i2h + h2h, num_outputs=4,
+                                         name=f"{name}slice")
+        i = symbol.Activation(i, act_type="sigmoid", name=f"{name}i")
+        f = symbol.Activation(f, act_type="sigmoid", name=f"{name}f")
+        g = symbol.Activation(g, act_type="tanh", name=f"{name}c")
+        o = symbol.Activation(o, act_type="sigmoid", name=f"{name}o")
+        next_c = f * states[1] + i * g
+        next_h = o * symbol.Activation(next_c, act_type="tanh")
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(BaseRNNCell):
+    """cuDNN-variant GRU (reset gate applied to the h2h product incl. its
+    bias), matching the fused op (ref: rnn_cell.py:469; ops/nn.py
+    _gru_step)."""
+
+    def __init__(self, num_hidden, prefix="gru_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._fetch_params()
+
+    @property
+    def state_info(self):
+        return [{"shape": (1, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_r", "_z", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f"{self._prefix}t{self._counter}_"
+        prev_h = states[0]
+        i2h, h2h = self._gate_fc(inputs, prev_h, 3 * self._num_hidden, name)
+        ir, iz, inew = symbol.SliceChannel(i2h, num_outputs=3,
+                                           name=f"{name}i2h_slice")
+        hr, hz, hnew = symbol.SliceChannel(h2h, num_outputs=3,
+                                           name=f"{name}h2h_slice")
+        r = symbol.Activation(ir + hr, act_type="sigmoid", name=f"{name}r")
+        z = symbol.Activation(iz + hz, act_type="sigmoid", name=f"{name}z")
+        cand = symbol.Activation(inew + r * hnew, act_type="tanh",
+                                 name=f"{name}h")
+        next_h = (1.0 - z) * cand + z * prev_h
+        return next_h, [next_h]
+
+
+class FusedRNNCell(BaseRNNCell):
+    """Whole-sequence cell over the fused `sym.RNN` op: one lax.scan
+    program instead of T unrolled steps (ref: rnn_cell.py:536)."""
+
+    def __init__(self, num_hidden, num_layers=1, mode="lstm",
+                 bidirectional=False, dropout=0.0, get_next_state=False,
+                 forget_bias=1.0, prefix=None, params=None):
+        if prefix is None:
+            prefix = f"{mode}_"
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._dropout = dropout
+        self._get_next_state = get_next_state
+        self._forget_bias = forget_bias
+        self._directions = ["l", "r"] if bidirectional else ["l"]
+        self._parameter = self.params.get(
+            "parameters", init=init.FusedRNN(
+                None, num_hidden, num_layers, mode, bidirectional,
+                forget_bias))
+
+    @property
+    def state_info(self):
+        b = (2 if self._bidirectional else 1) * self._num_layers
+        n = 2 if self._mode == "lstm" else 1
+        return [{"shape": (b, 1, self._num_hidden), "__layout__": "LNC"}
+                for _ in range(n)]
+
+    @property
+    def _gate_names(self):
+        return {"rnn_relu": ("",), "rnn_tanh": ("",),
+                "lstm": ("_i", "_f", "_c", "_o"),
+                "gru": ("_r", "_z", "_o")}[self._mode]
+
+    def _per_matrix_names(self, num_input):
+        """Packed-layout walk: yields (name, shape) in the exact order
+        ops/nn.py _rnn_slice_params consumes the vector (weights for every
+        (layer, direction), then all biases)."""
+        H, D = self._num_hidden, len(self._directions)
+        gates = self._gate_names
+        for layer in range(self._num_layers):
+            inp = num_input if layer == 0 else H * D
+            for direction in self._directions:
+                for gate in gates:
+                    yield (f"{self._prefix}{direction}{layer}_i2h{gate}_weight",
+                           (H, inp))
+                for gate in gates:
+                    yield (f"{self._prefix}{direction}{layer}_h2h{gate}_weight",
+                           (H, H))
+        for layer in range(self._num_layers):
+            for direction in self._directions:
+                for gate in gates:
+                    yield (f"{self._prefix}{direction}{layer}_i2h{gate}_bias",
+                           (H,))
+                for gate in gates:
+                    yield (f"{self._prefix}{direction}{layer}_h2h{gate}_bias",
+                           (H,))
+
+    def _infer_num_input(self, total):
+        """Invert rnn_param_size for the layer-0 input width."""
+        H, D = self._num_hidden, len(self._directions)
+        G = _GATES[self._mode]
+        rest = rnn_param_size(self._num_layers, 0, H,
+                              self._bidirectional, self._mode)
+        return (total - rest) // (D * G * H)
+
+    def unpack_weights(self, args):
+        args = dict(args)
+        arr = args.pop(self._parameter.name)
+        flat = arr.asnumpy().reshape(-1)
+        num_input = self._infer_num_input(flat.size)
+        offset = 0
+        for name, shape in self._per_matrix_names(num_input):
+            n = 1
+            for s in shape:
+                n *= s
+            args[name] = nd.array(flat[offset:offset + n].reshape(shape))
+            offset += n
+        assert offset == flat.size, "invalid parameter size for FusedRNNCell"
+        return args
+
+    def pack_weights(self, args):
+        import numpy as np
+
+        args = dict(args)
+        first = f"{self._prefix}l0_i2h{self._gate_names[0]}_weight"
+        num_input = args[first].shape[1]
+        chunks = []
+        for name, shape in self._per_matrix_names(num_input):
+            chunks.append(args.pop(name).asnumpy().reshape(-1))
+        args[self._parameter.name] = nd.array(np.concatenate(chunks))
+        return args
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError(
+            "FusedRNNCell cannot be stepped; use unroll")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        # the fused op wants one (T, N, C) tensor
+        t_axis = layout.find("T")
+        if not isinstance(inputs, symbol.Symbol):
+            steps = [symbol.expand_dims(x, axis=0) for x in inputs]
+            data = symbol.Concat(*steps, dim=0)
+        elif t_axis == 1:
+            data = symbol.swapaxes(inputs, dim1=0, dim2=1)
+        else:
+            data = inputs
+        if begin_state is None:
+            begin_state = self.begin_state()
+        state_kw = {"state": begin_state[0]}
+        if self._mode == "lstm":
+            state_kw["state_cell"] = begin_state[1]
+        out = symbol.RNN(
+            data=data, parameters=self._parameter,
+            state_size=self._num_hidden, num_layers=self._num_layers,
+            bidirectional=self._bidirectional, p=self._dropout,
+            state_outputs=self._get_next_state, mode=self._mode,
+            name=self._prefix + "rnn", **state_kw)
+        if not self._get_next_state:
+            outputs, states = out, []
+        elif self._mode == "lstm":
+            outputs, states = out[0], [out[1], out[2]]
+        else:
+            outputs, states = out[0], [out[1]]
+        if t_axis == 1:
+            outputs = symbol.swapaxes(outputs, dim1=0, dim2=1)
+        if merge_outputs is False:
+            outputs = list(symbol.split(outputs, axis=t_axis,
+                                        num_outputs=length, squeeze_axis=1))
+        return outputs, states
+
+    def unfuse(self):
+        """Equivalent SequentialRNNCell of step-able cells (ref:
+        rnn_cell.py unfuse); weight names line up with unpack_weights."""
+        make = {
+            "rnn_relu": lambda p: RNNCell(self._num_hidden, activation="relu",
+                                          prefix=p),
+            "rnn_tanh": lambda p: RNNCell(self._num_hidden, activation="tanh",
+                                          prefix=p),
+            "lstm": lambda p: LSTMCell(self._num_hidden, prefix=p,
+                                       forget_bias=self._forget_bias),
+            "gru": lambda p: GRUCell(self._num_hidden, prefix=p),
+        }[self._mode]
+        stack = SequentialRNNCell()
+        for i in range(self._num_layers):
+            if self._bidirectional:
+                stack.add(BidirectionalCell(
+                    make(f"{self._prefix}l{i}_"),
+                    make(f"{self._prefix}r{i}_"),
+                    output_prefix=f"{self._prefix}bi_l{i}_"))
+            else:
+                stack.add(make(f"{self._prefix}l{i}_"))
+            if self._dropout > 0 and i != self._num_layers - 1:
+                stack.add(DropoutCell(self._dropout,
+                                      prefix=f"{self._prefix}_dropout{i}_"))
+        return stack
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """Vertical stack: each cell's output feeds the next (ref:
+    rnn_cell.py:748)."""
+
+    def __init__(self, params=None):
+        super().__init__(prefix="", params=params)
+        self._override_cell_params = params is not None
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+        if self._override_cell_params:
+            assert cell._own_params, (
+                "Either specify params for SequentialRNNCell or child "
+                "cells, not both.")
+            cell.params._params.update(self.params._params)
+        self.params._params.update(cell.params._params)
+
+    @property
+    def state_info(self):
+        return sum((c.state_info for c in self._cells), [])
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return sum((c.begin_state(**kwargs) for c in self._cells), [])
+
+    def unpack_weights(self, args):
+        for cell in self._cells:
+            args = cell.unpack_weights(args)
+        return args
+
+    def pack_weights(self, args):
+        for cell in self._cells:
+            args = cell.pack_weights(args)
+        return args
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states, p = [], 0
+        for cell in self._cells:
+            assert not isinstance(cell, BidirectionalCell), \
+                "BidirectionalCell cannot be stepped inside a stack"
+            n = len(cell.state_info)
+            inputs, st = cell(inputs, states[p:p + n])
+            p += n
+            next_states.extend(st)
+        return inputs, next_states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        if begin_state is None:
+            begin_state = self.begin_state()
+        next_states, p = [], 0
+        last = len(self._cells) - 1
+        for i, cell in enumerate(self._cells):
+            n = len(cell.state_info)
+            inputs, st = cell.unroll(
+                length, inputs=inputs, begin_state=begin_state[p:p + n],
+                layout=layout,
+                merge_outputs=merge_outputs if i == last else None)
+            p += n
+            next_states.extend(st)
+        return inputs, next_states
+
+
+class DropoutCell(BaseRNNCell):
+    """Stateless dropout stage for stacks (ref: rnn_cell.py:827)."""
+
+    def __init__(self, dropout, prefix="dropout_", params=None):
+        super().__init__(prefix, params)
+        self.dropout = float(dropout)
+
+    @property
+    def state_info(self):
+        return []
+
+    def __call__(self, inputs, states):
+        if self.dropout > 0:
+            inputs = symbol.Dropout(data=inputs, p=self.dropout)
+        return inputs, states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        if isinstance(inputs, symbol.Symbol) and merge_outputs is not False:
+            # whole-sequence tensor: one dropout over all steps
+            return self(inputs, [])
+        return super().unroll(length, inputs, begin_state=begin_state,
+                              layout=layout, merge_outputs=merge_outputs)
+
+
+class ModifierCell(BaseRNNCell):
+    """Wraps a base cell and alters its behavior; parameters stay with the
+    base cell (ref: rnn_cell.py:867)."""
+
+    def __init__(self, base_cell):
+        super().__init__()
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self.base_cell.params
+
+    @property
+    def state_info(self):
+        return self.base_cell.state_info
+
+    def begin_state(self, func=symbol.zeros, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+    def unpack_weights(self, args):
+        return self.base_cell.unpack_weights(args)
+
+    def pack_weights(self, args):
+        return self.base_cell.pack_weights(args)
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError()
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout: randomly keep previous outputs/states (ref:
+    rnn_cell.py:909)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        assert not isinstance(base_cell, FusedRNNCell), (
+            "FusedRNNCell does not support zoneout; unfuse() first")
+        assert not isinstance(base_cell, BidirectionalCell), (
+            "Apply ZoneoutCell to the cells underneath the "
+            "BidirectionalCell instead")
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self.prev_output = None
+
+    def reset(self):
+        super().reset()
+        self.prev_output = None
+
+    def __call__(self, inputs, states):
+        cell = self.base_cell
+        p_out, p_st = self.zoneout_outputs, self.zoneout_states
+        next_out, next_states = cell(inputs, states)
+
+        def keep_mask(p, like):
+            return symbol.Dropout(symbol.ones_like(like), p=p)
+
+        prev = self.prev_output
+        if prev is None:
+            prev = symbol.zeros_like(next_out)
+        out = (symbol.where(keep_mask(p_out, next_out), next_out, prev)
+               if p_out != 0.0 else next_out)
+        new_states = (
+            [symbol.where(keep_mask(p_st, ns), ns, os)
+             for ns, os in zip(next_states, states)]
+            if p_st != 0.0 else next_states)
+        self.prev_output = out
+        return out, new_states
+
+
+class ResidualCell(ModifierCell):
+    """output = base(output) + input (ref: rnn_cell.py:957)."""
+
+    def __call__(self, inputs, states):
+        out, states = self.base_cell(inputs, states)
+        return out + inputs, states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        self.base_cell._modified = False
+        outputs, states = self.base_cell.unroll(
+            length, inputs=inputs, begin_state=begin_state, layout=layout,
+            merge_outputs=merge_outputs)
+        self.base_cell._modified = True
+        if isinstance(outputs, symbol.Symbol):
+            if not isinstance(inputs, symbol.Symbol):
+                inputs = _merge_steps(list(inputs), layout, True)
+            return outputs + inputs, states
+        steps, _ = _as_steps(inputs, length, layout)
+        return [o + x for o, x in zip(outputs, steps)], states
+
+
+class BidirectionalCell(BaseRNNCell):
+    """Runs one cell forward and one on the reversed sequence; outputs are
+    concatenated per step (ref: rnn_cell.py:998)."""
+
+    def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
+        super().__init__("", params=params)
+        self._output_prefix = output_prefix
+        self._override_cell_params = params is not None
+        if self._override_cell_params:
+            assert l_cell._own_params and r_cell._own_params, (
+                "Either specify params for BidirectionalCell or child "
+                "cells, not both.")
+            l_cell.params._params.update(self.params._params)
+            r_cell.params._params.update(self.params._params)
+        self.params._params.update(l_cell.params._params)
+        self.params._params.update(r_cell.params._params)
+        self._cells = [l_cell, r_cell]
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError(
+            "BidirectionalCell cannot be stepped; use unroll")
+
+    @property
+    def state_info(self):
+        return sum((c.state_info for c in self._cells), [])
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return sum((c.begin_state(**kwargs) for c in self._cells), [])
+
+    def unpack_weights(self, args):
+        for cell in self._cells:
+            args = cell.unpack_weights(args)
+        return args
+
+    def pack_weights(self, args):
+        for cell in self._cells:
+            args = cell.pack_weights(args)
+        return args
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        steps, _ = _as_steps(inputs, length, layout)
+        if begin_state is None:
+            begin_state = self.begin_state()
+        l_cell, r_cell = self._cells
+        n_l = len(l_cell.state_info)
+        l_out, l_states = l_cell.unroll(
+            length, inputs=steps, begin_state=begin_state[:n_l],
+            layout=layout, merge_outputs=False)
+        r_out, r_states = r_cell.unroll(
+            length, inputs=list(reversed(steps)),
+            begin_state=begin_state[n_l:], layout=layout,
+            merge_outputs=False)
+        outputs = [
+            symbol.Concat(lo, ro, dim=1,
+                          name=f"{self._output_prefix}t{i}")
+            for i, (lo, ro) in enumerate(zip(l_out, reversed(r_out)))]
+        return (_merge_steps(outputs, layout, merge_outputs),
+                l_states + r_states)

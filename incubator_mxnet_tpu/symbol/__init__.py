@@ -6,5 +6,9 @@ from . import register as _register
 
 _register.install_ops(globals())
 
+# public creation aliases (ref: python/mxnet/symbol/symbol.py zeros/ones)
+zeros = globals()["_zeros"]
+ones = globals()["_ones"]
+
 from . import infer  # noqa: E402,F401
 from . import contrib  # noqa: E402,F401

@@ -1,0 +1,228 @@
+"""Symbolic RNN cell tests (ref: tests/python/unittest/test_rnn.py —
+shape checks per cell, fused-vs-unfused numerical equivalence)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, rnn, sym
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+B, T, I, H = 4, 3, 5, 6
+
+
+def _unroll_args(cell, **kw):
+    inputs = [sym.Variable(f"t{i}_data") for i in range(T)]
+    outputs, states = cell.unroll(T, inputs, **kw)
+    return outputs, states
+
+
+def _bind_and_run(outputs, shapes, seed=7):
+    grouped = sym.Group(outputs) if isinstance(outputs, list) else outputs
+    args = grouped.list_arguments()
+    rng = np.random.RandomState(seed)
+    inferred, _, _ = grouped.infer_shape(**shapes)
+    feed = {}
+    for name, shp in zip(args, inferred):
+        feed[name] = rng.uniform(-0.5, 0.5, size=shp).astype("float32")
+    ex = grouped.simple_bind(**{k: tuple(v.shape) for k, v in feed.items()})
+    outs = ex.forward(**feed)
+    return [o.asnumpy() for o in outs], feed
+
+
+def test_rnn_cell_shapes():
+    cell = rnn.RNNCell(H, prefix="rnn_")
+    outputs, states = _unroll_args(cell, merge_outputs=False)
+    assert len(outputs) == T and len(states) == 1
+    assert sorted(cell.params._params) == [
+        "rnn_h2h_bias", "rnn_h2h_weight", "rnn_i2h_bias", "rnn_i2h_weight"]
+    outs, _ = _bind_and_run(outputs,
+                            {f"t{i}_data": (B, I) for i in range(T)})
+    assert all(o.shape == (B, H) for o in outs)
+
+
+def test_lstm_gru_cell_shapes():
+    for cell, n_states in ((rnn.LSTMCell(H, prefix="lstm_"), 2),
+                           (rnn.GRUCell(H, prefix="gru_"), 1)):
+        outputs, states = _unroll_args(cell, merge_outputs=False)
+        assert len(states) == n_states
+        outs, _ = _bind_and_run(outputs,
+                                {f"t{i}_data": (B, I) for i in range(T)})
+        assert all(o.shape == (B, H) for o in outs)
+
+
+def test_unroll_merge_layouts():
+    cell = rnn.GRUCell(H)
+    data = sym.Variable("data")
+    merged, _ = cell.unroll(T, data, layout="NTC", merge_outputs=True)
+    outs, _ = _bind_and_run(merged, {"data": (B, T, I)})
+    assert outs[0].shape == (B, T, H)
+    cell.reset()
+    tnc, _ = cell.unroll(T, sym.Variable("data"), layout="TNC",
+                         merge_outputs=True)
+    outs_t, _ = _bind_and_run(tnc, {"data": (T, B, I)})
+    assert outs_t[0].shape == (T, B, H)
+
+
+def test_sequential_and_modifier_cells():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(H, prefix="l0_"))
+    stack.add(rnn.ResidualCell(rnn.LSTMCell(H, prefix="l1_")))
+    stack.add(rnn.DropoutCell(0.0))
+    outputs, states = _unroll_args(stack, merge_outputs=False)
+    assert len(states) == 4  # 2 LSTM cells x (h, c)
+    outs, _ = _bind_and_run(outputs,
+                            {f"t{i}_data": (B, H) for i in range(T)})
+    assert all(o.shape == (B, H) for o in outs)
+
+
+def test_bidirectional_cell():
+    cell = rnn.BidirectionalCell(rnn.LSTMCell(H, prefix="l_"),
+                                 rnn.LSTMCell(H, prefix="r_"))
+    outputs, states = _unroll_args(cell, merge_outputs=False)
+    assert len(states) == 4
+    outs, _ = _bind_and_run(outputs,
+                            {f"t{i}_data": (B, I) for i in range(T)})
+    assert all(o.shape == (B, 2 * H) for o in outs)
+
+
+def test_zoneout_cell_runs():
+    cell = rnn.ZoneoutCell(rnn.RNNCell(H), zoneout_outputs=0.5,
+                           zoneout_states=0.5)
+    outputs, _ = _unroll_args(cell, merge_outputs=False)
+    outs, _ = _bind_and_run(outputs,
+                            {f"t{i}_data": (B, I) for i in range(T)})
+    assert all(o.shape == (B, H) for o in outs)
+
+
+@pytest.mark.parametrize("mode,bidirectional", [
+    ("lstm", False), ("gru", False), ("rnn_tanh", False), ("lstm", True)])
+def test_fused_matches_unfused(mode, bidirectional):
+    """FusedRNNCell (lax.scan program) and its unfuse() stack (unrolled
+    graph) are the same function once weights cross pack/unpack."""
+    layers = 2
+    fused = rnn.FusedRNNCell(H, num_layers=layers, mode=mode,
+                             bidirectional=bidirectional,
+                             get_next_state=False, prefix=f"{mode}_")
+    data = sym.Variable("data")
+    f_out, _ = fused.unroll(T, data, layout="NTC", merge_outputs=True)
+
+    rng = np.random.RandomState(0)
+    from incubator_mxnet_tpu.ops.nn import rnn_param_size
+
+    n_params = rnn_param_size(layers, I, H, bidirectional, mode)
+    packed = nd.array(rng.uniform(-0.5, 0.5, size=(n_params,))
+                      .astype("float32"))
+    x = rng.uniform(-1, 1, size=(B, T, I)).astype("float32")
+
+    ex = f_out.simple_bind(data=(B, T, I),
+                           **{fused._parameter.name: (n_params,)})
+    fused_val = ex.forward(data=x, **{fused._parameter.name: packed})[0].asnumpy()
+
+    stack = fused.unfuse()
+    s_out, _ = stack.unroll(T, sym.Variable("data"), layout="NTC",
+                            merge_outputs=True)
+    unpacked = stack.pack_weights(fused.unpack_weights(
+        {fused._parameter.name: packed}))
+    shapes = {k: tuple(v.shape) for k, v in unpacked.items()}
+    ex2 = s_out.simple_bind(data=(B, T, I), **shapes)
+    stack_val = ex2.forward(data=x, **unpacked)[0].asnumpy()
+
+    assert fused_val.shape == stack_val.shape == (B, T, H * (1 + bidirectional))
+    assert_almost_equal(fused_val, stack_val, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_pack_unpack_roundtrip():
+    fused = rnn.FusedRNNCell(H, num_layers=2, mode="lstm", prefix="lstm_")
+    from incubator_mxnet_tpu.ops.nn import rnn_param_size
+
+    n = rnn_param_size(2, I, H, False, "lstm")
+    packed = nd.array(np.random.RandomState(1).randn(n).astype("float32"))
+    back = fused.pack_weights(fused.unpack_weights(
+        {fused._parameter.name: packed}))
+    assert_almost_equal(back[fused._parameter.name].asnumpy(),
+                        packed.asnumpy())
+
+
+def test_simple_cell_pack_unpack_roundtrip():
+    cell = rnn.LSTMCell(H, prefix="lstm_")
+    rng = np.random.RandomState(2)
+    args = {
+        "lstm_i2h_weight": nd.array(rng.randn(4 * H, I).astype("float32")),
+        "lstm_i2h_bias": nd.array(rng.randn(4 * H).astype("float32")),
+        "lstm_h2h_weight": nd.array(rng.randn(4 * H, H).astype("float32")),
+        "lstm_h2h_bias": nd.array(rng.randn(4 * H).astype("float32")),
+    }
+    unpacked = cell.unpack_weights(args)
+    assert f"lstm_i2h_f_weight" in unpacked
+    repacked = cell.pack_weights(unpacked)
+    for k in args:
+        assert_almost_equal(repacked[k].asnumpy(), args[k].asnumpy())
+
+
+def test_rnn_checkpoint_roundtrip(tmp_path):
+    fused = rnn.FusedRNNCell(H, num_layers=1, mode="gru", prefix="gru_")
+    data = sym.Variable("data")
+    out, _ = fused.unroll(T, data, layout="NTC", merge_outputs=True)
+    from incubator_mxnet_tpu.ops.nn import rnn_param_size
+
+    n = rnn_param_size(1, I, H, False, "gru")
+    arg_params = {fused._parameter.name:
+                  nd.array(np.random.RandomState(3).randn(n)
+                           .astype("float32"))}
+    prefix = str(tmp_path / "rnnmodel")
+    rnn.save_rnn_checkpoint(fused, prefix, 1, out, arg_params, {})
+    sym2, arg2, _ = rnn.load_rnn_checkpoint(fused, prefix, 1)
+    assert_almost_equal(arg2[fused._parameter.name].asnumpy(),
+                        arg_params[fused._parameter.name].asnumpy())
+
+
+def test_encode_sentences_and_bucket_iter():
+    sentences = [["the", "cat", "sat"], ["a", "dog", "ran", "far"],
+                 ["the", "dog", "sat"], ["a", "cat", "ran", "far"],
+                 ["the", "cat"], ["a", "dog"]]
+    encoded, vocab = rnn.encode_sentences(sentences, start_label=1)
+    assert all(tok in vocab for s in sentences for tok in s)
+    it = rnn.BucketSentenceIter(encoded, batch_size=2, buckets=[2, 3, 4],
+                               invalid_label=0)
+    seen = 0
+    for batch in it:
+        seen += 1
+        assert batch.data[0].shape == (2, batch.bucket_key)
+        d = batch.data[0].asnumpy()
+        l = batch.label[0].asnumpy()
+        # label is data shifted one step left
+        np.testing.assert_array_equal(l[:, :-1], d[:, 1:])
+    assert seen == 3
+    it.reset()
+    assert sum(1 for _ in it) == 3
+
+
+def test_fused_default_init_nonzero():
+    # Module-path init must produce non-zero weights (the packed vector is
+    # 1-D; the initializer must init per weight matrix, not the flat blob)
+    fused = rnn.FusedRNNCell(H, num_layers=2, mode="lstm", prefix="lstm_")
+    data = sym.Variable("data")
+    out, _ = fused.unroll(T, data, layout="NTC", merge_outputs=True)
+    net = sym.FullyConnected(sym.Reshape(out, shape=(-3, -1)), num_hidden=2)
+    net = sym.SoftmaxOutput(net, sym.Variable("softmax_label"))
+    it = mx.io.NDArrayIter(
+        np.random.RandomState(0).rand(8, T, I).astype("float32"),
+        np.zeros((8, T), "float32").reshape(8, T)[:, 0], batch_size=8)
+    mod = mx.module.Module(net, context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    packed = mod.get_params()[0][fused._parameter.name].asnumpy()
+    n_bias = 2 * 1 * 2 * 4 * H  # L * D * 2 * G * H
+    n_weight = packed.size - n_bias
+    w = packed[:n_weight]
+    assert np.abs(w).min() >= 0 and np.count_nonzero(w) > 0.9 * w.size
+    # forget-gate biases carry the forget_bias constant
+    assert packed[n_weight + H:n_weight + 2 * H].mean() == pytest.approx(1.0)
+
+
+def test_bucket_iter_empty_bucket():
+    # a user-specified bucket with no sentences must not crash reset()
+    sents = [[1, 2], [3, 4], [5, 6], [7, 8]]
+    it = rnn.BucketSentenceIter(sents, batch_size=2, buckets=[2, 9],
+                                invalid_label=0)
+    assert sum(1 for _ in it) == 2
