@@ -46,6 +46,9 @@ from . import monitor  # noqa: F401
 from . import model  # noqa: F401
 from . import module  # noqa: F401
 from . import rnn  # noqa: F401
+from . import name  # noqa: F401
+from . import attribute  # noqa: F401
+from .attribute import AttrScope  # noqa: F401
 from . import gluon  # noqa: F401
 from . import executor  # noqa: F401
 from . import engine  # noqa: F401

@@ -1,0 +1,54 @@
+"""Attribute scopes for symbols (ref: python/mxnet/attribute.py —
+AttrScope:26). `with mx.AttrScope(ctx_group="dev1", lr_mult="0.1"):` stamps
+the given attributes onto every symbol (and auto-created weight variable)
+built inside the scope; nested scopes merge, inner keys winning.
+
+Scope state lives on a module-level stack (never on the scope object), so
+one AttrScope instance can be entered repeatedly — even nested within
+itself — without corrupting later symbol builds."""
+from __future__ import annotations
+
+__all__ = ["AttrScope", "current"]
+
+# (scope_object, effective_attrs) frames; effective = all enclosing scopes
+# merged, inner keys winning
+_STACK = []
+
+
+class AttrScope:
+    def __init__(self, **kwargs):
+        self._attr = dict(kwargs)
+
+    def get(self, attr=None):
+        """This scope's attributes merged with explicit `attr`
+        (explicit wins)."""
+        out = self._attr.copy()
+        if attr:
+            out.update(attr)
+        return out
+
+    def __enter__(self):
+        parent = _STACK[-1][1] if _STACK else {}
+        merged = {**parent, **self._attr}
+        _STACK.append((self, merged))
+        return self
+
+    def __exit__(self, exc_type, exc_value, traceback):
+        _STACK.pop()
+
+
+def current():
+    """The innermost active scope, or None."""
+    return _STACK[-1][0] if _STACK else None
+
+
+def resolve(attr=None):
+    """Attributes the active scopes assign, merged with `attr`
+    (explicit wins)."""
+    effective = _STACK[-1][1] if _STACK else None
+    if not effective:
+        return dict(attr) if attr else {}
+    out = effective.copy()
+    if attr:
+        out.update(attr)
+    return out

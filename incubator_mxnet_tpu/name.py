@@ -1,0 +1,65 @@
+"""Automatic symbol naming scopes (ref: python/mxnet/name.py —
+NameManager:25, Prefix:74). `with mx.name.Prefix("layer1_"):` prepends the
+prefix to every auto-generated (and explicit) symbol name created in the
+scope; a plain NameManager scope restarts hint counters from 0.
+
+The active-manager state lives on a module-level stack so one manager
+object can be entered repeatedly (even nested within itself) without
+leaving the scope permanently active."""
+from __future__ import annotations
+
+from .symbol.symbol import name_uid
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+_STACK = []
+
+
+class NameManager:
+    """Scope that turns `hint`s into unique names. Each manager instance
+    counts per hint from zero; entering pushes it as the active scope."""
+
+    def __init__(self):
+        self._counter = {}
+
+    def get(self, name, hint):
+        """Resolve a symbol name: an explicit `name` wins, else
+        `hint<N>` with this manager's counter."""
+        if name:
+            return name
+        n = self._counter.get(hint, 0)
+        self._counter[hint] = n + 1
+        return f"{hint}{n}"
+
+    def __enter__(self):
+        _STACK.append(self)
+        return self
+
+    def __exit__(self, exc_type, exc_value, traceback):
+        _STACK.pop()
+
+
+class Prefix(NameManager):
+    """NameManager that prepends `prefix` to every resolved name."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return self._prefix + super().get(name, hint)
+
+
+def current():
+    """The innermost active manager, or None."""
+    return _STACK[-1] if _STACK else None
+
+
+def resolve(name, hint):
+    """Active-scope name resolution; without a scope, fall back to the
+    process-global per-hint uid counters (stable auto-names like
+    `slicechannel0` across managers)."""
+    mgr = current()
+    if mgr is not None:
+        return mgr.get(name, hint)
+    return name or name_uid(hint)

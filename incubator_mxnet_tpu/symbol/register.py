@@ -33,12 +33,14 @@ def _should_create_input(op: OpDef, input_name: str, attrs: dict) -> bool:
 
 
 def invoke_symbol(op_name, args, kwargs):
+    from .. import attribute, name as name_scope
+
     op = OP_REGISTRY[op_name]
     kwargs = dict(kwargs)
     name = kwargs.pop("name", None)
-    kwargs.pop("attr", None)
+    scope_attrs = attribute.resolve(kwargs.pop("attr", None))
     base = op.name.lower().lstrip("_")
-    name = name or name_uid(base)
+    name = name_scope.resolve(name, base)
 
     if op.variadic:
         inputs = [a for a in args if isinstance(a, Symbol)]
@@ -48,6 +50,8 @@ def invoke_symbol(op_name, args, kwargs):
         attrs = dict(kwargs)
         entries = [s._outputs[0] for s in inputs]
         node = _Node(op, name, attrs, entries)
+        if scope_attrs:
+            node.misc_attrs.update(scope_attrs)
         return Symbol([(node, i) for i in range(node.num_outputs)])
 
     slots: list = [None] * len(op.inputs)
@@ -80,6 +84,8 @@ def invoke_symbol(op_name, args, kwargs):
         entries.append(s._outputs[0])
 
     node = _Node(op, name, attrs, entries)
+    if scope_attrs:
+        node.misc_attrs.update(scope_attrs)
     return Symbol([(node, i) for i in range(node.num_outputs)])
 
 

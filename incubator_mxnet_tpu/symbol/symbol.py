@@ -461,15 +461,22 @@ def _attr_str(v):
 def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
              init=None, stype=None, **kwargs):
     """Create a symbolic variable (ref: sym.Variable)."""
+    from .. import attribute
+
     node = _Node(None, name, {}, [])
+    scope_attrs = attribute.resolve(None)
+    if scope_attrs:
+        node.misc_attrs.update(scope_attrs)
     if shape is not None:
         node.misc_attrs["__shape__"] = tuple(shape)
     if dtype is not None:
         node.misc_attrs["__dtype__"] = str(dtype)
     if lr_mult is not None:
-        node.misc_attrs["lr_mult"] = lr_mult
+        # dunder keys: what Optimizer.set_lr_mult/set_wd_mult read from
+        # attr_dict (ref: symbol.py Variable -> __lr_mult__)
+        node.misc_attrs["__lr_mult__"] = lr_mult
     if wd_mult is not None:
-        node.misc_attrs["wd_mult"] = wd_mult
+        node.misc_attrs["__wd_mult__"] = wd_mult
     if init is not None:
         node.misc_attrs["__init__"] = init
     if attr:

@@ -240,3 +240,30 @@ def test_python_loss_module():
             seq.backward()
             seq.update()
     assert accuracy() > 0.9
+
+
+def test_variable_lr_mult_reaches_optimizer():
+    # Variable(lr_mult=...) -> symbol attr -> optimizer multiplier
+    # (ref: symbol.py Variable __lr_mult__ + optimizer.py set_lr_mult)
+    X, y = _make_data(n=40)
+    it = mx.io.NDArrayIter(X, y, batch_size=40)
+    data = sym.Variable("data")
+    w_frozen = sym.Variable("fc1_weight", lr_mult=0.0)
+    net = sym.FullyConnected(data, weight=w_frozen, num_hidden=8, name="fc1")
+    net = sym.FullyConnected(net, num_hidden=3, name="fc2")
+    net = sym.SoftmaxOutput(net, sym.Variable("softmax_label"), name="softmax")
+    mod = mx.module.Module(net, context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label, for_training=True)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5, "wd": 0.0})
+    w_before = mod.get_params()[0]["fc1_weight"].asnumpy().copy()
+    w2_before = mod.get_params()[0]["fc2_weight"].asnumpy().copy()
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    w_after = mod.get_params()[0]["fc1_weight"].asnumpy()
+    w2_after = mod.get_params()[0]["fc2_weight"].asnumpy()
+    assert_almost_equal(w_before, w_after)  # lr_mult=0 froze fc1
+    assert np.abs(w2_after - w2_before).max() > 0  # fc2 still learns

@@ -96,3 +96,80 @@ def test_variable_shape_attr():
     y = sym.relu(v)
     args, outs, _ = y.infer_shape()
     assert outs == [(3, 4)]
+
+
+# -- naming + attribute scopes (ref: python/mxnet/name.py, attribute.py) ----
+
+def test_name_prefix_scope():
+    import incubator_mxnet_tpu as mx
+
+    with mx.name.Prefix("stage1_"):
+        s = sym.FullyConnected(sym.Variable("data"), num_hidden=4)
+    assert s.list_outputs()[0].startswith("stage1_fullyconnected")
+    # auto-created weights inherit the resolved layer name
+    assert any(a.startswith("stage1_") and a.endswith("_weight")
+               for a in s.list_arguments())
+
+
+def test_name_manager_counts_per_scope():
+    import incubator_mxnet_tpu as mx
+
+    with mx.name.NameManager():
+        a = sym.Activation(sym.Variable("x"), act_type="relu")
+        b = sym.Activation(sym.Variable("y"), act_type="relu")
+    with mx.name.NameManager():
+        c = sym.Activation(sym.Variable("z"), act_type="relu")
+    assert a.list_outputs()[0] == "activation0_output"
+    assert b.list_outputs()[0] == "activation1_output"
+    assert c.list_outputs()[0] == "activation0_output"  # fresh scope restarts
+
+
+def test_attr_scope_stamps_symbols():
+    import incubator_mxnet_tpu as mx
+
+    with mx.AttrScope(ctx_group="dev1", lr_mult="0.1"):
+        fc = sym.FullyConnected(sym.Variable("data"), num_hidden=4, name="fc")
+        with mx.AttrScope(ctx_group="dev2"):
+            act = sym.Activation(fc, act_type="relu", name="act")
+    net = sym.Group([act])
+    attrs = net.attr_dict()
+    assert attrs["fc"]["ctx_group"] == "dev1"
+    assert attrs["fc"]["lr_mult"] == "0.1"
+    # nested scope overrides ctx_group but inherits lr_mult
+    assert attrs["act"]["ctx_group"] == "dev2"
+    assert attrs["act"]["lr_mult"] == "0.1"
+    # variables created in scope are stamped too
+    with mx.AttrScope(lr_mult="2"):
+        v = sym.Variable("w")
+    assert v.attr("lr_mult") == "2"
+    # outside any scope nothing leaks
+    clean = sym.Variable("clean")
+    assert clean.attr("lr_mult") is None
+
+
+def test_scope_reentrancy():
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import attribute, name as name_scope
+
+    # one scope object entered twice (even self-nested) must fully unwind
+    s = mx.AttrScope(a="1")
+    with s:
+        with s:
+            pass
+    assert attribute.current() is None
+    assert sym.Variable("clean_reent").attr("a") is None
+    # entering inside another scope must not fold the outer attrs into s
+    with mx.AttrScope(b="2"):
+        with s:
+            pass
+    with s:
+        v = sym.Variable("only_a")
+    assert v.attr("a") == "1" and v.attr("b") is None
+
+    m = name_scope.Prefix("p_")
+    with m:
+        with m:
+            pass
+    assert name_scope.current() is None
+    out = sym.Activation(sym.Variable("x"), act_type="relu").list_outputs()[0]
+    assert not out.startswith("p_")
