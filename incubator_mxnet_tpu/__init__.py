@@ -49,6 +49,11 @@ from . import rnn  # noqa: F401
 from . import name  # noqa: F401
 from . import attribute  # noqa: F401
 from .attribute import AttrScope  # noqa: F401
+from . import kvstore_server  # noqa: F401
+
+# a process launched in the server role serves until the job ends, then
+# exits — same import-time contract as the reference (kvstore_server.py:92)
+kvstore_server._init_kvstore_server_module()
 from . import gluon  # noqa: F401
 from . import executor  # noqa: F401
 from . import engine  # noqa: F401

@@ -1,0 +1,61 @@
+"""Server-role bootstrap (ref: python/mxnet/kvstore_server.py —
+KVStoreServer:28 wraps the server loop; _init_kvstore_server_module:75
+turns a process whose DMLC_ROLE is `server` into a blocking server).
+
+TPU-native mapping: the server loop is `ps.ParameterServer` (the
+authoritative-weight store behind kvstore type 'dist_async_server');
+workers ship the optimizer over the authenticated control channel exactly
+like the reference's CommandType::kController pickle. A process launched
+with MXTPU_ROLE=server (e.g. by tools/launch.py) calls `run()` and never
+returns until the job's workers disconnect.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+from .ps import ParameterServer, default_server_addr
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer:
+    """Blocking wrapper running the parameter-server loop in this
+    process."""
+
+    def __init__(self, kvstore=None, num_workers=None, host=None, port=None):
+        self.kvstore = kvstore  # accepted for API parity; the server loop
+        # here is self-contained and does not need a worker-side store
+        if num_workers is None:
+            num_workers = int(os.environ.get(
+                "MXTPU_NUM_WORKERS", os.environ.get("DMLC_NUM_WORKER", "1")))
+        addr_host, addr_port = default_server_addr()
+        self._server = ParameterServer(
+            num_workers=num_workers,
+            host=host if host is not None else addr_host,
+            port=port if port is not None else addr_port)
+
+    def run(self):
+        """Serve until every worker has disconnected (the reference's
+        MXKVStoreRunServer blocking contract)."""
+        logging.basicConfig(
+            level=logging.INFO,
+            format="%(asctime)-15s Server %(message)s")
+        self._server.serve_forever()
+
+
+def _init_kvstore_server_module():
+    """If this process was launched in the server role, run the server
+    loop and exit — mirrors the reference's import-time role check."""
+    role = os.environ.get("MXTPU_ROLE", os.environ.get("DMLC_ROLE", ""))
+    if role == "server":
+        server = KVStoreServer()
+        server.run()
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    # dedicated server process: `python -m incubator_mxnet_tpu.kvstore_server`
+    os.environ.setdefault("MXTPU_ROLE", "server")
+    _init_kvstore_server_module()

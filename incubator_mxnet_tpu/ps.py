@@ -531,6 +531,13 @@ class ParameterServer:
     def _cmd_keys(self):
         return ("val", sorted(self._store, key=str))
 
+    def serve_forever(self):
+        """Block this thread until a worker sends the stop command or
+        shutdown() is called — the dedicated-server-process entry
+        (kvstore_server.KVStoreServer.run)."""
+        self._stop.wait()
+        self._accept_thread.join(timeout=10)
+
     def shutdown(self):
         self._stop.set()
         # shutdown() (not just close()) wakes a thread blocked in accept();

@@ -1,6 +1,8 @@
 """Parameter-server protocol unit tests (single process, real sockets)
 (ref: src/kvstore/kvstore_dist_server.h — async apply :348, sync merge
 :346, row-sparse serving :499)."""
+import os
+import socket
 import threading
 
 import numpy as np
@@ -8,6 +10,14 @@ import pytest
 
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu.ps import ParameterServer, PSClient
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
 
 
 @pytest.fixture
@@ -238,3 +248,51 @@ def test_heartbeat_protocol():
     finally:
         c0.stop_server()
         c0.close()
+
+
+def test_kvstore_server_role(monkeypatch):
+    """Dedicated server-role process entry (ref: kvstore_server.py):
+    KVStoreServer.run blocks serving until a worker sends stop."""
+    from incubator_mxnet_tpu.kvstore_server import KVStoreServer
+
+    monkeypatch.setenv("MXTPU_PS_ADDR", "127.0.0.1:0")
+    srv = KVStoreServer(num_workers=1)
+    port = srv._server.port
+    t = threading.Thread(target=srv.run, daemon=True)
+    t.start()
+
+    c = PSClient("127.0.0.1", port)
+    c.init("w", np.ones((3,), dtype=np.float32))
+    np.testing.assert_array_equal(c.pull("w"), np.ones(3, dtype=np.float32))
+    c.stop_server()
+    t.join(timeout=10)
+    assert not t.is_alive(), "server loop did not exit after stop"
+    c.close()
+
+
+def test_kvstore_server_module_entry():
+    """`python -m incubator_mxnet_tpu.kvstore_server` serves and exits on
+    stop (the DMLC_ROLE=server bootstrap)."""
+    import subprocess
+    import sys
+
+    port = _free_port()
+    env = dict(os.environ)
+    env["MXTPU_PS_ADDR"] = f"127.0.0.1:{port}"
+    env["MXTPU_NUM_WORKERS"] = "1"
+    env.pop("MXTPU_ROLE", None)
+    p = subprocess.Popen(
+        [sys.executable, "-m", "incubator_mxnet_tpu.kvstore_server"],
+        env=env, cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    try:
+        c = PSClient("127.0.0.1", port)
+        c.init("k", np.full((2,), 7, dtype=np.float32))
+        np.testing.assert_array_equal(c.pull("k"),
+                                      np.full(2, 7, dtype=np.float32))
+        c.stop_server()
+        c.close()
+        assert p.wait(timeout=30) == 0
+    finally:
+        if p.poll() is None:
+            p.kill()
