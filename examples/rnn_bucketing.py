@@ -2,7 +2,10 @@
 """LSTM language model with BucketingModule (ref: example/rnn/bucketing/
 lstm_bucketing.py + python/mxnet/rnn BucketSentenceIter pattern).
 
-Trains on synthetic text when no corpus is given.
+Trains on synthetic text when no corpus is given. --cell picks the graph
+builder: "fused" lowers through the one-scan-program sym.RNN op (the
+reference's cudnn path), "stacked" unrolls mx.rnn LSTMCells step by step
+(the reference's cell path); both share the mx.rnn bucketing pipeline.
 """
 import argparse
 import logging
@@ -44,11 +47,14 @@ class BucketSentenceIter(DataIter):
 
     @property
     def provide_data(self):
-        return [DataDesc(self.data_name, (self.batch_size, self.default_bucket_key))]
+        # batches carry bucket width minus one (next-token shift below)
+        return [DataDesc(self.data_name,
+                         (self.batch_size, self.default_bucket_key - 1))]
 
     @property
     def provide_label(self):
-        return [DataDesc(self.label_name, (self.batch_size, self.default_bucket_key))]
+        return [DataDesc(self.label_name,
+                         (self.batch_size, self.default_bucket_key - 1))]
 
     def reset(self):
         self.cur = 0
@@ -76,13 +82,16 @@ def main():
     p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--num-epochs", type=int, default=2)
     p.add_argument("--vocab", type=int, default=100)
+    p.add_argument("--cell", choices=["fused", "stacked"], default="fused",
+                   help="fused sym.RNN op vs unrolled mx.rnn cell stack")
+    p.add_argument("--sentences", type=int, default=2000)
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
 
     # synthetic "language": markov chain over vocab
     rng = np.random.RandomState(0)
     sentences = []
-    for _ in range(2000):
+    for _ in range(args.sentences):
         L = rng.randint(5, 33)
         s = [rng.randint(1, args.vocab)]
         for _ in range(L - 1):
@@ -91,15 +100,21 @@ def main():
     buckets = [8, 16, 24, 33]
     train = BucketSentenceIter(sentences, args.batch_size, buckets)
 
+    if args.cell == "fused":
+        cell = mx.rnn.FusedRNNCell(args.num_hidden, num_layers=args.num_layers,
+                                   mode="lstm", prefix="lstm_")
+    else:
+        cell = mx.rnn.SequentialRNNCell()
+        for i in range(args.num_layers):
+            cell.add(mx.rnn.LSTMCell(args.num_hidden, prefix=f"lstm_l{i}_"))
+
     def sym_gen(seq_len):
         data = sym.Variable("data")
         label = sym.Variable("softmax_label")
         embed = sym.Embedding(data, input_dim=args.vocab, output_dim=args.num_embed,
                               name="embed")
-        x = sym.transpose(embed, axes=(1, 0, 2))  # (T, B, E)
-        out = sym.RNN(x, state_size=args.num_hidden, num_layers=args.num_layers,
-                      mode="lstm", name="lstm")
-        out = sym.transpose(out, axes=(1, 0, 2))  # (B, T, H)
+        cell.reset()
+        out, _ = cell.unroll(seq_len, embed, layout="NTC", merge_outputs=True)
         pred = sym.Reshape(out, shape=(-3, -2))
         pred = sym.FullyConnected(pred, num_hidden=args.vocab, name="pred")
         lab = sym.Reshape(label, shape=(-1,))
@@ -114,6 +129,7 @@ def main():
         initializer=mx.init.Xavier(), num_epoch=args.num_epochs,
         batch_end_callback=mx.callback.Speedometer(args.batch_size, 20),
     )
+    print("rnn_bucketing OK")
 
 
 if __name__ == "__main__":

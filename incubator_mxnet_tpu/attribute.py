@@ -10,9 +10,11 @@ from __future__ import annotations
 
 __all__ = ["AttrScope", "current"]
 
-# (scope_object, effective_attrs) frames; effective = all enclosing scopes
-# merged, inner keys winning
-_STACK = []
+from .base import ThreadLocalStack
+
+# (scope_object, effective_attrs) frames per thread; effective = all
+# enclosing scopes merged, inner keys winning
+_STACK = ThreadLocalStack()
 
 
 class AttrScope:
@@ -28,9 +30,10 @@ class AttrScope:
         return out
 
     def __enter__(self):
-        parent = _STACK[-1][1] if _STACK else {}
+        top = _STACK.top()
+        parent = top[1] if top else {}
         merged = {**parent, **self._attr}
-        _STACK.append((self, merged))
+        _STACK.push((self, merged))
         return self
 
     def __exit__(self, exc_type, exc_value, traceback):
@@ -38,14 +41,16 @@ class AttrScope:
 
 
 def current():
-    """The innermost active scope, or None."""
-    return _STACK[-1][0] if _STACK else None
+    """The innermost active scope in this thread, or None."""
+    top = _STACK.top()
+    return top[0] if top else None
 
 
 def resolve(attr=None):
     """Attributes the active scopes assign, merged with `attr`
     (explicit wins)."""
-    effective = _STACK[-1][1] if _STACK else None
+    top = _STACK.top()
+    effective = top[1] if top else None
     if not effective:
         return dict(attr) if attr else {}
     out = effective.copy()

@@ -62,3 +62,30 @@ class DType:
     int8 = "int8"
     int32 = "int32"
     int64 = "int64"
+
+
+class ThreadLocalStack:
+    """Per-thread stack for scope context managers (name/attribute scopes;
+    ref: the reference keeps these thread-local, tests/test_thread_local.py)."""
+
+    def __init__(self):
+        import threading
+
+        self._local = threading.local()
+
+    def frames(self):
+        try:
+            return self._local.stack
+        except AttributeError:
+            self._local.stack = []
+            return self._local.stack
+
+    def push(self, frame):
+        self.frames().append(frame)
+
+    def pop(self):
+        return self.frames().pop()
+
+    def top(self):
+        frames = self.frames()
+        return frames[-1] if frames else None

@@ -57,5 +57,6 @@ def _init_kvstore_server_module():
 
 if __name__ == "__main__":
     # dedicated server process: `python -m incubator_mxnet_tpu.kvstore_server`
-    os.environ.setdefault("MXTPU_ROLE", "server")
+    # is an explicit request to serve — override any inherited role env
+    os.environ["MXTPU_ROLE"] = "server"
     _init_kvstore_server_module()

@@ -12,7 +12,9 @@ from .symbol.symbol import name_uid
 
 __all__ = ["NameManager", "Prefix", "current"]
 
-_STACK = []
+from .base import ThreadLocalStack
+
+_STACK = ThreadLocalStack()  # per-thread active-manager stack
 
 
 class NameManager:
@@ -32,7 +34,7 @@ class NameManager:
         return f"{hint}{n}"
 
     def __enter__(self):
-        _STACK.append(self)
+        _STACK.push(self)
         return self
 
     def __exit__(self, exc_type, exc_value, traceback):
@@ -51,8 +53,8 @@ class Prefix(NameManager):
 
 
 def current():
-    """The innermost active manager, or None."""
-    return _STACK[-1] if _STACK else None
+    """The innermost active manager in this thread, or None."""
+    return _STACK.top()
 
 
 def resolve(name, hint):

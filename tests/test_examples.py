@@ -209,3 +209,17 @@ def test_profiler_demo():
 def test_module_chain():
     log = _run("module_chain.py", "--epochs", "6")
     assert "module_chain OK" in log
+
+
+def test_rnn_bucketing_stacked_cell():
+    log = _run("rnn_bucketing.py", "--num-epochs", "1", "--batch-size", "16",
+               "--num-hidden", "16", "--num-embed", "8", "--sentences", "300",
+               "--cell", "stacked", timeout=520)
+    assert "rnn_bucketing OK" in log
+
+
+def test_rnn_bucketing_fused_cell():
+    log = _run("rnn_bucketing.py", "--num-epochs", "1", "--batch-size", "16",
+               "--num-hidden", "16", "--num-embed", "8", "--sentences", "300",
+               "--cell", "fused", timeout=520)
+    assert "rnn_bucketing OK" in log

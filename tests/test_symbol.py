@@ -173,3 +173,28 @@ def test_scope_reentrancy():
     assert name_scope.current() is None
     out = sym.Activation(sym.Variable("x"), act_type="relu").list_outputs()[0]
     assert not out.startswith("p_")
+
+
+def test_scopes_are_thread_local():
+    # a scope active in one thread must not stamp symbols built in another
+    # (ref: tests/python/unittest/test_thread_local.py)
+    import threading
+
+    import incubator_mxnet_tpu as mx
+
+    results = {}
+
+    def other_thread():
+        v = sym.Variable("tl_other")
+        results["attr"] = v.attr("tl")
+        with mx.name.Prefix("other_"):
+            s = sym.Activation(sym.Variable("x"), act_type="relu")
+        results["name"] = s.list_outputs()[0]
+
+    with mx.AttrScope(tl="1"):
+        with mx.name.Prefix("main_"):
+            t = threading.Thread(target=other_thread)
+            t.start()
+            t.join(timeout=30)
+    assert results["attr"] is None  # main thread's AttrScope not visible
+    assert results["name"].startswith("other_")  # its own scope works
