@@ -3,9 +3,10 @@ AttrScope:26). `with mx.AttrScope(ctx_group="dev1", lr_mult="0.1"):` stamps
 the given attributes onto every symbol (and auto-created weight variable)
 built inside the scope; nested scopes merge, inner keys winning.
 
-Scope state lives on a module-level stack (never on the scope object), so
+Scope state lives on a per-thread stack (never on the scope object), so
 one AttrScope instance can be entered repeatedly — even nested within
-itself — without corrupting later symbol builds."""
+itself — without corrupting later symbol builds, and a scope active in
+one thread is invisible to others."""
 from __future__ import annotations
 
 __all__ = ["AttrScope", "current"]

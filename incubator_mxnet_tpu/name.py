@@ -3,9 +3,10 @@ NameManager:25, Prefix:74). `with mx.name.Prefix("layer1_"):` prepends the
 prefix to every auto-generated (and explicit) symbol name created in the
 scope; a plain NameManager scope restarts hint counters from 0.
 
-The active-manager state lives on a module-level stack so one manager
+The active-manager state lives on a per-thread stack so one manager
 object can be entered repeatedly (even nested within itself) without
-leaving the scope permanently active."""
+leaving the scope permanently active, and scopes do not leak across
+threads."""
 from __future__ import annotations
 
 from .symbol.symbol import name_uid

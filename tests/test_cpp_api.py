@@ -131,17 +131,17 @@ def test_generated_header_current():
             f.write(before)
 
 
-def test_cpp_mlp_trains(tmp_path):
-    """The flagship check: a C++ MNIST-shaped MLP composes ops from the
-    generated header and TRAINS (loss halves) via the embedded runtime."""
+def _build_and_run_cpp_example(tmp_path, example_dir, exe_name, epochs):
+    """Compile one examples/<dir>/<name>.cpp against the generated header +
+    embedded runtime and run it with the repo on PYTHONPATH."""
     assert imperative_lib() is not None  # builds the .so lazily
     libdir = os.path.join(REPO, "incubator_mxnet_tpu", "_native")
     pylibdir = sysconfig.get_config_var("LIBDIR")
     ver = sysconfig.get_config_var("LDVERSION") or "3.12"
-    exe = str(tmp_path / "mlp")
+    exe = str(tmp_path / exe_name)
     build = subprocess.run(
         ["g++", "-std=c++17",
-         os.path.join(REPO, "examples", "cpp_mlp", "mlp.cpp"),
+         os.path.join(REPO, "examples", example_dir, exe_name + ".cpp"),
          "-I" + os.path.join(REPO, "include"),
          "-I" + sysconfig.get_paths()["include"],
          "-L" + libdir, "-lmxtpu_imperative",
@@ -154,7 +154,19 @@ def test_cpp_mlp_trains(tmp_path):
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PALLAS_AXON_POOL_IPS", None)
-    run = subprocess.run([exe, "40"], capture_output=True, text=True,
+    run = subprocess.run([exe, str(epochs)], capture_output=True, text=True,
                          timeout=600, env=env)
     assert run.returncode == 0, (run.stdout[-800:], run.stderr[-1500:])
     assert "TRAINED" in run.stdout, run.stdout[-800:]
+
+
+def test_cpp_mlp_trains(tmp_path):
+    """The flagship check: a C++ MNIST-shaped MLP composes ops from the
+    generated header and TRAINS (loss halves) via the embedded runtime."""
+    _build_and_run_cpp_example(tmp_path, "cpp_mlp", "mlp", 40)
+
+
+def test_cpp_lenet_trains(tmp_path):
+    """Conv counterpart of the MLP check: Convolution/Pooling/Flatten
+    compose and differentiate from C++ (ref: cpp-package/example/lenet.cpp)."""
+    _build_and_run_cpp_example(tmp_path, "cpp_lenet", "lenet", 25)
