@@ -76,14 +76,15 @@ class PythonModule(BaseModule):
         if self.binded and not force_rebind:
             self.logger.warning("Already bound, ignoring bind()")
             return
+        self.binded = True
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
-        self.binded = True
-        self._data_shapes = [(n, tuple(s)) for n, s, *_ in
-                             (tuple(d) for d in data_shapes)]
-        self._label_shapes = ([(n, tuple(s)) for n, s, *_ in
-                               (tuple(d) for d in label_shapes)]
-                              if label_shapes else None)
+
+        def norm(shapes):
+            return [(d[0], tuple(d[1])) for d in (tuple(x) for x in shapes)]
+
+        self._data_shapes = norm(data_shapes)
+        self._label_shapes = norm(label_shapes) if label_shapes else None
         self._output_shapes = self._compute_output_shapes()
 
     def _compute_output_shapes(self):
@@ -106,17 +107,18 @@ class PythonLossModule(PythonModule):
     def __init__(self, name="pyloss", data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  grad_func=None):
+        if len(data_names) != 1 or len(label_names) != 1:
+            raise ValueError("PythonLossModule takes exactly one data and "
+                             "one label input")
         super().__init__(data_names, label_names, [name + "_output"],
                          logger=logger)
         self._name = name
-        assert len(data_names) == 1
-        assert len(label_names) == 1
+        if grad_func is not None and not callable(grad_func):
+            raise TypeError("grad_func must be callable")
+        self._grad_func = grad_func
         self._scores = None
         self._labels = None
         self._scores_grad = None
-        if grad_func is not None:
-            assert callable(grad_func)
-        self._grad_func = grad_func
 
     def _compute_output_shapes(self):
         # a loss stage passes its scores through unchanged
@@ -124,34 +126,34 @@ class PythonLossModule(PythonModule):
 
     def forward(self, data_batch, is_train=None):
         self._scores = data_batch.data[0]
-        if is_train is None:
-            is_train = self.for_training
-        if is_train:
+        training = self.for_training if is_train is None else is_train
+        if training:
             self._labels = data_batch.label[0]
 
     def get_outputs(self, merge_multi_context=True):
-        assert merge_multi_context is True
+        assert merge_multi_context, "single-context stage"
         return [self._scores]
 
     def backward(self, out_grads=None):
-        assert out_grads is None, "For a loss module, out_grads should be None"
+        if out_grads is not None:
+            raise ValueError("a loss stage is terminal; out_grads must be "
+                             "None")
         assert self.for_training
         self._backward_impl()
 
     def _backward_impl(self):
-        """Compute d(loss)/d(scores) into self._scores_grad. Override, or
-        pass grad_func= at construction."""
+        """Compute d(loss)/d(scores) into self._scores_grad (the contract
+        subclasses override). The grad_func= constructor argument is the
+        no-subclass shortcut."""
         if self._grad_func is None:
             raise NotImplementedError(
                 "PythonLossModule needs a grad_func or a _backward_impl "
                 "override")
-        grad = self._grad_func(self._scores, self._labels)
-        if not isinstance(grad, NDArray):
-            grad = nd.array(grad)
-        self._scores_grad = grad
+        g = self._grad_func(self._scores, self._labels)
+        self._scores_grad = g if isinstance(g, NDArray) else nd.array(g)
 
     def get_input_grads(self, merge_multi_context=True):
-        assert merge_multi_context is True
+        assert merge_multi_context, "single-context stage"
         return [self._scores_grad]
 
     def install_monitor(self, mon):
