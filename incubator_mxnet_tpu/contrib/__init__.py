@@ -9,3 +9,5 @@ from . import svrg  # noqa: F401
 from . import text  # noqa: F401
 from . import sharded_checkpoint  # noqa: F401
 from . import graph  # noqa: F401
+from . import io  # noqa: F401
+from . import tensorboard  # noqa: F401

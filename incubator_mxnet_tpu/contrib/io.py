@@ -1,0 +1,53 @@
+"""Contrib IO: gluon DataLoader → Module-style DataIter bridge
+(ref: python/mxnet/contrib/io.py DataLoaderIter:28 — lets the imperative
+data pipeline feed the symbolic Module/fit world)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import DataBatch, DataDesc, DataIter
+from ..ndarray.ndarray import NDArray
+from .. import ndarray as nd
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """Wrap a `gluon.data.DataLoader` as a `DataIter` with
+    provide_data/provide_label, so Module.fit (and anything else written
+    against the iterator protocol) can consume it."""
+
+    def __init__(self, loader, data_name="data", label_name="softmax_label",
+                 dtype="float32"):
+        super().__init__()
+        self._loader = loader
+        self._iter = iter(loader)
+        self.dtype = dtype
+        data, label = self._peek()
+        self.batch_size = data.shape[0]
+        self.provide_data = [DataDesc(data_name, data.shape, dtype)]
+        self.provide_label = [DataDesc(label_name, label.shape, dtype)]
+
+    def _peek(self):
+        """First batch, kept to serve shapes; re-served on first next()."""
+        self._head = next(self._iter)
+        return self._head
+
+    def _as_nd(self, x):
+        if isinstance(x, NDArray):
+            return x
+        return nd.array(np.asarray(x))
+
+    def reset(self):
+        self._iter = iter(self._loader)
+        self._head = None
+
+    def next(self):
+        if self._head is not None:
+            data, label = self._head
+            self._head = None
+        else:
+            data, label = next(self._iter)
+        return DataBatch(data=[self._as_nd(data)],
+                         label=[self._as_nd(label)],
+                         pad=0)

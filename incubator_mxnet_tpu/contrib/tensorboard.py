@@ -1,0 +1,37 @@
+"""TensorBoard metric logging (ref: python/mxnet/contrib/tensorboard.py —
+LogMetricsCallback:25, a Speedometer-shaped batch/eval callback that writes
+scalar summaries instead of printing).
+
+Backend: `torch.utils.tensorboard.SummaryWriter` when available (torch
+ships in this stack); a clear ImportError otherwise — same gating posture
+as the reference, which required the dmlc tensorboard package."""
+from __future__ import annotations
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback:
+    """Write each metric's current value as a TensorBoard scalar, keyed
+    `prefix/metric_name`, at every callback invocation."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+        except ImportError as e:
+            raise ImportError(
+                "LogMetricsCallback needs a tensorboard writer; install "
+                "`tensorboard` (torch.utils.tensorboard backend)") from e
+        self.summary_writer = SummaryWriter(logging_dir)
+
+    def __call__(self, param):
+        """BatchEndParam/epoch-end callback protocol."""
+        if param.eval_metric is None:
+            return
+        self.step += 1
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = f"{self.prefix}/{name}"
+            self.summary_writer.add_scalar(name, value, self.step)
+        self.summary_writer.flush()

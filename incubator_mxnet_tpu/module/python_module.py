@@ -138,7 +138,9 @@ class PythonLossModule(PythonModule):
         if out_grads is not None:
             raise ValueError("a loss stage is terminal; out_grads must be "
                              "None")
-        assert self.for_training
+        if not self.for_training:
+            raise RuntimeError("backward() on a module bound with "
+                               "for_training=False")
         self._backward_impl()
 
     def _backward_impl(self):

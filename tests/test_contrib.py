@@ -530,3 +530,55 @@ def test_conv_lstm_learns_motion():
         trainer.step(1)
         losses.append(float(loss.asscalar()))
     assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+
+# -- contrib.io DataLoaderIter (ref: contrib/io.py:28) ----------------------
+
+def test_dataloader_iter_feeds_module():
+    import numpy as np
+
+    from incubator_mxnet_tpu import gluon, sym
+    from incubator_mxnet_tpu.contrib.io import DataLoaderIter
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(96, 10).astype("float32")
+    W = rng.randn(10, 3)
+    y = np.argmax(X @ W, axis=1).astype("float32")
+    ds = gluon.data.ArrayDataset(X, y)
+    loader = gluon.data.DataLoader(ds, batch_size=32)
+    it = DataLoaderIter(loader)
+    assert it.provide_data[0].shape == (32, 10)
+
+    batches = sum(1 for _ in it)
+    assert batches == 3
+    it.reset()
+    assert sum(1 for _ in it) == 3  # reset rebuilds a full epoch
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=3, name="fc")
+    net = sym.SoftmaxOutput(net, sym.Variable("softmax_label"))
+    mod = mx.module.Module(net, context=mx.cpu())
+    it.reset()
+    mod.fit(it, optimizer="sgd", num_epoch=4, initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.5})
+    it.reset()
+    assert mod.score(it, "acc")[0][1] > 0.8
+
+
+# -- contrib.tensorboard (ref: contrib/tensorboard.py:25) -------------------
+
+def test_tensorboard_callback(tmp_path):
+    pytest.importorskip("torch.utils.tensorboard")
+    import os
+
+    from incubator_mxnet_tpu.contrib.tensorboard import LogMetricsCallback
+    from incubator_mxnet_tpu.model import BatchEndParam
+
+    m = mx.metric.Accuracy()
+    m.update(mx.nd.array([0.0, 1.0]), mx.nd.array([0.0, 1.0]))
+    cb = LogMetricsCallback(str(tmp_path), prefix="train")
+    cb(BatchEndParam(epoch=0, nbatch=1, eval_metric=m, locals=None))
+    cb(BatchEndParam(epoch=0, nbatch=2, eval_metric=m, locals=None))
+    events = [f for f in os.listdir(tmp_path) if "tfevents" in f]
+    assert events, "no TensorBoard event file written"
+    assert os.path.getsize(os.path.join(str(tmp_path), events[0])) > 0
