@@ -11,3 +11,5 @@ from . import sharded_checkpoint  # noqa: F401
 from . import graph  # noqa: F401
 from . import io  # noqa: F401
 from . import tensorboard  # noqa: F401
+from . import tensorrt  # noqa: F401
+from . import autograd  # noqa: F401

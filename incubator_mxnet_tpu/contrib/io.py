@@ -34,9 +34,8 @@ class DataLoaderIter(DataIter):
         return self._head
 
     def _as_nd(self, x):
-        if isinstance(x, NDArray):
-            return x
-        return nd.array(np.asarray(x))
+        x = x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+        return nd.array(x.astype(self.dtype, copy=False))
 
     def reset(self):
         self._iter = iter(self._loader)
@@ -48,6 +47,19 @@ class DataLoaderIter(DataIter):
             self._head = None
         else:
             data, label = next(self._iter)
+        data = np.asarray(data.asnumpy() if isinstance(data, NDArray)
+                          else data)
+        label = np.asarray(label.asnumpy() if isinstance(label, NDArray)
+                           else label)
+        pad = self.batch_size - data.shape[0]
+        if pad > 0:
+            # short final batch (DataLoader last_batch="keep"): pad by
+            # repeating the last row and report it, like NDArrayIter —
+            # score()/predict() strip padded rows via DataBatch.pad
+            data = np.concatenate(
+                [data, np.repeat(data[-1:], pad, axis=0)], axis=0)
+            label = np.concatenate(
+                [label, np.repeat(label[-1:], pad, axis=0)], axis=0)
         return DataBatch(data=[self._as_nd(data)],
                          label=[self._as_nd(label)],
-                         pad=0)
+                         pad=max(0, pad))

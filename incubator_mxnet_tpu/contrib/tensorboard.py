@@ -34,4 +34,9 @@ class LogMetricsCallback:
             if self.prefix is not None:
                 name = f"{self.prefix}/{name}"
             self.summary_writer.add_scalar(name, value, self.step)
+
+    def flush(self):
         self.summary_writer.flush()
+
+    def close(self):
+        self.summary_writer.close()

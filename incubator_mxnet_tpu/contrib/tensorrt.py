@@ -1,0 +1,62 @@
+"""TensorRT-compat API (ref: python/mxnet/contrib/tensorrt.py —
+set_use_tensorrt:30, get_optimized_symbol:50, tensorrt_bind:76).
+
+Role mapping: TensorRT's job — an AOT-optimized inference engine with
+optional half precision — is XLA's default job here. Every bind compiles
+and fuses the whole graph, so there is no separate "TensorRT graph pass"
+to toggle; what remains meaningful from this API is (a) script
+compatibility and (b) the half-precision switch, which on TPU means
+bfloat16 (`fp16_mode=True` casts the bound parameters so matmuls/convs hit
+the MXU at its native dtype). For ahead-of-time serialized engines, see
+`deploy.export_predictor` (the `.mxp` artifact)."""
+from __future__ import annotations
+
+import logging
+import os
+
+__all__ = ["set_use_tensorrt", "get_use_tensorrt", "get_optimized_symbol",
+           "tensorrt_bind"]
+
+_ENV = "MXTPU_USE_TENSORRT"
+
+
+def set_use_tensorrt(status):
+    """Accepted for script compatibility; graph optimization is XLA's
+    compile and is always on. The flag only records the preference."""
+    os.environ[_ENV] = str(int(bool(status)))
+    if status:
+        logging.getLogger(__name__).info(
+            "TensorRT-style graph optimization is XLA compilation here; "
+            "already enabled for every bind")
+
+
+def get_use_tensorrt():
+    return os.environ.get(_ENV, "0") == "1"
+
+
+def get_optimized_symbol(executor):
+    """The symbol whose whole graph the executor compiled. XLA fusion
+    happens inside compilation, so the optimized program has the same
+    symbol-level structure (there are no partitioned TRT subgraph nodes
+    to surface)."""
+    return executor._symbol
+
+
+def tensorrt_bind(symbol, ctx=None, all_params=None, type_dict=None,
+                  stype_dict=None, group2ctx=None, fp16_mode=False,
+                  **kwargs):
+    """simple_bind + parameter injection, the reference's one-call
+    inference-engine entry. fp16_mode=True casts every floating
+    parameter to bfloat16 (TPU half precision) before binding."""
+    all_params = dict(all_params or {})
+    type_dict = dict(type_dict or {})
+    if fp16_mode:
+        for name, arr in all_params.items():
+            if "float" in str(arr.dtype):
+                all_params[name] = arr.astype("bfloat16")
+                type_dict.setdefault(name, "bfloat16")
+    ex = symbol.simple_bind(ctx=ctx, grad_req="null", type_dict=type_dict,
+                            stype_dict=stype_dict, group2ctx=group2ctx,
+                            **kwargs)
+    ex.copy_params_from(all_params, allow_extra_params=True)
+    return ex

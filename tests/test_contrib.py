@@ -551,6 +551,17 @@ def test_dataloader_iter_feeds_module():
 
     batches = sum(1 for _ in it)
     assert batches == 3
+
+    # uneven dataset: final short batch is padded to batch_size + reported
+    it_odd = DataLoaderIter(gluon.data.DataLoader(
+        gluon.data.ArrayDataset(X[:70], y[:70].astype("int64")),
+        batch_size=32))
+    seen = [(b.data[0].shape, b.pad) for b in it_odd]
+    assert seen[-1] == ((32, 10), 26) and seen[0][1] == 0
+    assert "float32" in str(next(iter(
+        DataLoaderIter(gluon.data.DataLoader(
+            gluon.data.ArrayDataset(X[:32], y[:32].astype("int64")),
+            batch_size=32)))).label[0].dtype)
     it.reset()
     assert sum(1 for _ in it) == 3  # reset rebuilds a full epoch
 
@@ -579,6 +590,76 @@ def test_tensorboard_callback(tmp_path):
     cb = LogMetricsCallback(str(tmp_path), prefix="train")
     cb(BatchEndParam(epoch=0, nbatch=1, eval_metric=m, locals=None))
     cb(BatchEndParam(epoch=0, nbatch=2, eval_metric=m, locals=None))
+    cb.flush()
     events = [f for f in os.listdir(tmp_path) if "tfevents" in f]
     assert events, "no TensorBoard event file written"
     assert os.path.getsize(os.path.join(str(tmp_path), events[0])) > 0
+
+
+# -- contrib.tensorrt compat (ref: contrib/tensorrt.py:30,76) ---------------
+
+def test_tensorrt_bind_bf16_inference():
+    import numpy as np
+
+    from incubator_mxnet_tpu import nd, sym
+    from incubator_mxnet_tpu.contrib import tensorrt as trt
+
+    rng = np.random.RandomState(0)
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=3, name="fc2")
+    net = sym.softmax(net)
+
+    params = {
+        "fc1_weight": nd.array(rng.randn(8, 10).astype("float32") * 0.3),
+        "fc1_bias": nd.array(np.zeros(8, "float32")),
+        "fc2_weight": nd.array(rng.randn(3, 8).astype("float32") * 0.3),
+        "fc2_bias": nd.array(np.zeros(3, "float32")),
+    }
+    x = rng.randn(4, 10).astype("float32")
+
+    ex32 = trt.tensorrt_bind(net, all_params=params, data=(4, 10))
+    out32 = ex32.forward(is_train=False, data=nd.array(x))[0].asnumpy()
+
+    ex16 = trt.tensorrt_bind(net, all_params=params, fp16_mode=True,
+                             data=(4, 10))
+    assert "bfloat16" in str(ex16.arg_dict["fc1_weight"].dtype)
+    out16 = ex16.forward(is_train=False, data=nd.array(x))[0].asnumpy()
+    assert np.allclose(out32, np.asarray(out16, dtype=np.float32),
+                       atol=0.05)
+    assert trt.get_optimized_symbol(ex16) is net
+
+    trt.set_use_tensorrt(True)
+    assert trt.get_use_tensorrt()
+    trt.set_use_tensorrt(False)
+
+
+# -- contrib.autograd legacy API (ref: contrib/autograd.py) -----------------
+
+def test_contrib_autograd_grad_and_loss():
+    import numpy as np
+
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.contrib import autograd as cag
+
+    @cag.grad_and_loss
+    def f(x, y):
+        return x * x + 2 * y
+
+    grads, out = f(nd.array(np.array([3.0], np.float32)),
+                   nd.array(np.array([4.0], np.float32)))
+    np.testing.assert_allclose(out.asnumpy(), [17.0])
+    np.testing.assert_allclose(grads[0].asnumpy(), [6.0])  # d/dx = 2x
+    np.testing.assert_allclose(grads[1].asnumpy(), [2.0])  # d/dy = 2
+
+    g = cag.grad(lambda x: x * x * x, argnum=0)
+    np.testing.assert_allclose(
+        g(nd.array(np.array([2.0], np.float32)))[0].asnumpy(), [12.0])
+
+    with cag.train_section():
+        from incubator_mxnet_tpu import autograd as ag
+        assert ag.is_recording() and ag.is_training()
+    with cag.test_section():
+        from incubator_mxnet_tpu import autograd as ag
+        assert not ag.is_recording()
