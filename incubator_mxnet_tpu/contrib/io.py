@@ -47,6 +47,12 @@ class DataLoaderIter(DataIter):
             self._head = None
         else:
             data, label = next(self._iter)
+        if (isinstance(data, NDArray) and isinstance(label, NDArray)
+                and data.shape[0] == self.batch_size
+                and str(data.dtype) == self.dtype
+                and str(label.dtype) == self.dtype):
+            # common case: full device-side batch already in dtype
+            return DataBatch(data=[data], label=[label], pad=0)
         data = np.asarray(data.asnumpy() if isinstance(data, NDArray)
                           else data)
         label = np.asarray(label.asnumpy() if isinstance(label, NDArray)

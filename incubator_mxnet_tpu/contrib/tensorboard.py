@@ -14,9 +14,11 @@ class LogMetricsCallback:
     """Write each metric's current value as a TensorBoard scalar, keyed
     `prefix/metric_name`, at every callback invocation."""
 
-    def __init__(self, logging_dir, prefix=None):
+    def __init__(self, logging_dir, prefix=None, flush_secs=5):
         self.prefix = prefix
         self.step = 0
+        self._flush_secs = flush_secs
+        self._last_flush = 0.0
         try:
             from torch.utils.tensorboard import SummaryWriter
         except ImportError as e:
@@ -34,6 +36,14 @@ class LogMetricsCallback:
             if self.prefix is not None:
                 name = f"{self.prefix}/{name}"
             self.summary_writer.add_scalar(name, value, self.step)
+        import time
+
+        now = time.monotonic()
+        if now - self._last_flush >= self._flush_secs:
+            # fit() never calls flush(); throttled flushing keeps events
+            # visible for short runs without per-batch file IO
+            self._last_flush = now
+            self.summary_writer.flush()
 
     def flush(self):
         self.summary_writer.flush()

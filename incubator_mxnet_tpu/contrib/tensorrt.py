@@ -46,17 +46,30 @@ def tensorrt_bind(symbol, ctx=None, all_params=None, type_dict=None,
                   stype_dict=None, group2ctx=None, fp16_mode=False,
                   **kwargs):
     """simple_bind + parameter injection, the reference's one-call
-    inference-engine entry. fp16_mode=True casts every floating
-    parameter to bfloat16 (TPU half precision) before binding."""
+    inference-engine entry. fp16_mode=True binds the net in bfloat16 (TPU
+    half precision): parameters convert via contrib.amp (normalization
+    statistics stay fp32) and the data slots bind bf16, so fp32 feeds cast
+    down instead of promoting the matmuls back up."""
     all_params = dict(all_params or {})
     type_dict = dict(type_dict or {})
+    arg_names = set(symbol.list_arguments())
+    aux_names = set(symbol.list_auxiliary_states())
+    arg_params = {k: v for k, v in all_params.items() if k in arg_names}
+    aux_params = {k: v for k, v in all_params.items() if k in aux_names}
+    dropped = set(all_params) - set(arg_params) - set(aux_params)
+    if dropped:
+        raise ValueError(f"params not in the symbol: {sorted(dropped)}")
     if fp16_mode:
-        for name, arr in all_params.items():
-            if "float" in str(arr.dtype):
-                all_params[name] = arr.astype("bfloat16")
-                type_dict.setdefault(name, "bfloat16")
+        from .amp import convert_model
+
+        _, arg_params, aux_params = convert_model(
+            symbol, arg_params, aux_params, target_dtype="bfloat16")
+        for name, arr in {**arg_params, **aux_params}.items():
+            type_dict.setdefault(name, str(arr.dtype))
+        for name in arg_names - set(arg_params):  # data/label inputs
+            type_dict.setdefault(name, "bfloat16")
     ex = symbol.simple_bind(ctx=ctx, grad_req="null", type_dict=type_dict,
                             stype_dict=stype_dict, group2ctx=group2ctx,
                             **kwargs)
-    ex.copy_params_from(all_params, allow_extra_params=True)
+    ex.copy_params_from(arg_params, aux_params)
     return ex

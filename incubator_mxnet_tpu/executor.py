@@ -49,13 +49,18 @@ class Executor:
     def forward(self, is_train=False, **kwargs):
         """(ref: GraphExecutor::Forward) — returns list of output NDArrays."""
         for k, v in kwargs.items():
-            if k in self.arg_dict:
-                if isinstance(v, NDArray):
-                    self.arg_dict[k]._data = v._data
-                else:
-                    self.arg_dict[k]._data = jnp.asarray(v)
-            else:
+            if k not in self.arg_dict:
                 raise ValueError(f"unknown argument {k}")
+            data = v._data if isinstance(v, NDArray) else jnp.asarray(v)
+            slot = self.arg_dict[k]._data
+            if data.dtype != slot.dtype:
+                # slots keep their bound dtype; feeds cast into them
+                # (ref: Executor.forward copies into the existing buffer,
+                # executor.py arg_dict[name][:] = value) — this is what
+                # makes a bf16-bound executor compute in bf16 from fp32
+                # feeds instead of silently promoting back to fp32
+                data = data.astype(slot.dtype)
+            self.arg_dict[k]._data = data
 
         args = {k: v._data for k, v in self.arg_dict.items()}
         aux = {k: v._data for k, v in self.aux_dict.items()}

@@ -625,7 +625,10 @@ def test_tensorrt_bind_bf16_inference():
     ex16 = trt.tensorrt_bind(net, all_params=params, fp16_mode=True,
                              data=(4, 10))
     assert "bfloat16" in str(ex16.arg_dict["fc1_weight"].dtype)
-    out16 = ex16.forward(is_train=False, data=nd.array(x))[0].asnumpy()
+    out16_nd = ex16.forward(is_train=False, data=nd.array(x))[0]
+    # fp32 feed casts into the bf16 slot: the whole net computed in bf16
+    assert "bfloat16" in str(out16_nd.dtype)
+    out16 = out16_nd.asnumpy()
     assert np.allclose(out32, np.asarray(out16, dtype=np.float32),
                        atol=0.05)
     assert trt.get_optimized_symbol(ex16) is net
