@@ -223,3 +223,10 @@ def test_rnn_bucketing_fused_cell():
                "--num-hidden", "16", "--num-embed", "8", "--sentences", "300",
                "--cell", "fused", timeout=520)
     assert "rnn_bucketing OK" in log
+
+
+def test_kaggle_dsb(tmp_path):
+    log = _run("kaggle_dsb.py", "--epochs", "5", "--train-size", "480",
+               "--test-size", "64", "--out-dir", str(tmp_path),
+               timeout=520)
+    assert "kaggle_dsb OK" in log
