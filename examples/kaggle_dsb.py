@@ -34,12 +34,13 @@ def make_dataset(n, rng):
     return X, y.astype("float32")
 
 
-def gen_img_list(y, split, path):
-    """The gen_img_list.py artifact: index \t label \t filename rows with a
-    deterministic train/val split."""
+def gen_img_list(y, n_val, path):
+    """The gen_img_list.py artifact: index \t label \t filename rows with
+    the same deterministic train/val split the run trains on (first n_val
+    samples are validation)."""
     with open(path, "w") as f:
         for i, label in enumerate(y):
-            part = "val" if i % split == 0 else "train"
+            part = "val" if i < n_val else "train"
             f.write(f"{i}\t{int(label)}\t{part}/img_{i:05d}.jpg\t{part}\n")
     return path
 
@@ -81,9 +82,9 @@ def main():
 
     rng = np.random.RandomState(0)
     X, y = make_dataset(args.train_size, rng)
-    img_list = gen_img_list(y, split=5, path=os.path.join(args.out_dir,
-                                                          "img_list.lst"))
     n_val = args.train_size // 5
+    img_list = gen_img_list(y, n_val, path=os.path.join(args.out_dir,
+                                                        "img_list.lst"))
     train = mx.io.NDArrayIter(X[n_val:], y[n_val:], args.batch_size,
                               shuffle=True)
     val = mx.io.NDArrayIter(X[:n_val], y[:n_val], args.batch_size)
@@ -101,10 +102,9 @@ def main():
     Xt, _ = make_dataset(args.test_size, rng)
     test_iter = mx.io.NDArrayIter(Xt, None, args.batch_size)
     pred_mod = mx.module.Module.load(prefix, args.epochs)
-    # forward-only shape inference: give the label its (unused) shape
-    pred_mod.bind(test_iter.provide_data,
-                  [("softmax_label", (args.batch_size,))],
-                  for_training=False)
+    # no label shapes at predict time: the label's shape is inferred
+    # backward from the scores (SoftmaxOutput rule in symbol/infer.py)
+    pred_mod.bind(test_iter.provide_data, None, for_training=False)
     probs = pred_mod.predict(test_iter).asnumpy()
 
     sub = write_submission(os.path.join(args.out_dir, "submission.csv"),

@@ -88,6 +88,34 @@ def _lrelu(attrs, shapes, names):
     return {}
 
 
+@rule("SoftmaxOutput")
+def _softmax_out(attrs, shapes, names):
+    # label shape derives from the scores (bidirectional inference: the
+    # reference infers it backward, ref: softmax_output-inl.h InferShape) —
+    # this is what lets `Module.bind(data_shapes)` work without labels at
+    # predict time
+    data = shapes[0]
+    if attrs.get("multi_output"):
+        return {"label": (data[0],) + tuple(data[2:])}
+    if attrs.get("preserve_shape"):
+        return {"label": tuple(data[:-1])}
+    return {"label": (data[0],)}
+
+
+@rule("SVMOutput")
+def _svm_out(attrs, shapes, names):
+    return {"label": (shapes[0][0],)}
+
+
+def _same_shape_label(attrs, shapes, names):
+    return {"label": tuple(shapes[0])}
+
+
+for _name in ("LinearRegressionOutput", "LogisticRegressionOutput",
+              "MAERegressionOutput"):
+    PARAM_SHAPE_RULES[_name] = _same_shape_label
+
+
 @rule("RNN")
 def _rnn(attrs, shapes, names):
     data = shapes[0]  # (T, B, I)

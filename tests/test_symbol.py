@@ -198,3 +198,33 @@ def test_scopes_are_thread_local():
             t.join(timeout=30)
     assert results["attr"] is None  # main thread's AttrScope not visible
     assert results["name"].startswith("other_")  # its own scope works
+
+
+def test_label_shape_inferred_backward():
+    # predict-time bind without label shapes (ref: softmax_output InferShape
+    # infers label from data)
+    import numpy as np
+
+    import incubator_mxnet_tpu as mx
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = sym.SoftmaxOutput(net, sym.Variable("softmax_label"), name="softmax")
+    args, outs, _ = net.infer_shape(data=(8, 6))
+    by_name = dict(zip(net.list_arguments(), args))
+    assert by_name["softmax_label"] == (8,)
+    assert outs[0] == (8, 4)
+
+    mod = mx.module.Module(net, context=mx.cpu())
+    mod.bind([("data", (8, 6))], None, for_training=False)
+    mod.init_params(mx.init.Xavier())
+    it = mx.io.NDArrayIter(np.random.rand(8, 6).astype("float32"),
+                           None, batch_size=8)
+    out = mod.predict(it)
+    assert out.shape == (8, 4)
+
+    # regression heads: label is data-shaped
+    reg = sym.LinearRegressionOutput(sym.FullyConnected(
+        sym.Variable("data"), num_hidden=2, name="r"), sym.Variable("label"))
+    args, _, _ = reg.infer_shape(data=(5, 3))
+    assert dict(zip(reg.list_arguments(), args))["label"] == (5, 2)
