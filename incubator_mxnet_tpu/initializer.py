@@ -34,6 +34,14 @@ def register(klass):
 def create(name, **kwargs):
     if isinstance(name, Initializer):
         return name
+    if isinstance(name, (list, tuple)):
+        # the dumps() wire form after json parsing: [name, kwargs]
+        name, kwargs = name[0], {**(name[1] or {}), **kwargs}
+    elif isinstance(name, str) and name.startswith("["):
+        # a dumps() string as-is (e.g. an __init__ attr that rode a
+        # serialized symbol)
+        parsed = json.loads(name)
+        name, kwargs = parsed[0], {**(parsed[1] or {}), **kwargs}
     return _REGISTRY[name.lower()](**kwargs)
 
 

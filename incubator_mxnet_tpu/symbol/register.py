@@ -38,7 +38,15 @@ def invoke_symbol(op_name, args, kwargs):
     op = OP_REGISTRY[op_name]
     kwargs = dict(kwargs)
     name = kwargs.pop("name", None)
-    scope_attrs = attribute.resolve(kwargs.pop("attr", None))
+    explicit_attrs = dict(kwargs.pop("attr", None) or {})
+    for mult in ("lr_mult", "wd_mult"):
+        # accepted on any op like the reference; stored under both the
+        # plain and dunder spellings (optimizers read the dunder form)
+        v = kwargs.pop(mult, explicit_attrs.pop(mult, None))
+        if v is not None:
+            explicit_attrs[mult] = v
+            explicit_attrs[f"__{mult}__"] = v
+    scope_attrs = attribute.resolve(explicit_attrs)
     base = op.name.lower().lstrip("_")
     name = name_scope.resolve(name, base)
 
@@ -78,6 +86,10 @@ def invoke_symbol(op_name, args, kwargs):
                 continue
             aux = in_name in op.aux
             v = Variable(f"{name}_{in_name}")
+            if scope_attrs:
+                # the op's attrs reach its auto-created params too
+                # (ref: conv attr= stamps conv_weight/conv_bias)
+                v._set_attr(**scope_attrs)
             s = v
         if not isinstance(s, Symbol):
             raise TypeError(f"op {op.name}: input {in_name} must be a Symbol, got {type(s)}")

@@ -179,6 +179,25 @@ class Symbol:
                 out[n.name] = dict(n.misc_attrs)
         return out
 
+    def list_attr(self, recursive=False):
+        """This node's attributes as strings (ref: symbol.py list_attr) —
+        op parameters and user attrs in one map."""
+        node, _ = self._outputs[0]
+        out = {}
+        if not node.is_var:
+            out.update({k: _attr_str(v) for k, v in node.attrs.items()})
+        for k, v in node.misc_attrs.items():
+            s = _misc_attr_str(v)
+            if s is not None:
+                out[k] = s
+        return out
+
+    def __reduce__(self):
+        # pickling rides the json graph (ref: symbols pickle via handle
+        # serialization); live Initializer instances in attrs degrade to
+        # their dumps() form
+        return (load_json, (self.tojson(),))
+
     # -- arithmetic --------------------------------------------------------
     def _binop(self, other, op_name, scalar_op, reverse=False):
         from . import register as _r
@@ -423,14 +442,22 @@ class Symbol:
         nid = {id(n): i for i, n in enumerate(nodes)}
         out_nodes = []
         for n in nodes:
-            out_nodes.append(
-                {
-                    "op": "null" if n.is_var else n.op.name,
-                    "name": n.name,
-                    "attrs": {k: _attr_str(v) for k, v in n.attrs.items()},
-                    "inputs": [[nid[id(src)], i, 0] for src, i in n.inputs],
-                }
-            )
+            entry = {
+                "op": "null" if n.is_var else n.op.name,
+                "name": n.name,
+                "attrs": {k: _attr_str(v) for k, v in n.attrs.items()},
+                "inputs": [[nid[id(src)], i, 0] for src, i in n.inputs],
+            }
+            # user attrs (__lr_mult__, ctx_group, __shape__, ...) ride a
+            # SEPARATE map with native JSON types: merging them into
+            # "attrs" would let a user key shadow a real op parameter on
+            # load, and stringifying would mutate '4' into 4 on round-trip
+            user = {k: v for k, v in ((k, _misc_attr_json(v))
+                                      for k, v in n.misc_attrs.items())
+                    if v is not None}
+            if user:
+                entry["user_attrs"] = user
+            out_nodes.append(entry)
         heads = [[nid[id(node)], i, 0] for node, i in self._outputs]
         arg_nodes = [i for i, n in enumerate(nodes) if n.is_var]
         return json.dumps(
@@ -458,6 +485,39 @@ def _attr_str(v):
     return str(v)
 
 
+def _misc_attr_str(v):
+    """User attr value as a display string (list_attr)."""
+    from ..initializer import Initializer
+
+    if isinstance(v, Initializer):
+        try:
+            return v.dumps()
+        except TypeError:
+            return None
+    if isinstance(v, (str, int, float, bool, tuple, list)):
+        return _attr_str(v)
+    return None
+
+
+def _misc_attr_json(v):
+    """User attr value as a JSON-native value preserving its type, or None
+    if it cannot round-trip. Tuples ride as lists (restored on load);
+    Initializer instances degrade to their dumps() string, which
+    initializer.create() parses back."""
+    from ..initializer import Initializer
+
+    if isinstance(v, Initializer):
+        try:
+            return v.dumps()
+        except TypeError:
+            return None
+    if isinstance(v, tuple):
+        return list(v)
+    if isinstance(v, (str, int, float, bool, list)) or v is None:
+        return v
+    return None
+
+
 def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
              init=None, stype=None, **kwargs):
     """Create a symbolic variable (ref: sym.Variable)."""
@@ -472,10 +532,12 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None
     if dtype is not None:
         node.misc_attrs["__dtype__"] = str(dtype)
     if lr_mult is not None:
-        # dunder keys: what Optimizer.set_lr_mult/set_wd_mult read from
-        # attr_dict (ref: symbol.py Variable -> __lr_mult__)
+        # both spellings like the reference; optimizers read the dunder
+        # form from attr_dict (ref: symbol.py Variable -> __lr_mult__)
+        node.misc_attrs["lr_mult"] = lr_mult
         node.misc_attrs["__lr_mult__"] = lr_mult
     if wd_mult is not None:
+        node.misc_attrs["wd_mult"] = wd_mult
         node.misc_attrs["__wd_mult__"] = wd_mult
     if init is not None:
         node.misc_attrs["__init__"] = init
@@ -510,8 +572,11 @@ def load_json(json_str):
                     attrs[k] = ast.literal_eval(v)
                 except (ValueError, SyntaxError):
                     attrs[k] = v
-            inputs = [(nodes[i], oi) for i, oi, _ in nd_["inputs"]]
-            node = _Node(OP_REGISTRY[nd_["op"]], nd_["name"], attrs, inputs)
+            node = _Node(OP_REGISTRY[nd_["op"]], nd_["name"], attrs,
+                         [(nodes[i], oi) for i, oi, _ in nd_["inputs"]])
+        # user attrs round-trip typed; tuples rode as JSON lists
+        for k, v in nd_.get("user_attrs", {}).items():
+            node.misc_attrs[k] = tuple(v) if isinstance(v, list) else v
         nodes.append(node)
     return Symbol([(nodes[i], oi) for i, oi, _ in d["heads"]])
 

@@ -228,3 +228,75 @@ def test_label_shape_inferred_backward():
         sym.Variable("data"), num_hidden=2, name="r"), sym.Variable("label"))
     args, _, _ = reg.infer_shape(data=(5, 3))
     assert dict(zip(reg.list_arguments(), args))["label"] == (5, 2)
+
+
+def test_attr_pickle_and_list_attr():
+    # (ref: tests/python/unittest/test_attr.py — attr scope + pickling +
+    # list_attr/attr_dict contracts)
+    import pickle
+
+    import incubator_mxnet_tpu as mx
+
+    with mx.AttrScope(group="4", data="great"):
+        data = sym.Variable("data", attr={"dtype": "data", "group": "1"},
+                            lr_mult=1)
+        gdata = sym.Variable("data2")
+    assert gdata.attr("group") == "4"
+    assert data.attr("group") == "1"
+    assert data.attr("lr_mult") == 1
+    assert data.attr("__lr_mult__") == 1
+    data2 = pickle.loads(pickle.dumps(data))
+    assert data2.attr("dtype") == data.attr("dtype")
+
+    op = sym.Convolution(sym.Variable("x", attr={"mood": "angry"}),
+                         name="conv", kernel=(1, 1), num_filter=1,
+                         attr={"__mood__": "so so"}, wd_mult=2)
+    la = op.list_attr()
+    assert la["__mood__"] == "so so" and la["__wd_mult__"] == "2"
+    assert la["kernel"] == "(1, 1)" and la["num_filter"] == "1"
+    ad = op.attr_dict()
+    assert ad["x"]["mood"] == "angry"
+    assert ad["conv_weight"]["__mood__"] == "so so"  # stamps created params
+    assert ad["conv_bias"]["__mood__"] == "so so"
+    assert ad["conv"]["__wd_mult__"] == 2
+
+    # pickled op round-trips the graph AND the user attrs
+    op2 = pickle.loads(pickle.dumps(op))
+    assert op2.tojson() == op.tojson()
+    assert op2.attr_dict()["conv_weight"]["__mood__"] == "so so"
+    _, outs, _ = op2.infer_shape(x=(1, 1, 4, 4))
+    assert outs[0] == (1, 1, 4, 4)
+
+
+def test_attr_roundtrip_fidelity():
+    # regression for three round-trip hazards: string attrs keep their
+    # type, user keys never shadow op params, Variable(init=...) survives
+    import pickle
+
+    import numpy as np
+
+    import incubator_mxnet_tpu as mx
+
+    d = sym.Variable("data", attr={"group": "4"})
+    assert pickle.loads(pickle.dumps(d)).attr("group") == "4"  # stays str
+
+    with mx.AttrScope(mode="tagged"):  # collides with the RNN op param
+        r = sym.RNN(sym.Variable("x"), state_size=4, num_layers=1,
+                    mode="lstm")
+    r2 = pickle.loads(pickle.dumps(r))
+    node = r2._outputs[0][0]
+    assert node.attrs.get("mode", "lstm") == "lstm"  # op param intact
+    assert node.misc_attrs["mode"] == "tagged"       # user attr intact
+
+    # Variable(init=...) round-trips into a working initializer
+    net = sym.FullyConnected(
+        sym.Variable("data"),
+        weight=sym.Variable("w", init=mx.init.Constant(3.0)),
+        num_hidden=4, name="fc")
+    net = sym.SoftmaxOutput(net, sym.Variable("softmax_label"))
+    net2 = pickle.loads(pickle.dumps(net))
+    mod = mx.module.Module(net2, context=mx.cpu())
+    mod.bind([("data", (2, 5))], [("softmax_label", (2,))])
+    mod.init_params(mx.init.Xavier())
+    w = mod.get_params()[0]["w"].asnumpy()
+    np.testing.assert_allclose(w, 3.0)
