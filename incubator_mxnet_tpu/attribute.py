@@ -49,12 +49,17 @@ def current():
 
 def resolve(attr=None):
     """Attributes the active scopes assign, merged with `attr`
-    (explicit wins)."""
+    (explicit wins). lr_mult/wd_mult get their dunder twins — the
+    spelling Optimizer.set_lr_mult/set_wd_mult read from attr_dict."""
     top = _STACK.top()
     effective = top[1] if top else None
     if not effective:
-        return dict(attr) if attr else {}
-    out = effective.copy()
-    if attr:
-        out.update(attr)
+        out = dict(attr) if attr else {}
+    else:
+        out = effective.copy()
+        if attr:
+            out.update(attr)
+    for mult in ("lr_mult", "wd_mult"):
+        if mult in out and f"__{mult}__" not in out:
+            out[f"__{mult}__"] = out[mult]
     return out

@@ -198,6 +198,151 @@ def _xent_fused_local(logits, targets):
 
 
 # ---------------------------------------------------------------------------
+# Incremental decoding: KV cache + one-token steps + jitted generate
+# (the reference has no serving path; on TPU the decode loop is a single
+# lax.scan program — static shapes, cache updates via dynamic_update_slice)
+# ---------------------------------------------------------------------------
+
+
+def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int | None = None):
+    """Per-layer key/value cache: (L, B, T_max, H, Dh) + a scalar write
+    position. Static T_max keeps every decode step the same XLA program."""
+    T = int(max_len or cfg.max_len)
+    H = cfg.n_heads
+    Dh = cfg.d_model // H
+    shape = (cfg.n_layers, batch, T, H, Dh)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "pos": jnp.zeros((), jnp.int32),
+    }
+
+
+def decode_step(params, cache, tokens, cfg: TransformerConfig):
+    """One token through the stack with cached attention state.
+
+    tokens: (B,) int32 — the token at position cache["pos"]. The caller
+    must keep pos < the cache's T_max (generate() checks this at trace
+    time; past capacity, dynamic_update_slice would silently clamp).
+    Returns (logits (B, V), new_cache). Attention reads the full static
+    cache and masks positions beyond pos (no dynamic shapes)."""
+    B = tokens.shape[0]
+    pos = cache["pos"]
+    T_max = cache["k"].shape[2]
+    x = params["embed"][tokens] + jax.lax.dynamic_index_in_dim(
+        params["pos"], pos, axis=0, keepdims=False)  # (B, d)
+
+    stacked = {k: params[k] for k in _stack_keys(params)}
+    valid = (jnp.arange(T_max) <= pos)[None, None, :]  # (1, 1, T_max)
+    scale = 1.0 / np.sqrt(cfg.d_model // cfg.n_heads)
+
+    def body(x, layer_in):
+        lp, k_cache, v_cache = layer_in
+        h = _ln(x, lp["ln1_g"], lp["ln1_b"])  # (B, d)
+        q = (h @ lp["wq"]).reshape(B, cfg.n_heads, -1)
+        k = (h @ lp["wk"]).reshape(B, cfg.n_heads, -1)
+        v = (h @ lp["wv"]).reshape(B, cfg.n_heads, -1)
+        k_cache = lax.dynamic_update_slice_in_dim(
+            k_cache, k[:, None], pos, axis=1)  # (B, T_max, H, Dh)
+        v_cache = lax.dynamic_update_slice_in_dim(
+            v_cache, v[:, None], pos, axis=1)
+        logits = jnp.einsum("bhd,bthd->bht", q, k_cache) * scale
+        logits = jnp.where(valid, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        a = jnp.einsum("bht,bthd->bhd", probs, v_cache)
+        x = x + a.reshape(B, cfg.d_model) @ lp["wo"]
+        h = _ln(x, lp["ln2_g"], lp["ln2_b"])
+        if cfg.n_experts:
+            out, _ = moe_ffn(h, lp["router"], lp["w1"], lp["w2"])
+            x = x + out
+        else:
+            x = x + jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = lax.scan(body, x, (stacked, cache["k"], cache["v"]))
+    x = _ln(x, params["ln_f_g"], params["ln_f_b"])
+    logits = x @ params["embed"].T
+    new_cache = {"k": new_k, "v": new_v, "pos": pos + 1}
+    return logits, new_cache
+
+
+def prefill(params, cache, prompt, cfg: TransformerConfig):
+    """Fill the cache with the whole prompt in ONE batched pass (the
+    O(T_p)-sequential decode_step loop would serialize T_p attention
+    launches). Returns (cache, last-token logits (B, V))."""
+    B, T_p = prompt.shape
+    x = params["embed"][prompt] + params["pos"][:T_p][None]
+    stacked = {k: params[k] for k in _stack_keys(params)}
+
+    def body(x, layer_in):
+        lp, k_cache, v_cache = layer_in
+        h = _ln(x, lp["ln1_g"], lp["ln1_b"])
+        q = _split_heads(h @ lp["wq"], cfg.n_heads)
+        k = _split_heads(h @ lp["wk"], cfg.n_heads)
+        v = _split_heads(h @ lp["wv"], cfg.n_heads)
+        k_cache = lax.dynamic_update_slice_in_dim(k_cache, k, 0, axis=1)
+        v_cache = lax.dynamic_update_slice_in_dim(v_cache, v, 0, axis=1)
+        a = _dense_attention(q, k, v, causal=True)
+        x = x + a.reshape(B, T_p, cfg.d_model) @ lp["wo"]
+        h = _ln(x, lp["ln2_g"], lp["ln2_b"])
+        if cfg.n_experts:
+            flat = h.reshape(B * T_p, cfg.d_model)
+            out, _ = moe_ffn(flat, lp["router"], lp["w1"], lp["w2"])
+            x = x + out.reshape(B, T_p, cfg.d_model)
+        else:
+            x = x + jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = lax.scan(body, x, (stacked, cache["k"], cache["v"]))
+    h = _ln(x[:, -1], params["ln_f_g"], params["ln_f_b"])
+    logits = h @ params["embed"].T
+    return {"k": new_k, "v": new_v,
+            "pos": jnp.asarray(T_p, jnp.int32)}, logits
+
+
+def generate(params, prompt, n_steps, cfg: TransformerConfig, key=None,
+             temperature=0.0, max_len=None):
+    """Autoregressive generation as ONE jittable program: prefill the cache
+    by scanning the prompt, then sample/argmax n_steps continuation tokens.
+
+    prompt: (B, T_p) int32. Returns (B, n_steps) int32. temperature 0 =
+    greedy; otherwise categorical sampling with `key`."""
+    B, T_p = prompt.shape
+    cache = init_kv_cache(cfg, B, max_len)
+    T_max = cache["k"].shape[2]
+    if T_p + n_steps > T_max:
+        # all lengths are static: fail at trace time instead of letting
+        # dynamic_update_slice clamp writes onto the last cache slot
+        raise ValueError(
+            f"prompt ({T_p}) + n_steps ({n_steps}) exceeds the cache "
+            f"capacity ({T_max}); raise max_len")
+    if T_p + n_steps > params["pos"].shape[0]:
+        raise ValueError(
+            f"prompt ({T_p}) + n_steps ({n_steps}) exceeds max_len "
+            f"({params['pos'].shape[0]}) positional embeddings")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+
+    cache, last_logits = prefill(params, cache, prompt, cfg)
+
+    def sample(logits, k):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            k, logits / temperature, axis=-1).astype(jnp.int32)
+
+    def gen_body(carry, k):
+        cache, logits = carry
+        tok = sample(logits, k)
+        new_logits, cache = decode_step(params, cache, tok, cfg)
+        return (cache, new_logits), tok
+
+    keys = jax.random.split(key, n_steps)
+    _, toks = lax.scan(gen_body, (cache, last_logits), keys)
+    return toks.T  # (B, n_steps)
+
+
+# ---------------------------------------------------------------------------
 # GSPMD step: dp x ep x tp
 # ---------------------------------------------------------------------------
 

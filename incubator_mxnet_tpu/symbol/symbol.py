@@ -182,6 +182,10 @@ class Symbol:
     def list_attr(self, recursive=False):
         """This node's attributes as strings (ref: symbol.py list_attr) —
         op parameters and user attrs in one map."""
+        if recursive:
+            raise DeprecationWarning(
+                "Symbol.list_attr with recursive=True has been deprecated; "
+                "please use attr_dict instead")
         node, _ = self._outputs[0]
         out = {}
         if not node.is_var:
@@ -452,9 +456,18 @@ class Symbol:
             # SEPARATE map with native JSON types: merging them into
             # "attrs" would let a user key shadow a real op parameter on
             # load, and stringifying would mutate '4' into 4 on round-trip
-            user = {k: v for k, v in ((k, _misc_attr_json(v))
-                                      for k, v in n.misc_attrs.items())
-                    if v is not None}
+            user = {}
+            for k, v in n.misc_attrs.items():
+                j = _misc_attr_json(v)
+                if j is None and v is not None:
+                    import warnings
+
+                    warnings.warn(
+                        f"symbol attr {k!r} on node {n.name!r} has an "
+                        f"unserializable value ({type(v).__name__}); "
+                        "dropped from the serialized graph")
+                    continue
+                user[k] = j
             if user:
                 entry["user_attrs"] = user
             out_nodes.append(entry)
@@ -499,11 +512,17 @@ def _misc_attr_str(v):
     return None
 
 
+_TUPLE_TAG = "__tuple__"
+
+
 def _misc_attr_json(v):
-    """User attr value as a JSON-native value preserving its type, or None
-    if it cannot round-trip. Tuples ride as lists (restored on load);
-    Initializer instances degrade to their dumps() string, which
-    initializer.create() parses back."""
+    """User attr value as a JSON value preserving its type, or None if it
+    cannot round-trip (the caller warns). Tuples are tagged so lists stay
+    lists; numpy scalars become their Python value; Initializer instances
+    degrade to their dumps() string, which initializer.create() parses
+    back."""
+    import numpy as _np
+
     from ..initializer import Initializer
 
     if isinstance(v, Initializer):
@@ -511,9 +530,15 @@ def _misc_attr_json(v):
             return v.dumps()
         except TypeError:
             return None
+    if isinstance(v, _np.generic):
+        v = v.item()
     if isinstance(v, tuple):
-        return list(v)
-    if isinstance(v, (str, int, float, bool, list)) or v is None:
+        return {_TUPLE_TAG: list(v)}
+    if isinstance(v, (str, int, float, bool, list, dict)) or v is None:
+        try:
+            json.dumps(v)  # nested unserializable values
+        except (TypeError, ValueError):
+            return None
         return v
     return None
 
@@ -574,9 +599,11 @@ def load_json(json_str):
                     attrs[k] = v
             node = _Node(OP_REGISTRY[nd_["op"]], nd_["name"], attrs,
                          [(nodes[i], oi) for i, oi, _ in nd_["inputs"]])
-        # user attrs round-trip typed; tuples rode as JSON lists
+        # user attrs round-trip typed; tuples rode tagged
         for k, v in nd_.get("user_attrs", {}).items():
-            node.misc_attrs[k] = tuple(v) if isinstance(v, list) else v
+            if isinstance(v, dict) and set(v) == {_TUPLE_TAG}:
+                v = tuple(v[_TUPLE_TAG])
+            node.misc_attrs[k] = v
         nodes.append(node)
     return Symbol([(nodes[i], oi) for i, oi, _ in d["heads"]])
 

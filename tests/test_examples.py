@@ -230,3 +230,8 @@ def test_kaggle_dsb(tmp_path):
                "--test-size", "64", "--out-dir", str(tmp_path),
                timeout=520)
     assert "kaggle_dsb OK" in log
+
+
+def test_transformer_generate():
+    log = _run("transformer_generate.py", "--steps", "120", timeout=520)
+    assert "transformer_generate OK" in log

@@ -300,3 +300,36 @@ def test_attr_roundtrip_fidelity():
     mod.init_params(mx.init.Xavier())
     w = mod.get_params()[0]["w"].asnumpy()
     np.testing.assert_allclose(w, 3.0)
+
+
+def test_scope_lr_mult_reaches_optimizer_dunder():
+    # AttrScope(lr_mult=...) must produce the dunder spelling optimizers read
+    import incubator_mxnet_tpu as mx
+
+    with mx.AttrScope(lr_mult="0.25"):
+        fc = sym.FullyConnected(sym.Variable("data"), num_hidden=2, name="fc")
+    attrs = fc.attr_dict()
+    assert attrs["fc"]["__lr_mult__"] == "0.25"
+    assert attrs["fc_weight"]["__lr_mult__"] == "0.25"
+
+
+def test_attr_list_tuple_and_drop_warn():
+    import pickle
+    import warnings
+
+    v = sym.Variable("v")
+    v._set_attr(order=[1, 2], pair=(3, 4), meta={"a": 1})
+    v2 = pickle.loads(pickle.dumps(v))
+    assert v2.attr("order") == [1, 2]       # list stays list
+    assert v2.attr("pair") == (3, 4)        # tuple stays tuple
+    assert v2.attr("meta") == {"a": 1}      # dicts ride as JSON
+    w = sym.Variable("w")
+    w._set_attr(bad=object())
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        w.tojson()
+    assert any("unserializable" in str(r.message) for r in rec)
+    import pytest as _pytest
+
+    with _pytest.raises(DeprecationWarning):
+        v.list_attr(recursive=True)
