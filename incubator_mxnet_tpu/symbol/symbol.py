@@ -360,14 +360,28 @@ class Symbol:
         return args, outs, auxs
 
     def infer_type(self, **kwargs):
-        dt = np.float32
-        for v in kwargs.values():
-            if v is not None:
-                dt = dtype_np(v)
-                break
-        args = [dt for _ in self.list_arguments()]
-        auxs = [dt for _ in self.list_auxiliary_states()]
-        outs = [dt for _ in self.list_outputs()]
+        """Per-argument dtypes: a given dtype (or a Variable's __dtype__
+        attr) wins; everything else is float32, the framework's parameter
+        default (MXNet v1's own float-centric contract). Outputs take the
+        promoted type of the inputs."""
+        var_dtypes = {}
+        for n in self._topo_nodes():
+            if n.is_var and n.misc_attrs.get("__dtype__"):
+                var_dtypes[n.name] = dtype_np(n.misc_attrs["__dtype__"])
+
+        def arg_dt(name):
+            if kwargs.get(name) is not None:
+                return dtype_np(kwargs[name])
+            return var_dtypes.get(name, np.float32)
+
+        args = [arg_dt(n) for n in self.list_arguments()]
+        auxs = [arg_dt(n) for n in self.list_auxiliary_states()]
+        # outputs follow the floating compute dtype (int args like labels
+        # or indices must not promote everything to float64)
+        out_dt = next(
+            (np.dtype(d) for d in args
+             if np.issubdtype(np.dtype(d), np.floating)), np.float32)
+        outs = [out_dt for _ in self.list_outputs()]
         return args, outs, auxs
 
     # -- binding -----------------------------------------------------------

@@ -33,6 +33,8 @@ def main():
                     help="Pallas flash-attention kernels")
     ap.add_argument("--fused-xent", action="store_true",
                     help="Pallas fused softmax-xent loss kernel")
+    ap.add_argument("--decode-steps", type=int, default=0,
+                    help="also measure KV-cache generation throughput")
     args = ap.parse_args()
 
     import jax
@@ -71,7 +73,7 @@ def main():
     # 6 * params * tokens is the standard fwd+bwd FLOP estimate
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
     flops = 6.0 * n_params * tokens / elapsed
-    print(json.dumps({
+    out = {
         "metric": "transformer_train_tokens_per_sec",
         "value": round(tps, 1),
         "unit": "tokens/sec/chip",
@@ -81,7 +83,52 @@ def main():
         "loss": float(loss),
         "platform": devices[0].platform,
         "config": vars(args),
-    }))
+    }
+
+    if args.decode_steps > 0:
+        # KV-cache generation throughput: one jitted scan program.
+        # Prefill time is measured separately and subtracted so the
+        # number is decode-only and comparable across decode_steps.
+        import jax.numpy as jnp
+
+        prompt_len = min(32, args.seq // 2)
+        steps = min(args.decode_steps, cfg.max_len - prompt_len)
+        if steps < args.decode_steps:
+            out["decode_note"] = (f"decode_steps clamped to {steps} "
+                                  f"(max_len {cfg.max_len})")
+        prompt = jnp.asarray(
+            np.random.RandomState(1).randint(
+                0, args.vocab, (args.batch, prompt_len)), jnp.int32)
+        max_len = prompt_len + steps
+
+        def prefill_only(p, x):
+            cache = tfm.init_kv_cache(cfg, args.batch, max_len)
+            _, logits = tfm.prefill(p, cache, x, cfg)
+            return logits
+
+        gen = jax.jit(lambda p, x: tfm.generate(p, x, steps, cfg,
+                                                max_len=max_len))
+        pre = jax.jit(prefill_only)
+        gen(params, prompt).block_until_ready()  # compile
+        pre(params, prompt).block_until_ready()
+        reps = 3
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            toks = gen(params, prompt)
+        toks.block_until_ready()
+        t_gen = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            lg = pre(params, prompt)
+        lg.block_until_ready()
+        t_pre = time.perf_counter() - t0
+        out["decode_tokens_per_sec"] = round(
+            args.batch * steps * reps / max(t_gen - t_pre, 1e-9), 1)
+        out["decode_steps"] = steps
+        out["prefill_tokens_per_sec"] = round(
+            args.batch * prompt_len * reps / max(t_pre, 1e-9), 1)
+
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
