@@ -300,13 +300,40 @@ def prefill(params, cache, prompt, cfg: TransformerConfig):
             "pos": jnp.asarray(T_p, jnp.int32)}, logits
 
 
+def _filter_logits(logits, top_k=0, top_p=0.0):
+    """Standard sampling filters, static-shape (jit-safe): top_k keeps the
+    k largest logits, top_p (nucleus) keeps the smallest prefix of the
+    sorted distribution whose mass exceeds p; everything else goes to
+    -inf. The caller must pass TEMPERATURE-SCALED logits so the nucleus
+    is taken on the actual sampling distribution."""
+    need_sorted = (top_p and top_p > 0.0) or (top_k and top_k > 0)
+    if not need_sorted:
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]  # descending
+    if top_k and top_k > 0:
+        k = min(int(top_k), logits.shape[-1])  # clamp to vocab
+        kth = sorted_logits[..., k - 1][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p and top_p > 0.0:
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens whose PRECEDING mass is < p (always keeps the top-1)
+        keep_sorted = jnp.concatenate(
+            [jnp.zeros_like(cum[..., :1]), cum[..., :-1]], -1) < top_p
+        cutoff = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
+                         axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
+
+
 def generate(params, prompt, n_steps, cfg: TransformerConfig, key=None,
-             temperature=0.0, max_len=None):
+             temperature=0.0, max_len=None, top_k=0, top_p=0.0):
     """Autoregressive generation as ONE jittable program: prefill the cache
     by scanning the prompt, then sample/argmax n_steps continuation tokens.
 
     prompt: (B, T_p) int32. Returns (B, n_steps) int32. temperature 0 =
-    greedy; otherwise categorical sampling with `key`."""
+    greedy; otherwise categorical sampling with `key`, optionally
+    restricted by top_k / nucleus top_p."""
     B, T_p = prompt.shape
     cache = init_kv_cache(cfg, B, max_len)
     T_max = cache["k"].shape[2]
@@ -328,8 +355,11 @@ def generate(params, prompt, n_steps, cfg: TransformerConfig, key=None,
     def sample(logits, k):
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            k, logits / temperature, axis=-1).astype(jnp.int32)
+        # temperature first, then filters: the nucleus must be taken on
+        # the distribution actually sampled from
+        logits = _filter_logits(logits / temperature, top_k=top_k,
+                                top_p=top_p)
+        return jax.random.categorical(k, logits, axis=-1).astype(jnp.int32)
 
     def gen_body(carry, k):
         cache, logits = carry

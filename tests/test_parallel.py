@@ -331,3 +331,47 @@ def test_decode_step_moe():
         params, cache, np.zeros(3, np.int32), cfg)
     assert logits.shape == (3, 17) and int(cache["pos"]) == 1
     assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_filter_logits_topk_topp():
+    import numpy as np
+
+    from incubator_mxnet_tpu.models.transformer import _filter_logits
+
+    logits = jnp.asarray(np.log(np.array([[0.5, 0.25, 0.15, 0.07, 0.03]])))
+    k2 = np.asarray(_filter_logits(logits, top_k=2))
+    assert np.isfinite(k2[0, :2]).all() and np.isinf(k2[0, 2:]).all()
+
+    p6 = np.asarray(_filter_logits(logits, top_p=0.6))
+    # preceding-mass rule: token0 (0 < .6) and token1 (.5 < .6) survive
+    assert np.isfinite(p6[0, :2]).all() and np.isinf(p6[0, 2:]).all()
+
+    p1 = np.asarray(_filter_logits(logits, top_p=0.3))
+    assert np.isfinite(p1[0, 0]) and np.isinf(p1[0, 1:]).all()  # top-1 kept
+
+
+def test_generate_sampling_jits():
+    import numpy as np
+
+    import jax
+    from incubator_mxnet_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(vocab=19, d_model=16, n_heads=2, n_layers=1,
+                                d_ff=32, max_len=20)
+    params = tfm.init_params(cfg, seed=0)
+    prompt = np.zeros((2, 4), np.int32)
+    toks = jax.jit(lambda p, x, k: tfm.generate(
+        p, x, 6, cfg, key=k, temperature=0.8, top_k=5, top_p=0.9))(
+        params, prompt, jax.random.PRNGKey(1))
+    toks = np.asarray(toks)
+    assert toks.shape == (2, 6) and (toks >= 0).all() and (toks < 19).all()
+
+
+def test_filter_logits_topk_clamps_to_vocab():
+    import numpy as np
+
+    from incubator_mxnet_tpu.models.transformer import _filter_logits
+
+    logits = jnp.asarray(np.random.RandomState(0).randn(2, 5))
+    out = np.asarray(_filter_logits(logits, top_k=50))  # > vocab: keep all
+    assert np.isfinite(out).all()
