@@ -58,3 +58,27 @@ def test_param_container_roundtrip_stability(tmp_path):
     back = nd.load(path)
     for k, v in arrays.items():
         np.testing.assert_array_equal(back[k].asnumpy(), v.asnumpy())
+
+
+def test_golden_symbol_user_attrs_load():
+    """tests/golden/attrs-symbol.json pins the user_attrs schema (typed
+    map, tagged tuples, init wire form): future format changes must keep
+    loading it with full fidelity."""
+    import numpy as np
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import sym
+
+    net = sym.load(os.path.join(GOLD, "attrs-symbol.json"))
+    attrs = net.attr_dict()
+    assert attrs["data"]["ctx_group"] == "dev1"
+    assert attrs["data"]["__shape__"] == (4, 6)  # tuple restored
+    assert attrs["fc"]["note"] == "golden"
+    assert attrs["fc"]["pair"] == (1, 2)
+    assert attrs["fc_weight"]["__lr_mult__"] == 0.25
+    # the serialized Constant(0.5) init must re-apply on init_params
+    mod = mx.module.Module(net, context=mx.cpu())
+    mod.bind([("data", (4, 6))], None, for_training=False)
+    mod.init_params(mx.init.Xavier())
+    np.testing.assert_allclose(
+        mod.get_params()[0]["fc_weight"].asnumpy(), 0.5)
