@@ -42,6 +42,10 @@ __all__ = [
     "apply",
     "make_gspmd_train_step",
     "make_pipeline_train_step",
+    "init_kv_cache",
+    "decode_step",
+    "prefill",
+    "generate",
 ]
 
 
@@ -246,10 +250,14 @@ def decode_step(params, cache, tokens, cfg: TransformerConfig):
             k_cache, k[:, None], pos, axis=1)  # (B, T_max, H, Dh)
         v_cache = lax.dynamic_update_slice_in_dim(
             v_cache, v[:, None], pos, axis=1)
-        logits = jnp.einsum("bhd,bthd->bht", q, k_cache) * scale
-        logits = jnp.where(valid, logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1)
-        a = jnp.einsum("bht,bthd->bhd", probs, v_cache)
+        if cfg.use_flash:
+            from ..ops.pallas_kernels import flash_decode
+
+            a = flash_decode(q, k_cache, v_cache, pos + 1)
+        else:
+            from ..ops.pallas_kernels import dense_decode_attention
+
+            a = dense_decode_attention(q, k_cache, v_cache, pos + 1)
         x = x + a.reshape(B, cfg.d_model) @ lp["wo"]
         h = _ln(x, lp["ln2_g"], lp["ln2_b"])
         if cfg.n_experts:

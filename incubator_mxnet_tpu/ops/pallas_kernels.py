@@ -18,7 +18,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-__all__ = ["flash_attention", "softmax_xent"]
+__all__ = ["flash_attention", "softmax_xent", "flash_decode",
+           "dense_decode_attention"]
 
 _NEG_INF = -1e30
 
@@ -389,3 +390,84 @@ def softmax_xent(logits, labels, block_b=8, interpret=None, vma=None):
     loss = _xent(flat, lab, block_b, interpret,
                  tuple(vma) if vma else None)
     return loss.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# Flash decode: single-query attention over a KV cache (the serving-side
+# memory-bound op — one (1, D) query streams the cache once, online softmax,
+# no (T,) probability vector in HBM). Valid length arrives as data so every
+# decode step is the same compiled kernel.
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, nv_ref, o_ref, *, block_k, scale):
+    q = q_ref[...]  # (1, d)
+    nv = nv_ref[0]
+
+    def body(j, carry):
+        o, m, l = carry
+        k = k_ref[pl.ds(j * block_k, block_k), :]
+        v = v_ref[pl.ds(j * block_k, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        idx = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(idx < nv, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        o_new = o * alpha[:, None] + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    d = q.shape[1]
+    o0 = jnp.zeros((1, d), jnp.float32)
+    m0 = jnp.full((1,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((1,), jnp.float32)
+    num_k = (nv + block_k - 1) // block_k  # dynamic: stream only live blocks
+    o, m, l = jax.lax.fori_loop(0, num_k, body, (o0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[...] = (o / l[:, None]).astype(o_ref.dtype)
+
+
+def dense_decode_attention(q, k_cache, v_cache, n_valid):
+    """Reference single-query cache attention (also the non-tiling
+    fallback and decode_step's dense path): q (B, H, D), caches
+    (B, T, H, D), attend to the first n_valid positions."""
+    D = q.shape[-1]
+    s = jnp.einsum("bhd,bthd->bht", q, k_cache) / np.sqrt(D)
+    T = k_cache.shape[1]
+    s = jnp.where((jnp.arange(T) < n_valid)[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bht,bthd->bhd", p, v_cache)
+
+
+def flash_decode(q, k_cache, v_cache, n_valid, block_k=128, interpret=None):
+    """Single-step attention: q (B, H, D) against caches (B, T, H, D),
+    attending to the first `n_valid` positions (traced scalar). Returns
+    (B, H, D)."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    B, T, H, D = k_cache.shape
+    blk = min(block_k, T)
+    if T % blk != 0:  # cache length must tile; fall back to dense
+        return dense_decode_attention(q, k_cache, v_cache, n_valid)
+    qr = q.reshape(B * H, 1, D)
+    kr = k_cache.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    vr = v_cache.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    nv = jnp.full((1,), n_valid, jnp.int32)
+    kernel = functools.partial(_decode_kernel, block_k=blk,
+                               scale=1.0 / np.sqrt(D))
+    o = pl.pallas_call(
+        kernel,
+        grid=(B * H,),
+        in_specs=[
+            pl.BlockSpec((None, 1, D), lambda b: (b, 0, 0)),
+            pl.BlockSpec((None, T, D), lambda b: (b, 0, 0)),
+            pl.BlockSpec((None, T, D), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1,), lambda b: (0,)),
+        ],
+        out_specs=pl.BlockSpec((None, 1, D), lambda b: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, 1, D), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr, nv)
+    return o.reshape(B, H, D)

@@ -263,3 +263,42 @@ def test_gspmd_fused_xent_multidevice_mesh():
         loss, _ = step(params, tok, tgt)
         losses.append(float(loss))
     np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
+
+
+def test_flash_decode_matches_dense():
+    import numpy as np
+
+    from incubator_mxnet_tpu.ops.pallas_kernels import flash_decode
+
+    rng = np.random.RandomState(0)
+    B, T, H, D = 2, 64, 3, 16
+    q = jnp.asarray(rng.randn(B, H, D).astype("float32"))
+    k = jnp.asarray(rng.randn(B, T, H, D).astype("float32"))
+    v = jnp.asarray(rng.randn(B, T, H, D).astype("float32"))
+
+    for n_valid in (1, 17, 64):
+        got = np.asarray(flash_decode(q, k, v, n_valid, block_k=16,
+                                      interpret=True))
+        s = np.einsum("bhd,bthd->bht", q, k) / np.sqrt(D)
+        s = np.where((np.arange(T) < n_valid)[None, None], s, -1e30)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        want = np.einsum("bht,bthd->bhd", p, v)
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_flash_decode_jits_with_traced_n_valid():
+    import numpy as np
+
+    from incubator_mxnet_tpu.ops.pallas_kernels import flash_decode
+
+    B, T, H, D = 1, 32, 2, 8
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(B, H, D).astype("float32"))
+    k = jnp.asarray(rng.randn(B, T, H, D).astype("float32"))
+    v = jnp.asarray(rng.randn(B, T, H, D).astype("float32"))
+    f = jax.jit(lambda nv: flash_decode(q, k, v, nv, block_k=8,
+                                        interpret=True))
+    a = np.asarray(f(jnp.asarray(5, jnp.int32)))
+    b = np.asarray(f(jnp.asarray(30, jnp.int32)))  # same compiled kernel
+    assert a.shape == (B, H, D) and not np.allclose(a, b)

@@ -375,3 +375,23 @@ def test_filter_logits_topk_clamps_to_vocab():
     logits = jnp.asarray(np.random.RandomState(0).randn(2, 5))
     out = np.asarray(_filter_logits(logits, top_k=50))  # > vocab: keep all
     assert np.isfinite(out).all()
+
+
+def test_decode_step_flash_kernel_matches_dense():
+    # cfg.use_flash routes cache attention through the Pallas flash_decode
+    # kernel; tokens must match the dense path exactly (greedy)
+    import numpy as np
+
+    import jax
+    from incubator_mxnet_tpu.models import transformer as tfm
+
+    prompt = np.random.RandomState(2).randint(0, 29, (2, 6)).astype(np.int32)
+    outs = {}
+    for flash in (False, True):
+        cfg = tfm.TransformerConfig(vocab=29, d_model=32, n_heads=2,
+                                    n_layers=2, d_ff=64, max_len=16,
+                                    use_flash=flash)
+        params = tfm.init_params(cfg, seed=5)
+        outs[flash] = np.asarray(jax.jit(
+            lambda p, x, c=cfg: tfm.generate(p, x, 8, c))(params, prompt))
+    np.testing.assert_array_equal(outs[False], outs[True])
