@@ -121,3 +121,16 @@ class ProgressBar:
         logging.info("[%s] %s%%",
                      "=" * filled + "-" * (self.length - filled),
                      int(round(100 * frac)))
+
+
+class LogValidationMetricsCallback:
+    """Log eval metrics at epoch end (ref: callback.py
+    LogValidationMetricsCallback) — the eval_end_callback counterpart of
+    log_train_metric."""
+
+    def __call__(self, param):
+        if not param.eval_metric:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Epoch[%d] Validation-%s=%f",
+                         param.epoch, name, value)

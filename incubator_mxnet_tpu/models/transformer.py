@@ -237,8 +237,6 @@ def decode_step(params, cache, tokens, cfg: TransformerConfig):
         params["pos"], pos, axis=0, keepdims=False)  # (B, d)
 
     stacked = {k: params[k] for k in _stack_keys(params)}
-    valid = (jnp.arange(T_max) <= pos)[None, None, :]  # (1, 1, T_max)
-    scale = 1.0 / np.sqrt(cfg.d_model // cfg.n_heads)
 
     def body(x, layer_in):
         lp, k_cache, v_cache = layer_in

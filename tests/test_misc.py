@@ -250,3 +250,18 @@ def test_profiler_domain_counter():
     import pytest as _pytest
     with _pytest.raises(TypeError):
         profiler.Task(dom)  # name is required with a Domain
+
+
+def test_log_validation_metrics_callback(caplog):
+    import logging
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.model import BatchEndParam
+
+    m = mx.metric.Accuracy()
+    m.update(mx.nd.array([1.0, 0.0]), mx.nd.array([1.0, 0.0]))
+    cb = mx.callback.LogValidationMetricsCallback()
+    with caplog.at_level(logging.INFO):
+        cb(BatchEndParam(epoch=3, nbatch=0, eval_metric=m, locals=None))
+    assert any("Validation-accuracy" in r.getMessage()
+               for r in caplog.records)
