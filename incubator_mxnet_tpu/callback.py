@@ -16,6 +16,7 @@ import time
 from .model import save_checkpoint
 
 __all__ = ["Speedometer", "do_checkpoint", "module_checkpoint",
+           "LogValidationMetricsCallback",
            "log_train_metric", "ProgressBar"]
 
 

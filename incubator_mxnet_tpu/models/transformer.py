@@ -46,6 +46,7 @@ __all__ = [
     "decode_step",
     "prefill",
     "generate",
+    "beam_search",
 ]
 
 
@@ -376,6 +377,74 @@ def generate(params, prompt, n_steps, cfg: TransformerConfig, key=None,
     keys = jax.random.split(key, n_steps)
     _, toks = lax.scan(gen_body, (cache, last_logits), keys)
     return toks.T  # (B, n_steps)
+
+
+def beam_search(params, prompt, n_steps, cfg: TransformerConfig,
+                beam_size=4, max_len=None):
+    """Beam-search decoding as one jittable program.
+
+    prompt (B, T_p) int32 -> (sequences (B, beam, n_steps) int32,
+    scores (B, beam) summed log-probs), beams sorted best-first. The scan
+    carries only the cache and per-beam scores; sequences are rebuilt at
+    the end by backtracking the per-step parent pointers (no growing
+    buffers inside the loop)."""
+    B, T_p = prompt.shape
+    K, V = int(beam_size), cfg.vocab
+    cache = init_kv_cache(cfg, B, max_len)
+    T_max = cache["k"].shape[2]
+    # the first token comes from prefill logits, so only n_steps-1 decode
+    # writes/pos-embedding reads happen (positions T_p .. T_p+n_steps-2)
+    if T_p + n_steps - 1 > T_max:
+        raise ValueError(
+            f"prompt ({T_p}) + n_steps ({n_steps}) exceeds the cache "
+            f"capacity ({T_max}); raise max_len")
+    if T_p + n_steps - 1 > params["pos"].shape[0]:
+        raise ValueError(
+            f"prompt ({T_p}) + n_steps ({n_steps}) exceeds max_len "
+            f"({params['pos'].shape[0]}) positional embeddings")
+
+    cache, logits = prefill(params, cache, prompt, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)  # (B, V)
+    scores, first = lax.top_k(logp, K)  # (B, K)
+    first = first.astype(jnp.int32)
+
+    # replicate the cache per beam: (L, B, T, H, D) -> (L, B*K, T, H, D)
+    def rep(x):
+        return jnp.repeat(x, K, axis=1)
+
+    cache = {"k": rep(cache["k"]), "v": rep(cache["v"]), "pos": cache["pos"]}
+
+    def step(carry, _):
+        cache, scores, tokens = carry  # tokens (B, K) from previous step
+        logits, cache = decode_step(params, cache, tokens.reshape(B * K),
+                                    cfg)
+        logp = jax.nn.log_softmax(logits, axis=-1).reshape(B, K, V)
+        total = scores[..., None] + logp  # (B, K, V)
+        scores, flat = lax.top_k(total.reshape(B, K * V), K)  # (B, K)
+        parents = (flat // V).astype(jnp.int32)  # which beam each came from
+        tokens = (flat % V).astype(jnp.int32)
+        # reorder every beam-replicated cache row to follow its parent
+        gather = (jnp.arange(B)[:, None] * K + parents).reshape(B * K)
+        cache = {"k": cache["k"][:, gather], "v": cache["v"][:, gather],
+                 "pos": cache["pos"]}
+        return (cache, scores, tokens), (tokens, parents)
+
+    (cache, scores, last), (toks, parents) = lax.scan(
+        step, (cache, scores, first), None, length=n_steps - 1)
+    # toks/parents: (n_steps-1, B, K); prepend the first-step tokens
+    # and backtrack parents from the end to recover each beam's sequence
+    def back(carry, step_data):
+        beam_idx = carry  # (B, K) which beam each final beam was at t+1
+        tok_t, par_t = step_data
+        tok = jnp.take_along_axis(tok_t, beam_idx, axis=1)
+        beam_idx = jnp.take_along_axis(par_t, beam_idx, axis=1)
+        return beam_idx, tok
+
+    init_idx = jnp.tile(jnp.arange(K, dtype=jnp.int32)[None], (B, 1))
+    beam_idx, rev = lax.scan(back, init_idx, (toks, parents), reverse=True)
+    first_tok = jnp.take_along_axis(first, beam_idx, axis=1)  # (B, K)
+    seqs = jnp.concatenate([first_tok[None], rev], axis=0)  # (n_steps, B, K)
+    return seqs.transpose(1, 2, 0), scores
 
 
 # ---------------------------------------------------------------------------
