@@ -160,9 +160,11 @@ def test_bench_transformer_cli_emits_json(tmp_path):
         [sys.executable, os.path.join(REPO, "tools", "bench_transformer.py"),
          "--d-model", "32", "--n-layers", "1", "--d-ff", "64",
          "--vocab", "128", "--batch", "2", "--seq", "16",
-         "--iters", "2", "--warmup", "1"],
+         "--iters", "2", "--warmup", "1", "--decode-steps", "8"],
         capture_output=True, text=True, timeout=420, env=env)
     assert out.returncode == 0, out.stderr[-1500:]
     d = json.loads(out.stdout.strip().splitlines()[-1])
     assert d["metric"] == "transformer_train_tokens_per_sec"
     assert d["value"] > 0
+    assert d["decode_tokens_per_sec"] > 0
+    assert d["prefill_tokens_per_sec"] > 0
