@@ -67,6 +67,23 @@ def run_bench():
         return False
 
 
+def run_transformer_bench():
+    """Bonus on-chip evidence once the headline number is banked: the
+    flagship's train tokens/sec + KV-cache decode tokens/sec (flash +
+    fused-xent kernels). Appends the JSON line to the probe log."""
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "bench_transformer.py"),
+             "--flash", "--fused-xent", "--decode-steps", "64",
+             "--iters", "10", "--warmup", "2"],
+            capture_output=True, text=True, timeout=3600)
+        log(f"transformer bench rc={p.returncode} "
+            f"out={p.stdout.strip()[-500:]}")
+    except subprocess.TimeoutExpired:
+        log("transformer bench timed out")
+
+
 def main():
     once = "--once" in sys.argv
     deadline = time.time() + MAX_HOURS * 3600
@@ -76,6 +93,7 @@ def main():
             log("accelerator UP — running full bench")
             if run_bench():
                 log("fresh on-chip measurement cached — done")
+                run_transformer_bench()
                 return 0
             log("bench ran but no fresh TPU number; will retry")
         else:
