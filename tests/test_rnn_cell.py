@@ -226,3 +226,23 @@ def test_bucket_iter_empty_bucket():
     it = rnn.BucketSentenceIter(sents, batch_size=2, buckets=[2, 9],
                                 invalid_label=0)
     assert sum(1 for _ in it) == 2
+
+
+def test_fused_get_next_state_shapes():
+    fused = rnn.FusedRNNCell(H, num_layers=2, mode="lstm", prefix="lstm_",
+                             get_next_state=True)
+    out, states = fused.unroll(T, sym.Variable("data"), layout="NTC",
+                               merge_outputs=True)
+    assert len(states) == 2  # final h and c
+    grouped = sym.Group([out] + states)
+    from incubator_mxnet_tpu.ops.nn import rnn_param_size
+
+    n = rnn_param_size(2, I, H, False, "lstm")
+    ex = grouped.simple_bind(data=(B, T, I),
+                             **{fused._parameter.name: (n,)})
+    outs = ex.forward(data=np.zeros((B, T, I), "float32"),
+                      **{fused._parameter.name:
+                         nd.array(np.zeros(n, "float32"))})
+    assert outs[0].shape == (B, T, H)
+    assert outs[1].shape == (2, B, H)  # (L*D, B, H) final hidden
+    assert outs[2].shape == (2, B, H)  # final cell
