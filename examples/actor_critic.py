@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Advantage actor-critic on a toy episodic environment (ref:
+example/gluon/actor_critic/actor_critic.py — shared body, policy and
+value heads, advantage = return - V(s), joint policy/value loss).
+
+Environment: a 1-D corridor; the agent starts in the middle and gets +1
+for reaching the right end within the step budget, -1 for the left,
+small step penalty otherwise. A2C must learn to walk right.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+
+
+class Corridor:
+    def __init__(self, n=9, max_steps=20):
+        self.n, self.max_steps = n, max_steps
+
+    def reset(self):
+        self.pos, self.t = self.n // 2, 0
+        return self.obs()
+
+    def obs(self):
+        v = np.zeros(self.n, "float32")
+        v[self.pos] = 1.0
+        return v
+
+    def step(self, action):  # 0 = left, 1 = right
+        self.pos += 1 if action == 1 else -1
+        self.t += 1
+        if self.pos >= self.n - 1:
+            return self.obs(), 1.0, True
+        if self.pos <= 0:
+            return self.obs(), -1.0, True
+        if self.t >= self.max_steps:
+            return self.obs(), -0.5, True
+        return self.obs(), -0.02, False
+
+
+class ActorCritic(gluon.Block):
+    def __init__(self, n_obs, n_act=2):
+        super().__init__()
+        self.body = gluon.nn.Dense(32, activation="relu")
+        self.policy = gluon.nn.Dense(n_act)
+        self.value = gluon.nn.Dense(1)
+
+    def forward(self, x):
+        h = self.body(x)
+        return self.policy(h), self.value(h)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--episodes", type=int, default=250)
+    p.add_argument("--gamma", type=float, default=0.95)
+    args = p.parse_args()
+
+    rng = np.random.RandomState(0)
+    mx.random.seed(0)
+    env = Corridor()
+    net = ActorCritic(env.n)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+
+    returns_hist = []
+    for ep in range(args.episodes):
+        obs_l, act_l, rew_l = [], [], []
+        obs = env.reset()
+        done = False
+        while not done:
+            logits, _ = net(nd.array(obs[None]))
+            prob = nd.softmax(logits).asnumpy()[0].astype(np.float64)
+            prob /= prob.sum()  # float32 rounding vs numpy's strict check
+            a = rng.choice(2, p=prob)
+            obs_l.append(obs)
+            act_l.append(a)
+            obs, r, done = env.step(a)
+            rew_l.append(r)
+
+        # discounted returns, computed backward
+        G, rets = 0.0, []
+        for r in reversed(rew_l):
+            G = r + args.gamma * G
+            rets.append(G)
+        rets = np.asarray(rets[::-1], "float32")
+        returns_hist.append(float(sum(rew_l)))
+
+        X = nd.array(np.asarray(obs_l))
+        A = nd.array(np.asarray(act_l, "float32")).astype("int32")
+        R = nd.array(rets)
+        with autograd.record():
+            logits, values = net(X)
+            values = values.reshape(-1)
+            logp = nd.log_softmax(logits)
+            chosen = nd.sum(logp * nd.one_hot(A, 2), axis=1)
+            adv = R - values
+            # stop value gradients flowing through the policy term
+            policy_loss = -nd.mean(chosen * nd.stop_gradient(adv))
+            value_loss = nd.mean(adv ** 2)
+            loss = policy_loss + 0.5 * value_loss
+        loss.backward()
+        trainer.step(1)
+        if ep % 50 == 0:
+            recent = np.mean(returns_hist[-25:])
+            print(f"episode {ep} recent-return {recent:.3f}")
+
+    final = np.mean(returns_hist[-50:])
+    early = np.mean(returns_hist[:50])
+    print(f"mean return first-50 {early:.3f} -> last-50 {final:.3f}")
+    assert final > 0.6 and final > early, (early, final)
+    print("actor_critic OK")
+
+
+if __name__ == "__main__":
+    main()

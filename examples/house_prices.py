@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Tabular regression with k-fold validation (ref:
+example/gluon/house_prices/kaggle_k_fold_cross_validation.py — feature
+standardization, log-RMSE objective, k-fold model selection).
+
+Synthetic housing-like data (linear signal + interactions + noise) since
+the environment has no network egress; the workflow — standardize, k-fold
+train/validate, report mean log-RMSE — is the point.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+
+
+def make_data(n, d, rng):
+    X = rng.randn(n, d).astype("float32")
+    w = rng.randn(d) * 0.5
+    y = X @ w + 0.3 * X[:, 0] * X[:, 1] + 0.1 * rng.randn(n)
+    price = np.exp(2.0 + 0.5 * y)  # positive, skewed like prices
+    return X, price.astype("float32")
+
+
+def log_rmse(net, X, y):
+    """The net regresses log-price directly (stable — no clamping of a
+    raw-price output near zero)."""
+    pred = net(X).reshape(-1)
+    return float(nd.sqrt(nd.mean((pred - nd.log(y)) ** 2)).asscalar())
+
+
+def train_one(X, y, Xv, yv, epochs, lr, wd, batch_size, rng):
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(32, activation="relu"))
+    net.add(gluon.nn.Dense(1))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": lr, "wd": wd})
+    L = gluon.loss.L2Loss()
+    n = X.shape[0]
+    for _ in range(epochs):
+        perm = rng.permutation(n)
+        for s in range(0, n, batch_size):
+            idx = perm[s:s + batch_size]
+            xb, yb = nd.array(X[idx]), nd.array(np.log(y[idx]))
+            with autograd.record():
+                loss = L(net(xb).reshape(-1), yb)
+            loss.backward()
+            trainer.step(len(idx))
+    return net, log_rmse(net, nd.array(Xv), nd.array(yv))
+
+
+def k_fold(k, X, y, epochs, lr, wd, batch_size, rng):
+    fold = len(X) // k
+    scores = []
+    for i in range(k):
+        lo, hi = i * fold, (i + 1) * fold
+        Xv, yv = X[lo:hi], y[lo:hi]
+        Xt = np.concatenate([X[:lo], X[hi:]])
+        yt = np.concatenate([y[:lo], y[hi:]])
+        _, rmse = train_one(Xt, yt, Xv, yv, epochs, lr, wd, batch_size,
+                            rng)
+        scores.append(rmse)
+        print(f"fold {i}: val log-rmse {rmse:.4f}")
+    return float(np.mean(scores))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--samples", type=int, default=400)
+    p.add_argument("--features", type=int, default=12)
+    p.add_argument("--epochs", type=int, default=20)
+    p.add_argument("--k", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=64)
+    args = p.parse_args()
+
+    rng = np.random.RandomState(0)
+    mx.random.seed(0)
+    X, y = make_data(args.samples, args.features, rng)
+    # standardize features like the reference preprocessing
+    X = (X - X.mean(0)) / (X.std(0) + 1e-8)
+
+    mean_rmse = k_fold(args.k, X, y, args.epochs, lr=0.01, wd=1e-4,
+                       batch_size=args.batch_size, rng=rng)
+    print(f"mean val log-rmse over {args.k} folds: {mean_rmse:.4f}")
+    # predicting the mean log-price scores ~0.55 on this data; the net
+    # must do substantially better
+    assert mean_rmse < 0.35, mean_rmse
+    print("house_prices OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -82,13 +82,30 @@ def invoke(opdef: OpDef, args, kwargs):
         attrs = {k: v for k, v in kwargs.items() if v is not None or k in opdef.attrs}
     else:
         slots = [None] * len(opdef.inputs)
-        for i, a in enumerate(args):
-            slots[i] = _as_data_or_none(a)
         attrs = {}
+        positional_attrs = set()
+        attr_names = list(opdef.attrs)
+        for i, a in enumerate(args):
+            if i < len(slots):
+                slots[i] = _as_data_or_none(a)
+            else:
+                # positional overflow maps onto attrs in signature order,
+                # like the reference's generated signatures (e.g.
+                # nd.one_hot(indices, depth))
+                j = i - len(slots)
+                if j >= len(attr_names):
+                    raise TypeError(
+                        f"op {opdef.name}: too many positional arguments")
+                attrs[attr_names[j]] = a
+                positional_attrs.add(attr_names[j])
         for k, v in kwargs.items():
             if k in opdef.inputs:
                 slots[opdef.inputs.index(k)] = _as_data_or_none(v)
             else:
+                if k in positional_attrs:
+                    raise TypeError(
+                        f"op {opdef.name}: got multiple values for "
+                        f"argument {k!r}")
                 attrs[k] = v
 
     # resolve static attrs with defaults

@@ -63,13 +63,28 @@ def invoke_symbol(op_name, args, kwargs):
         return Symbol([(node, i) for i in range(node.num_outputs)])
 
     slots: list = [None] * len(op.inputs)
-    for i, a in enumerate(args):
-        slots[i] = a
     attrs = {}
+    positional_attrs = set()
+    attr_names = list(op.attrs)
+    for i, a in enumerate(args):
+        if i < len(slots):
+            slots[i] = a
+        else:
+            # positional overflow maps onto attrs in signature order,
+            # mirroring the eager frontend (e.g. sym.one_hot(idx, depth))
+            j = i - len(slots)
+            if j >= len(attr_names):
+                raise TypeError(
+                    f"op {op.name}: too many positional arguments")
+            attrs[attr_names[j]] = a
+            positional_attrs.add(attr_names[j])
     for k, v in kwargs.items():
         if k in op.inputs:
             slots[op.inputs.index(k)] = v
         elif k in op.attrs:
+            if k in positional_attrs:
+                raise TypeError(f"op {op.name}: got multiple values for "
+                                f"argument {k!r}")
             attrs[k] = v
         else:
             raise TypeError(f"op {op.name}: unknown argument {k!r}")

@@ -235,3 +235,20 @@ def test_kaggle_dsb(tmp_path):
 def test_transformer_generate():
     log = _run("transformer_generate.py", "--steps", "120", timeout=520)
     assert "transformer_generate OK" in log
+
+
+def test_lstm_crf():
+    log = _run("lstm_crf.py", "--epochs", "8", "--samples", "192",
+               timeout=520)
+    assert "lstm_crf OK" in log
+
+
+def test_house_prices():
+    log = _run("house_prices.py", "--samples", "300", "--epochs", "30",
+               "--k", "3", timeout=520)
+    assert "house_prices OK" in log
+
+
+def test_actor_critic():
+    log = _run("actor_critic.py", "--episodes", "200", timeout=520)
+    assert "actor_critic OK" in log
