@@ -252,3 +252,8 @@ def test_house_prices():
 def test_actor_critic():
     log = _run("actor_critic.py", "--episodes", "200", timeout=520)
     assert "actor_critic OK" in log
+
+
+def test_sn_gan():
+    log = _run("sn_gan.py", "--iters", "300", timeout=520)
+    assert "sn_gan OK" in log
