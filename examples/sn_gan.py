@@ -27,9 +27,11 @@ class SNDense(gluon.Block):
     def __init__(self, in_units, units, activation=None):
         super().__init__()
         with self.name_scope():
-            self.weight = gluon.Parameter("weight", shape=(units, in_units),
+            # params.get prefixes with the block name, so two SNDense
+            # layers coexist in one collect_params() dict
+            self.weight = self.params.get("weight", shape=(units, in_units),
                                           init=mx.init.Xavier())
-            self.bias = gluon.Parameter("bias", shape=(units,),
+            self.bias = self.params.get("bias", shape=(units,),
                                         init=mx.init.Zero())
         self._u = None
         self._act = activation
@@ -95,19 +97,12 @@ def main():
             return self.l2(self.l1(x))
 
     d = D()
-    d.l1.weight.initialize()
-    d.l1.bias.initialize()
-    d.l2.weight.initialize()
-    d.l2.bias.initialize()
+    d.initialize()
 
     gt = gluon.Trainer(G.collect_params(), "adam",
                        {"learning_rate": 2e-3, "beta1": 0.5})
-    dt = gluon.Trainer(
-        {**{f"d1_{k}": v for k, v in
-            {"w": d.l1.weight, "b": d.l1.bias}.items()},
-         **{f"d2_{k}": v for k, v in
-            {"w": d.l2.weight, "b": d.l2.bias}.items()}},
-        "adam", {"learning_rate": 2e-3, "beta1": 0.5})
+    dt = gluon.Trainer(d.collect_params(), "adam",
+                       {"learning_rate": 2e-3, "beta1": 0.5})
     L = gluon.loss.SigmoidBinaryCrossEntropyLoss()
     ones = nd.array(np.ones(args.batch, "float32"))
     zeros = nd.array(np.zeros(args.batch, "float32"))

@@ -257,3 +257,9 @@ def test_actor_critic():
 def test_sn_gan():
     log = _run("sn_gan.py", "--iters", "300", timeout=520)
     assert "sn_gan OK" in log
+
+
+def test_tree_lstm():
+    log = _run("tree_lstm.py", "--epochs", "4", "--train-trees", "120",
+               timeout=520)
+    assert "tree_lstm OK" in log
