@@ -263,3 +263,8 @@ def test_tree_lstm():
     log = _run("tree_lstm.py", "--epochs", "4", "--train-trees", "120",
                timeout=520)
     assert "tree_lstm OK" in log
+
+
+def test_embedding_learning():
+    log = _run("embedding_learning.py", "--epochs", "25", timeout=520)
+    assert "embedding_learning OK" in log
