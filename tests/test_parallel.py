@@ -438,3 +438,22 @@ def test_beam_search_matches_reference():
         np.testing.assert_array_equal(seqs[b], want_seqs)
         np.testing.assert_allclose(scores[b], want_scores, rtol=1e-4,
                                    atol=1e-4)
+
+
+def test_generate_sampling_deterministic_per_key():
+    import numpy as np
+
+    import jax
+    from incubator_mxnet_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(vocab=19, d_model=16, n_heads=2, n_layers=1,
+                                d_ff=32, max_len=20)
+    params = tfm.init_params(cfg, seed=0)
+    prompt = np.zeros((2, 4), np.int32)
+    gen = jax.jit(lambda p, x, k: tfm.generate(
+        p, x, 6, cfg, key=k, temperature=0.8))
+    a = np.asarray(gen(params, prompt, jax.random.PRNGKey(7)))
+    b = np.asarray(gen(params, prompt, jax.random.PRNGKey(7)))
+    c = np.asarray(gen(params, prompt, jax.random.PRNGKey(8)))
+    np.testing.assert_array_equal(a, b)  # same key -> same sample
+    assert not np.array_equal(a, c)      # different key -> different sample
