@@ -170,6 +170,9 @@ def _run_child(dtype, attempts=3, timeout=1500, extra_env=None):
         env = dict(os.environ)
         env["BENCH_CHILD"] = "1"
         env["BENCH_DTYPE"] = dtype
+        # persistent XLA compile cache: the axon tunnel flaps mid-compile,
+        # and without this every retry pays the full ResNet-50 compile again
+        env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jaxcache")
         env.update(extra_env or {})
         try:
             p = subprocess.run([sys.executable, os.path.abspath(__file__)],
@@ -259,6 +262,48 @@ def _cache_from_artifacts(repo_dir):
     return {"ts": ts, "results": results}
 
 
+def _bank_on_chip(cache_path, results):
+    """Merge on-chip measurements into BENCH_CACHE.json immediately.
+
+    Called after EVERY dtype that lands, not once at the end: the tunnel
+    to the chip can drop (or the whole bench can be killed) between the
+    bf16 and fp32 children, and a measured number must survive that.
+    Per-dtype merge semantics: a short uptime window that lands only bf16
+    must not clobber a previously cached fp32 measurement, and a salvaged
+    PARTIAL never overwrites a cached entry with a better number."""
+    merged = {}
+    try:
+        with open(cache_path) as f:
+            merged = {k: r
+                      for k, r in json.load(f).get("results", {}).items()
+                      if r.get("platform") == "tpu"}
+    except (OSError, ValueError, AttributeError):
+        pass
+    changed = False
+    for k, r in results.items():
+        if r.get("platform") != "tpu":
+            continue
+        old = merged.get(k)
+        if (old is not None and r.get("partial")
+                and _score(old) > _score(r)):
+            continue
+        merged[k] = r
+        changed = True
+    if not changed:
+        return
+    try:
+        # atomic replace: a kill mid-write must not truncate the cache and
+        # destroy every previously banked on-chip number
+        tmp = cache_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime()),
+                       "results": merged}, f)
+        os.replace(tmp, cache_path)
+    except OSError:
+        pass
+
+
 def _probe_accelerator(timeout=150):
     """Fast check that the TPU backend can initialize at all — a down
     tunnel makes jax.devices() hang, and burning full bench timeouts on
@@ -283,6 +328,8 @@ def main():
           file=sys.stderr, flush=True)
 
     results, errors = {}, {}
+    cache_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "BENCH_CACHE.json")
     try:
         child_timeout = int(os.environ.get("BENCH_CHILD_TIMEOUT", "2400"))
     except ValueError:
@@ -296,45 +343,13 @@ def main():
         r, err = _run_child(dtype, attempts=attempts, timeout=timeout)
         if r is not None:
             results[dtype] = r
+            # bank the on-chip number NOW — the tunnel may be gone before
+            # the next dtype finishes
+            _bank_on_chip(cache_path, {dtype: r})
         else:
             errors[dtype] = err
 
-    cache_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              "BENCH_CACHE.json")
     note = ""
-    if any(r.get("platform") == "tpu" for r in results.values()):
-        # remember the real-chip measurement: the axon tunnel flaps for
-        # hours at a time, and a later bench run should report the last
-        # true TPU number (labelled) instead of only a CPU fallback
-        try:
-            merged = {}
-            try:
-                with open(cache_path) as f:
-                    merged = {k: r
-                              for k, r in json.load(f).get("results", {}).items()
-                              if r.get("platform") == "tpu"}
-            except (OSError, ValueError, AttributeError):
-                pass
-            # per-dtype merge: a short uptime window that lands only bf16
-            # must not clobber a previously cached fp32 measurement (both
-            # sides filtered to real on-chip entries — the cache must never
-            # launder a CPU number into an "on-chip" report). A salvaged
-            # PARTIAL never overwrites a cached entry with a better number
-            # (e.g. an earlier full scan-mode measurement).
-            for k, r in results.items():
-                if r.get("platform") != "tpu":
-                    continue
-                old = merged.get(k)
-                if (old is not None and r.get("partial")
-                        and _score(old) > _score(r)):
-                    continue
-                merged[k] = r
-            with open(cache_path, "w") as f:
-                json.dump({"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ",
-                                               time.gmtime()),
-                           "results": merged}, f)
-        except OSError:
-            pass
     cached_ts = None
     if not any(r.get("platform") == "tpu" for r in results.values()):
         # nothing measured on the real chip this run (down tunnel, or a

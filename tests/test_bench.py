@@ -129,6 +129,55 @@ def test_silent_cpu_child_result_yields_cached_tpu_number(cache_guard):
     assert "last successful on-chip" in out["note"]
 
 
+def test_results_banked_per_dtype_as_they_land(cache_guard):
+    """Each dtype's on-chip number is written to the cache the moment its
+    child returns — a tunnel drop (or a killed bench) between the bf16 and
+    fp32 children must not discard the measured half."""
+    if os.path.exists(CACHE):
+        os.remove(CACHE)
+    bench = _load_bench()
+    bench._probe_accelerator = lambda timeout=150: True
+    seen = []
+
+    def run_child(dtype, **k):
+        if dtype == "bfloat16":
+            # snapshot proves bf16 was banked BEFORE fp32 ran
+            r = {"ips": 700.0, "scan_ips": 900.0, "scan_k": 8,
+                 "layout": "NHWC", "dtype": dtype,
+                 "platform": "tpu", "compile_s": 1.0, "loss": 1.0}
+            return r, None
+        with open(CACHE) as f:
+            seen.append(json.load(f)["results"])
+        raise SystemExit(0)  # simulate the bench dying before fp32 lands
+
+    bench._run_child = run_child
+    with pytest.raises(SystemExit):
+        _run_main(bench)
+    assert seen and seen[0]["bfloat16"]["scan_ips"] == 900.0
+
+
+def test_partial_never_clobbers_better_cached_entry(cache_guard):
+    with open(CACHE, "w") as f:
+        json.dump({"ts": "2026-01-01T00:00:00Z", "results": {
+            "bfloat16": {"ips": 500.0, "scan_ips": 1500.0, "scan_k": 8,
+                         "layout": "NHWC", "dtype": "bfloat16",
+                         "platform": "tpu", "compile_s": 1.0}}}, f)
+    bench = _load_bench()
+    # salvaged partial with a WORSE number: cache must keep the full run
+    bench._bank_on_chip(CACHE, {"bfloat16": {
+        "ips": 800.0, "scan_ips": 0.0, "partial": True,
+        "dtype": "bfloat16", "platform": "tpu"}})
+    with open(CACHE) as f:
+        kept = json.load(f)["results"]["bfloat16"]
+    assert kept["scan_ips"] == 1500.0
+    # a BETTER partial does replace it
+    bench._bank_on_chip(CACHE, {"bfloat16": {
+        "ips": 2000.0, "scan_ips": 0.0, "partial": True,
+        "dtype": "bfloat16", "platform": "tpu"}})
+    with open(CACHE) as f:
+        assert json.load(f)["results"]["bfloat16"]["ips"] == 2000.0
+
+
 def test_cache_from_artifacts(tmp_path):
     """A fresh machine (no BENCH_CACHE.json) must reconstruct the on-chip
     cache from committed BENCH_r{N}.json artifacts, never from CPU rows."""

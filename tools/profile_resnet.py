@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""On-chip cost summary of the headline ResNet-50 training step.
+
+Answers "is the step compute-bound, and at what efficiency": builds the
+same model/optimizer/step configuration bench.py's child measures (NHWC
+default, bs128, fp32 or bf16 — the construction is intentionally kept in
+lockstep with bench.child_main; change both together), then reports the
+compiled executable's XLA cost analysis (FLOPs, bytes accessed) next to
+the measured step time, giving achieved TFLOP/s and MFU against the
+chip's MXU peak. For per-op attribution use `mx.profiler` traces.
+
+Usage: python tools/profile_resnet.py [--dtype bfloat16] [--batch 128]
+Prints one JSON line; appends it to tools/bench_probe.log for provenance.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jaxcache")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--image", type=int, default=224)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (the axon plugin ignores "
+                         "JAX_PLATFORMS env; use --platform cpu to smoke-"
+                         "test off-chip)")
+    args = ap.parse_args()
+
+    import numpy as np
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+    import ml_dtypes
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, fused, gluon
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(0)
+    devices = jax.devices()
+    accel = [d for d in devices if d.platform != "cpu"]
+    target = accel[0] if accel else devices[0]
+    try:
+        cpu0 = jax.devices("cpu")[0]
+    except RuntimeError:
+        cpu0 = target
+
+    with jax.default_device(cpu0):
+        net = vision.resnet50_v1(classes=1000, layout="NHWC")
+        net.initialize(mx.init.Xavier())
+        if args.dtype == "bfloat16":
+            net.cast("bfloat16")
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    opt = mx.optimizer.SGD(learning_rate=0.05, momentum=0.9, wd=1e-4,
+                           rescale_grad=1.0 / args.batch)
+    step = fused.GluonTrainStep(net, lambda n, x, y: L(n(x), y), opt,
+                                device=target)
+
+    rng = np.random.RandomState(0)
+    xd = rng.rand(args.batch, args.image, args.image, 3).astype(np.float32)
+    if args.dtype == "bfloat16":
+        xd = xd.astype(ml_dtypes.bfloat16)
+    x = nd.array(jax.device_put(jnp.asarray(xd), target))
+    y = nd.array(jax.device_put(jnp.asarray(
+        rng.randint(0, 1000, size=args.batch).astype(np.float32)), target))
+
+    # warm + compile
+    t0 = time.perf_counter()
+    step(x, y).wait_to_read()
+    compile_s = time.perf_counter() - t0
+
+    # XLA's own cost model for the compiled step (AOT-lower the same jitted
+    # function __call__ executes; nothing runs, so donation is harmless)
+    cost = {}
+    try:
+        from incubator_mxnet_tpu import random as _rng_mod
+
+        lowered = step._step.lower(
+            step._params, step._states, x._data, y._data,
+            _rng_mod.next_key(), jnp.asarray(0.05, jnp.float32),
+            jnp.asarray(1.0, jnp.float32))
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+    except Exception as e:  # cost analysis is best-effort across backends
+        cost = {"error": str(e)[:200]}
+
+    # timed step
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(args.iters):
+        loss = step(x, y)
+    loss.wait_to_read()
+    step_ms = (time.perf_counter() - t0) / args.iters * 1e3
+
+    from bench import PEAK_FLOPS  # single source for the v5e MXU peak
+
+    flops = float(cost.get("flops", 0.0)) if isinstance(cost, dict) else 0.0
+    on_chip = target.platform != "cpu"
+    peak = PEAK_FLOPS.get(args.dtype, PEAK_FLOPS["float32"])
+    out = {
+        "tool": "profile_resnet",
+        "dtype": args.dtype,
+        "platform": target.platform,
+        "batch": args.batch,
+        "compile_s": round(compile_s, 1),
+        "step_ms": round(step_ms, 2),
+        "ips": round(args.batch / (step_ms / 1e3), 1),
+        "xla_flops_per_step": flops,
+        "achieved_tflops": round(flops / (step_ms / 1e3) / 1e12, 1)
+        if flops else None,
+        # MFU is against the TPU MXU peak — meaningless for a CPU smoke run
+        "mfu_vs_xla_flops": round(flops / (step_ms / 1e3) / peak, 3)
+        if flops and on_chip else None,
+        "xla_bytes_accessed": cost.get("bytes accessed")
+        if isinstance(cost, dict) else None,
+    }
+    line = json.dumps(out)
+    print(line, flush=True)
+    try:
+        with open(os.path.join(REPO, "tools", "bench_probe.log"), "a") as f:
+            f.write(f"[{time.strftime('%H:%M:%S')}] {line}\n")
+    except OSError:
+        pass
+
+
+if __name__ == "__main__":
+    main()
