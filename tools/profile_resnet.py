@@ -70,8 +70,10 @@ def main():
     xd = rng.rand(args.batch, args.image, args.image, 3).astype(np.float32)
     if args.dtype == "bfloat16":
         xd = xd.astype(ml_dtypes.bfloat16)
-    x = nd.array(jax.device_put(jnp.asarray(xd), target))
-    y = nd.array(jax.device_put(jnp.asarray(
+    # from_jax: nd.array() would round-trip through host numpy and force-
+    # cast bf16 inputs to float32, profiling a different program
+    x = nd.from_jax(jax.device_put(jnp.asarray(xd), target))
+    y = nd.from_jax(jax.device_put(jnp.asarray(
         rng.randint(0, 1000, size=args.batch).astype(np.float32)), target))
 
     # warm + compile
