@@ -604,7 +604,9 @@ def array(source_array, ctx=None, dtype=None):
     """Create an NDArray from any array-like (ref: mx.nd.array)."""
     if isinstance(source_array, NDArray):
         data = source_array._data
-    elif isinstance(source_array, np.ndarray):
+    elif isinstance(source_array, (np.ndarray, jax.Array)):
+        # jax arrays are the native device type: wrap without a host
+        # round-trip (which would also silently cast bf16 to float32)
         data = source_array
     else:
         # python lists/scalars default to float32, as the reference does

@@ -157,3 +157,19 @@ def test_wait_sync():
     y.wait_to_read()
     nd.waitall()
     assert y.asnumpy()[0, 0] == 10
+
+
+def test_array_from_jax_preserves_buffer_and_dtype():
+    """nd.array(jax.Array) wraps the device buffer as-is: no host round-
+    trip, no silent float32 cast (bf16 bench inputs stayed bf16 only after
+    this was pinned)."""
+    import jax.numpy as jnp
+
+    src = jnp.arange(6, dtype=jnp.bfloat16).reshape(2, 3)
+    out = nd.array(src)
+    assert out.dtype == "bfloat16"
+    assert out._data is src  # zero-copy wrap
+    # explicit dtype still converts
+    assert nd.array(src, dtype="float32").dtype == "float32"
+    # lists keep the reference's float32 default
+    assert nd.array([[1, 2], [3, 4]]).dtype == "float32"
