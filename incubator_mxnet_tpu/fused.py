@@ -44,7 +44,7 @@ class GluonTrainStep:
     """
 
     def __init__(self, net, loss_fn, optimizer, mesh=None, batch_axis=0, device=None,
-                 init_on_device=False):
+                 init_on_device=False, compute_dtype=None):
         self.net = net
         self.loss_fn = loss_fn
         self.opt = optimizer
@@ -62,6 +62,13 @@ class GluonTrainStep:
             raise ValueError(
                 "init_on_device supports the single-device path only; for a "
                 "mesh, params are placed by sharding annotations at build")
+        # mixed precision the TPU way (the reference's multi-precision SGD,
+        # ref: optimizer_op.cc mp_sgd_update): master params and optimizer
+        # states stay float32; inside the step, floating params and inputs
+        # are cast to compute_dtype (e.g. bfloat16) so convs/matmuls ride
+        # the MXU at full rate, while gradients and updates are f32.
+        # Contrast with net.cast("bfloat16"), which trains pure-bf16.
+        self.compute_dtype = jnp.dtype(compute_dtype) if compute_dtype else None
         self._built = False
         self._n = 0
         from .optimizer import Optimizer as _OptBase
@@ -223,7 +230,17 @@ class GluonTrainStep:
         names = self.names
         grad_names = [n for n, m in zip(names, self.grad_mask) if m]
 
+        cdt = self.compute_dtype
+
         def forward(grad_params, other_params, x, y, key):
+            if cdt is not None:
+                # bf16 compute against f32 master weights: cast floating
+                # params and data; BN aux stats stay f32 (other_params)
+                grad_params = [d.astype(cdt)
+                               if jnp.issubdtype(d.dtype, jnp.floating) else d
+                               for d in grad_params]
+                if jnp.issubdtype(x.dtype, jnp.floating):
+                    x = x.astype(cdt)
             mapping = {}
             for n, d in zip(grad_names, grad_params):
                 mapping[n] = NDArray._from_data(d)
@@ -237,7 +254,9 @@ class GluonTrainStep:
             finally:
                 autograd.set_training(prev_t)
                 autograd.set_recording(prev_r)
-            loss_data = jnp.mean(loss._data)
+            # loss reduction in f32 (a bf16 batch-mean loses precision in
+            # exactly the scalar people monitor); no-op for f32 nets
+            loss_data = jnp.mean(loss._data.astype(jnp.float32))
             # aux state updates (BN running stats) show up as rebound arrays
             aux_new = {
                 n: mapping[n]._data

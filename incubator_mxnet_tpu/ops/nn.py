@@ -414,33 +414,58 @@ def leaky_relu(
     raise ValueError(f"unknown act_type {act_type}")
 
 
-@register("softmax")
-def softmax(data, *, axis=-1, temperature=None, length=None):
+def _f32_reduce(fn, data, *args, **kwargs):
+    """Run a softmax-family reduction with float32 accumulation for sub-f32
+    inputs (TPU discipline: bf16 matmuls, f32 softmax/logsumexp — a bf16
+    logsumexp over a 1000-class axis loses ~2 decimal digits), returning
+    the input dtype."""
+    if data.dtype in (jnp.bfloat16, jnp.float16):
+        return fn(data.astype(jnp.float32), *args, **kwargs).astype(data.dtype)
+    return fn(data, *args, **kwargs)
+
+
+@register("softmax", optional=("length",), no_grad_inputs=("length",))
+def softmax(data, length=None, *, axis=-1, temperature=None,
+            use_length=None):
     x = data / temperature if temperature else data
-    return jax.nn.softmax(x, axis=axis)
+    if use_length is False:  # reference scripts pass use_length explicitly
+        length = None
+    if length is None:
+        return _f32_reduce(jax.nn.softmax, x, axis=axis)
+    # masked softmax (ref: softmax use_length=True): positions at or past
+    # each row's length get probability 0; fully-masked rows return 0s
+    ax = axis % x.ndim
+    shape = [1] * x.ndim
+    shape[ax] = x.shape[ax]
+    mask = jnp.arange(x.shape[ax]).reshape(shape) < jnp.expand_dims(
+        length.astype(jnp.int32), ax)
+    x = jnp.where(mask, x, -jnp.inf)
+    out = _f32_reduce(jax.nn.softmax, x, axis=axis)
+    return jnp.where(mask, out, jnp.zeros((), out.dtype))
 
 
 @register("log_softmax")
 def log_softmax(data, *, axis=-1, temperature=None):
     x = data / temperature if temperature else data
-    return jax.nn.log_softmax(x, axis=axis)
+    return _f32_reduce(jax.nn.log_softmax, x, axis=axis)
 
 
 @register("softmin")
 def softmin(data, *, axis=-1):
-    return jax.nn.softmax(-data, axis=axis)
+    return _f32_reduce(jax.nn.softmax, -data, axis=axis)
 
 
 @register("SoftmaxActivation")
 def softmax_activation(data, *, mode="instance"):
     if mode == "channel":
-        return jax.nn.softmax(data, axis=1)
-    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+        return _f32_reduce(jax.nn.softmax, data, axis=1)
+    return _f32_reduce(jax.nn.softmax, data.reshape(data.shape[0], -1),
+                       axis=-1).reshape(data.shape)
 
 
 @register("softmax_cross_entropy", no_grad_inputs=("label",))
 def softmax_cross_entropy(data, label):
-    logp = jax.nn.log_softmax(data, axis=-1)
+    logp = _f32_reduce(jax.nn.log_softmax, data, axis=-1)
     lbl = label.astype(jnp.int32)
     return -jnp.sum(jnp.take_along_axis(logp, lbl[:, None], axis=-1))
 

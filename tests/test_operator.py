@@ -148,6 +148,33 @@ def test_softmax_family():
     assert_almost_equal(p.sum(-1), np.ones(3), rtol=1e-5)
 
 
+def test_softmax_length_masking():
+    """softmax(length=) masks positions at/past each row's length to
+    probability 0 (ref: softmax use_length=True)."""
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    lens = [1, 2, 4]
+    out = nd.softmax(nd.array(x),
+                     length=nd.array(np.array(lens), dtype="int32"))
+    ref = np.zeros((3, 4), np.float32)
+    for i, li in enumerate(lens):
+        e = np.exp(x[i, :li] - x[i, :li].max())
+        ref[i, :li] = e / e.sum()
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-6)
+    assert_almost_equal(out.asnumpy().sum(-1), np.ones(3), rtol=1e-6)
+
+
+def test_softmax_bf16_f32_accumulation():
+    """Sub-f32 softmax/log_softmax accumulate in f32 and return the input
+    dtype: the bf16 result stays within bf16 output-rounding of the f32
+    one even over a 1000-wide axis."""
+    x = np.random.RandomState(0).randn(4, 1000).astype(np.float32)
+    for op in (nd.softmax, nd.log_softmax):
+        bf = op(nd.array(x).astype("bfloat16"))
+        assert bf.dtype == "bfloat16"
+        err = np.abs(bf.asnumpy().astype(np.float32) - op(nd.array(x)).asnumpy())
+        assert err.max() < 0.05
+
+
 def test_softmax_output_grad():
     # SoftmaxOutput backward = p - onehot (ref: softmax_output-inl.h)
     x = nd.array(np.random.randn(4, 3).astype("float32"))
