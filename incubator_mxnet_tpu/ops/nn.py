@@ -282,6 +282,12 @@ def batch_norm(
     (out, new_moving_mean, new_moving_var); the evaluator writes the new
     values back into the aux arrays (the reference mutates aux in place).
     """
+    if output_mean_var:
+        raise NotImplementedError(
+            "BatchNorm output_mean_var=True: the batch moments are carried "
+            "through the functional aux-state protocol here; read the "
+            "updated moving stats instead, or use LayerNorm's moment "
+            "outputs")
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     reduce_axes = tuple(i for i in range(data.ndim) if i != axis % data.ndim)
     bshape = [1] * data.ndim
@@ -307,15 +313,24 @@ def batch_norm(
     return out
 
 
-@register("LayerNorm")
+@register("LayerNorm",
+          num_outputs=lambda attrs: 3 if attrs.get("output_mean_var") else 1)
 def layer_norm(data, gamma, beta, *, axis=-1, eps=1e-5, output_mean_var=False):
-    """Layer normalization (ref: src/operator/nn/layer_norm.cc)."""
+    """Layer normalization (ref: src/operator/nn/layer_norm.cc).
+
+    With output_mean_var, also returns the per-group mean and std
+    (gradient-stopped, matching the reference's FNumVisibleOutputs)."""
     mean = jnp.mean(data, axis=axis, keepdims=True)
     var = jnp.var(data, axis=axis, keepdims=True)
     x_hat = (data - mean) * lax.rsqrt(var + eps)
     bshape = [1] * data.ndim
     bshape[axis % data.ndim] = data.shape[axis % data.ndim]
-    return x_hat * gamma.reshape(bshape) + beta.reshape(bshape)
+    out = x_hat * gamma.reshape(bshape) + beta.reshape(bshape)
+    if output_mean_var:
+        return (out,
+                lax.stop_gradient(jnp.squeeze(mean, axis)),
+                lax.stop_gradient(jnp.squeeze(jnp.sqrt(var + eps), axis)))
+    return out
 
 
 @register("InstanceNorm")
@@ -481,35 +496,51 @@ def softmax_cross_entropy(data, label):
 
 
 def _softmax_output_impl(
-    data, label, grad_scale, ignore_label, use_ignore, multi_output, normalization, smooth_alpha
+    data, label, grad_scale, ignore_label, use_ignore, multi_output,
+    normalization, smooth_alpha, preserve_shape
 ):
     if multi_output:
-        return jax.nn.softmax(data, axis=1)
-    return jax.nn.softmax(data, axis=-1)
+        return _f32_reduce(jax.nn.softmax, data, axis=1)
+    if preserve_shape or data.ndim <= 2:
+        return _f32_reduce(jax.nn.softmax, data, axis=-1)
+    # reference default for ND input: flatten the non-batch dims and
+    # softmax over the flattened classes (ref: softmax_output-inl.h;
+    # preserve_shape=True instead softmaxes each last-axis slice)
+    flat = data.reshape(data.shape[0], -1)
+    return _f32_reduce(jax.nn.softmax, flat, axis=-1).reshape(data.shape)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7, 8))
 def _softmax_output(
-    data, label, grad_scale, ignore_label, use_ignore, multi_output, normalization, smooth_alpha
+    data, label, grad_scale, ignore_label, use_ignore, multi_output,
+    normalization, smooth_alpha, preserve_shape
 ):
     return _softmax_output_impl(
-        data, label, grad_scale, ignore_label, use_ignore, multi_output, normalization, smooth_alpha
+        data, label, grad_scale, ignore_label, use_ignore, multi_output,
+        normalization, smooth_alpha, preserve_shape
     )
 
 
 def _softmax_output_fwd(
-    data, label, grad_scale, ignore_label, use_ignore, multi_output, normalization, smooth_alpha
+    data, label, grad_scale, ignore_label, use_ignore, multi_output,
+    normalization, smooth_alpha, preserve_shape
 ):
     out = _softmax_output_impl(
-        data, label, grad_scale, ignore_label, use_ignore, multi_output, normalization, smooth_alpha
+        data, label, grad_scale, ignore_label, use_ignore, multi_output,
+        normalization, smooth_alpha, preserve_shape
     )
     return out, (out, label)
 
 
 def _softmax_output_bwd(
-    grad_scale, ignore_label, use_ignore, multi_output, normalization, smooth_alpha, res, g
+    grad_scale, ignore_label, use_ignore, multi_output, normalization,
+    smooth_alpha, preserve_shape, res, g
 ):
     out, label = res
+    shape = out.shape
+    flattened = not multi_output and not preserve_shape and out.ndim > 2
+    if flattened:
+        out = out.reshape(shape[0], -1)
     axis = 1 if multi_output else -1
     n_class = out.shape[axis]
     lbl = label.astype(jnp.int32)
@@ -530,7 +561,10 @@ def _softmax_output_bwd(
     elif normalization == "valid" and use_ignore:
         valid = jnp.maximum(jnp.sum(lbl != int(ignore_label)).astype(out.dtype), 1.0)
         grad = grad / valid
-    return (grad * scale, jnp.zeros_like(label))
+    grad = grad * scale
+    if flattened:
+        grad = grad.reshape(shape)
+    return (grad, jnp.zeros_like(label))
 
 
 _softmax_output.defvjp(_softmax_output_fwd, _softmax_output_bwd)
@@ -553,6 +587,7 @@ def softmax_output(
     return _softmax_output(
         data, label, float(grad_scale), float(ignore_label), bool(use_ignore),
         bool(multi_output), normalization, float(smooth_alpha),
+        bool(preserve_shape),
     )
 
 
@@ -675,11 +710,15 @@ def _rnn_slice_params(params, num_layers, input_size, H, D, G):
     return Wx, Wh, bx, bh
 
 
-def _lstm_step(carry, x_t, wx, wh, bx, bh, H):
+def _lstm_step(carry, x_t, wx, wh, bx, bh, H, clip_min=None, clip_max=None):
     h, c = carry
     gates = x_t @ wx.T + bx + h @ wh.T + bh
     i, f, g, o = jnp.split(gates, 4, axis=-1)
     c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    if clip_min is not None or clip_max is not None:
+        # ref: rnn-inl.h / cuDNN cell clipping — the cell state is bounded
+        # BEFORE the output gate reads it
+        c_new = jnp.clip(c_new, clip_min, clip_max)
     h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
     return (h_new, c_new), h_new
 
@@ -746,6 +785,14 @@ def rnn(
     T, B, I = data.shape
     H, D, G = state_size, 2 if bidirectional else 1, _GATES[mode]
     step = _STEPS[mode]
+    if projection_size:
+        raise NotImplementedError(
+            "RNN projection_size: use gluon.contrib.rnn.LSTMPCell (the "
+            "projected-LSTM path); the fused RNN op runs full-rank cells")
+    if mode == "lstm" and (lstm_state_clip_min is not None
+                           or lstm_state_clip_max is not None):
+        step = functools.partial(_lstm_step, clip_min=lstm_state_clip_min,
+                                 clip_max=lstm_state_clip_max)
     Wx, Wh, bx, bh = _rnn_slice_params(parameters, num_layers, I, H, D, G)
 
     x = data

@@ -105,6 +105,10 @@ def _q_range(min_r, max_r):
 def _contrib_quantize(data, min_range, max_range, *, out_type="int8"):
     """fp32 -> int8 with explicit calibration range tensors; returns
     (q, min, max) like the reference."""
+    if out_type not in ("int8", "auto"):
+        raise NotImplementedError(
+            f"quantize out_type='{out_type}': the MXU int8 path is the "
+            f"implemented target (uint8 is not)")
     scale = _q_range(min_range, max_range)
     q = jnp.clip(jnp.rint(data * scale), -127, 127).astype(jnp.int8)
     amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
@@ -116,6 +120,10 @@ def _contrib_quantize_v2(data, *, out_type="int8", min_calib_range=None,
                          max_calib_range=None):
     """Range from attrs when calibrated, else from the data
     (ref: quantize_v2.cc)."""
+    if out_type not in ("int8", "auto"):
+        raise NotImplementedError(
+            f"quantize_v2 out_type='{out_type}': the MXU int8 path is the "
+            f"implemented target (uint8 is not)")
     if min_calib_range is not None and max_calib_range is not None:
         lo = jnp.asarray(min_calib_range, jnp.float32)
         hi = jnp.asarray(max_calib_range, jnp.float32)

@@ -73,7 +73,8 @@ def sample_unique_zipfian(*, range_max=1, shape=(1,), _rng=None):
 
 
 @register("_sample_multinomial", aliases=("sample_multinomial",), needs_rng=True,
-          no_grad_inputs=("data",))
+          no_grad_inputs=("data",),
+          num_outputs=lambda attrs: 2 if attrs.get("get_prob") else 1)
 def sample_multinomial(data, *, shape=(), get_prob=False, dtype="int32", _rng=None):
     n = int(jnp.prod(jnp.array(shape))) if shape else 1
     logits = jnp.log(jnp.maximum(data, 1e-30))
@@ -83,7 +84,17 @@ def sample_multinomial(data, *, shape=(), get_prob=False, dtype="int32", _rng=No
     else:
         out = jax.random.categorical(_rng, logits[:, None, :].repeat(max(n, 1), axis=1), axis=-1)
         out = out.reshape((data.shape[0],) + tuple(shape)) if shape else out.reshape((data.shape[0],))
-    return out.astype(_dt(dtype))
+    sample = out.astype(_dt(dtype))
+    if not get_prob:
+        return sample
+    # ref: sample_multinomial get_prob=True also returns the sampled
+    # class's log-likelihood (used by REINFORCE-style estimators)
+    if data.ndim == 1:
+        logp = logits[out.reshape(-1)].reshape(sample.shape)
+    else:
+        flat = out.reshape(data.shape[0], -1).astype(jnp.int32)
+        logp = jnp.take_along_axis(logits, flat, axis=-1).reshape(sample.shape)
+    return sample, logp.astype(data.dtype)
 
 
 @register("_shuffle", aliases=("shuffle",), needs_rng=True)

@@ -589,7 +589,11 @@ def batch_take(a, indices):
 
 @register("pick", no_grad_inputs=("index",))
 def pick(data, index, *, axis=-1, keepdims=False, mode="clip"):
-    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    idx = index.astype(jnp.int32)
+    if mode == "wrap":  # ref: pick mode=wrap wraps indices modulo the dim
+        idx = jnp.mod(idx, data.shape[axis])
+    else:
+        idx = jnp.clip(idx, 0, data.shape[axis] - 1)
     out = jnp.take_along_axis(data, jnp.expand_dims(idx, axis=axis), axis=axis)
     if not keepdims:
         out = jnp.squeeze(out, axis=axis)

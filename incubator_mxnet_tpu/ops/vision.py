@@ -497,6 +497,8 @@ def spatial_transformer(data, loc, *, target_shape=(0, 0),
     (ref: src/operator/spatial_transformer.cc): loc (B, 6) affine params ->
     grid -> bilinear sample of data.
     """
+    if sampler_type != "bilinear":  # the reference supports only bilinear too
+        raise ValueError(f"sampler_type must be 'bilinear', got {sampler_type}")
     grid = grid_generator(loc, transform_type=transform_type,
                           target_shape=target_shape)
     return bilinear_sampler(data, grid)
@@ -555,6 +557,11 @@ def bilinear_resize_2d(data, *, height=0, width=0, scale_height=None,
     Uses the reference's align_corners=True convention: source coordinate
     i * (H-1)/(oH-1) (jax.image.resize's half-pixel convention differs).
     """
+    if mode != "size":
+        raise NotImplementedError(
+            f"BilinearResize2D mode='{mode}': only explicit size/scale "
+            f"resizing is implemented (the like/odd_scale/to_even_* size "
+            f"derivations are not)")
     b, c, h, w = data.shape
     oh = int(height) if height else int(round(h * (scale_height or 1.0)))
     ow = int(width) if width else int(round(w * (scale_width or 1.0)))

@@ -163,6 +163,63 @@ def test_softmax_length_masking():
     assert_almost_equal(out.asnumpy().sum(-1), np.ones(3), rtol=1e-6)
 
 
+def test_op_attr_semantics_tail():
+    """Attrs that change op semantics or arity must act, not silently
+    no-op (round-4 AST sweep of registered-op signatures)."""
+    # pick mode=wrap wraps indices modulo the dim (default clips)
+    d = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    i = nd.array(np.array([4, -1], dtype=np.float32))
+    assert_almost_equal(nd.pick(d, i, mode="wrap").asnumpy(),
+                        np.array([1.0, 5.0]))  # 4%3=1, -1%3=2
+    assert_almost_equal(nd.pick(d, i).asnumpy(),
+                        np.array([2.0, 3.0]))  # clipped to 2, 0
+
+    # LayerNorm output_mean_var returns (out, mean, std)
+    x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    out, mean, std = nd.LayerNorm(
+        nd.array(x), nd.ones((8,)), nd.zeros((8,)), output_mean_var=True)
+    assert_almost_equal(mean.asnumpy(), x.mean(-1), rtol=1e-5)
+    assert_almost_equal(std.asnumpy(),
+                        np.sqrt(x.var(-1) + 1e-5), rtol=1e-5)
+    assert out.shape == (4, 8) and mean.shape == (4,)
+
+    # sample_multinomial get_prob returns the sampled log-likelihood
+    p = nd.array(np.array([[0.8, 0.2], [0.1, 0.9]], dtype=np.float32))
+    s, logp = nd.sample_multinomial(p, get_prob=True)
+    picked = p.asnumpy()[np.arange(2), s.asnumpy().astype(int)]
+    assert_almost_equal(logp.asnumpy(), np.log(picked), rtol=1e-5)
+
+    # SoftmaxOutput on ND input: default flattens non-batch dims;
+    # preserve_shape softmaxes each last-axis slice
+    d3 = nd.array(np.random.RandomState(1).randn(2, 3, 4).astype(np.float32))
+    lbl = nd.array(np.zeros(2, np.float32))
+    flat = nd.SoftmaxOutput(d3, lbl).asnumpy()
+    assert_almost_equal(flat.reshape(2, -1).sum(-1), np.ones(2), rtol=1e-5)
+    kept = nd.SoftmaxOutput(d3, nd.array(np.zeros((2, 3), np.float32)),
+                            preserve_shape=True).asnumpy()
+    assert_almost_equal(kept.sum(-1), np.ones((2, 3)), rtol=1e-5)
+
+
+def test_rnn_lstm_state_clip():
+    """lstm_state_clip_min/max bound the cell state inside the scan."""
+    T, B, I, H = 3, 2, 4, 5
+    rng = np.random.RandomState(0)
+    # G=4 gates: packed parameter vector sized like the fused RNN expects
+    n_params = 4 * H * (I + H + 2)
+    params = nd.array((rng.rand(n_params) * 4 - 2).astype(np.float32))
+    data = nd.array((rng.rand(T, B, I) * 8).astype(np.float32))
+    h0 = nd.zeros((1, B, H))
+    c0 = nd.zeros((1, B, H))
+    out_c, _, cN = nd.RNN(data, params, h0, c0, state_size=H, num_layers=1,
+                          mode="lstm", state_outputs=True,
+                          lstm_state_clip_min=-0.05, lstm_state_clip_max=0.05)
+    assert float(np.abs(cN.asnumpy()).max()) <= 0.05 + 1e-6
+    # clipping engaged (an unclipped run exceeds the bound)
+    _, _, cF = nd.RNN(data, params, h0, c0, state_size=H, num_layers=1,
+                      mode="lstm", state_outputs=True)
+    assert float(np.abs(cF.asnumpy()).max()) > 0.05
+
+
 def test_softmax_bf16_f32_accumulation():
     """Sub-f32 softmax/log_softmax accumulate in f32 and return the input
     dtype: the bf16 result stays within bf16 output-rounding of the f32
