@@ -497,7 +497,7 @@ def softmax_cross_entropy(data, label):
 
 def _softmax_output_impl(
     data, label, grad_scale, ignore_label, use_ignore, multi_output,
-    normalization, smooth_alpha, preserve_shape
+    normalization, smooth_alpha, preserve_shape, out_grad
 ):
     if multi_output:
         return _f32_reduce(jax.nn.softmax, data, axis=1)
@@ -510,37 +510,38 @@ def _softmax_output_impl(
     return _f32_reduce(jax.nn.softmax, flat, axis=-1).reshape(data.shape)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7, 8, 9))
 def _softmax_output(
     data, label, grad_scale, ignore_label, use_ignore, multi_output,
-    normalization, smooth_alpha, preserve_shape
+    normalization, smooth_alpha, preserve_shape, out_grad
 ):
     return _softmax_output_impl(
         data, label, grad_scale, ignore_label, use_ignore, multi_output,
-        normalization, smooth_alpha, preserve_shape
+        normalization, smooth_alpha, preserve_shape, out_grad
     )
 
 
 def _softmax_output_fwd(
     data, label, grad_scale, ignore_label, use_ignore, multi_output,
-    normalization, smooth_alpha, preserve_shape
+    normalization, smooth_alpha, preserve_shape, out_grad
 ):
     out = _softmax_output_impl(
         data, label, grad_scale, ignore_label, use_ignore, multi_output,
-        normalization, smooth_alpha, preserve_shape
+        normalization, smooth_alpha, preserve_shape, out_grad
     )
     return out, (out, label)
 
 
 def _softmax_output_bwd(
     grad_scale, ignore_label, use_ignore, multi_output, normalization,
-    smooth_alpha, preserve_shape, res, g
+    smooth_alpha, preserve_shape, out_grad, res, g
 ):
     out, label = res
     shape = out.shape
     flattened = not multi_output and not preserve_shape and out.ndim > 2
     if flattened:
         out = out.reshape(shape[0], -1)
+        g = g.reshape(shape[0], -1)
     axis = 1 if multi_output else -1
     n_class = out.shape[axis]
     lbl = label.astype(jnp.int32)
@@ -562,6 +563,10 @@ def _softmax_output_bwd(
         valid = jnp.maximum(jnp.sum(lbl != int(ignore_label)).astype(out.dtype), 1.0)
         grad = grad / valid
     grad = grad * scale
+    if out_grad:
+        # ref: softmax_output-inl.h out_grad=True — scale the implied-loss
+        # gradient by the incoming head gradient (make_loss chaining)
+        grad = grad * g
     if flattened:
         grad = grad.reshape(shape)
     return (grad, jnp.zeros_like(label))
@@ -587,7 +592,7 @@ def softmax_output(
     return _softmax_output(
         data, label, float(grad_scale), float(ignore_label), bool(use_ignore),
         bool(multi_output), normalization, float(smooth_alpha),
-        bool(preserve_shape),
+        bool(preserve_shape), bool(out_grad),
     )
 
 

@@ -138,6 +138,10 @@ def _contrib_quantize_v2(data, *, out_type="int8", min_calib_range=None,
 @register("_contrib_dequantize",
           no_grad_inputs=("data", "min_range", "max_range"))
 def _contrib_dequantize(data, min_range, max_range, *, out_type="float32"):
+    if out_type != "float32":
+        raise NotImplementedError(
+            f"dequantize out_type='{out_type}': only float32 reconstruction "
+            f"is implemented")
     scale = _q_range(min_range, max_range)
     return data.astype(jnp.float32) / scale
 
@@ -149,6 +153,10 @@ def _contrib_requantize(data, min_range, max_range, *, min_calib_range=None,
     """int32 accumulator -> int8 (ref: requantize.cc). The int32 range
     tensors describe the REAL values of the accumulator's int32 extremes,
     so the reconstruction scale is amax/(2^31-1), not the int8 127."""
+    if out_type not in ("int8", "auto"):
+        raise NotImplementedError(
+            f"requantize out_type='{out_type}': the MXU int8 path is the "
+            f"implemented target (uint8 is not)")
     amax32 = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
     real = data.astype(jnp.float32) * (amax32 / 2147483647.0)
     if min_calib_range is not None and max_calib_range is not None:

@@ -200,6 +200,30 @@ def test_op_attr_semantics_tail():
     assert_almost_equal(kept.sum(-1), np.ones((2, 3)), rtol=1e-5)
 
 
+def test_softmax_output_out_grad():
+    """out_grad=True chains the incoming head gradient instead of
+    discarding it (ref: softmax_output-inl.h kOut path)."""
+    x = nd.array(np.random.RandomState(2).randn(3, 4).astype(np.float32))
+    label = nd.array(np.array([0, 1, 2], dtype=np.float32))
+    w = nd.array((np.arange(12).reshape(3, 4) / 6.0).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(x, label, out_grad=True)
+        s = (out * w).sum()
+    s.backward()
+    p = out.asnumpy()
+    onehot = np.eye(4, dtype=np.float32)[[0, 1, 2]]
+    assert_almost_equal(x.grad.asnumpy(), (p - onehot) * w.asnumpy(),
+                        rtol=1e-5)
+    # default: head gradient ignored (implied-loss semantics)
+    x2 = nd.array(x.asnumpy())
+    x2.attach_grad()
+    with autograd.record():
+        out2 = nd.SoftmaxOutput(x2, label)
+        (out2 * w).sum().backward()
+    assert_almost_equal(x2.grad.asnumpy(), p - onehot, rtol=1e-5)
+
+
 def test_rnn_lstm_state_clip():
     """lstm_state_clip_min/max bound the cell state inside the scan."""
     T, B, I, H = 3, 2, 4, 5
