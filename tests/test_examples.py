@@ -268,3 +268,8 @@ def test_tree_lstm():
 def test_embedding_learning():
     log = _run("embedding_learning.py", "--epochs", "25", timeout=520)
     assert "embedding_learning OK" in log
+
+
+def test_mixed_precision():
+    log = _run("mixed_precision.py", "--steps", "40", timeout=520)
+    assert "mixed_precision OK" in log
