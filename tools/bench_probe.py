@@ -70,18 +70,20 @@ def run_bench():
 def run_transformer_bench():
     """Bonus on-chip evidence once the headline number is banked: the
     flagship's train tokens/sec + KV-cache decode tokens/sec (flash +
-    fused-xent kernels). Appends the JSON line to the probe log."""
-    try:
-        p = subprocess.run(
-            [sys.executable, os.path.join(REPO, "tools",
-                                          "bench_transformer.py"),
-             "--flash", "--fused-xent", "--decode-steps", "64",
-             "--iters", "10", "--warmup", "2"],
-            capture_output=True, text=True, timeout=3600)
-        log(f"transformer bench rc={p.returncode} "
-            f"out={p.stdout.strip()[-500:]}")
-    except subprocess.TimeoutExpired:
-        log("transformer bench timed out")
+    fused-xent kernels), in bf16 (the MXU-rate dtype) then fp32.
+    Appends the JSON lines to the probe log."""
+    for dtype in ("bfloat16", "float32"):
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.join(REPO, "tools",
+                                              "bench_transformer.py"),
+                 "--flash", "--fused-xent", "--decode-steps", "64",
+                 "--iters", "10", "--warmup", "2", "--dtype", dtype],
+                capture_output=True, text=True, timeout=3600)
+            log(f"transformer bench ({dtype}) rc={p.returncode} "
+                f"out={p.stdout.strip()[-500:]}")
+        except subprocess.TimeoutExpired:
+            log(f"transformer bench ({dtype}) timed out")
 
 
 def main():

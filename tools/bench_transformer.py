@@ -35,6 +35,8 @@ def main():
                     help="Pallas fused softmax-xent loss kernel")
     ap.add_argument("--decode-steps", type=int, default=0,
                     help="also measure KV-cache generation throughput")
+    ap.add_argument("--dtype", default="float32",
+                    help="parameter/activation dtype (bfloat16 = MXU rate)")
     args = ap.parse_args()
 
     import jax
@@ -47,7 +49,8 @@ def main():
     cfg = tfm.TransformerConfig(
         vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
         n_layers=args.n_layers, d_ff=args.d_ff, max_len=args.seq,
-        use_flash=args.flash, use_fused_xent=args.fused_xent)
+        dtype=args.dtype, use_flash=args.flash,
+        use_fused_xent=args.fused_xent)
     step, params = tfm.make_gspmd_train_step(mesh, cfg)
 
     rng = np.random.RandomState(0)
@@ -82,6 +85,7 @@ def main():
         "compile_s": round(compile_s, 1),
         "loss": float(loss),
         "platform": devices[0].platform,
+        "dtype": args.dtype,
         "config": vars(args),
     }
 
