@@ -1,0 +1,126 @@
+"""Edge-case battery in the reference's test_operator.py style: 0-size
+arrays, negative axes, reshape codes, broadcast corners, autograd heads,
+indexing semantics. Each case pinned against numpy (or the reference's
+documented convention where it differs from numpy)."""
+import numpy as np
+
+from incubator_mxnet_tpu import nd, autograd
+
+
+def test_zero_size_arrays():
+    assert nd.zeros((0, 3)).asnumpy().shape == (0, 3)
+    out = nd.concat(nd.zeros((0, 3)), nd.ones((2, 3)), dim=0)
+    assert out.shape == (2, 3)
+    assert float(nd.sum(nd.zeros((0, 3))).asscalar()) == 0.0
+    assert nd.dot(nd.zeros((0, 3)), nd.zeros((3, 4))).shape == (0, 4)
+
+
+def test_negative_axes_and_indices():
+    x = nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    np.testing.assert_allclose(
+        nd.slice_axis(x, axis=-1, begin=-2, end=None).asnumpy(),
+        x.asnumpy()[..., -2:])
+    np.testing.assert_allclose(nd.flip(x, axis=-1).asnumpy(),
+                               x.asnumpy()[..., ::-1])
+    np.testing.assert_allclose(
+        nd.take(x, nd.array([1.0, 0.0]), axis=-1).asnumpy(),
+        np.take(x.asnumpy(), [1, 0], axis=-1))
+    np.testing.assert_allclose(nd.mean(x, axis=-2).asnumpy(),
+                               x.asnumpy().mean(-2))
+    assert nd.expand_dims(x, axis=-1).shape == (2, 3, 4, 1)
+    assert nd.squeeze(nd.zeros((2, 1, 3)), axis=1).shape == (2, 3)
+    np.testing.assert_allclose(nd.repeat(x, repeats=2, axis=-1).asnumpy(),
+                               x.asnumpy().repeat(2, -1))
+
+
+def test_reshape_special_codes():
+    """0 = keep, -1 = infer, -2 = copy rest, -3 = merge two, -4 = split
+    (ref: matrix_op-inl.h reshape)."""
+    x = nd.zeros((2, 3, 4))
+    assert nd.reshape(x, (0, -1)).shape == (2, 12)
+    assert nd.reshape(x, (-2,)).shape == (2, 3, 4)
+    assert nd.reshape(x, (-3, 4)).shape == (6, 4)
+    assert nd.reshape(nd.zeros((6, 4)), (-4, 2, 3, 4)).shape == (2, 3, 4)
+
+
+def test_broadcast_corners():
+    x = nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    np.testing.assert_allclose((x + nd.array(2.0)).asnumpy(),
+                               x.asnumpy() + 2)
+    np.testing.assert_allclose(nd.broadcast_add(x, nd.ones((1, 3, 1))).asnumpy(),
+                               x.asnumpy() + 1)
+    np.testing.assert_allclose(
+        nd.broadcast_to(nd.ones((1, 3, 1)), shape=(2, 3, 4)).asnumpy(),
+        np.ones((2, 3, 4)))
+    np.testing.assert_allclose(
+        nd.sum(x, axis=1, exclude=True).asnumpy(),
+        x.asnumpy().sum(axis=(0, 2)))
+
+
+def test_backward_with_head_gradient():
+    x = nd.array(np.ones((2, 2), np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array(np.full((2, 2), 2.0, np.float32)))
+    np.testing.assert_allclose(x.grad.asnumpy(), np.full((2, 2), 6.0))
+
+
+def test_detach_blocks_gradient():
+    x = nd.array(np.ones(3, np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = (x * 2).detach() + x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), np.ones(3))
+
+
+def test_grad_req_add_accumulates():
+    x = nd.array(np.ones(3, np.float32))
+    x.attach_grad(grad_req="add")
+    for _ in range(2):
+        with autograd.record():
+            (x * x).sum().backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), np.full(3, 4.0))
+
+
+def test_setitem_patterns():
+    x = nd.zeros((3, 3))
+    x[1] = 5.0
+    assert x.asnumpy()[1].sum() == 15
+    x[0:2, 1] = nd.array(np.array([7.0, 8.0]))
+    assert x.asnumpy()[0, 1] == 7 and x.asnumpy()[1, 1] == 8
+
+
+def test_mask_indexing_semantics():
+    """The reference's convention: comparisons return FLOAT 0/1 masks and
+    an NDArray index is integer indices — so x[x > c] gathers at indices
+    0/1, NOT numpy boolean compression. Genuine bool masks (numpy bool or
+    a bool-dtype NDArray) compress numpy-style."""
+    x = nd.array(np.arange(6, dtype=np.float32))
+    m = x > 2.5
+    assert m.dtype == "float32"
+    np.testing.assert_allclose(x[m].asnumpy(), [0, 0, 0, 1, 1, 1])
+    np.testing.assert_allclose(
+        x[np.array([False, False, False, True, True, True])].asnumpy(),
+        [3, 4, 5])
+    np.testing.assert_allclose(
+        x[nd.array(np.array([0, 0, 0, 1, 1, 1]), dtype="bool")].asnumpy(),
+        [3, 4, 5])
+
+
+def test_norm_variants():
+    np.testing.assert_allclose(
+        nd.norm(nd.array(np.array([[3.0, -4.0]])), ord=1).asnumpy(), 7.0)
+    np.testing.assert_allclose(
+        nd.norm(nd.array(np.array([[3.0, 4.0]])), axis=1).asnumpy(), [5.0])
+
+
+def test_argsort_topk():
+    np.testing.assert_allclose(
+        nd.argsort(nd.array(np.array([3.0, 1.0, 2.0])),
+                   is_ascend=False).asnumpy(), [0, 2, 1])
+    val, idx = nd.topk(nd.array(np.array([[1.0, 9.0, 3.0]])), k=2,
+                       ret_typ="both")
+    np.testing.assert_allclose(val.asnumpy(), [[9.0, 3.0]])
+    np.testing.assert_allclose(idx.asnumpy(), [[1.0, 2.0]])
