@@ -74,6 +74,45 @@ def test_data_parallel_grads_match_single():
                             rtol=1e-4, atol=1e-5, names=(n1, n2))
 
 
+def test_data_parallel_mixed_precision_matches_single():
+    """compute_dtype='bfloat16' composes with the dp mesh: masters stay
+    f32 (replicated) and the sharded MP run equals the single-device MP
+    run to bf16 tolerance."""
+    from incubator_mxnet_tpu import gluon, fused
+    from incubator_mxnet_tpu.gluon import nn
+
+    def build(seed):
+        mx.random.seed(seed)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu", in_units=8),
+                nn.Dense(3, in_units=16))
+        net.initialize(mx.init.Xavier())
+        return net
+
+    np.random.seed(0)
+    X = np.random.randn(16, 8).astype("float32")
+    Y = np.random.randint(0, 3, 16).astype("float32")
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    net1 = build(7)
+    opt1 = mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0)
+    step1 = fused.GluonTrainStep(net1, lambda n, x, y: L(n(x), y), opt1,
+                                 compute_dtype="bfloat16")
+    l1 = float(step1(nd.array(X), nd.array(Y)).asscalar())
+
+    net2 = build(7)
+    opt2 = mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0)
+    step2 = fused.GluonTrainStep(net2, lambda n, x, y: L(n(x), y), opt2,
+                                 mesh=_mesh(), compute_dtype="bfloat16")
+    l2 = float(step2(nd.array(X), nd.array(Y)).asscalar())
+
+    assert abs(l1 - l2) < 1e-2  # bf16 reduction-order tolerance
+    assert all(str(d.dtype) == "float32" for d in step2._params)
+    for d1, d2 in zip(step1._params, step2._params):
+        assert_almost_equal(np.asarray(d1), np.asarray(d2),
+                            rtol=2e-2, atol=2e-3)
+
+
 def test_ring_attention_matches_full():
     mesh = _mesh(8, name="sp")
     B, T, H, D = 2, 32, 4, 8
