@@ -142,10 +142,20 @@ class SigmoidBinaryCrossEntropyLoss(Loss):
         label = F.reshape_like(label, pred)
         if self._from_sigmoid:
             eps = 1e-12
-            loss = -(F.log(pred + eps) * label
-                     + F.log(1.0 - pred + eps) * (1.0 - label))
-        else:
+            pos = F.log(pred + eps) * label
+            if pos_weight is not None:
+                pos = pos * pos_weight
+            loss = -(pos + F.log(1.0 - pred + eps) * (1.0 - label))
+        elif pos_weight is None:
             loss = _logit_bce(F, pred, label)
+        else:
+            # weighted stable form: (1-y)z + (1+(pw-1)y) * log(1+e^-z),
+            # with log(1+e^-z) written as softrelu(-|z|) + relu(-z)
+            log_weight = 1.0 + (pos_weight - 1.0) * label
+            loss = (pred - pred * label
+                    + log_weight * (F.Activation(-F.abs(pred),
+                                                 act_type="softrelu")
+                                    + F.relu(-pred)))
         return self._finish(F, loss, sample_weight)
 
 

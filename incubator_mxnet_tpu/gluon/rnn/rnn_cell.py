@@ -39,7 +39,12 @@ class RecurrentCell(HybridBlock):
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):
         """(ref: rnn_cell.py unroll) — python loop; under hybridize the whole
-        unrolled graph compiles into one XLA program."""
+        unrolled graph compiles into one XLA program.
+
+        With valid_length (B,), outputs past each sequence's length are
+        zeroed and the returned states are each sequence's states at its
+        LAST VALID step (the reference's SequenceMask + SequenceLast
+        semantics), so padded batches train identically to packed ones."""
         self.reset()
         axis = layout.find("T")
         from ... import ndarray as nd
@@ -50,10 +55,34 @@ class RecurrentCell(HybridBlock):
                 for x in nd.split(inputs, num_outputs=length, axis=axis, squeeze_axis=False)
             ]
         states = begin_state if begin_state is not None else self.begin_state(inputs[0].shape[0])
+        begin = states
         outputs = []
+        step_states = []
         for i in range(length):
             output, states = self(inputs[i], states)
             outputs.append(output)
+            if valid_length is not None:
+                step_states.append(states)
+        if valid_length is not None:
+            vl = valid_length if isinstance(valid_length, nd.NDArray) \
+                else nd.array(valid_length)
+            for i in range(length):
+                alive = (vl > float(i)).astype(outputs[i].dtype)
+                shape = (-1,) + (1,) * (len(outputs[i].shape) - 1)
+                outputs[i] = outputs[i] * alive.reshape(shape)
+            # per-sequence last-valid state: one-hot select over
+            # [begin] + steps, so valid_length 0 (an all-padding row)
+            # returns the untouched begin state
+            final = []
+            for k in range(len(states)):
+                stacked = nd.stack(begin[k],
+                                   *[s[k] for s in step_states], axis=0)
+                sel = nd.one_hot(vl, depth=length + 1)  # (B, T+1)
+                sshape = (length + 1, -1) + (1,) * (len(states[k].shape) - 1)
+                w = nd.transpose(sel, axes=(1, 0)).reshape(sshape)
+                final.append(nd.sum(stacked * w.astype(stacked.dtype),
+                                    axis=0))
+            states = final
         if merge_outputs:
             outputs = nd.stack(*outputs, axis=axis)
         return outputs, states
@@ -326,9 +355,29 @@ class BidirectionalCell(RecurrentCell):
         r_cell = self._children["r_cell"]
         begin = begin_state or self.begin_state(inputs[0].shape[0])
         nl = len(l_cell.state_info())
-        l_out, l_states = l_cell.unroll(length, inputs, begin[:nl], layout="NTC")
-        r_out, r_states = r_cell.unroll(length, list(reversed(inputs)), begin[nl:], layout="NTC")
-        r_out = list(reversed(r_out))
+        if valid_length is None:
+            l_out, l_states = l_cell.unroll(length, inputs, begin[:nl],
+                                            layout="NTC")
+            r_out, r_states = r_cell.unroll(length, list(reversed(inputs)),
+                                            begin[nl:], layout="NTC")
+            r_out = list(reversed(r_out))
+        else:
+            # padded batches: the reverse direction must see each
+            # sequence's VALID prefix reversed (ref: SequenceReverse with
+            # use_sequence_length), not the padding first
+            vl = valid_length if isinstance(valid_length, nd.NDArray) \
+                else nd.array(valid_length)
+            stacked = nd.stack(*inputs, axis=0)  # (T, B, ...)
+            rev = nd.SequenceReverse(stacked, vl, use_sequence_length=True)
+            rev_inputs = [rev[i] for i in range(length)]
+            l_out, l_states = l_cell.unroll(length, inputs, begin[:nl],
+                                            layout="NTC", valid_length=vl)
+            r_out, r_states = r_cell.unroll(length, rev_inputs, begin[nl:],
+                                            layout="NTC", valid_length=vl)
+            # un-reverse the valid prefix; masked tail is zeros either way
+            r_back = nd.SequenceReverse(nd.stack(*r_out, axis=0), vl,
+                                        use_sequence_length=True)
+            r_out = [r_back[i] for i in range(length)]
         outputs = [nd.concat(lo, ro, dim=-1) for lo, ro in zip(l_out, r_out)]
         if merge_outputs:
             outputs = nd.stack(*outputs, axis=axis)

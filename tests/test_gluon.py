@@ -154,6 +154,78 @@ def test_gru_bidirectional_states():
     assert new_states[0].shape == (2, 2, 8)
 
 
+def test_unroll_valid_length():
+    """valid_length zeroes outputs past each sequence's length and returns
+    LAST-VALID states; the bidirectional form reverses only the valid
+    prefix. Oracle: a truncated run of the same cells (ref:
+    test_gluon_rnn.py test_rnn_unroll_variant_length)."""
+    mx.random.seed(0)
+    cell = gluon.rnn.LSTMCell(8)
+    cell.initialize()
+    x_np = np.random.RandomState(0).rand(2, 5, 4).astype("float32")
+    vl = nd.array(np.array([3.0, 5.0]))
+    outs, states = cell.unroll(5, nd.array(x_np), layout="NTC",
+                               merge_outputs=True, valid_length=vl)
+    o = outs.asnumpy()
+    assert np.all(o[0, 3:] == 0) and np.any(o[0, 2] != 0)
+    cell2 = gluon.rnn.LSTMCell(8, params=cell.params)
+    _, st3 = cell2.unroll(3, nd.array(x_np[:, :3]), layout="NTC",
+                          merge_outputs=True)
+    for s_full, s_trunc in zip(states, st3):
+        assert_almost_equal(s_full.asnumpy()[0], s_trunc.asnumpy()[0],
+                            rtol=1e-5, atol=1e-6)
+
+    # valid_length 0 (an all-padding row): outputs zeroed, state = the
+    # UNTOUCHED begin state, not zeros
+    begin = [nd.array(np.full((2, 8), 9.0, "float32")),
+             nd.array(np.full((2, 8), 7.0, "float32"))]
+    vl0 = nd.array(np.array([0.0, 5.0]))
+    outs0, st0 = cell.unroll(5, nd.array(x_np), begin_state=begin,
+                             layout="NTC", merge_outputs=True,
+                             valid_length=vl0)
+    assert np.all(outs0.asnumpy()[0] == 0)
+    assert_almost_equal(st0[0].asnumpy()[0], np.full(8, 9.0))
+    assert_almost_equal(st0[1].asnumpy()[0], np.full(8, 7.0))
+
+    bi = gluon.rnn.BidirectionalCell(gluon.rnn.LSTMCell(6),
+                                     gluon.rnn.LSTMCell(6))
+    bi.initialize()
+    outs, states = bi.unroll(5, nd.array(x_np), layout="NTC",
+                             merge_outputs=True, valid_length=vl)
+    o = outs.asnumpy()
+    assert np.all(o[0, 3:] == 0)
+    bi2 = gluon.rnn.BidirectionalCell(bi._children["l_cell"],
+                                      bi._children["r_cell"])
+    outs3, st3 = bi2.unroll(3, nd.array(x_np[:, :3]), layout="NTC",
+                            merge_outputs=True)
+    assert_almost_equal(o[0, :3], outs3.asnumpy()[0], rtol=1e-5, atol=1e-6)
+    for s_full, s_trunc in zip(states, st3):
+        assert_almost_equal(s_full.asnumpy()[0], s_trunc.asnumpy()[0],
+                            rtol=1e-5, atol=1e-6)
+
+
+def test_sigmoid_bce_pos_weight():
+    """pos_weight weights the positive term (both logits and from_sigmoid
+    paths), matching torch's binary_cross_entropy_with_logits."""
+    import torch
+
+    pred = np.array([[0.5, -0.5, 2.0]], np.float32)
+    lbl = np.array([[1.0, 0.0, 1.0]], np.float32)
+    pw = np.array([[2.0, 2.0, 0.5]], np.float32)
+    ref = torch.nn.functional.binary_cross_entropy_with_logits(
+        torch.tensor(pred), torch.tensor(lbl),
+        pos_weight=torch.tensor(pw)).item()
+    L = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    out = float(L(nd.array(pred), nd.array(lbl), None,
+                  nd.array(pw)).asscalar())
+    assert abs(out - ref) < 1e-5
+    L2 = gluon.loss.SigmoidBinaryCrossEntropyLoss(from_sigmoid=True)
+    p = 1 / (1 + np.exp(-pred))
+    out2 = float(L2(nd.array(p), nd.array(lbl), None,
+                    nd.array(pw)).asscalar())
+    assert abs(out2 - ref) < 1e-4
+
+
 def test_lstm_cell_unroll():
     cell = gluon.rnn.LSTMCell(8)
     cell.initialize()

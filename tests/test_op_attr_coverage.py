@@ -74,6 +74,42 @@ ALWAYS_OK = {"cudnn_off", "cudnn_tune", "workspace", "out", "name", "ctx",
              "cudnn_algo_verbose", "_rng", "_training"}
 
 
+def test_no_silently_unused_gluon_forward_params():
+    """Same sweep over gluon forward-path methods (hybrid_forward /
+    forward / unroll): round 4 found SigmoidBCE pos_weight and
+    unroll valid_length declared but ignored this way."""
+    import glob
+
+    offenders = []
+    for path in sorted(glob.glob(
+            os.path.join(REPO, "incubator_mxnet_tpu", "gluon", "**", "*.py"),
+            recursive=True)):
+        tree = ast.parse(open(path).read())
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            if node.name not in ("hybrid_forward", "forward", "unroll"):
+                continue
+            body = node.body
+            # a body that only raises is an abstract base — fine
+            if (len(body) == 1 and isinstance(body[0], ast.Raise)):
+                continue
+            names = [a.arg for a in node.args.args + node.args.kwonlyargs
+                     if a.arg not in ("self", "F")]
+            used = {n.id for n in ast.walk(
+                ast.Module(body=body, type_ignores=[]))
+                if isinstance(n, ast.Name)}
+            for p in names:
+                if p in used or p in ALWAYS_OK:
+                    continue
+                offenders.append(
+                    f"{os.path.relpath(path, REPO)}:{node.lineno} "
+                    f"{node.name}({p})")
+    assert not offenders, (
+        "gluon forward params declared but never used:\n  "
+        + "\n  ".join(offenders))
+
+
 def test_no_silently_unused_op_params():
     offenders = []
     for rel in OP_FILES:
