@@ -19,6 +19,20 @@ import jax.numpy as jnp
 
 from . import random as _random
 
+_NDArray = None
+
+
+def _nd_cls():
+    """NDArray class, cached after the first call (ndarray imports autograd,
+    so a top-level import here would be circular; a per-call `from ...
+    import` in the eager dispatcher costs ~5us/op in importlib locks)."""
+    global _NDArray
+    if _NDArray is None:
+        from .ndarray.ndarray import NDArray as cls
+
+        _NDArray = cls
+    return _NDArray
+
 __all__ = [
     "record",
     "pause",
@@ -132,7 +146,7 @@ def invoke_recorded(fn, input_arrays, name=""):
     Central eager dispatcher used by every generated nd.* function.
     Always returns a list of NDArrays.
     """
-    from .ndarray.ndarray import NDArray
+    NDArray = _nd_cls()
 
     datas = [a._data if isinstance(a, NDArray) else a for a in input_arrays]
     nd_inputs = [a for a in input_arrays if isinstance(a, NDArray)]

@@ -10,6 +10,7 @@ autograd dispatcher (`autograd.invoke_recorded`), mirroring
 """
 from __future__ import annotations
 
+import os
 import sys
 
 import jax
@@ -17,6 +18,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .. import autograd
+from .. import profiler as _profiler
 from .. import random as _global_random
 from ..ops.registry import OP_REGISTRY, OpDef
 from .ndarray import NDArray
@@ -39,10 +41,22 @@ def _freeze(v):
     if isinstance(v, (list, tuple)):
         return tuple(_freeze(x) for x in v)
     if isinstance(v, dict):
-        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+        # no sort: call_attrs insertion order is opdef.attrs order (update
+        # of existing keys preserves position), identical across calls
+        return tuple((k, _freeze(x)) for k, x in v.items())
     # tag leaves with their type: hash(2) == hash(2.0) == hash(True), and a
     # closure traced with int 2 must not serve a call made with float 2.0
     return (type(v).__name__, v)
+
+
+def _eager_jit_enabled():
+    """Per-call read of MXTPU_EAGER_JIT (tests toggle it at runtime), kept
+    off the config registry's knob machinery — this is the hottest line of
+    eager dispatch. The knob stays documented in config.py."""
+    raw = os.environ.get("MXTPU_EAGER_JIT")
+    if raw is None:
+        return False
+    return raw.lower() not in ("0", "false", "off", "")
 
 
 def _maybe_jit(opdef, fn, call_attrs, live_idx, n_slots):
@@ -52,9 +66,7 @@ def _maybe_jit(opdef, fn, call_attrs, live_idx, n_slots):
     shape-diverse eager workloads; on TPU, steady-shape eager loops gain
     the fused-kernel dispatch the reference gets from its engine bulking
     (ref: MXNET_EXEC_BULK_EXEC_* — same latency-for-compilation trade)."""
-    from .. import config as _config
-
-    if opdef.needs_rng or not _config.get("MXTPU_EAGER_JIT"):
+    if opdef.needs_rng or not _eager_jit_enabled():
         return fn
     key = (opdef.name, _freeze(call_attrs), tuple(live_idx), n_slots)
     try:
@@ -140,8 +152,6 @@ def invoke(opdef: OpDef, args, kwargs):
             if full[ap] is not None:
                 full[ap] = lax.stop_gradient(full[ap])
         return opdef.fn(*full, **call_attrs)
-
-    from .. import profiler as _profiler
 
     fn = _maybe_jit(opdef, fn, call_attrs, live_idx, n_slots)
     if _profiler.aggregate_enabled():
