@@ -178,8 +178,7 @@ class NDArray:
     def reshape(self, *shape, **kwargs):
         if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
             shape = tuple(shape[0])
-        from . import register as _r
-
+        _r = _reg()
         return _r.invoke_by_name("Reshape", [self], {"shape": shape, **kwargs})
 
     def reshape_like(self, other):
@@ -207,36 +206,30 @@ class NDArray:
         return self._apply(lambda a, b: jnp.broadcast_to(a, b.shape), other)
 
     def split(self, num_outputs, axis=1, squeeze_axis=False):
-        from . import register as _r
-
+        _r = _reg()
         return _r.invoke_by_name(
             "split", [self],
             {"num_outputs": num_outputs, "axis": axis, "squeeze_axis": squeeze_axis},
         )
 
     def slice(self, begin, end, step=None):
-        from . import register as _r
-
+        _r = _reg()
         return _r.invoke_by_name("slice", [self], {"begin": begin, "end": end, "step": step or ()})
 
     def slice_axis(self, axis, begin, end):
-        from . import register as _r
-
+        _r = _reg()
         return _r.invoke_by_name("slice_axis", [self], {"axis": axis, "begin": begin, "end": end})
 
     def take(self, indices, axis=0, mode="clip"):
-        from . import register as _r
-
+        _r = _reg()
         return _r.invoke_by_name("take", [self, indices], {"axis": axis, "mode": mode})
 
     def one_hot(self, depth, **kwargs):
-        from . import register as _r
-
+        _r = _reg()
         return _r.invoke_by_name("one_hot", [self], {"depth": depth, **kwargs})
 
     def pad(self, mode, pad_width, constant_value=0.0):
-        from . import register as _r
-
+        _r = _reg()
         return _r.invoke_by_name(
             "pad", [self],
             {"mode": mode, "pad_width": pad_width, "constant_value": constant_value},
@@ -252,14 +245,12 @@ class NDArray:
         return self._apply(lambda d: jnp.flip(d, axis=axis))
 
     def diag(self, k=0):
-        from . import register as _r
-
+        _r = _reg()
         return _r.invoke_by_name("diag", [self], {"k": k})
 
     # -- reductions --------------------------------------------------------
     def _reduce(self, name, axis=None, keepdims=False, **kw):
-        from . import register as _r
-
+        _r = _reg()
         return _r.invoke_by_name(name, [self], {"axis": axis, "keepdims": keepdims, **kw})
 
     def sum(self, axis=None, keepdims=False, **kw):
@@ -278,33 +269,27 @@ class NDArray:
         return self._reduce("min", axis, keepdims)
 
     def norm(self, ord=2, axis=None, keepdims=False):
-        from . import register as _r
-
+        _r = _reg()
         return _r.invoke_by_name("norm", [self], {"ord": ord, "axis": axis, "keepdims": keepdims})
 
     def argmax(self, axis=None, keepdims=False):
-        from . import register as _r
-
+        _r = _reg()
         return _r.invoke_by_name("argmax", [self], {"axis": axis, "keepdims": keepdims})
 
     def argmin(self, axis=None, keepdims=False):
-        from . import register as _r
-
+        _r = _reg()
         return _r.invoke_by_name("argmin", [self], {"axis": axis, "keepdims": keepdims})
 
     def argsort(self, axis=-1, is_ascend=True):
-        from . import register as _r
-
+        _r = _reg()
         return _r.invoke_by_name("argsort", [self], {"axis": axis, "is_ascend": is_ascend})
 
     def sort(self, axis=-1, is_ascend=True):
-        from . import register as _r
-
+        _r = _reg()
         return _r.invoke_by_name("sort", [self], {"axis": axis, "is_ascend": is_ascend})
 
     def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
-        from . import register as _r
-
+        _r = _reg()
         return _r.invoke_by_name(
             "topk", [self], {"axis": axis, "k": k, "ret_typ": ret_typ, "is_ascend": is_ascend}
         )
@@ -355,8 +340,7 @@ class NDArray:
         return self._apply(jnp.ceil)
 
     def dot(self, other, transpose_a=False, transpose_b=False):
-        from . import register as _r
-
+        _r = _reg()
         return _r.invoke_by_name(
             "dot", [self, other], {"transpose_a": transpose_a, "transpose_b": transpose_b}
         )
@@ -598,6 +582,21 @@ def _static_set(d, key, v):
     head = jax.lax.slice(d, [0] * d.ndim, [start] + list(d.shape[1:]), ones)
     tail = jax.lax.slice(d, [stop] + [0] * (d.ndim - 1), list(d.shape), ones)
     return jnp.concatenate([head, val, tail], axis=0)
+
+
+
+_REGISTER = None
+
+
+def _reg():
+    """The register module, cached after first use (register imports this
+    module, so a top-level import would be circular; the per-call
+    `from . import` form costs importlib-lock time in hot methods)."""
+    global _REGISTER
+    if _REGISTER is None:
+        from . import register
+        _REGISTER = register
+    return _REGISTER
 
 
 def array(source_array, ctx=None, dtype=None):
