@@ -17,6 +17,20 @@ from ..base import dtype_np
 from ..context import Context, cpu, current_context
 from ..ndarray.ndarray import NDArray
 from ..ndarray import zeros as nd_zeros
+
+_current_subst_fn = None
+
+
+def _current_subst_cached():
+    """block._current_subst, cached after first use (block imports this
+    module; Parameter.data() is called per-param per-forward, so the
+    per-call `from .block import` costs importlib-lock time)."""
+    global _current_subst_fn
+    if _current_subst_fn is None:
+        from .block import _current_subst
+
+        _current_subst_fn = _current_subst
+    return _current_subst_fn()
 from .. import initializer as init_mod
 
 __all__ = ["DeferredInitializationError", "Parameter", "Constant", "ParameterDict"]
@@ -148,9 +162,7 @@ class Parameter:
                     f"parameter {self.name} deferred (shape {self._shape})"
                 )
             raise RuntimeError(f"parameter {self.name} not initialized")
-        from .block import _current_subst
-
-        subst = _current_subst()
+        subst = _current_subst_cached()
         if subst is not None and self.name in subst:
             return subst[self.name]
         return self._data
