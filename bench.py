@@ -193,15 +193,23 @@ def _last_json_line(text):
     return None
 
 
-def _run_child(dtype, attempts=3, timeout=1500, extra_env=None):
+def _run_child(dtype, attempts=3, timeout=1500, extra_env=None,
+               deadline=None):
     """Run one measurement in a subprocess; returns (result_dict, last_err).
 
     A child that times out or crashes mid-run may still have printed a
     stage measurement (the per-step JSON line); that partial is kept as a
-    fallback while the remaining attempts try for a full run."""
+    fallback while the remaining attempts try for a full run. `deadline`
+    (time.monotonic value) bounds the retries as a group."""
     last_err = None
     best_partial = None
     for i in range(attempts):
+        if deadline is not None:
+            left = deadline - time.monotonic()
+            if left < 120:
+                last_err = (last_err or "") + "; budget exhausted"
+                break
+            timeout = int(min(timeout, left))
         env = dict(os.environ)
         env["BENCH_CHILD"] = "1"
         env["BENCH_DTYPE"] = dtype
@@ -369,13 +377,30 @@ def main():
         child_timeout = int(os.environ.get("BENCH_CHILD_TIMEOUT", "2400"))
     except ValueError:
         child_timeout = 2400
+    try:
+        # hard wall-clock ceiling for the whole run: a tunnel that answers
+        # the probe but hangs execution RPCs must not turn the bench into
+        # a 3-attempts x 2400s x 2-dtypes (4h) stall — the cached number
+        # is the fallback after this budget
+        total_budget = float(os.environ.get("BENCH_TOTAL_BUDGET", "4500"))
+    except ValueError:
+        total_budget = 4500.0
+    t_start = time.monotonic()
     # bf16 first: it is the headline TPU path, so a short tunnel-uptime
     # window lands the most important number before the tunnel can flap
     for dtype in ("bfloat16", "float32"):
+        remaining = total_budget - (time.monotonic() - t_start)
+        if remaining < 120:
+            errors[dtype] = f"skipped: total budget {total_budget:.0f}s spent"
+            print(f"[bench] {dtype} skipped ({errors[dtype]})",
+                  file=sys.stderr, flush=True)
+            continue
         # healthy backend: full retries; down tunnel: one short attempt in
         # case the probe raced a recovery, then fall through to the cache
         attempts, timeout = (3, child_timeout) if accel_up else (1, 300)
-        r, err = _run_child(dtype, attempts=attempts, timeout=timeout)
+        timeout = int(min(timeout, remaining))
+        r, err = _run_child(dtype, attempts=attempts, timeout=timeout,
+                            deadline=t_start + total_budget)
         if r is not None:
             results[dtype] = r
             # bank the on-chip number NOW — the tunnel may be gone before

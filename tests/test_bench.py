@@ -95,7 +95,7 @@ def test_no_cache_no_backend_falls_to_cpu_child(cache_guard):
     bench._cache_from_artifacts = lambda repo_dir: None
     calls = []
 
-    def run_child(dtype, attempts=1, timeout=0, extra_env=None):
+    def run_child(dtype, attempts=1, timeout=0, extra_env=None, **kw):
         calls.append(extra_env or {})
         if extra_env and extra_env.get("JAX_PLATFORMS") == "cpu":
             return {"ips": 12.0, "scan_ips": 0.0, "scan_k": 0,
