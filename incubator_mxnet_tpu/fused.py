@@ -44,7 +44,8 @@ class GluonTrainStep:
     """
 
     def __init__(self, net, loss_fn, optimizer, mesh=None, batch_axis=0, device=None,
-                 init_on_device=False, compute_dtype=None):
+                 init_on_device=False, compute_dtype=None,
+                 shard_optimizer_states=False):
         self.net = net
         self.loss_fn = loss_fn
         self.opt = optimizer
@@ -69,6 +70,11 @@ class GluonTrainStep:
         # the MXU at full rate, while gradients and updates are f32.
         # Contrast with net.cast("bfloat16"), which trains pure-bf16.
         self.compute_dtype = jnp.dtype(compute_dtype) if compute_dtype else None
+        # ZeRO-1 analog: keep optimizer states sharded over the dp mesh
+        # axis (see _build's mesh branch)
+        self.shard_optimizer_states = shard_optimizer_states
+        if shard_optimizer_states and mesh is None:
+            raise ValueError("shard_optimizer_states requires a mesh")
         self._built = False
         self._n = 0
         from .optimizer import Optimizer as _OptBase
@@ -143,14 +149,43 @@ class GluonTrainStep:
 
             rep = NamedSharding(mesh, P())
             self._params = [jax.device_put(d, rep) for d in self._params]
-            self._states = jax.tree_util.tree_map(
-                lambda d: jax.device_put(d, rep), self._states
-            )
+            if self.shard_optimizer_states:
+                # ZeRO-1 the GSPMD way: optimizer states live sharded over
+                # the dp axis (leaves whose axis 0 divides the axis size;
+                # the scalar/ragged remainder stays replicated). From these
+                # placements XLA derives reduce-scatter(grads) -> sharded
+                # update -> all-gather(params) instead of a full gradient
+                # all-reduce + replicated update — same math, 1/N state HBM.
+                n = mesh.shape["data"]
+                shard = NamedSharding(mesh, P("data"))
+
+                def place_state(d):
+                    if getattr(d, "ndim", 0) >= 1 and d.shape[0] % n == 0:
+                        return jax.device_put(d, shard)
+                    return jax.device_put(d, rep)
+
+                self._states = jax.tree_util.tree_map(place_state,
+                                                      self._states)
+            else:
+                self._states = jax.tree_util.tree_map(
+                    lambda d: jax.device_put(d, rep), self._states
+                )
             self._data_sharding = NamedSharding(mesh, P("data"))
         else:
             self._data_sharding = None
         self._step_fn = self._make_step()
-        self._step = jax.jit(self._step_fn, donate_argnums=(0, 1))
+        if mesh is not None:
+            # pin output placements to the input ones: without this XLA may
+            # propagate replicated outputs for sharded optimizer states,
+            # re-sharding every step and defeating the 1/N state HBM
+            param_sh = [d.sharding for d in self._params]
+            state_sh = jax.tree_util.tree_map(lambda d: d.sharding,
+                                              self._states)
+            self._out_sh = (None, param_sh, state_sh)
+        else:
+            self._out_sh = None
+        self._step = jax.jit(self._step_fn, donate_argnums=(0, 1),
+                             out_shardings=self._out_sh)
 
         def scan_fn(params, states, xs, ys, keys, lrs, ts):
             def body(carry, inp):
@@ -165,7 +200,10 @@ class GluonTrainStep:
 
         # one jit wrapper; its cache keys on shapes, so varying K reuses
         # previously compiled executables
-        self._scan = jax.jit(scan_fn, donate_argnums=(0, 1))
+        self._scan = jax.jit(
+            scan_fn, donate_argnums=(0, 1),
+            out_shardings=(None,) + self._out_sh[1:]
+            if self._out_sh is not None else None)
         self._built = True
 
     def _materialize_on_device(self):
