@@ -204,6 +204,8 @@ class GluonTrainStep:
             scan_fn, donate_argnums=(0, 1),
             out_shardings=(None,) + self._out_sh[1:]
             if self._out_sh is not None else None)
+        self._accum = jax.jit(self._accum_fn, donate_argnums=(0, 1),
+                              out_shardings=self._out_sh)
         self._built = True
 
     def _materialize_on_device(self):
@@ -325,6 +327,47 @@ class GluonTrainStep:
                     new_states.append(None)
             return loss, new_params, new_states
 
+        def accum(params, states, xs, ys, keys, lr, t):
+            """K micro-batches -> ONE optimizer update, one device program.
+
+            Gradients SUM over micro-batches (set rescale_grad to
+            1/(micro_batch * K) for a mean over the effective batch —
+            the reference's grad_req='add' accumulation contract); BN aux
+            stats update every micro-batch, threaded through the scan
+            carry."""
+            grad_params = [d for d, m in zip(params, self.grad_mask) if m]
+            other_params = {
+                n: d for n, d, m in zip(names, params, self.grad_mask) if not m
+            }
+
+            def body(carry, inp):
+                others, gsum, lsum = carry
+                x, y, key = inp
+                (loss, aux_new), grads = jax.value_and_grad(
+                    forward, has_aux=True)(grad_params, others, x, y, key)
+                others = {**others, **aux_new}
+                gsum = [a + g for a, g in zip(gsum, grads)]
+                return (others, gsum, lsum + loss), None
+
+            zero_g = [jnp.zeros_like(d) for d in grad_params]
+            (others_f, gsum, lsum), _ = jax.lax.scan(
+                body, (other_params, zero_g, jnp.zeros((), jnp.float32)),
+                (xs, ys, keys))
+            new_params, new_states = [], []
+            gi = 0
+            for i, (n, d, m) in enumerate(zip(names, params, self.grad_mask)):
+                if m:
+                    w, st = self.opt.fused_update(n, d, gsum[gi], states[i],
+                                                  lr, t=t)
+                    gi += 1
+                    new_params.append(w)
+                    new_states.append(st)
+                else:
+                    new_params.append(others_f.get(n, d))
+                    new_states.append(None)
+            return lsum / xs.shape[0], new_params, new_states
+
+        self._accum_fn = accum
         return step
 
     def __call__(self, x, y):
@@ -390,6 +433,38 @@ class GluonTrainStep:
             self._params, self._states, xd, yd, keys,
             jnp.asarray(lrs, jnp.float32), jnp.asarray(ts, jnp.float32))
         return NDArray._from_data(losses)
+
+    def accum_steps(self, xs, ys):
+        """K micro-batches -> ONE optimizer update (gradient accumulation)
+        as one device program: grads sum over the K forward/backwards
+        (lax.scan), then the optimizer applies once. The big-effective-
+        batch analog of the reference's grad_req='add' workflow — set
+        rescale_grad = 1/(micro_batch*K) for a mean over the effective
+        batch. xs: (K, B, ...), ys: (K, ...). Returns the mean loss."""
+        xd = xs._data if isinstance(xs, NDArray) else jnp.asarray(xs)
+        yd = ys._data if isinstance(ys, NDArray) else jnp.asarray(ys)
+        if not self._built:
+            self._build(NDArray._from_data(xd[0]), NDArray._from_data(yd[0]))
+        k = int(xd.shape[0])
+        if self._data_sharding is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            stacked = NamedSharding(self.mesh, P(None, "data"))
+            xd = jax.device_put(xd, stacked)
+            yd = jax.device_put(yd, stacked)
+        elif self.device is not None:
+            xd = jax.device_put(xd, self.device)
+            yd = jax.device_put(yd, self.device)
+        keys = jnp.stack([_global_random.next_key() for _ in range(k)])
+        self._n += 1  # ONE update
+        self.opt.num_update = self._n
+        lr = (self.opt.lr_scheduler(self._n) if self.opt.lr_scheduler
+              else self.opt.lr)
+        loss, self._params, self._states = self._accum(
+            self._params, self._states, xd, yd, keys,
+            jnp.asarray(lr, jnp.float32),
+            jnp.asarray(float(self._n), jnp.float32))
+        return NDArray._from_data(loss)
 
     def memory_stats(self, x, y, name="train_step"):
         """Compile-time device memory breakdown of the fused step (the

@@ -456,6 +456,47 @@ def test_train_step_compute_dtype_mixed_precision():
     assert losses_mp[-1] < losses_mp[0]
 
 
+def test_accum_steps_matches_big_batch():
+    """K accumulated micro-batches == ONE step on the concatenated batch
+    (exact for a BN-free f32 net when rescale_grads match: summed
+    micro-batch mean-grads at rescale r == big-batch mean-grad at
+    rescale K*r). BN aux stats update every micro-batch."""
+    import jax
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import fused, gluon, nd
+    from incubator_mxnet_tpu.gluon import nn
+
+    def build(rescale):
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu", in_units=6), nn.Dense(3))
+        net.initialize(mx.init.Xavier())
+        L = gluon.loss.SoftmaxCrossEntropyLoss()
+        opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                               rescale_grad=rescale)
+        return net, fused.GluonTrainStep(net, lambda n, x, y: L(n(x), y),
+                                         opt)
+
+    rng = np.random.RandomState(3)
+    X = rng.rand(8, 6).astype("float32")
+    Y = rng.randint(0, 3, 8).astype("float32")
+    net_a, acc = build(0.5)
+    net_b, big = build(1.0)
+    net_a(nd.array(X)), net_b(nd.array(X))  # materialize deferred shapes
+    for p_src, p_dst in zip(net_a.collect_params().values(),
+                            net_b.collect_params().values()):
+        p_dst.set_data(nd.array(p_src.data().asnumpy()))
+
+    for _ in range(3):
+        la = float(acc.accum_steps(
+            nd.array(X.reshape(2, 4, 6)),
+            nd.array(Y.reshape(2, 4))).asscalar())
+        lb = float(big(nd.array(X), nd.array(Y)).asscalar())
+        np.testing.assert_allclose(la, lb, rtol=1e-5)
+    for da, db in zip(acc._params, big._params):
+        np.testing.assert_allclose(np.asarray(da), np.asarray(db),
+                                   rtol=1e-5, atol=1e-6)
+
+
 def test_scan_steps_matches_sequential():
     """K steps in one lax.scan program == K per-dispatch steps
     (params, optimizer states, losses all equal)."""
