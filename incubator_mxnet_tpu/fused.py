@@ -173,6 +173,17 @@ class GluonTrainStep:
             self._data_sharding = NamedSharding(mesh, P("data"))
         else:
             self._data_sharding = None
+        pending = getattr(self, "_pending_states", None)
+        if pending is not None:
+            # load_states() was called before the first step: overwrite the
+            # freshly created states with the checkpointed values, keeping
+            # this build's placements (incl. sharded optimizer states)
+            self._states = jax.tree_util.tree_map(
+                lambda cur, new: jax.device_put(jnp.asarray(new),
+                                                cur.sharding)
+                if hasattr(cur, "sharding") else new,
+                self._states, pending)
+            self._pending_states = None
         self._step_fn = self._make_step()
         if mesh is not None:
             # pin output placements to the input ones: without this XLA may
@@ -465,6 +476,39 @@ class GluonTrainStep:
             jnp.asarray(lr, jnp.float32),
             jnp.asarray(float(self._n), jnp.float32))
         return NDArray._from_data(loss)
+
+    def save_states(self, fname):
+        """Serialize optimizer states + the update count for resume (the
+        fused path's Trainer.save_states). Parameters travel separately
+        via sync_params() + net.save_parameters; this file carries the
+        optimizer side only."""
+        import pickle
+
+        if not self._built:
+            raise RuntimeError("save_states before the first step: "
+                               "optimizer states do not exist yet")
+        states_np = jax.tree_util.tree_map(jax.device_get, self._states)
+        with open(fname, "wb") as f:
+            pickle.dump({"n": self._n, "states": states_np}, f)
+
+    def load_states(self, fname):
+        """Restore optimizer states saved by save_states. May be called
+        before or after the first step; placements (including sharded
+        optimizer states) follow the step's current configuration."""
+        import pickle
+
+        with open(fname, "rb") as f:
+            d = pickle.load(f)
+        self._n = int(d["n"])
+        self.opt.num_update = self._n
+        if self._built:
+            self._states = jax.tree_util.tree_map(
+                lambda cur, new: jax.device_put(jnp.asarray(new),
+                                                cur.sharding)
+                if hasattr(cur, "sharding") else new,
+                self._states, d["states"])
+        else:
+            self._pending_states = d["states"]
 
     def memory_stats(self, x, y, name="train_step"):
         """Compile-time device memory breakdown of the fused step (the
