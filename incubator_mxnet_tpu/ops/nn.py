@@ -987,3 +987,7 @@ def svm_output(data, label, *, margin=1.0, regularization_coefficient=1.0,
 # round-4 name-parity aliases
 alias("BatchNorm", "BatchNorm_v1")
 alias("Embedding", "_contrib_SparseEmbedding")
+# legacy v1 forms kept for ported-script compat (ref: convolution_v1.cc,
+# pooling_v1.cc — same math, pre-NNVM parameter structs)
+alias("Convolution", "Convolution_v1")
+alias("Pooling", "Pooling_v1")

@@ -381,6 +381,163 @@ def roi_pooling(data, rois, *, pooled_size, spatial_scale):
     return jax.vmap(one_roi)(rois)
 
 
+@register("Crop", optional=("crop_like",), no_grad_inputs=("crop_like",))
+def crop_op(data, crop_like=None, *, offset=(0, 0), h_w=(0, 0),
+            center_crop=False, num_args=1):
+    """Legacy spatial crop of (N, C, H, W) to h_w or to crop_like's H/W,
+    at (y, x) offset or centered (ref: src/operator/crop.cc)."""
+    if crop_like is not None and num_args == 2:
+        th, tw = int(crop_like.shape[2]), int(crop_like.shape[3])
+    else:
+        th, tw = int(h_w[0]), int(h_w[1])
+    if th <= 0 or tw <= 0:
+        raise ValueError("Crop: target size must be positive (set h_w or "
+                         "pass crop_like with num_args=2)")
+    h, w = data.shape[2], data.shape[3]
+    if center_crop:
+        oy, ox = (h - th) // 2, (w - tw) // 2
+    else:
+        oy, ox = int(offset[0]), int(offset[1])
+    if not (0 <= oy and oy + th <= h and 0 <= ox and ox + tw <= w):
+        raise ValueError(f"Crop: window {th}x{tw}@({oy},{ox}) outside "
+                         f"{h}x{w}")
+    return data[:, :, oy:oy + th, ox:ox + tw]
+
+
+@register("_contrib_PSROIPooling", aliases=("PSROIPooling",),
+          no_grad_inputs=("rois",))
+def psroi_pooling(data, rois, *, spatial_scale, output_dim, pooled_size,
+                  group_size=0):
+    """Position-sensitive ROI pooling, the R-FCN head
+    (ref: src/operator/contrib/psroi_pooling.cc).
+
+    data (B, output_dim*group^2, H, W); rois (R, 5). Output bin (i, j) of
+    channel o AVERAGES the (o, gi, gj) channel page over the bin's
+    region — mask-and-reduce like ROIPooling above (no scatter kernel)."""
+    group = int(group_size) or int(pooled_size)
+    p = int(pooled_size)
+    b, c, h, w = data.shape
+    o_dim = int(output_dim)
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale
+        y1 = jnp.round(roi[2]) * spatial_scale
+        x2 = jnp.round(roi[3] + 1.0) * spatial_scale
+        y2 = jnp.round(roi[4] + 1.0) * spatial_scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bin_h, bin_w = rh / p, rw / p
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+        i = jnp.arange(p, dtype=jnp.float32)
+        y_lo = jnp.floor(y1 + i[:, None] * bin_h)
+        y_hi = jnp.ceil(y1 + (i[:, None] + 1.0) * bin_h)
+        x_lo = jnp.floor(x1 + i[:, None] * bin_w)
+        x_hi = jnp.ceil(x1 + (i[:, None] + 1.0) * bin_w)
+        row_m = (ys[None, :] >= y_lo) & (ys[None, :] < y_hi)  # (p, H)
+        col_m = (xs[None, :] >= x_lo) & (xs[None, :] < x_hi)  # (p, W)
+        img = data[bidx].reshape(o_dim, group, group, h, w)
+        gi = jnp.clip((i.astype(jnp.int32) * group) // p, 0, group - 1)
+        pages = img[:, gi][:, :, gi]  # (O, p, p, H, W): bin -> its page
+        m2 = (row_m[:, None, :, None] & col_m[None, :, None, :])  # (p,p,H,W)
+        num = jnp.sum(jnp.where(m2[None], pages, 0.0), axis=(-1, -2))
+        cnt = jnp.maximum(jnp.sum(m2, axis=(-1, -2)), 1).astype(data.dtype)
+        return num / cnt[None]  # (O, p, p); empty bins -> 0
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("_contrib_DeformablePSROIPooling",
+          aliases=("DeformablePSROIPooling",), optional=("trans",),
+          no_grad_inputs=("rois",), num_outputs=1)
+def deformable_psroi_pooling(data, rois, trans=None, *, spatial_scale,
+                             output_dim, pooled_size, group_size=0,
+                             part_size=0, sample_per_part=4,
+                             trans_std=0.0, no_trans=False):
+    """Deformable position-sensitive ROI pooling (Deformable R-FCN head,
+    ref: src/operator/contrib/deformable_psroi_pooling.cc).
+
+    Each bin averages sample_per_part^2 bilinear taps; `trans` holds
+    per-(class, bin) offsets in roi-size units, scaled by trans_std.
+    With no_trans/absent trans this is the sampled form of PSROIPooling."""
+    group = int(group_size) or int(pooled_size)
+    p = int(pooled_size)
+    part = int(part_size) or p
+    s = int(sample_per_part)
+    b, c, h, w = data.shape
+    o_dim = int(output_dim)
+    use_trans = (trans is not None) and not no_trans
+    n_cls = (trans.shape[1] // 2) if use_trans else 1
+    per_cls = max(o_dim // n_cls, 1)
+
+    def one_roi(roi, tr):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale - 0.5
+        y1 = jnp.round(roi[2]) * spatial_scale - 0.5
+        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bin_h, bin_w = rh / p, rw / p
+        sub_h, sub_w = bin_h / s, bin_w / s
+        i = jnp.arange(p, dtype=jnp.float32)
+        u = (jnp.arange(s, dtype=jnp.float32) + 0.5)
+        # base tap grid per bin: (p, s) each axis
+        ys0 = y1 + i[:, None] * bin_h + u[None, :] * sub_h
+        xs0 = x1 + i[:, None] * bin_w + u[None, :] * sub_w
+        # per-(class, bin) offsets from the part grid
+        if use_trans:
+            pi = jnp.clip((i.astype(jnp.int32) * part) // p, 0, part - 1)
+            t = tr.reshape(n_cls, 2, part, part)
+            off_y = t[:, 1][:, pi][:, :, pi] * trans_std  # (cls, p, p)
+            off_x = t[:, 0][:, pi][:, :, pi] * trans_std
+        else:
+            off_y = jnp.zeros((1, p, p), jnp.float32)
+            off_x = jnp.zeros((1, p, p), jnp.float32)
+        # tap coords: (cls, p_i, p_j, s_i, s_j)
+        ty = (ys0[None, :, None, :, None] + (off_y * rh)[:, :, :, None, None])
+        tx = (xs0[None, None, :, None, :] + (off_x * rw)[:, :, :, None, None])
+        ty = jnp.broadcast_to(ty, (n_cls, p, p, s, s))
+        tx = jnp.broadcast_to(tx, (n_cls, p, p, s, s))
+        img = data[bidx].reshape(o_dim, group, group, h, w)
+        gi = jnp.clip((i.astype(jnp.int32) * group) // p, 0, group - 1)
+        pages = img[:, gi][:, :, gi]  # (O, p, p, H, W)
+
+        def sample_o(page, cls_id):
+            # page (p, p, H, W); taps (p, p, s, s)
+            yy, xx = ty[cls_id], tx[cls_id]
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xx)
+            wy1, wx1 = yy - y0, xx - x0
+
+            def tap(yi, xi, wgt):
+                inb = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+                yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+                xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+                v = jnp.take_along_axis(
+                    jnp.take_along_axis(
+                        page[:, :, :, None, None, :],
+                        yc[:, :, None, :, :, None].astype(jnp.int32), axis=2),
+                    xc[:, :, None, :, :, None].astype(jnp.int32), axis=5)
+                return v[:, :, 0, :, :, 0] * (wgt * inb.astype(page.dtype))
+
+            out = (tap(y0, x0, (1 - wy1) * (1 - wx1))
+                   + tap(y0, x0 + 1, (1 - wy1) * wx1)
+                   + tap(y0 + 1, x0, wy1 * (1 - wx1))
+                   + tap(y0 + 1, x0 + 1, wy1 * wx1))
+            return jnp.mean(out, axis=(-1, -2))  # (p, p)
+
+        cls_ids = jnp.arange(o_dim, dtype=jnp.int32) // per_cls
+        cls_ids = jnp.clip(cls_ids, 0, n_cls - 1)
+        return jax.vmap(sample_o)(pages, cls_ids)  # (O, p, p)
+
+    if use_trans:
+        return jax.vmap(one_roi)(rois, trans)
+    dummy = jnp.zeros((rois.shape[0], 2, part, part), jnp.float32)
+    return jax.vmap(one_roi)(rois, dummy)
+
+
 def _bilinear_gather(img, ys, xs):
     """Bilinear sample img (C, H, W) at float coords ys/xs (...,) with zero pad."""
     c, h, w = img.shape

@@ -104,6 +104,7 @@ GRAD_SKIP = {
     # bin boundaries at any eps
     "ROIPooling", "BilinearSampler", "SpatialTransformer",
     "_contrib_DeformableConvolution", "Correlation", "_contrib_box_encode",
+    "_contrib_PSROIPooling", "_contrib_DeformablePSROIPooling",
     # int8 inference-only kernels (ref: quantized_conv.cu has no backward)
     "_contrib_quantized_conv", "_contrib_quantized_fully_connected",
     "_contrib_quantized_pooling",
@@ -124,6 +125,8 @@ BF16_SKIP = GRAD_SKIP | {
     "_linalg_maketrian", "_linalg_inverse", "_linalg_det",
     "_linalg_slogdet", "_Linalg_svd", "_linalg_svd", "_npi_eigvals",
     "softmax_cross_entropy", "_contrib_DeformablePSROIPooling",
+    # round(roi * scale) bin edges flip under bf16 coordinate rounding
+    "_contrib_PSROIPooling",
 }
 
 
@@ -193,6 +196,18 @@ SPECS = {
     "_contrib_ROIAlign": lambda: ((_rand((1, 2, 8, 8), 0, 1),
                                    jnp.asarray([[0.0, 1, 1, 6, 6]])),
                                   dict(pooled_size=(2, 2), spatial_scale=1.0)),
+    "Crop": lambda: ((_rand((1, 2, 8, 8)),),
+                     dict(offset=(1, 2), h_w=(4, 5))),
+    "_contrib_PSROIPooling": lambda: (
+        (_rand((1, 2 * 2 * 2, 8, 8), 0, 1),
+         jnp.asarray([[0.0, 1, 1, 6, 6]])),
+        dict(spatial_scale=1.0, output_dim=2, pooled_size=2)),
+    "_contrib_DeformablePSROIPooling": lambda: (
+        (_rand((1, 2 * 2 * 2, 8, 8), 0, 1),
+         jnp.asarray([[0.0, 1, 1, 6, 6]]),
+         _rand((1, 2, 2, 2), -0.05, 0.05)),
+        dict(spatial_scale=1.0, output_dim=2, pooled_size=2,
+             sample_per_part=2, trans_std=0.1)),
     "_contrib_BilinearResize2D": lambda: ((_rand((1, 2, 4, 4)),),
                                           dict(height=8, width=8)),
     "_contrib_DeformableConvolution": lambda: (

@@ -330,10 +330,93 @@ def test_deformable_convolution_grad_flows_to_offsets():
     off = nd.random.uniform(-0.3, 0.3, shape=(B, 2 * k * k, 4, 4))
     off.attach_grad()
     x.attach_grad()
-    with autograd.record():
+    with mx.autograd.record():
         out = nd.contrib.DeformableConvolution(x, off, w, None, kernel=(3, 3),
                                                num_filter=nf, no_bias=True)
         loss = (out * out).sum()
     loss.backward()
     assert float(np.abs(off.grad.asnumpy()).sum()) > 0
     assert float(np.abs(x.grad.asnumpy()).sum()) > 0
+
+
+def test_psroi_pooling_matches_loop_oracle():
+    """PSROIPooling vs an independent numpy loop implementation of the
+    reference semantics (ref: contrib/psroi_pooling.cc): bin (i,j) of
+    output channel o averages channel page (o, gi, gj) over the bin."""
+    np.random.seed(0)
+    O, G, H, W = 2, 3, 12, 16
+    data = np.random.rand(1, O * G * G, H, W).astype("float32")
+    rois = np.array([[0, 2, 1, 11, 9], [0, 0, 0, 15, 11]], dtype="float32")
+    scale, p = 0.5, 3
+    out = nd.PSROIPooling(nd.array(data), nd.array(rois),
+                          spatial_scale=scale, output_dim=O,
+                          pooled_size=p).asnumpy()
+    img = data[0].reshape(O, G, G, H, W)
+    ref = np.zeros((len(rois), O, p, p), "float32")
+    for r, roi in enumerate(rois):
+        x1 = round(roi[1]) * scale
+        y1 = round(roi[2]) * scale
+        x2 = round(roi[3] + 1) * scale
+        y2 = round(roi[4] + 1) * scale
+        bh = max(y2 - y1, 0.1) / p
+        bw = max(x2 - x1, 0.1) / p
+        for o in range(O):
+            for i in range(p):
+                for j in range(p):
+                    ylo = max(int(np.floor(y1 + i * bh)), 0)
+                    yhi = min(int(np.ceil(y1 + (i + 1) * bh)), H)
+                    xlo = max(int(np.floor(x1 + j * bw)), 0)
+                    xhi = min(int(np.ceil(x1 + (j + 1) * bw)), W)
+                    gi, gj = min(i * G // p, G - 1), min(j * G // p, G - 1)
+                    reg = img[o, gi, gj, ylo:yhi, xlo:xhi]
+                    ref[r, o, i, j] = reg.mean() if reg.size else 0.0
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_deformable_psroi_pooling_linear_field_and_offsets():
+    """On a linear field, sampled bin averages equal the bin-center value;
+    a constant trans offset shifts every sample by trans_std*roi_size in
+    that direction (ref: contrib/deformable_psroi_pooling.cc)."""
+    O, G, H, W, p = 1, 3, 20, 20, 3
+    lin = (np.arange(H)[:, None] * 10 + np.arange(W)[None, :]).astype("f4")
+    data = np.broadcast_to(lin, (1, O * G * G, H, W)).copy()
+    rois = np.array([[0, 2, 1, 11, 9]], dtype="float32")
+    base = nd.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), spatial_scale=1.0, output_dim=O,
+        pooled_size=p, sample_per_part=2, no_trans=True).asnumpy()
+    x1, y1 = 2 - 0.5, 1 - 0.5
+    x2, y2 = 12 - 0.5, 10 - 0.5
+    bh, bw = (y2 - y1) / p, (x2 - x1) / p
+    ref = np.array([[(y1 + (i + .5) * bh) * 10 + x1 + (j + .5) * bw
+                     for j in range(p)] for i in range(p)], "float32")
+    np.testing.assert_allclose(base[0, 0], ref, rtol=1e-4)
+    # constant +0.1 offset in x over roi width 10 at trans_std=1 -> +1 px
+    trans = np.zeros((1, 2, p, p), "float32")
+    trans[:, 0] = 0.1
+    shifted = nd.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), nd.array(trans), spatial_scale=1.0,
+        output_dim=O, pooled_size=p, sample_per_part=2,
+        trans_std=1.0).asnumpy()
+    np.testing.assert_allclose(shifted[0, 0] - base[0, 0],
+                               np.full((p, p), (x2 - x1) * 0.1), rtol=1e-3)
+
+
+def test_crop_legacy_op():
+    """Crop (legacy, ref: src/operator/crop.cc): h_w at offset, centered,
+    and like-shaped via the second input; gradient flows to data only."""
+    x = nd.array(np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8))
+    out = nd.Crop(x, offset=(1, 2), h_w=(4, 5))
+    np.testing.assert_allclose(out.asnumpy()[0, 0],
+                               x.asnumpy()[0, 0, 1:5, 2:7])
+    cen = nd.Crop(x, h_w=(4, 4), center_crop=True)
+    np.testing.assert_allclose(cen.asnumpy()[0, 0],
+                               x.asnumpy()[0, 0, 2:6, 2:6])
+    like = nd.zeros((1, 3, 3, 2))
+    out2 = nd.Crop(x, like, num_args=2)
+    assert out2.shape == (1, 1, 3, 2)
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.Crop(x, offset=(0, 0), h_w=(2, 2)).sum()
+    y.backward()
+    g = x.grad.asnumpy()[0, 0]
+    assert g[:2, :2].sum() == 4 and g.sum() == 4
