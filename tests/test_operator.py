@@ -244,6 +244,26 @@ def test_rnn_lstm_state_clip():
     assert float(np.abs(cF.asnumpy()).max()) > 0.05
 
 
+def test_softmax_length_under_symbol_and_jit():
+    """The masked softmax works where it matters: as a two-input symbol
+    and under a jit trace with the length as a traced tensor (an NDArray
+    length inside a hybridized net must not force a host round-trip)."""
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu import sym
+
+    x = np.arange(8, dtype=np.float32).reshape(2, 4)
+    d, l = sym.Variable("d"), sym.Variable("l")
+    ex = sym.softmax(d, l, axis=-1).simple_bind(d=(2, 4), l=(2,))
+    res = ex.forward(d=x, l=np.array([2.0, 4.0], np.float32))[0].asnumpy()
+    assert np.allclose(res[0, 2:], 0) and abs(res[0, :2].sum() - 1) < 1e-5
+
+    f = jax.jit(lambda xd, ld: nd.softmax(nd.from_jax(xd),
+                                          nd.from_jax(ld))._data)
+    r = np.asarray(f(jnp.asarray(x), jnp.asarray([2.0, 4.0])))
+    assert np.allclose(r[0, 2:], 0) and abs(r[1].sum() - 1) < 1e-5
+
+
 def test_softmax_bf16_f32_accumulation():
     """Sub-f32 softmax/log_softmax accumulate in f32 and return the input
     dtype: the bf16 result stays within bf16 output-rounding of the f32
