@@ -273,6 +273,23 @@ class ImageRecordIter(_PrefetchMixin, DataIter):
                     resize=int(resize), rand_crop=int(bool(rand_crop)),
                     rand_mirror=int(bool(rand_mirror)),
                     threads=max(1, preprocess_threads), seed=int(seed))
+        if self._native is not None:
+            # decide native-vs-python DETERMINISTICALLY for homogeneous
+            # shards: peek at record 0's payload magic. Without this the
+            # runtime fallback (non-JPEG seen mid-batch) races the
+            # prefetch thread, so observers could not rely on engagement
+            # state; heterogeneous shards still fall back at runtime.
+            try:
+                rr = recordio.MXRecordIO(path_imgrec, "r")
+                s = rr.read()
+                rr.close()
+                if s:
+                    _, img0 = recordio.unpack(s)
+                    if not (len(img0) >= 2 and img0[0] == 0xFF
+                            and img0[1] == 0xD8):
+                        self._native = None
+            except Exception:
+                pass  # unreadable first record: the runtime path decides
         self.reset()
 
     @property

@@ -333,27 +333,40 @@ def test_native_imgpipe_corrupt_jpeg_raises(tmp_path):
 
 
 def test_native_imgpipe_png_shard_falls_back(tmp_path):
-    """PNG-packed shards must keep working: the native path detects the
-    non-JPEG magic and hands the batch to the cv2 Python chain."""
+    """PNG-packed shards must keep working. A homogeneous PNG shard is
+    detected at CONSTRUCTION (record-0 magic peek — deterministic, no
+    race against the prefetch thread); a mixed shard whose first record
+    is JPEG engages native and falls back at runtime."""
     from incubator_mxnet_tpu._native import imgpipe_lib
 
     if imgpipe_lib() is None:
         pytest.skip("no toolchain / libjpeg")
-    path = str(tmp_path / "png.rec")
-    w = recordio.MXRecordIO(path, "w")
     rng = np.random.RandomState(5)
-    for i in range(4):
-        img = (rng.rand(20, 20, 3) * 255).astype(np.uint8)
-        ok, buf = cv2.imencode(".png", img)
-        w.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
-                              buf.tobytes()))
-    w.close()
-    it = io.ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
+
+    def write(path, kinds):
+        w = recordio.MXRecordIO(path, "w")
+        for i, kind in enumerate(kinds):
+            img = (rng.rand(20, 20, 3) * 255).astype(np.uint8)
+            ok, buf = cv2.imencode(kind, img)
+            w.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                                  buf.tobytes()))
+        w.close()
+
+    png = str(tmp_path / "png.rec")
+    write(png, [".png"] * 4)
+    it = io.ImageRecordIter(path_imgrec=png, data_shape=(3, 16, 16),
                             batch_size=4)
-    assert it._native is not None  # engages until it sees the payload
+    assert it._native is None  # peek saw PNG: python chain from the start
     batch = next(iter(it))
     assert batch.data[0].shape == (4, 3, 16, 16)
-    assert it._native is None  # permanently fell back
+
+    mixed = str(tmp_path / "mixed.rec")
+    write(mixed, [".jpg", ".png", ".jpg", ".png"])
+    it2 = io.ImageRecordIter(path_imgrec=mixed, data_shape=(3, 16, 16),
+                             batch_size=4)
+    batch = next(iter(it2))  # runtime fallback mid-batch
+    assert batch.data[0].shape == (4, 3, 16, 16)
+    assert it2._native is None  # permanently fell back
 
 
 def test_native_imgpipe_scale_matches_python(tmp_path):
