@@ -124,3 +124,21 @@ def test_argsort_topk():
                        ret_typ="both")
     np.testing.assert_allclose(val.asnumpy(), [[9.0, 3.0]])
     np.testing.assert_allclose(idx.asnumpy(), [[1.0, 2.0]])
+
+
+def test_key_block_stream_identical_to_fold_in():
+    """The block-precomputed key stream is bit-identical to per-call
+    fold_in(PRNGKey(seed), counter), across the block boundary, and a
+    reseed restarts it."""
+    import jax
+
+    from incubator_mxnet_tpu import random as r
+
+    r.seed(1234)
+    got = [np.asarray(r.next_key()) for _ in range(r._BLOCK_N + 10)]
+    for i, g in enumerate(got):
+        np.testing.assert_array_equal(
+            g, np.asarray(jax.random.fold_in(jax.random.PRNGKey(1234),
+                                             i + 1)))
+    r.seed(1234)
+    np.testing.assert_array_equal(np.asarray(r.next_key()), got[0])
