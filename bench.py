@@ -267,7 +267,7 @@ def _cache_from_artifacts(repo_dir):
     import glob
     import re
 
-    best_round, best = -1, None
+    rounds = []
     for path in glob.glob(os.path.join(repo_dir, "BENCH_r*.json")):
         m = re.search(r"BENCH_r(\d+)\.json$", path)
         if not m:
@@ -279,29 +279,38 @@ def _cache_from_artifacts(repo_dir):
             continue
         if parsed.get("platform") != "tpu":
             continue
-        if int(m.group(1)) > best_round:
-            best_round, best = int(m.group(1)), parsed
-    if best is None:
-        return None
-    results = {}
-    for dtype, short in (("float32", "fp32"), ("bfloat16", "bf16")):
-        if f"{short}_ips" not in best:
-            continue
-        # only reconstruct entries PROVEN on-chip: either a per-dtype
-        # platform tag (newer artifacts) or the headline dtype itself —
-        # a silently-CPU sibling dtype must not be laundered into "tpu"
-        platform = best.get(f"{short}_platform") or (
-            best["platform"] if best.get("dtype") == dtype else None)
-        if platform != "tpu":
-            continue
-        results[dtype] = {
-            "ips": best[f"{short}_ips"], "scan_ips": 0.0, "scan_k": 0,
-            "layout": best.get("layout"), "dtype": dtype,
-            "platform": "tpu", "compile_s": best.get("compile_s", 0.0),
-        }
+        rounds.append((int(m.group(1)), parsed))
+    rounds.sort(reverse=True)
+    results, ts = {}, None
+    # per-dtype, newest round first: a newer artifact whose entry for some
+    # dtype is invalid must not hide an older valid one for that dtype
+    for rnd, parsed in rounds:
+        for dtype, short in (("float32", "fp32"), ("bfloat16", "bf16")):
+            if dtype in results or f"{short}_ips" not in parsed:
+                continue
+            # only reconstruct entries PROVEN on-chip: either a per-dtype
+            # platform tag (newer artifacts) or the headline dtype itself —
+            # a silently-CPU sibling dtype must not be laundered into "tpu"
+            platform = parsed.get(f"{short}_platform") or (
+                parsed["platform"] if parsed.get("dtype") == dtype else None)
+            if platform != "tpu":
+                continue
+            if dtype == "bfloat16" and rnd < 4:
+                # rounds 1-3 wrapped the batch with nd.array(), which
+                # silently cast bf16 inputs to float32 — those "bf16"
+                # measurements ran f32-dominant programs and must not be
+                # replayed as bf16
+                continue
+            results[dtype] = {
+                "ips": parsed[f"{short}_ips"], "scan_ips": 0.0, "scan_k": 0,
+                "layout": parsed.get("layout"), "dtype": dtype,
+                "platform": "tpu",
+                "compile_s": parsed.get("compile_s", 0.0),
+            }
+            if ts is None:
+                ts = parsed.get("cached_ts") or f"round-{rnd} artifact"
     if not results:
         return None
-    ts = best.get("cached_ts") or f"round-{best_round} artifact"
     return {"ts": ts, "results": results}
 
 

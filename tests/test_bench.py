@@ -203,8 +203,18 @@ def test_cache_from_artifacts(tmp_path):
                    "bf16_ips": 120.0, "bf16_platform": "tpu",
                    "layout": "NHWC"}}))
     c = bench._cache_from_artifacts(str(tmp_path))
-    assert "float32" not in c["results"]  # tagged cpu: never laundered
-    assert c["results"]["bfloat16"]["ips"] == 120.0
+    # r03's fp32 is tagged cpu (never laundered) — but the per-dtype,
+    # newest-first merge still finds r01's valid fp32
+    assert c["results"]["float32"]["ips"] == 100.0
+    # round-3 artifact: its "bf16" fed f32 inputs (the nd.array cast bug
+    # found in round 4) and must NOT reconstruct as a bf16 measurement
+    assert "bfloat16" not in c["results"]
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps({
+        "parsed": {"platform": "tpu", "dtype": "bfloat16",
+                   "bf16_ips": 150.0, "bf16_platform": "tpu",
+                   "layout": "NHWC"}}))
+    c = bench._cache_from_artifacts(str(tmp_path))
+    assert c["results"]["bfloat16"]["ips"] == 150.0  # round-4+: trusted
     assert bench._cache_from_artifacts(str(tmp_path / "nope")) is None
 
 
