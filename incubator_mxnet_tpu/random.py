@@ -28,6 +28,17 @@ _BLOCK = None
 _BLOCK_BASE = 0
 _REFILL = None
 
+try:  # moved between jax.core and jax._src.core across jax versions
+    from jax.core import trace_state_clean as _trace_state_clean
+except ImportError:
+    try:
+        from jax._src.core import trace_state_clean as _trace_state_clean
+    except ImportError:
+        def _trace_state_clean():
+            # unknown jax internals: disable the block path entirely
+            # (correctness of traced callers over the amortization win)
+            return False
+
 
 def seed(seed_state, ctx="all"):
     """Seed the global generator (ref: mx.random.seed)."""
@@ -69,6 +80,12 @@ def next_key():
     with _LOCK:
         _COUNTER += 1
         c = _COUNTER
+        if not _trace_state_clean():
+            # inside a jit trace: derive the key as literals (a closed-over
+            # constant, the pre-block behavior). Running the jitted refill
+            # here would inline it into the outer trace and cache a TRACED
+            # value into module state — a leaked-tracer bug.
+            return jax.random.fold_in(jax.random.PRNGKey(_SEED), c)
         if _BLOCK is None or not (_BLOCK_BASE <= c < _BLOCK_BASE + _BLOCK_N):
             _BLOCK_BASE = c
             _BLOCK = _refill(_SEED, c)
