@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Multi-process GLOBAL-mesh SPMD training — the true multi-host path.
+
+Unlike the kvstore scripts (per-key push/pull semantics), this drives
+`fused.GluonTrainStep` over a mesh spanning BOTH processes: GSPMD inserts
+the cross-process gradient all-reduce (the ICI/DCN collective path of the
+scaling design, ref: docs/SCALING.md). Oracle, in the dryrun's style: the
+sharded loss trajectory must match a single-device run of the same
+seed/net to tight tolerance (BN-free net -> reduction-order noise only),
+and every process must see the identical trajectory.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+# 2 local CPU devices per process BEFORE jax initializes
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=2"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import distributed, fused, gluon, nd
+from incubator_mxnet_tpu.gluon import nn
+from jax.sharding import Mesh
+
+
+def build_net():
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu", in_units=16),
+            nn.Dense(4, in_units=32))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def trajectory(mesh, steps, X, Y):
+    net = build_net()
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                           rescale_grad=1.0 / X.shape[0])
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = fused.GluonTrainStep(net, lambda n, a, b: L(n(a), b), opt,
+                                mesh=mesh)
+    return [float(step(nd.array(X), nd.array(Y)).asscalar())
+            for _ in range(steps)]
+
+
+def main():
+    assert distributed.init_from_env(), "launcher env missing"
+    n_global = len(jax.devices())
+    n_local = len(jax.local_devices())
+    rank = jax.process_index()
+    assert n_global == 2 * n_local, (n_global, n_local)
+
+    rng = np.random.RandomState(0)  # same data on every process (SPMD)
+    X = rng.randn(8, 16).astype(np.float32)
+    Y = rng.randint(0, 4, 8).astype(np.float32)
+
+    mesh = Mesh(np.array(jax.devices()), axis_names=("data",))
+    tr = trajectory(mesh, 5, X, Y)
+
+    # single-device oracle on a 1-device mesh (local), same seed/net
+    solo = Mesh(np.array(jax.local_devices()[:1]), axis_names=("data",))
+    ref = trajectory(solo, 5, X, Y)
+
+    dmax = max(abs(a - b) for a, b in zip(tr, ref))
+    assert dmax < 1e-4, f"global-mesh trajectory diverges: {tr} vs {ref}"
+    assert tr[-1] < tr[0], f"not learning: {tr}"
+    print(f"rank {rank}: global mesh {n_global} devices over "
+          f"{jax.process_count()} processes, max|dloss|={dmax:.2e}")
+    print("dist_gspmd_mesh OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,14 @@ def test_dist_gluon_trainer_two_workers():
     assert log.count("dist_gluon_trainer OK") == 2
 
 
+def test_dist_gspmd_global_mesh_two_processes():
+    """The true multi-host path: GluonTrainStep over a mesh spanning two
+    PROCESSES (2x2 local CPU devices); GSPMD inserts the cross-process
+    gradient all-reduce and the trajectory matches single-device."""
+    log = _launch("dist_gspmd_mesh.py", 2)
+    assert log.count("dist_gspmd_mesh OK") == 2
+
+
 def test_dist_async_kvstore_two_workers():
     log = _launch("dist_async_kvstore.py", 2)
     assert log.count("dist_async_kvstore OK") == 2
