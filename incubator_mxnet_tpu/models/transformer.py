@@ -508,7 +508,17 @@ def make_gspmd_train_step(mesh: Mesh, cfg: TransformerConfig, lr=0.1, aux_weight
         out_shardings=(NamedSharding(mesh, P()), shardings),
         donate_argnums=(0,),
     )
-    return jstep, params
+
+    def run_step(p, tokens, targets):
+        # stage host batches onto the mesh explicitly: on a mesh spanning
+        # processes, jit cannot auto-commit raw host arrays (every process
+        # holds the same batch; device_put builds the global array from
+        # each process's addressable shards)
+        tokens = jax.device_put(jnp.asarray(tokens), data_sharding)
+        targets = jax.device_put(jnp.asarray(targets), data_sharding)
+        return jstep(p, tokens, targets)
+
+    return run_step, params
 
 
 # ---------------------------------------------------------------------------

@@ -56,6 +56,14 @@ def test_dist_gspmd_global_mesh_two_processes():
     assert log.count("dist_gspmd_mesh OK") == 2
 
 
+def test_dist_transformer_mesh_two_processes():
+    """The flagship's sharding rules over a (dp, ep, tp) mesh spanning
+    two processes: tp activation and dp gradient collectives both cross
+    the jit; dp's crosses the process boundary."""
+    log = _launch("dist_transformer_mesh.py", 2)
+    assert log.count("dist_transformer_mesh OK") == 2
+
+
 def test_dist_async_kvstore_two_workers():
     log = _launch("dist_async_kvstore.py", 2)
     assert log.count("dist_async_kvstore OK") == 2
