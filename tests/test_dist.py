@@ -64,6 +64,13 @@ def test_dist_transformer_mesh_two_processes():
     assert log.count("dist_transformer_mesh OK") == 2
 
 
+def test_dist_ring_attention_two_processes():
+    """Long-context sp: the ring's ppermute K/V hops cross the process
+    boundary; output equals exact dense attention."""
+    log = _launch("dist_ring_attention.py", 2)
+    assert log.count("dist_ring_attention OK") == 2
+
+
 def test_dist_async_kvstore_two_workers():
     log = _launch("dist_async_kvstore.py", 2)
     assert log.count("dist_async_kvstore OK") == 2
