@@ -71,6 +71,13 @@ def test_dist_ring_attention_two_processes():
     assert log.count("dist_ring_attention OK") == 2
 
 
+def test_dist_pipeline_two_processes():
+    """pp: the microbatch activation hand-off crosses the process
+    boundary between stages; equals sequential composition."""
+    log = _launch("dist_pipeline.py", 2)
+    assert log.count("dist_pipeline OK") == 2
+
+
 def test_dist_async_kvstore_two_workers():
     log = _launch("dist_async_kvstore.py", 2)
     assert log.count("dist_async_kvstore OK") == 2
