@@ -78,6 +78,13 @@ def test_dist_pipeline_two_processes():
     assert log.count("dist_pipeline OK") == 2
 
 
+def test_dist_moe_two_processes():
+    """ep: the MoE token all-to-all crosses the process boundary; equals
+    the dense single-device MoE (addressable-shard comparison)."""
+    log = _launch("dist_moe.py", 2)
+    assert log.count("dist_moe OK") == 2
+
+
 def test_dist_async_kvstore_two_workers():
     log = _launch("dist_async_kvstore.py", 2)
     assert log.count("dist_async_kvstore OK") == 2
