@@ -78,12 +78,18 @@ def set_training(train_mode_):
     return prev
 
 
+_RECORD_GEN = 0  # bumped per record() scope; see the overwrite warning
+
+
 class _AutogradScope:
     def __init__(self, recording=None, training=None):
         self._recording = recording
         self._training = training
 
     def __enter__(self):
+        if self._recording:
+            global _RECORD_GEN
+            _RECORD_GEN += 1
         if self._recording is not None:
             self._prev_rec = set_recording(self._recording)
         if self._training is not None:
@@ -369,7 +375,22 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # no
         if req == "add":
             grad._data = grad._data + ct.astype(grad.dtype)
         else:
+            if getattr(arr, "_grad_gen", None) == _RECORD_GEN:
+                # a second backward() in the SAME record scope is about to
+                # overwrite this grad. The reference's multi-device pattern
+                # (`for l in losses: l.backward()`) writes per-ctx buffers;
+                # here params have ONE logical buffer, so that port would
+                # silently keep only the last shard's gradient.
+                import warnings
+
+                warnings.warn(
+                    "gradient overwritten by a second backward() in the "
+                    "same record() scope; for sharded losses use "
+                    "autograd.backward([loss1, loss2, ...]) (accumulates "
+                    "in one pass) or attach_grad(grad_req='add')",
+                    RuntimeWarning, stacklevel=2)
             grad._data = jnp.asarray(ct, dtype=grad.dtype).reshape(grad.shape)
+            arr._grad_gen = _RECORD_GEN
 
     if not retain_graph:
         for h in heads:

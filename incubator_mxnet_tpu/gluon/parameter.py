@@ -135,6 +135,11 @@ class Parameter:
         if _abstract_mode():
             return  # shape now known; materialize later, outside the trace
         initializer, ctx = self._deferred_init
+        if isinstance(ctx, (list, tuple)):
+            # reference scripts pass ctx LISTS (per-device replicas); here a
+            # parameter is ONE logical array — SPMD/mesh sharding handles
+            # multi-device placement — so a list selects its first context
+            ctx = ctx[0] if ctx else None
         arr = nd_zeros(self._shape, ctx=ctx, dtype=self.dtype)
         initializer(init_mod.InitDesc(self.name, {"__init__": None}), arr)
         self._data = arr

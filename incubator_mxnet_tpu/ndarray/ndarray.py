@@ -36,7 +36,7 @@ class NDArray:
     """Dense tensor handle over a jax.Array."""
 
     __slots__ = ("_data", "_grad", "_grad_req", "_node", "_node_index",
-                 "_dense_grad_buf", "__weakref__")
+                 "_dense_grad_buf", "_grad_gen", "__weakref__")
 
     # make NDArray win against numpy in mixed dunder dispatch
     __array_priority__ = 1000.0

@@ -126,6 +126,51 @@ def test_argsort_topk():
     np.testing.assert_allclose(idx.asnumpy(), [[1.0, 2.0]])
 
 
+def test_ctx_list_initialize_and_sharded_backward():
+    """Reference multi-device ports: initialize(ctx=[c0, c1]) places the
+    single logical copy (first ctx); autograd.backward([shard losses])
+    accumulates like the full batch; per-loss backward in one record
+    scope warns about the silent overwrite; fresh scopes don't warn."""
+    import warnings
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.gluon import nn
+
+    net = nn.Dense(4, in_units=6)
+    net.initialize(mx.init.Xavier(), ctx=[mx.cpu(0), mx.cpu(0)])
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(8, 6).astype("float32"))
+    y = nd.array(rng.randint(0, 4, 8).astype("float32"))
+
+    with autograd.record():
+        full = L(net(x), y)
+    full.backward()
+    g_full = net.weight.grad().asnumpy().copy()
+
+    with autograd.record():
+        l1, l2 = L(net(x[:4]), y[:4]), L(net(x[4:]), y[4:])
+    autograd.backward([l1, l2])
+    np.testing.assert_allclose(net.weight.grad().asnumpy(), g_full,
+                               rtol=1e-5, atol=1e-6)
+
+    with autograd.record():
+        l1, l2 = L(net(x[:4]), y[:4]), L(net(x[4:]), y[4:])
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        l1.backward()
+        l2.backward()
+    assert any("overwritten" in str(m.message) for m in w)
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        for _ in range(2):
+            with autograd.record():
+                loss = L(net(x), y)
+            loss.backward()
+    assert not any("overwritten" in str(m.message) for m in w)
+
+
 def test_key_block_stream_identical_to_fold_in():
     """The block-precomputed key stream is bit-identical to per-call
     fold_in(PRNGKey(seed), counter), across the block boundary, and a
