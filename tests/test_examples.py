@@ -273,3 +273,8 @@ def test_embedding_learning():
 def test_mixed_precision():
     log = _run("mixed_precision.py", "--steps", "40", timeout=520)
     assert "mixed_precision OK" in log
+
+
+def test_large_scale_training():
+    log = _run("large_scale_training.py", "--updates", "8", timeout=520)
+    assert "large_scale_training OK" in log
