@@ -154,6 +154,38 @@ def test_gru_bidirectional_states():
     assert new_states[0].shape == (2, 2, 8)
 
 
+def test_lstm_layer_matches_torch():
+    """External oracle for the fused lax.scan RNN: a 2-layer gluon LSTM
+    with weights copied into torch.nn.LSTM produces the same outputs to
+    float32 resolution (gate order i,f,g,o on both sides)."""
+    import torch
+
+    mx.random.seed(0)
+    T, B, I, H, L = 5, 3, 4, 6, 2
+    net = gluon.rnn.LSTM(H, num_layers=L, layout="TNC", input_size=I)
+    net.initialize(mx.init.Xavier())
+    x_np = np.random.RandomState(0).rand(T, B, I).astype("float32")
+    out = net(nd.array(x_np)).asnumpy()
+
+    tl = torch.nn.LSTM(I, H, num_layers=L)
+    params = dict(net.collect_params().items())
+    with torch.no_grad():
+        for layer in range(L):
+            def find(sfx, _l=layer):
+                return [p for n, p in params.items()
+                        if n.endswith(sfx)][_l].data().asnumpy().copy()
+            getattr(tl, f"weight_ih_l{layer}").copy_(
+                torch.from_numpy(find("i2h_weight")))
+            getattr(tl, f"weight_hh_l{layer}").copy_(
+                torch.from_numpy(find("h2h_weight")))
+            getattr(tl, f"bias_ih_l{layer}").copy_(
+                torch.from_numpy(find("i2h_bias")))
+            getattr(tl, f"bias_hh_l{layer}").copy_(
+                torch.from_numpy(find("h2h_bias")))
+        ref, _ = tl(torch.from_numpy(x_np))
+    assert_almost_equal(out, ref.numpy(), rtol=1e-5, atol=1e-6)
+
+
 def test_unroll_valid_length():
     """valid_length zeroes outputs past each sequence's length and returns
     LAST-VALID states; the bidirectional form reverses only the valid
