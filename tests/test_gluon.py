@@ -185,6 +185,25 @@ def test_lstm_layer_matches_torch():
         ref, _ = tl(torch.from_numpy(x_np))
     assert_almost_equal(out, ref.numpy(), rtol=1e-5, atol=1e-6)
 
+    # GRU too: same r,z,n order and the cuDNN-style reset-before-matmul
+    # candidate gate on both sides
+    gnet = gluon.rnn.GRU(H, num_layers=1, layout="TNC", input_size=I)
+    gnet.initialize(mx.init.Xavier())
+    gout = gnet(nd.array(x_np)).asnumpy()
+    tg = torch.nn.GRU(I, H)
+    gparams = dict(gnet.collect_params().items())
+
+    def gfind(sfx):
+        return [p for n, p in gparams.items()
+                if n.endswith(sfx)][0].data().asnumpy().copy()
+    with torch.no_grad():
+        tg.weight_ih_l0.copy_(torch.from_numpy(gfind("i2h_weight")))
+        tg.weight_hh_l0.copy_(torch.from_numpy(gfind("h2h_weight")))
+        tg.bias_ih_l0.copy_(torch.from_numpy(gfind("i2h_bias")))
+        tg.bias_hh_l0.copy_(torch.from_numpy(gfind("h2h_bias")))
+        gref, _ = tg(torch.from_numpy(x_np))
+    assert_almost_equal(gout, gref.numpy(), rtol=1e-5, atol=1e-6)
+
 
 def test_unroll_valid_length():
     """valid_length zeroes outputs past each sequence's length and returns
