@@ -190,6 +190,7 @@ def pooling(
     count_include_pad=True,
     cudnn_off=False,
     layout=None,
+    p_value=2,
 ):
     """Max/avg/sum/lp pooling via XLA reduce_window (ref: nn/pooling.cc, nn/pool.h).
 
@@ -243,9 +244,12 @@ def pooling(
         counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
         return summed / counts
     if pool_type == "lp":
+        # ref: nn/pool.h lp_pooling — p_value in {1, 2, 3}
+        pv = float(p_value)
         return jnp.power(
-            lax.reduce_window(jnp.power(jnp.abs(data), 2.0), 0.0, lax.add, window, strides, padding),
-            0.5,
+            lax.reduce_window(jnp.power(jnp.abs(data), pv), 0.0, lax.add,
+                              window, strides, padding),
+            1.0 / pv,
         )
     raise ValueError(f"unknown pool_type {pool_type}")
 

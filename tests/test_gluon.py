@@ -154,6 +154,51 @@ def test_gru_bidirectional_states():
     assert new_states[0].shape == (2, 2, 8)
 
 
+def test_conv_pool_variants_match_torch():
+    """External oracles for the conv/pool lowerings the 2D tests don't
+    cover: Conv1D (strided+padded), Conv3D, padded AvgPool2D, and LP
+    pooling at p=1/2/3."""
+    import torch
+
+    rng = np.random.RandomState(0)
+
+    net1 = nn.Conv1D(6, 3, strides=2, padding=1, in_channels=4)
+    net1.initialize()
+    x1 = rng.rand(2, 4, 16).astype("float32")
+    t1 = torch.nn.Conv1d(4, 6, 3, stride=2, padding=1)
+    with torch.no_grad():
+        t1.weight.copy_(torch.from_numpy(net1.weight.data().asnumpy().copy()))
+        t1.bias.copy_(torch.from_numpy(net1.bias.data().asnumpy().copy()))
+        ref1 = t1(torch.from_numpy(x1)).numpy()
+    assert_almost_equal(net1(nd.array(x1)).asnumpy(), ref1,
+                        rtol=1e-4, atol=1e-5)
+
+    net3 = nn.Conv3D(4, 3, padding=1, in_channels=2)
+    net3.initialize()
+    x3 = rng.rand(1, 2, 6, 6, 6).astype("float32")
+    t3 = torch.nn.Conv3d(2, 4, 3, padding=1)
+    with torch.no_grad():
+        t3.weight.copy_(torch.from_numpy(net3.weight.data().asnumpy().copy()))
+        t3.bias.copy_(torch.from_numpy(net3.bias.data().asnumpy().copy()))
+        ref3 = t3(torch.from_numpy(x3)).numpy()
+    assert_almost_equal(net3(nd.array(x3)).asnumpy(), ref3,
+                        rtol=1e-4, atol=1e-5)
+
+    xp = rng.rand(1, 2, 7, 7).astype("float32")
+    out = nn.AvgPool2D(3, strides=2, padding=1)(nd.array(xp)).asnumpy()
+    refp = torch.nn.functional.avg_pool2d(torch.from_numpy(xp), 3,
+                                          stride=2, padding=1).numpy()
+    assert_almost_equal(out, refp, rtol=1e-5)
+
+    xl = rng.rand(1, 2, 8).astype("float32")
+    for pv in (1, 2, 3):
+        out = nd.Pooling(nd.array(xl), kernel=(2,), stride=(2,),
+                         pool_type="lp", p_value=pv).asnumpy()
+        refl = torch.nn.functional.lp_pool1d(torch.from_numpy(xl),
+                                             pv, 2).numpy()
+        assert_almost_equal(out, refl, rtol=1e-4)
+
+
 def test_lstm_layer_matches_torch():
     """External oracle for the fused lax.scan RNN: a 2-layer gluon LSTM
     with weights copied into torch.nn.LSTM produces the same outputs to
