@@ -205,6 +205,10 @@ def pooling(
             out = jnp.max(data, axis=spatial, keepdims=True)
         elif pool_type == "sum":
             out = jnp.sum(data, axis=spatial, keepdims=True)
+        elif pool_type == "lp":
+            pv = float(p_value)
+            out = jnp.power(jnp.sum(jnp.power(jnp.abs(data), pv),
+                                    axis=spatial, keepdims=True), 1.0 / pv)
         else:
             out = jnp.mean(data, axis=spatial, keepdims=True)
         return out
