@@ -187,3 +187,42 @@ def test_key_block_stream_identical_to_fold_in():
                                              i + 1)))
     r.seed(1234)
     np.testing.assert_array_equal(np.asarray(r.next_key()), got[0])
+
+
+def test_losses_match_torch():
+    """External oracles for the regression/ranking losses: Huber, L1, KL
+    (from_logits), and the squared-distance Triplet semantics."""
+    import torch
+
+    from incubator_mxnet_tpu import gluon
+
+    rng = np.random.RandomState(0)
+    p = rng.randn(4, 3).astype("float32")
+    l = rng.randn(4, 3).astype("float32")
+    out = float(gluon.loss.HuberLoss(rho=1.0)(
+        nd.array(p), nd.array(l)).mean().asscalar())
+    ref = torch.nn.functional.huber_loss(torch.tensor(p), torch.tensor(l),
+                                         delta=1.0).item()
+    assert abs(out - ref) < 1e-5
+    out = float(gluon.loss.L1Loss()(nd.array(p),
+                                    nd.array(l)).mean().asscalar())
+    ref = torch.nn.functional.l1_loss(torch.tensor(p),
+                                      torch.tensor(l)).item()
+    assert abs(out - ref) < 1e-5
+
+    a = rng.randn(4, 8).astype("float32")
+    pos = rng.randn(4, 8).astype("float32")
+    neg = rng.randn(4, 8).astype("float32")
+    out = float(gluon.loss.TripletLoss(margin=1.0)(
+        nd.array(a), nd.array(pos), nd.array(neg)).mean().asscalar())
+    ref = np.maximum(0, 1.0 + ((a - pos) ** 2).sum(-1)
+                     - ((a - neg) ** 2).sum(-1)).mean()
+    assert abs(out - ref) < 1e-4
+
+    lp = torch.log_softmax(torch.tensor(rng.randn(3, 5).astype("f4")), -1)
+    t = torch.softmax(torch.tensor(rng.randn(3, 5).astype("f4")), -1)
+    out = float(gluon.loss.KLDivLoss(from_logits=True)(
+        nd.array(lp.numpy()), nd.array(t.numpy())).mean().asscalar())
+    ref = torch.nn.functional.kl_div(lp, t,
+                                     reduction="batchmean").item() / 5
+    assert abs(out - ref) < 1e-5
