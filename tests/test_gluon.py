@@ -154,6 +154,48 @@ def test_gru_bidirectional_states():
     assert new_states[0].shape == (2, 2, 8)
 
 
+def test_grouped_deconv_bn_inference_dense_noflatten():
+    """Grouped transposed conv vs torch; BatchNorm inference uses the
+    running stats exactly; Dense(flatten=False) applies to the last axis."""
+    import torch
+
+    rng = np.random.RandomState(0)
+    netd = nn.Conv2DTranspose(4, 3, strides=2, padding=1, groups=2,
+                              in_channels=4)
+    netd.initialize()
+    xd = rng.rand(1, 4, 6, 6).astype("float32")
+    t = torch.nn.ConvTranspose2d(4, 4, 3, stride=2, padding=1, groups=2,
+                                 bias=False)
+    with torch.no_grad():
+        t.weight.copy_(torch.from_numpy(netd.weight.data().asnumpy().copy()))
+        ref = t(torch.from_numpy(xd)).numpy()
+    assert_almost_equal(netd(nd.array(xd)).asnumpy(), ref,
+                        rtol=1e-4, atol=1e-5)
+
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    xb = rng.rand(8, 3, 4, 4).astype("float32") * 2 + 1
+    with mx.autograd.record():
+        bn(nd.array(xb))  # one training pass moves the running stats
+    out = bn(nd.array(xb)).asnumpy()
+    rm = bn.running_mean.data().asnumpy()
+    rv = bn.running_var.data().asnumpy()
+    g = bn.gamma.data().asnumpy()
+    b = bn.beta.data().asnumpy()
+    ref = ((xb - rm[None, :, None, None])
+           / np.sqrt(rv[None, :, None, None] + 1e-5)
+           * g[None, :, None, None] + b[None, :, None, None])
+    assert_almost_equal(out, ref, rtol=1e-3, atol=1e-4)
+
+    dn = nn.Dense(5, flatten=False, in_units=4)
+    dn.initialize()
+    xf = rng.rand(2, 3, 4).astype("float32")
+    out = dn(nd.array(xf)).asnumpy()
+    assert out.shape == (2, 3, 5)
+    assert_almost_equal(out, xf @ dn.weight.data().asnumpy().T
+                        + dn.bias.data().asnumpy(), rtol=1e-5)
+
+
 def test_conv_pool_variants_match_torch():
     """External oracles for the conv/pool lowerings the 2D tests don't
     cover: Conv1D (strided+padded), Conv3D, padded AvgPool2D, and LP
