@@ -420,3 +420,21 @@ def test_crop_legacy_op():
     y.backward()
     g = x.grad.asnumpy()[0, 0]
     assert g[:2, :2].sum() == 4 and g.sum() == 4
+
+
+def test_bilinear_sampler_matches_torch_grid_sample():
+    """BilinearSampler == torch grid_sample (bilinear, zero padding,
+    align_corners=True; MXNet grid layout (N, [x, y], H, W))."""
+    import torch
+
+    x = np.random.RandomState(0).rand(1, 2, 5, 5).astype("float32")
+    gy, gx = np.meshgrid(np.linspace(-0.8, 0.8, 4),
+                         np.linspace(-0.7, 0.7, 4), indexing="ij")
+    grid_mx = np.stack([gx, gy])[None].astype("float32")
+    out = nd.BilinearSampler(nd.array(x), nd.array(grid_mx)).asnumpy()
+    grid_t = torch.from_numpy(np.stack([gx, gy],
+                                       axis=-1)[None].astype("float32"))
+    ref = torch.nn.functional.grid_sample(
+        torch.from_numpy(x), grid_t, mode="bilinear",
+        padding_mode="zeros", align_corners=True).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
