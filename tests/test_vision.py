@@ -438,3 +438,24 @@ def test_bilinear_sampler_matches_torch_grid_sample():
         torch.from_numpy(x), grid_t, mode="bilinear",
         padding_mode="zeros", align_corners=True).numpy()
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_spatial_transformer_matches_torch():
+    """SpatialTransformer == torch affine_grid + grid_sample end-to-end
+    (row-major 2x3 affine, align_corners=True convention)."""
+    import torch
+
+    x = np.random.RandomState(0).rand(2, 3, 6, 6).astype("float32")
+    theta = np.array([[0.9, 0.1, 0.05, -0.1, 1.1, 0.2],
+                      [1.0, 0.0, 0.0, 0.0, 1.0, 0.0]], "float32")
+    out = nd.SpatialTransformer(nd.array(x), nd.array(theta),
+                                target_shape=(4, 5),
+                                transform_type="affine",
+                                sampler_type="bilinear").asnumpy()
+    grid = torch.nn.functional.affine_grid(
+        torch.from_numpy(theta.reshape(2, 2, 3)), (2, 3, 4, 5),
+        align_corners=True)
+    ref = torch.nn.functional.grid_sample(
+        torch.from_numpy(x), grid, mode="bilinear", padding_mode="zeros",
+        align_corners=True).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
