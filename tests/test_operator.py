@@ -648,3 +648,16 @@ def test_multi_mp_sgd_update_masters_in_fp32():
     assert str(outs[0].asnumpy().dtype) == "bfloat16"
     np.testing.assert_allclose(outs[0].asnumpy().astype(np.float32), ref,
                                rtol=1e-2)  # low-precision refresh
+
+
+def test_lrn_matches_torch():
+    """LRN == torch local_response_norm (cross-channel, same alpha
+    normalization by window size)."""
+    import torch
+
+    x = np.random.RandomState(1).rand(2, 6, 4, 4).astype("float32")
+    out = nd.LRN(nd.array(x), nsize=5, alpha=1e-4, beta=0.75,
+                 knorm=2.0).asnumpy()
+    ref = torch.nn.functional.local_response_norm(
+        torch.from_numpy(x), 5, alpha=1e-4, beta=0.75, k=2.0).numpy()
+    assert_almost_equal(out, ref, rtol=1e-5, atol=1e-7)
