@@ -459,3 +459,27 @@ def test_spatial_transformer_matches_torch():
         torch.from_numpy(x), grid, mode="bilinear", padding_mode="zeros",
         align_corners=True).numpy()
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_correlation_displaced_matches_loop():
+    """Correlation with max_displacement=1: channel (dy+1)*3+(dx+1) holds
+    the channel-mean product of img1 at (y, x) with img2 at (y+dy, x+dx),
+    zero-padded (FlowNet semantics, ref: correlation.cc)."""
+    rng = np.random.RandomState(0)
+    a = rng.rand(1, 3, 5, 5).astype("float32")
+    b = rng.rand(1, 3, 5, 5).astype("float32")
+    out = nd.Correlation(nd.array(a), nd.array(b), kernel_size=1,
+                         max_displacement=1, stride1=1, stride2=1,
+                         pad_size=1).asnumpy()
+    ap = np.pad(a, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    bp = np.pad(b, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    ref = np.zeros_like(out)
+    for dy in (-1, 0, 1):
+        for dx in (-1, 0, 1):
+            ch = (dy + 1) * 3 + (dx + 1)
+            for y in range(out.shape[2]):
+                for x in range(out.shape[3]):
+                    ref[0, ch, y, x] = (ap[0, :, y + 1, x + 1]
+                                        * bp[0, :, y + 1 + dy,
+                                             x + 1 + dx]).mean()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
