@@ -78,7 +78,12 @@ def set_training(train_mode_):
     return prev
 
 
-_RECORD_GEN = 0  # bumped per record() scope; see the overwrite warning
+def _record_gen():
+    """Per-thread record()-scope generation, used by the gradient-overwrite
+    warning. Lives in the same thread-local as the recording flag so
+    concurrent record() scopes on other threads can neither trigger nor
+    suppress it."""
+    return getattr(_state(), "record_gen", 0)
 
 
 class _AutogradScope:
@@ -88,8 +93,7 @@ class _AutogradScope:
 
     def __enter__(self):
         if self._recording:
-            global _RECORD_GEN
-            _RECORD_GEN += 1
+            _state().record_gen = _record_gen() + 1
         if self._recording is not None:
             self._prev_rec = set_recording(self._recording)
         if self._training is not None:
@@ -375,7 +379,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # no
         if req == "add":
             grad._data = grad._data + ct.astype(grad.dtype)
         else:
-            if getattr(arr, "_grad_gen", None) == _RECORD_GEN:
+            if getattr(arr, "_grad_gen", None) == _record_gen():
                 # a second backward() in the SAME record scope is about to
                 # overwrite this grad. The reference's multi-device pattern
                 # (`for l in losses: l.backward()`) writes per-ctx buffers;
@@ -390,7 +394,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # no
                     "in one pass) or attach_grad(grad_req='add')",
                     RuntimeWarning, stacklevel=2)
             grad._data = jnp.asarray(ct, dtype=grad.dtype).reshape(grad.shape)
-            arr._grad_gen = _RECORD_GEN
+            arr._grad_gen = _record_gen()
 
     if not retain_graph:
         for h in heads:

@@ -327,7 +327,9 @@ def layer_norm(data, gamma, beta, *, axis=-1, eps=1e-5, output_mean_var=False):
     """Layer normalization (ref: src/operator/nn/layer_norm.cc).
 
     With output_mean_var, also returns the per-group mean and std
-    (gradient-stopped, matching the reference's FNumVisibleOutputs)."""
+    (gradient-stopped, matching the reference's FNumVisibleOutputs). The
+    normalized axis is kept as size 1 in mean/std (ref LayerNormShape sets
+    moments_shape[axis]=1) so (data - mean) / std broadcasts directly."""
     mean = jnp.mean(data, axis=axis, keepdims=True)
     var = jnp.var(data, axis=axis, keepdims=True)
     x_hat = (data - mean) * lax.rsqrt(var + eps)
@@ -336,8 +338,8 @@ def layer_norm(data, gamma, beta, *, axis=-1, eps=1e-5, output_mean_var=False):
     out = x_hat * gamma.reshape(bshape) + beta.reshape(bshape)
     if output_mean_var:
         return (out,
-                lax.stop_gradient(jnp.squeeze(mean, axis)),
-                lax.stop_gradient(jnp.squeeze(jnp.sqrt(var + eps), axis)))
+                lax.stop_gradient(mean),
+                lax.stop_gradient(jnp.sqrt(var + eps)))
     return out
 
 

@@ -404,6 +404,11 @@ def crop_op(data, crop_like=None, *, offset=(0, 0), h_w=(0, 0),
     return data[:, :, oy:oy + th, ox:ox + tw]
 
 
+def _round_half_away(x):
+    """C round(): halves go away from zero (jnp.round is banker's)."""
+    return jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5)
+
+
 @register("_contrib_PSROIPooling", aliases=("PSROIPooling",),
           no_grad_inputs=("rois",))
 def psroi_pooling(data, rois, *, spatial_scale, output_dim, pooled_size,
@@ -421,10 +426,11 @@ def psroi_pooling(data, rois, *, spatial_scale, output_dim, pooled_size,
 
     def one_roi(roi):
         bidx = roi[0].astype(jnp.int32)
-        x1 = jnp.round(roi[1]) * spatial_scale
-        y1 = jnp.round(roi[2]) * spatial_scale
-        x2 = jnp.round(roi[3] + 1.0) * spatial_scale
-        y2 = jnp.round(roi[4] + 1.0) * spatial_scale
+        # round(roi) + 1, half away from zero (ref psroi_pooling.cu:72-75)
+        x1 = _round_half_away(roi[1]) * spatial_scale
+        y1 = _round_half_away(roi[2]) * spatial_scale
+        x2 = (_round_half_away(roi[3]) + 1.0) * spatial_scale
+        y2 = (_round_half_away(roi[4]) + 1.0) * spatial_scale
         rh = jnp.maximum(y2 - y1, 0.1)
         rw = jnp.maximum(x2 - x1, 0.1)
         bin_h, bin_w = rh / p, rw / p
@@ -473,16 +479,18 @@ def deformable_psroi_pooling(data, rois, trans=None, *, spatial_scale,
 
     def one_roi(roi, tr):
         bidx = roi[0].astype(jnp.int32)
-        x1 = jnp.round(roi[1]) * spatial_scale - 0.5
-        y1 = jnp.round(roi[2]) * spatial_scale - 0.5
-        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
-        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+        x1 = _round_half_away(roi[1]) * spatial_scale - 0.5
+        y1 = _round_half_away(roi[2]) * spatial_scale - 0.5
+        x2 = (_round_half_away(roi[3]) + 1.0) * spatial_scale - 0.5
+        y2 = (_round_half_away(roi[4]) + 1.0) * spatial_scale - 0.5
         rh = jnp.maximum(y2 - y1, 0.1)
         rw = jnp.maximum(x2 - x1, 0.1)
         bin_h, bin_w = rh / p, rw / p
         sub_h, sub_w = bin_h / s, bin_w / s
         i = jnp.arange(p, dtype=jnp.float32)
-        u = (jnp.arange(s, dtype=jnp.float32) + 0.5)
+        # taps at iw * sub_bin from the bin start — no half-sub-bin center
+        # offset (ref deformable_psroi_pooling.cu:144-145)
+        u = jnp.arange(s, dtype=jnp.float32)
         # base tap grid per bin: (p, s) each axis
         ys0 = y1 + i[:, None] * bin_h + u[None, :] * sub_h
         xs0 = x1 + i[:, None] * bin_w + u[None, :] * sub_w
@@ -505,14 +513,20 @@ def deformable_psroi_pooling(data, rois, trans=None, *, spatial_scale,
         pages = img[:, gi][:, :, gi]  # (O, p, p, H, W)
 
         def sample_o(page, cls_id):
-            # page (p, p, H, W); taps (p, p, s, s)
+            # page (p, p, H, W); taps (p, p, s, s). A tap outside
+            # [-0.5, dim-0.5] is skipped from BOTH the sum and the count;
+            # in-range taps are clamped to [0, dim-1] before bilinear
+            # sampling (ref deformable_psroi_pooling.cu:147-158).
             yy, xx = ty[cls_id], tx[cls_id]
+            valid = ((yy >= -0.5) & (yy <= h - 0.5)
+                     & (xx >= -0.5) & (xx <= w - 0.5))
+            yy = jnp.clip(yy, 0.0, h - 1.0)
+            xx = jnp.clip(xx, 0.0, w - 1.0)
             y0 = jnp.floor(yy)
             x0 = jnp.floor(xx)
             wy1, wx1 = yy - y0, xx - x0
 
             def tap(yi, xi, wgt):
-                inb = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
                 yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
                 xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
                 v = jnp.take_along_axis(
@@ -520,13 +534,15 @@ def deformable_psroi_pooling(data, rois, trans=None, *, spatial_scale,
                         page[:, :, :, None, None, :],
                         yc[:, :, None, :, :, None].astype(jnp.int32), axis=2),
                     xc[:, :, None, :, :, None].astype(jnp.int32), axis=5)
-                return v[:, :, 0, :, :, 0] * (wgt * inb.astype(page.dtype))
+                return v[:, :, 0, :, :, 0] * wgt
 
             out = (tap(y0, x0, (1 - wy1) * (1 - wx1))
                    + tap(y0, x0 + 1, (1 - wy1) * wx1)
                    + tap(y0 + 1, x0, wy1 * (1 - wx1))
                    + tap(y0 + 1, x0 + 1, wy1 * wx1))
-            return jnp.mean(out, axis=(-1, -2))  # (p, p)
+            cnt = jnp.sum(valid, axis=(-1, -2)).astype(page.dtype)  # (p, p)
+            tot = jnp.sum(out * valid.astype(page.dtype), axis=(-1, -2))
+            return jnp.where(cnt > 0, tot / jnp.maximum(cnt, 1.0), 0.0)
 
         cls_ids = jnp.arange(o_dim, dtype=jnp.int32) // per_cls
         cls_ids = jnp.clip(cls_ids, 0, n_cls - 1)

@@ -174,14 +174,18 @@ def test_op_attr_semantics_tail():
     assert_almost_equal(nd.pick(d, i).asnumpy(),
                         np.array([2.0, 3.0]))  # clipped to 2, 0
 
-    # LayerNorm output_mean_var returns (out, mean, std)
+    # LayerNorm output_mean_var returns (out, mean, std); the normalized
+    # axis stays size 1 (ref layer_norm.cc LayerNormShape sets
+    # moments_shape[axis]=1) so (x - mean) / std broadcasts directly.
     x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
     out, mean, std = nd.LayerNorm(
         nd.array(x), nd.ones((8,)), nd.zeros((8,)), output_mean_var=True)
-    assert_almost_equal(mean.asnumpy(), x.mean(-1), rtol=1e-5)
+    assert_almost_equal(mean.asnumpy(), x.mean(-1, keepdims=True), rtol=1e-5)
     assert_almost_equal(std.asnumpy(),
-                        np.sqrt(x.var(-1) + 1e-5), rtol=1e-5)
-    assert out.shape == (4, 8) and mean.shape == (4,)
+                        np.sqrt(x.var(-1, keepdims=True) + 1e-5), rtol=1e-5)
+    assert out.shape == (4, 8) and mean.shape == (4, 1)
+    assert_almost_equal(((nd.array(x) - mean) / std).asnumpy(),
+                        out.asnumpy(), rtol=1e-5)
 
     # sample_multinomial get_prob returns the sampled log-likelihood
     p = nd.array(np.array([[0.8, 0.2], [0.1, 0.9]], dtype=np.float32))

@@ -373,32 +373,94 @@ def test_psroi_pooling_matches_loop_oracle():
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
 
 
-def test_deformable_psroi_pooling_linear_field_and_offsets():
-    """On a linear field, sampled bin averages equal the bin-center value;
-    a constant trans offset shifts every sample by trans_std*roi_size in
-    that direction (ref: contrib/deformable_psroi_pooling.cc)."""
-    O, G, H, W, p = 1, 3, 20, 20, 3
-    lin = (np.arange(H)[:, None] * 10 + np.arange(W)[None, :]).astype("f4")
-    data = np.broadcast_to(lin, (1, O * G * G, H, W)).copy()
-    rois = np.array([[0, 2, 1, 11, 9]], dtype="float32")
+def _deformable_psroi_oracle(data, rois, trans, scale, o_dim, p, group,
+                             part, s, trans_std):
+    """Numpy loop transcription of the reference kernel semantics
+    (ref: contrib/deformable_psroi_pooling.cu:96-159): taps at
+    iw*sub_bin from the bin start, out-of-[-0.5, dim-0.5] taps skipped
+    from sum AND count, in-range coords clamped, half-away rounding."""
+    _, C, H, W = data.shape
+    n_cls = 1 if trans is None else trans.shape[1] // 2
+    per_cls = max(o_dim // n_cls, 1)
+    out = np.zeros((len(rois), o_dim, p, p), "float32")
+
+    def rnd(v):  # C round(): half away from zero
+        return np.sign(v) * np.floor(np.abs(v) + 0.5)
+
+    def bilin(page, y, x):
+        y0, x0 = int(np.floor(y)), int(np.floor(x))
+        y1_, x1_ = int(np.ceil(y)), int(np.ceil(x))
+        dy, dx = y - y0, x - x0
+        return ((1 - dy) * (1 - dx) * page[y0, x0]
+                + (1 - dy) * dx * page[y0, x1_]
+                + dy * (1 - dx) * page[y1_, x0]
+                + dy * dx * page[y1_, x1_])
+
+    for r, roi in enumerate(rois):
+        bidx = int(roi[0])
+        x1 = rnd(roi[1]) * scale - 0.5
+        y1 = rnd(roi[2]) * scale - 0.5
+        x2 = (rnd(roi[3]) + 1.0) * scale - 0.5
+        y2 = (rnd(roi[4]) + 1.0) * scale - 0.5
+        rw, rh = max(x2 - x1, 0.1), max(y2 - y1, 0.1)
+        bh, bw = rh / p, rw / p
+        sub_h, sub_w = bh / s, bw / s
+        img = data[bidx].reshape(o_dim, group, group, H, W)
+        for o in range(o_dim):
+            cls = o // per_cls
+            for i in range(p):
+                for j in range(p):
+                    ph_ = min(max(i * part // p, 0), part - 1)
+                    pw_ = min(max(j * part // p, 0), part - 1)
+                    tx_ = 0.0 if trans is None else (
+                        trans[r, cls * 2, ph_, pw_] * trans_std)
+                    ty_ = 0.0 if trans is None else (
+                        trans[r, cls * 2 + 1, ph_, pw_] * trans_std)
+                    hst = i * bh + y1 + ty_ * rh
+                    wst = j * bw + x1 + tx_ * rw
+                    gi = min(max(i * group // p, 0), group - 1)
+                    gj = min(max(j * group // p, 0), group - 1)
+                    tot, cnt = 0.0, 0
+                    for ih in range(s):
+                        for iw in range(s):
+                            yv = hst + ih * sub_h
+                            xv = wst + iw * sub_w
+                            if (xv < -0.5 or xv > W - 0.5
+                                    or yv < -0.5 or yv > H - 0.5):
+                                continue
+                            yv = min(max(yv, 0.0), H - 1.0)
+                            xv = min(max(xv, 0.0), W - 1.0)
+                            tot += bilin(img[o, gi, gj], yv, xv)
+                            cnt += 1
+                    out[r, o, i, j] = tot / cnt if cnt else 0.0
+    return out
+
+
+def test_deformable_psroi_pooling_matches_loop_oracle():
+    """DeformablePSROIPooling vs a numpy loop oracle of the reference
+    kernel (ref: contrib/deformable_psroi_pooling.cu:96-159), including a
+    partially out-of-image ROI that exercises the tap-skipping path."""
+    rng = np.random.RandomState(3)
+    O, G, H, W, p, s = 2, 3, 12, 14, 3, 2
+    data = rng.rand(2, O * G * G, H, W).astype("float32")
+    # second ROI pokes outside the image so some taps are skipped
+    rois = np.array([[0, 2, 1, 11, 9],
+                     [1, -3, -2, 6, 5],
+                     [0, 10, 8, 16, 14]], dtype="float32")
     base = nd.DeformablePSROIPooling(
         nd.array(data), nd.array(rois), spatial_scale=1.0, output_dim=O,
-        pooled_size=p, sample_per_part=2, no_trans=True).asnumpy()
-    x1, y1 = 2 - 0.5, 1 - 0.5
-    x2, y2 = 12 - 0.5, 10 - 0.5
-    bh, bw = (y2 - y1) / p, (x2 - x1) / p
-    ref = np.array([[(y1 + (i + .5) * bh) * 10 + x1 + (j + .5) * bw
-                     for j in range(p)] for i in range(p)], "float32")
-    np.testing.assert_allclose(base[0, 0], ref, rtol=1e-4)
-    # constant +0.1 offset in x over roi width 10 at trans_std=1 -> +1 px
-    trans = np.zeros((1, 2, p, p), "float32")
-    trans[:, 0] = 0.1
+        pooled_size=p, sample_per_part=s, no_trans=True).asnumpy()
+    ref = _deformable_psroi_oracle(data, rois, None, 1.0, O, p, G, p, s, 0.0)
+    np.testing.assert_allclose(base, ref, rtol=1e-4, atol=1e-5)
+    # with per-(class, bin) trans offsets and a non-unit spatial scale
+    trans = rng.uniform(-0.2, 0.2, (len(rois), 2, p, p)).astype("float32")
     shifted = nd.DeformablePSROIPooling(
-        nd.array(data), nd.array(rois), nd.array(trans), spatial_scale=1.0,
-        output_dim=O, pooled_size=p, sample_per_part=2,
-        trans_std=1.0).asnumpy()
-    np.testing.assert_allclose(shifted[0, 0] - base[0, 0],
-                               np.full((p, p), (x2 - x1) * 0.1), rtol=1e-3)
+        nd.array(data), nd.array(rois), nd.array(trans), spatial_scale=0.5,
+        output_dim=O, pooled_size=p, sample_per_part=s,
+        trans_std=0.5).asnumpy()
+    ref2 = _deformable_psroi_oracle(data, rois, trans, 0.5, O, p, G, p, s,
+                                    0.5)
+    np.testing.assert_allclose(shifted, ref2, rtol=1e-4, atol=1e-5)
 
 
 def test_crop_legacy_op():
