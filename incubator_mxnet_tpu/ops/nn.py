@@ -1001,3 +1001,6 @@ alias("Embedding", "_contrib_SparseEmbedding")
 # pooling_v1.cc — same math, pre-NNVM parameter structs)
 alias("Convolution", "Convolution_v1")
 alias("Pooling", "Pooling_v1")
+# vendor-specific legacy name: same math, the cudnn dispatch is a backend
+# concern XLA subsumes (ref: cudnn_batch_norm.cc NNVM_REGISTER_OP)
+alias("BatchNorm", "CuDNNBatchNorm")

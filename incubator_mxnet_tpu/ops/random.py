@@ -186,3 +186,15 @@ def sample_gen_neg_binomial(mu, alpha, *, shape=(), dtype="float32", _rng=None):
     p = r / (r + _expand(mu, shape))
     lam = jax.random.gamma(k1, r, full) * (1 - p) / p
     return jax.random.poisson(k2, lam, full).astype(_dt(dtype))
+
+
+# Deprecated 1.x-era public spellings, kept so ported scripts resolve
+# (ref: src/operator/random/sample_op.cc:83,101,116,128,140,153,167,182
+# `.add_alias("random_*")`).
+from .registry import alias as _alias  # noqa: E402
+
+for _dist in ("uniform", "normal", "gamma", "exponential", "poisson",
+              "negative_binomial", "generalized_negative_binomial",
+              "randint"):
+    _alias(f"_random_{_dist}", f"random_{_dist}")
+del _alias, _dist

@@ -884,6 +884,14 @@ alias("broadcast_div", "_scatter_elemwise_div")
 alias("broadcast_add", "_grad_add")
 alias("histogram", "_histogram")
 alias("boolean_mask", "_contrib_boolean_mask")
+# deprecated 1.x public spellings (ref:
+# elemwise_binary_broadcast_op_basic.cc:34,82 `broadcast_plus/minus`;
+# broadcast_reduce_op_index.cc:112 `choose_element_0index` -> pick;
+# matrix_op.cc:451 `crop` -> slice)
+alias("broadcast_add", "broadcast_plus")
+alias("broadcast_sub", "broadcast_minus")
+alias("pick", "choose_element_0index")
+alias("slice", "crop")
 
 
 @register("_arange", aliases=("arange",))

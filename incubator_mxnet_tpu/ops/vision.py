@@ -350,10 +350,11 @@ def roi_pooling(data, rois, *, pooled_size, spatial_scale):
 
     def one_roi(roi):
         bidx = roi[0].astype(jnp.int32)
-        x1 = jnp.round(roi[1] * spatial_scale)
-        y1 = jnp.round(roi[2] * spatial_scale)
-        x2 = jnp.round(roi[3] * spatial_scale)
-        y2 = jnp.round(roi[4] * spatial_scale)
+        # std::round = half away from zero (ref roi_pooling.cc:69-72)
+        x1 = _round_half_away(roi[1] * spatial_scale)
+        y1 = _round_half_away(roi[2] * spatial_scale)
+        x2 = _round_half_away(roi[3] * spatial_scale)
+        y2 = _round_half_away(roi[4] * spatial_scale)
         rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
         rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
         bin_h, bin_w = rh / ph, rw / pw

@@ -1,0 +1,86 @@
+"""Registry-parity invariant: every user-callable reference op name resolves.
+
+The manifest `tests/data/ref_public_ops.txt` is pinned output of
+`tools/gen_ref_op_manifest.py`, which scrapes the reference NNVM registry
+(ref: src/operator/**/*.cc NNVM_REGISTER_OP / MXNET_OPERATOR_REGISTER_* /
+.add_alias). Pinning it makes "the registry diff vs the reference is empty"
+a tested invariant rather than a PARITY.md claim: if the manifest or the
+registry drifts, this fails.
+"""
+import os
+
+import pytest
+
+from incubator_mxnet_tpu import ndarray as nd
+from incubator_mxnet_tpu.ops import registry
+
+MANIFEST = os.path.join(os.path.dirname(__file__), "data",
+                        "ref_public_ops.txt")
+
+
+def _manifest_names():
+    with open(MANIFEST) as f:
+        return [ln.strip() for ln in f if ln.strip()]
+
+
+def test_manifest_is_pinned_and_nonempty():
+    names = _manifest_names()
+    # the reference registers ~209 user-callable names; a sudden shrink
+    # means the manifest file was clobbered, not that parity improved
+    assert len(names) >= 200
+    assert names == sorted(names)
+    # spot-check spellings from every era the manifest must cover
+    for probe in ("Convolution", "broadcast_plus", "choose_element_0index",
+                  "crop", "random_uniform", "batch_dot", "SVMOutput"):
+        assert probe in names, f"manifest lost {probe}"
+
+
+def test_every_reference_public_op_resolves():
+    """Each name must be a registered op (or alias), or a deliberate
+    frontend-level callable (Custom dispatch, sparse cast_storage)."""
+    missing = [n for n in _manifest_names()
+               if registry.get_op(n) is None and not hasattr(nd, n)]
+    assert not missing, f"reference public ops unresolved: {missing}"
+
+
+@pytest.mark.parametrize("deprecated,canonical", [
+    ("random_uniform", "_random_uniform"),
+    ("random_normal", "_random_normal"),
+    ("random_gamma", "_random_gamma"),
+    ("random_exponential", "_random_exponential"),
+    ("random_poisson", "_random_poisson"),
+    ("random_negative_binomial", "_random_negative_binomial"),
+    ("random_generalized_negative_binomial",
+     "_random_generalized_negative_binomial"),
+    ("random_randint", "_random_randint"),
+    ("broadcast_plus", "broadcast_add"),
+    ("broadcast_minus", "broadcast_sub"),
+    ("choose_element_0index", "pick"),
+    ("crop", "slice"),
+    ("CuDNNBatchNorm", "BatchNorm"),
+])
+def test_deprecated_alias_targets(deprecated, canonical):
+    """Deprecated 1.x spellings map to the same OpDef as their canonical op
+    (ref: sample_op.cc:83 etc., elemwise_binary_broadcast_op_basic.cc:34,82,
+    broadcast_reduce_op_index.cc:112, matrix_op.cc:451)."""
+    assert registry.get_op(deprecated) is registry.get_op(canonical)
+
+
+def test_deprecated_aliases_execute():
+    import numpy as np
+
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_allclose(
+        nd.crop(x, begin=(0, 1), end=(2, 3)).asnumpy(),
+        x.asnumpy()[:, 1:3])
+    np.testing.assert_allclose(
+        nd.broadcast_plus(x, nd.ones((2, 1))).asnumpy(), x.asnumpy() + 1)
+    np.testing.assert_allclose(
+        nd.broadcast_minus(x, nd.ones((2, 1))).asnumpy(), x.asnumpy() - 1)
+    np.testing.assert_allclose(
+        nd.choose_element_0index(
+            x, nd.array(np.array([0.0, 2.0]))).asnumpy(),
+        np.array([0.0, 5.0]))
+    assert nd.random_uniform(shape=(3, 2)).shape == (3, 2)
+    assert nd.random_normal(shape=(4,)).shape == (4,)
+    assert nd.random_randint(low=0, high=5, shape=(3,)).shape == (3,)

@@ -346,18 +346,25 @@ def test_psroi_pooling_matches_loop_oracle():
     np.random.seed(0)
     O, G, H, W = 2, 3, 12, 16
     data = np.random.rand(1, O * G * G, H, W).astype("float32")
-    rois = np.array([[0, 2, 1, 11, 9], [0, 0, 0, 15, 11]], dtype="float32")
+    # third ROI has half-integer coords: round(roi)+1 with half-away-from-
+    # zero rounding (C round, psroi_pooling.cu:72-75), NOT python banker's
+    rois = np.array([[0, 2, 1, 11, 9], [0, 0, 0, 15, 11],
+                     [0, 2.5, 1.5, 10.5, 8.5]], dtype="float32")
     scale, p = 0.5, 3
     out = nd.PSROIPooling(nd.array(data), nd.array(rois),
                           spatial_scale=scale, output_dim=O,
                           pooled_size=p).asnumpy()
     img = data[0].reshape(O, G, G, H, W)
     ref = np.zeros((len(rois), O, p, p), "float32")
+
+    def rnd(v):  # C round(): half away from zero
+        return np.sign(v) * np.floor(np.abs(v) + 0.5)
+
     for r, roi in enumerate(rois):
-        x1 = round(roi[1]) * scale
-        y1 = round(roi[2]) * scale
-        x2 = round(roi[3] + 1) * scale
-        y2 = round(roi[4] + 1) * scale
+        x1 = rnd(roi[1]) * scale
+        y1 = rnd(roi[2]) * scale
+        x2 = (rnd(roi[3]) + 1) * scale
+        y2 = (rnd(roi[4]) + 1) * scale
         bh = max(y2 - y1, 0.1) / p
         bw = max(x2 - x1, 0.1) / p
         for o in range(O):
