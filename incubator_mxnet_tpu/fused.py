@@ -35,6 +35,19 @@ from .ndarray.ndarray import NDArray
 __all__ = ["GluonTrainStep"]
 
 
+def _cast_like(new_state, old_state):
+    """Cast an optimizer-state pytree leaf-wise back to its pre-update
+    dtypes (None / array / tuple-of-arrays — the shapes create_state
+    produces). Keeps the scan carry dtype-stable for bf16-cast nets."""
+    if new_state is None or old_state is None:
+        return new_state
+    if isinstance(new_state, tuple):
+        return tuple(
+            n if o is None else n.astype(o.dtype)
+            for n, o in zip(new_state, old_state))
+    return new_state.astype(old_state.dtype)
+
+
 class GluonTrainStep:
     """Compile net+loss+optimizer into one donated-buffer step.
 
@@ -305,9 +318,11 @@ class GluonTrainStep:
             finally:
                 autograd.set_training(prev_t)
                 autograd.set_recording(prev_r)
-            # loss reduction in f32 (a bf16 batch-mean loses precision in
-            # exactly the scalar people monitor); no-op for f32 nets
-            loss_data = jnp.mean(loss._data.astype(jnp.float32))
+            # loss reduction in at least f32 (a bf16 batch-mean loses
+            # precision in exactly the scalar people monitor); promoted,
+            # not pinned, so float64 nets keep an f64 loss
+            ldt = jnp.promote_types(loss._data.dtype, jnp.float32)
+            loss_data = jnp.mean(loss._data.astype(ldt))
             # aux state updates (BN running stats) show up as rebound arrays
             aux_new = {
                 n: mapping[n]._data
@@ -331,8 +346,13 @@ class GluonTrainStep:
                     w, st = self.opt.fused_update(n, d, grads[gi], states[i],
                                                   lr, t=t)
                     gi += 1
-                    new_params.append(w)
-                    new_states.append(st)
+                    # pin param/state dtypes: the f32 lr/hyperparam scalars
+                    # promote bf16 update math to f32 (the right accumulation
+                    # discipline), but the OUTPUT must keep the input dtype
+                    # or the scan_steps carry (params/states thread through
+                    # a lax.scan) fails to typecheck for bf16-cast nets
+                    new_params.append(w.astype(d.dtype))
+                    new_states.append(_cast_like(st, states[i]))
                 else:
                     new_params.append(aux_new.get(n, d))
                     new_states.append(None)
@@ -358,11 +378,19 @@ class GluonTrainStep:
                     forward, has_aux=True)(grad_params, others, x, y, key)
                 others = {**others, **aux_new}
                 gsum = [a + g for a, g in zip(gsum, grads)]
-                return (others, gsum, lsum + loss), None
+                return (others, gsum, lsum + loss.astype(lsum.dtype)), None
 
             zero_g = [jnp.zeros_like(d) for d in grad_params]
+            # loss accumulator in the same promoted dtype forward() emits
+            # (>= f32; f64 for float64 nets), so the f64 path keeps an f64
+            # loss through accumulation too
+            float_dts = [d.dtype for d in grad_params
+                         if jnp.issubdtype(d.dtype, jnp.floating)]
+            acc_dt = jnp.promote_types(
+                jnp.result_type(*float_dts) if float_dts else jnp.float32,
+                jnp.float32)
             (others_f, gsum, lsum), _ = jax.lax.scan(
-                body, (other_params, zero_g, jnp.zeros((), jnp.float32)),
+                body, (other_params, zero_g, jnp.zeros((), acc_dt)),
                 (xs, ys, keys))
             new_params, new_states = [], []
             gi = 0
@@ -371,8 +399,8 @@ class GluonTrainStep:
                     w, st = self.opt.fused_update(n, d, gsum[gi], states[i],
                                                   lr, t=t)
                     gi += 1
-                    new_params.append(w)
-                    new_states.append(st)
+                    new_params.append(w.astype(d.dtype))
+                    new_states.append(_cast_like(st, states[i]))
                 else:
                     new_params.append(others_f.get(n, d))
                     new_states.append(None)

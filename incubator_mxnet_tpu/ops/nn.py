@@ -301,21 +301,23 @@ def batch_norm(
     bshape = [1] * data.ndim
     bshape[axis % data.ndim] = data.shape[axis % data.ndim]
 
-    # Statistics in fp32 regardless of activation dtype (bf16 mean/var loses
-    # too much precision); output cast back so bf16 stays bf16 end-to-end.
+    # Statistics in at least fp32 (bf16 mean/var loses too much precision);
+    # promote rather than pin so float64 activations keep f64 stats. Output
+    # cast back so bf16 stays bf16 end-to-end.
     out_dtype = data.dtype
-    xf = data.astype(jnp.float32)
+    stat_dt = jnp.promote_types(data.dtype, jnp.float32)
+    xf = data.astype(stat_dt)
     if _training and not use_global_stats:
         mean = jnp.mean(xf, axis=reduce_axes)
         var = jnp.var(xf, axis=reduce_axes)
         new_mean = moving_mean * momentum + mean.astype(moving_mean.dtype) * (1 - momentum)
         new_var = moving_var * momentum + var.astype(moving_var.dtype) * (1 - momentum)
     else:
-        mean, var = moving_mean.astype(jnp.float32), moving_var.astype(jnp.float32)
+        mean, var = moving_mean.astype(stat_dt), moving_var.astype(stat_dt)
         new_mean, new_var = moving_mean, moving_var
     x_hat = (xf - mean.reshape(bshape)) * lax.rsqrt(var.reshape(bshape) + eps)
-    out = (x_hat * g.reshape(bshape).astype(jnp.float32)
-           + beta.reshape(bshape).astype(jnp.float32)).astype(out_dtype)
+    out = (x_hat * g.reshape(bshape).astype(stat_dt)
+           + beta.reshape(bshape).astype(stat_dt)).astype(out_dtype)
     if _training:
         return out, lax.stop_gradient(new_mean), lax.stop_gradient(new_var)
     return out

@@ -718,6 +718,41 @@ def test_scan_steps_matches_sequential():
     assert np.isfinite(float(more.asscalar()))
 
 
+def test_scan_steps_bf16_cast_net():
+    """scan_steps on a bf16-CAST net (the bench.py bf16 configuration)
+    must compile and keep dtypes stable: the f32 lr scalar promotes the
+    update math to f32, and without the cast-back the lax.scan carry
+    typecheck fails (params/states enter bf16, exit f32). Regression for
+    the armed-bench bug found by tools/perf_analysis.py in round 5."""
+    from incubator_mxnet_tpu import fused
+
+    mx.random.seed(3)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"))
+    net.add(nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    net.cast("bfloat16")
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    step = fused.GluonTrainStep(net, lambda n, x, y: L(n(x), y), opt)
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    xs = nd.from_jax(jnp.asarray(rng.rand(3, 6, 5), jnp.bfloat16))
+    ys = nd.array(rng.randint(0, 3, size=(3, 6)).astype(np.float32))
+    losses = step.scan_steps(xs, ys)
+    assert np.all(np.isfinite(losses.asnumpy().astype(np.float32)))
+    step.sync_params()
+    for _, p in net.collect_params().items():
+        assert p.data().dtype == jnp.bfloat16, p
+    # loss should drop over a few more scans on the same batches
+    first = float(losses.asnumpy().astype(np.float32)[0])
+    for _ in range(3):
+        losses = step.scan_steps(xs, ys)
+    last = float(losses.asnumpy().astype(np.float32)[-1])
+    assert last < first
+
+
 def test_scan_steps_adam_bias_correction():
     """Adam's per-step bias correction t must advance INSIDE the scan —
     each of the K steps sees its own update count."""
