@@ -1,0 +1,248 @@
+# R training frontend (reference role: R-package/R/model.R
+# mx.model.FeedForward.create / predict, and R-package/R/symbol.R).
+#
+# Design: a "symbol" is a lightweight chain description (R lists tagged
+# with class "mx.symbol") built by mx.symbol.* constructors. Training is
+# imperative underneath — each batch runs forward through the generated
+# mx.nd.* ops under autograd, backward through the embedded runtime, and
+# updates via the framework's own fused optimizer ops (sgd_update /
+# sgd_mom_update), so the R loop stays thin while all math runs on XLA
+# devices. The reference instead binds a symbolic executor per batch
+# shape; the imperative form is the TPU-native equivalent of the same
+# user contract: symbol in, trained model out.
+
+mx.symbol.Variable <- function(name = "data") {
+  structure(list(op = "var", name = name), class = "mx.symbol")
+}
+
+mx.symbol.FullyConnected <- function(data, num_hidden, name = NULL,
+                                     no_bias = FALSE) {
+  structure(list(op = "fc", input = data, num_hidden = num_hidden,
+                 name = name, no_bias = no_bias), class = "mx.symbol")
+}
+
+mx.symbol.Activation <- function(data, act_type = "relu", name = NULL) {
+  structure(list(op = "act", input = data, act_type = act_type, name = name),
+            class = "mx.symbol")
+}
+
+#' Output head: trains with softmax cross-entropy, predicts probabilities
+#' (the reference SoftmaxOutput contract).
+mx.symbol.SoftmaxOutput <- function(data, name = "softmax") {
+  structure(list(op = "softmax_output", input = data, name = name),
+            class = "mx.symbol")
+}
+
+#' Linear regression head: trains with squared error (reference
+#' LinearRegressionOutput contract), predicts the raw output.
+mx.symbol.LinearRegressionOutput <- function(data, name = "linreg") {
+  structure(list(op = "linreg_output", input = data, name = name),
+            class = "mx.symbol")
+}
+
+is.mx.symbol <- function(x) inherits(x, "mx.symbol")
+
+#' Walk the chain root -> input, assigning default layer names (fc1, fc2,
+#' ... counted from the input side, matching user expectations).
+mx.symbol.chain <- function(symbol) {
+  chain <- list()
+  node <- symbol
+  while (!is.null(node)) {
+    chain[[length(chain) + 1L]] <- node
+    node <- node$input
+  }
+  chain <- rev(chain)  # input -> output order
+  counts <- list()
+  for (i in seq_along(chain)) {
+    node <- chain[[i]]
+    if (is.null(node$name) || !nzchar(node$name)) {
+      k <- node$op
+      counts[[k]] <- (if (is.null(counts[[k]])) 0L else counts[[k]]) + 1L
+      chain[[i]]$name <- paste0(k, counts[[k]])
+    }
+  }
+  chain
+}
+
+#' Parameter names the symbol requires (reference arguments(symbol) role),
+#' in chain order.
+mx.symbol.arguments <- function(symbol) {
+  args <- character(0)
+  for (node in mx.symbol.chain(symbol)) {
+    if (node$op == "fc") {
+      args <- c(args, paste0(node$name, "_weight"))
+      if (!isTRUE(node$no_bias)) args <- c(args, paste0(node$name, "_bias"))
+    }
+  }
+  args
+}
+
+#' Initialize parameters for a symbol given the input feature count.
+#' initializer: a function(shape) -> R array, or a numeric scale for
+#' uniform(-scale, scale) (reference mx.init.uniform).
+mx.model.init.params <- function(symbol, in_features, initializer = 0.07) {
+  init_fn <- if (is.function(initializer)) {
+    initializer
+  } else {
+    scale <- as.numeric(initializer)
+    function(shape) array(stats::runif(prod(shape), -scale, scale),
+                          dim = shape)
+  }
+  params <- list()
+  features <- in_features
+  for (node in mx.symbol.chain(symbol)) {
+    if (node$op == "fc") {
+      w <- init_fn(c(node$num_hidden, features))
+      params[[paste0(node$name, "_weight")]] <- mx.nd.array(w)
+      if (!isTRUE(node$no_bias)) {
+        params[[paste0(node$name, "_bias")]] <-
+          mx.nd.array(array(0, dim = node$num_hidden))
+      }
+      features <- node$num_hidden
+    }
+  }
+  params
+}
+
+#' Forward pass: data NDArray -> head-input NDArray (logits for a softmax
+#' head). params is the named list from mx.model.init.params.
+mx.symbol.forward <- function(symbol, params, data) {
+  h <- data
+  for (node in mx.symbol.chain(symbol)) {
+    h <- switch(node$op,
+      var = h,
+      fc = mx.nd.FullyConnected(
+        h, params[[paste0(node$name, "_weight")]],
+        if (isTRUE(node$no_bias)) NULL
+        else params[[paste0(node$name, "_bias")]],
+        num_hidden = node$num_hidden, no_bias = isTRUE(node$no_bias)),
+      act = mx.nd.Activation(h, act_type = node$act_type),
+      softmax_output = h,   # loss/softmax applied by the trainer/predictor
+      linreg_output = h,
+      stop("unsupported symbol op: ", node$op))
+  }
+  h
+}
+
+mx.model.head <- function(symbol) {
+  chain <- mx.symbol.chain(symbol)
+  chain[[length(chain)]]$op
+}
+
+#' Train a feed-forward model (reference mx.model.FeedForward.create,
+#' R-package/R/model.R:470 — same user contract, imperative engine).
+#'
+#' X: numeric matrix, one sample per ROW (n x d). y: numeric vector of
+#' 0-based class ids (softmax head) or regression targets (linreg head).
+#' eval.data: optional list(data = matrix, label = vector).
+#' Returns class "MXFeedForwardModel" usable with predict().
+mx.model.FeedForward.create <- function(symbol, X, y,
+                                        num.round = 10,
+                                        array.batch.size = 128,
+                                        learning.rate = 0.01,
+                                        momentum = 0,
+                                        wd = 0,
+                                        initializer = 0.07,
+                                        eval.data = NULL,
+                                        verbose = TRUE,
+                                        epoch.end.callback = NULL) {
+  stopifnot(is.mx.symbol(symbol), is.matrix(X) || is.array(X))
+  n <- nrow(X)
+  stopifnot(length(y) == n)
+  head <- mx.model.head(symbol)
+  params <- mx.model.init.params(symbol, ncol(X), initializer)
+  momentum_state <- NULL
+  if (momentum > 0) {
+    momentum_state <- lapply(params, function(p) {
+      mx.nd.zeros_like(p)
+    })
+  }
+  for (round in seq_len(num.round)) {
+    idx <- sample.int(n)
+    total_loss <- 0
+    nb <- 0L
+    for (start in seq(1L, n, by = array.batch.size)) {
+      take <- idx[start:min(start + array.batch.size - 1L, n)]
+      xb <- mx.nd.array(X[take, , drop = FALSE])
+      yb <- mx.nd.array(as.numeric(y[take]))
+      for (p in names(params)) mx.attach.grad(params[[p]])
+      mx.autograd.record()
+      out <- mx.symbol.forward(symbol, params, xb)
+      loss <- if (head == "softmax_output") {
+        mx.nd.softmax_cross_entropy(out, yb)
+      } else {
+        sq <- mx.nd.square(mx.nd.broadcast_sub(
+          mx.nd.reshape_like(out, yb), yb))
+        mx.nd.sum(sq)
+      }
+      mx.autograd.end()
+      mx.backward(loss)
+      scale <- 1 / length(take)
+      for (p in names(params)) {
+        g <- mx.grad(params[[p]])
+        if (momentum > 0) {
+          upd <- mx.nd.sgd_mom_update(params[[p]], g, momentum_state[[p]],
+                                      lr = learning.rate,
+                                      momentum = momentum, wd = wd,
+                                      rescale_grad = scale)
+          params[[p]] <- upd[[1L]]
+          momentum_state[[p]] <- upd[[2L]]
+        } else {
+          params[[p]] <- mx.nd.sgd_update(params[[p]], g,
+                                          lr = learning.rate, wd = wd,
+                                          rescale_grad = scale)
+        }
+      }
+      total_loss <- total_loss + sum(mx.nd.to.array(loss)) / length(take)
+      nb <- nb + 1L
+    }
+    if (verbose) {
+      msg <- sprintf("Round [%d] Train-loss=%f", round, total_loss / nb)
+      if (!is.null(eval.data)) {
+        model_now <- structure(list(symbol = symbol, params = params),
+                               class = "MXFeedForwardModel")
+        acc <- mx.model.accuracy(model_now, eval.data$data, eval.data$label)
+        msg <- sprintf("%s Validation-accuracy=%f", msg, acc)
+      }
+      cat(msg, "\n")
+    }
+    if (!is.null(epoch.end.callback)) epoch.end.callback(round)
+  }
+  structure(list(symbol = symbol, params = params),
+            class = "MXFeedForwardModel")
+}
+
+#' Predict: returns the n x k probability matrix for a softmax head
+#' (reference predict.MXFeedForwardModel layout, one sample per row) or
+#' the raw outputs for a regression head.
+predict.MXFeedForwardModel <- function(object, X, ...) {
+  xb <- mx.nd.array(X)
+  out <- mx.symbol.forward(object$symbol, object$params, xb)
+  if (mx.model.head(object$symbol) == "softmax_output") {
+    out <- mx.nd.softmax(out)
+  }
+  mx.nd.to.array(out)
+}
+
+mx.model.accuracy <- function(model, X, y) {
+  prob <- predict(model, X)
+  pred <- max.col(prob) - 1L  # 0-based class ids
+  mean(pred == as.integer(y))
+}
+
+#' Save/load a trained model as a plain RDS of host arrays (the reference
+#' saves .params/.json files; one artifact is the R idiom).
+mx.model.save <- function(model, file) {
+  host <- lapply(model$params, mx.nd.to.array)
+  saveRDS(list(symbol = model$symbol, params = host), file)
+}
+
+mx.model.load <- function(file) {
+  blob <- readRDS(file)
+  params <- lapply(blob$params, function(a) {
+    if (is.null(dim(a))) a <- array(a, dim = length(a))
+    mx.nd.array(a)
+  })
+  structure(list(symbol = blob$symbol, params = params),
+            class = "MXFeedForwardModel")
+}

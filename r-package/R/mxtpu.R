@@ -60,3 +60,40 @@ mx.backward <- function(loss) {
 mx.grad <- function(nd) {
   .Call(mxr_grad, nd)
 }
+
+#' Serialize a named list of op attributes to the JSON object the runtime
+#' expects (capi_imperative.py invoke(): nulls dropped, arrays -> tuples).
+#' Whole numbers are emitted without a decimal point so integer-typed op
+#' attrs (num_hidden, axis, ...) arrive as ints after json decoding.
+#' `arrays` names tuple-typed attrs (registry default is a tuple): those
+#' are ALWAYS encoded as JSON arrays, because R cannot distinguish the
+#' scalar 1 from the length-1 vector c(1) and ops like slice do
+#' len(begin)/begin[i] on them.
+mx.attrs.json <- function(attrs, arrays = character(0)) {
+  keep <- attrs[!vapply(attrs, is.null, logical(1))]
+  if (length(keep) == 0L) return(NULL)
+  enc1 <- function(v) {
+    if (is.logical(v)) return(if (v) "true" else "false")
+    if (is.character(v)) {
+      v <- gsub("\\\\", "\\\\\\\\", v)
+      return(paste0('"', gsub('"', '\\\\"', v), '"'))
+    }
+    if (is.numeric(v)) {
+      if (!is.finite(v)) return(if (v > 0) "1e308" else "-1e308")
+      if (v == floor(v) && abs(v) < 9e15) return(sprintf("%.0f", v))
+      return(format(v, digits = 17, scientific = FALSE))
+    }
+    stop("unsupported attr type: ", class(v))
+  }
+  enc <- function(v, force_array = FALSE) {
+    if (force_array || length(v) > 1L)
+      return(paste0("[", paste(vapply(v, enc1, character(1)),
+                               collapse = ","), "]"))
+    enc1(v)
+  }
+  parts <- vapply(names(keep), function(k) {
+    enc(keep[[k]], force_array = k %in% arrays)
+  }, character(1))
+  paste0("{", paste(sprintf('"%s":%s', names(keep), parts),
+                    collapse = ","), "}")
+}

@@ -1,0 +1,77 @@
+# Train an MLP on MNIST from R (reference role:
+# R-package/vignettes mlp example over mx.model.FeedForward.create).
+#
+# Uses the real MNIST idx files when present under
+# ~/.mxnet/datasets/mnist; otherwise falls back to a synthetic
+# 10-class problem with the same 784-feature shape so the script always
+# demonstrates the full train/predict/save/load path.
+#
+# Run (package installed, PYTHONPATH at the repo root):
+#   Rscript examples/mnist_mlp.R
+library(mxtpu)
+mx.init()
+
+read.idx.images <- function(path) {
+  con <- file(path, "rb")
+  on.exit(close(con))
+  readBin(con, integer(), 1, size = 4, endian = "big")  # magic
+  n <- readBin(con, integer(), 1, size = 4, endian = "big")
+  h <- readBin(con, integer(), 1, size = 4, endian = "big")
+  w <- readBin(con, integer(), 1, size = 4, endian = "big")
+  raw <- readBin(con, integer(), n * h * w, size = 1, signed = FALSE)
+  matrix(raw / 255, nrow = n, ncol = h * w, byrow = TRUE)
+}
+
+read.idx.labels <- function(path) {
+  con <- file(path, "rb")
+  on.exit(close(con))
+  readBin(con, integer(), 1, size = 4, endian = "big")
+  n <- readBin(con, integer(), 1, size = 4, endian = "big")
+  readBin(con, integer(), n, size = 1, signed = FALSE)
+}
+
+mnist.dir <- file.path(Sys.getenv("HOME"), ".mxnet", "datasets", "mnist")
+train.images <- file.path(mnist.dir, "train-images-idx3-ubyte")
+if (file.exists(train.images)) {
+  cat("using MNIST from", mnist.dir, "\n")
+  X <- read.idx.images(train.images)[1:2000, ]
+  y <- read.idx.labels(file.path(mnist.dir, "train-labels-idx1-ubyte"))[1:2000]
+  Xv <- read.idx.images(file.path(mnist.dir, "t10k-images-idx3-ubyte"))[1:500, ]
+  yv <- read.idx.labels(file.path(mnist.dir, "t10k-labels-idx1-ubyte"))[1:500]
+} else {
+  cat("MNIST not found; using synthetic 10-class data\n")
+  set.seed(42)
+  k <- 10
+  n <- 1200
+  centers <- matrix(rnorm(k * 784, sd = 2), k, 784)
+  y <- sample(0:(k - 1), n, replace = TRUE)
+  X <- centers[y + 1, ] + matrix(rnorm(n * 784, sd = 0.5), n, 784)
+  yv <- sample(0:(k - 1), 300, replace = TRUE)
+  Xv <- centers[yv + 1, ] + matrix(rnorm(300 * 784, sd = 0.5), 300, 784)
+}
+
+data <- mx.symbol.Variable("data")
+fc1 <- mx.symbol.FullyConnected(data, num_hidden = 128, name = "fc1")
+act1 <- mx.symbol.Activation(fc1, act_type = "relu")
+fc2 <- mx.symbol.FullyConnected(act1, num_hidden = 64, name = "fc2")
+act2 <- mx.symbol.Activation(fc2, act_type = "relu")
+fc3 <- mx.symbol.FullyConnected(act2, num_hidden = 10, name = "fc3")
+mlp <- mx.symbol.SoftmaxOutput(fc3, name = "sm")
+
+set.seed(0)
+model <- mx.model.FeedForward.create(
+  mlp, X, y,
+  num.round = 3, array.batch.size = 100,
+  learning.rate = 0.1, momentum = 0.9,
+  eval.data = list(data = Xv, label = yv))
+
+acc <- mx.model.accuracy(model, Xv, yv)
+cat(sprintf("final validation accuracy: %.3f\n", acc))
+stopifnot(acc > 0.6)
+
+# round-trip through save/load must preserve predictions exactly
+tmp <- tempfile(fileext = ".rds")
+mx.model.save(model, tmp)
+model2 <- mx.model.load(tmp)
+stopifnot(max(abs(predict(model, Xv) - predict(model2, Xv))) < 1e-6)
+cat("R MLP training OK\n")

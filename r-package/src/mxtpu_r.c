@@ -106,8 +106,11 @@ SEXP mxr_invoke(SEXP op, SEXP inputs, SEXP attrs) {
   int n_in = LENGTH(inputs);
   void* ins[16];
   if (n_in > 16) error("max 16 inputs");
-  for (int i = 0; i < n_in; ++i)
-    ins[i] = R_ExternalPtrAddr(VECTOR_ELT(inputs, i));
+  for (int i = 0; i < n_in; ++i) {
+    /* NULL element = optional input not supplied (e.g. bias w/ no_bias) */
+    SEXP el = VECTOR_ELT(inputs, i);
+    ins[i] = el == R_NilValue ? NULL : R_ExternalPtrAddr(el);
+  }
   const char* attrs_c =
       attrs == R_NilValue ? NULL : CHAR(STRING_ELT(attrs, 0));
   void* outs[8];

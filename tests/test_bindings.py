@@ -61,6 +61,69 @@ def test_r_call_registration_consistent():
     assert called <= registered, f"unregistered .Call: {called - registered}"
 
 
+def test_generated_r_ops_current():
+    """The checked-in ops_gen.R must match what the registry produces
+    (same content-compare pattern as the JVM generator test)."""
+    target = os.path.join(RPKG, "R", "ops_gen.R")
+    before = open(target).read()
+    try:
+        gen = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "gen_r_api.py")],
+            capture_output=True, text=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert gen.returncode == 0, gen.stderr[-800:]
+        after = open(target).read()
+        assert before == after, "stale ops_gen.R — run tools/gen_r_api.py"
+    finally:
+        with open(target, "w") as f:
+            f.write(before)
+
+
+def test_r_model_api_surface():
+    """model.R must define the FeedForward training frontend (reference
+    R-package/R/model.R:470 mx.model.FeedForward.create role)."""
+    src = _read(RPKG, "R", "model.R")
+    for fn in ("mx.model.FeedForward.create", "mx.symbol.Variable",
+               "mx.symbol.FullyConnected", "mx.symbol.Activation",
+               "mx.symbol.SoftmaxOutput", "mx.model.init.params",
+               "predict.MXFeedForwardModel", "mx.model.save",
+               "mx.model.load", "mx.model.accuracy"):
+        assert re.search(rf"^{re.escape(fn)} <- function",
+                         src, re.M), f"model.R missing {fn}"
+
+
+def test_r_frontend_calls_resolve():
+    """Every mx.nd.<op> call in model.R and the R examples must be a
+    function ops_gen.R actually defines, and every R-exported pattern
+    must match at least one definition (catches typos without R)."""
+    defined = set(re.findall(r"^(mx\.nd\.\w+) <- function",
+                             _read(RPKG, "R", "ops_gen.R"), re.M))
+    assert len(defined) > 250, "suspiciously few generated R ops"
+    srcs = [_read(RPKG, "R", "model.R")]
+    exdir = os.path.join(RPKG, "examples")
+    for f in sorted(os.listdir(exdir)):
+        if f.endswith(".R"):
+            srcs.append(_read(exdir, f))
+    for src in srcs:
+        used = set(re.findall(r"\b(mx\.nd\.\w+)\(", src))
+        used -= {"mx.nd.array", "mx.nd.to.array", "mx.nd.shape"}
+        missing = used - defined
+        assert not missing, f"R frontend calls unknown ops: {sorted(missing)}"
+
+
+def test_r_namespace_consistent():
+    """NAMESPACE export list must cover the hand-written API and the
+    generated/exported patterns must compile against the sources."""
+    ns = _read(RPKG, "NAMESPACE")
+    hand = _read(RPKG, "R", "mxtpu.R")
+    for fn in re.findall(r"^(mx\.[\w.]+) <- function", hand, re.M):
+        assert f"export({fn})" in ns or re.search(
+            r'exportPattern\("([^"]+)"\)', ns) and any(
+            re.match(pat.replace("\\\\", "\\"), fn)
+            for pat in re.findall(r'exportPattern\("([^"]+)"\)', ns)), \
+            f"NAMESPACE does not export {fn}"
+
+
 def test_r_uses_only_real_abi_symbols():
     c = _read(RPKG, "src", "mxtpu_r.c")
     used = set(re.findall(r"\b(MXTpuImp\w+)\(", c))
@@ -126,6 +189,40 @@ def test_jvm_binding_builds_and_trains():
         capture_output=True, text=True, timeout=600, env=env)
     assert run.returncode == 0, (run.stdout[-800:], run.stderr[-1500:])
     assert "TRAINED" in run.stdout
+    # Module.fit over an exported .mxt (the scala Module.fit contract):
+    # export a tiny trainer artifact, then fit it from the JVM
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        export = subprocess.run(
+            [sys.executable, "-c", """
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import deploy, gluon
+from incubator_mxnet_tpu.gluon import nn
+import sys
+net = nn.HybridSequential()
+net.add(nn.Dense(64, activation="relu"))
+net.add(nn.Dense(10))
+net.initialize(mx.init.Xavier())
+L = gluon.loss.SoftmaxCrossEntropyLoss()
+opt = mx.optimizer.SGD(learning_rate=0.2, rescale_grad=1.0/64)
+deploy.export_trainer(sys.argv[1], net, lambda n, x, y: L(n(x), y), opt,
+                      (64, 20), (64,))
+print("EXPORTED")
+""", os.path.join(td, "mlp")],
+            capture_output=True, text=True, timeout=600, env=env)
+        assert "EXPORTED" in export.stdout, export.stderr[-1500:]
+        fit = subprocess.run(
+            [os.path.join(_jdk(), "bin", "java"),
+             "-cp", os.path.join(JVM, "target", "mxtpu.jar"),
+             "-Djava.library.path=" + os.path.join(JVM, "target"),
+             "org.apache.mxtpu.examples.TrainMlp",
+             os.path.join(td, "mlp-train.mxt"), "64", "20"],
+            capture_output=True, text=True, timeout=600, env=env)
+        assert fit.returncode == 0, (fit.stdout[-800:], fit.stderr[-1500:])
+        assert "FITTED" in fit.stdout
 
 
 @pytest.mark.skipif(shutil.which("R") is None,
@@ -150,6 +247,13 @@ def test_r_binding_builds_and_smokes(tmp_path):
         capture_output=True, text=True, timeout=600, env=env)
     assert run.returncode == 0, (run.stdout[-800:], run.stderr[-1500:])
     assert "R binding smoke OK" in run.stdout
+    # the full training frontend: symbol -> FeedForward.create -> predict
+    # -> save/load round-trip (reference model.R user contract)
+    run = subprocess.run(
+        ["Rscript", os.path.join(RPKG, "examples", "mnist_mlp.R")],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert run.returncode == 0, (run.stdout[-800:], run.stderr[-1500:])
+    assert "R MLP training OK" in run.stdout
 
 
 def test_r_c_glue_compiles_headerless(tmp_path):
@@ -244,6 +348,78 @@ def test_julia_uses_only_real_abi_symbols():
     defined = set(re.findall(r"\b(MXTpuImp\w+)\(", impl))
     assert used, "no ccall symbols parsed from MXTpu.jl"
     assert used <= defined, f"Julia binding references unknown: {used - defined}"
+
+
+def test_jvm_infer_fit_api_surface():
+    """The infer/fit layer must exist and stay wired (reference:
+    scala-package infer Predictor.scala:81 descriptors + Module.fit):
+    DataDesc validation, DataIter/NDArrayIter, Module.fit over the .mxt
+    ABI, Classifier over the .mxp ABI; TrainMlp exercises both modes.
+    Always-on (no JDK needed): source-level checks only."""
+    base = os.path.join(JVM, "src", "main", "java", "org", "apache", "mxtpu")
+    desc = _read(base, "DataDesc.java")
+    assert "validate(float[] data)" in desc and "sampleSize()" in desc
+    it = _read(base, "DataIter.java")
+    assert "provideData()" in it and "provideLabel()" in it
+    ndit = _read(base, "NDArrayIter.java")
+    assert "implements DataIter" in ndit
+    mod = _read(base, "Module.java")
+    assert "fit(DataIter train, int epochs" in mod
+    # Module must orchestrate the .mxt ABI through Trainer (no new natives)
+    assert "new Trainer(" in mod and "trainer.step()" in mod
+    cls = _read(base, "Classifier.java")
+    assert "new Predictor(" in cls and "classify(" in cls
+    mlp = _read(base, "examples", "TrainMlp.java")
+    assert "FITTED" in mlp and "TRAINED" in mlp and "new Module(" in mlp
+
+
+def _julia_sources():
+    src_dir = os.path.join(REPO, "julia-package", "MXTpu.jl", "src")
+    out = {}
+    for f in sorted(os.listdir(src_dir)):
+        if f.endswith(".jl"):
+            out[f] = _read(src_dir, f)
+    return out
+
+
+def test_julia_op_names_resolve():
+    """Every op name the Julia surface (and its tests) invokes must exist
+    in the registry — catches spelling drift without a Julia toolchain."""
+    from incubator_mxnet_tpu.ops import registry
+
+    srcs = list(_julia_sources().values())
+    srcs.append(_read(REPO, "julia-package", "MXTpu.jl", "test",
+                      "runtests.jl"))
+    used = set()
+    for src in srcs:
+        used |= set(re.findall(r'\bop\("([\w.]+)"', src))
+        used |= set(re.findall(r'\binvoke\("([\w.]+)"', src))
+    assert used, "no op names parsed from Julia sources"
+    missing = sorted(n for n in used if registry.get_op(n) is None)
+    assert not missing, f"Julia calls unknown ops: {missing}"
+
+
+def test_julia_model_api_surface():
+    """The idiomatic layer must exist: operator overloads, Chain/Dense,
+    fit!/predict/accuracy (reference julia/src/model.jl role), and the
+    module must include both new files."""
+    srcs = _julia_sources()
+    assert "ndarray_ops.jl" in srcs and "model.jl" in srcs
+    main = srcs["MXTpu.jl"]
+    assert 'include("ndarray_ops.jl")' in main
+    assert 'include("model.jl")' in main
+    ops_src = srcs["ndarray_ops.jl"]
+    for overload in (r"Base\.:\+\(a::NDArray, b::NDArray\)",
+                     r"Base\.:\*\(a::NDArray, s::Real\)",
+                     r"Base\.:-\(a::NDArray, b::NDArray\)"):
+        assert re.search(overload, ops_src), f"missing overload {overload}"
+    model_src = srcs["model.jl"]
+    for fn in ("function fit!", "struct Dense", "struct Chain",
+               "function predict", "function accuracy"):
+        assert fn in model_src, f"model.jl missing {fn}"
+    # exports match definitions
+    for name in ("fit!", "Dense", "Chain", "predict", "accuracy", "matmul"):
+        assert name in main, f"MXTpu.jl does not export {name}"
 
 
 @pytest.mark.skipif(shutil.which("julia") is None,
