@@ -15,7 +15,11 @@
 module MXTpu
 
 export init, NDArray, to_array, invoke, attach_grad, backward, grad,
-       record_begin, record_end
+       record_begin, record_end,
+       # idiomatic surface (ndarray_ops.jl / model.jl)
+       op, attrs_json, matmul, relu, sigmoid, softmax, mean_nd, argmax_nd,
+       zeros_like, ones_like,
+       Dense, Chain, forward, params, fit!, predict, accuracy
 
 const _lib = Ref{String}("")
 
@@ -127,5 +131,8 @@ record_begin(train::Bool = true) =
 
 record_end() =
     _check(ccall((:MXTpuImpRecordEnd, _libpath()), Cint, ()), "record_end")
+
+include("ndarray_ops.jl")
+include("model.jl")
 
 end # module

@@ -1,0 +1,147 @@
+# Training API (reference role: julia/src/model.jl — the FeedForward
+# `mx.fit(...)` contract, reshaped to the Julia idiom: a Chain of layers
+# plus a mutating `fit!`).
+#
+# The loop is imperative over the embedded autograd runtime: forward via
+# generated-op calls, backward through the tape, updates via the
+# framework's fused optimizer ops (sgd_update / sgd_mom_update), so every
+# FLOP runs under XLA while Julia only orchestrates batches.
+
+"""Fully-connected layer with optional activation (:relu, :sigmoid,
+:identity). Weights initialize uniform(-scale, scale) on first use."""
+mutable struct Dense
+    num_hidden::Int
+    act::Symbol
+    weight::Union{NDArray,Nothing}
+    bias::Union{NDArray,Nothing}
+    scale::Float64
+end
+
+Dense(num_hidden::Int; act::Symbol = :identity, scale::Float64 = 0.07) =
+    Dense(num_hidden, act, nothing, nothing, scale)
+
+"""An ordered container of layers (reference chain/FeedForward shape)."""
+struct Chain
+    layers::Vector{Dense}
+end
+
+Chain(layers::Dense...) = Chain(collect(layers))
+
+function _materialize!(layer::Dense, in_features::Int)
+    if layer.weight === nothing
+        w = (rand(Float32, layer.num_hidden, in_features) .- 0.5f0) .*
+            Float32(2 * layer.scale)
+        layer.weight = NDArray(w)
+        layer.bias = NDArray(zeros(Float32, layer.num_hidden))
+    end
+    return layer.num_hidden
+end
+
+function _forward(layer::Dense, x::NDArray)
+    h = op("FullyConnected", x, layer.weight, layer.bias;
+           num_hidden = layer.num_hidden)
+    layer.act === :relu && return relu(h)
+    layer.act === :sigmoid && return sigmoid(h)
+    return h
+end
+
+function forward(model::Chain, x::NDArray)
+    h = x
+    for layer in model.layers
+        h = _forward(layer, h)
+    end
+    return h
+end
+
+params(model::Chain) = NDArray[p for l in model.layers
+                               for p in (l.weight, l.bias) if p !== nothing]
+
+"""Train `model` on rows of X (n x d) against 0-based integer labels y
+with softmax cross-entropy + SGD(momentum) — the reference `mx.fit`
+contract as a mutating Julia function. Returns per-epoch mean losses."""
+function fit!(model::Chain, X::AbstractMatrix, y::AbstractVector;
+              epochs::Int = 10, batch_size::Int = 100,
+              lr::Float64 = 0.01, momentum::Float64 = 0.0,
+              wd::Float64 = 0.0, verbose::Bool = true)
+    n, d = size(X)
+    length(y) == n || error("X rows != length(y)")
+    feat = d
+    for layer in model.layers
+        feat = _materialize!(layer, feat)
+    end
+    moms = momentum > 0 ?
+        Dict{UInt,NDArray}(objectid(p) => zeros_like(p)
+                           for p in params(model)) : nothing
+    losses = Float64[]
+    for epoch in 1:epochs
+        order = randperm_stable(n)
+        total = 0.0
+        nb = 0
+        for start in 1:batch_size:n
+            take = order[start:min(start + batch_size - 1, n)]
+            xb = NDArray(Float32.(X[take, :]))
+            yb = NDArray(Float32.(y[take]))
+            ps = params(model)
+            for p in ps
+                attach_grad(p)
+            end
+            record_begin(true)
+            out = forward(model, xb)
+            loss = op("softmax_cross_entropy", out, yb)
+            record_end()
+            backward(loss)
+            scale = 1.0 / length(take)
+            for layer in model.layers
+                for field in (:weight, :bias)
+                    p = getfield(layer, field)
+                    p === nothing && continue
+                    g = grad(p)
+                    if moms !== nothing
+                        m = moms[objectid(p)]
+                        upd = invoke("sgd_mom_update", [p, g, m];
+                                     attrs = attrs_json(lr = lr,
+                                                        momentum = momentum,
+                                                        wd = wd,
+                                                        rescale_grad = scale))
+                        delete!(moms, objectid(p))
+                        setfield!(layer, field, upd[1])
+                        moms[objectid(upd[1])] = upd[2]
+                    else
+                        upd = op("sgd_update", p, g; lr = lr, wd = wd,
+                                 rescale_grad = scale)
+                        setfield!(layer, field, upd)
+                    end
+                end
+            end
+            total += sum(to_array(loss)) / length(take)
+            nb += 1
+        end
+        push!(losses, total / nb)
+        verbose && println("epoch $epoch loss $(round(total / nb; digits=4))")
+    end
+    return losses
+end
+
+"""Deterministic permutation (no Random dependency in the package)."""
+function randperm_stable(n::Int)
+    v = collect(1:n)
+    state = UInt64(0x9E3779B97F4A7C15)
+    for i in n:-1:2
+        state = state * 0x5851F42D4C957F2D + 0x14057B7EF767814F
+        j = Int(mod(state >> 33, UInt64(i))) + 1
+        v[i], v[j] = v[j], v[i]
+    end
+    return v
+end
+
+"""Class probabilities (n x k), rows = samples."""
+function predict(model::Chain, X::AbstractMatrix)
+    out = forward(model, NDArray(Float32.(X)))
+    return to_array(softmax(out))
+end
+
+function accuracy(model::Chain, X::AbstractMatrix, y::AbstractVector)
+    prob = predict(model, X)
+    pred = [argmax(prob[i, :]) - 1 for i in 1:size(prob, 1)]
+    return sum(pred .== Int.(y)) / length(y)
+end

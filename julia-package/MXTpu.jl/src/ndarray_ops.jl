@@ -1,0 +1,71 @@
+# Idiomatic NDArray surface (reference role: julia/src/ndarray.jl —
+# operator overloading and broadcast-style math over the op registry).
+#
+# Every method lowers onto `invoke` over the embedded runtime, so the
+# math executes on XLA devices; only the operator spelling is Julia.
+
+"""JSON-encode op attributes (runtime contract: capi_imperative.py
+invoke() — nulls dropped, arrays become tuples, whole numbers must be
+ints so integer-typed attrs survive json decoding)."""
+function attrs_json(; kwargs...)
+    isempty(kwargs) && return ""
+    enc(v::Bool) = v ? "true" : "false"
+    enc(v::AbstractString) = "\"" * replace(replace(String(v), "\\" => "\\\\"),
+                                           "\"" => "\\\"") * "\""
+    enc(v::Integer) = string(v)
+    function enc(v::AbstractFloat)
+        isfinite(v) || return v > 0 ? "1e308" : "-1e308"
+        v == floor(v) && abs(v) < 9e15 && return string(Int64(v))
+        return string(v)
+    end
+    enc(v::Union{Tuple,AbstractVector}) =
+        "[" * join([enc(x) for x in v], ",") * "]"
+    parts = ["\"$(k)\":$(enc(v))" for (k, v) in kwargs if v !== nothing]
+    isempty(parts) && return ""
+    return "{" * join(parts, ",") * "}"
+end
+
+"""Call any registered op by name with NDArray inputs and keyword attrs;
+returns the single output, or a Vector{NDArray} for multi-output ops."""
+function op(name::String, inputs::NDArray...; kwargs...)
+    outs = invoke(name, collect(NDArray, inputs); attrs = attrs_json(; kwargs...))
+    return length(outs) == 1 ? outs[1] : outs
+end
+
+# --- operator overloading (elementwise ops broadcast, matching the
+# reference NDArray semantics where lhs/rhs shapes may differ) ----------
+Base.:+(a::NDArray, b::NDArray) = op("broadcast_add", a, b)
+Base.:-(a::NDArray, b::NDArray) = op("broadcast_sub", a, b)
+Base.:*(a::NDArray, b::NDArray) = op("broadcast_mul", a, b)  # elementwise
+Base.:/(a::NDArray, b::NDArray) = op("broadcast_div", a, b)
+Base.:+(a::NDArray, s::Real) = op("_plus_scalar", a; scalar = Float64(s))
+Base.:+(s::Real, a::NDArray) = a + s
+Base.:-(a::NDArray, s::Real) = op("_minus_scalar", a; scalar = Float64(s))
+Base.:-(s::Real, a::NDArray) = op("_rminus_scalar", a; scalar = Float64(s))
+Base.:-(a::NDArray) = 0.0 - a
+Base.:*(a::NDArray, s::Real) = op("_mul_scalar", a; scalar = Float64(s))
+Base.:*(s::Real, a::NDArray) = a * s
+Base.:/(a::NDArray, s::Real) = op("_div_scalar", a; scalar = Float64(s))
+Base.:^(a::NDArray, s::Real) = op("_power_scalar", a; scalar = Float64(s))
+
+"""Matrix product (the reference's `dot`)."""
+matmul(a::NDArray, b::NDArray) = op("dot", a, b)
+
+Base.sum(a::NDArray) = op("sum", a)
+Base.exp(a::NDArray) = op("exp", a)
+Base.log(a::NDArray) = op("log", a)
+Base.sqrt(a::NDArray) = op("sqrt", a)
+Base.abs(a::NDArray) = op("abs", a)
+Base.maximum(a::NDArray) = op("max", a)
+Base.minimum(a::NDArray) = op("min", a)
+Base.reshape(a::NDArray, dims::Tuple) = op("reshape", a; shape = dims)
+Base.reshape(a::NDArray, dims::Integer...) = reshape(a, dims)
+Base.transpose(a::NDArray) = op("transpose", a)
+
+relu(a::NDArray) = op("relu", a)
+sigmoid(a::NDArray) = op("sigmoid", a)
+softmax(a::NDArray) = op("softmax", a)
+mean_nd(a::NDArray) = op("mean", a)
+argmax_nd(a::NDArray; axis::Int = -1) = op("argmax", a; axis = axis)
+zeros_like(a::NDArray) = op("zeros_like", a)
+ones_like(a::NDArray) = op("ones_like", a)

@@ -21,3 +21,46 @@ g = MXTpu.to_array(MXTpu.grad(w))
 @test isapprox(g, Float32[4, 6]; atol = 1e-6)
 
 println("Julia binding smoke OK")
+
+
+"""Deterministic pseudo-gaussian noise so the test needs no Random seed."""
+function randn_stable(r::Int, c::Int, seed::Int)
+    out = Array{Float32}(undef, r, c)
+    s = UInt64(seed * 2654435761 + 1)
+    for i in eachindex(out)
+        s = s * 0x5851F42D4C957F2D + 0x14057B7EF767814F
+        u1 = ((s >> 11) % UInt64(1 << 20)) / Float32(1 << 20) + 1f-7
+        s = s * 0x5851F42D4C957F2D + 0x14057B7EF767814F
+        u2 = ((s >> 11) % UInt64(1 << 20)) / Float32(1 << 20)
+        out[i] = sqrt(-2f0 * log(u1)) * cos(2f0 * Float32(pi) * u2)
+    end
+    return out
+end
+
+# --- idiomatic surface: operator overloading + broadcasting ---------------
+a = MXTpu.NDArray(Float32[1 2; 3 4])
+b = MXTpu.NDArray(Float32[10, 20])          # broadcasts over rows
+@test MXTpu.to_array(a + b) == Float32[11 12; 23 24] ||
+      MXTpu.to_array(a + b) == Float32[11 22; 13 24]
+@test MXTpu.to_array(a * 2) == Float32[2 4; 6 8]
+@test MXTpu.to_array(2 * a) == Float32[2 4; 6 8]
+@test MXTpu.to_array(a - 1) == Float32[0 1; 2 3]
+@test MXTpu.to_array(a ^ 2) == Float32[1 4; 9 16]
+m = MXTpu.matmul(a, MXTpu.NDArray(Float32[1 0; 0 1]))
+@test MXTpu.to_array(m) == Float32[1 2; 3 4]
+@test isapprox(MXTpu.to_array(MXTpu.relu(a - 3))[1, 1], 0f0)
+@test isapprox(sum(MXTpu.to_array(MXTpu.softmax(a))), 2f0; atol = 1e-5)
+
+# --- fit!: a small MLP must separate a linearly separable 3-class blob ----
+n = 300
+centers = Float32[4 0; -4 4; 0 -4]
+ys = [i % 3 for i in 0:(n - 1)]
+Xs = vcat([centers[y + 1, :]' .+ 0.5f0 .* randn_stable(1, 2, 7 * i + y)
+           for (i, y) in enumerate(ys)]...)
+model = MXTpu.Chain(MXTpu.Dense(32; act = :relu), MXTpu.Dense(3))
+losses = MXTpu.fit!(model, Xs, ys; epochs = 8, batch_size = 50,
+                    lr = 0.1, momentum = 0.9, verbose = false)
+@test losses[end] < losses[1]
+acc = MXTpu.accuracy(model, Xs, ys)
+@test acc > 0.9
+println("Julia fit OK (acc=$(round(acc; digits=3)))")
