@@ -1,0 +1,88 @@
+package org.apache.mxtpu;
+
+import java.util.AbstractMap;
+import java.util.ArrayList;
+import java.util.List;
+import java.util.Map;
+
+/**
+ * Label-aware inference over an exported .mxp artifact (reference role:
+ * org.apache.mxnet.infer.Classifier — a Predictor plus a synset of class
+ * labels and top-k (label, probability) output,
+ * ref: scala-package/infer/src/main/scala/org/apache/mxnet/infer/Classifier.scala).
+ */
+public final class Classifier implements AutoCloseable {
+  private final Predictor predictor;
+  private final DataDesc inputDesc;
+  private final String[] labels;
+
+  /**
+   * @param mxpPath exported predictor artifact (deploy.export_predictor)
+   * @param inputDesc descriptor of the single data input; fed buffers are
+   *     validated against it before they reach the runtime
+   * @param labels class labels, index-aligned with the class axis of
+   *     output 0
+   */
+  public Classifier(String mxpPath, String pluginPathOrNull,
+                    DataDesc inputDesc, String[] labels) {
+    this.predictor = new Predictor(mxpPath, pluginPathOrNull);
+    this.inputDesc = inputDesc;
+    this.labels = labels.clone();
+  }
+
+  /** Top-k (label, probability) per SAMPLE for one batch: outer list has
+   * one entry per batch row of output 0 (batched artifacts produce a
+   * (batch, classes) output; a rank-1 output is one sample). */
+  public List<List<Map.Entry<String, Float>>> classifyBatch(float[] input,
+                                                            int k) {
+    inputDesc.validate(input);
+    predictor.setInput(inputDesc.name, input);
+    predictor.forward();
+    long[] shape = predictor.outputShape(0);
+    float[] probs = predictor.getOutput(0);
+    int classes = (int) shape[shape.length - 1];
+    int samples = probs.length / classes;
+    List<List<Map.Entry<String, Float>>> out = new ArrayList<>(samples);
+    for (int s = 0; s < samples; s++) {
+      out.add(topKOf(probs, s * classes, classes, k));
+    }
+    return out;
+  }
+
+  /** Top-k (label, probability) for the FIRST sample — the single-image
+   * convenience matching the reference Classifier.classify. */
+  public List<Map.Entry<String, Float>> classify(float[] input, int k) {
+    return classifyBatch(input, k).get(0);
+  }
+
+  /** Top-k over one sample's class slice; one device transfer, done by
+   * the caller — no per-call re-fetch. */
+  private List<Map.Entry<String, Float>> topKOf(float[] probs, int off,
+                                                int classes, int k) {
+    int kk = Math.min(k, classes);
+    boolean[] used = new boolean[classes];
+    List<Map.Entry<String, Float>> out = new ArrayList<>(kk);
+    for (int j = 0; j < kk; j++) {
+      int best = -1;
+      for (int i = 0; i < classes; i++) {
+        if (!used[i] && (best < 0 || probs[off + i] > probs[off + best])) {
+          best = i;
+        }
+      }
+      used[best] = true;
+      String label = best < labels.length ? labels[best] : ("class_" + best);
+      out.add(new AbstractMap.SimpleImmutableEntry<>(label,
+          probs[off + best]));
+    }
+    return out;
+  }
+
+  public Predictor predictor() {
+    return predictor;
+  }
+
+  @Override
+  public void close() {
+    predictor.close();
+  }
+}

@@ -1,0 +1,63 @@
+package org.apache.mxtpu;
+
+import java.util.Arrays;
+
+/**
+ * Shape/dtype descriptor for a named model input or output (reference
+ * role: org.apache.mxnet.DataDesc in scala-package core, used by the
+ * infer package's Predictor to validate fed data,
+ * ref: scala-package/infer/src/main/scala/org/apache/mxnet/infer/Predictor.scala:81).
+ */
+public final class DataDesc {
+  public final String name;
+  public final long[] shape;
+  public final String dtype;
+  public final String layout;
+
+  public DataDesc(String name, long[] shape) {
+    this(name, shape, "float32", "NC");
+  }
+
+  public DataDesc(String name, long[] shape, String dtype, String layout) {
+    this.name = name;
+    this.shape = shape.clone();
+    this.dtype = dtype;
+    this.layout = layout;
+  }
+
+  /** Elements per sample record (product of non-batch dims; the batch
+   * axis is by convention dimension 0). */
+  public long sampleSize() {
+    long n = 1;
+    for (int i = 1; i < shape.length; i++) {
+      n *= shape[i];
+    }
+    return n;
+  }
+
+  public long batchSize() {
+    return shape.length > 0 ? shape[0] : 1;
+  }
+
+  public long totalSize() {
+    long n = 1;
+    for (long s : shape) {
+      n *= s;
+    }
+    return n;
+  }
+
+  /** Throw if a flat buffer cannot be an instance of this descriptor. */
+  public void validate(float[] data) {
+    if (data.length != totalSize()) {
+      throw new MXTpuException("input '" + name + "': expected "
+          + totalSize() + " floats for shape " + Arrays.toString(shape)
+          + ", got " + data.length);
+    }
+  }
+
+  @Override
+  public String toString() {
+    return name + Arrays.toString(shape) + ":" + dtype + ":" + layout;
+  }
+}

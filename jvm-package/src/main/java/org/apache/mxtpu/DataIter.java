@@ -1,0 +1,30 @@
+package org.apache.mxtpu;
+
+/**
+ * Batch iterator contract for Module.fit (reference role:
+ * org.apache.mxnet.DataIter in scala-package core). Batches are flat
+ * row-major float buffers matching the descriptors' shapes.
+ */
+public interface DataIter {
+  final class Batch {
+    public final float[] data;
+    public final float[] label;
+
+    public Batch(float[] data, float[] label) {
+      this.data = data;
+      this.label = label;
+    }
+  }
+
+  boolean hasNext();
+
+  Batch next();
+
+  void reset();
+
+  /** Descriptor of the data tensor one batch carries. */
+  DataDesc provideData();
+
+  /** Descriptor of the label tensor one batch carries. */
+  DataDesc provideLabel();
+}

@@ -1,0 +1,78 @@
+package org.apache.mxtpu;
+
+/**
+ * Module-shaped training orchestration over the .mxt train ABI
+ * (reference role: org.apache.mxnet.module.Module + the scala-package
+ * fit loop; runtime: src/train.cc over the PJRT C API — the whole
+ * fwd/bwd/update step is one compiled program, the JVM only feeds
+ * batches and reads the loss).
+ */
+public final class Module implements AutoCloseable {
+  /** Per-epoch callback (reference epoch_end_callback role). */
+  public interface EpochCallback {
+    void onEpoch(int epoch, float meanLoss);
+  }
+
+  private final Trainer trainer;
+  private float lastLoss = Float.NaN;
+
+  /** Load a training artifact exported by
+   * incubator_mxnet_tpu.deploy.export_trainer (input names "x"/"y"). */
+  public Module(String mxtPath, String pluginPathOrNull) {
+    this.trainer = new Trainer(mxtPath, pluginPathOrNull);
+  }
+
+  /** Run `epochs` passes over the iterator; returns per-epoch mean loss
+   * (the fit(trainIter, epochs) contract of the reference Module). */
+  public float[] fit(DataIter train, int epochs) {
+    return fit(train, epochs, null);
+  }
+
+  public float[] fit(DataIter train, int epochs, EpochCallback callback) {
+    DataDesc xDesc = train.provideData();
+    DataDesc yDesc = train.provideLabel();
+    float[] epochLoss = new float[epochs];
+    for (int e = 0; e < epochs; e++) {
+      train.reset();
+      double total = 0.0;
+      int batches = 0;
+      while (train.hasNext()) {
+        DataIter.Batch b = train.next();
+        xDesc.validate(b.data);
+        yDesc.validate(b.label);
+        trainer.setInput(xDesc.name, b.data);
+        trainer.setInput(yDesc.name, b.label);
+        lastLoss = trainer.step();
+        total += lastLoss;
+        batches++;
+      }
+      if (batches == 0) {
+        throw new MXTpuException("fit: iterator produced no batches");
+      }
+      epochLoss[e] = (float) (total / batches);
+      if (callback != null) {
+        callback.onEpoch(e, epochLoss[e]);
+      }
+    }
+    return epochLoss;
+  }
+
+  public float lastLoss() {
+    return lastLoss;
+  }
+
+  /** Read a named state tensor (param:NAME / opt:NAME, see export_trainer)
+   * back to the host — the checkpointing path. */
+  public void getState(String name, float[] out) {
+    trainer.getState(name, out);
+  }
+
+  public void setState(String name, float[] data) {
+    trainer.setState(name, data);
+  }
+
+  @Override
+  public void close() {
+    trainer.close();
+  }
+}

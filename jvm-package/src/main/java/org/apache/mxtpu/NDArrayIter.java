@@ -1,0 +1,67 @@
+package org.apache.mxtpu;
+
+/**
+ * In-memory DataIter over host arrays (reference role:
+ * org.apache.mxnet.io.NDArrayIter). Rows = samples; the last partial
+ * batch is dropped, matching the reference's default pad behavior for
+ * training. Shuffling is the caller's concern (pre-permute the rows).
+ */
+public final class NDArrayIter implements DataIter {
+  private final float[] data;
+  private final float[] label;
+  private final int numSamples;
+  private final int sampleSize;
+  private final int batchSize;
+  private int cursor;
+
+  public NDArrayIter(float[] data, float[] label, int numSamples,
+                     int sampleSize, int batchSize) {
+    if (data.length != (long) numSamples * sampleSize) {
+      throw new MXTpuException("data length " + data.length
+          + " != numSamples*sampleSize " + (long) numSamples * sampleSize);
+    }
+    if (label.length != numSamples) {
+      throw new MXTpuException("label length " + label.length
+          + " != numSamples " + numSamples);
+    }
+    this.data = data;
+    this.label = label;
+    this.numSamples = numSamples;
+    this.sampleSize = sampleSize;
+    this.batchSize = batchSize;
+    this.cursor = 0;
+  }
+
+  @Override
+  public boolean hasNext() {
+    return cursor + batchSize <= numSamples;
+  }
+
+  @Override
+  public Batch next() {
+    if (!hasNext()) {
+      throw new MXTpuException("iterator exhausted; call reset()");
+    }
+    float[] xb = new float[batchSize * sampleSize];
+    float[] yb = new float[batchSize];
+    System.arraycopy(data, cursor * sampleSize, xb, 0, xb.length);
+    System.arraycopy(label, cursor, yb, 0, batchSize);
+    cursor += batchSize;
+    return new Batch(xb, yb);
+  }
+
+  @Override
+  public void reset() {
+    cursor = 0;
+  }
+
+  @Override
+  public DataDesc provideData() {
+    return new DataDesc("x", new long[] {batchSize, sampleSize});
+  }
+
+  @Override
+  public DataDesc provideLabel() {
+    return new DataDesc("y", new long[] {batchSize}, "float32", "N");
+  }
+}

@@ -3,19 +3,66 @@ package org.apache.mxtpu.examples;
 import java.util.Random;
 import org.apache.mxtpu.AttrMap;
 import org.apache.mxtpu.Autograd;
+import org.apache.mxtpu.DataIter;
 import org.apache.mxtpu.MXTpu;
+import org.apache.mxtpu.Module;
 import org.apache.mxtpu.NDArray;
+import org.apache.mxtpu.NDArrayIter;
 import org.apache.mxtpu.Ops;
 
 /**
- * Train a small MLP from the JVM via the generated op API (reference role:
- * scala-package examples). Requires PYTHONPATH to point at the repo and
- * java.library.path at the native libs; see jvm-package/README.md.
+ * Train a small MLP from the JVM (reference role: scala-package
+ * examples). Two modes:
+ *
+ * - `TrainMlp path/to/artifact-train.mxt` — the Module API: fit(iter,
+ *   epochs) orchestrating the .mxt train ABI (the reference Module.fit
+ *   contract; whole step compiled, no Python at runtime). Prints FITTED.
+ * - no args — the imperative generated-op API with explicit autograd
+ *   (the cpp-package-style path). Prints TRAINED.
+ *
+ * Requires PYTHONPATH at the repo and java.library.path at the native
+ * libs; see jvm-package/README.md.
  */
 public final class TrainMlp {
   private TrainMlp() {}
 
+  /** Module.fit over an exported .mxt: synthetic separable data shaped
+   * to the artifact's (batch, inDim) signature must drive the loss down. */
+  static void fitFromArtifact(String mxtPath, int batch, int inDim) {
+    Random rng = new Random(7);
+    int samples = batch * 6;
+    float[] xs = new float[samples * inDim];
+    float[] ys = new float[samples];
+    for (int i = 0; i < samples; i++) {
+      int c = rng.nextInt(10);
+      ys[i] = c;
+      for (int j = 0; j < inDim; j++) {
+        xs[i * inDim + j] = 0.1f * ((c + j) % 10)
+            + 0.3f * (float) rng.nextGaussian();
+      }
+    }
+    try (Module mod = new Module(mxtPath, null)) {
+      DataIter iter = new NDArrayIter(xs, ys, samples, inDim, batch);
+      float[] losses = mod.fit(iter, 8, (epoch, meanLoss) ->
+          System.out.printf("epoch %d loss %.4f%n", epoch, meanLoss));
+      System.out.printf("first %.4f last %.4f%n", losses[0],
+          losses[losses.length - 1]);
+      if (losses[losses.length - 1] < losses[0]) {
+        System.out.println("FITTED");
+      } else {
+        System.out.println("FAILED");
+        System.exit(1);
+      }
+    }
+  }
+
   public static void main(String[] args) {
+    if (args.length >= 1 && args[0].endsWith(".mxt")) {
+      int batch = args.length > 1 ? Integer.parseInt(args[1]) : 64;
+      int inDim = args.length > 2 ? Integer.parseInt(args[2]) : 20;
+      fitFromArtifact(args[0], batch, inDim);
+      return;
+    }
     MXTpu.init();
     int batch = 64;
     int inDim = 20;
