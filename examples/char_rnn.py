@@ -99,7 +99,7 @@ def main():
         loss = step(nd.array(x), nd.array(y))
         if i == 0:
             first_loss = float(loss.asscalar())
-        if (i + 1) % 50 == 0:
+        if (i + 1) % 50 == 0 or (i + 1) == args.steps:
             last_loss = float(loss.asscalar())
             print(f"step {i + 1}: loss {last_loss:.3f}")
     step.sync_params()
