@@ -28,7 +28,18 @@ run_examples() {
 
 run_suite() {
     echo "=== full suite, ONE process, no -x (the honest green bar) ==="
-    python -m pytest tests/ -q
+    # wall-clock budget (seconds): growth must stay visible — if the suite
+    # blows past this, split/trim tests instead of silently absorbing it
+    local budget="${MXTPU_SUITE_BUDGET:-3300}"
+    local t0 t1
+    t0=$(date +%s)
+    python -m pytest tests/ -q --durations=25
+    t1=$(date +%s)
+    echo "suite wall clock: $((t1 - t0))s (budget ${budget}s)"
+    if [ $((t1 - t0)) -gt "$budget" ]; then
+        echo "FAIL: suite exceeded its ${budget}s wall-clock budget" >&2
+        exit 1
+    fi
 }
 
 run_nightly() {

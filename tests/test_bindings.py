@@ -373,6 +373,32 @@ def test_jvm_infer_fit_api_surface():
     assert "FITTED" in mlp and "TRAINED" in mlp and "new Module(" in mlp
 
 
+def test_java_sources_structurally_balanced():
+    """No JDK in CI, so at minimum every .java file must have balanced
+    braces/parens/brackets outside strings and comments — catches
+    truncated or mis-edited sources before a gated build ever runs."""
+    java_root = os.path.join(JVM, "src", "main", "java")
+    checked = 0
+    for root, _dirs, files in os.walk(java_root):
+        for fname in files:
+            if not fname.endswith(".java"):
+                continue
+            src = _read(root, fname)
+            # strip line/block comments, then string/char literals
+            src = re.sub(r"//[^\n]*", "", src)
+            src = re.sub(r"/\*.*?\*/", "", src, flags=re.S)
+            # one alternation pass: a '"' char literal must not derail the
+            # string matcher (and vice versa) — left-to-right wins
+            src = re.sub(
+                r'"(?:\\.|[^"\\])*"|\'(?:\\.|[^\'\\])*\'', '""', src)
+            for o, c in (("{", "}"), ("(", ")"), ("[", "]")):
+                assert src.count(o) == src.count(c), (
+                    f"{fname}: unbalanced {o}{c} "
+                    f"({src.count(o)} vs {src.count(c)})")
+            checked += 1
+    assert checked >= 12, f"only {checked} java files found"
+
+
 def _julia_sources():
     src_dir = os.path.join(REPO, "julia-package", "MXTpu.jl", "src")
     out = {}
