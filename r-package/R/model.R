@@ -26,6 +26,28 @@ mx.symbol.Activation <- function(data, act_type = "relu", name = NULL) {
             class = "mx.symbol")
 }
 
+#' 2-D convolution layer. Data flows NCHW; `kernel`/`stride`/`pad` are
+#' length-2 vectors (the reference mx.symbol.Convolution contract).
+mx.symbol.Convolution <- function(data, kernel, num_filter,
+                                  stride = c(1, 1), pad = c(0, 0),
+                                  name = NULL) {
+  structure(list(op = "conv", input = data, kernel = kernel,
+                 num_filter = num_filter, stride = stride, pad = pad,
+                 name = name), class = "mx.symbol")
+}
+
+mx.symbol.Pooling <- function(data, kernel, pool_type = "max",
+                              stride = kernel, pad = c(0, 0), name = NULL) {
+  structure(list(op = "pool", input = data, kernel = kernel,
+                 pool_type = pool_type, stride = stride, pad = pad,
+                 name = name), class = "mx.symbol")
+}
+
+mx.symbol.Flatten <- function(data, name = NULL) {
+  structure(list(op = "flatten", input = data, name = name),
+            class = "mx.symbol")
+}
+
 #' Output head: trains with softmax cross-entropy, predicts probabilities
 #' (the reference SoftmaxOutput contract).
 mx.symbol.SoftmaxOutput <- function(data, name = "softmax") {
@@ -69,7 +91,7 @@ mx.symbol.chain <- function(symbol) {
 mx.symbol.arguments <- function(symbol) {
   args <- character(0)
   for (node in mx.symbol.chain(symbol)) {
-    if (node$op == "fc") {
+    if (node$op %in% c("fc", "conv")) {
       args <- c(args, paste0(node$name, "_weight"))
       if (!isTRUE(node$no_bias)) args <- c(args, paste0(node$name, "_bias"))
     }
@@ -77,9 +99,14 @@ mx.symbol.arguments <- function(symbol) {
   args
 }
 
-#' Initialize parameters for a symbol given the input feature count.
-#' initializer: a function(shape) -> R array, or a numeric scale for
-#' uniform(-scale, scale) (reference mx.init.uniform).
+#' Spatial output size of a conv/pool window along one axis.
+.mx.out.dim <- function(n, k, s, p) (n + 2L * p - k) %/% s + 1L
+
+#' Initialize parameters for a symbol given the per-sample input shape:
+#' a scalar feature count for MLP chains, or c(C, H, W) for chains that
+#' start with Convolution/Pooling (required there — conv weights need the
+#' channel count). initializer: a function(shape) -> R array, or a
+#' numeric scale for uniform(-scale, scale) (reference mx.init.uniform).
 mx.model.init.params <- function(symbol, in_features, initializer = 0.07) {
   init_fn <- if (is.function(initializer)) {
     initializer
@@ -89,16 +116,38 @@ mx.model.init.params <- function(symbol, in_features, initializer = 0.07) {
                           dim = shape)
   }
   params <- list()
-  features <- in_features
+  # `shape` tracks per-sample dims: a scalar feature count after fc/
+  # flatten, c(C, H, W) through conv/pool stages
+  shape <- in_features
   for (node in mx.symbol.chain(symbol)) {
     if (node$op == "fc") {
-      w <- init_fn(c(node$num_hidden, features))
+      w <- init_fn(c(node$num_hidden, prod(shape)))
       params[[paste0(node$name, "_weight")]] <- mx.nd.array(w)
       if (!isTRUE(node$no_bias)) {
         params[[paste0(node$name, "_bias")]] <-
           mx.nd.array(array(0, dim = node$num_hidden))
       }
-      features <- node$num_hidden
+      shape <- node$num_hidden
+    } else if (node$op == "conv") {
+      stopifnot(length(shape) == 3L)
+      w <- init_fn(c(node$num_filter, shape[1], node$kernel))
+      params[[paste0(node$name, "_weight")]] <- mx.nd.array(w)
+      params[[paste0(node$name, "_bias")]] <-
+        mx.nd.array(array(0, dim = node$num_filter))
+      shape <- c(node$num_filter,
+                 .mx.out.dim(shape[2], node$kernel[1], node$stride[1],
+                             node$pad[1]),
+                 .mx.out.dim(shape[3], node$kernel[2], node$stride[2],
+                             node$pad[2]))
+    } else if (node$op == "pool") {
+      stopifnot(length(shape) == 3L)
+      shape <- c(shape[1],
+                 .mx.out.dim(shape[2], node$kernel[1], node$stride[1],
+                             node$pad[1]),
+                 .mx.out.dim(shape[3], node$kernel[2], node$stride[2],
+                             node$pad[2]))
+    } else if (node$op == "flatten") {
+      shape <- prod(shape)
     }
   }
   params
@@ -117,6 +166,15 @@ mx.symbol.forward <- function(symbol, params, data) {
         else params[[paste0(node$name, "_bias")]],
         num_hidden = node$num_hidden, no_bias = isTRUE(node$no_bias)),
       act = mx.nd.Activation(h, act_type = node$act_type),
+      conv = mx.nd.Convolution(
+        h, params[[paste0(node$name, "_weight")]],
+        params[[paste0(node$name, "_bias")]],
+        kernel = node$kernel, num_filter = node$num_filter,
+        stride = node$stride, pad = node$pad),
+      pool = mx.nd.Pooling(h, kernel = node$kernel,
+                           pool_type = node$pool_type,
+                           stride = node$stride, pad = node$pad),
+      flatten = mx.nd.Flatten(h),
       softmax_output = h,   # loss/softmax applied by the trainer/predictor
       linreg_output = h,
       stop("unsupported symbol op: ", node$op))
@@ -129,12 +187,22 @@ mx.model.head <- function(symbol) {
   chain[[length(chain)]]$op
 }
 
+#' Row-subset a sample-major array of any rank (rows = samples).
+.mx.take.rows <- function(X, idx) {
+  d <- dim(X)
+  if (is.null(d) || length(d) <= 2L) return(X[idx, , drop = FALSE])
+  args <- c(list(X, idx), rep(list(quote(expr = )), length(d) - 1L),
+            list(drop = FALSE))
+  do.call(`[`, args)
+}
+
 #' Train a feed-forward model (reference mx.model.FeedForward.create,
 #' R-package/R/model.R:470 — same user contract, imperative engine).
 #'
-#' X: numeric matrix, one sample per ROW (n x d). y: numeric vector of
-#' 0-based class ids (softmax head) or regression targets (linreg head).
-#' eval.data: optional list(data = matrix, label = vector).
+#' X: samples along dim 1 — an n x d matrix for MLPs, or an
+#' n x C x H x W array for conv nets (NCHW). y: numeric vector of 0-based
+#' class ids (softmax head) or regression targets (linreg head).
+#' eval.data: optional list(data = matrix/array, label = vector).
 #' Returns class "MXFeedForwardModel" usable with predict().
 mx.model.FeedForward.create <- function(symbol, X, y,
                                         num.round = 10,
@@ -147,10 +215,11 @@ mx.model.FeedForward.create <- function(symbol, X, y,
                                         verbose = TRUE,
                                         epoch.end.callback = NULL) {
   stopifnot(is.mx.symbol(symbol), is.matrix(X) || is.array(X))
-  n <- nrow(X)
+  n <- dim(X)[1]
   stopifnot(length(y) == n)
   head <- mx.model.head(symbol)
-  params <- mx.model.init.params(symbol, ncol(X), initializer)
+  in_shape <- dim(X)[-1]  # per-sample dims: d, or c(C, H, W)
+  params <- mx.model.init.params(symbol, in_shape, initializer)
   momentum_state <- NULL
   if (momentum > 0) {
     momentum_state <- lapply(params, function(p) {
@@ -163,7 +232,7 @@ mx.model.FeedForward.create <- function(symbol, X, y,
     nb <- 0L
     for (start in seq(1L, n, by = array.batch.size)) {
       take <- idx[start:min(start + array.batch.size - 1L, n)]
-      xb <- mx.nd.array(X[take, , drop = FALSE])
+      xb <- mx.nd.array(.mx.take.rows(X, take))
       yb <- mx.nd.array(as.numeric(y[take]))
       for (p in names(params)) mx.attach.grad(params[[p]])
       mx.autograd.record()

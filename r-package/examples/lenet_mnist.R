@@ -1,0 +1,57 @@
+# Train a LeNet-style conv net on MNIST-shaped data from R (reference
+# role: R-package vignettes' mx.symbol.Convolution LeNet example over
+# mx.model.FeedForward.create).
+#
+# Uses synthetic 28x28 single-channel data (localized class blobs so the
+# convolutions do real work) — no dataset download needed; the script
+# always exercises the conv/pool/flatten path end-to-end. See
+# mnist_mlp.R for the real-MNIST loading pattern.
+#
+# Run (package installed, PYTHONPATH at the repo root):
+#   Rscript examples/lenet_mnist.R
+library(mxtpu)
+mx.init()
+
+set.seed(7)
+k <- 5
+n <- 600
+# k class prototypes with localized blobs so convolutions matter
+protos <- array(0, dim = c(k, 1, 28, 28))
+for (c in 1:k) {
+  cx <- 5 + 4 * c
+  protos[c, 1, (cx - 3):(cx + 3), (cx - 3):(cx + 3)] <- 1
+}
+y <- sample(0:(k - 1), n, replace = TRUE)
+X <- protos[y + 1, , , , drop = FALSE] +
+  array(rnorm(n * 28 * 28, sd = 0.3), dim = c(n, 1, 28, 28))
+dim(X) <- c(n, 1, 28, 28)
+yv <- sample(0:(k - 1), 150, replace = TRUE)
+Xv <- protos[yv + 1, , , , drop = FALSE] +
+  array(rnorm(150 * 28 * 28, sd = 0.3), dim = c(150, 1, 28, 28))
+dim(Xv) <- c(150, 1, 28, 28)
+
+data <- mx.symbol.Variable("data")
+c1 <- mx.symbol.Convolution(data, kernel = c(5, 5), num_filter = 8,
+                            name = "conv1")
+a1 <- mx.symbol.Activation(c1, act_type = "relu")
+p1 <- mx.symbol.Pooling(a1, kernel = c(2, 2), pool_type = "max")
+c2 <- mx.symbol.Convolution(p1, kernel = c(3, 3), num_filter = 16,
+                            name = "conv2")
+a2 <- mx.symbol.Activation(c2, act_type = "relu")
+p2 <- mx.symbol.Pooling(a2, kernel = c(2, 2), pool_type = "max")
+fl <- mx.symbol.Flatten(p2)
+fc1 <- mx.symbol.FullyConnected(fl, num_hidden = 64, name = "fc1")
+a3 <- mx.symbol.Activation(fc1, act_type = "relu")
+fc2 <- mx.symbol.FullyConnected(a3, num_hidden = k, name = "fc2")
+lenet <- mx.symbol.SoftmaxOutput(fc2, name = "sm")
+
+model <- mx.model.FeedForward.create(
+  lenet, X, y,
+  num.round = 2, array.batch.size = 100,
+  learning.rate = 0.05, momentum = 0.9,
+  eval.data = list(data = Xv, label = yv))
+
+acc <- mx.model.accuracy(model, Xv, yv)
+cat(sprintf("final validation accuracy: %.3f\n", acc))
+stopifnot(acc > 0.7)
+cat("R LeNet training OK\n")

@@ -85,6 +85,8 @@ def test_r_model_api_surface():
     src = _read(RPKG, "R", "model.R")
     for fn in ("mx.model.FeedForward.create", "mx.symbol.Variable",
                "mx.symbol.FullyConnected", "mx.symbol.Activation",
+               "mx.symbol.Convolution", "mx.symbol.Pooling",
+               "mx.symbol.Flatten",
                "mx.symbol.SoftmaxOutput", "mx.model.init.params",
                "predict.MXFeedForwardModel", "mx.model.save",
                "mx.model.load", "mx.model.accuracy"):
@@ -254,6 +256,12 @@ def test_r_binding_builds_and_smokes(tmp_path):
         capture_output=True, text=True, timeout=900, env=env)
     assert run.returncode == 0, (run.stdout[-800:], run.stderr[-1500:])
     assert "R MLP training OK" in run.stdout
+    # conv path: LeNet through mx.symbol.Convolution/Pooling/Flatten
+    run = subprocess.run(
+        ["Rscript", os.path.join(RPKG, "examples", "lenet_mnist.R")],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert run.returncode == 0, (run.stdout[-800:], run.stderr[-1500:])
+    assert "R LeNet training OK" in run.stdout
 
 
 def test_r_c_glue_compiles_headerless(tmp_path):
