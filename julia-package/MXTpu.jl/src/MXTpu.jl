@@ -19,7 +19,7 @@ export init, NDArray, to_array, invoke, attach_grad, backward, grad,
        # idiomatic surface (ndarray_ops.jl / model.jl)
        op, attrs_json, matmul, relu, sigmoid, softmax, mean_nd, argmax_nd,
        zeros_like, ones_like,
-       Dense, Chain, forward, params, fit!, predict, accuracy
+       Dense, Conv2D, Chain, forward, params, fit!, predict, accuracy
 
 const _lib = Ref{String}("")
 

@@ -20,28 +20,85 @@ end
 Dense(num_hidden::Int; act::Symbol = :identity, scale::Float64 = 0.07) =
     Dense(num_hidden, act, nothing, nothing, scale)
 
-"""An ordered container of layers (reference chain/FeedForward shape)."""
-struct Chain
-    layers::Vector{Dense}
+"""2-D convolution layer (NCHW) with optional max-pool and activation —
+the conv building block of the reference julia/src symbol API, in layer
+form. `in_shape` tracking happens at fit!/materialize time."""
+mutable struct Conv2D
+    kernel::NTuple{2,Int}
+    num_filter::Int
+    act::Symbol
+    pool::Union{NTuple{2,Int},Nothing}
+    weight::Union{NDArray,Nothing}
+    bias::Union{NDArray,Nothing}
+    scale::Float64
 end
 
-Chain(layers::Dense...) = Chain(collect(layers))
+Conv2D(kernel::NTuple{2,Int}, num_filter::Int; act::Symbol = :relu,
+       pool::Union{NTuple{2,Int},Nothing} = nothing,
+       scale::Float64 = 0.07) =
+    Conv2D(kernel, num_filter, act, pool, nothing, nothing, scale)
 
-function _materialize!(layer::Dense, in_features::Int)
+const Layer = Union{Dense,Conv2D}
+
+"""An ordered container of layers (reference chain/FeedForward shape)."""
+struct Chain
+    layers::Vector{Layer}
+end
+
+Chain(layers::Layer...) = Chain(collect(Layer, layers))
+
+_uniform(dims, scale) =
+    NDArray((rand(Float32, dims...) .- 0.5f0) .* Float32(2 * scale))
+
+"""Materialize params given the incoming per-sample shape (an Int feature
+count, or (C, H, W) for conv input); returns the outgoing shape."""
+function _materialize!(layer::Dense, in_shape)
+    feat = prod(in_shape)
     if layer.weight === nothing
-        w = (rand(Float32, layer.num_hidden, in_features) .- 0.5f0) .*
-            Float32(2 * layer.scale)
-        layer.weight = NDArray(w)
+        layer.weight = _uniform((layer.num_hidden, feat), layer.scale)
         layer.bias = NDArray(zeros(Float32, layer.num_hidden))
     end
     return layer.num_hidden
 end
 
+function _materialize!(layer::Conv2D, in_shape)
+    length(in_shape) == 3 ||
+        error("Conv2D needs a (C, H, W) input shape, got $in_shape")
+    c, h, w = in_shape
+    if layer.weight === nothing
+        layer.weight = _uniform(
+            (layer.num_filter, c, layer.kernel...), layer.scale)
+        layer.bias = NDArray(zeros(Float32, layer.num_filter))
+    end
+    oh = h - layer.kernel[1] + 1
+    ow = w - layer.kernel[2] + 1
+    if layer.pool !== nothing
+        oh = div(oh, layer.pool[1])
+        ow = div(ow, layer.pool[2])
+    end
+    return (layer.num_filter, oh, ow)
+end
+
+function _activate(h::NDArray, act::Symbol)
+    act === :relu && return relu(h)
+    act === :sigmoid && return sigmoid(h)
+    return h
+end
+
 function _forward(layer::Dense, x::NDArray)
     h = op("FullyConnected", x, layer.weight, layer.bias;
            num_hidden = layer.num_hidden)
-    layer.act === :relu && return relu(h)
-    layer.act === :sigmoid && return sigmoid(h)
+    return _activate(h, layer.act)
+end
+
+function _forward(layer::Conv2D, x::NDArray)
+    h = op("Convolution", x, layer.weight, layer.bias;
+           kernel = layer.kernel, num_filter = layer.num_filter)
+    h = _activate(h, layer.act)
+    if layer.pool !== nothing
+        h = op("Pooling", h; kernel = layer.pool, pool_type = "max",
+               stride = layer.pool)
+    end
     return h
 end
 
@@ -56,18 +113,22 @@ end
 params(model::Chain) = NDArray[p for l in model.layers
                                for p in (l.weight, l.bias) if p !== nothing]
 
-"""Train `model` on rows of X (n x d) against 0-based integer labels y
-with softmax cross-entropy + SGD(momentum) — the reference `mx.fit`
-contract as a mutating Julia function. Returns per-epoch mean losses."""
-function fit!(model::Chain, X::AbstractMatrix, y::AbstractVector;
+_rows(X, take) = X[take, ntuple(_ -> Colon(), ndims(X) - 1)...]
+
+"""Train `model` against 0-based integer labels y with softmax
+cross-entropy + SGD(momentum) — the reference `mx.fit` contract as a
+mutating Julia function. X has samples along dim 1: an n x d matrix for
+MLPs, or an n x C x H x W array for Conv2D chains (NCHW). Returns
+per-epoch mean losses."""
+function fit!(model::Chain, X::AbstractArray, y::AbstractVector;
               epochs::Int = 10, batch_size::Int = 100,
               lr::Float64 = 0.01, momentum::Float64 = 0.0,
               wd::Float64 = 0.0, verbose::Bool = true)
-    n, d = size(X)
+    n = size(X, 1)
     length(y) == n || error("X rows != length(y)")
-    feat = d
+    shape = ndims(X) == 2 ? size(X, 2) : size(X)[2:end]
     for layer in model.layers
-        feat = _materialize!(layer, feat)
+        shape = _materialize!(layer, shape)
     end
     moms = momentum > 0 ?
         Dict{UInt,NDArray}(objectid(p) => zeros_like(p)
@@ -79,7 +140,7 @@ function fit!(model::Chain, X::AbstractMatrix, y::AbstractVector;
         nb = 0
         for start in 1:batch_size:n
             take = order[start:min(start + batch_size - 1, n)]
-            xb = NDArray(Float32.(X[take, :]))
+            xb = NDArray(Float32.(_rows(X, take)))
             yb = NDArray(Float32.(y[take]))
             ps = params(model)
             for p in ps
@@ -135,12 +196,12 @@ function randperm_stable(n::Int)
 end
 
 """Class probabilities (n x k), rows = samples."""
-function predict(model::Chain, X::AbstractMatrix)
+function predict(model::Chain, X::AbstractArray)
     out = forward(model, NDArray(Float32.(X)))
     return to_array(softmax(out))
 end
 
-function accuracy(model::Chain, X::AbstractMatrix, y::AbstractVector)
+function accuracy(model::Chain, X::AbstractArray, y::AbstractVector)
     prob = predict(model, X)
     pred = [argmax(prob[i, :]) - 1 for i in 1:size(prob, 1)]
     return sum(pred .== Int.(y)) / length(y)

@@ -64,3 +64,22 @@ losses = MXTpu.fit!(model, Xs, ys; epochs = 8, batch_size = 50,
 acc = MXTpu.accuracy(model, Xs, ys)
 @test acc > 0.9
 println("Julia fit OK (acc=$(round(acc; digits=3)))")
+
+# --- Conv2D chain: a tiny conv net separates localized blob classes ------
+nc = 3
+imgs = zeros(Float32, 120, 1, 12, 12)
+yc = [i % nc for i in 0:119]
+for (i, cls) in enumerate(yc)
+    r = 2 + 3 * cls
+    imgs[i, 1, r:r+2, r:r+2] .= 1f0
+end
+imgs .+= 0.1f0 .* reshape(randn_stable(1, length(imgs), 99), size(imgs))
+cmodel = MXTpu.Chain(
+    MXTpu.Conv2D((3, 3), 4; act = :relu, pool = (2, 2)),
+    MXTpu.Dense(nc))
+closs = MXTpu.fit!(cmodel, imgs, yc; epochs = 6, batch_size = 40,
+                   lr = 0.1, momentum = 0.9, verbose = false)
+@test closs[end] < closs[1]
+cacc = MXTpu.accuracy(cmodel, imgs, yc)
+@test cacc > 0.85
+println("Julia conv fit OK (acc=$(round(cacc; digits=3)))")

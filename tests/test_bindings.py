@@ -448,7 +448,8 @@ def test_julia_model_api_surface():
                      r"Base\.:-\(a::NDArray, b::NDArray\)"):
         assert re.search(overload, ops_src), f"missing overload {overload}"
     model_src = srcs["model.jl"]
-    for fn in ("function fit!", "struct Dense", "struct Chain",
+    for fn in ("function fit!", "struct Dense", "struct Conv2D",
+               "struct Chain",
                "function predict", "function accuracy"):
         assert fn in model_src, f"model.jl missing {fn}"
     # exports match definitions
