@@ -63,14 +63,30 @@ def test_convolution_vs_naive():
 
 
 def test_convolution_grouped_dilated():
-    x = nd.array(np.random.randn(1, 4, 8, 8).astype("float32"))
-    w = nd.array(np.random.randn(8, 2, 3, 3).astype("float32"))
-    out = nd.Convolution(x, w, no_bias=True, kernel=(3, 3), num_filter=8, num_group=2,
+    """Grouped / strided / dilated convs match torch conv2d numerically
+    (not just in shape)."""
+    import torch
+
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 4, 8, 8).astype("float32")
+    w = rng.randn(8, 2, 3, 3).astype("float32")
+    out = nd.Convolution(nd.array(x), nd.array(w), no_bias=True,
+                         kernel=(3, 3), num_filter=8, num_group=2,
                          pad=(1, 1), stride=(2, 2))
     assert out.shape == (1, 8, 4, 4)
-    out2 = nd.Convolution(x, nd.array(np.random.randn(8, 4, 3, 3).astype("float32")),
-                          no_bias=True, kernel=(3, 3), num_filter=8, dilate=(2, 2))
+    ref = torch.nn.functional.conv2d(
+        torch.from_numpy(x), torch.from_numpy(w), stride=2, padding=1,
+        groups=2).numpy()
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=2e-4, atol=2e-4)
+
+    w2 = rng.randn(8, 4, 3, 3).astype("float32")
+    out2 = nd.Convolution(nd.array(x), nd.array(w2),
+                          no_bias=True, kernel=(3, 3), num_filter=8,
+                          dilate=(2, 2))
     assert out2.shape == (1, 8, 4, 4)
+    ref2 = torch.nn.functional.conv2d(
+        torch.from_numpy(x), torch.from_numpy(w2), dilation=2).numpy()
+    np.testing.assert_allclose(out2.asnumpy(), ref2, rtol=2e-4, atol=2e-4)
 
 
 def test_deconvolution_shape():
@@ -87,6 +103,61 @@ def test_deconvolution_shape():
     deconv_y = nd.Deconvolution(nd.array(y), nd.array(wc.transpose(0, 1, 2, 3)), no_bias=True,
                                 kernel=(3, 3), num_filter=4).asnumpy()
     assert_almost_equal(np.sum(conv_x * y), np.sum(xc * deconv_y), rtol=1e-3)
+
+
+def test_deconvolution_matches_torch_conv_transpose():
+    """Deconvolution == torch conv_transpose2d across stride/pad/adj/
+    groups (the reference's cuDNN-backed semantics; weight layout
+    (in_c, out_c/group, kh, kw) both sides, adj == output_padding)."""
+    import torch
+
+    rng = np.random.RandomState(0)
+    cases = [
+        # (in_c, out_c, k, stride, pad, adj, groups, h, w)
+        (4, 6, 3, 1, 0, 0, 1, 7, 7),
+        (4, 6, 3, 2, 1, 1, 1, 6, 5),
+        (4, 8, 4, 2, 1, 0, 2, 5, 6),
+        (6, 6, 2, 3, 0, 2, 3, 4, 4),
+    ]
+    for in_c, out_c, k, s, p, a, g, h, w in cases:
+        x = rng.randn(2, in_c, h, w).astype("float32")
+        wgt = rng.randn(in_c, out_c // g, k, k).astype("float32")
+        b = rng.randn(out_c).astype("float32")
+        out = nd.Deconvolution(
+            nd.array(x), nd.array(wgt), nd.array(b), no_bias=False,
+            kernel=(k, k), num_filter=out_c, stride=(s, s), pad=(p, p),
+            adj=(a, a), num_group=g).asnumpy()
+        ref = torch.nn.functional.conv_transpose2d(
+            torch.from_numpy(x), torch.from_numpy(wgt),
+            torch.from_numpy(b), stride=s, padding=p, output_padding=a,
+            groups=g).numpy()
+        assert out.shape == ref.shape, (out.shape, ref.shape)
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_deconvolution_gradients_match_torch():
+    """Deconvolution backward (data + weight grads) == torch autograd."""
+    import torch
+
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3, 6, 6).astype("float32")
+    wgt = rng.randn(3, 5, 3, 3).astype("float32")
+    xa, wa = nd.array(x), nd.array(wgt)
+    xa.attach_grad()
+    wa.attach_grad()
+    with mx.autograd.record():
+        out = nd.Deconvolution(xa, wa, no_bias=True, kernel=(3, 3),
+                               num_filter=5, stride=(2, 2), pad=(1, 1))
+        loss = (out * out).sum()
+    loss.backward()
+    xt = torch.from_numpy(x).requires_grad_(True)
+    wt = torch.from_numpy(wgt).requires_grad_(True)
+    ot = torch.nn.functional.conv_transpose2d(xt, wt, stride=2, padding=1)
+    (ot * ot).sum().backward()
+    np.testing.assert_allclose(xa.grad.asnumpy(), xt.grad.numpy(),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(wa.grad.asnumpy(), wt.grad.numpy(),
+                               rtol=1e-3, atol=1e-3)
 
 
 def test_pooling():
@@ -126,6 +197,42 @@ def test_batchnorm():
     assert_almost_equal(o, ref, rtol=1e-3, atol=1e-4)
     assert_almost_equal(mmv.asnumpy(), 0.9 * mm + 0.1 * mean, rtol=1e-4)
     assert_almost_equal(mvv.asnumpy(), 0.9 * mv + 0.1 * var, rtol=1e-4)
+
+
+def test_batchnorm_gradients_match_torch():
+    """Training-mode BatchNorm backward (data/gamma/beta grads, i.e. the
+    gradient THROUGH the batch statistics) == torch.nn.functional.
+    batch_norm autograd."""
+    import torch
+
+    rng = np.random.RandomState(5)
+    x = rng.randn(6, 3, 4, 4).astype("float32")
+    gamma = (rng.rand(3) + 0.5).astype("float32")
+    beta = rng.randn(3).astype("float32")
+    head = rng.randn(6, 3, 4, 4).astype("float32")  # non-trivial cotangent
+
+    xa, ga, ba = nd.array(x), nd.array(gamma), nd.array(beta)
+    for a in (xa, ga, ba):
+        a.attach_grad()
+    mmv, mvv = nd.array(np.zeros(3, "f4")), nd.array(np.ones(3, "f4"))
+    with autograd.record():
+        out = nd.BatchNorm(xa, ga, ba, mmv, mvv, fix_gamma=False, eps=1e-5)
+        loss = (out * nd.array(head)).sum()
+    loss.backward()
+
+    xt = torch.from_numpy(x).requires_grad_(True)
+    gt = torch.from_numpy(gamma).requires_grad_(True)
+    bt = torch.from_numpy(beta).requires_grad_(True)
+    ot = torch.nn.functional.batch_norm(
+        xt, torch.zeros(3), torch.ones(3), gt, bt, training=True,
+        momentum=0.1, eps=1e-5)
+    (ot * torch.from_numpy(head)).sum().backward()
+    np.testing.assert_allclose(xa.grad.asnumpy(), xt.grad.numpy(),
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(ga.grad.asnumpy(), gt.grad.numpy(),
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(ba.grad.asnumpy(), bt.grad.numpy(),
+                               rtol=1e-3, atol=1e-4)
 
 
 def test_layernorm():
@@ -411,6 +518,39 @@ def test_ctc_loss():
     loss = nd.CTCLoss(nd.array(x), nd.array(labels))
     assert loss.shape == (B,)
     assert (loss.asnumpy() > 0).all()
+
+
+def test_ctc_loss_matches_torch():
+    """CTCLoss == torch ctc_loss under matching conventions: data (T,B,C)
+    raw activations (both apply log_softmax internally), blank_label=
+    'first' => blank id 0 with 1-based class labels and 0-padding."""
+    import torch
+
+    rng = np.random.RandomState(4)
+    T, B, C = 12, 3, 6
+    x = rng.randn(T, B, C).astype("float32")
+    labels = np.array([[1, 2, 3, 0], [2, 2, 0, 0], [5, 4, 3, 2]],
+                      dtype="float32")
+    label_lens = np.array([3, 2, 4])
+    loss = nd.CTCLoss(nd.array(x), nd.array(labels)).asnumpy()
+    ref = torch.nn.functional.ctc_loss(
+        torch.from_numpy(x).log_softmax(-1),
+        torch.from_numpy(labels.astype(np.int64)),
+        input_lengths=torch.full((B,), T, dtype=torch.long),
+        target_lengths=torch.from_numpy(label_lens),
+        blank=0, reduction="none").numpy()
+    np.testing.assert_allclose(loss, ref, rtol=1e-4, atol=1e-4)
+    # variable input lengths via use_data_lengths
+    dlen = np.array([8, 12, 10], dtype="float32")
+    loss2 = nd.CTCLoss(nd.array(x), nd.array(labels), nd.array(dlen),
+                       use_data_lengths=True).asnumpy()
+    ref2 = torch.nn.functional.ctc_loss(
+        torch.from_numpy(x).log_softmax(-1),
+        torch.from_numpy(labels.astype(np.int64)),
+        input_lengths=torch.from_numpy(dlen.astype(np.int64)),
+        target_lengths=torch.from_numpy(label_lens),
+        blank=0, reduction="none").numpy()
+    np.testing.assert_allclose(loss2, ref2, rtol=1e-4, atol=1e-4)
 
 
 def test_pick_gather_scatter():
