@@ -47,6 +47,11 @@ run_nightly() {
     MXTPU_NIGHTLY=1 python -m pytest tests/test_large_array.py \
         tests/test_checkpoint_compat.py -q
     MXTPU_NIGHTLY=1 python -m pytest tests/test_dist.py -q -k seven
+    # the armed bench configuration (bf16 + on-device init + scan) must
+    # execute end-to-end so a broken measurement path can't wait for a
+    # live chip window to surface
+    MXTPU_NIGHTLY=1 python -m pytest \
+        tests/test_bench.py::test_bench_child_bf16_scan_executes -q
 }
 
 case "$tier" in
