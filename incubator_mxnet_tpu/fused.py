@@ -58,7 +58,7 @@ class GluonTrainStep:
 
     def __init__(self, net, loss_fn, optimizer, mesh=None, batch_axis=0, device=None,
                  init_on_device=False, compute_dtype=None,
-                 shard_optimizer_states=False):
+                 shard_optimizer_states=False, remat=False):
         self.net = net
         self.loss_fn = loss_fn
         self.opt = optimizer
@@ -83,6 +83,14 @@ class GluonTrainStep:
         # the MXU at full rate, while gradients and updates are f32.
         # Contrast with net.cast("bfloat16"), which trains pure-bf16.
         self.compute_dtype = jnp.dtype(compute_dtype) if compute_dtype else None
+        # rematerialization (jax.checkpoint over the whole forward): the
+        # backward recomputes activations instead of keeping them in HBM —
+        # the TPU-native form of the reference's MXNET_BACKWARD_DO_MIRROR /
+        # memonger (ref: docs/faq/env_var.md, example memonger usage).
+        # Trades ~1/3 more FLOPs for activation memory, buying larger
+        # batches on memory-bound models. Numerics are identical (same
+        # ops, same order, recomputed).
+        self.remat = bool(remat)
         # ZeRO-1 analog: keep optimizer states sharded over the dp mesh
         # axis (see _build's mesh branch)
         self.shard_optimizer_states = shard_optimizer_states
@@ -331,6 +339,20 @@ class GluonTrainStep:
             }
             return loss_data, aux_new
 
+        forward_scan = forward
+        if self.remat:
+            # recompute the forward during backward instead of saving
+            # activations (identical numerics, ~1/3 more FLOPs, far less
+            # HBM) — applied to the WHOLE net forward; XLA still fuses
+            # inside each recomputation. The accum scan body gets the
+            # barrier-free variant (prevent_cse=False is documented safe
+            # under scan and avoids optimization-barrier ops); `step`
+            # keeps the default because the same function is jitted
+            # standalone (scan_steps reuses step inside its scan, where
+            # the barrier is merely conservative).
+            forward_scan = jax.checkpoint(forward, prevent_cse=False)
+            forward = jax.checkpoint(forward)
+
         def step(params, states, x, y, key, lr, t):
             grad_params = [d for d, m in zip(params, self.grad_mask) if m]
             other_params = {
@@ -375,7 +397,8 @@ class GluonTrainStep:
                 others, gsum, lsum = carry
                 x, y, key = inp
                 (loss, aux_new), grads = jax.value_and_grad(
-                    forward, has_aux=True)(grad_params, others, x, y, key)
+                    forward_scan, has_aux=True)(grad_params, others, x, y,
+                                                key)
                 others = {**others, **aux_new}
                 gsum = [a + g for a, g in zip(gsum, grads)]
                 return (others, gsum, lsum + loss.astype(lsum.dtype)), None

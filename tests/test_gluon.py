@@ -839,3 +839,51 @@ def test_poisson_nll_scalar_reduction_and_frozen_groupnorm():
         loss = (gn(x) ** 2).sum()
     loss.backward()
     assert gn.gamma.grad_req == "null" and gn.beta.grad_req == "null"
+
+
+def test_remat_step_matches_plain():
+    """GluonTrainStep(remat=True) — jax.checkpoint over the forward (the
+    reference's MXNET_BACKWARD_DO_MIRROR / memonger role, the TPU way) —
+    must produce the SAME losses and parameters as the plain step:
+    rematerialization changes memory/FLOPs, never numerics."""
+    from incubator_mxnet_tpu import fused
+
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(6, 3, 8, 8).astype(np.float32))
+    y = nd.array(rng.randint(0, 5, size=6).astype(np.float32))
+
+    def build(remat):
+        mx.random.seed(11)
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(4, 3, padding=1, activation="relu"))
+        net.add(nn.Flatten())
+        net.add(nn.Dense(5))
+        net.initialize(mx.init.Xavier())
+        net(x)  # materialize deferred params NOW, under the fresh seed
+        L = gluon.loss.SoftmaxCrossEntropyLoss()
+        opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+        return net, fused.GluonTrainStep(net, lambda n, x, y: L(n(x), y),
+                                         opt, remat=remat)
+    net_a, step_a = build(False)
+    net_b, step_b = build(True)
+    for _ in range(3):
+        la = float(step_a(x, y).asscalar())
+        lb = float(step_b(x, y).asscalar())
+        np.testing.assert_allclose(la, lb, rtol=1e-6)
+    step_a.sync_params()
+    step_b.sync_params()
+    for (_, pa), (_, pb) in zip(net_a.collect_params().items(),
+                                net_b.collect_params().items()):
+        np.testing.assert_allclose(pa.data().asnumpy(),
+                                   pb.data().asnumpy(), rtol=1e-6,
+                                   atol=1e-7)
+    # remat composes with scan bulking AND matches the plain scan
+    xs = nd.array(rng.rand(2, 6, 3, 8, 8).astype(np.float32))
+    ys = nd.array(rng.randint(0, 5, size=(2, 6)).astype(np.float32))
+    l_scan_b = step_b.scan_steps(xs, ys).asnumpy()
+    l_scan_a = step_a.scan_steps(xs, ys).asnumpy()
+    np.testing.assert_allclose(l_scan_b, l_scan_a, rtol=1e-6, atol=1e-7)
+    # and with accum_steps (which uses the barrier-free checkpoint)
+    a_acc = float(step_a.accum_steps(xs, ys).asscalar())
+    b_acc = float(step_b.accum_steps(xs, ys).asscalar())
+    np.testing.assert_allclose(a_acc, b_acc, rtol=1e-6)
