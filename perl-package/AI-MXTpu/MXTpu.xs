@@ -6,6 +6,7 @@
 #include "XSUB.h"
 
 #include "mxtpu_predict.h"
+#include "mxtpu.h"
 
 MODULE = AI::MXTpu  PACKAGE = AI::MXTpu  PREFIX = mxtpu_
 
@@ -139,3 +140,134 @@ mxtpu_xs_free(h)
     IV h
   CODE:
     MXTpuPredFree(INT2PTR(MXTpuPredictorHandle, h));
+
+# --- training surface over the .mxt ABI (include/mxtpu.h) -----------------
+
+IV
+mxtpu_xs_trainer_create(artifact, plugin)
+    const char* artifact
+    SV* plugin
+  CODE:
+    {
+      MXTpuTrainerHandle h = NULL;
+      const char* p = SvOK(plugin) ? SvPV_nolen(plugin) : NULL;
+      if (MXTpuTrainerCreate(artifact, p, &h) != 0)
+        croak("%s", MXTpuLastError());
+      RETVAL = PTR2IV(h);
+    }
+  OUTPUT: RETVAL
+
+void
+mxtpu_xs_trainer_set_input(h, name, packed)
+    IV h
+    const char* name
+    SV* packed
+  CODE:
+    {
+      STRLEN len;
+      const char* buf = SvPV(packed, len);
+      if (MXTpuTrainerSetInput(INT2PTR(MXTpuTrainerHandle, h), name,
+                               buf, (size_t) len) != 0)
+        croak("%s", MXTpuLastError());
+    }
+
+double
+mxtpu_xs_trainer_step(h)
+    IV h
+  CODE:
+    {
+      float loss = 0.0f;
+      if (MXTpuTrainerStep(INT2PTR(MXTpuTrainerHandle, h), &loss) != 0)
+        croak("%s", MXTpuLastError());
+      RETVAL = (double) loss;
+    }
+  OUTPUT: RETVAL
+
+void
+mxtpu_xs_trainer_set_lr(h, lr)
+    IV h
+    double lr
+  CODE:
+    if (MXTpuTrainerSetLearningRate(INT2PTR(MXTpuTrainerHandle, h),
+                                    (float) lr) != 0)
+      croak("%s", MXTpuLastError());
+
+SV*
+mxtpu_xs_trainer_get_state(h, name, nbytes)
+    IV h
+    const char* name
+    size_t nbytes
+  CODE:
+    {
+      SV* out = newSV(nbytes);
+      SvPOK_on(out);
+      if (MXTpuTrainerGetState(INT2PTR(MXTpuTrainerHandle, h), name,
+                               SvPVX(out), nbytes) != 0) {
+        SvREFCNT_dec(out);
+        croak("%s", MXTpuLastError());
+      }
+      SvCUR_set(out, nbytes);
+      RETVAL = out;
+    }
+  OUTPUT: RETVAL
+
+void
+mxtpu_xs_trainer_set_state(h, name, packed)
+    IV h
+    const char* name
+    SV* packed
+  CODE:
+    {
+      STRLEN len;
+      const char* buf = SvPV(packed, len);
+      if (MXTpuTrainerSetState(INT2PTR(MXTpuTrainerHandle, h), name,
+                               buf, (size_t) len) != 0)
+        croak("%s", MXTpuLastError());
+    }
+
+void
+mxtpu_xs_trainer_free(h)
+    IV h
+  CODE:
+    MXTpuTrainerFree(INT2PTR(MXTpuTrainerHandle, h));
+
+int
+mxtpu_xs_trainer_num_states(h)
+    IV h
+  CODE:
+    {
+      int n = 0;
+      if (MXTpuTrainerNumStates(INT2PTR(MXTpuTrainerHandle, h), &n) != 0)
+        croak("%s", MXTpuLastError());
+      RETVAL = n;
+    }
+  OUTPUT: RETVAL
+
+const char*
+mxtpu_xs_trainer_state_name(h, idx)
+    IV h
+    int idx
+  CODE:
+    {
+      const char* name = NULL;
+      if (MXTpuTrainerStateName(INT2PTR(MXTpuTrainerHandle, h), idx,
+                                &name) != 0)
+        croak("%s", MXTpuLastError());
+      RETVAL = name;
+    }
+  OUTPUT: RETVAL
+
+void
+mxtpu_xs_trainer_state_shape(h, idx)
+    IV h
+    int idx
+  PPCODE:
+    {
+      const int64_t* dims = NULL;
+      int nd = 0, i;
+      if (MXTpuTrainerStateShape(INT2PTR(MXTpuTrainerHandle, h), idx,
+                                 &dims, &nd) != 0)
+        croak("%s", MXTpuLastError());
+      EXTEND(SP, nd);
+      for (i = 0; i < nd; ++i) PUSHs(sv_2mortal(newSViv((IV) dims[i])));
+    }

@@ -37,3 +37,89 @@ sub get_output {
 sub DESTROY { xs_free($_[0]{h}) if $_[0]{h} }
 
 1;
+
+# --- training over the .mxt ABI (reference role: AI::MXNet's fit loop;
+# here the whole fwd/bwd/update step is one compiled program and Perl
+# only feeds batches) -----------------------------------------------------
+package AI::MXTpu::Trainer;
+use strict;
+use warnings;
+
+sub new {
+    my ($class, $artifact, $plugin) = @_;
+    my $h = AI::MXTpu::xs_trainer_create($artifact, $plugin);
+    return bless { h => $h }, $class;
+}
+
+sub set_input {
+    my ($self, $name, @floats) = @_;
+    AI::MXTpu::xs_trainer_set_input($self->{h}, $name, pack('f*', @floats));
+}
+
+sub step { AI::MXTpu::xs_trainer_step($_[0]{h}) }
+
+sub num_states  { AI::MXTpu::xs_trainer_num_states($_[0]{h}) }
+sub state_name  { AI::MXTpu::xs_trainer_state_name($_[0]{h}, $_[1]) }
+sub state_shape { [AI::MXTpu::xs_trainer_state_shape($_[0]{h}, $_[1])] }
+
+# all state names (param:*/opt:*), in artifact order
+sub state_names {
+    my ($self) = @_;
+    return [map { $self->state_name($_) } 0 .. $self->num_states - 1];
+}
+
+sub set_learning_rate {
+    AI::MXTpu::xs_trainer_set_lr($_[0]{h}, $_[1]);
+}
+
+# state tensors travel as float lists (param:NAME / opt:NAME, see
+# deploy.export_trainer). The element count comes from the artifact's own
+# shape metadata so the read is always exactly sized; an explicit $count
+# is accepted but clamped to the true size (an over-read would otherwise
+# return uninitialized bytes past what the runtime wrote).
+sub state_count {
+    my ($self, $name) = @_;
+    for my $i (0 .. $self->num_states - 1) {
+        next unless $self->state_name($i) eq $name;
+        my $n = 1;
+        $n *= $_ for @{ $self->state_shape($i) };
+        return $n;
+    }
+    die "unknown state $name";
+}
+
+sub get_state {
+    my ($self, $name, $count) = @_;
+    my $true = $self->state_count($name);
+    $count = $true if !defined($count) || $count > $true;
+    return [unpack('f*',
+        AI::MXTpu::xs_trainer_get_state($self->{h}, $name, 4 * $count))];
+}
+
+sub set_state {
+    my ($self, $name, @floats) = @_;
+    AI::MXTpu::xs_trainer_set_state($self->{h}, $name, pack('f*', @floats));
+}
+
+# fit(\@batches, epochs): each batch is [ \@x_floats, \@y_floats ];
+# returns per-epoch mean losses (the reference fit(train_iter) contract).
+sub fit {
+    my ($self, $batches, $epochs) = @_;
+    $epochs ||= 1;
+    die "fit: no batches" unless @$batches;
+    my @epoch_loss;
+    for my $e (1 .. $epochs) {
+        my $total = 0;
+        for my $b (@$batches) {
+            $self->set_input('x', @{ $b->[0] });
+            $self->set_input('y', @{ $b->[1] });
+            $total += $self->step;
+        }
+        push @epoch_loss, $total / scalar(@$batches);
+    }
+    return \@epoch_loss;
+}
+
+sub DESTROY { AI::MXTpu::xs_trainer_free($_[0]{h}) if $_[0]{h} }
+
+1;

@@ -28,3 +28,23 @@ def with_seed(seed=None):
         return wrapper
 
     return deco
+
+
+def build_perl_pkg(tmp_path, repo):
+    """Copy perl-package/AI-MXTpu to tmp and build it (perl Makefile.PL;
+    make). One shared recipe so the predict and trainer tests can't drift.
+    Returns the build dir and the env to run perl with."""
+    import os
+    import shutil
+    import subprocess
+
+    pkg = os.path.join(repo, "perl-package", "AI-MXTpu")
+    build = str(tmp_path / "perlbuild")
+    shutil.copytree(pkg, build)
+    env = dict(os.environ, MXTPU_REPO=repo)
+    for cmd in (["perl", "Makefile.PL"], ["make"]):
+        out = subprocess.run(cmd, cwd=build, env=env, capture_output=True,
+                             text=True, timeout=300)
+        assert out.returncode == 0, (cmd, out.stdout[-1500:],
+                                     out.stderr[-1500:])
+    return build, env

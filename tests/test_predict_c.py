@@ -198,17 +198,15 @@ def test_perl_binding_builds_and_introspects(artifact, tmp_path):
     if shutil.which("perl") is None or shutil.which("make") is None:
         pytest.skip("perl/make unavailable")
     prefix, _, _ = artifact
-    assert predict_lib() is not None  # lazy native build
+    # the XS module links BOTH native libs (predict + train surfaces);
+    # build them lazily before make links against them
+    from incubator_mxnet_tpu._native import train_lib
+
+    from common import build_perl_pkg
+
+    assert predict_lib() is not None and train_lib() is not None
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    pkg = os.path.join(repo, "perl-package", "AI-MXTpu")
-    build = str(tmp_path / "perlbuild")
-    shutil.copytree(pkg, build)
-    env = dict(os.environ, MXTPU_REPO=repo)
-    for cmd in (["perl", "Makefile.PL"], ["make"]):
-        out = subprocess.run(cmd, cwd=build, env=env, capture_output=True,
-                             text=True, timeout=300)
-        assert out.returncode == 0, (cmd, out.stdout[-1500:],
-                                     out.stderr[-1500:])
+    build, env = build_perl_pkg(tmp_path, repo)
     script = f'''
 use blib;
 use AI::MXTpu;
