@@ -213,9 +213,9 @@ def pooling(
             out = jnp.mean(data, axis=spatial, keepdims=True)
         return out
     k = _tup(kernel, nd)
-    s = _tup(stride, nd) if stride is not None else k if pooling_convention == "valid" else _tup(1, nd)
-    if stride is None:
-        s = k
+    # unset stride defaults to 1 per dim (ref: pooling.cc:46-57); the
+    # gluon layers default strides=pool_size themselves before calling
+    s = _tup(stride, nd) if stride is not None else _tup(1, nd)
 
     def _dims(vals, one=1):
         t = tuple(vals)
@@ -225,18 +225,37 @@ def pooling(
     window = _dims(k)
     strides = _dims(s)
     pads = [(pi, pi) for pi in p]
+    has_empty_window = False
     if pooling_convention == "full":
         # ceil-mode: pad high side enough that ceil-division windows fit
         for i in range(nd):
-            in_sz = data.shape[spatial[i]] + 2 * p[i]
+            dim = data.shape[spatial[i]]
+            in_sz = dim + 2 * p[i]
             rem = (in_sz - k[i]) % s[i]
             extra = (s[i] - rem) % s[i] if rem != 0 else 0
             pads[i] = (p[i], p[i] + extra)
+            # the last ceil window is EMPTY when its start (in padded
+            # coords) lies at/after the end of left-pad + input
+            n_out = 1 + (in_sz - k[i] + extra) // s[i]
+            if (n_out - 1) * s[i] >= p[i] + dim:
+                has_empty_window = True
     padding = ((0, 0),) + tuple(pads) + ((0, 0),) if channels_last \
         else ((0, 0), (0, 0)) + tuple(pads)
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
-        return lax.reduce_window(data, init, lax.max, window, strides, padding)
+        out = lax.reduce_window(data, init, lax.max, window, strides, padding)
+        if has_empty_window and jnp.issubdtype(data.dtype, jnp.floating):
+            # a ceil window fell entirely past the input; the reference
+            # leaves MinValue<DType> (the lowest FINITE value,
+            # pool.h:103) there, not -inf. Statically gated: the common
+            # evenly-dividing case pays nothing.
+            ones = jnp.ones_like(data)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides,
+                                    padding)
+            out = jnp.where(cnt > 0, out,
+                            jnp.asarray(jnp.finfo(data.dtype).min,
+                                        data.dtype))
+        return out
     summed = lax.reduce_window(data, 0.0, lax.add, window, strides, padding)
     if pool_type == "sum":
         return summed

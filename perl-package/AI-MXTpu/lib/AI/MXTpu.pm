@@ -73,10 +73,10 @@ sub set_learning_rate {
 }
 
 # state tensors travel as float lists (param:NAME / opt:NAME, see
-# deploy.export_trainer). The element count comes from the artifact's own
-# shape metadata so the read is always exactly sized; an explicit $count
-# is accepted but clamped to the true size (an over-read would otherwise
-# return uninitialized bytes past what the runtime wrote).
+# deploy.export_trainer). The element count always comes from the
+# artifact's own shape metadata: the C API copies exactly the full
+# tensor, so any caller-supplied count would either over-read
+# uninitialized bytes or fail the runtime's buffer-size check.
 sub state_count {
     my ($self, $name) = @_;
     for my $i (0 .. $self->num_states - 1) {
@@ -89,9 +89,8 @@ sub state_count {
 }
 
 sub get_state {
-    my ($self, $name, $count) = @_;
-    my $true = $self->state_count($name);
-    $count = $true if !defined($count) || $count > $true;
+    my ($self, $name) = @_;
+    my $count = $self->state_count($name);
     return [unpack('f*',
         AI::MXTpu::xs_trainer_get_state($self->{h}, $name, 4 * $count))];
 }

@@ -199,6 +199,41 @@ def test_batchnorm():
     assert_almost_equal(mvv.asnumpy(), 0.9 * mv + 0.1 * var, rtol=1e-4)
 
 
+def test_pooling_full_convention_matches_torch_ceil_mode():
+    """pooling_convention='full' uses the PURE ceil formula
+    1 + ceil((in + 2p - k)/s) (ref: pooling.cc:163-167) — torch's
+    ceil_mode additionally DROPS a window that starts entirely inside
+    the right padding, so the two agree except in exactly that corner.
+    Compare numerics against torch where the formulas coincide, and pin
+    the reference formula (not torch's) where they diverge."""
+    import math
+
+    import torch
+
+    rng = np.random.RandomState(6)
+    for h, w, k, s, p in [(7, 7, 3, 2, 0), (6, 5, 2, 2, 0),
+                          (9, 8, 3, 3, 1), (5, 5, 4, 3, 1)]:
+        x = rng.randn(2, 3, h, w).astype("float32")
+        out = nd.Pooling(nd.array(x), kernel=(k, k), stride=(s, s),
+                         pad=(p, p), pool_type="max",
+                         pooling_convention="full").asnumpy()
+        exp = tuple(1 + math.ceil((d + 2 * p - k) / s) for d in (h, w))
+        assert out.shape[2:] == exp, (h, w, k, s, p, out.shape, exp)
+        ref = torch.nn.functional.max_pool2d(
+            torch.from_numpy(x), k, stride=s, padding=p,
+            ceil_mode=True).numpy()
+        if out.shape == ref.shape:  # formulas coincide: exact numerics
+            np.testing.assert_allclose(out, ref, rtol=1e-6)
+        else:  # reference keeps the extra ceil window; prefix must match
+            oh, ow = ref.shape[2:]
+            np.testing.assert_allclose(out[:, :, :oh, :ow], ref, rtol=1e-6)
+            # the extra (empty) window holds the lowest FINITE value
+            # (reference pool.h MinValue), never -inf
+            tail = out[:, :, oh:, :].ravel().tolist() + \
+                out[:, :, :, ow:].ravel().tolist()
+            assert tail and all(v == np.finfo(np.float32).min for v in tail)
+
+
 def test_batchnorm_gradients_match_torch():
     """Training-mode BatchNorm backward (data/gamma/beta grads, i.e. the
     gradient THROUGH the batch statistics) == torch.nn.functional.

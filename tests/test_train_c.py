@@ -350,7 +350,7 @@ die "no param:*_weight state" unless $wname;
 my $shape = $t->state_shape(
     (grep {{ $t->state_name($_) eq $wname }} 0 .. $t->num_states - 1)[0]);
 my $count = 1; $count *= $_ for @$shape;
-my $w = $t->get_state($wname, $count);
+my $w = $t->get_state($wname);
 die "bad state size" unless scalar(@$w) == $count;
 my $nz = grep {{ abs($_) > 1e-8 }} @$w;
 die "state all zeros" unless $nz > 0;
