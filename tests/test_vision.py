@@ -256,6 +256,24 @@ def test_bilinear_resize_2d():
     np.testing.assert_allclose(out[0, 0, 0], np.linspace(0, 3, 7), atol=1e-6)
 
 
+def test_bilinear_resize_2d_matches_torch():
+    """BilinearResize2D == torch interpolate(mode='bilinear',
+    align_corners=True) — the reference convention
+    (contrib/bilinear_resize.cc) — for up- AND down-scaling."""
+    import torch
+
+    rng = np.random.RandomState(8)
+    for h, w, oh, ow in [(4, 4, 8, 8), (5, 7, 3, 4), (6, 5, 13, 9),
+                         (1, 6, 4, 11)]:
+        x = rng.rand(2, 3, h, w).astype("float32")
+        out = nd.contrib.BilinearResize2D(
+            nd.array(x), height=oh, width=ow).asnumpy()
+        ref = torch.nn.functional.interpolate(
+            torch.from_numpy(x), size=(oh, ow), mode="bilinear",
+            align_corners=True).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
 def test_roi_align_position_sensitive():
     ph = pw = 2
     c_out = 3
