@@ -605,7 +605,15 @@ def roi_align(data, rois, *, pooled_size, spatial_scale, sample_ratio=-1,
         xs = (x1 + (j + k[None, :]) * bin_w).reshape(-1)  # (pw*s,)
         yg = jnp.repeat(ys, pw * s)
         xg = jnp.tile(xs, ph * s)
+        # reference boundary semantics (roi_align.cc bilinear_interpolate):
+        # a sample beyond [-1, dim] is zero; within that margin it CLAMPS
+        # to the edge (continuous at the border), unlike plain zero-pad
+        h, w = data.shape[2], data.shape[3]
+        valid = ((yg >= -1.0) & (yg <= h) & (xg >= -1.0) & (xg <= w))
+        yg = jnp.clip(yg, 0.0, h - 1.0)
+        xg = jnp.clip(xg, 0.0, w - 1.0)
         v = _bilinear_gather(data[bidx], yg, xg)  # (C, ph*s*pw*s)
+        v = v * valid.astype(v.dtype)[None, :]
         v = v.reshape(v.shape[0], ph, s, pw, s)
         full = jnp.mean(v, axis=(2, 4))  # (C, ph, pw)
         if not position_sensitive:

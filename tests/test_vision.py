@@ -570,3 +570,66 @@ def test_correlation_displaced_matches_loop():
                                         * bp[0, :, y + 1 + dy,
                                              x + 1 + dx]).mean()
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def _roi_align_oracle(data, rois, scale, ph, pw, s, aligned):
+    """Numpy loop transcription of the reference ROIAlign kernel
+    (ref: contrib/roi_align.cc bilinear_interpolate + the bin loop):
+    samples at (i + (k+0.5)/s)*bin from the roi start; a sample beyond
+    [-1, dim] contributes 0, within that margin it clamps to the edge."""
+    _, C, H, W = data.shape
+    out = np.zeros((len(rois), C, ph, pw), "float32")
+
+    def bilin(img, y, x):
+        if y < -1.0 or y > H or x < -1.0 or x > W:
+            return np.zeros(img.shape[0], "float32")
+        y = min(max(y, 0.0), H - 1.0)
+        x = min(max(x, 0.0), W - 1.0)
+        y0, x0 = int(np.floor(y)), int(np.floor(x))
+        y1_, x1_ = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+        dy, dx = y - y0, x - x0
+        return ((1 - dy) * (1 - dx) * img[:, y0, x0]
+                + (1 - dy) * dx * img[:, y0, x1_]
+                + dy * (1 - dx) * img[:, y1_, x0]
+                + dy * dx * img[:, y1_, x1_])
+
+    off = 0.5 if aligned else 0.0
+    for r, roi in enumerate(rois):
+        b = int(roi[0])
+        x1 = roi[1] * scale - off
+        y1 = roi[2] * scale - off
+        x2 = roi[3] * scale - off
+        y2 = roi[4] * scale - off
+        rw = (x2 - x1) if aligned else max(x2 - x1, 1.0)
+        rh = (y2 - y1) if aligned else max(y2 - y1, 1.0)
+        bh, bw = rh / ph, rw / pw
+        for i in range(ph):
+            for j in range(pw):
+                acc = np.zeros(C, "float32")
+                for ky in range(s):
+                    for kx in range(s):
+                        y = y1 + (i + (ky + 0.5) / s) * bh
+                        x = x1 + (j + (kx + 0.5) / s) * bw
+                        acc += bilin(data[b], y, x)
+                out[r, :, i, j] = acc / (s * s)
+    return out
+
+
+def test_roi_align_matches_loop_oracle():
+    """ROIAlign vs the reference-kernel numpy oracle, including ROIs that
+    poke past the image (the clamp-within-[-1,dim] boundary band) and
+    both aligned conventions."""
+    rng = np.random.RandomState(9)
+    data = rng.rand(2, 3, 10, 12).astype("float32")
+    rois = np.array([[0, 2, 1, 11, 9],
+                     [1, -2, -2, 6, 5],      # pokes past the top-left
+                     [0, 8, 6, 14, 12]],     # pokes past the bottom-right
+                    dtype="float32")
+    for aligned in (False, True):
+        for scale in (1.0, 0.5):
+            out = nd.contrib.ROIAlign(
+                nd.array(data), nd.array(rois), pooled_size=(3, 3),
+                spatial_scale=scale, sample_ratio=2,
+                aligned=aligned).asnumpy()
+            ref = _roi_align_oracle(data, rois, scale, 3, 3, 2, aligned)
+            np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
