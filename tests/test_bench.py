@@ -257,3 +257,31 @@ def test_bench_child_bf16_scan_executes(tmp_path):
     import math
 
     assert math.isfinite(final["loss"])
+
+
+@pytest.mark.skipif(not os.environ.get("MXTPU_NIGHTLY"),
+                    reason="two small inference compiles; nightly tier")
+def test_benchmark_score_inference_sweep_executes(tmp_path):
+    """The inference benchmark (benchmark_score analog, ref:
+    example/image-classification/benchmark_score.py) must execute its
+    full sweep — on-device param regen, per-batch AND scan modes, both
+    dtypes — so the tool is proven before a live chip window."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_COMPILATION_CACHE_DIR"] = str(tmp_path / "jc")
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "benchmark_score.py"),
+         "--models", "resnet18_v1", "--batch", "4", "--image", "32",
+         "--iters", "2", "--scan", "2", "--platform", "cpu"],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = [json.loads(ln) for ln in p.stdout.strip().splitlines()]
+    rows = [r for r in lines if "model" in r]
+    assert {r["dtype"] for r in rows} == {"bfloat16", "float32"}
+    for r in rows:
+        assert "error" not in r, r
+        assert r["ips"] > 0 and r["scan_ips"] > 0
+    summary = lines[-1]
+    assert summary["metric"] == "inference_images_per_sec"
+    assert len(summary["results"]) == 2

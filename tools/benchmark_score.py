@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""Inference throughput across the model zoo (the benchmark_score analog).
+
+Mirrors the reference's inference benchmark protocol
+(ref: example/image-classification/benchmark_score.py — synthetic data,
+forward-only, images/sec per model per batch size; headline numbers in
+docs/faq/perf.md:167-193: ResNet-50 fp32 1233.15 img/s @ bs128, fp16
+2355.04 img/s @ bs128, AlexNet 10990 img/s @ bs256 on one V100).
+
+TPU-native measurement:
+  - params are REGENERATED on the device from (shape, dtype, mean, std)
+    specs — only seeds cross the (flaky, slow) tunnel, exactly like
+    bench.py's minimal-wire mode; weight values do not affect timing
+  - predict-mode forward under jit (BN uses running stats, no aux writes)
+  - two modes per model: per-batch dispatch, and a lax.scan over K
+    device-resident batches inside ONE program (free of host dispatch
+    latency — the bulked-exec analog, dominant on remote-attached chips)
+
+Prints one JSON line per (model, dtype) plus a final summary line keyed
+against the reference's headline inference numbers.
+
+Usage:
+  python tools/benchmark_score.py                     # headline set
+  python tools/benchmark_score.py --models resnet18_v1 --batch 8 \
+      --iters 2 --scan 2 --platform cpu               # smoke (tests)
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jaxcache")
+
+# reference inference baselines (docs/faq/perf.md:167-193, 1x V100)
+REF_V100 = {
+    ("resnet50_v1", "float32"): 1233.15,
+    ("resnet50_v1", "bfloat16"): 2355.04,  # reference fp16 row
+    ("alexnet", "float32"): 10990.0,
+    ("inception_v3", "float32"): 616.95,
+}
+
+
+def bench_model(name, batch, image, dtype, iters, scan_k, target):
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+    from incubator_mxnet_tpu.gluon.block import _ParamSubst
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(0)
+    try:
+        cpu0 = jax.devices("cpu")[0]
+    except RuntimeError:
+        cpu0 = target
+    # build + init on host CPU (hundreds of tiny per-param programs would
+    # otherwise each cross the tunnel); ResNet supports TPU-native NHWC
+    kwargs = {"classes": 1000}
+    if name.startswith("resnet"):
+        kwargs["layout"] = "NHWC"
+        data_shape = (batch, image, image, 3)
+    else:
+        data_shape = (batch, 3, image, image)
+    if name == "inception_v3":
+        image = max(image, 299)
+        data_shape = (batch, 3, image, image)
+    with jax.default_device(cpu0):
+        net = vision.get_model(name, **kwargs)
+        net.initialize(mx.init.Xavier())
+        if dtype == "bfloat16":
+            net.cast("bfloat16")
+        # shape-resolve deferred params with one tiny host forward
+        prev = autograd.set_training(False)
+        try:
+            net(mx.nd.zeros((1,) + data_shape[1:],
+                            dtype="bfloat16" if dtype == "bfloat16"
+                            else "float32"))
+        finally:
+            autograd.set_training(prev)
+
+    params = list(net.collect_params().items())
+    names = [n for n, _ in params]
+    specs = []
+    for _, p in params:
+        d = p.data()._data
+        h = np.asarray(d, dtype=np.float32)
+        specs.append((tuple(d.shape), d.dtype, float(h.mean()),
+                      float(h.std())))
+
+    sharding = jax.sharding.SingleDeviceSharding(target)
+
+    def gen_params(seed):
+        key = jax.random.PRNGKey(seed)
+        outs = []
+        for i, (shape, dt, mean, std) in enumerate(specs):
+            k = jax.random.fold_in(key, i)
+            v = mean + jax.random.normal(k, shape, jnp.float32) * std
+            outs.append(v.astype(dt))
+        return tuple(outs)
+
+    dev_params = jax.jit(gen_params, out_shardings=sharding)(0)
+
+    jdtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+
+    def gen_batch(seed, lead=()):
+        def g(s):
+            k = jax.random.PRNGKey(s)
+            return jax.random.uniform(k, lead + data_shape,
+                                      jnp.float32).astype(jdtype)
+        return jax.jit(g, out_shardings=sharding)(seed)
+
+    def fwd(ps, x):
+        mapping = {n: NDArray._from_data(d) for n, d in zip(names, ps)}
+        prev_t = autograd.set_training(False)
+        prev_r = autograd.set_recording(False)
+        try:
+            with _ParamSubst(mapping):
+                out = net(NDArray._from_data(x))
+        finally:
+            autograd.set_training(prev_t)
+            autograd.set_recording(prev_r)
+        return out._data
+
+    jfwd = jax.jit(fwd)
+
+    def scan_fwd(ps, xs):
+        def body(carry, x):
+            # per-batch argmax: forces the full forward while keeping the
+            # program output (and the device->host copy) tiny
+            return carry, jnp.argmax(fwd(ps, x), axis=-1)
+        _, outs = jax.lax.scan(body, 0, xs)
+        return outs
+
+    jscan = jax.jit(scan_fwd)
+
+    x = gen_batch(0)
+    t0 = time.perf_counter()
+    jfwd(dev_params, x).block_until_ready()
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = jfwd(dev_params, x)
+    out.block_until_ready()
+    ips = batch * iters / (time.perf_counter() - t0)
+
+    scan_ips = 0.0
+    if scan_k > 1:
+        xs = gen_batch(1, lead=(scan_k,))
+        jscan(dev_params, xs).block_until_ready()  # compile + warm
+        reps = max(1, iters // scan_k)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            outs = jscan(dev_params, xs)
+        outs.block_until_ready()
+        scan_ips = batch * scan_k * reps / (time.perf_counter() - t0)
+
+    return {"model": name, "dtype": dtype, "batch": batch,
+            "ips": round(ips, 2), "scan_ips": round(scan_ips, 2),
+            "platform": target.platform, "compile_s": round(compile_s, 1)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--models", nargs="+",
+                    default=["resnet50_v1", "alexnet", "mobilenet1_0"])
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--image", type=int, default=224)
+    ap.add_argument("--iters", type=int, default=40)
+    ap.add_argument("--scan", type=int, default=8)
+    ap.add_argument("--dtypes", nargs="+",
+                    default=["bfloat16", "float32"])
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (the axon plugin ignores "
+                         "JAX_PLATFORMS env; use --platform cpu off-chip)")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    devices = jax.devices()
+    accel = [d for d in devices if d.platform != "cpu"]
+    target = accel[0] if accel else devices[0]
+
+    results = []
+    for name in args.models:
+        for dtype in args.dtypes:
+            try:
+                r = bench_model(name, args.batch, args.image, dtype,
+                                args.iters, args.scan, target)
+            except Exception as e:  # keep going: one model must not kill the sweep
+                r = {"model": name, "dtype": dtype, "batch": args.batch,
+                     "error": str(e)[:300]}
+            print(json.dumps(r), flush=True)
+            results.append(r)
+
+    summary = {"metric": "inference_images_per_sec", "results": []}
+    for r in results:
+        if "error" in r:
+            continue
+        best = max(r["ips"], r.get("scan_ips", 0.0))
+        entry = {"model": r["model"], "dtype": r["dtype"], "best_ips": best,
+                 "platform": r["platform"]}
+        ref = REF_V100.get((r["model"], r["dtype"]))
+        if ref:
+            entry["vs_v100_ref"] = round(best / ref, 3)
+        summary["results"].append(entry)
+    print(json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    main()
