@@ -43,6 +43,26 @@ public final class AttrMap {
     return attrs.isEmpty();
   }
 
+  /** Typed entries, insertion order (Symbol serialization). */
+  Iterable<Map.Entry<String, Object>> entries() {
+    return attrs.entrySet();
+  }
+
+  /** JSON string-body escape (quotes, backslashes, control chars). */
+  static String jsonEscape(String s) {
+    StringBuilder b = new StringBuilder(s.length());
+    for (char c : s.toCharArray()) {
+      if (c == '"' || c == '\\') {
+        b.append('\\').append(c);
+      } else if (c < 0x20) {
+        b.append(String.format("\\u%04x", (int) c));
+      } else {
+        b.append(c);
+      }
+    }
+    return b.toString();
+  }
+
   String toJson() {
     if (attrs.isEmpty()) {
       return null;
@@ -57,17 +77,7 @@ public final class AttrMap {
       b.append('"').append(e.getKey()).append("\":");
       Object v = e.getValue();
       if (v instanceof String) {
-        b.append('"');
-        for (char c : ((String) v).toCharArray()) {
-          if (c == '"' || c == '\\') {
-            b.append('\\').append(c);
-          } else if (c < 0x20) {
-            b.append(String.format("\\u%04x", (int) c));
-          } else {
-            b.append(c);
-          }
-        }
-        b.append('"');
+        b.append('"').append(jsonEscape((String) v)).append('"');
       } else if (v instanceof long[]) {
         b.append('[');
         long[] a = (long[]) v;

@@ -1,0 +1,123 @@
+package org.apache.mxtpu;
+
+import java.util.IdentityHashMap;
+import java.util.List;
+import java.util.Map;
+import java.util.Set;
+
+/**
+ * Evaluates a bound {@link Symbol} graph (reference role:
+ * org.apache.mxnet.Executor — forward/backward over bound arguments).
+ *
+ * Execution walks the graph in topological order through the embedded
+ * imperative runtime (one cached-compiled XLA program per op, the same
+ * path the generated {@link Ops} wrappers use); `forward(true)` records
+ * the op sequence on the runtime's autograd tape so {@link #backward}
+ * can populate per-argument gradients.
+ */
+public final class Executor implements AutoCloseable {
+  private final Symbol head;
+  private final List<Symbol.Node> topo;
+  private final Map<String, NDArray> args;
+  private final Set<String> gradWrt;
+  private Map<Symbol.Node, NDArray[]> values;
+  private boolean recorded = false;
+  private boolean closed = false;
+
+  Executor(Symbol head, Map<String, NDArray> args, Set<String> gradWrt) {
+    this.head = head;
+    this.topo = head.topoNodes();
+    this.args = args;
+    this.gradWrt = gradWrt;
+    for (String g : gradWrt) {
+      args.get(g).attachGrad();
+    }
+  }
+
+  /** Inference forward; returns the head outputs. */
+  public NDArray[] forward() {
+    return forward(false);
+  }
+
+  /** Forward pass; `train` records for a following {@link #backward}. */
+  public NDArray[] forward(boolean train) {
+    checkOpen();
+    boolean record = train && !gradWrt.isEmpty();
+    // a forward that throws mid-graph must not leave a half-populated
+    // value map (outputs() would NPE) or a stale `recorded` flag
+    // (backward() would run against the PREVIOUS step's tape)
+    recorded = false;
+    values = null;
+    if (record) {
+      try (Autograd scope = Autograd.record(true)) {
+        evalGraph();
+      }
+    } else {
+      evalGraph();
+    }
+    recorded = record;
+    return outputs();
+  }
+
+  private void evalGraph() {
+    Map<Symbol.Node, NDArray[]> vals = new IdentityHashMap<>();
+    for (Symbol.Node n : topo) {
+      if (n.op == null) {
+        vals.put(n, new NDArray[] {args.get(n.name)});
+        continue;
+      }
+      NDArray[] ins = new NDArray[n.inputs.size()];
+      for (int i = 0; i < ins.length; i++) {
+        Symbol src = n.inputs.get(i);
+        ins[i] = vals.get(src.node())[src.outIdx()];
+      }
+      vals.put(n, MXTpu.invoke(n.op, ins,
+          n.attrs.isEmpty() ? null : n.attrs));
+    }
+    values = vals; // assign only on full success (see forward)
+  }
+
+  /** Head outputs of the most recent forward. */
+  public NDArray[] outputs() {
+    checkOpen();
+    if (values == null) {
+      throw new MXTpuException("outputs: call forward() first");
+    }
+    return new NDArray[] {values.get(head.node())[head.outIdx()]};
+  }
+
+  /**
+   * Backward from the (scalar or ones-seeded) head output; gradients
+   * land on the gradWrt arguments ({@link #gradOf}).
+   */
+  public void backward() {
+    checkOpen();
+    if (!recorded) {
+      throw new MXTpuException("backward: needs a prior forward(true)");
+    }
+    outputs()[0].backward();
+    recorded = false;
+  }
+
+  /** Gradient of a gradWrt argument from the last backward. */
+  public NDArray gradOf(String argName) {
+    checkOpen();
+    if (!gradWrt.contains(argName)) {
+      throw new MXTpuException("gradOf: '" + argName
+          + "' was not in gradWrt at bind");
+    }
+    return args.get(argName).grad();
+  }
+
+  private void checkOpen() {
+    if (closed) {
+      throw new MXTpuException("Executor used after close()");
+    }
+  }
+
+  @Override
+  public void close() {
+    closed = true;
+    values = null; // intermediates are Cleaner-managed NDArrays
+  }
+}

@@ -1,0 +1,243 @@
+package org.apache.mxtpu;
+
+import java.util.ArrayList;
+import java.util.Collection;
+import java.util.IdentityHashMap;
+import java.util.LinkedHashMap;
+import java.util.LinkedHashSet;
+import java.util.List;
+import java.util.Map;
+import java.util.Set;
+
+/**
+ * Symbolic graph composition for the JVM (reference role:
+ * org.apache.mxnet.Symbol — scala-package/core .../Symbol.scala: Variable,
+ * op compose, listArguments, toJson, bind).
+ *
+ * A Symbol is one output of a graph node. Composition is pure-JVM data:
+ * nothing touches the runtime until {@link #bind}. The serialized form
+ * ({@link #toJson}) uses the same nnvm-style schema as the Python
+ * frontend's Symbol.tojson (nodes / arg_nodes / heads), so a graph
+ * composed in Java can be loaded by Python `symbol.load_json`, R, or
+ * the visualization tooling unchanged.
+ */
+public final class Symbol {
+  static final class Node {
+    final String op;      // null for a variable
+    final String name;
+    final AttrMap attrs;  // typed values; stringified only in toJson
+    final List<Symbol> inputs;
+
+    Node(String op, String name, AttrMap attrs, List<Symbol> inputs) {
+      this.op = op;
+      this.name = name;
+      this.attrs = attrs == null ? AttrMap.of() : attrs;
+      this.inputs = inputs;
+    }
+  }
+
+  private final Node node;
+  private final int outIdx;
+
+  private Symbol(Node node, int outIdx) {
+    this.node = node;
+    this.outIdx = outIdx;
+  }
+
+  Node node() {
+    return node;
+  }
+
+  int outIdx() {
+    return outIdx;
+  }
+
+  private static final Map<String, Integer> AUTO_NAMES = new LinkedHashMap<>();
+
+  private static synchronized String autoName(String op) {
+    String base = op.toLowerCase();
+    int n = AUTO_NAMES.merge(base, 1, Integer::sum);
+    return base + (n - 1);
+  }
+
+  /** A named graph input (reference Symbol.Variable). */
+  public static Symbol variable(String name) {
+    return new Symbol(new Node(null, name, null, new ArrayList<>()), 0);
+  }
+
+  /** Compose `opName` over inputs (positional, registry input order). */
+  public static Symbol op(String opName, Symbol... inputs) {
+    return op(opName, null, null, inputs);
+  }
+
+  public static Symbol op(String opName, AttrMap attrs, Symbol... inputs) {
+    return op(opName, null, attrs, inputs);
+  }
+
+  public static Symbol op(String opName, String name, AttrMap attrs,
+                          Symbol... inputs) {
+    List<Symbol> in = new ArrayList<>();
+    for (Symbol s : inputs) {
+      if (s == null) {
+        throw new MXTpuException(opName + ": null input symbol");
+      }
+      in.add(s);
+    }
+    String nm = name != null ? name : autoName(opName);
+    return new Symbol(new Node(opName, nm, attrs, in), 0);
+  }
+
+  /** Select output `idx` of this symbol's node (multi-output ops). */
+  public Symbol get(int idx) {
+    return new Symbol(node, idx);
+  }
+
+  public String name() {
+    return node.name;
+  }
+
+  /** Graph nodes in topological order (inputs before consumers). */
+  List<Node> topoNodes() {
+    List<Node> order = new ArrayList<>();
+    Set<Node> seen = java.util.Collections.newSetFromMap(new IdentityHashMap<>());
+    java.util.ArrayDeque<Object[]> stack = new java.util.ArrayDeque<>();
+    seen.add(node);
+    stack.push(new Object[] {node, 0});
+    while (!stack.isEmpty()) {
+      Object[] frame = stack.peek();
+      Node n = (Node) frame[0];
+      int i = (Integer) frame[1];
+      if (i < n.inputs.size()) {
+        frame[1] = i + 1;
+        Node src = n.inputs.get(i).node;
+        if (!seen.contains(src)) {
+          seen.add(src);
+          stack.push(new Object[] {src, 0});
+        }
+      } else {
+        stack.pop();
+        order.add(n); // pushed exactly once (seen-guarded), so no dedupe
+      }
+    }
+    return order;
+  }
+
+  /** Variable names in topological order (reference listArguments). */
+  public List<String> listArguments() {
+    List<String> names = new ArrayList<>();
+    for (Node n : topoNodes()) {
+      if (n.op == null) {
+        names.add(n.name);
+      }
+    }
+    return names;
+  }
+
+  /**
+   * Serialize with the Python frontend's schema (Symbol.tojson —
+   * nodes/arg_nodes/heads + a framework tag) so the graph round-trips
+   * through `symbol.load_json` for binding, plotting, or conversion.
+   */
+  public String toJson() {
+    List<Node> nodes = topoNodes();
+    Map<Node, Integer> nid = new IdentityHashMap<>();
+    for (int i = 0; i < nodes.size(); i++) {
+      nid.put(nodes.get(i), i);
+    }
+    StringBuilder b = new StringBuilder("{\n  \"nodes\": [");
+    for (int i = 0; i < nodes.size(); i++) {
+      Node n = nodes.get(i);
+      if (i > 0) {
+        b.append(',');
+      }
+      b.append("\n    {\"op\": \"").append(n.op == null ? "null" : esc(n.op))
+          .append("\", \"name\": \"").append(esc(n.name))
+          .append("\", \"attrs\": {");
+      boolean first = true;
+      for (Map.Entry<String, Object> e : n.attrs.entries()) {
+        if (!first) {
+          b.append(", ");
+        }
+        first = false;
+        b.append('"').append(esc(e.getKey())).append("\": \"")
+            .append(esc(displayValue(e.getValue()))).append('"');
+      }
+      b.append("}, \"inputs\": [");
+      for (int j = 0; j < n.inputs.size(); j++) {
+        Symbol s = n.inputs.get(j);
+        if (j > 0) {
+          b.append(", ");
+        }
+        b.append('[').append(nid.get(s.node)).append(", ").append(s.outIdx)
+            .append(", 0]");
+      }
+      b.append("]}");
+    }
+    b.append("\n  ],\n  \"arg_nodes\": [");
+    boolean first = true;
+    for (int i = 0; i < nodes.size(); i++) {
+      if (nodes.get(i).op == null) {
+        if (!first) {
+          b.append(", ");
+        }
+        first = false;
+        b.append(i);
+      }
+    }
+    b.append("],\n  \"heads\": [[").append(nid.get(node)).append(", ")
+        .append(outIdx).append(", 0]],\n")
+        .append("  \"attrs\": {\"framework\": \"incubator_mxnet_tpu\", ")
+        .append("\"version\": \"0.1\"}\n}");
+    return b.toString();
+  }
+
+  /** Python-literal display form (matches the frontend's _attr_str: the
+   * loader re-types values with literal_eval). */
+  static String displayValue(Object v) {
+    if (v instanceof Boolean) {
+      return ((Boolean) v) ? "True" : "False";
+    }
+    if (v instanceof long[]) {
+      long[] a = (long[]) v;
+      StringBuilder b = new StringBuilder("(");
+      for (int i = 0; i < a.length; i++) {
+        if (i > 0) {
+          b.append(", ");
+        }
+        b.append(a[i]);
+      }
+      return b.append(')').toString();
+    }
+    return String.valueOf(v);
+  }
+
+  private static String esc(String s) {
+    return AttrMap.jsonEscape(s);
+  }
+
+  /**
+   * Bind argument arrays to the graph (reference Executor bind): every
+   * name in {@link #listArguments} must be present; `gradWrt` selects
+   * the arguments that accumulate gradients during
+   * {@link Executor#backward}.
+   */
+  public Executor bind(Map<String, NDArray> args, Collection<String> gradWrt) {
+    List<String> wanted = listArguments();
+    for (String n : wanted) {
+      if (!args.containsKey(n)) {
+        throw new MXTpuException("bind: missing argument '" + n + "'");
+      }
+    }
+    Set<String> gw = new LinkedHashSet<>();
+    if (gradWrt != null) {
+      for (String g : gradWrt) {
+        if (!args.containsKey(g)) {
+          throw new MXTpuException("bind: gradWrt '" + g
+              + "' is not an argument");
+        }
+        gw.add(g);
+      }
+    }
+    return new Executor(this, args, gw);
+  }
+}

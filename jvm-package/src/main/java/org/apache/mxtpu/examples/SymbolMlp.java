@@ -1,0 +1,144 @@
+package org.apache.mxtpu.examples;
+
+import java.io.FileOutputStream;
+import java.io.IOException;
+import java.io.OutputStreamWriter;
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.nio.charset.StandardCharsets;
+import java.util.LinkedHashMap;
+import java.util.Map;
+import org.apache.mxtpu.AttrMap;
+import org.apache.mxtpu.Executor;
+import org.apache.mxtpu.MXTpu;
+import org.apache.mxtpu.NDArray;
+import org.apache.mxtpu.Ops;
+import org.apache.mxtpu.Symbol;
+
+/**
+ * The Symbol-level JVM API end to end (reference role: scala-package's
+ * Symbol compose -> bind -> Executor.forward/backward training loop,
+ * scala-package/core .../Symbol.scala + Executor.scala).
+ *
+ * Composes an MLP symbolically, binds it, trains with explicit
+ * forward(true)/backward/sgd_update steps, and (given an output dir)
+ * dumps the graph JSON plus the bound inputs and the logits so the
+ * Python test can reload the SAME graph via `symbol.load_json` and
+ * cross-check the forward numerics — the cross-language oracle.
+ */
+public final class SymbolMlp {
+  private SymbolMlp() {}
+
+  // deterministic data: must match the Python side of the oracle
+  private static float[] lcg(int n, int seed) {
+    float[] out = new float[n];
+    long state = seed;
+    for (int i = 0; i < n; i++) {
+      state = (state * 6364136223846793005L + 1442695040888963407L);
+      out[i] = ((state >>> 33) % 2000) / 1000.0f - 1.0f;
+    }
+    return out;
+  }
+
+  private static void writeFloats(String path, float[] data)
+      throws IOException {
+    ByteBuffer buf = ByteBuffer.allocate(data.length * 4)
+        .order(ByteOrder.LITTLE_ENDIAN);
+    buf.asFloatBuffer().put(data);
+    try (FileOutputStream f = new FileOutputStream(path)) {
+      f.write(buf.array());
+    }
+  }
+
+  public static void main(String[] args) throws IOException {
+    MXTpu.init();
+    int batch = 16;
+    int inDim = 8;
+    int hidden = 16;
+    int classes = 3;
+
+    Symbol x = Symbol.variable("x");
+    Symbol w1 = Symbol.variable("w1");
+    Symbol b1 = Symbol.variable("b1");
+    Symbol w2 = Symbol.variable("w2");
+    Symbol b2 = Symbol.variable("b2");
+    Symbol label = Symbol.variable("label");
+    Symbol h = Symbol.op("FullyConnected", "fc1",
+        AttrMap.of().set("num_hidden", hidden), x, w1, b1);
+    Symbol act = Symbol.op("Activation", "relu1",
+        AttrMap.of().set("act_type", "relu"), h);
+    Symbol logits = Symbol.op("FullyConnected", "fc2",
+        AttrMap.of().set("num_hidden", classes), act, w2, b2);
+    Symbol loss = Symbol.op("softmax_cross_entropy", "loss", null,
+        logits, label);
+
+    float[] xs = lcg(batch * inDim, 1);
+    float[] ys = new float[batch];
+    for (int i = 0; i < batch; i++) {
+      // separable-ish labels from the data so the loss can drop
+      float s = 0f;
+      for (int j = 0; j < inDim; j++) {
+        s += xs[i * inDim + j] * (j % 3 == 0 ? 1f : -0.5f);
+      }
+      ys[i] = s > 0.5f ? 2f : (s > -0.5f ? 1f : 0f);
+    }
+
+    Map<String, NDArray> argMap = new LinkedHashMap<>();
+    argMap.put("x", NDArray.fromFloats(new long[] {batch, inDim}, xs));
+    argMap.put("w1",
+        NDArray.fromFloats(new long[] {hidden, inDim}, lcg(hidden * inDim, 2)));
+    argMap.put("b1", NDArray.zeros(hidden));
+    argMap.put("w2", NDArray.fromFloats(new long[] {classes, hidden},
+        lcg(classes * hidden, 3)));
+    argMap.put("b2", NDArray.zeros(classes));
+    argMap.put("label", NDArray.fromFloats(new long[] {batch}, ys));
+
+    String[] params = {"w1", "b1", "w2", "b2"};
+    AttrMap sgd = AttrMap.of().set("lr", 0.1).set("rescale_grad",
+        1.0 / batch);
+
+    float first = Float.NaN;
+    float last = Float.NaN;
+    try (Executor exec = loss.bind(argMap, java.util.Arrays.asList(params))) {
+      for (int step = 0; step < 30; step++) {
+        float l = exec.forward(true)[0].scalar() / batch;
+        if (step == 0) {
+          first = l;
+        }
+        last = l;
+        exec.backward();
+        for (String p : params) {
+          NDArray updated = Ops.sgd_update(argMap.get(p), exec.gradOf(p), sgd);
+          argMap.put(p, updated);
+          updated.attachGrad(); // re-arm gradients for the next forward
+        }
+      }
+    }
+    System.out.printf("symbol fit first %.4f last %.4f%n", first, last);
+
+    if (args.length >= 1) {
+      // cross-language oracle artifacts: graph json, trained params,
+      // inputs, and the Java-side logits for the SAME binding
+      String dir = args[0];
+      try (OutputStreamWriter w = new OutputStreamWriter(
+          new FileOutputStream(dir + "/mlp-symbol.json"),
+          StandardCharsets.UTF_8)) {
+        w.write(logits.toJson());
+      }
+      writeFloats(dir + "/x.bin", xs);
+      for (String p : params) {
+        writeFloats(dir + "/" + p + ".bin", argMap.get(p).toFloats());
+      }
+      try (Executor inf = logits.bind(argMap, null)) {
+        writeFloats(dir + "/logits.bin", inf.forward()[0].toFloats());
+      }
+    }
+
+    if (last < first) {
+      System.out.println("SYMBOL_FITTED");
+    } else {
+      System.out.println("SYMBOL_FAILED");
+      System.exit(1);
+    }
+  }
+}
