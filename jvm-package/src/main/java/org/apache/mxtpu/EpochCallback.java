@@ -1,0 +1,7 @@
+package org.apache.mxtpu;
+
+/** Per-epoch training callback shared by {@link Module} and
+ * {@link SymbolModule} (reference epoch_end_callback role). */
+public interface EpochCallback {
+  void onEpoch(int epoch, float meanLoss);
+}

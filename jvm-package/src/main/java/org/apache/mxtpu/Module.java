@@ -8,11 +8,6 @@ package org.apache.mxtpu;
  * batches and reads the loss).
  */
 public final class Module implements AutoCloseable {
-  /** Per-epoch callback (reference epoch_end_callback role). */
-  public interface EpochCallback {
-    void onEpoch(int epoch, float meanLoss);
-  }
-
   private final Trainer trainer;
   private float lastLoss = Float.NaN;
 

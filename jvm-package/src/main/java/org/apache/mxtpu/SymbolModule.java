@@ -1,0 +1,144 @@
+package org.apache.mxtpu;
+
+import java.util.LinkedHashMap;
+import java.util.Map;
+
+/**
+ * Train a Java-composed {@link Symbol} directly from the JVM (reference
+ * role: org.apache.mxnet.module.Module bound to a Symbol — the
+ * scala-package's primary training path; contrast {@link Module}, which
+ * fits a Python-exported `.mxt` artifact).
+ *
+ * The loss head is an un-normalized loss (summed scalar, or a
+ * per-sample vector back-propagated ones-seeded); parameters update
+ * with fused `sgd_update` ops through the embedded imperative runtime,
+ * so every compute step is a cached XLA program and no Python is
+ * written by the user.
+ */
+public final class SymbolModule implements AutoCloseable {
+  private final Symbol loss;
+  private final String dataName;
+  private final String labelName;
+  private final Map<String, NDArray> args = new LinkedHashMap<>();
+  private final String[] paramNames;
+  private final double lr;
+  private final double wd;
+  private Executor exec;
+
+  /**
+   * @param loss loss symbol over variables {dataName, labelName} ∪
+   *     params.keySet(); the head must be an UN-normalized loss — a
+   *     summed scalar (e.g. softmax_cross_entropy) or a per-sample
+   *     vector — and is reported as (element total)/batch per epoch
+   * @param dataName the input variable fed from each batch's data
+   * @param labelName the input variable fed from each batch's label
+   * @param params initial parameter values by variable name
+   * @param lr SGD learning rate (gradients are rescaled by 1/batch)
+   * @param wd weight decay
+   */
+  public SymbolModule(Symbol loss, String dataName, String labelName,
+                      Map<String, NDArray> params, double lr, double wd) {
+    this.loss = loss;
+    this.dataName = dataName;
+    this.labelName = labelName;
+    this.paramNames = params.keySet().toArray(new String[0]);
+    this.lr = lr;
+    this.wd = wd;
+    args.putAll(params);
+    java.util.List<String> wanted = loss.listArguments();
+    for (String n : new String[] {dataName, labelName}) {
+      if (!wanted.contains(n)) {
+        throw new MXTpuException("SymbolModule: '" + n + "' is not a "
+            + "variable of the loss symbol (variables: " + wanted + ")");
+      }
+    }
+    for (String n : wanted) {
+      if (!n.equals(dataName) && !n.equals(labelName)
+          && !params.containsKey(n)) {
+        throw new MXTpuException("SymbolModule: no initial value for "
+            + "parameter '" + n + "'");
+      }
+    }
+  }
+
+  /** Epoch loop over the iterator; returns per-epoch mean loss (the
+   * reference Module.fit contract). */
+  public float[] fit(DataIter train, int epochs) {
+    return fit(train, epochs, null);
+  }
+
+  public float[] fit(DataIter train, int epochs, EpochCallback callback) {
+    DataDesc xDesc = train.provideData();
+    DataDesc yDesc = train.provideLabel();
+    long batch = xDesc.batchSize();
+    AttrMap step = AttrMap.of().set("lr", lr).set("wd", wd)
+        .set("rescale_grad", 1.0 / batch);
+    float[] epochLoss = new float[epochs];
+    for (int e = 0; e < epochs; e++) {
+      train.reset();
+      double total = 0.0;
+      int batches = 0;
+      while (train.hasNext()) {
+        DataIter.Batch b = train.next();
+        xDesc.validate(b.data);
+        yDesc.validate(b.label);
+        args.put(dataName, NDArray.fromFloats(xDesc.shape, b.data));
+        args.put(labelName, NDArray.fromFloats(yDesc.shape, b.label));
+        if (exec == null) {
+          exec = loss.bind(args, java.util.Arrays.asList(paramNames));
+        }
+        // the head is an un-normalized loss (summed scalar or
+        // per-sample vector — both standard); either way the per-sample
+        // mean is the element total over the batch size
+        float[] lv = exec.forward(true)[0].toFloats();
+        float sum = 0f;
+        for (float v : lv) {
+          sum += v;
+        }
+        float l = sum / batch;
+        exec.backward();
+        for (String p : paramNames) {
+          NDArray updated = Ops.sgd_update(args.get(p), exec.gradOf(p), step);
+          args.put(p, updated);
+          updated.attachGrad(); // re-arm for the next recorded forward
+        }
+        total += l;
+        batches++;
+      }
+      if (batches == 0) {
+        throw new MXTpuException("fit: iterator produced no batches");
+      }
+      epochLoss[e] = (float) (total / batches);
+      if (callback != null) {
+        callback.onEpoch(e, epochLoss[e]);
+      }
+    }
+    return epochLoss;
+  }
+
+  /** Forward an output head that shares this module's variables (e.g.
+   * the logits symbol the loss was built from) on new data. */
+  public float[] predict(Symbol output, long[] dataShape, float[] data) {
+    args.put(dataName, NDArray.fromFloats(dataShape, data));
+    try (Executor inf = output.bind(args, null)) {
+      return inf.forward()[0].toFloats();
+    }
+  }
+
+  /** Current parameter values by name (live, not copies). */
+  public Map<String, NDArray> params() {
+    Map<String, NDArray> out = new LinkedHashMap<>();
+    for (String p : paramNames) {
+      out.put(p, args.get(p));
+    }
+    return out;
+  }
+
+  @Override
+  public void close() {
+    if (exec != null) {
+      exec.close();
+      exec = null;
+    }
+  }
+}

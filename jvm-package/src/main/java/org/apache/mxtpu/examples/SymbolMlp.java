@@ -14,6 +14,7 @@ import org.apache.mxtpu.MXTpu;
 import org.apache.mxtpu.NDArray;
 import org.apache.mxtpu.Ops;
 import org.apache.mxtpu.Symbol;
+import org.apache.mxtpu.SymbolModule;
 
 /**
  * The Symbol-level JVM API end to end (reference role: scala-package's
@@ -139,6 +140,30 @@ public final class SymbolMlp {
     } else {
       System.out.println("SYMBOL_FAILED");
       System.exit(1);
+    }
+
+    // SymbolModule: the same graph trained through the Module-shaped
+    // API (fit over a DataIter, predict on the logits head) — the
+    // reference's Module(symbol).fit contract, fully in Java
+    Map<String, NDArray> fresh = new LinkedHashMap<>();
+    fresh.put("w1",
+        NDArray.fromFloats(new long[] {hidden, inDim}, lcg(hidden * inDim, 5)));
+    fresh.put("b1", NDArray.zeros(hidden));
+    fresh.put("w2", NDArray.fromFloats(new long[] {classes, hidden},
+        lcg(classes * hidden, 6)));
+    fresh.put("b2", NDArray.zeros(classes));
+    try (SymbolModule mod = new SymbolModule(loss, "x", "label", fresh,
+        0.1, 0.0)) {
+      float[] losses = mod.fit(
+          new org.apache.mxtpu.NDArrayIter(xs, ys, batch, inDim, batch), 20);
+      float[] logitsOut = mod.predict(logits, new long[] {batch, inDim}, xs);
+      if (losses[losses.length - 1] < losses[0]
+          && logitsOut.length == batch * classes) {
+        System.out.println("MODULE_FITTED");
+      } else {
+        System.out.println("MODULE_FAILED");
+        System.exit(1);
+      }
     }
   }
 }

@@ -237,6 +237,7 @@ print("EXPORTED")
             capture_output=True, text=True, timeout=600, env=env)
         assert run.returncode == 0, (run.stdout[-800:], run.stderr[-1500:])
         assert "SYMBOL_FITTED" in run.stdout
+        assert "MODULE_FITTED" in run.stdout
         # the Java-composed graph is a loadable Python symbol, and the
         # Java Executor's forward matches Python's bind on the same data
         import numpy as np
@@ -284,8 +285,15 @@ def test_jvm_symbol_api_surface():
     for needle in ("NDArray[] forward(boolean train)", "void backward()",
                    "NDArray gradOf(String argName)"):
         assert needle in ex, f"Executor.java missing {needle}"
+    # Module-over-Symbol (the reference's primary JVM training path:
+    # Module(symbol).fit — no Python export step)
+    mod = _read(base, "SymbolModule.java")
+    for needle in ("fit(DataIter train, int epochs",
+                   "Ops.sgd_update(", "float[] predict(Symbol output"):
+        assert needle in mod, f"SymbolModule.java missing {needle}"
     mlp = _read(base, "examples", "SymbolMlp.java")
     assert "SYMBOL_FITTED" in mlp and "loss.bind(" in mlp
+    assert "MODULE_FITTED" in mlp and "new SymbolModule(" in mlp
 
 
 @pytest.mark.skipif(shutil.which("R") is None,
