@@ -14,7 +14,8 @@ from .. import autograd
 from ..ndarray.ndarray import NDArray
 
 __all__ = ["quantize", "dequantize", "requantize", "calib_minmax",
-           "calib_entropy", "quantize_model", "quantize_net", "QuantizedNet"]
+           "calib_entropy", "quantize_model", "quantize_net",
+           "QuantizedNet", "as_chain"]
 
 
 def quantize(data, min_range=None, max_range=None, out_type="int8"):
@@ -263,6 +264,41 @@ def _fold_batchnorm(layers):
     return records
 
 
+def as_chain(net, probe=None):
+    """Flatten the standard zoo composition `output(features(x))` into a
+    HybridSequential sharing the same Parameters, so chain-only passes
+    (`quantize_net`) can see the full layer stack of AlexNet/VGG-class
+    models instead of one opaque fp32 island.
+
+    The flattening assumes the block's forward is exactly
+    output∘features; pass a `probe` batch to VERIFY that numerically
+    (raises on mismatch) — composite forwards (residual adds, branches)
+    fail the probe instead of being silently mis-flattened."""
+    from ..gluon import nn as gnn
+
+    if not (hasattr(net, "features") and hasattr(net, "output")):
+        raise ValueError(
+            "as_chain: net has no features/output children (zoo chain "
+            "pattern); pass a (Hybrid)Sequential directly instead")
+    chain = gnn.HybridSequential(prefix="")
+    chain.add(net.features)
+    chain.add(net.output)
+    if probe is not None:
+        from .. import autograd as _ag
+
+        prev = _ag.set_training(False)
+        try:
+            a = net(probe).asnumpy()
+            b = chain(probe).asnumpy()
+        finally:
+            _ag.set_training(prev)
+        if not np.allclose(a, b, rtol=1e-5, atol=1e-5):
+            raise ValueError(
+                "as_chain: output(features(x)) does not reproduce the "
+                "net's forward — composite model, cannot flatten")
+    return chain
+
+
 class QuantizedNet:
     """Jittable int8 inference program produced by `quantize_net`.
 
@@ -323,6 +359,17 @@ class QuantizedNet:
             else:  # identity (Dropout at inference)
                 pass
         return q.astype(jnp.float32) / s
+
+    def apply(self, x):
+        """The traceable forward (jnp in -> jnp out): compose under an
+        outer jit / vmap / lax.scan; `__call__` is its jitted form."""
+        return self._run(x)
+
+    @property
+    def num_fp32_islands(self):
+        """Layers that fell back to fp32 between dequant/quant pairs;
+        0 means the whole program runs on the int8 path."""
+        return sum(1 for s in self._steps if s["kind"] == "fp32")
 
     def __call__(self, x):
         xd = x._data if isinstance(x, NDArray) else jnp.asarray(x)

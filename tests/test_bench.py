@@ -285,3 +285,16 @@ def test_benchmark_score_inference_sweep_executes(tmp_path):
     summary = lines[-1]
     assert summary["metric"] == "inference_images_per_sec"
     assert len(summary["results"]) == 2
+    # the int8 path (as_chain + quantize_net + int8 MXU program) must
+    # also execute end-to-end
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "benchmark_score.py"),
+         "--models", "alexnet", "--batch", "4", "--image", "64",
+         "--iters", "2", "--scan", "2", "--dtypes", "int8",
+         "--platform", "cpu"],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert p.returncode == 0, p.stderr[-2000:]
+    rows = [json.loads(ln) for ln in p.stdout.strip().splitlines()]
+    int8 = [r for r in rows if r.get("dtype") == "int8"][0]
+    assert "error" not in int8, int8
+    assert int8["ips"] > 0 and int8["scan_ips"] > 0

@@ -258,3 +258,60 @@ def test_quantize_net_last_layer_fused_relu():
     assert (g >= 0).all(), "last-layer fused relu was dropped"
     f = net(x).asnumpy()
     assert np.abs(f - g).max() / (np.abs(f).max() + 1e-9) < 0.1
+
+
+def test_as_chain_flattens_zoo_pattern():
+    """as_chain flattens output(features(x)) models (AlexNet/VGG class)
+    into the same-parameter Sequential, numerically verified, so
+    quantize_net sees every layer instead of one fp32 island."""
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    from incubator_mxnet_tpu import autograd
+
+    rng = np.random.RandomState(0)
+    net = vision.alexnet(classes=4)
+    net.initialize(mx.init.Xavier())
+    probe = nd.array(rng.rand(2, 3, 64, 64).astype(np.float32))
+    prev = autograd.set_training(False)
+    try:
+        net(probe)  # resolve deferred shapes
+        chain = q.as_chain(net, probe=probe)
+        a = net(probe).asnumpy()
+        b = chain(probe).asnumpy()
+    finally:
+        autograd.set_training(prev)
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+    # the flattened chain now quantizes with NO fp32 islands
+    calib = [[nd.array(rng.rand(4, 3, 64, 64).astype(np.float32))]
+             for _ in range(2)]
+    qnet = q.quantize_net(chain, calib, num_calib_batches=2)
+    assert qnet.num_fp32_islands == 0
+    g = qnet(probe).asnumpy()
+    assert g.shape == a.shape and np.isfinite(g).all()
+
+
+def test_as_chain_rejects_composite_forward():
+    """A model whose forward is NOT output(features(x)) must fail the
+    numeric probe instead of being silently mis-flattened."""
+    from incubator_mxnet_tpu.gluon.block import HybridBlock
+
+    class Scaled(HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                feats = nn.HybridSequential(prefix="")
+                feats.add(nn.Dense(8, activation="relu"))
+                self.features = feats
+                self.output = nn.Dense(3)
+
+        def hybrid_forward(self, F, x):
+            return self.output(self.features(x)) * 2.0  # not the pattern
+
+    net = Scaled()
+    net.initialize(mx.init.Xavier())
+    probe = nd.array(np.random.RandomState(0)
+                     .rand(2, 5).astype(np.float32))
+    net(probe)
+    with pytest.raises(ValueError, match="does not reproduce"):
+        q.as_chain(net, probe=probe)
+    with pytest.raises(ValueError, match="features/output"):
+        q.as_chain(nn.Dense(3))

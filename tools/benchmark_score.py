@@ -43,6 +43,50 @@ REF_V100 = {
 }
 
 
+def time_modes(fwd, gen_batch, batch, iters, scan_k):
+    """Shared measurement protocol: compile, per-batch dispatch timing,
+    then a lax.scan over K device-resident batches in one program.
+    `fwd(x)` must be traceable (jnp in -> jnp out)."""
+    import jax
+    import jax.numpy as jnp
+
+    jfwd = jax.jit(fwd)
+
+    def scan_fwd(xs):
+        def body(carry, x):
+            # per-batch argmax: forces the full forward while keeping the
+            # program output (and the device->host copy) tiny
+            return carry, jnp.argmax(fwd(x), axis=-1)
+        _, outs = jax.lax.scan(body, 0, xs)
+        return outs
+
+    jscan = jax.jit(scan_fwd)
+
+    x = gen_batch(0)
+    t0 = time.perf_counter()
+    jfwd(x).block_until_ready()
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(max(1, iters)):
+        out = jfwd(x)
+    out.block_until_ready()
+    ips = batch * max(1, iters) / (time.perf_counter() - t0)
+
+    scan_ips = 0.0
+    if scan_k > 1:
+        xs = gen_batch(1, lead=(scan_k,))
+        jscan(xs).block_until_ready()  # compile + warm
+        reps = max(1, iters // scan_k)
+        t0 = time.perf_counter()
+        outs = None
+        for _ in range(reps):
+            outs = jscan(xs)
+        outs.block_until_ready()
+        scan_ips = batch * scan_k * reps / (time.perf_counter() - t0)
+    return round(ips, 2), round(scan_ips, 2), round(compile_s, 1)
+
+
 def bench_model(name, batch, image, dtype, iters, scan_k, target):
     import numpy as np
     import jax
@@ -83,6 +127,13 @@ def bench_model(name, batch, image, dtype, iters, scan_k, target):
         finally:
             autograd.set_training(prev)
 
+    if dtype == "int8":
+        # calibrated int8 program (v5e int8 MXU rate: 2x bf16); only
+        # chain-structured nets quantize fully — residual nets fall back
+        # to fp32 islands and are not int8 benchmarks, so reject them
+        return bench_int8(name, net, batch, data_shape, iters, scan_k,
+                          target, cpu0)
+
     params = list(net.collect_params().items())
     names = [n for n, _ in params]
     specs = []
@@ -114,8 +165,9 @@ def bench_model(name, batch, image, dtype, iters, scan_k, target):
                                       jnp.float32).astype(jdtype)
         return jax.jit(g, out_shardings=sharding)(seed)
 
-    def fwd(ps, x):
-        mapping = {n: NDArray._from_data(d) for n, d in zip(names, ps)}
+    def fwd(x):
+        mapping = {n: NDArray._from_data(d)
+                   for n, d in zip(names, dev_params)}
         prev_t = autograd.set_training(False)
         prev_r = autograd.set_recording(False)
         try:
@@ -126,42 +178,50 @@ def bench_model(name, batch, image, dtype, iters, scan_k, target):
             autograd.set_recording(prev_r)
         return out._data
 
-    jfwd = jax.jit(fwd)
-
-    def scan_fwd(ps, xs):
-        def body(carry, x):
-            # per-batch argmax: forces the full forward while keeping the
-            # program output (and the device->host copy) tiny
-            return carry, jnp.argmax(fwd(ps, x), axis=-1)
-        _, outs = jax.lax.scan(body, 0, xs)
-        return outs
-
-    jscan = jax.jit(scan_fwd)
-
-    x = gen_batch(0)
-    t0 = time.perf_counter()
-    jfwd(dev_params, x).block_until_ready()
-    compile_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = jfwd(dev_params, x)
-    out.block_until_ready()
-    ips = batch * iters / (time.perf_counter() - t0)
-
-    scan_ips = 0.0
-    if scan_k > 1:
-        xs = gen_batch(1, lead=(scan_k,))
-        jscan(dev_params, xs).block_until_ready()  # compile + warm
-        reps = max(1, iters // scan_k)
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            outs = jscan(dev_params, xs)
-        outs.block_until_ready()
-        scan_ips = batch * scan_k * reps / (time.perf_counter() - t0)
-
+    ips, scan_ips, compile_s = time_modes(fwd, gen_batch, batch, iters,
+                                          scan_k)
     return {"model": name, "dtype": dtype, "batch": batch,
-            "ips": round(ips, 2), "scan_ips": round(scan_ips, 2),
-            "platform": target.platform, "compile_s": round(compile_s, 1)}
+            "ips": ips, "scan_ips": scan_ips,
+            "platform": target.platform, "compile_s": compile_s}
+
+
+def bench_int8(name, net, batch, data_shape, iters, scan_k, target, cpu0):
+    """Calibrated int8 inference throughput (the quantize_net path:
+    int8 convs/matmuls with int32 accumulation on the MXU integer path;
+    ref role: src/operator/quantization/ + contrib quantize_model)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.contrib import quantization as q
+
+    rng = np.random.RandomState(0)
+    with jax.default_device(cpu0):
+        probe = nd.array(rng.rand(*(2,) + data_shape[1:])
+                         .astype(np.float32))
+        chain = q.as_chain(net, probe=probe)  # zoo nets: output(features(x))
+        calib = [[nd.array(rng.rand(*(4,) + data_shape[1:])
+                           .astype(np.float32))] for _ in range(2)]
+        qnet = q.quantize_net(chain, calib, num_calib_batches=2)
+    if qnet.num_fp32_islands:
+        raise RuntimeError(
+            f"{name}: {qnet.num_fp32_islands} fp32 island(s) after "
+            f"quantization — not a pure int8 chain, skipping as an int8 "
+            f"benchmark")
+
+    sharding = jax.sharding.SingleDeviceSharding(target)
+
+    def gen_batch(seed, lead=()):
+        def g(s):
+            k = jax.random.PRNGKey(s)
+            return jax.random.uniform(k, lead + data_shape, jnp.float32)
+        return jax.jit(g, out_shardings=sharding)(seed)
+
+    ips, scan_ips, compile_s = time_modes(qnet.apply, gen_batch, batch,
+                                          iters, scan_k)
+    return {"model": name, "dtype": "int8", "batch": batch,
+            "ips": ips, "scan_ips": scan_ips,
+            "platform": target.platform, "compile_s": compile_s}
 
 
 def main():
