@@ -356,12 +356,26 @@ def _bank_on_chip(cache_path, results):
         pass
 
 
-def _probe_accelerator(timeout=150):
-    """Fast check that the TPU backend can initialize at all — a down
-    tunnel makes jax.devices() hang, and burning full bench timeouts on
-    every retry would blow the driver's budget."""
+def _probe_accelerator(timeout=150, exec_check=False):
+    """Fast check that the TPU backend can initialize — a down tunnel
+    makes jax.devices() hang, and burning full bench timeouts on every
+    retry would blow the driver's budget.
+
+    exec_check=True additionally compiles AND runs a tiny program on the
+    accelerator: a flapping tunnel can answer the init RPC yet hang
+    execution (observed round 5: probe 'up', then a 40-min child that
+    never reached its first measurement), and a full ResNet child should
+    only be spent on a tunnel that demonstrably executes."""
     code = ("import jax; ds = jax.devices(); "
             "print('ACCEL' if any(d.platform != 'cpu' for d in ds) else 'CPU')")
+    if exec_check:
+        code = (
+            "import jax, jax.numpy as jnp; "
+            "ds = [d for d in jax.devices() if d.platform != 'cpu']; "
+            "assert ds, 'cpu only'; "
+            "x = jax.device_put(jnp.ones((128, 128)), ds[0]); "
+            "y = jax.jit(lambda a: (a @ a).sum())(x); "
+            "y.block_until_ready(); print('ACCEL-EXEC')")
     try:
         p = subprocess.run([sys.executable, "-c", code], capture_output=True,
                            text=True, timeout=timeout)
@@ -376,8 +390,15 @@ def main():
         return
 
     accel_up = _probe_accelerator()
-    print(f"[bench] accelerator probe: {'up' if accel_up else 'down'}",
-          file=sys.stderr, flush=True)
+    if accel_up:
+        # init answered — now demand an actual round-trip execution
+        # before spending 40-minute measurement children on the window
+        accel_up = _probe_accelerator(timeout=240, exec_check=True)
+        print(f"[bench] accelerator probe: init up, exec "
+              f"{'up' if accel_up else 'HANGING (treating as down)'}",
+              file=sys.stderr, flush=True)
+    else:
+        print("[bench] accelerator probe: down", file=sys.stderr, flush=True)
 
     results, errors = {}, {}
     cache_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),

@@ -25,7 +25,7 @@ from bench import _probe_accelerator  # noqa: E402
 LOG = os.path.join(REPO, "tools", "bench_probe.log")
 PROBE_INTERVAL = int(os.environ.get("BENCH_PROBE_INTERVAL", "300"))
 MAX_HOURS = float(os.environ.get("BENCH_PROBE_MAX_HOURS", "11"))
-PROBE_TIMEOUT = 180
+PROBE_TIMEOUT = 300  # exec-check adds a cold compile over a laggy tunnel
 
 
 def log(msg):
@@ -36,7 +36,9 @@ def log(msg):
 
 
 def accel_up():
-    return _probe_accelerator(timeout=PROBE_TIMEOUT)
+    # exec_check: a window only counts if a tiny program RUNS end-to-end
+    # (a flapping tunnel answers init yet hangs execution — round 5)
+    return _probe_accelerator(timeout=PROBE_TIMEOUT, exec_check=True)
 
 
 def run_bench():

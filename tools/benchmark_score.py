@@ -43,20 +43,41 @@ REF_V100 = {
 }
 
 
-def time_modes(fwd, gen_batch, batch, iters, scan_k):
+def make_gen_batch(target, data_shape, jdtype=None):
+    """On-device synthetic batch generator (only seeds cross the wire)."""
+    import jax
+    import jax.numpy as jnp
+
+    sharding = jax.sharding.SingleDeviceSharding(target)
+
+    def gen_batch(seed, lead=()):
+        def g(s):
+            k = jax.random.PRNGKey(s)
+            x = jax.random.uniform(k, lead + data_shape, jnp.float32)
+            return x if jdtype is None else x.astype(jdtype)
+        return jax.jit(g, out_shardings=sharding)(seed)
+
+    return gen_batch
+
+
+def time_modes(fwd, gen_batch, batch, iters, scan_k, params=()):
     """Shared measurement protocol: compile, per-batch dispatch timing,
     then a lax.scan over K device-resident batches in one program.
-    `fwd(x)` must be traceable (jnp in -> jnp out)."""
+
+    `fwd(params, x)` must be traceable (jnp in -> jnp out); params ride
+    as RUNTIME jit arguments, never closure constants — weights baked
+    into the HLO would let XLA fold weight-only subgraphs out of the
+    timed steady-state and duplicate ~100MB models in device memory."""
     import jax
     import jax.numpy as jnp
 
     jfwd = jax.jit(fwd)
 
-    def scan_fwd(xs):
+    def scan_fwd(ps, xs):
         def body(carry, x):
             # per-batch argmax: forces the full forward while keeping the
             # program output (and the device->host copy) tiny
-            return carry, jnp.argmax(fwd(x), axis=-1)
+            return carry, jnp.argmax(fwd(ps, x), axis=-1)
         _, outs = jax.lax.scan(body, 0, xs)
         return outs
 
@@ -64,24 +85,24 @@ def time_modes(fwd, gen_batch, batch, iters, scan_k):
 
     x = gen_batch(0)
     t0 = time.perf_counter()
-    jfwd(x).block_until_ready()
+    jfwd(params, x).block_until_ready()
     compile_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     out = None
     for _ in range(max(1, iters)):
-        out = jfwd(x)
+        out = jfwd(params, x)
     out.block_until_ready()
     ips = batch * max(1, iters) / (time.perf_counter() - t0)
 
     scan_ips = 0.0
     if scan_k > 1:
         xs = gen_batch(1, lead=(scan_k,))
-        jscan(xs).block_until_ready()  # compile + warm
+        jscan(params, xs).block_until_ready()  # compile + warm
         reps = max(1, iters // scan_k)
         t0 = time.perf_counter()
         outs = None
         for _ in range(reps):
-            outs = jscan(xs)
+            outs = jscan(params, xs)
         outs.block_until_ready()
         scan_ips = batch * scan_k * reps / (time.perf_counter() - t0)
     return round(ips, 2), round(scan_ips, 2), round(compile_s, 1)
@@ -157,17 +178,10 @@ def bench_model(name, batch, image, dtype, iters, scan_k, target):
     dev_params = jax.jit(gen_params, out_shardings=sharding)(0)
 
     jdtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    gen_batch = make_gen_batch(target, data_shape, jdtype)
 
-    def gen_batch(seed, lead=()):
-        def g(s):
-            k = jax.random.PRNGKey(s)
-            return jax.random.uniform(k, lead + data_shape,
-                                      jnp.float32).astype(jdtype)
-        return jax.jit(g, out_shardings=sharding)(seed)
-
-    def fwd(x):
-        mapping = {n: NDArray._from_data(d)
-                   for n, d in zip(names, dev_params)}
+    def fwd(ps, x):
+        mapping = {n: NDArray._from_data(d) for n, d in zip(names, ps)}
         prev_t = autograd.set_training(False)
         prev_r = autograd.set_recording(False)
         try:
@@ -179,7 +193,7 @@ def bench_model(name, batch, image, dtype, iters, scan_k, target):
         return out._data
 
     ips, scan_ips, compile_s = time_modes(fwd, gen_batch, batch, iters,
-                                          scan_k)
+                                          scan_k, params=dev_params)
     return {"model": name, "dtype": dtype, "batch": batch,
             "ips": ips, "scan_ips": scan_ips,
             "platform": target.platform, "compile_s": compile_s}
@@ -209,16 +223,11 @@ def bench_int8(name, net, batch, data_shape, iters, scan_k, target, cpu0):
             f"quantization — not a pure int8 chain, skipping as an int8 "
             f"benchmark")
 
-    sharding = jax.sharding.SingleDeviceSharding(target)
-
-    def gen_batch(seed, lead=()):
-        def g(s):
-            k = jax.random.PRNGKey(s)
-            return jax.random.uniform(k, lead + data_shape, jnp.float32)
-        return jax.jit(g, out_shardings=sharding)(seed)
-
-    ips, scan_ips, compile_s = time_modes(qnet.apply, gen_batch, batch,
-                                          iters, scan_k)
+    gen_batch = make_gen_batch(target, data_shape)
+    # the int8 weights live inside QuantizedNet's program by design (its
+    # own jit embeds them); params therefore stays empty here
+    ips, scan_ips, compile_s = time_modes(lambda _ps, x: qnet.apply(x),
+                                          gen_batch, batch, iters, scan_k)
     return {"model": name, "dtype": "int8", "batch": batch,
             "ips": ips, "scan_ips": scan_ips,
             "platform": target.platform, "compile_s": compile_s}
