@@ -41,6 +41,24 @@ def accel_up():
     return _probe_accelerator(timeout=PROBE_TIMEOUT, exec_check=True)
 
 
+def _reap_bench_processes():
+    """Kill processes whose argv[1] is exactly this repo's bench.py."""
+    import glob
+
+    target = os.path.join(REPO, "bench.py")
+    for pid_dir in glob.glob("/proc/[0-9]*"):
+        try:
+            with open(os.path.join(pid_dir, "cmdline"), "rb") as f:
+                argv = f.read().split(b"\0")
+        except OSError:
+            continue
+        if len(argv) >= 2 and argv[1].decode(errors="replace") == target:
+            try:
+                os.kill(int(os.path.basename(pid_dir)), 9)
+            except (OSError, ValueError):
+                pass
+
+
 def run_bench():
     """Full bench (fp32 + bf16, scan mode). Returns True if a TPU number
     landed in BENCH_CACHE.json during this run."""
@@ -51,15 +69,29 @@ def run_bench():
             before = json.load(f).get("ts")
     except (OSError, ValueError):
         pass
+    # outer kill only as a last resort ABOVE bench.py's own budget: the
+    # whole point of BENCH_TOTAL_BUDGET is bench.py's graceful
+    # budget-exhausted/cached-fallback path — killing below it would
+    # truncate exactly the slow-compile window the budget exists for
+    try:
+        budget = float(os.environ.get("BENCH_TOTAL_BUDGET", "7500"))
+    except ValueError:
+        budget = 7500.0
     try:
         p = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
-                           capture_output=True, text=True, timeout=5400)
+                           capture_output=True, text=True,
+                           timeout=budget + 900)
         log(f"bench rc={p.returncode} out={p.stdout.strip()[-400:]}")
         if p.stderr:
             log("bench stderr tail: " + "\n".join(
                 p.stderr.strip().splitlines()[-10:]))
     except subprocess.TimeoutExpired:
-        log("bench timed out after 5400s")
+        log(f"bench timed out after {budget + 900:.0f}s")
+        # subprocess.run kills only the direct child; reap any orphaned
+        # measurement grandchild still holding the tunnel. Exact-argv
+        # match only — a substring kill ("bench.py") could hit unrelated
+        # processes whose command line merely mentions the script.
+        _reap_bench_processes()
         return False
     try:
         with open(cache) as f:
