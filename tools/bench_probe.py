@@ -101,6 +101,23 @@ def run_bench():
         return False
 
 
+def run_inference_bench():
+    """On-chip inference sweep (the reference headline table's other
+    half) banked into INFER_CACHE.json, which bench.py folds into the
+    driver artifact line."""
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "benchmark_score.py"),
+             "--models", "resnet50_v1", "--iters", "30", "--scan", "8",
+             "--bank", os.path.join(REPO, "INFER_CACHE.json")],
+            capture_output=True, text=True, timeout=3600)
+        log(f"inference bench rc={p.returncode} "
+            f"out={p.stdout.strip()[-500:]}")
+    except subprocess.TimeoutExpired:
+        log("inference bench timed out")
+
+
 def run_transformer_bench():
     """Bonus on-chip evidence once the headline number is banked: the
     flagship's train tokens/sec + KV-cache decode tokens/sec (flash +
@@ -129,6 +146,7 @@ def main():
             log("accelerator UP — running full bench")
             if run_bench():
                 log("fresh on-chip measurement cached — done")
+                run_inference_bench()
                 run_transformer_bench()
                 return 0
             log("bench ran but no fresh TPU number; will retry")

@@ -246,6 +246,10 @@ def main():
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (the axon plugin ignores "
                          "JAX_PLATFORMS env; use --platform cpu off-chip)")
+    ap.add_argument("--bank", default=None, metavar="PATH",
+                    help="merge ON-CHIP rows into this JSON cache "
+                         "(atomic, per model+dtype; bench.py folds the "
+                         "banked numbers into its driver artifact line)")
     args = ap.parse_args()
 
     import jax
@@ -280,6 +284,42 @@ def main():
             entry["vs_v100_ref"] = round(best / ref, 3)
         summary["results"].append(entry)
     print(json.dumps(summary), flush=True)
+    if args.bank:
+        bank_results(args.bank, summary["results"])
+
+
+def bank_results(path, rows):
+    """Merge on-chip rows into the cache keyed by (model, dtype); a new
+    row replaces an old one only with a better number (same discipline
+    as bench.py's per-dtype banking). Atomic replace."""
+    kept = {}
+    try:
+        with open(path) as f:
+            kept = {tuple(k.split("|")): v
+                    for k, v in json.load(f).get("results", {}).items()
+                    if isinstance(v, dict) and v.get("platform") != "cpu"}
+    except Exception:  # missing, unreadable, or malformed: start empty —
+        kept = {}      # a corrupt cache must never lose a finished sweep
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    changed = False
+    for r in rows:
+        if r.get("platform") == "cpu":
+            continue
+        key = (r["model"], r["dtype"])
+        old = kept.get(key)
+        if old is not None and old.get("best_ips", 0) >= r["best_ips"]:
+            continue
+        # per-row stamp: a later merge that keeps this row must not
+        # misreport its measurement age via the file-level ts
+        kept[key] = dict(r, ts=now)
+        changed = True
+    if not changed:
+        return
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"ts": now,
+                   "results": {"|".join(k): v for k, v in kept.items()}}, f)
+    os.replace(tmp, path)
 
 
 if __name__ == "__main__":
