@@ -82,10 +82,61 @@ def stack_arrays(*arrays, axis=0):
 
 
 # ---------------------------------------------------------------------------
+# DLPack interop (ref: python/mxnet/ndarray/ndarray.py
+# to_dlpack_for_read / to_dlpack_for_write / from_dlpack; the DLTensor
+# role of include/mxnet/tensor_blob.h:111)
+
+
+class _CapsuleHolder:
+    """Adapter for legacy 'dltensor' PyCapsules (the reference
+    from_dlpack's primary input): jax consumes only protocol objects, so
+    wrap the capsule in one. A bare capsule carries no introspectable
+    device, and every legacy producer hands over host memory, so this
+    reports kDLCPU — protocol objects (preferred) carry their device."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, **kwargs):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (1, 0)  # (kDLCPU, 0)
+
+
+def from_dlpack(obj):
+    """Wrap any DLPack-exporting object (torch tensor, numpy array,
+    another framework's tensor) as an NDArray, zero-copy where the
+    producer's device/layout allows. Legacy 'dltensor' capsules are
+    accepted too and are assumed host-resident (see _CapsuleHolder)."""
+    if type(obj).__name__ == "PyCapsule":
+        obj = _CapsuleHolder(obj)
+    return NDArray._from_data(jnp.from_dlpack(obj))
+
+
+def to_dlpack_for_read(arr):
+    """Export `arr` through the DLPack protocol for read-only use
+    (e.g. `torch.from_dlpack`). XLA buffers are immutable, so reads
+    always see a consistent value."""
+    arr.wait_to_read()
+    return arr._data.__dlpack__()
+
+
+def to_dlpack_for_write(arr):
+    """The reference's for-write variant aliases the buffer for in-place
+    mutation by the consumer. XLA device buffers are immutable — aliased
+    writes cannot be supported. Consumers should write into their own
+    tensor and wrap it back with `from_dlpack` (zero-copy on CPU)."""
+    raise NotImplementedError(
+        "to_dlpack_for_write: XLA buffers are immutable; write into a "
+        "consumer-owned tensor and re-import it with nd.from_dlpack "
+        "instead (zero-copy on CPU)")
+
+
+# ---------------------------------------------------------------------------
 # serialization (ref: src/ndarray/ndarray.cc Save/Load,
 # python/mxnet/ndarray/utils.py:149 save / :222 load). Our container format:
 # magic + count + per-entry (name, dtype, shape, raw little-endian bytes).
-# ---------------------------------------------------------------------------
 
 _MAGIC = b"MXTPU001"
 

@@ -119,6 +119,16 @@ class NDArray:
     def wait_to_write(self):
         self._data.block_until_ready()
 
+    # -- DLPack interop (ref: ndarray.py to_dlpack_for_read/from_dlpack;
+    # include/mxnet/tensor_blob.h:111 DLTensor) -----------------------------
+    def __dlpack__(self, **kwargs):
+        """Standard DLPack protocol: `torch.from_dlpack(nd_array)` and
+        `np.from_dlpack(nd_array)` view the buffer zero-copy."""
+        return self._data.__dlpack__(**kwargs)
+
+    def __dlpack_device__(self):
+        return self._data.__dlpack_device__()
+
     # -- autograd ----------------------------------------------------------
     def attach_grad(self, grad_req="write", stype=None):
         """Allocate a gradient buffer (ref: autograd.mark_variables). Detaches."""

@@ -173,3 +173,39 @@ def test_array_from_jax_preserves_buffer_and_dtype():
     assert nd.array(src, dtype="float32").dtype == "float32"
     # lists keep the reference's float32 default
     assert nd.array([[1, 2], [3, 4]]).dtype == "float32"
+
+
+def test_dlpack_roundtrip_torch_and_numpy():
+    """DLPack interop (ref: ndarray.py to_dlpack_for_read/from_dlpack):
+    zero-copy exchange with torch and numpy through the standard
+    protocol, both directions, plus the legacy capsule path."""
+    import numpy as np
+    import torch
+
+    a = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    # protocol export: torch views the buffer
+    t = torch.from_dlpack(a)
+    np.testing.assert_array_equal(t.numpy(), a.asnumpy())
+    # import: a torch tensor becomes an NDArray
+    src = torch.arange(6, dtype=torch.float32).reshape(2, 3) * 2
+    b = nd.from_dlpack(src)
+    assert isinstance(b, nd.NDArray)
+    np.testing.assert_array_equal(b.asnumpy(), src.numpy())
+    # ops compose on the imported array
+    np.testing.assert_allclose((b + 1).asnumpy(), src.numpy() + 1)
+    # legacy capsule export
+    cap = nd.to_dlpack_for_read(a)
+    t2 = torch.utils.dlpack.from_dlpack(cap)
+    np.testing.assert_array_equal(t2.numpy(), a.asnumpy())
+    # numpy protocol import of our array
+    n = np.from_dlpack(a)
+    np.testing.assert_array_equal(n, a.asnumpy())
+    # legacy capsule IMPORT (the reference from_dlpack's primary input)
+    cap2 = torch.utils.dlpack.to_dlpack(
+        torch.arange(4, dtype=torch.float32) + 7)
+    c = nd.from_dlpack(cap2)
+    np.testing.assert_array_equal(c.asnumpy(),
+                                  np.arange(4, dtype=np.float32) + 7)
+    # for-write is an explicit, documented refusal (immutable buffers)
+    with pytest.raises(NotImplementedError, match="immutable"):
+        nd.to_dlpack_for_write(a)
