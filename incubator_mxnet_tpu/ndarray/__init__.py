@@ -91,8 +91,11 @@ class _CapsuleHolder:
     """Adapter for legacy 'dltensor' PyCapsules (the reference
     from_dlpack's primary input): jax consumes only protocol objects, so
     wrap the capsule in one. A bare capsule carries no introspectable
-    device, and every legacy producer hands over host memory, so this
-    reports kDLCPU — protocol objects (preferred) carry their device."""
+    device, so this reports kDLCPU — true for every capsule this module
+    itself produces (to_dlpack_for_read exports host buffers) and for
+    CPU-framework producers. A capsule wrapping ACCELERATOR memory from
+    a third party cannot be imported this way; hand over the producer's
+    tensor object instead (the protocol carries the true device)."""
 
     def __init__(self, capsule):
         self._capsule = capsule
@@ -115,11 +118,22 @@ def from_dlpack(obj):
 
 
 def to_dlpack_for_read(arr):
-    """Export `arr` through the DLPack protocol for read-only use
-    (e.g. `torch.from_dlpack`). XLA buffers are immutable, so reads
-    always see a consistent value."""
+    """Export `arr` as a legacy DLPack capsule for read-only use
+    (e.g. `torch.utils.dlpack.from_dlpack`). XLA buffers are immutable,
+    so reads always see a consistent value.
+
+    CPU-resident arrays export zero-copy. Accelerator-resident arrays
+    are copied to host first and export the HOST buffer — no external
+    framework can address a TPU buffer through a raw capsule, and this
+    keeps every capsule this module produces host-resident (the
+    assumption _CapsuleHolder relies on for re-import). For same-device
+    exchange, pass the NDArray itself: the `__dlpack__` protocol carries
+    the true device."""
     arr.wait_to_read()
-    return arr._data.__dlpack__()
+    d = arr._data
+    if any(dev.platform != "cpu" for dev in d.devices()):
+        return np.asarray(jax.device_get(d)).__dlpack__()
+    return d.__dlpack__()
 
 
 def to_dlpack_for_write(arr):
