@@ -423,3 +423,32 @@ def test_corrupt_infer_cache_never_suppresses_artifact(cache_guard):
             shutil.move(backup, infer_path)
         elif os.path.exists(infer_path):
             os.remove(infer_path)
+
+
+@pytest.mark.skipif(not os.environ.get("MXTPU_NIGHTLY"),
+                    reason="two program compiles + calibration; nightly tier")
+def test_perf_analysis_infer_executes(tmp_path):
+    """The offline inference-program analysis (perf_analysis_infer) must
+    run end-to-end and report the structural facts the TPU mapping
+    relies on: all resnet convs bf16 (NHWC), all int8 convs accumulating
+    in i32."""
+    import subprocess
+
+    report = tmp_path / "infer.md"
+    env = dict(os.environ)
+    env["JAX_COMPILATION_CACHE_DIR"] = str(tmp_path / "jc")
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "perf_analysis_infer.py"),
+         "--batch-resnet", "4", "--batch-alexnet", "4", "--image", "64",
+         "--scan", "2", "--report", str(report)],
+        capture_output=True, text=True, timeout=1200, env=env)
+    assert p.returncode == 0, p.stderr[-2000:]
+    rows = [json.loads(ln) for ln in p.stdout.strip().splitlines()]
+    assert len(rows) == 2
+    resnet, alexnet = rows
+    assert set(resnet["conv_out_dtypes"]) == {"bf16"}
+    assert resnet["nhwc_convs"] == resnet["convolutions"]
+    assert set(alexnet["conv_out_dtypes"]) == {"i32"}
+    assert alexnet["v5e_roofline_img_per_s"] > 0
+    assert "ROOFLINE" in report.read_text()
