@@ -105,17 +105,25 @@ def run_inference_bench():
     """On-chip inference sweep (the reference headline table's other
     half) banked into INFER_CACHE.json, which bench.py folds into the
     driver artifact line."""
-    try:
-        p = subprocess.run(
-            [sys.executable, os.path.join(REPO, "tools",
-                                          "benchmark_score.py"),
-             "--models", "resnet50_v1", "--iters", "30", "--scan", "8",
-             "--bank", os.path.join(REPO, "INFER_CACHE.json")],
-            capture_output=True, text=True, timeout=3600)
-        log(f"inference bench rc={p.returncode} "
-            f"out={p.stdout.strip()[-500:]}")
-    except subprocess.TimeoutExpired:
-        log("inference bench timed out")
+    bank = os.path.join(REPO, "INFER_CACHE.json")
+    sweeps = [
+        # headline: ResNet-50 bf16/fp32 (ref fp16 2355 / fp32 1233 img/s)
+        ["--models", "resnet50_v1", "--iters", "30", "--scan", "8"],
+        # int8 chain (MXU integer path, 2x bf16 rate; ref AlexNet 10990)
+        ["--models", "alexnet", "--batch", "256", "--iters", "30",
+         "--scan", "8", "--dtypes", "int8"],
+    ]
+    for extra in sweeps:
+        try:
+            p = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "tools", "benchmark_score.py"),
+                 "--bank", bank] + extra,
+                capture_output=True, text=True, timeout=3600)
+            log(f"inference bench {extra[1]}/{extra[-1]} rc={p.returncode} "
+                f"out={p.stdout.strip()[-500:]}")
+        except subprocess.TimeoutExpired:
+            log(f"inference bench {extra[1]} timed out")
 
 
 def run_transformer_bench():
