@@ -41,6 +41,15 @@ int MXTpuImpGrad(void* h, void** grad_out);
 int MXTpuImpRecordBegin(int train_mode);
 int MXTpuImpRecordEnd(void);
 int MXTpuImpBackward(void* loss);
+int MXTpuImpSymBind(const char* symbol_json, const char** arg_names,
+                    void** arg_handles, int n_args,
+                    const char** grad_names, int n_grad, void** out_exec);
+int MXTpuImpExecSetArg(void* exec, const char* name, void* nd);
+int MXTpuImpExecForward(void* exec, int is_train, void** outputs, int max_out,
+                        int* n_out);
+int MXTpuImpExecBackward(void* exec);
+int MXTpuImpExecGrad(void* exec, const char* arg_name, void** grad_out);
+int MXTpuImpExecFree(void* exec);
 }
 
 namespace mxtpu {
@@ -262,6 +271,74 @@ class NDArray {
   NDArray grad() const {
     void* g = nullptr;
     check(MXTpuImpGrad(h_, &g), "grad");
+    return NDArray(g);
+  }
+
+ private:
+  void* h_ = nullptr;
+};
+
+// ---------------------------------------------------------------------------
+// SymbolExecutor: whole-graph compiled execution (ref: the C ABI's
+// MXExecutorSimpleBind + GraphExecutor role, src/c_api/c_api_executor.cc).
+// Bind a symbol JSON (the Python frontend's Symbol.tojson schema — also
+// produced by the JVM Symbol API) over named argument arrays; forward runs
+// the ENTIRE graph as one jitted XLA program (contrast the per-op invoke
+// path of the generated mxtpu_ops.hpp wrappers).
+// ---------------------------------------------------------------------------
+class SymbolExecutor {
+ public:
+  SymbolExecutor(const std::string& symbol_json,
+                 const std::vector<std::pair<std::string, NDArray>>& args,
+                 const std::vector<std::string>& grad_names = {}) {
+    std::vector<const char*> names;
+    std::vector<void*> handles;
+    names.reserve(args.size());
+    handles.reserve(args.size());
+    for (const auto& kv : args) {
+      names.push_back(kv.first.c_str());
+      handles.push_back(kv.second.handle());
+    }
+    std::vector<const char*> gnames;
+    gnames.reserve(grad_names.size());
+    for (const auto& g : grad_names) gnames.push_back(g.c_str());
+    check(MXTpuImpSymBind(symbol_json.c_str(), names.data(), handles.data(),
+                          static_cast<int>(names.size()), gnames.data(),
+                          static_cast<int>(gnames.size()), &h_),
+          "SymbolExecutor::bind");
+  }
+  ~SymbolExecutor() { MXTpuImpExecFree(h_); }
+  SymbolExecutor(const SymbolExecutor&) = delete;
+  SymbolExecutor& operator=(const SymbolExecutor&) = delete;
+
+  // Feed new data into a bound argument (dtype-preserving).
+  void setArg(const std::string& name, const NDArray& nd) {
+    check(MXTpuImpExecSetArg(h_, name.c_str(), nd.handle()),
+          "SymbolExecutor::setArg");
+  }
+
+  // `max_out` bounds the output buffer (raise it for Group symbols with
+  // many heads; the ABI itself has no fixed limit).
+  std::vector<NDArray> forward(bool is_train = false, int max_out = 8) {
+    std::vector<void*> outs(static_cast<size_t>(max_out), nullptr);
+    int n_out = 0;
+    check(MXTpuImpExecForward(h_, is_train ? 1 : 0, outs.data(), max_out,
+                              &n_out),
+          "SymbolExecutor::forward");
+    std::vector<NDArray> r;
+    r.reserve(static_cast<size_t>(n_out));
+    for (int i = 0; i < n_out; ++i) r.emplace_back(outs[i]);
+    return r;
+  }
+
+  // Ones-seeded backward into the bound gradient arrays.
+  void backward() {
+    check(MXTpuImpExecBackward(h_), "SymbolExecutor::backward");
+  }
+
+  NDArray gradOf(const std::string& name) const {
+    void* g = nullptr;
+    check(MXTpuImpExecGrad(h_, name.c_str(), &g), "SymbolExecutor::gradOf");
     return NDArray(g);
   }
 

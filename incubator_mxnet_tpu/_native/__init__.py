@@ -246,6 +246,20 @@ def imperative_lib():
                                      ctypes.POINTER(ctypes.c_void_p)]
         lib.MXTpuImpRecordBegin.argtypes = [ctypes.c_int]
         lib.MXTpuImpBackward.argtypes = [ctypes.c_void_p]
+        lib.MXTpuImpSymBind.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_void_p)]
+        lib.MXTpuImpExecSetArg.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                           ctypes.c_void_p]
+        lib.MXTpuImpExecForward.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+        lib.MXTpuImpExecBackward.argtypes = [ctypes.c_void_p]
+        lib.MXTpuImpExecGrad.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.POINTER(ctypes.c_void_p)]
+        lib.MXTpuImpExecFree.argtypes = [ctypes.c_void_p]
         lib._imp_configured = True
     return lib
 

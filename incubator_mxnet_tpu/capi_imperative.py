@@ -107,3 +107,60 @@ def backward(loss):
 
 def op_list():
     return "\n".join(sorted(OP_REGISTRY))
+
+
+# -- graph-level execution (ref: c_api_executor.cc MXExecutorSimpleBind /
+# GraphExecutor — the whole symbol runs as ONE jitted XLA program, unlike
+# the per-op `invoke` path above) -------------------------------------------
+
+
+def sym_bind(symbol_json, names, arrays, grad_names):
+    """Bind a serialized symbol over named argument arrays -> Executor.
+
+    `grad_names` selects the arguments that accumulate gradients during
+    exec_backward (grad_req='write'); everything else binds 'null'."""
+    from .symbol import symbol as sym_mod
+
+    s = sym_mod.load_json(symbol_json)
+    wanted = s.list_arguments()
+    # None = a null C handle: treat as not supplied (clean error below)
+    args = {n: a for n, a in zip(list(names), list(arrays)) if a is not None}
+    missing = [n for n in wanted if n not in args]
+    if missing:
+        raise ValueError(f"sym_bind: missing arguments {missing}")
+    gset = set(grad_names)
+    unknown = sorted(gset - set(wanted))
+    if unknown:
+        raise ValueError(f"sym_bind: grad names {unknown} are not "
+                         f"arguments of the symbol")
+    reqs = {n: ("write" if n in gset else "null") for n in wanted}
+    return s.bind(args={n: args[n] for n in wanted}, grad_req=reqs)
+
+
+def exec_set_arg(ex, name, nd):
+    """Feed new data into a bound argument (dtype-preserving, the
+    Executor.forward(**kwargs) semantics)."""
+    if name not in ex.arg_dict:
+        raise KeyError(f"exec_set_arg: unknown argument '{name}'")
+    data = nd._data
+    slot = ex.arg_dict[name]._data
+    if data.dtype != slot.dtype:
+        data = data.astype(slot.dtype)
+    ex.arg_dict[name]._data = data
+
+
+def exec_forward(ex, is_train):
+    """Run the compiled graph; returns the output NDArrays."""
+    return list(ex.forward(is_train=bool(is_train)))
+
+
+def exec_backward(ex):
+    """Ones-seeded backward into the bound gradient arrays."""
+    ex.backward()
+
+
+def exec_grad(ex, name):
+    g = ex.grad_dict.get(name)
+    if g is None:
+        raise KeyError(f"exec_grad: no gradient bound for '{name}'")
+    return g

@@ -276,4 +276,99 @@ int MXTpuImpBackward(void* loss) {
   return 0;
 }
 
+// -- graph-level execution (ref: src/c_api/c_api_executor.cc
+// MXExecutorSimpleBind + GraphExecutor::Forward/Backward): the whole
+// symbol JSON binds to ONE jitted XLA program, unlike the per-op
+// MXTpuImpInvoke path. Executor handles are PyObject*; free with
+// MXTpuImpExecFree.
+
+int MXTpuImpSymBind(const char* symbol_json, const char** arg_names,
+                    void** arg_handles, int n_args,
+                    const char** grad_names, int n_grad, void** out_exec) {
+  Gil gil;
+  PyObject* names = PyList_New(n_args);
+  PyObject* arrays = PyList_New(n_args);
+  for (int i = 0; i < n_args; ++i) {
+    PyList_SET_ITEM(names, i, PyUnicode_FromString(arg_names[i]));
+    // null handle -> None (same mapping as MXTpuImpInvoke's optional
+    // inputs); the Python side reports it as a missing argument cleanly
+    PyObject* o = arg_handles[i] ? static_cast<PyObject*>(arg_handles[i])
+                                 : Py_None;
+    Py_INCREF(o);
+    PyList_SET_ITEM(arrays, i, o);
+  }
+  PyObject* grads = PyList_New(n_grad);
+  for (int i = 0; i < n_grad; ++i) {
+    PyList_SET_ITEM(grads, i, PyUnicode_FromString(grad_names[i]));
+  }
+  PyObject* args = Py_BuildValue("(sNNN)", symbol_json, names, arrays, grads);
+  PyObject* r = call("sym_bind", args);
+  Py_DECREF(args);
+  if (!r) return fail("sym_bind");
+  *out_exec = r;
+  return 0;
+}
+
+int MXTpuImpExecSetArg(void* exec, const char* name, void* nd) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OsO)", static_cast<PyObject*>(exec), name,
+                                 static_cast<PyObject*>(nd));
+  PyObject* r = call("exec_set_arg", args);
+  Py_DECREF(args);
+  if (!r) return fail("exec_set_arg");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuImpExecForward(void* exec, int is_train, void** outputs, int max_out,
+                        int* n_out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oi)", static_cast<PyObject*>(exec),
+                                 is_train);
+  PyObject* r = call("exec_forward", args);
+  Py_DECREF(args);
+  if (!r) return fail("exec_forward");
+  Py_ssize_t n = PyList_Size(r);
+  if (n > max_out) {
+    Py_DECREF(r);
+    g_err = "output buffer too small";
+    return 1;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GET_ITEM(r, i);
+    Py_INCREF(o);
+    outputs[i] = o;
+  }
+  *n_out = static_cast<int>(n);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuImpExecBackward(void* exec) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(exec));
+  PyObject* r = call("exec_backward", args);
+  Py_DECREF(args);
+  if (!r) return fail("exec_backward");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuImpExecGrad(void* exec, const char* arg_name, void** grad_out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", static_cast<PyObject*>(exec),
+                                 arg_name);
+  PyObject* r = call("exec_grad", args);
+  Py_DECREF(args);
+  if (!r) return fail("exec_grad");
+  *grad_out = r;
+  return 0;
+}
+
+int MXTpuImpExecFree(void* exec) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject*>(exec));
+  return 0;
+}
+
 }  // extern "C"
