@@ -1,0 +1,91 @@
+package org.apache.mxtpu;
+
+import java.util.Map;
+
+/**
+ * Whole-graph compiled execution of a {@link Symbol} (reference role:
+ * the C ABI executor path — MXExecutorSimpleBind + GraphExecutor — that
+ * scala-package's Executor wraps).
+ *
+ * Contrast {@link Executor}, which walks the graph op-by-op through the
+ * imperative runtime: here the ENTIRE symbol binds once in the runtime
+ * and every {@link #forward} runs one jitted XLA program. Feed new data
+ * with {@link #setArg}; gradients come from the executor's own bound
+ * gradient arrays ({@link #gradOf}), no attachGrad/record needed.
+ */
+public final class CompiledExecutor implements AutoCloseable {
+  private long handle;
+
+  public CompiledExecutor(Symbol sym, Map<String, NDArray> args,
+                          String[] gradWrt) {
+    String[] names = new String[args.size()];
+    long[] handles = new long[args.size()];
+    int i = 0;
+    for (Map.Entry<String, NDArray> e : args.entrySet()) {
+      names[i] = e.getKey();
+      handles[i] = e.getValue().handle();
+      i++;
+    }
+    handle = LibMXTpu.symBind(sym.toJson(), names, handles,
+        gradWrt == null ? new String[0] : gradWrt);
+    if (handle == 0) {
+      throw new MXTpuException("symBind: " + LibMXTpu.lastError());
+    }
+  }
+
+  /** Feed new data into a bound argument (dtype-preserving). */
+  public void setArg(String name, NDArray nd) {
+    checkOpen();
+    if (LibMXTpu.execSetArg(handle, name, nd.handle()) != 0) {
+      throw new MXTpuException("execSetArg " + name + ": "
+          + LibMXTpu.lastError());
+    }
+  }
+
+  /** Run the compiled graph; returns the head outputs. */
+  public NDArray[] forward(boolean train) {
+    checkOpen();
+    long[] outs = LibMXTpu.execForward(handle, train ? 1 : 0);
+    if (outs == null) {
+      throw new MXTpuException("execForward: " + LibMXTpu.lastError());
+    }
+    NDArray[] r = new NDArray[outs.length];
+    for (int i = 0; i < outs.length; i++) {
+      r[i] = new NDArray(outs[i]);
+    }
+    return r;
+  }
+
+  /** Ones-seeded backward into the executor's gradient arrays. */
+  public void backward() {
+    checkOpen();
+    if (LibMXTpu.execBackward(handle) != 0) {
+      throw new MXTpuException("execBackward: " + LibMXTpu.lastError());
+    }
+  }
+
+  /** Gradient of a gradWrt argument from the last backward. */
+  public NDArray gradOf(String argName) {
+    checkOpen();
+    long g = LibMXTpu.execGrad(handle, argName);
+    if (g == 0) {
+      throw new MXTpuException("execGrad " + argName + ": "
+          + LibMXTpu.lastError());
+    }
+    return new NDArray(g);
+  }
+
+  private void checkOpen() {
+    if (handle == 0) {
+      throw new MXTpuException("CompiledExecutor used after close()");
+    }
+  }
+
+  @Override
+  public void close() {
+    if (handle != 0) {
+      LibMXTpu.execFree(handle);
+      handle = 0;
+    }
+  }
+}

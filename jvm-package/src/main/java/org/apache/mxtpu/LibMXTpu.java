@@ -48,6 +48,20 @@ final class LibMXTpu {
 
   static native int backward(long lossHandle);
 
+  // --- graph-level executor (whole-symbol compiled execution) ----------
+  static native long symBind(String symbolJson, String[] argNames,
+                             long[] argHandles, String[] gradNames);
+
+  static native int execSetArg(long exec, String name, long nd);
+
+  static native long[] execForward(long exec, int isTrain);
+
+  static native int execBackward(long exec);
+
+  static native long execGrad(long exec, String argName);
+
+  static native int execFree(long exec);
+
   // --- .mxt trainer ----------------------------------------------------
   static native long trainerCreate(String mxtPath, String pluginPathOrNull);
 

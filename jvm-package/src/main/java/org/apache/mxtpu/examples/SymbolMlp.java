@@ -14,6 +14,7 @@ import org.apache.mxtpu.MXTpu;
 import org.apache.mxtpu.NDArray;
 import org.apache.mxtpu.Ops;
 import org.apache.mxtpu.Symbol;
+import org.apache.mxtpu.CompiledExecutor;
 import org.apache.mxtpu.SymbolModule;
 
 /**
@@ -164,6 +165,45 @@ public final class SymbolMlp {
         System.out.println("MODULE_FAILED");
         System.exit(1);
       }
+    }
+
+    // CompiledExecutor: the same loss graph bound ONCE in the runtime,
+    // each forward one jitted XLA program (the GraphExecutor contract)
+    Map<String, NDArray> cargs = new LinkedHashMap<>();
+    cargs.put("x", NDArray.fromFloats(new long[] {batch, inDim}, xs));
+    cargs.put("w1", NDArray.fromFloats(new long[] {hidden, inDim},
+        lcg(hidden * inDim, 8)));
+    cargs.put("b1", NDArray.zeros(hidden));
+    cargs.put("w2", NDArray.fromFloats(new long[] {classes, hidden},
+        lcg(classes * hidden, 9)));
+    cargs.put("b2", NDArray.zeros(classes));
+    cargs.put("label", NDArray.fromFloats(new long[] {batch}, ys));
+    AttrMap csgd = AttrMap.of().set("lr", 0.1).set("rescale_grad",
+        1.0 / batch);
+    float cfirst = Float.NaN;
+    float clast = Float.NaN;
+    try (CompiledExecutor cexec = new CompiledExecutor(loss, cargs, params)) {
+      for (int step = 0; step < 30; step++) {
+        float l = cexec.forward(true)[0].scalar() / batch;
+        if (step == 0) {
+          cfirst = l;
+        }
+        clast = l;
+        cexec.backward();
+        for (String p : params) {
+          NDArray updated = Ops.sgd_update(cargs.get(p), cexec.gradOf(p),
+              csgd);
+          cexec.setArg(p, updated);
+          cargs.put(p, updated);
+        }
+      }
+    }
+    System.out.printf("compiled fit first %.4f last %.4f%n", cfirst, clast);
+    if (clast < cfirst) {
+      System.out.println("COMPILED_FITTED");
+    } else {
+      System.out.println("COMPILED_FAILED");
+      System.exit(1);
     }
   }
 }

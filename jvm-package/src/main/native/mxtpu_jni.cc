@@ -31,6 +31,15 @@ int MXTpuImpGrad(void* h, void** grad_out);
 int MXTpuImpRecordBegin(int train_mode);
 int MXTpuImpRecordEnd(void);
 int MXTpuImpBackward(void* loss);
+int MXTpuImpSymBind(const char* symbol_json, const char** arg_names,
+                    void** arg_handles, int n_args,
+                    const char** grad_names, int n_grad, void** out_exec);
+int MXTpuImpExecSetArg(void* exec, const char* name, void* nd);
+int MXTpuImpExecForward(void* exec, int is_train, void** outputs, int max_out,
+                        int* n_out);
+int MXTpuImpExecBackward(void* exec);
+int MXTpuImpExecGrad(void* exec, const char* arg_name, void** grad_out);
+int MXTpuImpExecFree(void* exec);
 // trainer ABI (include/mxtpu.h)
 typedef void* MXTpuTrainerHandle;
 int MXTpuTrainerCreate(const char* path, const char* plugin,
@@ -178,6 +187,109 @@ Java_org_apache_mxtpu_LibMXTpu_recordEnd(JNIEnv*, jclass) {
 JNIEXPORT jint JNICALL
 Java_org_apache_mxtpu_LibMXTpu_backward(JNIEnv*, jclass, jlong h) {
   return MXTpuImpBackward(reinterpret_cast<void*>(h));
+}
+
+namespace {
+
+// jobjectArray of String -> owned std::strings + c_str views
+void jstrs(JNIEnv* env, jobjectArray arr, std::vector<std::string>* owned,
+           std::vector<const char*>* views) {
+  jsize n = arr ? env->GetArrayLength(arr) : 0;
+  owned->resize(static_cast<size_t>(n));
+  views->resize(static_cast<size_t>(n));
+  for (jsize i = 0; i < n; ++i) {
+    jstring s = static_cast<jstring>(env->GetObjectArrayElement(arr, i));
+    (*owned)[static_cast<size_t>(i)] = jstr(env, s);
+    (*views)[static_cast<size_t>(i)] =
+        (*owned)[static_cast<size_t>(i)].c_str();
+    if (s) env->DeleteLocalRef(s);
+  }
+}
+
+}  // namespace
+
+JNIEXPORT jlong JNICALL Java_org_apache_mxtpu_LibMXTpu_symBind(
+    JNIEnv* env, jclass, jstring json, jobjectArray argNames,
+    jlongArray argHandles, jobjectArray gradNames) {
+  std::vector<std::string> names_s, grads_s;
+  std::vector<const char*> names_c, grads_c;
+  jstrs(env, argNames, &names_s, &names_c);
+  jstrs(env, gradNames, &grads_s, &grads_c);
+  jsize n = env->GetArrayLength(argHandles);
+  if (n != static_cast<jsize>(names_c.size())) {
+    // the native error ring belongs to the Imp runtime; report the
+    // caller bug as a Java exception instead of an empty-detail failure
+    jclass exc = env->FindClass("java/lang/IllegalArgumentException");
+    if (exc) {
+      env->ThrowNew(exc, "symBind: argNames/argHandles length mismatch");
+    }
+    return 0;
+  }
+  std::vector<jlong> raw(static_cast<size_t>(n));
+  env->GetLongArrayRegion(argHandles, 0, n, raw.data());
+  std::vector<void*> handles(static_cast<size_t>(n));
+  for (jsize i = 0; i < n; ++i)
+    handles[static_cast<size_t>(i)] =
+        reinterpret_cast<void*>(raw[static_cast<size_t>(i)]);
+  std::string json_s = jstr(env, json);
+  void* ex = nullptr;
+  if (MXTpuImpSymBind(json_s.c_str(), names_c.data(), handles.data(),
+                      static_cast<int>(n), grads_c.data(),
+                      static_cast<int>(grads_c.size()), &ex) != 0) {
+    return 0;
+  }
+  return reinterpret_cast<jlong>(ex);
+}
+
+JNIEXPORT jint JNICALL Java_org_apache_mxtpu_LibMXTpu_execSetArg(
+    JNIEnv* env, jclass, jlong exec, jstring name, jlong nd) {
+  std::string n = jstr(env, name);
+  return MXTpuImpExecSetArg(reinterpret_cast<void*>(exec), n.c_str(),
+                            reinterpret_cast<void*>(nd));
+}
+
+JNIEXPORT jlongArray JNICALL Java_org_apache_mxtpu_LibMXTpu_execForward(
+    JNIEnv* env, jclass, jlong exec, jint isTrain) {
+  // grow-and-retry: a Group symbol can have arbitrarily many heads and
+  // Java has no max_out knob (the C++ SymbolExecutor exposes one)
+  std::vector<void*> outs(16, nullptr);
+  int n_out = 0;
+  int rc = MXTpuImpExecForward(reinterpret_cast<void*>(exec), isTrain,
+                               outs.data(), static_cast<int>(outs.size()),
+                               &n_out);
+  if (rc != 0 &&
+      std::strcmp(MXTpuImpError(), "output buffer too small") == 0) {
+    outs.assign(4096, nullptr);
+    rc = MXTpuImpExecForward(reinterpret_cast<void*>(exec), isTrain,
+                             outs.data(), static_cast<int>(outs.size()),
+                             &n_out);
+  }
+  if (rc != 0) return nullptr;
+  jlongArray out = env->NewLongArray(n_out);
+  std::vector<jlong> vals(static_cast<size_t>(n_out));
+  for (int i = 0; i < n_out; ++i)
+    vals[static_cast<size_t>(i)] = reinterpret_cast<jlong>(outs[i]);
+  env->SetLongArrayRegion(out, 0, n_out, vals.data());
+  return out;
+}
+
+JNIEXPORT jint JNICALL
+Java_org_apache_mxtpu_LibMXTpu_execBackward(JNIEnv*, jclass, jlong exec) {
+  return MXTpuImpExecBackward(reinterpret_cast<void*>(exec));
+}
+
+JNIEXPORT jlong JNICALL Java_org_apache_mxtpu_LibMXTpu_execGrad(
+    JNIEnv* env, jclass, jlong exec, jstring name) {
+  std::string n = jstr(env, name);
+  void* g = nullptr;
+  if (MXTpuImpExecGrad(reinterpret_cast<void*>(exec), n.c_str(), &g) != 0)
+    return 0;
+  return reinterpret_cast<jlong>(g);
+}
+
+JNIEXPORT jint JNICALL
+Java_org_apache_mxtpu_LibMXTpu_execFree(JNIEnv*, jclass, jlong exec) {
+  return MXTpuImpExecFree(reinterpret_cast<void*>(exec));
 }
 
 JNIEXPORT jlong JNICALL Java_org_apache_mxtpu_LibMXTpu_trainerCreate(

@@ -238,6 +238,7 @@ print("EXPORTED")
         assert run.returncode == 0, (run.stdout[-800:], run.stderr[-1500:])
         assert "SYMBOL_FITTED" in run.stdout
         assert "MODULE_FITTED" in run.stdout
+        assert "COMPILED_FITTED" in run.stdout
         # the Java-composed graph is a loadable Python symbol, and the
         # Java Executor's forward matches Python's bind on the same data
         import numpy as np
@@ -291,9 +292,16 @@ def test_jvm_symbol_api_surface():
     for needle in ("fit(DataIter train, int epochs",
                    "Ops.sgd_update(", "float[] predict(Symbol output"):
         assert needle in mod, f"SymbolModule.java missing {needle}"
+    # whole-graph compiled execution (the GraphExecutor contract) rides
+    # the same symBind natives the C++ SymbolExecutor uses
+    cex = _read(base, "CompiledExecutor.java")
+    for needle in ("LibMXTpu.symBind(", "NDArray[] forward(boolean train)",
+                   "void backward()", "NDArray gradOf(String argName)"):
+        assert needle in cex, f"CompiledExecutor.java missing {needle}"
     mlp = _read(base, "examples", "SymbolMlp.java")
     assert "SYMBOL_FITTED" in mlp and "loss.bind(" in mlp
     assert "MODULE_FITTED" in mlp and "new SymbolModule(" in mlp
+    assert "COMPILED_FITTED" in mlp and "new CompiledExecutor(" in mlp
 
 
 @pytest.mark.skipif(shutil.which("R") is None,
@@ -395,6 +403,7 @@ typedef float jfloat; typedef int jsize;
 class _jobject {}; typedef _jobject* jobject;
 typedef jobject jclass; typedef jobject jstring;
 typedef jobject jlongArray; typedef jobject jbyteArray;
+typedef jobject jobjectArray;
 struct JNIEnv {
   const char* GetStringUTFChars(jstring, void*) { return nullptr; }
   void ReleaseStringUTFChars(jstring, const char*) {}
@@ -405,6 +414,10 @@ struct JNIEnv {
   jbyte* GetByteArrayElements(jbyteArray, void*) { return nullptr; }
   void ReleaseByteArrayElements(jbyteArray, jbyte*, jint) {}
   jstring NewStringUTF(const char*) { return nullptr; }
+  jobject GetObjectArrayElement(jobjectArray, jsize) { return nullptr; }
+  void DeleteLocalRef(jobject) {}
+  jclass FindClass(const char*) { return nullptr; }
+  jint ThrowNew(jclass, const char*) { return 0; }
 };
 #define JNI_ABORT 2
 """)
