@@ -367,6 +367,10 @@ def test_infer_cache_folds_into_artifact_line(cache_guard, tmp_path):
 def test_benchmark_score_bank_merge(tmp_path):
     """bank_results: better-number-wins per (model, dtype); CPU rows are
     never banked."""
+    # hygiene: importing the tool must not mutate this process's env
+    # (a leaked JAX_COMPILATION_CACHE_DIR once poisoned example
+    # subprocesses with cache entries compiled for a different host)
+    env_before = dict(os.environ)
     sys.path.insert(0, os.path.join(REPO, "tools"))
     try:
         import importlib
@@ -374,6 +378,9 @@ def test_benchmark_score_bank_merge(tmp_path):
         importlib.reload(bs)
     finally:
         sys.path.pop(0)
+    assert dict(os.environ) == env_before, (
+        "importing benchmark_score mutated os.environ: "
+        f"{set(os.environ) ^ set(env_before)}")
     path = str(tmp_path / "infer.json")
     bs.bank_results(path, [
         {"model": "m", "dtype": "bfloat16", "best_ips": 100.0,

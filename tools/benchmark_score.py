@@ -32,7 +32,12 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jaxcache")
+# NOTE: the compile-cache env default lives in main(), NOT at module
+# level: importing this module (tests do) must not mutate the process
+# env — a leaked JAX_COMPILATION_CACHE_DIR makes unrelated subprocesses
+# share cache entries compiled for a DIFFERENT host (the axon tunnel's
+# CPU), which XLA loads with a feature-mismatch warning and silently
+# wrong numerics (observed: examples diverging mid-training).
 
 # reference inference baselines (docs/faq/perf.md:167-193, 1x V100)
 REF_V100 = {
@@ -252,6 +257,7 @@ def main():
                          "banked numbers into its driver artifact line)")
     args = ap.parse_args()
 
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jaxcache")
     import jax
 
     if args.platform:
