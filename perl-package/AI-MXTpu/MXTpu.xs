@@ -8,6 +8,32 @@
 #include "mxtpu_predict.h"
 #include "mxtpu.h"
 
+/* imperative / graph-level executor ABI (include/mxtpu_imperative.hpp is
+ * C++; declare the C entry points directly, the same pattern the JNI glue
+ * uses — tests/test_bindings-style consistency is covered by
+ * tests/test_train_c.py::test_perl_xs_uses_only_real_abi_symbols). */
+extern int MXTpuImpInit(void);
+extern const char* MXTpuImpError(void);
+extern int MXTpuImpNDCreate(int dtype, int ndim, const int64_t* dims,
+                            const void* data, void** out);
+extern int MXTpuImpNDShape(void* h, int64_t* dims, int max_ndim, int* ndim);
+extern int MXTpuImpNDCopyTo(void* h, void* out, size_t nbytes);
+extern int MXTpuImpNDFree(void* h);
+extern int MXTpuImpInvoke(const char* op_name, void** inputs, int n_in,
+                          const char* attrs_json, void** outputs, int max_out,
+                          int* n_out);
+extern int MXTpuImpSymBind(const char* symbol_json, const char** arg_names,
+                           void** arg_handles, int n_args,
+                           const char** grad_names, int n_grad,
+                           void** out_exec);
+extern int MXTpuImpExecSetArg(void* exec, const char* name, void* nd);
+extern int MXTpuImpExecForward(void* exec, int is_train, void** outputs,
+                               int max_out, int* n_out);
+extern int MXTpuImpExecBackward(void* exec);
+extern int MXTpuImpExecGrad(void* exec, const char* arg_name,
+                            void** grad_out);
+extern int MXTpuImpExecFree(void* exec);
+
 MODULE = AI::MXTpu  PACKAGE = AI::MXTpu  PREFIX = mxtpu_
 
 PROTOTYPES: DISABLE
@@ -271,3 +297,177 @@ mxtpu_xs_trainer_state_shape(h, idx)
       EXTEND(SP, nd);
       for (i = 0; i < nd; ++i) PUSHs(sv_2mortal(newSViv((IV) dims[i])));
     }
+
+# --- imperative + graph-level executor (the GraphExecutor role; same
+# --- natives the C++ SymbolExecutor and JVM CompiledExecutor ride) --------
+
+void
+mxtpu_xs_imp_init()
+  CODE:
+    if (MXTpuImpInit() != 0)
+      croak("%s", MXTpuImpError());
+
+IV
+mxtpu_xs_nd_from_floats(shape_av, bytes)
+    AV* shape_av
+    SV* bytes
+  CODE:
+    {
+      int nd = (int)(av_len(shape_av) + 1);
+      int64_t dims[8];
+      size_t n = 1;
+      int i;
+      STRLEN len;
+      const char* buf;
+      void* h = NULL;
+      if (nd > 8) croak("nd_from_floats: too many dims");
+      for (i = 0; i < nd; ++i) {
+        dims[i] = (int64_t)SvIV(*av_fetch(shape_av, i, 0));
+        n *= (size_t)dims[i];
+      }
+      buf = SvPV(bytes, len);
+      if (len != n * 4)
+        croak("nd_from_floats: %zu bytes for %zu float32 elements",
+              (size_t)len, n);
+      if (MXTpuImpNDCreate(0, nd, dims, buf, &h) != 0)
+        croak("%s", MXTpuImpError());
+      RETVAL = PTR2IV(h);
+    }
+  OUTPUT: RETVAL
+
+SV*
+mxtpu_xs_nd_bytes(h)
+    IV h
+  CODE:
+    {
+      int64_t dims[8];
+      int nd = 0, i;
+      size_t n = 1, nbytes;
+      SV* out;
+      if (MXTpuImpNDShape(INT2PTR(void*, h), dims, 8, &nd) != 0)
+        croak("%s", MXTpuImpError());
+      for (i = 0; i < nd; ++i) n *= (size_t)dims[i];
+      nbytes = n * 4;  /* float32 surface, matching nd_from_floats */
+      out = newSV(nbytes ? nbytes : 1);
+      SvPOK_on(out);
+      if (MXTpuImpNDCopyTo(INT2PTR(void*, h), SvPVX(out), nbytes) != 0) {
+        SvREFCNT_dec(out);
+        croak("%s", MXTpuImpError());
+      }
+      SvCUR_set(out, nbytes);
+      RETVAL = out;
+    }
+  OUTPUT: RETVAL
+
+void
+mxtpu_xs_nd_release(h)
+    IV h
+  CODE:
+    MXTpuImpNDFree(INT2PTR(void*, h));
+
+IV
+mxtpu_xs_invoke1(op, ins_av, attrs_json)
+    const char* op
+    AV* ins_av
+    SV* attrs_json
+  CODE:
+    {
+      int n_in = (int)(av_len(ins_av) + 1);
+      void* ins[16];
+      void* outs[8];
+      int n_out = 0, i;
+      const char* attrs = SvOK(attrs_json) ? SvPV_nolen(attrs_json) : NULL;
+      if (n_in > 16) croak("invoke1: too many inputs");
+      for (i = 0; i < n_in; ++i)
+        ins[i] = INT2PTR(void*, SvIV(*av_fetch(ins_av, i, 0)));
+      if (MXTpuImpInvoke(op, ins, n_in, attrs, outs, 8, &n_out) != 0)
+        croak("%s", MXTpuImpError());
+      if (n_out != 1) {
+        for (i = 0; i < n_out; ++i) MXTpuImpNDFree(outs[i]);
+        croak("invoke1(%s): expected 1 output, got %d", op, n_out);
+      }
+      RETVAL = PTR2IV(outs[0]);
+    }
+  OUTPUT: RETVAL
+
+IV
+mxtpu_xs_sym_bind(json, names_av, handles_av, grads_av)
+    const char* json
+    AV* names_av
+    AV* handles_av
+    AV* grads_av
+  CODE:
+    {
+      int n = (int)(av_len(names_av) + 1);
+      int n_g = (int)(av_len(grads_av) + 1);
+      const char* names[64];
+      void* handles[64];
+      const char* grads[64];
+      void* ex = NULL;
+      int i;
+      if (n > 64 || n_g > 64) croak("sym_bind: too many arguments");
+      if ((int)(av_len(handles_av) + 1) != n)
+        croak("sym_bind: names/handles length mismatch");
+      for (i = 0; i < n; ++i) {
+        names[i] = SvPV_nolen(*av_fetch(names_av, i, 0));
+        handles[i] = INT2PTR(void*, SvIV(*av_fetch(handles_av, i, 0)));
+      }
+      for (i = 0; i < n_g; ++i)
+        grads[i] = SvPV_nolen(*av_fetch(grads_av, i, 0));
+      if (MXTpuImpSymBind(json, names, handles, n, grads, n_g, &ex) != 0)
+        croak("%s", MXTpuImpError());
+      RETVAL = PTR2IV(ex);
+    }
+  OUTPUT: RETVAL
+
+void
+mxtpu_xs_exec_set_arg(ex, name, nd)
+    IV ex
+    const char* name
+    IV nd
+  CODE:
+    if (MXTpuImpExecSetArg(INT2PTR(void*, ex), name,
+                           INT2PTR(void*, nd)) != 0)
+      croak("%s", MXTpuImpError());
+
+void
+mxtpu_xs_exec_forward(ex, is_train)
+    IV ex
+    int is_train
+  PPCODE:
+    {
+      void* outs[16];
+      int n_out = 0, i;
+      if (MXTpuImpExecForward(INT2PTR(void*, ex), is_train, outs, 16,
+                              &n_out) != 0)
+        croak("%s", MXTpuImpError());
+      EXTEND(SP, n_out);
+      for (i = 0; i < n_out; ++i)
+        PUSHs(sv_2mortal(newSViv(PTR2IV(outs[i]))));
+    }
+
+void
+mxtpu_xs_exec_backward(ex)
+    IV ex
+  CODE:
+    if (MXTpuImpExecBackward(INT2PTR(void*, ex)) != 0)
+      croak("%s", MXTpuImpError());
+
+IV
+mxtpu_xs_exec_grad(ex, name)
+    IV ex
+    const char* name
+  CODE:
+    {
+      void* g = NULL;
+      if (MXTpuImpExecGrad(INT2PTR(void*, ex), name, &g) != 0)
+        croak("%s", MXTpuImpError());
+      RETVAL = PTR2IV(g);
+    }
+  OUTPUT: RETVAL
+
+void
+mxtpu_xs_exec_free(ex)
+    IV ex
+  CODE:
+    MXTpuImpExecFree(INT2PTR(void*, ex));

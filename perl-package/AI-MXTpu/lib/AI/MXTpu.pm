@@ -122,3 +122,76 @@ sub fit {
 sub DESTROY { AI::MXTpu::xs_trainer_free($_[0]{h}) if $_[0]{h} }
 
 1;
+
+# --- graph-level executor (reference role: AI::MXNet's Symbol/Executor;
+# the whole symbol JSON binds to ONE jitted XLA program per forward —
+# the same natives the C++ SymbolExecutor and JVM CompiledExecutor use) ---
+package AI::MXTpu::NDArray;
+use strict;
+use warnings;
+
+# float32 host<->device array travel as packed 'f*' strings
+sub from_floats {
+    my ($class, $shape, @floats) = @_;
+    AI::MXTpu::xs_imp_init();  # idempotent; arrays may precede any bind
+    my $h = AI::MXTpu::xs_nd_from_floats($shape, pack('f*', @floats));
+    return bless { h => $h }, $class;
+}
+
+sub handle { $_[0]{h} }
+
+sub values {
+    my ($self) = @_;
+    return [unpack('f*', AI::MXTpu::xs_nd_bytes($self->{h}))];
+}
+
+sub DESTROY { AI::MXTpu::xs_nd_release($_[0]{h}) if $_[0]{h} }
+
+package AI::MXTpu::SymbolExecutor;
+use strict;
+use warnings;
+
+# new($json, \@names, \@ndarrays, \@grad_names): bind a serialized
+# symbol (the Python frontend's Symbol.tojson schema) over named args.
+sub new {
+    my ($class, $json, $names, $arrays, $grad_names) = @_;
+    AI::MXTpu::xs_imp_init();
+    my @handles = map { $_->handle } @$arrays;
+    my $ex = AI::MXTpu::xs_sym_bind($json, $names, \@handles,
+                                    $grad_names || []);
+    return bless { ex => $ex }, $class;
+}
+
+sub set_arg {
+    my ($self, $name, $nd) = @_;
+    AI::MXTpu::xs_exec_set_arg($self->{ex}, $name, $nd->handle);
+}
+
+# forward($is_train) -> list of AI::MXTpu::NDArray outputs
+sub forward {
+    my ($self, $is_train) = @_;
+    my @outs = AI::MXTpu::xs_exec_forward($self->{ex}, $is_train ? 1 : 0);
+    return [map { bless { h => $_ }, 'AI::MXTpu::NDArray' } @outs];
+}
+
+sub backward { AI::MXTpu::xs_exec_backward($_[0]{ex}) }
+
+sub grad_of {
+    my ($self, $name) = @_;
+    my $g = AI::MXTpu::xs_exec_grad($self->{ex}, $name);
+    return bless { h => $g }, 'AI::MXTpu::NDArray';
+}
+
+# one fused optimizer op through the imperative runtime (e.g.
+# sgd_update); returns the updated NDArray
+sub sgd_update {
+    my ($class, $weight, $grad, $attrs_json) = @_;
+    my $h = AI::MXTpu::xs_invoke1('sgd_update',
+                                  [$weight->handle, $grad->handle],
+                                  $attrs_json);
+    return bless { h => $h }, 'AI::MXTpu::NDArray';
+}
+
+sub DESTROY { AI::MXTpu::xs_exec_free($_[0]{ex}) if $_[0]{ex} }
+
+1;

@@ -20,6 +20,8 @@
 // MXTpuImpError() for the message (thread-local).
 #include <Python.h>
 
+#include <dlfcn.h>
+
 #include <cstdint>
 #include <cstring>
 #include <string>
@@ -60,6 +62,16 @@ int fail(const char* where) {
 
 // Call a module-level function with a pre-built args tuple (steals nothing).
 PyObject* call(const char* fn, PyObject* args) {
+  if (!g_mod) {
+    // init failed (or was skipped and the auto-init could not import the
+    // package): fail the call cleanly instead of dereferencing NULL
+    PyErr_SetString(
+        PyExc_RuntimeError,
+        "mxtpu runtime not initialized: import of "
+        "incubator_mxnet_tpu.capi_imperative failed (is the repo on "
+        "PYTHONPATH?); call MXTpuImpInit and check MXTpuImpError");
+    return nullptr;
+  }
   PyObject* f = PyObject_GetAttrString(g_mod, fn);
   if (!f) return nullptr;
   PyObject* r = PyObject_CallObject(f, args);
@@ -73,10 +85,26 @@ struct Gil {
   // so MXTpuImpError() reports the error of the most recent call — a stale
   // message from an earlier failure must not mask a later subsystem's
   // error (read the error immediately after a failing call).
-  Gil() : st(PyGILState_Ensure()) { g_err.clear(); }
+  // PyGILState_Ensure before Py_Initialize ABORTS the process, so a
+  // caller that skips MXTpuImpInit gets auto-initialized instead of
+  // killed (observed: a perl script creating NDArrays before binding).
+  Gil() : st((ensure_init(), PyGILState_Ensure())) { g_err.clear(); }
   ~Gil() { PyGILState_Release(st); }
+
+ private:
+  static void ensure_init();
 };
 
+}  // namespace
+
+extern "C" int MXTpuImpInit(void);
+
+namespace {
+void Gil::ensure_init() {
+  if (!Py_IsInitialized()) {
+    MXTpuImpInit();  // safe: Init's own Gil sees an initialized runtime
+  }
+}
 }  // namespace
 
 extern "C" {
@@ -87,6 +115,17 @@ const char* MXTpuImpError(void) { return g_err.c_str(); }
 // Python, e.g. when loaded from a Python test) and import the shim module.
 int MXTpuImpInit(void) {
   if (!Py_IsInitialized()) {
+    // Hosts that dlopen this library RTLD_LOCAL (perl's DynaLoader, most
+    // language FFIs) leave libpython's symbols invisible to Python's own
+    // extension modules (numpy etc. rely on the interpreter's symbols
+    // being globally visible). Re-open the already-loaded libpython with
+    // RTLD_GLOBAL (NOLOAD: promote, never load a second copy). A C++
+    // embedder that linked libpython into its executable is unaffected.
+    Dl_info info;
+    if (dladdr(reinterpret_cast<void*>(&Py_InitializeEx), &info) &&
+        info.dli_fname) {
+      dlopen(info.dli_fname, RTLD_NOW | RTLD_GLOBAL | RTLD_NOLOAD);
+    }
     Py_InitializeEx(0);  // no signal handlers: we are a guest runtime
     // hand the GIL back so Gil{} below can take it from any thread
     PyEval_SaveThread();

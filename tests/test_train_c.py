@@ -328,12 +328,13 @@ def test_perl_trainer_fits(artifact, tmp_path):
 
     if shutil.which("perl") is None or shutil.which("make") is None:
         pytest.skip("perl/make unavailable")
-    from incubator_mxnet_tpu._native import predict_lib
+    from incubator_mxnet_tpu._native import imperative_lib, predict_lib
 
     from common import build_perl_pkg
 
-    # the XS module links BOTH native libs; build them before make runs
-    assert predict_lib() is not None and train_lib() is not None
+    # the XS module links ALL THREE native libs; build them before make
+    assert (predict_lib() is not None and train_lib() is not None
+            and imperative_lib() is not None)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     build, env = build_perl_pkg(tmp_path, repo)
     plugin = _usable_pjrt_plugin()
@@ -385,3 +386,119 @@ if ({1 if plugin else 0}) {{
     assert out.returncode == 0, (out.stdout[-1000:], out.stderr[-1500:])
     assert ("PERL FIT OK" in out.stdout
             or "PERL TRAINER ABI OK" in out.stdout)
+
+
+def test_perl_xs_uses_only_real_abi_symbols():
+    """Every MXTpu* symbol the XS glue calls must exist in the native
+    runtimes' sources (catches ABI drift without perl)."""
+    import re
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    xs = open(os.path.join(repo, "perl-package", "AI-MXTpu",
+                           "MXTpu.xs")).read()
+    used = set(re.findall(r"\b(MXTpu\w+)\(", xs))
+    impl = ""
+    for src in ("imperative.cc", "train.cc", "predict.cc"):
+        impl += open(os.path.join(repo, "src", src)).read()
+    defined = set(re.findall(r"\b(MXTpu\w+)\(", impl))
+    missing = used - defined
+    assert not missing, f"XS references unknown ABI symbols: {sorted(missing)}"
+
+
+def test_perl_symbol_executor_trains(tmp_path):
+    """Graph-level execution from Perl: a symbol JSON composed in Perl
+    binds through the embedded runtime (one jitted XLA program per
+    forward) and trains with forward(1)/backward/sgd_update — the
+    AI::MXNet Symbol/Executor role, third consumer of the same natives
+    as the C++ SymbolExecutor and JVM CompiledExecutor."""
+    import shutil
+    import subprocess
+
+    if shutil.which("perl") is None or shutil.which("make") is None:
+        pytest.skip("perl/make unavailable")
+    from incubator_mxnet_tpu._native import imperative_lib, predict_lib
+
+    from common import build_perl_pkg
+
+    # the XS module links all three native libs; build them before make
+    assert (predict_lib() is not None and train_lib() is not None
+            and imperative_lib() is not None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    build, env = build_perl_pkg(tmp_path, repo)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    script = r"""
+$| = 1;
+use blib;
+use AI::MXTpu;
+my $json = <<'JSON';
+{
+  "nodes": [
+    {"op": "null", "name": "x", "attrs": {}, "inputs": []},
+    {"op": "null", "name": "w1", "attrs": {}, "inputs": []},
+    {"op": "null", "name": "b1", "attrs": {}, "inputs": []},
+    {"op": "FullyConnected", "name": "fc1", "attrs": {"num_hidden": "16"},
+     "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+    {"op": "Activation", "name": "relu1", "attrs": {"act_type": "relu"},
+     "inputs": [[3, 0, 0]]},
+    {"op": "null", "name": "w2", "attrs": {}, "inputs": []},
+    {"op": "null", "name": "b2", "attrs": {}, "inputs": []},
+    {"op": "FullyConnected", "name": "fc2", "attrs": {"num_hidden": "3"},
+     "inputs": [[4, 0, 0], [5, 0, 0], [6, 0, 0]]},
+    {"op": "null", "name": "label", "attrs": {}, "inputs": []},
+    {"op": "softmax_cross_entropy", "name": "loss", "attrs": {},
+     "inputs": [[7, 0, 0], [8, 0, 0]]}
+  ],
+  "arg_nodes": [0, 1, 2, 5, 6, 8],
+  "heads": [[9, 0, 0]],
+  "attrs": {"framework": "incubator_mxnet_tpu", "version": "0.1"}
+}
+JSON
+srand(11);
+my $batch = 16; my $in = 8;
+my (@x, @y);
+for my $i (0 .. $batch - 1) {
+  my $c = $i % 3;
+  push @y, $c;
+  for my $j (0 .. $in - 1) {
+    push @x, 0.3 * (($c + $j) % 4) + 0.1 * rand();
+  }
+}
+my %nd = (
+  x     => AI::MXTpu::NDArray->from_floats([$batch, $in], @x),
+  w1    => AI::MXTpu::NDArray->from_floats([16, $in],
+             map { 0.3 * (rand() - 0.5) } 1 .. 16 * $in),
+  b1    => AI::MXTpu::NDArray->from_floats([16], (0) x 16),
+  w2    => AI::MXTpu::NDArray->from_floats([3, 16],
+             map { 0.3 * (rand() - 0.5) } 1 .. 3 * 16),
+  b2    => AI::MXTpu::NDArray->from_floats([3], (0) x 3),
+  label => AI::MXTpu::NDArray->from_floats([$batch], @y),
+);
+my @names = qw(x w1 b1 w2 b2 label);
+my @params = qw(w1 b1 w2 b2);
+my $ex = AI::MXTpu::SymbolExecutor->new(
+    $json, \@names, [map { $nd{$_} } @names], \@params);
+my ($first, $last);
+my $attrs = sprintf '{"lr":0.1,"rescale_grad":%.6f}', 1.0 / $batch;
+for my $step (1 .. 40) {
+  my $outs = $ex->forward(1);
+  my $l = $outs->[0]->values->[0] / $batch;
+  $first = $l if $step == 1;
+  $last = $l;
+  $ex->backward;
+  for my $p (@params) {
+    my $updated = AI::MXTpu::SymbolExecutor->sgd_update(
+        $nd{$p}, $ex->grad_of($p), $attrs);
+    $ex->set_arg($p, $updated);
+    $nd{$p} = $updated;
+  }
+}
+printf "first=%.4f last=%.4f\n", $first, $last;
+die "loss did not drop" unless $last < $first * 0.8;
+print "PERL_SYMBOL_TRAINED\n";
+"""
+    out = subprocess.run(["perl", "-e", script], cwd=build, env=env,
+                         capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, (out.stdout[-1000:], out.stderr[-1800:])
+    assert "PERL_SYMBOL_TRAINED" in out.stdout
