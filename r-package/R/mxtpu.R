@@ -97,3 +97,37 @@ mx.attrs.json <- function(attrs, arrays = character(0)) {
   paste0("{", paste(sprintf('"%s":%s', names(keep), parts),
                     collapse = ","), "}")
 }
+
+# --- graph-level executor (reference role: R-package's mx.simple.bind /
+# executor path; the whole symbol JSON binds to ONE jitted XLA program
+# per forward — the same natives as the C++/JVM/Perl executors) ----------
+
+#' Bind a serialized symbol (the Python frontend's Symbol.tojson schema)
+#' over a NAMED list of NDArrays; grad_names selects the arguments that
+#' accumulate gradients during mx.exec.backward.
+mx.symbol.bind.compiled <- function(symbol_json, args,
+                                    grad_names = character(0)) {
+  stopifnot(!is.null(names(args)), all(nzchar(names(args))))
+  .Call(mxr_sym_bind, symbol_json, names(args), unname(args),
+        as.character(grad_names))
+}
+
+#' Feed new data into a bound argument (dtype-preserving).
+mx.exec.set.arg <- function(exec, name, nd) {
+  invisible(.Call(mxr_exec_set_arg, exec, name, nd))
+}
+
+#' Run the compiled graph; returns a list of output NDArrays.
+mx.exec.forward <- function(exec, is.train = FALSE) {
+  .Call(mxr_exec_forward, exec, as.integer(is.train))
+}
+
+#' Ones-seeded backward into the executor's gradient arrays.
+mx.exec.backward <- function(exec) {
+  invisible(.Call(mxr_exec_backward, exec))
+}
+
+#' Gradient of a grad_names argument from the last backward.
+mx.exec.grad <- function(exec, name) {
+  .Call(mxr_exec_grad, exec, name)
+}

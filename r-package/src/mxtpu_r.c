@@ -29,6 +29,17 @@ extern int MXTpuImpGrad(void* h, void** grad_out);
 extern int MXTpuImpRecordBegin(int train_mode);
 extern int MXTpuImpRecordEnd(void);
 extern int MXTpuImpBackward(void* loss);
+extern int MXTpuImpSymBind(const char* symbol_json, const char** arg_names,
+                           void** arg_handles, int n_args,
+                           const char** grad_names, int n_grad,
+                           void** out_exec);
+extern int MXTpuImpExecSetArg(void* exec, const char* name, void* nd);
+extern int MXTpuImpExecForward(void* exec, int is_train, void** outputs,
+                               int max_out, int* n_out);
+extern int MXTpuImpExecBackward(void* exec);
+extern int MXTpuImpExecGrad(void* exec, const char* arg_name,
+                            void** grad_out);
+extern int MXTpuImpExecFree(void* exec);
 
 static void nd_finalizer(SEXP ptr) {
   void* h = R_ExternalPtrAddr(ptr);
@@ -154,6 +165,78 @@ SEXP mxr_grad(SEXP ptr) {
   return wrap_handle(g);
 }
 
+/* --- graph-level executor (the GraphExecutor role; same natives as the
+ * C++ SymbolExecutor, JVM CompiledExecutor, and Perl SymbolExecutor) --- */
+
+static void exec_finalizer(SEXP ptr) {
+  void* h = R_ExternalPtrAddr(ptr);
+  if (h) {
+    MXTpuImpExecFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+/* sym_bind(json, names_chr, handles_list, grad_names_chr) -> executor */
+SEXP mxr_sym_bind(SEXP json, SEXP names, SEXP handles, SEXP grad_names) {
+  int n = LENGTH(names);
+  int n_g = LENGTH(grad_names);
+  const char* nm[64];
+  void* hs[64];
+  const char* gn[64];
+  if (n > 64 || n_g > 64) error("sym_bind: max 64 arguments");
+  if (LENGTH(handles) != n) error("sym_bind: names/handles length mismatch");
+  for (int i = 0; i < n; ++i) {
+    nm[i] = CHAR(STRING_ELT(names, i));
+    /* NULL element -> NULL handle (clean missing-argument error in the
+     * runtime), the same mapping mxr_invoke applies */
+    SEXP el = VECTOR_ELT(handles, i);
+    hs[i] = el == R_NilValue ? NULL : R_ExternalPtrAddr(el);
+  }
+  for (int i = 0; i < n_g; ++i) gn[i] = CHAR(STRING_ELT(grad_names, i));
+  void* ex = NULL;
+  if (MXTpuImpSymBind(CHAR(STRING_ELT(json, 0)), nm, hs, n, gn, n_g,
+                      &ex) != 0)
+    error("sym_bind: %s", MXTpuImpError());
+  SEXP ptr = PROTECT(R_MakeExternalPtr(ex, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(ptr, exec_finalizer, TRUE);
+  UNPROTECT(1);
+  return ptr;
+}
+
+SEXP mxr_exec_set_arg(SEXP ex, SEXP name, SEXP nd) {
+  if (MXTpuImpExecSetArg(R_ExternalPtrAddr(ex), CHAR(STRING_ELT(name, 0)),
+                         R_ExternalPtrAddr(nd)) != 0)
+    error("exec_set_arg: %s", MXTpuImpError());
+  return R_NilValue;
+}
+
+/* exec_forward(ex, is_train) -> list of output handles */
+SEXP mxr_exec_forward(SEXP ex, SEXP is_train) {
+  void* outs[16];
+  int n_out = 0;
+  if (MXTpuImpExecForward(R_ExternalPtrAddr(ex), asInteger(is_train), outs,
+                          16, &n_out) != 0)
+    error("exec_forward: %s", MXTpuImpError());
+  SEXP out = PROTECT(allocVector(VECSXP, n_out));
+  for (int i = 0; i < n_out; ++i) SET_VECTOR_ELT(out, i, wrap_handle(outs[i]));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP mxr_exec_backward(SEXP ex) {
+  if (MXTpuImpExecBackward(R_ExternalPtrAddr(ex)) != 0)
+    error("exec_backward: %s", MXTpuImpError());
+  return R_NilValue;
+}
+
+SEXP mxr_exec_grad(SEXP ex, SEXP name) {
+  void* g = NULL;
+  if (MXTpuImpExecGrad(R_ExternalPtrAddr(ex), CHAR(STRING_ELT(name, 0)),
+                       &g) != 0)
+    error("exec_grad: %s", MXTpuImpError());
+  return wrap_handle(g);
+}
+
 static const R_CallMethodDef call_methods[] = {
     {"mxr_init", (DL_FUNC) &mxr_init, 0},
     {"mxr_nd_create", (DL_FUNC) &mxr_nd_create, 2},
@@ -165,6 +248,11 @@ static const R_CallMethodDef call_methods[] = {
     {"mxr_record_end", (DL_FUNC) &mxr_record_end, 0},
     {"mxr_backward", (DL_FUNC) &mxr_backward, 1},
     {"mxr_grad", (DL_FUNC) &mxr_grad, 1},
+    {"mxr_sym_bind", (DL_FUNC) &mxr_sym_bind, 4},
+    {"mxr_exec_set_arg", (DL_FUNC) &mxr_exec_set_arg, 3},
+    {"mxr_exec_forward", (DL_FUNC) &mxr_exec_forward, 2},
+    {"mxr_exec_backward", (DL_FUNC) &mxr_exec_backward, 1},
+    {"mxr_exec_grad", (DL_FUNC) &mxr_exec_grad, 2},
     {NULL, NULL, 0}};
 
 void R_init_mxtpu(DllInfo* dll) {

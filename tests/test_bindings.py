@@ -326,6 +326,7 @@ def test_r_binding_builds_and_smokes(tmp_path):
         capture_output=True, text=True, timeout=600, env=env)
     assert run.returncode == 0, (run.stdout[-800:], run.stderr[-1500:])
     assert "R binding smoke OK" in run.stdout
+    assert "R compiled executor OK" in run.stdout
     # the full training frontend: symbol -> FeedForward.create -> predict
     # -> save/load round-trip (reference model.R user contract)
     run = subprocess.run(
