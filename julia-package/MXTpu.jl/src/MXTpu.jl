@@ -19,7 +19,9 @@ export init, NDArray, to_array, invoke, attach_grad, backward, grad,
        # idiomatic surface (ndarray_ops.jl / model.jl)
        op, attrs_json, matmul, relu, sigmoid, softmax, mean_nd, argmax_nd,
        zeros_like, ones_like,
-       Dense, Conv2D, Chain, forward, params, fit!, predict, accuracy
+       Dense, Conv2D, Chain, forward, params, fit!, predict, accuracy,
+       # graph-level executor (whole-symbol compiled execution)
+       SymbolExecutor, set_arg, grad_of
 
 const _lib = Ref{String}("")
 
@@ -131,6 +133,76 @@ record_begin(train::Bool = true) =
 
 record_end() =
     _check(ccall((:MXTpuImpRecordEnd, _libpath()), Cint, ()), "record_end")
+
+# --- graph-level executor (the GraphExecutor role; same natives as the
+# C++ SymbolExecutor, JVM CompiledExecutor, Perl and R executors) --------
+
+"""Whole-graph compiled execution of a serialized symbol (the Python
+frontend's Symbol.tojson schema): every `forward` runs ONE jitted XLA
+program, unlike per-op `invoke`."""
+mutable struct SymbolExecutor
+    handle::Ptr{Cvoid}
+    function SymbolExecutor(json::String, names::Vector{String},
+                            arrays::Vector{NDArray},
+                            grad_names::Vector{String} = String[])
+        init()
+        length(names) == length(arrays) ||
+            error("SymbolExecutor: names/arrays length mismatch")
+        handles = Ptr{Cvoid}[nd.handle for nd in arrays]
+        ex = Ref{Ptr{Cvoid}}(C_NULL)
+        # @preserve: temporaries passed only by raw handle must not be
+        # finalized (freeing the underlying Python objects) mid-call
+        GC.@preserve arrays begin
+            _check(ccall((:MXTpuImpSymBind, _libpath()), Cint,
+                         (Cstring, Ptr{Cstring}, Ptr{Ptr{Cvoid}}, Cint,
+                          Ptr{Cstring}, Cint, Ptr{Ptr{Cvoid}}),
+                         json, names, handles, length(names),
+                         grad_names, length(grad_names), ex), "sym_bind")
+        end
+        self = new(ex[])
+        finalizer(self) do s
+            s.handle == C_NULL && return
+            ccall((:MXTpuImpExecFree, _libpath()), Cint, (Ptr{Cvoid},),
+                  s.handle)
+            s.handle = C_NULL
+        end
+        return self
+    end
+end
+
+"""Feed new data into a bound argument (dtype-preserving)."""
+function set_arg(ex::SymbolExecutor, name::String, nd::NDArray)
+    GC.@preserve nd begin
+        _check(ccall((:MXTpuImpExecSetArg, _libpath()), Cint,
+                     (Ptr{Cvoid}, Cstring, Ptr{Cvoid}),
+                     ex.handle, name, nd.handle), "exec_set_arg")
+    end
+end
+
+"""Run the compiled graph; returns the output NDArrays."""
+function forward(ex::SymbolExecutor; train::Bool = false)
+    outs = Vector{Ptr{Cvoid}}(undef, 16)
+    n_out = Ref{Cint}(0)
+    _check(ccall((:MXTpuImpExecForward, _libpath()), Cint,
+                 (Ptr{Cvoid}, Cint, Ptr{Ptr{Cvoid}}, Cint, Ptr{Cint}),
+                 ex.handle, train ? 1 : 0, outs, 16, n_out),
+           "exec_forward")
+    return [NDArray(outs[i]) for i in 1:n_out[]]
+end
+
+"""Ones-seeded backward into the executor's gradient arrays."""
+backward(ex::SymbolExecutor) =
+    _check(ccall((:MXTpuImpExecBackward, _libpath()), Cint, (Ptr{Cvoid},),
+                 ex.handle), "exec_backward")
+
+"""Gradient of a grad_names argument from the last backward."""
+function grad_of(ex::SymbolExecutor, name::String)
+    g = Ref{Ptr{Cvoid}}(C_NULL)
+    _check(ccall((:MXTpuImpExecGrad, _libpath()), Cint,
+                 (Ptr{Cvoid}, Cstring, Ptr{Ptr{Cvoid}}),
+                 ex.handle, name, g), "exec_grad")
+    return NDArray(g[])
+end
 
 include("ndarray_ops.jl")
 include("model.jl")

@@ -83,3 +83,21 @@ closs = MXTpu.fit!(cmodel, imgs, yc; epochs = 6, batch_size = 40,
 cacc = MXTpu.accuracy(cmodel, imgs, yc)
 @test cacc > 0.85
 println("Julia conv fit OK (acc=$(round(cacc; digits=3)))")
+
+# --- graph-level executor: bind sum(x*w') as ONE compiled program and
+# cross-check forward + ones-seeded gradient against Julia ----------------
+json = """{"nodes":[{"op":"null","name":"x","attrs":{},"inputs":[]},{"op":"null","name":"w","attrs":{},"inputs":[]},{"op":"FullyConnected","name":"fc","attrs":{"num_hidden":"3","no_bias":"True"},"inputs":[[0,0,0],[1,0,0]]},{"op":"sum","name":"s","attrs":{},"inputs":[[2,0,0]]}],"arg_nodes":[0,1],"heads":[[3,0,0]],"attrs":{"framework":"incubator_mxnet_tpu","version":"0.1"}}"""
+xm = rand(Float32, 4, 5)
+wm = rand(Float32, 3, 5)
+ex = MXTpu.SymbolExecutor(json, ["x", "w"],
+                          [MXTpu.NDArray(xm), MXTpu.NDArray(wm)], ["w"])
+outs = MXTpu.forward(ex; train = true)
+@test isapprox(MXTpu.to_array(outs[1])[1], sum(xm * wm'); rtol = 1e-5)
+MXTpu.backward(ex)
+gw = MXTpu.to_array(MXTpu.grad_of(ex, "w"))
+@test isapprox(gw, repeat(sum(xm; dims = 1), 3, 1); rtol = 1e-5)
+x2 = rand(Float32, 4, 5)
+MXTpu.set_arg(ex, "x", MXTpu.NDArray(x2))
+outs2 = MXTpu.forward(ex)
+@test isapprox(MXTpu.to_array(outs2[1])[1], sum(x2 * wm'); rtol = 1e-5)
+println("Julia compiled executor OK")

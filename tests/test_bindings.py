@@ -538,6 +538,11 @@ def test_julia_model_api_surface():
     # exports match definitions
     for name in ("fit!", "Dense", "Chain", "predict", "accuracy", "matmul"):
         assert name in main, f"MXTpu.jl does not export {name}"
+    # graph-level executor surface (same natives as the other frontends)
+    for needle in ("struct SymbolExecutor", ":MXTpuImpSymBind",
+                   "function grad_of(ex::SymbolExecutor",
+                   "set_arg(ex::SymbolExecutor"):
+        assert needle in main, f"MXTpu.jl missing {needle}"
 
 
 @pytest.mark.skipif(shutil.which("julia") is None,
