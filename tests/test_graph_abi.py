@@ -1,0 +1,121 @@
+"""Property tests for the graph-level embed ABI (sym_bind/exec_*):
+randomized op chains serialized as symbol JSON must match a directly
+composed jax program in BOTH forward value and ones-seeded gradients.
+
+The fixed-graph tests in test_cpp_api.py pin the C marshalling; these
+pin the SEMANTICS across arbitrary compositions (the property the five
+frontend executors all rely on).
+"""
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from incubator_mxnet_tpu import capi_imperative as capi
+from incubator_mxnet_tpu import nd
+
+# (op name, jax equivalent) — unary elementwise, numerically tame
+UNARY = [
+    ("relu", lambda x: jnp.maximum(x, 0)),
+    ("sigmoid", jax.nn.sigmoid),
+    ("tanh", jnp.tanh),
+    ("square", jnp.square),
+    ("softsign", lambda x: x / (1 + jnp.abs(x))),
+]
+# binary ops folding in a parameter variable
+BINARY = [
+    ("elemwise_add", jnp.add),
+    ("elemwise_mul", jnp.multiply),
+    ("elemwise_sub", jnp.subtract),
+]
+
+
+def _random_chain(rng, depth):
+    """Build (symbol_json, ref_fn, n_params): x -> depth ops -> sum."""
+    nodes = [{"op": "null", "name": "x", "attrs": {}, "inputs": []}]
+    steps = []  # ("u", fn) or ("b", fn, param_index)
+    cur = 0  # node index of the running value
+    n_params = 0
+    for i in range(depth):
+        if rng.rand() < 0.35:
+            name, fn = BINARY[rng.randint(len(BINARY))]
+            pname = f"p{n_params}"
+            nodes.append({"op": "null", "name": pname, "attrs": {},
+                          "inputs": []})
+            p_idx = len(nodes) - 1
+            nodes.append({"op": name, "name": f"n{i}", "attrs": {},
+                          "inputs": [[cur, 0, 0], [p_idx, 0, 0]]})
+            steps.append(("b", fn, n_params))
+            n_params += 1
+        else:
+            name, fn = UNARY[rng.randint(len(UNARY))]
+            nodes.append({"op": name, "name": f"n{i}", "attrs": {},
+                          "inputs": [[cur, 0, 0]]})
+            steps.append(("u", fn))
+        cur = len(nodes) - 1
+    nodes.append({"op": "sum", "name": "out", "attrs": {},
+                  "inputs": [[cur, 0, 0]]})
+    head = len(nodes) - 1
+    sym = json.dumps({
+        "nodes": nodes,
+        "arg_nodes": [i for i, n in enumerate(nodes) if n["op"] == "null"],
+        "heads": [[head, 0, 0]],
+        "attrs": {"framework": "incubator_mxnet_tpu", "version": "0.1"},
+    })
+
+    def ref_fn(x, params):
+        v = x
+        for step in steps:
+            if step[0] == "u":
+                v = step[1](v)
+            else:
+                v = step[1](v, params[step[2]])
+        return jnp.sum(v)
+
+    return sym, ref_fn, n_params
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_random_chain_forward_and_grads_match_jax(seed):
+    rng = np.random.RandomState(seed)
+    depth = rng.randint(3, 9)
+    sym, ref_fn, n_params = _random_chain(rng, depth)
+
+    shape = (3, 4)
+    x = rng.uniform(-1, 1, shape).astype(np.float32)
+    params = [rng.uniform(-1, 1, shape).astype(np.float32)
+              for _ in range(n_params)]
+
+    names = ["x"] + [f"p{i}" for i in range(n_params)]
+    arrays = [nd.array(x)] + [nd.array(p) for p in params]
+    grad_names = list(names)  # gradients wrt x AND every param
+    ex = capi.sym_bind(sym, names, arrays, grad_names)
+
+    out = capi.exec_forward(ex, 1)
+    assert len(out) == 1
+    want = ref_fn(jnp.asarray(x), [jnp.asarray(p) for p in params])
+    np.testing.assert_allclose(out[0].asnumpy(), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    capi.exec_backward(ex)
+    jax_grads = jax.grad(
+        lambda xx, ps: ref_fn(xx, ps), argnums=(0, 1))(
+        jnp.asarray(x), [jnp.asarray(p) for p in params])
+    np.testing.assert_allclose(capi.exec_grad(ex, "x").asnumpy(),
+                               np.asarray(jax_grads[0]),
+                               rtol=2e-5, atol=2e-5)
+    for i in range(n_params):
+        np.testing.assert_allclose(capi.exec_grad(ex, f"p{i}").asnumpy(),
+                                   np.asarray(jax_grads[1][i]),
+                                   rtol=2e-5, atol=2e-5)
+
+    # feed fresh data: the SAME bound program must track the new input
+    x2 = rng.uniform(-1, 1, shape).astype(np.float32)
+    capi.exec_set_arg(ex, "x", nd.array(x2))
+    out2 = capi.exec_forward(ex, 0)
+    want2 = ref_fn(jnp.asarray(x2), [jnp.asarray(p) for p in params])
+    np.testing.assert_allclose(out2[0].asnumpy(), np.asarray(want2),
+                               rtol=2e-5, atol=2e-5)
