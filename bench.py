@@ -539,29 +539,44 @@ def main():
         # measurement (the free-text note alone is not parseable)
         out["cached"] = True
         out["cached_ts"] = cached_ts
-    # fold banked ON-CHIP inference numbers (tools/benchmark_score.py
-    # --bank, run by the probe loop after a successful training bench)
-    # into the driver artifact: the reference's headline table is half
-    # inference rows (docs/faq/perf.md:167-193)
-    try:
-        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "INFER_CACHE.json")) as f:
-            infer = json.load(f)
-        rows, row_ts = {}, []
-        for k, v in infer.get("results", {}).items():
-            if (isinstance(v, dict) and "best_ips" in v
-                    and v.get("platform") not in (None, "cpu")):
-                rows[k] = round(float(v["best_ips"]), 2)
-                if v.get("ts"):
-                    row_ts.append(v["ts"])
-        if rows:
-            out["infer_ips"] = rows
-            # oldest per-row stamp = honest provenance for retained rows
-            out["infer_ts"] = min(row_ts) if row_ts else infer.get("ts")
-    except Exception:
-        # a corrupt auxiliary side-file must never suppress the primary
-        # artifact line (possibly the only record of an hours-long run)
-        pass
+    # fold banked ON-CHIP side-cache numbers (written by the probe loop
+    # after a successful training bench) into the driver artifact; a
+    # corrupt side-file must never suppress the primary line (possibly
+    # the only record of an hours-long run), and the oldest per-row
+    # stamp is surfaced as honest provenance for retained rows
+    def _fold_side_cache(filename, required_key, row_fn, out_key, ts_key):
+        try:
+            with open(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    filename)) as f:
+                data = json.load(f)
+            rows, row_ts = {}, []
+            for k, v in data.get("results", {}).items():
+                if (isinstance(v, dict) and required_key in v
+                        and v.get("platform") not in (None, "cpu")):
+                    rows[k] = row_fn(v)
+                    if v.get("ts"):
+                        row_ts.append(v["ts"])
+            if rows:
+                out[out_key] = rows
+                ts = min(row_ts) if row_ts else data.get("ts")
+                if ts:
+                    out[ts_key] = ts
+        except Exception:
+            pass
+
+    # transformer: train tokens/sec + KV-cache decode (flash + fused-xent)
+    _fold_side_cache(
+        "TRANSFORMER_CACHE.json", "value",
+        lambda v: {"train_tokens_per_sec": round(float(v["value"]), 1),
+                   "decode_tokens_per_sec": v.get("decode_tokens_per_sec")},
+        "transformer", "transformer_ts")
+    # inference: the reference's headline table is half inference rows
+    # (docs/faq/perf.md:167-193; tools/benchmark_score.py --bank)
+    _fold_side_cache(
+        "INFER_CACHE.json", "best_ips",
+        lambda v: round(float(v["best_ips"]), 2),
+        "infer_ips", "infer_ts")
     if errors:
         note += "; ".join(f"{k}: {v}" for k, v in errors.items())[:400]
     if note:

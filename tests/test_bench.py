@@ -459,3 +459,78 @@ def test_perf_analysis_infer_executes(tmp_path):
     assert set(alexnet["conv_out_dtypes"]) == {"i32"}
     assert alexnet["v5e_roofline_img_per_s"] > 0
     assert "ROOFLINE" in report.read_text()
+
+
+def test_transformer_cache_folds_into_artifact_line(cache_guard):
+    """Banked on-chip transformer numbers appear in the artifact line;
+    CPU rows and corrupt files never do (and never crash main)."""
+    path = os.path.join(REPO, "TRANSFORMER_CACHE.json")
+    backup = None
+    if os.path.exists(path):
+        backup = path + ".bak"
+        shutil.copy(path, backup)
+    try:
+        with open(CACHE, "w") as f:
+            json.dump({"ts": "2026-01-01T00:00:00Z", "results": {
+                "float32": {"ips": 1000.0, "scan_ips": 0.0, "scan_k": 0,
+                            "layout": "NHWC", "dtype": "float32",
+                            "platform": "tpu", "compile_s": 1.0,
+                            "loss": 1.0}}}, f)
+        with open(path, "w") as f:
+            json.dump({"results": {
+                "bfloat16": {"value": 123456.7, "platform": "tpu",
+                             "decode_tokens_per_sec": 888.9,
+                             "prefill_tokens_per_sec": 1e6},
+                "float32": {"value": 50.0, "platform": "cpu"}}}, f)
+        bench = _load_bench()
+        bench._probe_accelerator = lambda timeout=150, **kw: False
+        bench._run_child = lambda *a, **k: (None, "down")
+        out = _run_main(bench)
+        assert out["transformer"] == {
+            "bfloat16": {"train_tokens_per_sec": 123456.7,
+                         "decode_tokens_per_sec": 888.9}}
+        # corrupt side-file: artifact still prints, no transformer key
+        with open(path, "w") as f:
+            f.write("not json")
+        out = _run_main(_load_bench_with_down_probe())
+        assert out["value"] == 1000.0 and "transformer" not in out
+    finally:
+        if backup:
+            shutil.move(backup, path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+
+def _load_bench_with_down_probe():
+    bench = _load_bench()
+    bench._probe_accelerator = lambda timeout=150, **kw: False
+    bench._run_child = lambda *a, **k: (None, "down")
+    return bench
+
+
+def test_probe_bank_transformer_merge(tmp_path, monkeypatch):
+    """_bank_transformer: parses the LAST JSON line, skips CPU rows,
+    better-number-wins per dtype."""
+    import importlib
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import bench_probe as bp
+        importlib.reload(bp)
+    finally:
+        sys.path.pop(0)
+    monkeypatch.setattr(bp, "REPO", str(tmp_path))
+    line = json.dumps({"metric": "transformer_train_tokens_per_sec",
+                       "value": 1000.0, "platform": "tpu",
+                       "decode_tokens_per_sec": 10.0,
+                       "prefill_tokens_per_sec": 20.0})
+    bp._bank_transformer("noise\n" + line, "bfloat16")
+    path = tmp_path / "TRANSFORMER_CACHE.json"
+    assert json.loads(path.read_text())["results"]["bfloat16"]["value"] == 1000.0
+    # worse number does not clobber
+    bp._bank_transformer(json.dumps({"value": 900.0, "platform": "tpu"}),
+                         "bfloat16")
+    assert json.loads(path.read_text())["results"]["bfloat16"]["value"] == 1000.0
+    # cpu row never banked
+    bp._bank_transformer(json.dumps({"value": 5000.0, "platform": "cpu"}),
+                         "float32")
+    assert "float32" not in json.loads(path.read_text())["results"]
