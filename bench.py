@@ -577,6 +577,17 @@ def main():
         "INFER_CACHE.json", "best_ips",
         lambda v: round(float(v["best_ips"]), 2),
         "infer_ips", "infer_ts")
+    # committed hardware-independent roofline predictions (clearly
+    # labelled inside the blob as NOT measurements): the compiled-program
+    # analysis the first live window is meant to confirm
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "PERF_PREDICTION.json")) as f:
+            out["offline_roofline"] = json.load(f)
+    except Exception as e:
+        # never suppress the primary line, but a committed artifact that
+        # fails to load is a repo regression worth surfacing in-line
+        errors["offline_roofline"] = f"{type(e).__name__}: {e}"[:200]
     if errors:
         note += "; ".join(f"{k}: {v}" for k, v in errors.items())[:400]
     if note:

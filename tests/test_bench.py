@@ -534,3 +534,14 @@ def test_probe_bank_transformer_merge(tmp_path, monkeypatch):
     bp._bank_transformer(json.dumps({"value": 5000.0, "platform": "cpu"}),
                          "float32")
     assert "float32" not in json.loads(path.read_text())["results"]
+
+
+def test_offline_roofline_folds_with_label(cache_guard):
+    """The committed prediction artifact rides the bench line, clearly
+    labelled as predictions (never masquerading as measurements)."""
+    out = _run_main(_load_bench_with_down_probe())
+    ro = out.get("offline_roofline")
+    assert ro is not None, "PERF_PREDICTION.json should be committed"
+    assert "not measurements" in ro["note"]
+    assert ro["train_resnet50_bf16_scan"]["v5e_pred_img_per_s_range"]
+    assert set(ro["train_resnet50_bf16_scan"]["conv_dtypes"]) == {"bf16"}
