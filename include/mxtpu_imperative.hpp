@@ -50,6 +50,17 @@ int MXTpuImpExecForward(void* exec, int is_train, void** outputs, int max_out,
 int MXTpuImpExecBackward(void* exec);
 int MXTpuImpExecGrad(void* exec, const char* arg_name, void** grad_out);
 int MXTpuImpExecFree(void* exec);
+int MXTpuImpKVCreate(const char* type, void** out);
+int MXTpuImpKVInit(void* kv, const char* key, void* nd);
+int MXTpuImpKVPush(void* kv, const char* key, void* nd);
+int MXTpuImpKVPull(void* kv, const char* key, void* out_nd);
+int MXTpuImpKVPushPull(void* kv, const char* key, void* nd, void* out_nd);
+int MXTpuImpKVSetOptimizer(void* kv, const char* optimizer_name,
+                           const char* params_json);
+int MXTpuImpKVRankSize(void* kv, int* rank, int* size);
+int MXTpuImpKVBarrier(void* kv);
+int MXTpuImpKVNumDead(void* kv, int* n);
+int MXTpuImpKVFree(void* kv);
 }
 
 namespace mxtpu {
@@ -340,6 +351,66 @@ class SymbolExecutor {
     void* g = nullptr;
     check(MXTpuImpExecGrad(h_, name.c_str(), &g), "SymbolExecutor::gradOf");
     return NDArray(g);
+  }
+
+ private:
+  void* h_ = nullptr;
+};
+
+// ---------------------------------------------------------------------------
+// KVStore: the distributed communication surface (ref: the scala-package
+// core KVStore over MXKVStoreCreate/PushEx/PullEx, src/c_api/c_api.cc —
+// the API the reference's spark/ integration trains through). Types:
+// "local"/"device" (single-process), "dist_sync"/"dist_async" (multi-
+// process — the process must carry the tools/launch.py MXTPU_* env; the
+// store then joins the launcher's communicator as a full peer of Python
+// workers, collectives riding Gloo on CPU / ICI+DCN on TPU meshes).
+// Without an optimizer, push accumulates and pushPull is a per-step
+// allreduce; after setOptimizer, push APPLIES the update to the stored
+// weight (update_on_kvstore semantics) and pull broadcasts it.
+// ---------------------------------------------------------------------------
+class KVStore {
+ public:
+  explicit KVStore(const std::string& type = "local") {
+    check(MXTpuImpKVCreate(type.c_str(), &h_), "KVStore::create");
+  }
+  ~KVStore() { MXTpuImpKVFree(h_); }
+  KVStore(const KVStore&) = delete;
+  KVStore& operator=(const KVStore&) = delete;
+
+  void init(const std::string& key, const NDArray& value) {
+    check(MXTpuImpKVInit(h_, key.c_str(), value.handle()), "KVStore::init");
+  }
+  void push(const std::string& key, const NDArray& value) {
+    check(MXTpuImpKVPush(h_, key.c_str(), value.handle()), "KVStore::push");
+  }
+  // Pulls INTO `out` (broadcast semantics; `out` keeps its handle).
+  void pull(const std::string& key, NDArray* out) {
+    check(MXTpuImpKVPull(h_, key.c_str(), out->handle()), "KVStore::pull");
+  }
+  void pushPull(const std::string& key, const NDArray& value, NDArray* out) {
+    check(MXTpuImpKVPushPull(h_, key.c_str(), value.handle(), out->handle()),
+          "KVStore::pushPull");
+  }
+  // optimizer: a registered name ("sgd", "adam", ...); params_json: JSON
+  // object of constructor kwargs, e.g. R"({"learning_rate": 0.1})".
+  void setOptimizer(const std::string& optimizer,
+                    const std::string& params_json = "") {
+    check(MXTpuImpKVSetOptimizer(h_, optimizer.c_str(), params_json.c_str()),
+          "KVStore::setOptimizer");
+  }
+  int rank() const { return rankSize().first; }
+  int numWorkers() const { return rankSize().second; }
+  std::pair<int, int> rankSize() const {
+    int r = 0, s = 1;
+    check(MXTpuImpKVRankSize(h_, &r, &s), "KVStore::rankSize");
+    return {r, s};
+  }
+  void barrier() { check(MXTpuImpKVBarrier(h_), "KVStore::barrier"); }
+  int numDeadNode() const {
+    int n = 0;
+    check(MXTpuImpKVNumDead(h_, &n), "KVStore::numDeadNode");
+    return n;
   }
 
  private:

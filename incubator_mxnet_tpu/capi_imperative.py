@@ -164,3 +164,60 @@ def exec_grad(ex, name):
     if g is None:
         raise KeyError(f"exec_grad: no gradient bound for '{name}'")
     return g
+
+
+# -- kvstore (ref: src/c_api/c_api.cc MXKVStoreCreate/Init/PushEx/PullEx +
+# scala-package core KVStore — the surface the reference's spark/
+# integration trains through). Handles are KVStore objects; dist types
+# bootstrap jax.distributed from the MXTPU_* launcher env exactly like the
+# Python frontend (kvstore.create), so a C++/JVM worker process launched by
+# tools/launch.py joins the same communicator as a Python one. ------------
+
+
+def kv_create(kv_type):
+    from . import kvstore as kvstore_mod
+
+    return kvstore_mod.create(kv_type)
+
+
+def kv_type(kv):
+    return kv.type
+
+
+def kv_init(kv, key, nd):
+    kv.init(key, nd)
+
+
+def kv_push(kv, key, nd):
+    kv.push(key, nd)
+
+
+def kv_pull(kv, key, out_nd):
+    kv.pull(key, out=out_nd)
+
+
+def kv_pushpull(kv, key, nd, out_nd):
+    kv.pushpull(key, nd, out=out_nd)
+
+
+def kv_set_optimizer(kv, name, params_json):
+    """Build a registered optimizer from (name, params JSON) and install it
+    as the store-side updater — push then APPLIES updates to the stored
+    weight instead of accumulating (ref: kvstore.py set_optimizer, which
+    pickles the optimizer to the dist servers)."""
+    from . import optimizer as opt_mod
+
+    kwargs = json.loads(params_json) if params_json else {}
+    kv.set_optimizer(opt_mod.create(name, **kwargs))
+
+
+def kv_rank_size(kv):
+    return (int(kv.rank), int(kv.num_workers))
+
+
+def kv_barrier(kv):
+    kv.barrier()
+
+
+def kv_num_dead(kv):
+    return int(kv.num_dead_node)

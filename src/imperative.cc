@@ -410,4 +410,128 @@ int MXTpuImpExecFree(void* exec) {
   return 0;
 }
 
+// -- kvstore (ref: src/c_api/c_api.cc MXKVStoreCreate/Init/PushEx/PullEx —
+// the comm surface the reference's scala-package (and its spark/
+// integration) trains through). 'dist_*' types join the launcher's
+// communicator from the MXTPU_* env, so a C++/JVM worker process spawned
+// by tools/launch.py is a full peer of Python workers. Handles are
+// PyObject* KVStore instances; free with MXTpuImpKVFree.
+
+int MXTpuImpKVCreate(const char* type, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", type ? type : "local");
+  PyObject* r = call("kv_create", args);
+  Py_DECREF(args);
+  if (!r) return fail("kv_create");
+  *out = r;
+  return 0;
+}
+
+int MXTpuImpKVInit(void* kv, const char* key, void* nd) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OsO)", static_cast<PyObject*>(kv), key,
+                                 static_cast<PyObject*>(nd));
+  PyObject* r = call("kv_init", args);
+  Py_DECREF(args);
+  if (!r) return fail("kv_init");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuImpKVPush(void* kv, const char* key, void* nd) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OsO)", static_cast<PyObject*>(kv), key,
+                                 static_cast<PyObject*>(nd));
+  PyObject* r = call("kv_push", args);
+  Py_DECREF(args);
+  if (!r) return fail("kv_push");
+  Py_DECREF(r);
+  return 0;
+}
+
+// Pull the stored value INTO an existing array (broadcast semantics, the
+// reference MXKVStorePullEx contract): out_nd keeps its handle identity.
+int MXTpuImpKVPull(void* kv, const char* key, void* out_nd) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OsO)", static_cast<PyObject*>(kv), key,
+                                 static_cast<PyObject*>(out_nd));
+  PyObject* r = call("kv_pull", args);
+  Py_DECREF(args);
+  if (!r) return fail("kv_pull");
+  Py_DECREF(r);
+  return 0;
+}
+
+// Fused push+pull (allreduce when no optimizer is installed: the per-step
+// accumulator is reset after the pull, so step N+1 starts clean).
+int MXTpuImpKVPushPull(void* kv, const char* key, void* nd, void* out_nd) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OsOO)", static_cast<PyObject*>(kv), key, static_cast<PyObject*>(nd),
+      static_cast<PyObject*>(out_nd));
+  PyObject* r = call("kv_pushpull", args);
+  Py_DECREF(args);
+  if (!r) return fail("kv_pushpull");
+  Py_DECREF(r);
+  return 0;
+}
+
+// optimizer_name: a registered optimizer ("sgd", "adam", ...);
+// params_json: JSON object of constructor kwargs (or NULL). After this,
+// push APPLIES the update to the stored weight (update_on_kvstore).
+int MXTpuImpKVSetOptimizer(void* kv, const char* optimizer_name,
+                           const char* params_json) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oss)", static_cast<PyObject*>(kv),
+                                 optimizer_name,
+                                 params_json ? params_json : "");
+  PyObject* r = call("kv_set_optimizer", args);
+  Py_DECREF(args);
+  if (!r) return fail("kv_set_optimizer");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuImpKVRankSize(void* kv, int* rank, int* size) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(kv));
+  PyObject* r = call("kv_rank_size", args);
+  Py_DECREF(args);
+  if (!r) return fail("kv_rank_size");
+  *rank = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 0)));
+  *size = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 1)));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuImpKVBarrier(void* kv) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(kv));
+  PyObject* r = call("kv_barrier", args);
+  Py_DECREF(args);
+  if (!r) return fail("kv_barrier");
+  Py_DECREF(r);
+  return 0;
+}
+
+// Heartbeat-based dead-peer count (ref: KVStore::get_num_dead_node via
+// ps-lite Postoffice::GetDeadNodes) — 0 for single-process stores.
+int MXTpuImpKVNumDead(void* kv, int* n) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(kv));
+  PyObject* r = call("kv_num_dead", args);
+  Py_DECREF(args);
+  if (!r) return fail("kv_num_dead");
+  *n = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuImpKVFree(void* kv) {
+  if (!kv) return 0;
+  Gil gil;
+  Py_DECREF(static_cast<PyObject*>(kv));
+  return 0;
+}
+
 }  // extern "C"
