@@ -107,22 +107,30 @@ def child_main():
                                     size=batch_size).astype(np.float32)),
             target))
 
+    # HONEST-SYNC: the axon tunnel acknowledges block_until_ready (and so
+    # wait_to_read) WITHOUT awaiting execution — measured this round: a
+    # 1.1-TFLOP matmul "completes" in 25us by block_until_ready, then a
+    # device_get waits 156ms for the value. asnumpy() is a real fetch, and
+    # executions on one device are stream-ordered, so fetching the LAST
+    # loss closes the whole timed chain. (Train steps additionally chain
+    # through donated params, which serializes dispatch — but only the
+    # host fetch makes the final step's completion observable.)
     t0 = time.perf_counter()
     compile_s = 0.0
     print(f"[bench] init done ({dtype}), compiling...", file=sys.stderr, flush=True)
     for i in range(warmup):
         loss = step(x, y)
         if i == 0:
-            loss.wait_to_read()
+            loss.asnumpy()
             compile_s = time.perf_counter() - t0
             print(f"[bench] first step (compile) {compile_s:.1f}s",
                   file=sys.stderr, flush=True)
-    loss.wait_to_read()
+    loss.asnumpy()
 
     start = time.perf_counter()
     for _ in range(iters):
         loss = step(x, y)
-    loss.wait_to_read()
+    loss.asnumpy()
     elapsed = time.perf_counter() - start
     ips = batch_size * iters / elapsed
 
@@ -153,14 +161,14 @@ def child_main():
                 rng.randint(0, 1000, size=(scan_k, batch_size))
                 .astype(np.float32)), target))
         t0 = time.perf_counter()
-        step.scan_steps(xs, ys).wait_to_read()  # compile + warm
+        step.scan_steps(xs, ys).asnumpy()  # compile + warm (honest sync)
         print(f"[bench] scan compile {time.perf_counter()-t0:.1f}s",
               file=sys.stderr, flush=True)
         reps = max(1, iters // scan_k)
         t0 = time.perf_counter()
         for _ in range(reps):
             losses = step.scan_steps(xs, ys)
-        losses.wait_to_read()
+        losses.asnumpy()  # real fetch: closes the whole rep chain
         scan_ips = batch_size * scan_k * reps / (time.perf_counter() - t0)
 
     print(json.dumps({
@@ -369,13 +377,17 @@ def _probe_accelerator(timeout=150, exec_check=False):
     code = ("import jax; ds = jax.devices(); "
             "print('ACCEL' if any(d.platform != 'cpu' for d in ds) else 'CPU')")
     if exec_check:
+        # device_get, NOT block_until_ready: the axon tunnel acks
+        # block_until_ready without awaiting execution (measured), so only
+        # a real value fetch proves the chip executes
         code = (
             "import jax, jax.numpy as jnp; "
             "ds = [d for d in jax.devices() if d.platform != 'cpu']; "
             "assert ds, 'cpu only'; "
             "x = jax.device_put(jnp.ones((128, 128)), ds[0]); "
             "y = jax.jit(lambda a: (a @ a).sum())(x); "
-            "y.block_until_ready(); print('ACCEL-EXEC')")
+            "v = float(jax.device_get(y)); "
+            "assert v == 128.0 * 128 * 128, v; print('ACCEL-EXEC')")
     try:
         p = subprocess.run([sys.executable, "-c", code], capture_output=True,
                            text=True, timeout=timeout)

@@ -23,6 +23,16 @@ __all__ = ["flash_attention", "softmax_xent", "flash_decode",
 
 _NEG_INF = -1e30
 
+# Per-row statistics (lse, delta) ride with a trailing lane dimension:
+# Mosaic requires the last two dims of every block to be (8, 128)-tileable
+# or equal to the array dims, so a rank-1 (block_q,) stats block — whose
+# sublane dim is a squeezed batch axis — does not lower. The official TPU
+# flash kernels (jax.experimental.pallas.ops.tpu.flash_attention
+# MIN_BLOCK_SIZE) replicate the scalar across a full 128-wide lane dim;
+# 8 lanes satisfies the same rule via the equal-to-array-dim clause at
+# 1/16th the HBM footprint.
+_STAT_LANES = 8
+
 
 def _causal_mask(s, q_start, k_start):
     q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
@@ -67,7 +77,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_len,
     o, m, l = jax.lax.fori_loop(0, num_k, body, (o0, m0, l0))
     l = jnp.maximum(l, 1e-30)
     o_ref[...] = (o / l[:, None]).astype(o_ref.dtype)
-    lse_ref[...] = m + jnp.log(l)
+    lse_ref[...] = jnp.broadcast_to((m + jnp.log(l))[:, None],
+                                    (block_q, _STAT_LANES))
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
@@ -75,8 +86,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     """dQ for one query block: dq = sum_k (P*(dP - D)) * scale @ K."""
     q = q_ref[...]
     do = do_ref[...]
-    lse = lse_ref[...]
-    delta = delta_ref[...]  # rowsum(dO * O)
+    lse = lse_ref[...][:, :1]    # (block_q, 1) from the lane-replicated tile
+    delta = delta_ref[...][:, :1]  # rowsum(dO * O)
     block_q, d = q.shape
     q_idx = pl.program_id(1)
 
@@ -86,9 +97,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, q_idx * block_q, start * block_k)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         return dq + jnp.dot(ds.astype(k.dtype), k,
                             preferred_element_type=jnp.float32)
 
@@ -112,16 +123,16 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         dk, dv = carry
         q = q_ref[pl.ds(start * block_q, block_q), :]
         do = do_ref[pl.ds(start * block_q, block_q), :]
-        lse = lse_ref[pl.ds(start * block_q, block_q)]
-        delta = delta_ref[pl.ds(start * block_q, block_q)]
+        lse = lse_ref[pl.ds(start * block_q, block_q), :1]    # (bq, 1)
+        delta = delta_ref[pl.ds(start * block_q, block_q), :1]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, start * block_q, k_idx * block_k)
-        p = jnp.exp(s - lse[:, None])                       # (bq, bk)
+        p = jnp.exp(s - lse)                                # (bq, bk)
         dv_new = dv + jnp.dot(p.T.astype(do.dtype), do,
                               preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale              # (bq, bk)
+        ds = p * (dp - delta) * scale                       # (bq, bk)
         dk_new = dk + jnp.dot(ds.T.astype(q.dtype), q,
                               preferred_element_type=jnp.float32)
         return dk_new, dv_new
@@ -153,15 +164,16 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((None, block_q, _STAT_LANES),
+                         lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, T), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, T, _STAT_LANES), jnp.float32),
         ],
         interpret=interpret,
     )(qr, kr, vr)
-    return o.reshape(B, H, T, D), lse.reshape(B, H, T)
+    return o.reshape(B, H, T, D), lse[..., 0].reshape(B, H, T)
 
 
 def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
@@ -171,10 +183,13 @@ def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
     kr = k.reshape(B * H, T, D)
     vr = v.reshape(B * H, T, D)
     dor = do.reshape(B * H, T, D)
-    lser = lse.reshape(B * H, T)
+    # lane-replicate the per-row stats (see _STAT_LANES)
+    lser = jnp.broadcast_to(lse.reshape(B * H, T)[..., None],
+                            (B * H, T, _STAT_LANES))
     # D_i = rowsum(dO * O): cheap dense elementwise, no kernel needed
     delta = jnp.sum(dor.astype(jnp.float32)
                     * o.reshape(B * H, T, D).astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (B * H, T, _STAT_LANES))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, block_k=block_k, seq_len=T,
@@ -185,8 +200,10 @@ def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
             pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((None, block_q, _STAT_LANES),
+                         lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, _STAT_LANES),
+                         lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
@@ -202,8 +219,8 @@ def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
             pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, 0)),
             pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, 0)),
             pl.BlockSpec((None, T, D), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((None, T), lambda b, j: (b, 0)),
-            pl.BlockSpec((None, T), lambda b, j: (b, 0)),
+            pl.BlockSpec((None, T, _STAT_LANES), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((None, T, _STAT_LANES), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, 0)),

@@ -1,0 +1,132 @@
+package org.apache.mxtpu;
+
+import java.lang.ref.Cleaner;
+
+/**
+ * Distributed key-value communication surface (reference role:
+ * org.apache.mxnet.KVStore — the API the reference's spark/ integration
+ * trains through, over MXKVStoreCreate/PushEx/PullEx).
+ *
+ * Types: "local"/"device" (single-process), "dist_sync"/"dist_async"
+ * (multi-process: the JVM process must carry the tools/launch.py MXTPU_*
+ * env; it then joins the launcher's communicator as a full peer of Python
+ * and C++ workers — collectives ride Gloo on CPU, ICI/DCN on TPU meshes).
+ *
+ * Without an optimizer, push accumulates and {@link #pushPull} is a
+ * per-step allreduce; after {@link #setOptimizer} push APPLIES the update
+ * to the stored weight (update_on_kvstore semantics) and pull broadcasts
+ * it — the reference's server-side-optimizer protocol
+ * (kvstore_dist_server.h ApplyUpdates).
+ */
+public final class KVStore implements AutoCloseable {
+  private static final Cleaner CLEANER = Cleaner.create();
+
+  private long handle;
+  private final Cleaner.Cleanable cleanable;
+
+  private static final class FreeAction implements Runnable {
+    private long h;
+
+    FreeAction(long h) {
+      this.h = h;
+    }
+
+    @Override
+    public void run() {
+      if (h != 0) {
+        LibMXTpu.kvFree(h);
+        h = 0;
+      }
+    }
+  }
+
+  private final FreeAction freeAction;
+
+  public KVStore(String type) {
+    MXTpu.init();
+    this.handle = LibMXTpu.kvCreate(type);
+    if (this.handle == 0) {
+      throw new MXTpuException("KVStore(" + type + "): "
+          + LibMXTpu.lastError());
+    }
+    this.freeAction = new FreeAction(handle);
+    this.cleanable = CLEANER.register(this, freeAction);
+  }
+
+  private long h() {
+    if (handle == 0) {
+      throw new MXTpuException("KVStore used after close()");
+    }
+    return handle;
+  }
+
+  private static void check(int rc, String what) {
+    if (rc != 0) {
+      throw new MXTpuException(what + ": " + LibMXTpu.lastError());
+    }
+  }
+
+  public void init(String key, NDArray value) {
+    check(LibMXTpu.kvInit(h(), key, value.handle()), "KVStore.init");
+  }
+
+  public void push(String key, NDArray value) {
+    check(LibMXTpu.kvPush(h(), key, value.handle()), "KVStore.push");
+  }
+
+  /** Pulls the stored value INTO {@code out} (broadcast semantics). */
+  public void pull(String key, NDArray out) {
+    check(LibMXTpu.kvPull(h(), key, out.handle()), "KVStore.pull");
+  }
+
+  /** Fused push+pull: a per-step allreduce when no optimizer is set. */
+  public void pushPull(String key, NDArray value, NDArray out) {
+    check(LibMXTpu.kvPushPull(h(), key, value.handle(), out.handle()),
+        "KVStore.pushPull");
+  }
+
+  /**
+   * Install a registered optimizer ("sgd", "adam", ...) with JSON kwargs,
+   * e.g. {@code {"learning_rate": 0.1}} — push then applies updates.
+   */
+  public void setOptimizer(String name, String paramsJson) {
+    check(LibMXTpu.kvSetOptimizer(h(), name, paramsJson == null ? ""
+        : paramsJson), "KVStore.setOptimizer");
+  }
+
+  public int rank() {
+    return rankSize()[0];
+  }
+
+  public int numWorkers() {
+    return rankSize()[1];
+  }
+
+  private int[] rankSize() {
+    int[] rs = LibMXTpu.kvRankSize(h());
+    if (rs == null) {
+      throw new MXTpuException("KVStore.rankSize: " + LibMXTpu.lastError());
+    }
+    return rs;
+  }
+
+  public void barrier() {
+    check(LibMXTpu.kvBarrier(h()), "KVStore.barrier");
+  }
+
+  /** Heartbeat-based dead-peer count (0 for single-process stores). */
+  public int numDeadNode() {
+    int n = LibMXTpu.kvNumDead(h());
+    if (n < 0) {
+      throw new MXTpuException("KVStore.numDeadNode: "
+          + LibMXTpu.lastError());
+    }
+    return n;
+  }
+
+  @Override
+  public void close() {
+    cleanable.clean();
+    handle = 0;
+  }
+}

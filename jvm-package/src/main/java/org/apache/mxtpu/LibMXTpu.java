@@ -91,4 +91,26 @@ final class LibMXTpu {
   static native String predLastError();
 
   static native int predFree(long handle);
+
+  // --- kvstore (the scala-package core KVStore role; dist types join the
+  // tools/launch.py communicator from this process's MXTPU_* env) -------
+  static native long kvCreate(String type);
+
+  static native int kvInit(long kv, String key, long nd);
+
+  static native int kvPush(long kv, String key, long nd);
+
+  static native int kvPull(long kv, String key, long outNd);
+
+  static native int kvPushPull(long kv, String key, long nd, long outNd);
+
+  static native int kvSetOptimizer(long kv, String name, String paramsJson);
+
+  static native int[] kvRankSize(long kv);
+
+  static native int kvBarrier(long kv);
+
+  static native int kvNumDead(long kv);
+
+  static native int kvFree(long kv);
 }

@@ -1,0 +1,182 @@
+package org.apache.mxtpu;
+
+import java.io.BufferedReader;
+import java.io.File;
+import java.io.FileReader;
+import java.io.IOException;
+import java.net.ServerSocket;
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+/**
+ * Driver-side orchestration of multi-process data-parallel training
+ * (reference role: scala-package/spark MXNet.scala — the driver
+ * partitions the job, launches a gang of workers, each worker joins the
+ * KVStore communicator and trains its shard, and the driver collects the
+ * fitted parameters into a model).
+ *
+ * TPU-native shape: there are no parameter-server roles to schedule —
+ * every worker is a peer on the launcher communicator (gradients ride
+ * allreduce collectives: Gloo on CPU hosts, ICI/DCN on TPU meshes). The
+ * driver's job reduces to what Spark's did: assign ranks, set the
+ * MXTPU_* gang env (the tools/launch.py protocol), wait, and load the
+ * rank-0 parameter snapshot.
+ *
+ * The worker program is any Java main that trains through
+ * {@link SymbolModule#withKVStore} (see examples/ClusterWorker.java) and
+ * writes its parameters with {@link #saveParams} on rank 0.
+ */
+public final class MXTpuDist {
+  private int numWorkers = 2;
+  private String workerClass = "org.apache.mxtpu.examples.ClusterWorker";
+  private final List<String> workerArgs = new ArrayList<>();
+  private String classpath = System.getProperty("java.class.path");
+  private String libraryPath = System.getProperty("java.library.path");
+  private long timeoutMillis = 600_000;
+
+  public MXTpuDist setNumWorkers(int n) {
+    this.numWorkers = n;
+    return this;
+  }
+
+  /** Fully qualified name of the worker main class. */
+  public MXTpuDist setWorkerClass(String cls) {
+    this.workerClass = cls;
+    return this;
+  }
+
+  public MXTpuDist addWorkerArg(String arg) {
+    this.workerArgs.add(arg);
+    return this;
+  }
+
+  public MXTpuDist setClasspath(String cp) {
+    this.classpath = cp;
+    return this;
+  }
+
+  public MXTpuDist setLibraryPath(String lp) {
+    this.libraryPath = lp;
+    return this;
+  }
+
+  public MXTpuDist setTimeoutMillis(long ms) {
+    this.timeoutMillis = ms;
+    return this;
+  }
+
+  /**
+   * Launch the worker gang, wait for every rank, then load the fitted
+   * parameters the rank-0 worker wrote to {@code paramsOut}.
+   *
+   * @param paramsOut path the rank-0 worker writes (passed to every
+   *     worker as its first argument, before the configured args)
+   * @return parameter name → fitted value
+   */
+  public Map<String, NDArray> fit(String paramsOut) {
+    int port;
+    try (ServerSocket s = new ServerSocket(0)) {
+      port = s.getLocalPort();
+    } catch (IOException e) {
+      throw new MXTpuException("no free coordinator port: " + e);
+    }
+    String java = new File(new File(System.getProperty("java.home"), "bin"),
+        "java").getPath();
+    List<Process> gang = new ArrayList<>();
+    try {
+      for (int rank = 0; rank < numWorkers; rank++) {
+        List<String> cmd = new ArrayList<>();
+        cmd.add(java);
+        cmd.add("-cp");
+        cmd.add(classpath);
+        if (libraryPath != null) {
+          cmd.add("-Djava.library.path=" + libraryPath);
+        }
+        cmd.add(workerClass);
+        cmd.add(paramsOut);
+        cmd.addAll(workerArgs);
+        ProcessBuilder pb = new ProcessBuilder(cmd).inheritIO();
+        // the tools/launch.py gang protocol: any process with this env
+        // joins the same communicator, whatever language it runs
+        pb.environment().put("MXTPU_COORDINATOR", "127.0.0.1:" + port);
+        pb.environment().put("MXTPU_NUM_PROCESSES",
+            String.valueOf(numWorkers));
+        pb.environment().put("MXTPU_PROCESS_ID", String.valueOf(rank));
+        try {
+          gang.add(pb.start());
+        } catch (IOException e) {
+          throw new MXTpuException("worker spawn failed: " + e);
+        }
+      }
+      long deadline = System.currentTimeMillis() + timeoutMillis;
+      for (Process p : gang) {
+        try {
+          long left = Math.max(1, deadline - System.currentTimeMillis());
+          if (!p.waitFor(left, java.util.concurrent.TimeUnit.MILLISECONDS)) {
+            throw new MXTpuException("worker timed out");
+          }
+        } catch (InterruptedException e) {
+          Thread.currentThread().interrupt();
+          throw new MXTpuException("interrupted waiting for workers");
+        }
+        if (p.exitValue() != 0) {
+          throw new MXTpuException("worker failed rc=" + p.exitValue());
+        }
+      }
+    } finally {
+      for (Process p : gang) {
+        if (p.isAlive()) {
+          p.destroyForcibly();
+        }
+      }
+    }
+    return loadParams(paramsOut);
+  }
+
+  /** Text snapshot: one line per parameter, `name d0,d1 v0 v1 ...`. */
+  public static void saveParams(String path, Map<String, NDArray> params)
+      throws IOException {
+    try (java.io.PrintWriter w = new java.io.PrintWriter(path, "UTF-8")) {
+      for (Map.Entry<String, NDArray> e : params.entrySet()) {
+        long[] shape = e.getValue().shape();
+        StringBuilder sb = new StringBuilder(e.getKey()).append(' ');
+        for (int i = 0; i < shape.length; i++) {
+          sb.append(i == 0 ? "" : ",").append(shape[i]);
+        }
+        for (float v : e.getValue().toFloats()) {
+          sb.append(' ').append(v);
+        }
+        w.println(sb);
+      }
+    }
+  }
+
+  public static Map<String, NDArray> loadParams(String path) {
+    MXTpu.init(); // the driver JVM may not have touched the runtime yet
+    Map<String, NDArray> out = new LinkedHashMap<>();
+    try (BufferedReader r = new BufferedReader(new FileReader(path))) {
+      String line;
+      while ((line = r.readLine()) != null) {
+        if (line.isEmpty()) {
+          continue;
+        }
+        String[] parts = line.split(" ");
+        String[] dims = parts[1].split(",");
+        long[] shape = new long[dims.length];
+        for (int i = 0; i < dims.length; i++) {
+          shape[i] = Long.parseLong(dims[i]);
+        }
+        float[] vals = new float[parts.length - 2];
+        for (int i = 0; i < vals.length; i++) {
+          vals[i] = Float.parseFloat(parts[i + 2]);
+        }
+        out.put(parts[0], NDArray.fromFloats(shape, vals));
+      }
+    } catch (IOException e) {
+      throw new MXTpuException("loadParams(" + path + "): " + e);
+    }
+    return out;
+  }
+}

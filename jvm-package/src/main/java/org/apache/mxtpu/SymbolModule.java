@@ -24,6 +24,7 @@ public final class SymbolModule implements AutoCloseable {
   private final double lr;
   private final double wd;
   private Executor exec;
+  private KVStore kv;
 
   /**
    * @param loss loss symbol over variables {dataName, labelName} ∪
@@ -61,6 +62,18 @@ public final class SymbolModule implements AutoCloseable {
     }
   }
 
+  /**
+   * Attach a {@link KVStore} for data-parallel training (the reference
+   * Module's kvstore wiring): each step's gradients are allreduced
+   * across workers via pushPull before the local update, and the
+   * per-example rescale divides by the GLOBAL batch (batch × workers).
+   * Every worker must start from identical parameter values.
+   */
+  public SymbolModule withKVStore(KVStore kvstore) {
+    this.kv = kvstore;
+    return this;
+  }
+
   /** Epoch loop over the iterator; returns per-epoch mean loss (the
    * reference Module.fit contract). */
   public float[] fit(DataIter train, int epochs) {
@@ -71,8 +84,9 @@ public final class SymbolModule implements AutoCloseable {
     DataDesc xDesc = train.provideData();
     DataDesc yDesc = train.provideLabel();
     long batch = xDesc.batchSize();
+    long world = kv == null ? 1 : kv.numWorkers();
     AttrMap step = AttrMap.of().set("lr", lr).set("wd", wd)
-        .set("rescale_grad", 1.0 / batch);
+        .set("rescale_grad", 1.0 / (batch * world));
     float[] epochLoss = new float[epochs];
     for (int e = 0; e < epochs; e++) {
       train.reset();
@@ -98,7 +112,13 @@ public final class SymbolModule implements AutoCloseable {
         float l = sum / batch;
         exec.backward();
         for (String p : paramNames) {
-          NDArray updated = Ops.sgd_update(args.get(p), exec.gradOf(p), step);
+          NDArray g = exec.gradOf(p);
+          if (kv != null) {
+            // cross-worker gradient allreduce (pull back into the same
+            // array; the store accumulator resets per step)
+            kv.pushPull("grad_" + p, g, g);
+          }
+          NDArray updated = Ops.sgd_update(args.get(p), g, step);
           args.put(p, updated);
           updated.attachGrad(); // re-arm for the next recorded forward
         }

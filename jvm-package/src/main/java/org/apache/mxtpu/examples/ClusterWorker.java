@@ -1,0 +1,130 @@
+package org.apache.mxtpu.examples;
+
+import java.io.IOException;
+import java.util.LinkedHashMap;
+import java.util.Map;
+import org.apache.mxtpu.AttrMap;
+import org.apache.mxtpu.KVStore;
+import org.apache.mxtpu.MXTpu;
+import org.apache.mxtpu.MXTpuDist;
+import org.apache.mxtpu.NDArray;
+import org.apache.mxtpu.NDArrayIter;
+import org.apache.mxtpu.Symbol;
+import org.apache.mxtpu.SymbolModule;
+
+/**
+ * One data-parallel worker of an {@link MXTpuDist} gang (reference role:
+ * the executor-side closure of scala-package/spark MXNet.scala — each
+ * Spark partition ran a Module.fit against the shared KVStore; here each
+ * worker process joins the launcher communicator, trains its OWN shard,
+ * and rank 0 snapshots the fitted parameters for the driver).
+ *
+ * Every rank draws a DIFFERENT shard of the same synthetic class-
+ * clustered problem (rank-seeded), while parameters start from a COMMON
+ * seed; the per-step gradient allreduce (SymbolModule.withKVStore) keeps
+ * them identical — which the worker asserts before exiting.
+ */
+public final class ClusterWorker {
+  private ClusterWorker() {}
+
+  private static float[] lcg(int n, int seed) {
+    float[] out = new float[n];
+    long state = seed;
+    for (int i = 0; i < n; i++) {
+      state = (state * 6364136223846793005L + 1442695040888963407L);
+      out[i] = ((state >>> 33) % 2000) / 1000.0f - 1.0f;
+    }
+    return out;
+  }
+
+  public static void main(String[] args) throws IOException {
+    String paramsOut = args.length > 0 ? args[0] : "params.txt";
+    int epochs = args.length > 1 ? Integer.parseInt(args[1]) : 15;
+    int batch = 32;
+    int inDim = 16;
+    int hidden = 24;
+    int classes = 3;
+
+    MXTpu.init();
+    try (KVStore kv = new KVStore("dist_sync")) {
+      int rank = kv.rank();
+      int world = kv.numWorkers();
+
+      // rank-seeded shard: class-clustered points + noise
+      float[] xs = lcg(batch * inDim, 1000 + rank);
+      float[] ys = new float[batch];
+      for (int i = 0; i < batch; i++) {
+        int c = Math.floorMod((int) (xs[i * inDim] * 997), classes);
+        ys[i] = c;
+        for (int j = 0; j < inDim; j++) {
+          xs[i * inDim + j] = 0.3f * xs[i * inDim + j]
+              + 0.5f * ((c + j) % 3);
+        }
+      }
+
+      Symbol x = Symbol.variable("x");
+      Symbol label = Symbol.variable("label");
+      Symbol h = Symbol.op("FullyConnected", "fc1",
+          AttrMap.of().set("num_hidden", hidden),
+          x, Symbol.variable("w1"), Symbol.variable("b1"));
+      Symbol act = Symbol.op("Activation", "relu1",
+          AttrMap.of().set("act_type", "relu"), h);
+      Symbol logits = Symbol.op("FullyConnected", "fc2",
+          AttrMap.of().set("num_hidden", classes),
+          act, Symbol.variable("w2"), Symbol.variable("b2"));
+      Symbol loss = Symbol.op("softmax_cross_entropy", "loss", null,
+          logits, label);
+
+      // COMMON param seed on every rank — the data-parallel invariant
+      // needs identical starting points
+      Map<String, NDArray> params = new LinkedHashMap<>();
+      float[] w1v = lcg(hidden * inDim, 7);
+      float[] w2v = lcg(classes * hidden, 8);
+      for (int i = 0; i < w1v.length; i++) {
+        w1v[i] *= 0.2f;
+      }
+      for (int i = 0; i < w2v.length; i++) {
+        w2v[i] *= 0.2f;
+      }
+      params.put("w1", NDArray.fromFloats(new long[] {hidden, inDim}, w1v));
+      params.put("b1", NDArray.zeros(hidden));
+      params.put("w2", NDArray.fromFloats(new long[] {classes, hidden},
+          w2v));
+      params.put("b2", NDArray.zeros(classes));
+
+      SymbolModule mod = new SymbolModule(loss, "x", "label", params,
+          0.3, 0.0).withKVStore(kv);
+      NDArrayIter iter = new NDArrayIter(xs, ys, batch, inDim, batch);
+      float[] epochLoss = mod.fit(iter, epochs);
+      float first = epochLoss[0];
+      float last = epochLoss[epochs - 1];
+
+      // cross-rank weight agreement: sum(w1) must equal world * local
+      NDArray w1 = mod.params().get("w1");
+      NDArray probe = NDArray.zeros(hidden, inDim);
+      kv.pushPull("final_w1", w1, probe);
+      float[] local = w1.toFloats();
+      float[] summed = probe.toFloats();
+      double maxDev = 0;
+      for (int i = 0; i < local.length; i++) {
+        maxDev = Math.max(maxDev,
+            Math.abs(summed[i] - (double) world * local[i]));
+      }
+      kv.barrier();
+
+      if (rank == 0) {
+        MXTpuDist.saveParams(paramsOut, mod.params());
+      }
+      System.out.printf("rank %d/%d: loss %.4f -> %.4f, dev %.3g%n",
+          rank, world, first, last, maxDev);
+      if (last < first * 0.8f && maxDev < 1e-4) {
+        System.out.printf("TRAINED cluster_worker rank=%d world=%d%n",
+            rank, world);
+      } else {
+        System.out.println("FAILED cluster_worker");
+        System.exit(1);
+      }
+      mod.close();
+    }
+  }
+}

@@ -1,0 +1,42 @@
+package org.apache.mxtpu.examples;
+
+import java.io.File;
+import java.util.Map;
+import org.apache.mxtpu.MXTpuDist;
+import org.apache.mxtpu.NDArray;
+
+/**
+ * Driver side of the distributed JVM training demo (reference role: a
+ * user's Spark job calling scala-package/spark MXNet.fit — configure the
+ * cluster, fit, get a parameter map back).
+ *
+ * Launches {@code n} {@link ClusterWorker} processes (each joins the
+ * KVStore communicator, trains its shard, rank 0 snapshots parameters)
+ * and loads the fitted parameters into this JVM.
+ */
+public final class DistTrainMlp {
+  private DistTrainMlp() {}
+
+  public static void main(String[] args) throws Exception {
+    int n = args.length > 0 ? Integer.parseInt(args[0]) : 2;
+    String out = args.length > 1 ? args[1]
+        : File.createTempFile("mxtpu_dist_params", ".txt").getPath();
+
+    Map<String, NDArray> params = new MXTpuDist()
+        .setNumWorkers(n)
+        .addWorkerArg("15")
+        .fit(out);
+
+    long total = 0;
+    for (NDArray p : params.values()) {
+      total += p.toFloats().length;
+    }
+    if (params.containsKey("w1") && params.containsKey("w2") && total > 0) {
+      System.out.println("DISTFIT OK params=" + params.size()
+          + " elems=" + total);
+    } else {
+      System.out.println("DISTFIT FAILED");
+      System.exit(1);
+    }
+  }
+}

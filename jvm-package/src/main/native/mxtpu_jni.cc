@@ -40,6 +40,17 @@ int MXTpuImpExecForward(void* exec, int is_train, void** outputs, int max_out,
 int MXTpuImpExecBackward(void* exec);
 int MXTpuImpExecGrad(void* exec, const char* arg_name, void** grad_out);
 int MXTpuImpExecFree(void* exec);
+int MXTpuImpKVCreate(const char* type, void** out);
+int MXTpuImpKVInit(void* kv, const char* key, void* nd);
+int MXTpuImpKVPush(void* kv, const char* key, void* nd);
+int MXTpuImpKVPull(void* kv, const char* key, void* out_nd);
+int MXTpuImpKVPushPull(void* kv, const char* key, void* nd, void* out_nd);
+int MXTpuImpKVSetOptimizer(void* kv, const char* optimizer_name,
+                           const char* params_json);
+int MXTpuImpKVRankSize(void* kv, int* rank, int* size);
+int MXTpuImpKVBarrier(void* kv);
+int MXTpuImpKVNumDead(void* kv, int* n);
+int MXTpuImpKVFree(void* kv);
 // trainer ABI (include/mxtpu.h)
 typedef void* MXTpuTrainerHandle;
 int MXTpuTrainerCreate(const char* path, const char* plugin,
@@ -434,6 +445,81 @@ JNIEXPORT jint JNICALL
 Java_org_apache_mxtpu_LibMXTpu_predFree(JNIEnv*, jclass, jlong h) {
   MXTpuPredFree(reinterpret_cast<void*>(h));
   return 0;
+}
+
+// kvstore ABI (the scala-package core KVStore role; dist types join the
+// tools/launch.py communicator from the MXTPU_* env of THIS process)
+
+JNIEXPORT jlong JNICALL Java_org_apache_mxtpu_LibMXTpu_kvCreate(
+    JNIEnv* env, jclass, jstring type) {
+  std::string t = jstr(env, type);
+  void* h = nullptr;
+  if (MXTpuImpKVCreate(t.empty() ? "local" : t.c_str(), &h) != 0) return 0;
+  return reinterpret_cast<jlong>(h);
+}
+
+JNIEXPORT jint JNICALL Java_org_apache_mxtpu_LibMXTpu_kvInit(
+    JNIEnv* env, jclass, jlong kv, jstring key, jlong nd) {
+  std::string k = jstr(env, key);
+  return MXTpuImpKVInit(reinterpret_cast<void*>(kv), k.c_str(),
+                        reinterpret_cast<void*>(nd));
+}
+
+JNIEXPORT jint JNICALL Java_org_apache_mxtpu_LibMXTpu_kvPush(
+    JNIEnv* env, jclass, jlong kv, jstring key, jlong nd) {
+  std::string k = jstr(env, key);
+  return MXTpuImpKVPush(reinterpret_cast<void*>(kv), k.c_str(),
+                        reinterpret_cast<void*>(nd));
+}
+
+JNIEXPORT jint JNICALL Java_org_apache_mxtpu_LibMXTpu_kvPull(
+    JNIEnv* env, jclass, jlong kv, jstring key, jlong outNd) {
+  std::string k = jstr(env, key);
+  return MXTpuImpKVPull(reinterpret_cast<void*>(kv), k.c_str(),
+                        reinterpret_cast<void*>(outNd));
+}
+
+JNIEXPORT jint JNICALL Java_org_apache_mxtpu_LibMXTpu_kvPushPull(
+    JNIEnv* env, jclass, jlong kv, jstring key, jlong nd, jlong outNd) {
+  std::string k = jstr(env, key);
+  return MXTpuImpKVPushPull(reinterpret_cast<void*>(kv), k.c_str(),
+                            reinterpret_cast<void*>(nd),
+                            reinterpret_cast<void*>(outNd));
+}
+
+JNIEXPORT jint JNICALL Java_org_apache_mxtpu_LibMXTpu_kvSetOptimizer(
+    JNIEnv* env, jclass, jlong kv, jstring name, jstring paramsJson) {
+  std::string n = jstr(env, name), p = jstr(env, paramsJson);
+  return MXTpuImpKVSetOptimizer(reinterpret_cast<void*>(kv), n.c_str(),
+                                p.c_str());
+}
+
+JNIEXPORT jintArray JNICALL Java_org_apache_mxtpu_LibMXTpu_kvRankSize(
+    JNIEnv* env, jclass, jlong kv) {
+  int rank = 0, size = 1;
+  if (MXTpuImpKVRankSize(reinterpret_cast<void*>(kv), &rank, &size) != 0)
+    return nullptr;
+  jintArray out = env->NewIntArray(2);
+  jint vals[2] = {rank, size};
+  env->SetIntArrayRegion(out, 0, 2, vals);
+  return out;
+}
+
+JNIEXPORT jint JNICALL
+Java_org_apache_mxtpu_LibMXTpu_kvBarrier(JNIEnv*, jclass, jlong kv) {
+  return MXTpuImpKVBarrier(reinterpret_cast<void*>(kv));
+}
+
+JNIEXPORT jint JNICALL
+Java_org_apache_mxtpu_LibMXTpu_kvNumDead(JNIEnv*, jclass, jlong kv) {
+  int n = 0;
+  if (MXTpuImpKVNumDead(reinterpret_cast<void*>(kv), &n) != 0) return -1;
+  return n;
+}
+
+JNIEXPORT jint JNICALL
+Java_org_apache_mxtpu_LibMXTpu_kvFree(JNIEnv*, jclass, jlong kv) {
+  return MXTpuImpKVFree(reinterpret_cast<void*>(kv));
 }
 
 }  // extern "C"

@@ -88,27 +88,39 @@ def time_modes(fwd, gen_batch, batch, iters, scan_k, params=()):
 
     jscan = jax.jit(scan_fwd)
 
+    # HONEST-SYNC PROTOCOL: remote-attached accelerators (the axon
+    # tunnel) acknowledge block_until_ready WITHOUT awaiting execution —
+    # measured: a 1.1-TFLOP matmul "completes" in 25us by block, then
+    # device_get waits 156ms for the real value. Executions on one device
+    # are stream-ordered, so fetching a tiny slice of the LAST output
+    # forces the whole timed chain; every timed region below ends with
+    # that device_get (verified: 8 independent dispatches + final fetch
+    # == one 8-chained program == RTT + 8x compute).
+    def sync(o):
+        return jax.device_get(jax.numpy.ravel(o)[0])
+
     x = gen_batch(0)
     t0 = time.perf_counter()
-    jfwd(params, x).block_until_ready()
+    sync(jfwd(params, x))
     compile_s = time.perf_counter() - t0
+    sync(jfwd(params, x))  # steady-state warm
     t0 = time.perf_counter()
     out = None
     for _ in range(max(1, iters)):
         out = jfwd(params, x)
-    out.block_until_ready()
+    sync(out)
     ips = batch * max(1, iters) / (time.perf_counter() - t0)
 
     scan_ips = 0.0
     if scan_k > 1:
         xs = gen_batch(1, lead=(scan_k,))
-        jscan(params, xs).block_until_ready()  # compile + warm
+        sync(jscan(params, xs))  # compile + warm
         reps = max(1, iters // scan_k)
         t0 = time.perf_counter()
         outs = None
         for _ in range(reps):
             outs = jscan(params, xs)
-        outs.block_until_ready()
+        sync(outs)
         scan_ips = batch * scan_k * reps / (time.perf_counter() - t0)
     return round(ips, 2), round(scan_ips, 2), round(compile_s, 1)
 
