@@ -246,9 +246,10 @@ def decode_step(params, cache, tokens, cfg: TransformerConfig):
         k = (h @ lp["wk"]).reshape(B, cfg.n_heads, -1)
         v = (h @ lp["wv"]).reshape(B, cfg.n_heads, -1)
         k_cache = lax.dynamic_update_slice_in_dim(
-            k_cache, k[:, None], pos, axis=1)  # (B, T_max, H, Dh)
+            k_cache, k[:, None].astype(k_cache.dtype), pos,
+            axis=1)  # (B, T_max, H, Dh)
         v_cache = lax.dynamic_update_slice_in_dim(
-            v_cache, v[:, None], pos, axis=1)
+            v_cache, v[:, None].astype(v_cache.dtype), pos, axis=1)
         if cfg.use_flash:
             from ..ops.pallas_kernels import flash_decode
 
@@ -287,8 +288,12 @@ def prefill(params, cache, prompt, cfg: TransformerConfig):
         q = _split_heads(h @ lp["wq"], cfg.n_heads)
         k = _split_heads(h @ lp["wk"], cfg.n_heads)
         v = _split_heads(h @ lp["wv"], cfg.n_heads)
-        k_cache = lax.dynamic_update_slice_in_dim(k_cache, k, 0, axis=1)
-        v_cache = lax.dynamic_update_slice_in_dim(v_cache, v, 0, axis=1)
+        # cache dtype follows cfg.dtype; activations may be wider (f32
+        # master weights) — cast at the cache-write boundary
+        k_cache = lax.dynamic_update_slice_in_dim(
+            k_cache, k.astype(k_cache.dtype), 0, axis=1)
+        v_cache = lax.dynamic_update_slice_in_dim(
+            v_cache, v.astype(v_cache.dtype), 0, axis=1)
         a = _dense_attention(q, k, v, causal=True)
         x = x + a.reshape(B, T_p, cfg.d_model) @ lp["wo"]
         h = _ln(x, lp["ln2_g"], lp["ln2_b"])

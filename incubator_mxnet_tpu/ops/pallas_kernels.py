@@ -287,22 +287,25 @@ def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
 
 
 def _xent_fwd_kernel(logits_ref, labels_ref, loss_ref, lse_ref):
+    # per-row tensors ride as (block_b, 1): Mosaic rejects rank-1 blocks
+    # unless they span the array or tile by 128 (the trailing unit lane
+    # dim passes via the equal-to-array-dim clause)
     logits = logits_ref[...].astype(jnp.float32)      # (block_b, V)
-    labels = labels_ref[...]                          # (block_b,)
+    labels = labels_ref[...][:, 0]                    # (block_b,)
     m = jnp.max(logits, axis=-1)
     lse = m + jnp.log(jnp.sum(jnp.exp(logits - m[:, None]), axis=-1))
     onehot = (jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
               == labels[:, None])
     picked = jnp.sum(jnp.where(onehot, logits, 0.0), axis=-1)
-    loss_ref[...] = lse - picked
-    lse_ref[...] = lse
+    loss_ref[...] = (lse - picked)[:, None]
+    lse_ref[...] = lse[:, None]
 
 
 def _xent_bwd_kernel(logits_ref, labels_ref, lse_ref, dloss_ref, dlogits_ref):
     logits = logits_ref[...].astype(jnp.float32)
-    labels = labels_ref[...]
-    lse = lse_ref[...]
-    dloss = dloss_ref[...]
+    labels = labels_ref[...][:, 0]
+    lse = lse_ref[...][:, 0]
+    dloss = dloss_ref[...][:, 0]
     p = jnp.exp(logits - lse[:, None])                # softmax, recomputed
     onehot = (jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
               == labels[:, None])
@@ -321,23 +324,24 @@ def _sds(shape, dtype, vma):
 def _xent_fwd(logits, labels, block_b, interpret, vma):
     b, v = logits.shape
     grid = (pl.cdiv(b, block_b),)
-    return pl.pallas_call(
+    loss, lse = pl.pallas_call(
         _xent_fwd_kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((block_b, v), lambda i: (i, 0)),
-            pl.BlockSpec((block_b,), lambda i: (i,)),
+            pl.BlockSpec((block_b, 1), lambda i: (i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((block_b,), lambda i: (i,)),
-            pl.BlockSpec((block_b,), lambda i: (i,)),
+            pl.BlockSpec((block_b, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_b, 1), lambda i: (i, 0)),
         ],
         out_shape=[
-            _sds((b,), jnp.float32, vma),
-            _sds((b,), jnp.float32, vma),
+            _sds((b, 1), jnp.float32, vma),
+            _sds((b, 1), jnp.float32, vma),
         ],
         interpret=interpret,
-    )(logits, labels)
+    )(logits, labels[:, None])
+    return loss[:, 0], lse[:, 0]
 
 
 def _xent_bwd_call(logits, labels, lse, dloss, block_b, interpret, vma):
@@ -348,14 +352,14 @@ def _xent_bwd_call(logits, labels, lse, dloss, block_b, interpret, vma):
         grid=grid,
         in_specs=[
             pl.BlockSpec((block_b, v), lambda i: (i, 0)),
-            pl.BlockSpec((block_b,), lambda i: (i,)),
-            pl.BlockSpec((block_b,), lambda i: (i,)),
-            pl.BlockSpec((block_b,), lambda i: (i,)),
+            pl.BlockSpec((block_b, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_b, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_b, 1), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((block_b, v), lambda i: (i, 0)),
         out_shape=_sds((b, v), logits.dtype, vma),
         interpret=interpret,
-    )(logits, labels, lse, dloss)
+    )(logits, labels[:, None], lse[:, None], dloss[:, None])
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))

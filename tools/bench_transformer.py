@@ -110,21 +110,27 @@ def main():
             _, logits = tfm.prefill(p, cache, x, cfg)
             return logits
 
+        # honest sync: remote-attached chips ack block_until_ready without
+        # awaiting execution (see bench.py) — a device_get of a slice of
+        # the LAST output closes the stream-ordered dispatch chain
+        def sync(o):
+            return jax.device_get(jnp.ravel(o)[0])
+
         gen = jax.jit(lambda p, x: tfm.generate(p, x, steps, cfg,
                                                 max_len=max_len))
         pre = jax.jit(prefill_only)
-        gen(params, prompt).block_until_ready()  # compile
-        pre(params, prompt).block_until_ready()
+        sync(gen(params, prompt))  # compile
+        sync(pre(params, prompt))
         reps = 3
         t0 = time.perf_counter()
         for _ in range(reps):
             toks = gen(params, prompt)
-        toks.block_until_ready()
+        sync(toks)
         t_gen = time.perf_counter() - t0
         t0 = time.perf_counter()
         for _ in range(reps):
             lg = pre(params, prompt)
-        lg.block_until_ready()
+        sync(lg)
         t_pre = time.perf_counter() - t0
         out["decode_tokens_per_sec"] = round(
             args.batch * steps * reps / max(t_gen - t_pre, 1e-9), 1)
