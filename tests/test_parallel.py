@@ -410,6 +410,34 @@ def test_decode_step_matches_full_forward():
         seq = np.concatenate([seq, nxt[:, None]], axis=1)
 
 
+def test_decode_bf16_cache_with_f32_params():
+    """A bf16-config cache must accept f32 activations (mixed-precision
+    trainers hold f32 master weights): the cache write casts at the
+    boundary. Regression for the on-chip bf16 decode failure (round 5:
+    dynamic_update_slice dtype mismatch)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(vocab=31, d_model=32, n_heads=4, n_layers=2,
+                                d_ff=64, max_len=24, dtype="bfloat16")
+    params = tfm.init_params(cfg, seed=3)
+    # widen params to f32 (the master-weight layout)
+    params = jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.float32), params)
+    prompt = np.random.RandomState(0).randint(
+        0, cfg.vocab, (2, 5)).astype(np.int32)
+    toks = np.asarray(jax.jit(
+        lambda p, x: tfm.generate(p, x, 4, cfg))(params, prompt))
+    assert toks.shape == (2, 4)
+    assert ((0 <= toks) & (toks < cfg.vocab)).all()
+    # cache really is bf16 (the memory halving is the point)
+    cache = tfm.init_kv_cache(cfg, 2, 16)
+    assert cache["k"].dtype == jnp.bfloat16
+
+
 def test_decode_step_moe():
     # the MoE FFN path decodes too (router on a (B, d) step input)
     import numpy as np
