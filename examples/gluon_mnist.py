@@ -22,6 +22,12 @@ def main():
     p.add_argument("--hybridize", action="store_true")
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
+    # deterministic init + shuffle: the Xavier draw comes from the mx
+    # global RNG and the DataLoader shuffle from np.random, and an
+    # unlucky draw can land epoch-1 accuracy under the smoke test's bar
+    # (observed once in-suite, round 5)
+    mx.random.seed(0)
+    np.random.seed(0)
 
     rng = np.random.RandomState(0)
     proto = rng.rand(10, 1, 28, 28).astype("float32")
