@@ -63,6 +63,10 @@ def main():
         jax.config.update("jax_platforms", "cpu")
 
     logging.basicConfig(level=logging.INFO)
+    # deterministic init/shuffle (the smoke test asserts an accuracy bar;
+    # same-seed discipline as the reference's with_seed tests)
+    mx.random.seed(0)
+    np.random.seed(0)
     train, val = get_mnist_iters(args.batch_size, args.data_dir)
     net = models.get_lenet(10) if args.network == "lenet" else models.get_mlp(10)
     ctx = {"cpu": mx.cpu(), "tpu": mx.tpu(), "gpu": mx.gpu()}[args.ctx]
