@@ -220,6 +220,50 @@ def _iter_chain(net):
     return [net]
 
 
+def _fold_conv_bn(w, b, bn):
+    """Fold one BatchNorm's affine into the preceding conv/dense (w, b)."""
+    gamma = bn.gamma.data().asnumpy()
+    beta = bn.beta.data().asnumpy()
+    mean = bn.running_mean.data().asnumpy()
+    var = bn.running_var.data().asnumpy()
+    if not bn._scale:
+        gamma = np.ones_like(gamma)
+    f = gamma / np.sqrt(var + bn._epsilon)
+    w = w * f.reshape((-1,) + (1,) * (w.ndim - 1))
+    b = (b if b is not None else 0.0) * f + beta - mean * f
+    return w.astype(np.float32), b.astype(np.float32)
+
+
+def _conv_attrs(lyr):
+    return dict(kernel=lyr._kernel, stride=lyr._strides,
+                dilate=lyr._dilation, pad=lyr._padding,
+                num_filter=lyr._channels, num_group=lyr._groups)
+
+
+def _fold_resunit(u):
+    """Fold a v1 ResidualUnit's conv+BN pairs (body) and its projection
+    shortcut. Returns (body, proj): body = [{lyr, w, b, inner}] where
+    `inner` convs are followed by relu + int8 requantization and the last
+    conv's int32 accumulator flows into the skip-add; proj = {lyr, w, b}
+    or None (identity shortcut)."""
+    body = []
+    n = len(u.convs)
+    for i in range(n):
+        conv = u.convs[i]
+        w = conv.weight.data().asnumpy()
+        b = conv.bias.data().asnumpy() if conv.bias is not None else None
+        w, b = _fold_conv_bn(w, b, u.norms[i])
+        body.append(dict(lyr=conv, w=w, b=b, inner=i < n - 1))
+    proj = None
+    if u.proj is not None:
+        w = u.proj.weight.data().asnumpy()
+        b = None
+        if u.proj_norm is not None:
+            w, b = _fold_conv_bn(w, b, u.proj_norm)
+        proj = dict(lyr=u.proj, w=w.astype(np.float32), b=b)
+    return body, proj
+
+
 def _fold_batchnorm(layers):
     """Fold BatchNorm into the preceding conv/dense weights
     (ref: the quantize pass fuses conv+bn before quantizing).
@@ -228,6 +272,18 @@ def _fold_batchnorm(layers):
 
     records = []
     for layer in layers:
+        if (type(layer).__name__ == "ResidualUnit"
+                and getattr(layer, "_version", None) == 1
+                and not any(getattr(c, "_channels_last", False)
+                            for c in layer.convs)):
+            # v1 residual units quantize as a unit: int8 conv body +
+            # int8 shortcut, fp32 dequant-add-requant at the junction
+            # (ref: quantized resnet in src/operator/quantization/ — the
+            # reference's flagship int8 model IS ResNet). v2's
+            # pre-activation ordering breaks the conv+BN fold, so v2
+            # units stay fp32 islands.
+            records.append(("resunit", layer, None, None))
+            continue
         if isinstance(layer, gnn.BatchNorm):
             # fold only into a PLAIN conv/dense: a fused activation between
             # the linear op and the BN makes the fold invalid
@@ -237,16 +293,8 @@ def _fold_batchnorm(layers):
                 records.append(("bn_alone", layer, None, None))
                 continue
             kind, lyr, w, b = records[-1]
-            gamma = layer.gamma.data().asnumpy()
-            beta = layer.beta.data().asnumpy()
-            mean = layer.running_mean.data().asnumpy()
-            var = layer.running_var.data().asnumpy()
-            if not layer._scale:
-                gamma = np.ones_like(gamma)
-            f = gamma / np.sqrt(var + layer._epsilon)
-            w = w * f.reshape((-1,) + (1,) * (w.ndim - 1))
-            b = (b if b is not None else 0.0) * f + beta - mean * f
-            records[-1] = (kind, lyr, w, b.astype(np.float32))
+            w, b = _fold_conv_bn(w, b, layer)
+            records[-1] = (kind, lyr, w, b)
         elif hasattr(layer, "weight") and getattr(layer, "_transpose", False) is False \
                 and type(layer).__name__.startswith("Conv") \
                 and layer._act_type in (None, "relu"):
@@ -342,6 +390,36 @@ class QuantizedNet:
                     out = jnp.maximum(out, 0)
                 q = jnp.clip(jnp.round(out), -127, 127).astype(jnp.int8)
                 s = step["s_out"]
+            elif kind == "resunit":
+                # all convolutions int8 (MXU integer path); the skip-add
+                # happens in fp32 on the dequantized int32 accumulators —
+                # a fused elementwise epilogue, no extra matmul FLOPs
+                q_in = q
+                h = q
+                body32 = None
+                for sub in step["body"]:
+                    acc = qops.quantized_conv(
+                        h, sub["qw"], sub["qb"], no_bias=sub["qb"] is None,
+                        **sub["attrs"])
+                    if sub["inner"]:
+                        out = jnp.maximum(
+                            acc.astype(jnp.float32) * sub["requant_scale"], 0)
+                        h = jnp.clip(jnp.round(out), -127,
+                                     127).astype(jnp.int8)
+                    else:
+                        body32 = acc.astype(jnp.float32) * sub["deq_scale"]
+                if step["proj"] is not None:
+                    accp = qops.quantized_conv(
+                        q_in, step["proj"]["qw"], step["proj"]["qb"],
+                        no_bias=step["proj"]["qb"] is None,
+                        **step["proj"]["attrs"])
+                    skip32 = accp.astype(jnp.float32) * step["proj"]["deq_scale"]
+                else:
+                    skip32 = q_in.astype(jnp.float32) * step["skip_deq"]
+                out32 = jnp.maximum(body32 + skip32, 0)
+                q = jnp.clip(jnp.round(out32 * step["s_out"]), -127,
+                             127).astype(jnp.int8)
+                s = step["s_out"]
             elif kind == "maxpool":
                 q = qops.quantized_pooling(q, pool_type="max", **step["attrs"])
             elif kind == "avgpool":
@@ -381,14 +459,23 @@ def quantize_net(net, calib_data, num_calib_batches=10, calib_mode="minmax",
     """fp32 Gluon chain -> QuantizedNet with calibrated activation scales
     (ref: python quantize_model flow: collect stats -> set ranges -> emit
     quantized graph). Supports Conv2D/Dense (+folded BatchNorm, fused relu),
-    Max/Avg pooling, Flatten, Activation('relu'), Dropout; anything else
-    runs as an fp32 island between dequantize/quantize pairs."""
+    Max/Avg/Global pooling, Flatten, Activation('relu'), Dropout, and v1
+    residual units (int8 body + int8 projection shortcut, fp32
+    dequant-add-requant at the skip junction — the reference's flagship
+    int8 model is ResNet, src/operator/quantization/); anything else runs
+    as an fp32 island between dequantize/quantize pairs."""
     from ..gluon import nn as gnn
 
     if quantized_dtype != "int8":
         raise ValueError("only int8 is supported")
     layers = _iter_chain(net)
     records = _fold_batchnorm(layers)
+    # folded v1 residual units + per-internal-conv calibration ranges
+    folded_units = {i: _fold_resunit(lyr)
+                    for i, (kind, lyr, _w, _b) in enumerate(records)
+                    if kind == "resunit"}
+    res_amax = {i: [1e-8] * (len(body) - 1)
+                for i, (body, _proj) in folded_units.items()}
 
     def _pool_quantizable(lyr):
         """int8 pooling supports only valid-convention, full-window-divisor
@@ -425,7 +512,31 @@ def quantize_net(net, calib_data, num_calib_batches=10, calib_mode="minmax",
                     flatten=lyr._flatten)
                 if lyr._act_type == "relu":
                     x = jnp.maximum(x, 0)
-            elif isinstance(lyr, (gnn.MaxPool2D, gnn.AvgPool2D)):
+            elif kind == "resunit":
+                from ..ops import nn as nnops
+
+                body, proj = folded_units[i]
+                h = x
+                for j, rec in enumerate(body):
+                    h = nnops.convolution(
+                        h, jnp.asarray(rec["w"]),
+                        None if rec["b"] is None else jnp.asarray(rec["b"]),
+                        no_bias=rec["b"] is None, **_conv_attrs(rec["lyr"]))
+                    if rec["inner"]:
+                        h = jnp.maximum(h, 0)
+                        res_amax[i][j] = max(res_amax[i][j],
+                                             float(jnp.max(jnp.abs(h))))
+                if proj is None:
+                    skip = x
+                else:
+                    skip = nnops.convolution(
+                        x, jnp.asarray(proj["w"]),
+                        None if proj["b"] is None else jnp.asarray(proj["b"]),
+                        no_bias=proj["b"] is None,
+                        **_conv_attrs(proj["lyr"]))
+                x = jnp.maximum(skip + h, 0)
+            elif isinstance(lyr, (gnn.MaxPool2D, gnn.AvgPool2D,
+                                  gnn.GlobalMaxPool2D, gnn.GlobalAvgPool2D)):
                 from ..ops import nn as nnops
 
                 x = nnops.pooling(x, **lyr._kwargs)
@@ -519,6 +630,46 @@ def quantize_net(net, calib_data, num_calib_batches=10, calib_mode="minmax",
                 deq_scale=1.0 / (s_prev * s_w),
                 s_out=s_out))
             s_prev = s_out
+        elif kind == "resunit":
+            # int8 residual unit: int8 conv body + int8 shortcut conv,
+            # dequantized fp32 add at the junction (all FLOPs stay int8;
+            # the add is a fused elementwise epilogue), relu, requantize
+            # to the calibrated unit-output scale
+            body, proj = folded_units[i]
+            s_cur = s_prev
+            subs = []
+            for j, rec in enumerate(body):
+                w = rec["w"]
+                s_w = 127.0 / max(float(np.abs(w).max()), 1e-8)
+                qw = jnp.asarray(np.clip(np.round(w * s_w), -127, 127)
+                                 .astype(np.int8))
+                qb = (None if rec["b"] is None else
+                      jnp.asarray(np.round(rec["b"] * s_cur * s_w)
+                                  .astype(np.int32)))
+                sub = dict(qw=qw, qb=qb, attrs=_conv_attrs(rec["lyr"]),
+                           inner=rec["inner"])
+                if rec["inner"]:
+                    s_j = 127.0 / res_amax[i][j]
+                    sub["requant_scale"] = s_j / (s_cur * s_w)
+                    s_cur = s_j
+                else:
+                    sub["deq_scale"] = 1.0 / (s_cur * s_w)
+                subs.append(sub)
+            pstep = None
+            if proj is not None:
+                w = proj["w"]
+                s_w = 127.0 / max(float(np.abs(w).max()), 1e-8)
+                pstep = dict(
+                    qw=jnp.asarray(np.clip(np.round(w * s_w), -127, 127)
+                                   .astype(np.int8)),
+                    qb=(None if proj["b"] is None else
+                        jnp.asarray(np.round(proj["b"] * s_prev * s_w)
+                                    .astype(np.int32))),
+                    attrs=_conv_attrs(proj["lyr"]),
+                    deq_scale=1.0 / (s_prev * s_w))
+            steps.append(dict(kind="resunit", body=subs, proj=pstep,
+                              skip_deq=1.0 / s_prev, s_out=s_out))
+            s_prev = s_out
         elif (isinstance(lyr, (gnn.MaxPool2D, gnn.AvgPool2D))
               and _pool_quantizable(lyr)):
             steps.append(dict(
@@ -527,6 +678,13 @@ def quantize_net(net, calib_data, num_calib_batches=10, calib_mode="minmax",
                            stride=lyr._kwargs["stride"],
                            pad=lyr._kwargs["pad"])))
             # pooling keeps the input scale (max exactly; avg to rounding)
+        elif isinstance(lyr, (gnn.GlobalMaxPool2D, gnn.GlobalAvgPool2D)):
+            steps.append(dict(
+                kind="maxpool" if lyr._kwargs["pool_type"] == "max"
+                else "avgpool",
+                attrs=dict(kernel=lyr._kwargs["kernel"],
+                           stride=lyr._kwargs["stride"],
+                           pad=lyr._kwargs["pad"], global_pool=True)))
         elif isinstance(lyr, gnn.Activation) and lyr._act_type == "relu":
             steps.append(dict(kind="relu"))
         elif isinstance(lyr, gnn.Flatten):

@@ -315,3 +315,91 @@ def test_as_chain_rejects_composite_forward():
         q.as_chain(net, probe=probe)
     with pytest.raises(ValueError, match="features/output"):
         q.as_chain(nn.Dense(3))
+
+
+def test_quantize_net_residual_unit_int8():
+    """v1 residual units quantize as units — int8 conv body + int8
+    projection shortcut, fp32 dequant-add-requant at the skip junction —
+    with NO fp32 islands (the reference's flagship int8 model is ResNet:
+    src/operator/quantization/). v2's pre-activation ordering breaks the
+    conv+BN fold and must stay an fp32 island."""
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    prev = autograd.set_training(False)
+    try:
+        net = vision.get_model("resnet18_v1", classes=10)
+        net.initialize(mx.init.Xavier())
+        probe = nd.array(rng.rand(2, 3, 32, 32).astype(np.float32))
+        net(probe)
+        chain = q.as_chain(net, probe=probe)
+        calib = [[nd.array(rng.rand(4, 3, 32, 32).astype(np.float32))]
+                 for _ in range(3)]
+        qnet = q.quantize_net(chain, calib, num_calib_batches=3)
+        assert qnet.num_fp32_islands == 0
+        resunits = [s for s in qnet._steps if s["kind"] == "resunit"]
+        assert len(resunits) == 8  # (2, 2, 2, 2) stages
+        # stage-opening units (except stage 1) carry a projection shortcut
+        assert sum(1 for s in resunits if s["proj"] is not None) == 3
+        xs = nd.array(rng.rand(16, 3, 32, 32).astype(np.float32))
+        ref = net(xs).asnumpy()
+        got = qnet(xs).asnumpy()
+        rel = float(np.abs(got - ref).mean() / (np.abs(ref).mean() + 1e-9))
+        assert rel < 0.08, rel  # int8 noise, not structural error
+        agree = float((ref.argmax(1) == got.argmax(1)).mean())
+        assert agree >= 0.7, agree  # untrained logits: weak margins
+    finally:
+        autograd.set_training(prev)
+
+
+def test_quantize_net_bottleneck_resunit_int8():
+    """Bottleneck (1x1-3x3-1x1, biased 1x1s) units quantize fully too."""
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(1)
+    prev = autograd.set_training(False)
+    try:
+        net = vision.get_model("resnet50_v1", classes=10)
+        net.initialize(mx.init.Xavier())
+        probe = nd.array(rng.rand(2, 3, 32, 32).astype(np.float32))
+        net(probe)
+        chain = q.as_chain(net, probe=probe)
+        calib = [[nd.array(rng.rand(4, 3, 32, 32).astype(np.float32))]
+                 for _ in range(2)]
+        qnet = q.quantize_net(chain, calib, num_calib_batches=2)
+        assert qnet.num_fp32_islands == 0
+        assert sum(1 for s in qnet._steps if s["kind"] == "resunit") == 16
+        xs = nd.array(rng.rand(8, 3, 32, 32).astype(np.float32))
+        ref = net(xs).asnumpy()
+        got = qnet(xs).asnumpy()
+        rel = float(np.abs(got - ref).mean() / (np.abs(ref).mean() + 1e-9))
+        assert rel < 0.1, rel
+    finally:
+        autograd.set_training(prev)
+
+
+def test_quantize_net_v2_resunit_stays_fp32_island():
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(2)
+    prev = autograd.set_training(False)
+    try:
+        net = vision.get_model("resnet18_v2", classes=10)
+        net.initialize(mx.init.Xavier())
+        probe = nd.array(rng.rand(2, 3, 32, 32).astype(np.float32))
+        net(probe)
+        chain = q.as_chain(net, probe=probe)
+        calib = [[nd.array(rng.rand(4, 3, 32, 32).astype(np.float32))]]
+        qnet = q.quantize_net(chain, calib, num_calib_batches=1)
+        assert qnet.num_fp32_islands > 0  # v2 units: documented fallback
+        assert not any(s["kind"] == "resunit" for s in qnet._steps)
+        xs = nd.array(rng.rand(4, 3, 32, 32).astype(np.float32))
+        assert np.isfinite(qnet(xs).asnumpy()).all()
+    finally:
+        autograd.set_training(prev)

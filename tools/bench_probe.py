@@ -109,7 +109,11 @@ def run_inference_bench():
     sweeps = [
         # headline: ResNet-50 bf16/fp32 (ref fp16 2355 / fp32 1233 img/s)
         ["--models", "resnet50_v1", "--iters", "30", "--scan", "8"],
-        # int8 chain (MXU integer path, 2x bf16 rate; ref AlexNet 10990)
+        # int8 (MXU integer path, 2x bf16 rate): the reference's flagship
+        # int8 model is ResNet-50 (residual units quantize as units,
+        # round 5); AlexNet keys the V100 10990 img/s row
+        ["--models", "resnet50_v1", "--iters", "30",
+         "--scan", "8", "--dtypes", "int8"],
         ["--models", "alexnet", "--batch", "256", "--iters", "30",
          "--scan", "8", "--dtypes", "int8"],
     ]

@@ -147,7 +147,9 @@ def bench_model(name, batch, image, dtype, iters, scan_k, target):
     # build + init on host CPU (hundreds of tiny per-param programs would
     # otherwise each cross the tunnel); ResNet supports TPU-native NHWC
     kwargs = {"classes": 1000}
-    if name.startswith("resnet"):
+    if name.startswith("resnet") and dtype != "int8":
+        # int8 stays NCHW: the quantized-conv path (and the residual-unit
+        # quantizer) is NCHW; fp32/bf16 resnets use the TPU-native NHWC
         kwargs["layout"] = "NHWC"
         data_shape = (batch, image, image, 3)
     else:
