@@ -66,7 +66,8 @@ def child_main():
     ondev = (ondev_env == "1"
              or (ondev_env == "auto" and target.platform != "cpu"))
     step = fused.GluonTrainStep(net, lambda n, x, y: L(n(x), y), opt,
-                                device=target, init_on_device=ondev)
+                                device=target, init_on_device=ondev,
+                                remat=os.environ.get("BENCH_REMAT") == "1")
 
     rng = np.random.RandomState(0)
     import jax.numpy as jnp

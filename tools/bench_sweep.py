@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Config sweep over the headline train step for the next chip window.
+
+The round-5 profiler finding (docs/PERF_ANALYSIS.md §0): the bf16 step is
+HBM-bandwidth-bound and batch 256 REGRESSES (remat/spill). This sweep
+turns a future measurement window into optimization data instead of a
+re-measurement: each config runs bench.py's own child (BENCH_CHILD=1,
+honest device-get sync inside) and logs one JSON line per config.
+
+Usage: python tools/bench_sweep.py [--configs a,b,...]
+Configs (comma list; default all):
+  bs64       bf16 NHWC batch 64   (below the spill threshold?)
+  bs96       bf16 NHWC batch 96
+  base       bf16 NHWC batch 128  (the banked headline, for control)
+  remat      bf16 NHWC batch 128 + jax.checkpoint over the forward
+  nchw       bf16 NCHW batch 128  (layout control)
+Log: tools/bench_sweep.log (+ stdout).
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "tools", "bench_sweep.log")
+
+CONFIGS = {
+    "bs64": {"BENCH_DTYPE": "bfloat16", "BENCH_BATCH": "64"},
+    "bs96": {"BENCH_DTYPE": "bfloat16", "BENCH_BATCH": "96"},
+    "base": {"BENCH_DTYPE": "bfloat16", "BENCH_BATCH": "128"},
+    "remat": {"BENCH_DTYPE": "bfloat16", "BENCH_BATCH": "128",
+              "BENCH_REMAT": "1"},
+    "nchw": {"BENCH_DTYPE": "bfloat16", "BENCH_BATCH": "128",
+             "BENCH_LAYOUT": "NCHW"},
+}
+
+
+def log(msg):
+    line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+    print(line, flush=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", default=",".join(CONFIGS))
+    ap.add_argument("--timeout", type=int, default=2400)
+    args = ap.parse_args()
+    for name in args.configs.split(","):
+        cfg = CONFIGS[name.strip()]
+        env = dict(os.environ)
+        env.update(cfg)
+        env["BENCH_CHILD"] = "1"
+        env.setdefault("BENCH_ITERS", "20")
+        env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jaxcache")
+        t0 = time.perf_counter()
+        try:
+            p = subprocess.run([sys.executable,
+                                os.path.join(REPO, "bench.py")],
+                               capture_output=True, text=True,
+                               timeout=args.timeout, env=env)
+        except subprocess.TimeoutExpired:
+            log(f"{name}: TIMEOUT after {args.timeout}s")
+            continue
+        line = None
+        for ln in reversed((p.stdout or "").strip().splitlines()):
+            try:
+                d = json.loads(ln)
+            except ValueError:
+                continue
+            if isinstance(d, dict) and "ips" in d:
+                line = d
+                break
+        if line is None:
+            log(f"{name}: rc={p.returncode} no JSON "
+                f"(stderr: {(p.stderr or '').strip()[-300:]})")
+            continue
+        line["config"] = name
+        line["wall_s"] = round(time.perf_counter() - t0, 1)
+        log(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()
