@@ -93,6 +93,32 @@ def main():
     print(f"fp32 acc={acc_f:.4f} ({t_f*1e3:.1f} ms)  "
           f"int8 acc={acc_q:.4f} ({t_q*1e3:.1f} ms)")
     assert acc_f - acc_q <= 0.01, "int8 accuracy must be within 1% of fp32"
+
+    # residual networks quantize too (v1 units: int8 body + shortcut,
+    # fp32 add at the junction — the reference's flagship int8 model)
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    rnet = vision.get_model("resnet18_v1", classes=10)
+    rnet.initialize(mx.init.Xavier())
+    prev = autograd.set_training(False)
+    try:
+        probe = nd.array(rng.rand(2, 3, 32, 32).astype(np.float32))
+        rnet(probe)
+        rcal = Batches([rng.rand(4, 3, 32, 32).astype(np.float32)
+                        for _ in range(2)])
+        rq = q.quantize_net(q.as_chain(rnet, probe=probe), rcal,
+                            num_calib_batches=2)
+        assert rq.num_fp32_islands == 0, "residual units must quantize"
+        xs = nd.array(rng.rand(8, 3, 32, 32).astype(np.float32))
+        ref = rnet(xs).asnumpy()
+        got = rq(xs).asnumpy()
+        rel = np.abs(got - ref).mean() / (np.abs(ref).mean() + 1e-9)
+        print(f"resnet18_v1 int8: fp32 islands=0, "
+              f"mean rel logit err={rel:.4f}")
+        assert rel < 0.1
+    finally:
+        autograd.set_training(prev)
     print("quantized inference OK")
 
 
