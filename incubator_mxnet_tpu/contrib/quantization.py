@@ -602,6 +602,19 @@ def quantize_net(net, calib_data, num_calib_batches=10, calib_mode="minmax",
                          "(use 'minmax' or 'entropy')")
 
     # ---- pass 2: emit the int8 program ----
+    def _qweight(w, acc_bcast_shape):
+        """Per-output-channel symmetric int8 weight quantization (the
+        reference's channel-wise MKLDNN option — tighter than per-tensor;
+        output channel = axis 0 for conv (O,I,kh,kw) and dense (U,in)).
+        Returns (qw int8, s_w (C,), s_w broadcast over the int32
+        accumulator)."""
+        amax_w = np.abs(w).reshape(w.shape[0], -1).max(axis=1)
+        s_w = 127.0 / np.maximum(amax_w, 1e-8)
+        qw = jnp.asarray(
+            np.clip(np.round(w * s_w.reshape((-1,) + (1,) * (w.ndim - 1))),
+                    -127, 127).astype(np.int8))
+        return qw, s_w, s_w.reshape(acc_bcast_shape).astype(np.float32)
+
     s_in0 = 127.0 / amax_in
     steps = []
     s_prev = s_in0
@@ -612,9 +625,8 @@ def quantize_net(net, calib_data, num_calib_batches=10, calib_mode="minmax",
     for i, (kind, lyr, w, b) in enumerate(records):
         s_out = 127.0 / amax_out[i]
         if kind in ("conv", "dense"):
-            s_w = 127.0 / max(float(np.abs(w).max()), 1e-8)
-            qw = jnp.asarray(np.clip(np.round(w * s_w), -127, 127)
-                             .astype(np.int8))
+            bshape = (1, -1, 1, 1) if kind == "conv" else (1, -1)
+            qw, s_w, s_w_b = _qweight(w, bshape)
             qb = (None if b is None else
                   jnp.asarray(np.round(b * s_prev * s_w).astype(np.int32)))
             attrs = (dict(kernel=lyr._kernel, stride=lyr._strides,
@@ -626,8 +638,8 @@ def quantize_net(net, calib_data, num_calib_batches=10, calib_mode="minmax",
                 kind=kind, qw=qw, qb=qb, attrs=attrs,
                 relu=lyr._act_type == "relu",
                 last=i == last_q,
-                requant_scale=s_out / (s_prev * s_w),
-                deq_scale=1.0 / (s_prev * s_w),
+                requant_scale=jnp.asarray(s_out / (s_prev * s_w_b)),
+                deq_scale=jnp.asarray(1.0 / (s_prev * s_w_b)),
                 s_out=s_out))
             s_prev = s_out
         elif kind == "resunit":
@@ -639,10 +651,7 @@ def quantize_net(net, calib_data, num_calib_batches=10, calib_mode="minmax",
             s_cur = s_prev
             subs = []
             for j, rec in enumerate(body):
-                w = rec["w"]
-                s_w = 127.0 / max(float(np.abs(w).max()), 1e-8)
-                qw = jnp.asarray(np.clip(np.round(w * s_w), -127, 127)
-                                 .astype(np.int8))
+                qw, s_w, s_w_b = _qweight(rec["w"], (1, -1, 1, 1))
                 qb = (None if rec["b"] is None else
                       jnp.asarray(np.round(rec["b"] * s_cur * s_w)
                                   .astype(np.int32)))
@@ -650,23 +659,21 @@ def quantize_net(net, calib_data, num_calib_batches=10, calib_mode="minmax",
                            inner=rec["inner"])
                 if rec["inner"]:
                     s_j = 127.0 / res_amax[i][j]
-                    sub["requant_scale"] = s_j / (s_cur * s_w)
+                    sub["requant_scale"] = jnp.asarray(s_j / (s_cur * s_w_b))
                     s_cur = s_j
                 else:
-                    sub["deq_scale"] = 1.0 / (s_cur * s_w)
+                    sub["deq_scale"] = jnp.asarray(1.0 / (s_cur * s_w_b))
                 subs.append(sub)
             pstep = None
             if proj is not None:
-                w = proj["w"]
-                s_w = 127.0 / max(float(np.abs(w).max()), 1e-8)
+                qw, s_w, s_w_b = _qweight(proj["w"], (1, -1, 1, 1))
                 pstep = dict(
-                    qw=jnp.asarray(np.clip(np.round(w * s_w), -127, 127)
-                                   .astype(np.int8)),
+                    qw=qw,
                     qb=(None if proj["b"] is None else
                         jnp.asarray(np.round(proj["b"] * s_prev * s_w)
                                     .astype(np.int32))),
                     attrs=_conv_attrs(proj["lyr"]),
-                    deq_scale=1.0 / (s_prev * s_w))
+                    deq_scale=jnp.asarray(1.0 / (s_prev * s_w_b)))
             steps.append(dict(kind="resunit", body=subs, proj=pstep,
                               skip_deq=1.0 / s_prev, s_out=s_out))
             s_prev = s_out
