@@ -229,6 +229,34 @@ def test_last_json_line():
 
 
 @pytest.mark.skipif(not os.environ.get("MXTPU_NIGHTLY"),
+                    reason="extra ResNet-50 compile; nightly tier")
+def test_bench_child_remat_executes(tmp_path):
+    """The BENCH_REMAT knob (tools/bench_sweep.py's remat config) must
+    execute end-to-end — an armed sweep config may only meet hardware
+    after it has run on CPU (same discipline as the bf16-scan test)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.update({
+        "BENCH_CHILD": "1", "BENCH_DTYPE": "bfloat16", "BENCH_REMAT": "1",
+        "BENCH_BATCH": "4", "BENCH_IMAGE": "32",
+        "BENCH_ITERS": "2", "BENCH_WARMUP": "1", "BENCH_SCAN": "2",
+        "BENCH_ONDEVICE": "1", "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",  # axon ignores JAX_PLATFORMS
+        "JAX_COMPILATION_CACHE_DIR": str(tmp_path / "jc"),
+    })
+    p = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                       capture_output=True, text=True, timeout=900, env=env)
+    assert p.returncode == 0, p.stderr[-2000:]
+    final = [json.loads(ln) for ln in p.stdout.strip().splitlines()
+             if ln.startswith("{")][-1]
+    assert final.get("final") and final["ips"] > 0
+    import math
+
+    assert math.isfinite(final["loss"])
+
+
+@pytest.mark.skipif(not os.environ.get("MXTPU_NIGHTLY"),
                     reason="ResNet-50 compile x2 (~3-5 min); nightly tier")
 def test_bench_child_bf16_scan_executes(tmp_path):
     """The ARMED measurement configuration — bf16-cast net, on-device
