@@ -28,10 +28,10 @@ LOG = os.path.join(REPO, "tools", "tpu_consistency.log")
 
 
 def log(rec):
-    line = json.dumps(rec)
+    line = json.dumps(dict(rec, ts=time.strftime("%H:%M:%S")))
     print(line, flush=True)
-    with open(LOG, "a") as f:
-        f.write(f"[{time.strftime('%H:%M:%S')}] {line}\n")
+    with open(LOG, "a") as f:  # JSON-lines parseable (ts inside the record)
+        f.write(line + "\n")
 
 
 def main():
@@ -80,8 +80,12 @@ def main():
         ("avgpool_pad", S.Pooling(data=data, kernel=(3, 3), stride=(2, 2),
                                   pad=(1, 1), pool_type="avg"),
          {"data": (2, 3, 12, 12)}),
-        ("softmax_xent_shape", S.softmax(data=data, axis=-1),
-         {"data": (8, 100)}),
+        # a weighted softmax head: a plain sum-of-softmax head has an
+        # identically-zero input gradient (sum_i dy_i/dx_j = 0), which
+        # would make the backward check vacuous
+        ("softmax_weighted", S.sum(S.softmax(data=data, axis=-1)
+                                   * S.square(w)),
+         {"data": (8, 100), "w": (8, 100)}),
         ("reductions", S.sum(S.broadcast_mul(data, w), axis=(1,)),
          {"data": (6, 7), "w": (1, 7)}),
         ("tanh_sigmoid", S.tanh(data) + S.Activation(data,
@@ -119,7 +123,10 @@ def main():
                     nn.Flatten(), nn.Dense(10))
             net.initialize(mx.init.Xavier())
             L = gluon.loss.SoftmaxCrossEntropyLoss()
-            opt = mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1 / 16)
+            # NOTE: GluonTrainStep takes the batch MEAN of the loss, so
+            # rescale_grad must stay 1 (1/batch here would freeze the
+            # trajectory 16x and blunt the divergence oracle)
+            opt = mx.optimizer.SGD(learning_rate=0.1)
             step = fused.GluonTrainStep(net, lambda n, x, y: L(n(x), y), opt,
                                         device=ctx.jax_device())
             x = nd.array(rng.rand(16, 1, 12, 12).astype(np.float32))
