@@ -264,6 +264,11 @@ def _fold_resunit(u):
     return body, proj
 
 
+def _fire_convs(u):
+    """The three convs of a squeezenet Fire module."""
+    return (u.squeeze, u.left, u.right)
+
+
 def _fold_batchnorm(layers):
     """Fold BatchNorm into the preceding conv/dense weights
     (ref: the quantize pass fuses conv+bn before quantizing).
@@ -272,6 +277,15 @@ def _fold_batchnorm(layers):
 
     records = []
     for layer in layers:
+        if (type(layer).__name__ == "Fire"
+                and not any(getattr(c, "_channels_last", False)
+                            for c in _fire_convs(layer))):
+            # squeezenet branch-concat unit: squeeze conv -> two parallel
+            # expand convs -> channel concat, all relu, no BN — both
+            # branches requantize to ONE calibrated output scale so the
+            # concat itself is a pure int8 op
+            records.append(("fire", layer, None, None))
+            continue
         if (type(layer).__name__ == "ResidualUnit"
                 and getattr(layer, "_version", None) == 1
                 and not any(getattr(c, "_channels_last", False)
@@ -420,6 +434,23 @@ class QuantizedNet:
                 q = jnp.clip(jnp.round(out32 * step["s_out"]), -127,
                              127).astype(jnp.int8)
                 s = step["s_out"]
+            elif kind == "fire":
+                def _branch(qx, sub, relu=True):
+                    acc = qops.quantized_conv(
+                        qx, sub["qw"], sub["qb"], no_bias=False,
+                        **sub["attrs"])
+                    out = acc.astype(jnp.float32) * sub["requant_scale"]
+                    if relu:
+                        out = jnp.maximum(out, 0)
+                    return jnp.clip(jnp.round(out), -127,
+                                    127).astype(jnp.int8)
+
+                qs = _branch(q, step["squeeze"])
+                # both branches share s_out, so the concat stays int8
+                q = jnp.concatenate(
+                    [_branch(qs, step["left"]), _branch(qs, step["right"])],
+                    axis=1)
+                s = step["s_out"]
             elif kind == "maxpool":
                 q = qops.quantized_pooling(q, pool_type="max", **step["attrs"])
             elif kind == "avgpool":
@@ -476,12 +507,25 @@ def quantize_net(net, calib_data, num_calib_batches=10, calib_mode="minmax",
                     if kind == "resunit"}
     res_amax = {i: [1e-8] * (len(body) - 1)
                 for i, (body, _proj) in folded_units.items()}
+    # fire units: one internal range (the squeeze activation)
+    fire_amax = {i: 1e-8 for i, (kind, _l, _w, _b) in enumerate(records)
+                 if kind == "fire"}
 
     def _pool_quantizable(lyr):
-        """int8 pooling supports only valid-convention, full-window-divisor
-        pools; anything else runs as an fp32 island."""
+        """int8 pooling: valid-convention pools, plus ceil-mode ('full')
+        MAX pools (the int8-min pad identity keeps the max exact, except
+        when a ceil window falls entirely in padding — then fp32 island).
+        Non-count-include-pad avg with padding stays fp32."""
         kw = lyr._kwargs
-        if kw.get("pooling_convention", "valid") != "valid":
+        conv = kw.get("pooling_convention", "valid")
+        if conv == "full":
+            if kw["pool_type"] != "max":
+                return False
+            # reject when any ceil window would be empty (all padding)
+            # — mirrors ops.nn.pooling's has_empty_window rule; shapes
+            # are unknown here, so use the calibration-time shapes
+            return not getattr(lyr, "_q_has_empty_window", False)
+        if conv != "valid":
             return False
         if (kw["pool_type"] == "avg" and not kw.get("count_include_pad", True)
                 and any(_p for _p in np.atleast_1d(kw.get("pad", 0)))):
@@ -535,10 +579,45 @@ def quantize_net(net, calib_data, num_calib_batches=10, calib_mode="minmax",
                         no_bias=proj["b"] is None,
                         **_conv_attrs(proj["lyr"]))
                 x = jnp.maximum(skip + h, 0)
+            elif kind == "fire":
+                from ..ops import nn as nnops
+
+                sq, left, right = _fire_convs(lyr)
+                s = jnp.maximum(nnops.convolution(
+                    x, jnp.asarray(sq.weight.data()._data),
+                    jnp.asarray(sq.bias.data()._data),
+                    no_bias=False, **_conv_attrs(sq)), 0)
+                fire_amax[i] = max(fire_amax[i], float(jnp.max(s)))
+                outs = []
+                for c in (left, right):
+                    outs.append(jnp.maximum(nnops.convolution(
+                        s, jnp.asarray(c.weight.data()._data),
+                        jnp.asarray(c.bias.data()._data),
+                        no_bias=False, **_conv_attrs(c)), 0))
+                x = jnp.concatenate(outs, axis=1)
             elif isinstance(lyr, (gnn.MaxPool2D, gnn.AvgPool2D,
                                   gnn.GlobalMaxPool2D, gnn.GlobalAvgPool2D)):
                 from ..ops import nn as nnops
 
+                kw = lyr._kwargs
+                if kw.get("pooling_convention") == "full":
+                    # record whether any ceil window is all-padding at
+                    # THESE shapes (gates int8 eligibility below)
+                    kk = np.atleast_1d(kw["kernel"])
+                    ss = np.atleast_1d(kw["stride"])
+                    pp = np.atleast_1d(kw.get("pad", 0))
+                    empty = False
+                    for ax in range(len(kk)):
+                        dim = x.shape[2 + ax]
+                        in_sz = dim + 2 * int(pp[ax % len(pp)])
+                        kx = int(kk[ax % len(kk)])
+                        sx = int(ss[ax % len(ss)])
+                        rem = (in_sz - kx) % sx
+                        extra = (sx - rem) % sx if rem != 0 else 0
+                        n_out = 1 + (in_sz - kx + extra) // sx
+                        if (n_out - 1) * sx >= int(pp[ax % len(pp)]) + dim:
+                            empty = True
+                    lyr._q_has_empty_window = empty
                 x = nnops.pooling(x, **lyr._kwargs)
             elif isinstance(lyr, gnn.Flatten):
                 x = x.reshape(x.shape[0], -1)
@@ -677,13 +756,46 @@ def quantize_net(net, calib_data, num_calib_batches=10, calib_mode="minmax",
             steps.append(dict(kind="resunit", body=subs, proj=pstep,
                               skip_deq=1.0 / s_prev, s_out=s_out))
             s_prev = s_out
+        elif kind == "fire":
+            # int8 branch-concat unit: both expand branches requantize to
+            # the SAME calibrated output scale, so the channel concat is
+            # a pure int8 op (no per-branch dequant)
+            sq, left, right = _fire_convs(lyr)
+
+            def _fire_conv(c, s_in_c):
+                wv = c.weight.data().asnumpy()
+                bv = c.bias.data().asnumpy()
+                qw, s_wv, s_w_bv = _qweight(wv, (1, -1, 1, 1))
+                qb = jnp.asarray(np.round(bv * s_in_c * s_wv)
+                                 .astype(np.int32))
+                return qw, qb, s_w_bv
+
+            s_sq = 127.0 / fire_amax[i]
+            qw_s, qb_s, s_wb_s = _fire_conv(sq, s_prev)
+            qw_l, qb_l, s_wb_l = _fire_conv(left, s_sq)
+            qw_r, qb_r, s_wb_r = _fire_conv(right, s_sq)
+            steps.append(dict(
+                kind="fire",
+                squeeze=dict(qw=qw_s, qb=qb_s, attrs=_conv_attrs(sq),
+                             requant_scale=jnp.asarray(
+                                 s_sq / (s_prev * s_wb_s))),
+                left=dict(qw=qw_l, qb=qb_l, attrs=_conv_attrs(left),
+                          requant_scale=jnp.asarray(
+                              s_out / (s_sq * s_wb_l))),
+                right=dict(qw=qw_r, qb=qb_r, attrs=_conv_attrs(right),
+                           requant_scale=jnp.asarray(
+                               s_out / (s_sq * s_wb_r))),
+                s_out=s_out))
+            s_prev = s_out
         elif (isinstance(lyr, (gnn.MaxPool2D, gnn.AvgPool2D))
               and _pool_quantizable(lyr)):
             steps.append(dict(
                 kind="maxpool" if lyr._kwargs["pool_type"] == "max" else "avgpool",
                 attrs=dict(kernel=lyr._kwargs["kernel"],
                            stride=lyr._kwargs["stride"],
-                           pad=lyr._kwargs["pad"])))
+                           pad=lyr._kwargs["pad"],
+                           pooling_convention=lyr._kwargs.get(
+                               "pooling_convention", "valid"))))
             # pooling keeps the input scale (max exactly; avg to rounding)
         elif isinstance(lyr, (gnn.GlobalMaxPool2D, gnn.GlobalAvgPool2D)):
             steps.append(dict(

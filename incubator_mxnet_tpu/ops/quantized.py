@@ -61,9 +61,12 @@ def quantized_fully_connected(data, weight, bias=None, *, num_hidden=None,
 
 @register("_contrib_quantized_pooling", no_grad_inputs=("data",))
 def quantized_pooling(data, *, kernel=None, stride=None, pad=None,
-                      pool_type="max", global_pool=False):
+                      pool_type="max", global_pool=False,
+                      pooling_convention="valid"):
     """Pooling on int8 activations (ref: quantized_pooling.cc). Max pools
-    stay int8; avg pools accumulate in int32 and round back."""
+    stay int8 (including ceil-mode/'full' convention: the identity pad is
+    int8-min, so the max is exact); avg pools accumulate in int32 and
+    round back."""
     nd = data.ndim - 2
     if global_pool:
         k = data.shape[2:]
@@ -75,7 +78,16 @@ def quantized_pooling(data, *, kernel=None, stride=None, pad=None,
         p = _tup(pad, nd) if pad is not None else (0,) * nd
     dims = (1, 1) + tuple(k)
     strd = (1, 1) + tuple(strides)
-    padding = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+    pads = [(pi, pi) for pi in p]
+    if pooling_convention == "full" and not global_pool:
+        # ceil-mode (same high-side padding rule as ops.nn.pooling)
+        for i in range(nd):
+            dim = data.shape[2 + i]
+            in_sz = dim + 2 * p[i]
+            rem = (in_sz - k[i]) % strides[i]
+            extra = (strides[i] - rem) % strides[i] if rem != 0 else 0
+            pads[i] = (p[i], p[i] + extra)
+    padding = ((0, 0), (0, 0)) + tuple(pads)
     if pool_type == "max":
         return lax.reduce_window(data,
                                  jnp.asarray(jnp.iinfo(jnp.int8).min,

@@ -403,3 +403,49 @@ def test_quantize_net_v2_resunit_stays_fp32_island():
         assert np.isfinite(qnet(xs).asnumpy()).all()
     finally:
         autograd.set_training(prev)
+
+
+def test_quantize_net_fire_units_int8():
+    """SqueezeNet Fire modules quantize as branch-concat units: int8
+    squeeze + two expand branches requantized to ONE output scale so the
+    channel concat stays int8; ceil-mode max pools ride the int8 path too
+    (int8-min pad identity keeps the max exact). Whole net: 0 islands."""
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(3)
+    prev = autograd.set_training(False)
+    try:
+        net = vision.get_model("squeezenet1.0", classes=10)
+        net.initialize(mx.init.Xavier())
+        probe = nd.array(rng.rand(2, 3, 64, 64).astype(np.float32))
+        net(probe)
+        chain = q.as_chain(net, probe=probe)
+        calib = [[nd.array(rng.rand(4, 3, 64, 64).astype(np.float32))]
+                 for _ in range(3)]
+        qnet = q.quantize_net(chain, calib, num_calib_batches=3)
+        assert qnet.num_fp32_islands == 0
+        assert sum(1 for s in qnet._steps if s["kind"] == "fire") == 8
+        xs = nd.array(rng.rand(8, 3, 64, 64).astype(np.float32))
+        ref = net(xs).asnumpy()
+        got = qnet(xs).asnumpy()
+        rel = float(np.abs(got - ref).mean() / (np.abs(ref).mean() + 1e-9))
+        assert rel < 0.1, rel
+    finally:
+        autograd.set_training(prev)
+
+
+def test_quantized_pooling_full_convention_max_exact():
+    """Ceil-mode int8 max pool matches the fp32 pooling op bit-for-bit
+    (the pad identity is int8-min, so padding never wins the max)."""
+    rng = np.random.RandomState(0)
+    x = rng.randint(-127, 128, (2, 3, 7, 7)).astype(np.int8)
+    got = qops.quantized_pooling(
+        jnp.asarray(x), kernel=(3, 3), stride=(2, 2), pad=(0, 0),
+        pool_type="max", pooling_convention="full")
+    want = nnops.pooling(jnp.asarray(x, jnp.float32), kernel=(3, 3),
+                         stride=(2, 2), pad=(0, 0), pool_type="max",
+                         pooling_convention="full")
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want)
+                                  .astype(np.int8))
