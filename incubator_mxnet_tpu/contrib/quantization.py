@@ -269,6 +269,65 @@ def _fire_convs(u):
     return (u.squeeze, u.left, u.right)
 
 
+def _fold_tower(u):
+    """Nested folded structure for an inception _Tower (parallel branches
+    concatenated on channels). Each plain branch is a folded CHAIN (the
+    same records the top-level walker uses); a ('split', ...) branch is a
+    _Fanout: stem chain -> concat(b1 chain, b2 chain). Every chain record
+    carries its own amax slot (filled during calibration)."""
+    branches = []
+    for child in u._children.values():
+        if type(child).__name__ == "_Fanout":
+            branches.append({
+                "fanout": {
+                    "stem": _fold_batchnorm(_iter_chain(child.stem)),
+                    "b1": _fold_batchnorm(_iter_chain(child.b1)),
+                    "b2": _fold_batchnorm(_iter_chain(child.b2)),
+                }})
+        else:
+            branches.append({"recs": _fold_batchnorm(_iter_chain(child))})
+    return branches
+
+
+def _chain_quantizable(recs):
+    """A branch chain is int8-eligible when every record is a plain
+    conv (no fused non-relu act), relu, valid pool, flatten, or dropout."""
+    from ..gluon import nn as gnn
+
+    for kind, lyr, _w, _b in recs:
+        if kind == "conv":
+            if getattr(lyr, "_channels_last", False):
+                return False
+            continue
+        if isinstance(lyr, (gnn.MaxPool2D, gnn.AvgPool2D)):
+            kw = lyr._kwargs
+            if kw.get("pooling_convention", "valid") != "valid" \
+                    and kw["pool_type"] != "max":
+                return False
+            if (kw["pool_type"] == "avg"
+                    and not kw.get("count_include_pad", True)
+                    and any(_p for _p in np.atleast_1d(kw.get("pad", 0)))):
+                return False
+            continue
+        if isinstance(lyr, gnn.Activation) and lyr._act_type == "relu":
+            continue
+        if isinstance(lyr, (gnn.Flatten, gnn.Dropout)):
+            continue
+        return False
+    return True
+
+
+def _tower_quantizable(branches):
+    for br in branches:
+        if "fanout" in br:
+            f = br["fanout"]
+            if not all(_chain_quantizable(f[k]) for k in ("stem", "b1", "b2")):
+                return False
+        elif not _chain_quantizable(br["recs"]):
+            return False
+    return True
+
+
 def _fold_batchnorm(layers):
     """Fold BatchNorm into the preceding conv/dense weights
     (ref: the quantize pass fuses conv+bn before quantizing).
@@ -277,6 +336,14 @@ def _fold_batchnorm(layers):
 
     records = []
     for layer in layers:
+        if type(layer).__name__ == "_Tower":
+            # inception tower: parallel conv-chain branches concatenated
+            # on channels (possibly with one nested _Fanout split); each
+            # branch quantizes as a sub-chain and rescales to ONE tower
+            # output scale so the concat is a pure int8 op. Demoted to an
+            # fp32 island later if any branch is not chain-quantizable.
+            records.append(("tower", layer, None, None))
+            continue
         if (type(layer).__name__ == "Fire"
                 and not any(getattr(c, "_channels_last", False)
                             for c in _fire_convs(layer))):
@@ -434,6 +501,50 @@ class QuantizedNet:
                 q = jnp.clip(jnp.round(out32 * step["s_out"]), -127,
                              127).astype(jnp.int8)
                 s = step["s_out"]
+            elif kind == "tower":
+                def _run_branch(bsteps, qx):
+                    from ..ops import quantized as qo
+
+                    for st in bsteps:
+                        if st["kind"] == "conv":
+                            acc = qo.quantized_conv(
+                                qx, st["qw"], st["qb"],
+                                no_bias=st["qb"] is None, **st["attrs"])
+                            out = (acc.astype(jnp.float32)
+                                   * st["requant_scale"])
+                            if st["relu"]:
+                                out = jnp.maximum(out, 0)
+                            qx = jnp.clip(jnp.round(out), -127,
+                                          127).astype(jnp.int8)
+                        elif st["kind"] in ("maxpool", "avgpool"):
+                            qx = qo.quantized_pooling(
+                                qx, pool_type=st["kind"][:3],
+                                **st["attrs"])
+                        elif st["kind"] == "relu":
+                            qx = jnp.maximum(qx, 0)
+                        elif st["kind"] == "flatten":
+                            qx = qx.reshape(qx.shape[0], -1)
+                    return qx
+
+                def _rescaled(bsteps, rescale, qx):
+                    qb = _run_branch(bsteps, qx)
+                    return jnp.clip(jnp.round(qb.astype(jnp.float32)
+                                              * rescale), -127,
+                                    127).astype(jnp.int8)
+
+                parts = []
+                for br in step["branches"]:
+                    if "fanout" in br:
+                        f = br["fanout"]
+                        qs2 = _run_branch(f["stem"], q)
+                        for part in f["parts"]:
+                            parts.append(_rescaled(part["steps"],
+                                                   part["rescale"], qs2))
+                    else:
+                        parts.append(_rescaled(br["steps"], br["rescale"],
+                                               q))
+                q = jnp.concatenate(parts, axis=1)
+                s = step["s_out"]
             elif kind == "fire":
                 def _branch(qx, sub, relu=True):
                     acc = qops.quantized_conv(
@@ -510,6 +621,49 @@ def quantize_net(net, calib_data, num_calib_batches=10, calib_mode="minmax",
     # fire units: one internal range (the squeeze activation)
     fire_amax = {i: 1e-8 for i, (kind, _l, _w, _b) in enumerate(records)
                  if kind == "fire"}
+    # towers: folded branch trees + per-branch-record ranges (demote to
+    # an fp32 island when any branch is not chain-quantizable)
+    folded_towers = {}
+    tower_amax = {}
+    for i, (kind, lyr, _w, _b) in enumerate(records):
+        if kind != "tower":
+            continue
+        branches = _fold_tower(lyr)
+        if not _tower_quantizable(branches):
+            records[i] = (type(lyr).__name__, lyr, None, None)
+            continue
+        folded_towers[i] = branches
+        am = []
+        for br in branches:
+            if "fanout" in br:
+                f = br["fanout"]
+                am.append({k: [1e-8] * len(f[k])
+                           for k in ("stem", "b1", "b2")})
+            else:
+                am.append({"recs": [1e-8] * len(br["recs"])})
+        tower_amax[i] = am
+
+    def _sim_chain(recs, x, amaxes):
+        """fp32 simulation of a folded branch chain, recording per-record
+        activation ranges at the (post-relu-fused) conv outputs."""
+        from ..ops import nn as nnops
+
+        for j, (kind, lyr, w, b) in enumerate(recs):
+            if kind == "conv":
+                x = nnops.convolution(
+                    x, jnp.asarray(w), None if b is None else jnp.asarray(b),
+                    no_bias=b is None, **_conv_attrs(lyr))
+                if lyr._act_type == "relu":
+                    x = jnp.maximum(x, 0)
+                amaxes[j] = max(amaxes[j], float(jnp.max(jnp.abs(x))))
+            elif isinstance(lyr, (gnn.MaxPool2D, gnn.AvgPool2D)):
+                x = nnops.pooling(x, **lyr._kwargs)
+            elif isinstance(lyr, gnn.Activation):
+                x = jnp.maximum(x, 0)
+            elif isinstance(lyr, gnn.Flatten):
+                x = x.reshape(x.shape[0], -1)
+            # Dropout: identity at inference
+        return x
 
     def _pool_quantizable(lyr):
         """int8 pooling: valid-convention pools, plus ceil-mode ('full')
@@ -579,6 +733,17 @@ def quantize_net(net, calib_data, num_calib_batches=10, calib_mode="minmax",
                         no_bias=proj["b"] is None,
                         **_conv_attrs(proj["lyr"]))
                 x = jnp.maximum(skip + h, 0)
+            elif kind == "tower":
+                parts = []
+                for br, am in zip(folded_towers[i], tower_amax[i]):
+                    if "fanout" in br:
+                        f = br["fanout"]
+                        h = _sim_chain(f["stem"], x, am["stem"])
+                        parts.append(_sim_chain(f["b1"], h, am["b1"]))
+                        parts.append(_sim_chain(f["b2"], h, am["b2"]))
+                    else:
+                        parts.append(_sim_chain(br["recs"], x, am["recs"]))
+                x = jnp.concatenate(parts, axis=1)
             elif kind == "fire":
                 from ..ops import nn as nnops
 
@@ -695,6 +860,44 @@ def quantize_net(net, calib_data, num_calib_batches=10, calib_mode="minmax",
         return qw, s_w, s_w.reshape(acc_bcast_shape).astype(np.float32)
 
     s_in0 = 127.0 / amax_in
+
+    def _emit_chain(recs, s_in_c, amaxes):
+        """Emit executable int8 steps for a folded branch chain; every
+        conv requantizes to its own calibrated scale. Returns
+        (steps, final scale)."""
+        out = []
+        s_cur = s_in_c
+        for j, (kind, lyr, w, b) in enumerate(recs):
+            if kind == "conv":
+                qw, s_w, s_w_b = _qweight(w, (1, -1, 1, 1))
+                qb = (None if b is None else
+                      jnp.asarray(np.round(b * s_cur * s_w)
+                                  .astype(np.int32)))
+                s_j = 127.0 / amaxes[j]
+                out.append(dict(
+                    kind="conv", qw=qw, qb=qb, attrs=_conv_attrs(lyr),
+                    relu=lyr._act_type == "relu", last=False,
+                    requant_scale=jnp.asarray(s_j / (s_cur * s_w_b)),
+                    deq_scale=jnp.asarray(1.0 / (s_cur * s_w_b)),
+                    s_out=s_j))
+                s_cur = s_j
+            elif isinstance(lyr, (gnn.MaxPool2D, gnn.AvgPool2D)):
+                kw = lyr._kwargs
+                out.append(dict(
+                    kind="maxpool" if kw["pool_type"] == "max"
+                    else "avgpool",
+                    attrs=dict(kernel=kw["kernel"], stride=kw["stride"],
+                               pad=kw["pad"],
+                               pooling_convention=kw.get(
+                                   "pooling_convention", "valid"))))
+            elif isinstance(lyr, gnn.Activation):
+                out.append(dict(kind="relu"))
+            elif isinstance(lyr, gnn.Flatten):
+                out.append(dict(kind="flatten"))
+            else:  # Dropout
+                out.append(dict(kind="identity"))
+        return out, s_cur
+
     steps = []
     s_prev = s_in0
     last_q = max((i for i, r in enumerate(records) if r[0] in ("conv", "dense")),
@@ -755,6 +958,33 @@ def quantize_net(net, calib_data, num_calib_batches=10, calib_mode="minmax",
                     deq_scale=jnp.asarray(1.0 / (s_prev * s_w_b)))
             steps.append(dict(kind="resunit", body=subs, proj=pstep,
                               skip_deq=1.0 / s_prev, s_out=s_out))
+            s_prev = s_out
+        elif kind == "tower":
+            # inception tower: each branch emits as an int8 sub-chain and
+            # RESCALES its final int8 activations to the shared tower
+            # scale, so the channel concat stays int8; a nested fanout's
+            # two sub-branches rescale directly to the tower scale
+            # (concat(concat(a,b),c) == concat(a,b,c))
+            ebranches = []
+            for br, am in zip(folded_towers[i], tower_amax[i]):
+                if "fanout" in br:
+                    f = br["fanout"]
+                    stem_steps, s_stem = _emit_chain(f["stem"], s_prev,
+                                                     am["stem"])
+                    parts = []
+                    for key in ("b1", "b2"):
+                        bsteps, s_b = _emit_chain(f[key], s_stem, am[key])
+                        parts.append(dict(steps=bsteps,
+                                          rescale=s_out / s_b))
+                    ebranches.append(dict(fanout=dict(stem=stem_steps,
+                                                      parts=parts)))
+                else:
+                    bsteps, s_b = _emit_chain(br["recs"], s_prev,
+                                              am["recs"])
+                    ebranches.append(dict(steps=bsteps,
+                                          rescale=s_out / s_b))
+            steps.append(dict(kind="tower", branches=ebranches,
+                              s_out=s_out))
             s_prev = s_out
         elif kind == "fire":
             # int8 branch-concat unit: both expand branches requantize to

@@ -449,3 +449,79 @@ def test_quantized_pooling_full_convention_max_exact():
                          pooling_convention="full")
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want)
                                   .astype(np.int8))
+
+
+def test_quantize_net_tower_unit_int8():
+    """Inception-style towers quantize as units: each parallel branch
+    emits as an int8 sub-chain and rescales to ONE shared tower scale so
+    the channel concat stays int8; a nested _Fanout split flattens into
+    the same concat. Small hand-built tower (fast, always-on); the full
+    inception-v3 (299x299, fixed 8x8 head pool) runs nightly."""
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.gluon.model_zoo.vision.inception import (
+        _Tower, _conv)
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(4)
+    prev = autograd.set_training(False)
+    try:
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(8, 3, padding=1, activation="relu"))
+        # plain branches + a pooled branch + a nested split
+        net.add(_Tower([
+            [_conv(8, 1)],
+            [_conv(4, 1), _conv(8, 3, 1, 1)],
+            [("avgpool",), _conv(4, 1)],
+            [("split", [_conv(4, 1)], [_conv(6, 3, 1, 1)],
+              [_conv(6, 1)])],
+        ]))
+        net.add(nn.GlobalAvgPool2D())
+        net.add(nn.Flatten())
+        net.add(nn.Dense(5))
+        net.initialize(mx.init.Xavier())
+        probe = nd.array(rng.rand(2, 3, 12, 12).astype(np.float32))
+        net(probe)
+        calib = [[nd.array(rng.rand(4, 3, 12, 12).astype(np.float32))]
+                 for _ in range(3)]
+        qnet = q.quantize_net(net, calib, num_calib_batches=3)
+        assert qnet.num_fp32_islands == 0
+        assert sum(1 for s in qnet._steps if s["kind"] == "tower") == 1
+        xs = nd.array(rng.rand(8, 3, 12, 12).astype(np.float32))
+        ref = net(xs).asnumpy()
+        got = qnet(xs).asnumpy()
+        rel = float(np.abs(got - ref).mean() / (np.abs(ref).mean() + 1e-9))
+        assert rel < 0.1, rel
+    finally:
+        autograd.set_training(prev)
+
+
+@pytest.mark.skipif(not __import__("os").environ.get("MXTPU_NIGHTLY"),
+                    reason="full 299x299 inception quantize (~4 min)")
+def test_quantize_net_inceptionv3_full_int8_nightly():
+    """Whole inception-v3 at its native 299x299: 0 fp32 islands, 11
+    quantized towers (the reference's documented int8 model, ref:
+    example/quantization/imagenet_gen_qsym.py)."""
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(4)
+    prev = autograd.set_training(False)
+    try:
+        net = vision.get_model("inceptionv3", classes=10)
+        net.initialize(mx.init.Xavier())
+        probe = nd.array(rng.rand(1, 3, 299, 299).astype(np.float32))
+        net(probe)
+        chain = q.as_chain(net, probe=probe)
+        calib = [[nd.array(rng.rand(2, 3, 299, 299).astype(np.float32))]
+                 for _ in range(2)]
+        qnet = q.quantize_net(chain, calib, num_calib_batches=2)
+        assert qnet.num_fp32_islands == 0
+        assert sum(1 for s in qnet._steps if s["kind"] == "tower") == 11
+        xs = nd.array(rng.rand(4, 3, 299, 299).astype(np.float32))
+        ref = net(xs).asnumpy()
+        got = qnet(xs).asnumpy()
+        rel = float(np.abs(got - ref).mean() / (np.abs(ref).mean() + 1e-9))
+        assert rel < 0.12, rel
+    finally:
+        autograd.set_training(prev)
