@@ -600,12 +600,15 @@ def quantize_net(net, calib_data, num_calib_batches=10, calib_mode="minmax",
                  quantized_dtype="int8"):
     """fp32 Gluon chain -> QuantizedNet with calibrated activation scales
     (ref: python quantize_model flow: collect stats -> set ranges -> emit
-    quantized graph). Supports Conv2D/Dense (+folded BatchNorm, fused relu),
-    Max/Avg/Global pooling, Flatten, Activation('relu'), Dropout, and v1
-    residual units (int8 body + int8 projection shortcut, fp32
-    dequant-add-requant at the skip junction — the reference's flagship
-    int8 model is ResNet, src/operator/quantization/); anything else runs
-    as an fp32 island between dequantize/quantize pairs."""
+    quantized graph). Supports Conv2D/Dense (+folded BatchNorm, fused
+    relu), Max/Avg/Global pooling (incl. ceil-mode int8 max), Flatten,
+    Activation('relu'), Dropout, and three composite-unit families —
+    v1 residual units (int8 body + shortcut, fp32 dequant-add-requant at
+    the skip junction), squeezenet Fire modules, and inception towers
+    (parallel int8 sub-chains rescaled to one concat scale) — covering
+    the reference's documented int8 model set (resnet / inception /
+    mobilenet, src/operator/quantization/); anything else runs as an
+    fp32 island between dequantize/quantize pairs."""
     from ..gluon import nn as gnn
 
     if quantized_dtype != "int8":
