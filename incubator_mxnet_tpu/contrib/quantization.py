@@ -234,6 +234,19 @@ def _fold_conv_bn(w, b, bn):
     return w.astype(np.float32), b.astype(np.float32)
 
 
+def _bn_affine(bn):
+    """Inference-mode BatchNorm as a per-channel affine y = a*x + b."""
+    gamma = bn.gamma.data().asnumpy()
+    beta = bn.beta.data().asnumpy()
+    mean = bn.running_mean.data().asnumpy()
+    var = bn.running_var.data().asnumpy()
+    if not bn._scale:
+        gamma = np.ones_like(gamma)
+    a = gamma / np.sqrt(var + bn._epsilon)
+    b = beta - mean * a
+    return a.astype(np.float32), b.astype(np.float32)
+
+
 def _conv_attrs(lyr):
     return dict(kernel=lyr._kernel, stride=lyr._strides,
                 dilate=lyr._dilation, pad=lyr._padding,
@@ -274,7 +287,15 @@ def _fold_tower(u):
     concatenated on channels). Each plain branch is a folded CHAIN (the
     same records the top-level walker uses); a ('split', ...) branch is a
     _Fanout: stem chain -> concat(b1 chain, b2 chain). Every chain record
-    carries its own amax slot (filled during calibration)."""
+    carries its own amax slot (filled during calibration).
+
+    A densenet _DenseLayer is the two-branch special case
+    concat(x, body(x)): an IDENTITY branch (empty chain) + the
+    bn-relu-conv body chain (quantizable since standalone BN emits as an
+    int8 per-channel affine)."""
+    if type(u).__name__ == "_DenseLayer":
+        return [{"recs": []},
+                {"recs": _fold_batchnorm(_iter_chain(u.body))}]
     branches = []
     for child in u._children.values():
         if type(child).__name__ == "_Fanout":
@@ -297,6 +318,10 @@ def _chain_quantizable(recs):
     for kind, lyr, _w, _b in recs:
         if kind == "conv":
             if getattr(lyr, "_channels_last", False):
+                return False
+            continue
+        if kind == "bn_alone":
+            if getattr(lyr, "_axis", 1) != 1:
                 return False
             continue
         if isinstance(lyr, (gnn.MaxPool2D, gnn.AvgPool2D)):
@@ -336,7 +361,7 @@ def _fold_batchnorm(layers):
 
     records = []
     for layer in layers:
-        if type(layer).__name__ == "_Tower":
+        if type(layer).__name__ in ("_Tower", "_DenseLayer"):
             # inception tower: parallel conv-chain branches concatenated
             # on channels (possibly with one nested _Fanout split); each
             # branch quantizes as a sub-chain and rescales to ONE tower
@@ -520,6 +545,11 @@ class QuantizedNet:
                             qx = qo.quantized_pooling(
                                 qx, pool_type=st["kind"][:3],
                                 **st["attrs"])
+                        elif st["kind"] == "affine":
+                            o = (qx.astype(jnp.float32) * st["mul"]
+                                 + st["add"])
+                            qx = jnp.clip(jnp.round(o), -127,
+                                          127).astype(jnp.int8)
                         elif st["kind"] == "relu":
                             qx = jnp.maximum(qx, 0)
                         elif st["kind"] == "flatten":
@@ -561,6 +591,10 @@ class QuantizedNet:
                 q = jnp.concatenate(
                     [_branch(qs, step["left"]), _branch(qs, step["right"])],
                     axis=1)
+                s = step["s_out"]
+            elif kind == "affine":
+                out = (q.astype(jnp.float32) * step["mul"]) + step["add"]
+                q = jnp.clip(jnp.round(out), -127, 127).astype(jnp.int8)
                 s = step["s_out"]
             elif kind == "maxpool":
                 q = qops.quantized_pooling(q, pool_type="max", **step["attrs"])
@@ -658,6 +692,10 @@ def quantize_net(net, calib_data, num_calib_batches=10, calib_mode="minmax",
                     no_bias=b is None, **_conv_attrs(lyr))
                 if lyr._act_type == "relu":
                     x = jnp.maximum(x, 0)
+                amaxes[j] = max(amaxes[j], float(jnp.max(jnp.abs(x))))
+            elif kind == "bn_alone":
+                a, bb = _bn_affine(lyr)
+                x = x * a.reshape(1, -1, 1, 1) + bb.reshape(1, -1, 1, 1)
                 amaxes[j] = max(amaxes[j], float(jnp.max(jnp.abs(x))))
             elif isinstance(lyr, (gnn.MaxPool2D, gnn.AvgPool2D)):
                 x = nnops.pooling(x, **lyr._kwargs)
@@ -884,6 +922,16 @@ def quantize_net(net, calib_data, num_calib_batches=10, calib_mode="minmax",
                     deq_scale=jnp.asarray(1.0 / (s_cur * s_w_b)),
                     s_out=s_j))
                 s_cur = s_j
+            elif kind == "bn_alone":
+                a, bb = _bn_affine(lyr)
+                s_j = 127.0 / amaxes[j]
+                out.append(dict(
+                    kind="affine",
+                    mul=jnp.asarray((a * (s_j / s_cur))
+                                    .reshape(1, -1, 1, 1)),
+                    add=jnp.asarray((bb * s_j).reshape(1, -1, 1, 1)),
+                    s_out=s_j))
+                s_cur = s_j
             elif isinstance(lyr, (gnn.MaxPool2D, gnn.AvgPool2D)):
                 kw = lyr._kwargs
                 out.append(dict(
@@ -1037,6 +1085,19 @@ def quantize_net(net, calib_data, num_calib_batches=10, calib_mode="minmax",
                 attrs=dict(kernel=lyr._kwargs["kernel"],
                            stride=lyr._kwargs["stride"],
                            pad=lyr._kwargs["pad"], global_pool=True)))
+        elif kind == "bn_alone" and getattr(lyr, "_axis", 1) == 1:
+            # standalone inference BN = per-channel affine, exact in the
+            # int8 requant epilogue: q' = round(q * (a*s_out/s_in) +
+            # b*s_out) — no dequantized fp32 island needed (unlocks the
+            # pre-activation bn->relu->conv families: densenet, resnet v2)
+            a, b = _bn_affine(lyr)
+            bshape = (1, -1, 1, 1)
+            steps.append(dict(
+                kind="affine",
+                mul=jnp.asarray((a * (s_out / s_prev)).reshape(bshape)),
+                add=jnp.asarray((b * s_out).reshape(bshape)),
+                s_out=s_out))
+            s_prev = s_out
         elif isinstance(lyr, gnn.Activation) and lyr._act_type == "relu":
             steps.append(dict(kind="relu"))
         elif isinstance(lyr, gnn.Flatten):

@@ -525,3 +525,39 @@ def test_quantize_net_inceptionv3_full_int8_nightly():
         assert rel < 0.12, rel
     finally:
         autograd.set_training(prev)
+
+
+def test_quantize_net_denselayer_int8():
+    """densenet _DenseLayer = concat(x, body(x)) quantizes as the
+    two-branch tower special case: identity branch + the bn-relu-conv
+    body chain (standalone BN emits as an int8 per-channel affine)."""
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.gluon.model_zoo.vision.densenet import (
+        _DenseLayer)
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(5)
+    prev = autograd.set_training(False)
+    try:
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(8, 3, padding=1, activation="relu"))
+        net.add(_DenseLayer(growth_rate=4, bn_size=2, dropout=0))
+        net.add(_DenseLayer(growth_rate=4, bn_size=2, dropout=0))
+        net.add(nn.GlobalAvgPool2D())
+        net.add(nn.Flatten())
+        net.add(nn.Dense(5))
+        net.initialize(mx.init.Xavier())
+        probe = nd.array(rng.rand(2, 3, 10, 10).astype(np.float32))
+        net(probe)
+        calib = [[nd.array(rng.rand(4, 3, 10, 10).astype(np.float32))]
+                 for _ in range(3)]
+        qnet = q.quantize_net(net, calib, num_calib_batches=3)
+        assert qnet.num_fp32_islands == 0
+        assert sum(1 for s in qnet._steps if s["kind"] == "tower") == 2
+        xs = nd.array(rng.rand(8, 3, 10, 10).astype(np.float32))
+        ref = net(xs).asnumpy()
+        got = qnet(xs).asnumpy()
+        rel = float(np.abs(got - ref).mean() / (np.abs(ref).mean() + 1e-9))
+        assert rel < 0.1, rel
+    finally:
+        autograd.set_training(prev)
