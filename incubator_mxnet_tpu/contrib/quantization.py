@@ -353,6 +353,38 @@ def _tower_quantizable(branches):
     return True
 
 
+def _fold_resunit_v2(u):
+    """Record chains for a v2 (pre-activation) ResidualUnit. Returns
+    (pre, mid, last, proj): pre = [bn0, relu] (the shared pre-activation
+    that also feeds the projection shortcut), mid = [conv0, bn1, relu,
+    conv1, ...] up to but excluding the last conv, last = the final conv
+    record (its int32 accumulator flows into the skip add, no relu after
+    the add in v2), proj = the 1x1 projection conv record or None."""
+    from ..gluon import nn as gnn
+
+    n = len(u.convs)
+    relu = gnn.Activation("relu")
+
+    def conv_rec(c):
+        w = c.weight.data().asnumpy().astype(np.float32)
+        b = (c.bias.data().asnumpy().astype(np.float32)
+             if c.bias is not None else None)
+        return ("conv", c, w, b)
+
+    pre = [("bn_alone", u.norms[0], None, None),
+           ("relu", relu, None, None)]
+    mid = []
+    for i in range(n):
+        if i > 0:
+            mid.append(("bn_alone", u.norms[i], None, None))
+            mid.append(("relu", relu, None, None))
+        if i < n - 1:
+            mid.append(conv_rec(u.convs[i]))
+    last = conv_rec(u.convs[n - 1])
+    proj = conv_rec(u.proj) if u.proj is not None else None
+    return pre, mid, last, proj
+
+
 def _fold_batchnorm(layers):
     """Fold BatchNorm into the preceding conv/dense weights
     (ref: the quantize pass fuses conv+bn before quantizing).
@@ -377,6 +409,15 @@ def _fold_batchnorm(layers):
             # branches requantize to ONE calibrated output scale so the
             # concat itself is a pure int8 op
             records.append(("fire", layer, None, None))
+            continue
+        if (type(layer).__name__ == "ResidualUnit"
+                and getattr(layer, "_version", None) == 2
+                and not any(getattr(c, "_channels_last", False)
+                            for c in layer.convs)):
+            # v2 pre-activation: bn->relu precede each conv; standalone
+            # BNs emit as int8 affines, so the unit quantizes too —
+            # skip-add on dequantized accumulators, NO relu after the add
+            records.append(("resunit2", layer, None, None))
             continue
         if (type(layer).__name__ == "ResidualUnit"
                 and getattr(layer, "_version", None) == 1
@@ -526,53 +567,43 @@ class QuantizedNet:
                 q = jnp.clip(jnp.round(out32 * step["s_out"]), -127,
                              127).astype(jnp.int8)
                 s = step["s_out"]
+            elif kind == "resunit2":
+                # v2: shared pre-activation feeds body AND projection;
+                # skip-add on dequantized accumulators, NO relu after
+                # the add (pre-activation ordering), then requantize
+                q_in = q
+                qp = self._exec_branch(step["pre"], q)
+                if step["proj"] is not None:
+                    accp = qops.quantized_conv(
+                        qp, step["proj"]["qw"], step["proj"]["qb"],
+                        no_bias=step["proj"]["qb"] is None,
+                        **step["proj"]["attrs"])
+                    skip32 = (accp.astype(jnp.float32)
+                              * step["proj"]["deq_scale"])
+                else:
+                    skip32 = q_in.astype(jnp.float32) * step["skip_deq"]
+                qm = self._exec_branch(step["mid"], qp)
+                accl = qops.quantized_conv(
+                    qm, step["last"]["qw"], step["last"]["qb"],
+                    no_bias=step["last"]["qb"] is None,
+                    **step["last"]["attrs"])
+                body32 = accl.astype(jnp.float32) * step["last"]["deq_scale"]
+                out32 = body32 + skip32
+                q = jnp.clip(jnp.round(out32 * step["s_out"]), -127,
+                             127).astype(jnp.int8)
+                s = step["s_out"]
             elif kind == "tower":
-                def _run_branch(bsteps, qx):
-                    from ..ops import quantized as qo
-
-                    for st in bsteps:
-                        if st["kind"] == "conv":
-                            acc = qo.quantized_conv(
-                                qx, st["qw"], st["qb"],
-                                no_bias=st["qb"] is None, **st["attrs"])
-                            out = (acc.astype(jnp.float32)
-                                   * st["requant_scale"])
-                            if st["relu"]:
-                                out = jnp.maximum(out, 0)
-                            qx = jnp.clip(jnp.round(out), -127,
-                                          127).astype(jnp.int8)
-                        elif st["kind"] in ("maxpool", "avgpool"):
-                            qx = qo.quantized_pooling(
-                                qx, pool_type=st["kind"][:3],
-                                **st["attrs"])
-                        elif st["kind"] == "affine":
-                            o = (qx.astype(jnp.float32) * st["mul"]
-                                 + st["add"])
-                            qx = jnp.clip(jnp.round(o), -127,
-                                          127).astype(jnp.int8)
-                        elif st["kind"] == "relu":
-                            qx = jnp.maximum(qx, 0)
-                        elif st["kind"] == "flatten":
-                            qx = qx.reshape(qx.shape[0], -1)
-                    return qx
-
-                def _rescaled(bsteps, rescale, qx):
-                    qb = _run_branch(bsteps, qx)
-                    return jnp.clip(jnp.round(qb.astype(jnp.float32)
-                                              * rescale), -127,
-                                    127).astype(jnp.int8)
-
                 parts = []
                 for br in step["branches"]:
                     if "fanout" in br:
                         f = br["fanout"]
-                        qs2 = _run_branch(f["stem"], q)
+                        qs2 = self._exec_branch(f["stem"], q)
                         for part in f["parts"]:
-                            parts.append(_rescaled(part["steps"],
-                                                   part["rescale"], qs2))
+                            parts.append(self._rescaled(
+                                part["steps"], part["rescale"], qs2))
                     else:
-                        parts.append(_rescaled(br["steps"], br["rescale"],
-                                               q))
+                        parts.append(self._rescaled(
+                            br["steps"], br["rescale"], q))
                 q = jnp.concatenate(parts, axis=1)
                 s = step["s_out"]
             elif kind == "fire":
@@ -613,6 +644,36 @@ class QuantizedNet:
             else:  # identity (Dropout at inference)
                 pass
         return q.astype(jnp.float32) / s
+
+    def _exec_branch(self, bsteps, qx):
+        """Execute an int8 sub-chain (tower branch / unit segment)."""
+        from ..ops import quantized as qo
+
+        for st in bsteps:
+            if st["kind"] == "conv":
+                acc = qo.quantized_conv(
+                    qx, st["qw"], st["qb"], no_bias=st["qb"] is None,
+                    **st["attrs"])
+                out = acc.astype(jnp.float32) * st["requant_scale"]
+                if st["relu"]:
+                    out = jnp.maximum(out, 0)
+                qx = jnp.clip(jnp.round(out), -127, 127).astype(jnp.int8)
+            elif st["kind"] in ("maxpool", "avgpool"):
+                qx = qo.quantized_pooling(qx, pool_type=st["kind"][:3],
+                                          **st["attrs"])
+            elif st["kind"] == "affine":
+                o = qx.astype(jnp.float32) * st["mul"] + st["add"]
+                qx = jnp.clip(jnp.round(o), -127, 127).astype(jnp.int8)
+            elif st["kind"] == "relu":
+                qx = jnp.maximum(qx, 0)
+            elif st["kind"] == "flatten":
+                qx = qx.reshape(qx.shape[0], -1)
+        return qx
+
+    def _rescaled(self, bsteps, rescale, qx):
+        qb = self._exec_branch(bsteps, qx)
+        return jnp.clip(jnp.round(qb.astype(jnp.float32) * rescale),
+                        -127, 127).astype(jnp.int8)
 
     def apply(self, x):
         """The traceable forward (jnp in -> jnp out): compose under an
@@ -658,6 +719,13 @@ def quantize_net(net, calib_data, num_calib_batches=10, calib_mode="minmax",
     # fire units: one internal range (the squeeze activation)
     fire_amax = {i: 1e-8 for i, (kind, _l, _w, _b) in enumerate(records)
                  if kind == "fire"}
+    # v2 residual units: pre/mid chains + last-conv/proj records, with
+    # per-record ranges for the requant points
+    folded_v2 = {i: _fold_resunit_v2(lyr)
+                 for i, (kind, lyr, _w, _b) in enumerate(records)
+                 if kind == "resunit2"}
+    v2_amax = {i: {"pre": [1e-8] * len(pre), "mid": [1e-8] * len(mid)}
+               for i, (pre, mid, _l, _p) in folded_v2.items()}
     # towers: folded branch trees + per-branch-record ranges (demote to
     # an fp32 island when any branch is not chain-quantizable)
     folded_towers = {}
@@ -774,6 +842,25 @@ def quantize_net(net, calib_data, num_calib_batches=10, calib_mode="minmax",
                         no_bias=proj["b"] is None,
                         **_conv_attrs(proj["lyr"]))
                 x = jnp.maximum(skip + h, 0)
+            elif kind == "resunit2":
+                from ..ops import nn as nnops
+
+                pre, mid, last, proj = folded_v2[i]
+                h = _sim_chain(pre, x, v2_amax[i]["pre"])
+                skip = x
+                if proj is not None:
+                    _pk, pl, pw, pb = proj
+                    skip = nnops.convolution(
+                        h, jnp.asarray(pw),
+                        None if pb is None else jnp.asarray(pb),
+                        no_bias=pb is None, **_conv_attrs(pl))
+                h = _sim_chain(mid, h, v2_amax[i]["mid"])
+                _lk, ll, lw, lb = last
+                h = nnops.convolution(
+                    h, jnp.asarray(lw),
+                    None if lb is None else jnp.asarray(lb),
+                    no_bias=lb is None, **_conv_attrs(ll))
+                x = skip + h
             elif kind == "tower":
                 parts = []
                 for br, am in zip(folded_towers[i], tower_amax[i]):
@@ -1008,6 +1095,34 @@ def quantize_net(net, calib_data, num_calib_batches=10, calib_mode="minmax",
                     attrs=_conv_attrs(proj["lyr"]),
                     deq_scale=jnp.asarray(1.0 / (s_prev * s_w_b)))
             steps.append(dict(kind="resunit", body=subs, proj=pstep,
+                              skip_deq=1.0 / s_prev, s_out=s_out))
+            s_prev = s_out
+        elif kind == "resunit2":
+            pre, mid, last, proj = folded_v2[i]
+            pre_steps, s_pre = _emit_chain(pre, s_prev, v2_amax[i]["pre"])
+            pstep = None
+            if proj is not None:
+                _pk, pl, pw, pb = proj
+                qw, s_w, s_w_b = _qweight(pw, (1, -1, 1, 1))
+                pstep = dict(
+                    qw=qw,
+                    qb=(None if pb is None else
+                        jnp.asarray(np.round(pb * s_pre * s_w)
+                                    .astype(np.int32))),
+                    attrs=_conv_attrs(pl),
+                    deq_scale=jnp.asarray(1.0 / (s_pre * s_w_b)))
+            mid_steps, s_mid = _emit_chain(mid, s_pre, v2_amax[i]["mid"])
+            _lk, ll, lw, lb = last
+            qw, s_w, s_w_b = _qweight(lw, (1, -1, 1, 1))
+            lstep = dict(
+                qw=qw,
+                qb=(None if lb is None else
+                    jnp.asarray(np.round(lb * s_mid * s_w)
+                                .astype(np.int32))),
+                attrs=_conv_attrs(ll),
+                deq_scale=jnp.asarray(1.0 / (s_mid * s_w_b)))
+            steps.append(dict(kind="resunit2", pre=pre_steps,
+                              mid=mid_steps, last=lstep, proj=pstep,
                               skip_deq=1.0 / s_prev, s_out=s_out))
             s_prev = s_out
         elif kind == "tower":

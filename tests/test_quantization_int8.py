@@ -382,7 +382,11 @@ def test_quantize_net_bottleneck_resunit_int8():
         autograd.set_training(prev)
 
 
-def test_quantize_net_v2_resunit_stays_fp32_island():
+def test_quantize_net_v2_resunit_int8():
+    """v2 pre-activation units quantize too (round-5 affine-BN unlock):
+    the shared pre-activation (int8 affine + relu) feeds body AND
+    projection, the skip-add runs on dequantized accumulators with NO
+    relu after the add (pre-act ordering), then requantizes."""
     from incubator_mxnet_tpu import autograd
     from incubator_mxnet_tpu.gluon.model_zoo import vision
 
@@ -395,12 +399,17 @@ def test_quantize_net_v2_resunit_stays_fp32_island():
         probe = nd.array(rng.rand(2, 3, 32, 32).astype(np.float32))
         net(probe)
         chain = q.as_chain(net, probe=probe)
-        calib = [[nd.array(rng.rand(4, 3, 32, 32).astype(np.float32))]]
-        qnet = q.quantize_net(chain, calib, num_calib_batches=1)
-        assert qnet.num_fp32_islands > 0  # v2 units: documented fallback
-        assert not any(s["kind"] == "resunit" for s in qnet._steps)
-        xs = nd.array(rng.rand(4, 3, 32, 32).astype(np.float32))
-        assert np.isfinite(qnet(xs).asnumpy()).all()
+        calib = [[nd.array(rng.rand(4, 3, 32, 32).astype(np.float32))]
+                 for _ in range(3)]
+        qnet = q.quantize_net(chain, calib, num_calib_batches=3)
+        assert qnet.num_fp32_islands == 0
+        assert sum(1 for s in qnet._steps
+                   if s["kind"] == "resunit2") == 8
+        xs = nd.array(rng.rand(8, 3, 32, 32).astype(np.float32))
+        ref = net(xs).asnumpy()
+        got = qnet(xs).asnumpy()
+        rel = float(np.abs(got - ref).mean() / (np.abs(ref).mean() + 1e-9))
+        assert rel < 0.1, rel
     finally:
         autograd.set_training(prev)
 
