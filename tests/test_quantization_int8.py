@@ -570,3 +570,48 @@ def test_quantize_net_denselayer_int8():
         assert rel < 0.1, rel
     finally:
         autograd.set_training(prev)
+
+
+@pytest.mark.skipif(not __import__("os").environ.get("MXTPU_NIGHTLY"),
+                    reason="trains a small resnet (~2 min); nightly tier")
+def test_quantized_trained_resnet_accuracy_within_2pct():
+    """The composite-unit quantizer must preserve accuracy on a TRAINED
+    residual network, not just track random-net logits: train a CIFAR-stem
+    resnet on separable synthetic classes, quantize, and require int8
+    accuracy within 2% of fp32 (the reference's quantize_model accuracy
+    bar, example/quantization/)."""
+    from incubator_mxnet_tpu import autograd, fused, gluon
+    from incubator_mxnet_tpu.gluon.model_zoo.vision.resnet import ResNet
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    n, classes = 1024, 8
+    proto = rng.rand(classes, 3, 16, 16).astype(np.float32)
+    y = rng.randint(0, classes, n)
+    X = proto[y] + 0.15 * rng.randn(n, 3, 16, 16).astype(np.float32)
+    Xtr, ytr, Xte, yte = X[:768], y[:768], X[768:], y[768:]
+
+    net = ResNet(1, [1, 1], (8, 8, 16), False, classes=classes,
+                 thumbnail=True)
+    net.initialize(mx.init.Xavier())
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    opt = mx.optimizer.Adam(learning_rate=3e-3, rescale_grad=1.0 / 64)
+    step = fused.GluonTrainStep(net, lambda m, a, b: L(m(a), b), opt)
+    for _ in range(4):
+        for i in range(0, len(Xtr), 64):
+            step(nd.array(Xtr[i:i + 64]),
+                 nd.array(ytr[i:i + 64].astype(np.float32)))
+    step.sync_params()
+
+    prev = autograd.set_training(False)
+    try:
+        acc_f = (net(nd.array(Xte)).asnumpy().argmax(1) == yte).mean()
+        assert acc_f > 0.9, acc_f  # the task must be learnable
+        chain = q.as_chain(net, probe=nd.array(Xte[:2]))
+        calib = [[nd.array(Xtr[i:i + 64])] for i in range(0, 256, 64)]
+        qnet = q.quantize_net(chain, calib, num_calib_batches=4)
+        assert qnet.num_fp32_islands == 0
+        acc_q = (qnet(nd.array(Xte)).asnumpy().argmax(1) == yte).mean()
+        assert acc_f - acc_q <= 0.02, (acc_f, acc_q)
+    finally:
+        autograd.set_training(prev)
