@@ -480,12 +480,17 @@ def test_perf_analysis_infer_executes(tmp_path):
         capture_output=True, text=True, timeout=1200, env=env)
     assert p.returncode == 0, p.stderr[-2000:]
     rows = [json.loads(ln) for ln in p.stdout.strip().splitlines()]
-    assert len(rows) == 2
-    resnet, alexnet = rows
+    assert len(rows) == 3
+    resnet, alexnet, resnet_i8 = rows
     assert set(resnet["conv_out_dtypes"]) == {"bf16"}
     assert resnet["nhwc_convs"] == resnet["convolutions"]
     assert set(alexnet["conv_out_dtypes"]) == {"i32"}
     assert alexnet["v5e_roofline_img_per_s"] > 0
+    # int8 resnet: every conv (incl. residual-unit bodies + projection
+    # shortcuts) accumulates in i32 — no fp32 conv islands in the HLO
+    assert set(resnet_i8["conv_out_dtypes"]) == {"i32"}
+    assert resnet_i8["convolutions"] == resnet["convolutions"]
+    assert resnet_i8["v5e_roofline_img_per_s"] > 0
     assert "ROOFLINE" in report.read_text()
 
 

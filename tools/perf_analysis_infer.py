@@ -211,6 +211,73 @@ def analyze_alexnet_int8(batch, image, scan_k):
     }
 
 
+def analyze_resnet50_int8(batch, image, scan_k):
+    """The calibrated int8 ResNet-50 program (residual units quantize as
+    units, round 5 — NCHW; every conv FLOP int8, skip-joins in the f32
+    epilogue)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, nd
+    from incubator_mxnet_tpu.contrib import quantization as q
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(0)
+    net = vision.get_model("resnet50_v1", classes=1000)
+    net.initialize(mx.init.Xavier())
+    prev = autograd.set_training(False)
+    try:
+        net(nd.zeros((1, 3, image, image)))
+        probe = nd.array(np.random.RandomState(0)
+                         .rand(2, 3, image, image).astype(np.float32))
+        chain = q.as_chain(net, probe=probe)
+    finally:
+        autograd.set_training(prev)
+    rng = np.random.RandomState(0)
+    calib = [[nd.array(rng.rand(2, 3, image, image).astype(np.float32))]
+             for _ in range(2)]
+    qnet = q.quantize_net(chain, calib, num_calib_batches=2)
+    assert qnet.num_fp32_islands == 0
+
+    def scan_fwd(xs):
+        def body(c, x):
+            return c, jnp.argmax(qnet.apply(x), axis=-1)
+        _, outs = jax.lax.scan(body, 0, xs)
+        return outs
+
+    xs_sds = jax.ShapeDtypeStruct((scan_k, batch, 3, image, image),
+                                  jnp.float32)
+    t0 = time.time()
+    lowered = jax.jit(scan_fwd).lower(xs_sds)
+    stablehlo = lowered.as_text()
+    compiled = lowered.compile()
+    compile_s = time.time() - t0
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    flops = float(ca.get("flops", 0.0))
+
+    analytic_macs = RESNET50_FWD_FLOPS / 2 * (image / 224.0) ** 2
+    t_comp = batch * analytic_macs * 2 / V5E_INT8_OPS
+    # traffic: int8 activations (~11M acts/img, ~2 passes through the
+    # requant epilogues) + one pass over ~25.5M int8 params
+    est_bytes = 2.0 * 11e6 * (image / 224.0) ** 2 * batch + 25.5e6
+    t_mem = est_bytes / V5E_HBM_BW
+    pred = batch / max(t_comp, t_mem)
+    return {
+        "program": "resnet50_v1 int8 inference (residual units quantized)",
+        "batch": batch, "scan_k": scan_k, "compile_s": round(compile_s, 1),
+        "xla_flops_per_batch": flops,
+        "analytic_int8_ops_per_image_gop": round(analytic_macs * 2 / 1e9, 2),
+        "est_tpu_bytes_per_batch": round(est_bytes),
+        "bound": "memory" if t_mem > t_comp else "compute",
+        "v5e_roofline_img_per_s": round(pred),
+        "roofline_vs_v100_fp16_ref": round(pred / REF_V100_RESNET_FP16, 2),
+        **_conv_facts(stablehlo),
+    }
+
+
 def write_report(rows, path):
     lines = [
         "# Inference program analysis (offline, XLA-compiled)",
@@ -256,6 +323,7 @@ def main():
     rows = [
         analyze_resnet_bf16(args.batch_resnet, args.image, args.scan),
         analyze_alexnet_int8(args.batch_alexnet, args.image, args.scan),
+        analyze_resnet50_int8(args.batch_resnet, args.image, args.scan),
     ]
     for d in rows:
         print(json.dumps(d), flush=True)
