@@ -45,6 +45,13 @@ run_suite() {
     fi
 }
 
+run_telemetry() {
+    echo "=== telemetry smoke (off/on loop, exporter parse, overhead) ==="
+    # tiny train loop twice: telemetry off then on; asserts JSON/Prometheus
+    # dumps parse and the disabled path adds <5% wall time (no-op stubs)
+    python tools/telemetry_smoke.py
+}
+
 run_nightly() {
     echo "=== nightly tier (large tensors, checkpoint compat, 7-worker dist) ==="
     MXTPU_NIGHTLY=1 python -m pytest tests/test_large_array.py \
@@ -64,12 +71,13 @@ run_nightly() {
 }
 
 case "$tier" in
-    unit)     run_unit ;;
-    dist)     run_dist ;;
-    examples) run_examples ;;
-    suite)    run_suite ;;
-    nightly)  run_nightly ;;
-    all)      run_unit; run_dist; run_examples; run_nightly ;;
-    *) echo "unknown tier: $tier (unit|nightly|dist|examples|suite|all)"; exit 2 ;;
+    unit)      run_unit ;;
+    dist)      run_dist ;;
+    examples)  run_examples ;;
+    suite)     run_suite ;;
+    telemetry) run_telemetry ;;
+    nightly)   run_nightly ;;
+    all)       run_unit; run_telemetry; run_dist; run_examples; run_nightly ;;
+    *) echo "unknown tier: $tier (unit|nightly|dist|examples|suite|telemetry|all)"; exit 2 ;;
 esac
 echo "tier '$tier' green"

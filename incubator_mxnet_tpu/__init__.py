@@ -58,6 +58,7 @@ from . import gluon  # noqa: F401
 from . import executor  # noqa: F401
 from . import engine  # noqa: F401
 from . import profiler  # noqa: F401
+from . import telemetry  # noqa: F401
 from . import runtime  # noqa: F401
 from . import parallel  # noqa: F401
 from . import test_utils  # noqa: F401

@@ -129,6 +129,21 @@ register_knob("MXTPU_HEARTBEAT_TIMEOUT", 20.0, float,
 register_knob("MXNET_PROFILER_AUTOSTART", False, bool,
               "Start profiling at import (ref: env_var.md:192).")
 
+# telemetry
+register_knob("MXNET_TELEMETRY", False, bool,
+              "Master switch for the runtime telemetry layer (metrics "
+              "registry, tracing spans, exporters — see "
+              "docs/OBSERVABILITY.md). Off by default; while off every "
+              "instrumented site short-circuits through no-op stubs.")
+register_knob("MXNET_TELEMETRY_PORT", 0, int,
+              "When >0 and telemetry is enabled, serve Prometheus text "
+              "exposition at http://0.0.0.0:<port>/metrics from a daemon "
+              "thread (stdlib http.server; no client library needed).")
+register_knob("MXNET_TELEMETRY_MEM_INTERVAL", 1, int,
+              "Trainer steps between device-memory watermark samples at "
+              "step boundaries (0 disables memory sampling; sampling reads "
+              "device.memory_stats() plus host RSS).")
+
 # numerics / reproducibility
 register_knob("MXTPU_DEFAULT_DTYPE", "float32", str,
               "Default dtype for new NDArrays.")

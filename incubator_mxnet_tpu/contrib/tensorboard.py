@@ -7,7 +7,12 @@ ships in this stack); a clear ImportError otherwise — same gating posture
 as the reference, which required the dmlc tensorboard package."""
 from __future__ import annotations
 
-__all__ = ["LogMetricsCallback"]
+from ..telemetry.tb import LogTelemetryCallback  # noqa: F401 — the registry-
+# sourced sibling of LogMetricsCallback (same callback protocol, same
+# SummaryWriter gating); lives in telemetry/tb.py, re-exported here so both
+# tensorboard callbacks are importable from one place.
+
+__all__ = ["LogMetricsCallback", "LogTelemetryCallback"]
 
 
 class LogMetricsCallback:

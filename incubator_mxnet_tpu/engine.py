@@ -9,23 +9,40 @@ the reference's control API (bulking, waitall) as thin shims.
 from __future__ import annotations
 
 import contextlib
+import logging
 import os
+import time
 
 import jax
 
 __all__ = ["waitall", "bulk", "set_bulk_size"]
 
 from . import config as _config
+from . import telemetry as _telemetry
 
 _BULK_SIZE = _config.get("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 15)
 
+_log = logging.getLogger(__name__)
+
 
 def waitall():
-    """(ref: Engine::WaitForAll / MXNDArrayWaitAll)"""
+    """(ref: Engine::WaitForAll / MXNDArrayWaitAll). Barrier failures are
+    never raised (parity with the reference's best-effort WaitForAll from
+    Python) but they ARE observable: debug log + telemetry error counter."""
+    t0 = time.perf_counter() if _telemetry.enabled() else None
     try:
         jax.effects_barrier()
-    except Exception:
-        pass
+    except Exception as e:
+        _log.debug("engine.waitall: effects barrier failed: %r", e,
+                   exc_info=True)
+        _telemetry.inc("mxtpu_engine_waitall_errors_total",
+                       help="engine.waitall barriers that raised "
+                            "(swallowed; see debug log for tracebacks).")
+    finally:
+        if t0 is not None:
+            _telemetry.observe("mxtpu_engine_waitall_seconds",
+                               time.perf_counter() - t0,
+                               help="Wall time blocked in engine.waitall.")
 
 
 def set_bulk_size(size):

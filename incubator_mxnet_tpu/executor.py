@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from . import random as _global_random
+from . import telemetry as _telemetry
 from .ndarray.ndarray import NDArray
 
 __all__ = ["Executor"]
@@ -48,6 +49,10 @@ class Executor:
     # -- forward/backward --------------------------------------------------
     def forward(self, is_train=False, **kwargs):
         """(ref: GraphExecutor::Forward) — returns list of output NDArrays."""
+        with _telemetry.span("executor.forward", train=is_train):
+            return self._forward_impl(is_train, **kwargs)
+
+    def _forward_impl(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
             if k not in self.arg_dict:
                 raise ValueError(f"unknown argument {k}")
@@ -95,6 +100,10 @@ class Executor:
 
     def backward(self, out_grads=None, is_train=True):
         """(ref: GraphExecutor::Backward) — accumulate into grad arrays."""
+        with _telemetry.span("executor.backward"):
+            return self._backward_impl(out_grads, is_train)
+
+    def _backward_impl(self, out_grads=None, is_train=True):
         if self._vjp is None:
             raise RuntimeError("call forward(is_train=True) before backward()")
         if out_grads is None:

@@ -9,9 +9,11 @@ device. num_workers>0 selects the threaded prefetch path.
 from __future__ import annotations
 
 import concurrent.futures as _futures
+import time as _time
 
 import numpy as np
 
+from ... import telemetry as _telemetry
 from ...ndarray.ndarray import NDArray
 from ...ndarray import array as nd_array
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
@@ -53,6 +55,26 @@ class DataLoader:
         self._prefetch = max(0, prefetch or 2 * max(num_workers, 1))
 
     def __iter__(self):
+        it = self._iter_impl()
+        if not _telemetry.enabled():
+            yield from it
+            return
+        # batch-fetch latency as the consumer sees it: time blocked in
+        # next() — includes batchify for the serial path and result-wait
+        # for the prefetched path (a well-fed pipeline reads near zero)
+        while True:
+            t0 = _time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            _telemetry.observe(
+                "mxtpu_dataloader_fetch_seconds",
+                _time.perf_counter() - t0,
+                help="Time the training loop blocked fetching a batch.")
+            yield batch
+
+    def _iter_impl(self):
         if self._num_workers == 0:
             for batch in self._batch_sampler:
                 yield self._batchify_fn([self._dataset[i] for i in batch])
@@ -81,6 +103,10 @@ class DataLoader:
                 nxt = submit()
                 if nxt is not None:
                     pending.append(nxt)
+                _telemetry.set_gauge(
+                    "mxtpu_dataloader_queue_depth", len(pending),
+                    help="Prefetch batches in flight (0 = pipeline "
+                         "starved, consumer about to block).")
                 yield f.result()
 
     def __len__(self):

@@ -7,8 +7,11 @@ performed during backward; kvstore remains for dist (multi-host) setups.
 """
 from __future__ import annotations
 
+import time
+
 from .. import optimizer as opt
 from .. import kvstore as kvs
+from .. import telemetry as _telemetry
 from .parameter import ParameterDict
 
 __all__ = ["Trainer"]
@@ -95,6 +98,10 @@ class Trainer:
     def allreduce_grads(self):
         """(ref: trainer.py:327) — multi-host sum via kvstore; intra-host is
         already reduced by GSPMD."""
+        with _telemetry.span("trainer.allreduce_grads"):
+            self._allreduce_grads_impl()
+
+    def _allreduce_grads_impl(self):
         self._init_kvstore()
         if self._update_on_kvstore:
             raise ValueError(
@@ -129,6 +136,22 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """(ref: trainer.py:298)"""
+        if not _telemetry.enabled():
+            return self._step_impl(batch_size, ignore_stale_grad)
+        t0 = time.perf_counter()
+        with _telemetry.span("trainer.step"):
+            try:
+                return self._step_impl(batch_size, ignore_stale_grad)
+            finally:
+                _telemetry.observe(
+                    "mxtpu_trainer_step_seconds", time.perf_counter() - t0,
+                    help="End-to-end Trainer.step latency (allreduce + "
+                         "optimizer update; excludes forward/backward).")
+                # step boundary: the agreed sampling point for device
+                # memory watermarks (MXNET_TELEMETRY_MEM_INTERVAL)
+                _telemetry.step_boundary()
+
+    def _step_impl(self, batch_size, ignore_stale_grad=False):
         # rescale BEFORE _init_kvstore: server mode pickles the optimizer at
         # init, so the scale must already be baked in on the first step
         rescale = self._scale / batch_size

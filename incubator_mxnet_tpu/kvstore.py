@@ -17,6 +17,7 @@ Module/Trainer code ports unchanged; the transport is different by design:
 """
 from __future__ import annotations
 
+import functools
 import os
 import pickle
 import tempfile
@@ -27,6 +28,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import telemetry as _telemetry
 from .ndarray.ndarray import NDArray
 from .ndarray.sparse import RowSparseNDArray
 
@@ -616,6 +618,71 @@ def _key_int(key):
     if isinstance(key, int):
         return key
     return key
+
+
+# ---------------------------------------------------------------------------
+# telemetry instrumentation: bytes + latency per scalar-key push/pull.
+# Wrapping happens per class __dict__ so an inherited (already-wrapped)
+# method is never wrapped twice, and list-key calls pass through untimed —
+# they recurse into scalar calls which ARE timed, so nothing double-counts.
+# ---------------------------------------------------------------------------
+
+_KV_SECONDS = "mxtpu_kvstore_seconds"
+_KV_BYTES = "mxtpu_kvstore_bytes_total"
+
+
+def _payload_nbytes(value):
+    """Bytes of an NDArray / sparse NDArray / raw array payload (or a list
+    of them) without materializing anything on host."""
+    total = 0
+    for v in value if isinstance(value, (list, tuple)) else [value]:
+        if v is None:
+            continue
+        if hasattr(v, "data") and hasattr(v, "indices"):  # sparse: the wire
+            total += _payload_nbytes([v.data, v.indices])  # payload is rows
+            total += _payload_nbytes(getattr(v, "indptr", None))  # + indices
+            continue
+        data = getattr(v, "_data", v)
+        nbytes = getattr(data, "nbytes", None)
+        if nbytes is None:
+            shape = getattr(data, "shape", None)
+            if shape is None:
+                continue
+            itemsize = getattr(getattr(data, "dtype", None), "itemsize", 4)
+            nbytes = int(np.prod(shape)) * itemsize if shape else itemsize
+        total += int(nbytes)
+    return total
+
+
+def _instrument_kv(op, method):
+    @functools.wraps(method)
+    def wrapped(self, key, *args, **kwargs):
+        if not _telemetry.enabled() or isinstance(key, (list, tuple)):
+            return method(self, key, *args, **kwargs)
+        t0 = time.perf_counter()
+        try:
+            return method(self, key, *args, **kwargs)
+        finally:
+            _telemetry.observe(
+                _KV_SECONDS, time.perf_counter() - t0,
+                help="Latency of scalar-key kvstore operations.",
+                op=op, store=self.type)
+            payload = kwargs.get("value" if op == "push" else "out",
+                                 args[0] if args else None)
+            nbytes = _payload_nbytes(payload)
+            if nbytes:
+                _telemetry.inc(
+                    _KV_BYTES, nbytes,
+                    help="Payload bytes through kvstore push/pull.",
+                    op=op, store=self.type)
+    return wrapped
+
+
+for _cls in (KVStore, KVStoreDist, KVStoreDistAsync, KVStoreDistAsyncServer):
+    for _op in ("push", "pull"):
+        if _op in _cls.__dict__:
+            setattr(_cls, _op, _instrument_kv(_op, _cls.__dict__[_op]))
+del _cls, _op
 
 
 class _TcpHeartbeat:

@@ -17,8 +17,8 @@ __all__ = [
     "set_config", "set_state", "dump", "dumps", "pause", "resume", "Task",
     "Frame", "Event", "Counter", "Marker", "Domain", "scope",
     "aggregate_enabled",
-    "timed_invoke", "reset_stats", "memory_analysis", "record_memory",
-    "dumps_memory",
+    "timed_invoke", "record_duration", "reset_stats", "memory_analysis",
+    "record_memory", "dumps_memory",
 ]
 
 _CONFIG = {"filename": "profile.json", "profile_all": False,
@@ -80,8 +80,15 @@ def timed_invoke(op_name, call, *args, **kwargs):
                 data.block_until_ready()
     except Exception:
         pass  # timing must never break the op itself
-    _AGG_STATS.setdefault(op_name, _OpStat()).add(_time.perf_counter() - t0)
+    record_duration(op_name, _time.perf_counter() - t0)
     return results
+
+
+def record_duration(op_name, dur):
+    """Charge `dur` seconds to `op_name` in the aggregate table. Also the
+    sink telemetry spans feed when aggregate stats are on — one table, not
+    two (see telemetry/spans.py)."""
+    _AGG_STATS.setdefault(op_name, _OpStat()).add(dur)
 
 
 def reset_stats():
@@ -111,11 +118,14 @@ def dumps(reset=False, sort_by="total", ascending=False):
         f"{'Max(ms)':>10s} {'Avg(ms)':>10s}",
         "-" * 94,
     ]
+    if not rows:
+        lines.append("(no ops recorded)")
     for name, s in rows:
         avg = s.total / max(s.count, 1)
+        mn = 0.0 if s.count == 0 else s.min  # never render the inf sentinel
         lines.append(
             f"{name[:40]:<40s} {s.count:>8d} {s.total * 1e3:>12.3f} "
-            f"{s.min * 1e3:>10.3f} {s.max * 1e3:>10.3f} {avg * 1e3:>10.3f}")
+            f"{mn * 1e3:>10.3f} {s.max * 1e3:>10.3f} {avg * 1e3:>10.3f}")
     if reset:
         reset_stats()
     return "\n".join(lines)

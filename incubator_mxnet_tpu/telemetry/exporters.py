@@ -1,0 +1,157 @@
+"""Exporters: JSON dump, Prometheus text exposition, /metrics endpoint.
+
+`to_dict()`/`dump_json()` give a round-trippable JSON view of the whole
+registry; `prometheus_text()` renders text exposition format 0.0.4
+(the format every Prometheus/VictoriaMetrics/Grafana-agent scraper
+speaks); `start_http_server()` serves it from a stdlib daemon thread —
+no third-party client library, per the no-new-deps constraint.
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+from .metrics import REGISTRY
+
+__all__ = ["to_dict", "dump_json", "prometheus_text", "start_http_server"]
+
+
+def _fmt(value):
+    """Prometheus sample value: integers render bare, floats via repr
+    (repr round-trips; exposition format accepts scientific notation)."""
+    f = float(value)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _escape_label(value):
+    return (str(value).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def _render_labels(labels, extra=None):
+    items = list(labels.items())
+    if extra:
+        items += list(extra.items())
+    if not items:
+        return ""
+    body = ",".join(f'{k}="{_escape_label(v)}"' for k, v in items)
+    return "{" + body + "}"
+
+
+def to_dict(registry=None):
+    """Registry snapshot as plain JSON-serializable data. Histograms carry
+    count/sum/min/max plus per-upper-bound bucket counts (non-cumulative;
+    the exposition renderer cumulates)."""
+    registry = registry or REGISTRY
+    metrics = {}
+    for metric in registry.collect():
+        series = []
+        for labels, child in metric.series():
+            if metric.kind == "histogram":
+                bounds, buckets, count, total, mn, mx = child.snapshot()
+                series.append({
+                    "labels": labels,
+                    "count": count,
+                    "sum": total,
+                    "min": mn,
+                    "max": mx,
+                    "buckets": {str(b): n for b, n in zip(bounds, buckets)},
+                    "overflow": buckets[-1],  # observations above max bound
+                })
+            else:
+                series.append({"labels": labels, "value": child.value})
+        metrics[metric.name] = {
+            "type": metric.kind,
+            "help": metric.help,
+            "series": series,
+        }
+    return {"version": 1, "metrics": metrics}
+
+
+def dump_json(path=None, registry=None):
+    """Snapshot the registry; when `path` is given also write it as JSON.
+    Returns the snapshot dict either way."""
+    data = to_dict(registry)
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(data, f, indent=2, sort_keys=True)
+    return data
+
+
+def prometheus_text(registry=None):
+    """Text exposition format 0.0.4. Histogram buckets are cumulative and
+    always include le="+Inf"; counters keep whatever name they were
+    registered under (instrumented sites use the `_total` convention)."""
+    registry = registry or REGISTRY
+    lines = []
+    for metric in registry.collect():
+        lines.append(f"# HELP {metric.name} {metric.help}")
+        lines.append(f"# TYPE {metric.name} {metric.kind}")
+        for labels, child in metric.series():
+            if metric.kind == "histogram":
+                bounds, buckets, count, total, _mn, _mx = child.snapshot()
+                cum = 0
+                for b, n in zip(bounds, buckets):
+                    cum += n
+                    lines.append(
+                        f"{metric.name}_bucket"
+                        f"{_render_labels(labels, {'le': _fmt(b)})} {cum}")
+                lines.append(
+                    f"{metric.name}_bucket"
+                    f"{_render_labels(labels, {'le': '+Inf'})} {count}")
+                lines.append(
+                    f"{metric.name}_sum{_render_labels(labels)} {_fmt(total)}")
+                lines.append(
+                    f"{metric.name}_count{_render_labels(labels)} {count}")
+            else:
+                lines.append(
+                    f"{metric.name}{_render_labels(labels)} "
+                    f"{_fmt(child.value)}")
+    return "\n".join(lines) + "\n"
+
+
+class _MetricsServer:
+    """Stdlib HTTP server answering GET /metrics with the exposition text.
+    Daemon-threaded; `close()` for deterministic shutdown in tests."""
+
+    def __init__(self, port, registry=None, host="0.0.0.0"):
+        import http.server
+
+        registry = registry or REGISTRY
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.split("?")[0] in ("/metrics", "/"):
+                    body = prometheus_text(outer.registry).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_error(404)
+
+            def log_message(self, *args):
+                pass  # scrapes must not spam the training logs
+
+        self.registry = registry
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="mxtpu-telemetry-http")
+        self._thread.start()
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def start_http_server(port, registry=None, host="0.0.0.0"):
+    """Serve Prometheus exposition at http://host:port/metrics (port 0
+    picks an ephemeral port; read it back from the returned server)."""
+    return _MetricsServer(port, registry, host)

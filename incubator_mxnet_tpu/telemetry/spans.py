@@ -1,0 +1,95 @@
+"""Tracing spans: nested wall-time regions that feed three sinks at once.
+
+A span records its duration into the metrics registry
+(`mxtpu_span_seconds{span=...}`), forwards to
+`jax.profiler.TraceAnnotation` when a jax trace is running (so spans line
+up with the XLA device timeline in TensorBoard/Perfetto), and accumulates
+into the profiler's per-op aggregate table when `aggregate_stats` is on —
+unifying with `profiler.dumps()` instead of growing a second table.
+
+Nesting is tracked per-thread; `current_span()` exposes the innermost
+active span (its `parent` chain gives the full stack).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import profiler as _profiler
+from .metrics import REGISTRY
+
+__all__ = ["Span", "current_span", "SPAN_HISTOGRAM"]
+
+SPAN_HISTOGRAM = "mxtpu_span_seconds"
+_SPAN_HELP = ("Wall time of named host-side spans (executor forward/backward,"
+              " trainer step, ...); tags become extra labels.")
+
+_local = threading.local()
+
+
+def current_span():
+    """Innermost active span on this thread, or None."""
+    return getattr(_local, "current", None)
+
+
+class Span:
+    """Context manager for one timed region. Re-enterable is NOT supported
+    (create a fresh Span per region); re-use across threads is not either —
+    both mirror TraceAnnotation's contract."""
+
+    __slots__ = ("name", "tags", "parent", "_t0", "_annot")
+
+    def __init__(self, name, tags=None):
+        self.name = name
+        self.tags = dict(tags or {})
+        self.parent = None
+        self._t0 = None
+        self._annot = None
+
+    def __enter__(self):
+        self.parent = getattr(_local, "current", None)
+        _local.current = self
+        if _profiler._STATE["running"]:
+            try:
+                self._annot = _profiler.scope(self.name)
+                self._annot.__enter__()
+            except Exception:
+                self._annot = None  # tracing must never break the workload
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        dur = time.perf_counter() - self._t0
+        if self._annot is not None:
+            try:
+                self._annot.__exit__(exc_type, exc_val, exc_tb)
+            except Exception:
+                pass
+            self._annot = None
+        _local.current = self.parent
+        labels = {"span": self.name}
+        for k, v in self.tags.items():
+            labels[str(k)] = str(v)
+        REGISTRY.histogram(SPAN_HISTOGRAM, _SPAN_HELP).observe(dur, **labels)
+        if _profiler.aggregate_enabled():
+            _profiler.record_duration(self.name, dur)
+        return False
+
+
+class NoopSpan:
+    """Shared do-nothing span for the disabled path: one module-level
+    instance, safe to re-enter from any thread."""
+
+    __slots__ = ()
+    name = None
+    tags = {}
+    parent = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NOOP_SPAN = NoopSpan()

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Telemetry CI smoke: run a tiny train loop with telemetry off and on,
+assert the JSON/Prometheus dumps parse, and assert the disabled path adds
+<5% wall time over the enabled run (i.e. the no-op stubs really
+short-circuit — disabled must never be the slower configuration).
+
+Usage: python tools/telemetry_smoke.py [steps]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd, telemetry
+from incubator_mxnet_tpu.gluon import nn
+
+TOLERANCE = 1.05  # disabled wall time must stay within 5% of enabled
+REPEATS = 5       # best-of-N to shave scheduler noise
+
+
+def build():
+    np.random.seed(0)
+    X = np.random.randn(64, 8).astype("float32")
+    Y = np.random.randn(64, 1).astype("float32")
+    dataset = gluon.data.ArrayDataset(nd.array(X), nd.array(Y))
+    net = nn.Dense(1, in_units=8)
+    net.initialize(mx.init.Normal(0.1))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01})
+    return dataset, net, trainer, gluon.loss.L2Loss()
+
+
+def run_loop(dataset, net, trainer, loss_fn, kv, params):
+    for x, y in gluon.data.DataLoader(dataset, batch_size=16):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        for i, p in enumerate(params):
+            g = p.grad()
+            kv.pushpull(i, g, out=g)
+        trainer.step(16)
+    mx.engine.waitall()
+
+
+def timed(n, *args):
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        run_loop(*args)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    steps = int(sys.argv[1]) if len(sys.argv) > 1 else REPEATS
+    dataset, net, trainer, loss_fn = build()
+    kv = mx.kv.create("local")
+    params = list(net.collect_params().values())
+    args = (dataset, net, trainer, loss_fn, kv, params)
+
+    run_loop(*args)  # warm the jit caches before any timing
+
+    telemetry.disable()
+    t_off = timed(steps, *args)
+
+    telemetry.REGISTRY.reset()
+    telemetry.enable()
+    t_on = timed(steps, *args)
+
+    # exporters must produce parseable output from the enabled run
+    data = telemetry.dump_json()
+    json.loads(json.dumps(data))
+    for name in ("mxtpu_trainer_step_seconds", "mxtpu_kvstore_bytes_total",
+                 "mxtpu_dataloader_fetch_seconds"):
+        assert name in data["metrics"], f"missing series {name}"
+    text = telemetry.prometheus_text()
+    assert "# TYPE mxtpu_trainer_step_seconds histogram" in text
+    for line in text.rstrip("\n").splitlines():
+        if not line.startswith("#"):
+            metric, value = line.rsplit(" ", 1)
+            float(value)  # every sample value parses
+            assert metric.strip(), line
+    telemetry.disable()
+
+    print(f"telemetry smoke: off={t_off * 1e3:.2f}ms "
+          f"on={t_on * 1e3:.2f}ms (best of {steps})")
+    assert t_off <= t_on * TOLERANCE, (
+        f"disabled path is >{(TOLERANCE - 1) * 100:.0f}% slower than "
+        f"enabled ({t_off:.4f}s vs {t_on:.4f}s) — no-op stubs are not "
+        f"short-circuiting")
+    print("telemetry smoke OK")
+
+
+if __name__ == "__main__":
+    main()
