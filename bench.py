@@ -397,7 +397,94 @@ def _probe_accelerator(timeout=150, exec_check=False):
         return False
 
 
+def dispatch_overhead_main(assert_mode=False):
+    """Eager Trainer dispatch-overhead microbench: a ~200-parameter dense
+    stack stepped with aggregated multi-tensor updates vs the per-param
+    loop (MXNET_OPTIMIZER_AGGREGATION_SIZE=0). Dispatch counts come from
+    the mxtpu_trainer_dispatches_total counter; --assert additionally
+    requires strictly fewer aggregated dispatches AND identical final
+    weights (the CI aggregation smoke tier)."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, gluon, autograd, telemetry
+    from incubator_mxnet_tpu.gluon import nn
+
+    n_layers = int(os.environ.get("BENCH_DISPATCH_LAYERS", "100"))
+    width = int(os.environ.get("BENCH_DISPATCH_WIDTH", "8"))
+    steps = int(os.environ.get("BENCH_DISPATCH_STEPS", "5"))
+    telemetry.enable()
+
+    def build():
+        net = nn.Sequential()
+        for _ in range(n_layers):
+            net.add(nn.Dense(width))
+        net.initialize(mx.init.Xavier())
+        net(nd.ones((2, width)))
+        rng = np.random.RandomState(7)
+        for p in net.collect_params().values():
+            p.set_data(nd.array(
+                rng.uniform(-0.05, 0.05, size=p.shape).astype("float32")))
+        return net
+
+    def run(agg):
+        os.environ["MXNET_OPTIMIZER_AGGREGATION_SIZE"] = \
+            "4096" if agg else "0"
+        net = build()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05, "momentum": 0.9})
+        rng = np.random.RandomState(11)
+        xs = [nd.array(rng.uniform(-1, 1, size=(4, width)).astype("float32"))
+              for _ in range(steps)]
+
+        def one_epoch():
+            for x in xs:
+                with autograd.record():
+                    loss = (net(x) ** 2).sum()
+                loss.backward()
+                tr.step(4)
+            loss.asnumpy()  # close the async chain before timing
+
+        one_epoch()  # warmup: compiles every program involved
+        c = telemetry.counter("mxtpu_trainer_dispatches_total")
+        path = "aggregated" if agg else "per_param"
+        before = c.value(kind="optimizer_update", path=path)
+        t0 = time.perf_counter()
+        one_epoch()
+        dt = time.perf_counter() - t0
+        dispatches = c.value(kind="optimizer_update", path=path) - before
+        weights = np.concatenate([p.data().asnumpy().ravel()
+                                  for p in net.collect_params().values()])
+        return dt, dispatches, weights, len(list(net.collect_params()))
+
+    eager_s, eager_n, eager_w, n_params = run(agg=False)
+    agg_s, agg_n, agg_w, _ = run(agg=True)
+    match = bool(np.allclose(eager_w, agg_w, rtol=1e-5, atol=1e-7))
+    out = {
+        "metric": "trainer_dispatch_overhead",
+        "value": round(eager_s / agg_s, 3) if agg_s > 0 else 0.0,
+        "unit": "x_step_speedup_aggregated_vs_per_param",
+        "params": n_params,
+        "steps": steps,
+        "per_param_dispatches": int(eager_n),
+        "aggregated_dispatches": int(agg_n),
+        "per_param_s": round(eager_s, 4),
+        "aggregated_s": round(agg_s, 4),
+        "weights_match": match,
+    }
+    print(json.dumps(out), flush=True)
+    if assert_mode:
+        assert agg_n < eager_n, (
+            f"aggregation did not reduce dispatches: {agg_n} vs {eager_n}")
+        assert agg_n <= steps * max(1, n_params // 50), (
+            f"aggregated path issued {agg_n} dispatches for {steps} steps — "
+            "expected O(num_buckets) per step")
+        assert match, "aggregated and per-param weights diverged"
+
+
 def main():
+    if "--dispatch-overhead" in sys.argv or os.environ.get("BENCH_DISPATCH"):
+        dispatch_overhead_main(assert_mode="--assert" in sys.argv)
+        return
     if os.environ.get("BENCH_CHILD"):
         child_main()
         return

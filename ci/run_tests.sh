@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
 # CI tiers (ref: ci/docker/runtime_functions.sh — unittest / nightly /
-# distributed stages). Usage: ci/run_tests.sh [unit|nightly|dist|examples|all]
+# distributed stages). Usage:
+#   ci/run_tests.sh [unit|nightly|dist|examples|telemetry|aggregation|all]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -52,6 +53,14 @@ run_telemetry() {
     python tools/telemetry_smoke.py
 }
 
+run_aggregation() {
+    echo "=== aggregation smoke (dispatch counts + aggregated==eager weights) ==="
+    # ~200-param model stepped both ways on CPU; asserts (via the
+    # mxtpu_trainer_dispatches_total counter) strictly fewer dispatches on
+    # the aggregated path and bit-identical final weights
+    JAX_PLATFORMS=cpu python bench.py --dispatch-overhead --assert
+}
+
 run_nightly() {
     echo "=== nightly tier (large tensors, checkpoint compat, 7-worker dist) ==="
     MXTPU_NIGHTLY=1 python -m pytest tests/test_large_array.py \
@@ -76,8 +85,9 @@ case "$tier" in
     examples)  run_examples ;;
     suite)     run_suite ;;
     telemetry) run_telemetry ;;
+    aggregation) run_aggregation ;;
     nightly)   run_nightly ;;
-    all)       run_unit; run_telemetry; run_dist; run_examples; run_nightly ;;
-    *) echo "unknown tier: $tier (unit|nightly|dist|examples|suite|telemetry|all)"; exit 2 ;;
+    all)       run_unit; run_telemetry; run_aggregation; run_dist; run_examples; run_nightly ;;
+    *) echo "unknown tier: $tier (unit|nightly|dist|examples|suite|telemetry|aggregation|all)"; exit 2 ;;
 esac
 echo "tier '$tier' green"

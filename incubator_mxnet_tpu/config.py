@@ -81,6 +81,35 @@ register_knob("MXTPU_EAGER_JIT", False, bool,
               "XLA then re-specializes per input shape). Recommended for "
               "steady-shape eager loops on TPU; off by default because "
               "shape-diverse workloads pay a compile per new shape.")
+register_knob("MXTPU_EAGER_JIT_CACHE_SIZE", 512, int,
+              "LRU capacity of the eager-dispatch jit cache (entries; "
+              "0 = unbounded). Each entry is one (op, attrs) jitted "
+              "callable plus XLA's per-shape executables behind it; "
+              "shape-diverse eager workloads otherwise grow the cache "
+              "without limit. Read from the environment at insert time "
+              "so tests can retune it at runtime; current size is "
+              "exported as the mxtpu_eager_jit_cache_size gauge.")
+
+# optimizer / trainer aggregation
+register_knob("MXNET_OPTIMIZER_AGGREGATION_SIZE", 4096, int,
+              "Byte cap (in KB) of one aggregated optimizer-update bucket "
+              "on the eager Trainer path: parameters are grouped into "
+              "dtype-homogeneous buckets of at most this many KB and each "
+              "bucket is updated by ONE jitted multi-tensor program "
+              "instead of one dispatch per parameter (ref: the reference's "
+              "knob of the same name, which counts tensors — default 4 — "
+              "because its cost was kernel launches; here the cost is XLA "
+              "program dispatches, so the cap is bytes). 0 disables "
+              "aggregation (always per-param dispatch).")
+register_knob("MXTPU_ALLREDUCE_BUCKET_KB", 4096, int,
+              "Byte cap (in KB) of one gradient-allreduce bucket in "
+              "Trainer.allreduce_grads: dense gradients are flattened into "
+              "contiguous buckets of at most this many KB and each bucket "
+              "crosses the kvstore as ONE pushpull instead of one per "
+              "tensor (ref role: MXNET_KVSTORE_BIGARRAY_BOUND, the "
+              "reference's comms-granularity knob). Sparse (row_sparse) "
+              "gradients and compressed-gradient stores stay on the "
+              "per-key path. 0 disables bucketing.")
 
 # data / IO
 register_knob("MXTPU_PREFETCH_BUFFER", 2, int,
@@ -163,7 +192,9 @@ SUBSUMED = {
     "MXNET_BACKWARD_DO_MIRROR": "jax.checkpoint / remat policies",
     "MXNET_EXEC_INPLACE_GRAD_SUM_CAP": "XLA fusion of gradient sums",
     "MXNET_KVSTORE_REDUCTION_NTHREADS": "ICI collective all-reduce",
-    "MXNET_KVSTORE_BIGARRAY_BOUND": "GSPMD sharding decides partitioning",
+    "MXNET_KVSTORE_BIGARRAY_BOUND": "GSPMD sharding decides partitioning; "
+                                    "the comms-granularity role lives on as "
+                                    "MXTPU_ALLREDUCE_BUCKET_KB",
     "MXNET_KVSTORE_USETREE": "XLA collective scheduling over ICI topology",
     "MXNET_CUDNN_AUTOTUNE_DEFAULT": "XLA autotuning at compile time",
     "MXNET_SUBGRAPH_BACKEND": "XLA fusion passes",

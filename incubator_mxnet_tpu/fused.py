@@ -31,21 +31,9 @@ from . import autograd
 from . import random as _global_random
 from .gluon.block import _ParamSubst
 from .ndarray.ndarray import NDArray
+from .optimizer import _cast_state_like as _cast_like
 
 __all__ = ["GluonTrainStep"]
-
-
-def _cast_like(new_state, old_state):
-    """Cast an optimizer-state pytree leaf-wise back to its pre-update
-    dtypes (None / array / tuple-of-arrays — the shapes create_state
-    produces). Keeps the scan carry dtype-stable for bf16-cast nets."""
-    if new_state is None or old_state is None:
-        return new_state
-    if isinstance(new_state, tuple):
-        return tuple(
-            n if o is None else n.astype(o.dtype)
-            for n, o in zip(new_state, old_state))
-    return new_state.astype(old_state.dtype)
 
 
 class GluonTrainStep:

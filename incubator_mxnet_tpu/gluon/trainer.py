@@ -4,17 +4,44 @@ step:298, allreduce_grads:327, update:359).
 TPU-native: gradients live in single (mesh-replicated) arrays, so the
 per-device reduce of the reference collapses to the GSPMD all-reduce already
 performed during backward; kvstore remains for dist (multi-host) setups.
+
+Aggregated dispatch: the classic eager step issues O(2·P) tiny XLA programs
+— one updater call per parameter plus one allreduce per gradient. Both
+loops are bucketed here (ref: the reference's MXNET_OPTIMIZER_AGGREGATION_SIZE
+aggregation through multi_sgd_update et al., src/operator/optimizer_op.cc:318,
+and MXNET_KVSTORE_BIGARRAY_BOUND comms chunking): parameters are grouped
+into dtype-homogeneous byte-capped buckets, each bucket's update runs as
+ONE jitted multi-tensor program reusing the exact fused_update math every
+built-in optimizer ships, and each bucket's dense gradients cross the
+kvstore as ONE flattened pushpull. Tune with
+MXNET_OPTIMIZER_AGGREGATION_SIZE / MXTPU_ALLREDUCE_BUCKET_KB (0 disables
+either); dispatch counts are observable via mxtpu_trainer_dispatches_total.
 """
 from __future__ import annotations
 
+import math
 import time
 
+import jax
+import jax.numpy as jnp
+
+from .. import config as _config
 from .. import optimizer as opt
 from .. import kvstore as kvs
 from .. import telemetry as _telemetry
+from ..ndarray.ndarray import NDArray
 from .parameter import ParameterDict
 
 __all__ = ["Trainer"]
+
+_DISPATCHES = "mxtpu_trainer_dispatches_total"
+_DISPATCH_HELP = (
+    "XLA program dispatches issued by the eager Trainer, by kind "
+    "(optimizer_update | allreduce) and path (aggregated/bucketed = one "
+    "per bucket; per_param/per_key = one per tensor).")
+_BUCKET_BYTES = "mxtpu_trainer_bucket_bytes"
+_BUCKET_HELP = ("Payload bytes of one aggregated-dispatch bucket "
+                "(kind: optimizer_update | allreduce).")
 
 
 class Trainer:
@@ -39,6 +66,18 @@ class Trainer:
         self._kv_initialized = False
         self._update_on_kvstore = update_on_kvstore
         self._kv_shipped_rescale = None
+        # aggregated dispatch: byte caps resolved once at construction (KB
+        # knobs; 0 disables), bucket layout re-derived whenever the
+        # parameter signature (dtype/shape/stype set) changes
+        self._agg_bytes = max(
+            0, int(_config.get("MXNET_OPTIMIZER_AGGREGATION_SIZE"))) * 1024
+        self._allreduce_bucket_bytes = max(
+            0, int(_config.get("MXTPU_ALLREDUCE_BUCKET_KB"))) * 1024
+        self._agg_sig = None
+        self._agg_buckets = []
+        self._agg_rest = []
+        self._agg_fn_cache = {}
+        self._flat_fn_cache = {}
 
     @property
     def learning_rate(self):
@@ -108,11 +147,94 @@ class Trainer:
                 "allreduce_grads() is not supported when the optimizer "
                 "runs on the kvstore server; call step() "
                 "(ref: trainer.py:333)")
-        if self._kvstore is not None:
+        if self._kvstore is None:
+            return
+        kv = self._kvstore
+        cap = self._allreduce_bucket_bytes
+        if (cap <= 0
+                or not getattr(kv, "supports_bucketed_allreduce", False)
+                or getattr(kv, "_compression", None) is not None):
+            # per-key path: bucketing disabled, or the store keeps per-key
+            # state (async mix counters) / applies per-key compression —
+            # flattening through a synthetic key would bypass both
             for i, p in enumerate(self._params):
                 g = p.grad()
                 # merge-and-reset one-shot allreduce (no cross-step carry)
-                self._kvstore.pushpull(i, g, out=g)
+                kv.pushpull(i, g, out=g)
+            _telemetry.inc(_DISPATCHES, len(self._params), kind="allreduce",
+                           path="per_key", help=_DISPATCH_HELP)
+            return
+        from ..ndarray.sparse import BaseSparseNDArray
+
+        dense = []
+        for i, p in enumerate(self._params):
+            g = p.grad()
+            if isinstance(g, BaseSparseNDArray):
+                # sparse stays per-key: the store's row_sparse allreduce
+                # needs the (indices, data) structure intact
+                kv.pushpull(i, g, out=g)
+                _telemetry.inc(_DISPATCHES, 1, kind="allreduce",
+                               path="per_key", help=_DISPATCH_HELP)
+            else:
+                dense.append((i, g))
+        buckets = []
+        cur, cur_bytes, cur_dt = [], 0, None
+        for i, g in dense:
+            nb = g._data.nbytes
+            dt = str(g._data.dtype)
+            if cur and (dt != cur_dt or cur_bytes + nb > cap):
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append((i, g))
+            cur_bytes += nb
+            cur_dt = dt
+        if cur:
+            buckets.append(cur)
+        for j, bucket in enumerate(buckets):
+            if len(bucket) == 1:
+                i, g = bucket[0]
+                kv.pushpull(i, g, out=g)
+                payload = g._data.nbytes
+            else:
+                fl, unfl = self._flat_fns(tuple(
+                    (tuple(g._data.shape), str(g._data.dtype))
+                    for _, g in bucket))
+                flat = NDArray(fl([g._data for _, g in bucket]))
+                payload = flat._data.nbytes
+                # one synthetic key per bucket; the merge-and-reset store
+                # deletes it after the pull, so steps never cross-talk
+                kv.pushpull(f"__agg_bucket_{j}", flat, out=flat)
+                for (_, g), piece in zip(bucket, unfl(flat._data)):
+                    g._data = piece
+            _telemetry.inc(_DISPATCHES, 1, kind="allreduce",
+                           path="bucketed", help=_DISPATCH_HELP)
+            _telemetry.observe(_BUCKET_BYTES, payload, help=_BUCKET_HELP,
+                               buckets=_telemetry.BYTES_BUCKETS,
+                               kind="allreduce")
+
+    def _flat_fns(self, key):
+        """Jitted (flatten, unflatten) pair for one bucket layout; slices
+        and reshapes are baked, so each is a single fused program."""
+        fns = self._flat_fn_cache.get(key)
+        if fns is None:
+            shapes = [s for s, _ in key]
+            offs = []
+            off = 0
+            for s in shapes:
+                n = int(math.prod(s))
+                offs.append((off, off + n))
+                off += n
+
+            def fl(datas):
+                return jnp.concatenate([d.ravel() for d in datas])
+
+            def unfl(flat):
+                return [flat[a:b].reshape(s)
+                        for (a, b), s in zip(offs, shapes)]
+
+            fns = (jax.jit(fl), jax.jit(unfl))
+            self._flat_fn_cache[key] = fns
+        return fns
 
     def _amp_pre_update(self, rescale):
         """(skip_step, effective_rescale): overflow-skip + unscale factor
@@ -195,11 +317,294 @@ class Trainer:
         self._optimizer.rescale_grad = eff
         self._update(ignore_stale_grad)
 
+    # -- aggregated multi-tensor update path --------------------------------
+
+    def _aggregation_supported(self):
+        """Aggregation needs a dedicated fused_update that reproduces the
+        eager update step-for-step; custom optimizers inherit the base
+        generic hook and stay on the per-param path."""
+        if self._agg_bytes <= 0:
+            return False
+        o = self._optimizer
+        if self._updater.optimizer is not o:
+            # load_states(dump_optimizer=True) style divergence — the eager
+            # updater would use a different optimizer than we would
+            return False
+        return (type(o).fused_update is not opt.Optimizer.fused_update
+                and getattr(o, "fused_matches_eager", True))
+
     def _update(self, ignore_stale_grad=False):
+        if not ignore_stale_grad and self._aggregation_supported():
+            self._update_aggregated()
+            return
+        n = 0
         for i, p in enumerate(self._params):
             if p._data is None:
                 continue
             self._updater(i, p.grad(), p.data())
+            n += 1
+        _telemetry.inc(_DISPATCHES, n, kind="optimizer_update",
+                       path="per_param", help=_DISPATCH_HELP)
+
+    def _bucket_signature(self):
+        sig = []
+        for p in self._params:
+            d = p._data
+            if d is None:
+                sig.append(None)
+            else:
+                sig.append((str(d._data.dtype), tuple(d._data.shape),
+                            getattr(p, "stype", "default"),
+                            getattr(p, "grad_stype", "default")))
+        return tuple(sig)
+
+    def _build_update_buckets(self):
+        """Greedy in-order grouping into dtype-homogeneous byte-capped
+        buckets (ref: the reference's aggregation by MXNET_OPTIMIZER_
+        AGGREGATION_SIZE); sparse-typed params go to the per-param rest."""
+        buckets, rest = [], []
+        cur, cur_bytes, cur_dt = [], 0, None
+        for i, p in enumerate(self._params):
+            if p._data is None:
+                continue
+            if (getattr(p, "stype", "default") != "default"
+                    or getattr(p, "grad_stype", "default") != "default"):
+                rest.append(i)
+                continue
+            d = p._data._data
+            nb = d.nbytes
+            dt = str(d.dtype)
+            if cur and (dt != cur_dt or cur_bytes + nb > self._agg_bytes):
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            cur_bytes += nb
+            cur_dt = dt
+        if cur:
+            buckets.append(cur)
+        return buckets, rest
+
+    def _update_aggregated(self):
+        from ..ndarray.sparse import BaseSparseNDArray
+
+        sig = self._bucket_signature()
+        if sig != self._agg_sig:
+            self._agg_buckets, self._agg_rest = self._build_update_buckets()
+            self._agg_sig = sig
+            self._agg_fn_cache.clear()
+        for bid, bucket in enumerate(self._agg_buckets):
+            grads = [self._params[i].grad() for i in bucket]
+            if any(isinstance(g, BaseSparseNDArray) for g in grads):
+                # a dense-typed param still produced a sparse grad — the
+                # lazy-update semantics only exist on the per-param path
+                for i in bucket:
+                    p = self._params[i]
+                    self._updater(i, p.grad(), p.data())
+                _telemetry.inc(_DISPATCHES, len(bucket),
+                               kind="optimizer_update", path="per_param",
+                               help=_DISPATCH_HELP)
+                continue
+            self._dispatch_bucket(bid, bucket, grads)
+        for i in self._agg_rest:
+            p = self._params[i]
+            self._updater(i, p.grad(), p.data())
+        if self._agg_rest:
+            _telemetry.inc(_DISPATCHES, len(self._agg_rest),
+                           kind="optimizer_update", path="per_param",
+                           help=_DISPATCH_HELP)
+
+    def _dispatch_bucket(self, bid, bucket, grads):
+        o = self._optimizer
+        u = self._updater
+        weights = [self._params[i].data() for i in bucket]
+        for i, w in zip(bucket, weights):
+            if i not in u.states:
+                u.states[i] = o.create_state_multi_precision(i, w)
+                u.states_synced[i] = True
+        states = [u.states[i] for i in bucket]
+        # advance every count BEFORE reading ts/base_lr: on the eager path
+        # all params of step n already see num_update == n (the first
+        # update of the step raises the running max)
+        for i in bucket:
+            o._update_count(i)
+        ts = [o._index_update_count[i] for i in bucket]
+        base_lr = (o.lr_scheduler(o.num_update)
+                   if o.lr_scheduler is not None else o.lr)
+        names = tuple(o.idx2name.get(i, i) for i in bucket)
+        use_sgd = type(o) is opt.SGD
+        key = (bid, "sgd" if use_sgd else "generic", self._hyper_key(names))
+        fn = self._agg_fn_cache.get(key)
+        if fn is None:
+            if len(self._agg_fn_cache) > 256:
+                # hyperparameter churn (wd/momentum edits every step) would
+                # otherwise pin one jitted program per historical value
+                self._agg_fn_cache.clear()
+            if use_sgd:
+                fn = self._build_sgd_bucket_fn(
+                    names, mp=isinstance(states[0], tuple))
+            else:
+                fn = self._build_bucket_fn(names)
+            self._agg_fn_cache[key] = fn
+        w_data = [w._data for w in weights]
+        s_data = [self._state_data(s) for s in states]
+        g_data = [g._data for g in grads]
+        new_w, new_s = fn(
+            w_data, s_data, g_data,
+            jnp.asarray(base_lr, jnp.float32),
+            [jnp.asarray(t, jnp.float32) for t in ts],
+            jnp.asarray(o.rescale_grad, jnp.float32))
+        for w, nw in zip(weights, new_w):
+            w._data = nw
+        for s, ns in zip(states, new_s):
+            self._write_state(s, ns)
+        _telemetry.inc(_DISPATCHES, 1, kind="optimizer_update",
+                       path="aggregated", help=_DISPATCH_HELP)
+        _telemetry.observe(_BUCKET_BYTES, sum(d.nbytes for d in w_data),
+                           help=_BUCKET_HELP,
+                           buckets=_telemetry.BYTES_BUCKETS,
+                           kind="optimizer_update")
+
+    @staticmethod
+    def _is_mp_state(w, s):
+        """Multi-precision state shape: (mom_or_None, fp32 master) behind a
+        low-precision weight — hyperparameter scalars must then stay fp32
+        (the math runs on the master copy)."""
+        return (isinstance(s, tuple) and len(s) == 2 and s[1] is not None
+                and hasattr(s[1], "dtype") and str(s[1].dtype) == "float32"
+                and str(w.dtype) != "float32")
+
+    def _build_bucket_fn(self, names):
+        """One jitted program applying each param's own fused_update — the
+        exact math GluonTrainStep traces, so aggregated == eager for every
+        optimizer whose fused hook matches (fused_matches_eager)."""
+        o = self._optimizer
+        donate = (0, 1) if jax.default_backend() != "cpu" else ()
+
+        def run(w_data, s_data, g_data, lr, ts, rescale):
+            old_rescale = o.rescale_grad
+            new_w, new_s = [], []
+            try:
+                for name, w, s, g, t in zip(names, w_data, s_data, g_data,
+                                            ts):
+                    if self._is_mp_state(w, s):
+                        lr_p, rs_p = lr, rescale
+                    else:
+                        # eager hyperparams are weak python scalars (bf16
+                        # math stays bf16); a strong f32 traced scalar
+                        # would promote — cast to the weight dtype
+                        lr_p = lr.astype(w.dtype)
+                        rs_p = rescale.astype(w.dtype)
+                    o.rescale_grad = rs_p
+                    w2, s2 = o.fused_update(name, w, g, s, lr_p, t=t)
+                    new_w.append(w2.astype(w.dtype))
+                    new_s.append(opt._cast_state_like(s2, s))
+            finally:
+                o.rescale_grad = old_rescale
+            return new_w, new_s
+
+        return jax.jit(run, donate_argnums=donate)
+
+    def _build_sgd_bucket_fn(self, names, mp):
+        """SGD rides the registered multi-tensor ops (ref: optimizer_op.cc
+        multi_sgd_update / multi_sgd_mom_update / multi_mp_sgd_*)."""
+        o = self._optimizer
+        from ..ops import optimizer as _oo
+
+        mults = [self._mult_pair(n) for n in names]
+        momentum = o.momentum
+        clip = o.clip_gradient if o.clip_gradient else -1.0
+        wd_base = o.wd
+        donate = (0, 1) if jax.default_backend() != "cpu" else ()
+
+        def run(w_data, s_data, g_data, lr, ts, rescale):
+            n = len(w_data)
+            wds = tuple(wd_base * wm for _, wm in mults)
+            if mp:
+                # math on the fp32 masters; scalars stay fp32
+                lrs = tuple(lr * lm for lm, _ in mults)
+                flat = []
+                if momentum != 0.0:
+                    for w, g, s in zip(w_data, g_data, s_data):
+                        flat += [w, g, s[0], s[1]]
+                    outs = _oo.multi_mp_sgd_mom_update(
+                        *flat, lrs=lrs, wds=wds, num_weights=n,
+                        momentum=momentum, rescale_grad=rescale,
+                        clip_gradient=clip)
+                    new_w = list(outs[:n])
+                    new_s = list(zip(outs[n:2 * n], outs[2 * n:]))
+                else:
+                    for w, g, s in zip(w_data, g_data, s_data):
+                        flat += [w, g, s[1]]
+                    outs = _oo.multi_mp_sgd_update(
+                        *flat, lrs=lrs, wds=wds, num_weights=n,
+                        rescale_grad=rescale, clip_gradient=clip)
+                    new_w = list(outs[:n])
+                    new_s = [(None, w32) for w32 in outs[n:]]
+                return new_w, new_s
+            # non-mp: match eager weak-scalar typing — keep the math in the
+            # bucket dtype
+            dt = w_data[0].dtype
+            lrs = tuple((lr * lm).astype(dt) for lm, _ in mults)
+            rs = rescale.astype(dt)
+            flat = []
+            if momentum != 0.0:
+                for w, g, m in zip(w_data, g_data, s_data):
+                    flat += [w, g, m]
+                outs = _oo.multi_sgd_mom_update(
+                    *flat, lrs=lrs, wds=wds, num_weights=n,
+                    momentum=momentum, rescale_grad=rs, clip_gradient=clip)
+                return list(outs[:n]), list(outs[n:])
+            for w, g in zip(w_data, g_data):
+                flat += [w, g]
+            outs = _oo.multi_sgd_update(
+                *flat, lrs=lrs, wds=wds, num_weights=n,
+                rescale_grad=rs, clip_gradient=clip)
+            return list(outs), [None] * n
+
+        return jax.jit(run, donate_argnums=donate)
+
+    def _mult_pair(self, name):
+        o = self._optimizer
+        if name in o.param_dict:
+            p = o.param_dict[name]
+            return (float(p.lr_mult), float(p.wd_mult))
+        return (float(o.lr_mult.get(name, 1.0)),
+                float(o.wd_mult.get(name, 1.0)))
+
+    def _hyper_key(self, names):
+        """Everything a bucket fn bakes at trace time: the optimizer's
+        scalar hyperparams (minus the traced lr / rescale / counts) plus
+        each param's lr/wd multipliers."""
+        o = self._optimizer
+        scalars = tuple(sorted(
+            (k, v) for k, v in vars(o).items()
+            if not k.startswith("_")
+            and k not in ("rescale_grad", "lr", "num_update",
+                          "begin_num_update")
+            and isinstance(v, (int, float, bool, str, type(None)))))
+        return scalars + tuple(self._mult_pair(n) for n in names)
+
+    @staticmethod
+    def _state_data(state):
+        if state is None:
+            return None
+        if isinstance(state, tuple):
+            return tuple(Trainer._state_data(s) for s in state)
+        return state._data
+
+    @staticmethod
+    def _write_state(state, new):
+        """Write updated raw arrays back into the SAME NDArray objects the
+        Updater holds — save_states/load_states keep working unchanged."""
+        if state is None or new is None:
+            return
+        if isinstance(state, tuple):
+            for s, n in zip(state, new):
+                Trainer._write_state(s, n)
+            return
+        state._data = new
+
+    # -----------------------------------------------------------------------
 
     def save_states(self, fname):
         self._init_kvstore()

@@ -103,6 +103,14 @@ def _make_compressor(params):
 class KVStore:
     """Single-process store (ref: kvstore_local.h / comm.h)."""
 
+    # Trainer.allreduce_grads may flatten many dense grads into one
+    # contiguous array and pushpull it as a single key (bucketed
+    # allreduce, MXTPU_ALLREDUCE_BUCKET_KB). Safe wherever pushpull is a
+    # stateless per-key merge-and-reset; subclasses with per-key state on
+    # the push path (elastic-averaging mix counters, server-owned
+    # weights) flip this off and keep one pushpull per tensor.
+    supports_bucketed_allreduce = True
+
     def __init__(self, kv_type="local"):
         self._type = kv_type
         self._store = {}
@@ -387,6 +395,10 @@ class KVStoreDistAsync(KVStoreDist):
     MXTPU_ASYNC_PERIOD / MXTPU_ASYNC_ALPHA.
     """
 
+    # push is stateful per key (mix-point counters keyed by parameter);
+    # a flattened bucket key would dodge the elastic-averaging schedule
+    supports_bucketed_allreduce = False
+
     def __init__(self, kv_type="dist_async"):
         super().__init__(kv_type)
         from . import config as _config
@@ -456,6 +468,11 @@ class KVStoreDistAsyncServer(KVStoreDist):
     class exists for workloads that depend on server-applied async-SGD
     semantics (staleness realized per-push, shared optimizer state).
     """
+
+    # the server owns per-key weights; a synthetic bucket key has no
+    # server-side weight to update (and this store never takes the
+    # allreduce_grads path anyway — update_on_kvstore is forced on)
+    supports_bucketed_allreduce = False
 
     def __init__(self, kv_type="dist_async_server"):
         super().__init__(kv_type)

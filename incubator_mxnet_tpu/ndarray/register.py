@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import os
 import sys
+from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
@@ -34,7 +35,24 @@ def _as_data_or_none(x):
     return NDArray(jnp.asarray(x))
 
 
-_EAGER_JIT_CACHE: dict = {}
+# LRU: shape-diverse eager workloads would otherwise grow this without
+# bound (every distinct (op, attrs) keeps its jitted callable plus XLA's
+# per-shape executables alive). Cap via MXTPU_EAGER_JIT_CACHE_SIZE.
+_EAGER_JIT_CACHE: OrderedDict = OrderedDict()
+_EAGER_JIT_CACHE_DEFAULT_CAP = 512
+
+
+def _eager_jit_cache_cap():
+    """Env read at insert time (misses only — the hit path stays a dict
+    lookup), same runtime-retunable contract as MXTPU_EAGER_JIT; the knob
+    is documented in config.py. 0 = unbounded."""
+    raw = os.environ.get("MXTPU_EAGER_JIT_CACHE_SIZE")
+    if raw is None:
+        return _EAGER_JIT_CACHE_DEFAULT_CAP
+    try:
+        return int(raw)
+    except ValueError:
+        return _EAGER_JIT_CACHE_DEFAULT_CAP
 
 
 def _freeze(v):
@@ -79,6 +97,18 @@ def _maybe_jit(opdef, fn, call_attrs, live_idx, n_slots):
         # guarantees any hit was built from equal attrs
         cached = jax.jit(fn)
         _EAGER_JIT_CACHE[key] = cached
+        cap = _eager_jit_cache_cap()
+        if cap > 0:
+            while len(_EAGER_JIT_CACHE) > cap:
+                _EAGER_JIT_CACHE.popitem(last=False)
+        from .. import telemetry as _telemetry
+
+        _telemetry.set_gauge(
+            "mxtpu_eager_jit_cache_size", len(_EAGER_JIT_CACHE),
+            help="Entries in the eager-dispatch jit cache "
+                 "(LRU, capped by MXTPU_EAGER_JIT_CACHE_SIZE).")
+    else:
+        _EAGER_JIT_CACHE.move_to_end(key)
     return cached
 
 

@@ -205,7 +205,12 @@ def _per_weight(attr, i, what):
         # with None — raise rather than train at a silent default
         raise TypeError(f"multi update requires {what} (per-weight tuple)")
     if isinstance(attr, (tuple, list)):
-        return float(attr[i])
+        attr = attr[i]
+    if hasattr(attr, "dtype"):
+        # traced/array scalar (the aggregated Trainer path passes lr as a
+        # jit argument so lr changes don't retrace) — float() would be a
+        # ConcretizationError inside the trace
+        return attr
     return float(attr)
 
 

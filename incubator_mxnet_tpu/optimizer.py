@@ -42,6 +42,12 @@ def create(name, **kwargs):
 class Optimizer:
     """Base optimizer (ref: optimizer.py class Optimizer)."""
 
+    # True when fused_update reproduces update() step-for-step — the
+    # contract the aggregated Trainer path (gluon/trainer.py) relies on.
+    # SGLD (traced noise stream) and Nadam (per-parameter m_schedule)
+    # deviate deliberately and flip this off below.
+    fused_matches_eager = True
+
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
                  sym=None, begin_num_update=0, multi_precision=False,
@@ -154,6 +160,20 @@ def _is_row_sparse(grad):
     return isinstance(grad, RowSparseNDArray)
 
 
+def _cast_state_like(new_state, old_state):
+    """Cast an optimizer-state pytree leaf-wise back to its pre-update
+    dtypes (None / array / tuple-of-arrays — the shapes create_state
+    produces). Keeps jit carries dtype-stable for bf16-cast nets; shared
+    by fused.GluonTrainStep and the aggregated Trainer path."""
+    if new_state is None or old_state is None:
+        return new_state
+    if isinstance(new_state, tuple):
+        return tuple(
+            n if o is None or n is None else n.astype(o.dtype)
+            for n, o in zip(new_state, old_state))
+    return new_state.astype(old_state.dtype)
+
+
 def _sparse_grad_prep(opt, grad):
     """Rows + rescaled/clipped per-row gradient block for a lazy update
     (ref: optimizer_op-inl.h SGDUpdateRspImpl lazy_update path: only rows
@@ -178,6 +198,36 @@ class SGD(Optimizer):
         if self.momentum != 0.0:
             return zeros(weight.shape, dtype=str(weight.dtype))
         return None
+
+    def create_state_multi_precision(self, index, weight):
+        """(mom_or_None, fp32 master weight) for low-precision weights when
+        multi_precision is set (ref: optimizer.py SGD.create_state_multi_precision
+        — momentum is created in the master dtype)."""
+        if self.multi_precision and str(weight.dtype) in ("float16", "bfloat16"):
+            w32 = NDArray(weight._data.astype(jnp.float32))
+            return (self.create_state(index, w32), w32)
+        return self.create_state(index, weight)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if not isinstance(state, tuple):
+            self.update(index, weight, grad, state)
+            return
+        # mp state from create_state_multi_precision: math on the fp32
+        # master, low-precision weight refreshed by cast
+        # (ref: optimizer_op.cc mp_sgd_update / mp_sgd_mom_update)
+        mom, w32 = state
+        self._update_count(index)
+        attrs = self._common_attrs(index)
+        if _is_row_sparse(grad):
+            # the master-copy path has no lazy variant; densify
+            grad = grad.todense()
+        if mom is not None:
+            _writeback([weight, mom, w32], _call(
+                "mp_sgd_mom_update", [weight, grad, mom, w32],
+                {**attrs, "momentum": self.momentum}))
+        else:
+            _writeback([weight, w32],
+                       _call("mp_sgd_update", [weight, grad, w32], attrs))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -643,6 +693,23 @@ def get_updater(optimizer):
 
 
 def _sgd_fused(self, name, weight, grad, state, lr, t=None):
+    if isinstance(state, tuple):
+        # multi-precision state (mom_or_None, fp32 master) from
+        # create_state_multi_precision — route through the mp ops
+        from .ops import optimizer as _oo
+
+        lr, wd = _mults(self, name, lr)
+        clip = self.clip_gradient if self.clip_gradient else -1.0
+        mom, w32 = state
+        if mom is not None:
+            w2, m2, w322 = _oo.mp_sgd_mom_update(
+                weight, grad, mom, w32, lr=lr, momentum=self.momentum,
+                wd=wd, rescale_grad=self.rescale_grad, clip_gradient=clip)
+            return w2, (m2, w322)
+        w2, w322 = _oo.mp_sgd_update(
+            weight, grad, w32, lr=lr, wd=wd,
+            rescale_grad=self.rescale_grad, clip_gradient=clip)
+        return w2, (None, w322)
     g = grad * self.rescale_grad
     if self.clip_gradient:
         g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
@@ -919,6 +986,10 @@ def _nadam_fused(self, name, weight, grad, state, lr, t=None):
 
 Nadam.create_fused_state = _nadam_create_fused_state
 Nadam.fused_update = _nadam_fused
+# per-parameter m_schedule vs the eager path's shared Python float advanced
+# N times per step: trajectories differ by design, so the aggregated
+# Trainer path must not treat fused as an eager drop-in
+Nadam.fused_matches_eager = False
 
 
 def _adamw_fused(self, name, weight, grad, state, lr, t=None):
@@ -958,6 +1029,9 @@ def _sgld_fused(self, name, weight, grad, state, lr, t=None):
 
 
 SGLD.fused_update = _sgld_fused
+# deterministic fold_in noise vs the eager global RNG stream: same
+# distribution, different draws — excluded from eager-equivalent aggregation
+SGLD.fused_matches_eager = False
 
 
 def _test_fused(self, name, weight, grad, state, lr, t=None):

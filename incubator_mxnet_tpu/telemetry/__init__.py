@@ -28,6 +28,7 @@ import threading
 from .. import config as _config
 from .metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, REGISTRY, DEFAULT_BUCKETS,
+    BYTES_BUCKETS,
 )
 from .spans import Span, NoopSpan, NOOP_SPAN, current_span, SPAN_HISTOGRAM  # noqa: F401
 from .exporters import dump_json, prometheus_text, start_http_server, to_dict  # noqa: F401
@@ -36,6 +37,7 @@ from .tb import LogTelemetryCallback  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+    "DEFAULT_BUCKETS", "BYTES_BUCKETS",
     "Span", "NoopSpan", "current_span", "span",
     "dump_json", "prometheus_text", "start_http_server", "to_dict",
     "sample_device_memory", "step_boundary", "LogTelemetryCallback",

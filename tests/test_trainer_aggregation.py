@@ -1,0 +1,326 @@
+"""Aggregated multi-tensor Trainer updates + bucketed gradient allreduce
+(gluon/trainer.py): aggregated-vs-eager equivalence across optimizers and
+dtypes, O(num_buckets) dispatch counts via telemetry, fallback triggers
+(custom optimizer, sparse grads, ignore_stale_grad, disabled knob), bucketed
+allreduce equivalence, state save/load, and the eager-jit LRU cap."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd, telemetry
+from incubator_mxnet_tpu import optimizer as opt
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+@pytest.fixture
+def telem():
+    telemetry.REGISTRY.reset()
+    telemetry.enable()
+    yield telemetry
+    telemetry.disable()
+    telemetry.REGISTRY.reset()
+
+
+def _build(n_layers=5, width=8, dtype="float32", seed=7):
+    net = nn.Sequential()
+    for _ in range(n_layers):
+        net.add(nn.Dense(width))
+    net.initialize(mx.init.Xavier())
+    net(nd.ones((2, width)))  # materialize shapes
+    rng = np.random.RandomState(seed)
+    for p in net.collect_params().values():
+        p.set_data(nd.array(
+            rng.uniform(-0.1, 0.1, size=p.shape).astype("float32")))
+    if dtype != "float32":
+        net.cast(dtype)
+    return net
+
+
+def _train(net, trainer, steps=3, width=8, dtype="float32", seed=99,
+           **step_kw):
+    rng = np.random.RandomState(seed)
+    for _ in range(steps):
+        x = nd.array(rng.uniform(-1, 1, size=(4, width)).astype(
+            "float32")).astype(dtype)
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        trainer.step(4, **step_kw)
+    return [p.data().asnumpy().astype("float32")
+            for p in net.collect_params().values()]
+
+
+def _equiv(monkeypatch, make_optimizer, dtype="float32", steps=3,
+           rtol=1e-5, atol=1e-7, agg_kb="4096"):
+    monkeypatch.setenv("MXNET_OPTIMIZER_AGGREGATION_SIZE", "0")
+    n_eager = _build(dtype=dtype)
+    w_eager = _train(n_eager, gluon.Trainer(
+        n_eager.collect_params(), make_optimizer()), steps, dtype=dtype)
+    monkeypatch.setenv("MXNET_OPTIMIZER_AGGREGATION_SIZE", agg_kb)
+    n_agg = _build(dtype=dtype)
+    w_agg = _train(n_agg, gluon.Trainer(
+        n_agg.collect_params(), make_optimizer()), steps, dtype=dtype)
+    for a, b in zip(w_eager, w_agg):
+        assert_almost_equal(a, b, rtol=rtol, atol=atol)
+
+
+# -- aggregated == eager ----------------------------------------------------
+
+def test_aggregated_matches_eager_sgd_momentum(monkeypatch):
+    _equiv(monkeypatch, lambda: opt.SGD(learning_rate=0.05, momentum=0.9,
+                                        wd=1e-4))
+
+
+def test_aggregated_matches_eager_sgd_plain(monkeypatch):
+    _equiv(monkeypatch, lambda: opt.SGD(learning_rate=0.05))
+
+
+def test_aggregated_matches_eager_sgd_clip_and_mults(monkeypatch):
+    def make():
+        o = opt.SGD(learning_rate=0.05, momentum=0.9, clip_gradient=0.1)
+        o.lr_mult = {"dense0_weight": 2.0}
+        o.wd_mult = {"dense1_weight": 0.5}
+        return o
+    _equiv(monkeypatch, make)
+
+
+def test_aggregated_matches_eager_adam(monkeypatch):
+    _equiv(monkeypatch, lambda: opt.Adam(learning_rate=0.01, wd=1e-4))
+
+
+def test_aggregated_matches_eager_mixed_precision_bf16(monkeypatch):
+    # bf16 weights, fp32 master + momentum state (mp SGD): the aggregated
+    # path routes through multi_mp_sgd_mom_update and must match the eager
+    # mp_sgd_mom_update step exactly (math on the fp32 master either way)
+    _equiv(monkeypatch,
+           lambda: opt.SGD(learning_rate=0.05, momentum=0.9,
+                           multi_precision=True),
+           dtype="bfloat16", rtol=2e-2, atol=2e-2)
+
+
+def test_aggregated_matches_eager_with_lr_scheduler(monkeypatch):
+    # base lr is a traced jit input: the schedule must take effect each
+    # step without rebuilding the bucket program
+    from incubator_mxnet_tpu import lr_scheduler
+
+    _equiv(monkeypatch,
+           lambda: opt.SGD(learning_rate=0.1, momentum=0.9,
+                           lr_scheduler=lr_scheduler.FactorScheduler(
+                               step=1, factor=0.5)))
+
+
+def test_lr_scheduler_does_not_retrace(monkeypatch):
+    monkeypatch.setenv("MXNET_OPTIMIZER_AGGREGATION_SIZE", "4096")
+    from incubator_mxnet_tpu import lr_scheduler
+
+    net = _build()
+    tr = gluon.Trainer(net.collect_params(),
+                       opt.SGD(learning_rate=0.1,
+                               lr_scheduler=lr_scheduler.FactorScheduler(
+                                   step=1, factor=0.5)))
+    _train(net, tr, steps=4)
+    # one bucket, one cached program across all 4 lr values
+    assert len(tr._agg_buckets) == 1
+    assert len(tr._agg_fn_cache) == 1
+
+
+# -- dispatch counts --------------------------------------------------------
+
+def test_one_step_issues_o_num_buckets_dispatches(telem, monkeypatch):
+    monkeypatch.setenv("MXNET_OPTIMIZER_AGGREGATION_SIZE", "4096")
+    net = _build(n_layers=10)
+    n_params = len(list(net.collect_params()))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9})
+    _train(net, tr, steps=1)
+    c = telem.REGISTRY.get("mxtpu_trainer_dispatches_total")
+    agg = c.value(kind="optimizer_update", path="aggregated")
+    per = c.value(kind="optimizer_update", path="per_param")
+    assert per == 0
+    assert agg == len(tr._agg_buckets)
+    # the acceptance bar: O(num_buckets), not O(2N) per step
+    assert agg < 2 * n_params
+    # bucket payload histogram recorded one observation per bucket
+    h = telem.REGISTRY.get("mxtpu_trainer_bucket_bytes")
+    snap = h.labels(kind="optimizer_update").snapshot()
+    assert snap[2] == len(tr._agg_buckets)
+
+
+def test_byte_cap_splits_buckets(telem, monkeypatch):
+    # 1 KB cap over ~288B/layer: multiple buckets, still equivalent counts
+    monkeypatch.setenv("MXNET_OPTIMIZER_AGGREGATION_SIZE", "1")
+    net = _build(n_layers=10)
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05})
+    _train(net, tr, steps=2)
+    assert len(tr._agg_buckets) > 1
+    c = telem.REGISTRY.get("mxtpu_trainer_dispatches_total")
+    assert c.value(kind="optimizer_update",
+                   path="aggregated") == 2 * len(tr._agg_buckets)
+
+
+def test_byte_cap_split_preserves_equivalence(monkeypatch):
+    _equiv(monkeypatch, lambda: opt.SGD(learning_rate=0.05, momentum=0.9),
+           agg_kb="1")
+
+
+# -- fallbacks --------------------------------------------------------------
+
+def test_custom_optimizer_falls_back_to_per_param(telem, monkeypatch):
+    monkeypatch.setenv("MXNET_OPTIMIZER_AGGREGATION_SIZE", "4096")
+
+    class Custom(opt.SGD):
+        # inherits the base generic fused hook -> not aggregation-eligible
+        fused_update = opt.Optimizer.fused_update
+
+    net = _build()
+    tr = gluon.Trainer(net.collect_params(), Custom(learning_rate=0.01))
+    _train(net, tr, steps=1)
+    c = telem.REGISTRY.get("mxtpu_trainer_dispatches_total")
+    assert c.value(kind="optimizer_update", path="aggregated") == 0
+    assert c.value(kind="optimizer_update",
+                   path="per_param") == len(list(net.collect_params()))
+
+
+def test_fused_matches_eager_false_falls_back(telem, monkeypatch):
+    # SGLD's fused hook deliberately uses a different noise stream than the
+    # eager update — it must never take the aggregated path
+    monkeypatch.setenv("MXNET_OPTIMIZER_AGGREGATION_SIZE", "4096")
+    net = _build()
+    tr = gluon.Trainer(net.collect_params(), "sgld",
+                       {"learning_rate": 0.01})
+    _train(net, tr, steps=1)
+    c = telem.REGISTRY.get("mxtpu_trainer_dispatches_total")
+    assert c.value(kind="optimizer_update", path="aggregated") == 0
+    assert c.value(kind="optimizer_update", path="per_param") > 0
+
+
+def test_ignore_stale_grad_falls_back(telem, monkeypatch):
+    monkeypatch.setenv("MXNET_OPTIMIZER_AGGREGATION_SIZE", "4096")
+    net = _build()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05})
+    _train(net, tr, steps=1, ignore_stale_grad=True)
+    c = telem.REGISTRY.get("mxtpu_trainer_dispatches_total")
+    assert c.value(kind="optimizer_update", path="aggregated") == 0
+    assert c.value(kind="optimizer_update", path="per_param") > 0
+
+
+def test_sparse_grad_param_falls_back(telem, monkeypatch):
+    monkeypatch.setenv("MXNET_OPTIMIZER_AGGREGATION_SIZE", "4096")
+    emb = nn.Embedding(10, 4, sparse_grad=True)
+    emb.initialize(mx.init.Xavier())
+    tr = gluon.Trainer(emb.collect_params(), "sgd", {"learning_rate": 0.1})
+    with autograd.record():
+        y = emb(nd.array(np.array([1, 2, 3], dtype="float32")))
+        loss = (y * y).sum()
+    loss.backward()
+    tr.step(3)
+    c = telem.REGISTRY.get("mxtpu_trainer_dispatches_total")
+    assert c.value(kind="optimizer_update", path="aggregated") == 0
+    assert c.value(kind="optimizer_update", path="per_param") == 1
+
+
+def test_aggregation_disabled_by_env(telem, monkeypatch):
+    monkeypatch.setenv("MXNET_OPTIMIZER_AGGREGATION_SIZE", "0")
+    net = _build()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05})
+    _train(net, tr, steps=1)
+    c = telem.REGISTRY.get("mxtpu_trainer_dispatches_total")
+    assert c.value(kind="optimizer_update", path="aggregated") == 0
+    assert c.value(kind="optimizer_update",
+                   path="per_param") == len(list(net.collect_params()))
+
+
+# -- state round-trip -------------------------------------------------------
+
+def test_save_load_states_roundtrip_with_aggregation(monkeypatch, tmp_path):
+    # the aggregated path writes updated state back into the SAME NDArray
+    # objects the Updater serializes — a save/load across trainers must
+    # continue training identically
+    monkeypatch.setenv("MXNET_OPTIMIZER_AGGREGATION_SIZE", "4096")
+    net = _build()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9})
+    _train(net, tr, steps=2)
+    fname = str(tmp_path / "trainer.states")
+    tr.save_states(fname)
+    w_cont = _train(net, tr, steps=1, seed=123)
+
+    net2 = _build()
+    tr2 = gluon.Trainer(net2.collect_params(), "sgd",
+                        {"learning_rate": 0.05, "momentum": 0.9})
+    _train(net2, tr2, steps=2)  # same data: identical weights pre-load
+    tr2.load_states(fname)
+    w_loaded = _train(net2, tr2, steps=1, seed=123)
+    for a, b in zip(w_cont, w_loaded):
+        assert_almost_equal(a, b, rtol=1e-6, atol=1e-8)
+
+
+# -- bucketed allreduce -----------------------------------------------------
+
+def _train_dist(monkeypatch, bucket_kb, telem=None):
+    monkeypatch.setenv("MXTPU_ALLREDUCE_BUCKET_KB", bucket_kb)
+    monkeypatch.setenv("MXNET_OPTIMIZER_AGGREGATION_SIZE", "0")
+    net = _build()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore="dist_sync")
+    w = _train(net, tr, steps=2)
+    return w, tr
+
+
+def test_bucketed_allreduce_matches_per_key(telem, monkeypatch):
+    w_pk, _ = _train_dist(monkeypatch, "0")
+    c = telem.REGISTRY.get("mxtpu_trainer_dispatches_total")
+    per_key = c.value(kind="allreduce", path="per_key")
+    assert per_key > 0
+    w_bk, _ = _train_dist(monkeypatch, "4096")
+    assert c.value(kind="allreduce", path="bucketed") == 2  # 1 bucket/step
+    assert c.value(kind="allreduce", path="per_key") == per_key  # unchanged
+    for a, b in zip(w_pk, w_bk):
+        assert_almost_equal(a, b, rtol=1e-6, atol=1e-8)
+
+
+def test_bucketed_allreduce_byte_cap_splits(telem, monkeypatch):
+    w_pk, _ = _train_dist(monkeypatch, "0")
+    w_bk, _ = _train_dist(monkeypatch, "1")  # 1 KB: several buckets
+    c = telem.REGISTRY.get("mxtpu_trainer_dispatches_total")
+    assert c.value(kind="allreduce", path="bucketed") > 2
+    for a, b in zip(w_pk, w_bk):
+        assert_almost_equal(a, b, rtol=1e-6, atol=1e-8)
+
+
+# -- eager jit cache LRU ----------------------------------------------------
+
+def test_eager_jit_cache_lru_cap(telem, monkeypatch):
+    from incubator_mxnet_tpu.ndarray import register as ndreg
+
+    monkeypatch.setenv("MXTPU_EAGER_JIT", "1")
+    monkeypatch.setenv("MXTPU_EAGER_JIT_CACHE_SIZE", "4")
+    ndreg._EAGER_JIT_CACHE.clear()
+    a = nd.array(np.ones((3, 3), dtype="float32"))
+    for axis in (0, 1):  # distinct attrs -> distinct cache keys
+        nd.sum(a, axis=axis)
+        nd.mean(a, axis=axis)
+        nd.max(a, axis=axis)
+        nd.min(a, axis=axis)
+    assert 0 < len(ndreg._EAGER_JIT_CACHE) <= 4
+    g = telem.REGISTRY.get("mxtpu_eager_jit_cache_size")
+    assert g.value() == len(ndreg._EAGER_JIT_CACHE)
+    ndreg._EAGER_JIT_CACHE.clear()
+
+
+def test_eager_jit_cache_lru_evicts_oldest(monkeypatch):
+    from incubator_mxnet_tpu.ndarray import register as ndreg
+
+    monkeypatch.setenv("MXTPU_EAGER_JIT", "1")
+    monkeypatch.setenv("MXTPU_EAGER_JIT_CACHE_SIZE", "2")
+    ndreg._EAGER_JIT_CACHE.clear()
+    a = nd.array(np.ones((3, 3), dtype="float32"))
+    nd.sum(a, axis=0)
+    first_key = next(iter(ndreg._EAGER_JIT_CACHE))
+    nd.sum(a, axis=1)
+    nd.sum(a, axis=0)  # hit: refreshes first_key to MRU position
+    nd.mean(a, axis=0)  # miss: evicts the LRU entry (axis=1 sum)
+    assert len(ndreg._EAGER_JIT_CACHE) == 2
+    assert first_key in ndreg._EAGER_JIT_CACHE
+    ndreg._EAGER_JIT_CACHE.clear()
