@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI tiers (ref: ci/docker/runtime_functions.sh — unittest / nightly /
 # distributed stages). Usage:
-#   ci/run_tests.sh [unit|nightly|dist|examples|telemetry|aggregation|all]
+#   ci/run_tests.sh [unit|nightly|dist|examples|telemetry|aggregation|static-analysis|all]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -61,6 +61,20 @@ run_aggregation() {
     JAX_PLATFORMS=cpu python bench.py --dispatch-overhead --assert
 }
 
+run_static_analysis() {
+    echo "=== static-analysis tier (mxlint + graph validation) ==="
+    # framework lint: MUST be clean modulo the committed (empty) baseline.
+    # Runs without jax — keep it first so a bad sandbox fails fast.
+    python tools/mxlint.py --baseline ci/mxlint_baseline.json
+    # graph validation over two traced model_zoo networks: any
+    # error-severity MXA finding fails the tier (INFO findings like the
+    # 1000-class FC head's lane padding are expected and pass).
+    JAX_PLATFORMS=cpu python tools/graph_check.py \
+        --model resnet18_v1 --shape data=1,3,224,224
+    JAX_PLATFORMS=cpu python tools/graph_check.py \
+        --model squeezenet1.0 --shape data=1,3,224,224
+}
+
 run_nightly() {
     echo "=== nightly tier (large tensors, checkpoint compat, 7-worker dist) ==="
     MXTPU_NIGHTLY=1 python -m pytest tests/test_large_array.py \
@@ -86,8 +100,9 @@ case "$tier" in
     suite)     run_suite ;;
     telemetry) run_telemetry ;;
     aggregation) run_aggregation ;;
+    static-analysis) run_static_analysis ;;
     nightly)   run_nightly ;;
-    all)       run_unit; run_telemetry; run_aggregation; run_dist; run_examples; run_nightly ;;
-    *) echo "unknown tier: $tier (unit|nightly|dist|examples|suite|telemetry|aggregation|all)"; exit 2 ;;
+    all)       run_static_analysis; run_unit; run_telemetry; run_aggregation; run_dist; run_examples; run_nightly ;;
+    *) echo "unknown tier: $tier (unit|nightly|dist|examples|suite|telemetry|aggregation|static-analysis|all)"; exit 2 ;;
 esac
 echo "tier '$tier' green"

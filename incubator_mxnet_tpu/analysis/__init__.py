@@ -1,0 +1,31 @@
+"""Static analysis: graph validator + framework lint.
+
+Two pillars, both emitting structured diagnostics with stable codes
+(catalog: docs/STATIC_ANALYSIS.md):
+
+- Graph validator (`validate` / `validate_json`, codes MXA0xx): a pass
+  pipeline over Symbol graphs that front-loads the correctness checks the
+  reference runs as nnvm passes — structural integrity, full shape/dtype
+  inference with op-boundary provenance, and TPU perf hazards (host-sync
+  ops, hostile dtypes, tiling-defeating layouts). Reachable as
+  `Symbol.validate()`, the opt-in `MXNET_GRAPH_VALIDATE` hook at Executor
+  bind time, and `tools/graph_check.py`.
+
+- Framework lint (`mxlint`, codes MXL0xx): an AST checker over
+  `incubator_mxnet_tpu/` itself enforcing the framework's own invariants
+  (documented config knobs, registered telemetry names, no bare excepts,
+  no host materialization in hot paths, documented ops). CLI:
+  `tools/mxlint.py`; CI runs it with the committed zero-findings baseline
+  `ci/mxlint_baseline.json`.
+"""
+from .diagnostics import (  # noqa: F401
+    Diagnostic, Report, Severity, CODE_CATALOG, GraphValidationError,
+)
+from .passes import validate, validate_json, HOST_SYNC_OPS  # noqa: F401
+from .mxlint import LINT_RULES, LintFinding, run_lint  # noqa: F401
+
+__all__ = [
+    "Diagnostic", "Report", "Severity", "CODE_CATALOG",
+    "GraphValidationError", "validate", "validate_json", "HOST_SYNC_OPS",
+    "LINT_RULES", "LintFinding", "run_lint",
+]

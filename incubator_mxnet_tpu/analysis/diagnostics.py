@@ -1,0 +1,166 @@
+"""Structured diagnostics for the static-analysis layer.
+
+The reference front-loads graph correctness into nnvm passes that fail
+with op/node context (ref: src/nnvm/infer_graph_attr_pass.cc error paths);
+our XLA-tracing failures are deep and node-anonymous. Every check in this
+package therefore reports through one shape: a `Diagnostic` with a stable
+`MXA0xx` code, a severity, and per-node provenance (node name, op type,
+input names/shapes), collected into a `Report` that renders for humans,
+serializes for tooling, and feeds the `mxtpu_graph_validate_findings_total`
+counter at Executor bind time.
+
+Code space: `MXA0xx` = graph-validator findings (this module's consumers in
+`passes.py`); `MXL0xx` = framework-lint findings (`mxlint.py`). The catalog
+lives in docs/STATIC_ANALYSIS.md and is regenerated from `CODE_CATALOG`.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+
+__all__ = ["Severity", "Diagnostic", "Report", "CODE_CATALOG"]
+
+
+class Severity(enum.IntEnum):
+    """Ordered so max() over a report gives the report's overall level."""
+
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self):
+        return self.name.lower()
+
+
+# code -> (default severity, one-line summary). docs/STATIC_ANALYSIS.md
+# renders this table; tests assert every emitted code is cataloged.
+CODE_CATALOG = {
+    # structural
+    "MXA001": (Severity.ERROR, "graph contains a cycle"),
+    "MXA002": (Severity.ERROR, "dangling input: node input refers to a "
+                               "missing node or out-of-range output"),
+    "MXA003": (Severity.ERROR, "duplicate argument name: two distinct "
+                               "variable nodes share a name"),
+    "MXA004": (Severity.ERROR, "unknown operator (not in OP_REGISTRY)"),
+    # shape / dtype inference
+    "MXA010": (Severity.ERROR, "shape/dtype inference failed at an op "
+                               "boundary"),
+    "MXA011": (Severity.ERROR, "input shapes unavailable: inference could "
+                               "not reach this node"),
+    "MXA012": (Severity.WARNING, "dtype hazard on TPU (float64/int64 "
+                                 "silently demoted or slow; float16 has no "
+                                 "MXU support — use bfloat16)"),
+    # liveness
+    "MXA020": (Severity.WARNING, "dead node: unreachable from any graph "
+                                 "head"),
+    "MXA021": (Severity.WARNING, "given shape name matches no graph "
+                                 "argument (typo?)"),
+    "MXA022": (Severity.INFO, "unused node output (computed but never "
+                              "consumed and not a head)"),
+    # TPU perf hazards
+    "MXA030": (Severity.WARNING, "op forces a host transfer / defeats jit "
+                                 "(data-dependent output shape)"),
+    "MXA031": (Severity.WARNING, "explicit cast to a TPU-hostile dtype"),
+    "MXA032": (Severity.INFO, "layout defeats MXU/VPU tiling (lane dim "
+                              "128, sublane 8 for f32)"),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Diagnostic:
+    """One finding, with per-node provenance.
+
+    `node` / `op` / `inputs` carry the graph context the raw XLA trace
+    error lacks; `detail` is a short stable discriminator (used for
+    dedup and baseline keys), `message` the full human text.
+    """
+
+    code: str
+    severity: Severity
+    message: str
+    node: str | None = None
+    op: str | None = None
+    inputs: tuple = ()  # ((input_name, shape_or_None, dtype_or_None), ...)
+    detail: str = ""
+
+    def __str__(self):
+        loc = f" [node {self.node}" + (f" ({self.op})]" if self.op else "]") \
+            if self.node else ""
+        return f"{self.code} {self.severity}:{loc} {self.message}"
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d["severity"] = str(self.severity)
+        d["inputs"] = [list(i) for i in self.inputs]
+        return d
+
+
+class Report:
+    """An ordered collection of diagnostics with severity filters."""
+
+    def __init__(self, diagnostics=(), graph_name=None):
+        self.diagnostics = list(diagnostics)
+        self.graph_name = graph_name
+
+    def append(self, diag):
+        self.diagnostics.append(diag)
+
+    def extend(self, diags):
+        self.diagnostics.extend(diags)
+
+    def __iter__(self):
+        return iter(self.diagnostics)
+
+    def __len__(self):
+        return len(self.diagnostics)
+
+    def __bool__(self):
+        # truthiness = "has findings"; use .ok for the inverse reading
+        return bool(self.diagnostics)
+
+    @property
+    def errors(self):
+        return [d for d in self.diagnostics if d.severity >= Severity.ERROR]
+
+    @property
+    def warnings(self):
+        return [d for d in self.diagnostics
+                if d.severity == Severity.WARNING]
+
+    @property
+    def ok(self):
+        """True when nothing error-severity was found."""
+        return not self.errors
+
+    def by_code(self, code):
+        return [d for d in self.diagnostics if d.code == code]
+
+    def raise_if_errors(self):
+        if self.errors:
+            raise GraphValidationError(self)
+        return self
+
+    def __str__(self):
+        name = f" for {self.graph_name}" if self.graph_name else ""
+        if not self.diagnostics:
+            return f"graph validation{name}: clean"
+        lines = [f"graph validation{name}: {len(self.errors)} error(s), "
+                 f"{len(self.warnings)} warning(s)"]
+        for d in sorted(self.diagnostics, key=lambda d: -int(d.severity)):
+            lines.append(f"  {d}")
+        return "\n".join(lines)
+
+    def to_json(self, indent=2):
+        return json.dumps(
+            {"graph": self.graph_name,
+             "findings": [d.to_dict() for d in self.diagnostics]},
+            indent=indent)
+
+
+class GraphValidationError(ValueError):
+    """Raised by Report.raise_if_errors / MXNET_GRAPH_VALIDATE=raise."""
+
+    def __init__(self, report):
+        self.report = report
+        super().__init__(str(report))

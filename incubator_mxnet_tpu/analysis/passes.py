@@ -1,0 +1,397 @@
+"""Graph-validator pass pipeline over Symbol graphs.
+
+TPU-native analog of the reference's pre-execution nnvm passes (shape/type
+inference, graph checks — ref: src/nnvm/infer_graph_attr_pass.cc,
+src/executor/graph_executor.cc CheckAndInferShape): every check runs at
+graph-construction time and reports per-node provenance instead of letting
+XLA tracing throw a deep node-anonymous stack later.
+
+Passes (each a function `(ctx) -> None` appending to `ctx.report`):
+  structural  — cycle (MXA001), dangling input (MXA002), duplicate
+                names (MXA003)
+  given-names — shape kwargs that match no argument (MXA021)
+  inference   — full shape/dtype inference with op-boundary mismatch
+                reporting (MXA010/MXA011), reusing symbol/infer.py so the
+                validator and the executor can never disagree
+  dtype       — TPU dtype hazards (MXA012) and hostile casts (MXA031)
+  host-sync   — ops with data-dependent output shapes that force host
+                transfer / defeat jit (MXA030)
+  layout      — shapes that defeat MXU/VPU tiling (MXA032; MXU is
+                128x128, VPU lanes are 8x128 — see the TPU guide)
+  liveness    — unused node outputs (MXA022)
+
+`validate_json` additionally runs structural checks a live Symbol cannot
+express (dead nodes unreachable from heads — MXA020, unknown ops —
+MXA004) over the serialized nnvm-schema graph.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from .diagnostics import Diagnostic, Report, Severity, CODE_CATALOG
+
+__all__ = ["validate", "validate_json", "HOST_SYNC_OPS", "TPU_LANE",
+           "TPU_SUBLANE"]
+
+# ops whose output shape depends on input *values*: XLA cannot trace them
+# into the fused program, so eager use synchronizes device->host and
+# symbolic use forces per-batch retraces (ref: contrib.boolean_mask docs)
+HOST_SYNC_OPS = frozenset({
+    "boolean_mask",
+    "_contrib_boolean_mask",
+    "sample_unique_zipfian",
+})
+
+# dtypes that TPUs execute degraded: f64 is emulated (silently demoted
+# under default XLA flags), int64 is pair-emulated on the VPU, f16 has no
+# MXU path (bf16 is the native half type)
+_HAZARD_DTYPES = {"float64", "int64", "float16"}
+
+TPU_LANE = 128     # minor-most tile dim, all dtypes
+TPU_SUBLANE = 8    # second-minor tile dim for f32
+
+
+def _diag(code, message, node=None, op=None, inputs=(), detail="",
+          severity=None):
+    sev, _ = CODE_CATALOG[code]
+    return Diagnostic(code=code, severity=severity or sev, message=message,
+                      node=node, op=op, inputs=tuple(inputs), detail=detail)
+
+
+class _Ctx:
+    """Per-validation state shared by the passes."""
+
+    def __init__(self, symbol, given, report):
+        self.symbol = symbol
+        self.given = dict(given or {})
+        self.report = report
+        self.nodes = symbol._topo_nodes()
+        self.heads = list(symbol._outputs)
+        # filled by the inference pass: (id(node), out_idx) -> (shape, dtype)
+        self.entries = {}
+        self.has_cycle = False
+
+
+# -- structural --------------------------------------------------------------
+
+def _pass_structural(ctx):
+    # cycle: iterative three-color DFS from the heads. _topo_nodes uses a
+    # visited set so it terminates on cyclic graphs, but its order is then
+    # not topological — every later pass tolerates missing producer info.
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {}
+    for head, _ in ctx.heads:
+        if color.get(id(head), WHITE) != WHITE:
+            continue
+        stack = [(head, iter(head.inputs))]
+        color[id(head)] = GREY
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for src, _i in it:
+                c = color.get(id(src), WHITE)
+                if c == GREY:
+                    ctx.has_cycle = True
+                    ctx.report.append(_diag(
+                        "MXA001",
+                        f"cycle through node {src.name!r}: its inputs "
+                        f"transitively depend on its own output",
+                        node=src.name,
+                        op=None if src.is_var else src.op.name,
+                        detail=src.name))
+                elif c == WHITE:
+                    color[id(src)] = GREY
+                    stack.append((src, iter(src.inputs)))
+                    advanced = True
+                    break
+            if not advanced:
+                color[id(node)] = BLACK
+                stack.pop()
+
+    names_seen = {}
+    for n in ctx.nodes:
+        # dangling input: entry referencing an output slot the producer
+        # does not have (hand-built or corrupted graphs)
+        for j, (src, i) in enumerate(n.inputs):
+            if i >= src.num_outputs:
+                ctx.report.append(_diag(
+                    "MXA002",
+                    f"input {j} of node {n.name!r} references output {i} "
+                    f"of {src.name!r}, which has only {src.num_outputs} "
+                    f"output(s)",
+                    node=n.name, op=None if n.is_var else n.op.name,
+                    detail=f"{n.name}:{j}"))
+        prev = names_seen.get(n.name)
+        if prev is not None and prev is not n:
+            both_vars = n.is_var and prev.is_var
+            ctx.report.append(_diag(
+                "MXA003",
+                f"two distinct {'variable' if both_vars else 'graph'} "
+                f"nodes are both named {n.name!r}; name-keyed binding "
+                f"(arg_dict, save/load) will silently collapse them",
+                node=n.name,
+                op=None if n.is_var else n.op.name,
+                detail=n.name,
+                severity=Severity.ERROR if both_vars else Severity.WARNING))
+        else:
+            names_seen[n.name] = n
+
+
+def _pass_given_names(ctx):
+    known = set(ctx.symbol.list_inputs())
+    for name in ctx.given:
+        if name not in known:
+            ctx.report.append(_diag(
+                "MXA021",
+                f"shape given for {name!r}, which is not an input of this "
+                f"graph (inputs: {sorted(known)})",
+                detail=name))
+
+
+# -- shape / dtype inference -------------------------------------------------
+
+def _pass_inference(ctx):
+    from ..symbol.infer import infer_shapes, ShapeInferenceError
+
+    if ctx.has_cycle:
+        # inference over a cyclic graph would report every consumer of the
+        # cycle as "missing input shapes" — pure noise after MXA001
+        return
+    errors = []
+    given = {k: v for k, v in ctx.given.items()
+             if k in set(ctx.symbol.list_inputs())}
+    try:
+        infer_shapes(ctx.symbol, given, errors=errors, entry_out=ctx.entries)
+    except Exception as e:  # defensive: the collecting mode should not raise
+        ctx.report.append(_diag("MXA010", f"shape inference aborted: {e}"))
+        return
+    for err in errors:
+        if isinstance(err, ShapeInferenceError):
+            code = "MXA011" if err.missing_inputs else "MXA010"
+            ctx.report.append(_diag(
+                code, str(err), node=err.node_name, op=err.op_name,
+                inputs=err.input_info, detail=err.node_name))
+        else:
+            ctx.report.append(_diag("MXA010", str(err)))
+
+
+# -- TPU dtype hazards -------------------------------------------------------
+
+def _pass_dtype(ctx):
+    for n in ctx.nodes:
+        if n.is_var:
+            declared = n.misc_attrs.get("__dtype__")
+            if declared and str(np.dtype(declared)) in _HAZARD_DTYPES:
+                ctx.report.append(_diag(
+                    "MXA012",
+                    f"variable {n.name!r} declares dtype {declared}; on "
+                    f"TPU float64/int64 are emulated (or silently demoted "
+                    f"by XLA) and float16 has no MXU path — prefer "
+                    f"float32/bfloat16/int32",
+                    node=n.name, detail=f"{n.name}:{declared}"))
+            continue
+        if n.op.name in ("cast", "Cast", "amp_cast"):
+            target = {**n.op.attrs, **n.attrs}.get("dtype")
+            if target and str(target) in _HAZARD_DTYPES:
+                ctx.report.append(_diag(
+                    "MXA031",
+                    f"node {n.name!r} casts to {target}; this dtype is "
+                    f"TPU-hostile (emulated or silently demoted) and the "
+                    f"widening propagates to every consumer",
+                    node=n.name, op=n.op.name,
+                    detail=f"{n.name}:{target}"))
+        # silent upcast at an op boundary: inferred output wider than
+        # every input (e.g. an f32 literal promoting a bf16 activation)
+        out = ctx.entries.get((id(n), 0))
+        if out is None:
+            continue
+        in_dts = [ctx.entries.get((id(src), i)) for src, i in n.inputs]
+        in_dts = [d[1] for d in in_dts if d is not None]
+        if not in_dts:
+            continue
+        out_dt = np.dtype(out[1])
+        if (out_dt.kind == "f" and
+                all(np.dtype(d).kind == "f" for d in in_dts) and
+                all(np.dtype(d).itemsize < out_dt.itemsize for d in in_dts)):
+            ctx.report.append(_diag(
+                "MXA012",
+                f"node {n.name!r} ({n.op.name}) silently upcasts: inputs "
+                f"are {[str(np.dtype(d)) for d in in_dts]} but the output "
+                f"is {out_dt} — a float32 constant or attr is promoting "
+                f"the computation",
+                node=n.name, op=n.op.name, detail=f"{n.name}:upcast"))
+
+
+# -- host-sync / jit hazards -------------------------------------------------
+
+def _pass_host_sync(ctx):
+    for n in ctx.nodes:
+        if not n.is_var and n.op.name in HOST_SYNC_OPS:
+            ctx.report.append(_diag(
+                "MXA030",
+                f"node {n.name!r} uses op {n.op.name!r}, whose output "
+                f"shape depends on input values: it cannot live inside "
+                f"the fused XLA program and forces a host round-trip "
+                f"(and a retrace per distinct result shape) every step",
+                node=n.name, op=n.op.name, detail=n.name))
+
+
+# -- layout / tiling ---------------------------------------------------------
+
+def _pass_layout(ctx):
+    for n in ctx.nodes:
+        if n.is_var:
+            continue
+        attrs = {**n.op.attrs, **n.attrs}
+        if n.op.name == "FullyConnected":
+            nh = int(attrs.get("num_hidden") or 0)
+            if nh and nh % TPU_LANE:
+                ctx.report.append(_diag(
+                    "MXA032",
+                    f"node {n.name!r}: num_hidden={nh} is not a multiple "
+                    f"of {TPU_LANE}; the MXU pads the output lane dim to "
+                    f"{-(-nh // TPU_LANE) * TPU_LANE} "
+                    f"({100 * (-(-nh // TPU_LANE) * TPU_LANE - nh) // max(nh, 1)}% wasted)",
+                    node=n.name, op=n.op.name, detail=f"{n.name}:{nh}"))
+        elif n.op.name in ("Convolution", "Deconvolution"):
+            nf = int(attrs.get("num_filter") or 0)
+            if nf and nf % TPU_SUBLANE:
+                ctx.report.append(_diag(
+                    "MXA032",
+                    f"node {n.name!r}: num_filter={nf} is not a multiple "
+                    f"of {TPU_SUBLANE}; channel tiling pads every "
+                    f"activation tile",
+                    node=n.name, op=n.op.name, detail=f"{n.name}:{nf}"))
+        elif n.op.name == "Embedding":
+            od = int(attrs.get("output_dim") or 0)
+            if od and od % TPU_LANE:
+                ctx.report.append(_diag(
+                    "MXA032",
+                    f"node {n.name!r}: output_dim={od} is not a multiple "
+                    f"of {TPU_LANE}; embedding rows pad to the lane width",
+                    node=n.name, op=n.op.name, detail=f"{n.name}:{od}"))
+
+
+# -- liveness ----------------------------------------------------------------
+
+def _pass_unused_outputs(ctx):
+    used = set()
+    for n in ctx.nodes:
+        for src, i in n.inputs:
+            used.add((id(src), i))
+    for node, i in ctx.heads:
+        used.add((id(node), i))
+    for n in ctx.nodes:
+        if n.is_var or n.num_outputs <= 1:
+            continue
+        unused = [i for i in range(n.num_outputs) if (id(n), i) not in used]
+        if unused:
+            ctx.report.append(_diag(
+                "MXA022",
+                f"node {n.name!r} ({n.op.name}) computes "
+                f"{n.num_outputs} outputs but output(s) {unused} are "
+                f"never consumed",
+                node=n.name, op=n.op.name,
+                detail=f"{n.name}:{unused}"))
+
+
+_PASSES = (
+    _pass_structural,
+    _pass_given_names,
+    _pass_inference,
+    _pass_dtype,
+    _pass_host_sync,
+    _pass_layout,
+    _pass_unused_outputs,
+)
+
+
+def validate(symbol, shapes=None, name=None):
+    """Run the full pass pipeline over a Symbol.
+
+    `shapes` maps input names to shapes (same kwargs as infer_shape);
+    without them the inference pass still runs off `__shape__` attrs and
+    parameter-shape rules, reporting what it can. Returns a Report.
+    """
+    report = Report(graph_name=name or getattr(symbol, "name", None))
+    ctx = _Ctx(symbol, shapes, report)
+    for p in _PASSES:
+        p(ctx)
+    return report
+
+
+def validate_json(json_str, shapes=None, name=None):
+    """Validate a serialized graph (`Symbol.tojson` / `*-symbol.json`).
+
+    Runs the raw-dict structural checks first — dead nodes (MXA020) and
+    unknown ops (MXA004) are only expressible in the serialized form,
+    since a live Symbol is reachable-by-construction — then, when the
+    graph is loadable, the full Symbol pipeline.
+    """
+    from ..ops.registry import OP_REGISTRY
+    from ..symbol.symbol import load_json
+
+    report = Report(graph_name=name)
+    try:
+        d = json.loads(json_str)
+    except ValueError as e:
+        report.append(_diag("MXA004", f"not a graph json: {e}"))
+        return report
+
+    nodes = d.get("nodes", [])
+    heads = d.get("heads", [])
+    loadable = True
+    for idx, nd_ in enumerate(nodes):
+        op = nd_.get("op", "null")
+        if op != "null" and op not in OP_REGISTRY:
+            loadable = False
+            report.append(_diag(
+                "MXA004",
+                f"node {nd_.get('name', idx)!r} uses unknown op {op!r}",
+                node=nd_.get("name"), op=op, detail=str(nd_.get("name"))))
+        for j, ent in enumerate(nd_.get("inputs", [])):
+            if ent[0] >= idx:
+                # forward/self reference: the json schema is topo-ordered,
+                # so this is either corruption or a cycle
+                loadable = False
+                report.append(_diag(
+                    "MXA002",
+                    f"node {nd_.get('name', idx)!r} input {j} references "
+                    f"node index {ent[0]} at or after itself",
+                    node=nd_.get("name"), detail=f"{nd_.get('name')}:{j}"))
+
+    # dead nodes: anything not reachable from the heads
+    reachable = set()
+    stack = [h[0] for h in heads if h and h[0] < len(nodes)]
+    while stack:
+        i = stack.pop()
+        if i in reachable:
+            continue
+        reachable.add(i)
+        for ent in nodes[i].get("inputs", []):
+            if 0 <= ent[0] < len(nodes):
+                stack.append(ent[0])
+    for idx, nd_ in enumerate(nodes):
+        if idx not in reachable:
+            report.append(_diag(
+                "MXA020",
+                f"node {nd_.get('name', idx)!r} "
+                f"({nd_.get('op', 'null')}) is unreachable from the graph "
+                f"heads: dead weight in the serialized graph",
+                node=nd_.get("name"), op=nd_.get("op"),
+                detail=str(nd_.get("name"))))
+
+    if loadable:
+        try:
+            symbol = load_json(json_str)
+        except Exception as e:
+            report.append(_diag(
+                "MXA004", f"graph json failed to load: {e}"))
+            return report
+        sub = validate(symbol, shapes=shapes, name=name)
+        report.extend(sub.diagnostics)
+        if report.graph_name is None:
+            report.graph_name = sub.graph_name
+    return report

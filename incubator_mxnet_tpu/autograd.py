@@ -211,7 +211,9 @@ def sparse_embedding(x, weight, input_dim, output_dim):
     if not is_recording():
         return res
 
-    host_idx = np.asarray(idx).ravel()
+    # row-sparse grad has data-dependent nnz: np.unique cannot stay on
+    # device under jit, so this sync is the cost of the sparse format
+    host_idx = np.asarray(idx).ravel()  # mxlint: disable=MXL005
     uniq, inv = np.unique(host_idx, return_inverse=True)
     inv = jnp.asarray(inv)
 

@@ -90,6 +90,16 @@ register_knob("MXTPU_EAGER_JIT_CACHE_SIZE", 512, int,
               "so tests can retune it at runtime; current size is "
               "exported as the mxtpu_eager_jit_cache_size gauge.")
 
+# static analysis
+register_knob("MXNET_GRAPH_VALIDATE", "off", str,
+              "Opt-in graph validation at Executor bind time: 'off' "
+              "(default), 'warn' (run the analysis.validate pass pipeline "
+              "over the symbol being bound and log each MXA finding), or "
+              "'raise' (additionally raise GraphValidationError on any "
+              "error-severity finding). Findings also feed the "
+              "mxtpu_graph_validate_findings_total counter when telemetry "
+              "is on. See docs/STATIC_ANALYSIS.md.")
+
 # optimizer / trainer aggregation
 register_knob("MXNET_OPTIMIZER_AGGREGATION_SIZE", 4096, int,
               "Byte cap (in KB) of one aggregated optimizer-update bucket "
@@ -176,6 +186,25 @@ register_knob("MXNET_TELEMETRY_MEM_INTERVAL", 1, int,
 # numerics / reproducibility
 register_knob("MXTPU_DEFAULT_DTYPE", "float32", str,
               "Default dtype for new NDArrays.")
+register_knob("MXTPU_SPARSE_NNZ_BUCKETING", False, bool,
+              "Pad sparse (data, indices) buffers along nnz to the next "
+              "power-of-2 bucket (floor 16) so XLA sees a few stable "
+              "shapes instead of one executable per distinct nnz. Off by "
+              "default: padding trades memory/compute for compile-cache "
+              "hits, which only pays on TPU with nnz-diverse batches.")
+
+# contrib / compatibility shims
+register_knob("MXTPU_USE_TENSORRT", False, bool,
+              "TensorRT-compat preference flag (contrib.tensorrt). Purely "
+              "advisory on TPU: XLA compiles and fuses every bind already, "
+              "so this records the script's intent rather than toggling a "
+              "graph pass (ref: MXNET_USE_TENSORRT).")
+
+# model zoo
+register_knob("MXTPU_MODELS_ROOT", "", str,
+              "Directory for downloaded model-zoo parameter files "
+              "(default ~/.mxtpu/models; ref role: MXNET_HOME model "
+              "cache).")
 
 
 # Reference knobs whose role is subsumed by the XLA/JAX substrate: the

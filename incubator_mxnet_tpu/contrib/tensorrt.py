@@ -14,6 +14,8 @@ from __future__ import annotations
 import logging
 import os
 
+from .. import config as _config
+
 __all__ = ["set_use_tensorrt", "get_use_tensorrt", "get_optimized_symbol",
            "tensorrt_bind"]
 
@@ -31,7 +33,7 @@ def set_use_tensorrt(status):
 
 
 def get_use_tensorrt():
-    return os.environ.get(_ENV, "0") == "1"
+    return _config.get(_ENV)
 
 
 def get_optimized_symbol(executor):

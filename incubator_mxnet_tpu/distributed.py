@@ -10,7 +10,7 @@ DCN across hosts — serverless all-reduce instead of parameter servers.
 """
 from __future__ import annotations
 
-import os
+from . import config as _config
 
 __all__ = ["init_from_env", "is_initialized"]
 
@@ -35,15 +35,15 @@ def init_from_env():
             return True
     except AttributeError:  # older jax without is_initialized
         pass
-    coord = os.environ.get("MXTPU_COORDINATOR")
-    nproc = os.environ.get("MXTPU_NUM_PROCESSES")
-    if not coord or not nproc or int(nproc) <= 1:
+    coord = _config.get("MXTPU_COORDINATOR")
+    nproc = _config.get("MXTPU_NUM_PROCESSES")
+    if not coord or nproc <= 1:
         return False
     try:
         jax.distributed.initialize(
             coordinator_address=coord,
-            num_processes=int(nproc),
-            process_id=int(os.environ.get("MXTPU_PROCESS_ID", "0")),
+            num_processes=nproc,
+            process_id=_config.get("MXTPU_PROCESS_ID"),
         )
     except RuntimeError as e:
         # backend already started (a computation ran before kvstore.create):

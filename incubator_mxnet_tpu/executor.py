@@ -10,14 +10,48 @@ reference API while keeping everything async on device.
 """
 from __future__ import annotations
 
+import logging
+
 import jax
 import jax.numpy as jnp
 
+from . import config as _config
 from . import random as _global_random
 from . import telemetry as _telemetry
 from .ndarray.ndarray import NDArray
 
 __all__ = ["Executor"]
+
+_log = logging.getLogger(__name__)
+
+_VALIDATE_FINDINGS = "mxtpu_graph_validate_findings_total"
+
+
+def _maybe_validate(symbol, args, aux):
+    """Opt-in bind-time graph validation (MXNET_GRAPH_VALIDATE=warn|raise).
+
+    The nnvm analog: the reference runs shape/type passes inside
+    GraphExecutor::Init before any kernel exists; here the validator runs
+    the same checks over the symbol being bound, using the bound arrays'
+    shapes as ground truth, so a bad graph fails with per-node MXA
+    diagnostics instead of a node-anonymous XLA trace error."""
+    mode = str(_config.get("MXNET_GRAPH_VALIDATE")).lower()
+    if mode in ("", "off", "0", "false"):
+        return
+    from .analysis import validate as _validate
+
+    shapes = {n: tuple(a.shape) for n, a in {**args, **aux}.items()
+              if a is not None}
+    report = _validate(symbol, shapes=shapes)
+    for d in report:
+        _telemetry.inc(
+            _VALIDATE_FINDINGS, 1,
+            help="Findings emitted by bind-time graph validation "
+                 "(MXNET_GRAPH_VALIDATE), by code and severity.",
+            code=d.code, severity=str(d.severity))
+        _log.warning("graph validation: %s", d)
+    if mode == "raise":
+        report.raise_if_errors()
 
 
 class Executor:
@@ -28,6 +62,7 @@ class Executor:
         self.grad_dict = dict(args_grad or {})
         self.grad_req = dict(grad_req)
         self.aux_dict = dict(aux_states or {})
+        _maybe_validate(symbol, self.arg_dict, self.aux_dict)
         self._eval_fn = symbol.make_eval_fn()
         self._needs_rng = any(
             (not n.is_var) and n.op.needs_rng for n in symbol._topo_nodes()

@@ -22,7 +22,9 @@ def model_root(root=None):
     override with MXTPU_MODELS_ROOT."""
     if root:
         return os.path.expanduser(root)
-    env = os.environ.get("MXTPU_MODELS_ROOT")
+    from ... import config as _config
+
+    env = _config.get("MXTPU_MODELS_ROOT")
     if env:
         return os.path.expanduser(env)
     return os.path.expanduser(os.path.join("~", ".mxnet", "models"))

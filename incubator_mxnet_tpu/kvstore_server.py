@@ -28,8 +28,11 @@ class KVStoreServer:
         self.kvstore = kvstore  # accepted for API parity; the server loop
         # here is self-contained and does not need a worker-side store
         if num_workers is None:
-            num_workers = int(os.environ.get(
-                "MXTPU_NUM_WORKERS", os.environ.get("DMLC_NUM_WORKER", "1")))
+            # launcher wire protocol (reference DMLC_* pairing) -- raw env
+            # read by design, like MXTPU_ROLE below
+            num_workers = int(os.environ.get(  # mxlint: disable=MXL007
+                "MXTPU_NUM_WORKERS",
+                os.environ.get("DMLC_NUM_WORKER", "1")))
         addr_host, addr_port = default_server_addr()
         self._server = ParameterServer(
             num_workers=num_workers,
@@ -48,7 +51,11 @@ class KVStoreServer:
 def _init_kvstore_server_module():
     """If this process was launched in the server role, run the server
     loop and exit — mirrors the reference's import-time role check."""
-    role = os.environ.get("MXTPU_ROLE", os.environ.get("DMLC_ROLE", ""))
+    # launcher wire protocol, read before any framework import may
+    # finish (paired with the reference DMLC_* names) -- stays a raw
+    # env read by design
+    role = os.environ.get("MXTPU_ROLE",  # mxlint: disable=MXL007
+                          os.environ.get("DMLC_ROLE", ""))
     if role == "server":
         server = KVStoreServer()
         server.run()

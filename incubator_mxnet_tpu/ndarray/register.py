@@ -46,7 +46,7 @@ def _eager_jit_cache_cap():
     """Env read at insert time (misses only — the hit path stays a dict
     lookup), same runtime-retunable contract as MXTPU_EAGER_JIT; the knob
     is documented in config.py. 0 = unbounded."""
-    raw = os.environ.get("MXTPU_EAGER_JIT_CACHE_SIZE")
+    raw = os.environ.get("MXTPU_EAGER_JIT_CACHE_SIZE")  # mxlint: disable=MXL007
     if raw is None:
         return _EAGER_JIT_CACHE_DEFAULT_CAP
     try:
@@ -71,7 +71,7 @@ def _eager_jit_enabled():
     """Per-call read of MXTPU_EAGER_JIT (tests toggle it at runtime), kept
     off the config registry's knob machinery — this is the hottest line of
     eager dispatch. The knob stays documented in config.py."""
-    raw = os.environ.get("MXTPU_EAGER_JIT")
+    raw = os.environ.get("MXTPU_EAGER_JIT")  # mxlint: disable=MXL007
     if raw is None:
         return False
     return raw.lower() not in ("0", "false", "off", "")

@@ -473,6 +473,7 @@ def _f32_reduce(fn, data, *args, **kwargs):
 @register("softmax", optional=("length",), no_grad_inputs=("length",))
 def softmax(data, length=None, *, axis=-1, temperature=None,
             use_length=None):
+    """Softmax over `axis`, with optional `temperature` scaling."""
     x = data / temperature if temperature else data
     if use_length is False:  # reference scripts pass use_length explicitly
         length = None
@@ -492,17 +493,20 @@ def softmax(data, length=None, *, axis=-1, temperature=None,
 
 @register("log_softmax")
 def log_softmax(data, *, axis=-1, temperature=None):
+    """Numerically stable log of softmax over `axis`."""
     x = data / temperature if temperature else data
     return _f32_reduce(jax.nn.log_softmax, x, axis=axis)
 
 
 @register("softmin")
 def softmin(data, *, axis=-1):
+    """Softmax of the negated input: smallest values get the largest weights."""
     return _f32_reduce(jax.nn.softmax, -data, axis=axis)
 
 
 @register("SoftmaxActivation")
 def softmax_activation(data, *, mode="instance"):
+    """Reference-compat softmax over channels (or the whole instance)."""
     if mode == "channel":
         return _f32_reduce(jax.nn.softmax, data, axis=1)
     return _f32_reduce(jax.nn.softmax, data.reshape(data.shape[0], -1),
@@ -511,6 +515,8 @@ def softmax_activation(data, *, mode="instance"):
 
 @register("softmax_cross_entropy", no_grad_inputs=("label",))
 def softmax_cross_entropy(data, label):
+    """Cross-entropy between softmax(data) and integer labels, summed over the
+    batch."""
     logp = _f32_reduce(jax.nn.log_softmax, data, axis=-1)
     lbl = label.astype(jnp.int32)
     return -jnp.sum(jnp.take_along_axis(logp, lbl[:, None], axis=-1))
@@ -620,6 +626,8 @@ def softmax_output(
     out_grad=False,
     smooth_alpha=0.0,
 ):
+    """Softmax forward whose backward is (softmax - one_hot(label)) *
+    grad_scale -- the reference's fused softmax loss layer."""
     return _softmax_output(
         data, label, float(grad_scale), float(ignore_label), bool(use_ignore),
         bool(multi_output), normalization, float(smooth_alpha),
@@ -644,6 +652,8 @@ def _make_regression_output(name, fwd_fn, grad_fn):
 
     @register(name, no_grad_inputs=("label",))
     def op(data, label, *, grad_scale=1.0):
+        """Regression output: forward activation with the reference's fixed
+        loss gradient (out - label), attached via custom vjp."""
         return _impl(data, label, float(grad_scale))
 
     op.__name__ = name

@@ -43,6 +43,7 @@ def _one_minus_pow(beta, t):
 
 @register("sgd_update", no_grad_inputs=("weight", "grad"))
 def sgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    """SGD step: weight -= lr * (rescaled, clipped grad + wd * weight)."""
     g = _rescale_clip(grad, rescale_grad, clip_gradient)
     return weight - lr * (g + wd * weight)
 
@@ -51,6 +52,7 @@ def sgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0
 def sgd_mom_update(
     weight, grad, mom, *, lr, momentum=0.0, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True
 ):
+    """Momentum SGD step: mom = momentum * mom - lr * grad; weight += mom."""
     g = _rescale_clip(grad, rescale_grad, clip_gradient)
     new_mom = momentum * mom - lr * (g + wd * weight)
     return weight + new_mom, new_mom
@@ -58,6 +60,7 @@ def sgd_mom_update(
 
 @register("nag_mom_update", num_outputs=2, no_grad_inputs=("weight", "grad", "mom"))
 def nag_mom_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """Nesterov accelerated SGD step (gradient looked ahead through momentum)."""
     g = _rescale_clip(grad, rescale_grad, clip_gradient) + wd * weight
     new_mom = momentum * mom + g
     return weight - lr * (g + momentum * new_mom), new_mom
@@ -68,6 +71,7 @@ def adam_update(
     weight, grad, mean, var, *, lr, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
     rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True,
 ):
+    """Adam step: first/second-moment EMAs with epsilon-stabilized update."""
     g = _rescale_clip(grad, rescale_grad, clip_gradient) + wd * weight
     new_mean = beta1 * mean + (1 - beta1) * g
     new_var = beta2 * var + (1 - beta2) * jnp.square(g)
@@ -79,6 +83,7 @@ def rmsprop_update(
     weight, grad, n, *, lr, gamma1=0.95, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
     clip_gradient=-1.0, clip_weights=-1.0,
 ):
+    """RMSProp step: scale the gradient by the sqrt of a squared-grad EMA."""
     g = _rescale_clip(grad, rescale_grad, clip_gradient) + wd * weight
     new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
     new_w = weight - lr * g / jnp.sqrt(new_n + epsilon)
@@ -92,6 +97,8 @@ def rmspropalex_update(
     weight, grad, n, g, delta, *, lr, gamma1=0.95, gamma2=0.9, epsilon=1e-8, wd=0.0,
     rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0,
 ):
+    """Centered RMSProp (Alex Graves' variant): additionally tracks the grad
+    mean."""
     gr = _rescale_clip(grad, rescale_grad, clip_gradient) + wd * weight
     new_n = (1 - gamma1) * jnp.square(gr) + gamma1 * n
     new_g = (1 - gamma1) * gr + gamma1 * g
@@ -106,6 +113,7 @@ def rmspropalex_update(
 def ftrl_update(
     weight, grad, z, n, *, lr, lamda1=0.01, beta=1.0, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0
 ):
+    """FTRL-proximal step with L1/L2 regularization."""
     g = _rescale_clip(grad, rescale_grad, clip_gradient)
     new_n = n + jnp.square(g)
     sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
@@ -120,6 +128,7 @@ def ftrl_update(
 
 @register("signsgd_update", no_grad_inputs=("weight", "grad"))
 def signsgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """SignSGD step: weight -= lr * sign(grad)."""
     g = _rescale_clip(grad, rescale_grad, clip_gradient)
     return weight - lr * (jnp.sign(g) + wd * weight)
 
@@ -128,6 +137,7 @@ def signsgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0, clip_gradient=
 def signum_update(
     weight, grad, mom, *, lr, momentum=0.0, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0
 ):
+    """Signum step: SignSGD applied to a momentum buffer."""
     g = _rescale_clip(grad, rescale_grad, clip_gradient)
     new_mom = momentum * mom - (1 - momentum) * (g + wd * weight)
     new_w = weight * (1 - lr * wd_lh) + lr * jnp.sign(new_mom)
@@ -139,6 +149,7 @@ def ftml_update(
     weight, grad, d, v, z, *, lr, beta1=0.6, beta2=0.999, epsilon=1e-8, wd=0.0,
     rescale_grad=1.0, clip_grad=-1.0, t=1,
 ):
+    """FTML (Follow The Moving Leader) step."""
     g = _rescale_clip(grad, rescale_grad, clip_grad) + wd * weight
     new_v = beta2 * v + (1 - beta2) * jnp.square(g)
     d_t = (_one_minus_pow(beta1, t) / lr
@@ -154,6 +165,7 @@ def adamw_update(
     weight, grad, mean, var, *, lr, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
     rescale_grad=1.0, clip_gradient=-1.0,
 ):
+    """AdamW step: Adam with decoupled weight decay (eta * lr * wd * weight)."""
     g = _rescale_clip(grad, rescale_grad, clip_gradient)
     new_mean = beta1 * mean + (1 - beta1) * g
     new_var = beta2 * var + (1 - beta2) * jnp.square(g)
@@ -217,6 +229,7 @@ def _per_weight(attr, i, what):
 @register("multi_sgd_update", num_outputs=lambda attrs: _nw(attrs))
 def multi_sgd_update(*args, lrs, wds, num_weights, rescale_grad=1.0,
                      clip_gradient=-1.0):
+    """Aggregated SGD step over many (weight, grad) pairs in one fused program."""
     outs = []
     for i, (w, g) in enumerate(_multi_groups(args, 2, num_weights)):
         outs.append(sgd_update(
@@ -229,6 +242,7 @@ def multi_sgd_update(*args, lrs, wds, num_weights, rescale_grad=1.0,
           num_outputs=lambda attrs: 2 * _nw(attrs))
 def multi_sgd_mom_update(*args, lrs, wds, num_weights, momentum=0.0,
                          rescale_grad=1.0, clip_gradient=-1.0):
+    """Aggregated momentum-SGD step over many (weight, grad, mom) triples."""
     ws, ms = [], []
     for i, (w, g, m) in enumerate(_multi_groups(args, 3, num_weights)):
         new_w, new_m = sgd_mom_update(
@@ -261,6 +275,8 @@ def multi_mp_sgd_update(*args, lrs, wds, num_weights, rescale_grad=1.0,
           num_outputs=lambda attrs: 3 * _nw(attrs))
 def multi_mp_sgd_mom_update(*args, lrs, wds, num_weights, momentum=0.0,
                             rescale_grad=1.0, clip_gradient=-1.0):
+    """Aggregated mixed-precision momentum SGD: low-precision weights with fp32
+    master copies and momenta."""
     ws, ms, w32s = [], [], []
     for i, (w, g, m, w32) in enumerate(_multi_groups(args, 4, num_weights)):
         new_w32, new_m = sgd_mom_update(

@@ -150,6 +150,8 @@ def _contrib_quantize_v2(data, *, out_type="int8", min_calib_range=None,
 @register("_contrib_dequantize",
           no_grad_inputs=("data", "min_range", "max_range"))
 def _contrib_dequantize(data, min_range, max_range, *, out_type="float32"):
+    """Map int8/uint8 values back to float32 using the recorded (min, max)
+    range."""
     if out_type != "float32":
         raise NotImplementedError(
             f"dequantize out_type='{out_type}': only float32 reconstruction "
@@ -185,6 +187,7 @@ def _contrib_requantize(data, min_range, max_range, *, min_calib_range=None,
 @register("_contrib_quantized_flatten", num_outputs=3,
           no_grad_inputs=("data", "min_range", "max_range"))
 def _contrib_quantized_flatten(data, min_range, max_range):
+    """Flatten quantized data, passing its (min, max) range through unchanged."""
     return data.reshape(data.shape[0], -1), min_range, max_range
 
 

@@ -21,31 +21,37 @@ def _dt(dtype):
 
 @register("_random_uniform", aliases=("uniform",), needs_rng=True)
 def random_uniform(*, low=0.0, high=1.0, shape=(1,), dtype="float32", _rng=None):
+    """Draw Uniform(low, high) samples with the given shape."""
     return jax.random.uniform(_rng, shape, minval=low, maxval=high, dtype=_dt(dtype))
 
 
 @register("_random_normal", aliases=("normal",), needs_rng=True)
 def random_normal(*, loc=0.0, scale=1.0, shape=(1,), dtype="float32", _rng=None):
+    """Draw Normal(loc, scale) samples with the given shape."""
     return loc + scale * jax.random.normal(_rng, shape, dtype=_dt(dtype))
 
 
 @register("_random_gamma", aliases=("gamma_sample",), needs_rng=True)
 def random_gamma(*, alpha=1.0, beta=1.0, shape=(1,), dtype="float32", _rng=None):
+    """Draw Gamma(alpha, beta) samples with the given shape."""
     return beta * jax.random.gamma(_rng, alpha, shape, dtype=_dt(dtype))
 
 
 @register("_random_exponential", needs_rng=True)
 def random_exponential(*, lam=1.0, shape=(1,), dtype="float32", _rng=None):
+    """Draw Exponential(lam) samples with the given shape."""
     return jax.random.exponential(_rng, shape, dtype=_dt(dtype)) / lam
 
 
 @register("_random_poisson", needs_rng=True)
 def random_poisson(*, lam=1.0, shape=(1,), dtype="float32", _rng=None):
+    """Draw Poisson(lam) samples with the given shape."""
     return jax.random.poisson(_rng, lam, shape).astype(_dt(dtype))
 
 
 @register("_random_negative_binomial", needs_rng=True)
 def random_negative_binomial(*, k=1, p=0.5, shape=(1,), dtype="float32", _rng=None):
+    """Draw NegativeBinomial(k, p) samples with the given shape."""
     k1, k2 = jax.random.split(_rng)
     lam = jax.random.gamma(k1, k, shape) * (1 - p) / p
     return jax.random.poisson(k2, lam, shape).astype(_dt(dtype))
@@ -53,6 +59,8 @@ def random_negative_binomial(*, k=1, p=0.5, shape=(1,), dtype="float32", _rng=No
 
 @register("_random_generalized_negative_binomial", needs_rng=True)
 def random_gen_neg_binomial(*, mu=1.0, alpha=1.0, shape=(1,), dtype="float32", _rng=None):
+    """Draw generalized-negative-binomial (mu, alpha) samples with the given
+    shape."""
     k1, k2 = jax.random.split(_rng)
     r = 1.0 / alpha
     p = r / (r + mu)
@@ -62,11 +70,14 @@ def random_gen_neg_binomial(*, mu=1.0, alpha=1.0, shape=(1,), dtype="float32", _
 
 @register("_random_randint", aliases=("randint",), needs_rng=True)
 def random_randint(*, low=0, high=1, shape=(1,), dtype="int32", _rng=None):
+    """Draw integers uniformly from [low, high) with the given shape."""
     return jax.random.randint(_rng, shape, low, high, dtype=_dt(dtype))
 
 
 @register("_sample_unique_zipfian", needs_rng=True)
 def sample_unique_zipfian(*, range_max=1, shape=(1,), _rng=None):
+    """Draw unique samples from an approximate Zipfian over [0, range_max);
+    rejection sampling makes the work data-dependent (host-syncs under jit)."""
     u = jax.random.uniform(_rng, shape)
     cls = (jnp.exp(u * jnp.log(range_max + 1.0)) - 1.0).astype(jnp.int64)
     return jnp.clip(cls, 0, range_max - 1)
@@ -76,6 +87,7 @@ def sample_unique_zipfian(*, range_max=1, shape=(1,), _rng=None):
           no_grad_inputs=("data",),
           num_outputs=lambda attrs: 2 if attrs.get("get_prob") else 1)
 def sample_multinomial(data, *, shape=(), get_prob=False, dtype="int32", _rng=None):
+    """Draw category indices from each row's probability distribution."""
     n = int(jnp.prod(jnp.array(shape))) if shape else 1
     logits = jnp.log(jnp.maximum(data, 1e-30))
     if data.ndim == 1:
@@ -99,11 +111,13 @@ def sample_multinomial(data, *, shape=(), get_prob=False, dtype="int32", _rng=No
 
 @register("_shuffle", aliases=("shuffle",), needs_rng=True)
 def shuffle(data, *, _rng=None):
+    """Randomly permute the input along its first axis."""
     return jax.random.permutation(_rng, data, axis=0)
 
 
 @register("_random_bernoulli", aliases=("bernoulli",), needs_rng=True)
 def bernoulli(*, p=0.5, shape=(1,), dtype="float32", _rng=None):
+    """Draw Bernoulli samples from per-element probabilities (or logits)."""
     return jax.random.bernoulli(_rng, p, shape).astype(_dt(dtype))
 
 
@@ -126,6 +140,7 @@ def _expand(p, shape):
 @register("_sample_uniform", aliases=("sample_uniform",), needs_rng=True,
           no_grad_inputs=("low", "high"))
 def sample_uniform(low, high, *, shape=(), dtype="float32", _rng=None):
+    """Per-row Uniform draws: row i samples from (low[i], high[i])."""
     shape = _shape_tuple(shape)
     u = jax.random.uniform(_rng, tuple(low.shape) + shape, dtype=_dt(dtype))
     return _expand(low, shape) + u * (_expand(high, shape) - _expand(low, shape))
@@ -134,6 +149,7 @@ def sample_uniform(low, high, *, shape=(), dtype="float32", _rng=None):
 @register("_sample_normal", aliases=("sample_normal",), needs_rng=True,
           no_grad_inputs=("mu", "sigma"))
 def sample_normal(mu, sigma, *, shape=(), dtype="float32", _rng=None):
+    """Per-row Normal draws: row i samples from (mu[i], sigma[i])."""
     shape = _shape_tuple(shape)
     z = jax.random.normal(_rng, tuple(mu.shape) + shape, dtype=_dt(dtype))
     return _expand(mu, shape) + _expand(sigma, shape) * z
@@ -142,6 +158,7 @@ def sample_normal(mu, sigma, *, shape=(), dtype="float32", _rng=None):
 @register("_sample_gamma", aliases=("sample_gamma",), needs_rng=True,
           no_grad_inputs=("alpha", "beta"))
 def sample_gamma(alpha, beta, *, shape=(), dtype="float32", _rng=None):
+    """Per-row Gamma draws from (alpha[i], beta[i])."""
     shape = _shape_tuple(shape)
     g = jax.random.gamma(_rng, _expand(alpha, shape),
                          tuple(alpha.shape) + shape, dtype=_dt(dtype))
@@ -151,6 +168,7 @@ def sample_gamma(alpha, beta, *, shape=(), dtype="float32", _rng=None):
 @register("_sample_exponential", aliases=("sample_exponential",),
           needs_rng=True, no_grad_inputs=("lam",))
 def sample_exponential(lam, *, shape=(), dtype="float32", _rng=None):
+    """Per-row Exponential draws from lam[i]."""
     shape = _shape_tuple(shape)
     e = jax.random.exponential(_rng, tuple(lam.shape) + shape, dtype=_dt(dtype))
     return e / _expand(lam, shape)
@@ -159,6 +177,7 @@ def sample_exponential(lam, *, shape=(), dtype="float32", _rng=None):
 @register("_sample_poisson", aliases=("sample_poisson",), needs_rng=True,
           no_grad_inputs=("lam",))
 def sample_poisson(lam, *, shape=(), dtype="float32", _rng=None):
+    """Per-row Poisson draws from lam[i]."""
     shape = _shape_tuple(shape)
     return jax.random.poisson(_rng, _expand(lam, shape),
                               tuple(lam.shape) + shape).astype(_dt(dtype))
@@ -167,6 +186,7 @@ def sample_poisson(lam, *, shape=(), dtype="float32", _rng=None):
 @register("_sample_negative_binomial", aliases=("sample_negative_binomial",),
           needs_rng=True, no_grad_inputs=("k", "p"))
 def sample_negative_binomial(k, p, *, shape=(), dtype="float32", _rng=None):
+    """Per-row NegativeBinomial draws from (k[i], p[i])."""
     shape = _shape_tuple(shape)
     k1, k2 = jax.random.split(_rng)
     full = tuple(k.shape) + shape
@@ -179,6 +199,7 @@ def sample_negative_binomial(k, p, *, shape=(), dtype="float32", _rng=None):
           aliases=("sample_generalized_negative_binomial",), needs_rng=True,
           no_grad_inputs=("mu", "alpha"))
 def sample_gen_neg_binomial(mu, alpha, *, shape=(), dtype="float32", _rng=None):
+    """Per-row generalized-negative-binomial draws from (mu[i], alpha[i])."""
     shape = _shape_tuple(shape)
     k1, k2 = jax.random.split(_rng)
     full = tuple(mu.shape) + shape

@@ -24,6 +24,8 @@ from .registry import register, alias
 def _binary(name, fn, aliases=()):
     @register(name, aliases=aliases)
     def op(lhs, rhs):
+        """Elementwise binary operator with numpy broadcasting; the registered
+        name (e.g. broadcast_add) selects the function."""
         return fn(lhs, rhs)
 
     op.__name__ = name
@@ -76,6 +78,8 @@ _binary(
 def _scalar_op(name, fn, aliases=()):
     @register(name, aliases=aliases)
     def op(data, *, scalar=1.0):
+        """Elementwise op against a static `scalar` attr (ref:
+        elemwise_binary_scalar_op)."""
         return fn(data, scalar)
 
     op.__name__ = name
@@ -110,6 +114,7 @@ _scalar_op("_lesser_equal_scalar", lambda x, s: (x <= s).astype(x.dtype))
 def _unary(name, fn, aliases=()):
     @register(name, aliases=aliases)
     def op(data):
+        """Elementwise unary function applied to the whole array."""
         return fn(data)
 
     op.__name__ = name
@@ -166,6 +171,7 @@ _unary("make_loss", lambda x: x, aliases=("MakeLoss",))
 
 @register("smooth_l1")
 def smooth_l1(data, *, scalar=1.0):
+    """Elementwise smooth-L1: quadratic inside |x| < 1/sigma^2, linear outside."""
     s2 = scalar * scalar
     absd = jnp.abs(data)
     return jnp.where(absd < 1.0 / s2, 0.5 * s2 * data * data, absd - 0.5 / s2)
@@ -173,11 +179,13 @@ def smooth_l1(data, *, scalar=1.0):
 
 @register("clip")
 def clip(data, *, a_min=0.0, a_max=1.0):
+    """Clamp values to [a_min, a_max]."""
     return jnp.clip(data, a_min, a_max)
 
 
 @register("Cast", aliases=("cast",))
 def cast(data, *, dtype="float32"):
+    """Cast to `dtype`."""
     from ..base import dtype_np
 
     return data.astype(dtype_np(dtype))
@@ -185,6 +193,8 @@ def cast(data, *, dtype="float32"):
 
 @register("amp_cast")
 def amp_cast(data, *, dtype="float32"):
+    """AMP dtype cast -- same as `cast`, kept distinct so AMP graph passes can
+    target it."""
     from ..base import dtype_np
 
     return data.astype(dtype_np(dtype))
@@ -211,6 +221,8 @@ def _norm_axis(axis, ndim, exclude=False):
 def _reduce(name, fn, aliases=()):
     @register(name, aliases=aliases)
     def op(data, *, axis=None, keepdims=False, exclude=False):
+        """Reduction over `axis` (None = all axes) with keepdims/exclude
+        semantics."""
         ax = _norm_axis(axis, data.ndim, exclude)
         return fn(data, axis=ax, keepdims=keepdims)
 
@@ -229,6 +241,7 @@ _reduce("min", jnp.min, aliases=("min_axis",))
 
 @register("norm")
 def norm(data, *, ord=2, axis=None, keepdims=False):
+    """Vector norm of order `ord` over `axis`."""
     ax = None if axis is None else (axis if isinstance(axis, tuple) else (axis,))
     if ord == 1:
         r = jnp.sum(jnp.abs(data), axis=ax, keepdims=keepdims)
@@ -239,18 +252,21 @@ def norm(data, *, ord=2, axis=None, keepdims=False):
 
 @register("argmax", no_grad_inputs=("data",))
 def argmax(data, *, axis=None, keepdims=False):
+    """Index of the maximum along `axis`, as float (reference convention)."""
     out = jnp.argmax(data, axis=axis, keepdims=keepdims)
     return out.astype(jnp.float32)
 
 
 @register("argmin", no_grad_inputs=("data",))
 def argmin(data, *, axis=None, keepdims=False):
+    """Index of the minimum along `axis`, as float (reference convention)."""
     out = jnp.argmin(data, axis=axis, keepdims=keepdims)
     return out.astype(jnp.float32)
 
 
 @register("argmax_channel", no_grad_inputs=("data",))
 def argmax_channel(data):
+    """Argmax over axis 1 (the channel dim) for each instance."""
     return jnp.argmax(data, axis=1).astype(jnp.float32)
 
 
@@ -261,6 +277,7 @@ def argmax_channel(data):
 
 @register("dot")
 def dot(lhs, rhs, *, transpose_a=False, transpose_b=False):
+    """Dot / matrix product of the two inputs, with optional transposes."""
     a = lhs.T if transpose_a else lhs
     b = rhs.T if transpose_b else rhs
     if a.ndim == 1 and b.ndim == 1:
@@ -271,6 +288,7 @@ def dot(lhs, rhs, *, transpose_a=False, transpose_b=False):
 
 @register("batch_dot")
 def batch_dot(lhs, rhs, *, transpose_a=False, transpose_b=False):
+    """Batched matrix product over the leading batch dimension."""
     a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
     b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
     return jnp.matmul(a, b)
@@ -278,6 +296,7 @@ def batch_dot(lhs, rhs, *, transpose_a=False, transpose_b=False):
 
 @register("_linalg_gemm2", aliases=("linalg_gemm2",))
 def linalg_gemm2(A, B, *, transpose_a=False, transpose_b=False, alpha=1.0):
+    """GEMM: alpha * op(A) @ op(B) with optional transposes."""
     a = jnp.swapaxes(A, -1, -2) if transpose_a else A
     b = jnp.swapaxes(B, -1, -2) if transpose_b else B
     return alpha * jnp.matmul(a, b)
@@ -285,6 +304,7 @@ def linalg_gemm2(A, B, *, transpose_a=False, transpose_b=False, alpha=1.0):
 
 @register("_linalg_gemm", aliases=("linalg_gemm",))
 def linalg_gemm(A, B, C, *, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0):
+    """GEMM with accumulate: alpha * op(A) @ op(B) + beta * C."""
     a = jnp.swapaxes(A, -1, -2) if transpose_a else A
     b = jnp.swapaxes(B, -1, -2) if transpose_b else B
     return alpha * jnp.matmul(a, b) + beta * C
@@ -292,11 +312,13 @@ def linalg_gemm(A, B, C, *, transpose_a=False, transpose_b=False, alpha=1.0, bet
 
 @register("_linalg_potrf", aliases=("linalg_potrf",))
 def linalg_potrf(A):
+    """Cholesky factor of a symmetric positive-definite matrix."""
     return jnp.linalg.cholesky(A)
 
 
 @register("_linalg_potri", aliases=("linalg_potri",))
 def linalg_potri(A):
+    """Matrix inverse from a Cholesky factor (potrf output)."""
     L = A
     ident = jnp.broadcast_to(jnp.eye(L.shape[-1], dtype=L.dtype), L.shape)
     Linv = jax.scipy.linalg.solve_triangular(L, ident, lower=True)
@@ -305,6 +327,7 @@ def linalg_potri(A):
 
 @register("_linalg_trsm", aliases=("linalg_trsm",))
 def linalg_trsm(A, B, *, transpose=False, rightside=False, lower=True, alpha=1.0):
+    """Triangular solve against B (left or right sided), scaled by alpha."""
     a = jnp.swapaxes(A, -1, -2) if transpose else A
     low = bool(lower) != bool(transpose)
     if rightside:
@@ -317,6 +340,7 @@ def linalg_trsm(A, B, *, transpose=False, rightside=False, lower=True, alpha=1.0
 
 @register("_linalg_trmm", aliases=("linalg_trmm",))
 def linalg_trmm(A, B, *, transpose=False, rightside=False, lower=True, alpha=1.0):
+    """Triangular matrix product: alpha * op(A) @ B (left or right sided)."""
     a = jnp.swapaxes(A, -1, -2) if transpose else A
     a = jnp.tril(a) if (bool(lower) != bool(transpose)) else jnp.triu(a)
     return alpha * (jnp.matmul(B, a) if rightside else jnp.matmul(a, B))
@@ -324,22 +348,26 @@ def linalg_trmm(A, B, *, transpose=False, rightside=False, lower=True, alpha=1.0
 
 @register("_linalg_syrk", aliases=("linalg_syrk",))
 def linalg_syrk(A, *, transpose=False, alpha=1.0):
+    """Symmetric rank-k update: alpha * A @ A^T (or A^T @ A)."""
     at = jnp.swapaxes(A, -1, -2)
     return alpha * (jnp.matmul(at, A) if transpose else jnp.matmul(A, at))
 
 
 @register("_linalg_sumlogdiag", aliases=("linalg_sumlogdiag",))
 def linalg_sumlogdiag(A):
+    """Sum of the log of the diagonal entries (log-det from a Cholesky factor)."""
     return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
 
 
 @register("_linalg_extractdiag", aliases=("linalg_extractdiag",))
 def linalg_extractdiag(A, *, offset=0):
+    """Extract the k-th diagonal as a vector."""
     return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
 
 
 @register("_linalg_makediag", aliases=("linalg_makediag",))
 def linalg_makediag(A, *, offset=0):
+    """Embed a vector as the k-th diagonal of a square matrix."""
     return jax.vmap(lambda v: jnp.diag(v, k=offset))(A.reshape((-1, A.shape[-1]))).reshape(
         A.shape[:-1] + (A.shape[-1] + abs(offset),) * 2
     ) if A.ndim > 1 else jnp.diag(A, k=offset)
@@ -353,6 +381,8 @@ def linalg_makediag(A, *, offset=0):
 @register("Reshape", aliases=("reshape",))
 def reshape(data, *, shape=None, reverse=False):
     # supports MXNet magic numbers 0 (copy dim) and -1 (infer); -2/-3/-4 subset
+    """Reshape with the reference's special codes: 0 copy dim, -1 infer, -2
+    copy rest, -3 merge two, -4 split."""
     if shape is None:
         return data
     src = list(data.shape)
@@ -391,47 +421,57 @@ def reshape(data, *, shape=None, reverse=False):
 
 @register("reshape_like")
 def reshape_like(lhs, rhs):
+    """Reshape data to the shape of a second input (optionally a slice of its
+    dims)."""
     return jnp.reshape(lhs, rhs.shape)
 
 
 @register("Flatten", aliases=("flatten",))
 def flatten(data):
+    """Collapse all dims after the first into one."""
     return jnp.reshape(data, (data.shape[0], -1))
 
 
 @register("transpose")
 def transpose(data, *, axes=None):
+    """Permute axes (reversed order when `axes` is empty)."""
     return jnp.transpose(data, axes=axes if axes else None)
 
 
 @register("SwapAxis", aliases=("swapaxes",))
 def swapaxes(data, *, dim1=0, dim2=0):
+    """Exchange two axes."""
     return jnp.swapaxes(data, dim1, dim2)
 
 
 @register("expand_dims")
 def expand_dims(data, *, axis=0):
+    """Insert a size-1 axis at `axis`."""
     return jnp.expand_dims(data, axis)
 
 
 @register("squeeze")
 def squeeze(data, *, axis=None):
+    """Remove size-1 axes (all of them, or just `axis`)."""
     return jnp.squeeze(data, axis=axis)
 
 
 @register("broadcast_to")
 def broadcast_to(data, *, shape=None):
+    """Broadcast to `shape` (0 keeps the input's dim)."""
     tgt = tuple(d if s == 0 else s for s, d in zip(shape, data.shape))
     return jnp.broadcast_to(data, tgt)
 
 
 @register("broadcast_like")
 def broadcast_like(lhs, rhs):
+    """Broadcast data to the shape of a second input."""
     return jnp.broadcast_to(lhs, rhs.shape)
 
 
 @register("broadcast_axis", aliases=("broadcast_axes",))
 def broadcast_axis(data, *, axis=(), size=()):
+    """Broadcast the given size-1 axes to the given sizes."""
     axes = (axis,) if isinstance(axis, int) else tuple(axis)
     sizes = (size,) if isinstance(size, int) else tuple(size)
     tgt = list(data.shape)
@@ -442,26 +482,31 @@ def broadcast_axis(data, *, axis=(), size=()):
 
 @register("zeros_like")
 def zeros_like(data):
+    """Zeros with the input's shape and dtype."""
     return jnp.zeros_like(data)
 
 
 @register("ones_like")
 def ones_like(data):
+    """Ones with the input's shape and dtype."""
     return jnp.ones_like(data)
 
 
 @register("shape_array", no_grad_inputs=("data",))
 def shape_array(data):
+    """The input's shape as a 1-D int64 array."""
     return jnp.array(data.shape, dtype=jnp.int64)
 
 
 @register("size_array", no_grad_inputs=("data",))
 def size_array(data):
+    """The input's element count as a 1-element int64 array."""
     return jnp.array([data.size], dtype=jnp.int64)
 
 
 @register("slice")
 def slice_op(data, *, begin=(), end=(), step=()):
+    """Slice with per-axis begin/end/step (the reference's `slice`)."""
     idx = []
     for i in range(data.ndim):
         b = begin[i] if i < len(begin) else None
@@ -473,6 +518,7 @@ def slice_op(data, *, begin=(), end=(), step=()):
 
 @register("slice_axis")
 def slice_axis(data, *, axis=0, begin=0, end=None):
+    """Slice [begin, end) along one axis."""
     idx = [slice(None)] * data.ndim
     idx[axis] = slice(begin, end)
     return data[tuple(idx)]
@@ -480,6 +526,7 @@ def slice_axis(data, *, axis=0, begin=0, end=None):
 
 @register("slice_like")
 def slice_like(data, shape_like, *, axes=()):
+    """Slice data down to the shape of a second input on the given axes."""
     axs = tuple(axes) if axes else tuple(range(min(data.ndim, shape_like.ndim)))
     idx = [slice(None)] * data.ndim
     for a in axs:
@@ -489,16 +536,19 @@ def slice_like(data, shape_like, *, axes=()):
 
 @register("Concat", aliases=("concat",))
 def concat(*args, dim=1):
+    """Concatenate inputs along `dim`."""
     return jnp.concatenate(args, axis=dim)
 
 
 @register("stack")
 def stack(*args, axis=0):
+    """Stack inputs along a new `axis`."""
     return jnp.stack(args, axis=axis)
 
 
 @register("add_n", aliases=("ElementWiseSum", "_sum"))
 def add_n(*args):
+    """Elementwise sum of all inputs."""
     out = args[0]
     for a in args[1:]:
         out = out + a
@@ -511,6 +561,7 @@ def _split_outputs(attrs):
 
 @register("SliceChannel", aliases=("split",), num_outputs=_split_outputs)
 def split(data, *, num_outputs=1, axis=1, squeeze_axis=False):
+    """Split into `num_outputs` parts along `axis` (multi-output)."""
     parts = jnp.split(data, num_outputs, axis=axis)
     if squeeze_axis:
         parts = [jnp.squeeze(p, axis=axis) for p in parts]
@@ -519,22 +570,26 @@ def split(data, *, num_outputs=1, axis=1, squeeze_axis=False):
 
 @register("tile")
 def tile(data, *, reps=()):
+    """Repeat the whole array `reps` times per axis."""
     return jnp.tile(data, reps)
 
 
 @register("repeat")
 def repeat(data, *, repeats=1, axis=None):
+    """Repeat each element `repeats` times along `axis`."""
     return jnp.repeat(data, repeats, axis=axis)
 
 
 @register("reverse", aliases=("flip",))
 def reverse(data, *, axis=()):
+    """Reverse along the given axes."""
     axs = (axis,) if isinstance(axis, int) else tuple(axis)
     return jnp.flip(data, axis=axs)
 
 
 @register("Pad", aliases=("pad",))
 def pad(data, *, mode="constant", pad_width=(), constant_value=0.0):
+    """Pad spatial dims of 4-D/5-D input with constant/edge/reflect padding."""
     pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
     if mode == "constant":
         return jnp.pad(data, pw, mode="constant", constant_values=constant_value)
@@ -547,6 +602,8 @@ def pad(data, *, mode="constant", pad_width=(), constant_value=0.0):
 
 @register("diag")
 def diag(data, *, k=0):
+    """Extract the k-th diagonal (>=2-D input) or build a diagonal matrix
+    (1-D)."""
     if data.ndim == 1:
         return jnp.diag(data, k=k)
     return jnp.diagonal(data, offset=k, axis1=-2, axis2=-1)
@@ -554,6 +611,7 @@ def diag(data, *, k=0):
 
 @register("depth_to_space")
 def depth_to_space(data, *, block_size=2):
+    """Rearrange channel blocks into spatial blocks (NCHW, block_size)."""
     n, c, h, w = data.shape
     b = block_size
     x = data.reshape(n, b, b, c // (b * b), h, w)
@@ -563,6 +621,7 @@ def depth_to_space(data, *, block_size=2):
 
 @register("space_to_depth")
 def space_to_depth(data, *, block_size=2):
+    """Rearrange spatial blocks into channels (NCHW, block_size)."""
     n, c, h, w = data.shape
     b = block_size
     x = data.reshape(n, c, h // b, b, w // b, b)
@@ -577,18 +636,21 @@ def space_to_depth(data, *, block_size=2):
 
 @register("take", no_grad_inputs=("indices",))
 def take(a, indices, *, axis=0, mode="clip"):
+    """Gather slices along `axis` by integer indices, with clip/wrap modes."""
     idx = indices.astype(jnp.int32)
     return jnp.take(a, idx, axis=axis, mode=mode if mode != "raise" else "clip")
 
 
 @register("batch_take", no_grad_inputs=("indices",))
 def batch_take(a, indices):
+    """Per-row gather: out[i] = data[i, indices[i]]."""
     idx = indices.astype(jnp.int32)
     return a[jnp.arange(a.shape[0]), idx]
 
 
 @register("pick", no_grad_inputs=("index",))
 def pick(data, index, *, axis=-1, keepdims=False, mode="clip"):
+    """Pick one element per row along `axis` by index."""
     idx = index.astype(jnp.int32)
     if mode == "wrap":  # ref: pick mode=wrap wraps indices modulo the dim
         idx = jnp.mod(idx, data.shape[axis])
@@ -602,12 +664,14 @@ def pick(data, index, *, axis=-1, keepdims=False, mode="clip"):
 
 @register("Embedding", no_grad_inputs=("data",))
 def embedding(data, weight, *, input_dim=None, output_dim=None, dtype="float32", sparse_grad=False):
+    """Look up integer indices in a (input_dim, output_dim) weight table."""
     idx = data.astype(jnp.int32)
     return jnp.take(weight, idx, axis=0, mode="clip")
 
 
 @register("gather_nd", no_grad_inputs=("indices",))
 def gather_nd(data, indices):
+    """Gather elements addressed by the leading index dimension."""
     idx = indices.astype(jnp.int32)
     m = idx.shape[0]
     return data[tuple(idx[i] for i in range(m))]
@@ -615,6 +679,7 @@ def gather_nd(data, indices):
 
 @register("scatter_nd", no_grad_inputs=("indices",))
 def scatter_nd(data, indices, *, shape=None):
+    """Scatter data into zeros of `shape` at the given indices."""
     idx = indices.astype(jnp.int32)
     m = idx.shape[0]
     out = jnp.zeros(shape, dtype=data.dtype)
@@ -623,6 +688,7 @@ def scatter_nd(data, indices, *, shape=None):
 
 @register("one_hot", no_grad_inputs=("indices",))
 def one_hot(indices, *, depth=None, on_value=1.0, off_value=0.0, dtype="float32"):
+    """One-hot encode integer indices to `depth` classes with on/off values."""
     from ..base import dtype_np
 
     oh = jax.nn.one_hot(indices.astype(jnp.int32), depth)
@@ -631,6 +697,7 @@ def one_hot(indices, *, depth=None, on_value=1.0, off_value=0.0, dtype="float32"
 
 @register("where", no_grad_inputs=("condition",))
 def where(condition, x, y):
+    """Select elementwise from x or y by condition."""
     return jnp.where(condition.astype(bool), x, y)
 
 
@@ -638,6 +705,9 @@ def where(condition, x, y):
 def boolean_mask(data, index, *, axis=0):
     # dynamic-shape op: evaluated eagerly (not jit-safe); reference is
     # contrib.boolean_mask
+    """Keep rows where the boolean mask is set. Output shape is data-dependent
+    (jnp.compress), so this host-syncs under jit -- the validator flags it
+    as MXA030."""
     mask = index.astype(bool)
     return jnp.compress(mask, data, axis=axis)
 
@@ -649,6 +719,7 @@ def boolean_mask(data, index, *, axis=0):
 
 @register("sort")
 def sort(data, *, axis=-1, is_ascend=True):
+    """Sort along `axis`, optionally descending."""
     out = jnp.sort(data, axis=axis)
     if not is_ascend:
         out = jnp.flip(out, axis=axis)
@@ -657,6 +728,7 @@ def sort(data, *, axis=-1, is_ascend=True):
 
 @register("argsort", no_grad_inputs=("data",))
 def argsort(data, *, axis=-1, is_ascend=True, dtype="float32"):
+    """Indices that would sort along `axis`, cast to the requested dtype."""
     from ..base import dtype_np
 
     out = jnp.argsort(data, axis=axis)
@@ -672,6 +744,7 @@ def _topk_outputs(attrs):
 
 @register("topk", no_grad_inputs=("data",), num_outputs=_topk_outputs)
 def topk(data, *, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    """Top-k values/indices along `axis` with the reference's ret_typ modes."""
     from ..base import dtype_np
 
     ax = axis % data.ndim
@@ -696,6 +769,7 @@ def topk(data, *, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float
 
 @register("SequenceMask", optional=("sequence_length",), no_grad_inputs=("sequence_length",))
 def sequence_mask(data, sequence_length=None, *, use_sequence_length=False, value=0.0, axis=0):
+    """Mask time steps beyond each sequence's length with `value` (TNC layout)."""
     if not use_sequence_length or sequence_length is None:
         return data
     maxlen = data.shape[axis]
@@ -712,6 +786,7 @@ def sequence_mask(data, sequence_length=None, *, use_sequence_length=False, valu
 
 @register("SequenceLast", optional=("sequence_length",), no_grad_inputs=("sequence_length",))
 def sequence_last(data, sequence_length=None, *, use_sequence_length=False, axis=0):
+    """Last valid time step of each sequence (TNC layout)."""
     if not use_sequence_length or sequence_length is None:
         idx = [slice(None)] * data.ndim
         idx[axis] = -1
@@ -723,6 +798,7 @@ def sequence_last(data, sequence_length=None, *, use_sequence_length=False, axis
 
 @register("SequenceReverse", optional=("sequence_length",), no_grad_inputs=("sequence_length",))
 def sequence_reverse(data, sequence_length=None, *, use_sequence_length=False, axis=0):
+    """Reverse each sequence up to its length (TNC layout)."""
     if not use_sequence_length or sequence_length is None:
         return jnp.flip(data, axis=axis)
     moved = jnp.moveaxis(data, axis, 0)  # (T, B, ...)
@@ -743,12 +819,14 @@ def sequence_reverse(data, sequence_length=None, *, use_sequence_length=False, a
 
 @register("_arange_like", no_grad_inputs=("data",))
 def arange_like(data, *, start=0.0, step=1.0, axis=None):
+    """arange shaped like the input along `axis` (or its flattened size)."""
     n = data.size if axis is None else data.shape[axis]
     return start + step * jnp.arange(n, dtype=jnp.float32)
 
 
 @register("histogram", no_grad_inputs=("data",))
 def histogram(data, *, bin_cnt=10, range=None):
+    """Histogram counts (and bin edges) of the input."""
     lo, hi = range if range is not None else (float(data.min()), float(data.max()))
     hist, edges = jnp.histogram(data, bins=bin_cnt, range=(lo, hi))
     return hist.astype(jnp.float32)

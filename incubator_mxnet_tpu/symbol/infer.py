@@ -21,6 +21,45 @@ from ..ops import nn as _nn
 PARAM_SHAPE_RULES = {}
 
 
+class ShapeInferenceError(ValueError):
+    """Shape/dtype inference failed at one op boundary.
+
+    Carries the provenance a bare eval_shape traceback lacks: the node
+    name, its op type, and each input's name/shape/dtype (the reference
+    reports the same triple from InferShapeAttr — ref:
+    src/executor/infer_graph_attr_pass.cc error paths). `input_info` is
+    ((display_name, shape_or_None, dtype_str_or_None), ...);
+    `missing_inputs` distinguishes "inputs never got shapes" (MXA011)
+    from "shapes present but the op rejected them" (MXA010).
+    """
+
+    def __init__(self, node_name, op_name, input_info, reason,
+                 missing_inputs=False):
+        self.node_name = node_name
+        self.op_name = op_name
+        self.input_info = tuple(input_info)
+        self.missing_inputs = missing_inputs
+        ins = ", ".join(
+            f"{n}: {'shape ' + str(s) if s is not None else 'unknown shape'}"
+            + (f" {d}" if d else "")
+            for n, s, d in self.input_info) or "no inputs"
+        super().__init__(
+            f"shape inference failed at node {node_name!r} (op {op_name}): "
+            f"{reason} [inputs: {ins}]")
+
+
+def _input_info(node, op, in_shapes, in_dtypes):
+    info = []
+    for j, (src, _i) in enumerate(node.inputs):
+        pname = op.inputs[j] if (not op.variadic and j < len(op.inputs)) \
+            else f"arg{j}"
+        shp = in_shapes[j] if j < len(in_shapes) else None
+        dt = in_dtypes[j] if j < len(in_dtypes) else None
+        info.append((f"{pname}={src.name}", shp,
+                     str(np.dtype(dt)) if dt is not None else None))
+    return info
+
+
 def rule(name):
     def deco(fn):
         PARAM_SHAPE_RULES[name] = fn
@@ -130,16 +169,27 @@ def _rnn(attrs, shapes, names):
     return out
 
 
-def infer_shapes(symbol, given: dict, partial=False, dtypes_given=None):
+def infer_shapes(symbol, given: dict, partial=False, dtypes_given=None,
+                 errors=None, entry_out=None):
     """Walk the graph, assigning shapes to every entry.
 
     Returns {var_name: shape, ..., "__outputs__": [out shapes]}.
+
+    Failure modes: by default a per-node failure raises
+    `ShapeInferenceError` naming the node, its op, and the input
+    shapes/dtypes that failed to unify. With `partial=True` failing nodes
+    are skipped silently (partial-inference API contract). With `errors`
+    set to a list, each failure is appended and inference continues —
+    the graph validator's collect-everything mode. `entry_out`, when a
+    dict, is filled with {(id(node), out_idx): (shape, dtype)} for
+    downstream per-entry analyses.
     """
     nodes = symbol._topo_nodes()
     entry_shape = {}  # (id(node), idx) -> shape
     entry_dtype = {}
     var_shapes = {}
     key = jax.random.PRNGKey(0)
+    collect = errors is not None
 
     for node in nodes:
         if node.is_var:
@@ -177,11 +227,21 @@ def infer_shapes(symbol, given: dict, partial=False, dtypes_given=None):
             if partial:
                 continue
             missing = [
-                (src.name, op.inputs[j] if j < len(op.inputs) else j)
+                op.inputs[j] if (not op.variadic and j < len(op.inputs))
+                else src.name
                 for j, (src, i) in enumerate(node.inputs)
                 if in_shapes[j] is None
             ]
-            raise ValueError(f"cannot infer shape for inputs {missing} of op {node.name} ({op.name})")
+            err = ShapeInferenceError(
+                node.name, op.name,
+                _input_info(node, op, in_shapes, in_dtypes),
+                f"no shape known for input(s) {missing} — give them via "
+                f"infer_shape kwargs or a Variable(shape=...) attr",
+                missing_inputs=True)
+            if collect:
+                errors.append(err)
+                continue
+            raise err
 
         call_attrs = dict(op.attrs)
         call_attrs.update(node.attrs)
@@ -202,15 +262,27 @@ def infer_shapes(symbol, given: dict, partial=False, dtypes_given=None):
 
         try:
             out = jax.eval_shape(_fn, *structs)
-        except Exception:
+        except Exception as e:
             if partial:
                 continue
-            raise
+            # surface the op boundary, not jax's anonymous trace: name the
+            # node, its op type, and every input's shape/dtype
+            reason = str(e).strip().split("\n")[0] or type(e).__name__
+            err = ShapeInferenceError(
+                node.name, op.name,
+                _input_info(node, op, in_shapes, in_dtypes), reason)
+            if collect:
+                errors.append(err)
+                continue
+            raise err from e
         outs = out if isinstance(out, tuple) else (out,)
         for i, o in enumerate(outs):
             entry_shape[(id(node), i)] = tuple(o.shape)
             entry_dtype[(id(node), i)] = np.dtype(o.dtype)
 
+    if entry_out is not None:
+        for k, s in entry_shape.items():
+            entry_out[k] = (s, entry_dtype.get(k, np.float32))
     result = dict(var_shapes)
     outs = []
     for node, i in symbol._outputs:

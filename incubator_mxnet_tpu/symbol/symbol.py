@@ -384,6 +384,23 @@ class Symbol:
         outs = [out_dt for _ in self.list_outputs()]
         return args, outs, auxs
 
+    # -- static analysis ---------------------------------------------------
+    def validate(self, _raise=False, **shapes):
+        """Run the static graph validator over this Symbol.
+
+        `shapes` are input shapes (same kwargs as `infer_shape`); the
+        structural and hazard passes run even without them. Returns an
+        `analysis.Report` of `MXA0xx` diagnostics with per-node
+        provenance; `_raise=True` raises `GraphValidationError` on any
+        error-severity finding. See docs/STATIC_ANALYSIS.md.
+        """
+        from ..analysis import validate as _validate
+
+        report = _validate(self, shapes=shapes)
+        if _raise:
+            report.raise_if_errors()
+        return report
+
     # -- binding -----------------------------------------------------------
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None, stype_dict=None,
                     group2ctx=None, shared_arg_names=None, shared_exec=None,
@@ -395,9 +412,19 @@ class Symbol:
         from ..ndarray import zeros
 
         ctx = ctx or current_context()
-        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
-        if arg_shapes is None:
-            raise ValueError(f"cannot infer shapes from {kwargs}")
+        # call the inference pass directly (not the tuple-API infer_shape,
+        # which collapses every failure to (None, None, None)) so binding
+        # errors name the offending node, op, and input shapes
+        from .infer import infer_shapes
+
+        try:
+            shapes = infer_shapes(self, kwargs)
+        except ValueError as e:
+            raise ValueError(
+                f"simple_bind: cannot infer shapes from {kwargs}: {e}"
+            ) from e
+        arg_shapes = [shapes.get(n) for n in self.list_arguments()]
+        aux_shapes = [shapes.get(n) for n in self.list_auxiliary_states()]
         type_dict = type_dict or {}
         args = {}
         for name, shp in zip(self.list_arguments(), arg_shapes):

@@ -30,6 +30,9 @@ from .metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, REGISTRY, DEFAULT_BUCKETS,
     BYTES_BUCKETS,
 )
+from .names import (  # noqa: F401
+    METRIC_NAMES, SPAN_NAMES, is_registered_metric, is_registered_span,
+)
 from .spans import Span, NoopSpan, NOOP_SPAN, current_span, SPAN_HISTOGRAM  # noqa: F401
 from .exporters import dump_json, prometheus_text, start_http_server, to_dict  # noqa: F401
 from .memory import sample_device_memory, step_boundary  # noqa: F401
@@ -43,6 +46,8 @@ __all__ = [
     "sample_device_memory", "step_boundary", "LogTelemetryCallback",
     "enabled", "enable", "disable", "refresh_from_env",
     "counter", "gauge", "histogram", "inc", "observe", "set_gauge",
+    "METRIC_NAMES", "SPAN_NAMES", "is_registered_metric",
+    "is_registered_span",
 ]
 
 _state_lock = threading.Lock()

@@ -1,0 +1,72 @@
+"""Registered telemetry names: the single source of truth for every
+metric family and span the framework emits.
+
+The reference gets this property from its profiler's fixed category set
+(ref: src/profiler/profiler.h ProfileDomain); here, where any call site
+can mint a Counter by name, drift is a real hazard — a typo'd name forks
+a metric family and silently splits a dashboard series. So: every
+`mxtpu_*` metric name and every `span()` name used inside
+`incubator_mxnet_tpu/` MUST be declared here. `tools/mxlint.py` enforces
+it statically (rule MXL004), and docs/OBSERVABILITY.md documents each
+entry.
+
+User code is unconstrained — this registry governs the framework's own
+instrumentation, not application metrics.
+"""
+from __future__ import annotations
+
+__all__ = ["METRIC_NAMES", "SPAN_NAMES", "is_registered_metric",
+           "is_registered_span"]
+
+# name -> (kind, one-line description). Kind is documentation (the
+# registry in metrics.py enforces kind consistency at runtime).
+METRIC_NAMES = {
+    "mxtpu_span_seconds": (
+        "histogram", "Duration of telemetry spans, labeled by span name."),
+    "mxtpu_device_bytes_in_use": (
+        "gauge", "Current device (or host-RSS) memory, by device."),
+    "mxtpu_device_peak_bytes_in_use": (
+        "gauge", "Watermark of device (or host-RSS) memory, by device."),
+    "mxtpu_trainer_steps_total": (
+        "counter", "Trainer.step boundaries seen by the memory sampler."),
+    "mxtpu_trainer_step_seconds": (
+        "histogram", "End-to-end Trainer.step latency."),
+    "mxtpu_trainer_dispatches_total": (
+        "counter", "XLA program dispatches issued by the eager Trainer, "
+                   "by kind and path."),
+    "mxtpu_trainer_bucket_bytes": (
+        "histogram", "Payload bytes of one aggregated-dispatch bucket."),
+    "mxtpu_dataloader_fetch_seconds": (
+        "histogram", "Time the training loop blocked fetching a batch."),
+    "mxtpu_dataloader_queue_depth": (
+        "gauge", "Prefetch batches in flight."),
+    "mxtpu_kvstore_seconds": (
+        "histogram", "Latency of scalar-key kvstore operations."),
+    "mxtpu_kvstore_bytes_total": (
+        "counter", "Payload bytes through kvstore push/pull."),
+    "mxtpu_engine_waitall_seconds": (
+        "histogram", "Blocking time in engine.waitall barriers."),
+    "mxtpu_engine_waitall_errors_total": (
+        "counter", "Exceptions swallowed while draining waitall."),
+    "mxtpu_eager_jit_cache_size": (
+        "gauge", "Entries in the eager-dispatch jit cache (LRU)."),
+    "mxtpu_graph_validate_findings_total": (
+        "counter", "Findings emitted by bind-time graph validation "
+                   "(MXNET_GRAPH_VALIDATE), by code and severity."),
+}
+
+# span() names (tracing regions). Dots namespace by subsystem.
+SPAN_NAMES = frozenset({
+    "executor.forward",
+    "executor.backward",
+    "trainer.step",
+    "trainer.allreduce_grads",
+})
+
+
+def is_registered_metric(name):
+    return name in METRIC_NAMES
+
+
+def is_registered_span(name):
+    return name in SPAN_NAMES

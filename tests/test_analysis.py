@@ -1,0 +1,274 @@
+"""Static graph validator tests (analysis/: MXA diagnostics, passes,
+Symbol.validate, the Executor bind-time hook, and the JSON pipeline)."""
+import json
+
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import sym
+from incubator_mxnet_tpu import analysis
+from incubator_mxnet_tpu.analysis import (
+    CODE_CATALOG, GraphValidationError, Severity,
+)
+from incubator_mxnet_tpu.symbol.infer import ShapeInferenceError, infer_shapes
+
+
+def _mlp(nh1=128, nh2=128):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=nh1, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="act1")
+    net = sym.FullyConnected(net, num_hidden=nh2, name="fc2")
+    return net
+
+
+def _bad_add():
+    """fc1 output (32, 128) broadcast-added to a (7, 9) variable."""
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, num_hidden=128, name="fc1")
+    w = sym.Variable("w_bad")
+    return sym.broadcast_add(fc1, w, name="bad_add")
+
+
+# -- clean graphs ------------------------------------------------------------
+
+def test_clean_mlp_has_no_findings():
+    rep = _mlp().validate(data=(32, 128))
+    assert rep.ok
+    assert len(rep) == 0
+    assert "clean" in str(rep)
+
+
+def test_clean_model_zoo_graph_passes():
+    net = mx.gluon.model_zoo.vision.get_model("squeezenet1.0")
+    net.initialize()
+    rep = net._to_symbol().validate(data=(1, 3, 224, 224))
+    assert rep.ok, str(rep)
+
+
+# -- acceptance: shape mismatch reports the offending node by name -----------
+
+def test_shape_mismatch_names_offending_node():
+    rep = _bad_add().validate(data=(32, 100), w_bad=(7, 9))
+    assert not rep.ok
+    (d,) = rep.by_code("MXA010")
+    assert d.severity == Severity.ERROR
+    assert d.node == "bad_add"
+    assert d.op == "broadcast_add"
+    # provenance carries each input's display name, shape, and dtype
+    names = [i[0] for i in d.inputs]
+    shapes = [i[1] for i in d.inputs]
+    assert any("fc1" in n for n in names)
+    assert any("w_bad" in n for n in names)
+    assert (32, 128) in shapes and (7, 9) in shapes
+    assert "bad_add" in str(d) and "MXA010" in str(d)
+
+
+def test_validate_raise_mode():
+    with pytest.raises(GraphValidationError) as ei:
+        _bad_add().validate(_raise=True, data=(32, 100), w_bad=(7, 9))
+    assert "bad_add" in str(ei.value)
+    assert ei.value.report.by_code("MXA010")
+
+
+def test_infer_shapes_error_provenance():
+    # satellite: the raw inference error (no validator involved) names the
+    # node, op, and each input's shape/dtype
+    with pytest.raises(ShapeInferenceError) as ei:
+        infer_shapes(_bad_add(), {"data": (32, 100), "w_bad": (7, 9)})
+    e = ei.value
+    assert e.node_name == "bad_add"
+    assert e.op_name == "broadcast_add"
+    assert "bad_add" in str(e) and "(7, 9)" in str(e)
+
+
+def test_missing_input_shapes_is_mxa011():
+    rep = _mlp().validate()  # no shapes given at all
+    missing = rep.by_code("MXA011")
+    assert missing, str(rep)
+    assert all(d.severity == Severity.ERROR for d in missing)
+
+
+# -- structural passes -------------------------------------------------------
+
+def test_cycle_detection():
+    net = _mlp()
+    fc1 = next(n for n in net._topo_nodes() if n.name == "fc1")
+    fc2 = next(n for n in net._topo_nodes() if n.name == "fc2")
+    fc1.inputs.append((fc2, 0))  # close the loop: fc1 <- fc2 <- fc1
+    rep = net.validate(data=(32, 128))
+    assert rep.by_code("MXA001")
+    assert not rep.ok
+    # inference is skipped after a cycle: no missing-shape noise
+    assert not rep.by_code("MXA011")
+
+
+def test_dangling_input():
+    net = _mlp()
+    data = next(n for n in net._topo_nodes() if n.name == "data")
+    fc2 = next(n for n in net._topo_nodes() if n.name == "fc2")
+    fc2.inputs.append((data, 3))  # variables have exactly one output
+    rep = analysis.validate(net)
+    (d,) = rep.by_code("MXA002")
+    assert d.node == "fc2"
+    assert "output 3" in d.message
+
+
+def test_duplicate_variable_names():
+    a = sym.Variable("w")
+    b = sym.Variable("w")
+    net = sym.broadcast_add(a, b, name="dup_add")
+    rep = analysis.validate(net)
+    (d,) = rep.by_code("MXA003")
+    assert d.severity == Severity.ERROR
+    assert "'w'" in d.message
+
+
+def test_given_shape_typo_is_flagged():
+    rep = _mlp().validate(data=(32, 128), dta=(32, 128))
+    (d,) = rep.by_code("MXA021")
+    assert d.detail == "dta"
+    assert d.severity == Severity.WARNING
+
+
+# -- TPU hazard passes -------------------------------------------------------
+
+def test_host_sync_op_flagged():
+    data = sym.Variable("data")
+    mask = sym.Variable("mask")
+    net = sym.boolean_mask(data, mask, name="bmask")
+    rep = analysis.validate(net)
+    (d,) = rep.by_code("MXA030")
+    assert d.node == "bmask"
+    assert d.severity == Severity.WARNING
+
+
+def test_layout_finding_is_info_only():
+    rep = _mlp(nh2=100).validate(data=(32, 128))
+    (d,) = rep.by_code("MXA032")
+    assert d.severity == Severity.INFO
+    assert d.node == "fc2"
+    assert rep.ok  # info findings never fail validation
+
+
+def test_dtype_hazards():
+    x = sym.Variable("x", dtype="float64")
+    net = sym.cast(sym.sqrt(x, name="s"), dtype="float16", name="bad_cast")
+    rep = analysis.validate(net, shapes={"x": (8, 8)})
+    assert any(d.node == "x" for d in rep.by_code("MXA012"))
+    (c,) = rep.by_code("MXA031")
+    assert c.node == "bad_cast" and "float16" in c.message
+
+
+def test_unused_multi_output():
+    data = sym.Variable("data")
+    parts = sym.split(data, num_outputs=2, axis=0, name="sp")
+    rep = analysis.validate(parts[0])  # second output never consumed
+    (d,) = rep.by_code("MXA022")
+    assert d.node == "sp" and "[1]" in d.message
+
+
+# -- serialized-graph (JSON) pipeline ---------------------------------------
+
+def _graph_json(extra_nodes=(), op_override=None):
+    net = _mlp()
+    d = json.loads(net.tojson())
+    if op_override:
+        for nd_ in d["nodes"]:
+            if nd_["name"] in op_override:
+                nd_["op"] = op_override[nd_["name"]]
+    d["nodes"].extend(extra_nodes)
+    return json.dumps(d)
+
+def test_validate_json_dead_node():
+    dead = {"op": "null", "name": "orphan", "attrs": {}, "inputs": []}
+    rep = analysis.validate_json(_graph_json(extra_nodes=[dead]),
+                                 shapes={"data": (4, 128)})
+    (d,) = rep.by_code("MXA020")
+    assert d.node == "orphan"
+    assert d.severity == Severity.WARNING
+
+
+def test_validate_json_unknown_op():
+    rep = analysis.validate_json(
+        _graph_json(op_override={"act1": "frobnicate"}))
+    (d,) = rep.by_code("MXA004")
+    assert d.node == "act1" and "frobnicate" in d.message
+    assert not rep.ok
+
+
+def test_validate_json_forward_reference():
+    net = _mlp()
+    d = json.loads(net.tojson())
+    # corrupt: point some node's input at itself
+    node = next(n for n in d["nodes"] if n["inputs"])
+    node["inputs"][0][0] = len(d["nodes"]) - 1
+    rep = analysis.validate_json(json.dumps(d))
+    assert rep.by_code("MXA002") or rep.by_code("MXA001")
+    assert not rep.ok
+
+
+def test_validate_json_roundtrip_clean():
+    rep = analysis.validate_json(_mlp().tojson(), shapes={"data": (4, 128)})
+    assert rep.ok and len(rep) == 0
+
+
+# -- report / catalog invariants --------------------------------------------
+
+def test_every_emitted_code_is_cataloged():
+    reps = [
+        _bad_add().validate(data=(32, 100), w_bad=(7, 9)),
+        _mlp(nh2=100).validate(),
+        analysis.validate_json("not json {"),
+    ]
+    for rep in reps:
+        for d in rep:
+            assert d.code in CODE_CATALOG
+            assert d.code.startswith("MXA")
+
+
+def test_report_json_serializes():
+    rep = _bad_add().validate(data=(32, 100), w_bad=(7, 9))
+    payload = json.loads(rep.to_json())
+    assert payload["findings"]
+    f = payload["findings"][0]
+    assert {"code", "severity", "message", "node", "op"} <= set(f)
+
+
+# -- Executor bind-time hook -------------------------------------------------
+
+def test_bind_hook_off_by_default(monkeypatch):
+    monkeypatch.delenv("MXNET_GRAPH_VALIDATE", raising=False)
+    ex = _mlp().simple_bind(data=(4, 128))
+    assert ex.forward()[0].shape == (4, 128)
+
+
+def test_bind_hook_raise_mode(monkeypatch):
+    monkeypatch.setenv("MXNET_GRAPH_VALIDATE", "raise")
+    a = sym.Variable("w")
+    b = sym.Variable("w")
+    net = sym.broadcast_add(a, b, name="dup_add")
+    with pytest.raises(GraphValidationError) as ei:
+        net.simple_bind(w=(4, 4))
+    assert ei.value.report.by_code("MXA003")
+
+
+def test_bind_hook_warn_mode_logs_and_counts(monkeypatch, caplog):
+    monkeypatch.setenv("MXNET_GRAPH_VALIDATE", "warn")
+    mx.telemetry.enable()
+    try:
+        counter = mx.telemetry.REGISTRY.counter(
+            "mxtpu_graph_validate_findings_total")
+        before = counter.value(code="MXA032", severity="info")
+        net = _mlp(nh2=100)
+        with caplog.at_level("WARNING"):
+            ex = net.simple_bind(data=(4, 128))
+        assert ex.forward()[0].shape == (4, 100)  # warn mode never blocks
+        assert counter.value(code="MXA032", severity="info") == before + 1
+        assert any("MXA032" in r.message for r in caplog.records)
+    finally:
+        mx.telemetry.disable()
+
+
+def test_counter_name_is_registered():
+    assert mx.telemetry.is_registered_metric(
+        "mxtpu_graph_validate_findings_total")

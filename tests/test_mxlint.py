@@ -1,0 +1,223 @@
+"""Framework-lint tests: each MXL rule fires on a seeded fixture tree,
+suppression (inline + baseline) works, and the real package is clean."""
+import json
+import textwrap
+from pathlib import Path
+
+from incubator_mxnet_tpu.analysis.mxlint import (
+    LINT_RULES, load_baseline, run_lint,
+)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _fixture_package(tmp_path, files):
+    """Build a miniature package tree mirroring the real layout: run_lint
+    expects <root>/config.py, <root>/telemetry/names.py, and a sibling
+    docs/ dir."""
+    pkg = tmp_path / "pkg"
+    defaults = {
+        "config.py": """
+            KNOBS = {}
+            def register_knob(name, default, type_, doc):
+                KNOBS[name] = (default, type_, doc)
+            register_knob("MXNET_DOCUMENTED", 1, int, "fine")
+            """,
+        "telemetry/names.py": """
+            METRIC_NAMES = {
+                "mxtpu_good_total": ("counter", "fine"),
+            }
+            SPAN_NAMES = frozenset({"good.span"})
+            """,
+    }
+    for rel, body in {**defaults, **files}.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(body))
+    docs = tmp_path / "docs"
+    docs.mkdir(exist_ok=True)
+    (docs / "ENV_VARS.md").write_text("- `MXNET_DOCUMENTED`: fine\n")
+    return pkg
+
+
+def _codes(findings):
+    return sorted(f.code for f in findings)
+
+
+def _lint(tmp_path, files, **kw):
+    pkg = _fixture_package(tmp_path, files)
+    return run_lint(pkg, **kw)[0]
+
+
+# -- one fixture per rule ----------------------------------------------------
+
+def test_mxl001_bare_except(tmp_path):
+    fs = _lint(tmp_path, {"engine.py": """
+        def run():
+            try:
+                pass
+            except:
+                pass
+        """})
+    (f,) = [f for f in fs if f.code == "MXL001"]
+    assert f.detail == "run"
+    assert "bare" in f.message
+
+
+def test_mxl002_unregistered_knob(tmp_path):
+    fs = _lint(tmp_path, {"runtime.py": """
+        from . import config
+        def f():
+            return config.get("MXNET_NOT_A_KNOB")
+        """})
+    (f,) = [f for f in fs if f.code == "MXL002"]
+    assert f.detail == "MXNET_NOT_A_KNOB"
+
+
+def test_mxl002_resolves_module_constants(tmp_path):
+    fs = _lint(tmp_path, {"runtime.py": """
+        from . import config as _config
+        _KNOB = "MXNET_ALSO_MISSING"
+        def f():
+            return _config.get(_KNOB)
+        """})
+    assert "MXL002" in _codes(fs)
+
+
+def test_mxl003_undocumented_knob(tmp_path):
+    fs = _lint(tmp_path, {"config.py": """
+        KNOBS = {}
+        def register_knob(name, default, type_, doc):
+            KNOBS[name] = (default, type_, doc)
+        register_knob("MXNET_DOCUMENTED", 1, int, "fine")
+        register_knob("MXNET_UNDOCUMENTED", 1, int, "missing from docs")
+        """})
+    (f,) = [f for f in fs if f.code == "MXL003"]
+    assert f.detail == "MXNET_UNDOCUMENTED"
+    assert f.path.endswith("config.py")
+
+
+def test_mxl004_unregistered_metric_and_span(tmp_path):
+    fs = _lint(tmp_path, {"runtime.py": """
+        from . import telemetry as _telemetry
+        _CONST = "mxtpu_const_named_total"
+        def f():
+            _telemetry.inc("mxtpu_typo_total", 1)
+            _telemetry.inc(_CONST, 1)
+            _telemetry.inc("mxtpu_good_total", 1)
+            with _telemetry.span("bad.span"):
+                pass
+            with _telemetry.span("good.span"):
+                pass
+        """})
+    hits = sorted(f.detail for f in fs if f.code == "MXL004")
+    assert hits == ["bad.span", "mxtpu_const_named_total",
+                    "mxtpu_typo_total"]
+
+
+def test_mxl005_host_sync_only_in_hot_paths(tmp_path):
+    hot = """
+        import numpy as np
+        import jax.numpy as jnp
+        def step(x):
+            a = np.asarray(x)      # flagged: real numpy
+            b = jnp.asarray(x)     # fine: stays on device
+            c = x.asnumpy()        # flagged
+            return a, b, c
+        """
+    fs = _lint(tmp_path, {"executor.py": hot, "coldpath.py": hot})
+    hits = [f for f in fs if f.code == "MXL005"]
+    assert len(hits) == 2
+    assert all(f.path.endswith("executor.py") for f in hits)
+    assert {"step:np.asarray", "step:asnumpy"} == {f.detail for f in hits}
+
+
+def test_mxl006_op_docstring(tmp_path):
+    fs = _lint(tmp_path, {"ops/stuff.py": """
+        from .registry import register
+        @register("bad_op")
+        def bad_op(data):
+            return data
+        @register("good_op")
+        def good_op(data):
+            \"\"\"Documented.\"\"\"
+            return data
+        def plain_helper(data):
+            return data
+        """})
+    hits = [f for f in fs if f.code == "MXL006"]
+    assert [f.detail for f in hits] == ["<module>.bad_op"]
+
+
+def test_mxl007_env_read(tmp_path):
+    fs = _lint(tmp_path, {"runtime.py": """
+        import os
+        def f():
+            a = os.environ.get("MXTPU_SNEAKY")
+            b = os.environ["MXNET_ALSO_SNEAKY"]
+            os.environ["MXTPU_WRITE_OK"] = "1"   # stores are allowed
+            c = os.environ.get("HOME")           # non-framework: allowed
+            d = os.getenv("MXTPU_GETENV")
+            return a, b, c, d
+        """})
+    hits = sorted(f.detail for f in fs if f.code == "MXL007")
+    assert hits == ["MXNET_ALSO_SNEAKY", "MXTPU_GETENV", "MXTPU_SNEAKY"]
+
+
+# -- suppression -------------------------------------------------------------
+
+def test_inline_disable(tmp_path):
+    fs = _lint(tmp_path, {"runtime.py": """
+        import os
+        def f():
+            a = os.environ.get("MXTPU_OK")  # mxlint: disable=MXL007
+            b = os.environ.get("MXTPU_OTHER")  # mxlint: disable=MXL001
+            return a, b
+        """})
+    hits = [f for f in fs if f.code == "MXL007"]
+    # the disable naming a different code does not suppress
+    assert [f.detail for f in hits] == ["MXTPU_OTHER"]
+
+
+def test_baseline_suppression(tmp_path):
+    files = {"runtime.py": """
+        import os
+        def f():
+            return os.environ.get("MXTPU_LEGACY")
+        """}
+    fs = _lint(tmp_path, files)
+    (f,) = [f for f in fs if f.code == "MXL007"]
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"findings": [f.key]}))
+    pkg = tmp_path / "pkg"
+    kept, suppressed = run_lint(pkg, baseline=load_baseline(bl))
+    assert suppressed == 1
+    assert not [k for k in kept if k.code == "MXL007"]
+
+
+def test_baseline_key_is_line_number_free(tmp_path):
+    fs = _lint(tmp_path, {"runtime.py": """
+        import os
+        def f():
+            return os.environ.get("MXTPU_LEGACY")
+        """})
+    (f,) = [f for f in fs if f.code == "MXL007"]
+    assert f.key == "MXL007:pkg/runtime.py:MXTPU_LEGACY"
+    assert str(f.line) not in f.key.split(":", 1)[1]
+
+
+# -- the real package --------------------------------------------------------
+
+def test_repo_is_lint_clean():
+    findings, _ = run_lint(REPO_ROOT / "incubator_mxnet_tpu")
+    assert not findings, "\n".join(str(f) for f in findings)
+
+
+def test_committed_baseline_is_empty():
+    bl = load_baseline(REPO_ROOT / "ci" / "mxlint_baseline.json")
+    assert bl == set(), ("the CI baseline must stay empty: fix new "
+                        "violations instead of baselining them")
+
+
+def test_rule_catalog_complete():
+    assert sorted(LINT_RULES) == [f"MXL00{i}" for i in range(1, 8)]

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Graph validation CLI: run the analysis.validate pass pipeline over a
+Symbol and print MXA diagnostics (docs/STATIC_ANALYSIS.md has the code
+catalog).
+
+Three input modes:
+    python tools/graph_check.py --json model-symbol.json [--shape data=1,3,224,224]
+    python tools/graph_check.py --model resnet18_v1 --shape data=1,3,224,224
+    python tools/graph_check.py --json - < model-symbol.json
+
+`--model` traces the named gluon model_zoo network into a Symbol (the
+SymbolBlock bridge) first — the same graph an Executor would bind.
+
+Exit status is governed by --fail-on (default `error`): 0 when no
+finding at/above the threshold, 1 otherwise, 2 on bad usage. Use
+`--fail-on warning` for strict CI gates and `--fail-on never` to just
+print the report.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _parse_shape(spec):
+    """'data=1,3,224,224' -> ('data', (1, 3, 224, 224)); bare
+    '1,3,224,224' defaults the name to 'data'."""
+    name, _, dims = spec.rpartition("=")
+    name = name or "data"
+    try:
+        return name, tuple(int(d) for d in dims.split(","))
+    except ValueError:
+        raise SystemExit(f"bad --shape {spec!r} (want name=1,3,224,224)")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--json", metavar="PATH",
+                     help="validate a serialized symbol JSON file "
+                          "('-' reads stdin)")
+    src.add_argument("--model", metavar="NAME",
+                     help="validate a gluon model_zoo network (traced to "
+                          "a Symbol)")
+    ap.add_argument("--shape", action="append", default=[],
+                    metavar="NAME=D0,D1,...",
+                    help="input shape(s); repeatable. Bare dims bind to "
+                         "'data'. Without shapes only structural passes "
+                         "run (no shape/dtype inference).")
+    ap.add_argument("--fail-on", choices=["error", "warning", "never"],
+                    default="error",
+                    help="lowest severity that makes the exit status "
+                         "nonzero (default: error)")
+    ap.add_argument("--json-out", action="store_true",
+                    help="emit the report as JSON instead of text")
+    args = ap.parse_args(argv)
+
+    shapes = dict(_parse_shape(s) for s in args.shape)
+
+    from incubator_mxnet_tpu import analysis
+
+    if args.json:
+        text = (sys.stdin.read() if args.json == "-"
+                else open(args.json).read())
+        name = "<stdin>" if args.json == "-" else args.json
+        report = analysis.validate_json(text, shapes=shapes or None,
+                                        name=name)
+    else:
+        from incubator_mxnet_tpu.gluon.model_zoo import vision
+        net = vision.get_model(args.model)
+        net.initialize()
+        sym = net._to_symbol()
+        report = analysis.validate(sym, shapes=shapes or None,
+                                   name=args.model)
+
+    if args.json_out:
+        print(report.to_json())
+    else:
+        print(report)
+
+    if args.fail_on == "never":
+        return 0
+    threshold = (analysis.Severity.ERROR if args.fail_on == "error"
+                 else analysis.Severity.WARNING)
+    worst = [d for d in report if d.severity >= threshold]
+    return 1 if worst else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Framework lint CLI over incubator_mxnet_tpu (rules MXL001-MXL007).
+
+The rule engine lives in incubator_mxnet_tpu/analysis/mxlint.py; this
+wrapper loads it BY FILE PATH so linting never imports the framework
+package (and therefore never needs jax) — the lint tier must run in any
+bare CI sandbox.
+
+    python tools/mxlint.py                      # lint the package
+    python tools/mxlint.py --baseline ci/mxlint_baseline.json
+    python tools/mxlint.py --write-baseline ci/mxlint_baseline.json
+
+Exit status: 0 when no (non-baselined) findings, 1 otherwise. The
+committed baseline is EMPTY — it exists to prove the zero-findings
+invariant, not to park debt; --write-baseline is for bootstrapping a
+fork, not for silencing new violations.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _load_mxlint():
+    path = REPO_ROOT / "incubator_mxnet_tpu" / "analysis" / "mxlint.py"
+    spec = importlib.util.spec_from_file_location("_mxlint_standalone", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod  # dataclasses resolves hints via sys.modules
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("package", nargs="?",
+                    default=str(REPO_ROOT / "incubator_mxnet_tpu"),
+                    help="package directory to lint")
+    ap.add_argument("--baseline", help="JSON baseline of finding keys to "
+                                       "suppress")
+    ap.add_argument("--write-baseline", metavar="PATH",
+                    help="write current finding keys to PATH and exit 0")
+    ap.add_argument("--docs", help="docs directory (default: <repo>/docs)")
+    args = ap.parse_args(argv)
+
+    mxlint = _load_mxlint()
+    baseline = mxlint.load_baseline(args.baseline) if args.baseline else None
+    findings, suppressed = mxlint.run_lint(
+        args.package, docs_root=args.docs, baseline=baseline)
+
+    if args.write_baseline:
+        keys = sorted(f.key for f in findings)
+        Path(args.write_baseline).write_text(
+            json.dumps({"findings": keys}, indent=2) + "\n")
+        print(f"mxlint: wrote {len(keys)} baseline keys to "
+              f"{args.write_baseline}")
+        return 0
+
+    for f in findings:
+        print(f)
+    tail = f" ({suppressed} baselined)" if suppressed else ""
+    if findings:
+        print(f"mxlint: {len(findings)} finding(s){tail}", file=sys.stderr)
+        return 1
+    print(f"mxlint: clean{tail}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
