@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI tiers (ref: ci/docker/runtime_functions.sh — unittest / nightly /
 # distributed stages). Usage:
-#   ci/run_tests.sh [unit|nightly|dist|examples|telemetry|aggregation|static-analysis|all]
+#   ci/run_tests.sh [unit|nightly|dist|examples|telemetry|aggregation|static-analysis|chaos|all]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -75,6 +75,15 @@ run_static_analysis() {
         --model squeezenet1.0 --shape data=1,3,224,224
 }
 
+run_chaos() {
+    echo "=== chaos tier (fault injection: PS drops + torn checkpoint) ==="
+    # deterministic 2-worker sync-SGD over the real PS wire with seeded
+    # connection kills and one injected torn checkpoint; asserts the run
+    # completes, auto-resumes from the latest VALID epoch, and recovers
+    # weights bit-identical to the fault-free reference
+    JAX_PLATFORMS=cpu python tools/chaos_train.py
+}
+
 run_nightly() {
     echo "=== nightly tier (large tensors, checkpoint compat, 7-worker dist) ==="
     MXTPU_NIGHTLY=1 python -m pytest tests/test_large_array.py \
@@ -101,8 +110,9 @@ case "$tier" in
     telemetry) run_telemetry ;;
     aggregation) run_aggregation ;;
     static-analysis) run_static_analysis ;;
+    chaos)     run_chaos ;;
     nightly)   run_nightly ;;
-    all)       run_static_analysis; run_unit; run_telemetry; run_aggregation; run_dist; run_examples; run_nightly ;;
-    *) echo "unknown tier: $tier (unit|nightly|dist|examples|suite|telemetry|aggregation|static-analysis|all)"; exit 2 ;;
+    all)       run_static_analysis; run_unit; run_telemetry; run_aggregation; run_chaos; run_dist; run_examples; run_nightly ;;
+    *) echo "unknown tier: $tier (unit|nightly|dist|examples|suite|telemetry|aggregation|static-analysis|chaos|all)"; exit 2 ;;
 esac
 echo "tier '$tier' green"

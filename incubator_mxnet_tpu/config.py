@@ -164,6 +164,52 @@ register_knob("MXTPU_HEARTBEAT_TIMEOUT", 20.0, float,
               "Heartbeat staleness after which a peer counts as dead "
               "(ref: ps-lite PS_HEARTBEAT_TIMEOUT).")
 
+# resilience / fault tolerance (see docs/FAULT_TOLERANCE.md)
+register_knob("MXTPU_RETRY_MAX_ATTEMPTS", 8, int,
+              "Max calls (first try + retries) a resilience.RetryPolicy "
+              "makes before re-raising (ref role: ps-lite resender "
+              "retry bound).")
+register_knob("MXTPU_RETRY_BASE_DELAY", 0.05, float,
+              "Seconds slept before the first retry; attempt k sleeps "
+              "base * 2**k, capped by MXTPU_RETRY_MAX_DELAY.")
+register_knob("MXTPU_RETRY_MAX_DELAY", 2.0, float,
+              "Upper bound (seconds) on one exponential-backoff sleep.")
+register_knob("MXTPU_RETRY_DEADLINE", 120.0, float,
+              "Overall wall-clock budget (seconds) across all retries of "
+              "one operation; the policy re-raises rather than sleep past "
+              "it.")
+register_knob("MXTPU_RETRY_JITTER", 0.1, float,
+              "Backoff jitter fraction: each sleep is scaled by "
+              "1 + U(-j, +j) from a seeded PRNG (deterministic across "
+              "runs; 0 disables).")
+register_knob("MXTPU_FAULT_SPEC", "", str,
+              "Deterministic fault-injection spec, `site:mode@arg` rules "
+              "joined by ';' (e.g. 'ps.rpc:drop@0.05;ckpt.write:fail@2'). "
+              "Modes: drop (connection), fail (IO error), torn "
+              "(corrupt checkpoint); arg is a probability or 1-based "
+              "call indices. Empty (default) disables injection. See "
+              "docs/FAULT_TOLERANCE.md for the grammar and sites.")
+register_knob("MXTPU_FAULT_SEED", 0, int,
+              "Seed for the fault injector's per-(site, instance) PRNG "
+              "streams; same seed + same spec fires the same faults at "
+              "the same calls.")
+register_knob("MXTPU_PS_CONNECT_TIMEOUT", 30.0, float,
+              "Seconds one PSClient connect attempt may take before it "
+              "counts as failed and the retry policy redials.")
+register_knob("MXTPU_PS_SOCKET_TIMEOUT", 320.0, float,
+              "Idle timeout (seconds) on an established PSClient socket; "
+              "must exceed the server-side sync/barrier wait so a blocked "
+              "quorum RPC is not misread as a dead server.")
+register_knob("MXTPU_PS_SYNC_TIMEOUT", 300.0, float,
+              "Server-side cap (seconds) on one sync-push merge or "
+              "barrier generation wait; heartbeat evictions re-evaluate "
+              "the quorum well before this fires.")
+register_knob("MXTPU_PS_DEDUP_WINDOW", 128, int,
+              "Mutating RPCs remembered per client for exactly-once "
+              "replay suppression across reconnects; must exceed the "
+              "deepest pipelining a client does (the eager client "
+              "pipelines 1).")
+
 # profiler
 register_knob("MXNET_PROFILER_AUTOSTART", False, bool,
               "Start profiling at import (ref: env_var.md:192).")

@@ -18,13 +18,80 @@ Typical use with a Gluon net::
 """
 from __future__ import annotations
 
+import hashlib
+import json
+import logging
 import os
 
 import jax
 
 from ..ndarray.ndarray import NDArray
 
-__all__ = ["save", "restore", "latest_step", "CheckpointManager"]
+logger = logging.getLogger(__name__)
+
+__all__ = ["save", "restore", "latest_step", "verify", "CheckpointManager"]
+
+# An orbax checkpoint is a DIRECTORY; its sidecar manifest lists every
+# file with its sha256 so `restore` detects torn/corrupted shards before
+# orbax deserializes them. Single-process only: with multiple hosts each
+# writes just its own shards, so no one host can hash the full tree.
+_MANIFEST_SUFFIX = ".sha256"
+
+
+def _dir_manifest_entries(path):
+    entries = {}
+    for root, _dirs, files in os.walk(path):
+        for name in sorted(files):
+            full = os.path.join(root, name)
+            rel = os.path.relpath(full, path)
+            h = hashlib.sha256()
+            with open(full, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            entries[rel] = {"sha256": h.hexdigest(),
+                            "size": os.path.getsize(full)}
+    return entries
+
+
+def _write_dir_manifest(path):
+    manifest = path + _MANIFEST_SUFFIX
+    tmp = manifest + f".tmp.{os.getpid()}"
+    payload = json.dumps({"files": _dir_manifest_entries(path),
+                          "version": 1}, sort_keys=True).encode("utf-8")
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, manifest)
+
+
+def verify(path):
+    """True iff the checkpoint directory matches its sidecar manifest.
+    A checkpoint without a manifest (multi-host save, pre-resilience
+    save) verifies as legacy-valid."""
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        return False
+    try:
+        with open(path + _MANIFEST_SUFFIX, "rb") as f:
+            manifest = json.loads(f.read().decode("utf-8"))
+    except FileNotFoundError:
+        return True  # legacy: no manifest was ever written
+    except (OSError, ValueError):
+        return False
+    want = manifest.get("files", {})
+    have = _dir_manifest_entries(path)
+    if want != have:
+        logger.warning("sharded checkpoint %s failed manifest "
+                       "verification", path)
+        from .. import telemetry as _telemetry
+
+        _telemetry.inc("mxtpu_ckpt_verify_failures_total", 1,
+                       help="Checkpoint files failing manifest "
+                            "verification at load, by reason.",
+                       reason="sharded")
+        return False
+    return True
 
 
 def _is_nd(v):
@@ -62,6 +129,8 @@ def save(path, tree, force=False):
     path = os.path.abspath(path)
     with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
         ckptr.save(path, _to_jax_tree(tree), force=force)
+    if jax.process_count() == 1:
+        _write_dir_manifest(path)
     return path
 
 
@@ -77,6 +146,10 @@ def restore(path, like=None, shardings=None):
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
+    if not verify(path):
+        raise OSError(
+            f"sharded checkpoint {path} failed manifest verification "
+            "(torn or corrupted shard); restore from an older step")
     with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
         if like is not None:
             out = ckptr.restore(path, args=ocp.args.PyTreeRestore(

@@ -79,19 +79,29 @@ class DataLoader:
             for batch in self._batch_sampler:
                 yield self._batchify_fn([self._dataset[i] for i in batch])
             return
-        # threaded prefetch pipeline (PrefetcherIter analog)
-        with _futures.ThreadPoolExecutor(self._num_workers) as pool:
-            pending = []
+        # threaded prefetch pipeline (PrefetcherIter analog). Failure
+        # path: the FIRST worker/batchify exception is re-raised promptly
+        # with its batch index, and every still-pending future is
+        # cancelled — without this, an early failure surfaced only after
+        # the whole prefetch window drained, and non-executed futures
+        # wedged pool shutdown behind work nobody will consume.
+        pool = _futures.ThreadPoolExecutor(self._num_workers)
+        try:
+            pending = []  # (batch_index, future), consumed in order
             it = iter(self._batch_sampler)
+            n_submitted = 0
 
             def submit():
+                nonlocal n_submitted
                 try:
                     batch = next(it)
                 except StopIteration:
                     return None
-                return pool.submit(
-                    lambda b: self._batchify_fn([self._dataset[i] for i in b]), batch
-                )
+                idx = n_submitted
+                n_submitted += 1
+                return (idx, pool.submit(
+                    lambda b: self._batchify_fn(
+                        [self._dataset[i] for i in b]), batch))
 
             for _ in range(self._prefetch):
                 f = submit()
@@ -99,7 +109,7 @@ class DataLoader:
                     break
                 pending.append(f)
             while pending:
-                f = pending.pop(0)
+                idx, f = pending.pop(0)
                 nxt = submit()
                 if nxt is not None:
                     pending.append(nxt)
@@ -107,7 +117,18 @@ class DataLoader:
                     "mxtpu_dataloader_queue_depth", len(pending),
                     help="Prefetch batches in flight (0 = pipeline "
                          "starved, consumer about to block).")
-                yield f.result()
+                try:
+                    yield f.result()
+                except Exception as e:
+                    for _i, p in pending:
+                        p.cancel()
+                    raise RuntimeError(
+                        f"DataLoader worker failed on batch {idx}: "
+                        f"{type(e).__name__}: {e}") from e
+        finally:
+            # cancel_futures: a generator abandoned mid-epoch (or the
+            # failure path above) must not block on unconsumed batches
+            pool.shutdown(wait=True, cancel_futures=True)
 
     def __len__(self):
         return len(self._batch_sampler)

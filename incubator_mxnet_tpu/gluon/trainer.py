@@ -607,12 +607,15 @@ class Trainer:
     # -----------------------------------------------------------------------
 
     def save_states(self, fname):
+        from .. import resilience as _resilience
+
         self._init_kvstore()
         if self._update_on_kvstore and self._kvstore is not None:
             self._kvstore.save_optimizer_states(fname)
             return
-        with open(fname, "wb") as f:
-            f.write(self._updater.get_states(dump_optimizer=False))
+        _resilience.atomic_write_bytes(
+            fname, self._updater.get_states(dump_optimizer=False),
+            site="ckpt.states")
 
     def load_states(self, fname):
         self._init_kvstore()
@@ -621,3 +624,36 @@ class Trainer:
             return
         with open(fname, "rb") as f:
             self._updater.set_states(f.read())
+
+    def save_checkpoint(self, prefix, epoch, net=None):
+        """Crash-consistent epoch checkpoint: `prefix-%04d.params` (when
+        `net` is given) + `prefix-%04d.states`, both through the
+        tmp/fsync/rename + manifest protocol so `auto_resume` can walk
+        back over torn epochs after a crash."""
+        from .. import resilience as _resilience
+
+        if net is not None:
+            _resilience.atomic_save(f"{prefix}-{epoch:04d}.params",
+                                    net.save_parameters)
+        self.save_states(f"{prefix}-{epoch:04d}.states")
+
+    def auto_resume(self, prefix, net=None):
+        """Resume an interrupted run from the newest VERIFIED epoch under
+        `prefix`: loads the parameters into `net` (when given) and the
+        optimizer states when the matching `.states` file verifies too.
+        Returns the epoch to continue FROM (last valid epoch + 1), or 0
+        when no epoch verifies (fresh start)."""
+        import os
+
+        from .. import model as _model
+        from .. import resilience as _resilience
+
+        epoch = _model.latest_valid_checkpoint(prefix)
+        if epoch is None:
+            return 0
+        if net is not None:
+            net.load_parameters(f"{prefix}-{epoch:04d}.params")
+        states = f"{prefix}-{epoch:04d}.states"
+        if os.path.isfile(states) and _resilience.verify(states):
+            self.load_states(states)
+        return epoch + 1

@@ -18,6 +18,7 @@ Module/Trainer code ports unchanged; the transport is different by design:
 from __future__ import annotations
 
 import functools
+import logging
 import os
 import pickle
 import tempfile
@@ -31,6 +32,8 @@ import jax.numpy as jnp
 from . import telemetry as _telemetry
 from .ndarray.ndarray import NDArray
 from .ndarray.sparse import RowSparseNDArray
+
+logger = logging.getLogger(__name__)
 
 __all__ = ["KVStore", "TwoBitCompressor", "create", "create_kvstore_for_module"]
 
@@ -610,9 +613,10 @@ class KVStoreDistAsyncServer(KVStoreDist):
         """Optimizer state lives ON the server — fetch it over the wire
         (ref: the reference cannot do this; server state was unrecoverable
         there)."""
+        from . import resilience as _resilience
+
         blob = self._client.get_optimizer_states(dump_optimizer)
-        with open(fname, "wb") as f:
-            f.write(blob)
+        _resilience.atomic_write_bytes(fname, blob, site="ckpt.states")
 
     def load_optimizer_states(self, fname):
         with open(fname, "rb") as f:
@@ -622,7 +626,16 @@ class KVStoreDistAsyncServer(KVStoreDist):
         self._client.barrier()
 
     def close(self):
-        self._client.barrier()
+        try:
+            # best-effort farewell rendezvous: with a peer dead the
+            # quorum shrinks (or the barrier errors), and shutdown must
+            # proceed either way — a dead worker cannot hold the job's
+            # teardown hostage
+            self._client.barrier()
+        except (ConnectionError, OSError, RuntimeError) as e:
+            logger.warning("dist_async_server close: farewell barrier "
+                           "failed (%s: %s); shutting down anyway",
+                           type(e).__name__, e)
         if self._server is not None:
             self._server.shutdown()
         self._client.close()

@@ -3,16 +3,25 @@
 Checkpoint format: `prefix-symbol.json` (graph) + `prefix-%04d.params`
 (NDArray container with arg:/aux: prefixed keys), exactly mirroring the
 reference's save_checkpoint/load_checkpoint (model.py:394,424).
+
+Crash consistency: every file goes through resilience.checkpoint's
+tmp → fsync → atomic-rename protocol with a sha256 sidecar manifest, so a
+crash mid-write leaves the previous epoch intact and a torn file is
+DETECTED at load instead of silently loading garbage;
+`latest_valid_checkpoint` walks back to the newest epoch that still
+verifies (cf. CheckFreq, FAST'21).
 """
 from __future__ import annotations
 
 import collections
+import os
+import re
 
 from . import ndarray as nd
 from . import symbol as sym
 
 __all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
-           "load_params", "wait_checkpoints"]
+           "latest_valid_checkpoint", "load_params", "wait_checkpoints"]
 
 BatchEndParam = collections.namedtuple(
     "BatchEndParams", ["epoch", "nbatch", "eval_metric", "locals"]
@@ -28,13 +37,21 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
     onto the host dependency engine (write-var per prefix keeps epochs in
     order) so checkpointing overlaps the next training steps — the engine
     doing for host IO what it does for comm in the reference."""
+    from . import resilience as _resilience
+
     if symbol is not None:
-        symbol.save(f"{prefix}-symbol.json")
+        # own injection site: symbol rewrites must not consume the
+        # ckpt.write fault stream the params files are scheduled on
+        _resilience.atomic_save(
+            f"{prefix}-symbol.json",
+            lambda p: symbol.save(p), site="ckpt.symbol")
     save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
     save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
 
     if not run_async:
-        nd.save(f"{prefix}-{epoch:04d}.params", save_dict)
+        _resilience.atomic_save(
+            f"{prefix}-{epoch:04d}.params",
+            lambda p: nd.save(p, save_dict))
         return
     import atexit
 
@@ -52,7 +69,8 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                  for k, v in save_dict.items()}
     path = f"{prefix}-{epoch:04d}.params"
 
-    eng.push(lambda: nd.save(path, host_dict),
+    eng.push(lambda: _resilience.atomic_save(
+                 path, lambda p: nd.save(p, host_dict)),
              write_vars=[_ckpt_vars[prefix]])
 
 
@@ -80,7 +98,17 @@ def wait_checkpoints(prefix=None):
 
 
 def load_params(prefix, epoch):
-    save_dict = nd.load(f"{prefix}-{epoch:04d}.params")
+    from . import resilience as _resilience
+
+    path = f"{prefix}-{epoch:04d}.params"
+    # a missing file raises FileNotFoundError from nd.load as before;
+    # verification guards the EXISTING-but-torn case
+    if os.path.isfile(path) and not _resilience.verify(path):
+        raise OSError(
+            f"checkpoint {path} failed checksum verification (torn or "
+            "corrupted write); latest_valid_checkpoint(prefix) finds the "
+            "newest epoch that still verifies")
+    save_dict = nd.load(path)
     arg_params, aux_params = {}, {}
     for k, v in save_dict.items():
         tp, name = k.split(":", 1)
@@ -96,6 +124,28 @@ def load_checkpoint(prefix, epoch):
     symbol = sym.load(f"{prefix}-symbol.json")
     arg_params, aux_params = load_params(prefix, epoch)
     return symbol, arg_params, aux_params
+
+
+def latest_valid_checkpoint(prefix):
+    """Newest epoch under `prefix` whose params file passes manifest
+    verification, or None — the recovery entry point: after a crash,
+    resume from this epoch and every torn/corrupt newer file is skipped.
+    """
+    from . import resilience as _resilience
+
+    d = os.path.dirname(prefix) or "."
+    pat = re.compile(re.escape(os.path.basename(prefix))
+                     + r"-(\d{4,})\.params$")
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return None
+    epochs = sorted({int(m.group(1)) for n in names
+                     if (m := pat.match(n))}, reverse=True)
+    for epoch in epochs:
+        if _resilience.verify(f"{prefix}-{epoch:04d}.params"):
+            return epoch
+    return None
 
 
 class FeedForward:
@@ -165,3 +215,15 @@ class FeedForward:
         symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
         return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
                            aux_params=aux_params, begin_epoch=epoch, **kwargs)
+
+    @staticmethod
+    def resume(prefix, ctx=None, **kwargs):
+        """Resume from the newest VERIFIED checkpoint under `prefix`:
+        torn or corrupt epochs (crash mid-write) are skipped via their
+        checksum manifests. Raises FileNotFoundError when no epoch
+        verifies — resuming from garbage is never the right default."""
+        epoch = latest_valid_checkpoint(prefix)
+        if epoch is None:
+            raise FileNotFoundError(
+                f"no valid checkpoint found under prefix {prefix!r}")
+        return FeedForward.load(prefix, epoch, ctx=ctx, **kwargs)

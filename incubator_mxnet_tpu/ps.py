@@ -19,8 +19,12 @@ zmq. Enable with kvstore type 'dist_async_server'.
 """
 from __future__ import annotations
 
+import collections
 import hashlib
 import hmac
+import itertools
+import logging
+import os
 import pickle
 import secrets
 import socket
@@ -30,7 +34,23 @@ import time
 
 import numpy as np
 
+logger = logging.getLogger(__name__)
+
 __all__ = ["ParameterServer", "PSClient", "default_server_addr"]
+
+_RECONNECT_METRIC = "mxtpu_ps_reconnects_total"
+_RECONNECT_HELP = ("PSClient transparent reconnects after a mid-frame "
+                   "socket error, by cause.")
+_DEDUP_METRIC = "mxtpu_ps_dedup_hits_total"
+_DEDUP_HELP = ("Retried mutating RPCs the ParameterServer suppressed via "
+               "the per-client dedup window, by command.")
+_EVICT_METRIC = "mxtpu_ps_evictions_total"
+_EVICT_HELP = ("Workers evicted from the barrier/sync quorum after "
+               "heartbeat staleness (dist graceful degradation).")
+
+# wire/socket errors after which a frame exchange cannot be trusted; the
+# client closes and redials rather than reuse the poisoned socket
+_WIRE_ERRORS = (OSError, EOFError, struct.error)
 
 _LEN = struct.Struct(">Q")
 _U32 = struct.Struct(">I")
@@ -278,6 +298,18 @@ class ParameterServer:
         self._beats = {}
         self._beats_lock = threading.Lock()
         self._start_time = time.time()
+        from . import config as _config
+
+        # rendezvous waits and replay suppression (docs/FAULT_TOLERANCE.md)
+        self._sync_timeout = _config.get("MXTPU_PS_SYNC_TIMEOUT")
+        self._dedup_window = max(1, _config.get("MXTPU_PS_DEDUP_WINDOW"))
+        self._evict_timeout = _config.get("MXTPU_HEARTBEAT_TIMEOUT")
+        self._dedup = {}           # client_id -> OrderedDict(seq -> entry)
+        self._dedup_lock = threading.Lock()
+        # ranks seen via heartbeat then gone stale: they shrink the
+        # barrier/sync quorum instead of hanging every survivor until the
+        # rendezvous timeout; a fresh beat re-admits them
+        self._evicted = set()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         try:
@@ -334,7 +366,13 @@ class ParameterServer:
                     _send_msg(conn, ("ok",))
                     self.shutdown()
                     return
-                _send_msg(conn, self._dispatch(cmd, msg[1:]))
+                if cmd == "mut":
+                    # reliable envelope: ("mut", client_id, seq, cmd, *args)
+                    resp = self._handle_mut(msg[1], int(msg[2]), msg[3],
+                                            msg[4:])
+                else:
+                    resp = self._dispatch(cmd, msg[1:])
+                _send_msg(conn, resp)
         except (ConnectionError, OSError, EOFError, ValueError,
                 struct.error):
             pass  # malformed frame or peer gone: drop the connection
@@ -346,6 +384,72 @@ class ParameterServer:
             return getattr(self, "_cmd_" + cmd)(*args)
         except Exception as e:  # ship the failure to the worker
             return ("err", f"{type(e).__name__}: {e}")
+
+    def _handle_mut(self, client_id, seq, cmd, args):
+        """Exactly-once apply for mutating RPCs: each (client_id, seq) is
+        executed by the first frame that carries it; a retransmit (same
+        client redialing after a mid-frame drop) waits for the original's
+        result instead of re-executing — even when the original is still
+        blocked in a sync/barrier rendezvous on the dead connection.
+        The window is keyed by CLIENT, not connection, so it survives
+        reconnects (ref: ps-lite Resender's seq-based dedup)."""
+        with self._dedup_lock:
+            window = self._dedup.setdefault(client_id,
+                                            collections.OrderedDict())
+            entry = window.get(seq)
+            owner = entry is None
+            if owner:
+                entry = {"done": threading.Event(), "resp": None}
+                window[seq] = entry
+                while len(window) > self._dedup_window:
+                    oldest = next(iter(window))
+                    if not window[oldest]["done"].is_set():
+                        break  # never evict an in-flight original
+                    window.pop(oldest)
+        if owner:
+            resp = self._dispatch(cmd, args)
+            entry["resp"] = resp
+            entry["done"].set()
+            return resp
+        from . import telemetry as _telemetry
+
+        _telemetry.inc(_DEDUP_METRIC, 1, help=_DEDUP_HELP, command=cmd)
+        logger.debug("ps: duplicate %s seq=%d from %s suppressed",
+                     cmd, seq, client_id)
+        # generous slack over the longest a legitimate original can run
+        # (a full sync/barrier rendezvous wait)
+        if not entry["done"].wait(timeout=self._sync_timeout + 60):
+            return ("err", "TimeoutError: duplicate of an in-flight "
+                           f"{cmd} seq={seq} never completed")
+        return entry["resp"]
+
+    def _quorum(self):
+        """Workers a rendezvous must wait for: the configured world minus
+        heartbeat-evicted ranks. Eviction needs the rank to have beaten at
+        least once (a never-seen rank may still be starting up); a fresh
+        beat re-admits. Only meaningful while heartbeats ride this server
+        (tcp transport) — without beats the quorum is the full world."""
+        now = time.time()
+        newly = []
+        with self._beats_lock:
+            for rank, last in self._beats.items():
+                if now - last > self._evict_timeout:
+                    if rank not in self._evicted:
+                        self._evicted.add(rank)
+                        newly.append(rank)
+                else:
+                    self._evicted.discard(rank)
+            quorum = max(1, self.num_workers - len(self._evicted))
+        if newly:
+            from . import telemetry as _telemetry
+
+            for rank in newly:
+                logger.warning(
+                    "ps: worker %d heartbeat stale >%.1fs; evicting from "
+                    "the rendezvous quorum (now %d/%d)", rank,
+                    self._evict_timeout, quorum, self.num_workers)
+                _telemetry.inc(_EVICT_METRIC, 1, help=_EVICT_HELP)
+        return quorum
 
     # --- commands ---------------------------------------------------------
     def _cmd_init(self, key, value):
@@ -415,29 +519,35 @@ class ParameterServer:
             with self._key_lock(key):
                 self._apply(key, grad)
             return ("ok",)
-        # sync: aggregate num_workers contributions, apply once, release
-        # everyone at the new version (ref: :346 merge buffer path)
+        # sync: aggregate one contribution per live worker, apply once,
+        # release everyone at the new version (ref: :346 merge buffer
+        # path). Waits run in short slices so a heartbeat eviction
+        # mid-generation shrinks the quorum and releases the survivors
+        # instead of hanging them until the rendezvous timeout.
         with self._sync_cv:
             buf, count = self._merge.get(key, (None, 0))
             buf = grad if buf is None else buf + grad
             count += 1
-            if count == self.num_workers:
-                with self._key_lock(key):
-                    self._apply(key, buf)
-                self._merge[key] = (None, 0)
-                self._sync_cv.notify_all()
-            else:
-                self._merge[key] = (buf, count)
-                target = self._versions[key] + 1
-                ok = self._sync_cv.wait_for(
-                    lambda: self._versions[key] >= target, timeout=300)
-                if not ok:
-                    # a peer died mid-rendezvous: drop the stale buffer so a
-                    # retry cannot double-count, and surface the failure
+            self._merge[key] = (buf, count)
+            target = self._versions[key] + 1
+            deadline = time.monotonic() + self._sync_timeout
+            while self._versions[key] < target:
+                pend, npend = self._merge.get(key, (None, 0))
+                if pend is not None and npend >= self._quorum():
+                    with self._key_lock(key):
+                        self._apply(key, pend)
+                    self._merge[key] = (None, 0)
+                    self._sync_cv.notify_all()
+                    break
+                if time.monotonic() > deadline:
+                    # drop the stale buffer so a retry cannot double-count,
+                    # and surface the failure
                     self._merge[key] = (None, 0)
                     raise TimeoutError(
-                        f"sync push on {key!r} waited 300s for "
-                        f"{self.num_workers} contributions")
+                        f"sync push on {key!r} waited "
+                        f"{self._sync_timeout:.0f}s with {npend}/"
+                        f"{self._quorum()} contributions")
+                self._sync_cv.wait(timeout=1.0)
         return ("ok",)
 
     def _cmd_push_rows(self, key, indices, rows):
@@ -484,27 +594,38 @@ class ParameterServer:
             return ("val", np.array(self._store[key][rows], copy=True))
 
     def _cmd_barrier(self):
+        # generation-counted rendezvous (ref: ps-lite Postoffice::Barrier).
+        # Short wait slices re-evaluate the quorum so heartbeat evictions
+        # release the survivors; whichever waiter first observes
+        # count >= quorum opens the generation. A retransmitted barrier
+        # never double-counts: it rides the dedup window in _handle_mut.
         with self._barrier_cv:
             gen = self._barrier_gen
             self._barrier_count += 1
-            if self._barrier_count == self.num_workers:
-                self._barrier_count = 0
-                self._barrier_gen += 1
-                self._barrier_cv.notify_all()
-            else:
-                ok = self._barrier_cv.wait_for(
-                    lambda: self._barrier_gen > gen, timeout=300)
-                if not ok:
+            deadline = time.monotonic() + self._sync_timeout
+            while self._barrier_gen == gen:
+                if self._barrier_count >= self._quorum():
+                    self._barrier_count = 0
+                    self._barrier_gen += 1
+                    self._barrier_cv.notify_all()
+                    break
+                if time.monotonic() > deadline:
                     self._barrier_count -= 1
                     raise TimeoutError(
-                        f"barrier waited 300s with only "
-                        f"{self._barrier_count + 1}/{self.num_workers} "
+                        f"barrier waited {self._sync_timeout:.0f}s with "
+                        f"only {self._barrier_count + 1}/{self._quorum()} "
                         "workers present")
+                self._barrier_cv.wait(timeout=1.0)
         return ("ok",)
 
     def _cmd_heartbeat(self, rank):
         with self._beats_lock:
             self._beats[int(rank)] = time.time()
+            self._evicted.discard(int(rank))  # a live beat re-admits
+        with self._barrier_cv:
+            self._barrier_cv.notify_all()  # quorum may have changed
+        with self._sync_cv:
+            self._sync_cv.notify_all()
         return ("ok",)
 
     def _cmd_num_dead(self, requester, timeout, grace_elapsed):
@@ -555,66 +676,162 @@ class ParameterServer:
         self._accept_thread.join(timeout=10)
 
 
+# per-process client-id disambiguator: the server's dedup window is keyed
+# by (client_id, seq), so the id must be unique per CLIENT OBJECT and
+# stable across that object's reconnects
+_CLIENT_IDS = itertools.count()
+
+
 class PSClient:
     """Worker-side connection (ref: kvstore_dist.h push/pull over ps-lite).
 
     Thread-safe: one socket, request/response framing under a lock.
+
+    Resilient: a mid-frame socket error (or injected drop) closes the
+    socket and transparently redials + resends under a RetryPolicy —
+    never reuses a socket whose framing may be poisoned. Every mutating
+    RPC carries this client's monotonic sequence id in a ("mut", ...)
+    envelope so the server applies a retransmit exactly once; reads
+    (pull/keys/heartbeat/...) are idempotent and resend bare.
     """
 
-    def __init__(self, host, port, retries=60):
-        import time
+    def __init__(self, host, port, retries=60, instance=None):
+        from . import config as _config
+        from .resilience import RetryPolicy
 
+        self._host, self._port = host, int(port)
         self._lock = threading.Lock()
-        last = None
-        for _ in range(retries):
-            try:
-                self._sock = socket.create_connection((host, port), timeout=30)
-                break
-            except OSError as e:  # server may not be up yet
-                last = e
-                time.sleep(0.5)
-        else:
-            raise ConnectionError(
-                f"parameter server at {host}:{port} unreachable: {last}")
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        # outlive the server's own 300s rendezvous waits, which raise a
-        # proper error instead of this socket timing out first
-        self._sock.settimeout(320)
-
-    def _rpc(self, *msg):
+        self._sock = None
+        self._seq = 0
+        self._client_id = (f"{socket.gethostname()}:{os.getpid()}:"
+                           f"{next(_CLIENT_IDS)}")
+        # stable tag for the fault injector's per-client streams: the
+        # worker rank by default, so a seeded chaos schedule replays
+        # per-worker regardless of thread interleaving
+        self._instance = (instance if instance is not None
+                          else f"w{_config.get('MXTPU_PROCESS_ID')}")
+        self._connect_timeout = _config.get("MXTPU_PS_CONNECT_TIMEOUT")
+        # the socket timeout outlives the server's rendezvous waits, which
+        # raise a proper error instead of this socket timing out first
+        self._socket_timeout = _config.get("MXTPU_PS_SOCKET_TIMEOUT")
+        # first connect keeps the caller-visible `retries` contract (the
+        # server may simply not be up yet) on the knob-driven schedule
+        self._connect_policy = RetryPolicy.from_knobs(
+            max_attempts=max(1, int(retries)))
+        self._rpc_policy = RetryPolicy.from_knobs()
         with self._lock:
-            _send_msg(self._sock, msg)
-            resp = _recv_msg(self._sock)
+            self._reconnect_locked(first=True)
+
+    # --- connection management -------------------------------------------
+    def _close_locked(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _dial_once(self, _attempt):
+        from .resilience import fault as _fault
+
+        _fault.injector().raise_for("ps.connect", self._instance)
+        sock = socket.create_connection(
+            (self._host, self._port), timeout=self._connect_timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(self._socket_timeout)
+        return sock
+
+    def _reconnect_locked(self, first=False, cause="redial"):
+        self._close_locked()
+
+        def _log(attempt, exc, remaining):
+            logger.debug(
+                "PSClient redial %s:%d attempt %d failed (%s: %s); "
+                "%.1fs of deadline remaining", self._host, self._port,
+                attempt + 1, type(exc).__name__, exc, remaining)
+
+        try:
+            self._sock = self._connect_policy.call(
+                self._dial_once, OSError, site="ps.connect", on_retry=_log)
+        except OSError as e:
+            raise ConnectionError(
+                f"parameter server at {self._host}:{self._port} "
+                f"unreachable: {e}") from e
+        if not first:
+            from . import telemetry as _telemetry
+
+            _telemetry.inc(_RECONNECT_METRIC, 1, help=_RECONNECT_HELP,
+                           cause=cause)
+            logger.debug("PSClient reconnected to %s:%d (%s)",
+                         self._host, self._port, cause)
+
+    # --- framing ----------------------------------------------------------
+    def _rpc_attempt(self, frame):
+        from .resilience import fault as _fault
+
+        inj = _fault.injector()
+        with self._lock:
+            if self._sock is None:
+                self._reconnect_locked(cause="redial")
+            try:
+                inj.raise_for("ps.rpc", self._instance)
+                _send_msg(self._sock, frame)
+                # separate post-send site: a drop HERE leaves the request
+                # applied server-side, which is exactly what the dedup
+                # window must absorb on the retransmit
+                inj.raise_for("ps.rpc.recv", self._instance)
+                return _recv_msg(self._sock)
+            except _WIRE_ERRORS as e:
+                self._close_locked()  # poisoned mid-frame: next try redials
+                self._last_cause = type(e).__name__
+                raise
+
+    def _call(self, frame, site):
+        resp = self._rpc_policy.call(
+            lambda _a: self._rpc_attempt(frame), _WIRE_ERRORS, site=site)
         if resp[0] == "err":
             raise RuntimeError(f"parameter server: {resp[1]}")
         return resp[1] if len(resp) > 1 else None
 
+    def _rpc(self, *msg):
+        """Idempotent RPC: resent bare across reconnects."""
+        return self._call(tuple(msg), site="ps." + msg[0])
+
+    def _mut_rpc(self, cmd, *args):
+        """Mutating RPC: one sequence id for ALL resends of this call, so
+        the server's dedup window applies it exactly once."""
+        with self._lock:
+            self._seq += 1
+            frame = ("mut", self._client_id, self._seq, cmd) + args
+        return self._call(frame, site="ps." + cmd)
+
+    # --- API --------------------------------------------------------------
     def init(self, key, value):
-        return self._rpc("init", key, np.asarray(value))
+        return self._mut_rpc("init", key, np.asarray(value))
 
     def push(self, key, grad, sync=False):
-        return self._rpc("push", key, np.asarray(grad), bool(sync))
+        return self._mut_rpc("push", key, np.asarray(grad), bool(sync))
 
     def push_compressed(self, key, payload, shape):
-        return self._rpc("push_compressed", key, np.asarray(payload),
-                         tuple(shape))
+        return self._mut_rpc("push_compressed", key, np.asarray(payload),
+                             tuple(shape))
 
     def push_rows(self, key, indices, rows):
-        return self._rpc("push_rows", key, np.asarray(indices),
-                         np.asarray(rows))
+        return self._mut_rpc("push_rows", key, np.asarray(indices),
+                             np.asarray(rows))
 
     def set_optimizer_attrs(self, attrs):
-        return self._rpc("set_optimizer_attrs", dict(attrs))
+        return self._mut_rpc("set_optimizer_attrs", dict(attrs))
 
     def set_compression(self, params):
-        return self._rpc("set_compression", dict(params))
+        return self._mut_rpc("set_compression", dict(params))
 
     def get_optimizer_states(self, dump_optimizer=False):
         return _verify_blob(
             self._rpc("get_optimizer_states", bool(dump_optimizer)))
 
     def set_optimizer_states(self, blob):
-        return self._rpc("set_optimizer_states", _sign_blob(blob))
+        return self._mut_rpc("set_optimizer_states", _sign_blob(blob))
 
     def pull(self, key):
         return self._rpc("pull", key)
@@ -623,12 +840,13 @@ class PSClient:
         return self._rpc("pull_rows", key, np.asarray(row_ids))
 
     def set_optimizer(self, optimizer):
-        return self._rpc("set_optimizer",
-                         _sign_blob(pickle.dumps(
-                             optimizer, protocol=pickle.HIGHEST_PROTOCOL)))
+        return self._mut_rpc("set_optimizer",
+                             _sign_blob(pickle.dumps(
+                                 optimizer,
+                                 protocol=pickle.HIGHEST_PROTOCOL)))
 
     def barrier(self):
-        return self._rpc("barrier")
+        return self._mut_rpc("barrier")
 
     def heartbeat(self, rank):
         return self._rpc("heartbeat", int(rank))
@@ -641,13 +859,18 @@ class PSClient:
         return self._rpc("keys")
 
     def stop_server(self):
+        # deliberately NOT retried: at teardown a dead server is success,
+        # and a retry loop here would stall interpreter exit
         try:
-            self._rpc("stop")
-        except (RuntimeError, ConnectionError, OSError):
+            with self._lock:
+                if self._sock is None:
+                    self._sock = self._dial_once(0)
+                _send_msg(self._sock, ("stop",))
+                _recv_msg(self._sock)
+        except (RuntimeError, ConnectionError, EOFError, OSError,
+                struct.error):
             pass
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        with self._lock:
+            self._close_locked()

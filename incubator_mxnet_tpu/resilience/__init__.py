@@ -1,0 +1,43 @@
+"""Fault-tolerance layer: retry policies, deterministic fault injection,
+and crash-consistent checkpoint IO.
+
+The reference stack inherited its survival traits from ps-lite (worker
+heartbeats, dead-node detection, resumable server state — Li et al.,
+OSDI'14); this package is where those traits live for the TPU
+reproduction, plus the two the reference never had:
+
+- `retry`: `RetryPolicy` — exponential backoff with deterministic
+  jitter, a per-attempt timeout, and an overall deadline, driven by the
+  registered `MXTPU_RETRY_*` knobs. Every reconnect/redial loop in the
+  framework goes through it so chaos runs are tunable from one place.
+- `fault`: a seeded `FaultInjector` parsing `MXTPU_FAULT_SPEC`
+  (`site:mode@arg;...`, e.g. `ps.rpc:drop@0.05;ckpt.write:fail@2`).
+  Named injection sites inside the framework consult it; with a fixed
+  seed the same faults fire at the same calls every run, so a chaos
+  failure reproduces under a debugger (cf. Jepsen-style deterministic
+  fault schedules).
+- `checkpoint`: tmp-file → fsync → atomic-rename writes with a sidecar
+  sha256 manifest, verification at load, and the newest-uncorrupted
+  walk-back that powers `model.latest_valid_checkpoint` (cf. CheckFreq,
+  Mohan et al., FAST'21 on crash-consistent checkpointing).
+
+See docs/FAULT_TOLERANCE.md for semantics and a recovery walkthrough.
+"""
+from __future__ import annotations
+
+from .retry import RetryPolicy  # noqa: F401
+from .fault import (  # noqa: F401
+    FaultInjector, InjectedConnectionError, InjectedIOError, injector,
+    install, refresh_from_env,
+)
+from .checkpoint import (  # noqa: F401
+    atomic_save, atomic_write_bytes, manifest_path, read_manifest, verify,
+)
+
+__all__ = [
+    "RetryPolicy",
+    "FaultInjector", "InjectedConnectionError", "InjectedIOError",
+    "injector", "install", "refresh_from_env",
+    "atomic_save", "atomic_write_bytes", "manifest_path", "read_manifest",
+    "verify",
+]

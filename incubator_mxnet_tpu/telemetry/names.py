@@ -53,6 +53,27 @@ METRIC_NAMES = {
     "mxtpu_graph_validate_findings_total": (
         "counter", "Findings emitted by bind-time graph validation "
                    "(MXNET_GRAPH_VALIDATE), by code and severity."),
+    "mxtpu_retry_attempts_total": (
+        "counter", "Retry attempts issued by resilience.RetryPolicy, by "
+                   "site and outcome (retried/exhausted)."),
+    "mxtpu_ps_reconnects_total": (
+        "counter", "PSClient transparent reconnects after a mid-frame "
+                   "socket error, by cause."),
+    "mxtpu_ps_dedup_hits_total": (
+        "counter", "Retried mutating RPCs the ParameterServer suppressed "
+                   "via the per-client dedup window, by command."),
+    "mxtpu_ps_evictions_total": (
+        "counter", "Workers evicted from the barrier/sync quorum after "
+                   "heartbeat staleness (dist graceful degradation)."),
+    "mxtpu_fault_injections_total": (
+        "counter", "Faults fired by the deterministic injector "
+                   "(MXTPU_FAULT_SPEC), by site and mode."),
+    "mxtpu_ckpt_writes_total": (
+        "counter", "Checkpoint file writes through resilience.checkpoint, "
+                   "by outcome (ok/injected-fail/injected-torn)."),
+    "mxtpu_ckpt_verify_failures_total": (
+        "counter", "Checkpoint files failing manifest verification at "
+                   "load, by reason."),
 }
 
 # span() names (tracing regions). Dots namespace by subsystem.

@@ -1,0 +1,400 @@
+"""Fault-tolerance layer: retry core, deterministic fault injection,
+crash-consistent checkpoint IO, and resilient PS RPC (exactly-once
+retransmits, reconnects, quorum shrink). See docs/FAULT_TOLERANCE.md."""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from incubator_mxnet_tpu import model, nd, ps as _ps, resilience
+from incubator_mxnet_tpu.resilience import fault as _fault
+
+
+@pytest.fixture(autouse=True)
+def _reset_injector():
+    """Every test starts and ends with the no-op injector resolved."""
+    _fault.install(None)
+    yield
+    _fault.install(None)
+
+
+# ---------------------------------------------------------------------------
+# retry core
+# ---------------------------------------------------------------------------
+
+def test_retry_policy_schedule_is_deterministic():
+    a = list(resilience.RetryPolicy(max_attempts=6, seed=11).delays())
+    b = list(resilience.RetryPolicy(max_attempts=6, seed=11).delays())
+    c = list(resilience.RetryPolicy(max_attempts=6, seed=12).delays())
+    assert a == b
+    assert a != c
+    assert len(a) == 5  # one gap per retry, none after the last attempt
+
+
+def test_retry_policy_backoff_grows_and_caps():
+    p = resilience.RetryPolicy(max_attempts=10, base_delay=0.1,
+                               max_delay=0.4, jitter=0.0, seed=0)
+    ds = list(p.delays())
+    assert ds[0] == pytest.approx(0.1)
+    assert ds[1] == pytest.approx(0.2)
+    assert max(ds) == pytest.approx(0.4)  # capped
+
+
+def test_retry_call_retries_then_succeeds():
+    p = resilience.RetryPolicy(max_attempts=5, base_delay=0.001,
+                               max_delay=0.002, deadline=5.0, seed=0)
+    attempts = []
+
+    def fn(k):
+        attempts.append(k)
+        if k < 2:
+            raise ConnectionError("flaky")
+        return "done"
+
+    assert p.call(fn, ConnectionError, site="test") == "done"
+    assert attempts == [0, 1, 2]
+
+
+def test_retry_call_exhausts_and_reraises():
+    p = resilience.RetryPolicy(max_attempts=3, base_delay=0.001,
+                               max_delay=0.002, deadline=5.0, seed=0)
+    with pytest.raises(ConnectionError):
+        p.call(lambda k: (_ for _ in ()).throw(ConnectionError("always")),
+               ConnectionError, site="test")
+
+
+def test_retry_call_respects_deadline():
+    p = resilience.RetryPolicy(max_attempts=100, base_delay=0.2,
+                               max_delay=0.2, deadline=0.3, jitter=0.0,
+                               seed=0)
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError):
+        p.call(lambda k: (_ for _ in ()).throw(ConnectionError("x")),
+               ConnectionError, site="test")
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_retry_call_does_not_catch_other_errors():
+    p = resilience.RetryPolicy(max_attempts=5, base_delay=0.001, seed=0)
+    calls = []
+
+    def fn(k):
+        calls.append(k)
+        raise ValueError("not retryable")
+
+    with pytest.raises(ValueError):
+        p.call(fn, ConnectionError, site="test")
+    assert calls == [0]
+
+
+def test_retry_policy_from_knobs_reads_env(monkeypatch):
+    monkeypatch.setenv("MXTPU_RETRY_MAX_ATTEMPTS", "3")
+    monkeypatch.setenv("MXTPU_RETRY_BASE_DELAY", "0.25")
+    p = resilience.RetryPolicy.from_knobs()
+    assert p.max_attempts == 3
+    assert p.base_delay == 0.25
+    assert resilience.RetryPolicy.from_knobs(max_attempts=9).max_attempts == 9
+
+
+# ---------------------------------------------------------------------------
+# fault injector
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_parse_rejects_garbage():
+    for bad in ("nonsense", "a:b", "s:drop@oops", "s:drop@1.5",
+                "s:explode@1", "s:fail@0", "a:drop@0.1;a:fail@2"):
+        with pytest.raises(ValueError):
+            _fault.FaultInjector(bad)
+
+
+def test_fault_streams_are_deterministic_and_independent():
+    spec = "ps.rpc:drop@0.3"
+    a = _fault.FaultInjector(spec, seed=5)
+    b = _fault.FaultInjector(spec, seed=5)
+    run_a0 = [a.action("ps.rpc", "w0") for _ in range(50)]
+    run_a1 = [a.action("ps.rpc", "w1") for _ in range(50)]
+    # same seed replays exactly, per instance, regardless of the OTHER
+    # instance's interleaving (b drains w1 first)
+    run_b1 = [b.action("ps.rpc", "w1") for _ in range(50)]
+    run_b0 = [b.action("ps.rpc", "w0") for _ in range(50)]
+    assert run_a0 == run_b0
+    assert run_a1 == run_b1
+    assert run_a0 != run_a1  # distinct streams
+
+
+def test_fault_nth_call_and_counts():
+    inj = _fault.FaultInjector("ckpt.write:fail@2;s:torn@1,3", seed=0)
+    assert [inj.action("ckpt.write") for _ in range(4)] == [
+        None, "fail", None, None]
+    assert [inj.action("s") for _ in range(4)] == [
+        "torn", None, "torn", None]
+    assert inj.fired("ckpt.write") == 1
+    assert inj.fired(mode="torn") == 2
+    assert inj.stats() == {"ckpt.write:fail": 1, "s:torn": 2}
+
+
+def test_fault_raise_for_types():
+    inj = _fault.FaultInjector("a:drop@1;b:fail@1", seed=0)
+    with pytest.raises(ConnectionError):
+        inj.raise_for("a")
+    with pytest.raises(OSError):
+        inj.raise_for("b")
+    assert inj.raise_for("unknown.site") is None
+
+
+def test_injector_resolves_from_env(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "x.y:fail@1")
+    monkeypatch.setenv("MXTPU_FAULT_SEED", "9")
+    inj = _fault.refresh_from_env()
+    assert inj.active and inj.seed == 9
+    monkeypatch.delenv("MXTPU_FAULT_SPEC")
+    assert not _fault.refresh_from_env().active
+
+
+# ---------------------------------------------------------------------------
+# crash-consistent checkpoint IO
+# ---------------------------------------------------------------------------
+
+def test_atomic_write_roundtrip_and_manifest(tmp_path):
+    p = str(tmp_path / "w.params")
+    resilience.atomic_write_bytes(p, b"payload")
+    assert open(p, "rb").read() == b"payload"
+    m = resilience.read_manifest(p)
+    assert m["size"] == 7
+    assert resilience.verify(p)
+    assert not (tmp_path / f"w.params.tmp.{os.getpid()}").exists()
+
+
+def test_verify_detects_corruption_and_truncation(tmp_path):
+    p = str(tmp_path / "w.params")
+    resilience.atomic_write_bytes(p, b"0123456789")
+    with open(p, "r+b") as f:  # flip a byte, size unchanged
+        f.seek(3)
+        f.write(b"X")
+    assert not resilience.verify(p)
+    resilience.atomic_write_bytes(p, b"0123456789")
+    with open(p, "r+b") as f:
+        f.truncate(4)
+    assert not resilience.verify(p)
+
+
+def test_verify_legacy_file_without_manifest(tmp_path):
+    p = str(tmp_path / "old.params")
+    with open(p, "wb") as f:
+        f.write(b"pre-resilience bytes")
+    assert resilience.verify(p)  # must stay loadable
+    assert not resilience.verify(str(tmp_path / "missing.params"))
+
+
+def test_injected_fail_leaves_previous_checkpoint_intact(tmp_path):
+    p = str(tmp_path / "w.params")
+    resilience.atomic_write_bytes(p, b"good epoch")
+    _fault.install(_fault.FaultInjector("ckpt.write:fail@1", seed=0))
+    with pytest.raises(OSError):
+        resilience.atomic_write_bytes(p, b"never lands")
+    assert open(p, "rb").read() == b"good epoch"
+    assert resilience.verify(p)
+
+
+def test_injected_torn_write_is_detected(tmp_path):
+    p = str(tmp_path / "w.params")
+    _fault.install(_fault.FaultInjector("ckpt.write:torn@1", seed=0))
+    resilience.atomic_write_bytes(p, b"A" * 100)
+    assert os.path.getsize(p) == 50  # deliberately truncated
+    assert not resilience.verify(p)
+
+
+def test_latest_valid_checkpoint_walks_back_over_torn_epoch(tmp_path):
+    prefix = str(tmp_path / "run")
+    args = {"w": nd.array(np.arange(4, dtype=np.float32))}
+    for epoch in (1, 2):
+        model.save_checkpoint(prefix, epoch, None, args, {})
+    # epoch 3 is torn (crash mid-write): truncated canonical + manifest
+    _fault.install(_fault.FaultInjector("ckpt.write:torn@1", seed=0))
+    model.save_checkpoint(prefix, 3, None, args, {})
+    _fault.install(None)
+    assert model.latest_valid_checkpoint(prefix) == 2
+    with pytest.raises(OSError):
+        model.load_params(prefix, 3)
+    back, _ = model.load_params(prefix, 2)
+    np.testing.assert_array_equal(back["w"].asnumpy(),
+                                  np.arange(4, dtype=np.float32))
+    assert model.latest_valid_checkpoint(str(tmp_path / "nothing")) is None
+
+
+def test_async_save_checkpoint_writes_manifest(tmp_path):
+    prefix = str(tmp_path / "arun")
+    args = {"w": nd.array(np.ones(3, np.float32))}
+    model.save_checkpoint(prefix, 1, None, args, {}, run_async=True)
+    model.wait_checkpoints(prefix)
+    assert resilience.verify(f"{prefix}-0001.params")
+    assert model.latest_valid_checkpoint(prefix) == 1
+
+
+# ---------------------------------------------------------------------------
+# resilient PS RPC
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def server1():
+    srv = _ps.ParameterServer(1, host="127.0.0.1", port=0)
+    yield srv
+    srv.shutdown()
+
+
+def test_retried_push_applied_exactly_once(server1):
+    """THE acceptance assertion: a reply-dropped push is retransmitted
+    and the server's dedup window applies it exactly once (version and
+    value both prove it)."""
+    c = _ps.PSClient("127.0.0.1", server1.port)
+    c.init("w", np.zeros(4, np.float32))
+    base_version = server1._versions["w"]
+    # rpc seq on this client so far: init. Drop the NEXT recv: the push
+    # lands server-side, the reply is lost, the client redials + resends.
+    _fault.install(_fault.FaultInjector("ps.rpc.recv:drop@2", seed=1))
+    c.push("w", np.ones(4, np.float32))
+    _fault.install(None)
+    assert server1._versions["w"] == base_version + 1
+    np.testing.assert_array_equal(c.pull("w"), np.ones(4, np.float32))
+    assert _fault.injector() is not None
+    c.close()
+
+
+def test_presend_drop_is_resent_and_applied_once(server1):
+    c = _ps.PSClient("127.0.0.1", server1.port)
+    c.init("w2", np.zeros(2, np.float32))
+    _fault.install(_fault.FaultInjector("ps.rpc:drop@2", seed=1))
+    c.push("w2", np.ones(2, np.float32))
+    _fault.install(None)
+    assert server1._versions["w2"] == 1
+    c.close()
+
+
+def test_idempotent_pull_survives_reconnect(server1):
+    c = _ps.PSClient("127.0.0.1", server1.port)
+    c.init("w3", np.arange(3, dtype=np.float32))
+    _fault.install(_fault.FaultInjector("ps.rpc.recv:drop@2", seed=1))
+    np.testing.assert_array_equal(c.pull("w3"),
+                                  np.arange(3, dtype=np.float32))
+    _fault.install(None)
+    c.close()
+
+
+def test_sync_push_retransmit_no_double_count(monkeypatch):
+    """Two workers sync-push; one worker's reply drops mid-rendezvous.
+    The retransmit must wait on the ORIGINAL's result, not contribute a
+    second gradient to the merge buffer."""
+    monkeypatch.setenv("MXTPU_PS_SYNC_TIMEOUT", "60")
+    srv = _ps.ParameterServer(2, host="127.0.0.1", port=0)
+    try:
+        c0 = _ps.PSClient("127.0.0.1", srv.port, instance="w0")
+        c1 = _ps.PSClient("127.0.0.1", srv.port, instance="w1")
+        c0.init("w", np.zeros(4, np.float32))
+        # w0's 2nd rpc (the sync push) loses its reply
+        _fault.install(_fault.FaultInjector("ps.rpc.recv:drop@2", seed=1))
+        t = threading.Thread(
+            target=lambda: c0.push("w", np.ones(4, np.float32), sync=True))
+        t.start()
+        time.sleep(0.3)  # let w0's contribution land + its drop fire
+        c1.push("w", np.ones(4, np.float32), sync=True)
+        t.join(timeout=30)
+        assert not t.is_alive()
+        _fault.install(None)
+        assert srv._versions["w"] == 1  # ONE aggregated apply
+        np.testing.assert_array_equal(c1.pull("w"),
+                                      np.full(4, 2.0, np.float32))
+        c0.close()
+        c1.close()
+    finally:
+        srv.shutdown()
+
+
+def test_barrier_retransmit_no_double_count(monkeypatch):
+    monkeypatch.setenv("MXTPU_PS_SYNC_TIMEOUT", "60")
+    srv = _ps.ParameterServer(2, host="127.0.0.1", port=0)
+    try:
+        c0 = _ps.PSClient("127.0.0.1", srv.port, instance="w0")
+        c1 = _ps.PSClient("127.0.0.1", srv.port, instance="w1")
+        _fault.install(_fault.FaultInjector("ps.rpc.recv:drop@1", seed=1))
+        t = threading.Thread(target=c0.barrier)
+        t.start()
+        time.sleep(0.3)
+        c1.barrier()
+        t.join(timeout=30)
+        assert not t.is_alive()
+        _fault.install(None)
+        assert srv._barrier_gen == 1  # exactly one generation opened
+        # a second, fault-free round still pairs up correctly
+        t2 = threading.Thread(target=c0.barrier)
+        t2.start()
+        c1.barrier()
+        t2.join(timeout=30)
+        assert srv._barrier_gen == 2
+        c0.close()
+        c1.close()
+    finally:
+        srv.shutdown()
+
+
+def test_quorum_shrinks_after_heartbeat_eviction(monkeypatch):
+    """A worker whose heartbeat went stale is evicted: the survivor's
+    barrier completes instead of hanging out the full rendezvous wait."""
+    monkeypatch.setenv("MXTPU_HEARTBEAT_TIMEOUT", "1.0")
+    monkeypatch.setenv("MXTPU_PS_SYNC_TIMEOUT", "30")
+    srv = _ps.ParameterServer(2, host="127.0.0.1", port=0)
+    try:
+        c0 = _ps.PSClient("127.0.0.1", srv.port, instance="w0")
+        c0.heartbeat(1)      # rank 1 seen once...
+        time.sleep(1.3)      # ...then silent past the timeout
+        t0 = time.monotonic()
+        c0.barrier()         # quorum shrinks to 1; must not wait 30s
+        assert time.monotonic() - t0 < 10
+        # a fresh beat re-admits rank 1
+        c0.heartbeat(1)
+        assert 1 not in srv._evicted
+        c0.close()
+    finally:
+        srv.shutdown()
+
+
+def test_dedup_window_is_bounded(server1, monkeypatch):
+    c = _ps.PSClient("127.0.0.1", server1.port)
+    c.init("w", np.zeros(1, np.float32))
+    for _ in range(300):
+        c.push("w", np.ones(1, np.float32))
+    window = server1._dedup[c._client_id]
+    assert len(window) <= server1._dedup_window
+    c.close()
+
+
+def test_connect_loop_waits_for_late_server():
+    """The RetryPolicy connect loop rides out a server that is not up
+    yet (the launcher race the old fixed 0.5s x 60 loop covered)."""
+    probe = __import__("socket").socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    holder = {}
+
+    def late_start():
+        time.sleep(0.8)
+        holder["srv"] = _ps.ParameterServer(1, host="127.0.0.1", port=port)
+
+    t = threading.Thread(target=late_start)
+    t.start()
+    try:
+        c = _ps.PSClient("127.0.0.1", port)
+        c.init("w", np.zeros(1, np.float32))
+        c.close()
+    finally:
+        t.join()
+        holder["srv"].shutdown()
+
+
+def test_server_error_is_not_retried(server1):
+    c = _ps.PSClient("127.0.0.1", server1.port)
+    with pytest.raises(RuntimeError):
+        c.pull("never-initialized")
+    c.close()
