@@ -82,6 +82,27 @@ run_chaos() {
     # completes, auto-resumes from the latest VALID epoch, and recovers
     # weights bit-identical to the fault-free reference
     JAX_PLATFORMS=cpu python tools/chaos_train.py
+    echo "=== chaos tier: distributed tracing + flight recorder ==="
+    # traced chaos run (seeded drop + slow rank + forced retry
+    # exhaustion), then merge the trace files and gate on: >=1
+    # post-mortem dump, a straggler report naming the faulted rank
+    # (asserted inside chaos_train), and a parseable merged timeline
+    local obs_dir
+    obs_dir="$(mktemp -d -t mxtpu-chaos-obs-XXXXXX)"
+    JAX_PLATFORMS=cpu python tools/chaos_train.py --observability \
+        --workdir "$obs_dir"
+    JAX_PLATFORMS=cpu python tools/trace_merge.py "$obs_dir/traces" \
+        -o "$obs_dir/timeline.json" --stragglers --check
+    python - "$obs_dir" <<'PY'
+import json, os, sys
+d = sys.argv[1]
+dumps = [f for f in os.listdir(os.path.join(d, "traces"))
+         if f.startswith("flightrec-") and f.endswith(".json")]
+assert dumps, "chaos observability run produced no flight-recorder dump"
+json.load(open(os.path.join(d, "timeline.json")))
+print(f"chaos observability artifacts ok: {len(dumps)} dump(s) "
+      "+ parseable merged timeline")
+PY
 }
 
 run_nightly() {

@@ -214,6 +214,39 @@ register_knob("MXTPU_PS_DEDUP_WINDOW", 128, int,
 register_knob("MXNET_PROFILER_AUTOSTART", False, bool,
               "Start profiling at import (ref: env_var.md:192).")
 
+# distributed tracing / flight recorder (see docs/OBSERVABILITY.md)
+register_knob("MXTPU_TRACE_DIR", "", str,
+              "Directory for per-process binary-framed trace files "
+              "(span records with trace/span/parent ids). Setting it "
+              "activates cluster-wide trace export: every completed span "
+              "is appended to <dir>/trace-<pid>-<suffix>.mxtrace; merge "
+              "the files with tools/trace_merge.py into one "
+              "Chrome-trace/Perfetto timeline. Empty (default) disables "
+              "trace export.")
+register_knob("MXTPU_TRACE_BUFFER_SPANS", 256, int,
+              "Completed spans buffered in memory before one framed "
+              "write+flush to the trace file (atexit flushes the "
+              "remainder). Lower = fresher files after a crash, higher "
+              "= fewer write calls on the span exit path.")
+register_knob("MXTPU_FLIGHT_RECORDER_EVENTS", 4096, int,
+              "Capacity of the always-on flight-recorder ring buffer "
+              "(structured events: span boundaries, retries, reconnects, "
+              "evictions, checkpoint writes, injected faults). The ring "
+              "is a fixed-size in-memory black box costing one list "
+              "store per event; 0 disables recording entirely.")
+register_knob("MXTPU_FLIGHT_RECORDER_DIR", "", str,
+              "Destination directory for post-mortem flight-recorder "
+              "dumps (ring contents + metrics snapshot + config knobs as "
+              "JSON), written when a worker dies with an uncaught "
+              "exception, a retry policy exhausts, or the server evicts "
+              "a rank. Empty falls back to MXTPU_TRACE_DIR; when both "
+              "are empty no dump files are ever written (the ring still "
+              "records).")
+register_knob("MXTPU_FLIGHT_RECORDER_MAX_DUMPS", 8, int,
+              "Cap on post-mortem dump files one process may write "
+              "(guards against dump storms from a retry loop that "
+              "exhausts repeatedly).")
+
 # telemetry
 register_knob("MXNET_TELEMETRY", False, bool,
               "Master switch for the runtime telemetry layer (metrics "

@@ -72,6 +72,10 @@ class Executor:
         self._grad_names = None
         self.outputs: list[NDArray] = []
         self._monitor_callback = None
+        # binds are rare and expensive (each implies an XLA compile), so a
+        # post-mortem dump showing one near the failure is signal
+        _telemetry.log_event("executor_bind", args=len(self.arg_dict),
+                             outputs=len(symbol.list_outputs()))
 
     # -- properties mirroring the reference Executor ----------------------
     @property

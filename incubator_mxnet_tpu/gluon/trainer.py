@@ -631,7 +631,10 @@ class Trainer:
         tmp/fsync/rename + manifest protocol so `auto_resume` can walk
         back over torn epochs after a crash."""
         from .. import resilience as _resilience
+        from .. import telemetry as _telemetry
 
+        _telemetry.log_event("trainer_checkpoint", prefix=str(prefix),
+                             epoch=int(epoch))
         if net is not None:
             _resilience.atomic_save(f"{prefix}-{epoch:04d}.params",
                                     net.save_parameters)
@@ -648,9 +651,15 @@ class Trainer:
         from .. import model as _model
         from .. import resilience as _resilience
 
+        from .. import telemetry as _telemetry
+
         epoch = _model.latest_valid_checkpoint(prefix)
         if epoch is None:
+            _telemetry.log_event("trainer_resume", prefix=str(prefix),
+                                 epoch=-1, fresh=True)
             return 0
+        _telemetry.log_event("trainer_resume", prefix=str(prefix),
+                             epoch=int(epoch), fresh=False)
         if net is not None:
             net.load_parameters(f"{prefix}-{epoch:04d}.params")
         states = f"{prefix}-{epoch:04d}.states"

@@ -20,6 +20,19 @@ from .ps import ParameterServer, default_server_addr
 __all__ = ["KVStoreServer", "_init_kvstore_server_module"]
 
 
+def _server_metrics_port(num_workers):
+    """/metrics port for a PS server process: the configured base port
+    offset by the worker count, so on a shared host the workers (who sit
+    at the base port) and the server never collide. None when no port is
+    configured."""
+    from . import config as _config
+
+    base = _config.get("MXNET_TELEMETRY_PORT")
+    if base <= 0:
+        return None
+    return base + int(num_workers)
+
+
 class KVStoreServer:
     """Blocking wrapper running the parameter-server loop in this
     process."""
@@ -33,6 +46,18 @@ class KVStoreServer:
             num_workers = int(os.environ.get(  # mxlint: disable=MXL007
                 "MXTPU_NUM_WORKERS",
                 os.environ.get("DMLC_NUM_WORKER", "1")))
+        self.metrics_server = None
+        from . import config as _config
+
+        if _config.get("MXNET_TELEMETRY"):
+            # server-side counters (dedup hits, evictions) are useless if
+            # nobody can scrape them: bind this role's offset port BEFORE
+            # telemetry auto-resolution can grab the base one
+            metrics_port = _server_metrics_port(num_workers)
+            if metrics_port is not None:
+                from . import telemetry as _telemetry
+
+                self.metrics_server = _telemetry.enable(port=metrics_port)
         addr_host, addr_port = default_server_addr()
         self._server = ParameterServer(
             num_workers=num_workers,

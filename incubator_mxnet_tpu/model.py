@@ -38,7 +38,10 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
     order) so checkpointing overlaps the next training steps — the engine
     doing for host IO what it does for comm in the reference."""
     from . import resilience as _resilience
+    from . import telemetry as _telemetry
 
+    _telemetry.log_event("model_checkpoint", prefix=str(prefix),
+                         epoch=int(epoch), run_async=bool(run_async))
     if symbol is not None:
         # own injection site: symbol rewrites must not consume the
         # ckpt.write fault stream the params files are scheduled on
@@ -99,7 +102,9 @@ def wait_checkpoints(prefix=None):
 
 def load_params(prefix, epoch):
     from . import resilience as _resilience
+    from . import telemetry as _telemetry
 
+    _telemetry.log_event("model_load", prefix=str(prefix), epoch=int(epoch))
     path = f"{prefix}-{epoch:04d}.params"
     # a missing file raises FileNotFoundError from nd.load as before;
     # verification guards the EXISTING-but-torn case

@@ -52,6 +52,10 @@ _EVICT_HELP = ("Workers evicted from the barrier/sync quorum after "
 # client closes and redials rather than reuse the poisoned socket
 _WIRE_ERRORS = (OSError, EOFError, struct.error)
 
+# commands that ride the control plane every couple of seconds (the
+# heartbeat thread) — never spanned/traced, they would drown the timeline
+_UNTRACED_COMMANDS = frozenset({"heartbeat", "num_dead"})
+
 _LEN = struct.Struct(">Q")
 _U32 = struct.Struct(">I")
 _F64 = struct.Struct(">d")
@@ -361,6 +365,14 @@ class ParameterServer:
         try:
             while True:
                 msg = _recv_msg(conn)
+                ctx = None
+                if msg[0] == "trc":
+                    # tracing wrapper: ("trc", {tid, sid}, inner_frame) —
+                    # the sender's span becomes this request's parent
+                    info = msg[1]
+                    if isinstance(info, dict) and info.get("sid"):
+                        ctx = (info.get("tid"), info.get("sid"))
+                    msg = msg[2]
                 cmd = msg[0]
                 if cmd == "stop":
                     _send_msg(conn, ("ok",))
@@ -369,9 +381,9 @@ class ParameterServer:
                 if cmd == "mut":
                     # reliable envelope: ("mut", client_id, seq, cmd, *args)
                     resp = self._handle_mut(msg[1], int(msg[2]), msg[3],
-                                            msg[4:])
+                                            msg[4:], ctx)
                 else:
-                    resp = self._dispatch(cmd, msg[1:])
+                    resp = self._dispatch(cmd, msg[1:], ctx)
                 _send_msg(conn, resp)
         except (ConnectionError, OSError, EOFError, ValueError,
                 struct.error):
@@ -379,13 +391,23 @@ class ParameterServer:
         finally:
             conn.close()
 
-    def _dispatch(self, cmd, args):
+    def _dispatch(self, cmd, args, ctx=None):
         try:
-            return getattr(self, "_cmd_" + cmd)(*args)
+            if cmd in _UNTRACED_COMMANDS:
+                return getattr(self, "_cmd_" + cmd)(*args)
+            from . import telemetry as _telemetry
+            from .telemetry import distributed as _distributed
+
+            # the child span opens HERE, not in _serve: _handle_mut routes
+            # only the owning frame of each (client, seq) through dispatch,
+            # so a retried (deduped) mutation yields exactly one server span
+            with _distributed.remote_context(ctx, lane="server"):
+                with _telemetry.span("ps.server.handle", command=cmd):
+                    return getattr(self, "_cmd_" + cmd)(*args)
         except Exception as e:  # ship the failure to the worker
             return ("err", f"{type(e).__name__}: {e}")
 
-    def _handle_mut(self, client_id, seq, cmd, args):
+    def _handle_mut(self, client_id, seq, cmd, args, ctx=None):
         """Exactly-once apply for mutating RPCs: each (client_id, seq) is
         executed by the first frame that carries it; a retransmit (same
         client redialing after a mid-frame drop) waits for the original's
@@ -407,13 +429,15 @@ class ParameterServer:
                         break  # never evict an in-flight original
                     window.pop(oldest)
         if owner:
-            resp = self._dispatch(cmd, args)
+            resp = self._dispatch(cmd, args, ctx)
             entry["resp"] = resp
             entry["done"].set()
             return resp
         from . import telemetry as _telemetry
 
         _telemetry.inc(_DEDUP_METRIC, 1, help=_DEDUP_HELP, command=cmd)
+        _telemetry.log_event("ps_dedup_hit", command=cmd, seq=seq,
+                             client=client_id)
         logger.debug("ps: duplicate %s seq=%d from %s suppressed",
                      cmd, seq, client_id)
         # generous slack over the longest a legitimate original can run
@@ -442,6 +466,7 @@ class ParameterServer:
             quorum = max(1, self.num_workers - len(self._evicted))
         if newly:
             from . import telemetry as _telemetry
+            from .telemetry import recorder as _recorder
 
             for rank in newly:
                 logger.warning(
@@ -449,6 +474,12 @@ class ParameterServer:
                     "the rendezvous quorum (now %d/%d)", rank,
                     self._evict_timeout, quorum, self.num_workers)
                 _telemetry.inc(_EVICT_METRIC, 1, help=_EVICT_HELP)
+                _telemetry.log_event(
+                    "ps_eviction", rank=rank, quorum=quorum,
+                    world=self.num_workers,
+                    stale_s=round(self._evict_timeout, 3))
+            # a rank just fell out of the job: preserve the black box
+            _recorder.dump("eviction")
         return quorum
 
     # --- commands ---------------------------------------------------------
@@ -513,18 +544,21 @@ class ParameterServer:
         self._versions[key] += 1
 
     def _cmd_push(self, key, grad, sync):
+        from . import telemetry as _telemetry
+
         grad = np.asarray(grad)
         if not sync:
             # async: apply instantly, nobody waits (ref: :348-358)
-            with self._key_lock(key):
-                self._apply(key, grad)
+            with _telemetry.span("ps.server.merge", sync="0"):
+                with self._key_lock(key):
+                    self._apply(key, grad)
             return ("ok",)
         # sync: aggregate one contribution per live worker, apply once,
         # release everyone at the new version (ref: :346 merge buffer
         # path). Waits run in short slices so a heartbeat eviction
         # mid-generation shrinks the quorum and releases the survivors
         # instead of hanging them until the rendezvous timeout.
-        with self._sync_cv:
+        with _telemetry.span("ps.server.merge", sync="1"), self._sync_cv:
             buf, count = self._merge.get(key, (None, 0))
             buf = grad if buf is None else buf + grad
             count += 1
@@ -594,12 +628,14 @@ class ParameterServer:
             return ("val", np.array(self._store[key][rows], copy=True))
 
     def _cmd_barrier(self):
+        from . import telemetry as _telemetry
+
         # generation-counted rendezvous (ref: ps-lite Postoffice::Barrier).
         # Short wait slices re-evaluate the quorum so heartbeat evictions
         # release the survivors; whichever waiter first observes
         # count >= quorum opens the generation. A retransmitted barrier
         # never double-counts: it rides the dedup window in _handle_mut.
-        with self._barrier_cv:
+        with _telemetry.span("ps.server.barrier"), self._barrier_cv:
             gen = self._barrier_gen
             self._barrier_count += 1
             deadline = time.monotonic() + self._sync_timeout
@@ -762,31 +798,61 @@ class PSClient:
 
             _telemetry.inc(_RECONNECT_METRIC, 1, help=_RECONNECT_HELP,
                            cause=cause)
+            _telemetry.log_event("ps_reconnect", cause=cause,
+                                 addr=f"{self._host}:{self._port}")
             logger.debug("PSClient reconnected to %s:%d (%s)",
                          self._host, self._port, cause)
 
     # --- framing ----------------------------------------------------------
     def _rpc_attempt(self, frame):
         from .resilience import fault as _fault
+        from .telemetry.spans import current_span
 
         inj = _fault.injector()
+        # attach the current trace context so the server's child span joins
+        # this trace; the ("trc", ...) wrapper only exists when a span is
+        # live, so the untraced wire format is byte-identical to before
+        sp = current_span()
+        traced = sp is not None and sp.span_id is not None
+        if traced:
+            frame = ("trc", {"tid": sp.trace_id, "sid": sp.span_id}, frame)
         with self._lock:
             if self._sock is None:
                 self._reconnect_locked(cause="redial")
             try:
                 inj.raise_for("ps.rpc", self._instance)
+                if traced:
+                    # send/recv wall clocks of the SUCCESSFUL attempt
+                    # (annotate overwrites across retries) — paired with
+                    # the server span's start/end by trace_merge for
+                    # NTP-style clock-skew correction
+                    sp.annotate(send_ns=time.time_ns())
                 _send_msg(self._sock, frame)
                 # separate post-send site: a drop HERE leaves the request
                 # applied server-side, which is exactly what the dedup
                 # window must absorb on the retransmit
                 inj.raise_for("ps.rpc.recv", self._instance)
-                return _recv_msg(self._sock)
+                resp = _recv_msg(self._sock)
+                if traced:
+                    sp.annotate(recv_ns=time.time_ns())
+                return resp
             except _WIRE_ERRORS as e:
                 self._close_locked()  # poisoned mid-frame: next try redials
                 self._last_cause = type(e).__name__
+                if sp is not None:
+                    sp.bump("retries")
                 raise
 
     def _call(self, frame, site):
+        from . import telemetry as _telemetry
+
+        command = site.rpartition(".")[2]
+        if command in _UNTRACED_COMMANDS:
+            return self._call_inner(frame, site)
+        with _telemetry.span("ps.client.rpc", command=command):
+            return self._call_inner(frame, site)
+
+    def _call_inner(self, frame, site):
         resp = self._rpc_policy.call(
             lambda _a: self._rpc_attempt(frame), _WIRE_ERRORS, site=site)
         if resp[0] == "err":

@@ -109,10 +109,15 @@ def read_manifest(path):
     return m if isinstance(m, dict) and "sha256" in m else None
 
 
-def _inc(name, help_, **labels):
+def _inc(name, help_, path=None, **labels):
     from .. import telemetry as _telemetry
 
     _telemetry.inc(name, 1, help=help_, **labels)
+    # every write outcome / verify failure also lands in the flight
+    # recorder, so a post-mortem dump shows the checkpoint history
+    _telemetry.log_event(
+        "ckpt_write" if name == _WRITE_METRIC else "ckpt_verify_failure",
+        **(dict(labels, path=path) if path else labels))
 
 
 def atomic_save(path, writer, site="ckpt.write", instance=""):
@@ -129,7 +134,8 @@ def atomic_save(path, writer, site="ckpt.write", instance=""):
         # mid-write crash: partial tmp file, canonical + manifest untouched
         with open(tmp, "wb") as f:
             f.write(b"\0" * 64)
-        _inc(_WRITE_METRIC, _WRITE_HELP, outcome="injected-fail")
+        _inc(_WRITE_METRIC, _WRITE_HELP, path=path,
+             outcome="injected-fail")
         raise _fault.InjectedIOError(
             f"fault injection: checkpoint write failed at {site!r} "
             f"({path})")
@@ -146,7 +152,8 @@ def atomic_save(path, writer, site="ckpt.write", instance=""):
                 f.write(data)
             os.remove(tmp)
             _write_manifest(path, digest, size)
-            _inc(_WRITE_METRIC, _WRITE_HELP, outcome="injected-torn")
+            _inc(_WRITE_METRIC, _WRITE_HELP, path=path,
+                 outcome="injected-torn")
             logger.warning("fault injection: torn checkpoint left at %s",
                            path)
             return digest
@@ -159,7 +166,7 @@ def atomic_save(path, writer, site="ckpt.write", instance=""):
             pass
         raise
     _write_manifest(path, digest, size)
-    _inc(_WRITE_METRIC, _WRITE_HELP, outcome="ok")
+    _inc(_WRITE_METRIC, _WRITE_HELP, path=path, outcome="ok")
     return digest
 
 
@@ -181,22 +188,22 @@ def verify(path):
     """
     path = str(path)
     if not os.path.isfile(path):
-        _inc(_VERIFY_METRIC, _VERIFY_HELP, reason="missing-file")
+        _inc(_VERIFY_METRIC, _VERIFY_HELP, path=path, reason="missing-file")
         return False
     m = read_manifest(path)
     if m is None:
         if os.path.exists(manifest_path(path)):
-            _inc(_VERIFY_METRIC, _VERIFY_HELP, reason="bad-manifest")
+            _inc(_VERIFY_METRIC, _VERIFY_HELP, path=path, reason="bad-manifest")
             return False
         return True  # legacy checkpoint: no manifest was ever written
     size = m.get("size")
     if size is not None and os.path.getsize(path) != size:
-        _inc(_VERIFY_METRIC, _VERIFY_HELP, reason="size")
+        _inc(_VERIFY_METRIC, _VERIFY_HELP, path=path, reason="size")
         logger.warning("checkpoint %s failed verification: size %d != "
                        "manifest %d", path, os.path.getsize(path), size)
         return False
     if _sha256_file(path) != m["sha256"]:
-        _inc(_VERIFY_METRIC, _VERIFY_HELP, reason="checksum")
+        _inc(_VERIFY_METRIC, _VERIFY_HELP, path=path, reason="checksum")
         logger.warning("checkpoint %s failed verification: checksum "
                        "mismatch", path)
         return False

@@ -143,6 +143,8 @@ class FaultInjector:
 
         _telemetry.inc(_FAULT_METRIC, 1, help=_FAULT_HELP, site=site,
                        mode=rule.mode)
+        _telemetry.log_event("fault_injected", site=site, mode=rule.mode,
+                             instance=instance, call=n)
         return rule.mode
 
     def raise_for(self, site, instance=""):

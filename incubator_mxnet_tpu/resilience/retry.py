@@ -96,12 +96,25 @@ class RetryPolicy:
                 elapsed = time.monotonic() - start
                 remaining = self.deadline - elapsed
                 if delay is None or elapsed + delay > self.deadline:
+                    from ..telemetry import recorder as _recorder
+
                     _telemetry.inc(_RETRY_METRIC, 1, help=_RETRY_HELP,
                                    site=site or "unknown",
                                    outcome="exhausted")
+                    _recorder.log_event(
+                        "retry_exhausted", site=site or "unknown",
+                        attempts=attempt + 1, exc=type(e).__name__,
+                        elapsed_s=round(elapsed, 3))
+                    # the caller is about to see the error its retries
+                    # were hiding — this rank is likely going down, so
+                    # preserve the black box now
+                    _recorder.dump(f"retry-exhausted-{site or 'unknown'}")
                     raise
                 _telemetry.inc(_RETRY_METRIC, 1, help=_RETRY_HELP,
                                site=site or "unknown", outcome="retried")
+                _telemetry.log_event(
+                    "retry", site=site or "unknown", attempt=attempt + 1,
+                    exc=type(e).__name__, delay_s=round(delay, 4))
                 if on_retry is not None:
                     on_retry(attempt, e, remaining)
                 else:

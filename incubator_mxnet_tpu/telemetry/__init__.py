@@ -33,7 +33,10 @@ from .metrics import (  # noqa: F401
 from .names import (  # noqa: F401
     METRIC_NAMES, SPAN_NAMES, is_registered_metric, is_registered_span,
 )
+from . import distributed  # noqa: F401
+from . import recorder  # noqa: F401
 from .spans import Span, NoopSpan, NOOP_SPAN, current_span, SPAN_HISTOGRAM  # noqa: F401
+from .recorder import log_event  # noqa: F401
 from .exporters import dump_json, prometheus_text, start_http_server, to_dict  # noqa: F401
 from .memory import sample_device_memory, step_boundary  # noqa: F401
 from .tb import LogTelemetryCallback  # noqa: F401
@@ -42,6 +45,7 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
     "DEFAULT_BUCKETS", "BYTES_BUCKETS",
     "Span", "NoopSpan", "current_span", "span",
+    "distributed", "recorder", "log_event",
     "dump_json", "prometheus_text", "start_http_server", "to_dict",
     "sample_device_memory", "step_boundary", "LogTelemetryCallback",
     "enabled", "enable", "disable", "refresh_from_env",
@@ -85,11 +89,16 @@ def _maybe_start_http():
 
 def enable(port=None):
     """Turn telemetry on for this process (overrides the env default).
-    `port` additionally starts a /metrics endpoint there."""
+    `port` additionally starts a /metrics endpoint there — bound BEFORE
+    the enable flag flips, so an explicit port wins over
+    MXNET_TELEMETRY_PORT (processes sharing an env, e.g. PS servers on a
+    rank-offset port, would otherwise race onto the base port)."""
     global _http_server
-    _set_enabled(True)
     if port is not None and _http_server is None:
-        _http_server = start_http_server(port)
+        with _state_lock:
+            if _http_server is None:
+                _http_server = start_http_server(port)
+    _set_enabled(True)
     return _http_server
 
 
@@ -108,10 +117,14 @@ def refresh_from_env():
 
 def span(name, **tags):
     """Timed, nestable tracing region; see spans.Span. Returns the shared
-    no-op span while telemetry is disabled."""
-    if not enabled():
-        return NOOP_SPAN
-    return Span(name, tags)
+    no-op span while both telemetry and distributed tracing are off; a
+    trace-only span (no registry/profiler sinks) when only
+    MXTPU_TRACE_DIR is set."""
+    if enabled():
+        return Span(name, tags)
+    if distributed.trace_active():
+        return Span(name, tags, metrics=False)
+    return NOOP_SPAN
 
 
 # -- registry conveniences (always live; instrument through the helpers

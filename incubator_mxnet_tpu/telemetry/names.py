@@ -74,6 +74,12 @@ METRIC_NAMES = {
     "mxtpu_ckpt_verify_failures_total": (
         "counter", "Checkpoint files failing manifest verification at "
                    "load, by reason."),
+    "mxtpu_span_errors_total": (
+        "counter", "Spans whose body raised an exception, by span name "
+                   "(the span itself is tagged error=<ExcType>)."),
+    "mxtpu_flight_recorder_dumps_total": (
+        "counter", "Post-mortem flight-recorder dump files written, by "
+                   "reason."),
 }
 
 # span() names (tracing regions). Dots namespace by subsystem.
@@ -82,6 +88,10 @@ SPAN_NAMES = frozenset({
     "executor.backward",
     "trainer.step",
     "trainer.allreduce_grads",
+    "ps.client.rpc",
+    "ps.server.handle",
+    "ps.server.merge",
+    "ps.server.barrier",
 })
 
 

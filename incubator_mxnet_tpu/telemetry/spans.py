@@ -1,4 +1,4 @@
-"""Tracing spans: nested wall-time regions that feed three sinks at once.
+"""Tracing spans: nested wall-time regions that feed several sinks at once.
 
 A span records its duration into the metrics registry
 (`mxtpu_span_seconds{span=...}`), forwards to
@@ -6,6 +6,19 @@ A span records its duration into the metrics registry
 up with the XLA device timeline in TensorBoard/Perfetto), and accumulates
 into the profiler's per-op aggregate table when `aggregate_stats` is on —
 unifying with `profiler.dumps()` instead of growing a second table.
+
+When distributed tracing is active (`MXTPU_TRACE_DIR`), every span also
+carries Dapper-style identity — `trace_id`/`span_id`/`parent_id` — and is
+appended to this process's trace file on exit. A root span adopts the
+remote parent shipped by a peer (see `telemetry.distributed`), which is
+what links a worker's `trainer.step` to the server-side `merge` it caused.
+Completed spans additionally drop a boundary event into the flight
+recorder ring, so a post-mortem dump shows what the process was doing.
+
+A span whose body raises keeps its timing but is tagged
+`error=<ExcType>` (visible in traces and the `mxtpu_span_seconds` series)
+and bumps `mxtpu_span_errors_total{name=...}` — failed and healthy spans
+are never conflated.
 
 Nesting is tracked per-thread; `current_span()` exposes the innermost
 active span (its `parent` chain gives the full stack).
@@ -16,13 +29,18 @@ import threading
 import time
 
 from .. import profiler as _profiler
+from . import distributed as _distributed
+from . import recorder as _recorder
 from .metrics import REGISTRY
 
-__all__ = ["Span", "current_span", "SPAN_HISTOGRAM"]
+__all__ = ["Span", "current_span", "SPAN_HISTOGRAM", "SPAN_ERRORS"]
 
 SPAN_HISTOGRAM = "mxtpu_span_seconds"
 _SPAN_HELP = ("Wall time of named host-side spans (executor forward/backward,"
               " trainer step, ...); tags become extra labels.")
+SPAN_ERRORS = "mxtpu_span_errors_total"
+_ERRORS_HELP = ("Spans whose body raised, by span name (the exception type "
+                "is tagged on the span itself).")
 
 _local = threading.local()
 
@@ -35,20 +53,62 @@ def current_span():
 class Span:
     """Context manager for one timed region. Re-enterable is NOT supported
     (create a fresh Span per region); re-use across threads is not either —
-    both mirror TraceAnnotation's contract."""
+    both mirror TraceAnnotation's contract.
 
-    __slots__ = ("name", "tags", "parent", "_t0", "_annot")
+    `metrics=False` builds a trace-only span: it still gets identity and
+    lands in the trace file / flight recorder, but skips the registry and
+    profiler sinks — the shape `span()` hands out when distributed tracing
+    is on while telemetry proper is off."""
 
-    def __init__(self, name, tags=None):
+    __slots__ = ("name", "tags", "parent", "trace_id", "span_id",
+                 "parent_id", "extra", "_start_ns", "_t0", "_annot",
+                 "_metrics")
+
+    def __init__(self, name, tags=None, metrics=True):
         self.name = name
         self.tags = dict(tags or {})
         self.parent = None
+        self.trace_id = None
+        self.span_id = None
+        self.parent_id = None
+        self.extra = None
+        self._start_ns = None
         self._t0 = None
         self._annot = None
+        self._metrics = metrics
+
+    def annotate(self, **kv):
+        """Attach key/values to the span's trace record (not metric
+        labels — no cardinality cost). Used for e.g. the RPC send/recv
+        timestamps that drive clock-skew correction in trace_merge."""
+        if self.extra is None:
+            self.extra = {}
+        self.extra.update(kv)
+        return self
+
+    def bump(self, key, amount=1):
+        """Increment a numeric annotation (e.g. per-span retry count)."""
+        if self.extra is None:
+            self.extra = {}
+        self.extra[key] = self.extra.get(key, 0) + amount
+        return self
 
     def __enter__(self):
         self.parent = getattr(_local, "current", None)
         _local.current = self
+        if _distributed.trace_active():
+            self.span_id = _distributed.new_id()
+            parent = self.parent
+            if parent is not None and parent.span_id is not None:
+                self.trace_id = parent.trace_id
+                self.parent_id = parent.span_id
+            else:
+                remote = _distributed.remote_parent()
+                if remote is not None:
+                    self.trace_id, self.parent_id = remote
+                else:
+                    self.trace_id = _distributed.new_id()
+            self._start_ns = time.time_ns()
         if _profiler._STATE["running"]:
             try:
                 self._annot = _profiler.scope(self.name)
@@ -67,12 +127,37 @@ class Span:
                 pass
             self._annot = None
         _local.current = self.parent
-        labels = {"span": self.name}
-        for k, v in self.tags.items():
-            labels[str(k)] = str(v)
-        REGISTRY.histogram(SPAN_HISTOGRAM, _SPAN_HELP).observe(dur, **labels)
-        if _profiler.aggregate_enabled():
-            _profiler.record_duration(self.name, dur)
+        if exc_type is not None:
+            self.tags["error"] = getattr(exc_type, "__name__", str(exc_type))
+        if self._metrics:
+            labels = {"span": self.name}
+            for k, v in self.tags.items():
+                labels[str(k)] = str(v)
+            REGISTRY.histogram(SPAN_HISTOGRAM, _SPAN_HELP).observe(
+                dur, **labels)
+            if exc_type is not None:
+                REGISTRY.counter(SPAN_ERRORS, _ERRORS_HELP).inc(
+                    1, name=self.name)
+            if _profiler.aggregate_enabled():
+                _profiler.record_duration(self.name, dur)
+        if self.span_id is not None:
+            record = {
+                "name": self.name,
+                "tid": self.trace_id,
+                "sid": self.span_id,
+                "pid": self.parent_id,
+                "ts": self._start_ns,
+                "dur_ns": int(dur * 1e9),
+            }
+            if self.tags:
+                record["tags"] = {str(k): str(v)
+                                  for k, v in self.tags.items()}
+            if self.extra:
+                record["extra"] = self.extra
+            _distributed.record_span(record)
+        _recorder.log_event(
+            "span_end", name=self.name, dur_ns=int(dur * 1e9),
+            **({"error": self.tags["error"]} if exc_type is not None else {}))
         return False
 
 
@@ -84,12 +169,22 @@ class NoopSpan:
     name = None
     tags = {}
     parent = None
+    trace_id = None
+    span_id = None
+    parent_id = None
+    extra = None
 
     def __enter__(self):
         return self
 
     def __exit__(self, *exc):
         return False
+
+    def annotate(self, **kv):
+        return self
+
+    def bump(self, key, amount=1):
+        return self
 
 
 NOOP_SPAN = NoopSpan()

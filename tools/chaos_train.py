@@ -19,18 +19,29 @@ fault-free reference (2 workers: the one merge-buffer addition is
 commutative, and the update arithmetic is stateless, so recovery is
 exact, not approximate).
 
+With --observability the script instead runs the distributed-tracing
+proof (ci/run_tests.sh chaos tier, second half): a traced 2-worker run
+with one seeded drop and a deliberately slow rank, a forced
+retry-exhaustion post-mortem, then asserts on the merged timeline — a
+worker `trainer.step` is the causal ancestor of a server `merge` span in
+the same trace, the straggler report names the faulted rank, and a
+flight-recorder dump holds the injected fault event.
+
 Usage:  JAX_PLATFORMS=cpu python tools/chaos_train.py [--epochs 4]
+        JAX_PLATFORMS=cpu python tools/chaos_train.py --observability
 """
 import argparse
+import json
 import os
 import sys
 import threading
+import time
 
 import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from incubator_mxnet_tpu import model, nd, ps as _ps  # noqa: E402
+from incubator_mxnet_tpu import model, nd, ps as _ps, telemetry  # noqa: E402
 from incubator_mxnet_tpu.resilience import fault as _fault  # noqa: E402
 
 DIM = 8
@@ -40,6 +51,13 @@ LR = np.float32(0.1)
 # independently on EACH worker's stream (>=3 total drops overall)
 DROP_SPEC = "ps.rpc.recv:drop@2,5,9"
 TORN_SPEC = "ckpt.write:torn@{n}"
+
+# observability run: rank 0 makes 4 recv calls per epoch (pull, push,
+# checkpoint pull, barrier) + 1 init, rank 1 makes 3 — so over 3 epochs
+# call 11 exists ONLY on rank 0's stream and the faulted rank is
+# unambiguous for the straggler report
+OBS_DROP_SPEC = "ps.rpc.recv:drop@11"
+OBS_EPOCHS = 3
 
 
 def _target(epoch, rank):
@@ -98,6 +116,125 @@ def run_epochs(prefix, start_epoch, num_epochs, init_w, checkpoint=True):
     return final["w"]
 
 
+def run_observability(workdir):
+    """The distributed-tracing acceptance proof (see module docstring)."""
+    trace_dir = os.path.join(workdir, "traces")
+    os.makedirs(trace_dir, exist_ok=True)
+    os.environ["MXTPU_TRACE_DIR"] = trace_dir
+    os.environ["MXTPU_FLIGHT_RECORDER_DIR"] = trace_dir
+    os.environ["MXTPU_FAULT_SPEC"] = OBS_DROP_SPEC
+    telemetry.distributed.refresh_from_env()
+    telemetry.recorder.refresh_from_env()
+    _fault.install(None)
+    inj = _fault.injector()
+
+    srv = _ps.ParameterServer(2, host="127.0.0.1", port=0)
+    clients = [_ps.PSClient("127.0.0.1", srv.port, instance=f"w{r}")
+               for r in range(2)]
+    try:
+        clients[0].init("w", np.zeros(DIM, dtype=np.float32))
+
+        def worker(rank):
+            # one timeline lane per simulated rank (these are threads of
+            # one process; real multi-process runs get r<rank> for free)
+            telemetry.distributed.set_thread_lane(f"r{rank}")
+            c = clients[rank]
+            for epoch in range(1, OBS_EPOCHS + 1):
+                with telemetry.span("trainer.step", epoch=epoch):
+                    w = np.asarray(c.pull("w"), dtype=np.float32)
+                    if rank == 1:
+                        # the straggler: everyone else queues up at the
+                        # sync push / barrier waiting for this rank
+                        time.sleep(0.15)
+                    c.push("w", _grad(w, epoch, rank), sync=True)
+                    if rank == 0:
+                        c.pull("w")
+                    c.barrier()
+
+        threads = [threading.Thread(target=worker, args=(r,))
+                   for r in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+            assert not t.is_alive(), "worker wedged"
+    finally:
+        for c in clients:
+            c.close()
+        srv.shutdown()
+    drops = inj.fired("ps.rpc.recv", "drop")
+    assert drops >= 1, f"expected >=1 injected drop, fired {drops}"
+    print(f"[chaos] traced run done: {drops} drop(s) injected")
+
+    # post-mortem: exhaust the connect retries against a port nobody
+    # listens on — the RetryPolicy's exhaustion hook dumps the black box
+    import socket
+
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()
+    try:
+        _ps.PSClient("127.0.0.1", dead_port, retries=1)
+    except ConnectionError:
+        pass
+    else:
+        raise AssertionError("connect to a dead port unexpectedly worked")
+
+    telemetry.distributed.flush()
+    for var in ("MXTPU_TRACE_DIR", "MXTPU_FLIGHT_RECORDER_DIR",
+                "MXTPU_FAULT_SPEC"):
+        os.environ.pop(var, None)
+    _fault.install(None)
+
+    # --- verdicts over the artifacts --------------------------------------
+    import trace_merge
+
+    dumps = [f for f in os.listdir(trace_dir) if f.startswith("flightrec-")]
+    assert dumps, "no flight-recorder dump written"
+    with open(os.path.join(trace_dir, sorted(dumps)[0])) as f:
+        dump = json.load(f)
+    faults = [e for e in dump["events"] if e["kind"] == "fault_injected"]
+    assert faults, "dump holds no fault_injected event"
+    assert dump["reason"].startswith("retry-exhausted"), dump["reason"]
+    print(f"[chaos] post-mortem dump ok: reason={dump['reason']!r}, "
+          f"{len(dump['events'])} events, {len(faults)} injected fault(s)")
+
+    records, files = trace_merge.load_dir(trace_dir)
+    by_sid = {r["sid"]: r for r in records}
+    steps = {r["tid"]: r for r in records if r["name"] == "trainer.step"
+             and r["lane"].startswith("r")}
+    linked = []
+    for merge in (r for r in records if r["name"] == "ps.server.merge"):
+        node, chain = merge, []
+        while node is not None and node.get("pid"):
+            node = by_sid.get(node["pid"])
+            if node is not None:
+                chain.append(node["name"])
+        if merge["tid"] in steps and chain and chain[-1] == "trainer.step":
+            linked.append(merge)
+    assert linked, "no server merge span causally rooted in a trainer.step"
+    print(f"[chaos] causal ancestry ok: {len(linked)} merge span(s) chain "
+          "back to a worker trainer.step in the same trace")
+
+    report = trace_merge.straggler_report(records, trace_dir)
+    assert "r0" in report["stragglers"], (
+        f"faulted rank r0 not named by the straggler report: "
+        f"{report['stragglers']}")
+    trace_merge.print_report(report)
+
+    offsets, _anchor = trace_merge.estimate_offsets(records)
+    timeline = trace_merge.to_chrome_trace(records, offsets)
+    problems = trace_merge.check_timeline(timeline, records)
+    assert not problems, problems
+    out = os.path.join(workdir, "timeline.json")
+    with open(out, "w") as f:
+        json.dump(timeline, f)
+    json.load(open(out))  # the artifact CI archives must parse
+    print(f"[chaos] PASS (observability): {len(records)} spans from "
+          f"{len(files)} trace file(s); timeline at {out}")
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--epochs", type=int, default=4)
@@ -105,12 +242,20 @@ def main():
                     help="epoch whose checkpoint is torn; the chaos run "
                          "'crashes' right after it")
     ap.add_argument("--workdir", default=None)
+    ap.add_argument("--observability", action="store_true",
+                    help="run the distributed-tracing proof instead of "
+                         "the recovery proof")
     args = ap.parse_args()
 
     import tempfile
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="mxtpu-chaos-")
     os.makedirs(workdir, exist_ok=True)
+
+    if args.observability:
+        run_observability(workdir)
+        return
+
     init_w = np.zeros(DIM, dtype=np.float32)
 
     # --- 1. fault-free reference -----------------------------------------

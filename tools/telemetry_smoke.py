@@ -4,6 +4,13 @@ assert the JSON/Prometheus dumps parse, and assert the disabled path adds
 <5% wall time over the enabled run (i.e. the no-op stubs really
 short-circuit — disabled must never be the slower configuration).
 
+Also gates the always-on flight recorder: with telemetry AND tracing
+off, a training loop must log zero span events into the ring (span() is
+a true no-op), the default ring must cost <5% wall time over running
+with the ring disabled (MXTPU_FLIGHT_RECORDER_EVENTS=0), and a burst of
+log_event() calls must wrap the ring correctly (capacity kept, newest
+events survive).
+
 Usage: python tools/telemetry_smoke.py [steps]
 """
 import json
@@ -94,6 +101,46 @@ def main():
         f"disabled path is >{(TOLERANCE - 1) * 100:.0f}% slower than "
         f"enabled ({t_off:.4f}s vs {t_on:.4f}s) — no-op stubs are not "
         f"short-circuiting")
+
+    # -- flight recorder (always-on ring) -------------------------------
+    from incubator_mxnet_tpu import config as _config
+    from incubator_mxnet_tpu.telemetry import recorder as _recorder
+
+    # tracing off + telemetry off => span() is NOOP_SPAN: the training
+    # loop must not log a single span event into the ring
+    before = sum(1 for e in _recorder.snapshot() if e["kind"] == "span_end")
+    run_loop(*args)
+    after = sum(1 for e in _recorder.snapshot() if e["kind"] == "span_end")
+    assert after == before, (
+        f"{after - before} span_end event(s) reached the flight recorder "
+        "while telemetry and tracing were both off — the disabled span "
+        "path is not a no-op")
+
+    # the default ring must not cost measurable wall time: re-time the
+    # disabled loop with the recorder itself turned off and compare
+    os.environ["MXTPU_FLIGHT_RECORDER_EVENTS"] = "0"
+    _recorder.refresh_from_env()
+    t_noring = timed(steps, *args)
+    del os.environ["MXTPU_FLIGHT_RECORDER_EVENTS"]
+    _recorder.refresh_from_env()
+    print(f"flight recorder: ring-on={t_off * 1e3:.2f}ms "
+          f"ring-off={t_noring * 1e3:.2f}ms (best of {steps})")
+    assert t_off <= t_noring * TOLERANCE, (
+        f"always-on flight recorder adds >{(TOLERANCE - 1) * 100:.0f}% "
+        f"wall time ({t_off:.4f}s with ring vs {t_noring:.4f}s without)")
+
+    # wrap semantics: a burst larger than the ring keeps exactly
+    # `capacity` events and the newest ones survive
+    cap = _config.get("MXTPU_FLIGHT_RECORDER_EVENTS")
+    for i in range(cap + 16):
+        telemetry.log_event("smoke_burst", i=i)
+    snap = _recorder.snapshot()
+    assert len(snap) == cap, (
+        f"ring holds {len(snap)} events after a {cap + 16}-event burst "
+        f"(capacity {cap})")
+    assert snap[-1]["kind"] == "smoke_burst" and snap[-1]["i"] == cap + 15, (
+        "newest burst event missing from the ring snapshot")
+
     print("telemetry smoke OK")
 
 

@@ -1,0 +1,273 @@
+#!/usr/bin/env python
+"""Merge per-process .mxtrace files into one Chrome-trace/Perfetto
+timeline, with clock-skew correction and a straggler report.
+
+Every process in a traced run (MXTPU_TRACE_DIR) appends its completed
+spans to its own binary-framed trace file; this tool fuses them:
+
+    python tools/trace_merge.py /tmp/traces -o timeline.json
+    python tools/trace_merge.py /tmp/traces --stragglers
+    python tools/trace_merge.py /tmp/traces -o timeline.json \
+        --stragglers --check          # CI: nonzero exit on a bad timeline
+
+Open `timeline.json` in Perfetto (ui.perfetto.dev) or chrome://tracing:
+one row group ("process") per lane — r0, r1, ..., server — with the
+spans' trace ids in the args, so a worker's `trainer.step` and the server
+`merge` it caused line up on one screen.
+
+Clock-skew correction: hosts' wall clocks disagree by far more than an
+RPC takes, which would render causally-ordered spans out of order. Every
+client RPC span carries the send/recv wall clocks of its successful
+attempt, and the matching server span (parent id == the client span's id)
+carries the server-side start/end — an NTP-style offset estimate
+theta = ((server_start - send) + (server_end - recv)) / 2 per pair. The
+per-lane median of these pairs anchors every lane's clock to rank 0's.
+
+Straggler report (--stragglers): ranks ordered by client-observed
+barrier wait, flagged when >2 sigma above the mean (3+ ranks; with two
+ranks sigma-flagging is degenerate, so evidence flags carry the verdict),
+plus evidence flags for RPC retries and error-tagged spans — the faulted
+rank in a chaos run shows up with `rpc-retries`/`span-errors` even when
+its barrier numbers look ordinary.
+"""
+import argparse
+import json
+import os
+import statistics
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+from incubator_mxnet_tpu.telemetry import distributed as _distributed
+
+
+def load_dir(directory):
+    """All span records from every .mxtrace file under `directory`."""
+    records = []
+    files = sorted(f for f in os.listdir(directory)
+                   if f.endswith(".mxtrace"))
+    for name in files:
+        try:
+            records.extend(
+                _distributed.read_trace_file(os.path.join(directory, name)))
+        except ValueError as e:
+            print(f"trace_merge: skipping {name}: {e}", file=sys.stderr)
+    return records, files
+
+
+def _anchor_lane(lanes):
+    """Rank 0's lane when present, else the first worker-ish lane."""
+    for cand in ("r0", "w0"):
+        if cand in lanes:
+            return cand
+    workers = sorted(l for l in lanes if l != "server")
+    return workers[0] if workers else sorted(lanes)[0]
+
+
+def estimate_offsets(records):
+    """Per-lane clock offsets (ns to ADD to a lane's timestamps to land
+    on the anchor lane's clock), from client-RPC/server-span pairs."""
+    lanes = {r["lane"] for r in records}
+    by_sid = {r["sid"]: r for r in records}
+    # edge (client_lane, server_lane) -> [theta_ns ...] where
+    # theta = clock_server - clock_client
+    edges = {}
+    for srv in records:
+        parent = by_sid.get(srv.get("pid"))
+        if parent is None or parent["lane"] == srv["lane"]:
+            continue
+        extra = parent.get("extra") or {}
+        send, recv = extra.get("send_ns"), extra.get("recv_ns")
+        if send is None or recv is None:
+            continue
+        s_start = srv["ts"]
+        s_end = srv["ts"] + srv["dur_ns"]
+        theta = ((s_start - send) + (s_end - recv)) / 2.0
+        edges.setdefault((parent["lane"], srv["lane"]), []).append(theta)
+
+    meds = {pair: statistics.median(v) for pair, v in edges.items()}
+    anchor = _anchor_lane(lanes)
+    offsets = {anchor: 0.0}
+    # BFS over the pair graph: theta(c,s) = clock_s - clock_c, and
+    # offset_l is defined by t_anchor = t_l + offset_l, so
+    # offset_c - offset_s = theta(c,s)
+    frontier = [anchor]
+    while frontier:
+        lane = frontier.pop()
+        for (c, s), theta in meds.items():
+            if c == lane and s not in offsets:
+                offsets[s] = offsets[c] - theta
+                frontier.append(s)
+            elif s == lane and c not in offsets:
+                offsets[c] = offsets[s] + theta
+                frontier.append(c)
+    for lane in lanes:
+        offsets.setdefault(lane, 0.0)  # no pairs: leave the clock alone
+    return offsets, anchor
+
+
+def to_chrome_trace(records, offsets):
+    """Chrome-trace JSON object: one pid per lane, skew-corrected ts."""
+    lanes = sorted({r["lane"] for r in records})
+    pid_of = {lane: i + 1 for i, lane in enumerate(lanes)}
+    events = []
+    for lane in lanes:
+        events.append({"ph": "M", "name": "process_name",
+                       "pid": pid_of[lane], "tid": 0,
+                       "args": {"name": lane}})
+    spans = []
+    for r in records:
+        ts_us = (r["ts"] + offsets[r["lane"]]) / 1000.0
+        args = {"trace_id": r["tid"], "span_id": r["sid"]}
+        if r.get("pid"):
+            args["parent_id"] = r["pid"]
+        args.update(r.get("tags") or {})
+        args.update(r.get("extra") or {})
+        spans.append({
+            "ph": "X",
+            "name": r["name"],
+            "pid": pid_of[r["lane"]],
+            "tid": r.get("thr", 0),
+            "ts": ts_us,
+            "dur": r["dur_ns"] / 1000.0,
+            "args": args,
+        })
+    spans.sort(key=lambda e: e["ts"])
+    return {"traceEvents": events + spans, "displayTimeUnit": "ms"}
+
+
+def straggler_report(records, directory):
+    """Per-lane barrier-wait ranking + retry/error evidence."""
+    lanes = {}
+
+    def lane(name):
+        return lanes.setdefault(name, {
+            "lane": name, "barrier_wait_s": 0.0, "rpc_s": 0.0,
+            "rpcs": 0, "retries": 0, "errors": 0, "flags": []})
+
+    for r in records:
+        row = lane(r["lane"])
+        tags = r.get("tags") or {}
+        extra = r.get("extra") or {}
+        if "error" in tags:
+            row["errors"] += 1
+        if r["name"] == "ps.client.rpc":
+            row["rpcs"] += 1
+            row["rpc_s"] += r["dur_ns"] / 1e9
+            row["retries"] += int(extra.get("retries", 0))
+            if tags.get("command") == "barrier":
+                row["barrier_wait_s"] += r["dur_ns"] / 1e9
+
+    workers = sorted((row for name, row in lanes.items() if name != "server"),
+                     key=lambda row: -row["barrier_wait_s"])
+    waits = [row["barrier_wait_s"] for row in workers]
+    if len(waits) >= 3:
+        mean = statistics.mean(waits)
+        sigma = statistics.pstdev(waits)
+        for row in workers:
+            if sigma > 0 and row["barrier_wait_s"] > mean + 2 * sigma:
+                row["flags"].append("barrier-wait-outlier")
+    for row in workers:
+        if row["retries"]:
+            row["flags"].append("rpc-retries")
+        if row["errors"]:
+            row["flags"].append("span-errors")
+    dumps = sorted(f for f in os.listdir(directory)
+                   if f.startswith("flightrec-") and f.endswith(".json"))
+    return {
+        "lanes": workers + sorted(
+            (row for name, row in lanes.items() if name == "server"),
+            key=lambda row: row["lane"]),
+        "stragglers": [row["lane"] for row in workers if row["flags"]],
+        "dumps": dumps,
+    }
+
+
+def print_report(report):
+    print(f"{'lane':<10}{'barrier_wait_s':>15}{'rpc_s':>10}{'rpcs':>7}"
+          f"{'retries':>9}{'errors':>8}  flags")
+    for row in report["lanes"]:
+        print(f"{row['lane']:<10}{row['barrier_wait_s']:>15.4f}"
+              f"{row['rpc_s']:>10.4f}{row['rpcs']:>7}{row['retries']:>9}"
+              f"{row['errors']:>8}  {','.join(row['flags']) or '-'}")
+    if report["stragglers"]:
+        print(f"stragglers: {', '.join(report['stragglers'])}")
+    else:
+        print("stragglers: none flagged")
+    print(f"flight-recorder dumps: {len(report['dumps'])}")
+    for name in report["dumps"]:
+        print(f"  {name}")
+
+
+def check_timeline(timeline, records):
+    """Structural CI checks; returns a list of problem strings."""
+    problems = []
+    spans = [e for e in timeline["traceEvents"] if e["ph"] == "X"]
+    if not spans:
+        problems.append("timeline contains no spans")
+        return problems
+    last = None
+    for e in spans:  # the merger emits spans sorted by corrected ts
+        if last is not None and e["ts"] < last:
+            problems.append("span timestamps are not monotonic")
+            break
+        last = e["ts"]
+    by_sid = {r["sid"]: r for r in records}
+    cross = sum(1 for r in records
+                if r.get("pid") in by_sid
+                and by_sid[r["pid"]]["lane"] != r["lane"])
+    if len({r["lane"] for r in records}) > 1 and cross == 0:
+        problems.append("multiple lanes but no cross-lane parent link")
+    return problems
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="merge .mxtrace files into a Chrome-trace timeline")
+    ap.add_argument("trace_dir", help="directory holding *.mxtrace files")
+    ap.add_argument("-o", "--output", help="write Chrome-trace JSON here")
+    ap.add_argument("--stragglers", action="store_true",
+                    help="print the per-rank barrier-wait/straggler report")
+    ap.add_argument("--report-json",
+                    help="also write the straggler report as JSON")
+    ap.add_argument("--check", action="store_true",
+                    help="exit nonzero unless the merged timeline passes "
+                         "structural checks (CI gate)")
+    args = ap.parse_args(argv)
+
+    records, files = load_dir(args.trace_dir)
+    if not files:
+        print(f"trace_merge: no .mxtrace files in {args.trace_dir}",
+              file=sys.stderr)
+        return 1
+    offsets, anchor = estimate_offsets(records)
+    timeline = to_chrome_trace(records, offsets)
+    print(f"merged {len(records)} spans from {len(files)} trace file(s); "
+          f"lanes: {', '.join(sorted({r['lane'] for r in records}))} "
+          f"(clock anchor: {anchor})")
+    for lane, off in sorted(offsets.items()):
+        if off:
+            print(f"  clock offset {lane}: {off / 1e6:+.3f} ms")
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            json.dump(timeline, f)
+        print(f"wrote {args.output}")
+    report = straggler_report(records, args.trace_dir)
+    if args.stragglers:
+        print_report(report)
+    if args.report_json:
+        with open(args.report_json, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2)
+    if args.check:
+        problems = check_timeline(timeline, records)
+        if problems:
+            for p in problems:
+                print(f"trace_merge: CHECK FAILED: {p}", file=sys.stderr)
+            return 2
+        print("trace_merge: checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
