@@ -103,6 +103,29 @@ json.load(open(os.path.join(d, "timeline.json")))
 print(f"chaos observability artifacts ok: {len(dumps)} dump(s) "
       "+ parseable merged timeline")
 PY
+    echo "=== chaos tier: elastic membership (kill + rejoin mid-epoch) ==="
+    # rank 1 killed mid-epoch, evicted by heartbeat staleness, replaced
+    # by a fresh join that bootstraps state over the wire; asserts the
+    # stale-epoch rejection, bit-identical final weights, >=1 readmission
+    # in the metrics snapshot, and join/readmit in trace + flight recorder
+    # (all inside chaos_train); then re-merge the traces as CI would
+    local el_dir
+    el_dir="$(mktemp -d -t mxtpu-chaos-elastic-XXXXXX)"
+    JAX_PLATFORMS=cpu python tools/chaos_train.py --elastic \
+        --workdir "$el_dir"
+    JAX_PLATFORMS=cpu python tools/trace_merge.py "$el_dir/traces" \
+        -o "$el_dir/timeline.json" --check
+    python - "$el_dir" <<'PY'
+import json, os, sys
+d = sys.argv[1]
+snap = json.load(open(os.path.join(d, "metrics.json")))
+series = snap["metrics"]["mxtpu_ps_readmissions_total"]["series"]
+total = sum(s["value"] for s in series)
+assert total >= 1, f"metrics snapshot records {total} readmissions"
+json.load(open(os.path.join(d, "timeline.json")))
+print(f"chaos elastic artifacts ok: {int(total)} readmission(s) in the "
+      "metrics snapshot + parseable merged timeline")
+PY
 }
 
 run_nightly() {

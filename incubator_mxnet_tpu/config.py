@@ -209,6 +209,18 @@ register_knob("MXTPU_PS_DEDUP_WINDOW", 128, int,
               "replay suppression across reconnects; must exceed the "
               "deepest pipelining a client does (the eager client "
               "pipelines 1).")
+register_knob("MXTPU_MAX_WORKERS", 0, int,
+              "Elastic world cap for the parameter server: join RPCs may "
+              "admit brand-new ranks until num_workers reaches this value "
+              "(growth commits at the next barrier boundary). 0 keeps the "
+              "world fixed at the configured size; re-admission of "
+              "already-known ranks is always allowed.")
+register_knob("MXTPU_PS_BUCKET_KB", 1024, int,
+              "Byte cap (KiB) of one hierarchical-allreduce bucket on "
+              "dist_async_server: list-key pushpulls batch into a single "
+              "push_many/pull_many RPC pair per bucket after the "
+              "intra-host GSPMD reduction. 0 disables batching (one RPC "
+              "pair per key).")
 
 # profiler
 register_knob("MXNET_PROFILER_AUTOSTART", False, bool,

@@ -29,7 +29,8 @@ from ..ndarray.ndarray import NDArray
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["save", "restore", "latest_step", "verify", "CheckpointManager"]
+__all__ = ["save", "restore", "latest_step", "verify", "CheckpointManager",
+           "manifest_entry", "verify_wire_entry"]
 
 # An orbax checkpoint is a DIRECTORY; its sidecar manifest lists every
 # file with its sha256 so `restore` detects torn/corrupted shards before
@@ -53,6 +54,38 @@ def _dir_manifest_entries(path):
     return entries
 
 
+def manifest_entry(data):
+    """Manifest entry for an in-memory payload — the same
+    {"sha256", "size"} shape _dir_manifest_entries records per file,
+    reused as the parameter server's elastic-join wire/transfer format
+    (ps.ParameterServer state_manifest / PSClient.bootstrap)."""
+    return {"sha256": hashlib.sha256(data).hexdigest(), "size": len(data)}
+
+
+def verify_wire_entry(entry, data):
+    """True iff `data` matches a manifest_entry (extra keys ignored)."""
+    return (len(data) == entry.get("size")
+            and hashlib.sha256(data).hexdigest() == entry.get("sha256"))
+
+
+def _require_single_process(op):
+    """The sha256 dir-manifest is single-process-only: on a multi-host
+    save each host writes just its own shards, so no host can hash the
+    full tree, and a partial manifest would surface much later as a
+    baffling hash mismatch at restore. Fail the operation NOW with the
+    limitation spelled out instead."""
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            f"sharded_checkpoint.{op} on a multi-host job "
+            f"(jax.process_count()={jax.process_count()}): the sha256 "
+            "dir-manifest is single-process-only — each host writes only "
+            "its own shards, so no host can hash the complete checkpoint "
+            "tree, and a partial manifest would later fail restore with "
+            "a misleading hash mismatch. Until a per-shard manifest "
+            "exists, save/verify multi-host checkpoints through orbax "
+            "directly, or gather to one host first.")
+
+
 def _write_dir_manifest(path):
     manifest = path + _MANIFEST_SUFFIX
     tmp = manifest + f".tmp.{os.getpid()}"
@@ -67,8 +100,10 @@ def _write_dir_manifest(path):
 
 def verify(path):
     """True iff the checkpoint directory matches its sidecar manifest.
-    A checkpoint without a manifest (multi-host save, pre-resilience
-    save) verifies as legacy-valid."""
+    A checkpoint without a manifest (pre-resilience save) verifies as
+    legacy-valid. Single-process only — a multi-host job fails loudly
+    (see _require_single_process)."""
+    _require_single_process("verify")
     path = os.path.abspath(path)
     if not os.path.isdir(path):
         return False
@@ -123,14 +158,16 @@ def save(path, tree, force=False):
     """Write a (sharded) pytree checkpoint; every host writes its shards.
     Refuses to overwrite an existing checkpoint unless force=True (orbax's
     safe default — a failed re-save must not destroy the previous good
-    checkpoint silently)."""
+    checkpoint silently). Single-process only while the sha256 manifest
+    is — a multi-host job fails loudly up front rather than leaving a
+    checkpoint that flunks verification later."""
+    _require_single_process("save")
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
     with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
         ckptr.save(path, _to_jax_tree(tree), force=force)
-    if jax.process_count() == 1:
-        _write_dir_manifest(path)
+    _write_dir_manifest(path)
     return path
 
 

@@ -291,10 +291,25 @@ class Trainer:
             if rescale != self._kv_shipped_rescale:
                 self._ship_optimizer_attrs(rescale_grad=rescale)
                 self._kv_shipped_rescale = rescale
-            # push grads, pull server-updated weights — no local update
-            for i, p in enumerate(self._params):
-                self._kvstore.push(i, p.grad())
-                self._kvstore.pull(i, out=p.data())
+            # push grads, pull server-updated weights — no local update.
+            # Hierarchical path: ONE inter-host push_many/pull_many RPC
+            # pair per byte-capped bucket after the store's intra-host
+            # GSPMD reduction, vs one push+pull per parameter on the
+            # flat fallback.
+            kv = self._kvstore
+            if getattr(kv, "supports_hierarchical_pushpull", False):
+                kv.pushpull(list(range(len(self._params))),
+                            [p.grad() for p in self._params],
+                            out=[p.data() for p in self._params])
+                _telemetry.inc(_DISPATCHES, 1, kind="server_pushpull",
+                               path="hierarchical", help=_DISPATCH_HELP)
+            else:
+                for i, p in enumerate(self._params):
+                    kv.push(i, p.grad())
+                    kv.pull(i, out=p.data())
+                _telemetry.inc(_DISPATCHES, len(self._params),
+                               kind="server_pushpull", path="per_key",
+                               help=_DISPATCH_HELP)
             return
         if self._kvstore is not None:
             self.allreduce_grads()

@@ -476,9 +476,14 @@ class KVStoreDistAsyncServer(KVStoreDist):
     # server-side weight to update (and this store never takes the
     # allreduce_grads path anyway — update_on_kvstore is forced on)
     supports_bucketed_allreduce = False
+    # list-key pushpull runs hierarchically instead: intra-host GSPMD
+    # reduction first, then ONE push_many/pull_many RPC pair per
+    # byte-capped bucket — the Trainer keys off this flag
+    supports_hierarchical_pushpull = True
 
     def __init__(self, kv_type="dist_async_server"):
         super().__init__(kv_type)
+        from . import config as _config
         from . import ps as _ps
 
         host, port = _ps.default_server_addr()
@@ -490,11 +495,56 @@ class KVStoreDistAsyncServer(KVStoreDist):
             host = self._server.host
         self._client = _ps.PSClient(host, port)
         self._shapes = {}
+        # versioned membership: every worker (re)joins its rank up front —
+        # a replacement process re-admits into the quorum, learns the
+        # epoch + key directory, and its sync pushes are epoch-fenced
+        self._member = self._client.join(self.rank)
+        self._hb_stop = threading.Event()
+        self._hb_client = None
+        if self.num_workers > 1:
+            # data-plane liveness on a DEDICATED client: the main client
+            # serializes request/response under one lock, so a beat
+            # riding it would stall behind a blocked sync rendezvous —
+            # exactly when the server most needs to see this worker alive
+            self._hb_client = _ps.PSClient(host, port,
+                                           instance=f"hb{self.rank}")
+            interval = _config.get("MXTPU_HEARTBEAT_INTERVAL")
+            self._hb_client.heartbeat(self.rank)
+
+            def _beat_loop():
+                while not self._hb_stop.wait(interval):
+                    try:
+                        self._hb_client.heartbeat(self.rank)
+                    except (ConnectionError, OSError, RuntimeError):
+                        # the redial already ran under the client's
+                        # per-instance-seeded (jittered) RetryPolicy, so
+                        # a fleet-wide blip rejoins staggered; a server
+                        # that stays gone surfaces via num_dead instead
+                        pass
+
+            threading.Thread(target=_beat_loop, daemon=True,
+                             name=f"mxtpu-ps-beat-r{self.rank}").start()
 
     def barrier(self):
         # the server's counting barrier: matches PS semantics and works
         # even before jax.distributed collectives are usable
-        self._client.barrier()
+        from . import ps as _ps
+
+        try:
+            self._client.barrier()
+        except _ps.StaleEpochError:
+            # membership changed under us (a peer rejoined or was
+            # replaced): adopt the new epoch and rendezvous again
+            self.refresh_membership()
+            self._client.barrier()
+
+    def refresh_membership(self):
+        """Re-read {epoch, num_workers, quorum} after a membership change
+        (the recovery step a StaleEpochError asks for)."""
+        info = self._client.membership()
+        logger.info("dist_async_server r%d: membership epoch %s, world %s",
+                    self.rank, info["epoch"], info["num_workers"])
+        return info
 
     def init(self, key, value):
         if isinstance(key, (list, tuple)):
@@ -581,12 +631,73 @@ class KVStoreDistAsyncServer(KVStoreDist):
     def pushpull(self, key, value, out=None, priority=0):
         if isinstance(key, (list, tuple)):
             outs = out if isinstance(out, (list, tuple)) else [out] * len(key)
+            if self._hierarchical_ok(value):
+                self._pushpull_hierarchical(list(key), list(value),
+                                            list(outs))
+                return
             for k, v, o in zip(key, value, outs):
                 self.pushpull(k, v, o, priority)
             return
         self.push(key, value, priority)
         if out is not None:
             self.pull(key, out, priority)
+
+    def _hierarchical_ok(self, values):
+        """Dense, uncompressed list pushes batch hierarchically; sparse
+        and 2-bit-compressed gradients keep their dedicated wire formats
+        on the per-key path."""
+        from . import config as _config
+        from .ndarray.sparse import BaseSparseNDArray
+
+        if self._compression is not None:
+            return False
+        if _config.get("MXTPU_PS_BUCKET_KB") <= 0:
+            return False
+        for v in values:
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            if any(isinstance(x, BaseSparseNDArray) for x in vs):
+                return False
+        return True
+
+    def _pushpull_hierarchical(self, keys, values, outs):
+        """Hierarchical allreduce: stage 1 reduces each gradient
+        intra-host over the GSPMD mesh (`_reduce` — per-device shards
+        never cross the wire individually); stage 2 ships ONE
+        push_many/pull_many RPC pair per byte-capped bucket to the
+        server instead of one pair per key (~num_keys x fewer RPCs, and
+        a single choke point per bucket for membership changes).
+        Server-side application is per-key through the same optimizer
+        path, so weights stay bit-identical to the flat path."""
+        import numpy as _np
+
+        from . import config as _config
+
+        cap = _config.get("MXTPU_PS_BUCKET_KB") * 1024
+        grads = [_np.asarray(_to_data(self._reduce(v))) for v in values]
+        buckets = []
+        cur, cur_bytes = [], 0
+        for i, g in enumerate(grads):
+            if cur and cur_bytes + g.nbytes > cap:
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            cur_bytes += g.nbytes
+        if cur:
+            buckets.append(cur)
+        for bucket in buckets:
+            bkeys = [keys[i] for i in bucket]
+            self._client.push_many(bkeys, [grads[i] for i in bucket],
+                                   sync=False)
+            vals = self._client.pull_many(bkeys)
+            for i, val in zip(bucket, vals):
+                o = outs[i]
+                jval = jnp.asarray(val)
+                for oo in (o if isinstance(o, (list, tuple)) else [o]):
+                    if oo is not None:
+                        oo._data = jval
+        _telemetry.inc(_KV_BYTES, int(sum(g.nbytes for g in grads)),
+                       help="Payload bytes through kvstore push/pull.",
+                       op="pushpull_hierarchical", store=self.type)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Only the requested rows cross the wire
@@ -626,18 +737,21 @@ class KVStoreDistAsyncServer(KVStoreDist):
         self._client.barrier()
 
     def close(self):
+        self._hb_stop.set()
         try:
             # best-effort farewell rendezvous: with a peer dead the
             # quorum shrinks (or the barrier errors), and shutdown must
             # proceed either way — a dead worker cannot hold the job's
             # teardown hostage
-            self._client.barrier()
+            self.barrier()
         except (ConnectionError, OSError, RuntimeError) as e:
             logger.warning("dist_async_server close: farewell barrier "
                            "failed (%s: %s); shutting down anyway",
                            type(e).__name__, e)
         if self._server is not None:
             self._server.shutdown()
+        if self._hb_client is not None:
+            self._hb_client.close()
         self._client.close()
         # collective rendezvous AFTER the listener is closed: a successor
         # store on the same port must never find the old server accepting
@@ -738,7 +852,11 @@ class _TcpHeartbeat:
                                                port=port)
             port = self._server.port
             host = self._server.host
-        self._client = _ps.PSClient(host, port)
+        # per-rank instance tag: the client's redial RetryPolicy seeds
+        # its backoff jitter from it, so after a fleet-wide network blip
+        # every rank's heartbeat sender reconnects on a DIFFERENT
+        # schedule instead of thundering-herding the coordinator
+        self._client = _ps.PSClient(host, port, instance=f"hb{rank}")
         self._client.heartbeat(rank)
         self._stop = threading.Event()
         self._interval = interval
@@ -761,7 +879,11 @@ class _TcpHeartbeat:
             try:
                 self._client.heartbeat(self.rank)
             except (ConnectionError, OSError, RuntimeError):
-                pass  # server gone; num_dead will surface it
+                # the redial already ran (and backed off, jittered per
+                # rank) inside the client's RetryPolicy; a server that
+                # stays gone surfaces via num_dead, and a beat that does
+                # land after an eviction re-admits this rank
+                pass
 
     def num_dead(self):
         # never-seen peers count as dead only once THIS observer's own

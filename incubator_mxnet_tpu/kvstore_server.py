@@ -63,6 +63,22 @@ class KVStoreServer:
             num_workers=num_workers,
             host=host if host is not None else addr_host,
             port=port if port is not None else addr_port)
+        # elastic membership state, visible from process start: the
+        # epoch gauge must exist (at 0) before the first join bumps it,
+        # so dashboards can tell "no membership change yet" from "no
+        # server"
+        cap = _config.get("MXTPU_MAX_WORKERS")
+        from . import telemetry as _telemetry
+
+        _telemetry.set_gauge(
+            "mxtpu_ps_membership_epoch", 0,
+            help="Current membership epoch of the ParameterServer; bumps "
+                 "on every membership change (readmission, rank "
+                 "takeover, world growth).")
+        logging.getLogger(__name__).info(
+            "parameter server on %s:%d — world %d, elastic cap %s",
+            self._server.host, self._server.port, num_workers,
+            cap if cap > 0 else "off (fixed world)")
 
     def run(self):
         """Serve until every worker has disconnected (the reference's

@@ -21,7 +21,8 @@ from . import ndarray as nd
 from . import symbol as sym
 
 __all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
-           "latest_valid_checkpoint", "load_params", "wait_checkpoints"]
+           "latest_valid_checkpoint", "load_params", "wait_checkpoints",
+           "bootstrap_params"]
 
 BatchEndParam = collections.namedtuple(
     "BatchEndParams", ["epoch", "nbatch", "eval_metric", "locals"]
@@ -122,6 +123,22 @@ def load_params(prefix, epoch):
         elif tp == "aux":
             aux_params[name] = v
     return arg_params, aux_params
+
+
+def bootstrap_params(client, keys=None):
+    """Elastic-join state transfer (docs/FAULT_TOLERANCE.md — Elastic
+    membership): fetch the parameter server's key directory over the
+    wire, each tensor verified against the server's
+    sharded_checkpoint-format state manifest, and return {key: NDArray}
+    ready to load into a freshly-admitted worker's Block/Module.
+    Optimizer state lives ON the server in this mode, so parameters are
+    the whole transfer; `client` is a ps.PSClient that already join()ed."""
+    from . import telemetry as _telemetry
+
+    raw = client.bootstrap(keys)
+    _telemetry.log_event("model_bootstrap", keys=len(raw),
+                         epoch=client.epoch)
+    return {k: nd.array(v) for k, v in raw.items()}
 
 
 def load_checkpoint(prefix, epoch):

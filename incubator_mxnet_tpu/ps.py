@@ -31,12 +31,14 @@ import socket
 import struct
 import threading
 import time
+import zlib
 
 import numpy as np
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["ParameterServer", "PSClient", "default_server_addr"]
+__all__ = ["ParameterServer", "PSClient", "default_server_addr",
+           "StaleEpochError", "JoinRejectedError"]
 
 _RECONNECT_METRIC = "mxtpu_ps_reconnects_total"
 _RECONNECT_HELP = ("PSClient transparent reconnects after a mid-frame "
@@ -47,10 +49,44 @@ _DEDUP_HELP = ("Retried mutating RPCs the ParameterServer suppressed via "
 _EVICT_METRIC = "mxtpu_ps_evictions_total"
 _EVICT_HELP = ("Workers evicted from the barrier/sync quorum after "
                "heartbeat staleness (dist graceful degradation).")
+_JOIN_METRIC = "mxtpu_ps_joins_total"
+_JOIN_HELP = ("Join RPCs the ParameterServer accepted, by outcome "
+              "(registered / readmitted / pending).")
+_READMIT_METRIC = "mxtpu_ps_readmissions_total"
+_READMIT_HELP = ("Evicted ranks re-admitted to the quorum, via a fresh "
+                 "heartbeat or a join RPC (elastic membership).")
+_STALE_METRIC = "mxtpu_ps_stale_epoch_rejections_total"
+_STALE_HELP = ("Sync contributions rejected for carrying a stale "
+               "membership epoch, by command.")
+_EPOCH_METRIC = "mxtpu_ps_membership_epoch"
+_EPOCH_HELP = ("Current membership epoch of the ParameterServer; bumps on "
+               "every membership change (readmission, rank takeover, "
+               "world growth).")
 
 # wire/socket errors after which a frame exchange cannot be trusted; the
 # client closes and redials rather than reuse the poisoned socket
 _WIRE_ERRORS = (OSError, EOFError, struct.error)
+
+
+class StaleEpochError(RuntimeError):
+    """A sync push/barrier carried a membership epoch older than the
+    server's: the sender missed a membership change (join, readmission,
+    takeover) and must refresh via PSClient.membership() before it may
+    contribute again. Raised instead of silently merging the stale
+    contribution, which would skew the synchronous gradient math."""
+
+
+class JoinRejectedError(RuntimeError):
+    """The server cannot admit this rank right now (the elastic world is
+    at its MXTPU_MAX_WORKERS cap); the joiner backs off under its
+    RetryPolicy and retries."""
+
+
+# server-side errors cross the wire as "ClassName: message"; these names
+# re-raise as their class on the client so callers can catch the protocol
+# condition rather than parse a RuntimeError string
+_ERR_CLASSES = {"StaleEpochError": StaleEpochError,
+                "JoinRejectedError": JoinRejectedError}
 
 # commands that ride the control plane every couple of seconds (the
 # heartbeat thread) — never spanned/traced, they would drown the timeline
@@ -314,6 +350,15 @@ class ParameterServer:
         # barrier/sync quorum instead of hanging every survivor until the
         # rendezvous timeout; a fresh beat re-admits them
         self._evicted = set()
+        # elastic membership (docs/FAULT_TOLERANCE.md — Elastic
+        # membership): a monotonically-increasing epoch versions the rank
+        # set; sync contributions carry it and stale ones are fenced.
+        # Growth joins park in _pending_ranks until a barrier boundary so
+        # no in-flight merge generation changes its expected world.
+        self._epoch = 0
+        self._owners = {}          # rank -> owning client_id
+        self._pending_ranks = set()
+        self._max_workers = _config.get("MXTPU_MAX_WORKERS")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         try:
@@ -333,7 +378,9 @@ class ParameterServer:
                 "interface); listening on all interfaces instead")
             self._sock.bind(("0.0.0.0", port))
             self.host = "127.0.0.1"  # local clients reach it via loopback
-        self._sock.listen(num_workers + 2)
+        # backlog sized for the elastic cap, not just the starting world:
+        # a mass rejoin may dial more sockets than num_workers
+        self._sock.listen(max(num_workers, self._max_workers) + 2)
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
         self._threads = []
@@ -455,15 +502,20 @@ class ParameterServer:
         (tcp transport) — without beats the quorum is the full world."""
         now = time.time()
         newly = []
+        readmitted = []
         with self._beats_lock:
             for rank, last in self._beats.items():
                 if now - last > self._evict_timeout:
                     if rank not in self._evicted:
                         self._evicted.add(rank)
                         newly.append(rank)
-                else:
+                elif rank in self._evicted:
+                    # the quorum grows back: a fresh beat re-admits
                     self._evicted.discard(rank)
+                    readmitted.append(rank)
             quorum = max(1, self.num_workers - len(self._evicted))
+        for rank in readmitted:
+            self._note_readmission(rank, "heartbeat", quorum)
         if newly:
             from . import telemetry as _telemetry
             from .telemetry import recorder as _recorder
@@ -482,7 +534,158 @@ class ParameterServer:
             _recorder.dump("eviction")
         return quorum
 
+    # --- elastic membership ----------------------------------------------
+    def _note_readmission(self, rank, via, quorum=None):
+        from . import telemetry as _telemetry
+
+        if quorum is None:
+            quorum = max(1, self.num_workers - len(self._evicted))
+        logger.info("ps: rank %d re-admitted to the quorum via %s "
+                    "(now %d/%d)", rank, via, quorum, self.num_workers)
+        _telemetry.inc(_READMIT_METRIC, 1, help=_READMIT_HELP, via=via)
+        _telemetry.log_event("ps_readmission", rank=int(rank), via=via,
+                             quorum=quorum, world=self.num_workers,
+                             epoch=self._epoch)
+
+    def _publish_epoch(self, reason):
+        from . import telemetry as _telemetry
+
+        _telemetry.set_gauge(_EPOCH_METRIC, self._epoch, help=_EPOCH_HELP)
+        _telemetry.log_event("ps_membership_epoch", epoch=self._epoch,
+                             reason=reason, world=self.num_workers)
+
+    def _check_epoch(self, epoch, command):
+        """Fence a sync contribution against the membership epoch it was
+        computed under. `None` (a client that never joined) is always
+        accepted — the protocol is opt-in, so pre-elastic clients keep
+        working. The check runs at ENTRY, before the contribution touches
+        any merge buffer, so a rejection leaves the rendezvous untouched
+        and the gradient math bit-exact."""
+        if epoch is None or int(epoch) == self._epoch:
+            return
+        from . import telemetry as _telemetry
+
+        _telemetry.inc(_STALE_METRIC, 1, help=_STALE_HELP, command=command)
+        _telemetry.log_event("ps_stale_epoch", command=command,
+                             got=int(epoch), want=self._epoch)
+        raise StaleEpochError(
+            f"{command} carried membership epoch {int(epoch)} but the "
+            f"server is at {self._epoch}; the rank set changed — refresh "
+            "via membership() and re-contribute")
+
+    def _admit_pending(self):
+        """Commit parked growth joins at a barrier boundary: the new ranks
+        only count toward generations that START after this one, so no
+        in-flight merge ever waits on a contribution that was not part of
+        its world — which is what keeps elastic growth bit-exact."""
+        with self._beats_lock:
+            if not self._pending_ranks:
+                return
+            admitted = sorted(self._pending_ranks)
+            self._pending_ranks.clear()
+            self.num_workers = max(self.num_workers, admitted[-1] + 1)
+            self._epoch += 1
+        from . import telemetry as _telemetry
+
+        for rank in admitted:
+            _telemetry.log_event("ps_admission", rank=rank,
+                                 world=self.num_workers, epoch=self._epoch)
+            logger.info("ps: rank %d admitted at the epoch boundary "
+                        "(world now %d, membership epoch %d)", rank,
+                        self.num_workers, self._epoch)
+        self._publish_epoch("admit")
+
     # --- commands ---------------------------------------------------------
+    def _cmd_join(self, rank, client_id):
+        """Versioned membership join (ref: ps-lite dynamic node groups —
+        AddNode reassigned ids at the scheduler; here the server IS the
+        scheduler). Confirms or assigns a rank and returns the current
+        epoch + key directory. Three outcomes: an evicted rank re-admits
+        immediately (the quorum grows back NOW — survivors are already
+        rendezvousing without it); a brand-new rank parks in
+        _pending_ranks until the next barrier boundary; a live rank's
+        takeover by a new client_id fences the old incarnation. Every
+        membership change bumps the epoch so stale contributions are
+        rejected rather than merged."""
+        from . import telemetry as _telemetry
+
+        rank = int(rank)
+        with self._beats_lock:
+            world = self.num_workers
+            cap = self._max_workers if self._max_workers > 0 else world
+            if rank < 0:
+                # no preference: reuse the lowest dead rank, else grow
+                evicted = sorted(self._evicted)
+                rank = evicted[0] if evicted else world
+            readmitted = rank in self._evicted
+            takeover = (not readmitted
+                        and self._owners.get(rank, client_id) != client_id)
+            pending = rank in self._pending_ranks
+            if rank >= world and not pending:
+                if rank >= cap:
+                    raise JoinRejectedError(
+                        f"rank {rank} exceeds the elastic world cap "
+                        f"({world} configured, MXTPU_MAX_WORKERS={cap}); "
+                        "retry after an eviction or raise the cap")
+                self._pending_ranks.add(rank)
+                pending = True
+            self._owners[rank] = client_id
+            self._evicted.discard(rank)
+            if rank in self._beats:
+                # re-arm staleness from the join, not the pre-death beat
+                self._beats[rank] = time.time()
+            if readmitted or takeover:
+                self._epoch += 1
+            epoch = self._epoch
+        # a grown-back quorum may complete a parked rendezvous
+        with self._barrier_cv:
+            self._barrier_cv.notify_all()
+        with self._sync_cv:
+            self._sync_cv.notify_all()
+        outcome = ("readmitted" if readmitted
+                   else "pending" if pending else "registered")
+        _telemetry.inc(_JOIN_METRIC, 1, help=_JOIN_HELP, outcome=outcome)
+        _telemetry.log_event("ps_join", rank=rank, outcome=outcome,
+                             epoch=epoch, world=self.num_workers,
+                             client=str(client_id))
+        if readmitted:
+            self._note_readmission(rank, "join")
+        if readmitted or takeover:
+            self._publish_epoch("join")
+        logger.info("ps: rank %d joined (%s) at membership epoch %d",
+                    rank, outcome, epoch)
+        return ("val", {"epoch": epoch, "rank": rank, "pending": pending,
+                        "readmitted": readmitted,
+                        "num_workers": self.num_workers,
+                        "keys": sorted(self._store, key=str)})
+
+    def _cmd_membership(self):
+        """Read-only membership snapshot — the recovery RPC after a
+        StaleEpochError."""
+        return ("val", {"epoch": self._epoch,
+                        "num_workers": self.num_workers,
+                        "quorum": self._quorum(),
+                        "pending": sorted(self._pending_ranks)})
+
+    def _cmd_state_manifest(self):
+        """Key directory with per-tensor sha256 in the sharded_checkpoint
+        manifest shape — the joiner's state-transfer contract: it pulls
+        each key and verifies the bytes against this manifest, so a
+        server applying concurrent updates surfaces as a clean mismatch
+        (and a refetch) instead of silent skew."""
+        from .contrib import sharded_checkpoint as _sc
+
+        files = {}
+        for key in sorted(self._store, key=str):
+            with self._key_lock(key):
+                arr = self._store[key]
+                entry = _sc.manifest_entry(arr.tobytes())
+                entry["dtype"] = arr.dtype.name
+                entry["shape"] = list(int(d) for d in arr.shape)
+                files[str(key)] = entry
+        return ("val", {"version": 1, "epoch": self._epoch,
+                        "files": files})
+
     def _cmd_init(self, key, value):
         """First writer wins (rank 0 inits; ref: kvstore_dist.h Init)."""
         with self._key_lock(key):
@@ -543,7 +746,7 @@ class ParameterServer:
             self._store[key] = stored + grad
         self._versions[key] += 1
 
-    def _cmd_push(self, key, grad, sync):
+    def _cmd_push(self, key, grad, sync, epoch=None):
         from . import telemetry as _telemetry
 
         grad = np.asarray(grad)
@@ -553,6 +756,7 @@ class ParameterServer:
                 with self._key_lock(key):
                     self._apply(key, grad)
             return ("ok",)
+        self._check_epoch(epoch, "push")
         # sync: aggregate one contribution per live worker, apply once,
         # release everyone at the new version (ref: :346 merge buffer
         # path). Waits run in short slices so a heartbeat eviction
@@ -583,6 +787,68 @@ class ParameterServer:
                         f"{self._quorum()} contributions")
                 self._sync_cv.wait(timeout=1.0)
         return ("ok",)
+
+    def _cmd_push_many(self, keys, grads, sync, epoch=None):
+        """One RPC, many keys — the inter-host half of the hierarchical
+        allreduce (the worker already reduced intra-host over the GSPMD
+        mesh, so exactly one contribution per key per host arrives here).
+        Sync mode rendezvouses the whole bucket as ONE unit under a
+        synthetic bucket key: a single merge wait per bucket instead of
+        one per key, which is also the single choke point where
+        membership changes take effect between generations. Per-key
+        optimizer math is unchanged (each key still applies through
+        _apply under its own lock), so results stay bit-identical to the
+        flat per-key path."""
+        from . import telemetry as _telemetry
+
+        keys = tuple(keys)
+        grads = [np.asarray(g) for g in grads]
+        if len(keys) != len(grads):
+            raise ValueError(f"push_many got {len(keys)} keys but "
+                             f"{len(grads)} gradients")
+        if not sync:
+            with _telemetry.span("ps.server.merge", sync="0",
+                                 bucket=str(len(keys))):
+                for key, grad in zip(keys, grads):
+                    with self._key_lock(key):
+                        self._apply(key, grad)
+            return ("ok",)
+        self._check_epoch(epoch, "push_many")
+        bkey = ("__bucket__",) + keys
+        with _telemetry.span("ps.server.merge", sync="1",
+                             bucket=str(len(keys))), self._sync_cv:
+            buf, count = self._merge.get(bkey, (None, 0))
+            buf = (list(grads) if buf is None
+                   else [b + g for b, g in zip(buf, grads)])
+            count += 1
+            self._merge[bkey] = (buf, count)
+            target = self._versions.setdefault(bkey, 0) + 1
+            deadline = time.monotonic() + self._sync_timeout
+            while self._versions[bkey] < target:
+                pend, npend = self._merge.get(bkey, (None, 0))
+                if pend is not None and npend >= self._quorum():
+                    for key, grad in zip(keys, pend):
+                        with self._key_lock(key):
+                            self._apply(key, grad)
+                    self._merge[bkey] = (None, 0)
+                    self._versions[bkey] = target
+                    self._sync_cv.notify_all()
+                    break
+                if time.monotonic() > deadline:
+                    self._merge[bkey] = (None, 0)
+                    raise TimeoutError(
+                        f"sync push_many on {len(keys)} keys waited "
+                        f"{self._sync_timeout:.0f}s with {npend}/"
+                        f"{self._quorum()} contributions")
+                self._sync_cv.wait(timeout=1.0)
+        return ("ok",)
+
+    def _cmd_pull_many(self, keys):
+        out = []
+        for key in keys:
+            with self._key_lock(key):
+                out.append(np.array(self._store[key], copy=True))
+        return ("val", out)
 
     def _cmd_push_rows(self, key, indices, rows):
         """Sparse push: apply only the occupied rows, through the
@@ -627,14 +893,18 @@ class ParameterServer:
         with self._key_lock(key):
             return ("val", np.array(self._store[key][rows], copy=True))
 
-    def _cmd_barrier(self):
+    def _cmd_barrier(self, epoch=None):
         from . import telemetry as _telemetry
 
+        self._check_epoch(epoch, "barrier")
         # generation-counted rendezvous (ref: ps-lite Postoffice::Barrier).
         # Short wait slices re-evaluate the quorum so heartbeat evictions
         # release the survivors; whichever waiter first observes
         # count >= quorum opens the generation. A retransmitted barrier
         # never double-counts: it rides the dedup window in _handle_mut.
+        # Barriers are the epoch boundaries of elastic membership: parked
+        # growth joins commit when a generation opens, and every waiter
+        # returns the (possibly new) epoch so joined clients stay current.
         with _telemetry.span("ps.server.barrier"), self._barrier_cv:
             gen = self._barrier_gen
             self._barrier_count += 1
@@ -643,6 +913,7 @@ class ParameterServer:
                 if self._barrier_count >= self._quorum():
                     self._barrier_count = 0
                     self._barrier_gen += 1
+                    self._admit_pending()
                     self._barrier_cv.notify_all()
                     break
                 if time.monotonic() > deadline:
@@ -652,12 +923,16 @@ class ParameterServer:
                         f"only {self._barrier_count + 1}/{self._quorum()} "
                         "workers present")
                 self._barrier_cv.wait(timeout=1.0)
-        return ("ok",)
+        return ("ok", self._epoch)
 
     def _cmd_heartbeat(self, rank):
+        rank = int(rank)
         with self._beats_lock:
-            self._beats[int(rank)] = time.time()
-            self._evicted.discard(int(rank))  # a live beat re-admits
+            self._beats[rank] = time.time()
+            readmitted = rank in self._evicted
+            self._evicted.discard(rank)  # a live beat re-admits
+        if readmitted:
+            self._note_readmission(rank, "heartbeat")
         with self._barrier_cv:
             self._barrier_cv.notify_all()  # quorum may have changed
         with self._sync_cv:
@@ -750,11 +1025,22 @@ class PSClient:
         # the socket timeout outlives the server's rendezvous waits, which
         # raise a proper error instead of this socket timing out first
         self._socket_timeout = _config.get("MXTPU_PS_SOCKET_TIMEOUT")
+        # distinct backoff jitter per client: every worker redialing after
+        # the same network blip must NOT share one seed, or the whole
+        # fleet sleeps and retries in lockstep and the mass rejoin
+        # thundering-herds the server. The heartbeat sender's reconnect
+        # rides these policies too, so beats desynchronize the same way.
+        self._policy_seed = zlib.crc32(
+            f"{self._instance}:{self._client_id}".encode("utf-8"))
         # first connect keeps the caller-visible `retries` contract (the
         # server may simply not be up yet) on the knob-driven schedule
         self._connect_policy = RetryPolicy.from_knobs(
-            max_attempts=max(1, int(retries)))
-        self._rpc_policy = RetryPolicy.from_knobs()
+            max_attempts=max(1, int(retries)), seed=self._policy_seed)
+        self._rpc_policy = RetryPolicy.from_knobs(seed=self._policy_seed)
+        # membership epoch last observed (None until join/membership —
+        # epoch-less clients are always accepted, see _check_epoch)
+        self._epoch = None
+        self._rank = None
         with self._lock:
             self._reconnect_locked(first=True)
 
@@ -856,7 +1142,9 @@ class PSClient:
         resp = self._rpc_policy.call(
             lambda _a: self._rpc_attempt(frame), _WIRE_ERRORS, site=site)
         if resp[0] == "err":
-            raise RuntimeError(f"parameter server: {resp[1]}")
+            name = str(resp[1]).split(":", 1)[0]
+            cls = _ERR_CLASSES.get(name, RuntimeError)
+            raise cls(f"parameter server: {resp[1]}")
         return resp[1] if len(resp) > 1 else None
 
     def _rpc(self, *msg):
@@ -876,7 +1164,18 @@ class PSClient:
         return self._mut_rpc("init", key, np.asarray(value))
 
     def push(self, key, grad, sync=False):
-        return self._mut_rpc("push", key, np.asarray(grad), bool(sync))
+        return self._mut_rpc("push", key, np.asarray(grad), bool(sync),
+                             self._epoch)
+
+    def push_many(self, keys, grads, sync=False):
+        """One mutating RPC carrying a whole bucket of gradients — the
+        client half of the hierarchical allreduce."""
+        return self._mut_rpc("push_many", tuple(keys),
+                             tuple(np.asarray(g) for g in grads),
+                             bool(sync), self._epoch)
+
+    def pull_many(self, keys):
+        return list(self._rpc("pull_many", tuple(keys)))
 
     def push_compressed(self, key, payload, shape):
         return self._mut_rpc("push_compressed", key, np.asarray(payload),
@@ -912,10 +1211,109 @@ class PSClient:
                                  protocol=pickle.HIGHEST_PROTOCOL)))
 
     def barrier(self):
-        return self._mut_rpc("barrier")
+        epoch = self._mut_rpc("barrier", self._epoch)
+        if self._epoch is not None and epoch is not None:
+            # boundaries publish the (possibly bumped) membership epoch
+            self._epoch = int(epoch)
+        return epoch
 
     def heartbeat(self, rank):
         return self._rpc("heartbeat", int(rank))
+
+    # --- elastic membership ----------------------------------------------
+    @property
+    def epoch(self):
+        """Membership epoch last observed (None before join)."""
+        return self._epoch
+
+    @property
+    def rank(self):
+        """Rank the server assigned at join (None before join)."""
+        return self._rank
+
+    def join(self, rank=-1, wait=True, policy=None):
+        """Join (or rejoin) the membership: returns the server's verdict
+        {epoch, rank, pending, readmitted, num_workers, keys}. rank=-1
+        lets the server pick (lowest evicted rank, else world growth). A
+        world-full rejection backs off and retries under `policy` — the
+        rejoin backoff — and with wait=True a growth join also polls
+        until the next barrier boundary commits the admission."""
+        from .resilience import RetryPolicy
+
+        if policy is None:
+            policy = RetryPolicy.from_knobs(seed=self._policy_seed)
+        rank = -1 if rank is None else int(rank)
+        info = policy.call(
+            lambda _a: self._mut_rpc("join", rank, self._client_id),
+            JoinRejectedError, site="ps.join")
+        self._epoch = int(info["epoch"])
+        self._rank = int(info["rank"])
+        if wait and info["pending"]:
+            self.wait_admitted(policy=policy)
+        return info
+
+    def membership(self):
+        """Refresh {epoch, num_workers, quorum, pending} from the server
+        — the recovery step after a StaleEpochError."""
+        info = self._rpc("membership")
+        self._epoch = int(info["epoch"])
+        return info
+
+    def wait_admitted(self, policy=None):
+        """Backoff-poll until this rank is inside the world (its parked
+        growth join was committed by a barrier boundary)."""
+        from .resilience import RetryPolicy
+
+        if self._rank is None:
+            raise RuntimeError("wait_admitted before join()")
+        if policy is None:
+            policy = RetryPolicy.from_knobs(seed=self._policy_seed)
+        info = self.membership()
+        if self._rank < int(info["num_workers"]):
+            return info
+        for delay in policy.delays():
+            time.sleep(delay)
+            info = self.membership()
+            if self._rank < int(info["num_workers"]):
+                return info
+        raise TimeoutError(
+            f"rank {self._rank} was never admitted (world stuck at "
+            f"{info['num_workers']}); admissions commit at a barrier "
+            "boundary — is any live worker reaching one?")
+
+    def state_manifest(self):
+        return self._rpc("state_manifest")
+
+    def bootstrap(self, keys=None):
+        """State transfer on admit: pull every key in the server's
+        directory and verify the bytes against its sharded_checkpoint-
+        format manifest. A mismatch (the server applied a push between
+        manifest and pull) refetches the manifest once; returns
+        {key: np.ndarray}."""
+        from . import telemetry as _telemetry
+        from .contrib import sharded_checkpoint as _sc
+
+        if keys is None:
+            keys = self.keys()
+        manifest = self.state_manifest()
+        out = {}
+        for key in keys:
+            for _attempt in range(2):
+                entry = manifest["files"].get(str(key))
+                val = np.asarray(self.pull(key))
+                if entry is None or _sc.verify_wire_entry(
+                        entry, val.tobytes()):
+                    break
+                manifest = self.state_manifest()
+            else:
+                raise RuntimeError(
+                    f"bootstrap of key {key!r} never matched the server's "
+                    "state manifest — the server is applying concurrent "
+                    "updates; join at an epoch boundary instead")
+            out[key] = val
+        _telemetry.log_event("ps_bootstrap", keys=len(out),
+                             epoch=self._epoch)
+        return out
 
     def num_dead(self, rank, timeout, grace_elapsed=True):
         return self._rpc("num_dead", int(rank), float(timeout),

@@ -65,6 +65,19 @@ METRIC_NAMES = {
     "mxtpu_ps_evictions_total": (
         "counter", "Workers evicted from the barrier/sync quorum after "
                    "heartbeat staleness (dist graceful degradation)."),
+    "mxtpu_ps_joins_total": (
+        "counter", "Join RPCs the ParameterServer accepted, by outcome "
+                   "(registered / readmitted / pending)."),
+    "mxtpu_ps_readmissions_total": (
+        "counter", "Evicted ranks re-admitted to the quorum, via a fresh "
+                   "heartbeat or a join RPC (elastic membership)."),
+    "mxtpu_ps_stale_epoch_rejections_total": (
+        "counter", "Sync contributions rejected for carrying a stale "
+                   "membership epoch, by command."),
+    "mxtpu_ps_membership_epoch": (
+        "gauge", "Current membership epoch of the ParameterServer; bumps "
+                 "on every membership change (readmission, rank "
+                 "takeover, world growth)."),
     "mxtpu_fault_injections_total": (
         "counter", "Faults fired by the deterministic injector "
                    "(MXTPU_FAULT_SPEC), by site and mode."),

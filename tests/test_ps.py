@@ -296,3 +296,77 @@ def test_kvstore_server_module_entry():
     finally:
         if p.poll() is None:
             p.kill()
+
+
+# ---------------------------------------------------------------------------
+# hierarchical push_many/pull_many + elastic join protocol
+# ---------------------------------------------------------------------------
+
+def test_push_many_matches_per_key_pushes(server2):
+    """A bucketed push_many applies exactly what per-key pushes would —
+    per-key optimizer math is unchanged, only the RPC count drops."""
+    srv, (c0, c1) = server2
+    c0.init("a", np.zeros(3, np.float32))
+    c0.init("b", np.full(2, 10.0, np.float32))
+    # async: one RPC, both keys applied instantly
+    c0.push_many(["a", "b"], [np.ones(3, np.float32),
+                              np.full(2, 2.0, np.float32)])
+    a, b = c0.pull_many(["a", "b"])
+    np.testing.assert_array_equal(a, np.ones(3, np.float32))
+    np.testing.assert_array_equal(b, np.full(2, 12.0, np.float32))
+    # sync: the whole bucket rendezvouses as one unit across workers
+    def contribute(c, scale):
+        c.push_many(["a", "b"], [scale * np.ones(3, np.float32),
+                                 scale * np.ones(2, np.float32)],
+                    sync=True)
+
+    ts = [threading.Thread(target=contribute, args=(c, s))
+          for c, s in ((c0, 1.0), (c1, 2.0))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+        assert not t.is_alive()
+    a2, b2 = c0.pull_many(["a", "b"])
+    np.testing.assert_array_equal(a2, 4 * np.ones(3, np.float32))
+    np.testing.assert_array_equal(b2, np.full(2, 15.0, np.float32))
+    # per-key versions advanced once per applied bucket member
+    assert srv._versions["a"] == 2 and srv._versions["b"] == 2
+
+
+def test_join_growth_commits_at_barrier_boundary(server2, monkeypatch):
+    """A brand-new rank joins a full world under MXTPU_MAX_WORKERS: the
+    join parks, the next barrier generation commits it (num_workers and
+    the membership epoch rise), and every already-joined client learns
+    the new epoch from its barrier response."""
+    monkeypatch.setenv("MXTPU_PS_SYNC_TIMEOUT", "30")
+    srv, (c0, c1) = server2
+    srv._max_workers = 3  # the knob is read at server construction
+    c0.join(0)
+    c1.join(1)
+    assert srv._epoch == 0
+    c2 = PSClient("127.0.0.1", srv.port, instance="w2")
+    info = c2.join(2, wait=False)
+    assert info["pending"] and srv.num_workers == 2
+    t = threading.Thread(target=c0.barrier)
+    t.start()
+    c1.barrier()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert srv.num_workers == 3 and srv._epoch == 1
+    assert c0.epoch == 1 and c1.epoch == 1  # published at the boundary
+    admitted = c2.wait_admitted()
+    assert admitted["num_workers"] == 3 and c2.epoch == 1
+    c2.close()
+
+
+def test_join_rejected_when_world_full(server2):
+    """Without MXTPU_MAX_WORKERS headroom a growth join is refused with
+    the dedicated error class (the joiner's cue to back off)."""
+    from incubator_mxnet_tpu.ps import JoinRejectedError
+    from incubator_mxnet_tpu.resilience import RetryPolicy
+
+    srv, (c0, _c1) = server2
+    with pytest.raises(JoinRejectedError, match="MXTPU_MAX_WORKERS"):
+        c0.join(2, wait=False,
+                policy=RetryPolicy(max_attempts=1, base_delay=0.01))

@@ -398,3 +398,105 @@ def test_server_error_is_not_retried(server1):
     with pytest.raises(RuntimeError):
         c.pull("never-initialized")
     c.close()
+
+
+# ---------------------------------------------------------------------------
+# elastic membership (docs/FAULT_TOLERANCE.md — Elastic membership)
+# ---------------------------------------------------------------------------
+
+def test_quorum_grows_back_after_fresh_heartbeat(monkeypatch):
+    """Eviction is not a ratchet: a fresh beat from the stale rank
+    re-admits it, and the next rendezvous waits for BOTH workers again."""
+    monkeypatch.setenv("MXTPU_HEARTBEAT_TIMEOUT", "2.0")
+    monkeypatch.setenv("MXTPU_PS_SYNC_TIMEOUT", "30")
+    srv = _ps.ParameterServer(2, host="127.0.0.1", port=0)
+    try:
+        c0 = _ps.PSClient("127.0.0.1", srv.port, instance="w0")
+        c1 = _ps.PSClient("127.0.0.1", srv.port, instance="w1")
+        c0.heartbeat(0)
+        c1.heartbeat(1)
+        time.sleep(2.4)      # both beats stale now
+        c0.heartbeat(0)      # rank 0 comes back...
+        assert srv._quorum() == 1          # ...rank 1 is evicted
+        assert 1 in srv._evicted
+        c1.heartbeat(1)      # the stale rank beats again
+        assert srv._quorum() == 2          # quorum grew back
+        assert 1 not in srv._evicted
+        # and it is live again: a barrier really needs both ranks
+        t = threading.Thread(target=c0.barrier)
+        t.start()
+        time.sleep(0.5)
+        assert t.is_alive(), "one rank must no longer satisfy the quorum"
+        c1.barrier()
+        t.join(timeout=30)
+        assert not t.is_alive()
+        c0.close()
+        c1.close()
+    finally:
+        srv.shutdown()
+
+
+def test_stale_epoch_contribution_rejected(monkeypatch):
+    """A zombie's sync contribution is fenced: after its rank was taken
+    over (membership epoch bumped), the old incarnation's push raises
+    StaleEpochError instead of silently merging into the rendezvous."""
+    monkeypatch.setenv("MXTPU_PS_SYNC_TIMEOUT", "30")
+    srv = _ps.ParameterServer(1, host="127.0.0.1", port=0)
+    try:
+        old = _ps.PSClient("127.0.0.1", srv.port, instance="w0")
+        old.join(0)
+        old.init("w", np.zeros(2, np.float32))
+        assert srv._epoch == 0 and old.epoch == 0
+        replacement = _ps.PSClient("127.0.0.1", srv.port, instance="w0b")
+        replacement.join(0)                # takeover bumps the epoch
+        assert srv._epoch == 1 and replacement.epoch == 1
+        with pytest.raises(_ps.StaleEpochError):
+            old.push("w", np.ones(2, np.float32), sync=True)
+        assert srv._versions["w"] == 0     # the rejection merged NOTHING
+        # the current incarnation contributes normally
+        replacement.push("w", np.ones(2, np.float32), sync=True)
+        np.testing.assert_array_equal(np.asarray(replacement.pull("w")),
+                                      np.ones(2, np.float32))
+        # the zombie recovers by refreshing membership, then a NEW push
+        old.membership()
+        assert old.epoch == 1
+        old.push("w", np.ones(2, np.float32), sync=True)
+        np.testing.assert_array_equal(np.asarray(old.pull("w")),
+                                      2 * np.ones(2, np.float32))
+        old.close()
+        replacement.close()
+    finally:
+        srv.shutdown()
+
+
+def test_rejoin_readmits_and_bootstrap_matches(monkeypatch):
+    """An evicted rank's replacement join()s back in: the quorum grows,
+    the readmission is counted, and bootstrap() hands it the server's
+    authoritative weights verified against the state manifest."""
+    from incubator_mxnet_tpu import telemetry as _telemetry
+
+    monkeypatch.setenv("MXTPU_HEARTBEAT_TIMEOUT", "1.0")
+    monkeypatch.setenv("MXTPU_PS_SYNC_TIMEOUT", "30")
+    srv = _ps.ParameterServer(2, host="127.0.0.1", port=0)
+    try:
+        c0 = _ps.PSClient("127.0.0.1", srv.port, instance="w0")
+        c0.join(0)
+        c0.init("w", np.full(3, 7.0, np.float32))
+        c0.heartbeat(0)
+        c0.heartbeat(1)
+        time.sleep(1.3)
+        c0.heartbeat(0)
+        assert srv._quorum() == 1 and 1 in srv._evicted
+        c1b = _ps.PSClient("127.0.0.1", srv.port, instance="w1b")
+        info = c1b.join(1)
+        assert info["readmitted"] and info["rank"] == 1
+        assert srv._quorum() == 2 and 1 not in srv._evicted
+        assert srv._epoch == 1             # readmission bumped the epoch
+        assert info["keys"] == ("w",)      # key directory came with it
+        boot = model.bootstrap_params(c1b)
+        np.testing.assert_array_equal(boot["w"].asnumpy(),
+                                      np.full(3, 7.0, np.float32))
+        c0.close()
+        c1b.close()
+    finally:
+        srv.shutdown()

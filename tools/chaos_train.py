@@ -27,8 +27,21 @@ worker `trainer.step` is the causal ancestor of a server `merge` span in
 the same trace, the straggler report names the faulted rank, and a
 flight-recorder dump holds the injected fault event.
 
+With --elastic it runs the elastic-membership proof instead: a 2-worker
+sync job where rank 1 is killed MID-EPOCH (after its pull, before its
+push), evicted by heartbeat staleness, and replaced by a fresh process
+that join()s rank 1, bootstraps the full parameter state over the wire
+(manifest-verified, bit-equal to what the dead worker held), and
+finishes the job. The survivor's first post-join contribution carries a
+stale membership epoch and must be REJECTED, then succeed after a
+membership refresh. Asserts: final weights bit-identical to a fault-free
+reference, mxtpu_ps_readmissions_total >= 1 in the metrics snapshot, and
+the join/readmission/eviction visible in both the flight-recorder dumps
+and the merged trace.
+
 Usage:  JAX_PLATFORMS=cpu python tools/chaos_train.py [--epochs 4]
         JAX_PLATFORMS=cpu python tools/chaos_train.py --observability
+        JAX_PLATFORMS=cpu python tools/chaos_train.py --elastic
 """
 import argparse
 import json
@@ -58,6 +71,12 @@ TORN_SPEC = "ckpt.write:torn@{n}"
 # unambiguous for the straggler report
 OBS_DROP_SPEC = "ps.rpc.recv:drop@11"
 OBS_EPOCHS = 3
+
+# elastic run: rank 1 dies at this epoch, after pulling and before
+# pushing; a replacement is admitted and the epoch completes with it
+ELASTIC_EPOCHS = 4
+ELASTIC_KILL_EPOCH = 2
+ELASTIC_KEYS = ("w", "b")
 
 
 def _target(epoch, rank):
@@ -235,6 +254,237 @@ def run_observability(workdir):
           f"{len(files)} trace file(s); timeline at {out}")
 
 
+def _elastic_grads(vals, epoch, rank):
+    # fold the key index into the rank so each key gets its own
+    # deterministic gradient stream (still /2: two contributions per key)
+    return [_grad(np.asarray(v, dtype=np.float32), epoch, rank + 10 * i)
+            for i, v in enumerate(vals)]
+
+
+def _elastic_reference(init):
+    """Fault-free 2-worker run over the hierarchical (bucketed) path —
+    the bit-exactness yardstick for the elastic run."""
+    srv = _ps.ParameterServer(2, host="127.0.0.1", port=0)
+    clients = [_ps.PSClient("127.0.0.1", srv.port, instance=f"ref{r}")
+               for r in range(2)]
+    try:
+        for k, v in init.items():
+            clients[0].init(k, v)
+
+        def worker(rank):
+            c = clients[rank]
+            for epoch in range(1, ELASTIC_EPOCHS + 1):
+                vals = c.pull_many(ELASTIC_KEYS)
+                c.push_many(ELASTIC_KEYS,
+                            _elastic_grads(vals, epoch, rank), sync=True)
+
+        threads = [threading.Thread(target=worker, args=(r,))
+                   for r in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+            assert not t.is_alive(), "reference worker wedged"
+        return [np.asarray(v) for v in clients[0].pull_many(ELASTIC_KEYS)]
+    finally:
+        for c in clients:
+            c.close()
+        srv.shutdown()
+
+
+def run_elastic(workdir):
+    """The elastic-membership acceptance proof (see module docstring)."""
+    trace_dir = os.path.join(workdir, "traces")
+    os.makedirs(trace_dir, exist_ok=True)
+    os.environ["MXTPU_TRACE_DIR"] = trace_dir
+    os.environ["MXTPU_FLIGHT_RECORDER_DIR"] = trace_dir
+    # short staleness window so the kill is detected in seconds; set
+    # BEFORE server construction (the eviction timeout binds at init)
+    os.environ["MXTPU_HEARTBEAT_TIMEOUT"] = "2.0"
+    telemetry.distributed.refresh_from_env()
+    telemetry.recorder.refresh_from_env()
+    telemetry.enable()
+
+    init = {"w": np.zeros(DIM, dtype=np.float32),
+            "b": np.arange(DIM, dtype=np.float32)}
+    w_ref = _elastic_reference(init)
+    print(f"[chaos] elastic reference done: {ELASTIC_EPOCHS} epochs, "
+          f"w_ref[0][:3]={w_ref[0][:3]}")
+
+    srv = _ps.ParameterServer(2, host="127.0.0.1", port=0)
+    c0 = _ps.PSClient("127.0.0.1", srv.port, instance="w0")
+    c1 = _ps.PSClient("127.0.0.1", srv.port, instance="w1")
+    c1b = None
+    try:
+        for k, v in init.items():
+            c0.init(k, v)
+        c0.join(0)
+        c1.join(1)
+        c1.heartbeat(1)  # rank 1 is heartbeat-tracked, hence evictable
+
+        def step(c, rank, epoch):
+            telemetry.distributed.set_thread_lane(f"r{rank}")
+            with telemetry.span("trainer.step", epoch=epoch):
+                if rank == 1:
+                    c.heartbeat(1)
+                vals = c.pull_many(ELASTIC_KEYS)
+                c.push_many(ELASTIC_KEYS,
+                            _elastic_grads(vals, epoch, rank), sync=True)
+
+        def run_epoch(cs, epoch):
+            threads = [threading.Thread(target=step, args=(c, r, epoch))
+                       for r, c in enumerate(cs)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+                assert not t.is_alive(), f"worker wedged in epoch {epoch}"
+
+        for epoch in range(1, ELASTIC_KILL_EPOCH):
+            run_epoch([c0, c1], epoch)
+
+        # --- the kill: rank 1 pulled this epoch's weights, then dies ------
+        epoch = ELASTIC_KILL_EPOCH
+        vals0 = [np.asarray(v) for v in c0.pull_many(ELASTIC_KEYS)]
+        vals1 = [np.asarray(v) for v in c1.pull_many(ELASTIC_KEYS)]
+        c1.close()  # no farewell, no more heartbeats: a real crash
+        print(f"[chaos] killed rank 1 mid-epoch {epoch} "
+              "(pulled, never pushed)")
+        deadline = time.monotonic() + 30
+        while int(c0.membership()["quorum"]) >= 2:
+            assert time.monotonic() < deadline, "rank 1 never evicted"
+            time.sleep(0.25)
+        print("[chaos] rank 1 evicted by heartbeat staleness")
+
+        # --- the replacement: join, bootstrap, finish the epoch -----------
+        c1b = _ps.PSClient("127.0.0.1", srv.port, instance="w1b")
+        info = c1b.join(1)
+        assert info["readmitted"], f"join was not a readmission: {info}"
+        assert not info["pending"], f"readmission parked as pending: {info}"
+        assert c1b.epoch >= 1, c1b.epoch
+        assert tuple(info["keys"]) == tuple(sorted(ELASTIC_KEYS)), info
+        c1b.heartbeat(1)
+        boot = model.bootstrap_params(c1b)
+        for i, k in enumerate(ELASTIC_KEYS):
+            got = boot[k].asnumpy()
+            assert got.dtype == vals1[i].dtype, (got.dtype, vals1[i].dtype)
+            assert np.array_equal(got, vals1[i]), (
+                f"bootstrap of {k!r} diverged from the dead worker's view:"
+                f"\n  dead worker = {vals1[i]}\n  bootstrap   = {got}")
+        print(f"[chaos] replacement joined rank 1 at epoch {c1b.epoch}; "
+              f"bootstrap bit-equal for keys {ELASTIC_KEYS}")
+
+        # the survivor joined at epoch 0, so its first contribution now
+        # MUST bounce, and succeed only after a membership refresh
+        stale = {"fired": False}
+
+        def finish_r0():
+            telemetry.distributed.set_thread_lane("r0")
+            grads = _elastic_grads(vals0, epoch, 0)
+            try:
+                c0.push_many(ELASTIC_KEYS, grads, sync=True)
+            except _ps.StaleEpochError:
+                stale["fired"] = True
+                c0.membership()  # adopt the post-join epoch, then a NEW
+                # mutating RPC (fresh seq — the dedup window must not
+                # replay the cached rejection)
+                c0.push_many(ELASTIC_KEYS, grads, sync=True)
+
+        def finish_r1b():
+            telemetry.distributed.set_thread_lane("r1")
+            grads = _elastic_grads(
+                [boot[k].asnumpy() for k in ELASTIC_KEYS], epoch, 1)
+            c1b.push_many(ELASTIC_KEYS, grads, sync=True)
+
+        threads = [threading.Thread(target=finish_r0),
+                   threading.Thread(target=finish_r1b)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+            assert not t.is_alive(), "kill-epoch finish wedged"
+        assert stale["fired"], (
+            "survivor's stale-epoch contribution was not rejected")
+        print("[chaos] survivor's stale push rejected, retried at epoch "
+              f"{c0.epoch}; kill epoch completed with the replacement")
+
+        for epoch in range(ELASTIC_KILL_EPOCH + 1, ELASTIC_EPOCHS + 1):
+            run_epoch([c0, c1b], epoch)
+        w_final = [np.asarray(v) for v in c0.pull_many(ELASTIC_KEYS)]
+        assert int(c0.membership()["epoch"]) >= 1
+    finally:
+        for c in (c0, c1b):
+            if c is not None:
+                c.close()
+        srv.shutdown()
+    telemetry.recorder.dump("elastic-complete")
+    telemetry.distributed.flush()
+    for var in ("MXTPU_TRACE_DIR", "MXTPU_FLIGHT_RECORDER_DIR",
+                "MXTPU_HEARTBEAT_TIMEOUT"):
+        os.environ.pop(var, None)
+
+    # --- verdicts ---------------------------------------------------------
+    for i, k in enumerate(ELASTIC_KEYS):
+        assert w_final[i].dtype == w_ref[i].dtype
+        assert np.array_equal(w_final[i], w_ref[i]), (
+            f"elastic weights for {k!r} diverged from the fault-free "
+            f"run:\n  ref   = {w_ref[i]}\n  final = {w_final[i]}")
+    print("[chaos] final weights bit-identical to the fault-free "
+          "reference")
+
+    prom = telemetry.prometheus_text()
+
+    def counter_total(name):
+        return sum(float(line.rsplit(" ", 1)[1])
+                   for line in prom.splitlines()
+                   if line.startswith(name) and not line.startswith("#"))
+
+    readmits = counter_total("mxtpu_ps_readmissions_total")
+    stale_rej = counter_total("mxtpu_ps_stale_epoch_rejections_total")
+    assert readmits >= 1, f"readmissions counter at {readmits}, need >= 1"
+    assert stale_rej >= 1, f"stale-epoch counter at {stale_rej}, need >= 1"
+    snap_path = os.path.join(workdir, "metrics.json")
+    snap = telemetry.dump_json(snap_path)
+    snap_readmits = sum(
+        s["value"] for s in snap["metrics"].get(
+            "mxtpu_ps_readmissions_total", {}).get("series", []))
+    assert snap_readmits >= 1, (
+        f"metrics snapshot {snap_path} records {snap_readmits} "
+        "readmissions, need >= 1")
+    print(f"[chaos] metrics ok: {int(readmits)} readmission(s), "
+          f"{int(stale_rej)} stale-epoch rejection(s); snapshot at "
+          f"{snap_path}")
+
+    dumps = [f for f in os.listdir(trace_dir) if f.startswith("flightrec-")]
+    assert dumps, "no flight-recorder dump written"
+    kinds = set()
+    for fn in dumps:
+        with open(os.path.join(trace_dir, fn)) as f:
+            kinds |= {e["kind"] for e in json.load(f)["events"]}
+    for want in ("ps_eviction", "ps_join", "ps_readmission"):
+        assert want in kinds, (
+            f"flight-recorder dumps hold no {want} event; kinds={kinds}")
+    print(f"[chaos] flight recorder ok: {len(dumps)} dump(s) covering "
+          "eviction + join + readmission")
+
+    import trace_merge
+
+    records, files = trace_merge.load_dir(trace_dir)
+    joins = [r for r in records if r["name"] == "ps.client.rpc"
+             and r.get("tags", {}).get("command") == "join"]
+    assert joins, "no join RPC span in the merged trace"
+    offsets, _anchor = trace_merge.estimate_offsets(records)
+    timeline = trace_merge.to_chrome_trace(records, offsets)
+    problems = trace_merge.check_timeline(timeline, records)
+    assert not problems, problems
+    out = os.path.join(workdir, "timeline.json")
+    with open(out, "w") as f:
+        json.dump(timeline, f)
+    print(f"[chaos] PASS (elastic): {len(joins)} join RPC span(s) in "
+          f"{len(records)} merged spans from {len(files)} file(s); "
+          f"timeline at {out}")
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--epochs", type=int, default=4)
@@ -245,6 +495,9 @@ def main():
     ap.add_argument("--observability", action="store_true",
                     help="run the distributed-tracing proof instead of "
                          "the recovery proof")
+    ap.add_argument("--elastic", action="store_true",
+                    help="run the elastic-membership proof instead of "
+                         "the recovery proof")
     args = ap.parse_args()
 
     import tempfile
@@ -254,6 +507,9 @@ def main():
 
     if args.observability:
         run_observability(workdir)
+        return
+    if args.elastic:
+        run_elastic(workdir)
         return
 
     init_w = np.zeros(DIM, dtype=np.float32)
