@@ -65,9 +65,13 @@ def child_main():
     ondev_env = os.environ.get("BENCH_ONDEVICE", "auto")
     ondev = (ondev_env == "1"
              or (ondev_env == "auto" and target.platform != "cpu"))
+    # BENCH_REMAT_POLICY (set by --remat-policy) selects a named
+    # jax.checkpoint_policies tier; unset falls back to MXTPU_REMAT_POLICY
+    remat_policy = os.environ.get("BENCH_REMAT_POLICY") or None
     step = fused.GluonTrainStep(net, lambda n, x, y: L(n(x), y), opt,
                                 device=target, init_on_device=ondev,
-                                remat=os.environ.get("BENCH_REMAT") == "1")
+                                remat=os.environ.get("BENCH_REMAT") == "1",
+                                remat_policy=remat_policy)
 
     rng = np.random.RandomState(0)
     import jax.numpy as jnp
@@ -172,6 +176,13 @@ def child_main():
         losses.asnumpy()  # real fetch: closes the whole rep chain
         scan_ips = batch_size * scan_k * reps / (time.perf_counter() - t0)
 
+    # bytes/step from XLA's cost model on the single-step program — the
+    # HBM-traffic number reported next to img/s (BENCH_BYTES=0 skips the
+    # extra abstract compile; it reuses the persistent XLA cache)
+    bytes_per_step = 0.0
+    if os.environ.get("BENCH_BYTES", "1") != "0":
+        bytes_per_step = step.cost_stats(x, y).get("bytes_accessed", 0.0)
+
     print(json.dumps({
         "ips": round(ips, 2),
         "scan_ips": round(scan_ips, 2),
@@ -181,6 +192,10 @@ def child_main():
         "platform": target.platform,
         "compile_s": round(compile_s, 1),
         "loss": float(loss.asscalar()),
+        "bytes_per_step": round(bytes_per_step),
+        "remat_policy": step.remat_policy,
+        "fused_epilogue": os.environ.get("MXTPU_FUSED_EPILOGUE", "0")
+        not in ("", "0", "false", "off"),
         "final": True,  # distinguishes this from the mid-run partial line
     }), flush=True)
 
@@ -482,6 +497,18 @@ def dispatch_overhead_main(assert_mode=False):
 
 
 def main():
+    # HBM-traffic lever axes (satellite flags; env inheritance carries
+    # them into the measurement children)
+    argv = sys.argv[1:]
+    for i, a in enumerate(argv):
+        if a.startswith("--remat-policy"):
+            val = (a.split("=", 1)[1] if "=" in a
+                   else (argv[i + 1] if i + 1 < len(argv) else ""))
+            os.environ["BENCH_REMAT_POLICY"] = val
+        elif a == "--fused-epilogue":
+            os.environ["MXTPU_FUSED_EPILOGUE"] = "1"
+        elif a == "--stochastic-rounding":
+            os.environ["MXTPU_STOCHASTIC_ROUNDING"] = "1"
     if "--dispatch-overhead" in sys.argv or os.environ.get("BENCH_DISPATCH"):
         dispatch_overhead_main(assert_mode="--assert" in sys.argv)
         return
@@ -616,6 +643,14 @@ def main():
         out["compile_s"] = primary.get("compile_s")
         out["mode"] = ("scan" if primary.get("scan_ips", 0.0) > primary["ips"]
                        else "per-step")
+        # HBM traffic next to throughput: XLA cost-model bytes of the
+        # single-step program, plus which traffic levers were armed
+        if primary.get("bytes_per_step"):
+            out["bytes_per_step"] = primary["bytes_per_step"]
+        if primary.get("remat_policy"):
+            out["remat_policy"] = primary["remat_policy"]
+        if primary.get("fused_epilogue"):
+            out["fused_epilogue"] = True
         if out["mode"] == "scan":
             out["scan_k"] = primary.get("scan_k")
             out["per_step_ips"] = primary["ips"]

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI tiers (ref: ci/docker/runtime_functions.sh — unittest / nightly /
 # distributed stages). Usage:
-#   ci/run_tests.sh [unit|nightly|dist|examples|telemetry|aggregation|static-analysis|chaos|all]
+#   ci/run_tests.sh [unit|nightly|dist|examples|telemetry|aggregation|static-analysis|perf-structure|chaos|all]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -128,6 +128,24 @@ print(f"chaos elastic artifacts ok: {int(total)} readmission(s) in the "
 PY
 }
 
+run_perf_structure() {
+    echo "=== perf-structure tier (HLO structural gates on the headline program) ==="
+    # the scaled-down resnet50 bf16+scan step, compiled twice. Gate 1:
+    # default knobs — conv dtypes all-bf16, zero loose entry elementwise,
+    # zero standalone bf16 elementwise producers, zero epilogue rewrites
+    # (the knob-off program must not change shape as the levers evolve).
+    JAX_PLATFORMS=cpu python tools/perf_analysis.py \
+        --batch 4 --image 32 --scan 2 \
+        --assert-structure --max-unfused-bf16 0
+    # Gate 2: all three traffic levers on — the epilogue rewrite must
+    # actually fire (>0 rewrites) and the program must stay structurally
+    # clean under the selective remat policy + stochastic rounding.
+    JAX_PLATFORMS=cpu python tools/perf_analysis.py \
+        --batch 4 --image 32 --scan 2 \
+        --remat-policy convs --fused-epilogue --stochastic-rounding \
+        --assert-structure
+}
+
 run_nightly() {
     echo "=== nightly tier (large tensors, checkpoint compat, 7-worker dist) ==="
     MXTPU_NIGHTLY=1 python -m pytest tests/test_large_array.py \
@@ -155,8 +173,9 @@ case "$tier" in
     aggregation) run_aggregation ;;
     static-analysis) run_static_analysis ;;
     chaos)     run_chaos ;;
+    perf-structure) run_perf_structure ;;
     nightly)   run_nightly ;;
-    all)       run_static_analysis; run_unit; run_telemetry; run_aggregation; run_chaos; run_dist; run_examples; run_nightly ;;
-    *) echo "unknown tier: $tier (unit|nightly|dist|examples|suite|telemetry|aggregation|static-analysis|chaos|all)"; exit 2 ;;
+    all)       run_static_analysis; run_unit; run_telemetry; run_aggregation; run_perf_structure; run_chaos; run_dist; run_examples; run_nightly ;;
+    *) echo "unknown tier: $tier (unit|nightly|dist|examples|suite|telemetry|aggregation|static-analysis|perf-structure|chaos|all)"; exit 2 ;;
 esac
 echo "tier '$tier' green"

@@ -100,7 +100,42 @@ register_knob("MXNET_GRAPH_VALIDATE", "off", str,
               "mxtpu_graph_validate_findings_total counter when telemetry "
               "is on. See docs/STATIC_ANALYSIS.md.")
 
+# memory traffic (see docs/PERF_ANALYSIS.md §0)
+register_knob("MXTPU_FUSED_EPILOGUE", False, bool,
+              "Route conv→BN→ReLU(→residual-add) chains through the Pallas "
+              "NHWC epilogue kernel (ops/pallas_kernels.py:bn_act_epilogue) "
+              "inside traced train steps: one HBM pass applies the BN "
+              "affine, the activation, and the residual add to the conv "
+              "accumulator instead of leaving the fusion decision to XLA. "
+              "Off (default) keeps the XLA path bit-for-bit; off-TPU the "
+              "kernel runs in interpret mode only when tests request it.")
+register_knob("MXTPU_REMAT_POLICY", "", str,
+              "Named jax.checkpoint_policies policy for GluonTrainStep "
+              "rematerialization: 'convs' (save convolution AND matmul "
+              "results, recompute cheap elementwise — the tier tuned for "
+              "the HBM-saturated bf16 conv path), 'dots' (dots_saveable), "
+              "'dots_no_batch' (dots_with_no_batch_dims_saveable — "
+              "matmuls only; a conv net recomputes every conv under "
+              "this), 'offload' (offload dot "
+              "results to host memory), 'nothing' (nothing_saveable — "
+              "recompute everything, the legacy remat=True behavior), "
+              "'everything' (everything_saveable — no remat), or any "
+              "exact jax.checkpoint_policies attribute name. A non-empty "
+              "policy enables remat even without GluonTrainStep("
+              "remat=True); empty (default) preserves the legacy "
+              "all-or-nothing jax.checkpoint behavior.")
+
 # optimizer / trainer aggregation
+register_knob("MXTPU_STOCHASTIC_ROUNDING", False, bool,
+              "Master-free bf16 optimizer updates: for bf16 weights under "
+              "multi_precision, skip the f32 master copy and instead "
+              "compute the update in f32 from the bf16 weight, then "
+              "stochastically round the result back to bf16 (seeded per "
+              "(step, param); the unbiased rounding replaces the master's "
+              "role of accumulating sub-ulp updates). Cuts the f32 master "
+              "read+write (~0.6 GB/step on ResNet-50) from optimizer "
+              "traffic. Opt-in: equivalence to the f32-master path is to "
+              "tolerance, not bit-exact.")
 register_knob("MXNET_OPTIMIZER_AGGREGATION_SIZE", 4096, int,
               "Byte cap (in KB) of one aggregated optimizer-update bucket "
               "on the eager Trainer path: parameters are grouped into "
@@ -309,7 +344,8 @@ SUBSUMED = {
     "MXNET_GPU_MEM_POOL_TYPE": "PJRT BFC allocator",
     "MXNET_GPU_MEM_POOL_RESERVE": "XLA_PYTHON_CLIENT_PREALLOCATE",
     "MXNET_EXEC_ENABLE_INPLACE": "XLA buffer reuse + donation",
-    "MXNET_BACKWARD_DO_MIRROR": "jax.checkpoint / remat policies",
+    "MXNET_BACKWARD_DO_MIRROR": "jax.checkpoint / remat policies; the "
+                                "policy choice is MXTPU_REMAT_POLICY",
     "MXNET_EXEC_INPLACE_GRAD_SUM_CAP": "XLA fusion of gradient sums",
     "MXNET_KVSTORE_REDUCTION_NTHREADS": "ICI collective all-reduce",
     "MXNET_KVSTORE_BIGARRAY_BOUND": "GSPMD sharding decides partitioning; "

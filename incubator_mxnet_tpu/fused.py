@@ -28,12 +28,62 @@ import jax
 import jax.numpy as jnp
 
 from . import autograd
+from . import config
 from . import random as _global_random
 from .gluon.block import _ParamSubst
 from .ndarray.ndarray import NDArray
 from .optimizer import _cast_state_like as _cast_like
 
-__all__ = ["GluonTrainStep"]
+__all__ = ["GluonTrainStep", "resolve_remat_policy"]
+
+# Friendly tiers for MXTPU_REMAT_POLICY, ordered by how much they save
+# (everything_saveable = no recompute) vs recompute (nothing_saveable =
+# the legacy remat=True behavior). Any exact jax.checkpoint_policies
+# attribute name is also accepted.
+_REMAT_POLICY_ALIASES = {
+    "dots": "dots_saveable",
+    "dots_no_batch": "dots_with_no_batch_dims_saveable",
+    "offload": "offload_dot_with_no_batch_dims",
+    "nothing": "nothing_saveable",
+    "everything": "everything_saveable",
+}
+
+
+def _convs_and_dots_saveable(prim, *_, **__):
+    """The 'convs' tier: keep MXU results (convolutions AND matmuls) for
+    the backward, recompute only cheap elementwise/BN chains. jax's
+    builtin dots_* policies save dot_general only — on a conv net they
+    recompute every convolution (the expensive op) while saving nothing,
+    which is why the batch-256 bf16 remat config regressed instead of
+    merely trading flops for memory."""
+    return prim.name in ("conv_general_dilated", "dot_general")
+
+
+def resolve_remat_policy(name):
+    """Map a MXTPU_REMAT_POLICY value to a jax.checkpoint policy callable.
+
+    Accepts the friendly tier names ('convs', 'dots', 'dots_no_batch',
+    'offload', 'nothing', 'everything') or any exact attribute of
+    jax.checkpoint_policies. Returns None for the empty string (legacy
+    all-or-nothing checkpointing). Raises ValueError for unknown names,
+    listing what is available."""
+    if not name:
+        return None
+    if name == "convs":
+        return _convs_and_dots_saveable
+    cp = jax.checkpoint_policies
+    attr = _REMAT_POLICY_ALIASES.get(name, name)
+    pol = getattr(cp, attr, None)
+    if pol is None:
+        known = ["convs"] + sorted(_REMAT_POLICY_ALIASES) + sorted(
+            a for a in dir(cp) if not a.startswith("_"))
+        raise ValueError(
+            f"unknown remat policy {name!r} (MXTPU_REMAT_POLICY); expected "
+            f"one of {known}")
+    if attr == "offload_dot_with_no_batch_dims":
+        # this policy is a factory taking (src, dst) memory kinds
+        pol = pol("device", "pinned_host")
+    return pol
 
 
 class GluonTrainStep:
@@ -46,7 +96,8 @@ class GluonTrainStep:
 
     def __init__(self, net, loss_fn, optimizer, mesh=None, batch_axis=0, device=None,
                  init_on_device=False, compute_dtype=None,
-                 shard_optimizer_states=False, remat=False):
+                 shard_optimizer_states=False, remat=False,
+                 remat_policy=None):
         self.net = net
         self.loss_fn = loss_fn
         self.opt = optimizer
@@ -79,6 +130,20 @@ class GluonTrainStep:
         # batches on memory-bound models. Numerics are identical (same
         # ops, same order, recomputed).
         self.remat = bool(remat)
+        # selective remat: a named jax.checkpoint_policies policy (see
+        # resolve_remat_policy) decides WHICH intermediates survive to
+        # the backward instead of recomputing everything. On the
+        # HBM-saturated bf16 path, blanket recompute ADDS traffic (the
+        # measured batch-256 regression, docs/PERF_ANALYSIS.md §0);
+        # 'convs' keeps the expensive conv/matmul results and recomputes
+        # only cheap elementwise, trading the least bandwidth for the
+        # memory saved. A non-empty policy implies remat.
+        if remat_policy is None:
+            remat_policy = config.get("MXTPU_REMAT_POLICY")
+        self.remat_policy = remat_policy or ""
+        resolve_remat_policy(self.remat_policy)  # validate eagerly
+        if self.remat_policy:
+            self.remat = True
         # ZeRO-1 analog: keep optimizer states sharded over the dp mesh
         # axis (see _build's mesh branch)
         self.shard_optimizer_states = shard_optimizer_states
@@ -328,7 +393,17 @@ class GluonTrainStep:
             return loss_data, aux_new
 
         forward_scan = forward
-        if self.remat:
+        if self.remat and self.remat_policy:
+            # policy-selective remat: the named policy decides which
+            # intermediates are saved (e.g. 'convs' keeps conv and
+            # matmul results, recomputing only cheap elementwise in the
+            # backward) — strictly less recompute AND less traffic than
+            # the blanket checkpoint below on bandwidth-bound programs.
+            policy = resolve_remat_policy(self.remat_policy)
+            forward_scan = jax.checkpoint(forward, policy=policy,
+                                          prevent_cse=False)
+            forward = jax.checkpoint(forward, policy=policy)
+        elif self.remat:
             # recompute the forward during backward instead of saving
             # activations (identical numerics, ~1/3 more FLOPs, far less
             # HBM) — applied to the WHOLE net forward; XLA still fuses
@@ -567,6 +642,33 @@ class GluonTrainStep:
             self._step, self._params, self._states, xd, yd,
             _rng_mod.next_key(), jnp.asarray(self.opt.lr, jnp.float32),
             jnp.asarray(1.0, jnp.float32), name=name)
+
+    def cost_stats(self, x, y):
+        """XLA cost-model totals (flops, bytes accessed) of the compiled
+        single-step program — the bytes/step number bench.py records next
+        to img/s. Lowers against abstract shapes (no donated buffer is
+        touched); with the persistent compilation cache the re-lower is a
+        cache hit. Returns {} when the backend exposes no cost model."""
+        if not self._built:
+            self._build(
+                x if isinstance(x, NDArray) else NDArray(jnp.asarray(x)),
+                y if isinstance(y, NDArray) else NDArray(jnp.asarray(y)),
+            )
+        xd = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        yd = y._data if isinstance(y, NDArray) else jnp.asarray(y)
+        try:
+            abstract = jax.tree_util.tree_map(
+                lambda d: jax.ShapeDtypeStruct(d.shape, d.dtype),
+                (self._params, self._states, xd, yd,
+                 jnp.zeros((2,), jnp.uint32),
+                 jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)))
+            ca = self._step.lower(*abstract).compile().cost_analysis()
+            if isinstance(ca, list):  # older jax returns [dict]
+                ca = ca[0]
+            return {"flops": float(ca.get("flops", 0.0)),
+                    "bytes_accessed": float(ca.get("bytes accessed", 0.0))}
+        except Exception:  # no cost model on this backend/runtime
+            return {}
 
     def sync_params(self):
         """Write current param values back into the net's Parameters."""

@@ -446,7 +446,10 @@ class Trainer:
         base_lr = (o.lr_scheduler(o.num_update)
                    if o.lr_scheduler is not None else o.lr)
         names = tuple(o.idx2name.get(i, i) for i in bucket)
-        use_sgd = type(o) is opt.SGD
+        # stochastic-rounding SGD rides the generic fused_update loop: the
+        # multi_sgd_* ops don't know the SR rounding contract
+        use_sgd = (type(o) is opt.SGD
+                   and not getattr(o, "stochastic_rounding", False))
         key = (bid, "sgd" if use_sgd else "generic", self._hyper_key(names))
         fn = self._agg_fn_cache.get(key)
         if fn is None:
@@ -501,7 +504,11 @@ class Trainer:
             try:
                 for name, w, s, g, t in zip(names, w_data, s_data, g_data,
                                             ts):
-                    if self._is_mp_state(w, s):
+                    if self._is_mp_state(w, s) or (
+                            getattr(o, "stochastic_rounding", False)
+                            and str(w.dtype) == "bfloat16"):
+                        # master-copy math and the SR master-free path both
+                        # run in f32 — keep the traced scalars f32 too
                         lr_p, rs_p = lr, rescale
                     else:
                         # eager hyperparams are weak python scalars (bf16

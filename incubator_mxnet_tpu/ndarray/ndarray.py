@@ -36,7 +36,7 @@ class NDArray:
     """Dense tensor handle over a jax.Array."""
 
     __slots__ = ("_data", "_grad", "_grad_req", "_node", "_node_index",
-                 "_dense_grad_buf", "_grad_gen", "__weakref__")
+                 "_dense_grad_buf", "_grad_gen", "_epi_prov", "__weakref__")
 
     # make NDArray win against numpy in mixed dunder dispatch
     __array_priority__ = 1000.0
@@ -362,7 +362,16 @@ class NDArray:
         return autograd.invoke_recorded(lambda a: scalar_fn(a, other) if scalar_fn else fn(a, other), [self])[0]
 
     def __add__(self, other):
-        return self._binop(other, jnp.add, lambda a, s: a + s)
+        out = self._binop(other, jnp.add, lambda a, s: a + s)
+        if isinstance(other, NDArray) and (
+                getattr(self, "_epi_prov", None) is not None
+                or getattr(other, "_epi_prov", None) is not None):
+            # a BN output flowing into an add is a candidate residual
+            # join for the fused-epilogue rewrite (ops/epilogue.py)
+            from ..ops import epilogue as _epilogue
+
+            _epilogue.note_add(out, self, other)
+        return out
 
     __radd__ = __add__
 

@@ -77,6 +77,23 @@ def _eager_jit_enabled():
     return raw.lower() not in ("0", "false", "off", "")
 
 
+def _donation_argnums(opdef, live_idx):
+    """Positions (into the jitted fn's live-array arglist) of inputs the op
+    declares it consumes (`OpDef.donate`) — optimizer weight/state updates.
+
+    Donating lets XLA write the update in place instead of allocating a
+    second copy of every parameter buffer, which is where the eager
+    optimizer path's peak-memory headroom comes from. CPU donation is a
+    no-op in XLA (it warns and copies anyway), so the gate keeps the
+    default test backend quiet; the positions themselves are backend-free
+    and unit-testable."""
+    if not opdef.donate:
+        return ()
+    consumed = {opdef.inputs.index(n) for n in opdef.donate
+                if n in opdef.inputs}
+    return tuple(k for k, i in enumerate(live_idx) if i in consumed)
+
+
 def _maybe_jit(opdef, fn, call_attrs, live_idx, n_slots):
     """Per-(op, attrs) jit cache for eager dispatch (MXTPU_EAGER_JIT).
 
@@ -95,7 +112,9 @@ def _maybe_jit(opdef, fn, call_attrs, live_idx, n_slots):
     if cached is None:
         # the jitted callable closes over THIS call's attrs; the cache key
         # guarantees any hit was built from equal attrs
-        cached = jax.jit(fn)
+        donate = (_donation_argnums(opdef, live_idx)
+                  if jax.default_backend() != "cpu" else ())
+        cached = jax.jit(fn, donate_argnums=donate)
         _EAGER_JIT_CACHE[key] = cached
         cap = _eager_jit_cache_cap()
         if cap > 0:
@@ -158,6 +177,19 @@ def invoke(opdef: OpDef, args, kwargs):
     if unknown:
         raise TypeError(f"op {opdef.name}: unknown arguments {sorted(unknown)}")
 
+    if (opdef.name == "Activation" and call_attrs.get("act_type") == "relu"
+            and out is None and slots and slots[0] is not None
+            and getattr(slots[0], "_epi_prov", None) is not None):
+        # MXTPU_FUSED_EPILOGUE: the input carries BatchNorm provenance
+        # (recorded below, traced dispatches only) — re-emit the
+        # BN→ReLU(→add) chain as one Pallas epilogue pass; the unfused
+        # chain already dispatched becomes dead code under XLA DCE
+        from ..ops import epilogue as _epilogue
+
+        fused_val = _epilogue.maybe_rewrite_relu(slots[0])
+        if fused_val is not None:
+            return NDArray._from_data(fused_val)
+
     training = autograd.is_training()
     if opdef.needs_rng:
         call_attrs["_rng"] = _global_random.next_key()
@@ -199,6 +231,12 @@ def invoke(opdef: OpDef, args, kwargs):
             if holder is not None:
                 holder._data = new._data
         results = primary
+
+    if opdef.name == "BatchNorm" and out is None:
+        from ..ops import epilogue as _epilogue
+
+        if _epilogue.enabled():
+            _epilogue.note_batch_norm(results[0], slots, call_attrs)
 
     if out is not None:
         if len(results) != 1:

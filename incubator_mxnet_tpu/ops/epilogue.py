@@ -1,0 +1,117 @@
+"""Fused-epilogue rewrite: conv→BN→ReLU(→residual-add) chains to one
+Pallas pass (MXTPU_FUSED_EPILOGUE).
+
+The gluon frontend executes ops eagerly-within-trace (no whole-graph HLO
+pass to hook), so the chain is matched at op-dispatch time instead:
+BatchNorm dispatches record lightweight provenance on their output
+NDArray, residual adds propagate it, and a ReLU Activation dispatch whose
+input carries BN provenance re-emits the chain as ONE
+`pallas_kernels.bn_act_epilogue` call — the BN affine folded to
+per-channel scale/shift applied to the conv accumulator, the activation,
+and the residual add in a single HBM read+write.
+
+The ALREADY-dispatched unfused BN/add outputs are left in place: inside a
+jit trace they become dead code the moment the relu consumes the fused
+value instead, so XLA's DCE removes them and the rewrite costs nothing
+extra in the compiled program (the batch-moment reductions the scale/shift
+need unify with the BN's own via CSE). Provenance is only recorded while
+tracing (the output wraps a jax Tracer) AND the knob is on, so with
+`MXTPU_FUSED_EPILOGUE=0` — the default — every dispatch takes the
+identical code path and the compiled program is bit-for-bit today's.
+
+Only channels-last (axis == ndim-1) BatchNorm in f32-or-narrower dtypes
+is rewritten: the kernel tiles (rows, C) with C on lanes, and its math is
+f32 (a float64 net keeps f64 stats and must stay on the XLA path).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import config
+
+__all__ = ["enabled", "note_batch_norm", "note_add", "maybe_rewrite_relu",
+           "rewrites_applied"]
+
+# trace-time count of chains actually re-emitted through the kernel;
+# tests and the perf-structure CI tier assert on it (reset per check)
+rewrites_applied = 0
+
+
+def enabled():
+    return config.get("MXTPU_FUSED_EPILOGUE")
+
+
+def _tracing(nd):
+    return isinstance(nd._data, jax.core.Tracer)
+
+
+def note_batch_norm(out_nd, slots, call_attrs):
+    """Record BN provenance on the primary output (called from the eager
+    dispatcher after a BatchNorm op ran, knob already checked)."""
+    if not _tracing(out_nd):
+        return
+    data, gamma, beta, mmean, mvar = (s._data if s is not None else None
+                                      for s in slots[:5])
+    if data is None or gamma is None or beta is None:
+        return
+    out_nd._epi_prov = ("bn", (data, gamma, beta, mmean, mvar),
+                        dict(call_attrs))
+
+
+def note_add(out_nd, a_nd, b_nd):
+    """Propagate provenance through a residual add: if either operand is a
+    BN output of the same shape, the add is a candidate residual join."""
+    if not _tracing(out_nd) or not enabled():
+        return
+    for bn, other in ((a_nd, b_nd), (b_nd, a_nd)):
+        prov = getattr(bn, "_epi_prov", None)
+        if (prov is not None and prov[0] == "bn"
+                and bn._data.shape == other._data.shape):
+            out_nd._epi_prov = ("add", prov, other._data)
+            return
+
+
+def maybe_rewrite_relu(data_nd):
+    """Attempt the fused re-emit for relu(data). Returns the fused jnp
+    value, or None when the chain does not match."""
+    prov = getattr(data_nd, "_epi_prov", None)
+    if prov is None:
+        return None
+    if prov[0] == "bn":
+        return _emit(prov[1], prov[2], None)
+    if prov[0] == "add":
+        return _emit(prov[1][1], prov[1][2], prov[2])
+    return None
+
+
+def _emit(bn_inputs, attrs, residual):
+    data, gamma, beta, mmean, mvar = bn_inputs
+    axis = attrs.get("axis", 1) % data.ndim
+    if axis != data.ndim - 1:
+        return None  # kernel is channels-last only
+    stat_dt = jnp.promote_types(data.dtype, jnp.float32)
+    if stat_dt != jnp.float32:
+        return None  # f64 nets keep f64 stats on the XLA path
+    eps = attrs.get("eps", 1e-3)
+    g = jnp.ones_like(gamma) if attrs.get("fix_gamma", True) else gamma
+    if attrs.get("_training", False) and not attrs.get("use_global_stats",
+                                                       False):
+        # same batch moments the BN computed — CSE unifies the reductions
+        reduce_axes = tuple(range(data.ndim - 1))
+        xf = data.astype(stat_dt)
+        mean = jnp.mean(xf, axis=reduce_axes)
+        var = jnp.var(xf, axis=reduce_axes)
+    else:
+        mean = mmean.astype(stat_dt)
+        var = mvar.astype(stat_dt)
+    scale = g.astype(stat_dt) * lax.rsqrt(var + eps)
+    shift = beta.astype(stat_dt) - mean * scale
+    from . import pallas_kernels
+
+    out = pallas_kernels.bn_act_epilogue(data, scale, shift,
+                                         residual=residual)
+    global rewrites_applied
+    rewrites_applied += 1
+    return out

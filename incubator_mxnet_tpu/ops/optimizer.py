@@ -41,14 +41,16 @@ def _one_minus_pow(beta, t):
     return -jnp.expm1(jnp.asarray(t, jnp.float32) * math.log(beta))
 
 
-@register("sgd_update", no_grad_inputs=("weight", "grad"))
+@register("sgd_update", no_grad_inputs=("weight", "grad"),
+          donate=('weight',))
 def sgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
     """SGD step: weight -= lr * (rescaled, clipped grad + wd * weight)."""
     g = _rescale_clip(grad, rescale_grad, clip_gradient)
     return weight - lr * (g + wd * weight)
 
 
-@register("sgd_mom_update", num_outputs=2, no_grad_inputs=("weight", "grad", "mom"))
+@register("sgd_mom_update", num_outputs=2, no_grad_inputs=("weight", "grad", "mom"),
+          donate=('weight', 'mom'))
 def sgd_mom_update(
     weight, grad, mom, *, lr, momentum=0.0, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True
 ):
@@ -58,7 +60,8 @@ def sgd_mom_update(
     return weight + new_mom, new_mom
 
 
-@register("nag_mom_update", num_outputs=2, no_grad_inputs=("weight", "grad", "mom"))
+@register("nag_mom_update", num_outputs=2, no_grad_inputs=("weight", "grad", "mom"),
+          donate=('weight', 'mom'))
 def nag_mom_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
     """Nesterov accelerated SGD step (gradient looked ahead through momentum)."""
     g = _rescale_clip(grad, rescale_grad, clip_gradient) + wd * weight
@@ -66,7 +69,8 @@ def nag_mom_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0, rescale_grad=
     return weight - lr * (g + momentum * new_mom), new_mom
 
 
-@register("adam_update", num_outputs=3, no_grad_inputs=("weight", "grad", "mean", "var"))
+@register("adam_update", num_outputs=3, no_grad_inputs=("weight", "grad", "mean", "var"),
+          donate=('weight', 'mean', 'var'))
 def adam_update(
     weight, grad, mean, var, *, lr, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
     rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True,
@@ -78,7 +82,8 @@ def adam_update(
     return weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon), new_mean, new_var
 
 
-@register("rmsprop_update", num_outputs=2, no_grad_inputs=("weight", "grad", "n"))
+@register("rmsprop_update", num_outputs=2, no_grad_inputs=("weight", "grad", "n"),
+          donate=('weight', 'n'))
 def rmsprop_update(
     weight, grad, n, *, lr, gamma1=0.95, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
     clip_gradient=-1.0, clip_weights=-1.0,
@@ -92,7 +97,8 @@ def rmsprop_update(
     return new_w, new_n
 
 
-@register("rmspropalex_update", num_outputs=4, no_grad_inputs=("weight", "grad", "n", "g", "delta"))
+@register("rmspropalex_update", num_outputs=4, no_grad_inputs=("weight", "grad", "n", "g", "delta"),
+          donate=('weight', 'n', 'g', 'delta'))
 def rmspropalex_update(
     weight, grad, n, g, delta, *, lr, gamma1=0.95, gamma2=0.9, epsilon=1e-8, wd=0.0,
     rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0,
@@ -109,7 +115,8 @@ def rmspropalex_update(
     return new_w, new_n, new_g, new_delta
 
 
-@register("ftrl_update", num_outputs=3, no_grad_inputs=("weight", "grad", "z", "n"))
+@register("ftrl_update", num_outputs=3, no_grad_inputs=("weight", "grad", "z", "n"),
+          donate=('weight', 'z', 'n'))
 def ftrl_update(
     weight, grad, z, n, *, lr, lamda1=0.01, beta=1.0, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0
 ):
@@ -126,14 +133,16 @@ def ftrl_update(
     return new_w, new_z, new_n
 
 
-@register("signsgd_update", no_grad_inputs=("weight", "grad"))
+@register("signsgd_update", no_grad_inputs=("weight", "grad"),
+          donate=('weight',))
 def signsgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
     """SignSGD step: weight -= lr * sign(grad)."""
     g = _rescale_clip(grad, rescale_grad, clip_gradient)
     return weight - lr * (jnp.sign(g) + wd * weight)
 
 
-@register("signum_update", num_outputs=2, no_grad_inputs=("weight", "grad", "mom"))
+@register("signum_update", num_outputs=2, no_grad_inputs=("weight", "grad", "mom"),
+          donate=('weight', 'mom'))
 def signum_update(
     weight, grad, mom, *, lr, momentum=0.0, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0
 ):
@@ -144,7 +153,8 @@ def signum_update(
     return new_w, new_mom
 
 
-@register("ftml_update", num_outputs=4, no_grad_inputs=("weight", "grad", "d", "v", "z"))
+@register("ftml_update", num_outputs=4, no_grad_inputs=("weight", "grad", "d", "v", "z"),
+          donate=('weight', 'd', 'v', 'z'))
 def ftml_update(
     weight, grad, d, v, z, *, lr, beta1=0.6, beta2=0.999, epsilon=1e-8, wd=0.0,
     rescale_grad=1.0, clip_grad=-1.0, t=1,
@@ -160,7 +170,8 @@ def ftml_update(
     return new_w, d_t, new_v, new_z
 
 
-@register("adamw_update", num_outputs=3, no_grad_inputs=("weight", "grad", "mean", "var"))
+@register("adamw_update", num_outputs=3, no_grad_inputs=("weight", "grad", "mean", "var"),
+          donate=('weight', 'mean', 'var'))
 def adamw_update(
     weight, grad, mean, var, *, lr, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
     rescale_grad=1.0, clip_gradient=-1.0,
@@ -290,7 +301,8 @@ def multi_mp_sgd_mom_update(*args, lrs, wds, num_weights, momentum=0.0,
 
 
 @register("mp_sgd_update", num_outputs=2,
-          no_grad_inputs=("weight", "grad", "weight32"))
+          no_grad_inputs=("weight", "grad", "weight32"),
+          donate=('weight', 'weight32'))
 def mp_sgd_update(weight, grad, weight32, *, lr, wd=0.0, rescale_grad=1.0,
                   clip_gradient=-1.0, lazy_update=True):
     """Mixed-precision SGD: math on the fp32 master copy, low-precision
@@ -302,7 +314,8 @@ def mp_sgd_update(weight, grad, weight32, *, lr, wd=0.0, rescale_grad=1.0,
 
 
 @register("mp_sgd_mom_update", num_outputs=3,
-          no_grad_inputs=("weight", "grad", "mom", "weight32"))
+          no_grad_inputs=("weight", "grad", "mom", "weight32"),
+          donate=('weight', 'mom', 'weight32'))
 def mp_sgd_mom_update(weight, grad, mom, weight32, *, lr, momentum=0.0,
                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                       lazy_update=True):
@@ -314,7 +327,8 @@ def mp_sgd_mom_update(weight, grad, mom, weight32, *, lr, momentum=0.0,
 
 
 @register("_adamw_update", num_outputs=3,
-          no_grad_inputs=("weight", "grad", "mean", "var", "rescale_grad"))
+          no_grad_inputs=("weight", "grad", "mean", "var", "rescale_grad"),
+          donate=('weight', 'mean', 'var'))
 def _adamw_update_dyn(weight, grad, mean, var, rescale_grad, *, lr,
                       beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
                       clip_gradient=-1.0):
@@ -335,7 +349,8 @@ def _adamw_update_dyn(weight, grad, mean, var, rescale_grad, *, lr,
 
 @register("_mp_adamw_update", num_outputs=4,
           no_grad_inputs=("weight", "grad", "mean", "var", "weight32",
-                          "rescale_grad"))
+                          "rescale_grad"),
+          donate=('weight', 'mean', 'var', 'weight32'))
 def _mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad, *, lr,
                      beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
                      clip_gradient=-1.0):
@@ -348,7 +363,8 @@ def _mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad, *, lr,
 
 
 @register("_contrib_group_adagrad_update", num_outputs=2,
-          no_grad_inputs=("weight", "grad", "history"))
+          no_grad_inputs=("weight", "grad", "history"),
+          donate=('weight', 'history'))
 def _contrib_group_adagrad_update(weight, grad, history, *, lr,
                                   rescale_grad=1.0, clip_gradient=-1.0,
                                   epsilon=1e-5):

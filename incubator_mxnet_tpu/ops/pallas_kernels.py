@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 __all__ = ["flash_attention", "softmax_xent", "flash_decode",
-           "dense_decode_attention"]
+           "dense_decode_attention", "bn_act_epilogue"]
 
 _NEG_INF = -1e30
 
@@ -460,6 +460,180 @@ def dense_decode_attention(q, k_cache, v_cache, n_valid):
     s = jnp.where((jnp.arange(T) < n_valid)[None, None], s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bht,bthd->bhd", p, v_cache)
+
+
+def _epilogue_fwd_kernel(x_ref, scale_ref, shift_ref, o_ref):
+    """y = relu(x*scale + shift) for one (block_r, C) tile, f32 math."""
+    x = x_ref[...].astype(jnp.float32)
+    y = x * scale_ref[...] + shift_ref[...]
+    o_ref[...] = jnp.maximum(y, 0.0).astype(o_ref.dtype)
+
+
+def _epilogue_res_fwd_kernel(x_ref, scale_ref, shift_ref, r_ref, o_ref):
+    """y = relu(x*scale + shift + residual) in one tile pass."""
+    x = x_ref[...].astype(jnp.float32)
+    y = x * scale_ref[...] + shift_ref[...] + r_ref[...].astype(jnp.float32)
+    o_ref[...] = jnp.maximum(y, 0.0).astype(o_ref.dtype)
+
+
+def _epilogue_bwd_kernel(x_ref, scale_ref, y_ref, dy_ref,
+                         dx_ref, dscale_ref, dshift_ref, *, block_r, rows,
+                         dres_ref=None):
+    """Backward tile: mask from y>0 (no pre-activation tensor saved),
+    dx = dy*mask*scale, channel sums dscale/dshift ACCUMULATE across the
+    sequential TPU grid into one revisited (1, C) block (zeroed at i==0).
+    The final row block may be ragged (cdiv grid): rows beyond `rows` are
+    masked out of both dx and the channel sums."""
+    i = pl.program_id(0)
+    x = x_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    row = i * block_r + jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    live = (row < rows) & (y_ref[...].astype(jnp.float32) > 0.0)
+    g = jnp.where(live, dy, 0.0)
+    # x must be masked too: the padded tail of a ragged block reads as
+    # NaN in interpret mode, and 0 * NaN poisons the channel sums
+    x = jnp.where(live, x, 0.0)
+    dx_ref[...] = (g * scale_ref[...]).astype(dx_ref.dtype)
+    if dres_ref is not None:
+        dres_ref[...] = g.astype(dres_ref.dtype)
+
+    @pl.when(i == 0)
+    def _zero():
+        dscale_ref[...] = jnp.zeros_like(dscale_ref)
+        dshift_ref[...] = jnp.zeros_like(dshift_ref)
+
+    dscale_ref[...] += jnp.sum(g * x, axis=0, keepdims=True)
+    dshift_ref[...] += jnp.sum(g, axis=0, keepdims=True)
+
+
+def _epilogue_fwd_call(x, scale, shift, residual, block_r, interpret):
+    r, c = x.shape
+    grid = (pl.cdiv(r, block_r),)
+    row_spec = pl.BlockSpec((block_r, c), lambda i: (i, 0))
+    chan_spec = pl.BlockSpec((1, c), lambda i: (0, 0))
+    if residual is None:
+        return pl.pallas_call(
+            _epilogue_fwd_kernel,
+            grid=grid,
+            in_specs=[row_spec, chan_spec, chan_spec],
+            out_specs=row_spec,
+            out_shape=jax.ShapeDtypeStruct((r, c), x.dtype),
+            interpret=interpret,
+        )(x, scale, shift)
+    return pl.pallas_call(
+        _epilogue_res_fwd_kernel,
+        grid=grid,
+        in_specs=[row_spec, chan_spec, chan_spec, row_spec],
+        out_specs=row_spec,
+        out_shape=jax.ShapeDtypeStruct((r, c), x.dtype),
+        interpret=interpret,
+    )(x, scale, shift, residual)
+
+
+def _epilogue_bwd_call(x, scale, y, dy, with_res, block_r, interpret):
+    r, c = x.shape
+    grid = (pl.cdiv(r, block_r),)
+    row_spec = pl.BlockSpec((block_r, c), lambda i: (i, 0))
+    chan_spec = pl.BlockSpec((1, c), lambda i: (0, 0))
+    kernel = functools.partial(_epilogue_bwd_kernel, block_r=block_r, rows=r)
+    if with_res:
+        # dres rides as a 4th output; wrap so it lands after dshift in the
+        # positional out_refs yet reaches the kernel as a keyword
+        def kernel(x_ref, scale_ref, y_ref, dy_ref, dx_ref, dscale_ref,
+                   dshift_ref, dres_ref):
+            _epilogue_bwd_kernel(x_ref, scale_ref, y_ref, dy_ref, dx_ref,
+                                 dscale_ref, dshift_ref, block_r=block_r,
+                                 rows=r, dres_ref=dres_ref)
+    out_specs = [row_spec, chan_spec, chan_spec]
+    out_shape = [
+        jax.ShapeDtypeStruct((r, c), x.dtype),
+        jax.ShapeDtypeStruct((1, c), jnp.float32),
+        jax.ShapeDtypeStruct((1, c), jnp.float32),
+    ]
+    if with_res:
+        out_specs.append(row_spec)
+        out_shape.append(jax.ShapeDtypeStruct((r, c), dy.dtype))
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[row_spec, chan_spec, row_spec, row_spec],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(x, scale, y, dy)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _epi_plain(x, scale, shift, block_r, interpret):
+    return _epilogue_fwd_call(x, scale, shift, None, block_r, interpret)
+
+
+def _epi_plain_fwd(x, scale, shift, block_r, interpret):
+    y = _epilogue_fwd_call(x, scale, shift, None, block_r, interpret)
+    return y, (x, scale, y)
+
+
+def _epi_plain_bwd(block_r, interpret, res, dy):
+    x, scale, y = res
+    dx, dscale, dshift = _epilogue_bwd_call(x, scale, y, dy, False, block_r,
+                                            interpret)
+    return dx, dscale, dshift  # scale/shift primals are (1, C)
+
+
+_epi_plain.defvjp(_epi_plain_fwd, _epi_plain_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _epi_res(x, scale, shift, residual, block_r, interpret):
+    return _epilogue_fwd_call(x, scale, shift, residual, block_r, interpret)
+
+
+def _epi_res_fwd(x, scale, shift, residual, block_r, interpret):
+    y = _epilogue_fwd_call(x, scale, shift, residual, block_r, interpret)
+    # the residual itself is NOT saved: its gradient is dy*mask, and the
+    # mask regenerates from y
+    return y, (x, scale, y)
+
+
+def _epi_res_bwd(block_r, interpret, res, dy):
+    x, scale, y = res
+    dx, dscale, dshift, dres = _epilogue_bwd_call(x, scale, y, dy, True,
+                                                  block_r, interpret)
+    return dx, dscale, dshift, dres
+
+
+_epi_res.defvjp(_epi_res_fwd, _epi_res_bwd)
+
+
+def bn_act_epilogue(x, scale, shift, residual=None, block_rows=256,
+                    interpret=None):
+    """Fused conv/matmul epilogue: relu(x*scale + shift [+ residual]) on a
+    channels-last accumulator in ONE HBM pass, with a custom-VJP backward.
+
+    x: (..., C) — typically an NHWC conv output; scale/shift: (C,) — the
+    BN affine folded to per-channel scale = gamma*rsqrt(var+eps) and
+    shift = beta - mean*scale; residual: same shape as x or None. Math in
+    f32, output in x.dtype. The backward recomputes the ReLU mask from
+    the saved OUTPUT (y > 0), so no pre-activation tensor is kept:
+    dx = dy*mask*scale, dresidual = dy*mask, dscale = Σ dy*mask*x,
+    dshift = Σ dy*mask (channel sums accumulated across the sequential
+    grid). This is the HBM-traffic lever MXTPU_FUSED_EPILOGUE arms: the
+    BN-normalize + ReLU + residual-add chain reads and writes the
+    activation tensor once instead of once per op."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    c = x.shape[-1]
+    flat = x.reshape(-1, c)
+    r = flat.shape[0]
+    block_r = min(block_rows, r)
+    scale2 = jnp.asarray(scale, jnp.float32).reshape(1, c)
+    shift2 = jnp.asarray(shift, jnp.float32).reshape(1, c)
+    if residual is None:
+        y = _epi_plain(flat, scale2, shift2, block_r, interpret)
+    else:
+        y = _epi_res(flat, scale2, shift2, residual.reshape(-1, c), block_r,
+                     interpret)
+    return y.reshape(x.shape)
 
 
 def flash_decode(q, k_cache, v_cache, n_valid, block_k=128, interpret=None):

@@ -51,6 +51,12 @@ class OpDef:
     attrs: dict = field(default_factory=dict)  # attr name -> default
     aliases: Sequence[str] = ()
     no_grad_inputs: Sequence[str] = ()  # integer-like inputs w/o gradients
+    # inputs whose buffers the op semantically CONSUMES (in-place update
+    # contract: the caller rebinds them to the op's outputs — optimizer
+    # weight/state updates). The jitted eager dispatch donates these to
+    # XLA off-CPU so the update writes in place instead of allocating a
+    # second copy of every parameter (ref: MXNET_EXEC_ENABLE_INPLACE).
+    donate: Sequence[str] = ()
 
     @property
     def attr_names(self):
@@ -67,6 +73,7 @@ def register(
     optional=(),
     aliases=(),
     no_grad_inputs=(),
+    donate=(),
 ):
     """Decorator registering a pure function as an operator."""
 
@@ -98,6 +105,7 @@ def register(
             attrs=attrs,
             aliases=tuple(aliases),
             no_grad_inputs=tuple(no_grad_inputs),
+            donate=tuple(donate),
         )
         OP_REGISTRY[name] = opdef
         for a in aliases:

@@ -14,6 +14,7 @@ import pickle
 import numpy as np
 import jax.numpy as jnp
 
+from . import config
 from . import random as _global_random
 from .ndarray import register as _ndreg
 from .ndarray.ndarray import NDArray
@@ -189,20 +190,42 @@ def _sparse_grad_prep(opt, grad):
 class SGD(Optimizer):
     """(ref: optimizer.py:511 SGD, with momentum + multi-precision)"""
 
-    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+    def __init__(self, momentum=0.0, lazy_update=True,
+                 stochastic_rounding=None, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
         self.lazy_update = lazy_update
+        if stochastic_rounding is None:
+            stochastic_rounding = config.get("MXTPU_STOCHASTIC_ROUNDING")
+        self.stochastic_rounding = bool(stochastic_rounding)
 
     def create_state(self, index, weight):
         if self.momentum != 0.0:
             return zeros(weight.shape, dtype=str(weight.dtype))
         return None
 
+    def _sr_active(self, weight):
+        """Master-free stochastic-rounding path applies to plain SGD on
+        bfloat16 weights only: f16's 10-bit mantissa needs loss scaling on
+        top, and SGD subclasses (LBSGD) have their own update math that
+        does not know the SR contract."""
+        return (type(self) is SGD and self.stochastic_rounding
+                and str(weight.dtype) == "bfloat16")
+
     def create_state_multi_precision(self, index, weight):
         """(mom_or_None, fp32 master weight) for low-precision weights when
         multi_precision is set (ref: optimizer.py SGD.create_state_multi_precision
-        — momentum is created in the master dtype)."""
+        — momentum is created in the master dtype).
+
+        Under MXTPU_STOCHASTIC_ROUNDING a bf16 weight instead gets the
+        master-FREE variant: f32 momentum only, no w32 copy — the update
+        computes in f32 and stochastically rounds the new weight back to
+        bf16, cutting the optimizer's resident f32 bytes to ~1/2 (momentum
+        only) and its HBM traffic per step accordingly."""
+        if self._sr_active(weight):
+            if self.momentum != 0.0:
+                return zeros(weight.shape, dtype="float32")
+            return None
         if self.multi_precision and str(weight.dtype) in ("float16", "bfloat16"):
             w32 = NDArray(weight._data.astype(jnp.float32))
             return (self.create_state(index, w32), w32)
@@ -210,6 +233,9 @@ class SGD(Optimizer):
 
     def update_multi_precision(self, index, weight, grad, state):
         if not isinstance(state, tuple):
+            if self._sr_active(weight):
+                self._sr_update(index, weight, grad, state)
+                return
             self.update(index, weight, grad, state)
             return
         # mp state from create_state_multi_precision: math on the fp32
@@ -253,6 +279,24 @@ class SGD(Optimizer):
                                               {**attrs, "momentum": self.momentum}))
         else:
             _writeback([weight], _call("sgd_update", [weight, grad], attrs))
+
+    def _sr_update(self, index, weight, grad, state):
+        """Eager master-free bf16 step: same _sgd_sr_math + (seed, t, name)
+        key derivation as the fused/aggregated paths, so all three produce
+        identical weights for identical schedules (fused_matches_eager
+        holds)."""
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        name = self.idx2name.get(index, index)
+        if _is_row_sparse(grad):
+            grad = grad.todense()  # SR path has no lazy row-sparse variant
+        new_w, new_m = _sgd_sr_math(
+            self, weight._data, grad._data,
+            state._data if state is not None else None, lr, wd, t, name)
+        weight._data = new_w
+        if state is not None and new_m is not None:
+            state._data = new_m
 
 
 @register
@@ -692,6 +736,64 @@ def get_updater(optimizer):
 # ---------------------------------------------------------------------------
 
 
+def _stochastic_round_bf16(x32, key):
+    """Round f32 to bf16 with probability proportional to the distance to
+    each neighboring bf16 value, so the rounding error is zero-mean and
+    small updates (below bf16's ~2^-8 relative resolution) accumulate in
+    expectation instead of being silently dropped by round-to-nearest.
+
+    Bit trick: bf16 is the top 16 bits of f32, so adding a uniform 16-bit
+    integer to the f32 bit pattern and truncating the low half rounds up
+    with exactly the right probability; values already representable in
+    bf16 (low bits zero) are never changed. Non-finite inputs pass through
+    untouched — the integer walk would corrupt inf/nan payloads."""
+    import jax
+    from jax import lax
+
+    bits = jax.random.bits(key, x32.shape, jnp.uint32) & jnp.uint32(0xFFFF)
+    u = lax.bitcast_convert_type(x32, jnp.uint32)
+    r = (u + bits) & jnp.uint32(0xFFFF0000)
+    out = lax.bitcast_convert_type(r, jnp.float32)
+    out = jnp.where(jnp.isfinite(x32), out, x32)
+    return out.astype(jnp.bfloat16)
+
+
+def _sr_key(opt, t, name):
+    """Deterministic per-(step, param) PRNG key — the SGLD fused-noise
+    idiom, shared verbatim by the eager, aggregated, and fused SR paths so
+    their rounding draws (and therefore their weights) agree exactly."""
+    import binascii
+
+    import jax
+
+    key = jax.random.PRNGKey(getattr(opt, "fused_seed", 0))
+    key = jax.random.fold_in(key, jnp.asarray(t, jnp.int32))
+    key = jax.random.fold_in(key, binascii.crc32(str(name).encode()) & 0x7FFFFFFF)
+    return key
+
+
+def _sgd_sr_math(opt, weight, grad, state, lr, wd, t, name):
+    """Master-free bf16 SGD step (MXTPU_STOCHASTIC_ROUNDING): all math in
+    f32 (momentum IS f32 — create_state_multi_precision / the fused-state
+    hook allocate it that way), new weight stochastically rounded back to
+    bf16. Versus the (mom, w32-master) mp state this halves the resident
+    f32 bytes and removes the master read+write from every step's HBM
+    traffic; the unbiased rounding is what keeps convergence within
+    tolerance of the f32-master baseline."""
+    w32 = weight.astype(jnp.float32)
+    g = grad.astype(jnp.float32) * opt.rescale_grad
+    if opt.clip_gradient:
+        g = jnp.clip(g, -opt.clip_gradient, opt.clip_gradient)
+    g = g + wd * w32
+    if opt.momentum != 0.0 and state is not None:
+        new_mom = opt.momentum * state - lr * g
+        new_w32 = w32 + new_mom
+    else:
+        new_mom = state
+        new_w32 = w32 - lr * g
+    return _stochastic_round_bf16(new_w32, _sr_key(opt, t, name)), new_mom
+
+
 def _sgd_fused(self, name, weight, grad, state, lr, t=None):
     if isinstance(state, tuple):
         # multi-precision state (mom_or_None, fp32 master) from
@@ -710,6 +812,10 @@ def _sgd_fused(self, name, weight, grad, state, lr, t=None):
             weight, grad, w32, lr=lr, wd=wd,
             rescale_grad=self.rescale_grad, clip_gradient=clip)
         return w2, (None, w322)
+    if self._sr_active(weight):
+        lr, wd = _mults(self, name, lr)
+        return _sgd_sr_math(self, weight, grad, state, lr, wd,
+                            _t_or_eager(self, t), name)
     g = grad * self.rescale_grad
     if self.clip_gradient:
         g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
@@ -723,6 +829,21 @@ def _sgd_fused(self, name, weight, grad, state, lr, t=None):
 
 SGD.fused_update = _sgd_fused
 # (LBSGD gets its own LARS-aware fused hook below)
+
+
+def _sgd_create_fused_state(self, index, weight):
+    """Fused-path state: f32 momentum when stochastic rounding is active
+    on a bf16 weight (the scanned carry keeps the accumulator in full
+    precision; _cast_state_like then preserves f32 across steps).
+    Otherwise identical to create_state."""
+    if self._sr_active(weight):
+        if self.momentum != 0.0:
+            return zeros(weight.shape, dtype="float32")
+        return None
+    return self.create_state(index, weight)
+
+
+SGD.create_fused_state = _sgd_create_fused_state
 
 
 def _nag_fused(self, name, weight, grad, state, lr, t=None):

@@ -18,8 +18,13 @@ which backend executed the compile), then:
 
 Usage:
   python tools/perf_analysis.py [--batch 128] [--scan 8] [--image 224]
+                                [--remat-policy dots_no_batch]
+                                [--fused-epilogue] [--stochastic-rounding]
+                                [--assert-structure]
                                 [--report docs/PERF_ANALYSIS.md]
 Writes the report only with --report; always prints the JSON summary.
+--assert-structure exits non-zero when the structural invariants the TPU
+mapping relies on are violated (the CI perf-structure tier's gate).
 """
 import argparse
 import collections
@@ -38,11 +43,18 @@ V5E_HBM_BW = 819e9
 FWD_FLOPS_224 = 4.09e9  # ResNet-50 fwd GFLOPs/img at 224^2 (standard count)
 
 
-def build_and_compile(batch, image, scan_k):
+def build_and_compile(batch, image, scan_k, remat_policy="",
+                      fused_epilogue=False, stochastic_rounding=False):
     # hard-force the CPU backend: the axon TPU plugin ignores JAX_PLATFORMS
     # and a down tunnel would hang jax init (this is an offline analysis)
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jaxcache")
+    # the HBM-traffic levers under analysis (docs/PERF_ANALYSIS.md §0) —
+    # set before the framework import so config.get sees them everywhere
+    os.environ["MXTPU_REMAT_POLICY"] = remat_policy or ""
+    os.environ["MXTPU_FUSED_EPILOGUE"] = "1" if fused_epilogue else "0"
+    os.environ["MXTPU_STOCHASTIC_ROUNDING"] = (
+        "1" if stochastic_rounding else "0")
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -61,6 +73,9 @@ def build_and_compile(batch, image, scan_k):
                            rescale_grad=1.0 / batch)
     step = fused.GluonTrainStep(net, lambda n, x, y: L(n(x), y), opt)
 
+    from incubator_mxnet_tpu.ops import epilogue
+
+    epilogue.rewrites_applied = 0
     shape = (batch, image, image, 3)
     x0 = nd.from_jax(jnp.zeros(shape, jnp.bfloat16))
     y0 = nd.from_jax(jnp.zeros((batch,), jnp.float32))
@@ -80,7 +95,72 @@ def build_and_compile(batch, image, scan_k):
     stablehlo = lowered.as_text()
     compiled = lowered.compile()
     compile_s = time.time() - t0
-    return compiled, stablehlo, compile_s
+    return compiled, stablehlo, compile_s, epilogue.rewrites_applied
+
+
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2,
+                "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+                "f64": 8, "c64": 8, "c128": 16}
+
+
+def _shape_bytes(sig):
+    """Total bytes of every `dtype[d0,d1,...]` shape in an HLO signature
+    fragment (parameter list or result type; tuple results included)."""
+    total = 0
+    for dt, dims in re.findall(r"(\w+)\[([\d,]*)\]", sig):
+        if dt not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dt]
+    return total
+
+
+def fusion_bytes_breakdown(hlo_text, top_k=8):
+    """Per-fusion HBM-traffic proxy: each fused computation touches HBM
+    exactly through its parameters (reads) and root (write), so its
+    header signature IS its bytes_accessed up to layout padding. Returns
+    (total_bytes, [[name, bytes] descending top_k])."""
+    per = []
+    for m in re.finditer(
+            r"^(%fused_computation[\w.\-]*)\s*\(([^)]*)\)\s*->\s*(.+?)\s*\{",
+            hlo_text, re.M):
+        per.append([m.group(1),
+                    _shape_bytes(m.group(2)) + _shape_bytes(m.group(3))])
+    per.sort(key=lambda kv: -kv[1])
+    return sum(b for _, b in per), per[:top_k]
+
+
+def count_unfused_elementwise(hlo_text):
+    """Elementwise producers living OUTSIDE any fused computation — each
+    one is a standalone kernel making a full HBM round trip that epilogue
+    fusion should have absorbed. Returned per result dtype (`bf16` is the
+    hot-path count the CI tier watches; the CPU backend's f32 upcasts land
+    under `f32`)."""
+    counts = collections.Counter()
+    in_fused = False
+    for ln in hlo_text.splitlines():
+        s = ln.strip()
+        if ln.startswith("%fused_computation"):
+            in_fused = True
+            continue
+        if (ln.startswith("ENTRY") or
+                (ln.startswith("%") and ln.rstrip().endswith("{"))):
+            in_fused = False
+            continue
+        if ln.startswith("}"):
+            in_fused = False
+            continue
+        if in_fused:
+            continue
+        m = re.search(
+            r"= (\w+)\[[^\]]*\]\S* (?:add|multiply|maximum|subtract|divide)\(",
+            s)
+        if m:
+            counts[m.group(1)] += 1
+    return dict(counts)
 
 
 def analyze_program(stablehlo, hlo_text):
@@ -115,6 +195,8 @@ def analyze_program(stablehlo, hlo_text):
             if re.search(r"= \w+\[[^\]]*\] (add|multiply|maximum|subtract)\(",
                          ln):
                 loose_elem += 1
+    fus_total, fus_top = fusion_bytes_breakdown(hlo_text)
+    unfused = count_unfused_elementwise(hlo_text)
     return {
         "convolutions": len(conv_lines),
         "conv_dtypes": dict(conv_dtypes),
@@ -122,6 +204,10 @@ def analyze_program(stablehlo, hlo_text):
         "fusions": fusions,
         "while_loops": whiles,
         "entry_loose_elementwise": loose_elem,
+        "fusion_bytes_total": fus_total,
+        "fusion_bytes_top": fus_top,
+        "unfused_elementwise_by_dtype": unfused,
+        "unfused_bf16_elementwise": unfused.get("bf16", 0),
     }
 
 
@@ -130,11 +216,25 @@ def main():
     ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--image", type=int, default=224)
     ap.add_argument("--scan", type=int, default=8)
+    ap.add_argument("--remat-policy", default="",
+                    help="MXTPU_REMAT_POLICY tier for the compiled program")
+    ap.add_argument("--fused-epilogue", action="store_true",
+                    help="compile with MXTPU_FUSED_EPILOGUE=1")
+    ap.add_argument("--stochastic-rounding", action="store_true",
+                    help="compile with MXTPU_STOCHASTIC_ROUNDING=1")
+    ap.add_argument("--assert-structure", action="store_true",
+                    help="fail when structural invariants are violated")
+    ap.add_argument("--max-unfused-bf16", type=int, default=None,
+                    help="with --assert-structure: ceiling on standalone "
+                         "bf16 elementwise producers")
     ap.add_argument("--report", default=None)
     args = ap.parse_args()
 
-    compiled, stablehlo, compile_s = build_and_compile(
-        args.batch, args.image, args.scan)
+    compiled, stablehlo, compile_s, epi_rewrites = build_and_compile(
+        args.batch, args.image, args.scan,
+        remat_policy=args.remat_policy,
+        fused_epilogue=args.fused_epilogue,
+        stochastic_rounding=args.stochastic_rounding)
     ca = compiled.cost_analysis()
     if isinstance(ca, list):  # older jax returns [dict]
         ca = ca[0]
@@ -174,6 +274,10 @@ def main():
 
     out = {
         "batch": args.batch, "image": args.image, "scan_k": args.scan,
+        "remat_policy": args.remat_policy,
+        "fused_epilogue": bool(args.fused_epilogue),
+        "stochastic_rounding": bool(args.stochastic_rounding),
+        "epilogue_rewrites": epi_rewrites,
         "compile_s": round(compile_s, 1),
         "xla_flops_per_step": flops,
         "xla_bytes_per_step_cpu_module": bytes_acc,
@@ -194,6 +298,34 @@ def main():
     print(json.dumps(out))
     if args.report:
         write_report(out, args.report)
+
+    if args.assert_structure:
+        errs = []
+        if set(struct["conv_dtypes"]) != {"bf16"}:
+            errs.append(f"non-bf16 convolutions: {struct['conv_dtypes']}")
+        if struct["entry_loose_elementwise"] != 0:
+            errs.append(f"{struct['entry_loose_elementwise']} free-standing "
+                        "elementwise ops at entry scope")
+        if struct["while_loops"] < 1:
+            errs.append("scan did not lower to a while loop")
+        if struct["fusions"] <= 0:
+            errs.append("no fusion computations in the optimized module")
+        if args.fused_epilogue and epi_rewrites <= 0:
+            errs.append("MXTPU_FUSED_EPILOGUE=1 but zero epilogue rewrites "
+                        "applied (pattern match is dead)")
+        if not args.fused_epilogue and epi_rewrites != 0:
+            errs.append(f"knob off but {epi_rewrites} epilogue rewrites "
+                        "applied — the off path is no longer untouched")
+        if (args.max_unfused_bf16 is not None
+                and struct["unfused_bf16_elementwise"] > args.max_unfused_bf16):
+            errs.append(
+                f"{struct['unfused_bf16_elementwise']} standalone bf16 "
+                f"elementwise producers (ceiling {args.max_unfused_bf16})")
+        if errs:
+            for e in errs:
+                print(f"STRUCTURE VIOLATION: {e}", file=sys.stderr)
+            sys.exit(1)
+        print("structure OK", file=sys.stderr)
 
 
 def write_report(d, path):
@@ -230,6 +362,9 @@ it.
 | fusion computations | {d['fusions']} |
 | scan compiled to while loops | {d['while_loops']} |
 | unfused elementwise at entry scope | {d['entry_loose_elementwise']} |
+| standalone elementwise producers by dtype (outside fusions) | {d['unfused_elementwise_by_dtype']} |
+| fusion-signature bytes, whole module | {d['fusion_bytes_total']/1e9:.1f} GB (top: {', '.join(f"{n} {b/1e6:.0f}MB" for n, b in d['fusion_bytes_top'][:3])}) |
+| HBM-traffic levers | remat_policy={d['remat_policy']!r}, fused_epilogue={d['fused_epilogue']}, stochastic_rounding={d['stochastic_rounding']}, epilogue rewrites {d['epilogue_rewrites']} |
 | compile wall-clock (CPU backend) | {d['compile_s']} s |
 
 Methodology notes, verified this round:
