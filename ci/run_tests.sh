@@ -126,6 +126,29 @@ json.load(open(os.path.join(d, "timeline.json")))
 print(f"chaos elastic artifacts ok: {int(total)} readmission(s) in the "
       "metrics snapshot + parseable merged timeline")
 PY
+    echo "=== chaos tier: preemption + exact resume (SIGTERM mid-epoch) ==="
+    # a training subprocess takes SIGTERM mid-epoch, drains the in-flight
+    # step, writes a resume bundle (params + optimizer state + data
+    # cursor + RNG), and exits 83; a second subprocess auto-resumes and
+    # must land on the uninterrupted run's batch order AND final weights
+    # bit-identically; then a grad.nonfinite injection under the rollback
+    # guardrail policy must replay back onto the fault-free trajectory
+    # (all asserted inside chaos_train)
+    local pre_dir
+    pre_dir="$(mktemp -d -t mxtpu-chaos-preempt-XXXXXX)"
+    JAX_PLATFORMS=cpu python tools/chaos_train.py --preempt \
+        --workdir "$pre_dir"
+    python - "$pre_dir" <<'PY'
+import os, sys
+d = sys.argv[1]
+for f in ("batches-reference.txt", "batches-interrupt.txt",
+          "batches-resume.txt", "final-weights.npz"):
+    assert os.path.exists(os.path.join(d, f)), f"missing artifact {f}"
+bundle = [f for f in os.listdir(os.path.join(d, "bundle"))
+          if f.endswith("-preempt.bundle")]
+assert bundle, "no resume bundle left in the workdir"
+print("chaos preempt artifacts ok: batch logs + final weights + bundle")
+PY
 }
 
 run_perf_structure() {

@@ -221,9 +221,10 @@ register_knob("MXTPU_FAULT_SPEC", "", str,
               "Deterministic fault-injection spec, `site:mode@arg` rules "
               "joined by ';' (e.g. 'ps.rpc:drop@0.05;ckpt.write:fail@2'). "
               "Modes: drop (connection), fail (IO error), torn "
-              "(corrupt checkpoint); arg is a probability or 1-based "
-              "call indices. Empty (default) disables injection. See "
-              "docs/FAULT_TOLERANCE.md for the grammar and sites.")
+              "(corrupt checkpoint), sigterm (deliver SIGTERM to self — "
+              "a deterministic preemption); arg is a probability or "
+              "1-based call indices. Empty (default) disables injection. "
+              "See docs/FAULT_TOLERANCE.md for the grammar and sites.")
 register_knob("MXTPU_FAULT_SEED", 0, int,
               "Seed for the fault injector's per-(site, instance) PRNG "
               "streams; same seed + same spec fires the same faults at "
@@ -250,6 +251,24 @@ register_knob("MXTPU_MAX_WORKERS", 0, int,
               "(growth commits at the next barrier boundary). 0 keeps the "
               "world fixed at the configured size; re-admission of "
               "already-known ranks is always allowed.")
+register_knob("MXTPU_GUARDRAIL_POLICY", "", str,
+              "Divergence guardrail in Trainer.step: when non-empty, every "
+              "step runs one fused non-finite check over the gradients "
+              "(a single OR-reduce on device, one host sync) BEFORE they "
+              "reach the optimizer or the parameter server. 'skip' drops "
+              "the poisoned update; 'backoff' additionally halves the AMP "
+              "dynamic loss scale (attaching a unit-scale scaler when none "
+              "is present, so later steps keep the overflow check); "
+              "'rollback' raises GuardrailRollback for the training loop "
+              "to restore the last good checkpoint via auto_resume. Empty "
+              "(default) disables the check entirely — zero per-step "
+              "cost.")
+register_knob("MXTPU_CKPT_WALKBACK", 8, int,
+              "How many epochs model.latest_valid_checkpoint walks back "
+              "over corrupt/missing checkpoints before giving up (each "
+              "skipped epoch is logged to the flight recorder). 0 walks "
+              "all the way to epoch 0 — unbounded, the pre-knob "
+              "behavior.")
 register_knob("MXTPU_PS_BUCKET_KB", 1024, int,
               "Byte cap (KiB) of one hierarchical-allreduce bucket on "
               "dist_async_server: list-key pushpulls batch into a single "

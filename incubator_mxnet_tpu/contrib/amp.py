@@ -76,6 +76,7 @@ class DynamicLossScaler:
         return bool(flag) if flag is not None else False
 
     def update_scale(self, overflow):
+        prev = self.loss_scale
         if overflow:
             self.loss_scale = max(self.min_scale,
                                   self.loss_scale / self.scale_factor)
@@ -85,6 +86,18 @@ class DynamicLossScaler:
             if self._unskipped >= self.scale_window:
                 self.loss_scale *= self.scale_factor
                 self._unskipped = 0
+        from .. import telemetry as _telemetry
+
+        _telemetry.set_gauge(
+            "mxtpu_loss_scale", self.loss_scale,
+            help="Current dynamic loss scale of the AMP scaler (moves on "
+                 "overflow backoff and growth-window promotion).")
+        if self.loss_scale != prev:
+            # scale moves are rare and diagnostic gold: a shrinking scale
+            # trail in a post-mortem dump is a numeric-instability flag
+            _telemetry.log_event(
+                "loss_scale_change", scale=self.loss_scale, prev=prev,
+                cause="overflow" if overflow else "growth")
 
 
 def init_trainer(trainer, scaler=None):

@@ -16,6 +16,7 @@ import numpy as np
 from ... import telemetry as _telemetry
 from ...ndarray.ndarray import NDArray
 from ...ndarray import array as nd_array
+from ...resilience import fault as _fault
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
 __all__ = ["DataLoader", "default_batchify_fn"]
@@ -53,31 +54,81 @@ class DataLoader:
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._num_workers = num_workers
         self._prefetch = max(0, prefetch or 2 * max(num_workers, 1))
+        # checkpointable position: epoch index + batches served within it
+        # (docs/FAULT_TOLERANCE.md — Preemption and exact resume)
+        self._epoch = 0
+        self._batches = 0
+        self._resume_skip = 0
+
+    def state_dict(self):
+        """Checkpointable pipeline position — callable mid-epoch: `batch`
+        counts the batches the consumer has already received this epoch."""
+        return {"version": 1, "epoch": self._epoch, "batch": self._batches,
+                "batch_sampler": self._batch_sampler.state_dict()}
+
+    def load_state_dict(self, state):
+        """Restore a `state_dict()`: the next `__iter__` replays the
+        interrupted epoch's index order (sampler RNG rewound to its epoch
+        start) and fast-forwards past the first `batch` batches WITHOUT
+        fetching their data, so a resumed job sees the exact batch
+        sequence an uninterrupted run would have."""
+        self._epoch = int(state["epoch"])
+        self._batches = int(state["batch"])
+        self._resume_skip = self._batches
+        self._batch_sampler.load_state_dict(state["batch_sampler"],
+                                            mid_epoch=self._batches > 0)
 
     def __iter__(self):
+        self._batches = self._resume_skip
         it = self._iter_impl()
         if not _telemetry.enabled():
-            yield from it
-            return
-        # batch-fetch latency as the consumer sees it: time blocked in
-        # next() — includes batchify for the serial path and result-wait
-        # for the prefetched path (a well-fed pipeline reads near zero)
-        while True:
-            t0 = _time.perf_counter()
+            # cursor BEFORE yield: the generator suspends at yield, so a
+            # state_dict() taken after the consumer received batch k must
+            # already read k served
+            for batch in it:
+                self._batches += 1
+                yield batch
+        else:
+            # batch-fetch latency as the consumer sees it: time blocked in
+            # next() — includes batchify for the serial path and result-wait
+            # for the prefetched path (a well-fed pipeline reads near zero)
+            while True:
+                t0 = _time.perf_counter()
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
+                _telemetry.observe(
+                    "mxtpu_dataloader_fetch_seconds",
+                    _time.perf_counter() - t0,
+                    help="Time the training loop blocked fetching a batch.")
+                self._batches += 1
+                yield batch
+        # epoch bookkeeping only on normal exhaustion: an abandoned
+        # generator leaves the mid-epoch cursor for state_dict() to report
+        self._epoch += 1
+        self._batches = 0
+
+    def _fetch(self, batch):
+        _fault.injector().raise_for("data.fetch")
+        return self._batchify_fn([self._dataset[i] for i in batch])
+
+    def _index_iter(self):
+        """The epoch's batch-index stream, fast-forwarded past batches a
+        restored cursor already served (index-only: skipping is free)."""
+        it = iter(self._batch_sampler)
+        skip, self._resume_skip = self._resume_skip, 0
+        for _ in range(skip):
             try:
-                batch = next(it)
+                next(it)
             except StopIteration:
-                return
-            _telemetry.observe(
-                "mxtpu_dataloader_fetch_seconds",
-                _time.perf_counter() - t0,
-                help="Time the training loop blocked fetching a batch.")
-            yield batch
+                return iter(())
+        return it
 
     def _iter_impl(self):
         if self._num_workers == 0:
-            for batch in self._batch_sampler:
-                yield self._batchify_fn([self._dataset[i] for i in batch])
+            for batch in self._index_iter():
+                yield self._fetch(batch)
             return
         # threaded prefetch pipeline (PrefetcherIter analog). Failure
         # path: the FIRST worker/batchify exception is re-raised promptly
@@ -88,7 +139,7 @@ class DataLoader:
         pool = _futures.ThreadPoolExecutor(self._num_workers)
         try:
             pending = []  # (batch_index, future), consumed in order
-            it = iter(self._batch_sampler)
+            it = self._index_iter()
             n_submitted = 0
 
             def submit():
@@ -99,9 +150,7 @@ class DataLoader:
                     return None
                 idx = n_submitted
                 n_submitted += 1
-                return (idx, pool.submit(
-                    lambda b: self._batchify_fn(
-                        [self._dataset[i] for i in b]), batch))
+                return (idx, pool.submit(self._fetch, batch))
 
             for _ in range(self._prefetch):
                 f = submit()

@@ -20,6 +20,8 @@ either); dispatch counts are observable via mxtpu_trainer_dispatches_total.
 from __future__ import annotations
 
 import math
+import os
+import signal
 import time
 
 import jax
@@ -30,9 +32,10 @@ from .. import optimizer as opt
 from .. import kvstore as kvs
 from .. import telemetry as _telemetry
 from ..ndarray.ndarray import NDArray
+from ..resilience import fault as _fault
 from .parameter import ParameterDict
 
-__all__ = ["Trainer"]
+__all__ = ["GuardrailRollback", "Trainer"]
 
 _DISPATCHES = "mxtpu_trainer_dispatches_total"
 _DISPATCH_HELP = (
@@ -42,6 +45,17 @@ _DISPATCH_HELP = (
 _BUCKET_BYTES = "mxtpu_trainer_bucket_bytes"
 _BUCKET_HELP = ("Payload bytes of one aggregated-dispatch bucket "
                 "(kind: optimizer_update | allreduce).")
+_GUARDRAIL_METRIC = "mxtpu_guardrail_trips_total"
+_GUARDRAIL_HELP = ("Divergence-guardrail trips in Trainer.step, by policy "
+                   "(skip/backoff/rollback) and reason.")
+_GUARDRAIL_POLICIES = ("skip", "backoff", "rollback")
+
+
+class GuardrailRollback(RuntimeError):
+    """The divergence guardrail (MXTPU_GUARDRAIL_POLICY=rollback) saw
+    non-finite gradients: the step was NOT applied and the training loop
+    should restore the last good checkpoint via `Trainer.auto_resume`
+    and replay from there."""
 
 
 class Trainer:
@@ -236,6 +250,79 @@ class Trainer:
             self._flat_fn_cache[key] = fns
         return fns
 
+    def _grads_nonfinite(self):
+        """One fused non-finite sweep over every live gradient: per-grad
+        flags OR on device, ONE host sync total (the same discipline as
+        amp's has_overflow / the reference's multi_all_finite)."""
+        flag = None
+        for p in self._params:
+            if p._data is None:
+                continue
+            g = p.grad()
+            if hasattr(g, "data") and hasattr(g, "indices"):  # row_sparse
+                data = g.data._data
+            else:
+                data = g._data
+            bad = ~jnp.isfinite(data).all()
+            flag = bad if flag is None else flag | bad
+        return bool(flag) if flag is not None else False
+
+    def _guardrail_check(self, where):
+        """Divergence guardrail (MXTPU_GUARDRAIL_POLICY): True means the
+        caller must SKIP this step's update (the gradients were
+        non-finite and the policy absorbed it); `rollback` raises
+        GuardrailRollback instead. Runs BEFORE gradients reach the
+        optimizer or the parameter server, on both step paths, so one
+        poisoned step can never corrupt the weights."""
+        policy = _config.get("MXTPU_GUARDRAIL_POLICY")
+        if not policy:
+            return False
+        if policy not in _GUARDRAIL_POLICIES:
+            raise ValueError(
+                f"MXTPU_GUARDRAIL_POLICY={policy!r}; expected one of "
+                f"{_GUARDRAIL_POLICIES} (or empty to disable)")
+        inj = _fault.injector()
+        if inj.active and inj.action("grad.nonfinite") is not None:
+            # chaos poisoning: corrupt one gradient so the check below
+            # trips at an exactly reproducible step
+            for p in self._params:
+                if p._data is None:
+                    continue
+                g = p.grad()
+                if hasattr(g, "data") and hasattr(g, "indices"):
+                    g.data._data = g.data._data * jnp.nan
+                else:
+                    g._data = g._data * jnp.nan
+                break
+        if not self._grads_nonfinite():
+            return False
+        from ..telemetry import recorder as _recorder
+
+        _telemetry.inc(_GUARDRAIL_METRIC, 1, help=_GUARDRAIL_HELP,
+                       policy=policy, reason="nonfinite-grad")
+        _telemetry.log_event("guardrail_trip", policy=policy,
+                             reason="nonfinite-grad", where=where)
+        # a divergence event is exactly what post-mortems want context for
+        _recorder.dump("guardrail-trip")
+        if policy == "rollback":
+            raise GuardrailRollback(
+                "non-finite gradients detected; the step was not applied "
+                "— restore the last good checkpoint (Trainer.auto_resume) "
+                "and replay")
+        if policy == "backoff":
+            scaler = getattr(self, "_amp_scaler", None)
+            if scaler is None:
+                # no AMP in play: attach a unit scaler pinned at 1.0 (a
+                # huge window forbids growth) — later steps gain the
+                # overflow check without ever rescaling unscaled losses
+                from ..contrib import amp as _amp
+
+                scaler = _amp.DynamicLossScaler(
+                    init_scale=1.0, scale_window=10 ** 9, min_scale=1.0)
+                self._amp_scaler = scaler
+            scaler.update_scale(True)
+        return True
+
     def _amp_pre_update(self, rescale):
         """(skip_step, effective_rescale): overflow-skip + unscale factor
         for loss-scaled gradients (ref: contrib/amp loss-scaled step).
@@ -274,6 +361,12 @@ class Trainer:
                 _telemetry.step_boundary()
 
     def _step_impl(self, batch_size, ignore_stale_grad=False):
+        inj = _fault.injector()
+        if inj.active and inj.action("train.step") == "sigterm":
+            # deterministic preemption: deliver SIGTERM to self at an
+            # exact step; the drain handler only flags it, the step
+            # completes, and the loop's boundary poll takes the bundle
+            os.kill(os.getpid(), signal.SIGTERM)
         # rescale BEFORE _init_kvstore: server mode pickles the optimizer at
         # init, so the scale must already be baked in on the first step
         rescale = self._scale / batch_size
@@ -291,6 +384,9 @@ class Trainer:
             if rescale != self._kv_shipped_rescale:
                 self._ship_optimizer_attrs(rescale_grad=rescale)
                 self._kv_shipped_rescale = rescale
+            if self._guardrail_check("server_push"):
+                # the poisoned gradients never reach the shared server
+                return
             # push grads, pull server-updated weights — no local update.
             # Hierarchical path: ONE inter-host push_many/pull_many RPC
             # pair per byte-capped bucket after the store's intra-host
@@ -313,6 +409,10 @@ class Trainer:
             return
         if self._kvstore is not None:
             self.allreduce_grads()
+        # AFTER allreduce: one worker's NaN poisons every replica's
+        # reduced gradient, so the check must see the reduced values
+        if self._guardrail_check("local_update"):
+            return
         skip, eff = self._amp_pre_update(rescale)
         if skip:
             return
@@ -326,6 +426,8 @@ class Trainer:
                 "update() is not supported when the optimizer runs on the "
                 "kvstore server; call step() (ref: trainer.py:360)")
         rescale = self._scale / batch_size
+        if self._guardrail_check("update"):
+            return
         skip, eff = self._amp_pre_update(rescale)
         if skip:
             return
@@ -662,20 +764,55 @@ class Trainer:
                                     net.save_parameters)
         self.save_states(f"{prefix}-{epoch:04d}.states")
 
-    def auto_resume(self, prefix, net=None):
-        """Resume an interrupted run from the newest VERIFIED epoch under
-        `prefix`: loads the parameters into `net` (when given) and the
-        optimizer states when the matching `.states` file verifies too.
-        Returns the epoch to continue FROM (last valid epoch + 1), or 0
-        when no epoch verifies (fresh start)."""
+    def save_bundle(self, prefix, epoch, net=None, loader=None):
+        """Preemption resume bundle: params + optimizer states + data-
+        pipeline cursor + global RNG position, crash-consistently under
+        `prefix` (see resilience.preemption.write_bundle). Unlike
+        `save_checkpoint` this captures a MID-EPOCH point."""
+        from ..resilience import preemption as _preemption
+
+        return _preemption.write_bundle(prefix, trainer=self, net=net,
+                                        loader=loader, epoch=epoch)
+
+    def auto_resume(self, prefix, net=None, loader=None):
+        """Resume an interrupted run under `prefix`. Preference order:
+
+        1. a verified preemption bundle whose epoch is at least as new as
+           the epoch checkpoints — restores params, optimizer states, the
+           global RNG position, and (when `loader` is given) the data
+           pipeline's mid-epoch cursor, then returns the interrupted
+           epoch so the caller re-enters it (the loader fast-forwards
+           past the batches already trained);
+        2. else the newest VERIFIED epoch checkpoint: loads the
+           parameters into `net` (when given) and the optimizer states
+           when the matching `.states` file verifies too, returning last
+           valid epoch + 1;
+        3. else 0 (fresh start)."""
         import os
 
         from .. import model as _model
+        from .. import random as _random
         from .. import resilience as _resilience
+        from ..resilience import preemption as _preemption
 
         from .. import telemetry as _telemetry
 
         epoch = _model.latest_valid_checkpoint(prefix)
+        bundle = _preemption.read_bundle(prefix)
+        if bundle is not None and (epoch is None
+                                   or bundle["epoch"] >= epoch + 1):
+            b_paths = _preemption.bundle_paths(prefix)
+            _telemetry.log_event("trainer_resume", prefix=str(prefix),
+                                 epoch=int(bundle["epoch"]), fresh=False,
+                                 bundle=True)
+            if net is not None and bundle["has_params"]:
+                net.load_parameters(b_paths[1])
+            if bundle["has_states"]:
+                self.load_states(b_paths[2])
+            if loader is not None and bundle["loader"] is not None:
+                loader.load_state_dict(bundle["loader"])
+            _random.set_state(bundle["rng"])
+            return int(bundle["epoch"])
         if epoch is None:
             _telemetry.log_event("trainer_resume", prefix=str(prefix),
                                  epoch=-1, fresh=True)

@@ -501,6 +501,7 @@ class KVStoreDistAsyncServer(KVStoreDist):
         self._member = self._client.join(self.rank)
         self._hb_stop = threading.Event()
         self._hb_client = None
+        self._left = False
         if self.num_workers > 1:
             # data-plane liveness on a DEDICATED client: the main client
             # serializes request/response under one lock, so a beat
@@ -736,23 +737,50 @@ class KVStoreDistAsyncServer(KVStoreDist):
             self._client.set_optimizer_states(blob)
         self._client.barrier()
 
-    def close(self):
+    def leave(self):
+        """Graceful departure (resilience.preemption drain): stop the
+        heartbeat thread, then tell the server this rank is leaving so
+        the survivors' quorum shrinks immediately — no heartbeat-timeout
+        wait, no spurious eviction alarm. After leave() this rank is out
+        of the quorum, so close() skips the farewell rendezvous (a
+        departed rank counting toward a barrier would over-fill it).
+        Idempotent; a replacement process rejoins via the normal join
+        path."""
+        if self._left:
+            return
+        self._left = True
         self._hb_stop.set()
         try:
-            # best-effort farewell rendezvous: with a peer dead the
-            # quorum shrinks (or the barrier errors), and shutdown must
-            # proceed either way — a dead worker cannot hold the job's
-            # teardown hostage
-            self.barrier()
+            self._client.leave(self.rank)
+            logger.info("dist_async_server r%d: left the sync group "
+                        "gracefully", self.rank)
         except (ConnectionError, OSError, RuntimeError) as e:
-            logger.warning("dist_async_server close: farewell barrier "
-                           "failed (%s: %s); shutting down anyway",
-                           type(e).__name__, e)
+            # the server may already be gone mid-preemption; the quorum
+            # then shrinks via the heartbeat timeout instead
+            logger.warning("dist_async_server r%d: graceful leave failed "
+                           "(%s: %s); survivors will evict by heartbeat",
+                           self.rank, type(e).__name__, e)
+
+    def close(self):
+        self._hb_stop.set()
+        if not self._left:
+            try:
+                # best-effort farewell rendezvous: with a peer dead the
+                # quorum shrinks (or the barrier errors), and shutdown must
+                # proceed either way — a dead worker cannot hold the job's
+                # teardown hostage
+                self.barrier()
+            except (ConnectionError, OSError, RuntimeError) as e:
+                logger.warning("dist_async_server close: farewell barrier "
+                               "failed (%s: %s); shutting down anyway",
+                               type(e).__name__, e)
         if self._server is not None:
             self._server.shutdown()
         if self._hb_client is not None:
             self._hb_client.close()
         self._client.close()
+        if self._left:
+            return
         # collective rendezvous AFTER the listener is closed: a successor
         # store on the same port must never find the old server accepting
         super().barrier()

@@ -14,11 +14,14 @@ verifies (cf. CheckFreq, FAST'21).
 from __future__ import annotations
 
 import collections
+import logging
 import os
 import re
 
 from . import ndarray as nd
 from . import symbol as sym
+
+logger = logging.getLogger(__name__)
 
 __all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
            "latest_valid_checkpoint", "load_params", "wait_checkpoints",
@@ -152,8 +155,15 @@ def latest_valid_checkpoint(prefix):
     """Newest epoch under `prefix` whose params file passes manifest
     verification, or None — the recovery entry point: after a crash,
     resume from this epoch and every torn/corrupt newer file is skipped.
-    """
+
+    The walk-back is BOUNDED by MXTPU_CKPT_WALKBACK (0 = unbounded):
+    many consecutive corrupt epochs usually mean a sick filesystem, not
+    a torn tail — better to stop and say so than to silently resume
+    from days-old weights. Every skipped epoch lands in the flight
+    recorder."""
+    from . import config as _config
     from . import resilience as _resilience
+    from . import telemetry as _telemetry
 
     d = os.path.dirname(prefix) or "."
     pat = re.compile(re.escape(os.path.basename(prefix))
@@ -164,9 +174,24 @@ def latest_valid_checkpoint(prefix):
         return None
     epochs = sorted({int(m.group(1)) for n in names
                      if (m := pat.match(n))}, reverse=True)
-    for epoch in epochs:
+    bound = max(0, int(_config.get("MXTPU_CKPT_WALKBACK")))
+    for i, epoch in enumerate(epochs):
+        if bound and i >= bound:
+            logger.warning(
+                "latest_valid_checkpoint: gave up after %d corrupt "
+                "epochs under %s (MXTPU_CKPT_WALKBACK=%d); refusing to "
+                "walk back further — inspect the checkpoint directory",
+                bound, prefix, bound)
+            _telemetry.log_event("ckpt_walkback_exhausted",
+                                 prefix=str(prefix), bound=bound,
+                                 newest=epochs[0])
+            return None
         if _resilience.verify(f"{prefix}-{epoch:04d}.params"):
             return epoch
+        logger.warning("latest_valid_checkpoint: epoch %d under %s "
+                       "failed verification; walking back", epoch, prefix)
+        _telemetry.log_event("ckpt_skipped", prefix=str(prefix),
+                             epoch=int(epoch))
     return None
 
 

@@ -58,6 +58,10 @@ _READMIT_HELP = ("Evicted ranks re-admitted to the quorum, via a fresh "
 _STALE_METRIC = "mxtpu_ps_stale_epoch_rejections_total"
 _STALE_HELP = ("Sync contributions rejected for carrying a stale "
                "membership epoch, by command.")
+_LEAVE_METRIC = "mxtpu_ps_leaves_total"
+_LEAVE_HELP = ("Ranks that left the sync quorum via the graceful-leave "
+               "RPC (preemption drain) — the quorum shrinks immediately, "
+               "without waiting for a heartbeat timeout.")
 _EPOCH_METRIC = "mxtpu_ps_membership_epoch"
 _EPOCH_HELP = ("Current membership epoch of the ParameterServer; bumps on "
                "every membership change (readmission, rank takeover, "
@@ -350,6 +354,10 @@ class ParameterServer:
         # barrier/sync quorum instead of hanging every survivor until the
         # rendezvous timeout; a fresh beat re-admits them
         self._evicted = set()
+        # ranks that left via the graceful-leave RPC: unlike staleness
+        # evictions, a stray late beat from the dying process must NOT
+        # re-admit them — only an explicit join() does
+        self._departed = set()
         # elastic membership (docs/FAULT_TOLERANCE.md — Elastic
         # membership): a monotonically-increasing epoch versions the rank
         # set; sync contributions carry it and stale ones are fenced.
@@ -631,6 +639,7 @@ class ParameterServer:
                 pending = True
             self._owners[rank] = client_id
             self._evicted.discard(rank)
+            self._departed.discard(rank)  # an explicit rejoin is real
             if rank in self._beats:
                 # re-arm staleness from the join, not the pre-death beat
                 self._beats[rank] = time.time()
@@ -658,6 +667,44 @@ class ParameterServer:
                         "readmitted": readmitted,
                         "num_workers": self.num_workers,
                         "keys": sorted(self._store, key=str)})
+
+    def _cmd_leave(self, rank):
+        """Graceful departure (the preemption drain's farewell): the rank
+        is marked evicted NOW, so survivors' rendezvous quorum shrinks
+        without waiting out a heartbeat timeout. The leaver's beat record
+        is dropped too — unlike a staleness eviction, a stray late beat
+        from the dying process must not re-admit it. Symmetric with
+        eviction, a leave does NOT bump the membership epoch (the world
+        only shrank; survivors' in-flight contributions stay valid), and
+        a later join() of the same rank re-admits it through the normal
+        versioned path."""
+        from . import telemetry as _telemetry
+        from .telemetry import recorder as _recorder
+
+        rank = int(rank)
+        with self._beats_lock:
+            already = rank in self._evicted
+            self._beats.pop(rank, None)
+            self._owners.pop(rank, None)
+            if rank < self.num_workers:
+                self._evicted.add(rank)
+                self._departed.add(rank)
+            quorum = max(1, self.num_workers - len(self._evicted))
+        # a shrunk quorum may complete a parked rendezvous
+        with self._barrier_cv:
+            self._barrier_cv.notify_all()
+        with self._sync_cv:
+            self._sync_cv.notify_all()
+        if not already:
+            logger.info("ps: rank %d left the quorum gracefully "
+                        "(now %d/%d)", rank, quorum, self.num_workers)
+            _telemetry.inc(_LEAVE_METRIC, 1, help=_LEAVE_HELP)
+            _telemetry.log_event("ps_leave", rank=rank, quorum=quorum,
+                                 world=self.num_workers, epoch=self._epoch)
+            # a planned departure still closes a chapter: keep the black
+            # box, same as an unplanned eviction does
+            _recorder.dump("leave")
+        return ("ok", quorum)
 
     def _cmd_membership(self):
         """Read-only membership snapshot — the recovery RPC after a
@@ -928,6 +975,10 @@ class ParameterServer:
     def _cmd_heartbeat(self, rank):
         rank = int(rank)
         with self._beats_lock:
+            if rank in self._departed:
+                # a straggler beat from a rank that already said goodbye:
+                # it is draining, not back — only join() readmits it
+                return ("ok",)
             self._beats[rank] = time.time()
             readmitted = rank in self._evicted
             self._evicted.discard(rank)  # a live beat re-admits
@@ -1258,6 +1309,17 @@ class PSClient:
         info = self._rpc("membership")
         self._epoch = int(info["epoch"])
         return info
+
+    def leave(self, rank=None):
+        """Graceful departure (preemption drain): tell the server this
+        rank is gone so the survivors' quorum shrinks NOW instead of
+        after a heartbeat timeout. Defaults to the rank join() assigned.
+        Returns the post-leave quorum; rejoin later via join()."""
+        r = self._rank if rank is None else int(rank)
+        if r is None:
+            raise RuntimeError("leave() before join(): no rank to retire "
+                               "(pass rank= explicitly)")
+        return self._mut_rpc("leave", int(r))
 
     def wait_admitted(self, policy=None):
         """Backoff-poll until this rank is inside the world (its parked

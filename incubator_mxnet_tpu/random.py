@@ -12,7 +12,7 @@ import threading
 
 import jax
 
-__all__ = ["seed", "next_key", "current_seed"]
+__all__ = ["seed", "next_key", "current_seed", "get_state", "set_state"]
 
 _LOCK = threading.Lock()
 _SEED = 0
@@ -51,6 +51,25 @@ def seed(seed_state, ctx="all"):
 
 def current_seed():
     return _SEED
+
+
+def get_state():
+    """Checkpointable generator position. The whole state is (seed,
+    counter) on the host — keys derive via fold_in — so restoring it
+    makes every subsequent `next_key()` bit-identical (docs/
+    FAULT_TOLERANCE.md — Preemption and exact resume)."""
+    with _LOCK:
+        return {"seed": _SEED, "counter": _COUNTER}
+
+
+def set_state(state):
+    """Restore a `get_state()` snapshot (exact-resume counterpart of
+    `seed()`, which always rewinds the counter to 0)."""
+    global _SEED, _COUNTER, _BLOCK
+    with _LOCK:
+        _SEED = int(state["seed"])
+        _COUNTER = int(state["counter"])
+        _BLOCK = None
 
 
 def _refill(seed_val, start):

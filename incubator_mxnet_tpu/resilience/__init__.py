@@ -33,6 +33,11 @@ from .fault import (  # noqa: F401
 from .checkpoint import (  # noqa: F401
     atomic_save, atomic_write_bytes, manifest_path, read_manifest, verify,
 )
+from .preemption import (  # noqa: F401
+    PREEMPTED_EXIT_CODE, Preempted, checkpoint_and_exit, clear_bundle,
+    maybe_checkpoint_and_exit, read_bundle, write_bundle,
+)
+from . import preemption  # noqa: F401
 
 __all__ = [
     "RetryPolicy",
@@ -40,4 +45,7 @@ __all__ = [
     "injector", "install", "refresh_from_env",
     "atomic_save", "atomic_write_bytes", "manifest_path", "read_manifest",
     "verify",
+    "PREEMPTED_EXIT_CODE", "Preempted", "checkpoint_and_exit",
+    "clear_bundle", "maybe_checkpoint_and_exit", "preemption",
+    "read_bundle", "write_bundle",
 ]

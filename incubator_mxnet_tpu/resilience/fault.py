@@ -5,8 +5,9 @@ consult the process-wide injector at well-defined points. Grammar::
 
     spec     := rule (";" rule)*
     rule     := site ":" mode "@" arg
-    site     := dotted name (ps.rpc | ps.rpc.recv | ps.connect | ckpt.write)
-    mode     := drop | fail | torn
+    site     := dotted name (ps.rpc | ps.rpc.recv | ps.connect |
+                ckpt.write | data.fetch | grad.nonfinite | train.step)
+    mode     := drop | fail | torn | sigterm
     arg      := probability (float in [0,1)) | call indices (int[,int...])
 
 Examples::
@@ -15,6 +16,17 @@ Examples::
     ps.rpc.recv:drop@3,7        # drop the reply of calls 3 and 7 exactly
     ckpt.write:fail@2           # the 2nd checkpoint write raises mid-write
     ckpt.write:torn@3           # the 3rd write leaves a torn canonical file
+    data.fetch:fail@4           # the 4th DataLoader batch fetch raises
+    grad.nonfinite:fail@7       # poison step 7's gradients with a NaN
+    train.step:sigterm@5        # deliver SIGTERM to self at step 5
+                                # (a deterministic preemption)
+
+`sigterm` is the preemption mode: the site delivers SIGTERM to its own
+process, exercising the graceful-shutdown drain (resilience.preemption)
+at an exactly reproducible step. `grad.nonfinite` is consulted by the
+Trainer's divergence guardrail: any fired mode at that site multiplies
+the gradients by NaN before the non-finite check, so guardrail policies
+(skip / backoff / rollback) replay deterministically.
 
 Determinism: every (site, instance) pair owns an independent call counter
 and PRNG stream seeded from `MXTPU_FAULT_SEED` — concurrent clients do
@@ -39,7 +51,7 @@ _FAULT_METRIC = "mxtpu_fault_injections_total"
 _FAULT_HELP = ("Faults fired by the deterministic injector "
                "(MXTPU_FAULT_SPEC), by site and mode.")
 
-_MODES = ("drop", "fail", "torn")
+_MODES = ("drop", "fail", "torn", "sigterm")
 
 
 class InjectedConnectionError(ConnectionError):
@@ -119,7 +131,8 @@ class FaultInjector:
 
     def action(self, site, instance=""):
         """Advance the (site, instance) stream one call; return the fault
-        mode to apply at this call ('drop' | 'fail' | 'torn') or None."""
+        mode to apply at this call ('drop' | 'fail' | 'torn' | 'sigterm')
+        or None."""
         rule = self._rules.get(site)
         if rule is None:
             return None

@@ -93,6 +93,20 @@ METRIC_NAMES = {
     "mxtpu_flight_recorder_dumps_total": (
         "counter", "Post-mortem flight-recorder dump files written, by "
                    "reason."),
+    "mxtpu_ps_leaves_total": (
+        "counter", "Ranks that left the sync quorum via the graceful-leave "
+                   "RPC (preemption drain) — the quorum shrinks "
+                   "immediately, without a heartbeat timeout."),
+    "mxtpu_preemptions_total": (
+        "counter", "Preemption drains completed: a termination signal "
+                   "arrived, the in-flight step finished, and a resume "
+                   "bundle was written, by signal."),
+    "mxtpu_loss_scale": (
+        "gauge", "Current dynamic loss scale of the AMP scaler (moves on "
+                 "overflow backoff and growth-window promotion)."),
+    "mxtpu_guardrail_trips_total": (
+        "counter", "Divergence-guardrail trips in Trainer.step, by policy "
+                   "(skip/backoff/rollback) and reason."),
 }
 
 # span() names (tracing regions). Dots namespace by subsystem.

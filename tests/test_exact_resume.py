@@ -1,0 +1,495 @@
+"""Preemption-safe exact resume: checkpointable data pipeline, resume
+bundles, graceful PS leave, and divergence guardrails. See
+docs/FAULT_TOLERANCE.md — Preemption and exact resume."""
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, model as _model, ps as _ps
+from incubator_mxnet_tpu import resilience
+from incubator_mxnet_tpu.gluon import nn, Trainer
+from incubator_mxnet_tpu.gluon.data import DataLoader
+from incubator_mxnet_tpu.gluon.data.sampler import (
+    BatchSampler, RandomSampler, SequentialSampler)
+from incubator_mxnet_tpu.gluon.trainer import GuardrailRollback
+from incubator_mxnet_tpu.resilience import fault as _fault
+from incubator_mxnet_tpu.resilience import preemption as _preemption
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Every test starts/ends with the no-op injector, no drain request,
+    and no guardrail policy."""
+    _fault.install(None)
+    _preemption.reset()
+    os.environ.pop("MXTPU_GUARDRAIL_POLICY", None)
+    yield
+    _fault.install(None)
+    _preemption.uninstall()
+    _preemption.reset()
+    os.environ.pop("MXTPU_GUARDRAIL_POLICY", None)
+    os.environ.pop("MXTPU_CKPT_WALKBACK", None)
+
+
+class _ArangeDataset:
+    """dataset[i] == [i, i] — batch contents ARE the index order, so
+    bit-identical batches mean bit-identical order."""
+
+    def __init__(self, n=13):
+        self._n = n
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        return np.full(2, i, dtype=np.float32)
+
+
+def _drain(loader):
+    return [b.asnumpy() for b in loader]
+
+
+# ---------------------------------------------------------------------------
+# samplers: state_dict round trips
+# ---------------------------------------------------------------------------
+
+def test_random_sampler_state_roundtrip_live():
+    s = RandomSampler(10, seed=3)
+    list(s)
+    state = s.state_dict()
+    a = list(s)  # advances the live RNG
+    s2 = RandomSampler(10, seed=99)
+    s2.load_state_dict(state)  # epoch-boundary restore: live RNG state
+    assert list(s2) == a
+
+
+def test_random_sampler_mid_epoch_restore_replays_epoch():
+    s = RandomSampler(10, seed=3)
+    order = list(s)           # the epoch whose start was recorded
+    state = s.state_dict()
+    s2 = RandomSampler(10, seed=99)
+    s2.load_state_dict(state, mid_epoch=True)
+    assert list(s2) == order  # the SAME permutation is redrawn
+
+
+def test_batch_sampler_rollover_state_roundtrip():
+    s = BatchSampler(SequentialSampler(7), 3, last_batch="rollover")
+    first = list(s)           # leaves a rolled-over tail
+    state = s.state_dict()
+    second = list(s)          # consumes the tail
+    s2 = BatchSampler(SequentialSampler(7), 3, last_batch="rollover")
+    s2.load_state_dict(state)
+    assert list(s2) == second
+
+
+def test_sequential_sampler_is_stateless():
+    s = SequentialSampler(5)
+    assert s.state_dict() == {}
+    s.load_state_dict({}, mid_epoch=True)
+    assert list(s) == [0, 1, 2, 3, 4]
+
+
+# ---------------------------------------------------------------------------
+# DataLoader: mid-epoch bit-identical resume
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("shuffle", [False, True])
+@pytest.mark.parametrize("num_workers", [0, 2])
+def test_dataloader_mid_epoch_resume_bit_identical(shuffle, num_workers):
+    ds = _ArangeDataset(13)
+    ref = DataLoader(ds, batch_size=4, shuffle=shuffle,
+                     num_workers=num_workers)
+    it = iter(ref)
+    consumed = [next(it).asnumpy() for _ in range(2)]
+    state = ref.state_dict()
+    rest_ref = [b.asnumpy() for b in it]
+
+    # a brand-new loader (fresh process analog), global RNG perturbed
+    np.random.seed(1234)
+    np.random.rand(17)
+    res = DataLoader(ds, batch_size=4, shuffle=shuffle,
+                     num_workers=num_workers)
+    res.load_state_dict(state)
+    rest = _drain(res)
+    assert len(rest) == len(rest_ref) == 2
+    for a, b in zip(rest, rest_ref):
+        np.testing.assert_array_equal(a, b)
+    # and the NEXT epoch matches the uninterrupted run's next epoch
+    for a, b in zip(_drain(res), _drain(ref)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_dataloader_epoch_boundary_resume():
+    ds = _ArangeDataset(8)
+    ref = DataLoader(ds, batch_size=4, shuffle=True)
+    _drain(ref)                       # complete epoch 0
+    state = ref.state_dict()
+    assert state["epoch"] == 1 and state["batch"] == 0
+    epoch1_ref = _drain(ref)
+
+    res = DataLoader(ds, batch_size=4, shuffle=True)
+    res.load_state_dict(state)
+    for a, b in zip(_drain(res), epoch1_ref):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_dataloader_rollover_mid_epoch_resume():
+    ds = _ArangeDataset(10)
+    ref = DataLoader(ds, batch_size=3, shuffle=True, last_batch="rollover")
+    _drain(ref)                       # epoch 0 leaves a rolled tail
+    it = iter(ref)
+    next(it)                          # one batch into epoch 1
+    state = ref.state_dict()
+    rest_ref = [b.asnumpy() for b in it]
+
+    res = DataLoader(ds, batch_size=3, shuffle=True, last_batch="rollover")
+    res.load_state_dict(state)
+    rest = _drain(res)
+    assert len(rest) == len(rest_ref)
+    for a, b in zip(rest, rest_ref):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_dataloader_fetch_fault_site():
+    ds = _ArangeDataset(8)
+    loader = DataLoader(ds, batch_size=4)
+    _fault.install(_fault.FaultInjector("data.fetch:fail@2", seed=0))
+    it = iter(loader)
+    next(it)
+    with pytest.raises(OSError):
+        list(it)
+
+
+# ---------------------------------------------------------------------------
+# global RNG state
+# ---------------------------------------------------------------------------
+
+def test_random_get_set_state_exact():
+    mx.random.seed(7)
+    [mx.random.next_key() for _ in range(5)]
+    state = mx.random.get_state()
+    a = np.asarray(mx.random.next_key())
+    mx.random.seed(999)               # wreck the stream
+    mx.random.next_key()
+    mx.random.set_state(state)
+    b = np.asarray(mx.random.next_key())
+    np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# preemption: handlers + bundles
+# ---------------------------------------------------------------------------
+
+def test_preemption_flag_and_escalation():
+    _preemption.install()
+    assert not _preemption.requested()
+    os.kill(os.getpid(), signal.SIGTERM)
+    assert _preemption.requested()
+    with pytest.raises(_preemption.Preempted) as ei:
+        os.kill(os.getpid(), signal.SIGTERM)  # second signal escalates
+    assert ei.value.code == _preemption.PREEMPTED_EXIT_CODE == 83
+
+
+def test_preemption_chains_previous_handler():
+    hits = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: hits.append(s))
+    try:
+        _preemption.install()
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert hits == [signal.SIGTERM]
+    finally:
+        _preemption.uninstall()
+        signal.signal(signal.SIGTERM, prev)
+
+
+def _tiny_net():
+    net = nn.Dense(3)
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.array(np.ones((2, 2), np.float32)))
+    return net
+
+
+def _weights(net):
+    return [v.data().asnumpy().copy()
+            for _, v in sorted(net.collect_params().items())]
+
+
+def test_bundle_roundtrip_restores_everything(tmp_path):
+    prefix = str(tmp_path / "run")
+    net = _tiny_net()
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    loader = DataLoader(_ArangeDataset(13), batch_size=4, shuffle=True)
+    it = iter(loader)
+    [next(it) for _ in range(2)]
+    mx.random.seed(5)
+    [mx.random.next_key() for _ in range(3)]
+    rng_state = mx.random.get_state()
+    w = _weights(net)
+
+    tr.save_bundle(prefix, epoch=7, net=net, loader=loader)
+
+    mx.random.seed(999)
+    np.random.seed(42)
+    net2 = _tiny_net()
+    tr2 = Trainer(net2.collect_params(), "sgd", {"learning_rate": 0.1})
+    loader2 = DataLoader(_ArangeDataset(13), batch_size=4, shuffle=True)
+    assert tr2.auto_resume(prefix, net=net2, loader=loader2) == 7
+    for a, b in zip(w, _weights(net2)):
+        np.testing.assert_array_equal(a, b)
+    assert mx.random.get_state() == rng_state
+    rest = _drain(loader2)
+    rest_ref = [b.asnumpy() for b in it]
+    assert len(rest) == len(rest_ref) == 2
+    for a, b in zip(rest, rest_ref):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_bundle_rejects_corruption(tmp_path):
+    prefix = str(tmp_path / "run")
+    net = _tiny_net()
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    tr.save_bundle(prefix, epoch=2, net=net)
+    bundle_file = _preemption.bundle_paths(prefix)[0]
+    with open(bundle_file, "r+b") as f:
+        f.write(b"\xff\xff\xff")
+    assert _preemption.read_bundle(prefix) is None
+    # a corrupt bundle must not hijack auto_resume
+    assert tr.auto_resume(prefix, net=net) == 0
+
+
+def test_bundle_requires_manifest(tmp_path):
+    prefix = str(tmp_path / "run")
+    net = _tiny_net()
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    tr.save_bundle(prefix, epoch=2, net=net)
+    os.remove(resilience.manifest_path(_preemption.bundle_paths(prefix)[0]))
+    # no legacy loophole: a bundle WITHOUT a manifest is rejected
+    assert _preemption.read_bundle(prefix) is None
+
+
+def test_clear_bundle_removes_all_files(tmp_path):
+    prefix = str(tmp_path / "run")
+    net = _tiny_net()
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    loader = DataLoader(_ArangeDataset(8), batch_size=4)
+    tr.save_bundle(prefix, epoch=1, net=net, loader=loader)
+    _preemption.clear_bundle(prefix)
+    assert _preemption.read_bundle(prefix) is None
+    for p in _preemption.bundle_paths(prefix):
+        assert not os.path.exists(p)
+        assert not os.path.exists(resilience.manifest_path(p))
+
+
+def test_bundle_older_than_checkpoints_loses(tmp_path):
+    prefix = str(tmp_path / "run")
+    net = _tiny_net()
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    tr.save_bundle(prefix, epoch=1, net=net)
+    tr.save_checkpoint(prefix, 3, net=net)
+    # epoch checkpoint 3 is newer than the stale bundle: walk-back wins
+    assert tr.auto_resume(prefix, net=net) == 4
+
+
+def test_maybe_checkpoint_and_exit_noop_until_requested(tmp_path):
+    prefix = str(tmp_path / "run")
+    net = _tiny_net()
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    _preemption.maybe_checkpoint_and_exit(prefix, trainer=tr, net=net)
+    assert _preemption.read_bundle(prefix) is None
+    _preemption.install()
+    os.kill(os.getpid(), signal.SIGTERM)
+    with pytest.raises(_preemption.Preempted):
+        _preemption.maybe_checkpoint_and_exit(prefix, trainer=tr, net=net,
+                                              epoch=4)
+    bundle = _preemption.read_bundle(prefix)
+    assert bundle is not None and bundle["epoch"] == 4
+
+
+# ---------------------------------------------------------------------------
+# PS graceful leave
+# ---------------------------------------------------------------------------
+
+def test_ps_leave_shrinks_quorum_immediately():
+    srv = _ps.ParameterServer(2, host="127.0.0.1", port=0)
+    try:
+        c0 = _ps.PSClient("127.0.0.1", srv.port, instance="w0")
+        c1 = _ps.PSClient("127.0.0.1", srv.port, instance="w1")
+        c0.join(0)
+        c1.join(1)
+        assert c0.membership()["quorum"] == 2
+        # no heartbeat timeout involved: the default is far larger than
+        # this test's runtime, so only the leave RPC can shrink the quorum
+        assert c1.leave() == 1
+        assert c0.membership()["quorum"] == 1
+        # a stray late beat from the leaver must NOT re-admit it
+        c1.heartbeat(1)
+        assert c0.membership()["quorum"] == 1
+        # an explicit rejoin does, and bumps the epoch
+        info = c1.join(1)
+        assert info["readmitted"]
+        assert c0.membership()["quorum"] == 2
+        c0.close()
+        c1.close()
+    finally:
+        srv.shutdown()
+
+
+def test_ps_leave_is_idempotent():
+    srv = _ps.ParameterServer(2, host="127.0.0.1", port=0)
+    try:
+        c = _ps.PSClient("127.0.0.1", srv.port, instance="w1")
+        c.join(1)
+        assert c.leave() == 1
+        assert c.leave(1) == 1
+        c.close()
+    finally:
+        srv.shutdown()
+
+
+def test_ps_leave_before_join_requires_rank():
+    srv = _ps.ParameterServer(1, host="127.0.0.1", port=0)
+    try:
+        c = _ps.PSClient("127.0.0.1", srv.port)
+        with pytest.raises(RuntimeError, match="leave\\(\\) before join"):
+            c.leave()
+        c.close()
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# guardrails
+# ---------------------------------------------------------------------------
+
+def _train_one_step(tr, net, x):
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    tr.step(2)
+
+
+def _guardrail_world():
+    net = _tiny_net()
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    x = mx.nd.array(np.ones((2, 2), np.float32))
+    return net, tr, x
+
+
+def test_guardrail_skip_leaves_weights_untouched():
+    os.environ["MXTPU_GUARDRAIL_POLICY"] = "skip"
+    net, tr, x = _guardrail_world()
+    w0 = _weights(net)
+    _fault.install(_fault.FaultInjector("grad.nonfinite:fail@1", seed=0))
+    _train_one_step(tr, net, x)           # poisoned -> skipped
+    for a, b in zip(w0, _weights(net)):
+        np.testing.assert_array_equal(a, b)
+    _train_one_step(tr, net, x)           # clean -> applied
+    assert any(not np.array_equal(a, b)
+               for a, b in zip(w0, _weights(net)))
+
+
+def test_guardrail_backoff_attaches_unit_scaler():
+    os.environ["MXTPU_GUARDRAIL_POLICY"] = "backoff"
+    net, tr, x = _guardrail_world()
+    assert getattr(tr, "_amp_scaler", None) is None
+    w0 = _weights(net)
+    _fault.install(_fault.FaultInjector("grad.nonfinite:fail@1", seed=0))
+    _train_one_step(tr, net, x)
+    for a, b in zip(w0, _weights(net)):
+        np.testing.assert_array_equal(a, b)
+    # scaler lazily attached, pinned at 1.0: clean steps stay bit-exact
+    assert tr._amp_scaler is not None
+    assert tr._amp_scaler.loss_scale == 1.0
+    _train_one_step(tr, net, x)
+    assert any(not np.array_equal(a, b)
+               for a, b in zip(w0, _weights(net)))
+
+
+def test_guardrail_backoff_halves_live_amp_scaler():
+    from incubator_mxnet_tpu.contrib import amp
+
+    os.environ["MXTPU_GUARDRAIL_POLICY"] = "backoff"
+    net, tr, x = _guardrail_world()
+    amp.init_trainer(tr, amp.DynamicLossScaler(init_scale=8.0))
+    _fault.install(_fault.FaultInjector("grad.nonfinite:fail@1", seed=0))
+    _train_one_step(tr, net, x)
+    assert tr._amp_scaler.loss_scale == 4.0
+
+
+def test_guardrail_rollback_raises_without_applying():
+    os.environ["MXTPU_GUARDRAIL_POLICY"] = "rollback"
+    net, tr, x = _guardrail_world()
+    w0 = _weights(net)
+    _fault.install(_fault.FaultInjector("grad.nonfinite:fail@1", seed=0))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    with pytest.raises(GuardrailRollback):
+        tr.step(2)
+    for a, b in zip(w0, _weights(net)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_guardrail_rejects_unknown_policy():
+    os.environ["MXTPU_GUARDRAIL_POLICY"] = "explode"
+    net, tr, x = _guardrail_world()
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    with pytest.raises(ValueError, match="MXTPU_GUARDRAIL_POLICY"):
+        tr.step(2)
+
+
+def test_guardrail_off_by_default_costs_nothing():
+    net, tr, x = _guardrail_world()
+    # no policy: the injector site is never consulted
+    _fault.install(_fault.FaultInjector("grad.nonfinite:fail@1", seed=0))
+    _train_one_step(tr, net, x)
+    assert _fault.injector().fired(site="grad.nonfinite") == 0
+
+
+def test_train_step_sigterm_site_requests_drain():
+    _preemption.install()
+    net, tr, x = _guardrail_world()
+    _fault.install(_fault.FaultInjector("train.step:sigterm@2", seed=0))
+    _train_one_step(tr, net, x)
+    assert not _preemption.requested()
+    _train_one_step(tr, net, x)           # step 2: SIGTERM to self
+    # the step COMPLETED (drain semantics), only the flag is set
+    assert _preemption.requested()
+
+
+# ---------------------------------------------------------------------------
+# fault grammar + walk-back bound
+# ---------------------------------------------------------------------------
+
+def test_fault_grammar_accepts_sigterm_mode():
+    inj = _fault.FaultInjector("train.step:sigterm@5", seed=0)
+    assert inj.action("train.step") is None  # call 1
+    for _ in range(3):
+        inj.action("train.step")
+    assert inj.action("train.step") == "sigterm"  # call 5
+
+
+def test_fault_grammar_rejects_unknown_mode():
+    with pytest.raises(ValueError, match="mode"):
+        _fault.FaultInjector("train.step:explode@1", seed=0)
+
+
+def test_ckpt_walkback_bound(tmp_path):
+    prefix = str(tmp_path / "ck")
+    for e in range(5):
+        p = f"{prefix}-{e:04d}.params"
+        resilience.atomic_write_bytes(p, b"payload")
+        with open(p, "wb") as f:
+            f.write(b"torn")          # corrupt AFTER the manifest landed
+    os.environ["MXTPU_CKPT_WALKBACK"] = "3"
+    assert _model.latest_valid_checkpoint(prefix) is None
+    resilience.atomic_write_bytes(f"{prefix}-0000.params", b"good")
+    # bound 3 inspects epochs 4,3,2 and gives up before reaching 0
+    assert _model.latest_valid_checkpoint(prefix) is None
+    os.environ["MXTPU_CKPT_WALKBACK"] = "0"   # unbounded reaches it
+    assert _model.latest_valid_checkpoint(prefix) == 0

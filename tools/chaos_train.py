@@ -39,9 +39,22 @@ reference, mxtpu_ps_readmissions_total >= 1 in the metrics snapshot, and
 the join/readmission/eviction visible in both the flight-recorder dumps
 and the merged trace.
 
+With --preempt it runs the preemption / exact-resume proof: a fault-free
+reference gluon run records final weights and the full batch order; a
+training SUBPROCESS takes `train.step:sigterm@K` mid-epoch, drains (the
+in-flight step completes, a resume bundle with params + optimizer state
++ data-pipeline cursor + RNG position is written), and exits with code
+83; a second subprocess auto-resumes from the bundle and finishes.
+Asserts: exit code 83, and the resumed run's final weights AND the
+concatenated batch order are bit-identical to the uninterrupted
+reference. A second leg injects `grad.nonfinite` under
+MXTPU_GUARDRAIL_POLICY=rollback and proves rollback-and-replay recovers
+the fault-free trajectory exactly.
+
 Usage:  JAX_PLATFORMS=cpu python tools/chaos_train.py [--epochs 4]
         JAX_PLATFORMS=cpu python tools/chaos_train.py --observability
         JAX_PLATFORMS=cpu python tools/chaos_train.py --elastic
+        JAX_PLATFORMS=cpu python tools/chaos_train.py --preempt
 """
 import argparse
 import json
@@ -56,6 +69,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from incubator_mxnet_tpu import model, nd, ps as _ps, telemetry  # noqa: E402
 from incubator_mxnet_tpu.resilience import fault as _fault  # noqa: E402
+from incubator_mxnet_tpu.resilience import preemption as _preemption  # noqa: E402
 
 DIM = 8
 LR = np.float32(0.1)
@@ -77,6 +91,14 @@ OBS_EPOCHS = 3
 ELASTIC_EPOCHS = 4
 ELASTIC_KILL_EPOCH = 2
 ELASTIC_KEYS = ("w", "b")
+
+# preemption run: 3 epochs of 4 batches; SIGTERM at step 6 = batch 2 of
+# epoch 1 (0-based), so the drain and the resume are both mid-epoch
+PREEMPT_EPOCHS = 3
+PREEMPT_ITEMS = 13
+PREEMPT_BATCH = 4
+PREEMPT_SIGTERM_STEP = 6
+ROLLBACK_POISON_STEP = 6
 
 
 def _target(epoch, rank):
@@ -485,6 +507,225 @@ def run_elastic(workdir):
           f"timeline at {out}")
 
 
+class _PreemptDataset:
+    """dataset[i] is a row whose entries all equal i, so the batch tensors
+    ARE the batch-order record (same trick as tests/test_exact_resume.py)."""
+
+    def __init__(self, n=PREEMPT_ITEMS, dim=4):
+        self._n, self._dim = n, dim
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        return np.full(self._dim, i, dtype=np.float32)
+
+
+def _preempt_loop(prefix, log_path, resume=False, seed=4321):
+    """One single-worker gluon training run: PREEMPT_EPOCHS over a
+    shuffled _PreemptDataset, appending each consumed batch's index row to
+    `log_path` and offering a drain point after every step. Returns the
+    final weights (positional order)."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.gluon import Trainer, nn
+    from incubator_mxnet_tpu.gluon.data import DataLoader
+
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    net = nn.Dense(3)
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.array(np.ones((1, 4), np.float32)))  # shape-bind the params
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05})
+    loader = DataLoader(_PreemptDataset(), batch_size=PREEMPT_BATCH,
+                        shuffle=True)
+    start = trainer.auto_resume(prefix, net=net, loader=loader) if resume \
+        else 0
+    with open(log_path, "a") as log:
+        for epoch in range(start, PREEMPT_EPOCHS):
+            for batch in loader:
+                with autograd.record():
+                    loss = (net(batch) ** 2).mean()
+                loss.backward()
+                trainer.step(batch.shape[0])
+                log.write(" ".join(
+                    str(int(v)) for v in batch.asnumpy()[:, 0]) + "\n")
+                log.flush()
+                # the drain point: a no-op until a SIGTERM lands, then it
+                # writes the bundle, leaves the sync group, and exits 83
+                _preemption.maybe_checkpoint_and_exit(
+                    prefix, trainer=trainer, net=net, loader=loader,
+                    epoch=epoch)
+    return [v.data().asnumpy().copy()
+            for _, v in sorted(net.collect_params().items())]
+
+
+def _preempt_prefix(workdir):
+    return os.path.join(workdir, "bundle", "train")
+
+
+def _preempt_child(workdir, phase):
+    """Subprocess entry point for the two training legs of --preempt."""
+    prefix = _preempt_prefix(workdir)
+    os.makedirs(os.path.dirname(prefix), exist_ok=True)
+    _preemption.install()
+    w = _preempt_loop(prefix, os.path.join(workdir, f"batches-{phase}.txt"),
+                      resume=(phase == "resume"))
+    # only reached when the loop FINISHES (the interrupt phase exits 83
+    # from inside the drain point instead)
+    np.savez(os.path.join(workdir, "final-weights.npz"), *w)
+
+
+def _rollback_loop(prefix, seed=99):
+    """Epoch-granular train loop for the guardrail-rollback leg: a resume
+    bundle is written at every epoch start; a GuardrailRollback trip
+    restores it and replays the epoch. Returns (weights, rollbacks)."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.gluon import Trainer, nn
+    from incubator_mxnet_tpu.gluon.data import DataLoader
+    from incubator_mxnet_tpu.gluon.trainer import GuardrailRollback
+
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    net = nn.Dense(3)
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.array(np.ones((1, 4), np.float32)))
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05})
+    loader = DataLoader(_PreemptDataset(), batch_size=PREEMPT_BATCH,
+                        shuffle=True)
+    epoch, rollbacks = 0, 0
+    while epoch < PREEMPT_EPOCHS:
+        trainer.save_bundle(prefix, epoch=epoch, net=net, loader=loader)
+        try:
+            for batch in loader:
+                with autograd.record():
+                    loss = (net(batch) ** 2).mean()
+                loss.backward()
+                trainer.step(batch.shape[0])
+            epoch += 1
+        except GuardrailRollback:
+            rollbacks += 1
+            assert rollbacks <= PREEMPT_EPOCHS, "rollback is not converging"
+            epoch = trainer.auto_resume(prefix, net=net, loader=loader)
+    return ([v.data().asnumpy().copy()
+             for _, v in sorted(net.collect_params().items())], rollbacks)
+
+
+def _rollback_leg(workdir):
+    """Second half of --preempt: poison one gradient mid-run under
+    MXTPU_GUARDRAIL_POLICY=rollback and prove restore-and-replay lands on
+    the fault-free trajectory exactly."""
+    telemetry.enable()
+    rdir = os.path.join(workdir, "rollback")
+    os.makedirs(rdir, exist_ok=True)
+
+    os.environ.pop("MXTPU_GUARDRAIL_POLICY", None)
+    _fault.install(_fault.FaultInjector("", 0))
+    w_ref, rollbacks = _rollback_loop(os.path.join(rdir, "ref"))
+    assert rollbacks == 0
+    print(f"[chaos] rollback reference done: {PREEMPT_EPOCHS} epochs clean")
+
+    os.environ["MXTPU_GUARDRAIL_POLICY"] = "rollback"
+    inj = _fault.install(_fault.FaultInjector(
+        f"grad.nonfinite:fail@{ROLLBACK_POISON_STEP}", seed=7))
+    try:
+        w_chaos, rollbacks = _rollback_loop(os.path.join(rdir, "chaos"))
+    finally:
+        os.environ.pop("MXTPU_GUARDRAIL_POLICY", None)
+        _fault.install(None)
+    fired = inj.fired("grad.nonfinite", "fail")
+    assert fired == 1, f"expected 1 poisoned gradient, fired {fired}"
+    assert rollbacks == 1, f"expected exactly 1 rollback, got {rollbacks}"
+    print(f"[chaos] guardrail tripped at step {ROLLBACK_POISON_STEP}, "
+          "rolled back to the epoch-start bundle and replayed")
+
+    assert len(w_chaos) == len(w_ref)
+    for a, b in zip(w_chaos, w_ref):
+        assert a.dtype == b.dtype
+        assert np.array_equal(a, b), (
+            f"rollback replay diverged from the fault-free run:\n"
+            f"  ref   = {b}\n  final = {a}")
+    trips = sum(
+        float(line.rsplit(" ", 1)[1])
+        for line in telemetry.prometheus_text().splitlines()
+        if line.startswith("mxtpu_guardrail_trips_total")
+        and not line.startswith("#"))
+    assert trips >= 1, f"guardrail trip counter at {trips}, need >= 1"
+    print(f"[chaos] PASS (rollback): {int(trips)} guardrail trip(s); "
+          "replayed weights bit-identical to the fault-free reference")
+
+
+def run_preempt(workdir):
+    """The preemption / exact-resume acceptance proof (module docstring)."""
+    import subprocess
+
+    # --- 1. uninterrupted reference, in-process ---------------------------
+    _fault.install(_fault.FaultInjector("", 0))
+    os.environ.pop("MXTPU_FAULT_SPEC", None)
+    ref_dir = os.path.join(workdir, "ref")
+    os.makedirs(ref_dir, exist_ok=True)
+    ref_log = os.path.join(workdir, "batches-reference.txt")
+    w_ref = _preempt_loop(os.path.join(ref_dir, "train"), ref_log)
+    with open(ref_log) as f:
+        ref_batches = f.read().splitlines()
+    print(f"[chaos] preempt reference done: {PREEMPT_EPOCHS} epochs, "
+          f"{len(ref_batches)} steps")
+
+    # --- 2. the preempted run: SIGTERM mid-epoch, drain, exit 83 ----------
+    def child(phase, extra_env):
+        env = dict(os.environ, JAX_PLATFORMS="cpu", **extra_env)
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--preempt-child", phase, "--workdir", workdir],
+            env=env, timeout=600)
+
+    spec = f"train.step:sigterm@{PREEMPT_SIGTERM_STEP}"
+    p = child("interrupt", {"MXTPU_FAULT_SPEC": spec})
+    assert p.returncode == _preemption.PREEMPTED_EXIT_CODE, (
+        f"preempted child exited {p.returncode}, expected "
+        f"{_preemption.PREEMPTED_EXIT_CODE}")
+    bundle = _preemption.read_bundle(_preempt_prefix(workdir))
+    assert bundle is not None, "preempted child left no readable bundle"
+    assert bundle["has_params"] and bundle["has_states"], bundle
+    assert bundle["loader"] is not None, "bundle lost the loader cursor"
+    with open(os.path.join(workdir, "batches-interrupt.txt")) as f:
+        part1 = f.read().splitlines()
+    assert len(part1) == PREEMPT_SIGTERM_STEP, (
+        f"drain let {len(part1)} steps finish, expected the in-flight "
+        f"step to complete: {PREEMPT_SIGTERM_STEP}")
+    print(f"[chaos] child preempted after step {len(part1)} "
+          f"(mid-epoch {bundle['epoch']}), exit code {p.returncode}, "
+          "bundle verified")
+
+    # --- 3. the resumed run picks up mid-epoch and finishes --------------
+    p = child("resume", {})
+    assert p.returncode == 0, f"resumed child exited {p.returncode}"
+    with open(os.path.join(workdir, "batches-resume.txt")) as f:
+        part2 = f.read().splitlines()
+
+    # --- verdicts ---------------------------------------------------------
+    assert part1 + part2 == ref_batches, (
+        "batch order across preempt+resume diverged from the "
+        f"uninterrupted run:\n  ref    = {ref_batches}\n"
+        f"  pieces = {part1 + part2}")
+    final = np.load(os.path.join(workdir, "final-weights.npz"))
+    w_final = [final[k] for k in sorted(final.files,
+                                        key=lambda n: int(n[4:]))]
+    assert len(w_final) == len(w_ref)
+    for a, b in zip(w_final, w_ref):
+        assert a.dtype == b.dtype
+        assert np.array_equal(a, b), (
+            f"resumed weights diverged from the uninterrupted run:\n"
+            f"  ref   = {b}\n  final = {a}")
+    print(f"[chaos] PASS (preempt): exit 83 + resume replayed "
+          f"{len(part2)} remaining steps; batch order and final weights "
+          "bit-identical to the uninterrupted run")
+
+    # --- 4. divergence guardrail: rollback recovers the trajectory --------
+    _rollback_leg(workdir)
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--epochs", type=int, default=4)
@@ -498,6 +739,11 @@ def main():
     ap.add_argument("--elastic", action="store_true",
                     help="run the elastic-membership proof instead of "
                          "the recovery proof")
+    ap.add_argument("--preempt", action="store_true",
+                    help="run the preemption / exact-resume proof instead "
+                         "of the recovery proof")
+    ap.add_argument("--preempt-child", choices=("interrupt", "resume"),
+                    help=argparse.SUPPRESS)  # internal: --preempt phases
     args = ap.parse_args()
 
     import tempfile
@@ -505,11 +751,17 @@ def main():
     workdir = args.workdir or tempfile.mkdtemp(prefix="mxtpu-chaos-")
     os.makedirs(workdir, exist_ok=True)
 
+    if args.preempt_child:
+        _preempt_child(workdir, args.preempt_child)
+        return
     if args.observability:
         run_observability(workdir)
         return
     if args.elastic:
         run_elastic(workdir)
+        return
+    if args.preempt:
+        run_preempt(workdir)
         return
 
     init_w = np.zeros(DIM, dtype=np.float32)
