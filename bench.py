@@ -496,6 +496,95 @@ def dispatch_overhead_main(assert_mode=False):
         assert match, "aggregated and per-param weights diverged"
 
 
+def observatory_main(assert_mode=False):
+    """Performance-observatory bench: a small dense net trained for two
+    epochs with full telemetry on. Reports the per-phase step breakdown
+    (sum must track total step time), the HBM peak with span attribution,
+    and the retrace count over the steady-shape second epoch (must be 0).
+    --assert turns those properties into hard failures (the CI perf-gate
+    tier runs this mode)."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, gluon, autograd, telemetry
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.telemetry import stepstats, ledger, compilereg
+
+    n_layers = int(os.environ.get("BENCH_OBS_LAYERS", "4"))
+    width = int(os.environ.get("BENCH_OBS_WIDTH", "32"))
+    batch = int(os.environ.get("BENCH_OBS_BATCH", "32"))
+    n_batches = int(os.environ.get("BENCH_OBS_BATCHES", "8"))
+    telemetry.enable()
+    stepstats.reset()
+    ledger.reset()
+    compilereg.reset()
+
+    # explicit in_units: params materialize (and get ledger-tracked) now
+    net = nn.Sequential()
+    for _ in range(n_layers):
+        net.add(nn.Dense(width, in_units=width))
+    net.add(nn.Dense(1, in_units=width))
+    net.initialize(mx.init.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.01, "momentum": 0.9})
+    rng = np.random.RandomState(3)
+    x = rng.uniform(-1, 1, size=(batch * n_batches, width)).astype("float32")
+    y = rng.uniform(-1, 1, size=(batch * n_batches, 1)).astype("float32")
+    loader = gluon.data.DataLoader(
+        gluon.data.ArrayDataset(nd.array(x), nd.array(y)),
+        batch_size=batch)
+    loss_fn = gluon.loss.L2Loss()
+
+    def retraces():
+        total = 0.0
+        c = telemetry.REGISTRY.get("mxtpu_retraces_total")
+        if c is not None:
+            total = sum(child.value for _, child in c.series())
+        return total
+
+    def one_epoch():
+        for bx, by in loader:
+            with autograd.record():
+                # forward/backward issue async XLA work: dispatch phase
+                with stepstats.phase("dispatch"):
+                    loss = loss_fn(net(bx), by)
+            with stepstats.phase("dispatch"):
+                loss.backward()
+            tr.step(batch)  # optimizer_update phase + step_end inside
+            with stepstats.phase("device_sync"):
+                loss.asnumpy()
+
+    one_epoch()
+    r1 = retraces()
+    one_epoch()
+    r2 = retraces()
+
+    snap = stepstats.snapshot()
+    peak = ledger.peak_info()
+    out = {
+        "metric": "perf_observatory",
+        "value": round(snap.get("coverage") or 0.0, 4),
+        "unit": "phase_coverage_of_step_total",
+        "steps": snap["steps"],
+        "phases": {name: {"p50": round(q["p50"], 6), "p99": round(q["p99"], 6)}
+                   for name, q in snap["phases"].items()},
+        "hbm_peak_bytes": int(peak["peak_bytes"]),
+        "hbm_peak_span": peak["span"],
+        "retraces_epoch1": int(r1),
+        "retraces_epoch2": int(r2 - r1),
+        "anomalies": int(snap["anomalies"]),
+        "compiled_fns": len(compilereg.snapshot()),
+    }
+    print(json.dumps(out), flush=True)
+    if assert_mode:
+        cov = snap.get("coverage") or 0.0
+        assert 0.9 <= cov <= 1.1, (
+            f"phase sum diverged from step total: coverage={cov:.3f}")
+        assert peak["peak_bytes"] > 0 and peak["span"], (
+            f"HBM peak lacks span attribution: {peak}")
+        assert r2 - r1 == 0, (
+            f"steady-shape second epoch retraced {r2 - r1} time(s)")
+
+
 def main():
     # HBM-traffic lever axes (satellite flags; env inheritance carries
     # them into the measurement children)
@@ -511,6 +600,9 @@ def main():
             os.environ["MXTPU_STOCHASTIC_ROUNDING"] = "1"
     if "--dispatch-overhead" in sys.argv or os.environ.get("BENCH_DISPATCH"):
         dispatch_overhead_main(assert_mode="--assert" in sys.argv)
+        return
+    if "--observatory" in sys.argv or os.environ.get("BENCH_OBSERVATORY"):
+        observatory_main(assert_mode="--assert" in sys.argv)
         return
     if os.environ.get("BENCH_CHILD"):
         child_main()

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI tiers (ref: ci/docker/runtime_functions.sh — unittest / nightly /
 # distributed stages). Usage:
-#   ci/run_tests.sh [unit|nightly|dist|examples|telemetry|aggregation|static-analysis|perf-structure|chaos|all]
+#   ci/run_tests.sh [unit|nightly|dist|examples|telemetry|aggregation|static-analysis|perf-structure|perf-gate|chaos|all]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -169,6 +169,33 @@ run_perf_structure() {
         --assert-structure
 }
 
+run_perf_gate() {
+    echo "=== perf-gate tier (bench metrics vs committed baseline) ==="
+    # both JSON-emitting bench modes against ci/perf_baseline.json:
+    # deterministic counters (dispatch counts, retraces, anomalies) carry
+    # zero-tolerance bands; wall-clock ratios are report-only. --assert on
+    # the observatory run also enforces phase-sum coverage, HBM peak span
+    # attribution, and zero second-epoch retraces inside the bench itself.
+    local gate_dir
+    gate_dir="$(mktemp -d -t mxtpu-perf-gate-XXXXXX)"
+    JAX_PLATFORMS=cpu python bench.py --dispatch-overhead \
+        > "$gate_dir/bench.json"
+    JAX_PLATFORMS=cpu python bench.py --observatory --assert \
+        >> "$gate_dir/bench.json"
+    python tools/perf_gate.py "$gate_dir/bench.json" \
+        --baseline ci/perf_baseline.json
+    # negative self-test: a seeded dispatch-count regression MUST fail
+    if python tools/perf_gate.py "$gate_dir/bench.json" \
+        --baseline ci/perf_baseline.json \
+        --inject trainer_dispatch_overhead.aggregated_dispatches=4.0 \
+        > "$gate_dir/inject.log" 2>&1; then
+        echo "FAIL: perf_gate passed a seeded 4x dispatch regression" >&2
+        cat "$gate_dir/inject.log" >&2
+        exit 1
+    fi
+    echo "perf-gate: baseline comparison passed; seeded regression rejected"
+}
+
 run_nightly() {
     echo "=== nightly tier (large tensors, checkpoint compat, 7-worker dist) ==="
     MXTPU_NIGHTLY=1 python -m pytest tests/test_large_array.py \
@@ -197,8 +224,9 @@ case "$tier" in
     static-analysis) run_static_analysis ;;
     chaos)     run_chaos ;;
     perf-structure) run_perf_structure ;;
+    perf-gate) run_perf_gate ;;
     nightly)   run_nightly ;;
-    all)       run_static_analysis; run_unit; run_telemetry; run_aggregation; run_perf_structure; run_chaos; run_dist; run_examples; run_nightly ;;
-    *) echo "unknown tier: $tier (unit|nightly|dist|examples|suite|telemetry|aggregation|static-analysis|perf-structure|chaos|all)"; exit 2 ;;
+    all)       run_static_analysis; run_unit; run_telemetry; run_aggregation; run_perf_structure; run_perf_gate; run_chaos; run_dist; run_examples; run_nightly ;;
+    *) echo "unknown tier: $tier (unit|nightly|dist|examples|suite|telemetry|aggregation|static-analysis|perf-structure|perf-gate|chaos|all)"; exit 2 ;;
 esac
 echo "tier '$tier' green"

@@ -327,6 +327,29 @@ register_knob("MXNET_TELEMETRY_MEM_INTERVAL", 1, int,
               "Trainer steps between device-memory watermark samples at "
               "step boundaries (0 disables memory sampling; sampling reads "
               "device.memory_stats() plus host RSS).")
+register_knob("MXNET_TELEMETRY_STEPSTATS_WINDOW", 128, int,
+              "Rolling-window length (steps) for StepStats per-phase "
+              "p50/p99 gauges and the step-anomaly median (performance "
+              "observatory, docs/OBSERVABILITY.md).")
+register_knob("MXNET_TELEMETRY_ANOMALY_FACTOR", 3.0, float,
+              "A step whose wall time exceeds this multiple of the "
+              "rolling median step time emits a flight-recorder "
+              "step_anomaly event and bumps mxtpu_step_anomalies_total.")
+register_knob("MXNET_TELEMETRY_ANOMALY_MIN_STEPS", 8, int,
+              "Minimum steps in the StepStats window before anomaly "
+              "detection arms (suppresses warmup/compile outliers).")
+register_knob("MXNET_TELEMETRY_LEDGER_INTERVAL", 1, int,
+              "Trainer steps between HBM-ledger live-set samples at step "
+              "boundaries (0 disables ledger sampling and the leak "
+              "heuristic; role gauges still track alloc/free).")
+register_knob("MXNET_TELEMETRY_LEAK_WINDOW", 8, int,
+              "Consecutive monotonically-growing ledger samples before "
+              "the leak heuristic fires a memory_leak_suspect event "
+              "(0 disables the heuristic).")
+register_knob("MXTPU_PERF_GATE_TOLERANCE", 20.0, float,
+              "Default per-metric tolerance (percent) for "
+              "tools/perf_gate.py when a baseline entry carries no "
+              "explicit tolerance_pct band.")
 
 # numerics / reproducibility
 register_knob("MXTPU_DEFAULT_DTYPE", "float32", str,

@@ -40,9 +40,12 @@ def waitall():
                             "(swallowed; see debug log for tracebacks).")
     finally:
         if t0 is not None:
-            _telemetry.observe("mxtpu_engine_waitall_seconds",
-                               time.perf_counter() - t0,
+            dt = time.perf_counter() - t0
+            _telemetry.observe("mxtpu_engine_waitall_seconds", dt,
                                help="Wall time blocked in engine.waitall.")
+            # waitall is the loop's explicit device barrier; its blocked
+            # time is the step's device_sync phase
+            _telemetry.stepstats.record("device_sync", dt)
 
 
 def set_bulk_size(size):

@@ -76,6 +76,14 @@ class Executor:
         # post-mortem dump showing one near the failure is signal
         _telemetry.log_event("executor_bind", args=len(self.arg_dict),
                              outputs=len(symbol.list_outputs()))
+        # compile registry: two binds of the same symbol with different
+        # arg shapes are a retrace of that graph
+        _telemetry.compilereg.register(
+            f"executor.bind[{getattr(symbol, 'name', None) or 'sym'}]",
+            tuple(sorted(
+                (n, tuple(a.shape), str(a.dtype))
+                for n, a in {**self.arg_dict, **self.aux_dict}.items()
+                if a is not None)))
 
     # -- properties mirroring the reference Executor ----------------------
     @property
@@ -113,6 +121,7 @@ class Executor:
         if not is_train:
             outs, _ = self._jit_infer(args, aux, key)
             self.outputs = [NDArray._from_data(o) for o in outs]
+            _telemetry.ledger.track(self.outputs, "activations")
             self._vjp = None
             return self.outputs
 
@@ -135,6 +144,7 @@ class Executor:
             if k in self.aux_dict:
                 self.aux_dict[k]._data = v
         self.outputs = [NDArray._from_data(o) for o in outs]
+        _telemetry.ledger.track(self.outputs, "activations")
         return self.outputs
 
     def backward(self, out_grads=None, is_train=True):

@@ -24,12 +24,17 @@ time-dependent or stochastic custom updates.
 """
 from __future__ import annotations
 
+import time as _time
+
 import jax
 import jax.numpy as jnp
 
 from . import autograd
 from . import config
 from . import random as _global_random
+from . import telemetry as _telemetry
+from .telemetry import compilereg as _compilereg
+from .telemetry import stepstats as _stepstats
 from .gluon.block import _ParamSubst
 from .ndarray.ndarray import NDArray
 from .optimizer import _cast_state_like as _cast_like
@@ -503,21 +508,37 @@ class GluonTrainStep:
             )
         xd = x._data if isinstance(x, NDArray) else jnp.asarray(x)
         yd = y._data if isinstance(y, NDArray) else jnp.asarray(y)
-        if self._data_sharding is not None:
-            xd = jax.device_put(xd, self._data_sharding)
-            yd = jax.device_put(yd, self._data_sharding)
-        elif self.device is not None:
-            xd = jax.device_put(xd, self.device)
-            yd = jax.device_put(yd, self.device)
+        with _stepstats.phase("h2d"):
+            if self._data_sharding is not None:
+                xd = jax.device_put(xd, self._data_sharding)
+                yd = jax.device_put(yd, self._data_sharding)
+            elif self.device is not None:
+                xd = jax.device_put(xd, self.device)
+                yd = jax.device_put(yd, self.device)
         key = _global_random.next_key()
         self._n += 1
         self.opt.num_update = self._n
         lr = self.opt.lr_scheduler(self._n) if self.opt.lr_scheduler else self.opt.lr
-        loss, self._params, self._states = self._step(
-            self._params, self._states, xd, yd, key,
-            jnp.asarray(lr, jnp.float32),
-            jnp.asarray(float(self._n), jnp.float32),
-        )
+        sig = None
+        if _telemetry.enabled():
+            sig = ((tuple(xd.shape), str(xd.dtype)),
+                   (tuple(yd.shape), str(yd.dtype)))
+            first = not _compilereg.seen("GluonTrainStep.step", sig)
+            t0 = _time.perf_counter()
+        with _stepstats.phase("dispatch"):
+            loss, self._params, self._states = self._step(
+                self._params, self._states, xd, yd, key,
+                jnp.asarray(lr, jnp.float32),
+                jnp.asarray(float(self._n), jnp.float32),
+            )
+        if sig is not None:
+            # a first-seen batch signature means this dispatch traced and
+            # compiled; any later new signature is a retrace (the event
+            # ROADMAP item 4's compile-cache key must eliminate)
+            _compilereg.register(
+                "GluonTrainStep.step", sig,
+                compile_s=(_time.perf_counter() - t0) if first else None)
+            _stepstats.step_end()
         return NDArray._from_data(loss)
 
     def scan_steps(self, xs, ys):
@@ -665,8 +686,15 @@ class GluonTrainStep:
             ca = self._step.lower(*abstract).compile().cost_analysis()
             if isinstance(ca, list):  # older jax returns [dict]
                 ca = ca[0]
-            return {"flops": float(ca.get("flops", 0.0)),
-                    "bytes_accessed": float(ca.get("bytes accessed", 0.0))}
+            res = {"flops": float(ca.get("flops", 0.0)),
+                   "bytes_accessed": float(ca.get("bytes accessed", 0.0))}
+            if res and _telemetry.enabled():
+                sigd = ((tuple(xd.shape), str(xd.dtype)),
+                        (tuple(yd.shape), str(yd.dtype)))
+                _compilereg.register("GluonTrainStep.step", sigd)
+                _compilereg.annotate("GluonTrainStep.step", signature=sigd,
+                                     cost=res)
+            return res
         except Exception:  # no cost model on this backend/runtime
             return {}
 

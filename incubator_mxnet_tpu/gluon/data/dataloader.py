@@ -98,10 +98,13 @@ class DataLoader:
                     batch = next(it)
                 except StopIteration:
                     break
+                dt = _time.perf_counter() - t0
                 _telemetry.observe(
-                    "mxtpu_dataloader_fetch_seconds",
-                    _time.perf_counter() - t0,
+                    "mxtpu_dataloader_fetch_seconds", dt,
                     help="Time the training loop blocked fetching a batch.")
+                # the same measurement feeds the step breakdown: fetch
+                # time belongs to the step that consumes the batch
+                _telemetry.stepstats.record("data_fetch", dt)
                 self._batches += 1
                 yield batch
         # epoch bookkeeping only on normal exhaustion: an abandoned

@@ -17,6 +17,7 @@ from ..base import dtype_np
 from ..context import Context, cpu, current_context
 from ..ndarray.ndarray import NDArray
 from ..ndarray import zeros as nd_zeros
+from ..telemetry import ledger as _ledger
 
 _current_subst_fn = None
 
@@ -143,12 +144,14 @@ class Parameter:
         arr = nd_zeros(self._shape, ctx=ctx, dtype=self.dtype)
         initializer(init_mod.InitDesc(self.name, {"__init__": None}), arr)
         self._data = arr
+        _ledger.track(arr, "params")
         self._deferred_init = None
         if self._grad_req != "null":
             self._attach_grad()
 
     def _attach_grad(self):
         self._grad = NDArray._from_data(jnp.zeros(self._shape, dtype_np(self.dtype)))
+        _ledger.track(self._grad, "grads")
         self._data._grad = self._grad
         self._data._grad_req = self._grad_req
         # backward() may swap _grad for a RowSparseNDArray; this backref
@@ -196,6 +199,7 @@ class Parameter:
         if self._data is None:
             self._shape = arr.shape
             self._data = NDArray(jnp.asarray(arr._data, dtype_np(self.dtype)))
+            _ledger.track(self._data, "params")
             self._deferred_init = None
             if self._grad_req != "null":
                 self._attach_grad()

@@ -151,7 +151,8 @@ class Trainer:
     def allreduce_grads(self):
         """(ref: trainer.py:327) — multi-host sum via kvstore; intra-host is
         already reduced by GSPMD."""
-        with _telemetry.span("trainer.allreduce_grads"):
+        with _telemetry.span("trainer.allreduce_grads"), \
+                _telemetry.stepstats.phase("allreduce"):
             self._allreduce_grads_impl()
 
     def _allreduce_grads_impl(self):
@@ -248,6 +249,9 @@ class Trainer:
 
             fns = (jax.jit(fl), jax.jit(unfl))
             self._flat_fn_cache[key] = fns
+            # a miss here is a fresh trace pair; a second layout for the
+            # same trainer is a retrace (shape-driven bucket churn)
+            _telemetry.compilereg.register("trainer.flatten", key)
         return fns
 
     def _grads_nonfinite(self):
@@ -357,8 +361,15 @@ class Trainer:
                     help="End-to-end Trainer.step latency (allreduce + "
                          "optimizer update; excludes forward/backward).")
                 # step boundary: the agreed sampling point for device
-                # memory watermarks (MXNET_TELEMETRY_MEM_INTERVAL)
+                # memory watermarks (MXNET_TELEMETRY_MEM_INTERVAL) and the
+                # HBM ledger (MXNET_TELEMETRY_LEDGER_INTERVAL)
                 _telemetry.step_boundary()
+                # close the StepStats step: phases fed since the previous
+                # boundary (data fetch, dispatch, allreduce, update, sync)
+                # roll into the per-phase p50/p99 window; the step total is
+                # wall time since the previous boundary, so the anomaly
+                # guard sees the whole loop iteration
+                _telemetry.stepstats.step_end()
 
     def _step_impl(self, batch_size, ignore_stale_grad=False):
         inj = _fault.injector()
@@ -393,19 +404,20 @@ class Trainer:
             # GSPMD reduction, vs one push+pull per parameter on the
             # flat fallback.
             kv = self._kvstore
-            if getattr(kv, "supports_hierarchical_pushpull", False):
-                kv.pushpull(list(range(len(self._params))),
-                            [p.grad() for p in self._params],
-                            out=[p.data() for p in self._params])
-                _telemetry.inc(_DISPATCHES, 1, kind="server_pushpull",
-                               path="hierarchical", help=_DISPATCH_HELP)
-            else:
-                for i, p in enumerate(self._params):
-                    kv.push(i, p.grad())
-                    kv.pull(i, out=p.data())
-                _telemetry.inc(_DISPATCHES, len(self._params),
-                               kind="server_pushpull", path="per_key",
-                               help=_DISPATCH_HELP)
+            with _telemetry.stepstats.phase("pushpull"):
+                if getattr(kv, "supports_hierarchical_pushpull", False):
+                    kv.pushpull(list(range(len(self._params))),
+                                [p.grad() for p in self._params],
+                                out=[p.data() for p in self._params])
+                    _telemetry.inc(_DISPATCHES, 1, kind="server_pushpull",
+                                   path="hierarchical", help=_DISPATCH_HELP)
+                else:
+                    for i, p in enumerate(self._params):
+                        kv.push(i, p.grad())
+                        kv.pull(i, out=p.data())
+                    _telemetry.inc(_DISPATCHES, len(self._params),
+                                   kind="server_pushpull", path="per_key",
+                                   help=_DISPATCH_HELP)
             return
         if self._kvstore is not None:
             self.allreduce_grads()
@@ -417,7 +429,8 @@ class Trainer:
         if skip:
             return
         self._optimizer.rescale_grad = eff
-        self._update(ignore_stale_grad)
+        with _telemetry.stepstats.phase("optimizer_update"):
+            self._update(ignore_stale_grad)
 
     def update(self, batch_size, ignore_stale_grad=False):
         self._init_kvstore()
@@ -432,7 +445,8 @@ class Trainer:
         if skip:
             return
         self._optimizer.rescale_grad = eff
-        self._update(ignore_stale_grad)
+        with _telemetry.stepstats.phase("optimizer_update"):
+            self._update(ignore_stale_grad)
 
     # -- aggregated multi-tensor update path --------------------------------
 
@@ -538,6 +552,7 @@ class Trainer:
             if i not in u.states:
                 u.states[i] = o.create_state_multi_precision(i, w)
                 u.states_synced[i] = True
+                _telemetry.ledger.track(u.states[i], "optimizer_state")
         states = [u.states[i] for i in bucket]
         # advance every count BEFORE reading ts/base_lr: on the eager path
         # all params of step n already see num_update == n (the first
@@ -565,6 +580,11 @@ class Trainer:
             else:
                 fn = self._build_bucket_fn(names)
             self._agg_fn_cache[key] = fn
+            # new (optimizer-kind, hyper) program for this bucket: a second
+            # key for the same bucket id means hyper/signature churn
+            # retraced it (each bucket id is its own program, not a retrace)
+            _telemetry.compilereg.register(
+                f"trainer.bucket_update[{bid}]", key[1:])
         w_data = [w._data for w in weights]
         s_data = [self._state_data(s) for s in states]
         g_data = [g._data for g in grads]

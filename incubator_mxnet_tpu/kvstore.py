@@ -153,6 +153,7 @@ class KVStore:
             return
         v = value[0] if isinstance(value, (list, tuple)) else value
         self._store[key] = v if isinstance(v, NDArray) else NDArray(v)
+        _telemetry.ledger.track(self._store[key], "kv_buffers")
 
     def _reduce(self, value):
         """Sum a list of per-device values (CommCPU/CommDevice analog).

@@ -126,6 +126,9 @@ def _maybe_jit(opdef, fn, call_attrs, live_idx, n_slots):
             "mxtpu_eager_jit_cache_size", len(_EAGER_JIT_CACHE),
             help="Entries in the eager-dispatch jit cache "
                  "(LRU, capped by MXTPU_EAGER_JIT_CACHE_SIZE).")
+        # compile registry: a second attrs/arity key for the same op is a
+        # retrace of that op's eager program
+        _telemetry.compilereg.register(f"eager.{opdef.name}", key[1:])
     else:
         _EAGER_JIT_CACHE.move_to_end(key)
     return cached

@@ -39,6 +39,9 @@ from .spans import Span, NoopSpan, NOOP_SPAN, current_span, SPAN_HISTOGRAM  # no
 from .recorder import log_event  # noqa: F401
 from .exporters import dump_json, prometheus_text, start_http_server, to_dict  # noqa: F401
 from .memory import sample_device_memory, step_boundary  # noqa: F401
+from . import stepstats  # noqa: F401
+from . import ledger  # noqa: F401
+from . import compilereg  # noqa: F401
 from .tb import LogTelemetryCallback  # noqa: F401
 
 __all__ = [
@@ -48,6 +51,7 @@ __all__ = [
     "distributed", "recorder", "log_event",
     "dump_json", "prometheus_text", "start_http_server", "to_dict",
     "sample_device_memory", "step_boundary", "LogTelemetryCallback",
+    "stepstats", "ledger", "compilereg",
     "enabled", "enable", "disable", "refresh_from_env",
     "counter", "gauge", "histogram", "inc", "observe", "set_gauge",
     "METRIC_NAMES", "SPAN_NAMES", "is_registered_metric",

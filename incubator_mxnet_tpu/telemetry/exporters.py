@@ -80,10 +80,44 @@ def dump_json(path=None, registry=None):
     return data
 
 
+def _estimate_quantile(bounds, buckets, count, mn, mx, q):
+    """Quantile estimate by linear interpolation inside the bucket the
+    target rank lands in (non-cumulative bucket counts; observations
+    past the last bound resolve to the recorded max). Clamped to the
+    child's [min, max] so sparse low buckets can't report a value no
+    observation ever had."""
+    if not count:
+        return None
+    target = q * count
+    cum = 0.0
+    lo = 0.0
+    est = None
+    for b, n in zip(bounds, buckets):
+        if n and cum + n >= target:
+            est = lo + (b - lo) * ((target - cum) / n)
+            break
+        cum += n
+        lo = b
+    if est is None:  # rank lives in the +Inf overflow bucket
+        est = mx
+    if mn is not None:
+        est = max(est, mn)
+    if mx is not None:
+        est = min(est, mx)
+    return est
+
+
+# precomputed summary quantiles emitted per histogram child — scrapers
+# get p50/p95/p99 without PromQL histogram_quantile math
+_SUMMARY_QUANTILES = (("0.5", 0.5), ("0.95", 0.95), ("0.99", 0.99))
+
+
 def prometheus_text(registry=None):
     """Text exposition format 0.0.4. Histogram buckets are cumulative and
-    always include le="+Inf"; counters keep whatever name they were
-    registered under (instrumented sites use the `_total` convention)."""
+    always include le="+Inf"; each histogram child also carries
+    precomputed p50/p95/p99 samples under a `quantile` label (summary
+    convention); counters keep whatever name they were registered under
+    (instrumented sites use the `_total` convention)."""
     registry = registry or REGISTRY
     lines = []
     for metric in registry.collect():
@@ -91,7 +125,7 @@ def prometheus_text(registry=None):
         lines.append(f"# TYPE {metric.name} {metric.kind}")
         for labels, child in metric.series():
             if metric.kind == "histogram":
-                bounds, buckets, count, total, _mn, _mx = child.snapshot()
+                bounds, buckets, count, total, mn, mx = child.snapshot()
                 cum = 0
                 for b, n in zip(bounds, buckets):
                     cum += n
@@ -105,6 +139,13 @@ def prometheus_text(registry=None):
                     f"{metric.name}_sum{_render_labels(labels)} {_fmt(total)}")
                 lines.append(
                     f"{metric.name}_count{_render_labels(labels)} {count}")
+                for qlabel, q in _SUMMARY_QUANTILES:
+                    est = _estimate_quantile(bounds, buckets, count, mn, mx, q)
+                    if est is not None:
+                        lines.append(
+                            f"{metric.name}"
+                            f"{_render_labels(labels, {'quantile': qlabel})}"
+                            f" {_fmt(est)}")
             else:
                 lines.append(
                     f"{metric.name}{_render_labels(labels)} "

@@ -94,3 +94,8 @@ def step_boundary(registry=None):
     interval = _config.get("MXNET_TELEMETRY_MEM_INTERVAL")
     if interval > 0 and n % interval == 0:
         sample_device_memory(registry)
+    ledger_interval = _config.get("MXNET_TELEMETRY_LEDGER_INTERVAL")
+    if ledger_interval > 0 and n % ledger_interval == 0:
+        from . import ledger as _ledger
+
+        _ledger.step_sample(n)

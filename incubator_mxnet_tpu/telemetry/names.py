@@ -107,6 +107,32 @@ METRIC_NAMES = {
     "mxtpu_guardrail_trips_total": (
         "counter", "Divergence-guardrail trips in Trainer.step, by policy "
                    "(skip/backoff/rollback) and reason."),
+    "mxtpu_step_phase_seconds": (
+        "gauge", "Rolling per-phase step-time quantiles from StepStats, "
+                 "by phase and quantile (q=0.5/0.99)."),
+    "mxtpu_step_anomalies_total": (
+        "counter", "Steps whose wall time exceeded "
+                   "MXNET_TELEMETRY_ANOMALY_FACTOR x the rolling median "
+                   "(each also logs a step_anomaly flight event)."),
+    "mxtpu_ledger_live_bytes": (
+        "gauge", "Live NDArray bytes tracked by the HBM ledger, by role "
+                 "(params/grads/optimizer_state/activations/kv_buffers)."),
+    "mxtpu_ledger_peak_bytes": (
+        "gauge", "High-watermark of ledger-tracked live bytes; "
+                 "ledger.peak_info() names the span active at the peak."),
+    "mxtpu_ledger_leak_events_total": (
+        "counter", "Leak-heuristic firings: the tracked live set grew for "
+                   "MXNET_TELEMETRY_LEAK_WINDOW consecutive samples."),
+    "mxtpu_compiles_total": (
+        "counter", "New (function, shape-signature) pairs registered with "
+                   "the compile registry, by fn."),
+    "mxtpu_retraces_total": (
+        "counter", "Recompilations of an already-seen function with a NEW "
+                   "shape signature, by fn (each also logs a retrace "
+                   "flight event naming the shape delta)."),
+    "mxtpu_compile_seconds": (
+        "histogram", "Trace+compile wall time observed for first-seen "
+                     "shape signatures, by fn."),
 }
 
 # span() names (tracing regions). Dots namespace by subsystem.
@@ -115,6 +141,7 @@ SPAN_NAMES = frozenset({
     "executor.backward",
     "trainer.step",
     "trainer.allreduce_grads",
+    "trainer.phase",
     "ps.client.rpc",
     "ps.server.handle",
     "ps.server.merge",

@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""Noise-aware perf-regression gate over bench.py JSON output.
+
+bench.py modes print one JSON object per line, each with a "metric" field
+(e.g. trainer_dispatch_overhead, perf_observatory). This tool flattens
+every numeric/boolean field of each object into `<metric>.<field>` keys
+and compares them against a committed baseline with per-metric tolerance
+bands:
+
+    python bench.py --dispatch-overhead  > bench.json
+    python bench.py --observatory       >> bench.json
+    python tools/perf_gate.py bench.json --baseline ci/perf_baseline.json
+
+Baseline format (ci/perf_baseline.json):
+
+    {"version": 1,
+     "metrics": {
+       "trainer_dispatch_overhead.aggregated_dispatches": {
+         "value": 10, "tolerance_pct": 0, "direction": "lower_is_better"},
+       ...}}
+
+directions:
+  lower_is_better  — fail if current > baseline * (1 + tol/100)
+  higher_is_better — fail if current < baseline * (1 - tol/100)
+  band             — fail if |current - baseline| > baseline * tol/100
+`"report_only": true` marks a metric informational (printed, never fails)
+— used for wall-time ratios too noisy for shared CI runners. Deterministic
+counters (dispatch counts, retrace counts) get tight/zero tolerance.
+
+A metric present in the baseline but missing from the results FAILS (a
+silently vanished bench is itself a regression). New result keys absent
+from the baseline are reported but do not fail; run with --update to fold
+them in (preserves each existing metric's tolerance/direction settings).
+
+--inject key=factor multiplies an observed value before comparison — the
+CI tier's negative self-test that the gate actually fires.
+
+Exit codes: 0 pass, 1 regression, 2 usage/IO error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "ci", "perf_baseline.json")
+
+
+def default_tolerance_pct():
+    """MXTPU_PERF_GATE_TOLERANCE (documented in config.py) — the band
+    applied to metrics whose baseline entry doesn't set its own."""
+    raw = os.environ.get("MXTPU_PERF_GATE_TOLERANCE")
+    if raw is None:
+        return 20.0
+    try:
+        return float(raw)
+    except ValueError:
+        return 20.0
+
+
+def flatten_results(lines):
+    """bench JSON lines -> {"metric.field": number}. Booleans become
+    0/1 (so weights_match regressing to False trips a band of 0);
+    non-numeric fields (units, span names, nested dicts) are skipped."""
+    out = {}
+    for ln in lines:
+        ln = ln.strip()
+        if not ln or not ln.startswith("{"):
+            continue
+        try:
+            obj = json.loads(ln)
+        except ValueError:
+            continue
+        name = obj.get("metric")
+        if not name:
+            continue
+        for k, v in obj.items():
+            if k == "metric":
+                continue
+            if isinstance(v, bool):
+                out[f"{name}.{k}"] = float(v)
+            elif isinstance(v, (int, float)):
+                out[f"{name}.{k}"] = float(v)
+    return out
+
+
+def compare(observed, baseline_metrics, tol_default):
+    """-> (failures, reports): failures is a list of human-readable
+    regression strings; reports covers every compared metric."""
+    failures, reports = [], []
+    for key in sorted(baseline_metrics):
+        spec = baseline_metrics[key]
+        base = float(spec["value"])
+        tol = float(spec.get("tolerance_pct", tol_default))
+        direction = spec.get("direction", "band")
+        report_only = bool(spec.get("report_only", False))
+        if key not in observed:
+            failures.append(f"{key}: missing from bench results "
+                            f"(baseline={base})")
+            continue
+        cur = observed[key]
+        margin = abs(base) * tol / 100.0
+        if direction == "lower_is_better":
+            bad = cur > base + margin
+        elif direction == "higher_is_better":
+            bad = cur < base - margin
+        else:
+            bad = abs(cur - base) > margin
+        line = (f"{key}: current={cur:g} baseline={base:g} "
+                f"tol={tol:g}% [{direction}]"
+                f"{' (report-only)' if report_only else ''}")
+        reports.append(("FAIL " if bad else "ok   ") + line)
+        if bad and not report_only:
+            failures.append(line)
+    for key in sorted(set(observed) - set(baseline_metrics)):
+        reports.append(f"new  {key}: current={observed[key]:g} "
+                       "(not in baseline; --update to track)")
+    return failures, reports
+
+
+def update_baseline(path, observed, old_metrics, tol_default):
+    metrics = {}
+    for key in sorted(observed):
+        prev = old_metrics.get(key, {})
+        metrics[key] = {
+            "value": observed[key],
+            "tolerance_pct": prev.get("tolerance_pct", tol_default),
+            "direction": prev.get("direction", "band"),
+        }
+        if prev.get("report_only"):
+            metrics[key]["report_only"] = True
+    # baseline metrics no longer produced are dropped deliberately: the
+    # --update caller is asserting "this is the new full bench surface"
+    doc = {"version": 1, "metrics": metrics}
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return metrics
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("results", nargs="+",
+                    help="bench JSON-lines file(s); '-' reads stdin")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the baseline from these results")
+    ap.add_argument("--inject", action="append", default=[],
+                    metavar="KEY=FACTOR",
+                    help="multiply an observed metric before comparison "
+                         "(negative self-test)")
+    args = ap.parse_args(argv)
+
+    lines = []
+    for path in args.results:
+        try:
+            if path == "-":
+                lines.extend(sys.stdin.read().splitlines())
+            else:
+                with open(path) as f:
+                    lines.extend(f.read().splitlines())
+        except OSError as e:
+            print(f"perf_gate: cannot read {path}: {e}", file=sys.stderr)
+            return 2
+    observed = flatten_results(lines)
+    if not observed:
+        print("perf_gate: no bench metrics found in input", file=sys.stderr)
+        return 2
+
+    for spec in args.inject:
+        if "=" not in spec:
+            print(f"perf_gate: bad --inject {spec!r} (want KEY=FACTOR)",
+                  file=sys.stderr)
+            return 2
+        key, factor = spec.split("=", 1)
+        if key not in observed:
+            print(f"perf_gate: --inject key {key!r} not in results",
+                  file=sys.stderr)
+            return 2
+        observed[key] *= float(factor)
+
+    tol_default = default_tolerance_pct()
+    try:
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+    except OSError:
+        baseline = None
+    if args.update:
+        old = (baseline or {}).get("metrics", {})
+        metrics = update_baseline(args.baseline, observed, old, tol_default)
+        print(f"perf_gate: baseline updated with {len(metrics)} metrics "
+              f"-> {args.baseline}")
+        return 0
+    if baseline is None:
+        print(f"perf_gate: baseline {args.baseline} missing "
+              "(run with --update to create it)", file=sys.stderr)
+        return 2
+
+    failures, reports = compare(observed, baseline.get("metrics", {}),
+                                tol_default)
+    for r in reports:
+        print(r)
+    if failures:
+        print(f"\nperf_gate: {len(failures)} regression(s):",
+              file=sys.stderr)
+        for fl in failures:
+            print(f"  {fl}", file=sys.stderr)
+        return 1
+    print("\nperf_gate: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

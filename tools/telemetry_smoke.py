@@ -44,9 +44,15 @@ def build():
 
 
 def run_loop(dataset, net, trainer, loss_fn, kv, params):
+    from incubator_mxnet_tpu.telemetry import stepstats
+
     for x, y in gluon.data.DataLoader(dataset, batch_size=16):
         with autograd.record():
-            loss = loss_fn(net(x), y)
+            # the explicit phase() puts the step-decomposition collector
+            # (and, through trainer.step, the ledger sampler and compile
+            # registry) inside the off/on overhead gate
+            with stepstats.phase("dispatch"):
+                loss = loss_fn(net(x), y)
         loss.backward()
         for i, p in enumerate(params):
             g = p.grad()
@@ -55,13 +61,23 @@ def run_loop(dataset, net, trainer, loss_fn, kv, params):
     mx.engine.waitall()
 
 
-def timed(n, *args):
-    best = float("inf")
+def timed_ab(n, setup_a, setup_b, args):
+    """Best-of-N wall time for two configurations, measured in
+    alternating rounds. The A/B pairing inside each round is what makes
+    the 5%-overhead gates hold on noisy shared machines: two timings
+    taken minutes apart in process life drift more than the tolerance,
+    two timings taken back-to-back don't."""
+    best_a = best_b = float("inf")
     for _ in range(n):
+        setup_a()
         t0 = time.perf_counter()
         run_loop(*args)
-        best = min(best, time.perf_counter() - t0)
-    return best
+        best_a = min(best_a, time.perf_counter() - t0)
+        setup_b()
+        t0 = time.perf_counter()
+        run_loop(*args)
+        best_b = min(best_b, time.perf_counter() - t0)
+    return best_a, best_b
 
 
 def main():
@@ -73,26 +89,45 @@ def main():
 
     run_loop(*args)  # warm the jit caches before any timing
 
-    telemetry.disable()
-    t_off = timed(steps, *args)
-
     telemetry.REGISTRY.reset()
-    telemetry.enable()
-    t_on = timed(steps, *args)
+    t_off, t_on = timed_ab(steps, telemetry.disable, telemetry.enable, args)
 
     # exporters must produce parseable output from the enabled run
     data = telemetry.dump_json()
     json.loads(json.dumps(data))
     for name in ("mxtpu_trainer_step_seconds", "mxtpu_kvstore_bytes_total",
-                 "mxtpu_dataloader_fetch_seconds"):
+                 "mxtpu_dataloader_fetch_seconds",
+                 # perf-observatory collectors must have published from
+                 # the instrumented loop itself
+                 "mxtpu_step_phase_seconds", "mxtpu_ledger_live_bytes"):
         assert name in data["metrics"], f"missing series {name}"
     text = telemetry.prometheus_text()
     assert "# TYPE mxtpu_trainer_step_seconds histogram" in text
+    assert 'quantile="0.99"' in text, (
+        "histogram summary quantile lines missing from Prometheus dump")
     for line in text.rstrip("\n").splitlines():
         if not line.startswith("#"):
             metric, value = line.rsplit(" ", 1)
             float(value)  # every sample value parses
             assert metric.strip(), line
+
+    # functional spot-checks of the observatory collectors while enabled
+    from incubator_mxnet_tpu.telemetry import compilereg, ledger, stepstats
+
+    snap = stepstats.snapshot()
+    assert snap["steps"] > 0 and "dispatch" in snap["phases"], snap
+    probe = nd.zeros((32, 32))
+    base = ledger.live_bytes("activations")
+    ledger.track(probe, "activations")
+    assert ledger.live_bytes("activations") == base + probe._data.nbytes
+    ledger.untrack(probe)
+    assert ledger.live_bytes("activations") == base
+    assert compilereg.register("smoke.fn", ((4,),)) == "new"
+    assert compilereg.register("smoke.fn", ((4,),)) == "seen"
+    assert compilereg.register("smoke.fn", ((8,),)) == "retrace"
+    retraces = telemetry.counter("mxtpu_retraces_total")
+    assert retraces.value(fn="smoke.fn") == 1.0, (
+        "exactly one retrace expected for one new signature")
     telemetry.disable()
 
     print(f"telemetry smoke: off={t_off * 1e3:.2f}ms "
@@ -116,18 +151,23 @@ def main():
         "while telemetry and tracing were both off — the disabled span "
         "path is not a no-op")
 
-    # the default ring must not cost measurable wall time: re-time the
-    # disabled loop with the recorder itself turned off and compare
-    os.environ["MXTPU_FLIGHT_RECORDER_EVENTS"] = "0"
-    _recorder.refresh_from_env()
-    t_noring = timed(steps, *args)
-    del os.environ["MXTPU_FLIGHT_RECORDER_EVENTS"]
-    _recorder.refresh_from_env()
-    print(f"flight recorder: ring-on={t_off * 1e3:.2f}ms "
+    # the default ring must not cost measurable wall time: time the
+    # disabled loop with the recorder on vs off (paired rounds)
+    def ring_on():
+        os.environ.pop("MXTPU_FLIGHT_RECORDER_EVENTS", None)
+        _recorder.refresh_from_env()
+
+    def ring_off():
+        os.environ["MXTPU_FLIGHT_RECORDER_EVENTS"] = "0"
+        _recorder.refresh_from_env()
+
+    t_ring, t_noring = timed_ab(steps, ring_on, ring_off, args)
+    ring_on()  # restore the default ring for the wrap test below
+    print(f"flight recorder: ring-on={t_ring * 1e3:.2f}ms "
           f"ring-off={t_noring * 1e3:.2f}ms (best of {steps})")
-    assert t_off <= t_noring * TOLERANCE, (
+    assert t_ring <= t_noring * TOLERANCE, (
         f"always-on flight recorder adds >{(TOLERANCE - 1) * 100:.0f}% "
-        f"wall time ({t_off:.4f}s with ring vs {t_noring:.4f}s without)")
+        f"wall time ({t_ring:.4f}s with ring vs {t_noring:.4f}s without)")
 
     # wrap semantics: a burst larger than the ring keeps exactly
     # `capacity` events and the newest ones survive

@@ -9,6 +9,8 @@ spans to its own binary-framed trace file; this tool fuses them:
     python tools/trace_merge.py /tmp/traces --stragglers
     python tools/trace_merge.py /tmp/traces -o timeline.json \
         --stragglers --check          # CI: nonzero exit on a bad timeline
+    python tools/trace_merge.py /tmp/traces -o timeline.json --memory
+        # also render HBM-ledger samples as a Perfetto counter track
 
 Open `timeline.json` in Perfetto (ui.perfetto.dev) or chrome://tracing:
 one row group ("process") per lane — r0, r1, ..., server — with the
@@ -107,10 +109,19 @@ def estimate_offsets(records):
     return offsets, anchor
 
 
-def to_chrome_trace(records, offsets):
+def lane_pids(records):
+    """Stable pid assignment, one process row per lane — shared by the
+    span timeline and the memory counter track so they land in the same
+    Perfetto row groups."""
+    return {lane: i + 1
+            for i, lane in enumerate(sorted({r["lane"] for r in records}))}
+
+
+def to_chrome_trace(records, offsets, pid_of=None):
     """Chrome-trace JSON object: one pid per lane, skew-corrected ts."""
-    lanes = sorted({r["lane"] for r in records})
-    pid_of = {lane: i + 1 for i, lane in enumerate(lanes)}
+    if pid_of is None:
+        pid_of = lane_pids(records)
+    lanes = sorted(pid_of)
     events = []
     for lane in lanes:
         events.append({"ph": "M", "name": "process_name",
@@ -135,6 +146,31 @@ def to_chrome_trace(records, offsets):
         })
     spans.sort(key=lambda e: e["ts"])
     return {"traceEvents": events + spans, "displayTimeUnit": "ms"}
+
+
+def memory_counter_events(mem_records, offsets, pid_of):
+    """HBM-ledger samples (kind="mem", emitted by telemetry.ledger when
+    tracing is active) as Chrome-trace counter events: one "hbm_ledger"
+    counter track per lane, stacked by role, on the skew-corrected
+    clock. Perfetto draws these as an area chart beside the spans."""
+    events = []
+    for r in sorted(mem_records, key=lambda r: r["ts"]):
+        lane = r["lane"]
+        if lane not in pid_of:  # memory-only lane: give it a process row
+            pid_of[lane] = max(pid_of.values(), default=0) + 1
+            events.append({"ph": "M", "name": "process_name",
+                           "pid": pid_of[lane], "tid": 0,
+                           "args": {"name": lane}})
+        events.append({
+            "ph": "C",
+            "name": r.get("name", "hbm_ledger"),
+            "pid": pid_of[lane],
+            "tid": 0,
+            "ts": (r["ts"] + offsets.get(lane, 0.0)) / 1000.0,
+            "args": {role: b for role, b in sorted(
+                (r.get("bytes") or {}).items())},
+        })
+    return events
 
 
 def straggler_report(records, directory):
@@ -234,15 +270,31 @@ def main(argv=None):
     ap.add_argument("--check", action="store_true",
                     help="exit nonzero unless the merged timeline passes "
                          "structural checks (CI gate)")
+    ap.add_argument("--memory", action="store_true",
+                    help="render HBM-ledger samples (kind=mem records) as "
+                         "per-lane Perfetto counter tracks")
     args = ap.parse_args(argv)
 
-    records, files = load_dir(args.trace_dir)
+    all_records, files = load_dir(args.trace_dir)
     if not files:
         print(f"trace_merge: no .mxtrace files in {args.trace_dir}",
               file=sys.stderr)
         return 1
+    # memory samples share the trace stream but are not spans (no sid/dur)
+    # — partition them out before the span pipeline touches those fields
+    mem_records = [r for r in all_records if r.get("kind") == "mem"]
+    records = [r for r in all_records if r.get("kind") != "mem"]
+    if not records:
+        print(f"trace_merge: no span records in {args.trace_dir}",
+              file=sys.stderr)
+        return 1
     offsets, anchor = estimate_offsets(records)
-    timeline = to_chrome_trace(records, offsets)
+    pid_of = lane_pids(records)
+    timeline = to_chrome_trace(records, offsets, pid_of)
+    if args.memory:
+        timeline["traceEvents"].extend(
+            memory_counter_events(mem_records, offsets, pid_of))
+        print(f"memory track: {len(mem_records)} HBM-ledger sample(s)")
     print(f"merged {len(records)} spans from {len(files)} trace file(s); "
           f"lanes: {', '.join(sorted({r['lane'] for r in records}))} "
           f"(clock anchor: {anchor})")
