@@ -585,6 +585,189 @@ def observatory_main(assert_mode=False):
             f"steady-shape second epoch retraced {r2 - r1} time(s)")
 
 
+def _cold_start_child():
+    """One fresh-process training run against the persistent compile cache
+    (BENCH_COLD_CHILD=1; MXTPU_COMPILE_CACHE_DIR set by the parent).
+
+    Builds a small dense net + GluonTrainStep with fixed seeds, measures
+    time-to-first-step from process entry (imports + build + compile or
+    cache load + first synced step), runs a few more steps, and prints one
+    JSON line with the compile-event count (compilereg entries that
+    actually compiled, i.e. not served from the cache), the
+    mxtpu_compile_seconds observation count, the cache hit/miss/eviction
+    stats, and a sha256 of the final weights — the cold, warm, and
+    corrupt-cache legs must produce the identical digest."""
+    import hashlib
+
+    t0 = time.perf_counter()
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, fused, gluon, telemetry, compile_cache
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.telemetry import compilereg
+
+    t_imports = time.perf_counter()
+    width = int(os.environ.get("BENCH_COLD_WIDTH", "64"))
+    layers = int(os.environ.get("BENCH_COLD_LAYERS", "8"))
+    batch = int(os.environ.get("BENCH_COLD_BATCH", "16"))
+    steps = int(os.environ.get("BENCH_COLD_STEPS", "4"))
+    telemetry.enable()
+    compilereg.reset()
+    compile_cache.reset_stats()
+
+    mx.random.seed(0)
+    # deep enough that trace+compile dominates build_first_step_s on the
+    # cold leg — the gated warm/cold ratio needs real compile work to
+    # shrink, not just the fixed net-build/device-init floor
+    net = nn.Sequential()
+    for _ in range(layers):
+        net.add(nn.Dense(width, in_units=width, activation="relu"))
+    net.add(nn.Dense(1, in_units=width))
+    net.initialize(mx.init.Xavier())
+    L = gluon.loss.L2Loss()
+    opt = mx.optimizer.SGD(learning_rate=0.05, momentum=0.9,
+                           rescale_grad=1.0 / batch)
+    step = fused.GluonTrainStep(net, lambda n, a, b: L(n(a), b), opt)
+    rng = np.random.RandomState(7)
+    xs = rng.uniform(-1, 1, size=(steps, batch, width)).astype("float32")
+    ys = rng.uniform(-1, 1, size=(steps, batch, 1)).astype("float32")
+
+    loss = step(nd.array(xs[0]), nd.array(ys[0]))
+    first = float(loss.asnumpy())  # sync: first step has fully executed
+    ttfs = time.perf_counter() - t0
+    for i in range(1, steps):
+        loss = step(nd.array(xs[i]), nd.array(ys[i]))
+    loss.asnumpy()
+    total_s = time.perf_counter() - t0
+
+    step.sync_params()
+    weights = np.concatenate([p.data().asnumpy().ravel()
+                              for p in net.collect_params().values()])
+    compiled = cached = 0
+    for rec in compilereg.snapshot().values():
+        for info in rec["entries"]:
+            if info.get("cached"):
+                cached += 1
+            else:
+                compiled += 1
+    obs = 0
+    h = telemetry.REGISTRY.get("mxtpu_compile_seconds")
+    if h is not None:
+        obs = sum(child.count for _, child in h.series())
+    print(json.dumps({
+        "metric": "cold_start_child",
+        "ttfs_s": round(ttfs, 4),
+        # ttfs minus the interpreter/jax import block, which is identical
+        # in every leg: this is the part the cache can actually shrink
+        # (trace+compile vs deserialize), so the gated warm/cold ratio
+        # uses it instead of drowning the signal in import noise
+        "build_first_step_s": round(ttfs - (t_imports - t0), 4),
+        "total_s": round(total_s, 4),
+        "steps": steps,
+        "first_loss": first,
+        "compile_events": compiled,
+        "cached_events": cached,
+        "compile_seconds_obs": int(obs),
+        "cache": compile_cache.stats(),
+        "weights_sha256": hashlib.sha256(weights.tobytes()).hexdigest(),
+    }), flush=True)
+
+
+def cold_start_main(assert_mode=False):
+    """Cold-start bench (satellite of the persistent compile cache): run
+    the same single-step training child three times against one
+    MXTPU_COMPILE_CACHE_DIR —
+
+      1. cold    — empty cache; every jit compiles and persists,
+      2. warm    — fresh process, populated cache; MUST perform zero
+                   compiles (compilereg shows only cached entries, the
+                   mxtpu_compile_seconds histogram records nothing),
+      3. corrupt — every cache entry's bytes are flipped first; the load
+                   must fall back to a fresh compile, evict the bad
+                   entries, and still produce bit-identical weights.
+
+    Reports warm/cold time-to-first-step plus the cache counters as one
+    JSON line for tools/perf_gate.py; --assert turns the structural
+    properties into hard failures (the CI cold-start tier runs this)."""
+    import tempfile
+
+    legs = {}
+    with tempfile.TemporaryDirectory(prefix="mxtpu-coldstart-") as cdir:
+        env = dict(os.environ)
+        env.pop("BENCH_COLD_START", None)
+        env["BENCH_COLD_CHILD"] = "1"
+        env["MXTPU_COMPILE_CACHE_DIR"] = cdir
+
+        def run_leg(name):
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True, timeout=600)
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"cold-start {name} leg failed "
+                    f"(rc={p.returncode}):\n{p.stderr[-2000:]}")
+            line = [l for l in p.stdout.splitlines() if l.startswith("{")][-1]
+            legs[name] = json.loads(line)
+
+        run_leg("cold")
+        run_leg("warm")
+        for fname in os.listdir(cdir):
+            if fname.endswith(".exe"):
+                path = os.path.join(cdir, fname)
+                with open(path, "rb") as f:
+                    data = f.read()
+                with open(path, "wb") as f:
+                    f.write(bytes(b ^ 0xFF for b in data))
+        run_leg("corrupt")
+        entries = len([f for f in os.listdir(cdir) if f.endswith(".exe")])
+
+    cold, warm, corrupt = legs["cold"], legs["warm"], legs["corrupt"]
+    hashes = {leg["weights_sha256"] for leg in legs.values()}
+    ratio = (warm["build_first_step_s"] / cold["build_first_step_s"]
+             if cold["build_first_step_s"] > 0 else 0.0)
+    out = {
+        "metric": "cold_start",
+        "value": round(ratio, 4),
+        "unit": "x_warm_over_cold_build_first_step",
+        "cold_ttfs_s": cold["ttfs_s"],
+        "warm_ttfs_s": warm["ttfs_s"],
+        "cold_build_first_step_s": cold["build_first_step_s"],
+        "warm_build_first_step_s": warm["build_first_step_s"],
+        "cold_compile_events": cold["compile_events"],
+        "warm_compile_events": warm["compile_events"],
+        "warm_cached_events": warm["cached_events"],
+        "warm_compile_seconds_obs": warm["compile_seconds_obs"],
+        "warm_cache_hits": warm["cache"]["hits"],
+        "warm_saved_seconds": round(warm["cache"]["saved_seconds"], 4),
+        "corrupt_evictions": corrupt["cache"]["evictions"],
+        "corrupt_recompiles": corrupt["cache"]["misses"],
+        "weights_match": len(hashes) == 1,
+        "cache_entries": entries,
+    }
+    print(json.dumps(out), flush=True)
+    if assert_mode:
+        assert cold["compile_events"] > 0, (
+            "cold leg compiled nothing — the cache wrapper is not wired "
+            f"into the train step: {cold}")
+        assert warm["compile_events"] == 0, (
+            f"warm process still compiled {warm['compile_events']} "
+            "executable(s) — persistent cache missed")
+        assert warm["compile_seconds_obs"] == 0, (
+            "warm process recorded mxtpu_compile_seconds observations")
+        assert warm["cache"]["hits"] > 0, (
+            f"warm process hit nothing in the cache: {warm['cache']}")
+        assert corrupt["cache"]["evictions"] > 0, (
+            f"corrupt entries were not evicted: {corrupt['cache']}")
+        assert corrupt["cache"]["misses"] > 0, (
+            f"corrupt leg did not fall back to a fresh compile: "
+            f"{corrupt['cache']}")
+        assert len(hashes) == 1, (
+            f"weights diverged across legs: "
+            f"{ {k: v['weights_sha256'][:12] for k, v in legs.items()} }")
+        assert ratio < 1.0, (
+            f"warm time-to-first-step not better than cold: {out}")
+
+
 def main():
     # HBM-traffic lever axes (satellite flags; env inheritance carries
     # them into the measurement children)
@@ -603,6 +786,12 @@ def main():
         return
     if "--observatory" in sys.argv or os.environ.get("BENCH_OBSERVATORY"):
         observatory_main(assert_mode="--assert" in sys.argv)
+        return
+    if os.environ.get("BENCH_COLD_CHILD"):
+        _cold_start_child()
+        return
+    if "--cold-start" in sys.argv or os.environ.get("BENCH_COLD_START"):
+        cold_start_main(assert_mode="--assert" in sys.argv)
         return
     if os.environ.get("BENCH_CHILD"):
         child_main()

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI tiers (ref: ci/docker/runtime_functions.sh — unittest / nightly /
 # distributed stages). Usage:
-#   ci/run_tests.sh [unit|nightly|dist|examples|telemetry|aggregation|static-analysis|perf-structure|perf-gate|chaos|all]
+#   ci/run_tests.sh [unit|nightly|dist|examples|telemetry|aggregation|static-analysis|perf-structure|perf-gate|cold-start|chaos|all]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -182,11 +182,15 @@ run_perf_gate() {
         > "$gate_dir/bench.json"
     JAX_PLATFORMS=cpu python bench.py --observatory --assert \
         >> "$gate_dir/bench.json"
+    # --subset: the cold_start.* baseline keys belong to the cold-start
+    # tier's own bench run, not this results file
     python tools/perf_gate.py "$gate_dir/bench.json" \
-        --baseline ci/perf_baseline.json
+        --baseline ci/perf_baseline.json \
+        --subset trainer_dispatch_overhead --subset perf_observatory
     # negative self-test: a seeded dispatch-count regression MUST fail
     if python tools/perf_gate.py "$gate_dir/bench.json" \
         --baseline ci/perf_baseline.json \
+        --subset trainer_dispatch_overhead --subset perf_observatory \
         --inject trainer_dispatch_overhead.aggregated_dispatches=4.0 \
         > "$gate_dir/inject.log" 2>&1; then
         echo "FAIL: perf_gate passed a seeded 4x dispatch regression" >&2
@@ -194,6 +198,65 @@ run_perf_gate() {
         exit 1
     fi
     echo "perf-gate: baseline comparison passed; seeded regression rejected"
+}
+
+run_cold_start() {
+    echo "=== cold-start tier (persistent compile cache across processes) ==="
+    # bench.py --cold-start runs the same training child three times
+    # against one MXTPU_COMPILE_CACHE_DIR: cold (populates), warm (a
+    # fresh process that MUST perform zero compiles — compilereg shows
+    # only cached entries and the mxtpu_compile_seconds histogram stays
+    # empty), and corrupt (every entry's bytes flipped — the load must
+    # evict, fall back to a fresh compile, and still produce weights
+    # bit-identical to the other legs). --assert enforces all of that
+    # inside the bench; the gate then bands the counters + warm/cold
+    # time-to-first-step ratio against the committed baseline.
+    local cs_dir
+    cs_dir="$(mktemp -d -t mxtpu-cold-start-XXXXXX)"
+    JAX_PLATFORMS=cpu python bench.py --cold-start --assert \
+        > "$cs_dir/cold.json"
+    python tools/perf_gate.py "$cs_dir/cold.json" \
+        --baseline ci/perf_baseline.json --subset cold_start
+    # negative self-test: a seeded warm-slower-than-cold ratio MUST fail
+    # (the zero-valued compile counters can't be perturbed by a
+    # multiplicative inject, so the ratio is the tripwire)
+    if python tools/perf_gate.py "$cs_dir/cold.json" \
+        --baseline ci/perf_baseline.json --subset cold_start \
+        --inject cold_start.value=3.0 \
+        > "$cs_dir/inject.log" 2>&1; then
+        echo "FAIL: perf_gate passed a seeded 3x cold-start ratio" >&2
+        cat "$cs_dir/inject.log" >&2
+        exit 1
+    fi
+    # AOT warmup tool end-to-end: precompile two batch buckets of a real
+    # model_zoo net into a fresh cache, then re-run — the second pass
+    # must be all hits (nothing left to compile)
+    local wu_dir
+    wu_dir="$(mktemp -d -t mxtpu-warmup-XXXXXX)"
+    JAX_PLATFORMS=cpu MXTPU_COMPILE_CACHE_DIR="$wu_dir" \
+        python tools/warmup.py --model squeezenet1.0 \
+        --shape data=2,3,64,64 --batch-buckets 1,2 \
+        --classes 10 > "$cs_dir/warmup.json"
+    JAX_PLATFORMS=cpu MXTPU_COMPILE_CACHE_DIR="$wu_dir" \
+        python tools/warmup.py --model squeezenet1.0 \
+        --shape data=2,3,64,64 --batch-buckets 1,2 \
+        --classes 10 > "$cs_dir/warmup2.json"
+    python - "$cs_dir" <<'PY'
+import json, sys
+d = sys.argv[1]
+runs = []
+for f in ("warmup.json", "warmup2.json"):
+    lines = [json.loads(l) for l in open(f"{d}/{f}") if l.startswith("{")]
+    runs.append([o for o in lines if o["metric"] == "warmup_summary"][0])
+first, second = runs
+assert first["misses"] == first["combos"] > 0, first
+assert first["cache_entries"] == first["combos"], first
+assert second["hits"] == second["combos"] and second["misses"] == 0, second
+print(f"warmup tool ok: {first['combos']} combos precompiled, "
+      f"second pass {second['hits']}/{second['combos']} hits in "
+      f"{second['seconds']}s (first: {first['seconds']}s)")
+PY
+    echo "cold-start tier: zero warm compiles, corrupt fallback bit-identical, warmup tool all-hit on re-run"
 }
 
 run_nightly() {
@@ -225,8 +288,9 @@ case "$tier" in
     chaos)     run_chaos ;;
     perf-structure) run_perf_structure ;;
     perf-gate) run_perf_gate ;;
+    cold-start) run_cold_start ;;
     nightly)   run_nightly ;;
-    all)       run_static_analysis; run_unit; run_telemetry; run_aggregation; run_perf_structure; run_perf_gate; run_chaos; run_dist; run_examples; run_nightly ;;
-    *) echo "unknown tier: $tier (unit|nightly|dist|examples|suite|telemetry|aggregation|static-analysis|perf-structure|perf-gate|chaos|all)"; exit 2 ;;
+    all)       run_static_analysis; run_unit; run_telemetry; run_aggregation; run_perf_structure; run_perf_gate; run_cold_start; run_chaos; run_dist; run_examples; run_nightly ;;
+    *) echo "unknown tier: $tier (unit|nightly|dist|examples|suite|telemetry|aggregation|static-analysis|perf-structure|perf-gate|cold-start|chaos|all)"; exit 2 ;;
 esac
 echo "tier '$tier' green"

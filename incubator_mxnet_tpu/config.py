@@ -351,6 +351,27 @@ register_knob("MXTPU_PERF_GATE_TOLERANCE", 20.0, float,
               "tools/perf_gate.py when a baseline entry carries no "
               "explicit tolerance_pct band.")
 
+# cold start / persistent compile cache (compile_cache.py)
+register_knob("MXTPU_COMPILE_CACHE_DIR", "", str,
+              "Directory for the persistent, content-addressed compile "
+              "cache. Empty (the default) disables caching; when set, "
+              "every jit site compilereg tracks serves serialized XLA "
+              "executables from disk on restart instead of recompiling "
+              "(crash-consistent writes, sha256-verified loads; corrupt "
+              "or version-stale entries are evicted and recompiled). "
+              "Read at jit-construction time — set it before building "
+              "the model.")
+register_knob("MXTPU_COMPILE_CACHE_MAX_MB", 2048.0, float,
+              "LRU size cap (megabytes) on the compile-cache directory; "
+              "oldest-recency entries are evicted after each write until "
+              "the directory fits (the newest entry is never evicted). "
+              "0 or negative disables the cap.")
+register_knob("MXTPU_COMPILE_CACHE_SALT", "", str,
+              "Extra opaque string folded into every compile-cache key. "
+              "Bump it to force a cold rebuild of the cache without "
+              "deleting the directory (e.g. after an XLA flag change "
+              "the key material cannot see).")
+
 # numerics / reproducibility
 register_knob("MXTPU_DEFAULT_DTYPE", "float32", str,
               "Default dtype for new NDArrays.")

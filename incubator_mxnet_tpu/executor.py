@@ -15,6 +15,7 @@ import logging
 import jax
 import jax.numpy as jnp
 
+from . import compile_cache as _compile_cache
 from . import config as _config
 from . import random as _global_random
 from . import telemetry as _telemetry
@@ -67,7 +68,11 @@ class Executor:
         self._needs_rng = any(
             (not n.is_var) and n.op.needs_rng for n in symbol._topo_nodes()
         )
-        self._jit_infer = jax.jit(lambda a, x, k: self._eval_fn(a, x, k, False))
+        sym_name = getattr(symbol, "name", None) or "sym"
+        self._jit_infer = _compile_cache.wrap(
+            f"executor.infer[{sym_name}]",
+            jax.jit(lambda a, x, k: self._eval_fn(a, x, k, False)),
+            static_key=sym_name)
         self._vjp = None
         self._grad_names = None
         self.outputs: list[NDArray] = []
@@ -76,14 +81,33 @@ class Executor:
         # post-mortem dump showing one near the failure is signal
         _telemetry.log_event("executor_bind", args=len(self.arg_dict),
                              outputs=len(symbol.list_outputs()))
-        # compile registry: two binds of the same symbol with different
-        # arg shapes are a retrace of that graph
-        _telemetry.compilereg.register(
-            f"executor.bind[{getattr(symbol, 'name', None) or 'sym'}]",
-            tuple(sorted(
-                (n, tuple(a.shape), str(a.dtype))
-                for n, a in {**self.arg_dict, **self.aux_dict}.items()
-                if a is not None)))
+        if not _compile_cache.enabled():
+            # compile registry: two binds of the same symbol with
+            # different arg shapes are a retrace of that graph. With the
+            # persistent cache on, the wrapped infer jit registers its
+            # real outcome (cached hit vs compile) on first dispatch
+            # instead of this bind-implies-compile approximation.
+            _telemetry.compilereg.register(
+                f"executor.bind[{sym_name}]",
+                tuple(sorted(
+                    (n, tuple(a.shape), str(a.dtype))
+                    for n, a in {**self.arg_dict, **self.aux_dict}.items()
+                    if a is not None)))
+
+    def warmup(self):
+        """AOT-precompile the inference program into the persistent
+        compile cache without executing a forward (serving warm-start;
+        tools/warmup.py --infer). Abstract args mirror the bound slots,
+        so the entry written here is the one forward(is_train=False)
+        will look up. Returns the cache resolution status ("hit" /
+        "miss" / "memo" / "disabled")."""
+        if not getattr(self._jit_infer, "is_cached", False):
+            return "disabled"
+        args = {k: v._data for k, v in self.arg_dict.items()}
+        aux = {k: v._data for k, v in self.aux_dict.items()}
+        key = _global_random.next_key() if self._needs_rng else None
+        abstract = _compile_cache.abstractify((args, aux, key))
+        return self._jit_infer.warm(*abstract)
 
     # -- properties mirroring the reference Executor ----------------------
     @property

@@ -27,6 +27,7 @@ import time
 import jax
 import jax.numpy as jnp
 
+from .. import compile_cache as _compile_cache
 from .. import config as _config
 from .. import optimizer as opt
 from .. import kvstore as kvs
@@ -247,11 +248,16 @@ class Trainer:
                 return [flat[a:b].reshape(s)
                         for (a, b), s in zip(offs, shapes)]
 
-            fns = (jax.jit(fl), jax.jit(unfl))
+            fns = (_compile_cache.wrap("trainer.flatten", jax.jit(fl)),
+                   _compile_cache.wrap("trainer.unflatten",
+                                       jax.jit(unfl)))
             self._flat_fn_cache[key] = fns
-            # a miss here is a fresh trace pair; a second layout for the
-            # same trainer is a retrace (shape-driven bucket churn)
-            _telemetry.compilereg.register("trainer.flatten", key)
+            if not _compile_cache.enabled():
+                # a miss here is a fresh trace pair; a second layout for
+                # the same trainer is a retrace (shape-driven bucket
+                # churn). With the persistent cache on, the wrappers
+                # register (hit or compile) themselves.
+                _telemetry.compilereg.register("trainer.flatten", key)
         return fns
 
     def _grads_nonfinite(self):
@@ -579,12 +585,19 @@ class Trainer:
                     names, mp=isinstance(states[0], tuple))
             else:
                 fn = self._build_bucket_fn(names)
+            donate = (0, 1) if jax.default_backend() != "cpu" else ()
+            fn = _compile_cache.wrap(
+                f"trainer.bucket_update[{bid}]", fn, donated=donate,
+                static_key=key[1:])
             self._agg_fn_cache[key] = fn
-            # new (optimizer-kind, hyper) program for this bucket: a second
-            # key for the same bucket id means hyper/signature churn
-            # retraced it (each bucket id is its own program, not a retrace)
-            _telemetry.compilereg.register(
-                f"trainer.bucket_update[{bid}]", key[1:])
+            if not _compile_cache.enabled():
+                # new (optimizer-kind, hyper) program for this bucket: a
+                # second key for the same bucket id means hyper/signature
+                # churn retraced it (each bucket id is its own program,
+                # not a retrace). With the persistent cache on, the
+                # wrapper registers (hit or compile) itself.
+                _telemetry.compilereg.register(
+                    f"trainer.bucket_update[{bid}]", key[1:])
         w_data = [w._data for w in weights]
         s_data = [self._state_data(s) for s in states]
         g_data = [g._data for g in grads]

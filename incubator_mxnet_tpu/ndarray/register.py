@@ -114,7 +114,15 @@ def _maybe_jit(opdef, fn, call_attrs, live_idx, n_slots):
         # guarantees any hit was built from equal attrs
         donate = (_donation_argnums(opdef, live_idx)
                   if jax.default_backend() != "cpu" else ())
-        cached = jax.jit(fn, donate_argnums=donate)
+        from .. import compile_cache as _compile_cache
+
+        # persistent-cache wrapper (no-op when MXTPU_COMPILE_CACHE_DIR
+        # is unset): a restarted eager workload reloads each op's
+        # executable instead of re-tracing it. Calls traced through
+        # autograd's vjp see Tracers and bypass straight to the jit.
+        cached = _compile_cache.wrap(
+            f"eager.{opdef.name}", jax.jit(fn, donate_argnums=donate),
+            donated=donate, static_key=key[1:])
         _EAGER_JIT_CACHE[key] = cached
         cap = _eager_jit_cache_cap()
         if cap > 0:
@@ -126,9 +134,12 @@ def _maybe_jit(opdef, fn, call_attrs, live_idx, n_slots):
             "mxtpu_eager_jit_cache_size", len(_EAGER_JIT_CACHE),
             help="Entries in the eager-dispatch jit cache "
                  "(LRU, capped by MXTPU_EAGER_JIT_CACHE_SIZE).")
-        # compile registry: a second attrs/arity key for the same op is a
-        # retrace of that op's eager program
-        _telemetry.compilereg.register(f"eager.{opdef.name}", key[1:])
+        if not _compile_cache.enabled():
+            # compile registry: a second attrs/arity key for the same op
+            # is a retrace of that op's eager program. With the
+            # persistent cache on, the wrapper registers (hit or
+            # compile) itself on first dispatch.
+            _telemetry.compilereg.register(f"eager.{opdef.name}", key[1:])
     else:
         _EAGER_JIT_CACHE.move_to_end(key)
     return cached

@@ -30,8 +30,9 @@ import time
 from .metrics import REGISTRY
 from . import recorder as _recorder
 
-__all__ = ["register", "seen", "annotate", "signature_of", "snapshot",
-           "reset", "COMPILES_TOTAL", "RETRACES_TOTAL", "COMPILE_SECONDS"]
+__all__ = ["register", "register_cached", "seen", "annotate",
+           "signature_of", "snapshot", "reset", "COMPILES_TOTAL",
+           "RETRACES_TOTAL", "COMPILE_SECONDS"]
 
 COMPILES_TOTAL = "mxtpu_compiles_total"
 _COMPILES_HELP = ("New (function, shape-signature) pairs registered with "
@@ -62,18 +63,51 @@ def _on():
     return fn()
 
 
+def _dtype_name(dt):
+    """Canonical dtype spelling: np.dtype('float32').name == 'float32'
+    whether the caller held a dtype object, a scalar type, or a string —
+    `str(np.float32)` would spell the same dtype three different ways
+    and fork the cross-process cache key."""
+    name = getattr(dt, "name", None)
+    if isinstance(name, str):
+        return name
+    return getattr(dt, "__name__", None) or str(dt)
+
+
+def _canon(v):
+    """One value -> a canonical, repr-stable signature element. Dicts
+    hash by SORTED key (insertion order is a per-process accident);
+    containers recurse; arrays collapse to (shape, dtype-name)."""
+    if v is None:
+        return None
+    if isinstance(v, type):
+        # scalar types (np.float32) expose a class-level `shape`
+        # descriptor — canonicalize dtype-like classes by name instead
+        return ("dtype", _dtype_name(v))
+    name = getattr(v, "name", None)
+    if isinstance(name, str) and getattr(v, "kind", None) is not None:
+        # np.dtype instances (duck-typed: .name + .kind, no numpy import)
+        return ("dtype", name)
+    if hasattr(v, "shape"):
+        dt = getattr(v, "dtype", None)
+        return (tuple(v.shape), _dtype_name(dt) if dt is not None else "?")
+    if isinstance(v, dict):
+        return ("dict", tuple(
+            (str(k), _canon(v[k])) for k in sorted(v, key=str)))
+    if isinstance(v, (list, tuple)):
+        return (type(v).__name__, tuple(_canon(x) for x in v))
+    if isinstance(v, (bool, int, float, str, bytes)):
+        return (type(v).__name__, repr(v))
+    return (type(v).__name__,)
+
+
 def signature_of(*arrays):
-    """Abstract signature of positional array args: ((shape, dtype), ...)
-    over everything with .shape (None placeholders pass through)."""
-    sig = []
-    for a in arrays:
-        if a is None:
-            sig.append(None)
-        elif hasattr(a, "shape"):
-            sig.append((tuple(a.shape), str(getattr(a, "dtype", "?"))))
-        else:
-            sig.append((type(a).__name__,))
-    return tuple(sig)
+    """Canonical abstract signature of positional args: (shape,
+    dtype-name) per array, sorted-key tuples for dicts, values for
+    plain scalars (None placeholders pass through). repr() of the
+    result is identical across processes for the same program — the
+    property the persistent compile-cache key requires."""
+    return tuple(_canon(a) for a in arrays)
 
 
 def _fmt_sig(sig):
@@ -142,6 +176,32 @@ def register(fn, signature, compile_s=None, graph_hash=None, cost=None):
         "compile", fn=fn, signature=_fmt_sig(signature),
         graph_hash=graph_hash, compile_s=compile_s)
     return "new"
+
+
+def register_cached(fn, signature, graph_hash=None):
+    """Record that `fn` resolved `signature` from the persistent
+    compile cache: the signature becomes known (so `seen()` is True and
+    snapshot() lists it with cached=True) WITHOUT counting a compile or
+    retrace — a fully-warm process must show zero compile events.
+    Returns "cached", or "seen" when already registered."""
+    if not _on():
+        return None
+    if graph_hash is None:
+        graph_hash = hashlib.sha1(
+            repr((fn, signature)).encode()).hexdigest()[:16]
+    with _lock:
+        entry = _fns.setdefault(
+            fn, {"order": [], "entries": {}, "retraces": 0})
+        if signature in entry["entries"]:
+            return "seen"
+        entry["order"].append(signature)
+        entry["entries"][signature] = {
+            "graph_hash": graph_hash, "compile_s": None, "cost": None,
+            "cached": True, "ts_ns": time.time_ns()}
+    _recorder.log_event(
+        "compile_cache_hit", fn=fn, signature=_fmt_sig(signature),
+        graph_hash=graph_hash)
+    return "cached"
 
 
 def annotate(fn, signature=None, compile_s=None, cost=None):

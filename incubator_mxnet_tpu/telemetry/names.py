@@ -133,6 +133,19 @@ METRIC_NAMES = {
     "mxtpu_compile_seconds": (
         "histogram", "Trace+compile wall time observed for first-seen "
                      "shape signatures, by fn."),
+    "mxtpu_compile_cache_hits_total": (
+        "counter", "Executables served from the persistent compile "
+                   "cache instead of XLA, by fn."),
+    "mxtpu_compile_cache_misses_total": (
+        "counter", "Compile-cache lookups that fell through to a fresh "
+                   "XLA compile (the entry is then written back), "
+                   "by fn."),
+    "mxtpu_compile_cache_evictions_total": (
+        "counter", "Compile-cache entries deleted, by reason "
+                   "(corrupt / version / lru / clear) and fn."),
+    "mxtpu_compile_cache_saved_seconds": (
+        "counter", "Compile wall-clock skipped by cache hits: stored "
+                   "compile time minus deserialize cost, by fn."),
 }
 
 # span() names (tracing regions). Dots namespace by subsystem.

@@ -1,0 +1,242 @@
+"""Persistent compile cache (compile_cache.py): hit/miss/evict semantics,
+LRU size cap, corrupt-entry fallback, version-salt invalidation, donation
+mask in the key, and the cross-process properties the cold-start work
+rests on — a warm process performs zero compiles and produces
+bit-identical outputs, and the canonical compilereg signature reprs
+identically across interpreter instances (PYTHONHASHSEED varies them)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from incubator_mxnet_tpu import compile_cache
+from incubator_mxnet_tpu.telemetry import compilereg
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def cache_dir(tmp_path, monkeypatch):
+    d = tmp_path / "cc"
+    d.mkdir()
+    monkeypatch.setenv("MXTPU_COMPILE_CACHE_DIR", str(d))
+    compile_cache.reset_stats()
+    yield d
+    compile_cache.reset_stats()
+
+
+def _wrap(name="cctest.f", fn=None, donated=(), static_key=None):
+    if fn is None:
+        fn = lambda a, b: a @ b + 1.0  # noqa: E731
+    return compile_cache.wrap(name, jax.jit(fn), donated=donated,
+                              static_key=static_key)
+
+
+def _entries(cache_dir):
+    return sorted(p for p in cache_dir.iterdir() if p.suffix == ".exe")
+
+
+def test_disabled_without_dir(monkeypatch):
+    monkeypatch.delenv("MXTPU_COMPILE_CACHE_DIR", raising=False)
+    assert not compile_cache.enabled()
+    jitted = jax.jit(lambda a: a + 1)
+    assert compile_cache.wrap("cctest.plain", jitted) is jitted
+
+
+def test_miss_persists_then_fresh_wrapper_hits(cache_dir):
+    x = jnp.arange(16.0).reshape(4, 4)
+    f1 = _wrap()
+    r1 = np.asarray(f1(x, x))
+    st = compile_cache.stats()
+    assert st["misses"] == 1 and st["hits"] == 0
+    assert len(_entries(cache_dir)) == 1
+
+    # a fresh wrapper over a fresh jit is what a new process holds: the
+    # in-memory memo is empty, only the disk entry can satisfy it
+    compile_cache.reset_stats()
+    f2 = _wrap()
+    r2 = np.asarray(f2(x, x))
+    st = compile_cache.stats()
+    assert st["hits"] == 1 and st["misses"] == 0
+    np.testing.assert_array_equal(r1, r2)
+
+    # same wrapper again: served by the signature memo, no new counts
+    f2(x, x)
+    assert compile_cache.stats()["hits"] == 1
+
+
+def test_new_shape_is_its_own_entry(cache_dir):
+    f = _wrap()
+    f(jnp.ones((2, 2)), jnp.ones((2, 2)))
+    f(jnp.ones((3, 3)), jnp.ones((3, 3)))
+    assert compile_cache.stats()["misses"] == 2
+    assert len(_entries(cache_dir)) == 2
+
+
+def test_corrupt_entry_falls_back_evicts_and_matches(cache_dir):
+    x = jnp.arange(16.0).reshape(4, 4)
+    r1 = np.asarray(_wrap()(x, x))
+    for p in _entries(cache_dir):
+        p.write_bytes(bytes(b ^ 0xFF for b in p.read_bytes()))
+
+    compile_cache.reset_stats()
+    r2 = np.asarray(_wrap()(x, x))
+    st = compile_cache.stats()
+    assert st["evictions"] == 1, st
+    assert st["misses"] == 1 and st["hits"] == 0, st
+    # numerics must be unchanged by the fallback, and the recompile must
+    # have re-persisted a good entry
+    np.testing.assert_array_equal(r1, r2)
+    assert len(_entries(cache_dir)) == 1
+    compile_cache.reset_stats()
+    _wrap()(x, x)
+    assert compile_cache.stats()["hits"] == 1
+
+
+def test_salt_change_invalidates_without_evicting(cache_dir, monkeypatch):
+    x = jnp.ones((4, 4))
+    _wrap()(x, x)
+    assert len(_entries(cache_dir)) == 1
+
+    monkeypatch.setenv("MXTPU_COMPILE_CACHE_SALT", "rev-2")
+    compile_cache.reset_stats()
+    _wrap()(x, x)
+    st = compile_cache.stats()
+    assert st["misses"] == 1 and st["hits"] == 0 and st["evictions"] == 0
+    # both revisions coexist: rolling back the salt re-hits the old entry
+    assert len(_entries(cache_dir)) == 2
+
+
+def test_lru_cap_evicts_oldest_first(cache_dir, monkeypatch):
+    monkeypatch.setenv("MXTPU_COMPILE_CACHE_MAX_MB", "0.02")
+    x = jnp.ones((4, 4))
+    written = []
+    for i in range(7):
+        # distinct constants fold into distinct graphs -> distinct entries
+        before = set(_entries(cache_dir))
+        _wrap(f"cctest.lru{i}", lambda a, b, c=float(i): a * c + b)(x, x)
+        new = set(_entries(cache_dir)) - before
+        if new:
+            written.append(new.pop())
+    assert len(written) == 7
+    left = set(_entries(cache_dir))
+    assert compile_cache.stats()["evictions"] > 0
+    assert 1 <= len(left) < 7
+    # oldest-first: the newest entry always survives, the first one went
+    assert written[-1] in left
+    assert written[0] not in left
+    cap = 0.02 * 1024 * 1024
+    assert sum(p.stat().st_size for p in left) <= cap or len(left) == 1
+
+
+def test_donation_mask_participates_in_entry_key():
+    sig = compile_cache.abstract_signature((jnp.ones((2, 2)),))
+    k_plain = compile_cache.entry_key("f", "gh", sig, donated=())
+    k_donated = compile_cache.entry_key("f", "gh", sig, donated=(0, 1))
+    assert k_plain != k_donated
+    assert k_plain == compile_cache.entry_key("f", "gh", sig, donated=())
+    # static_key (e.g. eager-op attrs) forks the key too
+    assert k_plain != compile_cache.entry_key(
+        "f", "gh", sig, donated=(), static_key=("momentum", 0.9))
+
+
+def test_tracer_args_bypass_cache(cache_dir):
+    inner = _wrap("cctest.inner", lambda a, b: a * b)
+
+    @jax.jit
+    def outer(a):
+        return inner(a, a)
+
+    out = np.asarray(outer(jnp.ones((3,)) * 2.0))
+    np.testing.assert_array_equal(out, np.full((3,), 4.0, np.float32))
+    # the tracer path must not have consulted (or populated) the store
+    st = compile_cache.stats()
+    assert st["hits"] == 0 and st["misses"] == 0
+    assert len(_entries(cache_dir)) == 0
+
+
+_CHILD = r"""
+import hashlib, json, sys
+import numpy as np
+import jax, jax.numpy as jnp
+from incubator_mxnet_tpu import compile_cache
+
+f = compile_cache.wrap("cctest.child", jax.jit(
+    lambda a, b: jnp.tanh(a @ b) * 0.5 + a.sum()))
+x = jnp.asarray(np.random.RandomState(5).rand(8, 8).astype("float32"))
+r = np.asarray(f(x, x))
+print(json.dumps({
+    "digest": hashlib.sha256(r.tobytes()).hexdigest(),
+    **compile_cache.stats(),
+}))
+"""
+
+
+def _run_child(code, env):
+    p = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=300,
+                       cwd=REPO)
+    assert p.returncode == 0, p.stderr[-2000:]
+    return json.loads(p.stdout.strip().splitlines()[-1])
+
+
+def test_warm_process_zero_compiles_bit_identical(cache_dir):
+    env = dict(os.environ)
+    env.update({"MXTPU_COMPILE_CACHE_DIR": str(cache_dir),
+                "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO})
+    cold = _run_child(_CHILD, env)
+    assert cold["misses"] == 1 and cold["hits"] == 0
+    env["PYTHONHASHSEED"] = "99"  # hash order must not fork the key
+    warm = _run_child(_CHILD, env)
+    assert warm["hits"] == 1 and warm["misses"] == 0, warm
+    assert warm["digest"] == cold["digest"]
+
+
+_SIG_CHILD = r"""
+import hashlib, json
+import numpy as np
+from incubator_mxnet_tpu.telemetry import compilereg
+
+# two dicts, same mapping, opposite insertion order
+d1 = {"weight": np.zeros((4, 2), np.float32), "bias": np.zeros(2, np.float16)}
+d2 = {}
+for k in reversed(list(d1)):
+    d2[k] = d1[k]
+sig1 = compilereg.signature_of(d1, np.float32, 3, "pad")
+sig2 = compilereg.signature_of(d2, np.dtype("float32"), 3, "pad")
+assert sig1 == sig2, (sig1, sig2)
+print(hashlib.sha256(repr(sig1).encode()).hexdigest())
+"""
+
+
+def test_signature_hash_stable_across_processes():
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO})
+    digests = set()
+    for seed in ("0", "1234"):
+        env["PYTHONHASHSEED"] = seed
+        p = subprocess.run([sys.executable, "-c", _SIG_CHILD], env=env,
+                           capture_output=True, text=True, timeout=300,
+                           cwd=REPO)
+        assert p.returncode == 0, p.stderr[-2000:]
+        digests.add(p.stdout.strip())
+    assert len(digests) == 1, digests
+
+
+def test_signature_of_canonical_forms():
+    sig_obj = compilereg.signature_of({"b": np.float32, "a": 1})
+    sig_sorted = compilereg.signature_of({"a": 1, "b": np.float32})
+    assert sig_obj == sig_sorted
+    # dtype spelled three ways -> one canonical name
+    a = np.zeros(3, np.float32)
+    assert (compilereg.signature_of(a)
+            == compilereg.signature_of(a.astype("float32")))
+    one = compilereg.signature_of(a)[0]
+    assert one == ((3,), "float32")

@@ -32,6 +32,13 @@ silently vanished bench is itself a regression). New result keys absent
 from the baseline are reported but do not fail; run with --update to fold
 them in (preserves each existing metric's tolerance/direction settings).
 
+--subset PREFIX (repeatable) restricts the comparison to baseline keys
+starting with any given PREFIX — for CI tiers that run a subset of the
+bench modes in isolation (e.g. the cold-start tier gates `cold_start.*`
+without requiring the observatory metrics in the same results file).
+With --update, only the subset is rewritten; every other baseline
+metric is preserved verbatim.
+
 --inject key=factor multiplies an observed value before comparison — the
 CI tier's negative self-test that the gate actually fires.
 
@@ -121,8 +128,13 @@ def compare(observed, baseline_metrics, tol_default):
     return failures, reports
 
 
-def update_baseline(path, observed, old_metrics, tol_default):
+def update_baseline(path, observed, old_metrics, tol_default, subset=()):
     metrics = {}
+    if subset:
+        # out-of-subset metrics pass through untouched: a subset update
+        # only asserts "this is the new surface of THIS bench mode"
+        metrics.update({k: v for k, v in old_metrics.items()
+                        if not k.startswith(tuple(subset))})
     for key in sorted(observed):
         prev = old_metrics.get(key, {})
         metrics[key] = {
@@ -152,6 +164,10 @@ def main(argv=None):
                     metavar="KEY=FACTOR",
                     help="multiply an observed metric before comparison "
                          "(negative self-test)")
+    ap.add_argument("--subset", action="append", default=[],
+                    metavar="PREFIX",
+                    help="gate only baseline keys starting with PREFIX "
+                         "(repeatable; single-mode CI tiers)")
     args = ap.parse_args(argv)
 
     lines = []
@@ -188,9 +204,18 @@ def main(argv=None):
             baseline = json.load(f)
     except OSError:
         baseline = None
+    subset = tuple(args.subset)
+    if subset:
+        observed = {k: v for k, v in observed.items()
+                    if k.startswith(subset)}
+        if not observed:
+            print(f"perf_gate: no metrics match --subset {subset}",
+                  file=sys.stderr)
+            return 2
     if args.update:
         old = (baseline or {}).get("metrics", {})
-        metrics = update_baseline(args.baseline, observed, old, tol_default)
+        metrics = update_baseline(args.baseline, observed, old, tol_default,
+                                  subset=subset)
         print(f"perf_gate: baseline updated with {len(metrics)} metrics "
               f"-> {args.baseline}")
         return 0
@@ -199,8 +224,16 @@ def main(argv=None):
               "(run with --update to create it)", file=sys.stderr)
         return 2
 
-    failures, reports = compare(observed, baseline.get("metrics", {}),
-                                tol_default)
+    baseline_metrics = baseline.get("metrics", {})
+    if subset:
+        baseline_metrics = {k: v for k, v in baseline_metrics.items()
+                            if k.startswith(subset)}
+        if not baseline_metrics:
+            print(f"perf_gate: baseline has no {subset}* metrics "
+                  "(run with --update --subset to seed them)",
+                  file=sys.stderr)
+            return 2
+    failures, reports = compare(observed, baseline_metrics, tol_default)
     for r in reports:
         print(r)
     if failures:

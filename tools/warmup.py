@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Shape-bucket AOT warmup: precompile executables into the persistent
+compile cache so a fresh process reaches its first step without
+compiling anything.
+
+For every (batch bucket x dtype) combination this tool builds the named
+model_zoo network, then AOT lower/compiles (without executing a step or
+touching parameter buffers):
+
+- the fused train step (`GluonTrainStep.warmup`), and/or
+- the inference executor program (`Executor.warmup`, with --infer)
+
+into `MXTPU_COMPILE_CACHE_DIR`. A serving restart, an elastic joiner, or
+a preemption-resume that later runs the same program (same model, batch
+shape, dtype, optimizer hyperparameters, jax/framework versions) then
+deserializes the executable instead of paying the cold-start compile
+(81-111 s for resnet50 on TPU — docs/PERF_ANALYSIS.md §1, "Cold start").
+
+    MXTPU_COMPILE_CACHE_DIR=/var/cache/mxtpu python tools/warmup.py \\
+        --model resnet50_v1 --shape data=32,3,224,224 \\
+        --batch-buckets 1,8,32 --dtypes float32,bfloat16
+
+The train-step program embeds the optimizer update, so --lr/--momentum/
+--wd/--rescale-grad must match the training job's hyperparameters for
+the entry to be the one it looks up (scheduled values that change per
+step ride in as runtime scalars and do NOT retrace).
+
+Output is JSON lines (one per combination + a summary), the same format
+bench.py emits.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _parse_shape(spec):
+    name, _, dims = spec.rpartition("=")
+    name = name or "data"
+    try:
+        return name, tuple(int(d) for d in dims.split(","))
+    except ValueError:
+        raise SystemExit(f"bad --shape {spec!r} (want data=32,3,224,224)")
+
+
+def _emit(obj):
+    print(json.dumps(obj), flush=True)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", required=True,
+                    help="gluon model_zoo network name (e.g. resnet18_v1)")
+    ap.add_argument("--shape", default="data=1,3,224,224",
+                    metavar="NAME=B,C,H,W",
+                    help="input shape; the leading dim is replaced by "
+                         "each --batch-buckets value")
+    ap.add_argument("--batch-buckets", default="",
+                    help="comma-separated batch sizes to precompile "
+                         "(default: just the --shape batch)")
+    ap.add_argument("--dtypes", default="float32",
+                    help="comma-separated dtypes (float32, bfloat16)")
+    ap.add_argument("--classes", type=int, default=1000)
+    ap.add_argument("--train", action="store_true", default=True,
+                    help="warm the fused train step (default)")
+    ap.add_argument("--no-train", dest="train", action="store_false")
+    ap.add_argument("--infer", action="store_true",
+                    help="also warm the bound inference executor program")
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--momentum", type=float, default=0.9)
+    ap.add_argument("--wd", type=float, default=1e-4)
+    ap.add_argument("--rescale-grad", type=float, default=None,
+                    help="default: 1/batch (bench.py's convention)")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, fused, gluon, compile_cache
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    if not compile_cache.enabled():
+        print("warmup: MXTPU_COMPILE_CACHE_DIR is not set — nothing to "
+              "warm into", file=sys.stderr)
+        return 2
+
+    _, base_shape = _parse_shape(args.shape)
+    buckets = ([int(b) for b in args.batch_buckets.split(",") if b]
+               or [base_shape[0]])
+    dtypes = [d.strip() for d in args.dtypes.split(",") if d.strip()]
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    total = {"combos": 0, "statuses": {}}
+    t_start = time.perf_counter()
+    for batch in buckets:
+        shape = (batch,) + base_shape[1:]
+        for dtype in dtypes:
+            # fresh net per combination: cast() mutates parameters, and
+            # each (shape, dtype) pair is its own executable anyway
+            mx.random.seed(0)
+            net = vision.get_model(args.model, classes=args.classes)
+            net.initialize(mx.init.Xavier())
+            if dtype != "float32":
+                net.cast(dtype)
+            x = nd.zeros(shape, dtype=dtype)
+            y = nd.zeros((batch,), dtype="float32")
+            if args.train:
+                rescale = (args.rescale_grad if args.rescale_grad
+                           is not None else 1.0 / batch)
+                opt = mx.optimizer.SGD(learning_rate=args.lr,
+                                       momentum=args.momentum, wd=args.wd,
+                                       rescale_grad=rescale)
+                step = fused.GluonTrainStep(
+                    net, lambda n, a, b: L(n(a), b), opt)
+                t0 = time.perf_counter()
+                status = step.warmup(x, y)
+                _emit({"metric": "warmup", "site": "train_step",
+                       "model": args.model, "batch": batch, "dtype": dtype,
+                       "status": status,
+                       "seconds": round(time.perf_counter() - t0, 3)})
+                total["combos"] += 1
+                total["statuses"][status] = \
+                    total["statuses"].get(status, 0) + 1
+            if args.infer:
+                sym = net._to_symbol()
+                ex = sym.simple_bind(None, data=shape)
+                t0 = time.perf_counter()
+                status = ex.warmup()
+                _emit({"metric": "warmup", "site": "infer",
+                       "model": args.model, "batch": batch, "dtype": dtype,
+                       "status": status,
+                       "seconds": round(time.perf_counter() - t0, 3)})
+                total["combos"] += 1
+                total["statuses"][status] = \
+                    total["statuses"].get(status, 0) + 1
+
+    st = compile_cache.stats()
+    entries = []
+    store_dir = Path(compile_cache.cache_dir())
+    if store_dir.is_dir():
+        entries = [p for p in store_dir.iterdir()
+                   if p.name.endswith(".exe")]
+    _emit({"metric": "warmup_summary", "model": args.model,
+           "combos": total["combos"], **total["statuses"],
+           "cache_entries": len(entries),
+           "cache_bytes": sum(p.stat().st_size for p in entries),
+           "hits": st["hits"], "misses": st["misses"],
+           "seconds": round(time.perf_counter() - t_start, 3)})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
