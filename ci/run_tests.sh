@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI tiers (ref: ci/docker/runtime_functions.sh — unittest / nightly /
 # distributed stages). Usage:
-#   ci/run_tests.sh [unit|nightly|dist|examples|telemetry|aggregation|static-analysis|perf-structure|perf-gate|cold-start|chaos|all]
+#   ci/run_tests.sh [unit|nightly|dist|examples|telemetry|aggregation|static-analysis|perf-structure|perf-gate|cold-start|serving|chaos|all]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -256,7 +256,69 @@ print(f"warmup tool ok: {first['combos']} combos precompiled, "
       f"second pass {second['hits']}/{second['combos']} hits in "
       f"{second['seconds']}s (first: {first['seconds']}s)")
 PY
-    echo "cold-start tier: zero warm compiles, corrupt fallback bit-identical, warmup tool all-hit on re-run"
+    # same contract for the serving decode/prefill programs: --decode
+    # precompiles the decode step + every prefill bucket into a fresh
+    # cache; the re-run must be all hits
+    local wd_dir
+    wd_dir="$(mktemp -d -t mxtpu-warmup-decode-XXXXXX)"
+    JAX_PLATFORMS=cpu MXTPU_COMPILE_CACHE_DIR="$wd_dir" \
+        python tools/warmup.py --decode \
+        --d-model 32 --n-layers 2 --n-heads 2 --d-ff 64 \
+        --vocab 64 --seq 64 --slots 3 --page-size 8 \
+        > "$cs_dir/warmup_decode.json"
+    JAX_PLATFORMS=cpu MXTPU_COMPILE_CACHE_DIR="$wd_dir" \
+        python tools/warmup.py --decode \
+        --d-model 32 --n-layers 2 --n-heads 2 --d-ff 64 \
+        --vocab 64 --seq 64 --slots 3 --page-size 8 \
+        > "$cs_dir/warmup_decode2.json"
+    python - "$cs_dir" <<'PY'
+import json, sys
+d = sys.argv[1]
+runs = []
+for f in ("warmup_decode.json", "warmup_decode2.json"):
+    lines = [json.loads(l) for l in open(f"{d}/{f}") if l.startswith("{")]
+    runs.append(([o for o in lines if o["metric"] == "warmup_summary"][0],
+                 [o for o in lines if o["metric"] == "warmup"]))
+(first, sites1), (second, sites2) = runs
+assert first["misses"] == first["combos"] > 1, first
+assert first["cache_entries"] == first["combos"], first
+assert second["hits"] == second["combos"] and second["misses"] == 0, second
+assert {s["site"] for s in sites1} == {s["site"] for s in sites2}
+assert any(s["site"] == "serving_decode_step" for s in sites1), sites1
+print(f"warmup --decode ok: {first['combos']} serving sites precompiled "
+      f"(decode step + prefill buckets), second pass all-hit")
+PY
+    echo "cold-start tier: zero warm compiles, corrupt fallback bit-identical, warmup tool all-hit on re-run (model + serving)"
+}
+
+run_serving() {
+    echo "=== serving tier (paged decode engine + steady-state retrace gate) ==="
+    # engine smoke: kernel equivalence, allocator, token-identity vs
+    # generate(), and the steady-state zero-retrace assertions
+    JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
+    # seeded mixed-length trace through the continuous-batching engine;
+    # the gate zero-tolerates steady-state compiles/retraces and dense
+    # decode fallbacks (wall-clock throughput/latency are report-only)
+    local sv_dir
+    sv_dir="$(mktemp -d -t mxtpu-serving-XXXXXX)"
+    JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
+        MXTPU_COMPILE_CACHE_DIR="$sv_dir/cache" \
+        python tools/bench_transformer.py --serving \
+        --d-model 32 --n-layers 2 --n-heads 2 --d-ff 64 \
+        --vocab 64 --seq 64 --serving-requests 12 --slots 3 \
+        --page-size 8 > "$sv_dir/serving.json"
+    python tools/perf_gate.py "$sv_dir/serving.json" \
+        --baseline ci/perf_baseline.json --subset serving
+    # negative self-test: a seeded lost-request regression MUST fail
+    if python tools/perf_gate.py "$sv_dir/serving.json" \
+        --baseline ci/perf_baseline.json --subset serving \
+        --inject serving.requests_completed=0.5 \
+        > "$sv_dir/inject.log" 2>&1; then
+        echo "FAIL: perf_gate passed a seeded lost-request regression" >&2
+        cat "$sv_dir/inject.log" >&2
+        exit 1
+    fi
+    echo "serving tier: trace completed, zero steady-state retraces/fallbacks, seeded regression rejected"
 }
 
 run_nightly() {
@@ -289,8 +351,9 @@ case "$tier" in
     perf-structure) run_perf_structure ;;
     perf-gate) run_perf_gate ;;
     cold-start) run_cold_start ;;
+    serving)   run_serving ;;
     nightly)   run_nightly ;;
-    all)       run_static_analysis; run_unit; run_telemetry; run_aggregation; run_perf_structure; run_perf_gate; run_cold_start; run_chaos; run_dist; run_examples; run_nightly ;;
-    *) echo "unknown tier: $tier (unit|nightly|dist|examples|suite|telemetry|aggregation|static-analysis|perf-structure|perf-gate|cold-start|chaos|all)"; exit 2 ;;
+    all)       run_static_analysis; run_unit; run_telemetry; run_aggregation; run_perf_structure; run_perf_gate; run_cold_start; run_serving; run_chaos; run_dist; run_examples; run_nightly ;;
+    *) echo "unknown tier: $tier (unit|nightly|dist|examples|suite|telemetry|aggregation|static-analysis|perf-structure|perf-gate|cold-start|serving|chaos|all)"; exit 2 ;;
 esac
 echo "tier '$tier' green"

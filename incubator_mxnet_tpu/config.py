@@ -382,6 +382,33 @@ register_knob("MXTPU_SPARSE_NNZ_BUCKETING", False, bool,
               "default: padding trades memory/compute for compile-cache "
               "hits, which only pays on TPU with nnz-diverse batches.")
 
+# serving (serving/engine.py — continuous batching over a paged KV cache)
+register_knob("MXTPU_PAGE_SIZE", 16, int,
+              "Tokens per KV-cache page in the paged decode pool "
+              "(serving/pages.py). Smaller pages waste less capacity on "
+              "the last partial page per sequence but deepen the "
+              "page-table walk in paged_decode_attention; must keep the "
+              "page a TPU-friendly block (multiples of 8 recommended).")
+register_knob("MXTPU_DECODE_SLOTS", 8, int,
+              "Fixed number of decode slots in the continuous-batching "
+              "engine — the static batch dimension of every paged decode "
+              "step. Requests beyond this wait in the queue; raising it "
+              "trades per-step latency for throughput. Static so the "
+              "steady-state serving loop never retraces.")
+register_knob("MXTPU_SERVING_PAGES", 0, int,
+              "Total pages in the serving KV pool (page 0 is the "
+              "reserved null page). 0 (default) auto-sizes to "
+              "slots x ceil(max_len / page_size) + 1 — every slot can "
+              "hold a full-length sequence; set lower to oversubscribe "
+              "HBM and let admission backpressure manage the pool.")
+register_knob("MXTPU_PREFILL_BUCKETS", "", str,
+              "Comma-separated prompt-length buckets for serving "
+              "prefill (each bucket is one compiled program; prompts "
+              "pad up to the next bucket — the "
+              "MXTPU_SPARSE_NNZ_BUCKETING idea applied to sequence "
+              "length). Empty (default) uses powers of two from 16 up "
+              "to the model's max_len.")
+
 # contrib / compatibility shims
 register_knob("MXTPU_USE_TENSORRT", False, bool,
               "TensorRT-compat preference flag (contrib.tensorrt). Purely "

@@ -47,6 +47,9 @@ __all__ = [
     "prefill",
     "generate",
     "beam_search",
+    "init_paged_kv_cache",
+    "decode_step_paged",
+    "prefill_paged",
 ]
 
 
@@ -211,8 +214,18 @@ def _xent_fused_local(logits, targets):
 
 def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int | None = None):
     """Per-layer key/value cache: (L, B, T_max, H, Dh) + a scalar write
-    position. Static T_max keeps every decode step the same XLA program."""
+    position. Static T_max keeps every decode step the same XLA program.
+
+    T_max is rounded up to a DECODE_BLOCK multiple (when larger than one
+    block) so `flash_decode` always tiles — the silent dense fallback on
+    untiled caches cost the Pallas path exactly when caches got long
+    enough to need it. Extra slots are masked by `n_valid`, so numerics
+    are unchanged."""
+    from ..ops.pallas_kernels import DECODE_BLOCK
+
     T = int(max_len or cfg.max_len)
+    if T > DECODE_BLOCK and T % DECODE_BLOCK:
+        T += DECODE_BLOCK - T % DECODE_BLOCK
     H = cfg.n_heads
     Dh = cfg.d_model // H
     shape = (cfg.n_layers, batch, T, H, Dh)
@@ -310,6 +323,141 @@ def prefill(params, cache, prompt, cfg: TransformerConfig):
     logits = h @ params["embed"].T
     return {"k": new_k, "v": new_v,
             "pos": jnp.asarray(T_p, jnp.int32)}, logits
+
+
+# ---------------------------------------------------------------------------
+# Paged decoding: K/V in a global page pool shared by every decode slot
+# (serving path — the dense cache above burns B x T_max HBM and forces the
+# whole batch to one depth; pages + per-slot positions are what continuous
+# batching needs: serving/engine.py drives these three functions)
+# ---------------------------------------------------------------------------
+
+
+def init_paged_kv_cache(cfg: TransformerConfig, num_pages: int,
+                        page_size: int):
+    """Per-layer paged K/V pool: (L, num_pages, page_size, H, Dh). No
+    position scalar — slot positions live with the caller (the engine),
+    one per decode slot. Page 0 is the null page by convention
+    (serving.pages.PageAllocator never hands it out): dead slots and
+    padded prefill rows scatter their writes there."""
+    H = cfg.n_heads
+    Dh = cfg.d_model // H
+    shape = (cfg.n_layers, num_pages, page_size, H, Dh)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _page_write_index(page_table, positions, page_size):
+    """Flat pool row (page * page_size + offset) where each slot's next
+    token lands. positions: (S,) — tokens already cached per slot."""
+    page = jnp.take_along_axis(
+        page_table, (positions // page_size)[:, None], axis=1)[:, 0]
+    return page * page_size + positions % page_size
+
+
+def decode_step_paged(params, paged, tokens, positions, page_table,
+                      cfg: TransformerConfig):
+    """One token for every decode slot, each at its OWN depth.
+
+    paged: init_paged_kv_cache dict; tokens (S,) int32; positions (S,)
+    int32 — tokens already cached per slot (the new token is written at
+    that offset, then attention covers positions+1); page_table
+    (S, P_max) int32 rows of owned page ids. Dead slots (all-zero table
+    row, position 0) write to the null page and produce garbage logits
+    the caller discards. Returns (logits (S, V), new_paged). Shapes are
+    static in (S, P_max, pool) — every call is one XLA program."""
+    S = tokens.shape[0]
+    num_pages, page_size = paged["k"].shape[1], paged["k"].shape[2]
+    x = params["embed"][tokens] + params["pos"][positions]  # (S, d)
+    n_valid = positions + 1
+    write_idx = _page_write_index(page_table, positions, page_size)
+
+    stacked = {k: params[k] for k in _stack_keys(params)}
+
+    def body(x, layer_in):
+        lp, k_pool, v_pool = layer_in  # (num_pages, page_size, H, Dh)
+        h = _ln(x, lp["ln1_g"], lp["ln1_b"])
+        q = (h @ lp["wq"]).reshape(S, cfg.n_heads, -1)
+        k = (h @ lp["wk"]).reshape(S, cfg.n_heads, -1)
+        v = (h @ lp["wv"]).reshape(S, cfg.n_heads, -1)
+        flat = (num_pages * page_size,) + k_pool.shape[2:]
+        k_pool = k_pool.reshape(flat).at[write_idx].set(
+            k.astype(k_pool.dtype)).reshape(k_pool.shape)
+        v_pool = v_pool.reshape(flat).at[write_idx].set(
+            v.astype(v_pool.dtype)).reshape(v_pool.shape)
+        from ..ops.pallas_kernels import paged_decode_attention
+
+        a = paged_decode_attention(q, k_pool, v_pool, page_table, n_valid)
+        x = x + a.reshape(S, cfg.d_model) @ lp["wo"]
+        h = _ln(x, lp["ln2_g"], lp["ln2_b"])
+        if cfg.n_experts:
+            out, _ = moe_ffn(h, lp["router"], lp["w1"], lp["w2"])
+            x = x + out
+        else:
+            x = x + jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
+        return x, (k_pool, v_pool)
+
+    x, (new_k, new_v) = lax.scan(body, x, (stacked, paged["k"], paged["v"]))
+    x = _ln(x, params["ln_f_g"], params["ln_f_b"])
+    logits = x @ params["embed"].T
+    return logits, {"k": new_k, "v": new_v}
+
+
+def prefill_paged(params, paged, prompts, true_lens, page_table,
+                  cfg: TransformerConfig):
+    """Prefill a BUCKET of prompts straight into their pages in one pass.
+
+    prompts: (S, T_b) int32 padded to the bucket length; true_lens (S,)
+    — real prompt length per row (padding rows use 0); page_table
+    (S, P_max). Causal attention makes every position < true_len exact
+    regardless of the padding tail; padded positions scatter to the null
+    page and their activations are never read. Returns (new_paged,
+    logits (S, V) at each row's LAST REAL token — the first sampled
+    continuation token, matching prefill()'s x[:, -1] for full rows."""
+    S, T_b = prompts.shape
+    num_pages, page_size = paged["k"].shape[1], paged["k"].shape[2]
+    x = params["embed"][prompts] + params["pos"][:T_b][None]
+    stacked = {k: params[k] for k in _stack_keys(params)}
+
+    t = jnp.arange(T_b)
+    valid = t[None, :] < true_lens[:, None]  # (S, T_b)
+    page = jnp.take_along_axis(
+        page_table, jnp.broadcast_to((t // page_size)[None], (S, T_b)),
+        axis=1)
+    write_idx = jnp.where(valid, page * page_size + t[None] % page_size,
+                          0).reshape(S * T_b)
+
+    def body(x, layer_in):
+        lp, k_pool, v_pool = layer_in
+        h = _ln(x, lp["ln1_g"], lp["ln1_b"])
+        q = _split_heads(h @ lp["wq"], cfg.n_heads)
+        k = _split_heads(h @ lp["wk"], cfg.n_heads)
+        v = _split_heads(h @ lp["wv"], cfg.n_heads)
+        flat = (num_pages * page_size,) + k_pool.shape[2:]
+        kw = k.reshape((S * T_b,) + k.shape[2:]).astype(k_pool.dtype)
+        vw = v.reshape((S * T_b,) + v.shape[2:]).astype(v_pool.dtype)
+        k_pool = k_pool.reshape(flat).at[write_idx].set(kw).reshape(
+            k_pool.shape)
+        v_pool = v_pool.reshape(flat).at[write_idx].set(vw).reshape(
+            v_pool.shape)
+        a = _dense_attention(q, k, v, causal=True)
+        x = x + a.reshape(S, T_b, cfg.d_model) @ lp["wo"]
+        h = _ln(x, lp["ln2_g"], lp["ln2_b"])
+        if cfg.n_experts:
+            flat_h = h.reshape(S * T_b, cfg.d_model)
+            out, _ = moe_ffn(flat_h, lp["router"], lp["w1"], lp["w2"])
+            x = x + out.reshape(S, T_b, cfg.d_model)
+        else:
+            x = x + jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
+        return x, (k_pool, v_pool)
+
+    x, (new_k, new_v) = lax.scan(body, x, (stacked, paged["k"], paged["v"]))
+    last = jnp.maximum(true_lens - 1, 0)  # (S,)
+    x_last = jnp.take_along_axis(
+        x, last[:, None, None].astype(jnp.int32), axis=1)[:, 0]  # (S, d)
+    h = _ln(x_last, params["ln_f_g"], params["ln_f_b"])
+    logits = h @ params["embed"].T
+    return {"k": new_k, "v": new_v}, logits
 
 
 def _filter_logits(logits, top_k=0, top_p=0.0):

@@ -19,7 +19,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 __all__ = ["flash_attention", "softmax_xent", "flash_decode",
-           "dense_decode_attention", "bn_act_epilogue"]
+           "dense_decode_attention", "paged_decode_attention",
+           "bn_act_epilogue", "DECODE_BLOCK", "DENSE_FALLBACKS_TOTAL"]
 
 _NEG_INF = -1e30
 
@@ -416,9 +417,38 @@ def softmax_xent(logits, labels, block_b=8, interpret=None, vma=None):
 # ---------------------------------------------------------------------------
 # Flash decode: single-query attention over a KV cache (the serving-side
 # memory-bound op — one (1, D) query streams the cache once, online softmax,
-# no (T,) probability vector in HBM). Valid length arrives as data so every
-# decode step is the same compiled kernel.
+# no (T,) probability vector in HBM). Valid lengths arrive as data so every
+# decode step is the same compiled kernel; `n_valid` may be a scalar (whole
+# batch at one depth — the lockstep decode_step path) or a (B,) vector
+# (per-sequence depths — the continuous-batching serving path).
 # ---------------------------------------------------------------------------
+
+# flash_decode tiles the cache time axis in blocks of this size; caches are
+# padded up to a multiple at init (models.transformer.init_kv_cache) so the
+# Pallas path always engages instead of silently falling back to dense.
+DECODE_BLOCK = 128
+
+DENSE_FALLBACKS_TOTAL = "mxtpu_decode_dense_fallbacks_total"
+_FALLBACKS_HELP = ("flash_decode calls that fell back to the dense "
+                   "(non-Pallas) cache attention because the cache length "
+                   "does not tile into decode blocks, by reason.")
+
+
+def _count_dense_fallback(reason):
+    # trace-time event (shapes are static), so the counter costs nothing
+    # on the per-step hot path; lazy import keeps this module jax-only
+    # when telemetry is off
+    from .. import telemetry
+
+    telemetry.inc(DENSE_FALLBACKS_TOTAL, help=_FALLBACKS_HELP,
+                  reason=reason)
+
+
+def _per_seq_n_valid(n_valid, batch):
+    """Canonicalize `n_valid` (python/traced scalar or (B,) vector) to a
+    (B,) int32 vector."""
+    nv = jnp.asarray(n_valid, jnp.int32)
+    return jnp.broadcast_to(nv, (batch,))
 
 
 def _decode_kernel(q_ref, k_ref, v_ref, nv_ref, o_ref, *, block_k, scale):
@@ -453,11 +483,15 @@ def _decode_kernel(q_ref, k_ref, v_ref, nv_ref, o_ref, *, block_k, scale):
 def dense_decode_attention(q, k_cache, v_cache, n_valid):
     """Reference single-query cache attention (also the non-tiling
     fallback and decode_step's dense path): q (B, H, D), caches
-    (B, T, H, D), attend to the first n_valid positions."""
+    (B, T, H, D), attend to the first n_valid positions. `n_valid` is a
+    scalar (one depth for the whole batch) or a (B,) vector (ragged
+    per-sequence depths)."""
+    B, T = k_cache.shape[0], k_cache.shape[1]
     D = q.shape[-1]
+    nv = _per_seq_n_valid(n_valid, B)
     s = jnp.einsum("bhd,bthd->bht", q, k_cache) / np.sqrt(D)
-    T = k_cache.shape[1]
-    s = jnp.where((jnp.arange(T) < n_valid)[None, None], s, _NEG_INF)
+    s = jnp.where(jnp.arange(T)[None, None] < nv[:, None, None], s,
+                  _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bht,bthd->bhd", p, v_cache)
 
@@ -636,33 +670,135 @@ def bn_act_epilogue(x, scale, shift, residual=None, block_rows=256,
     return y.reshape(x.shape)
 
 
-def flash_decode(q, k_cache, v_cache, n_valid, block_k=128, interpret=None):
+def flash_decode(q, k_cache, v_cache, n_valid, block_k=DECODE_BLOCK,
+                 interpret=None):
     """Single-step attention: q (B, H, D) against caches (B, T, H, D),
-    attending to the first `n_valid` positions (traced scalar). Returns
-    (B, H, D)."""
+    attending to the first `n_valid` positions (traced scalar, or a (B,)
+    vector of per-sequence depths). Returns (B, H, D)."""
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     B, T, H, D = k_cache.shape
     blk = min(block_k, T)
     if T % blk != 0:  # cache length must tile; fall back to dense
+        _count_dense_fallback("untiled_cache")
         return dense_decode_attention(q, k_cache, v_cache, n_valid)
-    qr = q.reshape(B * H, 1, D)
-    kr = k_cache.transpose(0, 2, 1, 3).reshape(B * H, T, D)
-    vr = v_cache.transpose(0, 2, 1, 3).reshape(B * H, T, D)
-    nv = jnp.full((1,), n_valid, jnp.int32)
+    qr = q.reshape(B, H, 1, D)
+    kr = k_cache.transpose(0, 2, 1, 3)  # (B, H, T, D)
+    vr = v_cache.transpose(0, 2, 1, 3)
+    nv = _per_seq_n_valid(n_valid, B)
     kernel = functools.partial(_decode_kernel, block_k=blk,
                                scale=1.0 / np.sqrt(D))
     o = pl.pallas_call(
         kernel,
-        grid=(B * H,),
+        grid=(B, H),
         in_specs=[
-            pl.BlockSpec((None, 1, D), lambda b: (b, 0, 0)),
-            pl.BlockSpec((None, T, D), lambda b: (b, 0, 0)),
-            pl.BlockSpec((None, T, D), lambda b: (b, 0, 0)),
-            pl.BlockSpec((1,), lambda b: (0,)),
+            pl.BlockSpec((None, None, 1, D), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, T, D), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, T, D), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1,), lambda b, h: (b,)),
         ],
-        out_specs=pl.BlockSpec((None, 1, D), lambda b: (b, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, 1, D), q.dtype),
+        out_specs=pl.BlockSpec((None, None, 1, D),
+                               lambda b, h: (b, h, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, 1, D), q.dtype),
         interpret=interpret,
     )(qr, kr, vr, nv)
+    return o.reshape(B, H, D)
+
+
+# ---------------------------------------------------------------------------
+# Paged decode: single-query attention where K/V live in a global page pool
+# shared by every sequence (the vLLM/PagedAttention data structure). Each
+# sequence owns a page-table row; the kernel walks it with pl.ds gathers and
+# runs the same online-softmax accumulation as _decode_kernel. Per-sequence
+# valid lengths make it the continuous-batching serving kernel: slots at
+# different depths decode in ONE launch of one compiled program.
+# ---------------------------------------------------------------------------
+
+
+def _paged_decode_kernel(pt_ref, nv_ref, q_ref, k_ref, v_ref, o_ref, *,
+                         page_size, scale):
+    """One (b, h) grid step. pt_ref (B, P_max) and nv_ref (B,) are
+    scalar-prefetch refs (SMEM — readable for control flow and pl.ds
+    gather indices); k_ref/v_ref see the whole pool for head h."""
+    b = pl.program_id(0)
+    q = q_ref[...]  # (1, d)
+    nv = nv_ref[b]
+
+    def body(j, carry):
+        o, m, l = carry
+        page = pt_ref[b, j]
+        k = k_ref[pl.ds(page, 1)].reshape(page_size, -1)
+        v = v_ref[pl.ds(page, 1)].reshape(page_size, -1)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        idx = (j * page_size
+               + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+        s = jnp.where(idx < nv, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        o_new = o * alpha[:, None] + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    d = q.shape[1]
+    o0 = jnp.zeros((1, d), jnp.float32)
+    m0 = jnp.full((1,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((1,), jnp.float32)
+    # walk only the live pages of THIS sequence (dynamic bound, like the
+    # dynamic num_k of _decode_kernel); dead slots (nv == 0) do no work
+    num_pages = (nv + page_size - 1) // page_size
+    o, m, l = jax.lax.fori_loop(0, num_pages, body, (o0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[...] = (o / l[:, None]).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_pages, v_pages, page_table, n_valid,
+                           interpret=None):
+    """Single-query attention over a paged KV cache.
+
+    q: (B, H, D) — one query per decode slot;
+    k_pages/v_pages: (num_pages, page_size, H, D) — the global page pool;
+    page_table: (B, P_max) int32 — page ids owned by each slot, in
+    sequence order (entries past the live length are ignored);
+    n_valid: (B,) int32 (or scalar) — tokens live per slot; 0 marks a
+    dead slot (its output is the zero-length softmax of the null page —
+    finite garbage the caller discards).
+
+    Returns (B, H, D). The pool stays in its natural layout; the grid is
+    (B, H) and each step streams only ceil(n_valid/page_size) pages of
+    its own sequence via pl.ds gathers driven by the scalar-prefetched
+    page table (so HBM traffic per decoded token is the live cache, not
+    B x T_max)."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, D = q.shape
+    num_pages, page_size = k_pages.shape[0], k_pages.shape[1]
+    nv = _per_seq_n_valid(n_valid, B)
+    pt = jnp.asarray(page_table, jnp.int32)
+    qr = q.reshape(B, H, 1, D)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, H),
+        in_specs=[
+            pl.BlockSpec((None, None, 1, D),
+                         lambda b, h, *refs: (b, h, 0, 0)),
+            pl.BlockSpec((num_pages, page_size, None, D),
+                         lambda b, h, *refs: (0, 0, h, 0)),
+            pl.BlockSpec((num_pages, page_size, None, D),
+                         lambda b, h, *refs: (0, 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, 1, D),
+                               lambda b, h, *refs: (b, h, 0, 0)),
+    )
+    kernel = functools.partial(_paged_decode_kernel, page_size=page_size,
+                               scale=1.0 / np.sqrt(D))
+    o = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, 1, D), q.dtype),
+        interpret=interpret,
+    )(pt, nv, qr, k_pages, v_pages)
     return o.reshape(B, H, D)

@@ -1,0 +1,17 @@
+"""Online serving: continuous batching over a paged KV cache.
+
+The reference framework ships no request-facing path (its serving story
+was the C predict API over static graphs); this package is the TPU-native
+one. `pages.PageAllocator` owns the global KV page pool; `engine
+.ServingEngine` runs vLLM/Orca-style iteration-level scheduling: a fixed
+set of decode slots advance one token per step in ONE compiled program
+(`models.transformer.decode_step_paged` over
+`ops.pallas_kernels.paged_decode_attention`), requests admit into free
+slots with bucketed prefill and evict on EOS/length with immediate page
+recycling. Every shape is static, so the steady state performs zero
+retraces — gated by telemetry.compilereg and warmed by compile_cache.
+"""
+from .pages import PageAllocator  # noqa: F401
+from .engine import Request, RequestResult, ServingEngine  # noqa: F401
+
+__all__ = ["PageAllocator", "Request", "RequestResult", "ServingEngine"]

@@ -1,0 +1,371 @@
+"""Continuous-batching decode engine over the paged KV cache.
+
+Iteration-level scheduling (Orca) over PagedAttention-style storage
+(vLLM), on the repo's own primitives:
+
+- a FIFO request queue feeding a FIXED set of `MXTPU_DECODE_SLOTS`
+  decode slots — the static batch dimension of every decode step;
+- admission = all-or-nothing page allocation (serving/pages.py) for the
+  request's worst case, then a BUCKETED prefill (prompt padded up to one
+  of a few static lengths — the MXTPU_SPARSE_NNZ_BUCKETING idea applied
+  to sequence length) writing prompt K/V straight into the pages;
+- one `decode_step_paged` per engine step advances EVERY live slot one
+  token, each at its own depth (per-slot positions + page-table rows);
+- eviction on EOS or max-tokens recycles pages immediately — the next
+  admission can reuse them without touching device memory.
+
+Every device call has a static shape: one decode program, one prefill
+program per bucket. The steady state therefore performs ZERO retraces
+(compilereg-gated in CI) and a warm replica performs zero compiles
+(`warm()` AOT-populates the PR 10 compile cache; tools/warmup.py
+--decode drives it).
+
+Greedy decoding (temperature 0) — token-for-token identical to
+sequential `models.transformer.generate()` per request, which is the
+equivalence CI asserts.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from collections import deque
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import compile_cache, config, telemetry
+from ..models import transformer as _tfm
+from .pages import PageAllocator
+
+__all__ = ["Request", "RequestResult", "ServingEngine"]
+
+QUEUE_DEPTH = "mxtpu_serving_queue_depth"
+SLOTS_IN_USE = "mxtpu_serving_slots_in_use"
+PAGES_IN_USE = "mxtpu_serving_pages_in_use"
+PAGE_UTILIZATION = "mxtpu_serving_page_utilization"
+REQUESTS_TOTAL = "mxtpu_serving_requests_total"
+TOKENS_TOTAL = "mxtpu_serving_tokens_total"
+REQUEST_SECONDS = "mxtpu_serving_request_seconds"
+QUEUE_WAIT_SECONDS = "mxtpu_serving_queue_wait_seconds"
+TTFT_SECONDS = "mxtpu_serving_ttft_seconds"
+
+# sub-ms to minutes: decode steps are ms-scale, queued requests can wait
+_LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                    0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request: greedy-decode up to `max_new_tokens`
+    continuation tokens, stopping early when `eos_id` is produced
+    (the EOS token is included in the output)."""
+    request_id: int
+    prompt: np.ndarray  # (T_p,) int32
+    max_new_tokens: int
+    eos_id: int | None = None
+    submitted_at: float = 0.0
+    admitted_at: float = 0.0
+
+
+@dataclasses.dataclass
+class RequestResult:
+    request_id: int
+    tokens: list  # generated continuation (includes EOS when hit)
+    finish_reason: str  # "eos" | "length"
+    prompt_len: int
+    queue_wait_s: float
+    latency_s: float
+
+
+def _default_buckets(max_len):
+    """Powers of two from 16 up to (and always including) max_len."""
+    raw = str(config.get("MXTPU_PREFILL_BUCKETS") or "")
+    if raw.strip():
+        buckets = sorted({int(b) for b in raw.split(",") if b.strip()})
+    else:
+        buckets, b = [], 16
+        while b < max_len:
+            buckets.append(b)
+            b *= 2
+        buckets.append(max_len)
+    return [b for b in buckets if b <= max_len] or [max_len]
+
+
+class ServingEngine:
+    """Continuous-batching greedy-decode engine for one transformer.
+
+    >>> eng = ServingEngine(params, cfg)
+    >>> rid = eng.submit([1, 2, 3], max_new_tokens=16, eos_id=0)
+    >>> results = eng.run()          # drain queue + slots
+    >>> results[rid].tokens
+
+    `step()` runs ONE scheduler iteration (admissions + one decode
+    step) for callers that interleave serving with other work.
+    """
+
+    def __init__(self, params, cfg, *, slots=None, page_size=None,
+                 num_pages=None, max_len=None, clock=time.monotonic):
+        self.params = params
+        self.cfg = cfg
+        self.page_size = int(page_size or config.get("MXTPU_PAGE_SIZE"))
+        self.slots = int(slots or config.get("MXTPU_DECODE_SLOTS"))
+        self.max_len = int(max_len or cfg.max_len)
+        if self.max_len > cfg.max_len:
+            raise ValueError(f"max_len {self.max_len} exceeds the "
+                             f"model's positional table ({cfg.max_len})")
+        self.table_width = -(-self.max_len // self.page_size)
+        if num_pages is None:
+            num_pages = int(config.get("MXTPU_SERVING_PAGES"))
+        if not num_pages:  # auto: every slot can hold a full sequence
+            num_pages = self.slots * self.table_width + 1
+        self.allocator = PageAllocator(num_pages, self.page_size)
+        self.paged = _tfm.init_paged_kv_cache(cfg, num_pages,
+                                              self.page_size)
+        self.prefill_buckets = _default_buckets(self.max_len)
+        self._clock = clock
+
+        S, W = self.slots, self.table_width
+        self._tables = np.zeros((S, W), np.int32)
+        self._positions = np.zeros((S,), np.int32)
+        self._next_tok = np.zeros((S,), np.int32)
+        self._slot_req: list[Request | None] = [None] * S
+        self._slot_pages: list[list] = [[] for _ in range(S)]
+        self._slot_out: list[list] = [[] for _ in range(S)]
+        self._queue: deque[Request] = deque()
+        self._results: dict[int, RequestResult] = {}
+        self._ids = itertools.count()
+        self.steps = 0
+
+        # donation frees the old pool the moment the step runs; CPU
+        # buffers aren't donatable (jax warns and copies anyway)
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        self._decode = compile_cache.wrap(
+            "serving_decode_step",
+            jax.jit(self._decode_fn, donate_argnums=donate),
+            donated=donate)
+        # one jit per bucket: the bucket length is baked into the prompt
+        # shape, so each T_b is its own named executable for compilereg,
+        # the compile cache, and warmup
+        self._prefills = {
+            T_b: compile_cache.wrap(
+                f"serving_prefill_b{T_b}",
+                jax.jit(self._prefill_fn, donate_argnums=donate),
+                donated=donate, static_key=T_b)
+            for T_b in self.prefill_buckets}
+
+    # -- jitted programs ---------------------------------------------------
+
+    def _decode_fn(self, params, paged, tokens, positions, table):
+        logits, paged = _tfm.decode_step_paged(
+            params, paged, tokens, positions, table, self.cfg)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), paged
+
+    def _prefill_fn(self, params, paged, prompt, true_len, table):
+        paged, logits = _tfm.prefill_paged(
+            params, paged, prompt, true_len, table, self.cfg)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), paged
+
+    # -- public API --------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens, eos_id=None):
+        """Queue one request; returns its request id. Validation is
+        eager: an unservable request fails here, not mid-decode."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, "
+                             f"got {max_new_tokens}")
+        total = prompt.size + int(max_new_tokens)
+        if total > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_len ({self.max_len})")
+        need = self.allocator.pages_needed(total)
+        if need > self.allocator.capacity:
+            raise ValueError(
+                f"request needs {need} pages but the pool only has "
+                f"{self.allocator.capacity}")
+        rid = next(self._ids)
+        self._queue.append(Request(rid, prompt, int(max_new_tokens),
+                                   eos_id, submitted_at=self._clock()))
+        telemetry.set_gauge(QUEUE_DEPTH, len(self._queue))
+        return rid
+
+    def step(self):
+        """One scheduler iteration: admit queued requests into free
+        slots (FIFO, backpressured by page availability), then advance
+        every live slot one token in a single decode program. Returns
+        the number of live slots after the iteration."""
+        with telemetry.span("serving.step", step=self.steps):
+            self._admit()
+            live = self._decode_once()
+        self.steps += 1
+        self._export_gauges()
+        return live
+
+    def run(self, max_steps=100_000):
+        """Drive step() until the queue and every slot drain; returns
+        {request_id: RequestResult} for everything finished so far.
+        `max_steps` bounds a scheduler bug (a request that can never
+        finish) — hitting it raises instead of spinning forever."""
+        for _ in range(max_steps):
+            if not self._queue and not any(self._slot_req):
+                return dict(self._results)
+            self.step()
+        raise RuntimeError(f"serving engine did not drain within "
+                           f"{max_steps} steps")
+
+    def results(self):
+        return dict(self._results)
+
+    @property
+    def queue_depth(self):
+        return len(self._queue)
+
+    @property
+    def slots_in_use(self):
+        return sum(r is not None for r in self._slot_req)
+
+    def warm(self):
+        """AOT-precompile the decode step and every prefill bucket into
+        the persistent compile cache (no execution, no buffer writes).
+        Returns {site: status} with compile_cache.warm statuses."""
+        S, W = self.slots, self.table_width
+        a = compile_cache.abstractify
+        i32 = jnp.int32
+        out = {}
+        if getattr(self._decode, "is_cached", False):
+            out["serving_decode_step"] = self._decode.warm(
+                a(self.params), a(self.paged),
+                jax.ShapeDtypeStruct((S,), i32),
+                jax.ShapeDtypeStruct((S,), i32),
+                jax.ShapeDtypeStruct((S, W), i32))
+        for T_b, fn in self._prefills.items():
+            if getattr(fn, "is_cached", False):
+                out[f"serving_prefill_b{T_b}"] = fn.warm(
+                    a(self.params), a(self.paged),
+                    jax.ShapeDtypeStruct((1, T_b), i32),
+                    jax.ShapeDtypeStruct((1,), i32),
+                    jax.ShapeDtypeStruct((1, W), i32))
+        return out
+
+    # -- scheduling internals ----------------------------------------------
+
+    def _free_slot(self):
+        for s, r in enumerate(self._slot_req):
+            if r is None:
+                return s
+        return None
+
+    def _bucket_for(self, n):
+        for b in self.prefill_buckets:
+            if b >= n:
+                return b
+        raise ValueError(f"prompt length {n} exceeds the largest "
+                         f"prefill bucket {self.prefill_buckets[-1]}")
+
+    def _admit(self):
+        """FIFO admission: stop at the first request that can't get a
+        slot or its pages (head-of-line order keeps scheduling
+        deterministic — no small request overtakes a starved big one)."""
+        while self._queue:
+            slot = self._free_slot()
+            if slot is None:
+                return
+            req = self._queue[0]
+            total = req.prompt.size + req.max_new_tokens
+            pages = self.allocator.alloc(self.allocator.pages_needed(total))
+            if pages is None:
+                return  # backpressure: wait for an eviction
+            self._queue.popleft()
+            req.admitted_at = self._clock()
+            telemetry.observe(QUEUE_WAIT_SECONDS,
+                              req.admitted_at - req.submitted_at,
+                              buckets=_LATENCY_BUCKETS)
+            telemetry.set_gauge(QUEUE_DEPTH, len(self._queue))
+            self._prefill_into(slot, req, pages)
+
+    def _prefill_into(self, slot, req, pages):
+        T_p = req.prompt.size
+        T_b = self._bucket_for(T_p)
+        row = np.asarray(
+            self.allocator.table_row(pages, self.table_width), np.int32)
+        prompt = np.zeros((1, T_b), np.int32)
+        prompt[0, :T_p] = req.prompt
+        with telemetry.span("serving.prefill", request=req.request_id,
+                            bucket=T_b):
+            tok, self.paged = self._prefills[T_b](
+                self.params, self.paged, jnp.asarray(prompt),
+                jnp.asarray([T_p], np.int32), jnp.asarray(row[None]))
+        first = int(np.asarray(tok)[0])
+        telemetry.inc(TOKENS_TOTAL, amount=float(T_p), kind="prefill")
+        telemetry.observe(TTFT_SECONDS, self._clock() - req.submitted_at,
+                          buckets=_LATENCY_BUCKETS)
+        self._slot_req[slot] = req
+        self._slot_pages[slot] = pages
+        self._slot_out[slot] = [first]
+        self._tables[slot] = row
+        self._positions[slot] = T_p
+        self._next_tok[slot] = first
+        if self._is_done(req, [first]):
+            self._finish(slot)
+
+    def _decode_once(self):
+        live_slots = [s for s, r in enumerate(self._slot_req)
+                      if r is not None]
+        if not live_slots:
+            return 0
+        tok, self.paged = self._decode(
+            self.params, self.paged, jnp.asarray(self._next_tok),
+            jnp.asarray(self._positions), jnp.asarray(self._tables))
+        tok = np.asarray(tok)
+        n_live = len(live_slots)
+        telemetry.inc(TOKENS_TOTAL, amount=float(n_live), kind="decode")
+        for s in live_slots:
+            req = self._slot_req[s]
+            self._slot_out[s].append(int(tok[s]))
+            self._positions[s] += 1
+            self._next_tok[s] = tok[s]
+            if self._is_done(req, self._slot_out[s]):
+                self._finish(s)
+        return self.slots_in_use
+
+    def _is_done(self, req, out):
+        if req.eos_id is not None and out and out[-1] == req.eos_id:
+            return True
+        return len(out) >= req.max_new_tokens
+
+    def _finish(self, slot):
+        """Evict: record the result and recycle the pages IMMEDIATELY —
+        the very next _admit() can hand them to a queued request."""
+        req = self._slot_req[slot]
+        out = self._slot_out[slot]
+        reason = ("eos" if req.eos_id is not None and out
+                  and out[-1] == req.eos_id else "length")
+        now = self._clock()
+        self._results[req.request_id] = RequestResult(
+            request_id=req.request_id, tokens=list(out),
+            finish_reason=reason, prompt_len=int(req.prompt.size),
+            queue_wait_s=req.admitted_at - req.submitted_at,
+            latency_s=now - req.submitted_at)
+        telemetry.inc(REQUESTS_TOTAL, outcome=reason)
+        telemetry.observe(REQUEST_SECONDS, now - req.submitted_at,
+                          buckets=_LATENCY_BUCKETS)
+        self.allocator.free(self._slot_pages[slot])
+        self._slot_req[slot] = None
+        self._slot_pages[slot] = []
+        self._slot_out[slot] = []
+        self._tables[slot] = 0
+        self._positions[slot] = 0
+        self._next_tok[slot] = 0
+
+    def _export_gauges(self):
+        telemetry.set_gauge(QUEUE_DEPTH, len(self._queue))
+        telemetry.set_gauge(SLOTS_IN_USE, self.slots_in_use)
+        telemetry.set_gauge(PAGES_IN_USE, self.allocator.num_in_use)
+        telemetry.set_gauge(
+            PAGE_UTILIZATION,
+            self.allocator.num_in_use / max(1, self.allocator.capacity))

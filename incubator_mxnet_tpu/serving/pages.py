@@ -1,0 +1,107 @@
+"""Host-side page allocator for the paged KV cache.
+
+The device pool (`models.transformer.init_paged_kv_cache`) is
+`(L, num_pages, page_size, H, Dh)`; this allocator owns the free list
+over `num_pages` and hands out page ids. Page 0 is the RESERVED NULL
+PAGE: it is never allocated, and dead decode slots / padded prefill rows
+scatter their writes there, so an all-zero page-table row is always a
+safe "empty" row. Allocation is all-or-nothing (a request either gets
+every page it needs or stays in the queue — no mid-decode exhaustion),
+and `free()` returns pages for immediate reuse without touching device
+memory: stale K/V in a recycled page is dead data beyond every live
+sequence's `n_valid` until overwritten.
+
+Pure host bookkeeping — no jax imports, safe to use from schedulers and
+tests without a device.
+"""
+from __future__ import annotations
+
+from collections import deque
+
+__all__ = ["PageAllocator", "NULL_PAGE"]
+
+NULL_PAGE = 0
+
+
+class PageAllocator:
+    """Free-list allocator over a pool of `num_pages` KV pages of
+    `page_size` tokens each (page 0 reserved)."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is the "
+                             f"reserved null page), got {num_pages}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        # FIFO recycling keeps page ids roughly round-robin, which makes
+        # reuse-after-free bugs show up deterministically in tests
+        self._free = deque(range(1, self.num_pages))
+        self._owned: set[int] = set()
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_in_use(self) -> int:
+        return len(self._owned)
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable pages (the null page doesn't count)."""
+        return self.num_pages - 1
+
+    def pages_needed(self, n_tokens: int) -> int:
+        """Pages required to hold `n_tokens` cache entries."""
+        if n_tokens <= 0:
+            return 0
+        return -(-int(n_tokens) // self.page_size)
+
+    def alloc(self, n_pages: int):
+        """Allocate `n_pages` pages; returns the page-id list, or None
+        when the pool can't cover it (all-or-nothing — the caller keeps
+        the request queued instead of half-admitting it)."""
+        n_pages = int(n_pages)
+        if n_pages < 0:
+            raise ValueError(f"cannot alloc {n_pages} pages")
+        if n_pages > len(self._free):
+            return None
+        pages = [self._free.popleft() for _ in range(n_pages)]
+        self._owned.update(pages)
+        return pages
+
+    def extend(self, pages, old_tokens: int, new_tokens: int):
+        """Grow an allocation that covers `old_tokens` so it covers
+        `new_tokens`: allocates only the delta pages and returns the new
+        combined list (the input list is not mutated), or None when the
+        pool can't cover the growth (nothing is allocated)."""
+        need = self.pages_needed(new_tokens) - self.pages_needed(old_tokens)
+        if need <= 0:
+            return list(pages)
+        extra = self.alloc(need)
+        if extra is None:
+            return None
+        return list(pages) + extra
+
+    def free(self, pages):
+        """Return pages to the pool for immediate reuse. Freeing a page
+        that isn't currently allocated (double free, or the null page)
+        raises — that's a scheduler bug corrupting another request's
+        cache, not a condition to paper over."""
+        pages = list(pages)
+        bad = [p for p in pages if p not in self._owned]
+        if bad:
+            raise ValueError(f"freeing pages not currently allocated: {bad}")
+        for p in pages:
+            self._owned.discard(p)
+            self._free.append(p)
+
+    def table_row(self, pages, width: int):
+        """Pad a page list to a fixed-width page-table row (null-page
+        padded) — the static shape decode_step_paged needs."""
+        if len(pages) > width:
+            raise ValueError(f"{len(pages)} pages exceed table width "
+                             f"{width}")
+        return list(pages) + [NULL_PAGE] * (width - len(pages))

@@ -146,6 +146,38 @@ METRIC_NAMES = {
     "mxtpu_compile_cache_saved_seconds": (
         "counter", "Compile wall-clock skipped by cache hits: stored "
                    "compile time minus deserialize cost, by fn."),
+    "mxtpu_decode_dense_fallbacks_total": (
+        "counter", "flash_decode calls that fell back to the dense "
+                   "(non-Pallas) cache attention because the cache "
+                   "length does not tile into decode blocks, by reason."),
+    "mxtpu_serving_queue_depth": (
+        "gauge", "Requests waiting in the serving engine's admission "
+                 "queue (not yet holding a decode slot)."),
+    "mxtpu_serving_slots_in_use": (
+        "gauge", "Decode slots currently running a request, out of "
+                 "MXTPU_DECODE_SLOTS."),
+    "mxtpu_serving_pages_in_use": (
+        "gauge", "KV-cache pages currently owned by live requests "
+                 "(excludes the reserved null page)."),
+    "mxtpu_serving_page_utilization": (
+        "gauge", "Fraction of allocatable KV-cache pages in use "
+                 "(pages_in_use / (num_pages - 1))."),
+    "mxtpu_serving_requests_total": (
+        "counter", "Requests finished by the serving engine, by outcome "
+                   "(eos / length)."),
+    "mxtpu_serving_tokens_total": (
+        "counter", "Tokens processed by the serving engine, by kind "
+                   "(prefill = prompt tokens cached, decode = tokens "
+                   "generated)."),
+    "mxtpu_serving_request_seconds": (
+        "histogram", "Per-request wall time from submit to finish "
+                     "(queue wait + prefill + all decode steps)."),
+    "mxtpu_serving_queue_wait_seconds": (
+        "histogram", "Per-request wall time from submit to slot "
+                     "admission (backpressure latency)."),
+    "mxtpu_serving_ttft_seconds": (
+        "histogram", "Per-request time to first token: submit until the "
+                     "prefill emits the first sampled token."),
 }
 
 # span() names (tracing regions). Dots namespace by subsystem.
@@ -159,6 +191,8 @@ SPAN_NAMES = frozenset({
     "ps.server.handle",
     "ps.server.merge",
     "ps.server.barrier",
+    "serving.step",
+    "serving.prefill",
 })
 
 

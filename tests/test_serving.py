@@ -1,0 +1,359 @@
+"""Serving-tier tests: paged decode kernel, page allocator, and the
+continuous-batching engine (CPU, Pallas interpret mode)."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from incubator_mxnet_tpu.models import transformer as tfm
+from incubator_mxnet_tpu.ops.pallas_kernels import (
+    DECODE_BLOCK, dense_decode_attention, flash_decode,
+    paged_decode_attention)
+from incubator_mxnet_tpu.serving import PageAllocator, ServingEngine
+
+
+def _small_cfg(**kw):
+    base = dict(vocab=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+                max_len=64)
+    base.update(kw)
+    return tfm.TransformerConfig(**base)
+
+
+def _gather_dense(k_pages, v_pages, page_table, page_size):
+    """Rebuild the per-sequence dense caches a page table describes."""
+    B, P_max = page_table.shape
+    T = P_max * page_size
+    H, D = k_pages.shape[2], k_pages.shape[3]
+    kc = np.zeros((B, T, H, D), np.float32)
+    vc = np.zeros((B, T, H, D), np.float32)
+    for b in range(B):
+        for j in range(P_max):
+            pg = page_table[b, j]
+            kc[b, j * page_size:(j + 1) * page_size] = k_pages[pg]
+            vc[b, j * page_size:(j + 1) * page_size] = v_pages[pg]
+    return kc, vc
+
+
+# -- kernel ------------------------------------------------------------------
+
+def test_paged_decode_matches_dense_ragged():
+    rng = np.random.RandomState(0)
+    B, H, D, ps, P, P_max = 4, 2, 32, 8, 16, 4
+    q = rng.randn(B, H, D).astype(np.float32)
+    k_pages = rng.randn(P, ps, H, D).astype(np.float32)
+    v_pages = rng.randn(P, ps, H, D).astype(np.float32)
+    # ragged per-sequence depths, incl. one page-aligned and one dead slot
+    n_valid = np.array([13, 1, 16, 0], np.int32)
+    page_table = np.array([[1, 2, 3, 0], [4, 0, 0, 0],
+                           [5, 6, 0, 0], [0, 0, 0, 0]], np.int32)
+    got = np.asarray(paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(k_pages), jnp.asarray(v_pages),
+        jnp.asarray(page_table), jnp.asarray(n_valid), interpret=True))
+    kc, vc = _gather_dense(k_pages, v_pages, page_table, ps)
+    want = np.asarray(dense_decode_attention(
+        jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+        jnp.asarray(n_valid)))
+    live = n_valid > 0
+    np.testing.assert_allclose(got[live], want[live], rtol=2e-5, atol=2e-5)
+    # the dead slot must still be finite (zero-length softmax guard)
+    assert np.all(np.isfinite(got))
+
+
+def test_paged_decode_pages_reused_after_free():
+    """A page freed by one sequence and reallocated to another must read
+    the NEW contents — the kernel has no per-page residue."""
+    rng = np.random.RandomState(1)
+    H, D, ps, P = 2, 16, 4, 8
+    alloc = PageAllocator(P, ps)
+    pages_a = alloc.alloc(2)
+    k_pages = rng.randn(P, ps, H, D).astype(np.float32)
+    v_pages = rng.randn(P, ps, H, D).astype(np.float32)
+    alloc.free(pages_a)
+    pages_b = alloc.alloc(2)  # FIFO recycling reuses a's pages eventually
+    # overwrite the reused pages with new K/V (what prefill would do)
+    for pg in pages_b:
+        k_pages[pg] = rng.randn(ps, H, D)
+        v_pages[pg] = rng.randn(ps, H, D)
+    table = np.array([alloc.table_row(pages_b, 4)], np.int32)
+    n_valid = np.array([2 * ps], np.int32)
+    q = rng.randn(1, H, D).astype(np.float32)
+    got = np.asarray(paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(k_pages), jnp.asarray(v_pages),
+        jnp.asarray(table), jnp.asarray(n_valid), interpret=True))
+    kc, vc = _gather_dense(k_pages, v_pages, table, ps)
+    want = np.asarray(dense_decode_attention(
+        jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+        jnp.asarray(n_valid)))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_dense_decode_accepts_per_sequence_vector():
+    rng = np.random.RandomState(2)
+    B, T, H, D = 3, 24, 2, 8
+    q = jnp.asarray(rng.randn(B, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    nv = np.array([3, 24, 11], np.int32)
+    got = np.asarray(dense_decode_attention(q, k, v, jnp.asarray(nv)))
+    for b in range(B):
+        ref = np.asarray(dense_decode_attention(
+            q[b:b + 1], k[b:b + 1], v[b:b + 1], int(nv[b])))
+        np.testing.assert_allclose(got[b:b + 1], ref, rtol=1e-6, atol=1e-6)
+
+
+def test_flash_decode_accepts_per_sequence_vector():
+    rng = np.random.RandomState(3)
+    B, T, H, D = 3, 32, 2, 8
+    q = jnp.asarray(rng.randn(B, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    nv = jnp.asarray(np.array([5, 32, 17], np.int32))
+    got = np.asarray(flash_decode(q, k, v, nv, block_k=8, interpret=True))
+    want = np.asarray(dense_decode_attention(q, k, v, nv))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_kv_cache_padded_to_decode_block():
+    """Satellite: init_kv_cache rounds T_max up so flash_decode always
+    tiles (no silent dense fallback on long caches)."""
+    cfg = _small_cfg(max_len=512)
+    cache = tfm.init_kv_cache(cfg, batch=1, max_len=200)
+    T = cache["k"].shape[2]
+    assert T == 256 and T % DECODE_BLOCK == 0
+    # at or under one block, the kernel tiles as-is: no padding
+    assert tfm.init_kv_cache(cfg, 1, 16)["k"].shape[2] == 16
+    assert tfm.init_kv_cache(cfg, 1, 128)["k"].shape[2] == 128
+
+
+def test_no_dense_fallback_on_standard_configs(monkeypatch):
+    """The fallback counter stays 0 for caches init_kv_cache produces."""
+    from incubator_mxnet_tpu import telemetry
+    from incubator_mxnet_tpu.ops.pallas_kernels import (
+        DENSE_FALLBACKS_TOTAL)
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    telemetry.refresh_from_env()
+    try:
+        telemetry.REGISTRY.reset()
+        cfg = _small_cfg(max_len=512, use_flash=True)
+        for max_len in (64, 130, 200):
+            cache = tfm.init_kv_cache(cfg, 2, max_len)
+            q = jnp.zeros((2, cfg.n_heads,
+                           cfg.d_model // cfg.n_heads), jnp.float32)
+            flash_decode(q, cache["k"][0], cache["v"][0], 1,
+                         interpret=True)
+        assert DENSE_FALLBACKS_TOTAL not in telemetry.prometheus_text()
+        # an untiled cache passed directly IS counted
+        k = jnp.zeros((1, 130, 2, 8), jnp.float32)
+        flash_decode(jnp.zeros((1, 2, 8)), k, k, 1, interpret=True)
+        assert DENSE_FALLBACKS_TOTAL in telemetry.prometheus_text()
+    finally:
+        monkeypatch.delenv("MXNET_TELEMETRY", raising=False)
+        telemetry.refresh_from_env()
+        telemetry.REGISTRY.reset()
+
+
+# -- page allocator ----------------------------------------------------------
+
+def test_allocator_alloc_free_reuse():
+    a = PageAllocator(num_pages=6, page_size=4)
+    assert a.capacity == 5 and a.num_free == 5
+    p1 = a.alloc(3)
+    assert len(p1) == 3 and 0 not in p1 and a.num_in_use == 3
+    a.free(p1)
+    assert a.num_free == 5 and a.num_in_use == 0
+    # freed pages come back (FIFO order, never the null page)
+    p2 = a.alloc(5)
+    assert sorted(p2) == [1, 2, 3, 4, 5]
+
+
+def test_allocator_exhaustion_is_all_or_nothing():
+    a = PageAllocator(num_pages=4, page_size=2)
+    assert a.alloc(2) is not None
+    assert a.alloc(2) is None  # only 1 free: nothing gets allocated
+    assert a.num_free == 1
+
+
+def test_allocator_double_free_raises():
+    a = PageAllocator(num_pages=4, page_size=2)
+    p = a.alloc(1)
+    a.free(p)
+    with pytest.raises(ValueError):
+        a.free(p)
+    with pytest.raises(ValueError):
+        a.free([0])  # the null page is never allocatable
+
+
+def test_allocator_extend():
+    a = PageAllocator(num_pages=8, page_size=4)
+    p = a.alloc(a.pages_needed(5))  # 2 pages cover 5 tokens
+    grown = a.extend(p, 5, 13)  # 13 tokens need 4 pages
+    assert len(grown) == 4 and grown[:2] == p
+    assert a.extend(grown, 13, 16) == grown  # same page count: no-op
+    assert a.extend(grown, 16, 1000) is None  # can't grow: unchanged
+    assert a.num_in_use == 4
+
+
+def test_allocator_pages_needed():
+    a = PageAllocator(num_pages=4, page_size=8)
+    assert a.pages_needed(0) == 0
+    assert a.pages_needed(1) == 1
+    assert a.pages_needed(8) == 1
+    assert a.pages_needed(9) == 2
+
+
+# -- engine ------------------------------------------------------------------
+
+def test_engine_token_identical_to_sequential_generate():
+    """The continuous-batching acceptance bar: mixed-length requests
+    sharing decode steps produce, per request, EXACTLY the tokens
+    sequential greedy generate() produces."""
+    cfg = _small_cfg()
+    params = tfm.init_params(cfg, seed=3)
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(1, 64, size=(L,)).astype(np.int32)
+               for L in (4, 11, 7, 3, 19, 5)]
+    maxnew = [6, 3, 8, 5, 4, 7]
+    eng = ServingEngine(params, cfg, slots=3, page_size=8, num_pages=24)
+    rids = [eng.submit(p, m) for p, m in zip(prompts, maxnew)]
+    res = eng.run()
+    assert len(res) == len(prompts)
+    # more requests than slots: depths must actually have interleaved
+    assert eng.steps < sum(maxnew)
+    for rid, p, m in zip(rids, prompts, maxnew):
+        ref = np.asarray(
+            tfm.generate(params, jnp.asarray(p)[None], m, cfg))[0]
+        got = np.array(res[rid].tokens)
+        np.testing.assert_array_equal(got, ref)
+        assert res[rid].finish_reason == "length"
+    # every page recycled after the fleet drains
+    assert eng.allocator.num_in_use == 0
+    assert eng.slots_in_use == 0
+
+
+def test_engine_eos_stops_early_and_recycles():
+    cfg = _small_cfg()
+    params = tfm.init_params(cfg, seed=3)
+    rng = np.random.RandomState(5)
+    p = rng.randint(1, 64, size=(6,)).astype(np.int32)
+    ref = np.asarray(tfm.generate(params, jnp.asarray(p)[None], 8, cfg))[0]
+    eos = int(ref[2])
+    stop = int(np.argmax(ref == eos))  # first occurrence ends the request
+    eng = ServingEngine(params, cfg, slots=2, page_size=8, num_pages=16)
+    rid = eng.submit(p, 8, eos_id=eos)
+    out = eng.run()[rid]
+    assert out.tokens == [int(t) for t in ref[:stop + 1]]
+    assert out.finish_reason == "eos"
+    assert eng.allocator.num_in_use == 0
+
+
+def test_engine_backpressure_queues_until_pages_free():
+    """Pool smaller than the workload: admission must wait, nothing is
+    half-admitted, no page leaks, results stay exact."""
+    cfg = _small_cfg()
+    params = tfm.init_params(cfg, seed=3)
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, 64, size=(L,)).astype(np.int32)
+               for L in (12, 9, 14, 6)]
+    # pool fits ~one request at a time
+    eng = ServingEngine(params, cfg, slots=4, page_size=8, num_pages=5)
+    rids = [eng.submit(p, 4) for p in prompts]
+    eng.step()
+    assert eng.slots_in_use >= 1 and eng.queue_depth >= 1  # backpressured
+    res = eng.run()
+    for rid, p in zip(rids, prompts):
+        ref = np.asarray(
+            tfm.generate(params, jnp.asarray(p)[None], 4, cfg))[0]
+        np.testing.assert_array_equal(np.array(res[rid].tokens), ref)
+    assert eng.allocator.num_in_use == 0
+
+
+def test_engine_rejects_unservable_requests():
+    cfg = _small_cfg()
+    params = tfm.init_params(cfg, seed=0)
+    eng = ServingEngine(params, cfg, slots=2, page_size=8, num_pages=16)
+    with pytest.raises(ValueError):
+        eng.submit([], 4)
+    with pytest.raises(ValueError):
+        eng.submit([1, 2], 0)
+    with pytest.raises(ValueError):
+        eng.submit(np.ones(60, np.int32), 10)  # exceeds max_len
+
+
+def test_engine_steady_state_zero_retraces(tmp_path, monkeypatch):
+    """After the first wave compiles every bucket, further mixed-length
+    traffic adds ZERO signatures and ZERO retraces (compilereg-gated —
+    the property that makes the serving loop TPU-viable)."""
+    from incubator_mxnet_tpu import telemetry
+    from incubator_mxnet_tpu.telemetry import compilereg
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    monkeypatch.setenv("MXTPU_COMPILE_CACHE_DIR", str(tmp_path / "cc"))
+    telemetry.refresh_from_env()
+    compilereg.reset()
+    try:
+        cfg = _small_cfg()
+        params = tfm.init_params(cfg, seed=3)
+        rng = np.random.RandomState(1)
+        eng = ServingEngine(params, cfg, slots=3, page_size=8)
+
+        def totals():
+            snap = compilereg.snapshot()
+            return (sum(v["signatures"] for v in snap.values()),
+                    sum(v["retraces"] for v in snap.values()))
+
+        for _ in range(4):  # warmup wave touches every bucket <= 19
+            eng.submit(rng.randint(1, 64, size=(19,)), 3)
+            eng.submit(rng.randint(1, 64, size=(3,)), 2)
+        eng.run()
+        sigs1, re1 = totals()
+        assert sigs1 > 0
+        for L, m in [(3, 2), (9, 6), (14, 3), (2, 5), (7, 7), (19, 2)]:
+            eng.submit(rng.randint(1, 64, size=(L,)), m)
+        eng.run()
+        sigs2, re2 = totals()
+        assert (sigs2 - sigs1, re2 - re1) == (0, 0)
+    finally:
+        compilereg.reset()
+        monkeypatch.delenv("MXNET_TELEMETRY", raising=False)
+        telemetry.refresh_from_env()
+        telemetry.REGISTRY.reset()
+
+
+def test_engine_warm_precompiles_all_sites(tmp_path, monkeypatch):
+    """warm() populates the compile cache; a second engine (fresh
+    process stand-in) warms with ALL HITS — zero compiles at startup."""
+    monkeypatch.setenv("MXTPU_COMPILE_CACHE_DIR", str(tmp_path / "cc"))
+    cfg = _small_cfg()
+    params = tfm.init_params(cfg, seed=0)
+    eng = ServingEngine(params, cfg, slots=2, page_size=8)
+    first = eng.warm()
+    assert first and all(s in ("miss", "hit") for s in first.values())
+    eng2 = ServingEngine(params, cfg, slots=2, page_size=8)
+    second = eng2.warm()
+    assert second.keys() == first.keys()
+    assert all(s == "hit" for s in second.values()), second
+
+
+def test_engine_telemetry_gauges(monkeypatch):
+    from incubator_mxnet_tpu import telemetry
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    telemetry.refresh_from_env()
+    try:
+        telemetry.REGISTRY.reset()
+        cfg = _small_cfg()
+        params = tfm.init_params(cfg, seed=0)
+        eng = ServingEngine(params, cfg, slots=2, page_size=8,
+                            num_pages=16)
+        eng.submit([1, 2, 3], 3)
+        eng.run()
+        text = telemetry.prometheus_text()
+        for name in ("mxtpu_serving_requests_total",
+                     "mxtpu_serving_tokens_total",
+                     "mxtpu_serving_request_seconds",
+                     "mxtpu_serving_slots_in_use",
+                     "mxtpu_serving_pages_in_use"):
+            assert name in text, name
+    finally:
+        monkeypatch.delenv("MXNET_TELEMETRY", raising=False)
+        telemetry.refresh_from_env()
+        telemetry.REGISTRY.reset()

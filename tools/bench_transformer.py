@@ -37,7 +37,23 @@ def main():
                     help="also measure KV-cache generation throughput")
     ap.add_argument("--dtype", default="float32",
                     help="parameter/activation dtype (bfloat16 = MXU rate)")
+    ap.add_argument("--serving", action="store_true",
+                    help="benchmark the continuous-batching serving "
+                         "engine on a seeded mixed-length request trace "
+                         "instead of the train step (JSON compatible "
+                         "with perf_gate --subset serving)")
+    ap.add_argument("--serving-requests", type=int, default=12,
+                    help="requests in the seeded serving trace")
+    ap.add_argument("--slots", type=int, default=3,
+                    help="decode slots for --serving")
+    ap.add_argument("--page-size", type=int, default=8,
+                    help="KV page size for --serving")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="trace seed for --serving")
     args = ap.parse_args()
+
+    if args.serving:
+        return serving_bench(args)
 
     import jax
     from jax.sharding import Mesh
@@ -139,6 +155,117 @@ def main():
             args.batch * prompt_len * reps / max(t_pre, 1e-9), 1)
 
     print(json.dumps(out))
+
+
+def _pct(values, q):
+    if not values:
+        return 0.0
+    vals = sorted(values)
+    idx = min(len(vals) - 1, int(round(q * (len(vals) - 1))))
+    return vals[idx]
+
+
+def serving_bench(args):
+    """Continuous-batching engine on a seeded mixed-length trace.
+
+    Two phases: a warmup wave that touches every prefill bucket the
+    trace uses (compiles happen here, or resolve from the compile
+    cache), then the measured trace with staggered arrivals. The
+    structural counters the perf gate zero-tolerates — steady-state
+    compiles/retraces and dense fallbacks — are deltas over the
+    measured phase only; wall-time ratios are report-only.
+    """
+    import tempfile
+
+    # registration of jit signatures with compilereg rides the compile
+    # cache wrapper, so the bench needs both on BEFORE the engine builds
+    os.environ.setdefault("MXTPU_COMPILE_CACHE_DIR",
+                          tempfile.mkdtemp(prefix="mxtpu-serving-bench-"))
+    from incubator_mxnet_tpu import telemetry
+    from incubator_mxnet_tpu.telemetry import compilereg
+    from incubator_mxnet_tpu.models import transformer as tfm
+    from incubator_mxnet_tpu.serving import ServingEngine
+    from incubator_mxnet_tpu.ops.pallas_kernels import (
+        DENSE_FALLBACKS_TOTAL)
+    import jax
+
+    telemetry.enable()
+    cfg = tfm.TransformerConfig(
+        vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
+        n_layers=args.n_layers, d_ff=args.d_ff, max_len=args.seq,
+        dtype=args.dtype)
+    params = tfm.init_params(cfg, seed=0)
+    eng = ServingEngine(params, cfg, slots=args.slots,
+                        page_size=args.page_size)
+
+    rng = np.random.RandomState(args.seed)
+    max_prompt = max(4, min(cfg.max_len // 2, 3 * cfg.max_len // 4))
+    trace = []
+    for i in range(args.serving_requests):
+        p_len = int(rng.randint(2, max_prompt))
+        m_new = int(rng.randint(1, min(16, cfg.max_len - p_len)))
+        trace.append({
+            "arrival_step": int(rng.randint(0, 2 * args.serving_requests)),
+            "prompt": rng.randint(1, cfg.vocab, p_len).astype(np.int32),
+            "max_new": m_new})
+    trace.sort(key=lambda r: r["arrival_step"])
+
+    # warmup: one request per distinct bucket the trace will hit (a
+    # prompt of exactly the bucket length lands in that bucket)
+    buckets = sorted({eng._bucket_for(r["prompt"].size) for r in trace})
+    for b in buckets:
+        eng.submit(rng.randint(1, cfg.vocab, b).astype(np.int32), 2)
+    eng.run()
+    warm_results = len(eng.results())
+
+    def reg_totals():
+        snap = compilereg.snapshot()
+        return (sum(v["signatures"] for v in snap.values()),
+                sum(v["retraces"] for v in snap.values()))
+
+    sigs0, re0 = reg_totals()
+    occupancy, utilization = [], []
+    t0 = time.perf_counter()
+    pending = list(trace)
+    while pending or eng.queue_depth or eng.slots_in_use:
+        while pending and pending[0]["arrival_step"] <= eng.steps:
+            r = pending.pop(0)
+            r["rid"] = eng.submit(r["prompt"], r["max_new"])
+        eng.step()
+        occupancy.append(eng.slots_in_use)
+        utilization.append(
+            eng.allocator.num_in_use / max(1, eng.allocator.capacity))
+    elapsed = time.perf_counter() - t0
+    sigs1, re1 = reg_totals()
+
+    results = {k: v for k, v in eng.results().items()}
+    done = [results[r["rid"]] for r in trace if "rid" in r]
+    gen_tokens = sum(len(r.tokens) for r in done)
+    latencies = [r.latency_s for r in done]
+    fallbacks = sum(
+        ch.value for _, ch in
+        telemetry.REGISTRY.counter(DENSE_FALLBACKS_TOTAL).series())
+
+    out = {
+        "metric": "serving",
+        "requests_completed": len(done),
+        "tokens_per_sec": round(gen_tokens / max(elapsed, 1e-9), 1),
+        "p50_latency_s": round(_pct(latencies, 0.50), 4),
+        "p99_latency_s": round(_pct(latencies, 0.99), 4),
+        "mean_slot_occupancy": round(float(np.mean(occupancy)), 3),
+        "mean_page_utilization": round(float(np.mean(utilization)), 3),
+        "steady_compiles": (sigs1 - sigs0),
+        "steady_retraces": (re1 - re0),
+        "dense_fallbacks": fallbacks,
+        "engine_steps": eng.steps,
+        "warmup_requests": warm_results,
+        "slots": args.slots,
+        "page_size": args.page_size,
+        "platform": jax.devices()[0].platform,
+        "seed": args.seed,
+    }
+    print(json.dumps(out))
+    return 0
 
 
 if __name__ == "__main__":

@@ -54,7 +54,7 @@ def _emit(obj):
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--model", required=True,
+    ap.add_argument("--model",
                     help="gluon model_zoo network name (e.g. resnet18_v1)")
     ap.add_argument("--shape", default="data=1,3,224,224",
                     metavar="NAME=B,C,H,W",
@@ -76,7 +76,24 @@ def main(argv=None):
     ap.add_argument("--wd", type=float, default=1e-4)
     ap.add_argument("--rescale-grad", type=float, default=None,
                     help="default: 1/batch (bench.py's convention)")
+    ap.add_argument("--decode", action="store_true",
+                    help="warm the serving engine instead: the decode "
+                         "step and every prefill bucket "
+                         "(serving.ServingEngine.warm)")
+    ap.add_argument("--d-model", type=int, default=512)
+    ap.add_argument("--n-layers", type=int, default=6)
+    ap.add_argument("--n-heads", type=int, default=8)
+    ap.add_argument("--d-ff", type=int, default=2048)
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--seq", type=int, default=512,
+                    help="serving max_len; prefill buckets derive from it")
+    ap.add_argument("--slots", type=int, default=None,
+                    help="decode slots (default: MXTPU_DECODE_SLOTS)")
+    ap.add_argument("--page-size", type=int, default=None,
+                    help="KV page size (default: MXTPU_PAGE_SIZE)")
     args = ap.parse_args(argv)
+    if not args.model and not args.decode:
+        ap.error("need --model and/or --decode")
 
     import numpy as np
     import incubator_mxnet_tpu as mx
@@ -92,11 +109,38 @@ def main(argv=None):
     buckets = ([int(b) for b in args.batch_buckets.split(",") if b]
                or [base_shape[0]])
     dtypes = [d.strip() for d in args.dtypes.split(",") if d.strip()]
-    L = gluon.loss.SoftmaxCrossEntropyLoss()
 
     total = {"combos": 0, "statuses": {}}
     t_start = time.perf_counter()
-    for batch in buckets:
+
+    if args.decode:
+        # serving sites: ONE decode-step program + one prefill program
+        # per bucket — exactly the executables ServingEngine looks up,
+        # so a warmed restart admits its first request without compiling
+        from incubator_mxnet_tpu.models import transformer as tfm
+        from incubator_mxnet_tpu.serving import ServingEngine
+        dtype = dtypes[0] if dtypes else "float32"
+        cfg = tfm.TransformerConfig(
+            vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
+            n_layers=args.n_layers, d_ff=args.d_ff, max_len=args.seq,
+            dtype=dtype)
+        params = tfm.init_params(cfg, seed=0)
+        eng = ServingEngine(params, cfg, slots=args.slots,
+                            page_size=args.page_size)
+        t0 = time.perf_counter()
+        statuses = eng.warm()
+        dt = time.perf_counter() - t0
+        for site in sorted(statuses):
+            _emit({"metric": "warmup", "site": site, "model": "serving",
+                   "batch": eng.slots, "dtype": dtype,
+                   "status": statuses[site],
+                   "seconds": round(dt / max(len(statuses), 1), 3)})
+            total["combos"] += 1
+            total["statuses"][statuses[site]] = \
+                total["statuses"].get(statuses[site], 0) + 1
+
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    for batch in (buckets if args.model else []):
         shape = (batch,) + base_shape[1:]
         for dtype in dtypes:
             # fresh net per combination: cast() mutates parameters, and
@@ -144,7 +188,8 @@ def main(argv=None):
     if store_dir.is_dir():
         entries = [p for p in store_dir.iterdir()
                    if p.name.endswith(".exe")]
-    _emit({"metric": "warmup_summary", "model": args.model,
+    _emit({"metric": "warmup_summary",
+           "model": args.model or "serving",
            "combos": total["combos"], **total["statuses"],
            "cache_entries": len(entries),
            "cache_bytes": sum(p.stat().st_size for p in entries),
