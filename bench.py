@@ -68,8 +68,19 @@ def child_main():
     # BENCH_REMAT_POLICY (set by --remat-policy) selects a named
     # jax.checkpoint_policies tier; unset falls back to MXTPU_REMAT_POLICY
     remat_policy = os.environ.get("BENCH_REMAT_POLICY") or None
+    # BENCH_SHARD_POLICY (set by --shard-policy): ZeRO-shard optimizer
+    # state (+ masters) over a 1-axis 'data' mesh spanning every visible
+    # device of the target platform; telemetry is switched on so the
+    # final line can report the per-role per-device HBM ledger bytes
+    shard_policy = os.environ.get("BENCH_SHARD_POLICY") or None
+    mesh = None
+    if shard_policy and shard_policy != "replicated":
+        mesh_devs = [d for d in devices if d.platform == target.platform]
+        mesh = jax.sharding.Mesh(np.array(mesh_devs), axis_names=("data",))
+        mx.telemetry.enable()
     step = fused.GluonTrainStep(net, lambda n, x, y: L(n(x), y), opt,
                                 device=target, init_on_device=ondev,
+                                mesh=mesh, shard_policy=shard_policy,
                                 remat=os.environ.get("BENCH_REMAT") == "1",
                                 remat_policy=remat_policy)
 
@@ -183,7 +194,7 @@ def child_main():
     if os.environ.get("BENCH_BYTES", "1") != "0":
         bytes_per_step = step.cost_stats(x, y).get("bytes_accessed", 0.0)
 
-    print(json.dumps({
+    out = {
         "ips": round(ips, 2),
         "scan_ips": round(scan_ips, 2),
         "scan_k": scan_k,
@@ -197,7 +208,16 @@ def child_main():
         "fused_epilogue": os.environ.get("MXTPU_FUSED_EPILOGUE", "0")
         not in ("", "0", "false", "off"),
         "final": True,  # distinguishes this from the mid-run partial line
-    }), flush=True)
+    }
+    if mesh is not None:
+        # per-device (addressable-shard) HBM ledger bytes by role — the
+        # ZeRO saving shows up as optimizer_state shrinking by ~mesh size
+        from incubator_mxnet_tpu.telemetry import ledger as _ledger
+        out["shard_policy"] = step.shard_policy
+        out["mesh_devices"] = len(mesh.devices.flat)
+        for role in ("params", "grads", "optimizer_state"):
+            out[f"ledger_{role}_bytes"] = int(_ledger.live_bytes(role))
+    print(json.dumps(out), flush=True)
 
 
 def _score(r):
@@ -768,6 +788,136 @@ def cold_start_main(assert_mode=False):
             f"warm time-to-first-step not better than cold: {out}")
 
 
+def sharding_main(assert_mode=False):
+    """ZeRO-sharding gate (CI `sharding` tier): on a forced 8-device CPU
+    mesh, train the same bf16 multi-precision model under replicated /
+    zero1 / zero2 and require the final weights to match BITWISE, measure
+    the per-device optimizer-state (+ f32 master) HBM ledger bytes under
+    each policy, and prove the knob-off contract — a meshless job with
+    MXTPU_SHARD_POLICY exported lowers to the byte-identical program of
+    one without it. Emits one JSON line for tools/perf_gate.py; --assert
+    turns every property into a hard failure."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("MXTPU_SHARD_POLICY", None)  # policies passed explicitly
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, fused, gluon, telemetry
+    from incubator_mxnet_tpu.telemetry import ledger
+
+    n_dev = len(jax.devices())
+    steps = int(os.environ.get("BENCH_SHARDING_STEPS", "6"))
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def fresh_net(prefix="shb_"):
+        mx.random.seed(0)
+        net = gluon.nn.HybridSequential(prefix=prefix)
+        with net.name_scope():
+            net.add(gluon.nn.Dense(64, activation="relu", in_units=64))
+            net.add(gluon.nn.Dense(64, activation="relu", in_units=64))
+            net.add(gluon.nn.Dense(8, in_units=64))
+        net.initialize(mx.init.Xavier())
+        return net
+
+    rng = np.random.RandomState(1)
+    xs = rng.rand(steps, 16, 64).astype(np.float32)
+    ys = rng.randint(0, 8, size=(steps, 16)).astype(np.float32)
+
+    telemetry.enable()
+
+    def run(policy):
+        ledger.reset()
+        net = fresh_net()
+        net.cast("bfloat16")
+        opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                               multi_precision=True, rescale_grad=1.0 / 16)
+        mesh = jax.sharding.Mesh(np.array(jax.devices()),
+                                 axis_names=("data",))
+        step = fused.GluonTrainStep(net, lambda n, a, b: L(n(a), b), opt,
+                                    mesh=mesh, shard_policy=policy)
+        losses = []
+        for i in range(steps):
+            mx.random.seed(100 + i)
+            losses.append(float(step(nd.array(xs[i]),
+                                     nd.array(ys[i])).asscalar()))
+        opt_bytes = int(ledger.live_bytes("optimizer_state"))
+        step.sync_params()
+        weights = [np.asarray(d) for d in step._params]
+        placements = step.shard_placements()
+        return losses, weights, opt_bytes, placements
+
+    results = {p: run(p) for p in ("replicated", "zero1", "zero2")}
+    l_rep, w_rep, b_rep, _ = results["replicated"]
+    weights_match = all(
+        results[p][0] == l_rep
+        and all(np.array_equal(a, b) for a, b in zip(results[p][1], w_rep))
+        for p in ("zero1", "zero2"))
+    b_z1 = results["zero1"][2]
+    reduction = b_rep / max(b_z1, 1)
+    placements = results["zero1"][3]
+    spec_leaves = [s for specs in placements.values() for s in specs]
+    n_sharded = sum(1 for s in spec_leaves if any(a for a in s))
+    n_repl = len(spec_leaves) - n_sharded
+
+    # knob-off contract: a meshless build with the env knob exported must
+    # lower to the byte-identical program of one without it (fixed
+    # prefixes keep parameter names, hence program text, deterministic)
+    def lowered_meshless(prefix):
+        net = fresh_net(prefix=prefix)
+        opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                               rescale_grad=1.0 / 16)
+        step = fused.GluonTrainStep(net, lambda n, a, b: L(n(a), b), opt)
+        x = nd.array(xs[0]); y = nd.array(ys[0])
+        step._build(x, y)
+        return jax.jit(step._step_fn).lower(
+            step._params, step._states, x._data, y._data,
+            jax.random.PRNGKey(0), jnp.asarray(0.1, jnp.float32),
+            jnp.asarray(1.0, jnp.float32)).as_text()
+
+    text_unset = lowered_meshless("ko_")
+    os.environ["MXTPU_SHARD_POLICY"] = "zero1"
+    try:
+        text_knob = lowered_meshless("ko_")
+    finally:
+        os.environ.pop("MXTPU_SHARD_POLICY", None)
+    knob_off_identical = text_unset == text_knob
+
+    out = {
+        "metric": "sharding",
+        "value": round(reduction, 2),
+        "unit": "x_opt_state_bytes_replicated_over_zero1",
+        "devices": n_dev,
+        "steps": steps,
+        "weights_match": weights_match,
+        "opt_state_bytes_replicated": b_rep,
+        "opt_state_bytes_zero1": b_z1,
+        "opt_state_bytes_zero2": results["zero2"][2],
+        "opt_bytes_reduction_x": round(reduction, 2),
+        "knob_off_identical": knob_off_identical,
+        "placements_sharded": n_sharded,
+        "placements_replicated": n_repl,
+    }
+    print(json.dumps(out), flush=True)
+    if assert_mode:
+        assert n_dev >= 8, f"expected a forced 8-device CPU mesh, got {n_dev}"
+        assert weights_match, (
+            "final weights diverged across shard policies — the ZeRO "
+            "programs are not bit-identical to the replicated one")
+        assert reduction >= 6.0, (
+            f"zero1 cut optimizer-state bytes/device only {reduction:.2f}x "
+            f"(replicated={b_rep}, zero1={b_z1}); need >= 6x on 8 devices")
+        assert knob_off_identical, (
+            "MXTPU_SHARD_POLICY exported on a meshless job changed the "
+            "lowered train-step program — the knob-off contract is broken")
+        assert n_sharded > 0, f"no tensor was sharded: {placements}"
+
+
 def main():
     # HBM-traffic lever axes (satellite flags; env inheritance carries
     # them into the measurement children)
@@ -777,6 +927,10 @@ def main():
             val = (a.split("=", 1)[1] if "=" in a
                    else (argv[i + 1] if i + 1 < len(argv) else ""))
             os.environ["BENCH_REMAT_POLICY"] = val
+        elif a.startswith("--shard-policy"):
+            val = (a.split("=", 1)[1] if "=" in a
+                   else (argv[i + 1] if i + 1 < len(argv) else ""))
+            os.environ["BENCH_SHARD_POLICY"] = val
         elif a == "--fused-epilogue":
             os.environ["MXTPU_FUSED_EPILOGUE"] = "1"
         elif a == "--stochastic-rounding":
@@ -786,6 +940,9 @@ def main():
         return
     if "--observatory" in sys.argv or os.environ.get("BENCH_OBSERVATORY"):
         observatory_main(assert_mode="--assert" in sys.argv)
+        return
+    if "--sharding" in sys.argv or os.environ.get("BENCH_SHARDING"):
+        sharding_main(assert_mode="--assert" in sys.argv)
         return
     if os.environ.get("BENCH_COLD_CHILD"):
         _cold_start_child()

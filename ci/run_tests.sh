@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI tiers (ref: ci/docker/runtime_functions.sh — unittest / nightly /
 # distributed stages). Usage:
-#   ci/run_tests.sh [unit|nightly|dist|examples|telemetry|aggregation|static-analysis|perf-structure|perf-gate|cold-start|serving|chaos|all]
+#   ci/run_tests.sh [unit|nightly|dist|examples|telemetry|aggregation|static-analysis|perf-structure|perf-gate|cold-start|serving|sharding|chaos|all]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -291,6 +291,148 @@ PY
     echo "cold-start tier: zero warm compiles, corrupt fallback bit-identical, warmup tool all-hit on re-run (model + serving)"
 }
 
+run_sharding() {
+    echo "=== sharding tier (ZeRO policies: bit-identity + the memory gate) ==="
+    # bench.py --sharding trains the same bf16 multi-precision model on a
+    # forced 8-device CPU mesh under replicated/zero1/zero2; --assert
+    # enforces bitwise-equal final weights across all three policies, the
+    # >=6x per-device optimizer-state ledger reduction, and the knob-off
+    # contract (meshless + exported MXTPU_SHARD_POLICY lowers to the
+    # byte-identical program). The gate then bands the emitted counters.
+    local sh_dir
+    sh_dir="$(mktemp -d -t mxtpu-sharding-XXXXXX)"
+    JAX_PLATFORMS=cpu python bench.py --sharding --assert \
+        > "$sh_dir/sharding.json"
+    python tools/perf_gate.py "$sh_dir/sharding.json" \
+        --baseline ci/perf_baseline.json --subset sharding
+    # negative self-test: a seeded weight divergence MUST fail
+    if python tools/perf_gate.py "$sh_dir/sharding.json" \
+        --baseline ci/perf_baseline.json --subset sharding \
+        --inject sharding.weights_match=0 \
+        > "$sh_dir/inject.log" 2>&1; then
+        echo "FAIL: perf_gate passed a seeded shard-policy weight divergence" >&2
+        cat "$sh_dir/inject.log" >&2
+        exit 1
+    fi
+    echo "=== sharding tier: chaos leg (membership change mid-job) ==="
+    # a zero1/N=8 job checkpoints after 2 epochs through the
+    # manifest-verified sharded writer; a HALVED fleet (4 devices,
+    # replicated) restores the manifests, re-saves, and the restored
+    # 8-device job re-shards back onto the zero1 layout and runs the
+    # final epoch — final weights must be BIT-IDENTICAL to the
+    # uninterrupted run
+    local ch_dir
+    ch_dir="$(mktemp -d -t mxtpu-sharding-chaos-XXXXXX)"
+    JAX_PLATFORMS=cpu python - "$ch_dir" <<'PY'
+import json
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.pop("MXTPU_SHARD_POLICY", None)
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import fused, gluon, nd
+from incubator_mxnet_tpu.contrib import sharded_checkpoint as sc
+
+workdir = sys.argv[1]
+STEPS, SPLIT = 12, 8  # 3 epochs of 4 steps; preempted after epoch 2
+L = gluon.loss.SoftmaxCrossEntropyLoss()
+rng = np.random.RandomState(1)
+xs = rng.rand(STEPS, 16, 64).astype(np.float32)
+ys = rng.randint(0, 8, size=(STEPS, 16)).astype(np.float32)
+
+
+def make_step():
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential(prefix="chs_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(64, activation="relu", in_units=64))
+        net.add(gluon.nn.Dense(64, activation="relu", in_units=64))
+        net.add(gluon.nn.Dense(8, in_units=64))
+    net.initialize(mx.init.Xavier())
+    net.cast("bfloat16")
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                           multi_precision=True, rescale_grad=1.0 / 16)
+    mesh = Mesh(np.array(jax.devices()[:8]), axis_names=("data",))
+    return fused.GluonTrainStep(net, lambda n, a, b: L(n(a), b), opt,
+                                mesh=mesh, shard_policy="zero1")
+
+
+def run(step, lo, hi):
+    for i in range(lo, hi):
+        mx.random.seed(100 + i)  # pin the per-step key stream
+        step(nd.array(xs[i]), nd.array(ys[i])).asscalar()
+
+
+# the uninterrupted reference trajectory
+ref = make_step()
+run(ref, 0, STEPS)
+ref.sync_params()
+ref_w = [np.asarray(d) for d in ref._params]
+
+# the preempted job: 2 epochs, then checkpoint params + sharded states
+job = make_step()
+run(job, 0, SPLIT)
+s_leaves, s_def = jax.tree_util.tree_flatten(job._states)
+tree = {f"p{i}": a for i, a in enumerate(job._params)}
+tree.update({f"s{i}": a for i, a in enumerate(s_leaves)})
+ck1 = os.path.join(workdir, "zero1-n8")
+sc.save(ck1, tree)
+assert sc.verify(ck1), "checkpoint 1 failed manifest verification"
+with open(os.path.join(workdir, "meta.json"), "w") as f:
+    json.dump({"n": job._n}, f)
+del job
+
+# membership change: half the fleet picks the manifests up — restore
+# onto a 4-device replicated mesh, then hand the state back via a
+# second manifest-verified save
+mesh4 = Mesh(np.array(jax.devices()[:4]), axis_names=("data",))
+on4 = sc.restore(ck1, shardings={k: NamedSharding(mesh4, P())
+                                 for k in tree})
+assert all(v.sharding.mesh == mesh4 for v in on4.values())
+ck2 = os.path.join(workdir, "rep-n4")
+sc.save(ck2, on4)
+assert sc.verify(ck2), "checkpoint 2 failed manifest verification"
+
+# fleet restored: re-shard back onto the 8-device zero1 layout and
+# finish the final epoch
+res = make_step()
+res._build(nd.array(xs[0]), nd.array(ys[0]))
+r_leaves, r_def = jax.tree_util.tree_flatten(res._states)
+want = {f"p{i}": a.sharding for i, a in enumerate(res._params)}
+want.update({f"s{i}": a.sharding for i, a in enumerate(r_leaves)})
+back = sc.restore(ck2, shardings=want)
+assert any(s.spec != P() for s in want.values()), \
+    "re-shard target has no sharded leaf"
+res._params = type(res._params)(
+    back[f"p{i}"] for i in range(len(res._params)))
+res._states = jax.tree_util.tree_unflatten(
+    r_def, [back[f"s{i}"] for i in range(len(r_leaves))])
+with open(os.path.join(workdir, "meta.json")) as f:
+    res._n = int(json.load(f)["n"])
+res.opt.num_update = res._n
+run(res, SPLIT, STEPS)
+res.sync_params()
+res_w = [np.asarray(d) for d in res._params]
+
+for name, a, b in zip(res.names, res_w, ref_w):
+    assert np.array_equal(a, b), (
+        f"chaos leg diverged from the uninterrupted run at {name}")
+print(f"sharding chaos leg ok: zero1/N=8 -> replicated/N=4 -> "
+      f"zero1/N=8 membership change; {len(ref_w)} tensors bit-identical "
+      f"after the final epoch")
+PY
+    echo "sharding tier: policies bit-identical, >=6x opt-state bytes cut, knob-off program identical, membership-change re-shard bit-exact"
+}
+
 run_serving() {
     echo "=== serving tier (paged decode engine + steady-state retrace gate) ==="
     # engine smoke: kernel equivalence, allocator, token-identity vs
@@ -352,8 +494,9 @@ case "$tier" in
     perf-gate) run_perf_gate ;;
     cold-start) run_cold_start ;;
     serving)   run_serving ;;
+    sharding)  run_sharding ;;
     nightly)   run_nightly ;;
-    all)       run_static_analysis; run_unit; run_telemetry; run_aggregation; run_perf_structure; run_perf_gate; run_cold_start; run_serving; run_chaos; run_dist; run_examples; run_nightly ;;
-    *) echo "unknown tier: $tier (unit|nightly|dist|examples|suite|telemetry|aggregation|static-analysis|perf-structure|perf-gate|cold-start|serving|chaos|all)"; exit 2 ;;
+    all)       run_static_analysis; run_unit; run_telemetry; run_aggregation; run_perf_structure; run_perf_gate; run_cold_start; run_serving; run_sharding; run_chaos; run_dist; run_examples; run_nightly ;;
+    *) echo "unknown tier: $tier (unit|nightly|dist|examples|suite|telemetry|aggregation|static-analysis|perf-structure|perf-gate|cold-start|serving|sharding|chaos|all)"; exit 2 ;;
 esac
 echo "tier '$tier' green"

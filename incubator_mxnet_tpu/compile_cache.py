@@ -124,8 +124,22 @@ def abstract_signature(args):
     parts = []
     for leaf in leaves:
         if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            # committed arrays fold their sharding into the signature:
+            # under MXTPU_SHARD_POLICY the same train step is compiled
+            # once with replicated params (first call) and once with the
+            # settled sharded layout — two distinct executables that must
+            # not collide on one key. Mirrors abstractify(): uncommitted
+            # arrays (and plain ShapeDtypeStructs without a sharding)
+            # contribute None, so AOT warm() and runtime still agree.
+            if isinstance(leaf, jax.ShapeDtypeStruct):
+                sharding = leaf.sharding
+            elif getattr(leaf, "_committed", False):
+                sharding = getattr(leaf, "sharding", None)
+            else:
+                sharding = None
             parts.append((tuple(leaf.shape), _dtype_name(leaf.dtype),
-                          bool(getattr(leaf, "weak_type", False))))
+                          bool(getattr(leaf, "weak_type", False)),
+                          str(sharding) if sharding is not None else None))
         else:
             parts.append(("py", type(leaf).__name__, repr(leaf)))
     return (tuple(parts), str(treedef))

@@ -124,6 +124,23 @@ register_knob("MXTPU_REMAT_POLICY", "", str,
               "policy enables remat even without GluonTrainStep("
               "remat=True); empty (default) preserves the legacy "
               "all-or-nothing jax.checkpoint behavior.")
+register_knob("MXTPU_SHARD_POLICY", "", str,
+              "ZeRO sharding policy for GluonTrainStep on an explicit "
+              "mesh: 'zero1' partitions optimizer state and f32 master "
+              "weights over the 'data' axis (largest divisible axis per "
+              "tensor, ragged tensors fall back to replication — the "
+              "per-tensor decision is recorded and queryable via "
+              "GluonTrainStep.shard_placements()), freeing ~(N-1)/N of "
+              "optimizer+master HBM per device; 'zero2' additionally "
+              "reduce-scatters gradients so the sharded update consumes "
+              "only the local grad shard before all-gathering updated "
+              "params — one program, no host sync, bit-identical to "
+              "replicated. 'replicated' or empty (default) keeps the "
+              "legacy placement and leaves compiled programs "
+              "structurally identical. On the eager Trainer path the "
+              "knob shards newly created optimizer-state buckets next "
+              "to mesh-committed parameters. Ignored (with the legacy "
+              "placement) when no mesh is attached.")
 
 # optimizer / trainer aggregation
 register_knob("MXTPU_STOCHASTIC_ROUNDING", False, bool,

@@ -68,22 +68,44 @@ def verify_wire_entry(entry, data):
             and hashlib.sha256(data).hexdigest() == entry.get("sha256"))
 
 
-def _require_single_process(op):
-    """The sha256 dir-manifest is single-process-only: on a multi-host
-    save each host writes just its own shards, so no host can hash the
-    full tree, and a partial manifest would surface much later as a
-    baffling hash mismatch at restore. Fail the operation NOW with the
-    limitation spelled out instead."""
-    if jax.process_count() > 1:
-        raise NotImplementedError(
-            f"sharded_checkpoint.{op} on a multi-host job "
-            f"(jax.process_count()={jax.process_count()}): the sha256 "
-            "dir-manifest is single-process-only — each host writes only "
-            "its own shards, so no host can hash the complete checkpoint "
-            "tree, and a partial manifest would later fail restore with "
-            "a misleading hash mismatch. Until a per-shard manifest "
-            "exists, save/verify multi-host checkpoints through orbax "
-            "directly, or gather to one host first.")
+def _gather_to_host(tree):
+    """Multi-host save support: turn every leaf into something ONE
+    process (rank 0) can serialize and hash — the sha256 dir-manifest
+    needs the complete byte stream on a single host.
+
+    Per leaf: a host-local value (numpy, python scalar, or a jax array
+    whose shards are all addressable here) is assembled from its
+    addressable shards; a fully-replicated array is materialized from
+    any one shard (every device holds a whole copy, so every host can).
+    An array that is genuinely sharded ACROSS hosts cannot be gathered
+    without a collective — that leaf fails loudly, named, so the caller
+    can reshard (device_put onto a replicated sharding) or save through
+    orbax directly instead of discovering a partial checkpoint later."""
+    import numpy as np
+
+    def one(path, leaf):
+        data = leaf._data if _is_nd(leaf) else leaf
+        if not hasattr(data, "is_fully_addressable"):
+            return leaf  # numpy / python scalar: already host-local
+        sharding = getattr(data, "sharding", None)
+        if sharding is not None and getattr(sharding, "is_fully_replicated",
+                                            False):
+            return np.asarray(data.addressable_data(0))
+        if data.is_fully_addressable:
+            out = np.empty(data.shape, dtype=data.dtype)
+            for s in data.addressable_shards:
+                out[s.index] = np.asarray(s.data)
+            return out
+        raise ValueError(
+            f"sharded_checkpoint.save: tensor {jax.tree_util.keystr(path)} "
+            f"(shape {tuple(data.shape)}, sharding {sharding}) is sharded "
+            "across hosts — no single process holds all of it, and the "
+            "sha256 dir-manifest requires the full byte stream on the "
+            "writing host. Reshard it to a replicated or single-host "
+            "sharding before save(), or checkpoint through orbax "
+            "directly.")
+
+    return jax.tree_util.tree_map_with_path(one, tree, is_leaf=_is_nd)
 
 
 def _write_dir_manifest(path):
@@ -101,9 +123,10 @@ def _write_dir_manifest(path):
 def verify(path):
     """True iff the checkpoint directory matches its sidecar manifest.
     A checkpoint without a manifest (pre-resilience save) verifies as
-    legacy-valid. Single-process only — a multi-host job fails loudly
-    (see _require_single_process)."""
-    _require_single_process("verify")
+    legacy-valid. On a multi-host job only rank 0 (the manifest writer)
+    verifies; other ranks trust it and return True."""
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        return True
     path = os.path.abspath(path)
     if not os.path.isdir(path):
         return False
@@ -155,18 +178,24 @@ def _rewrap_like(restored, like):
 
 
 def save(path, tree, force=False):
-    """Write a (sharded) pytree checkpoint; every host writes its shards.
+    """Write a (sharded) pytree checkpoint.
+
     Refuses to overwrite an existing checkpoint unless force=True (orbax's
     safe default — a failed re-save must not destroy the previous good
-    checkpoint silently). Single-process only while the sha256 manifest
-    is — a multi-host job fails loudly up front rather than leaving a
-    checkpoint that flunks verification later."""
-    _require_single_process("save")
+    checkpoint silently). On a multi-host job every leaf is first gathered
+    to host memory (see _gather_to_host; a tensor sharded across hosts
+    fails loudly, named) and only rank 0 writes + hashes the manifest —
+    the sha256 dir-manifest needs the complete byte stream on one host."""
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
+    jtree = _to_jax_tree(tree)
+    if jax.process_count() > 1:
+        jtree = _gather_to_host(jtree)
+        if jax.process_index() != 0:
+            return path
     with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
-        ckptr.save(path, _to_jax_tree(tree), force=force)
+        ckptr.save(path, jtree, force=force)
     _write_dir_manifest(path)
     return path
 

@@ -103,7 +103,7 @@ class GluonTrainStep:
     def __init__(self, net, loss_fn, optimizer, mesh=None, batch_axis=0, device=None,
                  init_on_device=False, compute_dtype=None,
                  shard_optimizer_states=False, remat=False,
-                 remat_policy=None):
+                 remat_policy=None, shard_policy=None):
         self.net = net
         self.loss_fn = loss_fn
         self.opt = optimizer
@@ -150,11 +150,31 @@ class GluonTrainStep:
         resolve_remat_policy(self.remat_policy)  # validate eagerly
         if self.remat_policy:
             self.remat = True
-        # ZeRO-1 analog: keep optimizer states sharded over the dp mesh
-        # axis (see _build's mesh branch)
-        self.shard_optimizer_states = shard_optimizer_states
-        if shard_optimizer_states and mesh is None:
-            raise ValueError("shard_optimizer_states requires a mesh")
+        # ZeRO sharding policy over the mesh's 'data' axis (ROADMAP item
+        # 5): 'replicated' keeps the legacy placement; 'zero1' shards
+        # optimizer state + f32 masters 1/N (largest divisible axis per
+        # tensor, recorded per param — see parallel.zero); 'zero2' also
+        # reduce-scatters gradients so the update reads only the local
+        # shard. shard_optimizer_states=True (the pre-policy spelling)
+        # remains an alias for zero1.
+        from .parallel import zero as _zero
+
+        explicit = shard_policy is not None
+        if shard_policy is None:
+            shard_policy = config.get("MXTPU_SHARD_POLICY")
+        if not shard_policy and shard_optimizer_states:
+            shard_policy = "zero1"
+        shard_policy = _zero.resolve_policy(shard_policy)
+        if shard_policy != "replicated" and mesh is None:
+            if explicit or shard_optimizer_states:
+                raise ValueError(
+                    f"shard_policy={shard_policy!r} requires a mesh")
+            # env knob set globally but this step has no mesh: nothing
+            # to shard over — keep the (identical) replicated program
+            shard_policy = "replicated"
+        self.shard_policy = shard_policy
+        self.shard_optimizer_states = shard_policy != "replicated"
+        self.state_specs = None  # per-tensor placement record (mesh builds)
         self._built = False
         self._n = 0
         from .optimizer import Optimizer as _OptBase
@@ -229,23 +249,22 @@ class GluonTrainStep:
 
             rep = NamedSharding(mesh, P())
             self._params = [jax.device_put(d, rep) for d in self._params]
-            if self.shard_optimizer_states:
-                # ZeRO-1 the GSPMD way: optimizer states live sharded over
-                # the dp axis (leaves whose axis 0 divides the axis size;
-                # the scalar/ragged remainder stays replicated). From these
-                # placements XLA derives reduce-scatter(grads) -> sharded
-                # update -> all-gather(params) instead of a full gradient
-                # all-reduce + replicated update — same math, 1/N state HBM.
-                n = mesh.shape["data"]
-                shard = NamedSharding(mesh, P("data"))
+            if self.shard_policy != "replicated":
+                # ZeRO-1 the GSPMD way: optimizer states (including f32
+                # masters, which live inside the multi-precision state
+                # tuples) sharded over the dp axis along each tensor's
+                # largest divisible axis; the scalar/ragged remainder
+                # stays replicated. From these placements XLA derives
+                # reduce-scatter(grads) -> sharded update ->
+                # all-gather(params) instead of a full gradient
+                # all-reduce + replicated update — same math, 1/N state
+                # HBM. zero2 makes the grad reduce-scatter explicit in
+                # _make_step. The per-tensor decision lands in
+                # self.state_specs (see shard_placements()).
+                from .parallel import zero as _zero
 
-                def place_state(d):
-                    if getattr(d, "ndim", 0) >= 1 and d.shape[0] % n == 0:
-                        return jax.device_put(d, shard)
-                    return jax.device_put(d, rep)
-
-                self._states = jax.tree_util.tree_map(place_state,
-                                                      self._states)
+                self._states, self.state_specs = _zero.place_tree(
+                    self._states, mesh)
             else:
                 self._states = jax.tree_util.tree_map(
                     lambda d: jax.device_put(d, rep), self._states
@@ -264,6 +283,11 @@ class GluonTrainStep:
                 if hasattr(cur, "sharding") else new,
                 self._states, pending)
             self._pending_states = None
+        # HBM ledger: the fused path owns its state buffers (the eager
+        # Trainer tracks its own), so account them here — with sharded
+        # placements the ledger reports per-device (addressable-shard)
+        # bytes, which is where ZeRO's (N-1)/N saving shows up
+        _telemetry.ledger.track(list(self._states), "optimizer_state")
         self._step_fn = self._make_step()
         if mesh is not None:
             # pin output placements to the input ones: without this XLA may
@@ -361,6 +385,28 @@ class GluonTrainStep:
             treedef, [resolved[i] for i in range(len(leaves))])
         return params, states
 
+    def shard_placements(self):
+        """Per-parameter record of the optimizer-state placements the
+        shard policy chose: {param_name: [PartitionSpec, ...]} with one
+        spec per state leaf (empty list for grad_req='null' params).
+        P('data')-style specs mark sharded leaves; P() marks the
+        divisibility fallback to replication. None before the first
+        build or for meshless/replicated steps."""
+        if self.state_specs is None:
+            return None
+        out = {}
+        for name, spec in zip(self.names, self.state_specs):
+            out[name] = jax.tree_util.tree_leaves(spec)
+        return out
+
+    def _retrack_states(self, old_states):
+        """Each step donates the state buffers and returns fresh arrays;
+        move the HBM ledger's optimizer_state accounting from the dead
+        buffers to the live ones (donation frees device memory NOW,
+        before the Python objects die)."""
+        _telemetry.ledger.untrack(list(old_states))
+        _telemetry.ledger.track(list(self._states), "optimizer_state")
+
     @staticmethod
     def _state_data(state):
         if state is None:
@@ -374,6 +420,34 @@ class GluonTrainStep:
         grad_names = [n for n, m in zip(names, self.grad_mask) if m]
 
         cdt = self.compute_dtype
+        mesh = self.mesh
+        grad_specs = None
+        pin_rep = None
+        if mesh is not None and self.shard_policy != "replicated":
+            # The bit-identity fence (see parallel.zero.pin_replicated):
+            # params entering the forward and gradients leaving the
+            # backward are pinned replicated so the sharded state inputs
+            # cannot repartition the fwd/bwd math. Sharding then lives
+            # only in the elementwise update; the new weights *settle
+            # into the state layout* after the first step (GSPMD
+            # propagates it through the update), which is exact, saves
+            # param bytes too, and costs one extra compile at step 2.
+            from .parallel import zero as _zero
+
+            def pin_rep(tree):
+                return _zero.pin_replicated(tree, mesh)
+
+            if self.shard_policy == "zero2":
+                # zero2: additionally constrain each pinned gradient to
+                # the same largest-divisible-axis layout its optimizer
+                # state uses, so the update consumes only the local
+                # shard and the full gradient dies right after the
+                # slice (a layout constraint — values unchanged)
+                n_dev = mesh.shape["data"]
+                grad_specs = [
+                    _zero.largest_axis_spec(tuple(d.shape), n_dev)
+                    for d, m in zip(self._params, self.grad_mask) if m]
+                _shard_grads = _zero.shard_grads
 
         def forward(grad_params, other_params, x, y, key):
             if cdt is not None:
@@ -439,9 +513,16 @@ class GluonTrainStep:
             other_params = {
                 n: d for n, d, m in zip(names, params, self.grad_mask) if not m
             }
+            if pin_rep is not None:
+                grad_params = pin_rep(grad_params)
+                other_params = pin_rep(other_params)
             (loss, aux_new), grads = jax.value_and_grad(forward, has_aux=True)(
                 grad_params, other_params, x, y, key
             )
+            if pin_rep is not None:
+                grads = pin_rep(grads)
+            if grad_specs is not None:
+                grads = _shard_grads(grads, mesh, grad_specs)
             new_params, new_states = [], []
             gi = 0
             for i, (n, d, m) in enumerate(zip(names, params, self.grad_mask)):
@@ -473,6 +554,9 @@ class GluonTrainStep:
             other_params = {
                 n: d for n, d, m in zip(names, params, self.grad_mask) if not m
             }
+            if pin_rep is not None:
+                grad_params = pin_rep(grad_params)
+                other_params = pin_rep(other_params)
 
             def body(carry, inp):
                 others, gsum, lsum = carry
@@ -480,6 +564,13 @@ class GluonTrainStep:
                 (loss, aux_new), grads = jax.value_and_grad(
                     forward_scan, has_aux=True)(grad_params, others, x, y,
                                                 key)
+                if pin_rep is not None:
+                    grads = pin_rep(grads)
+                if grad_specs is not None:
+                    # shard inside the scan: the micro-batch accumulator
+                    # itself lives 1/N per device (sum of slices ==
+                    # slice of sum, so accumulation order is untouched)
+                    grads = _shard_grads(grads, mesh, grad_specs)
                 others = {**others, **aux_new}
                 gsum = [a + g for a, g in zip(gsum, grads)]
                 return (others, gsum, lsum + loss.astype(lsum.dtype)), None
@@ -542,12 +633,15 @@ class GluonTrainStep:
                    (tuple(yd.shape), str(yd.dtype)))
             first = not _compilereg.seen("GluonTrainStep.step", sig)
             t0 = _time.perf_counter()
+        old_states = self._states if telem else None
         with _stepstats.phase("dispatch"):
             loss, self._params, self._states = self._step(
                 self._params, self._states, xd, yd, key,
                 jnp.asarray(lr, jnp.float32),
                 jnp.asarray(float(self._n), jnp.float32),
             )
+        if telem:
+            self._retrack_states(old_states)
         if sig is not None:
             # a first-seen batch signature means this dispatch traced and
             # compiled; any later new signature is a retrace (the event
@@ -593,9 +687,13 @@ class GluonTrainStep:
                        if self.opt.lr_scheduler else self.opt.lr)
             ts.append(float(self._n))
         self.opt.num_update = self._n
+        telem = _telemetry.enabled()
+        old_states = self._states if telem else None
         losses, self._params, self._states = self._scan(
             self._params, self._states, xd, yd, keys,
             jnp.asarray(lrs, jnp.float32), jnp.asarray(ts, jnp.float32))
+        if telem:
+            self._retrack_states(old_states)
         return NDArray._from_data(losses)
 
     def accum_steps(self, xs, ys):
@@ -624,10 +722,14 @@ class GluonTrainStep:
         self.opt.num_update = self._n
         lr = (self.opt.lr_scheduler(self._n) if self.opt.lr_scheduler
               else self.opt.lr)
+        telem = _telemetry.enabled()
+        old_states = self._states if telem else None
         loss, self._params, self._states = self._accum(
             self._params, self._states, xd, yd, keys,
             jnp.asarray(lr, jnp.float32),
             jnp.asarray(float(self._n), jnp.float32))
+        if telem:
+            self._retrack_states(old_states)
         return NDArray._from_data(loss)
 
     def save_states(self, fname):

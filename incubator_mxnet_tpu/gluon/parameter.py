@@ -194,6 +194,32 @@ class Parameter:
     def list_ctx(self):
         return [self._data.context] if self._data is not None else []
 
+    @property
+    def sharding(self):
+        """The jax sharding of this parameter's live buffer (None until
+        the data is committed to a device/mesh). Parameters carry their
+        placement so the trainer and checkpoint layers can put optimizer
+        state and restored values next to the weight (ZeRO policies,
+        MXTPU_SHARD_POLICY) without reaching into ._data."""
+        d = self._data._data if self._data is not None else None
+        return getattr(d, "sharding", None)
+
+    def place(self, sharding):
+        """Commit the parameter's data (and dense grad buffer) onto
+        `sharding` — a jax.sharding.Sharding or a device. The mesh
+        entry point: place(NamedSharding(mesh, P())) replicates a
+        weight over the dp axis; subsequent eager ops and fused steps
+        then inherit the placement."""
+        import jax as _jax
+
+        if self._data is None:
+            raise RuntimeError(
+                f"cannot place uninitialized parameter {self.name}")
+        self._data._data = _jax.device_put(self._data._data, sharding)
+        if self._grad is not None:
+            self._grad._data = _jax.device_put(self._grad._data, sharding)
+        return self
+
     def set_data(self, data):
         arr = data if isinstance(data, NDArray) else NDArray(data)
         if self._data is None:

@@ -93,6 +93,18 @@ class Trainer:
         self._agg_rest = []
         self._agg_fn_cache = {}
         self._flat_fn_cache = {}
+        # ZeRO on the eager path: under MXTPU_SHARD_POLICY=zero1/zero2,
+        # optimizer state created for a mesh-committed parameter is
+        # placed sharded over the 'data' axis (parallel.zero largest-
+        # divisible-axis rule) and the bucketed multi-tensor updates
+        # operate on the shards; GSPMD partitions the elementwise bucket
+        # program accordingly. SR buckets keep their per-NAME fold_in
+        # keys (optimizer._sr_key), so sharded and replicated runs stay
+        # bit-identical.
+        from ..parallel import zero as _zero
+
+        self._shard_policy = _zero.resolve_policy(
+            _config.get("MXTPU_SHARD_POLICY"))
 
     @property
     def learning_rate(self):
@@ -558,6 +570,8 @@ class Trainer:
             if i not in u.states:
                 u.states[i] = o.create_state_multi_precision(i, w)
                 u.states_synced[i] = True
+                if self._shard_policy != "replicated":
+                    self._place_state_sharded(w, u.states[i])
                 _telemetry.ledger.track(u.states[i], "optimizer_state")
         states = [u.states[i] for i in bucket]
         # advance every count BEFORE reading ts/base_lr: on the eager path
@@ -580,11 +594,13 @@ class Trainer:
                 # hyperparameter churn (wd/momentum edits every step) would
                 # otherwise pin one jitted program per historical value
                 self._agg_fn_cache.clear()
+            out_sh = self._bucket_out_shardings(weights, states)
             if use_sgd:
                 fn = self._build_sgd_bucket_fn(
-                    names, mp=isinstance(states[0], tuple))
+                    names, mp=isinstance(states[0], tuple),
+                    out_shardings=out_sh)
             else:
-                fn = self._build_bucket_fn(names)
+                fn = self._build_bucket_fn(names, out_shardings=out_sh)
             donate = (0, 1) if jax.default_backend() != "cpu" else ()
             fn = _compile_cache.wrap(
                 f"trainer.bucket_update[{bid}]", fn, donated=donate,
@@ -617,6 +633,56 @@ class Trainer:
                            buckets=_telemetry.BYTES_BUCKETS,
                            kind="optimizer_update")
 
+    def _place_state_sharded(self, w, state):
+        """ZeRO placement for a freshly created optimizer state: when the
+        parameter is committed to a mesh with a 'data' axis
+        (Parameter.place / fused sync), put each state leaf — momentum
+        AND the f32 master copy — on its largest divisible axis over
+        that mesh (parallel.zero rule); ragged leaves stay replicated.
+        Meshless parameters are left alone, so the knob is a no-op on a
+        single device."""
+        from jax.sharding import NamedSharding
+        from ..parallel import zero as _zero
+
+        wsh = getattr(w._data, "sharding", None)
+        mesh = getattr(wsh, "mesh", None)
+        if mesh is None or "data" not in getattr(mesh, "axis_names", ()):
+            return
+        n = mesh.shape["data"]
+
+        def place(s):
+            if isinstance(s, NDArray):
+                spec = _zero.largest_axis_spec(tuple(s._data.shape), n)
+                s._data = jax.device_put(s._data, NamedSharding(mesh, spec))
+
+        if isinstance(state, tuple):
+            for s in state:
+                place(s)
+        else:
+            place(state)
+
+    def _bucket_out_shardings(self, weights, states):
+        """Pin bucket-update outputs to the input placements under a
+        ZeRO policy: without this XLA may emit replicated state outputs,
+        silently undoing the 1/N placement after the first dispatch.
+        Returns None (jit's default) for the replicated policy or when
+        no leaf in the bucket is mesh-committed — the knob-off program
+        is byte-identical."""
+        def sh(x):
+            d = getattr(x, "_data", x)
+            return getattr(d, "sharding", None)
+
+        if self._shard_policy == "replicated":
+            return None
+        w_sh = [sh(w) for w in weights]
+        s_sh = jax.tree_util.tree_map(sh, states)
+        mesh_committed = any(
+            getattr(s, "mesh", None) is not None
+            for s in w_sh + jax.tree_util.tree_leaves(s_sh))
+        if not mesh_committed:
+            return None
+        return (w_sh, s_sh)
+
     @staticmethod
     def _is_mp_state(w, s):
         """Multi-precision state shape: (mom_or_None, fp32 master) behind a
@@ -626,7 +692,7 @@ class Trainer:
                 and hasattr(s[1], "dtype") and str(s[1].dtype) == "float32"
                 and str(w.dtype) != "float32")
 
-    def _build_bucket_fn(self, names):
+    def _build_bucket_fn(self, names, out_shardings=None):
         """One jitted program applying each param's own fused_update — the
         exact math GluonTrainStep traces, so aggregated == eager for every
         optimizer whose fused hook matches (fused_matches_eager)."""
@@ -659,9 +725,10 @@ class Trainer:
                 o.rescale_grad = old_rescale
             return new_w, new_s
 
-        return jax.jit(run, donate_argnums=donate)
+        return jax.jit(run, donate_argnums=donate,
+                       out_shardings=out_shardings)
 
-    def _build_sgd_bucket_fn(self, names, mp):
+    def _build_sgd_bucket_fn(self, names, mp, out_shardings=None):
         """SGD rides the registered multi-tensor ops (ref: optimizer_op.cc
         multi_sgd_update / multi_sgd_mom_update / multi_mp_sgd_*)."""
         o = self._optimizer
@@ -718,7 +785,8 @@ class Trainer:
                 rescale_grad=rs, clip_gradient=clip)
             return list(outs), [None] * n
 
-        return jax.jit(run, donate_argnums=donate)
+        return jax.jit(run, donate_argnums=donate,
+                       out_shardings=out_shardings)
 
     def _mult_pair(self, name):
         o = self._optimizer

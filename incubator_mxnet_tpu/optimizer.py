@@ -834,12 +834,18 @@ SGD.fused_update = _sgd_fused
 def _sgd_create_fused_state(self, index, weight):
     """Fused-path state: f32 momentum when stochastic rounding is active
     on a bf16 weight (the scanned carry keeps the accumulator in full
-    precision; _cast_state_like then preserves f32 across steps).
-    Otherwise identical to create_state."""
+    precision; _cast_state_like then preserves f32 across steps). With
+    multi_precision on a low-precision weight, the (mom, f32 master)
+    tuple — fused_update already routes tuples through the mp ops, and
+    under MXTPU_SHARD_POLICY the master rides the state tree into the
+    ZeRO placement (1/N of the f32 bytes per device). Otherwise
+    identical to create_state."""
     if self._sr_active(weight):
         if self.momentum != 0.0:
             return zeros(weight.shape, dtype="float32")
         return None
+    if self.multi_precision and str(weight.dtype) in ("float16", "bfloat16"):
+        return self.create_state_multi_precision(index, weight)
     return self.create_state(index, weight)
 
 

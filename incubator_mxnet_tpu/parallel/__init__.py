@@ -10,6 +10,7 @@ from .mesh import (  # noqa: F401
     make_mesh, make_nd_mesh, data_sharding, replicated, local_mesh,
 )
 from . import collectives  # noqa: F401
+from . import zero  # noqa: F401
 from . import ring_attention  # noqa: F401
 from .ring_attention import ring_attention as ring_attention_fn  # noqa: F401
 from .ring_attention import ring_self_attention_sharded, ulysses_attention  # noqa: F401

@@ -12,7 +12,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["allreduce", "allgather", "broadcast", "reduce_scatter", "psum_in_shardmap"]
+__all__ = ["allreduce", "allgather", "broadcast", "reduce_scatter",
+           "reduce_scatter_constraint", "psum_in_shardmap"]
 
 
 def allreduce(values, mesh=None, axis_name="data"):
@@ -47,6 +48,18 @@ def reduce_scatter(x, mesh, axis_name="data"):
         mesh=mesh, in_specs=P(None), out_specs=P(axis_name), check_vma=False,
     )
     return fn(x)
+
+
+def reduce_scatter_constraint(x, mesh, spec):
+    """Traced-context counterpart of reduce_scatter(): inside one jitted
+    GSPMD program the gradient reduction is inserted by the partitioner
+    (not callable as the eager shard_map above), so the way to
+    reduce-scatter is to constrain the logically-reduced value to a
+    sharded layout — XLA then lowers all-reduce + slice into a
+    reduce-scatter and downstream consumers (the ZeRO optimizer update)
+    read only the local shard. Used by the zero2 policy
+    (parallel.zero.shard_grads)."""
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
 def broadcast(x, mesh):

@@ -58,7 +58,7 @@ _LEAKS_HELP = ("Leak-heuristic firings: the tracked live set grew for "
 _MAX_SAMPLES = 4096
 
 _lock = threading.Lock()
-_entries = {}        # token (weakref | int) -> (role, nbytes, obj_id)
+_entries = {}        # token (weakref | int) -> (role, nbytes, obj_id, ref)
 _by_id = {}          # id(obj) -> token
 _by_role = {}        # role -> live bytes
 _total = 0
@@ -82,11 +82,28 @@ def _on():
 
 
 def _nbytes(obj):
+    """Per-device footprint of `obj`: for an array committed to a mesh
+    this is the addressable (local-shard) bytes on the most loaded
+    device, NOT the global logical nbytes — a ZeRO-sharded optimizer
+    state costs 1/N of its logical size per device and the HBM ledger
+    must show that saving (a replicated array still reports full size:
+    every device holds a whole copy)."""
     data = getattr(obj, "_data", obj)
     try:
+        shards = getattr(data, "addressable_shards", None)
+        if shards:
+            per_device = {}
+            for s in shards:
+                per_device[s.device] = (per_device.get(s.device, 0)
+                                        + int(s.data.nbytes))
+            return max(per_device.values())
         return int(getattr(data, "nbytes", 0))
-    except TypeError:
-        return 0
+    except (TypeError, RuntimeError):
+        # tracers, deleted/donated buffers, non-jax arrays mid-teardown
+        try:
+            return int(getattr(data, "nbytes", 0))
+        except TypeError:
+            return 0
 
 
 def _add_locked(role, nbytes):
@@ -129,14 +146,24 @@ def track(obj, role):
     if nbytes <= 0:
         return 0
     obj_id = id(obj)
+    ref = None
     try:
-        token = weakref.ref(obj, _dead)
+        ref = weakref.ref(obj, _dead)
+        hash(ref)  # a weakref hashes via its referent...
+        token = ref
     except TypeError:
+        # ...and raw jax Arrays (fused optimizer states) are weakref-able
+        # but UNhashable — key those entries by id and keep a ref with an
+        # id-based death callback alive inside the entry instead
         token = obj_id
+        try:
+            ref = weakref.ref(obj, lambda _r, _i=obj_id: _dead_id(_i))
+        except TypeError:
+            ref = None
     with _lock:
         if obj_id in _by_id:
             return 0  # already tracked; first role wins
-        _entries[token] = (role, nbytes, obj_id)
+        _entries[token] = (role, nbytes, obj_id, ref)
         _by_id[obj_id] = token
         new_peak = _add_locked(role, nbytes)
     _publish(role, new_peak)
@@ -148,7 +175,7 @@ def _release_token(token):
         entry = _entries.pop(token, None)
         if entry is None:
             return None
-        role, nbytes, obj_id = entry
+        role, nbytes, obj_id = entry[:3]
         _by_id.pop(obj_id, None)
         _add_locked(role, -nbytes)
     return role, nbytes
@@ -156,6 +183,17 @@ def _release_token(token):
 
 def _dead(ref):
     released = _release_token(ref)
+    if released is not None and _on():
+        _publish(released[0], False)
+
+
+def _dead_id(obj_id):
+    """Death callback for id-keyed entries (unhashable referents)."""
+    with _lock:
+        token = _by_id.get(obj_id)
+    if token is None:
+        return
+    released = _release_token(token)
     if released is not None and _on():
         _publish(released[0], False)
 

@@ -75,6 +75,11 @@ def main():
     ap.add_argument("--timeout", type=int, default=2400)
     ap.add_argument("--remat-policy", default=None,
                     help="overlay MXTPU_REMAT_POLICY on every config")
+    ap.add_argument("--shard-policy", default=None,
+                    choices=("replicated", "zero1", "zero2"),
+                    help="overlay BENCH_SHARD_POLICY on every config "
+                         "(ZeRO-sharded optimizer state over all visible "
+                         "devices; the child logs per-role ledger bytes)")
     ap.add_argument("--fused-epilogue", action="store_true",
                     help="overlay MXTPU_FUSED_EPILOGUE=1 on every config")
     ap.add_argument("--results-dir", default=RESULTS_DIR,
@@ -86,6 +91,7 @@ def main():
         time.strftime("bench_sweep_%Y%m%d_%H%M%S.log"))
     log(f"sweep start: configs={args.configs} "
         f"remat_policy={args.remat_policy} "
+        f"shard_policy={args.shard_policy} "
         f"fused_epilogue={args.fused_epilogue} -> {_log_path}")
     for name in args.configs.split(","):
         cfg = CONFIGS[name.strip()]
@@ -93,6 +99,8 @@ def main():
         env.update(cfg)
         if args.remat_policy is not None:
             env["BENCH_REMAT_POLICY"] = args.remat_policy
+        if args.shard_policy is not None:
+            env["BENCH_SHARD_POLICY"] = args.shard_policy
         if args.fused_epilogue:
             env["MXTPU_FUSED_EPILOGUE"] = "1"
         env["BENCH_CHILD"] = "1"

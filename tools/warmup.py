@@ -76,6 +76,14 @@ def main(argv=None):
     ap.add_argument("--wd", type=float, default=1e-4)
     ap.add_argument("--rescale-grad", type=float, default=None,
                     help="default: 1/batch (bench.py's convention)")
+    ap.add_argument("--shard-policy", default="replicated",
+                    choices=("replicated", "zero1", "zero2"),
+                    help="warm the ZeRO-sharded train step: builds a "
+                         "1-axis 'data' mesh over all visible devices "
+                         "and precompiles the program with sharded "
+                         "optimizer state (must match the training "
+                         "job's MXTPU_SHARD_POLICY for the lookup to "
+                         "hit)")
     ap.add_argument("--decode", action="store_true",
                     help="warm the serving engine instead: the decode "
                          "step and every prefill bucket "
@@ -139,6 +147,12 @@ def main(argv=None):
             total["statuses"][statuses[site]] = \
                 total["statuses"].get(statuses[site], 0) + 1
 
+    mesh = None
+    if args.shard_policy != "replicated":
+        import jax
+        from jax.sharding import Mesh
+        mesh = Mesh(np.array(jax.devices()), axis_names=("data",))
+
     L = gluon.loss.SoftmaxCrossEntropyLoss()
     for batch in (buckets if args.model else []):
         shape = (batch,) + base_shape[1:]
@@ -159,12 +173,13 @@ def main(argv=None):
                                        momentum=args.momentum, wd=args.wd,
                                        rescale_grad=rescale)
                 step = fused.GluonTrainStep(
-                    net, lambda n, a, b: L(n(a), b), opt)
+                    net, lambda n, a, b: L(n(a), b), opt,
+                    mesh=mesh, shard_policy=args.shard_policy)
                 t0 = time.perf_counter()
                 status = step.warmup(x, y)
                 _emit({"metric": "warmup", "site": "train_step",
                        "model": args.model, "batch": batch, "dtype": dtype,
-                       "status": status,
+                       "shard_policy": args.shard_policy, "status": status,
                        "seconds": round(time.perf_counter() - t0, 3)})
                 total["combos"] += 1
                 total["statuses"][status] = \
