@@ -605,6 +605,196 @@ def observatory_main(assert_mode=False):
             f"steady-shape second epoch retraced {r2 - r1} time(s)")
 
 
+def recommender_main(assert_mode=False):
+    """Terascale sparse-embedding bench: a DLRM-style model whose
+    per-field tables live row-sharded on an in-process PS shard fleet,
+    trained on a seeded zipfian id trace in two configurations —
+
+      naive: per-key blocking pulls (one RPC per table per shard), no nnz
+             bucketing, no prefetch overlap;
+      opt:   deduped bucket-padded pulls batched into ONE multi-table RPC
+             per shard server, pull/forward overlap on the service's
+             ordered background worker.
+
+    Reports pull RPCs per step for both, steady-state (second-epoch)
+    retraces for the opt path, worker-resident embedding bytes vs the
+    full table, and whether the two configurations' final weights (every
+    shard's rows + the dense towers) are BIT-identical — the levers must
+    change wall time and wire shape, never math. --assert turns the
+    acceptance contract into hard failures:
+      opt pull RPCs/step <= num shard servers, steady retraces == 0,
+      weights_match == 1, worker embedding bytes < full table bytes.
+    """
+    import hashlib
+
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, gluon, autograd, telemetry
+    from incubator_mxnet_tpu import embedding as emb
+    from incubator_mxnet_tpu import optimizer as opt_mod
+    from incubator_mxnet_tpu.models import DLRM
+    from incubator_mxnet_tpu.telemetry import stepstats, ledger, compilereg
+
+    fields = int(os.environ.get("BENCH_REC_FIELDS", "3"))
+    vocab = int(os.environ.get("BENCH_REC_VOCAB", "200"))
+    shards = int(os.environ.get("BENCH_REC_SHARDS", "2"))
+    batch = int(os.environ.get("BENCH_REC_BATCH", "32"))
+    n_batches = int(os.environ.get("BENCH_REC_BATCHES", "6"))
+    epochs = 2
+    field_vocabs = [vocab + 17 * i for i in range(fields)]
+    telemetry.enable()
+
+    # one seeded zipfian trace shared by both configurations: hot ids
+    # repeat heavily inside a batch, which is exactly what the dedup
+    # lever monetizes
+    rng = np.random.RandomState(11)
+    trace = []
+    for _ in range(epochs * n_batches):
+        xd = rng.rand(batch, 4).astype("float32")
+        ids = np.stack([(rng.zipf(1.3, size=batch) - 1) % v
+                        for v in field_vocabs], axis=1)
+        y = rng.randint(0, 2, (batch, 1)).astype("float32")
+        trace.append((xd, ids, y))
+    raw_per_step = batch * fields
+    uniq_per_step = float(np.mean(
+        [sum(len(np.unique(ids[:, f])) for f in range(fields))
+         for _, ids, _ in trace]))
+
+    def counter_total(name):
+        fam = telemetry.REGISTRY.get(name)
+        return sum(ch.value for _, ch in fam.series()) if fam else 0.0
+
+    def counter_val(name, **labels):
+        fam = telemetry.REGISTRY.get(name)
+        return fam.value(**labels) if fam else 0.0
+
+    def run(mode):
+        os.environ["MXTPU_SPARSE_NNZ_BUCKETING"] = \
+            "1" if mode == "opt" else "0"
+        os.environ["MXTPU_SPARSE_PREFETCH"] = "1" if mode == "opt" else "0"
+        stepstats.reset()
+        ledger.reset()
+        compilereg.reset()
+        c0 = {
+            "batched": counter_val(emb.PULL_RPCS_TOTAL, path="batched"),
+            "per_key": counter_val(emb.PULL_RPCS_TOTAL, path="per_key"),
+            "retraces": counter_total("mxtpu_retraces_total"),
+            "ready": counter_val(emb.PREFETCH_HITS_TOTAL, outcome="ready"),
+        }
+        servers, svc = emb.launch_local_fleet(shards)
+        try:
+            mx.random.seed(42)
+            model = DLRM(field_vocabs, num_dense=4, embed_dim=8,
+                         service=svc, per_key=(mode == "naive"), seed=5)
+            model.initialize(mx.init.Xavier())
+            svc.set_optimizer(opt_mod.SGD(learning_rate=0.05))
+            tr = gluon.Trainer(model.collect_params(), "sgd",
+                               {"learning_rate": 0.05})
+            tr.attach_sparse_service(svc)
+            loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+
+            emb_peak = 0
+            retr_e1 = None
+            t0 = time.perf_counter()
+            model.prefetch(trace[0][1])
+            for i, (xd, ids, y) in enumerate(trace):
+                with autograd.record():
+                    out = model(nd.array(xd), ids)
+                    loss = loss_fn(out, nd.array(y)).mean()
+                loss.backward()
+                tr.step(1)  # pushes embedding grads behind dense work
+                # prefetch N+1 AFTER step N's push enqueued: the ordered
+                # worker preserves push(N) < pull(N+1)
+                if i + 1 < len(trace):
+                    model.prefetch(trace[i + 1][1])
+                loss.asnumpy()
+                emb_peak = max(emb_peak, ledger.live_bytes("embedding"))
+                if i + 1 == n_batches:
+                    svc.flush()
+                    retr_e1 = counter_total("mxtpu_retraces_total")
+            svc.flush()
+            dt = time.perf_counter() - t0
+            retr_total = counter_total("mxtpu_retraces_total")
+
+            # final weights: every shard's rows + the dense towers
+            h = hashlib.sha256()
+            for i in range(fields):
+                h.update(svc.full_table(f"dlrm_f{i}").tobytes())
+            for _, p in sorted(model.collect_params().items()):
+                h.update(np.asarray(p.data().asnumpy()).tobytes())
+            steps = len(trace)
+            return {
+                "pull_rpcs_batched": counter_val(
+                    emb.PULL_RPCS_TOTAL, path="batched") - c0["batched"],
+                "pull_rpcs_per_key": counter_val(
+                    emb.PULL_RPCS_TOTAL, path="per_key") - c0["per_key"],
+                "steady_retraces": retr_total - (retr_e1
+                                                 if retr_e1 is not None
+                                                 else 0.0),
+                "prefetch_ready": counter_val(
+                    emb.PREFETCH_HITS_TOTAL, outcome="ready") - c0["ready"],
+                "sparse_pull_p50": (stepstats.snapshot()["phases"]
+                                    .get("sparse_pull", {}).get("p50", 0.0)),
+                "steps_per_s": steps / dt,
+                "worker_embedding_bytes": int(emb_peak),
+                "weights_sha": h.hexdigest(),
+                "steps": steps,
+            }
+        finally:
+            svc.close()
+            for s in servers:
+                try:
+                    s.shutdown()
+                except Exception:
+                    pass
+
+    naive = run("naive")
+    opt_r = run("opt")
+
+    full_table_bytes = int(sum(v * 8 * 4 for v in field_vocabs))
+    rpc_per_step = (opt_r["pull_rpcs_batched"]
+                    + opt_r["pull_rpcs_per_key"]) / opt_r["steps"]
+    rpc_per_step_naive = (naive["pull_rpcs_batched"]
+                          + naive["pull_rpcs_per_key"]) / naive["steps"]
+    out = {
+        "metric": "recommender",
+        "value": round(opt_r["steps_per_s"], 3),
+        "unit": "steps_per_s",
+        "rpc_per_step": rpc_per_step,
+        "rpc_per_step_naive": rpc_per_step_naive,
+        "steady_retraces": int(opt_r["steady_retraces"]),
+        "weights_match": int(naive["weights_sha"] == opt_r["weights_sha"]),
+        "dedup_factor": round(raw_per_step / uniq_per_step, 3),
+        "prefetch_ready": int(opt_r["prefetch_ready"]),
+        "sparse_pull_p50_opt": round(opt_r["sparse_pull_p50"], 6),
+        "sparse_pull_p50_naive": round(naive["sparse_pull_p50"], 6),
+        "worker_embedding_bytes": opt_r["worker_embedding_bytes"],
+        "full_table_bytes": full_table_bytes,
+        "throughput_naive": round(naive["steps_per_s"], 3),
+        "num_servers": shards,
+        "num_tables": fields,
+    }
+    print(json.dumps(out), flush=True)
+    if assert_mode:
+        assert rpc_per_step <= shards + 1e-9, (
+            f"opt path issued {rpc_per_step} pull RPCs/step; the whole "
+            f"model must cost <= {shards} (one per shard server)")
+        assert rpc_per_step_naive > shards, (
+            f"naive per-key baseline issued only {rpc_per_step_naive} "
+            "RPCs/step — no contrast to measure")
+        assert out["steady_retraces"] == 0, (
+            f"bucketed steady state retraced {out['steady_retraces']} "
+            "time(s) in epoch 2")
+        assert out["weights_match"] == 1, (
+            "deduped+bucketed+overlapped weights diverged from the naive "
+            f"blocking path: {naive['weights_sha'][:12]} vs "
+            f"{opt_r['weights_sha'][:12]}")
+        assert 0 < out["worker_embedding_bytes"] < full_table_bytes, (
+            f"worker held {out['worker_embedding_bytes']}B of embedding "
+            f"rows vs full table {full_table_bytes}B — not O(batch)")
+        assert out["prefetch_ready"] >= 0
+
+
 def _cold_start_child():
     """One fresh-process training run against the persistent compile cache
     (BENCH_COLD_CHILD=1; MXTPU_COMPILE_CACHE_DIR set by the parent).
@@ -943,6 +1133,9 @@ def main():
         return
     if "--sharding" in sys.argv or os.environ.get("BENCH_SHARDING"):
         sharding_main(assert_mode="--assert" in sys.argv)
+        return
+    if "--recommender" in sys.argv or os.environ.get("BENCH_RECOMMENDER"):
+        recommender_main(assert_mode="--assert" in sys.argv)
         return
     if os.environ.get("BENCH_COLD_CHILD"):
         _cold_start_child()

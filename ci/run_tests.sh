@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI tiers (ref: ci/docker/runtime_functions.sh — unittest / nightly /
 # distributed stages). Usage:
-#   ci/run_tests.sh [unit|nightly|dist|examples|telemetry|aggregation|static-analysis|perf-structure|perf-gate|cold-start|serving|sharding|chaos|all]
+#   ci/run_tests.sh [unit|nightly|dist|examples|telemetry|aggregation|static-analysis|perf-structure|perf-gate|cold-start|serving|sharding|recommender|chaos|all]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -433,6 +433,138 @@ PY
     echo "sharding tier: policies bit-identical, >=6x opt-state bytes cut, knob-off program identical, membership-change re-shard bit-exact"
 }
 
+run_recommender() {
+    echo "=== recommender tier (sparse embedding: RPC budget + retrace + bit-identity gates) ==="
+    # unit coverage for the tier first: the sharded service, the remote
+    # SparseEmbedding block, DLRM, row-sparse kvstore plumbing, bucketing
+    JAX_PLATFORMS=cpu python -m pytest tests/test_embedding.py -q
+    # bench.py --recommender trains DLRM twice over a 2-server in-process
+    # shard fleet on one seeded zipfian trace: the naive per-key wire
+    # (blocking RPC per table per server, no bucketing, no overlap) vs the
+    # optimized path (dedup + nnz buckets + one multi-table RPC per server
+    # + background prefetch). --assert enforces <= num_servers pull RPCs
+    # per step, zero steady-state retraces, bit-identical final weights
+    # across the two paths, and O(batch) worker-side embedding bytes; the
+    # gate then bands the emitted counters (throughput is report-only).
+    local rc_dir
+    rc_dir="$(mktemp -d -t mxtpu-recommender-XXXXXX)"
+    JAX_PLATFORMS=cpu python bench.py --recommender --assert \
+        > "$rc_dir/recommender.json"
+    python tools/perf_gate.py "$rc_dir/recommender.json" \
+        --baseline ci/perf_baseline.json --subset recommender
+    # negative self-test: a seeded cross-path weight divergence MUST fail
+    if python tools/perf_gate.py "$rc_dir/recommender.json" \
+        --baseline ci/perf_baseline.json --subset recommender \
+        --inject recommender.weights_match=0 \
+        > "$rc_dir/inject.log" 2>&1; then
+        echo "FAIL: perf_gate passed a seeded sparse-path weight divergence" >&2
+        cat "$rc_dir/inject.log" >&2
+        exit 1
+    fi
+    echo "=== recommender tier: chaos leg (shard server lost mid-epoch) ==="
+    # DLRM trains over 2 shard servers; after epoch 1 the fleet snapshots
+    # through the manifest-verified bootstrap pull, shard 0's server is
+    # KILLED, a replacement bootstraps from the snapshot (PR-6
+    # state-transfer contract), and epoch 2 finishes on the healed fleet —
+    # final tables AND dense params must be bit-identical to an
+    # uninterrupted reference run
+    local rch_dir
+    rch_dir="$(mktemp -d -t mxtpu-recommender-chaos-XXXXXX)"
+    JAX_PLATFORMS=cpu python - "$rch_dir" <<'PY'
+import hashlib
+import os
+import sys
+
+os.environ["MXTPU_SPARSE_NNZ_BUCKETING"] = "1"
+os.environ["MXTPU_SPARSE_PREFETCH"] = "1"
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.embedding import launch_local_fleet
+from incubator_mxnet_tpu.models import DLRM
+from incubator_mxnet_tpu.ps import ParameterServer, PSClient
+
+workdir = sys.argv[1]
+FIELDS, VOCABS = 3, [120, 137, 154]
+STEPS, SPLIT, BATCH = 8, 4, 16  # 2 epochs of 4 steps; shard dies after ep. 1
+rng = np.random.RandomState(11)
+dense_x = rng.randn(STEPS, BATCH, 4).astype(np.float32)
+ids = np.stack([rng.zipf(1.3, size=(STEPS, BATCH)) % v
+                for v in VOCABS], -1).astype(np.int64)
+labels = rng.randint(0, 2, size=(STEPS, BATCH, 1)).astype(np.float32)
+loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+
+
+def make(svc):
+    mx.random.seed(42)
+    net = DLRM(VOCABS, num_dense=4, embed_dim=8, bottom_units=(16,),
+               top_units=(16,), service=svc, seed=5)
+    net.initialize(mx.init.Xavier())
+    svc.set_optimizer(mx.optimizer.SGD(learning_rate=0.05))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05})
+    tr.attach_sparse_service(svc)
+    return net, tr
+
+
+def run(net, tr, svc, lo, hi):
+    net.prefetch(ids[lo])
+    for i in range(lo, hi):
+        with autograd.record():
+            loss = loss_fn(net(nd.array(dense_x[i]), ids[i]),
+                           nd.array(labels[i])).mean()
+        loss.backward()
+        tr.step(1)
+        if i + 1 < hi:
+            net.prefetch(ids[i + 1])
+        loss.asnumpy()
+    svc.flush()
+
+
+def digest(net, svc):
+    h = hashlib.sha256()
+    for i in range(FIELDS):
+        h.update(np.ascontiguousarray(svc.full_table(f"dlrm_f{i}")))
+    for name in sorted(net.collect_params()):
+        h.update(np.ascontiguousarray(
+            net.collect_params()[name].data().asnumpy()))
+    return h.hexdigest()
+
+
+# uninterrupted reference trajectory
+servers, svc = launch_local_fleet(2)
+net, tr = make(svc)
+run(net, tr, svc, 0, STEPS)
+ref = digest(net, svc)
+svc.close()
+[s.shutdown() for s in servers]
+
+# the chaos run: epoch 1, snapshot, LOSE shard 0, heal, epoch 2
+servers, svc = launch_local_fleet(2)
+net, tr = make(svc)
+run(net, tr, svc, 0, SPLIT)
+svc.snapshot(workdir)
+servers[0].shutdown()  # the fleet loses a shard server mid-job
+repl = ParameterServer(num_workers=1, host="127.0.0.1", port=0)
+servers.append(repl)
+svc.restore_shard(0, workdir, PSClient("127.0.0.1", repl.port))
+run(net, tr, svc, SPLIT, STEPS)
+got = digest(net, svc)
+svc.close()
+[s.shutdown() for s in servers[1:]]
+
+assert got == ref, (
+    "healed fleet diverged from the uninterrupted run: "
+    f"{got[:12]} != {ref[:12]}")
+print("recommender chaos leg ok: shard server killed after epoch 1, "
+      "replacement bootstrapped from the manifest-verified snapshot, "
+      "final tables + dense params bit-identical")
+PY
+    echo "recommender tier: RPC budget held, zero steady retraces, paths bit-identical, shard loss healed bit-exact"
+}
+
 run_serving() {
     echo "=== serving tier (paged decode engine + steady-state retrace gate) ==="
     # engine smoke: kernel equivalence, allocator, token-identity vs
@@ -495,8 +627,9 @@ case "$tier" in
     cold-start) run_cold_start ;;
     serving)   run_serving ;;
     sharding)  run_sharding ;;
+    recommender) run_recommender ;;
     nightly)   run_nightly ;;
-    all)       run_static_analysis; run_unit; run_telemetry; run_aggregation; run_perf_structure; run_perf_gate; run_cold_start; run_serving; run_sharding; run_chaos; run_dist; run_examples; run_nightly ;;
-    *) echo "unknown tier: $tier (unit|nightly|dist|examples|suite|telemetry|aggregation|static-analysis|perf-structure|perf-gate|cold-start|serving|sharding|chaos|all)"; exit 2 ;;
+    all)       run_static_analysis; run_unit; run_telemetry; run_aggregation; run_perf_structure; run_perf_gate; run_cold_start; run_serving; run_sharding; run_recommender; run_chaos; run_dist; run_examples; run_nightly ;;
+    *) echo "unknown tier: $tier (unit|nightly|dist|examples|suite|telemetry|aggregation|static-analysis|perf-structure|perf-gate|cold-start|serving|sharding|recommender|chaos|all)"; exit 2 ;;
 esac
 echo "tier '$tier' green"

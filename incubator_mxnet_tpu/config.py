@@ -292,6 +292,23 @@ register_knob("MXTPU_PS_BUCKET_KB", 1024, int,
               "push_many/pull_many RPC pair per bucket after the "
               "intra-host GSPMD reduction. 0 disables batching (one RPC "
               "pair per key).")
+register_knob("MXTPU_EMBEDDING_SHARDS", "", str,
+              "Comma-separated host:port list of the embedding-shard PS "
+              "fleet (embedding.ShardedEmbeddingService). Row r of every "
+              "sharded table lives only on server r % num_shards, so a "
+              "table's HBM footprint divides across the fleet and no "
+              "worker ever materializes it. Empty (default): the service "
+              "must be handed explicit addresses or in-process servers "
+              "(tests/bench).")
+register_knob("MXTPU_SPARSE_PREFETCH", True, bool,
+              "Overlap embedding-row pulls with dense compute: the "
+              "sharded embedding service runs pulls and row-sparse grad "
+              "pushes on an ordered background thread, so the next "
+              "batch's rows stream in behind the current step's dense "
+              "forward/backward (the blocking remainder is the "
+              "sparse_pull stepstats phase). Off: every pull is a "
+              "blocking RPC on the critical path — same math, no "
+              "overlap.")
 
 # profiler
 register_knob("MXNET_PROFILER_AUTOSTART", False, bool,

@@ -1,0 +1,550 @@
+"""Terascale sparse embedding tier: PS-row-sharded tables with deduped,
+bucketed, prefetch-overlapped row pulls.
+
+The reference framework's signature production workload is row-sparse
+embedding training through ps-lite (ref: kvstore_dist row-sparse paths,
+src/kvstore/kvstore_dist_server.h DataHandleRowSparse): tables too large
+for one host live on the server fleet and workers move only the rows a
+batch touches. This module is that tier on the TPU-native stack:
+
+- **Row sharding.** Global row ``r`` of every table lives ONLY on shard
+  server ``r % num_shards`` (as local row ``r // num_shards``). A table's
+  HBM footprint divides across the fleet; a worker's footprint stays
+  O(batch) — ledger-tracked under role ``embedding``. Tables initialize
+  SERVER-SIDE from a deterministic per-global-row spec (ps.init_rows), so
+  not even one shard's rows ever materialize on a worker.
+- **Deduped, bucketed pulls.** Per step the batch's ids are uniqued on
+  host (the zipfian dedup win), padded to the MXTPU_SPARSE_NNZ_BUCKETING
+  power-of-two grid (stable shapes -> zero steady-state retraces; every
+  pull registers its shape signature with telemetry.compilereg under
+  ``embedding.pull``), and fetched with ONE ``pull_rows_multi`` RPC per
+  shard server carrying every table's rows — mirroring the hierarchical
+  push_many bucketing. The naive per-key path (one blocking RPC per table
+  per server, no bucketing) is kept as ``path="per_key"`` for the
+  recommender bench's A/B.
+- **Pull/forward overlap.** With MXTPU_SPARSE_PREFETCH an ordered
+  background worker owns ALL shard RPCs: grad pushes enqueue asynchronously
+  behind the dense allreduce, and the NEXT batch's pull enqueues behind
+  them — the queue preserves exactly the blocking path's push(N) < pull(N+1)
+  order, so overlap changes wall time, never math. The step blocks only on
+  the unfinished remainder of its prefetch, surfaced as the ``sparse_pull``
+  stepstats phase.
+
+Gradient flow: ``gluon.contrib.SparseEmbedding`` marks the pulled row
+block as an autograd variable; backward deposits the block's dense
+gradient (O(batch) rows), and ``push_grads`` ships the ``[:n_uniq]`` slice
+to the owning shards, where the server applies it through the optimizer's
+lazy row-sparse path (only touched rows update; membership-epoch fenced,
+dedup-enveloped — exactly-once across retries).
+
+Chaos/elasticity: a shard is a plain dense tensor under its key on a
+ParameterServer, so the PR-6 state-transfer contract applies unchanged —
+``snapshot()`` bootstraps each shard through its manifest-verified pull
+path into a sharded_checkpoint directory, and ``restore_shard()`` seeds a
+replacement server from those verified bytes.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from . import config as _config
+from .ndarray.ndarray import NDArray
+from .ndarray.sparse import bucket_nnz, pad_row_ids  # noqa: F401 (re-export)
+
+__all__ = ["ShardedEmbeddingService", "RemoteEmbeddingTable",
+           "launch_local_fleet"]
+
+PULL_RPCS_TOTAL = "mxtpu_embedding_pull_rpcs_total"
+_PULL_RPCS_HELP = ("Row-pull RPCs issued by the sharded embedding service, "
+                   "by path (batched = one multi-table RPC per server, "
+                   "per_key = naive one RPC per table per server).")
+PUSH_RPCS_TOTAL = "mxtpu_embedding_push_rpcs_total"
+_PUSH_RPCS_HELP = ("Row-sparse grad-push RPCs issued by the sharded "
+                   "embedding service, by path (batched / per_key).")
+ROWS_PULLED_TOTAL = "mxtpu_embedding_rows_pulled_total"
+_ROWS_HELP = ("Embedding rows fetched over the wire by the sharded "
+              "embedding service (after dedup, including bucket padding).")
+DEDUP_SAVED_TOTAL = "mxtpu_embedding_dedup_saved_rows_total"
+_DEDUP_HELP = ("Embedding row fetches avoided by per-step id dedup: "
+               "requested ids minus unique ids, summed over pulls (the "
+               "zipfian dedup win in rows).")
+PREFETCH_HITS_TOTAL = "mxtpu_embedding_prefetch_hits_total"
+_PREFETCH_HELP = ("Embedding pulls served from a completed or in-flight "
+                  "background prefetch, by outcome (ready = zero blocking, "
+                  "wait = blocked on the remainder).")
+
+# ledger role for worker-side pulled row blocks: the acceptance contract is
+# live bytes O(batch uniques), never O(vocab)
+LEDGER_ROLE = "embedding"
+
+
+def _shard_of(ids, num_shards):
+    """Route global row ids -> (shard index vector, local id vector)."""
+    ids = np.asarray(ids, np.int64)
+    return ids % num_shards, ids // num_shards
+
+
+class _Pull:
+    """A pull in flight on the worker thread (or completed inline)."""
+
+    __slots__ = ("event", "blocks", "error", "started")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.blocks = None
+        self.error = None
+        self.started = False
+
+
+class RemoteEmbeddingTable:
+    """Handle to one PS-sharded table: shape metadata + the id plan. All
+    wire traffic goes through the owning service (so multi-table steps
+    share one RPC per server)."""
+
+    def __init__(self, service, name, vocab, dim, dtype):
+        self.service = service
+        self.name = name
+        self.vocab = int(vocab)
+        self.dim = int(dim)
+        self.dtype = str(dtype)
+
+    def pull(self, raw_ids):
+        """Fetch the unique rows for `raw_ids` (deduped, bucket-padded).
+        Returns (rows_block np.ndarray, inv, n_uniq): block[inv[:len]]
+        reconstructs the per-position rows; rows [n_uniq:] are bucket
+        padding (repeats) and must never see gradient math."""
+        (block,), plan = self.service.pull([(self.name, raw_ids)])
+        return block, plan[0][1], plan[0][2]
+
+    def full_table(self):
+        """Gather the whole table onto THIS host (verification only —
+        workers never do this on the training path; O(vocab) here by
+        construction)."""
+        return self.service.full_table(self.name)
+
+
+class ShardedEmbeddingService:
+    """Client of an embedding-shard PS fleet. Not thread-safe for
+    concurrent steps; ONE training loop drives it (the background worker
+    is an internal pipeline stage, not a concurrency API)."""
+
+    def __init__(self, addrs=None, clients=None, prefetch=None):
+        from .ps import PSClient
+
+        if clients is None:
+            if addrs is None:
+                raw = _config.get("MXTPU_EMBEDDING_SHARDS")
+                addrs = [a for a in str(raw).split(",") if a.strip()]
+            if not addrs:
+                raise ValueError(
+                    "no embedding shards: pass addrs/clients or set "
+                    "MXTPU_EMBEDDING_SHARDS=host:port,host:port,...")
+            clients = []
+            for addr in addrs:
+                host, _, port = str(addr).strip().rpartition(":")
+                clients.append(PSClient(host, int(port)))
+        self._clients = list(clients)
+        self._tables = {}
+        self._bucket_floor = {}  # table -> sticky high-water pull bucket
+        self._optimizer = None
+        self._pending_grads = []   # [(name, uniq_ids, rows_nd, n_uniq)]
+        self._prefetched = {}      # plan key -> _Pull
+        self._prefetch_on = (_config.get("MXTPU_SPARSE_PREFETCH")
+                             if prefetch is None else bool(prefetch))
+        self._jobs = None
+        self._worker = None
+        self._worker_error = None
+        if self._prefetch_on:
+            self._jobs = queue.Queue()
+            self._worker = threading.Thread(
+                target=self._worker_loop, name="mxtpu-embedding-prefetch",
+                daemon=True)
+            self._worker.start()
+
+    # -- fleet ---------------------------------------------------------------
+    @property
+    def num_shards(self):
+        return len(self._clients)
+
+    @property
+    def clients(self):
+        return list(self._clients)
+
+    def set_optimizer(self, optimizer):
+        """Ship the optimizer to every shard server (server-side lazy
+        sparse apply — the worker never runs the embedding update)."""
+        self._optimizer = optimizer
+        for c in self._clients:
+            c.set_optimizer(optimizer)
+
+    def table(self, name, vocab, dim, dtype="float32", init="uniform",
+              scale=0.05, seed=0):
+        """Create (or re-open) a sharded table: shard s materializes its
+        local rows server-side from the deterministic spec. Idempotent —
+        init_rows is first-writer-wins per server."""
+        handle = self._tables.get(name)
+        if handle is not None:
+            return handle
+        vocab, dim = int(vocab), int(dim)
+        S = self.num_shards
+        for s, c in enumerate(self._clients):
+            local_rows = (vocab - s + S - 1) // S
+            spec = (("zeros",) if init == "zeros"
+                    else ("uniform", float(scale), int(seed), s, S))
+            c.init_rows(name, local_rows, dim, dtype, spec)
+        handle = RemoteEmbeddingTable(self, name, vocab, dim, dtype)
+        self._tables[name] = handle
+        return handle
+
+    # -- pull plane ----------------------------------------------------------
+    def _plan(self, requests):
+        """Host-side id plan for one step's pulls: dedup each table's ids,
+        pad the unique set to its nnz bucket (knob-gated), register the
+        resulting shape signature with the compile registry. Returns
+        [(name, inv, n_uniq, padded_uniq_ids)].
+
+        The bucket is a STICKY per-table high-water mark: once a table
+        has pulled a 32-row bucket it keeps pulling 32 even when a later
+        batch's uniques fit 16. A uniq count hovering at a bucket
+        boundary would otherwise flip the wire/gather shape every few
+        steps — and every flip back is a retrace; padding rows are far
+        cheaper than recompiles."""
+        from . import telemetry as _telemetry
+
+        plan = []
+        for name, raw in requests:
+            raw = np.asarray(raw, np.int64).reshape(-1)
+            uniq, inv = np.unique(raw, return_inverse=True)
+            padded, n_uniq = pad_row_ids(uniq)
+            if _config.get("MXTPU_SPARSE_NNZ_BUCKETING"):
+                floor = self._bucket_floor.get(name, 0)
+                if padded.size < floor:
+                    padded = np.concatenate(
+                        [padded,
+                         np.full(floor - padded.size, padded[-1], np.int64)])
+                else:
+                    self._bucket_floor[name] = padded.size
+            _telemetry.inc(DEDUP_SAVED_TOTAL, raw.size - n_uniq,
+                           help=_DEDUP_HELP)
+            dim = self._tables[name].dim
+            _telemetry.compilereg.register(
+                "embedding.pull",
+                (("table", name), ("block", (int(padded.size), dim)),
+                 ("inv", int(raw.size))))
+            plan.append((name, inv.astype(np.int64), n_uniq, padded))
+        return plan
+
+    def _rpc_pull(self, plan):
+        """The wire half: ONE pull_rows_multi RPC per shard server that
+        owns any requested row, covering every table in the plan."""
+        from . import telemetry as _telemetry
+
+        S = self.num_shards
+        blocks = [np.empty((p[3].size, self._tables[p[0]].dim),
+                           _np_dtype(self._tables[p[0]].dtype))
+                  for p in plan]
+        per_server = [([], []) for _ in range(S)]  # (names, local ids)
+        slots = [[] for _ in range(S)]             # (plan idx, positions)
+        for i, (name, _inv, _n, ids) in enumerate(plan):
+            shard, local = _shard_of(ids, S)
+            for s in range(S):
+                pos = np.nonzero(shard == s)[0]
+                if pos.size == 0:
+                    continue
+                per_server[s][0].append(name)
+                per_server[s][1].append(local[pos])
+                slots[s].append((i, pos))
+        with _telemetry.span("embedding.pull"):
+            for s in range(S):
+                names, locals_ = per_server[s]
+                if not names:
+                    continue
+                out = self._clients[s].pull_rows_multi(names, locals_)
+                _telemetry.inc(PULL_RPCS_TOTAL, 1, help=_PULL_RPCS_HELP,
+                               path="batched")
+                for (i, pos), rows in zip(slots[s], out):
+                    blocks[i][pos] = rows
+        _telemetry.inc(ROWS_PULLED_TOTAL,
+                       sum(p[3].size for p in plan), help=_ROWS_HELP)
+        return blocks
+
+    def _plan_key(self, plan):
+        return tuple((name, ids.tobytes()) for name, _i, _n, ids in plan)
+
+    def prefetch(self, requests):
+        """Enqueue the NEXT batch's pulls on the background worker: they
+        run after every already-enqueued grad push (so the math matches
+        the blocking path bit for bit) while the caller's dense compute
+        proceeds. No-op when prefetch is off."""
+        if not self._prefetch_on:
+            return None
+        plan = self._plan(requests)
+        fut = _Pull()
+        self._prefetched[self._plan_key(plan)] = fut
+        self._jobs.put(("pull", plan, fut))
+        return fut
+
+    def pull(self, requests):
+        """Fetch row blocks for `requests` = [(table_name, raw_ids)].
+        Served from a matching prefetch when one is outstanding;
+        otherwise the pull runs now — still ORDERED behind any pending
+        async pushes. Blocking time lands in the sparse_pull stepstats
+        phase. Returns (blocks, plan)."""
+        from . import telemetry as _telemetry
+        from .telemetry import stepstats as _stepstats
+
+        self._check_worker()
+        plan = self._plan(requests)
+        fut = self._prefetched.pop(self._plan_key(plan), None)
+        if fut is not None:
+            _telemetry.inc(
+                PREFETCH_HITS_TOTAL, 1, help=_PREFETCH_HELP,
+                outcome="ready" if fut.event.is_set() else "wait")
+            with _stepstats.phase("sparse_pull"):
+                fut.event.wait()
+            if fut.error is not None:
+                raise fut.error
+            return fut.blocks, plan
+        with _stepstats.phase("sparse_pull"):
+            if self._prefetch_on:
+                # an unprefetched pull still queues, so it cannot overtake
+                # an in-flight grad push of rows it is about to read
+                fut = _Pull()
+                self._jobs.put(("pull", plan, fut))
+                fut.event.wait()
+                if fut.error is not None:
+                    raise fut.error
+                return fut.blocks, plan
+            return self._rpc_pull(plan), plan
+
+    def pull_per_key(self, name, raw_ids):
+        """The naive baseline the recommender bench A/Bs against: one
+        BLOCKING pull_rows RPC per table per shard, no bucketing, no
+        overlap (the id dedup itself is framework behavior — both paths
+        share it, so weights stay comparable). Returns
+        (rows_block, inv, n_uniq)."""
+        from . import telemetry as _telemetry
+        from .telemetry import stepstats as _stepstats
+
+        raw = np.asarray(raw_ids, np.int64).reshape(-1)
+        uniq, inv = np.unique(raw, return_inverse=True)
+        _telemetry.inc(DEDUP_SAVED_TOTAL, raw.size - uniq.size,
+                       help=_DEDUP_HELP)
+        table = self._tables[name]
+        _telemetry.compilereg.register(
+            "embedding.pull",
+            (("table", name), ("block", (int(uniq.size), table.dim)),
+             ("inv", int(raw.size))))
+        block = np.empty((uniq.size, table.dim), _np_dtype(table.dtype))
+        shard, local = _shard_of(uniq, self.num_shards)
+        with _stepstats.phase("sparse_pull"), \
+                _telemetry.span("embedding.pull"):
+            for s in range(self.num_shards):
+                pos = np.nonzero(shard == s)[0]
+                if pos.size == 0:
+                    continue
+                block[pos] = self._clients[s].pull_rows(name, local[pos])
+                _telemetry.inc(PULL_RPCS_TOTAL, 1, help=_PULL_RPCS_HELP,
+                               path="per_key")
+        _telemetry.inc(ROWS_PULLED_TOTAL, uniq.size, help=_ROWS_HELP)
+        return block, inv.astype(np.int64), int(uniq.size)
+
+    # -- push plane ----------------------------------------------------------
+    def stash_grad(self, name, uniq_ids, rows_nd, n_uniq):
+        """Called by SparseEmbedding's forward: remember where backward
+        will deposit this block's gradient."""
+        self._pending_grads.append((name, uniq_ids, rows_nd, n_uniq))
+
+    def push_grads(self, grads=None, per_key=False):
+        """Push row-sparse grads to their owning shards. Default source is
+        the stashed pending set (after loss.backward()). With the worker
+        on, the push enqueues and returns immediately — asynchronously
+        behind the dense allreduce — and the NEXT pull queues behind it.
+        `per_key` forces the naive one-RPC-per-table blocking wire."""
+        if grads is None:
+            grads = [(name, ids[:n], _grad_of(rows_nd, n))
+                     for name, ids, rows_nd, n in self._pending_grads]
+            self._pending_grads.clear()
+        if not grads:
+            return
+        self._check_worker()
+        if self._prefetch_on and not per_key:
+            self._jobs.put(("push", list(grads)))
+            return
+        self._rpc_push(grads, per_key=per_key)
+
+    def _rpc_push(self, grads, per_key=False):
+        """One push_rows_multi RPC per shard server (or per-key blocking
+        RPCs for the baseline). Rows ride the dedup envelope and epoch
+        fence; the server applies them through the lazy sparse path."""
+        from . import telemetry as _telemetry
+
+        path = "per_key" if per_key else "batched"
+        S = self.num_shards
+        per_server = [([], [], []) for _ in range(S)]
+        for name, ids, rows in grads:
+            ids = np.asarray(ids, np.int64)
+            rows = np.asarray(rows)
+            shard, local = _shard_of(ids, S)
+            for s in range(S):
+                pos = np.nonzero(shard == s)[0]
+                if pos.size == 0:
+                    continue
+                per_server[s][0].append(name)
+                per_server[s][1].append(local[pos])
+                per_server[s][2].append(rows[pos])
+        with _telemetry.span("embedding.push"):
+            for s in range(S):
+                names, ids_l, rows_l = per_server[s]
+                if not names:
+                    continue
+                if per_key:
+                    for name, ids, rows in zip(names, ids_l, rows_l):
+                        self._clients[s].push_rows(name, ids, rows)
+                        _telemetry.inc(PUSH_RPCS_TOTAL, 1,
+                                       help=_PUSH_RPCS_HELP, path=path)
+                else:
+                    self._clients[s].push_rows_multi(names, ids_l, rows_l)
+                    _telemetry.inc(PUSH_RPCS_TOTAL, 1,
+                                   help=_PUSH_RPCS_HELP, path=path)
+
+    # -- background worker ---------------------------------------------------
+    def _worker_loop(self):
+        while True:
+            job = self._jobs.get()
+            kind = job[0]
+            if kind == "stop":
+                return
+            try:
+                if kind == "push":
+                    self._rpc_push(job[1])
+                else:  # pull
+                    _k, plan, fut = job
+                    fut.started = True
+                    fut.blocks = self._rpc_pull(plan)
+                    fut.event.set()
+            except Exception as e:  # surfaced on the next wait/flush
+                if kind == "pull":
+                    job[2].error = e
+                    job[2].event.set()
+                else:
+                    self._worker_error = e
+
+    def _check_worker(self):
+        err, self._worker_error = self._worker_error, None
+        if err is not None:
+            raise err
+
+    def flush(self):
+        """Drain the background queue (epoch boundary / before reading
+        weights): every enqueued push and prefetch has reached the
+        servers when this returns."""
+        if not self._prefetch_on:
+            return
+        done = threading.Event()
+        fut = _Pull()
+        fut.event = done
+        self._jobs.put(("pull", [], fut))  # empty plan = queue barrier
+        done.wait()
+        self._check_worker()
+
+    # -- verification / chaos ------------------------------------------------
+    def full_table(self, name):
+        """Reassemble a table from its shards (tests/bench only)."""
+        table = self._tables[name]
+        S = self.num_shards
+        out = np.empty((table.vocab, table.dim), _np_dtype(table.dtype))
+        for s, c in enumerate(self._clients):
+            out[s::S] = np.asarray(c.pull(name))
+        return out
+
+    def snapshot(self, directory):
+        """Write every shard's rows to `directory`/shard-<s> through the
+        manifest-verified bootstrap pull (PR-6 state-transfer contract) +
+        the sharded_checkpoint writer — the recovery source a replacement
+        shard server restores from."""
+        import os
+
+        from .contrib import sharded_checkpoint as _sc
+
+        self.flush()
+        paths = []
+        for s, c in enumerate(self._clients):
+            state = c.bootstrap()  # manifest-verified {key: rows}
+            path = os.path.join(directory, f"shard-{s}")
+            _sc.save(path, state)
+            if not _sc.verify(path):
+                raise RuntimeError(
+                    f"embedding snapshot shard {s} failed manifest "
+                    "verification")
+            paths.append(path)
+        return paths
+
+    def restore_shard(self, shard, directory, client):
+        """Seed a REPLACEMENT server for `shard` from a snapshot: verify
+        the manifest, init every key's local rows from the restored
+        bytes, re-ship the optimizer, and swap the client into the
+        fleet."""
+        import os
+
+        from .contrib import sharded_checkpoint as _sc
+
+        path = os.path.join(directory, f"shard-{shard}")
+        if not _sc.verify(path):
+            raise RuntimeError(
+                f"embedding snapshot shard {shard} failed manifest "
+                "verification at restore")
+        state = _sc.restore(path)
+        for key, arr in state.items():
+            client.init(key, np.asarray(arr))
+        if self._optimizer is not None:
+            client.set_optimizer(self._optimizer)
+        old, self._clients[shard] = self._clients[shard], client
+        try:
+            old.close()
+        except Exception:
+            pass
+        return state
+
+    def close(self):
+        if self._prefetch_on and self._worker is not None:
+            self._jobs.put(("stop",))
+            self._worker.join(timeout=10)
+            self._worker = None
+        for c in self._clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+
+def _grad_of(rows_nd, n_uniq):
+    """The [:n_uniq] slice of a pulled block's deposited gradient (bucket
+    padding rows never reach the wire or the optimizer)."""
+    g = getattr(rows_nd, "_grad", None)
+    if g is None:
+        raise RuntimeError(
+            "SparseEmbedding forward ran under record() but no gradient "
+            "was deposited — did loss.backward() run?")
+    return np.asarray(g.asnumpy())[:n_uniq]
+
+
+def _np_dtype(name):
+    from .ps import _dtype_by_name
+
+    return _dtype_by_name(name)
+
+
+def launch_local_fleet(num_shards, host="127.0.0.1"):
+    """In-process shard fleet for tests/bench: returns (servers, service).
+    Each shard is a real ParameterServer on a real socket (num_workers=1
+    — embedding pushes are async applies, never sync rendezvous)."""
+    from .ps import ParameterServer, PSClient
+
+    servers = [ParameterServer(num_workers=1, host=host, port=0)
+               for _ in range(int(num_shards))]
+    clients = [PSClient(host, s.port) for s in servers]
+    return servers, ShardedEmbeddingService(clients=clients)

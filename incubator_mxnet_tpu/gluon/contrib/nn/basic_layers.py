@@ -46,10 +46,89 @@ class Identity(HybridBlock):
 
 
 class SparseEmbedding(Embedding):
-    """Embedding with row-sparse gradient intent. On TPU the dense gather's
-    VJP is already a scatter-add XLA fuses well, so this is Embedding with
-    the reference's API (ref: contrib SparseEmbedding, gluon/nn Embedding
-    sparse_grad=True)."""
+    """Embedding with row-sparse gradients — and, given a
+    ``ShardedEmbeddingService``, a table that lives ONLY on the PS shard
+    fleet (ref: contrib SparseEmbedding over kvstore_dist row-sparse
+    pull/push; the reference trains terascale tables this way).
+
+    Local mode (``service=None``): the reference's contrib block —
+    Embedding with ``sparse_grad=True``, engaging the lazy row-sparse
+    optimizer paths.
+
+    Remote mode (``service=`` a :class:`~incubator_mxnet_tpu.embedding.
+    ShardedEmbeddingService`): no weight Parameter exists on this worker.
+    The table is registered on the fleet (rows hash-sharded, initialized
+    server-side), and each eager forward pulls only the batch's deduped,
+    bucket-padded unique rows, gathers through ``F.Embedding`` (so the
+    autograd tape records it), and — under ``autograd.record()`` — marks
+    the pulled block as a variable whose backward gradient the service
+    pushes back row-sparse. Worker-resident state is O(batch uniques),
+    never O(vocab). Eager-only: the row set is host data, so this mode
+    cannot be traced into a jit program.
+
+    ``per_key=True`` selects the naive blocking one-RPC-per-table wire
+    (the recommender bench's baseline); math is identical.
+    """
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=True, service=None,
+                 table=None, scale=0.05, seed=0, per_key=False, **kwargs):
+        if service is None:
+            self._remote = None
+            super().__init__(input_dim, output_dim, dtype=dtype,
+                             weight_initializer=weight_initializer,
+                             sparse_grad=sparse_grad, **kwargs)
+            return
+        HybridBlock.__init__(self, **kwargs)
+        self._input_dim = int(input_dim)
+        self._output_dim = int(output_dim)
+        self._service = service
+        self._per_key = bool(per_key)
+        self._remote = service.table(table or self.name, input_dim,
+                                     output_dim, dtype=dtype, scale=scale,
+                                     seed=seed)
+
+    def prefetch(self, x):
+        """Enqueue the pull for ids `x` on the service's background
+        worker; the matching forward then only blocks on the unfinished
+        remainder. No-op in local/per-key mode."""
+        if self._remote is None or self._per_key:
+            return
+        self._service.prefetch([(self._remote.name,
+                                 _host_ids(x))])
+
+    def forward(self, x, *args):
+        if self._remote is None:
+            return super().forward(x, *args)
+
+        from .... import autograd as _ag
+        from .... import ndarray as nd
+        from ....embedding import LEDGER_ROLE
+        from ....telemetry import ledger as _ledger
+
+        raw = _host_ids(x)
+        if self._per_key:
+            block, inv, n_uniq = self._service.pull_per_key(
+                self._remote.name, raw)
+        else:
+            block, inv, n_uniq = self._remote.pull(raw)
+        rows_nd = nd.array(block)
+        _ledger.track(rows_nd, LEDGER_ROLE)
+        if _ag.is_recording():
+            _ag.mark_variables([rows_nd],
+                               [nd.zeros(block.shape, dtype=block.dtype)])
+            self._service.stash_grad(self._remote.name, np.unique(raw),
+                                     rows_nd, n_uniq)
+        out = nd.Embedding(nd.array(inv.astype(np.int32)), rows_nd,
+                           input_dim=int(block.shape[0]),
+                           output_dim=self._output_dim)
+        return out.reshape(tuple(x.shape) + (self._output_dim,))
+
+
+def _host_ids(x):
+    """Flatten an id batch (NDArray or array-like) to host int64."""
+    x = x.asnumpy() if hasattr(x, "asnumpy") else x
+    return np.asarray(x, np.int64).reshape(-1)
 
 
 class SyncBatchNorm(BatchNorm):

@@ -150,6 +150,25 @@ class Parameter:
             self._attach_grad()
 
     def _attach_grad(self):
+        if self.grad_stype == "row_sparse":
+            # a row_sparse-grad parameter (embedding table) must not pay a
+            # vocab-sized dense zeros buffer before step one: start from an
+            # EMPTY row_sparse grad. backward() deposits only the rows a
+            # batch touched, the lazy optimizer paths consume them, and a
+            # dense cotangent still lazily materializes a dense buffer in
+            # autograd (no _dense_grad_buf backref to keep alive here).
+            from ..ndarray.sparse import RowSparseNDArray
+
+            width = tuple(self._shape[1:])
+            self._grad = RowSparseNDArray(
+                NDArray._from_data(jnp.zeros((0,) + width,
+                                             dtype_np(self.dtype))),
+                NDArray._from_data(jnp.zeros((0,), jnp.int64)),
+                tuple(self._shape))
+            _ledger.track(self._grad.data, "grads")
+            self._data._grad = self._grad
+            self._data._grad_req = self._grad_req
+            return
         self._grad = NDArray._from_data(jnp.zeros(self._shape, dtype_np(self.dtype)))
         _ledger.track(self._grad, "grads")
         self._data._grad = self._grad
@@ -216,7 +235,9 @@ class Parameter:
             raise RuntimeError(
                 f"cannot place uninitialized parameter {self.name}")
         self._data._data = _jax.device_put(self._data._data, sharding)
-        if self._grad is not None:
+        if isinstance(self._grad, NDArray):
+            # row_sparse grad buffers are O(batch rows) and rebuilt every
+            # backward — placement would not survive the step, skip them
             self._grad._data = _jax.device_put(self._grad._data, sharding)
         return self
 
@@ -253,8 +274,11 @@ class Parameter:
         self.dtype = dtype
         if self._data is not None:
             self._data._data = self._data._data.astype(dtype_np(dtype))
-            if self._grad is not None:
+            if isinstance(self._grad, NDArray):
                 self._grad._data = self._grad._data.astype(dtype_np(dtype))
+            elif self._grad is not None:
+                # sparse grad buffer: rebuild empty at the new dtype
+                self._attach_grad()
 
     def var(self):
         if self._var is None:

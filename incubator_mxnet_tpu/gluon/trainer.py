@@ -105,6 +105,21 @@ class Trainer:
 
         self._shard_policy = _zero.resolve_policy(
             _config.get("MXTPU_SHARD_POLICY"))
+        # PS-sharded embedding tier (embedding.ShardedEmbeddingService):
+        # when attached, pending row-sparse embedding grads ship at the
+        # step boundary, behind the dense gradient exchange
+        self._sparse_service = None
+
+    def attach_sparse_service(self, service):
+        """Wire a ShardedEmbeddingService into the step boundary: after
+        the dense allreduce/pushpull, the grads stashed by remote
+        SparseEmbedding blocks push to their shard servers —
+        asynchronously on the service's ordered worker when
+        MXTPU_SPARSE_PREFETCH is on, so the RPCs overlap the local
+        optimizer update while the NEXT step's prefetched pull still
+        queues behind them (push N happens-before pull N+1)."""
+        self._sparse_service = service
+        return service
 
     @property
     def learning_rate(self):
@@ -436,9 +451,16 @@ class Trainer:
                     _telemetry.inc(_DISPATCHES, len(self._params),
                                    kind="server_pushpull", path="per_key",
                                    help=_DISPATCH_HELP)
+            if self._sparse_service is not None:
+                self._sparse_service.push_grads()
             return
         if self._kvstore is not None:
             self.allreduce_grads()
+        # row-sparse embedding grads ship NOW, behind the dense allreduce:
+        # with prefetch on this only enqueues — the RPCs overlap the
+        # optimizer update below
+        if self._sparse_service is not None:
+            self._sparse_service.push_grads()
         # AFTER allreduce: one worker's NaN poisons every replica's
         # reduced gradient, so the check must see the reduced values
         if self._guardrail_check("local_update"):

@@ -702,13 +702,22 @@ class KVStoreDistAsyncServer(KVStoreDist):
                        op="pushpull_hierarchical", store=self.type)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Only the requested rows cross the wire
+        """Only the requested rows cross the wire — after a host-side
+        dedup (repeated ids in a batch are the common case on zipfian
+        data), bucket-padded to the MXTPU_SPARSE_NNZ_BUCKETING grid so
+        steady-state pulls keep a stable wire shape
         (ref: DataHandleRowSparse kvstore_dist_server.h:499)."""
         import numpy as _np
 
+        from .ndarray.sparse import pad_row_ids as _pad_row_ids
+
         rid = row_ids[0] if isinstance(row_ids, (list, tuple)) else row_ids
         idx = _np.asarray(_to_data(rid)).astype(_np.int64)
-        rows = jnp.asarray(self._client.pull_rows(key, idx))
+        uniq, inv = _np.unique(idx, return_inverse=True)
+        wire, _n = _pad_row_ids(uniq)
+        block = jnp.asarray(self._client.pull_rows(key, wire))
+        # scatter back to the caller's per-position view via the inverse
+        rows = jnp.take(block, jnp.asarray(inv), axis=0)
         outs = out if isinstance(out, (list, tuple)) else [out]
         for o in outs:
             if isinstance(o, RowSparseNDArray):

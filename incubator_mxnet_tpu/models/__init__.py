@@ -8,3 +8,6 @@ from .lenet import get_symbol as get_lenet  # noqa: F401
 from .mlp import get_symbol as get_mlp  # noqa: F401
 from .resnet import get_symbol as get_resnet  # noqa: F401
 from . import ssd  # noqa: F401
+# gluon-API models (eager; the sparse embedding tier is eager-only)
+from . import dlrm  # noqa: F401
+from .dlrm import DLRM  # noqa: F401

@@ -123,20 +123,55 @@ def _split_heads(x, n_heads):
     return x.reshape(B, T, n_heads, d // n_heads)
 
 
+FLASH_DENSE_FALLBACKS_TOTAL = "mxtpu_flash_dense_fallbacks_total"
+_FLASH_FALLBACKS_HELP = (
+    "Training flash-attention calls that fell back to the dense S×S "
+    "attention (non-causal sequences that do not tile into blocks — "
+    "causal remainders are padded into the Pallas path instead), by site "
+    "and reason.")
+
+
+def _count_flash_dense_fallback(site, reason):
+    # trace-time event (shapes are static), so the counter costs nothing
+    # on the per-step hot path; lazy import keeps this module jax-only
+    # when telemetry is off (same idiom as pallas_kernels flash_decode)
+    from .. import telemetry
+
+    telemetry.inc(FLASH_DENSE_FALLBACKS_TOTAL, help=_FLASH_FALLBACKS_HELP,
+                  site=site, reason=reason)
+
+
 def _flash_attention_fn(q, k, v, causal=True, block=128):
     """Adapter onto the Pallas flash kernels (ops/pallas_kernels.py):
-    model layout (B, T, H, Dh) <-> kernel layout (B, H, T, Dh). Falls back
-    to dense attention when the sequence doesn't tile into blocks."""
+    model layout (B, T, H, Dh) <-> kernel layout (B, H, T, Dh).
+
+    A sequence length that does not tile into blocks no longer silently
+    pays the dense S×S path when causal: q/k/v zero-pad along T to the
+    next block multiple, the kernel runs, and the output slices back to
+    T. Exact because a causal query at t < T never attends a padded key
+    at t' >= T (cost: < one block of extra rows). Non-causal remainders
+    would let every query see the padded keys, so they still fall back to
+    dense — now COUNTED via mxtpu_flash_dense_fallbacks_total instead of
+    vanishing from the perf picture."""
     from ..ops.pallas_kernels import flash_attention
 
     T = q.shape[1]
     blk = min(block, T)
-    if T % blk != 0:
+    pad = (-T) % blk
+    if pad and not causal:
+        _count_flash_dense_fallback("models.transformer",
+                                    "non_causal_remainder")
         return _dense_attention(q, k, v, causal)
+    if pad:
+        widths = ((0, 0), (0, pad), (0, 0), (0, 0))
+        q = jnp.pad(q, widths)
+        k = jnp.pad(k, widths)
+        v = jnp.pad(v, widths)
     out = flash_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
                           v.transpose(0, 2, 1, 3), causal=causal,
                           block_q=blk, block_k=blk)
-    return out.transpose(0, 2, 1, 3)
+    out = out.transpose(0, 2, 1, 3)
+    return out[:, :T] if pad else out
 
 
 def _dense_attention(q, k, v, causal=True):

@@ -41,6 +41,8 @@ __all__ = [
     "subtract",
     "multiply",
     "add_n",
+    "bucket_nnz",
+    "pad_row_ids",
 ]
 
 
@@ -286,6 +288,35 @@ def _pad_nnz(data, indices):
     indices = jnp.concatenate(
         [indices, jnp.zeros((pad,), indices.dtype)])
     return data, indices
+
+
+def bucket_nnz(n):
+    """Public bucket grid: the nnz a sparse buffer is padded to when
+    MXTPU_SPARSE_NNZ_BUCKETING is on — smallest power-of-2 >= n, floor 16.
+    Every consumer of the grid (sparse kernels, the sharded embedding
+    service's pull blocks, kvstore row pulls) MUST share this function so
+    one batch's nnz maps to one shape everywhere."""
+    return _bucket_nnz(n)
+
+
+def pad_row_ids(ids, force=False):
+    """Pad a host-side row-id vector up to its nnz bucket by repeating the
+    last id. Returns (padded_ids, n_valid). Repeats — not zeros — so a
+    padded PULL fetches a row that is being fetched anyway (no phantom row
+    0 traffic) and the consumer slices [:n_valid] before any gradient
+    math, keeping padding invisible to the optimizer. No-op (aside from
+    the int64 cast) while MXTPU_SPARSE_NNZ_BUCKETING is off and `force`
+    is not set."""
+    from .. import config as _config
+
+    ids = np.asarray(ids, np.int64).reshape(-1)
+    n = int(ids.shape[0])
+    if not (force or _config.get("MXTPU_SPARSE_NNZ_BUCKETING")):
+        return ids, n
+    b = _bucket_nnz(n)
+    if b == n or n == 0:
+        return ids, n
+    return np.concatenate([ids, np.full(b - n, ids[-1], np.int64)]), n
 
 
 def dot(lhs, rhs, transpose_a=False):

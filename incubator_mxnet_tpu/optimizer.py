@@ -178,9 +178,23 @@ def _cast_state_like(new_state, old_state):
 def _sparse_grad_prep(opt, grad):
     """Rows + rescaled/clipped per-row gradient block for a lazy update
     (ref: optimizer_op-inl.h SGDUpdateRspImpl lazy_update path: only rows
-    present in the row_sparse gradient are touched)."""
-    rows = grad.indices._data.astype(jnp.int32)
+    present in the row_sparse gradient are touched).
+
+    Duplicate row ids are segment-summed to unique rows first: the state
+    paths write with ``.at[rows].set``, which is last-write-wins on
+    repeats — without the fold a duplicated row would apply momentum/wd
+    once per occurrence and keep only the final racer's state. Framework
+    producers (autograd.sparse_embedding, kvstore row-sparse allreduce)
+    already emit unique rows, so the host check is the common-case cost.
+    """
+    idx = np.asarray(grad.indices._data)
     g = grad.data._data * opt.rescale_grad
+    if idx.size and np.unique(idx).size != idx.size:
+        uniq, inv = np.unique(idx, return_inverse=True)
+        g = jnp.zeros((uniq.size,) + g.shape[1:],
+                      g.dtype).at[jnp.asarray(inv)].add(g)
+        idx = uniq
+    rows = jnp.asarray(idx.astype(np.int32))
     if opt.clip_gradient:
         g = jnp.clip(g, -opt.clip_gradient, opt.clip_gradient)
     return rows, g

@@ -897,27 +897,108 @@ class ParameterServer:
                 out.append(np.array(self._store[key], copy=True))
         return ("val", out)
 
-    def _cmd_push_rows(self, key, indices, rows):
-        """Sparse push: apply only the occupied rows, through the
-        optimizer's sparse/lazy path (ref: DataHandleRowSparse :499)."""
+    def _apply_rows(self, key, indices, rows):
+        """Apply one key's row-sparse grad through the optimizer's
+        sparse/lazy path — only the touched rows of the stored tensor
+        move (ref: DataHandleRowSparse :499). Caller holds the key lock."""
         from .ndarray.ndarray import NDArray
         from .ndarray.sparse import RowSparseNDArray
 
+        stored = self._store[key]
+        if self._updater is not None:
+            rsp = RowSparseNDArray(NDArray(rows), NDArray(indices),
+                                   stored.shape)
+            w = NDArray(stored)
+            self._updater(key, rsp, w)
+            self._store[key] = np.asarray(w.asnumpy())
+        else:
+            upd = stored.copy()
+            np.add.at(upd, indices, rows)
+            self._store[key] = upd
+        self._versions[key] += 1
+
+    def _cmd_push_rows(self, key, indices, rows, epoch=None):
+        """Sparse push: apply only the occupied rows, through the
+        optimizer's sparse/lazy path (ref: DataHandleRowSparse :499)."""
+        self._check_epoch(epoch, "push_rows")
         indices = np.asarray(indices, np.int64)
         rows = np.asarray(rows)
         with self._key_lock(key):
-            stored = self._store[key]
-            if self._updater is not None:
-                rsp = RowSparseNDArray(NDArray(rows), NDArray(indices),
-                                       stored.shape)
-                w = NDArray(stored)
-                self._updater(key, rsp, w)
-                self._store[key] = np.asarray(w.asnumpy())
+            self._apply_rows(key, indices, rows)
+        return ("ok",)
+
+    # --- sharded embedding tables ------------------------------------------
+    # One server of an embedding-shard fleet stores ONLY its local rows of
+    # each table (global row r lives on server r % num_shards as local row
+    # r // num_shards; the client owns the mapping). The commands below are
+    # the shard-fleet data plane: a deterministic server-side init (so no
+    # worker ever materializes even a shard), and multi-key row pull/push
+    # so one RPC per SERVER carries every table's rows for a step —
+    # mirroring push_many's one-RPC-per-bucket hierarchy. State transfer
+    # (chaos replacement) rides the existing state_manifest/pull contract
+    # unchanged, because a shard is just a dense tensor under its key.
+
+    def _cmd_init_rows(self, key, num_rows, width, dtype, spec):
+        """Declare this server's shard of an embedding table: materialize
+        `num_rows` local rows SERVER-SIDE from a deterministic init spec
+        (first writer wins, like init). spec is ("zeros",) or
+        ("uniform", scale, seed, shard, num_shards): local row i is drawn
+        from a counter-based stream keyed by (seed, global row id), so a
+        row's initial value depends only on its global id — stable across
+        fleet layouts and never shipped over the wire."""
+        num_rows, width = int(num_rows), int(width)
+        with self._key_lock(key):
+            if key in self._store:
+                return ("ok",)
+            dt = _dtype_by_name(str(dtype))
+            kind = spec[0]
+            if kind == "zeros":
+                block = np.zeros((num_rows, width), dt)
+            elif kind == "uniform":
+                scale, seed, shard, num_shards = (
+                    float(spec[1]), int(spec[2]), int(spec[3]),
+                    int(spec[4]))
+                global_ids = shard + num_shards * np.arange(num_rows)
+                seeds = np.empty((num_rows, 2), np.uint64)
+                seeds[:, 0] = np.uint64(seed)
+                seeds[:, 1] = global_ids.astype(np.uint64)
+                block = np.empty((num_rows, width), dt)
+                for i in range(num_rows):
+                    rng = np.random.Philox(key=seeds[i])
+                    block[i] = np.random.Generator(rng).uniform(
+                        -scale, scale, width).astype(dt)
             else:
-                upd = stored.copy()
-                np.add.at(upd, indices, rows)
-                self._store[key] = upd
-            self._versions[key] += 1
+                raise ValueError(f"unknown embedding init spec {kind!r}")
+            self._store[key] = block
+            self._versions[key] = 0
+        return ("ok",)
+
+    def _cmd_pull_rows_multi(self, keys, ids_list):
+        """Serve the requested rows of MANY keys in one response — the
+        per-server half of the deduped/bucketed embedding pull (one RPC
+        per server per step instead of one per key)."""
+        out = []
+        for key, ids in zip(keys, ids_list):
+            ids = np.asarray(ids, np.int64)
+            with self._key_lock(key):
+                out.append(np.array(self._store[key][ids], copy=True))
+        return ("val", out)
+
+    def _cmd_push_rows_multi(self, keys, ids_list, rows_list, epoch=None):
+        """Apply many keys' row-sparse grads in one mutating RPC, each
+        through the lazy sparse optimizer path. Rides the dedup envelope
+        (exactly-once across client retries) and the membership-epoch
+        fence, like push_many."""
+        self._check_epoch(epoch, "push_rows_multi")
+        if not (len(keys) == len(ids_list) == len(rows_list)):
+            raise ValueError(
+                f"push_rows_multi got {len(keys)} keys, {len(ids_list)} "
+                f"id vectors, {len(rows_list)} row blocks")
+        for key, ids, rows in zip(keys, ids_list, rows_list):
+            ids = np.asarray(ids, np.int64)
+            rows = np.asarray(rows)
+            with self._key_lock(key):
+                self._apply_rows(key, ids, rows)
         return ("ok",)
 
     def _cmd_push_compressed(self, key, payload, shape):
@@ -1234,7 +1315,27 @@ class PSClient:
 
     def push_rows(self, key, indices, rows):
         return self._mut_rpc("push_rows", key, np.asarray(indices),
-                             np.asarray(rows))
+                             np.asarray(rows), self._epoch)
+
+    # --- sharded embedding tables ------------------------------------------
+    def init_rows(self, key, num_rows, width, dtype, spec):
+        """Create this server's shard of an embedding table from a
+        deterministic init spec (server-side materialization)."""
+        return self._mut_rpc("init_rows", key, int(num_rows), int(width),
+                             str(dtype), tuple(spec))
+
+    def pull_rows_multi(self, keys, ids_list):
+        """One RPC, many keys: fetch each key's requested rows."""
+        return list(self._rpc("pull_rows_multi", tuple(keys),
+                              [np.asarray(i, np.int64) for i in ids_list]))
+
+    def push_rows_multi(self, keys, ids_list, rows_list):
+        """One mutating RPC applying many keys' row-sparse grads through
+        the server's lazy sparse optimizer path (epoch-fenced, deduped)."""
+        return self._mut_rpc("push_rows_multi", tuple(keys),
+                             [np.asarray(i, np.int64) for i in ids_list],
+                             [np.asarray(r) for r in rows_list],
+                             self._epoch)
 
     def set_optimizer_attrs(self, attrs):
         return self._mut_rpc("set_optimizer_attrs", dict(attrs))

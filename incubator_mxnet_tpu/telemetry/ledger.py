@@ -43,7 +43,8 @@ from . import recorder as _recorder
 __all__ = ["track", "untrack", "donate", "live_bytes", "peak_info",
            "step_sample", "samples", "reset", "ROLES"]
 
-ROLES = ("params", "grads", "optimizer_state", "activations", "kv_buffers")
+ROLES = ("params", "grads", "optimizer_state", "activations", "kv_buffers",
+         "embedding")
 
 LIVE_BYTES = "mxtpu_ledger_live_bytes"
 _LIVE_HELP = ("Live NDArray bytes tracked by the HBM ledger, by role "

@@ -150,6 +150,31 @@ METRIC_NAMES = {
         "counter", "flash_decode calls that fell back to the dense "
                    "(non-Pallas) cache attention because the cache "
                    "length does not tile into decode blocks, by reason."),
+    "mxtpu_flash_dense_fallbacks_total": (
+        "counter", "Training flash-attention calls that fell back to the "
+                   "dense S×S attention (non-causal sequences that do "
+                   "not tile into blocks — causal remainders are padded "
+                   "into the Pallas path instead), by site and reason."),
+    "mxtpu_embedding_pull_rpcs_total": (
+        "counter", "Row-pull RPCs issued by the sharded embedding "
+                   "service, by path (batched = one multi-table RPC per "
+                   "server, per_key = naive one RPC per table per "
+                   "server)."),
+    "mxtpu_embedding_push_rpcs_total": (
+        "counter", "Row-sparse grad-push RPCs issued by the sharded "
+                   "embedding service, by path (batched / per_key)."),
+    "mxtpu_embedding_rows_pulled_total": (
+        "counter", "Embedding rows fetched over the wire by the sharded "
+                   "embedding service (after dedup, including bucket "
+                   "padding)."),
+    "mxtpu_embedding_dedup_saved_rows_total": (
+        "counter", "Embedding row fetches avoided by per-step id "
+                   "dedup: requested ids minus unique ids, summed over "
+                   "pulls (the zipfian dedup win in rows)."),
+    "mxtpu_embedding_prefetch_hits_total": (
+        "counter", "Embedding pulls served from a completed or in-flight "
+                   "background prefetch, by outcome (ready = zero "
+                   "blocking, wait = blocked on the remainder)."),
     "mxtpu_serving_queue_depth": (
         "gauge", "Requests waiting in the serving engine's admission "
                  "queue (not yet holding a decode slot)."),
@@ -191,6 +216,8 @@ SPAN_NAMES = frozenset({
     "ps.server.handle",
     "ps.server.merge",
     "ps.server.barrier",
+    "embedding.pull",
+    "embedding.push",
     "serving.step",
     "serving.prefill",
 })

@@ -57,9 +57,13 @@ _ANOM_HELP = ("Steps whose wall time exceeded MXNET_TELEMETRY_ANOMALY_FACTOR"
               " x the rolling median (each also logs a step_anomaly flight "
               "event).")
 
-# canonical phase names (open set — these are the framework-fed ones)
-PHASES = ("data_fetch", "h2d", "dispatch", "device_sync", "allreduce",
-          "pushpull", "optimizer_update")
+# canonical phase names (open set — these are the framework-fed ones).
+# sparse_pull is the time a step BLOCKED waiting for embedding rows from
+# the PS fleet: with MXTPU_SPARSE_PREFETCH the background thread absorbs
+# the RPC wall time and this phase shrinks toward zero — the direct
+# observatory readout of the pull/forward overlap win.
+PHASES = ("data_fetch", "h2d", "sparse_pull", "dispatch", "device_sync",
+          "allreduce", "pushpull", "optimizer_update")
 
 _lock = threading.Lock()
 _acc = {}            # phase -> accumulated seconds, current step

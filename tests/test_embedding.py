@@ -1,0 +1,381 @@
+"""Sparse embedding tier: PS-row-sharded tables (embedding.py), deduped
+bucketed pulls, pull/forward overlap, the remote gluon.contrib
+SparseEmbedding block, DLRM, and shard chaos/restore (ref:
+src/kvstore/kvstore_dist_server.h DataHandleRowSparse)."""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, embedding, gluon, nd, telemetry
+from incubator_mxnet_tpu.embedding import (ShardedEmbeddingService,
+                                           launch_local_fleet)
+from incubator_mxnet_tpu.ndarray.sparse import bucket_nnz
+from incubator_mxnet_tpu.ps import ParameterServer, PSClient
+from incubator_mxnet_tpu.telemetry import compilereg, ledger
+
+
+@pytest.fixture
+def telem():
+    telemetry.REGISTRY.reset()
+    ledger.reset()
+    compilereg.reset()
+    telemetry.enable()
+    yield telemetry
+    telemetry.disable()
+    telemetry.REGISTRY.reset()
+    ledger.reset()
+    compilereg.reset()
+
+
+def _fleet(num_shards, prefetch=False):
+    servers, svc = launch_local_fleet(num_shards)
+    if prefetch != svc._prefetch_on:
+        svc.close()
+        clients = [PSClient("127.0.0.1", s.port) for s in servers]
+        svc = ShardedEmbeddingService(clients=clients, prefetch=prefetch)
+    return servers, svc
+
+
+def _shutdown(servers, svc):
+    svc.close()
+    for s in servers:
+        try:
+            s.shutdown()
+        except Exception:
+            pass
+
+
+@pytest.fixture
+def fleet2():
+    servers, svc = _fleet(2)
+    yield svc
+    _shutdown(servers, svc)
+
+
+# -- sharded init -----------------------------------------------------------
+
+def test_init_deterministic_and_layout_independent():
+    """Row init depends only on (seed, global row id): reassembled tables
+    from a 1-shard and a 2-shard fleet are bit-identical, so resharding
+    the fleet never changes the model."""
+    tables = {}
+    for n in (1, 2):
+        servers, svc = _fleet(n)
+        try:
+            svc.table("emb", 11, 4, scale=0.1, seed=7)
+            tables[n] = svc.full_table("emb")
+        finally:
+            _shutdown(servers, svc)
+    assert tables[1].shape == (11, 4)
+    np.testing.assert_array_equal(tables[1], tables[2])
+    # non-degenerate draw, bounded by scale
+    assert np.abs(tables[1]).max() <= 0.1
+    assert np.unique(tables[1]).size > 11
+
+
+def test_table_idempotent_and_seed_sensitivity(fleet2):
+    t1 = fleet2.table("emb", 10, 4, seed=1)
+    assert fleet2.table("emb", 10, 4, seed=1) is t1
+    fleet2.table("other", 10, 4, seed=2)
+    assert not np.array_equal(fleet2.full_table("emb"),
+                              fleet2.full_table("other"))
+
+
+# -- pull plane -------------------------------------------------------------
+
+def test_pull_dedup_gather_matches_full_table(fleet2):
+    t = fleet2.table("emb", 23, 5, seed=3)
+    full = fleet2.full_table("emb")
+    raw = np.array([4, 19, 4, 0, 22, 19, 4], np.int64)
+    block, inv, n_uniq = t.pull(raw)
+    assert n_uniq == 4
+    np.testing.assert_array_equal(block[inv], full[raw])
+
+
+def test_pull_multi_table_single_plan(fleet2):
+    fleet2.table("a", 10, 3, seed=1)
+    fleet2.table("b", 16, 3, seed=2)
+    blocks, plan = fleet2.pull([("a", [1, 3, 1]), ("b", [0, 15])])
+    fa, fb = fleet2.full_table("a"), fleet2.full_table("b")
+    (na, inva, nna, _), (nb, invb, nnb, _) = plan
+    assert (na, nb) == ("a", "b") and (nna, nnb) == (2, 2)
+    np.testing.assert_array_equal(blocks[0][inva], fa[[1, 3, 1]])
+    np.testing.assert_array_equal(blocks[1][invb], fb[[0, 15]])
+
+
+def test_bucketed_pull_pads_to_grid(fleet2, monkeypatch):
+    monkeypatch.setenv("MXTPU_SPARSE_NNZ_BUCKETING", "1")
+    t = fleet2.table("emb", 100, 4, seed=5)
+    full = fleet2.full_table("emb")
+    raw = np.arange(20, dtype=np.int64)  # 20 uniques -> bucket 32
+    block, inv, n_uniq = t.pull(raw)
+    assert n_uniq == 20
+    assert block.shape[0] == bucket_nnz(20) == 32
+    np.testing.assert_array_equal(block[inv], full[raw])
+    # padding repeats the last unique row — never phantom row 0 traffic
+    np.testing.assert_array_equal(block[20:], np.tile(full[19], (12, 1)))
+
+
+def test_bucket_floor_is_sticky(fleet2, monkeypatch):
+    """Once a table pulled a 32-row bucket, later smaller batches keep the
+    32 shape: a uniq count hovering at a boundary must not flip the
+    gather shape back and forth (each flip-back is a retrace)."""
+    monkeypatch.setenv("MXTPU_SPARSE_NNZ_BUCKETING", "1")
+    t = fleet2.table("emb", 100, 4, seed=5)
+    big, _, _ = t.pull(np.arange(20, dtype=np.int64))
+    small, inv, n = t.pull(np.array([7, 7, 9], np.int64))
+    assert big.shape[0] == 32
+    assert small.shape[0] == 32 and n == 2
+    full = fleet2.full_table("emb")
+    np.testing.assert_array_equal(small[inv], full[[7, 7, 9]])
+
+
+def test_pull_registers_one_signature_per_bucket(fleet2, monkeypatch,
+                                                 telem):
+    monkeypatch.setenv("MXTPU_SPARSE_NNZ_BUCKETING", "1")
+    t = fleet2.table("emb", 200, 4, seed=5)
+    rng = np.random.RandomState(0)
+    for n in (17, 20, 25, 31, 19):  # all land in the 32 bucket
+        t.pull(rng.randint(0, 200, size=64, dtype=np.int64)[:n])
+    # the wire/gather shape signature is stable across varying nnz...
+    sigs = {e["signature"]
+            for e in compilereg.snapshot()["embedding.pull"]["entries"]}
+    assert len({s for s in sigs if "(32, 4)" in s}) == len(sigs)
+
+
+def test_unbucketed_pull_shape_tracks_nnz(fleet2, monkeypatch, telem):
+    monkeypatch.delenv("MXTPU_SPARSE_NNZ_BUCKETING", raising=False)
+    t = fleet2.table("emb", 200, 4, seed=5)
+    shapes = set()
+    for n in (17, 20, 25):
+        block, _, _ = t.pull(np.arange(n, dtype=np.int64))
+        shapes.add(block.shape[0])
+    assert shapes == {17, 20, 25}  # knob off: one shape (= one trace) per nnz
+
+
+# -- push plane -------------------------------------------------------------
+
+def test_push_sgd_matches_dense_reference(fleet2):
+    fleet2.table("emb", 13, 3, init="zeros")
+    fleet2.set_optimizer(mx.optimizer.SGD(learning_rate=0.5,
+                                          rescale_grad=1.0))
+    ref = np.zeros((13, 3), np.float32)
+    rng = np.random.RandomState(1)
+    for ids in ([0, 3, 12], [3, 7], [12]):
+        ids = np.asarray(ids, np.int64)
+        g = rng.randn(ids.size, 3).astype(np.float32)
+        fleet2.push_grads(grads=[("emb", ids, g)])
+        for i, r in enumerate(ids):
+            ref[r] -= 0.5 * g[i]
+    np.testing.assert_allclose(fleet2.full_table("emb"), ref,
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_push_lazy_momentum_only_touches_pushed_rows(fleet2):
+    """Server-side lazy sparse apply: momentum state advances only for
+    pushed rows; untouched rows stay bit-identical to init."""
+    fleet2.table("emb", 8, 2, seed=9)
+    before = fleet2.full_table("emb")
+    fleet2.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                                          rescale_grad=1.0))
+    g = np.ones((2, 2), np.float32)
+    fleet2.push_grads(grads=[("emb", np.array([1, 6]), g)])
+    fleet2.push_grads(grads=[("emb", np.array([1, 6]), g)])
+    after = fleet2.full_table("emb")
+    untouched = [r for r in range(8) if r not in (1, 6)]
+    np.testing.assert_array_equal(after[untouched], before[untouched])
+    # two momentum steps: v1 = g, v2 = 0.9 g + g -> total lr*(1 + 1.9)
+    np.testing.assert_allclose(after[[1, 6]], before[[1, 6]] - 0.1 * 2.9,
+                               rtol=1e-6)
+
+
+def test_per_key_and_batched_paths_agree(fleet2, telem):
+    fleet2.table("emb", 50, 4, seed=4)
+    raw = np.array([1, 1, 8, 49, 8, 30], np.int64)
+    b1, i1, n1 = fleet2.pull_per_key("emb", raw)
+    (b2,), plan = fleet2.pull([("emb", raw)])
+    _, i2, n2, _ = plan[0]
+    np.testing.assert_array_equal(b1[i1], b2[i2])
+    assert n1 == n2 == 4
+    fam = telemetry.REGISTRY.get(embedding.PULL_RPCS_TOTAL)
+    assert fam.value(path="per_key") == 2   # one RPC per shard per table
+    assert fam.value(path="batched") == 2   # one RPC per shard, all tables
+
+
+# -- pull/forward overlap ---------------------------------------------------
+
+def test_prefetch_bit_identical_to_blocking(monkeypatch):
+    """The ordered worker queue preserves push(N) < pull(N+1): the same
+    pull/push trace lands on bit-identical tables with overlap on/off."""
+    finals = {}
+    for prefetch in (False, True):
+        servers, svc = _fleet(2, prefetch=prefetch)
+        try:
+            t = svc.table("emb", 40, 4, seed=11)
+            svc.set_optimizer(mx.optimizer.SGD(learning_rate=0.2,
+                                               rescale_grad=1.0))
+            rng = np.random.RandomState(2)
+            batches = [rng.randint(0, 40, size=12).astype(np.int64)
+                       for _ in range(5)]
+            if prefetch:
+                svc.prefetch([("emb", batches[0])])
+            for i, raw in enumerate(batches):
+                block, inv, n = t.pull(raw)
+                uniq = np.unique(raw)
+                g = block[:n] * 0.1  # grad depends on pulled values
+                svc.push_grads(grads=[("emb", uniq, g)])
+                if prefetch and i + 1 < len(batches):
+                    svc.prefetch([("emb", batches[i + 1])])
+            svc.flush()
+            finals[prefetch] = svc.full_table("emb")
+        finally:
+            _shutdown(servers, svc)
+    np.testing.assert_array_equal(finals[False], finals[True])
+
+
+def test_prefetch_hit_counter_and_flush(telem):
+    servers, svc = _fleet(2, prefetch=True)
+    try:
+        t = svc.table("emb", 20, 4, seed=1)
+        raw = np.arange(6, dtype=np.int64)
+        svc.prefetch([("emb", raw)])
+        svc.flush()  # prefetch definitely completed -> "ready" hit
+        block, inv, n = t.pull(raw)
+        np.testing.assert_array_equal(block[inv],
+                                      svc.full_table("emb")[raw])
+        fam = telemetry.REGISTRY.get(embedding.PREFETCH_HITS_TOTAL)
+        assert sum(c.value for _l, c in fam.series()) == 1
+    finally:
+        _shutdown(servers, svc)
+
+
+def test_worker_error_surfaces_on_pull():
+    servers, svc = _fleet(1, prefetch=True)
+    try:
+        svc.table("emb", 8, 2)
+        svc._jobs.put(("push", [("nope", np.array([0]),
+                                 np.zeros((1, 2), np.float32))]))
+        with pytest.raises(RuntimeError, match="nope"):
+            svc.flush()
+    finally:
+        _shutdown(servers, svc)
+
+
+# -- gluon block + autograd -------------------------------------------------
+
+def test_remote_sparse_embedding_exact_grads():
+    """d/dw sum(emb(x)^2) = 2*count*w on touched rows; SGD on the server
+    applies it, untouched rows stay bit-identical."""
+    servers, svc = _fleet(2)
+    try:
+        lr = 0.25
+        svc.set_optimizer(mx.optimizer.SGD(learning_rate=lr,
+                                           rescale_grad=1.0))
+        layer = gluon.contrib.nn.SparseEmbedding(
+            17, 3, service=svc, table="emb", seed=6)
+        before = svc.full_table("emb")
+        x = nd.array(np.array([2, 5, 2, 11], np.int64))
+        with autograd.record():
+            y = layer(x)
+            loss = (y * y).sum()
+        loss.backward()
+        svc.push_grads()
+        after = svc.full_table("emb")
+        counts = {2: 2, 5: 1, 11: 1}
+        for r in range(17):
+            c = counts.get(r, 0)
+            np.testing.assert_allclose(
+                after[r], before[r] * (1.0 - 2.0 * lr * c),
+                rtol=1e-6, atol=1e-7)
+    finally:
+        _shutdown(servers, svc)
+
+
+def test_local_sparse_embedding_unchanged():
+    """service=None keeps the PR-era local block: a real Parameter with
+    row_sparse grads, no PS traffic."""
+    layer = gluon.contrib.nn.SparseEmbedding(10, 4)
+    layer.initialize()
+    x = nd.array(np.array([1, 3, 1], np.int64))
+    with autograd.record():
+        y = layer(x)
+        y.sum().backward()
+    assert y.shape == (3, 4)
+    assert layer.weight.grad_stype == "row_sparse"
+
+
+def test_dlrm_trains_end_to_end(telem):
+    servers, svc = _fleet(2)
+    try:
+        mx.random.seed(42)
+        from incubator_mxnet_tpu.models import DLRM
+
+        net = DLRM([30, 47], num_dense=3, embed_dim=4,
+                             bottom_units=(8,), top_units=(8,),
+                             service=svc, seed=5)
+        net.initialize(mx.init.Xavier())
+        svc.set_optimizer(mx.optimizer.SGD(learning_rate=0.05))
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05})
+        tr.attach_sparse_service(svc)
+        loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+        rng = np.random.RandomState(3)
+        t0 = np.concatenate([svc.full_table("dlrm_f0"),
+                             svc.full_table("dlrm_f1")])
+        for _ in range(3):
+            dense = nd.array(rng.randn(8, 3).astype(np.float32))
+            ids = rng.randint(0, 30, size=(8, 2)).astype(np.int64)
+            lab = nd.array(rng.randint(0, 2, size=(8, 1)).astype(np.float32))
+            with autograd.record():
+                out = net(dense, ids)
+                loss = loss_fn(out, lab).mean()
+            loss.backward()
+            tr.step(1)
+            assert np.isfinite(float(loss.asnumpy()))
+        svc.flush()
+        t1 = np.concatenate([svc.full_table("dlrm_f0"),
+                             svc.full_table("dlrm_f1")])
+        assert not np.array_equal(t0, t1)  # embeddings actually trained
+        # the worker never materialized a table: live embedding bytes are
+        # O(batch uniques), far under one table's footprint
+        assert 0 < ledger.live_bytes(embedding.LEDGER_ROLE) < t0.nbytes
+    finally:
+        _shutdown(servers, svc)
+
+
+# -- chaos: shard loss + restore --------------------------------------------
+
+def test_snapshot_restore_shard_bit_identical(tmp_path):
+    servers, svc = _fleet(2)
+    try:
+        svc.table("emb", 19, 4, seed=8)
+        svc.set_optimizer(mx.optimizer.SGD(learning_rate=0.1,
+                                           rescale_grad=1.0))
+        rng = np.random.RandomState(5)
+        for _ in range(3):
+            ids = np.unique(rng.randint(0, 19, size=8)).astype(np.int64)
+            svc.push_grads(
+                grads=[("emb", ids,
+                        rng.randn(ids.size, 4).astype(np.float32))])
+        svc.snapshot(str(tmp_path))
+        reference = svc.full_table("emb")
+
+        # kill shard 0 mid-run; bootstrap a replacement from the manifest-
+        # verified snapshot (PR-6 state-transfer contract)
+        servers[0].shutdown()
+        repl = ParameterServer(num_workers=1, host="127.0.0.1", port=0)
+        servers.append(repl)
+        svc.restore_shard(0, str(tmp_path),
+                          PSClient("127.0.0.1", repl.port))
+        np.testing.assert_array_equal(svc.full_table("emb"), reference)
+
+        # the replacement keeps TRAINING (optimizer re-shipped on restore)
+        g = np.ones((1, 4), np.float32)
+        svc.push_grads(grads=[("emb", np.array([0], np.int64), g)])
+        np.testing.assert_allclose(svc.full_table("emb")[0],
+                                   reference[0] - 0.1, rtol=1e-6)
+    finally:
+        _shutdown(servers, svc)

@@ -147,3 +147,132 @@ def test_heartbeat_dead_node_detection(tmp_path):
         assert hb.num_dead() == 2
     finally:
         hb.stop()
+
+
+# -- row-sparse gradient plumbing -------------------------------------------
+# _allreduce_row_sparse only moves (row_id, row) pairs across DCN; the
+# three process_allgather legs arrive in a fixed order (nnz, padded
+# indices, padded rows), so a counter-driven fake can stand in for a
+# second worker.
+
+def _fake_allgather(other_idx, other_dat):
+    other_idx = np.asarray(other_idx, np.int64)
+    other_dat = np.asarray(other_dat, np.float32)
+    state = {"calls": 0, "max_nnz": None}
+
+    def fake(arr):
+        arr = np.asarray(arr)
+        leg = state["calls"] % 3
+        state["calls"] += 1
+        if leg == 0:  # nnz
+            state["max_nnz"] = max(int(arr[0]), other_idx.shape[0])
+            return np.stack(
+                [arr, np.array([other_idx.shape[0]], np.int64)])
+        m = state["max_nnz"]
+        if leg == 1:  # indices, padded with -1
+            p = np.full((m,), -1, np.int64)
+            p[: other_idx.shape[0]] = other_idx
+            return np.stack([arr, p])
+        p = np.zeros((m,) + other_dat.shape[1:], other_dat.dtype)
+        p[: other_dat.shape[0]] = other_dat
+        return np.stack([arr, p])
+
+    return fake
+
+
+def _rsp(idx, dat, shape):
+    from incubator_mxnet_tpu.ndarray import sparse
+
+    return sparse.RowSparseNDArray(
+        nd.array(np.asarray(dat, np.float32)),
+        nd.array(np.asarray(idx, np.int64)), shape)
+
+
+def _allreduce_with_peer(monkeypatch, grad, peer_idx, peer_dat):
+    import jax.experimental.multihost_utils as mhu
+
+    monkeypatch.setattr(mhu, "process_allgather",
+                        _fake_allgather(peer_idx, peer_dat))
+    # the method reads no state off self — call it unbound
+    return kvstore.KVStoreDist._allreduce_row_sparse(None, grad)
+
+
+def test_allreduce_row_sparse_overlapping_ids(monkeypatch):
+    g = _rsp([1, 3], [[1.0, 2.0], [3.0, 4.0]], (6, 2))
+    out = _allreduce_with_peer(monkeypatch, g,
+                               [3, 5], [[10.0, 10.0], [20.0, 20.0]])
+    dense = np.zeros((6, 2), np.float32)
+    dense[1] += [1, 2]
+    dense[3] += [3, 4]
+    dense[3] += [10, 10]
+    dense[5] += [20, 20]
+    assert_almost_equal(out.todense().asnumpy(), dense, rtol=1e-6)
+
+
+def test_allreduce_row_sparse_disjoint_ids(monkeypatch):
+    g = _rsp([0], [[1.0, 1.0, 1.0]], (4, 3))
+    out = _allreduce_with_peer(monkeypatch, g, [2], [[5.0, 5.0, 5.0]])
+    dense = out.todense().asnumpy()
+    assert (dense[0] == 1).all() and (dense[2] == 5).all()
+    assert (dense[[1, 3]] == 0).all()
+
+
+def test_allreduce_row_sparse_empty_worker(monkeypatch):
+    """A worker whose batch touched zero rows still participates: its pad
+    rows carry index -1 and vanish on receive."""
+    g = _rsp(np.zeros((0,), np.int64), np.zeros((0, 2), np.float32), (5, 2))
+    out = _allreduce_with_peer(monkeypatch, g, [4], [[7.0, 8.0]])
+    dense = out.todense().asnumpy()
+    assert (dense[4] == [7, 8]).all() and (dense[:4] == 0).all()
+
+
+def test_allreduce_row_sparse_matches_dense_sum(monkeypatch):
+    rng = np.random.RandomState(3)
+    shape = (9, 4)
+    i0 = np.array([0, 2, 7], np.int64)
+    d0 = rng.randn(3, 4).astype(np.float32)
+    i1 = np.array([2, 5, 7, 8], np.int64)
+    d1 = rng.randn(4, 4).astype(np.float32)
+    out = _allreduce_with_peer(monkeypatch, _rsp(i0, d0, shape), i1, d1)
+    ref = np.zeros(shape, np.float32)
+    ref[i0] += d0
+    ref[i1] += d1
+    assert_almost_equal(out.todense().asnumpy(), ref, rtol=1e-6)
+
+
+def test_apply_sparse_push_updater_lazy_rows():
+    from incubator_mxnet_tpu import optimizer as opt
+
+    kv = kvstore.create("local")
+    kv.init("emb", nd.ones((4, 3)))
+    kv.set_optimizer(opt.SGD(learning_rate=0.5, rescale_grad=1.0))
+    kv.push("emb", _rsp([1, 3], np.ones((2, 3)), (4, 3)))
+    out = nd.zeros((4, 3))
+    kv.pull("emb", out=out)
+    w = out.asnumpy()
+    assert_almost_equal(w[[1, 3]], np.full((2, 3), 0.5), rtol=1e-6)
+    assert (w[[0, 2]] == 1).all()  # untouched rows: lazy apply skipped them
+
+
+def test_apply_sparse_push_no_updater_accumulates():
+    kv = kvstore.create("local")
+    kv.init("emb", nd.ones((3, 2)))
+    kv.push("emb", _rsp([0, 2], [[1.0, 1.0], [2.0, 2.0]], (3, 2)))
+    out = nd.zeros((3, 2))
+    kv.pull("emb", out=out)
+    assert_almost_equal(out.asnumpy(),
+                        np.array([[2, 2], [1, 1], [3, 3]], np.float32),
+                        rtol=1e-6)
+
+
+def test_apply_sparse_push_empty_nnz_is_noop():
+    from incubator_mxnet_tpu import optimizer as opt
+
+    kv = kvstore.create("local")
+    kv.init("emb", nd.ones((4, 2)))
+    kv.set_optimizer(opt.SGD(learning_rate=0.5, rescale_grad=1.0))
+    kv.push("emb", _rsp(np.zeros((0,), np.int64),
+                        np.zeros((0, 2), np.float32), (4, 2)))
+    out = nd.zeros((4, 2))
+    kv.pull("emb", out=out)
+    assert (out.asnumpy() == 1).all()

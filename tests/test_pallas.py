@@ -302,3 +302,54 @@ def test_flash_decode_jits_with_traced_n_valid():
     a = np.asarray(f(jnp.asarray(5, jnp.int32)))
     b = np.asarray(f(jnp.asarray(30, jnp.int32)))  # same compiled kernel
     assert a.shape == (B, H, D) and not np.allclose(a, b)
+
+
+# -- transformer flash remainder handling -----------------------------------
+
+def test_transformer_flash_causal_remainder_padded_not_dense():
+    """A causal T that doesn't tile into blocks pads into the Pallas path
+    (exact: query t < T never attends a padded key >= T) — it must match
+    dense WITHOUT registering a dense fallback."""
+    from incubator_mxnet_tpu import telemetry
+    from incubator_mxnet_tpu.models import transformer as tfm
+
+    rng = np.random.RandomState(0)
+    q, k, v = (rng.randn(2, 40, 2, 8).astype(np.float32) for _ in range(3))
+    telemetry.REGISTRY.reset()
+    telemetry.enable()
+    try:
+        out = tfm._flash_attention_fn(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v), causal=True, block=16)
+        ref = tfm._dense_attention(jnp.asarray(q), jnp.asarray(k),
+                                   jnp.asarray(v), causal=True)
+        assert out.shape == ref.shape == (2, 40, 2, 8)
+        assert float(jnp.abs(out - ref).max()) < 2e-4
+        fam = telemetry.REGISTRY.get(tfm.FLASH_DENSE_FALLBACKS_TOTAL)
+        assert fam is None or sum(c.value for _l, c in fam.series()) == 0
+    finally:
+        telemetry.disable()
+        telemetry.REGISTRY.reset()
+
+
+def test_transformer_flash_non_causal_remainder_counts_fallback():
+    """Non-causal remainders still take the dense path (padded keys would
+    be visible to every query) — but the fallback is now COUNTED."""
+    from incubator_mxnet_tpu import telemetry
+    from incubator_mxnet_tpu.models import transformer as tfm
+
+    rng = np.random.RandomState(1)
+    q, k, v = (rng.randn(1, 24, 2, 8).astype(np.float32) for _ in range(3))
+    telemetry.REGISTRY.reset()
+    telemetry.enable()
+    try:
+        out = tfm._flash_attention_fn(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v), causal=False, block=16)
+        ref = tfm._dense_attention(jnp.asarray(q), jnp.asarray(k),
+                                   jnp.asarray(v), causal=False)
+        assert float(jnp.abs(out - ref).max()) < 2e-4
+        fam = telemetry.REGISTRY.get(tfm.FLASH_DENSE_FALLBACKS_TOTAL)
+        assert fam.value(site="models.transformer",
+                         reason="non_causal_remainder") == 1
+    finally:
+        telemetry.disable()
+        telemetry.REGISTRY.reset()

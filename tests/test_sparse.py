@@ -385,3 +385,89 @@ def test_sparse_then_dense_grad_keeps_parameter_buffer():
     assert float(np.abs(g.asnumpy()).sum()) > 0
     emb.weight.zero_grad()
     assert float(np.abs(emb.weight.grad().asnumpy()).sum()) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# nnz bucketing (MXTPU_SPARSE_NNZ_BUCKETING)
+
+def test_bucket_nnz_grid():
+    """Smallest power-of-2 >= n with a floor of 16 — the single grid every
+    consumer (kernels, embedding pulls, kvstore row pulls) shares."""
+    assert sparse.bucket_nnz(0) == 16
+    assert sparse.bucket_nnz(1) == 16
+    assert sparse.bucket_nnz(16) == 16
+    assert sparse.bucket_nnz(17) == 32
+    assert sparse.bucket_nnz(32) == 32
+    assert sparse.bucket_nnz(33) == 64
+    assert sparse.bucket_nnz(1000) == 1024
+    prev = 0
+    for n in range(1, 300):
+        b = sparse.bucket_nnz(n)
+        assert b >= max(n, 16) and (b & (b - 1)) == 0  # power of two
+        assert b >= prev  # monotone in n
+        prev = b
+
+
+def test_pad_row_ids_knob_off_passthrough(monkeypatch):
+    monkeypatch.delenv("MXTPU_SPARSE_NNZ_BUCKETING", raising=False)
+    ids = np.array([5, 2, 9], np.int32)
+    padded, n = sparse.pad_row_ids(ids)
+    assert n == 3 and padded.shape == (3,) and padded.dtype == np.int64
+    np.testing.assert_array_equal(padded, [5, 2, 9])
+
+
+def test_pad_row_ids_pads_with_repeat(monkeypatch):
+    monkeypatch.setenv("MXTPU_SPARSE_NNZ_BUCKETING", "1")
+    padded, n = sparse.pad_row_ids(np.arange(20, dtype=np.int64))
+    assert n == 20 and padded.shape == (32,)
+    # repeats the LAST id — a padded pull fetches a row already in flight,
+    # never phantom row-0 traffic
+    assert (padded[20:] == 19).all()
+    # exact bucket size and empty input stay un-padded
+    exact, n16 = sparse.pad_row_ids(np.arange(16, dtype=np.int64))
+    assert n16 == 16 and exact.shape == (16,)
+    empty, n0 = sparse.pad_row_ids(np.zeros((0,), np.int64))
+    assert n0 == 0 and empty.shape == (0,)
+
+
+def test_pad_row_ids_force_overrides_knob(monkeypatch):
+    monkeypatch.delenv("MXTPU_SPARSE_NNZ_BUCKETING", raising=False)
+    padded, n = sparse.pad_row_ids(np.arange(5, dtype=np.int64), force=True)
+    assert n == 5 and padded.shape == (16,)
+
+
+def test_bucketing_one_trace_per_bucket(monkeypatch):
+    """The retrace contract: repeated pulls with varying nnz inside one
+    bucket register ONE shape signature (zero steady-state retraces);
+    with the knob off every distinct nnz is its own signature."""
+    from incubator_mxnet_tpu import telemetry
+    from incubator_mxnet_tpu.embedding import launch_local_fleet
+    from incubator_mxnet_tpu.telemetry import compilereg
+
+    telemetry.REGISTRY.reset()
+    compilereg.reset()
+    telemetry.enable()
+    try:
+        for knob, expect_sigs in (("1", 1), ("0", 4)):
+            monkeypatch.setenv("MXTPU_SPARSE_NNZ_BUCKETING", knob)
+            compilereg.reset()
+            servers, svc = launch_local_fleet(1)
+            try:
+                t = svc.table("emb", 64, 4, seed=1)
+                for n in (17, 22, 25, 31):  # one 32 bucket, four raw nnz
+                    t.pull(np.arange(n, dtype=np.int64))
+                    t.pull(np.arange(n, dtype=np.int64))  # repeat: no new sig
+                snap = compilereg.snapshot()["embedding.pull"]
+                # inv length varies with request size; key on the block
+                # (wire/gather) shape the bucketing is meant to stabilize
+                blocks = {e["signature"].split("'block', ")[1].split(")")[0]
+                          for e in snap["entries"]}
+                assert len(blocks) == expect_sigs, (knob, blocks)
+            finally:
+                svc.close()
+                for s in servers:
+                    s.shutdown()
+    finally:
+        telemetry.disable()
+        telemetry.REGISTRY.reset()
+        compilereg.reset()
