@@ -569,7 +569,8 @@ run_serving() {
     echo "=== serving tier (paged decode engine + steady-state retrace gate) ==="
     # engine smoke: kernel equivalence, allocator, token-identity vs
     # generate(), and the steady-state zero-retrace assertions
-    JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
+    JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \
+        tests/test_serving_observability.py -q
     # seeded mixed-length trace through the continuous-batching engine;
     # the gate zero-tolerates steady-state compiles/retraces and dense
     # decode fallbacks (wall-clock throughput/latency are report-only)
@@ -592,7 +593,77 @@ run_serving() {
         cat "$sv_dir/inject.log" >&2
         exit 1
     fi
-    echo "serving tier: trace completed, zero steady-state retraces/fallbacks, seeded regression rejected"
+    # -- serving observatory leg -----------------------------------------
+    # traced rerun of the same seeded trace: every request must yield a
+    # well-formed lifecycle lane, and the --requests report's TTFT
+    # figures must agree with the telemetry histogram dump
+    JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
+        MXTPU_COMPILE_CACHE_DIR="$sv_dir/cache" \
+        MXTPU_TRACE_DIR="$sv_dir/traces" \
+        MXTPU_FLIGHT_RECORDER_DIR="$sv_dir/traces" \
+        python tools/bench_transformer.py --serving \
+        --d-model 32 --n-layers 2 --n-heads 2 --d-ff 64 \
+        --vocab 64 --seq 64 --serving-requests 12 --slots 3 \
+        --page-size 8 --metrics-out "$sv_dir/metrics.json" \
+        > "$sv_dir/serving_traced.json"
+    python tools/trace_merge.py "$sv_dir/traces" \
+        -o "$sv_dir/timeline.json" --requests \
+        --requests-json "$sv_dir/requests.json" --check
+    SV_DIR="$sv_dir" python - <<'EOF'
+import glob, json, os
+sv = os.environ["SV_DIR"]
+# 12 timed requests plus one bucket-warmup request per prefill bucket
+report = json.load(open(os.path.join(sv, "requests.json")))
+assert report["count"] >= 12, f"expected >=12 request lanes, got {report['count']}"
+hist = json.load(open(os.path.join(sv, "metrics.json")))
+[series] = hist["metrics"]["mxtpu_serving_ttft_seconds"]["series"]
+ttfts = [row["ttft_s"] for row in report["requests"]]
+assert len(ttfts) == series["count"], (
+    f"--requests report has {len(ttfts)} TTFTs, histogram observed "
+    f"{series['count']}")
+assert abs(sum(ttfts) - series["sum"]) <= 1e-6 * max(1.0, series["sum"]), (
+    f"--requests TTFT sum {sum(ttfts)} != histogram sum {series['sum']}")
+lat = [row["latency_s"] for row in report["requests"]]
+[lseries] = hist["metrics"]["mxtpu_serving_request_seconds"]["series"]
+assert abs(sum(lat) - lseries["sum"]) <= 1e-6 * max(1.0, lseries["sum"])
+dumps = glob.glob(os.path.join(sv, "traces", "flightrec-*"))
+assert not dumps, f"clean traced run wrote post-mortem dumps: {dumps}"
+print(f"serving observability: {report['count']} request lanes check "
+      "out; trace TTFT/latency agree with histograms; no spurious SLO "
+      "dumps")
+EOF
+    # negative self-test: a seeded 1000x latency inflation against a
+    # 250ms TTFT objective MUST walk ok->warning->breach and write
+    # exactly ONE post-mortem dump carrying request timelines
+    mkdir -p "$sv_dir/breach"
+    JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
+        MXTPU_COMPILE_CACHE_DIR="$sv_dir/cache" \
+        MXTPU_FLIGHT_RECORDER_DIR="$sv_dir/breach" \
+        MXTPU_SLO_TTFT_P99=0.25 MXTPU_SLO_WINDOW_SHORT=4 \
+        MXTPU_SLO_WINDOW_LONG=8 MXTPU_SLO_MIN_SAMPLES=4 \
+        python tools/bench_transformer.py --serving \
+        --d-model 32 --n-layers 2 --n-heads 2 --d-ff 64 \
+        --vocab 64 --seq 64 --serving-requests 12 --slots 3 \
+        --page-size 8 --inject-latency 1000 \
+        > "$sv_dir/breach/serving.json"
+    SV_DIR="$sv_dir" python - <<'EOF'
+import glob, json, os
+sv = os.environ["SV_DIR"]
+out = json.load(open(os.path.join(sv, "breach", "serving.json")))
+assert out["slo"]["ttft"] == "breach", (
+    f"seeded latency inflation did not breach the TTFT SLO: {out['slo']}")
+assert out["slo_breaches"]["ttft"] == 1, out["slo_breaches"]
+dumps = glob.glob(os.path.join(sv, "breach", "flightrec-*slo-breach-ttft*"))
+assert len(dumps) == 1, (
+    f"expected exactly one slo-breach dump, got {dumps}")
+payload = json.load(open(dumps[0]))
+assert payload["request_timelines"], "breach dump carries no timelines"
+assert {"ttft_s", "latency_s", "finish"} <= set(
+    payload["request_timelines"][0])
+print("serving observability: seeded breach detected, one post-mortem "
+      "dump with request timelines")
+EOF
+    echo "serving tier: trace completed, zero steady-state retraces/fallbacks, seeded regression rejected, observatory legs green"
 }
 
 run_nightly() {

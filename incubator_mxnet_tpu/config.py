@@ -443,6 +443,58 @@ register_knob("MXTPU_PREFILL_BUCKETS", "", str,
               "length). Empty (default) uses powers of two from 16 up "
               "to the model's max_len.")
 
+# serving SLOs (telemetry/slo.py) — a threshold of 0 disables that
+# objective; when every threshold is 0 the serving engine attaches no
+# monitor at all (zero per-request cost)
+register_knob("MXTPU_SLO_TTFT_P99", 0.0, float,
+              "Serving SLO: time-to-first-token ceiling in seconds. A "
+              "finished request whose TTFT exceeds this burns error "
+              "budget; 0 disables the objective.")
+register_knob("MXTPU_SLO_QUEUE_WAIT_P99", 0.0, float,
+              "Serving SLO: queue-wait (submit to slot admission) "
+              "ceiling in seconds; 0 disables the objective.")
+register_knob("MXTPU_SLO_REQUEST_P99", 0.0, float,
+              "Serving SLO: end-to-end request latency ceiling in "
+              "seconds; 0 disables the objective.")
+register_knob("MXTPU_SLO_GOODPUT_MIN", 0.0, float,
+              "Serving SLO: goodput floor in [0, 1] — the fraction of "
+              "processed tokens that were neither prefill padding nor "
+              "spent on evicted requests. Samples BELOW the floor burn "
+              "budget; 0 disables the objective.")
+register_knob("MXTPU_SLO_BUDGET", 0.01, float,
+              "Error budget for every SLO objective: the fraction of "
+              "requests allowed to violate their threshold. Burn rate "
+              "= bad_fraction / budget (burn 1.0 spends the budget "
+              "exactly).")
+register_knob("MXTPU_SLO_WINDOW_SHORT", 32, int,
+              "Short burn-rate window in SAMPLES (finished requests). "
+              "Count-based, not wall-clock, so burn math is "
+              "deterministic under test.")
+register_knob("MXTPU_SLO_WINDOW_LONG", 128, int,
+              "Long burn-rate window in samples; breach requires BOTH "
+              "windows over MXTPU_SLO_BREACH_BURN (the classic "
+              "multi-window guard against paging on a blip).")
+register_knob("MXTPU_SLO_MIN_SAMPLES", 8, int,
+              "Samples an objective must see before the state machine "
+              "may leave 'ok' (cold-start guard).")
+register_knob("MXTPU_SLO_WARN_BURN", 1.0, float,
+              "Short-window burn rate at which an objective enters "
+              "'warning'.")
+register_knob("MXTPU_SLO_BREACH_BURN", 10.0, float,
+              "Burn rate both windows must reach for 'breach' (bumps "
+              "mxtpu_slo_breaches_total and writes one post-mortem "
+              "dump); the objective re-arms when the short window "
+              "drops back below this.")
+register_knob("MXTPU_SLO_DUMP_TIMELINES", 32, int,
+              "Finished-request timelines the serving engine retains "
+              "for the breach post-mortem dump (last N).")
+register_knob("MXTPU_DEBUG_ENDPOINTS", False, bool,
+              "Serve registered /debug/* JSON endpoints (e.g. "
+              "/debug/engine) from the telemetry HTTP server. Off by "
+              "default: introspection snapshots expose request ids and "
+              "queue contents, which not every /metrics scraper should "
+              "see.")
+
 # contrib / compatibility shims
 register_knob("MXTPU_USE_TENSORRT", False, bool,
               "TensorRT-compat preference flag (contrib.tensorrt). Purely "

@@ -37,6 +37,11 @@ import jax.numpy as jnp
 
 from .. import compile_cache, config, telemetry
 from ..models import transformer as _tfm
+from ..telemetry import compilereg
+from ..telemetry import distributed as _dtrace
+from ..telemetry import exporters as _exporters
+from ..telemetry import recorder as _recorder
+from ..telemetry import slo as _slo
 from .pages import PageAllocator
 
 __all__ = ["Request", "RequestResult", "ServingEngine"]
@@ -50,6 +55,20 @@ TOKENS_TOTAL = "mxtpu_serving_tokens_total"
 REQUEST_SECONDS = "mxtpu_serving_request_seconds"
 QUEUE_WAIT_SECONDS = "mxtpu_serving_queue_wait_seconds"
 TTFT_SECONDS = "mxtpu_serving_ttft_seconds"
+OLDEST_QUEUED = "mxtpu_serving_oldest_queued_seconds"
+ADMISSION_BLOCKED = "mxtpu_serving_admission_blocked_total"
+WASTED_TOKENS = "mxtpu_serving_wasted_tokens_total"
+GOODPUT = "mxtpu_serving_goodput"
+
+# per-request lifecycle record names (registered in telemetry/names.py);
+# emitted straight through distributed.record_span — zero-cost when
+# tracing is off, and rendered as one lane per request by
+# tools/trace_merge.py --requests
+REQ_SPAN = "serving.request"
+REQ_QUEUED_SPAN = "serving.request.queued"
+REQ_PREFILL_SPAN = "serving.request.prefill"
+REQ_DECODE_SPAN = "serving.request.decode"
+REQ_STEP_KIND = "req_step"  # batched decode-progress record, one per STEP
 
 # sub-ms to minutes: decode steps are ms-scale, queued requests can wait
 _LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
@@ -67,13 +86,15 @@ class Request:
     eos_id: int | None = None
     submitted_at: float = 0.0
     admitted_at: float = 0.0
+    ttft_s: float = 0.0       # set at prefill; 0 until admitted
+    trace: dict | None = None  # per-request trace context (tracing on)
 
 
 @dataclasses.dataclass
 class RequestResult:
     request_id: int
     tokens: list  # generated continuation (includes EOS when hit)
-    finish_reason: str  # "eos" | "length"
+    finish_reason: str  # "eos" | "length" | "evicted" | "cancelled"
     prompt_len: int
     queue_wait_s: float
     latency_s: float
@@ -106,7 +127,8 @@ class ServingEngine:
     """
 
     def __init__(self, params, cfg, *, slots=None, page_size=None,
-                 num_pages=None, max_len=None, clock=time.monotonic):
+                 num_pages=None, max_len=None, clock=time.monotonic,
+                 slo=None):
         self.params = params
         self.cfg = cfg
         self.page_size = int(page_size or config.get("MXTPU_PAGE_SIZE"))
@@ -137,6 +159,21 @@ class ServingEngine:
         self._results: dict[int, RequestResult] = {}
         self._ids = itertools.count()
         self.steps = 0
+
+        # host-side goodput accounting (source of truth independent of
+        # whether the metrics registry is enabled): device token-position
+        # kinds, plus tokens spent on requests later evicted mid-stream
+        self._tokens = {"prefill": 0, "decode": 0, "pad": 0}
+        self._wasted_evicted = 0
+        # last-N finished-request timelines, embedded in SLO breach dumps
+        # and the /debug/engine snapshot
+        self._timelines: deque = deque(
+            maxlen=max(1, int(config.get("MXTPU_SLO_DUMP_TIMELINES"))))
+        if slo is None:
+            slo = _slo.from_env(timelines=self.recent_timelines)
+        self.slo = slo or None
+        _exporters.register_debug_handler("/debug/engine",
+                                          self.debug_snapshot)
 
         # donation frees the old pool the moment the step runs; CPU
         # buffers aren't donatable (jax warns and copies anyway)
@@ -189,9 +226,20 @@ class ServingEngine:
                 f"request needs {need} pages but the pool only has "
                 f"{self.allocator.capacity}")
         rid = next(self._ids)
-        self._queue.append(Request(rid, prompt, int(max_new_tokens),
-                                   eos_id, submitted_at=self._clock()))
+        req = Request(rid, prompt, int(max_new_tokens), eos_id,
+                      submitted_at=self._clock())
+        if _dtrace.trace_active():
+            # trace context is born HERE: tid groups the whole lifecycle,
+            # sid is the root "serving.request" span every stage parents
+            # under, ns_submit anchors engine-clock deltas to wall time
+            req.trace = {"tid": _dtrace.new_id(), "sid": _dtrace.new_id(),
+                         "ns_submit": time.time_ns(),
+                         "clk_submit": req.submitted_at}
+        self._queue.append(req)
         telemetry.set_gauge(QUEUE_DEPTH, len(self._queue))
+        telemetry.set_gauge(
+            OLDEST_QUEUED,
+            self._clock() - self._queue[0].submitted_at)
         return rid
 
     def step(self):
@@ -274,11 +322,13 @@ class ServingEngine:
         while self._queue:
             slot = self._free_slot()
             if slot is None:
+                telemetry.inc(ADMISSION_BLOCKED, reason="slots")
                 return
             req = self._queue[0]
             total = req.prompt.size + req.max_new_tokens
             pages = self.allocator.alloc(self.allocator.pages_needed(total))
             if pages is None:
+                telemetry.inc(ADMISSION_BLOCKED, reason="pages")
                 return  # backpressure: wait for an eviction
             self._queue.popleft()
             req.admitted_at = self._clock()
@@ -286,6 +336,12 @@ class ServingEngine:
                               req.admitted_at - req.submitted_at,
                               buckets=_LATENCY_BUCKETS)
             telemetry.set_gauge(QUEUE_DEPTH, len(self._queue))
+            if req.trace is not None:
+                self._emit_request_record(
+                    REQ_QUEUED_SPAN, req.trace, ts=req.trace["ns_submit"],
+                    dur_s=req.admitted_at - req.submitted_at,
+                    pid=req.trace["sid"],
+                    extra={"request": req.request_id})
             self._prefill_into(slot, req, pages)
 
     def _prefill_into(self, slot, req, pages):
@@ -295,15 +351,35 @@ class ServingEngine:
             self.allocator.table_row(pages, self.table_width), np.int32)
         prompt = np.zeros((1, T_b), np.int32)
         prompt[0, :T_p] = req.prompt
+        clk_prefill = self._clock()
         with telemetry.span("serving.prefill", request=req.request_id,
                             bucket=T_b):
             tok, self.paged = self._prefills[T_b](
                 self.params, self.paged, jnp.asarray(prompt),
                 jnp.asarray([T_p], np.int32), jnp.asarray(row[None]))
         first = int(np.asarray(tok)[0])
+        clk_first = self._clock()
+        pad = T_b - T_p
+        self._tokens["prefill"] += T_p
         telemetry.inc(TOKENS_TOTAL, amount=float(T_p), kind="prefill")
-        telemetry.observe(TTFT_SECONDS, self._clock() - req.submitted_at,
+        if pad:
+            # padded rows run through the MXU like real tokens — they are
+            # processed-but-wasted, the prefill half of the goodput split
+            self._tokens["pad"] += pad
+            telemetry.inc(TOKENS_TOTAL, amount=float(pad), kind="pad")
+            telemetry.inc(WASTED_TOKENS, amount=float(pad),
+                          reason="prefill_pad")
+        req.ttft_s = clk_first - req.submitted_at
+        telemetry.observe(TTFT_SECONDS, req.ttft_s,
                           buckets=_LATENCY_BUCKETS)
+        if req.trace is not None:
+            req.trace["clk_first"] = clk_first
+            self._emit_request_record(
+                REQ_PREFILL_SPAN, req.trace,
+                ts=self._trace_ts(req.trace, clk_prefill),
+                dur_s=clk_first - clk_prefill, pid=req.trace["sid"],
+                extra={"request": req.request_id, "bucket": T_b,
+                       "prompt_len": T_p, "pad": pad})
         self._slot_req[slot] = req
         self._slot_pages[slot] = pages
         self._slot_out[slot] = [first]
@@ -323,7 +399,19 @@ class ServingEngine:
             jnp.asarray(self._positions), jnp.asarray(self._tables))
         tok = np.asarray(tok)
         n_live = len(live_slots)
+        self._tokens["decode"] += n_live
         telemetry.inc(TOKENS_TOTAL, amount=float(n_live), kind="decode")
+        if _dtrace.trace_active():
+            # ONE batched progress record per decode STEP (not per token):
+            # [request_id, tokens emitted so far] per live slot. Not a
+            # span — trace_merge partitions kind=req_step out of the span
+            # pipeline and uses it for per-request step counting.
+            _dtrace.record_span({
+                "kind": REQ_STEP_KIND, "ts": time.time_ns(),
+                "step": self.steps,
+                "slots": [[self._slot_req[s].request_id,
+                           len(self._slot_out[s]) + 1]
+                          for s in live_slots]})
         for s in live_slots:
             req = self._slot_req[s]
             self._slot_out[s].append(int(tok[s]))
@@ -338,22 +426,61 @@ class ServingEngine:
             return True
         return len(out) >= req.max_new_tokens
 
-    def _finish(self, slot):
+    def _finish(self, slot, reason=None):
         """Evict: record the result and recycle the pages IMMEDIATELY —
-        the very next _admit() can hand them to a queued request."""
+        the very next _admit() can hand them to a queued request.
+        `reason` overrides the eos/length inference (mid-stream
+        eviction passes "evicted")."""
         req = self._slot_req[slot]
         out = self._slot_out[slot]
-        reason = ("eos" if req.eos_id is not None and out
-                  and out[-1] == req.eos_id else "length")
+        if reason is None:
+            reason = ("eos" if req.eos_id is not None and out
+                      and out[-1] == req.eos_id else "length")
         now = self._clock()
+        queue_wait = req.admitted_at - req.submitted_at
+        latency = now - req.submitted_at
         self._results[req.request_id] = RequestResult(
             request_id=req.request_id, tokens=list(out),
             finish_reason=reason, prompt_len=int(req.prompt.size),
-            queue_wait_s=req.admitted_at - req.submitted_at,
-            latency_s=now - req.submitted_at)
+            queue_wait_s=queue_wait, latency_s=latency)
         telemetry.inc(REQUESTS_TOTAL, outcome=reason)
-        telemetry.observe(REQUEST_SECONDS, now - req.submitted_at,
+        telemetry.observe(REQUEST_SECONDS, latency,
                           buckets=_LATENCY_BUCKETS)
+        if reason == "evicted":
+            # everything this request pushed through the device is now
+            # undelivered output (its pad rows are already in the pad kind)
+            wasted = int(req.prompt.size) + len(out)
+            self._wasted_evicted += wasted
+            telemetry.inc(WASTED_TOKENS, amount=float(wasted),
+                          reason="evicted")
+        self._record_timeline(req, len(out), reason, queue_wait, latency)
+        _recorder.log_event("serving_request_finish",
+                            request=req.request_id, outcome=reason,
+                            tokens=len(out))
+        if self.slo is not None:
+            self.slo.observe_request(
+                ttft=req.ttft_s, queue_wait=queue_wait,
+                request_latency=latency,
+                goodput=self._goodput_fraction())
+        tr = req.trace
+        if tr is not None:
+            clk_first = tr.get("clk_first")
+            if clk_first is not None and len(out) > 1:
+                self._emit_request_record(
+                    REQ_DECODE_SPAN, tr,
+                    ts=self._trace_ts(tr, clk_first),
+                    dur_s=now - clk_first, pid=tr["sid"],
+                    extra={"request": req.request_id,
+                           "steps": len(out) - 1})
+            self._emit_request_record(
+                REQ_SPAN, tr, ts=tr["ns_submit"], dur_s=latency,
+                sid=tr["sid"],
+                extra={"request": req.request_id,
+                       "prompt_len": int(req.prompt.size),
+                       "tokens": len(out), "finish": reason,
+                       "queue_wait_s": queue_wait,
+                       "ttft_s": req.ttft_s, "latency_s": latency,
+                       "decode_steps": max(0, len(out) - 1)})
         self.allocator.free(self._slot_pages[slot])
         self._slot_req[slot] = None
         self._slot_pages[slot] = []
@@ -362,6 +489,157 @@ class ServingEngine:
         self._positions[slot] = 0
         self._next_tok[slot] = 0
 
+    # -- per-request trace plumbing ----------------------------------------
+
+    @staticmethod
+    def _trace_ts(tr, clk):
+        """Wall-clock ns for an engine-clock instant: deltas come from
+        the injectable engine clock (so trace durations agree with the
+        latency histograms even under a synthetic clock), anchored to
+        the wall time captured at submit."""
+        return tr["ns_submit"] + int((clk - tr["clk_submit"]) * 1e9)
+
+    @staticmethod
+    def _emit_request_record(name, tr, *, ts, dur_s, extra,
+                             sid=None, pid=None):
+        record = {"name": name, "tid": tr["tid"],
+                  "sid": sid if sid is not None else _dtrace.new_id(),
+                  "ts": int(ts), "dur_ns": max(0, int(dur_s * 1e9)),
+                  "extra": extra}
+        if pid is not None:
+            record["pid"] = pid
+        _dtrace.record_span(record)
+
+    def _record_timeline(self, req, n_tokens, reason, queue_wait, latency):
+        self._timelines.append({
+            "request_id": req.request_id,
+            "prompt_len": int(req.prompt.size),
+            "tokens": n_tokens,
+            "finish": reason,
+            "queue_wait_s": queue_wait,
+            "ttft_s": req.ttft_s if req.admitted_at else None,
+            "latency_s": latency,
+        })
+
+    # -- introspection ------------------------------------------------------
+
+    def recent_timelines(self):
+        """Last-N finished-request timeline dicts (newest last) — the
+        payload the SLO breach dump carries."""
+        return list(self._timelines)
+
+    def goodput(self):
+        """Token accounting split: device token-positions by kind, the
+        wasted share (prefill padding + evicted requests' tokens), and
+        the useful fraction."""
+        processed = sum(self._tokens.values())
+        useful = (self._tokens["prefill"] + self._tokens["decode"]
+                  - self._wasted_evicted)
+        return {
+            "prefill": self._tokens["prefill"],
+            "decode": self._tokens["decode"],
+            "pad": self._tokens["pad"],
+            "wasted_evicted": self._wasted_evicted,
+            "processed": processed,
+            "useful": useful,
+            "fraction": useful / processed if processed else 1.0,
+        }
+
+    def _goodput_fraction(self):
+        processed = sum(self._tokens.values())
+        if not processed:
+            return 1.0
+        return (self._tokens["prefill"] + self._tokens["decode"]
+                - self._wasted_evicted) / processed
+
+    def debug_snapshot(self):
+        """Live-engine JSON snapshot, served at /debug/engine by the
+        telemetry HTTP server (MXTPU_DEBUG_ENDPOINTS=1) and rendered by
+        tools/serving_top.py."""
+        now = self._clock()
+        slot_rows = []
+        for s, req in enumerate(self._slot_req):
+            if req is None:
+                slot_rows.append({"slot": s, "state": "idle"})
+            else:
+                slot_rows.append({
+                    "slot": s, "state": "decoding",
+                    "request_id": req.request_id,
+                    "age_s": now - req.submitted_at,
+                    "prompt_len": int(req.prompt.size),
+                    "tokens_out": len(self._slot_out[s]),
+                    "position": int(self._positions[s]),
+                    "pages_held": len(self._slot_pages[s]),
+                })
+        queued = [{"request_id": r.request_id,
+                   "age_s": now - r.submitted_at,
+                   "prompt_len": int(r.prompt.size),
+                   "max_new_tokens": r.max_new_tokens}
+                  for r in self._queue]
+        compile_rows = {
+            fn: {"signatures": v["signatures"], "retraces": v["retraces"]}
+            for fn, v in compilereg.snapshot().items()
+            if fn.startswith("serving_")}
+        return {
+            "schema": "mxtpu-serving-engine-debug-v1",
+            "steps": self.steps,
+            "slots": slot_rows,
+            "slots_in_use": self.slots_in_use,
+            "queue": queued,
+            "queue_depth": len(self._queue),
+            "pages": {
+                "capacity": self.allocator.capacity,
+                "in_use": self.allocator.num_in_use,
+                "free": self.allocator.num_free,
+                "page_size": self.allocator.page_size,
+                "occupancy": self.allocator.occupancy(),
+                "fragmentation": self.allocator.fragmentation(),
+            },
+            "tokens": self.goodput(),
+            "compile": compile_rows,
+            "slo": self.slo.snapshot() if self.slo is not None else None,
+            "requests_finished": len(self._results),
+        }
+
+    def cancel(self, request_id):
+        """Cancel a request: still-queued requests finish as
+        "cancelled" (nothing was processed); live ones are EVICTED
+        mid-stream — pages recycle immediately and every token they
+        pushed through the device counts as wasted. Returns True when
+        the request was cancelled, False when the id is unknown or
+        already finished."""
+        for i, req in enumerate(self._queue):
+            if req.request_id == request_id:
+                del self._queue[i]
+                now = self._clock()
+                waited = now - req.submitted_at
+                self._results[request_id] = RequestResult(
+                    request_id=request_id, tokens=[],
+                    finish_reason="cancelled",
+                    prompt_len=int(req.prompt.size),
+                    queue_wait_s=waited, latency_s=waited)
+                telemetry.inc(REQUESTS_TOTAL, outcome="cancelled")
+                telemetry.set_gauge(QUEUE_DEPTH, len(self._queue))
+                self._record_timeline(req, 0, "cancelled", waited, waited)
+                _recorder.log_event("serving_request_finish",
+                                    request=request_id,
+                                    outcome="cancelled", tokens=0)
+                if req.trace is not None:
+                    self._emit_request_record(
+                        REQ_SPAN, req.trace, ts=req.trace["ns_submit"],
+                        dur_s=waited, sid=req.trace["sid"],
+                        extra={"request": request_id,
+                               "prompt_len": int(req.prompt.size),
+                               "tokens": 0, "finish": "cancelled",
+                               "latency_s": waited, "decode_steps": 0})
+                return True
+        for s, req in enumerate(self._slot_req):
+            if req is not None and req.request_id == request_id:
+                self._finish(s, reason="evicted")
+                self._export_gauges()
+                return True
+        return False
+
     def _export_gauges(self):
         telemetry.set_gauge(QUEUE_DEPTH, len(self._queue))
         telemetry.set_gauge(SLOTS_IN_USE, self.slots_in_use)
@@ -369,3 +647,8 @@ class ServingEngine:
         telemetry.set_gauge(
             PAGE_UTILIZATION,
             self.allocator.num_in_use / max(1, self.allocator.capacity))
+        telemetry.set_gauge(
+            OLDEST_QUEUED,
+            self._clock() - self._queue[0].submitted_at
+            if self._queue else 0.0)
+        telemetry.set_gauge(GOODPUT, self._goodput_fraction())

@@ -53,6 +53,26 @@ class PageAllocator:
         """Allocatable pages (the null page doesn't count)."""
         return self.num_pages - 1
 
+    def occupancy(self) -> float:
+        """Fraction of allocatable pages currently owned, in [0, 1]."""
+        return self.num_in_use / self.capacity
+
+    def fragmentation(self) -> float:
+        """Free-list fragmentation in [0, 1]: 1 minus the largest run of
+        CONSECUTIVE page ids in the free list over the free count. 0 when
+        the free pages form one contiguous id range (or none are free);
+        approaches 1 as recycling interleaves the pool. Paged attention
+        doesn't need contiguity — this is a health signal for the
+        /debug/engine view, not an allocator constraint."""
+        if not self._free:
+            return 0.0
+        ids = sorted(self._free)
+        longest = run = 1
+        for prev, cur in zip(ids, ids[1:]):
+            run = run + 1 if cur == prev + 1 else 1
+            longest = max(longest, run)
+        return 1.0 - longest / len(ids)
+
     def pages_needed(self, n_tokens: int) -> int:
         """Pages required to hold `n_tokens` cache entries."""
         if n_tokens <= 0:

@@ -37,11 +37,15 @@ from . import distributed  # noqa: F401
 from . import recorder  # noqa: F401
 from .spans import Span, NoopSpan, NOOP_SPAN, current_span, SPAN_HISTOGRAM  # noqa: F401
 from .recorder import log_event  # noqa: F401
-from .exporters import dump_json, prometheus_text, start_http_server, to_dict  # noqa: F401
+from .exporters import (  # noqa: F401
+    dump_json, prometheus_text, start_http_server, to_dict,
+    register_debug_handler, unregister_debug_handler,
+)
 from .memory import sample_device_memory, step_boundary  # noqa: F401
 from . import stepstats  # noqa: F401
 from . import ledger  # noqa: F401
 from . import compilereg  # noqa: F401
+from . import slo  # noqa: F401
 from .tb import LogTelemetryCallback  # noqa: F401
 
 __all__ = [
@@ -50,8 +54,9 @@ __all__ = [
     "Span", "NoopSpan", "current_span", "span",
     "distributed", "recorder", "log_event",
     "dump_json", "prometheus_text", "start_http_server", "to_dict",
+    "register_debug_handler", "unregister_debug_handler",
     "sample_device_memory", "step_boundary", "LogTelemetryCallback",
-    "stepstats", "ledger", "compilereg",
+    "stepstats", "ledger", "compilereg", "slo",
     "enabled", "enable", "disable", "refresh_from_env",
     "counter", "gauge", "histogram", "inc", "observe", "set_gauge",
     "METRIC_NAMES", "SPAN_NAMES", "is_registered_metric",

@@ -13,7 +13,37 @@ import threading
 
 from .metrics import REGISTRY
 
-__all__ = ["to_dict", "dump_json", "prometheus_text", "start_http_server"]
+__all__ = ["to_dict", "dump_json", "prometheus_text", "start_http_server",
+           "register_debug_handler", "unregister_debug_handler",
+           "debug_handlers"]
+
+# /debug/* endpoint registry: path -> zero-arg callable returning a
+# JSON-serializable snapshot. Served by the telemetry HTTP server only
+# when MXTPU_DEBUG_ENDPOINTS is on (introspection snapshots expose
+# request ids — not every /metrics scraper should see them). Last
+# registration per path wins: a replaced engine takes over its path.
+_debug_lock = threading.Lock()
+_debug_handlers: dict = {}
+
+
+def register_debug_handler(path, provider):
+    """Expose `provider()` (returning JSON-serializable data) at `path`
+    on the telemetry HTTP server, gated by MXTPU_DEBUG_ENDPOINTS."""
+    if not path.startswith("/debug/"):
+        raise ValueError(f"debug handlers live under /debug/, got {path!r}")
+    with _debug_lock:
+        _debug_handlers[path] = provider
+
+
+def unregister_debug_handler(path):
+    with _debug_lock:
+        _debug_handlers.pop(path, None)
+
+
+def debug_handlers():
+    """Snapshot of the registered /debug/* paths."""
+    with _debug_lock:
+        return dict(_debug_handlers)
 
 
 def _fmt(value):
@@ -164,17 +194,33 @@ class _MetricsServer:
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
+            def _reply(self, body, content_type):
+                self.send_response(200)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
-                if self.path.split("?")[0] in ("/metrics", "/"):
-                    body = prometheus_text(outer.registry).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type",
-                                     "text/plain; version=0.0.4")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                else:
-                    self.send_error(404)
+                from .. import config as _config
+
+                path = self.path.split("?")[0]
+                if path in ("/metrics", "/"):
+                    self._reply(prometheus_text(outer.registry).encode(),
+                                "text/plain; version=0.0.4")
+                    return
+                provider = debug_handlers().get(path)
+                if (provider is not None
+                        and _config.get("MXTPU_DEBUG_ENDPOINTS")):
+                    try:
+                        body = json.dumps(provider(), default=str).encode()
+                    except Exception as e:  # snapshot bug: surface, not 404
+                        self.send_error(
+                            500, f"{type(e).__name__}: {e}")
+                        return
+                    self._reply(body, "application/json")
+                    return
+                self.send_error(404)
 
             def log_message(self, *args):
                 pass  # scrapes must not spam the training logs

@@ -189,11 +189,11 @@ METRIC_NAMES = {
                  "(pages_in_use / (num_pages - 1))."),
     "mxtpu_serving_requests_total": (
         "counter", "Requests finished by the serving engine, by outcome "
-                   "(eos / length)."),
+                   "(eos / length / evicted / cancelled)."),
     "mxtpu_serving_tokens_total": (
         "counter", "Tokens processed by the serving engine, by kind "
                    "(prefill = prompt tokens cached, decode = tokens "
-                   "generated)."),
+                   "generated, pad = prefill bucket padding rows)."),
     "mxtpu_serving_request_seconds": (
         "histogram", "Per-request wall time from submit to finish "
                      "(queue wait + prefill + all decode steps)."),
@@ -203,6 +203,31 @@ METRIC_NAMES = {
     "mxtpu_serving_ttft_seconds": (
         "histogram", "Per-request time to first token: submit until the "
                      "prefill emits the first sampled token."),
+    "mxtpu_serving_oldest_queued_seconds": (
+        "gauge", "Age of the head-of-queue request (0 when the queue is "
+                 "empty) — a wedged queue is visible BEFORE it drains."),
+    "mxtpu_serving_admission_blocked_total": (
+        "counter", "Scheduler iterations in which admission stalled with "
+                   "requests still queued, by reason (slots = no free "
+                   "decode slot, pages = KV page pool exhausted)."),
+    "mxtpu_serving_wasted_tokens_total": (
+        "counter", "Device token-positions that produced no delivered "
+                   "output, by reason (prefill_pad = bucket padding "
+                   "rows, evicted = prompt+generated tokens of requests "
+                   "evicted mid-stream)."),
+    "mxtpu_serving_goodput": (
+        "gauge", "Fraction of processed serving tokens that were useful "
+                 "(neither padding nor spent on evicted requests)."),
+    "mxtpu_slo_burn_rate": (
+        "gauge", "SLO error-budget burn rate (bad_fraction / budget), "
+                 "by objective and window (short / long)."),
+    "mxtpu_slo_state": (
+        "gauge", "SLO state machine position per objective "
+                 "(0 = ok, 1 = warning, 2 = breach)."),
+    "mxtpu_slo_breaches_total": (
+        "counter", "SLO breach transitions (each also logs a "
+                   "flight-recorder event and writes one post-mortem "
+                   "dump), by objective."),
 }
 
 # span() names (tracing regions). Dots namespace by subsystem.
@@ -220,6 +245,13 @@ SPAN_NAMES = frozenset({
     "embedding.push",
     "serving.step",
     "serving.prefill",
+    # per-request lifecycle records (trace-only; emitted straight
+    # through distributed.record_span, one lane per request in the
+    # trace_merge --requests view)
+    "serving.request",
+    "serving.request.queued",
+    "serving.request.prefill",
+    "serving.request.decode",
 })
 
 

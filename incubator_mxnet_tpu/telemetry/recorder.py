@@ -139,12 +139,14 @@ def _dump_dir():
             or _config.get("MXTPU_TRACE_DIR"))
 
 
-def dump(reason):
+def dump(reason, extra=None):
     """Write the post-mortem dump: ring contents + metrics snapshot +
-    resolved config knobs. Returns the path, or None when no destination
-    directory is configured (the common interactive case — the ring is
-    always recording, but files appear only where a dump dir was chosen)
-    or the per-process dump cap is spent."""
+    resolved config knobs. `extra` (a JSON-serializable dict) is merged
+    into the payload top-level — the SLO monitor rides it to attach the
+    last-N request timelines to a breach dump. Returns the path, or None
+    when no destination directory is configured (the common interactive
+    case — the ring is always recording, but files appear only where a
+    dump dir was chosen) or the per-process dump cap is spent."""
     global _dumps_written
     directory = _dump_dir()
     if not directory:
@@ -177,6 +179,9 @@ def dump(reason):
         "config": {name: _config.get(name)
                    for name in sorted(_config.KNOBS)},
     }
+    if extra:
+        for key, value in extra.items():
+            payload.setdefault(key, value)  # core schema keys win
     tmp = path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as f:
         json.dump(payload, f, separators=(",", ":"), sort_keys=True)

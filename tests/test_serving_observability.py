@@ -1,0 +1,490 @@
+"""Serving observatory tests: per-request lifecycle tracing, SLO
+burn-rate math and breach dumps, live /debug/engine introspection, and
+goodput accounting."""
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from incubator_mxnet_tpu import telemetry
+from incubator_mxnet_tpu.models import transformer as tfm
+from incubator_mxnet_tpu.serving import PageAllocator, ServingEngine
+from incubator_mxnet_tpu.serving.engine import (
+    ADMISSION_BLOCKED, GOODPUT, OLDEST_QUEUED, REQUESTS_TOTAL,
+    TOKENS_TOTAL, WASTED_TOKENS)
+from incubator_mxnet_tpu.telemetry import distributed as _distributed
+from incubator_mxnet_tpu.telemetry import exporters as _exporters
+from incubator_mxnet_tpu.telemetry import recorder as _recorder
+from incubator_mxnet_tpu.telemetry import slo as _slo
+
+_PARAM_CACHE = {}
+
+
+def _tiny_engine(**kw):
+    """Small enough that each engine compiles in well under a second on
+    CPU; prompts in these tests stay below 16 so only one prefill
+    bucket ever compiles."""
+    cfg, params = _PARAM_CACHE.get("tiny") or _PARAM_CACHE.setdefault(
+        "tiny", (tfm.TransformerConfig(vocab=32, d_model=16, n_heads=2,
+                                       n_layers=1, d_ff=32, max_len=32),
+                 None))
+    if params is None:
+        params = tfm.init_params(cfg, seed=0)
+        _PARAM_CACHE["tiny"] = (cfg, params)
+    base = dict(slots=2, page_size=8, num_pages=16)
+    base.update(kw)
+    return ServingEngine(params, cfg, **base)
+
+
+def _prompt(n, seed=0):
+    return np.random.RandomState(seed).randint(
+        1, 32, n).astype(np.int32)
+
+
+@pytest.fixture
+def traced(tmp_path, monkeypatch):
+    d = str(tmp_path / "traces")
+    monkeypatch.setenv("MXTPU_TRACE_DIR", d)
+    monkeypatch.setenv("MXTPU_FLIGHT_RECORDER_DIR", d)
+    _distributed.refresh_from_env()
+    _recorder.refresh_from_env()
+    yield d
+    monkeypatch.delenv("MXTPU_TRACE_DIR")
+    monkeypatch.delenv("MXTPU_FLIGHT_RECORDER_DIR")
+    _distributed.refresh_from_env()
+    _recorder.refresh_from_env()
+
+
+@pytest.fixture
+def metrics_on(monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    telemetry.refresh_from_env()
+    telemetry.REGISTRY.reset()
+    yield
+    monkeypatch.delenv("MXNET_TELEMETRY", raising=False)
+    telemetry.refresh_from_env()
+    telemetry.REGISTRY.reset()
+
+
+def _load_records(trace_dir):
+    _distributed.flush()
+    records = []
+    for name in sorted(os.listdir(trace_dir)):
+        if name.endswith(".mxtrace"):
+            records.extend(_distributed.read_trace_file(
+                os.path.join(trace_dir, name)))
+    return records
+
+
+def _trace_merge():
+    tools = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "..", "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    import trace_merge
+    return trace_merge
+
+
+def _serving_top():
+    tools = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "..", "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    import serving_top
+    return serving_top
+
+
+# -- per-request lifecycle tracing -------------------------------------------
+
+def test_request_trace_causal_chain(traced):
+    eng = _tiny_engine()
+    r0 = eng.submit(_prompt(5), 4)
+    r1 = eng.submit(_prompt(9, seed=1), 6, eos_id=0)
+    results = eng.run()
+    records = _load_records(traced)
+
+    roots = {r["extra"]["request"]: r for r in records
+             if r.get("name") == "serving.request"}
+    assert set(roots) == {r0, r1}
+    steps = [r for r in records if r.get("kind") == "req_step"]
+    for rid in (r0, r1):
+        root = roots[rid]
+        res = results[rid]
+        # every stage shares ONE trace id and parents under the root sid
+        stages = {r["name"]: r for r in records
+                  if r.get("name", "").startswith("serving.request.")
+                  and r["extra"].get("request") == rid}
+        assert {"serving.request.queued",
+                "serving.request.prefill"} <= set(stages)
+        if len(res.tokens) > 1:
+            assert "serving.request.decode" in stages
+        for stage in stages.values():
+            assert stage["tid"] == root["tid"]
+            assert stage["pid"] == root["sid"]
+            assert stage["ts"] >= root["ts"]
+        # extras carry the engine's own result figures exactly
+        extra = root["extra"]
+        assert extra["finish"] == res.finish_reason
+        assert extra["tokens"] == len(res.tokens)
+        assert extra["prompt_len"] == res.prompt_len
+        assert extra["latency_s"] == res.latency_s
+        assert extra["queue_wait_s"] == res.queue_wait_s
+        assert 0.0 < extra["ttft_s"] <= extra["latency_s"]
+        # one batched progress record per decode step, not per token
+        progressed = sum(1 for r in steps
+                         for slot in r["slots"] if slot[0] == rid)
+        assert progressed == extra["decode_steps"] == len(res.tokens) - 1
+    assert len(steps) <= eng.steps
+
+
+def test_zero_trace_records_when_off():
+    assert not _distributed.trace_active()
+    eng = _tiny_engine()
+    emitted = []
+    orig = _distributed.record_span
+    _distributed.record_span = emitted.append
+    try:
+        rid = eng.submit(_prompt(4), 3)
+        eng.run()
+    finally:
+        _distributed.record_span = orig
+    assert eng.results()[rid].tokens
+    assert not emitted, "engine emitted trace records with tracing off"
+    assert eng._queue == eng._queue.__class__()  # drained
+
+
+def test_trace_merge_requests_report(traced, tmp_path):
+    eng = _tiny_engine()
+    rids = [eng.submit(_prompt(4 + i, seed=i), 3 + i) for i in range(3)]
+    results = eng.run()
+    _distributed.flush()
+    tm = _trace_merge()
+    timeline = str(tmp_path / "timeline.json")
+    report = str(tmp_path / "requests.json")
+    rc = tm.main([traced, "-o", timeline, "--requests",
+                  "--requests-json", report, "--check"])
+    assert rc == 0
+    rep = json.load(open(report))
+    assert rep["count"] == len(rids)
+    by_rid = {row["request"]: row for row in rep["requests"]}
+    for rid in rids:
+        row = by_rid[rid]
+        res = results[rid]
+        assert row["finish"] == res.finish_reason
+        assert row["tokens"] == len(res.tokens)
+        assert row["ttft_s"] <= row["latency_s"]
+        assert row["progress_steps"] == row["decode_steps"]
+    # one Perfetto lane per request
+    tl = json.load(open(timeline))
+    lanes = {e["args"]["name"] for e in tl["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert {f"req{rid}" for rid in rids} <= lanes
+
+
+def test_trace_merge_requests_check_catches_orphan(traced, tmp_path):
+    # a root without its queued/prefill stages must fail --check
+    _distributed.record_span({
+        "name": "serving.request", "tid": _distributed.new_id(),
+        "sid": _distributed.new_id(), "ts": 1, "dur_ns": 10,
+        "extra": {"request": 7, "finish": "length", "tokens": 3,
+                  "decode_steps": 2}})
+    _distributed.flush()
+    tm = _trace_merge()
+    assert tm.main([traced, "--requests", "--check"]) == 2
+
+
+# -- SLO burn-rate monitor ---------------------------------------------------
+
+def test_burn_rate_state_machine_and_rearm():
+    mon = _slo.SLOMonitor(
+        [_slo.Objective("ttft", 0.5, budget=0.1)],
+        window_short=4, window_long=8, min_samples=4,
+        warn_burn=1.0, breach_burn=5.0, dump=False)
+    # 8 good samples: burn 0, state ok
+    for _ in range(8):
+        assert mon.observe("ttft", 0.1) == "ok"
+    # one bad sample: short window 1/4 bad -> burn 2.5 >= warn
+    assert mon.observe("ttft", 2.0) == "warning"
+    # three more: short burn 10, long (4 bad / 8) burn 5 -> breach
+    mon.observe("ttft", 2.0)
+    mon.observe("ttft", 2.0)
+    assert mon.observe("ttft", 2.0) == "breach"
+    snap = mon.snapshot()["ttft"]
+    assert snap["breaches"] == 1
+    assert snap["burn_short"] == pytest.approx(10.0)
+    assert snap["burn_long"] == pytest.approx(5.0)
+    # recovery drains the short window first: re-arm through warning/ok
+    states = [mon.observe("ttft", 0.1) for _ in range(8)]
+    assert states[-1] == "ok"
+    assert "breach" not in states[4:]
+    # a second episode is a SECOND breach (re-armed, not latched)
+    for _ in range(4):
+        state = mon.observe("ttft", 2.0)
+    assert state == "breach"
+    assert mon.snapshot()["ttft"]["breaches"] == 2
+
+
+def test_burn_rate_goodput_floor_and_cold_start():
+    mon = _slo.SLOMonitor(
+        [_slo.Objective("goodput", 0.8, kind="floor", budget=0.5)],
+        window_short=2, window_long=4, min_samples=4,
+        warn_burn=1.0, breach_burn=2.0, dump=False)
+    # below min_samples nothing can leave ok, however bad the burn
+    assert mon.observe("goodput", 0.1) == "ok"
+    assert mon.observe("goodput", 0.1) == "ok"
+    assert mon.observe("goodput", 0.1) == "ok"
+    assert mon.observe("goodput", 0.1) == "breach"  # 4th sample: both burn 2
+    assert mon.state("goodput") == "breach"
+    # floor direction: values ABOVE the threshold are good
+    mon2 = _slo.SLOMonitor([_slo.Objective("goodput", 0.8, kind="floor")],
+                           window_short=2, window_long=4, min_samples=1,
+                           dump=False)
+    assert mon2.observe("goodput", 0.95) == "ok"
+
+
+def test_breach_fires_exactly_one_dump(traced):
+    timelines = [{"request_id": 1, "latency_s": 2.0}]
+    mon = _slo.SLOMonitor(
+        [_slo.Objective("ttft", 0.5, budget=0.1)],
+        window_short=4, window_long=4, min_samples=4,
+        warn_burn=1.0, breach_burn=5.0,
+        timelines=lambda: timelines)
+    for _ in range(8):
+        mon.observe("ttft", 2.0)
+    dumps = [f for f in os.listdir(traced) if f.startswith("flightrec-")]
+    assert len(dumps) == 1, f"expected exactly one dump, got {dumps}"
+    payload = json.load(open(os.path.join(traced, dumps[0])))
+    assert payload["reason"] == "slo-breach-ttft"
+    assert payload["request_timelines"] == timelines
+    assert payload["slo"]["ttft"]["state"] == "breach"
+    # staying in breach writes nothing more; a fresh episode dumps again
+    for _ in range(8):
+        mon.observe("ttft", 0.1)
+    for _ in range(8):
+        mon.observe("ttft", 2.0)
+    dumps = sorted(f for f in os.listdir(traced)
+                   if f.startswith("flightrec-"))
+    assert len(dumps) == 2
+
+
+def test_slo_from_env(monkeypatch):
+    assert _slo.from_env() is None
+    monkeypatch.setenv("MXTPU_SLO_TTFT_P99", "0.25")
+    monkeypatch.setenv("MXTPU_SLO_GOODPUT_MIN", "0.5")
+    monkeypatch.setenv("MXTPU_SLO_WINDOW_SHORT", "3")
+    monkeypatch.setenv("MXTPU_SLO_WINDOW_LONG", "6")
+    mon = _slo.from_env()
+    names = {o.name: o for o in mon.objectives}
+    assert set(names) == {"ttft", "goodput"}
+    assert names["ttft"].kind == "ceiling"
+    assert names["goodput"].kind == "floor"
+    assert mon.window_short == 3 and mon.window_long == 6
+    # unknown keywords are ignored so the engine can feed its full set
+    mon.observe_request(ttft=0.1, queue_wait=9.9, request_latency=9.9,
+                        goodput=0.9)
+    assert mon.snapshot()["ttft"]["samples"] == 1
+
+
+def test_engine_attaches_slo_from_env_and_breaches(traced, monkeypatch):
+    monkeypatch.setenv("MXTPU_SLO_TTFT_P99", "1e-12")  # everything is bad
+    monkeypatch.setenv("MXTPU_SLO_WINDOW_SHORT", "2")
+    monkeypatch.setenv("MXTPU_SLO_WINDOW_LONG", "4")
+    monkeypatch.setenv("MXTPU_SLO_MIN_SAMPLES", "2")
+    eng = _tiny_engine()
+    assert eng.slo is not None
+    for i in range(4):
+        eng.submit(_prompt(4, seed=i), 3)
+    eng.run()
+    assert eng.slo.state("ttft") == "breach"
+    dumps = [f for f in os.listdir(traced) if f.startswith("flightrec-")
+             and "slo-breach-ttft" in f]
+    assert len(dumps) == 1
+    payload = json.load(open(os.path.join(traced, dumps[0])))
+    # the dump carries the engine's own last-N request timelines
+    assert payload["request_timelines"]
+    assert {t["request_id"] for t in payload["request_timelines"]} <= \
+        set(eng.results())
+    tl = payload["request_timelines"][0]
+    assert {"prompt_len", "tokens", "finish", "ttft_s",
+            "latency_s"} <= set(tl)
+
+
+# -- /debug/engine introspection ---------------------------------------------
+
+def test_debug_snapshot_matches_engine_midrun(metrics_on, tmp_path,
+                                              monkeypatch):
+    # the compile table in the snapshot is fed by compilereg, which only
+    # sees programs routed through the persistent compile cache
+    monkeypatch.setenv("MXTPU_COMPILE_CACHE_DIR", str(tmp_path / "cc"))
+    eng = _tiny_engine(slots=1)
+    r0 = eng.submit(_prompt(4), 8)
+    r1 = eng.submit(_prompt(5, seed=1), 4)
+    eng.step()  # r0 admitted + one decode step; r1 still queued
+    snap = eng.debug_snapshot()
+    json.dumps(snap)  # JSON-serializable end to end
+    assert snap["steps"] == 1
+    busy = [row for row in snap["slots"] if row["state"] == "decoding"]
+    assert len(busy) == 1 and busy[0]["request_id"] == r0
+    assert busy[0]["tokens_out"] == len(eng._slot_out[0])
+    assert busy[0]["pages_held"] == len(eng._slot_pages[0])
+    assert busy[0]["position"] == int(eng._positions[0])
+    assert snap["queue_depth"] == 1
+    assert snap["queue"][0]["request_id"] == r1
+    assert snap["queue"][0]["age_s"] > 0
+    assert snap["pages"]["in_use"] == eng.allocator.num_in_use > 0
+    assert snap["pages"]["occupancy"] == eng.allocator.occupancy()
+    assert snap["slo"] is None
+    eng.run()
+    snap = eng.debug_snapshot()
+    assert snap["queue_depth"] == 0 and snap["slots_in_use"] == 0
+    assert snap["requests_finished"] == 2
+    assert snap["compile"]  # serving_* programs with signature counts
+    assert all(fn.startswith("serving_") for fn in snap["compile"])
+
+
+def test_debug_endpoint_http(monkeypatch):
+    eng = _tiny_engine()
+    eng.submit(_prompt(4), 3)
+    eng.run()
+    srv = _exporters.start_http_server(0, host="127.0.0.1")
+    try:
+        url = f"http://127.0.0.1:{srv.port}/debug/engine"
+        # gated off by default: the endpoint must 404 without the knob
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(url)
+        assert err.value.code == 404
+        monkeypatch.setenv("MXTPU_DEBUG_ENDPOINTS", "1")
+        with urllib.request.urlopen(url) as resp:
+            assert resp.headers["Content-Type"] == "application/json"
+            snap = json.loads(resp.read().decode())
+        assert snap["schema"] == "mxtpu-serving-engine-debug-v1"
+        assert snap["requests_finished"] == 1
+    finally:
+        srv.close()
+
+
+def test_serving_top_render():
+    top = _serving_top()
+    eng = _tiny_engine(slots=1)
+    eng.submit(_prompt(4), 8)
+    eng.submit(_prompt(5, seed=1), 4)
+    eng.step()
+    text = top.render(eng.debug_snapshot())
+    assert "decoding" in text and "queued" in text
+    assert "serving_decode_step" in text
+    assert "goodput" in text
+    eng.run()
+    assert "idle" in top.render(eng.debug_snapshot())
+    assert top.snapshot_url("localhost:9090") == \
+        "http://localhost:9090/debug/engine"
+
+
+# -- goodput accounting ------------------------------------------------------
+
+def test_goodput_kinds_sum_to_tokens_total(metrics_on):
+    eng = _tiny_engine()
+    eng.submit(_prompt(5), 4)
+    eng.submit(_prompt(9, seed=1), 3)
+    eng.run()
+    rid = eng.submit(_prompt(4, seed=2), 12)
+    eng.step()
+    eng.step()
+    assert eng.cancel(rid)
+    good = eng.goodput()
+    # the registry's kind split must equal the host-side source of truth
+    counter = telemetry.REGISTRY.counter(TOKENS_TOTAL)
+    by_kind = {labels["kind"]: child.value
+               for labels, child in counter.series()}
+    assert by_kind == {"prefill": float(good["prefill"]),
+                       "decode": float(good["decode"]),
+                       "pad": float(good["pad"])}
+    assert sum(by_kind.values()) == float(good["processed"])
+    wasted = telemetry.REGISTRY.counter(WASTED_TOKENS)
+    by_reason = {labels["reason"]: child.value
+                 for labels, child in wasted.series()}
+    assert by_reason["prefill_pad"] == float(good["pad"])
+    assert by_reason["evicted"] == float(good["wasted_evicted"]) > 0
+    assert 0.0 < good["fraction"] < 1.0
+    assert good["useful"] == (good["prefill"] + good["decode"]
+                              - good["wasted_evicted"])
+    gauge = telemetry.REGISTRY.gauge(GOODPUT)
+    assert {labels == {} and child.value == pytest.approx(good["fraction"])
+            for labels, child in gauge.series()} == {True}
+    requests = telemetry.REGISTRY.counter(REQUESTS_TOTAL)
+    assert requests.value(outcome="evicted") == 1.0
+
+
+def test_cancel_queued_and_unknown():
+    eng = _tiny_engine(slots=1)
+    r0 = eng.submit(_prompt(4), 6)
+    r1 = eng.submit(_prompt(5, seed=1), 4)
+    assert eng.cancel(r1)  # still queued: nothing processed
+    res = eng.run()
+    assert res[r1].finish_reason == "cancelled"
+    assert res[r1].tokens == []
+    assert res[r0].finish_reason in ("eos", "length")
+    assert eng.goodput()["wasted_evicted"] == 0
+    assert not eng.cancel(r1)  # already finished
+    assert not eng.cancel(999)  # unknown
+    assert eng.allocator.num_in_use == 0  # no page leaks
+
+
+def test_evicted_request_frees_pages_for_queue():
+    eng = _tiny_engine(slots=1, num_pages=5, page_size=8)
+    r0 = eng.submit(_prompt(4), 20)   # holds 3 pages of 4
+    r1 = eng.submit(_prompt(4, seed=1), 4)
+    eng.step()
+    assert eng.queue_depth == 1  # r1 blocked behind r0
+    assert eng.cancel(r0)
+    res = eng.run()
+    assert res[r0].finish_reason == "evicted"
+    assert res[r1].finish_reason in ("eos", "length")
+    assert len(res[r1].tokens) == 4 or res[r1].tokens[-1] == 0
+
+
+# -- satellite metrics -------------------------------------------------------
+
+def test_oldest_queued_gauge_and_admission_blocked(metrics_on):
+    eng = _tiny_engine(slots=1)
+    eng.submit(_prompt(4), 8)
+    eng.submit(_prompt(5, seed=1), 4)
+    eng.step()
+    gauge = telemetry.REGISTRY.gauge(OLDEST_QUEUED)
+    [(labels, child)] = gauge.series()
+    assert child.value > 0  # head-of-queue age visible BEFORE admission
+    blocked = telemetry.REGISTRY.counter(ADMISSION_BLOCKED)
+    assert blocked.value(reason="slots") >= 1.0
+    eng.run()
+    [(labels, child)] = gauge.series()
+    assert child.value == 0.0  # drained queue reads zero
+
+
+def test_admission_blocked_pages_reason(metrics_on):
+    eng = _tiny_engine(slots=2, num_pages=4, page_size=8)
+    eng.submit(_prompt(4), 20)  # 3 of the 3 allocatable pages
+    eng.submit(_prompt(4, seed=1), 4)
+    eng.step()
+    blocked = telemetry.REGISTRY.counter(ADMISSION_BLOCKED)
+    assert blocked.value(reason="pages") >= 1.0
+    eng.run()
+
+
+# -- page allocator health ---------------------------------------------------
+
+def test_allocator_occupancy_and_fragmentation():
+    alloc = PageAllocator(num_pages=9, page_size=8)
+    assert alloc.occupancy() == 0.0
+    assert alloc.fragmentation() == 0.0  # pristine free list: contiguous
+    a = alloc.alloc(3)
+    b = alloc.alloc(2)
+    assert alloc.occupancy() == pytest.approx(5 / 8)
+    alloc.free(a)  # free list now [4,5... then 1,2,3] — interleaved ids
+    assert 0.0 <= alloc.fragmentation() <= 1.0
+    alloc.free(b)
+    assert alloc.occupancy() == 0.0
+    # everything free again: ids 1..8 are one contiguous run
+    assert alloc.fragmentation() == 0.0

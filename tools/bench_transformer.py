@@ -50,6 +50,17 @@ def main():
                     help="KV page size for --serving")
     ap.add_argument("--seed", type=int, default=0,
                     help="trace seed for --serving")
+    ap.add_argument("--metrics-out",
+                    help="after --serving, write the telemetry registry "
+                         "snapshot (dump_json) here — the CI observability "
+                         "leg cross-checks it against the trace_merge "
+                         "--requests report")
+    ap.add_argument("--inject-latency", type=float, default=0.0,
+                    help="latency-inflation factor for the SLO negative "
+                         "self-test: scales the engine's injectable clock "
+                         "so every measured latency (TTFT, queue wait, "
+                         "request seconds) inflates by this factor "
+                         "without slowing the run; 0/1 = off")
     args = ap.parse_args()
 
     if args.serving:
@@ -195,8 +206,16 @@ def serving_bench(args):
         n_layers=args.n_layers, d_ff=args.d_ff, max_len=args.seq,
         dtype=args.dtype)
     params = tfm.init_params(cfg, seed=0)
+    factor = args.inject_latency
+    if factor and factor != 1.0:
+        # seeded latency inflation: the engine times everything off its
+        # injectable clock, so scaling it inflates every per-request
+        # latency sample deterministically — the SLO negative self-test
+        clock = lambda: time.monotonic() * factor  # noqa: E731
+    else:
+        clock = time.monotonic
     eng = ServingEngine(params, cfg, slots=args.slots,
-                        page_size=args.page_size)
+                        page_size=args.page_size, clock=clock)
 
     rng = np.random.RandomState(args.seed)
     max_prompt = max(4, min(cfg.max_len // 2, 3 * cfg.max_len // 4))
@@ -264,6 +283,21 @@ def serving_bench(args):
         "platform": jax.devices()[0].platform,
         "seed": args.seed,
     }
+    # goodput split + SLO verdicts ride along as non-numeric-safe extras
+    # (perf_gate flattens only numeric leaves; dicts are skipped, and no
+    # baseline names these keys, so existing serving.* baselines hold)
+    goodput = eng.goodput()
+    out["goodput"] = round(goodput["fraction"], 4)
+    out["tokens_split"] = {k: goodput[k] for k in
+                           ("prefill", "decode", "pad", "wasted_evicted")}
+    if eng.slo is not None:
+        slo_snap = eng.slo.snapshot()
+        out["slo"] = {name: row["state"] for name, row in slo_snap.items()}
+        out["slo_breaches"] = {name: row["breaches"]
+                               for name, row in slo_snap.items()}
+    telemetry.distributed.flush()  # traced runs: close out the frames
+    if args.metrics_out:
+        telemetry.dump_json(args.metrics_out)
     print(json.dumps(out))
     return 0
 

@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Polling text UI over the serving engine's /debug/engine endpoint —
+`top` for the continuous-batching engine.
+
+The telemetry HTTP server (MXNET_TELEMETRY_PORT / telemetry.enable(port))
+serves the engine's live snapshot at /debug/engine when
+MXTPU_DEBUG_ENDPOINTS=1; this tool polls it and renders the slot table,
+queue, page-pool health, goodput split, compile counters, and SLO state:
+
+    python tools/serving_top.py http://localhost:9090
+    python tools/serving_top.py localhost:9090 --interval 0.5
+    python tools/serving_top.py http://localhost:9090 --once
+    python tools/serving_top.py --file snapshot.json   # offline render
+
+Stdlib-only (urllib), same no-new-deps rule as the exporters it reads.
+"""
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+CLEAR = "\x1b[2J\x1b[H"
+
+
+def snapshot_url(target):
+    """Normalize a host[:port] or URL into the /debug/engine endpoint."""
+    if "://" not in target:
+        target = "http://" + target
+    target = target.rstrip("/")
+    if not target.endswith("/debug/engine"):
+        target += "/debug/engine"
+    return target
+
+
+def fetch(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _bar(fraction, width=20):
+    fraction = min(1.0, max(0.0, float(fraction)))
+    filled = int(round(fraction * width))
+    return "#" * filled + "-" * (width - filled)
+
+
+def render(snap):
+    """The whole screen as one string — pure function of the snapshot,
+    so tests render without a server."""
+    lines = []
+    pages = snap.get("pages", {})
+    tokens = snap.get("tokens", {})
+    lines.append(
+        f"serving engine  step {snap.get('steps', 0)}  "
+        f"slots {snap.get('slots_in_use', 0)}/{len(snap.get('slots', []))}  "
+        f"queue {snap.get('queue_depth', 0)}  "
+        f"finished {snap.get('requests_finished', 0)}")
+    lines.append(
+        f"pages  {pages.get('in_use', 0)}/{pages.get('capacity', 0)} "
+        f"[{_bar(pages.get('occupancy', 0.0))}] "
+        f"occupancy {pages.get('occupancy', 0.0):.2f}  "
+        f"fragmentation {pages.get('fragmentation', 0.0):.2f}")
+    lines.append(
+        f"tokens prefill {tokens.get('prefill', 0)}  "
+        f"decode {tokens.get('decode', 0)}  pad {tokens.get('pad', 0)}  "
+        f"evicted {tokens.get('wasted_evicted', 0)}  "
+        f"goodput {tokens.get('fraction', 1.0):.3f}")
+    lines.append("")
+    lines.append(f"{'slot':<6}{'state':<10}{'request':>9}{'age_s':>9}"
+                 f"{'prompt':>8}{'tokens':>8}{'pos':>6}{'pages':>7}")
+    for row in snap.get("slots", []):
+        if row.get("state") == "idle":
+            lines.append(f"{row['slot']:<6}{'idle':<10}")
+        else:
+            lines.append(
+                f"{row['slot']:<6}{row['state']:<10}"
+                f"{row['request_id']:>9}{row['age_s']:>9.3f}"
+                f"{row['prompt_len']:>8}{row['tokens_out']:>8}"
+                f"{row['position']:>6}{row['pages_held']:>7}")
+    queue = snap.get("queue", [])
+    if queue:
+        lines.append("")
+        lines.append(f"{'queued':<9}{'age_s':>9}{'prompt':>8}{'max_new':>9}")
+        for row in queue:
+            lines.append(f"{row['request_id']:<9}{row['age_s']:>9.3f}"
+                         f"{row['prompt_len']:>8}"
+                         f"{row['max_new_tokens']:>9}")
+    compile_rows = snap.get("compile") or {}
+    if compile_rows:
+        lines.append("")
+        lines.append(f"{'program':<26}{'signatures':>12}{'retraces':>10}")
+        for fn in sorted(compile_rows):
+            row = compile_rows[fn]
+            lines.append(f"{fn:<26}{row.get('signatures', 0):>12}"
+                         f"{row.get('retraces', 0):>10}")
+    slo = snap.get("slo")
+    if slo:
+        lines.append("")
+        lines.append(f"{'objective':<18}{'state':<10}{'burn_s':>9}"
+                     f"{'burn_l':>9}{'breaches':>10}")
+        for name in sorted(slo):
+            row = slo[name]
+            lines.append(
+                f"{name:<18}{row.get('state', '?'):<10}"
+                f"{row.get('burn_short', 0.0):>9.2f}"
+                f"{row.get('burn_long', 0.0):>9.2f}"
+                f"{row.get('breaches', 0):>10}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="polling text UI over /debug/engine")
+    ap.add_argument("target", nargs="?",
+                    help="telemetry server URL or host:port")
+    ap.add_argument("--file", help="render a snapshot JSON file instead "
+                                   "of polling a server")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="poll interval in seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit")
+    args = ap.parse_args(argv)
+
+    if args.file:
+        with open(args.file, encoding="utf-8") as f:
+            print(render(json.load(f)))
+        return 0
+    if not args.target:
+        ap.error("need a server target or --file")
+    url = snapshot_url(args.target)
+    while True:
+        try:
+            snap = fetch(url)
+        except (urllib.error.URLError, OSError) as e:
+            print(f"serving_top: {url}: {e}", file=sys.stderr)
+            return 1
+        if args.once:
+            print(render(snap))
+            return 0
+        sys.stdout.write(CLEAR + render(snap) + "\n")
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

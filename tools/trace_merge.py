@@ -11,6 +11,10 @@ spans to its own binary-framed trace file; this tool fuses them:
         --stragglers --check          # CI: nonzero exit on a bad timeline
     python tools/trace_merge.py /tmp/traces -o timeline.json --memory
         # also render HBM-ledger samples as a Perfetto counter track
+    python tools/trace_merge.py /tmp/traces -o timeline.json --requests
+        # serving view: one Perfetto lane per request (queued -> prefill
+        # -> decode under the serving.request root) plus a per-request
+        # report: TTFT, queue wait, tokens, decode steps, finish reason
 
 Open `timeline.json` in Perfetto (ui.perfetto.dev) or chrome://tracing:
 one row group ("process") per lane — r0, r1, ..., server — with the
@@ -173,6 +177,146 @@ def memory_counter_events(mem_records, offsets, pid_of):
     return events
 
 
+REQ_ROOT = "serving.request"
+REQ_CHILD_PREFIX = "serving.request."
+
+
+def _request_records(records):
+    """(root record by request id, child records by request id) for the
+    serving.request* lifecycle records."""
+    roots, children = {}, {}
+    for r in records:
+        rid = (r.get("extra") or {}).get("request")
+        if r["name"] == REQ_ROOT:
+            roots[rid] = r
+        elif r["name"].startswith(REQ_CHILD_PREFIX):
+            children.setdefault(rid, []).append(r)
+    return roots, children
+
+
+def request_report(records, req_steps):
+    """Per-request lifecycle report from the serving.request* records
+    plus the batched kind=req_step decode-progress records."""
+    roots, children = _request_records(records)
+    progress = {}
+    for r in req_steps:
+        for rid, _tokens in (r.get("slots") or []):
+            progress[rid] = progress.get(rid, 0) + 1
+    rows = []
+    for rid in sorted(roots, key=lambda x: (x is None, x)):
+        extra = roots[rid].get("extra") or {}
+        rows.append({
+            "request": rid,
+            "prompt_len": extra.get("prompt_len"),
+            "tokens": extra.get("tokens"),
+            "queue_wait_s": extra.get("queue_wait_s"),
+            "ttft_s": extra.get("ttft_s"),
+            "latency_s": extra.get("latency_s"),
+            "decode_steps": extra.get("decode_steps"),
+            "progress_steps": progress.get(rid, 0),
+            "finish": extra.get("finish"),
+            "stages": sorted(c["name"] for c in children.get(rid, [])),
+        })
+    return {"requests": rows, "count": len(rows)}
+
+
+def print_request_report(report):
+    print(f"{'request':<9}{'prompt':>7}{'tokens':>7}{'queue_s':>9}"
+          f"{'ttft_s':>9}{'latency_s':>11}{'steps':>7}  finish")
+    for row in report["requests"]:
+        def f(key, width):
+            v = row.get(key)
+            return f"{v:>{width}.4f}" if isinstance(v, float) else \
+                f"{str(v if v is not None else '-'):>{width}}"
+        print(f"{str(row['request']):<9}{f('prompt_len', 7)}"
+              f"{f('tokens', 7)}{f('queue_wait_s', 9)}{f('ttft_s', 9)}"
+              f"{f('latency_s', 11)}{f('decode_steps', 7)}"
+              f"  {row['finish'] or '-'}")
+    print(f"requests: {report['count']}")
+
+
+def request_lane_events(records, offsets, pid_of):
+    """One Perfetto process row per request — the root serving.request
+    span with its queued/prefill/decode stages nested inside. The same
+    records also appear in their engine lane; these synthetic lanes are
+    the per-request view the --requests flag promises."""
+    roots, children = _request_records(records)
+    events = []
+    for rid in sorted(roots, key=lambda x: (x is None, x)):
+        lane_name = f"req{rid}"
+        pid = max(pid_of.values(), default=0) + 1
+        pid_of[lane_name] = pid
+        events.append({"ph": "M", "name": "process_name",
+                       "pid": pid, "tid": 0,
+                       "args": {"name": lane_name}})
+        group = [roots[rid]] + children.get(rid, [])
+        for r in sorted(group, key=lambda r: (r["ts"], -r["dur_ns"])):
+            args = {"trace_id": r["tid"], "span_id": r["sid"]}
+            args.update(r.get("extra") or {})
+            events.append({
+                "ph": "X", "name": r["name"], "pid": pid, "tid": 0,
+                "ts": (r["ts"] + offsets.get(r["lane"], 0.0)) / 1000.0,
+                "dur": r["dur_ns"] / 1000.0, "args": args,
+            })
+    return events
+
+
+def check_requests(records, req_steps):
+    """Structural CI checks for the per-request view: every completed
+    request must form a well-formed lane. Returns problem strings."""
+    problems = []
+    roots, children = _request_records(records)
+    if not roots:
+        problems.append("--requests: no serving.request records")
+        return problems
+    progress = {}
+    for r in req_steps:
+        for rid, _tokens in (r.get("slots") or []):
+            progress[rid] = progress.get(rid, 0) + 1
+    for rid, root in sorted(roots.items(),
+                            key=lambda kv: (kv[0] is None, kv[0])):
+        extra = root.get("extra") or {}
+        finish = extra.get("finish")
+        where = f"request {rid}"
+        if rid is None or finish is None:
+            problems.append(f"{where}: root record missing "
+                            f"request/finish extras")
+            continue
+        ttft, latency = extra.get("ttft_s"), extra.get("latency_s")
+        if (isinstance(ttft, float) and isinstance(latency, float)
+                and finish != "cancelled" and ttft > latency + 1e-9):
+            problems.append(f"{where}: ttft {ttft} exceeds latency "
+                            f"{latency}")
+        kids = {c["name"]: c for c in children.get(rid, [])}
+        if len(kids) != len(children.get(rid, [])):
+            problems.append(f"{where}: duplicate stage records")
+        for c in kids.values():
+            if c["tid"] != root["tid"]:
+                problems.append(f"{where}: stage {c['name']} is outside "
+                                f"the request's trace id")
+            if c.get("pid") != root["sid"]:
+                problems.append(f"{where}: stage {c['name']} does not "
+                                f"parent under the root span")
+            if c["ts"] < root["ts"] - 1_000:
+                problems.append(f"{where}: stage {c['name']} starts "
+                                f"before the root span")
+        if finish == "cancelled":
+            continue  # never admitted: root-only lane is well-formed
+        for needed in (REQ_CHILD_PREFIX + "queued",
+                       REQ_CHILD_PREFIX + "prefill"):
+            if needed not in kids:
+                problems.append(f"{where}: missing {needed} record")
+        steps = extra.get("decode_steps")
+        if steps and (REQ_CHILD_PREFIX + "decode") not in kids:
+            problems.append(f"{where}: {steps} decode steps but no "
+                            f"decode stage record")
+        if steps is not None and progress.get(rid, 0) != steps:
+            problems.append(
+                f"{where}: {progress.get(rid, 0)} req_step progress "
+                f"entries disagree with decode_steps={steps}")
+    return problems
+
+
 def straggler_report(records, directory):
     """Per-lane barrier-wait ranking + retry/error evidence."""
     lanes = {}
@@ -273,17 +417,29 @@ def main(argv=None):
     ap.add_argument("--memory", action="store_true",
                     help="render HBM-ledger samples (kind=mem records) as "
                          "per-lane Perfetto counter tracks")
+    ap.add_argument("--requests", action="store_true",
+                    help="serving view: print the per-request lifecycle "
+                         "report and add one Perfetto lane per request; "
+                         "with --check also require every completed "
+                         "request to form a well-formed lane")
+    ap.add_argument("--requests-json",
+                    help="also write the per-request report as JSON "
+                         "(implies --requests)")
     args = ap.parse_args(argv)
+    if args.requests_json:
+        args.requests = True
 
     all_records, files = load_dir(args.trace_dir)
     if not files:
         print(f"trace_merge: no .mxtrace files in {args.trace_dir}",
               file=sys.stderr)
         return 1
-    # memory samples share the trace stream but are not spans (no sid/dur)
-    # — partition them out before the span pipeline touches those fields
+    # memory samples and serving decode-progress records share the trace
+    # stream but are not spans (no sid/dur) — partition them out before
+    # the span pipeline touches those fields
     mem_records = [r for r in all_records if r.get("kind") == "mem"]
-    records = [r for r in all_records if r.get("kind") != "mem"]
+    req_steps = [r for r in all_records if r.get("kind") == "req_step"]
+    records = [r for r in all_records if r.get("kind") is None]
     if not records:
         print(f"trace_merge: no span records in {args.trace_dir}",
               file=sys.stderr)
@@ -295,6 +451,17 @@ def main(argv=None):
         timeline["traceEvents"].extend(
             memory_counter_events(mem_records, offsets, pid_of))
         print(f"memory track: {len(mem_records)} HBM-ledger sample(s)")
+    req_report = None
+    if args.requests:
+        timeline["traceEvents"].extend(
+            request_lane_events(records, offsets, pid_of))
+        # the request lanes restart the clock from each request's submit;
+        # keep the global "spans sorted by corrected ts" invariant intact
+        meta = [e for e in timeline["traceEvents"] if e["ph"] != "X"]
+        spans = sorted((e for e in timeline["traceEvents"]
+                        if e["ph"] == "X"), key=lambda e: e["ts"])
+        timeline["traceEvents"] = meta + spans
+        req_report = request_report(records, req_steps)
     print(f"merged {len(records)} spans from {len(files)} trace file(s); "
           f"lanes: {', '.join(sorted({r['lane'] for r in records}))} "
           f"(clock anchor: {anchor})")
@@ -311,8 +478,16 @@ def main(argv=None):
     if args.report_json:
         with open(args.report_json, "w", encoding="utf-8") as f:
             json.dump(report, f, indent=2)
+    if req_report is not None:
+        print_request_report(req_report)
+        if args.requests_json:
+            with open(args.requests_json, "w", encoding="utf-8") as f:
+                json.dump(req_report, f, indent=2)
+            print(f"wrote {args.requests_json}")
     if args.check:
         problems = check_timeline(timeline, records)
+        if args.requests:
+            problems.extend(check_requests(records, req_steps))
         if problems:
             for p in problems:
                 print(f"trace_merge: CHECK FAILED: {p}", file=sys.stderr)
