@@ -583,16 +583,79 @@ run_serving() {
         --vocab 64 --seq 64 --serving-requests 12 --slots 3 \
         --page-size 8 > "$sv_dir/serving.json"
     python tools/perf_gate.py "$sv_dir/serving.json" \
-        --baseline ci/perf_baseline.json --subset serving
+        --baseline ci/perf_baseline.json --subset serving.
     # negative self-test: a seeded lost-request regression MUST fail
     if python tools/perf_gate.py "$sv_dir/serving.json" \
-        --baseline ci/perf_baseline.json --subset serving \
+        --baseline ci/perf_baseline.json --subset serving. \
         --inject serving.requests_completed=0.5 \
         > "$sv_dir/inject.log" 2>&1; then
         echo "FAIL: perf_gate passed a seeded lost-request regression" >&2
         cat "$sv_dir/inject.log" >&2
         exit 1
     fi
+    # -- serving lever legs ----------------------------------------------
+    # prefix-cache leg: seeded shared-system-prompt trace (half the
+    # requests share one 32-token prefix). Gates the hit rate, the
+    # >=50% prefill-token elimination, greedy token identity vs
+    # generate(), and zero steady-state retraces — all deterministic.
+    JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
+        MXTPU_COMPILE_CACHE_DIR="$sv_dir/cache_prefix" \
+        python tools/bench_transformer.py --serving \
+        --d-model 32 --n-layers 2 --n-heads 2 --d-ff 64 \
+        --vocab 64 --seq 64 --serving-requests 12 --slots 3 \
+        --page-size 8 --serving-tag prefix --prefix-cache 1 \
+        --shared-prefix-frac 0.5 --prefix-len 32 --verify-tokens \
+        > "$sv_dir/serving_prefix.json"
+    python tools/perf_gate.py "$sv_dir/serving_prefix.json" \
+        --baseline ci/perf_baseline.json --subset serving_prefix.
+    # negative self-test: a seeded prefix-hit-rate collapse MUST fail
+    if python tools/perf_gate.py "$sv_dir/serving_prefix.json" \
+        --baseline ci/perf_baseline.json --subset serving_prefix. \
+        --inject serving_prefix.prefix_hit_rate=0.2 \
+        > "$sv_dir/inject_prefix.log" 2>&1; then
+        echo "FAIL: perf_gate passed a seeded prefix-hit-rate collapse" >&2
+        cat "$sv_dir/inject_prefix.log" >&2
+        exit 1
+    fi
+    # chunked-prefill leg: same mixed trace with MXTPU_PREFILL_CHUNK=8.
+    # Wall-clock TTFT is report-only on shared runners; the gated
+    # improvement is the term that drives short-request p99 TTFT under
+    # load — the head-of-line blocking bound (max prefill tokens any
+    # single step computed) must be strictly below the unchunked run's.
+    JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
+        MXTPU_COMPILE_CACHE_DIR="$sv_dir/cache_chunked" \
+        python tools/bench_transformer.py --serving \
+        --d-model 32 --n-layers 2 --n-heads 2 --d-ff 64 \
+        --vocab 64 --seq 64 --serving-requests 12 --slots 3 \
+        --page-size 8 --serving-tag chunked --prefill-chunk 8 \
+        --verify-tokens > "$sv_dir/serving_chunked.json"
+    python tools/perf_gate.py "$sv_dir/serving_chunked.json" \
+        --baseline ci/perf_baseline.json --subset serving_chunked.
+    SV_DIR="$sv_dir" python - <<'EOF'
+import json, os
+sv = os.environ["SV_DIR"]
+off = json.load(open(os.path.join(sv, "serving.json")))
+on = json.load(open(os.path.join(sv, "serving_chunked.json")))
+assert on["max_step_prefill_tokens"] < off["max_step_prefill_tokens"], (
+    "chunked prefill did not reduce head-of-line blocking: "
+    f"{on['max_step_prefill_tokens']} !< {off['max_step_prefill_tokens']}")
+print("chunked prefill: per-step prefill bound "
+      f"{off['max_step_prefill_tokens']} -> {on['max_step_prefill_tokens']} "
+      f"tokens; short-request p99 TTFT {on['ttft_p99_short_s']}s "
+      f"(report-only) vs {off['ttft_p99_short_s']}s unchunked")
+EOF
+    # speculation leg: n-gram prompt-lookup with lookahead 4 — gates
+    # the acceptance rate, token identity, and zero steady retraces
+    JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 \
+        MXTPU_COMPILE_CACHE_DIR="$sv_dir/cache_spec" \
+        python tools/bench_transformer.py --serving \
+        --d-model 32 --n-layers 2 --n-heads 2 --d-ff 64 \
+        --vocab 64 --seq 64 --serving-requests 12 --slots 3 \
+        --page-size 8 --serving-tag spec --spec-ngram 2 \
+        --spec-lookahead 4 --verify-tokens \
+        > "$sv_dir/serving_spec.json"
+    python tools/perf_gate.py "$sv_dir/serving_spec.json" \
+        --baseline ci/perf_baseline.json --subset serving_spec.
     # -- serving observatory leg -----------------------------------------
     # traced rerun of the same seeded trace: every request must yield a
     # well-formed lifecycle lane, and the --requests report's TTFT
@@ -663,7 +726,7 @@ assert {"ttft_s", "latency_s", "finish"} <= set(
 print("serving observability: seeded breach detected, one post-mortem "
       "dump with request timelines")
 EOF
-    echo "serving tier: trace completed, zero steady-state retraces/fallbacks, seeded regression rejected, observatory legs green"
+    echo "serving tier: trace completed, zero steady-state retraces/fallbacks, seeded regression rejected, lever legs gated (prefix/chunked/spec token-identical), observatory legs green"
 }
 
 run_nightly() {

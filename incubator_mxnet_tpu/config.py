@@ -442,6 +442,33 @@ register_knob("MXTPU_PREFILL_BUCKETS", "", str,
               "MXTPU_SPARSE_NNZ_BUCKETING idea applied to sequence "
               "length). Empty (default) uses powers of two from 16 up "
               "to the model's max_len.")
+register_knob("MXTPU_PREFIX_CACHE", 0, int,
+              "Prefix-cached copy-on-write KV pages in the serving "
+              "engine (the vLLM block-sharing design): prompts sharing "
+              "a page-aligned token prefix map the cached pages "
+              "read-only instead of re-prefilling them. 0 (default) "
+              "disables — the engine is byte-identical to the uncached "
+              "path; 1 enables with an unbounded cache (bounded only by "
+              "pool pressure); >1 enables with an LRU cap of that many "
+              "cached pages. Cached pages are only evicted at refcount "
+              "0 (no live request mapped).")
+register_knob("MXTPU_PREFILL_CHUNK", 0, int,
+              "Chunked prefill (Sarathi-style): slice serving prompts "
+              "into chunks of this many tokens and interleave one chunk "
+              "per engine step with the batched decode, so short "
+              "requests' TTFT stops hiding behind long prompts. 0 "
+              "(default) disables — prompts prefill in one bucketed "
+              "program at admission.")
+register_knob("MXTPU_SPEC_NGRAM", 0, int,
+              "N-gram length for draft-free prompt-lookup speculative "
+              "decoding in the serving engine: the trailing n-gram of a "
+              "request's own token history is matched against earlier "
+              "history and the continuation proposed. 0 (default) "
+              "disables speculation.")
+register_knob("MXTPU_SPEC_LOOKAHEAD", 4, int,
+              "Tokens proposed per speculative decode step (the wide "
+              "verification program processes lookahead+1 query rows "
+              "per slot). Only meaningful when MXTPU_SPEC_NGRAM > 0.")
 
 # serving SLOs (telemetry/slo.py) — a threshold of 0 disables that
 # objective; when every threshold is 0 the serving engine attaches no

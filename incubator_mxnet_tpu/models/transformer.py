@@ -50,6 +50,7 @@ __all__ = [
     "init_paged_kv_cache",
     "decode_step_paged",
     "prefill_paged",
+    "decode_step_paged_wide",
 ]
 
 
@@ -493,6 +494,76 @@ def prefill_paged(params, paged, prompts, true_lens, page_table,
     h = _ln(x_last, params["ln_f_g"], params["ln_f_b"])
     logits = h @ params["embed"].T
     return {"k": new_k, "v": new_v}, logits
+
+
+def decode_step_paged_wide(params, paged, tokens, start, n_real, page_table,
+                           cfg: TransformerConfig):
+    """Q consecutive tokens per decode slot in ONE pass — the wider-query
+    decode program behind three serving levers: chunked prefill
+    (Q = chunk size, carrying the running position in `start`),
+    cached-prefix tail prefill (`start` = tokens mapped from the prefix
+    cache), and n-gram speculative verification (Q = lookahead + 1,
+    accepted prefixes advance positions in bulk).
+
+    tokens: (S, Q) int32 — token j of row s sits at position
+    start[s] + j; start: (S,) int32 — tokens already cached per slot;
+    n_real: (S,) int32 — rows write K/V only for j < n_real (tokens
+    beyond scatter to the null page: chunk-tail padding, dead slots).
+    Attention for query j covers positions < start[s] + j + 1 — the
+    paged prefix written by earlier calls plus intra-call causal — via
+    ops.pallas_kernels.paged_decode_attention_wide. Positions past the
+    page table's capacity or the positional table also land on the null
+    page (speculative rows may run past a sequence's last owned page;
+    their outputs are discarded by the caller).
+
+    Returns (logits (S, Q, V), new_paged). Shapes are static in
+    (S, Q, P_max, pool) — every call is one XLA program."""
+    S, Q = tokens.shape
+    num_pages, page_size = paged["k"].shape[1], paged["k"].shape[2]
+    j = jnp.arange(Q, dtype=jnp.int32)
+    pos = start[:, None] + j[None, :]  # (S, Q) global positions
+    cap = min(page_table.shape[1] * page_size, params["pos"].shape[0])
+    writable = (j[None, :] < n_real[:, None]) & (pos < cap)
+    safe_pos = jnp.where(pos < cap, pos, 0)
+    x = params["embed"][tokens] + params["pos"][safe_pos]  # (S, Q, d)
+    page = jnp.take_along_axis(page_table, safe_pos // page_size, axis=1)
+    write_idx = jnp.where(
+        writable, page * page_size + safe_pos % page_size, 0
+    ).reshape(S * Q)
+
+    stacked = {k: params[k] for k in _stack_keys(params)}
+
+    def body(x, layer_in):
+        lp, k_pool, v_pool = layer_in
+        h = _ln(x, lp["ln1_g"], lp["ln1_b"])
+        q = _split_heads(h @ lp["wq"], cfg.n_heads)  # (S, Q, H, Dh)
+        k = _split_heads(h @ lp["wk"], cfg.n_heads)
+        v = _split_heads(h @ lp["wv"], cfg.n_heads)
+        flat = (num_pages * page_size,) + k_pool.shape[2:]
+        kw = k.reshape((S * Q,) + k.shape[2:]).astype(k_pool.dtype)
+        vw = v.reshape((S * Q,) + v.shape[2:]).astype(v_pool.dtype)
+        k_pool = k_pool.reshape(flat).at[write_idx].set(kw).reshape(
+            k_pool.shape)
+        v_pool = v_pool.reshape(flat).at[write_idx].set(vw).reshape(
+            v_pool.shape)
+        from ..ops.pallas_kernels import paged_decode_attention_wide
+
+        a = paged_decode_attention_wide(q, k_pool, v_pool, page_table,
+                                        start)
+        x = x + a.reshape(S, Q, cfg.d_model) @ lp["wo"]
+        h = _ln(x, lp["ln2_g"], lp["ln2_b"])
+        if cfg.n_experts:
+            flat_h = h.reshape(S * Q, cfg.d_model)
+            out, _ = moe_ffn(flat_h, lp["router"], lp["w1"], lp["w2"])
+            x = x + out.reshape(S, Q, cfg.d_model)
+        else:
+            x = x + jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
+        return x, (k_pool, v_pool)
+
+    x, (new_k, new_v) = lax.scan(body, x, (stacked, paged["k"], paged["v"]))
+    x = _ln(x, params["ln_f_g"], params["ln_f_b"])
+    logits = x @ params["embed"].T
+    return logits, {"k": new_k, "v": new_v}
 
 
 def _filter_logits(logits, top_k=0, top_p=0.0):

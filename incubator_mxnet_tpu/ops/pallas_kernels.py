@@ -20,7 +20,8 @@ from jax.experimental import pallas as pl
 
 __all__ = ["flash_attention", "softmax_xent", "flash_decode",
            "dense_decode_attention", "paged_decode_attention",
-           "bn_act_epilogue", "DECODE_BLOCK", "DENSE_FALLBACKS_TOTAL"]
+           "paged_decode_attention_wide", "bn_act_epilogue",
+           "DECODE_BLOCK", "DENSE_FALLBACKS_TOTAL"]
 
 _NEG_INF = -1e30
 
@@ -802,3 +803,99 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, n_valid,
         interpret=interpret,
     )(pt, nv, qr, k_pages, v_pages)
     return o.reshape(B, H, D)
+
+
+def _paged_decode_wide_kernel(pt_ref, nb_ref, q_ref, k_ref, v_ref, o_ref, *,
+                              page_size, scale):
+    """One (b, h) grid step with Q query rows at consecutive positions:
+    row i sits at position nb + i and attends idx < nb + i + 1 — the
+    paged prefix plus causal masking WITHIN the call. Same page walk and
+    online-softmax accumulation as _paged_decode_kernel, with per-row
+    (Q,) carries instead of (1,)."""
+    b = pl.program_id(0)
+    q = q_ref[...]  # (Q, d)
+    nb = nb_ref[b]
+    n_q = q.shape[0]
+
+    def body(j, carry):
+        o, m, l = carry
+        page = pt_ref[b, j]
+        k = k_ref[pl.ds(page, 1)].reshape(page_size, -1)
+        v = v_ref[pl.ds(page, 1)].reshape(page_size, -1)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        idx = (j * page_size
+               + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        s = jnp.where(idx < nb + row + 1, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        o_new = o * alpha[:, None] + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    d = q.shape[1]
+    o0 = jnp.zeros((n_q, d), jnp.float32)
+    m0 = jnp.full((n_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((n_q,), jnp.float32)
+    # the deepest row attends nb + Q tokens; clamp the walk to the table
+    # width so speculative rows past a sequence's last owned page never
+    # index the table out of bounds (their outputs are discarded)
+    num_pages = jnp.minimum((nb + n_q + page_size - 1) // page_size,
+                            pt_ref.shape[1])
+    o, m, l = jax.lax.fori_loop(0, num_pages, body, (o0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[...] = (o / l[:, None]).astype(o_ref.dtype)
+
+
+def paged_decode_attention_wide(q, k_pages, v_pages, page_table, n_base,
+                                interpret=None):
+    """Wider-query attention over a paged KV cache: Q consecutive query
+    tokens per sequence in ONE launch.
+
+    q: (B, Q, H, D) — query i of sequence b sits at position
+    n_base[b] + i; k_pages/v_pages: (num_pages, page_size, H, D) pool
+    (the caller has already scattered the Q new tokens' K/V into it);
+    page_table: (B, P_max) int32; n_base: (B,) int32 — tokens cached
+    per sequence BEFORE this call's first query. Query i attends
+    positions < n_base + i + 1 (paged prefix + intra-call causal), so a
+    single launch serves chunked prefill (Q = chunk), cached-prefix
+    tail prefill (n_base = cached tokens) and speculative verification
+    (Q = lookahead + 1) — the vLLM/Sarathi "one kernel, many query
+    widths" trick on the repo's own page walk.
+
+    Returns (B, Q, H, D)."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, Q, H, D = q.shape
+    num_pages, page_size = k_pages.shape[0], k_pages.shape[1]
+    nb = _per_seq_n_valid(n_base, B)
+    pt = jnp.asarray(page_table, jnp.int32)
+    qr = q.transpose(0, 2, 1, 3)  # (B, H, Q, D)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, H),
+        in_specs=[
+            pl.BlockSpec((None, None, Q, D),
+                         lambda b, h, *refs: (b, h, 0, 0)),
+            pl.BlockSpec((num_pages, page_size, None, D),
+                         lambda b, h, *refs: (0, 0, h, 0)),
+            pl.BlockSpec((num_pages, page_size, None, D),
+                         lambda b, h, *refs: (0, 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, Q, D),
+                               lambda b, h, *refs: (b, h, 0, 0)),
+    )
+    kernel = functools.partial(_paged_decode_wide_kernel,
+                               page_size=page_size,
+                               scale=1.0 / np.sqrt(D))
+    o = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Q, D), q.dtype),
+        interpret=interpret,
+    )(pt, nb, qr, k_pages, v_pages)
+    return o.transpose(0, 2, 1, 3)

@@ -10,8 +10,16 @@ set of decode slots advance one token per step in ONE compiled program
 slots with bucketed prefill and evict on EOS/length with immediate page
 recycling. Every shape is static, so the steady state performs zero
 retraces — gated by telemetry.compilereg and warmed by compile_cache.
+
+Three optional levers stack on that base (each knob-off
+byte-identical): `pages.PrefixCache` prefix-shares page-aligned prompt
+KV copy-on-write (`MXTPU_PREFIX_CACHE`), chunked prefill interleaves
+prompt chunks with decode steps (`MXTPU_PREFILL_CHUNK`), and n-gram
+prompt-lookup speculation verifies drafts through one wide-query
+program (`MXTPU_SPEC_NGRAM`/`MXTPU_SPEC_LOOKAHEAD`).
 """
-from .pages import PageAllocator  # noqa: F401
+from .pages import PageAllocator, PrefixCache  # noqa: F401
 from .engine import Request, RequestResult, ServingEngine  # noqa: F401
 
-__all__ = ["PageAllocator", "Request", "RequestResult", "ServingEngine"]
+__all__ = ["PageAllocator", "PrefixCache", "Request", "RequestResult",
+           "ServingEngine"]

@@ -23,6 +23,28 @@ program per bucket. The steady state therefore performs ZERO retraces
 Greedy decoding (temperature 0) — token-for-token identical to
 sequential `models.transformer.generate()` per request, which is the
 equivalence CI asserts.
+
+Three OPTIONAL throughput levers stack on this substrate, each
+knob-off byte-identical to the base engine (no extra compiled programs,
+same outputs):
+
+- `MXTPU_PREFIX_CACHE` — prefix-cached copy-on-write pages (vLLM
+  block sharing): admission looks up the longest cached page-aligned
+  prefix of the prompt, maps those pages READ-ONLY into the new
+  request's table (a host table write instead of device prefill) and
+  prefills only the tail. A cached partial page is copied before the
+  tail writes into it; a freshly-cached partial page is copied on the
+  first decode write (`serving_page_copy`).
+- `MXTPU_PREFILL_CHUNK` — chunked prefill (Sarathi-Serve): prompts
+  stream through one wide-query program (`serving_wide_q{C}`) a chunk
+  per step, interleaved with the batched decode, so short requests
+  stop waiting behind long prompts.
+- `MXTPU_SPEC_NGRAM` / `MXTPU_SPEC_LOOKAHEAD` — draft-free prompt
+  lookup speculation: the trailing n-gram of each slot's own history
+  proposes up to `lookahead` tokens; ONE wide-query call verifies all
+  slots' proposals and accepted prefixes advance positions in bulk.
+  Rejected tails need no rollback — their K/V lands beyond every
+  live `n_valid` (dead data, overwritten by the next step's writes).
 """
 from __future__ import annotations
 
@@ -42,7 +64,7 @@ from ..telemetry import distributed as _dtrace
 from ..telemetry import exporters as _exporters
 from ..telemetry import recorder as _recorder
 from ..telemetry import slo as _slo
-from .pages import PageAllocator
+from .pages import PageAllocator, PrefixCache
 
 __all__ = ["Request", "RequestResult", "ServingEngine"]
 
@@ -59,6 +81,21 @@ OLDEST_QUEUED = "mxtpu_serving_oldest_queued_seconds"
 ADMISSION_BLOCKED = "mxtpu_serving_admission_blocked_total"
 WASTED_TOKENS = "mxtpu_serving_wasted_tokens_total"
 GOODPUT = "mxtpu_serving_goodput"
+PREFIX_LOOKUPS = "mxtpu_serving_prefix_lookups_total"
+PREFIX_TOKENS_SAVED = "mxtpu_serving_prefix_tokens_saved_total"
+PREFIX_CACHED_PAGES = "mxtpu_serving_prefix_cached_pages"
+COW_COPIES = "mxtpu_serving_cow_copies_total"
+PREFILL_CHUNKS = "mxtpu_serving_prefill_chunks_total"
+SPEC_PROPOSED = "mxtpu_spec_proposed_tokens_total"
+SPEC_ACCEPTED = "mxtpu_spec_accepted_tokens_total"
+
+# tail-prefill chunk width when the prefix cache is on but chunked
+# prefill is off: the tail still streams through the wide program (the
+# bucketed prefill can only start at position 0), in fixed-width chunks
+# so ONE wide signature covers every tail length
+_SYNC_TAIL_CHUNK = 32
+
+_EMPTY_PROP = np.zeros((0,), np.int32)
 
 # per-request lifecycle record names (registered in telemetry/names.py);
 # emitted straight through distributed.record_span — zero-cost when
@@ -98,6 +135,7 @@ class RequestResult:
     prompt_len: int
     queue_wait_s: float
     latency_s: float
+    ttft_s: float = 0.0  # 0.0 for cancelled-in-queue requests
 
 
 def _default_buckets(max_len):
@@ -128,7 +166,8 @@ class ServingEngine:
 
     def __init__(self, params, cfg, *, slots=None, page_size=None,
                  num_pages=None, max_len=None, clock=time.monotonic,
-                 slo=None):
+                 slo=None, prefix_cache=None, prefill_chunk=None,
+                 spec_ngram=None, spec_lookahead=None):
         self.params = params
         self.cfg = cfg
         self.page_size = int(page_size or config.get("MXTPU_PAGE_SIZE"))
@@ -148,6 +187,25 @@ class ServingEngine:
         self.prefill_buckets = _default_buckets(self.max_len)
         self._clock = clock
 
+        # perf levers (each defaults from its knob; constructor args
+        # override for tests/benches) — all off reproduces the base
+        # engine byte-for-byte: no extra jits are even constructed
+        if prefix_cache is None:
+            prefix_cache = int(config.get("MXTPU_PREFIX_CACHE"))
+        if prefill_chunk is None:
+            prefill_chunk = int(config.get("MXTPU_PREFILL_CHUNK"))
+        if spec_ngram is None:
+            spec_ngram = int(config.get("MXTPU_SPEC_NGRAM"))
+        if spec_lookahead is None:
+            spec_lookahead = int(config.get("MXTPU_SPEC_LOOKAHEAD"))
+        self.prefill_chunk = max(0, min(int(prefill_chunk), self.max_len))
+        self.spec_ngram = max(0, int(spec_ngram))
+        self.spec_lookahead = max(1, int(spec_lookahead))
+        self.prefix_cache = (
+            PrefixCache(self.allocator,
+                        max_pages=prefix_cache if prefix_cache > 1 else 0)
+            if prefix_cache else None)
+
         S, W = self.slots, self.table_width
         self._tables = np.zeros((S, W), np.int32)
         self._positions = np.zeros((S,), np.int32)
@@ -155,6 +213,11 @@ class ServingEngine:
         self._slot_req: list[Request | None] = [None] * S
         self._slot_pages: list[list] = [[] for _ in range(S)]
         self._slot_out: list[list] = [[] for _ in range(S)]
+        # lever slot state: pending chunked-prefill descriptor, and the
+        # table index whose page must copy-on-write before the slot's
+        # next decode write (-1 = none)
+        self._slot_prefill: list[dict | None] = [None] * S
+        self._slot_cow_idx = [-1] * S
         self._queue: deque[Request] = deque()
         self._results: dict[int, RequestResult] = {}
         self._ids = itertools.count()
@@ -163,8 +226,17 @@ class ServingEngine:
         # host-side goodput accounting (source of truth independent of
         # whether the metrics registry is enabled): device token-position
         # kinds, plus tokens spent on requests later evicted mid-stream
-        self._tokens = {"prefill": 0, "decode": 0, "pad": 0}
+        self._tokens = {"prefill": 0, "decode": 0, "pad": 0,
+                        "spec_rejected": 0}
         self._wasted_evicted = 0
+        # lever counters (host source of truth; mirrored to telemetry)
+        self._prefix_lookups = 0
+        self._prefix_hits = 0
+        self._prefix_tokens_saved = 0
+        self._cow_copies = 0
+        self._prefill_chunks = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         # last-N finished-request timelines, embedded in SLO breach dumps
         # and the /debug/engine snapshot
         self._timelines: deque = deque(
@@ -191,6 +263,17 @@ class ServingEngine:
                 jax.jit(self._prefill_fn, donate_argnums=donate),
                 donated=donate, static_key=T_b)
             for T_b in self.prefill_buckets}
+        # lever programs are built LAZILY (and the page-copy jit only
+        # when the prefix cache is on) so an all-knobs-off engine
+        # registers exactly the legacy compile sites
+        self._donate = donate
+        self._wides: dict = {}
+        if self.prefix_cache is not None:
+            copy_donate = (0,) if donate else ()
+            self._page_copy = compile_cache.wrap(
+                "serving_page_copy",
+                jax.jit(self._copy_fn, donate_argnums=copy_donate),
+                donated=copy_donate)
 
     # -- jitted programs ---------------------------------------------------
 
@@ -203,6 +286,30 @@ class ServingEngine:
         paged, logits = _tfm.prefill_paged(
             params, paged, prompt, true_len, table, self.cfg)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), paged
+
+    def _wide_fn(self, params, paged, tokens, start, n_real, table):
+        logits, paged = _tfm.decode_step_paged_wide(
+            params, paged, tokens, start, n_real, table, self.cfg)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), paged
+
+    def _copy_fn(self, paged, src, dst):
+        k, v = paged["k"], paged["v"]
+        return {"k": k.at[:, dst].set(k[:, src]),
+                "v": v.at[:, dst].set(v[:, src])}
+
+    def _wide(self, n_q):
+        """Wide-query program for `n_q` rows per slot — one named site
+        (`serving_wide_q{n_q}`) per width, so chunked prefill, prefix
+        tail prefill, and speculative verification each trace exactly
+        once and the steady state stays retrace-free."""
+        fn = self._wides.get(n_q)
+        if fn is None:
+            fn = compile_cache.wrap(
+                f"serving_wide_q{n_q}",
+                jax.jit(self._wide_fn, donate_argnums=self._donate),
+                donated=self._donate, static_key=n_q)
+            self._wides[n_q] = fn
+        return fn
 
     # -- public API --------------------------------------------------------
 
@@ -249,7 +356,12 @@ class ServingEngine:
         the number of live slots after the iteration."""
         with telemetry.span("serving.step", step=self.steps):
             self._admit()
-            live = self._decode_once()
+            if self.prefill_chunk:
+                self._prefill_chunks_once()
+            if self.spec_ngram:
+                live = self._decode_spec_once()
+            else:
+                live = self._decode_once()
         self.steps += 1
         self._export_gauges()
         return live
@@ -298,6 +410,29 @@ class ServingEngine:
                     jax.ShapeDtypeStruct((1, T_b), i32),
                     jax.ShapeDtypeStruct((1,), i32),
                     jax.ShapeDtypeStruct((1, W), i32))
+        # lever programs: exactly the wide widths the enabled knobs
+        # will call, plus the page-copy program when caching is on
+        wide_qs = set()
+        if self.prefill_chunk:
+            wide_qs.add(self.prefill_chunk)
+        elif self.prefix_cache is not None:
+            wide_qs.add(min(_SYNC_TAIL_CHUNK, self.max_len))
+        if self.spec_ngram:
+            wide_qs.add(self.spec_lookahead + 1)
+        for q in sorted(wide_qs):
+            fn = self._wide(q)
+            if getattr(fn, "is_cached", False):
+                out[f"serving_wide_q{q}"] = fn.warm(
+                    a(self.params), a(self.paged),
+                    jax.ShapeDtypeStruct((S, q), i32),
+                    jax.ShapeDtypeStruct((S,), i32),
+                    jax.ShapeDtypeStruct((S,), i32),
+                    jax.ShapeDtypeStruct((S, W), i32))
+        if (self.prefix_cache is not None
+                and getattr(self._page_copy, "is_cached", False)):
+            out["serving_page_copy"] = self._page_copy.warm(
+                a(self.paged), jax.ShapeDtypeStruct((), i32),
+                jax.ShapeDtypeStruct((), i32))
         return out
 
     # -- scheduling internals ----------------------------------------------
@@ -319,12 +454,17 @@ class ServingEngine:
         """FIFO admission: stop at the first request that can't get a
         slot or its pages (head-of-line order keeps scheduling
         deterministic — no small request overtakes a starved big one)."""
+        levered = self.prefix_cache is not None or self.prefill_chunk
         while self._queue:
             slot = self._free_slot()
             if slot is None:
                 telemetry.inc(ADMISSION_BLOCKED, reason="slots")
                 return
             req = self._queue[0]
+            if levered:
+                if not self._admit_levered(slot, req):
+                    return  # backpressure: wait for an eviction
+                continue
             total = req.prompt.size + req.max_new_tokens
             pages = self.allocator.alloc(self.allocator.pages_needed(total))
             if pages is None:
@@ -389,11 +529,343 @@ class ServingEngine:
         if self._is_done(req, [first]):
             self._finish(slot)
 
+    # -- lever path: prefix-cached COW pages + chunked prefill -------------
+
+    def _admit_levered(self, slot, req):
+        """Admission with the prefix-cache / chunked-prefill levers on:
+        map the longest cached page-aligned prefix read-only into the
+        slot's table (a host write instead of device prefill), allocate
+        fresh pages for the rest, then stream only the uncached tail
+        through the wide program — synchronously here, or one chunk per
+        step when chunked prefill is on. Returns False on page
+        backpressure (the request stays queued)."""
+        ps = self.page_size
+        T_p = req.prompt.size
+        w_req = self.allocator.pages_needed(T_p + req.max_new_tokens)
+        used_full, part_page, n_part = [], None, 0
+        if self.prefix_cache is not None:
+            full_pages, partial = self.prefix_cache.lookup(req.prompt)
+            # the LAST prompt token is always recomputed — its logits
+            # are the first output token, which a table write can't give
+            limit = T_p - 1
+            n_full = min(len(full_pages), limit // ps)
+            used_full = full_pages[:n_full]
+            if partial is not None and n_full == len(full_pages):
+                page, chunk = partial
+                n_part = min(int(chunk.size), limit - n_full * ps)
+                part_page = page if n_part > 0 else None
+                n_part = max(0, n_part) if part_page is not None else 0
+        n_cached = len(used_full) * ps + n_part
+        # references: mapped full pages are shared for the slot's whole
+        # lifetime; the cached partial page is pinned only until its
+        # bytes are copied into a fresh page below
+        protect = used_full + ([part_page] if part_page is not None
+                               else [])
+        self.allocator.share(protect)
+        fresh = self.allocator.alloc(w_req - len(used_full))
+        if fresh is None and self.prefix_cache is not None:
+            # pool pressure: LRU-evict cache pages no live request maps
+            deficit = (w_req - len(used_full)) - self.allocator.num_free
+            self.prefix_cache.evict(deficit)
+            fresh = self.allocator.alloc(w_req - len(used_full))
+        if fresh is None:
+            self.allocator.free(protect)
+            telemetry.inc(ADMISSION_BLOCKED, reason="pages")
+            return False
+        if self.prefix_cache is not None:
+            self._prefix_lookups += 1
+            hit = n_cached > 0
+            self._prefix_hits += int(hit)
+            self._prefix_tokens_saved += n_cached
+            telemetry.inc(PREFIX_LOOKUPS,
+                          outcome="hit" if hit else "miss")
+            if n_cached:
+                telemetry.inc(PREFIX_TOKENS_SAVED,
+                              amount=float(n_cached))
+        self._queue.popleft()
+        req.admitted_at = self._clock()
+        telemetry.observe(QUEUE_WAIT_SECONDS,
+                          req.admitted_at - req.submitted_at,
+                          buckets=_LATENCY_BUCKETS)
+        telemetry.set_gauge(QUEUE_DEPTH, len(self._queue))
+        if req.trace is not None:
+            self._emit_request_record(
+                REQ_QUEUED_SPAN, req.trace, ts=req.trace["ns_submit"],
+                dur_s=req.admitted_at - req.submitted_at,
+                pid=req.trace["sid"],
+                extra={"request": req.request_id})
+        pages = used_full + fresh
+        row = np.asarray(
+            self.allocator.table_row(pages, self.table_width), np.int32)
+        if part_page is not None:
+            # eager copy-on-write: the tail prefill writes into this
+            # page's token range, so the slot gets a private copy of
+            # the cached bytes first
+            self.paged = self._page_copy(
+                self.paged, jnp.asarray(part_page, jnp.int32),
+                jnp.asarray(fresh[0], jnp.int32))
+            self.allocator.free([part_page])  # drop the pin only
+            self._cow_copies += 1
+            telemetry.inc(COW_COPIES, site="admit")
+        self._slot_req[slot] = req
+        self._slot_pages[slot] = pages
+        self._slot_out[slot] = []
+        self._slot_prefill[slot] = {
+            "prompt": req.prompt, "row": row, "pos": n_cached,
+            "n_cached": n_cached, "chunks": 0,
+            "clk_start": self._clock()}
+        if not self.prefill_chunk:
+            # synchronous tail prefill: run every chunk before the next
+            # admission (chunked mode instead leaves the descriptor for
+            # step() to advance one chunk per iteration)
+            while self._slot_prefill[slot] is not None:
+                self._prefill_chunks_once(only_slot=slot)
+        return True
+
+    def _prefill_chunks_once(self, only_slot=None):
+        """Advance pending prefills one chunk in ONE wide-program call
+        covering every mid-prefill slot; decoding/idle slots ride along
+        masked out (n_real=0, zero table rows — writes land in the null
+        page), so the call shape is static."""
+        pend = [s for s in range(self.slots)
+                if self._slot_prefill[s] is not None
+                and (only_slot is None or s == only_slot)]
+        if not pend:
+            return
+        C = self.prefill_chunk or min(_SYNC_TAIL_CHUNK, self.max_len)
+        S, W = self.slots, self.table_width
+        toks = np.zeros((S, C), np.int32)
+        start = np.zeros((S,), np.int32)
+        n_real = np.zeros((S,), np.int32)
+        tables = np.zeros((S, W), np.int32)
+        for s in pend:
+            st = self._slot_prefill[s]
+            pos, prompt = st["pos"], st["prompt"]
+            n = min(C, prompt.size - pos)
+            toks[s, :n] = prompt[pos:pos + n]
+            start[s] = pos
+            n_real[s] = n
+            tables[s] = st["row"]
+        with telemetry.span("serving.prefill_chunk", slots=len(pend)):
+            out, self.paged = self._wide(C)(
+                self.params, self.paged, jnp.asarray(toks),
+                jnp.asarray(start), jnp.asarray(n_real),
+                jnp.asarray(tables))
+        out = np.asarray(out)
+        for s in pend:
+            st = self._slot_prefill[s]
+            n = int(n_real[s])
+            st["pos"] += n
+            st["chunks"] += 1
+            self._prefill_chunks += 1
+            self._tokens["prefill"] += n
+            telemetry.inc(TOKENS_TOTAL, amount=float(n), kind="prefill")
+            telemetry.inc(PREFILL_CHUNKS)
+            pad = C - n
+            if pad:
+                self._tokens["pad"] += pad
+                telemetry.inc(TOKENS_TOTAL, amount=float(pad),
+                              kind="pad")
+                telemetry.inc(WASTED_TOKENS, amount=float(pad),
+                              reason="prefill_pad")
+            if st["pos"] >= st["prompt"].size:
+                self._finish_prefill(s, int(out[s, n - 1]))
+
+    def _finish_prefill(self, slot, first):
+        """Last tail chunk done: record TTFT, install the slot's decode
+        state, register the prompt's pages in the prefix cache, and arm
+        the lazy copy-on-write if caching shared the page the first
+        decode token will write into."""
+        st = self._slot_prefill[slot]
+        self._slot_prefill[slot] = None
+        req = self._slot_req[slot]
+        prompt = st["prompt"]
+        T_p = prompt.size
+        clk_first = self._clock()
+        req.ttft_s = clk_first - req.submitted_at
+        telemetry.observe(TTFT_SECONDS, req.ttft_s,
+                          buckets=_LATENCY_BUCKETS)
+        if req.trace is not None:
+            req.trace["clk_first"] = clk_first
+            self._emit_request_record(
+                REQ_PREFILL_SPAN, req.trace,
+                ts=self._trace_ts(req.trace, st["clk_start"]),
+                dur_s=clk_first - st["clk_start"], pid=req.trace["sid"],
+                extra={"request": req.request_id,
+                       "prompt_len": int(T_p),
+                       "cached": int(st["n_cached"]),
+                       "chunks": int(st["chunks"])})
+        self._slot_out[slot] = [first]
+        self._tables[slot] = st["row"]
+        self._positions[slot] = T_p
+        self._next_tok[slot] = first
+        if self.prefix_cache is not None:
+            n_prompt_pages = self.allocator.pages_needed(T_p)
+            self.prefix_cache.insert(
+                prompt, self._slot_pages[slot][:n_prompt_pages])
+            telemetry.set_gauge(PREFIX_CACHED_PAGES,
+                                self.prefix_cache.cached_pages)
+            # the page the first decode token (position T_p) writes
+            # into: if insert() just shared the slot's own partial tail
+            # page, it must copy-on-write before that write lands
+            wi = T_p // self.page_size
+            if (T_p % self.page_size
+                    and wi < len(self._slot_pages[slot])
+                    and self.allocator.refcount(
+                        self._slot_pages[slot][wi]) > 1):
+                self._slot_cow_idx[slot] = wi
+        if self._is_done(req, [first]):
+            self._finish(slot)
+
+    def _resolve_cow(self, slot):
+        """The slot's next decode write lands in a shared
+        partially-filled page: give it a private page first. Fallbacks
+        when the pool has no page for the copy: steal the cache's own
+        reference back (the writer becomes exclusive — no copy
+        needed), else LRU-evict one cached page and retry."""
+        idx = self._slot_cow_idx[slot]
+        self._slot_cow_idx[slot] = -1
+        page = self._slot_pages[slot][idx]
+        new = self.allocator.cow(page)
+        if new is None:
+            if self.prefix_cache.release(page):
+                return  # cache ref dropped; the slot now owns the page
+            if self.prefix_cache.evict(1):
+                new = self.allocator.cow(page)
+        if new is None:
+            raise RuntimeError(
+                f"copy-on-write of page {page} failed: KV pool "
+                f"exhausted and the prefix cache holds no evictable "
+                f"page")
+        if new != page:
+            self.paged = self._page_copy(
+                self.paged, jnp.asarray(page, jnp.int32),
+                jnp.asarray(new, jnp.int32))
+            self._slot_pages[slot][idx] = new
+            self._tables[slot, idx] = new
+            self._cow_copies += 1
+            telemetry.inc(COW_COPIES, site="decode")
+
+    # -- lever path: n-gram prompt-lookup speculation ----------------------
+
+    def _propose(self, prompt, out, k):
+        """Prompt-lookup proposal: match the trailing `spec_ngram`
+        tokens of the slot's history (prompt + generated) against
+        earlier history and propose up to `k` continuation tokens of
+        the most recent prior match."""
+        n = self.spec_ngram
+        hist = np.concatenate([prompt, np.asarray(out, np.int32)])
+        if hist.size < n + 1:
+            return _EMPTY_PROP
+        gram = hist[-n:]
+        for i in range(hist.size - n - 1, -1, -1):
+            if np.array_equal(hist[i:i + n], gram):
+                return hist[i + n:i + n + k].astype(np.int32)
+        return _EMPTY_PROP
+
+    def _decode_spec_once(self):
+        """Speculative decode step: every live slot processes
+        `lookahead+1` query rows in one wide program — its guaranteed
+        next token plus its proposal. The longest proposal prefix
+        matching the model's own greedy outputs is accepted in bulk;
+        rejected rows need no rollback (their K/V sits beyond the
+        slot's advanced position — dead data the next step
+        overwrites)."""
+        live_slots = [s for s, r in enumerate(self._slot_req)
+                      if r is not None and self._slot_prefill[s] is None]
+        if not live_slots:
+            return self.slots_in_use
+        if self.prefix_cache is not None:
+            for s in live_slots:
+                if self._slot_cow_idx[s] >= 0:
+                    self._resolve_cow(s)
+        S = self.slots
+        Q = self.spec_lookahead + 1
+        toks = np.zeros((S, Q), np.int32)
+        start = np.zeros((S,), np.int32)
+        n_real = np.zeros((S,), np.int32)
+        props = {}
+        for s in live_slots:
+            req = self._slot_req[s]
+            room = req.max_new_tokens - len(self._slot_out[s]) - 1
+            k_s = min(self.spec_lookahead, room)
+            prop = (self._propose(req.prompt, self._slot_out[s], k_s)
+                    if k_s > 0 else _EMPTY_PROP)
+            props[s] = prop
+            toks[s, 0] = self._next_tok[s]
+            if prop.size:
+                toks[s, 1:1 + prop.size] = prop
+            start[s] = self._positions[s]
+            n_real[s] = 1 + prop.size
+        tok, self.paged = self._wide(Q)(
+            self.params, self.paged, jnp.asarray(toks),
+            jnp.asarray(start), jnp.asarray(n_real),
+            jnp.asarray(self._tables))
+        tok = np.asarray(tok)
+        for s in live_slots:
+            req = self._slot_req[s]
+            prop = props[s]
+            # row i's argmax is the model's true greedy token i+1; the
+            # proposal is accepted exactly as far as it matches them
+            emitted = [int(tok[s, 0])]
+            for i in range(prop.size):
+                if int(prop[i]) != emitted[i]:
+                    break
+                emitted.append(int(tok[s, i + 1]))
+            accepted = len(emitted) - 1
+            self._spec_proposed += int(prop.size)
+            self._spec_accepted += accepted
+            if prop.size:
+                telemetry.inc(SPEC_PROPOSED, amount=float(prop.size))
+            if accepted:
+                telemetry.inc(SPEC_ACCEPTED, amount=float(accepted))
+            applied = 0
+            for t in emitted:
+                applied += 1
+                self._slot_out[s].append(t)
+                self._positions[s] += 1
+                self._next_tok[s] = t
+                if self._is_done(req, self._slot_out[s]):
+                    self._finish(s)
+                    break
+            # Q device rows split: delivered tokens, rejected/unused
+            # speculation rows, and padding rows past the proposal
+            rejected = (1 + int(prop.size)) - applied
+            pad = Q - 1 - int(prop.size)
+            self._tokens["decode"] += applied
+            telemetry.inc(TOKENS_TOTAL, amount=float(applied),
+                          kind="decode")
+            if rejected:
+                self._tokens["spec_rejected"] += rejected
+                telemetry.inc(TOKENS_TOTAL, amount=float(rejected),
+                              kind="spec_rejected")
+                telemetry.inc(WASTED_TOKENS, amount=float(rejected),
+                              reason="spec_rejected")
+            if pad:
+                self._tokens["pad"] += pad
+                telemetry.inc(TOKENS_TOTAL, amount=float(pad),
+                              kind="pad")
+                telemetry.inc(WASTED_TOKENS, amount=float(pad),
+                              reason="spec_pad")
+        if _dtrace.trace_active():
+            _dtrace.record_span({
+                "kind": REQ_STEP_KIND, "ts": time.time_ns(),
+                "step": self.steps,
+                "slots": [[self._slot_req[s].request_id,
+                           len(self._slot_out[s]) + 1]
+                          for s in live_slots
+                          if self._slot_req[s] is not None]})
+        return self.slots_in_use
+
     def _decode_once(self):
         live_slots = [s for s, r in enumerate(self._slot_req)
-                      if r is not None]
+                      if r is not None and self._slot_prefill[s] is None]
         if not live_slots:
-            return 0
+            return self.slots_in_use
+        if self.prefix_cache is not None:
+            for s in live_slots:
+                if self._slot_cow_idx[s] >= 0:
+                    self._resolve_cow(s)
         tok, self.paged = self._decode(
             self.params, self.paged, jnp.asarray(self._next_tok),
             jnp.asarray(self._positions), jnp.asarray(self._tables))
@@ -442,7 +914,8 @@ class ServingEngine:
         self._results[req.request_id] = RequestResult(
             request_id=req.request_id, tokens=list(out),
             finish_reason=reason, prompt_len=int(req.prompt.size),
-            queue_wait_s=queue_wait, latency_s=latency)
+            queue_wait_s=queue_wait, latency_s=latency,
+            ttft_s=req.ttft_s)
         telemetry.inc(REQUESTS_TOTAL, outcome=reason)
         telemetry.observe(REQUEST_SECONDS, latency,
                           buckets=_LATENCY_BUCKETS)
@@ -485,6 +958,8 @@ class ServingEngine:
         self._slot_req[slot] = None
         self._slot_pages[slot] = []
         self._slot_out[slot] = []
+        self._slot_prefill[slot] = None
+        self._slot_cow_idx[slot] = -1
         self._tables[slot] = 0
         self._positions[slot] = 0
         self._next_tok[slot] = 0
@@ -530,8 +1005,8 @@ class ServingEngine:
 
     def goodput(self):
         """Token accounting split: device token-positions by kind, the
-        wasted share (prefill padding + evicted requests' tokens), and
-        the useful fraction."""
+        wasted share (prefill padding + rejected speculation + evicted
+        requests' tokens), and the useful fraction."""
         processed = sum(self._tokens.values())
         useful = (self._tokens["prefill"] + self._tokens["decode"]
                   - self._wasted_evicted)
@@ -539,11 +1014,37 @@ class ServingEngine:
             "prefill": self._tokens["prefill"],
             "decode": self._tokens["decode"],
             "pad": self._tokens["pad"],
+            "spec_rejected": self._tokens["spec_rejected"],
             "wasted_evicted": self._wasted_evicted,
             "processed": processed,
             "useful": useful,
             "fraction": useful / processed if processed else 1.0,
         }
+
+    @property
+    def prefix_hit_rate(self):
+        """Fraction of admissions that mapped at least one cached page
+        (0.0 when the prefix cache is off or nothing was admitted)."""
+        return (self._prefix_hits / self._prefix_lookups
+                if self._prefix_lookups else 0.0)
+
+    @property
+    def prefix_tokens_saved(self):
+        """Prompt tokens never prefilled because their pages came from
+        the prefix cache."""
+        return self._prefix_tokens_saved
+
+    @property
+    def cow_copies(self):
+        """Copy-on-write page copies performed (admission + decode)."""
+        return self._cow_copies
+
+    @property
+    def spec_acceptance(self):
+        """Accepted / proposed draft tokens (0.0 before any
+        proposal)."""
+        return (self._spec_accepted / self._spec_proposed
+                if self._spec_proposed else 0.0)
 
     def _goodput_fraction(self):
         processed = sum(self._tokens.values())
@@ -562,13 +1063,16 @@ class ServingEngine:
             if req is None:
                 slot_rows.append({"slot": s, "state": "idle"})
             else:
+                pending = self._slot_prefill[s]
                 slot_rows.append({
-                    "slot": s, "state": "decoding",
+                    "slot": s,
+                    "state": "prefilling" if pending else "decoding",
                     "request_id": req.request_id,
                     "age_s": now - req.submitted_at,
                     "prompt_len": int(req.prompt.size),
                     "tokens_out": len(self._slot_out[s]),
-                    "position": int(self._positions[s]),
+                    "position": (int(pending["pos"]) if pending
+                                 else int(self._positions[s])),
                     "pages_held": len(self._slot_pages[s]),
                 })
         queued = [{"request_id": r.request_id,
@@ -580,8 +1084,41 @@ class ServingEngine:
             fn: {"signatures": v["signatures"], "retraces": v["retraces"]}
             for fn, v in compilereg.snapshot().items()
             if fn.startswith("serving_")}
+        cache = self.prefix_cache
+        prefix_rows = None
+        if cache is not None:
+            prefix_rows = {
+                "cached_pages": cache.cached_pages,
+                "capacity": cache.max_pages,
+                "lookups": self._prefix_lookups,
+                "hits": self._prefix_hits,
+                "hit_rate": self.prefix_hit_rate,
+                "tokens_saved": self._prefix_tokens_saved,
+                "evictions": cache.evictions,
+                "cow_copies": self._cow_copies,
+                "refcount_histogram": {
+                    str(k): v for k, v in sorted(
+                        self.allocator.refcount_histogram().items())},
+            }
+        spec_rows = None
+        if self.spec_ngram:
+            spec_rows = {
+                "ngram": self.spec_ngram,
+                "lookahead": self.spec_lookahead,
+                "proposed": self._spec_proposed,
+                "accepted": self._spec_accepted,
+                "acceptance": self.spec_acceptance,
+            }
+        chunk_rows = None
+        if self.prefill_chunk:
+            chunk_rows = {
+                "chunk": self.prefill_chunk,
+                "in_flight": sum(p is not None
+                                 for p in self._slot_prefill),
+                "chunks_total": self._prefill_chunks,
+            }
         return {
-            "schema": "mxtpu-serving-engine-debug-v1",
+            "schema": "mxtpu-serving-engine-debug-v2",
             "steps": self.steps,
             "slots": slot_rows,
             "slots_in_use": self.slots_in_use,
@@ -595,6 +1132,9 @@ class ServingEngine:
                 "occupancy": self.allocator.occupancy(),
                 "fragmentation": self.allocator.fragmentation(),
             },
+            "prefix_cache": prefix_rows,
+            "speculation": spec_rows,
+            "chunked_prefill": chunk_rows,
             "tokens": self.goodput(),
             "compile": compile_rows,
             "slo": self.slo.snapshot() if self.slo is not None else None,
@@ -652,3 +1192,6 @@ class ServingEngine:
             self._clock() - self._queue[0].submitted_at
             if self._queue else 0.0)
         telemetry.set_gauge(GOODPUT, self._goodput_fraction())
+        if self.prefix_cache is not None:
+            telemetry.set_gauge(PREFIX_CACHED_PAGES,
+                                self.prefix_cache.cached_pages)

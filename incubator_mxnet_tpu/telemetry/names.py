@@ -218,6 +218,34 @@ METRIC_NAMES = {
     "mxtpu_serving_goodput": (
         "gauge", "Fraction of processed serving tokens that were useful "
                  "(neither padding nor spent on evicted requests)."),
+    "mxtpu_serving_prefix_lookups_total": (
+        "counter", "Prefix-cache lookups at admission, by outcome (hit "
+                   "= at least one cached page mapped, miss = full "
+                   "prefill)."),
+    "mxtpu_serving_prefix_tokens_saved_total": (
+        "counter", "Prompt tokens NOT prefilled because their KV pages "
+                   "came from the prefix cache (table writes instead of "
+                   "device compute)."),
+    "mxtpu_serving_prefix_cached_pages": (
+        "gauge", "KV pages currently held by the prefix cache (each "
+                 "carries one allocator reference until LRU-evicted)."),
+    "mxtpu_serving_cow_copies_total": (
+        "counter", "Copy-on-write page copies, by site (admit = cached "
+                   "partial page copied before a tail prefill writes "
+                   "into it, decode = first decode token landing in a "
+                   "shared partially-filled page)."),
+    "mxtpu_serving_prefill_chunks_total": (
+        "counter", "Prefill chunks executed by the chunked-prefill "
+                   "path (one wide-query program call covers every "
+                   "mid-prefill slot's next chunk)."),
+    "mxtpu_spec_proposed_tokens_total": (
+        "counter", "Draft tokens proposed by the n-gram prompt-lookup "
+                   "speculator (excludes the one guaranteed token per "
+                   "step)."),
+    "mxtpu_spec_accepted_tokens_total": (
+        "counter", "Proposed draft tokens accepted by wide-query "
+                   "verification (acceptance rate = accepted / "
+                   "proposed)."),
     "mxtpu_slo_burn_rate": (
         "gauge", "SLO error-budget burn rate (bad_fraction / budget), "
                  "by objective and window (short / long)."),
@@ -245,6 +273,7 @@ SPAN_NAMES = frozenset({
     "embedding.push",
     "serving.step",
     "serving.prefill",
+    "serving.prefill_chunk",
     # per-request lifecycle records (trace-only; emitted straight
     # through distributed.record_span, one lane per request in the
     # trace_merge --requests view)

@@ -357,3 +357,294 @@ def test_engine_telemetry_gauges(monkeypatch):
         monkeypatch.delenv("MXNET_TELEMETRY", raising=False)
         telemetry.refresh_from_env()
         telemetry.REGISTRY.reset()
+
+
+# -- refcounted allocator + prefix cache -------------------------------------
+
+def test_allocator_share_free_keeps_page_live():
+    """share() adds a reference: the first free() only decrements, the
+    LAST deref recycles the page into the pool."""
+    a = PageAllocator(6, 4)
+    pages = a.alloc(2)
+    a.share(pages)
+    assert all(a.refcount(p) == 2 for p in pages)
+    a.free(pages)  # one of two refs: pages stay live
+    assert a.num_in_use == 2 and a.num_free == 3
+    assert all(a.refcount(p) == 1 for p in pages)
+    a.free(pages)  # last deref recycles
+    assert a.num_in_use == 0 and a.num_free == 5
+    assert all(a.refcount(p) == 0 for p in pages)
+    # sharing a dead page would read recycled garbage: must raise
+    with pytest.raises(ValueError):
+        a.share([pages[0]])
+
+
+def test_allocator_cow_semantics():
+    """cow() copies exactly once: an exclusive page returns itself (no
+    copy), a shared page yields a fresh exclusive id and moves one
+    reference; an empty pool returns None without touching state."""
+    a = PageAllocator(4, 4)
+    (p,) = a.alloc(1)
+    assert a.cow(p) == p  # refcount 1: no copy needed
+    a.share([p])
+    fresh = a.cow(p)
+    assert fresh not in (None, p)
+    assert a.refcount(p) == 1 and a.refcount(fresh) == 1
+    # pool now exhausted: a second cow on a re-shared page cannot copy
+    a.share([p])
+    (last,) = a.alloc(1)
+    assert a.cow(p) is None
+    assert a.refcount(p) == 2  # unchanged on failure
+    a.free([last])
+    assert a.cow(p) != p  # retry succeeds once a page frees
+    with pytest.raises(ValueError):
+        a.cow(99)
+
+
+def test_allocator_gauges_count_shared_pages_once():
+    a = PageAllocator(8, 4)
+    pages = a.alloc(3)
+    a.share(pages)
+    a.share(pages[:1])
+    assert a.num_in_use == 3  # 3 physical pages, 7 references
+    assert a.occupancy() == 3 / 7
+    assert a.refcount_histogram() == {2: 2, 3: 1}
+
+
+def test_prefix_cache_insert_lookup_roundtrip():
+    from incubator_mxnet_tpu.serving import PrefixCache
+    a = PageAllocator(12, 4)
+    cache = PrefixCache(a)
+    prompt = np.arange(1, 11, dtype=np.int32)  # 10 tokens: 2 full + tail 2
+    pages = a.alloc(3)
+    newly = cache.insert(prompt, pages)
+    assert newly == {0, 1, 2}
+    assert cache.cached_pages == 3
+    assert all(a.refcount(p) == 2 for p in pages)  # owner + cache
+    full, partial = cache.lookup(prompt)
+    assert full == pages[:2]
+    assert partial is not None and partial[0] == pages[2]
+    np.testing.assert_array_equal(partial[1], prompt[8:])
+    # a prompt sharing only the first chunk matches one page, no partial
+    other = np.concatenate([prompt[:4], np.full(6, 63, np.int32)])
+    full, partial = cache.lookup(other)
+    assert full == pages[:1] and partial is None
+    # re-inserting the same prompt shares nothing new
+    assert cache.insert(prompt, pages) == set()
+    assert all(a.refcount(p) == 2 for p in pages)
+
+
+def test_prefix_cache_evicts_lru_only_at_refcount_one():
+    from incubator_mxnet_tpu.serving import PrefixCache
+    a = PageAllocator(12, 4)
+    cache = PrefixCache(a)
+    p1 = a.alloc(2)
+    p2 = a.alloc(2)
+    cache.insert(np.arange(1, 9, dtype=np.int32), p1)
+    cache.insert(np.arange(20, 28, dtype=np.int32), p2)
+    a.free(p2)  # second prompt's owner finished; cache ref only
+    # p1 still owner-referenced: eviction may only take p2's pages
+    freed = cache.evict(10)
+    assert freed == 2
+    assert cache.cached_pages == 2
+    assert all(a.refcount(p) == 2 for p in p1)
+    a.free(p1)
+    assert cache.evict(10) == 2  # interior nodes go once leaves do
+    assert cache.cached_pages == 0 and a.num_in_use == 0
+
+
+def test_prefix_cache_release_is_leaf_only():
+    from incubator_mxnet_tpu.serving import PrefixCache
+    a = PageAllocator(12, 4)
+    cache = PrefixCache(a)
+    pages = a.alloc(3)
+    cache.insert(np.arange(1, 11, dtype=np.int32), pages)
+    assert not cache.release(pages[0])  # mid-trie: children key off it
+    assert cache.release(pages[2])      # partial leaf: droppable
+    assert cache.cached_pages == 2
+    assert a.refcount(pages[2]) == 1    # owner ref only now
+    assert not cache.release(99)        # unknown page
+
+
+# -- serving levers: prefix cache, chunked prefill, speculation --------------
+
+def _mixed_trace(rng, n=6, vocab=64, max_len=64):
+    """Seeded mixed trace where later prompts reuse earlier heads — the
+    workload prefix caching exists for."""
+    reqs = []
+    for i in range(n):
+        p_len = int(rng.randint(2, 40))
+        prompt = rng.randint(1, vocab, p_len).astype(np.int32)
+        if i >= 2 and rng.rand() < 0.7:
+            base = reqs[int(rng.randint(0, len(reqs)))][0]
+            keep = min(len(base), int(rng.randint(8, 36)))
+            tail = rng.randint(1, vocab, max(1, p_len - keep))
+            prompt = np.concatenate([base[:keep], tail.astype(np.int32)])
+        m_new = int(rng.randint(1, min(12, max_len - prompt.size)))
+        reqs.append((prompt, m_new))
+    return reqs
+
+
+def test_engine_token_identity_all_knob_combos():
+    """The hard gate for every lever: greedy decode stays
+    token-identical to sequential generate() across all 8 on/off
+    combinations of prefix cache x chunked prefill x speculation."""
+    import itertools
+    cfg = _small_cfg()
+    params = tfm.init_params(cfg, seed=3)
+    reqs = _mixed_trace(np.random.RandomState(11))
+    ref = [np.asarray(tfm.generate(params, jnp.asarray(p)[None], m,
+                                   cfg))[0]
+           for p, m in reqs]
+    for pc, ck, sp in itertools.product([0, 1], repeat=3):
+        eng = ServingEngine(params, cfg, slots=3, page_size=8,
+                            num_pages=25, prefix_cache=pc,
+                            prefill_chunk=6 if ck else 0,
+                            spec_ngram=2 if sp else 0, spec_lookahead=3)
+        rids = [eng.submit(p, m) for p, m in reqs]
+        res = eng.run()
+        for rid, want in zip(rids, ref):
+            np.testing.assert_array_equal(
+                np.array(res[rid].tokens), want,
+                err_msg=f"combo prefix={pc} chunk={ck} spec={sp}")
+        assert eng.slots_in_use == 0
+        # only cache references may outlive the drained fleet
+        held = (eng.prefix_cache.cached_pages
+                if eng.prefix_cache is not None else 0)
+        assert eng.allocator.num_in_use == held
+
+
+def test_engine_prefix_cache_saves_prefill_and_cows_once():
+    """Resubmitting a prompt maps its cached pages: the second prefill
+    computes only the (always-recomputed) last token, and each shared
+    partial page is copied exactly once per writer."""
+    cfg = _small_cfg()
+    params = tfm.init_params(cfg, seed=3)
+    rng = np.random.RandomState(2)
+    p = rng.randint(1, 64, 20).astype(np.int32)  # 2 full pages + tail 4
+    ref = np.asarray(tfm.generate(params, jnp.asarray(p)[None], 4, cfg))[0]
+    eng = ServingEngine(params, cfg, slots=2, page_size=8, num_pages=16,
+                        prefix_cache=1)
+    r1 = eng.submit(p, 4)
+    res1 = eng.run()
+    # first pass: miss, all 20 tokens prefilled, and the slot's own
+    # cached partial page copy-on-wrote at its first decode token
+    assert eng.prefix_hit_rate == 0.0
+    assert eng.goodput()["prefill"] == 20
+    assert eng.cow_copies == 1
+    r2 = eng.submit(p, 4)
+    res2 = eng.run()
+    np.testing.assert_array_equal(np.array(res1[r1].tokens), ref)
+    np.testing.assert_array_equal(np.array(res2[r2].tokens), ref)
+    # second pass: 19 of 20 tokens came from the cache (the last prompt
+    # token is always recomputed for its logits), plus one admission
+    # copy of the cached partial page
+    assert eng.prefix_tokens_saved == 19
+    assert eng.prefix_hit_rate == 0.5
+    assert eng.goodput()["prefill"] == 21
+    assert eng.cow_copies == 2
+    # identical tail: insert dedups, so no second decode-time cow
+    assert eng.allocator.num_in_use == eng.prefix_cache.cached_pages == 3
+
+
+def test_engine_all_levers_steady_state_zero_retraces(tmp_path,
+                                                      monkeypatch):
+    """With every lever on, the second identical trace adds ZERO
+    signatures and ZERO retraces — wide programs and the page copy are
+    one static shape each."""
+    from incubator_mxnet_tpu import telemetry
+    from incubator_mxnet_tpu.telemetry import compilereg
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    monkeypatch.setenv("MXTPU_COMPILE_CACHE_DIR", str(tmp_path / "cc"))
+    telemetry.refresh_from_env()
+    compilereg.reset()
+    try:
+        cfg = _small_cfg()
+        params = tfm.init_params(cfg, seed=3)
+        reqs = _mixed_trace(np.random.RandomState(4))
+        eng = ServingEngine(params, cfg, slots=3, page_size=8,
+                            num_pages=25, prefix_cache=1,
+                            prefill_chunk=6, spec_ngram=2,
+                            spec_lookahead=3)
+
+        def totals():
+            snap = compilereg.snapshot()
+            return (sum(v["signatures"] for v in snap.values()),
+                    sum(v["retraces"] for v in snap.values()))
+
+        for p_, m_ in reqs:
+            eng.submit(p_, m_)
+        eng.run()
+        sigs1, re1 = totals()
+        assert sigs1 > 0
+        sites = set(compilereg.snapshot())
+        assert any(s.startswith("serving_wide_q") for s in sites)
+        for p_, m_ in reqs:
+            eng.submit(p_, m_)
+        eng.run()
+        assert totals() == (sigs1, re1)
+    finally:
+        compilereg.reset()
+        monkeypatch.delenv("MXNET_TELEMETRY", raising=False)
+        telemetry.refresh_from_env()
+        telemetry.REGISTRY.reset()
+
+
+def test_engine_knobs_off_builds_only_legacy_sites(tmp_path, monkeypatch):
+    """All levers off must be byte-identical to the pre-lever engine:
+    the compiled-program set contains exactly the legacy decode +
+    prefill-bucket sites (no wide programs, no page copy)."""
+    from incubator_mxnet_tpu import telemetry
+    from incubator_mxnet_tpu.telemetry import compilereg
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    monkeypatch.setenv("MXTPU_COMPILE_CACHE_DIR", str(tmp_path / "cc"))
+    telemetry.refresh_from_env()
+    compilereg.reset()
+    try:
+        cfg = _small_cfg()
+        params = tfm.init_params(cfg, seed=3)
+        eng = ServingEngine(params, cfg, slots=3, page_size=8,
+                            num_pages=25, prefix_cache=0,
+                            prefill_chunk=0, spec_ngram=0)
+        for p_, m_ in _mixed_trace(np.random.RandomState(4)):
+            eng.submit(p_, m_)
+        eng.run()
+        sites = {s for s in compilereg.snapshot()
+                 if s.startswith("serving_")}
+        assert sites
+        assert all(s == "serving_decode_step"
+                   or s.startswith("serving_prefill_b") for s in sites)
+        assert not hasattr(eng, "_page_copy")
+        assert eng._wides == {}
+    finally:
+        compilereg.reset()
+        monkeypatch.delenv("MXNET_TELEMETRY", raising=False)
+        telemetry.refresh_from_env()
+        telemetry.REGISTRY.reset()
+
+
+def test_engine_debug_snapshot_v2_lever_sections():
+    cfg = _small_cfg()
+    params = tfm.init_params(cfg, seed=3)
+    rng = np.random.RandomState(6)
+    p = rng.randint(1, 64, 20).astype(np.int32)
+    eng = ServingEngine(params, cfg, slots=2, page_size=8, num_pages=16,
+                        prefix_cache=1, prefill_chunk=4, spec_ngram=2,
+                        spec_lookahead=3)
+    eng.submit(p, 4)
+    eng.run()
+    eng.submit(p, 4)
+    eng.run()
+    snap = eng.debug_snapshot()
+    assert snap["schema"] == "mxtpu-serving-engine-debug-v2"
+    prefix = snap["prefix_cache"]
+    assert prefix["cached_pages"] == 3
+    assert prefix["hits"] == 1 and prefix["lookups"] == 2
+    assert prefix["tokens_saved"] == 19
+    assert prefix["refcount_histogram"]  # str refcount -> page count
+    spec = snap["speculation"]
+    assert spec["ngram"] == 2 and spec["lookahead"] == 3
+    assert spec["proposed"] >= spec["accepted"] >= 0
+    chunked = snap["chunked_prefill"]
+    assert chunked["chunk"] == 4 and chunked["chunks_total"] > 0
+    assert snap["tokens"]["spec_rejected"] >= 0

@@ -361,8 +361,12 @@ def test_debug_endpoint_http(monkeypatch):
         with urllib.request.urlopen(url) as resp:
             assert resp.headers["Content-Type"] == "application/json"
             snap = json.loads(resp.read().decode())
-        assert snap["schema"] == "mxtpu-serving-engine-debug-v1"
+        assert snap["schema"] == "mxtpu-serving-engine-debug-v2"
         assert snap["requests_finished"] == 1
+        # lever sections are present but null with every knob off
+        assert snap["prefix_cache"] is None
+        assert snap["speculation"] is None
+        assert snap["chunked_prefill"] is None
     finally:
         srv.close()
 

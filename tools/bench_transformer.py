@@ -50,6 +50,30 @@ def main():
                     help="KV page size for --serving")
     ap.add_argument("--seed", type=int, default=0,
                     help="trace seed for --serving")
+    ap.add_argument("--shared-prefix-frac", type=float, default=0.0,
+                    help="fraction of --serving requests rewritten to "
+                         "share one seeded system prompt (drawn from a "
+                         "SEPARATE rng stream: the default trace stays "
+                         "byte-identical)")
+    ap.add_argument("--prefix-len", type=int, default=32,
+                    help="length of the shared system prompt for "
+                         "--shared-prefix-frac")
+    ap.add_argument("--prefix-cache", type=int, default=None,
+                    help="MXTPU_PREFIX_CACHE for the engine (None = env)")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="MXTPU_PREFILL_CHUNK for the engine (None = env)")
+    ap.add_argument("--spec-ngram", type=int, default=None,
+                    help="MXTPU_SPEC_NGRAM for the engine (None = env)")
+    ap.add_argument("--spec-lookahead", type=int, default=None,
+                    help="MXTPU_SPEC_LOOKAHEAD for the engine (None = env)")
+    ap.add_argument("--serving-tag", default="",
+                    help="suffix for the output metric name (serving_TAG) "
+                         "so lever configurations gate against their own "
+                         "perf_gate baseline family")
+    ap.add_argument("--verify-tokens", action="store_true",
+                    help="after the measured trace, recompute every "
+                         "request with sequential generate() and report "
+                         "token_identity (1.0 = greedy decode identical)")
     ap.add_argument("--metrics-out",
                     help="after --serving, write the telemetry registry "
                          "snapshot (dump_json) here — the CI observability "
@@ -215,7 +239,11 @@ def serving_bench(args):
     else:
         clock = time.monotonic
     eng = ServingEngine(params, cfg, slots=args.slots,
-                        page_size=args.page_size, clock=clock)
+                        page_size=args.page_size, clock=clock,
+                        prefix_cache=args.prefix_cache,
+                        prefill_chunk=args.prefill_chunk,
+                        spec_ngram=args.spec_ngram,
+                        spec_lookahead=args.spec_lookahead)
 
     rng = np.random.RandomState(args.seed)
     max_prompt = max(4, min(cfg.max_len // 2, 3 * cfg.max_len // 4))
@@ -228,12 +256,34 @@ def serving_bench(args):
             "prompt": rng.randint(1, cfg.vocab, p_len).astype(np.int32),
             "max_new": m_new})
     trace.sort(key=lambda r: r["arrival_step"])
+    if args.shared_prefix_frac > 0:
+        # shared-system-prompt mode: a seeded fraction of requests is
+        # rewritten to one common prefix + a short private tail — the
+        # workload prefix caching exists for. Drawn from a SEPARATE rng
+        # stream so the default trace's draw order is untouched.
+        rng2 = np.random.RandomState(args.seed + 1)
+        pl = max(1, min(args.prefix_len, 3 * cfg.max_len // 4 - 2))
+        shared = rng2.randint(1, cfg.vocab, pl).astype(np.int32)
+        n_share = int(round(args.shared_prefix_frac * len(trace)))
+        picked = rng2.choice(len(trace), size=n_share, replace=False)
+        for i in sorted(int(j) for j in picked):
+            r = trace[i]
+            new_len = max(int(r["prompt"].size), pl + 2)
+            tail = rng2.randint(1, cfg.vocab,
+                                new_len - pl).astype(np.int32)
+            r["prompt"] = np.concatenate([shared, tail])
+            r["max_new"] = max(1, min(r["max_new"],
+                                      cfg.max_len - new_len))
+            r["shared"] = True
 
     # warmup: one request per distinct bucket the trace will hit (a
     # prompt of exactly the bucket length lands in that bucket)
     buckets = sorted({eng._bucket_for(r["prompt"].size) for r in trace})
     for b in buckets:
-        eng.submit(rng.randint(1, cfg.vocab, b).astype(np.int32), 2)
+        # the top bucket equals max_len; clamp so prompt+max_new fits
+        # (no-op for every bucket below it: identical legacy draws)
+        eng.submit(rng.randint(1, cfg.vocab,
+                               min(b, cfg.max_len - 2)).astype(np.int32), 2)
     eng.run()
     warm_results = len(eng.results())
 
@@ -243,7 +293,21 @@ def serving_bench(args):
                 sum(v["retraces"] for v in snap.values()))
 
     sigs0, re0 = reg_totals()
+    # lever counters are cumulative on the engine; snapshot them so the
+    # reported figures are measured-phase deltas (the bucket-warmup wave
+    # populates the prefix cache but must not count as hits/saves)
+    lever0 = (eng._prefix_lookups, eng._prefix_hits,
+              eng._prefix_tokens_saved, eng._cow_copies,
+              eng._spec_proposed, eng._spec_accepted,
+              eng.goodput()["prefill"])
     occupancy, utilization = [], []
+    # head-of-line blocking bound: the most prefill tokens any single
+    # step computed. Deterministic (seeded trace, counted rows), and it
+    # is the term that drives short-request p99 TTFT under load — the
+    # chunked-prefill CI gate compares it off-vs-on because wall-clock
+    # TTFT on CPU interpret kernels is dominated by per-call overhead.
+    prefill_prev = eng.goodput()["prefill"]
+    max_step_prefill = 0
     t0 = time.perf_counter()
     pending = list(trace)
     while pending or eng.queue_depth or eng.slots_in_use:
@@ -254,6 +318,9 @@ def serving_bench(args):
         occupancy.append(eng.slots_in_use)
         utilization.append(
             eng.allocator.num_in_use / max(1, eng.allocator.capacity))
+        prefill_cur = eng.goodput()["prefill"]
+        max_step_prefill = max(max_step_prefill, prefill_cur - prefill_prev)
+        prefill_prev = prefill_cur
     elapsed = time.perf_counter() - t0
     sigs1, re1 = reg_totals()
 
@@ -265,8 +332,15 @@ def serving_bench(args):
         ch.value for _, ch in
         telemetry.REGISTRY.counter(DENSE_FALLBACKS_TOTAL).series())
 
+    # short-vs-long p99 TTFT split: classified by prompt length against
+    # the trace median so an off-vs-on A/B compares identical cohorts
+    median_len = float(np.median([r["prompt"].size for r in trace]))
+    ttft_short = [r.ttft_s for r in done if r.prompt_len <= median_len]
+    ttft_long = [r.ttft_s for r in done if r.prompt_len > median_len]
+
+    tag = f"serving_{args.serving_tag}" if args.serving_tag else "serving"
     out = {
-        "metric": "serving",
+        "metric": tag,
         "requests_completed": len(done),
         "tokens_per_sec": round(gen_tokens / max(elapsed, 1e-9), 1),
         "p50_latency_s": round(_pct(latencies, 0.50), 4),
@@ -290,6 +364,48 @@ def serving_bench(args):
     out["goodput"] = round(goodput["fraction"], 4)
     out["tokens_split"] = {k: goodput[k] for k in
                            ("prefill", "decode", "pad", "wasted_evicted")}
+    out["ttft_p99_short_s"] = round(_pct(ttft_short, 0.99), 4)
+    out["ttft_p99_long_s"] = round(_pct(ttft_long, 0.99), 4)
+    out["max_step_prefill_tokens"] = max_step_prefill
+    if eng.prefix_cache is not None:
+        lookups = eng._prefix_lookups - lever0[0]
+        hits = eng._prefix_hits - lever0[1]
+        saved = eng._prefix_tokens_saved - lever0[2]
+        computed = goodput["prefill"] - lever0[6]
+        out["prefix_hit_rate"] = round(hits / max(1, lookups), 4)
+        out["prefill_tokens_saved"] = saved
+        out["prefill_tokens_saved_frac"] = round(
+            saved / max(1, saved + computed), 4)
+        out["cow_copies"] = eng._cow_copies - lever0[3]
+        out["prefix_cached_pages"] = eng.prefix_cache.cached_pages
+        out["prefix_evictions"] = eng.prefix_cache.evictions
+    if eng.spec_ngram:
+        proposed = eng._spec_proposed - lever0[4]
+        accepted = eng._spec_accepted - lever0[5]
+        out["spec_proposed_tokens"] = proposed
+        out["spec_accepted_tokens"] = accepted
+        out["spec_acceptance"] = round(accepted / max(1, proposed), 4)
+    if eng.prefill_chunk:
+        out["prefill_chunks"] = eng._prefill_chunks
+    if args.verify_tokens:
+        # the hard gate: greedy decode through every enabled lever must
+        # be token-identical to sequential generate() (outside the
+        # timed window, so it never skews the wall-clock figures)
+        import jax.numpy as jnp
+        identical = True
+        for r in trace:
+            if "rid" not in r:
+                continue
+            got = np.asarray(results[r["rid"]].tokens)
+            if got.size == 0:
+                continue
+            ref = np.asarray(tfm.generate(
+                params, jnp.asarray(r["prompt"])[None], got.size,
+                cfg))[0]
+            if not np.array_equal(got, ref):
+                identical = False
+                break
+        out["token_identity"] = float(identical)
     if eng.slo is not None:
         slo_snap = eng.slo.snapshot()
         out["slo"] = {name: row["state"] for name, row in slo_snap.items()}

@@ -66,6 +66,31 @@ def render(snap):
         f"decode {tokens.get('decode', 0)}  pad {tokens.get('pad', 0)}  "
         f"evicted {tokens.get('wasted_evicted', 0)}  "
         f"goodput {tokens.get('fraction', 1.0):.3f}")
+    prefix = snap.get("prefix_cache")
+    if prefix:
+        hist = prefix.get("refcount_histogram") or {}
+        hist_str = " ".join(
+            f"{k}x{hist[k]}" for k in sorted(hist, key=int)) or "-"
+        lines.append(
+            f"prefix cached {prefix.get('cached_pages', 0)} pages  "
+            f"hit_rate {prefix.get('hit_rate', 0.0):.2f} "
+            f"({prefix.get('hits', 0)}/{prefix.get('lookups', 0)})  "
+            f"saved {prefix.get('tokens_saved', 0)} tok  "
+            f"cow {prefix.get('cow_copies', 0)}  "
+            f"evictions {prefix.get('evictions', 0)}  "
+            f"refs {hist_str}")
+    spec = snap.get("speculation")
+    if spec:
+        lines.append(
+            f"spec n={spec.get('ngram', 0)} k={spec.get('lookahead', 0)}  "
+            f"acceptance {spec.get('acceptance', 0.0):.2f} "
+            f"({spec.get('accepted', 0)}/{spec.get('proposed', 0)})")
+    chunked = snap.get("chunked_prefill")
+    if chunked:
+        lines.append(
+            f"chunked prefill C={chunked.get('chunk', 0)}  "
+            f"in_flight {chunked.get('in_flight', 0)}  "
+            f"chunks {chunked.get('chunks_total', 0)}")
     lines.append("")
     lines.append(f"{'slot':<6}{'state':<10}{'request':>9}{'age_s':>9}"
                  f"{'prompt':>8}{'tokens':>8}{'pos':>6}{'pages':>7}")
