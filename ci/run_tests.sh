@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI tiers (ref: ci/docker/runtime_functions.sh — unittest / nightly /
 # distributed stages). Usage:
-#   ci/run_tests.sh [unit|nightly|dist|examples|telemetry|aggregation|static-analysis|perf-structure|perf-gate|cold-start|serving|sharding|recommender|chaos|all]
+#   ci/run_tests.sh [unit|nightly|dist|examples|telemetry|aggregation|static-analysis|sanitizers|perf-structure|perf-gate|cold-start|serving|sharding|recommender|chaos|all]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -73,6 +73,25 @@ run_static_analysis() {
         --model resnet18_v1 --shape data=1,3,224,224
     JAX_PLATFORMS=cpu python tools/graph_check.py \
         --model squeezenet1.0 --shape data=1,3,224,224
+}
+
+run_sanitizers() {
+    echo "=== sanitizer tier (lockdep + page shadow state over real workloads) ==="
+    # clean scenarios: the serving engine (prefix cache + chunked prefill
+    # + speculation on) and the elastic chaos run execute under
+    # MXTPU_SANITIZERS=locks,pages with ZERO findings, plus the
+    # MXL008-MXL010 concurrency lint over the package
+    JAX_PLATFORMS=cpu python tools/sanitize.py --scenario all
+    # seeded negatives: each planted bug MUST be caught (exit 0 only when
+    # the sanitizer reports it) — a regression that blinds a sanitizer
+    # fails here instead of silently passing the clean scenarios forever
+    for inj in abba leaked-page lint; do
+        if ! JAX_PLATFORMS=cpu python tools/sanitize.py --inject "$inj"; then
+            echo "FAIL: sanitizers missed the seeded '$inj' bug" >&2
+            exit 1
+        fi
+    done
+    echo "sanitizer tier: clean scenarios green, all 3 seeded bugs caught"
 }
 
 run_chaos() {
@@ -755,6 +774,7 @@ case "$tier" in
     telemetry) run_telemetry ;;
     aggregation) run_aggregation ;;
     static-analysis) run_static_analysis ;;
+    sanitizers) run_sanitizers ;;
     chaos)     run_chaos ;;
     perf-structure) run_perf_structure ;;
     perf-gate) run_perf_gate ;;
@@ -763,7 +783,7 @@ case "$tier" in
     sharding)  run_sharding ;;
     recommender) run_recommender ;;
     nightly)   run_nightly ;;
-    all)       run_static_analysis; run_unit; run_telemetry; run_aggregation; run_perf_structure; run_perf_gate; run_cold_start; run_serving; run_sharding; run_recommender; run_chaos; run_dist; run_examples; run_nightly ;;
-    *) echo "unknown tier: $tier (unit|nightly|dist|examples|suite|telemetry|aggregation|static-analysis|perf-structure|perf-gate|cold-start|serving|sharding|recommender|chaos|all)"; exit 2 ;;
+    all)       run_static_analysis; run_sanitizers; run_unit; run_telemetry; run_aggregation; run_perf_structure; run_perf_gate; run_cold_start; run_serving; run_sharding; run_recommender; run_chaos; run_dist; run_examples; run_nightly ;;
+    *) echo "unknown tier: $tier (unit|nightly|dist|examples|suite|telemetry|aggregation|static-analysis|sanitizers|perf-structure|perf-gate|cold-start|serving|sharding|recommender|chaos|all)"; exit 2 ;;
 esac
 echo "tier '$tier' green"

@@ -14,18 +14,30 @@ Two pillars, both emitting structured diagnostics with stable codes
 - Framework lint (`mxlint`, codes MXL0xx): an AST checker over
   `incubator_mxnet_tpu/` itself enforcing the framework's own invariants
   (documented config knobs, registered telemetry names, no bare excepts,
-  no host materialization in hot paths, documented ops). CLI:
-  `tools/mxlint.py`; CI runs it with the committed zero-findings baseline
-  `ci/mxlint_baseline.json`.
+  no host materialization in hot paths, documented ops, thread/lock
+  hygiene). CLI: `tools/mxlint.py`; CI runs it with the committed
+  zero-findings baseline `ci/mxlint_baseline.json`.
+
+A third, dynamic pillar lives in `sanitizers` (codes MXS0xx): opt-in
+runtime checkers for the threaded runtime — lock-order/deadlock
+detection, KV-page refcount shadow state — enabled per-process via
+`MXTPU_SANITIZERS=locks,pages,threads` and free when off. CLI:
+`tools/sanitize.py`.
 """
 from .diagnostics import (  # noqa: F401
     Diagnostic, Report, Severity, CODE_CATALOG, GraphValidationError,
 )
 from .passes import validate, validate_json, HOST_SYNC_OPS  # noqa: F401
 from .mxlint import LINT_RULES, LintFinding, run_lint  # noqa: F401
+from .sanitizers import (  # noqa: F401
+    MXS_CATALOG, PageSanitizer, SanitizerError, attach_page_sanitizer,
+    san_condition, san_lock, san_rlock,
+)
 
 __all__ = [
     "Diagnostic", "Report", "Severity", "CODE_CATALOG",
     "GraphValidationError", "validate", "validate_json", "HOST_SYNC_OPS",
     "LINT_RULES", "LintFinding", "run_lint",
+    "MXS_CATALOG", "PageSanitizer", "SanitizerError",
+    "attach_page_sanitizer", "san_condition", "san_lock", "san_rlock",
 ]

@@ -100,6 +100,30 @@ register_knob("MXNET_GRAPH_VALIDATE", "off", str,
               "mxtpu_graph_validate_findings_total counter when telemetry "
               "is on. See docs/STATIC_ANALYSIS.md.")
 
+register_knob("MXTPU_SANITIZERS", "", str,
+              "Comma-separated runtime sanitizers from "
+              "analysis/sanitizers.py: 'locks' (san_lock primitives "
+              "become instrumented — global lock-order graph with "
+              "MXS001 deadlock-cycle reports, MXS002 "
+              "blocking-op-under-lock, MXS003 long holds), 'pages' "
+              "(shadow refcount/generation checking of every "
+              "PageAllocator transition — MXS010 double free, MXS011 "
+              "use-after-free, MXS012 COW violation, MXS013 leak at "
+              "drain, MXS014 shadow divergence), and 'threads' (gates "
+              "the MXL008-MXL010 concurrency lint in tools/sanitize.py "
+              "scenarios). Empty (default) = all off: san_lock returns "
+              "plain threading primitives, resolved once at lock "
+              "creation — no per-acquire indirection. Findings feed "
+              "mxtpu_sanitizer_findings_total and sanitizer_finding "
+              "flight events. See docs/STATIC_ANALYSIS.md.")
+
+register_knob("MXTPU_SANITIZER_HOLD_MS", 250.0, float,
+              "Lock-hold-time threshold in milliseconds for the locks "
+              "sanitizer: releasing a sanitized lock held longer than "
+              "this emits an MXS003 long-hold finding with the "
+              "acquisition site. Only read while MXTPU_SANITIZERS "
+              "includes 'locks'.")
+
 # memory traffic (see docs/PERF_ANALYSIS.md §0)
 register_knob("MXTPU_FUSED_EPILOGUE", False, bool,
               "Route conv→BN→ReLU(→residual-add) chains through the Pallas "

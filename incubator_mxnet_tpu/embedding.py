@@ -51,6 +51,7 @@ import threading
 import numpy as np
 
 from . import config as _config
+from .analysis.sanitizers import san_lock
 from .ndarray.ndarray import NDArray
 from .ndarray.sparse import bucket_nnz, pad_row_ids  # noqa: F401 (re-export)
 
@@ -156,6 +157,11 @@ class ShardedEmbeddingService:
                              if prefetch is None else bool(prefetch))
         self._jobs = None
         self._worker = None
+        # cross-thread error handoff: the worker WRITES under this lock,
+        # the training thread's _check_worker does an atomic
+        # read-and-clear under it (an unlocked swap here was the classic
+        # lost-error race the lock sanitizer exists to flag)
+        self._worker_error_lock = san_lock("embedding.worker_error")
         self._worker_error = None
         if self._prefetch_on:
             self._jobs = queue.Queue()
@@ -431,10 +437,12 @@ class ShardedEmbeddingService:
                     job[2].error = e
                     job[2].event.set()
                 else:
-                    self._worker_error = e
+                    with self._worker_error_lock:
+                        self._worker_error = e
 
     def _check_worker(self):
-        err, self._worker_error = self._worker_error, None
+        with self._worker_error_lock:
+            err, self._worker_error = self._worker_error, None
         if err is not None:
             raise err
 

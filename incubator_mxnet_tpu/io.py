@@ -305,7 +305,8 @@ class PrefetchingIter(DataIter):
                     continue
 
     def _start(self):
-        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread = threading.Thread(target=self._worker, daemon=True,
+                                        name="mxtpu-io-prefetch")
         self._thread.start()
 
     def reset(self):

@@ -65,10 +65,14 @@ class _PrefetchMixin:
                     if not put(b):
                         return
             except BaseException as e:  # surfaced on next()
-                self._producer_exc = e
+                # single writer (this thread), single reader (the consumer
+                # after it drains the None sentinel below) — the sentinel
+                # put() orders the write, so no lock is needed
+                self._producer_exc = e  # mxlint: disable=MXL008
                 put(None)
 
-        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="mxtpu-record-prefetch")
         self._thread.start()
 
     def _stop_prefetch(self):

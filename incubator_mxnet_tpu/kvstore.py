@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from . import telemetry as _telemetry
+from .analysis.sanitizers import san_lock
 from .ndarray.ndarray import NDArray
 from .ndarray.sparse import RowSparseNDArray
 
@@ -876,7 +877,7 @@ class _TcpHeartbeat:
     Works cross-host with no shared-filesystem assumption."""
 
     _singleton = None
-    _singleton_lock = threading.Lock()
+    _singleton_lock = san_lock("kvstore.hb_singleton")
 
     def __init__(self, rank, num_workers, host, port, interval, timeout):
         from . import ps as _ps
@@ -961,7 +962,8 @@ class _Heartbeat:
         os.makedirs(hb_dir, exist_ok=True)
         self._stop = threading.Event()
         self._beat()
-        t = threading.Thread(target=self._loop, daemon=True)
+        t = threading.Thread(target=self._loop, daemon=True,
+                             name="mxtpu-heartbeat-file")
         t.start()
 
     @classmethod

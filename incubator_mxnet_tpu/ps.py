@@ -35,6 +35,8 @@ import zlib
 
 import numpy as np
 
+from .analysis.sanitizers import san_condition, san_lock
+
 logger = logging.getLogger(__name__)
 
 __all__ = ["ParameterServer", "PSClient", "default_server_addr",
@@ -325,22 +327,22 @@ class ParameterServer:
         self.num_workers = num_workers
         self._store = {}           # key -> np.ndarray (authoritative)
         self._locks = {}           # key -> threading.Lock
-        self._locks_guard = threading.Lock()
+        self._locks_guard = san_lock("ps.locks_guard")
         self._updater = None
         self._compressor = None
         # sync-mode aggregation (ref: DataHandleDefault sync path :346)
         self._merge = {}           # key -> (buf, count)
-        self._sync_cv = threading.Condition()
+        self._sync_cv = san_condition("ps.sync_cv")
         self._versions = {}        # key -> applied-update count
         # barrier bookkeeping (ref: ps-lite Postoffice::Barrier)
-        self._barrier_cv = threading.Condition()
+        self._barrier_cv = san_condition("ps.barrier_cv")
         self._barrier_count = 0
         self._barrier_gen = 0
         # worker heartbeats (ref: ps-lite Heartbeat/GetDeadNodes) — rides
         # the same TCP control plane, so dead-node detection works
         # cross-host with no shared filesystem
         self._beats = {}
-        self._beats_lock = threading.Lock()
+        self._beats_lock = san_lock("ps.beats")
         self._start_time = time.time()
         from . import config as _config
 
@@ -349,7 +351,7 @@ class ParameterServer:
         self._dedup_window = max(1, _config.get("MXTPU_PS_DEDUP_WINDOW"))
         self._evict_timeout = _config.get("MXTPU_HEARTBEAT_TIMEOUT")
         self._dedup = {}           # client_id -> OrderedDict(seq -> entry)
-        self._dedup_lock = threading.Lock()
+        self._dedup_lock = san_lock("ps.dedup")
         # ranks seen via heartbeat then gone stale: they shrink the
         # barrier/sync quorum instead of hanging every survivor until the
         # rendezvous timeout; a fresh beat re-admits them
@@ -414,7 +416,7 @@ class ParameterServer:
 
     def _key_lock(self, key):
         with self._locks_guard:
-            return self._locks.setdefault(key, threading.Lock())
+            return self._locks.setdefault(key, san_lock("ps.key"))
 
     def _serve(self, conn):
         try:
@@ -1143,7 +1145,7 @@ class PSClient:
         from .resilience import RetryPolicy
 
         self._host, self._port = host, int(port)
-        self._lock = threading.Lock()
+        self._lock = san_lock("ps.client")
         self._sock = None
         self._seq = 0
         self._client_id = (f"{socket.gethostname()}:{os.getpid()}:"

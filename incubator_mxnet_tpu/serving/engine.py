@@ -58,6 +58,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import compile_cache, config, telemetry
+from ..analysis import sanitizers as _sanitizers
 from ..models import transformer as _tfm
 from ..telemetry import compilereg
 from ..telemetry import distributed as _dtrace
@@ -182,6 +183,9 @@ class ServingEngine:
         if not num_pages:  # auto: every slot can hold a full sequence
             num_pages = self.slots * self.table_width + 1
         self.allocator = PageAllocator(num_pages, self.page_size)
+        # shadow-state refcount checker (None unless MXTPU_SANITIZERS
+        # lists "pages"); run() proves quiescence at drain through it
+        self._page_san = _sanitizers.attach_page_sanitizer(self.allocator)
         self.paged = _tfm.init_paged_kv_cache(cfg, num_pages,
                                               self.page_size)
         self.prefill_buckets = _default_buckets(self.max_len)
@@ -373,6 +377,10 @@ class ServingEngine:
         finish) — hitting it raises instead of spinning forever."""
         for _ in range(max_steps):
             if not self._queue and not any(self._slot_req):
+                if self._page_san is not None:
+                    # every live reference must now be owned by the
+                    # prefix cache; anything else leaked (MXS013)
+                    self._page_san.assert_quiescent()
                 return dict(self._results)
             self.step()
         raise RuntimeError(f"serving engine did not drain within "
@@ -466,7 +474,8 @@ class ServingEngine:
                     return  # backpressure: wait for an eviction
                 continue
             total = req.prompt.size + req.max_new_tokens
-            pages = self.allocator.alloc(self.allocator.pages_needed(total))
+            pages = self.allocator.alloc(self.allocator.pages_needed(total),
+                                         owner=req.request_id)
             if pages is None:
                 telemetry.inc(ADMISSION_BLOCKED, reason="pages")
                 return  # backpressure: wait for an eviction
@@ -561,15 +570,17 @@ class ServingEngine:
         # bytes are copied into a fresh page below
         protect = used_full + ([part_page] if part_page is not None
                                else [])
-        self.allocator.share(protect)
-        fresh = self.allocator.alloc(w_req - len(used_full))
+        self.allocator.share(protect, owner=req.request_id)
+        fresh = self.allocator.alloc(w_req - len(used_full),
+                                     owner=req.request_id)
         if fresh is None and self.prefix_cache is not None:
             # pool pressure: LRU-evict cache pages no live request maps
             deficit = (w_req - len(used_full)) - self.allocator.num_free
             self.prefix_cache.evict(deficit)
-            fresh = self.allocator.alloc(w_req - len(used_full))
+            fresh = self.allocator.alloc(w_req - len(used_full),
+                                         owner=req.request_id)
         if fresh is None:
-            self.allocator.free(protect)
+            self.allocator.free(protect, owner=req.request_id)
             telemetry.inc(ADMISSION_BLOCKED, reason="pages")
             return False
         if self.prefix_cache is not None:
@@ -604,7 +615,8 @@ class ServingEngine:
             self.paged = self._page_copy(
                 self.paged, jnp.asarray(part_page, jnp.int32),
                 jnp.asarray(fresh[0], jnp.int32))
-            self.allocator.free([part_page])  # drop the pin only
+            self.allocator.free([part_page],  # drop the pin only
+                                owner=req.request_id)
             self._cow_copies += 1
             telemetry.inc(COW_COPIES, site="admit")
         self._slot_req[slot] = req
@@ -646,6 +658,13 @@ class ServingEngine:
             start[s] = pos
             n_real[s] = n
             tables[s] = st["row"]
+        if self._page_san is not None:
+            for s in pend:
+                lo = int(start[s]) // self.page_size
+                hi = (int(start[s]) + int(n_real[s]) - 1) // self.page_size
+                self._page_san.note_write(
+                    self._slot_req[s].request_id,
+                    self._slot_pages[s][lo:hi + 1])
         with telemetry.span("serving.prefill_chunk", slots=len(pend)):
             out, self.paged = self._wide(C)(
                 self.params, self.paged, jnp.asarray(toks),
@@ -726,12 +745,13 @@ class ServingEngine:
         idx = self._slot_cow_idx[slot]
         self._slot_cow_idx[slot] = -1
         page = self._slot_pages[slot][idx]
-        new = self.allocator.cow(page)
+        rid = self._slot_req[slot].request_id
+        new = self.allocator.cow(page, owner=rid)
         if new is None:
             if self.prefix_cache.release(page):
                 return  # cache ref dropped; the slot now owns the page
             if self.prefix_cache.evict(1):
-                new = self.allocator.cow(page)
+                new = self.allocator.cow(page, owner=rid)
         if new is None:
             raise RuntimeError(
                 f"copy-on-write of page {page} failed: KV pool "
@@ -797,6 +817,14 @@ class ServingEngine:
                 toks[s, 1:1 + prop.size] = prop
             start[s] = self._positions[s]
             n_real[s] = 1 + prop.size
+        if self._page_san is not None:
+            # rows [start, start+n_real) of each slot land in its table
+            for s in live_slots:
+                lo = int(start[s]) // self.page_size
+                hi = (int(start[s]) + int(n_real[s]) - 1) // self.page_size
+                self._page_san.note_write(
+                    self._slot_req[s].request_id,
+                    self._slot_pages[s][lo:hi + 1])
         tok, self.paged = self._wide(Q)(
             self.params, self.paged, jnp.asarray(toks),
             jnp.asarray(start), jnp.asarray(n_real),
@@ -866,6 +894,13 @@ class ServingEngine:
             for s in live_slots:
                 if self._slot_cow_idx[s] >= 0:
                     self._resolve_cow(s)
+        if self._page_san is not None:
+            # the step writes one K/V entry per live slot at _positions[s]
+            for s in live_slots:
+                self._page_san.note_write(
+                    self._slot_req[s].request_id,
+                    [self._slot_pages[s][int(self._positions[s])
+                                         // self.page_size]])
         tok, self.paged = self._decode(
             self.params, self.paged, jnp.asarray(self._next_tok),
             jnp.asarray(self._positions), jnp.asarray(self._tables))
@@ -954,7 +989,7 @@ class ServingEngine:
                        "queue_wait_s": queue_wait,
                        "ttft_s": req.ttft_s, "latency_s": latency,
                        "decode_steps": max(0, len(out) - 1)})
-        self.allocator.free(self._slot_pages[slot])
+        self.allocator.free(self._slot_pages[slot], owner=req.request_id)
         self._slot_req[slot] = None
         self._slot_pages[slot] = []
         self._slot_out[slot] = []

@@ -42,6 +42,30 @@ __all__ = ["PageAllocator", "PrefixCache", "NULL_PAGE"]
 
 NULL_PAGE = 0
 
+_recorder = None
+
+
+def _log_page_event(op, pages, owner, free):
+    """`page_lifecycle` flight-recorder events (alloc/share/cow/free
+    with owner provenance) so a post-mortem dump can reconstruct who
+    leaked a page. Emitted only while a page sanitizer is attached
+    (MXTPU_SANITIZERS=pages) — the default path does not spend ring
+    capacity or event-encoding time on per-page bookkeeping. Lazily
+    bound so this module stays importable without the telemetry package
+    (and keeps its no-jax-imports contract)."""
+    global _recorder
+    if _recorder is None:
+        try:
+            from ..telemetry import recorder as _rec
+        except Exception:
+            _recorder = False
+            return
+        _recorder = _rec
+    if _recorder is False:
+        return
+    _recorder.log_event("page_lifecycle", op=op, pages=list(pages),
+                        owner=owner, free=free)
+
 
 class PageAllocator:
     """Refcounting free-list allocator over a pool of `num_pages` KV
@@ -59,6 +83,9 @@ class PageAllocator:
         # reuse-after-free bugs show up deterministically in tests
         self._free = deque(range(1, self.num_pages))
         self._refs: dict[int, int] = {}
+        # armed by analysis.sanitizers.attach_page_sanitizer when the
+        # pages sanitizer is on; every transition below feeds it
+        self.sanitizer = None
 
     @property
     def num_free(self) -> int:
@@ -101,11 +128,13 @@ class PageAllocator:
             return 0
         return -(-int(n_tokens) // self.page_size)
 
-    def alloc(self, n_pages: int):
+    def alloc(self, n_pages: int, owner=None):
         """Allocate `n_pages` pages at refcount 1; returns the page-id
         list, or None when the pool can't cover it (all-or-nothing —
         the caller keeps the request queued instead of half-admitting
-        it)."""
+        it). `owner` is provenance (request id, "prefix_cache") for the
+        page_lifecycle event stream and the page sanitizer's mapping
+        registry."""
         n_pages = int(n_pages)
         if n_pages < 0:
             raise ValueError(f"cannot alloc {n_pages} pages")
@@ -114,9 +143,13 @@ class PageAllocator:
         pages = [self._free.popleft() for _ in range(n_pages)]
         for p in pages:
             self._refs[p] = 1
+        if self.sanitizer is not None:
+            self.sanitizer.on_alloc(pages, owner=owner)
+            if pages:
+                _log_page_event("alloc", pages, owner, len(self._free))
         return pages
 
-    def extend(self, pages, old_tokens: int, new_tokens: int):
+    def extend(self, pages, old_tokens: int, new_tokens: int, owner=None):
         """Grow an allocation that covers `old_tokens` so it covers
         `new_tokens`: allocates only the delta pages and returns the new
         combined list (the input list is not mutated), or None when the
@@ -124,21 +157,27 @@ class PageAllocator:
         need = self.pages_needed(new_tokens) - self.pages_needed(old_tokens)
         if need <= 0:
             return list(pages)
-        extra = self.alloc(need)
+        extra = self.alloc(need, owner=owner)
         if extra is None:
             return None
         return list(pages) + extra
 
-    def share(self, pages):
+    def share(self, pages, owner=None):
         """Add one reference to each page — a second page table now maps
         it read-only. Sharing a page that isn't live raises (that table
         would read recycled garbage)."""
         pages = list(pages)
         bad = [p for p in pages if p not in self._refs]
         if bad:
+            if self.sanitizer is not None:
+                self.sanitizer.on_share(bad, owner=owner)
             raise ValueError(f"sharing pages not currently allocated: {bad}")
+        if self.sanitizer is not None:
+            self.sanitizer.on_share(pages, owner=owner)
         for p in pages:
             self._refs[p] += 1
+        if self.sanitizer is not None and pages:
+            _log_page_event("share", pages, owner, len(self._free))
 
     def refcount(self, page: int) -> int:
         """References currently held on `page` (0 = free/null)."""
@@ -152,7 +191,7 @@ class PageAllocator:
             hist[c] = hist.get(c, 0) + 1
         return hist
 
-    def cow(self, page: int):
+    def cow(self, page: int, owner=None):
         """Copy-on-write: turn one reference on a SHARED `page` into an
         exclusively-owned page id. Returns `page` unchanged when the
         caller already holds the only reference (no copy needed); else
@@ -164,16 +203,21 @@ class PageAllocator:
         page = int(page)
         count = self._refs.get(page)
         if not count:
+            if self.sanitizer is not None:
+                self.sanitizer.on_cow(page, None, owner=owner)
             raise ValueError(f"cow on page {page} which is not allocated")
         if count == 1:
             return page
-        fresh = self.alloc(1)
+        fresh = self.alloc(1, owner=owner)
         if fresh is None:
             return None
         self._refs[page] = count - 1
+        if self.sanitizer is not None:
+            self.sanitizer.on_cow(page, fresh[0], owner=owner)
+            _log_page_event("cow", [page, fresh[0]], owner, len(self._free))
         return fresh[0]
 
-    def free(self, pages):
+    def free(self, pages, owner=None):
         """Drop one reference per page; a page returns to the pool for
         immediate reuse when its LAST reference drops. Freeing a page
         that isn't currently allocated (double free, or the null page)
@@ -182,13 +226,19 @@ class PageAllocator:
         pages = list(pages)
         bad = [p for p in pages if p not in self._refs]
         if bad:
+            if self.sanitizer is not None:
+                self.sanitizer.on_free(bad, owner=owner)
             raise ValueError(f"freeing pages not currently allocated: {bad}")
+        if self.sanitizer is not None:
+            self.sanitizer.on_free(pages, owner=owner)
         for p in pages:
             if self._refs[p] > 1:
                 self._refs[p] -= 1
             else:
                 del self._refs[p]
                 self._free.append(p)
+        if self.sanitizer is not None and pages:
+            _log_page_event("free", pages, owner, len(self._free))
 
     def table_row(self, pages, width: int):
         """Pad a page list to a fixed-width page-table row (null-page
@@ -316,7 +366,7 @@ class PrefixCache:
             node = children.get(key)
             if node is None:
                 page = pages[i]
-                self.allocator.share([page])
+                self.allocator.share([page], owner="prefix_cache")
                 node = _Node(page, tick)
                 children[key] = node
                 self._pages[page] = (children, key)
@@ -331,7 +381,7 @@ class PrefixCache:
             if key not in partials and i < len(pages):
                 page = pages[i]
                 if page not in self._pages:
-                    self.allocator.share([page])
+                    self.allocator.share([page], owner="prefix_cache")
                     partials[key] = (page, tick)
                     self._pages[page] = (partials, key)
                     newly_cached.add(i)
@@ -354,7 +404,7 @@ class PrefixCache:
             return False  # mid-trie: children key off this page's chunk
         del container[key]
         del self._pages[page]
-        self.allocator.free([page])
+        self.allocator.free([page], owner="prefix_cache")
         self.evictions += 1
         return True
 
@@ -392,7 +442,7 @@ class PrefixCache:
                 if key in container and page in self._pages:
                     del container[key]
                     del self._pages[page]
-                    self.allocator.free([page])
+                    self.allocator.free([page], owner="prefix_cache")
                     self.evictions += 1
                     freed += 1
                     progressed = True

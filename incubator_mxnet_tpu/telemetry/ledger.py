@@ -30,11 +30,11 @@ also skip the registry when the switch is off.
 """
 from __future__ import annotations
 
-import threading
 import time
 import weakref
 
 from .. import config as _config
+from ..analysis.sanitizers import san_lock
 from .metrics import REGISTRY
 from .spans import current_span
 from . import distributed as _distributed
@@ -58,7 +58,7 @@ _LEAKS_HELP = ("Leak-heuristic firings: the tracked live set grew for "
 
 _MAX_SAMPLES = 4096
 
-_lock = threading.Lock()
+_lock = san_lock("telemetry.ledger")
 _entries = {}        # token (weakref | int) -> (role, nbytes, obj_id, ref)
 _by_id = {}          # id(obj) -> token
 _by_role = {}        # role -> live bytes

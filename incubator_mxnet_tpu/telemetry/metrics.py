@@ -17,7 +17,8 @@ the simple float adds, the lock makes the invariants explicit).
 from __future__ import annotations
 
 import bisect
-import threading
+
+from ..analysis.sanitizers import san_lock
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
@@ -48,7 +49,7 @@ class _Metric:
     def __init__(self, name, help=""):
         self.name = name
         self.help = help
-        self._lock = threading.Lock()
+        self._lock = san_lock("telemetry.metric")
         self._children = {}
 
     def labels(self, **labels):
@@ -74,7 +75,7 @@ class _CounterChild:
     __slots__ = ("_lock", "value")
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = san_lock("telemetry.counter_child")
         self.value = 0.0
 
     def inc(self, amount=1.0):
@@ -102,7 +103,7 @@ class _GaugeChild:
     __slots__ = ("_lock", "value")
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = san_lock("telemetry.gauge_child")
         self.value = 0.0
 
     def set(self, value):
@@ -151,7 +152,7 @@ class _HistogramChild:
     __slots__ = ("_lock", "_bounds", "buckets", "count", "sum", "min", "max")
 
     def __init__(self, bounds):
-        self._lock = threading.Lock()
+        self._lock = san_lock("telemetry.hist_child")
         self._bounds = bounds
         self.buckets = [0] * (len(bounds) + 1)  # last slot: +Inf
         self.count = 0
@@ -202,7 +203,7 @@ class MetricsRegistry:
     tests may instantiate their own."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = san_lock("telemetry.registry")
         self._metrics = {}
 
     def _get_or_create(self, name, kind, factory):

@@ -256,6 +256,11 @@ METRIC_NAMES = {
         "counter", "SLO breach transitions (each also logs a "
                    "flight-recorder event and writes one post-mortem "
                    "dump), by objective."),
+    "mxtpu_sanitizer_findings_total": (
+        "counter", "Deduplicated findings from the runtime sanitizers "
+                   "(MXTPU_SANITIZERS), labeled by sanitizer "
+                   "(locks/pages) and MXS code; each also logs a "
+                   "sanitizer_finding flight-recorder event."),
 }
 
 # span() names (tracing regions). Dots namespace by subsystem.

@@ -31,6 +31,8 @@ import sys
 import threading
 import time
 
+from ..analysis.sanitizers import san_lock
+
 __all__ = [
     "FlightRecorder", "log_event", "snapshot", "dump", "recording",
     "refresh_from_env", "install_hooks",
@@ -69,9 +71,9 @@ class FlightRecorder:
         return max(held) + 1
 
 
-_state_lock = threading.Lock()
+_state_lock = san_lock("telemetry.recorder_state")
 _ring = None          # FlightRecorder, False when capacity == 0, None unresolved
-_dump_lock = threading.Lock()
+_dump_lock = san_lock("telemetry.recorder_dump")
 _dumps_written = 0
 _hooks_installed = False
 

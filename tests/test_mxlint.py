@@ -164,6 +164,81 @@ def test_mxl007_env_read(tmp_path):
     assert hits == ["MXNET_ALSO_SNEAKY", "MXTPU_GETENV", "MXTPU_SNEAKY"]
 
 
+def test_mxl008_unlocked_thread_body_write(tmp_path):
+    fs = _lint(tmp_path, {"runtime.py": """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self.count = 0
+                self.done = False
+                self._lock = threading.Lock()
+                self._t = threading.Thread(target=self._pump,
+                                           daemon=True, name="p")
+
+            def _pump(self):                  # registered Thread target
+                self.count += 1               # flagged: no lock held
+                local = 1                     # fine: local
+                with self._lock:
+                    self.done = True          # fine: lock held
+
+            def _worker(self):                # name-pattern thread body
+                self.table[3] = 0             # flagged: subscript write
+
+            def not_a_thread(self):
+                self.count = 5                # fine: not a thread body
+        """})
+    hits = sorted(f.detail for f in fs if f.code == "MXL008")
+    assert hits == ["_pump:count", "_worker:table"]
+
+
+def test_mxl008_global_write_and_other_object_ok(tmp_path):
+    fs = _lint(tmp_path, {"runtime.py": """
+        import threading
+
+        _TOTAL = 0
+
+        def _poll_loop(fut):
+            global _TOTAL
+            _TOTAL += 1            # flagged: module global, no lock
+            fut.blocks = [1]       # fine: not self / not a global
+            fut.event.set()        # fine: calls are not writes
+        """})
+    hits = [f.detail for f in fs if f.code == "MXL008"]
+    assert hits == ["_poll_loop:_TOTAL"]
+
+
+def test_mxl009_raw_lock_in_adopted_module(tmp_path):
+    body = """
+        import threading
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+        """
+    fs = _lint(tmp_path, {"ps.py": body,           # adopted: flagged
+                          "gps.py": body,          # suffix trap: clean
+                          "misc.py": body})        # not adopted: clean
+    hits = [f for f in fs if f.code == "MXL009"]
+    assert [f.path for f in hits] == ["pkg/ps.py"]
+    assert hits[0].detail == "__init__:threading.Lock"
+
+
+def test_mxl010_thread_without_daemon_and_name(tmp_path):
+    fs = _lint(tmp_path, {"runtime.py": """
+        import threading
+        def spawn(kw):
+            a = threading.Thread(target=print)                  # flagged
+            b = threading.Thread(target=print, daemon=True)     # flagged
+            c = threading.Thread(target=print, daemon=True,
+                                 name="good")                   # fine
+            d = threading.Thread(**kw)      # fine: kwargs unknowable
+            return a, b, c, d
+        """})
+    hits = [f for f in fs if f.code == "MXL010"]
+    assert len(hits) == 2
+    assert all(f.detail == "spawn" for f in hits)
+
+
 # -- suppression -------------------------------------------------------------
 
 def test_inline_disable(tmp_path):
@@ -220,4 +295,7 @@ def test_committed_baseline_is_empty():
 
 
 def test_rule_catalog_complete():
-    assert sorted(LINT_RULES) == [f"MXL00{i}" for i in range(1, 8)]
+    assert sorted(LINT_RULES) == [
+        "MXL001", "MXL002", "MXL003", "MXL004", "MXL005",
+        "MXL006", "MXL007", "MXL008", "MXL009", "MXL010",
+    ]

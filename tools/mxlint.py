@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Framework lint CLI over incubator_mxnet_tpu (rules MXL001-MXL007).
+"""Framework lint CLI over incubator_mxnet_tpu (rules MXL001-MXL010).
 
 The rule engine lives in incubator_mxnet_tpu/analysis/mxlint.py; this
 wrapper loads it BY FILE PATH so linting never imports the framework

@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""Run the runtime sanitizers (docs/STATIC_ANALYSIS.md) over real workloads.
+
+Two modes:
+
+    python tools/sanitize.py                        # all clean scenarios
+    python tools/sanitize.py --scenario serving     # one scenario
+    python tools/sanitize.py --inject abba          # seeded negative
+
+Clean scenarios run a workload under MXTPU_SANITIZERS=locks,pages (plus
+the MXL008-MXL010 concurrency lint for `threads`) and exit nonzero on ANY
+finding — this is the CI gate proving the instrumented runtime is itself
+sanitizer-clean:
+
+- serving: in-process ServingEngine smoke with prefix cache, chunked
+  prefill and n-gram speculation all ON; `run()` proves page quiescence
+  at drain via PageSanitizer.assert_quiescent().
+- chaos: `tools/chaos_train.py --elastic` in a subprocess with the
+  sanitizer env exported; fails on a nonzero exit or any `[sanitizers]`
+  line in its output (the atexit summary every sanitized process prints).
+- lint: MXL008-MXL010 over the package (the `threads` sanitizer is this
+  static check — Python offers no cheap dynamic data-race probe).
+
+Seeded negatives (--inject) plant one known bug and exit 0 ONLY when the
+sanitizer catches it — CI runs all three so a regression that blinds a
+sanitizer fails the build rather than silently passing it:
+
+- abba:        lock-order inversion across two lock classes  -> MXS001
+- leaked-page: extra unowned page reference alive at drain   -> MXS013
+- lint:        unlocked shared-state write from a thread body -> MXL008
+
+Exit status: 0 clean (or injection caught), 1 scenario findings,
+2 injection missed.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+SANITIZERS = "locks,pages,threads"
+
+
+def _load_mxlint():
+    """Load the lint engine by file path (no framework/jax import)."""
+    path = REPO_ROOT / "incubator_mxnet_tpu" / "analysis" / "mxlint.py"
+    spec = importlib.util.spec_from_file_location("_mxlint_standalone", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fail(msg):
+    print(f"sanitize: FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+# -- clean scenarios ----------------------------------------------------------
+
+def scenario_serving():
+    """Tiny ServingEngine with every lever on, sanitizers armed."""
+    import numpy as np
+    from incubator_mxnet_tpu.analysis import sanitizers
+    from incubator_mxnet_tpu.models import transformer as tfm
+    from incubator_mxnet_tpu.serving import ServingEngine
+
+    cfg = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=64)
+    params = tfm.init_params(cfg, seed=3)
+    rng = np.random.RandomState(17)
+    shared = rng.randint(1, 64, size=(9,)).astype(np.int32)
+    eng = ServingEngine(params, cfg, slots=3, page_size=8, num_pages=25,
+                        prefix_cache=1, prefill_chunk=6,
+                        spec_ngram=2, spec_lookahead=3)
+    for i in range(6):
+        tail = rng.randint(1, 64, size=(2 + i,)).astype(np.int32)
+        eng.submit(np.concatenate([shared, tail]), 5 + (i % 3))
+    eng.run()  # drain calls PageSanitizer.assert_quiescent()
+
+    rep = sanitizers.report()
+    if rep:
+        for d in rep:
+            print(f"sanitize: {d.code}: {d.message.splitlines()[0]}",
+                  file=sys.stderr)
+        return _fail(f"serving scenario produced {len(rep)} finding(s)")
+    print(f"sanitize: serving ok ({eng.steps} engine steps, "
+          f"0 findings)")
+    return 0
+
+
+def scenario_chaos():
+    """chaos_train --elastic in a subprocess with sanitizers exported."""
+    env = dict(os.environ, MXTPU_SANITIZERS="locks,pages")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    with tempfile.TemporaryDirectory(prefix="sanitize-chaos-") as wd:
+        proc = subprocess.run(
+            [sys.executable, str(REPO_ROOT / "tools" / "chaos_train.py"),
+             "--elastic", "--workdir", wd],
+            env=env, capture_output=True, text=True, timeout=900)
+    tainted = [ln for ln in (proc.stdout + proc.stderr).splitlines()
+               if "[sanitizers]" in ln]
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stdout[-2000:] + proc.stderr[-2000:])
+        return _fail(f"chaos_train exited {proc.returncode} under "
+                     f"sanitizers")
+    if tainted:
+        for ln in tainted:
+            print(f"sanitize: {ln}", file=sys.stderr)
+        return _fail("chaos_train run produced sanitizer findings")
+    print("sanitize: chaos ok (0 findings)")
+    return 0
+
+
+def scenario_lint():
+    """The `threads` sanitizer: MXL008-MXL010 over the package."""
+    mxlint = _load_mxlint()
+    fs, _ = mxlint.run_lint(REPO_ROOT / "incubator_mxnet_tpu",
+                            docs_root=REPO_ROOT / "docs")
+    conc = [f for f in fs if f.code in ("MXL008", "MXL009", "MXL010")]
+    for f in conc:
+        print(f"sanitize: {f.code} {f.path}:{f.line}: {f.message}",
+              file=sys.stderr)
+    if conc:
+        return _fail(f"concurrency lint produced {len(conc)} finding(s)")
+    print("sanitize: lint ok (0 findings)")
+    return 0
+
+
+# -- seeded negatives ---------------------------------------------------------
+
+def inject_abba():
+    """Establish A->B then B->A lock order; lockdep must report MXS001
+    from this single-threaded run (the cycle, not the crash, is the bug)."""
+    from incubator_mxnet_tpu.analysis import sanitizers
+    a = sanitizers.san_lock("inject.A")
+    b = sanitizers.san_lock("inject.B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # reverse edge closes the cycle
+            pass
+    if sanitizers.findings("MXS001"):
+        print("sanitize: inject abba caught (MXS001)")
+        return 0
+    print("sanitize: MISSED: ABBA inversion produced no MXS001",
+          file=sys.stderr)
+    return 2
+
+
+def inject_leaked_page():
+    """Take a page reference no owner mapping accounts for; the drain
+    accounting must report MXS013."""
+    from incubator_mxnet_tpu.analysis import sanitizers
+    from incubator_mxnet_tpu.serving import PageAllocator
+    alloc = PageAllocator(8, 8)
+    san = sanitizers.attach_page_sanitizer(alloc, force=True)
+    pages = alloc.alloc(2, owner=101)
+    alloc.share([pages[0]])  # anonymous ref: the seeded leak
+    san.check()
+    if sanitizers.findings("MXS013"):
+        print("sanitize: inject leaked-page caught (MXS013)")
+        return 0
+    print("sanitize: MISSED: leaked page reference produced no MXS013",
+          file=sys.stderr)
+    return 2
+
+
+_LINT_FIXTURE = '''\
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self.count = 0
+        self._thread = threading.Thread(target=self._worker,
+                                        daemon=True, name="w")
+
+    def _worker(self):
+        self.count += 1
+'''
+
+
+def inject_lint():
+    """An unlocked shared-state write from a thread body; MXL008 must
+    flag it."""
+    mxlint = _load_mxlint()
+    with tempfile.TemporaryDirectory(prefix="sanitize-lint-") as td:
+        pkg = Path(td) / "fixture_pkg"
+        pkg.mkdir()
+        (pkg / "racy.py").write_text(_LINT_FIXTURE)
+        fs, _ = mxlint.run_lint(pkg)
+    if any(f.code == "MXL008" for f in fs):
+        print("sanitize: inject lint caught (MXL008)")
+        return 0
+    print("sanitize: MISSED: unlocked thread-body write produced no "
+          "MXL008", file=sys.stderr)
+    return 2
+
+
+SCENARIOS = {"serving": scenario_serving, "chaos": scenario_chaos,
+             "lint": scenario_lint}
+INJECTIONS = {"abba": inject_abba, "leaked-page": inject_leaked_page,
+              "lint": inject_lint}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenario", choices=sorted(SCENARIOS) + ["all"],
+                    default="all", help="clean scenario(s) to run")
+    ap.add_argument("--inject", choices=sorted(INJECTIONS),
+                    help="run one seeded negative instead; exit 0 only "
+                         "when the sanitizer catches it")
+    args = ap.parse_args(argv)
+
+    # The enabled set is resolved at import; export it before the
+    # framework loads so every lock created anywhere is instrumented.
+    os.environ["MXTPU_SANITIZERS"] = SANITIZERS
+    sys.path.insert(0, str(REPO_ROOT))
+
+    if args.inject:
+        return INJECTIONS[args.inject]()
+
+    names = sorted(SCENARIOS) if args.scenario == "all" else [args.scenario]
+    rc = 0
+    for name in names:
+        rc = max(rc, SCENARIOS[name]())
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

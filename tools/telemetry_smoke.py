@@ -248,6 +248,59 @@ def main():
         f"({t_plain:.4f}s vs {t_slo:.4f}s) — the serving collectors "
         f"are not short-circuiting")
 
+    # -- runtime sanitizers (zero-cost-when-off contract) ---------------
+    import threading as _threading
+
+    from incubator_mxnet_tpu.analysis import sanitizers as _sanitizers
+
+    # structural half of the contract: with MXTPU_SANITIZERS unset the
+    # factories hand back PLAIN stdlib primitives (no wrapper object, no
+    # per-acquire indirection), no blocking-op patches are installed,
+    # and the allocator carries no shadow state
+    os.environ.pop("MXTPU_SANITIZERS", None)
+    _sanitizers.refresh_from_env()
+    assert type(_sanitizers.san_lock("smoke")) is type(_threading.Lock()), (
+        "san_lock() must return a plain threading.Lock while "
+        "MXTPU_SANITIZERS is unset")
+    assert _sanitizers._real_sleep is None, (
+        "blocking-op patches installed while the locks sanitizer is off")
+    eng_plain = ServingEngine(sparams, cfg, slots=2, page_size=8,
+                              num_pages=16)
+    assert eng_plain._page_san is None
+    assert eng_plain.allocator.sanitizer is None
+
+    # timed half: the sanitizer-off serving loop must stay within the
+    # same 5% bound against a fully armed engine (same gate shape as the
+    # telemetry off/on pairs above — if the off path secretly did
+    # sanitizer work it would show up as off NOT being faster)
+    os.environ["MXTPU_SANITIZERS"] = "locks,pages"
+    _sanitizers.refresh_from_env()
+    eng_armed = ServingEngine(sparams, cfg, slots=2, page_size=8,
+                              num_pages=16)
+    assert eng_armed._page_san is not None
+    os.environ.pop("MXTPU_SANITIZERS", None)
+    _sanitizers.refresh_from_env()
+
+    serve_loop(eng_plain)  # warm both engines before timing
+    serve_loop(eng_armed)
+    best_plain = best_armed = float("inf")
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        serve_loop(eng_plain)
+        best_plain = min(best_plain, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        serve_loop(eng_armed)
+        best_armed = min(best_armed, time.perf_counter() - t0)
+    print(f"sanitizers: off={best_plain * 1e3:.2f}ms "
+          f"armed={best_armed * 1e3:.2f}ms (best of {steps})")
+    assert best_plain <= best_armed * TOLERANCE, (
+        f"serving loop with sanitizers OFF is "
+        f">{(TOLERANCE - 1) * 100:.0f}% slower than with lockdep + page "
+        f"shadow state armed ({best_plain:.4f}s vs {best_armed:.4f}s) — "
+        f"the disabled path is not free")
+    assert not _sanitizers.report(), (
+        f"armed smoke engine produced findings: {_sanitizers.report()}")
+
     print("telemetry smoke OK")
 
 
