@@ -78,7 +78,8 @@ run_static_analysis() {
 run_sanitizers() {
     echo "=== sanitizer tier (lockdep + page shadow state over real workloads) ==="
     # clean scenarios: the serving engine (prefix cache + chunked prefill
-    # + speculation on) and the elastic chaos run execute under
+    # + speculation on), the fleet gateway (threaded router + HTTP front
+    # end + drain handshake), and the elastic chaos run execute under
     # MXTPU_SANITIZERS=locks,pages with ZERO findings, plus the
     # MXL008-MXL010 concurrency lint over the package
     JAX_PLATFORMS=cpu python tools/sanitize.py --scenario all
@@ -745,7 +746,15 @@ assert {"ttft_s", "latency_s", "finish"} <= set(
 print("serving observability: seeded breach detected, one post-mortem "
       "dump with request timelines")
 EOF
-    echo "serving tier: trace completed, zero steady-state retraces/fallbacks, seeded regression rejected, lever legs gated (prefix/chunked/spec token-identical), observatory legs green"
+    # fleet chaos: kill a replica mid-stream under load, roll the whole
+    # fleet, and hit the real HTTP gateway — gates on zero lost
+    # requests, token-identical failover vs the undisturbed reference,
+    # SLO monitors never reaching breach, and 429 backpressure
+    JAX_PLATFORMS=cpu python tools/chaos_serving.py --scenario all
+    # negative self-test: a silently dropped in-flight request MUST
+    # fail the zero-lost gate (exit 0 only when the gate catches it)
+    JAX_PLATFORMS=cpu python tools/chaos_serving.py --inject lost-request
+    echo "serving tier: trace completed, zero steady-state retraces/fallbacks, seeded regression rejected, lever legs gated (prefix/chunked/spec token-identical), observatory legs green, fleet chaos green (zero lost, token-identical failover, rolling restart zero drops, seeded lost-request caught)"
 }
 
 run_nightly() {

@@ -546,6 +546,40 @@ register_knob("MXTPU_DEBUG_ENDPOINTS", False, bool,
               "queue contents, which not every /metrics scraper should "
               "see.")
 
+# serving fleet (serving/fleet.py + serving/gateway.py — health-checked
+# routing, journaled mid-stream failover, draining rolling restarts)
+register_knob("MXTPU_FLEET_HEARTBEAT_TIMEOUT", 10.0, float,
+              "Seconds without a scheduler-pump heartbeat before the "
+              "fleet router declares a serving replica dead and "
+              "resubmits its journaled in-flight requests to the "
+              "survivors. Must exceed the replica's worst-case single "
+              "step (first-request compiles included) or a merely-slow "
+              "replica fails over spuriously — harmless for clients "
+              "(the journal dedups the zombie's late tokens) but "
+              "wasteful.")
+register_knob("MXTPU_FLEET_MAX_RESUBMITS", 3, int,
+              "Failover resubmissions a single request may consume "
+              "before the router gives up and fails it back to the "
+              "client (guards against a poison request that kills "
+              "every replica it lands on).")
+register_knob("MXTPU_GATEWAY_PORT", 0, int,
+              "TCP port for the serving HTTP gateway "
+              "(serving/gateway.py). 0 (default) binds an ephemeral "
+              "port — read it back from ServingGateway.port.")
+register_knob("MXTPU_GATEWAY_QUEUE_LIMIT", 64, int,
+              "Per-tenant router queue depth at which the gateway "
+              "stops admitting that tenant's requests and answers 429 "
+              "with Retry-After (bounded queueing instead of unbounded "
+              "latency collapse).")
+register_knob("MXTPU_GATEWAY_MAX_OCCUPANCY", 0.95, float,
+              "KV page-pool occupancy (on the LEAST loaded healthy "
+              "replica) above which the gateway sheds new requests "
+              "with 429 — admission control backpressured by the same "
+              "PageAllocator that backpressures slot admission.")
+register_knob("MXTPU_GATEWAY_RETRY_AFTER", 1.0, float,
+              "Retry-After seconds the gateway attaches to 429/503 "
+              "responses.")
+
 # contrib / compatibility shims
 register_knob("MXTPU_USE_TENSORRT", False, bool,
               "TensorRT-compat preference flag (contrib.tensorrt). Purely "

@@ -6,9 +6,11 @@ consult the process-wide injector at well-defined points. Grammar::
     spec     := rule (";" rule)*
     rule     := site ":" mode "@" arg
     site     := dotted name (ps.rpc | ps.rpc.recv | ps.connect |
-                ckpt.write | data.fetch | grad.nonfinite | train.step)
-    mode     := drop | fail | torn | sigterm
+                ckpt.write | data.fetch | grad.nonfinite | train.step |
+                gateway.accept | replica.rpc | replica.kill)
+    mode     := drop | fail | torn | sigterm | delay
     arg      := probability (float in [0,1)) | call indices (int[,int...])
+    arg      := ms | ms "x" (probability | indices)      # delay mode only
 
 Examples::
 
@@ -20,13 +22,32 @@ Examples::
     grad.nonfinite:fail@7       # poison step 7's gradients with a NaN
     train.step:sigterm@5        # deliver SIGTERM to self at step 5
                                 # (a deterministic preemption)
+    gateway.accept:fail@0.1     # the serving gateway 503s ~10% of accepts
+    replica.kill:fail@8         # a serving replica dies abruptly at its
+                                # 8th scheduler pump (mid-stream failover)
+    replica.rpc:delay@50        # every router<->replica exchange takes
+                                # 50 ms extra (a SLOW replica, not a dead
+                                # one — heartbeats go stale while the
+                                # replica keeps producing)
+    replica.rpc:delay@50x3,4    # only exchanges 3 and 4 are slow
+    replica.rpc:delay@50x0.2    # ~20% of exchanges are slow (seeded)
 
 `sigterm` is the preemption mode: the site delivers SIGTERM to its own
 process, exercising the graceful-shutdown drain (resilience.preemption)
 at an exactly reproducible step. `grad.nonfinite` is consulted by the
 Trainer's divergence guardrail: any fired mode at that site multiplies
 the gradients by NaN before the non-finite check, so guardrail policies
-(skip / backoff / rollback) replay deterministically.
+(skip / backoff / rollback) replay deterministically. `delay` is the
+slow-node mode: a fired call sleeps its rule's milliseconds in place
+(sites consult it through `sleep_for`/`raise_for`), which is how the
+serving fleet's chaos legs produce a live-but-stale replica whose
+requests fail over while it still streams (the duplicate-delivery path
+the journal must dedup). The serving-fleet sites: `gateway.accept` is
+consulted once per HTTP request before admission, `replica.rpc` once
+per router->replica dispatch and once per scheduler pump (the instance
+tag is the replica id), and `replica.kill` once per pump — ANY fired
+mode there kills the replica abruptly: no drain, no more heartbeats,
+its in-flight requests recover only through journal failover.
 
 Determinism: every (site, instance) pair owns an independent call counter
 and PRNG stream seeded from `MXTPU_FAULT_SEED` — concurrent clients do
@@ -43,6 +64,7 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 
 __all__ = ["FaultInjector", "InjectedConnectionError", "InjectedIOError",
            "injector", "install", "refresh_from_env"]
@@ -51,7 +73,7 @@ _FAULT_METRIC = "mxtpu_fault_injections_total"
 _FAULT_HELP = ("Faults fired by the deterministic injector "
                "(MXTPU_FAULT_SPEC), by site and mode.")
 
-_MODES = ("drop", "fail", "torn", "sigterm")
+_MODES = ("drop", "fail", "torn", "sigterm", "delay")
 
 
 class InjectedConnectionError(ConnectionError):
@@ -63,13 +85,14 @@ class InjectedIOError(OSError):
 
 
 class _Rule:
-    __slots__ = ("site", "mode", "prob", "indices")
+    __slots__ = ("site", "mode", "prob", "indices", "delay_ms")
 
-    def __init__(self, site, mode, prob, indices):
+    def __init__(self, site, mode, prob, indices, delay_ms=None):
         self.site = site
         self.mode = mode
         self.prob = prob          # float or None
         self.indices = indices    # frozenset of 1-based call indices or None
+        self.delay_ms = delay_ms  # float ms (mode "delay" only)
 
 
 def _parse_spec(spec):
@@ -87,7 +110,30 @@ def _parse_spec(spec):
             raise ValueError(
                 f"bad MXTPU_FAULT_SPEC mode {mode!r} in {part!r}; "
                 f"expected one of {_MODES}")
-        prob = indices = None
+        prob = indices = delay_ms = None
+        if mode == "delay":
+            # delay arg: "<ms>" (every call) or "<ms>x<prob-or-indices>"
+            ms, sep, arg = arg.partition("x")
+            if sep and not arg:
+                raise ValueError(
+                    f"bad MXTPU_FAULT_SPEC delay selector in {part!r}; "
+                    "expected delay@msxselector")
+            try:
+                delay_ms = float(ms)
+            except ValueError:
+                raise ValueError(
+                    f"bad MXTPU_FAULT_SPEC delay {ms!r} in {part!r}; "
+                    "expected milliseconds (delay@ms or "
+                    "delay@msxselector)") from None
+            if delay_ms < 0:
+                raise ValueError(
+                    f"MXTPU_FAULT_SPEC delay in {part!r} must be >= 0 ms")
+            if not arg:  # no selector: the rule fires on every call
+                if site in rules:
+                    raise ValueError(
+                        f"duplicate MXTPU_FAULT_SPEC site {site!r}")
+                rules[site] = _Rule(site, mode, None, None, delay_ms)
+                continue
         try:
             indices = frozenset(int(s) for s in arg.split(","))
         except ValueError:
@@ -109,7 +155,7 @@ def _parse_spec(spec):
                     "be >= 1 (1-based)")
         if site in rules:
             raise ValueError(f"duplicate MXTPU_FAULT_SPEC site {site!r}")
-        rules[site] = _Rule(site, mode, prob, indices)
+        rules[site] = _Rule(site, mode, prob, indices, delay_ms)
     return rules
 
 
@@ -131,8 +177,8 @@ class FaultInjector:
 
     def action(self, site, instance=""):
         """Advance the (site, instance) stream one call; return the fault
-        mode to apply at this call ('drop' | 'fail' | 'torn' | 'sigterm')
-        or None."""
+        mode to apply at this call ('drop' | 'fail' | 'torn' | 'sigterm'
+        | 'delay') or None."""
         rule = self._rules.get(site)
         if rule is None:
             return None
@@ -142,6 +188,8 @@ class FaultInjector:
             self._calls[key] = n
             if rule.indices is not None:
                 hit = n in rule.indices
+            elif rule.prob is None:
+                hit = True  # selector-less delay rule: every call
             else:
                 rng = self._rngs.get(key)
                 if rng is None:
@@ -162,8 +210,8 @@ class FaultInjector:
 
     def raise_for(self, site, instance=""):
         """Site helper for connection-shaped faults: raises the injected
-        error for `drop`/`fail`; returns any other action (or None) for
-        the site to interpret."""
+        error for `drop`/`fail`, sleeps a fired `delay` in place;
+        returns any other action (or None) for the site to interpret."""
         act = self.action(site, instance)
         if act == "drop":
             raise InjectedConnectionError(
@@ -171,7 +219,26 @@ class FaultInjector:
         if act == "fail":
             raise InjectedIOError(
                 f"fault injection: IO failure at {site!r}")
+        if act == "delay":
+            time.sleep(self._rules[site].delay_ms / 1000.0)
         return act
+
+    def sleep_for(self, site, instance=""):
+        """Site helper for latency-shaped faults: a fired `delay` rule
+        sleeps its milliseconds here; every action (or None) is
+        returned for the site to interpret."""
+        act = self.action(site, instance)
+        if act == "delay":
+            time.sleep(self._rules[site].delay_ms / 1000.0)
+        return act
+
+    def delay_ms(self, site):
+        """Configured delay for `site`'s rule (0.0 when the site has no
+        delay rule) — for sites that model the latency themselves
+        (e.g. a synthetic clock) instead of really sleeping."""
+        rule = self._rules.get(site)
+        return float(rule.delay_ms) if rule is not None \
+            and rule.delay_ms is not None else 0.0
 
     def fired(self, site=None, mode=None):
         """Injection count, optionally filtered by site and/or mode."""

@@ -17,9 +17,21 @@ KV copy-on-write (`MXTPU_PREFIX_CACHE`), chunked prefill interleaves
 prompt chunks with decode steps (`MXTPU_PREFILL_CHUNK`), and n-gram
 prompt-lookup speculation verifies drafts through one wide-query
 program (`MXTPU_SPEC_NGRAM`/`MXTPU_SPEC_LOOKAHEAD`).
+
+Above the single engine sits the fault-tolerant fleet layer:
+`fleet.FleetRouter` health-checks replicas by heartbeat, fails
+in-flight requests over mid-stream through the `fleet.RequestJournal`
+(greedy decode makes the replayed continuation token-identical), and
+runs zero-drop draining rolling restarts; `gateway.ServingGateway` is
+the streaming HTTP front door with tenant-fair admission control
+backpressured by KV page-pool occupancy.
 """
 from .pages import PageAllocator, PrefixCache  # noqa: F401
 from .engine import Request, RequestResult, ServingEngine  # noqa: F401
+from .fleet import (  # noqa: F401
+    FleetRouter, JournalEntry, Replica, RequestJournal)
+from .gateway import ServingGateway  # noqa: F401
 
 __all__ = ["PageAllocator", "PrefixCache", "Request", "RequestResult",
-           "ServingEngine"]
+           "ServingEngine", "FleetRouter", "JournalEntry", "Replica",
+           "RequestJournal", "ServingGateway"]

@@ -190,6 +190,12 @@ class ServingEngine:
                                               self.page_size)
         self.prefill_buckets = _default_buckets(self.max_len)
         self._clock = clock
+        # the engine lock: submit/cancel arrive from gateway and fleet
+        # threads while the pump thread sits inside step(). Reentrant
+        # because step() finishing a request may call back through the
+        # public surface; a san_rlock so lockdep sees the ordering
+        # against the fleet/journal locks.
+        self._lock = _sanitizers.san_rlock("serving.engine")
 
         # perf levers (each defaults from its knob; constructor args
         # override for tests/benches) — all off reproduces the base
@@ -336,39 +342,43 @@ class ServingEngine:
             raise ValueError(
                 f"request needs {need} pages but the pool only has "
                 f"{self.allocator.capacity}")
-        rid = next(self._ids)
-        req = Request(rid, prompt, int(max_new_tokens), eos_id,
-                      submitted_at=self._clock())
-        if _dtrace.trace_active():
-            # trace context is born HERE: tid groups the whole lifecycle,
-            # sid is the root "serving.request" span every stage parents
-            # under, ns_submit anchors engine-clock deltas to wall time
-            req.trace = {"tid": _dtrace.new_id(), "sid": _dtrace.new_id(),
-                         "ns_submit": time.time_ns(),
-                         "clk_submit": req.submitted_at}
-        self._queue.append(req)
-        telemetry.set_gauge(QUEUE_DEPTH, len(self._queue))
-        telemetry.set_gauge(
-            OLDEST_QUEUED,
-            self._clock() - self._queue[0].submitted_at)
-        return rid
+        with self._lock:
+            rid = next(self._ids)
+            req = Request(rid, prompt, int(max_new_tokens), eos_id,
+                          submitted_at=self._clock())
+            if _dtrace.trace_active():
+                # trace context is born HERE: tid groups the whole
+                # lifecycle, sid is the root "serving.request" span every
+                # stage parents under, ns_submit anchors engine-clock
+                # deltas to wall time
+                req.trace = {"tid": _dtrace.new_id(),
+                             "sid": _dtrace.new_id(),
+                             "ns_submit": time.time_ns(),
+                             "clk_submit": req.submitted_at}
+            self._queue.append(req)
+            telemetry.set_gauge(QUEUE_DEPTH, len(self._queue))
+            telemetry.set_gauge(
+                OLDEST_QUEUED,
+                self._clock() - self._queue[0].submitted_at)
+            return rid
 
     def step(self):
         """One scheduler iteration: admit queued requests into free
         slots (FIFO, backpressured by page availability), then advance
         every live slot one token in a single decode program. Returns
         the number of live slots after the iteration."""
-        with telemetry.span("serving.step", step=self.steps):
-            self._admit()
-            if self.prefill_chunk:
-                self._prefill_chunks_once()
-            if self.spec_ngram:
-                live = self._decode_spec_once()
-            else:
-                live = self._decode_once()
-        self.steps += 1
-        self._export_gauges()
-        return live
+        with self._lock:
+            with telemetry.span("serving.step", step=self.steps):
+                self._admit()
+                if self.prefill_chunk:
+                    self._prefill_chunks_once()
+                if self.spec_ngram:
+                    live = self._decode_spec_once()
+                else:
+                    live = self._decode_once()
+            self.steps += 1
+            self._export_gauges()
+            return live
 
     def run(self, max_steps=100_000):
         """Drive step() until the queue and every slot drain; returns
@@ -376,18 +386,37 @@ class ServingEngine:
         `max_steps` bounds a scheduler bug (a request that can never
         finish) — hitting it raises instead of spinning forever."""
         for _ in range(max_steps):
-            if not self._queue and not any(self._slot_req):
-                if self._page_san is not None:
-                    # every live reference must now be owned by the
-                    # prefix cache; anything else leaked (MXS013)
-                    self._page_san.assert_quiescent()
-                return dict(self._results)
+            with self._lock:
+                if not self._queue and not any(self._slot_req):
+                    if self._page_san is not None:
+                        # every live reference must now be owned by the
+                        # prefix cache; anything else leaked (MXS013)
+                        self._page_san.assert_quiescent()
+                    return dict(self._results)
             self.step()
         raise RuntimeError(f"serving engine did not drain within "
                            f"{max_steps} steps")
 
     def results(self):
-        return dict(self._results)
+        with self._lock:
+            return dict(self._results)
+
+    def live_tokens(self):
+        """{request_id: continuation tokens streamed so far} for every
+        request holding a slot (mid-prefill slots report []). Queued
+        requests have produced nothing and do not appear. This is the
+        fleet journal's streaming tap: it is read after every pump and
+        the per-request deltas forwarded to the client."""
+        with self._lock:
+            return {r.request_id: list(self._slot_out[s])
+                    for s, r in enumerate(self._slot_req) if r is not None}
+
+    def queued_request_ids(self):
+        """Request ids still waiting in the admission queue (FIFO
+        order) — the set a draining replica hands straight back to the
+        router instead of finishing locally."""
+        with self._lock:
+            return [r.request_id for r in self._queue]
 
     @property
     def queue_depth(self):
@@ -937,8 +966,15 @@ class ServingEngine:
         """Evict: record the result and recycle the pages IMMEDIATELY —
         the very next _admit() can hand them to a queued request.
         `reason` overrides the eos/length inference (mid-stream
-        eviction passes "evicted")."""
+        eviction passes "evicted").
+
+        Idempotent per occupancy: a slot that already finished (EOS in
+        the same step a cancel() raced in, say) returns without
+        touching the allocator — the double-free guard the MXS010
+        regression test pins."""
         req = self._slot_req[slot]
+        if req is None:
+            return
         out = self._slot_out[slot]
         if reason is None:
             reason = ("eos" if req.eos_id is not None and out
@@ -1092,6 +1128,10 @@ class ServingEngine:
         """Live-engine JSON snapshot, served at /debug/engine by the
         telemetry HTTP server (MXTPU_DEBUG_ENDPOINTS=1) and rendered by
         tools/serving_top.py."""
+        with self._lock:
+            return self._debug_snapshot_locked()
+
+    def _debug_snapshot_locked(self):
         now = self._clock()
         slot_rows = []
         for s, req in enumerate(self._slot_req):
@@ -1183,6 +1223,10 @@ class ServingEngine:
         pushed through the device counts as wasted. Returns True when
         the request was cancelled, False when the id is unknown or
         already finished."""
+        with self._lock:
+            return self._cancel_locked(request_id)
+
+    def _cancel_locked(self, request_id):
         for i, req in enumerate(self._queue):
             if req.request_id == request_id:
                 del self._queue[i]

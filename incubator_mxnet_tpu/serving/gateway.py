@@ -1,0 +1,259 @@
+"""HTTP serving gateway: streaming generation in front of the fleet.
+
+A small stdlib HTTP front end (the telemetry /metrics server is the
+pattern — no web framework, `ThreadingHTTPServer` + one daemon thread)
+that turns the FleetRouter into a service:
+
+- `POST /v1/generate` — body `{"prompt": [ids...], "max_new_tokens": N,
+  "eos_id": id?, "tenant": "name"?, "stream": true?}`. With
+  `stream: true` (the default) the response is close-delimited NDJSON
+  (HTTP/1.0, no Content-Length): one `{"event": "token", ...}` line per
+  generated token as it is produced, then a terminal `done`/`failed`
+  line. Tokens stream straight off the request journal, so a mid-stream
+  replica failover is invisible here beyond a pause: the journal's
+  epoch fence guarantees every index appears exactly once, in order.
+- `GET /healthz` — fleet liveness for load balancers: 200 while at
+  least one replica is healthy, 503 when draining or empty.
+
+Admission control is backpressure, not buffering: a request is REJECTED
+with `429 Retry-After` when its tenant's queue already holds
+`MXTPU_GATEWAY_QUEUE_LIMIT` waiting requests or when every healthy
+replica's KV page pool is above `MXTPU_GATEWAY_MAX_OCCUPANCY` — the
+caller retries against a fleet that said so honestly instead of timing
+out against one that lied. A draining fleet (rolling restart's final
+step, SIGTERM) answers `503 Retry-After`: new work belongs on the
+replacement fleet, in-flight work finishes here.
+
+The `gateway.accept` fault site is consulted once per HTTP request
+before admission (`drop`/`fail` → a clean 503 with outcome "injected",
+`delay` → a slow accept path), which is how the chaos legs separate
+"the gateway shed load" from "the fleet lost a request" — the former is
+allowed, the latter never is.
+"""
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .. import config, telemetry
+from ..analysis import sanitizers as _sanitizers
+from ..resilience import fault as _fault
+
+__all__ = ["ServingGateway"]
+
+GW_REQUESTS_TOTAL = "mxtpu_gateway_requests_total"
+GW_INFLIGHT = "mxtpu_gateway_inflight"
+
+
+class _Reject(Exception):
+    """Admission refused: (status, outcome label, body dict)."""
+
+    def __init__(self, status, outcome, body, retry_after=None):
+        super().__init__(body.get("error", outcome))
+        self.status = status
+        self.outcome = outcome
+        self.body = body
+        self.retry_after = retry_after
+
+
+class _GatewayServer(ThreadingHTTPServer):
+    daemon_threads = True
+    gateway = None  # set by ServingGateway before serving
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # HTTP/1.0: responses are close-delimited, which is what lets the
+    # token stream flush line-by-line without chunked-encoding framing
+    protocol_version = "HTTP/1.0"
+
+    def log_message(self, fmt, *args):  # quiet; telemetry has the counts
+        pass
+
+    def _reply(self, status, body, retry_after=None):
+        data = (json.dumps(body) + "\n").encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        if retry_after is not None:
+            self.send_header("Retry-After", f"{retry_after:g}")
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        gw = self.server.gateway
+        if self.path == "/healthz":
+            status, body = gw.health()
+            self._reply(status, body)
+        else:
+            self._reply(404, {"error": f"unknown path {self.path!r}"})
+
+    def do_POST(self):
+        gw = self.server.gateway
+        if self.path != "/v1/generate":
+            self._reply(404, {"error": f"unknown path {self.path!r}"})
+            return
+        gw.handle_generate(self)
+
+
+class ServingGateway:
+    """The fleet's HTTP front door. Binds 127.0.0.1:`port` (the
+    `MXTPU_GATEWAY_PORT` knob; 0 = ephemeral, read the bound port back
+    from `.port`) and serves until `close()`."""
+
+    def __init__(self, router, *, port=None, queue_limit=None,
+                 max_occupancy=None, retry_after=None,
+                 request_timeout=600.0):
+        self.router = router
+        self.queue_limit = int(
+            queue_limit if queue_limit is not None
+            else config.get("MXTPU_GATEWAY_QUEUE_LIMIT"))
+        self.max_occupancy = float(
+            max_occupancy if max_occupancy is not None
+            else config.get("MXTPU_GATEWAY_MAX_OCCUPANCY"))
+        self.retry_after = float(
+            retry_after if retry_after is not None
+            else config.get("MXTPU_GATEWAY_RETRY_AFTER"))
+        self.request_timeout = float(request_timeout)
+        self._inflight = 0
+        self._inflight_lock = _sanitizers.san_lock("serving.gateway")
+        bind_port = int(port if port is not None
+                        else config.get("MXTPU_GATEWAY_PORT"))
+        self._server = _GatewayServer(("127.0.0.1", bind_port), _Handler)
+        self._server.gateway = self
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="mxtpu-gateway-http")
+        self._thread.start()
+        telemetry.log_event("gateway_started", port=self.port)
+
+    @property
+    def port(self):
+        return self._server.server_address[1]
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=10.0)
+
+    # -- request path ------------------------------------------------------
+
+    def health(self):
+        healthy = self.router.healthy_count()
+        if self.router.draining:
+            return 503, {"status": "draining", "healthy_replicas": healthy,
+                         "retry_after_s": self.retry_after}
+        if not healthy:
+            return 503, {"status": "unhealthy", "healthy_replicas": 0}
+        return 200, {"status": "ok", "healthy_replicas": healthy}
+
+    def _admit(self, raw):
+        """Fault site, drain check, parse, backpressure, journal submit.
+        Returns (entry_id, tenant, event queue); raises _Reject."""
+        try:
+            _fault.injector().raise_for("gateway.accept")
+        except (ConnectionError, OSError) as e:
+            raise _Reject(503, "injected",
+                          {"error": f"fault injection: {e}"},
+                          retry_after=self.retry_after) from None
+        if self.router.draining:
+            raise _Reject(503, "draining",
+                          {"error": "fleet is draining (rolling restart); "
+                                    "retry against the replacement fleet"},
+                          retry_after=self.retry_after)
+        try:
+            payload = json.loads(raw or b"{}")
+            prompt = payload["prompt"]
+            max_new = int(payload["max_new_tokens"])
+        except (ValueError, TypeError, KeyError) as e:
+            raise _Reject(400, "error",
+                          {"error": f"bad request body: {e!r}"}) from None
+        tenant = str(payload.get("tenant", "default"))
+        if self.router.tenant_depth(tenant) >= self.queue_limit:
+            raise _Reject(429, "rejected",
+                          {"error": f"tenant {tenant!r} queue is full "
+                                    f"({self.queue_limit})"},
+                          retry_after=self.retry_after)
+        if self.router.min_occupancy() >= self.max_occupancy:
+            raise _Reject(429, "rejected",
+                          {"error": "KV page pools above "
+                                    f"{self.max_occupancy:.0%} on every "
+                                    "healthy replica"},
+                          retry_after=self.retry_after)
+        events = queue.Queue()
+        try:
+            entry_id = self.router.submit(
+                prompt, max_new, eos_id=payload.get("eos_id"),
+                tenant=tenant, sink=events.put)
+        except ValueError as e:
+            raise _Reject(400, "error", {"error": str(e)}) from None
+        except RuntimeError as e:
+            raise _Reject(503, "draining" if "draining" in str(e)
+                          else "error", {"error": str(e)},
+                          retry_after=self.retry_after) from None
+        return entry_id, tenant, payload.get("stream", True), events
+
+    def handle_generate(self, handler):
+        raw = handler.rfile.read(
+            int(handler.headers.get("Content-Length") or 0))
+        try:
+            entry_id, tenant, stream, events = self._admit(raw)
+        except _Reject as rej:
+            telemetry.inc(GW_REQUESTS_TOTAL, outcome=rej.outcome)
+            handler._reply(rej.status, rej.body,
+                           retry_after=rej.retry_after)
+            return
+        with self._inflight_lock:
+            self._inflight += 1
+            telemetry.set_gauge(GW_INFLIGHT, self._inflight)
+        try:
+            outcome = (self._stream_response(handler, entry_id, events)
+                       if stream else
+                       self._unary_response(handler, entry_id, events))
+        except (BrokenPipeError, ConnectionResetError):
+            outcome = "error"  # client went away mid-stream
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+                telemetry.set_gauge(GW_INFLIGHT, self._inflight)
+        telemetry.inc(GW_REQUESTS_TOTAL, outcome=outcome)
+
+    def _next_event(self, events):
+        try:
+            return events.get(timeout=self.request_timeout)
+        except queue.Empty:
+            return {"event": "failed",
+                    "error": f"gateway timeout after "
+                             f"{self.request_timeout:g}s"}
+
+    def _stream_response(self, handler, entry_id, events):
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/x-ndjson")
+        handler.send_header("X-Entry-Id", str(entry_id))
+        # no Content-Length: HTTP/1.0 + Connection: close delimit the
+        # stream, each event line flushes as the fleet produces it
+        handler.send_header("Connection", "close")
+        handler.end_headers()
+        while True:
+            ev = self._next_event(events)
+            handler.wfile.write((json.dumps(ev) + "\n").encode())
+            handler.wfile.flush()
+            if ev.get("event") == "done":
+                return "ok"
+            if ev.get("event") == "failed":
+                return "error"
+
+    def _unary_response(self, handler, entry_id, events):
+        while True:
+            ev = self._next_event(events)
+            if ev.get("event") == "done":
+                handler._reply(200, {"entry_id": entry_id,
+                                     "tokens": ev["tokens"],
+                                     "finish_reason": ev["finish_reason"],
+                                     "resubmits": ev.get("resubmits", 0)})
+                return "ok"
+            if ev.get("event") == "failed":
+                handler._reply(500, {"entry_id": entry_id,
+                                     "error": ev.get("error", "failed")})
+                return "error"

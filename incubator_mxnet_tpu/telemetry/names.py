@@ -246,6 +246,43 @@ METRIC_NAMES = {
         "counter", "Proposed draft tokens accepted by wide-query "
                    "verification (acceptance rate = accepted / "
                    "proposed)."),
+    "mxtpu_fleet_replicas": (
+        "gauge", "Serving replicas known to the fleet router, by state "
+                 "(healthy / draining / dead / left)."),
+    "mxtpu_fleet_failovers_total": (
+        "counter", "Replicas the fleet router declared dead on "
+                   "heartbeat timeout (each failover resubmits every "
+                   "journaled in-flight request of the corpse to a "
+                   "survivor)."),
+    "mxtpu_fleet_resubmits_total": (
+        "counter", "Requests resubmitted by the fleet router, by reason "
+                   "(failover = original replica declared dead, drain = "
+                   "handed off from a draining replica's admission "
+                   "queue, rpc = dispatch RPC to a replica failed)."),
+    "mxtpu_fleet_drains_total": (
+        "counter", "Serving replicas that completed the drain handshake "
+                   "and left the router (the rolling-restart path: stop "
+                   "admitting, hand off queued work, finish in-slot "
+                   "requests, leave)."),
+    "mxtpu_fleet_dup_tokens_dropped_total": (
+        "counter", "Stale or duplicate token deliveries the request "
+                   "journal discarded (a failed-over replica that was "
+                   "slow rather than dead keeps streaming under its old "
+                   "assignment epoch; clients never see a token "
+                   "twice)."),
+    "mxtpu_fleet_lost_requests_total": (
+        "counter", "Requests the fleet router failed back to the client "
+                   "after exhausting MXTPU_FLEET_MAX_RESUBMITS — the "
+                   "zero-lost-requests chaos gate asserts this stays "
+                   "0."),
+    "mxtpu_gateway_requests_total": (
+        "counter", "HTTP requests answered by the serving gateway, by "
+                   "outcome (ok / error = 4xx or journal failure, "
+                   "rejected = 429 backpressure, draining = 503 during "
+                   "shutdown, injected = gateway.accept fault)."),
+    "mxtpu_gateway_inflight": (
+        "gauge", "Generation requests currently open on the serving "
+                 "gateway (accepted, not yet finished streaming)."),
     "mxtpu_slo_burn_rate": (
         "gauge", "SLO error-budget burn rate (bad_fraction / budget), "
                  "by objective and window (short / long)."),

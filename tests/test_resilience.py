@@ -144,6 +144,49 @@ def test_fault_raise_for_types():
     assert inj.raise_for("unknown.site") is None
 
 
+def test_fault_delay_parse_variants():
+    # delay@ms (every call), delay@msxindices, delay@msxprobability
+    inj = _fault.FaultInjector(
+        "a:delay@50;b:delay@50x3,4;c:delay@50x0.2", seed=0)
+    assert inj.delay_ms("a") == 50.0
+    assert inj.delay_ms("b") == 50.0
+    assert inj.delay_ms("c") == 50.0
+    assert inj.delay_ms("unknown.site") == 0.0
+    # a selector-less delay rule fires on EVERY call
+    assert [inj.action("a") for _ in range(5)] == ["delay"] * 5
+    # an indexed delay fires only at those call indices
+    assert [inj.action("b") for _ in range(5)] == [
+        None, None, "delay", "delay", None]
+    for bad in ("s:delay@oops", "s:delay@-5", "s:delay@", "s:delay@5x"):
+        with pytest.raises(ValueError):
+            _fault.FaultInjector(bad)
+
+
+def test_fault_delay_sleeps_in_raise_for_and_sleep_for():
+    inj = _fault.FaultInjector("slow.site:delay@30", seed=0)
+    t0 = time.monotonic()
+    assert inj.sleep_for("slow.site") == "delay"
+    assert time.monotonic() - t0 >= 0.025
+    # raise_for treats delay as latency, not an error
+    t0 = time.monotonic()
+    assert inj.raise_for("slow.site") == "delay"
+    assert time.monotonic() - t0 >= 0.025
+    assert inj.fired("slow.site", mode="delay") == 2
+    # sites without a rule return instantly with None
+    assert inj.sleep_for("other.site") is None
+
+
+def test_fault_delay_probability_is_seeded_per_instance():
+    spec = "net.hop:delay@1x0.5"
+    a = _fault.FaultInjector(spec, seed=4)
+    b = _fault.FaultInjector(spec, seed=4)
+    run_a = [a.action("net.hop", "w0") for _ in range(40)]
+    assert run_a == [b.action("net.hop", "w0") for _ in range(40)]
+    assert "delay" in run_a and None in run_a  # probabilistic mix
+    # a different instance draws from an independent stream
+    assert run_a != [a.action("net.hop", "w1") for _ in range(40)]
+
+
 def test_injector_resolves_from_env(monkeypatch):
     monkeypatch.setenv("MXTPU_FAULT_SPEC", "x.y:fail@1")
     monkeypatch.setenv("MXTPU_FAULT_SEED", "9")

@@ -648,3 +648,58 @@ def test_engine_debug_snapshot_v2_lever_sections():
     chunked = snap["chunked_prefill"]
     assert chunked["chunk"] == 4 and chunked["chunks_total"] > 0
     assert snap["tokens"]["spec_rejected"] >= 0
+
+
+# -- cancel/eviction race hardening ------------------------------------------
+
+def test_cancel_after_finish_is_noop_and_waste_counted_once():
+    """The cancel/EOS race: a cancel() landing in the same step the
+    request finished must not double-free its pages (the PageSanitizer
+    MXS010 regression) and eviction waste is counted exactly once."""
+    from incubator_mxnet_tpu.analysis import sanitizers
+
+    sanitizers.reset()
+    cfg = _small_cfg()
+    params = tfm.init_params(cfg, seed=3)
+    rng = np.random.RandomState(9)
+    eng = ServingEngine(params, cfg, slots=2, page_size=8, num_pages=16)
+    san = sanitizers.attach_page_sanitizer(eng.allocator, force=True)
+    try:
+        # leg 1: cancel mid-stream is an eviction, waste counted once
+        p = rng.randint(1, 64, 6).astype(np.int32)
+        rid = eng.submit(p, 10)
+        eng.step()
+        eng.step()
+        out_now = len(eng.live_tokens()[rid])
+        assert 0 < out_now < 10
+        base = eng._wasted_evicted
+        assert eng.cancel(rid)
+        assert eng.results()[rid].finish_reason == "evicted"
+        assert eng._wasted_evicted == base + p.size + out_now
+        # the race: a second cancel of the finished id is a clean no-op
+        assert not eng.cancel(rid)
+        assert eng._wasted_evicted == base + p.size + out_now
+
+        # leg 2: cancel racing a natural EOS-in-the-same-step finish
+        rid2 = eng.submit(rng.randint(1, 64, 5).astype(np.int32), 3)
+        eng.run()
+        assert not eng.cancel(rid2)
+
+        # leg 3: the internal raced path — _finish() twice on one slot
+        rid3 = eng.submit(rng.randint(1, 64, 5).astype(np.int32), 8)
+        eng.step()
+        (slot,) = [s for s, r in enumerate(eng._slot_req)
+                   if r is not None and r.request_id == rid3]
+        out3 = len(eng._slot_out[slot])
+        base = eng._wasted_evicted
+        eng._finish(slot, reason="evicted")
+        eng._finish(slot, reason="evicted")  # idempotence guard
+        assert eng._wasted_evicted == base + 5 + out3
+
+        # nothing above double-freed a page or leaked a reference
+        eng.run()
+        san.check()
+        assert not sanitizers.findings("MXS010")
+        assert not sanitizers.report()
+    finally:
+        sanitizers.reset()

@@ -1,0 +1,421 @@
+"""Fault-tolerant serving fleet: request journal semantics (epoch
+fence, duplicate suppression), heartbeat-detected mid-stream failover
+with token-identical resume, zero-dropped-request rolling restarts and
+SIGTERM drain, the HTTP gateway, and the fleet view of serving_top.
+See docs/FAULT_TOLERANCE.md ("Serving failover")."""
+import http.client
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from incubator_mxnet_tpu.models import transformer as tfm
+from incubator_mxnet_tpu.resilience import fault as _fault
+from incubator_mxnet_tpu.resilience import preemption as _preemption
+from incubator_mxnet_tpu.serving import (
+    FleetRouter, RequestJournal, ServingEngine, ServingGateway)
+
+_PARAM_CACHE = {}
+
+
+@pytest.fixture(autouse=True)
+def _reset_injector():
+    _fault.install(None)
+    yield
+    _fault.install(None)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _tiny_model():
+    """One compiled model shared by every test in the file."""
+    if "tiny" not in _PARAM_CACHE:
+        cfg = tfm.TransformerConfig(vocab=32, d_model=16, n_heads=2,
+                                    n_layers=1, d_ff=32, max_len=32)
+        _PARAM_CACHE["tiny"] = (cfg, tfm.init_params(cfg, seed=3))
+    return _PARAM_CACHE["tiny"]
+
+
+def _workload(n=4, max_new=8, seed=7):
+    """Prompts plus their undisturbed greedy references — the oracle
+    the failover tests compare against."""
+    cfg, params = _tiny_model()
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(1, 32, size=rng.randint(3, 7)).astype(np.int32)
+               for _ in range(n)]
+    refs = [list(np.asarray(
+        tfm.generate(params, jnp.asarray(p)[None], max_new, cfg))[0])
+        for p in prompts]
+    return cfg, params, prompts, refs
+
+
+def _engine(cfg, params, clock=None, slots=2):
+    kw = {} if clock is None else {"clock": clock}
+    return ServingEngine(params, cfg, slots=slots, page_size=8,
+                         num_pages=16, **kw)
+
+
+def _assert_done_identical(router, ids, refs):
+    for i, eid in enumerate(ids):
+        r = router.result(eid)
+        assert r["state"] == "done", (i, r)
+        assert r["tokens"] == refs[i], (i, r["tokens"], refs[i])
+
+
+# -- journal semantics --------------------------------------------------------
+
+def test_journal_epoch_fence_and_duplicate_positions():
+    clk = FakeClock()
+    events = []
+    j = RequestJournal(clock=clk)
+    e = j.record([1, 2, 3], 8, None, "t0", events.append)
+    j.bind(e, "r1", 0)
+    assert j.on_tokens(e.entry_id, 0, 0, [5, 6]) == 2
+    # duplicate positions are dropped, never re-emitted
+    assert j.on_tokens(e.entry_id, 0, 0, [5]) == 0
+    assert j.dup_dropped == 1
+    assert j.on_tokens(e.entry_id, 0, 2, [7]) == 1
+    # a release bumps the epoch: the old assignment is fenced out
+    old_epoch = e.epoch
+    j.release(e)
+    assert e.epoch == old_epoch + 1
+    assert j.on_tokens(e.entry_id, old_epoch, 3, [9, 9]) == 0
+    assert j.dup_dropped == 3
+    assert not j.on_finish(e.entry_id, old_epoch, "eos")
+    # the live epoch continues at the next position
+    assert j.on_tokens(e.entry_id, e.epoch, 3, [8]) == 1
+    # a gap is a protocol bug, not a droppable delivery
+    with pytest.raises(RuntimeError, match="journal gap"):
+        j.on_tokens(e.entry_id, e.epoch, 10, [1])
+    assert j.on_finish(e.entry_id, e.epoch, "length")
+    tokens = [ev for ev in events if ev["event"] == "token"]
+    assert [ev["index"] for ev in tokens] == [0, 1, 2, 3]
+    assert [ev["token"] for ev in tokens] == [5, 6, 7, 8]
+    (done,) = [ev for ev in events if ev["event"] == "done"]
+    assert done["tokens"] == [5, 6, 7, 8]
+    assert j.snapshot()["states"] == {"done": 1}
+
+
+def test_journal_finish_is_idempotent_and_fail_counts_lost():
+    events = []
+    j = RequestJournal(clock=FakeClock())
+    e = j.record([1], 4, None, "t0", events.append)
+    j.finish_direct(e, "length")
+    j.finish_direct(e, "length")  # second is a no-op
+    assert sum(ev["event"] == "done" for ev in events) == 1
+    e2 = j.record([2], 4, None, "t0", events.append)
+    j.fail(e2, "budget exhausted")
+    assert j.lost == 1
+    assert e2.state == "failed"
+    (failed,) = [ev for ev in events if ev["event"] == "failed"]
+    assert "budget" in failed["error"]
+    # failing a finished entry changes nothing
+    j.fail(e, "late")
+    assert j.lost == 1 and e.state == "done"
+
+
+# -- mid-stream failover ------------------------------------------------------
+
+def test_midstream_failover_resumes_token_identical():
+    """Kill the replica mid-stream; the journal resume must continue
+    the greedy decode token-identically, with zero duplicates."""
+    cfg, params, prompts, refs = _workload()
+    clk = FakeClock()
+    router = FleetRouter(clock=clk, heartbeat_timeout=0.5)
+    for _ in range(2):
+        router.add_replica(_engine(cfg, params, clk))
+    streams = {i: [] for i in range(len(prompts))}
+    ids = [router.submit(p, 8, tenant=f"t{i % 2}", sink=streams[i].append)
+           for i, p in enumerate(prompts)]
+    # pump until request 0 has streamed SOME tokens but is unfinished
+    entry = router.journal.get(ids[0])
+    for _ in range(100):
+        router.tick()
+        clk.t += 0.01
+        if 0 < len(entry.tokens) < entry.max_new_tokens:
+            break
+    assert 0 < len(entry.tokens) < entry.max_new_tokens
+    victim = entry.replica_id
+    assert victim is not None
+    old_epoch = entry.epoch
+    router.kill(victim)  # silent: only the heartbeat can notice
+    # tick with small clock steps: the survivor keeps beating while the
+    # victim's heartbeat ages past the timeout
+    for _ in range(400):
+        if router.idle():
+            break
+        router.tick()
+        clk.t += 0.05
+    assert router.idle()
+    assert router.failovers == 1
+    assert router.resubmits >= 1
+    assert entry.resubmits == 1  # the failover consumed budget
+    _assert_done_identical(router, ids, refs)
+    snap = router.journal.snapshot()
+    assert snap["lost"] == 0
+    assert snap["dup_tokens_dropped"] == 0
+    # the client-facing streams saw every index exactly once, in order
+    for i, ref in enumerate(refs):
+        toks = [ev for ev in streams[i] if ev["event"] == "token"]
+        assert [ev["index"] for ev in toks] == list(range(len(ref)))
+        assert [ev["token"] for ev in toks] == ref
+    # a zombie delivery from the dead replica's epoch is fenced out
+    before = [list(s) for s in streams.values()]
+    assert router.journal.on_tokens(ids[0], old_epoch, 0, [1, 2, 3]) == 0
+    assert router.journal.snapshot()["dup_tokens_dropped"] == 3
+    assert [list(s) for s in streams.values()] == before
+
+
+def test_failover_budget_exhaustion_fails_request():
+    """With no surviving capacity and a zero resubmit budget, the
+    request fails loudly — counted lost, 'failed' event emitted."""
+    cfg, params, prompts, refs = _workload(n=1)
+    clk = FakeClock()
+    events = []
+    router = FleetRouter(clock=clk, heartbeat_timeout=0.5,
+                         max_resubmits=0)
+    rep = router.add_replica(_engine(cfg, params, clk))
+    eid = router.submit(prompts[0], 8, sink=events.append)
+    entry = router.journal.get(eid)
+    for _ in range(100):
+        router.tick()
+        clk.t += 0.01
+        if 0 < len(entry.tokens) < entry.max_new_tokens:
+            break
+    router.kill(rep.replica_id)
+    clk.t += 1.0
+    router.tick()
+    assert entry.state == "failed"
+    assert router.journal.lost == 1
+    assert any(ev["event"] == "failed" for ev in events)
+    assert router.result(eid)["state"] == "failed"
+    assert router.idle()  # a failed entry is not stuck work
+
+
+def test_requeue_finishes_directly_when_stream_already_satisfied():
+    """A failover resubmission whose streamed tokens already hit the
+    length budget completes router-side — no replica re-runs it."""
+    clk = FakeClock()
+    events = []
+    router = FleetRouter(clock=clk, heartbeat_timeout=0.5)
+    j = router.journal
+    e = j.record([1, 2], 2, None, "t0", events.append)
+    j.bind(e, "r9", 0)
+    assert j.on_tokens(e.entry_id, 0, 0, [4, 5]) == 2
+    with router._lock:
+        router._requeue_locked(e, reason="failover")
+    assert e.state == "done" and e.finish_reason == "length"
+    (done,) = [ev for ev in events if ev["event"] == "done"]
+    assert done["tokens"] == [4, 5]
+    assert router.tenant_depth("t0") == 0  # never requeued
+
+
+# -- rolling restart and SIGTERM drain ---------------------------------------
+
+def test_rolling_restart_drops_nothing():
+    """Drain every replica in turn (replacement joins first) while
+    requests keep arriving: zero drops, zero failovers."""
+    cfg, params, prompts, refs = _workload()
+    clk = FakeClock()
+    router = FleetRouter(clock=clk, heartbeat_timeout=30.0)
+    old = [router.add_replica(_engine(cfg, params, clk)) for _ in range(2)]
+    ids = [router.submit(p, 8) for p in prompts[:2]]
+    for _ in range(3):
+        router.tick()
+        clk.t += 0.01
+    for rep in old:
+        router.add_replica(_engine(cfg, params, clk))
+        router.drain(rep.replica_id)
+        ids.append(router.submit(prompts[len(ids)], 8))  # mid-roll arrival
+        for _ in range(300):
+            if rep.state == "left":
+                break
+            router.tick()
+            clk.t += 0.01
+        assert rep.state == "left", rep.state
+    assert router.run_until_idle()
+    _assert_done_identical(router, ids, refs)
+    assert router.drains == 2
+    assert router.failovers == 0  # planned churn is not failure
+
+
+def test_sigterm_drains_fleet_and_stops_admitting():
+    cfg, params, prompts, refs = _workload(n=2)
+    clk = FakeClock()
+    router = FleetRouter(clock=clk, heartbeat_timeout=30.0)
+    router.add_replica(_engine(cfg, params, clk))
+    ids = [router.submit(p, 8) for p in prompts]
+    router.tick()
+    _preemption.install()
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5
+        while not _preemption.requested() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert _preemption.requested()
+        router.tick()  # notices the request and starts the fleet drain
+        assert router.draining
+        with pytest.raises(RuntimeError, match="draining"):
+            router.submit(prompts[0], 8)
+        assert router.run_until_idle()
+    finally:
+        _preemption.uninstall()
+        _preemption.reset()
+    # in-flight work finished exactly; nothing was dropped at the door
+    _assert_done_identical(router, ids, refs)
+    assert router.journal.snapshot()["lost"] == 0
+
+
+def test_replica_rpc_fault_requeues_without_budget():
+    """A dispatch-time RPC fault requeues the request for free — only
+    failover resubmissions consume the budget."""
+    cfg, params, prompts, refs = _workload(n=1)
+    clk = FakeClock()
+    _fault.install(_fault.FaultInjector("replica.rpc:drop@1", seed=0))
+    router = FleetRouter(clock=clk, heartbeat_timeout=30.0,
+                         max_resubmits=0)
+    router.add_replica(_engine(cfg, params, clk))
+    eid = router.submit(prompts[0], 8)
+    entry = router.journal.get(eid)
+    assert router.run_until_idle()
+    assert _fault.injector().fired("replica.rpc") == 1
+    assert router.resubmits == 1
+    assert entry.resubmits == 0  # rpc retry did not touch the budget
+    _assert_done_identical(router, [eid], refs)
+
+
+# -- gateway ------------------------------------------------------------------
+
+def test_gateway_stream_healthz_and_rejections():
+    cfg, params, prompts, refs = _workload(n=2, seed=11)
+    router = FleetRouter(heartbeat_timeout=60.0)
+    router.add_replica(_engine(cfg, params))
+    router.start(interval=0.001)
+    gw = ServingGateway(router, port=0, queue_limit=16, max_occupancy=0.99)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", gw.port, timeout=60)
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        health = json.loads(resp.read())
+        assert resp.status == 200 and health["healthy_replicas"] == 1
+        conn.close()
+
+        # a streaming generate: NDJSON tokens, one done, entry id header
+        conn = http.client.HTTPConnection("127.0.0.1", gw.port, timeout=300)
+        conn.request("POST", "/v1/generate",
+                     json.dumps({"prompt": [int(t) for t in prompts[0]],
+                                 "max_new_tokens": 8}))
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("X-Entry-Id") is not None
+        events = [json.loads(ln) for ln in resp.read().split(b"\n")
+                  if ln.strip()]
+        conn.close()
+        toks = [e for e in events if e["event"] == "token"]
+        assert [e["token"] for e in toks] == refs[0]
+        assert [e["index"] for e in toks] == list(range(len(refs[0])))
+        assert sum(e["event"] == "done" for e in events) == 1
+
+        # malformed body -> 400, unknown path -> 404
+        conn = http.client.HTTPConnection("127.0.0.1", gw.port, timeout=60)
+        conn.request("POST", "/v1/generate", b"{not json")
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 400
+        conn.close()
+        conn = http.client.HTTPConnection("127.0.0.1", gw.port, timeout=60)
+        conn.request("GET", "/nope")
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 404
+        conn.close()
+
+        # a zero-budget gateway sheds with 429 + Retry-After
+        gw2 = ServingGateway(router, port=0, queue_limit=0,
+                             max_occupancy=0.99)
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", gw2.port,
+                                              timeout=60)
+            conn.request("POST", "/v1/generate",
+                         json.dumps({"prompt": [1, 2, 3],
+                                     "max_new_tokens": 4}))
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 429
+            assert resp.getheader("Retry-After") is not None
+            conn.close()
+        finally:
+            gw2.close()
+    finally:
+        gw.close()
+        router.stop()
+
+
+def test_gateway_accept_fault_injects_503():
+    cfg, params, prompts, _ = _workload(n=1)
+    _fault.install(_fault.FaultInjector("gateway.accept:fail@1", seed=0))
+    router = FleetRouter(heartbeat_timeout=60.0)
+    router.add_replica(_engine(cfg, params))
+    router.start(interval=0.001)
+    gw = ServingGateway(router, port=0, queue_limit=16, max_occupancy=0.99)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", gw.port, timeout=60)
+        conn.request("POST", "/v1/generate",
+                     json.dumps({"prompt": [1, 2], "max_new_tokens": 2}))
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 503
+        conn.close()
+        assert _fault.injector().fired("gateway.accept") == 1
+    finally:
+        gw.close()
+        router.stop()
+
+
+# -- operator view ------------------------------------------------------------
+
+def _serving_top():
+    tools = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "..", "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    import serving_top
+    return serving_top
+
+
+def test_debug_snapshot_and_render_fleet():
+    cfg, params, prompts, refs = _workload(n=2)
+    clk = FakeClock()
+    router = FleetRouter(clock=clk, heartbeat_timeout=30.0)
+    rep = router.add_replica(_engine(cfg, params, clk))
+    ids = [router.submit(p, 8, tenant="acme") for p in prompts]
+    for _ in range(3):
+        router.tick()
+        clk.t += 0.01
+    snap = router.debug_snapshot()
+    assert snap["schema"] == "mxtpu-serving-fleet-debug-v1"
+    rows = {r["replica"]: r for r in snap["replicas"]}
+    assert rows[rep.replica_id]["state"] == "healthy"
+    assert snap["journal"]["entries"] == 2
+
+    top = _serving_top()
+    screen = top.render_fleet(snap)
+    assert "serving fleet" in screen
+    assert rep.replica_id in screen
+    assert "journal 2 entries" in screen
+    # render_any dispatches on the embedded schema
+    assert top.render_any(snap) == screen
+    assert router.run_until_idle()
+    _assert_done_identical(router, ids, refs)

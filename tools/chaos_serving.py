@@ -1,0 +1,345 @@
+#!/usr/bin/env python
+"""Chaos CI for the fault-tolerant serving fleet (serving/fleet.py).
+
+Kills replicas mid-stream under load and PROVES the fleet's promises
+instead of asserting vibes:
+
+    python tools/chaos_serving.py                       # all scenarios
+    python tools/chaos_serving.py --scenario failover
+    python tools/chaos_serving.py --inject lost-request # seeded negative
+
+Scenarios (each gates on ALL of its invariants):
+
+- failover: manual-pump fleet on a fake clock; the seeded
+  `replica.kill` fault site kills one replica mid-stream (pinned
+  (seed, probability) — per-instance PRNG streams make exactly one
+  replica die early); a replacement joins. Gates: every request
+  finishes, every token stream is IDENTICAL to the undisturbed
+  single-model `tfm.generate` reference (greedy determinism through
+  journal resume), failovers counted, ZERO lost requests, ZERO
+  duplicate tokens, and the SLO monitor never reaches `breach` at any
+  tick.
+- rolling: full rolling restart — every replica drained in turn with a
+  replacement joining first, requests still arriving mid-roll. Gates:
+  zero dropped (all done, token-identical), drains counted, zero
+  failovers (planned churn must not look like failure).
+- wire: threaded fleet + real HTTP gateway; one replica silently
+  killed mid-stream (detection via heartbeat timeout only). Gates:
+  every HTTP stream completes 200 with strictly-sequential token
+  indexes and token-identical payloads; a queue_limit=0 gateway
+  answers 429 with Retry-After (backpressure proof).
+
+Seeded negative (--inject lost-request): the router silently skips ONE
+failover resubmission — the dropped request stays assigned to a corpse
+forever. The completeness gate MUST fail; exit 0 only when it does.
+This is CI proving the gate can fail, not just that it passed today.
+
+Exit status: 0 scenarios green (or injection caught), 1 gate failed,
+2 injection missed (the gate passed when it should not have).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+# pinned chaos schedule for the failover scenario: with seed 138 at
+# p=0.005, replica r1's replica.kill stream first fires at pump 6
+# (mid-stream), r2's at 522, and the replacement r3's at 313 — one
+# early death, survivors long enough to finish the run
+KILL_SPEC = "replica.kill:fail@0.005"
+KILL_SEED = 138
+
+
+def _fail(msg):
+    print(f"chaos_serving: FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _workload(n=8, max_new=12, seed=7):
+    """Prompts plus their undisturbed greedy references — the oracle
+    every scenario compares against."""
+    import numpy as np
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=64)
+    params = tfm.init_params(cfg, seed=3)
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(1, 64, size=rng.randint(3, 9)).astype(np.int32)
+               for _ in range(n)]
+    refs = [list(np.asarray(
+        tfm.generate(params, jnp.asarray(p)[None], max_new, cfg))[0])
+        for p in prompts]
+    return cfg, params, prompts, refs
+
+
+def _slo_monitor():
+    """Explicit fake-clock-scaled objectives: generous enough that a
+    HANDLED failover never breaches, tight enough that a stuck request
+    would (the run gates on state never reaching 'breach')."""
+    from incubator_mxnet_tpu.telemetry.slo import Objective, SLOMonitor
+    return SLOMonitor([Objective("ttft", 10.0),
+                       Objective("request_latency", 30.0)],
+                      min_samples=4, dump=False)
+
+
+def _mk_engine(cfg, params, clock=None, slots=3):
+    from incubator_mxnet_tpu.serving import ServingEngine
+    kw = {} if clock is None else {"clock": clock}
+    return ServingEngine(params, cfg, slots=slots, page_size=8,
+                         num_pages=24, **kw)
+
+
+def _check_results(router, ids, refs, label):
+    for i, eid in enumerate(ids):
+        r = router.result(eid)
+        if r["state"] != "done":
+            return _fail(f"{label}: request {i} ended {r['state']!r} "
+                         f"({r.get('error')})")
+        if r["tokens"] != refs[i]:
+            return _fail(f"{label}: request {i} tokens diverged from the "
+                         f"undisturbed reference\n  got  {r['tokens']}\n"
+                         f"  want {refs[i]}")
+    return 0
+
+
+def scenario_failover(lose_one=False):
+    """Kill one replica mid-stream; failover must be invisible."""
+    from incubator_mxnet_tpu.resilience import fault
+    from incubator_mxnet_tpu.serving import FleetRouter
+
+    cfg, params, prompts, refs = _workload()
+    clk = _FakeClock()
+    fault.install(fault.FaultInjector(KILL_SPEC, seed=KILL_SEED))
+    slo = _slo_monitor()
+    router = FleetRouter(clock=clk, heartbeat_timeout=0.4, slo=slo)
+    for _ in range(2):
+        router.add_replica(_mk_engine(cfg, params, clk))
+    router._chaos_lose_one = bool(lose_one)
+    ids = [router.submit(p, 12, tenant=f"t{i % 3}")
+           for i, p in enumerate(prompts)]
+    replaced = False
+    for _ in range(400):
+        if router.idle():
+            break
+        router.tick()
+        clk.t += 0.05
+        if any(slo.state(n) == "breach" for n in ("ttft",
+                                                  "request_latency")):
+            return _fail("failover: SLO monitor reached 'breach'")
+        if not replaced and router.healthy_count() < 2:
+            router.add_replica(_mk_engine(cfg, params, clk))
+            replaced = True
+    snap = router.journal.snapshot()
+    if not router.idle():
+        return _fail(f"failover: fleet never went idle — lost "
+                     f"request(s); journal {snap}")
+    rc = _check_results(router, ids, refs, "failover")
+    if rc:
+        return rc
+    if router.failovers < 1 or fault.injector().fired("replica.kill") < 1:
+        return _fail("failover: the kill never fired — scenario is vacuous")
+    if snap["lost"]:
+        return _fail(f"failover: {snap['lost']} request(s) lost")
+    if snap["dup_tokens_dropped"]:
+        return _fail(f"failover: journal deduped "
+                     f"{snap['dup_tokens_dropped']} tokens in a "
+                     f"zombie-free run")
+    print(f"chaos_serving: failover ok (8/8 token-identical, "
+          f"failovers={router.failovers}, resubmits={router.resubmits}, "
+          f"lost=0, slo ok)")
+    return 0
+
+
+def scenario_rolling():
+    """Full rolling restart under load drops zero requests."""
+    from incubator_mxnet_tpu.resilience import fault
+    from incubator_mxnet_tpu.serving import FleetRouter
+
+    cfg, params, prompts, refs = _workload()
+    clk = _FakeClock()
+    fault.install(fault.FaultInjector("", 0))
+    router = FleetRouter(clock=clk, heartbeat_timeout=30.0)
+    old = [router.add_replica(_mk_engine(cfg, params, clk, slots=2))
+           for _ in range(2)]
+    ids = [router.submit(p, 12) for p in prompts[:4]]
+    for _ in range(3):
+        router.tick()
+        clk.t += 0.01
+    for rep in old:  # roll the whole fleet, one replica at a time
+        router.add_replica(_mk_engine(cfg, params, clk, slots=2))
+        router.drain(rep.replica_id)
+        ids.append(router.submit(prompts[len(ids)], 12))  # mid-roll arrival
+        for _ in range(400):
+            if rep.state == "left":
+                break
+            router.tick()
+            clk.t += 0.01
+        if rep.state != "left":
+            return _fail(f"rolling: {rep.replica_id} never finished "
+                         f"draining (state {rep.state!r})")
+    for _ in range(400):
+        if router.idle():
+            break
+        router.tick()
+        clk.t += 0.01
+    if not router.idle():
+        return _fail(f"rolling: fleet never went idle; journal "
+                     f"{router.journal.snapshot()}")
+    rc = _check_results(router, ids, refs, "rolling")
+    if rc:
+        return rc
+    if router.drains != 2:
+        return _fail(f"rolling: expected 2 drains, counted "
+                     f"{router.drains}")
+    if router.failovers:
+        return _fail(f"rolling: planned restart produced "
+                     f"{router.failovers} failover(s)")
+    print(f"chaos_serving: rolling ok (6/6 token-identical through a "
+          f"full fleet roll, drains={router.drains}, failovers=0)")
+    return 0
+
+
+def scenario_wire():
+    """Threaded fleet behind the real HTTP gateway; silent kill."""
+    import http.client
+    import json
+    import threading
+    import time
+
+    from incubator_mxnet_tpu.resilience import fault
+    from incubator_mxnet_tpu.serving import FleetRouter, ServingGateway
+
+    cfg, params, prompts, refs = _workload(n=6, max_new=10, seed=11)
+    fault.install(fault.FaultInjector("", 0))
+    router = FleetRouter(heartbeat_timeout=3.0)
+    reps = [router.add_replica(_mk_engine(cfg, params)) for _ in range(2)]
+    router.start(interval=0.001)
+    gw = ServingGateway(router, port=0, queue_limit=64, max_occupancy=0.99)
+    out = {}
+
+    def client(i):
+        conn = http.client.HTTPConnection("127.0.0.1", gw.port,
+                                          timeout=300)
+        conn.request("POST", "/v1/generate",
+                     json.dumps({"prompt": [int(t) for t in prompts[i]],
+                                 "max_new_tokens": 10,
+                                 "tenant": f"t{i % 2}"}))
+        resp = conn.getresponse()
+        events = [json.loads(ln) for ln in resp.read().split(b"\n")
+                  if ln.strip()]
+        out[i] = (resp.status, events)
+        conn.close()
+
+    try:
+        threads = [threading.Thread(target=client, args=(i,), daemon=True,
+                                    name=f"chaos-client-{i}")
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        time.sleep(0.4)
+        router.kill(reps[0].replica_id)  # silent: heartbeat-only detection
+        for t in threads:
+            t.join(timeout=300)
+        for i in range(len(prompts)):
+            if i not in out:
+                return _fail(f"wire: client {i} never completed")
+            status, events = out[i]
+            if status != 200:
+                return _fail(f"wire: client {i} got HTTP {status}: "
+                             f"{events[:2]}")
+            toks = [e for e in events if e.get("event") == "token"]
+            done = [e for e in events if e.get("event") == "done"]
+            if len(done) != 1:
+                return _fail(f"wire: client {i} stream ended without "
+                             f"exactly one done event: {events[-2:]}")
+            if [e["index"] for e in toks] != list(range(len(refs[i]))):
+                return _fail(f"wire: client {i} token indexes not "
+                             f"strictly sequential (duplicate or gap): "
+                             f"{[e['index'] for e in toks]}")
+            if [e["token"] for e in toks] != refs[i]:
+                return _fail(f"wire: client {i} tokens diverged from "
+                             f"the undisturbed reference")
+        if router.failovers != 1:
+            return _fail(f"wire: expected exactly 1 failover, counted "
+                         f"{router.failovers}")
+        # backpressure proof: a zero-budget gateway sheds with 429
+        gw2 = ServingGateway(router, port=0, queue_limit=0,
+                             max_occupancy=0.99)
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", gw2.port,
+                                              timeout=30)
+            conn.request("POST", "/v1/generate",
+                         json.dumps({"prompt": [1, 2, 3],
+                                     "max_new_tokens": 4}))
+            resp = conn.getresponse()
+            retry_after = resp.getheader("Retry-After")
+            resp.read()
+            conn.close()
+            if resp.status != 429 or not retry_after:
+                return _fail(f"wire: overloaded gateway answered "
+                             f"{resp.status} (Retry-After: {retry_after})")
+        finally:
+            gw2.close()
+    finally:
+        gw.close()
+        router.stop()
+    print(f"chaos_serving: wire ok (6/6 HTTP streams token-identical "
+          f"through a mid-stream kill, failovers=1, 429+Retry-After)")
+    return 0
+
+
+def inject_lost_request():
+    """Seeded negative: the router drops ONE in-flight request during
+    failover. The completeness gate must FAIL — exit 0 only then."""
+    rc = scenario_failover(lose_one=True)
+    if rc != 0:
+        print("chaos_serving: inject lost-request caught (completeness "
+              "gate failed as it must)")
+        return 0
+    print("chaos_serving: MISSED: a silently dropped request passed the "
+          "zero-lost gate", file=sys.stderr)
+    return 2
+
+
+SCENARIOS = {"failover": scenario_failover, "rolling": scenario_rolling,
+             "wire": scenario_wire}
+INJECTIONS = {"lost-request": inject_lost_request}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenario", choices=sorted(SCENARIOS) + ["all"],
+                    default="all", help="scenario(s) to run")
+    ap.add_argument("--inject", choices=sorted(INJECTIONS),
+                    help="run one seeded negative instead; exit 0 only "
+                         "when the gate catches it")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, str(REPO_ROOT))
+
+    if args.inject:
+        return INJECTIONS[args.inject]()
+    names = sorted(SCENARIOS) if args.scenario == "all" else [args.scenario]
+    rc = 0
+    for name in names:
+        rc = max(rc, SCENARIOS[name]())
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

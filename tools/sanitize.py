@@ -15,6 +15,10 @@ sanitizer-clean:
 - serving: in-process ServingEngine smoke with prefix cache, chunked
   prefill and n-gram speculation all ON; `run()` proves page quiescence
   at drain via PageSanitizer.assert_quiescent().
+- gateway: threaded FleetRouter + HTTP ServingGateway smoke (streaming
+  requests, one drain handshake) — the serving fleet's lock order
+  (fleet -> replica -> engine -> journal) under real concurrency, and
+  leave()'s page-quiescence proof.
 - chaos: `tools/chaos_train.py --elastic` in a subprocess with the
   sanitizer env exported; fails on a nonzero exit or any `[sanitizers]`
   line in its output (the atexit summary every sanitized process prints).
@@ -91,6 +95,67 @@ def scenario_serving():
         return _fail(f"serving scenario produced {len(rep)} finding(s)")
     print(f"sanitize: serving ok ({eng.steps} engine steps, "
           f"0 findings)")
+    return 0
+
+
+def scenario_gateway():
+    """Fleet router + HTTP gateway under sanitizers: threaded dispatch,
+    streaming, and the drain handshake — lock order across
+    fleet/replica/engine/journal and page quiescence at leave()."""
+    import http.client
+    import json
+    import time
+
+    import numpy as np
+    from incubator_mxnet_tpu.analysis import sanitizers
+    from incubator_mxnet_tpu.models import transformer as tfm
+    from incubator_mxnet_tpu.serving import (
+        FleetRouter, ServingEngine, ServingGateway)
+
+    cfg = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=64)
+    params = tfm.init_params(cfg, seed=3)
+    rng = np.random.RandomState(23)
+    router = FleetRouter(heartbeat_timeout=60.0)
+    reps = [router.add_replica(
+        ServingEngine(params, cfg, slots=2, page_size=8, num_pages=24))
+        for _ in range(2)]
+    router.start(interval=0.001)
+    gw = ServingGateway(router, port=0, queue_limit=16, max_occupancy=0.99)
+    try:
+        for i in range(4):
+            prompt = rng.randint(1, 64, size=(4 + i,)).tolist()
+            conn = http.client.HTTPConnection("127.0.0.1", gw.port,
+                                              timeout=300)
+            conn.request("POST", "/v1/generate",
+                         json.dumps({"prompt": prompt,
+                                     "max_new_tokens": 6,
+                                     "tenant": f"t{i % 2}",
+                                     "stream": False}))
+            resp = conn.getresponse()
+            body = resp.read()
+            conn.close()
+            if resp.status != 200:
+                return _fail(f"gateway request {i} -> {resp.status}: "
+                             f"{body[:200]!r}")
+        # the drain handshake ends in leave()'s page-quiescence proof
+        router.drain(reps[0].replica_id)
+        deadline = time.monotonic() + 60
+        while reps[0].state != "left" and time.monotonic() < deadline:
+            time.sleep(0.01)
+        if reps[0].state != "left":
+            return _fail(f"drained replica stuck in {reps[0].state!r}")
+    finally:
+        gw.close()
+        router.stop()
+
+    rep = sanitizers.report()
+    if rep:
+        for d in rep:
+            print(f"sanitize: {d.code}: {d.message.splitlines()[0]}",
+                  file=sys.stderr)
+        return _fail(f"gateway scenario produced {len(rep)} finding(s)")
+    print("sanitize: gateway ok (4 requests, 1 drain, 0 findings)")
     return 0
 
 
@@ -204,8 +269,8 @@ def inject_lint():
     return 2
 
 
-SCENARIOS = {"serving": scenario_serving, "chaos": scenario_chaos,
-             "lint": scenario_lint}
+SCENARIOS = {"serving": scenario_serving, "gateway": scenario_gateway,
+             "chaos": scenario_chaos, "lint": scenario_lint}
 INJECTIONS = {"abba": inject_abba, "leaked-page": inject_leaked_page,
               "lint": inject_lint}
 
@@ -222,6 +287,12 @@ def main(argv=None):
     # The enabled set is resolved at import; export it before the
     # framework loads so every lock created anywhere is instrumented.
     os.environ["MXTPU_SANITIZERS"] = SANITIZERS
+    # The serving scenarios run jit-compiled steps UNDER the engine
+    # lock; the first step's XLA compile (~1-2 s on CPU) is a known,
+    # benign long hold. Raise the MXS003 threshold above compile time —
+    # a genuinely stuck lock (IO wait, deadlock-adjacent hold) still
+    # blows well past 5 s.
+    os.environ.setdefault("MXTPU_SANITIZER_HOLD_MS", "5000")
     sys.path.insert(0, str(REPO_ROOT))
 
     if args.inject:

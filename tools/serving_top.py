@@ -12,6 +12,13 @@ queue, page-pool health, goodput split, compile counters, and SLO state:
     python tools/serving_top.py http://localhost:9090 --once
     python tools/serving_top.py --file snapshot.json   # offline render
 
+When the process also runs a serving FLEET (serving/fleet.py), its
+/debug/fleet snapshot is rendered below the engine view: one row per
+replica (state, slots, queue, in-flight, pool occupancy, heartbeat
+age) plus the failover/drain counters — the operator's view of a
+rolling restart. A target without /debug/fleet just renders the engine
+view; `--file` dispatches on the snapshot's embedded schema.
+
 Stdlib-only (urllib), same no-new-deps rule as the exporters it reads.
 """
 import argparse
@@ -24,13 +31,13 @@ import urllib.request
 CLEAR = "\x1b[2J\x1b[H"
 
 
-def snapshot_url(target):
-    """Normalize a host[:port] or URL into the /debug/engine endpoint."""
+def snapshot_url(target, endpoint="/debug/engine"):
+    """Normalize a host[:port] or URL into a /debug/* endpoint."""
     if "://" not in target:
         target = "http://" + target
     target = target.rstrip("/")
-    if not target.endswith("/debug/engine"):
-        target += "/debug/engine"
+    if not target.endswith(endpoint):
+        target += endpoint
     return target
 
 
@@ -134,6 +141,54 @@ def render(snap):
     return "\n".join(lines)
 
 
+def render_fleet(snap):
+    """The fleet section as one string — pure function of a
+    /debug/fleet snapshot (mxtpu-serving-fleet-debug-v1)."""
+    lines = []
+    counters = snap.get("counters", {})
+    lines.append(
+        f"serving fleet  {'DRAINING  ' if snap.get('draining') else ''}"
+        f"failovers {counters.get('failovers', 0)}  "
+        f"resubmits {counters.get('resubmits', 0)}  "
+        f"drains {counters.get('drains', 0)}  "
+        f"hb_timeout {snap.get('heartbeat_timeout_s', 0.0):g}s")
+    journal = snap.get("journal", {})
+    states = journal.get("states", {})
+    states_str = " ".join(
+        f"{k}:{states[k]}" for k in sorted(states)) or "-"
+    lines.append(
+        f"journal {journal.get('entries', 0)} entries ({states_str})  "
+        f"dup_dropped {journal.get('dup_tokens_dropped', 0)}  "
+        f"lost {journal.get('lost', 0)}")
+    tenants = snap.get("tenants", {})
+    if tenants:
+        lines.append("queued  " + "  ".join(
+            f"{t}:{n}" for t, n in sorted(tenants.items())))
+    lines.append("")
+    lines.append(f"{'replica':<10}{'state':<10}{'slots':>8}{'queue':>7}"
+                 f"{'inflight':>10}{'occupancy':>24}{'hb_age':>9}"
+                 f"{'pumps':>8}")
+    for row in snap.get("replicas", []):
+        age = row.get("heartbeat_age_s")
+        lines.append(
+            f"{row.get('replica', '?'):<10}{row.get('state', '?'):<10}"
+            f"{row.get('slots_in_use', 0)}/{row.get('slots', 0):<5}"
+            f"{row.get('queue_depth', 0):>6}"
+            f"{row.get('inflight', 0):>10}"
+            f"  [{_bar(row.get('occupancy', 0.0))}]"
+            f"{(f'{age:.2f}' if age is not None else '-'):>9}"
+            f"{row.get('pumps', 0):>8}")
+    return "\n".join(lines)
+
+
+def render_any(snap):
+    """Schema dispatch for --file mode: fleet snapshots render the
+    fleet view, anything else the engine view."""
+    if snap.get("schema") == "mxtpu-serving-fleet-debug-v1":
+        return render_fleet(snap)
+    return render(snap)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="polling text UI over /debug/engine")
@@ -149,21 +204,29 @@ def main(argv=None):
 
     if args.file:
         with open(args.file, encoding="utf-8") as f:
-            print(render(json.load(f)))
+            print(render_any(json.load(f)))
         return 0
     if not args.target:
         ap.error("need a server target or --file")
     url = snapshot_url(args.target)
+    fleet_endpoint = snapshot_url(args.target, "/debug/fleet")
     while True:
         try:
             snap = fetch(url)
         except (urllib.error.URLError, OSError) as e:
             print(f"serving_top: {url}: {e}", file=sys.stderr)
             return 1
+        try:
+            fleet = fetch(fleet_endpoint)
+        except (urllib.error.URLError, OSError):
+            fleet = None  # engine-only process: no fleet section
+        screen = render(snap)
+        if fleet:
+            screen += "\n\n" + render_fleet(fleet)
         if args.once:
-            print(render(snap))
+            print(screen)
             return 0
-        sys.stdout.write(CLEAR + render(snap) + "\n")
+        sys.stdout.write(CLEAR + screen + "\n")
         sys.stdout.flush()
         time.sleep(args.interval)
 
