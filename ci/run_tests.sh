@@ -754,7 +754,36 @@ EOF
     # negative self-test: a silently dropped in-flight request MUST
     # fail the zero-lost gate (exit 0 only when the gate catches it)
     JAX_PLATFORMS=cpu python tools/chaos_serving.py --inject lost-request
-    echo "serving tier: trace completed, zero steady-state retraces/fallbacks, seeded regression rejected, lever legs gated (prefix/chunked/spec token-identical), observatory legs green, fleet chaos green (zero lost, token-identical failover, rolling restart zero drops, seeded lost-request caught)"
+    # -- fleet observatory leg -------------------------------------------
+    # traced failover chaos: the mid-stream kill must yield ONE trace
+    # per request with spans on both replicas, pass the distributed
+    # causal-chain checks, and write the failover post-mortem dump
+    mkdir -p "$sv_dir/fleet-traces"
+    JAX_PLATFORMS=cpu MXTPU_TRACE_DIR="$sv_dir/fleet-traces" \
+        python tools/chaos_serving.py --scenario failover
+    python tools/trace_merge.py "$sv_dir/fleet-traces" --fleet --check \
+        --fleet-json "$sv_dir/fleet.json"
+    SV_DIR="$sv_dir" python - <<'EOF'
+import glob, json, os
+sv = os.environ["SV_DIR"]
+report = json.load(open(os.path.join(sv, "fleet.json")))
+assert report["failovers"] >= 1, report
+multi = [row for row in report["entries"] if len(row["replicas"]) >= 2]
+assert multi, f"no entry ran on more than one replica: {report['entries']}"
+dumps = glob.glob(os.path.join(sv, "fleet-traces",
+                               "flightrec-*fleet-failover*"))
+assert len(dumps) >= 1, "failover wrote no flight-recorder post-mortem"
+payload = json.load(open(dumps[0]))
+assert payload["fleet"]["journal_entries"], "dump carries no journal rows"
+assert payload["fleet"]["replica_timelines"], "dump carries no timelines"
+print(f"fleet observatory: {report['count']} traced entries, "
+      f"{report['failovers']} failover span(s), causal chain checked, "
+      f"{len(dumps)} post-mortem dump(s)")
+EOF
+    # negative self-test: an orphaned replica span (broken causal chain)
+    # MUST fail `trace_merge --fleet --check`
+    JAX_PLATFORMS=cpu python tools/chaos_serving.py --inject broken-chain
+    echo "serving tier: trace completed, zero steady-state retraces/fallbacks, seeded regression rejected, lever legs gated (prefix/chunked/spec token-identical), observatory legs green, fleet chaos green (zero lost, token-identical failover, rolling restart zero drops, seeded lost-request caught), fleet observatory green (one trace across failover, causal chain checked, post-mortem dump present, broken-chain negative caught)"
 }
 
 run_nightly() {

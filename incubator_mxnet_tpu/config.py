@@ -579,6 +579,12 @@ register_knob("MXTPU_GATEWAY_MAX_OCCUPANCY", 0.95, float,
 register_knob("MXTPU_GATEWAY_RETRY_AFTER", 1.0, float,
               "Retry-After seconds the gateway attaches to 429/503 "
               "responses.")
+register_knob("MXTPU_GATEWAY_ACCESS_LOG", "", str,
+              "Structured NDJSON access log for the serving gateway: "
+              "a file path to append one JSON line per request "
+              "(tenant, status, token counts, queue-wait/TTFT/latency, "
+              "trace id, serving replica, failover count), '-' for "
+              "stderr, empty (default) for off.")
 
 # contrib / compatibility shims
 register_knob("MXTPU_USE_TENSORRT", False, bool,

@@ -190,6 +190,10 @@ class ServingEngine:
                                               self.page_size)
         self.prefill_buckets = _default_buckets(self.max_len)
         self._clock = clock
+        # explicit timeline lane for this engine's trace records (fleet
+        # replicas set it to their replica id so a multi-replica process
+        # still renders one lane per replica); None = the process lane
+        self.trace_lane = None
         # the engine lock: submit/cancel arrive from gateway and fleet
         # threads while the pump thread sits inside step(). Reentrant
         # because step() finishing a request may call back through the
@@ -323,9 +327,14 @@ class ServingEngine:
 
     # -- public API --------------------------------------------------------
 
-    def submit(self, prompt, max_new_tokens, eos_id=None):
+    def submit(self, prompt, max_new_tokens, eos_id=None, trace_ctx=None):
         """Queue one request; returns its request id. Validation is
-        eager: an unservable request fails here, not mid-decode."""
+        eager: an unservable request fails here, not mid-decode.
+
+        `trace_ctx` is an optional inbound (trace_id, parent_span_id)
+        pair — the fleet router passes its `fleet.dispatch` span so a
+        failed-over request's engine spans on BOTH replicas share ONE
+        trace, parented under the dispatch that placed them."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -347,14 +356,17 @@ class ServingEngine:
             req = Request(rid, prompt, int(max_new_tokens), eos_id,
                           submitted_at=self._clock())
             if _dtrace.trace_active():
-                # trace context is born HERE: tid groups the whole
-                # lifecycle, sid is the root "serving.request" span every
-                # stage parents under, ns_submit anchors engine-clock
-                # deltas to wall time
-                req.trace = {"tid": _dtrace.new_id(),
+                # trace context is born HERE (or adopted from trace_ctx):
+                # tid groups the whole lifecycle, sid is the root
+                # "serving.request" span every stage parents under,
+                # ns_submit anchors engine-clock deltas to wall time
+                tid, psid = trace_ctx if trace_ctx else (None, None)
+                req.trace = {"tid": tid or _dtrace.new_id(),
                              "sid": _dtrace.new_id(),
                              "ns_submit": time.time_ns(),
                              "clk_submit": req.submitted_at}
+                if psid is not None:
+                    req.trace["pid"] = psid
             self._queue.append(req)
             telemetry.set_gauge(QUEUE_DEPTH, len(self._queue))
             telemetry.set_gauge(
@@ -905,13 +917,16 @@ class ServingEngine:
                 telemetry.inc(WASTED_TOKENS, amount=float(pad),
                               reason="spec_pad")
         if _dtrace.trace_active():
-            _dtrace.record_span({
+            rec = {
                 "kind": REQ_STEP_KIND, "ts": time.time_ns(),
                 "step": self.steps,
                 "slots": [[self._slot_req[s].request_id,
                            len(self._slot_out[s]) + 1]
                           for s in live_slots
-                          if self._slot_req[s] is not None]})
+                          if self._slot_req[s] is not None]}
+            if self.trace_lane is not None:
+                rec["lane"] = self.trace_lane
+            _dtrace.record_span(rec)
         return self.slots_in_use
 
     def _decode_once(self):
@@ -942,12 +957,15 @@ class ServingEngine:
             # [request_id, tokens emitted so far] per live slot. Not a
             # span — trace_merge partitions kind=req_step out of the span
             # pipeline and uses it for per-request step counting.
-            _dtrace.record_span({
+            rec = {
                 "kind": REQ_STEP_KIND, "ts": time.time_ns(),
                 "step": self.steps,
                 "slots": [[self._slot_req[s].request_id,
                            len(self._slot_out[s]) + 1]
-                          for s in live_slots]})
+                          for s in live_slots]}
+            if self.trace_lane is not None:
+                rec["lane"] = self.trace_lane
+            _dtrace.record_span(rec)
         for s in live_slots:
             req = self._slot_req[s]
             self._slot_out[s].append(int(tok[s]))
@@ -1018,7 +1036,7 @@ class ServingEngine:
                            "steps": len(out) - 1})
             self._emit_request_record(
                 REQ_SPAN, tr, ts=tr["ns_submit"], dur_s=latency,
-                sid=tr["sid"],
+                sid=tr["sid"], pid=tr.get("pid"),
                 extra={"request": req.request_id,
                        "prompt_len": int(req.prompt.size),
                        "tokens": len(out), "finish": reason,
@@ -1045,8 +1063,7 @@ class ServingEngine:
         the wall time captured at submit."""
         return tr["ns_submit"] + int((clk - tr["clk_submit"]) * 1e9)
 
-    @staticmethod
-    def _emit_request_record(name, tr, *, ts, dur_s, extra,
+    def _emit_request_record(self, name, tr, *, ts, dur_s, extra,
                              sid=None, pid=None):
         record = {"name": name, "tid": tr["tid"],
                   "sid": sid if sid is not None else _dtrace.new_id(),
@@ -1054,6 +1071,8 @@ class ServingEngine:
                   "extra": extra}
         if pid is not None:
             record["pid"] = pid
+        if self.trace_lane is not None:
+            record["lane"] = self.trace_lane
         _dtrace.record_span(record)
 
     def _record_timeline(self, req, n_tokens, reason, queue_wait, latency):
@@ -1247,6 +1266,7 @@ class ServingEngine:
                     self._emit_request_record(
                         REQ_SPAN, req.trace, ts=req.trace["ns_submit"],
                         dur_s=waited, sid=req.trace["sid"],
+                        pid=req.trace.get("pid"),
                         extra={"request": request_id,
                                "prompt_len": int(req.prompt.size),
                                "tokens": 0, "finish": "cancelled",

@@ -55,7 +55,9 @@ from .. import config, telemetry
 from ..analysis import sanitizers as _sanitizers
 from ..resilience import fault as _fault
 from ..resilience import preemption as _preemption
+from ..telemetry import distributed as _dtrace
 from ..telemetry import exporters as _exporters
+from ..telemetry import recorder as _recorder
 
 __all__ = ["JournalEntry", "RequestJournal", "Replica", "FleetRouter"]
 
@@ -65,8 +67,39 @@ RESUBMITS_TOTAL = "mxtpu_fleet_resubmits_total"
 DRAINS_TOTAL = "mxtpu_fleet_drains_total"
 DUP_DROPPED_TOTAL = "mxtpu_fleet_dup_tokens_dropped_total"
 LOST_TOTAL = "mxtpu_fleet_lost_requests_total"
+# fleet-level rollups + per-replica federation gauges (the gateway's
+# /metrics aggregation; ROADMAP item 1's autoscaler input)
+FLEET_QUEUE_DEPTH = "mxtpu_fleet_queue_depth"
+FLEET_OLDEST_QUEUED = "mxtpu_fleet_oldest_queued_seconds"
+FLEET_REPLICA_HEALTH = "mxtpu_fleet_replica_health"
+FLEET_TOTAL_QUEUE_DEPTH = "mxtpu_fleet_total_queue_depth"
+FLEET_PAGE_OCCUPANCY = "mxtpu_fleet_page_occupancy"
+FLEET_REPLICA_QUEUE_DEPTH = "mxtpu_fleet_replica_queue_depth"
+FLEET_REPLICA_SLOTS = "mxtpu_fleet_replica_slots_in_use"
+FLEET_REPLICA_OCCUPANCY = "mxtpu_fleet_replica_page_occupancy"
 
 REPLICA_STATES = ("healthy", "draining", "dead", "left")
+
+# router-side trace records (registered in telemetry/names.py): the
+# causal chain gateway.request -> fleet.dispatch -> serving.request,
+# with fleet.failover spanning the outage window between losing a
+# replica and re-dispatching on the survivor. Emitted straight through
+# distributed.record_span — zero-cost when tracing is off.
+DISPATCH_SPAN = "fleet.dispatch"
+FAILOVER_SPAN = "fleet.failover"
+RESUBMIT_SPAN = "fleet.resubmit"
+# journal token-delivery record (not a span): absolute positions each
+# accepted delivery covered — trace_merge --fleet --check proves no
+# position was ever delivered twice from these
+DELIVERY_KIND = "fleet_delivery"
+ROUTER_LANE = "router"
+
+
+def _trace_ts(tr, clk):
+    """Wall-clock ns for a router-clock instant: deltas come from the
+    injectable fleet clock (fake clocks in tests/chaos), anchored to the
+    wall time captured when the entry was journaled."""
+    return tr["ns_submit"] + int((clk - tr["clk_submit"]) * 1e9)
 
 
 @dataclasses.dataclass
@@ -93,6 +126,11 @@ class JournalEntry:
     finished_at: float = 0.0
     finish_reason: str | None = None
     error: str | None = None
+    # distributed-trace context (None with tracing off): tid shared by
+    # every span the request produces anywhere in the fleet, psid the
+    # gateway's root span id, ns_submit/clk_submit the wall/router-clock
+    # anchor pair, plus transient dispatch/failover bookkeeping
+    trace: dict | None = None
 
 
 class RequestJournal:
@@ -170,6 +208,18 @@ class RequestJournal:
                         entry.first_token_at = now
                     self._emit_locked(entry, {
                         "event": "token", "index": pos, "token": int(tok)})
+                if taken and entry.trace is not None:
+                    # accepted-delivery record: the absolute position
+                    # range this delivery appended. trace_merge --fleet
+                    # --check proves per-entry contiguity (monotone
+                    # journal positions, no position delivered twice).
+                    tr = entry.trace
+                    _dtrace.record_span({
+                        "kind": DELIVERY_KIND, "ts": _trace_ts(tr, now),
+                        "tid": tr["tid"], "entry": entry.entry_id,
+                        "epoch": epoch,
+                        "start": len(entry.tokens) - taken, "n": taken,
+                        "replica": entry.replica_id, "lane": ROUTER_LANE})
             if dropped:
                 self.dup_dropped += dropped
                 telemetry.inc(DUP_DROPPED_TOTAL, amount=float(dropped))
@@ -253,6 +303,23 @@ class RequestJournal:
                     "dup_tokens_dropped": self.dup_dropped,
                     "lost": self.lost}
 
+    def dump_entries(self):
+        """Per-entry forensics rows (the failover post-mortem dump's
+        journal snapshot): enough to replay the resume decision for
+        every request that was in flight when a replica died."""
+        with self._lock:
+            return [{
+                "entry": e.entry_id, "tenant": e.tenant,
+                "state": e.state, "epoch": e.epoch,
+                "replica": e.replica_id, "engine_rid": e.engine_rid,
+                "tokens_delivered": len(e.tokens),
+                "max_new_tokens": e.max_new_tokens,
+                "resubmits": e.resubmits,
+                "finish_reason": e.finish_reason, "error": e.error,
+                "trace_id": (e.trace or {}).get("tid"),
+            } for e in sorted(self._entries.values(),
+                              key=lambda e: e.entry_id)]
+
 
 class Replica:
     """One ServingEngine behind the router's RPC seam.
@@ -268,6 +335,11 @@ class Replica:
         self.engine = engine
         self.journal = journal
         self._clock = clock
+        # the replica id is the engine's timeline lane: every
+        # serving.request span this engine emits lands on a per-replica
+        # lane in the merged fleet trace
+        if getattr(engine, "trace_lane", None) is None:
+            engine.trace_lane = self.replica_id
         self._lock = _sanitizers.san_lock("serving.replica")
         self.state = "healthy"
         self.last_beat = clock()
@@ -303,8 +375,13 @@ class Replica:
             base = len(entry.tokens)
             prompt = entry.prompt if not base else np.concatenate(
                 [entry.prompt, np.asarray(entry.tokens, np.int32)])
+            # the engine's serving.request span adopts the fleet trace
+            # id and parents under this dispatch's fleet.dispatch span
+            tr = entry.trace
+            ctx = ((tr["tid"], tr.get("dispatch_sid"))
+                   if tr is not None else None)
             rid = self.engine.submit(prompt, entry.max_new_tokens - base,
-                                     entry.eos_id)
+                                     entry.eos_id, trace_ctx=ctx)
             self._bindings[rid] = [entry.entry_id, entry.epoch, base, 0]
             return rid
 
@@ -480,6 +557,11 @@ class FleetRouter:
         # chaos_serving --inject lost-request: silently skip ONE failover
         # resubmission — the zero-lost-requests gate MUST catch this
         self._chaos_lose_one = False
+        # chaos_serving --inject broken-chain: drop ONE resubmitted
+        # entry's trace context before redispatch, orphaning the
+        # survivor's serving.request span — trace_merge --fleet --check
+        # MUST catch the broken causal chain
+        self._chaos_break_trace = False
         self._stop = threading.Event()
         self._threads: dict = {}
         self._started = False
@@ -518,11 +600,13 @@ class FleetRouter:
     # -- admission ---------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens, eos_id=None,
-               tenant="default", sink=None):
+               tenant="default", sink=None, trace_ctx=None):
         """Journal one request and queue it for dispatch; returns the
         journal entry id. Validation mirrors ServingEngine.submit so an
         unservable request fails HERE (the gateway's 400), never on a
-        replica."""
+        replica. `trace_ctx` is the gateway root span's
+        (trace_id, span_id) — every fleet/replica span of this request
+        shares the trace id and chains up to that root."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -550,6 +634,12 @@ class FleetRouter:
                     f"replica's pool holds")
             entry = self.journal.record(prompt, max_new_tokens, eos_id,
                                         tenant, sink)
+            if _dtrace.trace_active():
+                tid, psid = trace_ctx if trace_ctx else (None, None)
+                entry.trace = {
+                    "tid": tid or _dtrace.new_id(), "psid": psid,
+                    "ns_submit": time.time_ns(),
+                    "clk_submit": entry.submitted_at}
             self._enqueue_locked(entry, front=False)
             return entry.entry_id
 
@@ -606,18 +696,76 @@ class FleetRouter:
             # the zero-lost-requests chaos gate exists to catch.
             entries.pop(0)
             self._chaos_lose_one = False
+        self._dump_failover_locked(rep, entries)
         for entry in reversed(entries):  # appendleft keeps id order
-            self._requeue_locked(entry, reason="failover")
+            self._requeue_locked(entry, reason="failover",
+                                 cause="heartbeat_timeout")
 
-    def _requeue_locked(self, entry, reason):
+    def _dump_failover_locked(self, rep, entries):
+        """Flight-recorder post-mortem on every replica death: the full
+        journal snapshot plus every replica's recent request timelines
+        (victim AND survivors — the forensics view of what each side
+        was doing when the heartbeat timeout fired)."""
+        timelines = {}
+        for rid in sorted(self._replicas):
+            other = self._replicas[rid]
+            try:
+                timelines[rid] = other.engine.recent_timelines()
+            except Exception:  # a corpse's engine may be torn down
+                timelines[rid] = []
+        _recorder.dump("fleet-failover", extra={
+            "fleet": {
+                "victim": rep.replica_id,
+                "cause": "heartbeat_timeout",
+                "heartbeat_timeout_s": self.heartbeat_timeout,
+                "failovers": self.failovers,
+                "requeued_entries": [e.entry_id for e in entries],
+                "journal": self.journal.snapshot(),
+                "journal_entries": self.journal.dump_entries(),
+                "replica_timelines": timelines,
+            }})
+
+    def _emit_fleet_record(self, name, tr, *, ts, dur_s=0.0, extra=None,
+                           sid=None, pid=None):
+        rec = {"name": name, "tid": tr["tid"],
+               "sid": sid if sid is not None else _dtrace.new_id(),
+               "ts": int(ts), "dur_ns": max(0, int(dur_s * 1e9)),
+               "lane": ROUTER_LANE}
+        if pid is not None:
+            rec["pid"] = pid
+        if extra:
+            rec["extra"] = extra
+        _dtrace.record_span(rec)
+
+    def _requeue_locked(self, entry, reason, cause=None):
         """Resubmission path: bump the epoch (the dedup fence), then
         either finish directly (the streamed tokens already satisfy
         EOS/length), fail (failover budget exhausted), or requeue at
         the FRONT of the tenant queue so recovered requests do not wait
-        behind fresh arrivals."""
+        behind fresh arrivals. `cause` (failovers only) names what
+        killed the old assignment: heartbeat_timeout | rpc_fault."""
+        victim = entry.replica_id
         self.journal.release(entry)
         self.resubmits += 1
         telemetry.inc(RESUBMITS_TOTAL, reason=reason)
+        tr = entry.trace
+        now = self._clock()
+        if tr is not None:
+            self._emit_fleet_record(
+                RESUBMIT_SPAN, tr, ts=_trace_ts(tr, now),
+                pid=tr.get("psid"), extra={
+                    "entry": entry.entry_id, "reason": reason,
+                    "epoch": entry.epoch,
+                    "resume_pos": len(entry.tokens),
+                    "resubmits_remaining":
+                        self.max_resubmits - entry.resubmits
+                        - (1 if reason == "failover" else 0)})
+            if reason == "failover":
+                # the failover span covers the outage window: it opens
+                # here (the requeue) and closes at the next successful
+                # dispatch, which fills in the survivor replica id
+                tr["failover"] = {"cause": cause, "victim": victim,
+                                  "clk": now}
         if reason == "failover":
             # only unplanned resubmits consume budget: a rolling restart
             # may hand the same request off any number of times
@@ -626,15 +774,40 @@ class FleetRouter:
                 self.journal.fail(
                     entry, f"failover budget exhausted after "
                            f"{entry.resubmits - 1} resubmissions")
+                self._resolve_failover_locked(entry, None)
                 return
         if (entry.eos_id is not None and entry.tokens
                 and entry.tokens[-1] == entry.eos_id):
             self.journal.finish_direct(entry, "eos")
+            self._resolve_failover_locked(entry, None)
             return
         if len(entry.tokens) >= entry.max_new_tokens:
             self.journal.finish_direct(entry, "length")
+            self._resolve_failover_locked(entry, None)
             return
         self._enqueue_locked(entry, front=True)
+
+    def _resolve_failover_locked(self, entry, survivor):
+        """Close a pending fleet.failover span: the outage window ran
+        from the requeue to this moment — the survivor's dispatch, or a
+        terminal router-side decision (budget exhausted / finished
+        directly), in which case `survivor` is None."""
+        tr = entry.trace
+        if tr is None:
+            return
+        stash = tr.pop("failover", None)
+        if stash is None:
+            return
+        now = self._clock()
+        self._emit_fleet_record(
+            FAILOVER_SPAN, tr, ts=_trace_ts(tr, stash["clk"]),
+            dur_s=now - stash["clk"], pid=tr.get("psid"), extra={
+                "entry": entry.entry_id, "cause": stash["cause"],
+                "victim": stash["victim"], "survivor": survivor,
+                "epoch": entry.epoch,
+                "resume_pos": len(entry.tokens),
+                "resubmits_remaining":
+                    self.max_resubmits - entry.resubmits})
 
     def _progress_drains(self):
         with self._lock:
@@ -668,6 +841,19 @@ class FleetRouter:
                     if best is None:
                         return  # no capacity anywhere: stop the sweep
                     entry = dq.popleft()
+                    if self._chaos_break_trace and entry.resubmits:
+                        # seeded negative: lose the resubmission's trace
+                        # context, so the survivor's serving.request
+                        # span starts a fresh, orphaned trace — exactly
+                        # the broken chain --fleet --check must flag
+                        entry.trace = None
+                        self._chaos_break_trace = False
+                    tr = entry.trace
+                    dispatch_clk = self._clock()
+                    if tr is not None:
+                        # pre-mint the dispatch span id so the engine's
+                        # serving.request span can parent under it
+                        tr["dispatch_sid"] = _dtrace.new_id()
                     try:
                         erid = best.dispatch(
                             entry, allow_draining=self.draining)
@@ -679,6 +865,20 @@ class FleetRouter:
                         telemetry.inc(RESUBMITS_TOTAL, reason="rpc")
                         return
                     self.journal.bind(entry, best.replica_id, erid)
+                    if tr is not None:
+                        self._emit_fleet_record(
+                            DISPATCH_SPAN, tr,
+                            ts=_trace_ts(tr, dispatch_clk),
+                            dur_s=self._clock() - dispatch_clk,
+                            sid=tr.pop("dispatch_sid"),
+                            pid=tr.get("psid"), extra={
+                                "entry": entry.entry_id,
+                                "replica": best.replica_id,
+                                "request": erid, "epoch": entry.epoch,
+                                "resume_pos": len(entry.tokens),
+                                "resubmits": entry.resubmits})
+                        self._resolve_failover_locked(
+                            entry, best.replica_id)
                     dispatched = True
                 self._rr = (self._rr + 1) % n
 
@@ -709,7 +909,8 @@ class FleetRouter:
             for rep in reps:
                 for entry_id in rep.take_orphans():
                     self._requeue_locked(self.journal.get(entry_id),
-                                         reason="failover")
+                                         reason="failover",
+                                         cause="rpc_fault")
 
     # -- drains / rolling restarts -----------------------------------------
 
@@ -842,14 +1043,57 @@ class FleetRouter:
             return sum(r.state == "healthy"
                        for r in self._replicas.values())
 
+    def export_fleet_gauges(self):
+        """Refresh the fleet's rollup + per-replica federation gauges
+        in the process registry — called each router iteration and by
+        the gateway right before serving /metrics, so a scrape always
+        sees current values."""
+        self._export_gauges()
+
     def _export_gauges(self):
+        now = self._clock()
         with self._lock:
             counts = {}
-            for r in self._replicas.values():
+            per_replica = []
+            for rid in sorted(self._replicas):
+                r = self._replicas[rid]
                 counts[r.state] = counts.get(r.state, 0) + 1
+                eng = r.engine
+                per_replica.append(
+                    (rid, r.state, eng.queue_depth, eng.slots_in_use,
+                     eng.allocator.occupancy()))
+            front_depth = sum(len(dq) for dq in self._tenants.values())
+            oldest = min(
+                (e.submitted_at for dq in self._tenants.values()
+                 for e in dq), default=None)
         for state in REPLICA_STATES:
             telemetry.set_gauge(FLEET_REPLICAS, counts.get(state, 0),
                                 state=state)
+        # router front queue (requests journaled but not yet on any
+        # replica) — the autoscaler's backlog signal
+        telemetry.set_gauge(FLEET_QUEUE_DEPTH, front_depth)
+        telemetry.set_gauge(FLEET_OLDEST_QUEUED,
+                            (now - oldest) if oldest is not None else 0.0)
+        # fleet rollups across live replicas
+        live = [p for p in per_replica if p[1] in ("healthy", "draining")]
+        telemetry.set_gauge(
+            FLEET_TOTAL_QUEUE_DEPTH,
+            front_depth + sum(p[2] for p in live))
+        telemetry.set_gauge(
+            FLEET_PAGE_OCCUPANCY,
+            sum(p[4] for p in live) / len(live) if live else 0.0)
+        # per-replica federation: one labelled series per replica, and
+        # a one-hot health-state matrix (value 1 on the current state)
+        for rid, state, qd, slots, occ in per_replica:
+            for s in REPLICA_STATES:
+                telemetry.set_gauge(FLEET_REPLICA_HEALTH,
+                                    1.0 if s == state else 0.0,
+                                    replica=rid, state=s)
+            telemetry.set_gauge(FLEET_REPLICA_QUEUE_DEPTH, qd,
+                                replica=rid)
+            telemetry.set_gauge(FLEET_REPLICA_SLOTS, slots, replica=rid)
+            telemetry.set_gauge(FLEET_REPLICA_OCCUPANCY, occ,
+                                replica=rid)
 
     def debug_snapshot(self):
         """Live-fleet JSON snapshot, served at /debug/fleet by the
@@ -877,12 +1121,20 @@ class FleetRouter:
                         "drains": self.drains,
                         "ticks": self.ticks}
             draining = self.draining
+            oldest = min(
+                (e.submitted_at for dq in self._tenants.values()
+                 for e in dq), default=None)
+            front_queue = {
+                "depth": sum(len(dq) for dq in self._tenants.values()),
+                "oldest_s": (now - oldest) if oldest is not None else 0.0,
+            }
         return {
             "schema": "mxtpu-serving-fleet-debug-v1",
             "draining": draining,
             "heartbeat_timeout_s": self.heartbeat_timeout,
             "replicas": reps,
             "tenants": tenants,
+            "front_queue": front_queue,
             "counters": counters,
             "journal": self.journal.snapshot(),
         }

@@ -29,22 +29,48 @@ before admission (`drop`/`fail` → a clean 503 with outcome "injected",
 `delay` → a slow accept path), which is how the chaos legs separate
 "the gateway shed load" from "the fleet lost a request" — the former is
 allowed, the latter never is.
+
+Observability (the fleet observatory's front end):
+
+- With tracing on (`MXTPU_TRACE_DIR`), every request gets a root
+  `gateway.request` span on lane "gateway". An inbound W3C
+  `traceparent` header is adopted (external client correlation); the
+  response carries a `Traceparent` header naming the gateway span, the
+  NDJSON stream opens with a `{"event": "trace", ...}` line, and the
+  context rides `router.submit(trace_ctx=...)` down through
+  `fleet.dispatch` into each replica's `serving.request` span — one
+  trace per request, fleet-wide.
+- `GET /metrics` is the fleet metrics federation point: the router's
+  rollup + per-replica gauges are refreshed, then the whole process
+  registry is rendered as Prometheus text.
+- `MXTPU_GATEWAY_ACCESS_LOG` (a path, or `-` for stderr) turns on an
+  NDJSON access log: one line per generate request with tenant,
+  status, token counts, queue-wait/TTFT/latency, trace id, serving
+  replica, and failover count — replacing the silently-discarded
+  `log_message` default.
 """
 from __future__ import annotations
 
 import json
 import queue
+import sys
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import config, telemetry
 from ..analysis import sanitizers as _sanitizers
 from ..resilience import fault as _fault
+from ..telemetry import distributed as _dtrace
 
 __all__ = ["ServingGateway"]
 
 GW_REQUESTS_TOTAL = "mxtpu_gateway_requests_total"
 GW_INFLIGHT = "mxtpu_gateway_inflight"
+GW_ACCESS_LINES_TOTAL = "mxtpu_gateway_access_log_lines_total"
+
+GATEWAY_SPAN = "gateway.request"
+GATEWAY_LANE = "gateway"
 
 
 class _Reject(Exception):
@@ -63,13 +89,58 @@ class _GatewayServer(ThreadingHTTPServer):
     gateway = None  # set by ServingGateway before serving
 
 
+class _AccessLog:
+    """NDJSON access log behind `MXTPU_GATEWAY_ACCESS_LOG`: one JSON
+    line per request, appended to the configured path (`-` = stderr,
+    empty = off). Thread-safe; the file opens lazily on first write so
+    an idle gateway never touches disk."""
+
+    def __init__(self, target):
+        self._target = str(target or "")
+        self._lock = _sanitizers.san_lock("serving.gateway_access_log")
+        self._file = None
+
+    @property
+    def enabled(self):
+        return bool(self._target)
+
+    def write(self, record):
+        if not self._target:
+            return
+        line = json.dumps(record, sort_keys=True) + "\n"
+        with self._lock:
+            if self._target == "-":
+                sys.stderr.write(line)
+            else:
+                if self._file is None:
+                    self._file = open(self._target, "a", encoding="utf-8")
+                self._file.write(line)
+                self._file.flush()
+        telemetry.inc(GW_ACCESS_LINES_TOTAL)
+
+    def close(self):
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
 class _Handler(BaseHTTPRequestHandler):
     # HTTP/1.0: responses are close-delimited, which is what lets the
     # token stream flush line-by-line without chunked-encoding framing
     protocol_version = "HTTP/1.0"
+    _trace = None  # gateway.request trace context for this request
 
-    def log_message(self, fmt, *args):  # quiet; telemetry has the counts
-        pass
+    def log_message(self, fmt, *args):
+        # BaseHTTPRequestHandler's default stderr chatter stays off;
+        # non-generate traffic (healthz, metrics, 404s) gets a basic
+        # access-log line, while /v1/generate writes its rich line from
+        # handle_generate (with journal-derived latency/replica fields)
+        gw = self.server.gateway
+        if gw is None or self.path == "/v1/generate":
+            return
+        gw.access_log.write({"ts": time.time(), "method": self.command,
+                             "path": self.path, "line": fmt % args})
 
     def _reply(self, status, body, retry_after=None):
         data = (json.dumps(body) + "\n").encode()
@@ -78,6 +149,9 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(data)))
         if retry_after is not None:
             self.send_header("Retry-After", f"{retry_after:g}")
+        if self._trace is not None:
+            self.send_header("Traceparent", _dtrace.format_traceparent(
+                self._trace["tid"], self._trace["sid"]))
         self.end_headers()
         self.wfile.write(data)
 
@@ -86,6 +160,14 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/healthz":
             status, body = gw.health()
             self._reply(status, body)
+        elif self.path == "/metrics":
+            data = gw.metrics_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
         else:
             self._reply(404, {"error": f"unknown path {self.path!r}"})
 
@@ -116,6 +198,7 @@ class ServingGateway:
             retry_after if retry_after is not None
             else config.get("MXTPU_GATEWAY_RETRY_AFTER"))
         self.request_timeout = float(request_timeout)
+        self.access_log = _AccessLog(config.get("MXTPU_GATEWAY_ACCESS_LOG"))
         self._inflight = 0
         self._inflight_lock = _sanitizers.san_lock("serving.gateway")
         bind_port = int(port if port is not None
@@ -136,6 +219,15 @@ class ServingGateway:
         self._server.shutdown()
         self._server.server_close()
         self._thread.join(timeout=10.0)
+        self.access_log.close()
+
+    def metrics_text(self):
+        """Fleet metrics federation: refresh the router's rollup and
+        per-replica gauges, then render the whole process registry —
+        in-process replicas share it, so one scrape sees the gateway,
+        the router, and every replica's engine under one namespace."""
+        self.router.export_fleet_gauges()
+        return telemetry.prometheus_text()
 
     # -- request path ------------------------------------------------------
 
@@ -148,7 +240,7 @@ class ServingGateway:
             return 503, {"status": "unhealthy", "healthy_replicas": 0}
         return 200, {"status": "ok", "healthy_replicas": healthy}
 
-    def _admit(self, raw):
+    def _admit(self, raw, tr=None):
         """Fault site, drain check, parse, backpressure, journal submit.
         Returns (entry_id, tenant, event queue); raises _Reject."""
         try:
@@ -185,7 +277,8 @@ class ServingGateway:
         try:
             entry_id = self.router.submit(
                 prompt, max_new, eos_id=payload.get("eos_id"),
-                tenant=tenant, sink=events.put)
+                tenant=tenant, sink=events.put,
+                trace_ctx=(tr["tid"], tr["sid"]) if tr else None)
         except ValueError as e:
             raise _Reject(400, "error", {"error": str(e)}) from None
         except RuntimeError as e:
@@ -197,12 +290,27 @@ class ServingGateway:
     def handle_generate(self, handler):
         raw = handler.rfile.read(
             int(handler.headers.get("Content-Length") or 0))
+        t0 = time.monotonic()
+        tr = None
+        if _dtrace.trace_active():
+            # root span context: adopt the client's traceparent when it
+            # sent one (external correlation), else start a fresh trace
+            inbound = _dtrace.parse_traceparent(
+                handler.headers.get("traceparent"))
+            tr = {"tid": inbound[0] if inbound else _dtrace.new_id(),
+                  "pid": inbound[1] if inbound else None,
+                  "sid": _dtrace.new_id(), "ns": time.time_ns()}
+            handler._trace = tr
+        entry_id = tenant = None
         try:
-            entry_id, tenant, stream, events = self._admit(raw)
+            entry_id, tenant, stream, events = self._admit(raw, tr)
         except _Reject as rej:
             telemetry.inc(GW_REQUESTS_TOTAL, outcome=rej.outcome)
             handler._reply(rej.status, rej.body,
                            retry_after=rej.retry_after)
+            self._finish_http(tr, t0, status=rej.status,
+                              outcome=rej.outcome, tenant=tenant,
+                              entry_id=None)
             return
         with self._inflight_lock:
             self._inflight += 1
@@ -218,6 +326,50 @@ class ServingGateway:
                 self._inflight -= 1
                 telemetry.set_gauge(GW_INFLIGHT, self._inflight)
         telemetry.inc(GW_REQUESTS_TOTAL, outcome=outcome)
+        self._finish_http(tr, t0,
+                          status=200 if outcome == "ok" else 500,
+                          outcome=outcome, tenant=tenant,
+                          entry_id=entry_id)
+
+    def _finish_http(self, tr, t0, *, status, outcome, tenant, entry_id):
+        """End-of-request bookkeeping: close the gateway.request root
+        span and write the access-log line (rich journal-derived fields
+        when the request got far enough to have an entry)."""
+        dur_s = time.monotonic() - t0
+        if tr is not None:
+            rec = {"name": GATEWAY_SPAN, "tid": tr["tid"],
+                   "sid": tr["sid"], "ts": tr["ns"],
+                   "dur_ns": max(0, int(dur_s * 1e9)),
+                   "lane": GATEWAY_LANE,
+                   "extra": {"status": status, "outcome": outcome,
+                             "tenant": tenant, "entry": entry_id}}
+            if tr.get("pid"):
+                rec["pid"] = tr["pid"]
+            _dtrace.record_span(rec)
+        if not self.access_log.enabled:
+            return
+        line = {"ts": time.time(), "method": "POST",
+                "path": "/v1/generate", "status": status,
+                "outcome": outcome, "tenant": tenant, "entry": entry_id,
+                "latency_s": round(dur_s, 6),
+                "trace_id": tr["tid"] if tr else None}
+        if entry_id is not None:
+            e = self.router.journal.get(entry_id)
+            line.update({
+                "tenant": e.tenant,
+                "prompt_tokens": int(e.prompt.size),
+                "output_tokens": len(e.tokens),
+                "replica": e.replica_id,
+                "failovers": e.resubmits,
+                "finish_reason": e.finish_reason,
+                "queue_wait_s": (round(e.assigned_at - e.submitted_at, 6)
+                                 if e.assigned_at else None),
+                "ttft_s": (round(e.first_token_at - e.submitted_at, 6)
+                           if e.first_token_at else None)})
+            if e.finished_at:
+                line["latency_s"] = round(
+                    e.finished_at - e.submitted_at, 6)
+        self.access_log.write(line)
 
     def _next_event(self, events):
         try:
@@ -231,10 +383,21 @@ class ServingGateway:
         handler.send_response(200)
         handler.send_header("Content-Type", "application/x-ndjson")
         handler.send_header("X-Entry-Id", str(entry_id))
+        tr = handler._trace
+        if tr is not None:
+            handler.send_header("Traceparent", _dtrace.format_traceparent(
+                tr["tid"], tr["sid"]))
         # no Content-Length: HTTP/1.0 + Connection: close delimit the
         # stream, each event line flushes as the fleet produces it
         handler.send_header("Connection", "close")
         handler.end_headers()
+        if tr is not None:
+            # trace echo: streaming clients learn the trace id first,
+            # before any token, so a hung stream is already correlatable
+            handler.wfile.write((json.dumps(
+                {"event": "trace", "trace_id": tr["tid"],
+                 "entry_id": entry_id}) + "\n").encode())
+            handler.wfile.flush()
         while True:
             ev = self._next_event(events)
             handler.wfile.write((json.dumps(ev) + "\n").encode())
@@ -248,10 +411,13 @@ class ServingGateway:
         while True:
             ev = self._next_event(events)
             if ev.get("event") == "done":
-                handler._reply(200, {"entry_id": entry_id,
-                                     "tokens": ev["tokens"],
-                                     "finish_reason": ev["finish_reason"],
-                                     "resubmits": ev.get("resubmits", 0)})
+                body = {"entry_id": entry_id,
+                        "tokens": ev["tokens"],
+                        "finish_reason": ev["finish_reason"],
+                        "resubmits": ev.get("resubmits", 0)}
+                if handler._trace is not None:
+                    body["trace_id"] = handler._trace["tid"]
+                handler._reply(200, body)
                 return "ok"
             if ev.get("event") == "failed":
                 handler._reply(500, {"entry_id": entry_id,

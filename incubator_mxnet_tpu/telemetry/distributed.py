@@ -34,6 +34,7 @@ import atexit
 import itertools
 import json
 import os
+import re
 import secrets
 import struct
 import threading
@@ -42,7 +43,7 @@ __all__ = [
     "TRACE_MAGIC", "trace_active", "refresh_from_env", "new_id",
     "current_context", "remote_context", "remote_parent",
     "set_thread_lane", "current_lane", "record_span", "flush",
-    "read_trace_file",
+    "read_trace_file", "format_traceparent", "parse_traceparent",
 ]
 
 TRACE_MAGIC = b"MXTRACE1"
@@ -169,6 +170,42 @@ class remote_context:
         if self._set_lane:
             set_thread_lane(self._prev_lane)
         return False
+
+
+# -- W3C traceparent interop (the gateway's external correlation seam) -------
+
+# https://www.w3.org/TR/trace-context/: 00-<32hex trace>-<16hex parent>-<2hex>
+_TRACEPARENT_RE = re.compile(
+    r"^00-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+
+def format_traceparent(trace_id, span_id):
+    """Render an internal (trace_id, span_id) pair as a W3C traceparent
+    header value. Internal ids are 16 hex chars; the 32-hex W3C trace-id
+    field is left-padded with zeros (an inbound 32-hex id adopted by
+    `parse_traceparent` round-trips unchanged). Flags are always 01
+    (sampled) — a traceparent only exists while tracing is active."""
+    return f"00-{str(trace_id).zfill(32)}-{span_id}-01"
+
+
+def parse_traceparent(header):
+    """Parse a W3C traceparent header into an internal
+    (trace_id, parent_span_id) pair, or None when the header is missing
+    or malformed (the request then starts a fresh trace). The 32-hex
+    trace id is adopted verbatim minus redundant left zero-padding, so
+    a client-minted id survives the echo and internally-minted 16-hex
+    ids round-trip through `format_traceparent`."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RE.match(str(header).strip().lower())
+    if m is None:
+        return None
+    trace_hex, parent_hex = m.group(1), m.group(2)
+    if set(trace_hex) == {"0"} or set(parent_hex) == {"0"}:
+        return None  # all-zero ids are invalid per the spec
+    trimmed = trace_hex.lstrip("0")
+    trace_id = trace_hex[-16:] if len(trimmed) <= 16 else trace_hex
+    return (trace_id, parent_hex)
 
 
 # -- trace file writer -------------------------------------------------------

@@ -275,6 +275,33 @@ METRIC_NAMES = {
                    "after exhausting MXTPU_FLEET_MAX_RESUBMITS — the "
                    "zero-lost-requests chaos gate asserts this stays "
                    "0."),
+    "mxtpu_fleet_queue_depth": (
+        "gauge", "Requests in the fleet router's front queue (journaled "
+                 "but not yet dispatched to any replica) — the "
+                 "autoscaler's backlog signal."),
+    "mxtpu_fleet_oldest_queued_seconds": (
+        "gauge", "Age of the oldest request still waiting in the fleet "
+                 "router's front queue (0 when the queue is empty)."),
+    "mxtpu_fleet_total_queue_depth": (
+        "gauge", "Fleet-wide queued work: router front queue plus every "
+                 "live replica's engine admission queue."),
+    "mxtpu_fleet_page_occupancy": (
+        "gauge", "Mean KV page-pool occupancy across live (healthy or "
+                 "draining) replicas — the fleet-level capacity rollup "
+                 "the gateway federates at /metrics."),
+    "mxtpu_fleet_replica_health": (
+        "gauge", "One-hot replica health matrix: 1 on the replica's "
+                 "current state series (healthy / draining / dead / "
+                 "left), 0 on the rest, labeled {replica, state}."),
+    "mxtpu_fleet_replica_queue_depth": (
+        "gauge", "Engine admission-queue depth per replica (federated "
+                 "under the replica label at the gateway's /metrics)."),
+    "mxtpu_fleet_replica_slots_in_use": (
+        "gauge", "Decode slots in use per replica (federated under the "
+                 "replica label at the gateway's /metrics)."),
+    "mxtpu_fleet_replica_page_occupancy": (
+        "gauge", "KV page-pool occupancy per replica (federated under "
+                 "the replica label at the gateway's /metrics)."),
     "mxtpu_gateway_requests_total": (
         "counter", "HTTP requests answered by the serving gateway, by "
                    "outcome (ok / error = 4xx or journal failure, "
@@ -283,6 +310,9 @@ METRIC_NAMES = {
     "mxtpu_gateway_inflight": (
         "gauge", "Generation requests currently open on the serving "
                  "gateway (accepted, not yet finished streaming)."),
+    "mxtpu_gateway_access_log_lines_total": (
+        "counter", "Lines written to the gateway's structured NDJSON "
+                   "access log (MXTPU_GATEWAY_ACCESS_LOG)."),
     "mxtpu_slo_burn_rate": (
         "gauge", "SLO error-budget burn rate (bad_fraction / budget), "
                  "by objective and window (short / long)."),
@@ -323,6 +353,13 @@ SPAN_NAMES = frozenset({
     "serving.request.queued",
     "serving.request.prefill",
     "serving.request.decode",
+    # fleet observatory (trace-only): the causal chain of one request
+    # across the serving fleet — gateway root, router dispatch, and the
+    # failover/resubmit records that explain a mid-stream replica death
+    "gateway.request",
+    "fleet.dispatch",
+    "fleet.failover",
+    "fleet.resubmit",
 })
 
 

@@ -20,6 +20,8 @@ from incubator_mxnet_tpu.resilience import fault as _fault
 from incubator_mxnet_tpu.resilience import preemption as _preemption
 from incubator_mxnet_tpu.serving import (
     FleetRouter, RequestJournal, ServingEngine, ServingGateway)
+from incubator_mxnet_tpu.telemetry import distributed as _dtrace
+from incubator_mxnet_tpu.telemetry import recorder as _recorder
 
 _PARAM_CACHE = {}
 
@@ -410,12 +412,226 @@ def test_debug_snapshot_and_render_fleet():
     assert rows[rep.replica_id]["state"] == "healthy"
     assert snap["journal"]["entries"] == 2
 
+    assert snap["front_queue"]["depth"] >= 0
     top = _serving_top()
     screen = top.render_fleet(snap)
     assert "serving fleet" in screen
     assert rep.replica_id in screen
     assert "journal 2 entries" in screen
+    assert "front queue" in screen
     # render_any dispatches on the embedded schema
     assert top.render_any(snap) == screen
     assert router.run_until_idle()
     _assert_done_identical(router, ids, refs)
+
+
+# -- fleet observatory: one trace across the whole failover -------------------
+
+def _trace_merge():
+    tools = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "..", "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    import trace_merge
+    return trace_merge
+
+
+def _read_trace_records(directory):
+    records = []
+    for name in sorted(os.listdir(directory)):
+        if name.endswith(".mxtrace"):
+            records.extend(_dtrace.read_trace_file(
+                os.path.join(str(directory), name)))
+    return records
+
+
+def _traced_failover(tmp_path, break_chain=False):
+    """The mid-stream-kill scenario with tracing on. Returns
+    (records, ids, victim) after restoring the trace env."""
+    old = os.environ.get("MXTPU_TRACE_DIR")
+    os.environ["MXTPU_TRACE_DIR"] = str(tmp_path)
+    assert _dtrace.refresh_from_env()
+    _recorder.refresh_from_env()  # fresh per-process dump budget
+    try:
+        cfg, params, prompts, refs = _workload()
+        clk = FakeClock()
+        router = FleetRouter(clock=clk, heartbeat_timeout=0.5)
+        for rid in ("rA", "rB"):
+            router.add_replica(_engine(cfg, params, clk), replica_id=rid)
+        router._chaos_break_trace = bool(break_chain)
+        ids = [router.submit(p, 8, tenant=f"t{i % 2}")
+               for i, p in enumerate(prompts)]
+        entry = router.journal.get(ids[0])
+        for _ in range(100):
+            router.tick()
+            clk.t += 0.01
+            if 0 < len(entry.tokens) < entry.max_new_tokens:
+                break
+        assert 0 < len(entry.tokens) < entry.max_new_tokens
+        victim = entry.replica_id
+        router.kill(victim)
+        for _ in range(400):
+            if router.idle():
+                break
+            router.tick()
+            clk.t += 0.05
+        assert router.idle()
+        assert router.failovers == 1
+        # tracing must never disturb the decode: still token-identical
+        _assert_done_identical(router, ids, refs)
+        _dtrace.flush()
+    finally:
+        if old is None:
+            os.environ.pop("MXTPU_TRACE_DIR", None)
+        else:
+            os.environ["MXTPU_TRACE_DIR"] = old
+        _dtrace.refresh_from_env()
+    return _read_trace_records(tmp_path), ids, victim
+
+
+def test_traced_failover_one_trace_both_replicas(tmp_path):
+    records, ids, victim = _traced_failover(tmp_path)
+    spans = [r for r in records if "kind" not in r]
+
+    # every entry in flight on the victim gets exactly one failover
+    # span, carrying the full forensic context
+    fos = [r for r in spans if r["name"] == "fleet.failover"]
+    assert fos
+    assert len({fo["extra"]["entry"] for fo in fos}) == len(fos)
+    for fo in fos:
+        assert fo["extra"]["cause"] == "heartbeat_timeout"
+        assert fo["extra"]["victim"] == victim
+        assert fo["extra"]["survivor"] in ("rA", "rB")
+        assert fo["extra"]["survivor"] != victim
+    (fo0,) = [fo for fo in fos if fo["extra"]["entry"] == ids[0]]
+    survivor = fo0["extra"]["survivor"]
+    assert fo0["extra"]["resume_pos"] > 0  # mid-stream: resumed, not restarted
+
+    # ONE trace: the failed-over request's id appears on the router lane
+    # and on BOTH replica lanes (the victim's root span never closes —
+    # the engine died — but its child spans carry the trace id)
+    tid = fo0["tid"]
+    lanes = {r.get("lane") for r in records if r.get("tid") == tid}
+    assert {"router", victim, survivor} <= lanes
+
+    # every replica-side root span is parented under a fleet.dispatch
+    # span of the same trace — the causal chain is closed
+    disp = {r["sid"]: r for r in spans if r["name"] == "fleet.dispatch"}
+    roots = [r for r in spans if r["name"] == "serving.request"]
+    assert roots
+    for root in roots:
+        parent = disp.get(root.get("pid"))
+        assert parent is not None and parent["tid"] == root["tid"]
+
+    # exactly one failover span per failover resubmission
+    resubs = [r for r in spans if r["name"] == "fleet.resubmit"
+              and r["extra"]["reason"] == "failover"]
+    assert len(resubs) == len(fos)
+
+    # the merged fleet view and its causal-chain checks gate green
+    assert _trace_merge().main([str(tmp_path), "--fleet", "--check"]) == 0
+
+    # the failover wrote a flight-recorder post-mortem: journal snapshot,
+    # per-entry forensics and both replicas' recent timelines
+    dumps = [f for f in os.listdir(tmp_path)
+             if f.startswith("flightrec-") and "fleet-failover" in f]
+    assert len(dumps) == 1
+    with open(os.path.join(str(tmp_path), dumps[0])) as f:
+        payload = json.load(f)
+    fleet = payload["fleet"]
+    assert fleet["victim"] == victim
+    assert fleet["cause"] == "heartbeat_timeout"
+    assert fleet["journal"]["entries"] == len(ids)
+    assert {row["trace_id"] for row in fleet["journal_entries"]
+            if row["entry"] == ids[0]} == {tid}
+    assert set(fleet["replica_timelines"]) == {"rA", "rB"}
+
+
+def test_traced_broken_chain_fails_fleet_check(tmp_path):
+    """A replica span that lost its dispatch parent (seeded via the chaos
+    hook) must fail `trace_merge --fleet --check` — the gate proves it
+    can actually see a broken causal chain, not just print green."""
+    records, ids, victim = _traced_failover(tmp_path, break_chain=True)
+    spans = [r for r in records if "kind" not in r]
+    assert any(r["name"] == "fleet.dispatch" for r in spans)
+    assert _trace_merge().main([str(tmp_path), "--fleet", "--check"]) == 2
+
+
+def test_gateway_traceparent_adoption_and_access_log(tmp_path):
+    """The gateway adopts an inbound W3C traceparent as the trace root,
+    echoes the id to the client (header + NDJSON trace event), and the
+    access log records the request with its trace id."""
+    cfg, params, prompts, refs = _workload(n=1, seed=13)
+    access_path = os.path.join(str(tmp_path), "access.ndjson")
+    old_env = {k: os.environ.get(k)
+               for k in ("MXTPU_TRACE_DIR", "MXTPU_GATEWAY_ACCESS_LOG")}
+    os.environ["MXTPU_TRACE_DIR"] = str(tmp_path)
+    os.environ["MXTPU_GATEWAY_ACCESS_LOG"] = access_path
+    assert _dtrace.refresh_from_env()
+    tid, psid = "ab" * 8, "cd" * 8
+    try:
+        router = FleetRouter(heartbeat_timeout=60.0)
+        router.add_replica(_engine(cfg, params))
+        router.start(interval=0.001)
+        gw = ServingGateway(router, port=0, queue_limit=16,
+                            max_occupancy=0.99)
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", gw.port,
+                                              timeout=300)
+            conn.request("POST", "/v1/generate",
+                         json.dumps({"prompt": [int(t) for t in prompts[0]],
+                                     "max_new_tokens": 4}),
+                         headers={"traceparent":
+                                  _dtrace.format_traceparent(tid, psid)})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            echoed = resp.getheader("Traceparent")
+            assert echoed is not None
+            assert _dtrace.parse_traceparent(echoed)[0] == tid
+            events = [json.loads(ln) for ln in resp.read().split(b"\n")
+                      if ln.strip()]
+            conn.close()
+            # the stream leads with the trace correlation event
+            assert events[0]["event"] == "trace"
+            assert events[0]["trace_id"] == tid
+            assert sum(e["event"] == "done" for e in events) == 1
+        finally:
+            gw.close()
+            router.stop()
+        # the handler thread closes the root span right after the last
+        # stream write; poll briefly for it to land in the buffer
+        gw_spans = []
+        for _ in range(100):
+            _dtrace.flush()
+            gw_spans = [r for r in _read_trace_records(tmp_path)
+                        if r.get("name") == "gateway.request"]
+            if gw_spans:
+                break
+            time.sleep(0.01)
+    finally:
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        _dtrace.refresh_from_env()
+
+    # root span adopted the inbound context: same trace, parented under
+    # the client's span id, on the gateway lane
+    assert len(gw_spans) == 1
+    root = gw_spans[0]
+    assert root["tid"] == tid and root["pid"] == psid
+    assert root["lane"] == "gateway"
+    assert root["extra"]["status"] == 200
+    assert root["extra"]["outcome"] == "ok"
+
+    # the access log captured the rich per-request line
+    with open(access_path) as f:
+        lines = [json.loads(ln) for ln in f if ln.strip()]
+    gen = [ln for ln in lines if ln["path"] == "/v1/generate"]
+    assert len(gen) == 1
+    assert gen[0]["status"] == 200
+    assert gen[0]["trace_id"] == tid
+    assert gen[0]["output_tokens"] == 4  # max_new_tokens in the request
+    assert gen[0]["finish_reason"] == "length"
+    assert gen[0]["replica"] is not None

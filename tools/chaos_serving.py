@@ -29,10 +29,22 @@ Scenarios (each gates on ALL of its invariants):
   indexes and token-identical payloads; a queue_limit=0 gateway
   answers 429 with Retry-After (backpressure proof).
 
-Seeded negative (--inject lost-request): the router silently skips ONE
-failover resubmission — the dropped request stays assigned to a corpse
-forever. The completeness gate MUST fail; exit 0 only when it does.
-This is CI proving the gate can fail, not just that it passed today.
+Seeded negatives (CI proving the gates can fail, not just that they
+passed today; exit 0 only when the gate catches the corruption):
+
+- --inject lost-request: the router silently skips ONE failover
+  resubmission — the dropped request stays assigned to a corpse
+  forever. The completeness gate MUST fail.
+- --inject broken-chain: a traced failover run where the router drops
+  ONE resubmitted entry's trace context before redispatch, orphaning
+  the survivor's serving.request span. The serving gates still pass
+  (the corruption is observability-only) but
+  `trace_merge --fleet --check` MUST fail.
+
+With MXTPU_TRACE_DIR set, the failover scenario runs traced: the full
+causal chain (fleet.dispatch / fleet.failover / fleet.resubmit spans,
+journal delivery records, the failover post-mortem dump) lands in the
+trace dir for `trace_merge --fleet --check` — the traced CI leg.
 
 Exit status: 0 scenarios green (or injection caught), 1 gate failed,
 2 injection missed (the gate passed when it should not have).
@@ -116,10 +128,11 @@ def _check_results(router, ids, refs, label):
     return 0
 
 
-def scenario_failover(lose_one=False):
+def scenario_failover(lose_one=False, break_chain=False):
     """Kill one replica mid-stream; failover must be invisible."""
     from incubator_mxnet_tpu.resilience import fault
     from incubator_mxnet_tpu.serving import FleetRouter
+    from incubator_mxnet_tpu.telemetry import distributed as _dtrace
 
     cfg, params, prompts, refs = _workload()
     clk = _FakeClock()
@@ -129,6 +142,7 @@ def scenario_failover(lose_one=False):
     for _ in range(2):
         router.add_replica(_mk_engine(cfg, params, clk))
     router._chaos_lose_one = bool(lose_one)
+    router._chaos_break_trace = bool(break_chain)
     ids = [router.submit(p, 12, tenant=f"t{i % 3}")
            for i, p in enumerate(prompts)]
     replaced = False
@@ -158,6 +172,10 @@ def scenario_failover(lose_one=False):
         return _fail(f"failover: journal deduped "
                      f"{snap['dup_tokens_dropped']} tokens in a "
                      f"zombie-free run")
+    if _dtrace.trace_active():
+        # traced CI leg: make the causal chain durable for the
+        # trace_merge --fleet --check gate that runs next
+        _dtrace.flush()
     print(f"chaos_serving: failover ok (8/8 token-identical, "
           f"failovers={router.failovers}, resubmits={router.resubmits}, "
           f"lost=0, slo ok)")
@@ -315,9 +333,50 @@ def inject_lost_request():
     return 2
 
 
+def inject_broken_chain():
+    """Seeded negative for the TRACE gate: a traced failover run where
+    the router loses one resubmitted entry's trace context, orphaning
+    the survivor's serving.request span. The serving gates must still
+    pass (the corruption is observability-only) while
+    `trace_merge --fleet --check` must FAIL — exit 0 only then."""
+    import tempfile
+
+    from incubator_mxnet_tpu.telemetry import distributed as _dtrace
+
+    d = tempfile.mkdtemp(prefix="mxtpu-broken-chain-")
+    prev = os.environ.get("MXTPU_TRACE_DIR")
+    os.environ["MXTPU_TRACE_DIR"] = d
+    try:
+        _dtrace.refresh_from_env()
+        rc = scenario_failover(break_chain=True)
+        _dtrace.flush()
+    finally:
+        if prev is None:
+            os.environ.pop("MXTPU_TRACE_DIR", None)
+        else:
+            os.environ["MXTPU_TRACE_DIR"] = prev
+        _dtrace.refresh_from_env()
+    if rc != 0:
+        print("chaos_serving: MISSED: broken-chain corruption must be "
+              "invisible to the serving gates but the scenario failed "
+              f"(rc {rc})", file=sys.stderr)
+        return 2
+    sys.path.insert(0, str(REPO_ROOT / "tools"))
+    import trace_merge
+    merge_rc = trace_merge.main([d, "--fleet", "--check"])
+    if merge_rc != 0:
+        print("chaos_serving: inject broken-chain caught (trace gate "
+              "failed as it must)")
+        return 0
+    print("chaos_serving: MISSED: an orphaned replica span passed "
+          "trace_merge --fleet --check", file=sys.stderr)
+    return 2
+
+
 SCENARIOS = {"failover": scenario_failover, "rolling": scenario_rolling,
              "wire": scenario_wire}
-INJECTIONS = {"lost-request": inject_lost_request}
+INJECTIONS = {"lost-request": inject_lost_request,
+              "broken-chain": inject_broken_chain}
 
 
 def main(argv=None):

@@ -160,6 +160,11 @@ def render_fleet(snap):
         f"journal {journal.get('entries', 0)} entries ({states_str})  "
         f"dup_dropped {journal.get('dup_tokens_dropped', 0)}  "
         f"lost {journal.get('lost', 0)}")
+    front = snap.get("front_queue")
+    if front:
+        lines.append(
+            f"front queue {front.get('depth', 0)} waiting  "
+            f"oldest {front.get('oldest_s', 0.0):.2f}s")
     tenants = snap.get("tenants", {})
     if tenants:
         lines.append("queued  " + "  ".join(

@@ -248,6 +248,89 @@ def main():
         f"({t_plain:.4f}s vs {t_slo:.4f}s) — the serving collectors "
         f"are not short-circuiting")
 
+    # -- fleet observatory (gateway + router zero-cost-when-off) --------
+    import http.client as _http_client
+
+    from incubator_mxnet_tpu.resilience import fault as _fault
+    from incubator_mxnet_tpu.serving import FleetRouter, ServingGateway
+
+    _fault.install(_fault.FaultInjector("", 0))
+    fleet = FleetRouter(heartbeat_timeout=60.0)
+    for _ in range(2):
+        fleet.add_replica(ServingEngine(sparams, cfg, slots=2,
+                                        page_size=8, num_pages=16))
+    fleet.start(interval=0.001)
+    gw = ServingGateway(fleet, port=0, queue_limit=64,
+                        max_occupancy=0.99)
+
+    def gateway_loop(port):
+        for _ in range(3):
+            conn = _http_client.HTTPConnection("127.0.0.1", port,
+                                               timeout=120)
+            conn.request("POST", "/v1/generate", json.dumps({
+                "prompt": [int(t) for t in rng.randint(1, cfg.vocab, 5)],
+                "max_new_tokens": 4, "stream": False}))
+            resp = conn.getresponse()
+            body = resp.read()
+            conn.close()
+            assert resp.status == 200, (resp.status, body[:200])
+
+    try:
+        gateway_loop(gw.port)  # warm the gateway path on both replicas
+
+        # tracing off => the WHOLE serving stack (gateway root span,
+        # router dispatch/failover spans, journal delivery records,
+        # replica request spans) must emit ZERO trace records
+        assert not _distributed.trace_active()
+        emitted = []
+        orig_record = _distributed.record_span
+        _distributed.record_span = emitted.append
+        try:
+            gateway_loop(gw.port)
+        finally:
+            _distributed.record_span = orig_record
+        assert not emitted, (
+            f"{len(emitted)} trace record(s) emitted by the "
+            "gateway/router/replica path while tracing was off — the "
+            "fleet trace path is not free")
+
+        # /metrics federation sanity: rollups plus per-replica series
+        # under the replica label, from one scrape of the gateway
+        telemetry.enable()
+        conn = _http_client.HTTPConnection("127.0.0.1", gw.port,
+                                           timeout=120)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        fed = resp.read().decode()
+        conn.close()
+        assert resp.status == 200
+        for needle in ("mxtpu_fleet_total_queue_depth",
+                       "mxtpu_fleet_queue_depth",
+                       "mxtpu_fleet_oldest_queued_seconds",
+                       "mxtpu_fleet_page_occupancy",
+                       'mxtpu_fleet_replica_health{replica="r1"',
+                       'mxtpu_fleet_replica_page_occupancy{replica="r2"'):
+            assert needle in fed, f"/metrics federation missing {needle}"
+        telemetry.disable()
+
+        # disabled-overhead gate over the gateway+fleet loop: the
+        # telemetry-off HTTP round trip must stay within the same 5%
+        # bound (paired rounds absorb the loopback-HTTP noise)
+        t_gw_off, t_gw_on = timed_ab(steps, telemetry.disable,
+                                     telemetry.enable, (gw.port,),
+                                     loop=gateway_loop)
+        telemetry.disable()
+        print(f"fleet observatory: off={t_gw_off * 1e3:.2f}ms "
+              f"on={t_gw_on * 1e3:.2f}ms (best of {steps})")
+        assert t_gw_off <= t_gw_on * TOLERANCE, (
+            f"gateway+fleet loop with telemetry disabled is "
+            f">{(TOLERANCE - 1) * 100:.0f}% slower than enabled "
+            f"({t_gw_off:.4f}s vs {t_gw_on:.4f}s) — the fleet "
+            f"observatory is not short-circuiting")
+    finally:
+        gw.close()
+        fleet.stop()
+
     # -- runtime sanitizers (zero-cost-when-off contract) ---------------
     import threading as _threading
 

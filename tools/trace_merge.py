@@ -15,6 +15,14 @@ spans to its own binary-framed trace file; this tool fuses them:
         # serving view: one Perfetto lane per request (queued -> prefill
         # -> decode under the serving.request root) plus a per-request
         # report: TTFT, queue wait, tokens, decode steps, finish reason
+    python tools/trace_merge.py /tmp/traces --fleet --check
+        # fleet observatory: per-entry failover table (gateway/router/
+        # per-replica lanes come free — every record carries its lane)
+        # and the failover causal-chain validation: one trace per
+        # request, every replica span chained to a router dispatch,
+        # exactly one failover span per failover resubmission with the
+        # victim AND survivor lanes present, and the journal-delivery
+        # audit (no token position delivered twice, positions monotone)
 
 Open `timeline.json` in Perfetto (ui.perfetto.dev) or chrome://tracing:
 one row group ("process") per lane — r0, r1, ..., server — with the
@@ -317,6 +325,178 @@ def check_requests(records, req_steps):
     return problems
 
 
+FLEET_DISPATCH = "fleet.dispatch"
+FLEET_FAILOVER = "fleet.failover"
+FLEET_RESUBMIT = "fleet.resubmit"
+GATEWAY_ROOT = "gateway.request"
+
+
+def _fleet_records(records):
+    """Group the fleet-level records (gateway roots, router dispatch/
+    failover/resubmit spans) by journal entry id."""
+    fleet = {"dispatch": {}, "failover": {}, "resubmit": {},
+             "gateway": {}}
+    for r in records:
+        ent = (r.get("extra") or {}).get("entry")
+        if r["name"] == FLEET_DISPATCH:
+            fleet["dispatch"].setdefault(ent, []).append(r)
+        elif r["name"] == FLEET_FAILOVER:
+            fleet["failover"].setdefault(ent, []).append(r)
+        elif r["name"] == FLEET_RESUBMIT:
+            fleet["resubmit"].setdefault(ent, []).append(r)
+        elif r["name"] == GATEWAY_ROOT and ent is not None:
+            fleet["gateway"][ent] = r
+    return fleet
+
+
+def fleet_report(records, deliveries, directory):
+    """Per-request failover table for the fleet observatory view."""
+    fleet = _fleet_records(records)
+    delivered = {}
+    for r in deliveries:
+        delivered[r["entry"]] = delivered.get(r["entry"], 0) + r["n"]
+    entries = sorted(set(fleet["dispatch"]) | set(fleet["failover"])
+                     | set(fleet["resubmit"]) | set(delivered))
+    rows = []
+    for ent in entries:
+        disp = sorted(fleet["dispatch"].get(ent, []),
+                      key=lambda r: r["ts"])
+        fos = sorted(fleet["failover"].get(ent, []),
+                     key=lambda r: r["ts"])
+        gw = fleet["gateway"].get(ent)
+        tid = (disp or fos or [{}])[0].get("tid")
+        rows.append({
+            "entry": ent,
+            "trace_id": tid,
+            "tenant": ((gw.get("extra") or {}).get("tenant")
+                       if gw else None),
+            "gateway": gw is not None,
+            "replicas": [(r.get("extra") or {}).get("replica")
+                         for r in disp],
+            "failovers": len(fos),
+            "causes": sorted({(r.get("extra") or {}).get("cause")
+                              for r in fos}),
+            "resubmits": len(fleet["resubmit"].get(ent, [])),
+            "tokens_delivered": delivered.get(ent, 0),
+        })
+    dumps = sorted(f for f in os.listdir(directory)
+                   if f.startswith("flightrec-") and f.endswith(".json"))
+    return {"entries": rows, "count": len(rows),
+            "lanes": sorted({r["lane"] for r in records}),
+            "failovers": sum(len(v)
+                             for v in fleet["failover"].values()),
+            "dumps": dumps}
+
+
+def print_fleet_report(report):
+    print(f"fleet lanes: {', '.join(report['lanes'])}")
+    print(f"{'entry':<7}{'trace_id':<18}{'tenant':<10}{'gw':>4}"
+          f"{'fails':>7}{'resub':>7}{'tokens':>8}  replicas (causes)")
+    for row in report["entries"]:
+        causes = ",".join(c for c in row["causes"] if c)
+        reps = "->".join(str(r) for r in row["replicas"]) or "-"
+        print(f"{str(row['entry']):<7}{str(row['trace_id']):<18}"
+              f"{str(row['tenant'] or '-'):<10}"
+              f"{'y' if row['gateway'] else '-':>4}"
+              f"{row['failovers']:>7}{row['resubmits']:>7}"
+              f"{row['tokens_delivered']:>8}"
+              f"  {reps}{f' ({causes})' if causes else ''}")
+    print(f"entries: {report['count']}, failovers: "
+          f"{report['failovers']}, post-mortem dumps: "
+          f"{len(report['dumps'])}")
+
+
+def check_fleet(records, deliveries):
+    """Failover causal-chain validation (--fleet --check): every
+    replica-side serving.request chains to a router fleet.dispatch in
+    the SAME trace, failover spans pair one-to-one with failover
+    resubmissions and both the victim's and the survivor's lanes hold
+    spans of that trace, dispatches parent under the gateway root when
+    one exists, and the journal-delivery audit proves no token position
+    was ever delivered twice. Returns problem strings."""
+    problems = []
+    fleet = _fleet_records(records)
+    if not fleet["dispatch"]:
+        problems.append("--fleet: no fleet.dispatch records")
+        return problems
+    dispatch_by_sid = {r["sid"]: r
+                       for ds in fleet["dispatch"].values() for r in ds}
+    # a serving.request with a missing/foreign parent is a BROKEN
+    # CHAIN: the failed-over request forked a second, orphaned trace
+    for r in records:
+        if r["name"] != REQ_ROOT:
+            continue
+        where = (f"serving.request "
+                 f"{(r.get('extra') or {}).get('request')} "
+                 f"on {r['lane']}")
+        parent = dispatch_by_sid.get(r.get("pid"))
+        if parent is None:
+            problems.append(f"{where}: orphaned — no fleet.dispatch "
+                            f"parent (broken causal chain)")
+        elif parent["tid"] != r["tid"]:
+            problems.append(f"{where}: trace id {r['tid']} differs "
+                            f"from its dispatch's {parent['tid']}")
+    lanes_by_tid = {}
+    for r in records:
+        lanes_by_tid.setdefault(r["tid"], set()).add(r["lane"])
+    failed_over = set(fleet["failover"])
+    failed_over.update(
+        ent for ent, rs in fleet["resubmit"].items()
+        if any((r.get("extra") or {}).get("reason") == "failover"
+               for r in rs))
+    for ent in sorted(failed_over, key=lambda x: (x is None, x)):
+        where = f"entry {ent}"
+        fos = fleet["failover"].get(ent, [])
+        resub_fo = [r for r in fleet["resubmit"].get(ent, [])
+                    if (r.get("extra") or {}).get("reason") == "failover"]
+        if len(fos) != len(resub_fo):
+            problems.append(
+                f"{where}: {len(fos)} failover spans for "
+                f"{len(resub_fo)} failover resubmissions (must be "
+                f"exactly one per resubmission)")
+        epochs = [(r.get("extra") or {}).get("epoch") for r in fos]
+        if len(set(epochs)) != len(epochs):
+            problems.append(f"{where}: failover spans share an epoch")
+        tids = ({r["tid"] for r in fos}
+                | {r["tid"] for r in fleet["dispatch"].get(ent, [])})
+        if len(tids) > 1:
+            problems.append(f"{where}: fleet records span {len(tids)} "
+                            f"trace ids (one trace per request)")
+        for r in fos:
+            extra = r.get("extra") or {}
+            lanes = lanes_by_tid.get(r["tid"], set())
+            for side in ("victim", "survivor"):
+                rep = extra.get(side)
+                if rep is not None and rep not in lanes:
+                    problems.append(f"{where}: no spans on the {side} "
+                                    f"replica lane {rep!r}")
+    for ent, gw in sorted(fleet["gateway"].items()):
+        for r in fleet["dispatch"].get(ent, []):
+            if r["tid"] != gw["tid"]:
+                problems.append(f"entry {ent}: dispatch trace id "
+                                f"differs from the gateway root's")
+            elif r.get("pid") != gw["sid"]:
+                problems.append(f"entry {ent}: dispatch does not "
+                                f"parent under the gateway.request span")
+    # journal-position audit: each entry's accepted deliveries must
+    # tile [0, total) exactly — an overlap is a token position
+    # delivered twice, a gap a non-monotone journal
+    per_entry = {}
+    for r in deliveries:
+        per_entry.setdefault(r["entry"], []).append(r)
+    for ent, recs in sorted(per_entry.items()):
+        pos = 0
+        for r in sorted(recs, key=lambda r: r["start"]):
+            if r["start"] < pos:
+                problems.append(f"entry {ent}: token position "
+                                f"{r['start']} delivered twice")
+            elif r["start"] > pos:
+                problems.append(f"entry {ent}: journal positions jump "
+                                f"{pos} -> {r['start']}")
+            pos = max(pos, r["start"] + r["n"])
+    return problems
+
+
 def straggler_report(records, directory):
     """Per-lane barrier-wait ranking + retry/error evidence."""
     lanes = {}
@@ -425,9 +605,19 @@ def main(argv=None):
     ap.add_argument("--requests-json",
                     help="also write the per-request report as JSON "
                          "(implies --requests)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet observatory view: print the per-request "
+                         "failover table; with --check also validate "
+                         "every failover causal chain and the "
+                         "journal-delivery audit")
+    ap.add_argument("--fleet-json",
+                    help="also write the fleet report as JSON "
+                         "(implies --fleet)")
     args = ap.parse_args(argv)
     if args.requests_json:
         args.requests = True
+    if args.fleet_json:
+        args.fleet = True
 
     all_records, files = load_dir(args.trace_dir)
     if not files:
@@ -439,6 +629,8 @@ def main(argv=None):
     # the span pipeline touches those fields
     mem_records = [r for r in all_records if r.get("kind") == "mem"]
     req_steps = [r for r in all_records if r.get("kind") == "req_step"]
+    deliveries = [r for r in all_records
+                  if r.get("kind") == "fleet_delivery"]
     records = [r for r in all_records if r.get("kind") is None]
     if not records:
         print(f"trace_merge: no span records in {args.trace_dir}",
@@ -484,10 +676,19 @@ def main(argv=None):
             with open(args.requests_json, "w", encoding="utf-8") as f:
                 json.dump(req_report, f, indent=2)
             print(f"wrote {args.requests_json}")
+    if args.fleet:
+        flt_report = fleet_report(records, deliveries, args.trace_dir)
+        print_fleet_report(flt_report)
+        if args.fleet_json:
+            with open(args.fleet_json, "w", encoding="utf-8") as f:
+                json.dump(flt_report, f, indent=2)
+            print(f"wrote {args.fleet_json}")
     if args.check:
         problems = check_timeline(timeline, records)
         if args.requests:
             problems.extend(check_requests(records, req_steps))
+        if args.fleet:
+            problems.extend(check_fleet(records, deliveries))
         if problems:
             for p in problems:
                 print(f"trace_merge: CHECK FAILED: {p}", file=sys.stderr)
